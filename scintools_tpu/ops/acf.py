@@ -1,0 +1,69 @@
+"""2-D autocovariance of a dynamic spectrum (Wiener–Khinchin).
+
+Reference: ``Dynspec.calc_acf`` (dynspec.py:1337-1360): mean-subtract ->
+``fft2`` zero-padded to [2nf, 2nt] -> |.|^2 -> ``ifft2`` -> ``fftshift`` ->
+real part.
+
+numpy path reproduces that exactly (including taking the mean over valid
+pixels only, dynspec.py:1344).  jax path is the same math on ``jnp.fft``,
+jit-compiled, operating on the last two axes so it vmaps over a batch of
+epochs for free.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..backend import resolve
+
+
+def acf(dyn, backend: str = "numpy", subtract_mean: bool = True):
+    """Autocovariance, output shape [..., 2*nf, 2*nt]."""
+    backend = resolve(backend)
+    if backend == "numpy":
+        return _acf_numpy(np.asarray(dyn), subtract_mean)
+    return _acf_jax()(dyn, subtract_mean)
+
+
+def _acf_numpy(arr: np.ndarray, subtract_mean: bool) -> np.ndarray:
+    if subtract_mean:
+        # per-epoch valid-pixel mean (matches the jax path on batched input;
+        # identical to the reference's global mean for a single epoch)
+        valid = np.isfinite(arr)
+        denom = np.maximum(valid.sum(axis=(-2, -1), keepdims=True), 1)
+        mean = np.where(valid, arr, 0).sum(axis=(-2, -1), keepdims=True) / denom
+        arr = arr - mean
+    nf, nt = arr.shape[-2], arr.shape[-1]
+    a = np.fft.fft2(arr, s=[2 * nf, 2 * nt])
+    a = np.abs(a)
+    a **= 2
+    a = np.fft.ifft2(a)
+    a = np.fft.fftshift(a, axes=(-2, -1))
+    return np.real(a)
+
+
+@functools.lru_cache(maxsize=1)
+def _acf_jax():
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def impl(arr, subtract_mean):
+        if subtract_mean:
+            # jit-friendly masked mean (no boolean indexing): invalid pixels
+            # are excluded via where=; matches numpy on gap-free input.
+            valid = jnp.isfinite(arr)
+            denom = jnp.sum(valid, axis=(-2, -1), keepdims=True)
+            mean = (jnp.sum(jnp.where(valid, arr, 0.0), axis=(-2, -1),
+                            keepdims=True) / denom)
+            arr = arr - mean
+        nf, nt = arr.shape[-2], arr.shape[-1]
+        a = jnp.fft.fft2(arr, s=[2 * nf, 2 * nt])
+        p = jnp.real(a) ** 2 + jnp.imag(a) ** 2
+        a = jnp.fft.ifft2(p)
+        a = jnp.fft.fftshift(a, axes=(-2, -1))
+        return jnp.real(a)
+
+    return impl

@@ -1,0 +1,39 @@
+"""Test harness: 8 virtual CPU devices so mesh/pmap/shard_map paths are
+testable without TPU hardware (SURVEY.md §4.5), and float64 enabled so the
+jax path can be compared against the reference-compatible numpy path at
+tight tolerances."""
+
+import os
+
+# Must run before jax is first imported anywhere in the test process.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# The axon sitecustomize imports jax at interpreter boot with
+# JAX_PLATFORMS=axon, so the env var alone is too late — switch the platform
+# through the config (backends initialise lazily, so this still wins).
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def sim_dynspec():
+    """A small seeded simulated dynamic spectrum shared across tests."""
+    from scintools_tpu.io import from_simulation
+    from scintools_tpu.sim import Simulation
+
+    sim = Simulation(mb2=2, ns=64, nf=64, dlam=0.25, seed=64)
+    return from_simulation(sim, freq=1400.0, dt=2.0)

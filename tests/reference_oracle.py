@@ -1,0 +1,44 @@
+"""Import the reference implementation (read-only at /root/reference) as a
+test oracle for bit-match assertions.  The reference is UNTRUSTED third-party
+code: we only call its numeric functions and compare outputs — nothing from
+it is executed at import time beyond module definitions."""
+
+import os
+import sys
+
+os.environ.setdefault("MPLBACKEND", "Agg")
+
+_REF = "/root/reference/scintools"
+
+
+def reference_modules():
+    """Return (dynspec, scint_sim, scint_models, scint_utils) reference
+    modules, or None if unavailable."""
+    if not os.path.isdir(_REF):
+        return None
+    if _REF not in sys.path:
+        sys.path.insert(0, _REF)
+    try:
+        import dynspec as ref_dynspec  # noqa
+        import scint_models as ref_models  # noqa
+        import scint_sim as ref_sim  # noqa
+        import scint_utils as ref_utils  # noqa
+
+        return ref_dynspec, ref_sim, ref_models, ref_utils
+    except Exception:
+        return None
+
+
+def make_ref_dynspec(d):
+    """Build a reference Dynspec object (process=False) from DynspecData."""
+    import numpy as np
+
+    mods = reference_modules()
+    assert mods is not None
+    ref_dynspec = mods[0]
+    bd = ref_dynspec.BasicDyn(
+        np.array(d.dyn, dtype=np.float64), name=d.name, header=list(d.header),
+        times=np.asarray(d.times), freqs=np.asarray(d.freqs),
+        nchan=d.nchan, nsub=d.nsub, bw=d.bw, df=d.df, freq=d.freq,
+        tobs=d.tobs, dt=d.dt, mjd=d.mjd)
+    return ref_dynspec.Dynspec(dyn=bd, verbose=False, process=False)

@@ -1,0 +1,141 @@
+"""Ingest/IO tests: psrflux round-trip + reference-loader parity, par
+parser, results CSV, adapters (SURVEY.md §4 item 1)."""
+
+import numpy as np
+import pytest
+
+from scintools_tpu.data import DynspecData, stack_batch
+from scintools_tpu.io import (concatenate_time, from_arrays, from_simulation,
+                              float_array_from_dict, pars_to_params, read_par,
+                              read_psrflux, read_results, results_row,
+                              write_psrflux, write_results)
+
+from reference_oracle import reference_modules
+
+
+def _small_dyn(rng=None):
+    rng = rng or np.random.default_rng(0)
+    nchan, nsub = 16, 24
+    return from_arrays(
+        dyn=rng.standard_normal((nchan, nsub)) + 10,
+        times=30.0 * (np.arange(nsub) + 0.5),
+        freqs=1400.0 + 1.0 * np.arange(nchan),
+        df=1.0, dt=30.0, mjd=55000.0, name="test.dynspec")
+
+
+def test_psrflux_roundtrip(tmp_path):
+    d = _small_dyn()
+    path = str(tmp_path / "t.dynspec")
+    write_psrflux(d, path)
+    d2 = read_psrflux(path)
+    np.testing.assert_allclose(np.asarray(d2.dyn), np.asarray(d.dyn),
+                               rtol=1e-7)
+    np.testing.assert_allclose(d2.freqs, d.freqs, rtol=1e-9)
+    assert d2.mjd == d.mjd
+    assert d2.nchan == d.nchan and d2.nsub == d.nsub
+
+
+def test_psrflux_matches_reference_loader(tmp_path):
+    mods = reference_modules()
+    if mods is None:
+        pytest.skip("reference not available")
+    ref_dynspec = mods[0]
+    d = _small_dyn()
+    path = str(tmp_path / "t.dynspec")
+    write_psrflux(d, path)
+    rd = ref_dynspec.Dynspec(filename=path, verbose=False, process=False)
+    ours = read_psrflux(path)
+    np.testing.assert_allclose(np.asarray(ours.dyn), rd.dyn, rtol=1e-12)
+    np.testing.assert_allclose(ours.freqs, rd.freqs)
+    assert ours.nchan == rd.nchan and ours.nsub == rd.nsub
+    assert ours.df == rd.df and ours.bw == rd.bw
+    assert ours.dt == rd.dt and ours.tobs == rd.tobs
+    assert ours.mjd == rd.mjd
+
+
+def test_read_par(tmp_path):
+    p = tmp_path / "psr.par"
+    p.write_text(
+        "PSRJ     J0437-4715\n"
+        "RAJ      04:37:15.8  1  0.1\n"
+        "DECJ     -47:15:09.1  1  0.2\n"
+        "F0       173.6879458121843  1  1e-12\n"
+        "PB       5.741  0  1D-5\n"
+        "E        1.9180D-5\n"
+        "DMMODEL  ignore-me\n"
+        "# comment\n")
+    par = read_par(str(p))
+    assert par["PSRJ"] == "J0437-4715"
+    assert par["ECC"] == pytest.approx(1.918e-5)
+    assert par["PB_ERR"] == pytest.approx(1e-5)
+    assert par["F0_TYPE"] == "f"
+    assert "DMMODEL" not in par
+    params = pars_to_params(par)
+    # RAJ: 4h37m15.8s -> radians
+    assert params["RAJ"] == pytest.approx(
+        (4 + 37 / 60 + 15.8 / 3600) * np.pi / 12)
+    assert params["DECJ"] == pytest.approx(
+        -(47 + 15 / 60 + 9.1 / 3600) * np.pi / 180)
+
+
+def test_read_par_matches_reference(tmp_path):
+    mods = reference_modules()
+    if mods is None:
+        pytest.skip("reference not available")
+    ref_utils = mods[3]
+    p = tmp_path / "psr.par"
+    p.write_text("F0  173.68  1  1e-12\nPB  5.741\nE  1.918D-5\nNITS 1\n")
+    assert read_par(str(p)) == ref_utils.read_par(str(p))
+
+
+def test_results_roundtrip(tmp_path):
+    path = str(tmp_path / "results.csv")
+    d = _small_dyn()
+
+    class S:  # minimal fit-result stand-ins
+        tau, tauerr, dnu, dnuerr = 100.0, 5.0, 1.5, 0.1
+
+    class A:
+        eta, etaerr, lamsteps = 0.5, 0.05, True
+
+    write_results(path, results_row(d, scint=S, arc=A))
+    write_results(path, results_row(d))  # row without fits appends fine
+    out = read_results(path)
+    assert out["name"][0] == "test.dynspec"
+    np.testing.assert_allclose(float_array_from_dict(out, "tau"), [100.0])
+    assert "betaeta" in out
+
+
+def test_concatenate_time_gap():
+    a = _small_dyn()
+    b = a.replace(mjd=a.mjd + (a.tobs + 300) / 86400, name="b.dynspec")
+    c = concatenate_time(a, b)
+    assert c.nsub > a.nsub + b.nsub  # gap inserted
+    assert c.tobs == pytest.approx(a.tobs + 300 + b.tobs, rel=1e-6)
+    # gap region is zero-filled
+    gap = np.asarray(c.dyn)[:, a.nsub:c.nsub - b.nsub]
+    assert np.all(gap == 0)
+
+
+def test_stack_batch():
+    a, b = _small_dyn(), _small_dyn(np.random.default_rng(1))
+    batch = stack_batch([a, b])
+    assert batch.dyn.shape == (2, a.nchan, a.nsub)
+    assert batch.mjd.shape == (2,)
+
+
+def test_from_simulation_matches_reference_simdyn():
+    mods = reference_modules()
+    if mods is None:
+        pytest.skip("reference not available")
+    ref_dynspec, ref_sim = mods[0], mods[1]
+    rs = ref_sim.Simulation(ns=32, nf=8, dlam=0.25, seed=9, verbose=False)
+    sd = ref_dynspec.SimDyn(rs, freq=1400.0, dt=0.5)
+
+    from scintools_tpu.sim import Simulation
+
+    ours_sim = Simulation(ns=32, nf=8, dlam=0.25, seed=9)
+    ours = from_simulation(ours_sim, freq=1400.0, dt=0.5)
+    np.testing.assert_allclose(np.asarray(ours.dyn), sd.dyn, rtol=1e-12)
+    np.testing.assert_allclose(ours.freqs, sd.freqs, rtol=1e-12)
+    assert ours.name == sd.name

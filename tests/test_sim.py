@@ -1,0 +1,118 @@
+"""Simulator tests: seeded bit-match vs the reference, closed-form Fresnel
+filter vs the reference's quadrant construction, jax path statistics."""
+
+import numpy as np
+import pytest
+
+from scintools_tpu.sim import (SimParams, Simulation, fresnel_filter,
+                               frequency_scales, screen_weights,
+                               screen_weights_reference, simulate,
+                               simulate_ensemble, simulate_intensity)
+
+from reference_oracle import reference_modules
+
+P_SMALL = SimParams(nx=32, ny=32, nf=8, dlam=0.25)
+
+
+@pytest.fixture(scope="module")
+def ref_sim_mod():
+    mods = reference_modules()
+    if mods is None:
+        pytest.skip("reference not available")
+    return mods[1]
+
+
+def test_screen_weights_reference_bitmatch(ref_sim_mod):
+    """Our vectorised reference-weights construction reproduces the
+    reference's loop construction element-for-element."""
+    rs = ref_sim_mod.Simulation(ns=32, nf=2, seed=7, verbose=False)
+    ours = screen_weights_reference(SimParams(nx=32, ny=32, nf=2))
+    # rebuild reference w from its own code path: xyp = real(fft2(w*z)) is
+    # not invertible, so instead compare against a fresh manual run of its
+    # get_screen internals via the same seed: weights are deterministic,
+    # so compare screens after seeding identically.
+    np.random.seed(7)
+    z = np.random.randn(32, 32) + 1j * np.random.randn(32, 32)
+    screen = np.real(np.fft.fft2(ours * z))
+    np.testing.assert_allclose(screen, rs.xyp, rtol=1e-12, atol=1e-12)
+
+
+def test_simulation_bitmatch_reference(ref_sim_mod):
+    """Seeded numpy-path Simulation reproduces the reference E-field and
+    intensity exactly."""
+    rs = ref_sim_mod.Simulation(ns=32, nf=8, dlam=0.25, seed=11,
+                                verbose=False)
+    ours = Simulation(ns=32, nf=8, dlam=0.25, seed=11)
+    np.testing.assert_allclose(ours.xyp, rs.xyp, rtol=1e-12, atol=1e-12)
+    np.testing.assert_array_equal(ours.spe, rs.spe)
+    np.testing.assert_array_equal(ours.spi, rs.spi)
+
+
+def test_simulation_lamsteps_bitmatch(ref_sim_mod):
+    rs = ref_sim_mod.Simulation(ns=32, nf=8, dlam=0.25, seed=3,
+                                lamsteps=True, verbose=False)
+    ours = Simulation(ns=32, nf=8, dlam=0.25, seed=3, lamsteps=True)
+    np.testing.assert_array_equal(ours.spe, rs.spe)
+
+
+def test_simulation_anisotropic_bitmatch(ref_sim_mod):
+    rs = ref_sim_mod.Simulation(ns=32, nf=4, ar=2.0, psi=30.0, seed=5,
+                                verbose=False)
+    ours = Simulation(ns=32, nf=4, ar=2.0, psi=30.0, seed=5)
+    np.testing.assert_array_equal(ours.spe, rs.spe)
+
+
+def test_fresnel_filter_matches_reference_quadrants(ref_sim_mod):
+    """Closed-form full-grid filter == reference frfilt3 quadrant updates."""
+    rs = ref_sim_mod.Simulation(ns=16, nf=2, seed=1, verbose=False)
+    scale = 0.9
+    xye = (np.arange(256).reshape(16, 16) + 0.5).astype(np.complex128)
+    expected = ref_sim_mod.Simulation.frfilt3(rs, xye.copy(), scale)
+    p = SimParams(nx=16, ny=16, nf=1)
+    ours = xye * fresnel_filter(p, scale, xp=np).astype(np.complex64)
+    np.testing.assert_allclose(ours, expected, rtol=1e-6, atol=1e-6)
+
+
+def test_clean_vs_reference_weights_interior():
+    """Clean signed-frequency weights equal the reference construction away
+    from the kx/ky axis lines (where the reference has off-by-ones)."""
+    p = SimParams(nx=16, ny=16, nf=1, ar=1.5, psi=20.0)
+    wc = screen_weights(p)
+    wr = screen_weights_reference(p)
+    np.testing.assert_allclose(wc[1:8, 1:8], wr[1:8, 1:8], rtol=1e-12)
+    np.testing.assert_allclose(wc[9:, 1:8], wr[9:, 1:8], rtol=1e-12)
+
+
+def test_jax_simulation_statistics():
+    """jax path produces a physically sane dynamic spectrum: finite,
+    positive intensity with scintillation contrast."""
+    import jax
+
+    p = SimParams(nx=64, ny=64, nf=16, dlam=0.25)
+    spi = np.asarray(simulate_intensity(jax.random.PRNGKey(0), p))
+    assert spi.shape == (64, 16)
+    assert np.all(np.isfinite(spi)) and np.all(spi >= 0)
+    m = spi.mean()
+    # weak-to-moderate scattering: modulation index well above zero
+    assert spi.std() / m > 0.05
+
+
+def test_jax_freq_chunking_consistent():
+    import jax
+
+    p = SimParams(nx=32, ny=32, nf=8)
+    key = jax.random.PRNGKey(2)
+    full = np.asarray(simulate(key, p))
+    chunked = np.asarray(simulate(key, p, freq_chunk=4))
+    np.testing.assert_allclose(full, chunked, rtol=1e-10, atol=1e-12)
+
+
+def test_ensemble_shapes():
+    import jax
+
+    p = SimParams(nx=16, ny=16, nf=4)
+    keys = jax.random.split(jax.random.PRNGKey(0), 8)
+    out = np.asarray(simulate_ensemble(keys, p, screen_chunk=4))
+    assert out.shape == (8, 16, 4)
+    one = np.asarray(simulate_intensity(keys[3], p))
+    np.testing.assert_allclose(out[3], one, rtol=1e-10, atol=1e-12)
