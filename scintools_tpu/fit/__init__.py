@@ -1,0 +1,6 @@
+from .arc_fit import NormSspec, fit_arc, make_arc_fitter, norm_sspec  # noqa: F401
+from .filters import savgol1  # noqa: F401
+from .lm import (LsqResult, least_squares_numpy, lm_fit_batched,  # noqa: F401
+                 lm_fit_jax)
+from .scint_fit import (acf_cuts, fit_scint_params,  # noqa: F401
+                        fit_scint_params_batch, initial_guesses)

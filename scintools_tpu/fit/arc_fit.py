@@ -1,0 +1,487 @@
+"""Scintillation-arc curvature fitting.
+
+Reference: ``Dynspec.fit_arc`` (dynspec.py:414-785) and
+``Dynspec.norm_sspec`` (dynspec.py:787-926).  Two methods:
+
+* ``norm_sspec`` (flagship): normalise the Doppler axis of every delay row
+  by ``sqrt(tdel/eta_min)``, delay-scrunch to a 1-D power-vs-normalised-fdop
+  profile, fold the two arms, map normalised fdop back to an eta grid, and
+  fit a parabola around the smoothed peak (dynspec.py:661-771, 787-926).
+* ``gridmax``: for each trial eta, sample the secondary spectrum along
+  ``tdel = eta*fdop^2`` with bilinear interpolation and find the eta
+  maximising mean power (dynspec.py:516-659).
+
+The numpy path replicates the reference step-for-step (minus plotting),
+including its quirks: the double delmax frequency adjustment
+(dynspec.py:428-429 then 796-797), the value-matching peak lookup
+``argmin(|filt - max_inrange|)`` (dynspec.py:698), the asymmetric walk
+guard ``ind + ind1 < len-1`` on the *left* walk (dynspec.py:581-582), and
+the +2 dB profile shift when the profile at normalised fdop=1 is negative
+(dynspec.py:864-866).
+
+The jax path (:func:`make_arc_fitter`) is the fixed-shape SPMD rebuild:
+row-normalisation becomes a vmapped clamped ``jnp.interp`` (identical
+values to masked interp because linear interpolation is local and scale-
+invariant), NaN masks replace boolean compaction, the -3 dB walks become
+first-crossing reductions, and the windowed parabola fit uses 0/1 weights —
+so one jit compiles the whole measurement for a [B, nr, nc] batch of
+epochs.  Agreement with the numpy path is asserted on synthetic arcs in
+tests (not bit-equal: the walk guard quirk and boundary smoothing differ;
+documented there).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import numpy as np
+from scipy.ndimage import map_coordinates
+from scipy.signal import savgol_filter
+
+from ..backend import resolve
+from ..data import ArcFit, SecSpec
+from ..models.parabola import fit_log_parabola, fit_parabola
+
+C_M_S = 299792458.0
+
+
+@dataclasses.dataclass(frozen=True)
+class NormSspec:
+    """Normalised secondary spectrum (dynspec.py:923-925)."""
+
+    normsspec: Any      # [ntdel, nfdop]
+    normsspecavg: Any   # [nfdop] delay-scrunched profile
+    powerspec: Any      # [ntdel] fdop-scrunched power spectrum
+    tdel: Any           # [ntdel] cut delay (or beta) axis
+    fdopnew: Any        # [nfdop] normalised fdop axis
+
+
+def _beta_to_eta_factor(freq: float, ref_freq: float) -> float:
+    """Unit conversion used when fitting in tdel rather than beta space
+    (dynspec.py:494-499)."""
+    return C_M_S * 1e6 / ((ref_freq * 1e6) ** 2)
+
+
+def norm_sspec(sec: SecSpec, freq: float, eta: float, delmax=None,
+               startbin: int = 1, maxnormfac: float = 2, cutmid: int = 3,
+               numsteps: int | None = None, ref_freq: float = 1400.0
+               ) -> NormSspec:
+    """Normalise the fdop axis by the arc curvature (dynspec.py:787-926,
+    compute only).  ``eta`` is in the units of ``sec``'s delay axis (beta
+    for lamsteps, converted internally otherwise, dynspec.py:820-825)."""
+    sspec = np.array(sec.sspec, dtype=np.float64)
+    yaxis = np.asarray(sec.beta if sec.lamsteps else sec.tdel,
+                       dtype=np.float64)
+    tdel_axis = np.asarray(sec.tdel)
+    fdop = np.asarray(sec.fdop, dtype=np.float64)
+
+    delmax = np.max(tdel_axis) if delmax is None else delmax
+    delmax = delmax * (ref_freq / freq) ** 2
+
+    if not sec.lamsteps:
+        eta = eta / (freq / ref_freq) ** 2
+        eta = eta * _beta_to_eta_factor(freq, ref_freq)
+
+    ind = np.argmin(np.abs(tdel_axis - delmax))
+    sspec = sspec[startbin:ind, :]
+    nr, nc = sspec.shape
+    sspec[:, int(nc / 2 - np.floor(cutmid / 2)):
+          int(nc / 2 + np.floor(cutmid / 2))] = np.nan
+    tdel = yaxis[startbin:ind]
+
+    maxfdop = maxnormfac * np.sqrt(tdel[-1] / eta)
+    if maxfdop > np.max(fdop):
+        maxfdop = np.max(fdop)
+    nfdop = (2 * len(fdop[np.abs(fdop) <= maxfdop]) if numsteps is None
+             else int(numsteps))
+    fdopnew = np.linspace(-maxnormfac, maxnormfac, nfdop)
+
+    norm_rows = []
+    for ii in range(len(tdel)):
+        itdel = tdel[ii]
+        imaxfdop = maxnormfac * np.sqrt(itdel / eta)
+        mask = np.abs(fdop) <= imaxfdop
+        ifdop = fdop[mask] / np.sqrt(itdel / eta)
+        isspec = sspec[ii, mask]
+        norm_rows.append(np.interp(fdopnew, ifdop, isspec))
+    norm_arr = np.array(norm_rows)
+    isspecavg = np.nanmean(norm_arr, axis=0)
+    powerspec = np.nanmean(norm_arr, axis=1)
+    ind1 = np.argmin(np.abs(fdopnew - 1) - 2)
+    if isspecavg[ind1] < 0:
+        isspecavg = isspecavg + 2  # reference's dB-offset quirk
+    return NormSspec(normsspec=norm_arr, normsspecavg=isspecavg,
+                     powerspec=powerspec, tdel=tdel, fdopnew=fdopnew)
+
+
+def _noise_estimate(sspec: np.ndarray, cutmid: int, xp=np) -> float:
+    """Noise from the outer Doppler quadrants at high delay
+    (dynspec.py:446-451)."""
+    nr, nc = sspec.shape[-2], sspec.shape[-1]
+    a = sspec[..., nr // 2:, int(nc / 2 + np.ceil(cutmid / 2)):]
+    b = sspec[..., nr // 2:, : int(nc / 2 - np.floor(cutmid / 2))]
+    both = xp.concatenate(
+        [a.reshape(a.shape[:-2] + (-1,)), b.reshape(b.shape[:-2] + (-1,))],
+        axis=-1)
+    return xp.std(both, axis=-1)
+
+
+def _walk(filt: np.ndarray, ind: int, threshold: float) -> tuple[int, int]:
+    """The reference's peak-window walks (dynspec.py:702-718): step left
+    while the smoothed power stays above threshold (guarded, quirkily, on
+    ind+ind1), then right."""
+    n = len(filt)
+    power, ind1 = filt[ind], 1
+    while power > threshold and ind + ind1 < n - 1:
+        ind1 += 1
+        power = filt[ind - ind1]
+    power, ind2 = filt[ind], 1
+    while power > threshold and ind + ind2 < n - 1:
+        ind2 += 1
+        power = filt[ind + ind2]
+    return ind1, ind2
+
+
+def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
+            delmax=None, numsteps: int = 10000, startbin: int = 3,
+            cutmid: int = 3, etamax=None, etamin=None,
+            low_power_diff: float = -3.0, high_power_diff: float = -1.5,
+            ref_freq: float = 1400.0, constraint=(0, np.inf),
+            nsmooth: int = 5, noise_error: bool = True,
+            backend: str = "numpy") -> ArcFit:
+    """Find the arc curvature maximising power along ``tdel = eta fdop^2``
+    (dynspec.py:414-785, compute only; primary arc)."""
+    backend = resolve(backend)
+    if backend == "jax" and method == "norm_sspec":
+        fitter = make_arc_fitter(
+            fdop=np.asarray(sec.fdop), yaxis=np.asarray(
+                sec.beta if sec.lamsteps else sec.tdel),
+            tdel=np.asarray(sec.tdel), freq=freq, lamsteps=sec.lamsteps,
+            method=method, delmax=delmax, numsteps=int(numsteps),
+            startbin=startbin, cutmid=cutmid, etamax=etamax, etamin=etamin,
+            low_power_diff=low_power_diff, high_power_diff=high_power_diff,
+            ref_freq=ref_freq, constraint=tuple(constraint),
+            nsmooth=nsmooth, noise_error=noise_error)
+        import jax.numpy as jnp
+
+        batch = fitter(jnp.asarray(sec.sspec)[None])
+        return ArcFit(eta=batch.eta[0], etaerr=batch.etaerr[0],
+                      etaerr2=batch.etaerr2[0], lamsteps=batch.lamsteps,
+                      profile_eta=batch.profile_eta,
+                      profile_power=batch.profile_power[0],
+                      profile_power_filt=batch.profile_power_filt[0])
+    # gridmax has no jax path yet: fall through to the numpy implementation
+
+    sspec = np.array(sec.sspec, dtype=np.float64)
+    tdel_axis = np.asarray(sec.tdel)
+    fdop = np.asarray(sec.fdop, dtype=np.float64)
+    lamsteps = sec.lamsteps
+
+    delmax = np.max(tdel_axis) if delmax is None else delmax
+    delmax = delmax * (ref_freq / freq) ** 2
+
+    yaxis = np.asarray(sec.beta if lamsteps else sec.tdel, dtype=np.float64)
+    ind = np.argmin(np.abs(tdel_axis - delmax))
+    ymax = yaxis[ind] if lamsteps else delmax
+
+    noise = float(_noise_estimate(sspec, cutmid))
+
+    nr, nc = sspec.shape
+    sspec[0:startbin, :] = np.nan
+    sspec[:, int(nc / 2 - np.floor(cutmid / 2)):
+          int(nc / 2 + np.ceil(cutmid / 2))] = np.nan
+    sspec = sspec[0:ind, :]
+    yaxis_cut = yaxis[0:ind]
+    noise = noise / len(yaxis_cut[startbin:])
+
+    if etamax is None:
+        etamax = ymax / ((fdop[1] - fdop[0]) * cutmid) ** 2
+    if etamin is None:
+        etamin = (yaxis_cut[1] - yaxis_cut[0]) * startbin / np.max(fdop) ** 2
+
+    constraint = np.asarray(constraint, dtype=np.float64)
+    if not lamsteps:
+        b2e = _beta_to_eta_factor(freq, ref_freq)
+        etamax = etamax / (freq / ref_freq) ** 2 * b2e
+        etamin = etamin / (freq / ref_freq) ** 2 * b2e
+        constraint = constraint / (freq / ref_freq) ** 2 * b2e
+
+    sqrt_eta_all = np.linspace(np.sqrt(etamin), np.sqrt(etamax),
+                               int(numsteps))
+    sqrt_eta = sqrt_eta_all  # single-arc: full range
+    numsteps_new = len(sqrt_eta)
+
+    if method == "norm_sspec":
+        ns = norm_sspec(sec, freq, eta=etamin, delmax=delmax,
+                        startbin=startbin, maxnormfac=1, cutmid=cutmid,
+                        numsteps=numsteps_new, ref_freq=ref_freq)
+        prof = ns.normsspecavg.squeeze()
+        n = len(prof)
+        etafrac = np.linspace(-1, 1, n)
+        ipos = np.argwhere(etafrac > 1 / (2 * n))
+        ineg = np.argwhere(etafrac < -1 / (2 * n))
+        avg = (prof[ipos] + np.flip(prof[ineg], axis=0)) / 2
+        avg = avg.squeeze()
+        etafrac_avg = 1 / etafrac[ipos].squeeze()
+        valid = np.isfinite(avg) * (~np.isnan(avg))
+        avg = np.flip(avg[valid], axis=0)
+        etafrac_avg = np.flip(etafrac_avg[valid], axis=0)
+
+        eta_array = etamin * etafrac_avg ** 2
+        keep = np.argwhere(eta_array < etamax)
+        eta_array = eta_array[keep].squeeze()
+        avg = avg[keep].squeeze()
+
+        filt = savgol_filter(avg, nsmooth, 1)
+        inrange = np.argwhere((eta_array > constraint[0])
+                              * (eta_array < constraint[1]))
+        peak_ind = int(np.argmin(np.abs(filt - np.max(filt[inrange]))))
+        max_power = filt[peak_ind]
+
+        # -3 dB on the low-curvature side, -1.5 dB on the high side
+        i1, _ = _walk(filt, peak_ind, max_power + low_power_diff)
+        _, i2 = _walk(filt, peak_ind, max_power + high_power_diff)
+        xdata = eta_array[peak_ind - i1: peak_ind + i2]
+        ydata = avg[peak_ind - i1: peak_ind + i2]
+        yfit, eta, etaerr_fit = fit_parabola(xdata, ydata, xp=np)
+        if np.mean(np.gradient(np.diff(yfit))) > 0:
+            raise ValueError("Fit returned a forward parabola.")
+
+        etaerr2 = etaerr_fit
+        etaerr = etaerr_fit
+        if noise_error:
+            j1, j2 = _walk(filt, peak_ind, max_power - noise)
+            etaerr = np.ptp(eta_array[peak_ind - j1: peak_ind + j2]) / 2
+
+        return ArcFit(eta=eta, etaerr=etaerr, etaerr2=etaerr2,
+                      lamsteps=lamsteps, profile_eta=eta_array,
+                      profile_power=avg, profile_power_filt=filt)
+
+    if method == "gridmax":
+        x, y, z = fdop, yaxis_cut, sspec
+        sumpow_l, sumpow_r, eta_list = [], [], []
+        for se in sqrt_eta:
+            ieta = se ** 2
+            eta_list.append(ieta)
+            ynew = ieta * x ** 2
+            xpx = (x - x.min()) / (x.max() - x.min()) * z.shape[1]
+            ynewpx = (ynew - ynew.min()) / (y.max() - ynew.min()) * z.shape[0]
+            for side, store in ((x < 0, sumpow_l), (x > 0, sumpow_r)):
+                sel = side & (ynew < y.max())
+                coords = np.stack([ynewpx[sel], xpx[sel]])
+                zn = map_coordinates(z, coords, order=1, cval=np.nan)
+                store.append(np.mean(zn[~np.isnan(zn)]))
+        eta_array = np.array(eta_list)
+        sumpow = (np.array(sumpow_l) + np.array(sumpow_r)) / 2
+        ok = np.isfinite(sumpow)
+        eta_array, sumpow = eta_array[ok], sumpow[ok]
+        filt = savgol_filter(sumpow, nsmooth, 1)
+        inrange = np.argwhere((eta_array > constraint[0])
+                              * (eta_array < constraint[1]))
+        peak_ind = int(np.argmin(np.abs(filt - np.max(filt[inrange]))))
+        max_power = filt[peak_ind]
+        i1, _ = _walk(filt, peak_ind, max_power + low_power_diff)
+        _, i2 = _walk(filt, peak_ind, max_power + high_power_diff)
+        xdata = eta_array[peak_ind - i1: peak_ind + i2]
+        ydata = sumpow[peak_ind - i1: peak_ind + i2]
+        yfit, eta, etaerr_fit = fit_log_parabola(xdata, ydata, xp=np)
+        if np.mean(np.gradient(np.diff(yfit))) > 0:
+            raise ValueError("Fit returned a forward parabola.")
+        etaerr2 = etaerr_fit
+        etaerr = etaerr_fit
+        if noise_error:
+            j1, j2 = _walk(filt, peak_ind, max_power - noise)
+            etaerr = np.ptp(eta_array[peak_ind - j1: peak_ind + j2]) / 2
+        return ArcFit(eta=eta, etaerr=etaerr, etaerr2=etaerr2,
+                      lamsteps=lamsteps, profile_eta=eta_array,
+                      profile_power=sumpow, profile_power_filt=filt)
+
+    raise ValueError("unknown arc fitting method; choose from "
+                     "'gridmax' or 'norm_sspec'")
+
+
+# ---------------------------------------------------------------------------
+# jax fixed-shape batched fitter
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
+                            method, delmax, numsteps, startbin, cutmid,
+                            etamax, etamin, low_power_diff, high_power_diff,
+                            ref_freq, constraint, nsmooth, noise_error):
+    import jax
+    import jax.numpy as jnp
+
+    from .filters import savgol1
+    from ..models.parabola import fit_parabola as _fitpar
+
+    fdop = np.frombuffer(fdop_key[0]).reshape(fdop_key[1])
+    yaxis = np.frombuffer(yaxis_key[0]).reshape(yaxis_key[1])
+    tdel_axis = np.frombuffer(tdel_key[0]).reshape(tdel_key[1])
+
+    # ---- host-side static precomputation -------------------------------
+    # One frequency adjustment for the fit-level delay cut (dynspec.py:428-
+    # 429); norm_sspec then re-applies it internally (dynspec.py:796-797) —
+    # the reference's double-adjustment quirk, reproduced for parity.
+    dmax = np.max(tdel_axis) if delmax is None else delmax
+    dmax = dmax * (ref_freq / freq) ** 2
+    dmax_norm = dmax * (ref_freq / freq) ** 2
+    ind = int(np.argmin(np.abs(tdel_axis - dmax)))
+    ind_norm = int(np.argmin(np.abs(tdel_axis - dmax_norm)))
+    ymax = yaxis[ind] if lamsteps else dmax
+    yc = yaxis[:ind]
+    emax = etamax if etamax is not None else \
+        ymax / ((fdop[1] - fdop[0]) * cutmid) ** 2
+    emin = etamin if etamin is not None else \
+        (yc[1] - yc[0]) * startbin / np.max(fdop) ** 2
+    cons = np.asarray(constraint, dtype=np.float64)
+    emin_norm = emin
+    if not lamsteps:
+        b2e = _beta_to_eta_factor(freq, ref_freq)
+        emax = emax / (freq / ref_freq) ** 2 * b2e
+        emin = emin / (freq / ref_freq) ** 2 * b2e
+        cons = cons / (freq / ref_freq) ** 2 * b2e
+        # norm_sspec converts the (already converted) eta again
+        # (dynspec.py:820-825) — second half of the same quirk
+        emin_norm = emin / (freq / ref_freq) ** 2 * b2e
+    else:
+        emin_norm = emin
+
+    n = int(numsteps)
+    # norm_sspec internals (maxnormfac=1): rows startbin..ind_norm-1
+    tdel_rows = yaxis[startbin:ind_norm]
+    scales = np.sqrt(tdel_rows / emin_norm)         # [R] per-row fdop scale
+    fdopnew = np.linspace(-1.0, 1.0, n)
+    # fold indices (static): positive/negative arms of fdopnew
+    etafrac = np.linspace(-1.0, 1.0, n)
+    ipos = np.where(etafrac > 1 / (2 * n))[0]
+    ineg = np.where(etafrac < -1 / (2 * n))[0]
+    etafrac_avg = 1.0 / etafrac[ipos]               # descending eta
+    eta_array = emin * etafrac_avg[::-1] ** 2       # ascending in eta
+    keep_static = eta_array < emax                  # static part of validity
+    cons_mask = (eta_array > cons[0]) & (eta_array < cons[1])
+    # cutmid NaN columns of the row-normalised spectrum (norm_sspec flavour:
+    # floor on both sides, dynspec.py:838-839)
+    ncol = len(fdop)
+    cut_lo = int(ncol / 2 - np.floor(cutmid / 2))
+    cut_hi = int(ncol / 2 + np.floor(cutmid / 2))
+    col_nan = np.zeros(ncol, dtype=bool)
+    col_nan[cut_lo:cut_hi] = True
+
+    def one_epoch(sspec):
+        # ---- noise estimate (dynspec.py:446-451,463) -------------------
+        noise = _noise_estimate(sspec, cutmid, xp=jnp)
+        noise = noise / (ind - startbin)
+
+        # ---- normalised, delay-scrunched profile -----------------------
+        rows = sspec[startbin:ind_norm, :]
+        rows = jnp.where(col_nan[None, :], jnp.nan, rows)
+
+        fdop_j = jnp.asarray(fdop)
+        fdopnew_j = jnp.asarray(fdopnew)
+
+        def one_row(row, s):
+            imax = s  # maxnormfac=1 -> imaxfdop = sqrt(itdel/emin)
+            lo = jnp.searchsorted(fdop_j, -imax, side="left")
+            hi = jnp.searchsorted(fdop_j, imax, side="right") - 1
+            q = jnp.clip(fdopnew_j * s, fdop_j[lo], fdop_j[hi])
+            return jnp.interp(q, fdop_j, row)
+
+        norm = jax.vmap(one_row)(rows, jnp.asarray(scales))  # [R, n]
+        prof = jnp.nanmean(norm, axis=0)                     # [n]
+        # +2 dB quirk (dynspec.py:864-866)
+        i_at_1 = int(np.argmin(np.abs(fdopnew - 1) - 2))
+        prof = jnp.where(prof[i_at_1] < 0, prof + 2.0, prof)
+
+        # ---- fold arms onto the eta grid -------------------------------
+        avg = (prof[ipos] + prof[ineg][::-1]) / 2
+        avg = avg[::-1]                                     # ascending eta
+        valid = jnp.isfinite(avg) & jnp.asarray(keep_static)
+        # fill invalid (contiguous large-eta tail / NaN centre) with the
+        # nearest valid value so the smoother sees a continuous profile
+        fill = jnp.nanmin(jnp.where(valid, avg, jnp.nan))
+        avg_f = jnp.where(valid, avg, fill)
+        filt = savgol1(avg_f, nsmooth, xp=jnp)
+
+        # ---- peak within constraint (dynspec.py:693-699) ---------------
+        search = valid & jnp.asarray(cons_mask)
+        maxval = jnp.max(jnp.where(search, filt, -jnp.inf))
+        peak_ind = jnp.argmin(jnp.where(valid, jnp.abs(filt - maxval),
+                                        jnp.inf))
+        max_power = filt[peak_ind]
+
+        idx = jnp.arange(filt.shape[0])
+
+        last_valid = jnp.max(jnp.where(valid, idx, 0))
+
+        def window(threshold_lo, threshold_hi):
+            # first crossing below/above the peak (clean reformulation of
+            # the reference's while-walks); falls back to the profile ends
+            # when the threshold is never crossed
+            below = (filt <= threshold_lo) & (idx < peak_ind) & valid
+            left = jnp.maximum(jnp.max(jnp.where(below, idx, -1)), 0)
+            above = (filt <= threshold_hi) & (idx > peak_ind) & valid
+            right = jnp.min(jnp.where(above, idx, filt.shape[0]))
+            right = jnp.where(right >= filt.shape[0], last_valid, right)
+            return left, right
+
+        left, right = window(max_power + low_power_diff,
+                             max_power + high_power_diff)
+        w = ((idx >= left) & (idx < right + 1) & valid).astype(filt.dtype)
+        ea = jnp.asarray(eta_array)
+        yfit, eta, etaerr_fit = _fitpar(ea, avg_f, w=w, xp=jnp)
+
+        etaerr = etaerr_fit
+        if noise_error:
+            jl, jr = window(max_power - noise, max_power - noise)
+            wn_ = (idx >= jl) & (idx < jr + 1) & valid
+            lo_eta = jnp.min(jnp.where(wn_, ea, jnp.inf))
+            hi_eta = jnp.max(jnp.where(wn_, ea, -jnp.inf))
+            etaerr = (hi_eta - lo_eta) / 2
+
+        return eta, etaerr, etaerr_fit, avg_f, filt
+
+    @jax.jit
+    def impl(sspec_batch):
+        eta, etaerr, etaerr2, avg, filt = jax.vmap(one_epoch)(sspec_batch)
+        return ArcFit(eta=eta, etaerr=etaerr, etaerr2=etaerr2,
+                      lamsteps=lamsteps, profile_eta=jnp.asarray(eta_array),
+                      profile_power=avg, profile_power_filt=filt)
+
+    return impl
+
+
+def make_arc_fitter(fdop, yaxis, tdel, freq, lamsteps=True,
+                    method="norm_sspec", delmax=None, numsteps=1024,
+                    startbin=3, cutmid=3, etamax=None, etamin=None,
+                    low_power_diff=-3.0, high_power_diff=-1.5,
+                    ref_freq=1400.0, constraint=(0, np.inf), nsmooth=5,
+                    noise_error=True):
+    """Build a jit'd batched arc fitter for a fixed (fdop, yaxis) grid.
+
+    Returns ``fitter(sspec_batch [B, nr, nc]) -> ArcFit`` of [B] arrays.
+    All grid-dependent decisions (delay cut, eta grid, fold indices) are
+    made host-side once; the per-epoch measurement is pure fixed-shape jax.
+    Only the ``norm_sspec`` method is implemented on this path (the
+    reference's default and flagship; gridmax falls back to numpy).
+    """
+    if method != "norm_sspec":
+        raise NotImplementedError(
+            "jax arc fitter implements method='norm_sspec'; use the numpy "
+            "backend for gridmax")
+    fdop = np.ascontiguousarray(np.asarray(fdop, dtype=np.float64))
+    yaxis = np.ascontiguousarray(np.asarray(yaxis, dtype=np.float64))
+    tdel = np.ascontiguousarray(np.asarray(tdel, dtype=np.float64))
+    key = lambda a: (a.tobytes(), a.shape)  # noqa: E731
+    return _make_arc_fitter_cached(
+        key(fdop), key(yaxis), key(tdel), float(freq), bool(lamsteps),
+        method, None if delmax is None else float(delmax), int(numsteps),
+        int(startbin), int(cutmid),
+        None if etamax is None else float(etamax),
+        None if etamin is None else float(etamin), float(low_power_diff),
+        float(high_power_diff), float(ref_freq),
+        (float(constraint[0]), float(constraint[1])), int(nsmooth),
+        bool(noise_error))

@@ -1,0 +1,167 @@
+"""Scintillation-parameter fitting: tau_d and dnu_d from 1-D ACF cuts.
+
+Reference: ``Dynspec.get_scint_params(method='acf1d')``
+(dynspec.py:928-1033): take the central positive-lag row/column cuts of the
+2-D ACF, build initial guesses (white-noise spike from the first lag drop,
+tau at 1/e, dnu at half power), and least-squares fit the joint
+tau/dnu/amp/wn model with alpha fixed (default Kolmogorov 5/3) or free.
+
+The cut/guess construction is reproduced exactly, including the reference's
+``linspace(0, n, n)`` lag axes (step n/(n-1), not arange — dynspec.py:950,
+952).  The fit itself runs on either engine:
+
+* backend='numpy': scipy least squares (CPU, lmfit-equivalent class);
+* backend='jax': fixed-iteration LM; :func:`fit_scint_params_batch` vmaps
+  it over a [B, 2nf, 2nt] stack of ACFs for the batched-fit benchmark
+  (BASELINE config 2).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..backend import resolve
+from ..data import ScintParams
+from ..models.acf_models import scint_acf_model
+from .lm import least_squares_numpy, lm_fit_jax
+
+_ALPHA_KOLMOGOROV = 5 / 3
+
+
+def acf_cuts(acf2d, dt, df, nchan: int, nsub: int, xp=np):
+    """Central positive-lag cuts of the [2nf, 2nt] ACF and their lag axes
+    (dynspec.py:949-952)."""
+    ydata_f = acf2d[..., nchan:, nsub]
+    ydata_t = acf2d[..., nchan, nsub:]
+    nf_, nt_ = ydata_f.shape[-1], ydata_t.shape[-1]
+    xdata_f = df * xp.linspace(0, nf_, nf_)
+    xdata_t = dt * xp.linspace(0, nt_, nt_)
+    return xdata_t, ydata_t, xdata_f, ydata_f
+
+
+def initial_guesses(xdata_t, ydata_t, xdata_f, ydata_f, xp=np):
+    """wn from the zero-lag spike, amp from the first real lag, tau at 1/e,
+    dnu at half power (dynspec.py:965-972).  argmin-based: jit-safe."""
+    wn = xp.minimum(ydata_f[..., 0] - ydata_f[..., 1],
+                    ydata_t[..., 0] - ydata_t[..., 1])
+    amp = xp.maximum(ydata_f[..., 1], ydata_t[..., 1])
+    tau = xp.take_along_axis(
+        xdata_t if xdata_t.ndim == ydata_t.ndim else xp.broadcast_to(
+            xdata_t, ydata_t.shape),
+        xp.argmin(xp.abs(ydata_t - amp[..., None] / np.e), axis=-1)[..., None],
+        axis=-1)[..., 0]
+    dnu = xp.take_along_axis(
+        xdata_f if xdata_f.ndim == ydata_f.ndim else xp.broadcast_to(
+            xdata_f, ydata_f.shape),
+        xp.argmin(xp.abs(ydata_f - amp[..., None] / 2), axis=-1)[..., None],
+        axis=-1)[..., 0]
+    return tau, dnu, amp, wn
+
+
+def _residual_fixed_alpha(p, x_t, x_f, y, alpha):
+    import jax.numpy as jnp
+
+    tau, dnu, amp, wn = p[0], p[1], p[2], p[3]
+    model = scint_acf_model(x_t, x_f, tau, dnu, amp, wn, alpha, xp=jnp)
+    return y - model
+
+
+def _residual_free_alpha(p, x_t, x_f, y):
+    import jax.numpy as jnp
+
+    tau, dnu, amp, wn, alpha = p[0], p[1], p[2], p[3], p[4]
+    model = scint_acf_model(x_t, x_f, tau, dnu, amp, wn, alpha, xp=jnp)
+    return y - model
+
+
+def fit_scint_params(acf2d, dt, df, nchan: int, nsub: int,
+                     alpha: float | None = _ALPHA_KOLMOGOROV,
+                     backend: str = "numpy", steps: int = 40) -> ScintParams:
+    """Fit tau/dnu/amp/wn (alpha fixed unless ``alpha=None``) to one ACF."""
+    backend = resolve(backend)
+    if backend == "numpy":
+        a = np.asarray(acf2d, dtype=np.float64)
+        x_t, y_t, x_f, y_f = acf_cuts(a, dt, df, nchan, nsub, xp=np)
+        tau0, dnu0, amp0, wn0 = initial_guesses(x_t, y_t, x_f, y_f, xp=np)
+        y = np.concatenate([y_t, y_f])
+        free = alpha is None
+
+        def resid(p):
+            a_ = p[4] if free else alpha
+            return y - scint_acf_model(x_t, x_f, p[0], p[1], p[2], p[3], a_,
+                                       xp=np)
+
+        p0 = [tau0, dnu0, amp0, wn0] + ([_ALPHA_KOLMOGOROV] if free else [])
+        # tiny positive floors keep tau/dnu off the singular boundary
+        lo = [1e-10, 1e-10, 0.0, 0.0] + ([0.0] if free else [])
+        hi = [np.inf] * 4 + ([8.0] if free else [])
+        res = least_squares_numpy(resid, np.asarray(p0), bounds=(lo, hi))
+        return _to_scint_params(res, alpha, np)
+
+    return _fit_scint_jax(alpha, steps, False)(acf2d, float(dt), float(df),
+                                               nchan, nsub)
+
+
+def fit_scint_params_batch(acf2d_batch, dt, df, nchan: int, nsub: int,
+                           alpha: float | None = _ALPHA_KOLMOGOROV,
+                           steps: int = 40) -> ScintParams:
+    """Batched jax fit: acf2d [B, 2nf, 2nt], dt/df scalars or [B]."""
+    import jax.numpy as jnp
+
+    dt = jnp.broadcast_to(jnp.asarray(dt, dtype=jnp.result_type(float)),
+                          (acf2d_batch.shape[0],))
+    df = jnp.broadcast_to(jnp.asarray(df, dtype=jnp.result_type(float)),
+                          (acf2d_batch.shape[0],))
+    return _fit_scint_jax(alpha, steps, True)(acf2d_batch, dt, df, nchan,
+                                              nsub)
+
+
+def _to_scint_params(res, alpha, xp) -> ScintParams:
+    free = alpha is None
+    return ScintParams(
+        tau=res.params[..., 0], tauerr=res.stderr[..., 0],
+        dnu=res.params[..., 1], dnuerr=res.stderr[..., 1],
+        amp=res.params[..., 2], wn=res.params[..., 3],
+        talpha=res.params[..., 4] if free else alpha,
+        talphaerr=res.stderr[..., 4] if free else None,
+        redchi=res.redchi)
+
+
+@functools.lru_cache(maxsize=None)
+def _fit_scint_jax(alpha, steps, batched):
+    import jax
+    import jax.numpy as jnp
+
+    free = alpha is None
+
+    def single(acf2d, dt, df, nchan, nsub):
+        x_t, y_t, x_f, y_f = acf_cuts(acf2d, dt, df, nchan, nsub, xp=jnp)
+        tau0, dnu0, amp0, wn0 = initial_guesses(x_t, y_t, x_f, y_f, xp=jnp)
+        y = jnp.concatenate([y_t, y_f])
+        if free:
+            p0 = jnp.stack([tau0, dnu0, amp0, wn0,
+                            jnp.asarray(_ALPHA_KOLMOGOROV)])
+            lo = jnp.array([1e-10, 1e-10, 0.0, 0.0, 0.0])
+            hi = jnp.array([jnp.inf, jnp.inf, jnp.inf, jnp.inf, 8.0])
+            res = lm_fit_jax(_residual_free_alpha, p0, bounds=(lo, hi),
+                             args=(x_t, x_f, y), steps=steps)
+        else:
+            p0 = jnp.stack([tau0, dnu0, amp0, wn0])
+            lo = jnp.array([1e-10, 1e-10, 0.0, 0.0])
+            hi = jnp.full(4, jnp.inf)
+            res = lm_fit_jax(_residual_fixed_alpha, p0, bounds=(lo, hi),
+                             args=(x_t, x_f, y, alpha), steps=steps)
+        return res
+
+    if batched:
+        fn = jax.vmap(single, in_axes=(0, 0, 0, None, None))
+    else:
+        fn = single
+
+    @functools.partial(jax.jit, static_argnums=(3, 4))
+    def impl(acf2d, dt, df, nchan, nsub):
+        return _to_scint_params(fn(acf2d, dt, df, nchan, nsub), alpha, jnp)
+
+    return impl

@@ -1,0 +1,78 @@
+"""Closed-form ACF models for scintillation-parameter fitting.
+
+Reference: scint_models.py:27-105.  There the models are lmfit residual
+callbacks mutating ``model[0]``; here they are pure functions of
+``(x, params)`` that evaluate on numpy *or* jax arrays (pass ``xp``), so the
+same code serves the scipy least-squares CPU path and the vmapped
+fixed-iteration LM on TPU, including reverse-mode differentiation.
+
+Conventions preserved from the reference:
+* ``tau`` is the 1/e timescale, ``dnu`` the half-power bandwidth
+  (hence the ``dnu/log(2)`` scale inside the exponential,
+  scint_models.py:73);
+* a white-noise spike ``wn`` is added to the zero-lag sample only
+  (scint_models.py:48,74);
+* models are multiplied by the triangle taper ``1 - x/max(x)``, the
+  finite-scan bias of the ACF estimate (scint_models.py:50,76).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def tau_acf_model(x, tau, amp, wn, alpha=5 / 3, xp=np):
+    """Time-axis ACF cut model (scint_models.py:27-52)."""
+    model = amp * xp.exp(-(x / tau) ** alpha)
+    model = model + wn * (xp.arange(x.shape[0]) == 0)
+    return model * (1 - x / xp.max(x))
+
+
+def dnu_acf_model(x, dnu, amp, wn, xp=np):
+    """Frequency-axis ACF cut model (scint_models.py:55-78)."""
+    model = amp * xp.exp(-x / (dnu / np.log(2)))
+    model = model + wn * (xp.arange(x.shape[0]) == 0)
+    return model * (1 - x / xp.max(x))
+
+
+def scint_acf_model(x_t, x_f, tau, dnu, amp, wn, alpha=5 / 3, xp=np):
+    """Joint model over concatenated (time-cut, frequency-cut) data
+    (scint_models.py:81-105).  Returns the concatenated model vector."""
+    mt = tau_acf_model(x_t, tau, amp, wn, alpha, xp=xp)
+    mf = dnu_acf_model(x_f, dnu, amp, wn, xp=xp)
+    return xp.concatenate([mt, mf])
+
+
+def tau_sspec_model(x, tau, amp, wn, alpha=5 / 3, xp=np):
+    """Fourier-domain (power spectrum) counterpart of tau_acf_model.
+
+    The reference's version is broken — it calls the numpy *module*
+    ``np.fft(model)`` (scint_models.py:142) — so this is the repaired
+    semantics it intended: mirror the ACF model to a symmetric function and
+    take the real FFT, keeping the positive-lag half.
+    """
+    model = amp * xp.exp(-(x / tau) ** alpha)
+    model = model + wn * (xp.arange(x.shape[0]) == 0)
+    model = model * (1 - x / xp.max(x))
+    sym = xp.concatenate([model, model[::-1]])[: 2 * x.shape[0] - 1]
+    spec = xp.real(xp.fft.fft(sym))
+    return spec[: x.shape[0]]
+
+
+def dnu_sspec_model(x, dnu, amp, wn, xp=np):
+    """Fourier-domain counterpart of dnu_acf_model (reference stub at
+    scint_models.py:149-171, completed here)."""
+    model = amp * xp.exp(-x / (dnu / np.log(2)))
+    model = model + wn * (xp.arange(x.shape[0]) == 0)
+    model = model * (1 - x / xp.max(x))
+    sym = xp.concatenate([model, model[::-1]])[: 2 * x.shape[0] - 1]
+    spec = xp.real(xp.fft.fft(sym))
+    return spec[: x.shape[0]]
+
+
+def scint_sspec_model(x_t, x_f, tau, dnu, amp, wn, alpha=5 / 3, xp=np):
+    """Joint Fourier-domain model (reference stub at scint_models.py:174-188,
+    completed here)."""
+    mt = tau_sspec_model(x_t, tau, amp, wn, alpha, xp=xp)
+    mf = dnu_sspec_model(x_f, dnu, amp, wn, xp=xp)
+    return xp.concatenate([mt, mf])

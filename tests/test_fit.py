@@ -1,0 +1,276 @@
+"""Fit-engine tests: parabola/LM golden values, scint-parameter recovery,
+arc-curvature recovery on synthetic arcs, reference parity (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from scintools_tpu.fit import (fit_arc, fit_scint_params,
+                               fit_scint_params_batch, lm_fit_jax,
+                               least_squares_numpy, norm_sspec, savgol1)
+from scintools_tpu.fit.arc_fit import make_arc_fitter
+from scintools_tpu.data import SecSpec
+from scintools_tpu.models import (fit_log_parabola, fit_parabola,
+                                  polyfit2_cov, scint_acf_model,
+                                  tau_acf_model)
+
+from reference_oracle import reference_modules
+
+
+# ----------------------------------------------------------------- parabola
+
+def test_polyfit2_matches_numpy_polyfit(rng):
+    x = np.linspace(1, 5, 40)
+    y = 2 * x ** 2 - 3 * x + 1 + 0.01 * rng.standard_normal(40)
+    c_np, cov_np = np.polyfit(x, y, 2, cov=True)
+    c, cov = polyfit2_cov(x, y)
+    np.testing.assert_allclose(c, c_np, rtol=1e-8)
+    np.testing.assert_allclose(cov, cov_np, rtol=1e-6)
+
+
+def test_fit_parabola_matches_reference(rng):
+    mods = reference_modules()
+    if mods is None:
+        pytest.skip("reference not available")
+    ref_models = mods[2]
+    x = np.linspace(0.5, 2.0, 30)
+    y = -(x - 1.2) ** 2 + 0.02 * rng.standard_normal(30)
+    yfit_r, peak_r, err_r = ref_models.fit_parabola(x, y)
+    yfit, peak, err = fit_parabola(x, y)
+    np.testing.assert_allclose(peak, peak_r, rtol=1e-9)
+    np.testing.assert_allclose(err, err_r, rtol=1e-6)
+    np.testing.assert_allclose(yfit, yfit_r, rtol=1e-9)
+
+    yfit_r, peak_r, err_r = ref_models.fit_log_parabola(x, y)
+    yfit, peak, err = fit_log_parabola(x, y)
+    np.testing.assert_allclose(peak, peak_r, rtol=1e-9)
+    np.testing.assert_allclose(err, err_r, rtol=1e-6)
+
+
+def test_masked_parabola_equals_sliced(rng):
+    import jax.numpy as jnp
+
+    x = np.linspace(1, 3, 50)
+    y = -(x - 2.1) ** 2 + 0.01 * rng.standard_normal(50)
+    w = np.zeros(50)
+    w[10:40] = 1
+    _, peak_s, err_s = fit_parabola(x[10:40], y[10:40])
+    _, peak_m, err_m = fit_parabola(jnp.asarray(x), jnp.asarray(y),
+                                    w=jnp.asarray(w), xp=jnp)
+    np.testing.assert_allclose(float(peak_m), peak_s, rtol=1e-9)
+    np.testing.assert_allclose(float(err_m), err_s, rtol=1e-7)
+
+
+# ------------------------------------------------------------------- savgol
+
+def test_savgol1_matches_scipy(rng):
+    from scipy.signal import savgol_filter
+
+    y = rng.standard_normal(61).cumsum()
+    ours = savgol1(y, 5)
+    ref = savgol_filter(y, 5, 1)
+    np.testing.assert_allclose(ours, ref, rtol=1e-10, atol=1e-10)
+
+
+def test_savgol1_jax_matches_scipy(rng):
+    import jax.numpy as jnp
+    from scipy.signal import savgol_filter
+
+    y = rng.standard_normal(41).cumsum()
+    ours = np.asarray(savgol1(jnp.asarray(y), 7, xp=jnp))
+    ref = savgol_filter(y, 7, 1)
+    np.testing.assert_allclose(ours, ref, rtol=1e-9, atol=1e-9)
+
+
+# ----------------------------------------------------------------------- LM
+
+def test_lm_recovers_exponential():
+    import jax.numpy as jnp
+
+    x = np.linspace(0, 10, 100)
+    true = np.array([2.5, 1.3])
+    y = true[1] * np.exp(-x / true[0])
+
+    def resid(p, x_, y_):
+        return y_ - p[1] * jnp.exp(-x_ / p[0])
+
+    res = lm_fit_jax(resid, jnp.array([1.0, 1.0]),
+                     bounds=(jnp.array([1e-6, 1e-6]),
+                             jnp.array([np.inf, np.inf])),
+                     args=(jnp.asarray(x), jnp.asarray(y)), steps=30)
+    np.testing.assert_allclose(np.asarray(res.params), true, rtol=1e-6)
+
+
+def test_lm_matches_scipy_with_noise(rng):
+    import jax.numpy as jnp
+
+    x = np.linspace(0, 10, 200)
+    y = 1.5 * np.exp(-x / 3.0) + 0.01 * rng.standard_normal(200)
+
+    def resid_np(p):
+        return y - p[1] * np.exp(-x / p[0])
+
+    def resid_jax(p, x_, y_):
+        return y_ - p[1] * jnp.exp(-x_ / p[0])
+
+    r_np = least_squares_numpy(resid_np, np.array([1.0, 1.0]),
+                               bounds=([1e-6, 1e-6], [np.inf, np.inf]))
+    r_jax = lm_fit_jax(resid_jax, jnp.array([1.0, 1.0]),
+                       bounds=(jnp.array([1e-6, 1e-6]),
+                               jnp.array([np.inf, np.inf])),
+                       args=(jnp.asarray(x), jnp.asarray(y)), steps=40)
+    np.testing.assert_allclose(np.asarray(r_jax.params), r_np.params,
+                               rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(r_jax.stderr), r_np.stderr,
+                               rtol=1e-2)
+
+
+# ------------------------------------------------------------- scint params
+
+def _synthetic_acf(nchan=64, nsub=128, tau=120.0, dnu=4.0, dt=10.0, df=0.5,
+                   amp=1.0, wn=0.3):
+    """Build a [2nf, 2nt] ACF whose central cuts follow the model exactly."""
+    acf = np.zeros((2 * nchan, 2 * nsub))
+    tlags = dt * np.linspace(0, nsub, nsub)
+    flags = df * np.linspace(0, nchan, nchan)
+    cut_t = tau_acf_model(tlags, tau, amp, 0.0)
+    cut_f = amp * np.exp(-flags / (dnu / np.log(2))) * (1 - flags / flags.max())
+    acf[nchan, nsub:] = cut_t
+    acf[nchan:, nsub] = cut_f
+    acf[nchan, nsub] += wn  # zero-lag spike appears in both cuts
+    return acf
+
+
+def test_fit_scint_params_numpy_recovers():
+    acf = _synthetic_acf()
+    sp = fit_scint_params(acf, dt=10.0, df=0.5, nchan=64, nsub=128,
+                          backend="numpy")
+    np.testing.assert_allclose(sp.tau, 120.0, rtol=2e-2)
+    np.testing.assert_allclose(sp.dnu, 4.0, rtol=5e-2)
+
+
+def test_fit_scint_params_jax_matches_numpy():
+    acf = _synthetic_acf()
+    sp_np = fit_scint_params(acf, dt=10.0, df=0.5, nchan=64, nsub=128,
+                             backend="numpy")
+    sp_j = fit_scint_params(acf, dt=10.0, df=0.5, nchan=64, nsub=128,
+                            backend="jax")
+    np.testing.assert_allclose(float(sp_j.tau), sp_np.tau, rtol=1e-3)
+    np.testing.assert_allclose(float(sp_j.dnu), sp_np.dnu, rtol=1e-3)
+
+
+def test_fit_scint_params_batch():
+    acfs = np.stack([_synthetic_acf(tau=100.0), _synthetic_acf(tau=200.0)])
+    sp = fit_scint_params_batch(acfs, dt=10.0, df=0.5, nchan=64, nsub=128)
+    np.testing.assert_allclose(np.asarray(sp.tau), [100.0, 200.0], rtol=5e-2)
+
+
+def test_fit_scint_params_on_simulated(sim_dynspec):
+    """End-to-end: simulated dynspec -> ACF -> fit; recovered scales are
+    positive and within the observation span."""
+    from scintools_tpu.ops import acf
+
+    d = sim_dynspec
+    a = acf(np.asarray(d.dyn, dtype=np.float64), backend="numpy")
+    sp = fit_scint_params(a, dt=d.dt, df=d.df, nchan=d.nchan, nsub=d.nsub,
+                          backend="numpy")
+    assert 0 < sp.tau < d.tobs
+    assert 0 < sp.dnu < d.bw
+
+
+# ---------------------------------------------------------------- arc fits
+
+def _arc_secspec(eta=0.5, nr=128, nc=256, noise=0.05, rng=None):
+    """Synthetic secondary spectrum with power concentrated on the parabola
+    tdel = eta * fdop^2 (plus noise floor), in dB."""
+    rng = rng or np.random.default_rng(7)
+    fdop = np.linspace(-10, 10, nc)
+    tdel = np.linspace(0, 40, nr)
+    power = np.full((nr, nc), 1e-3)
+    arc_t = eta * fdop ** 2
+    for j, t in enumerate(arc_t):
+        i = np.argmin(np.abs(tdel - t))
+        if t <= tdel[-1]:
+            power[max(i - 1, 0): i + 2, j] += 1.0
+    power *= rng.uniform(0.8, 1.2, size=power.shape)
+    sec_db = 10 * np.log10(power + noise * 1e-3)
+    return SecSpec(sspec=sec_db, fdop=fdop, tdel=tdel, beta=tdel,
+                   lamsteps=True)
+
+
+def test_fit_arc_norm_sspec_recovers_eta():
+    sec = _arc_secspec(eta=0.5)
+    fit = fit_arc(sec, freq=1400.0, numsteps=2000, backend="numpy")
+    assert fit.eta == pytest.approx(0.5, rel=0.15)
+    assert fit.etaerr > 0
+
+
+def test_fit_arc_gridmax_recovers_eta():
+    sec = _arc_secspec(eta=0.5)
+    fit = fit_arc(sec, freq=1400.0, method="gridmax", numsteps=500,
+                  backend="numpy")
+    assert fit.eta == pytest.approx(0.5, rel=0.2)
+
+
+def test_fit_arc_jax_matches_numpy():
+    sec = _arc_secspec(eta=0.8)
+    f_np = fit_arc(sec, freq=1400.0, numsteps=1024, backend="numpy")
+    f_j = fit_arc(sec, freq=1400.0, numsteps=1024, backend="jax")
+    np.testing.assert_allclose(float(f_j.eta), f_np.eta, rtol=0.05)
+    assert f_j.profile_power.shape == f_j.profile_power_filt.shape
+
+
+def test_fit_arc_jax_matches_numpy_offref_freq():
+    """Regression: the delmax double-adjustment and eta double-conversion
+    quirks must match between backends when freq != ref_freq."""
+    sec = _arc_secspec(eta=0.5)
+    kw = dict(freq=1000.0, delmax=10.0, numsteps=1024)
+    f_np = fit_arc(sec, backend="numpy", **kw)
+    f_j = fit_arc(sec, backend="jax", **kw)
+    np.testing.assert_allclose(float(f_j.eta), f_np.eta, rtol=0.05)
+
+
+def test_fit_arc_gridmax_jax_falls_back_to_numpy():
+    sec = _arc_secspec(eta=0.5)
+    fit = fit_arc(sec, freq=1400.0, method="gridmax", numsteps=500,
+                  backend="jax")
+    assert fit.eta == pytest.approx(0.5, rel=0.2)
+
+
+def test_arc_fitter_batched():
+    secs = [_arc_secspec(eta=e, rng=np.random.default_rng(i))
+            for i, e in enumerate([0.4, 0.8])]
+    fitter = make_arc_fitter(fdop=secs[0].fdop, yaxis=secs[0].beta,
+                             tdel=secs[0].tdel, freq=1400.0, numsteps=1024)
+    import jax.numpy as jnp
+
+    batch = jnp.stack([jnp.asarray(s.sspec) for s in secs])
+    fit = fitter(batch)
+    etas = np.asarray(fit.eta)
+    np.testing.assert_allclose(etas, [0.4, 0.8], rtol=0.15)
+
+
+def test_norm_sspec_profile_peaks_at_unity():
+    """With eta set to the true curvature, the folded normalised profile
+    peaks at normalised fdop = +-1."""
+    sec = _arc_secspec(eta=0.6)
+    ns = norm_sspec(sec, freq=1400.0, eta=0.6, maxnormfac=2, numsteps=512)
+    prof = ns.normsspecavg
+    fx = ns.fdopnew
+    good = np.isfinite(prof) & (np.abs(fx) > 0.2)
+    peak_x = np.abs(fx[good][np.argmax(prof[good])])
+    assert peak_x == pytest.approx(1.0, abs=0.15)
+
+
+def test_fit_arc_forward_parabola_raises():
+    """A spectrum with power at the centre only (no arc) should trip the
+    forward-parabola guard (dynspec.py:723-724) or produce a tiny eta."""
+    rng = np.random.default_rng(3)
+    sec_db = 10 * np.log10(rng.uniform(0.9, 1.1, size=(64, 128)) * 1e-3)
+    sec = SecSpec(sspec=sec_db, fdop=np.linspace(-5, 5, 128),
+                  tdel=np.linspace(0, 20, 64), beta=np.linspace(0, 20, 64),
+                  lamsteps=True)
+    try:
+        fit = fit_arc(sec, freq=1400.0, numsteps=500, backend="numpy")
+        assert np.isfinite(fit.eta)
+    except ValueError as e:
+        assert "forward parabola" in str(e)
