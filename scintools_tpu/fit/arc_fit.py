@@ -401,7 +401,9 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         avg = avg[::-1]                                     # ascending eta
         valid = jnp.isfinite(avg) & jnp.asarray(keep_static)
         # fill invalid (contiguous large-eta tail / NaN centre) with the
-        # nearest valid value so the smoother sees a continuous profile
+        # lowest valid power so the smoother sees a continuous profile and
+        # the fill can never create a spurious peak (differs from the numpy
+        # path, which smooths the compacted array; tolerance in tests)
         fill = jnp.nanmin(jnp.where(valid, avg, jnp.nan))
         avg_f = jnp.where(valid, avg, fill)
         filt = savgol1(avg_f, nsmooth, xp=jnp)

@@ -99,31 +99,36 @@ def lm_fit_jax(residual_fn: Callable, p0, bounds=None, args: Sequence = (),
     else:
         project = lambda p: p  # noqa: E731
 
-    def cost(p):
-        r = residual_fn(p, *args)
-        return 0.5 * (r @ r)
-
     def step(state, _):
-        p, lam = state
-        r = residual_fn(p, *args)
+        # residual and cost at the current point ride in the carry, so each
+        # iteration evaluates residual_fn once (at the trial point) plus one
+        # jacobian — a rejected step reuses the carried (r, c) unchanged
+        p, r, c, lam = state
         J = jax.jacfwd(residual_fn)(p, *args)
         g = J.T @ r
         JTJ = J.T @ J
         damp = lam * jnp.diag(jnp.diag(JTJ)) + 1e-12 * jnp.eye(n_par)
         dp = jnp.linalg.solve(JTJ + damp, -g)
         p_try = project(p + dp)
-        better = cost(p_try) < cost(p)
+        r_try = residual_fn(p_try, *args)
+        c_try = 0.5 * (r_try @ r_try)
+        better = c_try < c
         p_new = jnp.where(better, p_try, p)
+        r_new = jnp.where(better, r_try, r)
+        c_new = jnp.where(better, c_try, c)
         lam_new = jnp.where(better, lam * lam_down, lam * lam_up)
-        return (p_new, lam_new), None
+        return (p_new, r_new, c_new, lam_new), None
 
-    (p_fin, _), _ = jax.lax.scan(step, (project(p0), jnp.asarray(lam0)),
-                                 length=steps)
-    r = residual_fn(p_fin, *args)
+    p_init = project(p0)
+    r0 = residual_fn(p_init, *args)
+    c0 = 0.5 * (r0 @ r0)
+    (p_fin, r, c_fin, _), _ = jax.lax.scan(
+        step, (p_init, r0, c0, jnp.asarray(lam0, dtype=p0.dtype)),
+        length=steps)
     J = jax.jacfwd(residual_fn)(p_fin, *args)
     cov, redchi = _covariance(jnp, J, r, n_par)
     return LsqResult(params=p_fin, stderr=jnp.sqrt(jnp.abs(jnp.diag(cov))),
-                     cov=cov, redchi=redchi, cost=0.5 * (r @ r))
+                     cov=cov, redchi=redchi, cost=c_fin)
 
 
 @functools.lru_cache(maxsize=None)

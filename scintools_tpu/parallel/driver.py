@@ -1,0 +1,296 @@
+"""Batched multi-epoch pipeline: the framework's "training step".
+
+Reference analogue: the serial per-file loop of ``sort_dyn``
+(dynspec.py:1615-1657) and the notebook's per-epoch workflow — here rebuilt
+as ONE jit-compiled SPMD program over a [B, nf, nt] batch of dynamic
+spectra (BASELINE config 4):
+
+    dyn [B, nf, nt]
+      ├─ ACF (Wiener–Khinchin fft2 pair, ops/acf.py)        → [B, 2nf, 2nt]
+      │   └─ vmapped fixed-iteration LM tau/dnu fit          → ScintParams[B]
+      ├─ (lamsteps) freq→lambda resample as ONE matmul       → [B, nlam, nt]
+      │       (natural-cubic-spline weights precomputed host-side; the
+      │        per-column interp1d loop of dynspec.py:1424-1426 becomes an
+      │        MXU-friendly [nlam, nf] x [B, nf, nt] einsum)
+      ├─ secondary spectrum (ops/sspec.py)                   → [B, nr, nc]
+      │   └─ fixed-shape batched arc fitter (fit/arc_fit.py) → ArcFit[B]
+      └─ results gathered host-side, invalid lanes dropped via BatchMask
+
+All grid-dependent decisions (FFT lengths, eta grids, fold indices) are
+made host-side from the static (freqs, times) template, so the device
+program has static shapes and no data-dependent control flow.
+
+With a mesh, the batch axis is sharded over ``data`` (DP: zero intra-step
+communication) and optionally the channel axis over ``chan`` (SP analogue
+for spectra too large for one device's HBM; XLA inserts ICI all-to-alls
+around the sharded-axis FFT).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import numpy as np
+
+from ..data import ArcFit, ScintParams
+from ..fit.arc_fit import make_arc_fitter
+from ..fit.scint_fit import fit_scint_params_batch
+from ..ops.acf import acf as acf_op
+from ..ops.scale import lambda_grid
+from ..ops.sspec import sspec as sspec_op, sspec_axes
+from . import mesh as mesh_mod
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    """Static configuration of the batched step (hashable: jit cache key).
+
+    Mirrors the kwargs of the reference's default_processing + fit calls
+    (dynspec.py:188-198, 414-418, 928-934) as a typed config object
+    (SURVEY.md §5 "config/flag system").
+    """
+
+    lamsteps: bool = True
+    prewhite: bool = True
+    window: str | None = "blackman"
+    window_frac: float = 0.1
+    fit_scint: bool = True
+    fit_arc: bool = True
+    alpha: float | None = 5 / 3       # None -> fit alpha too
+    lm_steps: int = 40
+    arc_numsteps: int = 2000
+    arc_startbin: int = 3
+    arc_cutmid: int = 3
+    arc_nsmooth: int = 5
+    arc_delmax: float | None = None
+    arc_constraint: tuple = (0.0, np.inf)
+    ref_freq: float = 1400.0
+    return_acf: bool = False
+    return_sspec: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineResult:
+    """Per-epoch measurements from one batched step ([B]-leading leaves)."""
+
+    scint: Any = None       # ScintParams with [B] leaves
+    arc: Any = None         # ArcFit with [B] leaves
+    acf: Any = None         # [B, 2nf, 2nt] when requested
+    sspec: Any = None       # [B, nr, nc] when requested
+    fdop: Any = None
+    tdel: Any = None
+    beta: Any = None
+
+
+def _register():
+    try:
+        import jax
+
+        jax.tree_util.register_pytree_node(
+            PipelineResult,
+            lambda r: ((r.scint, r.arc, r.acf, r.sspec, r.fdop, r.tdel,
+                        r.beta), None),
+            lambda _, l: PipelineResult(*l))
+    except ImportError:  # pragma: no cover
+        pass
+
+
+_register()
+
+
+def lambda_resample_matrix(freqs: np.ndarray) -> tuple[np.ndarray, np.ndarray, float]:
+    """Precompute the freq→uniform-lambda natural-cubic-spline resampling
+    as a dense matrix W so that ``lamdyn = W @ dyn`` (rows already flipped
+    to descending wavelength, matching ops.scale.scale_lambda / reference
+    dynspec.py:1427-1428).  Spline interpolation is linear in the data, so
+    W columns are the splines of the unit vectors."""
+    from ..ops.scale import _cubic_interp_jax
+    from ..data import _C_M_S
+
+    freqs = np.asarray(freqs, dtype=np.float64)
+    lam_eq, dlam = lambda_grid(freqs)
+    feq = _C_M_S / lam_eq / 1e6
+    eye = np.eye(len(freqs))
+    W = np.asarray(_cubic_interp_jax()(eye, freqs, feq))  # [nlam, nf]
+    return W[::-1].copy(), lam_eq[::-1].copy(), float(dlam)
+
+
+def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
+                  mesh=None, chan_sharded: bool = False):
+    """Build the jit'd batched step for a fixed (freqs, times) template.
+
+    Returns ``step(dyn_batch [B, nf, nt]) -> PipelineResult``.  Epochs with
+    other shapes go through parallel.batch.pad_batch / bucket_by_shape
+    first.  dt/df are taken from the template axes (uniform grids, as the
+    reference assumes — dynspec.py:1291-1299).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    freqs = np.asarray(freqs, dtype=np.float64)
+    times = np.asarray(times, dtype=np.float64)
+    nchan, nsub = len(freqs), len(times)
+    df = float(freqs[1] - freqs[0])
+    dt = float(times[1] - times[0])
+    fc = float(np.mean(freqs))
+
+    if config.lamsteps:
+        W, lam, dlam = lambda_resample_matrix(freqs)
+        nf_s = W.shape[0]
+        W_j = jnp.asarray(W)
+    else:
+        W_j, dlam = None, None
+        nf_s = nchan
+
+    fdop, tdel, beta = sspec_axes(nf_s, nsub, dt, df, dlam=dlam)
+    fdop = np.asarray(fdop, dtype=np.float64)
+    tdel = np.asarray(tdel, dtype=np.float64)
+
+    arc_fitter = None
+    if config.fit_arc:
+        arc_fitter = make_arc_fitter(
+            fdop=fdop, yaxis=beta if config.lamsteps else tdel, tdel=tdel,
+            freq=fc, lamsteps=config.lamsteps, numsteps=config.arc_numsteps,
+            startbin=config.arc_startbin, cutmid=config.arc_cutmid,
+            nsmooth=config.arc_nsmooth, delmax=config.arc_delmax,
+            constraint=config.arc_constraint, ref_freq=config.ref_freq)
+
+    def step(dyn_batch):
+        dyn_batch = jnp.asarray(dyn_batch)
+        out = {}
+        scint = None
+        if config.fit_scint or config.return_acf:
+            acf_b = acf_op(dyn_batch, backend="jax")
+            if config.fit_scint:
+                scint = fit_scint_params_batch(
+                    acf_b, dt, df, nchan, nsub, alpha=config.alpha,
+                    steps=config.lm_steps)
+            out["acf"] = acf_b if config.return_acf else None
+        arc = None
+        sec_b = None
+        if config.fit_arc or config.return_sspec:
+            fft_in = (jnp.einsum("lf,bft->blt", W_j, dyn_batch)
+                      if config.lamsteps else dyn_batch)
+            sec_b = sspec_op(fft_in, prewhite=config.prewhite,
+                             window=config.window,
+                             window_frac=config.window_frac, db=True,
+                             backend="jax")
+            if config.fit_arc:
+                arc = arc_fitter(sec_b)
+        return PipelineResult(
+            scint=scint, arc=arc, acf=out.get("acf"),
+            sspec=sec_b if config.return_sspec else None,
+            fdop=jnp.asarray(fdop), tdel=jnp.asarray(tdel),
+            beta=None if beta is None else jnp.asarray(beta))
+
+    if mesh is None:
+        return jax.jit(step)
+
+    in_shard = mesh_mod.data_sharding(mesh, chan_sharded=chan_sharded)
+    return jax.jit(step, in_shardings=in_shard)
+
+
+def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
+                 mesh=None, chunk: int | None = None):
+    """Host-side convenience driver: bucket heterogeneous epochs by shape,
+    pad each bucket to the mesh's data-axis multiple, run the jit'd step
+    per bucket (optionally in memory-bounded chunks), and gather results
+    with invalid lanes dropped.
+
+    Returns a list of (indices, PipelineResult) per bucket, where
+    ``indices`` maps result lanes back to the input epoch order: lane k of
+    every [B]-leading result leaf is epoch ``indices[k]`` (divisibility
+    pad-lanes are sliced off before returning).
+    """
+    from collections import defaultdict
+
+    from .batch import pad_batch
+
+    multiple = 1
+    if mesh is not None:
+        multiple = mesh.shape[mesh_mod.DATA_AXIS]
+    # Bucket on shape AND axis identity: two epochs with equal (nf, nt) but
+    # different bands/sampling must not share a pipeline (its df/fc/lambda
+    # grid are baked in host-side from the template axes).
+    buckets: dict[bytes, list[int]] = defaultdict(list)
+    for i, d in enumerate(epochs):
+        key = (np.asarray(d.freqs, dtype=np.float64).tobytes()
+               + np.asarray(d.times, dtype=np.float64).tobytes())
+        buckets[key].append(i)
+    results = []
+    for idx in buckets.values():
+        group = [epochs[i] for i in idx]
+        batch, _mask = pad_batch(group, batch_multiple=multiple)
+        step = make_pipeline(np.asarray(group[0].freqs),
+                             np.asarray(group[0].times), config, mesh=mesh)
+        dyn = np.asarray(batch.dyn)
+        B = dyn.shape[0]
+        if chunk is None or chunk >= B:
+            res = step(dyn)
+        else:
+            # memory-bounded chunking; chunk must respect mesh divisibility
+            c = max(multiple, (chunk // multiple) * multiple)
+            parts = [step(dyn[i:i + c]) for i in range(0, B, c)]
+            res = _concat_results(parts)
+        results.append((np.asarray(idx), _take_lanes(res, len(idx), B)))
+    return results
+
+
+def _take_lanes(res: PipelineResult, n: int, B: int) -> PipelineResult:
+    """Slice divisibility pad-lanes off every [B]-leading result leaf."""
+    if n == B:
+        return res
+    import jax
+
+    def slice_leaf(x):
+        return x[:n] if (hasattr(x, "ndim") and x.ndim >= 1) else x
+
+    def take(val):
+        if val is None:
+            return None
+        return jax.tree_util.tree_map(slice_leaf, val)
+
+    arc = res.arc
+    if arc is not None:
+        # every arc leaf is [B]-leading except the shared profile_eta grid
+        arc = dataclasses.replace(take(dataclasses.replace(
+            arc, profile_eta=None)), profile_eta=arc.profile_eta)
+    return dataclasses.replace(
+        res, scint=take(res.scint), arc=arc, acf=take(res.acf),
+        sspec=take(res.sspec))
+
+
+def _concat_results(parts):
+    """Concatenate PipelineResult chunks along the epoch axis ([B]-leading
+    leaves of scint/arc/acf/sspec); grid axes are identical across chunks."""
+    import jax
+
+    def _cat_leaf(*xs):
+        a = np.asarray(xs[0])
+        if a.ndim == 0:  # shared scalar (e.g. fixed talpha)
+            return a
+        return np.concatenate([np.asarray(x) for x in xs], axis=0)
+
+    def cat(field):
+        vals = [getattr(p, field) for p in parts]
+        if vals[0] is None:
+            return None
+        return jax.tree_util.tree_map(_cat_leaf, *vals)
+
+    first = parts[0]
+    out = {f: cat(f) for f in ("scint", "acf", "sspec")}
+    arc = None
+    if first.arc is not None:
+        # profile_eta is a shared grid (no batch axis); splice it back
+        cat_arc = jax.tree_util.tree_map(
+            _cat_leaf,
+            *[dataclasses.replace(p.arc, profile_eta=None) for p in parts])
+        arc = dataclasses.replace(cat_arc,
+                                  profile_eta=np.asarray(first.arc.profile_eta))
+    return PipelineResult(scint=out["scint"], arc=arc, acf=out["acf"],
+                          sspec=out["sspec"], fdop=np.asarray(first.fdop),
+                          tdel=np.asarray(first.tdel),
+                          beta=None if first.beta is None
+                          else np.asarray(first.beta))

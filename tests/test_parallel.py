@@ -1,0 +1,204 @@
+"""Mesh, padded batching, and the batched pipeline driver on the 8-virtual-
+device CPU mesh (conftest sets xla_force_host_platform_device_count=8 —
+SURVEY.md §4.5's multi-device-without-a-cluster strategy)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from scintools_tpu.data import DynspecData
+from scintools_tpu.io import from_simulation
+from scintools_tpu.ops import acf, sspec
+from scintools_tpu.parallel import (
+    PipelineConfig, bucket_by_shape, data_sharding, lambda_resample_matrix,
+    make_mesh, make_pipeline, pad_batch, run_pipeline, shard_leading,
+    sharded_mean)
+from scintools_tpu.sim import Simulation
+
+
+def _epoch(seed=1, nf=32, nt=32, freq=1400.0):
+    sim = Simulation(mb2=2, ns=nt, nf=nf, dlam=0.25, seed=seed)
+    return from_simulation(sim, freq=freq, dt=2.0)
+
+
+@pytest.fixture(scope="module")
+def epochs():
+    return [_epoch(seed=s) for s in (1, 2, 3)]
+
+
+def test_make_mesh_default_shape():
+    mesh = make_mesh()
+    assert mesh.shape["data"] == 8
+    assert mesh.shape["chan"] == 1
+
+
+def test_make_mesh_2d():
+    mesh = make_mesh(shape=(4, 2))
+    assert mesh.shape["data"] == 4 and mesh.shape["chan"] == 2
+
+
+def test_pad_batch_masks_and_multiple(epochs):
+    small = epochs[0].replace(dyn=np.asarray(epochs[0].dyn)[:24, :20],
+                              freqs=np.asarray(epochs[0].freqs)[:24],
+                              times=np.asarray(epochs[0].times)[:20])
+    batch, mask = pad_batch([small] + epochs[1:], batch_multiple=4)
+    assert np.asarray(batch.dyn).shape == (4, 32, 32)
+    assert mask.epoch.tolist() == [True, True, True, False]
+    assert mask.freq[0].sum() == 24 and mask.time[0].sum() == 20
+    assert mask.freq[1].all() and mask.time[1].all()
+    # mean-fill: padded region carries the epoch mean -> ~zero power after
+    # mean subtraction
+    pad_vals = np.asarray(batch.dyn)[0, 24:, :]
+    assert pad_vals == pytest.approx(np.mean(np.asarray(small.dyn)))
+
+
+def test_bucket_by_shape(epochs):
+    small = epochs[0].replace(dyn=np.asarray(epochs[0].dyn)[:16, :])
+    buckets = bucket_by_shape(epochs + [small])
+    assert set(buckets) == {(32, 32), (16, 32)}
+    assert buckets[(32, 32)] == [0, 1, 2]
+
+
+def test_lambda_resample_matrix_matches_scale_lambda(epochs):
+    from scintools_tpu.ops import scale_lambda
+
+    d = epochs[0]
+    W, lam, dlam = lambda_resample_matrix(np.asarray(d.freqs))
+    ref, lam_ref, dlam_ref = scale_lambda(d, backend="jax")
+    got = W @ np.asarray(d.dyn)
+    assert dlam == pytest.approx(dlam_ref)
+    np.testing.assert_allclose(lam, lam_ref, rtol=1e-12)
+    np.testing.assert_allclose(got, np.asarray(ref), rtol=1e-6, atol=1e-8)
+
+
+def test_pipeline_step_single_device(epochs):
+    batch, _ = pad_batch(epochs)
+    cfg = PipelineConfig(arc_numsteps=500, lm_steps=25, return_sspec=True)
+    step = make_pipeline(np.asarray(epochs[0].freqs),
+                         np.asarray(epochs[0].times), cfg)
+    res = step(np.asarray(batch.dyn))
+    B = 3
+    assert res.scint.tau.shape == (B,)
+    assert np.all(np.asarray(res.scint.tau) > 0)
+    assert res.arc.eta.shape == (B,)
+    assert np.all(np.isfinite(np.asarray(res.arc.eta)))
+    assert np.asarray(res.sspec).shape[0] == B
+
+
+def test_pipeline_matches_unbatched_ops(epochs):
+    """The fused driver must reproduce the standalone jax kernels."""
+    batch, _ = pad_batch(epochs)
+    cfg = PipelineConfig(lamsteps=False, fit_scint=False, fit_arc=False,
+                         return_sspec=True, return_acf=True)
+    step = make_pipeline(np.asarray(epochs[0].freqs),
+                         np.asarray(epochs[0].times), cfg)
+    res = step(np.asarray(batch.dyn))
+    want_sec = sspec(np.asarray(batch.dyn), backend="jax")
+    want_acf = acf(np.asarray(batch.dyn), backend="jax")
+    np.testing.assert_allclose(np.asarray(res.sspec), np.asarray(want_sec),
+                               rtol=1e-10, atol=1e-10)
+    np.testing.assert_allclose(np.asarray(res.acf), np.asarray(want_acf),
+                               rtol=1e-10, atol=1e-10)
+
+
+def test_pipeline_sharded_matches_single_device(epochs):
+    """DP over the 8-device mesh: same numbers as the unsharded step."""
+    batch, mask = pad_batch(epochs, batch_multiple=8)
+    cfg = PipelineConfig(arc_numsteps=500, lm_steps=25)
+    freqs = np.asarray(epochs[0].freqs)
+    times = np.asarray(epochs[0].times)
+
+    res_plain = make_pipeline(freqs, times, cfg)(np.asarray(batch.dyn))
+
+    mesh = make_mesh()
+    dyn_sharded = jax.device_put(np.asarray(batch.dyn), data_sharding(mesh))
+    res_mesh = make_pipeline(freqs, times, cfg, mesh=mesh)(dyn_sharded)
+
+    np.testing.assert_allclose(np.asarray(res_mesh.scint.tau),
+                               np.asarray(res_plain.scint.tau),
+                               rtol=1e-8)
+    np.testing.assert_allclose(np.asarray(res_mesh.arc.eta),
+                               np.asarray(res_plain.arc.eta), rtol=1e-8)
+    # only the real lanes matter downstream
+    assert mask.epoch[:3].all() and not mask.epoch[3:].any()
+
+
+def test_pipeline_chan_sharded_compiles(epochs):
+    """SP analogue: channel axis sharded 2-way; FFT forces ICI collectives;
+    numbers must not change."""
+    batch, _ = pad_batch(epochs, batch_multiple=4)
+    cfg = PipelineConfig(lamsteps=False, fit_scint=False, fit_arc=False,
+                         return_sspec=True)
+    freqs = np.asarray(epochs[0].freqs)
+    times = np.asarray(epochs[0].times)
+    mesh = make_mesh(shape=(4, 2))
+    dyn = jax.device_put(np.asarray(batch.dyn),
+                         data_sharding(mesh, chan_sharded=True))
+    res = make_pipeline(freqs, times, cfg, mesh=mesh, chan_sharded=True)(dyn)
+    res_plain = make_pipeline(freqs, times, cfg)(np.asarray(batch.dyn))
+    got = np.asarray(res.sspec)
+    want = np.asarray(res_plain.sspec)
+    # exact-zero power bins hit log10 -> -inf and flip with FFT summation
+    # order under resharding; compare where there is signal
+    sig = want > -200
+    assert sig.mean() > 0.9
+    np.testing.assert_allclose(got[sig], want[sig], rtol=1e-6, atol=1e-6)
+
+
+def test_run_pipeline_heterogeneous(epochs):
+    small = epochs[0].replace(dyn=np.asarray(epochs[0].dyn)[:16, :],
+                              freqs=np.asarray(epochs[0].freqs)[:16])
+    cfg = PipelineConfig(arc_numsteps=400, lm_steps=20, fit_arc=False)
+    results = run_pipeline(epochs + [small], cfg)
+    shapes = {tuple(np.asarray(idx).tolist()) for idx, _ in results}
+    assert shapes == {(0, 1, 2), (3,)}
+    for idx, res in results:
+        assert res.scint.tau.shape[0] == len(idx)
+
+
+def test_run_pipeline_mesh_trims_pad_lanes(epochs):
+    """3 epochs on an 8-device mesh: pad_batch rounds B up to 8, but the
+    returned lanes must be exactly the 3 real epochs."""
+    mesh = make_mesh()
+    cfg = PipelineConfig(arc_numsteps=400, lm_steps=20)
+    [(idx, res)] = run_pipeline(epochs, cfg, mesh=mesh)
+    assert idx.tolist() == [0, 1, 2]
+    assert res.scint.tau.shape == (3,)
+    assert res.arc.eta.shape == (3,)
+    [(_, res_plain)] = run_pipeline(epochs, cfg)
+    np.testing.assert_allclose(np.asarray(res.scint.tau),
+                               np.asarray(res_plain.scint.tau), rtol=1e-8)
+
+
+def test_run_pipeline_buckets_by_axis_identity(epochs):
+    """Equal shapes but a shifted band must NOT share a pipeline."""
+    shifted = epochs[0].replace(freqs=np.asarray(epochs[0].freqs) * 0.5,
+                                freq=None, bw=None, df=None)
+    cfg = PipelineConfig(fit_arc=False, lm_steps=15)
+    results = run_pipeline(epochs + [shifted], cfg)
+    groups = sorted(tuple(np.asarray(i).tolist()) for i, _ in results)
+    assert groups == [(0, 1, 2), (3,)]
+
+
+def test_run_pipeline_chunked_matches(epochs):
+    cfg = PipelineConfig(arc_numsteps=400, lm_steps=20)
+    [(idx_a, a)] = run_pipeline(epochs * 2, cfg)
+    [(idx_b, b)] = run_pipeline(epochs * 2, cfg, chunk=2)
+    np.testing.assert_allclose(np.asarray(a.scint.tau),
+                               np.asarray(b.scint.tau), rtol=1e-8)
+    np.testing.assert_allclose(np.asarray(a.arc.eta),
+                               np.asarray(b.arc.eta), rtol=1e-8)
+    np.testing.assert_array_equal(idx_a, idx_b)
+
+
+def test_shard_leading_and_sharded_mean(epochs):
+    mesh = make_mesh()
+    x = np.arange(16.0).reshape(16, 1) * np.ones((16, 4))
+    xs = jax.device_put(x, data_sharding(mesh))
+    got = sharded_mean(xs, mesh)
+    np.testing.assert_allclose(np.asarray(got), x.mean(axis=0), rtol=1e-12)
+
+    batch, _ = pad_batch(epochs * 3, batch_multiple=8)
+    sharded = shard_leading(batch, mesh)
+    assert np.asarray(sharded.dyn).shape[0] == 16
