@@ -136,10 +136,15 @@ def _sspec_jax():
                      - dyn[..., :-1, 1:] + dyn[..., :-1, :-1])
         else:
             simpw = dyn
-        simf = jnp.fft.fft2(simpw, s=[nrfft, ncfft])
+        # real input + positive-delay crop -> real FFT over the delay (row)
+        # axis: rfftn computes u = 0..nrfft/2 directly, halving the work of
+        # the reference's full complex fft2 (dynspec.py:1286-1289) whose
+        # negative delays are discarded anyway.  Row r of the reference's
+        # fftshift-then-crop output is u = r (delay axis unshifted), column
+        # c is v = c - ncfft/2 (Doppler axis shifted).
+        simf = jnp.fft.rfftn(simpw, s=(ncfft, nrfft), axes=(-1, -2))
         sec = jnp.real(simf) ** 2 + jnp.imag(simf) ** 2
-        sec = jnp.fft.fftshift(sec, axes=(-2, -1))
-        sec = sec[..., nrfft // 2:, :]
+        sec = jnp.fft.fftshift(sec, axes=-1)[..., : nrfft // 2, :]
         if prewhite:
             sec = sec / _postdark(nrfft, ncfft, xp=jnp).astype(sec.dtype)
         if db:

@@ -125,12 +125,24 @@ def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
     other shapes go through parallel.batch.pad_batch / bucket_by_shape
     first.  dt/df are taken from the template axes (uniform grids, as the
     reference assumes — dynspec.py:1291-1299).
+
+    Memoised on (axes, config, mesh): repeated calls with the same template
+    return the same compiled step (no retrace/recompile per survey batch).
     """
+    freqs = np.ascontiguousarray(np.asarray(freqs, dtype=np.float64))
+    times = np.ascontiguousarray(np.asarray(times, dtype=np.float64))
+    return _make_pipeline_cached(
+        (freqs.tobytes(), freqs.shape), (times.tobytes(), times.shape),
+        config, mesh, bool(chan_sharded))
+
+
+@functools.lru_cache(maxsize=None)
+def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
     import jax
     import jax.numpy as jnp
 
-    freqs = np.asarray(freqs, dtype=np.float64)
-    times = np.asarray(times, dtype=np.float64)
+    freqs = np.frombuffer(freqs_key[0]).reshape(freqs_key[1])
+    times = np.frombuffer(times_key[0]).reshape(times_key[1])
     nchan, nsub = len(freqs), len(times)
     df = float(freqs[1] - freqs[0])
     dt = float(times[1] - times[0])
@@ -162,7 +174,18 @@ def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
         out = {}
         scint = None
         if config.fit_scint or config.return_acf:
-            acf_b = acf_op(dyn_batch, backend="jax")
+            dyn_acf = dyn_batch
+            if mesh is not None and chan_sharded:
+                # Sharding policy: the ACF/fit path is small (one [2nf,2nt]
+                # array per epoch), so gather the channel axis and run it
+                # purely data-parallel; only the big secondary-spectrum FFT
+                # keeps the chan sharding.  (Also sidesteps an XLA CPU
+                # fft-thunk layout RET_CHECK on chan-sharded ifft2.)
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                dyn_acf = jax.lax.with_sharding_constraint(
+                    dyn_batch, NamedSharding(mesh, P(mesh_mod.DATA_AXIS)))
+            acf_b = acf_op(dyn_acf, backend="jax")
             if config.fit_scint:
                 scint = fit_scint_params_batch(
                     acf_b, dt, df, nchan, nsub, alpha=config.alpha,
@@ -214,10 +237,11 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
     # Bucket on shape AND axis identity: two epochs with equal (nf, nt) but
     # different bands/sampling must not share a pipeline (its df/fc/lambda
     # grid are baked in host-side from the template axes).
-    buckets: dict[bytes, list[int]] = defaultdict(list)
+    buckets: dict[tuple, list[int]] = defaultdict(list)
     for i, d in enumerate(epochs):
-        key = (np.asarray(d.freqs, dtype=np.float64).tobytes()
-               + np.asarray(d.times, dtype=np.float64).tobytes())
+        f = np.asarray(d.freqs, dtype=np.float64)
+        t = np.asarray(d.times, dtype=np.float64)
+        key = (f.shape, t.shape, f.tobytes(), t.tobytes())
         buckets[key].append(i)
     results = []
     for idx in buckets.values():

@@ -84,11 +84,14 @@ def shard_leading(tree, mesh):
     data = NamedSharding(mesh, P(DATA_AXIS))
     rep = replicated(mesh)
 
-    def put(leaf):
-        import numpy as np
+    import numpy as np
 
-        arr = np.asarray(leaf)
-        return jax.device_put(leaf, data if arr.ndim >= 1 else rep)
+    def put(leaf):
+        # read the rank without materialising device arrays on host
+        ndim = getattr(leaf, "ndim", None)
+        if ndim is None:
+            ndim = np.ndim(leaf)
+        return jax.device_put(leaf, data if ndim >= 1 else rep)
 
     return jax.tree_util.tree_map(put, tree)
 
