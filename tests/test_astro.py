@@ -1,0 +1,145 @@
+"""Astro ephemeris tests: physical invariants (no astropy in the image, so
+we check against well-known solar-system facts rather than a library)."""
+
+import numpy as np
+import pytest
+
+from scintools_tpu.astro import (
+    earth_posvel,
+    get_earth_velocity,
+    get_ssb_delay,
+    get_true_anomaly,
+    solve_kepler,
+)
+
+MJD_2024 = 60310.0  # 2024-01-01
+
+
+def test_kepler_roundtrip():
+    rng = np.random.default_rng(0)
+    M = rng.uniform(-np.pi, np.pi, 256)
+    for e in (0.0, 0.1, 0.6, 0.9):
+        E = solve_kepler(M, e)
+        np.testing.assert_allclose(E - e * np.sin(E), M, atol=1e-12)
+
+
+def test_earth_orbit_radius_and_speed():
+    mjd = MJD_2024 + np.arange(366.0)
+    (x, y, z), (vx, vy, vz) = earth_posvel(mjd)
+    r = np.sqrt(x**2 + y**2 + z**2)
+    v = np.sqrt(vx**2 + vy**2 + vz**2) * 1.495978707e8 / 86400.0  # km/s
+    # perihelion 0.9833 AU, aphelion 1.0167 AU (+ ~5e-3 AU SSB wobble)
+    assert 0.975 < r.min() < 0.99
+    assert 1.01 < r.max() < 1.025
+    # orbital speed 29.29..30.29 km/s
+    assert 29.0 < v.min() < 29.5
+    assert 30.0 < v.max() < 30.6
+    # perihelion (max speed) in early January
+    assert np.argmax(v) < 15 or np.argmax(v) > 360
+
+
+def test_vernal_equinox_geometry():
+    # At the March equinox the Sun's apparent direction is RA=0, so Earth
+    # sits at RA ~ 180 deg: x ~ -1 AU, |y| and |z| small.
+    mjd_equinox = 60389.0  # 2024-03-20
+    (x, y, z), _ = earth_posvel(mjd_equinox)
+    assert x < -0.98
+    assert abs(y) < 0.05
+    assert abs(z) < 0.02
+
+
+def test_ssb_delay_amplitude_and_sign():
+    mjd = MJD_2024 + np.arange(366.0)
+    # Source in the ecliptic plane (RA 0, DEC ~ 0): delay swings ~ +-499 s
+    d = get_ssb_delay(mjd, 0.0, 0.0)
+    assert 480 < np.max(d) < 510
+    assert -510 < np.min(d) < -480
+    # Source near the ecliptic pole: delay stays small
+    pole = get_ssb_delay(mjd, np.deg2rad(270.0), np.deg2rad(66.56))
+    assert np.max(np.abs(pole)) < 40
+
+
+def test_earth_velocity_annual_signature():
+    mjd = MJD_2024 + np.arange(366.0)
+    v_ra, v_dec = get_earth_velocity(mjd, 1.0, 0.3)
+    # projections bounded by the orbital speed, with annual periodicity
+    assert np.max(np.abs(v_ra)) < 30.6
+    assert np.max(np.abs(v_dec)) < 30.6
+    assert np.max(np.abs(v_ra)) > 20  # ecliptic-ish source sees most of it
+    # one-year periodicity to ~ the EMB approximation error
+    v_ra2, _ = get_earth_velocity(mjd + 365.25, 1.0, 0.3)
+    assert np.max(np.abs(v_ra - v_ra2)) < 0.3
+
+
+def test_true_anomaly_circular_and_eccentric():
+    pars = {"T0": 50000.0, "PB": 10.0, "ECC": 0.0}
+    mjds = 50000.0 + np.array([0.0, 2.5, 5.0, 7.5])
+    nu = get_true_anomaly(mjds, pars)
+    # circular orbit: true anomaly == mean anomaly
+    np.testing.assert_allclose(
+        np.mod(nu, 2 * np.pi), [0.0, np.pi / 2, np.pi, 3 * np.pi / 2],
+        atol=1e-10)
+
+    pars_e = {"T0": 50000.0, "PB": 10.0, "ECC": 0.5}
+    nu_e = get_true_anomaly(mjds, pars_e)
+    # eccentric orbit sweeps true anomaly faster near periastron
+    assert np.mod(nu_e[1], 2 * np.pi) > np.pi / 2
+    # at periastron and half-period (apastron) they agree
+    np.testing.assert_allclose(nu_e[0], 0.0, atol=1e-10)
+    np.testing.assert_allclose(np.mod(nu_e[2], 2 * np.pi), np.pi, atol=1e-10)
+
+
+def test_true_anomaly_pbdot_heuristic():
+    pars = {"T0": 50000.0, "PB": 10.0, "ECC": 0.0, "PBDOT": 0.0}
+    pars_pbdot = dict(pars, PBDOT=500.0)  # in 1e-12 s/s units, heuristic
+    mjds = 50000.0 + np.array([5000.0])
+    nu0 = get_true_anomaly(mjds, pars)
+    nu1 = get_true_anomaly(mjds, pars_pbdot)
+    # tiny but nonzero phase shift after 500 orbits
+    assert nu0 != nu1
+    assert abs(nu0 - nu1) < 0.01
+
+
+def test_jax_parity():
+    jnp = pytest.importorskip("jax.numpy")
+    mjd = MJD_2024 + np.linspace(0, 300, 32)
+    v_ra_np, v_dec_np = get_earth_velocity(mjd, 1.1, -0.4)
+    v_ra_j, v_dec_j = get_earth_velocity(jnp.asarray(mjd), 1.1, -0.4, xp=jnp)
+    np.testing.assert_allclose(v_ra_np, np.asarray(v_ra_j), atol=1e-8)
+    np.testing.assert_allclose(v_dec_np, np.asarray(v_dec_j), atol=1e-8)
+
+    pars = {"T0": 50000.0, "PB": 5.741, "ECC": 0.0879}
+    nu_np = get_true_anomaly(mjd, pars)
+    nu_j = get_true_anomaly(jnp.asarray(mjd), pars, xp=jnp)
+    np.testing.assert_allclose(nu_np, np.asarray(nu_j), atol=1e-8)
+
+
+def test_curvature_physics_chain():
+    """End-to-end: ephemeris + orbit -> effective velocity -> eta(t) model,
+    then recover the screen fraction s from noisy synthetic curvatures by
+    least squares (the reference's arc_curvature fitting workflow,
+    scint_models.py:266-315 driven by scint_utils.py:134-314)."""
+    from scipy.optimize import least_squares
+
+    from scintools_tpu.models.velocity import arc_curvature_model
+
+    pars = {"T0": 50000.0, "PB": 5.741, "ECC": 0.0879, "A1": 3.3667,
+            "OM": 1.0, "KIN": 42.4, "KOM": 207.0,
+            "PMRA": 121.4, "PMDEC": -71.5}
+    raj, decj = 1.2098, -0.8243  # J0437-ish, radians
+    mjds = 53000.0 + np.linspace(0, 365.25, 40)
+
+    nu = get_true_anomaly(mjds, pars)
+    v_ra, v_dec = get_earth_velocity(mjds, raj, decj)
+
+    true = dict(pars, d=0.157, s=0.7)
+    eta_true = arc_curvature_model(true, nu, v_ra, v_dec)
+    rng = np.random.default_rng(1)
+    eta_obs = eta_true * (1 + 0.02 * rng.standard_normal(len(mjds)))
+
+    def resid(p):
+        trial = dict(pars, d=0.157, s=p[0])
+        return eta_obs - arc_curvature_model(trial, nu, v_ra, v_dec)
+
+    res = least_squares(resid, x0=[0.5], bounds=([0.01], [0.99]))
+    assert res.x[0] == pytest.approx(0.7, abs=0.03)

@@ -1,0 +1,138 @@
+"""Dynspec wrapper: reference-UX workflow tests (SURVEY.md §4 integration:
+load -> process -> fit on seeded simulated data; sort_dyn triage)."""
+
+import numpy as np
+import pytest
+
+from scintools_tpu import Dynspec, sort_dyn
+from scintools_tpu.io import from_simulation, write_psrflux
+from scintools_tpu.sim import Simulation
+
+
+@pytest.fixture(scope="module")
+def sim_dyn():
+    sim = Simulation(mb2=2, ns=128, nf=128, dlam=0.25, seed=1234)
+    return from_simulation(sim, freq=1400.0, dt=8.0)
+
+
+@pytest.fixture(scope="module")
+def processed(sim_dyn):
+    ds = Dynspec(data=sim_dyn, process=True, lamsteps=True)
+    return ds
+
+
+def test_attribute_delegation(sim_dyn):
+    ds = Dynspec(data=sim_dyn, process=False)
+    assert ds.nchan == 128 and ds.nsub == 128
+    assert ds.freq == pytest.approx(1400.0)
+    np.testing.assert_array_equal(np.asarray(ds.dyn),
+                                  np.asarray(sim_dyn.dyn))
+    with pytest.raises(AttributeError):
+        ds.not_an_attribute
+
+
+def test_default_processing_products(processed):
+    ds = processed
+    assert ds.acf is not None and ds.acf.shape == (2 * ds.nchan, 2 * ds.nsub)
+    assert ds.lamsspec is not None and ds.beta is not None
+    assert ds.fdop is not None and ds.tdel is not None
+    assert np.isfinite(ds.lamsspec).any()
+
+
+def test_lazy_sspec_and_arc(sim_dyn):
+    ds = Dynspec(data=sim_dyn, process=False)
+    ds.trim_edges().refill()
+    assert ds.sspec is None and ds.lamsspec is None
+    fit = ds.fit_arc(lamsteps=True, numsteps=2000)  # triggers lazy sspec
+    assert ds.lamsspec is not None
+    assert ds.betaeta is not None and ds.betaeta > 0
+    assert np.isfinite(fit.eta)
+
+
+def test_lazy_acf_scint_params(sim_dyn):
+    ds = Dynspec(data=sim_dyn, process=False)
+    ds.trim_edges().refill()
+    sp = ds.get_scint_params()  # triggers lazy acf
+    assert ds.acf is not None
+    assert ds.tau > 0 and ds.dnu > 0
+    assert np.isfinite(sp.redchi)
+
+
+def test_backend_jax_matches_numpy(sim_dyn):
+    pytest.importorskip("jax")
+    ds_np = Dynspec(data=sim_dyn, process=True, lamsteps=False)
+    ds_j = Dynspec(data=sim_dyn, process=True, lamsteps=False,
+                   backend="jax")
+    mask = np.isfinite(ds_np.sspec) & (ds_np.sspec
+                                       > np.nanmax(ds_np.sspec) - 100)
+    assert np.nanmax(np.abs(ds_j.sspec[mask] - ds_np.sspec[mask])) < 1e-5
+
+
+def test_add_concatenates_epochs(sim_dyn):
+    a = Dynspec(data=sim_dyn, process=False)
+    b = Dynspec(data=sim_dyn.replace(
+        mjd=sim_dyn.mjd + (sim_dyn.tobs + 100) / 86400.0), process=False)
+    c = a + b
+    assert c.nsub > 2 * a.nsub  # gap zero-filled
+    assert c.nchan == a.nchan
+
+
+def test_scale_dyn_trapezoid(sim_dyn):
+    ds = Dynspec(data=sim_dyn, process=False)
+    ds.scale_dyn(scale="trapezoid")
+    assert ds.trapdyn.shape == np.asarray(sim_dyn.dyn).shape
+
+
+def test_cut_dyn_tiles(sim_dyn):
+    ds = Dynspec(data=sim_dyn, process=False)
+    ds.trim_edges().refill()
+    cutdyn, cutsspec = ds.cut_dyn(fcuts=1, tcuts=3)
+    assert len(cutdyn) == 2 and len(cutdyn[0]) == 4
+    assert sum(t.shape[1] for t in cutdyn[0]) == ds.nsub
+    assert sum(row[0].shape[0] for row in cutdyn) == ds.nchan
+    assert all(np.isfinite(s).any() for row in cutsspec for s in row)
+    assert len(ds.cutfreq) == 2 and len(ds.cutmjd) == 4
+
+
+def test_norm_sspec_method(processed):
+    ns = processed.norm_sspec(maxnormfac=2, numsteps=256)
+    assert ns.normsspecavg.shape == (256,)
+    assert np.isfinite(ns.normsspecavg).any()
+
+
+def test_svd_and_zap_and_crop(sim_dyn):
+    ds = Dynspec(data=sim_dyn, process=False)
+    ds.trim_edges().refill().svd_model(nmodes=1)
+    assert np.isfinite(np.asarray(ds.dyn)).all()
+    ds.zap(method="median", sigma=5)
+    ds.refill()
+    n0 = ds.nchan
+    ds.crop_dyn(fmin=float(np.min(ds.freqs)) + 10)
+    assert ds.nchan < n0
+
+
+def test_write_file_roundtrip(tmp_path, sim_dyn):
+    ds = Dynspec(data=sim_dyn, process=False)
+    fn = str(tmp_path / "rt.dynspec")
+    ds.write_file(fn)
+    ds2 = Dynspec(filename=fn, process=False)
+    np.testing.assert_allclose(np.asarray(ds2.dyn), np.asarray(ds.dyn),
+                               atol=1e-4 * np.abs(np.asarray(ds.dyn)).max())
+
+
+def test_sort_dyn_triage(tmp_path, sim_dyn):
+    good_fn = str(tmp_path / "good.dynspec")
+    write_psrflux(sim_dyn, good_fn)
+    # a bad epoch: too few channels
+    bad = sim_dyn.replace(dyn=np.asarray(sim_dyn.dyn)[:8, :],
+                          freqs=np.asarray(sim_dyn.freqs)[:8])
+    bad_fn = str(tmp_path / "bad.dynspec")
+    write_psrflux(bad, bad_fn)
+    missing_fn = str(tmp_path / "missing.dynspec")
+
+    good, badl = sort_dyn([good_fn, bad_fn, missing_fn],
+                          outdir=str(tmp_path))
+    assert good == [good_fn]
+    assert set(badl) == {bad_fn, missing_fn}
+    assert (tmp_path / "good_files.txt").read_text().strip() == good_fn
+    assert len((tmp_path / "bad_files.txt").read_text().split()) == 2

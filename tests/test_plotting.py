@@ -1,0 +1,69 @@
+"""Plotting smoke tests (Agg backend): every view renders and saves."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from scintools_tpu import Dynspec  # noqa: E402
+from scintools_tpu import plotting  # noqa: E402
+from scintools_tpu.io import from_simulation  # noqa: E402
+from scintools_tpu.sim import Simulation  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def ds():
+    sim = Simulation(mb2=2, ns=128, nf=128, dlam=0.25, seed=1234)
+    d = from_simulation(sim, freq=1400.0, dt=8.0)
+    out = Dynspec(data=d, process=True, lamsteps=True)
+    out._sim = sim
+    return out
+
+
+def test_plot_dyn(ds, tmp_path):
+    fig = ds.plot_dyn(filename=str(tmp_path / "dyn.png"))
+    assert (tmp_path / "dyn.png").stat().st_size > 0
+    plt.close(fig)
+
+
+def test_plot_acf(ds, tmp_path):
+    ds.get_scint_params()
+    fig = ds.plot_acf(filename=str(tmp_path / "acf.png"), crop_frac=0.5)
+    assert (tmp_path / "acf.png").stat().st_size > 0
+    plt.close(fig)
+
+
+def test_plot_sspec_with_arc(ds, tmp_path):
+    ds.fit_arc(lamsteps=True, numsteps=2000)
+    fig = ds.plot_sspec(plotarc=True, filename=str(tmp_path / "ss.png"))
+    assert (tmp_path / "ss.png").stat().st_size > 0
+    plt.close(fig)
+
+
+def test_plot_norm_sspec_and_arc_profile(ds, tmp_path):
+    ns = ds.norm_sspec(numsteps=256)
+    fig = plotting.plot_norm_sspec(ns, filename=str(tmp_path / "ns.png"))
+    plt.close(fig)
+    fig = plotting.plot_arc_profile(ds.arc_fit,
+                                    filename=str(tmp_path / "ap.png"))
+    assert (tmp_path / "ap.png").stat().st_size > 0
+    plt.close(fig)
+
+
+def test_plot_all(ds, tmp_path):
+    fig = ds.plot_all(filename=str(tmp_path / "all.png"))
+    assert (tmp_path / "all.png").stat().st_size > 0
+    plt.close(fig)
+
+
+def test_sim_views(ds, tmp_path):
+    sim = ds._sim
+    for fn, name in ((plotting.plot_screen, "screen"),
+                     (plotting.plot_intensity, "intensity"),
+                     (plotting.plot_efield, "efield")):
+        fig = fn(sim, filename=str(tmp_path / f"{name}.png"))
+        assert (tmp_path / f"{name}.png").stat().st_size > 0
+        plt.close(fig)
