@@ -1,0 +1,222 @@
+"""Command-line interface.
+
+The reference ships an argparse stub with zero arguments that does
+nothing (scintools/scintools.py:1-16).  This is the real CLI planned in
+SURVEY.md §5: ``info`` / ``process`` / ``sort`` / ``sim`` / ``bench``.
+
+    python -m scintools_tpu process obs1.dynspec obs2.dynspec \
+        --lamsteps --backend jax --results results.csv --store runs/survey
+
+``process`` is resumable: with ``--store`` each finished epoch is written
+to a content-hash-keyed store, and a rerun skips everything already done.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import sys
+
+import numpy as np
+
+
+def _expand(patterns: list[str]) -> list[str]:
+    from .utils import remove_duplicates
+
+    out = []
+    for p in patterns:
+        hits = sorted(glob.glob(p))
+        out.extend(hits if hits else [p])
+    return remove_duplicates(out)
+
+
+def cmd_info(args) -> int:
+    from .pipeline import Dynspec
+
+    rc = 0
+    for fn in _expand(args.files):
+        try:
+            Dynspec(filename=fn, process=False).info()
+        except Exception as e:
+            print(f"{fn}: unreadable ({e!r})", file=sys.stderr)
+            rc = 1
+    return rc
+
+
+def cmd_process(args) -> int:
+    from .pipeline import Dynspec
+    from .io.results import results_row, write_results
+    from .utils import (ResultsStore, StageTimers, content_key, get_logger,
+                        log_event)
+
+    log = get_logger()
+    timers = StageTimers()
+    files = _expand(args.files)
+    store = ResultsStore(args.store) if args.store else None
+    cfg = ("process", args.lamsteps, args.backend, not args.no_arc,
+           not args.no_scint)
+    if args.plots:
+        import os
+
+        os.makedirs(args.plots, exist_ok=True)
+    if store is not None:
+        todo = store.pending(files, lambda f: content_key(f, cfg))
+        log_event(log, "resume", total=len(files), todo=len(todo),
+                  done=len(files) - len(todo))
+        files = todo
+    failed = 0
+    for fn in files:
+        try:
+            with timers.stage("load+process"):
+                ds = Dynspec(filename=fn, process=True,
+                             lamsteps=args.lamsteps, backend=args.backend)
+            scint = arc = None
+            if not args.no_scint:
+                with timers.stage("scint_fit"):
+                    scint = ds.get_scint_params()
+            if not args.no_arc:
+                with timers.stage("arc_fit"):
+                    arc = ds.fit_arc(lamsteps=args.lamsteps)
+            row = results_row(ds.data, scint=scint, arc=arc)
+            if args.plots:
+                with timers.stage("plots"):
+                    import matplotlib
+
+                    matplotlib.use("Agg")
+                    ds.plot_all(filename=f"{args.plots}/"
+                                f"{row['name']}_all.png")
+            # store.put last: an epoch only counts as done once all its
+            # artefacts (CSV row comes from the store on export) exist
+            if args.results:
+                write_results(args.results, row)
+            if store is not None:
+                store.put(content_key(fn, cfg), row)
+            log_event(log, "epoch", file=fn,
+                      tau=row.get("tau"), dnu=row.get("dnu"),
+                      eta=row.get("betaeta", row.get("eta")))
+        except Exception as e:  # quarantine; keep the batch going
+            failed += 1
+            log_event(log, "epoch_failed", file=fn, error=repr(e))
+    if store is not None and args.results:
+        store.export_csv(args.results)
+    print(timers.report(), file=sys.stderr)
+    log_event(log, "done", processed=len(files) - failed, failed=failed)
+    return 0 if failed == 0 else 1
+
+
+def cmd_sort(args) -> int:
+    from .pipeline import sort_dyn
+
+    good, bad = sort_dyn(_expand(args.files), outdir=args.outdir,
+                         min_nsub=args.min_nsub, min_nchan=args.min_nchan,
+                         min_freq=args.min_freq, max_freq=args.max_freq,
+                         verbose=args.verbose)
+    print(json.dumps({"good": len(good), "bad": len(bad)}))
+    return 0
+
+
+def cmd_sim(args) -> int:
+    from .io import from_simulation
+    from .io.psrflux import write_psrflux
+    from .sim import Simulation
+
+    sim = Simulation(mb2=args.mb2, rf=args.rf, ds=args.ds,
+                     alpha=args.alpha, ar=args.ar, psi=args.psi,
+                     inner=args.inner, ns=args.ns, nf=args.nf,
+                     dlam=args.dlam, seed=args.seed, backend=args.backend)
+    d = from_simulation(sim, freq=args.freq, dt=args.dt)
+    write_psrflux(d, args.out)
+    print(json.dumps({"out": args.out, "nchan": d.nchan, "nsub": d.nsub}))
+    return 0
+
+
+def cmd_bench(args) -> int:
+    # bench.py lives at the repo root (the driver contract), not in the
+    # installed package: load it by path relative to this package, falling
+    # back to a plain import for checkout layouts with cwd on sys.path.
+    import importlib.util
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "bench.py")
+    if os.path.exists(path):
+        spec = importlib.util.spec_from_file_location("bench", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.main()
+        return 0
+    try:
+        import bench
+    except ImportError:
+        print("bench.py not found (run from a repo checkout)",
+              file=sys.stderr)
+        return 1
+    bench.main()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="scintools-tpu",
+        description="TPU-native pulsar scintillation analysis")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    q = sub.add_parser("info", help="print observation metadata")
+    q.add_argument("files", nargs="+")
+    q.set_defaults(fn=cmd_info)
+
+    q = sub.add_parser("process",
+                       help="process epochs: clean -> acf/sspec -> fits")
+    q.add_argument("files", nargs="+")
+    q.add_argument("--lamsteps", action="store_true")
+    q.add_argument("--backend", default="numpy",
+                   choices=["numpy", "jax"])
+    q.add_argument("--results", help="append-mode CSV output")
+    q.add_argument("--store", help="resumable per-epoch results dir")
+    q.add_argument("--plots", help="write summary plots to this dir")
+    q.add_argument("--no-arc", action="store_true")
+    q.add_argument("--no-scint", action="store_true")
+    q.set_defaults(fn=cmd_process)
+
+    q = sub.add_parser("sort", help="triage files into good/bad lists")
+    q.add_argument("files", nargs="+")
+    q.add_argument("--outdir")
+    q.add_argument("--min-nsub", type=int, default=10)
+    q.add_argument("--min-nchan", type=int, default=50)
+    q.add_argument("--min-freq", type=float, default=0)
+    q.add_argument("--max-freq", type=float, default=5000)
+    q.add_argument("--verbose", action="store_true")
+    q.set_defaults(fn=cmd_sort)
+
+    q = sub.add_parser("sim", help="simulate a dynspec -> psrflux file")
+    q.add_argument("--out", required=True)
+    q.add_argument("--mb2", type=float, default=2)
+    q.add_argument("--rf", type=float, default=1)
+    q.add_argument("--ds", type=float, default=0.01)
+    q.add_argument("--alpha", type=float, default=5 / 3)
+    q.add_argument("--ar", type=float, default=1)
+    q.add_argument("--psi", type=float, default=0)
+    q.add_argument("--inner", type=float, default=0.001)
+    q.add_argument("--ns", type=int, default=256)
+    q.add_argument("--nf", type=int, default=256)
+    q.add_argument("--dlam", type=float, default=0.25)
+    q.add_argument("--seed", type=int, default=None)
+    q.add_argument("--freq", type=float, default=1400.0)
+    q.add_argument("--dt", type=float, default=8.0)
+    q.add_argument("--backend", default="numpy",
+                   choices=["numpy", "jax"])
+    q.set_defaults(fn=cmd_sim)
+
+    q = sub.add_parser("bench", help="run the headline benchmark")
+    q.set_defaults(fn=cmd_bench)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

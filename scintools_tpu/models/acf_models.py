@@ -76,3 +76,34 @@ def scint_sspec_model(x_t, x_f, tau, dnu, amp, wn, alpha=5 / 3, xp=np):
     mt = tau_sspec_model(x_t, tau, amp, wn, alpha, xp=xp)
     mf = dnu_sspec_model(x_f, dnu, amp, wn, xp=xp)
     return xp.concatenate([mt, mf])
+
+
+def scint_acf_model_2d(x_t, x_f, tau, dnu, amp, wn, alpha=5 / 3,
+                       tilt=0.0, tmax=None, fmax=None, xp=np):
+    """2-D ACF model over signed (time, frequency) lags — the model the
+    reference declares but leaves empty (``scint_acf_model_2D``,
+    scint_models.py:108-112).
+
+    Design (ours, consistent with the 1-D cuts): stretched-exponential
+    temporal decorrelation sheared by a phase-gradient ``tilt`` (s/MHz —
+    refraction displaces the scintle pattern linearly in time per unit
+    frequency), exponential frequency decorrelation with half-power
+    bandwidth ``dnu``, a zero-lag white-noise spike, and the separable
+    finite-scan triangle taper.  At ``x_f=0`` / ``x_t=0`` it reduces to
+    :func:`tau_acf_model` / :func:`dnu_acf_model`.
+
+    x_t: [nt] signed time lags (s); x_f: [nf] signed frequency lags (MHz).
+    ``tmax``/``fmax`` are the taper scales — the FULL scan duration and
+    bandwidth (they default to the lag extent, which is only correct when
+    the lags span the whole scan; pass them explicitly when fitting a
+    cropped window).  Returns [nf, nt].
+    """
+    t = x_t[None, :]
+    f = x_f[:, None]
+    tmax = xp.max(xp.abs(x_t)) if tmax is None else tmax
+    fmax = xp.max(xp.abs(x_f)) if fmax is None else fmax
+    model = amp * xp.exp(-(xp.abs(t - tilt * f) / tau) ** alpha
+                         - xp.abs(f) * np.log(2) / dnu)
+    model = model + wn * ((t == 0) & (f == 0))
+    taper = (1 - xp.abs(t) / tmax) * (1 - xp.abs(f) / fmax)
+    return model * taper

@@ -283,20 +283,42 @@ class Dynspec:
         self.norm_sspec_result = ns
         return ns
 
-    def get_scint_params(self, method: str = "acf1d",
-                         alpha: float | None = 5 / 3,
+    def get_scint_params(self, method: str = "acf1d", *,
+                         alpha: float | None = 5 / 3, mcmc: bool = False,
                          backend: str | None = None) -> ScintParams:
         """tau_d / dnu_d from the ACF (dynspec.py:928-1033).  Sets
-        ``tau/tauerr/dnu/dnuerr/talpha`` (and ``scint_params``)."""
+        ``tau/tauerr/dnu/dnuerr/talpha`` (and ``scint_params``).
+
+        ``method='acf2d'`` fits the full 2-D ACF model incl. phase-gradient
+        tilt (sets ``tilt/tilterr``); ``mcmc=True`` refines the acf1d fit
+        with posterior sampling (the reference's lmfit-emcee option,
+        dynspec.py:989-992, rebuilt as a jax ensemble sampler)."""
         if self.acf is None:
             self.calc_acf()
-        if method not in ("acf1d",):
-            raise ValueError(f"unknown method {method!r} (acf2d via "
-                             "fit.fit_scint_params_2d)")
-        sp = _fit_scint_params(
-            self.acf, dt=self._data.dt, df=abs(self._data.df),
-            nchan=self._data.nchan, nsub=self._data.nsub, alpha=alpha,
-            backend=resolve(backend or self.backend))
+        b = resolve(backend or self.backend)
+        kw = dict(dt=self._data.dt, df=abs(self._data.df),
+                  nchan=self._data.nchan, nsub=self._data.nsub)
+        if alpha is None and (mcmc or method == "acf2d"):
+            raise NotImplementedError(
+                "free alpha (alpha=None) is only supported by the acf1d "
+                "LM fit; the acf2d and mcmc paths fit with fixed alpha")
+        if method == "acf1d":
+            if mcmc:
+                from .fit.mcmc import fit_scint_params_mcmc
+
+                sp = fit_scint_params_mcmc(self.acf, alpha=alpha, **kw)
+            else:
+                sp = _fit_scint_params(self.acf, alpha=alpha, backend=b,
+                                       **kw)
+        elif method == "acf2d":
+            from .fit.scint_fit import fit_scint_params_2d
+
+            sp, tilt, tilterr = fit_scint_params_2d(self.acf, alpha=alpha,
+                                                    backend=b, **kw)
+            self.tilt, self.tilterr = tilt, tilterr
+        else:
+            raise ValueError(f"unknown method {method!r}; use 'acf1d' or "
+                             "'acf2d'")
         self.scint_params = sp
         for k in ("tau", "tauerr", "dnu", "dnuerr", "talpha"):
             setattr(self, k, float(to_numpy(getattr(sp, k))))

@@ -1,0 +1,122 @@
+"""Ensemble MCMC sampler correctness + 2-D ACF model fitting."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from scintools_tpu.fit import (  # noqa: E402
+    ensemble_sample,
+    fit_scint_params,
+    fit_scint_params_2d,
+    fit_scint_params_mcmc,
+)
+from scintools_tpu.models.acf_models import scint_acf_model_2d  # noqa: E402
+
+
+def test_ensemble_recovers_gaussian():
+    """Sampler reproduces a correlated 2-D Gaussian's mean and covariance."""
+    mean = jnp.array([1.0, -2.0])
+    cov = jnp.array([[2.0, 0.8], [0.8, 1.0]])
+    prec = jnp.linalg.inv(cov)
+
+    def log_prob(p):
+        d = p - mean
+        return -0.5 * d @ prec @ d
+
+    rng = np.random.default_rng(0)
+    p0 = rng.standard_normal((64, 2))
+    chain, lps = ensemble_sample(log_prob, p0,
+                                 key=jax.random.PRNGKey(1), steps=1500)
+    post = np.asarray(chain[500:]).reshape(-1, 2)
+    np.testing.assert_allclose(post.mean(axis=0), [1.0, -2.0], atol=0.1)
+    np.testing.assert_allclose(np.cov(post.T), np.asarray(cov), atol=0.25)
+    assert np.isfinite(np.asarray(lps)).all()
+
+
+def test_ensemble_respects_prior_support():
+    def log_prob(p):
+        return jnp.where(p[0] > 0, -0.5 * jnp.sum((p - 1.0) ** 2),
+                         -jnp.inf)
+
+    p0 = np.abs(np.random.default_rng(1).standard_normal((32, 1))) + 0.1
+    chain, _ = ensemble_sample(log_prob, p0, steps=400)
+    assert (np.asarray(chain) > 0).all()
+
+
+def _synthetic_acf(tau=120.0, dnu=4.0, amp=1.0, wn=0.15, tilt=0.0,
+                   nchan=64, nsub=96, dt=8.0, df=0.25, noise=0.01,
+                   seed=0):
+    """A [2nchan, 2nsub] ACF laid out like ops.acf output (zero lag at
+    [nchan, nsub]), built from the 2-D model + noise."""
+    x_t = dt * np.arange(-nsub, nsub)
+    x_f = df * np.arange(-nchan, nchan)
+    m = scint_acf_model_2d(x_t, x_f, tau, dnu, amp, wn, 5 / 3, tilt, xp=np)
+    rng = np.random.default_rng(seed)
+    return m + noise * rng.standard_normal(m.shape)
+
+
+def test_fit_scint_params_2d_recovers_tilt():
+    acf2d = _synthetic_acf(tilt=20.0)
+    sp, tilt, tilterr = fit_scint_params_2d(acf2d, dt=8.0, df=0.25,
+                                            nchan=64, nsub=96)
+    assert sp.tau == pytest.approx(120.0, rel=0.1)
+    assert sp.dnu == pytest.approx(4.0, rel=0.15)
+    assert tilt == pytest.approx(20.0, rel=0.2)
+    assert tilterr > 0
+
+
+def test_fit_scint_params_2d_jax_matches_numpy():
+    acf2d = _synthetic_acf(tilt=-10.0, seed=3)
+    sp_np, tilt_np, _ = fit_scint_params_2d(acf2d, dt=8.0, df=0.25,
+                                            nchan=64, nsub=96,
+                                            backend="numpy")
+    sp_j, tilt_j, _ = fit_scint_params_2d(acf2d, dt=8.0, df=0.25,
+                                          nchan=64, nsub=96, backend="jax")
+    assert sp_j.tau == pytest.approx(float(sp_np.tau), rel=0.05)
+    assert sp_j.dnu == pytest.approx(float(sp_np.dnu), rel=0.05)
+    assert tilt_j == pytest.approx(tilt_np, rel=0.1, abs=0.5)
+
+
+def test_mcmc_scint_params_agree_with_lm():
+    acf2d = _synthetic_acf(noise=0.02, seed=5)
+    lm = fit_scint_params(acf2d, dt=8.0, df=0.25, nchan=64, nsub=96)
+    post = fit_scint_params_mcmc(acf2d, dt=8.0, df=0.25, nchan=64,
+                                 nsub=96, nwalkers=32, steps=400, burn=200)
+    assert float(post.tau) == pytest.approx(float(lm.tau), rel=0.1)
+    assert float(post.dnu) == pytest.approx(float(lm.dnu), rel=0.1)
+    assert float(post.tauerr) > 0 and float(post.dnuerr) > 0
+
+
+def test_dynspec_acf2d_and_mcmc_methods():
+    from scintools_tpu import Dynspec
+    from scintools_tpu.io import from_simulation
+    from scintools_tpu.sim import Simulation
+
+    d = from_simulation(Simulation(mb2=2, ns=128, nf=128, dlam=0.25,
+                                   seed=1234), freq=1400.0, dt=8.0)
+    ds = Dynspec(data=d, process=False)
+    ds.trim_edges().refill()
+    ds.get_scint_params(method="acf2d")
+    assert hasattr(ds, "tilt") and np.isfinite(ds.tilt)
+    assert ds.tau > 0 and ds.dnu > 0
+    tau_2d = ds.tau
+    ds.get_scint_params(method="acf1d", mcmc=True)
+    assert ds.tau == pytest.approx(tau_2d, rel=0.8)  # same order
+
+
+def test_mcmc_burn_validation_and_sampler_reuse():
+    with pytest.raises(ValueError, match="burn"):
+        fit_scint_params_mcmc(_synthetic_acf(), dt=8.0, df=0.25, nchan=64,
+                              nsub=96, steps=100, burn=100)
+    # two epochs of the same shape reuse one compiled sampler
+    from scintools_tpu.fit.mcmc import _scint_sampler_cached
+
+    _scint_sampler_cached.cache_clear()
+    for seed in (5, 6):
+        fit_scint_params_mcmc(_synthetic_acf(seed=seed), dt=8.0, df=0.25,
+                              nchan=64, nsub=96, nwalkers=16, steps=50,
+                              burn=20)
+    info = _scint_sampler_cached.cache_info()
+    assert info.misses == 1 and info.hits == 1

@@ -1,0 +1,154 @@
+"""Observability utils + resumable store + CLI workflows."""
+
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from scintools_tpu.cli import main as cli_main
+from scintools_tpu.io import from_simulation, write_psrflux
+from scintools_tpu.sim import Simulation
+from scintools_tpu.utils import (
+    ResultsStore,
+    StageTimers,
+    content_key,
+    get_logger,
+    is_valid,
+    load_pickle,
+    log_event,
+    remove_duplicates,
+    save_pickle,
+    trace_annotation,
+)
+
+
+def test_stage_timers_accumulate():
+    t = StageTimers()
+    for _ in range(3):
+        with t.stage("a"):
+            pass
+    with t.stage("b"):
+        pass
+    s = t.summary()
+    assert s["a"]["calls"] == 3 and s["b"]["calls"] == 1
+    assert "a" in t.report() and "s/call" in t.report()
+
+
+def test_stage_timers_block_on_device():
+    jax = pytest.importorskip("jax")
+    t = StageTimers()
+    with t.stage("jit", block=None):
+        y = jax.jit(lambda x: x * 2)(np.arange(8.0))
+    with t.stage("sync", block=y):
+        pass
+    assert t.summary()["sync"]["calls"] == 1
+
+
+def test_trace_annotation_noop():
+    with trace_annotation("region"):
+        pass
+
+
+def test_logger_structured(caplog):
+    log = get_logger("scintools_tpu.test")
+    log.propagate = True  # let caplog's root handler see it
+    with caplog.at_level(logging.INFO, logger="scintools_tpu.test"):
+        log_event(log, "epoch", file="x.dynspec", tau=123.456789,
+                  n=3)
+    msg = caplog.records[-1].getMessage()
+    assert msg.startswith("epoch ")
+    assert "file=x.dynspec" in msg and "tau=123.457" in msg and "n=3" in msg
+
+
+def test_misc_utils(tmp_path):
+    assert is_valid(np.array([1.0, np.nan, np.inf])).tolist() == \
+        [True, False, False]
+    assert remove_duplicates(["a", "b", "a", "c", "b"]) == ["a", "b", "c"]
+    obj = {"x": np.arange(3)}
+    fn = str(tmp_path / "o.pkl")
+    save_pickle(obj, fn)
+    np.testing.assert_array_equal(load_pickle(fn)["x"], np.arange(3))
+
+
+def test_store_resume_and_export(tmp_path):
+    store = ResultsStore(str(tmp_path / "store"))
+    items = ["a", "b", "c"]
+    keyfn = lambda s: content_key(s, ("cfg", 1))  # noqa: E731
+    assert store.pending(items, keyfn) == items
+    store.put(keyfn("b"), {"name": "b", "mjd": 1, "freq": 1400, "bw": 64,
+                           "tobs": 600, "dt": 8, "df": 0.5, "tau": 10.0,
+                           "tauerr": 1.0})
+    assert store.pending(items, keyfn) == ["a", "c"]
+    assert store.get(keyfn("b"))["tau"] == 10.0
+    # different config -> different key -> not resumed
+    assert store.pending(["b"], lambda s: content_key(s, ("cfg", 2))) == ["b"]
+    csv_fn = str(tmp_path / "out.csv")
+    assert store.export_csv(csv_fn) == 1
+    text = open(csv_fn).read()
+    assert "tau,tauerr" in text and ",10.0," in text
+
+
+def test_content_key_sensitivity(tmp_path):
+    fn = str(tmp_path / "f.bin")
+    open(fn, "wb").write(b"hello")
+    k1 = content_key(fn)
+    open(fn, "wb").write(b"hellp")
+    assert content_key(fn) != k1
+    a = np.arange(10.0)
+    assert content_key(a) != content_key(a.reshape(2, 5))
+
+
+@pytest.fixture(scope="module")
+def sim_file(tmp_path_factory):
+    d = from_simulation(Simulation(mb2=2, ns=128, nf=128, dlam=0.25,
+                                   seed=1234), freq=1400.0, dt=8.0)
+    fn = str(tmp_path_factory.mktemp("data") / "sim.dynspec")
+    write_psrflux(d, fn)
+    return fn
+
+
+def test_cli_info(sim_file, capsys):
+    assert cli_main(["info", sim_file]) == 0
+    assert "OBSERVATION PROPERTIES" in capsys.readouterr().out
+
+
+def test_cli_sim_roundtrip(tmp_path, capsys):
+    out = str(tmp_path / "sim_out.dynspec")
+    rc = cli_main(["sim", "--out", out, "--ns", "64", "--nf", "64",
+                   "--seed", "7"])
+    assert rc == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["nchan"] == 64 and os.path.exists(out)
+
+
+def test_cli_process_with_resume(sim_file, tmp_path, capsys):
+    res = str(tmp_path / "results.csv")
+    store = str(tmp_path / "store")
+    rc = cli_main(["process", sim_file, "--lamsteps", "--results", res,
+                   "--store", store])
+    assert rc == 0
+    rows = open(res).read().strip().splitlines()
+    assert len(rows) == 2  # header + 1 epoch
+    assert "betaeta" in rows[0] and "tau" in rows[0]
+    # rerun: resumed (store skips the file), CSV re-exported not duplicated
+    rc = cli_main(["process", sim_file, "--lamsteps", "--results", res,
+                   "--store", store])
+    assert rc == 0
+    assert len(open(res).read().strip().splitlines()) == 2
+
+
+def test_cli_process_quarantines_bad_file(tmp_path):
+    bad = str(tmp_path / "bad.dynspec")
+    open(bad, "w").write("not a dynspec\n")
+    rc = cli_main(["process", bad])
+    assert rc == 1  # failure reported, no crash
+
+
+def test_cli_sort(sim_file, tmp_path, capsys):
+    rc = cli_main(["sort", sim_file, str(tmp_path / "missing.dynspec"),
+                   "--outdir", str(tmp_path)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out == {"good": 1, "bad": 1}
