@@ -1,0 +1,29 @@
+"""The examples/arc_modelling.py walkthrough runs end-to-end and its
+measurements are self-consistent (SURVEY.md §4 integration strategy)."""
+
+import pathlib
+import runpy
+import sys
+
+import pytest
+
+_SCRIPT = (pathlib.Path(__file__).resolve().parents[1] / "examples"
+           / "arc_modelling.py")
+
+
+@pytest.mark.slow
+def test_arc_modelling_walkthrough(tmp_path):
+    mod = runpy.run_path(str(_SCRIPT))
+    results = mod["main"](str(tmp_path))
+    # single and summed epoch curvatures agree (same screen statistics)
+    single, summed = (results["betaeta_single"],
+                      results["betaeta_summed"])
+    assert abs(summed - single) / single < 0.3
+    assert results["tau"] > 0 and results["dnu"] > 0
+    lo, hi = results["eta_annual_minmax"]
+    assert 0 < lo < hi
+    assert (tmp_path / "sspec_arc.png").stat().st_size > 0
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))
