@@ -13,7 +13,7 @@ Prints ONE JSON line:
 Environment knobs: SCINT_BENCH_B (batch, default 1024), SCINT_BENCH_NF /
 SCINT_BENCH_NT (epoch shape, default 256x512), SCINT_BENCH_CPU_EPOCHS
 (epochs timed for the CPU baseline, default 4), SCINT_BENCH_CHUNK
-(device chunk, default 128).
+(device chunk, default 1024).
 """
 
 import json
@@ -89,15 +89,28 @@ def device_throughput(dyn, freqs, times, chunk: int) -> float:
 
     from scintools_tpu.parallel import PipelineConfig, make_pipeline
 
+    import jax.numpy as jnp
+
     cfg = PipelineConfig(arc_numsteps=2000, lm_steps=30)
     step = make_pipeline(freqs, times, cfg)
     B = dyn.shape[0]
     chunk = min(chunk, B)
+
+    def sync(results) -> float:
+        # ONE fused device->host scalar pull over all chunks: forces TRUE
+        # completion of every dispatched step without paying the tunnel
+        # round trip per chunk.  (jax.block_until_ready can return before
+        # remote execution finishes on tunnelled runtimes, which would
+        # fake arbitrarily high throughput.)
+        total = jnp.sum(jnp.stack([jnp.sum(r.arc.eta) + jnp.sum(r.scint.tau)
+                                   for r in results]))
+        return float(np.asarray(total))
+
     # stage the whole batch in HBM once (the dataloader-prefetch analogue);
     # the CPU baseline likewise reads host-resident arrays
     dyn_d = jax.device_put(dyn)
     # warmup/compile on the first chunk
-    jax.block_until_ready(step(dyn_d[:chunk]))
+    sync([step(dyn_d[:chunk])])
     t0 = time.perf_counter()
     outs = []
     for i in range(0, B, chunk):
@@ -105,7 +118,7 @@ def device_throughput(dyn, freqs, times, chunk: int) -> float:
         if part.shape[0] != chunk:  # keep one compiled shape
             part = dyn_d[B - chunk:B]
         outs.append(step(part))  # async dispatch; fits stay on device
-    jax.block_until_ready(outs)
+    sync(outs)
     dtime = time.perf_counter() - t0
     return B / dtime
 
@@ -115,7 +128,7 @@ def main():
     nf = _env_int("SCINT_BENCH_NF", 256)
     nt = _env_int("SCINT_BENCH_NT", 512)
     n_cpu = _env_int("SCINT_BENCH_CPU_EPOCHS", 4)
-    chunk = _env_int("SCINT_BENCH_CHUNK", 128)
+    chunk = _env_int("SCINT_BENCH_CHUNK", 1024)
 
     dyn, freqs, times = make_epochs(nf, nt, B=B)
 

@@ -1,0 +1,179 @@
+"""The five BASELINE.json benchmark configs, measured CPU-ref vs device.
+
+SURVEY.md §6: the reference publishes no numbers, so the CPU baseline is
+measured here from the reference-equivalent numpy path, then compared to
+the jit'd device path.  Prints one JSON line per config:
+
+    {"config": N, "metric": ..., "cpu": ..., "device": ..., "speedup": ...}
+
+Configs (BASELINE.md):
+    1 sspec of one 256x512 simulated dynspec            [sspec/s]
+    2 acf + tau/dnu LM fit                              [fits/s]
+    3 arc-curvature fit on one secondary spectrum       [fits/s]
+    4 batched 1024-epoch pipeline (see bench.py)        [dynspec/s]
+    5 Monte-Carlo screen ensemble                       [screens/s]
+
+Device timings force true remote completion via host scalar pulls
+(block_until_ready is not trustworthy over tunnelled runtimes).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import cpu_reference_per_epoch, device_throughput, make_epochs  # noqa: E402
+
+
+def _sync(x) -> float:
+    import jax.numpy as jnp
+
+    return float(np.asarray(jnp.sum(x)))
+
+
+def _time_cpu(fn, n=3):
+    fn()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return (time.perf_counter() - t0) / n
+
+
+def _time_dev(fn, n=10):
+    _sync(fn())
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn()
+    s = _sync(out)  # chain completion: steps on one stream run in order
+    del s
+    return (time.perf_counter() - t0) / n
+
+
+def config1_sspec(dyn1, B_dev: int = 256):
+    from scintools_tpu.ops import sspec
+
+    d64 = np.float64(dyn1)
+    cpu = _time_cpu(lambda: sspec(d64, backend="numpy"))
+    batch = np.broadcast_to(np.float32(dyn1), (B_dev,) + dyn1.shape).copy()
+    import jax
+
+    batch_d = jax.device_put(batch)
+    dev = _time_dev(lambda: sspec(batch_d, backend="jax")) / B_dev
+    return {"config": 1, "metric": "sspec/s (256x512)",
+            "cpu": 1 / cpu, "device": 1 / dev}
+
+
+def config2_acf_fit(dyn1, B_dev: int = 256):
+    from scintools_tpu.fit.scint_fit import fit_scint_params, \
+        fit_scint_params_from_dyn
+    from scintools_tpu.ops import acf
+
+    d64 = np.float64(dyn1)
+    nf, nt = dyn1.shape
+
+    def cpu_once():
+        a = acf(d64, backend="numpy")
+        fit_scint_params(a, 8.0, 0.5, nf, nt, backend="numpy")
+
+    cpu = _time_cpu(cpu_once)
+    import jax
+
+    batch_d = jax.device_put(
+        np.broadcast_to(np.float32(dyn1), (B_dev,) + dyn1.shape).copy())
+
+    def dev_once():
+        return fit_scint_params_from_dyn(batch_d, 8.0, 0.5).tau
+
+    dev = _time_dev(dev_once) / B_dev
+    return {"config": 2, "metric": "acf+scint-fits/s",
+            "cpu": 1 / cpu, "device": 1 / dev}
+
+
+def config3_arc_fit(dyn1, freqs, times, B_dev: int = 256):
+    from scintools_tpu.data import SecSpec
+    from scintools_tpu.fit import fit_arc, make_arc_fitter
+    from scintools_tpu.ops import scale_lambda, sspec, sspec_axes
+    from scintools_tpu.data import DynspecData
+
+    dt = float(times[1] - times[0])
+    df = float(freqs[1] - freqs[0])
+    epoch = DynspecData(dyn=np.float64(dyn1), freqs=freqs, times=times)
+    lamdyn, lam, dlam = scale_lambda(epoch, backend="numpy")
+    sec_np = sspec(lamdyn, backend="numpy")
+    fdop, tdel, beta = sspec_axes(lamdyn.shape[0], lamdyn.shape[1], dt, df,
+                                  dlam=dlam)
+    secsp = SecSpec(sspec=sec_np, fdop=fdop, tdel=tdel, beta=beta,
+                    lamsteps=True)
+    fc = float(np.mean(freqs))
+    cpu = _time_cpu(lambda: fit_arc(secsp, freq=fc, numsteps=2000,
+                                    backend="numpy"))
+
+    import jax
+
+    fitter = make_arc_fitter(fdop=fdop, yaxis=beta, tdel=tdel, freq=fc,
+                             lamsteps=True, numsteps=2000)
+    sec_b = jax.device_put(np.broadcast_to(
+        np.float32(sec_np), (B_dev,) + sec_np.shape).copy())
+    dev = _time_dev(lambda: fitter(sec_b).eta) / B_dev
+    return {"config": 3, "metric": "arc-fits/s",
+            "cpu": 1 / cpu, "device": 1 / dev}
+
+
+def config4_pipeline():
+    B = int(os.environ.get("SCINT_BENCH_B", 1024))
+    dyn, freqs, times = make_epochs(256, 512, B=B)
+    cpu_s = cpu_reference_per_epoch(dyn, freqs, times, 2)
+    rate = device_throughput(dyn, freqs, times,
+                             int(os.environ.get("SCINT_BENCH_CHUNK", 1024)))
+    return {"config": 4,
+            "metric": f"batched pipeline dynspec/s ({B} epochs)",
+            "cpu": 1 / cpu_s, "device": rate}
+
+
+def config5_ensemble(n_screens: int = 256, ns: int = 256, nf: int = 64):
+    from scintools_tpu.sim import SimParams, Simulation, simulate_ensemble
+
+    p = SimParams(mb2=2.0, rf=1.0, dx=0.01, dy=0.01, alpha=5 / 3, ar=1.0,
+                  psi=0.0, inner=0.001, nx=ns, ny=ns, nf=nf, dlam=0.25,
+                  lamsteps=False)
+
+    def cpu_once():
+        Simulation(mb2=2, ns=ns, nf=nf, dlam=0.25, seed=1, backend="numpy")
+
+    cpu = _time_cpu(cpu_once, n=2)
+
+    import jax
+
+    keys = jax.random.split(jax.random.PRNGKey(0), n_screens)
+
+    def dev_once():
+        return simulate_ensemble(keys, p, screen_chunk=32)
+
+    dev = _time_dev(dev_once, n=3) / n_screens
+    return {"config": 5, "metric": f"screens/s ({ns}x{ns}, nf={nf})",
+            "cpu": 1 / cpu, "device": 1 / dev}
+
+
+def main():
+    dyn, freqs, times = make_epochs(256, 512, B=4, n_base=2)
+    dyn1 = dyn[0]
+    rows = [
+        config1_sspec(dyn1),
+        config2_acf_fit(dyn1),
+        config3_arc_fit(dyn1, freqs, times),
+        config4_pipeline(),
+        config5_ensemble(),
+    ]
+    for r in rows:
+        r["speedup"] = round(r["device"] / r["cpu"], 2)
+        r["cpu"] = round(r["cpu"], 3)
+        r["device"] = round(r["device"], 3)
+        print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

@@ -40,8 +40,6 @@ from scintools_tpu.sim import Simulation  # noqa: E402
 
 
 def main(outdir: str = "/tmp/arc_modelling") -> dict:
-    import os
-
     os.makedirs(outdir, exist_ok=True)
     results = {}
 
@@ -57,7 +55,7 @@ def main(outdir: str = "/tmp/arc_modelling") -> dict:
     ds.plot_dyn(filename=f"{outdir}/dynspec.png")
 
     # -- 4. arc curvature ------------------------------------------------
-    fit = ds.fit_arc(lamsteps=True, numsteps=4000)
+    ds.fit_arc(lamsteps=True, numsteps=4000)
     results["betaeta_single"] = ds.betaeta
     print(f"single epoch:  betaeta = {ds.betaeta:.3f} "
           f"+/- {ds.betaetaerr:.3f}")

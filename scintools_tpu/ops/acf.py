@@ -44,6 +44,16 @@ def _acf_numpy(arr: np.ndarray, subtract_mean: bool) -> np.ndarray:
     return np.real(a)
 
 
+def _masked_mean_subtract(arr, jnp):
+    """jit-friendly masked mean subtraction (no boolean indexing): invalid
+    pixels are excluded via where=; matches numpy on gap-free input."""
+    valid = jnp.isfinite(arr)
+    denom = jnp.maximum(jnp.sum(valid, axis=(-2, -1), keepdims=True), 1)
+    mean = (jnp.sum(jnp.where(valid, arr, 0.0), axis=(-2, -1),
+                    keepdims=True) / denom)
+    return arr - mean
+
+
 @functools.lru_cache(maxsize=1)
 def _acf_jax():
     import jax
@@ -52,13 +62,7 @@ def _acf_jax():
     @functools.partial(jax.jit, static_argnums=(1,))
     def impl(arr, subtract_mean):
         if subtract_mean:
-            # jit-friendly masked mean (no boolean indexing): invalid pixels
-            # are excluded via where=; matches numpy on gap-free input.
-            valid = jnp.isfinite(arr)
-            denom = jnp.sum(valid, axis=(-2, -1), keepdims=True)
-            mean = (jnp.sum(jnp.where(valid, arr, 0.0), axis=(-2, -1),
-                            keepdims=True) / denom)
-            arr = arr - mean
+            arr = _masked_mean_subtract(arr, jnp)
         nf, nt = arr.shape[-2], arr.shape[-1]
         # real input -> half-spectrum rfft2 (2x the work/memory of the
         # reference's complex fft2 pair, dynspec.py:1351-1356, saved); the
@@ -70,3 +74,49 @@ def _acf_jax():
         return jnp.fft.fftshift(out, axes=(-2, -1))
 
     return impl
+
+
+@functools.lru_cache(maxsize=1)
+def _acf_cuts_jax():
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def impl(arr, subtract_mean):
+        if subtract_mean:
+            arr = _masked_mean_subtract(arr, jnp)
+        nf, nt = arr.shape[-2], arr.shape[-1]
+        # freq cut: sum over t of each column's padded 1-D autocovariance
+        F = jnp.fft.rfft(arr, n=2 * nf, axis=-2)
+        Sf = jnp.sum(jnp.real(F) ** 2 + jnp.imag(F) ** 2, axis=-1)
+        cut_f = jnp.fft.irfft(Sf, n=2 * nf, axis=-1)[..., :nf]
+        # time cut: sum over f of each row's padded 1-D autocovariance
+        T = jnp.fft.rfft(arr, n=2 * nt, axis=-1)
+        St = jnp.sum(jnp.real(T) ** 2 + jnp.imag(T) ** 2, axis=-2)
+        cut_t = jnp.fft.irfft(St, n=2 * nt, axis=-1)[..., :nt]
+        return cut_t, cut_f
+
+    return impl
+
+
+def acf_cuts_direct(dyn, backend: str = "jax", subtract_mean: bool = True):
+    """The central positive-lag 1-D cuts of the 2-D ACF, computed WITHOUT
+    the 2-D transform.
+
+    The scint-parameter fit consumes only ``acf[nchan:, nsub]`` and
+    ``acf[nchan, nsub:]`` (dynspec.py:949-952).  Those cuts are exactly
+
+        C(df, 0) = sum_t acf1d_freq(column t),
+        C(0, dt) = sum_f acf1d_time(row f),
+
+    so batched padded 1-D FFTs + a reduction give bit-identical values at
+    a fraction of the 2-D pair's FLOPs and without materialising the
+    [B, 2nf, 2nt] array (the dominant cost of the batched fit path).
+    Returns (cut_t [..., nt], cut_f [..., nf]).
+    """
+    backend = resolve(backend)
+    if backend == "numpy":
+        a = _acf_numpy(np.asarray(dyn), subtract_mean)
+        nf, nt = np.asarray(dyn).shape[-2:]
+        return a[..., nf, nt:], a[..., nf:, nt]
+    return _acf_cuts_jax()(dyn, subtract_mean)

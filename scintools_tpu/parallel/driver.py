@@ -185,12 +185,22 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
 
                 dyn_acf = jax.lax.with_sharding_constraint(
                     dyn_batch, NamedSharding(mesh, P(mesh_mod.DATA_AXIS)))
-            acf_b = acf_op(dyn_acf, backend="jax")
-            if config.fit_scint:
-                scint = fit_scint_params_batch(
-                    acf_b, dt, df, nchan, nsub, alpha=config.alpha,
+            if config.return_acf:
+                acf_b = acf_op(dyn_acf, backend="jax")
+                if config.fit_scint:
+                    scint = fit_scint_params_batch(
+                        acf_b, dt, df, nchan, nsub, alpha=config.alpha,
+                        steps=config.lm_steps)
+                out["acf"] = acf_b
+            elif config.fit_scint:
+                # fast path: 1-D cuts via padded 1-D FFT reductions — same
+                # values as the 2-D ACF route without materialising
+                # [B, 2nf, 2nt] (ops.acf.acf_cuts_direct)
+                from ..fit.scint_fit import fit_scint_params_from_dyn
+
+                scint = fit_scint_params_from_dyn(
+                    dyn_acf, dt, df, alpha=config.alpha,
                     steps=config.lm_steps)
-            out["acf"] = acf_b if config.return_acf else None
         arc = None
         sec_b = None
         if config.fit_arc or config.return_sspec:

@@ -142,3 +142,40 @@ def test_postdark_singular_lines():
     assert np.all(pd[:, 64] == 1)
     assert np.all(pd[0, :] == 1)
     assert pd.shape == (32, 128)
+
+
+def test_acf_cuts_direct_matches_2d_path():
+    """The 1-D-FFT cuts shortcut equals the cuts of the full 2-D ACF."""
+    from scintools_tpu.ops.acf import acf as acf_fn, acf_cuts_direct
+
+    rng = np.random.default_rng(7)
+    dyn = rng.standard_normal((3, 32, 48))
+    a2 = np.asarray(acf_fn(dyn, backend="jax"))
+    ct, cf = acf_cuts_direct(dyn, backend="jax")
+    np.testing.assert_allclose(np.asarray(ct), a2[:, 32, 48:], rtol=1e-8,
+                               atol=1e-8)
+    np.testing.assert_allclose(np.asarray(cf), a2[:, 32:, 48], rtol=1e-8,
+                               atol=1e-8)
+    # numpy backend agrees too
+    ct_np, cf_np = acf_cuts_direct(dyn, backend="numpy")
+    np.testing.assert_allclose(ct_np, np.asarray(ct), rtol=1e-8, atol=1e-8)
+    np.testing.assert_allclose(cf_np, np.asarray(cf), rtol=1e-8, atol=1e-8)
+
+
+def test_fit_from_dyn_matches_fit_from_acf():
+    from scintools_tpu.fit.scint_fit import (fit_scint_params_batch,
+                                             fit_scint_params_from_dyn)
+    from scintools_tpu.ops.acf import acf as acf_fn
+
+    rng = np.random.default_rng(8)
+    nf, nt = 48, 64
+    f = np.exp(-((np.arange(nf)[:, None] - nf / 2) / 6.0) ** 2)
+    t = np.exp(-((np.arange(nt)[None, :] - nt / 2) / 10.0) ** 2)
+    dyn = (f * t)[None] + 0.05 * rng.standard_normal((2, nf, nt))
+    acf_b = acf_fn(dyn, backend="jax")
+    sp_acf = fit_scint_params_batch(acf_b, 8.0, 0.5, nf, nt)
+    sp_dyn = fit_scint_params_from_dyn(dyn, 8.0, 0.5)
+    np.testing.assert_allclose(np.asarray(sp_dyn.tau),
+                               np.asarray(sp_acf.tau), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(sp_dyn.dnu),
+                               np.asarray(sp_acf.dnu), rtol=1e-5)
