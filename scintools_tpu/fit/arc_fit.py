@@ -144,6 +144,43 @@ def _walk(filt: np.ndarray, ind: int, threshold: float) -> tuple[int, int]:
     return ind1, ind2
 
 
+def _measure_peak(eta_array, power, filt, noise, constraint,
+                  low_power_diff, high_power_diff, noise_error, lamsteps,
+                  log_fit: bool) -> ArcFit:
+    """Constrained peak search + power-drop walks + (log-)parabola fit on
+    a precomputed power-vs-curvature profile (dynspec.py:693-744).
+
+    Shared by fit_arc's norm_sspec and gridmax branches and by the
+    multi-arc driver, which measures several windows of ONE profile.
+    """
+    inrange = np.argwhere((eta_array > constraint[0])
+                          * (eta_array < constraint[1]))
+    if inrange.size == 0:
+        raise ValueError(f"no eta grid points inside constraint "
+                         f"{tuple(constraint)}")
+    peak_ind = int(np.argmin(np.abs(filt - np.max(filt[inrange]))))
+    max_power = filt[peak_ind]
+
+    # -3 dB on the low-curvature side, -1.5 dB on the high side
+    i1, _ = _walk(filt, peak_ind, max_power + low_power_diff)
+    _, i2 = _walk(filt, peak_ind, max_power + high_power_diff)
+    xdata = eta_array[peak_ind - i1: peak_ind + i2]
+    ydata = power[peak_ind - i1: peak_ind + i2]
+    fitter = fit_log_parabola if log_fit else fit_parabola
+    yfit, eta, etaerr_fit = fitter(xdata, ydata, xp=np)
+    if np.mean(np.gradient(np.diff(yfit))) > 0:
+        raise ValueError("Fit returned a forward parabola.")
+
+    etaerr = etaerr_fit
+    if noise_error:
+        j1, j2 = _walk(filt, peak_ind, max_power - noise)
+        etaerr = np.ptp(eta_array[peak_ind - j1: peak_ind + j2]) / 2
+
+    return ArcFit(eta=eta, etaerr=etaerr, etaerr2=etaerr_fit,
+                  lamsteps=lamsteps, profile_eta=eta_array,
+                  profile_power=power, profile_power_filt=filt)
+
+
 def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
             delmax=None, numsteps: int = 10000, startbin: int = 3,
             cutmid: int = 3, etamax=None, etamin=None,
@@ -235,29 +272,9 @@ def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
         avg = avg[keep].squeeze()
 
         filt = savgol_filter(avg, nsmooth, 1)
-        inrange = np.argwhere((eta_array > constraint[0])
-                              * (eta_array < constraint[1]))
-        peak_ind = int(np.argmin(np.abs(filt - np.max(filt[inrange]))))
-        max_power = filt[peak_ind]
-
-        # -3 dB on the low-curvature side, -1.5 dB on the high side
-        i1, _ = _walk(filt, peak_ind, max_power + low_power_diff)
-        _, i2 = _walk(filt, peak_ind, max_power + high_power_diff)
-        xdata = eta_array[peak_ind - i1: peak_ind + i2]
-        ydata = avg[peak_ind - i1: peak_ind + i2]
-        yfit, eta, etaerr_fit = fit_parabola(xdata, ydata, xp=np)
-        if np.mean(np.gradient(np.diff(yfit))) > 0:
-            raise ValueError("Fit returned a forward parabola.")
-
-        etaerr2 = etaerr_fit
-        etaerr = etaerr_fit
-        if noise_error:
-            j1, j2 = _walk(filt, peak_ind, max_power - noise)
-            etaerr = np.ptp(eta_array[peak_ind - j1: peak_ind + j2]) / 2
-
-        return ArcFit(eta=eta, etaerr=etaerr, etaerr2=etaerr2,
-                      lamsteps=lamsteps, profile_eta=eta_array,
-                      profile_power=avg, profile_power_filt=filt)
+        return _measure_peak(eta_array, avg, filt, noise, constraint,
+                             low_power_diff, high_power_diff, noise_error,
+                             lamsteps, log_fit=False)
 
     if method == "gridmax":
         x, y, z = fdop, yaxis_cut, sspec
@@ -278,25 +295,9 @@ def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
         ok = np.isfinite(sumpow)
         eta_array, sumpow = eta_array[ok], sumpow[ok]
         filt = savgol_filter(sumpow, nsmooth, 1)
-        inrange = np.argwhere((eta_array > constraint[0])
-                              * (eta_array < constraint[1]))
-        peak_ind = int(np.argmin(np.abs(filt - np.max(filt[inrange]))))
-        max_power = filt[peak_ind]
-        i1, _ = _walk(filt, peak_ind, max_power + low_power_diff)
-        _, i2 = _walk(filt, peak_ind, max_power + high_power_diff)
-        xdata = eta_array[peak_ind - i1: peak_ind + i2]
-        ydata = sumpow[peak_ind - i1: peak_ind + i2]
-        yfit, eta, etaerr_fit = fit_log_parabola(xdata, ydata, xp=np)
-        if np.mean(np.gradient(np.diff(yfit))) > 0:
-            raise ValueError("Fit returned a forward parabola.")
-        etaerr2 = etaerr_fit
-        etaerr = etaerr_fit
-        if noise_error:
-            j1, j2 = _walk(filt, peak_ind, max_power - noise)
-            etaerr = np.ptp(eta_array[peak_ind - j1: peak_ind + j2]) / 2
-        return ArcFit(eta=eta, etaerr=etaerr, etaerr2=etaerr2,
-                      lamsteps=lamsteps, profile_eta=eta_array,
-                      profile_power=sumpow, profile_power_filt=filt)
+        return _measure_peak(eta_array, sumpow, filt, noise, constraint,
+                             low_power_diff, high_power_diff, noise_error,
+                             lamsteps, log_fit=True)
 
     raise ValueError("unknown arc fitting method; choose from "
                      "'gridmax' or 'norm_sspec'")
@@ -487,3 +488,54 @@ def make_arc_fitter(fdop, yaxis, tdel, freq, lamsteps=True,
         float(high_power_diff), float(ref_freq),
         (float(constraint[0]), float(constraint[1])), int(nsmooth),
         bool(noise_error))
+
+
+def fit_arcs_multi(sec: SecSpec, freq: float, brackets,
+                   method: str = "norm_sspec", backend: str = "numpy",
+                   low_power_diff: float = -3.0,
+                   high_power_diff: float = -1.5,
+                   noise_error: bool = True, **kw) -> list[ArcFit]:
+    """Measure several arcs in one secondary spectrum (the reference's
+    multi-arc mode: etamin/etamax arrays segment the sqrt-eta grid,
+    dynspec.py:470-491).
+
+    ``brackets`` is a sequence of (eta_lo, eta_hi) curvature windows (same
+    units as the fit: beta-eta for lamsteps spectra; ``None`` bounds mean
+    open-ended).  The global power-vs-curvature profile is computed ONCE,
+    then each arc is measured with the peak search constrained to its
+    window, as in the reference where one eta grid serves all arcs.
+    Returns one ArcFit per bracket.
+    """
+    brackets = [(0.0 if lo is None else float(lo),
+                 np.inf if hi is None else float(hi))
+                for lo, hi in brackets]
+    # one full-profile fit (first bracket as the constraint just to get a
+    # valid measurement); its profile/filter arrays are then re-measured
+    # per window without recomputing the expensive normalisation
+    first = fit_arc(sec, freq, method=method, backend=backend,
+                    constraint=brackets[0],
+                    low_power_diff=low_power_diff,
+                    high_power_diff=high_power_diff,
+                    noise_error=noise_error, **kw)
+    fits = [first]
+    eta_array = np.asarray(first.profile_eta)
+    power = np.asarray(first.profile_power)
+    filt = np.asarray(first.profile_power_filt)
+    # noise level reconstruction for the walk-based error (same estimate
+    # fit_arc used internally)
+    cutmid = kw.get("cutmid", 3)
+    startbin = kw.get("startbin", 3)
+    sspec_arr = np.array(sec.sspec, dtype=np.float64)
+    tdel_axis = np.asarray(sec.tdel)
+    delmax = kw.get("delmax")
+    dmax = np.max(tdel_axis) if delmax is None else delmax
+    dmax = dmax * (kw.get("ref_freq", 1400.0) / freq) ** 2
+    ind = int(np.argmin(np.abs(tdel_axis - dmax)))
+    noise = float(_noise_estimate(sspec_arr, cutmid)) / max(ind - startbin,
+                                                            1)
+    for lo, hi in brackets[1:]:
+        fits.append(_measure_peak(
+            eta_array, power, filt, noise, (lo, hi), low_power_diff,
+            high_power_diff, noise_error, sec.lamsteps,
+            log_fit=(method == "gridmax")))
+    return fits

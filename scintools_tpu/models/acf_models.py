@@ -43,6 +43,15 @@ def scint_acf_model(x_t, x_f, tau, dnu, amp, wn, alpha=5 / 3, xp=np):
     return xp.concatenate([mt, mf])
 
 
+def mirror_spectrum(y, xp=np):
+    """Mirror a positive-lag function to a symmetric one and return the
+    real FFT's positive half — the ACF->power-spectrum transform used by
+    every *_sspec_model AND by the spectral-domain fitter's data side
+    (they must share this construction to live on the same grid)."""
+    sym = xp.concatenate([y, y[::-1]])[: 2 * y.shape[0] - 1]
+    return xp.real(xp.fft.fft(sym))[: y.shape[0]]
+
+
 def tau_sspec_model(x, tau, amp, wn, alpha=5 / 3, xp=np):
     """Fourier-domain (power spectrum) counterpart of tau_acf_model.
 
@@ -51,23 +60,15 @@ def tau_sspec_model(x, tau, amp, wn, alpha=5 / 3, xp=np):
     semantics it intended: mirror the ACF model to a symmetric function and
     take the real FFT, keeping the positive-lag half.
     """
-    model = amp * xp.exp(-(x / tau) ** alpha)
-    model = model + wn * (xp.arange(x.shape[0]) == 0)
-    model = model * (1 - x / xp.max(x))
-    sym = xp.concatenate([model, model[::-1]])[: 2 * x.shape[0] - 1]
-    spec = xp.real(xp.fft.fft(sym))
-    return spec[: x.shape[0]]
+    model = tau_acf_model(x, tau, amp, wn, alpha, xp=xp)
+    return mirror_spectrum(model, xp=xp)
 
 
 def dnu_sspec_model(x, dnu, amp, wn, xp=np):
     """Fourier-domain counterpart of dnu_acf_model (reference stub at
     scint_models.py:149-171, completed here)."""
-    model = amp * xp.exp(-x / (dnu / np.log(2)))
-    model = model + wn * (xp.arange(x.shape[0]) == 0)
-    model = model * (1 - x / xp.max(x))
-    sym = xp.concatenate([model, model[::-1]])[: 2 * x.shape[0] - 1]
-    spec = xp.real(xp.fft.fft(sym))
-    return spec[: x.shape[0]]
+    model = dnu_acf_model(x, dnu, amp, wn, xp=xp)
+    return mirror_spectrum(model, xp=xp)
 
 
 def scint_sspec_model(x_t, x_f, tau, dnu, amp, wn, alpha=5 / 3, xp=np):

@@ -247,6 +247,46 @@ class Dynspec:
         ``betaeta/betaetaerr`` (lamsteps) or ``eta/etaerr``."""
         lamsteps = self.lamsteps if lamsteps is None else lamsteps
         sec = self._secspec(lamsteps)
+        if np.ndim(etamin) == 1 or np.ndim(etamax) == 1:
+            # multi-arc mode (reference: etamin/etamax arrays segment the
+            # eta grid, dynspec.py:470-491): one fit per curvature window.
+            # Scalars/None broadcast against the other bound; mismatched
+            # array lengths are an error (zip would truncate silently).
+            from .fit.arc_fit import fit_arcs_multi
+
+            n_arcs = max(np.size(etamin) if etamin is not None else 1,
+                         np.size(etamax) if etamax is not None else 1)
+
+            def as_bounds(x, default):
+                if x is None:
+                    return [default] * n_arcs
+                arr = list(np.atleast_1d(x))
+                if len(arr) == 1:
+                    arr = arr * n_arcs
+                if len(arr) != n_arcs:
+                    raise ValueError(
+                        f"etamin/etamax lengths differ: {np.size(etamin)} "
+                        f"vs {np.size(etamax)}")
+                return arr
+
+            brackets = list(zip(as_bounds(etamin, 0.0),
+                                as_bounds(etamax, np.inf)))
+            fits = fit_arcs_multi(
+                sec, freq=float(self._data.freq), brackets=brackets,
+                method=method, delmax=delmax, numsteps=numsteps,
+                startbin=startbin, cutmid=cutmid,
+                low_power_diff=low_power_diff,
+                high_power_diff=high_power_diff, ref_freq=ref_freq,
+                nsmooth=nsmooth, noise_error=noise_error,
+                backend=resolve(backend or self.backend))
+            self.arc_fit = fits
+            etas = np.array([float(to_numpy(f.eta)) for f in fits])
+            errs = np.array([float(to_numpy(f.etaerr)) for f in fits])
+            if lamsteps:
+                self.betaeta, self.betaetaerr = etas, errs
+            else:
+                self.eta, self.etaerr = etas, errs
+            return fits
         fit = _fit_arc(sec, freq=float(self._data.freq), method=method,
                        delmax=delmax, numsteps=numsteps, startbin=startbin,
                        cutmid=cutmid, etamax=etamax, etamin=etamin,
@@ -275,6 +315,10 @@ class Dynspec:
             if eta is None:
                 self.fit_arc(lamsteps=lamsteps)
                 eta = self.betaeta if lamsteps else self.eta
+            # after a multi-arc fit the attribute is an array: normalise
+            # by the primary (first-bracket) arc
+            if np.ndim(eta) == 1:
+                eta = float(eta[0])
         sec = self._secspec(lamsteps)
         ns = _norm_sspec(sec, freq=float(self._data.freq), eta=eta,
                          delmax=delmax, startbin=startbin,
@@ -298,10 +342,10 @@ class Dynspec:
         b = resolve(backend or self.backend)
         kw = dict(dt=self._data.dt, df=abs(self._data.df),
                   nchan=self._data.nchan, nsub=self._data.nsub)
-        if alpha is None and (mcmc or method == "acf2d"):
+        if alpha is None and (mcmc or method in ("acf2d", "sspec")):
             raise NotImplementedError(
                 "free alpha (alpha=None) is only supported by the acf1d "
-                "LM fit; the acf2d and mcmc paths fit with fixed alpha")
+                "LM fit; the acf2d/sspec/mcmc paths fit with fixed alpha")
         if method == "acf1d":
             if mcmc:
                 from .fit.mcmc import fit_scint_params_mcmc
@@ -316,9 +360,14 @@ class Dynspec:
             sp, tilt, tilterr = fit_scint_params_2d(self.acf, alpha=alpha,
                                                     backend=b, **kw)
             self.tilt, self.tilterr = tilt, tilterr
+        elif method == "sspec":
+            from .fit.scint_fit import fit_scint_params_sspec
+
+            sp = fit_scint_params_sspec(self.acf, alpha=alpha, backend=b,
+                                        **kw)
         else:
-            raise ValueError(f"unknown method {method!r}; use 'acf1d' or "
-                             "'acf2d'")
+            raise ValueError(f"unknown method {method!r}; use 'acf1d', "
+                             "'acf2d' or 'sspec'")
         self.scint_params = sp
         for k in ("tau", "tauerr", "dnu", "dnuerr", "talpha"):
             setattr(self, k, float(to_numpy(getattr(sp, k))))
@@ -381,6 +430,8 @@ class Dynspec:
         sec = self._secspec(lamsteps)
         eta = (self.betaeta if lamsteps else self.eta) \
             if kw.pop("plotarc", False) else None
+        if eta is not None and np.ndim(eta) == 1:
+            eta = float(eta[0])  # multi-arc: overlay the primary arc
         return plotting.plot_sspec(sec, eta=eta, **kw)
 
     def plot_all(self, **kw):
