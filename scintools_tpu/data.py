@@ -170,6 +170,7 @@ class ArcFit:
     profile_eta: Any = None      # eta grid of the power profile
     profile_power: Any = None    # mean power along arcs (dB)
     profile_power_filt: Any = None
+    noise: Any = None            # noise level used by the error walk
 
 
 def _register_result_pytrees():
@@ -182,7 +183,8 @@ def _register_result_pytrees():
              ("tau", "tauerr", "dnu", "dnuerr", "talpha", "talphaerr", "amp",
               "wn", "redchi"), ()),
             (ArcFit, ("eta", "etaerr", "etaerr2", "profile_eta",
-                      "profile_power", "profile_power_filt"), ("lamsteps",)),
+                      "profile_power", "profile_power_filt", "noise"),
+             ("lamsteps",)),
         ):
             def fl(obj, _lf=leaf_fields, _af=aux_fields):
                 return (tuple(getattr(obj, f) for f in _lf),

@@ -178,7 +178,8 @@ def _measure_peak(eta_array, power, filt, noise, constraint,
 
     return ArcFit(eta=eta, etaerr=etaerr, etaerr2=etaerr_fit,
                   lamsteps=lamsteps, profile_eta=eta_array,
-                  profile_power=power, profile_power_filt=filt)
+                  profile_power=power, profile_power_filt=filt,
+                  noise=noise)
 
 
 def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
@@ -208,7 +209,8 @@ def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
                       etaerr2=batch.etaerr2[0], lamsteps=batch.lamsteps,
                       profile_eta=batch.profile_eta,
                       profile_power=batch.profile_power[0],
-                      profile_power_filt=batch.profile_power_filt[0])
+                      profile_power_filt=batch.profile_power_filt[0],
+                      noise=batch.noise[0])
     # gridmax has no jax path yet: fall through to the numpy implementation
 
     sspec = np.array(sec.sspec, dtype=np.float64)
@@ -445,14 +447,16 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
             hi_eta = jnp.max(jnp.where(wn_, ea, -jnp.inf))
             etaerr = (hi_eta - lo_eta) / 2
 
-        return eta, etaerr, etaerr_fit, avg_f, filt
+        return eta, etaerr, etaerr_fit, avg_f, filt, noise
 
     @jax.jit
     def impl(sspec_batch):
-        eta, etaerr, etaerr2, avg, filt = jax.vmap(one_epoch)(sspec_batch)
+        eta, etaerr, etaerr2, avg, filt, noise = \
+            jax.vmap(one_epoch)(sspec_batch)
         return ArcFit(eta=eta, etaerr=etaerr, etaerr2=etaerr2,
                       lamsteps=lamsteps, profile_eta=jnp.asarray(eta_array),
-                      profile_power=avg, profile_power_filt=filt)
+                      profile_power=avg, profile_power_filt=filt,
+                      noise=noise)
 
     return impl
 
@@ -510,7 +514,7 @@ def fit_arcs_multi(sec: SecSpec, freq: float, brackets,
                  np.inf if hi is None else float(hi))
                 for lo, hi in brackets]
     # one full-profile fit (first bracket as the constraint just to get a
-    # valid measurement); its profile/filter arrays are then re-measured
+    # valid measurement); its profile/filter/noise are then re-measured
     # per window without recomputing the expensive normalisation
     first = fit_arc(sec, freq, method=method, backend=backend,
                     constraint=brackets[0],
@@ -521,21 +525,17 @@ def fit_arcs_multi(sec: SecSpec, freq: float, brackets,
     eta_array = np.asarray(first.profile_eta)
     power = np.asarray(first.profile_power)
     filt = np.asarray(first.profile_power_filt)
-    # noise level reconstruction for the walk-based error (same estimate
-    # fit_arc used internally)
-    cutmid = kw.get("cutmid", 3)
-    startbin = kw.get("startbin", 3)
-    sspec_arr = np.array(sec.sspec, dtype=np.float64)
-    tdel_axis = np.asarray(sec.tdel)
-    delmax = kw.get("delmax")
-    dmax = np.max(tdel_axis) if delmax is None else delmax
-    dmax = dmax * (kw.get("ref_freq", 1400.0) / freq) ** 2
-    ind = int(np.argmin(np.abs(tdel_axis - dmax)))
-    noise = float(_noise_estimate(sspec_arr, cutmid)) / max(ind - startbin,
-                                                            1)
+    noise = float(np.asarray(first.noise))
+    # profile_eta lives in converted (beta-eta) units for non-lamsteps
+    # spectra (fit_arc converts internally, arc_fit.py:244-247): apply the
+    # same conversion to the remaining brackets so all arcs are windowed
+    # in consistent units
+    ref_freq = kw.get("ref_freq", 1400.0)
+    conv = 1.0 if sec.lamsteps else \
+        _beta_to_eta_factor(freq, ref_freq) / (freq / ref_freq) ** 2
     for lo, hi in brackets[1:]:
         fits.append(_measure_peak(
-            eta_array, power, filt, noise, (lo, hi), low_power_diff,
-            high_power_diff, noise_error, sec.lamsteps,
+            eta_array, power, filt, noise, (lo * conv, hi * conv),
+            low_power_diff, high_power_diff, noise_error, sec.lamsteps,
             log_fit=(method == "gridmax")))
     return fits

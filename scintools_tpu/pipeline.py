@@ -269,8 +269,13 @@ class Dynspec:
                         f"vs {np.size(etamax)}")
                 return arr
 
-            brackets = list(zip(as_bounds(etamin, 0.0),
-                                as_bounds(etamax, np.inf)))
+            # honour an explicit constraint by intersecting it with every
+            # window (it would otherwise be silently ignored in multi-arc
+            # mode)
+            c0, c1 = float(constraint[0]), float(constraint[1])
+            brackets = [(max(lo, c0), min(hi, c1))
+                        for lo, hi in zip(as_bounds(etamin, 0.0),
+                                          as_bounds(etamax, np.inf))]
             fits = fit_arcs_multi(
                 sec, freq=float(self._data.freq), brackets=brackets,
                 method=method, delmax=delmax, numsteps=numsteps,

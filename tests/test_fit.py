@@ -348,3 +348,31 @@ def test_dynspec_multi_arc_attribute_handling():
     with pytest.raises(ValueError, match="lengths differ"):
         ds.fit_arc(lamsteps=True, etamin=[1.0, 5.0, 10.0],
                    etamax=[5.0, 10.0])
+
+
+def test_multi_arc_non_lamsteps_unit_consistency():
+    """For tdel-space spectra, bracket windows for arcs 2..N must go
+    through the SAME unit conversion fit_arc applies to arc 1's
+    constraint: the same bracket given twice must yield identical fits
+    (arc 1 via fit_arc's internal conversion, arc 2 via the multi-arc
+    driver's)."""
+    from scintools_tpu.fit.arc_fit import (_beta_to_eta_factor,
+                                           fit_arcs_multi)
+    from scintools_tpu.io import from_simulation
+    from scintools_tpu.sim import Simulation
+
+    from scintools_tpu import Dynspec
+
+    d = from_simulation(Simulation(mb2=2, ns=128, nf=128, dlam=0.25,
+                                   seed=1234), freq=1400.0, dt=8.0)
+    ds = Dynspec(data=d, process=True, lamsteps=False)
+    ds.fit_arc(lamsteps=False, numsteps=2000)
+    b2e = _beta_to_eta_factor(1400.0, 1400.0)
+    eta_user = ds.eta / b2e  # bracket in user (tdel) units
+    sec = ds._secspec(False)
+    fits = fit_arcs_multi(sec, 1400.0,
+                          brackets=[(0.5 * eta_user, 2 * eta_user)] * 2,
+                          numsteps=2000)
+    assert float(fits[0].eta) == pytest.approx(float(fits[1].eta),
+                                               rel=1e-9)
+    assert np.isfinite(fits[0].noise) and fits[0].noise > 0

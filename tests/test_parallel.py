@@ -202,3 +202,62 @@ def test_shard_leading_and_sharded_mean(epochs):
     batch, _ = pad_batch(epochs * 3, batch_multiple=8)
     sharded = shard_leading(batch, mesh)
     assert np.asarray(sharded.dyn).shape[0] == 16
+
+
+def test_survey_stats_masked_reduction():
+    """psum-based survey statistics match numpy on masked data."""
+    import jax.numpy as jnp
+
+    from scintools_tpu.parallel import survey_stats
+    from scintools_tpu.parallel.mesh import shard_leading
+
+    mesh = make_mesh()
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(64) * 3 + 10
+    x[5] = np.nan                       # failed fit
+    valid = np.ones(64, bool)
+    valid[40:48] = False                # padding lanes
+    xs = shard_leading(jnp.asarray(x), mesh)
+    out = survey_stats(xs, mesh, valid=jnp.asarray(valid))
+    ok = valid & np.isfinite(x)
+    assert out["count"] == int(ok.sum())
+    assert out["mean"] == pytest.approx(float(x[ok].mean()), rel=1e-6)
+    assert out["std"] == pytest.approx(float(x[ok].std()), rel=1e-5)
+
+
+def test_hybrid_mesh_single_host():
+    from scintools_tpu.parallel import make_hybrid_mesh
+
+    mesh = make_hybrid_mesh(ici_chan=2)
+    assert mesh.shape["chan"] == 2
+    assert mesh.shape["data"] * 2 == len(jax.devices())
+
+
+def test_initialize_multihost_noop_single_process():
+    from scintools_tpu.parallel import initialize_multihost
+
+    assert initialize_multihost() is False
+
+
+def test_survey_stats_large_mean_small_scatter():
+    """Two-pass variance survives f32-scale cancellation: tau ~ 5000 s
+    with 0.5 s scatter must not collapse to std=0."""
+    import jax.numpy as jnp
+
+    from scintools_tpu.parallel import survey_stats
+    from scintools_tpu.parallel.mesh import shard_leading
+
+    mesh = make_mesh()
+    rng = np.random.default_rng(3)
+    x = (5000.0 + 0.5 * rng.standard_normal(64)).astype(np.float32)
+    xs = shard_leading(jnp.asarray(x), mesh)
+    out = survey_stats(xs, mesh)
+    assert out["std"] == pytest.approx(float(x.std()), rel=0.05)
+    assert out["std"] > 0.1
+
+
+def test_hybrid_mesh_ici_validation():
+    from scintools_tpu.parallel import make_hybrid_mesh
+
+    with pytest.raises(ValueError, match="divisible"):
+        make_hybrid_mesh(ici_chan=3)
