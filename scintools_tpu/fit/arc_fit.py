@@ -20,9 +20,11 @@ the +2 dB profile shift when the profile at normalised fdop=1 is negative
 (dynspec.py:864-866).
 
 The jax path (:func:`make_arc_fitter`) is the fixed-shape SPMD rebuild:
-row-normalisation becomes a vmapped clamped ``jnp.interp`` (identical
-values to masked interp because linear interpolation is local and scale-
-invariant), NaN masks replace boolean compaction, the -3 dB walks become
+row-normalisation becomes vmapped uniform-grid linear interpolation
+(index arithmetic, no searchsorted; identical values to masked interp
+because linear interpolation is local and scale-invariant, and the fdop
+grid from sspec_axes is uniform), NaN masks replace boolean compaction,
+the -3 dB walks become
 first-crossing reductions, and the windowed parabola fit uses 0/1 weights —
 so one jit compiles the whole measurement for a [B, nr, nc] batch of
 epochs.  Agreement with the numpy path is asserted on synthetic arcs in
@@ -373,6 +375,19 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
     cut_hi = int(ncol / 2 + np.floor(cutmid / 2))
     col_nan = np.zeros(ncol, dtype=bool)
     col_nan[cut_lo:cut_hi] = True
+    # fdop is a uniform grid (sspec_axes), so row interpolation reduces to
+    # direct index arithmetic — no searchsorted (log-n gather chains) in
+    # the hot vmapped row loop.  The grid MUST be uniform for this; fail
+    # loudly for exotic callers.
+    f0 = float(fdop[0])
+    dfd = float(fdop[1] - fdop[0])
+    if not np.allclose(np.diff(fdop), dfd, rtol=1e-9, atol=0.0):
+        raise ValueError("jax arc fitter requires a uniform fdop grid "
+                         "(sspec_axes produces one); use backend='numpy' "
+                         "for non-uniform axes")
+    # half-ulp slack so ceil/floor match searchsorted when a query lands
+    # exactly on a grid value (linspace grids differ in the last ulp)
+    _EDGE_EPS = 1e-12
 
     def one_epoch(sspec):
         # ---- noise estimate (dynspec.py:446-451,463) -------------------
@@ -383,15 +398,23 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         rows = sspec[startbin:ind_norm, :]
         rows = jnp.where(col_nan[None, :], jnp.nan, rows)
 
-        fdop_j = jnp.asarray(fdop)
         fdopnew_j = jnp.asarray(fdopnew)
 
         def one_row(row, s):
             imax = s  # maxnormfac=1 -> imaxfdop = sqrt(itdel/emin)
-            lo = jnp.searchsorted(fdop_j, -imax, side="left")
-            hi = jnp.searchsorted(fdop_j, imax, side="right") - 1
-            q = jnp.clip(fdopnew_j * s, fdop_j[lo], fdop_j[hi])
-            return jnp.interp(q, fdop_j, row)
+            # uniform-grid bounds of |fdop| <= imax (match searchsorted
+            # left / right-1 up to half-ulp rounding on the grid values)
+            blo = (-imax - f0) / dfd
+            bhi = (imax - f0) / dfd
+            lo = jnp.ceil(blo - _EDGE_EPS * jnp.abs(blo)).astype(jnp.int32)
+            hi = jnp.floor(bhi + _EDGE_EPS * jnp.abs(bhi)).astype(jnp.int32)
+            lo = jnp.clip(lo, 0, ncol - 1)
+            hi = jnp.clip(hi, 0, ncol - 1)
+            q = jnp.clip(fdopnew_j * s, f0 + lo * dfd, f0 + hi * dfd)
+            pos = jnp.clip((q - f0) / dfd, 0.0, ncol - 1.0)
+            i0 = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, ncol - 2)
+            w = pos - i0
+            return row[i0] * (1.0 - w) + row[i0 + 1] * w
 
         norm = jax.vmap(one_row)(rows, jnp.asarray(scales))  # [R, n]
         prof = jnp.nanmean(norm, axis=0)                     # [n]

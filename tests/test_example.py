@@ -27,3 +27,16 @@ def test_arc_modelling_walkthrough(tmp_path):
 
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-v"]))
+
+
+@pytest.mark.slow
+def test_survey_pipeline_walkthrough(tmp_path):
+    script = _SCRIPT.parent / "survey_pipeline.py"
+    mod = runpy.run_path(str(script))
+    out = mod["main"](str(tmp_path))
+    assert out["rows"] == 64
+    assert out["stats"]["tau"]["count"] == 64
+    assert out["stats"]["tau"]["mean"] > 0
+    # rerun: everything resumed from the store, nothing recomputed
+    out2 = mod["main"](str(tmp_path))
+    assert out2["resumed"] == 64 and out2["rows"] == 64

@@ -51,13 +51,23 @@ def test_trace_annotation_noop():
         pass
 
 
-def test_logger_structured(caplog):
+def test_logger_structured():
+    # capture with our own handler: independent of caplog/root propagation
+    # (the package logger intentionally sets propagate=False)
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
     log = get_logger("scintools_tpu.test")
-    log.propagate = True  # let caplog's root handler see it
-    with caplog.at_level(logging.INFO, logger="scintools_tpu.test"):
-        log_event(log, "epoch", file="x.dynspec", tau=123.456789,
-                  n=3)
-    msg = caplog.records[-1].getMessage()
+    log.addHandler(Capture())
+    try:
+        log_event(log, "epoch", file="x.dynspec", tau=123.456789, n=3)
+    finally:
+        log.handlers = [h for h in log.handlers
+                        if not isinstance(h, Capture)]
+    msg = records[-1].getMessage()
     assert msg.startswith("epoch ")
     assert "file=x.dynspec" in msg and "tau=123.457" in msg and "n=3" in msg
 
