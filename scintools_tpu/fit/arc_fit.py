@@ -194,7 +194,7 @@ def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
     """Find the arc curvature maximising power along ``tdel = eta fdop^2``
     (dynspec.py:414-785, compute only; primary arc)."""
     backend = resolve(backend)
-    if backend == "jax" and method == "norm_sspec":
+    if backend == "jax" and method in ("norm_sspec", "gridmax"):
         fitter = make_arc_fitter(
             fdop=np.asarray(sec.fdop), yaxis=np.asarray(
                 sec.beta if sec.lamsteps else sec.tdel),
@@ -213,8 +213,6 @@ def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
                       profile_power=batch.profile_power[0],
                       profile_power_filt=batch.profile_power_filt[0],
                       noise=batch.noise[0])
-    # gridmax has no jax path yet: fall through to the numpy implementation
-
     sspec = np.array(sec.sspec, dtype=np.float64)
     tdel_axis = np.asarray(sec.tdel)
     fdop = np.asarray(sec.fdop, dtype=np.float64)
@@ -426,6 +424,14 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         avg = (prof[ipos] + prof[ineg][::-1]) / 2
         avg = avg[::-1]                                     # ascending eta
         valid = jnp.isfinite(avg) & jnp.asarray(keep_static)
+        return measure_profile(avg, valid, noise,
+                               jnp.asarray(eta_array), cons_mask,
+                               use_log=False) + (noise,)
+
+    def measure_profile(avg, valid, noise, ea, cmask, use_log):
+        """Masked peak search + power-drop windows + (log-)parabola fit on
+        a power-vs-eta profile — the jit-safe tail shared by both methods
+        (dynspec.py:693-744)."""
         # fill invalid (contiguous large-eta tail / NaN centre) with the
         # lowest valid power so the smoother sees a continuous profile and
         # the fill can never create a spurious peak (differs from the numpy
@@ -435,7 +441,7 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         filt = savgol1(avg_f, nsmooth, xp=jnp)
 
         # ---- peak within constraint (dynspec.py:693-699) ---------------
-        search = valid & jnp.asarray(cons_mask)
+        search = valid & jnp.asarray(cmask)
         maxval = jnp.max(jnp.where(search, filt, -jnp.inf))
         peak_ind = jnp.argmin(jnp.where(valid, jnp.abs(filt - maxval),
                                         jnp.inf))
@@ -459,8 +465,11 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         left, right = window(max_power + low_power_diff,
                              max_power + high_power_diff)
         w = ((idx >= left) & (idx < right + 1) & valid).astype(filt.dtype)
-        ea = jnp.asarray(eta_array)
-        yfit, eta, etaerr_fit = _fitpar(ea, avg_f, w=w, xp=jnp)
+        if use_log:
+            yfit, eta, etaerr_fit = fit_log_parabola(ea, avg_f, w=w,
+                                                     xp=jnp)
+        else:
+            yfit, eta, etaerr_fit = _fitpar(ea, avg_f, w=w, xp=jnp)
 
         etaerr = etaerr_fit
         if noise_error:
@@ -470,14 +479,93 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
             hi_eta = jnp.max(jnp.where(wn_, ea, -jnp.inf))
             etaerr = (hi_eta - lo_eta) / 2
 
-        return eta, etaerr, etaerr_fit, avg_f, filt, noise
+        return eta, etaerr, etaerr_fit, avg_f, filt
+
+    # ---- gridmax statics (dynspec.py:516-659) --------------------------
+    if method == "gridmax":
+        nrow_g = ind  # delay rows kept
+        eta_array_g = np.linspace(np.sqrt(emin), np.sqrt(emax),
+                                  int(numsteps)) ** 2
+        cons_mask_g = (eta_array_g > cons[0]) & (eta_array_g < cons[1])
+        # fit-level cutmid mask: floor/CEIL (dynspec.py:455-457) — one
+        # column wider on the high side than norm_sspec's floor/floor mask
+        col_nan_g = np.zeros(ncol, dtype=bool)
+        col_nan_g[int(ncol / 2 - np.floor(cutmid / 2)):
+                  int(ncol / 2 + np.ceil(cutmid / 2))] = True
+        x_f = fdop
+        # reference pixel mapping: column positions are STATIC
+        # (dynspec.py:540: scaled by shape, not shape-1 — quirk kept)
+        xpx = (x_f - x_f.min()) / (x_f.max() - x_f.min()) * ncol
+        col_ok = (xpx >= 0) & (xpx <= ncol - 1)     # cval=nan analogue
+        jx0 = np.clip(np.floor(xpx).astype(np.int32), 0, ncol - 2)
+        wx = (xpx - jx0).astype(np.float64)
+        xmin2 = float(np.min(x_f ** 2))
+        ymax_g = float(yc.max())
+        side_l = x_f < 0
+        side_r = x_f > 0
+        chunk = 256  # [chunk, ncol] sampling slabs bound device memory
+
+        def one_epoch_gridmax(sspec):
+            noise = _noise_estimate(sspec, cutmid, xp=jnp)
+            noise = noise / (ind - startbin)
+
+            z = sspec[:ind, :]
+            z = jnp.where(col_nan_g[None, :], jnp.nan, z)
+            z = z.at[:startbin, :].set(jnp.nan)
+
+            x2 = jnp.asarray(x_f ** 2)
+            jx0_j = jnp.asarray(jx0)
+            wx_j = jnp.asarray(wx)
+
+            def sample_eta(ieta):
+                ynew = ieta * x2
+                ymin = ieta * xmin2
+                ynewpx = (ynew - ymin) / (ymax_g - ymin) * nrow_g
+                row_ok = (ynewpx >= 0) & (ynewpx <= nrow_g - 1)
+                iy0 = jnp.clip(jnp.floor(ynewpx).astype(jnp.int32), 0,
+                               nrow_g - 2)
+                wy = ynewpx - iy0
+                v = (z[iy0, jx0_j] * (1 - wy) * (1 - wx_j)
+                     + z[iy0 + 1, jx0_j] * wy * (1 - wx_j)
+                     + z[iy0, jx0_j + 1] * (1 - wy) * wx_j
+                     + z[iy0 + 1, jx0_j + 1] * wy * wx_j)
+                v = jnp.where(row_ok & jnp.asarray(col_ok), v, jnp.nan)
+                inarc = ynew < ymax_g
+
+                def side_mean(side):
+                    ok = jnp.isfinite(v) & inarc & jnp.asarray(side)
+                    s = jnp.sum(jnp.where(ok, v, 0.0))
+                    c = jnp.sum(ok)
+                    return jnp.where(c > 0, s / jnp.maximum(c, 1), jnp.nan)
+
+                return (side_mean(side_l) + side_mean(side_r)) / 2
+
+            # chunked over the eta grid: [chunk, ncol] slabs, not [S, ncol]
+            S = len(eta_array_g)
+            pad = (-S) % chunk
+            eta_p = jnp.asarray(np.pad(eta_array_g, (0, pad),
+                                       constant_values=1.0))
+            sumpow = jax.lax.map(jax.vmap(sample_eta),
+                                 eta_p.reshape(-1, chunk)).reshape(-1)[:S]
+
+            valid = jnp.isfinite(sumpow)
+            return measure_profile(sumpow, valid, noise,
+                                   jnp.asarray(eta_array_g), cons_mask_g,
+                                   use_log=True) + (noise,)
+
+        epoch_fn = one_epoch_gridmax
+        profile_eta_out = eta_array_g
+    else:
+        epoch_fn = one_epoch
+        profile_eta_out = eta_array
 
     @jax.jit
     def impl(sspec_batch):
         eta, etaerr, etaerr2, avg, filt, noise = \
-            jax.vmap(one_epoch)(sspec_batch)
+            jax.vmap(epoch_fn)(sspec_batch)
         return ArcFit(eta=eta, etaerr=etaerr, etaerr2=etaerr2,
-                      lamsteps=lamsteps, profile_eta=jnp.asarray(eta_array),
+                      lamsteps=lamsteps,
+                      profile_eta=jnp.asarray(profile_eta_out),
                       profile_power=avg, profile_power_filt=filt,
                       noise=noise)
 
@@ -495,13 +583,12 @@ def make_arc_fitter(fdop, yaxis, tdel, freq, lamsteps=True,
     Returns ``fitter(sspec_batch [B, nr, nc]) -> ArcFit`` of [B] arrays.
     All grid-dependent decisions (delay cut, eta grid, fold indices) are
     made host-side once; the per-epoch measurement is pure fixed-shape jax.
-    Only the ``norm_sspec`` method is implemented on this path (the
-    reference's default and flagship; gridmax falls back to numpy).
+    Both reference methods are implemented: ``norm_sspec`` (row
+    normalisation) and ``gridmax`` (chunked bilinear sampling along
+    ``tdel = eta fdop^2`` trial arcs).
     """
-    if method != "norm_sspec":
-        raise NotImplementedError(
-            "jax arc fitter implements method='norm_sspec'; use the numpy "
-            "backend for gridmax")
+    if method not in ("norm_sspec", "gridmax"):
+        raise ValueError(f"unknown arc fitting method {method!r}")
     fdop = np.ascontiguousarray(np.asarray(fdop, dtype=np.float64))
     yaxis = np.ascontiguousarray(np.asarray(yaxis, dtype=np.float64))
     tdel = np.ascontiguousarray(np.asarray(tdel, dtype=np.float64))

@@ -139,3 +139,18 @@ def test_from_simulation_matches_reference_simdyn():
     np.testing.assert_allclose(np.asarray(ours.dyn), sd.dyn, rtol=1e-12)
     np.testing.assert_allclose(ours.freqs, sd.freqs, rtol=1e-12)
     assert ours.name == sd.name
+
+
+def test_clean_archive_gated():
+    """Without the observatory stack, clean_archive raises an actionable
+    ImportError rather than crashing obscurely (scint_utils.py:19-56)."""
+    from scintools_tpu.io import clean_archive
+
+    try:
+        import coast_guard  # noqa: F401
+
+        pytest.skip("coast_guard installed; gate not reachable")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError, match="psrchive"):
+        clean_archive(None)
