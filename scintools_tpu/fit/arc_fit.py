@@ -354,6 +354,15 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         emin_norm = emin
 
     n = int(numsteps)
+    # constraint sanity: the masks are host-side static, so an impossible
+    # window fails at build time like the numpy path does at fit time
+    # (otherwise the traced argmax would degenerate silently to index 0)
+    def _check_constraint(grid_mask, grid):
+        if not grid_mask.any():
+            raise ValueError(
+                f"no eta grid points inside constraint {tuple(cons)} "
+                f"(grid spans {grid.min():.4g}..{grid.max():.4g})")
+
     # norm_sspec internals (maxnormfac=1): rows startbin..ind_norm-1
     tdel_rows = yaxis[startbin:ind_norm]
     scales = np.sqrt(tdel_rows / emin_norm)         # [R] per-row fdop scale
@@ -366,6 +375,8 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
     eta_array = emin * etafrac_avg[::-1] ** 2       # ascending in eta
     keep_static = eta_array < emax                  # static part of validity
     cons_mask = (eta_array > cons[0]) & (eta_array < cons[1])
+    if method == "norm_sspec":
+        _check_constraint(cons_mask, eta_array)
     # cutmid NaN columns of the row-normalised spectrum (norm_sspec flavour:
     # floor on both sides, dynspec.py:838-839)
     ncol = len(fdop)
@@ -487,6 +498,7 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         eta_array_g = np.linspace(np.sqrt(emin), np.sqrt(emax),
                                   int(numsteps)) ** 2
         cons_mask_g = (eta_array_g > cons[0]) & (eta_array_g < cons[1])
+        _check_constraint(cons_mask_g, eta_array_g)
         # fit-level cutmid mask: floor/CEIL (dynspec.py:455-457) — one
         # column wider on the high side than norm_sspec's floor/floor mask
         col_nan_g = np.zeros(ncol, dtype=bool)

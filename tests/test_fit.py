@@ -389,3 +389,13 @@ def test_gridmax_jax_matches_numpy():
                     backend="jax")
     assert float(fit_j.eta) == pytest.approx(float(fit_np.eta), rel=0.15)
     assert float(fit_j.etaerr) > 0
+
+
+def test_jax_arc_fitter_impossible_constraint_raises():
+    """A constraint excluding the whole eta grid fails loudly at build
+    time on the jax path (the numpy path raises at fit time)."""
+    sec = _arc_secspec(eta=0.5)
+    for method in ("norm_sspec", "gridmax"):
+        with pytest.raises(ValueError, match="no eta grid points"):
+            fit_arc(sec, freq=1400.0, method=method, numsteps=500,
+                    constraint=(1e7, 2e7), backend="jax")

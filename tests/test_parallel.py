@@ -261,3 +261,21 @@ def test_hybrid_mesh_ici_validation():
 
     with pytest.raises(ValueError, match="divisible"):
         make_hybrid_mesh(ici_chan=3)
+
+
+def test_pipeline_non_lamsteps_config():
+    """The batched step also compiles and fits without lambda resampling
+    (sspec straight on the frequency grid, eta in tdel units)."""
+    from scintools_tpu.data import stack_batch
+
+    eps = [_epoch(seed=s) for s in (5, 6)]
+    batch = stack_batch(eps)
+    cfg = PipelineConfig(lamsteps=False, arc_numsteps=500, lm_steps=20)
+    step = make_pipeline(np.asarray(eps[0].freqs), np.asarray(eps[0].times),
+                         cfg)
+    res = step(np.asarray(batch.dyn, dtype=np.float32))
+    tau = np.asarray(res.scint.tau)
+    eta = np.asarray(res.arc.eta)
+    assert tau.shape == (2,) and np.all(np.isfinite(tau)) and np.all(tau > 0)
+    assert eta.shape == (2,) and np.all(np.isfinite(eta))
+    assert res.beta is None  # no lambda axis without lamsteps

@@ -116,3 +116,32 @@ def test_ensemble_shapes():
     assert out.shape == (8, 16, 4)
     one = np.asarray(simulate_intensity(keys[3], p))
     np.testing.assert_allclose(out[3], one, rtol=1e-10, atol=1e-12)
+
+
+def test_strong_scattering_rayleigh_statistics():
+    """Physics check: deep in strong scattering the E-field becomes
+    circular-Gaussian, so intensity is exponential-distributed with
+    modulation index <I^2>/<I>^2 -> 2 (Rayleigh limit).  Ensemble-averaged
+    over seeds to beat single-screen variance."""
+    import jax
+
+    p = SimParams(mb2=64.0, nx=128, ny=128, nf=8, dlam=0.25)
+    keys = jax.random.split(jax.random.PRNGKey(5), 6)
+    ratios = []
+    for k in keys:
+        spi = np.asarray(simulate_intensity(k, p), dtype=np.float64)
+        ratios.append((spi**2).mean() / spi.mean() ** 2)
+    ratio = np.mean(ratios)
+    assert 1.6 < ratio < 2.6, f"<I^2>/<I>^2 = {ratio}, expected ~2"
+
+
+def test_weak_scattering_low_modulation():
+    """Weak scattering (mb2 << 1): intensity stays close to uniform, with
+    scintillation index m^2 ~ mb2 << 1."""
+    import jax
+
+    p = SimParams(mb2=0.02, nx=128, ny=128, nf=8, dlam=0.25)
+    spi = np.asarray(simulate_intensity(jax.random.PRNGKey(6), p),
+                     dtype=np.float64)
+    m2 = spi.var() / spi.mean() ** 2
+    assert m2 < 0.15, f"m^2 = {m2}, expected << 1 in weak scattering"
