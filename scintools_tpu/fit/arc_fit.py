@@ -376,7 +376,11 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
     keep_static = eta_array < emax                  # static part of validity
     cons_mask = (eta_array > cons[0]) & (eta_array < cons[1])
     if method == "norm_sspec":
-        _check_constraint(cons_mask, eta_array)
+        # the searchable region is the constraint INTERSECTED with the
+        # static validity window (eta < emax): a constraint lying wholly
+        # past emax would degenerate silently at fit time otherwise
+        _check_constraint(cons_mask & keep_static,
+                          eta_array[keep_static])
     # cutmid NaN columns of the row-normalised spectrum (norm_sspec flavour:
     # floor on both sides, dynspec.py:838-839)
     ncol = len(fdop)

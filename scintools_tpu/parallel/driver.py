@@ -58,6 +58,7 @@ class PipelineConfig:
     window_frac: float = 0.1
     fit_scint: bool = True
     fit_arc: bool = True
+    fit_scint_2d: bool = False    # 2-D ACF fit incl. phase-gradient tilt
     alpha: float | None = 5 / 3       # None -> fit alpha too
     lm_steps: int = 40
     arc_numsteps: int = 2000
@@ -82,6 +83,9 @@ class PipelineResult:
     fdop: Any = None
     tdel: Any = None
     beta: Any = None
+    scint2d: Any = None     # ScintParams from the 2-D fit (fit_scint_2d)
+    tilt: Any = None        # [B] phase-gradient tilt (s/MHz)
+    tilterr: Any = None
 
 
 def _register():
@@ -91,7 +95,7 @@ def _register():
         jax.tree_util.register_pytree_node(
             PipelineResult,
             lambda r: ((r.scint, r.arc, r.acf, r.sspec, r.fdop, r.tdel,
-                        r.beta), None),
+                        r.beta, r.scint2d, r.tilt, r.tilterr), None),
             lambda _, l: PipelineResult(*l))
     except ImportError:  # pragma: no cover
         pass
@@ -173,7 +177,8 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
         dyn_batch = jnp.asarray(dyn_batch)
         out = {}
         scint = None
-        if config.fit_scint or config.return_acf:
+        scint2d = tilt = tilterr = None
+        if config.fit_scint or config.return_acf or config.fit_scint_2d:
             dyn_acf = dyn_batch
             if mesh is not None and chan_sharded:
                 # Sharding policy: the ACF/fit path is small (one [2nf,2nt]
@@ -185,13 +190,25 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
 
                 dyn_acf = jax.lax.with_sharding_constraint(
                     dyn_batch, NamedSharding(mesh, P(mesh_mod.DATA_AXIS)))
-            if config.return_acf:
+            if config.return_acf or config.fit_scint_2d:
                 acf_b = acf_op(dyn_acf, backend="jax")
                 if config.fit_scint:
                     scint = fit_scint_params_batch(
                         acf_b, dt, df, nchan, nsub, alpha=config.alpha,
                         steps=config.lm_steps)
-                out["acf"] = acf_b
+                if config.fit_scint_2d:
+                    from ..fit.scint_fit import fit_scint_params_2d_batch
+
+                    if config.alpha is None:
+                        raise NotImplementedError(
+                            "fit_scint_2d requires a fixed alpha "
+                            "(PipelineConfig.alpha=None fits alpha on the "
+                            "1-D path only)")
+                    scint2d, tilt, tilterr = fit_scint_params_2d_batch(
+                        acf_b, dt, abs(df), nchan, nsub,
+                        alpha=config.alpha, steps=config.lm_steps)
+                if config.return_acf:
+                    out["acf"] = acf_b
             elif config.fit_scint:
                 # fast path: 1-D cuts via padded 1-D FFT reductions — same
                 # values as the 2-D ACF route without materialising
@@ -216,7 +233,8 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
             scint=scint, arc=arc, acf=out.get("acf"),
             sspec=sec_b if config.return_sspec else None,
             fdop=jnp.asarray(fdop), tdel=jnp.asarray(tdel),
-            beta=None if beta is None else jnp.asarray(beta))
+            beta=None if beta is None else jnp.asarray(beta),
+            scint2d=scint2d, tilt=tilt, tilterr=tilterr)
 
     if mesh is None:
         return jax.jit(step)
@@ -293,7 +311,8 @@ def _take_lanes(res: PipelineResult, n: int, B: int) -> PipelineResult:
             arc, profile_eta=None)), profile_eta=arc.profile_eta)
     return dataclasses.replace(
         res, scint=take(res.scint), arc=arc, acf=take(res.acf),
-        sspec=take(res.sspec))
+        sspec=take(res.sspec), scint2d=take(res.scint2d),
+        tilt=take(res.tilt), tilterr=take(res.tilterr))
 
 
 def _concat_results(parts):
@@ -314,7 +333,8 @@ def _concat_results(parts):
         return jax.tree_util.tree_map(_cat_leaf, *vals)
 
     first = parts[0]
-    out = {f: cat(f) for f in ("scint", "acf", "sspec")}
+    out = {f: cat(f) for f in ("scint", "acf", "sspec", "scint2d", "tilt",
+                               "tilterr")}
     arc = None
     if first.arc is not None:
         # profile_eta is a shared grid (no batch axis); splice it back
@@ -327,4 +347,6 @@ def _concat_results(parts):
                           sspec=out["sspec"], fdop=np.asarray(first.fdop),
                           tdel=np.asarray(first.tdel),
                           beta=None if first.beta is None
-                          else np.asarray(first.beta))
+                          else np.asarray(first.beta),
+                          scint2d=out["scint2d"], tilt=out["tilt"],
+                          tilterr=out["tilterr"])

@@ -399,3 +399,16 @@ def test_jax_arc_fitter_impossible_constraint_raises():
         with pytest.raises(ValueError, match="no eta grid points"):
             fit_arc(sec, freq=1400.0, method=method, numsteps=500,
                     constraint=(1e7, 2e7), backend="jax")
+
+
+def test_constraint_past_emax_raises_norm_sspec():
+    """A constraint inside the eta grid but wholly past the emax validity
+    window must also fail at build time (guard intersects keep_static):
+    for this geometry the grid tops out ~3x past emax."""
+    sec = _arc_secspec(eta=0.5)
+    fdop = np.asarray(sec.fdop)
+    tdel = np.asarray(sec.tdel)
+    emax = tdel.max() / ((fdop[1] - fdop[0]) * 3) ** 2  # default cutmid=3
+    with pytest.raises(ValueError, match="no eta grid points"):
+        fit_arc(sec, freq=1400.0, numsteps=500, backend="jax",
+                constraint=(emax * 2, emax * 5))
