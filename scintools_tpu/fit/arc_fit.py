@@ -146,6 +146,17 @@ def _walk(filt: np.ndarray, ind: int, threshold: float) -> tuple[int, int]:
     return ind1, ind2
 
 
+def _check_profile_size(profile, nsmooth: int) -> None:
+    """Informative failure for profiles too short to smooth/fit
+    (np.size: robust to the 0-d arrays `.squeeze()` produces when only
+    one point survives masking)."""
+    if np.size(profile) <= nsmooth:
+        raise ValueError(
+            f"curvature profile has only {np.size(profile)} valid points "
+            f"(<= nsmooth={nsmooth}) — secondary spectrum too small or "
+            f"too masked to fit an arc")
+
+
 def _measure_peak(eta_array, power, filt, noise, constraint,
                   low_power_diff, high_power_diff, noise_error, lamsteps,
                   log_fit: bool) -> ArcFit:
@@ -273,6 +284,7 @@ def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
         eta_array = eta_array[keep].squeeze()
         avg = avg[keep].squeeze()
 
+        _check_profile_size(avg, nsmooth)
         filt = savgol_filter(avg, nsmooth, 1)
         return _measure_peak(eta_array, avg, filt, noise, constraint,
                              low_power_diff, high_power_diff, noise_error,
@@ -296,6 +308,7 @@ def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
         sumpow = (np.array(sumpow_l) + np.array(sumpow_r)) / 2
         ok = np.isfinite(sumpow)
         eta_array, sumpow = eta_array[ok], sumpow[ok]
+        _check_profile_size(sumpow, nsmooth)
         filt = savgol_filter(sumpow, nsmooth, 1)
         return _measure_peak(eta_array, sumpow, filt, noise, constraint,
                              low_power_diff, high_power_diff, noise_error,

@@ -81,6 +81,15 @@ def fit_scint_params(acf2d, dt, df, nchan: int, nsub: int,
                      backend: str = "numpy", steps: int = 40) -> ScintParams:
     """Fit tau/dnu/amp/wn (alpha fixed unless ``alpha=None``) to one ACF."""
     backend = resolve(backend)
+    # host-side validity check before dispatching to either engine (the
+    # jit'd jax fit would otherwise silently return NaN parameters)
+    cuts_concrete = np.concatenate(
+        [np.asarray(acf2d)[..., nchan, nsub:],
+         np.asarray(acf2d)[..., nchan:, nsub]], axis=-1)
+    if not np.isfinite(cuts_concrete).all():
+        raise ValueError(
+            "ACF cuts contain non-finite values — refill/zap the "
+            "dynamic spectrum before fitting scintillation parameters")
     if backend == "numpy":
         a = np.asarray(acf2d, dtype=np.float64)
         x_t, y_t, x_f, y_f = acf_cuts(a, dt, df, nchan, nsub, xp=np)

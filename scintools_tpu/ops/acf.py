@@ -22,6 +22,9 @@ from ..backend import resolve
 def acf(dyn, backend: str = "numpy", subtract_mean: bool = True):
     """Autocovariance, output shape [..., 2*nf, 2*nt]."""
     backend = resolve(backend)
+    shape = np.shape(dyn)  # works for lists and device arrays alike
+    if len(shape) < 2 or shape[-2] < 2 or shape[-1] < 2:
+        raise ValueError(f"ACF needs at least a 2x2 dynspec, got {shape}")
     if backend == "numpy":
         return _acf_numpy(np.asarray(dyn), subtract_mean)
     return _acf_jax()(dyn, subtract_mean)

@@ -79,9 +79,19 @@ def refill(d: DynspecData, linear: bool = True,
         x = np.arange(arr.shape[1])
         y = np.arange(arr.shape[0])
         xx, yy = np.meshgrid(x, y)
-        arr = griddata((xx[~mask], yy[~mask]), arr[~mask], (xx, yy),
-                       method="linear")
+        from scipy.spatial import QhullError
+
+        try:
+            arr = griddata((xx[~mask], yy[~mask]), arr[~mask], (xx, yy),
+                           method="linear")
+        except (QhullError, ValueError):
+            # degenerate triangulation (e.g. all valid pixels collinear
+            # after heavy RFI zapping -> Qhull precision error): fall
+            # through to the mean fill below
+            pass
     good = np.isfinite(arr)
+    if not good.any():
+        raise ValueError("refill: dynamic spectrum has no finite pixels")
     arr[~good] = np.mean(arr[good])
     return d.replace(dyn=arr)
 

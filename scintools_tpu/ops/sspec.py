@@ -62,6 +62,11 @@ def sspec(dyn, prewhite: bool = True, window: str | None = "blackman",
     Use :func:`sspec_axes` for the fdop/tdel/beta axes.
     """
     backend = resolve(backend)
+    shape = np.shape(dyn)  # works for lists and device arrays alike
+    if len(shape) < 2 or shape[-2] < 2 or shape[-1] < 2:
+        raise ValueError(f"secondary spectrum needs at least a 2x2 "
+                         f"dynspec, got {shape} (prewhitening "
+                         f"differences both axes)")
     if backend == "numpy":
         arr = np.asarray(dyn, dtype=np.float64)
         if arr.ndim > 2:  # batched: per-epoch (host loop; use jax on device)

@@ -179,3 +179,52 @@ def test_fit_from_dyn_matches_fit_from_acf():
                                np.asarray(sp_acf.tau), rtol=1e-5)
     np.testing.assert_allclose(np.asarray(sp_dyn.dnu),
                                np.asarray(sp_acf.dnu), rtol=1e-5)
+
+
+def test_degenerate_inputs_fail_informatively():
+    """Edge cases raise actionable errors, not deep internal tracebacks
+    (the quarantine layers rely on exceptions carrying the reason)."""
+    import pytest
+
+    from scintools_tpu.data import DynspecData
+    from scintools_tpu.fit.scint_fit import fit_scint_params
+    from scintools_tpu.ops import acf as acf_fn
+    from scintools_tpu.ops import refill, sspec
+
+    with pytest.raises(ValueError, match="2x2"):
+        sspec(np.random.rand(64, 1))
+    with pytest.raises(ValueError, match="2x2"):
+        acf_fn(np.random.rand(1, 64))
+    with pytest.raises(ValueError, match="no finite"):
+        refill(DynspecData(dyn=np.full((8, 8), np.nan),
+                           freqs=np.linspace(1400, 1408, 8),
+                           times=np.arange(8.0)), zeros=True)
+    a = np.full((64, 128), np.nan)
+    with pytest.raises(ValueError, match="non-finite"):
+        fit_scint_params(a, 8.0, 0.5, 32, 64)
+
+
+def test_refill_survives_degenerate_triangulation():
+    """Heavy RFI masking can leave all valid pixels collinear, which makes
+    Qhull's triangulation degenerate (flat simplex); refill must fall back
+    to the mean fill instead of crashing (realistic survey input)."""
+    from scintools_tpu.data import DynspecData
+    from scintools_tpu.ops import refill
+
+    dyn = np.full((32, 32), np.nan)
+    dyn[7, :] = np.linspace(1.0, 2.0, 32)  # one surviving channel row
+    d = DynspecData(dyn=dyn, freqs=np.linspace(1400, 1432, 32),
+                    times=np.arange(32.0) * 8)
+    out = refill(d)
+    assert np.isfinite(np.asarray(out.dyn)).all()
+
+
+def test_scint_fit_jax_backend_rejects_nan_too():
+    """The non-finite guard runs host-side, covering both engines."""
+    import pytest
+
+    from scintools_tpu.fit.scint_fit import fit_scint_params
+
+    a = np.full((64, 128), np.nan)
+    with pytest.raises(ValueError, match="non-finite"):
+        fit_scint_params(a, 8.0, 0.5, 32, 64, backend="jax")
