@@ -149,11 +149,12 @@ def _walk(filt: np.ndarray, ind: int, threshold: float) -> tuple[int, int]:
 def _check_profile_size(profile, nsmooth: int) -> None:
     """Informative failure for profiles too short to smooth/fit
     (np.size: robust to the 0-d arrays `.squeeze()` produces when only
-    one point survives masking)."""
-    if np.size(profile) <= nsmooth:
+    one point survives masking).  savgol accepts window_length == size,
+    so only strictly smaller profiles are rejected."""
+    if np.size(profile) < nsmooth:
         raise ValueError(
             f"curvature profile has only {np.size(profile)} valid points "
-            f"(<= nsmooth={nsmooth}) — secondary spectrum too small or "
+            f"(< nsmooth={nsmooth}) — secondary spectrum too small or "
             f"too masked to fit an arc")
 
 

@@ -82,17 +82,15 @@ def fit_scint_params(acf2d, dt, df, nchan: int, nsub: int,
     """Fit tau/dnu/amp/wn (alpha fixed unless ``alpha=None``) to one ACF."""
     backend = resolve(backend)
     # host-side validity check before dispatching to either engine (the
-    # jit'd jax fit would otherwise silently return NaN parameters)
-    cuts_concrete = np.concatenate(
-        [np.asarray(acf2d)[..., nchan, nsub:],
-         np.asarray(acf2d)[..., nchan:, nsub]], axis=-1)
-    if not np.isfinite(cuts_concrete).all():
+    # jit'd jax fit would otherwise silently return NaN parameters); one
+    # host copy, same slicing as the fit consumes
+    a = np.asarray(acf2d, dtype=np.float64)
+    x_t, y_t, x_f, y_f = acf_cuts(a, dt, df, nchan, nsub, xp=np)
+    if not (np.isfinite(y_t).all() and np.isfinite(y_f).all()):
         raise ValueError(
             "ACF cuts contain non-finite values — refill/zap the "
             "dynamic spectrum before fitting scintillation parameters")
     if backend == "numpy":
-        a = np.asarray(acf2d, dtype=np.float64)
-        x_t, y_t, x_f, y_f = acf_cuts(a, dt, df, nchan, nsub, xp=np)
         tau0, dnu0, amp0, wn0 = initial_guesses(x_t, y_t, x_f, y_f, xp=np)
         y = np.concatenate([y_t, y_f])
         free = alpha is None

@@ -20,6 +20,7 @@ import functools
 import numpy as np
 from scipy.interpolate import griddata
 from scipy.signal import medfilt, savgol_filter
+from scipy.spatial import QhullError
 
 from ..data import DynspecData
 
@@ -79,8 +80,6 @@ def refill(d: DynspecData, linear: bool = True,
         x = np.arange(arr.shape[1])
         y = np.arange(arr.shape[0])
         xx, yy = np.meshgrid(x, y)
-        from scipy.spatial import QhullError
-
         try:
             arr = griddata((xx[~mask], yy[~mask]), arr[~mask], (xx, yy),
                            method="linear")
