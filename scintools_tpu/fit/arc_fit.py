@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import warnings
 from typing import Any
 
 import numpy as np
@@ -109,8 +110,12 @@ def norm_sspec(sec: SecSpec, freq: float, eta: float, delmax=None,
         isspec = sspec[ii, mask]
         norm_rows.append(np.interp(fdopnew, ifdop, isspec))
     norm_arr = np.array(norm_rows)
-    isspecavg = np.nanmean(norm_arr, axis=0)
-    powerspec = np.nanmean(norm_arr, axis=1)
+    # columns fully inside the cutmid notch are all-NaN by construction
+    # (the reference produces the same NaN means, warning unsuppressed)
+    with warnings.catch_warnings():
+        warnings.filterwarnings("ignore", message="Mean of empty slice")
+        isspecavg = np.nanmean(norm_arr, axis=0)
+        powerspec = np.nanmean(norm_arr, axis=1)
     ind1 = np.argmin(np.abs(fdopnew - 1) - 2)
     if isspecavg[ind1] < 0:
         isspecavg = isspecavg + 2  # reference's dB-offset quirk

@@ -118,7 +118,11 @@ def _sspec_numpy(dyn, prewhite, window, window_frac, db):
     if prewhite:
         sec = sec / _postdark(nrfft, ncfft)
     if db:
-        sec = 10 * np.log10(sec)
+        # zero-power pad bins legitimately map to -inf dB (the reference
+        # produces the same values, warning unsuppressed); downstream
+        # consumers mask by power, so the divide warning is just noise
+        with np.errstate(divide="ignore"):
+            sec = 10 * np.log10(sec)
     return sec
 
 

@@ -96,7 +96,12 @@ def _swdsp(p: SimParams, consp: float, kx, ky, xp=np):
     b = r * cs ** 2 + sn ** 2 / r
     c = 2 * cs * sn * (1 / r - r)
     q2 = a * kx ** 2 + b * ky ** 2 + c * kx * ky
-    return con * q2 ** alf * xp.exp(-(kx ** 2 + ky ** 2) * p.inner ** 2 / 2)
+    # q2=0 at DC -> inf weight; callers zero the DC bin explicitly (the
+    # screen has no mean-phase term).  np.errstate only affects numpy
+    # ufunc warnings, so it is a harmless no-op under jax tracing.
+    with np.errstate(divide="ignore"):
+        w = con * q2 ** alf
+    return w * xp.exp(-(kx ** 2 + ky ** 2) * p.inner ** 2 / 2)
 
 
 def _abs_freq_index(n: int, xp=np):
@@ -337,9 +342,17 @@ def _ensemble_jax(p: SimParams, screen_chunk: int):
 
 def simulate_ensemble(keys, params: SimParams, screen_chunk: int = 8):
     """Monte-Carlo ensemble: [B] PRNGKeys -> [B, nx, nf] intensities,
-    lax.map'd in chunks of vmapped screens (BASELINE config 5: 10k screens).
-    B must be a multiple of screen_chunk."""
-    if keys.shape[0] % screen_chunk:
-        raise ValueError(f"ensemble size {keys.shape[0]} not divisible by "
-                         f"screen_chunk {screen_chunk}")
-    return _ensemble_jax(params, screen_chunk)(keys)
+    lax.map'd in chunks of vmapped screens (BASELINE config 5: 10k
+    screens).  Any B: keys are padded to the chunk multiple internally
+    (pad screens are simulated and discarded)."""
+    import jax.numpy as jnp
+
+    n = keys.shape[0]
+    pad = (-n) % screen_chunk
+    if pad:
+        # cycle the keys so any pad size works, even pad > n
+        reps = int(np.ceil(pad / n))
+        filler = jnp.concatenate([keys] * reps, axis=0)[:pad]
+        keys = jnp.concatenate([keys, filler], axis=0)
+    out = _ensemble_jax(params, screen_chunk)(keys)
+    return out[:n]

@@ -145,3 +145,22 @@ def test_weak_scattering_low_modulation():
                      dtype=np.float64)
     m2 = spi.var() / spi.mean() ** 2
     assert m2 < 0.15, f"m^2 = {m2}, expected << 1 in weak scattering"
+
+
+def test_ensemble_pads_to_chunk():
+    """Non-divisible ensemble sizes are padded internally and sliced."""
+    import jax
+
+    p = SimParams(nx=32, ny=32, nf=4)
+    keys = jax.random.split(jax.random.PRNGKey(1), 10)
+    out = np.asarray(simulate_ensemble(keys, p, screen_chunk=4))
+    assert out.shape == (10, 32, 4)
+    # identical to the divisible-path result for the same keys
+    out12 = np.asarray(simulate_ensemble(
+        jax.random.split(jax.random.PRNGKey(1), 10), p, screen_chunk=5))
+    np.testing.assert_allclose(out, out12, rtol=1e-6)
+    # pad larger than the batch itself (3 keys, chunk 8)
+    small = np.asarray(simulate_ensemble(
+        jax.random.split(jax.random.PRNGKey(2), 3), p, screen_chunk=8))
+    assert small.shape == (3, 32, 4)
+    assert np.isfinite(small).all()
