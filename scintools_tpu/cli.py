@@ -18,8 +18,6 @@ import glob
 import json
 import sys
 
-import numpy as np
-
 
 def _expand(patterns: list[str]) -> list[str]:
     from .utils import remove_duplicates
