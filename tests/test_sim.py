@@ -164,3 +164,30 @@ def test_ensemble_pads_to_chunk():
         jax.random.split(jax.random.PRNGKey(2), 3), p, screen_chunk=8))
     assert small.shape == (3, 32, 4)
     assert np.isfinite(small).all()
+
+
+@pytest.mark.slow
+def test_anisotropy_physics_through_full_chain():
+    """End-to-end physics: screen anisotropy (ar, psi) propagates through
+    simulate -> ACF -> tau fit.  Isotropic screens are exactly
+    psi-invariant; an ar=3 screen elongated along the scan (psi=90)
+    decorrelates several times slower than across it (psi=0)."""
+    from scintools_tpu.fit import fit_scint_params
+    from scintools_tpu.io import from_simulation
+    from scintools_tpu.ops import acf
+
+    def mean_tau(ar, psi, seeds=(1, 2, 3)):
+        taus = []
+        for s in seeds:
+            sim = Simulation(mb2=2, ns=128, nf=128, ar=ar, psi=psi,
+                             dlam=0.25, seed=s)
+            d = from_simulation(sim, freq=1400.0, dt=8.0)
+            a = acf(np.asarray(d.dyn, dtype=np.float64), backend="numpy")
+            sp = fit_scint_params(a, d.dt, d.df, d.nchan, d.nsub)
+            taus.append(float(sp.tau))
+        return np.mean(taus)
+
+    iso = mean_tau(1.0, 0) / mean_tau(1.0, 90)
+    assert iso == pytest.approx(1.0, abs=0.05)
+    aniso = mean_tau(3.0, 0) / mean_tau(3.0, 90)
+    assert aniso < 0.5, f"ar=3 tau ratio {aniso}, expected strong anisotropy"
