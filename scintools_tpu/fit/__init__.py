@@ -1,5 +1,6 @@
 from .arc_fit import (NormSspec, fit_arc, fit_arcs_multi,  # noqa: F401
                       make_arc_fitter, norm_sspec)
+from .curvature_fit import fit_arc_curvature  # noqa: F401
 from .filters import savgol1  # noqa: F401
 from .lm import (LsqResult, least_squares_numpy, lm_fit_batched,  # noqa: F401
                  lm_fit_jax)

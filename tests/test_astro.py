@@ -143,3 +143,49 @@ def test_curvature_physics_chain():
 
     res = least_squares(resid, x0=[0.5], bounds=([0.01], [0.99]))
     assert res.x[0] == pytest.approx(0.7, abs=0.03)
+
+
+def test_fit_arc_curvature_recovers_screen_params():
+    """Convenience screen fitter: recover (s, vism_psi) from noisy annual
+    curvatures on both engines (the reference leaves this workflow to
+    user scripts + lmfit)."""
+    from scintools_tpu.fit import fit_arc_curvature
+    from scintools_tpu.models.velocity import arc_curvature_model
+
+    pars = {"T0": 50000.0, "PB": 5.741, "ECC": 0.0879, "A1": 3.3667,
+            "OM": 1.0, "KIN": 42.4, "KOM": 207.0, "PMRA": 121.4,
+            "PMDEC": -71.5, "d": 0.157, "psi": 64.0}
+    raj, decj = 1.2098, -0.8243
+    mjds = 53000.0 + np.linspace(0, 365.25, 60)
+
+    nu = get_true_anomaly(mjds, pars)
+    v_ra, v_dec = get_earth_velocity(mjds, raj, decj)
+    truth = dict(pars, s=0.71, vism_psi=12.0)
+    eta = arc_curvature_model(truth, nu, v_ra, v_dec)
+    rng = np.random.default_rng(2)
+    eta_obs = eta * (1 + 0.03 * rng.standard_normal(len(mjds)))
+
+    start = dict(pars, s=0.4, vism_psi=0.0)
+    best, err, res = fit_arc_curvature(eta_obs, mjds, start, raj, decj,
+                                       fit_keys=("s", "vism_psi"),
+                                       etaerr=0.03 * eta)
+    assert best["s"] == pytest.approx(0.71, abs=0.03)
+    assert best["vism_psi"] == pytest.approx(12.0, abs=4.0)
+    assert err["s"] > 0
+
+    best_j, err_j, _ = fit_arc_curvature(eta_obs, mjds, start, raj, decj,
+                                         fit_keys=("s", "vism_psi"),
+                                         etaerr=0.03 * eta, backend="jax")
+    assert best_j["s"] == pytest.approx(best["s"], abs=0.02)
+    assert best_j["vism_psi"] == pytest.approx(best["vism_psi"], abs=2.0)
+
+
+def test_fit_arc_curvature_validates_keys():
+    from scintools_tpu.fit import fit_arc_curvature
+
+    with pytest.raises(ValueError, match="unknown fit key"):
+        fit_arc_curvature([1.0], [53000.0], {"d": 1, "s": 0.5}, 0, 0,
+                          fit_keys=("nope",))
+    with pytest.raises(ValueError, match="starting value"):
+        fit_arc_curvature([1.0], [53000.0], {"d": 1, "s": 0.5}, 0, 0,
+                          fit_keys=("vism_psi",))
