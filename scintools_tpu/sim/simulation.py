@@ -59,6 +59,16 @@ class SimParams:
     nf: int = 256
     dlam: float = 0.25     # fractional bandwidth
     lamsteps: bool = False
+    subharmonics: int = 0  # low-k compensation octaves (0 = reference
+    #                        behaviour).  FFT-synthesised screens miss all
+    #                        power below the fundamental grid frequency,
+    #                        which for steep Kolmogorov spectra truncates
+    #                        the large-scale structure function (see e.g.
+    #                        arXiv:2208.06060 and Lane et al. 1992).  Each
+    #                        octave adds the 8 modes at (p,q)*dq/3^o,
+    #                        |p|,|q|<=1, with spectrum-consistent weights.
+    #                        jax screen path only; the numpy path stays
+    #                        reference-exact and ignores this field.
 
 
 def derived_constants(p: SimParams) -> dict:
@@ -197,13 +207,19 @@ class Simulation:
     def __init__(self, mb2=2, rf=1, ds=0.01, alpha=5 / 3, ar=1, psi=0,
                  inner=0.001, ns=256, nf=256, dlam=0.25, lamsteps=False,
                  seed=None, nx=None, ny=None, dx=None, dy=None,
-                 verbose=False, backend: str = "numpy"):
+                 verbose=False, backend: str = "numpy",
+                 subharmonics: int = 0):
+        if subharmonics and backend != "jax":
+            raise ValueError(
+                "subharmonic low-k compensation is implemented on the jax "
+                "screen path only (the numpy path stays reference-exact); "
+                "pass backend='jax'")
         self.params = SimParams(
             mb2=mb2, rf=rf, dx=dx if dx is not None else ds,
             dy=dy if dy is not None else ds, alpha=alpha, ar=ar, psi=psi,
             inner=inner, nx=nx if nx is not None else ns,
             ny=ny if ny is not None else ns, nf=nf, dlam=dlam,
-            lamsteps=lamsteps)
+            lamsteps=lamsteps, subharmonics=int(subharmonics))
         # reference-compatible attribute aliases
         p = self.params
         self.mb2, self.rf, self.alpha, self.ar, self.psi = \
@@ -263,6 +279,27 @@ class Simulation:
 
 
 @functools.lru_cache(maxsize=None)
+def subharmonic_modes(p: SimParams) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side mode table for low-k screen compensation: wavenumbers
+    [M, 2] and amplitude weights [M] for ``p.subharmonics`` octaves of the
+    3x3 subharmonic scheme.  Weight = swdsp(k)/3^o: the amplitude carries
+    sqrt(cell area), and each octave's cells are (dq/3^o)^2."""
+    c = derived_constants(p)
+    ks, ws = [], []
+    for o in range(1, p.subharmonics + 1):
+        f = 3.0 ** -o
+        for pp in (-1, 0, 1):
+            for qq in (-1, 0, 1):
+                if pp == qq == 0:
+                    continue
+                kx, ky = pp * c["dqx"] * f, qq * c["dqy"] * f
+                ks.append((kx, ky))
+                ws.append(float(_swdsp(p, c["consp"], kx, ky, xp=np)) * f)
+    return (np.asarray(ks, dtype=np.float64),
+            np.asarray(ws, dtype=np.float64))
+
+
+@functools.lru_cache(maxsize=None)
 def _simulate_jax(p: SimParams, return_screen: bool, freq_chunk: int | None):
     import jax
     import jax.numpy as jnp
@@ -274,6 +311,11 @@ def _simulate_jax(p: SimParams, return_screen: bool, freq_chunk: int | None):
     filt_consts = derived_constants(p)
     qx2 = np.asarray(_abs_freq_index(p.nx)) ** 2 * filt_consts["ffconx"]
     qy2 = np.asarray(_abs_freq_index(p.ny)) ** 2 * filt_consts["ffcony"]
+    if p.subharmonics:
+        sub_k, sub_w = subharmonic_modes(p)
+        # mode phase on the spatial grid (x = i*dx): [M, nx], [M, ny]
+        sub_px = sub_k[:, 0:1] * (np.arange(p.nx) * p.dx)[None, :]
+        sub_py = sub_k[:, 1:2] * (np.arange(p.ny) * p.dy)[None, :]
 
     def one_freq(xyp, scale):
         q2 = (qx2[:, None] + qy2[None, :]) * scale
@@ -287,6 +329,22 @@ def _simulate_jax(p: SimParams, return_screen: bool, freq_chunk: int | None):
         z = (jax.random.normal(kr, (p.nx, p.ny))
              + 1j * jax.random.normal(ki, (p.nx, p.ny)))
         xyp = jnp.real(jnp.fft.fft2(w * z))
+        if p.subharmonics:
+            ks1, ks2 = jax.random.split(jax.random.fold_in(key, 7))
+            M = sub_w.shape[0]
+            gr = jax.random.normal(ks1, (M,))
+            gi = jax.random.normal(ks2, (M,))
+            # Re[w g e^{i(kx x + ky y)}] summed over modes, as separable
+            # outer products (cheap: M ~ 8*octaves modes)
+            cx, sx = jnp.cos(sub_px), jnp.sin(sub_px)  # [M, nx]
+            cy, sy = jnp.cos(sub_py), jnp.sin(sub_py)  # [M, ny]
+            wgr = sub_w * gr
+            wgi = sub_w * gi
+            xyp = xyp + (
+                jnp.einsum("m,mx,my->xy", wgr, cx, cy)
+                - jnp.einsum("m,mx,my->xy", wgr, sx, sy)
+                - jnp.einsum("m,mx,my->xy", wgi, sx, cy)
+                - jnp.einsum("m,mx,my->xy", wgi, cx, sy))
         if freq_chunk is None or freq_chunk >= p.nf:
             spe = jax.vmap(one_freq, in_axes=(None, 0), out_axes=1)(
                 xyp, scales)
