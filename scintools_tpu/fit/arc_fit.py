@@ -211,6 +211,27 @@ def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
     """Find the arc curvature maximising power along ``tdel = eta fdop^2``
     (dynspec.py:414-785, compute only; primary arc)."""
     backend = resolve(backend)
+    if method == "thetatheta":
+        # eigenvector-based measurement (beyond-reference; see
+        # fit.thetatheta): needs an explicit eta bracket, further
+        # narrowed by any constraint window
+        from .thetatheta import fit_arc_thetatheta
+
+        if etamin is None or etamax is None:
+            raise ValueError("method='thetatheta' needs explicit "
+                             "etamin/etamax bracketing the arc")
+        lo = max(float(etamin), float(constraint[0]))
+        hi = min(float(etamax), float(constraint[1]))
+        if not lo < hi:
+            raise ValueError(f"empty eta bracket after intersecting "
+                             f"[{etamin}, {etamax}] with constraint "
+                             f"{tuple(constraint)}")
+        eta, etaerr, etas, conc = fit_arc_thetatheta(
+            sec, lo, hi, n_eta=int(numsteps), startbin=startbin,
+            cutmid=cutmid, backend=backend)
+        return ArcFit(eta=eta, etaerr=etaerr, etaerr2=etaerr,
+                      lamsteps=sec.lamsteps, profile_eta=etas,
+                      profile_power=conc, profile_power_filt=conc)
     if backend == "jax" and method in ("norm_sspec", "gridmax"):
         fitter = make_arc_fitter(
             fdop=np.asarray(sec.fdop), yaxis=np.asarray(
@@ -657,6 +678,19 @@ def fit_arcs_multi(sec: SecSpec, freq: float, brackets,
     """
     brackets = [(0.0 if lo is None else float(lo),
                  np.inf if hi is None else float(hi))
+                for lo, hi in brackets]
+    if method == "thetatheta":
+        # each arc is its own bounded eigen-sweep: no shared profile to
+        # reuse, and the bracket must be finite
+        for lo, hi in brackets:
+            if not (np.isfinite(lo) and np.isfinite(hi) and lo > 0):
+                raise ValueError("thetatheta multi-arc brackets must be "
+                                 "finite positive (lo, hi) windows")
+        return [fit_arc(sec, freq, method=method, backend=backend,
+                        etamin=lo, etamax=hi,
+                        low_power_diff=low_power_diff,
+                        high_power_diff=high_power_diff,
+                        noise_error=noise_error, **kw)
                 for lo, hi in brackets]
     # one full-profile fit (first bracket as the constraint just to get a
     # valid measurement); its profile/filter/noise are then re-measured

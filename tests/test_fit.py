@@ -442,3 +442,82 @@ def test_fit_arc_bit_matches_reference_end_to_end():
 
     np.testing.assert_allclose(ds.betaeta, rd.betaeta, rtol=1e-10)
     np.testing.assert_allclose(ds.betaetaerr, rd.betaetaerr, rtol=1e-10)
+
+
+def test_thetatheta_recovers_curvature_both_backends():
+    """Eigenvalue-concentration curvature (beyond-reference method):
+    recovers the true eta on a synthetic arc, backends agree, and the
+    concentration peaks at the arc."""
+    from scintools_tpu.fit import fit_arc_thetatheta
+
+    sec = _arc_secspec(eta=0.6)
+    eta_np, err_np, etas, conc = fit_arc_thetatheta(sec, 0.1, 5.0,
+                                                    n_eta=64,
+                                                    backend="numpy")
+    eta_j, err_j, _, conc_j = fit_arc_thetatheta(sec, 0.1, 5.0, n_eta=64,
+                                                 backend="jax")
+    assert eta_np == pytest.approx(0.6, rel=0.1)
+    assert eta_j == pytest.approx(eta_np, rel=0.05)
+    np.testing.assert_allclose(conc_j, conc, rtol=2e-3, atol=2e-3)
+    assert err_np > 0
+    # the concentration curve peaks near the true arc, not at the edges
+    assert 0.3 < etas[np.argmax(conc)] < 1.2
+
+
+def test_thetatheta_via_fit_arc_dispatch():
+    from scintools_tpu.fit import fit_arc
+
+    sec = _arc_secspec(eta=0.6)
+    fit = fit_arc(sec, freq=1400.0, method="thetatheta", etamin=0.1,
+                  etamax=5.0, numsteps=64)
+    assert float(fit.eta) == pytest.approx(0.6, rel=0.1)
+    with pytest.raises(ValueError, match="etamin/etamax"):
+        fit_arc(sec, freq=1400.0, method="thetatheta")
+
+
+def test_thetatheta_on_simulated_spectrum():
+    """On a realistic simulated epoch the theta-theta eta lands in the
+    same range as the norm_sspec measurement."""
+    from scintools_tpu import Dynspec
+    from scintools_tpu.fit import fit_arc_thetatheta
+    from scintools_tpu.io import from_simulation
+    from scintools_tpu.sim import Simulation
+
+    d = from_simulation(Simulation(mb2=2, ns=128, nf=128, dlam=0.25,
+                                   seed=1234), freq=1400.0, dt=8.0)
+    ds = Dynspec(data=d, process=True, lamsteps=True)
+    ds.fit_arc(lamsteps=True, numsteps=2000)   # norm_sspec: ~12.3
+    sec = ds._secspec(True)
+    eta_tt, err_tt, _, _ = fit_arc_thetatheta(
+        sec, ds.betaeta / 4, ds.betaeta * 4, n_eta=96)
+    assert eta_tt == pytest.approx(ds.betaeta, rel=0.6)
+
+
+def test_thetatheta_multi_arc_and_kwargs():
+    """Multi-arc thetatheta runs one bounded sweep per bracket; cutmid/
+    startbin forward; constraint narrows the bracket."""
+    from scintools_tpu.fit.arc_fit import fit_arcs_multi
+
+    fdop = np.linspace(-10, 10, 256)
+    tdel = np.linspace(0, 40, 128)
+    power = np.full((128, 256), 1e-3)
+    for eta_true in (0.3, 2.0):
+        for j, f in enumerate(fdop):
+            t = eta_true * f ** 2
+            i = np.argmin(np.abs(tdel - t))
+            if t <= tdel[-1]:
+                power[max(i - 1, 0): i + 2, j] += 1.0
+    sec_db = 10 * np.log10(power)
+    sec = SecSpec(sspec=sec_db, fdop=fdop, tdel=tdel, beta=tdel,
+                  lamsteps=True)
+    fits = fit_arcs_multi(sec, 1400.0, brackets=[(0.1, 0.9), (0.9, 6.0)],
+                          method="thetatheta", numsteps=64)
+    assert float(fits[0].eta) == pytest.approx(0.3, rel=0.25)
+    assert float(fits[1].eta) == pytest.approx(2.0, rel=0.25)
+    # constraint intersects the bracket
+    f2 = fit_arc(sec, 1400.0, method="thetatheta", etamin=0.1, etamax=6.0,
+                 numsteps=64, constraint=(0.9, 6.0))
+    assert float(f2.eta) == pytest.approx(2.0, rel=0.25)
+    with pytest.raises(ValueError, match="empty eta bracket"):
+        fit_arc(sec, 1400.0, method="thetatheta", etamin=0.1, etamax=0.5,
+                constraint=(1.0, 2.0))
