@@ -249,3 +249,45 @@ def plot_efield(sim, ax=None, filename: str | None = None,
     ax.set_ylabel("Position")
     fig.colorbar(mesh, ax=ax, label="Re E")
     return _finish(fig, filename, display)
+
+
+def plot_thetatheta(sec: SecSpec, eta: float, ntheta: int = 129,
+                    theta_max: float | None = None, startbin: int = 3,
+                    cutmid: int = 3, conc_curve=None, ax=None,
+                    filename: str | None = None, display: bool = False):
+    """Theta-theta map at curvature ``eta`` (fit.thetatheta), optionally
+    with the eta concentration curve as an inset panel.  Pass the same
+    theta_max/startbin/cutmid used for the fit so the rendered map is the
+    one the measurement actually saw."""
+    import matplotlib.pyplot as plt
+
+    from .fit.thetatheta import theta_theta_map
+
+    M = theta_theta_map(sec, eta, ntheta=ntheta, theta_max=theta_max,
+                        startbin=startbin, cutmid=cutmid)
+    if ax is None:
+        fig, ax = plt.subplots(figsize=(7, 6))
+    else:
+        fig = ax.figure
+    with np.errstate(divide="ignore"):
+        img = 10 * np.log10(M ** 2)  # back to power dB for display
+    finite = img[np.isfinite(img)]
+    if finite.size:
+        vmin, vmax = np.percentile(finite, [5, 99.9])
+    else:
+        vmin = vmax = None
+    mesh = ax.imshow(img, origin="lower", cmap="viridis", vmin=vmin,
+                     vmax=vmax, extent=(-1, 1, -1, 1))
+    ax.set_xlabel(r"$\theta_2$ / $\theta_{max}$")
+    ax.set_ylabel(r"$\theta_1$ / $\theta_{max}$")
+    ax.set_title(rf"$\theta$-$\theta$ @ $\eta$={eta:.3g}")
+    fig.colorbar(mesh, ax=ax, label="Power (dB)")
+    if conc_curve is not None:
+        etas, conc = conc_curve
+        ins = ax.inset_axes([0.62, 0.72, 0.35, 0.25])
+        ins.semilogx(etas, conc, "w-", lw=1)
+        ins.axvline(eta, color="r", lw=0.8)
+        ins.set_xticks([])
+        ins.set_yticks([])
+        ins.patch.set_alpha(0.25)
+    return _finish(fig, filename, display)

@@ -67,3 +67,17 @@ def test_sim_views(ds, tmp_path):
         fig = fn(sim, filename=str(tmp_path / f"{name}.png"))
         assert (tmp_path / f"{name}.png").stat().st_size > 0
         plt.close(fig)
+
+
+def test_plot_thetatheta(ds, tmp_path):
+    from scintools_tpu.fit import fit_arc_thetatheta
+
+    if ds.betaeta is None:  # self-contained: don't rely on test order
+        ds.fit_arc(lamsteps=True, numsteps=2000)
+    sec = ds._secspec(True)
+    eta, err, etas, conc = fit_arc_thetatheta(
+        sec, ds.betaeta / 3, ds.betaeta * 3, n_eta=32, backend="numpy")
+    fig = plotting.plot_thetatheta(sec, eta, conc_curve=(etas, conc),
+                                   filename=str(tmp_path / "tt.png"))
+    assert (tmp_path / "tt.png").stat().st_size > 0
+    plt.close(fig)
