@@ -68,24 +68,32 @@ def load_nudft():
         path = build_nudft()
         if path is None:
             return None
-        lib = ctypes.CDLL(path)
-        lib.scint_nudft.restype = None
-        lib.scint_nudft.argtypes = [
-            ctypes.c_int64,   # ntime
-            ctypes.c_int64,   # nfreq
-            ctypes.c_int64,   # nr
-            ctypes.c_double,  # r0
-            ctypes.c_double,  # dr
-            ndpointer(dtype=np.float64, flags="C_CONTIGUOUS", ndim=1),  # fscale
-            ndpointer(dtype=np.float64, flags="C_CONTIGUOUS", ndim=1),  # tsrc
-            ctypes.c_int,     # tsrc_uniform
-            ndpointer(dtype=np.float64, flags="C_CONTIGUOUS", ndim=2),  # power
-            ndpointer(dtype=np.complex128, flags="C_CONTIGUOUS", ndim=2),  # out
-        ]
-        lib.scint_nudft_has_openmp.restype = ctypes.c_int
-        lib.scint_nudft_has_openmp.argtypes = []
+        lib = bind_nudft(path)
         _cached_lib = lib
         return lib
+
+
+def bind_nudft(path: str):
+    """CDLL-load a scint_nudft library and attach the one true ABI
+    signature — shared by the production loader and the sanitizer script
+    (scripts/sanitize_native.sh) so they can never drift apart."""
+    lib = ctypes.CDLL(path)
+    lib.scint_nudft.restype = None
+    lib.scint_nudft.argtypes = [
+        ctypes.c_int64,   # ntime
+        ctypes.c_int64,   # nfreq
+        ctypes.c_int64,   # nr
+        ctypes.c_double,  # r0
+        ctypes.c_double,  # dr
+        ndpointer(dtype=np.float64, flags="C_CONTIGUOUS", ndim=1),  # fscale
+        ndpointer(dtype=np.float64, flags="C_CONTIGUOUS", ndim=1),  # tsrc
+        ctypes.c_int,     # tsrc_uniform
+        ndpointer(dtype=np.float64, flags="C_CONTIGUOUS", ndim=2),  # power
+        ndpointer(dtype=np.complex128, flags="C_CONTIGUOUS", ndim=2),  # out
+    ]
+    lib.scint_nudft_has_openmp.restype = ctypes.c_int
+    lib.scint_nudft_has_openmp.argtypes = []
+    return lib
 
 
 def nudft_native(power: np.ndarray, fscale: np.ndarray, tsrc: np.ndarray,

@@ -28,6 +28,15 @@ def _finish(fig, filename: str | None, display: bool):
     return fig
 
 
+def _pclim(arr):
+    """Robust dB colour limits: 5th-99.9th percentile of finite values
+    (None, None when nothing is finite — matplotlib autoscales)."""
+    finite = arr[np.isfinite(arr)]
+    if finite.size == 0:
+        return None, None
+    return tuple(np.percentile(finite, [5, 99.9]))
+
+
 def _clim(arr, nsig_lo: float = 3, nsig_hi: float = 5):
     """Median +- sigma colour limits, the reference's robust scaling
     (dynspec.py:234-238: median +- 2/5 x MAD-derived std)."""
@@ -116,8 +125,7 @@ def plot_sspec(sec: SecSpec, eta: float | None = None, ax=None,
         fig, ax = plt.subplots(figsize=(8, 6))
     else:
         fig = ax.figure
-    finite = s[np.isfinite(s)]
-    vmin, vmax = np.percentile(finite, [5, 99.9])
+    vmin, vmax = _pclim(s)
     keep = np.abs(fdop) <= maxfdop
     mesh = ax.pcolormesh(fdop[keep], yaxis, s[:, keep], vmin=vmin,
                          vmax=vmax, cmap=cmap, shading="auto")
@@ -194,6 +202,44 @@ def plot_all(d: DynspecData, acf2d, sec: SecSpec, fit=None,
     return _finish(fig, filename, display)
 
 
+def plot_thetatheta(sec: SecSpec, eta: float, ntheta: int = 129,
+                    theta_max: float | None = None, startbin: int = 3,
+                    cutmid: int = 3, conc_curve=None, ax=None,
+                    filename: str | None = None, display: bool = False):
+    """Theta-theta map at curvature ``eta`` (fit.thetatheta), optionally
+    with the eta concentration curve as an inset panel.  Pass the same
+    theta_max/startbin/cutmid used for the fit so the rendered map is the
+    one the measurement actually saw."""
+    import matplotlib.pyplot as plt
+
+    from .fit.thetatheta import theta_theta_map
+
+    M = theta_theta_map(sec, eta, ntheta=ntheta, theta_max=theta_max,
+                        startbin=startbin, cutmid=cutmid)
+    if ax is None:
+        fig, ax = plt.subplots(figsize=(7, 6))
+    else:
+        fig = ax.figure
+    with np.errstate(divide="ignore"):
+        img = 10 * np.log10(M ** 2)  # back to power dB for display
+    vmin, vmax = _pclim(img)
+    mesh = ax.imshow(img, origin="lower", cmap="viridis", vmin=vmin,
+                     vmax=vmax, extent=(-1, 1, -1, 1))
+    ax.set_xlabel(r"$\theta_2$ / $\theta_{max}$")
+    ax.set_ylabel(r"$\theta_1$ / $\theta_{max}$")
+    ax.set_title(rf"$\theta$-$\theta$ @ $\eta$={eta:.3g}")
+    fig.colorbar(mesh, ax=ax, label="Power (dB)")
+    if conc_curve is not None:
+        etas, conc = conc_curve
+        ins = ax.inset_axes([0.62, 0.72, 0.35, 0.25])
+        ins.semilogx(etas, conc, "w-", lw=1)
+        ins.axvline(eta, color="r", lw=0.8)
+        ins.set_xticks([])
+        ins.set_yticks([])
+        ins.patch.set_alpha(0.25)
+    return _finish(fig, filename, display)
+
+
 # -- simulation views (scint_sim.py:266-335) --------------------------------
 
 def plot_screen(sim, ax=None, filename: str | None = None,
@@ -248,46 +294,4 @@ def plot_efield(sim, ax=None, filename: str | None = None,
     ax.set_xlabel("Frequency channel")
     ax.set_ylabel("Position")
     fig.colorbar(mesh, ax=ax, label="Re E")
-    return _finish(fig, filename, display)
-
-
-def plot_thetatheta(sec: SecSpec, eta: float, ntheta: int = 129,
-                    theta_max: float | None = None, startbin: int = 3,
-                    cutmid: int = 3, conc_curve=None, ax=None,
-                    filename: str | None = None, display: bool = False):
-    """Theta-theta map at curvature ``eta`` (fit.thetatheta), optionally
-    with the eta concentration curve as an inset panel.  Pass the same
-    theta_max/startbin/cutmid used for the fit so the rendered map is the
-    one the measurement actually saw."""
-    import matplotlib.pyplot as plt
-
-    from .fit.thetatheta import theta_theta_map
-
-    M = theta_theta_map(sec, eta, ntheta=ntheta, theta_max=theta_max,
-                        startbin=startbin, cutmid=cutmid)
-    if ax is None:
-        fig, ax = plt.subplots(figsize=(7, 6))
-    else:
-        fig = ax.figure
-    with np.errstate(divide="ignore"):
-        img = 10 * np.log10(M ** 2)  # back to power dB for display
-    finite = img[np.isfinite(img)]
-    if finite.size:
-        vmin, vmax = np.percentile(finite, [5, 99.9])
-    else:
-        vmin = vmax = None
-    mesh = ax.imshow(img, origin="lower", cmap="viridis", vmin=vmin,
-                     vmax=vmax, extent=(-1, 1, -1, 1))
-    ax.set_xlabel(r"$\theta_2$ / $\theta_{max}$")
-    ax.set_ylabel(r"$\theta_1$ / $\theta_{max}$")
-    ax.set_title(rf"$\theta$-$\theta$ @ $\eta$={eta:.3g}")
-    fig.colorbar(mesh, ax=ax, label="Power (dB)")
-    if conc_curve is not None:
-        etas, conc = conc_curve
-        ins = ax.inset_axes([0.62, 0.72, 0.35, 0.25])
-        ins.semilogx(etas, conc, "w-", lw=1)
-        ins.axvline(eta, color="r", lw=0.8)
-        ins.set_xticks([])
-        ins.set_yticks([])
-        ins.patch.set_alpha(0.25)
     return _finish(fig, filename, display)
