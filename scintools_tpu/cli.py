@@ -52,6 +52,13 @@ def cmd_process(args) -> int:
     timers = StageTimers()
     files = _expand(args.files)
     store = ResultsStore(args.store) if args.store else None
+    if args.batched and args.backend != "jax":
+        # the batched engine IS the jax pipeline; record that truthfully
+        # in the resume key rather than diverging silently
+        log_event(log, "note",
+                  msg="--batched runs the jax device pipeline; "
+                      "backend set to jax")
+        args.backend = "jax"
     cfg = ("process", args.lamsteps, args.backend, not args.no_arc,
            not args.no_scint)
     if args.plots:
@@ -63,6 +70,11 @@ def cmd_process(args) -> int:
         log_event(log, "resume", total=len(files), todo=len(todo),
                   done=len(files) - len(todo))
         files = todo
+    if args.batched:
+        if args.plots:
+            raise SystemExit("--batched does not render per-epoch plots; "
+                             "drop --plots or run without --batched")
+        return _process_batched(args, files, cfg, store, log, timers)
     failed = 0
     for fn in files:
         try:
@@ -100,6 +112,87 @@ def cmd_process(args) -> int:
         store.export_csv(args.results)
     print(timers.report(), file=sys.stderr)
     log_event(log, "done", processed=len(files) - failed, failed=failed)
+    return 0 if failed == 0 else 1
+
+
+def _process_batched(args, files, cfg, store, log, timers) -> int:
+    """Batched engine for cmd_process: trim/refill host-side, then ONE
+    jit-compiled step per shape bucket over the device mesh
+    (parallel.run_pipeline) instead of a per-file Python loop."""
+    import os
+
+    import numpy as np
+
+    from .io.psrflux import read_psrflux
+    from .io.results import results_row, write_results
+    from .ops.clean import refill, trim_edges
+    from .parallel import PipelineConfig, make_mesh, run_pipeline
+    from .utils import content_key, log_event
+
+    epochs, names, failed = [], [], 0
+    with timers.stage("load+clean"):
+        for fn in files:
+            try:
+                d = refill(trim_edges(read_psrflux(fn)))
+                if d.nchan < 2 or d.nsub < 2:
+                    raise ValueError(
+                        f"degenerate after trim: {d.nchan}x{d.nsub}")
+                epochs.append(d)
+                names.append(fn)
+            except Exception as e:
+                failed += 1
+                log_event(log, "epoch_failed", file=fn, error=repr(e))
+    processed = 0
+    if epochs:
+        pcfg = PipelineConfig(lamsteps=args.lamsteps,
+                              fit_arc=not args.no_arc,
+                              fit_scint=not args.no_scint)
+        try:
+            with timers.stage("batched_pipeline"):
+                buckets = run_pipeline(epochs, pcfg, mesh=make_mesh())
+        except Exception as e:
+            log_event(log, "pipeline_failed", error=repr(e),
+                      epochs=len(epochs))
+            failed += len(epochs)
+            buckets = []
+        for indices, res in buckets:
+            for lane, idx in enumerate(indices):
+                row = results_row(epochs[idx])
+                if res.scint is not None:
+                    row.update(
+                        tau=float(np.asarray(res.scint.tau)[lane]),
+                        tauerr=float(np.asarray(res.scint.tauerr)[lane]),
+                        dnu=float(np.asarray(res.scint.dnu)[lane]),
+                        dnuerr=float(np.asarray(res.scint.dnuerr)[lane]))
+                if res.arc is not None:
+                    key = "betaeta" if args.lamsteps else "eta"
+                    row[key] = float(np.asarray(res.arc.eta)[lane])
+                    row[key + "err"] = float(
+                        np.asarray(res.arc.etaerr)[lane])
+                # NaN lanes are FAILED fits: quarantine (no CSV row, no
+                # store entry -> retried on resume), as the per-file loop
+                # does via exceptions
+                fitvals = [v for k, v in row.items()
+                           if k in ("tau", "dnu", "eta", "betaeta")]
+                if fitvals and not np.all(np.isfinite(fitvals)):
+                    failed += 1
+                    log_event(log, "epoch_failed", file=names[idx],
+                              error="non-finite fit (NaN lane)")
+                    continue
+                # basename, matching the per-file loop's CSV name column
+                row["name"] = os.path.basename(names[idx])
+                if args.results:
+                    write_results(args.results, row)
+                if store is not None:
+                    store.put(content_key(names[idx], cfg), row)
+                processed += 1
+                log_event(log, "epoch", file=names[idx],
+                          tau=row.get("tau"),
+                          eta=row.get("betaeta", row.get("eta")))
+    if store is not None and args.results:
+        store.export_csv(args.results)
+    print(timers.report(), file=sys.stderr)
+    log_event(log, "done", processed=processed, failed=failed)
     return 0 if failed == 0 else 1
 
 
@@ -175,6 +268,9 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--plots", help="write summary plots to this dir")
     q.add_argument("--no-arc", action="store_true")
     q.add_argument("--no-scint", action="store_true")
+    q.add_argument("--batched", action="store_true",
+                   help="one jit-compiled step per shape bucket over the "
+                        "device mesh instead of a per-file loop")
     q.set_defaults(fn=cmd_process)
 
     q = sub.add_parser("sort", help="triage files into good/bad lists")

@@ -19,7 +19,7 @@ g++ -O1 -g -fsanitize=address,undefined -fno-omit-frame-pointer -fopenmp \
 
 ASAN_LIB=$(g++ -print-file-name=libasan.so)
 ASAN_OPTIONS=detect_leaks=0 LD_PRELOAD="$ASAN_LIB" \
-PYTHONPATH="$PWD" LIB="$WORK/libnudft_san.so" python - <<'EOF'
+PYTHONPATH="$PWD" LIB="$WORK/libnudft_san.so" python3 - <<'EOF'
 import os
 import numpy as np
 
