@@ -94,6 +94,33 @@ def default_float(backend: str):
     return jnp.zeros(0).dtype
 
 
+def force_host_cpu_devices(n: int) -> None:
+    """Force the CPU platform with ``n`` virtual XLA host devices.
+
+    Used by the test harness and the multi-chip dry run to validate
+    mesh/shard_map code without TPU hardware (SURVEY.md §4.5).  The axon
+    sitecustomize imports jax at interpreter boot with JAX_PLATFORMS=axon,
+    so env vars set by a caller can arrive too late; we both rewrite
+    XLA_FLAGS (read at backend initialisation) and switch the platform
+    through the config (backends initialise lazily, so this wins as long
+    as no jax.devices() call has happened yet in the process).
+    """
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    count = max(n, int(m.group(1))) if m else n
+    opt = f"--xla_force_host_platform_device_count={count}"
+    if m:
+        flags = flags[:m.start()] + opt + flags[m.end():]
+    else:
+        flags = (flags + " " + opt).strip()
+    os.environ["XLA_FLAGS"] = flags
+
+    jax, _ = _jax_modules()
+    jax.config.update("jax_platforms", "cpu")
+
+
 def jit(fun=None, **kwargs):
     """``jax.jit`` that is importable without jax (used at call time only)."""
     if fun is None:

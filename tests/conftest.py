@@ -3,21 +3,13 @@ testable without TPU hardware (SURVEY.md §4.5), and float64 enabled so the
 jax path can be compared against the reference-compatible numpy path at
 tight tolerances."""
 
-import os
+# Must run before any jax backend initialises in the test process.
+from scintools_tpu.backend import force_host_cpu_devices
 
-# Must run before jax is first imported anywhere in the test process.
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
+force_host_cpu_devices(8)
 
 import jax  # noqa: E402
 
-# The axon sitecustomize imports jax at interpreter boot with
-# JAX_PLATFORMS=axon, so the env var alone is too late — switch the platform
-# through the config (backends initialise lazily, so this still wins).
-jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import numpy as np  # noqa: E402
