@@ -24,6 +24,10 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from scintools_tpu.backend import honor_platform_env  # noqa: E402
+
+honor_platform_env()  # make JAX_PLATFORMS=cpu reliable under axon
+
 import numpy as np  # noqa: E402
 
 

@@ -121,6 +121,22 @@ def force_host_cpu_devices(n: int) -> None:
     jax.config.update("jax_platforms", "cpu")
 
 
+def honor_platform_env() -> None:
+    """Apply ``JAX_PLATFORMS`` through jax's config (idempotent).
+
+    Under the axon sitecustomize the env var alone is unreliable: the
+    plugin is registered at interpreter boot, and backend discovery can
+    still touch the (possibly unreachable) TPU tunnel even when the env
+    asks for cpu.  Routing the same choice through ``jax.config`` makes
+    ``JAX_PLATFORMS=cpu python ...`` actually local-only.  Call before
+    the first ``jax.devices()`` (entry points: CLI, examples).
+    """
+    plat = os.environ.get("JAX_PLATFORMS", "").strip()
+    if plat and jax_available():
+        jax, _ = _jax_modules()
+        jax.config.update("jax_platforms", plat)
+
+
 def jit(fun=None, **kwargs):
     """``jax.jit`` that is importable without jax (used at call time only)."""
     if fun is None:

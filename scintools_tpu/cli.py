@@ -308,6 +308,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
+    from .backend import honor_platform_env
+
+    honor_platform_env()
     args = build_parser().parse_args(argv)
     return args.fn(args)
 

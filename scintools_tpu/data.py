@@ -171,6 +171,14 @@ class ArcFit:
     profile_power: Any = None    # mean power along arcs (dB)
     profile_power_filt: Any = None
     noise: Any = None            # noise level used by the error walk
+    # per-arm measurement (asymm=True, gridmax): the reference plumbs an
+    # ``asymm`` flag and computes etaL/etaR but a copy-paste bug feeds the
+    # combined profile to both arms (dynspec.py:567-568) and never returns
+    # them; here the left/right fdop arms are fitted independently
+    eta_left: Any = None
+    etaerr_left: Any = None
+    eta_right: Any = None
+    etaerr_right: Any = None
 
 
 def _register_result_pytrees():
@@ -183,7 +191,9 @@ def _register_result_pytrees():
              ("tau", "tauerr", "dnu", "dnuerr", "talpha", "talphaerr", "amp",
               "wn", "redchi"), ()),
             (ArcFit, ("eta", "etaerr", "etaerr2", "profile_eta",
-                      "profile_power", "profile_power_filt", "noise"),
+                      "profile_power", "profile_power_filt", "noise",
+                      "eta_left", "etaerr_left", "eta_right",
+                      "etaerr_right"),
              ("lamsteps",)),
         ):
             def fl(obj, _lf=leaf_fields, _af=aux_fields):

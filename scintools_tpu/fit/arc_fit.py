@@ -201,16 +201,44 @@ def _measure_peak(eta_array, power, filt, noise, constraint,
                   noise=noise)
 
 
+def _attach_arms(fit: ArcFit, left_fn, right_fn) -> ArcFit:
+    """Attach independent left/right-arm measurements to a combined fit.
+    A degenerate arm (forward parabola / too-short profile) yields NaN for
+    that arm rather than failing the primary measurement."""
+    def _arm(fn):
+        try:
+            f = fn()
+            return float(f.eta), float(f.etaerr)
+        except ValueError:
+            return float("nan"), float("nan")
+
+    el, eel = _arm(left_fn)
+    er, eer = _arm(right_fn)
+    return dataclasses.replace(fit, eta_left=el, etaerr_left=eel,
+                               eta_right=er, etaerr_right=eer)
+
+
 def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
             delmax=None, numsteps: int = 10000, startbin: int = 3,
             cutmid: int = 3, etamax=None, etamin=None,
             low_power_diff: float = -3.0, high_power_diff: float = -1.5,
             ref_freq: float = 1400.0, constraint=(0, np.inf),
-            nsmooth: int = 5, noise_error: bool = True,
+            nsmooth: int = 5, noise_error: bool = True, asymm: bool = False,
             backend: str = "numpy") -> ArcFit:
     """Find the arc curvature maximising power along ``tdel = eta fdop^2``
-    (dynspec.py:414-785, compute only; primary arc)."""
+    (dynspec.py:414-785, compute only; primary arc).
+
+    ``asymm=True`` additionally fits the left and right fdop arms
+    independently (``eta_left/eta_right`` on the result).  The reference
+    plumbs this flag but a copy-paste bug feeds the combined profile to
+    both arm fits (dynspec.py:567-568) and the per-arm values are only
+    plotted, never returned — completed here (numpy backend)."""
     backend = resolve(backend)
+    if asymm and method == "thetatheta":
+        raise ValueError("asymm=True is not meaningful for "
+                         "method='thetatheta' (the theta-theta transform "
+                         "uses both arms jointly); use 'gridmax' or "
+                         "'norm_sspec'")
     if method == "thetatheta":
         # eigenvector-based measurement (beyond-reference; see
         # fit.thetatheta): needs an explicit eta bracket, further
@@ -232,7 +260,10 @@ def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
         return ArcFit(eta=eta, etaerr=etaerr, etaerr2=etaerr,
                       lamsteps=sec.lamsteps, profile_eta=etas,
                       profile_power=conc, profile_power_filt=conc)
-    if backend == "jax" and method in ("norm_sspec", "gridmax"):
+    # asymm is a per-epoch diagnostic -> numpy path (the batched jax fitter
+    # measures the combined profile only)
+    if backend == "jax" and not asymm and method in ("norm_sspec",
+                                                     "gridmax"):
         fitter = make_arc_fitter(
             fdop=np.asarray(sec.fdop), yaxis=np.asarray(
                 sec.beta if sec.lamsteps else sec.tdel),
@@ -299,23 +330,30 @@ def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
         etafrac = np.linspace(-1, 1, n)
         ipos = np.argwhere(etafrac > 1 / (2 * n))
         ineg = np.argwhere(etafrac < -1 / (2 * n))
-        avg = (prof[ipos] + np.flip(prof[ineg], axis=0)) / 2
-        avg = avg.squeeze()
-        etafrac_avg = 1 / etafrac[ipos].squeeze()
-        valid = np.isfinite(avg) * (~np.isnan(avg))
-        avg = np.flip(avg[valid], axis=0)
-        etafrac_avg = np.flip(etafrac_avg[valid], axis=0)
+        etafrac_pos = 1 / etafrac[ipos].squeeze()
 
-        eta_array = etamin * etafrac_avg ** 2
-        keep = np.argwhere(eta_array < etamax)
-        eta_array = eta_array[keep].squeeze()
-        avg = avg[keep].squeeze()
+        def _measure_arm(arm_prof, log_fit=False):
+            a = arm_prof.squeeze()
+            valid = np.isfinite(a) * (~np.isnan(a))
+            a = np.flip(a[valid], axis=0)
+            ef = np.flip(etafrac_pos[valid], axis=0)
+            ea = etamin * ef ** 2
+            keep = np.argwhere(ea < etamax)
+            ea = ea[keep].squeeze()
+            a = a[keep].squeeze()
+            _check_profile_size(a, nsmooth)
+            filt = savgol_filter(a, nsmooth, 1)
+            return _measure_peak(ea, a, filt, noise, constraint,
+                                 low_power_diff, high_power_diff,
+                                 noise_error, lamsteps, log_fit=log_fit)
 
-        _check_profile_size(avg, nsmooth)
-        filt = savgol_filter(avg, nsmooth, 1)
-        return _measure_peak(eta_array, avg, filt, noise, constraint,
-                             low_power_diff, high_power_diff, noise_error,
-                             lamsteps, log_fit=False)
+        fit = _measure_arm((prof[ipos] + np.flip(prof[ineg], axis=0)) / 2)
+        if asymm:
+            fit = _attach_arms(fit,
+                               lambda: _measure_arm(np.flip(prof[ineg],
+                                                            axis=0)),
+                               lambda: _measure_arm(prof[ipos]))
+        return fit
 
     if method == "gridmax":
         x, y, z = fdop, yaxis_cut, sspec
@@ -332,14 +370,22 @@ def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
                 zn = map_coordinates(z, coords, order=1, cval=np.nan)
                 store.append(np.mean(zn[~np.isnan(zn)]))
         eta_array = np.array(eta_list)
-        sumpow = (np.array(sumpow_l) + np.array(sumpow_r)) / 2
-        ok = np.isfinite(sumpow)
-        eta_array, sumpow = eta_array[ok], sumpow[ok]
-        _check_profile_size(sumpow, nsmooth)
-        filt = savgol_filter(sumpow, nsmooth, 1)
-        return _measure_peak(eta_array, sumpow, filt, noise, constraint,
-                             low_power_diff, high_power_diff, noise_error,
-                             lamsteps, log_fit=True)
+
+        def _measure_grid(pow_arr):
+            ok = np.isfinite(pow_arr)
+            ea, p = eta_array[ok], pow_arr[ok]
+            _check_profile_size(p, nsmooth)
+            filt = savgol_filter(p, nsmooth, 1)
+            return _measure_peak(ea, p, filt, noise, constraint,
+                                 low_power_diff, high_power_diff,
+                                 noise_error, lamsteps, log_fit=True)
+
+        fit = _measure_grid((np.array(sumpow_l) + np.array(sumpow_r)) / 2)
+        if asymm:
+            fit = _attach_arms(fit,
+                               lambda: _measure_grid(np.array(sumpow_l)),
+                               lambda: _measure_grid(np.array(sumpow_r)))
+        return fit
 
     raise ValueError("unknown arc fitting method; choose from "
                      "'gridmax' or 'norm_sspec'")

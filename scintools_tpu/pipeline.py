@@ -242,9 +242,11 @@ class Dynspec:
                 low_power_diff: float = -3.0, high_power_diff: float = -1.5,
                 ref_freq: float = 1400.0, constraint=(0, np.inf),
                 nsmooth: int = 5, noise_error: bool = True,
+                asymm: bool = False,
                 backend: str | None = None) -> ArcFit:
         """Arc-curvature measurement (dynspec.py:414-785).  Sets
-        ``betaeta/betaetaerr`` (lamsteps) or ``eta/etaerr``."""
+        ``betaeta/betaetaerr`` (lamsteps) or ``eta/etaerr``; with
+        ``asymm=True`` also fits each fdop arm (``eta_left/eta_right``)."""
         lamsteps = self.lamsteps if lamsteps is None else lamsteps
         sec = self._secspec(lamsteps)
         if np.ndim(etamin) == 1 or np.ndim(etamax) == 1:
@@ -254,6 +256,12 @@ class Dynspec:
             # array lengths are an error (zip would truncate silently).
             from .fit.arc_fit import fit_arcs_multi
 
+            if asymm:
+                raise ValueError(
+                    "asymm=True is not supported in multi-arc mode "
+                    "(secondary arcs are re-measured on the shared "
+                    "profile); fit each arc individually with a "
+                    "constraint window instead")
             n_arcs = max(np.size(etamin) if etamin is not None else 1,
                          np.size(etamax) if etamax is not None else 1)
 
@@ -298,7 +306,7 @@ class Dynspec:
                        low_power_diff=low_power_diff,
                        high_power_diff=high_power_diff, ref_freq=ref_freq,
                        constraint=constraint, nsmooth=nsmooth,
-                       noise_error=noise_error,
+                       noise_error=noise_error, asymm=asymm,
                        backend=resolve(backend or self.backend))
         self.arc_fit = fit
         if lamsteps:

@@ -3,8 +3,13 @@ testable without TPU hardware (SURVEY.md §4.5), and float64 enabled so the
 jax path can be compared against the reference-compatible numpy path at
 tight tolerances."""
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 # Must run before any jax backend initialises in the test process.
-from scintools_tpu.backend import force_host_cpu_devices
+from scintools_tpu.backend import force_host_cpu_devices  # noqa: E402
 
 force_host_cpu_devices(8)
 

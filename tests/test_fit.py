@@ -521,3 +521,70 @@ def test_thetatheta_multi_arc_and_kwargs():
     with pytest.raises(ValueError, match="empty eta bracket"):
         fit_arc(sec, 1400.0, method="thetatheta", etamin=0.1, etamax=0.5,
                 constraint=(1.0, 2.0))
+
+
+# ------------------------------------------------------------ asymm arms
+
+def _asymm_secspec(eta_l=0.6, eta_r=0.4, nr=128, nc=256, rng=None):
+    """Arc with different curvature on the two fdop arms (refractive
+    asymmetry): left arm follows eta_l, right arm eta_r."""
+    rng = rng or np.random.default_rng(11)
+    fdop = np.linspace(-10, 10, nc)
+    tdel = np.linspace(0, 40, nr)
+    power = np.full((nr, nc), 1e-3)
+    for j, f in enumerate(fdop):
+        eta = eta_l if f < 0 else eta_r
+        t = eta * f ** 2
+        i = np.argmin(np.abs(tdel - t))
+        if t <= tdel[-1]:
+            power[max(i - 1, 0): i + 2, j] += 1.0
+    power *= rng.uniform(0.9, 1.1, size=power.shape)
+    sec_db = 10 * np.log10(power)
+    return SecSpec(sspec=sec_db, fdop=fdop, tdel=tdel, beta=tdel,
+                   lamsteps=True)
+
+
+def test_fit_arc_asymm_recovers_per_arm_curvatures():
+    """asymm=True measures each fdop arm independently (the reference
+    plumbs `asymm` but its per-arm fits are broken by a copy-paste bug,
+    dynspec.py:567-568, and never returned)."""
+    sec = _asymm_secspec(eta_l=0.7, eta_r=0.35)
+    fit = fit_arc(sec, freq=1400.0, method="gridmax", numsteps=501,
+                  asymm=True, backend="numpy")
+    assert fit.eta_left == pytest.approx(0.7, rel=0.2)
+    assert fit.eta_right == pytest.approx(0.35, rel=0.2)
+    assert fit.eta_left > fit.eta_right
+    assert fit.etaerr_left > 0 and fit.etaerr_right > 0
+    # combined eta sits between the arms
+    assert fit.eta_right * 0.8 < fit.eta < fit.eta_left * 1.2
+
+
+def test_fit_arc_asymm_norm_sspec_symmetric_arms_agree():
+    """On a symmetric arc both arms and the combined fit agree."""
+    sec = _arc_secspec(eta=0.5)
+    fit = fit_arc(sec, freq=1400.0, numsteps=2000, asymm=True,
+                  backend="numpy")
+    assert fit.eta_left == pytest.approx(fit.eta_right, rel=0.15)
+    assert fit.eta == pytest.approx(0.5, rel=0.15)
+
+
+def test_fit_arc_asymm_default_off_leaves_arm_fields_none():
+    sec = _arc_secspec(eta=0.5)
+    fit = fit_arc(sec, freq=1400.0, numsteps=1000, backend="numpy")
+    assert fit.eta_left is None and fit.eta_right is None
+
+
+def test_fit_arc_asymm_rejects_unsupported_modes():
+    sec = _arc_secspec(eta=0.5)
+    with pytest.raises(ValueError, match="thetatheta"):
+        fit_arc(sec, freq=1400.0, method="thetatheta", etamin=0.1,
+                etamax=1.0, asymm=True, backend="numpy")
+    from scintools_tpu import Dynspec
+    from scintools_tpu.io import from_simulation
+    from scintools_tpu.sim import Simulation
+
+    d = from_simulation(Simulation(mb2=2, ns=64, nf=64, dlam=0.25, seed=3),
+                        freq=1400.0, dt=8.0)
+    ds = Dynspec(data=d, process=False, backend="numpy")
+    with pytest.raises(ValueError, match="multi-arc"):
+        ds.fit_arc(etamin=[0.1, 0.5], etamax=[0.4, 1.0], asymm=True)

@@ -9,7 +9,7 @@ import pytest
 
 from scintools_tpu.io import from_simulation, concatenate_time
 from scintools_tpu.ops import (correct_band, crop, scale_lambda,
-                               scale_trapezoid, sspec, trim_edges, zap)
+                               scale_trapezoid, zap)
 from scintools_tpu.ops.svd import svd_model
 from scintools_tpu.sim import Simulation
 
