@@ -1,0 +1,88 @@
+"""Per-stage device timing of the batched pipeline (BASELINE config 4).
+
+Separates the one-jit step into its stages to locate the bottleneck on
+real hardware before optimising:
+
+    lam    lambda-resample einsum only
+    sspec  + secondary spectrum (windows, prewhiten, rfft2, postdark, dB)
+    arc    + fixed-shape arc fitter
+    scint  ACF-cuts + vmapped LM fit only
+    full   everything (the bench.py configuration)
+
+All timings force TRUE remote completion by pulling a fused scalar to the
+host (block_until_ready is unreliable over tunnelled runtimes) and use an
+async dispatch chain of ``--iters`` steps per stage.
+
+Run serially with any other device work (a second TPU process can wedge
+the axon tunnel — see .claude/skills/verify/SKILL.md).
+
+Usage: python benchmarks/profile_stages.py [--b 256] [--iters 5]
+"""
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--b", type=int, default=256, help="batch size")
+    ap.add_argument("--nf", type=int, default=256)
+    ap.add_argument("--nt", type=int, default=512)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--numsteps", type=int, default=2000)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from scintools_tpu.parallel import PipelineConfig, make_pipeline
+
+    B, nf, nt = args.b, args.nf, args.nt
+    rng = np.random.default_rng(0)
+    dyn = ((1 + 0.3 * rng.standard_normal((B, nf, nt))) ** 2).astype(
+        np.float32)
+    freqs = np.linspace(1300.0, 1500.0, nf)
+    times = np.arange(nt) * 8.0
+
+    def sync(tree) -> float:
+        leaves = [x for x in jax.tree_util.tree_leaves(tree)
+                  if hasattr(x, "dtype")]
+        total = sum(jnp.sum(jnp.nan_to_num(x.astype(jnp.float32)))
+                    for x in leaves)
+        return float(np.asarray(total))
+
+    dyn_d = jax.device_put(dyn)
+
+    def bench(name, cfg):
+        step = make_pipeline(freqs, times, cfg)
+        t0 = time.perf_counter()
+        sync(step(dyn_d))
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(args.iters):
+            out = step(dyn_d)
+        sync(out)
+        dt = (time.perf_counter() - t0) / args.iters
+        print(f"{name:22s} {dt * 1e3:9.2f} ms/batch  "
+              f"{B / dt:9.0f} dynspec/s   (compile {compile_s:.1f}s)")
+
+    ns = args.numsteps
+    bench("lam+sspec only", PipelineConfig(
+        fit_scint=False, fit_arc=False, return_sspec=True, arc_numsteps=ns))
+    bench("sspec only (no lam)", PipelineConfig(
+        lamsteps=False, fit_scint=False, fit_arc=False, return_sspec=True,
+        arc_numsteps=ns))
+    bench("lam+sspec+arc", PipelineConfig(fit_scint=False, arc_numsteps=ns))
+    bench("scint fit only", PipelineConfig(fit_arc=False, arc_numsteps=ns))
+    bench("FULL (bench cfg)", PipelineConfig(arc_numsteps=ns, lm_steps=30))
+
+
+if __name__ == "__main__":
+    main()
