@@ -37,7 +37,8 @@ import numpy as np
 
 from ..backend import resolve
 
-__all__ = ["nudft", "slow_ft", "slow_ft_power", "nudft_pallas"]
+__all__ = ["nudft", "slow_ft", "slow_ft_power", "slow_ft_power_sharded",
+           "nudft_pallas"]
 
 
 def _r_grid(ntime: int) -> tuple[float, float, int]:
@@ -185,6 +186,59 @@ def slow_ft_power(dyn, freqs, db: bool = True, backend: str = "jax"):
 
     ss = slow_ft(dyn, freqs, backend="jax")
     p = jnp.real(ss) ** 2 + jnp.imag(ss) ** 2
+    return 10 * jnp.log10(p) if db else p
+
+
+def slow_ft_power_sharded(dyn, freqs, mesh, axis: str = "data",
+                          db: bool = True):
+    """Mesh-sharded arc-sharpened secondary spectrum (SURVEY.md §5
+    "long-context" analogue: the NUDFT as a device-sharded einsum).
+
+    The O(ntime * nfreq * nr) NUDFT decomposes output-parallel over the
+    Doppler axis: shard ``axis`` devices each build only their own
+    [nr/n, nt, chunk_f] phase slabs (zero communication — each Doppler
+    block depends on the whole dynspec, which is replicated, the way DP
+    replicates activations).  The frequency-axis FFT that follows is
+    along an unsharded axis, so XLA runs it locally per shard; only the
+    Doppler flip moves data between devices.  Use when a single spectrum
+    is too large for one device's HBM budget, or to cut single-spectrum
+    latency across a pod slice.
+
+    Returns the real power spectrum [ntime, nfreq] (10*log10 when
+    ``db``), sharded [axis, None] over the mesh.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    try:  # jax >= 0.4.35
+        from jax.experimental.shard_map import shard_map
+    except ImportError:  # pragma: no cover
+        from jax.shard_map import shard_map
+
+    ntime, nfreq = dyn.shape
+    freqs = np.asarray(freqs, dtype=np.float64)
+    fscale = freqs / freqs[nfreq // 2]
+    tsrc = np.arange(ntime, dtype=np.float64)
+    r0, dr, nr = _r_grid(ntime)
+    n = mesh.shape[axis]
+    nr_pad = (-nr) % n
+    nr_p = nr + nr_pad  # extra top bins computed then dropped
+    nr_local = nr_p // n
+
+    def local_block(dyn_rep):
+        idx = lax.axis_index(axis)
+        r0_local = r0 + dr * (idx * nr_local).astype(np.float64)
+        return _nudft_jax_reim(dyn_rep, fscale, tsrc, r0_local, dr, nr_local)
+
+    dyn_rep = jax.device_put(jnp.asarray(dyn),
+                             NamedSharding(mesh, P(None, None)))
+    re, im = shard_map(local_block, mesh=mesh, in_specs=P(None, None),
+                       out_specs=P(axis, None))(dyn_rep)
+    field = lax.complex(re, im)[:nr][::-1]  # flip = ppermute across shards
+    field = jnp.fft.fftshift(jnp.fft.fft(field, axis=1), axes=1)
+    p = jnp.real(field) ** 2 + jnp.imag(field) ** 2
     return 10 * jnp.log10(p) if db else p
 
 
