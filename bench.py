@@ -18,6 +18,7 @@ SCINT_BENCH_NT (epoch shape, default 256x512), SCINT_BENCH_CPU_EPOCHS
 
 import json
 import os
+import threading
 import time
 
 import numpy as np
@@ -135,15 +136,46 @@ def main():
     cpu_s = cpu_reference_per_epoch(dyn, freqs, times, n_cpu)
     cpu_rate = 1.0 / cpu_s
 
-    rate = device_throughput(dyn, freqs, times, chunk)
+    metric = (f"batched sspec+arc-fit+scint-fit throughput "
+              f"({B} dynspecs {nf}x{nt})")
 
+    # Watchdog: a wedged axon tunnel makes the first device op hang
+    # forever (no exception), which would leave the driver with no JSON
+    # at all.  Bound the device path and report the failure explicitly.
+    timeout_s = _env_int("SCINT_BENCH_DEVICE_TIMEOUT", 1200)
+    result: dict = {}
+
+    def _run():
+        try:
+            result["rate"] = device_throughput(dyn, freqs, times, chunk)
+        except Exception as e:  # pragma: no cover - surfaced in JSON
+            result["error"] = f"{type(e).__name__}: {e}"
+
+    th = threading.Thread(target=_run, daemon=True)
+    th.start()
+    th.join(timeout_s)
+
+    if "rate" in result:
+        rate = result["rate"]
+        print(json.dumps({
+            "metric": metric,
+            "value": round(rate, 3),
+            "unit": "dynspec/s",
+            "vs_baseline": round(rate / cpu_rate, 2),
+        }))
+        return
+    err = result.get(
+        "error",
+        f"device path did not complete within {timeout_s}s "
+        f"(accelerator tunnel unreachable?)")
     print(json.dumps({
-        "metric": f"batched sspec+arc-fit+scint-fit throughput "
-                  f"({B} dynspecs {nf}x{nt})",
-        "value": round(rate, 3),
-        "unit": "dynspec/s",
-        "vs_baseline": round(rate / cpu_rate, 2),
+        "metric": metric, "value": 0.0, "unit": "dynspec/s",
+        "vs_baseline": 0.0, "error": err,
+        "cpu_baseline_dynspec_per_s": round(cpu_rate, 3),
     }))
+    # the worker thread may be stuck inside an uninterruptible device
+    # claim; exit without waiting on it
+    os._exit(1)
 
 
 if __name__ == "__main__":
