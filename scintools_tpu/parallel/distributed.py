@@ -85,9 +85,19 @@ def make_hybrid_mesh(ici_chan: int = 1, devices=None):
         raise ValueError(
             f"ici_chan={ici_chan} must divide the {per_host} devices per "
             f"host (chan must not span the DCN boundary)")
-    dev_array = mesh_utils.create_hybrid_device_mesh(
-        mesh_shape=(per_host // ici_chan, ici_chan),
-        dcn_mesh_shape=(n_proc, 1), devices=devices)
+    try:
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(per_host // ici_chan, ici_chan),
+            dcn_mesh_shape=(n_proc, 1), devices=devices)
+    except ValueError:
+        # devices without slice metadata (multi-process CPU meshes, some
+        # single-slice topologies): group by process so the chan axis
+        # still never crosses the process (DCN) boundary
+        import numpy as _np
+
+        ordered = sorted(devices, key=lambda d: (d.process_index, d.id))
+        dev_array = _np.array(ordered, dtype=object).reshape(
+            n // ici_chan, ici_chan)
     return Mesh(dev_array, (DATA_AXIS, CHAN_AXIS))
 
 

@@ -1,0 +1,57 @@
+"""Worker process for the real two-process distributed test
+(tests/test_multihost.py).  Each worker owns 4 virtual CPU devices; the
+two form one 8-device global mesh over the jax.distributed runtime —
+the CPU stand-in for a two-host DCN slice (SURVEY.md §2.7 / §4.5).
+
+Usage: python multihost_worker.py <process_id> <coordinator_port>
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> int:
+    pid, port = int(sys.argv[1]), sys.argv[2]
+
+    from scintools_tpu.backend import force_host_cpu_devices
+
+    force_host_cpu_devices(4)
+
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from scintools_tpu.parallel import (DATA_AXIS, initialize_multihost,
+                                        make_hybrid_mesh, survey_stats)
+
+    assert initialize_multihost(f"127.0.0.1:{port}", num_processes=2,
+                                process_id=pid)
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+    assert jax.local_device_count() == 4
+
+    mesh = make_hybrid_mesh(ici_chan=1)
+    assert mesh.shape[DATA_AXIS] == 8
+
+    # global [8] measurement vector: value = global lane index, with one
+    # NaN lane (a failed fit) that the masked reduction must drop
+    global_vals = np.arange(8.0)
+    global_vals[3] = np.nan
+    local = global_vals[pid * 4:(pid + 1) * 4]
+    sharding = NamedSharding(mesh, P(DATA_AXIS))
+    arr = jax.make_array_from_process_local_data(sharding, local,
+                                                 global_shape=(8,))
+    stats = survey_stats(arr, mesh)
+    # finite lanes: 0,1,2,4,5,6,7 -> mean 25/7
+    np.testing.assert_allclose(stats["mean"], 25.0 / 7, rtol=1e-6)
+    assert stats["count"] == 7
+    print(f"MULTIHOST_OK pid={pid} mean={stats['mean']:.6f} "
+          f"count={stats['count']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

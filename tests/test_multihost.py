@@ -1,0 +1,52 @@
+"""REAL multi-process distributed test: two OS processes, 4 virtual CPU
+devices each, one 8-device global mesh over the jax.distributed runtime
+(gRPC coordinator), psum survey statistics across the process boundary.
+
+This is the CPU stand-in for a two-host DCN slice: the same
+``initialize_multihost`` / ``make_hybrid_mesh`` / ``survey_stats`` calls
+scale to TPU pods unchanged (SURVEY.md §2.7).  The in-process 8-device
+tests (test_parallel.py) cannot exercise cross-process init, process-local
+array assembly, or the coordinator handshake — this one does.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_mesh_psum_survey_stats():
+    port = _free_port()
+    env = dict(os.environ)
+    # workers pick their own platform/device-count; scrub inherited flags
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [subprocess.Popen(
+        [sys.executable, _WORKER, str(i), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for i in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multihost workers timed out:\n"
+                    + "\n".join(o or "" for o in outs))
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out}"
+        assert f"MULTIHOST_OK pid={i}" in out, out
+        assert "count=7" in out
