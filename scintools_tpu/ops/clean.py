@@ -137,11 +137,14 @@ def refill_fixed_point(dyn, iters: int = 50, zeros: bool = True):
     return _refill_fixed_point_jax()(dyn, iters, zeros)
 
 
-def correct_band(d: DynspecData, frequency: bool = True, time: bool = False,
-                 nsmooth: int | None = 5) -> DynspecData:
-    """Bandpass / gain correction: divide by savgol-smoothed row means
-    (frequency) and/or column means (time) (dynspec.py:1189-1226)."""
-    dyn = np.array(d.dyn, dtype=np.float64)
+def correct_band_array(arr, frequency: bool = True, time: bool = False,
+                       nsmooth: int | None = 5) -> np.ndarray:
+    """Bandpass / gain correction of a raw [nf, nt] array: divide by
+    savgol-smoothed row means (frequency) and/or column means (time)
+    (dynspec.py:1189-1226).  Array-level so it also serves the
+    lambda-resampled dynspec (the reference's ``lamsteps=True`` branch,
+    dynspec.py:1195-1198)."""
+    dyn = np.array(arr, dtype=np.float64)
     dyn[np.isnan(dyn)] = 0
     if frequency:
         bandpass = np.mean(dyn, axis=1)
@@ -155,7 +158,14 @@ def correct_band(d: DynspecData, frequency: bool = True, time: bool = False,
         if nsmooth is not None:
             ts = savgol_filter(ts, nsmooth, 1)
         dyn = dyn / ts[None, :]
-    return d.replace(dyn=dyn)
+    return dyn
+
+
+def correct_band(d: DynspecData, frequency: bool = True, time: bool = False,
+                 nsmooth: int | None = 5) -> DynspecData:
+    """Bandpass / gain correction of ``d.dyn`` (dynspec.py:1189-1226)."""
+    return d.replace(dyn=correct_band_array(d.dyn, frequency=frequency,
+                                            time=time, nsmooth=nsmooth))
 
 
 def zap(d: DynspecData, method: str = "median", sigma: float = 7,

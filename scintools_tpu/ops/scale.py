@@ -72,9 +72,15 @@ def _cubic_interp_jax():
         tests).
         """
         n = x.shape[0]
+        # solve in the wider of (data, grid) dtypes: scattering f64 grid
+        # spacings into an f32 system is a FutureWarning -> error in jax
+        dtype = jnp.result_type(y.dtype, x.dtype)
+        y = y.astype(dtype)
+        x = x.astype(dtype)
+        xq = xq.astype(dtype)
         h = jnp.diff(x)  # [n-1]
         # Build the natural-spline system A m = rhs for second derivatives m.
-        A = jnp.zeros((n, n), dtype=y.dtype)
+        A = jnp.zeros((n, n), dtype=dtype)
         A = A.at[0, 0].set(1.0)
         A = A.at[n - 1, n - 1].set(1.0)
         idx = jnp.arange(1, n - 1)

@@ -144,9 +144,23 @@ class Dynspec:
         return self
 
     def correct_band(self, frequency: bool = True, time: bool = False,
-                     nsmooth: int | None = 5) -> "Dynspec":
-        self._data = _correct_band(self._data, frequency=frequency,
-                                   time=time, nsmooth=nsmooth)
+                     nsmooth: int | None = 5,
+                     lamsteps: bool = False) -> "Dynspec":
+        """Bandpass/gain correction (dynspec.py:1189-1226).  With
+        ``lamsteps=True`` corrects the lambda-resampled dynspec instead
+        (resampling it first if needed), as the reference does."""
+        if lamsteps:
+            from .ops.clean import correct_band_array
+
+            if self.lamdyn is None:
+                self.scale_dyn()
+            self.lamdyn = correct_band_array(self.lamdyn,
+                                             frequency=frequency,
+                                             time=time, nsmooth=nsmooth)
+            self.lamsspec = None  # stale: recompute on next use
+        else:
+            self._data = _correct_band(self._data, frequency=frequency,
+                                       time=time, nsmooth=nsmooth)
         return self
 
     def zap(self, method: str = "median", sigma: float = 7,

@@ -246,3 +246,17 @@ def test_fit_arc_gridmax_matches_reference_end_to_end(ref):
     ds.fit_arc(method="gridmax", lamsteps=True, numsteps=501)
     np.testing.assert_allclose(ds.betaeta, rd.betaeta, rtol=1e-8)
     np.testing.assert_allclose(ds.betaetaerr, rd.betaetaerr, rtol=1e-8)
+
+
+def test_correct_band_lamsteps_matches_reference(ref, epoch):
+    """correct_band(lamsteps=True) corrects the lambda-resampled dynspec
+    (dynspec.py:1195-1198), matching the reference end-state."""
+    from scintools_tpu import Dynspec
+
+    rd = make_ref_dynspec(epoch)
+    rd.scale_dyn(scale="lambda")
+    rd.correct_band(frequency=True, time=True, lamsteps=True)
+
+    ds = Dynspec(data=epoch, process=False, backend="numpy")
+    ds.correct_band(frequency=True, time=True, lamsteps=True)
+    np.testing.assert_allclose(ds.lamdyn, rd.lamdyn, atol=1e-12)
