@@ -437,9 +437,21 @@ class Dynspec:
         return self.cutdyn, self.cutsspec
 
     # -- plotting (delegates to the plotting module) -----------------------
-    def plot_dyn(self, **kw):
+    def plot_dyn(self, lamsteps: bool = False, trap: bool = False, **kw):
+        """Dynamic spectrum view; ``lamsteps``/``trap`` plot the rescaled
+        arrays (dynspec.py:206-229), resampling first if needed."""
         from . import plotting
 
+        if lamsteps:
+            if self.lamdyn is None:
+                self.scale_dyn()
+            return plotting.plot_dyn(self._data, dyn=self.lamdyn,
+                                     y=self.lam,
+                                     ylabel="Wavelength (m)", **kw)
+        if trap:
+            if self.trapdyn is None:
+                self.scale_dyn(scale="trapezoid")
+            return plotting.plot_dyn(self._data, dyn=self.trapdyn, **kw)
         return plotting.plot_dyn(self._data, **kw)
 
     def plot_acf(self, **kw):

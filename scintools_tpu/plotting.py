@@ -46,21 +46,26 @@ def _clim(arr, nsig_lo: float = 3, nsig_hi: float = 5):
 
 
 def plot_dyn(d: DynspecData, ax=None, filename: str | None = None,
-             display: bool = False, cmap: str = "viridis"):
+             display: bool = False, cmap: str = "viridis",
+             dyn=None, y=None, ylabel: str | None = None):
     """Dynamic spectrum pcolormesh, time in minutes vs frequency in MHz
-    (dynspec.py:200-247)."""
+    (dynspec.py:200-247).  ``dyn``/``y``/``ylabel`` override the plotted
+    array and vertical axis — used for the reference's lamsteps/trap
+    views (dynspec.py:206-229) where the rows are wavelength or rescaled
+    time rather than frequency."""
     import matplotlib.pyplot as plt
 
-    dyn = to_numpy(d.dyn)
+    dyn = to_numpy(d.dyn if dyn is None else dyn)
+    y = to_numpy(d.freqs if y is None else y)
     if ax is None:
         fig, ax = plt.subplots(figsize=(9, 6))
     else:
         fig = ax.figure
     vmin, vmax = _clim(dyn, 2, 5)
-    mesh = ax.pcolormesh(to_numpy(d.times) / 60.0, to_numpy(d.freqs), dyn,
+    mesh = ax.pcolormesh(to_numpy(d.times) / 60.0, y, dyn,
                          vmin=vmin, vmax=vmax, cmap=cmap, shading="auto")
     ax.set_xlabel("Time (mins)")
-    ax.set_ylabel("Frequency (MHz)")
+    ax.set_ylabel(ylabel or "Frequency (MHz)")
     ax.set_title(d.name)
     fig.colorbar(mesh, ax=ax, label="Flux (arb.)")
     return _finish(fig, filename, display)

@@ -81,3 +81,17 @@ def test_plot_thetatheta(ds, tmp_path):
                                    filename=str(tmp_path / "tt.png"))
     assert (tmp_path / "tt.png").stat().st_size > 0
     plt.close(fig)
+
+
+def test_plot_dyn_lamsteps_and_trap(sim_dynspec, tmp_path):
+    """plot_dyn(lamsteps=True)/(trap=True) plot the rescaled arrays
+    (dynspec.py:206-229), resampling lazily."""
+    from scintools_tpu import Dynspec
+
+    ds = Dynspec(data=sim_dynspec, process=False, backend="numpy")
+    out = tmp_path / "lam.png"
+    ds.plot_dyn(lamsteps=True, filename=str(out))
+    assert out.exists() and ds.lamdyn is not None
+    out2 = tmp_path / "trap.png"
+    ds.plot_dyn(trap=True, filename=str(out2))
+    assert out2.exists() and ds.trapdyn is not None
