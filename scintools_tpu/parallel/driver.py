@@ -34,7 +34,6 @@ from typing import Any
 
 import numpy as np
 
-from ..data import ArcFit, ScintParams
 from ..fit.arc_fit import make_arc_fitter
 from ..fit.scint_fit import fit_scint_params_batch
 from ..ops.acf import acf as acf_op

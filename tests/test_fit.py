@@ -10,8 +10,7 @@ from scintools_tpu.fit import (fit_arc, fit_scint_params,
 from scintools_tpu.fit.arc_fit import make_arc_fitter
 from scintools_tpu.data import SecSpec
 from scintools_tpu.models import (fit_log_parabola, fit_parabola,
-                                  polyfit2_cov, scint_acf_model,
-                                  tau_acf_model)
+                                  polyfit2_cov, tau_acf_model)
 
 from reference_oracle import reference_modules
 

@@ -4,7 +4,7 @@ parser, results CSV, adapters (SURVEY.md §4 item 1)."""
 import numpy as np
 import pytest
 
-from scintools_tpu.data import DynspecData, stack_batch
+from scintools_tpu.data import stack_batch
 from scintools_tpu.io import (concatenate_time, from_arrays, from_simulation,
                               float_array_from_dict, pars_to_params, read_par,
                               read_psrflux, read_results, results_row,

@@ -7,7 +7,6 @@ import pytest
 
 import jax
 
-from scintools_tpu.data import DynspecData
 from scintools_tpu.io import from_simulation
 from scintools_tpu.ops import acf, sspec
 from scintools_tpu.parallel import (

@@ -5,7 +5,7 @@ import numpy as np
 import pytest
 
 from scintools_tpu.sim import (SimParams, Simulation, fresnel_filter,
-                               frequency_scales, screen_weights,
+                               screen_weights,
                                screen_weights_reference, simulate,
                                simulate_ensemble, simulate_intensity)
 
