@@ -131,8 +131,12 @@ def honor_platform_env() -> None:
     ``JAX_PLATFORMS=cpu python ...`` actually local-only.  Call before
     the first ``jax.devices()`` (entry points: CLI, examples).
     """
-    plat = os.environ.get("JAX_PLATFORMS", "").strip()
-    if plat and jax_available():
+    plat = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if plat == "cpu" and jax_available():
+        # only ever FORCE the local platform: accelerator platforms are
+        # jax's default resolution anyway, and re-applying e.g. "axon"
+        # inside a process that deliberately switched to cpu (tests,
+        # notebook under pytest) would point it back at the tunnel
         jax, _ = _jax_modules()
         jax.config.update("jax_platforms", plat)
 

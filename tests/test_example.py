@@ -40,3 +40,28 @@ def test_survey_pipeline_walkthrough(tmp_path):
     # rerun: everything resumed from the store, nothing recomputed
     out2 = mod["main"](str(tmp_path))
     assert out2["resumed"] == 64 and out2["rows"] == 64
+
+
+def test_notebook_cells_execute(tmp_path, monkeypatch):
+    """Every code cell of examples/arc_modelling.ipynb executes in order
+    (the reference's notebook cannot run at all: its data directory is
+    not shipped)."""
+    import matplotlib
+    matplotlib.use("Agg")
+    import nbformat
+
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
+    nb_path = pathlib.Path(repo) / "examples" / "arc_modelling.ipynb"
+    nb = nbformat.read(str(nb_path), as_version=4)
+    monkeypatch.chdir(repo)
+    ns: dict = {}
+    n_code = 0
+    for cell in nb.cells:
+        if cell.cell_type != "code":
+            continue
+        exec(compile(cell.source, f"cell{n_code}", "exec"), ns)  # noqa: S102
+        n_code += 1
+    assert n_code >= 7
+    assert ns["ds"].betaeta > 0
+    import matplotlib.pyplot as plt
+    plt.close("all")
