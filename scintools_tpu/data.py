@@ -171,10 +171,11 @@ class ArcFit:
     profile_power: Any = None    # mean power along arcs (dB)
     profile_power_filt: Any = None
     noise: Any = None            # noise level used by the error walk
-    # per-arm measurement (asymm=True, gridmax): the reference plumbs an
-    # ``asymm`` flag and computes etaL/etaR but a copy-paste bug feeds the
-    # combined profile to both arms (dynspec.py:567-568) and never returns
-    # them; here the left/right fdop arms are fitted independently
+    # per-arm measurement (asymm=True; both methods, both backends): the
+    # reference plumbs an ``asymm`` flag and computes etaL/etaR but a
+    # copy-paste bug feeds the combined profile to both arms
+    # (dynspec.py:567-568) and never returns them; here the left/right
+    # fdop arms are fitted independently (NaN for a degenerate arm)
     eta_left: Any = None
     etaerr_left: Any = None
     eta_right: Any = None

@@ -229,10 +229,11 @@ def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
     (dynspec.py:414-785, compute only; primary arc).
 
     ``asymm=True`` additionally fits the left and right fdop arms
-    independently (``eta_left/eta_right`` on the result).  The reference
-    plumbs this flag but a copy-paste bug feeds the combined profile to
-    both arm fits (dynspec.py:567-568) and the per-arm values are only
-    plotted, never returned — completed here (numpy backend)."""
+    independently (``eta_left/eta_right`` on the result) on both
+    backends (vmappable on jax).  The reference plumbs this flag but a
+    copy-paste bug feeds the combined profile to both arm fits
+    (dynspec.py:567-568) and the per-arm values are only plotted, never
+    returned — completed here."""
     backend = resolve(backend)
     if asymm and method == "thetatheta":
         raise ValueError("asymm=True is not meaningful for "
@@ -260,10 +261,7 @@ def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
         return ArcFit(eta=eta, etaerr=etaerr, etaerr2=etaerr,
                       lamsteps=sec.lamsteps, profile_eta=etas,
                       profile_power=conc, profile_power_filt=conc)
-    # asymm is a per-epoch diagnostic -> numpy path (the batched jax fitter
-    # measures the combined profile only)
-    if backend == "jax" and not asymm and method in ("norm_sspec",
-                                                     "gridmax"):
+    if backend == "jax" and method in ("norm_sspec", "gridmax"):
         fitter = make_arc_fitter(
             fdop=np.asarray(sec.fdop), yaxis=np.asarray(
                 sec.beta if sec.lamsteps else sec.tdel),
@@ -272,16 +270,24 @@ def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
             startbin=startbin, cutmid=cutmid, etamax=etamax, etamin=etamin,
             low_power_diff=low_power_diff, high_power_diff=high_power_diff,
             ref_freq=ref_freq, constraint=tuple(constraint),
-            nsmooth=nsmooth, noise_error=noise_error)
+            nsmooth=nsmooth, noise_error=noise_error, asymm=asymm)
         import jax.numpy as jnp
 
         batch = fitter(jnp.asarray(sec.sspec)[None])
+
+        def lane0(x):
+            return None if x is None else x[0]
+
         return ArcFit(eta=batch.eta[0], etaerr=batch.etaerr[0],
                       etaerr2=batch.etaerr2[0], lamsteps=batch.lamsteps,
                       profile_eta=batch.profile_eta,
                       profile_power=batch.profile_power[0],
                       profile_power_filt=batch.profile_power_filt[0],
-                      noise=batch.noise[0])
+                      noise=batch.noise[0],
+                      eta_left=lane0(batch.eta_left),
+                      etaerr_left=lane0(batch.etaerr_left),
+                      eta_right=lane0(batch.eta_right),
+                      etaerr_right=lane0(batch.etaerr_right))
     sspec = np.array(sec.sspec, dtype=np.float64)
     tdel_axis = np.asarray(sec.tdel)
     fdop = np.asarray(sec.fdop, dtype=np.float64)
@@ -400,7 +406,8 @@ def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
 def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
                             method, delmax, numsteps, startbin, cutmid,
                             etamax, etamin, low_power_diff, high_power_diff,
-                            ref_freq, constraint, nsmooth, noise_error):
+                            ref_freq, constraint, nsmooth, noise_error,
+                            asymm=False):
     import jax
     import jax.numpy as jnp
 
@@ -522,17 +529,35 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         prof = jnp.where(prof[i_at_1] < 0, prof + 2.0, prof)
 
         # ---- fold arms onto the eta grid -------------------------------
-        avg = (prof[ipos] + prof[ineg][::-1]) / 2
-        avg = avg[::-1]                                     # ascending eta
-        valid = jnp.isfinite(avg) & jnp.asarray(keep_static)
-        return measure_profile(avg, valid, noise,
-                               jnp.asarray(eta_array), cons_mask,
-                               use_log=False) + (noise,)
+        def measure_arm(arm, nan_on_forward=False):
+            # arm indexed like ipos (descending eta); flip to ascending
+            avg = arm[::-1]
+            valid = jnp.isfinite(avg) & jnp.asarray(keep_static)
+            return measure_profile(avg, valid, noise,
+                                   jnp.asarray(eta_array), cons_mask,
+                                   use_log=False,
+                                   nan_on_forward=nan_on_forward)
 
-    def measure_profile(avg, valid, noise, ea, cmask, use_log):
+        right = prof[ipos]
+        left = prof[ineg][::-1]
+        out = measure_arm((right + left) / 2) + (noise,)
+        if asymm:
+            el, eel = measure_arm(left, nan_on_forward=True)[:2]
+            er, eer = measure_arm(right, nan_on_forward=True)[:2]
+            out = out + (el, eel, er, eer)
+        return out
+
+    def measure_profile(avg, valid, noise, ea, cmask, use_log,
+                        nan_on_forward=False):
         """Masked peak search + power-drop windows + (log-)parabola fit on
         a power-vs-eta profile — the jit-safe tail shared by both methods
-        (dynspec.py:693-744)."""
+        (dynspec.py:693-744).
+
+        ``nan_on_forward``: NaN-poison eta/etaerr when the fit is a
+        forward (upward-opening) parabola — the jit-safe analogue of the
+        numpy path's raise (dynspec.py:598-599); used for the per-arm
+        asymm fits where a one-sided spectrum makes a degenerate arm.
+        """
         # fill invalid (contiguous large-eta tail / NaN centre) with the
         # lowest valid power so the smoother sees a continuous profile and
         # the fill can never create a spurious peak (differs from the numpy
@@ -579,6 +604,13 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
             lo_eta = jnp.min(jnp.where(wn_, ea, jnp.inf))
             hi_eta = jnp.max(jnp.where(wn_, ea, -jnp.inf))
             etaerr = (hi_eta - lo_eta) / 2
+
+        if nan_on_forward:
+            # mean(gradient(diff(yfit))) > 0 is the reference's forward-
+            # parabola test (dynspec.py:598)
+            fwd = jnp.mean(jnp.gradient(jnp.diff(yfit))) > 0
+            eta = jnp.where(fwd, jnp.nan, eta)
+            etaerr = jnp.where(fwd, jnp.nan, etaerr)
 
         return eta, etaerr, etaerr_fit, avg_f, filt
 
@@ -640,20 +672,32 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
                     c = jnp.sum(ok)
                     return jnp.where(c > 0, s / jnp.maximum(c, 1), jnp.nan)
 
-                return (side_mean(side_l) + side_mean(side_r)) / 2
+                sl, sr = side_mean(side_l), side_mean(side_r)
+                return jnp.stack([(sl + sr) / 2, sl, sr])
 
             # chunked over the eta grid: [chunk, ncol] slabs, not [S, ncol]
             S = len(eta_array_g)
             pad = (-S) % chunk
             eta_p = jnp.asarray(np.pad(eta_array_g, (0, pad),
                                        constant_values=1.0))
-            sumpow = jax.lax.map(jax.vmap(sample_eta),
-                                 eta_p.reshape(-1, chunk)).reshape(-1)[:S]
+            pows = jax.lax.map(jax.vmap(sample_eta),
+                               eta_p.reshape(-1, chunk)
+                               ).reshape(-1, 3)[:S]
 
-            valid = jnp.isfinite(sumpow)
-            return measure_profile(sumpow, valid, noise,
-                                   jnp.asarray(eta_array_g), cons_mask_g,
-                                   use_log=True) + (noise,)
+            def measure_pow(p, nan_on_forward=False):
+                return measure_profile(p, jnp.isfinite(p), noise,
+                                       jnp.asarray(eta_array_g),
+                                       cons_mask_g, use_log=True,
+                                       nan_on_forward=nan_on_forward)
+
+            out = measure_pow(pows[:, 0]) + (noise,)
+            if asymm:
+                el, eel = measure_pow(pows[:, 1],
+                                      nan_on_forward=True)[:2]
+                er, eer = measure_pow(pows[:, 2],
+                                      nan_on_forward=True)[:2]
+                out = out + (el, eel, er, eer)
+            return out
 
         epoch_fn = one_epoch_gridmax
         profile_eta_out = eta_array_g
@@ -663,13 +707,17 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
 
     @jax.jit
     def impl(sspec_batch):
-        eta, etaerr, etaerr2, avg, filt, noise = \
-            jax.vmap(epoch_fn)(sspec_batch)
+        res = jax.vmap(epoch_fn)(sspec_batch)
+        eta, etaerr, etaerr2, avg, filt, noise = res[:6]
+        arms = {}
+        if asymm:
+            arms = dict(zip(("eta_left", "etaerr_left", "eta_right",
+                             "etaerr_right"), res[6:10]))
         return ArcFit(eta=eta, etaerr=etaerr, etaerr2=etaerr2,
                       lamsteps=lamsteps,
                       profile_eta=jnp.asarray(profile_eta_out),
                       profile_power=avg, profile_power_filt=filt,
-                      noise=noise)
+                      noise=noise, **arms)
 
     return impl
 
@@ -679,7 +727,7 @@ def make_arc_fitter(fdop, yaxis, tdel, freq, lamsteps=True,
                     startbin=3, cutmid=3, etamax=None, etamin=None,
                     low_power_diff=-3.0, high_power_diff=-1.5,
                     ref_freq=1400.0, constraint=(0, np.inf), nsmooth=5,
-                    noise_error=True):
+                    noise_error=True, asymm=False):
     """Build a jit'd batched arc fitter for a fixed (fdop, yaxis) grid.
 
     Returns ``fitter(sspec_batch [B, nr, nc]) -> ArcFit`` of [B] arrays.
@@ -703,7 +751,7 @@ def make_arc_fitter(fdop, yaxis, tdel, freq, lamsteps=True,
         None if etamin is None else float(etamin), float(low_power_diff),
         float(high_power_diff), float(ref_freq),
         (float(constraint[0]), float(constraint[1])), int(nsmooth),
-        bool(noise_error))
+        bool(noise_error), bool(asymm))
 
 
 def fit_arcs_multi(sec: SecSpec, freq: float, brackets,
