@@ -237,6 +237,41 @@ class Dynspec:
             self.sspec = sec
         return self
 
+    def calc_sspec_slowft(self, backend: str | None = None) -> SecSpec:
+        """Arc-sharpened secondary spectrum via the slow-FT NUDFT
+        (scint_utils.py:317-398) as a ready-to-fit :class:`SecSpec`.
+
+        The reference exposes ``slow_FT`` as a free function returning a
+        raw complex field, leaving axes and integration to user scripts;
+        here the scaled-time transform (which removes the arcs' chromatic
+        smearing) is wired straight into the measurement chain: the
+        result has true-delay ``tdel`` (us) / ``fdop`` (mHz) axes and
+        positive delays only, so ``fit_arc``/``norm_sspec`` accept it
+        unchanged.  Stored as ``self.slowft_sspec``.
+        """
+        from .ops.nudft import slow_ft
+
+        b = resolve(backend or self.backend)
+        dyn_tf = to_numpy(self._data.dyn).T  # [ntime, nfreq]
+        ntime, nfreq = dyn_tf.shape
+        field = slow_ft(dyn_tf, to_numpy(self._data.freqs), backend=b,
+                        as_numpy=(b == "jax"))
+        field = to_numpy(field)
+        with np.errstate(divide="ignore"):
+            power_db = 10 * np.log10(np.abs(field) ** 2)
+        # axes: rows of `field` are Doppler, DESCENDING (slow_ft flips the
+        # ascending NUDFT grid); cols are delay, fftshifted ascending
+        fdop = np.sort(np.fft.fftfreq(ntime, d=self._data.dt)) * 1e3  # mHz
+        delay = np.fft.fftshift(np.fft.fftfreq(nfreq, d=abs(self._data.df)))
+        # orient [tdel, fdop]: transpose -> [delay asc, doppler desc];
+        # keep positive delays, flip cols to ascending Doppler
+        sspec = power_db.T[delay >= 0][:, ::-1]
+        tdel = delay[delay >= 0]                        # us (1/MHz)
+        sec = SecSpec(sspec=sspec, fdop=fdop, tdel=tdel, beta=None,
+                      lamsteps=False)
+        self.slowft_sspec = sec
+        return sec
+
     def _secspec(self, lamsteps: bool) -> SecSpec:
         """Assemble a SecSpec, lazily computing what is missing
         (the reference's recompute-on-missing, dynspec.py:426-443)."""
