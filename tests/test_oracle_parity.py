@@ -307,3 +307,42 @@ def test_psrflux_negative_df_flip_matches_reference(ref, epoch, tmp_path):
     np.testing.assert_allclose(np.asarray(ours.freqs), rd.freqs, atol=1e-9)
     assert ours.df == pytest.approx(rd.df)
     assert np.all(np.diff(np.asarray(ours.freqs)) > 0)
+
+
+# -------------------------------------------------------------- sort_dyn
+
+def test_sort_dyn_triage_matches_reference(ref, epoch, tmp_path):
+    """Batch triage vs reference sort_dyn (dynspec.py:1599-1660): same
+    good/bad classification on a mixed set (good epoch, wrong-band epoch,
+    too-few-subints epoch)."""
+    from scintools_tpu import sort_dyn as our_sort
+    from scintools_tpu.io import write_psrflux
+
+    good = epoch
+    offband = epoch.replace(freq=6000.0,
+                            freqs=np.asarray(epoch.freqs) + 4600.0)
+    short = epoch.replace(dyn=np.asarray(epoch.dyn)[:, :4],
+                          times=np.asarray(epoch.times)[:4], tobs=32.0)
+    files = []
+    for name, d in (("good", good), ("offband", offband), ("short", short)):
+        p = str(tmp_path / f"{name}.dynspec")
+        write_psrflux(d, p)
+        files.append(p)
+
+    ref_dynspec = ref[0]
+    ref_out = tmp_path / "refout"
+    ref_out.mkdir()
+    ref_dynspec.sort_dyn(files, outdir=str(ref_out), min_nsub=10,
+                         min_nchan=50, min_tsub=1, verbose=False)
+    ref_good = [l.strip() for l in
+                (ref_out / "good_files.txt").read_text().splitlines() if l]
+    ref_bad = [l.split("\t")[0] for l in
+               (ref_out / "bad_files.txt").read_text().splitlines()[1:] if l]
+
+    our_out = tmp_path / "ourout"
+    our_out.mkdir()
+    g, b = our_sort(files, outdir=str(our_out), min_nsub=10, min_nchan=50,
+                    min_tsub=1)
+    assert sorted(g) == sorted(ref_good)
+    assert sorted(b) == sorted(ref_bad)
+    assert files[0] in g and files[1] in b and files[2] in b
