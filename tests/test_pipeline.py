@@ -136,3 +136,36 @@ def test_sort_dyn_triage(tmp_path, sim_dyn):
     assert set(badl) == {bad_fn, missing_fn}
     assert (tmp_path / "good_files.txt").read_text().strip() == good_fn
     assert len((tmp_path / "bad_files.txt").read_text().split()) == 2
+
+
+def test_wrapper_chain_on_constant_dynspec_fails_informatively():
+    """A zero-variance dynspec cannot yield scint parameters; the failure
+    must carry a reason (quarantine layers log it), not a deep internal
+    traceback."""
+    from scintools_tpu.data import DynspecData
+
+    d = DynspecData(dyn=np.ones((32, 32)), freqs=np.linspace(1400, 1431, 32),
+                    times=np.arange(32) * 8.0)
+    ds = Dynspec(data=d, process=False, backend="numpy")
+    ds.calc_acf()
+    with pytest.raises(Exception) as ei:
+        ds.get_scint_params()
+    assert not isinstance(ei.value, (KeyError, IndexError, TypeError))
+
+
+def test_wrapper_chain_survives_nan_stripes():
+    """Zapped (NaN) stripes flow through refill -> acf -> sspec -> fits
+    without crashing and produce finite measurements."""
+    rng = np.random.default_rng(21)
+    dyn = (1 + 0.4 * rng.standard_normal((64, 64))) ** 2
+    dyn[10:12, :] = np.nan   # zapped channels
+    dyn[:, 30] = np.nan      # zapped subint
+    from scintools_tpu.data import DynspecData
+
+    d = DynspecData(dyn=dyn, freqs=np.linspace(1400, 1463, 64),
+                    times=np.arange(64) * 8.0)
+    ds = Dynspec(data=d, process=False, backend="numpy")
+    ds.refill().calc_acf()
+    ds.calc_sspec(lamsteps=True)
+    ds.get_scint_params()
+    assert np.isfinite(ds.tau) and np.isfinite(ds.dnu)
