@@ -471,6 +471,26 @@ class Dynspec:
                           for tc in tcols]
         return self.cutdyn, self.cutsspec
 
+    # -- results I/O -------------------------------------------------------
+    def write_results(self, filename: str) -> None:
+        """Append this observation's metadata and whichever measurements
+        have been made (tau/dnu, eta, betaeta, each with errors) to the
+        reference-schema CSV (scint_utils.py:75-108, which takes the
+        Dynspec object the same way)."""
+        from .io.results import write_results as _write
+
+        meta = dict(name=self._data.name, mjd=self._data.mjd,
+                    freq=self._data.freq, bw=self._data.bw,
+                    tobs=self._data.tobs, dt=self._data.dt,
+                    df=self._data.df)
+        for a in ("tau", "dnu", "eta", "betaeta"):
+            v = getattr(self, a, None)
+            if v is not None and np.ndim(v) == 0:
+                meta[a] = float(v)
+                err = getattr(self, a + "err", None)
+                meta[a + "err"] = None if err is None else float(err)
+        _write(filename, meta)
+
     # -- plotting (delegates to the plotting module) -----------------------
     def plot_dyn(self, lamsteps: bool = False, trap: bool = False, **kw):
         """Dynamic spectrum view; ``lamsteps``/``trap`` plot the rescaled

@@ -346,3 +346,32 @@ def test_sort_dyn_triage_matches_reference(ref, epoch, tmp_path):
     assert sorted(g) == sorted(ref_good)
     assert sorted(b) == sorted(ref_bad)
     assert files[0] in g and files[1] in b and files[2] in b
+
+
+# --------------------------------------------------------- write_results
+
+def test_dynspec_write_results_matches_reference(ref, epoch, tmp_path):
+    """Dynspec.write_results appends the same header and row the
+    reference's object-based writer does (scint_utils.py:75-108)."""
+    from scintools_tpu import Dynspec
+
+    r_utils = ref[3]
+    rd = make_ref_dynspec(epoch)
+    rd.tau, rd.tauerr = 100.0, 5.0
+    rd.dnu, rd.dnuerr = 10.0, 0.5
+    rd.betaeta, rd.betaetaerr = 0.4, 0.02
+    ref_csv = tmp_path / "ref.csv"
+    ref_csv.touch()
+    r_utils.write_results(str(ref_csv), dyn=rd)
+
+    ds = Dynspec(data=epoch, process=False, backend="numpy")
+    ds.tau, ds.tauerr = 100.0, 5.0
+    ds.dnu, ds.dnuerr = 10.0, 0.5
+    ds.betaeta, ds.betaetaerr = 0.4, 0.02
+    our_csv = tmp_path / "ours.csv"
+    ds.write_results(str(our_csv))
+
+    ref_lines = ref_csv.read_text().splitlines()
+    our_lines = our_csv.read_text().splitlines()
+    assert our_lines[0] == ref_lines[0]          # identical header
+    assert our_lines[1] == ref_lines[1]          # identical row
