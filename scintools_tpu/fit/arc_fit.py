@@ -183,8 +183,20 @@ def _measure_peak(eta_array, power, filt, noise, constraint,
     # -3 dB on the low-curvature side, -1.5 dB on the high side
     i1, _ = _walk(filt, peak_ind, max_power + low_power_diff)
     _, i2 = _walk(filt, peak_ind, max_power + high_power_diff)
+    # NOTE: the slice start may be negative when the walk overshoots a
+    # peak near the profile edge; python then wraps it, which for the
+    # usual overshoot-to-the-end case selects nearly the whole profile.
+    # The reference relies on exactly this behaviour (dynspec.py:638-641),
+    # so it is kept bit-for-bit; only the truly crashing case (wrap
+    # produces an EMPTY window, a deep numpy reduction error in the
+    # reference) is turned into an informative failure.
     xdata = eta_array[peak_ind - i1: peak_ind + i2]
     ydata = power[peak_ind - i1: peak_ind + i2]
+    if xdata.size == 0:
+        raise ValueError(
+            f"arc peak at grid index {peak_ind} leaves no points for the "
+            f"parabola fit — peak is at the eta-grid edge (widen "
+            f"etamin/etamax or the constraint window)")
     fitter = fit_log_parabola if log_fit else fit_parabola
     yfit, eta, etaerr_fit = fitter(xdata, ydata, xp=np)
     if np.mean(np.gradient(np.diff(yfit))) > 0:
@@ -193,7 +205,8 @@ def _measure_peak(eta_array, power, filt, noise, constraint,
     etaerr = etaerr_fit
     if noise_error:
         j1, j2 = _walk(filt, peak_ind, max_power - noise)
-        etaerr = np.ptp(eta_array[peak_ind - j1: peak_ind + j2]) / 2
+        win = eta_array[peak_ind - j1: peak_ind + j2]  # wrap as reference
+        etaerr = np.ptp(win) / 2 if win.size else np.nan
 
     return ArcFit(eta=eta, etaerr=etaerr, etaerr2=etaerr_fit,
                   lamsteps=lamsteps, profile_eta=eta_array,

@@ -212,10 +212,11 @@ def slow_ft_power_sharded(dyn, freqs, mesh, axis: str = "data",
     from jax import lax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    try:  # jax >= 0.4.35
+    # prefer the stable location (jax.shard_map); experimental fallback
+    # for older jax (same pattern as parallel/mesh.py)
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pragma: no cover - older jax
         from jax.experimental.shard_map import shard_map
-    except ImportError:  # pragma: no cover
-        from jax.shard_map import shard_map
 
     ntime, nfreq = dyn.shape
     freqs = np.asarray(freqs, dtype=np.float64)

@@ -477,18 +477,18 @@ class Dynspec:
         have been made (tau/dnu, eta, betaeta, each with errors) to the
         reference-schema CSV (scint_utils.py:75-108, which takes the
         Dynspec object the same way)."""
-        from .io.results import write_results as _write
+        from .io.results import results_row, write_results as _write
 
-        meta = dict(name=self._data.name, mjd=self._data.mjd,
-                    freq=self._data.freq, bw=self._data.bw,
-                    tobs=self._data.tobs, dt=self._data.dt,
-                    df=self._data.df)
+        meta = results_row(self._data)
         for a in ("tau", "dnu", "eta", "betaeta"):
             v = getattr(self, a, None)
-            if v is not None and np.ndim(v) == 0:
+            err = getattr(self, a + "err", None)
+            # only write complete (value, error) pairs: a bare value with
+            # no error would put a non-numeric token in the CSV and break
+            # float_array_from_dict on read-back
+            if v is not None and err is not None and np.ndim(v) == 0:
                 meta[a] = float(v)
-                err = getattr(self, a + "err", None)
-                meta[a + "err"] = None if err is None else float(err)
+                meta[a + "err"] = float(err)
         _write(filename, meta)
 
     # -- plotting (delegates to the plotting module) -----------------------

@@ -174,11 +174,41 @@ def test_slow_ft_power_sharded_nondivisible_doppler(rng):
     np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9)
 
 
-def test_calc_sspec_slowft_feeds_fit_arc(rng):
-    """The arc-sharpened secondary spectrum from the Dynspec wrapper has
-    ready-to-fit axes: fit_arc on it recovers a curvature consistent with
-    the standard lamsteps chain on the same simulated epoch."""
+def test_calc_sspec_slowft_axes_locate_injected_component(rng):
+    """AXIS GROUND TRUTH: a single interference component at known
+    (delay tau, Doppler fD) must appear at exactly (tau, fD) on the
+    wrapper's tdel/fdop axes — any orientation, flip, or unit error in
+    calc_sspec_slowft moves the peak."""
     from scintools_tpu import Dynspec
+    from scintools_tpu.io import from_arrays
+
+    nf, nt = 128, 256
+    freqs = np.linspace(1350.0, 1450.0, nf)   # MHz
+    times = np.arange(nt) * 8.0               # s
+    tau, fD = 0.5, 3.0                        # us, mHz
+    ph = 2 * np.pi * (tau * (freqs[:, None] - freqs.mean())
+                      + fD * 1e-3 * times[None, :])
+    dyn = 1.0 + 0.5 * np.cos(ph)
+    ds = Dynspec(data=from_arrays(dyn, freqs=freqs, times=times),
+                 process=False, backend="numpy")
+    sec = ds.calc_sspec_slowft()
+    assert np.all(np.diff(sec.fdop) > 0) and np.all(sec.tdel >= 0)
+    s = np.array(sec.sspec)
+    s[0, :] = -np.inf                               # DC delay row
+    ncol = s.shape[1]
+    s[:, ncol // 2 - 1: ncol // 2 + 2] = -np.inf    # DC Doppler column
+    i, j = np.unravel_index(np.argmax(s), s.shape)
+    assert sec.tdel[i] == pytest.approx(tau, abs=2 * (sec.tdel[1]
+                                                      - sec.tdel[0]))
+    assert abs(sec.fdop[j]) == pytest.approx(
+        fD, abs=2 * (sec.fdop[1] - sec.fdop[0]))
+
+
+def test_calc_sspec_slowft_feeds_fit_arc(rng):
+    """The slow-FT SecSpec is accepted unchanged by fit_arc on a
+    simulated epoch and yields a finite measurement."""
+    from scintools_tpu import Dynspec
+    from scintools_tpu.fit import fit_arc
     from scintools_tpu.io import from_simulation
     from scintools_tpu.sim import Simulation
 
@@ -189,17 +219,10 @@ def test_calc_sspec_slowft_feeds_fit_arc(rng):
 
     sec = ds.calc_sspec_slowft()
     assert sec.sspec.shape == (ds._data.nchan // 2, ds._data.nsub)
-    assert np.all(np.diff(sec.fdop) > 0) and np.all(sec.tdel >= 0)
     assert np.all(np.isfinite(sec.sspec[1:, :]))  # row 0 may hit log10(0)
-
-    from scintools_tpu.fit import fit_arc
 
     slow_fit = fit_arc(sec, freq=float(ds._data.freq), numsteps=2000,
                        startbin=2, backend="numpy")
-    ds.fit_arc(lamsteps=True, numsteps=2000)
-    # convert the lamsteps measurement (beta curvature) to eta units via
-    # the reference relation for comparison: both should be positive and
-    # within a factor of ~2 (different transforms, same screen)
     assert slow_fit.eta > 0 and np.isfinite(slow_fit.etaerr)
 
 
