@@ -420,7 +420,10 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
                             method, delmax, numsteps, startbin, cutmid,
                             etamax, etamin, low_power_diff, high_power_diff,
                             ref_freq, constraint, nsmooth, noise_error,
-                            asymm=False):
+                            asymm=False, constraints=None):
+    if asymm and constraints is not None:
+        raise ValueError("asymm=True and multi-arc constraints are "
+                         "mutually exclusive on the batched fitter")
     import jax
     import jax.numpy as jnp
 
@@ -480,13 +483,20 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
     etafrac_avg = 1.0 / etafrac[ipos]               # descending eta
     eta_array = emin * etafrac_avg[::-1] ** 2       # ascending in eta
     keep_static = eta_array < emax                  # static part of validity
-    cons_mask = (eta_array > cons[0]) & (eta_array < cons[1])
+    # multi-arc mode: one shared profile measured under K constraint
+    # windows (constraints=...); single-arc mode uses the one constraint
+    cons_windows = ([cons] if constraints is None
+                    else [np.asarray(c, dtype=np.float64)
+                          for c in constraints])
+    cons_masks = [(eta_array > c[0]) & (eta_array < c[1])
+                  for c in cons_windows]
+    cons_mask = cons_masks[0]
     if method == "norm_sspec":
         # the searchable region is the constraint INTERSECTED with the
         # static validity window (eta < emax): a constraint lying wholly
         # past emax would degenerate silently at fit time otherwise
-        _check_constraint(cons_mask & keep_static,
-                          eta_array[keep_static])
+        for cm in cons_masks:
+            _check_constraint(cm & keep_static, eta_array[keep_static])
     # cutmid NaN columns of the row-normalised spectrum (norm_sspec flavour:
     # floor on both sides, dynspec.py:838-839)
     ncol = len(fdop)
@@ -549,18 +559,26 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         prof = jnp.where(prof[i_at_1] < 0, prof + 2.0, prof)
 
         # ---- fold arms onto the eta grid -------------------------------
-        def measure_arm(arm, nan_on_forward=False):
+        def measure_arm(arm, nan_on_forward=False, cmask=None):
             # arm indexed like ipos (descending eta); flip to ascending
             avg = arm[::-1]
             valid = jnp.isfinite(avg) & jnp.asarray(keep_static)
             return measure_profile(avg, valid, noise,
-                                   jnp.asarray(eta_array), cons_mask,
+                                   jnp.asarray(eta_array),
+                                   cons_mask if cmask is None else cmask,
                                    use_log=False,
                                    nan_on_forward=nan_on_forward)
 
         right = prof[ipos]
         left = prof[ineg][::-1]
-        out = measure_arm((right + left) / 2) + (noise,)
+        combined = (right + left) / 2
+        if constraints is not None:
+            per = [measure_arm(combined, cmask=cm) for cm in cons_masks]
+            return (jnp.stack([p[0] for p in per]),    # eta       [K]
+                    jnp.stack([p[1] for p in per]),    # etaerr    [K]
+                    jnp.stack([p[2] for p in per]),    # etaerr2   [K]
+                    per[0][3], per[0][4], noise)       # shared profile
+        out = measure_arm(combined) + (noise,)
         if asymm:
             el, eel = measure_arm(left, nan_on_forward=True)[:2]
             er, eer = measure_arm(right, nan_on_forward=True)[:2]
@@ -639,8 +657,11 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         nrow_g = ind  # delay rows kept
         eta_array_g = np.linspace(np.sqrt(emin), np.sqrt(emax),
                                   int(numsteps)) ** 2
-        cons_mask_g = (eta_array_g > cons[0]) & (eta_array_g < cons[1])
-        _check_constraint(cons_mask_g, eta_array_g)
+        cons_masks_g = [(eta_array_g > c[0]) & (eta_array_g < c[1])
+                        for c in cons_windows]
+        cons_mask_g = cons_masks_g[0]
+        for cm in cons_masks_g:
+            _check_constraint(cm, eta_array_g)
         # fit-level cutmid mask: floor/CEIL (dynspec.py:455-457) — one
         # column wider on the high side than norm_sspec's floor/floor mask
         col_nan_g = np.zeros(ncol, dtype=bool)
@@ -704,12 +725,20 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
                                eta_p.reshape(-1, chunk)
                                ).reshape(-1, 3)[:S]
 
-            def measure_pow(p, nan_on_forward=False):
+            def measure_pow(p, nan_on_forward=False, cmask=None):
                 return measure_profile(p, jnp.isfinite(p), noise,
                                        jnp.asarray(eta_array_g),
-                                       cons_mask_g, use_log=True,
+                                       cons_mask_g if cmask is None
+                                       else cmask, use_log=True,
                                        nan_on_forward=nan_on_forward)
 
+            if constraints is not None:
+                per = [measure_pow(pows[:, 0], cmask=cm)
+                       for cm in cons_masks_g]
+                return (jnp.stack([q[0] for q in per]),
+                        jnp.stack([q[1] for q in per]),
+                        jnp.stack([q[2] for q in per]),
+                        per[0][3], per[0][4], noise)
             out = measure_pow(pows[:, 0]) + (noise,)
             if asymm:
                 el, eel = measure_pow(pows[:, 1],
@@ -747,7 +776,7 @@ def make_arc_fitter(fdop, yaxis, tdel, freq, lamsteps=True,
                     startbin=3, cutmid=3, etamax=None, etamin=None,
                     low_power_diff=-3.0, high_power_diff=-1.5,
                     ref_freq=1400.0, constraint=(0, np.inf), nsmooth=5,
-                    noise_error=True, asymm=False):
+                    noise_error=True, asymm=False, constraints=None):
     """Build a jit'd batched arc fitter for a fixed (fdop, yaxis) grid.
 
     Returns ``fitter(sspec_batch [B, nr, nc]) -> ArcFit`` of [B] arrays.
@@ -771,7 +800,9 @@ def make_arc_fitter(fdop, yaxis, tdel, freq, lamsteps=True,
         None if etamin is None else float(etamin), float(low_power_diff),
         float(high_power_diff), float(ref_freq),
         (float(constraint[0]), float(constraint[1])), int(nsmooth),
-        bool(noise_error), bool(asymm))
+        bool(noise_error), bool(asymm),
+        None if constraints is None else tuple(
+            (float(lo), float(hi)) for lo, hi in constraints))
 
 
 def fit_arcs_multi(sec: SecSpec, freq: float, brackets,

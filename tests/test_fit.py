@@ -649,3 +649,63 @@ def test_fit_arc_asymm_degenerate_arm_is_nan_on_jax():
     # least wildly unconstrained relative to the right arm
     el = float(f_j.eta_left)
     assert np.isnan(el) or abs(el - 0.5) > 0.25 * 0.5
+
+
+def test_batched_multi_arc_windows():
+    """make_arc_fitter(constraints=[...]) measures K arcs per epoch from
+    ONE shared profile: [B, K] eta leaves, each window's eta inside it."""
+    import jax.numpy as jnp
+
+    sec = _arc_secspec(eta=0.5)
+    # add a second arc at eta=1.5
+    fdop = np.asarray(sec.fdop)
+    tdel = np.asarray(sec.tdel)
+    power = 10 ** (np.asarray(sec.sspec) / 10)
+    for j, f in enumerate(fdop):
+        t = 1.5 * f ** 2
+        i = np.argmin(np.abs(tdel - t))
+        if t <= tdel[-1]:
+            power[max(i - 1, 0): i + 2, j] += 0.6
+    sec2 = SecSpec(sspec=10 * np.log10(power), fdop=fdop, tdel=tdel,
+                   beta=tdel, lamsteps=True)
+
+    windows = ((0.25, 0.9), (1.0, 2.5))
+    fitter = make_arc_fitter(fdop=fdop, yaxis=tdel, tdel=tdel, freq=1400.0,
+                             lamsteps=True, numsteps=2000,
+                             constraints=windows)
+    batch = fitter(jnp.asarray(sec2.sspec)[None])
+    eta = np.asarray(batch.eta)
+    assert eta.shape == (1, 2)
+    assert windows[0][0] < eta[0, 0] < windows[0][1]
+    assert windows[1][0] < eta[0, 1] < windows[1][1]
+    assert eta[0, 0] == pytest.approx(0.5, rel=0.2)
+    assert eta[0, 1] == pytest.approx(1.5, rel=0.2)
+
+
+def test_pipeline_arc_brackets_batched():
+    """PipelineConfig(arc_brackets=...) yields [B, K] curvature leaves
+    from the one-jit step."""
+    import jax.numpy as jnp
+
+    from scintools_tpu.parallel import PipelineConfig, make_pipeline
+
+    rng = np.random.default_rng(9)
+    B, nf, nt = 2, 48, 48
+    dyn = (1 + 0.3 * rng.standard_normal((B, nf, nt))).astype(np.float32)**2
+    freqs = np.linspace(1380.0, 1420.0, nf)
+    times = np.arange(nt) * 4.0
+    cfg = PipelineConfig(arc_numsteps=300, lm_steps=10, fit_scint=False,
+                         arc_brackets=((0.0, 5.0), (5.0, np.inf)))
+    res = make_pipeline(freqs, times, cfg)(jnp.asarray(dyn))
+    assert np.asarray(res.arc.eta).shape == (B, 2)
+    assert np.asarray(res.arc.etaerr).shape == (B, 2)
+
+
+def test_batched_multi_arc_rejects_asymm_combo():
+    sec = _arc_secspec(eta=0.5)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        make_arc_fitter(fdop=np.asarray(sec.fdop),
+                        yaxis=np.asarray(sec.tdel),
+                        tdel=np.asarray(sec.tdel), freq=1400.0,
+                        lamsteps=True, numsteps=500, asymm=True,
+                        constraints=((0.1, 1.0),))
