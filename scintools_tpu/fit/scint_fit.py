@@ -239,16 +239,17 @@ def _crop_acf_2d(acf2d, nchan, nsub, crop_t, crop_f):
 
 
 def fit_scint_params_2d(acf2d, dt, df, nchan: int, nsub: int,
-                        alpha: float = _ALPHA_KOLMOGOROV,
+                        alpha: float | None = _ALPHA_KOLMOGOROV,
                         crop_frac: float = 0.5, backend: str = "numpy",
                         steps: int = 60):
     """Fit the 2-D ACF model (models.scint_acf_model_2d — the reference's
     empty ``acf2d`` method, dynspec.py:953-957 / scint_models.py:108-112)
     over a central window of the 2-D ACF.
 
-    Fits (tau, dnu, amp, wn, tilt); the extra ``tilt`` (s/MHz) measures
-    the phase-gradient shear invisible to the 1-D cuts.  Returns
-    (ScintParams, tilt, tilterr).
+    Fits (tau, dnu, amp, wn, tilt), plus the power-law index when
+    ``alpha=None`` (free alpha, as on the 1-D path).  The extra ``tilt``
+    (s/MHz) measures the phase-gradient shear invisible to the 1-D cuts.
+    Returns (ScintParams, tilt, tilterr).
     """
     from ..models.acf_models import scint_acf_model_2d
 
@@ -263,9 +264,11 @@ def fit_scint_params_2d(acf2d, dt, df, nchan: int, nsub: int,
     # initial guesses from the 1-D cuts machinery
     xt1, yt1, xf1, yf1 = acf_cuts(a, dt, abs(df), nchan, nsub, xp=np)
     tau0, dnu0, amp0, wn0 = initial_guesses(xt1, yt1, xf1, yf1, xp=np)
-    p0 = np.array([float(tau0), float(dnu0), float(amp0), float(wn0), 0.0])
-    lo = [1e-10, 1e-10, 0.0, 0.0, -np.inf]
-    hi = [np.inf] * 4 + [np.inf]
+    free = alpha is None
+    p0 = np.array([float(tau0), float(dnu0), float(amp0), float(wn0), 0.0]
+                  + ([_ALPHA_KOLMOGOROV] if free else []))
+    lo = [1e-10, 1e-10, 0.0, 0.0, -np.inf] + ([0.0] if free else [])
+    hi = [np.inf] * 5 + ([8.0] if free else [])
 
     # taper scales = FULL scan extents (the ACF's finite-scan bias is set
     # by the observation length, not by our fit window)
@@ -273,8 +276,9 @@ def fit_scint_params_2d(acf2d, dt, df, nchan: int, nsub: int,
 
     if backend == "numpy":
         def resid(p):
+            a_ = p[5] if free else alpha
             m = scint_acf_model_2d(x_t, x_f, p[0], p[1], p[2], p[3],
-                                   alpha, p[4], tmax=tmax, fmax=fmax,
+                                   a_, p[4], tmax=tmax, fmax=fmax,
                                    xp=np)
             return (win - m).ravel()
 
@@ -285,8 +289,9 @@ def fit_scint_params_2d(acf2d, dt, df, nchan: int, nsub: int,
         import jax.numpy as jnp
 
         def resid_j(p, w, xt, xf):
+            a_ = p[5] if free else alpha
             m = scint_acf_model_2d(xt, xf, p[0], p[1], p[2], p[3],
-                                   alpha, p[4], tmax=tmax, fmax=fmax,
+                                   a_, p[4], tmax=tmax, fmax=fmax,
                                    xp=jnp)
             return (w - m).ravel()
 
@@ -299,7 +304,9 @@ def fit_scint_params_2d(acf2d, dt, df, nchan: int, nsub: int,
 
     sp = ScintParams(tau=params[0], tauerr=stderr[0], dnu=params[1],
                      dnuerr=stderr[1], amp=params[2], wn=params[3],
-                     talpha=alpha, redchi=redchi)
+                     talpha=float(params[5]) if free else alpha,
+                     talphaerr=float(stderr[5]) if free else None,
+                     redchi=redchi)
     return sp, float(params[4]), float(stderr[4])
 
 

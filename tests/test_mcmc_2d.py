@@ -170,3 +170,26 @@ def test_2d_batch_matches_single_epoch():
     assert float(tilt_b[0]) == pytest.approx(tilt_s, rel=0.02, abs=0.1)
     assert float(np.asarray(sp_b.tau)[0]) == pytest.approx(
         float(np.asarray(sp_s.tau)), rel=0.02)
+
+
+def test_fit_scint_params_2d_free_alpha(acf_fixture_2d=None):
+    """alpha=None on the 2-D path fits the power-law index too, recovering
+    the synthetic alpha within tolerance (as the 1-D free-alpha path)."""
+    from scintools_tpu.fit.scint_fit import fit_scint_params_2d
+    from scintools_tpu.models.acf_models import scint_acf_model_2d
+
+    dt, df = 10.0, 0.5
+    nchan, nsub = 48, 64
+    tau, dnu, alpha_true = 120.0, 4.0, 1.9
+    x_t = dt * (np.arange(2 * nsub) - nsub)
+    x_f = df * (np.arange(2 * nchan) - nchan)
+    acf2d = scint_acf_model_2d(x_t, x_f, tau, dnu, 1.0, 0.02, alpha_true,
+                               0.0, xp=np)
+    rng = np.random.default_rng(2)
+    acf2d = acf2d + 0.005 * rng.standard_normal(acf2d.shape)
+    sp, tilt, tilterr = fit_scint_params_2d(acf2d, dt, df, nchan, nsub,
+                                            alpha=None, backend="numpy")
+    assert float(sp.tau) == pytest.approx(tau, rel=0.15)
+    assert float(sp.dnu) == pytest.approx(dnu, rel=0.15)
+    assert float(sp.talpha) == pytest.approx(alpha_true, abs=0.4)
+    assert sp.talphaerr is not None and float(sp.talphaerr) > 0
