@@ -97,28 +97,34 @@ def ensemble_sample(log_prob_fn, p0, key=None, steps: int = 500,
 
 
 @functools.lru_cache(maxsize=32)
-def _scint_sampler_cached(nt: int, nf: int, alpha: float, nwalkers: int,
-                          steps: int):
+def _scint_sampler_cached(nt: int, nf: int, alpha: float | None,
+                          nwalkers: int, steps: int):
     """Sampler for the scint-params posterior, cached on static shapes
     only; the per-epoch data (lags, ACF cuts, noise scale) are traced
-    arguments, so surveys over many epochs reuse one compiled program."""
+    arguments, so surveys over many epochs reuse one compiled program.
+    ``alpha=None`` samples the power-law index as a fifth dimension."""
     import jax.numpy as jnp
 
     from ..models.acf_models import scint_acf_model
 
+    free = alpha is None
+
     def log_prob(p, x_t, x_f, y, sigma):
         tau, dnu, amp, wn = p[0], p[1], p[2], p[3]
+        a_ = p[4] if free else alpha
         inside = (tau > 0) & (dnu > 0) & (amp > 0) & (wn >= 0)
-        model = scint_acf_model(x_t, x_f, tau, dnu, amp, wn, alpha,
+        if free:
+            inside = inside & (a_ > 0) & (a_ < 8.0)
+        model = scint_acf_model(x_t, x_f, tau, dnu, amp, wn, a_,
                                 xp=jnp)
         chi2 = jnp.sum(((y - model) / sigma) ** 2)
         return jnp.where(inside, -0.5 * chi2, -jnp.inf)
 
-    return _build_sampler(4, nwalkers, steps, 2.0, log_prob)
+    return _build_sampler(5 if free else 4, nwalkers, steps, 2.0, log_prob)
 
 
 def fit_scint_params_mcmc(acf2d, dt, df, nchan: int, nsub: int,
-                          alpha: float = 5 / 3, nwalkers: int = 32,
+                          alpha: float | None = 5 / 3, nwalkers: int = 32,
                           steps: int = 600, burn: int = 300,
                           seed: int = 0, return_chain: bool = False):
     """Posterior tau/dnu/amp/wn via ensemble MCMC around the LM solution
@@ -141,29 +147,35 @@ def fit_scint_params_mcmc(acf2d, dt, df, nchan: int, nsub: int,
         raise ValueError(f"burn ({burn}) must be < steps ({steps})")
 
     # start from the deterministic fit
+    free = alpha is None
     lm = fit_scint_params(acf2d, dt, df, nchan, nsub, alpha=alpha,
                           backend="numpy")
+    alpha_best = float(np.asarray(lm.talpha))
     p_best = np.array([float(lm.tau), float(lm.dnu), float(lm.amp),
-                       float(lm.wn)])
+                       float(lm.wn)] + ([alpha_best] if free else []))
+    ndim = len(p_best)
     x_t, y_t, x_f, y_f = acf_cuts(np.asarray(acf2d, dtype=np.float64),
                                   dt, df, nchan, nsub, xp=np)
     y = np.concatenate([y_t, y_f])
-    resid = y - scint_acf_model(x_t, x_f, *p_best, alpha, xp=np)
+    resid = y - scint_acf_model(x_t, x_f, *p_best[:4], alpha_best, xp=np)
     sigma = max(float(np.std(resid)), 1e-12)
 
     rng = np.random.default_rng(seed)
-    p0 = p_best * (1.0 + 0.01 * rng.standard_normal((nwalkers, 4)))
+    p0 = p_best * (1.0 + 0.01 * rng.standard_normal((nwalkers, ndim)))
     p0 = np.abs(p0) + 1e-12
-    run = _scint_sampler_cached(len(x_t), len(x_f), float(alpha),
+    run = _scint_sampler_cached(len(x_t), len(x_f),
+                                None if free else float(alpha),
                                 int(nwalkers), int(steps))
     chain, _ = run(jax.random.PRNGKey(seed), jnp.asarray(p0),
                    jnp.asarray(x_t), jnp.asarray(x_f), jnp.asarray(y),
                    jnp.asarray(sigma))
-    post = np.asarray(chain[burn:]).reshape(-1, 4)
+    post = np.asarray(chain[burn:]).reshape(-1, ndim)
     med = np.median(post, axis=0)
     std = np.std(post, axis=0)
     out = ScintParams(tau=med[0], tauerr=std[0], dnu=med[1], dnuerr=std[1],
-                      amp=med[2], wn=med[3], talpha=alpha,
+                      amp=med[2], wn=med[3],
+                      talpha=med[4] if free else alpha,
+                      talphaerr=std[4] if free else None,
                       redchi=float(np.asarray(lm.redchi)))
     if return_chain:
         return out, np.asarray(chain[burn:])

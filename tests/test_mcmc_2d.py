@@ -193,3 +193,28 @@ def test_fit_scint_params_2d_free_alpha(acf_fixture_2d=None):
     assert float(sp.dnu) == pytest.approx(dnu, rel=0.15)
     assert float(sp.talpha) == pytest.approx(alpha_true, abs=0.4)
     assert sp.talphaerr is not None and float(sp.talphaerr) > 0
+
+
+def test_mcmc_free_alpha_samples_index():
+    """mcmc with alpha=None samples the power-law index as a fifth
+    dimension, recovering a synthetic alpha with a posterior spread."""
+    from scintools_tpu.fit.mcmc import fit_scint_params_mcmc
+    from scintools_tpu.models.acf_models import scint_acf_model
+
+    dt, df = 10.0, 0.5
+    nchan, nsub = 48, 64
+    tau, dnu, alpha_true = 120.0, 4.0, 2.0
+    x_t = dt * np.linspace(0, nsub, nsub)
+    x_f = df * np.linspace(0, nchan, nchan)
+    y = scint_acf_model(x_t, x_f, tau, dnu, 1.0, 0.02, alpha_true, xp=np)
+    rng = np.random.default_rng(4)
+    y = y + 0.01 * rng.standard_normal(y.shape)
+    # assemble a fake 2-D ACF whose central cuts reproduce (y_t, y_f)
+    acf2d = np.zeros((2 * nchan, 2 * nsub))
+    acf2d[nchan, nsub:] = y[:nsub]
+    acf2d[nchan:, nsub] = y[nsub:]
+    sp = fit_scint_params_mcmc(acf2d, dt, df, nchan, nsub, alpha=None,
+                               steps=400, burn=200, seed=1)
+    assert float(sp.talpha) == pytest.approx(alpha_true, abs=0.6)
+    assert sp.talphaerr is not None and float(sp.talphaerr) > 0
+    assert float(sp.tau) == pytest.approx(tau, rel=0.3)
