@@ -311,7 +311,7 @@ def fit_scint_params_2d(acf2d, dt, df, nchan: int, nsub: int,
 
 
 def fit_scint_params_sspec(acf2d, dt, df, nchan: int, nsub: int,
-                           alpha: float = _ALPHA_KOLMOGOROV,
+                           alpha: float | None = _ALPHA_KOLMOGOROV,
                            backend: str = "numpy",
                            steps: int = 60) -> ScintParams:
     """Fit tau/dnu in the Fourier (power-spectrum) domain — the method the
@@ -334,14 +334,17 @@ def fit_scint_params_sspec(acf2d, dt, df, nchan: int, nsub: int,
 
     y_spec = np.concatenate([mirror_spectrum(y_t, xp=np),
                              mirror_spectrum(y_f, xp=np)])
-    p0 = np.array([float(tau0), float(dnu0), float(amp0), float(wn0)])
-    lo = [1e-10, 1e-10, 0.0, 0.0]
-    hi = [np.inf] * 4
+    free = alpha is None
+    p0 = np.array([float(tau0), float(dnu0), float(amp0), float(wn0)]
+                  + ([_ALPHA_KOLMOGOROV] if free else []))
+    lo = [1e-10, 1e-10, 0.0, 0.0] + ([0.0] if free else [])
+    hi = [np.inf] * 4 + ([8.0] if free else [])
 
     if backend == "numpy":
         def resid(p):
+            a_ = p[4] if free else alpha
             return y_spec - scint_sspec_model(x_t, x_f, p[0], p[1], p[2],
-                                              p[3], alpha, xp=np)
+                                              p[3], a_, xp=np)
 
         res = least_squares_numpy(resid, p0, bounds=(lo, hi))
     else:
@@ -351,8 +354,9 @@ def fit_scint_params_sspec(acf2d, dt, df, nchan: int, nsub: int,
         x_t_j, x_f_j = jnp.asarray(x_t), jnp.asarray(x_f)
 
         def resid_j(p, xt, xf, ys):
+            a_ = p[4] if free else alpha
             return ys - scint_sspec_model(xt, xf, p[0], p[1], p[2], p[3],
-                                          alpha, xp=jnp)
+                                          a_, xp=jnp)
 
         res = lm_fit_jax(resid_j, jnp.asarray(p0),
                          bounds=(jnp.asarray(lo), jnp.asarray(hi)),

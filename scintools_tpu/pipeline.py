@@ -404,11 +404,7 @@ class Dynspec:
         b = resolve(backend or self.backend)
         kw = dict(dt=self._data.dt, df=abs(self._data.df),
                   nchan=self._data.nchan, nsub=self._data.nsub)
-        if alpha is None and method == "sspec":
-            raise NotImplementedError(
-                "free alpha (alpha=None) is supported by the acf1d (LM and "
-                "mcmc) and acf2d fits; the sspec path fits with fixed "
-                "alpha")
+
         if method == "acf1d":
             if mcmc:
                 from .fit.mcmc import fit_scint_params_mcmc

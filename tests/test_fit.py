@@ -709,3 +709,15 @@ def test_batched_multi_arc_rejects_asymm_combo():
                         tdel=np.asarray(sec.tdel), freq=1400.0,
                         lamsteps=True, numsteps=500, asymm=True,
                         constraints=((0.1, 1.0),))
+
+
+def test_scint_params_sspec_free_alpha(sim_dynspec):
+    """alpha=None on the Fourier-domain fit: every get_scint_params
+    method now supports a free power-law index."""
+    from scintools_tpu import Dynspec
+
+    ds = Dynspec(data=sim_dynspec, process=False, backend="numpy")
+    ds.calc_acf()
+    sp = ds.get_scint_params(method="sspec", alpha=None)
+    assert 0 < float(sp.talpha) < 8
+    assert np.isfinite(ds.tau) and np.isfinite(ds.dnu)
