@@ -278,3 +278,18 @@ def test_pipeline_non_lamsteps_config():
     assert tau.shape == (2,) and np.all(np.isfinite(tau)) and np.all(tau > 0)
     assert eta.shape == (2,) and np.all(np.isfinite(eta))
     assert res.beta is None  # no lambda axis without lamsteps
+
+
+def test_run_pipeline_chunked_matches_unchunked(epochs):
+    """Memory-bounded chunking (chunk < B) concatenates per-chunk results
+    into exactly the unchunked answer."""
+    cfg = PipelineConfig(arc_numsteps=400, lm_steps=20)
+    [(idx_u, res_u)] = run_pipeline(epochs, cfg)
+    [(idx_c, res_c)] = run_pipeline(epochs, cfg, chunk=1)
+    np.testing.assert_array_equal(np.asarray(idx_u), np.asarray(idx_c))
+    np.testing.assert_allclose(np.asarray(res_c.scint.tau),
+                               np.asarray(res_u.scint.tau), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(res_c.arc.eta),
+                               np.asarray(res_u.arc.eta), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(res_c.arc.profile_eta),
+                                  np.asarray(res_u.arc.profile_eta))
