@@ -466,10 +466,11 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
     # constraint sanity: the masks are host-side static, so an impossible
     # window fails at build time like the numpy path does at fit time
     # (otherwise the traced argmax would degenerate silently to index 0)
-    def _check_constraint(grid_mask, grid):
+    def _check_constraint(grid_mask, grid, window=None):
         if not grid_mask.any():
+            w = tuple(cons) if window is None else tuple(window)
             raise ValueError(
-                f"no eta grid points inside constraint {tuple(cons)} "
+                f"no eta grid points inside constraint {w} "
                 f"(grid spans {grid.min():.4g}..{grid.max():.4g})")
 
     # norm_sspec internals (maxnormfac=1): rows startbin..ind_norm-1
@@ -484,10 +485,18 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
     eta_array = emin * etafrac_avg[::-1] ** 2       # ascending in eta
     keep_static = eta_array < emax                  # static part of validity
     # multi-arc mode: one shared profile measured under K constraint
-    # windows (constraints=...); single-arc mode uses the one constraint
+    # windows (constraints=...); single-arc mode uses the one constraint.
+    # Windows get the same unit conversion the single constraint received
+    # above (lamsteps=False fits run in converted beta-eta units)
+    def _conv_window(c):
+        c = np.asarray(c, dtype=np.float64)
+        if not lamsteps:
+            c = c / (freq / ref_freq) ** 2 * _beta_to_eta_factor(freq,
+                                                                ref_freq)
+        return c
+
     cons_windows = ([cons] if constraints is None
-                    else [np.asarray(c, dtype=np.float64)
-                          for c in constraints])
+                    else [_conv_window(c) for c in constraints])
     cons_masks = [(eta_array > c[0]) & (eta_array < c[1])
                   for c in cons_windows]
     cons_mask = cons_masks[0]
@@ -495,8 +504,9 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         # the searchable region is the constraint INTERSECTED with the
         # static validity window (eta < emax): a constraint lying wholly
         # past emax would degenerate silently at fit time otherwise
-        for cm in cons_masks:
-            _check_constraint(cm & keep_static, eta_array[keep_static])
+        for cm, w in zip(cons_masks, cons_windows):
+            _check_constraint(cm & keep_static, eta_array[keep_static],
+                              window=w)
     # cutmid NaN columns of the row-normalised spectrum (norm_sspec flavour:
     # floor on both sides, dynspec.py:838-839)
     ncol = len(fdop)
@@ -517,6 +527,16 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
     # half-ulp slack so ceil/floor match searchsorted when a query lands
     # exactly on a grid value (linspace grids differ in the last ulp)
     _EDGE_EPS = 1e-12
+
+    def _stack_windows(measure_fn, masks, noise):
+        """Measure one shared profile under K constraint windows and
+        stack the per-window (eta, etaerr, etaerr2); profile/filter come
+        from the first window (identical across windows)."""
+        per = [measure_fn(cmask=cm) for cm in masks]
+        return (jnp.stack([q[0] for q in per]),
+                jnp.stack([q[1] for q in per]),
+                jnp.stack([q[2] for q in per]),
+                per[0][3], per[0][4], noise)
 
     # ---- static row-interp pattern ------------------------------------
     # The interpolation positions depend only on the (fdop, scales) grids,
@@ -573,11 +593,9 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         left = prof[ineg][::-1]
         combined = (right + left) / 2
         if constraints is not None:
-            per = [measure_arm(combined, cmask=cm) for cm in cons_masks]
-            return (jnp.stack([p[0] for p in per]),    # eta       [K]
-                    jnp.stack([p[1] for p in per]),    # etaerr    [K]
-                    jnp.stack([p[2] for p in per]),    # etaerr2   [K]
-                    per[0][3], per[0][4], noise)       # shared profile
+            return _stack_windows(
+                functools.partial(measure_arm, combined), cons_masks,
+                noise)
         out = measure_arm(combined) + (noise,)
         if asymm:
             el, eel = measure_arm(left, nan_on_forward=True)[:2]
@@ -660,8 +678,8 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         cons_masks_g = [(eta_array_g > c[0]) & (eta_array_g < c[1])
                         for c in cons_windows]
         cons_mask_g = cons_masks_g[0]
-        for cm in cons_masks_g:
-            _check_constraint(cm, eta_array_g)
+        for cm, w in zip(cons_masks_g, cons_windows):
+            _check_constraint(cm, eta_array_g, window=w)
         # fit-level cutmid mask: floor/CEIL (dynspec.py:455-457) — one
         # column wider on the high side than norm_sspec's floor/floor mask
         col_nan_g = np.zeros(ncol, dtype=bool)
@@ -733,12 +751,9 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
                                        nan_on_forward=nan_on_forward)
 
             if constraints is not None:
-                per = [measure_pow(pows[:, 0], cmask=cm)
-                       for cm in cons_masks_g]
-                return (jnp.stack([q[0] for q in per]),
-                        jnp.stack([q[1] for q in per]),
-                        jnp.stack([q[2] for q in per]),
-                        per[0][3], per[0][4], noise)
+                return _stack_windows(
+                    functools.partial(measure_pow, pows[:, 0]),
+                    cons_masks_g, noise)
             out = measure_pow(pows[:, 0]) + (noise,)
             if asymm:
                 el, eel = measure_pow(pows[:, 1],

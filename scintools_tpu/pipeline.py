@@ -404,6 +404,10 @@ class Dynspec:
         b = resolve(backend or self.backend)
         kw = dict(dt=self._data.dt, df=abs(self._data.df),
                   nchan=self._data.nchan, nsub=self._data.nsub)
+        if mcmc and method != "acf1d":
+            raise NotImplementedError(
+                "mcmc=True is only implemented for method='acf1d' "
+                "(posterior sampling of the 1-D ACF-cuts model)")
 
         if method == "acf1d":
             if mcmc:

@@ -721,3 +721,37 @@ def test_scint_params_sspec_free_alpha(sim_dynspec):
     sp = ds.get_scint_params(method="sspec", alpha=None)
     assert 0 < float(sp.talpha) < 8
     assert np.isfinite(ds.tau) and np.isfinite(ds.dnu)
+
+
+def test_batched_multi_arc_non_lamsteps_window_units():
+    """constraints windows on a tdel-space (lamsteps=False) fitter get the
+    same beta-eta unit conversion as the single constraint: a window
+    bracketing the fitted eta in USER units must contain the measurement."""
+    import jax.numpy as jnp
+
+    from scintools_tpu.fit.arc_fit import _beta_to_eta_factor
+
+    sec_lam = _arc_secspec(eta=0.5)
+    sec = SecSpec(sspec=np.asarray(sec_lam.sspec), fdop=sec_lam.fdop,
+                  tdel=sec_lam.tdel, beta=None, lamsteps=False)
+    freq = 1200.0
+    single = fit_arc(sec, freq=freq, numsteps=1500, backend="jax")
+    b2e = _beta_to_eta_factor(freq, 1400.0) / (freq / 1400.0) ** 2
+    eta_user = float(single.eta) / b2e
+    fitter = make_arc_fitter(fdop=np.asarray(sec.fdop),
+                             yaxis=np.asarray(sec.tdel),
+                             tdel=np.asarray(sec.tdel), freq=freq,
+                             lamsteps=False, numsteps=1500,
+                             constraints=((0.5 * eta_user, 2 * eta_user),))
+    batch = fitter(jnp.asarray(sec.sspec)[None])
+    np.testing.assert_allclose(float(batch.eta[0, 0]), float(single.eta),
+                               rtol=1e-9)
+
+
+def test_get_scint_params_mcmc_other_methods_raise(sim_dynspec):
+    from scintools_tpu import Dynspec
+
+    ds = Dynspec(data=sim_dynspec, process=False, backend="numpy")
+    ds.calc_acf()
+    with pytest.raises(NotImplementedError, match="acf1d"):
+        ds.get_scint_params(method="acf2d", mcmc=True)

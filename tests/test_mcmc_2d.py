@@ -172,7 +172,7 @@ def test_2d_batch_matches_single_epoch():
         float(np.asarray(sp_s.tau)), rel=0.02)
 
 
-def test_fit_scint_params_2d_free_alpha(acf_fixture_2d=None):
+def test_fit_scint_params_2d_free_alpha():
     """alpha=None on the 2-D path fits the power-law index too, recovering
     the synthetic alpha within tolerance (as the 1-D free-alpha path)."""
     from scintools_tpu.fit.scint_fit import fit_scint_params_2d
