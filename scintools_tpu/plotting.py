@@ -146,20 +146,46 @@ def plot_sspec(sec: SecSpec, eta: float | None = None, ax=None,
 
 
 def plot_norm_sspec(ns, ax=None, filename: str | None = None,
-                    display: bool = False):
-    """Delay-scrunched normalised profile vs normalised f_t
-    (dynspec.py:869-916)."""
+                    display: bool = False, unscrunched: bool = False,
+                    powerspec: bool = False, lamsteps: bool = True):
+    """Curvature-normalised secondary-spectrum views (dynspec.py:869-925):
+    the delay-scrunched profile, plus (``unscrunched``) the 2-D normalised
+    spectrum and (``powerspec``) the delay power spectrum vs sqrt(tdel) —
+    the reference's three panels."""
     import matplotlib.pyplot as plt
 
+    npanels = 1 + int(unscrunched) + int(powerspec)
     if ax is None:
-        fig, ax = plt.subplots(figsize=(8, 4))
+        fig, axes = plt.subplots(1, npanels,
+                                 figsize=(6 * npanels, 4), squeeze=False)
+        axes = list(axes[0])
     else:
-        fig = ax.figure
-    ax.plot(to_numpy(ns.fdopnew), to_numpy(ns.normsspecavg), "k-", lw=1)
+        fig, axes = ax.figure, [ax]
+    a = axes.pop(0)
+    a.plot(to_numpy(ns.fdopnew), to_numpy(ns.normsspecavg), "k-", lw=1)
     for x in (-1, 1):
-        ax.axvline(x, color="r", ls=":", lw=1)
-    ax.set_xlabel("Normalised f_t")
-    ax.set_ylabel("Mean power (dB)")
+        a.axvline(x, color="r", ls=":", lw=1)
+    a.set_xlabel("Normalised f_t")
+    a.set_ylabel("Mean power (dB)")
+    ylab = (r"$f_\lambda$ (m$^{-1}$)" if lamsteps
+            else r"$f_\nu$ ($\mu$s)")
+    if unscrunched and axes:
+        a = axes.pop(0)
+        arr = to_numpy(ns.normsspec)
+        vmin, vmax = _pclim(arr)
+        mesh = a.pcolormesh(to_numpy(ns.fdopnew), to_numpy(ns.tdel), arr,
+                            vmin=vmin, vmax=vmax, shading="auto")
+        for x in (-1, 1):
+            a.axvline(x, color="r", ls=":", lw=1)
+        a.set_xlabel("Normalised f_t")
+        a.set_ylabel(ylab)
+        fig.colorbar(mesh, ax=a, label="Power (dB)")
+    if powerspec and axes:
+        a = axes.pop(0)
+        a.loglog(np.sqrt(to_numpy(ns.tdel)), to_numpy(ns.powerspec))
+        a.set_xlabel(ylab.replace("(", "$^{1/2}$ ("))
+        a.set_ylabel("Mean power (dB)")
+    fig.tight_layout()
     return _finish(fig, filename, display)
 
 

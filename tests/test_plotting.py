@@ -95,3 +95,18 @@ def test_plot_dyn_lamsteps_and_trap(sim_dynspec, tmp_path):
     out2 = tmp_path / "trap.png"
     ds.plot_dyn(trap=True, filename=str(out2))
     assert out2.exists() and ds.trapdyn is not None
+
+
+def test_plot_norm_sspec_all_panels(sim_dynspec, tmp_path):
+    """The three reference norm_sspec views (scrunched, unscrunched 2-D,
+    power spectrum) render (dynspec.py:869-925)."""
+    from scintools_tpu import Dynspec
+    from scintools_tpu.plotting import plot_norm_sspec
+
+    ds = Dynspec(data=sim_dynspec, process=True, lamsteps=True,
+                 backend="numpy")
+    ns = ds.norm_sspec(eta=0.5, numsteps=128)
+    out = tmp_path / "norm3.png"
+    plot_norm_sspec(ns, filename=str(out), unscrunched=True,
+                    powerspec=True)
+    assert out.exists() and out.stat().st_size > 0
