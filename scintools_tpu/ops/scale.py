@@ -106,6 +106,39 @@ def _cubic_interp_jax():
     return impl
 
 
+def natural_cubic_interp_numpy(y: np.ndarray, x: np.ndarray,
+                               xq: np.ndarray) -> np.ndarray:
+    """Host-side natural cubic spline along axis 0 — the exact numpy
+    transcription of the jax solver above (same boundary conditions, so
+    the two agree to rounding).  Used where device execution must be
+    avoided at build time (e.g. precomputing resampling weights while
+    the accelerator is untouched/unreachable)."""
+    y = np.asarray(y, dtype=np.float64)
+    x = np.asarray(x, dtype=np.float64)
+    xq = np.asarray(xq, dtype=np.float64)
+    n = x.shape[0]
+    h = np.diff(x)
+    A = np.zeros((n, n))
+    A[0, 0] = A[n - 1, n - 1] = 1.0
+    idx = np.arange(1, n - 1)
+    A[idx, idx - 1] = h[:-1]
+    A[idx, idx] = 2.0 * (h[:-1] + h[1:])
+    A[idx, idx + 1] = h[1:]
+    slope = np.diff(y, axis=0) / h[:, None]
+    rhs = np.zeros_like(y)
+    rhs[1:-1] = 6.0 * (slope[1:] - slope[:-1])
+    m = np.linalg.solve(A, rhs)
+
+    j = np.clip(np.searchsorted(x, xq, side="right") - 1, 0, n - 2)
+    hj = (x[j + 1] - x[j])[:, None]
+    t0 = (x[j + 1][:, None] - xq[:, None])
+    t1 = (xq[:, None] - x[j][:, None])
+    yj, yj1, mj, mj1 = y[j], y[j + 1], m[j], m[j + 1]
+    return (mj * t0 ** 3 / (6 * hj) + mj1 * t1 ** 3 / (6 * hj)
+            + (yj / hj - mj * hj / 6) * t0
+            + (yj1 / hj - mj1 * hj / 6) * t1)
+
+
 def scale_trapezoid(d: DynspecData, window: str | None = "hanning",
                     window_frac: float = 0.1) -> np.ndarray:
     """Trapezoid time-rescaling (dynspec.py:1429-1476): mean-subtract,

@@ -111,14 +111,17 @@ def lambda_resample_matrix(freqs: np.ndarray) -> tuple[np.ndarray, np.ndarray, f
     to descending wavelength, matching ops.scale.scale_lambda / reference
     dynspec.py:1427-1428).  Spline interpolation is linear in the data, so
     W columns are the splines of the unit vectors."""
-    from ..ops.scale import _cubic_interp_jax
+    from ..ops.scale import natural_cubic_interp_numpy
     from ..data import _C_M_S
 
     freqs = np.asarray(freqs, dtype=np.float64)
     lam_eq, dlam = lambda_grid(freqs)
     feq = _C_M_S / lam_eq / 1e6
     eye = np.eye(len(freqs))
-    W = np.asarray(_cubic_interp_jax()(eye, freqs, feq))  # [nlam, nf]
+    # host-side numpy transcription of the jax natural-spline solver:
+    # building the pipeline must not execute anything on the device
+    # (the accelerator may be deliberately untouched at build time)
+    W = natural_cubic_interp_numpy(eye, freqs, feq)  # [nlam, nf]
     return W[::-1].copy(), lam_eq[::-1].copy(), float(dlam)
 
 

@@ -293,3 +293,46 @@ def test_run_pipeline_chunked_matches_unchunked(epochs):
                                np.asarray(res_u.arc.eta), rtol=1e-6)
     np.testing.assert_array_equal(np.asarray(res_c.arc.profile_eta),
                                   np.asarray(res_u.arc.profile_eta))
+
+
+def test_natural_cubic_numpy_matches_jax_solver():
+    """The host-side spline transcription agrees with the jax solver it
+    replaces in lambda_resample_matrix (same natural boundary conditions)."""
+    from scintools_tpu.ops.scale import (_cubic_interp_jax,
+                                         natural_cubic_interp_numpy)
+
+    rng = np.random.default_rng(6)
+    x = np.sort(rng.uniform(0, 10, 24))
+    xq = np.linspace(x[0], x[-1], 57)
+    y = rng.standard_normal((24, 5))
+    got = natural_cubic_interp_numpy(y, x, xq)
+    want = np.asarray(_cubic_interp_jax()(y, x, xq))
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-12)
+
+
+def test_make_pipeline_builds_without_device_execution(monkeypatch):
+    """Building the pipeline must run nothing on a device: entry() is
+    compile-checked by the driver against real hardware that may be
+    deliberately untouched until the step itself runs."""
+    import jax
+
+    calls = []
+    orig = jax.jit
+
+    def spy_jit(*a, **k):
+        f = orig(*a, **k)
+
+        def wrapped(*fa, **fk):
+            calls.append("exec")
+            return f(*fa, **fk)
+
+        wrapped.lower = getattr(f, "lower", None)
+        return wrapped
+
+    monkeypatch.setattr(jax, "jit", spy_jit)
+    freqs = np.linspace(1390.0, 1410.0, 24)
+    times = np.arange(24) * 4.0
+    # fresh config value so the lru_cache cannot return a prebuilt step
+    make_pipeline(freqs, times, PipelineConfig(arc_numsteps=311,
+                                               lm_steps=7))
+    assert calls == []
