@@ -159,9 +159,12 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
     if config.lamsteps:
         W, lam, dlam = lambda_resample_matrix(freqs)
         nf_s = W.shape[0]
-        W_j = jnp.asarray(W)
+        # stays numpy here: jnp.asarray inside the traced step embeds it
+        # as a compile-time constant instead of an eager device_put
+        # (building a pipeline must not touch the device)
+        W_np = W
     else:
-        W_j, dlam = None, None
+        W_np, dlam = None, None
         nf_s = nchan
 
     fdop, tdel, beta = sspec_axes(nf_s, nsub, dt, df, dlam=dlam)
@@ -226,7 +229,8 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
         arc = None
         sec_b = None
         if config.fit_arc or config.return_sspec:
-            fft_in = (jnp.einsum("lf,bft->blt", W_j, dyn_batch)
+            fft_in = (jnp.einsum("lf,bft->blt", jnp.asarray(W_np),
+                                 dyn_batch)
                       if config.lamsteps else dyn_batch)
             sec_b = sspec_op(fft_in, prewhite=config.prewhite,
                              window=config.window,

@@ -330,6 +330,17 @@ def test_make_pipeline_builds_without_device_execution(monkeypatch):
         return wrapped
 
     monkeypatch.setattr(jax, "jit", spy_jit)
+
+    def spy_put(*a, **k):
+        calls.append("device_put")
+        raise AssertionError("device_put during pipeline build")
+
+    monkeypatch.setattr(jax, "device_put", spy_put)
+    monkeypatch.setattr(jax.numpy, "asarray",
+                        lambda *a, **k: calls.append("asarray")
+                        or (_ for _ in ()).throw(
+                            AssertionError("eager jnp.asarray during "
+                                           "pipeline build")))
     freqs = np.linspace(1390.0, 1410.0, 24)
     times = np.arange(24) * 4.0
     # fresh config value so the lru_cache cannot return a prebuilt step
