@@ -2,27 +2,32 @@
 # One-shot device validation + measurement sequence, to run when TPU
 # hardware is reachable.  SERIAL on purpose: concurrent device processes
 # can wedge the axon tunnel (see .claude/skills/verify/SKILL.md).
-set -u
+set -u -o pipefail
 cd "$(dirname "$0")/.."
 
 probe() {
-  timeout 180 python -u -c "
+  # status must reflect the python probe (a wedged claim ignores
+  # SIGTERM: escalate to SIGKILL), not the log filter's status
+  local out
+  out=$(timeout -k 5 180 python -u -c "
 import numpy as np, jax, jax.numpy as jnp
 print('tpu alive:', float(np.asarray(jnp.sum(jnp.ones((64,64))))))
-" 2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -1
+" 2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -1)
+  echo "$out"
+  [[ "$out" == *"tpu alive"* ]]
 }
 
 echo "== probe =="
 probe || { echo "tunnel unreachable; aborting"; exit 1; }
 
 echo "== stage profile (bench shape) =="
-timeout 1800 python benchmarks/profile_stages.py --b 256 --iters 5 \
+timeout -k 10 1800 python benchmarks/profile_stages.py --b 256 --iters 5 \
   2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -8
 
 echo "== headline bench =="
-timeout 2400 python bench.py 2>&1 \
+timeout -k 10 2400 python bench.py 2>&1 \
   | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -2
 
 echo "== all five configs =="
-timeout 3600 python benchmarks/all_configs.py 2>&1 \
+timeout -k 10 3600 python benchmarks/all_configs.py 2>&1 \
   | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -6
