@@ -146,7 +146,8 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
     if epochs:
         pcfg = PipelineConfig(lamsteps=args.lamsteps,
                               fit_arc=not args.no_arc,
-                              fit_scint=not args.no_scint)
+                              fit_scint=not args.no_scint,
+                              arc_asymm=getattr(args, "arc_asymm", False))
         try:
             with timers.stage("batched_pipeline"):
                 buckets = run_pipeline(epochs, pcfg, mesh=make_mesh())
@@ -169,6 +170,13 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
                     row[key] = float(np.asarray(res.arc.eta)[lane])
                     row[key + "err"] = float(
                         np.asarray(res.arc.etaerr)[lane])
+                    if res.arc.eta_left is not None:
+                        # per-arm values go to the store rows only (the
+                        # CSV keeps the reference schema)
+                        for arm in ("eta_left", "etaerr_left",
+                                    "eta_right", "etaerr_right"):
+                            row[arm] = float(
+                                np.asarray(getattr(res.arc, arm))[lane])
                 # NaN lanes are FAILED fits: quarantine (no CSV row, no
                 # store entry -> retried on resume), as the per-file loop
                 # does via exceptions
@@ -268,6 +276,9 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--plots", help="write summary plots to this dir")
     q.add_argument("--no-arc", action="store_true")
     q.add_argument("--no-scint", action="store_true")
+    q.add_argument("--arc-asymm", action="store_true",
+                   help="also measure per-arm curvatures "
+                        "(eta_left/eta_right; batched mode)")
     q.add_argument("--batched", action="store_true",
                    help="one jit-compiled step per shape bucket over the "
                         "device mesh instead of a per-file loop")

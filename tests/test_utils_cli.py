@@ -196,3 +196,23 @@ def test_cli_process_batched(tmp_path, capsys):
                     "--results", res, "--store", store])
     assert rc2 == 0
     assert len(open(res).read().strip().splitlines()) == 4
+
+
+def test_cli_process_batched_asymm(tmp_path, capsys):
+    """--batched --arc-asymm persists per-arm curvatures in the store."""
+    import json
+
+    from scintools_tpu.cli import main
+    from scintools_tpu.io import from_simulation, write_psrflux
+    from scintools_tpu.sim import Simulation
+
+    f = str(tmp_path / "e1.dynspec")
+    write_psrflux(from_simulation(
+        Simulation(mb2=2, ns=64, nf=64, dlam=0.25, seed=41),
+        freq=1400.0, dt=8.0), f)
+    store = tmp_path / "store"
+    rc = main(["process", f, "--batched", "--backend", "jax",
+               "--lamsteps", "--arc-asymm", "--store", str(store)])
+    assert rc == 0
+    rows = [json.loads(p.read_text()) for p in store.glob("*.json")]
+    assert rows and "eta_left" in rows[0] and "eta_right" in rows[0]
