@@ -1,0 +1,337 @@
+"""Wavefield retrieval: recover the complex scattered E-field from a
+dynamic spectrum via chunked theta-theta eigendecomposition.
+
+A beyond-reference capability (the reference measures only power-domain
+quantities).  The dynamic spectrum is an intensity ``I = |E|^2``; its
+conjugate spectrum ``C = FFT2(I)`` is the autocorrelation of the conjugate
+wavefield, so interference between scattered images at Doppler angles
+``theta1, theta2`` (fd units) puts
+
+    C(fd = theta1 - theta2, tau = eta*(theta1^2 - theta2^2))
+        ~ mu(theta1) * conj(mu(theta2))
+
+i.e. the COMPLEX theta-theta matrix sampled at the true curvature is
+approximately rank-1 Hermitian, and its principal eigenvector is the
+complex image amplitude ``mu(theta)`` — phases included — up to one
+global phase (Sprenger et al. 2021; Baker et al. 2022 "interstellar
+holography").
+
+A single global eigenvector over the whole spectrum does NOT work: the
+stationary-phase mapping only holds locally (curvature drifts with
+frequency as eta ~ 1/f^2, and off-grid bin leakage scrambles the phases
+— measured in round 1, dynspec correlation ~ 0).  The published remedy,
+implemented here, is to *chunk* the dynspec into overlapping Hann-
+windowed time-frequency blocks, retrieve ``mu`` per chunk (with eta
+rescaled to the chunk centre frequency), reconstruct each chunk's field
+from its own image model, and stitch the chunks by overlap-add — fixing
+each chunk's unknown global phase against the already-accumulated field
+in the 50%-overlap region.
+
+Everything device-side is fixed-shape: chunks share one [nf_c, nt_c]
+geometry, so the jax path retrieves ALL chunks in one vmapped jit
+(batched exact NUDFT matmuls -> fixed-step power iteration -> two
+reconstruction matmuls); only the (cheap, sequential) phase stitching
+runs on host.
+
+Validity: the fd/tau axes follow calc_sspec conventions (mHz, us —
+``ops.sspec.sspec_axes``), so ``eta`` is the curvature ``fit_arc``
+reports for a non-lamsteps spectrum, quoted at ``data.freq``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import numpy as np
+
+from ..backend import resolve
+from ..data import DynspecData
+
+__all__ = ["Wavefield", "retrieve_wavefield"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Wavefield:
+    """Retrieved complex wavefield + per-chunk diagnostics.
+
+    ``field`` [nchan, nsub] is normalised so ``|field|^2`` is in the
+    dynspec's flux units.  ``conc`` is each chunk's top-eigenmode energy
+    fraction (1 = perfectly rank-1 theta-theta matrix); ``align`` is the
+    phase-stitch quality in [0, 1] (normalised overlap inner product;
+    the first chunk has no overlap and reports 1).
+    """
+
+    field: np.ndarray
+    freqs: np.ndarray
+    times: np.ndarray
+    eta: float
+    chunk_shape: tuple
+    conc: np.ndarray
+    align: np.ndarray
+    theta: np.ndarray = None       # shared theta grid (fd units, mHz)
+    chunk_etas: np.ndarray = None  # per-chunk curvature (us/mHz^2)
+
+    @property
+    def model_dynspec(self) -> np.ndarray:
+        """|E|^2 — compare against the input dynamic spectrum."""
+        return np.abs(self.field) ** 2
+
+
+def _chunk_starts(n: int, size: int) -> list:
+    """Start indices covering [0, n) with ~50% overlap; final chunk is
+    clamped so the spectrum edge is always covered."""
+    if size >= n:
+        return [0]
+    step = max(1, size // 2)
+    starts = list(range(0, n - size + 1, step))
+    if starts[-1] != n - size:
+        starts.append(n - size)
+    return starts
+
+
+def _chunk_field_xp(chunk, w2d, eta_c, theta_max, geom, ntheta, niter,
+                    mask_fd, mask_tau, xp, scan=None):
+    """Retrieve one chunk's complex field model.
+
+    ``geom`` = (dt_s, df_mhz) — static python floats shared by every
+    chunk.  ``eta_c``/``theta_max`` may be traced scalars.  Returns
+    (E [nf_c, nt_c] complex, conc).
+
+    The theta-theta matrix is sampled EXACTLY by a two-stage NUDFT
+    rather than interpolating an FFT grid: theta differences take only
+    2*ntheta-1 distinct Doppler values, so stage 1 is one [nf_c, nt_c] x
+    [nt_c, 2*ntheta-1] complex matmul (the time-axis NUDFT at every
+    distinct fd), and stage 2 evaluates the delay-axis NUDFT at each
+    entry's tau = eta*(theta1^2-theta2^2) by a phase-weighted reduction
+    over frequency.  Off-grid bilinear leakage was the dominant error of
+    the FFT-grid variant (oracle-stitch fidelity 0.72 -> 0.82 on the
+    synthetic-arc ground truth); both stages are matmul/reduce shaped,
+    which is also the right form for the MXU.
+    """
+    dt_s, df_mhz = geom
+    nf_c, nt_c = chunk.shape
+
+    I = w2d * (chunk - xp.mean(chunk))
+    t_loc = xp.arange(nt_c) * dt_s
+    f_loc = xp.arange(nf_c) * df_mhz
+
+    # theta grid (fd units, mHz); spacing d_th
+    th = xp.linspace(-theta_max, theta_max, ntheta)
+    d_th = th[1] - th[0]
+
+    # stage 1: time-axis NUDFT at the distinct fd differences k*d_th
+    ks = xp.arange(-(ntheta - 1), ntheta)
+    P_t = xp.exp(-2j * np.pi * (ks[:, None] * d_th * 1e-3)
+                 * t_loc[None, :])                       # [2n-1, nt_c]
+    B = I @ P_t.T                                        # [nf_c, 2n-1]
+
+    # stage 2: delay-axis NUDFT at tau_ij = eta*(th_i^2 - th_j^2)
+    t1, t2 = th[:, None], th[None, :]
+    fd = t1 - t2
+    tau = eta_c * (t1 ** 2 - t2 ** 2)
+    kij = xp.round(fd / d_th).astype(xp.int32) + (ntheta - 1)
+    ph = xp.exp(-2j * np.pi * tau[None, :, :] * f_loc[:, None, None])
+    TT = xp.sum(B[:, kij] * ph, axis=0)                  # [n, n]
+
+    # mask (a) the spectral origin — it maps onto the theta1=theta2
+    # diagonal at EVERY eta (C(0,0) would fill the diagonal with the
+    # total power and swamp the rank-1 structure) — and (b) pairs whose
+    # (fd, tau) fall outside the data's Nyquist window: theta
+    # differences reach 2*theta_max in fd, and low-frequency chunks
+    # carry eta_c above the shared span's design eta, so out-of-window
+    # NUDFT samples would alias wrapped power into the matrix
+    fd_nyq = 1e3 / (2 * dt_s)
+    tau_nyq = 1.0 / (2 * df_mhz)
+    origin = (xp.abs(fd) <= mask_fd) & (xp.abs(tau) <= mask_tau)
+    unmeasurable = (xp.abs(fd) > fd_nyq) | (xp.abs(tau) > tau_nyq)
+    TT = xp.where(origin | unmeasurable, 0.0, TT)
+    H = 0.5 * (TT + xp.conj(TT.T))
+
+    # principal eigenvector by fixed-step power iteration (identical on
+    # both backends; H is Hermitian with a dominant positive eigenvalue)
+    v = xp.ones(ntheta, dtype=H.dtype) / np.sqrt(ntheta)
+    if scan is None:
+        for _ in range(niter):
+            v = H @ v
+            v = v / xp.maximum(xp.sqrt(xp.sum(xp.abs(v) ** 2)), 1e-30)
+    else:
+        def body(v, _):
+            v = H @ v
+            return v / xp.maximum(xp.sqrt(xp.sum(xp.abs(v) ** 2)),
+                                  1e-30), None
+        v, _ = scan(body, v, None, length=niter)
+    lam = xp.real(xp.vdot(v, H @ v))
+    tot = xp.maximum(xp.sum(xp.abs(H) ** 2), 1e-30)
+    conc = lam ** 2 / tot
+    mu = xp.sqrt(xp.maximum(lam, 0.0)) * v
+
+    # forward model on the chunk footprint (chunk-local coordinates; the
+    # per-theta phase offsets of absolute coordinates live in mu):
+    #   E[f, t] = sum_j mu_j e^{2 pi i (tau_j * f_MHz + fd_j * 1e-3 * t_s)}
+    ph_f = xp.exp(2j * np.pi * f_loc[:, None] * (eta_c * th ** 2)[None, :])
+    ph_t = xp.exp(2j * np.pi * (th * 1e-3)[:, None] * t_loc[None, :])
+    E = (ph_f * mu[None, :]) @ ph_t
+
+    # anchor the amplitude: window-weighted model power == window-weighted
+    # chunk flux (the eigen-scale carries FFT/leakage factors)
+    flux = xp.sum(w2d * xp.maximum(chunk, 0.0))
+    model = xp.sum(w2d * xp.abs(E) ** 2)
+    E = E * xp.sqrt(xp.maximum(flux, 0.0) / xp.maximum(model, 1e-30))
+    return E, conc
+
+
+@functools.lru_cache(maxsize=16)
+def _chunks_jax(geom, ntheta: int, niter: int, mask_fd: float,
+                mask_tau: float):
+    """jit'd all-chunks retrieval, cached on the shared chunk geometry."""
+    import jax
+    import jax.numpy as jnp
+
+    def one(chunk, w2d, eta_c, theta_max):
+        return _chunk_field_xp(chunk, w2d, eta_c, theta_max, geom, ntheta,
+                               niter, mask_fd, mask_tau, xp=jnp,
+                               scan=jax.lax.scan)
+
+    @jax.jit
+    def run(chunks, w2d, etas, theta_maxs):
+        # lax.map, not vmap: stage 2 materialises an [nf_c, ntheta,
+        # ntheta] complex intermediate per chunk (tens of MB); a vmap
+        # over hundreds of chunks on a big dynspec would multiply that
+        # into HBM-exhausting territory, while sequential chunks keep
+        # the working set to one chunk and the per-chunk work is already
+        # matmul-shaped enough to fill the device
+        return jax.lax.map(lambda args: one(args[0], w2d, args[1],
+                                            args[2]),
+                           (chunks, etas, theta_maxs))
+
+    return run
+
+
+def retrieve_wavefield(data: DynspecData, eta: float, chunk_nf: int = 64,
+                       chunk_nt: int = 64, ntheta: int | None = None,
+                       niter: int = 60, mask_bins: float = 1.5,
+                       theta_frac: float = 0.95,
+                       backend: str = "jax") -> Wavefield:
+    """Retrieve the complex wavefield of ``data`` given arc curvature
+    ``eta`` (us/mHz^2, as fit by ``fit_arc`` on the non-lamsteps
+    secondary spectrum, quoted at ``data.freq``).
+
+    ``chunk_nf``/``chunk_nt`` set the Hann-windowed block size (50%
+    overlap); blocks must be small enough that the curvature is locally
+    constant but large enough to resolve the arc.  ``mask_bins`` masks
+    the spectral origin out to that many conjugate-spectrum bins.
+    ``theta_frac`` shrinks each chunk's theta span inside the observable
+    (fd, tau) window: theta_max = theta_frac * min(fd_max,
+    sqrt(tau_max / eta_chunk)).
+
+    ``ntheta=None`` (default) picks the theta grid from the chunk
+    geometry itself: spacing EQUAL to the chunk's Doppler bin width, so
+    every theta1-theta2 difference lands exactly on the conjugate-
+    spectrum fd grid and bilinear leakage is confined to the delay axis
+    (the standard theta-theta gridding trick).  An explicit ``ntheta``
+    overrides the point count but keeps the span.
+    """
+    backend = resolve(backend)
+    dyn = np.asarray(data.dyn, dtype=np.float64)
+    nchan, nsub = dyn.shape
+    chunk_nf = min(chunk_nf, nchan)
+    chunk_nt = min(chunk_nt, nsub)
+    dt_s = float(abs(data.dt))
+    df_mhz = float(abs(data.df))
+    f_ref = float(data.freq)
+    freqs = np.asarray(data.freqs, dtype=np.float64)
+
+    # shared chunk geometry (calc_sspec units: fd mHz, tau us)
+    geom = (dt_s, df_mhz)
+    d_fd_bin = 1e3 / (chunk_nt * dt_s)    # chunk Doppler resolution
+    d_tau_bin = 1.0 / (chunk_nf * df_mhz)  # chunk delay resolution
+    fd_max = 1e3 / (2 * dt_s)              # Nyquist extents of the data
+    tau_max = 1.0 / (2 * df_mhz)
+    mask_fd = mask_bins * d_fd_bin
+    mask_tau = mask_bins * d_tau_bin
+
+    fstarts = _chunk_starts(nchan, chunk_nf)
+    tstarts = _chunk_starts(nsub, chunk_nt)
+    w2d = np.hanning(chunk_nf)[:, None] * np.hanning(chunk_nt)[None, :]
+
+    # per-chunk curvature (eta ~ 1/f^2) and theta span
+    chunks, etas, slots = [], [], []
+    for cf in fstarts:
+        f_c = float(np.mean(freqs[cf:cf + chunk_nf]))
+        eta_c = float(eta) * (f_ref / f_c) ** 2
+        for ct in tstarts:
+            chunks.append(dyn[cf:cf + chunk_nf, ct:ct + chunk_nt])
+            etas.append(eta_c)
+            slots.append((cf, ct))
+    chunks = np.stack(chunks)
+
+    # theta grid: one shared span (chunks differ only a few % in eta),
+    # capped by the STEEPEST chunk's curvature (eta_hi) so no chunk's
+    # tau = eta_c*theta^2 leaves the delay Nyquist window.  Unless
+    # overridden, the spacing matches the chunk resolution on BOTH
+    # conjugate axes: at most the Doppler bin width, and fine enough
+    # that one theta step moves the delay by at most one delay bin at
+    # the arc edge (steep arcs are delay-resolved long before they are
+    # Doppler-resolved).  The NUDFT sampler is exact for any spacing.
+    eta_hi = max(etas)
+    theta_max = theta_frac * min(fd_max, float(np.sqrt(tau_max / eta_hi)))
+    if ntheta is None:
+        d_th = min(d_fd_bin, d_tau_bin / (2 * eta_hi * theta_max))
+        nhalf = int(np.clip(np.floor(theta_max / d_th), 4, 128))
+        ntheta = 2 * nhalf + 1
+    ntheta = int(ntheta)
+    tmaxs = [theta_max] * len(chunks)
+
+    if backend == "jax":
+        import jax.numpy as jnp
+
+        run = _chunks_jax(geom, int(ntheta), int(niter), float(mask_fd),
+                          float(mask_tau))
+        E_all, conc = run(jnp.asarray(chunks), jnp.asarray(w2d),
+                          jnp.asarray(etas), jnp.asarray(tmaxs))
+        E_all = np.asarray(E_all)
+        conc = np.asarray(conc, dtype=np.float64)
+    else:
+        out = [_chunk_field_xp(c, w2d, e, tm, geom, int(ntheta),
+                               int(niter), mask_fd, mask_tau, xp=np)
+               for c, e, tm in zip(chunks, etas, tmaxs)]
+        E_all = np.stack([o[0] for o in out])
+        conc = np.array([o[1] for o in out], dtype=np.float64)
+
+    # overlap-add stitch with per-chunk global-phase alignment (host).
+    # The BLEND window adds a small pedestal to the Hann analysis
+    # window: np.hanning is zero at its endpoints, so pure-Hann blending
+    # would leave the spectrum's outermost row/column of pixels (covered
+    # only by a chunk edge) identically zero; the pedestal gives them
+    # the nearest chunk's model value, and den-normalisation keeps the
+    # blend unbiased for any window
+    wb2d = np.outer(np.hanning(chunk_nf) + 0.02,
+                    np.hanning(chunk_nt) + 0.02)
+    num = np.zeros((nchan, nsub), dtype=np.complex128)
+    den = np.zeros((nchan, nsub), dtype=np.float64)
+    align = np.ones(len(slots), dtype=np.float64)
+    for k, (cf, ct) in enumerate(slots):
+        E_c = E_all[k]
+        sl = (slice(cf, cf + chunk_nf), slice(ct, ct + chunk_nt))
+        z = np.sum(num[sl] * np.conj(E_c) * w2d)
+        norm = (np.sqrt(np.sum(np.abs(num[sl]) ** 2 * w2d))
+                * np.sqrt(np.sum(np.abs(E_c) ** 2 * w2d)))
+        if norm > 0 and np.abs(z) > 1e-12 * norm:
+            align[k] = float(np.abs(z) / norm)
+            E_c = E_c * (z / np.abs(z))
+        num[sl] += E_c * wb2d
+        den[sl] += wb2d
+    field = num / np.maximum(den, 1e-12)
+    # re-anchor the total flux: overlap-add attenuates where neighbouring
+    # chunks blend imperfectly coherently
+    flux = float(np.sum(np.maximum(dyn, 0.0)))
+    model = float(np.sum(np.abs(field) ** 2))
+    if model > 0:
+        field = field * np.sqrt(flux / model)
+    return Wavefield(field=field, freqs=freqs,
+                     times=np.asarray(data.times, dtype=np.float64),
+                     eta=float(eta), chunk_shape=(chunk_nf, chunk_nt),
+                     conc=conc, align=align,
+                     theta=np.linspace(-theta_max, theta_max, ntheta),
+                     chunk_etas=np.asarray(etas, dtype=np.float64))

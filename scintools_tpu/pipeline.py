@@ -90,6 +90,7 @@ class Dynspec:
         self.norm_sspec_result = None
         self.scint_params = None
         self.arc_fit = None
+        self.wavefield = None
         if process:
             self.default_processing(lamsteps=lamsteps)
 
@@ -509,6 +510,28 @@ class Dynspec:
                 self.scale_dyn(scale="trapezoid")
             return plotting.plot_dyn(self._data, dyn=self.trapdyn, **kw)
         return plotting.plot_dyn(self._data, **kw)
+
+    def retrieve_wavefield(self, eta: float | None = None, **kw):
+        """Chunked theta-theta wavefield retrieval (fit.wavefield).
+
+        ``eta`` defaults to this object's fitted non-lamsteps curvature
+        (us/mHz^2; the primary arc after a multi-arc fit).  Beyond-
+        reference capability — the reference has no phase-retrieval
+        path.
+        """
+        from .fit.wavefield import retrieve_wavefield as _retrieve
+
+        if eta is None:
+            eta = self.eta
+            if eta is not None and np.ndim(eta) == 1:
+                eta = float(eta[0])
+        if eta is None:
+            raise ValueError(
+                "no curvature available: run fit_arc(lamsteps=False) or "
+                "pass eta= (us/mHz^2 at the band centre frequency)")
+        kw.setdefault("backend", resolve(self.backend))
+        self.wavefield = _retrieve(self._data, float(eta), **kw)
+        return self.wavefield
 
     def plot_acf(self, **kw):
         from . import plotting
