@@ -58,8 +58,9 @@ class Wavefield:
     ``field`` [nchan, nsub] is normalised so ``|field|^2`` is in the
     dynspec's flux units.  ``conc`` is each chunk's top-eigenmode energy
     fraction (1 = perfectly rank-1 theta-theta matrix); ``align`` is the
-    phase-stitch quality in [0, 1] (normalised overlap inner product;
-    the first chunk has no overlap and reports 1).
+    phase-stitch quality in [0, 1] (normalised overlap inner product);
+    chunks with no usable overlap to align against — the first chunk,
+    and chunks stitched onto a dead/zero-power region — report NaN.
     """
 
     field: np.ndarray
@@ -91,7 +92,7 @@ def _chunk_starts(n: int, size: int) -> list:
 
 
 def _chunk_field_xp(chunk, w2d, eta_c, theta_max, geom, ntheta, niter,
-                    mask_fd, mask_tau, xp, scan=None):
+                    mask_fd, mask_tau, xp, scan=None, cache=None):
     """Retrieve one chunk's complex field model.
 
     ``geom`` = (dt_s, df_mhz) — static python floats shared by every
@@ -112,6 +113,16 @@ def _chunk_field_xp(chunk, w2d, eta_c, theta_max, geom, ntheta, niter,
     dt_s, df_mhz = geom
     nf_c, nt_c = chunk.shape
 
+    def memo(key, fn):
+        # chunk-invariant tensors: the numpy host loop passes a dict so
+        # grid phases are built once (keyed by eta_c where they depend
+        # on it); the traced jax path passes None
+        if cache is None:
+            return fn()
+        if key not in cache:
+            cache[key] = fn()
+        return cache[key]
+
     I = w2d * (chunk - xp.mean(chunk))
     t_loc = xp.arange(nt_c) * dt_s
     f_loc = xp.arange(nf_c) * df_mhz
@@ -122,30 +133,37 @@ def _chunk_field_xp(chunk, w2d, eta_c, theta_max, geom, ntheta, niter,
 
     # stage 1: time-axis NUDFT at the distinct fd differences k*d_th
     ks = xp.arange(-(ntheta - 1), ntheta)
-    P_t = xp.exp(-2j * np.pi * (ks[:, None] * d_th * 1e-3)
-                 * t_loc[None, :])                       # [2n-1, nt_c]
+    P_t = memo("P_t", lambda: xp.exp(
+        -2j * np.pi * (ks[:, None] * d_th * 1e-3)
+        * t_loc[None, :]))                               # [2n-1, nt_c]
     B = I @ P_t.T                                        # [nf_c, 2n-1]
 
     # stage 2: delay-axis NUDFT at tau_ij = eta*(th_i^2 - th_j^2)
     t1, t2 = th[:, None], th[None, :]
     fd = t1 - t2
     tau = eta_c * (t1 ** 2 - t2 ** 2)
-    kij = xp.round(fd / d_th).astype(xp.int32) + (ntheta - 1)
-    ph = xp.exp(-2j * np.pi * tau[None, :, :] * f_loc[:, None, None])
-    TT = xp.sum(B[:, kij] * ph, axis=0)                  # [n, n]
+    kij = memo("kij", lambda: xp.round(fd / d_th).astype(xp.int32)
+               + (ntheta - 1))
 
-    # mask (a) the spectral origin — it maps onto the theta1=theta2
-    # diagonal at EVERY eta (C(0,0) would fill the diagonal with the
-    # total power and swamp the rank-1 structure) — and (b) pairs whose
-    # (fd, tau) fall outside the data's Nyquist window: theta
-    # differences reach 2*theta_max in fd, and low-frequency chunks
-    # carry eta_c above the shared span's design eta, so out-of-window
-    # NUDFT samples would alias wrapped power into the matrix
-    fd_nyq = 1e3 / (2 * dt_s)
-    tau_nyq = 1.0 / (2 * df_mhz)
-    origin = (xp.abs(fd) <= mask_fd) & (xp.abs(tau) <= mask_tau)
-    unmeasurable = (xp.abs(fd) > fd_nyq) | (xp.abs(tau) > tau_nyq)
-    TT = xp.where(origin | unmeasurable, 0.0, TT)
+    def _stage2_phases():
+        # mask (a) the spectral origin — it maps onto the theta1=theta2
+        # diagonal at EVERY eta (C(0,0) would fill the diagonal with the
+        # total power and swamp the rank-1 structure) — and (b) pairs
+        # whose (fd, tau) fall outside the data's Nyquist window: theta
+        # differences reach 2*theta_max in fd, and low-frequency chunks
+        # carry eta_c above the shared span's design eta, so
+        # out-of-window NUDFT samples would alias wrapped power
+        fd_nyq = 1e3 / (2 * dt_s)
+        tau_nyq = 1.0 / (2 * df_mhz)
+        ph = xp.exp(-2j * np.pi * tau[None, :, :] * f_loc[:, None, None])
+        origin = (xp.abs(fd) <= mask_fd) & (xp.abs(tau) <= mask_tau)
+        dead = origin | (xp.abs(fd) > fd_nyq) | (xp.abs(tau) > tau_nyq)
+        return ph, dead
+
+    ph, dead = memo(("eta", float(eta_c)) if cache is not None else None,
+                    _stage2_phases)
+    TT = xp.sum(B[:, kij] * ph, axis=0)                  # [n, n]
+    TT = xp.where(dead, 0.0, TT)
     H = 0.5 * (TT + xp.conj(TT.T))
 
     # principal eigenvector by fixed-step power iteration (identical on
@@ -169,8 +187,11 @@ def _chunk_field_xp(chunk, w2d, eta_c, theta_max, geom, ntheta, niter,
     # forward model on the chunk footprint (chunk-local coordinates; the
     # per-theta phase offsets of absolute coordinates live in mu):
     #   E[f, t] = sum_j mu_j e^{2 pi i (tau_j * f_MHz + fd_j * 1e-3 * t_s)}
-    ph_f = xp.exp(2j * np.pi * f_loc[:, None] * (eta_c * th ** 2)[None, :])
-    ph_t = xp.exp(2j * np.pi * (th * 1e-3)[:, None] * t_loc[None, :])
+    ph_f = memo(("ph_f", float(eta_c)) if cache is not None else None,
+                lambda: xp.exp(2j * np.pi * f_loc[:, None]
+                               * (eta_c * th ** 2)[None, :]))
+    ph_t = memo("ph_t", lambda: xp.exp(
+        2j * np.pi * (th * 1e-3)[:, None] * t_loc[None, :]))
     E = (ph_f * mu[None, :]) @ ph_t
 
     # anchor the amplitude: window-weighted model power == window-weighted
@@ -226,13 +247,17 @@ def retrieve_wavefield(data: DynspecData, eta: float, chunk_nf: int = 64,
     sqrt(tau_max / eta_chunk)).
 
     ``ntheta=None`` (default) picks the theta grid from the chunk
-    geometry itself: spacing EQUAL to the chunk's Doppler bin width, so
-    every theta1-theta2 difference lands exactly on the conjugate-
-    spectrum fd grid and bilinear leakage is confined to the delay axis
-    (the standard theta-theta gridding trick).  An explicit ``ntheta``
-    overrides the point count but keeps the span.
+    geometry itself: spacing fine enough to resolve BOTH conjugate axes
+    — at most one Doppler bin per step, and at most one delay bin per
+    step at the arc edge (min(d_fd_bin, d_tau_bin / (2*eta*theta_max)))
+    — capped at 257 points.  The NUDFT sampler is exact for any
+    spacing.  An explicit ``ntheta`` overrides the point count but
+    keeps the span.
     """
     backend = resolve(backend)
+    if not (np.isfinite(eta) and eta > 0):
+        raise ValueError(f"eta must be a positive finite curvature "
+                         f"(us/mHz^2), got {eta!r}")
     dyn = np.asarray(data.dyn, dtype=np.float64)
     nchan, nsub = dyn.shape
     chunk_nf = min(chunk_nf, nchan)
@@ -293,8 +318,10 @@ def retrieve_wavefield(data: DynspecData, eta: float, chunk_nf: int = 64,
         E_all = np.asarray(E_all)
         conc = np.asarray(conc, dtype=np.float64)
     else:
+        grid_cache: dict = {}
         out = [_chunk_field_xp(c, w2d, e, tm, geom, int(ntheta),
-                               int(niter), mask_fd, mask_tau, xp=np)
+                               int(niter), mask_fd, mask_tau, xp=np,
+                               cache=grid_cache)
                for c, e, tm in zip(chunks, etas, tmaxs)]
         E_all = np.stack([o[0] for o in out])
         conc = np.array([o[1] for o in out], dtype=np.float64)
@@ -310,7 +337,7 @@ def retrieve_wavefield(data: DynspecData, eta: float, chunk_nf: int = 64,
                     np.hanning(chunk_nt) + 0.02)
     num = np.zeros((nchan, nsub), dtype=np.complex128)
     den = np.zeros((nchan, nsub), dtype=np.float64)
-    align = np.ones(len(slots), dtype=np.float64)
+    align = np.full(len(slots), np.nan)
     for k, (cf, ct) in enumerate(slots):
         E_c = E_all[k]
         sl = (slice(cf, cf + chunk_nf), slice(ct, ct + chunk_nt))

@@ -271,6 +271,49 @@ def plot_thetatheta(sec: SecSpec, eta: float, ntheta: int = 129,
     return _finish(fig, filename, display)
 
 
+def plot_wavefield(wf, ax=None, filename: str | None = None,
+                   display: bool = False):
+    """Retrieved wavefield (fit.wavefield): amplitude, phase, and the
+    |E|^2 reconstruction — compare the latter against ``plot_dyn`` of
+    the input spectrum.  ``ax`` may be a single Axes (amplitude panel
+    only, matching the module convention) or a length-3 sequence."""
+    import matplotlib.pyplot as plt
+
+    f = wf.freqs
+    t = wf.times / 60.0
+    ext = (t[0], t[-1], f[0], f[-1])
+    field = to_numpy(wf.field)
+    title = (rf"wavefield @ $\eta$={wf.eta:.3g}; "
+             rf"conc={np.mean(wf.conc):.2f}")
+    if ax is not None and not np.iterable(ax):
+        fig = ax.figure
+        mesh = ax.imshow(np.abs(field), origin="lower", aspect="auto",
+                         cmap="magma", extent=ext)
+        ax.set_xlabel("Time (mins)")
+        ax.set_ylabel("Frequency (MHz)")
+        ax.set_title(title)
+        fig.colorbar(mesh, ax=ax, label="|E|")
+        return _finish(fig, filename, display)
+    if ax is None:
+        fig, axs = plt.subplots(1, 3, figsize=(15, 4.2), sharey=True)
+    else:
+        axs = list(ax)
+        fig = axs[0].figure
+    panels = (
+        (np.abs(field), "magma", "|E|", axs[0]),
+        (np.angle(field), "twilight", "arg E (rad)", axs[1]),
+        (np.abs(field) ** 2, "magma", r"$|E|^2$", axs[2]),
+    )
+    for img, cmap, label, a in panels:
+        mesh = a.imshow(img, origin="lower", aspect="auto", cmap=cmap,
+                        extent=ext)
+        a.set_xlabel("Time (mins)")
+        fig.colorbar(mesh, ax=a, label=label)
+    axs[0].set_ylabel("Frequency (MHz)")
+    axs[1].set_title(title)
+    return _finish(fig, filename, display)
+
+
 # -- simulation views (scint_sim.py:266-335) --------------------------------
 
 def plot_screen(sim, ax=None, filename: str | None = None,

@@ -83,6 +83,20 @@ def test_plot_thetatheta(ds, tmp_path):
     plt.close(fig)
 
 
+def test_plot_wavefield(ds, tmp_path):
+    wf = ds.retrieve_wavefield(eta=0.4, chunk_nf=32, chunk_nt=32,
+                               backend="numpy")
+    fig = plotting.plot_wavefield(wf, filename=str(tmp_path / "wf.png"))
+    assert (tmp_path / "wf.png").stat().st_size > 0
+    plt.close(fig)
+    # single-Axes convention (amplitude panel only)
+    fig, ax = plt.subplots()
+    out = plotting.plot_wavefield(wf, ax=ax,
+                                  filename=str(tmp_path / "wf1.png"))
+    assert (tmp_path / "wf1.png").stat().st_size > 0
+    plt.close(out)
+
+
 def test_plot_dyn_lamsteps_and_trap(sim_dynspec, tmp_path):
     """plot_dyn(lamsteps=True)/(trap=True) plot the rescaled arrays
     (dynspec.py:206-229), resampling lazily."""

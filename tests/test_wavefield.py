@@ -161,3 +161,23 @@ def test_wavefield_requires_curvature():
     ds = Dynspec(data=d, process=False)
     with pytest.raises(ValueError, match="no curvature"):
         ds.retrieve_wavefield()
+
+
+def test_wavefield_rejects_bad_eta():
+    d, _, _ = _synth_arc_field(nf=64, nt=64)
+    for bad in (0.0, -0.1, np.nan):
+        with pytest.raises(ValueError, match="positive finite"):
+            retrieve_wavefield(d, bad, backend="numpy")
+
+
+def test_wavefield_align_diagnostics():
+    """The first chunk has nothing to align against and reports NaN;
+    chunks with usable overlap report a quality in (0, 1]."""
+    d, _, eta = _synth_arc_field(nf=128, nt=128)
+    wf = retrieve_wavefield(d, eta, chunk_nf=64, chunk_nt=64,
+                            backend="numpy")
+    assert np.isnan(wf.align[0])
+    rest = wf.align[1:]
+    assert np.all((rest[~np.isnan(rest)] > 0)
+                  & (rest[~np.isnan(rest)] <= 1))
+    assert np.sum(~np.isnan(rest)) == len(rest)  # all overlaps were live
