@@ -78,6 +78,33 @@ class Wavefield:
         """|E|^2 — compare against the input dynamic spectrum."""
         return np.abs(self.field) ** 2
 
+    def secspec(self, pad: int = 2, db: bool = True) -> "SecSpec":
+        """Secondary spectrum of the FIELD: |FFT2(E)|^2.
+
+        Unlike the intensity secondary spectrum (whose power fills the
+        whole pairwise-difference manifold inside the arc), the field's
+        spectrum puts power AT the scattered images themselves — on the
+        single parabola tau = eta*fd^2 — so arcs are far sharper and
+        individual images separable.  The delay axis is full-signed
+        (the field is complex; no Hermitian fold), in calc_sspec units
+        (fdop mHz, tdel us).  ``pad`` zero-pads each axis by that factor
+        for finer spectral sampling.
+        """
+        from ..data import SecSpec
+
+        E = np.asarray(self.field)
+        nf, nt = E.shape
+        dt_s = float(self.times[1] - self.times[0])
+        df_mhz = float(abs(self.freqs[1] - self.freqs[0]))
+        S = np.fft.fftshift(np.fft.fft2(E, s=(pad * nf, pad * nt)))
+        P = np.abs(S) ** 2
+        if db:
+            with np.errstate(divide="ignore"):
+                P = 10.0 * np.log10(P)
+        fdop = np.fft.fftshift(np.fft.fftfreq(pad * nt, d=dt_s)) * 1e3
+        tdel = np.fft.fftshift(np.fft.fftfreq(pad * nf, d=df_mhz))
+        return SecSpec(sspec=P, fdop=fdop, tdel=tdel, lamsteps=False)
+
 
 def _chunk_starts(n: int, size: int) -> list:
     """Start indices covering [0, n) with ~50% overlap; final chunk is
@@ -242,9 +269,10 @@ def retrieve_wavefield(data: DynspecData, eta: float, chunk_nf: int = 64,
     overlap); blocks must be small enough that the curvature is locally
     constant but large enough to resolve the arc.  ``mask_bins`` masks
     the spectral origin out to that many conjugate-spectrum bins.
-    ``theta_frac`` shrinks each chunk's theta span inside the observable
-    (fd, tau) window: theta_max = theta_frac * min(fd_max,
-    sqrt(tau_max / eta_chunk)).
+    ``theta_frac`` shrinks the SHARED theta span inside the observable
+    (fd, tau) window; the span is one value for all chunks, capped by
+    the steepest (lowest-frequency) chunk's curvature: theta_max =
+    theta_frac * min(fd_max, sqrt(tau_max / max(eta_chunk))).
 
     ``ntheta=None`` (default) picks the theta grid from the chunk
     geometry itself: spacing fine enough to resolve BOTH conjugate axes
@@ -319,10 +347,21 @@ def retrieve_wavefield(data: DynspecData, eta: float, chunk_nf: int = 64,
         conc = np.asarray(conc, dtype=np.float64)
     else:
         grid_cache: dict = {}
-        out = [_chunk_field_xp(c, w2d, e, tm, geom, int(ntheta),
-                               int(niter), mask_fd, mask_tau, xp=np,
-                               cache=grid_cache)
-               for c, e, tm in zip(chunks, etas, tmaxs)]
+        out = []
+        last_eta = None
+        for c, e, tm in zip(chunks, etas, tmaxs):
+            if last_eta is not None and e != last_eta:
+                # chunks are frequency-row-major and rows are never
+                # revisited: drop the previous row's eta-keyed phase
+                # tensors (each [nf_c, ntheta, ntheta] complex) so peak
+                # cache memory stays one row, not the whole band
+                for k in [k for k in grid_cache
+                          if isinstance(k, tuple) and k[1] == last_eta]:
+                    del grid_cache[k]
+            last_eta = e
+            out.append(_chunk_field_xp(c, w2d, e, tm, geom, int(ntheta),
+                                       int(niter), mask_fd, mask_tau,
+                                       xp=np, cache=grid_cache))
         E_all = np.stack([o[0] for o in out])
         conc = np.array([o[1] for o in out], dtype=np.float64)
 

@@ -163,6 +163,26 @@ def test_wavefield_requires_curvature():
         ds.retrieve_wavefield()
 
 
+def test_wavefield_secspec_arc_sharpness():
+    """The field's secondary spectrum |FFT2(E)|^2 concentrates power ON
+    the arc tau = eta*fd^2 (the images themselves), unlike the intensity
+    spectrum whose power fills the pairwise-difference manifold."""
+    d, _, eta = _synth_arc_field()
+    wf = retrieve_wavefield(d, eta, chunk_nf=64, chunk_nt=64,
+                            backend="numpy")
+    sec = wf.secspec(pad=1, db=False)
+    P = np.asarray(sec.sspec)
+    assert P.shape == (len(sec.tdel), len(sec.fdop))
+    assert sec.tdel.min() < 0 < sec.tdel.max()  # full-signed delay axis
+    dtau = sec.tdel[1] - sec.tdel[0]
+    corridor = np.abs(sec.tdel[:, None]
+                      - eta * sec.fdop[None, :] ** 2) < 5 * dtau
+    assert P[corridor].sum() / P.sum() > 0.9
+    # dB mode finite where power is nonzero, shape preserved by padding
+    sec2 = wf.secspec(pad=2)
+    assert sec2.sspec.shape == (2 * len(sec.tdel), 2 * len(sec.fdop))
+
+
 def test_wavefield_rejects_bad_eta():
     d, _, _ = _synth_arc_field(nf=64, nt=64)
     for bad in (0.0, -0.1, np.nan):
