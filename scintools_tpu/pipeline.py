@@ -285,6 +285,15 @@ class Dynspec:
                        beta=self.beta if lamsteps else None,
                        lamsteps=lamsteps)
 
+    def secspec(self, lamsteps: bool | None = None) -> SecSpec:
+        """The secondary spectrum with its axes as one SecSpec record,
+        computing it first if needed — the public accessor for code that
+        consumes spectra directly (fit.fit_arc_thetatheta,
+        plotting.plot_sspec, ...).  ``lamsteps`` defaults to this
+        object's processing mode."""
+        return self._secspec(self.lamsteps if lamsteps is None
+                             else lamsteps)
+
     # -- measurements ------------------------------------------------------
     def fit_arc(self, method: str = "norm_sspec", lamsteps: bool | None
                 = None, delmax=None, numsteps: int = 10000,

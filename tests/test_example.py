@@ -23,6 +23,8 @@ def test_arc_modelling_walkthrough(tmp_path):
     lo, hi = results["eta_annual_minmax"]
     assert 0 < lo < hi
     assert (tmp_path / "sspec_arc.png").stat().st_size > 0
+    assert results["wavefield_corr"] > 0.5
+    assert (tmp_path / "wavefield_sspec.png").stat().st_size > 0
 
 
 if __name__ == "__main__":

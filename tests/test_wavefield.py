@@ -154,6 +154,18 @@ def test_wavefield_border_pixels_live():
     assert np.abs(wf.field[:, -1]).max() > 0
 
 
+def test_dynspec_public_secspec_accessor():
+    """Dynspec.secspec() is the public SecSpec accessor (lazily computes;
+    honours the processing mode) — examples must not need _secspec."""
+    from scintools_tpu import Dynspec
+
+    d, _, _ = _synth_arc_field(nf=64, nt=64)
+    ds = Dynspec(data=d, process=False)
+    sec = ds.secspec(lamsteps=False)
+    assert sec.sspec is not None and not sec.lamsteps
+    assert sec.sspec.shape == (len(sec.tdel), len(sec.fdop))
+
+
 def test_wavefield_requires_curvature():
     from scintools_tpu import Dynspec
 
