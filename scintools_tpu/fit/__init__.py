@@ -3,7 +3,8 @@ from .arc_fit import (NormSspec, fit_arc, fit_arcs_multi,  # noqa: F401
 from .curvature_fit import fit_arc_curvature  # noqa: F401
 from .thetatheta import (fit_arc_thetatheta,  # noqa: F401
                          theta_theta_map)
-from .wavefield import Wavefield, retrieve_wavefield  # noqa: F401
+from .wavefield import (Wavefield, retrieve_wavefield,  # noqa: F401
+                        retrieve_wavefield_batch)
 from .filters import savgol1  # noqa: F401
 from .lm import (LsqResult, least_squares_numpy, lm_fit_batched,  # noqa: F401
                  lm_fit_jax)

@@ -48,7 +48,8 @@ import numpy as np
 from ..backend import resolve
 from ..data import DynspecData
 
-__all__ = ["Wavefield", "retrieve_wavefield"]
+__all__ = ["Wavefield", "retrieve_wavefield",
+           "retrieve_wavefield_batch"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -282,18 +283,62 @@ def retrieve_wavefield(data: DynspecData, eta: float, chunk_nf: int = 64,
     spacing.  An explicit ``ntheta`` overrides the point count but
     keeps the span.
     """
-    backend = resolve(backend)
-    if not (np.isfinite(eta) and eta > 0):
-        raise ValueError(f"eta must be a positive finite curvature "
-                         f"(us/mHz^2), got {eta!r}")
     dyn = np.asarray(data.dyn, dtype=np.float64)
-    nchan, nsub = dyn.shape
+    return retrieve_wavefield_batch(
+        dyn[None], np.asarray(data.freqs, dtype=np.float64),
+        np.asarray(data.times, dtype=np.float64), [eta],
+        freq=float(data.freq), dt=float(data.dt), df=float(data.df),
+        chunk_nf=chunk_nf, chunk_nt=chunk_nt, ntheta=ntheta,
+        niter=niter, mask_bins=mask_bins, theta_frac=theta_frac,
+        backend=backend)[0]
+
+
+def retrieve_wavefield_batch(dyn_batch, freqs, times, etas,
+                             freq: float | None = None,
+                             dt: float | None = None,
+                             df: float | None = None,
+                             chunk_nf: int = 64, chunk_nt: int = 64,
+                             ntheta: int | None = None, niter: int = 60,
+                             mask_bins: float = 1.5,
+                             theta_frac: float = 0.95,
+                             backend: str = "jax") -> list:
+    """Retrieve wavefields for a BATCH of epochs sharing one grid.
+
+    ``dyn_batch`` [B, nchan, nsub] of epochs that GENUINELY share the
+    (freqs, times) grid — e.g. a fixed-setup survey's equal-shape
+    epochs.  Padded buckets from ``parallel.pad_batch`` are NOT
+    supported: fill rows/columns would be stitched as real signal and
+    bias the flux anchor — group equal-shape epochs instead.  ``etas``
+    [B] are per-epoch curvatures quoted at ``freq`` (default: the band
+    centre); ``dt``/``df`` override the axis spacings (defaulting to
+    the axis differences).  All epochs share the chunk plan and one
+    theta grid (span capped by the steepest epoch's lowest-frequency
+    chunk), so on the jax backend every chunk of every epoch runs
+    through ONE compiled program; only the per-epoch phase stitching is
+    host-side.  Returns a list of ``Wavefield``.
+    """
+    backend = resolve(backend)
+    dyn_batch = np.asarray(dyn_batch, dtype=np.float64)
+    if dyn_batch.ndim != 3:
+        raise ValueError(f"dyn_batch must be [B, nchan, nsub], got "
+                         f"shape {dyn_batch.shape}")
+    etas_b = np.asarray([float(e) for e in etas], dtype=np.float64)
+    if len(etas_b) != dyn_batch.shape[0]:
+        raise ValueError(f"{len(etas_b)} curvatures for "
+                         f"{dyn_batch.shape[0]} epochs")
+    if not np.all(np.isfinite(etas_b) & (etas_b > 0)):
+        raise ValueError(f"eta must be a positive finite curvature "
+                         f"(us/mHz^2), got {list(etas_b)}")
+    B, nchan, nsub = dyn_batch.shape
     chunk_nf = min(chunk_nf, nchan)
     chunk_nt = min(chunk_nt, nsub)
-    dt_s = float(abs(data.dt))
-    df_mhz = float(abs(data.df))
-    f_ref = float(data.freq)
-    freqs = np.asarray(data.freqs, dtype=np.float64)
+    freqs = np.asarray(freqs, dtype=np.float64)
+    times = np.asarray(times, dtype=np.float64)
+    dt_s = float(abs(dt)) if dt is not None else (
+        float(abs(times[1] - times[0])) if len(times) > 1 else 1.0)
+    df_mhz = float(abs(df)) if df is not None else (
+        float(abs(freqs[1] - freqs[0])) if len(freqs) > 1 else 1.0)
+    f_ref = float(np.mean(freqs)) if freq is None else float(freq)
 
     # shared chunk geometry (calc_sspec units: fd mHz, tau us)
     geom = (dt_s, df_mhz)
@@ -306,35 +351,41 @@ def retrieve_wavefield(data: DynspecData, eta: float, chunk_nf: int = 64,
 
     fstarts = _chunk_starts(nchan, chunk_nf)
     tstarts = _chunk_starts(nsub, chunk_nt)
+    slots = [(cf, ct) for cf in fstarts for ct in tstarts]
+    K = len(slots)
     w2d = np.hanning(chunk_nf)[:, None] * np.hanning(chunk_nt)[None, :]
 
-    # per-chunk curvature (eta ~ 1/f^2) and theta span
-    chunks, etas, slots = [], [], []
-    for cf in fstarts:
-        f_c = float(np.mean(freqs[cf:cf + chunk_nf]))
-        eta_c = float(eta) * (f_ref / f_c) ** 2
-        for ct in tstarts:
-            chunks.append(dyn[cf:cf + chunk_nf, ct:ct + chunk_nt])
-            etas.append(eta_c)
-            slots.append((cf, ct))
-    chunks = np.stack(chunks)
+    # per-(epoch, chunk) curvature: eta ~ 1/f^2 across the band
+    row_scale = np.array([(f_ref / float(np.mean(freqs[cf:cf + chunk_nf])))
+                          ** 2 for cf in fstarts])
+    chunk_scale = np.repeat(row_scale, len(tstarts))          # [K]
+    eta_bc = etas_b[:, None] * chunk_scale[None, :]           # [B, K]
 
-    # theta grid: one shared span (chunks differ only a few % in eta),
-    # capped by the STEEPEST chunk's curvature (eta_hi) so no chunk's
-    # tau = eta_c*theta^2 leaves the delay Nyquist window.  Unless
-    # overridden, the spacing matches the chunk resolution on BOTH
-    # conjugate axes: at most the Doppler bin width, and fine enough
-    # that one theta step moves the delay by at most one delay bin at
-    # the arc edge (steep arcs are delay-resolved long before they are
-    # Doppler-resolved).  The NUDFT sampler is exact for any spacing.
-    eta_hi = max(etas)
+    # theta grid: ONE shared span for the whole batch (one compiled
+    # program), capped by the STEEPEST chunk of the steepest epoch so no
+    # chunk's tau = eta_c*theta^2 leaves the delay Nyquist window.
+    # Unless overridden, the spacing matches the chunk resolution on
+    # BOTH conjugate axes: at most the Doppler bin width, and fine
+    # enough that one theta step moves the delay by at most one delay
+    # bin at the arc edge (steep arcs are delay-resolved long before
+    # they are Doppler-resolved).  The NUDFT sampler is exact for any
+    # spacing.
+    eta_hi = float(eta_bc.max())
     theta_max = theta_frac * min(fd_max, float(np.sqrt(tau_max / eta_hi)))
     if ntheta is None:
         d_th = min(d_fd_bin, d_tau_bin / (2 * eta_hi * theta_max))
         nhalf = int(np.clip(np.floor(theta_max / d_th), 4, 128))
         ntheta = 2 * nhalf + 1
     ntheta = int(ntheta)
-    tmaxs = [theta_max] * len(chunks)
+
+    # flatten epochs x chunks -> one device program
+    chunks = np.empty((B * K, chunk_nf, chunk_nt))
+    for b in range(B):
+        for k, (cf, ct) in enumerate(slots):
+            chunks[b * K + k] = dyn_batch[b, cf:cf + chunk_nf,
+                                          ct:ct + chunk_nt]
+    etas_flat = eta_bc.reshape(-1)
+    tmaxs = np.full(B * K, theta_max)
 
     if backend == "jax":
         import jax.numpy as jnp
@@ -342,19 +393,19 @@ def retrieve_wavefield(data: DynspecData, eta: float, chunk_nf: int = 64,
         run = _chunks_jax(geom, int(ntheta), int(niter), float(mask_fd),
                           float(mask_tau))
         E_all, conc = run(jnp.asarray(chunks), jnp.asarray(w2d),
-                          jnp.asarray(etas), jnp.asarray(tmaxs))
+                          jnp.asarray(etas_flat), jnp.asarray(tmaxs))
         E_all = np.asarray(E_all)
         conc = np.asarray(conc, dtype=np.float64)
     else:
         grid_cache: dict = {}
         out = []
         last_eta = None
-        for c, e, tm in zip(chunks, etas, tmaxs):
+        for c, e, tm in zip(chunks, etas_flat, tmaxs):
             if last_eta is not None and e != last_eta:
-                # chunks are frequency-row-major and rows are never
-                # revisited: drop the previous row's eta-keyed phase
-                # tensors (each [nf_c, ntheta, ntheta] complex) so peak
-                # cache memory stays one row, not the whole band
+                # chunks are epoch- then frequency-row-major and rows
+                # are never revisited: drop the previous row's eta-keyed
+                # phase tensors (each [nf_c, ntheta, ntheta] complex) so
+                # peak cache memory stays one row, not the whole batch
                 for k in [k for k in grid_cache
                           if isinstance(k, tuple) and k[1] == last_eta]:
                     del grid_cache[k]
@@ -365,20 +416,36 @@ def retrieve_wavefield(data: DynspecData, eta: float, chunk_nf: int = 64,
         E_all = np.stack([o[0] for o in out])
         conc = np.array([o[1] for o in out], dtype=np.float64)
 
-    # overlap-add stitch with per-chunk global-phase alignment (host).
-    # The BLEND window adds a small pedestal to the Hann analysis
-    # window: np.hanning is zero at its endpoints, so pure-Hann blending
-    # would leave the spectrum's outermost row/column of pixels (covered
-    # only by a chunk edge) identically zero; the pedestal gives them
-    # the nearest chunk's model value, and den-normalisation keeps the
-    # blend unbiased for any window
+    theta = np.linspace(-theta_max, theta_max, ntheta)
+    return [
+        _stitch(E_all[b * K:(b + 1) * K], conc[b * K:(b + 1) * K],
+                dyn_batch[b], slots, (chunk_nf, chunk_nt), w2d, freqs,
+                times, float(etas_b[b]), eta_bc[b], theta)
+        for b in range(B)
+    ]
+
+
+def _stitch(E_chunks, conc, dyn, slots, chunk_shape, w2d, freqs, times,
+            eta, chunk_etas, theta) -> Wavefield:
+    """Overlap-add one epoch's chunk fields with per-chunk global-phase
+    alignment (host-side; cheap).
+
+    The BLEND window adds a small pedestal to the Hann analysis window:
+    np.hanning is zero at its endpoints, so pure-Hann blending would
+    leave the spectrum's outermost row/column of pixels (covered only by
+    a chunk edge) identically zero; the pedestal gives them the nearest
+    chunk's model value, and den-normalisation keeps the blend unbiased
+    for any window.
+    """
+    chunk_nf, chunk_nt = chunk_shape
+    nchan, nsub = dyn.shape
     wb2d = np.outer(np.hanning(chunk_nf) + 0.02,
                     np.hanning(chunk_nt) + 0.02)
     num = np.zeros((nchan, nsub), dtype=np.complex128)
     den = np.zeros((nchan, nsub), dtype=np.float64)
     align = np.full(len(slots), np.nan)
     for k, (cf, ct) in enumerate(slots):
-        E_c = E_all[k]
+        E_c = E_chunks[k]
         sl = (slice(cf, cf + chunk_nf), slice(ct, ct + chunk_nt))
         z = np.sum(num[sl] * np.conj(E_c) * w2d)
         norm = (np.sqrt(np.sum(np.abs(num[sl]) ** 2 * w2d))
@@ -395,9 +462,7 @@ def retrieve_wavefield(data: DynspecData, eta: float, chunk_nf: int = 64,
     model = float(np.sum(np.abs(field) ** 2))
     if model > 0:
         field = field * np.sqrt(flux / model)
-    return Wavefield(field=field, freqs=freqs,
-                     times=np.asarray(data.times, dtype=np.float64),
-                     eta=float(eta), chunk_shape=(chunk_nf, chunk_nt),
-                     conc=conc, align=align,
-                     theta=np.linspace(-theta_max, theta_max, ntheta),
-                     chunk_etas=np.asarray(etas, dtype=np.float64))
+    return Wavefield(field=field, freqs=freqs, times=times, eta=eta,
+                     chunk_shape=(chunk_nf, chunk_nt), conc=conc,
+                     align=align, theta=theta,
+                     chunk_etas=np.asarray(chunk_etas, dtype=np.float64))
