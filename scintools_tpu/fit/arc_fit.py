@@ -420,7 +420,8 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
                             method, delmax, numsteps, startbin, cutmid,
                             etamax, etamin, low_power_diff, high_power_diff,
                             ref_freq, constraint, nsmooth, noise_error,
-                            asymm=False, constraints=None):
+                            asymm=False, constraints=None,
+                            scrunch_rows=0):
     if asymm and constraints is not None:
         raise ValueError("asymm=True and multi-arc constraints are "
                          "mutually exclusive on the batched fitter")
@@ -568,12 +569,51 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         rows = sspec[startbin:ind_norm, :]
         rows = jnp.where(col_nan[None, :], jnp.nan, rows)
 
-        i0 = jnp.asarray(_i0_static)
-        w = jnp.asarray(_w_static, dtype=rows.dtype)
-        v0 = jnp.take_along_axis(rows, i0, axis=1)
-        v1 = jnp.take_along_axis(rows, i0 + 1, axis=1)
-        norm = v0 * (1.0 - w) + v1 * w                       # [R, n]
-        prof = jnp.nanmean(norm, axis=0)                     # [n]
+        if scrunch_rows:
+            # lax.scan over row blocks: the full-gather path materialises
+            # [R, n] (x3 under a B-epoch vmap: [B, R, n] v0/v1/norm in
+            # HBM); accumulating the delay-scrunch nansum/count per block
+            # caps the working set at [B, scrunch_rows, n] regardless of
+            # the delay cut.  Same values as nanmean (sum/count), modulo
+            # f.p. association; NaN-padded tail rows contribute nothing.
+            R = _i0_static.shape[0]
+            nb = -(-R // scrunch_rows)
+            pad = nb * scrunch_rows - R
+            rows_b = jnp.pad(rows, ((0, pad), (0, 0)),
+                             constant_values=np.nan).reshape(
+                                 nb, scrunch_rows, ncol)
+            i0_b = jnp.asarray(np.pad(_i0_static, ((0, pad), (0, 0)))
+                               .reshape(nb, scrunch_rows, n))
+            w_b = jnp.asarray(np.pad(_w_static, ((0, pad), (0, 0)))
+                              .reshape(nb, scrunch_rows, n),
+                              dtype=rows.dtype)
+
+            def body(carry, xs):
+                s, c = carry
+                rc, ic, wc = xs
+                v0 = jnp.take_along_axis(rc, ic, axis=1)
+                v1 = jnp.take_along_axis(rc, ic + 1, axis=1)
+                nrm = v0 * (1.0 - wc) + v1 * wc
+                # nanmean semantics exactly: skip NaN only — a -inf
+                # value (zero-power dB pixel) must poison the mean as it
+                # does on the full-gather path
+                keep = ~jnp.isnan(nrm)
+                s = s + jnp.sum(jnp.where(keep, nrm, 0.0), axis=0)
+                c = c + jnp.sum(keep.astype(s.dtype), axis=0)
+                return (s, c), None
+
+            (s, c), _ = jax.lax.scan(
+                body, (jnp.zeros(n, rows.dtype),
+                       jnp.zeros(n, rows.dtype)),
+                (rows_b, i0_b, w_b))
+            prof = jnp.where(c > 0, s / jnp.maximum(c, 1.0), jnp.nan)
+        else:
+            i0 = jnp.asarray(_i0_static)
+            w = jnp.asarray(_w_static, dtype=rows.dtype)
+            v0 = jnp.take_along_axis(rows, i0, axis=1)
+            v1 = jnp.take_along_axis(rows, i0 + 1, axis=1)
+            norm = v0 * (1.0 - w) + v1 * w                   # [R, n]
+            prof = jnp.nanmean(norm, axis=0)                 # [n]
         # +2 dB quirk (dynspec.py:864-866)
         i_at_1 = int(np.argmin(np.abs(fdopnew - 1) - 2))
         prof = jnp.where(prof[i_at_1] < 0, prof + 2.0, prof)
@@ -791,7 +831,8 @@ def make_arc_fitter(fdop, yaxis, tdel, freq, lamsteps=True,
                     startbin=3, cutmid=3, etamax=None, etamin=None,
                     low_power_diff=-3.0, high_power_diff=-1.5,
                     ref_freq=1400.0, constraint=(0, np.inf), nsmooth=5,
-                    noise_error=True, asymm=False, constraints=None):
+                    noise_error=True, asymm=False, constraints=None,
+                    scrunch_rows=0):
     """Build a jit'd batched arc fitter for a fixed (fdop, yaxis) grid.
 
     Returns ``fitter(sspec_batch [B, nr, nc]) -> ArcFit`` of [B] arrays.
@@ -800,9 +841,17 @@ def make_arc_fitter(fdop, yaxis, tdel, freq, lamsteps=True,
     Both reference methods are implemented: ``norm_sspec`` (row
     normalisation) and ``gridmax`` (chunked bilinear sampling along
     ``tdel = eta fdop^2`` trial arcs).
+
+    ``scrunch_rows`` (norm_sspec only): 0 materialises the full [R, n]
+    row-resample ([B, R, n] under a batch); a positive value accumulates
+    the delay-scrunch over lax.scan blocks of that many rows, trading
+    one big gather for bounded HBM working set — same values modulo
+    floating-point association.
     """
     if method not in ("norm_sspec", "gridmax"):
         raise ValueError(f"unknown arc fitting method {method!r}")
+    if int(scrunch_rows) < 0:
+        raise ValueError(f"scrunch_rows must be >= 0, got {scrunch_rows}")
     fdop = np.ascontiguousarray(np.asarray(fdop, dtype=np.float64))
     yaxis = np.ascontiguousarray(np.asarray(yaxis, dtype=np.float64))
     tdel = np.ascontiguousarray(np.asarray(tdel, dtype=np.float64))
@@ -817,7 +866,8 @@ def make_arc_fitter(fdop, yaxis, tdel, freq, lamsteps=True,
         (float(constraint[0]), float(constraint[1])), int(nsmooth),
         bool(noise_error), bool(asymm),
         None if constraints is None else tuple(
-            (float(lo), float(hi)) for lo, hi in constraints))
+            (float(lo), float(hi)) for lo, hi in constraints),
+        int(scrunch_rows))
 
 
 def fit_arcs_multi(sec: SecSpec, freq: float, brackets,

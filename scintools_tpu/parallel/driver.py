@@ -68,6 +68,7 @@ class PipelineConfig:
     arc_constraint: tuple = (0.0, np.inf)
     arc_asymm: bool = False       # per-arm eta_left/eta_right in ArcFit
     arc_brackets: tuple | None = None  # K (lo, hi) windows -> eta [B, K]
+    arc_scrunch_rows: int = 0     # >0: lax.scan row blocks (bounded HBM)
     ref_freq: float = 1400.0
     return_acf: bool = False
     return_sspec: bool = False
@@ -179,7 +180,8 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
             startbin=config.arc_startbin, cutmid=config.arc_cutmid,
             nsmooth=config.arc_nsmooth, delmax=config.arc_delmax,
             constraint=config.arc_constraint, ref_freq=config.ref_freq,
-            asymm=config.arc_asymm, constraints=config.arc_brackets)
+            asymm=config.arc_asymm, constraints=config.arc_brackets,
+            scrunch_rows=config.arc_scrunch_rows)
 
     def step(dyn_batch):
         dyn_batch = jnp.asarray(dyn_batch)

@@ -248,6 +248,31 @@ def test_arc_fitter_batched():
     np.testing.assert_allclose(etas, [0.4, 0.8], rtol=0.15)
 
 
+def test_arc_fitter_scrunch_rows_matches_gather():
+    """scrunch_rows>0 (lax.scan row-block delay-scrunch, bounded HBM
+    working set) reproduces the full-gather path's measurements to
+    floating-point association."""
+    secs = [_arc_secspec(eta=e, rng=np.random.default_rng(i))
+            for i, e in enumerate([0.4, 0.8])]
+    kw = dict(fdop=secs[0].fdop, yaxis=secs[0].beta, tdel=secs[0].tdel,
+              freq=1400.0, numsteps=1024)
+    import jax.numpy as jnp
+
+    batch = np.stack([np.asarray(s.sspec) for s in secs])
+    batch[0, 40, 10] = -np.inf  # zero-power dB pixel: must poison the
+    batch[1, 25, 30] = np.nan   # mean exactly like nanmean; NaN skipped
+    batch = jnp.asarray(batch)
+    base = make_arc_fitter(**kw)(batch)
+    for rc in (7, 32):  # non-divisor and divisor block sizes
+        fit = make_arc_fitter(scrunch_rows=rc, **kw)(batch)
+        np.testing.assert_allclose(np.asarray(fit.eta),
+                                   np.asarray(base.eta), rtol=1e-10)
+        np.testing.assert_allclose(np.asarray(fit.etaerr),
+                                   np.asarray(base.etaerr), rtol=1e-8)
+    with pytest.raises(ValueError, match="scrunch_rows"):
+        make_arc_fitter(scrunch_rows=-7, **kw)
+
+
 def test_norm_sspec_profile_peaks_at_unity():
     """With eta set to the true curvature, the folded normalised profile
     peaks at normalised fdop = +-1."""
