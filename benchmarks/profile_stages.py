@@ -80,6 +80,12 @@ def main():
         lamsteps=False, fit_scint=False, fit_arc=False, return_sspec=True,
         arc_numsteps=ns))
     bench("lam+sspec+arc", PipelineConfig(fit_scint=False, arc_numsteps=ns))
+    # A/B the arc delay-scrunch strategies (roadmap: pick a default from
+    # on-chip numbers, not CPU guesses): full [B, R, n] gather vs lax.scan
+    # row blocks with a bounded working set
+    for rc in (64, 256):
+        bench(f"lam+sspec+arc rc={rc}", PipelineConfig(
+            fit_scint=False, arc_numsteps=ns, arc_scrunch_rows=rc))
     bench("scint fit only", PipelineConfig(fit_arc=False, arc_numsteps=ns))
     bench("FULL (bench cfg)", PipelineConfig(arc_numsteps=ns, lm_steps=30))
 
