@@ -2,7 +2,8 @@
 
 The reference ships an argparse stub with zero arguments that does
 nothing (scintools/scintools.py:1-16).  This is the real CLI planned in
-SURVEY.md §5: ``info`` / ``process`` / ``sort`` / ``sim`` / ``bench``.
+SURVEY.md §5: ``info`` / ``process`` / ``sort`` / ``sim`` /
+``wavefield`` / ``bench``.
 
     python -m scintools_tpu process obs1.dynspec obs2.dynspec \
         --lamsteps --backend jax --results results.csv --store runs/survey
@@ -230,6 +231,62 @@ def cmd_sim(args) -> int:
     return 0
 
 
+def cmd_wavefield(args) -> int:
+    import numpy as np
+
+    from .pipeline import Dynspec
+
+    files = _expand(args.files)
+    if args.out and len(files) != 1:
+        print(f"--out needs exactly one input file (got {len(files)}); "
+              f"omit it to write per-file <name>.wavefield.npz",
+              file=sys.stderr)
+        return 1
+    if args.plots:
+        import matplotlib
+
+        matplotlib.use("Agg")
+    rc = 0
+    for fn in files:
+        try:
+            ds = Dynspec(filename=fn, process=True, backend=args.backend)
+            if args.eta is not None:
+                eta = float(args.eta)
+            else:
+                ds.fit_arc(method="thetatheta", lamsteps=False,
+                           etamin=args.etamin, etamax=args.etamax,
+                           numsteps=args.numsteps)
+                eta = float(ds.eta)
+            wf = ds.retrieve_wavefield(eta=eta, chunk_nf=args.chunk,
+                                       chunk_nt=args.chunk)
+            dyn = np.asarray(ds.data.dyn, float)
+            corr = float(np.corrcoef(dyn.ravel(),
+                                     wf.model_dynspec.ravel())[0, 1])
+            base = fn.rsplit(".", 1)[0]
+            out = args.out if args.out else f"{base}.wavefield.npz"
+            wf.save(out)
+            if args.plots:
+                import matplotlib.pyplot as plt
+
+                from . import plotting
+
+                plotting.plot_wavefield(
+                    wf, filename=f"{base}.wavefield.png")
+                plotting.plot_sspec(
+                    wf.secspec(), eta=eta,
+                    filename=f"{base}.wavefield_sspec.png")
+                plt.close("all")
+            print(json.dumps({
+                "file": fn, "eta": eta, "corr": round(corr, 4),
+                "conc_mean": round(float(wf.conc.mean()), 4),
+                "ntheta": len(wf.theta), "out": out}))
+        except Exception as e:
+            print(f"{fn}: wavefield retrieval failed ({e})",
+                  file=sys.stderr)
+            rc = 1
+    return rc
+
+
 def cmd_bench(args) -> int:
     # bench.py lives at the repo root (the driver contract), not in the
     # installed package: load it by path relative to this package, falling
@@ -312,6 +369,28 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--backend", default="numpy",
                    choices=["numpy", "jax"])
     q.set_defaults(fn=cmd_sim)
+
+    q = sub.add_parser(
+        "wavefield",
+        help="retrieve the complex wavefield (theta-theta holography)")
+    q.add_argument("files", nargs="+", help="psrflux dynspec files")
+    q.add_argument("--eta", type=float, default=None,
+                   help="arc curvature (us/mHz^2); omit to fit it")
+    q.add_argument("--etamin", type=float, default=1e-4,
+                   help="curvature-fit bracket (used when --eta omitted)")
+    q.add_argument("--etamax", type=float, default=100.0)
+    q.add_argument("--numsteps", type=int, default=128,
+                   help="curvature-sweep points")
+    q.add_argument("--chunk", type=int, default=64,
+                   help="chunk size (both axes)")
+    q.add_argument("--out", default=None,
+                   help="output .npz (single input only; default "
+                        "<file>.wavefield.npz)")
+    q.add_argument("--plots", action="store_true",
+                   help="also write wavefield + field-sspec PNGs")
+    q.add_argument("--backend", default="numpy",
+                   choices=["numpy", "jax", "auto"])
+    q.set_defaults(fn=cmd_wavefield)
 
     q = sub.add_parser("bench", help="run the headline benchmark")
     q.set_defaults(fn=cmd_bench)

@@ -79,6 +79,31 @@ class Wavefield:
         """|E|^2 — compare against the input dynamic spectrum."""
         return np.abs(self.field) ** 2
 
+    def save(self, path: str) -> None:
+        """Persist to an .npz (complex field + axes + diagnostics).
+        None-valued optional fields are omitted (a pickled None would
+        make the file unloadable under np.load's allow_pickle=False)."""
+        arrays = dict(field=self.field, freqs=self.freqs,
+                      times=self.times, eta=self.eta,
+                      chunk_shape=np.asarray(self.chunk_shape),
+                      conc=self.conc, align=self.align)
+        if self.theta is not None:
+            arrays["theta"] = self.theta
+        if self.chunk_etas is not None:
+            arrays["chunk_etas"] = self.chunk_etas
+        np.savez_compressed(path, **arrays)
+
+    @classmethod
+    def load(cls, path: str) -> "Wavefield":
+        with np.load(path) as z:
+            return cls(field=z["field"], freqs=z["freqs"],
+                       times=z["times"], eta=float(z["eta"]),
+                       chunk_shape=tuple(int(x) for x in z["chunk_shape"]),
+                       conc=z["conc"], align=z["align"],
+                       theta=z["theta"] if "theta" in z.files else None,
+                       chunk_etas=z["chunk_etas"]
+                       if "chunk_etas" in z.files else None)
+
     def secspec(self, pad: int = 2, db: bool = True) -> "SecSpec":
         """Secondary spectrum of the FIELD: |FFT2(E)|^2.
 

@@ -133,6 +133,31 @@ def test_cli_sim_roundtrip(tmp_path, capsys):
     assert info["nchan"] == 64 and os.path.exists(out)
 
 
+def test_cli_wavefield(sim_file, tmp_path, capsys):
+    """wavefield subcommand: fit curvature, retrieve, persist npz; the
+    saved Wavefield round-trips."""
+    from scintools_tpu.fit import Wavefield
+
+    out = str(tmp_path / "wf.npz")
+    rc = cli_main(["wavefield", sim_file, "--out", out, "--chunk", "32",
+                   "--numsteps", "64", "--etamin", "1e-3",
+                   "--etamax", "10"])
+    assert rc == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["eta"] > 0 and os.path.exists(out)
+    wf = Wavefield.load(out)
+    assert wf.field.shape == (128, 128)
+    assert np.iscomplexobj(wf.field)
+    assert wf.eta == pytest.approx(info["eta"])
+    assert len(wf.theta) == info["ntheta"]
+
+
+def test_cli_wavefield_bad_file(tmp_path):
+    fn = str(tmp_path / "nope.dynspec")
+    open(fn, "w").write("not a dynspec\n")
+    assert cli_main(["wavefield", fn]) == 1
+
+
 def test_cli_process_with_resume(sim_file, tmp_path, capsys):
     res = str(tmp_path / "results.csv")
     store = str(tmp_path / "store")
