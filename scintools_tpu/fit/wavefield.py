@@ -220,8 +220,11 @@ def _chunk_field_xp(chunk, w2d, eta_c, theta_max, geom, ntheta, niter,
     H = 0.5 * (TT + xp.conj(TT.T))
 
     # principal eigenvector by fixed-step power iteration (identical on
-    # both backends; H is Hermitian with a dominant positive eigenvalue)
-    v = xp.ones(ntheta, dtype=H.dtype) / np.sqrt(ntheta)
+    # both backends; H is Hermitian with a dominant positive eigenvalue).
+    # The init is derived from H (zeros_like + 1 == ones) so that under
+    # shard_map the scan carry carries H's varying-axis type — a literal
+    # ones() is "unvarying" and newer jax rejects the carry mismatch
+    v = (xp.zeros_like(H[0]) + 1.0) / np.sqrt(ntheta)
     if scan is None:
         for _ in range(niter):
             v = H @ v
@@ -257,8 +260,14 @@ def _chunk_field_xp(chunk, w2d, eta_c, theta_max, geom, ntheta, niter,
 
 @functools.lru_cache(maxsize=16)
 def _chunks_jax(geom, ntheta: int, niter: int, mask_fd: float,
-                mask_tau: float):
-    """jit'd all-chunks retrieval, cached on the shared chunk geometry."""
+                mask_tau: float, mesh=None):
+    """jit'd all-chunks retrieval, cached on the shared chunk geometry.
+
+    With ``mesh``, the flattened chunk axis is sharded over the mesh's
+    ``data`` axis via shard_map — each device lax.maps its local chunks
+    (zero cross-device communication; stitching gathers on host), so a
+    survey bucket's holography scales across the slice.
+    """
     import jax
     import jax.numpy as jnp
 
@@ -267,8 +276,7 @@ def _chunks_jax(geom, ntheta: int, niter: int, mask_fd: float,
                                niter, mask_fd, mask_tau, xp=jnp,
                                scan=jax.lax.scan)
 
-    @jax.jit
-    def run(chunks, w2d, etas, theta_maxs):
+    def run_local(chunks, w2d, etas, theta_maxs):
         # lax.map, not vmap: stage 2 materialises an [nf_c, ntheta,
         # ntheta] complex intermediate per chunk (tens of MB); a vmap
         # over hundreds of chunks on a big dynspec would multiply that
@@ -279,7 +287,21 @@ def _chunks_jax(geom, ntheta: int, niter: int, mask_fd: float,
                                             args[2]),
                            (chunks, etas, theta_maxs))
 
-    return run
+    if mesh is None:
+        return jax.jit(run_local)
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import DATA_AXIS
+
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+    shard = shard_map(
+        run_local, mesh=mesh,
+        in_specs=(P(DATA_AXIS), P(), P(DATA_AXIS), P(DATA_AXIS)),
+        out_specs=(P(DATA_AXIS), P(DATA_AXIS)))
+    return jax.jit(shard)
 
 
 def retrieve_wavefield(data: DynspecData, eta: float, chunk_nf: int = 64,
@@ -325,7 +347,7 @@ def retrieve_wavefield_batch(dyn_batch, freqs, times, etas,
                              chunk_nf: int = 64, chunk_nt: int = 64,
                              ntheta: int | None = None, niter: int = 60,
                              mask_bins: float = 1.5,
-                             theta_frac: float = 0.95,
+                             theta_frac: float = 0.95, mesh=None,
                              backend: str = "jax") -> list:
     """Retrieve wavefields for a BATCH of epochs sharing one grid.
 
@@ -340,7 +362,10 @@ def retrieve_wavefield_batch(dyn_batch, freqs, times, etas,
     theta grid (span capped by the steepest epoch's lowest-frequency
     chunk), so on the jax backend every chunk of every epoch runs
     through ONE compiled program; only the per-epoch phase stitching is
-    host-side.  Returns a list of ``Wavefield``.
+    host-side.  With ``mesh`` (jax backend), the flattened chunk axis
+    is sharded over the mesh's ``data`` axis — embarrassingly parallel
+    holography across the slice (chunk count padded to the axis size).
+    Returns a list of ``Wavefield``.
     """
     backend = resolve(backend)
     dyn_batch = np.asarray(dyn_batch, dtype=np.float64)
@@ -416,11 +441,33 @@ def retrieve_wavefield_batch(dyn_batch, freqs, times, etas,
         import jax.numpy as jnp
 
         run = _chunks_jax(geom, int(ntheta), int(niter), float(mask_fd),
-                          float(mask_tau))
+                          float(mask_tau), mesh)
+        n_flat = chunks.shape[0]
+        if mesh is not None:
+            # pad the chunk axis to the data-axis size so shard_map gets
+            # equal shards; dummy chunks (zero flux) are dropped after
+            from ..parallel.mesh import DATA_AXIS
+
+            nd = int(mesh.shape[DATA_AXIS])
+            pad = (-n_flat) % nd
+            if pad:
+                chunks = np.concatenate(
+                    [chunks, np.zeros((pad,) + chunks.shape[1:])])
+                etas_flat = np.concatenate([etas_flat,
+                                            np.full(pad, eta_hi)])
+                tmaxs = np.concatenate([tmaxs, np.full(pad, theta_max)])
+            # place each shard directly on its device (leading axis on
+            # the data axis) — staging the whole padded tensor on device
+            # 0 and letting jit reshard would put the entire bucket's
+            # chunk tensor in one device's HBM
+            from ..parallel.mesh import shard_leading
+
+            chunks, etas_flat, tmaxs = shard_leading(
+                (chunks, etas_flat, tmaxs), mesh)
         E_all, conc = run(jnp.asarray(chunks), jnp.asarray(w2d),
                           jnp.asarray(etas_flat), jnp.asarray(tmaxs))
-        E_all = np.asarray(E_all)
-        conc = np.asarray(conc, dtype=np.float64)
+        E_all = np.asarray(E_all)[:n_flat]
+        conc = np.asarray(conc, dtype=np.float64)[:n_flat]
     else:
         grid_cache: dict = {}
         out = []
