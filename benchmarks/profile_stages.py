@@ -87,7 +87,14 @@ def main():
         bench(f"lam+sspec+arc rc={rc}", PipelineConfig(
             fit_scint=False, arc_numsteps=ns, arc_scrunch_rows=rc))
     bench("scint fit only", PipelineConfig(fit_arc=False, arc_numsteps=ns))
+    # A/B the ACF-cut route: padded 1-D FFTs (VPU) vs Gram-matrix diagonal
+    # sums (MXU) — same linear correlations, different hardware unit
+    bench("scint fit mxu cuts", PipelineConfig(
+        fit_arc=False, arc_numsteps=ns, scint_cuts="matmul"))
     bench("FULL (bench cfg)", PipelineConfig(arc_numsteps=ns, lm_steps=30))
+    bench("FULL mxu+rc64", PipelineConfig(
+        arc_numsteps=ns, lm_steps=30, scint_cuts="matmul",
+        arc_scrunch_rows=64))
 
 
 if __name__ == "__main__":

@@ -162,11 +162,12 @@ def _fit_scint_single_from_cuts(y_t, y_f, dt, df, alpha, steps):
 
 
 @functools.lru_cache(maxsize=None)
-def _fit_scint_from_dyn_jax(alpha, steps):
+def _fit_scint_from_dyn_jax(alpha, steps, cuts_method="fft"):
     """Batched fit STRAIGHT from the dynspec batch: the 1-D cuts are
     computed with padded 1-D FFT reductions (ops.acf.acf_cuts_direct),
     never materialising the [B, 2nf, 2nt] 2-D ACF — the fast path of the
-    batched pipeline."""
+    batched pipeline.  ``cuts_method="matmul"`` uses the MXU Gram-matrix
+    route for the cuts instead of 1-D FFTs."""
     import jax
     import jax.numpy as jnp
 
@@ -174,7 +175,8 @@ def _fit_scint_from_dyn_jax(alpha, steps):
 
     @jax.jit
     def impl(dyn_batch, dt, df):
-        cut_t, cut_f = acf_cuts_direct(dyn_batch, backend="jax")
+        cut_t, cut_f = acf_cuts_direct(dyn_batch, backend="jax",
+                                       method=cuts_method)
         res = jax.vmap(
             lambda yt, yf, a, b: _fit_scint_single_from_cuts(
                 yt, yf, a, b, alpha, steps))(cut_t, cut_f, dt, df)
@@ -185,7 +187,8 @@ def _fit_scint_from_dyn_jax(alpha, steps):
 
 def fit_scint_params_from_dyn(dyn_batch, dt, df,
                               alpha: float | None = _ALPHA_KOLMOGOROV,
-                              steps: int = 40) -> ScintParams:
+                              steps: int = 40,
+                              cuts_method: str = "fft") -> ScintParams:
     """tau/dnu fits for a [B, nf, nt] dynspec batch via direct ACF cuts
     (identical results to the 2-D-ACF route; much less FFT work)."""
     import jax.numpy as jnp
@@ -194,7 +197,8 @@ def fit_scint_params_from_dyn(dyn_batch, dt, df,
                           (dyn_batch.shape[0],))
     df = jnp.broadcast_to(jnp.asarray(df, dtype=jnp.result_type(float)),
                           (dyn_batch.shape[0],))
-    return _fit_scint_from_dyn_jax(alpha, steps)(dyn_batch, dt, df)
+    return _fit_scint_from_dyn_jax(alpha, steps, cuts_method)(
+        dyn_batch, dt, df)
 
 
 @functools.lru_cache(maxsize=None)

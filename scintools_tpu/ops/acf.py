@@ -102,7 +102,43 @@ def _acf_cuts_jax():
     return impl
 
 
-def acf_cuts_direct(dyn, backend: str = "jax", subtract_mean: bool = True):
+def _diag_sums(C, jnp):
+    """Positive-offset diagonal sums of square matrices on the last two
+    axes: out[..., k] = sum_i C[..., i, i+k] for k = 0..n-1."""
+    n = C.shape[-1]
+    i = jnp.arange(n)
+    idx = i[:, None] + i[None, :]              # [row i, lag k] -> i + k
+    mask = idx < n
+    idx = jnp.where(mask, idx, 0)
+    shape = (1,) * (C.ndim - 2) + (n, n)
+    g = jnp.take_along_axis(C, idx.reshape(shape), axis=-1)
+    return jnp.sum(jnp.where(mask.reshape(shape), g, 0.0), axis=-2)
+
+
+@functools.lru_cache(maxsize=1)
+def _acf_cuts_matmul_jax():
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def impl(arr, subtract_mean):
+        if subtract_mean:
+            arr = _masked_mean_subtract(arr, jnp)
+        # Gram matrices on the MXU: the zero-time-lag freq cut is the
+        # k-th-diagonal sum of X X^T, the zero-freq-lag time cut of
+        # X^T X (both are the padded-FFT cuts' linear correlations,
+        # written as dense contractions so they ride the systolic array
+        # instead of the VPU FFT path).
+        hi = jax.lax.Precision.HIGHEST
+        Cf = jnp.einsum("...ft,...gt->...fg", arr, arr, precision=hi)
+        Ct = jnp.einsum("...ft,...fs->...ts", arr, arr, precision=hi)
+        return _diag_sums(Ct, jnp), _diag_sums(Cf, jnp)
+
+    return impl
+
+
+def acf_cuts_direct(dyn, backend: str = "jax", subtract_mean: bool = True,
+                    method: str = "fft"):
     """The central positive-lag 1-D cuts of the 2-D ACF, computed WITHOUT
     the 2-D transform.
 
@@ -116,10 +152,23 @@ def acf_cuts_direct(dyn, backend: str = "jax", subtract_mean: bool = True):
     a fraction of the 2-D pair's FLOPs and without materialising the
     [B, 2nf, 2nt] array (the dominant cost of the batched fit path).
     Returns (cut_t [..., nt], cut_f [..., nf]).
+
+    ``method="matmul"`` computes the same cuts as diagonal sums of the
+    Gram matrices X X^T / X^T X — identical linear correlations, but as
+    dense f32 contractions that map onto the TPU MXU instead of the VPU
+    FFT pipeline (HIGHEST precision; agrees with the FFT path to normal
+    f32 contraction error).  ``method`` selects between the two jax
+    routes only: the numpy backend always slices the cuts out of the
+    reference-exact 2-D ACF (same values either way).
     """
+    if method not in ("fft", "matmul"):
+        raise ValueError(f"acf_cuts_direct: unknown method {method!r} "
+                         "(expected 'fft' or 'matmul')")
     backend = resolve(backend)
     if backend == "numpy":
         a = _acf_numpy(np.asarray(dyn), subtract_mean)
         nf, nt = np.asarray(dyn).shape[-2:]
         return a[..., nf, nt:], a[..., nf:, nt]
+    if method == "matmul":
+        return _acf_cuts_matmul_jax()(dyn, subtract_mean)
     return _acf_cuts_jax()(dyn, subtract_mean)

@@ -69,6 +69,13 @@ class PipelineConfig:
     arc_asymm: bool = False       # per-arm eta_left/eta_right in ArcFit
     arc_brackets: tuple | None = None  # K (lo, hi) windows -> eta [B, K]
     arc_scrunch_rows: int = 0     # >0: lax.scan row blocks (bounded HBM)
+    # ACF-cut route for the scint fit: "fft" (padded 1-D FFTs, VPU),
+    # "matmul" (Gram-matrix diagonal sums, MXU), or "auto" (matmul on
+    # TPU — measured ~2x faster there — fft elsewhere).  Only applies to
+    # the direct-cuts fast path; when return_acf/fit_scint_2d force the
+    # full 2-D ACF anyway, the fit reads its cuts from that ACF and this
+    # knob is irrelevant.
+    scint_cuts: str = "auto"
     ref_freq: float = 1400.0
     return_acf: bool = False
     return_sspec: bool = False
@@ -138,11 +145,62 @@ def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
     Memoised on (axes, config, mesh): repeated calls with the same template
     return the same compiled step (no retrace/recompile per survey batch).
     """
+    if config.scint_cuts not in ("auto", "fft", "matmul"):
+        raise ValueError(
+            f"PipelineConfig.scint_cuts: unknown method "
+            f"{config.scint_cuts!r} (expected 'auto', 'fft' or 'matmul')")
     freqs = np.ascontiguousarray(np.asarray(freqs, dtype=np.float64))
     times = np.ascontiguousarray(np.asarray(times, dtype=np.float64))
     return _make_pipeline_cached(
         (freqs.tobytes(), freqs.shape), (times.tobytes(), times.shape),
         config, mesh, bool(chan_sharded))
+
+
+# "auto" falls back to the FFT route above this many bytes of Gram-matrix
+# working set: the matmul route materialises [B, nf, nf] + [B, nt, nt]
+# (the FFT route stays O(nf*nt) per epoch), so long axes must not OOM a
+# pipeline that worked before the auto default existed.
+_AUTO_MATMUL_GRAM_BYTE_CAP = 1 << 30
+
+
+def _gram_bytes(batch_shape, mesh, itemsize: int) -> int:
+    """Per-device bytes the matmul cuts route would materialise: the
+    [b, nf, nf] + [b, nt, nt] Gram matrices, with the batch axis divided
+    over the mesh's data axis when sharded."""
+    b = int(np.prod(batch_shape[:-2], dtype=np.int64))
+    if mesh is not None:
+        b = -(-b // int(mesh.shape.get(mesh_mod.DATA_AXIS, 1)))
+    nf, nt = int(batch_shape[-2]), int(batch_shape[-1])
+    return itemsize * b * (nf * nf + nt * nt)
+
+
+def _resolve_cuts(method: str, mesh, batch_shape=None,
+                  itemsize: int = 4) -> str:
+    """Resolve scint_cuts="auto" per target hardware: the MXU Gram route
+    is ~2x the FFT route on TPU (measured, docs/performance.md) and has
+    no advantage on CPU.  Called at TRACE time (inside the first step
+    call), never at pipeline-build time, so building stays device-free."""
+    if method not in ("auto", "fft", "matmul"):
+        raise ValueError(f"scint_cuts: unknown method {method!r} "
+                         "(expected 'auto', 'fft' or 'matmul')")
+    if method != "auto":
+        return method
+    if (batch_shape is not None
+            and _gram_bytes(batch_shape, mesh, itemsize)
+            > _AUTO_MATMUL_GRAM_BYTE_CAP):
+        return "fft"
+    import jax
+
+    try:
+        devs = (list(mesh.devices.flat) if mesh is not None
+                else jax.devices())
+        d = devs[0]
+        kind = str(getattr(d, "device_kind", "")).lower()
+        if "tpu" in kind or d.platform in ("tpu", "axon"):
+            return "matmul"
+    except Exception:
+        pass
+    return "fft"
 
 
 @functools.lru_cache(maxsize=None)
@@ -227,7 +285,10 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
 
                 scint = fit_scint_params_from_dyn(
                     dyn_acf, dt, df, alpha=config.alpha,
-                    steps=config.lm_steps)
+                    steps=config.lm_steps,
+                    cuts_method=_resolve_cuts(
+                        config.scint_cuts, mesh, dyn_acf.shape,
+                        itemsize=dyn_acf.dtype.itemsize))
         arc = None
         sec_b = None
         if config.fit_arc or config.return_sspec:

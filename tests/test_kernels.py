@@ -162,6 +162,49 @@ def test_acf_cuts_direct_matches_2d_path():
     np.testing.assert_allclose(cf_np, np.asarray(cf), rtol=1e-8, atol=1e-8)
 
 
+def test_acf_cuts_matmul_matches_fft_path():
+    """The MXU Gram-matrix cuts equal the padded-1-D-FFT cuts."""
+    from scintools_tpu.ops.acf import acf_cuts_direct
+
+    rng = np.random.default_rng(11)
+    dyn = rng.standard_normal((3, 32, 48))
+    ct, cf = acf_cuts_direct(dyn, backend="jax", method="fft")
+    ct_m, cf_m = acf_cuts_direct(dyn, backend="jax", method="matmul")
+    assert np.asarray(ct_m).shape == np.asarray(ct).shape
+    assert np.asarray(cf_m).shape == np.asarray(cf).shape
+    np.testing.assert_allclose(np.asarray(ct_m), np.asarray(ct),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(cf_m), np.asarray(cf),
+                               rtol=1e-6, atol=1e-6)
+    # f32 input (the on-device dtype) stays within f32 contraction error
+    ct32, cf32 = acf_cuts_direct(dyn.astype(np.float32), backend="jax",
+                                 method="matmul")
+    scale = np.abs(np.asarray(ct)).max()
+    np.testing.assert_allclose(np.asarray(ct32), np.asarray(ct),
+                               atol=1e-3 * scale)
+    np.testing.assert_allclose(np.asarray(cf32), np.asarray(cf),
+                               atol=1e-3 * scale)
+
+
+def test_fit_from_dyn_matmul_cuts_route():
+    """fit_scint_params_from_dyn(cuts_method='matmul') matches the FFT
+    route's fitted parameters."""
+    from scintools_tpu.fit.scint_fit import fit_scint_params_from_dyn
+    from scintools_tpu.sim import Simulation
+    from scintools_tpu.io import from_simulation
+
+    sim = Simulation(mb2=2, ns=64, nf=48, dlam=0.25, seed=42)
+    d = from_simulation(sim, freq=1400.0, dt=8.0)
+    dyn = np.asarray(d.dyn)[None].astype(np.float64)
+    a = fit_scint_params_from_dyn(dyn, d.dt, abs(d.df))
+    b = fit_scint_params_from_dyn(dyn, d.dt, abs(d.df),
+                                  cuts_method="matmul")
+    np.testing.assert_allclose(np.asarray(b.tau), np.asarray(a.tau),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(b.dnu), np.asarray(a.dnu),
+                               rtol=1e-5)
+
+
 def test_fit_from_dyn_matches_fit_from_acf():
     from scintools_tpu.fit.scint_fit import (fit_scint_params_batch,
                                              fit_scint_params_from_dyn)

@@ -85,6 +85,54 @@ def test_pipeline_step_single_device(epochs):
     assert np.asarray(res.sspec).shape[0] == B
 
 
+def test_pipeline_matmul_cuts_matches_fft_cuts(epochs):
+    """scint_cuts='matmul' (MXU Gram route) fits the same parameters as
+    the default FFT-cut route."""
+    batch, _ = pad_batch(epochs)
+    freqs = np.asarray(epochs[0].freqs)
+    times = np.asarray(epochs[0].times)
+    kw = dict(fit_arc=False, lm_steps=25)
+    # baseline pins the FFT route explicitly: the default is "auto", which
+    # resolves to "matmul" on TPU — the comparison must not collapse to
+    # matmul-vs-matmul there
+    a = make_pipeline(freqs, times, PipelineConfig(scint_cuts="fft", **kw))(
+        np.asarray(batch.dyn))
+    b = make_pipeline(freqs, times, PipelineConfig(
+        scint_cuts="matmul", **kw))(np.asarray(batch.dyn))
+    np.testing.assert_allclose(np.asarray(b.scint.tau),
+                               np.asarray(a.scint.tau), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(b.scint.dnu),
+                               np.asarray(a.scint.dnu), rtol=1e-4)
+
+
+def test_resolve_cuts_validation_and_size_gate():
+    from scintools_tpu.parallel.driver import _resolve_cuts
+
+    with pytest.raises(ValueError, match="scint_cuts"):
+        _resolve_cuts("mxu", None)
+    with pytest.raises(ValueError, match="scint_cuts"):
+        # typos surface at pipeline BUILD time, not first execution
+        make_pipeline(np.linspace(1300., 1500., 8), np.arange(16) * 8.0,
+                      PipelineConfig(scint_cuts="mxu"))
+    assert _resolve_cuts("fft", None) == "fft"
+    assert _resolve_cuts("matmul", None) == "matmul"  # explicit: honoured
+    # auto falls back to fft when the Gram working set would be huge
+    assert _resolve_cuts("auto", None, (256, 128, 2048)) == "fft"
+    # the gate judges the PER-DEVICE working set (batch axis sharded over
+    # the data mesh axis) and respects the actual dtype width
+    from scintools_tpu.parallel.driver import _gram_bytes
+
+    mesh = make_mesh((8, 1))
+    assert _gram_bytes((256, 128, 1024), mesh, 4) * 8 == \
+        _gram_bytes((256, 128, 1024), None, 4)
+    assert _gram_bytes((64, 128, 1024), None, 8) == \
+        2 * _gram_bytes((64, 128, 1024), None, 4)
+    with pytest.raises(ValueError, match="method"):
+        from scintools_tpu.ops.acf import acf_cuts_direct
+
+        acf_cuts_direct(np.zeros((2, 4, 4)), method="matmull")
+
+
 def test_pipeline_matches_unbatched_ops(epochs):
     """The fused driver must reproduce the standalone jax kernels."""
     batch, _ = pad_batch(epochs)
