@@ -74,24 +74,35 @@ def main():
               f"{B / dt:9.0f} dynspec/s   (compile {compile_s:.1f}s)")
 
     ns = args.numsteps
+    # Baseline rows PIN the pre-auto routes (scint_cuts="fft",
+    # arc_scrunch_rows=0): PipelineConfig's defaults now auto-select the
+    # fast routes on TPU, and an A/B where the baseline silently resolves
+    # to the candidate route compares the fast path against itself
     bench("lam+sspec only", PipelineConfig(
         fit_scint=False, fit_arc=False, return_sspec=True, arc_numsteps=ns))
     bench("sspec only (no lam)", PipelineConfig(
         lamsteps=False, fit_scint=False, fit_arc=False, return_sspec=True,
         arc_numsteps=ns))
-    bench("lam+sspec+arc", PipelineConfig(fit_scint=False, arc_numsteps=ns))
-    # A/B the arc delay-scrunch strategies (roadmap: pick a default from
-    # on-chip numbers, not CPU guesses): full [B, R, n] gather vs lax.scan
-    # row blocks with a bounded working set
+    bench("lam+sspec+arc rc=0", PipelineConfig(
+        fit_scint=False, arc_numsteps=ns, arc_scrunch_rows=0))
+    # A/B the arc delay-scrunch strategies: full [B, R, n] gather vs
+    # lax.scan row blocks with a bounded working set
     for rc in (64, 256):
         bench(f"lam+sspec+arc rc={rc}", PipelineConfig(
             fit_scint=False, arc_numsteps=ns, arc_scrunch_rows=rc))
-    bench("scint fit only", PipelineConfig(fit_arc=False, arc_numsteps=ns))
     # A/B the ACF-cut route: padded 1-D FFTs (VPU) vs Gram-matrix diagonal
     # sums (MXU) — same linear correlations, different hardware unit
+    bench("scint fit fft cuts", PipelineConfig(
+        fit_arc=False, arc_numsteps=ns, scint_cuts="fft"))
     bench("scint fit mxu cuts", PipelineConfig(
         fit_arc=False, arc_numsteps=ns, scint_cuts="matmul"))
-    bench("FULL (bench cfg)", PipelineConfig(arc_numsteps=ns, lm_steps=30))
+    # lm_steps=1 isolates the cut computation from the vmapped LM chain
+    # (the difference to the previous row is ~39 LM iterations)
+    bench("scint mxu lm_steps=1", PipelineConfig(
+        fit_arc=False, arc_numsteps=ns, scint_cuts="matmul", lm_steps=1))
+    bench("FULL fft+rc0", PipelineConfig(
+        arc_numsteps=ns, lm_steps=30, scint_cuts="fft",
+        arc_scrunch_rows=0))
     bench("FULL mxu+rc64", PipelineConfig(
         arc_numsteps=ns, lm_steps=30, scint_cuts="matmul",
         arc_scrunch_rows=64))

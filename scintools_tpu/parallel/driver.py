@@ -68,7 +68,11 @@ class PipelineConfig:
     arc_constraint: tuple = (0.0, np.inf)
     arc_asymm: bool = False       # per-arm eta_left/eta_right in ArcFit
     arc_brackets: tuple | None = None  # K (lo, hi) windows -> eta [B, K]
-    arc_scrunch_rows: int = 0     # >0: lax.scan row blocks (bounded HBM)
+    # Arc delay-scrunch strategy: 0 = full [B, R, n] gather, >0 = lax.scan
+    # row blocks of that size (bounded HBM), -1 = auto (64-row blocks on
+    # TPU — measured faster there both times it was profiled on chip —
+    # full gather elsewhere)
+    arc_scrunch_rows: int = -1
     # ACF-cut route for the scint fit: "fft" (padded 1-D FFTs, VPU),
     # "matmul" (Gram-matrix diagonal sums, MXU), or "auto" (matmul on
     # TPU — measured ~2x faster there — fft elsewhere).  Only applies to
@@ -149,6 +153,11 @@ def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
         raise ValueError(
             f"PipelineConfig.scint_cuts: unknown method "
             f"{config.scint_cuts!r} (expected 'auto', 'fft' or 'matmul')")
+    if config.arc_scrunch_rows < -1:
+        raise ValueError(
+            f"PipelineConfig.arc_scrunch_rows must be -1 (auto), 0 (full "
+            f"gather) or a positive block size, got "
+            f"{config.arc_scrunch_rows}")
     freqs = np.ascontiguousarray(np.asarray(freqs, dtype=np.float64))
     times = np.ascontiguousarray(np.asarray(times, dtype=np.float64))
     return _make_pipeline_cached(
@@ -174,6 +183,22 @@ def _gram_bytes(batch_shape, mesh, itemsize: int) -> int:
     return itemsize * b * (nf * nf + nt * nt)
 
 
+def _target_is_tpu(mesh) -> bool:
+    """Whether the execution target (the mesh's devices, or the default
+    device set) is a TPU.  Called at TRACE time only — never at
+    pipeline-build time, so building stays device-free."""
+    import jax
+
+    try:
+        devs = (list(mesh.devices.flat) if mesh is not None
+                else jax.devices())
+        d = devs[0]
+        kind = str(getattr(d, "device_kind", "")).lower()
+        return "tpu" in kind or d.platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
 def _resolve_cuts(method: str, mesh, batch_shape=None,
                   itemsize: int = 4) -> str:
     """Resolve scint_cuts="auto" per target hardware: the MXU Gram route
@@ -189,18 +214,12 @@ def _resolve_cuts(method: str, mesh, batch_shape=None,
             and _gram_bytes(batch_shape, mesh, itemsize)
             > _AUTO_MATMUL_GRAM_BYTE_CAP):
         return "fft"
-    import jax
+    return "matmul" if _target_is_tpu(mesh) else "fft"
 
-    try:
-        devs = (list(mesh.devices.flat) if mesh is not None
-                else jax.devices())
-        d = devs[0]
-        kind = str(getattr(d, "device_kind", "")).lower()
-        if "tpu" in kind or d.platform in ("tpu", "axon"):
-            return "matmul"
-    except Exception:
-        pass
-    return "fft"
+
+# auto block size for arc_scrunch_rows=-1 on TPU: both on-chip profiles
+# (docs/performance.md) had 64-row scan blocks beating the full gather
+_AUTO_ARC_SCRUNCH_TPU = 64
 
 
 @functools.lru_cache(maxsize=None)
@@ -230,16 +249,21 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
     fdop = np.asarray(fdop, dtype=np.float64)
     tdel = np.asarray(tdel, dtype=np.float64)
 
-    arc_fitter = None
-    if config.fit_arc:
-        arc_fitter = make_arc_fitter(
+    def build_arc_fitter():
+        # called at TRACE time (inside the first step call), so the
+        # scrunch auto-default may probe the execution target; building
+        # the pipeline itself stays device-free
+        rc = config.arc_scrunch_rows
+        if rc == -1:
+            rc = _AUTO_ARC_SCRUNCH_TPU if _target_is_tpu(mesh) else 0
+        return make_arc_fitter(
             fdop=fdop, yaxis=beta if config.lamsteps else tdel, tdel=tdel,
             freq=fc, lamsteps=config.lamsteps, numsteps=config.arc_numsteps,
             startbin=config.arc_startbin, cutmid=config.arc_cutmid,
             nsmooth=config.arc_nsmooth, delmax=config.arc_delmax,
             constraint=config.arc_constraint, ref_freq=config.ref_freq,
             asymm=config.arc_asymm, constraints=config.arc_brackets,
-            scrunch_rows=config.arc_scrunch_rows)
+            scrunch_rows=rc)
 
     def step(dyn_batch):
         dyn_batch = jnp.asarray(dyn_batch)
@@ -300,7 +324,7 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
                              window_frac=config.window_frac, db=True,
                              backend="jax")
             if config.fit_arc:
-                arc = arc_fitter(sec_b)
+                arc = build_arc_fitter()(sec_b)
         return PipelineResult(
             scint=scint, arc=arc, acf=out.get("acf"),
             sspec=sec_b if config.return_sspec else None,

@@ -105,7 +105,8 @@ def test_pipeline_matmul_cuts_matches_fft_cuts(epochs):
                                np.asarray(a.scint.dnu), rtol=1e-4)
 
 
-def test_resolve_cuts_validation_and_size_gate():
+def test_resolve_cuts_validation_and_size_gate(monkeypatch):
+    import scintools_tpu.parallel.driver as drv
     from scintools_tpu.parallel.driver import _resolve_cuts
 
     with pytest.raises(ValueError, match="scint_cuts"):
@@ -116,8 +117,14 @@ def test_resolve_cuts_validation_and_size_gate():
                       PipelineConfig(scint_cuts="mxu"))
     assert _resolve_cuts("fft", None) == "fft"
     assert _resolve_cuts("matmul", None) == "matmul"  # explicit: honoured
-    # auto falls back to fft when the Gram working set would be huge
+    # the gate itself (not the CPU fallthrough, which also returns fft):
+    # on a pretend-TPU target, auto picks matmul under the cap and falls
+    # back to fft when the Gram working set would be huge
+    monkeypatch.setattr(drv, "_target_is_tpu", lambda mesh: True)
+    assert _resolve_cuts("auto", None, (4, 64, 64)) == "matmul"
     assert _resolve_cuts("auto", None, (256, 128, 2048)) == "fft"
+    monkeypatch.undo()
+    assert _resolve_cuts("auto", None, (4, 64, 64)) == "fft"  # CPU target
     # the gate judges the PER-DEVICE working set (batch axis sharded over
     # the data mesh axis) and respects the actual dtype width
     from scintools_tpu.parallel.driver import _gram_bytes
