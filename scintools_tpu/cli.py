@@ -258,7 +258,8 @@ def cmd_wavefield(args) -> int:
                            numsteps=args.numsteps)
                 eta = float(ds.eta)
             wf = ds.retrieve_wavefield(eta=eta, chunk_nf=args.chunk,
-                                       chunk_nt=args.chunk)
+                                       chunk_nt=args.chunk,
+                                       conc_weight=args.conc_weight)
             dyn = np.asarray(ds.data.dyn, float)
             corr = float(np.corrcoef(dyn.ravel(),
                                      wf.model_dynspec.ravel())[0, 1])
@@ -388,6 +389,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "<file>.wavefield.npz)")
     q.add_argument("--plots", action="store_true",
                    help="also write wavefield + field-sspec PNGs")
+    q.add_argument("--conc-weight", type=float, default=0.0,
+                   help="blend-weight exponent on per-chunk eigenmode "
+                        "concentration (0 = uniform blend)")
     q.add_argument("--backend", default="numpy",
                    choices=["numpy", "jax", "auto"])
     q.set_defaults(fn=cmd_wavefield)

@@ -307,7 +307,7 @@ def _chunks_jax(geom, ntheta: int, niter: int, mask_fd: float,
 def retrieve_wavefield(data: DynspecData, eta: float, chunk_nf: int = 64,
                        chunk_nt: int = 64, ntheta: int | None = None,
                        niter: int = 60, mask_bins: float = 1.5,
-                       theta_frac: float = 0.95,
+                       theta_frac: float = 0.95, conc_weight: float = 0.0,
                        backend: str = "jax") -> Wavefield:
     """Retrieve the complex wavefield of ``data`` given arc curvature
     ``eta`` (us/mHz^2, as fit by ``fit_arc`` on the non-lamsteps
@@ -337,7 +337,7 @@ def retrieve_wavefield(data: DynspecData, eta: float, chunk_nf: int = 64,
         freq=float(data.freq), dt=float(data.dt), df=float(data.df),
         chunk_nf=chunk_nf, chunk_nt=chunk_nt, ntheta=ntheta,
         niter=niter, mask_bins=mask_bins, theta_frac=theta_frac,
-        backend=backend)[0]
+        conc_weight=conc_weight, backend=backend)[0]
 
 
 def retrieve_wavefield_batch(dyn_batch, freqs, times, etas,
@@ -347,7 +347,8 @@ def retrieve_wavefield_batch(dyn_batch, freqs, times, etas,
                              chunk_nf: int = 64, chunk_nt: int = 64,
                              ntheta: int | None = None, niter: int = 60,
                              mask_bins: float = 1.5,
-                             theta_frac: float = 0.95, mesh=None,
+                             theta_frac: float = 0.95,
+                             conc_weight: float = 0.0, mesh=None,
                              backend: str = "jax") -> list:
     """Retrieve wavefields for a BATCH of epochs sharing one grid.
 
@@ -492,13 +493,14 @@ def retrieve_wavefield_batch(dyn_batch, freqs, times, etas,
     return [
         _stitch(E_all[b * K:(b + 1) * K], conc[b * K:(b + 1) * K],
                 dyn_batch[b], slots, (chunk_nf, chunk_nt), w2d, freqs,
-                times, float(etas_b[b]), eta_bc[b], theta)
+                times, float(etas_b[b]), eta_bc[b], theta,
+                conc_weight=conc_weight)
         for b in range(B)
     ]
 
 
 def _stitch(E_chunks, conc, dyn, slots, chunk_shape, w2d, freqs, times,
-            eta, chunk_etas, theta) -> Wavefield:
+            eta, chunk_etas, theta, conc_weight: float = 0.0) -> Wavefield:
     """Overlap-add one epoch's chunk fields with per-chunk global-phase
     alignment (host-side; cheap).
 
@@ -508,11 +510,32 @@ def _stitch(E_chunks, conc, dyn, slots, chunk_shape, w2d, freqs, times,
     a chunk edge) identically zero; the pedestal gives them the nearest
     chunk's model value, and den-normalisation keeps the blend unbiased
     for any window.
+
+    ``conc_weight`` > 0 additionally weights each chunk's contribution by
+    ``(conc_k / max conc)**conc_weight`` — chunks whose theta-theta
+    matrix was poorly rank-1 (low top-eigenmode energy fraction) defer
+    to better-concentrated neighbours in the overlap regions; 0 keeps
+    the uniform blend.  Measured on the simulator's Kolmogorov screens
+    (docs/roadmap.md): ground-truth dynspec correlation is flat at
+    cw<=0.5 and degrades slightly beyond (0.774 -> 0.749 at cw=4 on the
+    strong-anisotropy case), so the default stays 0; the knob is kept
+    for data whose chunk quality is genuinely bimodal (e.g. RFI-hit
+    blocks).
     """
     chunk_nf, chunk_nt = chunk_shape
     nchan, nsub = dyn.shape
     wb2d = np.outer(np.hanning(chunk_nf) + 0.02,
                     np.hanning(chunk_nt) + 0.02)
+    quality = np.ones(len(slots))
+    if conc_weight > 0:
+        c = np.maximum(np.nan_to_num(np.asarray(conc, dtype=np.float64)),
+                       0.0)
+        cmax = c.max()
+        if cmax > 0:
+            # floor keeps every pixel covered even if one chunk's conc
+            # underflows: a zero-weight sole contributor would leave a
+            # hole that the flux re-anchor then inflates
+            quality = np.maximum((c / cmax) ** conc_weight, 1e-3)
     num = np.zeros((nchan, nsub), dtype=np.complex128)
     den = np.zeros((nchan, nsub), dtype=np.float64)
     align = np.full(len(slots), np.nan)
@@ -525,8 +548,8 @@ def _stitch(E_chunks, conc, dyn, slots, chunk_shape, w2d, freqs, times,
         if norm > 0 and np.abs(z) > 1e-12 * norm:
             align[k] = float(np.abs(z) / norm)
             E_c = E_c * (z / np.abs(z))
-        num[sl] += E_c * wb2d
-        den[sl] += wb2d
+        num[sl] += quality[k] * E_c * wb2d
+        den[sl] += quality[k] * wb2d
     field = num / np.maximum(den, 1e-12)
     # re-anchor the total flux: overlap-add attenuates where neighbouring
     # chunks blend imperfectly coherently

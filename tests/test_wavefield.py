@@ -81,6 +81,26 @@ def test_chunk_starts_cover_and_overlap():
     assert _chunk_starts(50, 64) == [0]  # chunk clamped by caller
 
 
+def test_wavefield_conc_weight_blend():
+    """conc_weight-ed blend stays a valid field close to the uniform
+    blend (the knob is measured neutral on simulated screens; it must
+    not break coverage or the flux anchor)."""
+    d, E, eta = _synth_arc_field()
+    wf0 = retrieve_wavefield(d, eta, chunk_nf=64, chunk_nt=64,
+                             backend="numpy")
+    wf1 = retrieve_wavefield(d, eta, chunk_nf=64, chunk_nt=64,
+                             conc_weight=2.0, backend="numpy")
+    assert np.all(np.isfinite(wf1.field))
+    # same flux anchor
+    assert np.sum(np.abs(wf1.field) ** 2) == pytest.approx(
+        np.sum(np.abs(wf0.field) ** 2), rel=1e-6)
+    # and a similar model (weighting only reshuffles overlap blending)
+    a, b = np.abs(wf0.field), np.abs(wf1.field)
+    num = np.sum((a - a.mean()) * (b - b.mean()))
+    den = np.sqrt(np.sum((a - a.mean()) ** 2) * np.sum((b - b.mean()) ** 2))
+    assert num / den > 0.98
+
+
 def test_wavefield_ground_truth_fidelity():
     """|E_rec|^2 reproduces the intensity of a known thin-arc field."""
     d, E, eta = _synth_arc_field()
