@@ -116,6 +116,31 @@ def _concentration_numpy(M: np.ndarray) -> float:
 
 
 @functools.lru_cache(maxsize=32)
+def _make_concentration_jax(power_iters: int):
+    """The ONE jax implementation of the top-eigenmode energy fraction
+    (fixed-step power iteration on the symmetrised map), shared by the
+    single-epoch sweep and the batched pipeline fitter.  The init vector
+    derives from M (zeros_like + 1) so the same closure is safe under
+    shard_map varying-axis typing (see fit/wavefield.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    def concentration(M):
+        S = 0.5 * (M + M.T)
+        v = (jnp.zeros_like(S[0]) + 1.0) / np.sqrt(S.shape[0])
+
+        def body(v, _):
+            v = S @ v
+            return v / jnp.maximum(jnp.linalg.norm(v), 1e-30), None
+
+        v, _ = jax.lax.scan(body, v, None, length=power_iters)
+        lam = v @ S @ v
+        tot = jnp.maximum(jnp.sum(S * S), 1e-30)  # ||S||_F^2 = sum lam^2
+        return lam ** 2 / tot
+
+    return concentration
+
+
 def _tt_search_jax(f0_fd: float, d_fd: float, nfd: int, t0_t: float,
                    d_t: float, nt: int, ntheta: int, theta_max: float,
                    power_iters: int):
@@ -128,19 +153,7 @@ def _tt_search_jax(f0_fd: float, d_fd: float, nfd: int, t0_t: float,
     th = np.linspace(-theta_max, theta_max, ntheta)
     t1 = np.ascontiguousarray(th[:, None])
     t2 = np.ascontiguousarray(th[None, :])
-
-    def concentration(M):
-        S = 0.5 * (M + M.T)
-        v = jnp.ones(S.shape[0]) / np.sqrt(S.shape[0])
-
-        def body(v, _):
-            v = S @ v
-            return v / jnp.maximum(jnp.linalg.norm(v), 1e-30), None
-
-        v, _ = jax.lax.scan(body, v, None, length=power_iters)
-        lam = v @ S @ v
-        tot = jnp.maximum(jnp.sum(S * S), 1e-30)  # ||S||_F^2 = sum lam^2
-        return lam ** 2 / tot
+    concentration = _make_concentration_jax(power_iters)
 
     @jax.jit
     def search(power, etas):
@@ -167,6 +180,125 @@ def _half_width_bounds(etas: np.ndarray, conc: np.ndarray,
     while hi < len(conc) - 1 and conc[hi + 1] >= half:
         hi += 1
     return float(etas[lo]), float(etas[hi])
+
+
+@functools.lru_cache(maxsize=None)
+def _make_tt_fitter_cached(f0_fd: float, d_fd: float, nfd: int,
+                           t0_t: float, d_t: float, nt: int,
+                           etamin: float, etamax: float, n_eta: int,
+                           ntheta: int, theta_max: float,
+                           power_iters: int, startbin: int, cutmid: int,
+                           lamsteps: bool):
+    import jax
+    import jax.numpy as jnp
+
+    from ..data import ArcFit
+
+    etas = np.geomspace(etamin, etamax, n_eta)
+    log_etas = np.log(etas)
+    h = float(log_etas[1] - log_etas[0])       # uniform in log-eta
+    th = np.linspace(-theta_max, theta_max, ntheta)
+    t1 = np.ascontiguousarray(th[:, None])
+    t2 = np.ascontiguousarray(th[None, :])
+    row_mask = np.zeros(nt, dtype=bool)
+    row_mask[:startbin] = True
+    col_mask = np.zeros(nfd, dtype=bool)
+    if cutmid:
+        col_mask[nfd // 2 - cutmid // 2: nfd // 2 + (cutmid + 1) // 2] = True
+    concentration = _make_concentration_jax(power_iters)
+
+    def one_epoch(s_db):
+        # dB -> linear amplitude, masked exactly as _power_linear
+        p = 10.0 ** (s_db / 20.0)
+        p = jnp.where(jnp.isfinite(p), p, 0.0)
+        p = jnp.where(row_mask[:, None] | col_mask[None, :], 0.0, p)
+
+        conc = jax.lax.map(
+            lambda e: concentration(_tt_remap(p, e, t1, t2, f0_fd, d_fd,
+                                              nfd, t0_t, d_t, nt, xp=jnp)),
+            jnp.asarray(etas))
+
+        i = jnp.argmax(conc)
+        # sub-grid vertex of the 3-point parabola in log-eta (the grid is
+        # geomspace, so log-spacing is exactly uniform and the closed-form
+        # vertex equals the numpy path's np.polyfit through the 3 points)
+        ic = jnp.clip(i, 1, n_eta - 2)
+        y0 = conc[ic - 1]
+        y1 = conc[ic]
+        y2 = conc[ic + 1]
+        denom = y0 - 2.0 * y1 + y2
+        delta = jnp.where(denom < 0,
+                          0.5 * h * (y0 - y2) / denom, 0.0)
+        log_eta_pk = jnp.asarray(log_etas)[ic] + delta
+        eta = jnp.where((i == ic) & (denom < 0),
+                        jnp.exp(log_eta_pk),
+                        jnp.asarray(etas)[i])
+
+        # fixed-shape half-width walk (numpy path: _half_width_bounds):
+        # nearest below-half index on each side of the peak bounds it
+        half = conc[i] - 0.5 * (conc[i] - jnp.median(conc))
+        below = conc < half
+        idx = jnp.arange(n_eta)
+        jl = jnp.max(jnp.where(below & (idx < i), idx, -1))
+        lo = jl + 1                                  # -1 (none) -> 0
+        jr = jnp.min(jnp.where(below & (idx > i), idx, n_eta))
+        hi = jr - 1                                  # n (none) -> n-1
+        walk_err = (jnp.asarray(etas)[hi] - jnp.asarray(etas)[lo]) / 4.0
+        # grid-edge peak: no walk, quote the local grid spacing instead
+        # (numpy path, fit_arc_thetatheta:222-225)
+        edge = (i == 0) | (i == n_eta - 1)
+        near = (jnp.asarray(etas)[jnp.minimum(i + 1, n_eta - 1)]
+                - jnp.asarray(etas)[jnp.maximum(i - 1, 0)]) / 2.0
+        etaerr = jnp.where(edge, near, walk_err)
+        return eta, etaerr, conc
+
+    @jax.jit
+    def fitter(sspec_batch):
+        eta, etaerr, conc = jax.vmap(one_epoch)(jnp.asarray(sspec_batch))
+        return ArcFit(eta=eta, etaerr=etaerr, etaerr2=etaerr,
+                      lamsteps=lamsteps,
+                      profile_eta=jnp.asarray(etas),
+                      profile_power=conc)
+
+    return fitter
+
+
+def make_tt_fitter(fdop, yaxis, etamin: float, etamax: float,
+                   n_eta: int = 128, ntheta: int = 129,
+                   theta_max: float | None = None, power_iters: int = 30,
+                   startbin: int = 3, cutmid: int = 3,
+                   lamsteps: bool = True):
+    """Build a jit'd BATCHED theta-theta curvature fitter for a fixed
+    (fdop, yaxis) secondary-spectrum grid.
+
+    Returns ``fitter(sspec_batch [B, nr, nc] dB) -> ArcFit`` with [B]
+    ``eta``/``etaerr`` leaves, ``profile_eta`` the shared trial-curvature
+    grid and ``profile_power`` the [B, n_eta] concentration curves.  The
+    whole measurement — dB decoding, theta-theta remaps, power-iteration
+    concentration sweep, sub-grid peak and half-width error — is ONE
+    fixed-shape jit, so it vmaps over survey batches and shards over a
+    mesh like the norm_sspec fitter (driver: PipelineConfig.arc_method=
+    "thetatheta").  Curvature units follow the grid: beta-eta (m^-1 /
+    mHz^2) for lamsteps spectra, us/mHz^2 otherwise — identical to
+    ``fit_arc_thetatheta`` on the same SecSpec.
+
+    Building is device-free (static grids only); first call compiles.
+    """
+    fdop = np.asarray(fdop, dtype=np.float64)
+    yaxis = np.asarray(yaxis, dtype=np.float64)
+    if not (np.isfinite(etamin) and np.isfinite(etamax)
+            and 0 < etamin < etamax):
+        raise ValueError(
+            f"theta-theta needs a finite positive curvature bracket, got "
+            f"({etamin}, {etamax})")
+    if theta_max is None:
+        theta_max = float(np.max(fdop)) / 2
+    return _make_tt_fitter_cached(
+        float(fdop[0]), float(fdop[1] - fdop[0]), len(fdop),
+        float(yaxis[0]), float(yaxis[1] - yaxis[0]), len(yaxis),
+        float(etamin), float(etamax), int(n_eta), int(ntheta),
+        float(theta_max), int(power_iters), int(startbin), int(cutmid),
+        bool(lamsteps))
 
 
 def fit_arc_thetatheta(sec: SecSpec, etamin: float, etamax: float,

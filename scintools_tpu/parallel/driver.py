@@ -60,7 +60,14 @@ class PipelineConfig:
     fit_scint_2d: bool = False    # 2-D ACF fit incl. phase-gradient tilt
     alpha: float | None = 5 / 3       # None -> fit alpha too
     lm_steps: int = 40
+    # Curvature estimator: "norm_sspec" / "gridmax" (the reference's two
+    # power-profile methods, fit/arc_fit.py) or "thetatheta" (eigenvalue
+    # concentration, fit/thetatheta.py — needs a finite arc_constraint
+    # bracket; arc_numsteps becomes the eta-sweep size, where an
+    # untouched 2000 default is auto-replaced by 128)
+    arc_method: str = "norm_sspec"
     arc_numsteps: int = 2000
+    arc_ntheta: int = 129         # thetatheta only: theta-grid points
     arc_startbin: int = 3
     arc_cutmid: int = 3
     arc_nsmooth: int = 5
@@ -158,6 +165,40 @@ def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
             f"PipelineConfig.arc_scrunch_rows must be -1 (auto), 0 (full "
             f"gather) or a positive block size, got "
             f"{config.arc_scrunch_rows}")
+    if config.arc_method not in ("norm_sspec", "gridmax", "thetatheta"):
+        raise ValueError(
+            f"PipelineConfig.arc_method: unknown method "
+            f"{config.arc_method!r} (expected 'norm_sspec', 'gridmax' or "
+            f"'thetatheta')")
+    if config.arc_method == "thetatheta" and config.fit_arc:
+        lo, hi = config.arc_constraint
+        if not (np.isfinite(lo) and np.isfinite(hi) and 0 < lo < hi):
+            raise ValueError(
+                "arc_method='thetatheta' sweeps arc_constraint as its "
+                f"trial-curvature bracket, which must be finite and "
+                f"positive, got {config.arc_constraint} (units follow "
+                "the spectrum: beta-eta for lamsteps, us/mHz^2 "
+                "otherwise, as fit_arc_thetatheta)")
+        if config.arc_brackets is not None or config.arc_asymm:
+            raise ValueError(
+                "arc_method='thetatheta' does not support arc_brackets/"
+                "arc_asymm (multi-arc: run separate configs with "
+                "different arc_constraint brackets; the concentration "
+                "sweep has no per-arm split)")
+        # knobs of the power-profile fitters that the concentration sweep
+        # has no analogue for: reject loudly rather than silently ignore
+        _def = PipelineConfig()
+        ignored = [name for name, val, dflt in (
+            ("arc_delmax", config.arc_delmax, _def.arc_delmax),
+            ("arc_nsmooth", config.arc_nsmooth, _def.arc_nsmooth),
+            ("arc_scrunch_rows", config.arc_scrunch_rows,
+             _def.arc_scrunch_rows),
+        ) if val != dflt]
+        if ignored:
+            raise ValueError(
+                f"arc_method='thetatheta' has no equivalent of "
+                f"{', '.join(ignored)} (norm_sspec/gridmax knobs); leave "
+                "them at their defaults")
     freqs = np.ascontiguousarray(np.asarray(freqs, dtype=np.float64))
     times = np.ascontiguousarray(np.asarray(times, dtype=np.float64))
     return _make_pipeline_cached(
@@ -253,12 +294,30 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
         # called at TRACE time (inside the first step call), so the
         # scrunch auto-default may probe the execution target; building
         # the pipeline itself stays device-free
+        if config.arc_method == "thetatheta":
+            from ..fit.thetatheta import make_tt_fitter
+
+            # arc_numsteps' 2000-point default sizes the norm_sspec eta
+            # grid; a 2000-iteration remap+power-iteration sweep is ~15x
+            # the documented-sufficient theta-theta sweep, so an
+            # untouched default becomes 128 here (explicit values win)
+            n_eta = config.arc_numsteps
+            if n_eta == PipelineConfig().arc_numsteps:
+                n_eta = 128
+            return make_tt_fitter(
+                fdop=fdop, yaxis=beta if config.lamsteps else tdel,
+                etamin=float(config.arc_constraint[0]),
+                etamax=float(config.arc_constraint[1]),
+                n_eta=n_eta, ntheta=config.arc_ntheta,
+                startbin=config.arc_startbin, cutmid=config.arc_cutmid,
+                lamsteps=config.lamsteps)
         rc = config.arc_scrunch_rows
         if rc == -1:
             rc = _AUTO_ARC_SCRUNCH_TPU if _target_is_tpu(mesh) else 0
         return make_arc_fitter(
             fdop=fdop, yaxis=beta if config.lamsteps else tdel, tdel=tdel,
-            freq=fc, lamsteps=config.lamsteps, numsteps=config.arc_numsteps,
+            freq=fc, lamsteps=config.lamsteps, method=config.arc_method,
+            numsteps=config.arc_numsteps,
             startbin=config.arc_startbin, cutmid=config.arc_cutmid,
             nsmooth=config.arc_nsmooth, delmax=config.arc_delmax,
             constraint=config.arc_constraint, ref_freq=config.ref_freq,

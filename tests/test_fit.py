@@ -499,6 +499,37 @@ def test_thetatheta_via_fit_arc_dispatch():
         fit_arc(sec, freq=1400.0, method="thetatheta")
 
 
+def test_make_tt_fitter_batched_matches_single():
+    """The batched fixed-shape theta-theta fitter reproduces
+    fit_arc_thetatheta's eta/etaerr/concentration on every lane."""
+    from scintools_tpu.fit import fit_arc_thetatheta
+    from scintools_tpu.fit.thetatheta import make_tt_fitter
+
+    sec = _arc_secspec(eta=0.6)
+    eta_j, err_j, etas, conc_j = fit_arc_thetatheta(
+        sec, 0.1, 5.0, n_eta=64, backend="jax")
+    fitter = make_tt_fitter(sec.fdop, sec.beta, 0.1, 5.0, n_eta=64,
+                            lamsteps=True)
+    batch = np.stack([np.asarray(sec.sspec)] * 3)
+    fit = fitter(batch)
+    assert np.asarray(fit.eta).shape == (3,)
+    np.testing.assert_allclose(np.asarray(fit.profile_eta), etas,
+                               rtol=1e-12)
+    for b in range(3):
+        np.testing.assert_allclose(np.asarray(fit.profile_power[b]),
+                                   conc_j, rtol=1e-5, atol=1e-7)
+        assert float(fit.eta[b]) == pytest.approx(eta_j, rel=1e-5)
+        assert float(fit.etaerr[b]) == pytest.approx(err_j, rel=1e-5)
+
+
+def test_make_tt_fitter_validation():
+    from scintools_tpu.fit.thetatheta import make_tt_fitter
+
+    with pytest.raises(ValueError, match="bracket"):
+        make_tt_fitter(np.linspace(-10, 10, 32), np.linspace(0, 40, 16),
+                       0.0, np.inf)
+
+
 def test_thetatheta_on_simulated_spectrum():
     """On a realistic simulated epoch the theta-theta eta lands in the
     same range as the norm_sspec measurement."""

@@ -7,6 +7,7 @@ import pytest
 
 import jax
 
+from scintools_tpu.data import SecSpec
 from scintools_tpu.io import from_simulation
 from scintools_tpu.ops import acf, sspec
 from scintools_tpu.parallel import (
@@ -138,6 +139,57 @@ def test_resolve_cuts_validation_and_size_gate(monkeypatch):
         from scintools_tpu.ops.acf import acf_cuts_direct
 
         acf_cuts_direct(np.zeros((2, 4, 4)), method="matmull")
+
+
+def test_pipeline_thetatheta_arc_method(epochs):
+    """arc_method='thetatheta' runs the eigen-concentration curvature
+    inside the one-jit step and matches the standalone fitter on the
+    same secondary spectra."""
+    from scintools_tpu.fit import fit_arc_thetatheta
+
+    batch, _ = pad_batch(epochs)
+    freqs = np.asarray(epochs[0].freqs)
+    times = np.asarray(epochs[0].times)
+    cfg = PipelineConfig(arc_method="thetatheta", arc_constraint=(1.0, 50.0),
+                         arc_numsteps=48, fit_scint=False,
+                         return_sspec=True)
+    res = make_pipeline(freqs, times, cfg)(np.asarray(batch.dyn))
+    eta = np.asarray(res.arc.eta)
+    assert eta.shape == (len(epochs),)
+    assert np.all(np.isfinite(eta)) and np.all(eta > 0)
+    assert np.asarray(res.arc.profile_power).shape == (len(epochs), 48)
+    # lane 0 equals the standalone theta-theta fit on the step's sspec
+    sec = SecSpec(sspec=np.asarray(res.sspec)[0],
+                  fdop=np.asarray(res.fdop), tdel=np.asarray(res.tdel),
+                  beta=np.asarray(res.beta), lamsteps=True)
+    eta_s, err_s, _, _ = fit_arc_thetatheta(sec, 1.0, 50.0, n_eta=48,
+                                            backend="jax")
+    assert float(eta[0]) == pytest.approx(eta_s, rel=1e-5)
+    assert float(np.asarray(res.arc.etaerr)[0]) == pytest.approx(err_s,
+                                                                 rel=1e-5)
+
+
+def test_pipeline_thetatheta_validation():
+    freqs = np.linspace(1300.0, 1500.0, 8)
+    times = np.arange(16) * 8.0
+    with pytest.raises(ValueError, match="bracket"):
+        make_pipeline(freqs, times, PipelineConfig(
+            arc_method="thetatheta"))   # default (0, inf) constraint
+    with pytest.raises(ValueError, match="arc_brackets"):
+        make_pipeline(freqs, times, PipelineConfig(
+            arc_method="thetatheta", arc_constraint=(0.1, 5.0),
+            arc_brackets=((0.1, 1.0), (1.0, 5.0))))
+    with pytest.raises(ValueError, match="arc_method"):
+        make_pipeline(freqs, times, PipelineConfig(arc_method="ttheta"))
+    # power-profile-only knobs are rejected, not silently ignored
+    with pytest.raises(ValueError, match="arc_delmax"):
+        make_pipeline(freqs, times, PipelineConfig(
+            arc_method="thetatheta", arc_constraint=(0.1, 5.0),
+            arc_delmax=0.5))
+    with pytest.raises(ValueError, match="arc_scrunch_rows"):
+        make_pipeline(freqs, times, PipelineConfig(
+            arc_method="thetatheta", arc_constraint=(0.1, 5.0),
+            arc_scrunch_rows=64))
 
 
 def test_pipeline_matches_unbatched_ops(epochs):
