@@ -62,6 +62,23 @@ def cmd_process(args) -> int:
         args.backend = "jax"
     cfg = ("process", args.lamsteps, args.backend, not args.no_arc,
            not args.no_scint)
+    # non-default estimator settings enter the resume key (different
+    # estimators are different results); defaults keep the legacy key so
+    # existing stores still resume
+    arc_method = getattr(args, "arc_method", "norm_sspec")
+    arc_bracket = getattr(args, "arc_bracket", None)
+    # fail fast on estimator misconfiguration, before any file I/O
+    if arc_bracket is not None and not (0 < arc_bracket[0]
+                                        < arc_bracket[1]):
+        raise SystemExit(f"--arc-bracket must be 0 < LO < HI, got "
+                         f"{arc_bracket[0]} {arc_bracket[1]}")
+    if (arc_method == "thetatheta" and not args.no_arc
+            and arc_bracket is None):
+        raise SystemExit("--arc-method thetatheta requires "
+                         "--arc-bracket LO HI (the curvature sweep "
+                         "range)")
+    if arc_method != "norm_sspec" or arc_bracket is not None:
+        cfg += (arc_method, tuple(arc_bracket or ()))
     if args.plots:
         import os
 
@@ -88,7 +105,18 @@ def cmd_process(args) -> int:
                     scint = ds.get_scint_params()
             if not args.no_arc:
                 with timers.stage("arc_fit"):
-                    arc = ds.fit_arc(lamsteps=args.lamsteps)
+                    fkw = {"method": arc_method}
+                    if arc_bracket is not None:
+                        if arc_method == "thetatheta":
+                            fkw["etamin"], fkw["etamax"] = arc_bracket
+                        else:
+                            fkw["constraint"] = tuple(arc_bracket)
+                    if arc_method == "thetatheta":
+                        # Dynspec.fit_arc's numsteps default (10000) sizes
+                        # the power-profile grid; the concentration sweep
+                        # needs ~128 (same cap the batched driver applies)
+                        fkw["numsteps"] = 128
+                    arc = ds.fit_arc(lamsteps=args.lamsteps, **fkw)
             row = results_row(ds.data, scint=scint, arc=arc)
             if args.plots:
                 with timers.stage("plots"):
@@ -145,10 +173,15 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
                 log_event(log, "epoch_failed", file=fn, error=repr(e))
     processed = 0
     if epochs:
-        pcfg = PipelineConfig(lamsteps=args.lamsteps,
-                              fit_arc=not args.no_arc,
-                              fit_scint=not args.no_scint,
-                              arc_asymm=getattr(args, "arc_asymm", False))
+        pkw = dict(lamsteps=args.lamsteps,
+                   fit_arc=not args.no_arc,
+                   fit_scint=not args.no_scint,
+                   arc_asymm=getattr(args, "arc_asymm", False),
+                   arc_method=getattr(args, "arc_method", "norm_sspec"))
+        bracket = getattr(args, "arc_bracket", None)
+        if bracket is not None:
+            pkw["arc_constraint"] = (float(bracket[0]), float(bracket[1]))
+        pcfg = PipelineConfig(**pkw)
         try:
             with timers.stage("batched_pipeline"):
                 buckets = run_pipeline(epochs, pcfg, mesh=make_mesh())
@@ -337,6 +370,15 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--arc-asymm", action="store_true",
                    help="also measure per-arm curvatures "
                         "(eta_left/eta_right; batched mode)")
+    q.add_argument("--arc-method", default="norm_sspec",
+                   choices=["norm_sspec", "gridmax", "thetatheta"],
+                   help="curvature estimator, per-file and batched "
+                        "(thetatheta requires --arc-bracket)")
+    q.add_argument("--arc-bracket", type=float, nargs=2, default=None,
+                   metavar=("LO", "HI"),
+                   help="curvature bracket: the peak-search constraint "
+                        "(norm_sspec/gridmax) or the sweep range "
+                        "(thetatheta)")
     q.add_argument("--batched", action="store_true",
                    help="one jit-compiled step per shape bucket over the "
                         "device mesh instead of a per-file loop")

@@ -169,6 +169,20 @@ def test_pipeline_thetatheta_arc_method(epochs):
                                                                  rel=1e-5)
 
 
+def test_pipeline_gridmax_arc_method(epochs):
+    """arc_method='gridmax' (the reference's other power-profile method)
+    dispatches through the batched driver."""
+    batch, _ = pad_batch(epochs)
+    cfg = PipelineConfig(arc_method="gridmax", arc_numsteps=200,
+                         fit_scint=False)
+    res = make_pipeline(np.asarray(epochs[0].freqs),
+                        np.asarray(epochs[0].times), cfg)(
+        np.asarray(batch.dyn))
+    eta = np.asarray(res.arc.eta)
+    assert eta.shape == (len(epochs),)
+    assert np.all(np.isfinite(eta)) and np.all(eta > 0)
+
+
 def test_pipeline_thetatheta_validation():
     freqs = np.linspace(1300.0, 1500.0, 8)
     times = np.arange(16) * 8.0

@@ -223,6 +223,41 @@ def test_cli_process_batched(tmp_path, capsys):
     assert len(open(res).read().strip().splitlines()) == 4
 
 
+def test_cli_process_batched_thetatheta(tmp_path, capsys):
+    """--arc-method thetatheta with --arc-bracket runs the batched
+    eigen-concentration estimator; resuming with a different estimator
+    re-runs the epochs (distinct resume key)."""
+    from scintools_tpu.sim import Simulation
+
+    files = []
+    for i in range(2):
+        d = from_simulation(Simulation(mb2=2, ns=64, nf=64, dlam=0.25,
+                                       seed=70 + i), freq=1400.0, dt=8.0)
+        fn = str(tmp_path / f"t{i}.dynspec")
+        write_psrflux(d, fn)
+        files.append(fn)
+    res = str(tmp_path / "r.csv")
+    store = str(tmp_path / "st")
+    # misconfiguration fails fast, before any file I/O
+    with pytest.raises(SystemExit, match="arc-bracket"):
+        cli_main(["process", *files, "--batched",
+                  "--arc-method", "thetatheta"])
+    with pytest.raises(SystemExit, match="arc-bracket"):
+        cli_main(["process", *files, "--arc-bracket", "5.0", "1.0"])
+    rc = cli_main(["process", *files, "--lamsteps", "--batched",
+                   "--arc-method", "thetatheta",
+                   "--arc-bracket", "1.0", "50.0",
+                   "--results", res, "--store", store])
+    assert rc == 0
+    rows = open(res).read().strip().splitlines()
+    assert len(rows) == 3 and "betaeta" in rows[0]
+    # default-method rerun must NOT be satisfied by the thetatheta store
+    rc2 = cli_main(["process", *files, "--lamsteps", "--batched",
+                    "--results", res, "--store", store])
+    assert rc2 == 0
+    assert len(open(res).read().strip().splitlines()) == 5
+
+
 def test_cli_process_batched_asymm(tmp_path, capsys):
     """--batched --arc-asymm persists per-arm curvatures in the store."""
     import json
