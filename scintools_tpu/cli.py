@@ -3,7 +3,7 @@
 The reference ships an argparse stub with zero arguments that does
 nothing (scintools/scintools.py:1-16).  This is the real CLI planned in
 SURVEY.md §5: ``info`` / ``process`` / ``sort`` / ``sim`` /
-``wavefield`` / ``bench``.
+``curvature`` / ``wavefield`` / ``bench``.
 
     python -m scintools_tpu process obs1.dynspec obs2.dynspec \
         --lamsteps --backend jax --results results.csv --store runs/survey
@@ -264,6 +264,120 @@ def cmd_sim(args) -> int:
     return 0
 
 
+def cmd_curvature(args) -> int:
+    """Fit physical screen parameters to a survey's curvature series.
+
+    The reference ships the ``arc_curvature`` residual model but leaves
+    the actual annual-variation fit to user notebooks; this completes
+    the workflow: results CSV (from ``process --lamsteps``) + par file
+    -> screen fraction / velocity / anisotropy with errors, as JSON.
+    """
+    import numpy as np
+
+    from .fit import fit_arc_curvature
+    from .io.parfile import pars_to_params, read_par
+    from .io.results import float_array_from_dict, read_results
+
+    res = read_results(args.results)
+    if "betaeta" not in res:
+        raise SystemExit(
+            "curvature fitting needs the 'betaeta' column (lamsteps "
+            "curvature, 1/(m mHz^2) — the model's units); run "
+            "process --lamsteps to produce it")
+    mjd = float_array_from_dict(res, "mjd")
+    eta = float_array_from_dict(res, "betaeta")
+    etaerr = (float_array_from_dict(res, "betaetaerr")
+              if "betaetaerr" in res else None)
+    keep = np.isfinite(mjd) & np.isfinite(eta) & (eta > 0)
+    if etaerr is not None:
+        keep &= np.isfinite(etaerr) & (etaerr > 0)
+    if int(keep.sum()) < len(args.fit) + 1:
+        raise SystemExit(f"only {int(keep.sum())} usable epochs in "
+                         f"{args.results} for {len(args.fit)} fitted "
+                         "parameters")
+    mjd, eta = mjd[keep], eta[keep]
+    if etaerr is not None:
+        etaerr = etaerr[keep]
+
+    pars = pars_to_params(read_par(args.par))
+    raj, decj = pars.get("RAJ"), pars.get("DECJ")
+    if raj is None or decj is None:
+        raise SystemExit(f"{args.par} needs RAJ/DECJ (source position "
+                         "for the Earth-velocity projection)")
+    # screen starting values: par-file distance if present, then --start
+    _SCREEN_KEYS = ("s", "d", "psi", "vism_psi", "vism_ra", "vism_dec")
+    pars.setdefault("d", float(pars.get("DIST", 1.0)))
+    pars.setdefault("s", 0.5)
+    for k in args.fit:
+        if k.startswith("vism_"):
+            pars.setdefault(k, 0.0)
+    if "psi" in args.fit:
+        pars.setdefault("psi", 45.0)   # start only; optimised away
+    for kv in args.start or []:
+        k, sep, v = kv.partition("=")
+        if not sep or k not in _SCREEN_KEYS:
+            raise SystemExit(
+                f"--start takes KEY=VALUE pairs with KEY in "
+                f"{'/'.join(_SCREEN_KEYS)}, got {kv!r}")
+        try:
+            pars[k] = float(v)
+        except ValueError:
+            raise SystemExit(f"--start {k}: {v!r} is not a number")
+    if "vism_psi" in args.fit and "psi" not in pars:
+        # 'psi' in the model params selects the ANISOTROPIC branch and
+        # fixes the projection axis; silently defaulting it would bias
+        # s/vism_psi with no warning
+        raise SystemExit(
+            "fitting vism_psi needs the anisotropy axis psi: pass "
+            "--start psi=<deg> (fixed) or add psi to --fit")
+
+    best, errors, fitres = fit_arc_curvature(
+        eta, mjd, pars, raj, decj, fit_keys=tuple(args.fit),
+        etaerr=etaerr, backend=args.backend)
+
+    def _num(x):
+        # strict machine-readable stdout: a singular covariance yields
+        # inf/NaN stderr, which json.dumps would emit as invalid JSON
+        x = float(x)
+        return x if np.isfinite(x) else None
+
+    print(json.dumps({
+        "n_epochs": int(len(mjd)),
+        "fit": {k: {"value": _num(best[k]), "err": _num(errors[k])}
+                for k in args.fit},
+        "cost": _num(np.asarray(fitres.cost)),
+    }, allow_nan=False))
+
+    if args.plot:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        from .astro import get_earth_velocity, get_true_anomaly
+        from .models.velocity import arc_curvature_model
+
+        grid = np.linspace(mjd.min(), mjd.max(), 500)
+        nu = (get_true_anomaly(grid, best) if "PB" in best
+              else np.zeros_like(grid))
+        v_ra, v_dec = get_earth_velocity(grid, raj, decj)
+        model = arc_curvature_model(best, nu, v_ra, v_dec)
+        fig, ax = plt.subplots(figsize=(8, 4))
+        if etaerr is not None:
+            ax.errorbar(mjd, eta, yerr=etaerr, fmt="o", ms=4,
+                        label="measured")
+        else:
+            ax.plot(mjd, eta, "o", ms=4, label="measured")
+        ax.plot(grid, model, "-", label="screen model")
+        ax.set_xlabel("MJD")
+        ax.set_ylabel(r"$\beta$-curvature (1/(m mHz$^2$))")
+        ax.legend()
+        fig.tight_layout()
+        fig.savefig(args.plot, dpi=120)
+        plt.close(fig)
+    return 0
+
+
 def cmd_wavefield(args) -> int:
     import numpy as np
 
@@ -412,6 +526,27 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--backend", default="numpy",
                    choices=["numpy", "jax"])
     q.set_defaults(fn=cmd_sim)
+
+    q = sub.add_parser(
+        "curvature",
+        help="fit screen parameters to a survey's curvature time series")
+    q.add_argument("results",
+                   help="results CSV from `process --lamsteps` (needs "
+                        "the betaeta column)")
+    q.add_argument("--par", required=True,
+                   help="tempo2 .par file with RAJ/DECJ (+ orbit keys "
+                        "for binaries)")
+    q.add_argument("--fit", nargs="+", default=["s", "vism_psi"],
+                   choices=["s", "d", "psi", "vism_psi", "vism_ra",
+                            "vism_dec"],
+                   help="screen keys to fit")
+    q.add_argument("--start", nargs="*", default=None, metavar="KEY=VAL",
+                   help="starting values / fixed screen parameters")
+    q.add_argument("--plot", default=None,
+                   help="write a data-vs-model PNG here")
+    q.add_argument("--backend", default="numpy",
+                   choices=["numpy", "jax"])
+    q.set_defaults(fn=cmd_curvature)
 
     q = sub.add_parser(
         "wavefield",

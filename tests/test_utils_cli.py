@@ -223,6 +223,69 @@ def test_cli_process_batched(tmp_path, capsys):
     assert len(open(res).read().strip().splitlines()) == 4
 
 
+def test_cli_curvature_recovers_screen(tmp_path, capsys):
+    """`curvature` fits screen parameters straight from a results CSV +
+    par file, closing the annual-variation workflow the reference leaves
+    to notebooks."""
+    from scintools_tpu.astro import get_earth_velocity, get_true_anomaly
+    from scintools_tpu.io.parfile import pars_to_params, read_par
+    from scintools_tpu.io.results import write_results
+    from scintools_tpu.models.velocity import arc_curvature_model
+
+    par = tmp_path / "psr.par"
+    par.write_text(
+        "PSRJ J0437-4715\nRAJ 04:37:15.8\nDECJ -47:15:09.1\n"
+        "T0 50000.0\nPB 5.741\nECC 0.0879\nA1 3.3667\nOM 1.0\n"
+        "KIN 42.4\nKOM 207.0\nPMRA 121.4\nPMDEC -71.5\nDIST 0.157\n")
+    pars = pars_to_params(read_par(str(par)))
+    raj, decj = pars["RAJ"], pars["DECJ"]
+    mjds = 53000.0 + np.linspace(0, 365.25, 60)
+    nu = get_true_anomaly(mjds, pars)
+    v_ra, v_dec = get_earth_velocity(mjds, raj, decj)
+    truth = dict(pars, d=0.157, psi=64.0, s=0.71, vism_psi=12.0)
+    eta = arc_curvature_model(truth, nu, v_ra, v_dec)
+    rng = np.random.default_rng(3)
+    eta_obs = eta * (1 + 0.03 * rng.standard_normal(len(mjds)))
+
+    csvf = str(tmp_path / "r.csv")
+    for m, e, err in zip(mjds, eta_obs, 0.03 * eta):
+        write_results(csvf, dict(name="x", mjd=m, freq=1400.0, bw=256.0,
+                                 tobs=3600.0, dt=8.0, df=1.0,
+                                 betaeta=e, betaetaerr=err))
+    png = str(tmp_path / "fit.png")
+    rc = cli_main(["curvature", csvf, "--par", str(par),
+                   "--fit", "s", "vism_psi",
+                   "--start", "s=0.4", "vism_psi=0.0", "psi=64.0",
+                   "--plot", png])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["n_epochs"] == 60
+    assert out["fit"]["s"]["value"] == pytest.approx(0.71, abs=0.03)
+    assert out["fit"]["vism_psi"]["value"] == pytest.approx(12.0, abs=4.0)
+    assert out["fit"]["s"]["err"] > 0
+    import os
+
+    assert os.path.exists(png)
+    # missing betaeta column fails with guidance, not a stack trace
+    bad = str(tmp_path / "noeta.csv")
+    write_results(bad, dict(name="x", mjd=53000.0, freq=1400.0, bw=256.0,
+                            tobs=3600.0, dt=8.0, df=1.0, eta=1.0,
+                            etaerr=0.1))
+    with pytest.raises(SystemExit, match="betaeta"):
+        cli_main(["curvature", bad, "--par", str(par)])
+    # anisotropic fits must not inherit a silent default axis
+    with pytest.raises(SystemExit, match="psi"):
+        cli_main(["curvature", csvf, "--par", str(par),
+                  "--fit", "s", "vism_psi"])
+    # --start typos fail fast instead of silently running unused keys
+    with pytest.raises(SystemExit, match="--start"):
+        cli_main(["curvature", csvf, "--par", str(par),
+                  "--start", "vismpsi=12"])
+    with pytest.raises(SystemExit, match="not a number"):
+        cli_main(["curvature", csvf, "--par", str(par),
+                  "--start", "s=0.4x"])
+
+
 def test_cli_process_batched_thetatheta(tmp_path, capsys):
     """--arc-method thetatheta with --arc-bracket runs the batched
     eigen-concentration estimator; resuming with a different estimator
