@@ -313,6 +313,7 @@ def cmd_curvature(args) -> int:
             pars.setdefault(k, 0.0)
     if "psi" in args.fit:
         pars.setdefault("psi", 45.0)   # start only; optimised away
+    user_start = set()
     for kv in args.start or []:
         k, sep, v = kv.partition("=")
         if not sep or k not in _SCREEN_KEYS:
@@ -323,13 +324,28 @@ def cmd_curvature(args) -> int:
             pars[k] = float(v)
         except ValueError:
             raise SystemExit(f"--start {k}: {v!r} is not a number")
-    if "vism_psi" in args.fit and "psi" not in pars:
-        # 'psi' in the model params selects the ANISOTROPIC branch and
-        # fixes the projection axis; silently defaulting it would bias
-        # s/vism_psi with no warning
+        user_start.add(k)
+    # The model has two mutually exclusive screen-velocity branches
+    # (models/velocity.py): psi present -> ANISOTROPIC, reads vism_psi
+    # only; psi absent -> isotropic, reads vism_ra/vism_dec only.
+    # Reject every combination where a user-supplied velocity would be
+    # silently ignored, instead of fitting a dead parameter.
+    wants = lambda k: k in args.fit or k in user_start  # noqa: E731
+    aniso = wants("vism_psi")
+    iso = wants("vism_ra") or wants("vism_dec")
+    if aniso and iso:
         raise SystemExit(
-            "fitting vism_psi needs the anisotropy axis psi: pass "
+            "vism_psi (anisotropic screen) and vism_ra/vism_dec "
+            "(isotropic screen) are mutually exclusive model branches; "
+            "use one or the other")
+    if aniso and "psi" not in pars:
+        raise SystemExit(
+            "using vism_psi needs the anisotropy axis psi: pass "
             "--start psi=<deg> (fixed) or add psi to --fit")
+    if iso and "psi" in pars:
+        raise SystemExit(
+            "psi selects the anisotropic branch, which ignores "
+            "vism_ra/vism_dec; drop psi or fit vism_psi instead")
 
     best, errors, fitres = fit_arc_curvature(
         eta, mjd, pars, raj, decj, fit_keys=tuple(args.fit),

@@ -370,7 +370,8 @@ def fit_scint_params_sspec(acf2d, dt, df, nchan: int, nsub: int,
 
 @functools.lru_cache(maxsize=None)
 def _fit_scint_2d_batch_jax(alpha, steps, crop_t, crop_f, nchan, nsub):
-    """Batched 2-D ACF fit (tau, dnu, amp, wn, tilt), vmapped over epochs.
+    """Batched 2-D ACF fit (tau, dnu, amp, wn, tilt — plus the power-law
+    index when ``alpha is None``), vmapped over epochs.
 
     Windows are cropped from the [B, 2nf, 2nt] ACF batch with static
     bounds; taper scales use the full scan extents (see
@@ -380,6 +381,8 @@ def _fit_scint_2d_batch_jax(alpha, steps, crop_t, crop_f, nchan, nsub):
     import jax.numpy as jnp
 
     from ..models.acf_models import scint_acf_model_2d
+
+    free = alpha is None
 
     def single(win, y_t_full, y_f_full, dt, df):
         x_t, x_f = acf_lags_2d(dt, df, crop_t, crop_f, xp=jnp)
@@ -393,16 +396,22 @@ def _fit_scint_2d_batch_jax(alpha, steps, crop_t, crop_f, nchan, nsub):
             df * jnp.linspace(0, nf_, nf_), y_f_full, xp=jnp)
 
         def resid(p, w):
+            a_ = p[5] if free else alpha
             m = scint_acf_model_2d(x_t, x_f, p[0], p[1], p[2], p[3],
-                                   alpha, p[4], tmax=tmax, fmax=fmax,
+                                   a_, p[4], tmax=tmax, fmax=fmax,
                                    xp=jnp)
             return (w - m).ravel()
 
-        p0 = jnp.stack([tau0, dnu0, amp0, wn0, jnp.zeros_like(tau0)])
-        lo = jnp.array([1e-10, 1e-10, 0.0, 0.0, -jnp.inf])
-        hi = jnp.array([jnp.inf] * 5)
-        return lm_fit_jax(resid, p0, bounds=(lo, hi), args=(win,),
-                          steps=steps)
+        p0 = [tau0, dnu0, amp0, wn0, jnp.zeros_like(tau0)]
+        lo = [1e-10, 1e-10, 0.0, 0.0, -jnp.inf]
+        hi = [jnp.inf] * 5
+        if free:
+            p0.append(jnp.full_like(tau0, _ALPHA_KOLMOGOROV))
+            lo.append(0.0)
+            hi.append(8.0)
+        return lm_fit_jax(resid, jnp.stack(p0),
+                          bounds=(jnp.array(lo), jnp.array(hi)),
+                          args=(win,), steps=steps)
 
     @jax.jit
     def impl(acf2d_batch, dt, df):
@@ -413,7 +422,9 @@ def _fit_scint_2d_batch_jax(alpha, steps, crop_t, crop_f, nchan, nsub):
         sp = ScintParams(
             tau=res.params[:, 0], tauerr=res.stderr[:, 0],
             dnu=res.params[:, 1], dnuerr=res.stderr[:, 1],
-            amp=res.params[:, 2], wn=res.params[:, 3], talpha=alpha,
+            amp=res.params[:, 2], wn=res.params[:, 3],
+            talpha=res.params[:, 5] if free else alpha,
+            talphaerr=res.stderr[:, 5] if free else None,
             redchi=res.redchi)
         return sp, res.params[:, 4], res.stderr[:, 4]
 
@@ -421,11 +432,13 @@ def _fit_scint_2d_batch_jax(alpha, steps, crop_t, crop_f, nchan, nsub):
 
 
 def fit_scint_params_2d_batch(acf2d_batch, dt, df, nchan: int, nsub: int,
-                              alpha: float = _ALPHA_KOLMOGOROV,
+                              alpha: float | None = _ALPHA_KOLMOGOROV,
                               crop_frac: float = 0.5, steps: int = 60):
     """Vmapped 2-D ACF fits for a [B, 2nf, 2nt] batch: population-level
     phase-gradient (tilt) statistics in one device program — a capability
     with no reference analogue (its 2-D method is an empty stub).
+    ``alpha=None`` frees the power-law index per epoch, as on the
+    single-epoch and 1-D paths.
 
     Returns (ScintParams with [B] leaves, tilt [B], tilterr [B]).
     """

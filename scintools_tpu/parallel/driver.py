@@ -350,11 +350,6 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
                 if config.fit_scint_2d:
                     from ..fit.scint_fit import fit_scint_params_2d_batch
 
-                    if config.alpha is None:
-                        raise NotImplementedError(
-                            "fit_scint_2d requires a fixed alpha "
-                            "(PipelineConfig.alpha=None fits alpha on the "
-                            "1-D path only)")
                     scint2d, tilt, tilterr = fit_scint_params_2d_batch(
                         acf_b, dt, abs(df), nchan, nsub,
                         alpha=config.alpha, steps=config.lm_steps)

@@ -172,6 +172,42 @@ def test_2d_batch_matches_single_epoch():
         float(np.asarray(sp_s.tau)), rel=0.02)
 
 
+def test_2d_batch_free_alpha_matches_single_epoch():
+    """alpha=None on the BATCHED 2-D path (previously fixed-alpha only)
+    matches the single-epoch free-alpha fit and reports talphaerr."""
+    from scintools_tpu.fit import fit_scint_params_2d_batch
+
+    acf2d = _synthetic_acf(tilt=12.0, seed=9)
+    sp_s, tilt_s, _ = fit_scint_params_2d(acf2d, 8.0, 0.25, 64, 96,
+                                          alpha=None, backend="jax",
+                                          steps=60)
+    sp_b, tilt_b, _ = fit_scint_params_2d_batch(acf2d[None], 8.0, 0.25,
+                                                64, 96, alpha=None,
+                                                steps=60)
+    assert float(np.asarray(sp_b.talpha)[0]) == pytest.approx(
+        float(np.asarray(sp_s.talpha)), rel=0.02)
+    assert float(np.asarray(sp_b.tau)[0]) == pytest.approx(
+        float(np.asarray(sp_s.tau)), rel=0.02)
+    assert float(tilt_b[0]) == pytest.approx(tilt_s, rel=0.05, abs=0.1)
+    assert np.asarray(sp_b.talphaerr).shape == (1,)
+
+
+def test_pipeline_2d_free_alpha():
+    """The driver no longer rejects fit_scint_2d + alpha=None."""
+    from scintools_tpu.io import from_simulation
+    from scintools_tpu.parallel import PipelineConfig, make_pipeline
+    from scintools_tpu.sim import Simulation
+
+    d = from_simulation(Simulation(mb2=2, ns=64, nf=64, dlam=0.25,
+                                   seed=3), freq=1400.0, dt=8.0)
+    dyn = np.asarray(d.dyn, dtype=np.float32)[None]
+    cfg = PipelineConfig(fit_arc=False, fit_scint=False,
+                         fit_scint_2d=True, alpha=None, lm_steps=20)
+    res = make_pipeline(np.asarray(d.freqs), np.asarray(d.times), cfg)(dyn)
+    assert np.isfinite(float(np.asarray(res.scint2d.talpha)[0]))
+    assert float(np.asarray(res.scint2d.talpha)[0]) > 0
+
+
 def test_fit_scint_params_2d_free_alpha():
     """alpha=None on the 2-D path fits the power-law index too, recovering
     the synthetic alpha within tolerance (as the 1-D free-alpha path)."""

@@ -277,6 +277,17 @@ def test_cli_curvature_recovers_screen(tmp_path, capsys):
     with pytest.raises(SystemExit, match="psi"):
         cli_main(["curvature", csvf, "--par", str(par),
                   "--fit", "s", "vism_psi"])
+    # ...nor may a supplied velocity land in the branch that ignores it
+    with pytest.raises(SystemExit, match="psi"):
+        cli_main(["curvature", csvf, "--par", str(par), "--fit", "s",
+                  "--start", "vism_psi=20"])
+    with pytest.raises(SystemExit, match="anisotropic"):
+        cli_main(["curvature", csvf, "--par", str(par),
+                  "--fit", "s", "vism_ra", "--start", "psi=60"])
+    with pytest.raises(SystemExit, match="mutually exclusive"):
+        cli_main(["curvature", csvf, "--par", str(par),
+                  "--fit", "s", "vism_psi", "vism_ra",
+                  "--start", "psi=60"])
     # --start typos fail fast instead of silently running unused keys
     with pytest.raises(SystemExit, match="--start"):
         cli_main(["curvature", csvf, "--par", str(par),
