@@ -62,9 +62,9 @@ class PipelineConfig:
     lm_steps: int = 40
     # Curvature estimator: "norm_sspec" / "gridmax" (the reference's two
     # power-profile methods, fit/arc_fit.py) or "thetatheta" (eigenvalue
-    # concentration, fit/thetatheta.py — needs a finite arc_constraint
-    # bracket; arc_numsteps becomes the eta-sweep size, where an
-    # untouched 2000 default is auto-replaced by 128)
+    # concentration, fit/thetatheta.py — needs finite arc_constraint or
+    # arc_brackets windows; arc_numsteps becomes the per-window sweep
+    # size, where an untouched 2000 default is auto-replaced by 128)
     arc_method: str = "norm_sspec"
     arc_numsteps: int = 2000
     arc_ntheta: int = 129         # thetatheta only: theta-grid points
@@ -171,20 +171,23 @@ def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
             f"{config.arc_method!r} (expected 'norm_sspec', 'gridmax' or "
             f"'thetatheta')")
     if config.arc_method == "thetatheta" and config.fit_arc:
-        lo, hi = config.arc_constraint
-        if not (np.isfinite(lo) and np.isfinite(hi) and 0 < lo < hi):
+        windows = (config.arc_brackets if config.arc_brackets is not None
+                   else (config.arc_constraint,))
+        if len(windows) == 0:
+            raise ValueError("arc_brackets must contain at least one "
+                             "(lo, hi) window")
+        for lo, hi in windows:
+            if not (np.isfinite(lo) and np.isfinite(hi) and 0 < lo < hi):
+                raise ValueError(
+                    "arc_method='thetatheta' sweeps its curvature "
+                    f"bracket(s), which must be finite and positive, got "
+                    f"{tuple(windows)} (units follow the spectrum: "
+                    "beta-eta for lamsteps, us/mHz^2 otherwise, as "
+                    "fit_arc_thetatheta)")
+        if config.arc_asymm:
             raise ValueError(
-                "arc_method='thetatheta' sweeps arc_constraint as its "
-                f"trial-curvature bracket, which must be finite and "
-                f"positive, got {config.arc_constraint} (units follow "
-                "the spectrum: beta-eta for lamsteps, us/mHz^2 "
-                "otherwise, as fit_arc_thetatheta)")
-        if config.arc_brackets is not None or config.arc_asymm:
-            raise ValueError(
-                "arc_method='thetatheta' does not support arc_brackets/"
-                "arc_asymm (multi-arc: run separate configs with "
-                "different arc_constraint brackets; the concentration "
-                "sweep has no per-arm split)")
+                "arc_method='thetatheta' does not support arc_asymm "
+                "(the concentration sweep has no per-arm split)")
         # knobs of the power-profile fitters that the concentration sweep
         # has no analogue for: reject loudly rather than silently ignore
         _def = PipelineConfig()
@@ -304,13 +307,37 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
             n_eta = config.arc_numsteps
             if n_eta == PipelineConfig().arc_numsteps:
                 n_eta = 128
-            return make_tt_fitter(
-                fdop=fdop, yaxis=beta if config.lamsteps else tdel,
-                etamin=float(config.arc_constraint[0]),
-                etamax=float(config.arc_constraint[1]),
-                n_eta=n_eta, ntheta=config.arc_ntheta,
-                startbin=config.arc_startbin, cutmid=config.arc_cutmid,
-                lamsteps=config.lamsteps)
+
+            def make_one(lo, hi):
+                return make_tt_fitter(
+                    fdop=fdop, yaxis=beta if config.lamsteps else tdel,
+                    etamin=float(lo), etamax=float(hi),
+                    n_eta=n_eta, ntheta=config.arc_ntheta,
+                    startbin=config.arc_startbin,
+                    cutmid=config.arc_cutmid, lamsteps=config.lamsteps)
+
+            if config.arc_brackets is None:
+                return make_one(*config.arc_constraint)
+            # multi-arc: one bounded sweep per bracket, stacked to the
+            # same [B, K] result shape as norm_sspec's multi-window fit;
+            # each bracket keeps its own eta grid ([K, n_eta] profiles)
+            fitters = [make_one(lo, hi) for lo, hi in config.arc_brackets]
+
+            def multi(sec_b):
+                from ..data import ArcFit
+
+                fits = [f(sec_b) for f in fitters]
+                return ArcFit(
+                    eta=jnp.stack([f.eta for f in fits], axis=1),
+                    etaerr=jnp.stack([f.etaerr for f in fits], axis=1),
+                    etaerr2=jnp.stack([f.etaerr2 for f in fits], axis=1),
+                    lamsteps=config.lamsteps,
+                    profile_eta=jnp.stack(
+                        [f.profile_eta for f in fits]),
+                    profile_power=jnp.stack(
+                        [f.profile_power for f in fits], axis=1))
+
+            return multi
         rc = config.arc_scrunch_rows
         if rc == -1:
             rc = _AUTO_ARC_SCRUNCH_TPU if _target_is_tpu(mesh) else 0

@@ -169,6 +169,28 @@ def test_pipeline_thetatheta_arc_method(epochs):
                                                                  rel=1e-5)
 
 
+def test_pipeline_thetatheta_multi_bracket(epochs):
+    """arc_brackets with thetatheta: one bounded sweep per bracket,
+    [B, K] results, each lane matching its single-bracket run."""
+    batch, _ = pad_batch(epochs)
+    freqs = np.asarray(epochs[0].freqs)
+    times = np.asarray(epochs[0].times)
+    brackets = ((1.0, 12.0), (12.0, 80.0))
+    kw = dict(arc_method="thetatheta", arc_numsteps=32, fit_scint=False)
+    res = make_pipeline(freqs, times, PipelineConfig(
+        arc_brackets=brackets, **kw))(np.asarray(batch.dyn))
+    eta = np.asarray(res.arc.eta)
+    assert eta.shape == (len(epochs), 2)
+    assert np.asarray(res.arc.profile_eta).shape == (2, 32)
+    assert np.asarray(res.arc.profile_power).shape == (len(epochs), 2, 32)
+    for k, (lo, hi) in enumerate(brackets):
+        assert np.all((eta[:, k] >= lo) & (eta[:, k] <= hi))
+        single = make_pipeline(freqs, times, PipelineConfig(
+            arc_constraint=(lo, hi), **kw))(np.asarray(batch.dyn))
+        np.testing.assert_allclose(eta[:, k],
+                                   np.asarray(single.arc.eta), rtol=1e-6)
+
+
 def test_pipeline_gridmax_arc_method(epochs):
     """arc_method='gridmax' (the reference's other power-profile method)
     dispatches through the batched driver."""
@@ -189,10 +211,17 @@ def test_pipeline_thetatheta_validation():
     with pytest.raises(ValueError, match="bracket"):
         make_pipeline(freqs, times, PipelineConfig(
             arc_method="thetatheta"))   # default (0, inf) constraint
-    with pytest.raises(ValueError, match="arc_brackets"):
+    with pytest.raises(ValueError, match="finite and positive"):
+        make_pipeline(freqs, times, PipelineConfig(
+            arc_method="thetatheta",
+            arc_brackets=((0.1, 1.0), (1.0, np.inf))))
+    with pytest.raises(ValueError, match="arc_asymm"):
         make_pipeline(freqs, times, PipelineConfig(
             arc_method="thetatheta", arc_constraint=(0.1, 5.0),
-            arc_brackets=((0.1, 1.0), (1.0, 5.0))))
+            arc_asymm=True))
+    with pytest.raises(ValueError, match="at least one"):
+        make_pipeline(freqs, times, PipelineConfig(
+            arc_method="thetatheta", arc_brackets=()))
     with pytest.raises(ValueError, match="arc_method"):
         make_pipeline(freqs, times, PipelineConfig(arc_method="ttheta"))
     # power-profile-only knobs are rejected, not silently ignored
