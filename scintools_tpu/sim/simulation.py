@@ -398,19 +398,121 @@ def _ensemble_jax(p: SimParams, screen_chunk: int):
     return impl
 
 
+# float physics fields that may be TRACED (swept) without retracing: all
+# enter the weights/filters as plain arithmetic.  alpha is excluded (it
+# feeds scipy gamma at trace-build time), ints/bools shape the program.
+_SWEEPABLE = ("mb2", "rf", "dx", "dy", "ar", "psi", "inner", "dlam")
+
+
+def _pad_cycle(arr, multiple: int):
+    """Pad the leading axis up to the next ``multiple`` by cycling the
+    existing rows (pad rows are computed and discarded by callers).
+    Works for any pad size, even pad > n."""
+    import jax.numpy as jnp
+
+    n = arr.shape[0]
+    pad = (-n) % multiple
+    if not pad:
+        return arr
+    reps = int(np.ceil(pad / n))
+    filler = jnp.concatenate([arr] * reps, axis=0)[:pad]
+    return jnp.concatenate([arr, filler], axis=0)
+
+
+@functools.lru_cache(maxsize=None)
+def _simulate_sweep_jax(p: SimParams, fields: tuple, point_chunk: int):
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    def one(key, vals):
+        # the replaced instance holds TRACERS in its float fields; it is
+        # a data carrier only (never hashed / used as a jit static arg)
+        q = _dc.replace(p, **dict(zip(fields, vals)))
+        w = screen_weights(q, xp=jnp)
+        scales = frequency_scales(q, xp=jnp)
+
+        kr, ki = jax.random.split(key)
+        z = (jax.random.normal(kr, (p.nx, p.ny))
+             + 1j * jax.random.normal(ki, (p.nx, p.ny)))
+        xyp = jnp.real(jnp.fft.fft2(w * z))
+
+        def one_freq(scale):
+            # the SAME closed-form filter the static path folds as a
+            # constant (fresnel_filter), here traced through q
+            filt = fresnel_filter(q, scale, xp=jnp)
+            xye = jnp.fft.ifft2(jnp.fft.fft2(jnp.exp(1j * xyp * scale))
+                                * filt)
+            return xye[:, p.ny // 2]
+
+        spe = jax.vmap(one_freq, out_axes=1)(scales)
+        return jnp.real(spe) ** 2 + jnp.imag(spe) ** 2
+
+    @jax.jit
+    def impl(keys, vals):
+        kc = keys.reshape(-1, point_chunk, *keys.shape[1:])
+        vc = vals.reshape(-1, point_chunk, vals.shape[-1])
+        out = jax.lax.map(lambda kv: jax.vmap(one)(kv[0], kv[1]),
+                          (kc, vc))
+        return out.reshape(-1, p.nx, p.nf)
+
+    return impl
+
+
+def simulate_sweep(keys, params: SimParams, sweep: dict,
+                   point_chunk: int = 4):
+    """Parameter-grid Monte Carlo: simulate B screens whose PHYSICS
+    parameters vary per point, in ONE compiled program.
+
+    ``sweep`` maps float field names (any of mb2/rf/dx/dy/ar/psi/inner/
+    dlam) to [B] arrays (scalars broadcast); ``keys`` is [B] PRNGKeys,
+    one per point.  The swept fields are traced, not static, so a
+    100-point (mb2, ar) grid costs one compile — the building block for
+    simulation-based inference over screen parameters.  Other fields
+    come from ``params`` (alpha/shape fields stay static; subharmonics
+    is unsupported here because its mode table is built host-side).
+
+    Returns intensities [B, nx, nf].
+    """
+    import jax.numpy as jnp
+
+    if params.subharmonics:
+        raise ValueError("simulate_sweep does not support subharmonics "
+                         "(host-side mode table); use simulate_ensemble "
+                         "per parameter point instead")
+    fields = tuple(sorted(sweep))
+    if not fields:
+        raise ValueError("sweep must name at least one field")
+    for f in fields:
+        if f not in _SWEEPABLE:
+            raise ValueError(f"cannot sweep {f!r}; sweepable float "
+                             f"fields are {_SWEEPABLE}")
+    n = keys.shape[0]
+    vals = np.stack([np.broadcast_to(
+        np.asarray(sweep[f], dtype=np.float64), (n,)) for f in fields],
+        axis=-1)
+    keys = _pad_cycle(keys, point_chunk)
+    vals = _pad_cycle(jnp.asarray(vals), point_chunk)
+    # canonicalise the cached trace key: the swept fields' base values
+    # are overwritten by tracers immediately, so they must not fork the
+    # compile cache (SBI loops often rebuild SimParams per call)
+    params_c = params
+    if any(getattr(params, f) != 0.0 for f in fields):
+        import dataclasses as _dc
+
+        params_c = _dc.replace(params, **{f: 0.0 for f in fields})
+    out = _simulate_sweep_jax(params_c, fields, int(point_chunk))(
+        keys, vals)
+    return out[:n]
+
+
 def simulate_ensemble(keys, params: SimParams, screen_chunk: int = 8):
     """Monte-Carlo ensemble: [B] PRNGKeys -> [B, nx, nf] intensities,
     lax.map'd in chunks of vmapped screens (BASELINE config 5: 10k
     screens).  Any B: keys are padded to the chunk multiple internally
     (pad screens are simulated and discarded)."""
-    import jax.numpy as jnp
-
     n = keys.shape[0]
-    pad = (-n) % screen_chunk
-    if pad:
-        # cycle the keys so any pad size works, even pad > n
-        reps = int(np.ceil(pad / n))
-        filler = jnp.concatenate([keys] * reps, axis=0)[:pad]
-        keys = jnp.concatenate([keys, filler], axis=0)
+    keys = _pad_cycle(keys, screen_chunk)
     out = _ensemble_jax(params, screen_chunk)(keys)
     return out[:n]

@@ -186,6 +186,21 @@ def test_acf_cuts_matmul_matches_fft_path():
                                atol=1e-3 * scale)
 
 
+def test_acf_cuts_matmul_odd_shapes():
+    """Route equivalence holds on awkward (odd, non-pow2) shapes."""
+    from scintools_tpu.ops.acf import acf_cuts_direct
+
+    rng = np.random.default_rng(5)
+    for shape in ((2, 17, 33), (1, 31, 15), (3, 7, 53)):
+        dyn = rng.standard_normal(shape)
+        ct, cf = acf_cuts_direct(dyn, backend="jax", method="fft")
+        ct_m, cf_m = acf_cuts_direct(dyn, backend="jax", method="matmul")
+        np.testing.assert_allclose(np.asarray(ct_m), np.asarray(ct),
+                                   rtol=1e-6, atol=1e-6, err_msg=str(shape))
+        np.testing.assert_allclose(np.asarray(cf_m), np.asarray(cf),
+                                   rtol=1e-6, atol=1e-6, err_msg=str(shape))
+
+
 def test_fit_from_dyn_matmul_cuts_route():
     """fit_scint_params_from_dyn(cuts_method='matmul') matches the FFT
     route's fitted parameters."""

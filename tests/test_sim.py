@@ -118,6 +118,54 @@ def test_ensemble_shapes():
     np.testing.assert_allclose(out[3], one, rtol=1e-10, atol=1e-12)
 
 
+def test_simulate_sweep_matches_static_points():
+    """Each sweep point equals the static-params simulation of the same
+    (key, physics) — the traced-parameter path reproduces the
+    constant-folded one."""
+    import dataclasses
+
+    import jax
+
+    from scintools_tpu.sim import simulate_sweep
+
+    p = SimParams(nx=16, ny=16, nf=4)
+    keys = jax.random.split(jax.random.PRNGKey(1), 3)
+    sweep = {"mb2": np.array([0.5, 2.0, 8.0]),
+             "ar": np.array([1.0, 2.0, 3.0])}
+    out = np.asarray(simulate_sweep(keys, p, sweep, point_chunk=2))
+    assert out.shape == (3, 16, 4)
+    for i in range(3):
+        q = dataclasses.replace(p, mb2=float(sweep["mb2"][i]),
+                                ar=float(sweep["ar"][i]))
+        want = np.asarray(simulate_intensity(keys[i], q))
+        np.testing.assert_allclose(out[i], want, rtol=1e-8, atol=1e-10)
+
+
+def test_simulate_sweep_physics_and_validation():
+    """Scintillation strength grows along a swept mb2 axis; bad sweeps
+    fail loudly."""
+    import jax
+    import pytest
+
+    from scintools_tpu.sim import simulate_sweep
+
+    p = SimParams(nx=128, ny=128, nf=8, dlam=0.25)
+    keys = jax.random.split(jax.random.PRNGKey(4), 2)
+    out = np.asarray(simulate_sweep(keys, p, {"mb2": [0.02, 16.0]}),
+                     dtype=np.float64)
+    m2 = out.var(axis=(1, 2)) / out.mean(axis=(1, 2)) ** 2
+    assert m2[0] < 0.15 < m2[1]
+    with pytest.raises(ValueError, match="sweep"):
+        simulate_sweep(keys, p, {"alpha": [1.0, 2.0]})
+    with pytest.raises(ValueError, match="at least one"):
+        simulate_sweep(keys, p, {})
+    import dataclasses
+
+    with pytest.raises(ValueError, match="subharmonics"):
+        simulate_sweep(keys, dataclasses.replace(p, subharmonics=1),
+                       {"mb2": [1.0, 2.0]})
+
+
 def test_strong_scattering_rayleigh_statistics():
     """Physics check: deep in strong scattering the E-field becomes
     circular-Gaussian, so intensity is exponential-distributed with
