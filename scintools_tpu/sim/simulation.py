@@ -497,11 +497,9 @@ def simulate_sweep(keys, params: SimParams, sweep: dict,
     # canonicalise the cached trace key: the swept fields' base values
     # are overwritten by tracers immediately, so they must not fork the
     # compile cache (SBI loops often rebuild SimParams per call)
-    params_c = params
-    if any(getattr(params, f) != 0.0 for f in fields):
-        import dataclasses as _dc
+    import dataclasses as _dc
 
-        params_c = _dc.replace(params, **{f: 0.0 for f in fields})
+    params_c = _dc.replace(params, **{f: 0.0 for f in fields})
     out = _simulate_sweep_jax(params_c, fields, int(point_chunk))(
         keys, vals)
     return out[:n]

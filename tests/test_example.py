@@ -27,6 +27,22 @@ def test_arc_modelling_walkthrough(tmp_path):
     assert (tmp_path / "wavefield_sspec.png").stat().st_size > 0
 
 
+@pytest.mark.slow
+def test_screen_inference_walkthrough(tmp_path):
+    """Synthetic-likelihood screen inference recovers the hidden
+    (mb2, ar) to within the grid's resolution (examples/
+    screen_inference.py; the observation is a single noisy epoch, so
+    the tolerance is one-to-two grid steps)."""
+    script = _SCRIPT.parent / "screen_inference.py"
+    mod = runpy.run_path(str(script))
+    res = mod["main"](str(tmp_path), seed=47)
+    assert res["truth"] == {"mb2": 4.0, "ar": 2.0}
+    assert 4.0 / 3 <= res["posterior_mean"]["mb2"] <= 12.0
+    assert abs(res["posterior_mean"]["ar"] - 2.0) <= 1.2
+    assert 1.0 <= res["map"]["mb2"] <= 16.0
+    assert (tmp_path / "posterior.png").stat().st_size > 0
+
+
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-v"]))
 
