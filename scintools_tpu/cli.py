@@ -67,6 +67,9 @@ def cmd_process(args) -> int:
     # existing stores still resume
     arc_method = getattr(args, "arc_method", "norm_sspec")
     arc_bracket = getattr(args, "arc_bracket", None)
+    scint_2d = getattr(args, "scint_2d", False)
+    if scint_2d:
+        cfg += ("scint2d",)
     # fail fast on estimator misconfiguration, before any file I/O
     if arc_bracket is not None and not (0 < arc_bracket[0]
                                         < arc_bracket[1]):
@@ -100,9 +103,22 @@ def cmd_process(args) -> int:
                 ds = Dynspec(filename=fn, process=True,
                              lamsteps=args.lamsteps, backend=args.backend)
             scint = arc = None
+            tilt_row = {}
             if not args.no_scint:
                 with timers.stage("scint_fit"):
                     scint = ds.get_scint_params()
+            if scint_2d:
+                with timers.stage("scint_fit_2d"):
+                    import math
+
+                    ds.get_scint_params(method="acf2d")
+                    if not math.isfinite(float(ds.tilt)):
+                        # quarantine like any failed fit (retried on
+                        # resume), not stored as a NaN result
+                        raise ValueError(
+                            "2-D ACF fit returned non-finite tilt")
+                    tilt_row = dict(tilt=float(ds.tilt),
+                                    tilterr=float(ds.tilterr))
             if not args.no_arc:
                 with timers.stage("arc_fit"):
                     fkw = {"method": arc_method}
@@ -118,6 +134,8 @@ def cmd_process(args) -> int:
                         fkw["numsteps"] = 128
                     arc = ds.fit_arc(lamsteps=args.lamsteps, **fkw)
             row = results_row(ds.data, scint=scint, arc=arc)
+            row.update(tilt_row)   # store rows only; CSV keeps the
+            #                        reference schema (as eta_left does)
             if args.plots:
                 with timers.stage("plots"):
                     import matplotlib
@@ -176,6 +194,7 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
         pkw = dict(lamsteps=args.lamsteps,
                    fit_arc=not args.no_arc,
                    fit_scint=not args.no_scint,
+                   fit_scint_2d=getattr(args, "scint_2d", False),
                    arc_asymm=getattr(args, "arc_asymm", False),
                    arc_method=getattr(args, "arc_method", "norm_sspec"))
         bracket = getattr(args, "arc_bracket", None)
@@ -211,11 +230,16 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
                                     "eta_right", "etaerr_right"):
                             row[arm] = float(
                                 np.asarray(getattr(res.arc, arm))[lane])
+                if res.tilt is not None:
+                    # store rows only, like the per-arm values
+                    row["tilt"] = float(np.asarray(res.tilt)[lane])
+                    row["tilterr"] = float(np.asarray(res.tilterr)[lane])
                 # NaN lanes are FAILED fits: quarantine (no CSV row, no
                 # store entry -> retried on resume), as the per-file loop
                 # does via exceptions
                 fitvals = [v for k, v in row.items()
-                           if k in ("tau", "dnu", "eta", "betaeta")]
+                           if k in ("tau", "dnu", "eta", "betaeta",
+                                    "tilt")]
                 if fitvals and not np.all(np.isfinite(fitvals)):
                     failed += 1
                     log_event(log, "epoch_failed", file=names[idx],
@@ -497,6 +521,9 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--plots", help="write summary plots to this dir")
     q.add_argument("--no-arc", action="store_true")
     q.add_argument("--no-scint", action="store_true")
+    q.add_argument("--scint-2d", action="store_true",
+                   help="also fit the 2-D ACF model (phase-gradient "
+                        "tilt -> store rows; per-file and batched)")
     q.add_argument("--arc-asymm", action="store_true",
                    help="also measure per-arm curvatures "
                         "(eta_left/eta_right; batched mode)")

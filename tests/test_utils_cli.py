@@ -223,6 +223,29 @@ def test_cli_process_batched(tmp_path, capsys):
     assert len(open(res).read().strip().splitlines()) == 4
 
 
+def test_cli_process_scint_2d(tmp_path, capsys):
+    """--scint-2d adds phase-gradient tilt to the store rows (per-file
+    and batched), without touching the reference CSV schema."""
+    import glob
+
+    d = from_simulation(Simulation(mb2=2, ns=64, nf=64, dlam=0.25,
+                                   seed=90), freq=1400.0, dt=8.0)
+    fn = str(tmp_path / "e.dynspec")
+    write_psrflux(d, fn)
+    for extra in ([], ["--batched"]):
+        store = str(tmp_path / ("st_b" if extra else "st_p"))
+        res = str(tmp_path / ("rb.csv" if extra else "rp.csv"))
+        rc = cli_main(["process", fn, "--lamsteps", "--no-arc",
+                       "--scint-2d", "--results", res, "--store", store,
+                       *extra])
+        assert rc == 0
+        rows = open(res).read().strip().splitlines()
+        assert "tilt" not in rows[0]     # CSV keeps reference schema
+        [row_file] = glob.glob(f"{store}/*.json")
+        row = json.loads(open(row_file).read())
+        assert np.isfinite(row["tilt"]) and row["tilterr"] >= 0
+
+
 def test_cli_curvature_recovers_screen(tmp_path, capsys):
     """`curvature` fits screen parameters straight from a results CSV +
     par file, closing the annual-variation workflow the reference leaves
