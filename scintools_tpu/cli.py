@@ -421,6 +421,7 @@ def cmd_curvature(args) -> int:
 def cmd_wavefield(args) -> int:
     import numpy as np
 
+    from .backend import resolve
     from .pipeline import Dynspec
 
     files = _expand(args.files)
@@ -433,7 +434,12 @@ def cmd_wavefield(args) -> int:
         import matplotlib
 
         matplotlib.use("Agg")
-    rc = 0
+
+    # phase 1: load + process + curvature per file.  Only the light
+    # DynspecData survives this loop (the Dynspec wrapper's ACF/sspec
+    # caches are dropped with it) — grouping needs all epochs' grids
+    # before any retrieval can be batched.
+    epochs, rc = [], 0
     for fn in files:
         try:
             ds = Dynspec(filename=fn, process=True, backend=args.backend)
@@ -444,34 +450,84 @@ def cmd_wavefield(args) -> int:
                            etamin=args.etamin, etamax=args.etamax,
                            numsteps=args.numsteps)
                 eta = float(ds.eta)
-            wf = ds.retrieve_wavefield(eta=eta, chunk_nf=args.chunk,
-                                       chunk_nt=args.chunk,
-                                       conc_weight=args.conc_weight)
-            dyn = np.asarray(ds.data.dyn, float)
-            corr = float(np.corrcoef(dyn.ravel(),
-                                     wf.model_dynspec.ravel())[0, 1])
-            base = fn.rsplit(".", 1)[0]
-            out = args.out if args.out else f"{base}.wavefield.npz"
-            wf.save(out)
-            if args.plots:
-                import matplotlib.pyplot as plt
-
-                from . import plotting
-
-                plotting.plot_wavefield(
-                    wf, filename=f"{base}.wavefield.png")
-                plotting.plot_sspec(
-                    wf.secspec(), eta=eta,
-                    filename=f"{base}.wavefield_sspec.png")
-                plt.close("all")
-            print(json.dumps({
-                "file": fn, "eta": eta, "corr": round(corr, 4),
-                "conc_mean": round(float(wf.conc.mean()), 4),
-                "ntheta": len(wf.theta), "out": out}))
+            epochs.append((fn, ds.data, eta))
         except Exception as e:
             print(f"{fn}: wavefield retrieval failed ({e})",
                   file=sys.stderr)
             rc = 1
+
+    def persist(fn, data, eta, wf, nbatch) -> None:
+        dyn = np.asarray(data.dyn, dtype=np.float64)
+        corr = float(np.corrcoef(dyn.ravel(),
+                                 wf.model_dynspec.ravel())[0, 1])
+        base = fn.rsplit(".", 1)[0]
+        out = args.out if args.out else f"{base}.wavefield.npz"
+        wf.save(out)
+        if args.plots:
+            import matplotlib.pyplot as plt
+
+            from . import plotting
+
+            plotting.plot_wavefield(wf, filename=f"{base}.wavefield.png")
+            plotting.plot_sspec(wf.secspec(), eta=eta,
+                                filename=f"{base}.wavefield_sspec.png")
+            plt.close("all")
+        print(json.dumps({
+            "file": fn, "eta": eta, "corr": round(corr, 4),
+            "conc_mean": round(float(wf.conc.mean()), 4),
+            "ntheta": len(wf.theta), "batch": nbatch, "out": out}))
+
+    # phase 2: retrieval + streaming persist per group — equal-grid
+    # epochs on the jax backend go through retrieve_wavefield_batch
+    # (every chunk of every epoch in ONE compiled program); others stay
+    # per-file, each isolated in its own try
+    from .fit.wavefield import retrieve_wavefield, \
+        retrieve_wavefield_batch
+
+    groups: dict = {}
+    for item in epochs:
+        f = np.asarray(item[1].freqs, dtype=np.float64)
+        t = np.asarray(item[1].times, dtype=np.float64)
+        groups.setdefault((f.shape, t.shape, f.tobytes(), t.tobytes()),
+                          []).append(item)
+    kw = dict(chunk_nf=args.chunk, chunk_nt=args.chunk,
+              conc_weight=args.conc_weight)
+    for group in groups.values():
+        if resolve(args.backend) == "jax" and len(group) > 1:
+            try:
+                d0 = group[0][1]
+                wfs = retrieve_wavefield_batch(
+                    np.stack([np.asarray(d.dyn, dtype=np.float64)
+                              for _, d, _ in group]),
+                    np.asarray(d0.freqs), np.asarray(d0.times),
+                    [eta for _, _, eta in group], freq=float(d0.freq),
+                    dt=float(d0.dt), df=float(d0.df), backend="jax",
+                    **kw)
+                for (fn, d, eta), wf in zip(group, wfs):
+                    try:
+                        persist(fn, d, eta, wf, len(group))
+                    except Exception as e:
+                        print(f"{fn}: wavefield output failed ({e})",
+                              file=sys.stderr)
+                        rc = 1
+                continue
+            except Exception as e:
+                # the batching itself can be the failure (one epoch's
+                # degenerate eta, batch OOM): fall back to independent
+                # per-file retrieval instead of failing the whole group
+                print(f"batched retrieval failed ({e}); retrying "
+                      f"{len(group)} file(s) individually",
+                      file=sys.stderr)
+        for fn, d, eta in group:
+            try:
+                persist(fn, d, eta,
+                        retrieve_wavefield(d, eta,
+                                           backend=args.backend, **kw),
+                        1)
+            except Exception as e:
+                print(f"{fn}: wavefield retrieval failed ({e})",
+                      file=sys.stderr)
+                rc = 1
     return rc
 
 

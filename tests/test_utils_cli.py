@@ -152,6 +152,62 @@ def test_cli_wavefield(sim_file, tmp_path, capsys):
     assert len(wf.theta) == info["ntheta"]
 
 
+def test_cli_wavefield_batches_equal_grids(tmp_path, capsys):
+    """Equal-grid survey epochs on the jax backend retrieve through ONE
+    compiled batch; a different-shaped file stays per-file — and a
+    failing group does not block the others."""
+    files = []
+    for i, ns in enumerate((64, 64, 48)):
+        d = from_simulation(Simulation(mb2=2, ns=ns, nf=64, dlam=0.25,
+                                       seed=60 + i), freq=1400.0, dt=8.0)
+        fn = str(tmp_path / f"w{i}.dynspec")
+        write_psrflux(d, fn)
+        files.append(fn)
+    rc = cli_main(["wavefield", *files, "--chunk", "32",
+                   "--numsteps", "48", "--etamin", "1e-3",
+                   "--etamax", "10", "--backend", "jax"])
+    assert rc == 0
+    lines = [json.loads(x) for x in
+             capsys.readouterr().out.strip().splitlines()]
+    by_file = {x["file"]: x for x in lines}
+    assert by_file[files[0]]["batch"] == 2
+    assert by_file[files[1]]["batch"] == 2
+    assert by_file[files[2]]["batch"] == 1
+    for x in lines:
+        assert np.isfinite(x["corr"])
+        assert os.path.exists(x["out"])
+
+
+def test_cli_wavefield_isolates_failures(tmp_path, capsys, monkeypatch):
+    """One epoch's retrieval failure must not take down its group
+    (regression: a group-wide try once reported every member failed)."""
+    import scintools_tpu.fit.wavefield as wfmod
+
+    files = []
+    for i in range(2):
+        d = from_simulation(Simulation(mb2=2, ns=64, nf=64, dlam=0.25,
+                                       seed=80 + i), freq=1400.0, dt=8.0)
+        fn = str(tmp_path / f"f{i}.dynspec")
+        write_psrflux(d, fn)
+        files.append(fn)
+    real = wfmod.retrieve_wavefield
+    state = {"first": True}
+
+    def flaky(data, eta, **kw):
+        if state.pop("first", False):
+            raise RuntimeError("boom")
+        return real(data, eta, **kw)
+
+    monkeypatch.setattr(wfmod, "retrieve_wavefield", flaky)
+    rc = cli_main(["wavefield", *files, "--chunk", "32",
+                   "--numsteps", "48", "--etamin", "1e-3",
+                   "--etamax", "10"])   # numpy backend: per-file path
+    assert rc == 1
+    lines = [json.loads(x) for x in
+             capsys.readouterr().out.strip().splitlines()]
+    assert len(lines) == 1 and np.isfinite(lines[0]["corr"])
+
+
 def test_cli_wavefield_bad_file(tmp_path):
     fn = str(tmp_path / "nope.dynspec")
     open(fn, "w").write("not a dynspec\n")
