@@ -201,9 +201,17 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
         if bracket is not None:
             pkw["arc_constraint"] = (float(bracket[0]), float(bracket[1]))
         pcfg = PipelineConfig(**pkw)
+        mesh_shape = getattr(args, "mesh", None)
         try:
+            # inside the quarantine handler: an invalid --mesh for this
+            # host's device count must fail like any pipeline failure
+            # (logged, rc=1), not as a raw traceback
+            mesh = (make_mesh(tuple(int(x) for x in mesh_shape))
+                    if mesh_shape else make_mesh())
             with timers.stage("batched_pipeline"):
-                buckets = run_pipeline(epochs, pcfg, mesh=make_mesh())
+                buckets = run_pipeline(
+                    epochs, pcfg, mesh=mesh,
+                    chunk=getattr(args, "chunk_epochs", None))
         except Exception as e:
             log_event(log, "pipeline_failed", error=repr(e),
                       epochs=len(epochs))
@@ -595,6 +603,15 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--batched", action="store_true",
                    help="one jit-compiled step per shape bucket over the "
                         "device mesh instead of a per-file loop")
+    q.add_argument("--chunk-epochs", type=int, default=None,
+                   help="batched mode: bound device memory by limiting "
+                        "epochs per step (rounded up to the mesh's "
+                        "data-axis size, with a warning)")
+    q.add_argument("--mesh", type=int, nargs=2, default=None,
+                   metavar=("DATA", "CHAN"),
+                   help="batched mode: mesh shape (data x chan "
+                        "parallelism; CHAN>1 shards the sspec FFT's "
+                        "channel axis)")
     q.set_defaults(fn=cmd_process)
 
     q = sub.add_parser("sort", help="triage files into good/bad lists")

@@ -145,8 +145,13 @@ def lambda_resample_matrix(freqs: np.ndarray) -> tuple[np.ndarray, np.ndarray, f
 
 
 def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
-                  mesh=None, chan_sharded: bool = False):
+                  mesh=None, chan_sharded: bool | None = None):
     """Build the jit'd batched step for a fixed (freqs, times) template.
+
+    ``chan_sharded=None`` (default) derives channel sharding from the
+    mesh itself: any mesh with a >1 ``chan`` axis shards the
+    secondary-spectrum FFT's channel axis (why else build one).  Pass an
+    explicit bool to override.
 
     Returns ``step(dyn_batch [B, nf, nt]) -> PipelineResult``.  Epochs with
     other shapes go through parallel.batch.pad_batch / bucket_by_shape
@@ -204,6 +209,9 @@ def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
                 "them at their defaults")
     freqs = np.ascontiguousarray(np.asarray(freqs, dtype=np.float64))
     times = np.ascontiguousarray(np.asarray(times, dtype=np.float64))
+    if chan_sharded is None:
+        chan_sharded = (mesh is not None
+                        and int(mesh.shape.get(mesh_mod.CHAN_AXIS, 1)) > 1)
     return _make_pipeline_cached(
         (freqs.tobytes(), freqs.shape), (times.tobytes(), times.shape),
         config, mesh, bool(chan_sharded))
@@ -421,11 +429,14 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
 
 
 def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
-                 mesh=None, chunk: int | None = None):
+                 mesh=None, chunk: int | None = None,
+                 chan_sharded: bool | None = None):
     """Host-side convenience driver: bucket heterogeneous epochs by shape,
     pad each bucket to the mesh's data-axis multiple, run the jit'd step
     per bucket (optionally in memory-bounded chunks), and gather results
-    with invalid lanes dropped.
+    with invalid lanes dropped.  ``chan_sharded=None`` derives channel
+    sharding from the mesh (any >1 ``chan`` axis shards the big
+    secondary-spectrum FFT; see make_pipeline).
 
     Returns a list of (indices, PipelineResult) per bucket, where
     ``indices`` maps result lanes back to the input epoch order: lane k of
@@ -453,7 +464,8 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
         group = [epochs[i] for i in idx]
         batch, _mask = pad_batch(group, batch_multiple=multiple)
         step = make_pipeline(np.asarray(group[0].freqs),
-                             np.asarray(group[0].times), config, mesh=mesh)
+                             np.asarray(group[0].times), config, mesh=mesh,
+                             chan_sharded=chan_sharded)
         dyn = np.asarray(batch.dyn)
         B = dyn.shape[0]
         if chunk is None or chunk >= B:
@@ -461,6 +473,14 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
         else:
             # memory-bounded chunking; chunk must respect mesh divisibility
             c = max(multiple, (chunk // multiple) * multiple)
+            if c != chunk:
+                import warnings
+
+                warnings.warn(
+                    f"run_pipeline: chunk={chunk} adjusted to {c} (the "
+                    f"mesh's data axis needs multiples of {multiple}); "
+                    "size chunk accordingly when bounding device memory",
+                    stacklevel=2)
             parts = [step(dyn[i:i + c]) for i in range(0, B, c)]
             res = _concat_results(parts)
         results.append((np.asarray(idx), _take_lanes(res, len(idx), B)))

@@ -20,6 +20,36 @@ print('tpu alive:', float(np.asarray(jnp.sum(jnp.ones((64,64))))))
 echo "== probe =="
 probe || { echo "tunnel unreachable; aborting"; exit 1; }
 
+echo "== pallas nudft lowers on chip =="
+# the Pallas NUDFT is CI-validated in interpret mode only; this is the
+# real-Mosaic lowering check.  Gate on python's EXIT STATUS (the rel-err
+# line prints before the assert, so grepping for it cannot detect a
+# failure), captured to a file because the log-noise filter pipeline
+# would otherwise own the status.
+pallas_out=$(mktemp)
+if ! timeout -k 10 600 python -u -c "
+import numpy as np
+from scintools_tpu.ops.nudft import nudft_pallas
+rng = np.random.default_rng(0)
+nt, nf, nr = 128, 64, 64
+power = rng.standard_normal((nt, nf))
+fscale = 1.0 + 0.01 * np.arange(nf) / nf
+tsrc = np.arange(nt, dtype=float)
+r0, dr = -0.5, 1.0 / nt
+ks = np.arange(nr) * dr + r0
+ph = np.exp(2j*np.pi*np.einsum('r,t,f->rtf', ks, tsrc, fscale))
+want = np.einsum('rtf,tf->rf', ph, power)
+got = np.asarray(nudft_pallas(power, fscale, tsrc, r0, dr, nr))
+err = np.max(np.abs(got - want)) / max(np.max(np.abs(want)), 1e-30)
+print('pallas on-chip rel err vs direct oracle:', err)
+assert err < 5e-3, err
+" > "$pallas_out" 2>&1; then
+  grep -v -E 'INFO|WARN|axon_|Logging|E0000' "$pallas_out" | tail -5
+  echo "pallas lowering check FAILED"
+  exit 1
+fi
+grep -v -E 'INFO|WARN|axon_|Logging|E0000' "$pallas_out" | tail -1
+
 echo "== stage profile (bench shape) =="
 timeout -k 10 1800 python benchmarks/profile_stages.py --b 256 --iters 5 \
   2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -8

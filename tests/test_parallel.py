@@ -330,6 +330,21 @@ def test_run_pipeline_buckets_by_axis_identity(epochs):
     assert groups == [(0, 1, 2), (3,)]
 
 
+def test_run_pipeline_chan_sharded_matches(epochs):
+    """A mesh with a >1 chan axis DERIVES channel sharding in
+    run_pipeline (chan_sharded=None default) and reproduces the plain
+    results."""
+    cfg = PipelineConfig(arc_numsteps=400, lm_steps=20)
+    mesh = make_mesh((4, 2))
+    [(idx_c, c)] = run_pipeline(epochs, cfg, mesh=mesh)
+    [(idx_p, p)] = run_pipeline(epochs, cfg)
+    np.testing.assert_array_equal(idx_c, idx_p)
+    np.testing.assert_allclose(np.asarray(c.arc.eta),
+                               np.asarray(p.arc.eta), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(c.scint.tau),
+                               np.asarray(p.scint.tau), rtol=1e-4)
+
+
 def test_run_pipeline_chunked_matches(epochs):
     cfg = PipelineConfig(arc_numsteps=400, lm_steps=20)
     [(idx_a, a)] = run_pipeline(epochs * 2, cfg)

@@ -411,6 +411,33 @@ def test_cli_process_batched_thetatheta(tmp_path, capsys):
     assert len(open(res).read().strip().splitlines()) == 5
 
 
+def test_cli_process_batched_mesh_and_chunk(tmp_path, capsys):
+    """--mesh D C and --chunk-epochs drive the chan-sharded, memory-
+    bounded engine to the same measurements as the default run."""
+    files = []
+    for i in range(3):
+        d = from_simulation(Simulation(mb2=2, ns=64, nf=64, dlam=0.25,
+                                       seed=40 + i), freq=1400.0, dt=8.0)
+        fn = str(tmp_path / f"m{i}.dynspec")
+        write_psrflux(d, fn)
+        files.append(fn)
+
+    def run(tag, extra):
+        res = str(tmp_path / f"{tag}.csv")
+        rc = cli_main(["process", *files, "--lamsteps", "--batched",
+                       "--results", res, *extra])
+        assert rc == 0
+        rows = open(res).read().strip().splitlines()
+        return {r.split(",")[0]: [float(x) for x in r.split(",")[7:]]
+                for r in rows[1:]}
+
+    plain = run("plain", [])
+    fancy = run("fancy", ["--mesh", "4", "2", "--chunk-epochs", "2"])
+    assert plain.keys() == fancy.keys()
+    for k in plain:
+        np.testing.assert_allclose(fancy[k], plain[k], rtol=1e-4)
+
+
 def test_cli_process_batched_asymm(tmp_path, capsys):
     """--batched --arc-asymm persists per-arm curvatures in the store."""
     import json
