@@ -91,6 +91,17 @@ def cmd_process(args) -> int:
         log_event(log, "resume", total=len(files), todo=len(todo),
                   done=len(files) - len(todo))
         files = todo
+    if not args.batched:
+        for flag, name in ((getattr(args, "mesh", None), "--mesh"),
+                           (getattr(args, "chunk_epochs", None),
+                            "--chunk-epochs")):
+            if flag is not None:
+                raise SystemExit(f"{name} only applies to the batched "
+                                 "engine; add --batched")
+    if getattr(args, "full_csv", False) and not (args.store
+                                                 and args.results):
+        raise SystemExit("--full-csv exports the store's columns: it "
+                         "needs both --store and --results")
     if args.batched:
         if args.plots:
             raise SystemExit("--batched does not render per-epoch plots; "
@@ -156,7 +167,8 @@ def cmd_process(args) -> int:
             failed += 1
             log_event(log, "epoch_failed", file=fn, error=repr(e))
     if store is not None and args.results:
-        store.export_csv(args.results)
+        store.export_csv(args.results,
+                         full=getattr(args, "full_csv", False))
     print(timers.report(), file=sys.stderr)
     log_event(log, "done", processed=len(files) - failed, failed=failed)
     return 0 if failed == 0 else 1
@@ -264,7 +276,8 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
                           tau=row.get("tau"),
                           eta=row.get("betaeta", row.get("eta")))
     if store is not None and args.results:
-        store.export_csv(args.results)
+        store.export_csv(args.results,
+                         full=getattr(args, "full_csv", False))
     print(timers.report(), file=sys.stderr)
     log_event(log, "done", processed=processed, failed=failed)
     return 0 if failed == 0 else 1
@@ -603,10 +616,14 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--batched", action="store_true",
                    help="one jit-compiled step per shape bucket over the "
                         "device mesh instead of a per-file loop")
+    q.add_argument("--full-csv", action="store_true",
+                   help="with --store + --results: export EVERY store "
+                        "column (tilt, per-arm curvatures, ...) instead "
+                        "of the reference-compatible schema")
     q.add_argument("--chunk-epochs", type=int, default=None,
                    help="batched mode: bound device memory by limiting "
-                        "epochs per step (rounded up to the mesh's "
-                        "data-axis size, with a warning)")
+                        "epochs per step (adjusted to a multiple of the "
+                        "mesh's data-axis size, with a warning)")
     q.add_argument("--mesh", type=int, nargs=2, default=None,
                    metavar=("DATA", "CHAN"),
                    help="batched mode: mesh shape (data x chan "

@@ -84,20 +84,35 @@ class ResultsStore:
         """Items whose key is not yet in the store (the resume filter)."""
         return [it for it in items if keyfn(it) not in self]
 
-    def export_csv(self, filename: str) -> int:
-        """Write all records to the reference-compatible CSV
-        (io/results.write_results schema).  Returns the row count."""
+    def export_csv(self, filename: str, full: bool = False) -> int:
+        """Write all records to CSV.  Default: the reference-compatible
+        schema (io/results.write_results — extra columns like tilt or
+        per-arm curvatures are dropped, as the reference's readers
+        expect).  ``full=True`` instead writes EVERY column the records
+        carry (union of keys, blank where absent) for downstream tools
+        that want the beyond-reference measurements.  Returns the row
+        count."""
+        import csv
+
         from ..io.results import write_results
 
         if os.path.exists(filename):
             os.remove(filename)
-        n = 0
-        for rec in self.records():
-            row = {k: v for k, v in rec.items() if not k.startswith("_")}
-            if "name" in row:
+        rows = [{k: v for k, v in rec.items() if not k.startswith("_")}
+                for rec in self.records()]
+        rows = [r for r in rows if "name" in r]
+        if not full:
+            for row in rows:
                 write_results(filename, row)
-                n += 1
-        return n
+            return len(rows)
+        lead = ["name", "mjd", "freq", "bw", "tobs", "dt", "df"]
+        extra = sorted({k for r in rows for k in r} - set(lead))
+        with open(filename, "w", newline="") as fh:
+            w = csv.DictWriter(fh, fieldnames=lead + extra,
+                               restval="")
+            w.writeheader()
+            w.writerows(rows)
+        return len(rows)
 
 
 def seed_range_pending(store: ResultsStore, seeds: Iterable[int],

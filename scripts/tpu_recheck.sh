@@ -27,6 +27,7 @@ echo "== pallas nudft lowers on chip =="
 # failure), captured to a file because the log-noise filter pipeline
 # would otherwise own the status.
 pallas_out=$(mktemp)
+trap 'rm -f "$pallas_out"' EXIT
 if ! timeout -k 10 600 python -u -c "
 import numpy as np
 from scintools_tpu.ops.nudft import nudft_pallas

@@ -302,6 +302,34 @@ def test_cli_process_scint_2d(tmp_path, capsys):
         assert np.isfinite(row["tilt"]) and row["tilterr"] >= 0
 
 
+def test_cli_full_csv_export(tmp_path, capsys):
+    """--full-csv exports every store column (tilt etc.); the default
+    export keeps the reference schema."""
+    from scintools_tpu.sim import Simulation
+
+    d = from_simulation(Simulation(mb2=2, ns=64, nf=64, dlam=0.25,
+                                   seed=91), freq=1400.0, dt=8.0)
+    fn = str(tmp_path / "e.dynspec")
+    write_psrflux(d, fn)
+    res = str(tmp_path / "r.csv")
+    store = str(tmp_path / "st")
+    rc = cli_main(["process", fn, "--lamsteps", "--no-arc", "--scint-2d",
+                   "--results", res, "--store", store, "--full-csv"])
+    assert rc == 0
+    header, row = open(res).read().strip().splitlines()
+    cols = header.split(",")
+    assert "tilt" in cols and "tau" in cols
+    vals = dict(zip(cols, row.split(",")))
+    assert np.isfinite(float(vals["tilt"]))
+    # prerequisite-less flags fail loudly instead of silently no-opping
+    with pytest.raises(SystemExit, match="--store"):
+        cli_main(["process", fn, "--results", res, "--full-csv"])
+    with pytest.raises(SystemExit, match="--batched"):
+        cli_main(["process", fn, "--mesh", "4", "2"])
+    with pytest.raises(SystemExit, match="--batched"):
+        cli_main(["process", fn, "--chunk-epochs", "2"])
+
+
 def test_cli_curvature_recovers_screen(tmp_path, capsys):
     """`curvature` fits screen parameters straight from a results CSV +
     par file, closing the annual-variation workflow the reference leaves
