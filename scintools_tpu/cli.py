@@ -82,15 +82,8 @@ def cmd_process(args) -> int:
                          "range)")
     if arc_method != "norm_sspec" or arc_bracket is not None:
         cfg += (arc_method, tuple(arc_bracket or ()))
-    if args.plots:
-        import os
-
-        os.makedirs(args.plots, exist_ok=True)
-    if store is not None:
-        todo = store.pending(files, lambda f: content_key(f, cfg))
-        log_event(log, "resume", total=len(files), todo=len(todo),
-                  done=len(files) - len(todo))
-        files = todo
+    # prerequisite checks stay ahead of the plots mkdir and the store
+    # resume scan (which hashes every input file): truly fail-fast
     if not args.batched:
         for flag, name in ((getattr(args, "mesh", None), "--mesh"),
                            (getattr(args, "chunk_epochs", None),
@@ -102,6 +95,15 @@ def cmd_process(args) -> int:
                                                  and args.results):
         raise SystemExit("--full-csv exports the store's columns: it "
                          "needs both --store and --results")
+    if args.plots:
+        import os
+
+        os.makedirs(args.plots, exist_ok=True)
+    if store is not None:
+        todo = store.pending(files, lambda f: content_key(f, cfg))
+        log_event(log, "resume", total=len(files), todo=len(todo),
+                  done=len(files) - len(todo))
+        files = todo
     if args.batched:
         if args.plots:
             raise SystemExit("--batched does not render per-epoch plots; "

@@ -100,16 +100,20 @@ class ResultsStore:
             os.remove(filename)
         rows = [{k: v for k, v in rec.items() if not k.startswith("_")}
                 for rec in self.records()]
-        rows = [r for r in rows if "name" in r]
         if not full:
+            # the reference schema REQUIRES name/mjd/... columns; rows
+            # without them (e.g. seed-keyed simulation records) cannot
+            # be expressed in it and are skipped
+            rows = [r for r in rows if "name" in r]
             for row in rows:
                 write_results(filename, row)
             return len(rows)
         lead = ["name", "mjd", "freq", "bw", "tobs", "dt", "df"]
-        extra = sorted({k for r in rows for k in r} - set(lead))
+        present = {k for r in rows for k in r}
+        fields = ([k for k in lead if k in present]
+                  + sorted(present - set(lead)))
         with open(filename, "w", newline="") as fh:
-            w = csv.DictWriter(fh, fieldnames=lead + extra,
-                               restval="")
+            w = csv.DictWriter(fh, fieldnames=fields, restval="")
             w.writeheader()
             w.writerows(rows)
         return len(rows)

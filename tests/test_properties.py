@@ -1,0 +1,152 @@
+"""Property-based tests (hypothesis) for the core kernels.
+
+SURVEY.md §4's property tier, upgraded from fixed seeds to searched
+inputs: Wiener–Khinchin against a brute-force autocovariance, parabola
+vertex recovery, trim idempotence, psrflux round-trips, NUDFT vs the
+direct sum, and the FFT-vs-MXU cut equivalence.  Shapes are bounded
+(and fixed on jax-path properties: every new shape is a recompile);
+values are what hypothesis searches.
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+from hypothesis import HealthCheck, given, settings  # noqa: E402
+from hypothesis import strategies as st  # noqa: E402
+from hypothesis.extra import numpy as hnp  # noqa: E402
+
+_SETTINGS = settings(max_examples=25, deadline=None,
+                     suppress_health_check=[HealthCheck.too_slow])
+
+
+def _finite_arrays(shape_strategy, lo=-1e3, hi=1e3):
+    return shape_strategy.flatmap(
+        lambda s: hnp.arrays(np.float64, s,
+                             elements=st.floats(lo, hi, width=64)))
+
+
+_dyn_shapes = st.tuples(st.integers(3, 10), st.integers(3, 12))
+
+
+@_SETTINGS
+@given(_finite_arrays(_dyn_shapes))
+def test_acf_wiener_khinchin_vs_brute_force(dyn):
+    """The padded-FFT ACF equals the brute-force linear autocovariance
+    of the mean-subtracted array at every non-degenerate lag."""
+    from scintools_tpu.ops import acf
+
+    nf, nt = dyn.shape
+    a = acf(dyn, backend="numpy")
+    x = dyn - dyn.mean()
+    scale = max(np.abs(x).max() ** 2 * x.size, 1e-12)
+    for df in (-nf + 1, -1, 0, 2, nf - 1):
+        for dt in (-nt + 1, 0, 1, nt - 1):
+            want = sum(
+                x[i, j] * x[i + df, j + dt]
+                for i in range(max(0, -df), min(nf, nf - df))
+                for j in range(max(0, -dt), min(nt, nt - dt)))
+            got = a[nf + df, nt + dt]
+            assert abs(got - want) < 1e-9 * scale + 1e-9, (df, dt)
+
+
+@_SETTINGS
+@given(st.floats(-50, -0.01), st.floats(-100, 100), st.floats(-100, 100))
+def test_parabola_vertex_recovery(a, b, c):
+    """fit_parabola recovers the vertex of an exact downward parabola."""
+    from scintools_tpu.models.parabola import fit_parabola
+
+    x = np.linspace(-3.0, 5.0, 41)
+    y = a * x ** 2 + b * x + c
+    yfit, peak, err = fit_parabola(x, y)
+    assert float(peak) == pytest.approx(-b / (2 * a), rel=1e-6, abs=1e-5)
+    np.testing.assert_allclose(yfit, y, atol=1e-6 * max(np.abs(y).max(),
+                                                        1.0))
+
+
+@_SETTINGS
+@given(_finite_arrays(st.tuples(st.integers(4, 9), st.integers(4, 9)),
+                      lo=0.1, hi=10.0),
+       st.integers(0, 2), st.integers(0, 2),
+       st.integers(0, 2), st.integers(0, 2))
+def test_trim_edges_idempotent(dyn, top, bottom, left, right):
+    """trim_edges is idempotent however many zero borders the input
+    carries (only interior stays non-zero by construction)."""
+    from scintools_tpu.data import DynspecData
+    from scintools_tpu.ops import trim_edges
+
+    nf, nt = dyn.shape
+    dyn = np.pad(dyn, ((top, bottom), (left, right)))
+    freqs = 1400.0 + np.arange(dyn.shape[0]) * 0.5
+    times = np.arange(dyn.shape[1]) * 8.0
+    d = DynspecData(dyn=dyn, freqs=freqs, times=times)
+    once = trim_edges(d)
+    twice = trim_edges(once)
+    np.testing.assert_array_equal(np.asarray(once.dyn),
+                                  np.asarray(twice.dyn))
+    assert once.dyn.shape == (nf, nt)
+    np.testing.assert_array_equal(np.asarray(once.freqs),
+                                  np.asarray(twice.freqs))
+
+
+@_SETTINGS
+@given(_finite_arrays(st.tuples(st.integers(2, 8), st.integers(2, 10)),
+                      lo=-100.0, hi=100.0))
+def test_psrflux_roundtrip(dyn):
+    """write_psrflux -> read_psrflux preserves the dynspec and axes to
+    text precision, for any finite flux values."""
+    import tempfile
+
+    from scintools_tpu.io import from_arrays, read_psrflux, write_psrflux
+
+    nf, nt = dyn.shape
+    d = from_arrays(dyn=dyn, freqs=1400.0 + np.arange(nf) * 0.5,
+                    times=(np.arange(nt) + 0.5) * 8.0, mjd=53005.0,
+                    name="prop")
+    with tempfile.NamedTemporaryFile(suffix=".dynspec") as fh:
+        write_psrflux(d, fh.name)
+        back = read_psrflux(fh.name)
+    np.testing.assert_allclose(np.asarray(back.dyn), dyn,
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(back.freqs),
+                               np.asarray(d.freqs), rtol=1e-9)
+    assert back.dyn.shape == dyn.shape
+
+
+@_SETTINGS
+@given(_finite_arrays(st.tuples(st.integers(3, 8), st.integers(2, 5)),
+                      lo=-10.0, hi=10.0),
+       st.floats(0.9, 1.1), st.floats(-1.0, 0.0), st.floats(0.01, 0.1))
+def test_nudft_matches_direct_sum(power, fs_slope, r0, dr):
+    """The numpy NUDFT equals the direct phase sum for arbitrary power,
+    frequency scalings, and Doppler grids."""
+    from scintools_tpu.ops.nudft import nudft
+
+    nt, nf = power.shape
+    fscale = fs_slope * (1.0 + 0.05 * np.arange(nf) / nf)
+    tsrc = np.arange(nt, dtype=np.float64) * 1.5
+    nr = 6
+    got = np.asarray(nudft(power, fscale, tsrc, r0, dr, nr,
+                           backend="numpy"))
+    ks = np.arange(nr) * dr + r0
+    ph = np.exp(2j * np.pi * np.einsum("r,t,f->rtf", ks, tsrc, fscale))
+    want = np.einsum("rtf,tf->rf", ph, power)
+    scale = max(np.abs(want).max(), 1e-12)
+    assert np.max(np.abs(got - want)) < 1e-9 * scale
+
+
+@_SETTINGS
+@given(hnp.arrays(np.float64, (2, 12, 14),
+                  elements=st.floats(-100, 100, width=64)))
+def test_matmul_cuts_equal_fft_cuts(dyn):
+    """Gram-matrix diagonal sums == padded-FFT cuts for arbitrary
+    values (fixed shape: each new shape would recompile the jax path)."""
+    from scintools_tpu.ops.acf import acf_cuts_direct
+
+    ct, cf = acf_cuts_direct(dyn, backend="jax", method="fft")
+    ct_m, cf_m = acf_cuts_direct(dyn, backend="jax", method="matmul")
+    scale = max(float(np.abs(np.asarray(ct)).max()), 1e-9)
+    np.testing.assert_allclose(np.asarray(ct_m), np.asarray(ct),
+                               atol=1e-8 * scale + 1e-9)
+    np.testing.assert_allclose(np.asarray(cf_m), np.asarray(cf),
+                               atol=1e-8 * scale + 1e-9)

@@ -98,6 +98,15 @@ def test_store_resume_and_export(tmp_path):
     assert store.export_csv(csv_fn) == 1
     text = open(csv_fn).read()
     assert "tau,tauerr" in text and ",10.0," in text
+    # full export keeps name-less records (seed-keyed sim results) and
+    # every column; the reference-schema export must skip them
+    store.put(content_key(("seed", 5), ("cfg", 1)),
+              {"seed": 5, "m2": 0.4})
+    assert store.export_csv(csv_fn) == 1
+    assert store.export_csv(csv_fn, full=True) == 2
+    lines = open(csv_fn).read().strip().splitlines()
+    assert "seed" in lines[0] and "tau" in lines[0]
+    assert len(lines) == 3
 
 
 def test_content_key_sensitivity(tmp_path):
