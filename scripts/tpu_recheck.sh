@@ -44,12 +44,32 @@ got = np.asarray(nudft_pallas(power, fscale, tsrc, r0, dr, nr))
 err = np.max(np.abs(got - want)) / max(np.max(np.abs(want)), 1e-30)
 print('pallas on-chip rel err vs direct oracle:', err)
 assert err < 5e-3, err
+
+# experimental arc row-resample kernel (ops/resample_pallas): the
+# per-lane take_along_axis is the Mosaic risk — this is its first
+# hardware lowering; if it fails, the production scan path is
+# unaffected (the kernel is not wired into the fitter)
+from scintools_tpu.ops.resample_pallas import row_scrunch_pallas
+R, C, n = 96, 256, 128
+rows = rng.standard_normal((R, C))
+scales = np.sqrt(np.linspace(0.05, 1.0, R))
+pos = np.clip((np.linspace(-1, 1, n)[None] * scales[:, None] * 0.5
+               + 0.5) * (C - 1), 0, C - 2 + 0.999)
+i0 = np.clip(np.floor(pos).astype(np.int32), 0, C - 2)
+wgt = pos - i0
+v0 = np.take_along_axis(rows, i0, axis=1)
+v1 = np.take_along_axis(rows, i0 + 1, axis=1)
+want2 = np.nanmean(v0 * (1 - wgt) + v1 * wgt, axis=0)
+got2 = np.asarray(row_scrunch_pallas(rows, i0, wgt))
+err2 = np.max(np.abs(got2 - want2)) / max(np.max(np.abs(want2)), 1e-30)
+print('row-scrunch pallas on-chip rel err:', err2)
+assert err2 < 5e-3, err2
 " > "$pallas_out" 2>&1; then
   grep -v -E 'INFO|WARN|axon_|Logging|E0000' "$pallas_out" | tail -5
   echo "pallas lowering check FAILED"
   exit 1
 fi
-grep -v -E 'INFO|WARN|axon_|Logging|E0000' "$pallas_out" | tail -1
+grep -v -E 'INFO|WARN|axon_|Logging|E0000' "$pallas_out" | tail -2
 
 echo "== stage profile (bench shape) =="
 timeout -k 10 1800 python benchmarks/profile_stages.py --b 256 --iters 5 \

@@ -1,0 +1,66 @@
+"""Experimental Pallas row-resample prototype (ops/resample_pallas):
+interpret-mode equivalence with the production arc-fitter math.  The
+real-Mosaic lowering is gated in scripts/tpu_recheck.sh, not here (CPU
+CI cannot exercise it)."""
+
+import numpy as np
+import pytest
+
+from scintools_tpu.ops.resample_pallas import row_scrunch_pallas
+
+
+def _reference_scrunch(rows, i0, w):
+    v0 = np.take_along_axis(rows, i0, axis=1)
+    v1 = np.take_along_axis(rows, i0 + 1, axis=1)
+    nrm = v0 * (1.0 - w) + v1 * w
+    with np.errstate(invalid="ignore"):
+        return np.nanmean(nrm, axis=0)
+
+
+def _pattern(R, C, n, rng):
+    """Arc-fitter-like monotonic gather pattern with interp weights."""
+    scales = np.sqrt(np.linspace(0.05, 1.0, R))
+    pos = np.clip((np.linspace(-1, 1, n)[None, :] * scales[:, None]
+                   * 0.5 + 0.5) * (C - 1), 0, C - 2 + 0.999)
+    i0 = np.floor(pos).astype(np.int32)
+    return np.clip(i0, 0, C - 2), (pos - i0)
+
+
+def test_row_scrunch_matches_reference_math():
+    rng = np.random.default_rng(3)
+    R, C, n = 37, 48, 29
+    rows = rng.standard_normal((R, C))
+    rows[5, :] = np.nan                 # dead row
+    rows[:, 10] = np.nan                # cutmid-style dead column
+    i0, w = _pattern(R, C, n, rng)
+    want = _reference_scrunch(rows, i0, w)
+    got = np.asarray(row_scrunch_pallas(rows, i0, w, block_r=8,
+                                        interpret=True))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7,
+                               equal_nan=True)
+
+
+def test_row_scrunch_all_nan_bins_and_padding():
+    """Bins every row misses stay NaN; row padding to the block multiple
+    contributes nothing (R not a multiple of block_r)."""
+    rng = np.random.default_rng(4)
+    R, C, n = 11, 16, 8
+    rows = rng.standard_normal((R, C))
+    i0, w = _pattern(R, C, n, rng)
+    # genuinely all-NaN output bin: kill BOTH stencil columns of bin 3
+    # in every row, so cnt==0 there and the NaN branch must fire
+    for r in range(R):
+        rows[r, i0[r, 3]] = np.nan
+        rows[r, i0[r, 3] + 1] = np.nan
+    want = _reference_scrunch(rows, i0, w)
+    assert np.isnan(want[3])            # the scenario is real
+    got = np.asarray(row_scrunch_pallas(rows, i0, w, block_r=4,
+                                        interpret=True))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7,
+                               equal_nan=True)
+
+
+def test_row_scrunch_shape_validation():
+    with pytest.raises(ValueError, match="shape mismatch"):
+        row_scrunch_pallas(np.zeros((4, 8)), np.zeros((3, 5), np.int32),
+                           np.zeros((3, 5)), interpret=True)
