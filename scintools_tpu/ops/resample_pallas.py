@@ -8,8 +8,8 @@ The production paths are a full [B, R, n] XLA gather (arc_scrunch_rows
 kernel fuses gather + interpolate + NaN-masked accumulation in VMEM so
 the [rb, n] intermediates never touch HBM.
 
-Status: validated in INTERPRET mode only (tests/test_properties.py is
-CPU; `scripts/tpu_recheck.sh` carries the real-Mosaic lowering gate —
+Status: validated in INTERPRET mode only (tests/test_resample_pallas.py
+is CPU; `scripts/tpu_recheck.sh` carries the real-Mosaic lowering gate —
 the per-lane `take_along_axis` is exactly the op Mosaic may refuse or
 serialise, docs/roadmap.md).  NOT wired into make_arc_fitter until it
 measures faster on hardware; use `row_scrunch_pallas` directly to A/B.

@@ -205,6 +205,24 @@ def test_pipeline_gridmax_arc_method(epochs):
     assert np.all(np.isfinite(eta)) and np.all(eta > 0)
 
 
+def test_pipeline_thetatheta_chan_sharded(epochs):
+    """The eigen-concentration fitter runs on a chan-sharded secondary
+    spectrum (XLA gathers across the chan axis) and matches the
+    unsharded result."""
+    batch, _ = pad_batch(epochs)
+    freqs = np.asarray(epochs[0].freqs)
+    times = np.asarray(epochs[0].times)
+    cfg = PipelineConfig(arc_method="thetatheta",
+                         arc_constraint=(1.0, 50.0), arc_numsteps=32,
+                         fit_scint=False)
+    mesh = make_mesh((4, 2))
+    [(idx_m, res_m)] = run_pipeline(epochs, cfg, mesh=mesh)
+    res_p = make_pipeline(freqs, times, cfg)(np.asarray(batch.dyn))
+    np.testing.assert_array_equal(idx_m, np.arange(len(epochs)))
+    np.testing.assert_allclose(np.asarray(res_m.arc.eta),
+                               np.asarray(res_p.arc.eta), rtol=1e-6)
+
+
 def test_pipeline_thetatheta_validation():
     freqs = np.linspace(1300.0, 1500.0, 8)
     times = np.arange(16) * 8.0

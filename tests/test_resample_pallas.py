@@ -17,7 +17,7 @@ def _reference_scrunch(rows, i0, w):
         return np.nanmean(nrm, axis=0)
 
 
-def _pattern(R, C, n, rng):
+def _pattern(R, C, n):
     """Arc-fitter-like monotonic gather pattern with interp weights."""
     scales = np.sqrt(np.linspace(0.05, 1.0, R))
     pos = np.clip((np.linspace(-1, 1, n)[None, :] * scales[:, None]
@@ -32,7 +32,7 @@ def test_row_scrunch_matches_reference_math():
     rows = rng.standard_normal((R, C))
     rows[5, :] = np.nan                 # dead row
     rows[:, 10] = np.nan                # cutmid-style dead column
-    i0, w = _pattern(R, C, n, rng)
+    i0, w = _pattern(R, C, n)
     want = _reference_scrunch(rows, i0, w)
     got = np.asarray(row_scrunch_pallas(rows, i0, w, block_r=8,
                                         interpret=True))
@@ -46,7 +46,7 @@ def test_row_scrunch_all_nan_bins_and_padding():
     rng = np.random.default_rng(4)
     R, C, n = 11, 16, 8
     rows = rng.standard_normal((R, C))
-    i0, w = _pattern(R, C, n, rng)
+    i0, w = _pattern(R, C, n)
     # genuinely all-NaN output bin: kill BOTH stencil columns of bin 3
     # in every row, so cnt==0 there and the NaN branch must fire
     for r in range(R):
