@@ -1,0 +1,29 @@
+"""scintools-tpu: TPU-native pulsar-scintillation analysis framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of ramain/scintools
+(reference mounted at /root/reference): dynamic-spectrum ingest and
+cleaning, ACF and secondary spectra, scintillation-parameter and arc-
+curvature fitting, and Kolmogorov phase-screen simulation — with every
+kernel behind a ``backend=`` registry (numpy = reference-compatible CPU
+path; jax = jit/vmap/shard_map TPU path) and batch drivers that scale over
+device meshes.
+
+Unlike the reference's single mutable ``Dynspec`` class with plotting
+interleaved into compute (dynspec.py:29), the layers here are:
+
+    ops/       pure-functional kernels (numpy + jax backends)
+    models/    closed-form fit models + physics
+    fit/       fixed-iteration least squares, vmappable
+    sim/       phase-screen Monte Carlo (jit'd FFT propagator)
+    parallel/  mesh + sharding policy, padded batch pipeline
+    io/        psrflux / par / results / adapters (host-side)
+    astro/     analytic ephemeris (no astropy dependency)
+    pipeline   thin stateful Dynspec wrapper preserving the reference UX
+    plotting   matplotlib views, consuming results only
+"""
+
+__version__ = "0.1.0"
+
+from .backend import jax_available, resolve, xp  # noqa: F401
+from .data import ArcFit, DynspecData, ScintParams, SecSpec  # noqa: F401
+from .pipeline import Dynspec, sort_dyn  # noqa: F401

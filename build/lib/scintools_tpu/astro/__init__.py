@@ -1,0 +1,14 @@
+"""Analytic ephemeris utilities (self-contained; no astropy).
+
+Replaces the reference's astropy-based helpers (scint_utils.py:134-194,
+281-314) with a Standish mean-element ephemeris and a fixed-iteration
+Kepler solver that also run under jax tracing.
+"""
+
+from .ephemeris import (  # noqa: F401
+    earth_posvel,
+    get_earth_velocity,
+    get_ssb_delay,
+    get_true_anomaly,
+    solve_kepler,
+)

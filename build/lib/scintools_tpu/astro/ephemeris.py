@@ -1,0 +1,209 @@
+"""Analytic solar-system ephemeris: Earth barycentric position & velocity.
+
+The reference computes Earth's barycentric state with astropy
+(``get_earth_velocity`` / ``get_ssb_delay``, scint_utils.py:160-194,
+134-157).  astropy is not a dependency of this framework, so this module
+implements a self-contained analytic ephemeris:
+
+* Keplerian mean elements of the Earth-Moon barycenter and the four giant
+  planets (Standish's approximate elements, valid 1800-2050 AD, J2000
+  ecliptic frame), propagated with a fixed-iteration Newton Kepler solver
+  (jit/vmap-safe: no data-dependent control flow).
+* The Sun's offset from the solar-system barycenter is recovered from the
+  giant-planet positions (point-mass barycenter), so positions are
+  *barycentric*, not heliocentric.
+
+Accuracy (vs JPL ephemerides, dominated by the neglected Earth-Moon
+separation of ~4700 km and perturbations of the inner planets):
+position ~1e-4 AU (=> Romer-delay error <~0.1 s out of +-500 s), velocity
+~0.02 km/s (Earth's orbital speed is ~30 km/s; Earth's motion about the
+EMB contributes ~0.012 km/s).  This is far below the km/s-scale effective
+velocities the scintillation models fit (models/velocity.py), and well
+below typical vism uncertainties.
+
+All functions take MJD (TT ~ TDB to <2 ms) scalars or arrays and work on
+numpy by default; pass ``xp=jax.numpy`` for traced/jitted evaluation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+AU_KM = 1.495978707e8          # km
+AU_M = 1.495978707e11          # m
+C_M_S = 299792458.0            # m/s
+DAY_S = 86400.0
+_OBLIQUITY_J2000 = np.deg2rad(23.439291111)
+
+# Standish approximate Keplerian elements, 1800-2050 AD (public JPL tables):
+# a [AU], e, I [deg], L [deg], long.peri [deg], Omega [deg]; value + rate
+# per Julian century from J2000.
+_ELEMENTS = {
+    "emb": ((1.00000261, 0.00000562), (0.01671123, -0.00004392),
+            (-0.00001531, -0.01294668), (100.46457166, 35999.37244981),
+            (102.93768193, 0.32327364), (0.0, 0.0)),
+    "jupiter": ((5.20288700, -0.00011607), (0.04838624, -0.00013253),
+                (1.30439695, -0.00183714), (34.39644051, 3034.74612775),
+                (14.72847983, 0.21252668), (100.47390909, 0.20469106)),
+    "saturn": ((9.53667594, -0.00125060), (0.05386179, -0.00050991),
+               (2.48599187, 0.00193609), (49.95424423, 1222.49362201),
+               (92.59887831, -0.41897216), (113.66242448, -0.28867794)),
+    "uranus": ((19.18916464, -0.00196176), (0.04725744, -0.00004397),
+               (0.77263783, -0.00242939), (313.23810451, 428.48202785),
+               (170.95427630, 0.40805281), (74.01692503, 0.04240589)),
+    "neptune": ((30.06992276, 0.00026291), (0.00859048, 0.00005105),
+                (1.77004347, 0.00035372), (-55.12002969, 218.45945325),
+                (44.96476227, -0.32241464), (131.78422574, -0.00508664)),
+}
+
+# planet/Sun mass ratios (IAU nominal values)
+_MASS_RATIO = {"jupiter": 9.5479194e-4, "saturn": 2.8588567e-4,
+               "uranus": 4.3662440e-5, "neptune": 5.1513890e-5}
+
+
+def solve_kepler(M, e, xp=np, iters: int = 15):
+    """Eccentric anomaly E from mean anomaly M (radians): fixed-iteration
+    Newton, jit/vmap-safe (converges to machine precision for e < 0.95 in
+    well under 15 iterations)."""
+    E = M + e * xp.sin(M)
+    for _ in range(iters):
+        E = E - (E - e * xp.sin(E) - M) / (1.0 - e * xp.cos(E))
+    return E
+
+
+def _body_posvel_ecliptic(body: str, mjd, xp=np):
+    """Heliocentric position [AU] and velocity [AU/day] in the J2000
+    ecliptic frame from the mean elements.  mjd may be an array."""
+    mjd = xp.asarray(mjd, dtype=np.float64)
+    T = (mjd - 51544.5) / 36525.0  # Julian centuries from J2000.0
+    (a0, ad), (e0, ed), (i0, idot), (L0, Ld), (w0, wd), (O0, Od) = \
+        _ELEMENTS[body]
+    a = a0 + ad * T
+    e = e0 + ed * T
+    inc = xp.deg2rad(i0 + idot * T)
+    L = xp.deg2rad(L0 + Ld * T)
+    lperi = xp.deg2rad(w0 + wd * T)
+    Omega = xp.deg2rad(O0 + Od * T)
+    omega = lperi - Omega
+    M = xp.mod(L - lperi + np.pi, 2 * np.pi) - np.pi
+
+    E = solve_kepler(M, e, xp=xp)
+    cosE, sinE = xp.cos(E), xp.sin(E)
+    b_over_a = xp.sqrt(1.0 - e ** 2)
+    xo = a * (cosE - e)
+    yo = a * b_over_a * sinE
+
+    # d/dt: mean motion from the L rate (rad/day); element rates are
+    # negligible over one sample (they matter only through M above)
+    n = xp.deg2rad(Ld - wd) / 36525.0
+    Edot = n / (1.0 - e * cosE)
+    vxo = -a * sinE * Edot
+    vyo = a * b_over_a * cosE * Edot
+
+    co, so = xp.cos(omega), xp.sin(omega)
+    cO, sO = xp.cos(Omega), xp.sin(Omega)
+    ci, si = xp.cos(inc), xp.sin(inc)
+    r11 = co * cO - so * sO * ci
+    r12 = -so * cO - co * sO * ci
+    r21 = co * sO + so * cO * ci
+    r22 = -so * sO + co * cO * ci
+    r31 = so * si
+    r32 = co * si
+
+    def rot(px, py):
+        return (r11 * px + r12 * py, r21 * px + r22 * py, r31 * px + r32 * py)
+
+    return rot(xo, yo), rot(vxo, vyo)
+
+
+def _ecliptic_to_equatorial(vec3, xp=np):
+    x, y, z = vec3
+    ce, se = np.cos(_OBLIQUITY_J2000), np.sin(_OBLIQUITY_J2000)
+    return x, ce * y - se * z, se * y + ce * z
+
+
+def earth_posvel(mjd, xp=np):
+    """Earth barycentric (SSB) position [AU] and velocity [AU/day] in the
+    J2000 *equatorial* frame, as two (x, y, z) tuples of arrays.
+
+    Earth is approximated by the Earth-Moon barycenter; the Sun's offset
+    from the SSB is reconstructed from the four giant planets.
+    """
+    (ex, ey, ez), (evx, evy, evz) = _body_posvel_ecliptic("emb", mjd, xp=xp)
+    # Sun wrt SSB = -sum(m_p/M_tot * r_p heliocentric)
+    mtot = 1.0 + sum(_MASS_RATIO.values())
+    sx = sy = sz = svx = svy = svz = 0.0
+    for body, mu in _MASS_RATIO.items():
+        (px, py, pz), (pvx, pvy, pvz) = _body_posvel_ecliptic(body, mjd,
+                                                              xp=xp)
+        f = mu / mtot
+        sx, sy, sz = sx - f * px, sy - f * py, sz - f * pz
+        svx, svy, svz = svx - f * pvx, svy - f * pvy, svz - f * pvz
+    pos = _ecliptic_to_equatorial((ex + sx, ey + sy, ez + sz), xp=xp)
+    vel = _ecliptic_to_equatorial((evx + svx, evy + svy, evz + svz), xp=xp)
+    return pos, vel
+
+
+def _radec_basis(raj: float, decj: float, xp=np):
+    """Unit vectors: line of sight n, +RA (east) and +DEC (north) tangent
+    directions, in the J2000 equatorial frame.  raj/decj in radians."""
+    cr, sr = xp.cos(raj), xp.sin(raj)
+    cd, sd = xp.cos(decj), xp.sin(decj)
+    n = (cd * cr, cd * sr, sd)
+    e_ra = (-sr, cr, 0.0)
+    e_dec = (-cr * sd, -sr * sd, cd)
+    return n, e_ra, e_dec
+
+
+def get_earth_velocity(mjds, raj: float, decj: float, xp=np):
+    """Earth's barycentric velocity projected on the +RA / +DEC sky
+    directions of a source, in km/s (reference: scint_utils.py:160-194,
+    which uses astropy ``get_body_barycentric_posvel``).
+
+    Parameters: mjds array, raj/decj in radians.
+    Returns (vearth_ra, vearth_dec) arrays in km/s.
+    """
+    _, (vx, vy, vz) = earth_posvel(mjds, xp=xp)
+    _, e_ra, e_dec = _radec_basis(raj, decj, xp=xp)
+    to_kms = AU_KM / DAY_S
+    v_ra = (vx * e_ra[0] + vy * e_ra[1] + vz * e_ra[2]) * to_kms
+    v_dec = (vx * e_dec[0] + vy * e_dec[1] + vz * e_dec[2]) * to_kms
+    return v_ra, v_dec
+
+
+def get_ssb_delay(mjds, raj: float, decj: float, xp=np):
+    """Romer delay (s) from the geocenter to the solar-system barycenter
+    for a source at (raj, decj) radians (reference: scint_utils.py:134-157).
+
+    Positive when Earth is on the source side of the SSB: barycentric
+    arrival time = topocentric MJD + delay/86400.
+    """
+    (x, y, z), _ = earth_posvel(mjds, xp=xp)
+    n, _, _ = _radec_basis(raj, decj, xp=xp)
+    return (x * n[0] + y * n[1] + z * n[2]) * AU_M / C_M_S
+
+
+def get_true_anomaly(mjds, pars: dict, xp=np):
+    """True anomaly of the pulsar orbit at each MJD (reference:
+    scint_utils.py:281-314, which fsolves Kepler per epoch; here a
+    fixed-iteration Newton solve, vmap-safe).
+
+    ``pars`` needs T0 [MJD], PB [days], ECC; optional PBDOT (s/s, as in
+    tempo2 par files — the reference applies the same 1e-12 heuristic for
+    values given in 1e-12 s/s units, replicated here).
+    """
+    mjds = xp.asarray(mjds, dtype=np.float64)
+    T0, PB = pars["T0"], pars["PB"]
+    ECC = pars.get("ECC", 0.0)
+    PBDOT = pars.get("PBDOT", 0.0)
+    if abs(PBDOT) > 1e-6:  # given in units of 1e-12 s/s
+        PBDOT = PBDOT * 1e-12
+    nb = 2 * np.pi / PB  # rad/day
+
+    tsince = mjds - T0
+    # mean anomaly with linear period derivative (d(PB)/dt = PBDOT)
+    M = nb * (tsince - 0.5 * (PBDOT / PB) * tsince ** 2)
+    M = xp.mod(M, 2 * np.pi)
+    E = solve_kepler(M, ECC, xp=xp)
+    return 2 * xp.arctan2(xp.sqrt(1 + ECC) * xp.sin(E / 2),
+                          xp.sqrt(1 - ECC) * xp.cos(E / 2))

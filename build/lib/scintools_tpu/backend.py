@@ -1,0 +1,149 @@
+"""Backend registry: every kernel in scintools-tpu dispatches through here.
+
+The reference (ramain/scintools) hardwires NumPy/SciPy into its methods
+(e.g. ``np.fft.fft2`` at ``dynspec.py:1286,1351``).  We instead expose each
+kernel as a pure function taking ``backend=`` so the same pipeline runs:
+
+* ``"numpy"``  — CPU path, bit-matching the reference semantics (default);
+* ``"jax"``    — TPU/XLA path: jit-compiled, vmap/shard_map-able.
+
+``"auto"`` resolves to jax when an accelerator is present, else numpy.
+
+JAX import is lazy so the numpy path works on machines without jax, and so
+test harnesses can set ``JAX_PLATFORMS`` / ``XLA_FLAGS`` before first import.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+NUMPY = "numpy"
+JAX = "jax"
+
+_VALID = (NUMPY, JAX)
+
+
+class BackendError(ValueError):
+    pass
+
+
+@functools.lru_cache(maxsize=1)
+def _jax_modules():
+    import jax
+    import jax.numpy as jnp
+
+    return jax, jnp
+
+
+def jax_available() -> bool:
+    try:
+        _jax_modules()
+        return True
+    except Exception:  # pragma: no cover - jax is installed in CI
+        return False
+
+
+@functools.lru_cache(maxsize=1)
+def has_accelerator() -> bool:
+    """True when jax sees a non-CPU device (TPU here; axon tunnel included)."""
+    if not jax_available():
+        return False
+    jax, _ = _jax_modules()
+    try:
+        return any(d.platform != "cpu" for d in jax.devices())
+    except Exception:  # pragma: no cover
+        return False
+
+
+def resolve(backend: str | None) -> str:
+    """Normalise a backend name. ``None``/"auto" -> jax if an accelerator
+    is attached, else numpy (the reference-compatible default)."""
+    if backend is None or backend == "auto":
+        return JAX if has_accelerator() else NUMPY
+    if backend not in _VALID:
+        raise BackendError(
+            f"unknown backend {backend!r}; expected one of {_VALID} or 'auto'")
+    if backend == JAX and not jax_available():
+        raise BackendError("jax backend requested but jax is not importable")
+    return backend
+
+
+def xp(backend: str):
+    """Return the array namespace (numpy or jax.numpy) for a backend."""
+    backend = resolve(backend)
+    if backend == NUMPY:
+        return np
+    return _jax_modules()[1]
+
+
+def to_numpy(a):
+    """Device -> host: materialise any array as numpy (no-op for numpy)."""
+    return np.asarray(a)
+
+
+def default_float(backend: str):
+    """numpy path keeps the reference's float64; jax follows the global
+    x64 flag (f32 on TPU unless tests enable x64)."""
+    backend = resolve(backend)
+    if backend == NUMPY:
+        return np.float64
+    _, jnp = _jax_modules()
+    return jnp.zeros(0).dtype
+
+
+def force_host_cpu_devices(n: int) -> None:
+    """Force the CPU platform with ``n`` virtual XLA host devices.
+
+    Used by the test harness and the multi-chip dry run to validate
+    mesh/shard_map code without TPU hardware (SURVEY.md §4.5).  The axon
+    sitecustomize imports jax at interpreter boot with JAX_PLATFORMS=axon,
+    so env vars set by a caller can arrive too late; we both rewrite
+    XLA_FLAGS (read at backend initialisation) and switch the platform
+    through the config (backends initialise lazily, so this wins as long
+    as no jax.devices() call has happened yet in the process).
+    """
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    count = max(n, int(m.group(1))) if m else n
+    opt = f"--xla_force_host_platform_device_count={count}"
+    if m:
+        flags = flags[:m.start()] + opt + flags[m.end():]
+    else:
+        flags = (flags + " " + opt).strip()
+    os.environ["XLA_FLAGS"] = flags
+
+    jax, _ = _jax_modules()
+    jax.config.update("jax_platforms", "cpu")
+
+
+def honor_platform_env() -> None:
+    """Apply ``JAX_PLATFORMS`` through jax's config (idempotent).
+
+    Under the axon sitecustomize the env var alone is unreliable: the
+    plugin is registered at interpreter boot, and backend discovery can
+    still touch the (possibly unreachable) TPU tunnel even when the env
+    asks for cpu.  Routing the same choice through ``jax.config`` makes
+    ``JAX_PLATFORMS=cpu python ...`` actually local-only.  Call before
+    the first ``jax.devices()`` (entry points: CLI, examples).
+    """
+    plat = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if plat == "cpu" and jax_available():
+        # only ever FORCE the local platform: accelerator platforms are
+        # jax's default resolution anyway, and re-applying e.g. "axon"
+        # inside a process that deliberately switched to cpu (tests,
+        # notebook under pytest) would point it back at the tunnel
+        jax, _ = _jax_modules()
+        jax.config.update("jax_platforms", plat)
+
+
+def jit(fun=None, **kwargs):
+    """``jax.jit`` that is importable without jax (used at call time only)."""
+    if fun is None:
+        return functools.partial(jit, **kwargs)
+    jax, _ = _jax_modules()
+    return jax.jit(fun, **kwargs)

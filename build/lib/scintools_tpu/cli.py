@@ -1,0 +1,725 @@
+"""Command-line interface.
+
+The reference ships an argparse stub with zero arguments that does
+nothing (scintools/scintools.py:1-16).  This is the real CLI planned in
+SURVEY.md §5: ``info`` / ``process`` / ``sort`` / ``sim`` /
+``curvature`` / ``wavefield`` / ``bench``.
+
+    python -m scintools_tpu process obs1.dynspec obs2.dynspec \
+        --lamsteps --backend jax --results results.csv --store runs/survey
+
+``process`` is resumable: with ``--store`` each finished epoch is written
+to a content-hash-keyed store, and a rerun skips everything already done.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import sys
+
+
+def _expand(patterns: list[str]) -> list[str]:
+    from .utils import remove_duplicates
+
+    out = []
+    for p in patterns:
+        hits = sorted(glob.glob(p))
+        out.extend(hits if hits else [p])
+    return remove_duplicates(out)
+
+
+def cmd_info(args) -> int:
+    from .pipeline import Dynspec
+
+    rc = 0
+    for fn in _expand(args.files):
+        try:
+            Dynspec(filename=fn, process=False).info()
+        except Exception as e:
+            print(f"{fn}: unreadable ({e!r})", file=sys.stderr)
+            rc = 1
+    return rc
+
+
+def cmd_process(args) -> int:
+    from .pipeline import Dynspec
+    from .io.results import results_row, write_results
+    from .utils import (ResultsStore, StageTimers, content_key, get_logger,
+                        log_event)
+
+    log = get_logger()
+    timers = StageTimers()
+    files = _expand(args.files)
+    store = ResultsStore(args.store) if args.store else None
+    if args.batched and args.backend != "jax":
+        # the batched engine IS the jax pipeline; record that truthfully
+        # in the resume key rather than diverging silently
+        log_event(log, "note",
+                  msg="--batched runs the jax device pipeline; "
+                      "backend set to jax")
+        args.backend = "jax"
+    cfg = ("process", args.lamsteps, args.backend, not args.no_arc,
+           not args.no_scint)
+    # non-default estimator settings enter the resume key (different
+    # estimators are different results); defaults keep the legacy key so
+    # existing stores still resume
+    arc_method = getattr(args, "arc_method", "norm_sspec")
+    arc_bracket = getattr(args, "arc_bracket", None)
+    scint_2d = getattr(args, "scint_2d", False)
+    if scint_2d:
+        cfg += ("scint2d",)
+    # fail fast on estimator misconfiguration, before any file I/O
+    if arc_bracket is not None and not (0 < arc_bracket[0]
+                                        < arc_bracket[1]):
+        raise SystemExit(f"--arc-bracket must be 0 < LO < HI, got "
+                         f"{arc_bracket[0]} {arc_bracket[1]}")
+    if (arc_method == "thetatheta" and not args.no_arc
+            and arc_bracket is None):
+        raise SystemExit("--arc-method thetatheta requires "
+                         "--arc-bracket LO HI (the curvature sweep "
+                         "range)")
+    if arc_method != "norm_sspec" or arc_bracket is not None:
+        cfg += (arc_method, tuple(arc_bracket or ()))
+    # prerequisite checks stay ahead of the plots mkdir and the store
+    # resume scan (which hashes every input file): truly fail-fast
+    if not args.batched:
+        for flag, name in ((getattr(args, "mesh", None), "--mesh"),
+                           (getattr(args, "chunk_epochs", None),
+                            "--chunk-epochs")):
+            if flag is not None:
+                raise SystemExit(f"{name} only applies to the batched "
+                                 "engine; add --batched")
+    if getattr(args, "full_csv", False) and not (args.store
+                                                 and args.results):
+        raise SystemExit("--full-csv exports the store's columns: it "
+                         "needs both --store and --results")
+    if args.plots:
+        import os
+
+        os.makedirs(args.plots, exist_ok=True)
+    if store is not None:
+        todo = store.pending(files, lambda f: content_key(f, cfg))
+        log_event(log, "resume", total=len(files), todo=len(todo),
+                  done=len(files) - len(todo))
+        files = todo
+    if args.batched:
+        if args.plots:
+            raise SystemExit("--batched does not render per-epoch plots; "
+                             "drop --plots or run without --batched")
+        return _process_batched(args, files, cfg, store, log, timers)
+    failed = 0
+    for fn in files:
+        try:
+            with timers.stage("load+process"):
+                ds = Dynspec(filename=fn, process=True,
+                             lamsteps=args.lamsteps, backend=args.backend)
+            scint = arc = None
+            tilt_row = {}
+            if not args.no_scint:
+                with timers.stage("scint_fit"):
+                    scint = ds.get_scint_params()
+            if scint_2d:
+                with timers.stage("scint_fit_2d"):
+                    import math
+
+                    ds.get_scint_params(method="acf2d")
+                    if not math.isfinite(float(ds.tilt)):
+                        # quarantine like any failed fit (retried on
+                        # resume), not stored as a NaN result
+                        raise ValueError(
+                            "2-D ACF fit returned non-finite tilt")
+                    tilt_row = dict(tilt=float(ds.tilt),
+                                    tilterr=float(ds.tilterr))
+            if not args.no_arc:
+                with timers.stage("arc_fit"):
+                    fkw = {"method": arc_method}
+                    if arc_bracket is not None:
+                        if arc_method == "thetatheta":
+                            fkw["etamin"], fkw["etamax"] = arc_bracket
+                        else:
+                            fkw["constraint"] = tuple(arc_bracket)
+                    if arc_method == "thetatheta":
+                        # Dynspec.fit_arc's numsteps default (10000) sizes
+                        # the power-profile grid; the concentration sweep
+                        # needs ~128 (same cap the batched driver applies)
+                        fkw["numsteps"] = 128
+                    arc = ds.fit_arc(lamsteps=args.lamsteps, **fkw)
+            row = results_row(ds.data, scint=scint, arc=arc)
+            row.update(tilt_row)   # store rows only; CSV keeps the
+            #                        reference schema (as eta_left does)
+            if args.plots:
+                with timers.stage("plots"):
+                    import matplotlib
+
+                    matplotlib.use("Agg")
+                    ds.plot_all(filename=f"{args.plots}/"
+                                f"{row['name']}_all.png")
+            # store.put last: an epoch only counts as done once all its
+            # artefacts (CSV row comes from the store on export) exist
+            if args.results:
+                write_results(args.results, row)
+            if store is not None:
+                store.put(content_key(fn, cfg), row)
+            log_event(log, "epoch", file=fn,
+                      tau=row.get("tau"), dnu=row.get("dnu"),
+                      eta=row.get("betaeta", row.get("eta")))
+        except Exception as e:  # quarantine; keep the batch going
+            failed += 1
+            log_event(log, "epoch_failed", file=fn, error=repr(e))
+    if store is not None and args.results:
+        store.export_csv(args.results,
+                         full=getattr(args, "full_csv", False))
+    print(timers.report(), file=sys.stderr)
+    log_event(log, "done", processed=len(files) - failed, failed=failed)
+    return 0 if failed == 0 else 1
+
+
+def _process_batched(args, files, cfg, store, log, timers) -> int:
+    """Batched engine for cmd_process: trim/refill host-side, then ONE
+    jit-compiled step per shape bucket over the device mesh
+    (parallel.run_pipeline) instead of a per-file Python loop."""
+    import os
+
+    import numpy as np
+
+    from .io.psrflux import read_psrflux
+    from .io.results import results_row, write_results
+    from .ops.clean import refill, trim_edges
+    from .parallel import PipelineConfig, make_mesh, run_pipeline
+    from .utils import content_key, log_event
+
+    epochs, names, failed = [], [], 0
+    with timers.stage("load+clean"):
+        for fn in files:
+            try:
+                d = refill(trim_edges(read_psrflux(fn)))
+                if d.nchan < 2 or d.nsub < 2:
+                    raise ValueError(
+                        f"degenerate after trim: {d.nchan}x{d.nsub}")
+                epochs.append(d)
+                names.append(fn)
+            except Exception as e:
+                failed += 1
+                log_event(log, "epoch_failed", file=fn, error=repr(e))
+    processed = 0
+    if epochs:
+        pkw = dict(lamsteps=args.lamsteps,
+                   fit_arc=not args.no_arc,
+                   fit_scint=not args.no_scint,
+                   fit_scint_2d=getattr(args, "scint_2d", False),
+                   arc_asymm=getattr(args, "arc_asymm", False),
+                   arc_method=getattr(args, "arc_method", "norm_sspec"))
+        bracket = getattr(args, "arc_bracket", None)
+        if bracket is not None:
+            pkw["arc_constraint"] = (float(bracket[0]), float(bracket[1]))
+        pcfg = PipelineConfig(**pkw)
+        mesh_shape = getattr(args, "mesh", None)
+        try:
+            # inside the quarantine handler: an invalid --mesh for this
+            # host's device count must fail like any pipeline failure
+            # (logged, rc=1), not as a raw traceback
+            mesh = (make_mesh(tuple(int(x) for x in mesh_shape))
+                    if mesh_shape else make_mesh())
+            with timers.stage("batched_pipeline"):
+                buckets = run_pipeline(
+                    epochs, pcfg, mesh=mesh,
+                    chunk=getattr(args, "chunk_epochs", None))
+        except Exception as e:
+            log_event(log, "pipeline_failed", error=repr(e),
+                      epochs=len(epochs))
+            failed += len(epochs)
+            buckets = []
+        for indices, res in buckets:
+            for lane, idx in enumerate(indices):
+                row = results_row(epochs[idx])
+                if res.scint is not None:
+                    row.update(
+                        tau=float(np.asarray(res.scint.tau)[lane]),
+                        tauerr=float(np.asarray(res.scint.tauerr)[lane]),
+                        dnu=float(np.asarray(res.scint.dnu)[lane]),
+                        dnuerr=float(np.asarray(res.scint.dnuerr)[lane]))
+                if res.arc is not None:
+                    key = "betaeta" if args.lamsteps else "eta"
+                    row[key] = float(np.asarray(res.arc.eta)[lane])
+                    row[key + "err"] = float(
+                        np.asarray(res.arc.etaerr)[lane])
+                    if res.arc.eta_left is not None:
+                        # per-arm values go to the store rows only (the
+                        # CSV keeps the reference schema)
+                        for arm in ("eta_left", "etaerr_left",
+                                    "eta_right", "etaerr_right"):
+                            row[arm] = float(
+                                np.asarray(getattr(res.arc, arm))[lane])
+                if res.tilt is not None:
+                    # store rows only, like the per-arm values
+                    row["tilt"] = float(np.asarray(res.tilt)[lane])
+                    row["tilterr"] = float(np.asarray(res.tilterr)[lane])
+                # NaN lanes are FAILED fits: quarantine (no CSV row, no
+                # store entry -> retried on resume), as the per-file loop
+                # does via exceptions
+                fitvals = [v for k, v in row.items()
+                           if k in ("tau", "dnu", "eta", "betaeta",
+                                    "tilt")]
+                if fitvals and not np.all(np.isfinite(fitvals)):
+                    failed += 1
+                    log_event(log, "epoch_failed", file=names[idx],
+                              error="non-finite fit (NaN lane)")
+                    continue
+                # basename, matching the per-file loop's CSV name column
+                row["name"] = os.path.basename(names[idx])
+                if args.results:
+                    write_results(args.results, row)
+                if store is not None:
+                    store.put(content_key(names[idx], cfg), row)
+                processed += 1
+                log_event(log, "epoch", file=names[idx],
+                          tau=row.get("tau"),
+                          eta=row.get("betaeta", row.get("eta")))
+    if store is not None and args.results:
+        store.export_csv(args.results,
+                         full=getattr(args, "full_csv", False))
+    print(timers.report(), file=sys.stderr)
+    log_event(log, "done", processed=processed, failed=failed)
+    return 0 if failed == 0 else 1
+
+
+def cmd_sort(args) -> int:
+    from .pipeline import sort_dyn
+
+    good, bad = sort_dyn(_expand(args.files), outdir=args.outdir,
+                         min_nsub=args.min_nsub, min_nchan=args.min_nchan,
+                         min_freq=args.min_freq, max_freq=args.max_freq,
+                         verbose=args.verbose)
+    print(json.dumps({"good": len(good), "bad": len(bad)}))
+    return 0
+
+
+def cmd_sim(args) -> int:
+    from .io import from_simulation
+    from .io.psrflux import write_psrflux
+    from .sim import Simulation
+
+    sim = Simulation(mb2=args.mb2, rf=args.rf, ds=args.ds,
+                     alpha=args.alpha, ar=args.ar, psi=args.psi,
+                     inner=args.inner, ns=args.ns, nf=args.nf,
+                     dlam=args.dlam, seed=args.seed, backend=args.backend)
+    d = from_simulation(sim, freq=args.freq, dt=args.dt)
+    write_psrflux(d, args.out)
+    print(json.dumps({"out": args.out, "nchan": d.nchan, "nsub": d.nsub}))
+    return 0
+
+
+def cmd_curvature(args) -> int:
+    """Fit physical screen parameters to a survey's curvature series.
+
+    The reference ships the ``arc_curvature`` residual model but leaves
+    the actual annual-variation fit to user notebooks; this completes
+    the workflow: results CSV (from ``process --lamsteps``) + par file
+    -> screen fraction / velocity / anisotropy with errors, as JSON.
+    """
+    import numpy as np
+
+    from .fit import fit_arc_curvature
+    from .io.parfile import pars_to_params, read_par
+    from .io.results import float_array_from_dict, read_results
+
+    res = read_results(args.results)
+    if "betaeta" not in res:
+        raise SystemExit(
+            "curvature fitting needs the 'betaeta' column (lamsteps "
+            "curvature, 1/(m mHz^2) — the model's units); run "
+            "process --lamsteps to produce it")
+    mjd = float_array_from_dict(res, "mjd")
+    eta = float_array_from_dict(res, "betaeta")
+    etaerr = (float_array_from_dict(res, "betaetaerr")
+              if "betaetaerr" in res else None)
+    keep = np.isfinite(mjd) & np.isfinite(eta) & (eta > 0)
+    if etaerr is not None:
+        keep &= np.isfinite(etaerr) & (etaerr > 0)
+    if int(keep.sum()) < len(args.fit) + 1:
+        raise SystemExit(f"only {int(keep.sum())} usable epochs in "
+                         f"{args.results} for {len(args.fit)} fitted "
+                         "parameters")
+    mjd, eta = mjd[keep], eta[keep]
+    if etaerr is not None:
+        etaerr = etaerr[keep]
+
+    pars = pars_to_params(read_par(args.par))
+    raj, decj = pars.get("RAJ"), pars.get("DECJ")
+    if raj is None or decj is None:
+        raise SystemExit(f"{args.par} needs RAJ/DECJ (source position "
+                         "for the Earth-velocity projection)")
+    # screen starting values: par-file distance if present, then --start
+    _SCREEN_KEYS = ("s", "d", "psi", "vism_psi", "vism_ra", "vism_dec")
+    pars.setdefault("d", float(pars.get("DIST", 1.0)))
+    pars.setdefault("s", 0.5)
+    for k in args.fit:
+        if k.startswith("vism_"):
+            pars.setdefault(k, 0.0)
+    if "psi" in args.fit:
+        pars.setdefault("psi", 45.0)   # start only; optimised away
+    user_start = set()
+    for kv in args.start or []:
+        k, sep, v = kv.partition("=")
+        if not sep or k not in _SCREEN_KEYS:
+            raise SystemExit(
+                f"--start takes KEY=VALUE pairs with KEY in "
+                f"{'/'.join(_SCREEN_KEYS)}, got {kv!r}")
+        try:
+            pars[k] = float(v)
+        except ValueError:
+            raise SystemExit(f"--start {k}: {v!r} is not a number")
+        user_start.add(k)
+    # The model has two mutually exclusive screen-velocity branches
+    # (models/velocity.py): psi present -> ANISOTROPIC, reads vism_psi
+    # only; psi absent -> isotropic, reads vism_ra/vism_dec only.
+    # Reject every combination where a user-supplied velocity would be
+    # silently ignored, instead of fitting a dead parameter.
+    wants = lambda k: k in args.fit or k in user_start  # noqa: E731
+    aniso = wants("vism_psi")
+    iso = wants("vism_ra") or wants("vism_dec")
+    if aniso and iso:
+        raise SystemExit(
+            "vism_psi (anisotropic screen) and vism_ra/vism_dec "
+            "(isotropic screen) are mutually exclusive model branches; "
+            "use one or the other")
+    if aniso and "psi" not in pars:
+        raise SystemExit(
+            "using vism_psi needs the anisotropy axis psi: pass "
+            "--start psi=<deg> (fixed) or add psi to --fit")
+    if iso and "psi" in pars:
+        raise SystemExit(
+            "psi selects the anisotropic branch, which ignores "
+            "vism_ra/vism_dec; drop psi or fit vism_psi instead")
+
+    best, errors, fitres = fit_arc_curvature(
+        eta, mjd, pars, raj, decj, fit_keys=tuple(args.fit),
+        etaerr=etaerr, backend=args.backend)
+
+    def _num(x):
+        # strict machine-readable stdout: a singular covariance yields
+        # inf/NaN stderr, which json.dumps would emit as invalid JSON
+        x = float(x)
+        return x if np.isfinite(x) else None
+
+    print(json.dumps({
+        "n_epochs": int(len(mjd)),
+        "fit": {k: {"value": _num(best[k]), "err": _num(errors[k])}
+                for k in args.fit},
+        "cost": _num(np.asarray(fitres.cost)),
+    }, allow_nan=False))
+
+    if args.plot:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        from .astro import get_earth_velocity, get_true_anomaly
+        from .models.velocity import arc_curvature_model
+
+        grid = np.linspace(mjd.min(), mjd.max(), 500)
+        nu = (get_true_anomaly(grid, best) if "PB" in best
+              else np.zeros_like(grid))
+        v_ra, v_dec = get_earth_velocity(grid, raj, decj)
+        model = arc_curvature_model(best, nu, v_ra, v_dec)
+        fig, ax = plt.subplots(figsize=(8, 4))
+        if etaerr is not None:
+            ax.errorbar(mjd, eta, yerr=etaerr, fmt="o", ms=4,
+                        label="measured")
+        else:
+            ax.plot(mjd, eta, "o", ms=4, label="measured")
+        ax.plot(grid, model, "-", label="screen model")
+        ax.set_xlabel("MJD")
+        ax.set_ylabel(r"$\beta$-curvature (1/(m mHz$^2$))")
+        ax.legend()
+        fig.tight_layout()
+        fig.savefig(args.plot, dpi=120)
+        plt.close(fig)
+    return 0
+
+
+def cmd_wavefield(args) -> int:
+    import numpy as np
+
+    from .backend import resolve
+    from .pipeline import Dynspec
+
+    files = _expand(args.files)
+    if args.out and len(files) != 1:
+        print(f"--out needs exactly one input file (got {len(files)}); "
+              f"omit it to write per-file <name>.wavefield.npz",
+              file=sys.stderr)
+        return 1
+    if args.plots:
+        import matplotlib
+
+        matplotlib.use("Agg")
+
+    # phase 1: load + process + curvature per file.  Only the light
+    # DynspecData survives this loop (the Dynspec wrapper's ACF/sspec
+    # caches are dropped with it) — grouping needs all epochs' grids
+    # before any retrieval can be batched.
+    epochs, rc = [], 0
+    for fn in files:
+        try:
+            ds = Dynspec(filename=fn, process=True, backend=args.backend)
+            if args.eta is not None:
+                eta = float(args.eta)
+            else:
+                ds.fit_arc(method="thetatheta", lamsteps=False,
+                           etamin=args.etamin, etamax=args.etamax,
+                           numsteps=args.numsteps)
+                eta = float(ds.eta)
+            epochs.append((fn, ds.data, eta))
+        except Exception as e:
+            print(f"{fn}: wavefield retrieval failed ({e})",
+                  file=sys.stderr)
+            rc = 1
+
+    def persist(fn, data, eta, wf, nbatch) -> None:
+        dyn = np.asarray(data.dyn, dtype=np.float64)
+        corr = float(np.corrcoef(dyn.ravel(),
+                                 wf.model_dynspec.ravel())[0, 1])
+        base = fn.rsplit(".", 1)[0]
+        out = args.out if args.out else f"{base}.wavefield.npz"
+        wf.save(out)
+        if args.plots:
+            import matplotlib.pyplot as plt
+
+            from . import plotting
+
+            plotting.plot_wavefield(wf, filename=f"{base}.wavefield.png")
+            plotting.plot_sspec(wf.secspec(), eta=eta,
+                                filename=f"{base}.wavefield_sspec.png")
+            plt.close("all")
+        print(json.dumps({
+            "file": fn, "eta": eta, "corr": round(corr, 4),
+            "conc_mean": round(float(wf.conc.mean()), 4),
+            "ntheta": len(wf.theta), "batch": nbatch, "out": out}))
+
+    # phase 2: retrieval + streaming persist per group — equal-grid
+    # epochs on the jax backend go through retrieve_wavefield_batch
+    # (every chunk of every epoch in ONE compiled program); others stay
+    # per-file, each isolated in its own try
+    from .fit.wavefield import retrieve_wavefield, \
+        retrieve_wavefield_batch
+
+    groups: dict = {}
+    for item in epochs:
+        f = np.asarray(item[1].freqs, dtype=np.float64)
+        t = np.asarray(item[1].times, dtype=np.float64)
+        groups.setdefault((f.shape, t.shape, f.tobytes(), t.tobytes()),
+                          []).append(item)
+    kw = dict(chunk_nf=args.chunk, chunk_nt=args.chunk,
+              conc_weight=args.conc_weight)
+    for group in groups.values():
+        if resolve(args.backend) == "jax" and len(group) > 1:
+            try:
+                d0 = group[0][1]
+                wfs = retrieve_wavefield_batch(
+                    np.stack([np.asarray(d.dyn, dtype=np.float64)
+                              for _, d, _ in group]),
+                    np.asarray(d0.freqs), np.asarray(d0.times),
+                    [eta for _, _, eta in group], freq=float(d0.freq),
+                    dt=float(d0.dt), df=float(d0.df), backend="jax",
+                    **kw)
+                for (fn, d, eta), wf in zip(group, wfs):
+                    try:
+                        persist(fn, d, eta, wf, len(group))
+                    except Exception as e:
+                        print(f"{fn}: wavefield output failed ({e})",
+                              file=sys.stderr)
+                        rc = 1
+                continue
+            except Exception as e:
+                # the batching itself can be the failure (one epoch's
+                # degenerate eta, batch OOM): fall back to independent
+                # per-file retrieval instead of failing the whole group
+                print(f"batched retrieval failed ({e}); retrying "
+                      f"{len(group)} file(s) individually",
+                      file=sys.stderr)
+        for fn, d, eta in group:
+            try:
+                persist(fn, d, eta,
+                        retrieve_wavefield(d, eta,
+                                           backend=args.backend, **kw),
+                        1)
+            except Exception as e:
+                print(f"{fn}: wavefield retrieval failed ({e})",
+                      file=sys.stderr)
+                rc = 1
+    return rc
+
+
+def cmd_bench(args) -> int:
+    # bench.py lives at the repo root (the driver contract), not in the
+    # installed package: load it by path relative to this package, falling
+    # back to a plain import for checkout layouts with cwd on sys.path.
+    import importlib.util
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "bench.py")
+    if os.path.exists(path):
+        spec = importlib.util.spec_from_file_location("bench", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.main()
+        return 0
+    try:
+        import bench
+    except ImportError:
+        print("bench.py not found (run from a repo checkout)",
+              file=sys.stderr)
+        return 1
+    bench.main()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="scintools-tpu",
+        description="TPU-native pulsar scintillation analysis")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    q = sub.add_parser("info", help="print observation metadata")
+    q.add_argument("files", nargs="+")
+    q.set_defaults(fn=cmd_info)
+
+    q = sub.add_parser("process",
+                       help="process epochs: clean -> acf/sspec -> fits")
+    q.add_argument("files", nargs="+")
+    q.add_argument("--lamsteps", action="store_true")
+    q.add_argument("--backend", default="numpy",
+                   choices=["numpy", "jax"])
+    q.add_argument("--results", help="append-mode CSV output")
+    q.add_argument("--store", help="resumable per-epoch results dir")
+    q.add_argument("--plots", help="write summary plots to this dir")
+    q.add_argument("--no-arc", action="store_true")
+    q.add_argument("--no-scint", action="store_true")
+    q.add_argument("--scint-2d", action="store_true",
+                   help="also fit the 2-D ACF model (phase-gradient "
+                        "tilt -> store rows; per-file and batched)")
+    q.add_argument("--arc-asymm", action="store_true",
+                   help="also measure per-arm curvatures "
+                        "(eta_left/eta_right; batched mode)")
+    q.add_argument("--arc-method", default="norm_sspec",
+                   choices=["norm_sspec", "gridmax", "thetatheta"],
+                   help="curvature estimator, per-file and batched "
+                        "(thetatheta requires --arc-bracket)")
+    q.add_argument("--arc-bracket", type=float, nargs=2, default=None,
+                   metavar=("LO", "HI"),
+                   help="curvature bracket: the peak-search constraint "
+                        "(norm_sspec/gridmax) or the sweep range "
+                        "(thetatheta)")
+    q.add_argument("--batched", action="store_true",
+                   help="one jit-compiled step per shape bucket over the "
+                        "device mesh instead of a per-file loop")
+    q.add_argument("--full-csv", action="store_true",
+                   help="with --store + --results: export EVERY store "
+                        "column (tilt, per-arm curvatures, ...) instead "
+                        "of the reference-compatible schema")
+    q.add_argument("--chunk-epochs", type=int, default=None,
+                   help="batched mode: bound device memory by limiting "
+                        "epochs per step (adjusted to a multiple of the "
+                        "mesh's data-axis size, with a warning)")
+    q.add_argument("--mesh", type=int, nargs=2, default=None,
+                   metavar=("DATA", "CHAN"),
+                   help="batched mode: mesh shape (data x chan "
+                        "parallelism; CHAN>1 shards the sspec FFT's "
+                        "channel axis)")
+    q.set_defaults(fn=cmd_process)
+
+    q = sub.add_parser("sort", help="triage files into good/bad lists")
+    q.add_argument("files", nargs="+")
+    q.add_argument("--outdir")
+    q.add_argument("--min-nsub", type=int, default=10)
+    q.add_argument("--min-nchan", type=int, default=50)
+    q.add_argument("--min-freq", type=float, default=0)
+    q.add_argument("--max-freq", type=float, default=5000)
+    q.add_argument("--verbose", action="store_true")
+    q.set_defaults(fn=cmd_sort)
+
+    q = sub.add_parser("sim", help="simulate a dynspec -> psrflux file")
+    q.add_argument("--out", required=True)
+    q.add_argument("--mb2", type=float, default=2)
+    q.add_argument("--rf", type=float, default=1)
+    q.add_argument("--ds", type=float, default=0.01)
+    q.add_argument("--alpha", type=float, default=5 / 3)
+    q.add_argument("--ar", type=float, default=1)
+    q.add_argument("--psi", type=float, default=0)
+    q.add_argument("--inner", type=float, default=0.001)
+    q.add_argument("--ns", type=int, default=256)
+    q.add_argument("--nf", type=int, default=256)
+    q.add_argument("--dlam", type=float, default=0.25)
+    q.add_argument("--seed", type=int, default=None)
+    q.add_argument("--freq", type=float, default=1400.0)
+    q.add_argument("--dt", type=float, default=8.0)
+    q.add_argument("--backend", default="numpy",
+                   choices=["numpy", "jax"])
+    q.set_defaults(fn=cmd_sim)
+
+    q = sub.add_parser(
+        "curvature",
+        help="fit screen parameters to a survey's curvature time series")
+    q.add_argument("results",
+                   help="results CSV from `process --lamsteps` (needs "
+                        "the betaeta column)")
+    q.add_argument("--par", required=True,
+                   help="tempo2 .par file with RAJ/DECJ (+ orbit keys "
+                        "for binaries)")
+    q.add_argument("--fit", nargs="+", default=["s", "vism_psi"],
+                   choices=["s", "d", "psi", "vism_psi", "vism_ra",
+                            "vism_dec"],
+                   help="screen keys to fit")
+    q.add_argument("--start", nargs="*", default=None, metavar="KEY=VAL",
+                   help="starting values / fixed screen parameters")
+    q.add_argument("--plot", default=None,
+                   help="write a data-vs-model PNG here")
+    q.add_argument("--backend", default="numpy",
+                   choices=["numpy", "jax"])
+    q.set_defaults(fn=cmd_curvature)
+
+    q = sub.add_parser(
+        "wavefield",
+        help="retrieve the complex wavefield (theta-theta holography)")
+    q.add_argument("files", nargs="+", help="psrflux dynspec files")
+    q.add_argument("--eta", type=float, default=None,
+                   help="arc curvature (us/mHz^2); omit to fit it")
+    q.add_argument("--etamin", type=float, default=1e-4,
+                   help="curvature-fit bracket (used when --eta omitted)")
+    q.add_argument("--etamax", type=float, default=100.0)
+    q.add_argument("--numsteps", type=int, default=128,
+                   help="curvature-sweep points")
+    q.add_argument("--chunk", type=int, default=64,
+                   help="chunk size (both axes)")
+    q.add_argument("--out", default=None,
+                   help="output .npz (single input only; default "
+                        "<file>.wavefield.npz)")
+    q.add_argument("--plots", action="store_true",
+                   help="also write wavefield + field-sspec PNGs")
+    q.add_argument("--conc-weight", type=float, default=0.0,
+                   help="blend-weight exponent on per-chunk eigenmode "
+                        "concentration (0 = uniform blend)")
+    q.add_argument("--backend", default="numpy",
+                   choices=["numpy", "jax", "auto"])
+    q.set_defaults(fn=cmd_wavefield)
+
+    q = sub.add_parser("bench", help="run the headline benchmark")
+    q.set_defaults(fn=cmd_bench)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    from .backend import honor_platform_env
+
+    honor_platform_env()
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,232 @@
+"""Core data model: the dynamic spectrum as an immutable pytree.
+
+The reference keeps all state as mutable attributes on one ``Dynspec`` class
+(``dynspec.py:29``, attributes ``dyn/freqs/times/nchan/nsub/bw/df/freq/tobs/
+dt/mjd`` set in ``load_file`` at ``dynspec.py:99-156``).  Here the data model
+is a frozen dataclass registered as a JAX pytree, so a whole observing epoch
+can be vmapped/sharded as one value, and every processing step is a pure
+function ``DynspecData -> DynspecData``.
+
+Array fields (pytree leaves):
+    dyn    [nchan, nsub]  flux (frequency x time, ascending frequency)
+    freqs  [nchan]        channel centre frequencies (MHz)
+    times  [nsub]         time since observation start (s)
+    mjd, df, dt, bw, freq, tobs : scalars (leaves so they batch under vmap)
+
+Static fields (aux data): name, header.
+
+Derived integer shapes (nchan, nsub) come from ``dyn.shape`` so they remain
+static under jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import numpy as np
+
+from .backend import to_numpy
+
+_C_M_S = 299792458.0  # speed of light, m/s (scipy.constants.c)
+
+
+@dataclasses.dataclass(frozen=True)
+class DynspecData:
+    dyn: Any
+    freqs: Any
+    times: Any
+    mjd: Any = 50000.0
+    df: Any = None
+    dt: Any = None
+    bw: Any = None
+    freq: Any = None
+    tobs: Any = None
+    name: str = "dynspec"
+    header: tuple = ()
+
+    def __post_init__(self):
+        # Fill derivable metadata host-side when not provided.  Mirrors the
+        # duck-typed attribute derivations of BasicDyn (dynspec.py:1494-1523)
+        # but with the off-by-one quirks fixed (reference uses
+        # ``freqs[1]-freqs[2]`` for df and drops the trailing channel in bw).
+        if self.df is None:
+            f = to_numpy(self.freqs)
+            object.__setattr__(self, "df", float(f[1] - f[0]) if f.size > 1 else 1.0)
+        if self.dt is None:
+            t = to_numpy(self.times)
+            object.__setattr__(self, "dt", float(t[1] - t[0]) if t.size > 1 else 1.0)
+        if self.bw is None:
+            f = to_numpy(self.freqs)
+            object.__setattr__(self, "bw", float(abs(f[-1] - f[0])) + abs(self.df))
+        if self.freq is None:
+            object.__setattr__(self, "freq", float(np.mean(to_numpy(self.freqs))))
+        if self.tobs is None:
+            t = to_numpy(self.times)
+            object.__setattr__(self, "tobs", float(t[-1] - t[0]) + abs(self.dt))
+
+    # -- static shape info (safe under jit) --------------------------------
+    @property
+    def nchan(self) -> int:
+        return self.dyn.shape[-2]
+
+    @property
+    def nsub(self) -> int:
+        return self.dyn.shape[-1]
+
+    @property
+    def lams(self):
+        """Channel wavelengths (m)."""
+        return _C_M_S / (to_numpy(self.freqs) * 1e6)
+
+    def replace(self, **kw) -> "DynspecData":
+        return dataclasses.replace(self, **kw)
+
+    def info_str(self) -> str:
+        """Observation summary, mirroring Dynspec.info (dynspec.py:1478-1491)."""
+        return (
+            "\t OBSERVATION PROPERTIES\n\n"
+            f"filename:\t\t\t{self.name}\n"
+            f"MJD:\t\t\t\t{self.mjd}\n"
+            f"Centre frequency (MHz):\t\t{self.freq}\n"
+            f"Bandwidth (MHz):\t\t{self.bw}\n"
+            f"Channel bandwidth (MHz):\t{self.df}\n"
+            f"Integration time (s):\t\t{self.tobs}\n"
+            f"Subintegration time (s):\t{self.dt}\n"
+        )
+
+
+_LEAF_FIELDS = ("dyn", "freqs", "times", "mjd", "df", "dt", "bw", "freq", "tobs")
+_AUX_FIELDS = ("name", "header")
+
+
+def _flatten(d: DynspecData):
+    return tuple(getattr(d, f) for f in _LEAF_FIELDS), tuple(
+        getattr(d, f) for f in _AUX_FIELDS)
+
+
+def _unflatten(aux, leaves):
+    kw = dict(zip(_LEAF_FIELDS, leaves))
+    kw.update(dict(zip(_AUX_FIELDS, aux)))
+    return DynspecData(**kw)
+
+
+def _register_pytree():
+    try:
+        import jax
+
+        jax.tree_util.register_pytree_node(DynspecData, _flatten, _unflatten)
+    except ImportError:  # pragma: no cover
+        pass
+
+
+_register_pytree()
+
+
+# ---------------------------------------------------------------------------
+# Result containers
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SecSpec:
+    """Secondary spectrum + axes.
+
+    Mirrors the attributes the reference stores after ``calc_sspec``
+    (``dynspec.py:1315-1326``): ``sspec`` in dB, ``fdop`` (mHz), ``tdel``
+    (us), and ``beta`` (m^-1) when computed in lambda steps.
+    """
+
+    sspec: Any
+    fdop: Any
+    tdel: Any
+    beta: Any = None
+    lamsteps: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ScintParams:
+    """tau/dnu fit result (reference: dynspec.py:994-1000)."""
+
+    tau: Any
+    tauerr: Any
+    dnu: Any
+    dnuerr: Any
+    talpha: Any
+    talphaerr: Any = None
+    amp: Any = None
+    wn: Any = None
+    redchi: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ArcFit:
+    """Arc-curvature fit result (reference: dynspec.py:777-785)."""
+
+    eta: Any
+    etaerr: Any
+    etaerr2: Any
+    lamsteps: bool = True
+    profile_eta: Any = None      # eta grid of the power profile
+    profile_power: Any = None    # mean power along arcs (dB)
+    profile_power_filt: Any = None
+    noise: Any = None            # noise level used by the error walk
+    # per-arm measurement (asymm=True; both methods, both backends): the
+    # reference plumbs an ``asymm`` flag and computes etaL/etaR but a
+    # copy-paste bug feeds the combined profile to both arms
+    # (dynspec.py:567-568) and never returns them; here the left/right
+    # fdop arms are fitted independently (NaN for a degenerate arm)
+    eta_left: Any = None
+    etaerr_left: Any = None
+    eta_right: Any = None
+    etaerr_right: Any = None
+
+
+def _register_result_pytrees():
+    try:
+        import jax
+
+        for cls, leaf_fields, aux_fields in (
+            (SecSpec, ("sspec", "fdop", "tdel", "beta"), ("lamsteps",)),
+            (ScintParams,
+             ("tau", "tauerr", "dnu", "dnuerr", "talpha", "talphaerr", "amp",
+              "wn", "redchi"), ()),
+            (ArcFit, ("eta", "etaerr", "etaerr2", "profile_eta",
+                      "profile_power", "profile_power_filt", "noise",
+                      "eta_left", "etaerr_left", "eta_right",
+                      "etaerr_right"),
+             ("lamsteps",)),
+        ):
+            def fl(obj, _lf=leaf_fields, _af=aux_fields):
+                return (tuple(getattr(obj, f) for f in _lf),
+                        tuple(getattr(obj, f) for f in _af))
+
+            def unfl(aux, leaves, _cls=cls, _lf=leaf_fields, _af=aux_fields):
+                kw = dict(zip(_lf, leaves))
+                kw.update(dict(zip(_af, aux)))
+                return _cls(**kw)
+
+            jax.tree_util.register_pytree_node(cls, fl, unfl)
+    except ImportError:  # pragma: no cover
+        pass
+
+
+_register_result_pytrees()
+
+
+def stack_batch(items: Sequence[DynspecData]) -> DynspecData:
+    """Stack equally-shaped epochs into one batched DynspecData [B, ...].
+
+    Heterogeneous shapes must be padded first (see parallel.batch)."""
+    import numpy as _np
+
+    if not items:
+        raise ValueError("empty batch")
+    shapes = {to_numpy(d.dyn).shape for d in items}
+    if len(shapes) != 1:
+        raise ValueError(f"cannot stack heterogeneous shapes {shapes}; "
+                         "pad first (parallel.batch.pad_batch)")
+    kw = {f: _np.stack([_np.asarray(getattr(d, f)) for d in items])
+          for f in _LEAF_FIELDS}
+    return DynspecData(name=f"batch[{len(items)}]",
+                       header=items[0].header, **kw)

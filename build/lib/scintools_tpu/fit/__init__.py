@@ -1,0 +1,16 @@
+from .arc_fit import (NormSspec, fit_arc, fit_arcs_multi,  # noqa: F401
+                      make_arc_fitter, norm_sspec)
+from .curvature_fit import fit_arc_curvature  # noqa: F401
+from .thetatheta import (fit_arc_thetatheta,  # noqa: F401
+                         theta_theta_map)
+from .wavefield import (Wavefield, retrieve_wavefield,  # noqa: F401
+                        retrieve_wavefield_batch)
+from .filters import savgol1  # noqa: F401
+from .lm import (LsqResult, least_squares_numpy, lm_fit_batched,  # noqa: F401
+                 lm_fit_jax)
+from .mcmc import ensemble_sample, fit_scint_params_mcmc  # noqa: F401
+from .scint_fit import (acf_cuts, fit_scint_params,  # noqa: F401
+                        fit_scint_params_2d, fit_scint_params_2d_batch,
+                        fit_scint_params_batch,
+                        fit_scint_params_from_dyn, fit_scint_params_sspec,
+                        initial_guesses)

@@ -1,0 +1,930 @@
+"""Scintillation-arc curvature fitting.
+
+Reference: ``Dynspec.fit_arc`` (dynspec.py:414-785) and
+``Dynspec.norm_sspec`` (dynspec.py:787-926).  Two methods:
+
+* ``norm_sspec`` (flagship): normalise the Doppler axis of every delay row
+  by ``sqrt(tdel/eta_min)``, delay-scrunch to a 1-D power-vs-normalised-fdop
+  profile, fold the two arms, map normalised fdop back to an eta grid, and
+  fit a parabola around the smoothed peak (dynspec.py:661-771, 787-926).
+* ``gridmax``: for each trial eta, sample the secondary spectrum along
+  ``tdel = eta*fdop^2`` with bilinear interpolation and find the eta
+  maximising mean power (dynspec.py:516-659).
+
+The numpy path replicates the reference step-for-step (minus plotting),
+including its quirks: the double delmax frequency adjustment
+(dynspec.py:428-429 then 796-797), the value-matching peak lookup
+``argmin(|filt - max_inrange|)`` (dynspec.py:698), the asymmetric walk
+guard ``ind + ind1 < len-1`` on the *left* walk (dynspec.py:581-582), and
+the +2 dB profile shift when the profile at normalised fdop=1 is negative
+(dynspec.py:864-866).
+
+The jax path (:func:`make_arc_fitter`) is the fixed-shape SPMD rebuild:
+row-normalisation becomes vmapped uniform-grid linear interpolation
+(index arithmetic, no searchsorted; identical values to masked interp
+because linear interpolation is local and scale-invariant, and the fdop
+grid from sspec_axes is uniform), NaN masks replace boolean compaction,
+the -3 dB walks become
+first-crossing reductions, and the windowed parabola fit uses 0/1 weights —
+so one jit compiles the whole measurement for a [B, nr, nc] batch of
+epochs.  Agreement with the numpy path is asserted on synthetic arcs in
+tests (not bit-equal: the walk guard quirk and boundary smoothing differ;
+documented there).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import warnings
+from typing import Any
+
+import numpy as np
+from scipy.ndimage import map_coordinates
+from scipy.signal import savgol_filter
+
+from ..backend import resolve
+from ..data import ArcFit, SecSpec
+from ..models.parabola import fit_log_parabola, fit_parabola
+
+C_M_S = 299792458.0
+
+
+@dataclasses.dataclass(frozen=True)
+class NormSspec:
+    """Normalised secondary spectrum (dynspec.py:923-925)."""
+
+    normsspec: Any      # [ntdel, nfdop]
+    normsspecavg: Any   # [nfdop] delay-scrunched profile
+    powerspec: Any      # [ntdel] fdop-scrunched power spectrum
+    tdel: Any           # [ntdel] cut delay (or beta) axis
+    fdopnew: Any        # [nfdop] normalised fdop axis
+
+
+def _beta_to_eta_factor(freq: float, ref_freq: float) -> float:
+    """Unit conversion used when fitting in tdel rather than beta space
+    (dynspec.py:494-499)."""
+    return C_M_S * 1e6 / ((ref_freq * 1e6) ** 2)
+
+
+def norm_sspec(sec: SecSpec, freq: float, eta: float, delmax=None,
+               startbin: int = 1, maxnormfac: float = 2, cutmid: int = 3,
+               numsteps: int | None = None, ref_freq: float = 1400.0
+               ) -> NormSspec:
+    """Normalise the fdop axis by the arc curvature (dynspec.py:787-926,
+    compute only).  ``eta`` is in the units of ``sec``'s delay axis (beta
+    for lamsteps, converted internally otherwise, dynspec.py:820-825)."""
+    sspec = np.array(sec.sspec, dtype=np.float64)
+    yaxis = np.asarray(sec.beta if sec.lamsteps else sec.tdel,
+                       dtype=np.float64)
+    tdel_axis = np.asarray(sec.tdel)
+    fdop = np.asarray(sec.fdop, dtype=np.float64)
+
+    delmax = np.max(tdel_axis) if delmax is None else delmax
+    delmax = delmax * (ref_freq / freq) ** 2
+
+    if not sec.lamsteps:
+        eta = eta / (freq / ref_freq) ** 2
+        eta = eta * _beta_to_eta_factor(freq, ref_freq)
+
+    ind = np.argmin(np.abs(tdel_axis - delmax))
+    sspec = sspec[startbin:ind, :]
+    nr, nc = sspec.shape
+    sspec[:, int(nc / 2 - np.floor(cutmid / 2)):
+          int(nc / 2 + np.floor(cutmid / 2))] = np.nan
+    tdel = yaxis[startbin:ind]
+
+    maxfdop = maxnormfac * np.sqrt(tdel[-1] / eta)
+    if maxfdop > np.max(fdop):
+        maxfdop = np.max(fdop)
+    nfdop = (2 * len(fdop[np.abs(fdop) <= maxfdop]) if numsteps is None
+             else int(numsteps))
+    fdopnew = np.linspace(-maxnormfac, maxnormfac, nfdop)
+
+    norm_rows = []
+    for ii in range(len(tdel)):
+        itdel = tdel[ii]
+        imaxfdop = maxnormfac * np.sqrt(itdel / eta)
+        mask = np.abs(fdop) <= imaxfdop
+        ifdop = fdop[mask] / np.sqrt(itdel / eta)
+        isspec = sspec[ii, mask]
+        norm_rows.append(np.interp(fdopnew, ifdop, isspec))
+    norm_arr = np.array(norm_rows)
+    # columns fully inside the cutmid notch are all-NaN by construction
+    # (the reference produces the same NaN means, warning unsuppressed)
+    with warnings.catch_warnings():
+        warnings.filterwarnings("ignore", message="Mean of empty slice")
+        isspecavg = np.nanmean(norm_arr, axis=0)
+        powerspec = np.nanmean(norm_arr, axis=1)
+    ind1 = np.argmin(np.abs(fdopnew - 1) - 2)
+    if isspecavg[ind1] < 0:
+        isspecavg = isspecavg + 2  # reference's dB-offset quirk
+    return NormSspec(normsspec=norm_arr, normsspecavg=isspecavg,
+                     powerspec=powerspec, tdel=tdel, fdopnew=fdopnew)
+
+
+def _noise_estimate(sspec: np.ndarray, cutmid: int, xp=np) -> float:
+    """Noise from the outer Doppler quadrants at high delay
+    (dynspec.py:446-451)."""
+    nr, nc = sspec.shape[-2], sspec.shape[-1]
+    a = sspec[..., nr // 2:, int(nc / 2 + np.ceil(cutmid / 2)):]
+    b = sspec[..., nr // 2:, : int(nc / 2 - np.floor(cutmid / 2))]
+    both = xp.concatenate(
+        [a.reshape(a.shape[:-2] + (-1,)), b.reshape(b.shape[:-2] + (-1,))],
+        axis=-1)
+    return xp.std(both, axis=-1)
+
+
+def _walk(filt: np.ndarray, ind: int, threshold: float) -> tuple[int, int]:
+    """The reference's peak-window walks (dynspec.py:702-718): step left
+    while the smoothed power stays above threshold (guarded, quirkily, on
+    ind+ind1), then right."""
+    n = len(filt)
+    power, ind1 = filt[ind], 1
+    while power > threshold and ind + ind1 < n - 1:
+        ind1 += 1
+        power = filt[ind - ind1]
+    power, ind2 = filt[ind], 1
+    while power > threshold and ind + ind2 < n - 1:
+        ind2 += 1
+        power = filt[ind + ind2]
+    return ind1, ind2
+
+
+def _check_profile_size(profile, nsmooth: int) -> None:
+    """Informative failure for profiles too short to smooth/fit
+    (np.size: robust to the 0-d arrays `.squeeze()` produces when only
+    one point survives masking).  savgol accepts window_length == size,
+    so only strictly smaller profiles are rejected."""
+    if np.size(profile) < nsmooth:
+        raise ValueError(
+            f"curvature profile has only {np.size(profile)} valid points "
+            f"(< nsmooth={nsmooth}) — secondary spectrum too small or "
+            f"too masked to fit an arc")
+
+
+def _measure_peak(eta_array, power, filt, noise, constraint,
+                  low_power_diff, high_power_diff, noise_error, lamsteps,
+                  log_fit: bool) -> ArcFit:
+    """Constrained peak search + power-drop walks + (log-)parabola fit on
+    a precomputed power-vs-curvature profile (dynspec.py:693-744).
+
+    Shared by fit_arc's norm_sspec and gridmax branches and by the
+    multi-arc driver, which measures several windows of ONE profile.
+    """
+    inrange = np.argwhere((eta_array > constraint[0])
+                          * (eta_array < constraint[1]))
+    if inrange.size == 0:
+        raise ValueError(f"no eta grid points inside constraint "
+                         f"{tuple(constraint)}")
+    peak_ind = int(np.argmin(np.abs(filt - np.max(filt[inrange]))))
+    max_power = filt[peak_ind]
+
+    # -3 dB on the low-curvature side, -1.5 dB on the high side
+    i1, _ = _walk(filt, peak_ind, max_power + low_power_diff)
+    _, i2 = _walk(filt, peak_ind, max_power + high_power_diff)
+    # NOTE: the slice start may be negative when the walk overshoots a
+    # peak near the profile edge; python then wraps it, which for the
+    # usual overshoot-to-the-end case selects nearly the whole profile.
+    # The reference relies on exactly this behaviour (dynspec.py:638-641),
+    # so it is kept bit-for-bit; only the truly crashing case (wrap
+    # produces an EMPTY window, a deep numpy reduction error in the
+    # reference) is turned into an informative failure.
+    xdata = eta_array[peak_ind - i1: peak_ind + i2]
+    ydata = power[peak_ind - i1: peak_ind + i2]
+    if xdata.size == 0:
+        raise ValueError(
+            f"arc peak at grid index {peak_ind} leaves no points for the "
+            f"parabola fit — peak is at the eta-grid edge (widen "
+            f"etamin/etamax or the constraint window)")
+    fitter = fit_log_parabola if log_fit else fit_parabola
+    yfit, eta, etaerr_fit = fitter(xdata, ydata, xp=np)
+    if np.mean(np.gradient(np.diff(yfit))) > 0:
+        raise ValueError("Fit returned a forward parabola.")
+
+    etaerr = etaerr_fit
+    if noise_error:
+        j1, j2 = _walk(filt, peak_ind, max_power - noise)
+        win = eta_array[peak_ind - j1: peak_ind + j2]  # wrap as reference
+        etaerr = np.ptp(win) / 2 if win.size else np.nan
+
+    return ArcFit(eta=eta, etaerr=etaerr, etaerr2=etaerr_fit,
+                  lamsteps=lamsteps, profile_eta=eta_array,
+                  profile_power=power, profile_power_filt=filt,
+                  noise=noise)
+
+
+def _attach_arms(fit: ArcFit, left_fn, right_fn) -> ArcFit:
+    """Attach independent left/right-arm measurements to a combined fit.
+    A degenerate arm (forward parabola / too-short profile) yields NaN for
+    that arm rather than failing the primary measurement."""
+    def _arm(fn):
+        try:
+            f = fn()
+            return float(f.eta), float(f.etaerr)
+        except ValueError:
+            return float("nan"), float("nan")
+
+    el, eel = _arm(left_fn)
+    er, eer = _arm(right_fn)
+    return dataclasses.replace(fit, eta_left=el, etaerr_left=eel,
+                               eta_right=er, etaerr_right=eer)
+
+
+def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
+            delmax=None, numsteps: int = 10000, startbin: int = 3,
+            cutmid: int = 3, etamax=None, etamin=None,
+            low_power_diff: float = -3.0, high_power_diff: float = -1.5,
+            ref_freq: float = 1400.0, constraint=(0, np.inf),
+            nsmooth: int = 5, noise_error: bool = True, asymm: bool = False,
+            backend: str = "numpy") -> ArcFit:
+    """Find the arc curvature maximising power along ``tdel = eta fdop^2``
+    (dynspec.py:414-785, compute only; primary arc).
+
+    ``asymm=True`` additionally fits the left and right fdop arms
+    independently (``eta_left/eta_right`` on the result) on both
+    backends (vmappable on jax).  The reference plumbs this flag but a
+    copy-paste bug feeds the combined profile to both arm fits
+    (dynspec.py:567-568) and the per-arm values are only plotted, never
+    returned — completed here."""
+    backend = resolve(backend)
+    if asymm and method == "thetatheta":
+        raise ValueError("asymm=True is not meaningful for "
+                         "method='thetatheta' (the theta-theta transform "
+                         "uses both arms jointly); use 'gridmax' or "
+                         "'norm_sspec'")
+    if method == "thetatheta":
+        # eigenvector-based measurement (beyond-reference; see
+        # fit.thetatheta): needs an explicit eta bracket, further
+        # narrowed by any constraint window
+        from .thetatheta import fit_arc_thetatheta
+
+        if etamin is None or etamax is None:
+            raise ValueError("method='thetatheta' needs explicit "
+                             "etamin/etamax bracketing the arc")
+        lo = max(float(etamin), float(constraint[0]))
+        hi = min(float(etamax), float(constraint[1]))
+        if not lo < hi:
+            raise ValueError(f"empty eta bracket after intersecting "
+                             f"[{etamin}, {etamax}] with constraint "
+                             f"{tuple(constraint)}")
+        eta, etaerr, etas, conc = fit_arc_thetatheta(
+            sec, lo, hi, n_eta=int(numsteps), startbin=startbin,
+            cutmid=cutmid, backend=backend)
+        return ArcFit(eta=eta, etaerr=etaerr, etaerr2=etaerr,
+                      lamsteps=sec.lamsteps, profile_eta=etas,
+                      profile_power=conc, profile_power_filt=conc)
+    if backend == "jax" and method in ("norm_sspec", "gridmax"):
+        fitter = make_arc_fitter(
+            fdop=np.asarray(sec.fdop), yaxis=np.asarray(
+                sec.beta if sec.lamsteps else sec.tdel),
+            tdel=np.asarray(sec.tdel), freq=freq, lamsteps=sec.lamsteps,
+            method=method, delmax=delmax, numsteps=int(numsteps),
+            startbin=startbin, cutmid=cutmid, etamax=etamax, etamin=etamin,
+            low_power_diff=low_power_diff, high_power_diff=high_power_diff,
+            ref_freq=ref_freq, constraint=tuple(constraint),
+            nsmooth=nsmooth, noise_error=noise_error, asymm=asymm)
+        import jax.numpy as jnp
+
+        batch = fitter(jnp.asarray(sec.sspec)[None])
+
+        def lane0(x):
+            return None if x is None else x[0]
+
+        return ArcFit(eta=batch.eta[0], etaerr=batch.etaerr[0],
+                      etaerr2=batch.etaerr2[0], lamsteps=batch.lamsteps,
+                      profile_eta=batch.profile_eta,
+                      profile_power=batch.profile_power[0],
+                      profile_power_filt=batch.profile_power_filt[0],
+                      noise=batch.noise[0],
+                      eta_left=lane0(batch.eta_left),
+                      etaerr_left=lane0(batch.etaerr_left),
+                      eta_right=lane0(batch.eta_right),
+                      etaerr_right=lane0(batch.etaerr_right))
+    sspec = np.array(sec.sspec, dtype=np.float64)
+    tdel_axis = np.asarray(sec.tdel)
+    fdop = np.asarray(sec.fdop, dtype=np.float64)
+    lamsteps = sec.lamsteps
+
+    delmax = np.max(tdel_axis) if delmax is None else delmax
+    delmax = delmax * (ref_freq / freq) ** 2
+
+    yaxis = np.asarray(sec.beta if lamsteps else sec.tdel, dtype=np.float64)
+    ind = np.argmin(np.abs(tdel_axis - delmax))
+    ymax = yaxis[ind] if lamsteps else delmax
+
+    noise = float(_noise_estimate(sspec, cutmid))
+
+    nr, nc = sspec.shape
+    sspec[0:startbin, :] = np.nan
+    sspec[:, int(nc / 2 - np.floor(cutmid / 2)):
+          int(nc / 2 + np.ceil(cutmid / 2))] = np.nan
+    sspec = sspec[0:ind, :]
+    yaxis_cut = yaxis[0:ind]
+    noise = noise / len(yaxis_cut[startbin:])
+
+    if etamax is None:
+        etamax = ymax / ((fdop[1] - fdop[0]) * cutmid) ** 2
+    if etamin is None:
+        etamin = (yaxis_cut[1] - yaxis_cut[0]) * startbin / np.max(fdop) ** 2
+
+    constraint = np.asarray(constraint, dtype=np.float64)
+    if not lamsteps:
+        b2e = _beta_to_eta_factor(freq, ref_freq)
+        etamax = etamax / (freq / ref_freq) ** 2 * b2e
+        etamin = etamin / (freq / ref_freq) ** 2 * b2e
+        constraint = constraint / (freq / ref_freq) ** 2 * b2e
+
+    sqrt_eta_all = np.linspace(np.sqrt(etamin), np.sqrt(etamax),
+                               int(numsteps))
+    sqrt_eta = sqrt_eta_all  # single-arc: full range
+    numsteps_new = len(sqrt_eta)
+
+    if method == "norm_sspec":
+        ns = norm_sspec(sec, freq, eta=etamin, delmax=delmax,
+                        startbin=startbin, maxnormfac=1, cutmid=cutmid,
+                        numsteps=numsteps_new, ref_freq=ref_freq)
+        prof = ns.normsspecavg.squeeze()
+        n = len(prof)
+        etafrac = np.linspace(-1, 1, n)
+        ipos = np.argwhere(etafrac > 1 / (2 * n))
+        ineg = np.argwhere(etafrac < -1 / (2 * n))
+        etafrac_pos = 1 / etafrac[ipos].squeeze()
+
+        def _measure_arm(arm_prof, log_fit=False):
+            a = arm_prof.squeeze()
+            valid = np.isfinite(a) * (~np.isnan(a))
+            a = np.flip(a[valid], axis=0)
+            ef = np.flip(etafrac_pos[valid], axis=0)
+            ea = etamin * ef ** 2
+            keep = np.argwhere(ea < etamax)
+            ea = ea[keep].squeeze()
+            a = a[keep].squeeze()
+            _check_profile_size(a, nsmooth)
+            filt = savgol_filter(a, nsmooth, 1)
+            return _measure_peak(ea, a, filt, noise, constraint,
+                                 low_power_diff, high_power_diff,
+                                 noise_error, lamsteps, log_fit=log_fit)
+
+        fit = _measure_arm((prof[ipos] + np.flip(prof[ineg], axis=0)) / 2)
+        if asymm:
+            fit = _attach_arms(fit,
+                               lambda: _measure_arm(np.flip(prof[ineg],
+                                                            axis=0)),
+                               lambda: _measure_arm(prof[ipos]))
+        return fit
+
+    if method == "gridmax":
+        x, y, z = fdop, yaxis_cut, sspec
+        sumpow_l, sumpow_r, eta_list = [], [], []
+        for se in sqrt_eta:
+            ieta = se ** 2
+            eta_list.append(ieta)
+            ynew = ieta * x ** 2
+            xpx = (x - x.min()) / (x.max() - x.min()) * z.shape[1]
+            ynewpx = (ynew - ynew.min()) / (y.max() - ynew.min()) * z.shape[0]
+            for side, store in ((x < 0, sumpow_l), (x > 0, sumpow_r)):
+                sel = side & (ynew < y.max())
+                coords = np.stack([ynewpx[sel], xpx[sel]])
+                zn = map_coordinates(z, coords, order=1, cval=np.nan)
+                store.append(np.mean(zn[~np.isnan(zn)]))
+        eta_array = np.array(eta_list)
+
+        def _measure_grid(pow_arr):
+            ok = np.isfinite(pow_arr)
+            ea, p = eta_array[ok], pow_arr[ok]
+            _check_profile_size(p, nsmooth)
+            filt = savgol_filter(p, nsmooth, 1)
+            return _measure_peak(ea, p, filt, noise, constraint,
+                                 low_power_diff, high_power_diff,
+                                 noise_error, lamsteps, log_fit=True)
+
+        fit = _measure_grid((np.array(sumpow_l) + np.array(sumpow_r)) / 2)
+        if asymm:
+            fit = _attach_arms(fit,
+                               lambda: _measure_grid(np.array(sumpow_l)),
+                               lambda: _measure_grid(np.array(sumpow_r)))
+        return fit
+
+    raise ValueError("unknown arc fitting method; choose from "
+                     "'gridmax' or 'norm_sspec'")
+
+
+# ---------------------------------------------------------------------------
+# jax fixed-shape batched fitter
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
+                            method, delmax, numsteps, startbin, cutmid,
+                            etamax, etamin, low_power_diff, high_power_diff,
+                            ref_freq, constraint, nsmooth, noise_error,
+                            asymm=False, constraints=None,
+                            scrunch_rows=0):
+    if asymm and constraints is not None:
+        raise ValueError("asymm=True and multi-arc constraints are "
+                         "mutually exclusive on the batched fitter")
+    import jax
+    import jax.numpy as jnp
+
+    from .filters import savgol1
+    from ..models.parabola import fit_parabola as _fitpar
+
+    fdop = np.frombuffer(fdop_key[0]).reshape(fdop_key[1])
+    yaxis = np.frombuffer(yaxis_key[0]).reshape(yaxis_key[1])
+    tdel_axis = np.frombuffer(tdel_key[0]).reshape(tdel_key[1])
+
+    # ---- host-side static precomputation -------------------------------
+    # One frequency adjustment for the fit-level delay cut (dynspec.py:428-
+    # 429); norm_sspec then re-applies it internally (dynspec.py:796-797) —
+    # the reference's double-adjustment quirk, reproduced for parity.
+    dmax = np.max(tdel_axis) if delmax is None else delmax
+    dmax = dmax * (ref_freq / freq) ** 2
+    dmax_norm = dmax * (ref_freq / freq) ** 2
+    ind = int(np.argmin(np.abs(tdel_axis - dmax)))
+    ind_norm = int(np.argmin(np.abs(tdel_axis - dmax_norm)))
+    ymax = yaxis[ind] if lamsteps else dmax
+    yc = yaxis[:ind]
+    emax = etamax if etamax is not None else \
+        ymax / ((fdop[1] - fdop[0]) * cutmid) ** 2
+    emin = etamin if etamin is not None else \
+        (yc[1] - yc[0]) * startbin / np.max(fdop) ** 2
+    cons = np.asarray(constraint, dtype=np.float64)
+    emin_norm = emin
+    if not lamsteps:
+        b2e = _beta_to_eta_factor(freq, ref_freq)
+        emax = emax / (freq / ref_freq) ** 2 * b2e
+        emin = emin / (freq / ref_freq) ** 2 * b2e
+        cons = cons / (freq / ref_freq) ** 2 * b2e
+        # norm_sspec converts the (already converted) eta again
+        # (dynspec.py:820-825) — second half of the same quirk
+        emin_norm = emin / (freq / ref_freq) ** 2 * b2e
+    else:
+        emin_norm = emin
+
+    n = int(numsteps)
+    # constraint sanity: the masks are host-side static, so an impossible
+    # window fails at build time like the numpy path does at fit time
+    # (otherwise the traced argmax would degenerate silently to index 0)
+    def _check_constraint(grid_mask, grid, window=None):
+        if not grid_mask.any():
+            w = tuple(cons) if window is None else tuple(window)
+            raise ValueError(
+                f"no eta grid points inside constraint {w} "
+                f"(grid spans {grid.min():.4g}..{grid.max():.4g})")
+
+    # norm_sspec internals (maxnormfac=1): rows startbin..ind_norm-1
+    tdel_rows = yaxis[startbin:ind_norm]
+    scales = np.sqrt(tdel_rows / emin_norm)         # [R] per-row fdop scale
+    fdopnew = np.linspace(-1.0, 1.0, n)
+    # fold indices (static): positive/negative arms of fdopnew
+    etafrac = np.linspace(-1.0, 1.0, n)
+    ipos = np.where(etafrac > 1 / (2 * n))[0]
+    ineg = np.where(etafrac < -1 / (2 * n))[0]
+    etafrac_avg = 1.0 / etafrac[ipos]               # descending eta
+    eta_array = emin * etafrac_avg[::-1] ** 2       # ascending in eta
+    keep_static = eta_array < emax                  # static part of validity
+    # multi-arc mode: one shared profile measured under K constraint
+    # windows (constraints=...); single-arc mode uses the one constraint.
+    # Windows get the same unit conversion the single constraint received
+    # above (lamsteps=False fits run in converted beta-eta units)
+    def _conv_window(c):
+        c = np.asarray(c, dtype=np.float64)
+        if not lamsteps:
+            c = c / (freq / ref_freq) ** 2 * _beta_to_eta_factor(freq,
+                                                                ref_freq)
+        return c
+
+    cons_windows = ([cons] if constraints is None
+                    else [_conv_window(c) for c in constraints])
+    cons_masks = [(eta_array > c[0]) & (eta_array < c[1])
+                  for c in cons_windows]
+    cons_mask = cons_masks[0]
+    if method == "norm_sspec":
+        # the searchable region is the constraint INTERSECTED with the
+        # static validity window (eta < emax): a constraint lying wholly
+        # past emax would degenerate silently at fit time otherwise
+        for cm, w in zip(cons_masks, cons_windows):
+            _check_constraint(cm & keep_static, eta_array[keep_static],
+                              window=w)
+    # cutmid NaN columns of the row-normalised spectrum (norm_sspec flavour:
+    # floor on both sides, dynspec.py:838-839)
+    ncol = len(fdop)
+    cut_lo = int(ncol / 2 - np.floor(cutmid / 2))
+    cut_hi = int(ncol / 2 + np.floor(cutmid / 2))
+    col_nan = np.zeros(ncol, dtype=bool)
+    col_nan[cut_lo:cut_hi] = True
+    # fdop is a uniform grid (sspec_axes), so row interpolation reduces to
+    # direct index arithmetic — no searchsorted (log-n gather chains) in
+    # the hot vmapped row loop.  The grid MUST be uniform for this; fail
+    # loudly for exotic callers.
+    f0 = float(fdop[0])
+    dfd = float(fdop[1] - fdop[0])
+    if not np.allclose(np.diff(fdop), dfd, rtol=1e-9, atol=0.0):
+        raise ValueError("jax arc fitter requires a uniform fdop grid "
+                         "(sspec_axes produces one); use backend='numpy' "
+                         "for non-uniform axes")
+    # half-ulp slack so ceil/floor match searchsorted when a query lands
+    # exactly on a grid value (linspace grids differ in the last ulp)
+    _EDGE_EPS = 1e-12
+
+    def _stack_windows(measure_fn, masks, noise):
+        """Measure one shared profile under K constraint windows and
+        stack the per-window (eta, etaerr, etaerr2); profile/filter come
+        from the first window (identical across windows)."""
+        per = [measure_fn(cmask=cm) for cm in masks]
+        return (jnp.stack([q[0] for q in per]),
+                jnp.stack([q[1] for q in per]),
+                jnp.stack([q[2] for q in per]),
+                per[0][3], per[0][4], noise)
+
+    # ---- static row-interp pattern ------------------------------------
+    # The interpolation positions depend only on the (fdop, scales) grids,
+    # never on the data: precompute the [R, n] gather indices and weights
+    # host-side once, so the device step is one take_along_axis + fused
+    # multiply-adds instead of per-row index arithmetic.
+    def _row_interp_pattern():
+        s = scales[:, None]                                  # [R, 1]
+        blo = (-s - f0) / dfd
+        bhi = (s - f0) / dfd
+        lo = np.clip(np.ceil(blo - _EDGE_EPS * np.abs(blo)).astype(np.int64),
+                     0, ncol - 1)
+        hi = np.clip(np.floor(bhi + _EDGE_EPS * np.abs(bhi)).astype(np.int64),
+                     0, ncol - 1)
+        q = np.clip(fdopnew[None, :] * s, f0 + lo * dfd, f0 + hi * dfd)
+        pos = np.clip((q - f0) / dfd, 0.0, ncol - 1.0)
+        i0 = np.clip(np.floor(pos).astype(np.int64), 0, ncol - 2)
+        w = pos - i0
+        return i0.astype(np.int32), w
+
+    _i0_static, _w_static = _row_interp_pattern()            # [R, n]
+
+    def one_epoch(sspec):
+        # ---- noise estimate (dynspec.py:446-451,463) -------------------
+        noise = _noise_estimate(sspec, cutmid, xp=jnp)
+        noise = noise / (ind - startbin)
+
+        # ---- normalised, delay-scrunched profile -----------------------
+        rows = sspec[startbin:ind_norm, :]
+        rows = jnp.where(col_nan[None, :], jnp.nan, rows)
+
+        if scrunch_rows:
+            # lax.scan over row blocks: the full-gather path materialises
+            # [R, n] (x3 under a B-epoch vmap: [B, R, n] v0/v1/norm in
+            # HBM); accumulating the delay-scrunch nansum/count per block
+            # caps the working set at [B, scrunch_rows, n] regardless of
+            # the delay cut.  Same values as nanmean (sum/count), modulo
+            # f.p. association; NaN-padded tail rows contribute nothing.
+            R = _i0_static.shape[0]
+            nb = -(-R // scrunch_rows)
+            pad = nb * scrunch_rows - R
+            rows_b = jnp.pad(rows, ((0, pad), (0, 0)),
+                             constant_values=np.nan).reshape(
+                                 nb, scrunch_rows, ncol)
+            i0_b = jnp.asarray(np.pad(_i0_static, ((0, pad), (0, 0)))
+                               .reshape(nb, scrunch_rows, n))
+            w_b = jnp.asarray(np.pad(_w_static, ((0, pad), (0, 0)))
+                              .reshape(nb, scrunch_rows, n),
+                              dtype=rows.dtype)
+
+            def body(carry, xs):
+                s, c = carry
+                rc, ic, wc = xs
+                v0 = jnp.take_along_axis(rc, ic, axis=1)
+                v1 = jnp.take_along_axis(rc, ic + 1, axis=1)
+                nrm = v0 * (1.0 - wc) + v1 * wc
+                # nanmean semantics exactly: skip NaN only — a -inf
+                # value (zero-power dB pixel) must poison the mean as it
+                # does on the full-gather path
+                keep = ~jnp.isnan(nrm)
+                s = s + jnp.sum(jnp.where(keep, nrm, 0.0), axis=0)
+                c = c + jnp.sum(keep.astype(s.dtype), axis=0)
+                return (s, c), None
+
+            (s, c), _ = jax.lax.scan(
+                body, (jnp.zeros(n, rows.dtype),
+                       jnp.zeros(n, rows.dtype)),
+                (rows_b, i0_b, w_b))
+            prof = jnp.where(c > 0, s / jnp.maximum(c, 1.0), jnp.nan)
+        else:
+            i0 = jnp.asarray(_i0_static)
+            w = jnp.asarray(_w_static, dtype=rows.dtype)
+            v0 = jnp.take_along_axis(rows, i0, axis=1)
+            v1 = jnp.take_along_axis(rows, i0 + 1, axis=1)
+            norm = v0 * (1.0 - w) + v1 * w                   # [R, n]
+            prof = jnp.nanmean(norm, axis=0)                 # [n]
+        # +2 dB quirk (dynspec.py:864-866)
+        i_at_1 = int(np.argmin(np.abs(fdopnew - 1) - 2))
+        prof = jnp.where(prof[i_at_1] < 0, prof + 2.0, prof)
+
+        # ---- fold arms onto the eta grid -------------------------------
+        def measure_arm(arm, nan_on_forward=False, cmask=None):
+            # arm indexed like ipos (descending eta); flip to ascending
+            avg = arm[::-1]
+            valid = jnp.isfinite(avg) & jnp.asarray(keep_static)
+            return measure_profile(avg, valid, noise,
+                                   jnp.asarray(eta_array),
+                                   cons_mask if cmask is None else cmask,
+                                   use_log=False,
+                                   nan_on_forward=nan_on_forward)
+
+        right = prof[ipos]
+        left = prof[ineg][::-1]
+        combined = (right + left) / 2
+        if constraints is not None:
+            return _stack_windows(
+                functools.partial(measure_arm, combined), cons_masks,
+                noise)
+        out = measure_arm(combined) + (noise,)
+        if asymm:
+            el, eel = measure_arm(left, nan_on_forward=True)[:2]
+            er, eer = measure_arm(right, nan_on_forward=True)[:2]
+            out = out + (el, eel, er, eer)
+        return out
+
+    def measure_profile(avg, valid, noise, ea, cmask, use_log,
+                        nan_on_forward=False):
+        """Masked peak search + power-drop windows + (log-)parabola fit on
+        a power-vs-eta profile — the jit-safe tail shared by both methods
+        (dynspec.py:693-744).
+
+        ``nan_on_forward``: NaN-poison eta/etaerr when the fit is a
+        forward (upward-opening) parabola — the jit-safe analogue of the
+        numpy path's raise (dynspec.py:598-599); used for the per-arm
+        asymm fits where a one-sided spectrum makes a degenerate arm.
+        """
+        # fill invalid (contiguous large-eta tail / NaN centre) with the
+        # lowest valid power so the smoother sees a continuous profile and
+        # the fill can never create a spurious peak (differs from the numpy
+        # path, which smooths the compacted array; tolerance in tests)
+        fill = jnp.nanmin(jnp.where(valid, avg, jnp.nan))
+        avg_f = jnp.where(valid, avg, fill)
+        filt = savgol1(avg_f, nsmooth, xp=jnp)
+
+        # ---- peak within constraint (dynspec.py:693-699) ---------------
+        search = valid & jnp.asarray(cmask)
+        maxval = jnp.max(jnp.where(search, filt, -jnp.inf))
+        peak_ind = jnp.argmin(jnp.where(valid, jnp.abs(filt - maxval),
+                                        jnp.inf))
+        max_power = filt[peak_ind]
+
+        idx = jnp.arange(filt.shape[0])
+
+        last_valid = jnp.max(jnp.where(valid, idx, 0))
+
+        def window(threshold_lo, threshold_hi):
+            # first crossing below/above the peak (clean reformulation of
+            # the reference's while-walks); falls back to the profile ends
+            # when the threshold is never crossed
+            below = (filt <= threshold_lo) & (idx < peak_ind) & valid
+            left = jnp.maximum(jnp.max(jnp.where(below, idx, -1)), 0)
+            above = (filt <= threshold_hi) & (idx > peak_ind) & valid
+            right = jnp.min(jnp.where(above, idx, filt.shape[0]))
+            right = jnp.where(right >= filt.shape[0], last_valid, right)
+            return left, right
+
+        left, right = window(max_power + low_power_diff,
+                             max_power + high_power_diff)
+        w = ((idx >= left) & (idx < right + 1) & valid).astype(filt.dtype)
+        if use_log:
+            yfit, eta, etaerr_fit = fit_log_parabola(ea, avg_f, w=w,
+                                                     xp=jnp)
+        else:
+            yfit, eta, etaerr_fit = _fitpar(ea, avg_f, w=w, xp=jnp)
+
+        etaerr = etaerr_fit
+        if noise_error:
+            jl, jr = window(max_power - noise, max_power - noise)
+            wn_ = (idx >= jl) & (idx < jr + 1) & valid
+            lo_eta = jnp.min(jnp.where(wn_, ea, jnp.inf))
+            hi_eta = jnp.max(jnp.where(wn_, ea, -jnp.inf))
+            etaerr = (hi_eta - lo_eta) / 2
+
+        if nan_on_forward:
+            # mean(gradient(diff(yfit))) > 0 is the reference's forward-
+            # parabola test (dynspec.py:598)
+            fwd = jnp.mean(jnp.gradient(jnp.diff(yfit))) > 0
+            eta = jnp.where(fwd, jnp.nan, eta)
+            etaerr = jnp.where(fwd, jnp.nan, etaerr)
+
+        return eta, etaerr, etaerr_fit, avg_f, filt
+
+    # ---- gridmax statics (dynspec.py:516-659) --------------------------
+    if method == "gridmax":
+        nrow_g = ind  # delay rows kept
+        eta_array_g = np.linspace(np.sqrt(emin), np.sqrt(emax),
+                                  int(numsteps)) ** 2
+        cons_masks_g = [(eta_array_g > c[0]) & (eta_array_g < c[1])
+                        for c in cons_windows]
+        cons_mask_g = cons_masks_g[0]
+        for cm, w in zip(cons_masks_g, cons_windows):
+            _check_constraint(cm, eta_array_g, window=w)
+        # fit-level cutmid mask: floor/CEIL (dynspec.py:455-457) — one
+        # column wider on the high side than norm_sspec's floor/floor mask
+        col_nan_g = np.zeros(ncol, dtype=bool)
+        col_nan_g[int(ncol / 2 - np.floor(cutmid / 2)):
+                  int(ncol / 2 + np.ceil(cutmid / 2))] = True
+        x_f = fdop
+        # reference pixel mapping: column positions are STATIC
+        # (dynspec.py:540: scaled by shape, not shape-1 — quirk kept)
+        xpx = (x_f - x_f.min()) / (x_f.max() - x_f.min()) * ncol
+        col_ok = (xpx >= 0) & (xpx <= ncol - 1)     # cval=nan analogue
+        jx0 = np.clip(np.floor(xpx).astype(np.int32), 0, ncol - 2)
+        wx = (xpx - jx0).astype(np.float64)
+        xmin2 = float(np.min(x_f ** 2))
+        ymax_g = float(yc.max())
+        side_l = x_f < 0
+        side_r = x_f > 0
+        chunk = 256  # [chunk, ncol] sampling slabs bound device memory
+
+        def one_epoch_gridmax(sspec):
+            noise = _noise_estimate(sspec, cutmid, xp=jnp)
+            noise = noise / (ind - startbin)
+
+            z = sspec[:ind, :]
+            z = jnp.where(col_nan_g[None, :], jnp.nan, z)
+            z = z.at[:startbin, :].set(jnp.nan)
+
+            x2 = jnp.asarray(x_f ** 2)
+            jx0_j = jnp.asarray(jx0)
+            wx_j = jnp.asarray(wx)
+
+            def sample_eta(ieta):
+                ynew = ieta * x2
+                ymin = ieta * xmin2
+                ynewpx = (ynew - ymin) / (ymax_g - ymin) * nrow_g
+                row_ok = (ynewpx >= 0) & (ynewpx <= nrow_g - 1)
+                iy0 = jnp.clip(jnp.floor(ynewpx).astype(jnp.int32), 0,
+                               nrow_g - 2)
+                wy = ynewpx - iy0
+                v = (z[iy0, jx0_j] * (1 - wy) * (1 - wx_j)
+                     + z[iy0 + 1, jx0_j] * wy * (1 - wx_j)
+                     + z[iy0, jx0_j + 1] * (1 - wy) * wx_j
+                     + z[iy0 + 1, jx0_j + 1] * wy * wx_j)
+                v = jnp.where(row_ok & jnp.asarray(col_ok), v, jnp.nan)
+                inarc = ynew < ymax_g
+
+                def side_mean(side):
+                    ok = jnp.isfinite(v) & inarc & jnp.asarray(side)
+                    s = jnp.sum(jnp.where(ok, v, 0.0))
+                    c = jnp.sum(ok)
+                    return jnp.where(c > 0, s / jnp.maximum(c, 1), jnp.nan)
+
+                sl, sr = side_mean(side_l), side_mean(side_r)
+                return jnp.stack([(sl + sr) / 2, sl, sr])
+
+            # chunked over the eta grid: [chunk, ncol] slabs, not [S, ncol]
+            S = len(eta_array_g)
+            pad = (-S) % chunk
+            eta_p = jnp.asarray(np.pad(eta_array_g, (0, pad),
+                                       constant_values=1.0))
+            pows = jax.lax.map(jax.vmap(sample_eta),
+                               eta_p.reshape(-1, chunk)
+                               ).reshape(-1, 3)[:S]
+
+            def measure_pow(p, nan_on_forward=False, cmask=None):
+                return measure_profile(p, jnp.isfinite(p), noise,
+                                       jnp.asarray(eta_array_g),
+                                       cons_mask_g if cmask is None
+                                       else cmask, use_log=True,
+                                       nan_on_forward=nan_on_forward)
+
+            if constraints is not None:
+                return _stack_windows(
+                    functools.partial(measure_pow, pows[:, 0]),
+                    cons_masks_g, noise)
+            out = measure_pow(pows[:, 0]) + (noise,)
+            if asymm:
+                el, eel = measure_pow(pows[:, 1],
+                                      nan_on_forward=True)[:2]
+                er, eer = measure_pow(pows[:, 2],
+                                      nan_on_forward=True)[:2]
+                out = out + (el, eel, er, eer)
+            return out
+
+        epoch_fn = one_epoch_gridmax
+        profile_eta_out = eta_array_g
+    else:
+        epoch_fn = one_epoch
+        profile_eta_out = eta_array
+
+    @jax.jit
+    def impl(sspec_batch):
+        res = jax.vmap(epoch_fn)(sspec_batch)
+        eta, etaerr, etaerr2, avg, filt, noise = res[:6]
+        arms = {}
+        if asymm:
+            arms = dict(zip(("eta_left", "etaerr_left", "eta_right",
+                             "etaerr_right"), res[6:10]))
+        return ArcFit(eta=eta, etaerr=etaerr, etaerr2=etaerr2,
+                      lamsteps=lamsteps,
+                      profile_eta=jnp.asarray(profile_eta_out),
+                      profile_power=avg, profile_power_filt=filt,
+                      noise=noise, **arms)
+
+    return impl
+
+
+def make_arc_fitter(fdop, yaxis, tdel, freq, lamsteps=True,
+                    method="norm_sspec", delmax=None, numsteps=1024,
+                    startbin=3, cutmid=3, etamax=None, etamin=None,
+                    low_power_diff=-3.0, high_power_diff=-1.5,
+                    ref_freq=1400.0, constraint=(0, np.inf), nsmooth=5,
+                    noise_error=True, asymm=False, constraints=None,
+                    scrunch_rows=0):
+    """Build a jit'd batched arc fitter for a fixed (fdop, yaxis) grid.
+
+    Returns ``fitter(sspec_batch [B, nr, nc]) -> ArcFit`` of [B] arrays.
+    All grid-dependent decisions (delay cut, eta grid, fold indices) are
+    made host-side once; the per-epoch measurement is pure fixed-shape jax.
+    Both reference methods are implemented: ``norm_sspec`` (row
+    normalisation) and ``gridmax`` (chunked bilinear sampling along
+    ``tdel = eta fdop^2`` trial arcs).
+
+    ``scrunch_rows`` (norm_sspec only): 0 materialises the full [R, n]
+    row-resample ([B, R, n] under a batch); a positive value accumulates
+    the delay-scrunch over lax.scan blocks of that many rows, trading
+    one big gather for bounded HBM working set — same values modulo
+    floating-point association.
+    """
+    if method not in ("norm_sspec", "gridmax"):
+        raise ValueError(f"unknown arc fitting method {method!r}")
+    if int(scrunch_rows) < 0:
+        raise ValueError(f"scrunch_rows must be >= 0, got {scrunch_rows}")
+    fdop = np.ascontiguousarray(np.asarray(fdop, dtype=np.float64))
+    yaxis = np.ascontiguousarray(np.asarray(yaxis, dtype=np.float64))
+    tdel = np.ascontiguousarray(np.asarray(tdel, dtype=np.float64))
+    key = lambda a: (a.tobytes(), a.shape)  # noqa: E731
+    return _make_arc_fitter_cached(
+        key(fdop), key(yaxis), key(tdel), float(freq), bool(lamsteps),
+        method, None if delmax is None else float(delmax), int(numsteps),
+        int(startbin), int(cutmid),
+        None if etamax is None else float(etamax),
+        None if etamin is None else float(etamin), float(low_power_diff),
+        float(high_power_diff), float(ref_freq),
+        (float(constraint[0]), float(constraint[1])), int(nsmooth),
+        bool(noise_error), bool(asymm),
+        None if constraints is None else tuple(
+            (float(lo), float(hi)) for lo, hi in constraints),
+        int(scrunch_rows))
+
+
+def fit_arcs_multi(sec: SecSpec, freq: float, brackets,
+                   method: str = "norm_sspec", backend: str = "numpy",
+                   low_power_diff: float = -3.0,
+                   high_power_diff: float = -1.5,
+                   noise_error: bool = True, **kw) -> list[ArcFit]:
+    """Measure several arcs in one secondary spectrum (the reference's
+    multi-arc mode: etamin/etamax arrays segment the sqrt-eta grid,
+    dynspec.py:470-491).
+
+    ``brackets`` is a sequence of (eta_lo, eta_hi) curvature windows (same
+    units as the fit: beta-eta for lamsteps spectra; ``None`` bounds mean
+    open-ended).  The global power-vs-curvature profile is computed ONCE,
+    then each arc is measured with the peak search constrained to its
+    window, as in the reference where one eta grid serves all arcs.
+    Returns one ArcFit per bracket.
+    """
+    brackets = [(0.0 if lo is None else float(lo),
+                 np.inf if hi is None else float(hi))
+                for lo, hi in brackets]
+    if method == "thetatheta":
+        # each arc is its own bounded eigen-sweep: no shared profile to
+        # reuse, and the bracket must be finite
+        for lo, hi in brackets:
+            if not (np.isfinite(lo) and np.isfinite(hi) and lo > 0):
+                raise ValueError("thetatheta multi-arc brackets must be "
+                                 "finite positive (lo, hi) windows")
+        return [fit_arc(sec, freq, method=method, backend=backend,
+                        etamin=lo, etamax=hi,
+                        low_power_diff=low_power_diff,
+                        high_power_diff=high_power_diff,
+                        noise_error=noise_error, **kw)
+                for lo, hi in brackets]
+    # one full-profile fit (first bracket as the constraint just to get a
+    # valid measurement); its profile/filter/noise are then re-measured
+    # per window without recomputing the expensive normalisation
+    first = fit_arc(sec, freq, method=method, backend=backend,
+                    constraint=brackets[0],
+                    low_power_diff=low_power_diff,
+                    high_power_diff=high_power_diff,
+                    noise_error=noise_error, **kw)
+    fits = [first]
+    eta_array = np.asarray(first.profile_eta)
+    power = np.asarray(first.profile_power)
+    filt = np.asarray(first.profile_power_filt)
+    noise = float(np.asarray(first.noise))
+    # profile_eta lives in converted (beta-eta) units for non-lamsteps
+    # spectra (fit_arc converts internally, arc_fit.py:244-247): apply the
+    # same conversion to the remaining brackets so all arcs are windowed
+    # in consistent units
+    ref_freq = kw.get("ref_freq", 1400.0)
+    conv = 1.0 if sec.lamsteps else \
+        _beta_to_eta_factor(freq, ref_freq) / (freq / ref_freq) ** 2
+    for lo, hi in brackets[1:]:
+        fits.append(_measure_peak(
+            eta_array, power, filt, noise, (lo * conv, hi * conv),
+            low_power_diff, high_power_diff, noise_error, sec.lamsteps,
+            log_fit=(method == "gridmax")))
+    return fits

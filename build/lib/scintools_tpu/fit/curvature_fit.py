@@ -1,0 +1,137 @@
+"""Screen-parameter fitting from arc-curvature time series.
+
+The reference ships ``arc_curvature`` as an lmfit residual callback
+(scint_models.py:266-315) and leaves the actual fitting to user scripts
+(the notebook workflow).  This module provides the complete measurement:
+given per-epoch curvatures eta(t) (from ``fit_arc`` over a survey), fit
+the physical screen model — fractional distance ``s``, pulsar distance
+``d``, anisotropy axis ``psi``, screen velocity ``vism_psi``/``vism_ra``/
+``vism_dec`` — with the Earth ephemeris and binary orbit evaluated from
+the built-in analytic astro module (no astropy / tempo2 runtime needed).
+
+Both engines: scipy least squares (CPU) and the fixed-iteration jax LM
+(vmappable over pulsars for population fits).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from ..astro import get_earth_velocity, get_true_anomaly
+from ..backend import resolve
+from ..models.velocity import arc_curvature_residuals
+from .lm import LsqResult, least_squares_numpy, lm_fit_jax
+
+# default box bounds per fittable key
+_BOUNDS = {
+    "s": (1e-3, 1 - 1e-3),
+    "d": (1e-3, 30.0),          # kpc
+    "psi": (0.0, 180.0),        # deg
+    "vism_psi": (-300.0, 300.0),  # km/s
+    "vism_ra": (-300.0, 300.0),
+    "vism_dec": (-300.0, 300.0),
+}
+
+
+def fit_arc_curvature(eta_obs, mjds, pars: dict, raj: float, decj: float,
+                      fit_keys: Sequence[str] = ("s", "vism_psi"),
+                      etaerr=None, backend: str = "numpy",
+                      steps: int = 60, n_starts: int = 5
+                      ) -> tuple[dict, dict, LsqResult]:
+    """Fit screen parameters to measured curvatures eta(t).
+
+    Parameters
+    ----------
+    eta_obs : [N] measured curvatures (1/(m mHz^2)), one per MJD.
+    mjds : [N] epochs.
+    pars : model parameters (par-file keys + screen keys); entries named
+        in ``fit_keys`` are optimised from their values here, the rest
+        stay fixed.  Keplerian keys (T0/PB/ECC/...) enable the binary
+        term; ``psi`` in pars (or fit_keys) selects the anisotropic
+        model (scint_models.py:295-303).
+    raj, decj : source position (radians) for the Earth-velocity
+        projection.
+    etaerr : optional [N] 1-sigma errors -> weights 1/etaerr.
+    n_starts : the model ``eta = d s(1-s)/(2 veff(s)^2)`` is multimodal
+        in ``s`` (near-symmetric about 1/2 when the pulsar term is
+        small); when ``s`` is fitted, the optimiser restarts from
+        ``n_starts`` values spread over (0, 1) and keeps the lowest-cost
+        solution.
+
+    Returns (best_fit dict, errors dict, LsqResult).
+    """
+    backend = resolve(backend)
+    eta_obs = np.asarray(eta_obs, dtype=np.float64)
+    mjds = np.asarray(mjds, dtype=np.float64)
+    for k in fit_keys:
+        if k not in _BOUNDS:
+            raise ValueError(f"unknown fit key {k!r}; choose from "
+                             f"{sorted(_BOUNDS)}")
+        if k not in pars:
+            raise ValueError(f"fit key {k!r} needs a starting value in "
+                             f"pars")
+    weights = None if etaerr is None else 1.0 / np.asarray(etaerr,
+                                                           dtype=np.float64)
+
+    # host-side ephemeris (concrete MJDs)
+    nu = get_true_anomaly(mjds, pars) if "PB" in pars else np.zeros_like(
+        mjds)
+    v_ra, v_dec = get_earth_velocity(mjds, raj, decj)
+
+    p0 = np.array([float(pars[k]) for k in fit_keys])
+    lo = np.array([_BOUNDS[k][0] for k in fit_keys])
+    hi = np.array([_BOUNDS[k][1] for k in fit_keys])
+
+    # multi-start over s (the multimodal axis): the given start plus a
+    # spread across (0, 1)
+    starts = [p0]
+    if "s" in fit_keys and n_starts > 1:
+        i_s = list(fit_keys).index("s")
+        for sv in np.linspace(0.15, 0.85, n_starts - 1):
+            alt = p0.copy()
+            alt[i_s] = sv
+            starts.append(alt)
+
+    fixed = {k: v for k, v in pars.items() if k not in fit_keys}
+
+    if backend == "numpy":
+        def resid(p):
+            trial = dict(fixed, **{k: p[i] for i, k in enumerate(fit_keys)})
+            return arc_curvature_residuals(trial, eta_obs, weights, nu,
+                                           v_ra, v_dec, xp=np)
+
+        fits = [least_squares_numpy(resid, s0, bounds=(lo, hi))
+                for s0 in starts]
+        res = min(fits, key=lambda r: float(r.cost))
+    else:
+        import jax
+        import jax.numpy as jnp
+
+        w_j = None if weights is None else jnp.asarray(weights)
+        data = (jnp.asarray(eta_obs), jnp.asarray(nu), jnp.asarray(v_ra),
+                jnp.asarray(v_dec))
+
+        def resid_j(p, eta, nu_, vra, vdec):
+            trial = dict(fixed, **{k: p[i] for i, k in
+                                   enumerate(fit_keys)})
+            return arc_curvature_residuals(trial, eta, w_j, nu_, vra,
+                                           vdec, xp=jnp)
+
+        # all starts fitted in one vmapped trace (no per-start retrace)
+        fit_all = jax.vmap(lambda s0: lm_fit_jax(
+            resid_j, s0, bounds=(jnp.asarray(lo), jnp.asarray(hi)),
+            args=data, steps=steps))
+        res_all = fit_all(jnp.asarray(np.stack(starts)))
+        best_i = int(np.argmin(np.asarray(res_all.cost)))
+        res = jax.tree_util.tree_map(lambda x: x[best_i], res_all)
+
+    best = dict(pars)
+    errors = {}
+    params = np.asarray(res.params)
+    stderr = np.asarray(res.stderr)
+    for i, k in enumerate(fit_keys):
+        best[k] = float(params[i])
+        errors[k] = float(stderr[i])
+    return best, errors, res

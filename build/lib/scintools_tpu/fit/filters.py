@@ -1,0 +1,49 @@
+"""Fixed-shape smoothing filters for jit'd fitting pipelines.
+
+The reference smooths arc power profiles with
+``scipy.signal.savgol_filter(x, nsmooth, 1)`` (dynspec.py:560,691).  scipy's
+default edge mode ('interp') fits a polynomial to the first/last window and
+evaluates it at the edge positions.  :func:`savgol1` reproduces that exactly
+for polyorder=1 with static shapes: interior via correlation with the
+(uniform) order-1 coefficients, edges via closed-form linear regression —
+differentiable and vmappable.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def savgol1(y, window: int, xp=np):
+    """Savitzky–Golay, polyorder=1, scipy mode='interp' semantics.
+
+    For polyorder 1 the interior coefficients are the uniform moving
+    average; the first/last ``window//2`` samples come from a straight-line
+    fit to the first/last ``window`` samples."""
+    if window % 2 != 1:
+        raise ValueError("window must be odd")
+    half = window // 2
+    n = y.shape[-1]
+    if n < window:
+        raise ValueError(f"window {window} longer than data {n}")
+
+    kernel = xp.ones(window) / window
+    if xp is np:
+        mid = np.convolve(y, kernel, mode="valid")
+    else:
+        mid = xp.convolve(y, kernel, mode="valid")
+
+    # closed-form linear fit over the first/last window evaluated at the
+    # in-window positions 0..half-1 (and mirrored at the tail)
+    t = xp.arange(window)
+    tbar = (window - 1) / 2.0
+    denom = xp.sum((t - tbar) ** 2)
+
+    def line(seg, pos):
+        b = xp.sum((t - tbar) * seg) / denom
+        a = xp.mean(seg) - b * tbar
+        return a + b * pos
+
+    head = line(y[..., :window], xp.arange(half))
+    tail = line(y[..., -window:], xp.arange(window - half, window))
+    return xp.concatenate([head, mid, tail], axis=-1)

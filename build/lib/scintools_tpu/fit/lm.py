@@ -1,0 +1,153 @@
+"""Least-squares engines.
+
+The reference drives all parameter fits through lmfit's MINPACK wrapper
+(``Minimizer(...).minimize()``, dynspec.py:987).  lmfit's data-dependent
+iteration counts cannot vmap, so the TPU path here is a **fixed-iteration
+Levenberg–Marquardt** with box bounds by projection: every epoch in a batch
+runs the same instruction stream (SPMD-uniform), making ``vmap``/``pmap``
+over thousands of epochs trivial.  The numpy path wraps
+``scipy.optimize.least_squares`` (same convergence class as lmfit) for the
+reference-equivalent CPU behaviour.
+
+Both return :class:`LsqResult` with lmfit-style stderr: the square root of
+``diag(inv(J^T J) * redchi)``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class LsqResult:
+    params: Any       # [P] best-fit vector
+    stderr: Any       # [P] 1-sigma errors (lmfit-style scaled covariance)
+    cov: Any          # [P, P]
+    redchi: Any       # reduced chi^2
+    cost: Any         # 0.5 * sum(residual^2) at optimum
+
+
+def _register():
+    try:
+        import jax
+
+        jax.tree_util.register_pytree_node(
+            LsqResult,
+            lambda r: ((r.params, r.stderr, r.cov, r.redchi, r.cost), None),
+            lambda _, l: LsqResult(*l))
+    except ImportError:  # pragma: no cover
+        pass
+
+
+_register()
+
+
+def _covariance(xp, J, r, n_par):
+    """lmfit-style scaled covariance: inv(J^T J) * redchi."""
+    n = r.shape[0]
+    dof = max(n - n_par, 1) if isinstance(n, int) else n - n_par
+    redchi = (r @ r) / dof
+    JTJ = J.T @ J
+    cov = xp.linalg.inv(JTJ + 1e-300 * xp.eye(n_par)) * redchi
+    return cov, redchi
+
+
+def least_squares_numpy(residual_fn: Callable, p0, bounds=None,
+                        args: Sequence = ()) -> LsqResult:
+    """scipy TRF least squares (CPU path)."""
+    from scipy.optimize import least_squares as _ls
+
+    p0 = np.asarray(p0, dtype=np.float64)
+    if bounds is None:
+        lo, hi = -np.inf, np.inf
+    else:
+        lo = np.asarray(bounds[0], dtype=np.float64)
+        hi = np.asarray(bounds[1], dtype=np.float64)
+        # TRF requires a strictly interior start
+        hi_in = np.where(np.isfinite(hi), hi - 1e-12, hi)
+        lo_in = np.where(np.isfinite(lo), lo + 1e-12, lo)
+        p0 = np.clip(p0, lo_in, hi_in)
+    sol = _ls(lambda p: np.asarray(residual_fn(p, *args), dtype=np.float64),
+              p0, bounds=(lo, hi))
+    cov, redchi = _covariance(np, sol.jac, sol.fun, p0.size)
+    return LsqResult(params=sol.x, stderr=np.sqrt(np.abs(np.diag(cov))),
+                     cov=cov, redchi=redchi, cost=0.5 * sol.fun @ sol.fun)
+
+
+def lm_fit_jax(residual_fn: Callable, p0, bounds=None, args: Sequence = (),
+               steps: int = 30, lam0: float = 1e-3, lam_up: float = 10.0,
+               lam_down: float = 0.3):
+    """Fixed-iteration damped LM with box projection; fully jittable and
+    vmappable (no data-dependent control flow; rejected steps raise the
+    damping instead of re-solving).
+
+    residual_fn(p, *args) -> [N]; p0 [P].  Returns LsqResult of jax arrays.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    p0 = jnp.asarray(p0, dtype=jnp.result_type(float))
+    n_par = p0.shape[0]
+    if bounds is not None:
+        lo = jnp.asarray(bounds[0], dtype=p0.dtype)
+        hi = jnp.asarray(bounds[1], dtype=p0.dtype)
+        project = lambda p: jnp.clip(p, lo, hi)  # noqa: E731
+    else:
+        project = lambda p: p  # noqa: E731
+
+    def step(state, _):
+        # residual and cost at the current point ride in the carry, so each
+        # iteration evaluates residual_fn once (at the trial point) plus one
+        # jacobian — a rejected step reuses the carried (r, c) unchanged
+        p, r, c, lam = state
+        J = jax.jacfwd(residual_fn)(p, *args)
+        g = J.T @ r
+        JTJ = J.T @ J
+        damp = lam * jnp.diag(jnp.diag(JTJ)) + 1e-12 * jnp.eye(n_par)
+        dp = jnp.linalg.solve(JTJ + damp, -g)
+        p_try = project(p + dp)
+        r_try = residual_fn(p_try, *args)
+        c_try = 0.5 * (r_try @ r_try)
+        better = c_try < c
+        p_new = jnp.where(better, p_try, p)
+        r_new = jnp.where(better, r_try, r)
+        c_new = jnp.where(better, c_try, c)
+        lam_new = jnp.where(better, lam * lam_down, lam * lam_up)
+        return (p_new, r_new, c_new, lam_new), None
+
+    p_init = project(p0)
+    r0 = residual_fn(p_init, *args)
+    c0 = 0.5 * (r0 @ r0)
+    (p_fin, r, c_fin, _), _ = jax.lax.scan(
+        step, (p_init, r0, c0, jnp.asarray(lam0, dtype=p0.dtype)),
+        length=steps)
+    J = jax.jacfwd(residual_fn)(p_fin, *args)
+    cov, redchi = _covariance(jnp, J, r, n_par)
+    return LsqResult(params=p_fin, stderr=jnp.sqrt(jnp.abs(jnp.diag(cov))),
+                     cov=cov, redchi=redchi, cost=c_fin)
+
+
+@functools.lru_cache(maxsize=None)
+def _batched_lm(residual_fn, steps, n_batched_args):
+    """jit'd vmap of lm_fit_jax over leading batch axes of p0/bounds/args."""
+    import jax
+
+    def single(p0, lo, hi, *args):
+        return lm_fit_jax(residual_fn, p0, bounds=(lo, hi), args=args,
+                          steps=steps)
+
+    inner = jax.vmap(single, in_axes=(0, None, None) + (0,) * n_batched_args)
+    return jax.jit(inner)
+
+
+def lm_fit_batched(residual_fn: Callable, p0, bounds, args: Sequence,
+                   steps: int = 30) -> LsqResult:
+    """Fit B independent problems at once: p0 [B, P], every element of
+    ``args`` [B, ...]; bounds shared.  ``residual_fn`` must be a module-level
+    (hashable) function for the jit cache."""
+    fn = _batched_lm(residual_fn, steps, len(args))
+    return fn(p0, bounds[0], bounds[1], *args)

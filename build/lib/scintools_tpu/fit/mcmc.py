@@ -1,0 +1,182 @@
+"""jax-native affine-invariant ensemble MCMC (Goodman & Weare 2010).
+
+The reference's posterior option is ``lmfit.Minimizer.emcee`` + corner
+plots inside ``get_scint_params(mcmc=True)`` (dynspec.py:989-992,
+1025-1031).  Neither lmfit nor emcee is a dependency here, so this module
+implements the same stretch-move ensemble algorithm natively in JAX:
+every walker move is a fixed-shape ``lax.scan`` step with the walker
+batch vmapped, so a whole posterior sampling run is ONE jit-compiled
+device program (and itself vmappable over epochs).
+
+The parallel stretch move: walkers are split into two halves; each half
+proposes along lines through partners drawn from the *other* half with
+scale ``z ~ g(z) ∝ 1/sqrt(z)`` on [1/a, a], accepted with probability
+``z^(ndim-1) L(prop)/L(cur)`` — which preserves detailed balance and is
+affine-invariant (no tuning to parameter scales/correlations).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..data import ScintParams
+
+
+def _build_sampler(ndim: int, nwalkers: int, steps: int, a: float,
+                   log_prob_fn):
+    """jit'd sampler for ``log_prob_fn(p, *data_args) -> scalar``.
+
+    Data flows through as traced arguments (NOT captured in the closure),
+    so factories that cache the result stay cacheable on static shapes.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    half = nwalkers // 2
+
+    def update_half(key, group, other, lp_group, data):
+        kz, ki, ka = jax.random.split(key, 3)
+        z = ((a - 1.0) * jax.random.uniform(kz, (half,)) + 1.0) ** 2 / a
+        idx = jax.random.randint(ki, (half,), 0, half)
+        prop = other[idx] + z[:, None] * (group - other[idx])
+        lp_prop = jax.vmap(lambda p: log_prob_fn(p, *data))(prop)
+        log_ratio = (ndim - 1) * jnp.log(z) + lp_prop - lp_group
+        accept = jnp.log(jax.random.uniform(ka, (half,))) < log_ratio
+        return (jnp.where(accept[:, None], prop, group),
+                jnp.where(accept, lp_prop, lp_group))
+
+    @jax.jit
+    def run(key, p0, *data):
+        lp0 = jax.vmap(lambda p: log_prob_fn(p, *data))(p0)
+
+        def one_step(carry, key):
+            w, lp = carry
+            k1, k2 = jax.random.split(key)
+            g1, g2 = w[:half], w[half:]
+            l1, l2 = lp[:half], lp[half:]
+            g1, l1 = update_half(k1, g1, g2, l1, data)
+            g2, l2 = update_half(k2, g2, g1, l2, data)
+            w = jnp.concatenate([g1, g2])
+            lp = jnp.concatenate([l1, l2])
+            return (w, lp), (w, lp)
+
+        keys = jax.random.split(key, steps)
+        _, (chain, lps) = jax.lax.scan(one_step, (p0, lp0), keys)
+        return chain, lps
+
+    return run
+
+
+def ensemble_sample(log_prob_fn, p0, key=None, steps: int = 500,
+                    a: float = 2.0, data_args: tuple = ()):
+    """Sample ``log_prob_fn`` with the stretch-move ensemble.
+
+    p0: [nwalkers, ndim] initial walkers (nwalkers even, >= 2*ndim
+    recommended).  Returns (chain [steps, nwalkers, ndim],
+    log_probs [steps, nwalkers]) as device arrays.
+
+    ``log_prob_fn(p, *data_args)`` must be jax-traceable over a [ndim]
+    vector, returning a scalar (``-jnp.inf`` outside the prior support).
+    Each call builds and jit-compiles a fresh sampler; for repeated
+    sampling over many datasets of the same shape, build once via the
+    cached factories (see :func:`fit_scint_params_mcmc`) or close over
+    ``jax.jit`` yourself.
+    """
+    import jax
+
+    p0 = np.asarray(p0)
+    if p0.ndim != 2 or p0.shape[0] % 2:
+        raise ValueError("p0 must be [nwalkers(even), ndim]")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    run = _build_sampler(p0.shape[1], p0.shape[0], int(steps), float(a),
+                         log_prob_fn)
+    return run(key, p0, *data_args)
+
+
+@functools.lru_cache(maxsize=32)
+def _scint_sampler_cached(nt: int, nf: int, alpha: float | None,
+                          nwalkers: int, steps: int):
+    """Sampler for the scint-params posterior, cached on static shapes
+    only; the per-epoch data (lags, ACF cuts, noise scale) are traced
+    arguments, so surveys over many epochs reuse one compiled program.
+    ``alpha=None`` samples the power-law index as a fifth dimension."""
+    import jax.numpy as jnp
+
+    from ..models.acf_models import scint_acf_model
+
+    free = alpha is None
+
+    def log_prob(p, x_t, x_f, y, sigma):
+        tau, dnu, amp, wn = p[0], p[1], p[2], p[3]
+        a_ = p[4] if free else alpha
+        inside = (tau > 0) & (dnu > 0) & (amp > 0) & (wn >= 0)
+        if free:
+            inside = inside & (a_ > 0) & (a_ < 8.0)
+        model = scint_acf_model(x_t, x_f, tau, dnu, amp, wn, a_,
+                                xp=jnp)
+        chi2 = jnp.sum(((y - model) / sigma) ** 2)
+        return jnp.where(inside, -0.5 * chi2, -jnp.inf)
+
+    return _build_sampler(5 if free else 4, nwalkers, steps, 2.0, log_prob)
+
+
+def fit_scint_params_mcmc(acf2d, dt, df, nchan: int, nsub: int,
+                          alpha: float | None = 5 / 3, nwalkers: int = 32,
+                          steps: int = 600, burn: int = 300,
+                          seed: int = 0, return_chain: bool = False):
+    """Posterior tau/dnu/amp/wn via ensemble MCMC around the LM solution
+    (the reference's ``get_scint_params(mcmc=True)``, dynspec.py:989-992).
+
+    Gaussian likelihood on the 1-D ACF cuts with the noise scale taken
+    from the LM best fit's residual; positivity priors.  Returns a
+    :class:`ScintParams` with posterior medians and stds (and the
+    post-burn chain [steps-burn, nwalkers, 4] when ``return_chain``);
+    ``redchi`` is the deterministic LM fit's reduced chi-square (the
+    posterior itself has no independent noise estimate to judge with).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.acf_models import scint_acf_model
+    from .scint_fit import acf_cuts, fit_scint_params
+
+    if burn >= steps:
+        raise ValueError(f"burn ({burn}) must be < steps ({steps})")
+
+    # start from the deterministic fit
+    free = alpha is None
+    lm = fit_scint_params(acf2d, dt, df, nchan, nsub, alpha=alpha,
+                          backend="numpy")
+    alpha_best = float(np.asarray(lm.talpha))
+    p_best = np.array([float(lm.tau), float(lm.dnu), float(lm.amp),
+                       float(lm.wn)] + ([alpha_best] if free else []))
+    ndim = len(p_best)
+    x_t, y_t, x_f, y_f = acf_cuts(np.asarray(acf2d, dtype=np.float64),
+                                  dt, df, nchan, nsub, xp=np)
+    y = np.concatenate([y_t, y_f])
+    resid = y - scint_acf_model(x_t, x_f, *p_best[:4], alpha_best, xp=np)
+    sigma = max(float(np.std(resid)), 1e-12)
+
+    rng = np.random.default_rng(seed)
+    p0 = p_best * (1.0 + 0.01 * rng.standard_normal((nwalkers, ndim)))
+    p0 = np.abs(p0) + 1e-12
+    run = _scint_sampler_cached(len(x_t), len(x_f),
+                                None if free else float(alpha),
+                                int(nwalkers), int(steps))
+    chain, _ = run(jax.random.PRNGKey(seed), jnp.asarray(p0),
+                   jnp.asarray(x_t), jnp.asarray(x_f), jnp.asarray(y),
+                   jnp.asarray(sigma))
+    post = np.asarray(chain[burn:]).reshape(-1, ndim)
+    med = np.median(post, axis=0)
+    std = np.std(post, axis=0)
+    out = ScintParams(tau=med[0], tauerr=std[0], dnu=med[1], dnuerr=std[1],
+                      amp=med[2], wn=med[3],
+                      talpha=med[4] if free else alpha,
+                      talphaerr=std[4] if free else None,
+                      redchi=float(np.asarray(lm.redchi)))
+    if return_chain:
+        return out, np.asarray(chain[burn:])
+    return out

@@ -1,0 +1,358 @@
+"""Theta-theta transform: eigenvector-based arc-curvature measurement.
+
+A beyond-reference capability (the reference measures curvature only by
+power-profile peak fitting, dynspec.py:414-785).  The theta-theta method
+(Sprenger et al. 2021; Baker et al. 2022) remaps the secondary spectrum
+from (f_D, tau) to scattered-image angular coordinates (theta1, theta2):
+interference between images at theta1 and theta2 appears at
+
+    f_D  = theta1 - theta2          (Doppler: velocity difference)
+    tau  = eta * (theta1^2 - theta2^2)   (delay: geometric path difference)
+
+where theta is measured in Doppler units (so the main arc maps to the
+theta2=0 / theta1=0 axes).  At the TRUE curvature the remapped amplitude
+matrix is approximately the outer product of the single scattered-image
+profile — i.e. rank-1 — so the top-eigenmode energy fraction of the
+(symmetrised) theta-theta matrix peaks at the true eta.  This gives a
+narrow curvature response and works per-arc on multi-arc spectra (each
+arc measured in its own eta bracket).
+
+Everything is fixed-shape: the map is bilinear gathers on a static theta
+grid (ONE implementation shared by both backends via the xp-namespace
+pattern), the concentration metric is a fixed-step power iteration, and
+the eta sweep is a lax.map — one jit per (grid geometry, ntheta) on the
+jax backend.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..backend import resolve
+from ..data import SecSpec
+
+
+def _power_linear(sec: SecSpec, startbin: int = 3,
+                  cutmid: int = 3) -> np.ndarray:
+    """Secondary spectrum as linear AMPLITUDE (sqrt of power, undoing the
+    dB of calc_sspec), NaNs -> 0.  With amplitudes the theta-theta matrix
+    of a single scattered image is the outer product |h(theta1)||h(theta2)|
+    — exactly rank 1 — which is what the concentration metric detects.
+
+    The first ``startbin`` delay rows and central ``cutmid`` Doppler
+    columns are zeroed (same masking as fit_arc, dynspec.py:455-457):
+    the spectral origin maps onto the theta1=theta2 diagonal at EVERY
+    trial eta, so leaving it in biases the concentration sweep."""
+    s = np.asarray(sec.sspec, dtype=np.float64)
+    p = 10.0 ** (s / 20.0)   # sqrt(10^(dB/10))
+    p[~np.isfinite(p)] = 0.0
+    if startbin:
+        p[:startbin, :] = 0.0
+    if cutmid:
+        nc = p.shape[1]
+        p[:, nc // 2 - cutmid // 2: nc // 2 + (cutmid + 1) // 2] = 0.0
+    return p
+
+
+def _tt_remap(power, eta, t1, t2, f0_fd, d_fd, nfd, t0_t, d_t, nt, xp):
+    """Bilinear theta-theta remap — the single implementation behind both
+    backends (pass xp=np or jax.numpy).  ``power`` [nt, nfd] amplitude;
+    t1/t2 the theta grid as column/row; returns [ntheta, ntheta]."""
+    fd = t1 - t2
+    tau = eta * (t1 ** 2 - t2 ** 2)
+    # conjugate symmetry P(-fd, -tau) = P(fd, tau): fold tau >= 0
+    neg = tau < 0
+    fd = xp.where(neg, -fd, fd)
+    tau = xp.abs(tau)
+    fi = (fd - f0_fd) / d_fd
+    ti = (tau - t0_t) / d_t
+    inb = (fi >= 0) & (fi <= nfd - 1) & (ti >= 0) & (ti <= nt - 1)
+    fi = xp.clip(fi, 0, nfd - 1 - 1e-9)
+    ti = xp.clip(ti, 0, nt - 1 - 1e-9)
+    f0 = xp.floor(fi).astype(xp.int32)
+    t0 = xp.floor(ti).astype(xp.int32)
+    wf, wt = fi - f0, ti - t0
+    val = (power[t0, f0] * (1 - wt) * (1 - wf)
+           + power[t0 + 1, f0] * wt * (1 - wf)
+           + power[t0, f0 + 1] * (1 - wt) * wf
+           + power[t0 + 1, f0 + 1] * wt * wf)
+    return xp.where(inb, val, 0.0)
+
+
+def theta_theta_map(sec: SecSpec, eta: float, ntheta: int = 129,
+                    theta_max: float | None = None, power=None,
+                    startbin: int = 3, cutmid: int = 3) -> np.ndarray:
+    """Remap the secondary spectrum onto a [ntheta, ntheta] theta-theta
+    grid for trial curvature ``eta`` (delay-axis units per fdop^2 — the
+    same eta fit_arc reports for this spectrum).
+
+    ``power`` (a precomputed amplitude array from the masking step) can
+    be passed to avoid recomputation across many trial etas.
+    """
+    if power is None:
+        power = _power_linear(sec, startbin=startbin, cutmid=cutmid)
+    fdop = np.asarray(sec.fdop, dtype=np.float64)
+    yaxis = np.asarray(sec.beta if sec.lamsteps else sec.tdel,
+                       dtype=np.float64)
+    if theta_max is None:
+        theta_max = float(np.max(fdop)) / 2
+    th = np.linspace(-theta_max, theta_max, ntheta)
+    return _tt_remap(power, eta, th[:, None], th[None, :],
+                     float(fdop[0]), float(fdop[1] - fdop[0]), len(fdop),
+                     float(yaxis[0]), float(yaxis[1] - yaxis[0]),
+                     len(yaxis), xp=np)
+
+
+def _concentration_numpy(M: np.ndarray) -> float:
+    """Top-eigenmode energy fraction lambda_max^2 / ||S||_F^2 of the
+    symmetrised map (=1 for an exact rank-1 arc; the Frobenius norm is
+    the full eigen-energy, immune to the near-empty diagonal)."""
+    S = 0.5 * (M + M.T)
+    evals = np.linalg.eigvalsh(S)
+    tot = float(np.sum(evals ** 2))
+    return float(np.max(evals ** 2) / tot) if tot > 0 else 0.0
+
+
+@functools.lru_cache(maxsize=32)
+def _make_concentration_jax(power_iters: int):
+    """The ONE jax implementation of the top-eigenmode energy fraction
+    (fixed-step power iteration on the symmetrised map), shared by the
+    single-epoch sweep and the batched pipeline fitter.  The init vector
+    derives from M (zeros_like + 1) so the same closure is safe under
+    shard_map varying-axis typing (see fit/wavefield.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    def concentration(M):
+        S = 0.5 * (M + M.T)
+        v = (jnp.zeros_like(S[0]) + 1.0) / np.sqrt(S.shape[0])
+
+        def body(v, _):
+            v = S @ v
+            return v / jnp.maximum(jnp.linalg.norm(v), 1e-30), None
+
+        v, _ = jax.lax.scan(body, v, None, length=power_iters)
+        lam = v @ S @ v
+        tot = jnp.maximum(jnp.sum(S * S), 1e-30)  # ||S||_F^2 = sum lam^2
+        return lam ** 2 / tot
+
+    return concentration
+
+
+def _tt_search_jax(f0_fd: float, d_fd: float, nfd: int, t0_t: float,
+                   d_t: float, nt: int, ntheta: int, theta_max: float,
+                   power_iters: int):
+    """jit'd concentration sweep, cached on the GRID GEOMETRY scalars only
+    (axis origin/spacing/length) — epochs sharing a template reuse one
+    compiled program; full axis contents never enter the key."""
+    import jax
+    import jax.numpy as jnp
+
+    th = np.linspace(-theta_max, theta_max, ntheta)
+    t1 = np.ascontiguousarray(th[:, None])
+    t2 = np.ascontiguousarray(th[None, :])
+    concentration = _make_concentration_jax(power_iters)
+
+    @jax.jit
+    def search(power, etas):
+        def one(eta):
+            return concentration(_tt_remap(power, eta, t1, t2, f0_fd,
+                                           d_fd, nfd, t0_t, d_t, nt,
+                                           xp=jnp))
+
+        return jax.lax.map(one, etas)
+
+    return search
+
+
+def _half_width_bounds(etas: np.ndarray, conc: np.ndarray,
+                       i: int) -> tuple[float, float]:
+    """Walk outward from peak ``i`` to the first drop below half height on
+    each side — bounds only the fitted peak, not disjoint regions (second
+    arcs, edge plateaus)."""
+    half = conc[i] - 0.5 * (conc[i] - np.median(conc))
+    lo = i
+    while lo > 0 and conc[lo - 1] >= half:
+        lo -= 1
+    hi = i
+    while hi < len(conc) - 1 and conc[hi + 1] >= half:
+        hi += 1
+    return float(etas[lo]), float(etas[hi])
+
+
+@functools.lru_cache(maxsize=None)
+def _make_tt_fitter_cached(f0_fd: float, d_fd: float, nfd: int,
+                           t0_t: float, d_t: float, nt: int,
+                           etamin: float, etamax: float, n_eta: int,
+                           ntheta: int, theta_max: float,
+                           power_iters: int, startbin: int, cutmid: int,
+                           lamsteps: bool):
+    import jax
+    import jax.numpy as jnp
+
+    from ..data import ArcFit
+
+    etas = np.geomspace(etamin, etamax, n_eta)
+    log_etas = np.log(etas)
+    h = float(log_etas[1] - log_etas[0])       # uniform in log-eta
+    th = np.linspace(-theta_max, theta_max, ntheta)
+    t1 = np.ascontiguousarray(th[:, None])
+    t2 = np.ascontiguousarray(th[None, :])
+    row_mask = np.zeros(nt, dtype=bool)
+    row_mask[:startbin] = True
+    col_mask = np.zeros(nfd, dtype=bool)
+    if cutmid:
+        col_mask[nfd // 2 - cutmid // 2: nfd // 2 + (cutmid + 1) // 2] = True
+    concentration = _make_concentration_jax(power_iters)
+
+    def one_epoch(s_db):
+        # dB -> linear amplitude, masked exactly as _power_linear
+        p = 10.0 ** (s_db / 20.0)
+        p = jnp.where(jnp.isfinite(p), p, 0.0)
+        p = jnp.where(row_mask[:, None] | col_mask[None, :], 0.0, p)
+
+        conc = jax.lax.map(
+            lambda e: concentration(_tt_remap(p, e, t1, t2, f0_fd, d_fd,
+                                              nfd, t0_t, d_t, nt, xp=jnp)),
+            jnp.asarray(etas))
+
+        i = jnp.argmax(conc)
+        # sub-grid vertex of the 3-point parabola in log-eta (the grid is
+        # geomspace, so log-spacing is exactly uniform and the closed-form
+        # vertex equals the numpy path's np.polyfit through the 3 points)
+        ic = jnp.clip(i, 1, n_eta - 2)
+        y0 = conc[ic - 1]
+        y1 = conc[ic]
+        y2 = conc[ic + 1]
+        denom = y0 - 2.0 * y1 + y2
+        delta = jnp.where(denom < 0,
+                          0.5 * h * (y0 - y2) / denom, 0.0)
+        log_eta_pk = jnp.asarray(log_etas)[ic] + delta
+        eta = jnp.where((i == ic) & (denom < 0),
+                        jnp.exp(log_eta_pk),
+                        jnp.asarray(etas)[i])
+
+        # fixed-shape half-width walk (numpy path: _half_width_bounds):
+        # nearest below-half index on each side of the peak bounds it
+        half = conc[i] - 0.5 * (conc[i] - jnp.median(conc))
+        below = conc < half
+        idx = jnp.arange(n_eta)
+        jl = jnp.max(jnp.where(below & (idx < i), idx, -1))
+        lo = jl + 1                                  # -1 (none) -> 0
+        jr = jnp.min(jnp.where(below & (idx > i), idx, n_eta))
+        hi = jr - 1                                  # n (none) -> n-1
+        walk_err = (jnp.asarray(etas)[hi] - jnp.asarray(etas)[lo]) / 4.0
+        # grid-edge peak: no walk, quote the local grid spacing instead
+        # (numpy path, fit_arc_thetatheta:222-225)
+        edge = (i == 0) | (i == n_eta - 1)
+        near = (jnp.asarray(etas)[jnp.minimum(i + 1, n_eta - 1)]
+                - jnp.asarray(etas)[jnp.maximum(i - 1, 0)]) / 2.0
+        etaerr = jnp.where(edge, near, walk_err)
+        return eta, etaerr, conc
+
+    @jax.jit
+    def fitter(sspec_batch):
+        eta, etaerr, conc = jax.vmap(one_epoch)(jnp.asarray(sspec_batch))
+        return ArcFit(eta=eta, etaerr=etaerr, etaerr2=etaerr,
+                      lamsteps=lamsteps,
+                      profile_eta=jnp.asarray(etas),
+                      profile_power=conc)
+
+    return fitter
+
+
+def make_tt_fitter(fdop, yaxis, etamin: float, etamax: float,
+                   n_eta: int = 128, ntheta: int = 129,
+                   theta_max: float | None = None, power_iters: int = 30,
+                   startbin: int = 3, cutmid: int = 3,
+                   lamsteps: bool = True):
+    """Build a jit'd BATCHED theta-theta curvature fitter for a fixed
+    (fdop, yaxis) secondary-spectrum grid.
+
+    Returns ``fitter(sspec_batch [B, nr, nc] dB) -> ArcFit`` with [B]
+    ``eta``/``etaerr`` leaves, ``profile_eta`` the shared trial-curvature
+    grid and ``profile_power`` the [B, n_eta] concentration curves.  The
+    whole measurement — dB decoding, theta-theta remaps, power-iteration
+    concentration sweep, sub-grid peak and half-width error — is ONE
+    fixed-shape jit, so it vmaps over survey batches and shards over a
+    mesh like the norm_sspec fitter (driver: PipelineConfig.arc_method=
+    "thetatheta").  Curvature units follow the grid: beta-eta (m^-1 /
+    mHz^2) for lamsteps spectra, us/mHz^2 otherwise — identical to
+    ``fit_arc_thetatheta`` on the same SecSpec.
+
+    Building is device-free (static grids only); first call compiles.
+    """
+    fdop = np.asarray(fdop, dtype=np.float64)
+    yaxis = np.asarray(yaxis, dtype=np.float64)
+    if not (np.isfinite(etamin) and np.isfinite(etamax)
+            and 0 < etamin < etamax):
+        raise ValueError(
+            f"theta-theta needs a finite positive curvature bracket, got "
+            f"({etamin}, {etamax})")
+    if theta_max is None:
+        theta_max = float(np.max(fdop)) / 2
+    return _make_tt_fitter_cached(
+        float(fdop[0]), float(fdop[1] - fdop[0]), len(fdop),
+        float(yaxis[0]), float(yaxis[1] - yaxis[0]), len(yaxis),
+        float(etamin), float(etamax), int(n_eta), int(ntheta),
+        float(theta_max), int(power_iters), int(startbin), int(cutmid),
+        bool(lamsteps))
+
+
+def fit_arc_thetatheta(sec: SecSpec, etamin: float, etamax: float,
+                       n_eta: int = 128, ntheta: int = 129,
+                       theta_max: float | None = None,
+                       power_iters: int = 30, startbin: int = 3,
+                       cutmid: int = 3, backend: str = "jax"
+                       ) -> tuple[float, float, np.ndarray, np.ndarray]:
+    """Measure the arc curvature by theta-theta eigenvalue concentration.
+
+    Sweeps ``n_eta`` trial curvatures log-spaced over [etamin, etamax]
+    (delay-axis units / fdop^2 — beta-eta for lamsteps spectra), computes
+    the top-eigenmode energy fraction of each theta-theta map, and fits a
+    parabola to the peak of the concentration curve.  Cost scales
+    linearly with ``n_eta`` (one ntheta^2 remap + power iteration each).
+
+    Returns (eta, etaerr, eta_grid, concentration_curve).
+    """
+    backend = resolve(backend)
+    etas = np.geomspace(etamin, etamax, n_eta)
+    fdop = np.asarray(sec.fdop, dtype=np.float64)
+    yaxis = np.asarray(sec.beta if sec.lamsteps else sec.tdel,
+                       dtype=np.float64)
+    if theta_max is None:
+        theta_max = float(np.max(fdop)) / 2
+    power = _power_linear(sec, startbin=startbin, cutmid=cutmid)
+
+    if backend == "jax":
+        import jax.numpy as jnp
+
+        search = _tt_search_jax(
+            float(fdop[0]), float(fdop[1] - fdop[0]), len(fdop),
+            float(yaxis[0]), float(yaxis[1] - yaxis[0]), len(yaxis),
+            int(ntheta), float(theta_max), int(power_iters))
+        conc = np.asarray(search(jnp.asarray(power), jnp.asarray(etas)))
+    else:
+        th = np.linspace(-theta_max, theta_max, ntheta)
+        conc = np.array([_concentration_numpy(_tt_remap(
+            power, e, th[:, None], th[None, :], float(fdop[0]),
+            float(fdop[1] - fdop[0]), len(fdop), float(yaxis[0]),
+            float(yaxis[1] - yaxis[0]), len(yaxis), xp=np))
+            for e in etas])
+
+    i = int(np.argmax(conc))
+    if 0 < i < n_eta - 1:
+        # parabola through the peak in log-eta for a sub-grid estimate
+        x = np.log(etas[i - 1: i + 2])
+        y = conc[i - 1: i + 2]
+        a, b, _ = np.polyfit(x, y, 2)
+        eta = float(np.exp(-b / (2 * a))) if a < 0 else float(etas[i])
+        lo, hi = _half_width_bounds(etas, conc, i)
+        etaerr = float((hi - lo) / 4)
+    else:
+        eta = float(etas[i])
+        etaerr = float(etas[min(i + 1, n_eta - 1)]
+                       - etas[max(i - 1, 0)]) / 2
+    return eta, etaerr, etas, conc

@@ -1,0 +1,100 @@
+"""Ingest adapters: build DynspecData from arrays, MATLAB files, simulations.
+
+Reference duck-typed classes: BasicDyn (dynspec.py:1494-1523), MatlabDyn
+(dynspec.py:1526-1562), SimDyn (dynspec.py:1565-1596).  All reduce to "make
+the 13 metadata attributes consistent"; here they are constructor functions
+returning :class:`DynspecData`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..data import DynspecData
+
+
+def from_arrays(dyn, times, freqs, name: str = "BasicDyn", header=("BasicDyn",),
+                **meta) -> DynspecData:
+    """BasicDyn equivalent.  ``dyn`` is [nchan, nsub] with matching axes."""
+    times = np.asarray(times)
+    freqs = np.asarray(freqs)
+    if times.size == 0 or freqs.size == 0:
+        raise ValueError("must input array of times and frequencies")
+    return DynspecData(dyn=np.asarray(dyn), times=times, freqs=freqs,
+                       name=name, header=tuple(header), **meta)
+
+
+def _freqs_from_dlam(freq: float, nchan: int, dlam: float) -> np.ndarray:
+    """Synthetic frequency axis for lambda-stepped simulations
+    (dynspec.py:1586-1589): uniform in 1/lambda over fractional bandwidth
+    dlam, rescaled to centre frequency."""
+    lams = np.linspace(1, 1 + dlam, nchan)
+    freqs = 1.0 / lams
+    return freq * np.linspace(freqs.min(), freqs.max(), nchan)
+
+
+def from_matlab(matfilename: str, dt: float = 2.7 * 60,
+                freq: float = 1400.0) -> DynspecData:
+    """Load a Coles et al. MATLAB simulation (.mat with ``spi``/``dlam``),
+    mirroring MatlabDyn (dynspec.py:1526-1562)."""
+    from scipy.io import loadmat
+
+    mat = loadmat(matfilename)
+    if "spi" not in mat:
+        raise KeyError('no variable named "spi" found in mat file')
+    if "dlam" not in mat:
+        raise KeyError('no variable named "dlam" found in mat file')
+    spi = mat["spi"]
+    dlam = float(mat["dlam"])
+    nsub, nchan = spi.shape
+    freqs = _freqs_from_dlam(freq, nchan, dlam)
+    bw = freqs.max() - freqs.min()
+    times = dt * np.arange(nsub)
+    return DynspecData(
+        dyn=spi.transpose(), freqs=freqs, times=times, mjd=50000.0,
+        df=bw / nchan, dt=dt, bw=bw, freq=freq,
+        tobs=float(times[-1] - times[0]),
+        name=matfilename.split()[0],
+        header=(str(mat.get("__header__", "")),
+                f"Dynspec loaded from Matfile {matfilename}"))
+
+
+def from_simulation(sim, freq: float = 1400.0, dt: float = 0.5,
+                    mjd: float = 50000.0, efield: bool = False,
+                    nsub: int | None = None) -> DynspecData:
+    """Wrap a :class:`scintools_tpu.sim.Simulation` (SimDyn equivalent,
+    dynspec.py:1565-1596): transpose intensity to [nchan, nsub] and build a
+    synthetic frequency axis from the fractional bandwidth."""
+    spi = np.real(sim.spe) if efield else sim.spi
+    spi = np.asarray(spi)
+    if nsub is not None:
+        spi = spi[:nsub, :]
+    nsub_, nchan = spi.shape
+    freqs = _freqs_from_dlam(freq, nchan, sim.dlam)
+    bw = freqs.max() - freqs.min()
+    times = dt * np.arange(nsub_)
+    name = (f"sim:mb2={sim.mb2},ar={sim.ar},psi={sim.psi},dlam={sim.dlam}"
+            + (",lamsteps" if sim.lamsteps else ""))
+    return DynspecData(
+        dyn=spi.transpose(), freqs=freqs, times=times, mjd=mjd,
+        df=bw / nchan, dt=dt, bw=bw, freq=freq,
+        tobs=float(times[-1] - times[0]), name=name, header=(name,))
+
+
+def concatenate_time(a: DynspecData, b: DynspecData) -> DynspecData:
+    """Time-concatenate two epochs, zero-filling the gap computed from their
+    MJDs — the reference's ``Dynspec.__add__`` (dynspec.py:47-97)."""
+    timegap = round((b.mjd - a.mjd) * 86400 - a.tobs, 1)
+    extratimes = np.arange(a.dt / 2, timegap, a.dt)
+    nextra = 0 if timegap < a.dt else len(extratimes)
+    gap = np.zeros([np.shape(a.dyn)[0], nextra])
+    nsub = a.nsub + nextra + b.nsub
+    tobs = a.tobs + timegap + b.tobs
+    times = np.linspace(0, tobs, nsub)
+    newdyn = np.concatenate((np.asarray(a.dyn), gap, np.asarray(b.dyn)),
+                            axis=1)
+    name = (a.name.split(".")[0] + "+" + b.name.split(".")[0] + ".dynspec")
+    return DynspecData(dyn=newdyn, freqs=a.freqs, times=times,
+                       mjd=min(a.mjd, b.mjd), df=a.df, dt=a.dt, bw=a.bw,
+                       freq=a.freq, tobs=tobs, name=name,
+                       header=tuple(a.header) + tuple(b.header))

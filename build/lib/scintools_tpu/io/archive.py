@@ -1,0 +1,45 @@
+"""psrchive bridge: RFI-clean an archive before making a dynspec.
+
+Reference: ``clean_archive`` (scint_utils.py:19-56), which shells into the
+optional psrchive + coast_guard stack.  Neither is installable in most
+environments (they are observatory builds), so this module gates cleanly:
+the function works when the stack is present and raises an actionable
+error otherwise.  The rest of the framework never needs it — psrflux
+files and dyn-like adapters are the supported ingest paths.
+"""
+
+from __future__ import annotations
+
+
+def clean_archive(archive, template: str | None = None,
+                  bandwagon: float = 0.99, channel_threshold: float = 5,
+                  subint_threshold: float = 5):
+    """Surgical + bandwagon RFI cleaning of a psrchive archive
+    (scint_utils.py:19-56).
+
+    ``archive`` is a loaded ``psrchive.Archive``.  Requires the external
+    psrchive python bindings and coast_guard; raises ImportError with
+    install guidance when absent.
+    """
+    try:
+        from coast_guard import cleaners  # type: ignore
+    except ImportError as e:  # pragma: no cover - env-dependent
+        raise ImportError(
+            "clean_archive needs the observatory stack: psrchive python "
+            "bindings + coast_guard (https://github.com/larskuenkel/"
+            "iterative_cleaner or coast_guard). Install them in your "
+            "psrchive environment, or pre-clean archives and ingest "
+            "psrflux dynamic spectra instead.") from e
+
+    surgical = cleaners.load_cleaner("surgical")
+    params = f"chan_numpieces=1,subint_numpieces=1,chanthresh={channel_threshold},subintthresh={subint_threshold}"
+    if template is not None:
+        params += f",template={template}"
+    surgical.parse_config_string(params)
+    surgical.run(archive)
+
+    bandwagon_cleaner = cleaners.load_cleaner("bandwagon")
+    bandwagon_cleaner.parse_config_string(
+        f"badchantol={bandwagon},badsubtol=1.0")
+    bandwagon_cleaner.run(archive)
+    return archive

@@ -1,0 +1,89 @@
+"""tempo2 ``.par`` pulsar-ephemeris parser.
+
+Reference: ``read_par`` (scint_utils.py:197-249) and ``pars_to_params``
+(scint_utils.py:252-278).  Values are typed (int / float / string), errors
+stored as ``<KEY>_ERR``, the type recorded as ``<KEY>_TYPE``; DM-model and
+fit-control keys are ignored.  RAJ/DECJ sexagesimal strings convert to
+radians without astropy.
+"""
+
+from __future__ import annotations
+
+from decimal import Decimal, InvalidOperation
+
+import numpy as np
+
+_IGNORE = ['DMMODEL', 'DMOFF', 'DM_', 'CM_', 'CONSTRAIN', 'JUMP', 'NITS',
+           'NTOA', 'CORRECT_TROPOSPHERE', 'PLANET_SHAPIRO', 'DILATEFREQ',
+           'TIMEEPH', 'MODE', 'TZRMJD', 'TZRSITE', 'TZRFRQ', 'EPHVER',
+           'T2CMETHOD']
+
+
+def read_par(parfile: str) -> dict:
+    par: dict = {}
+    with open(parfile) as fh:
+        for line in fh:
+            sline = line.split()
+            if (not sline or line[0] == "#" or line[0:2] == "C "
+                    or sline[0] in _IGNORE):
+                continue
+            param = sline[0]
+            if param == "E":
+                param = "ECC"
+            val = sline[1]
+            err = None
+            if len(sline) == 3 and sline[2] not in ("0", "1"):
+                err = sline[2].replace("D", "E")
+            elif len(sline) == 4:
+                err = sline[3].replace("D", "E")
+
+            p_type = None
+            try:
+                val = int(val)
+                p_type = "d"
+            except ValueError:
+                try:
+                    val = float(Decimal(val.replace("D", "E")))
+                    p_type = "e" if ("e" in sline[1]
+                                     or "E" in sline[1].replace("D", "E")) \
+                        else "f"
+                except InvalidOperation:
+                    p_type = "s"
+
+            par[param] = val
+            if err:
+                par[param + "_ERR"] = float(err)
+            if p_type:
+                par[param + "_TYPE"] = p_type
+    return par
+
+
+def hms_to_rad(s: str) -> float:
+    """Sexagesimal hour angle 'hh:mm:ss.s' -> radians."""
+    sign = -1.0 if s.strip().startswith("-") else 1.0
+    h, m, sec = (list(map(float, s.strip().lstrip("+-").split(":"))) + [0, 0])[:3]
+    return sign * (h + m / 60 + sec / 3600) * np.pi / 12
+
+
+def dms_to_rad(s: str) -> float:
+    """Sexagesimal degrees 'dd:mm:ss.s' -> radians."""
+    sign = -1.0 if s.strip().startswith("-") else 1.0
+    d, m, sec = (list(map(float, s.strip().lstrip("+-").split(":"))) + [0, 0])[:3]
+    return sign * (d + m / 60 + sec / 3600) * np.pi / 180
+
+
+def pars_to_params(pars: dict, params: dict | None = None) -> dict:
+    """par-dict -> flat fit-parameter dict (the lmfit-free analogue of
+    scint_utils.py:252-278): numeric entries copied, RAJ/DECJ converted to
+    radians.  Strings are dropped."""
+    out = dict(params) if params else {}
+    for key, value in pars.items():
+        if key in ("RAJ", "RA") and isinstance(value, str):
+            out["RAJ"] = hms_to_rad(value)
+            dec = pars.get("DECJ", pars.get("DEC"))
+            if isinstance(dec, str):
+                out["DECJ"] = dms_to_rad(dec)
+            continue
+        if isinstance(value, (int, float)) and not isinstance(value, bool):
+            out[key] = float(value)
+    return out

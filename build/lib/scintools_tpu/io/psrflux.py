@@ -1,0 +1,74 @@
+"""psrflux-format dynamic spectrum reader/writer.
+
+Reference parser: ``Dynspec.load_file`` (dynspec.py:99-156).  Format: ``#``
+header lines (``MJD0:`` giving the start MJD), then a 6-column table
+``isub ichan time[min] freq[MHz] flux fluxerr`` .  We reproduce the
+reference's metadata derivations exactly (rounding of df/bw, dt>1s rounding,
+descending-band flip at dynspec.py:142-147).  Flux errors (column 5) are
+not retained, matching the reference, which reads then drops them.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..data import DynspecData
+
+
+def read_psrflux(filename: str) -> DynspecData:
+    head = []
+    mjd = 50000.0
+    with open(filename) as fh:
+        for line in fh:
+            if line.startswith("#"):
+                headline = line[1:].strip()
+                head.append(headline)
+                parts = headline.split()
+                if parts and parts[0] == "MJD0:":
+                    mjd = float(parts[1])
+    raw = np.loadtxt(filename).transpose()
+    times = np.unique(raw[2] * 60)  # minutes -> seconds since obs start
+    freqs_col = raw[3]
+    fluxes = raw[4]
+
+    nchan = int(np.unique(raw[1])[-1]) + 1
+    freqs = np.unique(freqs_col)
+    bw = freqs_col[-1] - freqs_col[0]
+    # note: reference computes df from the *unsorted* column before unique
+    df = round(bw / (nchan - 1), 5)
+    bw = round(bw + df, 2)
+    nsub = int(np.unique(raw[0])[-1]) + 1
+    tobs = times[-1] + times[0]
+    dt = tobs / nsub
+    if dt > 1:
+        dt = round(dt)
+    else:
+        times = np.linspace(times[0], times[-1], nsub)
+    tobs = dt * nsub
+    freq = round(float(np.mean(freqs)), 2)
+
+    dyn = fluxes.reshape([nsub, nchan]).transpose()
+    if df < 0:  # descending band: flip to ascending (dynspec.py:142-147)
+        df = -df
+        bw = -bw
+        dyn = np.flip(dyn, 0)
+
+    return DynspecData(dyn=dyn, freqs=freqs, times=times, mjd=mjd, df=df,
+                       dt=dt, bw=bw, freq=freq, tobs=tobs,
+                       name=os.path.basename(filename), header=tuple(head))
+
+
+def write_psrflux(d: DynspecData, filename: str) -> None:
+    """Write a DynspecData in psrflux format (round-trips read_psrflux)."""
+    dyn = np.asarray(d.dyn)
+    freqs = np.asarray(d.freqs)
+    times = np.asarray(d.times)
+    with open(filename, "w") as fh:
+        fh.write(f"# MJD0: {d.mjd}\n")
+        fh.write("# Dynamic spectrum written by scintools-tpu\n")
+        for isub in range(dyn.shape[1]):
+            for ichan in range(dyn.shape[0]):
+                fh.write(f"{isub} {ichan} {times[isub]/60:.8f} "
+                         f"{freqs[ichan]:.8f} {dyn[ichan, isub]:.8e} 0.0\n")

@@ -1,0 +1,71 @@
+"""Results store: append-mode CSV of per-epoch measurements.
+
+Reference: ``write_results``/``read_results``/``float_array_from_dict``
+(scint_utils.py:75-131).  Schema kept compatible — base columns
+``name,mjd,freq,bw,tobs,dt,df`` plus conditional ``tau,tauerr``,
+``dnu,dnuerr``, ``eta,etaerr``, ``betaeta,betaetaerr`` — so existing survey
+tooling can read our files.  The append-mode pattern doubles as crash-safe
+partial-results checkpointing for batch runs (SURVEY.md §5 checkpoint row).
+"""
+
+from __future__ import annotations
+
+import csv
+import os
+
+import numpy as np
+
+
+_OPTIONAL = (("tau", "tauerr"), ("dnu", "dnuerr"),
+             ("eta", "etaerr"), ("betaeta", "betaetaerr"))
+
+
+def write_results(filename: str, meta: dict) -> None:
+    """Append one row.  ``meta`` must carry name/mjd/freq/bw/tobs/dt/df and
+    may carry any of the optional measurement pairs."""
+    header = "name,mjd,freq,bw,tobs,dt,df"
+    row = "{name},{mjd},{freq},{bw},{tobs},{dt},{df}".format(**meta)
+    for a, b in _OPTIONAL:
+        if a in meta and meta[a] is not None:
+            header += f",{a},{b}"
+            row += f",{meta[a]},{meta.get(b)}"
+    with open(filename, "a") as fh:
+        if not os.path.exists(filename) or os.stat(filename).st_size == 0:
+            fh.write(header + "\n")
+        fh.write(row + "\n")
+
+
+def results_row(d, scint=None, arc=None) -> dict:
+    """Build a write_results row from DynspecData + optional fit results."""
+    meta = dict(name=d.name, mjd=d.mjd, freq=d.freq, bw=d.bw, tobs=d.tobs,
+                dt=d.dt, df=d.df)
+    if scint is not None:
+        meta.update(tau=float(scint.tau), tauerr=float(scint.tauerr),
+                    dnu=float(scint.dnu), dnuerr=float(scint.dnuerr))
+    if arc is not None:
+        key = "betaeta" if arc.lamsteps else "eta"
+        meta[key] = float(arc.eta)
+        meta[key + "err"] = float(arc.etaerr)
+    return meta
+
+
+def read_results(filename: str) -> dict:
+    """CSV -> dict of string lists (scint_utils.py:111-124)."""
+    with open(filename) as fh:
+        data = list(csv.reader(fh, delimiter=","))
+    keys = data[0]
+    out: dict = {k: [] for k in keys}
+    for row in data[1:]:
+        for ii, v in enumerate(row):
+            out[keys[ii]].append(v)
+    return out
+
+
+def float_array_from_dict(dictionary: dict, key: str) -> np.ndarray:
+    return np.array([float(v) for v in dictionary[key]])
+
+
+def read_dynlist(file_path: str) -> list[str]:
+    """File-of-filenames reader (scint_utils.py:66-72)."""
+    with open(file_path) as fh:
+        return fh.read().splitlines()

@@ -1,0 +1,7 @@
+from .acf_models import (dnu_acf_model, dnu_sspec_model,  # noqa: F401
+                         scint_acf_model, scint_sspec_model, tau_acf_model,
+                         tau_sspec_model)
+from .parabola import (fit_log_parabola, fit_parabola, masked_ptp,  # noqa: F401
+                       polyfit2_cov)
+from .velocity import (arc_curvature_model, arc_curvature_residuals,  # noqa: F401
+                       effective_velocity_annual, thin_screen_veff)

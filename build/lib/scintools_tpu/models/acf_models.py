@@ -1,0 +1,110 @@
+"""Closed-form ACF models for scintillation-parameter fitting.
+
+Reference: scint_models.py:27-105.  There the models are lmfit residual
+callbacks mutating ``model[0]``; here they are pure functions of
+``(x, params)`` that evaluate on numpy *or* jax arrays (pass ``xp``), so the
+same code serves the scipy least-squares CPU path and the vmapped
+fixed-iteration LM on TPU, including reverse-mode differentiation.
+
+Conventions preserved from the reference:
+* ``tau`` is the 1/e timescale, ``dnu`` the half-power bandwidth
+  (hence the ``dnu/log(2)`` scale inside the exponential,
+  scint_models.py:73);
+* a white-noise spike ``wn`` is added to the zero-lag sample only
+  (scint_models.py:48,74);
+* models are multiplied by the triangle taper ``1 - x/max(x)``, the
+  finite-scan bias of the ACF estimate (scint_models.py:50,76).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def tau_acf_model(x, tau, amp, wn, alpha=5 / 3, xp=np):
+    """Time-axis ACF cut model (scint_models.py:27-52)."""
+    model = amp * xp.exp(-(x / tau) ** alpha)
+    model = model + wn * (xp.arange(x.shape[0]) == 0)
+    return model * (1 - x / xp.max(x))
+
+
+def dnu_acf_model(x, dnu, amp, wn, xp=np):
+    """Frequency-axis ACF cut model (scint_models.py:55-78)."""
+    model = amp * xp.exp(-x / (dnu / np.log(2)))
+    model = model + wn * (xp.arange(x.shape[0]) == 0)
+    return model * (1 - x / xp.max(x))
+
+
+def scint_acf_model(x_t, x_f, tau, dnu, amp, wn, alpha=5 / 3, xp=np):
+    """Joint model over concatenated (time-cut, frequency-cut) data
+    (scint_models.py:81-105).  Returns the concatenated model vector."""
+    mt = tau_acf_model(x_t, tau, amp, wn, alpha, xp=xp)
+    mf = dnu_acf_model(x_f, dnu, amp, wn, xp=xp)
+    return xp.concatenate([mt, mf])
+
+
+def mirror_spectrum(y, xp=np):
+    """Mirror a positive-lag function to a symmetric one and return the
+    real FFT's positive half — the ACF->power-spectrum transform used by
+    every *_sspec_model AND by the spectral-domain fitter's data side
+    (they must share this construction to live on the same grid)."""
+    sym = xp.concatenate([y, y[::-1]])[: 2 * y.shape[0] - 1]
+    return xp.real(xp.fft.fft(sym))[: y.shape[0]]
+
+
+def tau_sspec_model(x, tau, amp, wn, alpha=5 / 3, xp=np):
+    """Fourier-domain (power spectrum) counterpart of tau_acf_model.
+
+    The reference's version is broken — it calls the numpy *module*
+    ``np.fft(model)`` (scint_models.py:142) — so this is the repaired
+    semantics it intended: mirror the ACF model to a symmetric function and
+    take the real FFT, keeping the positive-lag half.
+    """
+    model = tau_acf_model(x, tau, amp, wn, alpha, xp=xp)
+    return mirror_spectrum(model, xp=xp)
+
+
+def dnu_sspec_model(x, dnu, amp, wn, xp=np):
+    """Fourier-domain counterpart of dnu_acf_model (reference stub at
+    scint_models.py:149-171, completed here)."""
+    model = dnu_acf_model(x, dnu, amp, wn, xp=xp)
+    return mirror_spectrum(model, xp=xp)
+
+
+def scint_sspec_model(x_t, x_f, tau, dnu, amp, wn, alpha=5 / 3, xp=np):
+    """Joint Fourier-domain model (reference stub at scint_models.py:174-188,
+    completed here)."""
+    mt = tau_sspec_model(x_t, tau, amp, wn, alpha, xp=xp)
+    mf = dnu_sspec_model(x_f, dnu, amp, wn, xp=xp)
+    return xp.concatenate([mt, mf])
+
+
+def scint_acf_model_2d(x_t, x_f, tau, dnu, amp, wn, alpha=5 / 3,
+                       tilt=0.0, tmax=None, fmax=None, xp=np):
+    """2-D ACF model over signed (time, frequency) lags — the model the
+    reference declares but leaves empty (``scint_acf_model_2D``,
+    scint_models.py:108-112).
+
+    Design (ours, consistent with the 1-D cuts): stretched-exponential
+    temporal decorrelation sheared by a phase-gradient ``tilt`` (s/MHz —
+    refraction displaces the scintle pattern linearly in time per unit
+    frequency), exponential frequency decorrelation with half-power
+    bandwidth ``dnu``, a zero-lag white-noise spike, and the separable
+    finite-scan triangle taper.  At ``x_f=0`` / ``x_t=0`` it reduces to
+    :func:`tau_acf_model` / :func:`dnu_acf_model`.
+
+    x_t: [nt] signed time lags (s); x_f: [nf] signed frequency lags (MHz).
+    ``tmax``/``fmax`` are the taper scales — the FULL scan duration and
+    bandwidth (they default to the lag extent, which is only correct when
+    the lags span the whole scan; pass them explicitly when fitting a
+    cropped window).  Returns [nf, nt].
+    """
+    t = x_t[None, :]
+    f = x_f[:, None]
+    tmax = xp.max(xp.abs(x_t)) if tmax is None else tmax
+    fmax = xp.max(xp.abs(x_f)) if fmax is None else fmax
+    model = amp * xp.exp(-(xp.abs(t - tilt * f) / tau) ** alpha
+                         - xp.abs(f) * np.log(2) / dnu)
+    model = model + wn * ((t == 0) & (f == 0))
+    taper = (1 - xp.abs(t) / tmax) * (1 - xp.abs(f) / fmax)
+    return model * taper

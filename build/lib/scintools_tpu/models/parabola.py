@@ -1,0 +1,84 @@
+"""Parabola peak fitting with error propagation.
+
+Reference: ``fit_parabola``/``fit_log_parabola`` (scint_models.py:216-263):
+scale x by 1000/ptp, degree-2 polyfit with covariance, peak at -b/2a with
+error propagated from the parameter covariance; the log variant fits in
+log(x) and exponentiates.
+
+Implemented as explicit degree-2 least squares (Vandermonde normal solve)
+with numpy's polyfit covariance scaling ``resid / (n - order - 2)``
+(asserted equal to ``np.polyfit(cov=True)`` in tests), so the same code
+runs under numpy and jax and vmaps over batches of profiles.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def polyfit2_cov(x, y, w=None, xp=np):
+    """Degree-2 least squares returning (coeffs [a,b,c], cov [3,3]) with
+    np.polyfit's covariance scaling.
+
+    ``w`` is an optional 0/1 mask enabling fixed-shape windowed fits under
+    jit: with binary weights the normal equations, residual and count reduce
+    exactly to the subset fit the reference does by slicing."""
+    V = xp.stack([x ** 2, x, xp.ones_like(x)], axis=-1)  # [n, 3]
+    if w is None:
+        n = x.shape[0]
+        G = V.T @ V
+        rhs = V.T @ y
+    else:
+        n = xp.sum(w)
+        G = V.T @ (V * w[:, None])
+        rhs = V.T @ (w * y)
+    coeffs = xp.linalg.solve(G, rhs)
+    r2 = (y - V @ coeffs) ** 2
+    resid = xp.sum(r2 if w is None else w * r2)
+    # np.polyfit(cov=True) default scaling in numpy 2.x: chi2/dof with
+    # dof = n - (deg+1)  (asserted equal to np.polyfit in tests)
+    scale = resid / (n - 3)
+    cov = xp.linalg.inv(G) * scale
+    return coeffs, cov
+
+
+def masked_ptp(x, w, xp=np):
+    inf = xp.asarray(np.inf, dtype=x.dtype)
+    return (xp.max(xp.where(w > 0, x, -inf))
+            - xp.min(xp.where(w > 0, x, inf)))
+
+
+def fit_parabola(x, y, w=None, xp=np):
+    """Return (yfit, peak, peak_error) — reference semantics
+    (scint_models.py:216-242) including the 1000/ptp pre-scaling."""
+    ptp = (xp.max(x) - xp.min(x)) if w is None else masked_ptp(x, w, xp)
+    xs = x * (1000.0 / ptp)
+    coeffs, cov = polyfit2_cov(xs, y, w=w, xp=xp)
+    a, b, c = coeffs[0], coeffs[1], coeffs[2]
+    yfit = a * xs ** 2 + b * xs + c
+    aerr = xp.abs(cov[0, 0]) ** 0.5
+    berr = xp.abs(cov[1, 1]) ** 0.5
+    peak = -b / (2 * a)
+    peak_error = xp.sqrt(berr ** 2 * (1 / (2 * a)) ** 2
+                         + aerr ** 2 * (b / 2) ** 2)
+    return yfit, peak * (ptp / 1000.0), peak_error * (ptp / 1000.0)
+
+
+def fit_log_parabola(x, y, w=None, xp=np):
+    """Parabola in log(x); peak exponentiated, fractional error
+    (scint_models.py:245-263).
+
+    Mirrors the reference's double pre-scaling: it hands fit_parabola
+    ``logx*(1000/ptp)`` (which fit_parabola rescales again internally), so
+    the returned peak is in those scaled units and converts back via
+    ``exp(peak*ptp/1000)`` (scint_models.py:253-259).
+    """
+    logx = xp.log(x)
+    ptp = ((xp.max(logx) - xp.min(logx)) if w is None
+           else masked_ptp(logx, w, xp))
+    xs = logx * (1000.0 / ptp)
+    yfit, peak, peak_error = fit_parabola(xs, y, w=w, xp=xp)
+    frac_error = peak_error / peak
+    peak = xp.exp(peak * ptp / 1000.0)
+    peak_error = frac_error * peak
+    return yfit, peak, peak_error

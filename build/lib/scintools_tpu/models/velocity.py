@@ -1,0 +1,101 @@
+"""Astrometric velocity and arc-curvature physics models.
+
+Reference: ``effective_velocity_annual`` and ``arc_curvature``
+(scint_models.py:266-378).  Pure functions of a flat parameter dict (the
+par-file keys in capitals, screen parameters in lower case), evaluating on
+numpy or jax arrays, so the curvature model can be fit over many epochs with
+the vmapped least-squares engine.
+
+Also implements ``thin_screen`` (stub in the reference,
+scint_models.py:204-213): the thin-screen curvature as a plain model value.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+V_C_KMS = 299792.458          # km/s
+KM_PER_KPC = 3.085677581e16   # km
+SEC_PER_YR = 86400 * 365.2425
+MAS_RAD = np.pi / (3600 * 180 * 1000)
+
+
+def effective_velocity_annual(params: dict, true_anomaly, vearth_ra,
+                              vearth_dec, xp=np):
+    """Effective screen velocity in RA/DEC: Keplerian pulsar orbit (A1, PB,
+    ECC, OM, KIN, KOM) + proper motion (PMRA/PMDEC) + Earth velocity,
+    weighted by the fractional screen distance s (scint_models.py:323-378).
+    Returns (veff_ra, veff_dec, vp_ra, vp_dec) in km/s."""
+    s, d = params["s"], params["d"] * KM_PER_KPC
+
+    if "PB" in params:
+        A1, PB, ECC = params["A1"], params["PB"], params["ECC"]
+        OM = params["OM"] * xp.pi / 180
+        KIN = params["KIN"] * xp.pi / 180
+        KOM = params["KOM"] * xp.pi / 180
+        vp_0 = (2 * xp.pi * A1 * V_C_KMS) / (xp.sin(KIN) * PB * 86400
+                                             * xp.sqrt(1 - ECC ** 2))
+        vp_x = -vp_0 * (ECC * xp.sin(OM) + xp.sin(true_anomaly + OM))
+        vp_y = vp_0 * xp.cos(KIN) * (ECC * xp.cos(OM)
+                                     + xp.cos(true_anomaly + OM))
+    else:
+        vp_x = vp_y = xp.zeros_like(xp.asarray(true_anomaly))
+        KOM = 0.0
+
+    pmra_v = params.get("PMRA", 0.0) * MAS_RAD * d / SEC_PER_YR
+    pmdec_v = params.get("PMDEC", 0.0) * MAS_RAD * d / SEC_PER_YR
+
+    vp_ra = xp.sin(KOM) * vp_x + xp.cos(KOM) * vp_y
+    vp_dec = xp.cos(KOM) * vp_x - xp.sin(KOM) * vp_y
+
+    veff_ra = s * vearth_ra + (1 - s) * (vp_ra + pmra_v)
+    veff_dec = s * vearth_dec + (1 - s) * (vp_dec + pmdec_v)
+    return veff_ra, veff_dec, vp_ra, vp_dec
+
+
+def arc_curvature_model(params: dict, true_anomaly, vearth_ra, vearth_dec,
+                        xp=np):
+    """Predicted arc curvature eta(t) in 1/(m mHz^2)
+    (scint_models.py:266-315): ``eta = d s (1-s) / (2 veff^2)`` with the
+    screen velocity projected onto the anisotropy axis when psi is given."""
+    d_km = params["d"] * KM_PER_KPC
+    s = params["s"]
+
+    veff_ra, veff_dec, _, _ = effective_velocity_annual(
+        params, true_anomaly, vearth_ra, vearth_dec, xp=xp)
+
+    vism_ra = params.get("vism_ra", 0.0)
+    vism_dec = params.get("vism_dec", 0.0)
+
+    if "psi" in params:  # anisotropic screen
+        psi = params["psi"] * xp.pi / 180
+        vism_psi = params.get("vism_psi", 0.0)
+        veff2 = (veff_ra * xp.sin(psi) + veff_dec * xp.cos(psi)
+                 - vism_psi) ** 2
+    else:
+        veff2 = (veff_ra - vism_ra) ** 2 + (veff_dec - vism_dec) ** 2
+
+    model = d_km * s * (1 - s) / (2 * veff2)  # 1/(km Hz^2)
+    return model / 1e9  # -> 1/(m mHz^2)
+
+
+def arc_curvature_residuals(params: dict, eta_obs, weights, true_anomaly,
+                            vearth_ra, vearth_dec, xp=np):
+    """(ydata - model) * weights, the reference's fitter convention
+    (scint_models.py:312-315)."""
+    model = arc_curvature_model(params, true_anomaly, vearth_ra, vearth_dec,
+                                xp=xp)
+    if weights is None:
+        weights = xp.ones_like(xp.asarray(eta_obs))
+    return (eta_obs - model) * weights
+
+
+def thin_screen_veff(params: dict, true_anomaly, vearth_ra, vearth_dec,
+                     xp=np):
+    """|veff| for a thin screen — the model the reference left as a stub
+    (scint_models.py:204-213)."""
+    veff_ra, veff_dec, _, _ = effective_velocity_annual(
+        params, true_anomaly, vearth_ra, vearth_dec, xp=xp)
+    vism_ra = params.get("vism_ra", 0.0)
+    vism_dec = params.get("vism_dec", 0.0)
+    return xp.sqrt((veff_ra - vism_ra) ** 2 + (veff_dec - vism_dec) ** 2)

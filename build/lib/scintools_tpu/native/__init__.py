@@ -1,0 +1,119 @@
+"""Native (C++) runtime components, built on demand with the system g++.
+
+The reference ships one native component — an OpenMP non-uniform DFT
+(fit_1d-response.c, loaded via ctypes at scint_utils.py:337-383) that must be
+compiled by hand.  Here the equivalent C++ library compiles itself the first
+time it is needed (cached next to the source), and every caller has a numpy
+fallback, so the package never hard-requires a toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+import numpy as np
+from numpy.ctypeslib import ndpointer
+
+log = logging.getLogger("scintools_tpu.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "nudft.cc")
+_LIB = os.path.join(_DIR, "libscintnudft.so")
+
+_lock = threading.Lock()
+_cached_lib = None
+_build_failed = False
+
+
+def build_nudft(force: bool = False) -> str | None:
+    """Compile nudft.cc -> libscintnudft.so; returns the path or None.
+
+    Unlike the reference (manual gcc line in fit_1d-response.c:1), the build
+    is automatic: g++ -O3 -fopenmp, falling back to no-OpenMP if that fails.
+    """
+    global _build_failed
+    if not force and os.path.exists(_LIB) and (
+            os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
+        return _LIB
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB]
+    for flags in (["-fopenmp"], []):
+        cmd = base[:1] + flags + base[1:]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            log.info("built %s (%s)", _LIB, " ".join(flags) or "no openmp")
+            return _LIB
+        except (subprocess.SubprocessError, FileNotFoundError) as e:
+            log.warning("native build failed (%s): %s", cmd, e)
+    _build_failed = True
+    return None
+
+
+def load_nudft():
+    """ctypes handle to the NUDFT library, or None when unavailable.
+
+    Mirrors the role of the reference's ctypes loader (scint_utils.py:337-355)
+    but with automatic build + graceful degradation instead of a hard file
+    dependency.
+    """
+    global _cached_lib
+    with _lock:
+        if _cached_lib is not None:
+            return _cached_lib
+        if _build_failed:
+            return None
+        path = build_nudft()
+        if path is None:
+            return None
+        lib = bind_nudft(path)
+        _cached_lib = lib
+        return lib
+
+
+def bind_nudft(path: str):
+    """CDLL-load a scint_nudft library and attach the one true ABI
+    signature — shared by the production loader and the sanitizer script
+    (scripts/sanitize_native.sh) so they can never drift apart."""
+    lib = ctypes.CDLL(path)
+    lib.scint_nudft.restype = None
+    lib.scint_nudft.argtypes = [
+        ctypes.c_int64,   # ntime
+        ctypes.c_int64,   # nfreq
+        ctypes.c_int64,   # nr
+        ctypes.c_double,  # r0
+        ctypes.c_double,  # dr
+        ndpointer(dtype=np.float64, flags="C_CONTIGUOUS", ndim=1),  # fscale
+        ndpointer(dtype=np.float64, flags="C_CONTIGUOUS", ndim=1),  # tsrc
+        ctypes.c_int,     # tsrc_uniform
+        ndpointer(dtype=np.float64, flags="C_CONTIGUOUS", ndim=2),  # power
+        ndpointer(dtype=np.complex128, flags="C_CONTIGUOUS", ndim=2),  # out
+    ]
+    lib.scint_nudft_has_openmp.restype = ctypes.c_int
+    lib.scint_nudft_has_openmp.argtypes = []
+    return lib
+
+
+def nudft_native(power: np.ndarray, fscale: np.ndarray, tsrc: np.ndarray,
+                 r0: float, dr: float, nr: int) -> np.ndarray | None:
+    """out[r, f] = sum_t exp(+2j*pi*(r0 + r*dr)*tsrc[t]*fscale[f]) * power[t, f]
+
+    Returns None when the native library cannot be built/loaded.
+    """
+    lib = load_nudft()
+    if lib is None:
+        return None
+    power = np.ascontiguousarray(power, dtype=np.float64)
+    fscale = np.ascontiguousarray(fscale, dtype=np.float64)
+    tsrc = np.ascontiguousarray(tsrc, dtype=np.float64)
+    ntime, nfreq = power.shape
+    uniform = 1
+    if ntime > 2:
+        dt = tsrc[1] - tsrc[0]
+        uniform = int(np.allclose(np.diff(tsrc), dt, rtol=0, atol=1e-12))
+    out = np.empty((nr, nfreq), dtype=np.complex128)
+    lib.scint_nudft(ntime, nfreq, nr, float(r0), float(dr), fscale, tsrc,
+                    uniform, power, out)
+    return out

@@ -1,0 +1,9 @@
+from .acf import acf  # noqa: F401
+from .clean import (correct_band, crop, refill, refill_fixed_point,  # noqa: F401
+                    trim_edges, zap)
+from .nudft import (nudft, nudft_pallas, slow_ft, slow_ft_power,  # noqa: F401
+                    slow_ft_power_sharded)
+from .scale import scale_lambda, scale_trapezoid  # noqa: F401
+from .sspec import next_pow2_fft_lens, sspec, sspec_axes  # noqa: F401
+from .svd import svd_model  # noqa: F401
+from .windows import apply_2d_window, split_window  # noqa: F401

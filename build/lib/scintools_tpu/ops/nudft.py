@@ -1,0 +1,369 @@
+"""Non-uniform DFT of a dynamic spectrum along frequency-scaled time.
+
+Capability parity with the reference's ``slow_FT`` (scint_utils.py:317-398)
+and its native kernel ``comp_dft_for_secspec`` (fit_1d-response.c:16-48):
+transforming along ``t * (f / fref)`` removes the chromatic smearing of
+scintillation arcs before the Doppler axis is formed.  The math:
+
+    out[r, f] = sum_t exp(+2j*pi * (r0 + r*dr) * tsrc[t] * fscale[f])
+                * power[t, f]
+
+followed (in :func:`slow_ft`) by a Doppler-axis flip and an ordinary FFT +
+shift along frequency, exactly like the reference's working C path.  The
+reference's pure-numpy fallback is broken (undefined ``t``, different
+shift/sign — scint_utils.py:389-392); ours is fixed and tested against the
+native path.
+
+Execution paths (all agree to float64 tolerances; see tests/test_nudft.py):
+
+* ``numpy``  — Doppler-chunked broadcast einsum (bounded memory);
+* native C++ — OpenMP rotation-recurrence kernel
+  (scintools_tpu/native/nudft.cc), auto-built, used by the numpy backend
+  when available;
+* ``jax``    — frequency-chunked batched matvec under ``lax.map``: for each
+  frequency the phase matrix is a dense [nr, nt] complex operator, so the
+  contraction is MXU-shaped and XLA pipelines chunk-by-chunk without ever
+  materialising the full [nr, nt, nf] phase tensor;
+* pallas     — TPU kernel (``nudft_pallas``) that computes phases on the fly
+  in VMEM tiles and accumulates over time blocks, trading HBM bandwidth for
+  VPU transcendentals.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..backend import resolve
+
+__all__ = ["nudft", "slow_ft", "slow_ft_power", "slow_ft_power_sharded",
+           "nudft_pallas"]
+
+
+def _r_grid(ntime: int) -> tuple[float, float, int]:
+    """Doppler grid of the reference driver (scint_utils.py:363-366):
+    fftfreq spacing, starting at its minimum, one bin per time sample."""
+    r = np.fft.fftfreq(ntime)
+    return float(r.min()), float(r[1] - r[0]), ntime
+
+
+def _nudft_numpy(power, fscale, tsrc, r0, dr, nr, chunk_r: int = 32):
+    power = np.asarray(power, dtype=np.float64)
+    fscale = np.asarray(fscale, dtype=np.float64)
+    tsrc = np.asarray(tsrc, dtype=np.float64)
+    ntime, nfreq = power.shape
+    rvals = r0 + dr * np.arange(nr)
+    tf = tsrc[:, None] * fscale[None, :]  # [nt, nf]
+    out = np.empty((nr, nfreq), dtype=np.complex128)
+    for start in range(0, nr, chunk_r):
+        rc = rvals[start:start + chunk_r]
+        phase = 2j * np.pi * rc[:, None, None] * tf[None, :, :]
+        out[start:start + chunk_r] = np.einsum(
+            "rtf,tf->rf", np.exp(phase), power, optimize=True)
+    return out
+
+
+def _nudft_jax_reim(power, fscale, tsrc, r0, dr, nr, chunk_f: int = 16):
+    """jax path returning ``(re, im)`` real arrays.
+
+    Real dtypes only at every boundary, and the contraction is two REAL
+    batched matvecs rather than one complex einsum: the axon TPU backend
+    does not implement complex transfers or complex dots (and the MXU is a
+    real systolic array anyway) — see memory note tpu-complex-unsupported.
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    power = jnp.asarray(power)
+    if not jnp.issubdtype(power.dtype, jnp.floating):
+        power = power.astype(jnp.float32)
+    fscale = jnp.asarray(fscale, dtype=power.dtype)
+    tsrc = jnp.asarray(tsrc, dtype=power.dtype)
+    ntime, nfreq = power.shape
+    pad = (-nfreq) % chunk_f
+    fs = jnp.pad(fscale, (0, pad))
+    pw = jnp.pad(power, ((0, 0), (0, pad)))
+    nchunks = (nfreq + pad) // chunk_f
+    fs = fs.reshape(nchunks, chunk_f)
+    pw = jnp.moveaxis(pw.reshape(ntime, nchunks, chunk_f), 1, 0)  # [nc,nt,cf]
+    rvals = (r0 + dr * jnp.arange(nr)).astype(power.dtype)
+
+    def one_chunk(operand):
+        fs_c, p_c = operand  # [cf], [nt, cf]
+        # [nr, nt, cf] phases built per chunk only; never the full tensor.
+        phase = (2 * jnp.pi) * (
+            rvals[:, None, None] * tsrc[None, :, None] * fs_c[None, None, :])
+        re = jnp.einsum("rtc,tc->rc", jnp.cos(phase), p_c)
+        im = jnp.einsum("rtc,tc->rc", jnp.sin(phase), p_c)
+        return re, im
+
+    re, im = lax.map(one_chunk, (fs, pw))         # each [nc, nr, cf]
+    re = jnp.moveaxis(re, 0, 1).reshape(nr, nfreq + pad)[:, :nfreq]
+    im = jnp.moveaxis(im, 0, 1).reshape(nr, nfreq + pad)[:, :nfreq]
+    return re, im
+
+
+def nudft(power, fscale, tsrc=None, r0=None, dr=None, nr=None,
+          backend: str = "numpy", use_native: bool | None = None):
+    """NUDFT core: ``out[r, f] = sum_t cis(2*pi*(r0+r*dr)*tsrc[t]*fscale[f])
+    * power[t, f]``.
+
+    Defaults reproduce the reference driver's grid (tsrc = sample index,
+    Doppler bins = fftfreq(ntime) sorted ascending — scint_utils.py:360-366).
+    ``use_native=None`` tries the C++ library on the numpy backend and falls
+    back silently.
+    """
+    ntime = power.shape[0]
+    if tsrc is None:
+        tsrc = np.arange(ntime, dtype=np.float64)
+    if r0 is None or dr is None or nr is None:
+        g0, gd, gn = _r_grid(ntime)
+        r0 = g0 if r0 is None else r0
+        dr = gd if dr is None else dr
+        nr = gn if nr is None else nr
+    if resolve(backend) == "jax":
+        from jax import lax
+
+        re, im = _nudft_jax_reim(power, fscale, tsrc, r0, dr, nr)
+        # complex assembled ON DEVICE (supported on TPU); callers on real
+        # TPU must not transfer it directly — use slow_ft_power, or
+        # jnp.real/jnp.imag before the transfer (tpu-complex-unsupported).
+        return lax.complex(re, im)
+    if use_native is None or use_native:
+        from ..native import nudft_native
+
+        out = nudft_native(power, fscale, tsrc, r0, dr, nr)
+        if out is not None:
+            return out
+        if use_native:
+            raise RuntimeError("native NUDFT library unavailable")
+    return _nudft_numpy(power, fscale, tsrc, r0, dr, nr)
+
+
+def slow_ft(dyn, freqs, backend: str = "numpy", use_native: bool | None = None,
+            as_numpy: bool = False):
+    """Arc-sharpened secondary-spectrum field of ``dyn`` [ntime, nfreq].
+
+    Pipeline parity with the reference's working (C) branch
+    (scint_utils.py:356-397): scale time by f/fref (fref = centre channel),
+    NUDFT along scaled time, flip the Doppler axis, then FFT + fftshift along
+    frequency.  Returns complex [ntime, nfreq].
+    """
+    dyn = np.asarray(dyn) if resolve(backend) == "numpy" else dyn
+    ntime, nfreq = dyn.shape
+    freqs = np.asarray(freqs, dtype=np.float64)
+    fscale = freqs / freqs[nfreq // 2]
+    out = nudft(dyn, fscale, backend=backend, use_native=use_native)
+    if resolve(backend) == "jax":
+        import jax.numpy as jnp
+
+        out = out[::-1]
+        out = jnp.fft.fftshift(jnp.fft.fft(out, axis=1), axes=1)
+        if as_numpy:
+            # transfer real and imaginary planes separately: complex
+            # host<->device copies are unimplemented on the axon TPU
+            return (np.asarray(jnp.real(out))
+                    + 1j * np.asarray(jnp.imag(out)))
+        return out
+    out = np.asarray(out)[::-1]
+    return np.fft.fftshift(np.fft.fft(out, axis=1), axes=1)
+
+
+def slow_ft_power(dyn, freqs, db: bool = True, backend: str = "jax"):
+    """|slow_ft|^2 with real dtypes at every boundary — the TPU-safe,
+    jit-composable form of the arc-sharpened secondary spectrum.
+
+    The reference exposes only the complex field (scint_utils.py:317); its
+    consumers immediately take power.  Returns real [ntime, nfreq]
+    (10*log10 when ``db``).
+    """
+    if resolve(backend) != "jax":
+        ss = slow_ft(dyn, freqs, backend="numpy")
+        p = np.abs(ss) ** 2
+        return 10 * np.log10(p) if db else p
+    import jax.numpy as jnp
+
+    ss = slow_ft(dyn, freqs, backend="jax")
+    p = jnp.real(ss) ** 2 + jnp.imag(ss) ** 2
+    return 10 * jnp.log10(p) if db else p
+
+
+def slow_ft_power_sharded(dyn, freqs, mesh, axis: str = "data",
+                          db: bool = True):
+    """Mesh-sharded arc-sharpened secondary spectrum (SURVEY.md §5
+    "long-context" analogue: the NUDFT as a device-sharded einsum).
+
+    The O(ntime * nfreq * nr) NUDFT decomposes output-parallel over the
+    Doppler axis: shard ``axis`` devices each build only their own
+    [nr/n, nt, chunk_f] phase slabs (zero communication — each Doppler
+    block depends on the whole dynspec, which is replicated, the way DP
+    replicates activations).  The frequency-axis FFT that follows is
+    along an unsharded axis, so XLA runs it locally per shard; only the
+    Doppler flip moves data between devices.  Use when a single spectrum
+    is too large for one device's HBM budget, or to cut single-spectrum
+    latency across a pod slice.
+
+    Returns the real power spectrum [ntime, nfreq] (10*log10 when
+    ``db``), sharded [axis, None] over the mesh.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # prefer the stable location (jax.shard_map); experimental fallback
+    # for older jax (same pattern as parallel/mesh.py)
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    ntime, nfreq = dyn.shape
+    freqs = np.asarray(freqs, dtype=np.float64)
+    fscale = freqs / freqs[nfreq // 2]
+    tsrc = np.arange(ntime, dtype=np.float64)
+    r0, dr, nr = _r_grid(ntime)
+    n = mesh.shape[axis]
+    nr_pad = (-nr) % n
+    nr_p = nr + nr_pad  # extra top bins computed then dropped
+    nr_local = nr_p // n
+
+    def local_block(dyn_rep):
+        idx = lax.axis_index(axis)
+        r0_local = r0 + dr * (idx * nr_local).astype(np.float64)
+        return _nudft_jax_reim(dyn_rep, fscale, tsrc, r0_local, dr, nr_local)
+
+    dyn_rep = jax.device_put(jnp.asarray(dyn),
+                             NamedSharding(mesh, P(None, None)))
+    re, im = shard_map(local_block, mesh=mesh, in_specs=P(None, None),
+                       out_specs=P(axis, None))(dyn_rep)
+    field = lax.complex(re, im)[:nr][::-1]  # flip = ppermute across shards
+    field = jnp.fft.fftshift(jnp.fft.fft(field, axis=1), axes=1)
+    p = jnp.real(field) ** 2 + jnp.imag(field) ** 2
+    return 10 * jnp.log10(p) if db else p
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+
+def _nudft_pallas_kernel(fs_ref, pw_ref, re_ref, im_ref, *,
+                         r0, dr, t0, dt, block_r, block_t, nt):
+    """One (r-block, f-block) tile: accumulate over time in VMEM-sized
+    [block_r, block_t, block_f] phase slabs computed on the fly.
+
+    Mosaic constraints probed on the axon TPU (see tests/test_nudft.py and
+    memory note tpu-complex-unsupported): 1-D iota must be the integer
+    broadcasted_iota form; lane-dim dynamic slices feeding rank-3 broadcasts
+    inside fori_loop fail to compile.  So the time grid is generated
+    in-kernel from its (t0, dt) affine form instead of being sliced out of a
+    tsrc operand — uniform tsrc only (callers fall back to the einsum path
+    otherwise).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    r_idx = lax.broadcasted_iota(jnp.int32, (block_r, 1, 1), 0
+                                 ).astype(jnp.float32)
+    rvals = r0 + dr * (i * block_r + r_idx)          # [block_r, 1, 1]
+    t_idx = lax.broadcasted_iota(jnp.int32, (1, block_t, 1), 1
+                                 ).astype(jnp.float32)
+    fs3 = fs_ref[0:1, :][:, None, :]       # [1, 1, block_f]
+    acc_re = jnp.zeros(re_ref.shape, dtype=jnp.float32)
+    acc_im = jnp.zeros(im_ref.shape, dtype=jnp.float32)
+
+    def body(tb, carry):
+        a_re, a_im = carry
+        p = pw_ref[pl.dslice(tb * block_t, block_t), :]  # [block_t, block_f]
+        ts3 = t0 + dt * (tb * block_t + t_idx)           # [1, block_t, 1]
+        # [block_r, block_t, block_f]
+        phase = (2 * jnp.pi) * (rvals * ts3 * fs3)
+        a_re = a_re + jnp.sum(jnp.cos(phase) * p[None, :, :], axis=1)
+        a_im = a_im + jnp.sum(jnp.sin(phase) * p[None, :, :], axis=1)
+        return a_re, a_im
+
+    n_tb = nt // block_t
+    if n_tb == 1:
+        # trip-count-1 fori_loop fails mosaic compilation on this backend
+        acc_re, acc_im = body(0, (acc_re, acc_im))
+    else:
+        acc_re, acc_im = lax.fori_loop(0, n_tb, body, (acc_re, acc_im))
+    re_ref[...] = acc_re
+    im_ref[...] = acc_im
+
+
+def nudft_pallas(power, fscale, tsrc=None, r0=None, dr=None, nr=None,
+                 block_r: int = 64, block_t: int = 64, block_f: int = 128,
+                 interpret: bool = False):
+    """Pallas-TPU NUDFT: float32 in/out (re, im), phases generated in VMEM.
+
+    Grid is (nr/block_r, nf/block_f); each instance streams the time axis in
+    ``block_t`` slabs so the [r, t, f] phase tensor never touches HBM.
+    Inputs are zero-padded to block multiples (zero power contributes zero).
+    Requires uniform tsrc (falls back to the einsum path otherwise).
+    Returns complex64 [nr, nf] — on-device only on real TPU; transfer
+    real/imag planes separately (tpu-complex-unsupported).
+
+    Block sizes bound VMEM: several live [block_r, block_t, block_f] f32
+    slabs (phase, cos, sin, products) must fit in ~16 MB, so keep
+    block_r*block_t*block_f at or below ~1M elements (defaults: 0.5M).
+    Oversizing fails with an opaque remote-compile 500 on this backend.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    power = jnp.asarray(power, dtype=jnp.float32)
+    ntime, nfreq = power.shape
+    if r0 is None or dr is None or nr is None:
+        g0, gd, gn = _r_grid(ntime)
+        r0 = g0 if r0 is None else r0
+        dr = gd if dr is None else dr
+        nr = gn if nr is None else nr
+    if tsrc is None:
+        t0, dt = 0.0, 1.0
+    else:
+        tsrc = np.asarray(tsrc, dtype=np.float64)
+        if ntime > 2 and not np.allclose(
+                np.diff(tsrc), tsrc[1] - tsrc[0], rtol=0, atol=1e-12):
+            re, im = _nudft_jax_reim(power, fscale, tsrc, r0, dr, nr)
+            return lax.complex(re, im)
+        t0 = float(tsrc[0])
+        dt = float(tsrc[1] - tsrc[0]) if ntime > 1 else 1.0
+
+    block_r = min(block_r, nr)
+    block_t = min(block_t, ntime)
+    block_f = min(block_f, nfreq)
+    pad_t = (-ntime) % block_t
+    pad_f = (-nfreq) % block_f
+    pad_r = (-nr) % block_r
+    pw = jnp.pad(power, ((0, pad_t), (0, pad_f)))
+    fs = jnp.pad(jnp.asarray(fscale, dtype=jnp.float32), (0, pad_f))
+    nt_p, nf_p = pw.shape
+    nr_p = nr + pad_r
+
+    kernel = functools.partial(
+        _nudft_pallas_kernel, r0=float(r0), dr=float(dr), t0=t0, dt=dt,
+        block_r=block_r, block_t=block_t, nt=nt_p)
+    out_shape = [
+        jax.ShapeDtypeStruct((nr_p, nf_p), jnp.float32) for _ in range(2)]
+    grid = (nr_p // block_r, nf_p // block_f)
+    re, im = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_f), lambda i, j: (0, j)),     # fscale row
+            pl.BlockSpec((nt_p, block_f), lambda i, j: (0, j)),  # power
+        ],
+        out_specs=[
+            pl.BlockSpec((block_r, block_f), lambda i, j: (i, j)),
+            pl.BlockSpec((block_r, block_f), lambda i, j: (i, j)),
+        ],
+        out_shape=out_shape,
+        interpret=interpret,
+    )(fs[None, :], pw)
+    out = lax.complex(re, im)[:nr, :nfreq]
+    return out

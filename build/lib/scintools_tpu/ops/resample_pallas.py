@@ -1,0 +1,109 @@
+"""EXPERIMENTAL Pallas prototype: fused arc row-resample + delay-scrunch.
+
+The arc fitter's hot op (fit/arc_fit.py) is, per epoch: gather each
+delay row of the secondary spectrum onto a row-specific normalised
+Doppler grid (static indices/weights [R, n]) and nanmean over rows.
+The production paths are a full [B, R, n] XLA gather (arc_scrunch_rows
+=0) and a lax.scan over row blocks (=N, the TPU auto default); this
+kernel fuses gather + interpolate + NaN-masked accumulation in VMEM so
+the [rb, n] intermediates never touch HBM.
+
+Status: validated in INTERPRET mode only (tests/test_resample_pallas.py
+is CPU; `scripts/tpu_recheck.sh` carries the real-Mosaic lowering gate —
+the per-lane `take_along_axis` is exactly the op Mosaic may refuse or
+serialise, docs/roadmap.md).  NOT wired into make_arc_fitter until it
+measures faster on hardware; use `row_scrunch_pallas` directly to A/B.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+__all__ = ["row_scrunch_pallas"]
+
+
+def _kernel(rows_ref, i0_ref, w_ref, sum_ref, cnt_ref):
+    import jax.numpy as jnp
+
+    rows = rows_ref[...]                       # [rb, C]
+    i0 = i0_ref[...]                           # [rb, n]
+    w = w_ref[...].astype(rows.dtype)          # [rb, n]
+    v0 = jnp.take_along_axis(rows, i0, axis=1)
+    v1 = jnp.take_along_axis(rows, i0 + 1, axis=1)
+    nrm = v0 * (1.0 - w) + v1 * w
+    keep = ~jnp.isnan(nrm)
+    sum_ref[...] = jnp.sum(jnp.where(keep, nrm, 0.0), axis=0,
+                           keepdims=True)
+    cnt_ref[...] = jnp.sum(keep.astype(rows.dtype), axis=0,
+                           keepdims=True)
+
+
+@functools.lru_cache(maxsize=8)
+def _build(R: int, C: int, n: int, block_r: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    nb = -(-R // block_r)
+
+    def run(rows, i0, w):
+        pad_r = nb * block_r - R
+        # NaN row padding contributes nothing (keep=False lanes)
+        rows_p = jnp.pad(rows, ((0, pad_r), (0, 0)),
+                         constant_values=np.nan)
+        i0_p = jnp.pad(i0, ((0, pad_r), (0, 0)))
+        w_p = jnp.pad(w, ((0, pad_r), (0, 0)))
+        s, c = pl.pallas_call(
+            _kernel,
+            grid=(nb,),
+            in_specs=[
+                pl.BlockSpec((block_r, C), lambda b: (b, 0)),
+                pl.BlockSpec((block_r, n), lambda b: (b, 0)),
+                pl.BlockSpec((block_r, n), lambda b: (b, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, n), lambda b: (b, 0)),
+                pl.BlockSpec((1, n), lambda b: (b, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((nb, n), rows.dtype),
+                jax.ShapeDtypeStruct((nb, n), rows.dtype),
+            ],
+            interpret=interpret,
+        )(rows_p, i0_p, w_p)
+        cnt = jnp.sum(c, axis=0)
+        # guarded denominator, as the production scan path does: the 0/0
+        # of an all-NaN bin is discarded by the where but would trip
+        # jax_debug_nans during exactly the hardware A/B this exists for
+        return jnp.where(cnt > 0,
+                         jnp.sum(s, axis=0) / jnp.maximum(cnt, 1.0),
+                         jnp.nan)
+
+    return jax.jit(run)
+
+
+def row_scrunch_pallas(rows, i0, w, block_r: int = 64,
+                       interpret: bool = False):
+    """NaN-skipping delay-scrunch of row-resampled spectra.
+
+    ``rows`` [R, C] (one epoch's masked sspec rows), ``i0``/``w``
+    [R, n] static gather indices and linear-interp weights (from the
+    arc fitter's `_row_interp_pattern`).  Returns the [n] profile:
+    nanmean over rows of ``rows[r, i0[r, j]] * (1-w) + rows[r, i0+1] * w``
+    — bit-compatible with the production paths' math (modulo f.p.
+    association).  vmap over a batch axis works (pallas batching rule).
+    """
+    import jax.numpy as jnp
+
+    rows = jnp.asarray(rows)
+    i0 = jnp.asarray(i0, dtype=jnp.int32)
+    w = jnp.asarray(w)
+    R, C = rows.shape[-2], rows.shape[-1]
+    n = i0.shape[-1]
+    if i0.shape[-2] != R or w.shape[-2:] != (R, n):
+        raise ValueError(f"shape mismatch: rows [{R},{C}], i0 "
+                         f"{i0.shape}, w {w.shape}")
+    return _build(int(R), int(C), int(n), int(min(block_r, R)),
+                  bool(interpret))(rows, i0, w)

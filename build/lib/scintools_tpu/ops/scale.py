@@ -1,0 +1,164 @@
+"""Axis rescaling: frequency -> uniform wavelength steps, and trapezoid.
+
+Reference: ``Dynspec.scale_dyn`` (dynspec.py:1402-1476).
+
+``lambda`` mode resamples every time column from the (uniform-frequency)
+channel grid onto a uniform-wavelength grid with cubic interpolation
+(dynspec.py:1412-1428); output is flipped so wavelength decreases with row
+index, matching ascending frequency.  The reference loops over columns with
+``interp1d(kind='cubic')``; scipy's interpolator handles the whole 2-D array
+at once (identical splines), so the numpy path is loop-free.  The jax path
+implements a *natural* cubic spline with a dense solve (nchan is small) so
+it jits and vmaps; it differs from scipy's not-a-knot boundary only in the
+outermost two channels (tolerance asserted in tests).
+
+``trapezoid`` mode time-resamples each row by f/fmin (dynspec.py:1429-1476).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+from scipy.interpolate import interp1d
+
+from ..backend import resolve
+from ..data import DynspecData, _C_M_S
+from .windows import apply_2d_window
+
+
+def lambda_grid(freqs: np.ndarray):
+    """Uniform wavelength grid spanning the band (dynspec.py:1418-1420):
+    step = max |diff(lambda)|, which the reference takes so the lambda grid
+    never oversamples the coarsest channel spacing."""
+    lams = _C_M_S / (np.asarray(freqs) * 1e6)
+    dlam = np.max(np.abs(np.diff(lams)))
+    lam_eq = np.arange(np.min(lams), np.max(lams), dlam)
+    return lam_eq, dlam
+
+
+def scale_lambda(d: DynspecData, backend: str = "numpy") -> tuple:
+    """Return (lamdyn [nlam, nt], lam [nlam], dlam).
+
+    lamdyn rows are flipped (descending wavelength = ascending frequency),
+    matching dynspec.py:1427-1428.
+    """
+    backend = resolve(backend)
+    freqs = np.asarray(d.freqs)
+    lam_eq, dlam = lambda_grid(freqs)
+    feq = _C_M_S / lam_eq / 1e6
+    if backend == "numpy":
+        f = interp1d(freqs, np.asarray(d.dyn), kind="cubic", axis=0)
+        arout = f(feq)
+    else:
+        arout = _cubic_interp_jax()(d.dyn, np.asarray(freqs, dtype=np.float64),
+                                    np.asarray(feq, dtype=np.float64))
+    return arout[::-1], lam_eq[::-1], dlam
+
+
+@functools.lru_cache(maxsize=1)
+def _cubic_interp_jax():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def impl(y, x, xq):
+        """Natural cubic spline along axis 0, evaluated at xq.
+
+        x is a static-shape 1-D grid.  Dense tridiagonal solve: nchan is a
+        few hundred, so an O(n^2) solve is negligible next to the FFTs and
+        keeps the code mesh-shardable.  Differs from scipy's not-a-knot
+        boundary only in the outermost two channels (documented tolerance in
+        tests).
+        """
+        n = x.shape[0]
+        # solve in the wider of (data, grid) dtypes: scattering f64 grid
+        # spacings into an f32 system is a FutureWarning -> error in jax
+        dtype = jnp.result_type(y.dtype, x.dtype)
+        y = y.astype(dtype)
+        x = x.astype(dtype)
+        xq = xq.astype(dtype)
+        h = jnp.diff(x)  # [n-1]
+        # Build the natural-spline system A m = rhs for second derivatives m.
+        A = jnp.zeros((n, n), dtype=dtype)
+        A = A.at[0, 0].set(1.0)
+        A = A.at[n - 1, n - 1].set(1.0)
+        idx = jnp.arange(1, n - 1)
+        A = A.at[idx, idx - 1].set(h[:-1])
+        A = A.at[idx, idx].set(2.0 * (h[:-1] + h[1:]))
+        A = A.at[idx, idx + 1].set(h[1:])
+        dy = jnp.diff(y, axis=0)
+        slope = dy / h[:, None]
+        rhs = jnp.zeros_like(y)
+        rhs = rhs.at[1:-1].set(6.0 * (slope[1:] - slope[:-1]))
+        m = jnp.linalg.solve(A, rhs)  # [n, nt] second derivatives
+
+        j = jnp.clip(jnp.searchsorted(x, xq, side="right") - 1, 0, n - 2)
+        xj, xj1 = x[j], x[j + 1]
+        hj = (xj1 - xj)[:, None]
+        t0 = (x[j + 1][:, None] - xq[:, None])
+        t1 = (xq[:, None] - xj[:, None])
+        yj, yj1, mj, mj1 = y[j], y[j + 1], m[j], m[j + 1]
+        return (mj * t0 ** 3 / (6 * hj) + mj1 * t1 ** 3 / (6 * hj)
+                + (yj / hj - mj * hj / 6) * t0
+                + (yj1 / hj - mj1 * hj / 6) * t1)
+
+    return impl
+
+
+def natural_cubic_interp_numpy(y: np.ndarray, x: np.ndarray,
+                               xq: np.ndarray) -> np.ndarray:
+    """Host-side natural cubic spline along axis 0 — the exact numpy
+    transcription of the jax solver above (same boundary conditions, so
+    the two agree to rounding).  Used where device execution must be
+    avoided at build time (e.g. precomputing resampling weights while
+    the accelerator is untouched/unreachable)."""
+    y = np.asarray(y, dtype=np.float64)
+    x = np.asarray(x, dtype=np.float64)
+    xq = np.asarray(xq, dtype=np.float64)
+    n = x.shape[0]
+    h = np.diff(x)
+    A = np.zeros((n, n))
+    A[0, 0] = A[n - 1, n - 1] = 1.0
+    idx = np.arange(1, n - 1)
+    A[idx, idx - 1] = h[:-1]
+    A[idx, idx] = 2.0 * (h[:-1] + h[1:])
+    A[idx, idx + 1] = h[1:]
+    slope = np.diff(y, axis=0) / h[:, None]
+    rhs = np.zeros_like(y)
+    rhs[1:-1] = 6.0 * (slope[1:] - slope[:-1])
+    m = np.linalg.solve(A, rhs)
+
+    j = np.clip(np.searchsorted(x, xq, side="right") - 1, 0, n - 2)
+    hj = (x[j + 1] - x[j])[:, None]
+    t0 = (x[j + 1][:, None] - xq[:, None])
+    t1 = (xq[:, None] - x[j][:, None])
+    yj, yj1, mj, mj1 = y[j], y[j + 1], m[j], m[j + 1]
+    return (mj * t0 ** 3 / (6 * hj) + mj1 * t1 ** 3 / (6 * hj)
+            + (yj / hj - mj * hj / 6) * t0
+            + (yj1 / hj - mj1 * hj / 6) * t1)
+
+
+def scale_trapezoid(d: DynspecData, window: str | None = "hanning",
+                    window_frac: float = 0.1) -> np.ndarray:
+    """Trapezoid time-rescaling (dynspec.py:1429-1476): mean-subtract,
+    window, then per-row resample the time axis by a frequency-dependent
+    maximum time, zero-padding the tail."""
+    dyn = np.array(d.dyn, dtype=np.float64)
+    dyn -= np.mean(dyn)
+    if window is not None:
+        dyn = apply_2d_window(dyn, window, window_frac, backend="numpy")
+    nf = dyn.shape[0]
+    times = np.asarray(d.times)
+    freqs = np.asarray(d.freqs)
+    scalefrac = 1 / (freqs.max() / freqs.min())
+    timestep = times.max() * (1 - scalefrac) / (nf + 1)
+    trapdyn = np.empty_like(dyn)
+    for ii in range(nf):
+        maxtime = times.max() - (nf - (ii + 1)) * timestep
+        nkeep = int(np.sum(times <= maxtime))
+        newline = np.interp(np.linspace(times.min(), times.max(), nkeep),
+                            times, dyn[ii, :])
+        trapdyn[ii, :] = np.concatenate([newline,
+                                         np.zeros(dyn.shape[1] - nkeep)])
+    return trapdyn

@@ -1,0 +1,163 @@
+"""Secondary spectrum: 2-D power spectrum of the dynamic spectrum.
+
+Reference: ``Dynspec.calc_sspec`` (dynspec.py:1228-1335).  Pipeline:
+
+    mean-subtract -> split edge window -> mean-subtract again ->
+    prewhiten (first difference both axes) -> fft2 padded to next-pow2*2 ->
+    |.|^2 -> fftshift -> keep positive delays -> postdarken (divide by the
+    sin^2 response of the prewhitening filter) -> 10*log10
+
+Axes (dynspec.py:1291-1299): fdop in mHz, tdel in us, and beta in 1/m when
+the input is in uniform-wavelength steps.
+
+The reference prewhitens with ``convolve2d([[1,-1],[-1,1]], dyn, 'valid')``
+(dynspec.py:1282), which equals the separable second difference
+``d[1:,1:] - d[1:,:-1] - d[:-1,1:] + d[:-1,:-1]``; the numpy path keeps
+scipy's convolve2d for bit-matching, the jax path uses the difference form
+(XLA fuses it into the FFT's pad).
+
+Quirks preserved on both paths (SURVEY.md "hard parts"): the double mean
+subtraction (dynspec.py:1251,1280), asymmetric window insertion, and the
+postdark singular rows/cols forced to 1 (dynspec.py:1308-1309).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+from scipy.signal import convolve2d
+
+from ..backend import resolve
+from .windows import apply_2d_window
+
+
+def next_pow2_fft_lens(nf: int, nt: int) -> tuple[int, int]:
+    """FFT lengths: next power of two, doubled (dynspec.py:1277-1279)."""
+    nrfft = int(2 ** (np.ceil(np.log2(nf)) + 1))
+    ncfft = int(2 ** (np.ceil(np.log2(nt)) + 1))
+    return nrfft, ncfft
+
+
+def sspec_axes(nf: int, nt: int, dt, df, dlam=None):
+    """fdop (mHz), tdel (us), beta (1/m, when dlam given).
+
+    Mirrors dynspec.py:1291-1299. ``dt``/``df``/``dlam`` may be traced
+    scalars under vmap; shapes depend only on static nf/nt.
+    """
+    nrfft, ncfft = next_pow2_fft_lens(nf, nt)
+    td = np.arange(nrfft // 2)
+    fd = np.arange(-ncfft // 2, ncfft // 2)
+    fdop = fd * 1e3 / (ncfft * dt)
+    tdel = td / (nrfft * df)
+    beta = None if dlam is None else td / (nrfft * dlam)
+    return fdop, tdel, beta
+
+
+def sspec(dyn, prewhite: bool = True, window: str | None = "blackman",
+          window_frac: float = 0.1, db: bool = True, backend: str = "numpy"):
+    """Secondary spectrum of ``dyn`` [..., nf, nt].
+
+    Returns sec [..., nrfft/2, ncfft] in dB (positive delays only).
+    Use :func:`sspec_axes` for the fdop/tdel/beta axes.
+    """
+    backend = resolve(backend)
+    shape = np.shape(dyn)  # works for lists and device arrays alike
+    if len(shape) < 2 or shape[-2] < 2 or shape[-1] < 2:
+        raise ValueError(f"secondary spectrum needs at least a 2x2 "
+                         f"dynspec, got {shape} (prewhitening "
+                         f"differences both axes)")
+    if backend == "numpy":
+        arr = np.asarray(dyn, dtype=np.float64)
+        if arr.ndim > 2:  # batched: per-epoch (host loop; use jax on device)
+            lead = arr.shape[:-2]
+            flat = arr.reshape((-1,) + arr.shape[-2:])
+            out = np.stack([_sspec_numpy(a, prewhite, window, window_frac, db)
+                            for a in flat])
+            return out.reshape(lead + out.shape[-2:])
+        return _sspec_numpy(arr, prewhite, window, window_frac, db)
+    return _sspec_jax()(dyn, prewhite, window, window_frac, db)
+
+
+def _postdark(nrfft: int, ncfft: int, xp=np):
+    """sin^2 response of the 2x2 prewhitening filter on the cropped grid.
+
+    dynspec.py:1301-1309: outer product of sin^2(pi*fd/ncfft) and
+    sin^2(pi*td/nrfft), transposed to [nrfft/2, ncfft]; the fdop=0 column
+    and tdel=0 row are forced to 1 to avoid 0/0.
+    """
+    td = xp.arange(nrfft // 2)
+    fd = xp.arange(-ncfft // 2, ncfft // 2)
+    vec1 = xp.sin(xp.pi / ncfft * fd) ** 2  # [ncfft]
+    vec2 = xp.sin(xp.pi / nrfft * td) ** 2  # [nrfft/2]
+    pd = vec2[:, None] * vec1[None, :]
+    if xp is np:
+        pd[:, ncfft // 2] = 1
+        pd[0, :] = 1
+    else:
+        pd = pd.at[:, ncfft // 2].set(1.0)
+        pd = pd.at[0, :].set(1.0)
+    return pd
+
+
+def _sspec_numpy(dyn, prewhite, window, window_frac, db):
+    nf, nt = dyn.shape[-2], dyn.shape[-1]
+    dyn = dyn - np.mean(dyn)
+    if window is not None:
+        dyn = apply_2d_window(dyn, window, window_frac, backend="numpy")
+    nrfft, ncfft = next_pow2_fft_lens(nf, nt)
+    dyn = dyn - np.mean(dyn)
+    if prewhite:
+        simpw = convolve2d([[1, -1], [-1, 1]], dyn, mode="valid")
+    else:
+        simpw = dyn
+    simf = np.fft.fft2(simpw, s=[nrfft, ncfft])
+    sec = np.real(simf * np.conj(simf))
+    sec = np.fft.fftshift(sec)
+    sec = sec[nrfft // 2:, :]
+    if prewhite:
+        sec = sec / _postdark(nrfft, ncfft)
+    if db:
+        # zero-power pad bins legitimately map to -inf dB (the reference
+        # produces the same values, warning unsuppressed); downstream
+        # consumers mask by power, so the divide warning is just noise
+        with np.errstate(divide="ignore"):
+            sec = 10 * np.log10(sec)
+    return sec
+
+
+@functools.lru_cache(maxsize=1)
+def _sspec_jax():
+    import jax
+    import jax.numpy as jnp
+
+    @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+    def impl(dyn, prewhite, window, window_frac, db):
+        nf, nt = dyn.shape[-2], dyn.shape[-1]
+        dyn = dyn - jnp.mean(dyn, axis=(-2, -1), keepdims=True)
+        if window is not None:
+            dyn = apply_2d_window(dyn, window, window_frac, backend="jax")
+        nrfft, ncfft = next_pow2_fft_lens(nf, nt)
+        dyn = dyn - jnp.mean(dyn, axis=(-2, -1), keepdims=True)
+        if prewhite:
+            # separable 2nd difference == convolve2d([[1,-1],[-1,1]], 'valid')
+            simpw = (dyn[..., 1:, 1:] - dyn[..., 1:, :-1]
+                     - dyn[..., :-1, 1:] + dyn[..., :-1, :-1])
+        else:
+            simpw = dyn
+        # real input + positive-delay crop -> real FFT over the delay (row)
+        # axis: rfftn computes u = 0..nrfft/2 directly, halving the work of
+        # the reference's full complex fft2 (dynspec.py:1286-1289) whose
+        # negative delays are discarded anyway.  Row r of the reference's
+        # fftshift-then-crop output is u = r (delay axis unshifted), column
+        # c is v = c - ncfft/2 (Doppler axis shifted).
+        simf = jnp.fft.rfftn(simpw, s=(ncfft, nrfft), axes=(-1, -2))
+        sec = jnp.real(simf) ** 2 + jnp.imag(simf) ** 2
+        sec = jnp.fft.fftshift(sec, axes=-1)[..., : nrfft // 2, :]
+        if prewhite:
+            sec = sec / _postdark(nrfft, ncfft, xp=jnp).astype(sec.dtype)
+        if db:
+            sec = 10.0 * jnp.log10(sec)
+        return sec
+
+    return impl

@@ -1,0 +1,60 @@
+"""Split edge-taper windows for secondary-spectrum FFTs.
+
+The reference builds a window of length ``floor(window_frac*n)`` (blackman /
+hanning / hamming / bartlett), splits it in the middle and inserts ones so the
+taper only touches the edges (``dynspec.py:1253-1275``).  Note the insertion
+point ``ceil(len(w)/2)`` makes the split asymmetric for odd window lengths —
+we reproduce that exactly, since the numpy path must bit-match.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..backend import resolve, xp as _xp
+
+WINDOWS = ("hanning", "hamming", "blackman", "bartlett")
+
+
+def _base_window(name: str, m: int) -> np.ndarray:
+    if name == "hanning":
+        return np.hanning(m)
+    if name == "hamming":
+        return np.hamming(m)
+    if name == "blackman":
+        return np.blackman(m)
+    if name == "bartlett":
+        return np.bartlett(m)
+    raise ValueError(f"unknown window {name!r}; expected one of {WINDOWS}")
+
+
+def split_window(n: int, window: str = "blackman",
+                 window_frac: float = 0.1) -> np.ndarray:
+    """Length-``n`` edge taper: half the base window, flat ones, second half.
+
+    Equivalent to ``np.insert(w, ceil(len(w)/2), ones(n-len(w)))``
+    (dynspec.py:1269-1272).  Always built host-side with numpy: the window
+    depends only on static shapes, so the jax path treats it as a constant
+    folded into the jit trace.
+    """
+    m = int(np.floor(window_frac * n))
+    w = _base_window(window, m)
+    cut = int(np.ceil(m / 2))
+    return np.concatenate([w[:cut], np.ones(n - m), w[cut:]])
+
+
+def apply_2d_window(dyn, window: str = "blackman", window_frac: float = 0.1,
+                    backend: str = "numpy"):
+    """Apply the split taper along both axes of ``dyn`` [nf, nt].
+
+    Matches dynspec.py:1273-1275: time window multiplies rows, frequency
+    window multiplies columns.
+    """
+    backend = resolve(backend)
+    xp = _xp(backend)
+    nf, nt = dyn.shape[-2], dyn.shape[-1]
+    tw = split_window(nt, window, window_frac)
+    fw = split_window(nf, window, window_frac)
+    tw = xp.asarray(tw, dtype=dyn.dtype)
+    fw = xp.asarray(fw, dtype=dyn.dtype)
+    return dyn * tw[..., None, :] * fw[..., :, None]

@@ -1,0 +1,116 @@
+"""Padded batching of heterogeneous observing epochs.
+
+The reference processes epochs one at a time in a serial Python loop
+(``sort_dyn``, dynspec.py:1615-1657; the notebook's epoch-summing loop).
+The TPU pipeline instead wants one [B, nf, nt] array per step.  Real epochs
+have heterogeneous shapes, so batching is pad-and-mask (SURVEY.md hard part
+(c)):
+
+* epochs are grouped into shape buckets (`bucket_by_shape`) — same
+  observing setup -> same shape -> zero padding waste, one compile;
+* within a bucket (or when forcing a single shape) `pad_batch` pads each
+  dyn with its *own mean* — after the sspec kernel's mean subtraction
+  (ops/sspec.py) padded pixels are ~0, so they add no FFT power, matching
+  the reference's `refill` policy of filling gaps with the mean
+  (dynspec.py:1186-1187);
+* a `BatchMask` records what was real, and is carried through vmapped fits
+  so invalid lanes are dropped at gather time instead of raising (the
+  quarantine pattern of sort_dyn, made SPMD).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import defaultdict
+from typing import Any, Sequence
+
+import numpy as np
+
+from ..data import DynspecData, stack_batch
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchMask:
+    """Validity masks for a padded batch (all numpy, host-side)."""
+
+    epoch: Any  # [B] bool — False for pad-epochs added for divisibility
+    freq: Any   # [B, nf] bool — True where the channel is real
+    time: Any   # [B, nt] bool — True where the subint is real
+
+    @property
+    def n_valid(self) -> int:
+        return int(np.sum(self.epoch))
+
+
+def bucket_by_shape(epochs: Sequence[DynspecData]):
+    """Group epoch indices by dyn shape: {(nf, nt): [indices]}.
+
+    Shape equality alone does NOT imply the epochs can share one pipeline
+    (same shape, different band → different df/fc/λ-grid); the driver
+    buckets on full axis identity (parallel.driver.run_pipeline)."""
+    buckets: dict[tuple, list[int]] = defaultdict(list)
+    for i, d in enumerate(epochs):
+        buckets[(d.nchan, d.nsub)].append(i)
+    return dict(buckets)
+
+
+def _pad_axis(x: np.ndarray, n: int) -> np.ndarray:
+    """Extend a 1-D coordinate axis to length n, continuing its grid."""
+    if len(x) >= n:
+        return x[:n]
+    step = x[1] - x[0] if len(x) > 1 else 1.0
+    extra = x[-1] + step * np.arange(1, n - len(x) + 1)
+    return np.concatenate([x, extra])
+
+
+def pad_epoch(d: DynspecData, nchan: int, nsub: int,
+              fill: str = "mean") -> tuple[DynspecData, np.ndarray, np.ndarray]:
+    """Pad one epoch to [nchan, nsub]; returns (padded, freq_mask, time_mask).
+
+    fill='mean' pads with the epoch mean (zero power after mean-subtract);
+    fill='zero' pads with 0 (matches the reference's time-concat gap fill,
+    dynspec.py:76-84).
+    """
+    dyn = np.asarray(d.dyn, dtype=np.float64)
+    nf, nt = dyn.shape
+    if nf > nchan or nt > nsub:
+        raise ValueError(f"epoch {dyn.shape} larger than pad target "
+                         f"({nchan}, {nsub}); crop first")
+    value = float(np.mean(dyn)) if fill == "mean" else 0.0
+    out = np.full((nchan, nsub), value, dtype=np.float64)
+    out[:nf, :nt] = dyn
+    fmask = np.zeros(nchan, dtype=bool)
+    fmask[:nf] = True
+    tmask = np.zeros(nsub, dtype=bool)
+    tmask[:nt] = True
+    padded = d.replace(dyn=out, freqs=_pad_axis(np.asarray(d.freqs), nchan),
+                       times=_pad_axis(np.asarray(d.times), nsub))
+    return padded, fmask, tmask
+
+
+def pad_batch(epochs: Sequence[DynspecData], nchan: int | None = None,
+              nsub: int | None = None, batch_multiple: int = 1,
+              fill: str = "mean") -> tuple[DynspecData, BatchMask]:
+    """Pad epochs to a common shape, stack, and round B up to a multiple of
+    ``batch_multiple`` (the mesh's data-axis size) with mask-invalid copies
+    of the last epoch."""
+    if not epochs:
+        raise ValueError("empty batch")
+    nchan = max(d.nchan for d in epochs) if nchan is None else nchan
+    nsub = max(d.nsub for d in epochs) if nsub is None else nsub
+    padded, fmasks, tmasks, valid = [], [], [], []
+    for d in epochs:
+        p, fm, tm = pad_epoch(d, nchan, nsub, fill=fill)
+        padded.append(p)
+        fmasks.append(fm)
+        tmasks.append(tm)
+        valid.append(True)
+    while len(padded) % batch_multiple:
+        padded.append(padded[-1])
+        fmasks.append(fmasks[-1])
+        tmasks.append(tmasks[-1])
+        valid.append(False)
+    batch = stack_batch(padded)
+    mask = BatchMask(epoch=np.asarray(valid), freq=np.stack(fmasks),
+                     time=np.stack(tmasks))
+    return batch, mask

@@ -1,0 +1,145 @@
+"""Multi-host runtime bootstrap + cross-device survey statistics.
+
+The reference is strictly single-process (SURVEY.md §2.7); its "survey
+statistics" are per-file CSV rows aggregated by hand.  This module holds
+the two pieces that make the framework a distributed system:
+
+* :func:`initialize_multihost` — one-call ``jax.distributed`` bootstrap
+  (coordinator + process grid from args or the standard env vars).  After
+  it, ``jax.devices()`` enumerates the global device set and the
+  existing ``make_mesh``/``make_pipeline`` code scales unchanged: the
+  ``data`` axis spans hosts (DCN carries only data-parallel traffic),
+  ``chan`` stays intra-host on ICI.
+* :func:`make_hybrid_mesh` — an ICI×DCN-aware mesh: devices grouped so
+  the ``chan`` (ICI) axis never crosses a DCN boundary
+  (``mesh_utils.create_hybrid_device_mesh``).
+* :func:`survey_stats` — masked mean/std/count reductions of per-epoch
+  measurements (tau, dnu, eta, ...) over a data-sharded batch with
+  ``psum`` collectives inside ``shard_map`` — the "mean curvature per
+  pulsar" reduction of SURVEY.md §2.7, running on ICI/DCN instead of a
+  host gather.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .mesh import CHAN_AXIS, DATA_AXIS, make_mesh
+
+
+def initialize_multihost(coordinator_address: str | None = None,
+                         num_processes: int | None = None,
+                         process_id: int | None = None) -> bool:
+    """Initialise the JAX distributed runtime for a multi-host slice.
+
+    Arguments default to the standard env vars (JAX_COORDINATOR_ADDRESS,
+    JAX_NUM_PROCESSES, JAX_PROCESS_ID); on TPU pods all three can be
+    auto-detected by jax and may stay None.  Returns True when a
+    multi-process runtime was initialised, False for single-process runs
+    (no-op).  Safe to call twice.
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and (v := os.environ.get("JAX_NUM_PROCESSES")):
+        num_processes = int(v)
+    if process_id is None and (v := os.environ.get("JAX_PROCESS_ID")):
+        process_id = int(v)
+    if coordinator_address is None and num_processes in (None, 1):
+        return False  # single host
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+    except RuntimeError as e:
+        # idempotency: jax raises "distributed.initialize should only be
+        # called once" (wording differs across versions — match both)
+        msg = str(e).lower()
+        if "already" not in msg and "once" not in msg:
+            raise
+    return True
+
+
+def make_hybrid_mesh(ici_chan: int = 1, devices=None):
+    """Mesh whose ``chan`` axis stays inside each ICI island.
+
+    ``ici_chan`` is the channel-parallel degree per host/slice; the
+    ``data`` axis takes everything else (spanning DCN between hosts).
+    Falls back to a flat mesh when there is a single process.
+    """
+    import jax
+
+    n_proc = getattr(jax, "process_count", lambda: 1)()
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if n % ici_chan:
+        raise ValueError(f"{n} devices not divisible by ici_chan={ici_chan}")
+    if n_proc <= 1:
+        return make_mesh((n // ici_chan, ici_chan), devices=devices)
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh
+
+    per_host = n // n_proc
+    if ici_chan > per_host or per_host % ici_chan:
+        raise ValueError(
+            f"ici_chan={ici_chan} must divide the {per_host} devices per "
+            f"host (chan must not span the DCN boundary)")
+    try:
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(per_host // ici_chan, ici_chan),
+            dcn_mesh_shape=(n_proc, 1), devices=devices)
+    except ValueError:
+        # devices without slice metadata (multi-process CPU meshes, some
+        # single-slice topologies): group by process so the chan axis
+        # still never crosses the process (DCN) boundary
+        import numpy as _np
+
+        ordered = sorted(devices, key=lambda d: (d.process_index, d.id))
+        dev_array = _np.array(ordered, dtype=object).reshape(
+            n // ici_chan, ici_chan)
+    return Mesh(dev_array, (DATA_AXIS, CHAN_AXIS))
+
+
+def survey_stats(values, mesh, valid=None, axis: str = DATA_AXIS) -> dict:
+    """Masked survey statistics of a data-sharded [B] measurement array.
+
+    Invalid lanes (padding, failed fits, NaNs) are excluded via ``valid``
+    plus an automatic finiteness mask — the collective analogue of the
+    reference's quarantine pattern (bad epochs never crash or bias the
+    reduction).  All three reductions ride one ``psum`` each inside
+    ``shard_map``; the result is replicated on every device/host.
+
+    Returns {"mean", "std", "count"} as scalars.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    x = jnp.asarray(values)
+    ok = jnp.isfinite(x)
+    if valid is not None:
+        ok = ok & jnp.asarray(valid)
+
+    def local(xb, okb):
+        # two-pass: global mean first, then centred second moment — avoids
+        # the catastrophic E[x^2]-mean^2 cancellation in f32 when the
+        # measurement has a large mean and small scatter (tau ~ 5000 s
+        # +- 0.5 would otherwise round to std=0 on TPU)
+        xb = jnp.where(okb, xb, 0.0)
+        n = jax.lax.psum(jnp.sum(okb), axis_name=axis)
+        nf = jnp.maximum(n, 1).astype(xb.dtype)
+        mean = jax.lax.psum(jnp.sum(xb), axis_name=axis) / nf
+        d = jnp.where(okb, xb - mean, 0.0)
+        var = jax.lax.psum(jnp.sum(d * d), axis_name=axis) / nf
+        return (mean[None], jnp.sqrt(var)[None], n[None])
+
+    mean, std, count = shard_map(
+        local, mesh=mesh, in_specs=(P(axis), P(axis)),
+        out_specs=(P(None), P(None), P(None)))(x, ok)
+    return {"mean": float(mean[0]), "std": float(std[0]),
+            "count": int(count[0])}

@@ -1,0 +1,549 @@
+"""Batched multi-epoch pipeline: the framework's "training step".
+
+Reference analogue: the serial per-file loop of ``sort_dyn``
+(dynspec.py:1615-1657) and the notebook's per-epoch workflow — here rebuilt
+as ONE jit-compiled SPMD program over a [B, nf, nt] batch of dynamic
+spectra (BASELINE config 4):
+
+    dyn [B, nf, nt]
+      ├─ ACF (Wiener–Khinchin fft2 pair, ops/acf.py)        → [B, 2nf, 2nt]
+      │   └─ vmapped fixed-iteration LM tau/dnu fit          → ScintParams[B]
+      ├─ (lamsteps) freq→lambda resample as ONE matmul       → [B, nlam, nt]
+      │       (natural-cubic-spline weights precomputed host-side; the
+      │        per-column interp1d loop of dynspec.py:1424-1426 becomes an
+      │        MXU-friendly [nlam, nf] x [B, nf, nt] einsum)
+      ├─ secondary spectrum (ops/sspec.py)                   → [B, nr, nc]
+      │   └─ fixed-shape batched arc fitter (fit/arc_fit.py) → ArcFit[B]
+      └─ results gathered host-side, invalid lanes dropped via BatchMask
+
+All grid-dependent decisions (FFT lengths, eta grids, fold indices) are
+made host-side from the static (freqs, times) template, so the device
+program has static shapes and no data-dependent control flow.
+
+With a mesh, the batch axis is sharded over ``data`` (DP: zero intra-step
+communication) and optionally the channel axis over ``chan`` (SP analogue
+for spectra too large for one device's HBM; XLA inserts ICI all-to-alls
+around the sharded-axis FFT).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import numpy as np
+
+from ..fit.arc_fit import make_arc_fitter
+from ..fit.scint_fit import fit_scint_params_batch
+from ..ops.acf import acf as acf_op
+from ..ops.scale import lambda_grid
+from ..ops.sspec import sspec as sspec_op, sspec_axes
+from . import mesh as mesh_mod
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    """Static configuration of the batched step (hashable: jit cache key).
+
+    Mirrors the kwargs of the reference's default_processing + fit calls
+    (dynspec.py:188-198, 414-418, 928-934) as a typed config object
+    (SURVEY.md §5 "config/flag system").
+    """
+
+    lamsteps: bool = True
+    prewhite: bool = True
+    window: str | None = "blackman"
+    window_frac: float = 0.1
+    fit_scint: bool = True
+    fit_arc: bool = True
+    fit_scint_2d: bool = False    # 2-D ACF fit incl. phase-gradient tilt
+    alpha: float | None = 5 / 3       # None -> fit alpha too
+    lm_steps: int = 40
+    # Curvature estimator: "norm_sspec" / "gridmax" (the reference's two
+    # power-profile methods, fit/arc_fit.py) or "thetatheta" (eigenvalue
+    # concentration, fit/thetatheta.py — needs finite arc_constraint or
+    # arc_brackets windows; arc_numsteps becomes the per-window sweep
+    # size, where an untouched 2000 default is auto-replaced by 128)
+    arc_method: str = "norm_sspec"
+    arc_numsteps: int = 2000
+    arc_ntheta: int = 129         # thetatheta only: theta-grid points
+    arc_startbin: int = 3
+    arc_cutmid: int = 3
+    arc_nsmooth: int = 5
+    arc_delmax: float | None = None
+    arc_constraint: tuple = (0.0, np.inf)
+    arc_asymm: bool = False       # per-arm eta_left/eta_right in ArcFit
+    arc_brackets: tuple | None = None  # K (lo, hi) windows -> eta [B, K]
+    # Arc delay-scrunch strategy: 0 = full [B, R, n] gather, >0 = lax.scan
+    # row blocks of that size (bounded HBM), -1 = auto (64-row blocks on
+    # TPU — measured faster there both times it was profiled on chip —
+    # full gather elsewhere)
+    arc_scrunch_rows: int = -1
+    # ACF-cut route for the scint fit: "fft" (padded 1-D FFTs, VPU),
+    # "matmul" (Gram-matrix diagonal sums, MXU), or "auto" (matmul on
+    # TPU — measured ~2x faster there — fft elsewhere).  Only applies to
+    # the direct-cuts fast path; when return_acf/fit_scint_2d force the
+    # full 2-D ACF anyway, the fit reads its cuts from that ACF and this
+    # knob is irrelevant.
+    scint_cuts: str = "auto"
+    ref_freq: float = 1400.0
+    return_acf: bool = False
+    return_sspec: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineResult:
+    """Per-epoch measurements from one batched step ([B]-leading leaves)."""
+
+    scint: Any = None       # ScintParams with [B] leaves
+    arc: Any = None         # ArcFit with [B] leaves
+    acf: Any = None         # [B, 2nf, 2nt] when requested
+    sspec: Any = None       # [B, nr, nc] when requested
+    fdop: Any = None
+    tdel: Any = None
+    beta: Any = None
+    scint2d: Any = None     # ScintParams from the 2-D fit (fit_scint_2d)
+    tilt: Any = None        # [B] phase-gradient tilt (s/MHz)
+    tilterr: Any = None
+
+
+def _register():
+    try:
+        import jax
+
+        jax.tree_util.register_pytree_node(
+            PipelineResult,
+            lambda r: ((r.scint, r.arc, r.acf, r.sspec, r.fdop, r.tdel,
+                        r.beta, r.scint2d, r.tilt, r.tilterr), None),
+            lambda _, l: PipelineResult(*l))
+    except ImportError:  # pragma: no cover
+        pass
+
+
+_register()
+
+
+def lambda_resample_matrix(freqs: np.ndarray) -> tuple[np.ndarray, np.ndarray, float]:
+    """Precompute the freq→uniform-lambda natural-cubic-spline resampling
+    as a dense matrix W so that ``lamdyn = W @ dyn`` (rows already flipped
+    to descending wavelength, matching ops.scale.scale_lambda / reference
+    dynspec.py:1427-1428).  Spline interpolation is linear in the data, so
+    W columns are the splines of the unit vectors."""
+    from ..ops.scale import natural_cubic_interp_numpy
+    from ..data import _C_M_S
+
+    freqs = np.asarray(freqs, dtype=np.float64)
+    lam_eq, dlam = lambda_grid(freqs)
+    feq = _C_M_S / lam_eq / 1e6
+    eye = np.eye(len(freqs))
+    # host-side numpy transcription of the jax natural-spline solver:
+    # building the pipeline must not execute anything on the device
+    # (the accelerator may be deliberately untouched at build time)
+    W = natural_cubic_interp_numpy(eye, freqs, feq)  # [nlam, nf]
+    return W[::-1].copy(), lam_eq[::-1].copy(), float(dlam)
+
+
+def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
+                  mesh=None, chan_sharded: bool | None = None):
+    """Build the jit'd batched step for a fixed (freqs, times) template.
+
+    ``chan_sharded=None`` (default) derives channel sharding from the
+    mesh itself: any mesh with a >1 ``chan`` axis shards the
+    secondary-spectrum FFT's channel axis (why else build one).  Pass an
+    explicit bool to override.
+
+    Returns ``step(dyn_batch [B, nf, nt]) -> PipelineResult``.  Epochs with
+    other shapes go through parallel.batch.pad_batch / bucket_by_shape
+    first.  dt/df are taken from the template axes (uniform grids, as the
+    reference assumes — dynspec.py:1291-1299).
+
+    Memoised on (axes, config, mesh): repeated calls with the same template
+    return the same compiled step (no retrace/recompile per survey batch).
+    """
+    if config.scint_cuts not in ("auto", "fft", "matmul"):
+        raise ValueError(
+            f"PipelineConfig.scint_cuts: unknown method "
+            f"{config.scint_cuts!r} (expected 'auto', 'fft' or 'matmul')")
+    if config.arc_scrunch_rows < -1:
+        raise ValueError(
+            f"PipelineConfig.arc_scrunch_rows must be -1 (auto), 0 (full "
+            f"gather) or a positive block size, got "
+            f"{config.arc_scrunch_rows}")
+    if config.arc_method not in ("norm_sspec", "gridmax", "thetatheta"):
+        raise ValueError(
+            f"PipelineConfig.arc_method: unknown method "
+            f"{config.arc_method!r} (expected 'norm_sspec', 'gridmax' or "
+            f"'thetatheta')")
+    if config.arc_method == "thetatheta" and config.fit_arc:
+        windows = (config.arc_brackets if config.arc_brackets is not None
+                   else (config.arc_constraint,))
+        if len(windows) == 0:
+            raise ValueError("arc_brackets must contain at least one "
+                             "(lo, hi) window")
+        for lo, hi in windows:
+            if not (np.isfinite(lo) and np.isfinite(hi) and 0 < lo < hi):
+                raise ValueError(
+                    "arc_method='thetatheta' sweeps its curvature "
+                    f"bracket(s), which must be finite and positive, got "
+                    f"{tuple(windows)} (units follow the spectrum: "
+                    "beta-eta for lamsteps, us/mHz^2 otherwise, as "
+                    "fit_arc_thetatheta)")
+        if config.arc_asymm:
+            raise ValueError(
+                "arc_method='thetatheta' does not support arc_asymm "
+                "(the concentration sweep has no per-arm split)")
+        # knobs of the power-profile fitters that the concentration sweep
+        # has no analogue for: reject loudly rather than silently ignore
+        _def = PipelineConfig()
+        ignored = [name for name, val, dflt in (
+            ("arc_delmax", config.arc_delmax, _def.arc_delmax),
+            ("arc_nsmooth", config.arc_nsmooth, _def.arc_nsmooth),
+            ("arc_scrunch_rows", config.arc_scrunch_rows,
+             _def.arc_scrunch_rows),
+        ) if val != dflt]
+        if ignored:
+            raise ValueError(
+                f"arc_method='thetatheta' has no equivalent of "
+                f"{', '.join(ignored)} (norm_sspec/gridmax knobs); leave "
+                "them at their defaults")
+    freqs = np.ascontiguousarray(np.asarray(freqs, dtype=np.float64))
+    times = np.ascontiguousarray(np.asarray(times, dtype=np.float64))
+    if chan_sharded is None:
+        chan_sharded = (mesh is not None
+                        and int(mesh.shape.get(mesh_mod.CHAN_AXIS, 1)) > 1)
+    return _make_pipeline_cached(
+        (freqs.tobytes(), freqs.shape), (times.tobytes(), times.shape),
+        config, mesh, bool(chan_sharded))
+
+
+# "auto" falls back to the FFT route above this many bytes of Gram-matrix
+# working set: the matmul route materialises [B, nf, nf] + [B, nt, nt]
+# (the FFT route stays O(nf*nt) per epoch), so long axes must not OOM a
+# pipeline that worked before the auto default existed.
+_AUTO_MATMUL_GRAM_BYTE_CAP = 1 << 30
+
+
+def _gram_bytes(batch_shape, mesh, itemsize: int) -> int:
+    """Per-device bytes the matmul cuts route would materialise: the
+    [b, nf, nf] + [b, nt, nt] Gram matrices, with the batch axis divided
+    over the mesh's data axis when sharded."""
+    b = int(np.prod(batch_shape[:-2], dtype=np.int64))
+    if mesh is not None:
+        b = -(-b // int(mesh.shape.get(mesh_mod.DATA_AXIS, 1)))
+    nf, nt = int(batch_shape[-2]), int(batch_shape[-1])
+    return itemsize * b * (nf * nf + nt * nt)
+
+
+def _target_is_tpu(mesh) -> bool:
+    """Whether the execution target (the mesh's devices, or the default
+    device set) is a TPU.  Called at TRACE time only — never at
+    pipeline-build time, so building stays device-free."""
+    import jax
+
+    try:
+        devs = (list(mesh.devices.flat) if mesh is not None
+                else jax.devices())
+        d = devs[0]
+        kind = str(getattr(d, "device_kind", "")).lower()
+        return "tpu" in kind or d.platform in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+def _resolve_cuts(method: str, mesh, batch_shape=None,
+                  itemsize: int = 4) -> str:
+    """Resolve scint_cuts="auto" per target hardware: the MXU Gram route
+    is ~2x the FFT route on TPU (measured, docs/performance.md) and has
+    no advantage on CPU.  Called at TRACE time (inside the first step
+    call), never at pipeline-build time, so building stays device-free."""
+    if method not in ("auto", "fft", "matmul"):
+        raise ValueError(f"scint_cuts: unknown method {method!r} "
+                         "(expected 'auto', 'fft' or 'matmul')")
+    if method != "auto":
+        return method
+    if (batch_shape is not None
+            and _gram_bytes(batch_shape, mesh, itemsize)
+            > _AUTO_MATMUL_GRAM_BYTE_CAP):
+        return "fft"
+    return "matmul" if _target_is_tpu(mesh) else "fft"
+
+
+# auto block size for arc_scrunch_rows=-1 on TPU: both on-chip profiles
+# (docs/performance.md) had 64-row scan blocks beating the full gather
+_AUTO_ARC_SCRUNCH_TPU = 64
+
+
+@functools.lru_cache(maxsize=None)
+def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
+    import jax
+    import jax.numpy as jnp
+
+    freqs = np.frombuffer(freqs_key[0]).reshape(freqs_key[1])
+    times = np.frombuffer(times_key[0]).reshape(times_key[1])
+    nchan, nsub = len(freqs), len(times)
+    df = float(freqs[1] - freqs[0])
+    dt = float(times[1] - times[0])
+    fc = float(np.mean(freqs))
+
+    if config.lamsteps:
+        W, lam, dlam = lambda_resample_matrix(freqs)
+        nf_s = W.shape[0]
+        # stays numpy here: jnp.asarray inside the traced step embeds it
+        # as a compile-time constant instead of an eager device_put
+        # (building a pipeline must not touch the device)
+        W_np = W
+    else:
+        W_np, dlam = None, None
+        nf_s = nchan
+
+    fdop, tdel, beta = sspec_axes(nf_s, nsub, dt, df, dlam=dlam)
+    fdop = np.asarray(fdop, dtype=np.float64)
+    tdel = np.asarray(tdel, dtype=np.float64)
+
+    def build_arc_fitter():
+        # called at TRACE time (inside the first step call), so the
+        # scrunch auto-default may probe the execution target; building
+        # the pipeline itself stays device-free
+        if config.arc_method == "thetatheta":
+            from ..fit.thetatheta import make_tt_fitter
+
+            # arc_numsteps' 2000-point default sizes the norm_sspec eta
+            # grid; a 2000-iteration remap+power-iteration sweep is ~15x
+            # the documented-sufficient theta-theta sweep, so an
+            # untouched default becomes 128 here (explicit values win)
+            n_eta = config.arc_numsteps
+            if n_eta == PipelineConfig().arc_numsteps:
+                n_eta = 128
+
+            def make_one(lo, hi):
+                return make_tt_fitter(
+                    fdop=fdop, yaxis=beta if config.lamsteps else tdel,
+                    etamin=float(lo), etamax=float(hi),
+                    n_eta=n_eta, ntheta=config.arc_ntheta,
+                    startbin=config.arc_startbin,
+                    cutmid=config.arc_cutmid, lamsteps=config.lamsteps)
+
+            if config.arc_brackets is None:
+                return make_one(*config.arc_constraint)
+            # multi-arc: one bounded sweep per bracket, stacked to the
+            # same [B, K] result shape as norm_sspec's multi-window fit;
+            # each bracket keeps its own eta grid ([K, n_eta] profiles)
+            fitters = [make_one(lo, hi) for lo, hi in config.arc_brackets]
+
+            def multi(sec_b):
+                from ..data import ArcFit
+
+                fits = [f(sec_b) for f in fitters]
+                return ArcFit(
+                    eta=jnp.stack([f.eta for f in fits], axis=1),
+                    etaerr=jnp.stack([f.etaerr for f in fits], axis=1),
+                    etaerr2=jnp.stack([f.etaerr2 for f in fits], axis=1),
+                    lamsteps=config.lamsteps,
+                    profile_eta=jnp.stack(
+                        [f.profile_eta for f in fits]),
+                    profile_power=jnp.stack(
+                        [f.profile_power for f in fits], axis=1))
+
+            return multi
+        rc = config.arc_scrunch_rows
+        if rc == -1:
+            rc = _AUTO_ARC_SCRUNCH_TPU if _target_is_tpu(mesh) else 0
+        return make_arc_fitter(
+            fdop=fdop, yaxis=beta if config.lamsteps else tdel, tdel=tdel,
+            freq=fc, lamsteps=config.lamsteps, method=config.arc_method,
+            numsteps=config.arc_numsteps,
+            startbin=config.arc_startbin, cutmid=config.arc_cutmid,
+            nsmooth=config.arc_nsmooth, delmax=config.arc_delmax,
+            constraint=config.arc_constraint, ref_freq=config.ref_freq,
+            asymm=config.arc_asymm, constraints=config.arc_brackets,
+            scrunch_rows=rc)
+
+    def step(dyn_batch):
+        dyn_batch = jnp.asarray(dyn_batch)
+        out = {}
+        scint = None
+        scint2d = tilt = tilterr = None
+        if config.fit_scint or config.return_acf or config.fit_scint_2d:
+            dyn_acf = dyn_batch
+            if mesh is not None and chan_sharded:
+                # Sharding policy: the ACF/fit path is small (one [2nf,2nt]
+                # array per epoch), so gather the channel axis and run it
+                # purely data-parallel; only the big secondary-spectrum FFT
+                # keeps the chan sharding.  (Also sidesteps an XLA CPU
+                # fft-thunk layout RET_CHECK on chan-sharded ifft2.)
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                dyn_acf = jax.lax.with_sharding_constraint(
+                    dyn_batch, NamedSharding(mesh, P(mesh_mod.DATA_AXIS)))
+            if config.return_acf or config.fit_scint_2d:
+                acf_b = acf_op(dyn_acf, backend="jax")
+                if config.fit_scint:
+                    scint = fit_scint_params_batch(
+                        acf_b, dt, df, nchan, nsub, alpha=config.alpha,
+                        steps=config.lm_steps)
+                if config.fit_scint_2d:
+                    from ..fit.scint_fit import fit_scint_params_2d_batch
+
+                    scint2d, tilt, tilterr = fit_scint_params_2d_batch(
+                        acf_b, dt, abs(df), nchan, nsub,
+                        alpha=config.alpha, steps=config.lm_steps)
+                if config.return_acf:
+                    out["acf"] = acf_b
+            elif config.fit_scint:
+                # fast path: 1-D cuts via padded 1-D FFT reductions — same
+                # values as the 2-D ACF route without materialising
+                # [B, 2nf, 2nt] (ops.acf.acf_cuts_direct)
+                from ..fit.scint_fit import fit_scint_params_from_dyn
+
+                scint = fit_scint_params_from_dyn(
+                    dyn_acf, dt, df, alpha=config.alpha,
+                    steps=config.lm_steps,
+                    cuts_method=_resolve_cuts(
+                        config.scint_cuts, mesh, dyn_acf.shape,
+                        itemsize=dyn_acf.dtype.itemsize))
+        arc = None
+        sec_b = None
+        if config.fit_arc or config.return_sspec:
+            fft_in = (jnp.einsum("lf,bft->blt", jnp.asarray(W_np),
+                                 dyn_batch)
+                      if config.lamsteps else dyn_batch)
+            sec_b = sspec_op(fft_in, prewhite=config.prewhite,
+                             window=config.window,
+                             window_frac=config.window_frac, db=True,
+                             backend="jax")
+            if config.fit_arc:
+                arc = build_arc_fitter()(sec_b)
+        return PipelineResult(
+            scint=scint, arc=arc, acf=out.get("acf"),
+            sspec=sec_b if config.return_sspec else None,
+            fdop=jnp.asarray(fdop), tdel=jnp.asarray(tdel),
+            beta=None if beta is None else jnp.asarray(beta),
+            scint2d=scint2d, tilt=tilt, tilterr=tilterr)
+
+    if mesh is None:
+        return jax.jit(step)
+
+    in_shard = mesh_mod.data_sharding(mesh, chan_sharded=chan_sharded)
+    return jax.jit(step, in_shardings=in_shard)
+
+
+def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
+                 mesh=None, chunk: int | None = None,
+                 chan_sharded: bool | None = None):
+    """Host-side convenience driver: bucket heterogeneous epochs by shape,
+    pad each bucket to the mesh's data-axis multiple, run the jit'd step
+    per bucket (optionally in memory-bounded chunks), and gather results
+    with invalid lanes dropped.  ``chan_sharded=None`` derives channel
+    sharding from the mesh (any >1 ``chan`` axis shards the big
+    secondary-spectrum FFT; see make_pipeline).
+
+    Returns a list of (indices, PipelineResult) per bucket, where
+    ``indices`` maps result lanes back to the input epoch order: lane k of
+    every [B]-leading result leaf is epoch ``indices[k]`` (divisibility
+    pad-lanes are sliced off before returning).
+    """
+    from collections import defaultdict
+
+    from .batch import pad_batch
+
+    multiple = 1
+    if mesh is not None:
+        multiple = mesh.shape[mesh_mod.DATA_AXIS]
+    # Bucket on shape AND axis identity: two epochs with equal (nf, nt) but
+    # different bands/sampling must not share a pipeline (its df/fc/lambda
+    # grid are baked in host-side from the template axes).
+    buckets: dict[tuple, list[int]] = defaultdict(list)
+    for i, d in enumerate(epochs):
+        f = np.asarray(d.freqs, dtype=np.float64)
+        t = np.asarray(d.times, dtype=np.float64)
+        key = (f.shape, t.shape, f.tobytes(), t.tobytes())
+        buckets[key].append(i)
+    results = []
+    for idx in buckets.values():
+        group = [epochs[i] for i in idx]
+        batch, _mask = pad_batch(group, batch_multiple=multiple)
+        step = make_pipeline(np.asarray(group[0].freqs),
+                             np.asarray(group[0].times), config, mesh=mesh,
+                             chan_sharded=chan_sharded)
+        dyn = np.asarray(batch.dyn)
+        B = dyn.shape[0]
+        if chunk is None or chunk >= B:
+            res = step(dyn)
+        else:
+            # memory-bounded chunking; chunk must respect mesh divisibility
+            c = max(multiple, (chunk // multiple) * multiple)
+            if c != chunk:
+                import warnings
+
+                warnings.warn(
+                    f"run_pipeline: chunk={chunk} adjusted to {c} (the "
+                    f"mesh's data axis needs multiples of {multiple}); "
+                    "size chunk accordingly when bounding device memory",
+                    stacklevel=2)
+            parts = [step(dyn[i:i + c]) for i in range(0, B, c)]
+            res = _concat_results(parts)
+        results.append((np.asarray(idx), _take_lanes(res, len(idx), B)))
+    return results
+
+
+def _take_lanes(res: PipelineResult, n: int, B: int) -> PipelineResult:
+    """Slice divisibility pad-lanes off every [B]-leading result leaf."""
+    if n == B:
+        return res
+    import jax
+
+    def slice_leaf(x):
+        return x[:n] if (hasattr(x, "ndim") and x.ndim >= 1) else x
+
+    def take(val):
+        if val is None:
+            return None
+        return jax.tree_util.tree_map(slice_leaf, val)
+
+    arc = res.arc
+    if arc is not None:
+        # every arc leaf is [B]-leading except the shared profile_eta grid
+        arc = dataclasses.replace(take(dataclasses.replace(
+            arc, profile_eta=None)), profile_eta=arc.profile_eta)
+    return dataclasses.replace(
+        res, scint=take(res.scint), arc=arc, acf=take(res.acf),
+        sspec=take(res.sspec), scint2d=take(res.scint2d),
+        tilt=take(res.tilt), tilterr=take(res.tilterr))
+
+
+def _concat_results(parts):
+    """Concatenate PipelineResult chunks along the epoch axis ([B]-leading
+    leaves of scint/arc/acf/sspec); grid axes are identical across chunks."""
+    import jax
+
+    def _cat_leaf(*xs):
+        a = np.asarray(xs[0])
+        if a.ndim == 0:  # shared scalar (e.g. fixed talpha)
+            return a
+        return np.concatenate([np.asarray(x) for x in xs], axis=0)
+
+    def cat(field):
+        vals = [getattr(p, field) for p in parts]
+        if vals[0] is None:
+            return None
+        return jax.tree_util.tree_map(_cat_leaf, *vals)
+
+    first = parts[0]
+    out = {f: cat(f) for f in ("scint", "acf", "sspec", "scint2d", "tilt",
+                               "tilterr")}
+    arc = None
+    if first.arc is not None:
+        # profile_eta is a shared grid (no batch axis); splice it back
+        cat_arc = jax.tree_util.tree_map(
+            _cat_leaf,
+            *[dataclasses.replace(p.arc, profile_eta=None) for p in parts])
+        arc = dataclasses.replace(cat_arc,
+                                  profile_eta=np.asarray(first.arc.profile_eta))
+    return PipelineResult(scint=out["scint"], arc=arc, acf=out["acf"],
+                          sspec=out["sspec"], fdop=np.asarray(first.fdop),
+                          tdel=np.asarray(first.tdel),
+                          beta=None if first.beta is None
+                          else np.asarray(first.beta),
+                          scint2d=out["scint2d"], tilt=out["tilt"],
+                          tilterr=out["tilterr"])

@@ -1,0 +1,121 @@
+"""Device mesh + sharding policy.
+
+The reference has no multi-device capability at all (SURVEY.md §2.7: no
+MPI/NCCL/multiprocessing anywhere; its only parallelism is OpenMP inside
+the C NUDFT, fit_1d-response.c:28).  This module is the new first-class
+component that replaces it the TPU way: a named ``jax.sharding.Mesh`` over
+ICI plus a small sharding policy, so the batched pipeline scales from one
+chip to a pod slice without touching kernel code.
+
+Axes:
+
+* ``data`` — the epoch/batch axis (DP analogue): 1024 observing epochs
+  split across devices; no cross-device communication inside a step.
+* ``chan`` — the frequency-channel axis (SP/TP analogue): a single
+  dynspec's rows sharded across devices when one spectrum exceeds HBM;
+  XLA inserts ICI all-to-alls for the transposed FFT axis.
+
+Multi-host: ``make_mesh`` uses ``jax.devices()``, which in a multi-host
+runtime already enumerates the global device set, so the same code scales
+to DCN-connected slices — keep ``data`` outermost so DCN only ever carries
+data-parallel traffic (SURVEY.md §5 "distributed communication backend").
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+DATA_AXIS = "data"
+CHAN_AXIS = "chan"
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def make_mesh(shape: Sequence[int] | None = None,
+              axis_names: Sequence[str] = (DATA_AXIS, CHAN_AXIS),
+              devices=None):
+    """Build a Mesh.  Default: all devices on the ``data`` axis, ``chan=1``.
+
+    ``shape=(d, c)`` splits devices into d-way data x c-way channel
+    parallelism; ``shape=None`` -> (ndev, 1).
+    """
+    jax = _jax()
+    from jax.sharding import Mesh
+
+    devices = list(jax.devices()) if devices is None else list(devices)
+    n = len(devices)
+    if shape is None:
+        shape = (n,) + (1,) * (len(axis_names) - 1)
+    if math.prod(shape) != n:
+        raise ValueError(f"mesh shape {tuple(shape)} != {n} devices")
+    import numpy as np
+
+    dev_array = np.asarray(devices).reshape(tuple(shape))
+    return Mesh(dev_array, tuple(axis_names))
+
+
+def data_sharding(mesh, chan_sharded: bool = False):
+    """NamedSharding for a [B, nf, nt] batch: B over ``data``; optionally
+    nf over ``chan``.  Trailing dims replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if chan_sharded and CHAN_AXIS in mesh.axis_names:
+        return NamedSharding(mesh, P(DATA_AXIS, CHAN_AXIS))
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, P())
+
+
+def shard_leading(tree, mesh):
+    """device_put every array leaf with its leading axis on ``data``
+    (scalar leaves replicated).  Input batch B must divide mesh['data']."""
+    jax = _jax()
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    data = NamedSharding(mesh, P(DATA_AXIS))
+    rep = replicated(mesh)
+
+    import numpy as np
+
+    def put(leaf):
+        # read the rank without materialising device arrays on host
+        ndim = getattr(leaf, "ndim", None)
+        if ndim is None:
+            ndim = np.ndim(leaf)
+        return jax.device_put(leaf, data if ndim >= 1 else rep)
+
+    return jax.tree_util.tree_map(put, tree)
+
+
+def sharded_mean(x, mesh, axis: str = DATA_AXIS):
+    """Cross-device survey reduction via an explicit collective: mean of a
+    [B, ...] array over its (data-sharded) leading axis using ``psum``
+    inside ``shard_map`` — the ICI-collective building block for survey
+    statistics (mean curvature per pulsar etc.)."""
+    jax = _jax()
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    n = x.shape[0]
+    spec = P(axis) if x.ndim >= 1 else P()
+
+    def local(block):
+        s = jnp.sum(block, axis=0)
+        return jax.lax.psum(s, axis_name=axis)[None] / n
+
+    out = shard_map(local, mesh=mesh, in_specs=(spec,),
+                    out_specs=P(None))(x)
+    return out[0]

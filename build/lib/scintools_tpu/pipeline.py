@@ -1,0 +1,617 @@
+"""Stateful ``Dynspec`` wrapper: the reference's UX on the functional core.
+
+The reference's ``Dynspec`` class (dynspec.py:29) is a mutable state machine
+— load, then call processing methods that set result attributes (``acf``,
+``sspec``, ``lamsspec``, ``eta``, ``tau`` ...), with lazy recomputation when
+a fit needs a product that does not exist yet (e.g. dynspec.py:426-443,
+942-945).  This module preserves that workflow 1:1 for users migrating from
+the reference, while all computation lives in the pure layers
+(:mod:`scintools_tpu.ops`, :mod:`scintools_tpu.fit`):
+
+    ds = Dynspec(filename="obs.dynspec", lamsteps=True)   # auto-process
+    ds.fit_arc(lamsteps=True)                              # lazy sspec
+    ds.get_scint_params()                                  # lazy acf
+    print(ds.betaeta, ds.tau, ds.dnu)
+
+Every method takes ``backend=`` (defaults to the instance's backend) so the
+same script runs the numpy reference-parity path or the jit'd TPU path.
+
+Also here: ``cut_dyn`` sub-band/sub-time tiling (dynspec.py:1035-1127) and
+``sort_dyn`` batch triage (dynspec.py:1599-1660).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import numpy as np
+
+from .backend import resolve, to_numpy
+from .data import ArcFit, DynspecData, ScintParams, SecSpec
+from .fit.arc_fit import fit_arc as _fit_arc
+from .fit.arc_fit import norm_sspec as _norm_sspec
+from .fit.scint_fit import fit_scint_params as _fit_scint_params
+from .io.adapters import concatenate_time, from_simulation
+from .io.psrflux import read_psrflux, write_psrflux
+from .ops.acf import acf as _acf
+from .ops.clean import correct_band as _correct_band
+from .ops.clean import crop as _crop
+from .ops.clean import refill as _refill
+from .ops.clean import trim_edges as _trim_edges
+from .ops.clean import zap as _zap
+from .ops.scale import scale_lambda, scale_trapezoid
+from .ops.sspec import sspec as _sspec
+from .ops.sspec import sspec_axes
+from .ops.svd import svd_model as _svd_model
+
+
+class Dynspec:
+    """Mutable observation wrapper with the reference's method surface.
+
+    Construct from a psrflux ``filename=``, a :class:`DynspecData`
+    (``data=``), a dyn-like object with the reference's 13 duck-typed
+    attributes (``dyn_obj=``, dynspec.py:158-186), or a
+    :class:`scintools_tpu.sim.Simulation` (``sim=``).
+    """
+
+    def __init__(self, filename: str | None = None, data: DynspecData = None,
+                 dyn_obj=None, sim=None, process: bool = True,
+                 lamsteps: bool = False, backend: str = "numpy",
+                 verbose: bool = False, **sim_kw):
+        if sum(x is not None for x in (filename, data, dyn_obj, sim)) != 1:
+            raise ValueError(
+                "give exactly one of filename=, data=, dyn_obj=, sim=")
+        if filename is not None:
+            data = read_psrflux(filename)
+        elif sim is not None:
+            data = from_simulation(sim, **sim_kw)
+        elif dyn_obj is not None:
+            data = DynspecData(
+                dyn=np.asarray(dyn_obj.dyn), freqs=np.asarray(dyn_obj.freqs),
+                times=np.asarray(dyn_obj.times), mjd=float(dyn_obj.mjd),
+                df=float(dyn_obj.df), dt=float(dyn_obj.dt),
+                bw=float(dyn_obj.bw), freq=float(dyn_obj.freq),
+                tobs=float(dyn_obj.tobs), name=str(dyn_obj.name),
+                header=tuple(getattr(dyn_obj, "header", ())))
+        self._data = data
+        self.backend = resolve(backend)
+        self.verbose = verbose
+        self.lamsteps = lamsteps
+        # result attributes, reference naming (dynspec.py attributes)
+        self.acf = None
+        self.sspec = None
+        self.lamsspec = None
+        self.fdop = self.tdel = self.beta = None
+        self.lamdyn = self.lam = self.dlam = None
+        self.trapdyn = None
+        self.eta = self.etaerr = None
+        self.betaeta = self.betaetaerr = None
+        self.norm_sspec_result = None
+        self.scint_params = None
+        self.arc_fit = None
+        self.wavefield = None
+        if process:
+            self.default_processing(lamsteps=lamsteps)
+
+    # -- data attribute delegation (reference attribute names) -------------
+    @property
+    def data(self) -> DynspecData:
+        return self._data
+
+    def __getattr__(self, name):
+        # delegate dyn/freqs/times/mjd/df/dt/bw/freq/tobs/name/header and
+        # nchan/nsub to the wrapped DynspecData
+        if name.startswith("_"):
+            raise AttributeError(name)
+        d = self.__dict__.get("_data")
+        if d is not None and hasattr(d, name):
+            return getattr(d, name)
+        raise AttributeError(f"{type(self).__name__!s} has no attribute "
+                             f"{name!r}")
+
+    def __add__(self, other: "Dynspec") -> "Dynspec":
+        """Time-concatenate two epochs, zero-filling the MJD gap
+        (dynspec.py:47-97)."""
+        out = concatenate_time(self._data, other._data)
+        return Dynspec(data=out, process=False, lamsteps=self.lamsteps,
+                       backend=self.backend, verbose=self.verbose)
+
+    def info(self) -> None:
+        print(self._data.info_str())
+
+    def write_file(self, filename: str) -> None:
+        """Write the current dynamic spectrum as a psrflux file."""
+        write_psrflux(self._data, filename)
+
+    # -- processing steps (mutate wrapped data, return self for chaining) --
+    def default_processing(self, lamsteps: bool = False) -> "Dynspec":
+        """trim_edges -> refill -> calc_acf -> [scale_dyn] -> calc_sspec
+        (dynspec.py:188-198)."""
+        self.trim_edges().refill(linear=True)
+        self.calc_acf()
+        self.lamsteps = lamsteps
+        if lamsteps:
+            self.scale_dyn()
+        self.calc_sspec(lamsteps=lamsteps)
+        return self
+
+    def trim_edges(self) -> "Dynspec":
+        self._data = _trim_edges(self._data)
+        return self
+
+    def refill(self, linear: bool = True, zeros: bool = True) -> "Dynspec":
+        self._data = _refill(self._data, linear=linear, zeros=zeros)
+        return self
+
+    def correct_band(self, frequency: bool = True, time: bool = False,
+                     nsmooth: int | None = 5,
+                     lamsteps: bool = False) -> "Dynspec":
+        """Bandpass/gain correction (dynspec.py:1189-1226).  With
+        ``lamsteps=True`` corrects the lambda-resampled dynspec instead
+        (resampling it first if needed), as the reference does."""
+        if lamsteps:
+            from .ops.clean import correct_band_array
+
+            if self.lamdyn is None:
+                self.scale_dyn()
+            self.lamdyn = correct_band_array(self.lamdyn,
+                                             frequency=frequency,
+                                             time=time, nsmooth=nsmooth)
+            self.lamsspec = None  # stale: recompute on next use
+        else:
+            self._data = _correct_band(self._data, frequency=frequency,
+                                       time=time, nsmooth=nsmooth)
+        return self
+
+    def zap(self, method: str = "median", sigma: float = 7,
+            m: int = 3) -> "Dynspec":
+        self._data = _zap(self._data, method=method, sigma=sigma, m=m)
+        return self
+
+    def crop_dyn(self, fmin: float = 0, fmax: float = np.inf,
+                 tmin: float = 0, tmax: float = np.inf) -> "Dynspec":
+        self._data = _crop(self._data, fmin=fmin, fmax=fmax, tmin=tmin,
+                           tmax=tmax)
+        return self
+
+    def svd_model(self, nmodes: int = 1) -> "Dynspec":
+        """Flatten the bandpass/gain with a rank-``nmodes`` SVD model
+        (scint_utils.py:401-426)."""
+        flat, _ = _svd_model(to_numpy(self._data.dyn), nmodes=nmodes,
+                             backend=self.backend)
+        self._data = self._data.replace(dyn=to_numpy(flat))
+        return self
+
+    def scale_dyn(self, scale: str = "lambda", window: str = "hanning",
+                  window_frac: float = 0.1) -> "Dynspec":
+        """Resample to uniform wavelength steps (``lambda``) or trapezoid
+        time-rescaling (dynspec.py:1402-1476)."""
+        if scale == "lambda":
+            lamdyn, lam, dlam = scale_lambda(self._data,
+                                             backend=self.backend)
+            self.lamdyn, self.lam, self.dlam = (to_numpy(lamdyn), lam, dlam)
+        elif scale == "trapezoid":
+            self.trapdyn = scale_trapezoid(self._data, window=window,
+                                           window_frac=window_frac)
+        else:
+            raise ValueError(f"unknown scale {scale!r}")
+        return self
+
+    # -- transforms --------------------------------------------------------
+    def calc_acf(self, backend: str | None = None) -> "Dynspec":
+        """2-D autocovariance via Wiener-Khinchin (dynspec.py:1337-1360)."""
+        b = resolve(backend or self.backend)
+        self.acf = to_numpy(_acf(np.asarray(to_numpy(self._data.dyn),
+                                            dtype=np.float64), backend=b))
+        return self
+
+    def calc_sspec(self, prewhite: bool = True, window: str = "blackman",
+                   window_frac: float = 0.1, lamsteps: bool = False,
+                   trap: bool = False, backend: str | None = None
+                   ) -> "Dynspec":
+        """Secondary spectrum (dynspec.py:1228-1335); with
+        ``lamsteps=True`` computes it from the lambda-resampled dynspec and
+        stores it as ``lamsspec`` with the ``beta`` axis."""
+        b = resolve(backend or self.backend)
+        if lamsteps:
+            if self.lamdyn is None:
+                self.scale_dyn()
+            arr = self.lamdyn
+        elif trap:
+            if self.trapdyn is None:
+                self.scale_dyn(scale="trapezoid")
+            arr = self.trapdyn
+        else:
+            arr = to_numpy(self._data.dyn)
+        sec = to_numpy(_sspec(np.asarray(arr, dtype=np.float64),
+                              prewhite=prewhite, window=window,
+                              window_frac=window_frac, db=True, backend=b))
+        nf, nt = arr.shape
+        fdop, tdel, beta = sspec_axes(
+            nf, nt, self._data.dt, self._data.df,
+            dlam=self.dlam if lamsteps else None)
+        self.fdop, self.tdel = fdop, tdel
+        if lamsteps:
+            self.lamsspec, self.beta = sec, beta
+        else:
+            self.sspec = sec
+        return self
+
+    def calc_sspec_slowft(self, backend: str | None = None) -> SecSpec:
+        """Arc-sharpened secondary spectrum via the slow-FT NUDFT
+        (scint_utils.py:317-398) as a ready-to-fit :class:`SecSpec`.
+
+        The reference exposes ``slow_FT`` as a free function returning a
+        raw complex field, leaving axes and integration to user scripts;
+        here the scaled-time transform (which removes the arcs' chromatic
+        smearing) is wired straight into the measurement chain: the
+        result has true-delay ``tdel`` (us) / ``fdop`` (mHz) axes and
+        positive delays only, so ``fit_arc``/``norm_sspec`` accept it
+        unchanged.  Stored as ``self.slowft_sspec``.
+        """
+        from .ops.nudft import slow_ft
+
+        b = resolve(backend or self.backend)
+        dyn_tf = to_numpy(self._data.dyn).T  # [ntime, nfreq]
+        ntime, nfreq = dyn_tf.shape
+        field = slow_ft(dyn_tf, to_numpy(self._data.freqs), backend=b,
+                        as_numpy=(b == "jax"))
+        field = to_numpy(field)
+        with np.errstate(divide="ignore"):
+            power_db = 10 * np.log10(np.abs(field) ** 2)
+        # axes: rows of `field` are Doppler, DESCENDING (slow_ft flips the
+        # ascending NUDFT grid); cols are delay, fftshifted ascending
+        fdop = np.sort(np.fft.fftfreq(ntime, d=self._data.dt)) * 1e3  # mHz
+        delay = np.fft.fftshift(np.fft.fftfreq(nfreq, d=abs(self._data.df)))
+        # orient [tdel, fdop]: transpose -> [delay asc, doppler desc];
+        # keep positive delays, flip cols to ascending Doppler
+        sspec = power_db.T[delay >= 0][:, ::-1]
+        tdel = delay[delay >= 0]                        # us (1/MHz)
+        sec = SecSpec(sspec=sspec, fdop=fdop, tdel=tdel, beta=None,
+                      lamsteps=False)
+        self.slowft_sspec = sec
+        return sec
+
+    def _secspec(self, lamsteps: bool) -> SecSpec:
+        """Assemble a SecSpec, lazily computing what is missing
+        (the reference's recompute-on-missing, dynspec.py:426-443)."""
+        if lamsteps and self.lamsspec is None:
+            self.calc_sspec(lamsteps=True)
+        if not lamsteps and self.sspec is None:
+            self.calc_sspec()
+        return SecSpec(sspec=self.lamsspec if lamsteps else self.sspec,
+                       fdop=self.fdop, tdel=self.tdel,
+                       beta=self.beta if lamsteps else None,
+                       lamsteps=lamsteps)
+
+    def secspec(self, lamsteps: bool | None = None) -> SecSpec:
+        """The secondary spectrum with its axes as one SecSpec record,
+        computing it first if needed — the public accessor for code that
+        consumes spectra directly (fit.fit_arc_thetatheta,
+        plotting.plot_sspec, ...).  ``lamsteps`` defaults to this
+        object's processing mode."""
+        return self._secspec(self.lamsteps if lamsteps is None
+                             else lamsteps)
+
+    # -- measurements ------------------------------------------------------
+    def fit_arc(self, method: str = "norm_sspec", lamsteps: bool | None
+                = None, delmax=None, numsteps: int = 10000,
+                startbin: int = 3, cutmid: int = 3, etamax=None, etamin=None,
+                low_power_diff: float = -3.0, high_power_diff: float = -1.5,
+                ref_freq: float = 1400.0, constraint=(0, np.inf),
+                nsmooth: int = 5, noise_error: bool = True,
+                asymm: bool = False,
+                backend: str | None = None) -> ArcFit:
+        """Arc-curvature measurement (dynspec.py:414-785).  Sets
+        ``betaeta/betaetaerr`` (lamsteps) or ``eta/etaerr``; with
+        ``asymm=True`` also fits each fdop arm (``eta_left/eta_right``)."""
+        lamsteps = self.lamsteps if lamsteps is None else lamsteps
+        sec = self._secspec(lamsteps)
+        if np.ndim(etamin) == 1 or np.ndim(etamax) == 1:
+            # multi-arc mode (reference: etamin/etamax arrays segment the
+            # eta grid, dynspec.py:470-491): one fit per curvature window.
+            # Scalars/None broadcast against the other bound; mismatched
+            # array lengths are an error (zip would truncate silently).
+            from .fit.arc_fit import fit_arcs_multi
+
+            if asymm:
+                raise ValueError(
+                    "asymm=True is not supported in multi-arc mode "
+                    "(secondary arcs are re-measured on the shared "
+                    "profile); fit each arc individually with a "
+                    "constraint window instead")
+            n_arcs = max(np.size(etamin) if etamin is not None else 1,
+                         np.size(etamax) if etamax is not None else 1)
+
+            def as_bounds(x, default):
+                if x is None:
+                    return [default] * n_arcs
+                arr = list(np.atleast_1d(x))
+                if len(arr) == 1:
+                    arr = arr * n_arcs
+                if len(arr) != n_arcs:
+                    raise ValueError(
+                        f"etamin/etamax lengths differ: {np.size(etamin)} "
+                        f"vs {np.size(etamax)}")
+                return arr
+
+            # honour an explicit constraint by intersecting it with every
+            # window (it would otherwise be silently ignored in multi-arc
+            # mode)
+            c0, c1 = float(constraint[0]), float(constraint[1])
+            brackets = [(max(lo, c0), min(hi, c1))
+                        for lo, hi in zip(as_bounds(etamin, 0.0),
+                                          as_bounds(etamax, np.inf))]
+            fits = fit_arcs_multi(
+                sec, freq=float(self._data.freq), brackets=brackets,
+                method=method, delmax=delmax, numsteps=numsteps,
+                startbin=startbin, cutmid=cutmid,
+                low_power_diff=low_power_diff,
+                high_power_diff=high_power_diff, ref_freq=ref_freq,
+                nsmooth=nsmooth, noise_error=noise_error,
+                backend=resolve(backend or self.backend))
+            self.arc_fit = fits
+            etas = np.array([float(to_numpy(f.eta)) for f in fits])
+            errs = np.array([float(to_numpy(f.etaerr)) for f in fits])
+            if lamsteps:
+                self.betaeta, self.betaetaerr = etas, errs
+            else:
+                self.eta, self.etaerr = etas, errs
+            return fits
+        fit = _fit_arc(sec, freq=float(self._data.freq), method=method,
+                       delmax=delmax, numsteps=numsteps, startbin=startbin,
+                       cutmid=cutmid, etamax=etamax, etamin=etamin,
+                       low_power_diff=low_power_diff,
+                       high_power_diff=high_power_diff, ref_freq=ref_freq,
+                       constraint=constraint, nsmooth=nsmooth,
+                       noise_error=noise_error, asymm=asymm,
+                       backend=resolve(backend or self.backend))
+        self.arc_fit = fit
+        if lamsteps:
+            self.betaeta = float(to_numpy(fit.eta))
+            self.betaetaerr = float(to_numpy(fit.etaerr))
+        else:
+            self.eta = float(to_numpy(fit.eta))
+            self.etaerr = float(to_numpy(fit.etaerr))
+        return fit
+
+    def norm_sspec(self, eta: float | None = None, delmax=None,
+                   startbin: int = 1, maxnormfac: float = 2,
+                   cutmid: int = 3, lamsteps: bool | None = None,
+                   numsteps: int | None = None, ref_freq: float = 1400.0):
+        """Curvature-normalised secondary spectrum (dynspec.py:787-926)."""
+        lamsteps = self.lamsteps if lamsteps is None else lamsteps
+        if eta is None:
+            eta = self.betaeta if lamsteps else self.eta
+            if eta is None:
+                self.fit_arc(lamsteps=lamsteps)
+                eta = self.betaeta if lamsteps else self.eta
+            # after a multi-arc fit the attribute is an array: normalise
+            # by the primary (first-bracket) arc
+            if np.ndim(eta) == 1:
+                eta = float(eta[0])
+        sec = self._secspec(lamsteps)
+        ns = _norm_sspec(sec, freq=float(self._data.freq), eta=eta,
+                         delmax=delmax, startbin=startbin,
+                         maxnormfac=maxnormfac, cutmid=cutmid,
+                         numsteps=numsteps, ref_freq=ref_freq)
+        self.norm_sspec_result = ns
+        return ns
+
+    def get_scint_params(self, method: str = "acf1d", *,
+                         alpha: float | None = 5 / 3, mcmc: bool = False,
+                         backend: str | None = None) -> ScintParams:
+        """tau_d / dnu_d from the ACF (dynspec.py:928-1033).  Sets
+        ``tau/tauerr/dnu/dnuerr/talpha`` (and ``scint_params``).
+
+        ``method='acf2d'`` fits the full 2-D ACF model incl. phase-gradient
+        tilt (sets ``tilt/tilterr``); ``mcmc=True`` refines the acf1d fit
+        with posterior sampling (the reference's lmfit-emcee option,
+        dynspec.py:989-992, rebuilt as a jax ensemble sampler)."""
+        if self.acf is None:
+            self.calc_acf()
+        b = resolve(backend or self.backend)
+        kw = dict(dt=self._data.dt, df=abs(self._data.df),
+                  nchan=self._data.nchan, nsub=self._data.nsub)
+        if mcmc and method != "acf1d":
+            raise NotImplementedError(
+                "mcmc=True is only implemented for method='acf1d' "
+                "(posterior sampling of the 1-D ACF-cuts model)")
+
+        if method == "acf1d":
+            if mcmc:
+                from .fit.mcmc import fit_scint_params_mcmc
+
+                sp = fit_scint_params_mcmc(self.acf, alpha=alpha, **kw)
+            else:
+                sp = _fit_scint_params(self.acf, alpha=alpha, backend=b,
+                                       **kw)
+        elif method == "acf2d":
+            from .fit.scint_fit import fit_scint_params_2d
+
+            sp, tilt, tilterr = fit_scint_params_2d(self.acf, alpha=alpha,
+                                                    backend=b, **kw)
+            self.tilt, self.tilterr = tilt, tilterr
+        elif method == "sspec":
+            from .fit.scint_fit import fit_scint_params_sspec
+
+            sp = fit_scint_params_sspec(self.acf, alpha=alpha, backend=b,
+                                        **kw)
+        else:
+            raise ValueError(f"unknown method {method!r}; use 'acf1d', "
+                             "'acf2d' or 'sspec'")
+        self.scint_params = sp
+        for k in ("tau", "tauerr", "dnu", "dnuerr", "talpha"):
+            setattr(self, k, float(to_numpy(getattr(sp, k))))
+        return sp
+
+    # -- sub-band / sub-time analysis -------------------------------------
+    def cut_dyn(self, fcuts: int = 0, tcuts: int = 0,
+                backend: str | None = None):
+        """Slice the dynspec into (fcuts+1) x (tcuts+1) tiles and compute
+        each tile's ACF and secondary spectrum (dynspec.py:1035-1127).
+
+        Sets ``cutdyn``, ``cutacf``, ``cutsspec`` (object arrays indexed
+        [ifreq][itime]; tiles may differ in shape by one row/col) plus the
+        per-tile centre ``cutmjd``/``cutfreq``.  Returns (cutdyn, cutsspec).
+        """
+        b = resolve(backend or self.backend)
+        dyn = to_numpy(self._data.dyn)
+        freqs = to_numpy(self._data.freqs)
+        times = to_numpy(self._data.times)
+        frows = np.array_split(np.arange(dyn.shape[0]), fcuts + 1)
+        tcols = np.array_split(np.arange(dyn.shape[1]), tcuts + 1)
+        nfr, ntc = len(frows), len(tcols)
+        self.cutdyn = [[None] * ntc for _ in range(nfr)]
+        self.cutacf = [[None] * ntc for _ in range(nfr)]
+        self.cutsspec = [[None] * ntc for _ in range(nfr)]
+        self.cutfreq = np.zeros(nfr)
+        self.cutmjd = np.zeros(ntc)
+        for i, fr in enumerate(frows):
+            self.cutfreq[i] = float(np.mean(freqs[fr]))
+            for j, tc in enumerate(tcols):
+                tile = dyn[np.ix_(fr, tc)]
+                self.cutdyn[i][j] = tile
+                self.cutacf[i][j] = to_numpy(
+                    _acf(np.asarray(tile, dtype=np.float64), backend=b))
+                self.cutsspec[i][j] = to_numpy(
+                    _sspec(np.asarray(tile, dtype=np.float64), backend=b))
+        self.cutmjd[:] = [float(self._data.mjd
+                                + np.mean(times[tc]) / 86400.0)
+                          for tc in tcols]
+        return self.cutdyn, self.cutsspec
+
+    # -- results I/O -------------------------------------------------------
+    def write_results(self, filename: str) -> None:
+        """Append this observation's metadata and whichever measurements
+        have been made (tau/dnu, eta, betaeta, each with errors) to the
+        reference-schema CSV (scint_utils.py:75-108, which takes the
+        Dynspec object the same way)."""
+        from .io.results import results_row, write_results as _write
+
+        meta = results_row(self._data)
+        for a in ("tau", "dnu", "eta", "betaeta"):
+            v = getattr(self, a, None)
+            err = getattr(self, a + "err", None)
+            # only write complete (value, error) pairs: a bare value with
+            # no error would put a non-numeric token in the CSV and break
+            # float_array_from_dict on read-back
+            if v is not None and err is not None and np.ndim(v) == 0:
+                meta[a] = float(v)
+                meta[a + "err"] = float(err)
+        _write(filename, meta)
+
+    # -- plotting (delegates to the plotting module) -----------------------
+    def plot_dyn(self, lamsteps: bool = False, trap: bool = False, **kw):
+        """Dynamic spectrum view; ``lamsteps``/``trap`` plot the rescaled
+        arrays (dynspec.py:206-229), resampling first if needed."""
+        from . import plotting
+
+        if lamsteps:
+            if self.lamdyn is None:
+                self.scale_dyn()
+            return plotting.plot_dyn(self._data, dyn=self.lamdyn,
+                                     y=self.lam,
+                                     ylabel="Wavelength (m)", **kw)
+        if trap:
+            if self.trapdyn is None:
+                self.scale_dyn(scale="trapezoid")
+            return plotting.plot_dyn(self._data, dyn=self.trapdyn, **kw)
+        return plotting.plot_dyn(self._data, **kw)
+
+    def retrieve_wavefield(self, eta: float | None = None, **kw):
+        """Chunked theta-theta wavefield retrieval (fit.wavefield).
+
+        ``eta`` defaults to this object's fitted non-lamsteps curvature
+        (us/mHz^2; the primary arc after a multi-arc fit).  Beyond-
+        reference capability — the reference has no phase-retrieval
+        path.
+        """
+        from .fit.wavefield import retrieve_wavefield as _retrieve
+
+        if eta is None:
+            eta = self.eta
+            if eta is not None and np.ndim(eta) == 1:
+                eta = float(eta[0])
+        if eta is None:
+            raise ValueError(
+                "no curvature available: run fit_arc(lamsteps=False) or "
+                "pass eta= (us/mHz^2 at the band centre frequency)")
+        kw.setdefault("backend", resolve(self.backend))
+        self.wavefield = _retrieve(self._data, float(eta), **kw)
+        return self.wavefield
+
+    def plot_acf(self, **kw):
+        from . import plotting
+
+        if self.acf is None:
+            self.calc_acf()
+        return plotting.plot_acf(self.acf, self._data,
+                                 scint_params=self.scint_params, **kw)
+
+    def plot_sspec(self, lamsteps: bool | None = None, **kw):
+        from . import plotting
+
+        lamsteps = self.lamsteps if lamsteps is None else lamsteps
+        sec = self._secspec(lamsteps)
+        eta = (self.betaeta if lamsteps else self.eta) \
+            if kw.pop("plotarc", False) else None
+        if eta is not None and np.ndim(eta) == 1:
+            eta = float(eta[0])  # multi-arc: overlay the primary arc
+        return plotting.plot_sspec(sec, eta=eta, **kw)
+
+    def plot_all(self, **kw):
+        from . import plotting
+
+        sec = self._secspec(self.lamsteps)
+        if self.acf is None:
+            self.calc_acf()
+        return plotting.plot_all(self._data, self.acf, sec, **kw)
+
+
+def sort_dyn(dynfiles: Sequence[str], outdir: str | None = None,
+             min_nsub: int = 10, min_nchan: int = 50,
+             min_tsub: float = 10, min_freq: float = 0,
+             max_freq: float = 5000, max_frac_bw: float = 2,
+             remove_fracbw: float = 0.6, verbose: bool = False,
+             backend: str = "numpy") -> tuple[list[str], list[str]]:
+    """Batch triage of psrflux files into good/bad lists
+    (dynspec.py:1599-1660): metadata filters (frequency range, fractional
+    bandwidth, minimum channels/subints/duration), then a processing smoke
+    test (trim -> refill -> time gain correction -> sspec) with an all-NaN
+    quarantine.  Writes ``good_files.txt`` / ``bad_files.txt`` to
+    ``outdir`` when given; returns (good, bad).
+    """
+    good, bad = [], []
+    for fn in dynfiles:
+        try:
+            ds = Dynspec(filename=fn, process=False, backend=backend,
+                         verbose=verbose)
+            if not (min_freq < ds.freq < max_freq):
+                raise ValueError(f"freq {ds.freq} outside range")
+            if ds.bw / ds.freq > max_frac_bw:
+                raise ValueError("fractional bandwidth too large")
+            bw0 = ds.bw
+            ds.trim_edges()
+            if ds.nchan < min_nchan or ds.nsub < min_nsub:
+                raise ValueError("too few channels/subints after trim")
+            if ds.tobs < 60 * min_tsub:
+                raise ValueError("observation too short")
+            if ds.bw < remove_fracbw * bw0:
+                raise ValueError("too much band trimmed away")
+            ds.refill().correct_band(time=True)
+            ds.calc_sspec()
+            if np.all(np.isnan(ds.sspec)):
+                raise ValueError("all-NaN secondary spectrum")
+            good.append(fn)
+        except Exception as e:  # quarantine, never crash the batch
+            if verbose:
+                print(f"sort_dyn: {fn}: {e}")
+            bad.append(fn)
+    if outdir is not None:
+        os.makedirs(outdir, exist_ok=True)
+        for name, lst in (("good_files.txt", good), ("bad_files.txt", bad)):
+            with open(os.path.join(outdir, name), "w") as f:
+                f.writelines(x + "\n" for x in lst)
+    return good, bad

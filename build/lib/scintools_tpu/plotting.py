@@ -1,0 +1,371 @@
+"""Matplotlib views of dynamic spectra and their products.
+
+The reference interleaves plotting into compute methods on ``Dynspec``
+(``plot_dyn``/``plot_acf``/``plot_sspec``/``plot_all``,
+dynspec.py:200-412, and ``Simulation.plot_*``, scint_sim.py:266-335).
+Here plotting is a separate presentation layer that only *consumes*
+results (SURVEY.md §7 architecture), so the compute path stays pure and
+jit-friendly.  Every function returns the matplotlib Figure; pass
+``filename=`` to save and ``display=False`` for headless use.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .backend import to_numpy
+from .data import DynspecData, SecSpec
+
+
+def _finish(fig, filename: str | None, display: bool):
+    if filename is not None:
+        fig.savefig(filename, dpi=150, bbox_inches="tight",
+                    pad_inches=0.1)
+    if display:  # pragma: no cover - interactive only
+        import matplotlib.pyplot as plt
+
+        plt.show()
+    return fig
+
+
+def _pclim(arr):
+    """Robust dB colour limits: 5th-99.9th percentile of finite values
+    (None, None when nothing is finite — matplotlib autoscales)."""
+    finite = arr[np.isfinite(arr)]
+    if finite.size == 0:
+        return None, None
+    return tuple(np.percentile(finite, [5, 99.9]))
+
+
+def _clim(arr, nsig_lo: float = 3, nsig_hi: float = 5):
+    """Median +- sigma colour limits, the reference's robust scaling
+    (dynspec.py:234-238: median +- 2/5 x MAD-derived std)."""
+    a = arr[np.isfinite(arr)]
+    med, std = np.median(a), np.std(a)
+    return med - nsig_lo * std, med + nsig_hi * std
+
+
+def plot_dyn(d: DynspecData, ax=None, filename: str | None = None,
+             display: bool = False, cmap: str = "viridis",
+             dyn=None, y=None, ylabel: str | None = None):
+    """Dynamic spectrum pcolormesh, time in minutes vs frequency in MHz
+    (dynspec.py:200-247).  ``dyn``/``y``/``ylabel`` override the plotted
+    array and vertical axis — used for the reference's lamsteps/trap
+    views (dynspec.py:206-229) where the rows are wavelength or rescaled
+    time rather than frequency."""
+    import matplotlib.pyplot as plt
+
+    dyn = to_numpy(d.dyn if dyn is None else dyn)
+    y = to_numpy(d.freqs if y is None else y)
+    if ax is None:
+        fig, ax = plt.subplots(figsize=(9, 6))
+    else:
+        fig = ax.figure
+    vmin, vmax = _clim(dyn, 2, 5)
+    mesh = ax.pcolormesh(to_numpy(d.times) / 60.0, y, dyn,
+                         vmin=vmin, vmax=vmax, cmap=cmap, shading="auto")
+    ax.set_xlabel("Time (mins)")
+    ax.set_ylabel(ylabel or "Frequency (MHz)")
+    ax.set_title(d.name)
+    fig.colorbar(mesh, ax=ax, label="Flux (arb.)")
+    return _finish(fig, filename, display)
+
+
+def plot_acf(acf2d, d: DynspecData | None = None, scint_params=None,
+             ax=None, filename: str | None = None, display: bool = False,
+             crop_frac: float = 1.0, cmap: str = "viridis"):
+    """2-D ACF with the zero-lag white-noise spike suppressed
+    (dynspec.py:249-306: the centre pixel is replaced by its neighbours'
+    mean so it doesn't swamp the colour scale).  Optionally annotates the
+    fitted tau/dnu from ``scint_params``."""
+    import matplotlib.pyplot as plt
+
+    a = np.array(to_numpy(acf2d), dtype=np.float64)
+    nf, nt = a.shape
+    cf, ct = nf // 2, nt // 2
+    a[cf, ct] = (a[cf, ct - 1] + a[cf, ct + 1]
+                 + a[cf - 1, ct] + a[cf + 1, ct]) / 4
+    if ax is None:
+        fig, ax = plt.subplots(figsize=(7, 6))
+    else:
+        fig = ax.figure
+    if d is not None:
+        tlag = (np.arange(nt) - ct) * d.dt / 60.0
+        flag = (np.arange(nf) - cf) * d.df
+    else:
+        tlag = np.arange(nt) - ct
+        flag = np.arange(nf) - cf
+    if crop_frac < 1.0:
+        it = int(ct * crop_frac)
+        if_ = int(cf * crop_frac)
+        a = a[cf - if_:cf + if_, ct - it:ct + it]
+        tlag = tlag[ct - it:ct + it]
+        flag = flag[cf - if_:cf + if_]
+    mesh = ax.pcolormesh(tlag, flag, a, cmap=cmap, shading="auto")
+    ax.set_xlabel("Time lag (mins)" if d is not None else "Time lag")
+    ax.set_ylabel("Frequency lag (MHz)" if d is not None
+                  else "Frequency lag")
+    if scint_params is not None:
+        tau = float(to_numpy(scint_params.tau)) / 60.0
+        dnu = float(to_numpy(scint_params.dnu))
+        ax.axvline(tau, color="w", ls=":", lw=1, alpha=0.7)
+        ax.axhline(dnu, color="w", ls=":", lw=1, alpha=0.7)
+        ax.set_title(f"tau_d={tau:.2f} min, dnu_d={dnu:.4f} MHz")
+    fig.colorbar(mesh, ax=ax, label="ACF")
+    return _finish(fig, filename, display)
+
+
+def plot_sspec(sec: SecSpec, eta: float | None = None, ax=None,
+               filename: str | None = None, display: bool = False,
+               maxfdop=np.inf, cmap: str = "viridis"):
+    """Secondary spectrum in dB with percentile colour limits and an
+    optional fitted-arc overlay ``tdel = eta fdop^2`` (dynspec.py:308-379).
+    """
+    import matplotlib.pyplot as plt
+
+    s = to_numpy(sec.sspec)
+    fdop = to_numpy(sec.fdop)
+    yaxis = to_numpy(sec.beta if sec.lamsteps else sec.tdel)
+    if ax is None:
+        fig, ax = plt.subplots(figsize=(8, 6))
+    else:
+        fig = ax.figure
+    vmin, vmax = _pclim(s)
+    keep = np.abs(fdop) <= maxfdop
+    mesh = ax.pcolormesh(fdop[keep], yaxis, s[:, keep], vmin=vmin,
+                         vmax=vmax, cmap=cmap, shading="auto")
+    if eta is not None:
+        xf = np.linspace(fdop[keep].min(), fdop[keep].max(), 256)
+        ax.plot(xf, eta * xf ** 2, "r--", lw=1, alpha=0.8)
+        ax.set_ylim(yaxis.min(), yaxis.max())
+    ax.set_xlabel("f_t (mHz)")
+    ax.set_ylabel(r"$\beta$ (m$^{-1}$)" if sec.lamsteps
+                  else r"$\tau$ ($\mu$s)")
+    fig.colorbar(mesh, ax=ax, label="Power (dB)")
+    return _finish(fig, filename, display)
+
+
+def plot_norm_sspec(ns, ax=None, filename: str | None = None,
+                    display: bool = False, unscrunched: bool = False,
+                    powerspec: bool = False, lamsteps: bool = True):
+    """Curvature-normalised secondary-spectrum views (dynspec.py:869-925):
+    the delay-scrunched profile, plus (``unscrunched``) the 2-D normalised
+    spectrum and (``powerspec``) the delay power spectrum vs sqrt(tdel) —
+    the reference's three panels."""
+    import matplotlib.pyplot as plt
+
+    npanels = 1 + int(unscrunched) + int(powerspec)
+    if ax is None:
+        fig, axes = plt.subplots(1, npanels,
+                                 figsize=(6 * npanels, 4), squeeze=False)
+        axes = list(axes[0])
+    else:
+        fig, axes = ax.figure, [ax]
+    a = axes.pop(0)
+    a.plot(to_numpy(ns.fdopnew), to_numpy(ns.normsspecavg), "k-", lw=1)
+    for x in (-1, 1):
+        a.axvline(x, color="r", ls=":", lw=1)
+    a.set_xlabel("Normalised f_t")
+    a.set_ylabel("Mean power (dB)")
+    ylab = (r"$f_\lambda$ (m$^{-1}$)" if lamsteps
+            else r"$f_\nu$ ($\mu$s)")
+    if unscrunched and axes:
+        a = axes.pop(0)
+        arr = to_numpy(ns.normsspec)
+        vmin, vmax = _pclim(arr)
+        mesh = a.pcolormesh(to_numpy(ns.fdopnew), to_numpy(ns.tdel), arr,
+                            vmin=vmin, vmax=vmax, shading="auto")
+        for x in (-1, 1):
+            a.axvline(x, color="r", ls=":", lw=1)
+        a.set_xlabel("Normalised f_t")
+        a.set_ylabel(ylab)
+        fig.colorbar(mesh, ax=a, label="Power (dB)")
+    if powerspec and axes:
+        a = axes.pop(0)
+        a.loglog(np.sqrt(to_numpy(ns.tdel)), to_numpy(ns.powerspec))
+        a.set_xlabel(ylab.replace("(", "$^{1/2}$ ("))
+        a.set_ylabel("Mean power (dB)")
+    fig.tight_layout()
+    return _finish(fig, filename, display)
+
+
+def plot_arc_profile(fit, ax=None, filename: str | None = None,
+                     display: bool = False):
+    """Power vs curvature profile with the fitted eta (fit_arc products)."""
+    import matplotlib.pyplot as plt
+
+    if ax is None:
+        fig, ax = plt.subplots(figsize=(8, 4))
+    else:
+        fig = ax.figure
+    x = to_numpy(fit.profile_eta)
+    ax.plot(x, to_numpy(fit.profile_power), color="0.6", lw=0.8,
+            label="profile")
+    ax.plot(x, to_numpy(fit.profile_power_filt), "k-", lw=1.2,
+            label="smoothed")
+    eta = float(to_numpy(fit.eta))
+    ax.axvline(eta, color="r", ls="--",
+               label=f"eta={eta:.3g}")
+    ax.set_xscale("log")
+    ax.set_xlabel(r"Curvature $\eta$")
+    ax.set_ylabel("Mean power (dB)")
+    ax.legend(loc="best", fontsize=8)
+    return _finish(fig, filename, display)
+
+
+def plot_all(d: DynspecData, acf2d, sec: SecSpec, fit=None,
+             filename: str | None = None, display: bool = False):
+    """2x2 summary: dynspec, ACF, secondary spectrum, arc profile
+    (dynspec.py:381-412; the reference's fourth panel is the norm-sspec
+    profile — here the arc profile when a fit is given, else blank)."""
+    import matplotlib.pyplot as plt
+
+    fig, axes = plt.subplots(2, 2, figsize=(14, 10))
+    plot_dyn(d, ax=axes[0, 0])
+    plot_acf(acf2d, d, ax=axes[0, 1])
+    plot_sspec(sec, eta=None if fit is None else float(to_numpy(fit.eta)),
+               ax=axes[1, 0])
+    if fit is not None:
+        plot_arc_profile(fit, ax=axes[1, 1])
+    else:
+        axes[1, 1].axis("off")
+    fig.tight_layout()
+    return _finish(fig, filename, display)
+
+
+def plot_thetatheta(sec: SecSpec, eta: float, ntheta: int = 129,
+                    theta_max: float | None = None, startbin: int = 3,
+                    cutmid: int = 3, conc_curve=None, ax=None,
+                    filename: str | None = None, display: bool = False):
+    """Theta-theta map at curvature ``eta`` (fit.thetatheta), optionally
+    with the eta concentration curve as an inset panel.  Pass the same
+    theta_max/startbin/cutmid used for the fit so the rendered map is the
+    one the measurement actually saw."""
+    import matplotlib.pyplot as plt
+
+    from .fit.thetatheta import theta_theta_map
+
+    M = theta_theta_map(sec, eta, ntheta=ntheta, theta_max=theta_max,
+                        startbin=startbin, cutmid=cutmid)
+    if ax is None:
+        fig, ax = plt.subplots(figsize=(7, 6))
+    else:
+        fig = ax.figure
+    with np.errstate(divide="ignore"):
+        img = 10 * np.log10(M ** 2)  # back to power dB for display
+    vmin, vmax = _pclim(img)
+    mesh = ax.imshow(img, origin="lower", cmap="viridis", vmin=vmin,
+                     vmax=vmax, extent=(-1, 1, -1, 1))
+    ax.set_xlabel(r"$\theta_2$ / $\theta_{max}$")
+    ax.set_ylabel(r"$\theta_1$ / $\theta_{max}$")
+    ax.set_title(rf"$\theta$-$\theta$ @ $\eta$={eta:.3g}")
+    fig.colorbar(mesh, ax=ax, label="Power (dB)")
+    if conc_curve is not None:
+        etas, conc = conc_curve
+        ins = ax.inset_axes([0.62, 0.72, 0.35, 0.25])
+        ins.semilogx(etas, conc, "w-", lw=1)
+        ins.axvline(eta, color="r", lw=0.8)
+        ins.set_xticks([])
+        ins.set_yticks([])
+        ins.patch.set_alpha(0.25)
+    return _finish(fig, filename, display)
+
+
+def plot_wavefield(wf, ax=None, filename: str | None = None,
+                   display: bool = False):
+    """Retrieved wavefield (fit.wavefield): amplitude, phase, and the
+    |E|^2 reconstruction — compare the latter against ``plot_dyn`` of
+    the input spectrum.  ``ax`` may be a single Axes (amplitude panel
+    only, matching the module convention) or a length-3 sequence."""
+    import matplotlib.pyplot as plt
+
+    f = wf.freqs
+    t = wf.times / 60.0
+    ext = (t[0], t[-1], f[0], f[-1])
+    field = to_numpy(wf.field)
+    title = (rf"wavefield @ $\eta$={wf.eta:.3g}; "
+             rf"conc={np.mean(wf.conc):.2f}")
+    if ax is not None and not np.iterable(ax):
+        fig = ax.figure
+        mesh = ax.imshow(np.abs(field), origin="lower", aspect="auto",
+                         cmap="magma", extent=ext)
+        ax.set_xlabel("Time (mins)")
+        ax.set_ylabel("Frequency (MHz)")
+        ax.set_title(title)
+        fig.colorbar(mesh, ax=ax, label="|E|")
+        return _finish(fig, filename, display)
+    if ax is None:
+        fig, axs = plt.subplots(1, 3, figsize=(15, 4.2), sharey=True)
+    else:
+        axs = list(ax)
+        fig = axs[0].figure
+    panels = (
+        (np.abs(field), "magma", "|E|", axs[0]),
+        (np.angle(field), "twilight", "arg E (rad)", axs[1]),
+        (np.abs(field) ** 2, "magma", r"$|E|^2$", axs[2]),
+    )
+    for img, cmap, label, a in panels:
+        mesh = a.imshow(img, origin="lower", aspect="auto", cmap=cmap,
+                        extent=ext)
+        a.set_xlabel("Time (mins)")
+        fig.colorbar(mesh, ax=a, label=label)
+    axs[0].set_ylabel("Frequency (MHz)")
+    axs[1].set_title(title)
+    return _finish(fig, filename, display)
+
+
+# -- simulation views (scint_sim.py:266-335) --------------------------------
+
+def plot_screen(sim, ax=None, filename: str | None = None,
+                display: bool = False):
+    """Phase screen (scint_sim.py:266-280)."""
+    import matplotlib.pyplot as plt
+
+    if ax is None:
+        fig, ax = plt.subplots(figsize=(7, 6))
+    else:
+        fig = ax.figure
+    x = np.arange(sim.nx) * sim.dx
+    y = np.arange(sim.ny) * sim.dy
+    mesh = ax.pcolormesh(x, y, to_numpy(sim.xyp).T, cmap="RdBu_r",
+                         shading="auto")
+    ax.set_xlabel("x (Fresnel scales)")
+    ax.set_ylabel("y (Fresnel scales)")
+    fig.colorbar(mesh, ax=ax, label="Phase (rad)")
+    return _finish(fig, filename, display)
+
+
+def plot_intensity(sim, ax=None, filename: str | None = None,
+                   display: bool = False):
+    """Simulated intensity vs position and frequency channel
+    (scint_sim.py:282-298)."""
+    import matplotlib.pyplot as plt
+
+    if ax is None:
+        fig, ax = plt.subplots(figsize=(8, 6))
+    else:
+        fig = ax.figure
+    spi = to_numpy(sim.spi)
+    mesh = ax.pcolormesh(np.arange(spi.shape[1]), np.arange(spi.shape[0]),
+                         spi, cmap="magma", shading="auto")
+    ax.set_xlabel("Frequency channel")
+    ax.set_ylabel("Position")
+    fig.colorbar(mesh, ax=ax, label="Intensity")
+    return _finish(fig, filename, display)
+
+
+def plot_efield(sim, ax=None, filename: str | None = None,
+                display: bool = False):
+    """Real part of the propagated E-field (scint_sim.py:317-331)."""
+    import matplotlib.pyplot as plt
+
+    if ax is None:
+        fig, ax = plt.subplots(figsize=(8, 6))
+    else:
+        fig = ax.figure
+    mesh = ax.pcolormesh(np.real(to_numpy(sim.spe)), cmap="RdBu_r",
+                         shading="auto")
+    ax.set_xlabel("Frequency channel")
+    ax.set_ylabel("Position")
+    fig.colorbar(mesh, ax=ax, label="Re E")
+    return _finish(fig, filename, display)

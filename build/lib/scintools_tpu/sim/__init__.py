@@ -1,0 +1,5 @@
+from .simulation import (SimParams, Simulation, derived_constants,  # noqa: F401
+                         fresnel_filter, frequency_scales, screen_weights,
+                         screen_weights_reference, simulate,
+                         simulate_ensemble, simulate_intensity,
+                         simulate_sweep)

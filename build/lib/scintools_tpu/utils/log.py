@@ -1,0 +1,37 @@
+"""Structured logging (SURVEY.md §5 "metrics/logging" row).
+
+The reference reports progress with bare ``print()`` calls scattered
+through compute methods (dynspec.py:107,155; scint_sim.py:62-69).  Here a
+single std-``logging`` channel with a key=value formatter, so batch
+drivers and the CLI emit grep-able, timestamped events without touching
+the compute layers.
+"""
+
+from __future__ import annotations
+
+import logging
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s %(message)s"
+
+
+def get_logger(name: str = "scintools_tpu", level=logging.INFO
+               ) -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(_FORMAT, datefmt="%H:%M:%S"))
+        logger.addHandler(h)
+        logger.setLevel(level)
+        logger.propagate = False
+    return logger
+
+
+def log_event(logger: logging.Logger, event: str, **fields) -> None:
+    """Emit ``event key=value ...`` (floats compacted)."""
+    parts = [event]
+    for k, v in fields.items():
+        if isinstance(v, float):
+            parts.append(f"{k}={v:.6g}")
+        else:
+            parts.append(f"{k}={v}")
+    logger.info(" ".join(parts))

@@ -1,0 +1,42 @@
+"""Small utilities the reference stubs out or scatters.
+
+``is_valid`` (scint_utils.py:59-63) and working implementations of the
+reference's empty stubs ``remove_duplicates`` and ``make_pickle``
+(scint_utils.py:431-450).
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+
+def is_valid(array) -> np.ndarray:
+    """Finite & non-NaN boolean mask (scint_utils.py:59-63)."""
+    a = np.asarray(array)
+    return np.isfinite(a) & ~np.isnan(a)
+
+
+def remove_duplicates(filelist: list[str]) -> list[str]:
+    """Order-preserving dedup of a file list (reference stub,
+    scint_utils.py:437-443)."""
+    seen = set()
+    out = []
+    for f in filelist:
+        if f not in seen:
+            seen.add(f)
+            out.append(f)
+    return out
+
+
+def save_pickle(obj, filename: str) -> None:
+    """Pickle any result object (reference's empty ``make_pickle``,
+    scint_utils.py:446-450, made real)."""
+    with open(filename, "wb") as fh:
+        pickle.dump(obj, fh, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def load_pickle(filename: str):
+    with open(filename, "rb") as fh:
+        return pickle.load(fh)

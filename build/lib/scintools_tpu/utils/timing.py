@@ -1,0 +1,90 @@
+"""Tracing / profiling hooks (SURVEY.md §5 "tracing" row).
+
+The reference's only instrumentation is wall-clock prints around file
+loads (dynspec.py:104,153-155).  Here:
+
+* :class:`StageTimers` — accumulating per-stage wall-clock timers for the
+  pipeline driver and CLI, with device-sync-aware timing (``block=`` calls
+  ``jax.block_until_ready`` before stopping the clock, so jit async
+  dispatch doesn't fake speed).
+* :func:`trace_annotation` — names a region in the device profile
+  (``jax.profiler.TraceAnnotation``); no-op without jax.
+* :func:`profile_trace` — context manager around ``jax.profiler.trace``
+  writing a TensorBoard-loadable device trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import OrderedDict
+
+
+class StageTimers:
+    """Accumulate wall time and call counts per named stage.
+
+    >>> timers = StageTimers()
+    >>> with timers.stage("sspec"):
+    ...     out = compute()
+    >>> timers.summary()
+    {'sspec': {'calls': 1, 'total_s': ..., 'mean_s': ...}}
+    """
+
+    def __init__(self):
+        self._acc: "OrderedDict[str, list]" = OrderedDict()
+
+    @contextlib.contextmanager
+    def stage(self, name: str, block=None):
+        """Time a region.  Pass ``block=value_or_pytree`` to synchronise on
+        device completion of that value before stopping the clock."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if block is not None:
+                try:
+                    import jax
+
+                    jax.block_until_ready(block)
+                except ImportError:  # pragma: no cover
+                    pass
+            dt = time.perf_counter() - t0
+            tot, n = self._acc.get(name, (0.0, 0))
+            self._acc[name] = (tot + dt, n + 1)
+
+    def summary(self) -> dict:
+        return {k: {"calls": n, "total_s": round(tot, 6),
+                    "mean_s": round(tot / n, 6)}
+                for k, (tot, n) in self._acc.items()}
+
+    def report(self) -> str:
+        lines = [f"{k:>24s}  {v['calls']:5d} calls  "
+                 f"{v['total_s']:9.3f} s total  {v['mean_s']:9.4f} s/call"
+                 for k, v in self.summary().items()]
+        return "\n".join(lines)
+
+
+def trace_annotation(name: str):
+    """Named region in the device profiler timeline; no-op without jax."""
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except ImportError:  # pragma: no cover
+        return contextlib.nullcontext()
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str):
+    """Capture a device trace viewable in TensorBoard/XProf.
+
+    >>> with profile_trace("/tmp/trace"):
+    ...     step(batch).block_until_ready()
+    """
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -19,6 +19,13 @@ def test_arc_modelling_walkthrough(tmp_path):
     single, summed = (results["betaeta_single"],
                       results["betaeta_summed"])
     assert abs(summed - single) / single < 0.3
+    # the eigen-concentration estimator lands within the same order of
+    # magnitude (this epoch's diffuse arc makes the two methods measure
+    # genuinely different curvature statistics; see the example comment).
+    # The window is the sweep bracket itself — a backend-dependent peak
+    # anywhere in the sweep passes; only a broken sweep could fail
+    ratio = results["betaeta_thetatheta"] / single
+    assert 1 / 5 <= ratio <= 5.0
     assert results["tau"] > 0 and results["dnu"] > 0
     lo, hi = results["eta_annual_minmax"]
     assert 0 < lo < hi
