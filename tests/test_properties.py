@@ -136,6 +136,54 @@ def test_nudft_matches_direct_sum(power, fs_slope, r0, dr):
 
 
 @_SETTINGS
+@given(hnp.arrays(np.float64, (12, 16),
+                  elements=st.floats(0.01, 100, width=64)))
+def test_sspec_backend_equivalence(dyn):
+    """numpy and jax secondary spectra agree for arbitrary positive
+    flux values (fixed shape: the jax path compiles per shape).  The
+    critical backend-equivalence suite (SURVEY.md §4.3), value-searched."""
+    from scintools_tpu.ops import sspec
+
+    s_np = sspec(dyn, backend="numpy")
+    s_j = np.asarray(sspec(dyn, backend="jax"))
+    # compare in dB where power is non-negligible (log of ~0 power is
+    # backend-noise-dominated by construction)
+    mask = np.isfinite(s_np) & (s_np > s_np.max() - 200)
+    np.testing.assert_allclose(s_j[mask], s_np[mask], rtol=1e-6,
+                               atol=1e-6)
+
+
+@_SETTINGS
+@given(hnp.arrays(np.float64, (2, 12),
+                  elements=st.floats(-50, 50, width=64)))
+def test_scale_lambda_exact_on_linear_data(coeffs):
+    """Both backends' cubic splines reproduce data LINEAR in frequency
+    exactly on the wavelength grid (every cubic spline is exact on
+    linear functions regardless of boundary condition — the two paths
+    differ by design only in boundaries, ops/scale.py:9-12, which this
+    invariant is insensitive to; rough data near edges legitimately
+    diverges between not-a-knot and natural splines)."""
+    from scintools_tpu.data import DynspecData
+    from scintools_tpu.ops import scale_lambda
+
+    a, b = coeffs            # per-column slope/offset in frequency
+    freqs = 1300.0 + np.arange(10) * 12.0
+    dyn = a[None, :] * (freqs[:, None] - 1350.0) / 60.0 + b[None, :]
+    d = DynspecData(dyn=dyn, freqs=freqs, times=np.arange(12) * 8.0)
+    out_np, lam, dlam = scale_lambda(d, backend="numpy")
+    out_j, _, _ = scale_lambda(d, backend="jax")
+    from scintools_tpu.data import _C_M_S
+
+    feq = (_C_M_S / np.asarray(lam) / 1e6)     # rows already flipped
+    want = a[None, :] * (feq[:, None] - 1350.0) / 60.0 + b[None, :]
+    scale = float(np.abs(want).max()) + 1.0
+    np.testing.assert_allclose(np.asarray(out_np), want,
+                               atol=1e-9 * scale)
+    np.testing.assert_allclose(np.asarray(out_j), want,
+                               atol=1e-9 * scale)
+
+
+@_SETTINGS
 @given(hnp.arrays(np.float64, (2, 12, 14),
                   elements=st.floats(-100, 100, width=64)))
 def test_matmul_cuts_equal_fft_cuts(dyn):
