@@ -50,3 +50,7 @@ def test_two_process_mesh_psum_survey_stats():
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
         assert f"MULTIHOST_OK pid={i}" in out, out
         assert "count=7" in out
+    # both processes ran the SAME one-jit pipeline step over the global
+    # mesh and must agree on every global measurement
+    sums = [o.split("pipeline_checksum=")[1].split()[0] for o in outs]
+    assert sums[0] == sums[1], f"cross-process divergence: {sums}"
