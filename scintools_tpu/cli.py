@@ -301,13 +301,42 @@ def cmd_sim(args) -> int:
     from .io.psrflux import write_psrflux
     from .sim import Simulation
 
-    sim = Simulation(mb2=args.mb2, rf=args.rf, ds=args.ds,
-                     alpha=args.alpha, ar=args.ar, psi=args.psi,
-                     inner=args.inner, ns=args.ns, nf=args.nf,
-                     dlam=args.dlam, seed=args.seed, backend=args.backend)
-    d = from_simulation(sim, freq=args.freq, dt=args.dt)
-    write_psrflux(d, args.out)
-    print(json.dumps({"out": args.out, "nchan": d.nchan, "nsub": d.nsub}))
+    def one(seed, out):
+        sim = Simulation(mb2=args.mb2, rf=args.rf, ds=args.ds,
+                         alpha=args.alpha, ar=args.ar, psi=args.psi,
+                         inner=args.inner, ns=args.ns, nf=args.nf,
+                         dlam=args.dlam, seed=seed, backend=args.backend)
+        d = from_simulation(sim, freq=args.freq, dt=args.dt)
+        write_psrflux(d, out)
+        return d
+
+    n = int(getattr(args, "ensemble", 1) or 1)
+    if n <= 1:
+        d = one(args.seed, args.out)
+        print(json.dumps({"out": args.out, "nchan": d.nchan,
+                          "nsub": d.nsub}))
+        return 0
+    # seeded survey: N epochs <stem>_KKKK<ext>, consumable directly by
+    # `process --batched` (equal grids -> one compiled step)
+    import os
+
+    if args.seed is None:
+        # match single-run semantics (no --seed = independent randoms):
+        # a fresh random base per invocation, reported for reproduction
+        import numpy as np
+
+        base = int(np.random.SeedSequence().entropy % (2 ** 31))
+    else:
+        base = int(args.seed)
+    stem, ext = os.path.splitext(args.out)
+    ext = ext or ".dynspec"
+    files = []
+    for i in range(n):
+        out = f"{stem}_{i:04d}{ext}"
+        one(base + i, out)
+        files.append(out)
+    print(json.dumps({"out": f"{stem}_*{ext}", "files": n,
+                      "seed_base": base}))
     return 0
 
 
@@ -656,6 +685,9 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--nf", type=int, default=256)
     q.add_argument("--dlam", type=float, default=0.25)
     q.add_argument("--seed", type=int, default=None)
+    q.add_argument("--ensemble", type=int, default=1,
+                   help="write N consecutively-seeded epochs "
+                        "(<out-stem>_KKKK.<ext>) instead of one file")
     q.add_argument("--freq", type=float, default=1400.0)
     q.add_argument("--dt", type=float, default=8.0)
     q.add_argument("--backend", default="numpy",

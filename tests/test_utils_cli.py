@@ -311,6 +311,29 @@ def test_cli_process_scint_2d(tmp_path, capsys):
         assert np.isfinite(row["tilt"]) and row["tilterr"] >= 0
 
 
+def test_cli_sim_ensemble_feeds_batched_process(tmp_path, capsys):
+    """sim --ensemble N writes N seeded equal-grid epochs that process
+    --batched consumes in one compiled step."""
+    out = str(tmp_path / "e.dynspec")
+    rc = cli_main(["sim", "--out", out, "--ns", "64", "--nf", "64",
+                   "--seed", "7", "--ensemble", "3"])
+    assert rc == 0
+    info = json.loads(capsys.readouterr().out)
+    assert info["files"] == 3 and info["seed_base"] == 7
+    import glob
+
+    files = sorted(glob.glob(str(tmp_path / "e_*.dynspec")))
+    assert len(files) == 3
+    res = str(tmp_path / "r.csv")
+    rc = cli_main(["process", *files, "--lamsteps", "--batched",
+                   "--results", res])
+    assert rc == 0
+    assert len(open(res).read().strip().splitlines()) == 4
+    # distinct seeds -> distinct spectra (not 3 copies of one epoch)
+    a, b = open(files[0]).read(), open(files[1]).read()
+    assert a != b
+
+
 def test_cli_full_csv_export(tmp_path, capsys):
     """--full-csv exports every store column (tilt etc.); the default
     export keeps the reference schema."""
