@@ -428,7 +428,6 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
     import jax
     import jax.numpy as jnp
 
-    from .filters import savgol1
     from ..models.parabola import fit_parabola as _fitpar
 
     fdop = np.frombuffer(fdop_key[0]).reshape(fdop_key[1])
@@ -619,15 +618,14 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         prof = jnp.where(prof[i_at_1] < 0, prof + 2.0, prof)
 
         # ---- fold arms onto the eta grid -------------------------------
-        def measure_arm(arm, nan_on_forward=False, cmask=None):
+        def measure_arm(arm, cmask=None):
             # arm indexed like ipos (descending eta); flip to ascending
             avg = arm[::-1]
             valid = jnp.isfinite(avg) & jnp.asarray(keep_static)
             return measure_profile(avg, valid, noise,
                                    jnp.asarray(eta_array),
                                    cons_mask if cmask is None else cmask,
-                                   use_log=False,
-                                   nan_on_forward=nan_on_forward)
+                                   use_log=False)
 
         right = prof[ipos]
         left = prof[ineg][::-1]
@@ -638,77 +636,162 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
                 noise)
         out = measure_arm(combined) + (noise,)
         if asymm:
-            el, eel = measure_arm(left, nan_on_forward=True)[:2]
-            er, eer = measure_arm(right, nan_on_forward=True)[:2]
+            el, eel = measure_arm(left)[:2]
+            er, eer = measure_arm(right)[:2]
             out = out + (el, eel, er, eer)
         return out
 
-    def measure_profile(avg, valid, noise, ea, cmask, use_log,
-                        nan_on_forward=False):
-        """Masked peak search + power-drop windows + (log-)parabola fit on
-        a power-vs-eta profile — the jit-safe tail shared by both methods
-        (dynspec.py:693-744).
+    def measure_profile(avg, valid, noise, ea, cmask, use_log):
+        """Masked peak search + power-drop walks + (log-)parabola fit on
+        a power-vs-eta profile — the jit-safe tail shared by both
+        methods, emulating the numpy path's COMPACTED-array semantics
+        exactly (dynspec.py:693-744): the serial reference chain drops
+        invalid entries before smoothing/walking, and index-space walks
+        on the compacted vs masked-full array diverge by 10-30% in eta
+        on diffuse arcs.  Here the compaction is an argsort gather, the
+        savgol is scipy's polyorder-1 'interp' filter at the dynamic
+        boundary (interior = centred moving average; edges = linear LSQ
+        over the first/last window evaluated at the edge positions),
+        and the walks reproduce the reference's quirks: first examined
+        offset is 2, the left walk guards on ind+ind1 like the right
+        one, negative left indices wrap python-style, and the slice
+        window EXCLUDES the right crossing point.
 
-        ``nan_on_forward``: NaN-poison eta/etaerr when the fit is a
-        forward (upward-opening) parabola — the jit-safe analogue of the
-        numpy path's raise (dynspec.py:598-599); used for the per-arm
-        asymm fits where a one-sided spectrum makes a degenerate arm.
+        Forward (upward-opening) parabolas NaN-poison eta/etaerr for
+        EVERY fit — the jit-safe analogue of the numpy path's
+        unconditional raise (dynspec.py:598-599).
         """
-        # fill invalid (contiguous large-eta tail / NaN centre) with the
-        # lowest valid power so the smoother sees a continuous profile and
-        # the fill can never create a spurious peak (differs from the numpy
-        # path, which smooths the compacted array; tolerance in tests)
-        fill = jnp.nanmin(jnp.where(valid, avg, jnp.nan))
-        avg_f = jnp.where(valid, avg, fill)
-        filt = savgol1(avg_f, nsmooth, xp=jnp)
+        n = avg.shape[0]
+        idx = jnp.arange(n)
+        # ---- compaction (numpy: a[valid], ascending eta) ---------------
+        order = jnp.argsort(jnp.where(valid, idx, n + idx))
+        avg_c = jnp.where(valid[order], avg[order], 0.0)
+        ea_c = ea[order]
+        cmask_c = jnp.asarray(cmask)[order]
+        nv = jnp.sum(valid)
+        in_c = idx < nv
 
-        # ---- peak within constraint (dynspec.py:693-699) ---------------
-        search = valid & jnp.asarray(cmask)
-        maxval = jnp.max(jnp.where(search, filt, -jnp.inf))
-        peak_ind = jnp.argmin(jnp.where(valid, jnp.abs(filt - maxval),
+        # ---- scipy savgol_filter(a, nsmooth, 1) on length-nv array -----
+        h = nsmooth // 2
+        kern = jnp.ones(nsmooth, dtype=avg.dtype) / nsmooth
+        mov = jnp.convolve(avg_c, kern, mode="same")
+        t = jnp.arange(nsmooth, dtype=avg.dtype)
+        tm = (nsmooth - 1) / 2.0
+        denom = jnp.sum((t - tm) ** 2)
+
+        def linfit(seg):
+            b = jnp.sum((t - tm) * seg) / denom
+            a0 = jnp.mean(seg) - b * tm
+            return a0, b
+
+        a_h, b_h = linfit(jax.lax.dynamic_slice(avg_c, (0,), (nsmooth,)))
+        start_t = jnp.maximum(nv - nsmooth, 0)
+        a_t, b_t = linfit(jax.lax.dynamic_slice(avg_c, (start_t,),
+                                                (nsmooth,)))
+        filt_c = mov
+        filt_c = jnp.where(idx < h, a_h + b_h * idx, filt_c)
+        filt_c = jnp.where((idx >= nv - h) & in_c,
+                           a_t + b_t * (idx - start_t), filt_c)
+        filt_c = jnp.where(in_c, filt_c, jnp.nan)
+
+        # ---- peak (dynspec.py:693-699; argmin over ALL compacted) ------
+        search = in_c & cmask_c
+        maxval = jnp.max(jnp.where(search, filt_c, -jnp.inf))
+        peak_ind = jnp.argmin(jnp.where(in_c, jnp.abs(filt_c - maxval),
                                         jnp.inf))
-        max_power = filt[peak_ind]
+        max_power = filt_c[peak_ind]
 
-        idx = jnp.arange(filt.shape[0])
+        nv_safe = jnp.maximum(nv, 1)
 
-        last_valid = jnp.max(jnp.where(valid, idx, 0))
+        def walk(threshold):
+            """The reference _walk (dynspec.py:702-718) in closed form:
+            terminal ind = smallest j >= 1 with [j == 1 and
+            filt[peak] <= thr] or [j >= 2 and filt[(peak -/+ j) mod nv]
+            <= thr] or [peak + j >= nv - 1] (BOTH directions guard on
+            peak + j — the reference quirk)."""
+            stop_guard = peak_ind + idx >= nv - 1
+            first = (idx == 1) & (max_power <= threshold)
 
-        def window(threshold_lo, threshold_hi):
-            # first crossing below/above the peak (clean reformulation of
-            # the reference's while-walks); falls back to the profile ends
-            # when the threshold is never crossed
-            below = (filt <= threshold_lo) & (idx < peak_ind) & valid
-            left = jnp.maximum(jnp.max(jnp.where(below, idx, -1)), 0)
-            above = (filt <= threshold_hi) & (idx > peak_ind) & valid
-            right = jnp.min(jnp.where(above, idx, filt.shape[0]))
-            right = jnp.where(right >= filt.shape[0], last_valid, right)
-            return left, right
+            def terminal(values):
+                crossed = (idx >= 2) & (values <= threshold)
+                cond = (idx >= 1) & (first | crossed | stop_guard)
+                return jnp.min(jnp.where(cond, idx, n))
 
-        left, right = window(max_power + low_power_diff,
-                             max_power + high_power_diff)
-        w = ((idx >= left) & (idx < right + 1) & valid).astype(filt.dtype)
+            v_l = filt_c[jnp.mod(peak_ind - idx, nv_safe)]
+            v_r = filt_c[jnp.mod(peak_ind + idx, nv_safe)]
+            return terminal(v_l), terminal(v_r)
+
+        def window_mask(i1, i2):
+            """numpy slice arr[peak-i1 : peak+i2] on the length-nv
+            compacted array, including python's negative-start wrap
+            (kept bit-for-bit, see _measure_peak)."""
+            start = peak_ind - i1
+            stop = peak_ind + i2
+            astart = jnp.where(start < 0, nv + start, start)
+            return in_c & (idx >= astart) & (idx < stop)
+
+        i1, _ = walk(max_power + low_power_diff)
+        _, i2 = walk(max_power + high_power_diff)
+        wstart = jnp.where(peak_ind - i1 < 0, nv + peak_ind - i1,
+                           peak_ind - i1)
+        wstop = peak_ind + i2
+        w = window_mask(i1, i2).astype(avg.dtype)
         if use_log:
-            yfit, eta, etaerr_fit = fit_log_parabola(ea, avg_f, w=w,
+            yfit, eta, etaerr_fit = fit_log_parabola(ea_c, avg_c, w=w,
                                                      xp=jnp)
         else:
-            yfit, eta, etaerr_fit = _fitpar(ea, avg_f, w=w, xp=jnp)
+            yfit, eta, etaerr_fit = _fitpar(ea_c, avg_c, w=w, xp=jnp)
 
         etaerr = etaerr_fit
         if noise_error:
-            jl, jr = window(max_power - noise, max_power - noise)
-            wn_ = (idx >= jl) & (idx < jr + 1) & valid
-            lo_eta = jnp.min(jnp.where(wn_, ea, jnp.inf))
-            hi_eta = jnp.max(jnp.where(wn_, ea, -jnp.inf))
-            etaerr = (hi_eta - lo_eta) / 2
+            j1, j2 = walk(max_power - noise)
+            wn_ = window_mask(j1, j2)
+            lo_eta = jnp.min(jnp.where(wn_, ea_c, jnp.inf))
+            hi_eta = jnp.max(jnp.where(wn_, ea_c, -jnp.inf))
+            # empty (wrapped) noise window: the numpy path guards ptp of
+            # an empty slice to NaN (arc_fit.py _measure_peak), finite
+            # eta kept — match that, not a -inf from the inf fills
+            etaerr = jnp.where(jnp.any(wn_), (hi_eta - lo_eta) / 2,
+                               jnp.nan)
 
-        if nan_on_forward:
-            # mean(gradient(diff(yfit))) > 0 is the reference's forward-
-            # parabola test (dynspec.py:598)
-            fwd = jnp.mean(jnp.gradient(jnp.diff(yfit))) > 0
-            eta = jnp.where(fwd, jnp.nan, eta)
-            etaerr = jnp.where(fwd, jnp.nan, etaerr)
+        # the reference's forward-parabola check, on the WINDOW slice
+        # with index spacing exactly as numpy computes it
+        # (mean(np.gradient(np.diff(yfit_window))) > 0, dynspec.py:598) —
+        # computed on the full grid the sign can flip on the sqrt-spaced
+        # eta axis and falsely reject fits the reference accepts
+        wlen = wstop - wstart
+        m = wlen - 1                       # diff length
+        dfull = jnp.diff(yfit)             # [n-1]; pair (k, k+1)
+        k = jnp.arange(n)
+        safe = lambda i: jnp.clip(i, 0, n - 2)  # noqa: E731
+        d0 = dfull[safe(wstart + k)]
+        dm = dfull[safe(wstart + k - 1)]
+        dp = dfull[safe(wstart + k + 1)]
+        g = jnp.where(k == 0, dp - d0,
+                      jnp.where(k == m - 1, d0 - dm, (dp - dm) / 2))
+        g_mean = (jnp.sum(jnp.where(k < m, g, 0.0))
+                  / jnp.maximum(m, 1))
 
-        return eta, etaerr, etaerr_fit, avg_f, filt
+        # degenerate lanes -> NaN (the numpy path RAISES for every one of
+        # these and the batch driver quarantines NaN): profile shorter
+        # than the smoother; no constraint point among the valid
+        # entries; a parabola window of < 3 points (the reference's
+        # np.gradient forward-check crashes there — and a 2-point
+        # parabola's vertex is pure floating-point noise, which showed
+        # up as plain-vs-sharded nondeterminism); or a forward
+        # (upward-opening) fitted parabola, which the reference raises
+        # on unconditionally (dynspec.py:598), not just for arms
+        bad = ((nv < nsmooth) | ~jnp.any(search)
+               | (jnp.sum(w > 0) < 3) | (g_mean > 0))
+        eta = jnp.where(bad, jnp.nan, eta)
+        etaerr = jnp.where(bad, jnp.nan, etaerr)
+
+        # full-grid profile outputs (NaN at invalid), matching the old
+        # output contract: scatter the compacted smooth back
+        inv = jnp.argsort(order)
+        avg_f = jnp.where(valid, avg, jnp.nan)
+        filt_full = jnp.where(valid, filt_c[inv], jnp.nan)
+        return eta, etaerr, etaerr_fit, avg_f, filt_full
 
     # ---- gridmax statics (dynspec.py:516-659) --------------------------
     if method == "gridmax":
@@ -783,12 +866,11 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
                                eta_p.reshape(-1, chunk)
                                ).reshape(-1, 3)[:S]
 
-            def measure_pow(p, nan_on_forward=False, cmask=None):
+            def measure_pow(p, cmask=None):
                 return measure_profile(p, jnp.isfinite(p), noise,
                                        jnp.asarray(eta_array_g),
                                        cons_mask_g if cmask is None
-                                       else cmask, use_log=True,
-                                       nan_on_forward=nan_on_forward)
+                                       else cmask, use_log=True)
 
             if constraints is not None:
                 return _stack_windows(
@@ -796,10 +878,8 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
                     cons_masks_g, noise)
             out = measure_pow(pows[:, 0]) + (noise,)
             if asymm:
-                el, eel = measure_pow(pows[:, 1],
-                                      nan_on_forward=True)[:2]
-                er, eer = measure_pow(pows[:, 2],
-                                      nan_on_forward=True)[:2]
+                el, eel = measure_pow(pows[:, 1])[:2]
+                er, eer = measure_pow(pows[:, 2])[:2]
                 out = out + (el, eel, er, eer)
             return out
 

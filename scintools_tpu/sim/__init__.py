@@ -3,3 +3,4 @@ from .simulation import (SimParams, Simulation, derived_constants,  # noqa: F401
                          screen_weights_reference, simulate,
                          simulate_ensemble, simulate_intensity,
                          simulate_sweep)
+from .synth import thin_arc_epoch  # noqa: F401

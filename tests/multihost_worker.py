@@ -59,12 +59,18 @@ def main() -> int:
     from scintools_tpu.parallel import (PipelineConfig, data_sharding,
                                         make_pipeline)
 
-    rng = np.random.default_rng(0)          # identical on both workers
-    dyn_global = ((1.0 + 0.3 * rng.standard_normal((8, 16, 16))) ** 2)
-    freqs = np.linspace(1300.0, 1500.0, 16)
-    times = np.arange(16) * 8.0
+    # thin-arc epochs (identical on both workers): the fitter now
+    # faithfully NaN-quarantines arc-less noise like the reference's
+    # raises, so the SPMD check needs genuinely fittable spectra
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from synth import synth_arc_epoch
+
+    eps = [synth_arc_epoch(nf=32, nt=32, seed=k) for k in range(8)]
+    dyn_global = np.stack([np.asarray(d.dyn) for d in eps])
+    freqs = np.asarray(eps[0].freqs)
+    times = np.asarray(eps[0].times)
     step = make_pipeline(freqs, times,
-                         PipelineConfig(arc_numsteps=200, lm_steps=10),
+                         PipelineConfig(arc_numsteps=300, lm_steps=10),
                          mesh=mesh)
     sh = data_sharding(mesh)
     garr = jax.make_array_from_process_local_data(

@@ -1,0 +1,20 @@
+"""Shared synthetic epochs for tests that need ROBUSTLY arc-fittable
+dynspecs at small sizes — thin wrappers over the package generator
+(scintools_tpu.sim.thin_arc_epoch): the reference's arc fitter is
+genuinely brittle on small noisy phase-screen sims (forward-parabola /
+too-short-window raises, which the batched path faithfully maps to NaN
+quarantine), while these thin-arc epochs fit for every seed."""
+
+from scintools_tpu.data import DynspecData
+from scintools_tpu.sim import thin_arc_epoch
+
+
+def synth_arc_epoch(nf=64, nt=64, seed=0, **kw) -> DynspecData:
+    return thin_arc_epoch(nf=nf, nt=nt, seed=seed, **kw)
+
+
+def synth_arc_epoch_nonlam(nf=64, nt=64, seed=0) -> DynspecData:
+    """Variant tuned for the NON-lamsteps fitter (verified 6/6 seeds at
+    64x64, numsteps=500): broader image envelope, more noise."""
+    return thin_arc_epoch(nf=nf, nt=nt, seed=seed, arc_frac=0.6,
+                          nimg=24, core=4.0, noise=0.02, env=0.15)

@@ -499,6 +499,46 @@ def test_thetatheta_via_fit_arc_dispatch():
         fit_arc(sec, freq=1400.0, method="thetatheta")
 
 
+def test_batched_fit_arc_quarantines_where_numpy_raises():
+    """Quarantine parity: on epochs where the serial reference chain
+    RAISES (forward parabola / too-short window — genuinely common on
+    small noisy spectra), the batched fitter returns NaN, never a
+    spurious finite curvature; where the chain succeeds, the batched
+    value is bit-identical.  This also pins down what used to be
+    plain-vs-sharded nondeterminism: 2-point parabola vertices are
+    floating-point noise."""
+    from scintools_tpu.io import from_simulation
+    from scintools_tpu.ops import scale_lambda, sspec as sspec_op, \
+        sspec_axes
+    from scintools_tpu.sim import Simulation
+
+    matched = raised = 0
+    for seed in (1, 2, 40, 41, 203):
+        nf, nt = (32, 32) if seed in (1, 2) else (96, 128)
+        d = from_simulation(Simulation(mb2=2, ns=nt, nf=nf, dlam=0.25,
+                                       seed=seed), freq=1400.0, dt=8.0)
+        lamdyn, lam, dlam = scale_lambda(d, backend="numpy")
+        arr = sspec_op(np.asarray(lamdyn, np.float64), backend="numpy")
+        fdop, tdel, beta = sspec_axes(lamdyn.shape[0], lamdyn.shape[1],
+                                      float(d.dt), float(d.df), dlam=dlam)
+        sec = SecSpec(sspec=arr, fdop=fdop, tdel=tdel, beta=beta,
+                      lamsteps=True)
+        try:
+            eta_n = float(fit_arc(sec, freq=float(d.freq), numsteps=500,
+                                  backend="numpy").eta)
+        except ValueError:
+            eta_n = float("nan")
+            raised += 1
+        eta_j = float(fit_arc(sec, freq=float(d.freq), numsteps=500,
+                              backend="jax").eta)
+        if np.isnan(eta_n):
+            assert np.isnan(eta_j), (seed, eta_j)
+        else:
+            np.testing.assert_allclose(eta_j, eta_n, rtol=1e-12)
+            matched += 1
+    assert matched >= 1 and raised >= 1   # both behaviors exercised
+
+
 def test_make_tt_fitter_batched_matches_single():
     """The batched fixed-shape theta-theta fitter reproduces
     fit_arc_thetatheta's eta/etaerr/concentration on every lane."""
@@ -668,13 +708,14 @@ def test_pipeline_arc_asymm_batched():
 
     from scintools_tpu.parallel import PipelineConfig, make_pipeline
 
-    rng = np.random.default_rng(5)
-    B, nf, nt = 3, 48, 48
-    dyn = (1 + 0.3 * rng.standard_normal((B, nf, nt))).astype(np.float32)**2
-    freqs = np.linspace(1380.0, 1420.0, nf)
-    times = np.arange(nt) * 4.0
-    cfg = PipelineConfig(arc_numsteps=300, lm_steps=10, arc_asymm=True)
-    res = make_pipeline(freqs, times, cfg)(jnp.asarray(dyn))
+    from synth import synth_arc_epoch
+
+    B = 3
+    eps = [synth_arc_epoch(seed=s) for s in range(B)]
+    dyn = np.stack([np.asarray(d.dyn, dtype=np.float32) for d in eps])
+    cfg = PipelineConfig(arc_numsteps=500, lm_steps=10, arc_asymm=True)
+    res = make_pipeline(np.asarray(eps[0].freqs),
+                        np.asarray(eps[0].times), cfg)(jnp.asarray(dyn))
     for field in ("eta_left", "etaerr_left", "eta_right", "etaerr_right"):
         v = getattr(res.arc, field)
         assert v is not None and v.shape == (B,)
@@ -787,18 +828,31 @@ def test_batched_multi_arc_non_lamsteps_window_units():
 
     from scintools_tpu.fit.arc_fit import _beta_to_eta_factor
 
-    sec_lam = _arc_secspec(eta=0.5)
-    sec = SecSpec(sspec=np.asarray(sec_lam.sspec), fdop=sec_lam.fdop,
-                  tdel=sec_lam.tdel, beta=None, lamsteps=False)
-    freq = 1200.0
-    single = fit_arc(sec, freq=freq, numsteps=1500, backend="jax")
+    from synth import synth_arc_epoch_nonlam
+    from scintools_tpu.ops import sspec as sspec_op, sspec_axes
+
+    # a realistic thin-arc epoch with an explicit eta grid bracketing
+    # the true curvature, so the jax fit is deterministic and interior
+    # (the reference chain raises on peak-at-grid-edge spectra, which
+    # the batched fitter maps to NaN — not the property under test here)
+    d = synth_arc_epoch_nonlam(seed=0)
+    arr = sspec_op(np.asarray(d.dyn, np.float64), backend="numpy")
+    fdop, tdel, beta = sspec_axes(64, 64, float(d.dt), float(d.df))
+    sec = SecSpec(sspec=arr, fdop=fdop, tdel=tdel, beta=None,
+                  lamsteps=False)
+    freq = float(d.freq)
+    true_eta = 0.6 * (1 / (2 * 0.5)) / (0.4 * (1e3 / 20.0)) ** 2
+    kw = dict(etamin=true_eta / 5, etamax=true_eta * 5)
+    single = fit_arc(sec, freq=freq, numsteps=500, backend="jax", **kw)
+    assert np.isfinite(float(single.eta))
     b2e = _beta_to_eta_factor(freq, 1400.0) / (freq / 1400.0) ** 2
     eta_user = float(single.eta) / b2e
     fitter = make_arc_fitter(fdop=np.asarray(sec.fdop),
                              yaxis=np.asarray(sec.tdel),
                              tdel=np.asarray(sec.tdel), freq=freq,
-                             lamsteps=False, numsteps=1500,
-                             constraints=((0.5 * eta_user, 2 * eta_user),))
+                             lamsteps=False, numsteps=500,
+                             constraints=((0.5 * eta_user, 2 * eta_user),),
+                             **kw)
     batch = fitter(jnp.asarray(sec.sspec)[None])
     np.testing.assert_allclose(float(batch.eta[0, 0]), float(single.eta),
                                rtol=1e-9)

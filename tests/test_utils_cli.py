@@ -440,12 +440,14 @@ def test_cli_process_batched_thetatheta(tmp_path, capsys):
     """--arc-method thetatheta with --arc-bracket runs the batched
     eigen-concentration estimator; resuming with a different estimator
     re-runs the epochs (distinct resume key)."""
-    from scintools_tpu.sim import Simulation
+    from synth import synth_arc_epoch
 
     files = []
     for i in range(2):
-        d = from_simulation(Simulation(mb2=2, ns=64, nf=64, dlam=0.25,
-                                       seed=70 + i), freq=1400.0, dt=8.0)
+        # arc-bearing epochs: the norm_sspec resume pass must also fit
+        # (the fitter NaN-quarantines arc-less spectra like the
+        # reference's raises, which would drop the resumed rows)
+        d = synth_arc_epoch(seed=70 + i)
         fn = str(tmp_path / f"t{i}.dynspec")
         write_psrflux(d, fn)
         files.append(fn)
