@@ -724,18 +724,17 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         def window_mask(i1, i2):
             """numpy slice arr[peak-i1 : peak+i2] on the length-nv
             compacted array, including python's negative-start wrap
-            (kept bit-for-bit, see _measure_peak)."""
+            (kept bit-for-bit, see _measure_peak).  Returns
+            (mask, astart, stop) so callers share ONE wrap expression."""
             start = peak_ind - i1
             stop = peak_ind + i2
             astart = jnp.where(start < 0, nv + start, start)
-            return in_c & (idx >= astart) & (idx < stop)
+            return in_c & (idx >= astart) & (idx < stop), astart, stop
 
         i1, _ = walk(max_power + low_power_diff)
         _, i2 = walk(max_power + high_power_diff)
-        wstart = jnp.where(peak_ind - i1 < 0, nv + peak_ind - i1,
-                           peak_ind - i1)
-        wstop = peak_ind + i2
-        w = window_mask(i1, i2).astype(avg.dtype)
+        wmask, wstart, wstop = window_mask(i1, i2)
+        w = wmask.astype(avg.dtype)
         if use_log:
             yfit, eta, etaerr_fit = fit_log_parabola(ea_c, avg_c, w=w,
                                                      xp=jnp)
@@ -745,7 +744,7 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         etaerr = etaerr_fit
         if noise_error:
             j1, j2 = walk(max_power - noise)
-            wn_ = window_mask(j1, j2)
+            wn_, _, _ = window_mask(j1, j2)
             lo_eta = jnp.min(jnp.where(wn_, ea_c, jnp.inf))
             hi_eta = jnp.max(jnp.where(wn_, ea_c, -jnp.inf))
             # empty (wrapped) noise window: the numpy path guards ptp of
@@ -785,6 +784,9 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
                | (jnp.sum(w > 0) < 3) | (g_mean > 0))
         eta = jnp.where(bad, jnp.nan, eta)
         etaerr = jnp.where(bad, jnp.nan, etaerr)
+        # the whole fit is absent on the numpy path (raise): etaerr2
+        # from the degenerate normal equations must not leak either
+        etaerr_fit = jnp.where(bad, jnp.nan, etaerr_fit)
 
         # full-grid profile outputs (NaN at invalid), matching the old
         # output contract: scatter the compacted smooth back

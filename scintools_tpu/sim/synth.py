@@ -18,7 +18,18 @@ import numpy as np
 
 from ..data import DynspecData
 
-__all__ = ["thin_arc_epoch"]
+__all__ = ["thin_arc_epoch", "thin_arc_eta"]
+
+
+def thin_arc_eta(arc_frac: float = 0.5, df: float = 0.5,
+                 dt: float = 10.0, **_ignored) -> float:
+    """The curvature (us/mHz^2) thin_arc_epoch injects for these
+    parameters — the single source of truth for tests that bracket the
+    true arc (extra kwargs like nimg/core are accepted and ignored so a
+    tuning dict can be passed wholesale)."""
+    fd_max = 1e3 / (2 * dt)
+    tau_max = 1 / (2 * df)
+    return arc_frac * tau_max / (0.4 * fd_max) ** 2
 
 
 def thin_arc_epoch(nf: int = 64, nt: int = 64, seed: int = 0,
@@ -38,8 +49,7 @@ def thin_arc_epoch(nf: int = 64, nt: int = 64, seed: int = 0,
     freqs = 1400.0 + np.arange(nf) * df
     times = np.arange(nt) * dt
     fd_max = 1e3 / (2 * dt)
-    tau_max = 1 / (2 * df)
-    eta = arc_frac * tau_max / (0.4 * fd_max) ** 2
+    eta = thin_arc_eta(arc_frac=arc_frac, df=df, dt=dt)
     th = np.linspace(-0.4 * fd_max, 0.4 * fd_max, nimg)
     mu = ((rng.normal(size=nimg) + 1j * rng.normal(size=nimg))
           * np.exp(-0.5 * (th / (env * fd_max)) ** 2))

@@ -7,6 +7,11 @@ quarantine), while these thin-arc epochs fit for every seed."""
 
 from scintools_tpu.data import DynspecData
 from scintools_tpu.sim import thin_arc_epoch
+from scintools_tpu.sim.synth import thin_arc_eta  # noqa: F401
+
+# tuning for the NON-lamsteps fitter (verified 6/6 seeds at 64x64,
+# numsteps=500): broader image envelope, more noise
+NONLAM_KW = dict(arc_frac=0.6, nimg=24, core=4.0, noise=0.02, env=0.15)
 
 
 def synth_arc_epoch(nf=64, nt=64, seed=0, **kw) -> DynspecData:
@@ -14,7 +19,4 @@ def synth_arc_epoch(nf=64, nt=64, seed=0, **kw) -> DynspecData:
 
 
 def synth_arc_epoch_nonlam(nf=64, nt=64, seed=0) -> DynspecData:
-    """Variant tuned for the NON-lamsteps fitter (verified 6/6 seeds at
-    64x64, numsteps=500): broader image envelope, more noise."""
-    return thin_arc_epoch(nf=nf, nt=nt, seed=seed, arc_frac=0.6,
-                          nimg=24, core=4.0, noise=0.02, env=0.15)
+    return thin_arc_epoch(nf=nf, nt=nt, seed=seed, **NONLAM_KW)

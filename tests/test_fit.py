@@ -828,7 +828,7 @@ def test_batched_multi_arc_non_lamsteps_window_units():
 
     from scintools_tpu.fit.arc_fit import _beta_to_eta_factor
 
-    from synth import synth_arc_epoch_nonlam
+    from synth import NONLAM_KW, synth_arc_epoch_nonlam, thin_arc_eta
     from scintools_tpu.ops import sspec as sspec_op, sspec_axes
 
     # a realistic thin-arc epoch with an explicit eta grid bracketing
@@ -841,7 +841,7 @@ def test_batched_multi_arc_non_lamsteps_window_units():
     sec = SecSpec(sspec=arr, fdop=fdop, tdel=tdel, beta=None,
                   lamsteps=False)
     freq = float(d.freq)
-    true_eta = 0.6 * (1 / (2 * 0.5)) / (0.4 * (1e3 / 20.0)) ** 2
+    true_eta = thin_arc_eta(**NONLAM_KW)
     kw = dict(etamin=true_eta / 5, etamax=true_eta * 5)
     single = fit_arc(sec, freq=freq, numsteps=500, backend="jax", **kw)
     assert np.isfinite(float(single.eta))

@@ -576,7 +576,15 @@ def test_pipeline_matches_serial_numpy_chain():
         sp = fit_scint_params(acf(d64, backend="numpy"), d.dt, d.df,
                               d.nchan, d.nsub, backend="numpy")
         compared.append(lane)
-        assert float(res.arc.eta[lane]) == pytest.approx(fit.eta, rel=0.1)
+        # the batched fitter emulates the serial chain's compacted-array
+        # semantics exactly (bit-level on a shared spectrum —
+        # test_batched_fit_arc_quarantines_where_numpy_raises); the
+        # residual here (~1e-4) is purely the upstream lambda-resample
+        # boundary (pipeline: natural-spline matrix; serial chain: scipy
+        # not-a-knot — ops/scale.py:9-12).  Was rel=0.1 before the
+        # fitter emulated the chain's compaction semantics.
+        assert float(res.arc.eta[lane]) == pytest.approx(fit.eta,
+                                                         rel=1e-3)
         assert float(res.scint.tau[lane]) == pytest.approx(float(sp.tau),
                                                            rel=0.1)
         assert float(res.scint.dnu[lane]) == pytest.approx(float(sp.dnu),
