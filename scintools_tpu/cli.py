@@ -187,7 +187,8 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
     from .io.psrflux import read_psrflux
     from .io.results import results_row, write_results
     from .ops.clean import refill, trim_edges
-    from .parallel import PipelineConfig, make_mesh, run_pipeline
+    from .parallel import (PipelineConfig, make_mesh, run_pipeline,
+                           survey_routes)
     from .utils import content_key, log_event
 
     epochs, names, failed = [], [], 0
@@ -222,6 +223,13 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
             # (logged, rc=1), not as a raw traceback
             mesh = (make_mesh(tuple(int(x) for x in mesh_shape))
                     if mesh_shape else make_mesh())
+            # the resolved auto routes: matmul vs fft cuts differ at f32
+            # rounding, so a survey resumed on a different host class
+            # drifts numerically — make that diagnosable
+            routes = survey_routes(epochs, pcfg, mesh=mesh,
+                                   chunk=getattr(args, "chunk_epochs",
+                                                 None))
+            log_event(log, "routes", **routes)
             with timers.stage("batched_pipeline"):
                 buckets = run_pipeline(
                     epochs, pcfg, mesh=mesh,
@@ -231,6 +239,17 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
                       epochs=len(epochs))
             failed += len(epochs)
             buckets = []
+        if buckets and store is not None:
+            # baseline only updates on a run that produced results, and
+            # drift compares route VALUES (keys embed batch composition,
+            # which legitimately shrinks on every partial resume)
+            prev = store.get_meta("routes")
+            vals = lambda r: sorted(  # noqa: E731
+                {tuple(sorted(v.items())) for v in r.values()})
+            if prev is not None and vals(prev) != vals(routes):
+                log_event(log, "routes_changed", previous=prev,
+                          current=routes)
+            store.put_meta("routes", routes)
         for indices, res in buckets:
             for lane, idx in enumerate(indices):
                 row = results_row(epochs[idx])

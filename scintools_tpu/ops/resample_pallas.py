@@ -101,9 +101,22 @@ def row_scrunch_pallas(rows, i0, w, block_r: int = 64,
     i0 = jnp.asarray(i0, dtype=jnp.int32)
     w = jnp.asarray(w)
     R, C = rows.shape[-2], rows.shape[-1]
+    if C < 2:
+        raise ValueError(f"rows needs >= 2 columns to interpolate, got {C}")
     n = i0.shape[-1]
     if i0.shape[-2] != R or w.shape[-2:] != (R, n):
         raise ValueError(f"shape mismatch: rows [{R},{C}], i0 "
                          f"{i0.shape}, w {w.shape}")
+    # public A/B entry point: an out-of-range gather inside a real
+    # Mosaic kernel is UB that interpret-mode tests cannot catch.  Guard
+    # by clamping the anchor in range with the weight pinned to the edge
+    # sample, still evaluated through the same lerp as in-range lanes —
+    # so a NaN edge NEIGHBOUR NaN-poisons the lane exactly as the
+    # production paths' math would (NaN*0 is NaN), which is the
+    # bit-compat contract; this is edge-value clamping only for finite
+    # neighbourhoods, not a full select
+    w = jnp.where(i0 > C - 2, w.dtype.type(1),
+                  jnp.where(i0 < 0, w.dtype.type(0), w))
+    i0 = jnp.clip(i0, 0, C - 2)
     return _build(int(R), int(C), int(n), int(min(block_r, R)),
                   bool(interpret))(rows, i0, w)

@@ -1,6 +1,7 @@
 from .batch import BatchMask, bucket_by_shape, pad_batch, pad_epoch  # noqa: F401
 from .driver import (PipelineConfig, PipelineResult,  # noqa: F401
-                     lambda_resample_matrix, make_pipeline, run_pipeline)
+                     lambda_resample_matrix, make_pipeline, resolve_routes,
+                     run_pipeline, survey_routes)
 from .mesh import (CHAN_AXIS, DATA_AXIS, data_sharding, make_mesh,  # noqa: F401
                    replicated, shard_leading, sharded_mean)
 from .distributed import (initialize_multihost,  # noqa: F401

@@ -274,6 +274,93 @@ def _resolve_cuts(method: str, mesh, batch_shape=None,
 _AUTO_ARC_SCRUNCH_TPU = 64
 
 
+def _resolve_arc_scrunch(config: "PipelineConfig", mesh) -> int:
+    """arc_scrunch_rows=-1 auto rule — the single source of truth shared
+    by the step builder and the recorded route metadata."""
+    rc = config.arc_scrunch_rows
+    if rc == -1:
+        rc = _AUTO_ARC_SCRUNCH_TPU if _target_is_tpu(mesh) else 0
+    return int(rc)
+
+
+def resolve_routes(config: "PipelineConfig", mesh=None,
+                   batch_shape=None, itemsize: int = 4) -> dict:
+    """The concrete routes this host's execution target resolves the
+    config's ``auto`` knobs to, plus the target platform.
+
+    Record this beside a resumable survey: the matmul and FFT ACF-cut
+    routes differ at f32 contraction rounding, so the same store resumed
+    on a different host class can yield numerically drifted tau/dnu.
+    The CLI writes this dict as store metadata and logs a
+    ``routes_changed`` event when a resume sees a different resolution.
+    """
+    return {"scint_cuts": _resolve_cuts(config.scint_cuts, mesh,
+                                        batch_shape, itemsize),
+            "arc_scrunch_rows": _resolve_arc_scrunch(config, mesh),
+            "target_is_tpu": bool(_target_is_tpu(mesh))}
+
+
+def _bucket_epochs(epochs) -> dict:
+    """Group epoch indices by shape AND axis identity.  The single
+    source of truth for run_pipeline's bucketing (two epochs with equal
+    (nf, nt) but different bands/sampling must not share a pipeline:
+    its df/fc/lambda grid are baked in host-side from the template
+    axes); survey_routes uses the same grouping so recorded routes
+    describe exactly the batches that get traced."""
+    from collections import defaultdict
+
+    buckets: dict[tuple, list[int]] = defaultdict(list)
+    for i, d in enumerate(epochs):
+        f = np.asarray(d.freqs, dtype=np.float64)
+        t = np.asarray(d.times, dtype=np.float64)
+        buckets[(f.shape, t.shape, f.tobytes(), t.tobytes())].append(i)
+    return buckets
+
+
+def _adjust_chunk(multiple: int, chunk: int) -> int:
+    """Largest mesh-divisible chunk size <= chunk (but >= one multiple);
+    shared by run_pipeline's chunk loop and survey_routes."""
+    return max(multiple, (chunk // multiple) * multiple)
+
+
+def _step_batch_sizes(B: int, multiple: int, chunk: int | None) -> set:
+    """The set of per-step batch sizes run_pipeline's chunk loop issues
+    for a padded bucket of B epochs (an uneven final chunk traces as its
+    own program and may resolve auto routes differently)."""
+    if chunk is None or chunk >= B:
+        return {B}
+    c = _adjust_chunk(multiple, chunk)
+    return {c} | ({B % c} if B % c else set())
+
+
+def survey_routes(epochs, config: "PipelineConfig", mesh=None,
+                  chunk: int | None = None) -> dict:
+    """Per-bucket resolved routes for a ``run_pipeline`` call with the
+    same arguments — the metadata the CLI records beside a resumable
+    store.  Shares run_pipeline's bucketing (_bucket_epochs),
+    divisibility padding and chunk math (_step_batch_sizes) so the
+    recorded ``scint_cuts`` matches what each traced step actually
+    resolves (the auto route depends on the per-step padded batch shape
+    through the Gram byte cap).
+
+    Keys are descriptive (``bucket<k>:<n>of<nf>x<nt>:step<b>``) and
+    depend on batch composition; drift comparisons must compare the
+    route *values* (see the CLI's resume check), not the keys.
+    """
+    multiple = 1
+    if mesh is not None:
+        multiple = mesh.shape[mesh_mod.DATA_AXIS]
+    out = {}
+    for k, (key, idx) in enumerate(_bucket_epochs(epochs).items()):
+        (nf,), (nt,) = key[0], key[1]
+        n = len(idx)
+        B = -(-n // multiple) * multiple
+        for b in sorted(_step_batch_sizes(B, multiple, chunk)):
+            out[f"bucket{k}:{n}of{nf}x{nt}:step{b}"] = resolve_routes(
+                config, mesh, batch_shape=(b, nf, nt))
+    return out
+
+
 @functools.lru_cache(maxsize=None)
 def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
     import jax
@@ -346,9 +433,7 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
                         [f.profile_power for f in fits], axis=1))
 
             return multi
-        rc = config.arc_scrunch_rows
-        if rc == -1:
-            rc = _AUTO_ARC_SCRUNCH_TPU if _target_is_tpu(mesh) else 0
+        rc = _resolve_arc_scrunch(config, mesh)
         return make_arc_fitter(
             fdop=fdop, yaxis=beta if config.lamsteps else tdel, tdel=tdel,
             freq=fc, lamsteps=config.lamsteps, method=config.arc_method,
@@ -443,24 +528,13 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
     every [B]-leading result leaf is epoch ``indices[k]`` (divisibility
     pad-lanes are sliced off before returning).
     """
-    from collections import defaultdict
-
     from .batch import pad_batch
 
     multiple = 1
     if mesh is not None:
         multiple = mesh.shape[mesh_mod.DATA_AXIS]
-    # Bucket on shape AND axis identity: two epochs with equal (nf, nt) but
-    # different bands/sampling must not share a pipeline (its df/fc/lambda
-    # grid are baked in host-side from the template axes).
-    buckets: dict[tuple, list[int]] = defaultdict(list)
-    for i, d in enumerate(epochs):
-        f = np.asarray(d.freqs, dtype=np.float64)
-        t = np.asarray(d.times, dtype=np.float64)
-        key = (f.shape, t.shape, f.tobytes(), t.tobytes())
-        buckets[key].append(i)
     results = []
-    for idx in buckets.values():
+    for idx in _bucket_epochs(epochs).values():
         group = [epochs[i] for i in idx]
         batch, _mask = pad_batch(group, batch_multiple=multiple)
         step = make_pipeline(np.asarray(group[0].freqs),
@@ -472,7 +546,7 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
             res = step(dyn)
         else:
             # memory-bounded chunking; chunk must respect mesh divisibility
-            c = max(multiple, (chunk // multiple) * multiple)
+            c = _adjust_chunk(multiple, chunk)
             if c != chunk:
                 import warnings
 

@@ -80,6 +80,27 @@ class ResultsStore:
     def records(self) -> list[dict]:
         return [r for k in self.keys() if (r := self.get(k)) is not None]
 
+    def put_meta(self, name: str, record: dict) -> None:
+        """Run metadata (e.g. the resolved auto cuts/scrunch routes),
+        kept outside the results namespace: files are ``meta.<name>``
+        (no ``.json``), so ``keys()``/``records()``/CSV export never see
+        them.  Atomic like ``put``; the tmp name is per-process so two
+        CLI runs sharing a store cannot interleave half-writes."""
+        path = os.path.join(self.dir, f"meta.{name}")
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(record, fh)
+        os.replace(tmp, path)
+
+    def get_meta(self, name: str) -> dict | None:
+        """Metadata is diagnostic: a missing OR unreadable/corrupt file
+        degrades to None rather than failing the run that asked."""
+        try:
+            with open(os.path.join(self.dir, f"meta.{name}")) as fh:
+                return json.load(fh)
+        except (OSError, ValueError):
+            return None
+
     def pending(self, items: Sequence, keyfn: Callable) -> list:
         """Items whose key is not yet in the store (the resume filter)."""
         return [it for it in items if keyfn(it) not in self]

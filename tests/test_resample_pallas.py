@@ -64,3 +64,36 @@ def test_row_scrunch_shape_validation():
     with pytest.raises(ValueError, match="shape mismatch"):
         row_scrunch_pallas(np.zeros((4, 8)), np.zeros((3, 5), np.int32),
                            np.zeros((3, 5)), interpret=True)
+    with pytest.raises(ValueError, match=">= 2 columns"):
+        row_scrunch_pallas(np.zeros((4, 1)), np.zeros((4, 5), np.int32),
+                           np.zeros((4, 5)), interpret=True)
+
+
+def test_row_scrunch_out_of_range_clamps_to_edge():
+    """Out-of-range gather indices (caller bug / degenerate pattern) must
+    read the edge sample — clamp semantics, matching XLA's clamped
+    take_along_axis — instead of issuing UB gathers on real Mosaic."""
+    rng = np.random.default_rng(5)
+    R, C, n = 6, 16, 8
+    rows = rng.standard_normal((R, C))
+    i0, w = _pattern(R, C, n)
+    i0[0, 0], w[0, 0] = -3, 0.7          # below range -> rows[:, 0]
+    i0[1, 1], w[1, 1] = C - 1, 0.4       # above range -> rows[:, C-1]
+    i0[2, 2], w[2, 2] = C + 5, 0.0
+    ref_i0 = np.clip(i0, 0, C - 2)
+    ref_w = np.where(i0 > C - 2, 1.0, np.where(i0 < 0, 0.0, w))
+    want = _reference_scrunch(rows, ref_i0, ref_w)
+    got = np.asarray(row_scrunch_pallas(rows, i0, w, block_r=4,
+                                        interpret=True))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7,
+                               equal_nan=True)
+    # a NaN edge NEIGHBOUR poisons the clamped lane through the lerp
+    # (NaN*0 is NaN) — the bit-compat contract with the production
+    # paths' math, NOT full select-the-edge-sample semantics
+    rows2 = rows.copy()
+    rows2[:, C - 2] = np.nan
+    want2 = _reference_scrunch(rows2, ref_i0, ref_w)
+    got2 = np.asarray(row_scrunch_pallas(rows2, i0, w, block_r=4,
+                                         interpret=True))
+    np.testing.assert_allclose(got2, want2, rtol=1e-6, atol=1e-7,
+                               equal_nan=True)

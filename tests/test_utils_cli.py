@@ -109,6 +109,63 @@ def test_store_resume_and_export(tmp_path):
     assert len(lines) == 3
 
 
+def test_store_meta_outside_results_namespace(tmp_path):
+    # run metadata (resolved auto routes) must never leak into keys(),
+    # records() or CSV export, and must round-trip
+    store = ResultsStore(str(tmp_path / "store"))
+    store.put("abcd", {"name": "x", "mjd": 1, "freq": 1400, "bw": 64,
+                       "tobs": 600, "dt": 8, "df": 0.5})
+    store.put_meta("routes", {"scint_cuts": "fft", "arc_scrunch_rows": 0,
+                              "target_is_tpu": False})
+    assert store.get_meta("routes")["scint_cuts"] == "fft"
+    assert store.get_meta("nope") is None
+    # corrupt metadata degrades to None (diagnostic-only: must never
+    # fail the run that asked)
+    with open(tmp_path / "store" / "meta.routes", "w") as fh:
+        fh.write('{"half": ')
+    assert store.get_meta("routes") is None
+    assert store.keys() == ["abcd"]
+    assert len(store.records()) == 1
+    csv_fn = str(tmp_path / "out.csv")
+    assert store.export_csv(csv_fn, full=True) == 1
+
+
+def test_resolve_routes_cpu():
+    from scintools_tpu.parallel import PipelineConfig, resolve_routes
+
+    r = resolve_routes(PipelineConfig(), mesh=None)
+    # on the CPU test platform every auto knob resolves to the CPU route
+    assert r == {"scint_cuts": "fft", "arc_scrunch_rows": 0,
+                 "target_is_tpu": False}
+    # explicit settings pass through unchanged
+    r2 = resolve_routes(PipelineConfig(scint_cuts="matmul",
+                                       arc_scrunch_rows=32), mesh=None)
+    assert r2["scint_cuts"] == "matmul" and r2["arc_scrunch_rows"] == 32
+
+
+def test_survey_routes_mirrors_bucketing():
+    from types import SimpleNamespace
+
+    from scintools_tpu.parallel import PipelineConfig, survey_routes
+
+    def ep(nf, nt, f0=1000.0):
+        return SimpleNamespace(freqs=f0 + np.arange(nf) * 0.5,
+                               times=np.arange(nt) * 8.0)
+
+    # two shape buckets + one axis-identity split within a shape
+    epochs = [ep(64, 32), ep(64, 32), ep(64, 32, f0=1400.0), ep(32, 16)]
+    routes = survey_routes(epochs, PipelineConfig(), mesh=None)
+    assert sorted(routes) == ["bucket0:2of64x32:step2",
+                              "bucket1:1of64x32:step1",
+                              "bucket2:1of32x16:step1"]
+    assert all(r["scint_cuts"] == "fft" for r in routes.values())
+    # chunking: uneven final chunk traces separately and is recorded
+    routes_c = survey_routes([ep(64, 32)] * 5, PipelineConfig(),
+                             mesh=None, chunk=2)
+    assert sorted(routes_c) == ["bucket0:5of64x32:step1",   # remainder
+                                "bucket0:5of64x32:step2"]
+
+
 def test_content_key_sensitivity(tmp_path):
     fn = str(tmp_path / "f.bin")
     open(fn, "wb").write(b"hello")
