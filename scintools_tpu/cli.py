@@ -240,16 +240,24 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
             failed += len(epochs)
             buckets = []
         if buckets and store is not None:
-            # baseline only updates on a run that produced results, and
-            # drift compares route VALUES (keys embed batch composition,
-            # which legitimately shrinks on every partial resume)
-            prev = store.get_meta("routes")
-            vals = lambda r: sorted(  # noqa: E731
-                {tuple(sorted(v.items())) for v in r.values()})
-            if prev is not None and vals(prev) != vals(routes):
+            # Baseline only updates on a run that produced results.
+            # Drift means: a bucket key BOTH runs resolved (identical
+            # composition must resolve identically), or a change in the
+            # composition-free fields (target platform, scrunch route).
+            # scint_cuts on non-shared keys is NOT drift — the auto cut
+            # legitimately depends on the per-step batch shape, which
+            # shrinks on every partial resume.
+            prev = store.get_meta("routes") or {}
+            cf = lambda r: {(v["target_is_tpu"],  # noqa: E731
+                             v["arc_scrunch_rows"]) for v in r.values()}
+            if prev and (any(prev[k] != routes[k]
+                             for k in set(prev) & set(routes))
+                         or cf(prev) != cf(routes)):
                 log_event(log, "routes_changed", previous=prev,
                           current=routes)
-            store.put_meta("routes", routes)
+            # merge so a partial resume never erases the full-survey
+            # baseline
+            store.put_meta("routes", {**prev, **routes})
         for indices, res in buckets:
             for lane, idx in enumerate(indices):
                 row = results_row(epochs[idx])

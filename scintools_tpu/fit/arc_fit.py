@@ -151,6 +151,14 @@ def _walk(filt: np.ndarray, ind: int, threshold: float) -> tuple[int, int]:
     return ind1, ind2
 
 
+# Relative power variation below which a parabola-fit window counts as
+# flat (degenerate).  Degenerate windows sit ~14 orders below this (f.p.
+# dust on a constant profile); real weak arcs sit ~7 orders above
+# (>= 0.01 dB structure), so both backends — whose window values are
+# bit-identical — always make the same call.
+_FLAT_WINDOW_TOL = 1e-9
+
+
 def _check_profile_size(profile, nsmooth: int) -> None:
     """Informative failure for profiles too short to smooth/fit
     (np.size: robust to the 0-d arrays `.squeeze()` produces when only
@@ -197,6 +205,28 @@ def _measure_peak(eta_array, power, filt, noise, constraint,
             f"arc peak at grid index {peak_ind} leaves no points for the "
             f"parabola fit — peak is at the eta-grid edge (widen "
             f"etamin/etamax or the constraint window)")
+    # Flat-window degeneracy guard (INTENDED deviation from the
+    # reference, which happily returns the vertex): when the windowed
+    # power is constant to ~f.p. dust, the parabola's a and b are pure
+    # rounding noise, so the vertex, its sign, and even the
+    # forward-parabola check are nondeterministic across BLAS
+    # implementations (the reference's np.polyfit SVD gives a third
+    # value again).  This happens systematically on non-lamsteps
+    # norm_sspec fits: the double eta conversion
+    # (dynspec.py:498-499 then 820-825) shrinks eta by beta_to_eta^2
+    # ~ 2e-8, so every resample scale is ~4 orders past the fdop grid
+    # and all bins clamp to the row-edge mean.  The threshold is
+    # decided on profile values that are BIT-IDENTICAL across
+    # backends, so numpy's raise and the batched fitter's NaN
+    # quarantine always agree.
+    if np.ptp(ydata) <= _FLAT_WINDOW_TOL * max(1.0,
+                                               abs(np.max(ydata))):
+        raise ValueError(
+            "curvature profile is flat across the fit window to "
+            "floating-point precision — the parabola vertex would be "
+            "rounding noise (non-lamsteps norm_sspec fits hit this "
+            "systematically: the reference's double eta conversion "
+            "clamps every resampled bin to the row edges)")
     fitter = fit_log_parabola if log_fit else fit_parabola
     yfit, eta, etaerr_fit = fitter(xdata, ydata, xp=np)
     if np.mean(np.gradient(np.diff(yfit))) > 0:
@@ -780,8 +810,16 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         # up as plain-vs-sharded nondeterminism); or a forward
         # (upward-opening) fitted parabola, which the reference raises
         # on unconditionally (dynspec.py:598), not just for arms
+        # flat-window degeneracy: windowed power constant to f.p. dust
+        # makes the vertex rounding noise — numpy raises there (see
+        # _measure_peak); the decision reads bit-identical profile
+        # values, so the two backends always agree
+        y_hi = jnp.max(jnp.where(wmask, avg_c, -jnp.inf))
+        y_lo = jnp.min(jnp.where(wmask, avg_c, jnp.inf))
+        flat = ((y_hi - y_lo)
+                <= _FLAT_WINDOW_TOL * jnp.maximum(1.0, jnp.abs(y_hi)))
         bad = ((nv < nsmooth) | ~jnp.any(search)
-               | (jnp.sum(w > 0) < 3) | (g_mean > 0))
+               | (jnp.sum(w > 0) < 3) | (g_mean > 0) | flat)
         eta = jnp.where(bad, jnp.nan, eta)
         etaerr = jnp.where(bad, jnp.nan, etaerr)
         # the whole fit is absent on the numpy path (raise): etaerr2

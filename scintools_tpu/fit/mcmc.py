@@ -96,6 +96,262 @@ def ensemble_sample(log_prob_fn, p0, key=None, steps: int = 500,
     return run(key, p0, *data_args)
 
 
+def _posterior_summary(chain, burn, ndim):
+    """Post-burn medians and stds, chain flattened over walkers."""
+    post = np.asarray(chain[burn:]).reshape(-1, ndim)
+    return np.median(post, axis=0), np.std(post, axis=0), post
+
+
+@functools.lru_cache(maxsize=32)
+def _scint2d_sampler_cached(crop_t: int, crop_f: int,
+                            alpha: float | None, nwalkers: int,
+                            steps: int):
+    """Sampler for the 2-D ACF posterior (tau, dnu, amp, wn, tilt
+    [, alpha]), cached on static shapes; the window, lag grids, taper
+    scales and noise scale are traced arguments."""
+    import jax.numpy as jnp
+
+    from ..models.acf_models import scint_acf_model_2d
+
+    free = alpha is None
+
+    def log_prob(p, win, x_t, x_f, tmax, fmax, sigma):
+        tau, dnu, amp, wn, tilt = p[0], p[1], p[2], p[3], p[4]
+        a_ = p[5] if free else alpha
+        inside = (tau > 0) & (dnu > 0) & (amp > 0) & (wn >= 0)
+        if free:
+            inside = inside & (a_ > 0) & (a_ < 8.0)
+        m = scint_acf_model_2d(x_t, x_f, tau, dnu, amp, wn, a_, tilt,
+                               tmax=tmax, fmax=fmax, xp=jnp)
+        chi2 = jnp.sum(((win - m) / sigma) ** 2)
+        return jnp.where(inside, -0.5 * chi2, -jnp.inf)
+
+    return _build_sampler(6 if free else 5, nwalkers, steps, 2.0,
+                          log_prob)
+
+
+def fit_scint_params_2d_mcmc(acf2d, dt, df, nchan: int, nsub: int,
+                             alpha: float | None = 5 / 3,
+                             crop_frac: float = 0.5, nwalkers: int = 32,
+                             steps: int = 600, burn: int = 300,
+                             seed: int = 0, return_chain: bool = False):
+    """Posterior over the 2-D ACF model incl. phase-gradient tilt — the
+    ``mcmc=True`` analogue of :func:`fit.scint_fit.fit_scint_params_2d`
+    (reference surface: get_scint_params mcmc, dynspec.py:989-992,
+    extended to the acf2d method it never finished).
+
+    Returns (ScintParams, tilt, tilterr) with posterior medians/stds
+    (plus the post-burn chain when ``return_chain``: columns
+    tau, dnu, amp, wn, tilt[, alpha]).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.acf_models import scint_acf_model_2d
+    from .scint_fit import _crop_acf_2d, acf_lags_2d, fit_scint_params_2d
+
+    if burn >= steps:
+        raise ValueError(f"burn ({burn}) must be < steps ({steps})")
+    free = alpha is None
+    lm_sp, lm_tilt, _ = fit_scint_params_2d(acf2d, dt, df, nchan, nsub,
+                                            alpha=alpha, backend="numpy",
+                                            crop_frac=crop_frac)
+    alpha_best = float(np.asarray(lm_sp.talpha))
+    p_best = np.array([float(lm_sp.tau), float(lm_sp.dnu),
+                       float(lm_sp.amp), float(lm_sp.wn), float(lm_tilt)]
+                      + ([alpha_best] if free else []))
+    ndim = len(p_best)
+    a = np.asarray(acf2d, dtype=np.float64)
+    crop_t = max(2, int(nsub * crop_frac / 2))
+    crop_f = max(2, int(nchan * crop_frac / 2))
+    win = _crop_acf_2d(a, nchan, nsub, crop_t, crop_f)
+    x_t, x_f = acf_lags_2d(float(dt), float(abs(df)), crop_t, crop_f,
+                           xp=np)
+    tmax, fmax = float(dt) * nsub, float(abs(df)) * nchan
+    resid = win - scint_acf_model_2d(
+        x_t, x_f, p_best[0], p_best[1], p_best[2], p_best[3],
+        alpha_best, p_best[4], tmax=tmax, fmax=fmax, xp=np)
+    sigma = max(float(np.std(resid)), 1e-12)
+
+    rng = np.random.default_rng(seed)
+    p0 = p_best * (1.0 + 0.01 * rng.standard_normal((nwalkers, ndim)))
+    # keep positivity-constrained dims inside the prior; tilt may be 0
+    # or negative, so jitter it additively instead
+    p0[:, :4] = np.abs(p0[:, :4]) + 1e-12
+    p0[:, 4] = p_best[4] + 0.01 * rng.standard_normal(nwalkers)
+    run = _scint2d_sampler_cached(crop_t, crop_f,
+                                  None if free else float(alpha),
+                                  int(nwalkers), int(steps))
+    chain, _ = run(jax.random.PRNGKey(seed), jnp.asarray(p0),
+                   jnp.asarray(win), jnp.asarray(x_t), jnp.asarray(x_f),
+                   jnp.asarray(tmax), jnp.asarray(fmax),
+                   jnp.asarray(sigma))
+    med, std, _ = _posterior_summary(chain, burn, ndim)
+    sp = ScintParams(tau=med[0], tauerr=std[0], dnu=med[1],
+                     dnuerr=std[1], amp=med[2], wn=med[3],
+                     talpha=med[5] if free else alpha,
+                     talphaerr=std[5] if free else None,
+                     redchi=float(np.asarray(lm_sp.redchi)))
+    out = (sp, float(med[4]), float(std[4]))
+    if return_chain:
+        return out + (np.asarray(chain[burn:]),)
+    return out
+
+
+@functools.lru_cache(maxsize=32)
+def _sspec_sampler_cached(nt: int, nf: int, alpha: float | None,
+                          nwalkers: int, steps: int):
+    """Sampler for the Fourier-domain (sspec-method) posterior."""
+    import jax.numpy as jnp
+
+    from ..models.acf_models import scint_sspec_model
+
+    free = alpha is None
+
+    def log_prob(p, x_t, x_f, y, sigma):
+        tau, dnu, amp, wn = p[0], p[1], p[2], p[3]
+        a_ = p[4] if free else alpha
+        inside = (tau > 0) & (dnu > 0) & (amp > 0) & (wn >= 0)
+        if free:
+            inside = inside & (a_ > 0) & (a_ < 8.0)
+        m = scint_sspec_model(x_t, x_f, tau, dnu, amp, wn, a_, xp=jnp)
+        chi2 = jnp.sum(((y - m) / sigma) ** 2)
+        return jnp.where(inside, -0.5 * chi2, -jnp.inf)
+
+    return _build_sampler(5 if free else 4, nwalkers, steps, 2.0,
+                          log_prob)
+
+
+def fit_scint_params_sspec_mcmc(acf2d, dt, df, nchan: int, nsub: int,
+                                alpha: float | None = 5 / 3,
+                                nwalkers: int = 32, steps: int = 600,
+                                burn: int = 300, seed: int = 0,
+                                return_chain: bool = False):
+    """Posterior tau/dnu in the Fourier (power-spectrum) domain — the
+    ``mcmc=True`` analogue of fit_scint_params_sspec (the reference's
+    unfinished 'sspec' method, dynspec.py:953-957)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.acf_models import mirror_spectrum, scint_sspec_model
+    from .scint_fit import acf_cuts, fit_scint_params_sspec
+
+    if burn >= steps:
+        raise ValueError(f"burn ({burn}) must be < steps ({steps})")
+    free = alpha is None
+    lm = fit_scint_params_sspec(acf2d, dt, df, nchan, nsub, alpha=alpha,
+                                backend="numpy")
+    alpha_best = float(np.asarray(lm.talpha))
+    p_best = np.array([float(lm.tau), float(lm.dnu), float(lm.amp),
+                       float(lm.wn)] + ([alpha_best] if free else []))
+    ndim = len(p_best)
+    a = np.asarray(acf2d, dtype=np.float64)
+    x_t, y_t, x_f, y_f = acf_cuts(a, dt, abs(df), nchan, nsub, xp=np)
+    y = np.concatenate([mirror_spectrum(y_t, xp=np),
+                        mirror_spectrum(y_f, xp=np)])
+    resid = y - scint_sspec_model(x_t, x_f, *p_best[:4], alpha_best,
+                                  xp=np)
+    sigma = max(float(np.std(resid)), 1e-12)
+
+    rng = np.random.default_rng(seed)
+    p0 = p_best * (1.0 + 0.01 * rng.standard_normal((nwalkers, ndim)))
+    p0 = np.abs(p0) + 1e-12
+    run = _sspec_sampler_cached(len(x_t), len(x_f),
+                                None if free else float(alpha),
+                                int(nwalkers), int(steps))
+    chain, _ = run(jax.random.PRNGKey(seed), jnp.asarray(p0),
+                   jnp.asarray(x_t), jnp.asarray(x_f), jnp.asarray(y),
+                   jnp.asarray(sigma))
+    med, std, _ = _posterior_summary(chain, burn, ndim)
+    out = ScintParams(tau=med[0], tauerr=std[0], dnu=med[1],
+                      dnuerr=std[1], amp=med[2], wn=med[3],
+                      talpha=med[4] if free else alpha,
+                      talphaerr=std[4] if free else None,
+                      redchi=float(np.asarray(lm.redchi)))
+    if return_chain:
+        return out, np.asarray(chain[burn:])
+    return out
+
+
+def fit_arc_curvature_mcmc(eta_obs, mjds, pars: dict, raj: float,
+                           decj: float,
+                           fit_keys=("s", "vism_psi"), etaerr=None,
+                           nwalkers: int = 32, steps: int = 800,
+                           burn: int = 400, seed: int = 0,
+                           return_chain: bool = False):
+    """Posterior over screen parameters from a curvature time series —
+    the ``mcmc=True`` analogue of fit.fit_arc_curvature (reference
+    surface: the lmfit-emcee option of its arc_curvature residuals,
+    scint_models.py:266-315).
+
+    Uniform box priors from the fitter's bounds; the likelihood noise
+    scale comes from ``etaerr`` when given, else from the LM solution's
+    residual std.  Returns (best dict, errors dict, post-burn chain |
+    None) with posterior medians/stds for the fitted keys.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..astro import get_earth_velocity, get_true_anomaly
+    from ..models.velocity import arc_curvature_residuals
+    from .curvature_fit import _BOUNDS, fit_arc_curvature
+
+    if burn >= steps:
+        raise ValueError(f"burn ({burn}) must be < steps ({steps})")
+    fit_keys = tuple(fit_keys)
+    eta_obs = np.asarray(eta_obs, dtype=np.float64)
+    mjds = np.asarray(mjds, dtype=np.float64)
+    best0, _, _ = fit_arc_curvature(eta_obs, mjds, pars, raj, decj,
+                                    fit_keys=fit_keys, etaerr=etaerr,
+                                    backend="numpy")
+    nu = (get_true_anomaly(mjds, pars) if "PB" in pars
+          else np.zeros_like(mjds))
+    v_ra, v_dec = get_earth_velocity(mjds, raj, decj)
+    # the noise scale enters through sigma; the residuals themselves
+    # stay unweighted
+    weights = None
+    if etaerr is not None:
+        sigma = np.asarray(etaerr, dtype=np.float64)
+    else:
+        # unweighted residuals at the LM optimum set the noise scale
+        resid0 = arc_curvature_residuals(best0, eta_obs, None, nu, v_ra,
+                                         v_dec, xp=np)
+        sigma = max(float(np.std(np.asarray(resid0))), 1e-12)
+    fixed = {k: v for k, v in pars.items() if k not in fit_keys}
+    lo = np.array([_BOUNDS[k][0] for k in fit_keys])
+    hi = np.array([_BOUNDS[k][1] for k in fit_keys])
+
+    def log_prob(p, eta, nu_, vra, vdec, sig):
+        trial = dict(fixed, **{k: p[i] for i, k in enumerate(fit_keys)})
+        r = arc_curvature_residuals(trial, eta, weights, nu_, vra, vdec,
+                                    xp=jnp)
+        chi2 = jnp.sum((r / sig) ** 2)
+        inside = jnp.all((p > jnp.asarray(lo)) & (p < jnp.asarray(hi)))
+        return jnp.where(inside, -0.5 * chi2, -jnp.inf)
+
+    ndim = len(fit_keys)
+    rng = np.random.default_rng(seed)
+    p_best = np.array([best0[k] for k in fit_keys])
+    span = hi - lo
+    p0 = np.clip(p_best + 0.01 * span
+                 * rng.standard_normal((nwalkers, ndim)),
+                 lo + 1e-9 * span, hi - 1e-9 * span)
+    chain, _ = ensemble_sample(
+        log_prob, p0, key=jax.random.PRNGKey(seed), steps=steps,
+        data_args=(jnp.asarray(eta_obs), jnp.asarray(nu),
+                   jnp.asarray(v_ra), jnp.asarray(v_dec),
+                   jnp.asarray(sigma)))
+    med, std, _ = _posterior_summary(chain, burn, ndim)
+    best = dict(best0)
+    errors = {}
+    for i, k in enumerate(fit_keys):
+        best[k] = float(med[i])
+        errors[k] = float(std[i])
+    if return_chain:
+        return best, errors, np.asarray(chain[burn:])
+    return best, errors, None
+
+
 @functools.lru_cache(maxsize=32)
 def _scint_sampler_cached(nt: int, nf: int, alpha: float | None,
                           nwalkers: int, steps: int):

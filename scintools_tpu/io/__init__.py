@@ -1,4 +1,4 @@
-from .archive import clean_archive  # noqa: F401
+from .archive import clean_archive, make_dynspec  # noqa: F401
 from .adapters import (concatenate_time, from_arrays, from_matlab,  # noqa: F401
                        from_simulation)
 from .parfile import pars_to_params, read_par  # noqa: F401

@@ -43,3 +43,53 @@ def clean_archive(archive, template: str | None = None,
         f"badchantol={bandwagon},badsubtol=1.0")
     bandwagon_cleaner.run(archive)
     return archive
+
+
+def make_dynspec(archive: str, template: str | None = None,
+                 phasebin: int = 1, outdir: str | None = None) -> str:
+    """Create a psrflux-format dynamic spectrum from a folded archive by
+    shelling out to psrchive's ``psrflux`` (the command the reference's
+    empty stub documents: ``psrflux -s [template] -e dynspec [archive]``,
+    scint_utils.py:431-437 — implemented for real here, gated on the
+    observatory stack like :func:`clean_archive`).
+
+    ``archive`` is a path to a psrchive archive file.  Returns the path
+    of the written ``<archive>.dynspec`` (in ``outdir`` when given,
+    which psrflux creates the file into via ``-D``).  Requires the
+    ``psrflux`` executable on PATH; raises RuntimeError with guidance
+    otherwise.  The result loads with ``io.psrflux.read_psrflux``.
+    """
+    import os
+    import shutil
+    import subprocess
+
+    if shutil.which("psrflux") is None:
+        raise RuntimeError(
+            "make_dynspec shells out to psrchive's `psrflux`, which is "
+            "not on PATH. Install psrchive (observatory stack), or "
+            "produce .dynspec files elsewhere and ingest them with "
+            "io.psrflux.read_psrflux.")
+    if phasebin != 1:
+        raise NotImplementedError(
+            "phasebin != 1 needs a pre-bscrunched archive: run "
+            "`pam --setnbin <phasebin>` first (the reference stub never "
+            "implemented this either, scint_utils.py:431-437)")
+    cmd = ["psrflux"]
+    if template is not None:
+        cmd += ["-s", template]
+    cmd += ["-e", "dynspec", archive]
+    if outdir is not None:
+        cmd += ["-D", outdir]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+    except subprocess.CalledProcessError as e:
+        err = (e.stderr or b"").decode(errors="replace").strip()
+        raise RuntimeError(
+            f"psrflux failed (exit {e.returncode}) on {archive!r}:"
+            f"\n{err}") from e
+    out = archive + ".dynspec"
+    if outdir is not None:
+        out = os.path.join(outdir, os.path.basename(out))
+    if not os.path.exists(out):
+        raise RuntimeError(f"psrflux ran but {out!r} was not written")
+    return out

@@ -3,5 +3,7 @@ from .acf_models import (dnu_acf_model, dnu_sspec_model,  # noqa: F401
                          tau_sspec_model)
 from .parabola import (fit_log_parabola, fit_parabola, masked_ptp,  # noqa: F401
                        polyfit2_cov)
+from .power_curve import (arc_power_curve, arc_power_curve_model,  # noqa: F401
+                          fit_arc_power_curve)
 from .velocity import (arc_curvature_model, arc_curvature_residuals,  # noqa: F401
                        effective_velocity_annual, thin_screen_veff)

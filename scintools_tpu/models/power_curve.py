@@ -1,0 +1,107 @@
+"""Arc power-curve template.
+
+Reference: ``arc_power_curve`` (scint_models.py:191-201) — an EMPTY stub
+there (``model = []``); the docstring promises "a template for the power
+curve in secondary spectrum vs sqrt(curvature) or normalised fdop".
+Implemented for real here (SURVEY.md §2.2): the delay-scrunched power
+profile decays as a power law above a noise floor, so the template in
+linear power is ``amp * |x|^(-index) + floor``, evaluated in the dB
+space the profiles are measured in (norm_sspec's ``powerspec`` output is
+``nanmean`` of dB rows, plotted log-log vs sqrt(tdel) —
+dynspec.py:863,728-735).
+
+``arc_power_curve`` keeps the reference's residual calling convention
+(params, xdata, ydata, weights) so lmfit-style callers port directly,
+while :func:`fit_arc_power_curve` drives it with the framework's
+fixed-iteration LM over an ArcFit/NormSspec profile.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["arc_power_curve_model", "arc_power_curve",
+           "fit_arc_power_curve"]
+
+
+def arc_power_curve_model(x, amp, index, floor, xp=np):
+    """Template power curve in dB vs sqrt(curvature) or normalised fdop:
+    a power-law decay ``amp * |x|^(-index)`` over a noise floor, in dB.
+
+    ``amp``/``floor`` are linear powers (floor >= 0); x must be > 0
+    (profiles are measured on positive sqrt-eta / |fdop| grids).
+    """
+    return 10.0 * xp.log10(amp * xp.abs(x) ** (-index) + floor)
+
+
+def arc_power_curve(params, xdata, ydata=None, weights=None, xp=np):
+    """Reference-signature entry point (scint_models.py:191-201).
+
+    ``params`` is any mapping with keys ``amp``, ``index``, ``floor``
+    (an lmfit ``Parameters`` works via its mapping interface).  With
+    ``ydata`` given, returns the weighted residual ``(ydata - model) *
+    weights`` as the reference's residual convention promises; without
+    it, returns the model template itself.
+    """
+    amp, index, floor = (params["amp"], params["index"], params["floor"])
+    try:  # lmfit Parameter objects carry .value
+        amp, index, floor = amp.value, index.value, floor.value
+    except AttributeError:
+        pass
+    model = arc_power_curve_model(xdata, amp, index, floor, xp=xp)
+    if ydata is None:
+        return model
+    if weights is None:
+        weights = xp.ones_like(xp.asarray(ydata))
+    return (ydata - model) * weights
+
+
+def fit_arc_power_curve(x, power_db, steps: int = 40, backend="numpy"):
+    """Fit the power-curve template to a measured profile.
+
+    ``x`` is the profile's abscissa (sqrt(eta) or normalised fdop, > 0);
+    ``power_db`` the mean power in dB (e.g. ``NormSspec.powerspec`` vs
+    ``sqrt(tdel)``, or an ``ArcFit.profile_power`` vs
+    ``sqrt(profile_eta)``).  NaN bins are dropped.  Returns
+    ``(params, stderr)`` with params ``[amp, index, floor]``.
+    """
+    x = np.asarray(x, dtype=np.float64)
+    y = np.asarray(power_db, dtype=np.float64)
+    ok = np.isfinite(x) & np.isfinite(y) & (x > 0)
+    if ok.sum() < 4:
+        raise ValueError(f"power-curve fit needs >= 4 finite bins with "
+                         f"x > 0, got {int(ok.sum())}")
+    from ..backend import resolve
+
+    backend = resolve(backend)
+    x, y = x[ok], y[ok]
+    # init: log-log slope for the index, head/tail powers for amp/floor
+    ylin = 10.0 ** (y / 10.0)
+    lo = float(np.percentile(ylin, 5))
+    slope = np.polyfit(np.log10(x), y / 10.0, 1)[0]
+    p0 = np.array([max(ylin.max() * x.min() ** max(-slope, 0.0), 1e-12),
+                   max(-slope, 0.1), max(lo, 1e-12)])
+    lb = np.array([1e-300, 0.0, 0.0])
+    ub = np.array([np.inf, 20.0, np.inf])
+
+    if backend == "jax":
+        import jax.numpy as jnp
+
+        from ..fit.lm import lm_fit_jax
+
+        def resid(p, xj, yj):
+            return yj - arc_power_curve_model(xj, p[0], p[1], p[2],
+                                              xp=jnp)
+
+        res = lm_fit_jax(resid, p0, bounds=(lb, ub),
+                         args=(jnp.asarray(x), jnp.asarray(y)),
+                         steps=steps)
+    else:
+        from ..fit.lm import least_squares_numpy
+
+        def resid(p, xn, yn):
+            return yn - arc_power_curve_model(xn, p[0], p[1], p[2])
+
+        res = least_squares_numpy(resid, p0, bounds=(lb, ub),
+                                  args=(x, y))
+    return np.asarray(res.params), np.asarray(res.stderr)

@@ -414,30 +414,42 @@ class Dynspec:
         b = resolve(backend or self.backend)
         kw = dict(dt=self._data.dt, df=abs(self._data.df),
                   nchan=self._data.nchan, nsub=self._data.nsub)
-        if mcmc and method != "acf1d":
-            raise NotImplementedError(
-                "mcmc=True is only implemented for method='acf1d' "
-                "(posterior sampling of the 1-D ACF-cuts model)")
-
+        # mcmc=True stores the post-burn chain as ``self.mcmc_chain``
+        # for plotting.plot_posterior (the reference's corner export,
+        # dynspec.py:1025-1031)
         if method == "acf1d":
             if mcmc:
                 from .fit.mcmc import fit_scint_params_mcmc
 
-                sp = fit_scint_params_mcmc(self.acf, alpha=alpha, **kw)
+                sp, self.mcmc_chain = fit_scint_params_mcmc(
+                    self.acf, alpha=alpha, return_chain=True, **kw)
             else:
                 sp = _fit_scint_params(self.acf, alpha=alpha, backend=b,
                                        **kw)
         elif method == "acf2d":
-            from .fit.scint_fit import fit_scint_params_2d
+            if mcmc:
+                from .fit.mcmc import fit_scint_params_2d_mcmc
 
-            sp, tilt, tilterr = fit_scint_params_2d(self.acf, alpha=alpha,
-                                                    backend=b, **kw)
+                sp, tilt, tilterr, self.mcmc_chain = \
+                    fit_scint_params_2d_mcmc(self.acf, alpha=alpha,
+                                             return_chain=True, **kw)
+            else:
+                from .fit.scint_fit import fit_scint_params_2d
+
+                sp, tilt, tilterr = fit_scint_params_2d(
+                    self.acf, alpha=alpha, backend=b, **kw)
             self.tilt, self.tilterr = tilt, tilterr
         elif method == "sspec":
-            from .fit.scint_fit import fit_scint_params_sspec
+            if mcmc:
+                from .fit.mcmc import fit_scint_params_sspec_mcmc
 
-            sp = fit_scint_params_sspec(self.acf, alpha=alpha, backend=b,
-                                        **kw)
+                sp, self.mcmc_chain = fit_scint_params_sspec_mcmc(
+                    self.acf, alpha=alpha, return_chain=True, **kw)
+            else:
+                from .fit.scint_fit import fit_scint_params_sspec
+
+                sp = fit_scint_params_sspec(self.acf, alpha=alpha,
+                                            backend=b, **kw)
         else:
             raise ValueError(f"unknown method {method!r}; use 'acf1d', "
                              "'acf2d' or 'sspec'")

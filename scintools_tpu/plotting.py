@@ -213,6 +213,73 @@ def plot_arc_profile(fit, ax=None, filename: str | None = None,
     return _finish(fig, filename, display)
 
 
+def plot_posterior(chain, labels=None, truths=None, bins: int = 40,
+                   filename: str | None = None, display: bool = False):
+    """Corner plot of an MCMC chain — the posterior export the reference
+    gets from the ``corner`` package after ``lmfit.Minimizer.emcee``
+    (dynspec.py:1025-1031), rebuilt on bare matplotlib.
+
+    ``chain`` is ``[steps, nwalkers, ndim]`` (as the ``return_chain``
+    outputs of the fit.mcmc samplers) or an already-flat ``[N, ndim]``.
+    Diagonal: marginal histograms with median and ±1σ quantile lines;
+    off-diagonal: 2-D histograms.  ``truths`` draws reference values.
+    """
+    import matplotlib.pyplot as plt
+
+    chain = np.asarray(chain)
+    if chain.ndim == 3:
+        chain = chain.reshape(-1, chain.shape[-1])
+    if chain.ndim != 2:
+        raise ValueError(f"chain must be [steps, walkers, ndim] or "
+                         f"[N, ndim], got shape {chain.shape}")
+    ndim = chain.shape[1]
+    if labels is None:
+        labels = [f"p{i}" for i in range(ndim)]
+    if len(labels) != ndim:
+        raise ValueError(f"{len(labels)} labels for {ndim} parameters")
+    if truths is not None and len(truths) != ndim:
+        raise ValueError(f"{len(truths)} truths for {ndim} parameters")
+    fig, axes = plt.subplots(ndim, ndim,
+                             figsize=(2.2 * ndim, 2.2 * ndim),
+                             squeeze=False)
+    q16, q50, q84 = np.percentile(chain, [16, 50, 84], axis=0)
+    for i in range(ndim):
+        for j in range(ndim):
+            ax = axes[i, j]
+            if j > i:
+                ax.axis("off")
+                continue
+            if i == j:
+                ax.hist(chain[:, i], bins=bins, color="0.6",
+                        histtype="stepfilled")
+                ax.axvline(q50[i], color="k", ls="-", lw=1)
+                ax.axvline(q16[i], color="k", ls="--", lw=0.8)
+                ax.axvline(q84[i], color="k", ls="--", lw=0.8)
+                if truths is not None:
+                    ax.axvline(truths[i], color="r", lw=1)
+                ax.set_yticks([])
+                ax.set_title(f"{labels[i]} = {q50[i]:.3g}"
+                             f"$^{{+{q84[i] - q50[i]:.2g}}}"
+                             f"_{{-{q50[i] - q16[i]:.2g}}}$",
+                             fontsize=9)
+            else:
+                ax.hist2d(chain[:, j], chain[:, i], bins=bins,
+                          cmap="Greys")
+                if truths is not None:
+                    ax.axvline(truths[j], color="r", lw=0.8)
+                    ax.axhline(truths[i], color="r", lw=0.8)
+            if i == ndim - 1:
+                ax.set_xlabel(labels[j])
+            else:
+                ax.set_xticklabels([])
+            if j == 0 and i > 0:
+                ax.set_ylabel(labels[i])
+            elif j > 0:
+                ax.set_yticklabels([])
+    fig.tight_layout()
+    return _finish(fig, filename, display)
+
+
 def plot_all(d: DynspecData, acf2d, sec: SecSpec, fit=None,
              filename: str | None = None, display: bool = False):
     """2x2 summary: dynspec, ACF, secondary spectrum, arc profile

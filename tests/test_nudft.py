@@ -221,9 +221,27 @@ def test_calc_sspec_slowft_feeds_fit_arc(rng):
     assert sec.sspec.shape == (ds._data.nchan // 2, ds._data.nsub)
     assert np.all(np.isfinite(sec.sspec[1:, :]))  # row 0 may hit log10(0)
 
-    slow_fit = fit_arc(sec, freq=float(ds._data.freq), numsteps=2000,
-                       startbin=2, backend="numpy")
+    # tdel-space fits need an explicit etamin that keeps the reference's
+    # double-converted resample scales inside the fdop grid; the default
+    # grid is flat-window degenerate and now quarantines loudly (see
+    # test_fit.test_fit_arc_nonlam_degenerate_quarantine_parity)
+    from scintools_tpu.fit.arc_fit import _beta_to_eta_factor
+
+    freq = float(ds._data.freq)
+    conv = (_beta_to_eta_factor(freq, 1400.0) / (freq / 1400.0) ** 2) ** 2
+    etamin = float(np.max(sec.tdel)) / (float(np.max(sec.fdop)) ** 2
+                                        * conv)
+    slow_fit = fit_arc(sec, freq=freq, numsteps=2000, startbin=2,
+                       backend="numpy", etamin=etamin,
+                       etamax=100 * etamin)
     assert slow_fit.eta > 0 and np.isfinite(slow_fit.etaerr)
+    # interior peak: a real measurement, not the grid-edge noise vertex
+    filt = np.asarray(slow_fit.profile_power_filt)
+    peak = int(np.argmin(np.abs(filt - np.max(filt))))
+    assert 10 < peak < filt.size - 10
+    with pytest.raises(ValueError, match="flat across the fit window"):
+        fit_arc(sec, freq=freq, numsteps=2000, startbin=2,
+                backend="numpy")
 
 
 def test_calc_sspec_slowft_tone_concentrates(rng):
