@@ -425,9 +425,7 @@ def fit_scint_params_mcmc(acf2d, dt, df, nchan: int, nsub: int,
     chain, _ = run(jax.random.PRNGKey(seed), jnp.asarray(p0),
                    jnp.asarray(x_t), jnp.asarray(x_f), jnp.asarray(y),
                    jnp.asarray(sigma))
-    post = np.asarray(chain[burn:]).reshape(-1, ndim)
-    med = np.median(post, axis=0)
-    std = np.std(post, axis=0)
+    med, std, _ = _posterior_summary(chain, burn, ndim)
     out = ScintParams(tau=med[0], tauerr=std[0], dnu=med[1], dnuerr=std[1],
                       amp=med[2], wn=med[3],
                       talpha=med[4] if free else alpha,

@@ -54,10 +54,12 @@ def make_dynspec(archive: str, template: str | None = None,
     observatory stack like :func:`clean_archive`).
 
     ``archive`` is a path to a psrchive archive file.  Returns the path
-    of the written ``<archive>.dynspec`` (in ``outdir`` when given,
-    which psrflux creates the file into via ``-D``).  Requires the
-    ``psrflux`` executable on PATH; raises RuntimeError with guidance
-    otherwise.  The result loads with ``io.psrflux.read_psrflux``.
+    of the written ``<archive>.dynspec`` (moved into ``outdir`` when
+    given — psrflux itself always writes beside the archive, so the
+    relocation happens host-side rather than through version-dependent
+    psrflux flags).  Requires the ``psrflux`` executable on PATH; raises
+    RuntimeError with guidance otherwise.  The result loads with
+    ``io.psrflux.read_psrflux``.
     """
     import os
     import shutil
@@ -78,8 +80,6 @@ def make_dynspec(archive: str, template: str | None = None,
     if template is not None:
         cmd += ["-s", template]
     cmd += ["-e", "dynspec", archive]
-    if outdir is not None:
-        cmd += ["-D", outdir]
     try:
         subprocess.run(cmd, check=True, capture_output=True)
     except subprocess.CalledProcessError as e:
@@ -88,8 +88,11 @@ def make_dynspec(archive: str, template: str | None = None,
             f"psrflux failed (exit {e.returncode}) on {archive!r}:"
             f"\n{err}") from e
     out = archive + ".dynspec"
-    if outdir is not None:
-        out = os.path.join(outdir, os.path.basename(out))
     if not os.path.exists(out):
         raise RuntimeError(f"psrflux ran but {out!r} was not written")
+    if outdir is not None:
+        os.makedirs(outdir, exist_ok=True)
+        dest = os.path.join(outdir, os.path.basename(out))
+        os.replace(out, dest)
+        out = dest
     return out

@@ -144,6 +144,18 @@ def lambda_resample_matrix(freqs: np.ndarray) -> tuple[np.ndarray, np.ndarray, f
     return W[::-1].copy(), lam_eq[::-1].copy(), float(dlam)
 
 
+def _resolve_chan_sharded(mesh, chan_sharded: bool | None) -> bool:
+    """chan_sharded=None derivation rule — the single source of truth
+    for make_pipeline's in_shardings and run_pipeline's host-side
+    global-array assembly (they must agree, or multihost batches pay a
+    resharding collective every step): any mesh with a >1 ``chan`` axis
+    shards the secondary-spectrum FFT's channel axis."""
+    if chan_sharded is None:
+        return (mesh is not None
+                and int(mesh.shape.get(mesh_mod.CHAN_AXIS, 1)) > 1)
+    return bool(chan_sharded)
+
+
 def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
                   mesh=None, chan_sharded: bool | None = None):
     """Build the jit'd batched step for a fixed (freqs, times) template.
@@ -209,12 +221,9 @@ def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
                 "them at their defaults")
     freqs = np.ascontiguousarray(np.asarray(freqs, dtype=np.float64))
     times = np.ascontiguousarray(np.asarray(times, dtype=np.float64))
-    if chan_sharded is None:
-        chan_sharded = (mesh is not None
-                        and int(mesh.shape.get(mesh_mod.CHAN_AXIS, 1)) > 1)
     return _make_pipeline_cached(
         (freqs.tobytes(), freqs.shape), (times.tobytes(), times.shape),
-        config, mesh, bool(chan_sharded))
+        config, mesh, _resolve_chan_sharded(mesh, chan_sharded))
 
 
 # "auto" falls back to the FFT route above this many bytes of Gram-matrix
@@ -510,7 +519,29 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
         return jax.jit(step)
 
     in_shard = mesh_mod.data_sharding(mesh, chan_sharded=chan_sharded)
-    return jax.jit(step, in_shardings=in_shard)
+    kw = {}
+    if jax.process_count() > 1:
+        # multihost: replicate outputs inside the compiled program (an
+        # ICI/DCN all-gather) so every process can materialise full
+        # results as numpy — jax forbids host-side conversion of
+        # non-addressable shards
+        kw["out_shardings"] = mesh_mod.replicated(mesh)
+    return jax.jit(step, in_shardings=in_shard, **kw)
+
+
+def _as_global_batch(dyn, mesh, chan_sharded: bool):
+    """Under a multi-process runtime, assemble the (host-replicated)
+    batch into a global jax.Array: each process contributes exactly its
+    addressable shards by global index.  Single-process: pass through
+    (jit's in_shardings handles the device_put).  ``chan_sharded`` is
+    the already-resolved bool (_resolve_chan_sharded)."""
+    import jax
+
+    if mesh is None or jax.process_count() <= 1:
+        return dyn
+    sh = mesh_mod.data_sharding(mesh, chan_sharded=chan_sharded)
+    return jax.make_array_from_callback(dyn.shape, sh,
+                                        lambda idx: dyn[idx])
 
 
 def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
@@ -533,6 +564,7 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
     multiple = 1
     if mesh is not None:
         multiple = mesh.shape[mesh_mod.DATA_AXIS]
+    chan_sharded = _resolve_chan_sharded(mesh, chan_sharded)
     results = []
     for idx in _bucket_epochs(epochs).values():
         group = [epochs[i] for i in idx]
@@ -543,7 +575,7 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
         dyn = np.asarray(batch.dyn)
         B = dyn.shape[0]
         if chunk is None or chunk >= B:
-            res = step(dyn)
+            res = step(_as_global_batch(dyn, mesh, chan_sharded))
         else:
             # memory-bounded chunking; chunk must respect mesh divisibility
             c = _adjust_chunk(multiple, chunk)
@@ -555,7 +587,9 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
                     f"mesh's data axis needs multiples of {multiple}); "
                     "size chunk accordingly when bounding device memory",
                     stacklevel=2)
-            parts = [step(dyn[i:i + c]) for i in range(0, B, c)]
+            parts = [step(_as_global_batch(dyn[i:i + c], mesh,
+                                           chan_sharded))
+                     for i in range(0, B, c)]
             res = _concat_results(parts)
         results.append((np.asarray(idx), _take_lanes(res, len(idx), B)))
     return results

@@ -45,8 +45,11 @@ def main() -> int:
     arr = jax.make_array_from_process_local_data(sharding, local,
                                                  global_shape=(8,))
     stats = survey_stats(arr, mesh)
-    # finite lanes: 0,1,2,4,5,6,7 -> mean 25/7
-    np.testing.assert_allclose(stats["mean"], 25.0 / 7, rtol=1e-6)
+    # cross-process masked reduction equals the local numpy answer
+    # exactly: finite lanes 0,1,2,4,5,6,7
+    finite = global_vals[np.isfinite(global_vals)]
+    np.testing.assert_allclose(stats["mean"], finite.mean(), rtol=1e-6)
+    np.testing.assert_allclose(stats["std"], finite.std(), rtol=1e-6)
     assert stats["count"] == 7
 
     # FULL one-jit pipeline step over the two-process mesh: each
@@ -85,8 +88,36 @@ def main() -> int:
     assert np.all(np.isfinite(tau)) and np.all(tau > 0)
     assert np.all(np.isfinite(eta))
     checksum = float(np.sum(tau) + np.sum(eta))
+
+    # HYBRID mesh with a real chan axis: 2-process CPU devices carry no
+    # slice metadata, so this exercises the grouped-by-process fallback
+    # (parallel/distributed.py) — the chan (ICI) axis must never cross
+    # the process (DCN) boundary
+    hmesh = make_hybrid_mesh(ici_chan=2)
+    assert hmesh.shape[DATA_AXIS] == 4
+    for row in hmesh.devices:
+        assert len({d.process_index for d in row}) == 1, (
+            "chan axis crosses the process boundary")
+    from scintools_tpu.parallel import run_pipeline
+
+    # FULL run_pipeline over the hybrid (chan-sharded) multihost mesh:
+    # the host-side driver assembles global arrays from process-local
+    # shards, the program replicates outputs over DCN, and the parent
+    # compares every measurement against its own single-process run
+    buckets = run_pipeline(eps, PipelineConfig(arc_numsteps=300,
+                                               lm_steps=10), mesh=hmesh)
+    [(ridx, rres)] = buckets
+    rtau = np.asarray(rres.scint.tau)
+    reta = np.asarray(rres.arc.eta)
+    assert rtau.shape == (8,) and reta.shape == (8,)
+    # the same epochs through the plain data-mesh step must agree
+    # (mesh-topology invariance, small f32 slack for collective order)
+    np.testing.assert_allclose(rtau[np.argsort(ridx)], tau, rtol=1e-4)
+    np.testing.assert_allclose(reta[np.argsort(ridx)], eta, rtol=1e-4)
+    vals = ",".join(f"{v:.17e}" for v in np.concatenate([rtau, reta]))
     print(f"MULTIHOST_OK pid={pid} mean={stats['mean']:.6f} "
-          f"count={stats['count']} pipeline_checksum={checksum:.9e}")
+          f"count={stats['count']} pipeline_checksum={checksum:.9e} "
+          f"run_pipeline_vals={vals}")
     return 0
 
 

@@ -614,6 +614,14 @@ def test_make_dynspec_gates_without_psrchive(monkeypatch, tmp_path):
     assert out == str(tmp_path / "a.ar.dynspec")
     assert calls["cmd"] == ["psrflux", "-s", "t.std", "-e", "dynspec",
                             str(tmp_path / "a.ar")]
+    # outdir relocates host-side (psrflux always writes beside the
+    # archive; no version-dependent flags involved)
+    out2 = arch.make_dynspec(str(tmp_path / "a.ar"),
+                             outdir=str(tmp_path / "moved"))
+    assert out2 == str(tmp_path / "moved" / "a.ar.dynspec")
+    import os
+
+    assert os.path.exists(out2)
     with pytest.raises(NotImplementedError, match="phasebin"):
         arch.make_dynspec(str(tmp_path / "a.ar"), phasebin=4)
 

@@ -54,3 +54,35 @@ def test_two_process_mesh_psum_survey_stats():
     # mesh and must agree on every global measurement
     sums = [o.split("pipeline_checksum=")[1].split()[0] for o in outs]
     assert sums[0] == sums[1], f"cross-process divergence: {sums}"
+
+    # full run_pipeline over the 2-process hybrid mesh: identical values
+    # on both processes, and they match THIS process's single-process
+    # run_pipeline on the same epochs (the test env has 8 in-process
+    # virtual devices — same global program, different process topology)
+    import numpy as np
+
+    vals = [np.array([float(v) for v in
+                      o.split("run_pipeline_vals=")[1].split()[0]
+                      .split(",")]) for o in outs]
+    np.testing.assert_array_equal(vals[0], vals[1])
+
+    import sys as _sys
+
+    _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from synth import synth_arc_epoch
+
+    from scintools_tpu.parallel import (PipelineConfig, make_mesh,
+                                        run_pipeline)
+
+    eps = [synth_arc_epoch(nf=32, nt=32, seed=k) for k in range(8)]
+    [(idx, res)] = run_pipeline(eps, PipelineConfig(arc_numsteps=300,
+                                                    lm_steps=10),
+                                mesh=make_mesh((4, 2)))
+    order = np.argsort(idx)
+    mine = np.concatenate([np.asarray(res.scint.tau)[order],
+                           np.asarray(res.arc.eta)[order]])
+    # worker vals are input-ordered (one bucket).  The two PROCESSES
+    # bit-match each other above; across process TOPOLOGIES (2-process
+    # hybrid vs in-process mesh) the f32 collectives reassociate
+    # FFT/LM reductions, so this cross-check carries a small slack.
+    np.testing.assert_allclose(vals[0], mine, rtol=1e-3)

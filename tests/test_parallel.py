@@ -353,6 +353,31 @@ def test_run_pipeline_buckets_by_axis_identity(epochs):
     assert groups == [(0, 1, 2), (3,)]
 
 
+def test_chan_sharded_program_contains_collectives(epochs):
+    """HLO evidence that the chan-sharded program is genuinely
+    distributed (checkable on one chip / virtual devices): its compiled
+    module contains cross-device collectives — the all-gather funnelling
+    the chan axis into the data-parallel ACF path plus whatever XLA's
+    SPMD partitioner inserts for the chan-sharded secondary-spectrum
+    FFT — while the unsharded program contains none at all."""
+    import re
+
+    batch, _ = pad_batch(epochs, batch_multiple=4)
+    cfg = PipelineConfig(arc_numsteps=300, lm_steps=10)
+    freqs = np.asarray(epochs[0].freqs)
+    times = np.asarray(epochs[0].times)
+    dyn = np.asarray(batch.dyn)
+    mesh = make_mesh(shape=(4, 2))
+    step = make_pipeline(freqs, times, cfg, mesh=mesh, chan_sharded=True)
+    txt = step.lower(dyn).compile().as_text()
+    coll = re.compile(r"all-to-all|all-gather|collective-permute|"
+                      r"all-reduce")
+    assert coll.search(txt), "no collectives in the chan-sharded program"
+    plain = make_pipeline(freqs, times, cfg).lower(dyn).compile().as_text()
+    assert not coll.search(plain), \
+        "unsharded program unexpectedly contains collectives"
+
+
 def test_run_pipeline_chan_sharded_matches(epochs):
     """A mesh with a >1 chan axis DERIVES channel sharding in
     run_pipeline (chan_sharded=None default) and reproduces the plain
