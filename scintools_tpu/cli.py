@@ -570,7 +570,7 @@ def cmd_wavefield(args) -> int:
         groups.setdefault((f.shape, t.shape, f.tobytes(), t.tobytes()),
                           []).append(item)
     kw = dict(chunk_nf=args.chunk, chunk_nt=args.chunk,
-              conc_weight=args.conc_weight)
+              conc_weight=args.conc_weight, refine=args.refine)
     for group in groups.values():
         if resolve(args.backend) == "jax" and len(group) > 1:
             try:
@@ -763,6 +763,10 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--conc-weight", type=float, default=0.0,
                    help="blend-weight exponent on per-chunk eigenmode "
                         "concentration (0 = uniform blend)")
+    q.add_argument("--refine", type=int, default=10,
+                   help="alternating-projection iterations per chunk "
+                        "after the eigen seed (0 = pure eigenvector "
+                        "retrieval)")
     q.add_argument("--backend", default="numpy",
                    choices=["numpy", "jax", "auto"])
     q.set_defaults(fn=cmd_wavefield)

@@ -93,6 +93,6 @@ def make_dynspec(archive: str, template: str | None = None,
     if outdir is not None:
         os.makedirs(outdir, exist_ok=True)
         dest = os.path.join(outdir, os.path.basename(out))
-        os.replace(out, dest)
+        shutil.move(out, dest)  # cross-filesystem-safe, unlike replace
         out = dest
     return out

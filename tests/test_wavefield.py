@@ -314,3 +314,32 @@ def test_wavefield_align_diagnostics():
     assert np.all((rest[~np.isnan(rest)] > 0)
                   & (rest[~np.isnan(rest)] <= 1))
     assert np.sum(~np.isnan(rest)) == len(rest)  # all overlaps were live
+
+
+def test_wavefield_refine_lifts_weak_scattering():
+    """The fixed-count alternating-projection refinement (measured
+    magnitude / model phase-and-support, seeded by the eigenvector)
+    lifts the weak-scattering regime that the pure rank-1 retrieval
+    leaves at ~0.3 intensity correlation, and does not hurt elsewhere
+    (it lifts the strong-anisotropy case too: 0.78 -> 0.94)."""
+    from scintools_tpu import Dynspec
+    from scintools_tpu.fit import fit_arc_thetatheta
+    from scintools_tpu.io import from_simulation
+    from scintools_tpu.sim import Simulation
+
+    sim = Simulation(mb2=2, ar=3, psi=90, ns=256, nf=256, dlam=0.25,
+                     seed=1234)
+    d = from_simulation(sim, freq=1400.0, dt=8.0)
+    ds = Dynspec(data=d, process=True)
+    eta, _, _, _ = fit_arc_thetatheta(ds.secspec(False), 1e-3, 10.0,
+                                      n_eta=96, backend="numpy")
+    dyn = np.asarray(d.dyn, float)
+
+    def corr(refine):
+        wf = retrieve_wavefield(d, eta, chunk_nf=32, chunk_nt=32,
+                                refine=refine, backend="jax")
+        return np.corrcoef(dyn.ravel(), wf.model_dynspec.ravel())[0, 1]
+
+    r0, r10 = corr(0), corr(10)
+    assert r10 > r0 + 0.08, (r0, r10)
+    assert r10 > 0.4, (r0, r10)
