@@ -78,8 +78,16 @@ def _residual_free_alpha(p, x_t, x_f, y):
 
 def fit_scint_params(acf2d, dt, df, nchan: int, nsub: int,
                      alpha: float | None = _ALPHA_KOLMOGOROV,
-                     backend: str = "numpy", steps: int = 40) -> ScintParams:
-    """Fit tau/dnu/amp/wn (alpha fixed unless ``alpha=None``) to one ACF."""
+                     backend: str = "numpy", steps: int = 20
+                     ) -> ScintParams:
+    """Fit tau/dnu/amp/wn (alpha fixed unless ``alpha=None``) to one ACF.
+
+    steps=20 everywhere in this module: measured convergence on
+    simulated epochs (docs/roadmap.md round-2 entry) — the 1-D fit is
+    within 0.05 sigma of an 80-step reference by 20 steps, the 2-D
+    fit's measurable lanes within 1e-5 sigma; locked by
+    tests/test_fit.py::test_lm_steps_default_is_converged.
+    """
     backend = resolve(backend)
     # host-side validity check before dispatching to either engine (the
     # jit'd jax fit would otherwise silently return NaN parameters); one
@@ -113,7 +121,7 @@ def fit_scint_params(acf2d, dt, df, nchan: int, nsub: int,
 
 def fit_scint_params_batch(acf2d_batch, dt, df, nchan: int, nsub: int,
                            alpha: float | None = _ALPHA_KOLMOGOROV,
-                           steps: int = 40) -> ScintParams:
+                           steps: int = 20) -> ScintParams:
     """Batched jax fit: acf2d [B, 2nf, 2nt], dt/df scalars or [B]."""
     import jax.numpy as jnp
 
@@ -187,7 +195,7 @@ def _fit_scint_from_dyn_jax(alpha, steps, cuts_method="fft"):
 
 def fit_scint_params_from_dyn(dyn_batch, dt, df,
                               alpha: float | None = _ALPHA_KOLMOGOROV,
-                              steps: int = 40,
+                              steps: int = 20,
                               cuts_method: str = "fft") -> ScintParams:
     """tau/dnu fits for a [B, nf, nt] dynspec batch via direct ACF cuts
     (identical results to the 2-D-ACF route; much less FFT work)."""
@@ -245,7 +253,7 @@ def _crop_acf_2d(acf2d, nchan, nsub, crop_t, crop_f):
 def fit_scint_params_2d(acf2d, dt, df, nchan: int, nsub: int,
                         alpha: float | None = _ALPHA_KOLMOGOROV,
                         crop_frac: float = 0.5, backend: str = "numpy",
-                        steps: int = 60):
+                        steps: int = 20):
     """Fit the 2-D ACF model (models.scint_acf_model_2d — the reference's
     empty ``acf2d`` method, dynspec.py:953-957 / scint_models.py:108-112)
     over a central window of the 2-D ACF.
@@ -317,7 +325,7 @@ def fit_scint_params_2d(acf2d, dt, df, nchan: int, nsub: int,
 def fit_scint_params_sspec(acf2d, dt, df, nchan: int, nsub: int,
                            alpha: float | None = _ALPHA_KOLMOGOROV,
                            backend: str = "numpy",
-                           steps: int = 60) -> ScintParams:
+                           steps: int = 20) -> ScintParams:
     """Fit tau/dnu in the Fourier (power-spectrum) domain — the method the
     reference declares but never finishes (``get_scint_params('sspec')``
     stub at dynspec.py:953-957 calling broken models at
@@ -433,7 +441,7 @@ def _fit_scint_2d_batch_jax(alpha, steps, crop_t, crop_f, nchan, nsub):
 
 def fit_scint_params_2d_batch(acf2d_batch, dt, df, nchan: int, nsub: int,
                               alpha: float | None = _ALPHA_KOLMOGOROV,
-                              crop_frac: float = 0.5, steps: int = 60):
+                              crop_frac: float = 0.5, steps: int = 20):
     """Vmapped 2-D ACF fits for a [B, 2nf, 2nt] batch: population-level
     phase-gradient (tilt) statistics in one device program — a capability
     with no reference analogue (its 2-D method is an empty stub).

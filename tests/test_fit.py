@@ -555,6 +555,39 @@ def test_fit_arc_nonlam_wellconditioned_bit_parity():
     assert float(fn.eta) == pytest.approx(4 * etamin_c, rel=0.05)
 
 
+def test_lm_steps_default_is_converged():
+    """The PipelineConfig default lm_steps must leave the batched
+    scint fit CONVERGED: quadrupling the step budget may move tau/dnu
+    by at most a small fraction of their own 1-sigma errors on
+    realistic simulated epochs (guards both the default and future
+    LM-schedule changes)."""
+    from scintools_tpu.io import from_simulation
+    from scintools_tpu.ops import refill, trim_edges
+    from scintools_tpu.parallel import PipelineConfig, make_pipeline
+    from scintools_tpu.sim import Simulation
+
+    eps = [refill(trim_edges(from_simulation(
+        Simulation(mb2=m, ns=128, nf=128, dlam=0.25, seed=s),
+        freq=1400.0, dt=8.0))) for s, m in ((0, 2), (1, 8), (2, 20))]
+    dyn = np.stack([np.asarray(e.dyn, np.float32) for e in eps])
+    freqs, times = np.asarray(eps[0].freqs), np.asarray(eps[0].times)
+    default = PipelineConfig().lm_steps
+
+    def fit(steps):
+        r = make_pipeline(freqs, times,
+                          PipelineConfig(fit_arc=False,
+                                         lm_steps=steps))(dyn)
+        return (np.asarray(r.scint.tau), np.asarray(r.scint.dnu),
+                np.asarray(r.scint.tauerr), np.asarray(r.scint.dnuerr))
+
+    base = fit(default)
+    ref = fit(4 * default)
+    dtau = np.abs(base[0] - ref[0]) / np.maximum(ref[2], 1e-12)
+    ddnu = np.abs(base[1] - ref[1]) / np.maximum(ref[3], 1e-12)
+    assert dtau.max() < 0.1, dtau
+    assert ddnu.max() < 0.1, ddnu
+
+
 def test_arc_power_curve_template_and_fit():
     """models.arc_power_curve: the reference's empty stub
     (scint_models.py:191-201) implemented as a power-law + floor dB
