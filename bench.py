@@ -92,7 +92,10 @@ def device_throughput(dyn, freqs, times, chunk: int) -> float:
 
     import jax.numpy as jnp
 
-    cfg = PipelineConfig(arc_numsteps=2000, lm_steps=30)
+    # lm_steps rides the shipped default (20 — measured convergence,
+    # fit/scint_fit.py) so the bench always measures the framework as
+    # configured out of the box; only the BASELINE-pinned numsteps stays
+    cfg = PipelineConfig(arc_numsteps=2000)
     step = make_pipeline(freqs, times, cfg)
     B = dyn.shape[0]
     chunk = min(chunk, B)
@@ -168,11 +171,83 @@ def main():
         "error",
         f"device path did not complete within {timeout_s}s "
         f"(accelerator tunnel unreachable?)")
+
+    # Honest fallback: the SAME one-jit SPMD program on host CPU, in a
+    # fresh subprocess (this process's jax backend is claimed by the
+    # wedged tunnel; forcing CPU must happen before backend init).
+    # Clearly labelled — it measures the batched-program speedup over
+    # the serial reference on identical silicon, NOT chip throughput.
+    fb: dict = {}
+    fb_err = None
+    try:
+        import subprocess
+        import sys
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        fb_b = _env_int("SCINT_BENCH_FALLBACK_B", 64)
+        code = (
+            "import json, os\n"
+            "from scintools_tpu.backend import force_host_cpu_devices\n"
+            "force_host_cpu_devices(1)\n"
+            "import bench\n"
+            f"dyn, freqs, times = bench.make_epochs({nf}, {nt}, "
+            f"B={fb_b})\n"
+            f"rate = bench.device_throughput(dyn, freqs, times, "
+            f"chunk={fb_b})\n"
+            "print(json.dumps({'rate': rate}))\n")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=_env_int("SCINT_BENCH_FALLBACK_TIMEOUT", 1500),
+            env=env, cwd=here)
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                fb = json.loads(line)
+                break
+            except json.JSONDecodeError:
+                continue
+        if not fb.get("rate"):
+            fb_err = (f"fallback rc={proc.returncode}: "
+                      f"{proc.stderr.strip()[-400:]}")
+    except Exception as e:  # pragma: no cover - fallback is best-effort
+        fb, fb_err = {}, f"fallback {type(e).__name__}: {e}"
+
+    # the wedged-looking device thread may have finished late while the
+    # fallback ran — a real chip number always beats the degraded record
+    if "rate" in result:
+        rate = result["rate"]
+        print(json.dumps({
+            "metric": metric,
+            "value": round(rate, 3),
+            "unit": "dynspec/s",
+            "vs_baseline": round(rate / cpu_rate, 2),
+            "note": f"device completed after the {timeout_s}s watchdog",
+        }), flush=True)
+        os._exit(0)
+
+    if fb.get("rate"):
+        rate = float(fb["rate"])
+        print(json.dumps({
+            "metric": metric,
+            "value": round(rate, 3),
+            "unit": "dynspec/s",
+            "vs_baseline": round(rate / cpu_rate, 2),
+            "device": "cpu-fallback (ACCELERATOR UNREACHABLE: this is "
+                      "the batched one-jit program vs the serial "
+                      "reference on the same host CPU, not chip "
+                      "throughput)",
+            "error": err,
+            "cpu_baseline_dynspec_per_s": round(cpu_rate, 3),
+        }), flush=True)
+        os._exit(1)
+
     print(json.dumps({
         "metric": metric, "value": 0.0, "unit": "dynspec/s",
         "vs_baseline": 0.0, "error": err,
+        "fallback_error": fb_err,
         "cpu_baseline_dynspec_per_s": round(cpu_rate, 3),
-    }))
+    }), flush=True)
     # the worker thread may be stuck inside an uninterruptible device
     # claim; exit without waiting on it
     os._exit(1)

@@ -28,3 +28,51 @@ def test_cpu_reference_path_runs_tiny():
     dyn, freqs, times = make_epochs(32, 32, n_base=1, B=2, seed=3)
     s = cpu_reference_per_epoch(dyn, freqs, times, n_epochs=1)
     assert s > 0
+
+
+def test_device_throughput_runs_on_cpu_tiny():
+    """The batched device path itself (used both for the chip run and
+    the wedged-tunnel cpu-fallback subprocess) executes on the forced-
+    CPU test backend and returns a positive rate."""
+    from bench import device_throughput, make_epochs
+
+    dyn, freqs, times = make_epochs(32, 32, n_base=1, B=4, seed=3)
+    rate = device_throughput(dyn, freqs, times, chunk=4)
+    assert rate > 0
+
+
+def test_bench_emits_json_line_with_fallback(tmp_path):
+    """End-to-end bench contract on a host without a reachable
+    accelerator-only backend: exactly one parseable JSON line on stdout
+    with the required keys, nonzero value (here the jit path runs on
+    the CPU backend directly, so no fallback fires — and if it ever
+    does, the keys still parse)."""
+    import json
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    # timeouts sized so device watchdog + fallback both fit inside this
+    # test's own 900s subprocess budget even if the fallback fires
+    env.update(SCINT_BENCH_B="4", SCINT_BENCH_NF="32",
+               SCINT_BENCH_NT="32", SCINT_BENCH_CPU_EPOCHS="1",
+               SCINT_BENCH_CHUNK="4", SCINT_BENCH_DEVICE_TIMEOUT="300",
+               SCINT_BENCH_FALLBACK_B="4",
+               SCINT_BENCH_FALLBACK_TIMEOUT="300",
+               JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    code = ("from scintools_tpu.backend import force_host_cpu_devices\n"
+            "force_host_cpu_devices(1)\n"
+            "import runpy\n"
+            "runpy.run_path(r'%s', run_name='__main__')\n"
+            % os.path.join(REPO, "bench.py"))
+    out = subprocess.run([sys.executable, "-c", code], text=True,
+                         capture_output=True, timeout=900, env=env,
+                         cwd=REPO)
+    lines = [ln for ln in out.stdout.strip().splitlines()
+             if ln.startswith("{")]
+    assert lines, f"no JSON on stdout:\n{out.stdout}\n{out.stderr}"
+    rec = json.loads(lines[-1])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec, rec
+    assert rec["value"] > 0, rec
