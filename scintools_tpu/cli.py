@@ -68,6 +68,7 @@ def cmd_process(args) -> int:
     arc_method = getattr(args, "arc_method", "norm_sspec")
     arc_bracket = getattr(args, "arc_bracket", None)
     scint_2d = getattr(args, "scint_2d", False)
+    mcmc = getattr(args, "mcmc", False)
     if scint_2d:
         cfg += ("scint2d",)
     # fail fast on estimator misconfiguration, before any file I/O
@@ -82,6 +83,17 @@ def cmd_process(args) -> int:
                          "range)")
     if arc_method != "norm_sspec" or arc_bracket is not None:
         cfg += (arc_method, tuple(arc_bracket or ()))
+    if mcmc:
+        if args.batched:
+            raise SystemExit("--mcmc samples per-epoch posteriors in "
+                             "the per-file engine; drop --batched "
+                             "(batched surveys use the deterministic "
+                             "fits)")
+        if args.no_scint and not scint_2d:
+            raise SystemExit("--mcmc has nothing to sample with "
+                             "--no-scint (add --scint-2d or drop "
+                             "--no-scint)")
+        cfg += ("mcmc",)   # posterior rows must not resume as LM rows
     # prerequisite checks stay ahead of the plots mkdir and the store
     # resume scan (which hashes every input file): truly fail-fast
     if not args.batched:
@@ -119,12 +131,12 @@ def cmd_process(args) -> int:
             tilt_row = {}
             if not args.no_scint:
                 with timers.stage("scint_fit"):
-                    scint = ds.get_scint_params()
+                    scint = ds.get_scint_params(mcmc=mcmc)
             if scint_2d:
                 with timers.stage("scint_fit_2d"):
                     import math
 
-                    ds.get_scint_params(method="acf2d")
+                    ds.get_scint_params(method="acf2d", mcmc=mcmc)
                     if not math.isfinite(float(ds.tilt)):
                         # quarantine like any failed fit (retried on
                         # resume), not stored as a NaN result
@@ -156,6 +168,17 @@ def cmd_process(args) -> int:
                     matplotlib.use("Agg")
                     ds.plot_all(filename=f"{args.plots}/"
                                 f"{row['name']}_all.png")
+                    if mcmc and getattr(ds, "mcmc_chain", None) is not None:
+                        # the reference's corner export
+                        # (dynspec.py:1025-1031)
+                        from .plotting import plot_posterior
+
+                        labels = ["tau", "dnu", "amp", "wn"]
+                        if scint_2d:   # last sampled method was acf2d
+                            labels.append("tilt")
+                        plot_posterior(ds.mcmc_chain, labels=labels,
+                                       filename=f"{args.plots}/"
+                                       f"{row['name']}_corner.png")
             # store.put last: an epoch only counts as done once all its
             # artefacts (CSV row comes from the store on export) exist
             if args.results:
@@ -659,6 +682,10 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--scint-2d", action="store_true",
                    help="also fit the 2-D ACF model (phase-gradient "
                         "tilt -> store rows; per-file and batched)")
+    q.add_argument("--mcmc", action="store_true",
+                   help="posterior scint parameters via ensemble MCMC "
+                        "(per-file engine; with --plots also writes a "
+                        "<name>_corner.png posterior plot)")
     q.add_argument("--arc-asymm", action="store_true",
                    help="also measure per-arm curvatures "
                         "(eta_left/eta_right; batched mode)")

@@ -575,3 +575,30 @@ def test_cli_process_batched_asymm(tmp_path, capsys):
     assert rc == 0
     rows = [json.loads(p.read_text()) for p in store.glob("*.json")]
     assert rows and "eta_left" in rows[0] and "eta_right" in rows[0]
+
+
+def test_cli_process_mcmc_posterior(sim_file, tmp_path):
+    """--mcmc runs posterior scint fits in the per-file engine and, with
+    --plots, exports a corner plot per epoch; --batched rejects it."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    out = str(tmp_path / "r.csv")
+    plots = str(tmp_path / "plots")
+    rc = cli_main(["process", sim_file, "--lamsteps", "--no-arc",
+                   "--mcmc", "--results", out, "--plots", plots])
+    assert rc == 0
+    import os
+
+    pngs = os.listdir(plots)
+    assert any(p.endswith("_corner.png") for p in pngs), pngs
+    text = open(out).read()
+    assert "tau" in text.splitlines()[0]
+    with pytest.raises(SystemExit, match="mcmc"):
+        cli_main(["process", sim_file, "--batched", "--mcmc",
+                  "--results", out])
+    # inert combination must fail loudly, not silently change the
+    # resume key
+    with pytest.raises(SystemExit, match="nothing to sample"):
+        cli_main(["process", sim_file, "--no-scint", "--mcmc",
+                  "--results", out])
