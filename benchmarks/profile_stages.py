@@ -36,7 +36,16 @@ def main():
     ap.add_argument("--nt", type=int, default=512)
     ap.add_argument("--iters", type=int, default=5)
     ap.add_argument("--numsteps", type=int, default=2000)
+    ap.add_argument("--only", default=None,
+                    help="run only rows whose name contains one of "
+                         "these comma-separated substrings (e.g. "
+                         "'rc=,cuts,lm_steps' for the auto-route A/Bs "
+                         "at a bigger --b); exits nonzero if nothing "
+                         "matches")
     args = ap.parse_args()
+    only = ([s for s in args.only.split(",") if s]
+            if args.only else None)
+    matched = 0
 
     import jax
     import jax.numpy as jnp
@@ -60,6 +69,10 @@ def main():
     dyn_d = jax.device_put(dyn)
 
     def bench(name, cfg):
+        nonlocal matched
+        if only is not None and not any(s in name for s in only):
+            return
+        matched += 1
         step = make_pipeline(freqs, times, cfg)
         t0 = time.perf_counter()
         sync(step(dyn_d))
@@ -97,7 +110,8 @@ def main():
     bench("scint fit mxu cuts", PipelineConfig(
         fit_arc=False, arc_numsteps=ns, scint_cuts="matmul"))
     # lm_steps=1 isolates the cut computation from the vmapped LM chain
-    # (the difference to the previous row is ~39 LM iterations)
+    # (the difference to the previous row, which runs the
+    # PipelineConfig default, is default-minus-one LM iterations)
     bench("scint mxu lm_steps=1", PipelineConfig(
         fit_arc=False, arc_numsteps=ns, scint_cuts="matmul", lm_steps=1))
     bench("FULL fft+rc0", PipelineConfig(
@@ -106,6 +120,11 @@ def main():
     bench("FULL mxu+rc64", PipelineConfig(
         arc_numsteps=ns, lm_steps=30, scint_cuts="matmul",
         arc_scrunch_rows=64))
+    if only is not None and matched == 0:
+        # a renamed row must FAIL the recheck script, not silently
+        # skip the A/B it was asked for
+        print(f"--only {args.only!r} matched no rows", file=sys.stderr)
+        sys.exit(2)
 
 
 if __name__ == "__main__":

@@ -78,7 +78,20 @@ grep -v -E 'INFO|WARN|axon_|Logging|E0000' "$pallas_out" | tail -2
 
 echo "== stage profile (bench shape) =="
 timeout -k 10 1800 python benchmarks/profile_stages.py --b 256 --iters 5 \
-  2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -8
+  2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -10
+
+echo "== auto-route A/B at the bench batch size (B=1024) =="
+# the arc_scrunch_rows=-1 / scint_cuts=auto defaults were extrapolated
+# from B=256; re-validate them at the size bench.py actually runs.
+# ONE invocation (one jax init, one 512 MB batch): profile_stages
+# exits nonzero if the row filter matches nothing (renamed rows must
+# fail loudly, not skip the A/B)
+if ! timeout -k 10 3600 python benchmarks/profile_stages.py --b 1024 \
+  --iters 3 --only "rc=,cuts,lm_steps" \
+  2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -8; then
+  echo "B=1024 auto-route A/B FAILED"
+  exit 1
+fi
 
 echo "== headline bench =="
 timeout -k 10 2400 python bench.py 2>&1 \
