@@ -1,0 +1,163 @@
+"""On-chip A/B for the two experimental Pallas kernels — the
+prove-or-remove measurement (docs/roadmap.md): each kernel is timed
+against the production path it would replace, on the shapes the
+pipeline actually runs, and a JSON verdict line is printed per kernel.
+
+    python benchmarks/pallas_ab.py            # both kernels
+    python benchmarks/pallas_ab.py --kernel row_scrunch
+
+Run serially with any other device work (a second TPU process can wedge
+the axon tunnel).  Timings force TRUE remote completion by pulling a
+fused scalar to the host; each candidate runs ``--iters`` async
+dispatches after a warmup/compile call.
+
+Verdict rule: "wire" when the Pallas kernel is >= 1.15x the production
+path (a margin below that is not worth carrying a second code path);
+"keep-off" otherwise.  The driver of record is scripts/tpu_recheck.sh.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _sync(x) -> float:
+    import jax.numpy as jnp
+
+    return float(np.asarray(jnp.sum(jnp.nan_to_num(
+        x.astype(jnp.float32) if hasattr(x, "astype") else x))))
+
+
+def _time(fn, args, iters: int) -> float:
+    """ms per call over an async dispatch chain (compile excluded)."""
+    out = fn(*args)
+    _sync(out)                     # warmup + compile + first completion
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def _emit(kernel, pallas_ms, base_ms, base_name):
+    speed = base_ms / pallas_ms if pallas_ms > 0 else 0.0
+    print(json.dumps({
+        "kernel": kernel, "pallas_ms": round(pallas_ms, 3),
+        "baseline": base_name, "baseline_ms": round(base_ms, 3),
+        "speedup": round(speed, 3),
+        "verdict": "wire" if speed >= 1.15 else "keep-off",
+    }), flush=True)
+
+
+def ab_row_scrunch(iters: int, B: int = 64, R: int = 250, C: int = 512,
+                   n: int = 2000, interpret: bool = False):
+    """Arc delay-scrunch: Pallas fused gather+nanmean vs the production
+    lax.scan 64-row-block path (the TPU auto default) on the bench
+    shape ([B] epochs vmapped, pattern shared)."""
+    import jax
+    import jax.numpy as jnp
+
+    from scintools_tpu.ops.resample_pallas import (row_scrunch_pallas,
+                                                   row_scrunch_scan)
+
+    rng = np.random.default_rng(0)
+    rows = rng.standard_normal((B, R, C)).astype(np.float32)
+    rows[:, :, C // 2 - 1: C // 2 + 1] = np.nan      # cutmid notch
+    scales = np.sqrt(np.linspace(0.05, 1.0, R))
+    pos = np.clip((np.linspace(-1, 1, n)[None] * scales[:, None] * 0.5
+                   + 0.5) * (C - 1), 0, C - 2 + 0.999)
+    i0 = np.clip(np.floor(pos).astype(np.int32), 0, C - 2)
+    w = (pos - i0).astype(np.float32)
+
+    # the baseline IS the production scrunch (shared helper): the
+    # arc fitter calls row_scrunch_scan, so kernel and baseline
+    # cannot drift apart silently
+    i0_j2, w_j2 = jnp.asarray(i0), jnp.asarray(w)
+    scan_batch = jax.jit(jax.vmap(
+        lambda r: row_scrunch_scan(r, i0_j2, w_j2, block_r=64)))
+    i0_j, w_j = jnp.asarray(i0), jnp.asarray(w)
+    pallas_batch = jax.jit(jax.vmap(
+        lambda r: row_scrunch_pallas(r, i0_j, w_j,
+                                     interpret=interpret)))
+
+    rows_d = jax.device_put(rows)
+    base_ms = _time(scan_batch, (rows_d,), iters)
+    pallas_ms = _time(pallas_batch, (rows_d,), iters)
+    # numerics must agree before any perf verdict counts
+    a = np.asarray(scan_batch(rows_d))
+    b = np.asarray(pallas_batch(rows_d))
+    ok = np.allclose(a, b, rtol=1e-5, atol=1e-6, equal_nan=True)
+    if not ok:
+        print(json.dumps({"kernel": "row_scrunch",
+                          "verdict": "numerics-mismatch"}), flush=True)
+        return False
+    _emit("row_scrunch", pallas_ms, base_ms, "scan-64 (production)")
+    return True
+
+
+def ab_nudft(iters: int, B: int = 8, nt: int = 512, nf: int = 256,
+             interpret: bool = False):
+    """Slow-FT NUDFT: Pallas VMEM-phase kernel vs the production chunked
+    einsum (both vmapped over a [B] epoch batch)."""
+    import jax
+    import jax.numpy as jnp
+
+    from scintools_tpu.ops.nudft import _r_grid, nudft, nudft_pallas
+
+    rng = np.random.default_rng(1)
+    dyn = rng.standard_normal((B, nt, nf)).astype(np.float32)
+    freqs = np.linspace(1300.0, 1500.0, nf)
+    fscale = freqs / freqs[nf // 2]
+    tsrc = np.arange(nt, dtype=np.float64)
+    r0, dr, nr = _r_grid(nt)
+
+    def ein_one(d):
+        out = nudft(d, fscale, backend="jax")
+        return jnp.real(out) ** 2 + jnp.imag(out) ** 2
+
+    def pal_one(d):
+        out = nudft_pallas(d, fscale, tsrc, r0, dr, nr,
+                           interpret=interpret)
+        return jnp.real(out) ** 2 + jnp.imag(out) ** 2
+
+    ein_b = jax.jit(jax.vmap(ein_one))
+    pal_b = jax.jit(jax.vmap(pal_one))
+    dyn_d = jax.device_put(dyn)
+    base_ms = _time(ein_b, (dyn_d,), iters)
+    pallas_ms = _time(pal_b, (dyn_d,), iters)
+    a = np.asarray(ein_b(dyn_d))
+    b = np.asarray(pal_b(dyn_d))
+    scale = max(float(np.max(np.abs(a))), 1e-30)
+    if not np.allclose(a / scale, b / scale, rtol=0, atol=5e-5):
+        print(json.dumps({"kernel": "nudft",
+                          "verdict": "numerics-mismatch"}), flush=True)
+        return False
+    _emit("nudft", pallas_ms, base_ms, "chunked einsum (production)")
+    return True
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--kernel", choices=["row_scrunch", "nudft", "both"],
+                    default="both")
+    ap.add_argument("--iters", type=int, default=10)
+    args = ap.parse_args()
+    ok = True
+    if args.kernel in ("row_scrunch", "both"):
+        ok = ab_row_scrunch(args.iters) and ok
+    if args.kernel in ("nudft", "both"):
+        ok = ab_nudft(args.iters) and ok
+    if not ok:
+        # a numerics mismatch must fail the recheck gate, not just
+        # print a verdict line
+        sys.exit(3)
+
+
+if __name__ == "__main__":
+    main()

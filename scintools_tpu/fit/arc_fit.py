@@ -603,39 +603,14 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
             # [R, n] (x3 under a B-epoch vmap: [B, R, n] v0/v1/norm in
             # HBM); accumulating the delay-scrunch nansum/count per block
             # caps the working set at [B, scrunch_rows, n] regardless of
-            # the delay cut.  Same values as nanmean (sum/count), modulo
-            # f.p. association; NaN-padded tail rows contribute nothing.
-            R = _i0_static.shape[0]
-            nb = -(-R // scrunch_rows)
-            pad = nb * scrunch_rows - R
-            rows_b = jnp.pad(rows, ((0, pad), (0, 0)),
-                             constant_values=np.nan).reshape(
-                                 nb, scrunch_rows, ncol)
-            i0_b = jnp.asarray(np.pad(_i0_static, ((0, pad), (0, 0)))
-                               .reshape(nb, scrunch_rows, n))
-            w_b = jnp.asarray(np.pad(_w_static, ((0, pad), (0, 0)))
-                              .reshape(nb, scrunch_rows, n),
-                              dtype=rows.dtype)
+            # the delay cut.  Shared with the Pallas A/B baseline
+            # (ops.resample_pallas.row_scrunch_scan), so the
+            # prove-or-remove measurement always races the kernel
+            # against exactly this production path.
+            from ..ops.resample_pallas import row_scrunch_scan
 
-            def body(carry, xs):
-                s, c = carry
-                rc, ic, wc = xs
-                v0 = jnp.take_along_axis(rc, ic, axis=1)
-                v1 = jnp.take_along_axis(rc, ic + 1, axis=1)
-                nrm = v0 * (1.0 - wc) + v1 * wc
-                # nanmean semantics exactly: skip NaN only — a -inf
-                # value (zero-power dB pixel) must poison the mean as it
-                # does on the full-gather path
-                keep = ~jnp.isnan(nrm)
-                s = s + jnp.sum(jnp.where(keep, nrm, 0.0), axis=0)
-                c = c + jnp.sum(keep.astype(s.dtype), axis=0)
-                return (s, c), None
-
-            (s, c), _ = jax.lax.scan(
-                body, (jnp.zeros(n, rows.dtype),
-                       jnp.zeros(n, rows.dtype)),
-                (rows_b, i0_b, w_b))
-            prof = jnp.where(c > 0, s / jnp.maximum(c, 1.0), jnp.nan)
+            prof = row_scrunch_scan(rows, _i0_static, _w_static,
+                                    block_r=scrunch_rows)
         else:
             i0 = jnp.asarray(_i0_static)
             w = jnp.asarray(_w_static, dtype=rows.dtype)

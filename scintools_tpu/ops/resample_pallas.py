@@ -1,18 +1,22 @@
-"""EXPERIMENTAL Pallas prototype: fused arc row-resample + delay-scrunch.
+"""Arc row-resample + delay-scrunch: production scan path + Pallas kernel.
 
 The arc fitter's hot op (fit/arc_fit.py) is, per epoch: gather each
 delay row of the secondary spectrum onto a row-specific normalised
 Doppler grid (static indices/weights [R, n]) and nanmean over rows.
-The production paths are a full [B, R, n] XLA gather (arc_scrunch_rows
-=0) and a lax.scan over row blocks (=N, the TPU auto default); this
-kernel fuses gather + interpolate + NaN-masked accumulation in VMEM so
-the [rb, n] intermediates never touch HBM.
 
-Status: validated in INTERPRET mode only (tests/test_resample_pallas.py
-is CPU; `scripts/tpu_recheck.sh` carries the real-Mosaic lowering gate —
-the per-lane `take_along_axis` is exactly the op Mosaic may refuse or
-serialise, docs/roadmap.md).  NOT wired into make_arc_fitter until it
-measures faster on hardware; use `row_scrunch_pallas` directly to A/B.
+* :func:`row_scrunch_scan` — the PRODUCTION path for
+  ``arc_scrunch_rows > 0`` (the TPU auto default): a ``lax.scan`` over
+  row blocks that bounds the working set to [block_r, n].  The arc
+  fitter calls it directly.
+* :func:`row_scrunch_pallas` — EXPERIMENTAL fused kernel: gather +
+  interpolate + NaN-masked accumulation in VMEM so the [rb, n]
+  intermediates never touch HBM.  Validated in INTERPRET mode only
+  (tests/test_resample_pallas.py is CPU); `scripts/tpu_recheck.sh`
+  carries the real-Mosaic lowering gate (the per-lane
+  ``take_along_axis`` is exactly the op Mosaic may refuse or
+  serialise) and `benchmarks/pallas_ab.py` races it against
+  row_scrunch_scan for the wire/remove decision.  NOT wired into
+  make_arc_fitter until it measures faster on hardware.
 """
 
 from __future__ import annotations
@@ -21,7 +25,54 @@ import functools
 
 import numpy as np
 
-__all__ = ["row_scrunch_pallas"]
+__all__ = ["row_scrunch_pallas", "row_scrunch_scan"]
+
+
+def row_scrunch_scan(rows, i0, w, block_r: int = 64):
+    """PRODUCTION delay-scrunch: NaN-skipping nanmean of row-resampled
+    spectra via a ``lax.scan`` over ``block_r``-row blocks (the arc
+    fitter's TPU auto default — bounds the working set to [block_r, n]
+    instead of materialising [R, n] gathers; fit/arc_fit.py calls this,
+    and benchmarks/pallas_ab.py A/Bs ``row_scrunch_pallas`` against it,
+    so kernel and baseline can never drift apart silently).
+
+    Same arguments as :func:`row_scrunch_pallas`; same math modulo
+    floating-point association.  NaN-padded tail rows contribute
+    nothing; a -inf value (zero-power dB pixel) poisons its bin's mean
+    exactly as the full-gather path would.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    rows = jnp.asarray(rows)
+    i0 = jnp.asarray(i0, dtype=jnp.int32)
+    R, C = rows.shape[-2], rows.shape[-1]
+    n = i0.shape[-1]
+    w = jnp.asarray(w, dtype=rows.dtype)
+    block_r = min(block_r, R)
+    nb = -(-R // block_r)
+    pad = nb * block_r - R
+    rows_b = jnp.pad(rows, ((0, pad), (0, 0)),
+                     constant_values=np.nan).reshape(nb, block_r, C)
+    i0_b = jnp.pad(i0, ((0, pad), (0, 0))).reshape(nb, block_r, n)
+    w_b = jnp.pad(w, ((0, pad), (0, 0))).reshape(nb, block_r, n)
+
+    def body(carry, xs):
+        s, c = carry
+        rc, ic, wc = xs
+        v0 = jnp.take_along_axis(rc, ic, axis=1)
+        v1 = jnp.take_along_axis(rc, ic + 1, axis=1)
+        nrm = v0 * (1.0 - wc) + v1 * wc
+        # nanmean semantics exactly: skip NaN only
+        keep = ~jnp.isnan(nrm)
+        s = s + jnp.sum(jnp.where(keep, nrm, 0.0), axis=0)
+        c = c + jnp.sum(keep.astype(s.dtype), axis=0)
+        return (s, c), None
+
+    (s, c), _ = jax.lax.scan(
+        body, (jnp.zeros(n, rows.dtype), jnp.zeros(n, rows.dtype)),
+        (rows_b, i0_b, w_b))
+    return jnp.where(c > 0, s / jnp.maximum(c, 1.0), jnp.nan)
 
 
 def _kernel(rows_ref, i0_ref, w_ref, sum_ref, cnt_ref):

@@ -76,6 +76,16 @@ assert err2 < 5e-3, err2
 fi
 grep -v -E 'INFO|WARN|axon_|Logging|E0000' "$pallas_out" | tail -2
 
+echo "== pallas prove-or-remove A/B =="
+# measured decision for the two experimental kernels (docs/roadmap.md:
+# wire a kernel only if it beats the production path by >= 1.15x with
+# matching numerics; otherwise it gets deleted)
+if ! timeout -k 10 1800 python benchmarks/pallas_ab.py --iters 10 \
+  2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -4; then
+  echo "pallas A/B FAILED"
+  exit 1
+fi
+
 echo "== stage profile (bench shape) =="
 timeout -k 10 1800 python benchmarks/profile_stages.py --b 256 --iters 5 \
   2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -10

@@ -76,3 +76,22 @@ def test_bench_emits_json_line_with_fallback(tmp_path):
     for key in ("metric", "value", "unit", "vs_baseline"):
         assert key in rec, rec
     assert rec["value"] > 0, rec
+
+
+def test_pallas_ab_harness_runs_tiny(capsys):
+    """The prove-or-remove A/B harness executes end-to-end (interpret
+    mode on CPU) and each kernel's JSON line reports matching numerics
+    — a 'numerics-mismatch' verdict here means the A/B baselines have
+    drifted from the kernels."""
+    import json
+
+    import benchmarks.pallas_ab as AB
+
+    assert AB.ab_row_scrunch(1, B=2, R=20, C=64, n=50, interpret=True)
+    assert AB.ab_nudft(1, B=1, nt=32, nf=32, interpret=True)
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()
+             if ln.startswith("{")]
+    assert {r["kernel"] for r in lines} == {"row_scrunch", "nudft"}
+    for r in lines:
+        assert r["verdict"] in ("wire", "keep-off"), r
