@@ -156,6 +156,21 @@ def main(outdir: str = "/tmp/arc_modelling") -> dict:
                filename=f"{outdir}/wavefield_sspec.png")
     plt.close("all")
 
+    # -- 9. posterior scintillation parameters (mcmc=True) ---------------
+    # the reference's lmfit-emcee + corner option, rebuilt as a jitted
+    # ensemble sampler: every get_scint_params method accepts mcmc=True;
+    # the post-burn chain lands on ds.mcmc_chain for corner export
+    from scintools_tpu.plotting import plot_posterior
+
+    sp_post = ds.get_scint_params(method="acf1d", mcmc=True)
+    results["tau_posterior"] = float(sp_post.tau)
+    results["tau_posterior_err"] = float(sp_post.tauerr)
+    print(f"posterior: tau = {sp_post.tau:.1f} +- {sp_post.tauerr:.1f} s "
+          f"(LM point fit above; errors now from the sampled posterior)")
+    plot_posterior(ds.mcmc_chain, labels=["tau", "dnu", "amp", "wn"],
+                   filename=f"{outdir}/posterior_corner.png")
+    plt.close("all")
+
     print(f"plots in {outdir}/")
     return results
 

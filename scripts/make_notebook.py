@@ -69,6 +69,14 @@ anisotropic screen gives the thin arc the rank-1 model the method
 needs; the field's own secondary spectrum then puts power at the
 scattered images themselves (a sharp single parabola) instead of the
 intensity spectrum's filled pairwise-difference manifold.""",
+
+    """## 9. Posterior scintillation parameters (MCMC)
+
+The reference's lmfit-emcee + corner option, rebuilt as a jitted
+ensemble sampler (no lmfit/emcee/corner dependency): every
+`get_scint_params` method accepts `mcmc=True`; the post-burn chain
+lands on `ds.mcmc_chain` for corner export via
+`plotting.plot_posterior`.""",
 ]
 
 CODE = [
@@ -147,10 +155,19 @@ corr = np.corrcoef(np.asarray(ds_h.data.dyn, float).ravel(),
 print(f"eta = {ds_h.eta:.3f};  |E|^2 reconstruction corr = {corr:.2f}")
 plot_wavefield(wf, display=False)
 plot_sspec(wf.secspec(), eta=ds_h.eta, display=False);""",
+
+    """from scintools_tpu.plotting import plot_posterior
+
+sp_post = ds.get_scint_params(method="acf1d", mcmc=True)
+print(f"posterior: tau = {sp_post.tau:.1f} +/- {sp_post.tauerr:.1f} s")
+plot_posterior(ds.mcmc_chain, labels=["tau", "dnu", "amp", "wn"],
+               display=False);""",
 ]
 
 
 def main():
+    import hashlib
+
     nb = nbf.v4.new_notebook()
     nb.metadata["kernelspec"] = {"name": "python3",
                                  "display_name": "Python 3",
@@ -159,6 +176,10 @@ def main():
     for md, code in zip(MD[1:], CODE[1:]):
         cells.append(nbf.v4.new_markdown_cell(md))
         cells.append(nbf.v4.new_code_cell(code))
+    # deterministic cell ids (content hash): regenerating an unchanged
+    # notebook must produce a byte-identical file, not id churn
+    for c in cells:
+        c["id"] = hashlib.sha1(c["source"].encode()).hexdigest()[:12]
     nb.cells = cells
     out = os.path.join(REPO, "examples", "arc_modelling.ipynb")
     with open(out, "w") as f:

@@ -32,6 +32,12 @@ def test_arc_modelling_walkthrough(tmp_path):
     assert (tmp_path / "sspec_arc.png").stat().st_size > 0
     assert results["wavefield_corr"] > 0.5
     assert (tmp_path / "wavefield_sspec.png").stat().st_size > 0
+    # section 9: posterior medians stay near the LM point fit, with a
+    # real (finite, positive) sampled error bar and a corner export
+    assert results["tau_posterior"] == pytest.approx(results["tau"],
+                                                     rel=0.5)
+    assert 0 < results["tau_posterior_err"] < results["tau_posterior"]
+    assert (tmp_path / "posterior_corner.png").stat().st_size > 0
 
 
 @pytest.mark.slow
