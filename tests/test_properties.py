@@ -205,3 +205,31 @@ def test_matmul_cuts_equal_fft_cuts(dyn):
                                atol=1e-8 * scale + 1e-9)
     np.testing.assert_allclose(np.asarray(cf_m), np.asarray(cf),
                                atol=1e-8 * scale + 1e-9)
+
+
+@_SETTINGS
+@given(_finite_arrays(st.tuples(st.integers(3, 12), st.integers(4, 16))),
+       st.integers(2, 24), st.integers(1, 5), st.data())
+def test_row_scrunch_scan_equals_full_gather(rows, n, block_r, data):
+    """The shared block-scan delay-scrunch (production arc-fitter path,
+    also the Pallas A/B baseline) equals the full-gather nanmean for
+    ANY block size, gather pattern, and NaN placement."""
+    from scintools_tpu.ops.resample_pallas import row_scrunch_scan
+
+    R, C = rows.shape
+    # random valid monotone-ish gather pattern + some NaN rows/cells
+    i0 = data.draw(hnp.arrays(np.int64, (R, n),
+                              elements=st.integers(0, C - 2)))
+    w = data.draw(hnp.arrays(np.float64, (R, n),
+                             elements=st.floats(0, 1, width=64)))
+    nanmask = data.draw(hnp.arrays(np.bool_, (R, C)))
+    rows = np.where(nanmask, np.nan, rows)
+    from test_resample_pallas import _reference_scrunch
+
+    want = _reference_scrunch(rows, i0, w)
+    got = np.asarray(row_scrunch_scan(rows, i0, w, block_r=block_r))
+    # the scan sums block-wise, nanmean sequentially: equality holds
+    # modulo f.p. association only (same tolerance as the Pallas A/B
+    # tests) — values reach 1e3, so a few ulps of ~1e4 partial sums
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7,
+                               equal_nan=True)
