@@ -653,7 +653,8 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         exactly (dynspec.py:693-744): the serial reference chain drops
         invalid entries before smoothing/walking, and index-space walks
         on the compacted vs masked-full array diverge by 10-30% in eta
-        on diffuse arcs.  Here the compaction is an argsort gather, the
+        on diffuse arcs.  Here the compaction is a cumsum-based stable
+        partition (one scatter, no sort), the
         savgol is scipy's polyorder-1 'interp' filter at the dynamic
         boundary (interior = centred moving average; edges = linear LSQ
         over the first/last window evaluated at the edge positions),
@@ -669,11 +670,18 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         n = avg.shape[0]
         idx = jnp.arange(n)
         # ---- compaction (numpy: a[valid], ascending eta) ---------------
-        order = jnp.argsort(jnp.where(valid, idx, n + idx))
+        # stable partition (valid first, original order preserved) —
+        # the same permutation argsort(where(valid, idx, n+idx)) gives,
+        # computed as a cumsum + one scatter instead of an O(n log n)
+        # sort; ``positions`` is simultaneously the inverse permutation
+        # used to scatter the smoothed profile back at the end
+        nv_run = jnp.cumsum(valid)
+        nv = nv_run[-1]
+        positions = jnp.where(valid, nv_run - 1, nv + idx - nv_run)
+        order = jnp.zeros(n, dtype=idx.dtype).at[positions].set(idx)
         avg_c = jnp.where(valid[order], avg[order], 0.0)
         ea_c = ea[order]
         cmask_c = jnp.asarray(cmask)[order]
-        nv = jnp.sum(valid)
         in_c = idx < nv
 
         # ---- scipy savgol_filter(a, nsmooth, 1) on length-nv array -----
@@ -803,7 +811,7 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
 
         # full-grid profile outputs (NaN at invalid), matching the old
         # output contract: scatter the compacted smooth back
-        inv = jnp.argsort(order)
+        inv = positions   # inverse of ``order`` by construction
         avg_f = jnp.where(valid, avg, jnp.nan)
         filt_full = jnp.where(valid, filt_c[inv], jnp.nan)
         return eta, etaerr, etaerr_fit, avg_f, filt_full
