@@ -7,8 +7,11 @@ chunk on the accelerator — measured against the reference-equivalent
 serial NumPy/SciPy path (scintools' own execution model: one epoch at a
 time through calc_sspec/fit_arc/get_scint_params, dynspec.py:1615-1657).
 
-Prints ONE JSON line:
+Prints one or more JSON lines — CONSUMERS TAKE THE LAST ONE:
     {"metric": ..., "value": N, "unit": "dynspec/s", "vs_baseline": N}
+(on a wedged accelerator a zero record is flushed first so an external
+kill still leaves a parseable round record, then the labelled
+cpu-fallback or late-arriving device record follows as the last line)
 
 Environment knobs: SCINT_BENCH_B (batch, default 1024), SCINT_BENCH_NF /
 SCINT_BENCH_NT (epoch shape, default 256x512), SCINT_BENCH_CPU_EPOCHS
@@ -177,6 +180,18 @@ def main():
     # wedged tunnel; forcing CPU must happen before backend init).
     # Clearly labelled — it measures the batched-program speedup over
     # the serial reference on identical silicon, NOT chip throughput.
+    #
+    # The zero record goes out FIRST (flushed): if whatever is driving
+    # this process kills it mid-fallback, the round still records the
+    # failure + CPU baseline instead of nothing; a successful fallback
+    # (or a late chip result) then prints a SECOND line, and consumers
+    # take the last JSON line.
+    zero_rec = {
+        "metric": metric, "value": 0.0, "unit": "dynspec/s",
+        "vs_baseline": 0.0, "error": err,
+        "cpu_baseline_dynspec_per_s": round(cpu_rate, 3),
+    }
+    print(json.dumps(zero_rec), flush=True)
     fb: dict = {}
     fb_err = None
     try:
@@ -199,7 +214,7 @@ def main():
         env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
         proc = subprocess.run(
             [sys.executable, "-c", code], capture_output=True, text=True,
-            timeout=_env_int("SCINT_BENCH_FALLBACK_TIMEOUT", 1500),
+            timeout=_env_int("SCINT_BENCH_FALLBACK_TIMEOUT", 900),
             env=env, cwd=here)
         for line in reversed(proc.stdout.strip().splitlines()):
             try:
@@ -242,12 +257,11 @@ def main():
         }), flush=True)
         os._exit(1)
 
-    print(json.dumps({
-        "metric": metric, "value": 0.0, "unit": "dynspec/s",
-        "vs_baseline": 0.0, "error": err,
-        "fallback_error": fb_err,
-        "cpu_baseline_dynspec_per_s": round(cpu_rate, 3),
-    }), flush=True)
+    if fb_err:
+        # re-emit the zero record with the fallback diagnostics so the
+        # LAST line carries the full story
+        print(json.dumps(dict(zero_rec, fallback_error=fb_err)),
+              flush=True)
     # the worker thread may be stuck inside an uninterruptible device
     # claim; exit without waiting on it
     os._exit(1)

@@ -42,11 +42,11 @@ def test_device_throughput_runs_on_cpu_tiny():
 
 
 def test_bench_emits_json_line_with_fallback(tmp_path):
-    """End-to-end bench contract on a host without a reachable
-    accelerator-only backend: exactly one parseable JSON line on stdout
-    with the required keys, nonzero value (here the jit path runs on
-    the CPU backend directly, so no fallback fires — and if it ever
-    does, the keys still parse)."""
+    """End-to-end bench contract: the LAST JSON line on stdout carries
+    the round record with the required keys and a nonzero value (here
+    the jit path runs on the CPU backend directly; on a wedged
+    accelerator a zero record precedes the labelled fallback line, and
+    consumers always take the last)."""
     import json
     import subprocess
     import sys
