@@ -26,7 +26,7 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from bench import cpu_reference_per_epoch, device_throughput, make_epochs  # noqa: E402
+from bench import device_throughput, make_epochs, serial_baseline  # noqa: E402
 
 
 def _sync(x) -> float:
@@ -126,12 +126,13 @@ def config3_arc_fit(dyn1, freqs, times, B_dev: int = 256):
 def config4_pipeline():
     B = int(os.environ.get("SCINT_BENCH_B", 1024))
     dyn, freqs, times = make_epochs(256, 512, B=B)
-    cpu_s = cpu_reference_per_epoch(dyn, freqs, times, 2)
-    rate = device_throughput(dyn, freqs, times,
-                             int(os.environ.get("SCINT_BENCH_CHUNK", 1024)))
+    base = serial_baseline(dyn, freqs, times, 2)
+    res = device_throughput(dyn, freqs, times,
+                            int(os.environ.get("SCINT_BENCH_CHUNK", 1024)))
     return {"config": 4,
             "metric": f"batched pipeline dynspec/s ({B} epochs)",
-            "cpu": 1 / cpu_s, "device": rate}
+            "cpu": base["dynspec_per_s"], "device": res["rate"],
+            "compile_s": res["compile_s"]}
 
 
 def config5_ensemble(n_screens: int = 256, ns: int = 256, nf: int = 64):

@@ -68,6 +68,16 @@ def main():
 
     dyn_d = jax.device_put(dyn)
 
+    from scintools_tpu.parallel.driver import _resolve_cuts
+    from scintools_tpu.utils.roofline import (device_peaks,
+                                              pipeline_epoch_model)
+
+    peaks = device_peaks()
+    if peaks.get("peak_tflops"):
+        print(f"# roofline peaks: {peaks['device_kind']} "
+              f"{peaks['peak_tflops']} TFLOP/s, {peaks['peak_gbs']} GB/s "
+              f"({peaks['source']})")
+
     def bench(name, cfg):
         nonlocal matched
         if only is not None and not any(s in name for s in only):
@@ -83,8 +93,26 @@ def main():
             out = step(dyn_d)
         sync(out)
         dt = (time.perf_counter() - t0) / args.iters
+        # analytic per-epoch flop model for this row's configuration
+        # (utils/roofline.py) -> achieved GFLOP/s and % of chip peak
+        # batch_shape matters: auto resolution applies the Gram-byte cap
+        # at trace time against the per-step batch (driver._resolve_cuts),
+        # so the model must pass the same shape or it reports the wrong
+        # route at large B (1024x256x512 f32 exceeds the 1 GiB cap)
+        model = pipeline_epoch_model(
+            nf, nt, lamsteps=cfg.lamsteps, numsteps=cfg.arc_numsteps,
+            lm_steps=cfg.lm_steps,
+            scint_cuts=_resolve_cuts(cfg.scint_cuts, None, (B, nf, nt)),
+            fit_arc=cfg.fit_arc, fit_scint=cfg.fit_scint)
+        gflops = (B / dt) * model["total"]["flops"] / 1e9
+        gbs = (B / dt) * model["total"]["bytes"] / 1e9
+        roof = f"{gflops:8.0f} GF/s {gbs:7.0f} GB/s"
+        if peaks.get("peak_tflops"):
+            roof += f"  {0.1 * gflops / peaks['peak_tflops']:5.2f}%MFU"
+        if peaks.get("peak_gbs"):
+            roof += f" {100.0 * gbs / peaks['peak_gbs']:5.1f}%BW"
         print(f"{name:22s} {dt * 1e3:9.2f} ms/batch  "
-              f"{B / dt:9.0f} dynspec/s   (compile {compile_s:.1f}s)")
+              f"{B / dt:9.0f} dynspec/s {roof}  (compile {compile_s:.1f}s)")
 
     ns = args.numsteps
     # Baseline rows PIN the pre-auto routes (scint_cuts="fft",

@@ -252,7 +252,10 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
             routes = survey_routes(epochs, pcfg, mesh=mesh,
                                    chunk=getattr(args, "chunk_epochs",
                                                  None))
-            log_event(log, "routes", **routes)
+            # routes keys like 'bucket0:5of256x512:step8' are not valid
+            # identifiers — pass as one JSON field, never ** unpacking
+            # (non-identifier ** keys are implementation-defined)
+            log_event(log, "routes", routes=json.dumps(routes))
             with timers.stage("batched_pipeline"):
                 buckets = run_pipeline(
                     epochs, pcfg, mesh=mesh,
@@ -271,8 +274,12 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
             # legitimately depends on the per-step batch shape, which
             # shrinks on every partial resume.
             prev = store.get_meta("routes") or {}
-            cf = lambda r: {(v["target_is_tpu"],  # noqa: E731
-                             v["arc_scrunch_rows"]) for v in r.values()}
+            # .get: a schema-drifted / hand-edited meta record must read
+            # as "drifted", not crash the run (get_meta promises metadata
+            # degrades rather than failing)
+            cf = lambda r: {(v.get("target_is_tpu"),  # noqa: E731
+                             v.get("arc_scrunch_rows"))
+                            for v in r.values() if isinstance(v, dict)}
             if prev and (any(prev[k] != routes[k]
                              for k in set(prev) & set(routes))
                          or cf(prev) != cf(routes)):

@@ -148,7 +148,8 @@ def fit_scint_params_2d_mcmc(acf2d, dt, df, nchan: int, nsub: int,
     import jax.numpy as jnp
 
     from ..models.acf_models import scint_acf_model_2d
-    from .scint_fit import _crop_acf_2d, acf_lags_2d, fit_scint_params_2d
+    from .scint_fit import (_crop_acf_2d, acf2d_crop_sizes, acf_lags_2d,
+                            fit_scint_params_2d)
 
     if burn >= steps:
         raise ValueError(f"burn ({burn}) must be < steps ({steps})")
@@ -162,8 +163,7 @@ def fit_scint_params_2d_mcmc(acf2d, dt, df, nchan: int, nsub: int,
                       + ([alpha_best] if free else []))
     ndim = len(p_best)
     a = np.asarray(acf2d, dtype=np.float64)
-    crop_t = max(2, int(nsub * crop_frac / 2))
-    crop_f = max(2, int(nchan * crop_frac / 2))
+    crop_t, crop_f = acf2d_crop_sizes(nchan, nsub, crop_frac)
     win = _crop_acf_2d(a, nchan, nsub, crop_t, crop_f)
     x_t, x_f = acf_lags_2d(float(dt), float(abs(df)), crop_t, crop_f,
                            xp=np)

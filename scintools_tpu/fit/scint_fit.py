@@ -245,6 +245,14 @@ def acf_lags_2d(dt, df, crop_t: int, crop_f: int, xp=np):
     return x_t, x_f
 
 
+def acf2d_crop_sizes(nchan: int, nsub: int, crop_frac: float) -> tuple:
+    """Central-window half-sizes (crop_t, crop_f) of the 2-D ACF fit —
+    the single source of the crop rule, shared with the MCMC sampler so
+    both always score the same window."""
+    return (max(2, int(nsub * crop_frac / 2)),
+            max(2, int(nchan * crop_frac / 2)))
+
+
 def _crop_acf_2d(acf2d, nchan, nsub, crop_t, crop_f):
     return acf2d[..., nchan - crop_f: nchan + crop_f + 1,
                  nsub - crop_t: nsub + crop_t + 1]
@@ -266,8 +274,7 @@ def fit_scint_params_2d(acf2d, dt, df, nchan: int, nsub: int,
     from ..models.acf_models import scint_acf_model_2d
 
     backend = resolve(backend)
-    crop_t = max(2, int(nsub * crop_frac / 2))
-    crop_f = max(2, int(nchan * crop_frac / 2))
+    crop_t, crop_f = acf2d_crop_sizes(nchan, nsub, crop_frac)
     a = np.asarray(acf2d, dtype=np.float64)
     win = _crop_acf_2d(a, nchan, nsub, crop_t, crop_f)
     x_t, x_f = acf_lags_2d(float(dt), float(abs(df)), crop_t, crop_f,

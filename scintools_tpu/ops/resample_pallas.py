@@ -45,8 +45,13 @@ def row_scrunch_scan(rows, i0, w, block_r: int = 64):
     import jax.numpy as jnp
 
     rows = jnp.asarray(rows)
+    if rows.ndim != 2:
+        raise ValueError(
+            f"row_scrunch_scan expects 2-D [R, C] rows, got shape "
+            f"{rows.shape}; batched callers must vmap (as the arc "
+            f"fitter and the A/B harness do)")
     i0 = jnp.asarray(i0, dtype=jnp.int32)
-    R, C = rows.shape[-2], rows.shape[-1]
+    R, C = rows.shape
     n = i0.shape[-1]
     w = jnp.asarray(w, dtype=rows.dtype)
     block_r = min(block_r, R)

@@ -176,10 +176,13 @@ def main():
     for md, code in zip(MD[1:], CODE[1:]):
         cells.append(nbf.v4.new_markdown_cell(md))
         cells.append(nbf.v4.new_code_cell(code))
-    # deterministic cell ids (content hash): regenerating an unchanged
-    # notebook must produce a byte-identical file, not id churn
-    for c in cells:
-        c["id"] = hashlib.sha1(c["source"].encode()).hexdigest()[:12]
+    # deterministic cell ids (index+content hash): regenerating an
+    # unchanged notebook must produce a byte-identical file, not id
+    # churn; the index keeps ids unique even for identical cell sources
+    # (duplicate ids are invalid nbformat)
+    for i, c in enumerate(cells):
+        c["id"] = hashlib.sha1(
+            f"{i}:{c['source']}".encode()).hexdigest()[:12]
     nb.cells = cells
     out = os.path.join(REPO, "examples", "arc_modelling.ipynb")
     with open(out, "w") as f:

@@ -103,6 +103,43 @@ if ! timeout -k 10 3600 python benchmarks/profile_stages.py --b 1024 \
   exit 1
 fi
 
+echo "== f32 numerics budget on chip =="
+# the committed budget test (tests/test_f32_budget.py) runs f32-on-CPU
+# in CI; re-run its core loop with the f32 leg on the REAL chip so the
+# documented budgets (docs/performance.md) are validated on hardware.
+# The f64 oracle stays on host CPU (chips have no f64).
+if ! timeout -k 10 1800 python -u -c "
+import numpy as np, jax
+from tests.test_f32_budget import BUDGET, REGIMES, _get
+from scintools_tpu.io import from_simulation
+from scintools_tpu.sim import Simulation
+from scintools_tpu.parallel import PipelineConfig, make_pipeline
+cpu = jax.local_devices(backend='cpu')[0]
+step = None
+worst = {k: 0.0 for k in BUDGET}
+for rg in REGIMES:
+    sim = Simulation(mb2=rg['mb2'], ns=128, nf=128, dlam=0.25,
+                     seed=rg['seed'], ar=rg['ar'])
+    d = from_simulation(sim, freq=1400.0, dt=8.0)
+    if step is None:
+        step = make_pipeline(np.asarray(d.freqs), np.asarray(d.times),
+                             PipelineConfig(arc_numsteps=1000))
+    dyn64 = np.asarray(d.dyn, np.float64)[None]
+    r32 = step(dyn64.astype(np.float32))          # on chip, f32
+    with jax.enable_x64(True), jax.default_device(cpu):
+        r64 = step(dyn64)                         # host f64 oracle
+    for name, budget in BUDGET.items():
+        v64, v32 = _get(r64, name), _get(r32, name)
+        rel = abs(v32 - v64) / abs(v64)
+        worst[name] = max(worst[name], rel)
+        assert rel <= budget, (name, rg, rel, budget)
+print('on-chip f32 drift within budget; worst:',
+      {k: f'{v:.2e}' for k, v in worst.items()})
+" 2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -3; then
+  echo "f32 on-chip check FAILED"
+  exit 1
+fi
+
 echo "== headline bench =="
 timeout -k 10 2400 python bench.py 2>&1 \
   | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -2

@@ -1,0 +1,92 @@
+"""MFU / roofline accounting (utils/roofline.py): the analytic flop model
+and peak resolution feeding bench.py's headline record and
+benchmarks/profile_stages.py's per-row %-of-peak columns."""
+
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from scintools_tpu.utils.roofline import (PEAKS_BY_KIND, device_peaks,
+                                          pipeline_epoch_model,
+                                          roofline_record)
+
+
+def test_epoch_model_stages_and_totals():
+    m = pipeline_epoch_model(256, 512)
+    assert set(m) == {"lam", "sspec", "scint", "arc", "total"}
+    for v in m.values():
+        assert v["flops"] > 0 and v["bytes"] > 0
+    assert m["total"]["flops"] == pytest.approx(
+        sum(v["flops"] for k, v in m.items() if k != "total"))
+    # the padded fft2 dominates an individual epoch at bench shapes
+    assert m["sspec"]["flops"] > m["arc"]["flops"]
+
+
+def test_epoch_model_flags_drop_stages():
+    m = pipeline_epoch_model(128, 128, lamsteps=False, fit_arc=False,
+                             fit_scint=False)
+    assert set(m) == {"sspec", "total"}
+
+
+def test_epoch_model_monotone_in_shape_and_steps():
+    small = pipeline_epoch_model(64, 64)["total"]["flops"]
+    big = pipeline_epoch_model(256, 512)["total"]["flops"]
+    assert big > small
+    a = pipeline_epoch_model(64, 64, numsteps=500)["arc"]["flops"]
+    b = pipeline_epoch_model(64, 64, numsteps=2000)["arc"]["flops"]
+    assert b == pytest.approx(4 * a)
+
+
+def test_epoch_model_cut_routes_differ():
+    mm = pipeline_epoch_model(256, 512, scint_cuts="matmul")
+    ff = pipeline_epoch_model(256, 512, scint_cuts="fft")
+    # the Gram route does more raw flops (that's the point: MXU work)
+    assert mm["scint"]["flops"] > ff["scint"]["flops"]
+
+
+def test_device_peaks_table_and_override(monkeypatch):
+    p = device_peaks(SimpleNamespace(device_kind="TPU v4"))
+    assert p["peak_tflops"] == PEAKS_BY_KIND["TPU v4"][0]
+    assert p["peak_gbs"] == PEAKS_BY_KIND["TPU v4"][1]
+    assert "TPU v4" in p["source"]
+
+    unknown = device_peaks(SimpleNamespace(device_kind="FPGA x1"))
+    assert unknown["peak_tflops"] is None and unknown["peak_gbs"] is None
+
+    monkeypatch.setenv("SCINT_PEAK_TFLOPS", "123.5")
+    monkeypatch.setenv("SCINT_PEAK_GBS", "456.0")
+    ov = device_peaks(SimpleNamespace(device_kind="FPGA x1"))
+    assert ov["peak_tflops"] == 123.5 and ov["peak_gbs"] == 456.0
+    assert "override" in ov["source"]
+
+
+def test_roofline_record_arithmetic():
+    rate = 100.0  # epochs/s
+    peaks = {"device_kind": "TPU v4", "peak_tflops": 275.0,
+             "peak_gbs": 1228.0, "source": "test"}
+    rec = roofline_record(rate, 256, 512, peaks=peaks)
+    m = pipeline_epoch_model(256, 512)["total"]
+    assert rec["achieved_gflops"] == pytest.approx(rate * m["flops"] / 1e9,
+                                                   rel=1e-2)
+    assert rec["mfu_pct"] == pytest.approx(
+        100.0 * rate * m["flops"] / 275e12, rel=2e-2)
+    assert rec["hbm_pct"] == pytest.approx(
+        100.0 * rate * m["bytes"] / 1228e9, rel=2e-2)
+    assert rec["arithmetic_intensity_flop_per_byte"] > 0
+    assert set(rec["per_stage_gflop"]) == {"lam", "sspec", "scint", "arc"}
+
+
+def test_roofline_record_no_peaks_omits_mfu():
+    rec = roofline_record(10.0, 64, 64, peaks={})
+    assert "mfu_pct" not in rec and "hbm_pct" not in rec
+    assert rec["achieved_gflops"] > 0
+
+
+def test_epoch_model_sanity_magnitude():
+    """Order-of-magnitude anchor: one 256x512 epoch is a few hundred
+    MFLOP (fft2 on 512x1024 padded grid ~ 50 MFLOP, the cubic solve and
+    Gram cuts dominate) — if the model drifts by orders of magnitude the
+    MFU headline is garbage."""
+    f = pipeline_epoch_model(256, 512)["total"]["flops"]
+    assert 1e8 < f < 1e10
