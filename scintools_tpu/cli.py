@@ -600,7 +600,8 @@ def cmd_wavefield(args) -> int:
         groups.setdefault((f.shape, t.shape, f.tobytes(), t.tobytes()),
                           []).append(item)
     kw = dict(chunk_nf=args.chunk, chunk_nt=args.chunk,
-              conc_weight=args.conc_weight, refine=args.refine)
+              conc_weight=args.conc_weight, refine=args.refine,
+              refine_global=args.refine_global)
     for group in groups.values():
         if resolve(args.backend) == "jax" and len(group) > 1:
             try:
@@ -801,6 +802,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="alternating-projection iterations per chunk "
                         "after the eigen seed (0 = pure eigenvector "
                         "retrieval)")
+    q.add_argument("--refine-global", type=int, default=0,
+                   help="global arc-support Gerchberg-Saxton iterations "
+                        "on the stitched field (recommended 30 for "
+                        "weak/moderate scattering; see the regime map "
+                        "in docs/wavefield.md — degrades strong "
+                        "anisotropic screens)")
     q.add_argument("--backend", default="numpy",
                    choices=["numpy", "jax", "auto"])
     q.set_defaults(fn=cmd_wavefield)

@@ -200,11 +200,18 @@ def _measure_peak(eta_array, power, filt, noise, constraint,
     # reference) is turned into an informative failure.
     xdata = eta_array[peak_ind - i1: peak_ind + i2]
     ydata = power[peak_ind - i1: peak_ind + i2]
-    if xdata.size == 0:
+    if xdata.size < 3:
+        # < 3 points under-determines the parabola (np.polyfit deg=2)
+        # and the forward-parabola gradient check needs 3; seen on real
+        # dirty data when RFI zapping narrows the -3 dB window to a
+        # couple of bins (tests/data fixture).  The reference would
+        # crash inside np.gradient here; we quarantine with a reason.
         raise ValueError(
-            f"arc peak at grid index {peak_ind} leaves no points for the "
-            f"parabola fit — peak is at the eta-grid edge (widen "
-            f"etamin/etamax or the constraint window)")
+            f"arc peak at grid index {peak_ind} leaves only "
+            f"{xdata.size} point(s) for the parabola fit — peak is at "
+            f"the eta-grid edge or the power-drop window collapsed "
+            f"(widen etamin/etamax, the constraint window, or "
+            f"low_power_diff)")
     # Flat-window degeneracy guard (INTENDED deviation from the
     # reference, which happily returns the vertex): when the windowed
     # power is constant to ~f.p. dust, the parabola's a and b are pure

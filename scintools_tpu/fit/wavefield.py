@@ -363,7 +363,7 @@ def retrieve_wavefield(data: DynspecData, eta: float, chunk_nf: int = 64,
                        chunk_nt: int = 64, ntheta: int | None = None,
                        niter: int = 60, mask_bins: float = 1.5,
                        theta_frac: float = 0.95, conc_weight: float = 0.0,
-                       refine: int = 10,
+                       refine: int = 10, refine_global: int = 0,
                        backend: str = "jax") -> Wavefield:
     """Retrieve the complex wavefield of ``data`` given arc curvature
     ``eta`` (us/mHz^2, as fit by ``fit_arc`` on the non-lamsteps
@@ -393,6 +393,12 @@ def retrieve_wavefield(data: DynspecData, eta: float, chunk_nf: int = 64,
     chunk 32x32): mb2=20 ar=10 0.78 -> 0.94, mb2=2 ar=3 0.32 -> 0.46,
     mb2=2 ar=1 0.29 -> 0.45; converged by ~10 iterations, broad ridge
     plateau.  ``refine=0`` recovers the pure eigenvector retrieval.
+
+    ``refine_global`` (opt-in, default 0) runs that many global
+    arc-support Gerchberg-Saxton iterations on the STITCHED field
+    (``refine_wavefield_global``): lifts weak-scattering true-field
+    fidelity 0.68-0.70 -> ~0.86 but degrades strong screens — see the
+    regime map in docs/wavefield.md before enabling.
     """
     dyn = np.asarray(data.dyn, dtype=np.float64)
     return retrieve_wavefield_batch(
@@ -401,7 +407,8 @@ def retrieve_wavefield(data: DynspecData, eta: float, chunk_nf: int = 64,
         freq=float(data.freq), dt=float(data.dt), df=float(data.df),
         chunk_nf=chunk_nf, chunk_nt=chunk_nt, ntheta=ntheta,
         niter=niter, mask_bins=mask_bins, theta_frac=theta_frac,
-        conc_weight=conc_weight, refine=refine, backend=backend)[0]
+        conc_weight=conc_weight, refine=refine,
+        refine_global=refine_global, backend=backend)[0]
 
 
 def retrieve_wavefield_batch(dyn_batch, freqs, times, etas,
@@ -413,6 +420,7 @@ def retrieve_wavefield_batch(dyn_batch, freqs, times, etas,
                              mask_bins: float = 1.5,
                              theta_frac: float = 0.95,
                              conc_weight: float = 0.0, refine: int = 10,
+                             refine_global: int = 0,
                              mesh=None,
                              backend: str = "jax") -> list:
     """Retrieve wavefields for a BATCH of epochs sharing one grid.
@@ -556,13 +564,65 @@ def retrieve_wavefield_batch(dyn_batch, freqs, times, etas,
         conc = np.array([o[1] for o in out], dtype=np.float64)
 
     theta = np.linspace(-theta_max, theta_max, ntheta)
-    return [
+    wfs = [
         _stitch(E_all[b * K:(b + 1) * K], conc[b * K:(b + 1) * K],
                 dyn_batch[b], slots, (chunk_nf, chunk_nt), w2d, freqs,
                 times, float(etas_b[b]), eta_bc[b], theta,
                 conc_weight=conc_weight)
         for b in range(B)
     ]
+    if refine_global:
+        wfs = [dataclasses.replace(w, field=refine_wavefield_global(
+            w.field, dyn_batch[b], df_mhz, dt_s, float(etas_b[b]),
+            iters=int(refine_global))) for b, w in enumerate(wfs)]
+    return wfs
+
+
+def refine_wavefield_global(field, dyn, df, dt, eta, iters: int = 30,
+                            corridor_frac: float = 0.5,
+                            corridor_floor_bins: float = 5.0):
+    """Global arc-support Gerchberg-Saxton refinement of a stitched
+    wavefield (round-3; opt-in via ``refine_global=``).
+
+    Alternates (a) a magnitude projection — keep the model's phases,
+    take |E| from the measured intensity — with (b) a support projection
+    in the FIELD conjugate spectrum: zero everything outside the
+    corridor |tau - eta fd^2| <= corridor_frac*|eta|*fd^2 +
+    corridor_floor_bins*dtau around the single image parabola (scattered
+    images live ON tau = eta fd^2 in the field spectrum, unlike the
+    intensity spectrum's pairwise-difference manifold).  The corridor is
+    RESTRICTIVE (~0.5% of the conjugate plane at default settings) — a
+    loose mask would make the magnitude projection trivially reproduce
+    the dynspec with garbage phases.
+
+    Measured against the simulator's TRUE complex field (per-chunk
+    gauge-invariant overlap, the phase-sensitive metric; see
+    docs/wavefield.md regime map): weak screens mb2=2 ar=1/3 lift
+    0.68/0.70 -> 0.855/0.859.  STRONG screens regress (mb2=20 ar=10:
+    0.74 -> 0.63) — their delay structure overflows the single-parabola
+    corridor — hence opt-in; use for weak-scattering data only.
+
+    Returns the refined complex field [nchan, nsub] with total flux
+    re-anchored to the data.
+    """
+    dyn = np.asarray(dyn, dtype=np.float64)
+    nf_, nt_ = dyn.shape
+    amp = np.sqrt(np.maximum(dyn, 0.0))
+    tau = np.fft.fftfreq(nf_, d=abs(df))          # us
+    fd = np.fft.fftfreq(nt_, d=abs(dt)) * 1e3     # mHz
+    dtau = abs(tau[1]) if nf_ > 1 else 1.0
+    mask = (np.abs(tau[:, None] - eta * fd[None, :] ** 2)
+            <= corridor_frac * abs(eta) * fd[None, :] ** 2
+            + corridor_floor_bins * dtau)
+    E = np.asarray(field, dtype=np.complex128)
+    for _ in range(int(iters)):
+        E = amp * np.exp(1j * np.angle(E))
+        E = np.fft.ifft2(np.fft.fft2(E) * mask)
+    flux = float(np.sum(np.maximum(dyn, 0.0)))
+    model = float(np.sum(np.abs(E) ** 2))
+    if model > 0:
+        E = E * np.sqrt(flux / model)
+    return E
 
 
 def _stitch(E_chunks, conc, dyn, slots, chunk_shape, w2d, freqs, times,

@@ -1,7 +1,7 @@
 from .archive import clean_archive, make_dynspec  # noqa: F401
 from .adapters import (concatenate_time, from_arrays, from_matlab,  # noqa: F401
                        from_simulation)
-from .parfile import pars_to_params, read_par  # noqa: F401
+from .parfile import pars_to_lmfit_params, pars_to_params, read_par  # noqa: F401
 from .psrflux import read_psrflux, write_psrflux  # noqa: F401
 from .results import (float_array_from_dict, read_dynlist,  # noqa: F401
                       read_results, results_row, write_results)

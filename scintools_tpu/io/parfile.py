@@ -75,7 +75,10 @@ def dms_to_rad(s: str) -> float:
 def pars_to_params(pars: dict, params: dict | None = None) -> dict:
     """par-dict -> flat fit-parameter dict (the lmfit-free analogue of
     scint_utils.py:252-278): numeric entries copied, RAJ/DECJ converted to
-    radians.  Strings are dropped."""
+    radians.  Strings are dropped.
+
+    For drop-in interop with scripts written against the reference's
+    lmfit return type, use :func:`pars_to_lmfit_params`."""
     out = dict(params) if params else {}
     for key, value in pars.items():
         if key in ("RAJ", "RA") and isinstance(value, str):
@@ -86,4 +89,24 @@ def pars_to_params(pars: dict, params: dict | None = None) -> dict:
             continue
         if isinstance(value, (int, float)) and not isinstance(value, bool):
             out[key] = float(value)
+    return out
+
+
+def pars_to_lmfit_params(pars: dict, params=None):
+    """par-dict -> ``lmfit.Parameters``, matching the reference's return
+    type exactly (scint_utils.py:252-278: each numeric entry added with
+    ``vary=False``, RAJ/DECJ in radians) so lmfit-based user scripts port
+    without edits.  Requires lmfit (not a framework dependency — this
+    repo's fitters don't use it); raises ImportError with the dict-based
+    alternative named when it is absent."""
+    try:
+        from lmfit import Parameters
+    except ImportError as e:  # pragma: no cover - env without lmfit
+        raise ImportError(
+            "pars_to_lmfit_params requires the optional 'lmfit' package; "
+            "use pars_to_params (plain dict, same values) with this "
+            "framework's own fitters") from e
+    out = params if params is not None else Parameters()
+    for key, value in pars_to_params(pars).items():
+        out.add(key, value=value, vary=False)
     return out

@@ -172,7 +172,18 @@ def zap(d: DynspecData, method: str = "median", sigma: float = 7,
         m: int = 3) -> DynspecData:
     """RFI zapping (dynspec.py:1389-1400): ``median`` NaNs out pixels more
     than ``sigma`` median-absolute-deviations from the median; ``medfilt``
-    median-filters the array."""
+    median-filters the array; ``channels`` excises whole channels whose
+    per-channel statistics are anomalous.
+
+    ``channels`` covers the RFI class pixel thresholds cannot: a channel
+    with a slowly drifting gain (e.g. a saturating receiver) stays inside
+    the global pixel threshold at every sample, yet its residual
+    low-Doppler ridge after bandpass correction can bury a scintillation
+    arc (demonstrated by tests/data/J0000+0000_degraded.dynspec).  The
+    reference delegates this to the external coast_guard "surgical"
+    cleaner (scint_utils.py:19-56); here it is native: robust z-scores of
+    per-channel median, spread (IQR) and linear time-trend, any of which
+    beyond ``sigma`` flags the channel (NaN, to be repaired by refill)."""
     dyn = np.array(d.dyn, dtype=np.float64)
     if method == "median":
         dev = np.abs(dyn - np.median(dyn[~np.isnan(dyn)]))
@@ -180,6 +191,32 @@ def zap(d: DynspecData, method: str = "median", sigma: float = 7,
         dyn[dev / mdev > sigma] = np.nan
     elif method == "medfilt":
         dyn = medfilt(dyn, kernel_size=m)
+    elif method == "channels":
+        with np.errstate(invalid="ignore"):
+            t = np.arange(dyn.shape[1], dtype=np.float64)
+            t = (t - t.mean()) / max(t.std(), 1.0)
+            med = np.nanmedian(dyn, axis=1)
+            q75, q25 = (np.nanpercentile(dyn, 75, axis=1),
+                        np.nanpercentile(dyn, 25, axis=1))
+            spread = q75 - q25
+            valid = np.isfinite(dyn)
+            dyn0 = np.where(valid, dyn, 0.0)
+            n = np.maximum(valid.sum(axis=1), 1)
+            # per-channel linear trend vs normalised time (covariance
+            # with a unit-variance regressor), scale-normalised
+            mean_c = dyn0.sum(axis=1) / n
+            trend = ((dyn0 - mean_c[:, None] * valid) * t).sum(axis=1) / n
+            trend = trend / np.maximum(np.abs(mean_c), 1e-30)
+
+        def _robust_z(x):
+            x = np.where(np.isfinite(x), x, np.nanmedian(x))
+            c = np.median(x)
+            s = np.median(np.abs(x - c)) * 1.4826
+            return np.abs(x - c) / max(s, 1e-30)
+
+        bad = ((_robust_z(med) > sigma) | (_robust_z(spread) > sigma)
+               | (_robust_z(trend) > sigma))
+        dyn[bad, :] = np.nan
     else:
         raise ValueError(f"unknown zap method {method!r}")
     return d.replace(dyn=dyn)

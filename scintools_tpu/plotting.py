@@ -73,18 +73,38 @@ def plot_dyn(d: DynspecData, ax=None, filename: str | None = None,
 
 def plot_acf(acf2d, d: DynspecData | None = None, scint_params=None,
              ax=None, filename: str | None = None, display: bool = False,
-             crop_frac: float = 1.0, cmap: str = "viridis"):
-    """2-D ACF with the zero-lag white-noise spike suppressed
-    (dynspec.py:249-306: the centre pixel is replaced by its neighbours'
-    mean so it doesn't swamp the colour scale).  Optionally annotates the
-    fitted tau/dnu from ``scint_params``."""
+             crop_frac: float = 1.0, cmap: str = "viridis",
+             contour: bool = False, wn_method: str = "reference"):
+    """2-D ACF with the zero-lag white-noise spike suppressed.
+
+    ``wn_method="reference"`` (default) subtracts the lag0-lag1 drop
+    from the centre pixel exactly as the reference does
+    (dynspec.py:267-270: ``wn = arr[0][0] - arr[0][1]`` on the
+    ifftshifted array, i.e. the spike is set to the first time-lag
+    neighbour's value); ``wn_method="neighbours"`` replaces it with the
+    four neighbours' mean (slightly smoother on noisy ACFs).
+
+    ``contour=True`` draws filled contours instead of pcolormesh
+    (reference ``contour=`` option, dynspec.py:276-277).
+
+    With ``scint_params``, adds the reference's scint-scaled TWIN AXES
+    (dynspec.py:283-292): a second y axis in units of the fitted dnu_d
+    and a second x axis in units of tau_d, plus the guide lines."""
     import matplotlib.pyplot as plt
 
     a = np.array(to_numpy(acf2d), dtype=np.float64)
     nf, nt = a.shape
     cf, ct = nf // 2, nt // 2
-    a[cf, ct] = (a[cf, ct - 1] + a[cf, ct + 1]
-                 + a[cf - 1, ct] + a[cf + 1, ct]) / 4
+    if wn_method == "reference":
+        # wn = lag0 - lag1; lag0 -= wn  ==  set spike to the first
+        # time-lag neighbour (dynspec.py:267-270 on the unshifted array)
+        a[cf, ct] = a[cf, ct + 1]
+    elif wn_method == "neighbours":
+        a[cf, ct] = (a[cf, ct - 1] + a[cf, ct + 1]
+                     + a[cf - 1, ct] + a[cf + 1, ct]) / 4
+    else:
+        raise ValueError(f"unknown wn_method {wn_method!r} "
+                         "(expected 'reference' or 'neighbours')")
     if ax is None:
         fig, ax = plt.subplots(figsize=(7, 6))
     else:
@@ -101,7 +121,10 @@ def plot_acf(acf2d, d: DynspecData | None = None, scint_params=None,
         a = a[cf - if_:cf + if_, ct - it:ct + it]
         tlag = tlag[ct - it:ct + it]
         flag = flag[cf - if_:cf + if_]
-    mesh = ax.pcolormesh(tlag, flag, a, cmap=cmap, shading="auto")
+    if contour:
+        mesh = ax.contourf(tlag, flag, a, cmap=cmap)
+    else:
+        mesh = ax.pcolormesh(tlag, flag, a, cmap=cmap, shading="auto")
     ax.set_xlabel("Time lag (mins)" if d is not None else "Time lag")
     ax.set_ylabel("Frequency lag (MHz)" if d is not None
                   else "Frequency lag")
@@ -111,7 +134,18 @@ def plot_acf(acf2d, d: DynspecData | None = None, scint_params=None,
         ax.axvline(tau, color="w", ls=":", lw=1, alpha=0.7)
         ax.axhline(dnu, color="w", ls=":", lw=1, alpha=0.7)
         ax.set_title(f"tau_d={tau:.2f} min, dnu_d={dnu:.4f} MHz")
-    fig.colorbar(mesh, ax=ax, label="ACF")
+        # scint-scaled twin axes (reference dynspec.py:283-292)
+        if dnu != 0 and tau != 0:
+            miny, maxy = ax.get_ylim()
+            ax2 = ax.twinx()
+            ax2.set_ylim(miny / dnu, maxy / dnu)
+            ax2.set_ylabel(f"Frequency lag / (dnu_d = {round(dnu, 2)})")
+            minx, maxx = ax.get_xlim()
+            ax3 = ax.twiny()
+            ax3.set_xlim(minx / tau, maxx / tau)
+            ax3.set_xlabel(f"Time lag / (tau_d = {round(tau, 2)})")
+    fig.colorbar(mesh, ax=ax, pad=0.15 if scint_params is not None
+                 else 0.05, label="ACF")
     return _finish(fig, filename, display)
 
 

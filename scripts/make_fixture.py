@@ -1,0 +1,78 @@
+"""Generate the committed real-format observational fixture.
+
+The reference's de-facto integration target is real J0437-4715 psrflux
+data with band-edge roll-off, dropout gaps and RFI (its notebook,
+reference examples/arc_modelling.ipynb; the data directory is not
+shipped).  This script writes a faithfully degraded simulated epoch
+through the framework's own psrflux writer so CI can exercise the
+dirty-data path (trim -> refill -> zap -> correct_band -> sspec -> fits)
+on a REAL-format file with genuine defects:
+
+* dead band edges (all-zero channels, as backends emit them),
+* a dropout time gap (zeroed subints mid-observation),
+* narrowband RFI (two hot channels, one multiplicative ramp),
+* impulsive broadband RFI (two hot subints),
+* a slow receiver gain drift in time,
+* a bandpass ripple in frequency.
+
+Deterministic (fixed seeds); re-running reproduces the committed file
+byte-for-byte.  Output: tests/data/J0000+0000_degraded.dynspec
+"""
+
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from scintools_tpu.io import from_simulation, write_psrflux  # noqa: E402
+from scintools_tpu.sim import Simulation  # noqa: E402
+
+
+def build(nf: int = 96, nt: int = 144, seed: int = 20260731):
+    sim = Simulation(mb2=2, ns=nt, nf=nf, dlam=0.25, seed=seed)
+    d = from_simulation(sim, freq=1400.0, dt=8.0)
+    dyn = np.asarray(d.dyn, dtype=np.float64).copy()
+    rng = np.random.default_rng(seed)
+
+    # receiver systematics BEFORE the defects (they multiply real flux)
+    gain_t = 1.0 + 0.25 * np.sin(2 * np.pi * np.arange(nt) / nt * 1.5)
+    bandpass_f = 1.0 + 0.30 * np.cos(2 * np.pi * np.arange(nf) / nf * 2.2)
+    dyn *= bandpass_f[:, None] * gain_t[None, :]
+
+    # narrowband RFI: two hot channels + one multiplicative ramp channel
+    dyn[17, :] += np.abs(rng.normal(25.0, 5.0, nt))
+    dyn[58, :] += np.abs(rng.normal(40.0, 8.0, nt))
+    dyn[33, :] *= np.linspace(1.0, 9.0, nt)
+    # impulsive broadband RFI: two hot subints
+    dyn[:, 41] += np.abs(rng.normal(30.0, 6.0, nf))
+    dyn[:, 97] += np.abs(rng.normal(22.0, 4.0, nf))
+
+    # dropout gap: backend wrote zeros for 9 dead subints
+    dyn[:, 70:79] = 0.0
+    # dead band edges: 4 + 3 all-zero channels (receiver roll-off)
+    dyn[:4, :] = 0.0
+    dyn[-3:, :] = 0.0
+    # scattered dead pixels (packet loss)
+    ii = rng.integers(4, nf - 3, 60)
+    jj = rng.integers(0, nt, 60)
+    dyn[ii, jj] = 0.0
+
+    return type(d)(dyn=dyn, freqs=np.asarray(d.freqs),
+                   times=np.asarray(d.times), mjd=58000.0,
+                   name="J0000+0000_degraded")
+
+
+def main():
+    out_dir = os.environ.get("SCINT_FIXTURE_OUT",
+                             os.path.join(REPO, "tests", "data"))
+    os.makedirs(out_dir, exist_ok=True)
+    out = os.path.join(out_dir, "J0000+0000_degraded.dynspec")
+    write_psrflux(build(), out)
+    print(f"wrote {out} ({os.path.getsize(out)} bytes)")
+
+
+if __name__ == "__main__":
+    main()

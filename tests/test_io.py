@@ -78,6 +78,23 @@ def test_read_par(tmp_path):
         -(47 + 15 / 60 + 9.1 / 3600) * np.pi / 180)
 
 
+def test_pars_to_lmfit_params_interop():
+    """Reference-type interop (scint_utils.py:252-278): returns lmfit
+    Parameters with vary=False when lmfit is installed; without it (this
+    CI image) raises an ImportError that names the dict alternative."""
+    from scintools_tpu.io import pars_to_lmfit_params
+
+    try:
+        import lmfit  # noqa: F401
+    except ImportError:
+        with pytest.raises(ImportError, match="pars_to_params"):
+            pars_to_lmfit_params({"F0": 100.0})
+        return
+    out = pars_to_lmfit_params({"F0": 100.0, "PB": 5.74})
+    assert out["F0"].value == 100.0 and not out["F0"].vary
+    assert out["PB"].value == 5.74
+
+
 def test_read_par_matches_reference(tmp_path):
     mods = reference_modules()
     if mods is None:

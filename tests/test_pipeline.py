@@ -111,6 +111,31 @@ def test_svd_and_zap_and_crop(sim_dyn):
     assert ds.nchan < n0
 
 
+def test_zap_channels_flags_drift_and_hot_not_clean(sim_dyn):
+    """zap(method='channels'): per-channel robust triage catches a
+    drifting-gain ramp (inside the global pixel distribution at every
+    sample — invisible to the 'median' method) and an additive hot
+    channel, and leaves clean channels alone (ops/clean.py; the
+    reference delegates this class to coast_guard's surgical cleaner,
+    scint_utils.py:19-56)."""
+    from scintools_tpu.ops.clean import zap
+
+    dyn = np.array(sim_dyn.dyn, dtype=np.float64)
+    med = float(np.median(dyn))
+    nt = dyn.shape[1]
+    dyn[5, :] *= np.linspace(1.0, 3.0, nt)     # gain drift
+    dyn[11, :] += 20 * med                     # hot channel
+    d = sim_dyn.replace(dyn=dyn)
+
+    z = zap(d, method="channels", sigma=4)
+    bad = np.where(np.all(np.isnan(np.asarray(z.dyn)), axis=1))[0]
+    assert 5 in bad and 11 in bad
+    assert len(bad) <= 4  # surgical: no broad collateral damage
+    # the pixel method does NOT catch the smooth ramp (that's the point)
+    zp = np.asarray(zap(d, method="median", sigma=5).dyn)
+    assert not np.all(np.isnan(zp[5, :]))
+
+
 def test_write_file_roundtrip(tmp_path, sim_dyn):
     ds = Dynspec(data=sim_dyn, process=False)
     fn = str(tmp_path / "rt.dynspec")

@@ -36,6 +36,48 @@ def test_plot_acf(ds, tmp_path):
     plt.close(fig)
 
 
+def test_plot_acf_reference_parity_features(ds, tmp_path):
+    """plot_acf carries the reference's UX: contour mode
+    (dynspec.py:276-277), the exact lag0-lag1 white-noise-spike
+    subtraction (dynspec.py:267-270), and the scint-scaled twin axes
+    (dynspec.py:283-292) when a fit is supplied."""
+    from scintools_tpu.plotting import plot_acf
+
+    ds.get_scint_params()
+    a = np.asarray(ds.acf)
+    fig = plot_acf(a, d=ds.data, scint_params=ds.scint_params,
+                   contour=True, filename=str(tmp_path / "c.png"))
+    # twin axes present: base + twinx + twiny (+ colorbar axes)
+    assert len(fig.axes) >= 4
+    labels = {ax.get_ylabel() for ax in fig.axes} \
+        | {ax.get_xlabel() for ax in fig.axes}
+    assert any("dnu_d" in s for s in labels)
+    assert any("tau_d" in s for s in labels)
+    plt.close(fig)
+
+    # wn_method="reference": the PLOTTED centre pixel equals the +1
+    # time-lag neighbour (read back from the QuadMesh), and the caller's
+    # array keeps its spike
+    nf, nt = a.shape
+    cf, ct = nf // 2, nt // 2
+    spike_before = a[cf, ct]
+    fig2 = plot_acf(a, d=ds.data, wn_method="reference")
+    plotted = np.asarray(
+        fig2.axes[0].collections[0].get_array()).reshape(nf, nt)
+    assert plotted[cf, ct] == a[cf, ct + 1]
+    assert a[cf, ct] == spike_before  # input untouched
+    plt.close(fig2)
+    fig3 = plot_acf(a, d=ds.data, wn_method="neighbours")
+    plotted3 = np.asarray(
+        fig3.axes[0].collections[0].get_array()).reshape(nf, nt)
+    assert plotted3[cf, ct] == (a[cf, ct - 1] + a[cf, ct + 1]
+                                + a[cf - 1, ct] + a[cf + 1, ct]) / 4
+    plt.close(fig3)
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="wn_method"):
+        plot_acf(a, d=ds.data, wn_method="refernce")
+
+
 def test_plot_sspec_with_arc(ds, tmp_path):
     ds.fit_arc(lamsteps=True, numsteps=2000)
     fig = ds.plot_sspec(plotarc=True, filename=str(tmp_path / "ss.png"))

@@ -343,3 +343,70 @@ def test_wavefield_refine_lifts_weak_scattering():
     r0, r10 = corr(0), corr(10)
     assert r10 > r0 + 0.08, (r0, r10)
     assert r10 > 0.4, (r0, r10)
+
+
+def test_refine_global_lifts_weak_scattering_true_field():
+    """Global arc-support Gerchberg-Saxton (refine_global=, round-3)
+    lifts weak-scattering TRUE-FIELD fidelity past the 0.6 target the
+    per-chunk rank-1 retrieval plateaus under (~0.45 intensity corr /
+    ~0.7 true-field overlap) — scored against the simulator's complex
+    field, the phase-sensitive metric.  The corridor must stay
+    restrictive: a loose mask would fake intensity corr with garbage
+    phases, so the mask-area guard is part of the contract."""
+    from scintools_tpu import Dynspec
+    from scintools_tpu.fit import fit_arc_thetatheta
+    from scintools_tpu.fit.wavefield import refine_wavefield_global
+    from scintools_tpu.io import from_simulation
+    from scintools_tpu.sim import Simulation
+
+    sim = Simulation(mb2=2, ar=1, ns=256, nf=256, dlam=0.25, seed=1234)
+    d = from_simulation(sim, freq=1400.0, dt=8.0)
+    E_true = np.asarray(sim.spe).T
+    ds = Dynspec(data=d, process=True)
+    eta, _, _, _ = fit_arc_thetatheta(ds.secspec(False), 1e-3, 10.0,
+                                      n_eta=96, backend="numpy")
+    dyn = np.asarray(d.dyn, float)
+    wf = retrieve_wavefield(d, eta, chunk_nf=32, chunk_nt=32, refine=10,
+                            backend="jax")
+    E0 = np.asarray(wf.field)
+    ov0 = np.mean(_chunk_overlaps(E0, E_true, 32))
+
+    # the corridor is restrictive (core of the method's honesty)
+    tau = np.fft.fftfreq(dyn.shape[0], d=float(d.df))
+    fd = np.fft.fftfreq(dyn.shape[1], d=float(d.dt)) * 1e3
+    mask = (np.abs(tau[:, None] - eta * fd[None, :] ** 2)
+            <= 0.5 * abs(eta) * fd[None, :] ** 2 + 5 * abs(tau[1]))
+    assert mask.mean() < 0.02, mask.mean()
+
+    Eg = refine_wavefield_global(E0, dyn, float(d.df), float(d.dt), eta,
+                                 iters=30)
+    ovG = np.mean(_chunk_overlaps(Eg, E_true, 32))
+    assert ovG > 0.8, (ov0, ovG)       # measured 0.855 (was 0.684)
+    assert ovG > ov0 + 0.1, (ov0, ovG)
+    # flux stays anchored to the data
+    assert np.isclose(np.sum(np.abs(Eg) ** 2),
+                      np.sum(np.maximum(dyn, 0)), rtol=1e-6)
+
+
+def test_refine_global_plumbed_through_retrieval():
+    """refine_global= reaches the public retrieval APIs and changes the
+    field (single + batch paths agree with the manual composition)."""
+    from scintools_tpu.fit.wavefield import (refine_wavefield_global,
+                                             retrieve_wavefield_batch)
+
+    d, _, eta = _synth_arc_field(nf=96, nt=96, seed=5)
+    dyn = np.asarray(d.dyn, float)
+    wf0 = retrieve_wavefield(d, eta, chunk_nf=48, chunk_nt=48, refine=4,
+                             backend="numpy")
+    wfg = retrieve_wavefield(d, eta, chunk_nf=48, chunk_nt=48, refine=4,
+                             refine_global=8, backend="numpy")
+    manual = refine_wavefield_global(wf0.field, dyn, float(d.df),
+                                     float(d.dt), eta, iters=8)
+    np.testing.assert_allclose(wfg.field, manual, rtol=1e-10, atol=1e-12)
+
+    wfb = retrieve_wavefield_batch(dyn[None], d.freqs, d.times, [eta],
+                                   freq=float(d.freq), chunk_nf=48,
+                                   chunk_nt=48, refine=4, refine_global=8,
+                                   backend="numpy")[0]
+    np.testing.assert_allclose(wfb.field, wfg.field, rtol=1e-10,
+                               atol=1e-12)
