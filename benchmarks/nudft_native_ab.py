@@ -1,0 +1,130 @@
+"""Native-vs-native NUDFT A/B: this framework's C++ kernel against the
+reference's ACTUAL C kernel, compiled from its shipped source, same host,
+same inputs.
+
+The reference's one native component (fit_1d-response.c: per-sample
+cos/sin accumulation, OpenMP collapse(2) dynamic) exists because the
+pure-NumPy NUDFT was measured too slow (scint_utils.py:343).  This
+framework's replacement (native/nudft.cc) is an own-design rotation-
+recurrence kernel: per (r, f) pair the phase step is constant on a
+uniform time grid, so the inner loop is one complex multiply instead of
+cos+sin.  This harness makes that comparison a measured number rather
+than a claim:
+
+* compiles the reference C source (read from /root/reference, UNTRUSTED
+  third-party code — compiled and called only as a numeric oracle) into
+  a throwaway /tmp directory with its own documented gcc line,
+* checks both kernels agree to f64 tolerance on random inputs,
+* times both (+ the numpy einsum fallback for context) and prints one
+  JSON line per size with the speedup.
+
+Skips gracefully (explicit JSON) when the reference tree or gcc is
+unavailable.  CPU-only: no jax import, safe under a wedged tunnel.
+"""
+
+import ctypes
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+from numpy.ctypeslib import ndpointer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+REF_SRC = "/root/reference/scintools/fit_1d-response.c"
+
+
+def build_reference(tmpdir: str):
+    """Compile the reference kernel with its own build line
+    (fit_1d-response.c:1) into tmpdir; return the bound function."""
+    so = os.path.join(tmpdir, "fit_1d-response.so")
+    cmd = ["gcc", "-Wall", "-O2", "-fopenmp", "--std=gnu11", "-shared",
+           "-Wl,-soname,fit_1d-response", "-o", so, "-fPIC", REF_SRC]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    lib = ctypes.CDLL(so)
+    fn = lib.comp_dft_for_secspec
+    fn.restype = None
+    fn.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_double, ctypes.c_double,
+        ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        ndpointer(np.complex128, flags="C_CONTIGUOUS"),
+    ]
+    return fn
+
+
+def time_best(fn, repeats=5):
+    best = np.inf
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main(sizes=(128, 256, 512)):
+    from scintools_tpu.native import load_nudft, nudft_native
+    from scintools_tpu.ops.nudft import _nudft_numpy
+
+    if not os.path.isfile(REF_SRC):
+        print(json.dumps({"error": "reference source unavailable",
+                          "path": REF_SRC}))
+        return
+    if load_nudft() is None:
+        print(json.dumps({"error": "own native kernel failed to build"}))
+        return
+
+    with tempfile.TemporaryDirectory() as td:
+        try:
+            ref_fn = build_reference(td)
+        except (subprocess.CalledProcessError, OSError) as e:
+            print(json.dumps({"error": f"reference build failed: {e}"}))
+            return
+
+        rng = np.random.default_rng(0)
+        for n in sizes:
+            ntime = nfreq = nr = n
+            power = rng.standard_normal((ntime, nfreq))
+            fscale = 1.0 + 0.05 * np.arange(nfreq) / nfreq
+            tsrc = np.arange(ntime, dtype=np.float64)
+            r0, dr = -0.5, 1.0 / ntime
+
+            out_ref = np.empty((nr, nfreq), dtype=np.complex128)
+
+            def run_ref():
+                ref_fn(ntime, nfreq, nr, r0, dr, fscale, tsrc,
+                       np.ascontiguousarray(power), out_ref)
+
+            run_ref()  # warm (thread pool spin-up)
+            got = nudft_native(power, fscale, tsrc, r0, dr, nr)
+            scale = np.max(np.abs(out_ref))
+            err = float(np.max(np.abs(got - out_ref)) / max(scale, 1e-30))
+            if err > 1e-9:
+                print(json.dumps({"n": n, "error": "numerics mismatch",
+                                  "rel_err": err}))
+                continue
+
+            t_ref = time_best(run_ref)
+            t_own = time_best(lambda: nudft_native(power, fscale, tsrc,
+                                                   r0, dr, nr))
+            t_np = time_best(lambda: _nudft_numpy(power, fscale, tsrc,
+                                                  r0, dr, nr), repeats=2)
+            print(json.dumps({
+                "kernel": "nudft", "n": n, "rel_err": err,
+                "reference_c_s": round(t_ref, 4),
+                "own_cpp_s": round(t_own, 4),
+                "numpy_einsum_s": round(t_np, 4),
+                "speedup_vs_reference_c": round(t_ref / t_own, 2),
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main(tuple(int(s) for s in sys.argv[1].split(","))
+         if len(sys.argv) > 1 else (128, 256, 512))

@@ -578,6 +578,29 @@ def retrieve_wavefield_batch(dyn_batch, freqs, times, etas,
     return wfs
 
 
+def field_overlap(A, B, cs: int = 32):
+    """Gauge-invariant per-chunk fidelity between two complex fields:
+    Hann-windowed normalised inner products |<A, B>| over the standard
+    50%-overlap chunk tiling, returned as an array (random-phase floor
+    ~1/cs).  THE evaluation metric for wavefield retrieval — phase-
+    sensitive, insensitive to the unobservable per-chunk global phase —
+    used by the CI ground-truth tests and the docs/wavefield.md regime
+    map (scripts/wavefield_regime_map.py); both call this single
+    definition."""
+    A = np.asarray(A)
+    B = np.asarray(B)
+    w = np.hanning(cs)[:, None] * np.hanning(cs)[None, :]
+    ovs = []
+    for cf in _chunk_starts(A.shape[0], cs):
+        for ct in _chunk_starts(A.shape[1], cs):
+            Ea, Eb = A[cf:cf + cs, ct:ct + cs], B[cf:cf + cs, ct:ct + cs]
+            den = np.sqrt(np.sum(np.abs(Ea) ** 2 * w)
+                          * np.sum(np.abs(Eb) ** 2 * w))
+            if den > 0:
+                ovs.append(abs(np.sum(Ea * np.conj(Eb) * w)) / den)
+    return np.asarray(ovs)
+
+
 def refine_wavefield_global(field, dyn, df, dt, eta, iters: int = 30,
                             corridor_frac: float = 0.5,
                             corridor_floor_bins: float = 5.0):

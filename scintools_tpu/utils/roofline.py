@@ -57,9 +57,11 @@ def pipeline_epoch_model(nf: int, nt: int, *, lamsteps: bool = True,
     Stage models (one nf x nt epoch; padded FFT lengths nrfft/ncfft are
     next-pow2*2 as in ops/sspec.py):
 
-    lam    natural cubic spline along the channel axis: dense solve of the
-           tridiagonal-as-dense system (2/3 nf^3 + 2 nf^2 nt for the nt
-           right-hand sides) + 12-flop polynomial eval per output sample.
+    lam    freq->lambda resample as the batched pipeline executes it
+           (parallel.driver.lambda_resample_matrix): the natural-spline
+           solve is amortised host-side into a dense [nlam, nf] weight
+           matrix, so the per-epoch device work is ONE matmul,
+           2 nlam nf nt with nlam ~= nf.
     sspec  full complex fft2 on [nrfft, ncfft] (two 1-D passes) + ~15
            elementwise ops/element (window, prewhiten 4-tap, |.|^2,
            postdark divide, log10).
@@ -76,8 +78,8 @@ def pipeline_epoch_model(nf: int, nt: int, *, lamsteps: bool = True,
     out: dict[str, dict[str, float]] = {}
 
     if lamsteps:
-        flops = (2.0 / 3.0) * nf ** 3 + 2.0 * nf ** 2 * nt + 12.0 * nf * nt
-        out["lam"] = {"flops": flops, "bytes": 2.0 * 4 * nf * nt}
+        out["lam"] = {"flops": 2.0 * nf * nf * nt,
+                      "bytes": 2.0 * 4 * nf * nt + 4.0 * nf * nf}
 
     # sspec: two complex 1-D FFT passes over the padded grid + elementwise
     fft2 = ncfft * _cfft(nrfft) + nrfft * _cfft(ncfft)

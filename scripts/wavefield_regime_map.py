@@ -30,7 +30,7 @@ force_host_cpu_devices(1)
 
 from scintools_tpu import Dynspec  # noqa: E402
 from scintools_tpu.fit import fit_arc_thetatheta  # noqa: E402
-from scintools_tpu.fit.wavefield import (_chunk_starts,  # noqa: E402
+from scintools_tpu.fit.wavefield import (field_overlap,  # noqa: E402
                                          refine_wavefield_global,
                                          retrieve_wavefield)
 from scintools_tpu.io import from_simulation  # noqa: E402
@@ -38,18 +38,9 @@ from scintools_tpu.sim import Simulation  # noqa: E402
 
 
 def chunk_overlap(A, B, cs=32):
-    """Gauge-invariant per-chunk fidelity vs the true field (mean of
-    Hann-windowed normalised inner products; random-phase floor ~0.03)."""
-    w = np.hanning(cs)[:, None] * np.hanning(cs)[None, :]
-    ovs = []
-    for cf in _chunk_starts(A.shape[0], cs):
-        for ct in _chunk_starts(A.shape[1], cs):
-            Ea, Eb = A[cf:cf + cs, ct:ct + cs], B[cf:cf + cs, ct:ct + cs]
-            den = np.sqrt(np.sum(np.abs(Ea) ** 2 * w)
-                          * np.sum(np.abs(Eb) ** 2 * w))
-            if den > 0:
-                ovs.append(abs(np.sum(Ea * np.conj(Eb) * w)) / den)
-    return float(np.mean(ovs))
+    """Mean of the package's canonical gauge-invariant fidelity metric
+    (fit.wavefield.field_overlap — the same definition CI uses)."""
+    return float(np.mean(field_overlap(A, B, cs)))
 
 
 def one(mb2, ar, seed=1234):

@@ -144,6 +144,31 @@ def test_slow_ft_sharpens_drifting_tone(rng):
     assert peak > 5 * np.median(prof)
 
 
+def test_native_ab_harness_vs_reference_c(capsys):
+    """benchmarks/nudft_native_ab.py compiles the reference's own C
+    kernel and verifies our C++ kernel agrees on identical inputs (the
+    speedup number is informational; the AGREEMENT is the test)."""
+    import json
+
+    import benchmarks.nudft_native_ab as AB
+
+    AB.main(sizes=(64,))
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.strip().splitlines()
+             if ln.startswith("{")]
+    assert lines, "no output"
+    rec = lines[-1]
+    # a numerics-mismatch record carries the measured rel_err — that is
+    # the regression this test exists to catch and must FAIL, never
+    # skip; only infrastructure unavailability (no gcc / no reference
+    # tree / no native build) may skip
+    assert rec.get("error") != "numerics mismatch", rec
+    if "error" in rec:
+        pytest.skip(f"native A/B unavailable: {rec['error']}")
+    assert rec["rel_err"] < 1e-9
+    assert rec["own_cpp_s"] > 0 and rec["reference_c_s"] > 0
+
+
 def test_slow_ft_power_sharded_matches_unsharded(rng):
     """Doppler-axis-sharded NUDFT over the 8-device CPU mesh agrees with
     the single-device jax path (SURVEY.md §5 long-context analogue)."""
