@@ -5,7 +5,7 @@ delay row of the secondary spectrum onto a row-specific normalised
 Doppler grid (static indices/weights [R, n]) and nanmean over rows.
 
 * :func:`row_scrunch_scan` — the PRODUCTION path for
-  ``arc_scrunch_rows > 0`` (the TPU auto default): a ``lax.scan`` over
+  ``arc_scrunch_rows > 0`` (the auto default on every target): a ``lax.scan`` over
   row blocks that bounds the working set to [block_r, n].  The arc
   fitter calls it directly.
 * :func:`row_scrunch_pallas` — EXPERIMENTAL fused kernel: gather +

@@ -84,8 +84,8 @@ class PipelineConfig:
     arc_brackets: tuple | None = None  # K (lo, hi) windows -> eta [B, K]
     # Arc delay-scrunch strategy: 0 = full [B, R, n] gather, >0 = lax.scan
     # row blocks of that size (bounded HBM), -1 = auto (64-row blocks on
-    # TPU — measured faster there both times it was profiled on chip —
-    # full gather elsewhere)
+    # every target: measured faster on chip both times it was profiled
+    # AND 1.4x faster on host CPU at B=16/64 — docs/performance.md)
     arc_scrunch_rows: int = -1
     # ACF-cut route for the scint fit: "fft" (padded 1-D FFTs, VPU),
     # "matmul" (Gram-matrix diagonal sums, MXU), or "auto" (matmul on
@@ -285,17 +285,19 @@ def _resolve_cuts(method: str, mesh, batch_shape=None,
     return "matmul" if _target_is_tpu(mesh) else "fft"
 
 
-# auto block size for arc_scrunch_rows=-1 on TPU: both on-chip profiles
-# (docs/performance.md) had 64-row scan blocks beating the full gather
-_AUTO_ARC_SCRUNCH_TPU = 64
+# auto block size for arc_scrunch_rows=-1: both on-chip profiles
+# (docs/performance.md) had 64-row scan blocks beating the full gather,
+# and the round-3 CPU profiles agree (1.40-1.42x at B=16/64, 256x512) —
+# the bounded working set wins on both targets, so auto is 64 everywhere
+_AUTO_ARC_SCRUNCH = 64
 
 
-def _resolve_arc_scrunch(config: "PipelineConfig", mesh) -> int:
+def _resolve_arc_scrunch(config: "PipelineConfig") -> int:
     """arc_scrunch_rows=-1 auto rule — the single source of truth shared
     by the step builder and the recorded route metadata."""
     rc = config.arc_scrunch_rows
     if rc == -1:
-        rc = _AUTO_ARC_SCRUNCH_TPU if _target_is_tpu(mesh) else 0
+        rc = _AUTO_ARC_SCRUNCH
     return int(rc)
 
 
@@ -312,7 +314,7 @@ def resolve_routes(config: "PipelineConfig", mesh=None,
     """
     return {"scint_cuts": _resolve_cuts(config.scint_cuts, mesh,
                                         batch_shape, itemsize),
-            "arc_scrunch_rows": _resolve_arc_scrunch(config, mesh),
+            "arc_scrunch_rows": _resolve_arc_scrunch(config),
             "target_is_tpu": bool(_target_is_tpu(mesh))}
 
 
@@ -449,7 +451,7 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
                         [f.profile_power for f in fits], axis=1))
 
             return multi
-        rc = _resolve_arc_scrunch(config, mesh)
+        rc = _resolve_arc_scrunch(config)
         return make_arc_fitter(
             fdop=fdop, yaxis=beta if config.lamsteps else tdel, tdel=tdel,
             freq=fc, lamsteps=config.lamsteps, method=config.arc_method,

@@ -134,8 +134,10 @@ def test_resolve_routes_cpu():
     from scintools_tpu.parallel import PipelineConfig, resolve_routes
 
     r = resolve_routes(PipelineConfig(), mesh=None)
-    # on the CPU test platform every auto knob resolves to the CPU route
-    assert r == {"scint_cuts": "fft", "arc_scrunch_rows": 0,
+    # on the CPU test platform: fft cuts, and the scan-block scrunch —
+    # 64 on EVERY target since the round-3 CPU profiles (1.4x over the
+    # full gather at B=16/64, docs/performance.md)
+    assert r == {"scint_cuts": "fft", "arc_scrunch_rows": 64,
                  "target_is_tpu": False}
     # explicit settings pass through unchanged
     r2 = resolve_routes(PipelineConfig(scint_cuts="matmul",
