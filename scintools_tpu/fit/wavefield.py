@@ -629,23 +629,40 @@ def refine_wavefield_global(field, dyn, df, dt, eta, iters: int = 30,
     re-anchored to the data.
     """
     dyn = np.asarray(dyn, dtype=np.float64)
-    nf_, nt_ = dyn.shape
     amp = np.sqrt(np.maximum(dyn, 0.0))
-    tau = np.fft.fftfreq(nf_, d=abs(df))          # us
-    fd = np.fft.fftfreq(nt_, d=abs(dt)) * 1e3     # mHz
-    dtau = abs(tau[1]) if nf_ > 1 else 1.0
-    mask = (np.abs(tau[:, None] - eta * fd[None, :] ** 2)
-            <= corridor_frac * abs(eta) * fd[None, :] ** 2
-            + corridor_floor_bins * dtau)
+    mask = arc_support_mask(dyn.shape, df, dt, eta,
+                            corridor_frac=corridor_frac,
+                            corridor_floor_bins=corridor_floor_bins)
     E = np.asarray(field, dtype=np.complex128)
     for _ in range(int(iters)):
         E = amp * np.exp(1j * np.angle(E))
-        E = np.fft.ifft2(np.fft.fft2(E) * mask)
+        E = arc_support_project(E, mask)
     flux = float(np.sum(np.maximum(dyn, 0.0)))
     model = float(np.sum(np.abs(E) ** 2))
     if model > 0:
         E = E * np.sqrt(flux / model)
     return E
+
+
+def arc_support_mask(shape, df, dt, eta, corridor_frac: float = 0.5,
+                     corridor_floor_bins: float = 5.0) -> np.ndarray:
+    """Boolean conjugate-plane corridor |tau - eta fd^2| <=
+    corridor_frac*|eta|*fd^2 + corridor_floor_bins*dtau on the UNSHIFTED
+    fft2 grid of a [nchan, nsub] field (tau us from df MHz, fd mHz from
+    dt s) — the support constraint of refine_wavefield_global."""
+    nf_, nt_ = shape
+    tau = np.fft.fftfreq(nf_, d=abs(df))          # us
+    fd = np.fft.fftfreq(nt_, d=abs(dt)) * 1e3     # mHz
+    dtau = abs(tau[1]) if nf_ > 1 else 1.0
+    return (np.abs(tau[:, None] - eta * fd[None, :] ** 2)
+            <= corridor_frac * abs(eta) * fd[None, :] ** 2
+            + corridor_floor_bins * dtau)
+
+
+def arc_support_project(E, mask):
+    """The (linear, idempotent) support projection: zero the field's
+    conjugate spectrum outside the corridor."""
+    return np.fft.ifft2(np.fft.fft2(E) * mask)
 
 
 def _stitch(E_chunks, conc, dyn, slots, chunk_shape, w2d, freqs, times,

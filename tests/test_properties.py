@@ -233,3 +233,90 @@ def test_row_scrunch_scan_equals_full_gather(rows, n, block_r, data):
     # tests) — values reach 1e3, so a few ulps of ~1e4 partial sums
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7,
                                equal_nan=True)
+
+
+@_SETTINGS
+@given(_finite_arrays(st.just((24, 24)), lo=-10, hi=10),
+       st.floats(0.05, 2.0), st.floats(0.1, 0.9))
+def test_refine_global_support_projection_idempotent(field, eta, frac):
+    """The PRODUCTION arc-corridor support projection
+    (fit.wavefield.arc_support_mask/arc_support_project — the exact
+    helpers refine_wavefield_global iterates) is a LINEAR PROJECTOR:
+    applying it twice must equal applying it once (to f.p. dust), and
+    the corridor must stay restrictive on these grids."""
+    from scintools_tpu.fit.wavefield import (arc_support_mask,
+                                             arc_support_project)
+
+    E = field + 1j * field[::-1]
+    mask = arc_support_mask(E.shape, 0.5, 10.0, eta, corridor_frac=frac)
+    assert mask.mean() < 0.9  # the constraint constrains
+
+    once = arc_support_project(E, mask)
+    twice = arc_support_project(once, mask)
+    np.testing.assert_allclose(twice, once, rtol=0, atol=1e-10 *
+                               max(np.abs(once).max(), 1.0))
+
+
+@_SETTINGS
+@given(_finite_arrays(st.just((20, 20)), lo=0.0, hi=10.0),
+       st.floats(0.3, 3.0), st.integers(1, 8))
+def test_refine_global_flux_anchor(dyn, eta, iters):
+    """refine_wavefield_global re-anchors total flux to the data for
+    ANY iteration count and corridor, whenever the refined field is
+    nonzero."""
+    from scintools_tpu.fit.wavefield import refine_wavefield_global
+
+    rng = np.random.default_rng(0)
+    field = rng.standard_normal(dyn.shape) + 1j * rng.standard_normal(
+        dyn.shape)
+    E = refine_wavefield_global(field, dyn, 0.5, 10.0, eta, iters=iters)
+    flux = np.sum(np.maximum(dyn, 0.0))
+    model = np.sum(np.abs(E) ** 2)
+    if model > 0 and flux > 0:
+        np.testing.assert_allclose(model, flux, rtol=1e-9)
+
+
+@_SETTINGS
+@given(_finite_arrays(st.tuples(st.integers(6, 12), st.integers(8, 16)),
+                      lo=0.0, hi=5.0),
+       st.permutations(list(range(6))))
+def test_zap_channels_flags_permutation_equivariant(dyn, perm):
+    """zap(method='channels') decides per channel from per-channel
+    statistics only, so permuting channels permutes the flagged set —
+    no positional bias."""
+    from scintools_tpu.data import DynspecData
+    from scintools_tpu.ops.clean import zap
+
+    nf = dyn.shape[0]
+    p = np.concatenate([np.asarray(perm), np.arange(6, nf)])
+    freqs = 1400.0 + 0.5 * np.arange(nf)
+    times = 10.0 * np.arange(dyn.shape[1])
+    base = DynspecData(dyn=dyn, freqs=freqs, times=times)
+    permuted = DynspecData(dyn=dyn[p], freqs=freqs, times=times)
+    bad_base = np.where(np.all(np.isnan(
+        np.asarray(zap(base, method="channels", sigma=3).dyn)), axis=1))[0]
+    bad_perm = np.where(np.all(np.isnan(
+        np.asarray(zap(permuted, method="channels", sigma=3).dyn)),
+        axis=1))[0]
+    np.testing.assert_array_equal(sorted(p[bad_perm]), sorted(bad_base))
+
+
+@_SETTINGS
+@given(_finite_arrays(st.just((32, 32)), lo=-5, hi=5),
+       st.floats(-np.pi, np.pi))
+def test_field_overlap_gauge_and_self_properties(field, phase):
+    """field_overlap is 1 against itself, invariant to a global phase,
+    and symmetric — the properties that make it a gauge-invariant
+    fidelity metric."""
+    from scintools_tpu.fit.wavefield import field_overlap
+
+    E = field + 1j * np.roll(field, 3, axis=0)
+    if not np.any(np.abs(E) > 1e-12):
+        return
+    ov_self = field_overlap(E, E, cs=16)
+    np.testing.assert_allclose(ov_self, 1.0, atol=1e-9)
+    ov_phase = field_overlap(E * np.exp(1j * phase), E, cs=16)
+    np.testing.assert_allclose(ov_phase, 1.0, atol=1e-9)
+    F = np.roll(E, 5, axis=1)
+    np.testing.assert_allclose(field_overlap(E, F, cs=16),
+                               field_overlap(F, E, cs=16), atol=1e-12)
