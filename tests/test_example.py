@@ -38,6 +38,15 @@ def test_arc_modelling_walkthrough(tmp_path):
                                                      rel=0.5)
     assert 0 < results["tau_posterior_err"] < results["tau_posterior"]
     assert (tmp_path / "posterior_corner.png").stat().st_size > 0
+    # section 10: the committed dirty fixture recovers through the
+    # survey cleaning recipe (golden values in test_dirty_fixture.py).
+    # The fixture is committed, so its absence is a broken checkout —
+    # fail with a message, not a KeyError
+    assert "dirty_betaeta" in results, \
+        "tests/data/J0000+0000_degraded.dynspec missing from checkout"
+    assert results["dirty_betaeta"] == pytest.approx(260.87, rel=1e-2)
+    assert results["dirty_tau"] > 0
+    assert (tmp_path / "dirty_cleaned_dyn.png").stat().st_size > 0
 
 
 @pytest.mark.slow
