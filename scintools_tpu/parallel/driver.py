@@ -83,9 +83,10 @@ class PipelineConfig:
     arc_asymm: bool = False       # per-arm eta_left/eta_right in ArcFit
     arc_brackets: tuple | None = None  # K (lo, hi) windows -> eta [B, K]
     # Arc delay-scrunch strategy: 0 = full [B, R, n] gather, >0 = lax.scan
-    # row blocks of that size (bounded HBM), -1 = auto (64-row blocks on
-    # every target: measured faster on chip both times it was profiled
-    # AND 1.4x faster on host CPU at B=16/64 — docs/performance.md)
+    # row blocks of that size (bounded HBM), -1 = auto: the scan beats
+    # the gather on every target, with a target-tuned block — 64 on chip
+    # (both on-chip profiles), 16 on host CPU (round-3 interleaved
+    # repeats: 1.45x over 64-row blocks — docs/performance.md)
     arc_scrunch_rows: int = -1
     # ACF-cut route for the scint fit: "fft" (padded 1-D FFTs, VPU),
     # "matmul" (Gram-matrix diagonal sums, MXU), or "auto" (matmul on
@@ -285,19 +286,23 @@ def _resolve_cuts(method: str, mesh, batch_shape=None,
     return "matmul" if _target_is_tpu(mesh) else "fft"
 
 
-# auto block size for arc_scrunch_rows=-1: both on-chip profiles
-# (docs/performance.md) had 64-row scan blocks beating the full gather,
-# and the round-3 CPU profiles agree (1.40-1.42x at B=16/64, 256x512) —
-# the bounded working set wins on both targets, so auto is 64 everywhere
-_AUTO_ARC_SCRUNCH = 64
+# auto block sizes for arc_scrunch_rows=-1: the scan beats the full
+# gather on BOTH targets, but the best block differs — 64 on chip (both
+# on-chip profiles, docs/performance.md) vs 16 on host CPU (round-3
+# interleaved repeats at B=64, 256x512: rc=16 ~36-38 dynspec/s vs rc=64
+# ~25.5, a stable 1.45x; rc=8 within noise of 16)
+_AUTO_ARC_SCRUNCH_TPU = 64
+_AUTO_ARC_SCRUNCH_CPU = 16
 
 
-def _resolve_arc_scrunch(config: "PipelineConfig") -> int:
+def _resolve_arc_scrunch(config: "PipelineConfig", mesh) -> int:
     """arc_scrunch_rows=-1 auto rule — the single source of truth shared
-    by the step builder and the recorded route metadata."""
+    by the step builder and the recorded route metadata.  Resolved at
+    TRACE time (like _resolve_cuts), never at build time."""
     rc = config.arc_scrunch_rows
     if rc == -1:
-        rc = _AUTO_ARC_SCRUNCH
+        rc = (_AUTO_ARC_SCRUNCH_TPU if _target_is_tpu(mesh)
+              else _AUTO_ARC_SCRUNCH_CPU)
     return int(rc)
 
 
@@ -314,7 +319,7 @@ def resolve_routes(config: "PipelineConfig", mesh=None,
     """
     return {"scint_cuts": _resolve_cuts(config.scint_cuts, mesh,
                                         batch_shape, itemsize),
-            "arc_scrunch_rows": _resolve_arc_scrunch(config),
+            "arc_scrunch_rows": _resolve_arc_scrunch(config, mesh),
             "target_is_tpu": bool(_target_is_tpu(mesh))}
 
 
@@ -451,7 +456,7 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
                         [f.profile_power for f in fits], axis=1))
 
             return multi
-        rc = _resolve_arc_scrunch(config)
+        rc = _resolve_arc_scrunch(config, mesh)
         return make_arc_fitter(
             fdop=fdop, yaxis=beta if config.lamsteps else tdel, tdel=tdel,
             freq=fc, lamsteps=config.lamsteps, method=config.arc_method,

@@ -131,13 +131,17 @@ def test_resolve_cuts_validation_and_size_gate(monkeypatch):
     assert _resolve_cuts("auto", None, (256, 128, 2048)) == "fft"
     monkeypatch.undo()
     assert _resolve_cuts("auto", None, (4, 64, 64)) == "fft"  # CPU target
-    # arc scrunch auto: 64-row scan blocks on EVERY target (on-chip
-    # profiles round 1-2; CPU profiles round 3 at B=16/64 both 1.4x
-    # over the full gather — docs/performance.md)
+    # arc scrunch auto: scan blocks on EVERY target, block size tuned
+    # per target — 64 on chip (on-chip profiles rounds 1-2), 16 on CPU
+    # (round-3 interleaved repeats: 1.45x over 64 — docs/performance.md)
     from scintools_tpu.parallel.driver import _resolve_arc_scrunch
 
-    assert _resolve_arc_scrunch(PipelineConfig()) == 64
-    assert _resolve_arc_scrunch(PipelineConfig(arc_scrunch_rows=0)) == 0
+    assert _resolve_arc_scrunch(PipelineConfig(), None) == 16  # CPU here
+    monkeypatch.setattr(drv, "_target_is_tpu", lambda mesh: True)
+    assert _resolve_arc_scrunch(PipelineConfig(), None) == 64
+    monkeypatch.undo()
+    assert _resolve_arc_scrunch(PipelineConfig(arc_scrunch_rows=0),
+                                None) == 0
     # the gate judges the PER-DEVICE working set (batch axis sharded over
     # the data mesh axis) and respects the actual dtype width
     from scintools_tpu.parallel.driver import _gram_bytes
