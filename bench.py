@@ -134,6 +134,7 @@ def serial_baseline(dyn, freqs, times, n_epochs: int) -> dict:
     dt = float(times[1] - times[0])
     per = []
 
+    n_quarantined = 0
     if mods is not None:
         impl = "reference (/root/reference/scintools, imported live)"
         note = ("scint LM fit step timed via this repo's numpy fitter: "
@@ -148,7 +149,7 @@ def serial_baseline(dyn, freqs, times, n_epochs: int) -> dict:
                 rd.fit_arc(lamsteps=True, numsteps=2000, plot=False,
                            display=False)
             except ValueError:
-                pass  # degenerate noise epoch: reference raises on it
+                n_quarantined += 1  # meaning documented at the record key
             rd.calc_acf()
             fit_scint_params(rd.acf, dt, df, d64.shape[0], d64.shape[1],
                              backend="numpy")
@@ -174,7 +175,7 @@ def serial_baseline(dyn, freqs, times, n_epochs: int) -> dict:
                 fit_arc(secsp, freq=float(np.mean(freqs)), numsteps=2000,
                         backend="numpy")
             except ValueError:
-                pass
+                n_quarantined += 1
             a = acf(d64, backend="numpy")
             fit_scint_params(a, dt, df, d64.shape[0], d64.shape[1],
                              backend="numpy")
@@ -190,6 +191,10 @@ def serial_baseline(dyn, freqs, times, n_epochs: int) -> dict:
         "iqr_s": round(q75 - q25, 4),
         "dispersion_pct": round(100.0 * (q75 - q25) / median, 1) if median else 0.0,
         "dynspec_per_s": round(1.0 / median, 3) if median else 0.0,
+        # degenerate epochs skip the reference's arc fit (it raises), so
+        # they run faster — the source of per-epoch IQR spread; the
+        # median is robust to it
+        "n_quarantined_epochs": int(n_quarantined),
     }
     if note:
         rec["note"] = note
