@@ -589,6 +589,16 @@ def field_overlap(A, B, cs: int = 32):
     definition."""
     A = np.asarray(A)
     B = np.asarray(B)
+    if A.shape != B.shape:
+        raise ValueError(f"field shapes differ: {A.shape} vs {B.shape}")
+    # Fields smaller than the chunk in either dimension: shrink the
+    # chunk (and its window) to fit rather than broadcasting a cs x cs
+    # Hann against a sub-cs tile.
+    cs = int(min(cs, A.shape[0], A.shape[1]))
+    if cs < 3:
+        # np.hanning(2) is all-zero — every chunk would have zero weight
+        raise ValueError(
+            f"field {A.shape} too small for field_overlap (min dim >= 3)")
     w = np.hanning(cs)[:, None] * np.hanning(cs)[None, :]
     ovs = []
     for cf in _chunk_starts(A.shape[0], cs):

@@ -206,7 +206,13 @@ def zap(d: DynspecData, method: str = "median", sigma: float = 7,
             # with a unit-variance regressor), scale-normalised
             mean_c = dyn0.sum(axis=1) / n
             trend = ((dyn0 - mean_c[:, None] * valid) * t).sum(axis=1) / n
-            trend = trend / np.maximum(np.abs(mean_c), 1e-30)
+            # No per-channel normalisation: dividing each channel's trend
+            # by |its own mean| distorts relative z-scores and, on
+            # mean-subtracted / band-corrected dynspecs (channel means
+            # ~ 0), explodes them and falsely excises clean channels.
+            # _robust_z below is invariant to any GLOBAL positive scale,
+            # so the raw covariance (flux-units trend per unit
+            # normalised time) is the right statistic as-is.
 
         def _robust_z(x):
             x = np.where(np.isfinite(x), x, np.nanmedian(x))

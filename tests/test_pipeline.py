@@ -136,6 +136,28 @@ def test_zap_channels_flags_drift_and_hot_not_clean(sim_dyn):
     assert not np.all(np.isnan(zp[5, :]))
 
 
+def test_zap_channels_mean_subtracted_no_false_excision(sim_dyn):
+    """Round-4 regression (ADVICE r3): on a mean-subtracted dynspec the
+    per-channel means sit near zero; the trend statistic must be
+    normalised by a GLOBAL robust flux scale, not the per-channel mean,
+    or clean channels' trend z-scores explode and get falsely excised."""
+    from scintools_tpu.ops.clean import zap
+
+    dyn = np.array(sim_dyn.dyn, dtype=np.float64)
+    dyn -= dyn.mean(axis=1, keepdims=True)      # channel means ~ 0
+    d = sim_dyn.replace(dyn=dyn)
+    z = zap(d, method="channels", sigma=4)
+    bad = np.where(np.all(np.isnan(np.asarray(z.dyn)), axis=1))[0]
+    assert len(bad) <= 2  # no mass false excision
+
+    # a genuine strong ramp on the subtracted data is still caught
+    dyn2 = dyn.copy()
+    scale = np.median(np.abs(np.asarray(sim_dyn.dyn)))
+    dyn2[7, :] += np.linspace(-5, 5, dyn.shape[1]) * scale
+    z2 = zap(sim_dyn.replace(dyn=dyn2), method="channels", sigma=4)
+    assert np.all(np.isnan(np.asarray(z2.dyn)[7, :]))
+
+
 def test_write_file_roundtrip(tmp_path, sim_dyn):
     ds = Dynspec(data=sim_dyn, process=False)
     fn = str(tmp_path / "rt.dynspec")

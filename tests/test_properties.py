@@ -320,3 +320,27 @@ def test_field_overlap_gauge_and_self_properties(field, phase):
     F = np.roll(E, 5, axis=1)
     np.testing.assert_allclose(field_overlap(E, F, cs=16),
                                field_overlap(F, E, cs=16), atol=1e-12)
+
+
+def test_field_overlap_small_field_clamps_chunk():
+    """Round-4 regression (ADVICE r3): fields smaller than cs in either
+    dimension must not crash — the chunk clamps to the field size and
+    the self-overlap is still 1.  Mismatched shapes raise."""
+    from scintools_tpu.fit.wavefield import field_overlap
+
+    rng = np.random.default_rng(0)
+    E = rng.normal(size=(8, 40)) + 1j * rng.normal(size=(8, 40))
+    ov = field_overlap(E, E, cs=32)          # nf=8 < cs
+    assert ov.size > 0
+    np.testing.assert_allclose(ov, 1.0, atol=1e-9)
+    ov2 = field_overlap(E[:3, :5], E[:3, :5], cs=32)
+    assert ov2.size > 0
+    np.testing.assert_allclose(ov2, 1.0, atol=1e-9)
+    import pytest
+    with pytest.raises(ValueError):
+        field_overlap(E, E[:, :10], cs=16)
+    # min dim < 3: np.hanning(2) is all-zero, must raise not return []
+    with pytest.raises(ValueError):
+        field_overlap(E[:2, :], E[:2, :], cs=16)
+    with pytest.raises(ValueError):
+        field_overlap(E[:1, :1], E[:1, :1], cs=16)
