@@ -103,10 +103,14 @@ def serial_baseline(dyn, freqs, times, n_epochs: int) -> dict:
 
     Chain per epoch (reference symbols): calc_sspec(lamsteps=True) —
     which internally runs scale_dyn — then fit_arc(norm_sspec), then
-    calc_acf, then the tau/dnu LM fit.  The reference's get_scint_params
-    hard-imports lmfit (not installed here), so that one step is timed
-    via this repo's numpy LM fitter (same residual model, same data) and
-    the substitution is labelled in the returned record.
+    calc_acf, then the reference's own get_scint_params run VERBATIM:
+    its hard lmfit import is satisfied by tests/lmfit_shim.py, a minimal
+    Parameters/Minimizer over scipy.optimize.leastsq (which is exactly
+    what lmfit wraps), so no step of the denominator is substituted.
+    The record still quantifies what the round-3 substitution was worth:
+    ``scint_substitute_delta_s`` is the median per-epoch time difference
+    between the verbatim reference step and the repo numpy fitter that
+    round 3 timed in its place.
 
     Falls back to the repo's reference-equivalent numpy chain (oracle
     bit-matched by tests/test_oracle_parity.py) if the reference tree is
@@ -121,6 +125,12 @@ def serial_baseline(dyn, freqs, times, n_epochs: int) -> dict:
         from reference_oracle import make_ref_dynspec, reference_modules
 
         mods = reference_modules()
+        if mods is not None:
+            # satisfy the reference's hard lmfit/corner imports so its
+            # get_scint_params runs verbatim (no-op if real lmfit exists)
+            import lmfit_shim
+
+            lmfit_shim.install()
     except Exception:
         mods = None
     finally:
@@ -135,10 +145,12 @@ def serial_baseline(dyn, freqs, times, n_epochs: int) -> dict:
     per = []
 
     n_quarantined = 0
+    scint_deltas = []
     if mods is not None:
         impl = "reference (/root/reference/scintools, imported live)"
-        note = ("scint LM fit step timed via this repo's numpy fitter: "
-                "reference get_scint_params requires lmfit (not installed)")
+        note = ("get_scint_params runs the reference code verbatim via "
+                "tests/lmfit_shim.py (scipy.optimize.leastsq — the "
+                "optimizer lmfit itself wraps)")
         for i in range(n_epochs):
             d64 = np.asarray(dyn[i], dtype=np.float64)
             d = DynspecData(dyn=d64, freqs=freqs, times=times)
@@ -151,9 +163,16 @@ def serial_baseline(dyn, freqs, times, n_epochs: int) -> dict:
             except ValueError:
                 n_quarantined += 1  # meaning documented at the record key
             rd.calc_acf()
+            ts0 = time.perf_counter()
+            rd.get_scint_params(plot=False, display=False)
+            t_ref_scint = time.perf_counter() - ts0
+            per.append(time.perf_counter() - t0)
+            # off the clock: what the round-3 substitute step would have
+            # cost on the same data, to quantify the removed substitution
+            ts0 = time.perf_counter()
             fit_scint_params(rd.acf, dt, df, d64.shape[0], d64.shape[1],
                              backend="numpy")
-            per.append(time.perf_counter() - t0)
+            scint_deltas.append(t_ref_scint - (time.perf_counter() - ts0))
     else:
         from scintools_tpu.data import SecSpec
         from scintools_tpu.fit import fit_arc
@@ -196,6 +215,11 @@ def serial_baseline(dyn, freqs, times, n_epochs: int) -> dict:
         # median is robust to it
         "n_quarantined_epochs": int(n_quarantined),
     }
+    if scint_deltas:
+        # positive = the verbatim reference step is SLOWER than the
+        # round-3 substitute (i.e. the old baseline was conservative)
+        rec["scint_substitute_delta_s"] = round(
+            float(np.median(scint_deltas)), 4)
     if note:
         rec["note"] = note
     return rec
@@ -348,13 +372,20 @@ def main():
             from types import SimpleNamespace
 
             from scintools_tpu.utils.roofline import (device_peaks,
+                                                      measure_host_peaks,
                                                       roofline_record)
 
             # a cpu-fallback rate was NOT measured on the probed chip:
-            # judging it against TPU peaks/routes would be meaningless
+            # judging it against TPU peaks/routes would be meaningless —
+            # measure THIS host's peaks instead so the record still
+            # carries mfu_pct / roofline_pct (round-4: every headline
+            # defends its roofline gap, fallback included)
             kind = "" if is_fallback else (probe.get("device_kind") or "")
-            peaks = device_peaks(SimpleNamespace(device_kind=kind)) \
-                if kind else {}
+            if is_fallback:
+                peaks = measure_host_peaks()
+            else:
+                peaks = device_peaks(SimpleNamespace(device_kind=kind)) \
+                    if kind else {}
             on_tpu = (not is_fallback
                       and ("tpu" in kind.lower()
                            or probe.get("platform") in ("tpu", "axon")))

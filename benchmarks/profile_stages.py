@@ -70,9 +70,14 @@ def main():
 
     from scintools_tpu.parallel.driver import _resolve_cuts
     from scintools_tpu.utils.roofline import (device_peaks,
+                                              measure_host_peaks,
                                               pipeline_epoch_model)
 
     peaks = device_peaks()
+    if not peaks.get("peak_tflops") and jax.devices()[0].platform == "cpu":
+        # CPU run (tests / wedged-tunnel fallback): measure THIS host's
+        # peaks so the %MFU / %roof columns are never silently absent
+        peaks = measure_host_peaks()
     if peaks.get("peak_tflops"):
         print(f"# roofline peaks: {peaks['device_kind']} "
               f"{peaks['peak_tflops']} TFLOP/s, {peaks['peak_gbs']} GB/s "
@@ -111,6 +116,14 @@ def main():
             roof += f"  {0.1 * gflops / peaks['peak_tflops']:5.2f}%MFU"
         if peaks.get("peak_gbs"):
             roof += f" {100.0 * gbs / peaks['peak_gbs']:5.1f}%BW"
+        if peaks.get("peak_tflops") and peaks.get("peak_gbs"):
+            # % of the roofline ceiling at this row's arithmetic
+            # intensity: min(peak_flops, AI * peak_bw) — the one number
+            # each row must defend (see utils/roofline.roofline_record)
+            ai = model["total"]["flops"] / model["total"]["bytes"]
+            ceil_gf = min(peaks["peak_tflops"] * 1e3,
+                          ai * peaks["peak_gbs"])
+            roof += f" {100.0 * gflops / ceil_gf:5.1f}%roof"
         print(f"{name:22s} {dt * 1e3:9.2f} ms/batch  "
               f"{B / dt:9.0f} dynspec/s {roof}  (compile {compile_s:.1f}s)")
 

@@ -90,3 +90,29 @@ def test_epoch_model_sanity_magnitude():
     MFU headline is garbage."""
     f = pipeline_epoch_model(256, 512)["total"]["flops"]
     assert 1e8 < f < 1e10
+
+
+def test_roofline_pct_against_ai_implied_ceiling():
+    """roofline_pct judges the rate against min(peak_flops, AI*peak_bw):
+    for this pipeline's AI (~a few flop/byte) on a v4-like chip the bound
+    is bandwidth, and the fraction equals achieved_bytes/peak_bytes."""
+    peaks = {"device_kind": "TPU v4", "peak_tflops": 275.0,
+             "peak_gbs": 1228.0, "source": "test"}
+    rec = roofline_record(100.0, 256, 512, peaks=peaks)
+    m = pipeline_epoch_model(256, 512)["total"]
+    ai = m["flops"] / m["bytes"]
+    assert ai * 1228e9 < 275e12  # bandwidth-bound at this AI
+    assert rec["roofline_bound"] == "bandwidth"
+    assert rec["roofline_pct"] == pytest.approx(
+        100.0 * 100.0 * m["bytes"] / 1228e9, rel=2e-2)
+    # bandwidth-bound => roofline_pct coincides with hbm_pct
+    assert rec["roofline_pct"] == pytest.approx(rec["hbm_pct"], rel=2e-2)
+
+
+def test_measure_host_peaks_shape():
+    from scintools_tpu.utils.roofline import measure_host_peaks
+
+    p = measure_host_peaks(matmul_n=256, copy_mb=32, iters=1)
+    assert p["device_kind"] == "host-cpu"
+    assert p["peak_tflops"] > 0 and p["peak_gbs"] > 0
+    assert p["source"].startswith("measured on this host")
