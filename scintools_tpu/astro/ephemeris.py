@@ -17,7 +17,12 @@ Accuracy (vs JPL ephemerides, dominated by the neglected Earth-Moon
 separation of ~4700 km and perturbations of the inner planets):
 position ~1e-4 AU (=> Romer-delay error <~0.1 s out of +-500 s), velocity
 ~0.02 km/s (Earth's orbital speed is ~30 km/s; Earth's motion about the
-EMB contributes ~0.012 km/s).  This is far below the km/s-scale effective
+EMB contributes ~0.012 km/s).  These bounds are a REGRESSION TEST, not a
+claim: tests/test_astro.py pins this module against a committed golden
+table (tests/data/earth_ephemeris_golden.json) generated from an
+independent truncated-VSOP87D + IAU-precession truth source
+(tests/vsop87_truth.py; measured headroom ~7e-5 AU / ~0.014 km/s over
+1990-2040).  This is far below the km/s-scale effective
 velocities the scintillation models fit (models/velocity.py), and well
 below typical vism uncertainties.
 

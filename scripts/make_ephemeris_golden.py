@@ -1,0 +1,34 @@
+"""Regenerate tests/data/earth_ephemeris_golden.json from the
+independent VSOP87-based truth source (tests/vsop87_truth.py).
+
+The committed table is the external anchor for the production analytic
+ephemeris's documented accuracy bounds (astro/ephemeris.py: <=1e-4 AU,
+<=0.02 km/s); tests/test_astro.py asserts both the production module
+against the table AND the generator against the table (so silent edits
+to either side fail).
+
+Usage: python scripts/make_ephemeris_golden.py
+"""
+
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(_HERE, "tests"))
+
+import vsop87_truth  # noqa: E402
+
+
+def main():
+    table = vsop87_truth.make_golden_table()
+    out = os.path.join(_HERE, "tests", "data",
+                       "earth_ephemeris_golden.json")
+    with open(out, "w") as f:
+        json.dump(table, f, indent=1)
+        f.write("\n")
+    print(f"wrote {out} ({len(table['epochs'])} epochs)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,455 @@
+"""Independent Earth-ephemeris truth source for pinning the production
+analytic ephemeris (scintools_tpu/astro/ephemeris.py) to external data.
+
+The round-3 verdict required the documented accuracy bounds (<=1e-4 AU,
+<=0.02 km/s vs JPL) to become a regression test against external truth.
+This image has no astropy/jplephem and no network, so the truth here is
+built from PUBLISHED series data, implemented independently of the
+production code path:
+
+* Earth heliocentric position from the truncated VSOP87D series
+  (Bretagnon & Francou 1988), coefficients as tabulated in Meeus,
+  "Astronomical Algorithms" (2nd ed.), Table 32.a — the standard public
+  truncation, accurate to ~1" in longitude / ~1e-6..1e-5 AU in position
+  over 1900-2100, i.e. an order of magnitude tighter than the 1e-4 AU
+  bound being asserted.
+* VSOP87D is referred to the ecliptic and equinox OF DATE; positions are
+  rotated to the J2000 equatorial frame via the mean obliquity of date
+  (Meeus 22.2) and the IAU 1976 precession angles zeta/z/theta
+  (Meeus 21.2), applied as the transpose of the J2000->date matrix.
+* The Sun's offset from the solar-system barycenter is reconstructed
+  from an INDEPENDENT re-implementation of the giant-planet Keplerian
+  propagation (Standish's published 1800-2050 mean elements — the same
+  public table the production module cites, but fresh code, so a sign or
+  frame bug in the production barycenter would NOT be replicated here).
+  The giants' element errors (<~1e-3 AU) enter the barycenter scaled by
+  their mass ratios (~1e-3), contributing <~1e-6 AU.
+* Velocity by central finite differences (+-0.05 d): the truncation
+  error ~ n^3 dt^2 / 6 ~ 2e-9 AU/d is negligible.
+
+Overall truth error budget vs JPL: ~1e-5 AU position, ~2e-3 km/s
+velocity — sufficient to *assert* production's 1e-4 AU / 0.02 km/s.
+
+This module generates tests/data/earth_ephemeris_golden.json (via
+scripts/make_ephemeris_golden.py) and is itself regression-locked by the
+committed table.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# --- VSOP87D Earth series, Meeus Table 32.a -----------------------------
+# Each term: (A [1e-8 rad or 1e-8 AU], B [rad], C [rad / Julian
+# millennium]); series value = sum_k tau^k * sum_i A cos(B + C tau).
+
+_L0 = [
+    (175347046.0, 0.0, 0.0),
+    (3341656.0, 4.6692568, 6283.0758500),
+    (34894.0, 4.62610, 12566.15170),
+    (3497.0, 2.7441, 5753.3849),
+    (3418.0, 2.8289, 3.5231),
+    (3136.0, 3.6277, 77713.7715),
+    (2676.0, 4.4181, 7860.4194),
+    (2343.0, 6.1352, 3930.2097),
+    (1324.0, 0.7425, 11506.7698),
+    (1273.0, 2.0371, 529.6910),
+    (1199.0, 1.1096, 1577.3435),
+    (990.0, 5.2330, 5884.9270),
+    (902.0, 2.0450, 26.2980),
+    (857.0, 3.5080, 398.1490),
+    (780.0, 1.1790, 5223.6940),
+    (753.0, 2.5330, 5507.5530),
+    (505.0, 4.5830, 18849.2280),
+    (492.0, 4.2050, 775.5230),
+    (357.0, 2.9200, 0.0670),
+    (317.0, 5.8490, 11790.6290),
+    (284.0, 1.8990, 796.2980),
+    (271.0, 0.3150, 10977.0790),
+    (243.0, 0.3450, 5486.7780),
+    (206.0, 4.8060, 2544.3140),
+    (205.0, 1.8690, 5573.1430),
+    (202.0, 2.4580, 6069.7770),
+    (156.0, 0.8330, 213.2990),
+    (132.0, 3.4110, 2942.4630),
+    (126.0, 1.0830, 20.7750),
+    (115.0, 0.6450, 0.9800),
+    (103.0, 0.6360, 4694.0030),
+    (102.0, 0.9760, 15720.8390),
+    (102.0, 4.2670, 7.1140),
+    (99.0, 6.2100, 2146.1700),
+    (98.0, 0.6800, 155.4200),
+    (86.0, 5.9800, 161000.6900),
+    (85.0, 1.3000, 6275.9600),
+    (85.0, 3.6700, 71430.7000),
+    (80.0, 1.8100, 17260.1500),
+    (79.0, 3.0400, 12036.4600),
+    (75.0, 1.7600, 5088.6300),
+    (74.0, 3.5000, 3154.6900),
+    (74.0, 4.6800, 801.8200),
+    (70.0, 0.8300, 9437.7600),
+    (62.0, 3.9800, 8827.3900),
+    (61.0, 1.8200, 7084.9000),
+    (57.0, 2.7800, 6286.6000),
+    (56.0, 4.3900, 14143.5000),
+    (56.0, 3.4700, 6279.5500),
+    (52.0, 0.1900, 12139.5500),
+    (52.0, 1.3300, 1748.0200),
+    (51.0, 0.2800, 5856.4800),
+    (49.0, 0.4900, 1194.4500),
+    (41.0, 5.3700, 8429.2400),
+    (41.0, 2.4000, 19651.0500),
+    (39.0, 6.1700, 10447.3900),
+    (37.0, 6.0400, 10213.2900),
+    (37.0, 2.5700, 1059.3800),
+    (36.0, 1.7100, 2352.8700),
+    (36.0, 1.7800, 6812.7700),
+    (33.0, 0.5900, 17789.8500),
+    (30.0, 0.4400, 83996.8500),
+    (30.0, 2.7400, 1349.8700),
+    (25.0, 3.1600, 4690.4800),
+]
+_L1 = [
+    (628331966747.0, 0.0, 0.0),
+    (206059.0, 2.678235, 6283.075850),
+    (4303.0, 2.63512, 12566.15170),
+    (425.0, 1.5900, 3.5230),
+    (119.0, 5.7960, 26.2980),
+    (109.0, 2.9660, 1577.3440),
+    (93.0, 2.5900, 18849.2300),
+    (72.0, 1.1400, 529.6900),
+    (68.0, 1.8700, 398.1500),
+    (67.0, 4.4100, 5507.5500),
+    (59.0, 2.8900, 5223.6900),
+    (56.0, 2.1700, 155.4200),
+    (45.0, 0.4000, 796.3000),
+    (36.0, 0.4700, 775.5200),
+    (29.0, 2.6500, 7.1100),
+    (21.0, 5.3400, 0.9800),
+    (19.0, 1.8500, 5486.7800),
+    (19.0, 4.9700, 213.3000),
+    (17.0, 2.9900, 6275.9600),
+    (16.0, 0.0300, 2544.3100),
+    (16.0, 1.4300, 2146.1700),
+    (15.0, 1.2100, 10977.0800),
+    (12.0, 2.8300, 1748.0200),
+    (12.0, 3.2600, 5088.6300),
+    (12.0, 5.2700, 1194.4500),
+    (12.0, 2.0800, 4694.0000),
+    (11.0, 0.7700, 553.5700),
+    (10.0, 1.3000, 6286.6000),
+    (10.0, 4.2400, 1349.8700),
+    (9.0, 2.7000, 242.7300),
+    (9.0, 5.6400, 951.7200),
+    (8.0, 5.3000, 2352.8700),
+    (6.0, 2.6500, 9437.7600),
+    (6.0, 4.6700, 4690.4800),
+]
+_L2 = [
+    (52919.0, 0.0, 0.0),
+    (8720.0, 1.0721, 6283.0758),
+    (309.0, 0.8670, 12566.1520),
+    (27.0, 0.0500, 3.5200),
+    (16.0, 5.1900, 26.3000),
+    (16.0, 3.6800, 155.4200),
+    (10.0, 0.7600, 18849.2300),
+    (9.0, 2.0600, 77713.7700),
+    (7.0, 0.8300, 775.5200),
+    (5.0, 4.6600, 1577.3400),
+    (4.0, 1.0300, 7.1100),
+    (4.0, 3.4400, 5573.1400),
+    (3.0, 5.1400, 796.3000),
+    (3.0, 6.0500, 5507.5500),
+    (3.0, 1.1900, 242.7300),
+    (3.0, 6.1200, 529.6900),
+    (3.0, 0.3100, 398.1500),
+    (3.0, 2.2800, 553.5700),
+    (2.0, 4.3800, 5223.6900),
+    (2.0, 3.7500, 0.9800),
+]
+_L3 = [
+    (289.0, 5.8440, 6283.0760),
+    (35.0, 0.0, 0.0),
+    (17.0, 5.4900, 12566.1500),
+    (3.0, 5.2000, 155.4200),
+    (1.0, 4.7200, 3.5200),
+    (1.0, 5.3000, 18849.2300),
+    (1.0, 5.9700, 242.7300),
+]
+_L4 = [
+    (114.0, 3.1420, 0.0),
+    (8.0, 4.1300, 6283.0800),
+    (1.0, 3.8400, 12566.1500),
+]
+_L5 = [(1.0, 3.1400, 0.0)]
+
+_B0 = [
+    (280.0, 3.1990, 84334.6620),
+    (102.0, 5.4220, 5507.5530),
+    (80.0, 3.8800, 5223.6900),
+    (44.0, 3.7000, 2352.8700),
+    (32.0, 4.0000, 1577.3400),
+]
+_B1 = [
+    (9.0, 3.9000, 5507.5500),
+    (6.0, 1.7300, 5223.6900),
+]
+
+_R0 = [
+    (100013989.0, 0.0, 0.0),
+    (1670700.0, 3.0984635, 6283.0758500),
+    (13956.0, 3.05525, 12566.15170),
+    (3084.0, 5.1985, 77713.7715),
+    (1628.0, 1.1739, 5753.3849),
+    (1576.0, 2.8469, 7860.4194),
+    (925.0, 5.4530, 11506.7700),
+    (542.0, 4.5640, 3930.2100),
+    (472.0, 3.6610, 5884.9270),
+    (346.0, 0.9640, 5507.5530),
+    (329.0, 5.9000, 5223.6940),
+    (307.0, 0.2990, 5573.1430),
+    (243.0, 4.2730, 11790.6290),
+    (212.0, 5.8470, 1577.3440),
+    (186.0, 5.0220, 10977.0790),
+    (175.0, 3.0120, 18849.2280),
+    (110.0, 5.0550, 5486.7780),
+    (98.0, 0.8900, 6069.7800),
+    (86.0, 5.6900, 15720.8400),
+    (86.0, 1.2700, 161000.6900),
+    (65.0, 0.2700, 17260.1500),
+    (63.0, 0.9200, 529.6900),
+    (57.0, 2.0100, 83996.8500),
+    (56.0, 5.2400, 71430.7000),
+    (49.0, 3.2500, 2544.3100),
+    (47.0, 2.5800, 775.5200),
+    (45.0, 5.5400, 9437.7600),
+    (43.0, 6.0100, 6275.9600),
+    (39.0, 5.3600, 4694.0000),
+    (38.0, 2.3900, 8827.3900),
+    (37.0, 0.8300, 19651.0500),
+    (37.0, 4.9000, 12139.5500),
+    (36.0, 1.6700, 12036.4600),
+    (35.0, 1.8400, 2942.4600),
+    (33.0, 0.2400, 7084.9000),
+    (32.0, 0.1800, 5088.6300),
+    (32.0, 1.7800, 398.1500),
+    (28.0, 1.2100, 6286.6000),
+    (28.0, 1.9000, 6279.5500),
+    (26.0, 4.5900, 10447.3900),
+]
+_R1 = [
+    (103019.0, 1.107490, 6283.075850),
+    (1721.0, 1.0644, 12566.1517),
+    (702.0, 3.1420, 0.0),
+    (32.0, 1.0200, 18849.2300),
+    (31.0, 2.8400, 5507.5500),
+    (25.0, 1.3200, 5223.6900),
+    (18.0, 1.4200, 1577.3400),
+    (10.0, 5.9100, 10977.0800),
+    (9.0, 1.4200, 6275.9600),
+    (9.0, 0.2700, 5486.7800),
+]
+_R2 = [
+    (4359.0, 5.7846, 6283.0758),
+    (124.0, 5.5790, 12566.1520),
+    (12.0, 3.1400, 0.0),
+    (9.0, 3.6300, 77713.7700),
+    (6.0, 1.8700, 5573.1400),
+    (3.0, 5.4700, 18849.2300),
+]
+_R3 = [
+    (145.0, 4.2730, 6283.0760),
+    (7.0, 3.9200, 12566.1500),
+]
+_R4 = [(4.0, 2.5600, 6283.0800)]
+
+
+def _series(terms_by_power, tau):
+    tau = np.asarray(tau, dtype=np.float64)
+    total = np.zeros_like(tau)
+    for k, terms in enumerate(terms_by_power):
+        t = np.array(terms, dtype=np.float64)  # [n, 3]
+        s = np.sum(t[:, 0] * np.cos(t[:, 1] + t[:, 2] * tau[..., None]),
+                   axis=-1)
+        total = total + s * tau ** k
+    return total * 1e-8
+
+
+def earth_heliocentric_lbr(mjd):
+    """VSOP87D Earth heliocentric (L, B, R): longitude/latitude [rad],
+    ecliptic and equinox OF DATE, radius [AU].  TDB MJD in, arrays out."""
+    tau = (np.asarray(mjd, dtype=np.float64) - 51544.5) / 365250.0
+    L = _series([_L0, _L1, _L2, _L3, _L4, _L5], tau)
+    B = _series([_B0, _B1], tau)
+    R = _series([_R0, _R1, _R2, _R3, _R4], tau)
+    return np.mod(L, 2 * np.pi), B, R
+
+
+def _rx(a):
+    c, s = np.cos(a), np.sin(a)
+    return np.array([[1, 0, 0], [0, c, s], [0, -s, c]])
+
+
+def _rz(a):
+    c, s = np.cos(a), np.sin(a)
+    return np.array([[c, s, 0], [-s, c, 0], [0, 0, 1]])
+
+
+def _ry(a):
+    c, s = np.cos(a), np.sin(a)
+    return np.array([[c, 0, -s], [0, 1, 0], [s, 0, c]])
+
+
+_ARCSEC = np.pi / (180.0 * 3600.0)
+
+
+def _precession_date_to_j2000(mjd):
+    """Rotation matrix: mean equatorial frame of date -> J2000 mean
+    equatorial frame.  IAU 1976 angles (Meeus 21.2), J2000->date matrix
+    P = Rz(-z) Ry(theta) Rz(-zeta); returned is its transpose."""
+    T = (float(mjd) - 51544.5) / 36525.0
+    zeta = (2306.2181 * T + 0.30188 * T ** 2 + 0.017998 * T ** 3) * _ARCSEC
+    z = (2306.2181 * T + 1.09468 * T ** 2 + 0.018203 * T ** 3) * _ARCSEC
+    theta = (2004.3109 * T - 0.42665 * T ** 2 - 0.041833 * T ** 3) * _ARCSEC
+    P = _rz(-z) @ _ry(theta) @ _rz(-zeta)
+    return P.T
+
+
+def _mean_obliquity(mjd):
+    T = (float(mjd) - 51544.5) / 36525.0
+    eps_arcsec = (23.0 * 3600 + 26.0 * 60 + 21.448
+                  - 46.8150 * T - 0.00059 * T ** 2 + 0.001813 * T ** 3)
+    return eps_arcsec * _ARCSEC
+
+
+def earth_heliocentric_j2000_equatorial(mjd):
+    """Earth heliocentric position [AU] in the J2000 mean equatorial
+    frame (scalar mjd -> length-3 vector)."""
+    L, B, R = earth_heliocentric_lbr(mjd)
+    x = R * np.cos(B) * np.cos(L)
+    y = R * np.cos(B) * np.sin(L)
+    zc = R * np.sin(B)
+    ecl_date = np.array([x, y, zc], dtype=np.float64)
+    # ecliptic of date -> equatorial of date (rotate about x by -eps)
+    eq_date = _rx(-_mean_obliquity(mjd)) @ ecl_date
+    return _precession_date_to_j2000(mjd) @ eq_date
+
+
+# --- independent giant-planet barycenter correction ---------------------
+# Standish approximate Keplerian elements 1800-2050 (public JPL table):
+# a [AU] (+rate/cy), e (+rate), I [deg] (+rate), L [deg] (+rate),
+# long.peri [deg] (+rate), Omega [deg] (+rate).  Fresh implementation —
+# matrix rotations and its own Newton solve, sharing no code with
+# scintools_tpu.astro.ephemeris.
+_GIANTS = {
+    "jupiter": ([5.20288700, 0.04838624, 1.30439695, 34.39644051,
+                 14.72847983, 100.47390909],
+                [-0.00011607, -0.00013253, -0.00183714, 3034.74612775,
+                 0.21252668, 0.20469106], 9.5479194e-4),
+    "saturn": ([9.53667594, 0.05386179, 2.48599187, 49.95424423,
+                92.59887831, 113.66242448],
+               [-0.00125060, -0.00050991, 0.00193609, 1222.49362201,
+                -0.41897216, -0.28867794], 2.8588567e-4),
+    "uranus": ([19.18916464, 0.04725744, 0.77263783, 313.23810451,
+                170.95427630, 74.01692503],
+               [-0.00196176, -0.00004397, -0.00242939, 428.48202785,
+                0.40805281, 0.04240589], 4.3662440e-5),
+    "neptune": ([30.06992276, 0.00859048, 1.77004347, -55.12002969,
+                 44.96476227, 131.78422574],
+                [0.00026291, 0.00005105, 0.00035372, 218.45945325,
+                 -0.32241464, -0.00508664], 5.1513890e-5),
+}
+
+
+def _giant_heliocentric_ecliptic_j2000(name, mjd):
+    """Heliocentric position [AU] of a giant planet, J2000 ecliptic."""
+    el0, rate, _ = _GIANTS[name]
+    T = (float(mjd) - 51544.5) / 36525.0
+    a, e, inc, L, lperi, Omega = (v0 + r * T for v0, r in zip(el0, rate))
+    inc, L, lperi, Omega = (np.deg2rad(v) for v in (inc, L, lperi, Omega))
+    omega = lperi - Omega
+    M = np.mod(L - lperi + np.pi, 2 * np.pi) - np.pi
+    E = M
+    for _ in range(20):
+        E = E - (E - e * np.sin(E) - M) / (1.0 - e * np.cos(E))
+    xo = a * (np.cos(E) - e)
+    yo = a * np.sqrt(1 - e * e) * np.sin(E)
+    orb = np.array([xo, yo, 0.0])
+    # orbital plane -> J2000 ecliptic: Rz(-Omega) Rx(-inc) Rz(-omega)
+    return _rz(-Omega) @ _rx(-inc) @ _rz(-omega) @ orb
+
+
+def sun_barycentric_offset_j2000_equatorial(mjd):
+    """Sun's position wrt the solar-system barycenter [AU], J2000
+    equatorial: -sum(m_p r_p) / (M_sun + sum m_p) over the four giants
+    (inner planets contribute < 5e-7 AU)."""
+    mtot = 1.0 + sum(mu for *_, mu in _GIANTS.values())
+    acc = np.zeros(3)
+    for name, (_, _, mu) in _GIANTS.items():
+        acc = acc - (mu / mtot) * _giant_heliocentric_ecliptic_j2000(
+            name, mjd)
+    eps0 = _mean_obliquity(51544.5)
+    return _rx(-eps0) @ acc
+
+
+def earth_barycentric_state(mjd, dt_days: float = 0.05):
+    """TRUTH: Earth barycentric position [AU] and velocity [km/s] in the
+    J2000 equatorial frame, scalar mjd -> two length-3 vectors.
+
+    Earth proper (VSOP87D is the Earth, not the EMB) + Sun-SSB offset;
+    velocity by central differences over +-dt_days."""
+    def pos(m):
+        return (earth_heliocentric_j2000_equatorial(m)
+                + sun_barycentric_offset_j2000_equatorial(m))
+
+    p = pos(mjd)
+    v_au_day = (pos(mjd + dt_days) - pos(mjd - dt_days)) / (2 * dt_days)
+    AU_KM, DAY_S = 1.495978707e8, 86400.0
+    return p, v_au_day * (AU_KM / DAY_S)
+
+
+GOLDEN_MJDS = [
+    47892.0,    # 1990-01-01
+    48257.0,    # 1991-01-01
+    49718.0,    # 1995-01-01
+    50814.0,    # 1998-01-01
+    51544.5,    # J2000.0 epoch (2000-01-01.5)
+    52275.25,   # 2002-01-01.25 (fractional day)
+    53371.0,    # 2005-01-01
+    54466.0,    # 2008-01-01
+    55562.0,    # 2011-01-01
+    56658.0,    # 2014-01-01
+    57754.0,    # 2017-01-01
+    58849.0,    # 2020-01-01
+    59945.75,   # 2023-01-01.75 (fractional day)
+    61041.0,    # 2026-01-01
+    62137.0,    # 2029-01-01
+    63232.0,    # 2032-01-01
+    64328.0,    # 2035-01-01
+    65424.0,    # 2038-01-01
+    66154.0,    # 2040-01-01
+    59215.5,    # 2021-01-01.5 (mid-year-offset check: 2021 perihelion side)
+    58666.0,    # 2019-07-02 (aphelion side)
+]
+
+
+def make_golden_table():
+    rows = []
+    for m in GOLDEN_MJDS:
+        p, v = earth_barycentric_state(m)
+        rows.append({"mjd": m,
+                     "pos_au": [round(float(c), 10) for c in p],
+                     "vel_kms": [round(float(c), 8) for c in v]})
+    return {
+        "frame": "J2000 mean equatorial, solar-system barycentric",
+        "provenance": (
+            "truncated VSOP87D Earth series (Bretagnon & Francou 1988; "
+            "coefficients per Meeus, Astronomical Algorithms 2nd ed., "
+            "Table 32.a), ecliptic-of-date -> J2000 via IAU 1976 "
+            "precession, + Sun-SSB offset from Standish 1800-2050 mean "
+            "elements of the four giant planets; velocity by +-0.05 d "
+            "central differences.  Estimated accuracy vs JPL DE: "
+            "~1e-5 AU, ~2e-3 km/s.  Generated by "
+            "scripts/make_ephemeris_golden.py (tests/vsop87_truth.py)."),
+        "epochs": rows,
+    }
