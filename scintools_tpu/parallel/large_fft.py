@@ -1,0 +1,231 @@
+"""Channel-sharded secondary spectrum for ONE dynspec too large for a
+single device — the load-bearing "sharded FFT" component (SURVEY.md §2.7,
+round-3 verdict item 4).
+
+The batched pipeline chan-shards its FFTs through the GSPMD partitioner
+(parallel/driver.py); this module is the EXPLICIT program for the single
+giant-spectrum case, written as a classic distributed 2-D FFT so every
+byte of per-device working set is accounted for:
+
+    rows (channels) sharded over the mesh
+      1. global mean subtraction           — psum
+      2. separable edge window             — shard-local (host 1-D vectors)
+      3. second mean subtraction           — psum (reference quirk,
+                                             dynspec.py:1251,1280)
+      4. 2x2 prewhitening difference       — one-row halo via ppermute
+      5. Doppler-axis fftshift             — pre-modulation by (-1)^t
+                                             (no post-FFT block permute)
+      6. FFT along time                    — shard-local, rows sharded
+      7. distributed transpose             — all_to_all (ICI)
+      8. FFT along delay                   — shard-local, columns sharded
+      9. |.|^2, crop positive delays,
+         postdark sin^2, 10 log10          — shard-local (postdark built
+                                             per-shard from axis_index)
+
+Per-device peak working set is ~(2 complex64 copies of the padded grid)/P
+versus ~2 full copies (plus FFT temporaries) on one device: at a 32k x 32k
+padded grid (input ~16k x 16k) the single-device working set is ~16-32 GB
+— beyond one v4/v5e chip's HBM once the batched pipeline's buffers are
+resident — while 8-way sharding holds ~2-4 GB/device.  The dryrun
+(__graft_entry__.dryrun_multichip) validates this program against the
+host-tiled reference at a driver-sized grid, and
+tests/test_parallel.py asserts equality at several sizes (the genuinely
+HBM-scale grid is env-gated: SCINT_BIG_FFT=1).
+
+Output matches ops.sspec.sspec exactly (same quirks, same axis ordering):
+[nrfft/2, ncfft] in dB, Doppler axis shifted.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ..ops.sspec import next_pow2_fft_lens
+from ..ops.windows import split_window
+from . import mesh as mesh_mod
+
+__all__ = ["sspec_sharded", "sspec_host_tiled"]
+
+_ROWS = "rows"
+
+
+def _flat_row_mesh(mesh):
+    """1-D mesh over all of ``mesh``'s devices (power-of-two count
+    required by the block-aligned transpose/shift)."""
+    devs = list(np.asarray(mesh.devices).ravel())
+    P = len(devs)
+    if P & (P - 1):
+        # largest power-of-two subset: the transpose needs block alignment
+        P = 1 << (P.bit_length() - 1)
+        devs = devs[:P]
+    return mesh_mod.make_mesh(shape=(P,), axis_names=(_ROWS,),
+                              devices=devs), P
+
+
+@functools.lru_cache(maxsize=4)
+def _build(P: int, nf: int, nt: int, prewhite: bool, window,
+           window_frac: float, db: bool, mesh):
+    # Mesh is value-hashable, so it keys the lru_cache directly
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as Pt
+
+    nrfft, ncfft = next_pow2_fft_lens(nf, nt)
+    if nrfft % P or ncfft % P:
+        raise ValueError(
+            f"padded grid {nrfft}x{ncfft} (from dynspec {nf}x{nt}) is not "
+            f"divisible by the {P}-device row mesh; use a spectrum with "
+            f"at least {P // 2 + 1} rows and columns or a smaller mesh")
+    br = nrfft // P          # row block per device (input padded to nrfft)
+    bc = ncfft // P          # column block after the transpose
+
+    # host-built 1-D constants (static shapes -> folded into the trace)
+    if window is not None:
+        tw = split_window(nt, window, window_frac).astype(np.float32)
+        fw = split_window(nf, window, window_frac).astype(np.float32)
+    else:
+        tw = np.ones(nt, np.float32)
+        fw = np.ones(nf, np.float32)
+    fw_pad = np.zeros(nrfft, np.float32)
+    fw_pad[:nf] = fw
+    # Doppler fftshift as pre-modulation along the (unsharded) time axis
+    mod = ((-1.0) ** np.arange(ncfft)).astype(np.float32)
+    inv_n = np.float32(1.0 / (nf * nt))
+
+    def block(d, fwb):
+        # d: [br, nt] local rows; fwb: [br] local slice of fw_pad
+        idx = jax.lax.axis_index(_ROWS)
+        grow = idx * br + jnp.arange(br)          # global row indices
+        valid = (grow < nf).astype(d.dtype)[:, None]
+
+        m1 = jax.lax.psum(jnp.sum(d), _ROWS) * inv_n
+        d = (d - m1) * valid
+        d = d * tw[None, :] * fwb[:, None]
+        m2 = jax.lax.psum(jnp.sum(d), _ROWS) * inv_n
+        d = (d - m2) * valid
+
+        if prewhite:
+            # halo: first local row of device i+1 (garbage wrap-around at
+            # the last device is masked: rows >= nf-1 are invalid)
+            halo = jax.lax.ppermute(
+                d[:1], _ROWS, [((i + 1) % P, i) for i in range(P)])
+            dn = jnp.concatenate([d[1:], halo], axis=0)  # row r+1
+            pw = (dn[:, 1:] - dn[:, :-1] - d[:, 1:] + d[:, :-1])
+            pw = pw * (grow < nf - 1).astype(d.dtype)[:, None]
+            pw = jnp.pad(pw, ((0, 0), (0, ncfft - (nt - 1))))
+        else:
+            pw = jnp.pad(d, ((0, 0), (0, ncfft - nt)))
+
+        f1 = jnp.fft.fft(pw * mod[None, :], axis=-1)     # [br, ncfft]
+        # distributed transpose: split time into P blocks, gather all rows
+        f1t = jax.lax.all_to_all(f1, _ROWS, split_axis=1, concat_axis=0,
+                                 tiled=True)             # [nrfft, bc]
+        f2 = jnp.fft.fft(f1t, axis=0)                    # [nrfft, bc]
+        sec = jnp.real(f2) ** 2 + jnp.imag(f2) ** 2
+        sec = sec[: nrfft // 2]                          # positive delays
+
+        if prewhite:
+            # postdark for THIS column shard, in the already-shifted
+            # Doppler order (ops.sspec._postdark: fd = col - ncfft/2).
+            # NB the singular fixes apply to the full 2-D ROW 0 and
+            # COLUMN ncfft/2 (pd forced to exactly 1 there), not to the
+            # 1-D factors
+            gcol = idx * bc + jnp.arange(bc)
+            v1 = jnp.sin(jnp.pi / ncfft * (gcol - ncfft // 2)) ** 2
+            v2 = jnp.sin(jnp.pi / nrfft * jnp.arange(nrfft // 2)) ** 2
+            pd = v2[:, None] * v1[None, :]
+            pd = jnp.where((gcol == ncfft // 2)[None, :], 1.0, pd)
+            pd = pd.at[0, :].set(1.0)
+            sec = sec / pd
+        if db:
+            sec = 10.0 * jnp.log10(sec)
+        return sec
+
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    fn = shard_map(block, mesh=mesh,
+                   in_specs=(Pt(_ROWS, None), Pt(_ROWS)),
+                   out_specs=Pt(None, _ROWS))
+    jfn = jax.jit(fn, in_shardings=(NamedSharding(mesh, Pt(_ROWS, None)),
+                                    NamedSharding(mesh, Pt(_ROWS))))
+    return jfn, fw_pad, nrfft, ncfft
+
+
+def sspec_sharded(dyn, mesh, prewhite: bool = True,
+                  window: str | None = "blackman",
+                  window_frac: float = 0.1, db: bool = True):
+    """Secondary spectrum of ONE [nf, nt] dynspec with the padded FFT grid
+    sharded over ``mesh``'s devices (rows before the transpose, Doppler
+    columns after).  Returns a global jax array [nrfft/2, ncfft] whose
+    shards live one per device; numerics match ``ops.sspec.sspec`` (f32).
+    """
+    import jax
+
+    dyn = np.asarray(dyn, dtype=np.float32)
+    if dyn.ndim != 2:
+        raise ValueError(f"sspec_sharded takes one [nf, nt] dynspec, "
+                         f"got shape {dyn.shape}")
+    if dyn.shape[0] < 2 or dyn.shape[1] < 2:
+        # same contract as ops.sspec.sspec — prewhitening differences
+        # both axes, and a sub-2 axis would silently mask to all zeros
+        raise ValueError(f"secondary spectrum needs at least a 2x2 "
+                         f"dynspec, got {dyn.shape}")
+    nf, nt = dyn.shape
+    flat, P = _flat_row_mesh(mesh)
+    jfn, fw_pad, nrfft, _ = _build(P, nf, nt, bool(prewhite), window,
+                                   float(window_frac), bool(db), flat)
+    # pad rows host-side so every device holds an equal block; padded rows
+    # are masked inside the program and land in the FFT's zero padding
+    dyn_pad = np.zeros((nrfft, nt), np.float32)
+    dyn_pad[:nf] = dyn
+    return jfn(dyn_pad, fw_pad)
+
+
+def sspec_host_tiled(dyn, prewhite: bool = True,
+                     window: str | None = "blackman",
+                     window_frac: float = 0.1, db: bool = True,
+                     tile: int = 1024):
+    """Host reference for :func:`sspec_sharded`: the same secondary
+    spectrum computed with numpy in row/column TILES, so the host never
+    materialises more than one padded complex copy plus a tile — the
+    "host-tiled computation" the sharded result is asserted against
+    (independent of both ops.sspec paths; float64 per tile).
+    """
+    from ..ops.windows import apply_2d_window
+
+    dyn = np.asarray(dyn, dtype=np.float64)
+    nf, nt = dyn.shape
+    nrfft, ncfft = next_pow2_fft_lens(nf, nt)
+    d = dyn - dyn.mean()
+    if window is not None:
+        d = apply_2d_window(d, window, window_frac, backend="numpy")
+    d = d - d.mean()
+    if prewhite:
+        pw = d[1:, 1:] - d[1:, :-1] - d[:-1, 1:] + d[:-1, :-1]
+    else:
+        pw = d
+
+    # FFT along time in row tiles into the single full buffer
+    buf = np.zeros((nrfft, ncfft), np.complex128)
+    for r0 in range(0, pw.shape[0], tile):
+        blk = pw[r0:r0 + tile]
+        buf[r0:r0 + blk.shape[0]] = np.fft.fft(blk, n=ncfft, axis=1)
+    # FFT along rows in column tiles, in place
+    for c0 in range(0, ncfft, tile):
+        buf[:, c0:c0 + tile] = np.fft.fft(buf[:, c0:c0 + tile], axis=0)
+
+    sec = (buf.real ** 2 + buf.imag ** 2)[: nrfft // 2]
+    del buf
+    sec = np.fft.fftshift(sec, axes=1)
+    if prewhite:
+        from ..ops.sspec import _postdark
+
+        sec = sec / _postdark(nrfft, ncfft)
+    if db:
+        with np.errstate(divide="ignore"):
+            sec = 10 * np.log10(sec)
+    return sec
