@@ -583,6 +583,12 @@ def cmd_wavefield(args) -> int:
             plt.close("all")
         print(json.dumps({
             "file": fn, "eta": eta, "corr": round(corr, 4),
+            # corr is of the PERSISTED field; when the (default) auto
+            # rule applied the global refinement, intensity corr can
+            # legitimately DROP while the phases improve (docs/
+            # wavefield.md "WEAKER metric" note) — refined_global says
+            # whether that happened
+            "refined_global": int(wf.refined_global),
             "conc_mean": round(float(wf.conc.mean()), 4),
             "ntheta": len(wf.theta), "batch": nbatch, "out": out}))
 
@@ -802,12 +808,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="alternating-projection iterations per chunk "
                         "after the eigen seed (0 = pure eigenvector "
                         "retrieval)")
-    q.add_argument("--refine-global", type=int, default=0,
+    q.add_argument("--refine-global", default="auto",
+                   type=lambda v: v if v == "auto" else int(v),
                    help="global arc-support Gerchberg-Saxton iterations "
-                        "on the stitched field (recommended 30 for "
-                        "weak/moderate scattering; see the regime map "
-                        "in docs/wavefield.md — degrades strong "
-                        "anisotropic screens)")
+                        "on the stitched field: 'auto' (default) "
+                        "refines per epoch iff the measured intensity "
+                        "corr is < 0.80 (picks the better branch in "
+                        "every cell of the docs/wavefield.md regime "
+                        "map); 0 = never, N = always N iterations")
     q.add_argument("--backend", default="numpy",
                    choices=["numpy", "jax", "auto"])
     q.set_defaults(fn=cmd_wavefield)

@@ -49,7 +49,42 @@ from ..backend import resolve
 from ..data import DynspecData
 
 __all__ = ["Wavefield", "retrieve_wavefield",
-           "retrieve_wavefield_batch"]
+           "retrieve_wavefield_batch", "intensity_corr",
+           "auto_refine_decision"]
+
+# Auto regime rule for the global arc-support refinement (round-4,
+# verdict item 8).  The 12-regime ground-truth map
+# (docs/wavefield.md, scripts/wavefield_regime_map.py) shows the global
+# refinement lifts true-field fidelity everywhere EXCEPT where the
+# chunked retrieval already explains the intensity well — the
+# strong-screen signature.  The measurable discriminant is the
+# intensity correlation of the stitched |E|^2 with the data: lifting
+# regimes sit at corr 0.45-0.75, the two regressing cells at 0.81/0.94.
+# Threshold 0.80 picks the better (or equal) branch in all 12 cells:
+# corr < 0.80 -> refine (10 lifts), corr >= 0.80 -> skip (avoids
+# 0.744->0.630 and keeps the flat 0.802 cell at its better value).
+AUTO_REFINE_CORR_THRESHOLD = 0.80
+AUTO_REFINE_ITERS = 30
+
+
+def intensity_corr(field, dyn) -> float:
+    """Pearson correlation of |field|^2 with the dynspec — the measured
+    strong-screen discriminant used by the auto refinement rule (and a
+    general retrieval-quality diagnostic; gauge-invariant by
+    construction)."""
+    field = np.asarray(field)
+    dyn = np.asarray(dyn, dtype=np.float64)
+    m = np.abs(field.ravel()) ** 2
+    d = dyn.ravel()
+    sd, sm = np.std(d), np.std(m)
+    if sd == 0 or sm == 0 or not (np.isfinite(sd) and np.isfinite(sm)):
+        return 0.0
+    return float(np.corrcoef(d, m)[0, 1])
+
+
+def auto_refine_decision(corr: float) -> bool:
+    """True -> run the global refinement (weak/moderate regime)."""
+    return bool(corr < AUTO_REFINE_CORR_THRESHOLD)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -73,6 +108,8 @@ class Wavefield:
     align: np.ndarray
     theta: np.ndarray = None       # shared theta grid (fd units, mHz)
     chunk_etas: np.ndarray = None  # per-chunk curvature (us/mHz^2)
+    refined_global: int = 0        # global-GS iterations actually applied
+    # (0 = skipped; set by the "auto" rule or an explicit request)
 
     @property
     def model_dynspec(self) -> np.ndarray:
@@ -86,7 +123,8 @@ class Wavefield:
         arrays = dict(field=self.field, freqs=self.freqs,
                       times=self.times, eta=self.eta,
                       chunk_shape=np.asarray(self.chunk_shape),
-                      conc=self.conc, align=self.align)
+                      conc=self.conc, align=self.align,
+                      refined_global=np.asarray(self.refined_global))
         if self.theta is not None:
             arrays["theta"] = self.theta
         if self.chunk_etas is not None:
@@ -102,7 +140,9 @@ class Wavefield:
                        conc=z["conc"], align=z["align"],
                        theta=z["theta"] if "theta" in z.files else None,
                        chunk_etas=z["chunk_etas"]
-                       if "chunk_etas" in z.files else None)
+                       if "chunk_etas" in z.files else None,
+                       refined_global=int(z["refined_global"])
+                       if "refined_global" in z.files else 0)
 
     def secspec(self, pad: int = 2, db: bool = True) -> "SecSpec":
         """Secondary spectrum of the FIELD: |FFT2(E)|^2.
@@ -363,7 +403,8 @@ def retrieve_wavefield(data: DynspecData, eta: float, chunk_nf: int = 64,
                        chunk_nt: int = 64, ntheta: int | None = None,
                        niter: int = 60, mask_bins: float = 1.5,
                        theta_frac: float = 0.95, conc_weight: float = 0.0,
-                       refine: int = 10, refine_global: int = 0,
+                       refine: int = 10,
+                       refine_global: int | str = "auto",
                        backend: str = "jax") -> Wavefield:
     """Retrieve the complex wavefield of ``data`` given arc curvature
     ``eta`` (us/mHz^2, as fit by ``fit_arc`` on the non-lamsteps
@@ -394,11 +435,17 @@ def retrieve_wavefield(data: DynspecData, eta: float, chunk_nf: int = 64,
     mb2=2 ar=1 0.29 -> 0.45; converged by ~10 iterations, broad ridge
     plateau.  ``refine=0`` recovers the pure eigenvector retrieval.
 
-    ``refine_global`` (opt-in, default 0) runs that many global
-    arc-support Gerchberg-Saxton iterations on the STITCHED field
-    (``refine_wavefield_global``): lifts weak-scattering true-field
-    fidelity 0.68-0.70 -> ~0.86 but degrades strong screens — see the
-    regime map in docs/wavefield.md before enabling.
+    ``refine_global`` (default ``"auto"``) controls the global
+    arc-support Gerchberg-Saxton pass on the STITCHED field
+    (``refine_wavefield_global``): it lifts weak-scattering true-field
+    fidelity 0.68-0.70 -> ~0.86 but degrades strong screens.  The auto
+    rule measures the regime from the data itself — the intensity
+    correlation of the stitched |E|^2 with the dynspec — and refines
+    only below ``AUTO_REFINE_CORR_THRESHOLD`` (0.80), which picks the
+    better-or-equal branch in all 12 cells of the ground-truth map
+    (docs/wavefield.md).  Pass an int for the manual override: 0 = never
+    refine, N = always N iterations.  ``Wavefield.refined_global``
+    records what was applied.
     """
     dyn = np.asarray(data.dyn, dtype=np.float64)
     return retrieve_wavefield_batch(
@@ -420,7 +467,7 @@ def retrieve_wavefield_batch(dyn_batch, freqs, times, etas,
                              mask_bins: float = 1.5,
                              theta_frac: float = 0.95,
                              conc_weight: float = 0.0, refine: int = 10,
-                             refine_global: int = 0,
+                             refine_global: int | str = "auto",
                              mesh=None,
                              backend: str = "jax") -> list:
     """Retrieve wavefields for a BATCH of epochs sharing one grid.
@@ -442,6 +489,13 @@ def retrieve_wavefield_batch(dyn_batch, freqs, times, etas,
     Returns a list of ``Wavefield``.
     """
     backend = resolve(backend)
+    if isinstance(refine_global, str):
+        if refine_global != "auto":
+            raise ValueError(
+                f"refine_global must be 'auto' or an iteration count, "
+                f"got {refine_global!r}")
+    else:
+        refine_global = int(refine_global)  # fail fast, pre-retrieval
     dyn_batch = np.asarray(dyn_batch, dtype=np.float64)
     if dyn_batch.ndim != 3:
         raise ValueError(f"dyn_batch must be [B, nchan, nsub], got "
@@ -571,10 +625,29 @@ def retrieve_wavefield_batch(dyn_batch, freqs, times, etas,
                 conc_weight=conc_weight)
         for b in range(B)
     ]
-    if refine_global:
+    if refine_global == "auto":
+        # Round-4 auto regime rule: refine where the stitched field does
+        # NOT already explain the intensity (weak/moderate screens);
+        # skip where it does (strong-screen signature — the refinement's
+        # single-parabola corridor would destroy real multi-arc delay
+        # structure).  Per-epoch decision from measured data only.
+        out = []
+        for b, w in enumerate(wfs):
+            corr = intensity_corr(w.field, dyn_batch[b])
+            if auto_refine_decision(corr):
+                w = dataclasses.replace(
+                    w, field=refine_wavefield_global(
+                        w.field, dyn_batch[b], df_mhz, dt_s,
+                        float(etas_b[b]), iters=AUTO_REFINE_ITERS),
+                    refined_global=AUTO_REFINE_ITERS)
+            out.append(w)
+        wfs = out
+    elif refine_global:
         wfs = [dataclasses.replace(w, field=refine_wavefield_global(
             w.field, dyn_batch[b], df_mhz, dt_s, float(etas_b[b]),
-            iters=int(refine_global))) for b, w in enumerate(wfs)]
+            iters=int(refine_global)),
+            refined_global=int(refine_global))
+            for b, w in enumerate(wfs)]
     return wfs
 
 
@@ -615,7 +688,9 @@ def refine_wavefield_global(field, dyn, df, dt, eta, iters: int = 30,
                             corridor_frac: float = 0.5,
                             corridor_floor_bins: float = 5.0):
     """Global arc-support Gerchberg-Saxton refinement of a stitched
-    wavefield (round-3; opt-in via ``refine_global=``).
+    wavefield (round-3; since round 4 applied AUTOMATICALLY by the
+    retrieval APIs' default ``refine_global="auto"`` whenever the
+    measured regime is weak/moderate — see ``auto_refine_decision``).
 
     Alternates (a) a magnitude projection — keep the model's phases,
     take |E| from the measured intensity — with (b) a support projection
@@ -633,7 +708,9 @@ def refine_wavefield_global(field, dyn, df, dt, eta, iters: int = 30,
     docs/wavefield.md regime map): weak screens mb2=2 ar=1/3 lift
     0.68/0.70 -> 0.855/0.859.  STRONG screens regress (mb2=20 ar=10:
     0.74 -> 0.63) — their delay structure overflows the single-parabola
-    corridor — hence opt-in; use for weak-scattering data only.
+    corridor — which is why the auto rule SKIPS them (intensity corr of
+    the unrefined field >= 0.80); only force it via an explicit
+    ``refine_global=N`` on data you know is weak-scattering.
 
     Returns the refined complex field [nchan, nsub] with total flux
     re-anchored to the data.

@@ -79,9 +79,9 @@ def test_wavefield_conc_weight_blend():
     not break coverage or the flux anchor)."""
     d, E, eta = _synth_arc_field()
     wf0 = retrieve_wavefield(d, eta, chunk_nf=64, chunk_nt=64,
-                             backend="numpy")
+                             backend="numpy", refine_global=0)
     wf1 = retrieve_wavefield(d, eta, chunk_nf=64, chunk_nt=64,
-                             conc_weight=2.0, backend="numpy")
+                             conc_weight=2.0, backend="numpy", refine_global=0)
     assert np.all(np.isfinite(wf1.field))
     # same flux anchor
     assert np.sum(np.abs(wf1.field) ** 2) == pytest.approx(
@@ -97,7 +97,7 @@ def test_wavefield_ground_truth_fidelity():
     """|E_rec|^2 reproduces the intensity of a known thin-arc field."""
     d, E, eta = _synth_arc_field()
     wf = retrieve_wavefield(d, eta, chunk_nf=64, chunk_nt=64,
-                            backend="numpy")
+                            backend="numpy", refine_global=0)
     assert isinstance(wf, Wavefield)
     assert wf.field.shape == d.dyn.shape
     r = np.corrcoef(np.asarray(d.dyn).ravel(),
@@ -113,9 +113,9 @@ def test_wavefield_ground_truth_fidelity():
 def test_wavefield_backends_agree():
     d, _, eta = _synth_arc_field(nf=128, nt=128)
     wf_np = retrieve_wavefield(d, eta, chunk_nf=64, chunk_nt=64,
-                               backend="numpy")
+                               backend="numpy", refine_global=0)
     wf_j = retrieve_wavefield(d, eta, chunk_nf=64, chunk_nt=64,
-                              backend="jax")
+                              backend="jax", refine_global=0)
     np.testing.assert_allclose(wf_j.conc, wf_np.conc, rtol=1e-6, atol=1e-9)
     np.testing.assert_allclose(np.abs(wf_j.field), np.abs(wf_np.field),
                                rtol=1e-5, atol=1e-6 * np.abs(
@@ -128,7 +128,7 @@ def test_wavefield_gauge_invariant_fidelity():
     high even though one global inner product may not be."""
     d, E, eta = _synth_arc_field()
     wf = retrieve_wavefield(d, eta, chunk_nf=64, chunk_nt=64,
-                            backend="numpy")
+                            backend="numpy", refine_global=0)
     assert np.mean(_chunk_overlaps(wf.field, E, 64)) > 0.6
 
 
@@ -137,7 +137,7 @@ def test_wavefield_on_simulated_screen(screen_epoch):
     most of the dynspec (the naive global eigenvector gives ~0)."""
     _, _, ds, eta = screen_epoch
     wf = ds.retrieve_wavefield(eta=eta, chunk_nf=32, chunk_nt=32,
-                               backend="numpy")
+                               backend="numpy", refine_global=0)
     assert wf is ds.wavefield
     dyn = np.asarray(ds.data.dyn, float)
     r = np.corrcoef(dyn.ravel(), wf.model_dynspec.ravel())[0, 1]
@@ -153,7 +153,7 @@ def test_wavefield_auto_theta_grid_steep_arc():
     d, _, eta = _synth_arc_field(nf=128, nt=128)
     steep = 50 * eta  # arc now delay-limited
     wf = retrieve_wavefield(d, steep, chunk_nf=64, chunk_nt=64,
-                            backend="numpy")
+                            backend="numpy", refine_global=0)
     assert wf.field.shape == d.dyn.shape
     assert len(wf.theta) >= 9  # did not collapse to the minimum grid
     # the steepest chunk stays inside the delay Nyquist window
@@ -175,7 +175,7 @@ def test_wavefield_border_pixels_live():
     stitched field nonzero (pure Hann blending zeroes them)."""
     d, _, eta = _synth_arc_field(nf=128, nt=128)
     wf = retrieve_wavefield(d, eta, chunk_nf=64, chunk_nt=64,
-                            backend="numpy")
+                            backend="numpy", refine_global=0)
     assert np.abs(wf.field[0, :]).max() > 0
     assert np.abs(wf.field[-1, :]).max() > 0
     assert np.abs(wf.field[:, 0]).max() > 0
@@ -193,7 +193,7 @@ def test_wavefield_matches_true_simulated_field(screen_epoch):
     np.testing.assert_allclose(np.asarray(d.dyn), np.abs(E_true) ** 2,
                                rtol=1e-5)        # dyn IS |E_true|^2
     wf = retrieve_wavefield(d, eta, chunk_nf=32, chunk_nt=32,
-                            backend="numpy")
+                            backend="numpy", refine_global=0)
     ovs = _chunk_overlaps(wf.field, E_true, 32)
     assert np.mean(ovs) > 0.55  # measured 0.71; floor ~0.03
 
@@ -210,14 +210,14 @@ def test_wavefield_batch_matches_single():
     d0 = ds[0][0]
     wfs = retrieve_wavefield_batch(dyn_b, d0.freqs, d0.times, etas,
                                    freq=float(d0.freq), chunk_nf=48,
-                                   chunk_nt=48, backend="numpy")
+                                   chunk_nt=48, backend="numpy", refine_global=0)
     assert len(wfs) == 3
     # batch shares ONE theta grid capped by the steepest epoch
     assert all(len(w.theta) == len(wfs[0].theta) for w in wfs)
     compared = 0
     for (d, _, _), eta_i, w in zip(ds, etas, wfs):
         single = retrieve_wavefield(d, eta_i, chunk_nf=48, chunk_nt=48,
-                                    ntheta=len(w.theta), backend="numpy")
+                                    ntheta=len(w.theta), backend="numpy", refine_global=0)
         # identical fields wherever the single retrieval's own span
         # matches the batch's shared (steepest-epoch-capped) span — true
         # for at least the steepest epoch by construction
@@ -228,7 +228,7 @@ def test_wavefield_batch_matches_single():
     assert compared >= 1  # the check above must never become vacuous
     wfs_j = retrieve_wavefield_batch(dyn_b, d0.freqs, d0.times, etas,
                                      freq=float(d0.freq), chunk_nf=48,
-                                     chunk_nt=48, backend="jax")
+                                     chunk_nt=48, backend="jax", refine_global=0)
     for wn, wj in zip(wfs, wfs_j):
         np.testing.assert_allclose(wj.conc, wn.conc, rtol=1e-6,
                                    atol=1e-9)
@@ -240,11 +240,11 @@ def test_wavefield_batch_validates_inputs():
     d, _, eta = _synth_arc_field(nf=64, nt=64)
     dyn = np.asarray(d.dyn)
     with pytest.raises(ValueError, match=r"\[B, nchan, nsub\]"):
-        retrieve_wavefield_batch(dyn, d.freqs, d.times, [eta])
+        retrieve_wavefield_batch(dyn, d.freqs, d.times, [eta], refine_global=0)
     with pytest.raises(ValueError, match="2 curvatures for 1"):
-        retrieve_wavefield_batch(dyn[None], d.freqs, d.times, [eta, eta])
+        retrieve_wavefield_batch(dyn[None], d.freqs, d.times, [eta, eta], refine_global=0)
     with pytest.raises(ValueError, match="positive finite"):
-        retrieve_wavefield_batch(dyn[None], d.freqs, d.times, [-1.0])
+        retrieve_wavefield_batch(dyn[None], d.freqs, d.times, [-1.0], refine_global=0)
 
 
 def test_dynspec_public_secspec_accessor():
@@ -265,7 +265,7 @@ def test_wavefield_requires_curvature():
     d, _, _ = _synth_arc_field(nf=64, nt=64)
     ds = Dynspec(data=d, process=False)
     with pytest.raises(ValueError, match="no curvature"):
-        ds.retrieve_wavefield()
+        ds.retrieve_wavefield(refine_global=0)
 
 
 def test_wavefield_secspec_arc_sharpness():
@@ -274,7 +274,7 @@ def test_wavefield_secspec_arc_sharpness():
     spectrum whose power fills the pairwise-difference manifold."""
     d, _, eta = _synth_arc_field()
     wf = retrieve_wavefield(d, eta, chunk_nf=64, chunk_nt=64,
-                            backend="numpy")
+                            backend="numpy", refine_global=0)
     sec = wf.secspec(pad=1, db=False)
     P = np.asarray(sec.sspec)
     assert P.shape == (len(sec.tdel), len(sec.fdop))
@@ -292,7 +292,7 @@ def test_wavefield_rejects_bad_eta():
     d, _, _ = _synth_arc_field(nf=64, nt=64)
     for bad in (0.0, -0.1, np.nan):
         with pytest.raises(ValueError, match="positive finite"):
-            retrieve_wavefield(d, bad, backend="numpy")
+            retrieve_wavefield(d, bad, backend="numpy", refine_global=0)
 
 
 def test_wavefield_align_diagnostics():
@@ -300,7 +300,7 @@ def test_wavefield_align_diagnostics():
     chunks with usable overlap report a quality in (0, 1]."""
     d, _, eta = _synth_arc_field(nf=128, nt=128)
     wf = retrieve_wavefield(d, eta, chunk_nf=64, chunk_nt=64,
-                            backend="numpy")
+                            backend="numpy", refine_global=0)
     assert np.isnan(wf.align[0])
     rest = wf.align[1:]
     assert np.all((rest[~np.isnan(rest)] > 0)
@@ -329,7 +329,7 @@ def test_wavefield_refine_lifts_weak_scattering():
 
     def corr(refine):
         wf = retrieve_wavefield(d, eta, chunk_nf=32, chunk_nt=32,
-                                refine=refine, backend="jax")
+                                refine=refine, backend="jax", refine_global=0)
         return np.corrcoef(dyn.ravel(), wf.model_dynspec.ravel())[0, 1]
 
     r0, r10 = corr(0), corr(10)
@@ -358,9 +358,12 @@ def test_refine_global_lifts_weak_scattering_true_field():
     eta, _, _, _ = fit_arc_thetatheta(ds.secspec(False), 1e-3, 10.0,
                                       n_eta=96, backend="numpy")
     dyn = np.asarray(d.dyn, float)
+    # refine_global=0 pins the UNrefined baseline (the default is the
+    # round-4 auto rule, which would already refine this weak regime)
     wf = retrieve_wavefield(d, eta, chunk_nf=32, chunk_nt=32, refine=10,
-                            backend="jax")
+                            refine_global=0, backend="jax")
     E0 = np.asarray(wf.field)
+    assert wf.refined_global == 0
     ov0 = np.mean(_chunk_overlaps(E0, E_true, 32))
 
     # the corridor is restrictive (core of the method's honesty)
@@ -389,7 +392,7 @@ def test_refine_global_plumbed_through_retrieval():
     d, _, eta = _synth_arc_field(nf=96, nt=96, seed=5)
     dyn = np.asarray(d.dyn, float)
     wf0 = retrieve_wavefield(d, eta, chunk_nf=48, chunk_nt=48, refine=4,
-                             backend="numpy")
+                             backend="numpy", refine_global=0)
     wfg = retrieve_wavefield(d, eta, chunk_nf=48, chunk_nt=48, refine=4,
                              refine_global=8, backend="numpy")
     manual = refine_wavefield_global(wf0.field, dyn, float(d.df),
@@ -402,3 +405,90 @@ def test_refine_global_plumbed_through_retrieval():
                                    backend="numpy")[0]
     np.testing.assert_allclose(wfb.field, wfg.field, rtol=1e-10,
                                atol=1e-12)
+
+
+def test_auto_refine_rule_beats_both_fixed_settings_on_regime_map():
+    """The auto rule (refine iff measured intensity corr < 0.80) picks
+    the better-or-equal true-field branch in ALL 12 cells of the
+    committed ground-truth regime map (docs/wavefield.md, measured by
+    scripts/wavefield_regime_map.py at 256^2/seed 1234) — i.e. default
+    auto >= max(always-off, always-on) everywhere, which neither fixed
+    setting achieves."""
+    from scintools_tpu.fit.wavefield import auto_refine_decision
+
+    # (mb2, ar, corr0, ov0, ovG) from the committed map
+    MAP = [
+        (1, 1, 0.496, 0.679, 0.845), (1, 3, 0.526, 0.682, 0.773),
+        (1, 10, 0.600, 0.713, 0.760), (2, 1, 0.487, 0.684, 0.855),
+        (2, 3, 0.459, 0.702, 0.859), (2, 10, 0.537, 0.702, 0.790),
+        (5, 1, 0.621, 0.689, 0.809), (5, 3, 0.448, 0.719, 0.858),
+        (5, 10, 0.813, 0.802, 0.800), (20, 1, 0.745, 0.769, 0.799),
+        (20, 3, 0.670, 0.752, 0.804), (20, 10, 0.940, 0.744, 0.630),
+    ]
+    worse_off = worse_on = 0
+    for mb2, ar, corr0, ov0, ovG in MAP:
+        auto = ovG if auto_refine_decision(corr0) else ov0
+        best = max(ov0, ovG)
+        assert auto == pytest.approx(best), (mb2, ar, corr0)
+        worse_off += ov0 < best - 1e-9
+        worse_on += ovG < best - 1e-9
+    # and neither fixed branch is optimal everywhere
+    assert worse_off >= 10 and worse_on >= 2
+
+
+def test_auto_refine_decision_consistent_end_to_end():
+    """Default retrieval applies the auto rule per epoch: the decision
+    recorded on the Wavefield matches the measured corr of the
+    UNrefined field, and an auto-refined field actually differs."""
+    from scintools_tpu.fit.wavefield import (AUTO_REFINE_ITERS,
+                                             auto_refine_decision,
+                                             intensity_corr)
+
+    d, E, eta = _synth_arc_field()
+    wf0 = retrieve_wavefield(d, eta, chunk_nf=64, chunk_nt=64,
+                             backend="numpy", refine_global=0)
+    corr0 = intensity_corr(wf0.field, d.dyn)
+    wf_auto = retrieve_wavefield(d, eta, chunk_nf=64, chunk_nt=64,
+                                 backend="numpy")  # default "auto"
+    expect = AUTO_REFINE_ITERS if auto_refine_decision(corr0) else 0
+    assert wf_auto.refined_global == expect
+    if expect:
+        assert not np.allclose(wf_auto.field, wf0.field)
+    else:
+        np.testing.assert_allclose(wf_auto.field, wf0.field)
+    # explicit int still overrides in both directions
+    wf_on = retrieve_wavefield(d, eta, chunk_nf=64, chunk_nt=64,
+                               backend="numpy", refine_global=5)
+    assert wf_on.refined_global == 5
+    assert not np.allclose(wf_on.field, wf0.field)
+
+
+def test_intensity_corr_properties():
+    from scintools_tpu.fit.wavefield import intensity_corr
+
+    rng = np.random.default_rng(0)
+    dyn = rng.random((32, 32)) + 0.5
+    E = np.sqrt(dyn) * np.exp(1j * rng.random((32, 32)))
+    assert intensity_corr(E, dyn) == pytest.approx(1.0)
+    assert intensity_corr(E * np.exp(1j * 0.7), dyn) == pytest.approx(1.0)
+    assert intensity_corr(np.ones_like(E), dyn) == 0.0  # degenerate
+    assert abs(intensity_corr(rng.random((32, 32)) + 0j, dyn)) < 0.2
+
+
+def test_wavefield_save_load_records_refinement(tmp_path):
+    d, E, eta = _synth_arc_field()
+    wf = retrieve_wavefield(d, eta, chunk_nf=64, chunk_nt=64,
+                            backend="numpy", refine_global=3)
+    fn = str(tmp_path / "wf.npz")
+    wf.save(fn)
+    wf2 = Wavefield.load(fn)
+    assert wf2.refined_global == 3
+    np.testing.assert_allclose(wf2.field, wf.field)
+
+
+def test_refine_global_bad_string_fails_fast():
+    """A typo'd refine_global string raises a clear ValueError BEFORE
+    the expensive retrieval, naming the parameter."""
+    d, E, eta = _synth_arc_field()
+    with pytest.raises(ValueError, match="refine_global"):
+        retrieve_wavefield(d, eta, refine_global="Auto", backend="numpy")
