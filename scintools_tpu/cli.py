@@ -62,6 +62,9 @@ def cmd_process(args) -> int:
         args.backend = "jax"
     cfg = ("process", args.lamsteps, args.backend, not args.no_arc,
            not args.no_scint)
+    if getattr(args, "clean", False):
+        # cleaned results are different results: new resume key
+        cfg += ("clean",)
     # non-default estimator settings enter the resume key (different
     # estimators are different results); defaults keep the legacy key so
     # existing stores still resume
@@ -125,8 +128,22 @@ def cmd_process(args) -> int:
     for fn in files:
         try:
             with timers.stage("load+process"):
-                ds = Dynspec(filename=fn, process=True,
-                             lamsteps=args.lamsteps, backend=args.backend)
+                if getattr(args, "clean", False):
+                    # RFI/gain cleaning between load and the fits:
+                    # channel triage -> repair -> bandpass removal (the
+                    # reference delegates this class to coast_guard,
+                    # scint_utils.py:19-56); fits recompute lazily
+                    ds = Dynspec(filename=fn, process=False,
+                                 lamsteps=args.lamsteps,
+                                 backend=args.backend)
+                    ds.trim_edges().refill() \
+                      .zap(method="channels", sigma=5) \
+                      .zap(method="subints", sigma=5).refill() \
+                      .correct_band()
+                else:
+                    ds = Dynspec(filename=fn, process=True,
+                                 lamsteps=args.lamsteps,
+                                 backend=args.backend)
             scint = arc = None
             tilt_row = {}
             if not args.no_scint:
@@ -214,11 +231,20 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
                            survey_routes)
     from .utils import content_key, log_event
 
+    from .ops.clean import correct_band, zap
+
     epochs, names, failed = [], [], 0
     with timers.stage("load+clean"):
         for fn in files:
             try:
                 d = refill(trim_edges(read_psrflux(fn)))
+                if getattr(args, "clean", False):
+                    # same host-side chain as the per-file engine's
+                    # --clean: channel + subint triage -> repair ->
+                    # bandpass removal
+                    d = correct_band(refill(zap(
+                        zap(d, method="channels", sigma=5),
+                        method="subints", sigma=5)))
                 if d.nchan < 2 or d.nsub < 2:
                     raise ValueError(
                         f"degenerate after trim: {d.nchan}x{d.nsub}")
@@ -566,9 +592,9 @@ def cmd_wavefield(args) -> int:
             rc = 1
 
     def persist(fn, data, eta, wf, nbatch) -> None:
-        dyn = np.asarray(data.dyn, dtype=np.float64)
-        corr = float(np.corrcoef(dyn.ravel(),
-                                 wf.model_dynspec.ravel())[0, 1])
+        from .fit.wavefield import intensity_corr
+
+        corr = intensity_corr(wf.field, data.dyn)
         base = fn.rsplit(".", 1)[0]
         out = args.out if args.out else f"{base}.wavefield.npz"
         wf.save(out)
@@ -582,7 +608,8 @@ def cmd_wavefield(args) -> int:
                                 filename=f"{base}.wavefield_sspec.png")
             plt.close("all")
         print(json.dumps({
-            "file": fn, "eta": eta, "corr": round(corr, 4),
+            "file": fn, "eta": eta,
+            "corr": round(corr, 4) if np.isfinite(corr) else None,
             # corr is of the PERSISTED field; when the (default) auto
             # rule applied the global refinement, intensity corr can
             # legitimately DROP while the phases improve (docs/
@@ -712,6 +739,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="curvature bracket: the peak-search constraint "
                         "(norm_sspec/gridmax) or the sweep range "
                         "(thetatheta)")
+    q.add_argument("--clean", action="store_true",
+                   help="RFI/gain cleaning between load and the fits: "
+                        "per-channel robust triage (zap method="
+                        "'channels'), gap repair, bandpass removal — "
+                        "for real survey data with receiver "
+                        "pathologies (both engines; enters the resume "
+                        "key)")
     q.add_argument("--batched", action="store_true",
                    help="one jit-compiled step per shape bucket over the "
                         "device mesh instead of a per-file loop")

@@ -78,13 +78,16 @@ def intensity_corr(field, dyn) -> float:
     d = dyn.ravel()
     sd, sm = np.std(d), np.std(m)
     if sd == 0 or sm == 0 or not (np.isfinite(sd) and np.isfinite(sm)):
-        return 0.0
+        # degenerate (constant / non-finite) input: no meaningful corr
+        return float("nan")
     return float(np.corrcoef(d, m)[0, 1])
 
 
 def auto_refine_decision(corr: float) -> bool:
-    """True -> run the global refinement (weak/moderate regime)."""
-    return bool(corr < AUTO_REFINE_CORR_THRESHOLD)
+    """True -> run the global refinement (weak/moderate regime).  A
+    non-finite corr (degenerate input) SKIPS refinement: the GS pass
+    would only spread the degeneracy through the whole field."""
+    return bool(np.isfinite(corr) and corr < AUTO_REFINE_CORR_THRESHOLD)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -625,29 +628,22 @@ def retrieve_wavefield_batch(dyn_batch, freqs, times, etas,
                 conc_weight=conc_weight)
         for b in range(B)
     ]
+    # Round-4 auto regime rule: refine where the stitched field does
+    # NOT already explain the intensity (weak/moderate screens); skip
+    # where it does (strong-screen signature — the refinement's
+    # single-parabola corridor would destroy real multi-arc delay
+    # structure).  Per-epoch decision from measured data only; an
+    # explicit int applies uniformly (0 = never).
     if refine_global == "auto":
-        # Round-4 auto regime rule: refine where the stitched field does
-        # NOT already explain the intensity (weak/moderate screens);
-        # skip where it does (strong-screen signature — the refinement's
-        # single-parabola corridor would destroy real multi-arc delay
-        # structure).  Per-epoch decision from measured data only.
-        out = []
-        for b, w in enumerate(wfs):
-            corr = intensity_corr(w.field, dyn_batch[b])
-            if auto_refine_decision(corr):
-                w = dataclasses.replace(
-                    w, field=refine_wavefield_global(
-                        w.field, dyn_batch[b], df_mhz, dt_s,
-                        float(etas_b[b]), iters=AUTO_REFINE_ITERS),
-                    refined_global=AUTO_REFINE_ITERS)
-            out.append(w)
-        wfs = out
-    elif refine_global:
-        wfs = [dataclasses.replace(w, field=refine_wavefield_global(
-            w.field, dyn_batch[b], df_mhz, dt_s, float(etas_b[b]),
-            iters=int(refine_global)),
-            refined_global=int(refine_global))
+        iters_b = [AUTO_REFINE_ITERS if auto_refine_decision(
+            intensity_corr(w.field, dyn_batch[b])) else 0
             for b, w in enumerate(wfs)]
+    else:
+        iters_b = [int(refine_global)] * len(wfs)
+    wfs = [dataclasses.replace(w, field=refine_wavefield_global(
+        w.field, dyn_batch[b], df_mhz, dt_s, float(etas_b[b]),
+        iters=n), refined_global=n) if n else w
+        for b, (w, n) in enumerate(zip(wfs, iters_b))]
     return wfs
 
 

@@ -168,12 +168,23 @@ def correct_band(d: DynspecData, frequency: bool = True, time: bool = False,
                                             time=time, nsmooth=nsmooth))
 
 
+def _robust_z(x):
+    """|x - median| in units of the MAD-estimated sigma (1.4826*MAD);
+    non-finite entries read as the median (z = 0)."""
+    x = np.where(np.isfinite(x), x, np.nanmedian(x))
+    c = np.median(x)
+    s = np.median(np.abs(x - c)) * 1.4826
+    return np.abs(x - c) / max(s, 1e-30)
+
+
 def zap(d: DynspecData, method: str = "median", sigma: float = 7,
         m: int = 3) -> DynspecData:
     """RFI zapping (dynspec.py:1389-1400): ``median`` NaNs out pixels more
     than ``sigma`` median-absolute-deviations from the median; ``medfilt``
     median-filters the array; ``channels`` excises whole channels whose
-    per-channel statistics are anomalous.
+    per-channel statistics are anomalous; ``subints`` (round-4) is the
+    time-axis mirror — whole anomalous subintegrations (broadband
+    impulsive RFI).
 
     ``channels`` covers the RFI class pixel thresholds cannot: a channel
     with a slowly drifting gain (e.g. a saturating receiver) stays inside
@@ -210,19 +221,28 @@ def zap(d: DynspecData, method: str = "median", sigma: float = 7,
             # by |its own mean| distorts relative z-scores and, on
             # mean-subtracted / band-corrected dynspecs (channel means
             # ~ 0), explodes them and falsely excises clean channels.
-            # _robust_z below is invariant to any GLOBAL positive scale,
+            # _robust_z is invariant to any GLOBAL positive scale,
             # so the raw covariance (flux-units trend per unit
             # normalised time) is the right statistic as-is.
-
-        def _robust_z(x):
-            x = np.where(np.isfinite(x), x, np.nanmedian(x))
-            c = np.median(x)
-            s = np.median(np.abs(x - c)) * 1.4826
-            return np.abs(x - c) / max(s, 1e-30)
 
         bad = ((_robust_z(med) > sigma) | (_robust_z(spread) > sigma)
                | (_robust_z(trend) > sigma))
         dyn[bad, :] = np.nan
+    elif method == "subints":
+        # round-4: the TIME-axis mirror of "channels" — excise whole
+        # subintegrations whose per-subint median or spread is anomalous
+        # (broadband impulsive RFI: a lightning strike / radar sweep
+        # lifts EVERY channel for one subint).  A whole-subint excision
+        # removes the impulse without clipping bright scintles the way a
+        # global pixel threshold does (bright scintillation maxima are
+        # heavy-tailed REAL signal; zapping them biases tau low).
+        with np.errstate(invalid="ignore"):
+            med = np.nanmedian(dyn, axis=0)
+            q75, q25 = (np.nanpercentile(dyn, 75, axis=0),
+                        np.nanpercentile(dyn, 25, axis=0))
+            spread = q75 - q25
+        bad = (_robust_z(med) > sigma) | (_robust_z(spread) > sigma)
+        dyn[:, bad] = np.nan
     else:
         raise ValueError(f"unknown zap method {method!r}")
     return d.replace(dyn=dyn)

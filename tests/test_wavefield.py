@@ -471,7 +471,10 @@ def test_intensity_corr_properties():
     E = np.sqrt(dyn) * np.exp(1j * rng.random((32, 32)))
     assert intensity_corr(E, dyn) == pytest.approx(1.0)
     assert intensity_corr(E * np.exp(1j * 0.7), dyn) == pytest.approx(1.0)
-    assert intensity_corr(np.ones_like(E), dyn) == 0.0  # degenerate
+    assert not np.isfinite(intensity_corr(np.ones_like(E), dyn))
+    # degenerate corr must SKIP refinement, never force it
+    from scintools_tpu.fit.wavefield import auto_refine_decision
+    assert not auto_refine_decision(float("nan"))
     assert abs(intensity_corr(rng.random((32, 32)) + 0j, dyn)) < 0.2
 
 
