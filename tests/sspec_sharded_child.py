@@ -1,0 +1,70 @@
+"""Subprocess worker for the sspec_sharded EXECUTION tests.
+
+Executing the distributed-FFT program (all_to_all / ppermute /psum
+thunks on the virtual-device CPU backend) is isolated in a child
+process: round-4 isolation runs showed the XLA CPU runtime can
+intermittently corrupt the process heap under these collective thunks
+(abort shows up much later, in unrelated tests — see
+docs/roadmap.md "KNOWN FLAKE"; our own native code is ASan-clean).
+Numerics are asserted HERE and the parent only checks the exit code,
+so a runtime-level fault is contained without weakening the test.
+
+Usage: python sspec_sharded_child.py {main|pow2|hbm}
+"""
+
+import sys
+
+import numpy as np
+
+
+def main(case: str) -> None:
+    from scintools_tpu.backend import force_host_cpu_devices
+
+    force_host_cpu_devices(8)
+    import jax
+
+    from scintools_tpu.ops import sspec
+    from scintools_tpu.ops.sspec import _postdark, next_pow2_fft_lens
+    from scintools_tpu.parallel import (make_mesh, sspec_host_tiled,
+                                        sspec_sharded)
+
+    rng = np.random.default_rng(3 if case == "main" else 4)
+    if case == "main":
+        dyn = (1 + 0.3 * rng.standard_normal((200, 300))).astype(
+            np.float32) ** 2
+        mesh = make_mesh(shape=(4, 2))
+        ref = sspec_host_tiled(dyn, tile=64)
+        tol = 0.1
+    elif case == "pow2":
+        # non-pow2 device count -> largest pow2 subset; rectangular
+        dyn = (1 + 0.3 * rng.standard_normal((65, 140))).astype(
+            np.float32) ** 2
+        mesh = make_mesh(shape=(3, 1), devices=jax.devices()[:3])
+        ref = sspec(np.float64(dyn), backend="numpy")
+        tol = 0.1
+    elif case == "hbm":
+        # the genuinely load-bearing size: 16k x 16k padded grid
+        # (2 GB per complex64 copy)
+        n = 8192
+        rng = np.random.default_rng(5)
+        dyn = (1 + 0.3 * rng.standard_normal((n, n))).astype(
+            np.float32) ** 2
+        mesh = make_mesh(shape=(8, 1))
+        ref = sspec_host_tiled(dyn, tile=2048)
+        tol = 0.15
+    else:
+        raise SystemExit(f"unknown case {case!r}")
+
+    s_sh = np.asarray(sspec_sharded(dyn, mesh))
+    assert s_sh.shape == ref.shape, (s_sh.shape, ref.shape)
+    nr, nc = next_pow2_fft_lens(*dyn.shape)
+    # real-power bins only, postdark near-singular bins excluded (the
+    # sin^2 ~ 1e-9 divide amplifies f32 noise in EVERY f32 path)
+    m = (ref > ref.max() - 90) & (_postdark(nr, nc) >= 1e-4)
+    dmax = float(np.nanmax(np.abs(s_sh[m] - ref[m])))
+    assert dmax < tol, f"{case}: sharded off by {dmax} dB"
+    print(f"OK {case} shape={s_sh.shape} max|d|={dmax:.4f} dB")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -7,6 +7,8 @@ import os
 import numpy as np
 import pytest
 
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 import jax
 
 from scintools_tpu.data import SecSpec
@@ -667,41 +669,59 @@ def test_wavefield_batch_mesh_sharded_matches_unsharded():
                                    atol=1e-9 * np.abs(b.field).max())
 
 
+def _run_sharded_child(case: str, timeout: int = 600) -> str:
+    """Execute a sspec_sharded case in tests/sspec_sharded_child.py —
+    a SUBPROCESS, because executing all_to_all/ppermute thunks on the
+    virtual-device CPU backend can intermittently corrupt the process
+    heap (XLA runtime flake, round-4 isolation runs; docs/roadmap.md).
+    The child asserts the numerics; the parent checks rc + the OK line."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    p = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__),
+                                      "sspec_sharded_child.py"), case],
+        capture_output=True, text=True, timeout=timeout, env=env)
+    assert p.returncode == 0, f"{case} child failed:\n{p.stderr[-1500:]}"
+    ok = [ln for ln in p.stdout.splitlines() if ln.startswith("OK ")]
+    assert ok, p.stdout[-500:]
+    return ok[-1]
+
+
 def test_sspec_sharded_matches_host_tiled_and_kernel():
     """Round-4 load-bearing sharded FFT (SURVEY §2.7): the explicit
     shard_map distributed secondary spectrum of ONE large dynspec equals
     (a) the independent host-TILED numpy computation and (b) the
     production numpy kernel, at f32 precision, on awkward (non-pow2,
     rectangular) shapes; and its HLO contains the all-to-all transpose
-    plus the psum/ppermute the program is built from."""
+    plus the psum/ppermute the program is built from.  Execution runs in
+    a subprocess (_run_sharded_child); the host-only reference cross-
+    check and the compile-only HLO inspection stay in-process."""
     import re
 
     from scintools_tpu.ops import sspec
-    from scintools_tpu.parallel import sspec_host_tiled, sspec_sharded
+    from scintools_tpu.parallel import sspec_host_tiled
 
     rng = np.random.default_rng(3)
     dyn = (1 + 0.3 * rng.standard_normal((200, 300))).astype(
         np.float32) ** 2
-    mesh = make_mesh(shape=(4, 2))
-    s_sh = np.asarray(sspec_sharded(dyn, mesh))
+    # host-tiled is the same math as the kernel (both f64): near-exact
     s_ht = sspec_host_tiled(dyn, tile=64)
     s_np = sspec(np.float64(dyn), backend="numpy")
-    assert s_sh.shape == s_np.shape == (256, 1024)
-    # host-tiled is the same math as the kernel (both f64): near-exact
+    assert s_ht.shape == s_np.shape == (256, 1024)
     m = s_np > s_np.max() - 120
     np.testing.assert_allclose(s_ht[m], s_np[m], atol=1e-10)
-    # sharded (f32) agrees to f32-FFT precision on real-power bins
-    # (top-90dB mask: below that, f32 leakage from peak bins dominates;
-    # postdark near-singular bins excluded: dividing by sin^2 ~ 1e-9
-    # amplifies f32 noise there in EVERY f32 path, jax kernel included)
-    from scintools_tpu.ops.sspec import _postdark
 
-    pd_ok = _postdark(512, 1024) >= 1e-4
-    m90 = (s_np > s_np.max() - 90) & pd_ok
-    assert float(np.nanmax(np.abs(s_sh[m90] - s_ht[m90]))) < 0.1
+    # sharded execution vs host-tiled: in the child (same seed/shape)
+    line = _run_sharded_child("main")
+    assert "shape=(256, 1024)" in line
 
+    # HLO evidence (compile only, no thunk execution)
     from scintools_tpu.parallel.large_fft import _build, _flat_row_mesh
 
+    mesh = make_mesh(shape=(4, 2))
     flat, P = _flat_row_mesh(mesh)
     assert P == 8
     jfn, fw_pad, nrfft, ncfft = _build(P, 200, 300, True, "blackman",
@@ -717,22 +737,9 @@ def test_sspec_sharded_matches_host_tiled_and_kernel():
 def test_sspec_sharded_pow2_subset_and_nonsquare():
     """A non-power-of-two device mesh falls back to the largest
     power-of-two subset; rectangular spectra keep exact axis ordering
-    (regression for the transpose/shift index math)."""
-    from scintools_tpu.ops import sspec
-    from scintools_tpu.parallel import sspec_sharded
-
-    rng = np.random.default_rng(4)
-    dyn = (1 + 0.3 * rng.standard_normal((65, 140))).astype(
-        np.float32) ** 2
-    mesh3 = make_mesh(shape=(3, 1), devices=__import__("jax").devices()[:3])
-    s_sh = np.asarray(sspec_sharded(dyn, mesh3))  # uses 2 devices
-    s_np = sspec(np.float64(dyn), backend="numpy")
-    assert s_sh.shape == s_np.shape
-    from scintools_tpu.ops.sspec import _postdark, next_pow2_fft_lens
-
-    nr, nc = next_pow2_fft_lens(*dyn.shape)
-    m = (s_np > s_np.max() - 90) & (_postdark(nr, nc) >= 1e-4)
-    assert float(np.nanmax(np.abs(s_sh[m] - s_np[m]))) < 0.1
+    (regression for the transpose/shift index math).  Runs in the
+    containment subprocess (asserts vs the production numpy kernel)."""
+    _run_sharded_child("pow2")
 
 
 @pytest.mark.skipif(not os.environ.get("SCINT_BIG_FFT"),
@@ -741,20 +748,9 @@ def test_sspec_sharded_pow2_subset_and_nonsquare():
 def test_sspec_sharded_hbm_scale():
     """The genuinely load-bearing size: 8k x 8k input -> 16k x 16k padded
     grid (2 GB per complex64 copy; ~4+ GB working set on one device vs
-    ~0.5 GB/device on 8) — same program, asserted against host-tiled."""
-    from scintools_tpu.parallel import sspec_host_tiled, sspec_sharded
-
-    rng = np.random.default_rng(5)
-    n = 8192
-    dyn = (1 + 0.3 * rng.standard_normal((n, n))).astype(np.float32) ** 2
-    mesh = make_mesh(shape=(8, 1))
-    s_sh = np.asarray(sspec_sharded(dyn, mesh))
-    assert s_sh.shape == (8192, 16384)
-    s_ht = sspec_host_tiled(dyn, tile=2048)
-    from scintools_tpu.ops.sspec import _postdark
-
-    m = (s_ht > s_ht.max() - 90) & (_postdark(16384, 16384) >= 1e-4)
-    assert float(np.nanmax(np.abs(s_sh[m] - s_ht[m]))) < 0.25
+    ~0.5 GB/device on 8) — same program, asserted against host-tiled in
+    the containment subprocess."""
+    _run_sharded_child("hbm", timeout=1800)
 
 
 def test_sspec_sharded_rejects_degenerate_inputs():
