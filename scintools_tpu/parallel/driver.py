@@ -610,13 +610,23 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
 
 
 def _take_lanes(res: PipelineResult, n: int, B: int) -> PipelineResult:
-    """Slice divisibility pad-lanes off every [B]-leading result leaf."""
+    """Slice divisibility pad-lanes off every [B]-leading result leaf.
+
+    Leaves are pulled to HOST before slicing: an eager ``x[:n]`` on an
+    array sharded over the mesh compiles a resharding program whose
+    cross-module all-gather must rendezvous ALL devices' threads — on an
+    oversubscribed host (1 core, 8 virtual devices) stragglers can miss
+    XLA's 40 s rendezvous budget and the runtime CHECK-aborts the whole
+    process (observed as the round-4 full-suite flake).  A host transfer
+    is collective-free, and every consumer reads these lanes as numpy
+    anyway."""
     if n == B:
         return res
     import jax
 
     def slice_leaf(x):
-        return x[:n] if (hasattr(x, "ndim") and x.ndim >= 1) else x
+        return np.asarray(x)[:n] if (hasattr(x, "ndim")
+                                     and x.ndim >= 1) else x
 
     def take(val):
         if val is None:
