@@ -80,20 +80,45 @@ def row_scrunch_scan(rows, i0, w, block_r: int = 64):
     return jnp.where(c > 0, s / jnp.maximum(c, 1.0), jnp.nan)
 
 
-def _kernel(rows_ref, i0_ref, w_ref, sum_ref, cnt_ref):
+def _kernel(rows_ref, i0_ref, w_ref, sum_ref, cnt_ref, *, L):
     import jax.numpy as jnp
 
     rows = rows_ref[...]                       # [rb, C]
-    i0 = i0_ref[...]                           # [rb, n]
-    w = w_ref[...].astype(rows.dtype)          # [rb, n]
-    v0 = jnp.take_along_axis(rows, i0, axis=1)
-    v1 = jnp.take_along_axis(rows, i0 + 1, axis=1)
-    nrm = v0 * (1.0 - w) + v1 * w
-    keep = ~jnp.isnan(nrm)
-    sum_ref[...] = jnp.sum(jnp.where(keep, nrm, 0.0), axis=0,
-                           keepdims=True)
-    cnt_ref[...] = jnp.sum(keep.astype(rows.dtype), axis=0,
-                           keepdims=True)
+    C = rows.shape[1]
+    n_pad = i0_ref.shape[1]                    # padded to a multiple of L
+    # Real-Mosaic gather constraints (probed on the axon TPU): the
+    # index array must MATCH the operand shape, and tpu.dynamic_gather
+    # compiles only within one 128-lane vector register — a 256-lane
+    # same-shape gather dies in the backend.  So the n resample lanes
+    # are walked in L(=128)-lane chunks, and each chunk gathers from
+    # every 128-lane source segment with local indices, keeping the
+    # in-range segment's lanes (static unrolled double loop; selects
+    # are VPU-cheap next to the HBM traffic this kernel avoids).
+    for k in range(n_pad // L):
+        i0 = i0_ref[:, k * L:(k + 1) * L]      # [rb, L] static slice
+        w = w_ref[:, k * L:(k + 1) * L].astype(rows.dtype)
+        v0 = jnp.zeros(i0.shape, rows.dtype)
+        v1 = jnp.zeros(i0.shape, rows.dtype)
+        for s in range(C // L):
+            seg = rows[:, s * L:(s + 1) * L]   # [rb, L] register-width
+            loc0 = i0 - s * L
+            g0 = jnp.take_along_axis(seg, jnp.clip(loc0, 0, L - 1),
+                                     axis=1)
+            v0 = jnp.where((loc0 >= 0) & (loc0 < L), g0, v0)
+            loc1 = loc0 + 1
+            g1 = jnp.take_along_axis(seg, jnp.clip(loc1, 0, L - 1),
+                                     axis=1)
+            v1 = jnp.where((loc1 >= 0) & (loc1 < L), g1, v1)
+        nrm = v0 * (1.0 - w) + v1 * w
+        keep = ~jnp.isnan(nrm)
+        # Mosaic also requires the last two block dims to be (8k, 128k)
+        # or the full array dims — a [1, n] per-block row violates the
+        # sublane rule — so each block's partials are broadcast across
+        # one full 8-sublane tile; the host-side reducer reads sublane 0.
+        sm = jnp.sum(jnp.where(keep, nrm, 0.0), axis=0, keepdims=True)
+        ct = jnp.sum(keep.astype(rows.dtype), axis=0, keepdims=True)
+        sum_ref[0, :, k * L:(k + 1) * L] = jnp.broadcast_to(sm, (8, L))
+        cnt_ref[0, :, k * L:(k + 1) * L] = jnp.broadcast_to(ct, (8, L))
 
 
 @functools.lru_cache(maxsize=8)
@@ -104,31 +129,41 @@ def _build(R: int, C: int, n: int, block_r: int, interpret: bool):
 
     nb = -(-R // block_r)
 
+    L = min(128, C)                          # gather register width
+    if C % L:
+        raise ValueError(
+            f"row_scrunch_pallas requires C to be a multiple of 128 (or "
+            f"C < 128), got C={C}: the Mosaic dynamic_gather decomposition "
+            f"works in 128-lane segments; use row_scrunch_scan instead")
+    n_pad = -(-n // L) * L                   # chunked same-shape gathers
+
     def run(rows, i0, w):
         pad_r = nb * block_r - R
-        # NaN row padding contributes nothing (keep=False lanes)
+        # NaN row padding contributes nothing (keep=False lanes); lane
+        # padding gathers index 0 with weight 0 and is sliced off below
         rows_p = jnp.pad(rows, ((0, pad_r), (0, 0)),
                          constant_values=np.nan)
-        i0_p = jnp.pad(i0, ((0, pad_r), (0, 0)))
-        w_p = jnp.pad(w, ((0, pad_r), (0, 0)))
+        i0_p = jnp.pad(i0, ((0, pad_r), (0, n_pad - n)))
+        w_p = jnp.pad(w, ((0, pad_r), (0, n_pad - n)))
         s, c = pl.pallas_call(
-            _kernel,
+            functools.partial(_kernel, L=L),
             grid=(nb,),
             in_specs=[
                 pl.BlockSpec((block_r, C), lambda b: (b, 0)),
-                pl.BlockSpec((block_r, n), lambda b: (b, 0)),
-                pl.BlockSpec((block_r, n), lambda b: (b, 0)),
+                pl.BlockSpec((block_r, n_pad), lambda b: (b, 0)),
+                pl.BlockSpec((block_r, n_pad), lambda b: (b, 0)),
             ],
             out_specs=[
-                pl.BlockSpec((1, n), lambda b: (b, 0)),
-                pl.BlockSpec((1, n), lambda b: (b, 0)),
+                pl.BlockSpec((1, 8, n_pad), lambda b: (b, 0, 0)),
+                pl.BlockSpec((1, 8, n_pad), lambda b: (b, 0, 0)),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct((nb, n), rows.dtype),
-                jax.ShapeDtypeStruct((nb, n), rows.dtype),
+                jax.ShapeDtypeStruct((nb, 8, n_pad), rows.dtype),
+                jax.ShapeDtypeStruct((nb, 8, n_pad), rows.dtype),
             ],
             interpret=interpret,
         )(rows_p, i0_p, w_p)
+        s, c = s[:, 0, :n], c[:, 0, :n]  # sublanes 1-7 are tile copies
         cnt = jnp.sum(c, axis=0)
         # guarded denominator, as the production scan path does: the 0/0
         # of an all-NaN bin is discarded by the where but would trip

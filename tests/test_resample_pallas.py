@@ -60,6 +60,28 @@ def test_row_scrunch_all_nan_bins_and_padding():
                                equal_nan=True)
 
 
+def test_row_scrunch_multi_chunk_multi_segment():
+    """C > 128 and n > 128 exercise BOTH static loops of the Mosaic
+    decomposition (n walked in 128-lane chunks, each gathering from
+    every 128-lane source segment) — including the cross-segment v1
+    handoff where i0 = L-1 (v1 reads lane 0 of the next segment) and
+    anchors sitting exactly on a segment boundary (i0 = L)."""
+    rng = np.random.default_rng(6)
+    R, C, n = 24, 256, 200
+    rows = rng.standard_normal((R, C))
+    rows[3, :] = np.nan
+    rows[:, 130] = np.nan               # dead column in segment 1
+    i0, w = _pattern(R, C, n)
+    i0[0, 0], w[0, 0] = 127, 0.5        # v1 crosses into segment 1
+    i0[1, 1], w[1, 1] = 128, 0.25       # anchor on the boundary
+    i0[2, 2], w[2, 2] = 126, 1.0        # full weight on the edge lane
+    want = _reference_scrunch(rows, i0, w)
+    got = np.asarray(row_scrunch_pallas(rows, i0, w, block_r=8,
+                                        interpret=True))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7,
+                               equal_nan=True)
+
+
 def test_row_scrunch_shape_validation():
     with pytest.raises(ValueError, match="shape mismatch"):
         row_scrunch_pallas(np.zeros((4, 8)), np.zeros((3, 5), np.int32),
