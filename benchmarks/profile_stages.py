@@ -140,8 +140,9 @@ def main():
     bench("lam+sspec+arc rc=0", PipelineConfig(
         fit_scint=False, arc_numsteps=ns, arc_scrunch_rows=0))
     # A/B the arc delay-scrunch strategies: full [B, R, n] gather vs
-    # lax.scan row blocks with a bounded working set
-    for rc in (64, 256):
+    # lax.scan row blocks vs the fused Pallas VMEM kernel (the on-chip
+    # auto route since round 4)
+    for rc in (64, 256, "pallas"):
         bench(f"lam+sspec+arc rc={rc}", PipelineConfig(
             fit_scint=False, arc_numsteps=ns, arc_scrunch_rows=rc))
     # A/B the ACF-cut route: padded 1-D FFTs (VPU) vs Gram-matrix diagonal

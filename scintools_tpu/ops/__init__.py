@@ -1,7 +1,7 @@
 from .acf import acf  # noqa: F401
 from .clean import (correct_band, crop, refill, refill_fixed_point,  # noqa: F401
                     trim_edges, zap)
-from .nudft import (nudft, nudft_pallas, slow_ft, slow_ft_power,  # noqa: F401
+from .nudft import (nudft, slow_ft, slow_ft_power,  # noqa: F401
                     slow_ft_power_sharded)
 from .scale import scale_lambda, scale_trapezoid  # noqa: F401
 from .sspec import next_pow2_fft_lens, sspec, sspec_axes  # noqa: F401

@@ -23,22 +23,18 @@ Execution paths (all agree to float64 tolerances; see tests/test_nudft.py):
 * ``jax``    — frequency-chunked batched matvec under ``lax.map``: for each
   frequency the phase matrix is a dense [nr, nt] complex operator, so the
   contraction is MXU-shaped and XLA pipelines chunk-by-chunk without ever
-  materialising the full [nr, nt, nf] phase tensor;
-* pallas     — TPU kernel (``nudft_pallas``) that computes phases on the fly
-  in VMEM tiles and accumulates over time blocks, trading HBM bandwidth for
-  VPU transcendentals.
+  materialising the full [nr, nt, nf] phase tensor.  (A Pallas VMEM-phase
+  kernel was A/B'd on-chip in round 4 and deleted: 0.44x the einsum —
+  see the note at the end of this file.)
 """
 
 from __future__ import annotations
-
-import functools
 
 import numpy as np
 
 from ..backend import resolve
 
-__all__ = ["nudft", "slow_ft", "slow_ft_power", "slow_ft_power_sharded",
-           "nudft_pallas"]
+__all__ = ["nudft", "slow_ft", "slow_ft_power", "slow_ft_power_sharded"]
 
 
 def _r_grid(ntime: int) -> tuple[float, float, int]:
@@ -94,8 +90,15 @@ def _nudft_jax_reim(power, fscale, tsrc, r0, dr, nr, chunk_f: int = 16):
         # [nr, nt, cf] phases built per chunk only; never the full tensor.
         phase = (2 * jnp.pi) * (
             rvals[:, None, None] * tsrc[None, :, None] * fs_c[None, None, :])
-        re = jnp.einsum("rtc,tc->rc", jnp.cos(phase), p_c)
-        im = jnp.einsum("rtc,tc->rc", jnp.sin(phase), p_c)
+        # HIGHEST precision: under vmap XLA lowers these to batched MXU
+        # matmuls whose default bf16 passes cost ~100x accuracy (2e-3 vs
+        # 2.7e-5 scaled error against the f64 oracle, measured on-chip at
+        # 512x256) — the f32 passes keep the batched pipeline's slow_ft
+        # at the same accuracy as the unbatched call
+        re = jnp.einsum("rtc,tc->rc", jnp.cos(phase), p_c,
+                        precision=lax.Precision.HIGHEST)
+        im = jnp.einsum("rtc,tc->rc", jnp.sin(phase), p_c,
+                        precision=lax.Precision.HIGHEST)
         return re, im
 
     re, im = lax.map(one_chunk, (fs, pw))         # each [nc, nr, cf]
@@ -244,126 +247,11 @@ def slow_ft_power_sharded(dyn, freqs, mesh, axis: str = "data",
 
 
 # ---------------------------------------------------------------------------
-# Pallas TPU kernel
-# ---------------------------------------------------------------------------
-
-def _nudft_pallas_kernel(fs_ref, pw_ref, re_ref, im_ref, *,
-                         r0, dr, t0, dt, block_r, block_t, nt):
-    """One (r-block, f-block) tile: accumulate over time in VMEM-sized
-    [block_r, block_t, block_f] phase slabs computed on the fly.
-
-    Mosaic constraints probed on the axon TPU (see tests/test_nudft.py and
-    memory note tpu-complex-unsupported): 1-D iota must be the integer
-    broadcasted_iota form; lane-dim dynamic slices feeding rank-3 broadcasts
-    inside fori_loop fail to compile.  So the time grid is generated
-    in-kernel from its (t0, dt) affine form instead of being sliced out of a
-    tsrc operand — uniform tsrc only (callers fall back to the einsum path
-    otherwise).
-    """
-    import jax.numpy as jnp
-    from jax import lax
-    from jax.experimental import pallas as pl
-
-    i = pl.program_id(0)
-    r_idx = lax.broadcasted_iota(jnp.int32, (block_r, 1, 1), 0
-                                 ).astype(jnp.float32)
-    rvals = r0 + dr * (i * block_r + r_idx)          # [block_r, 1, 1]
-    t_idx = lax.broadcasted_iota(jnp.int32, (1, block_t, 1), 1
-                                 ).astype(jnp.float32)
-    fs3 = fs_ref[0:1, :][:, None, :]       # [1, 1, block_f]
-    acc_re = jnp.zeros(re_ref.shape, dtype=jnp.float32)
-    acc_im = jnp.zeros(im_ref.shape, dtype=jnp.float32)
-
-    def body(tb, carry):
-        a_re, a_im = carry
-        p = pw_ref[pl.dslice(tb * block_t, block_t), :]  # [block_t, block_f]
-        ts3 = t0 + dt * (tb * block_t + t_idx)           # [1, block_t, 1]
-        # [block_r, block_t, block_f]
-        phase = (2 * jnp.pi) * (rvals * ts3 * fs3)
-        a_re = a_re + jnp.sum(jnp.cos(phase) * p[None, :, :], axis=1)
-        a_im = a_im + jnp.sum(jnp.sin(phase) * p[None, :, :], axis=1)
-        return a_re, a_im
-
-    n_tb = nt // block_t
-    if n_tb == 1:
-        # trip-count-1 fori_loop fails mosaic compilation on this backend
-        acc_re, acc_im = body(0, (acc_re, acc_im))
-    else:
-        acc_re, acc_im = lax.fori_loop(0, n_tb, body, (acc_re, acc_im))
-    re_ref[...] = acc_re
-    im_ref[...] = acc_im
-
-
-def nudft_pallas(power, fscale, tsrc=None, r0=None, dr=None, nr=None,
-                 block_r: int = 64, block_t: int = 64, block_f: int = 128,
-                 interpret: bool = False):
-    """Pallas-TPU NUDFT: float32 in/out (re, im), phases generated in VMEM.
-
-    Grid is (nr/block_r, nf/block_f); each instance streams the time axis in
-    ``block_t`` slabs so the [r, t, f] phase tensor never touches HBM.
-    Inputs are zero-padded to block multiples (zero power contributes zero).
-    Requires uniform tsrc (falls back to the einsum path otherwise).
-    Returns complex64 [nr, nf] — on-device only on real TPU; transfer
-    real/imag planes separately (tpu-complex-unsupported).
-
-    Block sizes bound VMEM: several live [block_r, block_t, block_f] f32
-    slabs (phase, cos, sin, products) must fit in ~16 MB, so keep
-    block_r*block_t*block_f at or below ~1M elements (defaults: 0.5M).
-    Oversizing fails with an opaque remote-compile 500 on this backend.
-    """
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-    from jax.experimental import pallas as pl
-
-    power = jnp.asarray(power, dtype=jnp.float32)
-    ntime, nfreq = power.shape
-    if r0 is None or dr is None or nr is None:
-        g0, gd, gn = _r_grid(ntime)
-        r0 = g0 if r0 is None else r0
-        dr = gd if dr is None else dr
-        nr = gn if nr is None else nr
-    if tsrc is None:
-        t0, dt = 0.0, 1.0
-    else:
-        tsrc = np.asarray(tsrc, dtype=np.float64)
-        if ntime > 2 and not np.allclose(
-                np.diff(tsrc), tsrc[1] - tsrc[0], rtol=0, atol=1e-12):
-            re, im = _nudft_jax_reim(power, fscale, tsrc, r0, dr, nr)
-            return lax.complex(re, im)
-        t0 = float(tsrc[0])
-        dt = float(tsrc[1] - tsrc[0]) if ntime > 1 else 1.0
-
-    block_r = min(block_r, nr)
-    block_t = min(block_t, ntime)
-    block_f = min(block_f, nfreq)
-    pad_t = (-ntime) % block_t
-    pad_f = (-nfreq) % block_f
-    pad_r = (-nr) % block_r
-    pw = jnp.pad(power, ((0, pad_t), (0, pad_f)))
-    fs = jnp.pad(jnp.asarray(fscale, dtype=jnp.float32), (0, pad_f))
-    nt_p, nf_p = pw.shape
-    nr_p = nr + pad_r
-
-    kernel = functools.partial(
-        _nudft_pallas_kernel, r0=float(r0), dr=float(dr), t0=t0, dt=dt,
-        block_r=block_r, block_t=block_t, nt=nt_p)
-    out_shape = [
-        jax.ShapeDtypeStruct((nr_p, nf_p), jnp.float32) for _ in range(2)]
-    grid = (nr_p // block_r, nf_p // block_f)
-    re, im = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_f), lambda i, j: (0, j)),     # fscale row
-            pl.BlockSpec((nt_p, block_f), lambda i, j: (0, j)),  # power
-        ],
-        out_specs=[
-            pl.BlockSpec((block_r, block_f), lambda i, j: (i, j)),
-            pl.BlockSpec((block_r, block_f), lambda i, j: (i, j)),
-        ],
-        out_shape=out_shape,
-        interpret=interpret,
-    )(fs[None, :], pw)
-    out = lax.complex(re, im)[:nr, :nfreq]
-    return out
+# A Pallas NUDFT kernel (VMEM-generated phase slabs) lived here through
+# round 4.  It lowered and ran correctly on real Mosaic (rel err 2.7e-5
+# vs the f64 oracle at 512x256) but measured 0.44x the production
+# chunked-einsum path above (benchmarks history: pallas_ab.py round-4
+# verdict "keep-off" — the MXU contraction beats VPU cos/sin slabs for
+# this op), so it was deleted per the prove-or-remove policy
+# (docs/roadmap.md).  The fused row-scrunch kernel in resample_pallas.py
+# is the one that won its A/B and got wired.

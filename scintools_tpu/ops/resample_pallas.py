@@ -4,19 +4,19 @@ The arc fitter's hot op (fit/arc_fit.py) is, per epoch: gather each
 delay row of the secondary spectrum onto a row-specific normalised
 Doppler grid (static indices/weights [R, n]) and nanmean over rows.
 
-* :func:`row_scrunch_scan` — the PRODUCTION path for
-  ``arc_scrunch_rows > 0`` (the auto default on every target): a ``lax.scan`` over
-  row blocks that bounds the working set to [block_r, n].  The arc
-  fitter calls it directly.
-* :func:`row_scrunch_pallas` — EXPERIMENTAL fused kernel: gather +
-  interpolate + NaN-masked accumulation in VMEM so the [rb, n]
-  intermediates never touch HBM.  Validated in INTERPRET mode only
-  (tests/test_resample_pallas.py is CPU); `scripts/tpu_recheck.sh`
-  carries the real-Mosaic lowering gate (the per-lane
-  ``take_along_axis`` is exactly the op Mosaic may refuse or
-  serialise) and `benchmarks/pallas_ab.py` races it against
-  row_scrunch_scan for the wire/remove decision.  NOT wired into
-  make_arc_fitter until it measures faster on hardware.
+* :func:`row_scrunch_pallas` — the on-chip PRODUCTION path since round
+  4 (`arc_scrunch_rows=-1` auto on TPU): gather + interpolate +
+  NaN-masked accumulation fused in VMEM so the [rb, n] intermediates
+  never touch HBM.  Measured 3.5x the scan path at the bench shape
+  with 1e-7 agreement (benchmarks/pallas_ab.py, the regression guard);
+  `scripts/tpu_recheck.sh` carries the real-Mosaic correctness gate.
+  CPU executions (CI, forced route) run it in interpret mode.
+* :func:`row_scrunch_scan` — the host-CPU auto route
+  (``arc_scrunch_rows > 0``): a ``lax.scan`` over row blocks that
+  bounds the working set to [block_r, n].  Also the fallback for
+  Doppler widths the Mosaic gather decomposition cannot tile
+  (ncol >= 128 and not a multiple of 128 — unreachable via the
+  pipeline, whose FFT grids are pow2).
 """
 
 from __future__ import annotations

@@ -83,11 +83,12 @@ class PipelineConfig:
     arc_asymm: bool = False       # per-arm eta_left/eta_right in ArcFit
     arc_brackets: tuple | None = None  # K (lo, hi) windows -> eta [B, K]
     # Arc delay-scrunch strategy: 0 = full [B, R, n] gather, >0 = lax.scan
-    # row blocks of that size (bounded HBM), -1 = auto: the scan beats
-    # the gather on every target, with a target-tuned block — 64 on chip
-    # (both on-chip profiles), 16 on host CPU (round-3 interleaved
-    # repeats: 1.45x over 64-row blocks — docs/performance.md)
-    arc_scrunch_rows: int = -1
+    # row blocks of that size (bounded HBM), "pallas" = fused VMEM kernel
+    # (ops/resample_pallas; interpret mode off-TPU), -1 = auto: the
+    # Pallas kernel on chip (round-4 A/B: 3.5x the scan at the bench
+    # shape), scan-16 on host CPU (round-3 interleaved repeats: 1.45x
+    # over 64-row blocks — docs/performance.md)
+    arc_scrunch_rows: int | str = -1
     # ACF-cut route for the scint fit: "fft" (padded 1-D FFTs, VPU),
     # "matmul" (Gram-matrix diagonal sums, MXU), or "auto" (matmul on
     # TPU — measured ~2x faster there — fft elsewhere).  Only applies to
@@ -185,10 +186,12 @@ def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
         raise ValueError(
             f"PipelineConfig.scint_cuts: unknown method "
             f"{config.scint_cuts!r} (expected 'auto', 'fft' or 'matmul')")
-    if config.arc_scrunch_rows < -1:
+    if (config.arc_scrunch_rows != "pallas"
+            and (isinstance(config.arc_scrunch_rows, str)
+                 or config.arc_scrunch_rows < -1)):
         raise ValueError(
             f"PipelineConfig.arc_scrunch_rows must be -1 (auto), 0 (full "
-            f"gather) or a positive block size, got "
+            f"gather), a positive block size or 'pallas', got "
             f"{config.arc_scrunch_rows}")
     if config.arc_method not in ("norm_sspec", "gridmax", "thetatheta"):
         raise ValueError(
@@ -286,24 +289,27 @@ def _resolve_cuts(method: str, mesh, batch_shape=None,
     return "matmul" if _target_is_tpu(mesh) else "fft"
 
 
-# auto block sizes for arc_scrunch_rows=-1: the scan beats the full
-# gather on BOTH targets, but the best block differs — 64 on chip (both
-# on-chip profiles, docs/performance.md) vs 16 on host CPU (round-3
-# interleaved repeats at B=64, 256x512: rc=16 ~36-38 dynspec/s vs rc=64
-# ~25.5, a stable 1.45x; rc=8 within noise of 16)
-_AUTO_ARC_SCRUNCH_TPU = 64
+# auto routes for arc_scrunch_rows=-1: on chip the fused Pallas kernel
+# (round-4 A/B at the bench shape: 3.5x the 64-row scan, numerics
+# agreeing to 1e-7; non-conforming Doppler widths demote to scan-64
+# inside the fitter); on host CPU the 16-row scan (round-3 interleaved
+# repeats at B=64, 256x512: rc=16 ~36-38 dynspec/s vs rc=64 ~25.5, a
+# stable 1.45x; rc=8 within noise of 16 — a CPU Pallas route would be
+# interpret-mode and far slower)
+_AUTO_ARC_SCRUNCH_TPU = "pallas"
 _AUTO_ARC_SCRUNCH_CPU = 16
 
 
-def _resolve_arc_scrunch(config: "PipelineConfig", mesh) -> int:
+def _resolve_arc_scrunch(config: "PipelineConfig", mesh):
     """arc_scrunch_rows=-1 auto rule — the single source of truth shared
     by the step builder and the recorded route metadata.  Resolved at
-    TRACE time (like _resolve_cuts), never at build time."""
+    TRACE time (like _resolve_cuts), never at build time.  Returns a
+    block-size int or the route string "pallas"."""
     rc = config.arc_scrunch_rows
     if rc == -1:
         rc = (_AUTO_ARC_SCRUNCH_TPU if _target_is_tpu(mesh)
               else _AUTO_ARC_SCRUNCH_CPU)
-    return int(rc)
+    return rc if rc == "pallas" else int(rc)
 
 
 def resolve_routes(config: "PipelineConfig", mesh=None,

@@ -20,40 +20,21 @@ print('tpu alive:', float(np.asarray(jnp.sum(jnp.ones((64,64))))))
 echo "== probe =="
 probe || { echo "tunnel unreachable; aborting"; exit 1; }
 
-echo "== pallas nudft lowers on chip =="
-# the Pallas NUDFT is CI-validated in interpret mode only; this is the
-# real-Mosaic lowering check.  Gate on python's EXIT STATUS (the rel-err
-# line prints before the assert, so grepping for it cannot detect a
-# failure), captured to a file because the log-noise filter pipeline
-# would otherwise own the status.
+echo "== pallas row-scrunch lowers on chip =="
+# the fused row-scrunch kernel is the arc fitter's on-chip auto route
+# since round 4 (wire verdict, 3.5x the scan); CI validates it in
+# interpret mode only, so this is the real-Mosaic correctness gate.
+# Gate on python's EXIT STATUS (the rel-err line prints before the
+# assert, so grepping for it cannot detect a failure), captured to a
+# file because the log-noise filter pipeline would otherwise own the
+# status.  (The Pallas NUDFT that was also gated here was deleted in
+# round 4: 0.44x the production einsum — benchmarks/pallas_ab.py.)
 pallas_out=$(mktemp)
 trap 'rm -f "$pallas_out"' EXIT
 if ! timeout -k 10 600 python -u -c "
 import numpy as np
-from scintools_tpu.ops.nudft import nudft_pallas
-rng = np.random.default_rng(0)
-nt, nf, nr = 128, 64, 64
-power = rng.standard_normal((nt, nf))
-fscale = 1.0 + 0.01 * np.arange(nf) / nf
-tsrc = np.arange(nt, dtype=float)
-r0, dr = -0.5, 1.0 / nt
-ks = np.arange(nr) * dr + r0
-ph = np.exp(2j*np.pi*np.einsum('r,t,f->rtf', ks, tsrc, fscale))
-want = np.einsum('rtf,tf->rf', ph, power)
-# transfer real/imag planes separately: complex64 host transfer is
-# UNIMPLEMENTED on the axon backend (the kernel itself lowers fine)
-import jax.numpy as jnp
-out = nudft_pallas(power, fscale, tsrc, r0, dr, nr)
-got = np.asarray(jnp.real(out)) + 1j * np.asarray(jnp.imag(out))
-err = np.max(np.abs(got - want)) / max(np.max(np.abs(want)), 1e-30)
-print('pallas on-chip rel err vs direct oracle:', err)
-assert err < 5e-3, err
-
-# experimental arc row-resample kernel (ops/resample_pallas): the
-# per-lane take_along_axis is the Mosaic risk — this is its first
-# hardware lowering; if it fails, the production scan path is
-# unaffected (the kernel is not wired into the fitter)
 from scintools_tpu.ops.resample_pallas import row_scrunch_pallas
+rng = np.random.default_rng(0)
 R, C, n = 96, 256, 128
 rows = rng.standard_normal((R, C))
 rows[7, :] = np.nan    # dead row + dead column: the NaN-mask path must
@@ -81,7 +62,7 @@ fi
 grep -v -E 'INFO|WARN|axon_|Logging|E0000' "$pallas_out" | tail -2
 
 echo "== pallas prove-or-remove A/B =="
-# measured decision for the two experimental kernels (docs/roadmap.md:
+# regression guard for the wired row-scrunch route (docs/roadmap.md:
 # wire a kernel only if it beats the production path by >= 1.15x with
 # matching numerics; otherwise it gets deleted)
 if ! timeout -k 10 1800 python benchmarks/pallas_ab.py --iters 10 \

@@ -87,18 +87,6 @@ def test_jax_matches_numpy(small_problem):
     np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-9)
 
 
-def test_pallas_interpret_matches_numpy(small_problem):
-    from scintools_tpu.ops.nudft import nudft_pallas
-
-    power, fscale, tsrc, r0, dr, nr = small_problem
-    want = _nudft_numpy(power, fscale, tsrc, r0, dr, nr)
-    got = np.asarray(nudft_pallas(
-        power, fscale, tsrc, r0, dr, nr, block_r=8, block_t=8, block_f=8,
-        interpret=True))
-    # float32 kernel vs float64 oracle
-    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
-
-
 def test_uniform_fscale_reduces_to_dft(rng):
     """With fscale == 1 the NUDFT is an inverse-convention DFT on the
     Doppler grid: out[k, f] = n * ifft(power * cis(2*pi*r0*t))[k, f]."""

@@ -115,6 +115,24 @@ def test_pipeline_matmul_cuts_matches_fft_cuts(epochs):
                                np.asarray(a.scint.dnu), rtol=1e-4)
 
 
+def test_pipeline_pallas_scrunch_route_matches_scan(epochs):
+    """arc_scrunch_rows='pallas' (the on-chip auto route; interpret mode
+    here on CPU) fits the same curvature as the scan route — the full
+    pipeline equivalence behind the round-4 wire verdict."""
+    batch, _ = pad_batch(epochs)
+    freqs = np.asarray(epochs[0].freqs)
+    times = np.asarray(epochs[0].times)
+    kw = dict(fit_scint=False, arc_numsteps=400)
+    a = make_pipeline(freqs, times, PipelineConfig(
+        arc_scrunch_rows=64, **kw))(np.asarray(batch.dyn))
+    b = make_pipeline(freqs, times, PipelineConfig(
+        arc_scrunch_rows="pallas", **kw))(np.asarray(batch.dyn))
+    np.testing.assert_allclose(np.asarray(b.arc.eta),
+                               np.asarray(a.arc.eta), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(b.arc.etaerr),
+                               np.asarray(a.arc.etaerr), rtol=1e-4)
+
+
 def test_resolve_cuts_validation_and_size_gate(monkeypatch):
     import scintools_tpu.parallel.driver as drv
     from scintools_tpu.parallel.driver import _resolve_cuts
@@ -135,14 +153,14 @@ def test_resolve_cuts_validation_and_size_gate(monkeypatch):
     assert _resolve_cuts("auto", None, (256, 128, 2048)) == "fft"
     monkeypatch.undo()
     assert _resolve_cuts("auto", None, (4, 64, 64)) == "fft"  # CPU target
-    # arc scrunch auto: scan blocks on EVERY target, block size tuned
-    # per target — 64 on chip (on-chip profiles rounds 1-2), 16 on CPU
-    # (round-3 interleaved repeats: 1.45x over 64 — docs/performance.md)
+    # arc scrunch auto: the fused Pallas kernel on chip (round-4 A/B:
+    # 3.5x the 64-row scan), scan-16 on CPU (round-3 interleaved
+    # repeats: 1.45x over 64 — docs/performance.md)
     from scintools_tpu.parallel.driver import _resolve_arc_scrunch
 
     assert _resolve_arc_scrunch(PipelineConfig(), None) == 16  # CPU here
     monkeypatch.setattr(drv, "_target_is_tpu", lambda mesh: True)
-    assert _resolve_arc_scrunch(PipelineConfig(), None) == 64
+    assert _resolve_arc_scrunch(PipelineConfig(), None) == "pallas"
     monkeypatch.undo()
     assert _resolve_arc_scrunch(PipelineConfig(arc_scrunch_rows=0),
                                 None) == 0
