@@ -626,9 +626,8 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
             # route in CI) run the same kernel in interpret mode.
             from ..ops.resample_pallas import row_scrunch_pallas
 
-            prof = row_scrunch_pallas(
-                rows, _i0_static, _w_static,
-                interpret=jax.default_backend() != "tpu")
+            prof = row_scrunch_pallas(rows, _i0_static, _w_static,
+                                      interpret="auto")
         elif scrunch_rows:
             # lax.scan over row blocks: the full-gather path materialises
             # [R, n] (x3 under a B-epoch vmap: [B, R, n] v0/v1/norm in

@@ -175,8 +175,23 @@ def _build(R: int, C: int, n: int, block_r: int, interpret: bool):
     return jax.jit(run)
 
 
+def pallas_interpret_default() -> bool:
+    """True when Pallas must run in interpret mode: the execution target
+    is not a real TPU.  Reads ``jax.default_device`` overrides first —
+    ``jax.default_backend()`` still reports "tpu" inside a
+    ``with jax.default_device(cpu)`` block, which is exactly how the f64
+    oracle re-traces a TPU-built pipeline on host."""
+    import jax
+
+    dev = getattr(jax.config, "jax_default_device", None)
+    # jax.default_device accepts a Device object OR a platform string
+    platform = (dev if isinstance(dev, str)
+                else getattr(dev, "platform", None)) or jax.default_backend()
+    return platform != "tpu"
+
+
 def row_scrunch_pallas(rows, i0, w, block_r: int = 64,
-                       interpret: bool = False):
+                       interpret=False):
     """NaN-skipping delay-scrunch of row-resampled spectra.
 
     ``rows`` [R, C] (one epoch's masked sspec rows), ``i0``/``w``
@@ -209,5 +224,10 @@ def row_scrunch_pallas(rows, i0, w, block_r: int = 64,
     w = jnp.where(i0 > C - 2, w.dtype.type(1),
                   jnp.where(i0 < 0, w.dtype.type(0), w))
     i0 = jnp.clip(i0, 0, C - 2)
+    if interpret == "auto":
+        # resolved at TRACE time, so a TPU-built fitter re-traced under
+        # jax.default_device(cpu) (the f64-oracle pattern) flips to
+        # interpret mode instead of failing to lower
+        interpret = pallas_interpret_default()
     return _build(int(R), int(C), int(n), int(min(block_r, R)),
                   bool(interpret))(rows, i0, w)

@@ -18,9 +18,13 @@ the 8 regimes, 2026-07-31): eta 1.7e-5, tau 2.2e-7, dnu 1.9e-7, etaerr
 quantities and sized to one arc-grid bin-hop for eta: the arc vertex
 comes from a parabola refine around an argmax over the sqrt-eta grid, so
 an f32 perturbation can legitimately move the peak by one grid cell
-(~1/numsteps relative).  Budgets hold for the on-chip run too
-(scripts/tpu_recheck.sh re-executes this file's core loop on hardware);
-documented in docs/performance.md.
+(~1/numsteps relative).  The hardware tier (benchmarks/f32_budget_onchip.py, run by
+scripts/tpu_recheck.sh) carries its own, looser budgets: the chip's FFT
+and matmul reassociation drifts eta by up to ~3.9e-2 on conditioned
+profiles, and one weak-scattering regime fits a near-flat parabola
+whose vertex is noise-amplified — there the criterion is the fit's own
+reported vertex error (drift <= 1 x etaerr2, measured 0.24); documented
+in docs/performance.md.
 """
 
 import numpy as np

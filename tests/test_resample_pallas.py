@@ -1,7 +1,8 @@
-"""Experimental Pallas row-resample prototype (ops/resample_pallas):
-interpret-mode equivalence with the production arc-fitter math.  The
-real-Mosaic lowering is gated in scripts/tpu_recheck.sh, not here (CPU
-CI cannot exercise it)."""
+"""Pallas row-resample kernel (ops/resample_pallas) — the arc fitter's
+on-chip PRODUCTION route since round 4: interpret-mode equivalence with
+the arc-fitter math.  The real-Mosaic lowering and the wire-verdict A/B
+are gated in scripts/tpu_recheck.sh, not here (CPU CI cannot exercise
+them)."""
 
 import numpy as np
 import pytest
