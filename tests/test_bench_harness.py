@@ -148,6 +148,11 @@ def test_bench_emits_json_line_with_fallback(tmp_path):
                SCINT_BENCH_FALLBACK_B="4",
                SCINT_BENCH_FALLBACK_TIMEOUT="300",
                SCINT_BENCH_PROBE_TIMEOUT="120",
+               # pin the retry loop off: a loaded CI host exceeding the
+               # probe cap must degrade to the fallback inside this
+               # test's 900s budget, not burn 3 x (120s + pause)
+               SCINT_BENCH_PROBE_RETRIES="1",
+               SCINT_BENCH_PROBE_PAUSE="0",
                SCINT_BENCH_FORCE_CPU="1",
                JAX_PLATFORMS="cpu")
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
@@ -221,19 +226,20 @@ def test_bench_wedged_probe_takes_fallback_path(tmp_path):
 
 
 def test_pallas_ab_harness_runs_tiny(capsys):
-    """The prove-or-remove A/B harness executes end-to-end (interpret
-    mode on CPU) and each kernel's JSON line reports matching numerics
-    — a 'numerics-mismatch' verdict here means the A/B baselines have
-    drifted from the kernels."""
+    """The regression-guard A/B harness executes end-to-end (interpret
+    mode on CPU) and the JSON line reports matching numerics — a
+    'numerics-mismatch' verdict here means the scan baseline has
+    drifted from the wired kernel.  (Timing verdicts are meaningless in
+    interpret mode; ab_row_scrunch ignores them there.  ab_nudft was
+    deleted in round 4 with its kernel — keep-off at 0.44x.)"""
     import json
 
     import benchmarks.pallas_ab as AB
 
     assert AB.ab_row_scrunch(1, B=2, R=20, C=64, n=50, interpret=True)
-    assert AB.ab_nudft(1, B=1, nt=32, nf=32, interpret=True)
     lines = [json.loads(ln) for ln in
              capsys.readouterr().out.strip().splitlines()
              if ln.startswith("{")]
-    assert {r["kernel"] for r in lines} == {"row_scrunch", "nudft"}
+    assert {r["kernel"] for r in lines} == {"row_scrunch"}
     for r in lines:
         assert r["verdict"] in ("wire", "keep-off"), r
