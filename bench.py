@@ -459,7 +459,26 @@ def main():
         th.join(timeout_s)
 
         if "rate" in result:
-            print(json.dumps(device_record(result, probe=probe)))
+            rec = device_record(result, probe=probe)
+            # round-4 incident: a degraded tunnel measured the same
+            # program ~20x slower while the chip was healthy minutes
+            # later.  Size-independent detector: every healthy on-chip
+            # capture runs >= several % of the bandwidth roofline
+            # (docs/performance.md round-4 tables: 6-10 % full step);
+            # the degraded flight ran 0.4-1.0 %.  Keep the honest
+            # number but stamp it so a weather-run is never read as a
+            # ceiling.  CPU platforms are exempt (different ceiling,
+            # no tunnel in the path).
+            roof_pct = (rec.get("roofline") or {}).get("roofline_pct")
+            if (probe.get("platform") in ("tpu", "axon")
+                    and isinstance(roof_pct, (int, float))
+                    and roof_pct < 1.5):
+                rec["tunnel_weather_suspect"] = (
+                    f"on-chip roofline_pct={roof_pct} is far below "
+                    f"every healthy capture (docs/performance.md "
+                    f"round-4 tables); re-run scripts/tpu_recheck.sh "
+                    f"single-flight")
+            print(json.dumps(rec))
             return
         err = result.get(
             "error",

@@ -124,8 +124,21 @@ def main():
             ceil_gf = min(peaks["peak_tflops"] * 1e3,
                           ai * peaks["peak_gbs"])
             roof += f" {100.0 * gflops / ceil_gf:5.1f}%roof"
+        weather = ""
+        if (jax.devices()[0].platform != "cpu"
+                and peaks.get("peak_tflops") and peaks.get("peak_gbs")
+                and 100.0 * gflops / ceil_gf < 3.0):
+            # round-4 incident: one flight measured every B=256 stage
+            # ~20x slower (dispatch-bound tunnel degradation) while the
+            # chip was healthy minutes later — 0.4-1.0 % of roofline vs
+            # 6-43 % for every healthy row (docs/performance.md).  The
+            # %roof column is size- and config-normalised, so a sub-3 %
+            # row on chip is weather, not data — stamp it so a bad
+            # flight can't masquerade.
+            weather = "  [TUNNEL-WEATHER? <3% roofline on chip]"
         print(f"{name:22s} {dt * 1e3:9.2f} ms/batch  "
-              f"{B / dt:9.0f} dynspec/s {roof}  (compile {compile_s:.1f}s)")
+              f"{B / dt:9.0f} dynspec/s {roof}  (compile {compile_s:.1f}s)"
+              f"{weather}")
 
     ns = args.numsteps
     # Baseline rows PIN the pre-auto routes (scint_cuts="fft",
