@@ -328,6 +328,15 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
                     row[key] = float(np.asarray(res.arc.eta)[lane])
                     row[key + "err"] = float(
                         np.asarray(res.arc.etaerr)[lane])
+                    # store rows only (CSV keeps the reference schema):
+                    # the parabola-vertex fit error — when it exceeds
+                    # the eta value itself the vertex is noise-amplified
+                    # (near-flat parabola) and the measurement should be
+                    # down-weighted (measured on chip: f32 moves such a
+                    # vertex by 0.24 sigma of THIS error — see
+                    # benchmarks/f32_budget_onchip.py)
+                    row[key + "err2"] = float(
+                        np.asarray(res.arc.etaerr2)[lane])
                     if res.arc.eta_left is not None:
                         # per-arm values go to the store rows only (the
                         # CSV keeps the reference schema)

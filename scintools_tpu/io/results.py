@@ -46,6 +46,14 @@ def results_row(d, scint=None, arc=None) -> dict:
         key = "betaeta" if arc.lamsteps else "eta"
         meta[key] = float(arc.eta)
         meta[key + "err"] = float(arc.etaerr)
+        # parabola-vertex fit error — the conditioning signal
+        # (docs/migrating.md: down-weight epochs with etaerr2 > |eta|).
+        # Store/full-CSV only: write_results' _OPTIONAL filter keeps the
+        # reference CSV schema unchanged.  getattr: duck-typed arc
+        # results (reference-style objects) may predate the field.
+        err2 = getattr(arc, "etaerr2", None)
+        if err2 is not None:
+            meta[key + "err2"] = float(err2)
     return meta
 
 
