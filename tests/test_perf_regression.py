@@ -1,0 +1,105 @@
+"""Performance-regression tier (SURVEY.md §4 item 6): the BASELINE
+configs as in-process pytest cases, asserting RELATIVE speedups of the
+batched one-jit path over the serial numpy chain on the SAME host in
+the SAME process — robust to absolute host speed, unlike wall-clock
+floors.
+
+Opt-in (`SCINT_PERF=1 pytest -m perf`): relative timings on an
+oversubscribed CI host are still noisy, so this tier never gates the
+default suite.  The margins are ~4x below the ratios measured on an
+idle host (batched-vs-serial ~7-11x on CPU, BENCH_r03), so a pass is
+meaningful and a fail means a real regression, not scheduler noise.
+The driver-of-record numbers remain bench.py / benchmarks/ (hardware);
+this tier exists so a CPU-only CI can still catch a batching/jit
+regression before it reaches a chip.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = [
+    pytest.mark.perf,
+    pytest.mark.skipif(
+        os.environ.get("SCINT_PERF", "").lower() not in ("1", "true", "yes"),
+        reason="relative-perf tier is opt-in: SCINT_PERF=1"),
+]
+
+
+def _median_time(fn, n=3) -> float:
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+@pytest.fixture(scope="module")
+def epochs():
+    from synth import synth_arc_epoch
+
+    return [synth_arc_epoch(seed=s) for s in range(8)]
+
+
+def test_batched_sspec_beats_serial_numpy(epochs):
+    """BASELINE config 1 (relative form): one jit'd batched sspec vs the
+    per-epoch numpy chain."""
+    import jax
+    import jax.numpy as jnp
+
+    from scintools_tpu.ops import sspec
+
+    dyn = np.stack([np.asarray(e.dyn, np.float32) for e in epochs])
+
+    def serial():
+        for d in dyn:
+            sspec(d, backend="numpy")
+
+    batched = jax.jit(jax.vmap(lambda d: sspec(d, backend="jax")))
+    float(np.asarray(jnp.sum(batched(dyn))))        # warmup + compile
+    t_batch = _median_time(
+        lambda: float(np.asarray(jnp.sum(batched(dyn)))))
+    t_serial = _median_time(serial)
+    assert t_serial / t_batch > 1.5, (t_serial, t_batch)
+
+
+def test_batched_pipeline_beats_serial_chain(epochs):
+    """BASELINE config 4 (relative form): the one-jit batched pipeline
+    (sspec + arc fit + scint fit) vs the serial numpy chain that
+    bit-matches the reference's per-file loop."""
+    from scintools_tpu.parallel import PipelineConfig, make_pipeline, pad_batch
+    from scintools_tpu.pipeline import Dynspec
+
+    batch, _ = pad_batch(epochs)
+    freqs = np.asarray(epochs[0].freqs)
+    times = np.asarray(epochs[0].times)
+    step = make_pipeline(freqs, times,
+                         PipelineConfig(arc_numsteps=500, lm_steps=20))
+    dyn = np.asarray(batch.dyn, np.float32)
+
+    def batched():
+        r = step(dyn)
+        return (float(np.asarray(r.scint.tau).sum())
+                + float(np.nansum(np.asarray(r.arc.eta))))
+
+    batched()                                       # warmup + compile
+
+    def serial():
+        # the reference's execution model: one epoch at a time through
+        # the numpy-backend wrapper chain (calc_sspec -> fit_arc ->
+        # get_scint_params), as dynspec.py:1615-1657 loops files
+        for e in epochs:
+            d = Dynspec(dyn_obj=e, process=False, backend="numpy")
+            d.calc_sspec(lamsteps=True)
+            try:
+                d.fit_arc(lamsteps=True, numsteps=500)
+            except ValueError:
+                pass                                # quarantine path
+            d.get_scint_params()
+
+    t_batch = _median_time(batched)
+    t_serial = _median_time(serial)
+    assert t_serial / t_batch > 1.5, (t_serial, t_batch)
