@@ -83,7 +83,7 @@ def main():
               f"{peaks['peak_tflops']} TFLOP/s, {peaks['peak_gbs']} GB/s "
               f"({peaks['source']})")
 
-    def bench(name, cfg):
+    def bench(name, cfg, model_ok: bool = True):
         nonlocal matched
         if only is not None and not any(s in name for s in only):
             return
@@ -104,29 +104,31 @@ def main():
         # at trace time against the per-step batch (driver._resolve_cuts),
         # so the model must pass the same shape or it reports the wrong
         # route at large B (1024x256x512 f32 exceeds the 1 GiB cap)
-        model = pipeline_epoch_model(
-            nf, nt, lamsteps=cfg.lamsteps, numsteps=cfg.arc_numsteps,
-            lm_steps=cfg.lm_steps,
-            scint_cuts=_resolve_cuts(cfg.scint_cuts, None, (B, nf, nt)),
-            fit_arc=cfg.fit_arc, fit_scint=cfg.fit_scint)
-        gflops = (B / dt) * model["total"]["flops"] / 1e9
-        gbs = (B / dt) * model["total"]["bytes"] / 1e9
-        roof = f"{gflops:8.0f} GF/s {gbs:7.0f} GB/s"
-        if peaks.get("peak_tflops"):
-            roof += f"  {0.1 * gflops / peaks['peak_tflops']:5.2f}%MFU"
-        if peaks.get("peak_gbs"):
-            roof += f" {100.0 * gbs / peaks['peak_gbs']:5.1f}%BW"
-        if peaks.get("peak_tflops") and peaks.get("peak_gbs"):
-            # % of the roofline ceiling at this row's arithmetic
-            # intensity: min(peak_flops, AI * peak_bw) — the one number
-            # each row must defend (see utils/roofline.roofline_record)
-            ai = model["total"]["flops"] / model["total"]["bytes"]
-            ceil_gf = min(peaks["peak_tflops"] * 1e3,
-                          ai * peaks["peak_gbs"])
-            roof += f" {100.0 * gflops / ceil_gf:5.1f}%roof"
+        roof, gflops, ceil_gf = "", None, None
+        if model_ok:
+            model = pipeline_epoch_model(
+                nf, nt, lamsteps=cfg.lamsteps, numsteps=cfg.arc_numsteps,
+                lm_steps=cfg.lm_steps,
+                scint_cuts=_resolve_cuts(cfg.scint_cuts, None, (B, nf, nt)),
+                fit_arc=cfg.fit_arc, fit_scint=cfg.fit_scint)
+            gflops = (B / dt) * model["total"]["flops"] / 1e9
+            gbs = (B / dt) * model["total"]["bytes"] / 1e9
+            roof = f"{gflops:8.0f} GF/s {gbs:7.0f} GB/s"
+            if peaks.get("peak_tflops"):
+                roof += f"  {0.1 * gflops / peaks['peak_tflops']:5.2f}%MFU"
+            if peaks.get("peak_gbs"):
+                roof += f" {100.0 * gbs / peaks['peak_gbs']:5.1f}%BW"
+            if peaks.get("peak_tflops") and peaks.get("peak_gbs"):
+                # % of the roofline ceiling at this row's arithmetic
+                # intensity: min(peak_flops, AI * peak_bw) — the one
+                # number each row must defend (utils/roofline)
+                ai = model["total"]["flops"] / model["total"]["bytes"]
+                ceil_gf = min(peaks["peak_tflops"] * 1e3,
+                              ai * peaks["peak_gbs"])
+                roof += f" {100.0 * gflops / ceil_gf:5.1f}%roof"
         weather = ""
-        if (jax.devices()[0].platform != "cpu"
-                and peaks.get("peak_tflops") and peaks.get("peak_gbs")
+        if (ceil_gf is not None
+                and jax.devices()[0].platform != "cpu"
                 and 100.0 * gflops / ceil_gf < 3.0):
             # round-4 incident: one flight measured every B=256 stage
             # ~20x slower (dispatch-bound tunnel degradation) while the
@@ -158,6 +160,14 @@ def main():
     for rc in (64, 256, "pallas"):
         bench(f"lam+sspec+arc rc={rc}", PipelineConfig(
             fit_scint=False, arc_numsteps=ns, arc_scrunch_rows=rc))
+    # the alternative curvature estimator: batched theta-theta eigenvalue
+    # route (fit/thetatheta.py) — much heavier per epoch than norm_sspec
+    # (dense [ntheta^2] bilinear samples per eta trial) but robust on
+    # low-S/N arcs; profiled so its on-chip cost is a number, not a guess
+    bench("thetatheta arc", PipelineConfig(
+        fit_scint=False, arc_method="thetatheta",
+        arc_constraint=(1.0, 50.0), arc_numsteps=24, arc_ntheta=65),
+        model_ok=False)   # the analytic flop model covers norm_sspec only
     # A/B the ACF-cut route: padded 1-D FFTs (VPU) vs Gram-matrix diagonal
     # sums (MXU) — same linear correlations, different hardware unit
     bench("scint fit fft cuts", PipelineConfig(

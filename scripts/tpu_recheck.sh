@@ -101,7 +101,7 @@ fi
 
 echo "== stage profile (bench shape) =="
 timeout -k 10 1800 python benchmarks/profile_stages.py --b 256 --iters 5 \
-  2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -10
+  2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -13
 
 echo "== auto-route A/B at the bench batch size (B=1024) =="
 # the arc_scrunch_rows=-1 / scint_cuts=auto defaults were extrapolated
