@@ -260,7 +260,8 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
                    fit_scint=not args.no_scint,
                    fit_scint_2d=getattr(args, "scint_2d", False),
                    arc_asymm=getattr(args, "arc_asymm", False),
-                   arc_method=getattr(args, "arc_method", "norm_sspec"))
+                   arc_method=getattr(args, "arc_method", "norm_sspec"),
+                   arc_stack=getattr(args, "arc_stack", False))
         bracket = getattr(args, "arc_bracket", None)
         if bracket is not None:
             pkw["arc_constraint"] = (float(bracket[0]), float(bracket[1]))
@@ -314,7 +315,44 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
             # merge so a partial resume never erases the full-survey
             # baseline
             store.put_meta("routes", {**prev, **routes})
-        for indices, res in buckets:
+        for bucket_no, (indices, res) in enumerate(buckets):
+            if res.arc_stacked is not None:
+                # one campaign curvature per shape-bucket (all its
+                # epochs share a grid); meta + log only — per-epoch
+                # rows keep the reference CSV schema.  A chunked bucket
+                # yields one SUB-campaign fit per chunk (leaves of
+                # ndim>=1) — reported as lists.
+                key = "betaeta" if args.lamsteps else "eta"
+
+                def _vals(x):
+                    a = np.asarray(x).ravel()
+                    return float(a[0]) if a.size == 1 else [
+                        float(v) for v in a]
+
+                camp_files = sorted(os.path.basename(names[i])
+                                    for i in indices)
+                camp = {"bucket": bucket_no, "n_epochs": len(indices),
+                        "files": camp_files,
+                        key: _vals(res.arc_stacked.eta),
+                        key + "err": _vals(res.arc_stacked.etaerr),
+                        key + "err2": _vals(res.arc_stacked.etaerr2)}
+                log_event(log, "arc_stack", bucket=bucket_no,
+                          n_epochs=len(indices), **{
+                              key: camp[key], key + "err": camp[key + "err"]})
+                if store is not None:
+                    # one atomic meta file per campaign, keyed by the
+                    # epochs it covers: concurrent runs can't lose each
+                    # other's records (no shared-list read-modify-
+                    # write), identical re-runs overwrite idempotently,
+                    # and a RESUMED partial survey writes a separate
+                    # record whose "files" list says exactly which
+                    # sub-campaign it is.  Enumerate with
+                    # store.meta_names("arc_stack.").
+                    import hashlib
+
+                    digest = hashlib.sha1(
+                        "\n".join(camp_files).encode()).hexdigest()[:12]
+                    store.put_meta(f"arc_stack.{digest}", camp)
             for lane, idx in enumerate(indices):
                 row = results_row(epochs[idx])
                 if res.scint is not None:
@@ -748,6 +786,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="curvature bracket: the peak-search constraint "
                         "(norm_sspec/gridmax) or the sweep range "
                         "(thetatheta)")
+    q.add_argument("--arc-stack", action="store_true",
+                   help="ALSO measure one campaign curvature per "
+                        "shape-bucket by nanmean-stacking the epochs' "
+                        "normalised profiles before the arc fit "
+                        "(norm_sspec, batched mode; weak-arc S/N grows "
+                        "as sqrt(epochs); written to store meta + log)")
     q.add_argument("--clean", action="store_true",
                    help="RFI/gain cleaning between load and the fits: "
                         "per-channel robust triage (zap method="

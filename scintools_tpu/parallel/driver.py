@@ -82,6 +82,13 @@ class PipelineConfig:
     arc_constraint: tuple = (0.0, np.inf)
     arc_asymm: bool = False       # per-arm eta_left/eta_right in ArcFit
     arc_brackets: tuple | None = None  # K (lo, hi) windows -> eta [B, K]
+    # Campaign stacking (norm_sspec only; beyond the reference): ALSO
+    # nanmean-stack the per-epoch normalised profiles across the batch
+    # and measure once -> PipelineResult.arc_stacked (scalar ArcFit);
+    # weak-arc S/N grows as sqrt(B).  Corrupted (NaN) epochs drop out
+    # of the stack (nan-robust reductions); run_pipeline NaN-fills its
+    # divisibility pad-lanes so they cannot bias the campaign.
+    arc_stack: bool = False
     # Arc delay-scrunch strategy: 0 = full [B, R, n] gather, >0 = lax.scan
     # row blocks of that size (bounded HBM), "pallas" = fused VMEM kernel
     # (ops/resample_pallas; interpret mode off-TPU), -1 = auto: the
@@ -115,6 +122,7 @@ class PipelineResult:
     scint2d: Any = None     # ScintParams from the 2-D fit (fit_scint_2d)
     tilt: Any = None        # [B] phase-gradient tilt (s/MHz)
     tilterr: Any = None
+    arc_stacked: Any = None  # scalar ArcFit (campaign stack, arc_stack)
 
 
 def _register():
@@ -124,7 +132,8 @@ def _register():
         jax.tree_util.register_pytree_node(
             PipelineResult,
             lambda r: ((r.scint, r.arc, r.acf, r.sspec, r.fdop, r.tdel,
-                        r.beta, r.scint2d, r.tilt, r.tilterr), None),
+                        r.beta, r.scint2d, r.tilt, r.tilterr,
+                        r.arc_stacked), None),
             lambda _, l: PipelineResult(*l))
     except ImportError:  # pragma: no cover
         pass
@@ -198,6 +207,13 @@ def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
             f"PipelineConfig.arc_method: unknown method "
             f"{config.arc_method!r} (expected 'norm_sspec', 'gridmax' or "
             f"'thetatheta')")
+    if config.arc_stack and (config.arc_method != "norm_sspec"
+                             or not config.fit_arc
+                             or config.arc_brackets is not None):
+        raise ValueError(
+            "PipelineConfig.arc_stack requires fit_arc=True with "
+            "arc_method='norm_sspec' and no arc_brackets (the campaign "
+            "stack averages ONE normalised profile per epoch)")
     if config.arc_method == "thetatheta" and config.fit_arc:
         windows = (config.arc_brackets if config.arc_brackets is not None
                    else (config.arc_constraint,))
@@ -517,6 +533,7 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
                         config.scint_cuts, mesh, dyn_acf.shape,
                         itemsize=dyn_acf.dtype.itemsize))
         arc = None
+        arc_stacked = None
         sec_b = None
         if config.fit_arc or config.return_sspec:
             fft_in = (jnp.einsum("lf,bft->blt", jnp.asarray(W_np),
@@ -527,13 +544,19 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
                              window_frac=config.window_frac, db=True,
                              backend="jax")
             if config.fit_arc:
-                arc = build_arc_fitter()(sec_b)
+                fitter = build_arc_fitter()
+                arc = fitter(sec_b)
+                if config.arc_stack:
+                    # campaign stack: NaN pad-lanes/corrupted epochs
+                    # drop out of the nan-robust reductions
+                    arc_stacked = fitter.stacked(sec_b)
         return PipelineResult(
             scint=scint, arc=arc, acf=out.get("acf"),
             sspec=sec_b if config.return_sspec else None,
             fdop=jnp.asarray(fdop), tdel=jnp.asarray(tdel),
             beta=None if beta is None else jnp.asarray(beta),
-            scint2d=scint2d, tilt=tilt, tilterr=tilterr)
+            scint2d=scint2d, tilt=tilt, tilterr=tilterr,
+            arc_stacked=arc_stacked)
 
     if mesh is None:
         return jax.jit(step)
@@ -593,6 +616,13 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
                              np.asarray(group[0].times), config, mesh=mesh,
                              chan_sharded=chan_sharded)
         dyn = np.asarray(batch.dyn)
+        if config.arc_stack and not np.all(_mask.epoch):
+            # divisibility pad-lanes are COPIES of the last epoch
+            # (pad_batch) — fine for per-epoch results (sliced off
+            # below) but they would bias the campaign stack; NaN-fill
+            # them so the stacked nanmean drops them
+            dyn = dyn.copy()
+            dyn[~_mask.epoch] = np.nan
         B = dyn.shape[0]
         if chunk is None or chunk >= B:
             res = step(_as_global_batch(dyn, mesh, chan_sharded))
@@ -678,10 +708,28 @@ def _concat_results(parts):
             *[dataclasses.replace(p.arc, profile_eta=None) for p in parts])
         arc = dataclasses.replace(cat_arc,
                                   profile_eta=np.asarray(first.arc.profile_eta))
+    arc_stacked = None
+    if first.arc_stacked is not None:
+        if len(parts) == 1:
+            arc_stacked = first.arc_stacked
+        else:
+            # the campaign stack is a per-STEP reduction: a chunked run
+            # yields one sub-campaign fit per chunk, stacked to
+            # [n_chunks] leaves (consumers see ndim>=1 and report
+            # each).  profile_eta is the SHARED grid — splice it back
+            # unstacked, as the per-epoch arc concat above does.
+            arc_stacked = jax.tree_util.tree_map(
+                lambda *xs: np.stack([np.asarray(x) for x in xs]),
+                *[dataclasses.replace(p.arc_stacked, profile_eta=None)
+                  for p in parts])
+            arc_stacked = dataclasses.replace(
+                arc_stacked,
+                profile_eta=np.asarray(first.arc_stacked.profile_eta))
     return PipelineResult(scint=out["scint"], arc=arc, acf=out["acf"],
                           sspec=out["sspec"], fdop=np.asarray(first.fdop),
                           tdel=np.asarray(first.tdel),
                           beta=None if first.beta is None
                           else np.asarray(first.beta),
                           scint2d=out["scint2d"], tilt=out["tilt"],
-                          tilterr=out["tilterr"])
+                          tilterr=out["tilterr"],
+                          arc_stacked=arc_stacked)

@@ -92,6 +92,16 @@ class ResultsStore:
             json.dump(record, fh)
         os.replace(tmp, path)
 
+    def meta_names(self, prefix: str = "") -> list[str]:
+        """Names of stored metadata records (optionally filtered by
+        prefix) — for record FAMILIES written under per-record keys
+        (e.g. ``arc_stack.<digest>``: one atomic file per campaign, so
+        concurrent runs can never lose each other's records the way a
+        read-modify-append of one shared list would)."""
+        return sorted(f[len("meta."):] for f in os.listdir(self.dir)
+                      if f.startswith("meta." + prefix)
+                      and ".tmp" not in f)
+
     def get_meta(self, name: str) -> dict | None:
         """Metadata is diagnostic: a missing OR unreadable/corrupt file
         degrades to None rather than failing the run that asked."""

@@ -133,6 +133,42 @@ def test_pipeline_pallas_scrunch_route_matches_scan(epochs):
                                np.asarray(a.arc.etaerr), rtol=1e-4)
 
 
+def test_pipeline_arc_stack_campaign():
+    """arc_stack=True adds a scalar campaign ArcFit; run_pipeline's
+    divisibility pad-lanes (copies of the last epoch) are NaN-filled so
+    they cannot bias the stack.  (Thin-arc synth epochs: the tiny
+    weak-scattering fixture epochs have no arc and the campaign fit
+    would legitimately quarantine.)"""
+    from synth import synth_arc_epoch
+
+    from scintools_tpu.parallel import run_pipeline
+
+    arc_epochs = [synth_arc_epoch(seed=s) for s in range(3)]
+    freqs = np.asarray(arc_epochs[0].freqs)
+    times = np.asarray(arc_epochs[0].times)
+    cfg = PipelineConfig(fit_scint=False, arc_numsteps=400,
+                         arc_stack=True)
+    batch, _ = pad_batch(arc_epochs)
+    res = make_pipeline(freqs, times, cfg)(np.asarray(batch.dyn))
+    eta_c = float(np.asarray(res.arc_stacked.eta))
+    assert np.isfinite(eta_c)
+    per = np.asarray(res.arc.eta)
+    assert np.nanmin(per) * 0.8 <= eta_c <= np.nanmax(per) * 1.2
+
+    # mesh multiple of 4 forces one pad lane for 3 epochs: the campaign
+    # fit must equal the unpadded 3-epoch stack exactly
+    import jax
+
+    mesh = make_mesh(shape=(4, 1), devices=jax.devices()[:4])
+    (idx, rp), = run_pipeline(arc_epochs, cfg, mesh=mesh)
+    np.testing.assert_allclose(float(np.asarray(rp.arc_stacked.eta)),
+                               eta_c, rtol=1e-5)
+
+    with pytest.raises(ValueError, match="arc_stack"):
+        make_pipeline(freqs, times, PipelineConfig(
+            arc_stack=True, arc_method="gridmax"))
+
+
 def test_resolve_cuts_validation_and_size_gate(monkeypatch):
     import scintools_tpu.parallel.driver as drv
     from scintools_tpu.parallel.driver import _resolve_cuts

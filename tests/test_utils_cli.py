@@ -346,6 +346,27 @@ def test_cli_process_batched(tmp_path, capsys):
     assert rc2 == 0
     assert len(open(res).read().strip().splitlines()) == 4
 
+    # --arc-stack: one campaign record per bucket under its own meta
+    # key (idempotent per file-set; resumable without lost updates).
+    # The weak sims may quarantine the campaign fit to NaN — the
+    # record must exist either way, with the epoch count and files.
+    from scintools_tpu.utils.store import ResultsStore
+
+    store2 = str(tmp_path / "st2")
+    rc3 = cli_main(["process", *files, "--lamsteps", "--batched",
+                    "--arc-stack", "--store", store2])
+    assert rc3 == 0
+    st2 = ResultsStore(store2)
+    names_m = st2.meta_names("arc_stack.")
+    assert len(names_m) == 1
+    camp = st2.get_meta(names_m[0])
+    assert camp["n_epochs"] == 3 and len(camp["files"]) == 3
+    assert "betaeta" in camp and "betaetaerr2" in camp
+    # re-run on the same store: no duplicate campaign records
+    assert cli_main(["process", *files, "--lamsteps", "--batched",
+                     "--arc-stack", "--store", store2]) == 0
+    assert st2.meta_names("arc_stack.") == names_m
+
 
 def test_cli_process_scint_2d(tmp_path, capsys):
     """--scint-2d adds phase-gradient tilt to the store rows (per-file
