@@ -106,6 +106,19 @@ def cmd_process(args) -> int:
             if flag is not None:
                 raise SystemExit(f"{name} only applies to the batched "
                                  "engine; add --batched")
+        if getattr(args, "arc_stack", False):
+            raise SystemExit("--arc-stack stacks profiles across the "
+                             "batch; add --batched")
+    elif getattr(args, "arc_stack", False):
+        # fail as a usage error, not a quarantined whole-survey
+        # pipeline failure inside run_pipeline
+        if args.no_arc:
+            raise SystemExit("--arc-stack needs the arc fit; drop "
+                             "--no-arc")
+        if arc_method != "norm_sspec":
+            raise SystemExit("--arc-stack requires "
+                             "--arc-method norm_sspec (the campaign "
+                             "stack averages normalised profiles)")
     if getattr(args, "full_csv", False) and not (args.store
                                                  and args.results):
         raise SystemExit("--full-csv exports the store's columns: it "
@@ -329,29 +342,41 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
                     return float(a[0]) if a.size == 1 else [
                         float(v) for v in a]
 
-                camp_files = sorted(os.path.basename(names[i])
-                                    for i in indices)
+                # files in BUCKET ORDER (not sorted): with
+                # --chunk-epochs C the per-chunk sub-campaign k covers
+                # files[k*C:(k+1)*C], so the record stays mappable
+                camp_files = [os.path.basename(names[i]) for i in indices]
                 camp = {"bucket": bucket_no, "n_epochs": len(indices),
                         "files": camp_files,
                         key: _vals(res.arc_stacked.eta),
                         key + "err": _vals(res.arc_stacked.etaerr),
                         key + "err2": _vals(res.arc_stacked.etaerr2)}
+                if np.ndim(np.asarray(res.arc_stacked.eta)) >= 1:
+                    # chunked bucket: one SUB-campaign fit per chunk
+                    # (S/N grows as sqrt(chunk), not sqrt(n_epochs)).
+                    # Record the EFFECTIVE chunk (run_pipeline rounds
+                    # the request up to the mesh's data-axis multiple):
+                    # sub-campaign k covers files[k*C:(k+1)*C] (the
+                    # final chunk's divisibility pad-lanes are NaN and
+                    # contribute nothing)
+                    mult = (mesh.shape["data"] if mesh is not None
+                            else 1)
+                    camp["chunk_epochs"] = (
+                        -(-int(args.chunk_epochs) // mult) * mult)
                 log_event(log, "arc_stack", bucket=bucket_no,
                           n_epochs=len(indices), **{
                               key: camp[key], key + "err": camp[key + "err"]})
                 if store is not None:
                     # one atomic meta file per campaign, keyed by the
-                    # epochs it covers: concurrent runs can't lose each
-                    # other's records (no shared-list read-modify-
-                    # write), identical re-runs overwrite idempotently,
-                    # and a RESUMED partial survey writes a separate
-                    # record whose "files" list says exactly which
-                    # sub-campaign it is.  Enumerate with
-                    # store.meta_names("arc_stack.").
-                    import hashlib
-
-                    digest = hashlib.sha1(
-                        "\n".join(camp_files).encode()).hexdigest()[:12]
+                    # FULL PATHS it covers (basenames can collide across
+                    # sessions): concurrent runs can't lose each other's
+                    # records (no shared-list read-modify-write),
+                    # identical re-runs overwrite idempotently, and a
+                    # RESUMED partial survey writes a separate record
+                    # whose "files" list says exactly which sub-campaign
+                    # it is.  Enumerate with store.meta_names("arc_stack.").
+                    digest = content_key(
+                        "\n".join(names[i] for i in indices), ())[:12]
                     store.put_meta(f"arc_stack.{digest}", camp)
             for lane, idx in enumerate(indices):
                 row = results_row(epochs[idx])

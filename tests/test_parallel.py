@@ -164,6 +164,16 @@ def test_pipeline_arc_stack_campaign():
     np.testing.assert_allclose(float(np.asarray(rp.arc_stacked.eta)),
                                eta_c, rtol=1e-5)
 
+    # chunked run (no mesh): one SUB-campaign fit per chunk, [n_chunks]
+    # leaves with the shared profile_eta grid left unstacked
+    (idx2, rc_), = run_pipeline(arc_epochs, cfg, chunk=2)
+    assert np.asarray(rc_.arc_stacked.eta).shape == (2,)
+    assert np.asarray(rc_.arc_stacked.profile_eta).ndim == 1
+    np.testing.assert_allclose(
+        float(np.asarray(rc_.arc_stacked.eta)[0]),
+        float(np.asarray(make_pipeline(freqs, times, cfg)(
+            np.asarray(batch.dyn)[:2]).arc_stacked.eta)), rtol=1e-5)
+
     with pytest.raises(ValueError, match="arc_stack"):
         make_pipeline(freqs, times, PipelineConfig(
             arc_stack=True, arc_method="gridmax"))

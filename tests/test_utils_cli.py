@@ -367,6 +367,13 @@ def test_cli_process_batched(tmp_path, capsys):
                      "--arc-stack", "--store", store2]) == 0
     assert st2.meta_names("arc_stack.") == names_m
 
+    # usage errors fail fast, not as quarantined pipeline failures
+    with pytest.raises(SystemExit, match="arc-stack"):
+        cli_main(["process", *files, "--arc-stack"])
+    with pytest.raises(SystemExit, match="norm_sspec"):
+        cli_main(["process", *files, "--batched", "--arc-stack",
+                  "--arc-method", "gridmax"])
+
 
 def test_cli_process_scint_2d(tmp_path, capsys):
     """--scint-2d adds phase-gradient tilt to the store rows (per-file
