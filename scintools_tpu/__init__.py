@@ -26,4 +26,4 @@ __version__ = "0.1.0"
 
 from .backend import jax_available, resolve, xp  # noqa: F401
 from .data import ArcFit, DynspecData, ScintParams, SecSpec  # noqa: F401
-from .pipeline import Dynspec, sort_dyn  # noqa: F401
+from .pipeline import Dynspec, fit_arc_campaign, sort_dyn  # noqa: F401

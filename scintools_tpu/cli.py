@@ -354,15 +354,17 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
                 if np.ndim(np.asarray(res.arc_stacked.eta)) >= 1:
                     # chunked bucket: one SUB-campaign fit per chunk
                     # (S/N grows as sqrt(chunk), not sqrt(n_epochs)).
-                    # Record the EFFECTIVE chunk (run_pipeline rounds
-                    # the request up to the mesh's data-axis multiple):
+                    # Record the EFFECTIVE chunk via run_pipeline's own
+                    # adjustment rule (single source of truth):
                     # sub-campaign k covers files[k*C:(k+1)*C] (the
                     # final chunk's divisibility pad-lanes are NaN and
                     # contribute nothing)
+                    from .parallel.driver import _adjust_chunk
+
                     mult = (mesh.shape["data"] if mesh is not None
                             else 1)
-                    camp["chunk_epochs"] = (
-                        -(-int(args.chunk_epochs) // mult) * mult)
+                    camp["chunk_epochs"] = _adjust_chunk(
+                        mult, int(args.chunk_epochs))
                 log_event(log, "arc_stack", bucket=bucket_no,
                           n_epochs=len(indices), **{
                               key: camp[key], key + "err": camp[key + "err"]})
@@ -375,8 +377,14 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
                     # RESUMED partial survey writes a separate record
                     # whose "files" list says exactly which sub-campaign
                     # it is.  Enumerate with store.meta_names("arc_stack.").
+                    # "arc_stack:" prefix: the joined string must never
+                    # itself be an existing path, or content_key would
+                    # hash file BYTES for single-epoch campaigns (and
+                    # byte-identical copies would collide)
                     digest = content_key(
-                        "\n".join(names[i] for i in indices), ())[:12]
+                        "arc_stack:" + "\n".join(names[i]
+                                                 for i in indices),
+                        ())[:12]
                     store.put_meta(f"arc_stack.{digest}", camp)
             for lane, idx in enumerate(indices):
                 row = results_row(epochs[idx])

@@ -627,3 +627,50 @@ def sort_dyn(dynfiles: Sequence[str], outdir: str | None = None,
             with open(os.path.join(outdir, name), "w") as f:
                 f.writelines(x + "\n" for x in lst)
     return good, bad
+
+
+def fit_arc_campaign(epochs, lamsteps: bool = True, numsteps: int = 2000,
+                     constraint=(0.0, np.inf), mesh=None, **config_kw):
+    """One campaign arc curvature from MANY epochs of the same source.
+
+    Incoherent profile stacking (beyond the reference, whose fitter is
+    one-file-at-a-time): every epoch's normalised delay-scrunched
+    power-vs-curvature profile is nanmean-stacked before a single arc
+    measurement, growing weak-arc S/N as sqrt(len(epochs)).  Epochs may
+    be ``Dynspec`` wrappers, ``DynspecData``, or psrflux paths (paths
+    get the batched engine's standard preparation: trim_edges ->
+    refill); all epochs must land in ONE shape/axis bucket — mixed
+    grids are a usage error, reported with the bucket split.  Returns a
+    scalar :class:`~scintools_tpu.data.ArcFit` whose profile fields
+    plot directly (``plotting.plot_arc_profile``).
+
+    Extra keyword arguments become :class:`PipelineConfig` fields (e.g.
+    ``arc_scrunch_rows``, ``prewhite``); execution delegates to
+    ``parallel.run_pipeline`` (one jit for the whole campaign,
+    NaN-filled divisibility pad-lanes, optional ``mesh=`` sharding).
+    """
+    from .io import read_psrflux
+    from .ops import refill, trim_edges
+    from .parallel import PipelineConfig, run_pipeline
+
+    datas = []
+    for e in epochs:
+        if isinstance(e, str):
+            datas.append(refill(trim_edges(read_psrflux(e))))
+        elif isinstance(e, Dynspec):
+            datas.append(e._data)
+        else:
+            datas.append(e)
+    if not datas:
+        raise ValueError("fit_arc_campaign needs at least one epoch")
+    cfg = PipelineConfig(lamsteps=lamsteps, fit_scint=False,
+                         arc_numsteps=numsteps, arc_constraint=constraint,
+                         arc_stack=True, **config_kw)
+    results = run_pipeline(datas, cfg, mesh=mesh)
+    if len(results) != 1:
+        raise ValueError(
+            f"fit_arc_campaign epochs span {len(results)} shape/axis "
+            f"buckets (sizes {[len(i) for i, _ in results]}) — a "
+            f"campaign stack needs one shared grid; fit each bucket "
+            f"separately")
+    return results[0][1].arc_stacked

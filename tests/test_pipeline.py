@@ -216,3 +216,27 @@ def test_wrapper_chain_survives_nan_stripes():
     ds.calc_sspec(lamsteps=True)
     ds.get_scint_params()
     assert np.isfinite(ds.tau) and np.isfinite(ds.dnu)
+
+
+def test_fit_arc_campaign_helper():
+    """fit_arc_campaign: scalar campaign ArcFit from a mixed list of
+    Dynspec wrappers and DynspecData epochs, matching the underlying
+    arc_stack pipeline."""
+    from synth import synth_arc_epoch
+
+    from scintools_tpu import Dynspec, fit_arc_campaign
+    from scintools_tpu.parallel import PipelineConfig, make_pipeline, pad_batch
+
+    eps = [synth_arc_epoch(seed=s) for s in range(3)]
+    mixed = [Dynspec(data=eps[0], process=False), eps[1], eps[2]]
+    fit = fit_arc_campaign(mixed, numsteps=400)
+    eta = float(np.asarray(fit.eta))
+    assert np.isfinite(eta)
+
+    batch, _ = pad_batch(eps)
+    cfg = PipelineConfig(lamsteps=True, fit_scint=False,
+                         arc_numsteps=400, arc_stack=True)
+    want = make_pipeline(np.asarray(eps[0].freqs), np.asarray(eps[0].times),
+                         cfg)(np.asarray(batch.dyn, np.float32))
+    np.testing.assert_allclose(eta, float(np.asarray(want.arc_stacked.eta)),
+                               rtol=1e-6)
