@@ -71,7 +71,12 @@ def main(outdir: str = "/tmp/survey_pipeline") -> dict:
     log_event(log, "survey_start", total=len(epochs), todo=len(todo))
 
     mesh = make_mesh()  # all devices on the data axis
-    cfg = PipelineConfig(lamsteps=True, arc_numsteps=1000, lm_steps=30)
+    # arc_stack: besides the per-epoch fits, nanmean-stack every epoch's
+    # normalised profile and measure ONE campaign curvature per bucket
+    # (weak-arc S/N grows as sqrt(epochs) — beyond the reference's
+    # one-file-at-a-time fitter)
+    cfg = PipelineConfig(lamsteps=True, arc_numsteps=1000, lm_steps=30,
+                         arc_stack=True)
 
     stats = {}
     if todo:
@@ -80,7 +85,12 @@ def main(outdir: str = "/tmp/survey_pipeline") -> dict:
 
         # gather per-epoch rows + survey reductions per shape bucket
         all_tau, all_eta = [], []
-        for indices, res in buckets:
+        for bucket_no, (indices, res) in enumerate(buckets):
+            camp_eta = float(np.asarray(res.arc_stacked.eta))
+            log_event(log, "campaign_arc", bucket=bucket_no,
+                      n_epochs=len(indices), betaeta=camp_eta,
+                      betaetaerr=float(np.asarray(res.arc_stacked.etaerr)))
+            stats.setdefault("campaign_eta", []).append(camp_eta)
             tau = np.asarray(res.scint.tau)
             eta = np.asarray(res.arc.eta)
             all_tau.append(tau)
