@@ -237,6 +237,29 @@ def _last_json_line(stdout: str) -> dict:
     return {}
 
 
+def stamp_tunnel_weather(rec: dict, probe: dict) -> dict:
+    """Stamp an on-chip headline whose roofline fraction is far below
+    every healthy capture.
+
+    Round-4 incident: a degraded tunnel measured the same program ~20x
+    slower while the chip was healthy minutes later.  Size-independent
+    detector: every healthy on-chip capture runs >= several % of the
+    bandwidth roofline (docs/performance.md round-4 tables: 6-10 % full
+    step); the degraded flight ran 0.4-1.0 %.  The honest number is
+    kept — the stamp just stops a weather-run being read as a ceiling.
+    CPU platforms are exempt (different ceiling, no tunnel in the path).
+    """
+    roof_pct = (rec.get("roofline") or {}).get("roofline_pct")
+    if (probe.get("platform") in ("tpu", "axon")
+            and isinstance(roof_pct, (int, float))
+            and roof_pct < 1.5):
+        rec["tunnel_weather_suspect"] = (
+            f"on-chip roofline_pct={roof_pct} is far below every "
+            f"healthy capture (docs/performance.md round-4 tables); "
+            f"re-run scripts/tpu_recheck.sh single-flight")
+    return rec
+
+
 def device_preprobe(timeout_s: int) -> dict:
     """Cheap subprocess probe of the attached accelerator BEFORE the full
     run: claims the device, runs one tiny op, reports platform + latency.
@@ -459,25 +482,8 @@ def main():
         th.join(timeout_s)
 
         if "rate" in result:
-            rec = device_record(result, probe=probe)
-            # round-4 incident: a degraded tunnel measured the same
-            # program ~20x slower while the chip was healthy minutes
-            # later.  Size-independent detector: every healthy on-chip
-            # capture runs >= several % of the bandwidth roofline
-            # (docs/performance.md round-4 tables: 6-10 % full step);
-            # the degraded flight ran 0.4-1.0 %.  Keep the honest
-            # number but stamp it so a weather-run is never read as a
-            # ceiling.  CPU platforms are exempt (different ceiling,
-            # no tunnel in the path).
-            roof_pct = (rec.get("roofline") or {}).get("roofline_pct")
-            if (probe.get("platform") in ("tpu", "axon")
-                    and isinstance(roof_pct, (int, float))
-                    and roof_pct < 1.5):
-                rec["tunnel_weather_suspect"] = (
-                    f"on-chip roofline_pct={roof_pct} is far below "
-                    f"every healthy capture (docs/performance.md "
-                    f"round-4 tables); re-run scripts/tpu_recheck.sh "
-                    f"single-flight")
+            rec = stamp_tunnel_weather(device_record(result, probe=probe),
+                                       probe)
             print(json.dumps(rec))
             return
         err = result.get(
@@ -534,11 +540,13 @@ def main():
 
     # the wedged-looking device thread may have finished late while the
     # fallback ran — a real chip number always beats the degraded record
+    # (but a run that blew the watchdog is the LIKELIEST to be weather-
+    # degraded, so it gets the stamp too)
     if "rate" in result:
-        print(json.dumps(device_record(
+        print(json.dumps(stamp_tunnel_weather(device_record(
             result, probe=probe,
-            note=f"device completed after the {timeout_s}s watchdog")),
-            flush=True)
+            note=f"device completed after the {timeout_s}s watchdog"),
+            probe)), flush=True)
         os._exit(0)
 
     if fb.get("rate"):

@@ -243,3 +243,23 @@ def test_pallas_ab_harness_runs_tiny(capsys):
     assert {r["kernel"] for r in lines} == {"row_scrunch"}
     for r in lines:
         assert r["verdict"] in ("wire", "keep-off"), r
+
+
+def test_stamp_tunnel_weather():
+    """The weather stamp fires only for on-chip records whose roofline
+    fraction is incident-class low — never for CPU platforms, healthy
+    fractions, or records without roofline accounting."""
+    import bench
+
+    def rec(pct):
+        return {"roofline": {"roofline_pct": pct}}
+
+    tpu = {"platform": "axon"}
+    assert "tunnel_weather_suspect" in bench.stamp_tunnel_weather(
+        rec(0.5), tpu)
+    assert "tunnel_weather_suspect" not in bench.stamp_tunnel_weather(
+        rec(9.7), tpu)
+    assert "tunnel_weather_suspect" not in bench.stamp_tunnel_weather(
+        rec(0.5), {"platform": "cpu"})
+    assert "tunnel_weather_suspect" not in bench.stamp_tunnel_weather(
+        {"roofline": {"error": "x"}}, tpu)
