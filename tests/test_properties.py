@@ -235,6 +235,33 @@ def test_row_scrunch_scan_equals_full_gather(rows, n, block_r, data):
                                equal_nan=True)
 
 
+@settings(max_examples=10, deadline=None,
+          suppress_health_check=[HealthCheck.too_slow])
+@given(st.integers(9, 40), st.integers(120, 200), st.data())
+def test_row_scrunch_pallas_segmented_gather_equals_reference(R, n, data):
+    """The Mosaic 128-lane segmented-gather decomposition (interpret
+    mode; fixed C=256 so every example crosses segment boundaries
+    WITHOUT recompiling per shape) equals the full-gather nanmean for
+    ANY gather pattern, weights, and NaN placement — including anchors
+    at the 127/128 segment boundary, which hypothesis reaches freely."""
+    from scintools_tpu.ops.resample_pallas import row_scrunch_pallas
+    from test_resample_pallas import _reference_scrunch
+
+    C = 256                      # two source segments; n spans 1-2 chunks
+    rows = data.draw(_finite_arrays(st.just((R, C)), lo=-100, hi=100))
+    i0 = data.draw(hnp.arrays(np.int64, (R, n),
+                              elements=st.integers(0, C - 2)))
+    w = data.draw(hnp.arrays(np.float64, (R, n),
+                             elements=st.floats(0, 1, width=64)))
+    nanmask = data.draw(hnp.arrays(np.bool_, (R, C)))
+    rows = np.where(nanmask, np.nan, rows)
+    want = _reference_scrunch(rows, i0, w)
+    got = np.asarray(row_scrunch_pallas(rows, i0.astype(np.int32), w,
+                                        block_r=8, interpret=True))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7,
+                               equal_nan=True)
+
+
 @_SETTINGS
 @given(_finite_arrays(st.just((24, 24)), lo=-10, hi=10),
        st.floats(0.05, 2.0), st.floats(0.1, 0.9))
