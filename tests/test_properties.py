@@ -237,17 +237,17 @@ def test_row_scrunch_scan_equals_full_gather(rows, n, block_r, data):
 
 @settings(max_examples=10, deadline=None,
           suppress_health_check=[HealthCheck.too_slow])
-@given(st.integers(9, 40), st.integers(120, 200), st.data())
-def test_row_scrunch_pallas_segmented_gather_equals_reference(R, n, data):
+@given(st.data())
+def test_row_scrunch_pallas_segmented_gather_equals_reference(data):
     """The Mosaic 128-lane segmented-gather decomposition (interpret
-    mode; fixed C=256 so every example crosses segment boundaries
-    WITHOUT recompiling per shape) equals the full-gather nanmean for
+    mode; FIXED shape per this file's convention — one kernel build,
+    hypothesis searches values only) equals the full-gather nanmean for
     ANY gather pattern, weights, and NaN placement — including anchors
     at the 127/128 segment boundary, which hypothesis reaches freely."""
     from scintools_tpu.ops.resample_pallas import row_scrunch_pallas
     from test_resample_pallas import _reference_scrunch
 
-    C = 256                      # two source segments; n spans 1-2 chunks
+    R, C, n = 24, 256, 160       # two source segments; n spans 2 chunks
     rows = data.draw(_finite_arrays(st.just((R, C)), lo=-100, hi=100))
     i0 = data.draw(hnp.arrays(np.int64, (R, n),
                               elements=st.integers(0, C - 2)))
