@@ -20,6 +20,14 @@ print('tpu alive:', float(np.asarray(jnp.sum(jnp.ones((64,64))))))
 echo "== probe =="
 probe || { echo "tunnel unreachable; aborting"; exit 1; }
 
+# HEADLINE FIRST (round-4 lesson: the tunnel wedged mid-flight and
+# took the un-run bench stage with it — the headline is the round's
+# #1 deliverable, so it runs before the gates; a broken route would
+# surface as a failed/NaN bench, which the later gates then explain)
+echo "== headline bench =="
+timeout -k 10 2400 python bench.py 2>&1 \
+  | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -2
+
 echo "== pallas row-scrunch lowers on chip =="
 # the fused row-scrunch kernel is the arc fitter's on-chip auto route
 # since round 4 (wire verdict, 3.5x the scan); CI validates it in
@@ -126,10 +134,6 @@ if ! timeout -k 10 1800 python benchmarks/f32_budget_onchip.py \
   echo "f32 on-chip check FAILED"
   exit 1
 fi
-
-echo "== headline bench =="
-timeout -k 10 2400 python bench.py 2>&1 \
-  | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -2
 
 echo "== all five configs =="
 timeout -k 10 3600 python benchmarks/all_configs.py 2>&1 \
