@@ -381,9 +381,16 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
                     # itself be an existing path, or content_key would
                     # hash file BYTES for single-epoch campaigns (and
                     # byte-identical copies would collide)
+                    # realpath-normalize so the same campaign invoked
+                    # with different path spellings (relative vs ./ vs
+                    # absolute vs symlinked) keys ONE record, as
+                    # idempotence requires.  Digest-scheme change
+                    # (round 5): stores written before this keyed on the
+                    # raw spelling; those records remain enumerable but
+                    # a re-run writes under the normalized key.
                     digest = content_key(
-                        "arc_stack:" + "\n".join(names[i]
-                                                 for i in indices),
+                        "arc_stack:" + "\n".join(
+                            os.path.realpath(names[i]) for i in indices),
                         ())[:12]
                     store.put_meta(f"arc_stack.{digest}", camp)
             for lane, idx in enumerate(indices):

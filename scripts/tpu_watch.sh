@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Tunnel watcher: probe the axon tunnel every TPU_WATCH_PAUSE seconds; on
+# the first healthy probe, run the complete single-flight capture set
+# (scripts/tpu_recheck.sh — headline bench FIRST) into a timestamped
+# flight log, then exit.  The tunnel's health comes and goes in
+# minute-scale windows, so the capture must start the moment a probe
+# answers — not at the next human check-in.
+#
+# Single-flight discipline: this script is the ONLY process allowed to
+# touch the device while it runs (concurrent device processes can wedge
+# the tunnel for good).  CPU-side work (tests, dryruns) must pin
+# JAX_PLATFORMS=cpu.
+set -u
+cd "$(dirname "$0")/.."
+
+PAUSE="${TPU_WATCH_PAUSE:-600}"
+MAX_TRIES="${TPU_WATCH_TRIES:-60}"
+LOG_DIR=benchmarks/flights
+mkdir -p "$LOG_DIR"
+
+for ((i = 1; i <= MAX_TRIES; i++)); do
+  ts=$(date -u +%Y%m%dT%H%M%SZ)
+  # a wedged claim ignores SIGTERM: escalate to SIGKILL after 5 s
+  # match the success marker anywhere in the output (NOT tail -1: an
+  # unfiltered trailing teardown line must not mask a healthy probe)
+  out=$(timeout -k 5 180 python -u -c "
+import numpy as np, jax, jax.numpy as jnp
+print('tpu alive:', float(np.asarray(jnp.sum(jnp.ones((64,64))))))
+" 2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -3)
+  echo "[$ts] probe $i/$MAX_TRIES: ${out##*$'\n'}"
+  if [[ "$out" == *"tpu alive"* ]]; then
+    log="$LOG_DIR/r5_flight_${ts}.log"
+    echo "[$ts] tunnel ALIVE — starting full capture -> $log"
+    bash scripts/tpu_recheck.sh 2>&1 | tee "$log"
+    rc=${PIPESTATUS[0]}
+    echo "recheck rc=$rc (log: $log)"
+    exit "$rc"
+  fi
+  sleep "$PAUSE"
+done
+echo "tunnel never answered in $MAX_TRIES probes"
+exit 1
