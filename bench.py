@@ -41,6 +41,9 @@ import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 CACHE_DIR = os.path.join(_HERE, ".jax_cache")
+# default bench shape (B, nf, nt) — the single source for main()'s env
+# defaults AND stamp_tunnel_weather's near-default floor calibration
+DEFAULT_SHAPE = (1024, 256, 512)
 
 
 def _env_int(name, default):
@@ -258,8 +261,9 @@ def stamp_tunnel_weather(rec: dict, probe: dict,
     # comes from the caller (main() already parsed it); the default
     # keeps a bare stamp_tunnel_weather(rec, probe) conservative (stamps
     # apply) rather than reading ambient env state here.
-    b, nf, nt = shape if shape is not None else (1024, 256, 512)
-    near_default = (b * nf * nt) >= (1024 * 256 * 512) // 2
+    b, nf, nt = shape if shape is not None else DEFAULT_SHAPE
+    db, dnf, dnt = DEFAULT_SHAPE
+    near_default = (b * nf * nt) >= (db * dnf * dnt) // 2
     if (probe.get("platform") in ("tpu", "axon")
             and near_default
             and isinstance(roof_pct, (int, float))
@@ -391,9 +395,9 @@ def device_throughput(dyn, freqs, times, chunk: int) -> dict:
 
 
 def main():
-    B = _env_int("SCINT_BENCH_B", 1024)
-    nf = _env_int("SCINT_BENCH_NF", 256)
-    nt = _env_int("SCINT_BENCH_NT", 512)
+    B = _env_int("SCINT_BENCH_B", DEFAULT_SHAPE[0])
+    nf = _env_int("SCINT_BENCH_NF", DEFAULT_SHAPE[1])
+    nt = _env_int("SCINT_BENCH_NT", DEFAULT_SHAPE[2])
     n_cpu = min(_env_int("SCINT_BENCH_CPU_EPOCHS", 16), B)
     chunk = _env_int("SCINT_BENCH_CHUNK", 1024)
 

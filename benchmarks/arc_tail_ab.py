@@ -1,0 +1,115 @@
+"""A/B the arc measurement tail: exact (reference-semantics) vs fast.
+
+The exact tail emulates the serial reference's compacted-array
+measurement chain bit-for-bit (dynspec.py:580-618,702-744) — a stable
+partition, savgol edge linfits, mod-wrap power-drop walks.  The fast
+tail (``arc_tail="fast"``, fit/arc_fit.py) runs the same stages as
+masked reductions on the full grid.  This harness measures, on SIMULATED
+scintillation epochs (bench.make_epochs — real arcs, so eta agreement is
+meaningful, unlike profile_stages' noise batch):
+
+  - full-step time for both tails at the bench configuration
+    (lam-resample + sspec + arc fit + scint fit, auto routes), and
+  - eta agreement quoted against the fit's OWN etaerr: the contract is
+    |eta_fast - eta_exact| <= etaerr on every healthy (finite) lane,
+    plus NaN-quarantine agreement between the two tails.
+
+Prints one JSON line:
+    {"kernel": "arc_tail", "t_exact_ms": ..., "t_fast_ms": ...,
+     "speedup": ..., "median_abs_deta_over_etaerr": ...,
+     "max_abs_deta_over_etaerr": ..., "nan_lanes_agree": true,
+     "n_finite": N, "B": B, "verdict": "ship-opt-in" | "numerics-mismatch"}
+
+Usage: python benchmarks/arc_tail_ab.py [--b 256] [--iters 5]
+Run serially with any other device work (single-flight tunnel policy).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--b", type=int, default=256)
+    ap.add_argument("--nf", type=int, default=256)
+    ap.add_argument("--nt", type=int, default=512)
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+    from scintools_tpu.parallel import PipelineConfig, make_pipeline
+
+    B = args.b
+    dyn, freqs, times = bench.make_epochs(args.nf, args.nt, B=B)
+    dyn_d = jax.device_put(dyn)
+
+    def sync(res) -> float:
+        total = jnp.sum(jnp.nan_to_num(res.arc.eta)) + jnp.sum(
+            jnp.nan_to_num(res.scint.tau))
+        return float(np.asarray(total))
+
+    def run(tail):
+        step = make_pipeline(freqs, times,
+                             PipelineConfig(arc_numsteps=2000,
+                                            arc_tail=tail))
+        t0 = time.perf_counter()
+        res = step(dyn_d)
+        sync(res)
+        compile_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(args.iters):
+            out = step(dyn_d)
+        sync(out)
+        dt = (time.perf_counter() - t0) / args.iters
+        return dt, compile_s, out
+
+    t_exact, c_exact, res_exact = run("exact")
+    t_fast, c_fast, res_fast = run("fast")
+
+    e_ex = np.asarray(res_exact.arc.eta, dtype=np.float64)
+    e_fa = np.asarray(res_fast.arc.eta, dtype=np.float64)
+    err = np.maximum(np.asarray(res_exact.arc.etaerr, dtype=np.float64),
+                     np.asarray(res_fast.arc.etaerr, dtype=np.float64))
+    finite = np.isfinite(e_ex) & np.isfinite(e_fa) & np.isfinite(err) \
+        & (err > 0)
+    ratio = np.abs(e_fa[finite] - e_ex[finite]) / err[finite]
+    nan_agree = bool(np.array_equal(np.isnan(e_ex), np.isnan(e_fa)))
+
+    med = float(np.median(ratio)) if ratio.size else float("nan")
+    mx = float(np.max(ratio)) if ratio.size else float("nan")
+    # ship the opt-in knob only if agreement holds: every healthy lane
+    # within 1 etaerr and the two tails quarantine the same lanes
+    ok = ratio.size > 0 and mx <= 1.0 and nan_agree
+    rec = {
+        "kernel": "arc_tail",
+        "platform": jax.devices()[0].platform,
+        "B": B, "nf": args.nf, "nt": args.nt, "iters": args.iters,
+        "t_exact_ms": round(t_exact * 1e3, 2),
+        "t_fast_ms": round(t_fast * 1e3, 2),
+        "speedup": round(t_exact / t_fast, 3),
+        "compile_exact_s": round(c_exact, 1),
+        "compile_fast_s": round(c_fast, 1),
+        "median_abs_deta_over_etaerr": round(med, 4),
+        "max_abs_deta_over_etaerr": round(mx, 4),
+        "n_finite": int(ratio.size),
+        "nan_lanes_agree": nan_agree,
+        "verdict": "ship-opt-in" if ok else "numerics-mismatch",
+    }
+    print(json.dumps(rec))
+    if not ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -185,6 +185,11 @@ def main():
     bench("FULL mxu+rc64", PipelineConfig(
         arc_numsteps=ns, lm_steps=30, scint_cuts="matmul",
         arc_scrunch_rows=64))
+    # (the exact-vs-fast arc measurement-tail A/B lives in
+    # benchmarks/arc_tail_ab.py — on simulated arcs, with the eta
+    # agreement verdict — not here: duplicating it as stage rows would
+    # spend two extra full-pipeline compiles of a minute-scale tunnel
+    # window re-measuring what that harness already gates)
     if only is not None and matched == 0:
         # a renamed row must FAIL the recheck script, not silently
         # skip the A/B it was asked for

@@ -458,7 +458,7 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
                             etamax, etamin, low_power_diff, high_power_diff,
                             ref_freq, constraint, nsmooth, noise_error,
                             asymm=False, constraints=None,
-                            scrunch_rows=0):
+                            scrunch_rows=0, arc_tail="exact"):
     if asymm and constraints is not None:
         raise ValueError("asymm=True and multi-arc constraints are "
                          "mutually exclusive on the batched fitter")
@@ -688,6 +688,99 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         prof, noise = profile_of(sspec)
         return measure_from_prof(prof, noise)
 
+    def measure_profile_fast(avg, valid, noise, ea, cmask, use_log):
+        """Masked-reduction measurement tail (``arc_tail="fast"``).
+
+        Same stages as the exact tail — smooth, constrained peak search,
+        power-drop walks, (log-)parabola vertex fit, noise-crossing
+        etaerr — but computed directly on the masked full grid instead
+        of emulating the reference's compacted-array semantics
+        (dynspec.py:580-618,702-744).  What it drops, and why it's
+        cheaper: no stable-partition compaction (a full-length scatter +
+        three gathers per measurement), no savgol edge linfits (a plain
+        masked moving average at the boundaries too), no mod-wrap walk
+        indexing (crossings found by masked min/max index reductions in
+        original index space), and no scatter-back of the smoothed
+        profile.  On diffuse arcs the walk endpoints can differ from the
+        compacted-index walks by a few grid cells, moving eta by up to
+        tens of percent of etaerr — the A/B contract (tests +
+        benchmarks/profile_stages.py) is |eta_fast - eta_exact| within
+        the fit's own etaerr, not bit equality.  Degenerate lanes NaN
+        out under the same conditions as the exact tail.
+        """
+        from ..models.parabola import (fit_log_parabola_vertex,
+                                       fit_parabola_vertex)
+
+        n = avg.shape[0]
+        idx = jnp.arange(n)
+        nv = jnp.sum(valid)
+        avg_z = jnp.where(valid, avg, 0.0)
+
+        # masked moving average of width nsmooth: each point averages
+        # its VALID neighbours; boundary points just see fewer of them
+        kern = jnp.ones(nsmooth, dtype=avg.dtype)
+        num = jnp.convolve(avg_z, kern, mode="same")
+        den = jnp.convolve(valid.astype(avg.dtype), kern, mode="same")
+        filt = jnp.where(valid, num / jnp.maximum(den, 1.0), jnp.nan)
+
+        # constrained peak (argmax over valid & constraint)
+        search = valid & jnp.asarray(cmask)
+        peak_ind = jnp.argmax(jnp.where(search, filt, -jnp.inf))
+        max_power = filt[peak_ind]
+
+        def crossings(threshold):
+            """Nearest valid grid points at/below ``threshold`` on each
+            side of the peak, in original index space (replaces the
+            exact tail's compacted-index walks)."""
+            below = valid & (filt <= threshold)
+            left = jnp.max(jnp.where(below & (idx < peak_ind), idx, -1))
+            right = jnp.min(jnp.where(below & (idx > peak_ind), idx, n))
+            return left, right
+
+        l1, _ = crossings(max_power + low_power_diff)
+        _, r2 = crossings(max_power + high_power_diff)
+        # window includes the left crossing, excludes the right one —
+        # the exact tail's slice convention (arr[peak-i1:peak+i2])
+        wmask = valid & (idx >= jnp.maximum(l1, 0)) & (idx < r2)
+        w = wmask.astype(avg.dtype)
+
+        # shared vertex helpers (models/parabola.py): same pre-scaling
+        # and error propagation as the exact tail's fit, with the
+        # quadratic coefficient exposed — its sign IS the
+        # forward-parabola check here (no windowed-gradient emulation)
+        if use_log:
+            a_c, eta, etaerr_fit = fit_log_parabola_vertex(
+                ea, avg_z, w=w, xp=jnp)
+        else:
+            a_c, _, eta, etaerr_fit = fit_parabola_vertex(
+                ea, avg_z, w=w, xp=jnp)
+
+        etaerr = etaerr_fit
+        if noise_error:
+            ln, rn = crossings(max_power - noise)
+            nmask = valid & (idx >= jnp.maximum(ln, 0)) & (idx < rn)
+            lo_eta = jnp.min(jnp.where(nmask, ea, jnp.inf))
+            hi_eta = jnp.max(jnp.where(nmask, ea, -jnp.inf))
+            etaerr = jnp.where(jnp.any(nmask), (hi_eta - lo_eta) / 2,
+                               jnp.nan)
+
+        # degenerate lanes -> NaN, same conditions as the exact tail
+        # (profile shorter than the smoother; empty constraint; <3
+        # window points; forward parabola — here simply a_c > 0; flat
+        # window)
+        y_hi = jnp.max(jnp.where(wmask, avg_z, -jnp.inf))
+        y_lo = jnp.min(jnp.where(wmask, avg_z, jnp.inf))
+        flat = ((y_hi - y_lo)
+                <= _FLAT_WINDOW_TOL * jnp.maximum(1.0, jnp.abs(y_hi)))
+        bad = ((nv < nsmooth) | ~jnp.any(search)
+               | (jnp.sum(w > 0) < 3) | (a_c > 0) | flat)
+        eta = jnp.where(bad, jnp.nan, eta)
+        etaerr = jnp.where(bad, jnp.nan, etaerr)
+        etaerr_fit = jnp.where(bad, jnp.nan, etaerr_fit)
+
+        avg_f = jnp.where(valid, avg, jnp.nan)
+        return eta, etaerr, etaerr_fit, avg_f, filt
+
     def measure_profile(avg, valid, noise, ea, cmask, use_log):
         """Masked peak search + power-drop walks + (log-)parabola fit on
         a power-vs-eta profile — the jit-safe tail shared by both
@@ -858,6 +951,12 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         filt_full = jnp.where(valid, filt_c[inv], jnp.nan)
         return eta, etaerr, etaerr_fit, avg_f, filt_full
 
+    if arc_tail == "fast":
+        # late-binding closure: measure_arm / measure_pow read this name
+        # at trace time, so rebinding routes BOTH methods (and the
+        # stacked mode) through the masked-reduction tail
+        measure_profile = measure_profile_fast  # noqa: F811
+
     # ---- gridmax statics (dynspec.py:516-659) --------------------------
     if method == "gridmax":
         nrow_g = ind  # delay rows kept
@@ -1006,7 +1105,7 @@ def make_arc_fitter(fdop, yaxis, tdel, freq, lamsteps=True,
                     low_power_diff=-3.0, high_power_diff=-1.5,
                     ref_freq=1400.0, constraint=(0, np.inf), nsmooth=5,
                     noise_error=True, asymm=False, constraints=None,
-                    scrunch_rows=0):
+                    scrunch_rows=0, arc_tail="exact"):
     """Build a jit'd batched arc fitter for a fixed (fdop, yaxis) grid.
 
     Returns ``fitter(sspec_batch [B, nr, nc]) -> ArcFit`` of [B] arrays.
@@ -1031,9 +1130,20 @@ def make_arc_fitter(fdop, yaxis, tdel, freq, lamsteps=True,
     kernel (ops/resample_pallas, measured 3.5x the scan on-chip;
     interpret mode off-TPU, scan fallback for non-conforming Doppler
     widths).
+
+    ``arc_tail``: ``"exact"`` (default) runs the measurement tail with
+    the reference's compacted-array semantics bit-for-bit
+    (dynspec.py:580-618,702-744 — the parity contract); ``"fast"`` runs
+    the same stages as masked reductions on the full grid (no
+    compaction scatter/gathers, no savgol edge linfits, no mod-wrap
+    walks).  Opt-in speed knob: eta agrees with the exact tail to
+    within the fit's own etaerr on healthy arcs, NOT bit-exactly.
     """
     if method not in ("norm_sspec", "gridmax"):
         raise ValueError(f"unknown arc fitting method {method!r}")
+    if arc_tail not in ("exact", "fast"):
+        raise ValueError(f"arc_tail must be 'exact' or 'fast', got "
+                         f"{arc_tail!r}")
     if scrunch_rows != "pallas" and (isinstance(scrunch_rows, str)
                                      or int(scrunch_rows) < 0):
         raise ValueError(f"scrunch_rows must be >= 0 or 'pallas', got "
@@ -1053,7 +1163,8 @@ def make_arc_fitter(fdop, yaxis, tdel, freq, lamsteps=True,
         bool(noise_error), bool(asymm),
         None if constraints is None else tuple(
             (float(lo), float(hi)) for lo, hi in constraints),
-        scrunch_rows if scrunch_rows == "pallas" else int(scrunch_rows))
+        scrunch_rows if scrunch_rows == "pallas" else int(scrunch_rows),
+        str(arc_tail))
 
 
 def fit_arcs_multi(sec: SecSpec, freq: float, brackets,

@@ -48,9 +48,13 @@ def masked_ptp(x, w, xp=np):
             - xp.min(xp.where(w > 0, x, inf)))
 
 
-def fit_parabola(x, y, w=None, xp=np):
-    """Return (yfit, peak, peak_error) — reference semantics
-    (scint_models.py:216-242) including the 1000/ptp pre-scaling."""
+def fit_parabola_vertex(x, y, w=None, xp=np):
+    """Degree-2 vertex fit returning ``(a, yfit, peak, peak_error)``
+    where ``a`` is the quadratic coefficient in the pre-scaled frame
+    (its sign decides forward/backward opening) — the shared core of
+    ``fit_parabola`` and the fast arc tail's direct coefficient check,
+    so the 1000/ptp pre-scaling and the vertex error propagation exist
+    exactly once."""
     ptp = (xp.max(x) - xp.min(x)) if w is None else masked_ptp(x, w, xp)
     xs = x * (1000.0 / ptp)
     coeffs, cov = polyfit2_cov(xs, y, w=w, xp=xp)
@@ -61,7 +65,28 @@ def fit_parabola(x, y, w=None, xp=np):
     peak = -b / (2 * a)
     peak_error = xp.sqrt(berr ** 2 * (1 / (2 * a)) ** 2
                          + aerr ** 2 * (b / 2) ** 2)
-    return yfit, peak * (ptp / 1000.0), peak_error * (ptp / 1000.0)
+    return a, yfit, peak * (ptp / 1000.0), peak_error * (ptp / 1000.0)
+
+
+def fit_log_parabola_vertex(x, y, w=None, xp=np):
+    """``fit_log_parabola``'s vertex with the quadratic coefficient
+    exposed: ``(a, peak, peak_error)`` after the reference's double
+    pre-scaling and exp conversion (scint_models.py:245-263)."""
+    logx = xp.log(x)
+    ptp = ((xp.max(logx) - xp.min(logx)) if w is None
+           else masked_ptp(logx, w, xp))
+    xs = logx * (1000.0 / ptp)
+    a, _, peak, peak_error = fit_parabola_vertex(xs, y, w=w, xp=xp)
+    frac_error = peak_error / peak
+    peak = xp.exp(peak * ptp / 1000.0)
+    return a, peak, frac_error * peak
+
+
+def fit_parabola(x, y, w=None, xp=np):
+    """Return (yfit, peak, peak_error) — reference semantics
+    (scint_models.py:216-242) including the 1000/ptp pre-scaling."""
+    _, yfit, peak, peak_error = fit_parabola_vertex(x, y, w=w, xp=xp)
+    return yfit, peak, peak_error
 
 
 def fit_log_parabola(x, y, w=None, xp=np):

@@ -96,6 +96,12 @@ class PipelineConfig:
     # shape), scan-16 on host CPU (round-3 interleaved repeats: 1.45x
     # over 64-row blocks — docs/performance.md)
     arc_scrunch_rows: int | str = -1
+    # Arc measurement tail: "exact" (default) keeps the reference's
+    # compacted-array semantics bit-for-bit (the parity contract —
+    # dynspec.py:580-618,702-744); "fast" runs the same smooth/peak/
+    # walk/parabola stages as masked reductions on the full grid.
+    # Opt-in: eta agrees within the fit's own etaerr, not bit-exactly.
+    arc_tail: str = "exact"
     # ACF-cut route for the scint fit: "fft" (padded 1-D FFTs, VPU),
     # "matmul" (Gram-matrix diagonal sums, MXU), or "auto" (matmul on
     # TPU — measured ~2x faster there — fft elsewhere).  Only applies to
@@ -202,6 +208,10 @@ def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
             f"PipelineConfig.arc_scrunch_rows must be -1 (auto), 0 (full "
             f"gather), a positive block size or 'pallas', got "
             f"{config.arc_scrunch_rows}")
+    if config.arc_tail not in ("exact", "fast"):
+        raise ValueError(
+            f"PipelineConfig.arc_tail must be 'exact' or 'fast', got "
+            f"{config.arc_tail!r}")
     if config.arc_method not in ("norm_sspec", "gridmax", "thetatheta"):
         raise ValueError(
             f"PipelineConfig.arc_method: unknown method "
@@ -240,6 +250,7 @@ def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
             ("arc_nsmooth", config.arc_nsmooth, _def.arc_nsmooth),
             ("arc_scrunch_rows", config.arc_scrunch_rows,
              _def.arc_scrunch_rows),
+            ("arc_tail", config.arc_tail, _def.arc_tail),
         ) if val != dflt]
         if ignored:
             raise ValueError(
@@ -487,7 +498,7 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
             nsmooth=config.arc_nsmooth, delmax=config.arc_delmax,
             constraint=config.arc_constraint, ref_freq=config.ref_freq,
             asymm=config.arc_asymm, constraints=config.arc_brackets,
-            scrunch_rows=rc)
+            scrunch_rows=rc, arc_tail=config.arc_tail)
 
     def step(dyn_batch):
         dyn_batch = jnp.asarray(dyn_batch)

@@ -124,6 +124,17 @@ if ! timeout -k 10 3600 python benchmarks/profile_stages.py --b 1024 \
   exit 1
 fi
 
+echo "== arc measurement-tail A/B (exact vs fast, simulated arcs) =="
+# the opt-in arc_tail="fast" knob ships only while its numerics hold:
+# every healthy lane's eta within the fit's own etaerr of the exact
+# tail, NaN quarantine identical (benchmarks/arc_tail_ab.py exits
+# nonzero on a numerics-mismatch verdict)
+if ! timeout -k 10 1800 python benchmarks/arc_tail_ab.py --b 256 --iters 5 \
+  2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -2; then
+  echo "arc tail A/B FAILED"
+  exit 1
+fi
+
 echo "== f32 numerics budget on chip =="
 # hardware tier of the f32 drift suite: chip-f32 vs host-f64 oracle
 # with degenerate-profile awareness (a weak-scattering epoch whose two

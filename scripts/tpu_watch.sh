@@ -22,10 +22,15 @@ for ((i = 1; i <= MAX_TRIES; i++)); do
   ts=$(date -u +%Y%m%dT%H%M%SZ)
   # a wedged claim ignores SIGTERM: escalate to SIGKILL after 5 s
   # match the success marker anywhere in the output (NOT tail -1: an
-  # unfiltered trailing teardown line must not mask a healthy probe)
+  # unfiltered trailing teardown line must not mask a healthy probe).
+  # The marker embeds the backend platform: a silent CPU fallback must
+  # NOT trigger the one-shot capture on the wrong device.
   out=$(timeout -k 5 180 python -u -c "
 import numpy as np, jax, jax.numpy as jnp
-print('tpu alive:', float(np.asarray(jnp.sum(jnp.ones((64,64))))))
+s = float(np.asarray(jnp.sum(jnp.ones((64,64)))))
+print('probe platform=%s sum=%s' % (jax.devices()[0].platform, s))
+if jax.devices()[0].platform in ('tpu', 'axon') and s == 4096.0:
+    print('tpu alive')
 " 2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -3)
   echo "[$ts] probe $i/$MAX_TRIES: ${out##*$'\n'}"
   if [[ "$out" == *"tpu alive"* ]]; then

@@ -1091,3 +1091,113 @@ def test_get_scint_params_unknown_method_raises(sim_dynspec):
     ds.calc_acf()
     with pytest.raises(ValueError, match="unknown method"):
         ds.get_scint_params(method="nope")
+
+
+# ---------------------------------------------------------------------------
+# arc_tail="fast": masked-reduction measurement tail (opt-in speed knob)
+# ---------------------------------------------------------------------------
+
+
+def test_arc_tail_fast_matches_exact_within_etaerr():
+    """The fast tail runs the same smooth/peak/walk/parabola stages as
+    the exact (reference-semantics) tail, on the masked full grid —
+    the contract is eta agreement within the fit's OWN etaerr on
+    healthy arcs, not bit equality."""
+    import jax.numpy as jnp
+
+    secs = [_arc_secspec(eta=e, rng=np.random.default_rng(10 + i))
+            for i, e in enumerate([0.3, 0.5, 0.8, 1.2])]
+    kw = dict(fdop=secs[0].fdop, yaxis=secs[0].beta, tdel=secs[0].tdel,
+              freq=1400.0, numsteps=1024)
+    batch = jnp.stack([jnp.asarray(s.sspec) for s in secs])
+    exact = make_arc_fitter(arc_tail="exact", **kw)(batch)
+    fast = make_arc_fitter(arc_tail="fast", **kw)(batch)
+    e_ex = np.asarray(exact.eta)
+    e_fa = np.asarray(fast.eta)
+    err = np.maximum(np.asarray(exact.etaerr), np.asarray(fast.etaerr))
+    assert np.all(np.isfinite(e_fa)), e_fa
+    assert np.all(np.abs(e_fa - e_ex) <= err), (e_fa, e_ex, err)
+    # both recover the planted curvatures
+    np.testing.assert_allclose(e_fa, [0.3, 0.5, 0.8, 1.2], rtol=0.15)
+    assert np.all(np.asarray(fast.etaerr) > 0)
+
+
+def test_arc_tail_fast_gridmax():
+    import jax.numpy as jnp
+
+    sec = _arc_secspec(eta=0.5)
+    kw = dict(fdop=np.asarray(sec.fdop), yaxis=np.asarray(sec.beta),
+              tdel=np.asarray(sec.tdel), freq=1400.0, numsteps=500,
+              method="gridmax")
+    batch = jnp.asarray(sec.sspec)[None]
+    exact = make_arc_fitter(arc_tail="exact", **kw)(batch)
+    fast = make_arc_fitter(arc_tail="fast", **kw)(batch)
+    e_ex = float(np.asarray(exact.eta)[0])
+    e_fa = float(np.asarray(fast.eta)[0])
+    err = max(float(np.asarray(exact.etaerr)[0]),
+              float(np.asarray(fast.etaerr)[0]))
+    assert np.isfinite(e_fa)
+    assert abs(e_fa - e_ex) <= err, (e_fa, e_ex, err)
+    assert e_fa == pytest.approx(0.5, rel=0.2)
+
+
+def test_arc_tail_fast_degenerate_lanes_nan():
+    """Degenerate epochs NaN out under the fast tail exactly like the
+    exact tail (the batch driver's quarantine contract): a flat
+    (constant-power) spectrum and an all-NaN spectrum."""
+    import jax.numpy as jnp
+
+    sec = _arc_secspec(eta=0.5)
+    kw = dict(fdop=sec.fdop, yaxis=sec.beta, tdel=sec.tdel,
+              freq=1400.0, numsteps=1024)
+    flat = np.zeros_like(np.asarray(sec.sspec))
+    allnan = np.full_like(flat, np.nan)
+    batch = jnp.stack([jnp.asarray(sec.sspec), jnp.asarray(flat),
+                       jnp.asarray(allnan)])
+    for tail in ("exact", "fast"):
+        fit = make_arc_fitter(arc_tail=tail, **kw)(batch)
+        eta = np.asarray(fit.eta)
+        assert np.isfinite(eta[0]), (tail, eta)
+        assert np.isnan(eta[1]) and np.isnan(eta[2]), (tail, eta)
+        assert np.isnan(np.asarray(fit.etaerr)[1:]).all(), tail
+
+
+def test_arc_tail_fast_stacked_and_constraints():
+    """The fast tail rides the same late-bound closure as the exact
+    one: the campaign stack and multi-window (constraints) modes route
+    through it unchanged."""
+    import jax.numpy as jnp
+
+    eta_true = 0.6
+    secs = [_arc_secspec(eta=eta_true, rng=np.random.default_rng(200 + i))
+            for i in range(4)]
+    kw = dict(fdop=secs[0].fdop, yaxis=secs[0].beta, tdel=secs[0].tdel,
+              freq=1400.0, numsteps=1024)
+    batch = jnp.stack([jnp.asarray(s.sspec) for s in secs])
+    fitter = make_arc_fitter(arc_tail="fast", **kw)
+    stacked = fitter.stacked(batch)
+    assert float(stacked.eta) == pytest.approx(eta_true, rel=0.15)
+    multi = make_arc_fitter(arc_tail="fast",
+                            constraints=((0.3, 1.2), (0.05, 0.3)),
+                            **kw)(batch)
+    assert np.asarray(multi.eta).shape == (4, 2)
+    np.testing.assert_allclose(np.asarray(multi.eta)[:, 0], eta_true,
+                               rtol=0.15)
+
+
+def test_arc_tail_validation():
+    from scintools_tpu.parallel import PipelineConfig, make_pipeline
+
+    sec = _arc_secspec()
+    with pytest.raises(ValueError, match="arc_tail"):
+        make_arc_fitter(fdop=sec.fdop, yaxis=sec.beta, tdel=sec.tdel,
+                        freq=1400.0, arc_tail="bogus")
+    freqs = np.linspace(1400.0, 1440.0, 32)
+    times = np.arange(32) * 8.0
+    with pytest.raises(ValueError, match="arc_tail"):
+        make_pipeline(freqs, times, PipelineConfig(arc_tail="bogus"))
+    with pytest.raises(ValueError, match="arc_tail"):
+        make_pipeline(freqs, times,
+                      PipelineConfig(arc_method="thetatheta",
+                                     arc_tail="fast",
+                                     arc_constraint=(0.1, 2.0)))
