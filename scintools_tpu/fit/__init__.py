@@ -10,6 +10,7 @@ from .lm import (LsqResult, least_squares_numpy, lm_fit_batched,  # noqa: F401
                  lm_fit_jax)
 from .mcmc import (ensemble_sample, fit_arc_curvature_mcmc,  # noqa: F401
                    fit_scint_params_2d_mcmc, fit_scint_params_mcmc,
+                   fit_scint_params_mcmc_batch,
                    fit_scint_params_sspec_mcmc)
 from .scint_fit import (acf_cuts, fit_scint_params,  # noqa: F401
                         fit_scint_params_2d, fit_scint_params_2d_batch,

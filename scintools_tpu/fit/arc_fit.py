@@ -749,7 +749,7 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         # quadratic coefficient exposed — its sign IS the
         # forward-parabola check here (no windowed-gradient emulation)
         if use_log:
-            a_c, eta, etaerr_fit = fit_log_parabola_vertex(
+            a_c, _, eta, etaerr_fit = fit_log_parabola_vertex(
                 ea, avg_z, w=w, xp=jnp)
         else:
             a_c, _, eta, etaerr_fit = fit_parabola_vertex(

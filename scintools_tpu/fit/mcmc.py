@@ -434,3 +434,111 @@ def fit_scint_params_mcmc(acf2d, dt, df, nchan: int, nsub: int,
     if return_chain:
         return out, np.asarray(chain[burn:])
     return out
+
+
+def fit_scint_params_mcmc_batch(acf2d_batch, dt, df, nchan: int, nsub: int,
+                                alpha: float | None = 5 / 3,
+                                nwalkers: int = 32, steps: int = 600,
+                                burn: int = 300, seed: int = 0,
+                                lm_steps: int = 20, mesh=None,
+                                return_chain: bool = False):
+    """Batched posterior tau/dnu/amp/wn over B epochs in ONE device
+    program: the stretch-move sampler of :func:`fit_scint_params_mcmc`
+    vmapped over the epoch axis (the module docstring's "itself
+    vmappable over epochs", made API), started from the vmapped
+    fixed-iteration LM fit — the SPMD analogue of looping the
+    reference's ``get_scint_params(mcmc=True)`` (dynspec.py:989-992)
+    over files one at a time.
+
+    ``mesh`` shards the epoch axis over the mesh's ``data`` axis:
+    walker updates are per-epoch element-wise work plus per-epoch lag
+    reductions, so the program is embarrassingly parallel and the
+    input sharding alone distributes it (no collectives).  Degenerate
+    (NaN-LM) lanes propagate NaN posteriors — the batch driver's
+    quarantine convention.
+
+    Returns :class:`ScintParams` of [B] posterior medians/stds (and
+    the post-burn chain [B, steps-burn, nwalkers, ndim] when
+    ``return_chain``); ``redchi`` carries the LM fits' values.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.acf_models import scint_acf_model
+    from .scint_fit import acf_cuts, fit_scint_params_batch
+
+    if burn >= steps:
+        raise ValueError(f"burn ({burn}) must be < steps ({steps})")
+    acf_np = np.asarray(acf2d_batch, dtype=np.float64)
+    B = acf_np.shape[0]
+    free = alpha is None
+
+    lm = fit_scint_params_batch(acf2d_batch, dt, df, nchan, nsub,
+                                alpha=alpha, steps=lm_steps)
+    cols = [np.asarray(lm.tau, dtype=np.float64),
+            np.asarray(lm.dnu, dtype=np.float64),
+            np.asarray(lm.amp, dtype=np.float64),
+            np.asarray(lm.wn, dtype=np.float64)]
+    alpha_best = (np.asarray(lm.talpha, dtype=np.float64) if free
+                  else np.full(B, float(alpha)))
+    if free:
+        cols.append(alpha_best)
+    p_best = np.stack(cols, axis=1)                       # [B, ndim]
+    ndim = p_best.shape[1]
+
+    x_t, y_t, x_f, y_f = acf_cuts(acf_np, dt, df, nchan, nsub, xp=np)
+    y = np.concatenate([y_t, y_f], axis=-1)               # [B, L]
+    # per-epoch noise scale from the LM best fit's residual (the same
+    # convention as the single-epoch path); cheap host loop — the model
+    # evaluation is [L]-sized
+    sigma = np.empty(B)
+    for b in range(B):
+        m = scint_acf_model(x_t, x_f, *p_best[b, :4], alpha_best[b],
+                            xp=np)
+        sigma[b] = max(float(np.std(y[b] - m)), 1e-12)
+
+    rng = np.random.default_rng(seed)
+    p0 = p_best[:, None, :] * (
+        1.0 + 0.01 * rng.standard_normal((B, nwalkers, ndim)))
+    p0 = np.abs(p0) + 1e-12
+
+    run = _scint_sampler_cached(len(x_t), len(x_f),
+                                None if free else float(alpha),
+                                int(nwalkers), int(steps))
+    vrun = jax.vmap(run, in_axes=(0, 0, None, None, 0, 0))
+    keys = jax.random.split(jax.random.PRNGKey(seed), B)
+    args = [keys, jnp.asarray(p0), jnp.asarray(x_t), jnp.asarray(x_f),
+            jnp.asarray(y), jnp.asarray(sigma)]
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import DATA_AXIS
+
+        shard = NamedSharding(mesh, P(DATA_AXIS))
+        for i in (0, 1, 4, 5):  # epoch-axis leaves; lag axes replicate
+            args[i] = jax.device_put(args[i], shard)
+    chain, lps = vrun(*args)
+    chain = np.asarray(chain)                  # [B, steps, nw, ndim]
+    post = chain[:, burn:].reshape(B, -1, ndim)
+    med = np.median(post, axis=1)
+    std = np.std(post, axis=1)
+    # quarantine: a lane whose LM start was degenerate (NaN params —
+    # the batched LM clamps tau/dnu to a positivity floor but NaNs
+    # amp/wn on dead epochs) never leaves -inf log-prob, so its
+    # "posterior" is just the jittered start.  NaN-poison it like
+    # every other batched fitter instead of reporting the clamp floor.
+    lp_post = np.asarray(lps)[:, burn:]
+    dead = (~np.all(np.isfinite(p_best), axis=1)
+            | ~np.isfinite(sigma)
+            | ~np.any(np.isfinite(lp_post).reshape(B, -1), axis=1))
+    med[dead] = np.nan
+    std[dead] = np.nan
+    out = ScintParams(
+        tau=med[:, 0], tauerr=std[:, 0], dnu=med[:, 1], dnuerr=std[:, 1],
+        amp=med[:, 2], wn=med[:, 3],
+        talpha=med[:, 4] if free else np.full(B, float(alpha)),
+        talphaerr=std[:, 4] if free else None,
+        redchi=np.asarray(lm.redchi))
+    if return_chain:
+        return out, chain[:, burn:]
+    return out

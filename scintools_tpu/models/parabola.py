@@ -70,16 +70,22 @@ def fit_parabola_vertex(x, y, w=None, xp=np):
 
 def fit_log_parabola_vertex(x, y, w=None, xp=np):
     """``fit_log_parabola``'s vertex with the quadratic coefficient
-    exposed: ``(a, peak, peak_error)`` after the reference's double
-    pre-scaling and exp conversion (scint_models.py:245-263)."""
+    exposed: ``(a, yfit, peak, peak_error)`` after the reference's
+    double pre-scaling and exp conversion (scint_models.py:245-263).
+
+    Mirrors the reference's double pre-scaling: it hands the vertex fit
+    ``logx*(1000/ptp)`` (rescaled again internally), so the fitted peak
+    is in those scaled units and converts back via
+    ``exp(peak*ptp/1000)`` (scint_models.py:253-259).
+    """
     logx = xp.log(x)
     ptp = ((xp.max(logx) - xp.min(logx)) if w is None
            else masked_ptp(logx, w, xp))
     xs = logx * (1000.0 / ptp)
-    a, _, peak, peak_error = fit_parabola_vertex(xs, y, w=w, xp=xp)
+    a, yfit, peak, peak_error = fit_parabola_vertex(xs, y, w=w, xp=xp)
     frac_error = peak_error / peak
     peak = xp.exp(peak * ptp / 1000.0)
-    return a, peak, frac_error * peak
+    return a, yfit, peak, frac_error * peak
 
 
 def fit_parabola(x, y, w=None, xp=np):
@@ -91,19 +97,8 @@ def fit_parabola(x, y, w=None, xp=np):
 
 def fit_log_parabola(x, y, w=None, xp=np):
     """Parabola in log(x); peak exponentiated, fractional error
-    (scint_models.py:245-263).
-
-    Mirrors the reference's double pre-scaling: it hands fit_parabola
-    ``logx*(1000/ptp)`` (which fit_parabola rescales again internally), so
-    the returned peak is in those scaled units and converts back via
-    ``exp(peak*ptp/1000)`` (scint_models.py:253-259).
-    """
-    logx = xp.log(x)
-    ptp = ((xp.max(logx) - xp.min(logx)) if w is None
-           else masked_ptp(logx, w, xp))
-    xs = logx * (1000.0 / ptp)
-    yfit, peak, peak_error = fit_parabola(xs, y, w=w, xp=xp)
-    frac_error = peak_error / peak
-    peak = xp.exp(peak * ptp / 1000.0)
-    peak_error = frac_error * peak
+    (scint_models.py:245-263).  Delegates to
+    :func:`fit_log_parabola_vertex` so the double pre-scaling exists
+    exactly once."""
+    _, yfit, peak, peak_error = fit_log_parabola_vertex(x, y, w=w, xp=xp)
     return yfit, peak, peak_error

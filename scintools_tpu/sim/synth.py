@@ -62,3 +62,19 @@ def thin_arc_epoch(nf: int = 64, nt: int = 64, seed: int = 0,
     dyn = np.abs(E) ** 2 * (1 + noise * rng.standard_normal((nf, nt)))
     return DynspecData(dyn=dyn, freqs=freqs, times=times,
                        name=f"synth{seed}", mjd=53000.0 + seed)
+
+
+def thin_arc_betaeta(freqs, arc_frac: float = 0.5, df: float = 0.5,
+                     dt: float = 10.0, ref_freq: float = 1400.0,
+                     **_ignored) -> float:
+    """:func:`thin_arc_eta` converted to the lamsteps fitter's beta-eta
+    units at this epoch's mean frequency — the closed-form ground truth
+    a lamsteps arc fit on :func:`thin_arc_epoch` should recover.
+    Inverse of the unit conversion ``fit_arc`` applies to non-lamsteps
+    constraints (fit/arc_fit.py; reference dynspec.py:470-491)."""
+    from ..fit.arc_fit import _beta_to_eta_factor
+
+    f = float(np.mean(np.asarray(freqs)))
+    b2e = _beta_to_eta_factor(f, ref_freq)
+    return (thin_arc_eta(arc_frac=arc_frac, df=df, dt=dt)
+            / b2e * (f / ref_freq) ** 2)

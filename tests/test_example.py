@@ -19,13 +19,22 @@ def test_arc_modelling_walkthrough(tmp_path):
     single, summed = (results["betaeta_single"],
                       results["betaeta_summed"])
     assert abs(summed - single) / single < 0.3
-    # the eigen-concentration estimator lands within the same order of
-    # magnitude (this epoch's diffuse arc makes the two methods measure
-    # genuinely different curvature statistics; see the example comment).
-    # The window is the sweep bracket itself — a backend-dependent peak
-    # anywhere in the sweep passes; only a broken sweep could fail
+    # diffuse epoch: the two estimators measure genuinely different
+    # curvature statistics (power-weighted mean vs sharpest
+    # substructure) — same order of magnitude only
     ratio = results["betaeta_thetatheta"] / single
     assert 1 / 5 <= ratio <= 5.0
+    # planted-truth accuracy gate (round-5: the real bound a
+    # subtly-wrong estimator fails — the thin-arc epoch's curvature is
+    # known in closed form, sim.synth.thin_arc_betaeta).  theta-theta
+    # measured within 1.3-4.5% of truth across seeds; 10% has 2x
+    # headroom.  norm_sspec carries the documented power-weighted
+    # envelope bias on this epoch type (10-45% high), bounded at 50%.
+    truth = results["betaeta_planted_truth"]
+    assert abs(results["betaeta_planted_tt"] - truth) / truth < 0.10, \
+        (results["betaeta_planted_tt"], truth)
+    assert abs(results["betaeta_planted_ns"] - truth) / truth < 0.50, \
+        (results["betaeta_planted_ns"], truth)
     assert results["tau"] > 0 and results["dnu"] > 0
     lo, hi = results["eta_annual_minmax"]
     assert 0 < lo < hi
