@@ -343,10 +343,17 @@ def device_preprobe(timeout_s: int) -> dict:
         return {"ok": False, "error": f"probe {type(e).__name__}: {e}"}
 
 
-def device_throughput(dyn, freqs, times, chunk: int) -> dict:
+def device_throughput(dyn, freqs, times, chunk: int,
+                      repeats: int = 1) -> dict:
     """Batched jit pipeline on the attached accelerator (one chip here;
     the same step shards over a mesh unchanged).  Returns a dict with
-    dynspec/s plus compile and measure wall time, separately."""
+    dynspec/s plus compile and measure wall time, separately.
+
+    ``repeats > 1`` re-times the measured pass that many times and
+    reports the MEDIAN rate plus the per-repeat rates — the
+    CPU-fallback path uses 3 so a single contention spike on a shared
+    host can't own the round's record (round-4 lesson: the r03/r04
+    fallback headlines were single-shot and incomparable)."""
     _enable_compile_cache()
     import jax
 
@@ -381,17 +388,26 @@ def device_throughput(dyn, freqs, times, chunk: int) -> dict:
     sync([step(dyn_d[:chunk])])
     compile_s = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    outs = []
-    for i in range(0, B, chunk):
-        part = dyn_d[i:i + chunk]
-        if part.shape[0] != chunk:  # keep one compiled shape
-            part = dyn_d[B - chunk:B]
-        outs.append(step(part))  # async dispatch; fits stay on device
-    sync(outs)
-    measure_s = time.perf_counter() - t0
-    return {"rate": B / measure_s, "compile_s": round(compile_s, 2),
-            "measure_s": round(measure_s, 3)}
+    rates = []
+    for _ in range(max(int(repeats), 1)):
+        t0 = time.perf_counter()
+        outs = []
+        for i in range(0, B, chunk):
+            part = dyn_d[i:i + chunk]
+            if part.shape[0] != chunk:  # keep one compiled shape
+                part = dyn_d[B - chunk:B]
+            outs.append(step(part))  # async dispatch; fits on device
+        sync(outs)
+        rates.append(B / (time.perf_counter() - t0))
+    rate = float(np.median(rates))
+    # measure_s is derived from the SAME median pass the rate reports,
+    # so the two fields always describe one measurement (round-over-
+    # round measure_s comparisons must not be spike-owned)
+    rec = {"rate": rate, "compile_s": round(compile_s, 2),
+           "measure_s": round(B / rate, 3)}
+    if len(rates) > 1:
+        rec["repeat_rates"] = [round(r, 2) for r in rates]
+    return rec
 
 
 def main():
@@ -422,6 +438,8 @@ def main():
             "baseline": baseline,
             "probe": probe,
         }
+        if res.get("repeat_rates"):
+            rec["repeat_rates"] = res["repeat_rates"]
         # MFU/roofline accounting against the probed chip's published
         # peaks (device kind comes from the probe subprocess, so a wedged
         # main-process backend is never touched here)
@@ -555,10 +573,21 @@ def main():
             f"dyn, freqs, times = bench.make_epochs({nf}, {nt}, "
             f"B={fb_b})\n"
             f"res = bench.device_throughput(dyn, freqs, times, "
-            f"chunk={fb_b})\n"
+            f"chunk={fb_b}, repeats=3)\n"
             "print(json.dumps(res))\n")
         env = _cache_env()
         env["PYTHONPATH"] = _HERE + os.pathsep + env.get("PYTHONPATH", "")
+        # pin BLAS/threadpool counts in the fresh subprocess so the
+        # fallback rate is comparable round-over-round even when driver
+        # hosts differ in core count or ambient load (no-op on a 1-core
+        # host; the env only binds at library load, hence subprocess).
+        # Force-set, NOT setdefault: an ambient OMP_NUM_THREADS from an
+        # unrelated CI setup must not silently defeat the pin.
+        n_thr = str(_env_int("SCINT_BENCH_CPU_THREADS",
+                             min(os.cpu_count() or 1, 8)))
+        for k in ("OMP_NUM_THREADS", "OPENBLAS_NUM_THREADS",
+                  "MKL_NUM_THREADS", "NUMEXPR_NUM_THREADS"):
+            env[k] = n_thr
         proc = subprocess.run(
             [sys.executable, "-c", code], capture_output=True, text=True,
             timeout=_env_int("SCINT_BENCH_FALLBACK_TIMEOUT", 900),
@@ -582,12 +611,25 @@ def main():
         os._exit(0)
 
     if fb.get("rate"):
+        try:
+            load1 = round(os.getloadavg()[0], 2)
+        except OSError:  # pragma: no cover
+            load1 = None
         print(json.dumps(device_record(
             fb, probe, is_fallback=True,
             device="cpu-fallback (ACCELERATOR UNREACHABLE: this is "
                    "the batched one-jit program vs the serial "
                    "reference on the same host CPU, not chip "
                    "throughput)",
+            # host fingerprint: r03's 39.4 vs r04's 27.4 were
+            # irreconcilable partly because the records carried no
+            # host/contention context (docs/performance.md round-5
+            # reconciliation)
+            host={"nproc": os.cpu_count(), "load1": load1,
+                  "cpu_threads_pinned": _env_int(
+                      "SCINT_BENCH_CPU_THREADS",
+                      min(os.cpu_count() or 1, 8)),
+                  "fallback_B": _env_int("SCINT_BENCH_FALLBACK_B", 64)},
             error=err)), flush=True)
         os._exit(1)
 

@@ -1,0 +1,19 @@
+# Sphinx configuration (the reference ships a Sphinx skeleton + built
+# HTML: /root/reference/docs/source/index.rst, docs/build/).  This
+# config builds the same markdown sources via MyST where sphinx is
+# available: `sphinx-build -b html docs docs/build/sphinx`.
+#
+# The pinned CI/bench environment has NO sphinx (and installs are not
+# allowed there) — `python scripts/build_docs.py` is the
+# zero-dependency route that produces docs/build/html from the same
+# sources, and tests/test_docs_build.py keeps it building.
+
+project = "scintools-tpu"
+author = "scintools-tpu developers"
+
+extensions = ["myst_parser"]
+source_suffix = {".rst": "restructuredtext", ".md": "markdown"}
+exclude_patterns = ["build", "_build"]
+
+html_theme = "alabaster"
+myst_heading_anchors = 3

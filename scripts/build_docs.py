@@ -1,0 +1,232 @@
+"""Build the static HTML documentation site from the markdown docs.
+
+The reference ships a Sphinx skeleton plus built HTML
+(/root/reference/docs/source/index.rst, docs/build/).  This repo's docs
+are markdown (docs/*.md + README.md + PARITY.md); two build routes:
+
+  - ``docs/conf.py`` + ``docs/index.rst``: a standard Sphinx+MyST
+    skeleton for environments that have sphinx installed.
+  - this script: a ZERO-DEPENDENCY builder (stdlib only — the pinned
+    environment ships no sphinx/mkdocs and installs are not allowed)
+    covering the subset of markdown the docs actually use: ATX
+    headings, fenced code, tables, nested lists, blockquotes, links,
+    emphasis, inline code.
+
+Usage: python scripts/build_docs.py [outdir]   (default docs/build/html)
+Exit status is nonzero if any page fails to convert.
+"""
+
+from __future__ import annotations
+
+import html
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (source path relative to repo, output stem, nav title)
+PAGES = [
+    ("README.md", "index", "Overview & quickstart"),
+    ("docs/overview.md", "overview", "Architecture overview"),
+    ("docs/api.md", "api", "API reference"),
+    ("docs/performance.md", "performance", "Performance & roofline"),
+    ("docs/migrating.md", "migrating", "Migrating from scintools"),
+    ("docs/wavefield.md", "wavefield", "Wavefield holography"),
+    ("docs/roadmap.md", "roadmap", "Roadmap / build log"),
+    ("PARITY.md", "parity", "Reference parity contract"),
+    ("BASELINE.md", "baseline", "Benchmark baselines"),
+]
+
+_STYLE = """
+body { margin: 0; font: 15px/1.55 system-ui, sans-serif; color: #1a202c; }
+.wrap { display: flex; min-height: 100vh; }
+nav { width: 230px; flex-shrink: 0; background: #f7f8fa;
+      border-right: 1px solid #e2e8f0; padding: 1.2em 1em; }
+nav h1 { font-size: 1.0em; margin: 0 0 .8em; }
+nav a { display: block; color: #2b6cb0; text-decoration: none;
+        padding: .18em 0; font-size: .95em; }
+nav a.current { font-weight: 600; color: #1a202c; }
+main { flex: 1; max-width: 54em; padding: 1.5em 2.5em 4em; }
+pre { background: #f6f8fa; border: 1px solid #e2e8f0; border-radius: 6px;
+      padding: .8em 1em; overflow-x: auto; font-size: .88em; }
+code { background: #f0f2f5; border-radius: 3px; padding: .08em .3em;
+       font-size: .92em; }
+pre code { background: none; padding: 0; }
+table { border-collapse: collapse; margin: 1em 0; font-size: .93em; }
+th, td { border: 1px solid #cbd5e0; padding: .35em .7em; text-align: left; }
+th { background: #f7f8fa; }
+blockquote { border-left: 3px solid #cbd5e0; margin: 1em 0;
+             padding: .1em 1em; color: #4a5568; }
+h1, h2, h3 { line-height: 1.25; }
+h2 { border-bottom: 1px solid #e2e8f0; padding-bottom: .25em; }
+"""
+
+
+def _inline(s: str) -> str:
+    """Inline markdown -> HTML on an ALREADY-ESCAPED string."""
+    # protect inline code spans first so emphasis rules can't touch them
+    spans: list[str] = []
+    s = re.sub(r"``(.+?)``|`([^`]+)`",
+               lambda m: _stash_wrap(m, spans), s)
+    s = re.sub(r"\[([^\]]+)\]\(([^)\s]+)\)", _link, s)
+    s = re.sub(r"\*\*([^*]+)\*\*", r"<strong>\1</strong>", s)
+    s = re.sub(r"(?<![\w*])\*([^*\s][^*]*?)\*(?![\w*])", r"<em>\1</em>", s)
+    s = re.sub(r"\x00(\d+)\x00", lambda m: spans[int(m.group(1))], s)
+    return s
+
+
+def _stash_wrap(m, spans):
+    code = m.group(1) if m.group(1) is not None else m.group(2)
+    spans.append(f"<code>{code}</code>")
+    return f"\x00{len(spans) - 1}\x00"
+
+
+def _link(m):
+    text, url = m.group(1), m.group(2)
+    # internal .md links become .html siblings (sections dropped)
+    base = url.split("#")[0]
+    for src, stem, _ in PAGES:
+        if base and os.path.basename(src) == os.path.basename(base):
+            url = stem + ".html"
+            break
+    return f'<a href="{url}">{text}</a>'
+
+
+def md_to_html(text: str) -> str:
+    out: list[str] = []
+    lines = text.splitlines()
+    i = 0
+    in_code = False
+    para: list[str] = []
+    lists: list[str] = []          # stack of open list tags
+    table: list[str] = []
+
+    def flush_para():
+        if para:
+            out.append("<p>" + _inline(" ".join(para)) + "</p>")
+            para.clear()
+
+    def close_lists(depth=0):
+        while len(lists) > depth:
+            out.append(f"</{lists.pop()}>")
+
+    def flush_table():
+        if not table:
+            return
+        rows = [r for r in table if not re.fullmatch(
+            r"\|?[\s:|-]+\|?", r)]
+        out.append("<table>")
+        for k, row in enumerate(rows):
+            cells = [c.strip() for c in row.strip().strip("|").split("|")]
+            tag = "th" if k == 0 else "td"
+            out.append("<tr>" + "".join(
+                f"<{tag}>{_inline(c)}</{tag}>" for c in cells) + "</tr>")
+        out.append("</table>")
+        table.clear()
+
+    while i < len(lines):
+        raw = lines[i]
+        line = html.escape(raw, quote=False)
+        if raw.lstrip().startswith("```"):
+            flush_para(); flush_table()
+            if not in_code:
+                close_lists()
+                out.append("<pre><code>")
+            else:
+                out.append("</code></pre>")
+            in_code = not in_code
+            i += 1
+            continue
+        if in_code:
+            out.append(line)
+            i += 1
+            continue
+        if re.fullmatch(r"\s*", raw):
+            flush_para(); flush_table(); close_lists()
+            i += 1
+            continue
+        m = re.match(r"(#{1,5})\s+(.*)", raw)
+        if m:
+            flush_para(); flush_table(); close_lists()
+            n = len(m.group(1))
+            out.append(f"<h{n}>{_inline(html.escape(m.group(2)))}</h{n}>")
+            i += 1
+            continue
+        if re.fullmatch(r"\s*(-{3,}|\*{3,})\s*", raw):
+            flush_para(); flush_table(); close_lists()
+            out.append("<hr/>")
+            i += 1
+            continue
+        if raw.lstrip().startswith("|"):
+            flush_para(); close_lists()
+            table.append(line)
+            i += 1
+            continue
+        m = re.match(r"(\s*)([-*]|\d+\.)\s+(.*)", raw)
+        if m:
+            flush_para(); flush_table()
+            depth = len(m.group(1)) // 2 + 1
+            tag = "ol" if m.group(2)[0].isdigit() else "ul"
+            while len(lists) > depth:
+                out.append(f"</{lists.pop()}>")
+            while len(lists) < depth:
+                lists.append(tag)
+                out.append(f"<{tag}>")
+            out.append("<li>" + _inline(html.escape(m.group(3),
+                                                    quote=False)) + "</li>")
+            i += 1
+            continue
+        if raw.lstrip().startswith(">"):
+            flush_para(); flush_table(); close_lists()
+            quote = []
+            while i < len(lines) and lines[i].lstrip().startswith(">"):
+                quote.append(html.escape(
+                    lines[i].lstrip()[1:].strip(), quote=False))
+                i += 1
+            out.append("<blockquote><p>" + _inline(" ".join(quote))
+                       + "</p></blockquote>")
+            continue
+        if lists:
+            # lazy continuation of the previous list item
+            out[-1] = out[-1][:-5] + " " + _inline(line.strip()) + "</li>"
+            i += 1
+            continue
+        para.append(line.strip())
+        i += 1
+    flush_para(); flush_table(); close_lists()
+    if in_code:
+        raise ValueError("unterminated code fence")
+    return "\n".join(out)
+
+
+def build(outdir: str) -> list[str]:
+    os.makedirs(outdir, exist_ok=True)
+    written = []
+    for src, stem, title in PAGES:
+        path = os.path.join(REPO, src)
+        if not os.path.exists(path):
+            raise FileNotFoundError(f"doc source missing: {src}")
+        with open(path, encoding="utf-8") as fh:
+            body = md_to_html(fh.read())
+        nav = "\n".join(
+            f'<a href="{s}.html"{" class=current" if s == stem else ""}>'
+            f"{t}</a>" for _, s, t in PAGES)
+        page = (
+            "<!DOCTYPE html><html><head><meta charset='utf-8'/>"
+            f"<title>scintools-tpu — {title}</title>"
+            f"<style>{_STYLE}</style></head><body><div class='wrap'>"
+            f"<nav><h1>scintools-tpu</h1>{nav}</nav>"
+            f"<main>{body}</main></div></body></html>")
+        dest = os.path.join(outdir, stem + ".html")
+        with open(dest, "w", encoding="utf-8") as fh:
+            fh.write(page)
+        written.append(dest)
+    return written
+
+
+if __name__ == "__main__":
+    out = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        REPO, "docs", "build", "html")
+    pages = build(out)
+    print(f"built {len(pages)} pages -> {out}")

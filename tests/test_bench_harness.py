@@ -223,6 +223,13 @@ def test_bench_wedged_probe_takes_fallback_path(tmp_path):
     assert roof["peaks"]["source"].startswith("measured on this host"), roof
     assert roof["roofline_bound"] in ("compute", "bandwidth")
     assert 0 < roof["roofline_pct"] <= 120  # sane fraction of ceiling
+    # round-5 stabilisation: the fallback rate is the MEDIAN of 3 timed
+    # passes and the record carries a host fingerprint, so cross-round
+    # disagreements are diagnosable from the records alone
+    assert len(last["repeat_rates"]) == 3, last.get("repeat_rates")
+    assert last["host"]["nproc"] == os.cpu_count()
+    assert last["host"]["fallback_B"] == 4
+    assert last["host"]["cpu_threads_pinned"] >= 1
 
 
 def test_pallas_ab_harness_runs_tiny(capsys):

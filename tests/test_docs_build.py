@@ -1,0 +1,76 @@
+"""The documentation site builds from the shipped markdown sources
+(SURVEY.md: the reference ships built Sphinx HTML; the pinned
+environment has no sphinx, so scripts/build_docs.py is the
+zero-dependency builder and this test is its gate)."""
+
+import os
+import re
+import runpy
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+
+@pytest.fixture(scope="module")
+def built(tmp_path_factory):
+    import build_docs
+
+    out = str(tmp_path_factory.mktemp("docs_html"))
+    return build_docs, build_docs.build(out)
+
+
+def test_all_pages_build_nonempty(built):
+    build_docs, pages = built
+    assert len(pages) == len(build_docs.PAGES)
+    for p in pages:
+        assert os.path.getsize(p) > 1000, p
+
+
+def test_index_carries_nav_and_quickstart(built):
+    _, pages = built
+    index = [p for p in pages if p.endswith("index.html")][0]
+    h = open(index, encoding="utf-8").read()
+    assert "<nav>" in h and 'href="performance.html"' in h
+    assert "Quickstart" in h
+    # code fences render as escaped blocks, not markup soup
+    assert "<pre><code>" in h
+
+
+def test_tables_and_escaping(built):
+    build_docs, pages = built
+    perf = [p for p in pages if p.endswith("performance.html")][0]
+    h = open(perf, encoding="utf-8").read()
+    assert "<table>" in h and "<th>" in h
+    # no markdown table separators may leak into rendered paragraphs
+    text = re.sub(r"<[^>]+>", "", h)
+    assert "|---" not in text
+    # raw angle brackets in prose/code must be escaped, not swallowed
+    # or emitted as live markup
+    frag = build_docs.md_to_html(
+        "threshold `a < b` and loose x < y prose\n\n```\nif a < b:\n```\n")
+    assert "a &lt; b" in frag and "x &lt; y" in frag, frag
+    assert "if a &lt; b:" in frag, frag
+
+
+def test_internal_md_links_rewritten(built):
+    _, pages = built
+    for p in pages:
+        h = open(p, encoding="utf-8").read()
+        # no intra-site link may still point at a .md file
+        for m in re.finditer(r'href="([^"]+)"', h):
+            url = m.group(1)
+            if url.startswith(("http", "#", "mailto:")):
+                continue
+            assert not url.endswith(".md"), (p, url)
+
+
+def test_cli_entrypoint(tmp_path, capsys, monkeypatch):
+    monkeypatch.setattr(sys, "argv",
+                        ["build_docs.py", str(tmp_path / "out")])
+    runpy.run_path(os.path.join(REPO, "scripts", "build_docs.py"),
+                   run_name="__main__")
+    assert "built" in capsys.readouterr().out
+    assert (tmp_path / "out" / "index.html").exists()
