@@ -62,21 +62,48 @@ def row_scrunch_scan(rows, i0, w, block_r: int = 64):
     i0_b = jnp.pad(i0, ((0, pad), (0, 0))).reshape(nb, block_r, n)
     w_b = jnp.pad(w, ((0, pad), (0, 0))).reshape(nb, block_r, n)
 
-    def body(carry, xs):
-        s, c = carry
+    # Column-reduction strategy (round-5 CPU finding, measured in
+    # docs/performance.md): jnp.sum(axis=0) over a fused masked block
+    # lowers on XLA CPU to a scalarised strided loop ~4.4x slower than
+    # a GEMM, and the gathers themselves are cheap — the old
+    # sum/count accumulation was the CPU fallback's binder.  So each
+    # block stacks FOUR inf-free row groups — the inf-clamped values,
+    # the not-NaN mask, and the -inf/+inf indicators — into one
+    # materialised [4*block_r, n] matrix (the concat is the fusion
+    # barrier that stops XLA folding the mask math back into the
+    # reduction loop) and reduces all four with ONE [4, 4*block_r]
+    # GEMM.  The inf counts reconstruct nanmean's exact semantics
+    # afterwards (-inf poisons its bin, +inf likewise, both -> NaN),
+    # because a 0-weight times an infinity inside the GEMM would be
+    # NaN.  Oracle-tested against np.nanmean over the lerp, including
+    # the inf hazards (tests/test_resample_pallas.py::
+    # test_row_scrunch_scan_inf_nan_oracle).  Precision pinned so the
+    # TPU route cannot silently take a bf16 MXU pass (same guard as
+    # the NUDFT einsum, ops/nudft.py).
+    # block-identity selector: row g sums group g's block_r rows
+    sel = jnp.kron(jnp.eye(4, dtype=rows.dtype),
+                   jnp.ones(block_r, rows.dtype))
+    hi = jax.lax.Precision.HIGHEST
+
+    def body(acc, xs):
         rc, ic, wc = xs
         v0 = jnp.take_along_axis(rc, ic, axis=1)
         v1 = jnp.take_along_axis(rc, ic + 1, axis=1)
         nrm = v0 * (1.0 - wc) + v1 * wc
-        # nanmean semantics exactly: skip NaN only
         keep = ~jnp.isnan(nrm)
-        s = s + jnp.sum(jnp.where(keep, nrm, 0.0), axis=0)
-        c = c + jnp.sum(keep.astype(s.dtype), axis=0)
-        return (s, c), None
+        fin = jnp.isfinite(nrm)
+        st = jnp.concatenate([
+            jnp.where(fin, nrm, 0.0),
+            keep.astype(rows.dtype),
+            (nrm == -jnp.inf).astype(rows.dtype),
+            (nrm == jnp.inf).astype(rows.dtype)], axis=0)
+        return acc + jnp.matmul(sel, st, precision=hi), None
 
-    (s, c), _ = jax.lax.scan(
-        body, (jnp.zeros(n, rows.dtype), jnp.zeros(n, rows.dtype)),
-        (rows_b, i0_b, w_b))
+    acc, _ = jax.lax.scan(body, jnp.zeros((4, n), rows.dtype),
+                          (rows_b, i0_b, w_b))
+    s, c, nneg, npos = acc[0], acc[1], acc[2], acc[3]
+    s = jnp.where(nneg > 0, jnp.where(npos > 0, jnp.nan, -jnp.inf),
+                  jnp.where(npos > 0, jnp.inf, s))
     return jnp.where(c > 0, s / jnp.maximum(c, 1.0), jnp.nan)
 
 

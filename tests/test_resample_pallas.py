@@ -120,3 +120,49 @@ def test_row_scrunch_out_of_range_clamps_to_edge():
                                          interpret=True))
     np.testing.assert_allclose(got2, want2, rtol=1e-6, atol=1e-7,
                                equal_nan=True)
+
+
+def test_row_scrunch_scan_inf_nan_oracle():
+    """The GEMM-reduction scan reproduces np.nanmean's exact inf/NaN
+    semantics over the lerp: -inf poisons its bin, +inf likewise, both
+    present -> NaN, NaN skipped — including 0/1 interpolation weights
+    (the 0 x inf hazard that rules out a zero-weight-selector GEMM)."""
+    import jax
+
+    from scintools_tpu.ops.resample_pallas import row_scrunch_scan
+
+    rng = np.random.default_rng(42)
+    R, C, n = 30, 64, 96
+    for trial in range(6):
+        rows = rng.standard_normal((R, C))
+        # NaN row/column, -inf and +inf pixels, an all-special column
+        rows[3, :] = np.nan
+        rows[:, 11] = np.nan
+        rows[rng.integers(R), rng.integers(C)] = -np.inf
+        rows[rng.integers(R), rng.integers(C)] = np.inf
+        if trial % 2:
+            rows[:, 20] = -np.inf           # whole-bin -inf poisoning
+            rows[5, 20] = np.inf            # ... and a +inf in it -> NaN
+        pos = np.clip(np.sort(rng.uniform(0, C - 1.001, (R, n)), axis=1),
+                      0, C - 2 + 0.999)
+        i0 = np.clip(np.floor(pos).astype(np.int32), 0, C - 2)
+        w = pos - i0
+        w[0, :8] = 0.0                      # exact-0 and exact-1 weights
+        w[1, :8] = 1.0                      # force the 0 x inf products
+        for blk in (7, 16, R):
+            got = np.asarray(row_scrunch_scan(rows, i0, w, block_r=blk))
+            v0 = np.take_along_axis(rows, i0, axis=1)
+            v1 = np.take_along_axis(rows, i0 + 1, axis=1)
+            import warnings
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                want = np.nanmean(v0 * (1 - w) + v1 * w, axis=0)
+            assert np.array_equal(np.isnan(want), np.isnan(got)), \
+                (trial, blk)
+            assert np.array_equal(np.isneginf(want), np.isneginf(got)), \
+                (trial, blk)
+            assert np.array_equal(np.isposinf(want), np.isposinf(got)), \
+                (trial, blk)
+            m = np.isfinite(want)
+            np.testing.assert_allclose(got[m], want[m], rtol=1e-12,
+                                       atol=1e-12)
