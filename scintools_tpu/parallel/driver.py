@@ -93,8 +93,11 @@ class PipelineConfig:
     # row blocks of that size (bounded HBM), "pallas" = fused VMEM kernel
     # (ops/resample_pallas; interpret mode off-TPU), -1 = auto: the
     # Pallas kernel on chip (round-4 A/B: 3.5x the scan at the bench
-    # shape), scan-16 on host CPU (round-3 interleaved repeats: 1.45x
-    # over 64-row blocks — docs/performance.md)
+    # shape); on host CPU the scan with the largest block whose
+    # [B_local, 4*block, numsteps] working set fits the 256 MiB cap
+    # (round 5: the GEMM-reduction scan favours BIG blocks — one-block
+    # 507 ms vs 16-row 615-696 ms at B=64 — so small batches get big
+    # blocks and the bench batch keeps the 16-row floor)
     arc_scrunch_rows: int | str = -1
     # Arc measurement tail: "exact" (default) keeps the reference's
     # compacted-array semantics bit-for-bit (the parity contract —
@@ -319,24 +322,49 @@ def _resolve_cuts(method: str, mesh, batch_shape=None,
 # auto routes for arc_scrunch_rows=-1: on chip the fused Pallas kernel
 # (round-4 A/B at the bench shape: 3.5x the 64-row scan, numerics
 # agreeing to 1e-7; non-conforming Doppler widths demote to scan-64
-# inside the fitter); on host CPU the 16-row scan (round-3 interleaved
-# repeats at B=64, 256x512: rc=16 ~36-38 dynspec/s vs rc=64 ~25.5, a
-# stable 1.45x; rc=8 within noise of 16 — a CPU Pallas route would be
-# interpret-mode and far slower)
+# inside the fitter).  On host CPU the scan with the LARGEST block the
+# working-set cap allows: since the GEMM-reduction body (round 5,
+# ops/resample_pallas.py) bigger blocks win — the round-5 micro-bench
+# at B=64, 256x512 measured one-block 507 ms vs 16-row 615-696 ms —
+# but each block materialises a [B_local, 4*block, numsteps] f32
+# stack, so the block is sized to keep that under the cap (the old
+# round-3 fixed 16 is the floor; that measurement predates the GEMM
+# body and its 16-beats-64 ordering no longer holds).
 _AUTO_ARC_SCRUNCH_TPU = "pallas"
-_AUTO_ARC_SCRUNCH_CPU = 16
+_AUTO_ARC_SCRUNCH_CPU_MIN = 16
+_AUTO_ARC_SCRUNCH_CPU_MAX = 256
+_AUTO_ARC_SCRUNCH_BYTE_CAP = 256 * 1024 * 1024
 
 
-def _resolve_arc_scrunch(config: "PipelineConfig", mesh):
+def _resolve_arc_scrunch(config: "PipelineConfig", mesh,
+                         batch_shape=None, itemsize: int = 4):
     """arc_scrunch_rows=-1 auto rule — the single source of truth shared
     by the step builder and the recorded route metadata.  Resolved at
     TRACE time (like _resolve_cuts), never at build time.  Returns a
-    block-size int or the route string "pallas"."""
+    block-size int or the route string "pallas".
+
+    ``batch_shape`` (the traced [B, nf, nt], when known) sizes the CPU
+    scan block: the largest one whose per-block masked stack
+    [B_local, 4*block, arc_numsteps] (at ``itemsize`` bytes/element)
+    stays under the byte cap, clamped to [16, 256] with a pow2 floor.
+    Unknown shape falls back to the floor."""
     rc = config.arc_scrunch_rows
-    if rc == -1:
-        rc = (_AUTO_ARC_SCRUNCH_TPU if _target_is_tpu(mesh)
-              else _AUTO_ARC_SCRUNCH_CPU)
-    return rc if rc == "pallas" else int(rc)
+    if rc != -1:
+        return rc if rc == "pallas" else int(rc)
+    if _target_is_tpu(mesh):
+        return _AUTO_ARC_SCRUNCH_TPU
+    blk = _AUTO_ARC_SCRUNCH_CPU_MIN
+    if batch_shape is not None:
+        b = int(np.prod(batch_shape[:-2], dtype=np.int64))
+        if mesh is not None:
+            b = -(-b // int(mesh.shape.get(mesh_mod.DATA_AXIS, 1)))
+        per_row = (4 * max(int(config.arc_numsteps), 1)
+                   * int(itemsize) * max(b, 1))
+        fit = int(min(_AUTO_ARC_SCRUNCH_CPU_MAX,
+                      max(_AUTO_ARC_SCRUNCH_CPU_MIN,
+                          _AUTO_ARC_SCRUNCH_BYTE_CAP // per_row)))
+        blk = 1 << (fit.bit_length() - 1)   # pow2 floor: stable shapes
+    return blk
 
 
 def resolve_routes(config: "PipelineConfig", mesh=None,
@@ -352,7 +380,9 @@ def resolve_routes(config: "PipelineConfig", mesh=None,
     """
     return {"scint_cuts": _resolve_cuts(config.scint_cuts, mesh,
                                         batch_shape, itemsize),
-            "arc_scrunch_rows": _resolve_arc_scrunch(config, mesh),
+            "arc_scrunch_rows": _resolve_arc_scrunch(config, mesh,
+                                                     batch_shape,
+                                                     itemsize),
             "target_is_tpu": bool(_target_is_tpu(mesh))}
 
 
@@ -444,10 +474,11 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
     fdop = np.asarray(fdop, dtype=np.float64)
     tdel = np.asarray(tdel, dtype=np.float64)
 
-    def build_arc_fitter():
+    def build_arc_fitter(batch_shape=None, itemsize: int = 4):
         # called at TRACE time (inside the first step call), so the
-        # scrunch auto-default may probe the execution target; building
-        # the pipeline itself stays device-free
+        # scrunch auto-default may probe the execution target AND see
+        # the traced batch shape; building the pipeline itself stays
+        # device-free
         if config.arc_method == "thetatheta":
             from ..fit.thetatheta import make_tt_fitter
 
@@ -489,7 +520,8 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
                         [f.profile_power for f in fits], axis=1))
 
             return multi
-        rc = _resolve_arc_scrunch(config, mesh)
+        rc = _resolve_arc_scrunch(config, mesh, batch_shape,
+                                  itemsize=itemsize)
         return make_arc_fitter(
             fdop=fdop, yaxis=beta if config.lamsteps else tdel, tdel=tdel,
             freq=fc, lamsteps=config.lamsteps, method=config.arc_method,
@@ -555,7 +587,8 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
                              window_frac=config.window_frac, db=True,
                              backend="jax")
             if config.fit_arc:
-                fitter = build_arc_fitter()
+                fitter = build_arc_fitter(tuple(dyn_batch.shape),
+                                          dyn_batch.dtype.itemsize)
                 arc = fitter(sec_b)
                 if config.arc_stack:
                     # campaign stack: NaN pad-lanes/corrupted epochs

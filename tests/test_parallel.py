@@ -210,6 +210,21 @@ def test_resolve_cuts_validation_and_size_gate(monkeypatch):
     monkeypatch.undo()
     assert _resolve_arc_scrunch(PipelineConfig(arc_scrunch_rows=0),
                                 None) == 0
+    # round-5 adaptive CPU block: the GEMM-reduction scan favours the
+    # largest block whose [B_local, 4*block, numsteps] f32 stack fits
+    # the cap — small batches get big blocks, the bench batch keeps
+    # the 16-row floor, and explicit values always win
+    cfgn = PipelineConfig(arc_numsteps=2000)
+    assert _resolve_arc_scrunch(cfgn, None, (64, 256, 512)) == 128
+    assert _resolve_arc_scrunch(cfgn, None, (1024, 256, 512)) == 16
+    assert _resolve_arc_scrunch(cfgn, None, (4, 256, 512)) == 256
+    assert _resolve_arc_scrunch(PipelineConfig(arc_scrunch_rows=32),
+                                None, (4, 256, 512)) == 32
+    # the cap judges the PER-DEVICE batch: an 8-way data mesh divides B
+    from types import SimpleNamespace
+
+    mesh8 = SimpleNamespace(shape={"data": 8})
+    assert _resolve_arc_scrunch(cfgn, mesh8, (1024, 256, 512)) == 64
     # the gate judges the PER-DEVICE working set (batch axis sharded over
     # the data mesh axis) and respects the actual dtype width
     from scintools_tpu.parallel.driver import _gram_bytes
