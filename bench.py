@@ -27,7 +27,9 @@ SCINT_BENCH_NT (epoch shape, default 256x512), SCINT_BENCH_CPU_EPOCHS
 (device chunk, default 1024), SCINT_BENCH_PROBE_TIMEOUT (pre-probe cap,
 default 180), SCINT_BENCH_PROBE_RETRIES / SCINT_BENCH_PROBE_PAUSE
 (probe retry loop for transient tunnel weather, default 3 x 120 s
-pause), SCINT_BENCH_DEVICE_TIMEOUT (full-run watchdog, default 1200).
+pause), SCINT_BENCH_DEVICE_TIMEOUT (full-run watchdog, default 1200),
+SCINT_BENCH_REPEATS (timed device passes, median reported, default 3),
+SCINT_BENCH_CPU_THREADS (BLAS pin in the fallback subprocess).
 """
 
 import json
@@ -523,7 +525,11 @@ def main():
 
         def _run():
             try:
-                result.update(device_throughput(dyn, freqs, times, chunk))
+                # median-of-3 on chip too: passes are sub-second there,
+                # and tunnel weather makes single-shot rates spiky
+                result.update(device_throughput(
+                    dyn, freqs, times, chunk,
+                    repeats=_env_int("SCINT_BENCH_REPEATS", 3)))
             except Exception as e:  # pragma: no cover - surfaced in JSON
                 result["error"] = f"{type(e).__name__}: {e}"
 
