@@ -1201,3 +1201,25 @@ def test_arc_tail_validation():
                       PipelineConfig(arc_method="thetatheta",
                                      arc_tail="fast",
                                      arc_constraint=(0.1, 2.0)))
+
+
+def test_arc_tail_fast_asymm_arms():
+    """The fast tail serves the per-arm (asymm) measurements through
+    the same late-bound closure: arm etas bracket the combined eta and
+    agree with the exact tail within the arm errors."""
+    import jax.numpy as jnp
+
+    sec = _arc_secspec(eta=0.6, rng=np.random.default_rng(77))
+    kw = dict(fdop=sec.fdop, yaxis=sec.beta, tdel=sec.tdel,
+              freq=1400.0, numsteps=1024, asymm=True)
+    batch = jnp.asarray(sec.sspec)[None]
+    exact = make_arc_fitter(arc_tail="exact", **kw)(batch)
+    fast = make_arc_fitter(arc_tail="fast", **kw)(batch)
+    for arm in ("eta_left", "eta_right"):
+        e = float(np.asarray(getattr(exact, arm))[0])
+        f = float(np.asarray(getattr(fast, arm))[0])
+        err = max(float(np.asarray(
+            getattr(exact, arm.replace("eta_", "etaerr_")))[0]), 1e-3)
+        assert np.isfinite(f)
+        assert f == pytest.approx(0.6, rel=0.25)
+        assert abs(f - e) <= max(3 * err, 0.15 * e), (arm, f, e, err)

@@ -398,3 +398,21 @@ def test_mcmc_batch_agrees_with_truth_and_single():
     with pytest.raises(ValueError, match="burn"):
         fit_scint_params_mcmc_batch(acfs, dt=8.0, df=0.25, nchan=64,
                                     nsub=96, steps=10, burn=10)
+
+
+def test_mcmc_batch_free_alpha():
+    """alpha=None samples the power-law index as a fifth dimension per
+    lane, matching the single-epoch free-alpha contract."""
+    from scintools_tpu.fit import fit_scint_params_mcmc_batch
+
+    acfs = np.stack([_synthetic_acf(tau=110.0, noise=0.02, seed=30 + i)
+                     for i in range(2)])
+    post, chain = fit_scint_params_mcmc_batch(
+        acfs, dt=8.0, df=0.25, nchan=64, nsub=96, alpha=None,
+        nwalkers=32, steps=300, burn=150, seed=7, return_chain=True)
+    assert chain.shape[0] == 2 and chain.shape[-1] == 5
+    ta = np.asarray(post.talpha)
+    assert ta.shape == (2,)
+    assert np.all((ta > 0.5) & (ta < 6.0)), ta
+    assert np.all(np.asarray(post.talphaerr) > 0)
+    np.testing.assert_allclose(np.asarray(post.tau), 110.0, rtol=0.15)
