@@ -118,6 +118,40 @@ def main(outdir: str = "/tmp/survey_pipeline") -> dict:
                 log_event(log, "survey_stat", measurement=name,
                           **stats[name])
 
+        # posterior error bars at survey scale (beyond the reference,
+        # whose mcmc option runs one file at a time): ONE vmapped
+        # stretch-move sampler over a sub-batch of epochs, the epoch
+        # axis sharded over the mesh's data axis
+        indices0, _ = buckets[0]
+        # the sharded epoch axis must divide the mesh's data axis, and
+        # a PARTIAL resume can leave bucket 0 with any count — round
+        # what is actually available down to a mesh multiple and skip
+        # the section when the bucket is smaller than the mesh
+        data_ax = mesh.shape["data"]
+        n_sub = (min(8, len(indices0)) // data_ax) * data_ax
+        if n_sub:
+            with timers.stage("mcmc_batch"):
+                from scintools_tpu.fit import fit_scint_params_mcmc_batch
+                from scintools_tpu.ops import acf as acf_op
+
+                sub = [todo[i] for i in indices0[:n_sub]]
+                acf_b = np.asarray(acf_op(np.stack(
+                    [np.asarray(d.dyn, np.float64) for d in sub]),
+                    backend="jax"))
+                d0 = sub[0]
+                post = fit_scint_params_mcmc_batch(
+                    acf_b, dt=float(d0.times[1] - d0.times[0]),
+                    df=float(d0.freqs[1] - d0.freqs[0]),
+                    nchan=acf_b.shape[1] // 2, nsub=acf_b.shape[2] // 2,
+                    nwalkers=16, steps=120, burn=60, seed=11, mesh=mesh)
+                stats["tau_posterior"] = [round(float(t), 3)
+                                          for t in np.asarray(post.tau)]
+                log_event(log, "mcmc_batch", n=len(sub),
+                          tau_med=stats["tau_posterior"])
+        else:
+            log_event(log, "mcmc_batch_skipped",
+                      n_bucket=len(indices0), mesh_data=data_ax)
+
     csv_path = os.path.join(outdir, "results.csv")
     n_rows = store.export_csv(csv_path)
     log_event(log, "survey_done", rows=n_rows)

@@ -5,6 +5,7 @@ import pathlib
 import runpy
 import sys
 
+import numpy as np
 import pytest
 
 _SCRIPT = (pathlib.Path(__file__).resolve().parents[1] / "examples"
@@ -86,6 +87,10 @@ def test_survey_pipeline_walkthrough(tmp_path):
     assert out["rows"] == 64
     assert out["stats"]["tau"]["count"] == 64
     assert out["stats"]["tau"]["mean"] > 0
+    # the batched (mesh-sharded) posterior section: finite positive
+    # medians for every sampled epoch
+    tp = np.asarray(out["stats"]["tau_posterior"])
+    assert len(tp) >= 1 and np.all(np.isfinite(tp)) and np.all(tp > 0)
     # rerun: everything resumed from the store, nothing recomputed
     out2 = mod["main"](str(tmp_path))
     assert out2["resumed"] == 64 and out2["rows"] == 64
