@@ -5,6 +5,22 @@
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
+# single-flight mutual exclusion: hold .device.lock for the WHOLE
+# flight, so any concurrently-started bench.py (e.g. the round
+# driver's end-of-round run) WAITS on the same flock instead of
+# double-claiming the tunnel (two concurrent device processes can
+# wedge it for good).  bench.py skips its own acquisition when this
+# env var says an ancestor already holds the lock.
+# wait default sized ABOVE a concurrent bench.py's worst-case hold
+# (1200 s device watchdog + baseline + margin): the opposing holder
+# finishing and this flight then starting is the correct serialisation
+exec 9>".device.lock"
+if ! flock -w "${TPU_LOCK_WAIT:-2700}" 9; then
+  echo "device single-flight lock busy >${TPU_LOCK_WAIT:-2700}s; aborting"
+  exit 4
+fi
+export SCINT_DEVICE_LOCK_HELD=1
+
 probe() {
   # status must reflect the python probe (a wedged claim ignores
   # SIGTERM: escalate to SIGKILL), not the log filter's status.  The

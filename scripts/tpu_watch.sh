@@ -15,12 +15,44 @@ cd "$(dirname "$0")/.."
 
 PAUSE="${TPU_WATCH_PAUSE:-600}"
 MAX_TRIES="${TPU_WATCH_TRIES:-60}"
+# Soft stop (epoch seconds): stop launching NEW probes past this time.
+# The REAL single-flight guarantee against the round driver's own
+# end-of-round bench is the .device.lock flock that tpu_recheck.sh and
+# bench.py both take — a capture already in flight simply holds the
+# lock and a concurrent bench WAITS instead of double-claiming.  The
+# deadline just stops pointless probing late in the round.
+DEADLINE="${TPU_WATCH_DEADLINE:-0}"
+if ! [[ "$DEADLINE" =~ ^[0-9]+$ ]]; then
+  echo "TPU_WATCH_DEADLINE must be numeric epoch seconds, got: $DEADLINE"
+  exit 2
+fi
 LOG_DIR=benchmarks/flights
 mkdir -p "$LOG_DIR"
 
 for ((i = 1; i <= MAX_TRIES; i++)); do
+  now=$(date +%s)
+  if [[ "$DEADLINE" -gt 0 && "$now" -ge "$DEADLINE" ]]; then
+    echo "[$(date -u +%Y%m%dT%H%M%SZ)] deadline reached; standing down"
+    exit 3
+  fi
   ts=$(date -u +%Y%m%dT%H%M%SZ)
   # a wedged claim ignores SIGTERM: escalate to SIGKILL after 5 s
+  # the probe itself claims the device, so it must respect the
+  # single-flight lock: if a capture (or the round driver's bench)
+  # holds it, SKIP this cycle instead of double-claiming the tunnel
+  exec 9>".device.lock"
+  if ! flock -n 9; then
+    echo "[$ts] probe $i/$MAX_TRIES: skipped (.device.lock held)"
+    exec 9>&-
+    # same deadline-capped nap as the failed-probe path below
+    nap="$PAUSE"
+    if [[ "$DEADLINE" -gt 0 ]]; then
+      left=$((DEADLINE - $(date +%s)))
+      if ((left < nap)); then nap=$((left > 0 ? left : 0)); fi
+    fi
+    sleep "$nap"
+    continue
+  fi
   # match the success marker anywhere in the output (NOT tail -1: an
   # unfiltered trailing teardown line must not mask a healthy probe).
   # The marker embeds the backend platform: a silent CPU fallback must
@@ -32,6 +64,10 @@ print('probe platform=%s sum=%s' % (jax.devices()[0].platform, s))
 if jax.devices()[0].platform in ('tpu', 'axon') and s == 4096.0:
     print('tpu alive')
 " 2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -3)
+  # probe subprocess has exited: release BEFORE launching the capture
+  # (tpu_recheck.sh takes the same lock with its own descriptor; holding
+  # ours across the child would deadlock it against its own parent)
+  exec 9>&-
   echo "[$ts] probe $i/$MAX_TRIES: ${out##*$'\n'}"
   if [[ "$out" == *"tpu alive"* ]]; then
     log="$LOG_DIR/r5_flight_${ts}.log"
@@ -41,7 +77,14 @@ if jax.devices()[0].platform in ('tpu', 'axon') and s == 4096.0:
     echo "recheck rc=$rc (log: $log)"
     exit "$rc"
   fi
-  sleep "$PAUSE"
+  # never sleep past the deadline (a failed probe at deadline-30s must
+  # not add a full PAUSE before standing down)
+  nap="$PAUSE"
+  if [[ "$DEADLINE" -gt 0 ]]; then
+    left=$((DEADLINE - $(date +%s)))
+    if ((left < nap)); then nap=$((left > 0 ? left : 0)); fi
+  fi
+  sleep "$nap"
 done
 echo "tunnel never answered in $MAX_TRIES probes"
 exit 1
