@@ -411,3 +411,38 @@ def test_bench_lock_inherited_sentinel(monkeypatch):
 
     monkeypatch.setenv("SCINT_DEVICE_LOCK_HELD", "1")
     assert bench._acquire_device_lock(0) == "inherited"
+
+
+def test_salvage_freshness_gate(tmp_path):
+    """_salvage_flight_record only accepts records newer than the
+    caller's lock-wait start: a stale prior-flight log must never
+    masquerade as the current holder's measurement."""
+    import json
+    import time
+
+    import bench
+
+    metric = "m-test"
+    rec = {"metric": metric, "value": 5.0, "probe": {"ok": True}}
+    log_path = os.path.join(REPO, "benchmarks", "flights",
+                            "r5_flight_freshness_tmp.log")
+    try:
+        with open(log_path, "w") as fh:
+            fh.write(json.dumps(rec) + "\n")
+        now = time.time()
+        got = bench._salvage_flight_record(metric, newer_than=now - 60)
+        assert got and got["value"] == 5.0
+        assert "min ago" in got["salvaged_from"]
+        # age the log past the gate -> rejected
+        os.utime(log_path, (now - 7200, now - 7200))
+        assert bench._salvage_flight_record(metric,
+                                            newer_than=now - 600) is None
+        # fallback-labelled or probe-failed records never qualify
+        with open(log_path, "w") as fh:
+            fh.write(json.dumps(dict(rec, device="cpu-fallback (x)"))
+                     + "\n")
+            fh.write(json.dumps(dict(rec, probe={"ok": False})) + "\n")
+        assert bench._salvage_flight_record(metric,
+                                            newer_than=now - 600) is None
+    finally:
+        os.unlink(log_path)
