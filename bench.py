@@ -48,8 +48,12 @@ CACHE_DIR = os.path.join(_HERE, ".jax_cache")
 DEFAULT_SHAPE = (1024, 256, 512)
 # single-flight device lock shared with scripts/tpu_recheck.sh: two
 # concurrent device processes can wedge the axon tunnel for good, so
-# every device-touching phase (probe + full run) holds this flock
-DEVICE_LOCK = os.path.join(_HERE, ".device.lock")
+# every device-touching phase (probe + full run) holds this flock.
+# SCINT_BENCH_LOCK_FILE overrides the path — tests isolate on it so
+# they never collide with a LIVE watcher's probe-time hold of the
+# real lock.
+DEVICE_LOCK = (os.environ.get("SCINT_BENCH_LOCK_FILE")
+               or os.path.join(_HERE, ".device.lock"))
 
 
 def _acquire_device_lock(timeout_s: int):

@@ -18,7 +18,7 @@ import numpy as np
 
 from ..data import DynspecData
 
-__all__ = ["thin_arc_epoch", "thin_arc_eta"]
+__all__ = ["thin_arc_betaeta", "thin_arc_epoch", "thin_arc_eta"]
 
 
 def thin_arc_eta(arc_frac: float = 0.5, df: float = 0.5,

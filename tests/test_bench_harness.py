@@ -316,13 +316,21 @@ def test_bench_respects_device_lock(tmp_path):
 
     import bench
 
-    holder = open(bench.DEVICE_LOCK, "w")
+    # isolated lock path: the REAL .device.lock may be held by a live
+    # tunnel watcher's probe at any moment (SCINT_BENCH_LOCK_FILE is
+    # honoured by bench.py at import)
+    lock_file = str(tmp_path / "device.lock")
+    holder = open(lock_file, "w")
     fcntl.flock(holder, fcntl.LOCK_EX | fcntl.LOCK_NB)
     try:
         env = dict(os.environ)
-        env.update(SCINT_BENCH_B="4", SCINT_BENCH_NF="32",
+        # NF=48 (not 32): a DIFFERENT metric string from the salvage
+        # test's fake flight record, so parallel test runs can never
+        # cross-salvage each other's logs
+        env.update(SCINT_BENCH_B="4", SCINT_BENCH_NF="48",
                    SCINT_BENCH_NT="32", SCINT_BENCH_CPU_EPOCHS="1",
                    SCINT_BENCH_CHUNK="4", SCINT_BENCH_LOCK_WAIT="1",
+                   SCINT_BENCH_LOCK_FILE=lock_file,
                    SCINT_BENCH_FALLBACK_B="4",
                    SCINT_BENCH_FALLBACK_TIMEOUT="600",
                    JAX_PLATFORMS="cpu")
@@ -368,7 +376,8 @@ def test_bench_lock_busy_salvages_flight_record(tmp_path):
                                                   "platform": "axon"}}
     log_path = os.path.join(REPO, "benchmarks", "flights",
                             "r5_flight_testtmp.log")
-    holder = open(bench.DEVICE_LOCK, "w")
+    lock_file = str(tmp_path / "device.lock")
+    holder = open(lock_file, "w")
     fcntl.flock(holder, fcntl.LOCK_EX | fcntl.LOCK_NB)
     try:
         with open(log_path, "w") as fh:
@@ -378,6 +387,7 @@ def test_bench_lock_busy_salvages_flight_record(tmp_path):
         env.update(SCINT_BENCH_B="4", SCINT_BENCH_NF="32",
                    SCINT_BENCH_NT="32", SCINT_BENCH_CPU_EPOCHS="1",
                    SCINT_BENCH_CHUNK="4", SCINT_BENCH_LOCK_WAIT="1",
+                   SCINT_BENCH_LOCK_FILE=lock_file,
                    JAX_PLATFORMS="cpu")
         env.pop("SCINT_DEVICE_LOCK_HELD", None)
         env.pop("SCINT_BENCH_FORCE_CPU", None)
@@ -413,36 +423,57 @@ def test_bench_lock_inherited_sentinel(monkeypatch):
     assert bench._acquire_device_lock(0) == "inherited"
 
 
-def test_salvage_freshness_gate(tmp_path):
+def test_salvage_freshness_gate(tmp_path, monkeypatch):
     """_salvage_flight_record only accepts records newer than the
     caller's lock-wait start: a stale prior-flight log must never
-    masquerade as the current holder's measurement."""
+    masquerade as the current holder's measurement.  Fully isolated in
+    tmp_path (the in-process call allows repointing bench._HERE, unlike
+    the subprocess-based lock tests)."""
     import json
     import time
 
     import bench
 
+    monkeypatch.setattr(bench, "_HERE", str(tmp_path))
+    flights = tmp_path / "benchmarks" / "flights"
+    flights.mkdir(parents=True)
     metric = "m-test"
     rec = {"metric": metric, "value": 5.0, "probe": {"ok": True}}
-    log_path = os.path.join(REPO, "benchmarks", "flights",
-                            "r5_flight_freshness_tmp.log")
-    try:
-        with open(log_path, "w") as fh:
-            fh.write(json.dumps(rec) + "\n")
-        now = time.time()
-        got = bench._salvage_flight_record(metric, newer_than=now - 60)
-        assert got and got["value"] == 5.0
-        assert "min ago" in got["salvaged_from"]
-        # age the log past the gate -> rejected
-        os.utime(log_path, (now - 7200, now - 7200))
-        assert bench._salvage_flight_record(metric,
-                                            newer_than=now - 600) is None
-        # fallback-labelled or probe-failed records never qualify
-        with open(log_path, "w") as fh:
-            fh.write(json.dumps(dict(rec, device="cpu-fallback (x)"))
-                     + "\n")
-            fh.write(json.dumps(dict(rec, probe={"ok": False})) + "\n")
-        assert bench._salvage_flight_record(metric,
-                                            newer_than=now - 600) is None
-    finally:
-        os.unlink(log_path)
+    log_path = flights / "r5_flight_freshness_tmp.log"
+    log_path.write_text(json.dumps(rec) + "\n")
+    now = time.time()
+    got = bench._salvage_flight_record(metric, newer_than=now - 60)
+    assert got and got["value"] == 5.0
+    assert "min ago" in got["salvaged_from"]
+    # age the log past the gate -> rejected
+    os.utime(log_path, (now - 7200, now - 7200))
+    assert bench._salvage_flight_record(metric,
+                                        newer_than=now - 600) is None
+    # fallback-labelled or probe-failed records never qualify
+    log_path.write_text(
+        json.dumps(dict(rec, device="cpu-fallback (x)")) + "\n"
+        + json.dumps(dict(rec, probe={"ok": False})) + "\n")
+    assert bench._salvage_flight_record(metric,
+                                        newer_than=now - 600) is None
+
+
+def test_device_lock_default_path():
+    """With no SCINT_BENCH_LOCK_FILE override, bench's lock path is the
+    repo-root .device.lock that tpu_recheck.sh / tpu_watch.sh flock —
+    the production single-flight guarantee the isolated-path tests
+    deliberately bypass."""
+    import importlib
+    import subprocess
+    import sys
+
+    code = ("import os; os.environ.pop('SCINT_BENCH_LOCK_FILE', None)\n"
+            "import bench\n"
+            "print(bench.DEVICE_LOCK)\n")
+    out = subprocess.run([sys.executable, "-c", code], text=True,
+                         capture_output=True, timeout=120,
+                         env={**os.environ,
+                              "PYTHONPATH": REPO + os.pathsep
+                              + os.environ.get("PYTHONPATH", "")},
+                         cwd=REPO)
+    path = out.stdout.strip().splitlines()[-1]
+    assert path == os.path.join(REPO, ".device.lock"), (path, out.stderr)
