@@ -1,0 +1,55 @@
+"""Summarise a tpu_recheck flight log: one line per captured stage.
+
+Post-flight workflow helper: the capture window is minutes-scale, so
+landing the evidence into docs/PARITY quickly matters.  Prints every
+JSON record and every stage-profile row found in the log, prefixed by
+the stage banner it appeared under, plus a PASS/FAIL verdict per gate.
+
+Usage: python scripts/flight_digest.py benchmarks/flights/<log> [...]
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+
+
+def digest(path: str) -> int:
+    stage = "(preamble)"
+    n_rec = 0
+    print(f"== {path} ==")
+    with open(path, errors="replace") as fh:
+        for raw in fh:
+            line = raw.strip()
+            m = re.match(r"^==\s*(.+?)\s*==$", line)
+            if m:
+                stage = m.group(1)
+                continue
+            if line.startswith("{"):
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                n_rec += 1
+                keys = ("metric", "kernel", "config", "value", "unit",
+                        "vs_baseline", "speedup", "verdict", "device",
+                        "tunnel_weather_suspect", "error")
+                brief = {k: rec[k] for k in keys if k in rec}
+                print(f"  [{stage}] {brief}")
+            elif re.match(r"^\S.*\sms/batch\s", line):
+                print(f"  [{stage}] {line}")
+            elif "FAILED" in line or "rel err" in line or "alive" in line:
+                print(f"  [{stage}] {line}")
+    print(f"  ({n_rec} JSON records)")
+    return 0 if n_rec else 1
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 2:
+        print(__doc__)
+        sys.exit(2)
+    try:
+        sys.exit(max(digest(p) for p in sys.argv[1:]))
+    except BrokenPipeError:  # `| head` closing the pipe is fine
+        sys.exit(0)
