@@ -1,0 +1,19 @@
+"""Tiny single-op device probe shared by tpu_watch.sh / tpu_recheck.sh.
+
+Prints ``probe platform=<p> sum=<s>`` and, ONLY when the backend is a
+real TPU and the op computed correctly, the success marker
+``tpu alive`` — a silent CPU fallback must never greenlight the
+hour-scale "on-chip" capture on the wrong device.  Callers wrap this
+in ``timeout -k <grace> <t>`` (a wedged tunnel claim hangs forever and
+ignores SIGTERM) and grep for the marker.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+s = float(np.asarray(jnp.sum(jnp.ones((64, 64)))))
+print("probe platform=%s sum=%s" % (jax.devices()[0].platform, s))
+if jax.devices()[0].platform in ("tpu", "axon") and s == 4096.0:
+    print("tpu alive")

@@ -23,17 +23,12 @@ export SCINT_DEVICE_LOCK_HELD=1
 
 probe() {
   # status must reflect the python probe (a wedged claim ignores
-  # SIGTERM: escalate to SIGKILL), not the log filter's status.  The
-  # marker embeds the backend platform: a silent CPU fallback must not
-  # greenlight the hour-scale "on-chip" capture on the wrong device.
+  # SIGTERM: escalate to SIGKILL), not the log filter's status;
+  # scripts/device_probe.py embeds the platform check so a silent CPU
+  # fallback cannot greenlight the hour-scale "on-chip" capture
   local out
-  out=$(timeout -k 5 180 python -u -c "
-import numpy as np, jax, jax.numpy as jnp
-s = float(np.asarray(jnp.sum(jnp.ones((64,64)))))
-print('probe platform=%s sum=%s' % (jax.devices()[0].platform, s))
-if jax.devices()[0].platform in ('tpu', 'axon') and s == 4096.0:
-    print('tpu alive')
-" 2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -2)
+  out=$(timeout -k 5 180 python -u scripts/device_probe.py \
+    2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -2)
   echo "$out"
   [[ "$out" == *"tpu alive"* ]]
 }

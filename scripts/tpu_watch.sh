@@ -29,6 +29,17 @@ fi
 LOG_DIR=benchmarks/flights
 mkdir -p "$LOG_DIR"
 
+# sleep PAUSE, but never past the deadline (a failed/skipped probe at
+# deadline-30s must not add a full PAUSE before standing down)
+nap_capped() {
+  local nap="$PAUSE"
+  if [[ "$DEADLINE" -gt 0 ]]; then
+    local left=$((DEADLINE - $(date +%s)))
+    ((left < nap)) && nap=$((left > 0 ? left : 0))
+  fi
+  sleep "$nap"
+}
+
 for ((i = 1; i <= MAX_TRIES; i++)); do
   now=$(date +%s)
   if [[ "$DEADLINE" -gt 0 && "$now" -ge "$DEADLINE" ]]; then
@@ -44,26 +55,14 @@ for ((i = 1; i <= MAX_TRIES; i++)); do
   if ! flock -n 9; then
     echo "[$ts] probe $i/$MAX_TRIES: skipped (.device.lock held)"
     exec 9>&-
-    # same deadline-capped nap as the failed-probe path below
-    nap="$PAUSE"
-    if [[ "$DEADLINE" -gt 0 ]]; then
-      left=$((DEADLINE - $(date +%s)))
-      if ((left < nap)); then nap=$((left > 0 ? left : 0)); fi
-    fi
-    sleep "$nap"
+    nap_capped
     continue
   fi
   # match the success marker anywhere in the output (NOT tail -1: an
-  # unfiltered trailing teardown line must not mask a healthy probe).
-  # The marker embeds the backend platform: a silent CPU fallback must
-  # NOT trigger the one-shot capture on the wrong device.
-  out=$(timeout -k 5 180 python -u -c "
-import numpy as np, jax, jax.numpy as jnp
-s = float(np.asarray(jnp.sum(jnp.ones((64,64)))))
-print('probe platform=%s sum=%s' % (jax.devices()[0].platform, s))
-if jax.devices()[0].platform in ('tpu', 'axon') and s == 4096.0:
-    print('tpu alive')
-" 2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -3)
+  # unfiltered trailing teardown line must not mask a healthy probe);
+  # scripts/device_probe.py embeds the platform check
+  out=$(timeout -k 5 180 python -u scripts/device_probe.py \
+    2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -3)
   # probe subprocess has exited: release BEFORE launching the capture
   # (tpu_recheck.sh takes the same lock with its own descriptor; holding
   # ours across the child would deadlock it against its own parent)
@@ -77,14 +76,7 @@ if jax.devices()[0].platform in ('tpu', 'axon') and s == 4096.0:
     echo "recheck rc=$rc (log: $log)"
     exit "$rc"
   fi
-  # never sleep past the deadline (a failed probe at deadline-30s must
-  # not add a full PAUSE before standing down)
-  nap="$PAUSE"
-  if [[ "$DEADLINE" -gt 0 ]]; then
-    left=$((DEADLINE - $(date +%s)))
-    if ((left < nap)); then nap=$((left > 0 ? left : 0)); fi
-  fi
-  sleep "$nap"
+  nap_capped
 done
 echo "tunnel never answered in $MAX_TRIES probes"
 exit 1
