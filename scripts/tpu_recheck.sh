@@ -33,29 +33,62 @@ probe() {
   [[ "$out" == *"tpu alive"* ]]
 }
 
+stage_out=$(mktemp)
+trap 'rm -f "$stage_out"' EXIT
+
+# gated <label> <timeout_s> <success_tail_n> <cmd...>: run the stage to
+# a capture file; on success print the log-noise-filtered tail, on
+# FAILURE print an UNFILTERED tail — a backend-init hang emits only
+# INFO/axon lines, and the round-5 flight's filtered failure tail was
+# empty, leaving wedge-vs-genuine-failure undecidable from the log.
+# Exit status is the python process's own (pipefail cannot help here:
+# the capture file, not a pipe, owns the output).
+gated() {
+  local label="$1" tmo="$2" tail_n="$3"
+  shift 3
+  if ! timeout -k 10 "$tmo" "$@" > "$stage_out" 2>&1; then
+    tail -12 "$stage_out"
+    echo "$label FAILED (unfiltered tail above)"
+    exit 1
+  fi
+  # `|| true`: under pipefail an all-noise (fully filtered) success log
+  # would otherwise turn grep's no-match status into a stage failure
+  { grep -v -E 'INFO|WARN|axon_|Logging|E0000' "$stage_out" || true; } \
+    | tail -"$tail_n"
+}
+
 echo "== probe =="
 probe || { echo "tunnel unreachable; aborting"; exit 1; }
 
-# HEADLINE FIRST (round-4 lesson: the tunnel wedged mid-flight and
-# took the un-run bench stage with it — the headline is the round's
-# #1 deliverable, so it runs before the gates; a broken route would
-# surface as a failed/NaN bench, which the later gates then explain)
+# STAGE ORDER = MARGINAL EVIDENCE PER HEALTHY MINUTE.  The tunnel's
+# healthy windows are minute-scale (the 2026-08-02 window lasted just
+# long enough for the bench before wedging at the next stage), so:
+#   1. headline bench         (round's #1 deliverable; landed 2026-08-02,
+#                              a repeat in a healthier window raises it)
+#   2-3. pallas gate + nudft bf16 guard (sub-minute CORRECTNESS verdicts
+#        that validate every capture below; CPU CI cannot see either)
+#   4. f32 on-chip budget     (published figures' only missing capture)
+#   5. all five configs       (configs 1-3 have no on-chip record)
+#   6. B=256 stage profile    (repeat-healthy-flight evidence)
+#   7. B=1024 auto-route A/B  (repeat-healthy-flight evidence)
+#   8. arc-tail A/B           (fast-tail on-chip verdict)
+#   9. pallas prove-or-remove A/B (perf regression guard; has a round-4
+#      verdict already, so it rides last)
 echo "== headline bench =="
-timeout -k 10 2400 python bench.py 2>&1 \
-  | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -2
+# gated: a bench that wedges or falls back to CPU exits nonzero, and
+# every stage below is then doomed (wedge) or suspect — abort with the
+# unfiltered tail rather than spending the window on a dead tunnel
+gated "headline bench" 2400 2 python bench.py
 
 echo "== pallas row-scrunch lowers on chip =="
 # the fused row-scrunch kernel is the arc fitter's on-chip auto route
 # since round 4 (wire verdict, 3.5x the scan); CI validates it in
 # interpret mode only, so this is the real-Mosaic correctness gate.
-# Gate on python's EXIT STATUS (the rel-err line prints before the
-# assert, so grepping for it cannot detect a failure), captured to a
-# file because the log-noise filter pipeline would otherwise own the
-# status.  (The Pallas NUDFT that was also gated here was deleted in
-# round 4: 0.44x the production einsum — benchmarks/pallas_ab.py.)
-pallas_out=$(mktemp)
-trap 'rm -f "$pallas_out"' EXIT
-if ! timeout -k 10 600 python -u -c "
+# Gated on python's EXIT STATUS (the rel-err line prints before the
+# assert, so grepping for it cannot detect a failure).  (The Pallas
+# NUDFT that was also gated here was deleted in round 4: 0.44x the
+# production einsum — benchmarks/pallas_ab.py.)
+gated "pallas lowering check" 600 2 python -u -c "
 import numpy as np
 from scintools_tpu.ops.resample_pallas import row_scrunch_pallas
 rng = np.random.default_rng(0)
@@ -78,22 +111,14 @@ got2 = np.asarray(row_scrunch_pallas(rows, i0, wgt))
 err2 = np.max(np.abs(got2 - want2)) / max(np.max(np.abs(want2)), 1e-30)
 print('row-scrunch pallas on-chip rel err:', err2)
 assert err2 < 5e-3, err2
-" > "$pallas_out" 2>&1; then
-  # failure path: UNFILTERED tail — a backend-init hang emits only
-  # INFO/axon lines, and the round-5 flight's filtered tail was empty,
-  # leaving the wedge-vs-genuine-failure question undecidable from the log
-  tail -12 "$pallas_out"
-  echo "pallas lowering check FAILED (unfiltered tail above)"
-  exit 1
-fi
-grep -v -E 'INFO|WARN|axon_|Logging|E0000' "$pallas_out" | tail -2
+"
 
 echo "== nudft einsum on-chip accuracy (bf16-lowering guard) =="
 # the round-4 A/B caught the vmapped einsum NUDFT silently lowering to
 # bf16 MXU passes (2e-3 scaled error); _nudft_jax_reim now pins
 # Precision.HIGHEST.  CPU CI cannot see this (einsum precision is exact
 # there), so the on-chip oracle check lives here permanently.
-if ! timeout -k 10 600 python -u -c "
+gated "nudft einsum accuracy check" 600 2 python -u -c "
 import numpy as np, jax, jax.numpy as jnp
 from scintools_tpu.ops.nudft import _nudft_numpy, _r_grid, nudft
 rng = np.random.default_rng(1)
@@ -111,24 +136,21 @@ pw = np.abs(w) ** 2
 err = float(np.max(np.abs(a[0] - pw)) / pw.max())
 print('vmapped einsum nudft vs f64 oracle, scaled err:', err)
 assert err < 2e-4, ('bf16 MXU lowering is back?', err)
-" 2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -2; then
-  echo "nudft einsum accuracy check FAILED"
-  exit 1
-fi
+"
 
-echo "== pallas prove-or-remove A/B =="
-# regression guard for the wired row-scrunch route (docs/roadmap.md:
-# wire a kernel only if it beats the production path by >= 1.15x with
-# matching numerics; otherwise it gets deleted)
-if ! timeout -k 10 1800 python benchmarks/pallas_ab.py --iters 10 \
-  2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -4; then
-  echo "pallas A/B FAILED"
-  exit 1
-fi
+echo "== f32 numerics budget on chip =="
+# hardware tier of the f32 drift suite: chip-f32 vs host-f64 oracle
+# with degenerate-profile awareness (a weak-scattering epoch whose two
+# arc lobes agree to <0.1 dB may legitimately flip under f32 — see
+# benchmarks/f32_budget_onchip.py).  CI tier: tests/test_f32_budget.py.
+gated "f32 on-chip check" 1800 4 python benchmarks/f32_budget_onchip.py
+
+echo "== all five configs =="
+gated "all five configs" 3600 6 python benchmarks/all_configs.py
 
 echo "== stage profile (bench shape) =="
-timeout -k 10 1800 python benchmarks/profile_stages.py --b 256 --iters 5 \
-  2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -13
+gated "B=256 stage profile" 1800 13 python benchmarks/profile_stages.py \
+  --b 256 --iters 5
 
 echo "== auto-route A/B at the bench batch size (B=1024) =="
 # the arc_scrunch_rows=-1 / scint_cuts=auto defaults were extrapolated
@@ -136,35 +158,18 @@ echo "== auto-route A/B at the bench batch size (B=1024) =="
 # ONE invocation (one jax init, one 512 MB batch): profile_stages
 # exits nonzero if the row filter matches nothing (renamed rows must
 # fail loudly, not skip the A/B)
-if ! timeout -k 10 3600 python benchmarks/profile_stages.py --b 1024 \
-  --iters 3 --only "rc=,cuts,lm_steps" \
-  2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -8; then
-  echo "B=1024 auto-route A/B FAILED"
-  exit 1
-fi
+gated "B=1024 auto-route A/B" 3600 8 python benchmarks/profile_stages.py \
+  --b 1024 --iters 3 --only "rc=,cuts,lm_steps"
 
 echo "== arc measurement-tail A/B (exact vs fast, simulated arcs) =="
 # the opt-in arc_tail="fast" knob ships only while its numerics hold:
 # every healthy lane's eta within the fit's own etaerr of the exact
 # tail, NaN quarantine identical (benchmarks/arc_tail_ab.py exits
 # nonzero on a numerics-mismatch verdict)
-if ! timeout -k 10 1800 python benchmarks/arc_tail_ab.py --b 256 --iters 5 \
-  2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -2; then
-  echo "arc tail A/B FAILED"
-  exit 1
-fi
+gated "arc tail A/B" 1800 2 python benchmarks/arc_tail_ab.py --b 256 --iters 5
 
-echo "== f32 numerics budget on chip =="
-# hardware tier of the f32 drift suite: chip-f32 vs host-f64 oracle
-# with degenerate-profile awareness (a weak-scattering epoch whose two
-# arc lobes agree to <0.1 dB may legitimately flip under f32 — see
-# benchmarks/f32_budget_onchip.py).  CI tier: tests/test_f32_budget.py.
-if ! timeout -k 10 1800 python benchmarks/f32_budget_onchip.py \
-  2>&1 | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -4; then
-  echo "f32 on-chip check FAILED"
-  exit 1
-fi
-
-echo "== all five configs =="
-timeout -k 10 3600 python benchmarks/all_configs.py 2>&1 \
-  | grep -v -E 'INFO|WARN|axon_|Logging|E0000' | tail -6
+echo "== pallas prove-or-remove A/B =="
+# regression guard for the wired row-scrunch route (docs/roadmap.md:
+# wire a kernel only if it beats the production path by >= 1.15x with
+# matching numerics; otherwise it gets deleted)
+gated "pallas A/B" 1800 4 python benchmarks/pallas_ab.py --iters 10
