@@ -755,7 +755,13 @@ def main():
     sal = None
     if device_lock not in (None, "inherited", "cpu-forced") \
             and wedge_like and "rate" not in result:
-        max_age_s = 3600 * _env_int("SCINT_BENCH_SALVAGE_MAX_AGE_H", 12)
+        # 24 h, not 12: the round spans ~12 h of build plus judge time,
+        # and a flight captured at its start must still qualify for the
+        # driver's end-of-round bench (12 h cut that exactly).  Stale
+        # PRIOR-round leakage is prevented by the metric match, the
+        # salvaged-records-never-requalify guard, and the fact that a
+        # round without an on-chip bench leaves no qualifying record.
+        max_age_s = 3600 * _env_int("SCINT_BENCH_SALVAGE_MAX_AGE_H", 24)
         sal = _salvage_flight_record(
             metric, newer_than=time.time() - max_age_s,
             why=(f"tunnel unreachable at capture time ({err}); newest "
