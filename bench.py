@@ -29,7 +29,13 @@ default 180), SCINT_BENCH_PROBE_RETRIES / SCINT_BENCH_PROBE_PAUSE
 (probe retry loop for transient tunnel weather, default 3 x 120 s
 pause), SCINT_BENCH_DEVICE_TIMEOUT (full-run watchdog, default 1200),
 SCINT_BENCH_REPEATS (timed device passes, median reported, default 3),
-SCINT_BENCH_CPU_THREADS (BLAS pin in the fallback subprocess).
+SCINT_BENCH_CPU_THREADS (BLAS pin in the fallback subprocess),
+SCINT_BENCH_TRACE (path: enable scintools_tpu.obs tracing and append
+span/counter events in the --trace JSONL format, so the headline
+decomposes with `scintools-tpu trace report` — the bench emits
+bench.baseline_epoch / bench.step.* spans and run_pipeline's own
+pipeline.* spans ride along; the env var propagates into the probe and
+fallback subprocesses, which append to the same file).
 """
 
 import json
@@ -172,6 +178,30 @@ def _env_int(name, default):
     return int(os.environ.get(name, default))
 
 
+def _maybe_enable_trace():
+    """Enable obs tracing when SCINT_BENCH_TRACE names a JSONL path.
+
+    Idempotent (obs.enable dedupes the sink per path) and called from
+    BOTH main() and device_throughput(), because the CPU fallback runs
+    device_throughput in a fresh subprocess that inherits the env but
+    never enters main().  The JSONL sink flushes per event, so records
+    survive bench's os._exit paths.
+    """
+    path = os.environ.get("SCINT_BENCH_TRACE")
+    if path:
+        from scintools_tpu import obs
+
+        obs.enable(jsonl=path)
+
+
+def _trace_flush():
+    """Push counters to the trace sink (spans stream as they close)."""
+    if os.environ.get("SCINT_BENCH_TRACE"):
+        from scintools_tpu import obs
+
+        obs.flush()
+
+
 def _cache_env(env=None):
     """Env dict with the persistent XLA compilation cache enabled.
 
@@ -272,6 +302,8 @@ def serial_baseline(dyn, freqs, times, n_epochs: int) -> dict:
 
     n_quarantined = 0
     scint_deltas = []
+    from scintools_tpu import obs
+
     if mods is not None:
         impl = "reference (/root/reference/scintools, imported live)"
         note = ("get_scint_params runs the reference code verbatim via "
@@ -281,17 +313,18 @@ def serial_baseline(dyn, freqs, times, n_epochs: int) -> dict:
             d64 = np.asarray(dyn[i], dtype=np.float64)
             d = DynspecData(dyn=d64, freqs=freqs, times=times)
             t0 = time.perf_counter()
-            rd = make_ref_dynspec(d)
-            rd.calc_sspec(lamsteps=True, plot=False)
-            try:
-                rd.fit_arc(lamsteps=True, numsteps=2000, plot=False,
-                           display=False)
-            except ValueError:
-                n_quarantined += 1  # meaning documented at the record key
-            rd.calc_acf()
-            ts0 = time.perf_counter()
-            rd.get_scint_params(plot=False, display=False)
-            t_ref_scint = time.perf_counter() - ts0
+            with obs.span("bench.baseline_epoch", impl="reference"):
+                rd = make_ref_dynspec(d)
+                rd.calc_sspec(lamsteps=True, plot=False)
+                try:
+                    rd.fit_arc(lamsteps=True, numsteps=2000, plot=False,
+                               display=False)
+                except ValueError:
+                    n_quarantined += 1  # meaning documented at record key
+                rd.calc_acf()
+                ts0 = time.perf_counter()
+                rd.get_scint_params(plot=False, display=False)
+                t_ref_scint = time.perf_counter() - ts0
             per.append(time.perf_counter() - t0)
             # off the clock: what the round-3 substitute step would have
             # cost on the same data, to quantify the removed substitution
@@ -310,20 +343,22 @@ def serial_baseline(dyn, freqs, times, n_epochs: int) -> dict:
             d64 = np.asarray(dyn[i], dtype=np.float64)
             epoch = DynspecData(dyn=d64, freqs=freqs, times=times)
             t0 = time.perf_counter()
-            lamdyn, lam, dlam = scale_lambda(epoch, backend="numpy")
-            sec = sspec(lamdyn, backend="numpy")
-            fdop, tdel, beta = sspec_axes(lamdyn.shape[0], lamdyn.shape[1],
-                                          dt, df, dlam=dlam)
-            secsp = SecSpec(sspec=sec, fdop=fdop, tdel=tdel, beta=beta,
-                            lamsteps=True)
-            try:
-                fit_arc(secsp, freq=float(np.mean(freqs)), numsteps=2000,
-                        backend="numpy")
-            except ValueError:
-                n_quarantined += 1
-            a = acf(d64, backend="numpy")
-            fit_scint_params(a, dt, df, d64.shape[0], d64.shape[1],
-                             backend="numpy")
+            with obs.span("bench.baseline_epoch", impl="repo-numpy"):
+                lamdyn, lam, dlam = scale_lambda(epoch, backend="numpy")
+                sec = sspec(lamdyn, backend="numpy")
+                fdop, tdel, beta = sspec_axes(lamdyn.shape[0],
+                                              lamdyn.shape[1],
+                                              dt, df, dlam=dlam)
+                secsp = SecSpec(sspec=sec, fdop=fdop, tdel=tdel, beta=beta,
+                                lamsteps=True)
+                try:
+                    fit_arc(secsp, freq=float(np.mean(freqs)),
+                            numsteps=2000, backend="numpy")
+                except ValueError:
+                    n_quarantined += 1
+                a = acf(d64, backend="numpy")
+                fit_scint_params(a, dt, df, d64.shape[0], d64.shape[1],
+                                 backend="numpy")
             per.append(time.perf_counter() - t0)
 
     per = np.asarray(per)
@@ -477,8 +512,10 @@ def device_throughput(dyn, freqs, times, chunk: int,
     host can't own the round's record (round-4 lesson: the r03/r04
     fallback headlines were single-shot and incomparable)."""
     _enable_compile_cache()
+    _maybe_enable_trace()
     import jax
 
+    from scintools_tpu import obs
     from scintools_tpu.parallel import PipelineConfig, make_pipeline
 
     import jax.numpy as jnp
@@ -503,23 +540,28 @@ def device_throughput(dyn, freqs, times, chunk: int,
 
     # stage the whole batch in HBM once (the dataloader-prefetch analogue);
     # the CPU baseline likewise reads host-resident arrays
-    dyn_d = jax.device_put(dyn)
+    with obs.span("bench.h2d", bytes=int(dyn.nbytes)):
+        dyn_d = jax.device_put(dyn)
+        obs.fence(dyn_d)
+    obs.inc("bytes_h2d", int(dyn.nbytes))
     # warmup/compile on the first chunk (persistent cache makes repeat
     # rounds near-free; compile_s includes the first execution)
     t0 = time.perf_counter()
-    sync([step(dyn_d[:chunk])])
+    with obs.span("bench.step.compile", chunk=chunk):
+        sync([step(dyn_d[:chunk])])
     compile_s = time.perf_counter() - t0
 
     rates = []
     for _ in range(max(int(repeats), 1)):
         t0 = time.perf_counter()
-        outs = []
-        for i in range(0, B, chunk):
-            part = dyn_d[i:i + chunk]
-            if part.shape[0] != chunk:  # keep one compiled shape
-                part = dyn_d[B - chunk:B]
-            outs.append(step(part))  # async dispatch; fits on device
-        sync(outs)
+        with obs.span("bench.step.execute", B=B, chunk=chunk):
+            outs = []
+            for i in range(0, B, chunk):
+                part = dyn_d[i:i + chunk]
+                if part.shape[0] != chunk:  # keep one compiled shape
+                    part = dyn_d[B - chunk:B]
+                outs.append(step(part))  # async dispatch; fits on device
+            sync(outs)
         rates.append(B / (time.perf_counter() - t0))
     rate = float(np.median(rates))
     # measure_s is derived from the SAME median pass the rate reports,
@@ -529,10 +571,12 @@ def device_throughput(dyn, freqs, times, chunk: int,
            "measure_s": round(B / rate, 3)}
     if len(rates) > 1:
         rec["repeat_rates"] = [round(r, 2) for r in rates]
+    _trace_flush()   # counters, for the fallback-subprocess caller
     return rec
 
 
 def main():
+    _maybe_enable_trace()
     B = _env_int("SCINT_BENCH_B", DEFAULT_SHAPE[0])
     nf = _env_int("SCINT_BENCH_NF", DEFAULT_SHAPE[1])
     nt = _env_int("SCINT_BENCH_NT", DEFAULT_SHAPE[2])
@@ -676,6 +720,7 @@ def main():
         if "rate" in result:
             rec = stamp_tunnel_weather(device_record(result, probe=probe),
                                        probe, shape=(B, nf, nt))
+            _trace_flush()
             print(json.dumps(rec))
             return
         err = result.get(
@@ -709,6 +754,7 @@ def main():
         "vs_baseline": 0.0, "error": err, "probe": probe,
         "baseline": baseline,
     }
+    _trace_flush()
     print(json.dumps(zero_rec), flush=True)
     if device_lock is None:
         # the holder is (almost certainly) a single-flight capture whose

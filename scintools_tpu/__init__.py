@@ -18,12 +18,14 @@ interleaved into compute (dynspec.py:29), the layers here are:
     parallel/  mesh + sharding policy, padded batch pipeline
     io/        psrflux / par / results / adapters (host-side)
     astro/     analytic ephemeris (no astropy dependency)
+    obs/       tracing & metrics (spans, counters, JSONL trace sink)
     pipeline   thin stateful Dynspec wrapper preserving the reference UX
     plotting   matplotlib views, consuming results only
 """
 
 __version__ = "0.1.0"
 
+from . import obs  # noqa: F401  (tracing/metrics; no-op until enabled)
 from .backend import jax_available, resolve, xp  # noqa: F401
 from .data import ArcFit, DynspecData, ScintParams, SecSpec  # noqa: F401
 from .pipeline import Dynspec, fit_arc_campaign, sort_dyn  # noqa: F401

@@ -19,6 +19,8 @@ import glob
 import json
 import sys
 
+from . import obs
+
 
 def _expand(patterns: list[str]) -> list[str]:
     from .utils import remove_duplicates
@@ -36,7 +38,7 @@ def cmd_info(args) -> int:
     rc = 0
     for fn in _expand(args.files):
         try:
-            Dynspec(filename=fn, process=False).info()
+            print(Dynspec(filename=fn, process=False).info())
         except Exception as e:
             print(f"{fn}: unreadable ({e!r})", file=sys.stderr)
             rc = 1
@@ -220,6 +222,7 @@ def cmd_process(args) -> int:
                       eta=row.get("betaeta", row.get("eta")))
         except Exception as e:  # quarantine; keep the batch going
             failed += 1
+            obs.inc("epochs_failed")
             log_event(log, "epoch_failed", file=fn, error=repr(e))
     if store is not None and args.results:
         store.export_csv(args.results,
@@ -265,6 +268,7 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
                 names.append(fn)
             except Exception as e:
                 failed += 1
+                obs.inc("epochs_failed")
                 log_event(log, "epoch_failed", file=fn, error=repr(e))
     processed = 0
     if epochs:
@@ -434,6 +438,7 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
                                     "tilt")]
                 if fitvals and not np.all(np.isfinite(fitvals)):
                     failed += 1
+                    obs.inc("epochs_failed")
                     log_event(log, "epoch_failed", file=names[idx],
                               error="non-finite fit (NaN lane)")
                     continue
@@ -761,6 +766,19 @@ def cmd_wavefield(args) -> int:
     return rc
 
 
+def cmd_trace_report(args) -> int:
+    """Aggregate a JSONL trace (written by ``--trace``) into the
+    per-stage count/total/p50/p95 table plus counters."""
+    try:
+        print(obs.report(args.tracefile))
+    except (OSError, UnicodeDecodeError) as e:
+        # UnicodeDecodeError: a binary file (e.g. a .dynspec passed by
+        # mistake) must fail with a one-line error, not a traceback
+        print(f"{args.tracefile}: unreadable ({e})", file=sys.stderr)
+        return 1
+    return 0
+
+
 def cmd_bench(args) -> int:
     # bench.py lives at the repo root (the driver contract), not in the
     # installed package: load it by path relative to this package, falling
@@ -768,6 +786,16 @@ def cmd_bench(args) -> int:
     import importlib.util
     import os
 
+    if getattr(args, "trace", None):
+        # bench runs its fallback/probe phases in fresh subprocesses
+        # that enable tracing from this env var (bench._maybe_enable_
+        # trace); without it the subprocess spans of the very run being
+        # diagnosed would silently miss the trace file.  Unconditional
+        # assignment: an ambient SCINT_BENCH_TRACE from earlier
+        # experimentation must not divert this run's spans elsewhere.
+        # abspath: the fallback subprocess runs with cwd=repo-root, so a
+        # relative path would silently split the trace across two files.
+        os.environ["SCINT_BENCH_TRACE"] = os.path.abspath(args.trace)
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     path = os.path.join(root, "bench.py")
     if os.path.exists(path):
@@ -790,6 +818,11 @@ def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="scintools-tpu",
         description="TPU-native pulsar scintillation analysis")
+    p.add_argument("--trace", metavar="OUT.jsonl", default=None,
+                   help="enable pipeline tracing for this invocation and "
+                        "append span/counter events (one JSON per line) "
+                        "to this file; inspect with "
+                        "`scintools-tpu trace report OUT.jsonl`")
     sub = p.add_subparsers(dest="command", required=True)
 
     q = sub.add_parser("info", help="print observation metadata")
@@ -949,6 +982,15 @@ def build_parser() -> argparse.ArgumentParser:
 
     q = sub.add_parser("bench", help="run the headline benchmark")
     q.set_defaults(fn=cmd_bench)
+
+    q = sub.add_parser("trace",
+                       help="inspect JSONL traces written by --trace")
+    tsub = q.add_subparsers(dest="trace_command", required=True)
+    r = tsub.add_parser("report",
+                        help="per-stage span table (count/total/p50/p95) "
+                             "+ counters from a trace file")
+    r.add_argument("tracefile", help="JSONL trace written by --trace")
+    r.set_defaults(fn=cmd_trace_report)
     return p
 
 
@@ -957,7 +999,18 @@ def main(argv: list[str] | None = None) -> int:
 
     honor_platform_env()
     args = build_parser().parse_args(argv)
-    return args.fn(args)
+    if args.trace:
+        try:
+            obs.enable(jsonl=args.trace)
+        except OSError as e:
+            print(f"--trace {args.trace}: cannot open ({e})",
+                  file=sys.stderr)
+            return 1
+    try:
+        return args.fn(args)
+    finally:
+        if args.trace:
+            obs.disable()  # flush counters + close the JSONL sink
 
 
 if __name__ == "__main__":  # pragma: no cover

@@ -43,6 +43,7 @@ import numpy as np
 from scipy.ndimage import map_coordinates
 from scipy.signal import savgol_filter
 
+from .. import obs
 from ..backend import resolve
 from ..data import ArcFit, SecSpec
 from ..models.parabola import fit_log_parabola, fit_parabola
@@ -268,6 +269,7 @@ def _attach_arms(fit: ArcFit, left_fn, right_fn) -> ArcFit:
                                eta_right=er, etaerr_right=eer)
 
 
+@obs.traced("fit.arc")
 def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
             delmax=None, numsteps: int = 10000, startbin: int = 3,
             cutmid: int = 3, etamax=None, etamin=None,

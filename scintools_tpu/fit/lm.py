@@ -21,6 +21,8 @@ from typing import Any, Callable, Sequence
 
 import numpy as np
 
+from .. import obs
+
 
 @dataclasses.dataclass(frozen=True)
 class LsqResult:
@@ -71,11 +73,22 @@ def least_squares_numpy(residual_fn: Callable, p0, bounds=None,
         hi_in = np.where(np.isfinite(hi), hi - 1e-12, hi)
         lo_in = np.where(np.isfinite(lo), lo + 1e-12, lo)
         p0 = np.clip(p0, lo_in, hi_in)
-    sol = _ls(lambda p: np.asarray(residual_fn(p, *args), dtype=np.float64),
-              p0, bounds=(lo, hi))
+    with obs.span("fit.lsq_numpy") as sp:
+        sol = _ls(lambda p: np.asarray(residual_fn(p, *args),
+                                       dtype=np.float64),
+                  p0, bounds=(lo, hi))
+        cost = 0.5 * sol.fun @ sol.fun
+        if obs.enabled():
+            # data-dependent convergence accounting (the jax path is
+            # fixed-iteration by construction: its count is the lm_steps
+            # counter recorded per executed batch by the driver)
+            sp.set(nfev=int(sol.nfev), status=int(sol.status),
+                   cost=float(cost))
+            obs.inc("lsq_nfev", int(sol.nfev))
+            obs.inc("lsq_fits")
     cov, redchi = _covariance(np, sol.jac, sol.fun, p0.size)
     return LsqResult(params=sol.x, stderr=np.sqrt(np.abs(np.diag(cov))),
-                     cov=cov, redchi=redchi, cost=0.5 * sol.fun @ sol.fun)
+                     cov=cov, redchi=redchi, cost=cost)
 
 
 def lm_fit_jax(residual_fn: Callable, p0, bounds=None, args: Sequence = (),

@@ -22,6 +22,7 @@ import functools
 
 import numpy as np
 
+from .. import obs
 from ..backend import resolve
 from ..data import ScintParams
 from ..models.acf_models import scint_acf_model
@@ -98,31 +99,50 @@ def fit_scint_params(acf2d, dt, df, nchan: int, nsub: int,
         raise ValueError(
             "ACF cuts contain non-finite values — refill/zap the "
             "dynamic spectrum before fitting scintillation parameters")
-    if backend == "numpy":
-        tau0, dnu0, amp0, wn0 = initial_guesses(x_t, y_t, x_f, y_f, xp=np)
-        y = np.concatenate([y_t, y_f])
-        free = alpha is None
+    with obs.span("fit.scint", backend=backend) as sp:
+        if backend == "numpy":
+            tau0, dnu0, amp0, wn0 = initial_guesses(x_t, y_t, x_f, y_f,
+                                                    xp=np)
+            y = np.concatenate([y_t, y_f])
+            free = alpha is None
 
-        def resid(p):
-            a_ = p[4] if free else alpha
-            return y - scint_acf_model(x_t, x_f, p[0], p[1], p[2], p[3], a_,
-                                       xp=np)
+            def resid(p):
+                a_ = p[4] if free else alpha
+                return y - scint_acf_model(x_t, x_f, p[0], p[1], p[2],
+                                           p[3], a_, xp=np)
 
-        p0 = [tau0, dnu0, amp0, wn0] + ([_ALPHA_KOLMOGOROV] if free else [])
-        # tiny positive floors keep tau/dnu off the singular boundary
-        lo = [1e-10, 1e-10, 0.0, 0.0] + ([0.0] if free else [])
-        hi = [np.inf] * 4 + ([8.0] if free else [])
-        res = least_squares_numpy(resid, np.asarray(p0), bounds=(lo, hi))
-        return _to_scint_params(res, alpha, np)
-
-    return _fit_scint_jax(alpha, steps, False)(acf2d, float(dt), float(df),
-                                               nchan, nsub)
+            p0 = ([tau0, dnu0, amp0, wn0]
+                  + ([_ALPHA_KOLMOGOROV] if free else []))
+            # tiny positive floors keep tau/dnu off the singular boundary
+            lo = [1e-10, 1e-10, 0.0, 0.0] + ([0.0] if free else [])
+            hi = [np.inf] * 4 + ([8.0] if free else [])
+            res = least_squares_numpy(resid, np.asarray(p0),
+                                      bounds=(lo, hi))
+            out = _to_scint_params(res, alpha, np)
+        else:
+            obs.inc("lm_steps", steps)
+            out = obs.fence(_fit_scint_jax(alpha, steps, False)(
+                acf2d, float(dt), float(df), nchan, nsub))
+        if obs.enabled():
+            # convergence residual (an eager device sync, so only when
+            # someone is watching); both branches set out.redchi
+            try:
+                sp.set(redchi=float(np.asarray(out.redchi)))
+            except Exception:
+                pass
+    return out
 
 
 def fit_scint_params_batch(acf2d_batch, dt, df, nchan: int, nsub: int,
                            alpha: float | None = _ALPHA_KOLMOGOROV,
                            steps: int = 20) -> ScintParams:
-    """Batched jax fit: acf2d [B, 2nf, 2nt], dt/df scalars or [B]."""
+    """Batched jax fit: acf2d [B, 2nf, 2nt], dt/df scalars or [B].
+
+    No ``lm_steps`` accounting here: this entry point runs at TRACE time
+    inside the batched step, where a counter would fire once per compile
+    and undercount steady-state executions — run_pipeline increments
+    ``lm_steps`` host-side per executed batch instead.
+    """
     import jax.numpy as jnp
 
     dt = jnp.broadcast_to(jnp.asarray(dt, dtype=jnp.result_type(float)),
@@ -198,7 +218,9 @@ def fit_scint_params_from_dyn(dyn_batch, dt, df,
                               steps: int = 20,
                               cuts_method: str = "fft") -> ScintParams:
     """tau/dnu fits for a [B, nf, nt] dynspec batch via direct ACF cuts
-    (identical results to the 2-D-ACF route; much less FFT work)."""
+    (identical results to the 2-D-ACF route; much less FFT work).
+    Like :func:`fit_scint_params_batch`, no trace-time ``lm_steps``
+    accounting here — the driver counts per executed batch."""
     import jax.numpy as jnp
 
     dt = jnp.broadcast_to(jnp.asarray(dt, dtype=jnp.result_type(float)),

@@ -1,0 +1,30 @@
+"""scintools_tpu.obs — pipeline-wide tracing & metrics.
+
+Spans (nested, monotonic-clock, thread-safe), counters/gauges
+(``epochs_processed``, ``bytes_h2d``, ``jit_cache_miss``, ...),
+JAX-aware compile-vs-execute accounting with block-until-ready fencing,
+and pluggable sinks (key=value logger, JSONL trace file, in-process
+registry with a per-stage ``summary()``).
+
+Usage::
+
+    from scintools_tpu import obs
+
+    obs.enable(jsonl="trace.jsonl")        # or: with obs.tracing(...):
+    with obs.span("my.stage", epochs=8):
+        ...
+    obs.inc("epochs_processed", 8)
+    print(obs.render_summary())
+    obs.disable()
+
+Disabled (the default), every hook is a single flag check — see
+docs/observability.md for the span taxonomy and the trace CLI.
+"""
+
+from .core import (Registry, counters, disable, enable,  # noqa: F401
+                   enabled, flush, gauge, get_registry, inc,
+                   render_summary, reset, span, summary, traced, tracing)
+from .jax_helpers import (bytes_of, fence,  # noqa: F401
+                          instrument_jit)
+from .report import aggregate, load_events, render, report  # noqa: F401
+from .sinks import JsonlSink, LogSink  # noqa: F401

@@ -1,0 +1,366 @@
+"""Tracing/metrics core: spans, counters, gauges, and the in-process
+registry (ISSUE 1 tentpole; the decomposable-timing layer SURVEY.md §5
+"tracing" planned and GPU pulsar-search practice — arXiv:1711.10855 —
+demands before any perf work).
+
+Design constraints, in order:
+
+1. **Disabled cost is one flag check.**  ``span()``/``inc()`` test a
+   module-level bool first; disabled ``span()`` returns one shared
+   ``_NULL_SPAN`` singleton (no allocation, enter/exit are constant
+   methods), disabled ``inc()`` returns immediately.  Verified by
+   tests/test_obs.py::test_disabled_span_is_shared_noop.
+2. **Thread-safe collection.**  The registry mutates under one lock;
+   span nesting uses a thread-local stack, so concurrent pipeline
+   drivers / bench watchdog threads cannot corrupt each other's paths.
+3. **jax-free.**  Importing this module never imports jax (device
+   helpers live in :mod:`scintools_tpu.obs.jax_helpers`).
+
+Spans are host-side wall-clock (``time.perf_counter``) regions.  Device
+work dispatched asynchronously inside a span is only charged to it when
+the caller fences (see ``jax_helpers.fence`` /
+``jax_helpers.instrument_jit``, which block_until_ready before the span
+closes) — raw spans around un-fenced jax dispatch measure dispatch, and
+say nothing about device time.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+
+# Single source of the enabled flag.  Read via enabled()/the fast-path
+# checks below; written only by enable()/disable() under _LOCK.
+_ENABLED = False
+_LOCK = threading.RLock()
+_TLS = threading.local()
+
+# bounded in-process event history (tests / summary drill-down); the
+# per-name duration lists in the registry are what summary() reads
+_EVENT_HISTORY = 65536
+
+
+def _span_stack() -> list:
+    stack = getattr(_TLS, "stack", None)
+    if stack is None:
+        stack = _TLS.stack = []
+    return stack
+
+
+class _NullSpan:
+    """The disabled-mode span: a shared, stateless context manager."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Span:
+    """One timed region.  Use via ``with span(name, **attrs):``.
+
+    ``path`` is the '/'-joined nesting path ("pipeline.run/pipeline.stage")
+    assigned at __enter__ from this thread's span stack; ``name`` is the
+    aggregation key (``summary()`` groups by name, so the same stage
+    reached through different parents still lands in one table row).
+    """
+
+    __slots__ = ("name", "attrs", "path", "dur_ms", "_t0")
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+        self.path = name
+        self.dur_ms = None
+        self._t0 = 0.0
+
+    def set(self, **attrs) -> "Span":
+        """Attach attributes discovered inside the region (fit residuals,
+        iteration counts, ...) before the span closes."""
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "Span":
+        stack = _span_stack()
+        if stack:
+            self.path = stack[-1].path + "/" + self.name
+        stack.append(self)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.dur_ms = (time.perf_counter() - self._t0) * 1e3
+        stack = _span_stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        elif self in stack:  # unbalanced exit (generator half-closed)
+            stack.remove(self)
+        if exc_type is not None:
+            self.attrs.setdefault("error", exc_type.__name__)
+        _REGISTRY.record_span(self)
+        return False
+
+
+class Registry:
+    """Thread-safe in-memory aggregation + fan-out to attached sinks."""
+
+    def __init__(self):
+        self._durs: dict[str, list] = {}
+        self._counters: dict[str, float] = {}
+        self._flushed: dict[str, float] = {}
+        self._gauges: dict[str, float] = {}
+        self._events = deque(maxlen=_EVENT_HISTORY)
+        self._sinks: list = []
+
+    # -- collection --------------------------------------------------------
+    def record_span(self, span: Span) -> None:
+        event = {"ts": time.time(), "kind": "span", "name": span.name,
+                 "path": span.path, "dur_ms": round(span.dur_ms, 6),
+                 "attrs": span.attrs}
+        with _LOCK:
+            self._durs.setdefault(span.name, []).append(span.dur_ms)
+            self._events.append(event)
+            sinks = list(self._sinks)
+        for s in sinks:
+            s.emit(event)
+
+    def inc(self, name: str, value=1) -> None:
+        with _LOCK:
+            self._counters[name] = self._counters.get(name, 0) + value
+
+    def gauge(self, name: str, value) -> None:
+        with _LOCK:
+            self._gauges[name] = value
+
+    def add_sink(self, sink) -> None:
+        with _LOCK:
+            self._sinks.append(sink)
+
+    def remove_sink(self, sink) -> None:
+        with _LOCK:
+            if sink in self._sinks:
+                self._sinks.remove(sink)
+
+    # -- readout -----------------------------------------------------------
+    def events(self) -> list:
+        with _LOCK:
+            return list(self._events)
+
+    def counters(self) -> dict:
+        with _LOCK:
+            return dict(self._counters)
+
+    def gauges(self) -> dict:
+        with _LOCK:
+            return dict(self._gauges)
+
+    def span_names(self) -> list:
+        with _LOCK:
+            return list(self._durs)
+
+    def summary(self) -> dict:
+        """Per-stage stats: {name: {count, total_ms, mean_ms, p50_ms,
+        p95_ms}}, insertion-ordered (first occurrence first)."""
+        with _LOCK:
+            durs = {k: list(v) for k, v in self._durs.items()}
+        return {name: summarize_durations(d) for name, d in durs.items()}
+
+    def flush(self) -> None:
+        """Push counter DELTAS since the last flush (and current gauges)
+        to the sinks, then flush them.  Deltas — not totals — so a
+        process that flushes more than once (bench flushes at its exit
+        points AND inside device_throughput for the fallback subprocess)
+        never double-counts: ``trace report`` sums counter events, and a
+        sum of deltas is the true total."""
+        with _LOCK:
+            sinks = list(self._sinks)
+            deltas = {name: value - self._flushed.get(name, 0)
+                      for name, value in self._counters.items()
+                      if value != self._flushed.get(name, 0)}
+            self._flushed.update(self._counters)
+            gauges = dict(self._gauges)
+        now = time.time()
+        for s in sinks:
+            for name, value in deltas.items():
+                s.emit({"ts": now, "kind": "counter", "name": name,
+                        "value": value})
+            for name, value in gauges.items():
+                s.emit({"ts": now, "kind": "gauge", "name": name,
+                        "value": value})
+            s.flush()
+
+    def reset(self) -> None:
+        with _LOCK:
+            self._durs.clear()
+            self._counters.clear()
+            self._flushed.clear()
+            self._gauges.clear()
+            self._events.clear()
+
+
+def _quantile(sorted_durs: list, q: float) -> float:
+    """Nearest-rank quantile of an already-sorted list (stdlib-only)."""
+    i = int(round(q * (len(sorted_durs) - 1)))
+    return sorted_durs[min(max(i, 0), len(sorted_durs) - 1)]
+
+
+def summarize_durations(durs: list) -> dict:
+    s = sorted(durs)
+    total = sum(s)
+    return {"count": len(s),
+            "total_ms": round(total, 3),
+            "mean_ms": round(total / len(s), 3),
+            "p50_ms": round(_quantile(s, 0.50), 3),
+            "p95_ms": round(_quantile(s, 0.95), 3)}
+
+
+_REGISTRY = Registry()
+
+
+# ---------------------------------------------------------------------------
+# module-level API (the fast path)
+# ---------------------------------------------------------------------------
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def span(name: str, **attrs):
+    """A timed region.  Disabled: the shared no-op singleton (the flag
+    check is the entire cost).  Enabled: a fresh :class:`Span`."""
+    if not _ENABLED:
+        return _NULL_SPAN
+    return Span(name, attrs)
+
+
+def inc(name: str, value=1) -> None:
+    """Add to a named counter (no-op when disabled)."""
+    if _ENABLED:
+        _REGISTRY.inc(name, value)
+
+
+def gauge(name: str, value) -> None:
+    """Set a named gauge to its latest value (no-op when disabled)."""
+    if _ENABLED:
+        _REGISTRY.gauge(name, value)
+
+
+def get_registry() -> Registry:
+    return _REGISTRY
+
+
+def traced(name: str):
+    """Decorator form of :func:`span` for whole-function stages.
+
+    Disabled cost is one flag check in the wrapper; enabled, the call
+    runs inside a span named ``name``.
+    """
+    import functools
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not _ENABLED:
+                return fn(*args, **kwargs)
+            with Span(name, {}):
+                return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def enable(jsonl: str | None = None, log: bool = False,
+           logger=None) -> None:
+    """Turn tracing on, optionally attaching sinks.
+
+    ``jsonl=`` appends one JSON event per line to that path (idempotent:
+    enabling twice with the same path attaches one sink).  ``log=True``
+    mirrors spans onto the key=value logger (``logger=`` overrides the
+    default channel).
+    """
+    global _ENABLED
+    import os
+
+    from .sinks import JsonlSink, LogSink
+
+    with _LOCK:
+        # dedupe on the RESOLVED path: the CLI and bench may name the
+        # same file with different spellings (relative vs absolute)
+        if jsonl is not None and not any(
+                isinstance(s, JsonlSink)
+                and os.path.abspath(s.path) == os.path.abspath(jsonl)
+                for s in _REGISTRY._sinks):
+            _REGISTRY.add_sink(JsonlSink(jsonl))
+        if log and not any(isinstance(s, LogSink)
+                           for s in _REGISTRY._sinks):
+            _REGISTRY.add_sink(LogSink(logger))
+        _ENABLED = True
+
+
+def disable(flush: bool = True) -> None:
+    """Turn tracing off; by default flush counters to (and close) every
+    attached sink.  The in-memory registry keeps its data until
+    ``reset()`` so post-run ``summary()`` still works."""
+    global _ENABLED
+    with _LOCK:
+        _ENABLED = False
+        sinks = list(_REGISTRY._sinks)
+    if flush:
+        _REGISTRY.flush()
+    for s in sinks:
+        _REGISTRY.remove_sink(s)
+        close = getattr(s, "close", None)
+        if close is not None:
+            close()
+
+
+@contextlib.contextmanager
+def tracing(jsonl: str | None = None, log: bool = False, reset: bool = True):
+    """Scoped tracing for tests/benchmarks::
+
+        with obs.tracing(jsonl="run.jsonl"):
+            run_pipeline(epochs, cfg)
+        print(obs.render_summary())
+    """
+    if reset:
+        _REGISTRY.reset()
+    enable(jsonl=jsonl, log=log)
+    try:
+        yield _REGISTRY
+    finally:
+        disable()
+
+
+def summary() -> dict:
+    return _REGISTRY.summary()
+
+
+def counters() -> dict:
+    return _REGISTRY.counters()
+
+
+def reset() -> None:
+    _REGISTRY.reset()
+
+
+def flush() -> None:
+    _REGISTRY.flush()
+
+
+def render_summary() -> str:
+    """The per-stage table + counters for the CURRENT in-process registry
+    (same renderer as ``trace report``)."""
+    from .report import render
+
+    return render(summary(), counters(), gauges=_REGISTRY.gauges())

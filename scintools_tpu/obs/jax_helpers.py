@@ -1,0 +1,160 @@
+"""JAX-aware tracing helpers: honest device timing and compile/execute
+split.
+
+jit dispatch is asynchronous — a host span around an un-fenced jit call
+measures dispatch latency, not device work — and a jit entry point's
+first call bundles trace+compile with execution.  Two helpers fix both:
+
+* :func:`fence` — block_until_ready when tracing is enabled (identity
+  otherwise, and a transparent pass-through for tracers / non-arrays),
+  so span-closed == work-done.
+* :func:`instrument_jit` — wraps a jit'd callable so each distinct input
+  signature records a ``<name>.compile`` span (``fn.lower().compile()``
+  — trace+compile only, no execution) and every call records a
+  ``<name>.execute`` span fenced on completion, plus a
+  ``jit_cache_miss`` counter per fresh signature.  Disabled tracing
+  short-circuits to the raw callable: identical dispatch path, identical
+  results (the AOT executable and the jit cache compile the same
+  program, asserted bit-identical by tests/test_obs.py).
+"""
+
+from __future__ import annotations
+
+from . import core
+
+# wrapper memo keyed by id(fn); the wrapper closes over fn (strong ref),
+# so the id cannot be recycled while the entry lives.  Steps from
+# parallel.make_pipeline are themselves lru_cached, so repeated
+# run_pipeline calls reuse one wrapper (and its compiled-executable
+# cache) per step.
+_WRAPPERS: dict = {}
+
+
+def fence(value):
+    """block_until_ready(value) when tracing is enabled; returns value.
+
+    Safe on pytrees, numpy arrays, and jax tracers (no-op for anything
+    that cannot block).
+    """
+    if not core.enabled():
+        return value
+    try:
+        import jax
+
+        return jax.block_until_ready(value)
+    except Exception:
+        return value
+
+
+def bytes_of(tree) -> int:
+    """Total nbytes over a pytree's array leaves (host or device) — the
+    unit of the ``bytes_h2d`` transfer counter."""
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(tree)
+    except Exception:
+        leaves = [tree]
+    return int(sum(getattr(x, "nbytes", 0) for x in leaves))
+
+
+def _signature(args, kwargs):
+    """Shape/dtype signature of a call — the jit-cache key proxy."""
+    try:
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        sig = [str(treedef)]
+    except Exception:
+        leaves, sig = list(args) + sorted(kwargs.items()), []
+    for x in leaves:
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            sig.append((tuple(x.shape), str(x.dtype)))
+        else:
+            sig.append(repr(x))
+    return tuple(sig)
+
+
+def instrument_jit(fn, name: str):
+    """Wrap jit'd ``fn`` with compile/execute span accounting.
+
+    Cheap when tracing is disabled (one flag check, then the raw
+    callable).  Memoised on ``fn`` so the compiled-executable cache
+    survives across calls; wrapping the same function twice returns the
+    same wrapper (first name wins).
+    """
+    cached = _WRAPPERS.get(id(fn))
+    if cached is not None and cached.__wrapped__ is fn:
+        return cached
+
+    compiled_cache: dict = {}
+
+    def traced_call(*args, **kwargs):
+        import jax
+
+        key = _signature(args, kwargs)
+        compiled = compiled_cache.get(key)
+        if compiled is None:
+            core.inc("jit_cache_miss")
+            compiled = _compile(key, *args, **kwargs)
+        if compiled is fn:
+            # no AOT path: the first (compiling) call was already timed
+            # and executed inside _compile; later calls land here
+            with core.span(name + ".execute"):
+                out = fn(*args, **kwargs)
+                jax.block_until_ready(out)
+            return out
+        if isinstance(compiled, tuple):  # first call's output rides along
+            compiled_cache[key] = compiled[0] if compiled[0] is not None \
+                else fn
+            return compiled[1]
+        try:
+            with core.span(name + ".execute"):
+                out = compiled(*args, **kwargs)
+                jax.block_until_ready(out)
+            return out
+        except Exception:
+            # AOT executables can be stricter about input placement than
+            # jit; fall back rather than fail the pipeline, and remember
+            # the fallback so later calls do not re-pay the failed
+            # dispatch.  The failed .execute span records with an error
+            # attr; the fallback runs under a .compile span (it pays
+            # jit's trace+compile) so execute rows stay uncontaminated.
+            compiled_cache[key] = fn
+            with core.span(name + ".compile", signature=str(key)[:200],
+                           includes_first_execute=True):
+                out = fn(*args, **kwargs)
+                jax.block_until_ready(out)
+            return out
+
+    def _compile(key, *args, **kwargs):
+        import jax
+
+        lower = getattr(fn, "lower", None)
+        if lower is not None:
+            try:
+                with core.span(name + ".compile",
+                               signature=str(key)[:200]):
+                    executable = lower(*args, **kwargs).compile()
+                compiled_cache[key] = executable
+                return executable
+            except Exception:
+                pass
+        # fallback (non-jit callable / lowering unsupported): the first
+        # call IS trace+compile+execute; record it as compile so the
+        # steady-state .execute rows stay uncontaminated
+        with core.span(name + ".compile", signature=str(key)[:200],
+                       includes_first_execute=True):
+            out = fn(*args, **kwargs)
+            jax.block_until_ready(out)
+        return (None, out)
+
+    def wrapper(*args, **kwargs):
+        if not core.enabled():
+            return fn(*args, **kwargs)
+        return traced_call(*args, **kwargs)
+
+    wrapper.__wrapped__ = fn
+    wrapper.__name__ = getattr(fn, "__name__", name)
+    _WRAPPERS[id(fn)] = wrapper
+    return wrapper

@@ -1,0 +1,94 @@
+"""Trace readout: JSONL -> per-stage table (count/total/p50/p95).
+
+One renderer serves both the in-process ``obs.render_summary()`` and the
+``scintools-tpu trace report out.jsonl`` CLI, so a live run and its
+persisted trace read identically.
+"""
+
+from __future__ import annotations
+
+import json
+
+from .core import summarize_durations
+
+
+def load_events(path: str) -> list:
+    """Parse a JSONL trace, skipping non-JSON noise lines (a trace file
+    may interleave with logger output when both target one stream)."""
+    events = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(ev, dict):
+                events.append(ev)
+    return events
+
+
+def aggregate(events: list) -> tuple:
+    """(spans, counters, gauges): spans is {name: {count, total_ms,
+    mean_ms, p50_ms, p95_ms}} keyed in first-appearance order; counters
+    sum across events (a multi-run trace file accumulates); gauges keep
+    the last value."""
+    durs: dict[str, list] = {}
+    counters: dict[str, float] = {}
+    gauges: dict[str, float] = {}
+    for ev in events:
+        kind = ev.get("kind", "span")
+        name = ev.get("name")
+        if name is None:
+            continue
+        if kind == "span" and isinstance(ev.get("dur_ms"), (int, float)):
+            durs.setdefault(name, []).append(float(ev["dur_ms"]))
+        elif kind == "counter" and isinstance(ev.get("value"),
+                                              (int, float)):
+            counters[name] = counters.get(name, 0) + ev["value"]
+        elif kind == "gauge" and "value" in ev:
+            gauges[name] = ev["value"]
+    spans = {name: summarize_durations(d) for name, d in durs.items()}
+    return spans, counters, gauges
+
+
+def render(spans: dict, counters: dict | None = None,
+           gauges: dict | None = None) -> str:
+    """Fixed-width per-stage table, longest-total first, then counters."""
+    lines = []
+    if spans:
+        w = max(len("stage"), max(len(n) for n in spans))
+        lines.append(f"{'stage':<{w}}  {'count':>7}  {'total_ms':>12}  "
+                     f"{'mean_ms':>10}  {'p50_ms':>10}  {'p95_ms':>10}")
+        lines.append("-" * (w + 58))
+        order = sorted(spans, key=lambda n: spans[n]["total_ms"],
+                       reverse=True)
+        for name in order:
+            s = spans[name]
+            lines.append(
+                f"{name:<{w}}  {s['count']:>7d}  {s['total_ms']:>12.3f}  "
+                f"{s['mean_ms']:>10.3f}  {s['p50_ms']:>10.3f}  "
+                f"{s['p95_ms']:>10.3f}")
+    else:
+        lines.append("(no spans)")
+    if counters:
+        lines.append("")
+        lines.append("counters:")
+        for name in sorted(counters):
+            v = counters[name]
+            v = int(v) if float(v).is_integer() else v
+            lines.append(f"  {name} = {v}")
+    if gauges:
+        lines.append("")
+        lines.append("gauges:")
+        for name in sorted(gauges):
+            lines.append(f"  {name} = {gauges[name]}")
+    return "\n".join(lines)
+
+
+def report(path: str) -> str:
+    """The ``trace report`` payload for one JSONL trace file."""
+    spans, counters, gauges = aggregate(load_events(path))
+    return render(spans, counters, gauges)

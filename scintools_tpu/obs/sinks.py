@@ -1,0 +1,74 @@
+"""Pluggable trace sinks: JSONL file and the key=value logger.
+
+Every sink receives the same event dicts the in-process registry
+records (``kind`` = "span" | "counter" | "gauge"); the JSONL format is
+the on-disk contract `scintools-tpu trace report` consumes (one JSON
+object per line: ts, kind, name, dur_ms/value, attrs).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+
+class JsonlSink:
+    """Append one JSON event per line to ``path`` (thread-safe).
+
+    Opened in append mode so a multi-command session (or a driver that
+    re-enables tracing) accumulates one decomposable trace; ``trace
+    report`` aggregates across everything in the file.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def emit(self, event: dict) -> None:
+        # default=str: attrs may carry shapes/dtypes/paths — never let a
+        # non-JSON-native attr kill the traced pipeline.  Flushed per
+        # line: event rate is per-stage (not per-sample), and bench.py
+        # exits via os._exit, which would drop a buffered tail.
+        line = json.dumps(event, default=str)
+        with self._lock:
+            if self._fh is not None:
+                self._fh.write(line + "\n")
+                self._fh.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+
+
+class LogSink:
+    """Mirror events onto the structured key=value logger
+    (:func:`scintools_tpu.utils.log.log_event`), so traces interleave
+    with the CLI's existing epoch/resume/routes events."""
+
+    def __init__(self, logger=None):
+        from ..utils.log import get_logger
+
+        self._logger = logger if logger is not None else get_logger()
+
+    def emit(self, event: dict) -> None:
+        from ..utils.log import log_event
+
+        kind = event.get("kind", "span")
+        if kind == "span":
+            fields = {"name": event["name"], "dur_ms": event["dur_ms"]}
+            fields.update(event.get("attrs") or {})
+            log_event(self._logger, "span", **fields)
+        else:
+            log_event(self._logger, kind, name=event["name"],
+                      value=event.get("value"))
+
+    def flush(self) -> None:
+        pass

@@ -16,6 +16,7 @@ import functools
 
 import numpy as np
 
+from .. import obs
 from ..backend import resolve
 
 
@@ -25,9 +26,12 @@ def acf(dyn, backend: str = "numpy", subtract_mean: bool = True):
     shape = np.shape(dyn)  # works for lists and device arrays alike
     if len(shape) < 2 or shape[-2] < 2 or shape[-1] < 2:
         raise ValueError(f"ACF needs at least a 2x2 dynspec, got {shape}")
-    if backend == "numpy":
-        return _acf_numpy(np.asarray(dyn), subtract_mean)
-    return _acf_jax()(dyn, subtract_mean)
+    # eager calls time real (fenced) kernel work; calls under a jit trace
+    # time trace construction inside the enclosing .compile span
+    with obs.span("ops.acf", backend=backend, shape=list(shape)):
+        if backend == "numpy":
+            return _acf_numpy(np.asarray(dyn), subtract_mean)
+        return obs.fence(_acf_jax()(dyn, subtract_mean))
 
 
 def _acf_numpy(arr: np.ndarray, subtract_mean: bool) -> np.ndarray:

@@ -16,13 +16,16 @@ Reference mapping:
 from __future__ import annotations
 
 import functools
+import logging
 
 import numpy as np
 from scipy.interpolate import griddata
 from scipy.signal import medfilt, savgol_filter
 from scipy.spatial import QhullError
 
+from .. import obs
 from ..data import DynspecData
+from ..utils.log import get_logger, log_event
 
 
 def trim_edges(d: DynspecData) -> DynspecData:
@@ -92,6 +95,13 @@ def refill(d: DynspecData, linear: bool = True,
     if not good.any():
         raise ValueError("refill: dynamic spectrum has no finite pixels")
     arr[~good] = np.mean(arr[good])
+    log = get_logger()
+    if obs.enabled() or log.isEnabledFor(logging.DEBUG):
+        n_gaps = int(mask.sum())
+        obs.inc("refill_calls")
+        obs.inc("refill_pixels", n_gaps)
+        log_event(log, "refill", level=logging.DEBUG, n_filled=n_gaps,
+                  shape=f"{arr.shape[0]}x{arr.shape[1]}")
     return d.replace(dyn=arr)
 
 
@@ -245,6 +255,17 @@ def zap(d: DynspecData, method: str = "median", sigma: float = 7,
         dyn[:, bad] = np.nan
     else:
         raise ValueError(f"unknown zap method {method!r}")
+    log = get_logger()
+    if obs.enabled() or log.isEnabledFor(logging.DEBUG):
+        # telemetry only: the NaN scans and float64 view are not worth
+        # paying on the per-epoch hot path when nobody is listening
+        before = np.asarray(d.dyn, dtype=np.float64)
+        n_zapped = max(int(np.isnan(dyn).sum())
+                       - int(np.isnan(before).sum()), 0)
+        obs.inc("zap_calls")
+        obs.inc("zap_pixels", n_zapped)
+        log_event(log, "zap", level=logging.DEBUG, method=method,
+                  sigma=sigma, n_zapped=n_zapped)
     return d.replace(dyn=dyn)
 
 

@@ -28,6 +28,7 @@ import functools
 import numpy as np
 from scipy.signal import convolve2d
 
+from .. import obs
 from ..backend import resolve
 from .windows import apply_2d_window
 
@@ -67,16 +68,22 @@ def sspec(dyn, prewhite: bool = True, window: str | None = "blackman",
         raise ValueError(f"secondary spectrum needs at least a 2x2 "
                          f"dynspec, got {shape} (prewhitening "
                          f"differences both axes)")
-    if backend == "numpy":
-        arr = np.asarray(dyn, dtype=np.float64)
-        if arr.ndim > 2:  # batched: per-epoch (host loop; use jax on device)
-            lead = arr.shape[:-2]
-            flat = arr.reshape((-1,) + arr.shape[-2:])
-            out = np.stack([_sspec_numpy(a, prewhite, window, window_frac, db)
-                            for a in flat])
-            return out.reshape(lead + out.shape[-2:])
-        return _sspec_numpy(arr, prewhite, window, window_frac, db)
-    return _sspec_jax()(dyn, prewhite, window, window_frac, db)
+    # span semantics: eager calls time real kernel work (fenced on the
+    # jax path); calls from inside a jit trace (the batched step) time
+    # TRACE construction and land inside that step's .compile span
+    with obs.span("ops.sspec", backend=backend, shape=list(shape)):
+        if backend == "numpy":
+            arr = np.asarray(dyn, dtype=np.float64)
+            if arr.ndim > 2:  # batched: per-epoch host loop (jax on device)
+                lead = arr.shape[:-2]
+                flat = arr.reshape((-1,) + arr.shape[-2:])
+                out = np.stack([_sspec_numpy(a, prewhite, window,
+                                             window_frac, db)
+                                for a in flat])
+                return out.reshape(lead + out.shape[-2:])
+            return _sspec_numpy(arr, prewhite, window, window_frac, db)
+        return obs.fence(_sspec_jax()(dyn, prewhite, window, window_frac,
+                                      db))
 
 
 def _postdark(nrfft: int, ncfft: int, xp=np):

@@ -34,6 +34,7 @@ from typing import Any
 
 import numpy as np
 
+from .. import obs
 from ..fit.arc_fit import make_arc_fitter
 from ..fit.scint_fit import fit_scint_params_batch
 from ..ops.acf import acf as acf_op
@@ -645,6 +646,16 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
     ``indices`` maps result lanes back to the input epoch order: lane k of
     every [B]-leading result leaf is epoch ``indices[k]`` (divisibility
     pad-lanes are sliced off before returning).
+
+    When :mod:`scintools_tpu.obs` tracing is enabled, each bucket batch
+    records the stage spans ``pipeline.stage`` (host staging: bucketing,
+    padding, step build), ``pipeline.step.compile`` /
+    ``pipeline.step.execute`` (the fused sspec→arc-fit device step, with
+    compile time split from fenced execute time per input signature) and
+    ``pipeline.gather`` (result slicing to host), under one
+    ``pipeline.run`` root, plus ``epochs_processed`` / ``bytes_h2d`` /
+    ``jit_cache_miss`` counters.  Disabled tracing takes the identical
+    dispatch path (tests assert bit-identical results on vs off).
     """
     from .batch import pad_batch
 
@@ -653,39 +664,55 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
         multiple = mesh.shape[mesh_mod.DATA_AXIS]
     chan_sharded = _resolve_chan_sharded(mesh, chan_sharded)
     results = []
-    for idx in _bucket_epochs(epochs).values():
-        group = [epochs[i] for i in idx]
-        batch, _mask = pad_batch(group, batch_multiple=multiple)
-        step = make_pipeline(np.asarray(group[0].freqs),
-                             np.asarray(group[0].times), config, mesh=mesh,
-                             chan_sharded=chan_sharded)
-        dyn = np.asarray(batch.dyn)
-        if config.arc_stack and not np.all(_mask.epoch):
-            # divisibility pad-lanes are COPIES of the last epoch
-            # (pad_batch) — fine for per-epoch results (sliced off
-            # below) but they would bias the campaign stack; NaN-fill
-            # them so the stacked nanmean drops them
-            dyn = dyn.copy()
-            dyn[~_mask.epoch] = np.nan
-        B = dyn.shape[0]
-        if chunk is None or chunk >= B:
-            res = step(_as_global_batch(dyn, mesh, chan_sharded))
-        else:
-            # memory-bounded chunking; chunk must respect mesh divisibility
-            c = _adjust_chunk(multiple, chunk)
-            if c != chunk:
-                import warnings
+    with obs.span("pipeline.run", epochs=len(epochs)):
+        for idx in _bucket_epochs(epochs).values():
+            with obs.span("pipeline.stage", epochs=len(idx)) as stage_sp:
+                group = [epochs[i] for i in idx]
+                batch, _mask = pad_batch(group, batch_multiple=multiple)
+                step = make_pipeline(np.asarray(group[0].freqs),
+                                     np.asarray(group[0].times), config,
+                                     mesh=mesh, chan_sharded=chan_sharded)
+                dyn = np.asarray(batch.dyn)
+                if config.arc_stack and not np.all(_mask.epoch):
+                    # divisibility pad-lanes are COPIES of the last epoch
+                    # (pad_batch) — fine for per-epoch results (sliced off
+                    # below) but they would bias the campaign stack;
+                    # NaN-fill them so the stacked nanmean drops them
+                    dyn = dyn.copy()
+                    dyn[~_mask.epoch] = np.nan
+                stage_sp.set(batch_shape=list(dyn.shape))
+            obs.inc("epochs_processed", len(idx))
+            obs.inc("bytes_h2d", int(dyn.nbytes))
+            # fixed-iteration LM budget actually dispatched for this
+            # batch (host-side: trace-time counters inside the jit'd
+            # step would undercount cached re-executions)
+            n_lm_fits = int(config.fit_scint) + int(config.fit_scint_2d)
+            if n_lm_fits:
+                obs.inc("lm_steps",
+                        config.lm_steps * n_lm_fits * dyn.shape[0])
+            step = obs.instrument_jit(step, "pipeline.step")
+            B = dyn.shape[0]
+            if chunk is None or chunk >= B:
+                res = step(_as_global_batch(dyn, mesh, chan_sharded))
+            else:
+                # memory-bounded chunking; chunk must respect mesh
+                # divisibility
+                c = _adjust_chunk(multiple, chunk)
+                if c != chunk:
+                    import warnings
 
-                warnings.warn(
-                    f"run_pipeline: chunk={chunk} adjusted to {c} (the "
-                    f"mesh's data axis needs multiples of {multiple}); "
-                    "size chunk accordingly when bounding device memory",
-                    stacklevel=2)
-            parts = [step(_as_global_batch(dyn[i:i + c], mesh,
-                                           chan_sharded))
-                     for i in range(0, B, c)]
-            res = _concat_results(parts)
-        results.append((np.asarray(idx), _take_lanes(res, len(idx), B)))
+                    warnings.warn(
+                        f"run_pipeline: chunk={chunk} adjusted to {c} (the "
+                        f"mesh's data axis needs multiples of {multiple}); "
+                        "size chunk accordingly when bounding device "
+                        "memory", stacklevel=2)
+                parts = [step(_as_global_batch(dyn[i:i + c], mesh,
+                                               chan_sharded))
+                         for i in range(0, B, c)]
+                res = _concat_results(parts)
+            with obs.span("pipeline.gather", epochs=len(idx)):
+                results.append((np.asarray(idx),
+                                _take_lanes(res, len(idx), B)))
     return results
 
 

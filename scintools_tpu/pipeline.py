@@ -117,8 +117,12 @@ class Dynspec:
         return Dynspec(data=out, process=False, lamsteps=self.lamsteps,
                        backend=self.backend, verbose=self.verbose)
 
-    def info(self) -> None:
-        print(self._data.info_str())
+    def info(self) -> str:
+        """Human-readable observation metadata.  Returns the string
+        (display is the caller's concern — the CLI ``info`` command
+        prints it; the compute layers stay print-free, enforced by
+        tests/test_no_print.py)."""
+        return self._data.info_str()
 
     def write_file(self, filename: str) -> None:
         """Write the current dynamic spectrum as a psrflux file."""
@@ -619,7 +623,10 @@ def sort_dyn(dynfiles: Sequence[str], outdir: str | None = None,
             good.append(fn)
         except Exception as e:  # quarantine, never crash the batch
             if verbose:
-                print(f"sort_dyn: {fn}: {e}")
+                from .utils.log import get_logger, log_event
+
+                log_event(get_logger(), "sort_dyn_reject", file=fn,
+                          error=repr(e))
             bad.append(fn)
     if outdir is not None:
         os.makedirs(outdir, exist_ok=True)

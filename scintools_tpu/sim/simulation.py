@@ -230,20 +230,38 @@ class Simulation:
         for k, v in derived_constants(p).items():
             setattr(self, k, v)
 
-        if backend == "jax":
-            import jax
+        # progress goes through the structured channel (the reference
+        # prints from its compute loop, scint_sim.py:62-69): one event
+        # per simulation, INFO when verbose= asks for it, DEBUG otherwise
+        import logging
+        import time as _time
 
-            key = jax.random.PRNGKey(0 if seed is None else seed)
-            spe, xyp = simulate(key, p, return_screen=True)
-            self.xyp = np.asarray(xyp)
-            self.spe = np.asarray(spe)
-            # last-frequency full intensity field, kept attribute-compatible
-            # with the numpy path (reference sets it in get_intensity)
-            self.xyi = np.abs(self.spe[:, -1:]) ** 2
-        else:
-            self.xyp = self._screen_numpy(seed)
-            self.spe = self._intensity_numpy()
-        self.spi = np.real(self.spe * np.conj(self.spe))
+        from .. import obs
+        from ..utils.log import get_logger, log_event
+
+        t0 = _time.perf_counter()
+        with obs.span("sim.simulation", backend=backend, nx=p.nx,
+                      ny=p.ny, nf=p.nf):
+            if backend == "jax":
+                import jax
+
+                key = jax.random.PRNGKey(0 if seed is None else seed)
+                spe, xyp = simulate(key, p, return_screen=True)
+                self.xyp = np.asarray(xyp)
+                self.spe = np.asarray(spe)
+                # last-frequency full intensity field, kept
+                # attribute-compatible with the numpy path (reference
+                # sets it in get_intensity)
+                self.xyi = np.abs(self.spe[:, -1:]) ** 2
+            else:
+                self.xyp = self._screen_numpy(seed)
+                self.spe = self._intensity_numpy()
+            self.spi = np.real(self.spe * np.conj(self.spe))
+        obs.inc("screens_simulated")
+        log_event(get_logger(), "sim",
+                  level=logging.INFO if verbose else logging.DEBUG,
+                  backend=backend, nx=p.nx, ny=p.ny, nf=p.nf, mb2=p.mb2,
+                  seed=seed, dur_ms=(_time.perf_counter() - t0) * 1e3)
 
     def _screen_numpy(self, seed) -> np.ndarray:
         """Seeded screen: weights on the signed-frequency grid times a

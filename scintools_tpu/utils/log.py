@@ -5,33 +5,60 @@ through compute methods (dynspec.py:107,155; scint_sim.py:62-69).  Here a
 single std-``logging`` channel with a key=value formatter, so batch
 drivers and the CLI emit grep-able, timestamped events without touching
 the compute layers.
+
+``SCINTOOLS_TPU_LOG`` sets the default level (name or number, e.g.
+``DEBUG`` / ``10``); an explicit ``level=`` argument wins, and — unlike
+the original ``if not logger.handlers`` guard, which silently ignored
+``level`` on every call after the first — the level is (re)applied on
+every :func:`get_logger` call that passes one.
 """
 
 from __future__ import annotations
 
 import logging
+import os
 
 _FORMAT = "%(asctime)s %(levelname)s %(name)s %(message)s"
 
 
-def get_logger(name: str = "scintools_tpu", level=logging.INFO
-               ) -> logging.Logger:
+def _default_level():
+    env = os.environ.get("SCINTOOLS_TPU_LOG", "").strip()
+    if not env:
+        return logging.INFO
+    if env.isdigit():
+        return int(env)
+    return logging.getLevelName(env.upper()) \
+        if isinstance(logging.getLevelName(env.upper()), int) else logging.INFO
+
+
+def get_logger(name: str = "scintools_tpu", level=None) -> logging.Logger:
+    """The shared key=value channel.  ``level=None`` means "leave an
+    already-configured logger alone; initialise a fresh one from
+    ``SCINTOOLS_TPU_LOG`` (default INFO)"."""
     logger = logging.getLogger(name)
     if not logger.handlers:
         h = logging.StreamHandler()
         h.setFormatter(logging.Formatter(_FORMAT, datefmt="%H:%M:%S"))
         logger.addHandler(h)
-        logger.setLevel(level)
+        logger.setLevel(_default_level() if level is None else level)
         logger.propagate = False
+    elif level is not None:
+        # always honour an explicit level, first call or not
+        logger.setLevel(level)
     return logger
 
 
-def log_event(logger: logging.Logger, event: str, **fields) -> None:
-    """Emit ``event key=value ...`` (floats compacted)."""
+def log_event(logger: logging.Logger, event: str, *,
+              level: int = logging.INFO, **fields) -> None:
+    """Emit ``event key=value ...`` (floats compacted).  ``level=`` routes
+    chatty per-operation events (e.g. per-epoch refill/zap stats) to
+    DEBUG so they only appear under ``SCINTOOLS_TPU_LOG=DEBUG``."""
+    if not logger.isEnabledFor(level):
+        return
     parts = [event]
     for k, v in fields.items():
         if isinstance(v, float):
             parts.append(f"{k}={v:.6g}")
         else:
             parts.append(f"{k}={v}")
-    logger.info(" ".join(parts))
+    logger.log(level, " ".join(parts))

@@ -36,21 +36,37 @@ class StageTimers:
     @contextlib.contextmanager
     def stage(self, name: str, block=None):
         """Time a region.  Pass ``block=value_or_pytree`` to synchronise on
-        device completion of that value before stopping the clock."""
+        device completion of that value before stopping the clock.
+
+        Regions are mirrored as ``stage.<name>`` spans into
+        :mod:`scintools_tpu.obs` when tracing is enabled, so CLI stage
+        timers land in ``--trace`` files alongside the pipeline spans.
+        """
+        from .. import obs
+
+        sp = obs.span("stage." + name)
+        sp.__enter__()
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            if block is not None:
-                try:
-                    import jax
+            try:
+                if block is not None:
+                    try:
+                        import jax
 
-                    jax.block_until_ready(block)
-                except ImportError:  # pragma: no cover
-                    pass
-            dt = time.perf_counter() - t0
-            tot, n = self._acc.get(name, (0.0, 0))
-            self._acc[name] = (tot + dt, n + 1)
+                        jax.block_until_ready(block)
+                    except ImportError:  # pragma: no cover
+                        pass
+            finally:
+                # the span must close and the stage must accumulate even
+                # when the device sync raises (async failure surfacing
+                # here), or the leaked span corrupts every later span
+                # path on this thread
+                dt = time.perf_counter() - t0
+                sp.__exit__(None, None, None)
+                tot, n = self._acc.get(name, (0.0, 0))
+                self._acc[name] = (tot + dt, n + 1)
 
     def summary(self) -> dict:
         return {k: {"calls": n, "total_s": round(tot, 6),

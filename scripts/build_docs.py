@@ -31,6 +31,7 @@ PAGES = [
     ("docs/overview.md", "overview", "Architecture overview"),
     ("docs/api.md", "api", "API reference"),
     ("docs/performance.md", "performance", "Performance & roofline"),
+    ("docs/observability.md", "observability", "Tracing & metrics"),
     ("docs/migrating.md", "migrating", "Migrating from scintools"),
     ("docs/wavefield.md", "wavefield", "Wavefield holography"),
     ("docs/roadmap.md", "roadmap", "Roadmap / build log"),

@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Repo lint: no ``print(`` in ``scintools_tpu/`` outside the two
+display modules (plotting.py, cli.py).
+
+The observability layer (scintools_tpu.obs spans/counters + the
+utils.log key=value channel) is the ONLY reporting channel for compute
+code; a stray print in an op or fitter bypasses sinks, corrupts
+machine-readable CLI stdout (the bench/sim/sort commands print JSON
+records), and is invisible to `trace report`.  Enforced in tier-1 via
+tests/test_no_print.py.
+
+Token-based, not regex: string literals and comments mentioning print()
+(docstrings quoting the reference's behaviour) are fine; only a real
+NAME token ``print`` in code counts.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import sys
+import tokenize
+
+# display modules: stdout IS their output channel
+ALLOWED = {"plotting.py", "cli.py"}
+
+
+def find_prints(path: str) -> list:
+    """(line, text) of every real ``print`` name token in a source file."""
+    with open(path, "rb") as fh:
+        src = fh.read()
+    hits = []
+    try:
+        tokens = tokenize.tokenize(io.BytesIO(src).readline)
+        for tok in tokens:
+            if tok.type == tokenize.NAME and tok.string == "print":
+                hits.append((tok.start[0], tok.line.strip()))
+    except tokenize.TokenError:  # pragma: no cover - unparseable file
+        hits.append((0, "TokenError: could not tokenize"))
+    return hits
+
+
+def check_tree(pkg_dir: str) -> list:
+    """All offending (path, line, text) under ``pkg_dir``."""
+    offenders = []
+    for root, _dirs, files in os.walk(pkg_dir):
+        for name in sorted(files):
+            if not name.endswith(".py") or name in ALLOWED:
+                continue
+            path = os.path.join(root, name)
+            for line, text in find_prints(path):
+                offenders.append((os.path.relpath(path, pkg_dir), line,
+                                  text))
+    return offenders
+
+
+def main() -> int:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = os.path.join(here, "scintools_tpu")
+    offenders = check_tree(pkg)
+    for path, line, text in offenders:
+        sys.stderr.write(f"{path}:{line}: print() in compute path "
+                         f"(use scintools_tpu.obs / utils.log): "
+                         f"{text}\n")
+    if offenders:
+        sys.stderr.write(f"{len(offenders)} print() call(s) outside "
+                         f"{sorted(ALLOWED)}\n")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

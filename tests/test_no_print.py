@@ -1,0 +1,30 @@
+"""Tier-1 lint: the observability layer stays the only reporting channel
+— no ``print(`` in ``scintools_tpu/`` outside plotting.py / cli.py
+(scripts/check_no_print.py, token-based so docstrings may quote the
+reference's prints)."""
+
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(_HERE), "scripts"))
+
+import check_no_print  # noqa: E402
+
+
+def test_no_print_in_compute_path():
+    pkg = os.path.join(os.path.dirname(_HERE), "scintools_tpu")
+    offenders = check_no_print.check_tree(pkg)
+    assert offenders == [], (
+        "print() found outside plotting.py/cli.py — route through "
+        "scintools_tpu.obs spans/counters or utils.log.log_event:\n"
+        + "\n".join(f"  {p}:{ln}: {txt}" for p, ln, txt in offenders))
+
+
+def test_checker_catches_a_real_print(tmp_path):
+    bad = tmp_path / "mod.py"
+    bad.write_text('x = 1\nprint("leak")\n'
+                   '"""a docstring saying print(foo) is fine"""\n'
+                   "# print(comment) ignored too\n")
+    hits = check_no_print.find_prints(str(bad))
+    assert [ln for ln, _ in hits] == [2]

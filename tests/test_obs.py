@@ -1,0 +1,311 @@
+"""scintools_tpu.obs: spans, counters, JSONL round-trip, disabled-mode
+no-op, and the traced batched pipeline (ISSUE 1 tentpole acceptance:
+compile-vs-execute rows in `trace report`, bit-identical results with
+tracing on vs off, stage spans exactly once per epoch batch)."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from synth import synth_arc_epoch
+
+from scintools_tpu import obs
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs_state():
+    """Every test starts and ends with tracing off and an empty registry
+    (obs state is process-global by design)."""
+    obs.disable(flush=False)
+    obs.reset()
+    yield
+    obs.disable(flush=False)
+    obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# core: disabled no-op, nesting, counters
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_span_is_shared_noop():
+    # disabled span() returns ONE shared singleton: no allocation beyond
+    # the flag check, nothing recorded
+    assert not obs.enabled()
+    s1, s2 = obs.span("a", attr=1), obs.span("b")
+    assert s1 is s2
+    with s1 as inside:
+        inside.set(more=2)     # set() is a no-op, not an error
+    obs.inc("epochs_processed", 5)
+    obs.gauge("g", 1.0)
+    assert obs.summary() == {}
+    assert obs.counters() == {}
+    assert obs.get_registry().events() == []
+
+
+def test_disabled_wrapper_paths_record_nothing():
+    # the pipeline's always-installed hooks must stay silent when off
+    @obs.traced("f.stage")
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2
+    assert obs.fence(np.ones(3)).sum() == 3.0
+    assert obs.summary() == {}
+
+
+def test_nested_span_timing_attrs_and_paths():
+    with obs.tracing() as reg:
+        with obs.span("outer", kind="root") as sp_out:
+            time.sleep(0.002)
+            with obs.span("inner") as sp_in:
+                time.sleep(0.001)
+                sp_in.set(found=3)
+    events = {e["name"]: e for e in reg.events()}
+    assert set(events) == {"outer", "inner"}
+    assert events["inner"]["path"] == "outer/inner"
+    assert events["outer"]["path"] == "outer"
+    assert events["outer"]["attrs"] == {"kind": "root"}
+    assert events["inner"]["attrs"] == {"found": 3}
+    # monotonic-clock duration: child fits inside parent, both >= sleeps
+    assert sp_in.dur_ms >= 1.0
+    assert sp_out.dur_ms >= sp_in.dur_ms + 2.0 - 0.5
+    s = obs.summary()
+    assert s["outer"]["count"] == 1
+    for k in ("total_ms", "mean_ms", "p50_ms", "p95_ms"):
+        assert s["outer"][k] >= s["inner"][k] > 0
+
+
+def test_counter_aggregation_across_threads():
+    with obs.tracing():
+        def work():
+            for _ in range(1000):
+                obs.inc("epochs_processed")
+                obs.inc("bytes_h2d", 2)
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # spans from concurrent threads must not corrupt each other's
+        # nesting (thread-local stacks)
+        with obs.span("main.only"):
+            pass
+    c = obs.counters()
+    assert c["epochs_processed"] == 8000
+    assert c["bytes_h2d"] == 16000
+    assert obs.summary()["main.only"]["count"] == 1
+
+
+def test_summary_percentiles():
+    with obs.tracing() as reg:
+        pass
+    # inject known durations straight into the registry
+    for d in [1.0, 2.0, 3.0, 4.0, 100.0]:
+        reg._durs.setdefault("x", []).append(d)
+    s = reg.summary()["x"]
+    assert s["count"] == 5
+    assert s["total_ms"] == 110.0
+    assert s["p50_ms"] == 3.0
+    assert s["p95_ms"] == 100.0
+
+
+# ---------------------------------------------------------------------------
+# JSONL sink -> trace report round trip
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_roundtrip_through_trace_report(tmp_path, capsys):
+    from scintools_tpu.cli import main as cli_main
+
+    path = str(tmp_path / "t.jsonl")
+    with obs.tracing(jsonl=path):
+        with obs.span("ops.sspec", backend="numpy"):
+            time.sleep(0.001)
+        with obs.span("ops.sspec", backend="numpy"):
+            pass
+        obs.inc("epochs_processed", 3)
+    # file has one JSON object per line; spans + flushed counters
+    events = [json.loads(x) for x in open(path) if x.strip()]
+    kinds = {e["kind"] for e in events}
+    assert kinds == {"span", "counter"}
+    assert sum(e["kind"] == "span" for e in events) == 2
+
+    rc = cli_main(["trace", "report", path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "ops.sspec" in out
+    assert "epochs_processed = 3" in out
+    # aggregation columns present
+    for col in ("count", "total_ms", "p50_ms", "p95_ms"):
+        assert col in out
+
+
+def test_multiple_flushes_do_not_double_count(tmp_path, capsys):
+    # bench flushes at its exit points AND inside device_throughput;
+    # counter events are deltas, so trace report's sum stays the truth
+    from scintools_tpu.cli import main as cli_main
+
+    path = str(tmp_path / "f.jsonl")
+    obs.enable(jsonl=path)
+    try:
+        obs.inc("bytes_h2d", 100)
+        obs.flush()
+        obs.flush()                      # no new increments: no event
+        obs.inc("bytes_h2d", 50)
+    finally:
+        obs.disable()                    # flushes the remaining delta
+    events = [json.loads(x) for x in open(path) if x.strip()]
+    vals = [e["value"] for e in events if e["kind"] == "counter"]
+    assert vals == [100, 50]
+    rc = cli_main(["trace", "report", path])
+    assert rc == 0
+    assert "bytes_h2d = 150" in capsys.readouterr().out
+
+
+def test_trace_report_missing_or_binary_file(tmp_path, capsys):
+    from scintools_tpu.cli import main as cli_main
+
+    rc = cli_main(["trace", "report", str(tmp_path / "nope.jsonl")])
+    assert rc == 1
+    binary = tmp_path / "not_a_trace.bin"
+    binary.write_bytes(b"\xff\xfe\x00binary\x9c")
+    rc = cli_main(["trace", "report", str(binary)])   # no traceback
+    assert rc == 1
+
+
+def test_cli_unwritable_trace_path_is_clean_error(tmp_path, capsys):
+    from scintools_tpu.cli import main as cli_main
+
+    rc = cli_main(["--trace", str(tmp_path / "no/such/dir/t.jsonl"),
+                   "trace", "report", str(tmp_path / "x.jsonl")])
+    assert rc == 1
+    assert "cannot open" in capsys.readouterr().err
+    assert not obs.enabled()
+
+
+# ---------------------------------------------------------------------------
+# traced batched pipeline (the acceptance criteria)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def traced_pipeline(tmp_path_factory):
+    """One pipeline over 2 simulated epochs, run tracing-off then
+    tracing-on (JSONL attached), results + events captured."""
+    from scintools_tpu.parallel import PipelineConfig, run_pipeline
+
+    # smallest program that still exercises the full step (sspec -> arc
+    # fit -> scint fit): the fixture pays TWO compiles (jit for the off
+    # run, AOT for the traced run), so keep the trace cheap
+    epochs = [synth_arc_epoch(seed=s) for s in range(2)]
+    cfg = PipelineConfig(arc_numsteps=64, lm_steps=3)
+    obs.disable(flush=False)
+    obs.reset()
+    res_off = run_pipeline(epochs, cfg)
+    spans_off = obs.summary()
+    path = str(tmp_path_factory.mktemp("trace") / "pipe.jsonl")
+    with obs.tracing(jsonl=path) as reg:
+        res_on = run_pipeline(epochs, cfg)
+        events = reg.events()
+        counters = obs.counters()
+    res_off2 = run_pipeline(epochs, cfg)   # off again, post-trace
+    return dict(res_off=res_off, res_on=res_on, res_off2=res_off2,
+                events=events, counters=counters, path=path,
+                spans_off=spans_off)
+
+
+def test_disabled_pipeline_records_no_spans(traced_pipeline):
+    assert traced_pipeline["spans_off"] == {}
+
+
+def test_stage_spans_once_per_epoch_batch(traced_pipeline):
+    # 2 equal-grid epochs -> ONE bucket batch -> each stage span exactly
+    # once; compile and execute split into separate spans by the
+    # AOT-instrumented step
+    names = [e["name"] for e in traced_pipeline["events"]]
+    for stage in ("pipeline.run", "pipeline.stage",
+                  "pipeline.step.compile", "pipeline.step.execute",
+                  "pipeline.gather"):
+        assert names.count(stage) == 1, (stage, names)
+    # nesting: stage/gather under the run root
+    paths = {e["name"]: e["path"] for e in traced_pipeline["events"]}
+    assert paths["pipeline.stage"] == "pipeline.run/pipeline.stage"
+    assert paths["pipeline.gather"] == "pipeline.run/pipeline.gather"
+
+
+def test_pipeline_counters(traced_pipeline):
+    c = traced_pipeline["counters"]
+    assert c["epochs_processed"] == 2
+    assert c["jit_cache_miss"] >= 1
+    # 2 epochs of 64x64 float64
+    assert c["bytes_h2d"] == 2 * 64 * 64 * 8
+
+
+def test_tracing_does_not_change_results(traced_pipeline):
+    """Acceptance: bit-identical results with tracing on vs off (and
+    off-after-on, so enabling tracing once cannot poison later runs)."""
+    def leaves(buckets):
+        out = []
+        for _idx, res in buckets:
+            for leaf in (res.scint.tau, res.scint.dnu, res.arc.eta,
+                         res.arc.etaerr):
+                out.append(np.asarray(leaf))
+        return out
+
+    for a, b in zip(leaves(traced_pipeline["res_off"]),
+                    leaves(traced_pipeline["res_on"])):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(leaves(traced_pipeline["res_off"]),
+                    leaves(traced_pipeline["res_off2"])):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_trace_report_has_compile_and_execute_rows(traced_pipeline,
+                                                   capsys):
+    """Acceptance: `trace report` on a JSONL from a traced run_pipeline
+    over >= 2 simulated epochs shows distinct compile-time and
+    execute-time rows."""
+    from scintools_tpu.cli import main as cli_main
+
+    rc = cli_main(["trace", "report", traced_pipeline["path"]])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "pipeline.step.compile" in out
+    assert "pipeline.step.execute" in out
+    lines = {ln.split()[0]: ln for ln in out.splitlines() if ln.strip()}
+    # compile and execute are separate aggregation rows with real times
+    assert lines["pipeline.step.compile"] != lines["pipeline.step.execute"]
+    assert "epochs_processed = 2" in out
+
+
+def test_instrument_jit_reuses_compiled_signature():
+    import jax
+    import jax.numpy as jnp
+
+    calls = []
+
+    @jax.jit
+    def f(x):
+        calls.append(1)
+        return jnp.sin(x).sum()
+
+    g = obs.instrument_jit(f, "t.step")
+    assert obs.instrument_jit(f, "t.step") is g    # memoised wrapper
+    x = np.ones((4, 4), np.float32)
+    with obs.tracing() as reg:
+        out1 = g(x)
+        out2 = g(x)                                 # same signature
+        g(np.ones((2, 2), np.float32))              # new signature
+    names = [e["name"] for e in reg.events()]
+    assert names.count("t.step.compile") == 2
+    assert names.count("t.step.execute") == 3
+    assert obs.counters()["jit_cache_miss"] == 2
+    assert float(np.asarray(out1)) == float(np.asarray(out2))
+    # disabled: falls straight through to the jit callable
+    y = g(x)
+    assert float(np.asarray(y)) == float(np.asarray(out1))

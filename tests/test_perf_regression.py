@@ -46,30 +46,46 @@ def epochs():
 
 def test_batched_sspec_beats_serial_numpy(epochs):
     """BASELINE config 1 (relative form): one jit'd batched sspec vs the
-    per-epoch numpy chain."""
+    per-epoch numpy chain.  Runs under obs tracing so a failure names
+    the guilty stage (per-stage count/total/p50/p95), not one opaque
+    total."""
     import jax
     import jax.numpy as jnp
 
+    from scintools_tpu import obs
     from scintools_tpu.ops import sspec
 
     dyn = np.stack([np.asarray(e.dyn, np.float32) for e in epochs])
 
     def serial():
-        for d in dyn:
-            sspec(d, backend="numpy")
+        with obs.span("perf.serial_sspec"):
+            for d in dyn:
+                sspec(d, backend="numpy")
 
     batched = jax.jit(jax.vmap(lambda d: sspec(d, backend="jax")))
-    float(np.asarray(jnp.sum(batched(dyn))))        # warmup + compile
-    t_batch = _median_time(
-        lambda: float(np.asarray(jnp.sum(batched(dyn)))))
-    t_serial = _median_time(serial)
-    assert t_serial / t_batch > 1.5, (t_serial, t_batch)
+
+    def run_batched():
+        with obs.span("perf.batched_sspec"):
+            float(np.asarray(jnp.sum(batched(dyn))))
+
+    run_batched()                                   # warmup + compile
+    with obs.tracing():
+        t_batch = _median_time(run_batched)
+        t_serial = _median_time(serial)
+        stages = obs.render_summary()
+    assert t_serial / t_batch > 1.5, (
+        f"batched sspec regressed: serial={t_serial:.3f}s "
+        f"batched={t_batch:.3f}s — per-stage spans:\n{stages}")
 
 
 def test_batched_pipeline_beats_serial_chain(epochs):
     """BASELINE config 4 (relative form): the one-jit batched pipeline
     (sspec + arc fit + scint fit) vs the serial numpy chain that
-    bit-matches the reference's per-file loop."""
+    bit-matches the reference's per-file loop.  The serial chain's
+    stages (sspec / arc fit / scint fit) and the batched step run under
+    obs spans, and the assertion carries the per-stage summary so a
+    regression names the guilty stage instead of one opaque total."""
+    from scintools_tpu import obs
     from scintools_tpu.parallel import PipelineConfig, make_pipeline, pad_batch
     from scintools_tpu.pipeline import Dynspec
 
@@ -81,25 +97,33 @@ def test_batched_pipeline_beats_serial_chain(epochs):
     dyn = np.asarray(batch.dyn, np.float32)
 
     def batched():
-        r = step(dyn)
-        return (float(np.asarray(r.scint.tau).sum())
-                + float(np.nansum(np.asarray(r.arc.eta))))
+        with obs.span("perf.batched_step"):
+            r = step(dyn)
+            return (float(np.asarray(r.scint.tau).sum())
+                    + float(np.nansum(np.asarray(r.arc.eta))))
 
     batched()                                       # warmup + compile
 
     def serial():
         # the reference's execution model: one epoch at a time through
         # the numpy-backend wrapper chain (calc_sspec -> fit_arc ->
-        # get_scint_params), as dynspec.py:1615-1657 loops files
+        # get_scint_params), as dynspec.py:1615-1657 loops files.  The
+        # wrapper methods hit the instrumented ops/fit entry points, so
+        # ops.sspec / fit.arc / fit.scint rows appear per epoch.
         for e in epochs:
-            d = Dynspec(dyn_obj=e, process=False, backend="numpy")
-            d.calc_sspec(lamsteps=True)
-            try:
-                d.fit_arc(lamsteps=True, numsteps=500)
-            except ValueError:
-                pass                                # quarantine path
-            d.get_scint_params()
+            with obs.span("perf.serial_epoch"):
+                d = Dynspec(dyn_obj=e, process=False, backend="numpy")
+                d.calc_sspec(lamsteps=True)
+                try:
+                    d.fit_arc(lamsteps=True, numsteps=500)
+                except ValueError:
+                    pass                            # quarantine path
+                d.get_scint_params()
 
-    t_batch = _median_time(batched)
-    t_serial = _median_time(serial)
-    assert t_serial / t_batch > 1.5, (t_serial, t_batch)
+    with obs.tracing():
+        t_batch = _median_time(batched)
+        t_serial = _median_time(serial)
+        stages = obs.render_summary()
+    assert t_serial / t_batch > 1.5, (
+        f"batched pipeline regressed: serial={t_serial:.3f}s "
+        f"batched={t_batch:.3f}s — per-stage spans:\n{stages}")

@@ -72,6 +72,45 @@ def test_logger_structured():
     assert "file=x.dynspec" in msg and "tau=123.457" in msg and "n=3" in msg
 
 
+def test_get_logger_level_applied_on_every_call():
+    # the old `if not logger.handlers` guard swallowed level= after the
+    # first call; an explicit level must now always win
+    log = get_logger("scintools_tpu.test_lvl", level=logging.INFO)
+    assert log.level == logging.INFO
+    log2 = get_logger("scintools_tpu.test_lvl", level=logging.DEBUG)
+    assert log2 is log and log.level == logging.DEBUG
+    # level=None leaves a configured logger alone
+    get_logger("scintools_tpu.test_lvl")
+    assert log.level == logging.DEBUG
+
+
+def test_get_logger_env_default(monkeypatch):
+    monkeypatch.setenv("SCINTOOLS_TPU_LOG", "DEBUG")
+    log = get_logger("scintools_tpu.test_envlvl")
+    assert log.level == logging.DEBUG
+    monkeypatch.setenv("SCINTOOLS_TPU_LOG", "not-a-level")
+    log = get_logger("scintools_tpu.test_envlvl2")
+    assert log.level == logging.INFO     # unparseable -> INFO
+
+
+def test_log_event_level_routing():
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    log = get_logger("scintools_tpu.test_route", level=logging.INFO)
+    log.addHandler(Capture())
+    try:
+        log_event(log, "chatty", level=logging.DEBUG, n=1)   # filtered
+        log_event(log, "loud", n=2)                           # kept
+    finally:
+        log.handlers = [h for h in log.handlers
+                        if not isinstance(h, Capture)]
+    assert [r.getMessage().split()[0] for r in records] == ["loud"]
+
+
 def test_misc_utils(tmp_path):
     assert is_valid(np.array([1.0, np.nan, np.inf])).tolist() == \
         [True, False, False]
