@@ -10,7 +10,15 @@ the sort_dyn loop at dynspec.py:1615-1657), imported live as an oracle.
 
 Prints one or more JSON lines — CONSUMERS TAKE THE LAST ONE:
     {"metric": ..., "value": N, "unit": "dynspec/s", "vs_baseline": N,
-     "compile_s": N, "measure_s": N, "baseline": {...}}
+     "compile_s": N, "cold_start_s": N, "warm_start_s": N,
+     "measure_s": N, "captured_at": N, "baseline": {...}}
+(cold_start_s = this process's first-step completion — the TRUE
+empty-cache cold start only when .jax_cache was empty; a repeat round
+in the same workspace is cache-served, so compare it against
+warm_start_s to tell which was measured.  warm_start_s =
+fresh-process populated-persistent-cache first step, measure_s = the
+steady-state pass — the fixed-cost decomposition; captured_at is the
+record-time epoch stamp that gates flight-record salvage)
 (on a wedged accelerator a zero record is flushed first so an external
 kill still leaves a parseable round record, then the labelled
 cpu-fallback or late-arriving device record follows as the last line)
@@ -30,6 +38,8 @@ default 180), SCINT_BENCH_PROBE_RETRIES / SCINT_BENCH_PROBE_PAUSE
 pause), SCINT_BENCH_DEVICE_TIMEOUT (full-run watchdog, default 1200),
 SCINT_BENCH_REPEATS (timed device passes, median reported, default 3),
 SCINT_BENCH_CPU_THREADS (BLAS pin in the fallback subprocess),
+SCINT_BENCH_FLIGHTS_DIR (flight-log dir for record salvage, default
+benchmarks/flights/ — test fixtures point it at tmp dirs),
 SCINT_BENCH_TRACE (path: enable scintools_tpu.obs tracing and append
 span/counter events in the --trace JSONL format, so the headline
 decomposes with `scintools-tpu trace report` — the bench emits
@@ -60,6 +70,11 @@ DEFAULT_SHAPE = (1024, 256, 512)
 # real lock.
 DEVICE_LOCK = (os.environ.get("SCINT_BENCH_LOCK_FILE")
                or os.path.join(_HERE, ".device.lock"))
+# flight-log evidence directory consulted by _salvage_flight_record.
+# SCINT_BENCH_FLIGHTS_DIR overrides (mirroring SCINT_BENCH_LOCK_FILE)
+# so test fixtures write to tmp_path, never the tracked evidence dir.
+FLIGHTS_DIR = (os.environ.get("SCINT_BENCH_FLIGHTS_DIR")
+               or os.path.join(_HERE, "benchmarks", "flights"))
 
 
 def _acquire_device_lock(timeout_s: int):
@@ -110,9 +125,15 @@ def _release_device_lock(lock) -> None:
 
 
 def _salvage_flight_record(metric: str, newer_than: float, why=None):
-    """Newest on-chip bench record in benchmarks/flights/*.log whose
-    metric matches this run's configuration AND whose log was written
-    after ``newer_than`` (epoch seconds).
+    """Newest on-chip bench record in FLIGHTS_DIR/*.log whose metric
+    matches this run's configuration AND whose embedded ``captured_at``
+    stamp (epoch seconds, written by the bench at record time) is after
+    ``newer_than``.
+
+    Freshness is gated on ``captured_at``, NEVER on file mtime: a git
+    checkout refreshes mtimes, so a tracked prior-round log would
+    otherwise re-emit a stale number as current (ADVICE r5, medium).
+    Records without the stamp (pre-round-6 logs) never qualify.
 
     Two callers, one mechanism.  (a) When another process holds the
     device lock (a single-flight capture mid-run), that capture's OWN
@@ -122,7 +143,7 @@ def _salvage_flight_record(metric: str, newer_than: float, why=None):
     wedged but a flight EARLIER IN THE SAME ROUND landed an on-chip
     record (the round-5 reality: headline captured 15:43, tunnel
     wedged by 16:05), re-emitting that record — provenance-stamped
-    with the log's age and the caller's ``why`` — beats surrendering
+    with the record's age and the caller's ``why`` — beats surrendering
     the round record to a CPU fallback for a fifth time; the caller
     bounds the age.  A stale prior-round number must never masquerade
     as current: only genuine on-chip records qualify (probe ok,
@@ -131,12 +152,8 @@ def _salvage_flight_record(metric: str, newer_than: float, why=None):
     import glob
 
     best = None
-    for path in glob.glob(os.path.join(_HERE, "benchmarks", "flights",
-                                       "*.log")):
+    for path in glob.glob(os.path.join(FLIGHTS_DIR, "*.log")):
         try:
-            mtime = os.path.getmtime(path)
-            if mtime < newer_than:
-                continue
             with open(path, errors="replace") as fh:
                 for line in fh:
                     line = line.strip()
@@ -146,19 +163,21 @@ def _salvage_flight_record(metric: str, newer_than: float, why=None):
                         rec = json.loads(line)
                     except json.JSONDecodeError:
                         continue
+                    cap = rec.get("captured_at")
                     if (rec.get("metric") == metric
+                            and isinstance(cap, (int, float))
+                            and cap >= newer_than
                             and isinstance(rec.get("value"), (int, float))
                             and rec["value"] > 0
                             and (rec.get("probe") or {}).get("ok")
                             # a record that was itself salvaged must not
-                            # re-qualify: each re-emission refreshes the
-                            # log mtime, so without this a stale number
-                            # would roll the age gate forward forever
+                            # re-qualify: a stale number must not roll
+                            # forward through repeated re-emission
                             and "salvaged_from" not in rec
                             and not str(rec.get("device", "")
                                         ).startswith("cpu-fallback")):
-                        if best is None or mtime > best[0]:
-                            best = (mtime, rec, os.path.basename(path))
+                        if best is None or cap > best[0]:
+                            best = (cap, rec, os.path.basename(path))
         except OSError:  # pragma: no cover
             continue
     if best is None:
@@ -166,7 +185,7 @@ def _salvage_flight_record(metric: str, newer_than: float, why=None):
     rec = dict(best[1])
     age_min = max(0.0, (time.time() - best[0]) / 60.0)
     rec["salvaged_from"] = (
-        f"flight log {best[2]} (written {age_min:.0f} min ago): "
+        f"flight log {best[2]} (captured {age_min:.0f} min ago): "
         + (why if why else
            "within this run's device-lock wait — the single-flight "
            "capture holding the lock produced this on-chip record "
@@ -215,15 +234,16 @@ def _cache_env(env=None):
 
 
 def _enable_compile_cache():
-    """Turn the persistent compilation cache on for THIS process."""
+    """Turn the persistent compilation cache on for THIS process (the
+    repo-local .jax_cache — bench's round-over-round contract), via the
+    shared wiring in scintools_tpu.compile_cache."""
     for k, v in _cache_env().items():
         os.environ.setdefault(k, v)
     try:
-        import jax
+        from scintools_tpu import compile_cache
 
-        jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        compile_cache.enable_persistent_cache(
+            os.environ.get("JAX_COMPILATION_CACHE_DIR", CACHE_DIR))
     except Exception:
         pass  # cache is an optimisation; never fail the bench over it
 
@@ -544,12 +564,25 @@ def device_throughput(dyn, freqs, times, chunk: int,
         dyn_d = jax.device_put(dyn)
         obs.fence(dyn_d)
     obs.inc("bytes_h2d", int(dyn.nbytes))
-    # warmup/compile on the first chunk (persistent cache makes repeat
-    # rounds near-free; compile_s includes the first execution)
+    # COLD start: first-step completion in this process — trace + XLA
+    # compile (or persistent-cache deserialize when a previous round
+    # populated .jax_cache) + first execution
     t0 = time.perf_counter()
     with obs.span("bench.step.compile", chunk=chunk):
         sync([step(dyn_d[:chunk])])
     compile_s = time.perf_counter() - t0
+
+    # WARM-cache start: what a FRESH process pays once the persistent
+    # cache holds this program — lower() re-traces (bypassing jit's
+    # in-process cache) and compile() is served from disk.  The span
+    # name feeds `trace report`'s cold/warm compile split.
+    t0 = time.perf_counter()
+    try:
+        with obs.span("bench.step.compile.warm", chunk=chunk):
+            step.lower(dyn_d[:chunk]).compile()
+        warm_s = time.perf_counter() - t0
+    except Exception:  # lowering quirk must never sink the bench
+        warm_s = None
 
     rates = []
     for _ in range(max(int(repeats), 1)):
@@ -568,7 +601,13 @@ def device_throughput(dyn, freqs, times, chunk: int,
     # so the two fields always describe one measurement (round-over-
     # round measure_s comparisons must not be spike-owned)
     rec = {"rate": rate, "compile_s": round(compile_s, 2),
+           # fixed-cost decomposition: cold_start_s = fresh-process,
+           # empty-cache first step; warm_start_s = fresh-process,
+           # POPULATED-cache first step; measure_s = steady state
+           "cold_start_s": round(compile_s, 2),
            "measure_s": round(B / rate, 3)}
+    if warm_s is not None:
+        rec["warm_start_s"] = round(warm_s, 2)
     if len(rates) > 1:
         rec["repeat_rates"] = [round(r, 2) for r in rates]
     _trace_flush()   # counters, for the fallback-subprocess caller
@@ -603,7 +642,14 @@ def main():
             "measure_s": res.get("measure_s"),
             "baseline": baseline,
             "probe": probe,
+            # written at record time; the ONLY freshness signal
+            # _salvage_flight_record trusts (file mtime is refreshed by
+            # git checkouts and must never gate salvage)
+            "captured_at": round(time.time(), 1),
         }
+        for k in ("cold_start_s", "warm_start_s"):
+            if res.get(k) is not None:
+                rec[k] = res[k]
         if res.get("repeat_rates"):
             rec["repeat_rates"] = res["repeat_rates"]
         # MFU/roofline accounting against the probed chip's published
@@ -752,7 +798,7 @@ def main():
     zero_rec = {
         "metric": metric, "value": 0.0, "unit": "dynspec/s",
         "vs_baseline": 0.0, "error": err, "probe": probe,
-        "baseline": baseline,
+        "baseline": baseline, "captured_at": round(time.time(), 1),
     }
     _trace_flush()
     print(json.dumps(zero_rec), flush=True)

@@ -45,6 +45,25 @@ def cmd_info(args) -> int:
     return rc
 
 
+def _validate_estimator_flags(args) -> None:
+    """Shared --arc-bracket/--arc-method/--pad-chunks fail-fast for
+    process and warmup: a warmup must reject exactly the configs the
+    survey would reject, from one rule site."""
+    bracket = getattr(args, "arc_bracket", None)
+    if bracket is not None and not (0 < bracket[0] < bracket[1]):
+        raise SystemExit(f"--arc-bracket must be 0 < LO < HI, got "
+                         f"{bracket[0]} {bracket[1]}")
+    if (getattr(args, "arc_method", "norm_sspec") == "thetatheta"
+            and not args.no_arc and bracket is None):
+        raise SystemExit("--arc-method thetatheta requires "
+                         "--arc-bracket LO HI (the curvature sweep "
+                         "range)")
+    if (getattr(args, "pad_chunks", False)
+            and getattr(args, "chunk_epochs", None) is None):
+        raise SystemExit("--pad-chunks pads the final chunk up to "
+                         "--chunk-epochs; set --chunk-epochs")
+
+
 def cmd_process(args) -> int:
     from .pipeline import Dynspec
     from .io.results import results_row, write_results
@@ -77,15 +96,7 @@ def cmd_process(args) -> int:
     if scint_2d:
         cfg += ("scint2d",)
     # fail fast on estimator misconfiguration, before any file I/O
-    if arc_bracket is not None and not (0 < arc_bracket[0]
-                                        < arc_bracket[1]):
-        raise SystemExit(f"--arc-bracket must be 0 < LO < HI, got "
-                         f"{arc_bracket[0]} {arc_bracket[1]}")
-    if (arc_method == "thetatheta" and not args.no_arc
-            and arc_bracket is None):
-        raise SystemExit("--arc-method thetatheta requires "
-                         "--arc-bracket LO HI (the curvature sweep "
-                         "range)")
+    _validate_estimator_flags(args)
     if arc_method != "norm_sspec" or arc_bracket is not None:
         cfg += (arc_method, tuple(arc_bracket or ()))
     if mcmc:
@@ -108,10 +119,17 @@ def cmd_process(args) -> int:
             if flag is not None:
                 raise SystemExit(f"{name} only applies to the batched "
                                  "engine; add --batched")
+        for flag, name in ((getattr(args, "pad_chunks", False),
+                            "--pad-chunks"),
+                           (getattr(args, "no_async", False),
+                            "--no-async")):
+            if flag:
+                raise SystemExit(f"{name} only applies to the batched "
+                                 "engine; add --batched")
         if getattr(args, "arc_stack", False):
             raise SystemExit("--arc-stack stacks profiles across the "
                              "batch; add --batched")
-    elif getattr(args, "arc_stack", False):
+    if args.batched and getattr(args, "arc_stack", False):
         # fail as a usage error, not a quarantined whole-survey
         # pipeline failure inside run_pipeline
         if args.no_arc:
@@ -232,25 +250,20 @@ def cmd_process(args) -> int:
     return 0 if failed == 0 else 1
 
 
-def _process_batched(args, files, cfg, store, log, timers) -> int:
-    """Batched engine for cmd_process: trim/refill host-side, then ONE
-    jit-compiled step per shape bucket over the device mesh
-    (parallel.run_pipeline) instead of a per-file Python loop."""
-    import os
-
-    import numpy as np
+def _load_clean_epochs(args, files, log, timers=None):
+    """Shared load+clean stage of the batched engine and ``warmup``:
+    trim/refill (plus the --clean chain) host-side, quarantining
+    unreadable/degenerate files.  Returns (epochs, names, failed)."""
+    import contextlib
 
     from .io.psrflux import read_psrflux
-    from .io.results import results_row, write_results
-    from .ops.clean import refill, trim_edges
-    from .parallel import (PipelineConfig, make_mesh, run_pipeline,
-                           survey_routes)
-    from .utils import content_key, log_event
-
-    from .ops.clean import correct_band, zap
+    from .ops.clean import correct_band, refill, trim_edges, zap
+    from .utils import log_event
 
     epochs, names, failed = [], [], 0
-    with timers.stage("load+clean"):
+    stage = (timers.stage("load+clean") if timers is not None
+             else contextlib.nullcontext())
+    with stage:
         for fn in files:
             try:
                 d = refill(trim_edges(read_psrflux(fn)))
@@ -270,19 +283,45 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
                 failed += 1
                 obs.inc("epochs_failed")
                 log_event(log, "epoch_failed", file=fn, error=repr(e))
+    return epochs, names, failed
+
+
+def _pipeline_config_from_args(args):
+    """PipelineConfig from the shared process/warmup estimator flags —
+    one builder, so a warmup compiles exactly the config the survey
+    will run."""
+    from .parallel import PipelineConfig
+
+    pkw = dict(lamsteps=args.lamsteps,
+               fit_arc=not args.no_arc,
+               fit_scint=not args.no_scint,
+               fit_scint_2d=getattr(args, "scint_2d", False),
+               arc_asymm=getattr(args, "arc_asymm", False),
+               arc_method=getattr(args, "arc_method", "norm_sspec"),
+               arc_stack=getattr(args, "arc_stack", False))
+    bracket = getattr(args, "arc_bracket", None)
+    if bracket is not None:
+        pkw["arc_constraint"] = (float(bracket[0]), float(bracket[1]))
+    return PipelineConfig(**pkw)
+
+
+def _process_batched(args, files, cfg, store, log, timers) -> int:
+    """Batched engine for cmd_process: trim/refill host-side, then ONE
+    jit-compiled step per shape bucket over the device mesh
+    (parallel.run_pipeline) instead of a per-file Python loop."""
+    import os
+
+    import numpy as np
+
+    from .io.results import results_row, write_results
+    from .parallel import make_mesh, run_pipeline, survey_routes
+    from .utils import content_key, log_event
+
+    epochs, names, failed = _load_clean_epochs(args, files, log,
+                                               timers=timers)
     processed = 0
     if epochs:
-        pkw = dict(lamsteps=args.lamsteps,
-                   fit_arc=not args.no_arc,
-                   fit_scint=not args.no_scint,
-                   fit_scint_2d=getattr(args, "scint_2d", False),
-                   arc_asymm=getattr(args, "arc_asymm", False),
-                   arc_method=getattr(args, "arc_method", "norm_sspec"),
-                   arc_stack=getattr(args, "arc_stack", False))
-        bracket = getattr(args, "arc_bracket", None)
-        if bracket is not None:
-            pkw["arc_constraint"] = (float(bracket[0]), float(bracket[1]))
-        pcfg = PipelineConfig(**pkw)
+        pcfg = _pipeline_config_from_args(args)
         mesh_shape = getattr(args, "mesh", None)
         try:
             # inside the quarantine handler: an invalid --mesh for this
@@ -295,7 +334,9 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
             # drifts numerically — make that diagnosable
             routes = survey_routes(epochs, pcfg, mesh=mesh,
                                    chunk=getattr(args, "chunk_epochs",
-                                                 None))
+                                                 None),
+                                   pad_chunks=getattr(args, "pad_chunks",
+                                                      False))
             # routes keys like 'bucket0:5of256x512:step8' are not valid
             # identifiers — pass as one JSON field, never ** unpacking
             # (non-identifier ** keys are implementation-defined)
@@ -303,7 +344,9 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
             with timers.stage("batched_pipeline"):
                 buckets = run_pipeline(
                     epochs, pcfg, mesh=mesh,
-                    chunk=getattr(args, "chunk_epochs", None))
+                    chunk=getattr(args, "chunk_epochs", None),
+                    async_exec=not getattr(args, "no_async", False),
+                    pad_chunks=getattr(args, "pad_chunks", False))
         except Exception as e:
             log_event(log, "pipeline_failed", error=repr(e),
                       epochs=len(epochs))
@@ -458,6 +501,115 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
     print(timers.report(), file=sys.stderr)
     log_event(log, "done", processed=processed, failed=failed)
     return 0 if failed == 0 else 1
+
+
+def cmd_warmup(args) -> int:
+    """Pre-compile the batched pipeline's step set for a template +
+    config, so a later ``process --batched`` run pays ZERO trace/compile
+    time (the fixed-cost amortization layer, scintools_tpu.compile_cache).
+
+    For every step signature the matching ``process --batched`` survey
+    would execute — including the uneven trailing-chunk signature from
+    the chunk math, unless --pad-chunks collapses it — this (a) AOT-
+    exports the jit'd step as a serialized jax.export artifact keyed on
+    (template axes, config, mesh, batch shape, dtype, jax/backend
+    version) and (b) compiles the deserialized module with the
+    persistent XLA cache enabled, so the survey process deserializes
+    the step AND hits the XLA disk cache instead of re-tracing and
+    re-compiling.  ``--batch`` sizes the planned survey batch (default:
+    the number of template files per bucket).  A signature whose
+    artifact already exists reports ``cached`` but is still compiled
+    against the persistent XLA cache — near-free when warm, and it
+    repairs an evicted cache entry (the AOT artifacts have no eviction;
+    the XLA cache does).
+
+    Prints one JSON line: cache dir + per-signature status/compile time.
+    """
+    import time
+
+    from . import compile_cache
+    from .parallel import make_mesh, make_pipeline
+    from .parallel import mesh as mesh_mod
+    from .parallel.driver import _resolve_chan_sharded, _resolve_donate
+    from .utils import get_logger, log_event
+
+    log = get_logger()
+    files = _expand(args.files)
+    _validate_estimator_flags(args)
+    cache = compile_cache.enable_persistent_cache()
+    if cache is None:
+        print(json.dumps({"error": "compile cache disabled "
+                          "(SCINT_COMPILE_CACHE=off); nothing to warm"}))
+        return 1
+    epochs, _names, failed = _load_clean_epochs(args, files, log)
+    if not epochs:
+        print(json.dumps({"error": "no usable template epochs",
+                          "failed": failed}))
+        return 1
+    pcfg = _pipeline_config_from_args(args)
+    mesh_shape = getattr(args, "mesh", None)
+    mesh = (make_mesh(tuple(int(x) for x in mesh_shape)) if mesh_shape
+            else make_mesh())
+    chan = _resolve_chan_sharded(mesh, None)
+    chunk = getattr(args, "chunk_epochs", None)
+    pad_chunks = getattr(args, "pad_chunks", False)
+    plans = compile_cache.plan_steps(epochs, pcfg, mesh=mesh, chunk=chunk,
+                                    pad_chunks=pad_chunks,
+                                    batch=args.batch)
+    import jax
+
+    sigs = []
+    for freqs, times, bshape, dtype, chunked in plans:
+        donate = _resolve_donate(not getattr(args, "no_async", False),
+                                 chunked, mesh)
+        key = compile_cache.step_key(freqs, times, pcfg, mesh, chan,
+                                     bshape, dtype, donate=donate)
+        sig = {"shape": list(bshape), "key": key}
+        t0 = time.perf_counter()
+        spec_sharding = (mesh_mod.data_sharding(mesh, chan)
+                         if mesh is not None else None)
+        # --force first: a load under --force would memoize the stale
+        # artifact and defeat the re-export
+        fn = None if args.force else compile_cache.load_step(key,
+                                                            count=False)
+        if fn is not None:
+            sig["status"] = "cached"
+            # the AOT artifact has no eviction but the XLA persistent
+            # cache does: recompile the deserialized module anyway —
+            # near-free on a warm cache, and it REPAIRS an evicted
+            # entry instead of letting the survey pay the full compile
+            fn.lower(jax.ShapeDtypeStruct(
+                tuple(bshape), jax.dtypes.canonicalize_dtype(dtype),
+                sharding=spec_sharding)).compile()
+        else:
+            step = make_pipeline(freqs, times, pcfg, mesh=mesh,
+                                 chan_sharded=chan, donate=donate)
+            path = compile_cache.export_step(step, bshape, dtype, key)
+            if path is None:
+                # export unsupported for this step/sharding: still warm
+                # the persistent XLA cache through the plain jit path
+                sig["status"] = "xla-cache-only"
+                spec = jax.ShapeDtypeStruct(
+                    tuple(bshape), jax.dtypes.canonicalize_dtype(dtype))
+                step.lower(spec).compile()
+            else:
+                sig["status"] = "exported"
+                # compile the DESERIALIZED module (not the live step):
+                # that is the exact program the survey process will ask
+                # XLA for, so the persistent-cache fingerprints match
+                fn = compile_cache.load_step(key, count=False)
+                fn.lower(jax.ShapeDtypeStruct(
+                    tuple(bshape), jax.dtypes.canonicalize_dtype(dtype),
+                    sharding=spec_sharding)).compile()
+        sig["compile_s"] = round(time.perf_counter() - t0, 3)
+        sigs.append(sig)
+        log_event(log, "warmup_signature", **{k: v for k, v in sig.items()
+                                              if k != "shape"},
+                  shape="x".join(str(s) for s in bshape))
+    print(json.dumps({"cache_dir": cache, "jax": jax.__version__,
+                      "backend": jax.default_backend(),
+                      "signatures": sigs, "failed_templates": failed}))
+    return 0
 
 
 def cmd_sort(args) -> int:
@@ -883,12 +1035,64 @@ def build_parser() -> argparse.ArgumentParser:
                    help="batched mode: bound device memory by limiting "
                         "epochs per step (adjusted to a multiple of the "
                         "mesh's data-axis size, with a warning)")
+    q.add_argument("--pad-chunks", action="store_true",
+                   help="batched mode with --chunk-epochs: pad the final "
+                        "uneven chunk up to the chunk size (mask-sliced "
+                        "on gather) so the survey compiles exactly ONE "
+                        "program")
+    q.add_argument("--no-async", action="store_true",
+                   help="batched mode: disable the double-buffered chunk "
+                        "executor (prefetch thread overlapping host "
+                        "staging with device compute) and run the serial "
+                        "staging loop instead; results are bit-identical "
+                        "either way")
     q.add_argument("--mesh", type=int, nargs=2, default=None,
                    metavar=("DATA", "CHAN"),
                    help="batched mode: mesh shape (data x chan "
                         "parallelism; CHAN>1 shards the sspec FFT's "
                         "channel axis)")
     q.set_defaults(fn=cmd_process)
+
+    q = sub.add_parser(
+        "warmup",
+        help="pre-compile the batched step set for a template + config "
+             "(persistent compile cache + AOT export), so a later "
+             "`process --batched` run re-traces nothing")
+    q.add_argument("files", nargs="+",
+                   help="template psrflux file(s): the survey's inputs "
+                        "or one representative epoch per observing setup")
+    q.add_argument("--batch", type=int, default=None,
+                   help="planned survey batch size per shape bucket "
+                        "(default: the number of template files in the "
+                        "bucket) — pass the production size to warm up "
+                        "from a few template files")
+    q.add_argument("--lamsteps", action="store_true")
+    q.add_argument("--no-arc", action="store_true")
+    q.add_argument("--no-scint", action="store_true")
+    q.add_argument("--scint-2d", action="store_true")
+    q.add_argument("--arc-asymm", action="store_true")
+    q.add_argument("--arc-method", default="norm_sspec",
+                   choices=["norm_sspec", "gridmax", "thetatheta"])
+    q.add_argument("--arc-bracket", type=float, nargs=2, default=None,
+                   metavar=("LO", "HI"))
+    q.add_argument("--arc-stack", action="store_true")
+    q.add_argument("--clean", action="store_true",
+                   help="mirror process --clean (cleaning changes epoch "
+                        "shapes, so the warmed signatures must match)")
+    q.add_argument("--chunk-epochs", type=int, default=None,
+                   help="mirror the survey's --chunk-epochs (the uneven "
+                        "trailing-chunk signature is warmed too)")
+    q.add_argument("--pad-chunks", action="store_true",
+                   help="mirror the survey's --pad-chunks (one compiled "
+                        "program per bucket)")
+    q.add_argument("--no-async", action="store_true",
+                   help="mirror the survey's --no-async (input donation "
+                        "differs, which is part of the cache key)")
+    q.add_argument("--mesh", type=int, nargs=2, default=None,
+                   metavar=("DATA", "CHAN"))
+    q.add_argument("--force", action="store_true",
+                   help="re-export even when an artifact already exists")
+    q.set_defaults(fn=cmd_warmup)
 
     q = sub.add_parser("sort", help="triage files into good/bad lists")
     q.add_argument("files", nargs="+")

@@ -1,0 +1,354 @@
+"""Persistent fixed-cost amortization: XLA compile cache + AOT step export.
+
+BENCH_r05 measured 324.7 s of XLA compilation against 0.54 s of useful
+device time — a fresh process is >99.8 % fixed cost.  Two mechanisms,
+layered (the compile-cache discipline GPU pulsar pipelines use to hide
+host costs behind the FFT engine — arXiv:1711.10855, arXiv:1804.05335):
+
+1. **Persistent XLA compilation cache** (:func:`enable_persistent_cache`)
+   — wires ``jax_compilation_cache_dir`` so XLA executables are
+   deserialized from disk instead of recompiled.  Directory from
+   ``SCINT_COMPILE_CACHE`` (default ``~/.cache/scintools_tpu/xla``;
+   ``0``/``off`` disables).  Min-compile-time gating keeps trivial
+   programs from spamming the disk.
+2. **AOT export of the jit'd pipeline step** (:func:`export_step` /
+   :func:`load_step`) — ``jax.export`` StableHLO artifacts keyed on
+   (freqs/times digest, PipelineConfig, mesh shape, batch shape, dtype,
+   jax/backend version, x64 flag), so a fresh process *deserializes* the
+   step instead of re-tracing it.  Layer 2 removes the trace+lower cost;
+   layer 1 removes the XLA compile cost of the deserialized module
+   (warmup compiles exactly the program the loading process will ask
+   for, so the persistent-cache fingerprints match).
+
+Artifacts are written by ``scintools-tpu warmup`` (cli.py) and loaded
+opportunistically by :func:`scintools_tpu.parallel.run_pipeline`; a
+lookup increments the ``compile_cache_hit`` / ``compile_cache_miss``
+obs counters so ``trace report`` decomposes cold vs warm starts.
+
+Everything here is host-side file I/O plus jax config; nothing touches
+the device except the caller-provided step itself.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import numpy as np
+
+from . import obs
+
+ENV_VAR = "SCINT_COMPILE_CACHE"
+DEFAULT_DIR = "~/.cache/scintools_tpu/xla"
+_DISABLED_VALUES = ("", "0", "off", "none", "disabled", "false")
+# artifact format version: bump to invalidate every existing artifact
+_FORMAT = 1
+
+
+def cache_dir() -> str | None:
+    """Resolved cache directory, or None when disabled via env."""
+    val = os.environ.get(ENV_VAR)
+    if val is not None and val.strip().lower() in _DISABLED_VALUES:
+        return None
+    return os.path.expanduser(val or DEFAULT_DIR)
+
+
+def aot_dir() -> str | None:
+    d = cache_dir()
+    return None if d is None else os.path.join(d, "aot")
+
+
+def enable_persistent_cache(path: str | None = None) -> str | None:
+    """Wire jax's persistent compilation cache to ``path`` (default: the
+    env-resolved :func:`cache_dir`).  Returns the directory in effect,
+    or None when disabled.  Idempotent; an ambient
+    ``JAX_COMPILATION_CACHE_DIR`` (or an explicit earlier wiring) wins
+    over the default so bench's repo-local ``.jax_cache`` contract and
+    user overrides are respected.  Min-compile-time gating (1 s) keeps
+    sub-second programs out of the cache."""
+    d = path if path is not None else cache_dir()
+    if d is None:
+        return None
+    try:
+        import jax
+
+        ambient = (os.environ.get("JAX_COMPILATION_CACHE_DIR")
+                   or getattr(jax.config, "jax_compilation_cache_dir",
+                              None))
+        if path is None and ambient:
+            d = ambient
+        os.makedirs(d, exist_ok=True)
+        # export to children (warmup/process pairs, bench subprocesses)
+        os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", d)
+        jax.config.update("jax_compilation_cache_dir", d)
+        if "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS" not in os.environ:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0)
+        if "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES" not in os.environ:
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", 0)
+        return d
+    except Exception:
+        # the cache is an optimisation: never fail the pipeline over it
+        return None
+
+
+_SERIALIZATION_DONE = False
+
+
+def _register_serialization() -> None:
+    """Register the pipeline's custom result pytrees with jax.export so
+    the step's out_tree serializes (idempotent; re-registration of an
+    already-known node is not an error here)."""
+    global _SERIALIZATION_DONE
+    if _SERIALIZATION_DONE:
+        return
+    from jax import export
+
+    from .data import ArcFit, ScintParams, SecSpec
+    from .parallel.driver import PipelineResult
+
+    for cls in (PipelineResult, ScintParams, ArcFit, SecSpec):
+        try:
+            export.register_pytree_node_serialization(
+                cls, serialized_name=f"scintools_tpu.{cls.__name__}",
+                serialize_auxdata=lambda aux: json.dumps(aux).encode(),
+                deserialize_auxdata=lambda b: json.loads(b.decode()))
+        except ValueError:
+            pass  # already registered (e.g. two drivers in one process)
+    _SERIALIZATION_DONE = True
+
+
+def _mesh_desc(mesh) -> tuple | None:
+    if mesh is None:
+        return None
+    return tuple((str(name), int(size))
+                 for name, size in dict(mesh.shape).items())
+
+
+_SOURCE_FP: str | None = None
+
+
+def _source_fingerprint() -> str:
+    """Digest of the package's own source tree (computed once per
+    process).  Any code change to scintools_tpu changes the traced
+    program in ways the config/axes key cannot see — an upgraded
+    package must never silently serve a stale artifact."""
+    global _SOURCE_FP
+    if _SOURCE_FP is None:
+        import glob as _glob
+
+        pkg = os.path.dirname(os.path.abspath(__file__))
+        h = hashlib.sha256()
+        for py in sorted(_glob.glob(os.path.join(pkg, "**", "*.py"),
+                                    recursive=True)):
+            # package-relative path: byte-identical code must key the
+            # same from any checkout/install location
+            h.update(os.path.relpath(py, pkg).encode())
+            try:
+                with open(py, "rb") as fh:
+                    h.update(fh.read())
+            except OSError:  # pragma: no cover
+                continue
+        _SOURCE_FP = h.hexdigest()[:16]
+    return _SOURCE_FP
+
+
+def step_key(freqs, times, config, mesh, chan_sharded: bool,
+             batch_shape, dtype, donate: bool = False) -> str:
+    """Content-hash key of one compiled step signature.
+
+    Anything that changes the traced program (or the validity of its
+    serialized StableHLO) is in the key: the exact frequency/time axes,
+    the full PipelineConfig, mesh shape + channel sharding, the padded
+    batch shape, the canonical input dtype, input donation, the x64
+    flag, the jax / jaxlib / backend-platform versions, and a digest of
+    this package's own source tree (any scintools_tpu code change can
+    change the traced program, so it must invalidate every artifact)."""
+    import jax
+    import jaxlib
+
+    f = np.ascontiguousarray(np.asarray(freqs, dtype=np.float64))
+    t = np.ascontiguousarray(np.asarray(times, dtype=np.float64))
+    desc = repr((
+        _FORMAT,
+        f.shape, t.shape,
+        repr(config),
+        _mesh_desc(mesh), bool(chan_sharded),
+        tuple(int(s) for s in batch_shape),
+        str(jax.dtypes.canonicalize_dtype(dtype)),
+        bool(donate),
+        bool(jax.config.jax_enable_x64),
+        jax.__version__, jaxlib.__version__, jax.default_backend(),
+        _source_fingerprint(),
+    ))
+    h = hashlib.sha256()
+    h.update(f.tobytes())
+    h.update(t.tobytes())
+    h.update(desc.encode())
+    return h.hexdigest()[:32]
+
+
+def artifact_path(key: str) -> str | None:
+    d = aot_dir()
+    return None if d is None else os.path.join(d, key + ".jaxexport")
+
+
+def export_step(step, batch_shape, dtype, key: str) -> str | None:
+    """AOT-lower ``step`` for one input signature and persist the
+    serialized jax.export artifact under ``key``.  Returns the artifact
+    path, or None when the cache is disabled or export is unsupported
+    for this step (e.g. an exotic sharding) — failure never propagates,
+    the jit path simply stays the fallback."""
+    path = artifact_path(key)
+    if path is None:
+        return None
+    try:
+        import jax
+        from jax import export
+
+        _register_serialization()
+        spec = jax.ShapeDtypeStruct(
+            tuple(int(s) for s in batch_shape),
+            jax.dtypes.canonicalize_dtype(dtype))
+        data = export.export(step)(spec).serialize()
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, path)  # atomic: concurrent warmups can't tear
+        # the file changed: a memoized deserialization of the OLD bytes
+        # must not outlive it (warmup --force relies on this)
+        _LOADED.pop(path, None)
+        return path
+    except Exception:
+        return None
+
+
+_PRIMED = False
+
+
+def _prime_ffi_registrations() -> None:
+    """Eagerly register the backend's lazily-registered custom-call
+    targets before executing a deserialized module.
+
+    jaxlib registers CPU LAPACK/FFI custom-call targets at LOWERING
+    time of the corresponding primitives.  A process that deserializes
+    an exported module and executes it WITHOUT ever lowering those
+    primitives hits an unregistered custom-call target and — on jaxlib
+    0.4.37 CPU — segfaults outright (reproduced: the LM fit's
+    ``linalg.solve``; lowering one solve in-process fixes it).  Lower
+    millisecond-scale surrogates of every linalg/fft primitive the
+    pipeline can embed: trace+lower only — no XLA compile, no
+    execution, no device memory."""
+    global _PRIMED
+    if _PRIMED:
+        return
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        spec = jax.ShapeDtypeStruct(
+            (3, 3), jax.dtypes.canonicalize_dtype(np.float64))
+        for fn in (
+            lambda a: jnp.linalg.solve(a, a),
+            lambda a: jnp.linalg.eigh(a + a.T)[0],
+            lambda a: jnp.linalg.svd(a, compute_uv=False),
+            lambda a: jnp.linalg.qr(a)[0],
+            lambda a: jnp.linalg.cholesky(a @ a.T + 3.0 * jnp.eye(3)),
+            lambda a: jnp.fft.rfft2(a).real + jnp.fft.ifft2(a).real,
+        ):
+            try:
+                jax.jit(fn).lower(spec)
+            except Exception:
+                continue
+        _PRIMED = True
+    except Exception:
+        pass
+
+
+# in-process memo of deserialized steps: repeated run_pipeline calls in
+# one process reuse ONE jit'd wrapper (and its compiled-executable
+# cache) per artifact, mirroring make_pipeline's lru_cache.  Keyed by
+# the artifact PATH (not the content key): the same key under a
+# different SCINT_COMPILE_CACHE dir is a different artifact, and a
+# memo hit must never outlive its file.
+_LOADED: dict = {}
+
+
+def load_step(key: str, count: bool = True):
+    """Deserialize the AOT artifact for ``key`` into a jit'd callable,
+    or None when absent/unreadable.  Increments ``compile_cache_hit`` /
+    ``compile_cache_miss`` (obs counters, no-ops when tracing is off)
+    unless ``count=False``.
+
+    The returned callable is ``jax.jit`` of the deserialized module's
+    call: its first invocation pays XLA compile of the StableHLO, which
+    the persistent compilation cache serves from disk when ``warmup``
+    populated it (warmup compiles via this same loader, so the
+    fingerprints match)."""
+    path = artifact_path(key)
+    if path is None:
+        return None
+    if not os.path.exists(path):
+        if count:
+            obs.inc("compile_cache_miss")
+        return None
+    cached = _LOADED.get(path)
+    if cached is not None:
+        if count:
+            obs.inc("compile_cache_hit")
+        return cached
+    try:
+        import jax
+        from jax import export
+
+        _register_serialization()
+        _prime_ffi_registrations()
+        with open(path, "rb") as fh:
+            data = fh.read()
+        fn = jax.jit(export.deserialize(data).call)
+        _LOADED[path] = fn
+        if count:
+            obs.inc("compile_cache_hit")
+        return fn
+    except Exception:
+        # corrupt / version-skewed artifact: a key mismatch should have
+        # prevented this, but degrade to the jit path rather than fail
+        if count:
+            obs.inc("compile_cache_miss")
+        return None
+
+
+def plan_steps(epochs, config, mesh=None, chunk: int | None = None,
+               pad_chunks: bool = False, batch: int | None = None) -> list:
+    """The exact step signatures a ``run_pipeline(epochs, config, mesh,
+    chunk=..., pad_chunks=...)`` call will execute, as
+    ``[(freqs, times, (b, nf, nt), dtype, chunked), ...]`` — shares the
+    driver's bucketing, divisibility padding and chunk math so a warmup
+    compiles precisely the programs the survey will ask for.
+    ``chunked`` says whether that bucket runs through the chunk loop
+    (which decides input donation — part of the cache key).
+
+    ``batch`` overrides each bucket's epoch count (warm up for the
+    production survey size from a few template files)."""
+    from .parallel import driver as drv
+    from .parallel import mesh as mesh_mod
+
+    multiple = 1
+    if mesh is not None:
+        multiple = mesh.shape[mesh_mod.DATA_AXIS]
+    plans = []
+    for key, idx in drv._bucket_epochs(epochs).items():
+        (nf,), (nt,) = key[0], key[1]
+        n = batch if batch is not None else len(idx)
+        B = -(-n // multiple) * multiple
+        freqs = np.frombuffer(key[2]).reshape(key[0])
+        times = np.frombuffer(key[3]).reshape(key[1])
+        chunked = chunk is not None and chunk < B
+        for b in sorted(drv._step_batch_sizes(B, multiple, chunk,
+                                              pad_chunks=pad_chunks)):
+            # run_pipeline stages batches as float64 (pad_batch)
+            plans.append((freqs, times, (b, nf, nt), np.float64, chunked))
+    return plans

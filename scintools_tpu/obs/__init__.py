@@ -26,5 +26,6 @@ from .core import (Registry, counters, disable, enable,  # noqa: F401
                    render_summary, reset, span, summary, traced, tracing)
 from .jax_helpers import (bytes_of, fence,  # noqa: F401
                           instrument_jit)
-from .report import aggregate, load_events, render, report  # noqa: F401
+from .report import (aggregate, compile_split, load_events,  # noqa: F401
+                     render, report)
 from .sinks import JsonlSink, LogSink  # noqa: F401

@@ -54,9 +54,38 @@ def aggregate(events: list) -> tuple:
     return spans, counters, gauges
 
 
+def compile_split(spans: dict, counters: dict | None = None) -> dict | None:
+    """Cold/warm compile decomposition of a span table: cold compiles
+    (``*.compile`` — full trace+XLA), warm compiles (``*.compile.warm``
+    — AOT-deserialized steps whose XLA work is served by the persistent
+    cache), and execute time, plus the compile-cache counters.  None
+    when the trace has no compile spans at all."""
+    counters = counters or {}
+
+    def total(pred):
+        rows = [s for n, s in spans.items() if pred(n)]
+        return (round(sum(s["total_ms"] for s in rows), 3),
+                sum(s["count"] for s in rows))
+
+    cold = total(lambda n: n.endswith(".compile"))
+    warm = total(lambda n: n.endswith(".compile.warm"))
+    execute = total(lambda n: n.endswith(".execute"))
+    if not (cold[1] or warm[1]):
+        return None
+    return {
+        "cold_compile_ms": cold[0], "cold_compile_spans": cold[1],
+        "warm_compile_ms": warm[0], "warm_compile_spans": warm[1],
+        "execute_ms": execute[0], "execute_spans": execute[1],
+        "compile_cache_hit": int(counters.get("compile_cache_hit", 0)),
+        "compile_cache_miss": int(counters.get("compile_cache_miss", 0)),
+        "jit_cache_miss": int(counters.get("jit_cache_miss", 0)),
+    }
+
+
 def render(spans: dict, counters: dict | None = None,
            gauges: dict | None = None) -> str:
-    """Fixed-width per-stage table, longest-total first, then counters."""
+    """Fixed-width per-stage table, longest-total first, then the
+    cold/warm compile split, then counters."""
     lines = []
     if spans:
         w = max(len("stage"), max(len(n) for n in spans))
@@ -73,6 +102,22 @@ def render(spans: dict, counters: dict | None = None,
                 f"{s['p95_ms']:>10.3f}")
     else:
         lines.append("(no spans)")
+    split = compile_split(spans, counters)
+    if split:
+        lines.append("")
+        lines.append("cold/warm compile split:")
+        lines.append(f"  cold compile  total_ms = "
+                     f"{split['cold_compile_ms']:.3f}  "
+                     f"({split['cold_compile_spans']} span(s))")
+        lines.append(f"  warm compile  total_ms = "
+                     f"{split['warm_compile_ms']:.3f}  "
+                     f"({split['warm_compile_spans']} span(s))")
+        lines.append(f"  execute       total_ms = "
+                     f"{split['execute_ms']:.3f}  "
+                     f"({split['execute_spans']} span(s))")
+        lines.append(f"  compile_cache_hit = {split['compile_cache_hit']}, "
+                     f"compile_cache_miss = {split['compile_cache_miss']}, "
+                     f"jit_cache_miss = {split['jit_cache_miss']}")
     if counters:
         lines.append("")
         lines.append("counters:")

@@ -4,6 +4,7 @@ from .driver import (PipelineConfig, PipelineResult,  # noqa: F401
                      run_pipeline, survey_routes)
 from .mesh import (CHAN_AXIS, DATA_AXIS, data_sharding, make_mesh,  # noqa: F401
                    replicated, shard_leading, sharded_mean)
+from .schedule import execute_chunks  # noqa: F401
 from .distributed import (initialize_multihost,  # noqa: F401
                           make_hybrid_mesh, survey_stats)
 from .large_fft import sspec_host_tiled, sspec_sharded  # noqa: F401

@@ -185,21 +185,27 @@ def _resolve_chan_sharded(mesh, chan_sharded: bool | None) -> bool:
 
 
 def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
-                  mesh=None, chan_sharded: bool | None = None):
+                  mesh=None, chan_sharded: bool | None = None,
+                  donate: bool = False):
     """Build the jit'd batched step for a fixed (freqs, times) template.
 
     ``chan_sharded=None`` (default) derives channel sharding from the
     mesh itself: any mesh with a >1 ``chan`` axis shards the
     secondary-spectrum FFT's channel axis (why else build one).  Pass an
-    explicit bool to override.
+    explicit bool to override.  ``donate=True`` donates the input batch
+    buffer to the step (``donate_argnums``) so XLA reuses its HBM for
+    intermediates — only safe when each call gets a fresh buffer, as the
+    async chunk executor guarantees (schedule.py); donation is a no-op
+    with a warning on CPU, so the driver only requests it on TPU.
 
     Returns ``step(dyn_batch [B, nf, nt]) -> PipelineResult``.  Epochs with
     other shapes go through parallel.batch.pad_batch / bucket_by_shape
     first.  dt/df are taken from the template axes (uniform grids, as the
     reference assumes — dynspec.py:1291-1299).
 
-    Memoised on (axes, config, mesh): repeated calls with the same template
-    return the same compiled step (no retrace/recompile per survey batch).
+    Memoised on (axes, config, mesh, donate): repeated calls with the same
+    template return the same compiled step (no retrace/recompile per
+    survey batch).
     """
     if config.scint_cuts not in ("auto", "fft", "matmul"):
         raise ValueError(
@@ -265,7 +271,8 @@ def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
     times = np.ascontiguousarray(np.asarray(times, dtype=np.float64))
     return _make_pipeline_cached(
         (freqs.tobytes(), freqs.shape), (times.tobytes(), times.shape),
-        config, mesh, _resolve_chan_sharded(mesh, chan_sharded))
+        config, mesh, _resolve_chan_sharded(mesh, chan_sharded),
+        bool(donate))
 
 
 # "auto" falls back to the FFT route above this many bytes of Gram-matrix
@@ -410,18 +417,24 @@ def _adjust_chunk(multiple: int, chunk: int) -> int:
     return max(multiple, (chunk // multiple) * multiple)
 
 
-def _step_batch_sizes(B: int, multiple: int, chunk: int | None) -> set:
+def _step_batch_sizes(B: int, multiple: int, chunk: int | None,
+                      pad_chunks: bool = False) -> set:
     """The set of per-step batch sizes run_pipeline's chunk loop issues
     for a padded bucket of B epochs (an uneven final chunk traces as its
-    own program and may resolve auto routes differently)."""
+    own program and may resolve auto routes differently).  With
+    ``pad_chunks`` the final chunk is padded up to the chunk size, so a
+    chunked survey compiles exactly ONE program."""
     if chunk is None or chunk >= B:
         return {B}
     c = _adjust_chunk(multiple, chunk)
+    if pad_chunks:
+        return {c}
     return {c} | ({B % c} if B % c else set())
 
 
 def survey_routes(epochs, config: "PipelineConfig", mesh=None,
-                  chunk: int | None = None) -> dict:
+                  chunk: int | None = None,
+                  pad_chunks: bool = False) -> dict:
     """Per-bucket resolved routes for a ``run_pipeline`` call with the
     same arguments — the metadata the CLI records beside a resumable
     store.  Shares run_pipeline's bucketing (_bucket_epochs),
@@ -442,14 +455,16 @@ def survey_routes(epochs, config: "PipelineConfig", mesh=None,
         (nf,), (nt,) = key[0], key[1]
         n = len(idx)
         B = -(-n // multiple) * multiple
-        for b in sorted(_step_batch_sizes(B, multiple, chunk)):
+        for b in sorted(_step_batch_sizes(B, multiple, chunk,
+                                          pad_chunks=pad_chunks)):
             out[f"bucket{k}:{n}of{nf}x{nt}:step{b}"] = resolve_routes(
                 config, mesh, batch_shape=(b, nf, nt))
     return out
 
 
 @functools.lru_cache(maxsize=None)
-def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
+def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded,
+                          donate=False):
     import jax
     import jax.numpy as jnp
 
@@ -603,11 +618,13 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
             scint2d=scint2d, tilt=tilt, tilterr=tilterr,
             arc_stacked=arc_stacked)
 
+    kw = {}
+    if donate:
+        kw["donate_argnums"] = 0
     if mesh is None:
-        return jax.jit(step)
+        return jax.jit(step, **kw)
 
     in_shard = mesh_mod.data_sharding(mesh, chan_sharded=chan_sharded)
-    kw = {}
     if jax.process_count() > 1:
         # multihost: replicate outputs inside the compiled program (an
         # ICI/DCN all-gather) so every process can materialise full
@@ -617,30 +634,65 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded):
     return jax.jit(step, in_shardings=in_shard, **kw)
 
 
-def _as_global_batch(dyn, mesh, chan_sharded: bool):
+def _resolve_donate(async_exec: bool, chunked: bool, mesh) -> bool:
+    """Input-donation rule — single source of truth for run_pipeline and
+    the warmup CLI (the AOT cache key includes donation, so the two must
+    agree).  Donate only on the async chunked path (each chunk is a
+    fresh buffer) and only on TPU (CPU ignores donation with a
+    warning)."""
+    return bool(async_exec and chunked and _target_is_tpu(mesh))
+
+
+def _as_global_batch(dyn, mesh, chan_sharded: bool, commit: bool = False):
     """Under a multi-process runtime, assemble the (host-replicated)
     batch into a global jax.Array: each process contributes exactly its
     addressable shards by global index.  Single-process: pass through
-    (jit's in_shardings handles the device_put).  ``chan_sharded`` is
-    the already-resolved bool (_resolve_chan_sharded)."""
+    (jit's in_shardings handles the device_put), unless ``commit=True``
+    — then the transfer happens HERE (with the mesh sharding applied),
+    which the async executor uses to move H2D onto the prefetch thread
+    and the AOT-loaded step requires (a deserialized export demands
+    correctly-placed inputs).  ``chan_sharded`` is the already-resolved
+    bool (_resolve_chan_sharded)."""
     import jax
 
-    if mesh is None or jax.process_count() <= 1:
+    if mesh is not None and jax.process_count() > 1:
+        sh = mesh_mod.data_sharding(mesh, chan_sharded=chan_sharded)
+        return jax.make_array_from_callback(dyn.shape, sh,
+                                            lambda idx: dyn[idx])
+    if not commit:
         return dyn
-    sh = mesh_mod.data_sharding(mesh, chan_sharded=chan_sharded)
-    return jax.make_array_from_callback(dyn.shape, sh,
-                                        lambda idx: dyn[idx])
+    if mesh is not None:
+        return jax.device_put(
+            dyn, mesh_mod.data_sharding(mesh, chan_sharded=chan_sharded))
+    return jax.device_put(dyn)
 
 
 def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
                  mesh=None, chunk: int | None = None,
-                 chan_sharded: bool | None = None):
+                 chan_sharded: bool | None = None,
+                 async_exec: bool = True, pad_chunks: bool = False):
     """Host-side convenience driver: bucket heterogeneous epochs by shape,
     pad each bucket to the mesh's data-axis multiple, run the jit'd step
     per bucket (optionally in memory-bounded chunks), and gather results
     with invalid lanes dropped.  ``chan_sharded=None`` derives channel
     sharding from the mesh (any >1 ``chan`` axis shards the big
     secondary-spectrum FFT; see make_pipeline).
+
+    ``async_exec`` (default on) overlaps host staging with device
+    execution on the chunked path: a prefetch thread slices, pads and
+    transfers chunk k+1 while the device runs chunk k (bounded queue,
+    depth 2 — parallel.schedule); the sync path (``async_exec=False``)
+    is preserved and bit-identical (tested).  ``pad_chunks`` pads the
+    final uneven chunk up to the chunk size with mask-invalid lanes
+    (sliced off at gather, like divisibility pads), so a chunked survey
+    compiles exactly ONE program instead of two.
+
+    When the persistent compile cache is enabled (``SCINT_COMPILE_CACHE``,
+    on by default — scintools_tpu.compile_cache) each step signature is
+    first looked up as an AOT artifact written by ``scintools-tpu
+    warmup``: a hit deserializes the step instead of re-tracing it
+    (``compile_cache_hit``/``compile_cache_miss`` counters), so a warmed
+    fresh process re-traces nothing (``jit_cache_miss == 0``).
 
     Returns a list of (indices, PipelineResult) per bucket, where
     ``indices`` maps result lanes back to the input epoch order: lane k of
@@ -651,27 +703,33 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
     records the stage spans ``pipeline.stage`` (host staging: bucketing,
     padding, step build), ``pipeline.step.compile`` /
     ``pipeline.step.execute`` (the fused sspec→arc-fit device step, with
-    compile time split from fenced execute time per input signature) and
+    compile time split from fenced execute time per input signature),
+    ``pipeline.prefetch`` (async host staging per chunk) and
     ``pipeline.gather`` (result slicing to host), under one
     ``pipeline.run`` root, plus ``epochs_processed`` / ``bytes_h2d`` /
-    ``jit_cache_miss`` counters.  Disabled tracing takes the identical
-    dispatch path (tests assert bit-identical results on vs off).
+    ``jit_cache_miss`` / ``prefetch_stall_s`` counters.  Disabled
+    tracing takes the identical dispatch path (tests assert bit-identical
+    results on vs off).
     """
+    from .. import compile_cache
     from .batch import pad_batch
+    from .schedule import execute_chunks
 
     multiple = 1
     if mesh is not None:
         multiple = mesh.shape[mesh_mod.DATA_AXIS]
     chan_sharded = _resolve_chan_sharded(mesh, chan_sharded)
+    use_cache = compile_cache.cache_dir() is not None
+    if use_cache:
+        compile_cache.enable_persistent_cache()
     results = []
     with obs.span("pipeline.run", epochs=len(epochs)):
         for idx in _bucket_epochs(epochs).values():
             with obs.span("pipeline.stage", epochs=len(idx)) as stage_sp:
                 group = [epochs[i] for i in idx]
                 batch, _mask = pad_batch(group, batch_multiple=multiple)
-                step = make_pipeline(np.asarray(group[0].freqs),
-                                     np.asarray(group[0].times), config,
-                                     mesh=mesh, chan_sharded=chan_sharded)
+                freqs_np = np.asarray(group[0].freqs)
+                times_np = np.asarray(group[0].times)
                 dyn = np.asarray(batch.dyn)
                 if config.arc_stack and not np.all(_mask.epoch):
                     # divisibility pad-lanes are COPIES of the last epoch
@@ -680,6 +738,36 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
                     # NaN-fill them so the stacked nanmean drops them
                     dyn = dyn.copy()
                     dyn[~_mask.epoch] = np.nan
+                c = None
+                if chunk is not None and chunk < dyn.shape[0]:
+                    # memory-bounded chunking; chunk must respect mesh
+                    # divisibility
+                    c = _adjust_chunk(multiple, chunk)
+                    if c != chunk:
+                        import warnings
+
+                        warnings.warn(
+                            f"run_pipeline: chunk={chunk} adjusted to {c} "
+                            f"(the mesh's data axis needs multiples of "
+                            f"{multiple}); size chunk accordingly when "
+                            "bounding device memory", stacklevel=2)
+                    if pad_chunks and dyn.shape[0] % c:
+                        # uniform-chunk padding: extend the final chunk to
+                        # the full chunk size with mask-invalid lanes —
+                        # the same pad-lane machinery as divisibility
+                        # padding (copies of the last epoch, NaN under
+                        # arc_stack so the campaign nanmean drops them),
+                        # sliced off at gather.  One chunk size -> ONE
+                        # compiled program for the whole survey.
+                        pad_n = c - dyn.shape[0] % c
+                        extra = np.repeat(dyn[-1:], pad_n, axis=0)
+                        if config.arc_stack:
+                            extra = np.full_like(extra, np.nan)
+                        dyn = np.concatenate([dyn, extra], axis=0)
+                donate = _resolve_donate(async_exec, c is not None, mesh)
+                step = make_pipeline(freqs_np, times_np, config,
+                                     mesh=mesh, chan_sharded=chan_sharded,
+                                     donate=donate)
                 stage_sp.set(batch_shape=list(dyn.shape))
             obs.inc("epochs_processed", len(idx))
             obs.inc("bytes_h2d", int(dyn.nbytes))
@@ -692,23 +780,45 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
                         config.lm_steps * n_lm_fits * dyn.shape[0])
             step = obs.instrument_jit(step, "pipeline.step")
             B = dyn.shape[0]
-            if chunk is None or chunk >= B:
-                res = step(_as_global_batch(dyn, mesh, chan_sharded))
-            else:
-                # memory-bounded chunking; chunk must respect mesh
-                # divisibility
-                c = _adjust_chunk(multiple, chunk)
-                if c != chunk:
-                    import warnings
+            # AOT lookup: one artifact per step batch size this bucket
+            # will issue (warmup wrote them keyed identically)
+            aot = {}
+            if use_cache:
+                for b in sorted(_step_batch_sizes(B, multiple, c,
+                                                  pad_chunks=pad_chunks)):
+                    fn = compile_cache.load_step(compile_cache.step_key(
+                        freqs_np, times_np, config, mesh, chan_sharded,
+                        (b,) + dyn.shape[1:], dyn.dtype, donate=donate))
+                    if fn is not None:
+                        aot[b] = obs.instrument_jit(fn, "pipeline.step",
+                                                    aot=True)
 
-                    warnings.warn(
-                        f"run_pipeline: chunk={chunk} adjusted to {c} (the "
-                        f"mesh's data axis needs multiples of {multiple}); "
-                        "size chunk accordingly when bounding device "
-                        "memory", stacklevel=2)
-                parts = [step(_as_global_batch(dyn[i:i + c], mesh,
-                                               chan_sharded))
-                         for i in range(0, B, c)]
+            def dispatch(x, _aot=aot, _step=step):
+                fn = _aot.get(int(x.shape[0]))
+                if fn is None:
+                    return _step(x)
+                if isinstance(x, np.ndarray):
+                    # a deserialized export needs correctly-placed
+                    # inputs (it has no in_shardings to do it)
+                    x = _as_global_batch(x, mesh, chan_sharded,
+                                         commit=True)
+                return fn(x)
+
+            if c is None:
+                res = dispatch(_as_global_batch(dyn, mesh, chan_sharded))
+            else:
+                starts = list(range(0, B, c))
+
+                def stage_chunk(k, _dyn=dyn, _starts=starts, _c=c):
+                    i = _starts[k]
+                    # commit on the async path: H2D runs on the
+                    # prefetch thread, overlapped with device compute
+                    return _as_global_batch(_dyn[i:i + _c], mesh,
+                                            chan_sharded,
+                                            commit=async_exec)
+
+                parts = execute_chunks(dispatch, len(starts), stage_chunk,
+                                       async_exec=async_exec)
                 res = _concat_results(parts)
             with obs.span("pipeline.gather", epochs=len(idx)):
                 results.append((np.asarray(idx),

@@ -1,0 +1,114 @@
+"""Async double-buffered chunk execution for the batch driver.
+
+``run_pipeline``'s chunk loop used to stage (slice/pad/transfer),
+execute, and gather strictly serially, so the device idled during host
+work even on warm surveys.  This module overlaps them the way GPU
+pulsar pipelines hide host costs behind the FFT engine (arXiv:
+1711.10855 §input pipeline): a producer thread stages chunk k+1 —
+numpy slice + device placement — while the device executes chunk k.
+
+Design constraints:
+
+* **Bounded queue, depth 2.**  One staged chunk waiting + one being
+  staged; HBM never holds more than ``depth`` staged inputs beyond the
+  executing one.
+* **Bit-identical to the sync path.**  Staging performs exactly the
+  slice/``device_put`` the jit dispatch would do internally; execution
+  order (and therefore every result) is unchanged — asserted by
+  tests/test_schedule.py against the ``async_exec=False`` path.
+* **Async dispatch preserved.**  ``step()`` returns un-fenced device
+  futures; nothing here blocks on results (the driver's gather fences
+  once at the end), so device-side chunk k+2 dispatch can overlap the
+  k+1 transfer too.
+* **Honest accounting.**  Producer staging runs under a
+  ``pipeline.prefetch`` span; consumer time spent waiting on the queue
+  accumulates in the ``prefetch_stall_s`` counter (seconds the device
+  loop was starved by host staging).  Chunk 0's wait is excluded — it
+  is unavoidable startup latency with nothing to overlap against, so
+  a zero counter really does mean "staging fully hidden".
+
+Errors on either side propagate: a staging exception re-raises in the
+caller (with the producer stopped), and a step exception stops the
+producer before it stages further chunks.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from .. import obs
+
+# one staged chunk in the queue + one being staged by the producer
+DEFAULT_DEPTH = 2
+
+
+class _StageError:
+    """Sentinel carrying a producer-side exception to the consumer."""
+
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def execute_chunks(step, n_chunks: int, stage, *, async_exec: bool = True,
+                   depth: int = DEFAULT_DEPTH) -> list:
+    """Run ``[step(stage(k)) for k in range(n_chunks)]`` with chunk
+    staging overlapped against device execution.
+
+    ``stage(k)`` builds the device-ready input for chunk ``k`` (host
+    slicing/padding + transfer); ``step(x)`` dispatches the compiled
+    program (asynchronously — results are futures).  Results come back
+    in chunk order.  ``async_exec=False`` (or a single chunk) runs the
+    exact serial loop.
+    """
+    if not async_exec or n_chunks <= 1:
+        return [step(stage(k)) for k in range(n_chunks)]
+
+    q: queue.Queue = queue.Queue(maxsize=max(int(depth) - 1, 1))
+    stop = threading.Event()
+
+    def produce():
+        for k in range(n_chunks):
+            if stop.is_set():
+                return
+            try:
+                with obs.span("pipeline.prefetch", chunk=k):
+                    item = stage(k)
+            except BaseException as e:  # re-raised by the consumer
+                item = _StageError(e)
+            while not stop.is_set():
+                try:
+                    q.put((k, item), timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            if isinstance(item, _StageError):
+                return
+
+    producer = threading.Thread(target=produce, name="scint-prefetch",
+                                daemon=True)
+    producer.start()
+    results = []
+    stall_s = 0.0
+    try:
+        for n in range(n_chunks):
+            t0 = time.perf_counter()
+            k, item = q.get()
+            if n > 0:
+                # chunk 0's staging wait is unavoidable startup latency
+                # (nothing to overlap against yet); only waits while
+                # the device could have been busy count as stalls, so
+                # prefetch_stall_s == 0 really means "fully hidden"
+                stall_s += time.perf_counter() - t0
+            if isinstance(item, _StageError):
+                raise item.exc
+            results.append(step(item))
+    finally:
+        stop.set()
+        producer.join()
+        if stall_s:
+            obs.inc("prefetch_stall_s", round(stall_s, 6))
+    return results

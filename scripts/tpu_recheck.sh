@@ -57,38 +57,26 @@ gated() {
     | tail -"$tail_n"
 }
 
-echo "== probe =="
-probe || { echo "tunnel unreachable; aborting"; exit 1; }
+# triage <label> <timeout_s> <cmd...>: non-aborting variant of gated for
+# the post-failure diagnosis path — prints the unfiltered tail and a
+# PASS/FAIL verdict, returns the stage's status instead of exiting.
+triage() {
+  local label="$1" tmo="$2"
+  shift 2
+  if timeout -k 10 "$tmo" "$@" > "$stage_out" 2>&1; then
+    { grep -v -E 'INFO|WARN|axon_|Logging|E0000' "$stage_out" || true; } \
+      | tail -2
+    echo "$label: PASS"
+    return 0
+  fi
+  tail -12 "$stage_out"
+  echo "$label: FAIL (unfiltered tail above)"
+  return 1
+}
 
-# STAGE ORDER = MARGINAL EVIDENCE PER HEALTHY MINUTE.  The tunnel's
-# healthy windows are minute-scale (the 2026-08-02 window lasted just
-# long enough for the bench before wedging at the next stage), so:
-#   1. headline bench         (round's #1 deliverable; landed 2026-08-02,
-#                              a repeat in a healthier window raises it)
-#   2-3. pallas gate + nudft bf16 guard (sub-minute CORRECTNESS verdicts
-#        that validate every capture below; CPU CI cannot see either)
-#   4. f32 on-chip budget     (published figures' only missing capture)
-#   5. all five configs       (configs 1-3 have no on-chip record)
-#   6. B=256 stage profile    (repeat-healthy-flight evidence)
-#   7. B=1024 auto-route A/B  (repeat-healthy-flight evidence)
-#   8. arc-tail A/B           (fast-tail on-chip verdict)
-#   9. pallas prove-or-remove A/B (perf regression guard; has a round-4
-#      verdict already, so it rides last)
-echo "== headline bench =="
-# gated: a bench that wedges or falls back to CPU exits nonzero, and
-# every stage below is then doomed (wedge) or suspect — abort with the
-# unfiltered tail rather than spending the window on a dead tunnel
-gated "headline bench" 2400 2 python bench.py
-
-echo "== pallas row-scrunch lowers on chip =="
-# the fused row-scrunch kernel is the arc fitter's on-chip auto route
-# since round 4 (wire verdict, 3.5x the scan); CI validates it in
-# interpret mode only, so this is the real-Mosaic correctness gate.
-# Gated on python's EXIT STATUS (the rel-err line prints before the
-# assert, so grepping for it cannot detect a failure).  (The Pallas
-# NUDFT that was also gated here was deleted in round 4: 0.44x the
-# production einsum — benchmarks/pallas_ab.py.)
-gated "pallas lowering check" 600 2 python -u -c "
+# the two sub-minute correctness gates, defined once so BOTH the normal
+# stage sequence and the headline-failure triage run the same code
+PALLAS_CODE="
 import numpy as np
 from scintools_tpu.ops.resample_pallas import row_scrunch_pallas
 rng = np.random.default_rng(0)
@@ -113,12 +101,7 @@ print('row-scrunch pallas on-chip rel err:', err2)
 assert err2 < 5e-3, err2
 "
 
-echo "== nudft einsum on-chip accuracy (bf16-lowering guard) =="
-# the round-4 A/B caught the vmapped einsum NUDFT silently lowering to
-# bf16 MXU passes (2e-3 scaled error); _nudft_jax_reim now pins
-# Precision.HIGHEST.  CPU CI cannot see this (einsum precision is exact
-# there), so the on-chip oracle check lives here permanently.
-gated "nudft einsum accuracy check" 600 2 python -u -c "
+NUDFT_CODE="
 import numpy as np, jax, jax.numpy as jnp
 from scintools_tpu.ops.nudft import _nudft_numpy, _r_grid, nudft
 rng = np.random.default_rng(1)
@@ -137,6 +120,69 @@ err = float(np.max(np.abs(a[0] - pw)) / pw.max())
 print('vmapped einsum nudft vs f64 oracle, scaled err:', err)
 assert err < 2e-4, ('bf16 MXU lowering is back?', err)
 "
+
+echo "== probe =="
+probe || { echo "tunnel unreachable; aborting"; exit 1; }
+
+# STAGE ORDER = MARGINAL EVIDENCE PER HEALTHY MINUTE.  The tunnel's
+# healthy windows are minute-scale (the 2026-08-02 window lasted just
+# long enough for the bench before wedging at the next stage), so:
+#   1. headline bench         (round's #1 deliverable; landed 2026-08-02,
+#                              a repeat in a healthier window raises it)
+#   2-3. pallas gate + nudft bf16 guard (sub-minute CORRECTNESS verdicts
+#        that validate every capture below; CPU CI cannot see either)
+#   4. f32 on-chip budget     (published figures' only missing capture)
+#   5. all five configs       (configs 1-3 have no on-chip record)
+#   6. B=256 stage profile    (repeat-healthy-flight evidence)
+#   7. B=1024 auto-route A/B  (repeat-healthy-flight evidence)
+#   8. arc-tail A/B           (fast-tail on-chip verdict)
+#   9. pallas prove-or-remove A/B (perf regression guard; has a round-4
+#      verdict already, so it rides last)
+echo "== headline bench =="
+# a bench that wedges or falls back to CPU exits nonzero, and every
+# hour-scale stage below is then doomed (wedge) or suspect — abort
+# rather than spending the window on a dead tunnel.  BUT a bench
+# failure can also be a genuine repo regression (not weather), so
+# before exiting nonzero still attempt the two SUB-MINUTE correctness
+# gates: they cost ~a minute against a 2400 s bench budget, and their
+# verdicts distinguish "tunnel dead" (both hang/fail to init) from
+# "regression" (gates pass, bench genuinely broken) — ADVICE r5.
+if ! timeout -k 10 2400 python bench.py > "$stage_out" 2>&1; then
+  tail -12 "$stage_out"
+  echo "headline bench FAILED (unfiltered tail above)"
+  echo "== post-failure triage: sub-minute correctness gates =="
+  triage "pallas lowering check" 600 python -u -c "$PALLAS_CODE"
+  pallas_rc=$?
+  triage "nudft einsum accuracy check" 600 python -u -c "$NUDFT_CODE"
+  nudft_rc=$?
+  if [ "$pallas_rc" -eq 0 ] && [ "$nudft_rc" -eq 0 ]; then
+    echo "triage verdict: correctness gates PASS on chip — the bench" \
+         "failure looks like a genuine regression, not tunnel weather"
+  else
+    echo "triage verdict: correctness gates also failing — consistent" \
+         "with a wedged tunnel, not a repo regression"
+  fi
+  exit 1
+fi
+{ grep -v -E 'INFO|WARN|axon_|Logging|E0000' "$stage_out" || true; } \
+  | tail -2
+
+echo "== pallas row-scrunch lowers on chip =="
+# the fused row-scrunch kernel is the arc fitter's on-chip auto route
+# since round 4 (wire verdict, 3.5x the scan); CI validates it in
+# interpret mode only, so this is the real-Mosaic correctness gate.
+# Gated on python's EXIT STATUS (the rel-err line prints before the
+# assert, so grepping for it cannot detect a failure).  (The Pallas
+# NUDFT that was also gated here was deleted in round 4: 0.44x the
+# production einsum — benchmarks/pallas_ab.py.)
+gated "pallas lowering check" 600 2 python -u -c "$PALLAS_CODE"
+
+echo "== nudft einsum on-chip accuracy (bf16-lowering guard) =="
+# the round-4 A/B caught the vmapped einsum NUDFT silently lowering to
+# bf16 MXU passes (2e-3 scaled error); _nudft_jax_reim now pins
+# Precision.HIGHEST.  CPU CI cannot see this (einsum precision is exact
+# there), so the on-chip oracle check lives here permanently.
+gated "nudft einsum accuracy check" 600 2 python -u -c "$NUDFT_CODE"
 
 echo "== f32 numerics budget on chip =="
 # hardware tier of the f32 drift suite: chip-f32 vs host-f64 oracle

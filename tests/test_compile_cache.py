@@ -1,0 +1,333 @@
+"""Fixed-cost amortization layer (scintools_tpu.compile_cache): cache
+keys, AOT export→import round trips, the warmup→process zero-retrace
+contract, and uniform-chunk padding.  Everything runs on the forced-CPU
+test backend (no device assumptions); cache dirs are isolated per test
+via SCINT_COMPILE_CACHE."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from synth import synth_arc_epoch
+
+from scintools_tpu import compile_cache, obs
+from scintools_tpu.parallel import PipelineConfig, make_mesh, run_pipeline
+from scintools_tpu.parallel.driver import (_step_batch_sizes,
+                                           make_pipeline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = PipelineConfig(arc_numsteps=96, lm_steps=3)
+
+
+@pytest.fixture()
+def cache_dir(tmp_path, monkeypatch):
+    """Isolated persistent-cache dir + clean obs state per test."""
+    d = str(tmp_path / "scc")
+    monkeypatch.setenv("SCINT_COMPILE_CACHE", d)
+    obs.disable(flush=False)
+    obs.reset()
+    yield d
+    obs.disable(flush=False)
+    obs.reset()
+
+
+def _leaves(buckets):
+    import jax
+
+    out = []
+    for _idx, res in buckets:
+        out.extend(np.asarray(x)
+                   for x in jax.tree_util.tree_leaves(res))
+    return out
+
+
+def test_cache_dir_env_switch(monkeypatch):
+    monkeypatch.setenv("SCINT_COMPILE_CACHE", "/tmp/somewhere")
+    assert compile_cache.cache_dir() == "/tmp/somewhere"
+    for off in ("0", "off", "none", ""):
+        monkeypatch.setenv("SCINT_COMPILE_CACHE", off)
+        assert compile_cache.cache_dir() is None
+        assert compile_cache.enable_persistent_cache() is None
+        assert compile_cache.artifact_path("k") is None
+    monkeypatch.delenv("SCINT_COMPILE_CACHE")
+    assert compile_cache.cache_dir() == os.path.expanduser(
+        compile_cache.DEFAULT_DIR)
+
+
+def test_step_key_invalidation(cache_dir, monkeypatch):
+    """Anything that changes the compiled program changes the key:
+    config, axes, batch shape, dtype, mesh, donation, and the jax
+    version (a new jax must never deserialize an old artifact)."""
+    import jax
+
+    e = synth_arc_epoch(seed=0)
+    f, t = np.asarray(e.freqs), np.asarray(e.times)
+    base = compile_cache.step_key(f, t, CFG, None, False, (4, 64, 64),
+                                  np.float64)
+    assert base == compile_cache.step_key(f, t, CFG, None, False,
+                                          (4, 64, 64), np.float64)
+    others = [
+        compile_cache.step_key(f, t, PipelineConfig(arc_numsteps=97,
+                                                    lm_steps=3),
+                               None, False, (4, 64, 64), np.float64),
+        compile_cache.step_key(f + 1.0, t, CFG, None, False, (4, 64, 64),
+                               np.float64),
+        compile_cache.step_key(f, t, CFG, None, False, (8, 64, 64),
+                               np.float64),
+        compile_cache.step_key(f, t, CFG, None, False, (4, 64, 64),
+                               np.float32),
+        compile_cache.step_key(f, t, CFG, make_mesh(), True, (4, 64, 64),
+                               np.float64),
+        compile_cache.step_key(f, t, CFG, None, False, (4, 64, 64),
+                               np.float64, donate=True),
+    ]
+    monkeypatch.setattr(jax, "__version__", "999.0.0")
+    others.append(compile_cache.step_key(f, t, CFG, None, False,
+                                         (4, 64, 64), np.float64))
+    assert len({base, *others}) == len(others) + 1
+
+
+def test_aot_roundtrip_equals_live_step(cache_dir):
+    """Acceptance: the exported→serialized→deserialized step returns a
+    bit-identical PipelineResult to the live-traced jit step."""
+    import jax
+
+    eps = [synth_arc_epoch(seed=s) for s in range(3)]
+    f, t = np.asarray(eps[0].freqs), np.asarray(eps[0].times)
+    dyn = np.stack([np.asarray(e.dyn, dtype=np.float64) for e in eps])
+    step = make_pipeline(f, t, CFG)
+    key = compile_cache.step_key(f, t, CFG, None, False, dyn.shape,
+                                 dyn.dtype)
+    path = compile_cache.export_step(step, dyn.shape, dyn.dtype, key)
+    assert path is not None and os.path.exists(path)
+    loaded = compile_cache.load_step(key)
+    assert loaded is not None
+    live = step(dyn)
+    aot = loaded(dyn)
+    assert type(aot) is type(live)
+    l1 = jax.tree_util.tree_leaves(live)
+    l2 = jax.tree_util.tree_leaves(aot)
+    assert len(l1) == len(l2)
+    for a, b in zip(l1, l2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_load_step_counters_and_memo(cache_dir):
+    """A lookup miss counts compile_cache_miss; a hit counts
+    compile_cache_hit; repeated loads reuse ONE in-process callable so
+    the jit executable cache survives across run_pipeline calls."""
+    e = synth_arc_epoch(seed=0)
+    f, t = np.asarray(e.freqs), np.asarray(e.times)
+    key = compile_cache.step_key(f, t, CFG, None, False, (2, 64, 64),
+                                 np.float64)
+    with obs.tracing():
+        assert compile_cache.load_step(key) is None
+        assert obs.counters().get("compile_cache_miss") == 1
+        step = make_pipeline(f, t, CFG)
+        compile_cache.export_step(step, (2, 64, 64), np.float64, key)
+        fn1 = compile_cache.load_step(key)
+        fn2 = compile_cache.load_step(key)
+        assert fn1 is fn2 is not None
+        assert obs.counters().get("compile_cache_hit") == 2
+
+
+def test_run_pipeline_aot_zero_retrace_in_process(cache_dir):
+    """After an in-process export of the exact signature, a traced
+    run_pipeline serves the step from the artifact: compile_cache_hit
+    >= 1, jit_cache_miss == 0, results bit-identical to the jit path."""
+    eps = [synth_arc_epoch(seed=s) for s in range(3)]
+    ref = run_pipeline(eps, CFG)   # jit path (cold; nothing exported yet)
+    f, t = np.asarray(eps[0].freqs), np.asarray(eps[0].times)
+    step = make_pipeline(f, t, CFG)
+    key = compile_cache.step_key(f, t, CFG, None, False, (3, 64, 64),
+                                 np.float64)
+    assert compile_cache.export_step(step, (3, 64, 64), np.float64,
+                                     key) is not None
+    with obs.tracing() as reg:
+        res = run_pipeline(eps, CFG)
+        c = obs.counters()
+        names = [ev["name"] for ev in reg.events()]
+    assert c.get("compile_cache_hit", 0) >= 1
+    assert c.get("jit_cache_miss", 0) == 0
+    # the warm compile records under its own span name for the report's
+    # cold/warm split
+    assert "pipeline.step.compile.warm" in names
+    assert "pipeline.step.compile" not in names
+    for a, b in zip(_leaves(ref), _leaves(res)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_warmup_cli_then_fresh_run_zero_retrace(cache_dir, tmp_path):
+    """Acceptance: `scintools-tpu warmup` in one FRESH process, then
+    the pipeline in a SECOND fresh process (the production survey
+    flow), shows zero retrace: jit_cache_miss == 0, compile_cache_hit
+    >= 1, finite results.  Both subprocesses are genuinely cold — this
+    is also the regression test for the jaxlib lazy-FFI-registration
+    segfault (compile_cache._prime_ffi_registrations)."""
+    from scintools_tpu.io.psrflux import write_psrflux
+
+    files = []
+    for s in range(3):
+        fn = str(tmp_path / f"tmpl_{s}.dynspec")
+        write_psrflux(synth_arc_epoch(seed=s), fn)
+        files.append(fn)
+    # warm-up config: scint-only (cheap compile) — must match the
+    # consumer's PipelineConfig below through _pipeline_config_from_args
+    env = dict(os.environ,
+               SCINT_COMPILE_CACHE=cache_dir,
+               JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    code = ("from scintools_tpu.backend import force_host_cpu_devices\n"
+            "force_host_cpu_devices(8)\n"
+            "import jax\n"
+            "jax.config.update('jax_enable_x64', True)\n"
+            "from scintools_tpu.cli import main\n"
+            "import sys\n"
+            "sys.exit(main(['warmup', '--no-arc'] + %r))\n" % files)
+    out = subprocess.run([sys.executable, "-c", code], text=True,
+                         capture_output=True, timeout=600, env=env,
+                         cwd=REPO)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    rec = json.loads([ln for ln in out.stdout.splitlines()
+                      if ln.startswith("{")][-1])
+    assert rec["signatures"], rec
+    assert all(s["status"] in ("exported", "cached")
+               for s in rec["signatures"]), rec
+    # second process: a COLD consumer that never traced this config
+    consumer = (
+        "from scintools_tpu.backend import force_host_cpu_devices\n"
+        "force_host_cpu_devices(8)\n"
+        "import jax\n"
+        "jax.config.update('jax_enable_x64', True)\n"
+        "import json\n"
+        "import numpy as np\n"
+        "from scintools_tpu import obs\n"
+        "from scintools_tpu.io.psrflux import read_psrflux\n"
+        "from scintools_tpu.ops.clean import refill, trim_edges\n"
+        "from scintools_tpu.parallel import (PipelineConfig, make_mesh,\n"
+        "                                    run_pipeline)\n"
+        "epochs = [refill(trim_edges(read_psrflux(f))) for f in %r]\n"
+        "cfg = PipelineConfig(lamsteps=False, fit_arc=False)\n"
+        "with obs.tracing():\n"
+        "    buckets = run_pipeline(epochs, cfg, mesh=make_mesh())\n"
+        "    c = obs.counters()\n"
+        "(_i, res), = buckets\n"
+        "print(json.dumps({'counters': c,\n"
+        "                  'tau_finite': bool(np.all(np.isfinite(\n"
+        "                      np.asarray(res.scint.tau))))}))\n" % files)
+    out = subprocess.run([sys.executable, "-c", consumer], text=True,
+                         capture_output=True, timeout=600, env=env,
+                         cwd=REPO)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    rec = json.loads([ln for ln in out.stdout.splitlines()
+                      if ln.startswith("{")][-1])
+    assert rec["counters"].get("compile_cache_hit", 0) >= 1, rec
+    assert rec["counters"].get("jit_cache_miss", 0) == 0, rec
+    assert rec["tau_finite"], rec
+
+
+def test_uniform_chunk_padding_identical_lanes(cache_dir):
+    """pad_chunks pads the final uneven chunk to the chunk size and the
+    gathered lanes still map 1:1 to the input epochs: full-chunk lanes
+    bit-identical, final-chunk lanes equal to tight tolerance (that
+    chunk legitimately runs a different-shaped program without
+    padding), and only ONE step batch size is issued."""
+    eps = [synth_arc_epoch(seed=s) for s in range(5)]
+    assert _step_batch_sizes(5, 1, 2) == {2, 1}
+    assert _step_batch_sizes(5, 1, 2, pad_chunks=True) == {2}
+    [(idx_a, a)] = run_pipeline(eps, CFG, chunk=2, async_exec=False)
+    [(idx_b, b)] = run_pipeline(eps, CFG, chunk=2, pad_chunks=True,
+                                async_exec=False)
+    np.testing.assert_array_equal(idx_a, idx_b)
+    tau_a, tau_b = np.asarray(a.scint.tau), np.asarray(b.scint.tau)
+    eta_a, eta_b = np.asarray(a.arc.eta), np.asarray(b.arc.eta)
+    assert tau_b.shape == (5,) and eta_b.shape == (5,)
+    # lanes 0-3 ran in identical full chunks: bit-identical
+    np.testing.assert_array_equal(tau_a[:4], tau_b[:4])
+    np.testing.assert_array_equal(eta_a[:4], eta_b[:4])
+    # lane 4: same math at a different batch shape (1 vs 2)
+    np.testing.assert_allclose(tau_a[4:], tau_b[4:], rtol=1e-8)
+    np.testing.assert_allclose(eta_a[4:], eta_b[4:], rtol=1e-8)
+
+
+def test_uniform_chunk_padding_arc_stack_unbiased(cache_dir):
+    """Under arc_stack the chunk pad-lanes are NaN-filled so they drop
+    out of the campaign nanmean — a padded final chunk must measure the
+    same sub-campaign curvature as the unpadded one."""
+    cfg = PipelineConfig(arc_numsteps=96, lm_steps=3, arc_stack=True)
+    eps = [synth_arc_epoch(seed=s) for s in range(3)]
+    [(_, a)] = run_pipeline(eps, cfg, chunk=2, async_exec=False)
+    [(_, b)] = run_pipeline(eps, cfg, chunk=2, pad_chunks=True,
+                            async_exec=False)
+    # chunked campaign: one sub-campaign fit per chunk ([2] leaves)
+    eta_a = np.asarray(a.arc_stacked.eta)
+    eta_b = np.asarray(b.arc_stacked.eta)
+    assert eta_a.shape == eta_b.shape == (2,)
+    np.testing.assert_array_equal(eta_a[0], eta_b[0])
+    # final sub-campaign: 1 real epoch either way (pad lanes are NaN),
+    # measured at a different batch shape
+    np.testing.assert_allclose(eta_a[1], eta_b[1], rtol=1e-8)
+
+
+def test_run_pipeline_cache_disabled_no_lookups(monkeypatch):
+    """SCINT_COMPILE_CACHE=off: no artifact lookups, no counters, and
+    the pipeline runs exactly as before."""
+    monkeypatch.setenv("SCINT_COMPILE_CACHE", "off")
+    obs.disable(flush=False)
+    obs.reset()
+    eps = [synth_arc_epoch(seed=s) for s in range(2)]
+    with obs.tracing():
+        res = run_pipeline(eps, CFG)
+        c = obs.counters()
+    assert "compile_cache_hit" not in c
+    assert "compile_cache_miss" not in c
+    assert c.get("jit_cache_miss", 0) >= 0
+    (_idx, r), = res
+    assert np.asarray(r.scint.tau).shape == (2,)
+
+
+def test_plan_steps_matches_run_pipeline_signatures(cache_dir):
+    """plan_steps (the warmup planner) predicts exactly the signatures
+    run_pipeline executes, including the uneven trailing chunk and the
+    --batch override."""
+    eps = [synth_arc_epoch(seed=s) for s in range(5)]
+    plans = compile_cache.plan_steps(eps, CFG, chunk=2)
+    shapes = sorted(p[2] for p in plans)
+    assert shapes == [(1, 64, 64), (2, 64, 64)]
+    assert all(p[4] for p in plans)  # both signatures are chunked
+    plans = compile_cache.plan_steps(eps, CFG, chunk=2, pad_chunks=True)
+    assert [p[2] for p in plans] == [(2, 64, 64)]
+    plans = compile_cache.plan_steps(eps[:2], CFG, batch=64, chunk=16)
+    assert sorted(p[2] for p in plans) == [(16, 64, 64)]
+    plans = compile_cache.plan_steps(eps[:2], CFG)
+    assert [p[2] for p in plans] == [(2, 64, 64)]
+    assert not plans[0][4]
+
+
+def test_trace_report_prints_cold_warm_split(cache_dir, tmp_path,
+                                             capsys):
+    """`trace report` decomposes cold vs warm compile time and the
+    compile-cache counters from a traced run."""
+    from scintools_tpu.cli import main as cli_main
+
+    eps = [synth_arc_epoch(seed=s) for s in range(2)]
+    f, t = np.asarray(eps[0].freqs), np.asarray(eps[0].times)
+    step = make_pipeline(f, t, CFG)
+    key = compile_cache.step_key(f, t, CFG, None, False, (2, 64, 64),
+                                 np.float64)
+    compile_cache.export_step(step, (2, 64, 64), np.float64, key)
+    path = str(tmp_path / "trace.jsonl")
+    with obs.tracing(jsonl=path):
+        run_pipeline(eps, CFG)
+    rc = cli_main(["trace", "report", path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "cold/warm compile split:" in out
+    assert "warm compile" in out and "cold compile" in out
+    assert "compile_cache_hit = 1" in out
+    assert "jit_cache_miss = 0" in out

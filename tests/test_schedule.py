@@ -1,0 +1,166 @@
+"""Async double-buffered chunk execution (parallel.schedule): the
+acceptance contract is BIT-IDENTICAL PipelineResults vs the preserved
+sync path — chunked, mesh-sharded, and arc_stack included — plus honest
+prefetch accounting and error propagation."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from synth import synth_arc_epoch
+
+from scintools_tpu import obs
+from scintools_tpu.parallel import (PipelineConfig, execute_chunks,
+                                    make_mesh, run_pipeline)
+
+CFG = PipelineConfig(arc_numsteps=80, lm_steps=3)
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.disable(flush=False)
+    obs.reset()
+    yield
+    obs.disable(flush=False)
+    obs.reset()
+
+
+@pytest.fixture(scope="module")
+def epochs():
+    return [synth_arc_epoch(seed=s) for s in range(5)]
+
+
+def _leaves(buckets):
+    import jax
+
+    out = []
+    for _idx, res in buckets:
+        out.extend(np.asarray(x) for x in jax.tree_util.tree_leaves(res))
+    return out
+
+
+def _assert_bit_identical(a, b):
+    la, lb = _leaves(a), _leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_async_matches_sync_chunked(epochs):
+    """Acceptance: async_exec=True (the default) is bit-identical to the
+    sync path on the chunked route, uneven final chunk included."""
+    sync = run_pipeline(epochs, CFG, chunk=2, async_exec=False)
+    rasync = run_pipeline(epochs, CFG, chunk=2, async_exec=True)
+    _assert_bit_identical(sync, rasync)
+
+
+def test_async_matches_sync_mesh_arc_stack(epochs):
+    """Acceptance: bit-identical under a device mesh WITH the campaign
+    stack (NaN pad-lane handling rides through the async staging)."""
+    cfg = PipelineConfig(arc_numsteps=80, lm_steps=3, arc_stack=True)
+    mesh = make_mesh()
+    sync = run_pipeline(epochs, cfg, mesh=mesh, chunk=8,
+                        async_exec=False)
+    rasync = run_pipeline(epochs, cfg, mesh=mesh, chunk=8,
+                          async_exec=True)
+    _assert_bit_identical(sync, rasync)
+    assert sync[0][1].arc_stacked is not None
+
+
+def test_async_matches_sync_pad_chunks(epochs):
+    """async + uniform-chunk padding together (the production warm-path
+    configuration) still bit-match their sync twins."""
+    sync = run_pipeline(epochs, CFG, chunk=2, pad_chunks=True,
+                        async_exec=False)
+    rasync = run_pipeline(epochs, CFG, chunk=2, pad_chunks=True,
+                          async_exec=True)
+    _assert_bit_identical(sync, rasync)
+
+
+def test_async_records_prefetch_spans_and_stall(epochs):
+    with obs.tracing() as reg:
+        run_pipeline(epochs, CFG, chunk=2, async_exec=True)
+        counters = obs.counters()
+        names = [e["name"] for e in reg.events()]
+    # 5 epochs at chunk=2 -> 3 staged chunks, each under its own span
+    assert names.count("pipeline.prefetch") == 3
+    assert counters.get("prefetch_stall_s", 0) >= 0
+
+
+def test_execute_chunks_orders_results():
+    """Results come back in submission order even when staging is much
+    faster than consumption (queue backpressure)."""
+    staged = []
+
+    def stage(k):
+        staged.append(k)
+        return k
+
+    out = execute_chunks(lambda x: x * 10, 7, stage, async_exec=True)
+    assert out == [0, 10, 20, 30, 40, 50, 60]
+    assert staged == list(range(7))
+    assert execute_chunks(lambda x: -x, 3, lambda k: k,
+                          async_exec=False) == [0, -1, -2]
+
+
+def test_execute_chunks_stage_error_propagates():
+    def stage(k):
+        if k == 2:
+            raise ValueError("bad chunk")
+        return k
+
+    with pytest.raises(ValueError, match="bad chunk"):
+        execute_chunks(lambda x: x, 5, stage, async_exec=True)
+    # the producer thread is joined: no stragglers left behind
+    assert not [t for t in threading.enumerate()
+                if t.name == "scint-prefetch"]
+
+
+def test_execute_chunks_step_error_stops_producer():
+    staged = []
+
+    def stage(k):
+        staged.append(k)
+        return k
+
+    def step(x):
+        if x >= 1:
+            raise RuntimeError("device failed")
+        return x
+
+    with pytest.raises(RuntimeError, match="device failed"):
+        execute_chunks(step, 100, stage, async_exec=True)
+    # bounded queue + stop event: the producer cannot have raced far
+    # past the failure point
+    assert len(staged) <= 5
+    assert not [t for t in threading.enumerate()
+                if t.name == "scint-prefetch"]
+
+
+def test_execute_chunks_depth_bounds_staging():
+    """At most depth-1 staged chunks sit in the queue while one is
+    being staged: the producer must block rather than stage the whole
+    survey ahead (HBM bound)."""
+    in_flight = []
+    peak = []
+    gate = threading.Event()
+
+    def stage(k):
+        in_flight.append(k)
+        return k
+
+    def step(x):
+        # consumer deliberately slow for the first item so the producer
+        # runs ahead as far as the queue allows
+        if x == 0:
+            gate.wait(timeout=0.5)
+        peak.append(len(in_flight))
+        return x
+
+    out = execute_chunks(step, 6, stage, async_exec=True, depth=2)
+    assert out == list(range(6))
+    # with depth=2 the producer can be at most 2 items ahead of the
+    # consumer (1 queued + 1 in stage()) -> when item 0 executes, at
+    # most items 0..2 can have been staged
+    assert peak[0] <= 3, peak
