@@ -253,11 +253,14 @@ def cmd_process(args) -> int:
 def _load_clean_epochs(args, files, log, timers=None):
     """Shared load+clean stage of the batched engine and ``warmup``:
     trim/refill (plus the --clean chain) host-side, quarantining
-    unreadable/degenerate files.  Returns (epochs, names, failed)."""
+    unreadable/degenerate files.  Returns (epochs, names, failed).
+
+    The single-epoch chain itself is ``serve.load_epoch`` — ONE
+    implementation, so a served epoch enters the pipeline bit-identical
+    to a direct run (the byte-equality contract of docs/serving.md)."""
     import contextlib
 
-    from .io.psrflux import read_psrflux
-    from .ops.clean import correct_band, refill, trim_edges, zap
+    from .serve import load_epoch
     from .utils import log_event
 
     epochs, names, failed = [], [], 0
@@ -266,18 +269,8 @@ def _load_clean_epochs(args, files, log, timers=None):
     with stage:
         for fn in files:
             try:
-                d = refill(trim_edges(read_psrflux(fn)))
-                if getattr(args, "clean", False):
-                    # same host-side chain as the per-file engine's
-                    # --clean: channel + subint triage -> repair ->
-                    # bandpass removal
-                    d = correct_band(refill(zap(
-                        zap(d, method="channels", sigma=5),
-                        method="subints", sigma=5)))
-                if d.nchan < 2 or d.nsub < 2:
-                    raise ValueError(
-                        f"degenerate after trim: {d.nchan}x{d.nsub}")
-                epochs.append(d)
+                epochs.append(load_epoch(
+                    fn, clean=getattr(args, "clean", False)))
                 names.append(fn)
             except Exception as e:
                 failed += 1
@@ -286,23 +279,37 @@ def _load_clean_epochs(args, files, log, timers=None):
     return epochs, names, failed
 
 
+def _estimator_opts(args) -> dict:
+    """The shared estimator flags as a plain option dict — the job
+    payload of the serve protocol AND the input of the one
+    PipelineConfig builder (serve.config_from_opts), so ``process
+    --batched``, ``warmup`` and a served survey all run the identical
+    config."""
+    opts = dict(lamsteps=bool(args.lamsteps),
+                no_arc=bool(getattr(args, "no_arc", False)),
+                no_scint=bool(getattr(args, "no_scint", False)),
+                scint_2d=bool(getattr(args, "scint_2d", False)),
+                arc_asymm=bool(getattr(args, "arc_asymm", False)),
+                arc_method=getattr(args, "arc_method", "norm_sspec"),
+                arc_stack=bool(getattr(args, "arc_stack", False)))
+    bracket = getattr(args, "arc_bracket", None)
+    if bracket is not None:
+        opts["arc_bracket"] = [float(bracket[0]), float(bracket[1])]
+    if getattr(args, "clean", False):
+        opts["clean"] = True
+    for k in ("arc_numsteps", "lm_steps"):
+        if getattr(args, k, None) is not None:
+            opts[k] = int(getattr(args, k))
+    return opts
+
+
 def _pipeline_config_from_args(args):
     """PipelineConfig from the shared process/warmup estimator flags —
     one builder, so a warmup compiles exactly the config the survey
     will run."""
-    from .parallel import PipelineConfig
+    from .serve import config_from_opts
 
-    pkw = dict(lamsteps=args.lamsteps,
-               fit_arc=not args.no_arc,
-               fit_scint=not args.no_scint,
-               fit_scint_2d=getattr(args, "scint_2d", False),
-               arc_asymm=getattr(args, "arc_asymm", False),
-               arc_method=getattr(args, "arc_method", "norm_sspec"),
-               arc_stack=getattr(args, "arc_stack", False))
-    bracket = getattr(args, "arc_bracket", None)
-    if bracket is not None:
-        pkw["arc_constraint"] = (float(bracket[0]), float(bracket[1]))
-    return PipelineConfig(**pkw)
+    return config_from_opts(_estimator_opts(args))
 
 
 def _process_batched(args, files, cfg, store, log, timers) -> int:
@@ -313,7 +320,8 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
 
     import numpy as np
 
-    from .io.results import results_row, write_results
+    from .io.results import (batch_lane_row, results_row, row_fit_values,
+                             write_results)
     from .parallel import make_mesh, run_pipeline, survey_routes
     from .utils import content_key, log_event
 
@@ -442,43 +450,15 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
                     store.put_meta(f"arc_stack.{digest}", camp)
             for lane, idx in enumerate(indices):
                 row = results_row(epochs[idx])
-                if res.scint is not None:
-                    row.update(
-                        tau=float(np.asarray(res.scint.tau)[lane]),
-                        tauerr=float(np.asarray(res.scint.tauerr)[lane]),
-                        dnu=float(np.asarray(res.scint.dnu)[lane]),
-                        dnuerr=float(np.asarray(res.scint.dnuerr)[lane]))
-                if res.arc is not None:
-                    key = "betaeta" if args.lamsteps else "eta"
-                    row[key] = float(np.asarray(res.arc.eta)[lane])
-                    row[key + "err"] = float(
-                        np.asarray(res.arc.etaerr)[lane])
-                    # store rows only (CSV keeps the reference schema):
-                    # the parabola-vertex fit error — when it exceeds
-                    # the eta value itself the vertex is noise-amplified
-                    # (near-flat parabola) and the measurement should be
-                    # down-weighted (measured on chip: f32 moves such a
-                    # vertex by 0.24 sigma of THIS error — see
-                    # benchmarks/f32_budget_onchip.py)
-                    row[key + "err2"] = float(
-                        np.asarray(res.arc.etaerr2)[lane])
-                    if res.arc.eta_left is not None:
-                        # per-arm values go to the store rows only (the
-                        # CSV keeps the reference schema)
-                        for arm in ("eta_left", "etaerr_left",
-                                    "eta_right", "etaerr_right"):
-                            row[arm] = float(
-                                np.asarray(getattr(res.arc, arm))[lane])
-                if res.tilt is not None:
-                    # store rows only, like the per-arm values
-                    row["tilt"] = float(np.asarray(res.tilt)[lane])
-                    row["tilterr"] = float(np.asarray(res.tilterr)[lane])
+                # one shared per-lane row builder (io.results) keeps the
+                # batched CLI and the serve worker bit-identical; the
+                # beyond-reference columns (etaerr2, per-arm curvatures,
+                # tilt) stay store-only via write_results' schema filter
+                row.update(batch_lane_row(res, lane, args.lamsteps))
                 # NaN lanes are FAILED fits: quarantine (no CSV row, no
                 # store entry -> retried on resume), as the per-file loop
                 # does via exceptions
-                fitvals = [v for k, v in row.items()
-                           if k in ("tau", "dnu", "eta", "betaeta",
-                                    "tilt")]
+                fitvals = row_fit_values(row)
                 if fitvals and not np.all(np.isfinite(fitvals)):
                     failed += 1
                     obs.inc("epochs_failed")
@@ -610,6 +590,112 @@ def cmd_warmup(args) -> int:
                       "backend": jax.default_backend(),
                       "signatures": sigs, "failed_templates": failed}))
     return 0
+
+
+def cmd_serve(args) -> int:
+    """Run a resident survey worker bound to a queue directory: claim
+    leased jobs, dynamically batch compatible epochs onto the warm
+    compiled step signatures (``run_pipeline(pad_to=batch)``), write
+    idempotent content-keyed result rows, and requeue/poison failures
+    (scintools_tpu.serve; docs/serving.md)."""
+    from . import compile_cache
+    from .parallel import make_mesh
+    from .serve import JobQueue, ServeWorker
+    from .utils import get_logger, log_event
+
+    log = get_logger()
+    queue = JobQueue(args.queue, max_retries=args.max_retries)
+    compile_cache.enable_persistent_cache()
+    mesh = (make_mesh(tuple(int(x) for x in args.mesh)) if args.mesh
+            else None)
+    try:
+        worker = ServeWorker(queue, batch_size=args.batch,
+                             max_wait_s=args.max_wait, lease_s=args.lease,
+                             poll_s=args.poll, mesh=mesh,
+                             async_exec=not args.no_async)
+    except ValueError as e:
+        # e.g. batch/mesh divisibility — a usage error, not a traceback
+        raise SystemExit(str(e))
+    try:
+        stats = worker.run(max_batches=args.max_batches,
+                           exit_on_drain=not args.ignore_drain,
+                           idle_exit_s=args.idle_exit)
+    except KeyboardInterrupt:
+        # leased jobs are reclaimed by lease expiry; report honestly
+        stats = dict(worker.stats)
+        log_event(log, "serve_interrupted", **stats)
+    if args.results:
+        stats["csv_rows"] = queue.results.export_csv(
+            args.results, full=getattr(args, "full_csv", False))
+    print(json.dumps({"queue": args.queue, "worker": worker.worker_id,
+                      **stats}))
+    return 0 if stats["jobs_failed"] == 0 else 1
+
+
+def cmd_submit(args) -> int:
+    """Submit epoch files to a serve queue (idempotent per file
+    content + estimator options); prints one JSON line with the job
+    ids and their states."""
+    from .serve import SurveyClient
+
+    _validate_estimator_flags(args)
+    files = _expand(args.files)
+    client = SurveyClient(args.queue)
+    recs = client.submit(files, _estimator_opts(args))
+    fresh = sum(1 for r in recs if r["status"] == "submitted")
+    missing = sum(1 for r in recs if r["status"] == "missing")
+    base = {"queue": args.queue, "submitted": fresh,
+            "deduped": len(recs) - fresh - missing, "missing": missing,
+            "jobs": recs}
+    if args.wait is not None:
+        waited = client.wait([r["job"] for r in recs
+                              if r["job"] is not None],
+                             timeout=args.wait)
+        print(json.dumps({**base, "done": len(waited["done"]),
+                          "failed": len(waited["failed"]),
+                          "pending": len(waited["pending"])}))
+        return 0 if not (waited["failed"] or waited["pending"]
+                         or missing) else 1
+    print(json.dumps(base))
+    return 0 if missing == 0 else 1
+
+
+def _existing_queue_dir(qdir: str) -> str:
+    """status/drain are read-side verbs: a mistyped path must error,
+    not silently create a fresh empty queue tree (whose all-zero
+    counts would read as 'survey done' — or worse, whose planted
+    drain marker would stop the next worker started there)."""
+    import os
+
+    if not os.path.isdir(qdir):
+        raise SystemExit(f"{qdir}: no such queue directory (submit or "
+                         "serve creates one)")
+    return qdir
+
+
+def cmd_queue_status(args) -> int:
+    """One JSON line of queue state counts (queued/leased/done/failed),
+    stored result rows, depth, and drain flag."""
+    from .serve import SurveyClient
+
+    print(json.dumps({"queue": args.queue,
+                      **SurveyClient(
+                          _existing_queue_dir(args.queue)).status()}))
+    return 0
+
+
+def cmd_drain(args) -> int:
+    """Request worker drain (finish the queue, then exit) and — with
+    ``--timeout`` — wait for the queue to empty."""
+    from .serve import SurveyClient
+
+    client = SurveyClient(_existing_queue_dir(args.queue))
+    st = client.drain(timeout=args.timeout)
+    if args.results:
+        st["csv_rows"] = client.export_csv(
+            args.results, full=getattr(args, "full_csv", False))
+    print(json.dumps({"queue": args.queue, **st}))
+    return 0 if st["drained"] or args.timeout is None else 1
 
 
 def cmd_sort(args) -> int:
@@ -1093,6 +1179,93 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--force", action="store_true",
                    help="re-export even when an artifact already exists")
     q.set_defaults(fn=cmd_warmup)
+
+    q = sub.add_parser(
+        "serve",
+        help="run a resident survey worker: claim queued epochs, batch "
+             "them onto warm compiled steps, write idempotent results "
+             "(the queue dir is the API — see submit/status/drain)")
+    q.add_argument("queue", help="queue directory (created if absent)")
+    q.add_argument("--batch", type=int, default=8,
+                   help="dynamic batch size = the compiled step's padded "
+                        "batch shape (warm it with `warmup --batch N`)")
+    q.add_argument("--max-wait", type=float, default=2.0,
+                   help="max seconds a partial batch waits for more "
+                        "compatible epochs before flushing padded")
+    q.add_argument("--lease", type=float, default=60.0,
+                   help="job lease seconds: a SIGKILLed worker's claims "
+                        "are requeued after this expires")
+    q.add_argument("--poll", type=float, default=0.2,
+                   help="idle queue poll interval (seconds)")
+    q.add_argument("--max-retries", type=int, default=3,
+                   help="retries (with exponential backoff) before a "
+                        "job is poisoned to failed/")
+    q.add_argument("--max-batches", type=int, default=None,
+                   help="exit after this many executed batches")
+    q.add_argument("--idle-exit", type=float, default=None,
+                   help="exit after this many seconds with no work")
+    q.add_argument("--ignore-drain", action="store_true",
+                   help="keep serving even when a drain is requested")
+    q.add_argument("--results", default=None,
+                   help="export the results store to this CSV on exit")
+    q.add_argument("--full-csv", action="store_true",
+                   help="with --results: export EVERY store column")
+    q.add_argument("--no-async", action="store_true",
+                   help="disable the async chunk executor (as process)")
+    q.add_argument("--mesh", type=int, nargs=2, default=None,
+                   metavar=("DATA", "CHAN"),
+                   help="device mesh (as process --batched); --batch "
+                        "must divide by DATA")
+    q.set_defaults(fn=cmd_serve)
+
+    q = sub.add_parser(
+        "submit",
+        help="submit epoch files to a serve queue (idempotent per file "
+             "content + estimator options)")
+    q.add_argument("queue", help="queue directory (created if absent)")
+    q.add_argument("files", nargs="+")
+    q.add_argument("--lamsteps", action="store_true")
+    q.add_argument("--no-arc", action="store_true")
+    q.add_argument("--no-scint", action="store_true")
+    q.add_argument("--scint-2d", action="store_true")
+    q.add_argument("--arc-asymm", action="store_true")
+    q.add_argument("--arc-method", default="norm_sspec",
+                   choices=["norm_sspec", "gridmax", "thetatheta"])
+    q.add_argument("--arc-bracket", type=float, nargs=2, default=None,
+                   metavar=("LO", "HI"))
+    q.add_argument("--clean", action="store_true",
+                   help="RFI/gain cleaning before the fits (enters the "
+                        "job identity, as process --clean enters the "
+                        "resume key)")
+    q.add_argument("--arc-numsteps", type=int, default=None,
+                   help="override the eta-grid size (advanced; enters "
+                        "the job identity)")
+    q.add_argument("--lm-steps", type=int, default=None,
+                   help="override the LM iteration budget (advanced; "
+                        "enters the job identity)")
+    q.add_argument("--wait", type=float, default=None,
+                   help="block until the submitted jobs are terminal "
+                        "(or this many seconds pass)")
+    q.set_defaults(fn=cmd_submit)
+
+    q = sub.add_parser("status",
+                       help="print a serve queue's state as one JSON "
+                            "line")
+    q.add_argument("queue")
+    q.set_defaults(fn=cmd_queue_status)
+
+    q = sub.add_parser(
+        "drain",
+        help="ask the resident worker(s) to finish the queue and exit")
+    q.add_argument("queue")
+    q.add_argument("--timeout", type=float, default=None,
+                   help="wait up to this many seconds for the queue to "
+                        "empty (omit to just set the marker)")
+    q.add_argument("--results", default=None,
+                   help="export the results store to this CSV")
+    q.add_argument("--full-csv", action="store_true",
+                   help="with --results: export EVERY store column")
+    q.set_defaults(fn=cmd_drain)
 
     q = sub.add_parser("sort", help="triage files into good/bad lists")
     q.add_argument("files", nargs="+")

@@ -57,6 +57,46 @@ def results_row(d, scint=None, arc=None) -> dict:
     return meta
 
 
+def batch_lane_row(res, lane: int, lamsteps: bool) -> dict:
+    """Measurement columns for ONE lane of a batched ``PipelineResult``
+    — the single source of truth shared by the CLI's batched engine and
+    the resident serve worker, so a served survey's rows are
+    bit-identical to a direct ``run_pipeline`` run's."""
+    row: dict = {}
+    if res.scint is not None:
+        row.update(
+            tau=float(np.asarray(res.scint.tau)[lane]),
+            tauerr=float(np.asarray(res.scint.tauerr)[lane]),
+            dnu=float(np.asarray(res.scint.dnu)[lane]),
+            dnuerr=float(np.asarray(res.scint.dnuerr)[lane]))
+    if res.arc is not None:
+        key = "betaeta" if lamsteps else "eta"
+        row[key] = float(np.asarray(res.arc.eta)[lane])
+        row[key + "err"] = float(np.asarray(res.arc.etaerr)[lane])
+        # the parabola-vertex fit error (conditioning signal) — store
+        # rows only; write_results' _OPTIONAL filter keeps the CSV on
+        # the reference schema
+        row[key + "err2"] = float(np.asarray(res.arc.etaerr2)[lane])
+        if res.arc.eta_left is not None:
+            # per-arm values go to the store rows only as well
+            for arm in ("eta_left", "etaerr_left",
+                        "eta_right", "etaerr_right"):
+                row[arm] = float(np.asarray(getattr(res.arc, arm))[lane])
+    if res.tilt is not None:
+        # store rows only, like the per-arm values
+        row["tilt"] = float(np.asarray(res.tilt)[lane])
+        row["tilterr"] = float(np.asarray(res.tilterr)[lane])
+    return row
+
+
+def row_fit_values(row: dict) -> list:
+    """The fitted quantities a quarantine decision looks at: a NaN in
+    any of them marks the lane a FAILED fit (retried on resume), as the
+    per-file loop does via exceptions."""
+    return [v for k, v in row.items()
+            if k in ("tau", "dnu", "eta", "betaeta", "tilt")]
+
+
 def read_results(filename: str) -> dict:
     """CSV -> dict of string lists (scint_utils.py:111-124)."""
     with open(filename) as fh:

@@ -27,5 +27,5 @@ from .core import (Registry, counters, disable, enable,  # noqa: F401
 from .jax_helpers import (bytes_of, fence,  # noqa: F401
                           instrument_jit)
 from .report import (aggregate, compile_split, load_events,  # noqa: F401
-                     render, report)
+                     render, report, serve_section)
 from .sinks import JsonlSink, LogSink  # noqa: F401

@@ -82,10 +82,39 @@ def compile_split(spans: dict, counters: dict | None = None) -> dict | None:
     }
 
 
+def serve_section(counters: dict | None,
+                  gauges: dict | None = None) -> dict | None:
+    """Resident-service readout (scintools_tpu.serve): job outcomes,
+    mean dynamic-batch fill, and queue wait, derived from the worker's
+    counters.  None when the trace carries no serve activity."""
+    counters = counters or {}
+    gauges = gauges or {}
+    lanes_total = counters.get("serve_lanes_total", 0)
+    claimed = counters.get("serve_jobs_claimed", 0)
+    if not (lanes_total or claimed or counters.get("jobs_done")
+            or counters.get("jobs_failed")):
+        return None
+    out = {
+        "batches": int(counters.get("serve_batches", 0)),
+        "jobs_done": int(counters.get("jobs_done", 0)),
+        "jobs_failed": int(counters.get("jobs_failed", 0)),
+        "job_retries": int(counters.get("job_retries", 0)),
+        "batch_fill_ratio": (
+            round(counters.get("serve_lanes_filled", 0) / lanes_total, 4)
+            if lanes_total else None),
+        "queue_wait_s_mean": (
+            round(counters.get("queue_wait_s", 0.0) / claimed, 6)
+            if claimed else None),
+    }
+    if "queue_depth" in gauges:
+        out["queue_depth_last"] = gauges["queue_depth"]
+    return out
+
+
 def render(spans: dict, counters: dict | None = None,
            gauges: dict | None = None) -> str:
     """Fixed-width per-stage table, longest-total first, then the
-    cold/warm compile split, then counters."""
+    cold/warm compile split, then the serve section, then counters."""
     lines = []
     if spans:
         w = max(len("stage"), max(len(n) for n in spans))
@@ -118,6 +147,23 @@ def render(spans: dict, counters: dict | None = None,
         lines.append(f"  compile_cache_hit = {split['compile_cache_hit']}, "
                      f"compile_cache_miss = {split['compile_cache_miss']}, "
                      f"jit_cache_miss = {split['jit_cache_miss']}")
+    serve = serve_section(counters, gauges)
+    if serve:
+        lines.append("")
+        lines.append("serve (resident survey service):")
+        lines.append(f"  batches = {serve['batches']}, "
+                     f"jobs_done = {serve['jobs_done']}, "
+                     f"jobs_failed = {serve['jobs_failed']}, "
+                     f"job_retries = {serve['job_retries']}")
+        if serve["batch_fill_ratio"] is not None:
+            lines.append(f"  batch_fill_ratio (mean) = "
+                         f"{serve['batch_fill_ratio']}")
+        if serve["queue_wait_s_mean"] is not None:
+            lines.append(f"  queue_wait_s (mean per job) = "
+                         f"{serve['queue_wait_s_mean']}")
+        if "queue_depth_last" in serve:
+            lines.append(f"  queue_depth (last) = "
+                         f"{serve['queue_depth_last']}")
     if counters:
         lines.append("")
         lines.append("counters:")

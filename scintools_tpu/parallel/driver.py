@@ -670,7 +670,8 @@ def _as_global_batch(dyn, mesh, chan_sharded: bool, commit: bool = False):
 def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
                  mesh=None, chunk: int | None = None,
                  chan_sharded: bool | None = None,
-                 async_exec: bool = True, pad_chunks: bool = False):
+                 async_exec: bool = True, pad_chunks: bool = False,
+                 pad_to: int | None = None):
     """Host-side convenience driver: bucket heterogeneous epochs by shape,
     pad each bucket to the mesh's data-axis multiple, run the jit'd step
     per bucket (optionally in memory-bounded chunks), and gather results
@@ -686,6 +687,14 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
     final uneven chunk up to the chunk size with mask-invalid lanes
     (sliced off at gather, like divisibility pads), so a chunked survey
     compiles exactly ONE program instead of two.
+
+    ``pad_to`` pads every bucket whose batch is SMALLER than that size
+    up to exactly ``pad_to`` epochs with the same mask-invalid lanes —
+    the resident-service contract (scintools_tpu.serve): a partial
+    dynamic batch executes the one warm compiled signature the batcher
+    targets instead of tracing a fresh program per fill level.  Must be
+    a multiple of the mesh's data-axis size; buckets already at or over
+    ``pad_to`` are left alone (the chunk machinery governs them).
 
     When the persistent compile cache is enabled (``SCINT_COMPILE_CACHE``,
     on by default — scintools_tpu.compile_cache) each step signature is
@@ -718,6 +727,11 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
     multiple = 1
     if mesh is not None:
         multiple = mesh.shape[mesh_mod.DATA_AXIS]
+    if pad_to is not None and (pad_to < 1 or pad_to % multiple):
+        raise ValueError(
+            f"pad_to={pad_to} must be a positive multiple of the mesh's "
+            f"data-axis size ({multiple}) — the padded batch is the "
+            "compiled signature")
     chan_sharded = _resolve_chan_sharded(mesh, chan_sharded)
     use_cache = compile_cache.cache_dir() is not None
     if use_cache:
@@ -738,6 +752,16 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
                     # NaN-fill them so the stacked nanmean drops them
                     dyn = dyn.copy()
                     dyn[~_mask.epoch] = np.nan
+                if pad_to is not None and dyn.shape[0] < pad_to:
+                    # fixed-signature padding: extend to exactly pad_to
+                    # with mask-invalid lanes (copies of the last epoch;
+                    # NaN under arc_stack so the campaign nanmean drops
+                    # them), sliced off at gather like divisibility pads
+                    extra = np.repeat(dyn[-1:], pad_to - dyn.shape[0],
+                                      axis=0)
+                    if config.arc_stack:
+                        extra = np.full_like(extra, np.nan)
+                    dyn = np.concatenate([dyn, extra], axis=0)
                 c = None
                 if chunk is not None and chunk < dyn.shape[0]:
                     # memory-bounded chunking; chunk must respect mesh

@@ -1,0 +1,19 @@
+"""scintools_tpu.serve — resident survey service.
+
+A durable filesystem job queue (one JSON file per job, atomic writes,
+worker leases with expiry, bounded retries with exponential backoff and
+a terminal poison state), a dynamic batcher that coalesces compatible
+queued epochs onto the warm compiled step signatures PR 2's
+warmup/compile-cache already paid for, a resident worker loop, and a
+filesystem-protocol client — the substrate for serving a continuous
+stream of observing epochs from one warm process (CLI verbs
+``scintools-tpu serve`` / ``submit`` / ``status`` / ``drain``; see
+docs/serving.md).
+"""
+
+from .batcher import Batch, DynamicBatcher, bucket_key  # noqa: F401
+from .client import SurveyClient  # noqa: F401
+from .queue import (DEFAULT_MAX_RETRIES, Job, JobQueue,  # noqa: F401
+                    cfg_signature, job_key)
+from .worker import (ServeWorker, config_from_opts,  # noqa: F401
+                     load_epoch, pipeline_runner)

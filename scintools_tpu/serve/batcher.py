@@ -1,0 +1,115 @@
+"""Dynamic batching of queued epochs onto warm compiled step signatures.
+
+The whole point of a *resident* worker is that PR 2's fixed-cost layer
+(compile_cache + ``warmup``) already paid for ONE compiled program per
+(axes, config, padded batch shape) signature — so the batcher's job is
+to coalesce compatible queued epochs into exactly those signatures and
+nothing else, the dynamic-batching discipline GPU pulsar front-ends use
+to keep the FFT engine saturated (arXiv:1804.05335).
+
+Grouping key = (config signature, full axis identity): two epochs with
+equal (nf, nt) but different bands must not share a compiled step —
+the same rule as ``parallel.driver._bucket_epochs``.  A bucket flushes
+when it reaches ``batch_size`` (the warmed signature) or when its
+oldest member has waited ``max_wait_s`` (bounded latency); partial
+flushes are padded up to ``batch_size`` by the driver's mask-invalid
+lane machinery (``run_pipeline(pad_to=...)``), so the worker executes
+ONE resident compiled program per shape bucket regardless of fill.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+from typing import Any
+
+import numpy as np
+
+from .queue import Job, cfg_signature
+
+
+@dataclasses.dataclass(frozen=True)
+class Batch:
+    """One flushable unit: jobs + their loaded epochs, single bucket."""
+
+    jobs: tuple
+    epochs: tuple
+    cfg: dict
+    key: tuple
+    fill_ratio: float
+    waited_s: float
+
+
+def bucket_key(cfg: dict, epoch) -> tuple:
+    """(config signature, axes digest, shape) — epochs sharing it can
+    ride one compiled step."""
+    f = np.ascontiguousarray(np.asarray(epoch.freqs, dtype=np.float64))
+    t = np.ascontiguousarray(np.asarray(epoch.times, dtype=np.float64))
+    axes = hashlib.sha1(f.tobytes() + t.tobytes()).hexdigest()[:16]
+    return (cfg_signature(cfg), axes, f.shape + t.shape)
+
+
+class DynamicBatcher:
+    """Accumulates (job, epoch) pairs into shape/config buckets and
+    yields :class:`Batch` flushes on max-batch or max-wait."""
+
+    def __init__(self, batch_size: int = 8, max_wait_s: float = 2.0):
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        self.batch_size = int(batch_size)
+        self.max_wait_s = float(max_wait_s)
+        # key -> [(added_at, job, epoch), ...] — PER-ITEM stamps, so a
+        # tail left over after a full-slice flush waits its own
+        # max_wait rather than inheriting the flushed head's deadline
+        self._buckets: dict[tuple, list] = {}
+
+    def add(self, job: Job, epoch: Any, now: float | None = None) -> None:
+        now = time.time() if now is None else now
+        key = bucket_key(job.cfg, epoch)
+        if job.solo:
+            # whole-batch-failure retry: a private singleton bucket, so
+            # the poison member fails alone and healthy members succeed
+            # alone (the padded step signature is the same either way)
+            key = key + (("solo", job.id),)
+        self._buckets.setdefault(key, []).append((now, job, epoch))
+
+    @property
+    def pending(self) -> int:
+        return sum(len(items) for items in self._buckets.values())
+
+    def oldest_wait_s(self, now: float | None = None) -> float:
+        now = time.time() if now is None else now
+        if not self._buckets:
+            return 0.0
+        return max(now - items[0][0] for items in self._buckets.values())
+
+    def pop_ready(self, now: float | None = None,
+                  force: bool = False) -> list[Batch]:
+        """Full buckets always flush; partial buckets flush when their
+        OLDEST member's deadline has passed or ``force`` is set
+        (drain).  A bucket that overfilled between polls flushes in
+        ``batch_size`` slices — every flush is at most one compiled
+        signature wide — and the leftover tail restarts the wait from
+        its own members' add times."""
+        now = time.time() if now is None else now
+        out: list[Batch] = []
+        for key in list(self._buckets):
+            items = self._buckets[key]
+            while len(items) >= self.batch_size or (
+                    items and (force
+                               or (now - items[0][0]) >= self.max_wait_s)):
+                take, items = (items[:self.batch_size],
+                               items[self.batch_size:])
+                jobs = tuple(j for _, j, _ in take)
+                epochs = tuple(e for _, _, e in take)
+                out.append(Batch(
+                    jobs=jobs, epochs=epochs, cfg=dict(jobs[0].cfg),
+                    key=key,
+                    fill_ratio=len(take) / float(self.batch_size),
+                    waited_s=max(now - take[0][0], 0.0)))
+            if items:
+                self._buckets[key] = items
+            else:
+                del self._buckets[key]
+        return out

@@ -1,0 +1,98 @@
+"""Filesystem-protocol client for the resident survey service.
+
+No network dependency: the queue directory is the API, so any process
+that can see the filesystem can submit work, poll status, collect
+results, and drain the worker — the CLI verbs ``submit`` / ``status``
+/ ``drain`` are thin wrappers over this class.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Sequence
+
+from .queue import DONE, FAILED, JobQueue
+
+
+class SurveyClient:
+    """Submit / wait / drain / export against a serve queue directory
+    (everything a shell or notebook needs to drive a resident worker)."""
+
+    def __init__(self, queue_dir: str):
+        self.queue = JobQueue(queue_dir)
+
+    # -- submission --------------------------------------------------------
+    def submit(self, paths: Sequence[str],
+               opts: dict | None = None) -> list[dict]:
+        """Submit epoch files for processing under ``opts`` (the
+        estimator options a ``process --batched`` run would take).
+        Idempotent per (file content, opts): re-submitting reports the
+        existing state instead of duplicating.  A nonexistent path
+        (typo, unexpanded glob) reports ``status="missing"`` with
+        ``job=None`` instead of poisoning the queue.  Returns one
+        record per path: ``{file, job, status}``."""
+        opts = dict(opts or {})
+        out = []
+        for p in paths:
+            if not os.path.exists(p):
+                out.append({"file": p, "job": None, "status": "missing"})
+                continue
+            job_id, status = self.queue.submit(p, opts)
+            out.append({"file": p, "job": job_id, "status": status})
+        return out
+
+    # -- inspection --------------------------------------------------------
+    def status(self) -> dict:
+        return self.queue.status()
+
+    def result(self, job_id: str) -> dict | None:
+        return self.queue.results.get(job_id)
+
+    def wait(self, job_ids: Sequence[str], timeout: float = 60.0,
+             poll_s: float = 0.2) -> dict:
+        """Block until every job is terminal (done or failed) or the
+        timeout lapses.  Returns ``{done: [...], failed: [...],
+        pending: [...]}``."""
+        deadline = time.time() + timeout
+        pending = list(job_ids)
+        done: list[str] = []
+        failed: list[str] = []
+        while pending and time.time() < deadline:
+            still = []
+            for job_id in pending:
+                if job_id in self.queue.results:
+                    done.append(job_id)
+                elif self.queue.state_of(job_id) == FAILED:
+                    failed.append(job_id)
+                elif self.queue.state_of(job_id) == DONE:
+                    done.append(job_id)
+                else:
+                    still.append(job_id)
+            pending = still
+            if pending:
+                time.sleep(poll_s)
+        return {"done": done, "failed": failed, "pending": pending}
+
+    # -- results -----------------------------------------------------------
+    def export_csv(self, filename: str, full: bool = False) -> int:
+        """Write every stored result row to CSV (reference schema by
+        default; ``full=True`` adds the beyond-reference columns) —
+        the same exporter as ``process --store``, so a served survey's
+        CSV is directly comparable to a direct run's."""
+        return self.queue.results.export_csv(filename, full=full)
+
+    # -- drain -------------------------------------------------------------
+    def drain(self, timeout: float | None = None,
+              poll_s: float = 0.2) -> dict:
+        """Ask the worker(s) to finish and stop: set the drain marker,
+        then (``timeout is not None``) wait for the queue to empty.
+        Returns the final status plus ``drained``."""
+        self.queue.request_drain()
+        if timeout is not None:
+            deadline = time.time() + timeout
+            while not self.queue.empty() and time.time() < deadline:
+                time.sleep(poll_s)
+        st = self.queue.status()
+        st["drained"] = self.queue.empty()
+        return st

@@ -1,0 +1,396 @@
+"""Durable on-disk job queue for the resident survey service.
+
+The queue directory IS the protocol (no network dependency): clients
+and workers on the same filesystem coordinate purely through atomic
+file operations, the way the reference's append-mode CSV made a killed
+batch run resumable (scint_utils.py:75-108) — here generalised to a
+real work queue with leases, as real-time pulsar-search pipelines front
+their persistent accelerator workers (arXiv:1804.05335 §real-time
+operation).
+
+Layout (all JSON, one file per job, written tmp+``os.replace`` so a
+crash can never leave a torn record)::
+
+    qdir/
+      queued/<job_id>.json    submitted, waiting for a worker
+      leased/<job_id>.json    claimed by a worker, lease expiry inside
+      done/<job_id>.json      completed (result row in results/)
+      failed/<job_id>.json    terminal: retries exhausted (poison input)
+      results/                utils.store.ResultsStore (idempotent rows)
+      control/drain           drain marker (serve exits when empty)
+
+Semantics:
+
+* **Idempotent submit** — ``job_id = content_key(file bytes, config)``
+  (utils/store.py), so re-submitting the same epoch+config is a no-op
+  in every state, including ``done`` (the result row already exists in
+  ``results/``).
+* **Leases, not locks** — ``claim`` moves ``queued/ -> leased/`` with
+  an expiry stamp; the move is an ``os.rename`` whose atomicity picks
+  exactly one winner among racing workers.  A SIGKILLed worker's
+  leased jobs are reclaimed by ``reap_expired`` after the lease runs
+  out: back to ``queued/`` with ``attempts + 1`` and exponential
+  backoff, or to ``failed/`` once ``max_retries`` is exhausted.
+* **At-least-once execution, exactly-once results** — a lease can
+  expire under a live worker (long compile), so the same job may
+  execute twice; the content-keyed results store makes the second
+  write idempotent, and ``complete`` finalises from whichever state
+  dir the job landed in.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from typing import Sequence
+
+from ..utils.store import ResultsStore, content_key
+
+# job states = subdirectories
+QUEUED, LEASED, DONE, FAILED = "queued", "leased", "done", "failed"
+_STATES = (QUEUED, LEASED, DONE, FAILED)
+
+DEFAULT_MAX_RETRIES = 3
+DEFAULT_BACKOFF_S = 1.0
+BACKOFF_CAP_S = 300.0
+
+_LAST_STAMP = 0.0
+
+
+def _submit_stamp() -> float:
+    """Strictly-increasing submit timestamps within one process, so
+    FIFO claim order equals submit order even when ``time.time()``
+    ties across a tight submit loop (claim's tiebreak would otherwise
+    fall back to hash order)."""
+    global _LAST_STAMP
+    t = time.time()
+    if t <= _LAST_STAMP:
+        t = _LAST_STAMP + 1e-6
+    _LAST_STAMP = t
+    return t
+
+
+def cfg_signature(cfg: dict) -> tuple:
+    """Canonical hashable form of a job's processing options: sorted
+    (key, value) pairs with lists normalised to tuples AND defaults
+    dropped — ``None``, boolean ``False`` (every serve boolean option
+    defaults off) and the default ``arc_method`` — so a sparse dict
+    (``{"lamsteps": True}``) and the CLI's fully-materialised option
+    dict hash to the SAME job identity (the idempotent-submit
+    contract), regardless of dict ordering or JSON round-trips."""
+    def norm(v):
+        if isinstance(v, (list, tuple)):
+            return tuple(norm(x) for x in v)
+        return v
+
+    out = []
+    for k, v in sorted((cfg or {}).items()):
+        if v is None or v is False:
+            continue
+        if k == "arc_method" and v == "norm_sspec":
+            continue
+        out.append((str(k), norm(v)))
+    return tuple(out)
+
+
+def job_key(path: str, cfg: dict) -> str:
+    """The job's identity AND its results-store key: a content hash of
+    the input file's bytes + the processing options.  Identical epochs
+    submitted under different path spellings dedup to one job."""
+    return content_key(path, ("serve",) + cfg_signature(cfg))
+
+
+@dataclasses.dataclass(frozen=True)
+class Job:
+    """One queued unit of work (an observing epoch + its options)."""
+
+    id: str
+    file: str
+    cfg: dict
+    submitted_at: float
+    attempts: int = 0
+    not_before: float = 0.0
+    lease_worker: str | None = None
+    lease_expires_at: float | None = None
+    error: str | None = None
+    # retry in a singleton batch: set when a WHOLE batch failed, so the
+    # members cannot re-coalesce into the same failing batch and burn
+    # every healthy member's retry budget alongside the poison one
+    solo: bool = False
+
+    def to_record(self) -> dict:
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None}
+
+    @classmethod
+    def from_record(cls, rec: dict) -> "Job":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in rec.items() if k in fields})
+
+
+class JobQueue:
+    """Durable filesystem job queue: atomic state files, rename-arbited
+    claims with expiring leases, bounded-retry requeues, and a
+    content-keyed results store (see the module docstring for the
+    directory protocol)."""
+
+    def __init__(self, directory: str,
+                 max_retries: int = DEFAULT_MAX_RETRIES,
+                 backoff_s: float = DEFAULT_BACKOFF_S):
+        self.dir = directory
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        for sub in _STATES + ("control",):
+            os.makedirs(os.path.join(directory, sub), exist_ok=True)
+        self.results = ResultsStore(os.path.join(directory, "results"))
+
+    # -- paths / low-level records -----------------------------------------
+    def _path(self, state: str, job_id: str) -> str:
+        return os.path.join(self.dir, state, f"{job_id}.json")
+
+    def _write(self, state: str, job: Job) -> None:
+        path = self._path(state, job.id)
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(job.to_record(), fh)
+        os.replace(tmp, path)
+
+    def _read(self, state: str, job_id: str) -> Job | None:
+        try:
+            with open(self._path(state, job_id)) as fh:
+                return Job.from_record(json.load(fh))
+        except (OSError, ValueError, TypeError):
+            return None
+
+    def _ids(self, state: str) -> list[str]:
+        d = os.path.join(self.dir, state)
+        return sorted(os.path.splitext(f)[0] for f in os.listdir(d)
+                      if f.endswith(".json"))
+
+    def state_of(self, job_id: str) -> str | None:
+        for state in _STATES:
+            if os.path.exists(self._path(state, job_id)):
+                return state
+        return None
+
+    def get(self, job_id: str) -> Job | None:
+        for state in _STATES:
+            job = self._read(state, job_id)
+            if job is not None:
+                return job
+        return None
+
+    # -- client side -------------------------------------------------------
+    def submit(self, path: str, cfg: dict | None = None) -> tuple[str, str]:
+        """Enqueue one epoch file.  Returns ``(job_id, status)``:
+        ``"submitted"`` for a fresh submission, or — for an idempotent
+        dedup hit — the job's existing state (``queued/leased/done/
+        failed``); a result row already in the store reports ``"done"``
+        without touching the queue at all (the dedup-against-the-store
+        contract)."""
+        if not os.path.exists(path):
+            # fail fast: content_key would silently hash the path
+            # SPELLING (an unmatched glob pattern, a typo) and the
+            # worker would burn its whole retry budget discovering it
+            raise FileNotFoundError(f"cannot submit {path!r}: no such "
+                                    "file")
+        cfg = dict(cfg or {})
+        job_id = job_key(path, cfg)
+        if job_id in self.results:
+            return job_id, DONE
+        existing = self.state_of(job_id)
+        if existing is not None:
+            return job_id, existing
+        self._write(QUEUED, Job(id=job_id, file=os.path.abspath(path),
+                                cfg=cfg, submitted_at=_submit_stamp()))
+        return job_id, "submitted"
+
+    # -- worker side -------------------------------------------------------
+    def claim(self, worker: str, n: int, lease_s: float,
+              now: float | None = None) -> list[Job]:
+        """Lease up to ``n`` runnable queued jobs (FIFO by submit time,
+        backoff-eligible only).  The queued->leased ``os.rename`` is
+        the race arbiter: a loser's rename raises and it simply moves
+        on.  The winner immediately rewrites the leased record with
+        the lease stamp (worker id + expiry)."""
+        now = time.time() if now is None else now
+        claimed: list[Job] = []
+        candidates = []
+        for job_id in self._ids(QUEUED):
+            job = self._read(QUEUED, job_id)
+            if job is None or job.not_before > now:
+                continue
+            # a queued duplicate of a still-leased job (crash window of
+            # a requeue) must not double-execute while the lease lives
+            if os.path.exists(self._path(LEASED, job_id)):
+                continue
+            candidates.append(job)
+        candidates.sort(key=lambda j: (j.submitted_at, j.id))
+        for job in candidates:
+            if len(claimed) >= n:
+                break
+            try:
+                os.rename(self._path(QUEUED, job.id),
+                          self._path(LEASED, job.id))
+            except OSError:
+                continue  # another worker won this one
+            # stamp the lease onto the record we actually renamed, not
+            # the pre-rename read: another worker may have failed+
+            # requeued this job in the read->rename window, and its
+            # attempts/backoff must survive the claim
+            fresh = self._read(LEASED, job.id) or job
+            leased = dataclasses.replace(fresh, lease_worker=worker,
+                                         lease_expires_at=now + lease_s)
+            self._write(LEASED, leased)
+            claimed.append(leased)
+        return claimed
+
+    def renew(self, jobs: Sequence[Job], lease_s: float,
+              now: float | None = None) -> None:
+        """Extend the lease on jobs this worker still holds (called
+        right before a long batch execution so a compile cannot outlive
+        the lease)."""
+        now = time.time() if now is None else now
+        for job in jobs:
+            held = self._read(LEASED, job.id)
+            if held is not None and held.lease_worker == job.lease_worker:
+                self._write(LEASED, dataclasses.replace(
+                    held, lease_expires_at=now + lease_s))
+
+    def reap_expired(self, now: float | None = None
+                     ) -> tuple[list[Job], list[Job]]:
+        """Requeue (or poison) every leased job whose lease has run out
+        — the SIGKILLed-worker recovery path.  Returns ``(requeued,
+        poisoned)``.  A leased record still inside the claim's
+        rename-then-rewrite window (no expiry stamp yet) is given a
+        grace period from the file's mtime."""
+        now = time.time() if now is None else now
+        requeued, poisoned = [], []
+        for job_id in self._ids(LEASED):
+            job = self._read(LEASED, job_id)
+            if job is None:
+                continue
+            exp = job.lease_expires_at
+            if exp is None:
+                try:
+                    exp = os.path.getmtime(self._path(LEASED, job_id)) + 30.0
+                except OSError:
+                    continue
+            if exp > now:
+                continue
+            attempts = job.attempts + 1
+            back = dataclasses.replace(
+                job, attempts=attempts, lease_worker=None,
+                lease_expires_at=None,
+                error=f"lease expired (attempt {attempts})")
+            if attempts > self.max_retries:
+                self._write(FAILED, back)
+                poisoned.append(back)
+            else:
+                back = dataclasses.replace(
+                    back, not_before=now + self._backoff(attempts))
+                self._write(QUEUED, back)
+                requeued.append(back)
+            self._remove(LEASED, job_id)
+        return requeued, poisoned
+
+    def _backoff(self, attempts: int) -> float:
+        return min(self.backoff_s * (2.0 ** max(attempts - 1, 0)),
+                   BACKOFF_CAP_S)
+
+    def _remove(self, state: str, job_id: str) -> None:
+        try:
+            os.remove(self._path(state, job_id))
+        except OSError:
+            pass
+
+    def complete(self, job: Job) -> None:
+        """Finalise a job whose result row is stored.  Tolerates the
+        at-least-once window: the job may have been requeued from under
+        an expired lease, so finalise from whichever state dir holds it
+        (and drop any queued duplicate)."""
+        self._write(DONE, dataclasses.replace(
+            job, lease_worker=None, lease_expires_at=None, error=None))
+        for state in (LEASED, QUEUED, FAILED):
+            self._remove(state, job.id)
+
+    def fail(self, job: Job, error: str, retryable: bool = True,
+             now: float | None = None) -> str:
+        """Record a job failure: requeue with exponential backoff while
+        retries remain (and the failure is retryable), else move to the
+        terminal ``failed/`` state.  Returns the resulting state.
+
+        A job another worker already COMPLETED (the at-least-once race:
+        this worker's lease expired mid-batch, the job was requeued and
+        finished elsewhere) is never un-completed — the stale failure
+        is dropped and ``done`` wins, symmetric with ``complete``'s
+        tolerance of requeued copies."""
+        now = time.time() if now is None else now
+        if job.id in self.results \
+                or os.path.exists(self._path(DONE, job.id)):
+            for s in (LEASED, QUEUED):
+                self._remove(s, job.id)
+            return DONE
+        attempts = job.attempts + 1
+        rec = dataclasses.replace(job, attempts=attempts, error=error,
+                                  lease_worker=None, lease_expires_at=None)
+        if not retryable or attempts > self.max_retries:
+            self._write(FAILED, rec)
+            state = FAILED
+        else:
+            self._write(QUEUED, dataclasses.replace(
+                rec, not_before=now + self._backoff(attempts)))
+            state = QUEUED
+        for s in (LEASED,) + ((QUEUED,) if state == FAILED else ()):
+            self._remove(s, job.id)
+        return state
+
+    # -- introspection / control -------------------------------------------
+    def counts(self) -> dict:
+        return {state: len(self._ids(state)) for state in _STATES}
+
+    def status(self, now: float | None = None) -> dict:
+        now = time.time() if now is None else now
+        st = self.counts()
+        st["results"] = len(self.results.keys())
+        st["depth"] = st[QUEUED] + st[LEASED]
+        st["drain_requested"] = self.drain_requested()
+        oldest = None
+        for job_id in self._ids(QUEUED):
+            job = self._read(QUEUED, job_id)
+            if job is not None:
+                age = now - job.submitted_at
+                oldest = age if oldest is None else max(oldest, age)
+        st["oldest_queued_s"] = round(oldest, 3) if oldest is not None \
+            else None
+        return st
+
+    def empty(self) -> bool:
+        return not self._ids(QUEUED) and not self._ids(LEASED)
+
+    def jobs(self, state: str) -> list[Job]:
+        return [j for j in (self._read(state, i) for i in self._ids(state))
+                if j is not None]
+
+    # drain: a marker file — any client can request it, the worker exits
+    # once the queue is empty (serve/worker.py honours it)
+    def _drain_path(self) -> str:
+        return os.path.join(self.dir, "control", "drain")
+
+    def request_drain(self) -> None:
+        path = self._drain_path()
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w") as fh:
+            fh.write(str(time.time()))
+        os.replace(tmp, path)
+
+    def clear_drain(self) -> None:
+        try:
+            os.remove(self._drain_path())
+        except OSError:
+            pass
+
+    def drain_requested(self) -> bool:
+        return os.path.exists(self._drain_path())

@@ -1,0 +1,297 @@
+"""Resident survey worker: the warm process that serves the queue.
+
+PR 2 made a warm process cheap (persistent compile cache, AOT
+``warmup``, async chunk execution); this loop keeps that process
+RESIDENT and feeds it a continuous stream of epochs — claim leased
+jobs, coalesce them through the :class:`~.batcher.DynamicBatcher` onto
+the warm compiled signatures, execute ONE padded step per shape
+bucket (``run_pipeline(pad_to=batch_size)``), write content-keyed
+result rows (idempotent — utils.store), and finalise the queue state.
+Per-job failures (unreadable file, degenerate epoch, NaN lane) are
+isolated from the batch: the job retries with backoff until the
+queue's retry budget poisons it to ``failed/``; the batch's other
+lanes complete normally.
+
+Observability (all via :mod:`scintools_tpu.obs`, visible in ``trace
+report``): gauges ``queue_depth`` / ``batch_fill_ratio``; counters
+``queue_wait_s`` (submit->claim wait, summed), ``serve_jobs_claimed``,
+``serve_batches``, ``serve_lanes_filled`` / ``serve_lanes_total``
+(mean fill), ``jobs_done`` / ``jobs_failed`` / ``job_retries``; spans
+``serve.poll`` / ``serve.load`` / ``serve.batch``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+from .. import obs
+from ..utils.log import get_logger, log_event
+from .batcher import Batch, DynamicBatcher
+from .queue import JobQueue
+
+
+def config_from_opts(opts: dict):
+    """PipelineConfig from a job's stored option dict — the one
+    builder shared with the CLI (``cmd_process``/``cmd_warmup`` build
+    the same dict from argparse flags), so a served epoch runs exactly
+    the config a ``process --batched`` survey would."""
+    from ..parallel import PipelineConfig
+
+    opts = dict(opts or {})
+    pkw = dict(lamsteps=bool(opts.get("lamsteps", False)),
+               fit_arc=not opts.get("no_arc", False),
+               fit_scint=not opts.get("no_scint", False),
+               fit_scint_2d=bool(opts.get("scint_2d", False)),
+               arc_asymm=bool(opts.get("arc_asymm", False)),
+               arc_method=opts.get("arc_method", "norm_sspec"),
+               arc_stack=bool(opts.get("arc_stack", False)))
+    bracket = opts.get("arc_bracket")
+    if bracket is not None:
+        pkw["arc_constraint"] = (float(bracket[0]), float(bracket[1]))
+    # sizing knobs (client API; the CLI keeps the survey defaults)
+    for k in ("arc_numsteps", "lm_steps"):
+        if opts.get(k) is not None:
+            pkw[k] = int(opts[k])
+    return PipelineConfig(**pkw)
+
+
+def load_epoch(path: str, clean: bool = False):
+    """Host-side load+clean of one epoch — the same chain as the
+    batched CLI engine (trim/refill, plus the --clean triage), so a
+    served epoch enters the pipeline bit-identical to a direct run."""
+    from ..io.psrflux import read_psrflux
+    from ..ops.clean import correct_band, refill, trim_edges, zap
+
+    d = refill(trim_edges(read_psrflux(path)))
+    if clean:
+        d = correct_band(refill(zap(
+            zap(d, method="channels", sigma=5),
+            method="subints", sigma=5)))
+    if d.nchan < 2 or d.nsub < 2:
+        raise ValueError(f"degenerate after trim: {d.nchan}x{d.nsub}")
+    return d
+
+
+def pipeline_runner(batch: Batch, batch_size: int, mesh=None,
+                    async_exec: bool = True) -> list:
+    """Default batch executor: ONE padded compiled step over the
+    bucket (``pad_to`` holds the warm signature), rows built by the
+    same helpers as the CLI's batched engine.  Returns one row dict
+    (or None for a failed lane) per job, in job order."""
+    from ..io.results import batch_lane_row, results_row
+    from ..parallel import run_pipeline
+
+    cfg = config_from_opts(batch.cfg)
+    buckets = run_pipeline(list(batch.epochs), cfg, mesh=mesh,
+                           async_exec=async_exec, pad_to=batch_size)
+    rows: list = [None] * len(batch.jobs)
+    for idx, res in buckets:
+        for lane, i in enumerate(idx):
+            row = results_row(batch.epochs[i])
+            row.update(batch_lane_row(res, lane, cfg.lamsteps))
+            row["name"] = os.path.basename(batch.jobs[i].file)
+            rows[i] = row
+    return rows
+
+
+class ServeWorker:
+    """One resident worker process bound to a queue directory.
+
+    ``runner`` is injectable for tests (``runner(batch, batch_size,
+    mesh, async_exec) -> [row|None, ...]``); the default is the real
+    padded ``run_pipeline`` executor above.
+    """
+
+    def __init__(self, queue: JobQueue, batch_size: int = 8,
+                 max_wait_s: float = 2.0, lease_s: float = 60.0,
+                 poll_s: float = 0.2, mesh=None, runner=None,
+                 async_exec: bool = True, worker_id: str | None = None):
+        self.queue = queue
+        self.batch_size = int(batch_size)
+        if mesh is not None:
+            from ..parallel import mesh as mesh_mod
+
+            mult = int(dict(mesh.shape).get(mesh_mod.DATA_AXIS, 1))
+            if self.batch_size % mult:
+                # fail fast HERE (one rule site, CLI and API alike):
+                # run_pipeline's pad_to would otherwise reject every
+                # batch at runtime and poison the whole queue
+                raise ValueError(
+                    f"batch_size={self.batch_size} must be a multiple "
+                    f"of the mesh data axis ({mult}) — the padded "
+                    "batch is the compiled signature")
+        self.max_wait_s = float(max_wait_s)
+        self.lease_s = float(lease_s)
+        self.poll_s = float(poll_s)
+        self.mesh = mesh
+        self.async_exec = bool(async_exec)
+        self.runner = runner if runner is not None else pipeline_runner
+        self.worker_id = worker_id or f"{os.uname().nodename}:{os.getpid()}"
+        self.batcher = DynamicBatcher(batch_size=self.batch_size,
+                                      max_wait_s=self.max_wait_s)
+        self.log = get_logger()
+        self.stats = {"batches": 0, "jobs_done": 0, "jobs_failed": 0,
+                      "job_retries": 0, "lanes_filled": 0,
+                      "lanes_total": 0}
+
+    # -- one scheduling round ----------------------------------------------
+    def poll_once(self, now: float | None = None,
+                  force_flush: bool = False) -> int:
+        """Reap -> claim -> load -> batch -> execute.  Returns the
+        number of batches executed this round.  An injected ``now``
+        (tests/replay) drives EVERY clock read in the round, flush
+        deadlines included; live runs re-read the wall clock at flush
+        so epoch-load time counts toward a partial bucket's wait."""
+        injected = now is not None
+        now = time.time() if now is None else now
+        with obs.span("serve.poll"):
+            requeued, poisoned = self.queue.reap_expired(now)
+            self._count_retries(requeued, poisoned, reason="lease_expired")
+            jobs = self.queue.claim(self.worker_id, n=self.batch_size,
+                                    lease_s=self._claim_lease_s(),
+                                    now=now)
+            # counts() is listdir-only; status() would open and parse
+            # every queued job file per poll just to discard its
+            # oldest-age readout
+            counts = self.queue.counts()
+            obs.gauge("queue_depth", counts["queued"] + counts["leased"])
+        for job in jobs:
+            obs.inc("serve_jobs_claimed")
+            obs.inc("queue_wait_s",
+                    round(max(now - job.submitted_at, 0.0), 6))
+            try:
+                with obs.span("serve.load", file=job.file):
+                    epoch = load_epoch(job.file,
+                                       clean=bool(job.cfg.get("clean")))
+            except Exception as e:
+                self._job_failed(job, f"load failed: {e!r}")
+                continue
+            self.batcher.add(job, epoch, now)
+        drain = self.queue.drain_requested()
+        batches = self.batcher.pop_ready(now if injected else time.time(),
+                                         force=force_flush or drain)
+        for batch in batches:
+            self._execute(batch)
+        return len(batches)
+
+    def _claim_lease_s(self) -> float:
+        # the lease must cover the batcher's wait AND one execution
+        return self.lease_s + self.max_wait_s
+
+    def _count_retries(self, requeued, poisoned, reason: str) -> None:
+        for job in requeued:
+            self.stats["job_retries"] += 1
+            obs.inc("job_retries")
+            log_event(self.log, "job_requeued", job=job.id,
+                      attempts=job.attempts, reason=reason)
+        for job in poisoned:
+            self.stats["jobs_failed"] += 1
+            obs.inc("jobs_failed")
+            log_event(self.log, "job_poisoned", job=job.id,
+                      attempts=job.attempts, error=job.error)
+
+    def _job_failed(self, job, error: str) -> None:
+        state = self.queue.fail(job, error)
+        if state == "done":
+            # completed by another worker under the at-least-once race;
+            # the stale local failure is dropped, nothing to count
+            return
+        if state == "failed":
+            self.stats["jobs_failed"] += 1
+            obs.inc("jobs_failed")
+            log_event(self.log, "job_poisoned", job=job.id, error=error)
+        else:
+            self.stats["job_retries"] += 1
+            obs.inc("job_retries")
+            log_event(self.log, "job_requeued", job=job.id, error=error)
+
+    def _execute(self, batch: Batch) -> None:
+        from ..io.results import row_fit_values
+
+        import numpy as np
+
+        n = len(batch.jobs)
+        # long compiles must not outlive the claim lease mid-execution
+        self.queue.renew(batch.jobs, self._claim_lease_s())
+        obs.gauge("batch_fill_ratio", round(batch.fill_ratio, 4))
+        obs.inc("serve_batches")
+        obs.inc("serve_lanes_filled", n)
+        obs.inc("serve_lanes_total", self.batch_size)
+        self.stats["batches"] += 1
+        self.stats["lanes_filled"] += n
+        self.stats["lanes_total"] += self.batch_size
+        try:
+            with obs.span("serve.batch", jobs=n,
+                          fill=round(batch.fill_ratio, 4)):
+                rows = self.runner(batch, self.batch_size, self.mesh,
+                                   self.async_exec)
+        except Exception as e:
+            # whole-batch failure (pipeline error): requeue every member
+            # marked SOLO, so retries run as singleton batches — the
+            # poison member exhausts its own budget alone and healthy
+            # members complete alone instead of re-coalescing into the
+            # same failing batch until all are poisoned together
+            for job in batch.jobs:
+                self._job_failed(dataclasses.replace(job, solo=True),
+                                 f"batch failed: {e!r}")
+            log_event(self.log, "batch_failed", jobs=n, error=repr(e))
+            return
+        for job, row in zip(batch.jobs, rows):
+            fitvals = row_fit_values(row) if row is not None else []
+            if row is None or (fitvals
+                               and not np.all(np.isfinite(fitvals))):
+                self._job_failed(job, "non-finite fit (NaN lane)")
+                continue
+            self.queue.results.put_new(job.id, row)
+            self.queue.complete(job)
+            self.stats["jobs_done"] += 1
+            obs.inc("jobs_done")
+            log_event(self.log, "job_done", job=job.id,
+                      file=os.path.basename(job.file),
+                      tau=row.get("tau"),
+                      eta=row.get("betaeta", row.get("eta")))
+
+    # -- the resident loop -------------------------------------------------
+    def run(self, max_batches: int | None = None,
+            exit_on_drain: bool = True,
+            idle_exit_s: float | None = None) -> dict:
+        """Serve until told to stop.  Exit conditions: ``max_batches``
+        executed; a drain request with the queue empty and no pending
+        partial batches (``exit_on_drain``); or ``idle_exit_s`` with no
+        work arriving.  Returns the worker's stats dict."""
+        log_event(self.log, "serve_start", worker=self.worker_id,
+                  batch=self.batch_size, max_wait_s=self.max_wait_s,
+                  lease_s=self.lease_s, queue=self.queue.dir)
+        idle_since = None
+        while True:
+            ran = self.poll_once()
+            if ran:
+                idle_since = None
+                if max_batches is not None and \
+                        self.stats["batches"] >= max_batches:
+                    break
+                continue
+            if self.batcher.pending:
+                # partial bucket waiting on its deadline: short sleep
+                time.sleep(min(self.poll_s, self.max_wait_s / 4 or
+                               self.poll_s))
+                continue
+            if exit_on_drain and self.queue.drain_requested() \
+                    and self.queue.empty():
+                # CONSUME the drain request: a drain-then-start flow
+                # ("finish this queue and exit") must work, so the
+                # marker is honoured whenever present and cleared by
+                # the worker that completes it — the next serving
+                # session starts resident again
+                self.queue.clear_drain()
+                break
+            now = time.time()
+            idle_since = now if idle_since is None else idle_since
+            if idle_exit_s is not None and now - idle_since >= idle_exit_s:
+                break
+            time.sleep(self.poll_s)
+        log_event(self.log, "serve_exit", worker=self.worker_id,
+                  **self.stats)
+        return dict(self.stats)

@@ -60,17 +60,41 @@ class ResultsStore:
         return os.path.exists(self._path(key))
 
     def put(self, key: str, record: dict) -> None:
-        """Atomic write: crash mid-write leaves no half-record behind."""
-        tmp = self._path(key) + ".tmp"
+        """Atomic write: crash mid-write leaves no half-record behind.
+        The tmp name is per-process (like ``put_meta``) so concurrent
+        writers of the same key — the serve worker's at-least-once
+        duplicate executions — can never interleave bytes through one
+        shared tmp file; each write is whole, and the last rename
+        wins."""
+        tmp = self._path(key) + f".tmp{os.getpid()}"
         with open(tmp, "w") as fh:
             json.dump(record, fh)
         os.replace(tmp, self._path(key))
 
+    def put_new(self, key: str, record: dict) -> bool:
+        """Write-once variant of :meth:`put` for at-least-once
+        producers (the serve worker: a lease can expire under a live
+        worker, so the same job may execute twice).  A repeat returns
+        False and leaves the stored row untouched, so no result is
+        ever duplicated.  Two truly concurrent duplicates can both
+        pass the existence check, but each write is whole (atomic
+        per-process tmp + rename) and duplicate executions of a job
+        are deterministic, so the surviving row is valid and identical
+        either way."""
+        if key in self:
+            return False
+        self.put(key, record)
+        return True
+
     def get(self, key: str) -> dict | None:
+        """A missing OR unreadable/corrupt row degrades to None (as
+        ``get_meta`` does): the store is a multi-writer surface under
+        the serve protocol, and one bad row must not make
+        ``records()``/``export_csv`` raise away every healthy row."""
         try:
             with open(self._path(key)) as fh:
                 return json.load(fh)
-        except FileNotFoundError:
+        except (OSError, ValueError):
             return None
 
     def keys(self) -> list[str]:

@@ -31,6 +31,7 @@ PAGES = [
     ("docs/overview.md", "overview", "Architecture overview"),
     ("docs/api.md", "api", "API reference"),
     ("docs/performance.md", "performance", "Performance & roofline"),
+    ("docs/serving.md", "serving", "Resident survey service"),
     ("docs/observability.md", "observability", "Tracing & metrics"),
     ("docs/migrating.md", "migrating", "Migrating from scintools"),
     ("docs/wavefield.md", "wavefield", "Wavefield holography"),

@@ -5,9 +5,12 @@ display modules (plotting.py, cli.py).
 The observability layer (scintools_tpu.obs spans/counters + the
 utils.log key=value channel) is the ONLY reporting channel for compute
 code; a stray print in an op or fitter bypasses sinks, corrupts
-machine-readable CLI stdout (the bench/sim/sort commands print JSON
-records), and is invisible to `trace report`.  Enforced in tier-1 via
-tests/test_no_print.py.
+machine-readable CLI stdout (the bench/sim/sort AND serve/submit/
+status/drain commands print JSON records), and is invisible to `trace
+report`.  The walk covers every package subtree — including
+``scintools_tpu/serve/`` (whose worker/queue/client must report via
+obs counters and log_event, never stdout: the serve CLI's JSON line is
+parsed by scripts).  Enforced in tier-1 via tests/test_no_print.py.
 
 Token-based, not regex: string literals and comments mentioning print()
 (docstrings quoting the reference's behaviour) are fine; only a real

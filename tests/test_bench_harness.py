@@ -6,9 +6,18 @@ import os
 import sys
 
 import numpy as np
+import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+# the two reference-oracle tests NEED the reference checkout: a clean
+# repo checkout without /root/reference must skip them (green tier-1),
+# not fail them
+_REFERENCE = "/root/reference"
+needs_reference = pytest.mark.skipif(
+    not os.path.exists(_REFERENCE),
+    reason=f"reference implementation not mounted at {_REFERENCE}")
 
 
 def test_make_epochs_deterministic_and_shaped():
@@ -22,6 +31,7 @@ def test_make_epochs_deterministic_and_shaped():
     assert len(f1) == 32 and len(t1) == 32
 
 
+@needs_reference
 def test_serial_baseline_reference_runs_tiny():
     """The CPU denominator times the ACTUAL reference implementation
     (imported live) and reports median + dispersion per epoch."""
@@ -41,6 +51,7 @@ def test_serial_baseline_reference_runs_tiny():
     assert "scint_substitute_delta_s" in rec
 
 
+@needs_reference
 def test_lmfit_shim_matches_reference_fit_semantics():
     """The lmfit shim runs the reference's get_scint_params verbatim and
     its fitted tau/dnu agree with this repo's numpy LM fitter on the same

@@ -8,9 +8,11 @@ spanning weak to strong scattering and anisotropy, so CI fails if any
 change pushes the f32 path beyond the documented budget.
 
 Mechanics: the same ``make_pipeline`` step is traced twice — once under
-x64 (f64 compute, the oracle) and once inside ``jax.enable_x64(False)``
-(true f32 compute end-to-end: closed-over f64 constants are demoted at
-trace time exactly as on the chip; output dtypes asserted to prove it).
+x64 (f64 compute, the oracle) and once inside the x64-disabled context
+(``jax.enable_x64(False)`` where jax still has it, else
+``jax.experimental.disable_x64()`` — see ``_x64_disabled``): true f32
+compute end-to-end, closed-over f64 constants demoted at trace time
+exactly as on the chip; output dtypes asserted to prove it.
 
 Budgets vs observation (f32-on-CPU, 128x128, numsteps=1000; worst over
 the 8 regimes, 2026-07-31): eta 1.7e-5, tau 2.2e-7, dnu 1.9e-7, etaerr
@@ -32,6 +34,21 @@ import pytest
 
 # documented budget: relative |f32 - f64| / |f64|
 BUDGET = {"eta": 5e-3, "etaerr": 1e-2, "tau": 1e-3, "dnu": 1e-3}
+
+
+def _x64_disabled():
+    """Context manager forcing f32 compute for the traced leg.
+
+    jax < 0.4.x exposed ``jax.enable_x64(bool)``; jaxlib 0.4.37 removed
+    it in favour of ``jax.experimental.disable_x64()`` — pick whichever
+    this jax provides (version-guarded, per the jax changelog)."""
+    import jax
+
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(False)
+    from jax.experimental import disable_x64
+
+    return disable_x64()
 
 REGIMES = (
     dict(mb2=0.5, ar=1.0, seed=1),    # very weak scattering
@@ -70,13 +87,11 @@ def _get(r, name):
 
 
 def test_f32_pipeline_within_budget(pipeline_and_epochs):
-    import jax
-
     step, epochs = pipeline_and_epochs
     worst = {k: (0.0, None) for k in BUDGET}
     for rg, dyn64 in epochs:
         r64 = step(dyn64)
-        with jax.enable_x64(False):
+        with _x64_disabled():
             r32 = step(dyn64.astype(np.float32))
             # prove the leg really computed in f32 (not silently promoted)
             assert np.asarray(r32.scint.tau).dtype == np.float32

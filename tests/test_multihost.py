@@ -21,31 +21,50 @@ _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 
 def _free_port() -> int:
+    """Bind-then-release: the kernel hands out a currently-free
+    ephemeral port.  Another process may still grab it between release
+    and the coordinator's bind — the launcher below retries once on
+    that exact failure instead of flaking."""
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
 
 
+def _launch_workers(env) -> tuple[list, list]:
+    """Run the two-process mesh on a freshly-probed free port,
+    retrying ONCE with a new port if the coordinator lost the
+    bind race ('Address already in use')."""
+    for attempt in (0, 1):
+        port = _free_port()
+        procs = [subprocess.Popen(
+            [sys.executable, _WORKER, str(i), str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env) for i in range(2)]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=240)
+                outs.append(out)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            pytest.fail("multihost workers timed out:\n"
+                        + "\n".join(o or "" for o in outs))
+        bind_lost = any(p.returncode != 0
+                        and "Address already in use" in (o or "")
+                        for p, o in zip(procs, outs))
+        if bind_lost and attempt == 0:
+            continue
+        return procs, outs
+    return procs, outs  # pragma: no cover (loop always returns)
+
+
 def test_two_process_mesh_psum_survey_stats():
-    port = _free_port()
     env = dict(os.environ)
     # workers pick their own platform/device-count; scrub inherited flags
     env.pop("XLA_FLAGS", None)
     env["JAX_PLATFORMS"] = "cpu"
-    procs = [subprocess.Popen(
-        [sys.executable, _WORKER, str(i), str(port)],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env=env) for i in range(2)]
-    outs = []
-    try:
-        for p in procs:
-            out, _ = p.communicate(timeout=240)
-            outs.append(out)
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        pytest.fail("multihost workers timed out:\n"
-                    + "\n".join(o or "" for o in outs))
+    procs, outs = _launch_workers(env)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
         assert f"MULTIHOST_OK pid={i}" in out, out

@@ -21,6 +21,22 @@ def test_no_print_in_compute_path():
         + "\n".join(f"  {p}:{ln}: {txt}" for p, ln, txt in offenders))
 
 
+def test_checker_walks_serve_subtree(tmp_path):
+    """The serve subsystem's modules are inside the lint's walk: a
+    print() planted in a scintools_tpu/serve/-shaped tree is caught
+    (its CLI JSON protocol would be corrupted by stray stdout)."""
+    pkg = tmp_path / "scintools_tpu"
+    (pkg / "serve").mkdir(parents=True)
+    (pkg / "serve" / "worker.py").write_text("print('leak')\n")
+    offenders = check_no_print.check_tree(str(pkg))
+    assert [(p, ln) for p, ln, _ in offenders] == \
+        [(os.path.join("serve", "worker.py"), 1)]
+    # and the REAL serve subtree is present and clean
+    real = os.path.join(os.path.dirname(_HERE), "scintools_tpu", "serve")
+    assert os.path.isdir(real)
+    assert check_no_print.check_tree(real) == []
+
+
 def test_checker_catches_a_real_print(tmp_path):
     bad = tmp_path / "mod.py"
     bad.write_text('x = 1\nprint("leak")\n'

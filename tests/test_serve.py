@@ -1,0 +1,632 @@
+"""Resident survey service (scintools_tpu.serve): queue durability and
+lease semantics, dynamic batching onto warm compiled signatures, the
+worker loop's failure isolation, and the end-to-end fault-tolerance
+contract — a SIGKILLed worker's survey resumes to completion with
+results bit-identical to a direct ``run_pipeline`` of the same epochs.
+
+All pipeline tests share ONE tiny 32x32 signature (OPTS below) so the
+in-process jit trace is paid once across the module."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from synth import synth_arc_epoch
+
+from scintools_tpu import obs
+from scintools_tpu.io.psrflux import write_psrflux
+from scintools_tpu.serve import (DynamicBatcher, JobQueue, ServeWorker,
+                                 SurveyClient, job_key)
+from scintools_tpu.serve.queue import Job
+from scintools_tpu.serve.worker import config_from_opts, load_epoch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# one shared tiny-but-real signature for every pipeline-executing test
+OPTS = {"lamsteps": True, "arc_numsteps": 96, "lm_steps": 3}
+# seeds whose 32x32 thin-arc epochs fit finitely under OPTS (seed 0 and
+# 3 legitimately NaN-quarantine at this size — used by the poison test)
+GOOD_SEEDS = (1, 2, 4, 5, 7, 8)
+NAN_SEED = 0
+
+
+def _write_epochs(tmp_path, seeds):
+    files = []
+    for s in seeds:
+        fn = str(tmp_path / f"epoch_{s:02d}.dynspec")
+        write_psrflux(synth_arc_epoch(nf=32, nt=32, seed=s), fn)
+        files.append(fn)
+    return files
+
+
+def _stub_runner(rows_by_name=None, fail_names=()):
+    """A sub-millisecond runner for queue/batcher-semantics tests: real
+    epochs, no jax."""
+
+    def run(batch, batch_size, mesh, async_exec):
+        rows = []
+        for job, ep in zip(batch.jobs, batch.epochs):
+            name = os.path.basename(job.file)
+            if name in fail_names:
+                rows.append({"name": name, "tau": float("nan")})
+                continue
+            row = {"name": name, "mjd": ep.mjd, "freq": ep.freq,
+                   "bw": ep.bw, "tobs": ep.tobs, "dt": ep.dt,
+                   "df": ep.df, "tau": 1.5, "tauerr": 0.1}
+            if rows_by_name:
+                row.update(rows_by_name.get(name, {}))
+            rows.append(row)
+        return rows
+
+    return run
+
+
+# ---------------------------------------------------------------------------
+# queue semantics
+# ---------------------------------------------------------------------------
+
+
+def test_submit_idempotent_across_states_and_store(tmp_path):
+    files = _write_epochs(tmp_path, GOOD_SEEDS[:2])
+    q = JobQueue(str(tmp_path / "q"))
+    jid, st = q.submit(files[0], OPTS)
+    assert st == "submitted"
+    # same content + config -> same job, no duplicate (reports the
+    # existing state)
+    jid2, st2 = q.submit(files[0], OPTS)
+    assert (jid2, st2) == (jid, "queued")
+    assert q.counts()["queued"] == 1
+    # different config -> different job
+    jid3, st3 = q.submit(files[0], dict(OPTS, lamsteps=False))
+    assert jid3 != jid and st3 == "submitted"
+    # a stored result row dedups straight to done (never re-queued)
+    jid4, _ = q.submit(files[1], OPTS)
+    q2 = JobQueue(str(tmp_path / "q"))
+    q2.results.put(jid4, {"name": "x", "tau": 1.0})
+    assert q2.submit(files[1], OPTS) == (jid4, "done")
+    # identical bytes under a different path spelling dedup too
+    alias = str(tmp_path / "alias.dynspec")
+    with open(files[0], "rb") as src, open(alias, "wb") as dst:
+        dst.write(src.read())
+    assert q.submit(alias, OPTS)[0] == jid
+    # option dicts are canonicalised over defaults: a sparse dict and
+    # the CLI's fully-materialised one are the SAME job identity
+    sparse = dict(OPTS)
+    full = dict(OPTS, no_arc=False, no_scint=False, scint_2d=False,
+                arc_asymm=False, arc_stack=False,
+                arc_method="norm_sspec", arc_bracket=None)
+    assert q.submit(files[0], full)[0] == q.submit(files[0], sparse)[0]
+    # a nonexistent path fails fast instead of enqueueing its spelling
+    with pytest.raises(FileNotFoundError):
+        q.submit(str(tmp_path / "nope_missing.dynspec"), OPTS)
+    client = SurveyClient(str(tmp_path / "q"))
+    (rec,) = client.submit([str(tmp_path / "nope_missing.dynspec")], OPTS)
+    assert rec["status"] == "missing" and rec["job"] is None
+
+
+def test_claim_lease_expiry_requeue_backoff_and_poison(tmp_path):
+    files = _write_epochs(tmp_path, GOOD_SEEDS[:3])
+    q = JobQueue(str(tmp_path / "q"), max_retries=2, backoff_s=10.0)
+    for f in files:
+        q.submit(f, OPTS)
+    now = 1000.0
+    got = q.claim("w1", n=2, lease_s=5.0, now=now)
+    assert [j.file for j in got] == [os.path.abspath(f)
+                                     for f in files[:2]]  # FIFO
+    assert q.counts() == {"queued": 1, "leased": 2, "done": 0, "failed": 0}
+    # a second worker cannot double-claim leased jobs
+    got2 = q.claim("w2", n=4, lease_s=5.0, now=now)
+    assert [j.file for j in got2] == [os.path.abspath(files[2])]
+    # nothing expired yet
+    assert q.reap_expired(now + 4.0) == ([], [])
+    # SIGKILL simulation: the leases just run out
+    requeued, poisoned = q.reap_expired(now + 6.0)
+    assert len(requeued) == 3 and not poisoned
+    assert q.counts()["queued"] == 3 and q.counts()["leased"] == 0
+    # exponential backoff: not claimable until not_before passes
+    assert q.claim("w1", n=4, lease_s=5.0, now=now + 7.0) == []
+    again = q.claim("w1", n=4, lease_s=5.0, now=now + 6.0 + 10.0)
+    assert len(again) == 3 and all(j.attempts == 1 for j in again)
+    # retries exhaust -> terminal failed/ (poison), not an infinite loop
+    _, poisoned = q.reap_expired(now + 100.0)
+    assert not poisoned
+    q.claim("w1", n=4, lease_s=1.0, now=now + 200.0)
+    _, poisoned = q.reap_expired(now + 300.0)
+    assert len(poisoned) == 3
+    assert q.counts()["failed"] == 3 and q.empty()
+    for job in q.jobs("failed"):
+        assert job.attempts == 3 and "lease expired" in job.error
+
+
+def test_fail_and_complete_tolerate_requeued_copies(tmp_path):
+    files = _write_epochs(tmp_path, GOOD_SEEDS[:1])
+    q = JobQueue(str(tmp_path / "q"), max_retries=1, backoff_s=0.0)
+    q.submit(files[0], OPTS)
+    (job,) = q.claim("w1", n=1, lease_s=5.0, now=0.0)
+    # the lease expired under a LIVE worker and the job was requeued;
+    # the worker still finishes and completes -> done wins, no orphans
+    q.reap_expired(1e9)
+    assert q.counts()["queued"] == 1
+    q.complete(job)
+    assert q.counts() == {"queued": 0, "leased": 0, "done": 1, "failed": 0}
+    # explicit fail: retryable requeues with attempts+1, then poisons
+    q2 = JobQueue(str(tmp_path / "q2"), max_retries=1, backoff_s=0.0)
+    q2.submit(files[0], OPTS)
+    (j,) = q2.claim("w", n=1, lease_s=5.0)
+    assert q2.fail(j, "transient") == "queued"
+    (j,) = q2.claim("w", n=1, lease_s=5.0, now=time.time() + 1.0)
+    assert j.attempts == 1
+    assert q2.fail(j, "still broken") == "failed"
+    assert q2.counts()["failed"] == 1
+    assert q2.jobs("failed")[0].error == "still broken"
+    # a stale failure for a job ANOTHER worker completed never
+    # un-completes it: done wins, no failed/queued orphans
+    q3 = JobQueue(str(tmp_path / "q3"), max_retries=1, backoff_s=0.0)
+    q3.submit(files[0], OPTS)
+    (j3,) = q3.claim("wA", n=1, lease_s=5.0)
+    q3.results.put(j3.id, {"name": "x", "tau": 1.0})
+    q3.complete(j3)
+    assert q3.fail(j3, "stale worker A failure") == "done"
+    assert q3.counts() == {"queued": 0, "leased": 0, "done": 1,
+                           "failed": 0}
+
+
+def test_claim_preserves_concurrent_requeue_attempts(tmp_path,
+                                                     monkeypatch):
+    """A fail+requeue landing in another claimer's read->rename window
+    must not have its retry accounting reset: the lease stamp applies
+    to the record that was actually renamed, not the stale pre-race
+    read."""
+    files = _write_epochs(tmp_path, GOOD_SEEDS[:1])
+    q = JobQueue(str(tmp_path / "q"), backoff_s=0.0)
+    jid, _ = q.submit(files[0], OPTS)
+    real_rename = os.rename
+
+    def racy_rename(src, dst):
+        # worker B's fail()->requeue slips in between A's candidate
+        # read and A's rename: the queued record now carries attempts=2
+        if os.path.basename(src) == f"{jid}.json" and "queued" in src:
+            with open(src) as fh:
+                rec = json.load(fh)
+            rec.update(attempts=2, error="B failed it twice")
+            with open(src, "w") as fh:
+                json.dump(rec, fh)
+        real_rename(src, dst)
+
+    monkeypatch.setattr(os, "rename", racy_rename)
+    (j,) = q.claim("workerA", n=1, lease_s=5.0)
+    assert j.attempts == 2 and j.error == "B failed it twice"
+    assert q.jobs("leased")[0].attempts == 2
+
+
+def test_results_store_put_new_atomicity_and_corrupt_row(tmp_path):
+    """put_new never rewrites an existing row; a torn/corrupt row
+    degrades to None and cannot break records()/export_csv for the
+    healthy rows (the store is multi-writer under serve)."""
+    from scintools_tpu.utils.store import ResultsStore
+
+    st = ResultsStore(str(tmp_path / "r"))
+    assert st.put_new("k1", {"name": "a", "tau": 1.0}) is True
+    assert st.put_new("k1", {"name": "a", "tau": 2.0}) is False
+    assert st.get("k1")["tau"] == 1.0
+    with open(os.path.join(st.dir, "torn.json"), "w") as fh:
+        fh.write('{"name": "b", "tau":')   # crash mid-write elsewhere
+    assert st.get("torn") is None
+    assert [r["name"] for r in st.records()] == ["a"]
+    out = str(tmp_path / "o.csv")
+    assert st.export_csv(out, full=True) == 1
+
+
+# ---------------------------------------------------------------------------
+# batcher semantics
+# ---------------------------------------------------------------------------
+
+
+def test_batcher_flush_on_fill_deadline_and_force(tmp_path):
+    files = _write_epochs(tmp_path, GOOD_SEEDS[:4])
+    eps = [load_epoch(f) for f in files]
+    jobs = [Job(id=f"j{i}", file=f, cfg=dict(OPTS), submitted_at=0.0)
+            for i, f in enumerate(files)]
+    b = DynamicBatcher(batch_size=2, max_wait_s=5.0)
+    b.add(jobs[0], eps[0], now=100.0)
+    assert b.pop_ready(now=100.1) == [] and b.pending == 1
+    # fill -> immediate flush at exactly batch_size
+    b.add(jobs[1], eps[1], now=100.2)
+    (full,) = b.pop_ready(now=100.3)
+    assert [j.id for j in full.jobs] == ["j0", "j1"]
+    assert full.fill_ratio == 1.0 and b.pending == 0
+    # deadline -> partial flush with fill < 1
+    b.add(jobs[2], eps[2], now=200.0)
+    assert b.pop_ready(now=204.9) == []
+    (part,) = b.pop_ready(now=205.1)
+    assert part.fill_ratio == 0.5 and [j.id for j in part.jobs] == ["j2"]
+    # force (drain) flushes immediately
+    b.add(jobs[3], eps[3], now=300.0)
+    (forced,) = b.pop_ready(now=300.0, force=True)
+    assert [j.id for j in forced.jobs] == ["j3"]
+    # an overfilled bucket flushes in batch_size slices, and the tail
+    # waits ITS OWN max_wait (per-item stamps) instead of inheriting
+    # the flushed head's expired deadline
+    for k, t in ((0, 400.0), (1, 400.1), (2, 406.0)):
+        b.add(jobs[k], eps[k], now=t)
+    (head,) = b.pop_ready(now=406.1)
+    assert [j.id for j in head.jobs] == ["j0", "j1"]
+    assert b.pop_ready(now=410.9) == []      # j2 deadline is 411.0
+    (tail,) = b.pop_ready(now=411.1)
+    assert [j.id for j in tail.jobs] == ["j2"]
+
+
+def test_batcher_buckets_by_config_and_axes(tmp_path):
+    f1 = _write_epochs(tmp_path, GOOD_SEEDS[:1])[0]
+    ep32 = load_epoch(f1)
+    fn64 = str(tmp_path / "big.dynspec")
+    write_psrflux(synth_arc_epoch(nf=64, nt=64, seed=1), fn64)
+    ep64 = load_epoch(fn64)
+    b = DynamicBatcher(batch_size=2, max_wait_s=0.0)
+    b.add(Job(id="a", file=f1, cfg=dict(OPTS), submitted_at=0.0), ep32)
+    b.add(Job(id="b", file=fn64, cfg=dict(OPTS), submitted_at=0.0), ep64)
+    b.add(Job(id="c", file=f1, cfg=dict(OPTS, lamsteps=False),
+              submitted_at=0.0), ep32)
+    batches = b.pop_ready(force=True)
+    # three singleton buckets: mixed shapes/configs never share a step
+    assert sorted(len(x.jobs) for x in batches) == [1, 1, 1]
+    assert len({x.key for x in batches}) == 3
+
+
+# ---------------------------------------------------------------------------
+# worker loop (stub runner: queue/batching semantics without jax)
+# ---------------------------------------------------------------------------
+
+
+def test_smoke_submit_serve_drain_status_in_process(tmp_path):
+    """The tier-1 smoke of the serve protocol: submit -> serve (one
+    in-process worker, stub executor) -> drain -> status, sub-second."""
+    t0 = time.perf_counter()
+    files = _write_epochs(tmp_path, GOOD_SEEDS[:3])
+    qdir = str(tmp_path / "q")
+    client = SurveyClient(qdir)
+    recs = client.submit(files, OPTS)
+    assert [r["status"] for r in recs] == ["submitted"] * 3
+    client.drain()   # worker exits once the queue is empty
+    worker = ServeWorker(JobQueue(qdir), batch_size=2, max_wait_s=0.0,
+                         lease_s=30.0, poll_s=0.01,
+                         runner=_stub_runner())
+    stats = worker.run()
+    assert stats["jobs_done"] == 3 and stats["jobs_failed"] == 0
+    st = client.status()
+    assert st["done"] == 3 and st["results"] == 3 and st["depth"] == 0
+    # resubmit dedups against the results store
+    assert [r["status"] for r in client.submit(files, OPTS)] == \
+        ["done"] * 3
+    assert time.perf_counter() - t0 < 1.0, "serve smoke must stay fast"
+
+
+def test_worker_isolates_poison_jobs_from_the_batch(tmp_path):
+    """A NaN lane fails ONLY its own job: healthy batch members
+    complete, the poison member retries with backoff and lands in
+    failed/ once the retry budget is spent."""
+    files = _write_epochs(tmp_path, GOOD_SEEDS[:2] + (NAN_SEED,))
+    qdir = str(tmp_path / "q")
+    q = JobQueue(qdir, max_retries=1, backoff_s=0.0)
+    for f in files:
+        q.submit(f, OPTS)
+    q.request_drain()
+    bad = os.path.basename(files[2])
+    worker = ServeWorker(q, batch_size=3, max_wait_s=0.0, lease_s=30.0,
+                         poll_s=0.01, runner=_stub_runner(
+                             fail_names={bad}))
+    stats = worker.run()
+    assert stats["jobs_done"] == 2
+    assert stats["jobs_failed"] == 1 and stats["job_retries"] == 1
+    assert q.counts()["failed"] == 1
+    (poison,) = q.jobs("failed")
+    assert os.path.basename(poison.file) == bad
+    assert "non-finite" in poison.error
+    assert len(q.results.keys()) == 2
+
+
+def test_whole_batch_failure_isolates_poison_via_solo_retries(tmp_path):
+    """A batch-wide pipeline exception must not burn the healthy
+    members' retry budgets alongside the poison one: every member
+    requeues marked solo, retries run as singleton batches, the poison
+    job alone is poisoned and the healthy jobs complete."""
+    files = _write_epochs(tmp_path, GOOD_SEEDS[:3])
+    qdir = str(tmp_path / "q")
+    q = JobQueue(qdir, max_retries=2, backoff_s=0.0)
+    for f in files:
+        q.submit(f, OPTS)
+    q.request_drain()
+    bad = os.path.basename(files[1])
+    ok_runner = _stub_runner()
+
+    def runner(batch, batch_size, mesh, async_exec):
+        if any(os.path.basename(j.file) == bad for j in batch.jobs) \
+                and len(batch.jobs) > 1:
+            raise RuntimeError("poison member wedges the whole batch")
+        if [os.path.basename(j.file) for j in batch.jobs] == [bad]:
+            raise RuntimeError("still poison, even alone")
+        return ok_runner(batch, batch_size, mesh, async_exec)
+
+    worker = ServeWorker(q, batch_size=3, max_wait_s=0.0, lease_s=30.0,
+                         poll_s=0.01, runner=runner)
+    stats = worker.run()
+    assert stats["jobs_done"] == 2, stats
+    assert stats["jobs_failed"] == 1, stats
+    (poison,) = q.jobs("failed")
+    assert os.path.basename(poison.file) == bad and poison.solo
+    assert len(q.results.keys()) == 2
+
+
+def test_worker_mesh_indivisible_batch_fails_fast(tmp_path):
+    from scintools_tpu.parallel import make_mesh
+
+    q = JobQueue(str(tmp_path / "q"))
+    with pytest.raises(ValueError, match="multiple of the mesh"):
+        ServeWorker(q, batch_size=3, mesh=make_mesh((4, 2)))
+
+
+def test_worker_load_failure_quarantined(tmp_path):
+    qdir = str(tmp_path / "q")
+    q = JobQueue(qdir, max_retries=0, backoff_s=0.0)
+    missing = str(tmp_path / "nope.dynspec")
+    with open(missing, "w") as fh:
+        fh.write("not a psrflux file\n")
+    q.submit(missing, OPTS)
+    q.request_drain()
+    worker = ServeWorker(q, batch_size=2, max_wait_s=0.0, lease_s=30.0,
+                         poll_s=0.01, runner=_stub_runner())
+    stats = worker.run()
+    assert stats["jobs_failed"] == 1 and stats["jobs_done"] == 0
+    assert q.counts()["failed"] == 1
+    assert "load failed" in q.jobs("failed")[0].error
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: real pipeline, fault tolerance, warm-signature contract
+# ---------------------------------------------------------------------------
+
+
+def _direct_csv(files, opts, tmp_path, batch):
+    """The direct-run oracle: same loader, same config, same batch
+    decomposition (chunk=batch, pad_chunks -> identical padded compiled
+    signatures), same row builders, same content-keyed store."""
+    from scintools_tpu.io.results import (batch_lane_row, results_row,
+                                          row_fit_values)
+    from scintools_tpu.parallel import run_pipeline
+    from scintools_tpu.utils.store import ResultsStore
+
+    cfg = config_from_opts(opts)
+    epochs = [load_epoch(f) for f in files]
+    store = ResultsStore(str(tmp_path / "direct_store"))
+    buckets = run_pipeline(epochs, cfg, chunk=batch, pad_chunks=True,
+                           async_exec=False)
+    for idx, res in buckets:
+        for lane, i in enumerate(idx):
+            row = results_row(epochs[i])
+            row.update(batch_lane_row(res, lane, cfg.lamsteps))
+            fitvals = row_fit_values(row)
+            if fitvals and not np.all(np.isfinite(fitvals)):
+                continue   # the CLI's quarantine rule
+            row["name"] = os.path.basename(files[i])
+            store.put(job_key(files[i], opts), row)
+    out = str(tmp_path / "direct.csv")
+    store.export_csv(out)
+    with open(out) as fh:
+        return fh.read()
+
+
+def test_served_results_bit_identical_to_direct_run(tmp_path):
+    """Dynamic batching + pad_to changes NOTHING numerically: a served
+    survey's exported CSV is byte-identical to a direct run_pipeline
+    over the same epochs with the same batch decomposition."""
+    files = _write_epochs(tmp_path, GOOD_SEEDS)   # 6 epochs, batch 4
+    qdir = str(tmp_path / "q")
+    client = SurveyClient(qdir)
+    client.submit(files, OPTS)
+    client.drain()
+    worker = ServeWorker(JobQueue(qdir), batch_size=4, max_wait_s=0.0,
+                         lease_s=120.0, poll_s=0.01)
+    stats = worker.run()
+    assert stats["jobs_done"] == len(files)
+    assert stats["jobs_failed"] == 0
+    served = str(tmp_path / "served.csv")
+    client.export_csv(served)
+    with open(served) as fh:
+        served_text = fh.read()
+    assert served_text == _direct_csv(files, OPTS, tmp_path, batch=4)
+
+
+def test_worker_sigkill_mid_batch_resumes_bit_identical(tmp_path):
+    """THE fault-tolerance acceptance demo: N submitted epochs survive
+    a worker SIGKILL mid-batch — leased jobs are reclaimed after lease
+    expiry, no result row is duplicated (content-keyed store), and the
+    final CSV is bit-identical to a direct run_pipeline of the same
+    epochs."""
+    files = _write_epochs(tmp_path, GOOD_SEEDS)   # 6 epochs
+    qdir = str(tmp_path / "q")
+    client = SurveyClient(qdir)
+    recs = client.submit(files, OPTS)
+    assert [r["status"] for r in recs] == ["submitted"] * 6
+
+    # a REAL subprocess worker (x64 CPU, like the test env), cold
+    # compile cache so its first batch reliably outlives the kill delay
+    env = dict(os.environ, JAX_PLATFORMS="cpu", SCINT_COMPILE_CACHE="off")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    code = (
+        "from scintools_tpu.backend import force_host_cpu_devices\n"
+        "force_host_cpu_devices(1)\n"
+        "import jax\n"
+        "jax.config.update('jax_enable_x64', True)\n"
+        "import sys\n"
+        "from scintools_tpu.cli import main\n"
+        "sys.exit(main(['serve', %r, '--batch', '4', '--max-wait', '1',"
+        " '--lease', '2', '--poll', '0.05']))\n" % qdir)
+    proc = subprocess.Popen([sys.executable, "-c", code], env=env,
+                            cwd=REPO, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True)
+    queue = JobQueue(qdir)
+    try:
+        # wait until the worker holds a FULL batch of leases (claim is
+        # atomic per job; the batch then sits in its long cold compile)
+        deadline = time.time() + 120.0
+        while time.time() < deadline:
+            if queue.counts()["leased"] == 4:
+                break
+            if proc.poll() is not None:
+                pytest.fail("worker exited early:\n"
+                            + (proc.stdout.read() or ""))
+            time.sleep(0.02)
+        else:
+            pytest.fail("worker never leased a full batch")
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    # mid-batch death: 4 leased (orphaned), 2 still queued, no results
+    counts = queue.counts()
+    assert counts["leased"] == 4 and counts["queued"] == 2
+    assert len(queue.results.keys()) == 0
+
+    # resume: a fresh worker drains the queue — the 2 queued jobs ride
+    # the first (padded) batch, the 4 orphans reclaim at lease expiry
+    client.drain()
+    resume = ServeWorker(JobQueue(qdir, backoff_s=0.1), batch_size=4,
+                         max_wait_s=0.0, lease_s=120.0, poll_s=0.05)
+    stats = resume.run()
+    assert stats["jobs_done"] == 6 and stats["jobs_failed"] == 0
+    assert stats["job_retries"] >= 4   # the reclaimed leases
+    assert queue.empty() and queue.counts()["done"] == 6
+    # exactly one result row per epoch: idempotent content keys
+    assert len(queue.results.keys()) == 6
+
+    served = str(tmp_path / "served.csv")
+    client.export_csv(served)
+    with open(served) as fh:
+        served_text = fh.read()
+    assert served_text == _direct_csv(files, OPTS, tmp_path, batch=4)
+    assert served_text.count("\n") == 7   # header + 6 rows
+
+
+def test_warmed_worker_zero_retrace_and_trace_report(tmp_path,
+                                                     monkeypatch):
+    """Acceptance: a warmed worker serves with ``jit_cache_miss == 0``
+    (every batch rides the AOT artifact + persistent cache), and
+    ``batch_fill_ratio`` / ``queue_wait_s`` appear in trace report."""
+    from scintools_tpu import compile_cache
+    from scintools_tpu.parallel.driver import make_pipeline
+
+    monkeypatch.setenv("SCINT_COMPILE_CACHE", str(tmp_path / "scc"))
+    obs.disable(flush=False)
+    obs.reset()
+    files = _write_epochs(tmp_path, GOOD_SEEDS[:4])
+    cfg = config_from_opts(OPTS)
+    tmpl = load_epoch(files[0])
+    f, t = np.asarray(tmpl.freqs), np.asarray(tmpl.times)
+    # warm the exact signature the batcher will execute: (batch, nf, nt)
+    step = make_pipeline(f, t, cfg)
+    key = compile_cache.step_key(f, t, cfg, None, False,
+                                 (4,) + tmpl.dyn.shape, np.float64)
+    assert compile_cache.export_step(step, (4,) + tmpl.dyn.shape,
+                                     np.float64, key) is not None
+
+    qdir = str(tmp_path / "q")
+    client = SurveyClient(qdir)
+    client.submit(files, OPTS)
+    client.drain()
+    trace = str(tmp_path / "serve.jsonl")
+    with obs.tracing(jsonl=trace):
+        worker = ServeWorker(JobQueue(qdir), batch_size=4,
+                             max_wait_s=0.0, lease_s=120.0, poll_s=0.01)
+        stats = worker.run()
+        c = obs.counters()
+    assert stats["jobs_done"] == 4 and stats["jobs_failed"] == 0
+    assert c.get("jit_cache_miss", 0) == 0, c
+    assert c.get("compile_cache_hit", 0) >= 1, c
+    assert c.get("serve_batches") == 1
+    assert c.get("serve_lanes_filled") == 4
+    assert c.get("queue_wait_s", 0) > 0
+    assert c.get("jobs_done") == 4
+    # the persisted trace renders the serve section + the two headline
+    # quantities (the acceptance wording: they "appear in trace report")
+    text = obs.report(trace)
+    assert "serve (resident survey service)" in text
+    assert "batch_fill_ratio" in text
+    assert "queue_wait_s" in text
+    assert "jobs_done = 4" in text
+    obs.reset()
+
+
+def test_cli_submit_status_drain_roundtrip(tmp_path, capsys):
+    """The filesystem protocol through the CLI verbs (no worker): submit
+    twice (dedup), status counts, drain marker + CSV export."""
+    from scintools_tpu.cli import main as cli_main
+
+    files = _write_epochs(tmp_path, GOOD_SEEDS[:2])
+    qdir = str(tmp_path / "q")
+    assert cli_main(["submit", qdir, "--lamsteps", *files]) == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["submitted"] == 2 and rec["deduped"] == 0
+    assert all(r["status"] == "submitted" for r in rec["jobs"])
+    assert cli_main(["submit", qdir, "--lamsteps", *files]) == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["submitted"] == 0 and rec["deduped"] == 2
+
+    # an unmatched glob / typo'd path is reported missing with rc 1,
+    # never enqueued as its literal spelling
+    bogus = str(tmp_path / "bogus_*.dynspec")
+    assert cli_main(["submit", qdir, bogus]) == 1
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["missing"] == 1 and rec["submitted"] == 0
+    assert rec["jobs"][0]["status"] == "missing"
+
+    assert cli_main(["status", qdir]) == 0
+    st = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert st["queued"] == 2 and st["depth"] == 2
+    assert st["drain_requested"] is False
+
+    # read-side verbs on a mistyped path error instead of silently
+    # creating (and then reporting) a fresh empty queue
+    typo = str(tmp_path / "not_a_queue")
+    for verb in (["status", typo], ["drain", typo]):
+        with pytest.raises(SystemExit, match="no such queue"):
+            cli_main(verb)
+        assert not os.path.exists(typo)
+    capsys.readouterr()
+
+    # drain with no worker: marker set, queue not emptied -> rc 1
+    assert cli_main(["drain", qdir, "--timeout", "0.1"]) == 1
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["drained"] is False
+    assert JobQueue(qdir).drain_requested()
+    # marker-only drain (no timeout) reports rc 0
+    assert cli_main(["drain", qdir]) == 0
+    capsys.readouterr()
+
+
+def test_cli_serve_idle_exit_and_drain_consumption(tmp_path, capsys):
+    """`serve` on an empty queue: --idle-exit returns promptly with a
+    clean stats line; a pending drain request makes the worker exit
+    immediately AND consumes the marker (the drain-then-start flow:
+    'finish this queue and exit'), so the next session is resident."""
+    from scintools_tpu.cli import main as cli_main
+
+    qdir = str(tmp_path / "q")
+    JobQueue(qdir).request_drain()
+    # --ignore-drain: marker untouched, exits on idle instead
+    assert cli_main(["serve", qdir, "--idle-exit", "0.05",
+                     "--poll", "0.01", "--ignore-drain"]) == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["jobs_done"] == 0 and rec["batches"] == 0
+    assert JobQueue(qdir).drain_requested()
+    # honoured drain: immediate exit on the empty queue, marker consumed
+    assert cli_main(["serve", qdir, "--poll", "0.01"]) == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["jobs_done"] == 0
+    assert not JobQueue(qdir).drain_requested()
