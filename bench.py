@@ -36,7 +36,10 @@ SCINT_BENCH_NT (epoch shape, default 256x512), SCINT_BENCH_CPU_EPOCHS
 default 180), SCINT_BENCH_PROBE_RETRIES / SCINT_BENCH_PROBE_PAUSE
 (probe retry loop for transient tunnel weather, default 3 x 120 s
 pause), SCINT_BENCH_DEVICE_TIMEOUT (full-run watchdog, default 1200),
-SCINT_BENCH_REPEATS (timed device passes, median reported, default 3),
+SCINT_BENCH_REPEATS (minimum timed device passes, default 3) +
+SCINT_BENCH_MIN_MEASURE_S (minimum total measured wall, default 2 s —
+passes repeat until both are met, capped at SCINT_BENCH_MAX_REPEATS,
+default 32; the record reports median + IQR as ``rate_stats``),
 SCINT_BENCH_CPU_THREADS (BLAS pin in the fallback subprocess),
 SCINT_BENCH_FLIGHTS_DIR (flight-log dir for record salvage, default
 benchmarks/flights/ — test fixtures point it at tmp dirs),
@@ -526,11 +529,15 @@ def device_throughput(dyn, freqs, times, chunk: int,
     the same step shards over a mesh unchanged).  Returns a dict with
     dynspec/s plus compile and measure wall time, separately.
 
-    ``repeats > 1`` re-times the measured pass that many times and
-    reports the MEDIAN rate plus the per-repeat rates — the
-    CPU-fallback path uses 3 so a single contention spike on a shared
-    host can't own the round's record (round-4 lesson: the r03/r04
-    fallback headlines were single-shot and incomparable)."""
+    ``repeats`` sets the MINIMUM number of measured passes; passes
+    keep running until the total measured wall reaches
+    ``SCINT_BENCH_MIN_MEASURE_S`` (default 2 s, capped at
+    ``SCINT_BENCH_MAX_REPEATS``) so the window is fixed-budget rather
+    than fixed-count, and the record reports ``rate_stats`` —
+    {n, median, q25, q75, iqr_pct, measure_wall_s} — instead of a raw
+    per-repeat list (round-5 lesson: 3 samples spread ±10% on chip;
+    round-4 lesson: the r03/r04 fallback headlines were single-shot
+    and incomparable)."""
     _enable_compile_cache()
     _maybe_enable_trace()
     import jax
@@ -575,17 +582,33 @@ def device_throughput(dyn, freqs, times, chunk: int,
     # WARM-cache start: what a FRESH process pays once the persistent
     # cache holds this program — lower() re-traces (bypassing jit's
     # in-process cache) and compile() is served from disk.  The span
-    # name feeds `trace report`'s cold/warm compile split.
+    # name feeds `trace report`'s cold/warm compile split.  The
+    # compiled handle also yields XLA's OWN cost analysis for the exact
+    # step program — the measured-roofline source (flops + bytes
+    # accessed per execution), preferred over the analytic model in the
+    # headline record.
     t0 = time.perf_counter()
+    warm_s = cost = None
     try:
         with obs.span("bench.step.compile.warm", chunk=chunk):
-            step.lower(dyn_d[:chunk]).compile()
+            compiled = step.lower(dyn_d[:chunk]).compile()
         warm_s = time.perf_counter() - t0
-    except Exception:  # lowering quirk must never sink the bench
-        warm_s = None
+        from scintools_tpu.obs import xla_cost_analysis
 
+        cost = xla_cost_analysis(compiled)
+    except Exception:  # lowering quirk must never sink the bench
+        pass
+
+    # Measurement window (round-6 stabilisation): BENCH_r05's 3-sample
+    # repeat_rates spread 1699-2052 dynspec/s (~±10%) because each pass
+    # was ~0.5 s of wall — too short for a tunnelled runtime's jitter.
+    # Repeat timed passes until BOTH a minimum pass count AND a minimum
+    # total measured wall are reached, then report median + IQR.
+    min_wall = float(os.environ.get("SCINT_BENCH_MIN_MEASURE_S", "2.0"))
+    max_passes = _env_int("SCINT_BENCH_MAX_REPEATS", 32)
     rates = []
-    for _ in range(max(int(repeats), 1)):
+    spent = 0.0
+    while True:
         t0 = time.perf_counter()
         with obs.span("bench.step.execute", B=B, chunk=chunk):
             outs = []
@@ -595,8 +618,16 @@ def device_throughput(dyn, freqs, times, chunk: int,
                     part = dyn_d[B - chunk:B]
                 outs.append(step(part))  # async dispatch; fits on device
             sync(outs)
-        rates.append(B / (time.perf_counter() - t0))
+        dt_pass = time.perf_counter() - t0
+        rates.append(B / dt_pass)
+        spent += dt_pass
+        if len(rates) >= max_passes:
+            break
+        if len(rates) >= max(int(repeats), 1) and spent >= min_wall:
+            break
     rate = float(np.median(rates))
+    q25, q75 = (float(np.percentile(rates, 25)),
+                float(np.percentile(rates, 75)))
     # measure_s is derived from the SAME median pass the rate reports,
     # so the two fields always describe one measurement (round-over-
     # round measure_s comparisons must not be spike-owned)
@@ -605,11 +636,20 @@ def device_throughput(dyn, freqs, times, chunk: int,
            # empty-cache first step; warm_start_s = fresh-process,
            # POPULATED-cache first step; measure_s = steady state
            "cold_start_s": round(compile_s, 2),
-           "measure_s": round(B / rate, 3)}
+           "measure_s": round(B / rate, 3),
+           # median + IQR over the whole fixed-wall window, replacing
+           # the old spike-prone 3-sample list
+           "rate_stats": {"n": len(rates), "median": round(rate, 2),
+                          "q25": round(q25, 2), "q75": round(q75, 2),
+                          "iqr_pct": (round(100.0 * (q75 - q25) / rate, 1)
+                                      if rate else 0.0),
+                          "measure_wall_s": round(spent, 3)}}
     if warm_s is not None:
         rec["warm_start_s"] = round(warm_s, 2)
-    if len(rates) > 1:
-        rec["repeat_rates"] = [round(r, 2) for r in rates]
+    if cost:
+        # per-STEP counts at this chunk size; consumers divide by the
+        # batch to get per-epoch numbers
+        rec["cost_analysis"] = dict(cost, batch=int(chunk))
     _trace_flush()   # counters, for the fallback-subprocess caller
     return rec
 
@@ -650,8 +690,8 @@ def main():
         for k in ("cold_start_s", "warm_start_s"):
             if res.get(k) is not None:
                 rec[k] = res[k]
-        if res.get("repeat_rates"):
-            rec["repeat_rates"] = res["repeat_rates"]
+        if res.get("rate_stats"):
+            rec["rate_stats"] = res["rate_stats"]
         # MFU/roofline accounting against the probed chip's published
         # peaks (device kind comes from the probe subprocess, so a wedged
         # main-process backend is never touched here)
@@ -692,9 +732,22 @@ def main():
             if on_tpu and _gram_bytes((bc, nf, nt), None, 4) \
                     <= _AUTO_MATMUL_GRAM_BYTE_CAP:
                 cuts = "matmul"
+            # measured per-epoch costs from the compiled step's own XLA
+            # cost analysis (device_throughput captured per-step counts
+            # at its chunk size) — preferred over the model inside
+            # roofline_record; the record keeps both plus the
+            # measured_vs_model ratios
+            measured = None
+            ca = res.get("cost_analysis")
+            if ca and ca.get("batch") and ca.get("flops") \
+                    and ca.get("bytes_accessed"):
+                measured = {
+                    "flops": ca["flops"] / ca["batch"],
+                    "bytes_accessed": ca["bytes_accessed"] / ca["batch"],
+                }
             rec["roofline"] = roofline_record(
-                rate, nf, nt, peaks=peaks, scint_cuts=cuts,
-                numsteps=2000, lm_steps=20)
+                rate, nf, nt, peaks=peaks, measured=measured,
+                scint_cuts=cuts, numsteps=2000, lm_steps=20)
         except Exception as e:  # accounting must never sink the record
             rec["roofline"] = {"error": f"{type(e).__name__}: {e}"}
         rec.update(extra)

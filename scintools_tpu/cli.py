@@ -47,8 +47,8 @@ def cmd_info(args) -> int:
 
 def _validate_estimator_flags(args) -> None:
     """Shared --arc-bracket/--arc-method/--pad-chunks fail-fast for
-    process and warmup: a warmup must reject exactly the configs the
-    survey would reject, from one rule site."""
+    process, warmup and submit: a warmup or submit must reject exactly
+    the configs the survey would reject, from one rule site."""
     bracket = getattr(args, "arc_bracket", None)
     if bracket is not None and not (0 < bracket[0] < bracket[1]):
         raise SystemExit(f"--arc-bracket must be 0 < LO < HI, got "
@@ -62,6 +62,14 @@ def _validate_estimator_flags(args) -> None:
             and getattr(args, "chunk_epochs", None) is None):
         raise SystemExit("--pad-chunks pads the final chunk up to "
                          "--chunk-epochs; set --chunk-epochs")
+    from .serve.queue import validate_job_cfg
+    try:
+        validate_job_cfg(
+            {"sspec_crop": getattr(args, "sspec_crop", False),
+             "no_arc": getattr(args, "no_arc", False),
+             "arc_method": getattr(args, "arc_method", "norm_sspec")})
+    except ValueError as e:
+        raise SystemExit(str(e))
 
 
 def cmd_process(args) -> int:
@@ -99,6 +107,14 @@ def cmd_process(args) -> int:
     _validate_estimator_flags(args)
     if arc_method != "norm_sspec" or arc_bracket is not None:
         cfg += (arc_method, tuple(arc_bracket or ()))
+    # precision / fft-length policies change results (bf16 rounding,
+    # composite-grid sampling): non-defaults enter the resume key
+    for knob, dflt in (("precision", "f32"), ("fft_lens", "pow2")):
+        val = getattr(args, knob, dflt)
+        if val != dflt:
+            cfg += (f"{knob}={val}",)
+    if getattr(args, "sspec_crop", False):
+        cfg += ("sspec_crop",)
     if mcmc:
         if args.batched:
             raise SystemExit("--mcmc samples per-epoch posteriors in "
@@ -122,7 +138,13 @@ def cmd_process(args) -> int:
         for flag, name in ((getattr(args, "pad_chunks", False),
                             "--pad-chunks"),
                            (getattr(args, "no_async", False),
-                            "--no-async")):
+                            "--no-async"),
+                           (getattr(args, "precision", "f32") != "f32",
+                            "--precision"),
+                           (getattr(args, "fft_lens", "pow2") != "pow2",
+                            "--fft-lens"),
+                           (getattr(args, "sspec_crop", False),
+                            "--sspec-crop")):
             if flag:
                 raise SystemExit(f"{name} only applies to the batched "
                                  "engine; add --batched")
@@ -297,6 +319,15 @@ def _estimator_opts(args) -> dict:
         opts["arc_bracket"] = [float(bracket[0]), float(bracket[1])]
     if getattr(args, "clean", False):
         opts["clean"] = True
+    # performance-policy knobs enter the option dict (and therefore the
+    # job identity / resume key / batch bucket) only when non-default,
+    # so legacy stores and queued jobs keep their identities
+    if getattr(args, "precision", "f32") != "f32":
+        opts["precision"] = str(args.precision)
+    if getattr(args, "fft_lens", "pow2") != "pow2":
+        opts["fft_lens"] = str(args.fft_lens)
+    if getattr(args, "sspec_crop", False):
+        opts["sspec_crop"] = True
     for k in ("arc_numsteps", "lm_steps"):
         if getattr(args, k, None) is not None:
             opts[k] = int(getattr(args, k))
@@ -1052,6 +1083,33 @@ def cmd_bench(args) -> int:
     return 0
 
 
+def _add_perf_policy_flags(q) -> None:
+    """The batched engine's precision/FFT-length/crop knobs — one
+    definition shared by process/warmup/submit so a warmed or served
+    config always matches what a survey would run (the knobs enter the
+    resume key, the compile-cache key and the serve job identity)."""
+    q.add_argument("--precision", default="f32",
+                   choices=["f32", "bf16_io"],
+                   help="batched-engine I/O precision: bf16_io "
+                        "transfers + holds the dynspec batch in "
+                        "bfloat16 (half the H2D bytes and first-stage "
+                        "reads) with f32 compute; parity budget in "
+                        "docs/performance.md")
+    q.add_argument("--fft-lens", default="pow2", dest="fft_lens",
+                   choices=["pow2", "fast"],
+                   help="secondary-spectrum FFT padding: pow2 = the "
+                        "reference's next-pow2-doubled rule (parity); "
+                        "fast = smallest even 2^a*3^b*5^c composite "
+                        ">= 2n per axis (never longer, often much "
+                        "shorter for non-pow2 epochs)")
+    q.add_argument("--sspec-crop", action="store_true", dest="sspec_crop",
+                   help="fuse the arc fitter's delay-window crop into "
+                        "the compiled step (norm_sspec only): the "
+                        "spectrum tail beyond the fitted window is "
+                        "never materialised; eta identical, etaerr's "
+                        "noise window shrinks to the cropped grid")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="scintools-tpu",
@@ -1137,6 +1195,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="batched mode: mesh shape (data x chan "
                         "parallelism; CHAN>1 shards the sspec FFT's "
                         "channel axis)")
+    _add_perf_policy_flags(q)
     q.set_defaults(fn=cmd_process)
 
     q = sub.add_parser(
@@ -1178,6 +1237,7 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar=("DATA", "CHAN"))
     q.add_argument("--force", action="store_true",
                    help="re-export even when an artifact already exists")
+    _add_perf_policy_flags(q)
     q.set_defaults(fn=cmd_warmup)
 
     q = sub.add_parser(
@@ -1246,6 +1306,7 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--wait", type=float, default=None,
                    help="block until the submitted jobs are terminal "
                         "(or this many seconds pass)")
+    _add_perf_policy_flags(q)
     q.set_defaults(fn=cmd_submit)
 
     q = sub.add_parser("status",

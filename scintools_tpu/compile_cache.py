@@ -349,6 +349,10 @@ def plan_steps(epochs, config, mesh=None, chunk: int | None = None,
         chunked = chunk is not None and chunk < B
         for b in sorted(drv._step_batch_sizes(B, multiple, chunk,
                                               pad_chunks=pad_chunks)):
-            # run_pipeline stages batches as float64 (pad_batch)
-            plans.append((freqs, times, (b, nf, nt), np.float64, chunked))
+            # the staging dtype is the precision policy's transfer dtype
+            # (driver.stage_dtype): f64 host staging by default, bf16
+            # under precision="bf16_io" — it is part of the step key,
+            # so warmup must plan exactly what run_pipeline will stage
+            plans.append((freqs, times, (b, nf, nt),
+                          drv.stage_dtype(config.precision), chunked))
     return plans

@@ -124,6 +124,30 @@ def norm_sspec(sec: SecSpec, freq: float, eta: float, delmax=None,
                      powerspec=powerspec, tdel=tdel, fdopnew=fdopnew)
 
 
+def norm_sspec_row_window(tdel_axis, freq: float, ref_freq: float = 1400.0,
+                          delmax: float | None = None
+                          ) -> tuple[int, int, float]:
+    """The delay-row window the batched norm_sspec fitter consumes:
+    ``(ind, ind_norm, dmax_raw)`` where ``ind`` is the fit-level delay
+    cut index, ``ind_norm`` the row-normalisation cut (the reference's
+    double frequency adjustment, dynspec.py:428-429 then 796-797), and
+    ``dmax_raw`` the pre-adjustment delmax (``max(tdel)`` when None).
+
+    Single source of truth shared by :func:`make_arc_fitter`'s builder
+    and the pipeline driver's fused sspec crop
+    (``PipelineConfig.sspec_crop``): the driver crops the secondary
+    spectrum to ``max(ind, ind_norm) + 1`` rows and rebuilds the fitter
+    on the cropped axes with ``delmax=dmax_raw`` pinned, which this
+    shared rule guarantees resolves to the SAME indices."""
+    tdel_axis = np.asarray(tdel_axis, dtype=np.float64)
+    dmax_raw = float(np.max(tdel_axis)) if delmax is None else float(delmax)
+    dmax = dmax_raw * (ref_freq / freq) ** 2
+    dmax_norm = dmax * (ref_freq / freq) ** 2
+    ind = int(np.argmin(np.abs(tdel_axis - dmax)))
+    ind_norm = int(np.argmin(np.abs(tdel_axis - dmax_norm)))
+    return ind, ind_norm, dmax_raw
+
+
 def _noise_estimate(sspec: np.ndarray, cutmid: int, xp=np) -> float:
     """Noise from the outer Doppler quadrants at high delay
     (dynspec.py:446-451)."""
@@ -477,11 +501,11 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
     # One frequency adjustment for the fit-level delay cut (dynspec.py:428-
     # 429); norm_sspec then re-applies it internally (dynspec.py:796-797) —
     # the reference's double-adjustment quirk, reproduced for parity.
-    dmax = np.max(tdel_axis) if delmax is None else delmax
-    dmax = dmax * (ref_freq / freq) ** 2
-    dmax_norm = dmax * (ref_freq / freq) ** 2
-    ind = int(np.argmin(np.abs(tdel_axis - dmax)))
-    ind_norm = int(np.argmin(np.abs(tdel_axis - dmax_norm)))
+    # The row indices come from the shared rule so the driver's fused
+    # sspec crop (norm_sspec_row_window) resolves identically.
+    ind, ind_norm, dmax_raw = norm_sspec_row_window(
+        tdel_axis, freq, ref_freq=ref_freq, delmax=delmax)
+    dmax = dmax_raw * (ref_freq / freq) ** 2
     ymax = yaxis[ind] if lamsteps else dmax
     yc = yaxis[:ind]
     emax = etamax if etamax is not None else \

@@ -190,7 +190,8 @@ def _fit_scint_single_from_cuts(y_t, y_f, dt, df, alpha, steps):
 
 
 @functools.lru_cache(maxsize=None)
-def _fit_scint_from_dyn_jax(alpha, steps, cuts_method="fft"):
+def _fit_scint_from_dyn_jax(alpha, steps, cuts_method="fft",
+                            acf_lens="exact"):
     """Batched fit STRAIGHT from the dynspec batch: the 1-D cuts are
     computed with padded 1-D FFT reductions (ops.acf.acf_cuts_direct),
     never materialising the [B, 2nf, 2nt] 2-D ACF — the fast path of the
@@ -204,7 +205,7 @@ def _fit_scint_from_dyn_jax(alpha, steps, cuts_method="fft"):
     @jax.jit
     def impl(dyn_batch, dt, df):
         cut_t, cut_f = acf_cuts_direct(dyn_batch, backend="jax",
-                                       method=cuts_method)
+                                       method=cuts_method, lens=acf_lens)
         res = jax.vmap(
             lambda yt, yf, a, b: _fit_scint_single_from_cuts(
                 yt, yf, a, b, alpha, steps))(cut_t, cut_f, dt, df)
@@ -216,7 +217,8 @@ def _fit_scint_from_dyn_jax(alpha, steps, cuts_method="fft"):
 def fit_scint_params_from_dyn(dyn_batch, dt, df,
                               alpha: float | None = _ALPHA_KOLMOGOROV,
                               steps: int = 20,
-                              cuts_method: str = "fft") -> ScintParams:
+                              cuts_method: str = "fft",
+                              acf_lens: str = "exact") -> ScintParams:
     """tau/dnu fits for a [B, nf, nt] dynspec batch via direct ACF cuts
     (identical results to the 2-D-ACF route; much less FFT work).
     Like :func:`fit_scint_params_batch`, no trace-time ``lm_steps``
@@ -227,7 +229,7 @@ def fit_scint_params_from_dyn(dyn_batch, dt, df,
                           (dyn_batch.shape[0],))
     df = jnp.broadcast_to(jnp.asarray(df, dtype=jnp.result_type(float)),
                           (dyn_batch.shape[0],))
-    return _fit_scint_from_dyn_jax(alpha, steps, cuts_method)(
+    return _fit_scint_from_dyn_jax(alpha, steps, cuts_method, acf_lens)(
         dyn_batch, dt, df)
 
 

@@ -25,7 +25,7 @@ from .core import (Registry, counters, disable, enable,  # noqa: F401
                    enabled, flush, gauge, get_registry, inc,
                    render_summary, reset, span, summary, traced, tracing)
 from .jax_helpers import (bytes_of, fence,  # noqa: F401
-                          instrument_jit)
+                          instrument_jit, xla_cost_analysis)
 from .report import (aggregate, compile_split, load_events,  # noqa: F401
-                     render, report, serve_section)
+                     measured_roofline, render, report, serve_section)
 from .sinks import JsonlSink, LogSink  # noqa: F401

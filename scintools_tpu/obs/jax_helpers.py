@@ -12,7 +12,10 @@ first call bundles trace+compile with execution.  Two helpers fix both:
   signature records a ``<name>.compile`` span (``fn.lower().compile()``
   — trace+compile only, no execution) and every call records a
   ``<name>.execute`` span fenced on completion, plus a
-  ``jit_cache_miss`` counter per fresh signature.  Disabled tracing
+  ``jit_cache_miss`` counter per fresh signature and per-signature
+  ``step_flops[...]`` / ``step_bytes[...]`` gauges read from the
+  executable's own XLA cost analysis (the measured-roofline source
+  consumed by ``trace report`` and bench.py).  Disabled tracing
   short-circuits to the raw callable: identical dispatch path, identical
   results (the AOT executable and the jit cache compile the same
   program, asserted bit-identical by tests/test_obs.py).
@@ -58,6 +61,68 @@ def bytes_of(tree) -> int:
     return int(sum(getattr(x, "nbytes", 0) for x in leaves))
 
 
+def xla_cost_analysis(compiled) -> dict | None:
+    """{'flops': F, 'bytes_accessed': B} from an XLA executable's own
+    cost analysis, or None when the backend doesn't report one.
+
+    These are XLA's MEASURED per-execution counts for the exact compiled
+    program — the numbers the roofline accounting should trust over the
+    analytic model (utils/roofline.py), whose byte counts are a
+    deliberate lower bound.  Handles both cost_analysis() return shapes
+    (a dict on current jax, a one-element list of dicts on older)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    out = {}
+    flops = ca.get("flops")
+    if isinstance(flops, (int, float)) and flops > 0:
+        out["flops"] = float(flops)
+    byts = ca.get("bytes accessed")
+    if isinstance(byts, (int, float)) and byts > 0:
+        out["bytes_accessed"] = float(byts)
+    return out or None
+
+
+def _sig_label(key) -> str:
+    """Compact 'BxNFxNT:dtype' label of a call signature's first array
+    leaf — the per-signature key of the step_flops/step_bytes gauges."""
+    for item in key:
+        if (isinstance(item, tuple) and len(item) == 2
+                and isinstance(item[0], tuple)):
+            shape, dtype = item
+            return "x".join(str(int(s)) for s in shape) + f":{dtype}"
+    return "scalar"
+
+
+def _record_cost_analysis(name: str, key, compiled, memo: dict) -> None:
+    """Publish per-signature measured cost gauges: ``step_flops[<name>:
+    <shape>:<dtype>]`` / ``step_bytes[...]`` — one pair per compiled
+    signature, consumed by ``trace report``'s measured-roofline section
+    and by tests.  Gauges (not counters): the cost is a property of the
+    program, not an accumulating total.
+
+    ``memo`` caches the extracted costs per signature so the EXECUTE
+    path can re-emit them on every traced call: a trace enabled after
+    the (memoised, lru-cached) step was first compiled — the normal
+    warm-process case — must still carry the costs of the programs it
+    actually ran."""
+    costs = memo.get(key)
+    if costs is None:
+        costs = memo[key] = xla_cost_analysis(compiled) or {}
+    if not costs:
+        return
+    label = f"{name}:{_sig_label(key)}"
+    if "flops" in costs:
+        core.gauge(f"step_flops[{label}]", costs["flops"])
+    if "bytes_accessed" in costs:
+        core.gauge(f"step_bytes[{label}]", costs["bytes_accessed"])
+
+
 def _signature(args, kwargs):
     """Shape/dtype signature of a call — the jit-cache key proxy."""
     try:
@@ -96,6 +161,7 @@ def instrument_jit(fn, name: str, aot: bool = False):
         return cached
 
     compiled_cache: dict = {}
+    cost_memo: dict = {}
     compile_span = name + (".compile.warm" if aot else ".compile")
 
     def traced_call(*args, **kwargs):
@@ -119,6 +185,10 @@ def instrument_jit(fn, name: str, aot: bool = False):
                 else fn
             return compiled[1]
         try:
+            # re-emit the signature's measured cost gauges per traced
+            # call: tracing may have been enabled AFTER the warm step
+            # compiled (memoised steps outlive any one trace window)
+            _record_cost_analysis(name, key, compiled, cost_memo)
             with core.span(name + ".execute"):
                 out = compiled(*args, **kwargs)
                 jax.block_until_ready(out)
@@ -147,6 +217,9 @@ def instrument_jit(fn, name: str, aot: bool = False):
                                signature=str(key)[:200]):
                     executable = lower(*args, **kwargs).compile()
                 compiled_cache[key] = executable
+                # measured roofline source: XLA's own per-execution
+                # flop/byte counts for this exact signature
+                _record_cost_analysis(name, key, executable, cost_memo)
                 return executable
             except Exception:
                 pass

@@ -82,6 +82,54 @@ def compile_split(spans: dict, counters: dict | None = None) -> dict | None:
     }
 
 
+def measured_roofline(gauges: dict | None) -> dict | None:
+    """Per-signature MEASURED step costs from the ``step_flops[...]`` /
+    ``step_bytes[...]`` gauges (XLA cost analysis, recorded by
+    ``obs.instrument_jit`` at compile time), each compared against the
+    analytic model (utils/roofline.py) evaluated at the signature's
+    parsed [B, nf, nt] shape with the default pipeline config.
+
+    Returns ``{label: {flops, bytes, ai, model_flops?, model_bytes?,
+    flops_vs_model?, bytes_vs_model?}}`` or None when the trace carries
+    no cost gauges.  The model column is a default-config estimate (the
+    trace does not record the PipelineConfig); bench.py's record
+    computes the same comparison with its exact config.
+    """
+    gauges = gauges or {}
+    rows: dict[str, dict] = {}
+    for name, value in gauges.items():
+        for prefix, field in (("step_flops[", "flops"),
+                              ("step_bytes[", "bytes")):
+            if name.startswith(prefix) and name.endswith("]"):
+                label = name[len(prefix):-1]
+                rows.setdefault(label, {})[field] = float(value)
+    if not rows:
+        return None
+    for label, row in rows.items():
+        if row.get("flops") and row.get("bytes"):
+            row["ai"] = round(row["flops"] / row["bytes"], 2)
+        # label format: "<span name>:<B>x<nf>x<nt>:<dtype>"
+        parts = label.split(":")
+        dims = parts[1].split("x") if len(parts) >= 2 else []
+        if len(dims) == 3 and all(d.isdigit() for d in dims):
+            try:
+                from ..utils.roofline import pipeline_epoch_model
+
+                b, nf, nt = (int(d) for d in dims)
+                m = pipeline_epoch_model(nf, nt)["total"]
+                row["model_flops"] = b * m["flops"]
+                row["model_bytes"] = b * m["bytes"]
+                if row.get("flops"):
+                    row["flops_vs_model"] = round(
+                        row["flops"] / row["model_flops"], 2)
+                if row.get("bytes"):
+                    row["bytes_vs_model"] = round(
+                        row["bytes"] / row["model_bytes"], 2)
+            except Exception:  # model must never sink the report
+                pass
+    return rows
+
+
 def serve_section(counters: dict | None,
                   gauges: dict | None = None) -> dict | None:
     """Resident-service readout (scintools_tpu.serve): job outcomes,
@@ -147,6 +195,22 @@ def render(spans: dict, counters: dict | None = None,
         lines.append(f"  compile_cache_hit = {split['compile_cache_hit']}, "
                      f"compile_cache_miss = {split['compile_cache_miss']}, "
                      f"jit_cache_miss = {split['jit_cache_miss']}")
+    meas = measured_roofline(gauges)
+    if meas:
+        lines.append("")
+        lines.append("measured roofline (XLA cost_analysis, per compiled "
+                     "signature; model = analytic default-config "
+                     "estimate):")
+        for label, row in meas.items():
+            gfl = row.get("flops", 0.0) / 1e9
+            gby = row.get("bytes", 0.0) / 1e9
+            part = (f"  {label}: {gfl:.3f} GFLOP, {gby:.3f} GB"
+                    + (f", AI={row['ai']}" if "ai" in row else ""))
+            if "flops_vs_model" in row or "bytes_vs_model" in row:
+                part += (f"  [vs model: flops x"
+                         f"{row.get('flops_vs_model', '?')}, bytes x"
+                         f"{row.get('bytes_vs_model', '?')}]")
+            lines.append(part)
     serve = serve_section(counters, gauges)
     if serve:
         lines.append("")

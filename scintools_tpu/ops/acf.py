@@ -20,8 +20,32 @@ from .. import obs
 from ..backend import resolve
 
 
-def acf(dyn, backend: str = "numpy", subtract_mean: bool = True):
-    """Autocovariance, output shape [..., 2*nf, 2*nt]."""
+def _acf_pad_lens(nf: int, nt: int, lens: str) -> tuple[int, int]:
+    """Padded Wiener–Khinchin FFT lengths.  ``"exact"`` is the
+    reference's [2nf, 2nt] (dynspec.py:1348; the parity path).
+    ``"fast"`` rounds each up to the next even 5-smooth composite —
+    the linear autocovariance has support < 2n per axis, so any >= 2n
+    zero-padding computes IDENTICAL values (the output is centre-cropped
+    back to [2nf, 2nt]); the longer-but-smooth plan is faster whenever
+    2n has a large prime factor."""
+    if lens == "exact":
+        return 2 * nf, 2 * nt
+    if lens == "fast":
+        from .sspec import next_fast_len
+
+        return next_fast_len(2 * nf), next_fast_len(2 * nt)
+    raise ValueError(f"acf lens must be 'exact' or 'fast', got {lens!r}")
+
+
+def acf(dyn, backend: str = "numpy", subtract_mean: bool = True,
+        lens: str = "exact"):
+    """Autocovariance, output shape [..., 2*nf, 2*nt].
+
+    ``lens="fast"`` (jax path) pads the internal FFT pair to 5-smooth
+    composite lengths instead of exactly [2nf, 2nt]; the zero-padded
+    linear autocovariance is unchanged (the extra bins are cropped), so
+    values agree to FFT rounding — the plan, not the math, changes.
+    """
     backend = resolve(backend)
     shape = np.shape(dyn)  # works for lists and device arrays alike
     if len(shape) < 2 or shape[-2] < 2 or shape[-1] < 2:
@@ -30,8 +54,9 @@ def acf(dyn, backend: str = "numpy", subtract_mean: bool = True):
     # time trace construction inside the enclosing .compile span
     with obs.span("ops.acf", backend=backend, shape=list(shape)):
         if backend == "numpy":
+            # numpy path = the reference parity path: always exact-2n
             return _acf_numpy(np.asarray(dyn), subtract_mean)
-        return obs.fence(_acf_jax()(dyn, subtract_mean))
+        return obs.fence(_acf_jax()(dyn, subtract_mean, lens))
 
 
 def _acf_numpy(arr: np.ndarray, subtract_mean: bool) -> np.ndarray:
@@ -66,19 +91,26 @@ def _acf_jax():
     import jax
     import jax.numpy as jnp
 
-    @functools.partial(jax.jit, static_argnums=(1,))
-    def impl(arr, subtract_mean):
+    @functools.partial(jax.jit, static_argnums=(1, 2))
+    def impl(arr, subtract_mean, lens):
         if subtract_mean:
             arr = _masked_mean_subtract(arr, jnp)
         nf, nt = arr.shape[-2], arr.shape[-1]
+        Lf, Lt = _acf_pad_lens(nf, nt, lens)
         # real input -> half-spectrum rfft2 (2x the work/memory of the
         # reference's complex fft2 pair, dynspec.py:1351-1356, saved); the
         # power spectrum of a real array is even, so irfft2 of the half
         # plane reconstructs the full autocovariance exactly
-        a = jnp.fft.rfft2(arr, s=(2 * nf, 2 * nt))
+        a = jnp.fft.rfft2(arr, s=(Lf, Lt))
         p = jnp.real(a) ** 2 + jnp.imag(a) ** 2
-        out = jnp.fft.irfft2(p, s=(2 * nf, 2 * nt))
-        return jnp.fft.fftshift(out, axes=(-2, -1))
+        out = jnp.fft.irfft2(p, s=(Lf, Lt))
+        out = jnp.fft.fftshift(out, axes=(-2, -1))
+        if (Lf, Lt) != (2 * nf, 2 * nt):
+            # centre crop back to the reference's [2nf, 2nt] window: the
+            # extra padded bins are zero lags beyond the linear support
+            r0, c0 = Lf // 2 - nf, Lt // 2 - nt
+            out = out[..., r0:r0 + 2 * nf, c0:c0 + 2 * nt]
+        return out
 
     return impl
 
@@ -88,19 +120,20 @@ def _acf_cuts_jax():
     import jax
     import jax.numpy as jnp
 
-    @functools.partial(jax.jit, static_argnums=(1,))
-    def impl(arr, subtract_mean):
+    @functools.partial(jax.jit, static_argnums=(1, 2))
+    def impl(arr, subtract_mean, lens):
         if subtract_mean:
             arr = _masked_mean_subtract(arr, jnp)
         nf, nt = arr.shape[-2], arr.shape[-1]
+        Lf, Lt = _acf_pad_lens(nf, nt, lens)
         # freq cut: sum over t of each column's padded 1-D autocovariance
-        F = jnp.fft.rfft(arr, n=2 * nf, axis=-2)
+        F = jnp.fft.rfft(arr, n=Lf, axis=-2)
         Sf = jnp.sum(jnp.real(F) ** 2 + jnp.imag(F) ** 2, axis=-1)
-        cut_f = jnp.fft.irfft(Sf, n=2 * nf, axis=-1)[..., :nf]
+        cut_f = jnp.fft.irfft(Sf, n=Lf, axis=-1)[..., :nf]
         # time cut: sum over f of each row's padded 1-D autocovariance
-        T = jnp.fft.rfft(arr, n=2 * nt, axis=-1)
+        T = jnp.fft.rfft(arr, n=Lt, axis=-1)
         St = jnp.sum(jnp.real(T) ** 2 + jnp.imag(T) ** 2, axis=-2)
-        cut_t = jnp.fft.irfft(St, n=2 * nt, axis=-1)[..., :nt]
+        cut_t = jnp.fft.irfft(St, n=Lt, axis=-1)[..., :nt]
         return cut_t, cut_f
 
     return impl
@@ -142,7 +175,7 @@ def _acf_cuts_matmul_jax():
 
 
 def acf_cuts_direct(dyn, backend: str = "jax", subtract_mean: bool = True,
-                    method: str = "fft"):
+                    method: str = "fft", lens: str = "exact"):
     """The central positive-lag 1-D cuts of the 2-D ACF, computed WITHOUT
     the 2-D transform.
 
@@ -163,7 +196,9 @@ def acf_cuts_direct(dyn, backend: str = "jax", subtract_mean: bool = True,
     FFT pipeline (HIGHEST precision; agrees with the FFT path to normal
     f32 contraction error).  ``method`` selects between the two jax
     routes only: the numpy backend always slices the cuts out of the
-    reference-exact 2-D ACF (same values either way).
+    reference-exact 2-D ACF (same values either way).  ``lens`` pads
+    the 1-D FFTs as :func:`acf` does ("fast" = 5-smooth composite
+    lengths; the positive-lag cut values are unchanged).
     """
     if method not in ("fft", "matmul"):
         raise ValueError(f"acf_cuts_direct: unknown method {method!r} "
@@ -175,4 +210,4 @@ def acf_cuts_direct(dyn, backend: str = "jax", subtract_mean: bool = True,
         return a[..., nf, nt:], a[..., nf:, nt]
     if method == "matmul":
         return _acf_cuts_matmul_jax()(dyn, subtract_mean)
-    return _acf_cuts_jax()(dyn, subtract_mean)
+    return _acf_cuts_jax()(dyn, subtract_mean, lens)

@@ -75,7 +75,7 @@ def refill(d: DynspecData, linear: bool = True,
            zeros: bool = True) -> DynspecData:
     """Replace NaN (and optionally zero) pixels by 2-D linear interpolation
     over valid pixels, residual NaNs by the mean (dynspec.py:1165-1187)."""
-    arr = np.array(d.dyn, dtype=np.float64)
+    arr = np.array(d.dyn, dtype=np.float64)  # host-f64: numpy parity path (reference zap)
     if zeros:
         arr[arr == 0] = np.nan
     mask = ~np.isfinite(arr)
@@ -154,7 +154,7 @@ def correct_band_array(arr, frequency: bool = True, time: bool = False,
     (dynspec.py:1189-1226).  Array-level so it also serves the
     lambda-resampled dynspec (the reference's ``lamsteps=True`` branch,
     dynspec.py:1195-1198)."""
-    dyn = np.array(arr, dtype=np.float64)
+    dyn = np.array(arr, dtype=np.float64)  # host-f64: numpy parity path (refill)
     dyn[np.isnan(dyn)] = 0
     if frequency:
         bandpass = np.mean(dyn, axis=1)
@@ -205,7 +205,7 @@ def zap(d: DynspecData, method: str = "median", sigma: float = 7,
     cleaner (scint_utils.py:19-56); here it is native: robust z-scores of
     per-channel median, spread (IQR) and linear time-trend, any of which
     beyond ``sigma`` flags the channel (NaN, to be repaired by refill)."""
-    dyn = np.array(d.dyn, dtype=np.float64)
+    dyn = np.array(d.dyn, dtype=np.float64)  # host-f64: numpy parity path (bandpass)
     if method == "median":
         dev = np.abs(dyn - np.median(dyn[~np.isnan(dyn)]))
         mdev = np.median(dev[~np.isnan(dev)])
@@ -214,7 +214,7 @@ def zap(d: DynspecData, method: str = "median", sigma: float = 7,
         dyn = medfilt(dyn, kernel_size=m)
     elif method == "channels":
         with np.errstate(invalid="ignore"):
-            t = np.arange(dyn.shape[1], dtype=np.float64)
+            t = np.arange(dyn.shape[1], dtype=np.float64)  # host-f64: numpy parity path (bandpass)
             t = (t - t.mean()) / max(t.std(), 1.0)
             med = np.nanmedian(dyn, axis=1)
             q75, q25 = (np.nanpercentile(dyn, 75, axis=1),
@@ -259,7 +259,7 @@ def zap(d: DynspecData, method: str = "median", sigma: float = 7,
     if obs.enabled() or log.isEnabledFor(logging.DEBUG):
         # telemetry only: the NaN scans and float64 view are not worth
         # paying on the per-epoch hot path when nobody is listening
-        before = np.asarray(d.dyn, dtype=np.float64)
+        before = np.asarray(d.dyn, dtype=np.float64)  # host-f64: host telemetry only
         n_zapped = max(int(np.isnan(dyn).sum())
                        - int(np.isnan(before).sum()), 0)
         obs.inc("zap_calls")

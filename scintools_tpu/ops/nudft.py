@@ -45,13 +45,13 @@ def _r_grid(ntime: int) -> tuple[float, float, int]:
 
 
 def _nudft_numpy(power, fscale, tsrc, r0, dr, nr, chunk_r: int = 32):
-    power = np.asarray(power, dtype=np.float64)
-    fscale = np.asarray(fscale, dtype=np.float64)
-    tsrc = np.asarray(tsrc, dtype=np.float64)
+    power = np.asarray(power, dtype=np.float64)  # host-f64: numpy oracle path
+    fscale = np.asarray(fscale, dtype=np.float64)  # host-f64: numpy oracle path
+    tsrc = np.asarray(tsrc, dtype=np.float64)  # host-f64: numpy oracle path
     ntime, nfreq = power.shape
     rvals = r0 + dr * np.arange(nr)
     tf = tsrc[:, None] * fscale[None, :]  # [nt, nf]
-    out = np.empty((nr, nfreq), dtype=np.complex128)
+    out = np.empty((nr, nfreq), dtype=np.complex128)  # host-f64: numpy oracle path
     for start in range(0, nr, chunk_r):
         rc = rvals[start:start + chunk_r]
         phase = 2j * np.pi * rc[:, None, None] * tf[None, :, :]
@@ -119,7 +119,7 @@ def nudft(power, fscale, tsrc=None, r0=None, dr=None, nr=None,
     """
     ntime = power.shape[0]
     if tsrc is None:
-        tsrc = np.arange(ntime, dtype=np.float64)
+        tsrc = np.arange(ntime, dtype=np.float64)  # host-f64: host grid precompute
     if r0 is None or dr is None or nr is None:
         g0, gd, gn = _r_grid(ntime)
         r0 = g0 if r0 is None else r0
@@ -155,7 +155,7 @@ def slow_ft(dyn, freqs, backend: str = "numpy", use_native: bool | None = None,
     """
     dyn = np.asarray(dyn) if resolve(backend) == "numpy" else dyn
     ntime, nfreq = dyn.shape
-    freqs = np.asarray(freqs, dtype=np.float64)
+    freqs = np.asarray(freqs, dtype=np.float64)  # host-f64: host grid precompute
     fscale = freqs / freqs[nfreq // 2]
     out = nudft(dyn, fscale, backend=backend, use_native=use_native)
     if resolve(backend) == "jax":
@@ -222,9 +222,9 @@ def slow_ft_power_sharded(dyn, freqs, mesh, axis: str = "data",
         from jax.experimental.shard_map import shard_map
 
     ntime, nfreq = dyn.shape
-    freqs = np.asarray(freqs, dtype=np.float64)
+    freqs = np.asarray(freqs, dtype=np.float64)  # host-f64: host grid precompute
     fscale = freqs / freqs[nfreq // 2]
-    tsrc = np.arange(ntime, dtype=np.float64)
+    tsrc = np.arange(ntime, dtype=np.float64)  # host-f64: host grid precompute
     r0, dr, nr = _r_grid(ntime)
     n = mesh.shape[axis]
     nr_pad = (-nr) % n
@@ -233,7 +233,14 @@ def slow_ft_power_sharded(dyn, freqs, mesh, axis: str = "data",
 
     def local_block(dyn_rep):
         idx = lax.axis_index(axis)
-        r0_local = r0 + dr * (idx * nr_local).astype(np.float64)
+        # the runtime's float dtype explicitly (f32 under the
+        # production x64-off runtime, f64 on x64-enabled hosts):
+        # requesting float64 unconditionally only triggered jax's
+        # truncation UserWarning under x64-off before being cast to f32
+        # anyway (the MULTICHIP_r05 tail incident; the suite now
+        # promotes that warning to an error)
+        r0_local = r0 + dr * (idx * nr_local).astype(
+            jnp.result_type(float))
         return _nudft_jax_reim(dyn_rep, fscale, tsrc, r0_local, dr, nr_local)
 
     dyn_rep = jax.device_put(jnp.asarray(dyn),

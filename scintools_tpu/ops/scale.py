@@ -51,8 +51,8 @@ def scale_lambda(d: DynspecData, backend: str = "numpy") -> tuple:
         f = interp1d(freqs, np.asarray(d.dyn), kind="cubic", axis=0)
         arout = f(feq)
     else:
-        arout = _cubic_interp_jax()(d.dyn, np.asarray(freqs, dtype=np.float64),
-                                    np.asarray(feq, dtype=np.float64))
+        arout = _cubic_interp_jax()(d.dyn, np.asarray(freqs, dtype=np.float64),  # host-f64: host axes
+                                    np.asarray(feq, dtype=np.float64))  # host-f64: host axes
     return arout[::-1], lam_eq[::-1], dlam
 
 
@@ -113,9 +113,9 @@ def natural_cubic_interp_numpy(y: np.ndarray, x: np.ndarray,
     the two agree to rounding).  Used where device execution must be
     avoided at build time (e.g. precomputing resampling weights while
     the accelerator is untouched/unreachable)."""
-    y = np.asarray(y, dtype=np.float64)
-    x = np.asarray(x, dtype=np.float64)
-    xq = np.asarray(xq, dtype=np.float64)
+    y = np.asarray(y, dtype=np.float64)  # host-f64: numpy parity path (spline solve)
+    x = np.asarray(x, dtype=np.float64)  # host-f64: numpy parity path (spline solve)
+    xq = np.asarray(xq, dtype=np.float64)  # host-f64: numpy parity path (spline solve)
     n = x.shape[0]
     h = np.diff(x)
     A = np.zeros((n, n))
@@ -144,7 +144,7 @@ def scale_trapezoid(d: DynspecData, window: str | None = "hanning",
     """Trapezoid time-rescaling (dynspec.py:1429-1476): mean-subtract,
     window, then per-row resample the time axis by a frequency-dependent
     maximum time, zero-padding the tail."""
-    dyn = np.array(d.dyn, dtype=np.float64)
+    dyn = np.array(d.dyn, dtype=np.float64)  # host-f64: numpy parity path
     dyn -= np.mean(dyn)
     if window is not None:
         dyn = apply_2d_window(dyn, window, window_frac, backend="numpy")

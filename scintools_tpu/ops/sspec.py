@@ -40,13 +40,61 @@ def next_pow2_fft_lens(nf: int, nt: int) -> tuple[int, int]:
     return nrfft, ncfft
 
 
-def sspec_axes(nf: int, nt: int, dt, df, dlam=None):
+def next_fast_len(n: int) -> int:
+    """Smallest EVEN 5-smooth composite (2^a * 3^b * 5^c, a >= 1) >= n.
+
+    XLA's FFT (like FFTW/pocketfft) runs mixed-radix 2/3/5 plans at
+    near-pow2 efficiency, so padding a 300-channel epoch (2n = 600) to
+    600 (2^3*3*5^2) instead of 1024 cuts the padded grid — and every FFT
+    pass and elementwise byte over it — by 41% (the transform-sizing lever of
+    GPU pulsar FFT work, arXiv:1711.10855, and FFTArray's length
+    engineering, arXiv:2508.03697).  Evenness is required downstream:
+    the spectrum keeps ``nrfft/2`` positive-delay rows and the Doppler
+    fftshift assumes a symmetric grid."""
+    if n <= 2:
+        return 2
+    best = 1
+    while best < n:  # next power of two: the fallback ceiling
+        best *= 2
+    p5 = 1
+    while p5 < best:
+        p35 = p5
+        while p35 < best:
+            # smallest even power-of-two multiple of p35 reaching n
+            m = p35 * 2
+            while m < n:
+                m *= 2
+            best = min(best, m)
+            p35 *= 3
+        p5 *= 5
+    return int(best)
+
+
+def fft_lens(nf: int, nt: int, mode: str = "pow2") -> tuple[int, int]:
+    """Padded secondary-spectrum FFT lengths for one [nf, nt] epoch.
+
+    ``mode="pow2"`` is the reference's next-pow2-doubled rule (the
+    parity path, bit-identical to dynspec.py:1277-1279); ``"fast"``
+    pads to the smallest even 5-smooth composite >= 2n per axis — never
+    longer than pow2, identical to it when n is a power of two, and up
+    to ~38% shorter per axis otherwise (different spectral sampling:
+    an opt-in performance knob, not a parity path)."""
+    if mode == "pow2":
+        return next_pow2_fft_lens(nf, nt)
+    if mode == "fast":
+        return next_fast_len(2 * nf), next_fast_len(2 * nt)
+    raise ValueError(f"fft_lens mode must be 'pow2' or 'fast', got "
+                     f"{mode!r}")
+
+
+def sspec_axes(nf: int, nt: int, dt, df, dlam=None, lens: str = "pow2"):
     """fdop (mHz), tdel (us), beta (1/m, when dlam given).
 
     Mirrors dynspec.py:1291-1299. ``dt``/``df``/``dlam`` may be traced
-    scalars under vmap; shapes depend only on static nf/nt.
+    scalars under vmap; shapes depend only on static nf/nt (and the
+    static ``lens`` padding mode, which must match the ``sspec`` call).
     """
-    nrfft, ncfft = next_pow2_fft_lens(nf, nt)
+    nrfft, ncfft = fft_lens(nf, nt, lens)
     td = np.arange(nrfft // 2)
     fd = np.arange(-ncfft // 2, ncfft // 2)
     fdop = fd * 1e3 / (ncfft * dt)
@@ -56,11 +104,20 @@ def sspec_axes(nf: int, nt: int, dt, df, dlam=None):
 
 
 def sspec(dyn, prewhite: bool = True, window: str | None = "blackman",
-          window_frac: float = 0.1, db: bool = True, backend: str = "numpy"):
+          window_frac: float = 0.1, db: bool = True, backend: str = "numpy",
+          lens: str = "pow2", crop_rows: int | None = None):
     """Secondary spectrum of ``dyn`` [..., nf, nt].
 
     Returns sec [..., nrfft/2, ncfft] in dB (positive delays only).
-    Use :func:`sspec_axes` for the fdop/tdel/beta axes.
+    Use :func:`sspec_axes` for the fdop/tdel/beta axes (same ``lens``).
+
+    ``lens`` selects the padded FFT lengths (:func:`fft_lens`):
+    ``"pow2"`` is the reference parity path, ``"fast"`` the 5-smooth
+    composite padding.  ``crop_rows`` (static) keeps only the first
+    ``crop_rows`` delay rows — the postdark/dB elementwise tail then
+    touches ONLY the consumed sub-region, so a consumer that reads a
+    delay window (the arc fitter's delmax crop) never round-trips the
+    full padded spectrum through HBM.
     """
     backend = resolve(backend)
     shape = np.shape(dyn)  # works for lists and device arrays alike
@@ -73,17 +130,19 @@ def sspec(dyn, prewhite: bool = True, window: str | None = "blackman",
     # TRACE construction and land inside that step's .compile span
     with obs.span("ops.sspec", backend=backend, shape=list(shape)):
         if backend == "numpy":
-            arr = np.asarray(dyn, dtype=np.float64)
+            arr = np.asarray(dyn, dtype=np.float64)  # host-f64: parity path
             if arr.ndim > 2:  # batched: per-epoch host loop (jax on device)
                 lead = arr.shape[:-2]
                 flat = arr.reshape((-1,) + arr.shape[-2:])
                 out = np.stack([_sspec_numpy(a, prewhite, window,
-                                             window_frac, db)
+                                             window_frac, db, lens,
+                                             crop_rows)
                                 for a in flat])
                 return out.reshape(lead + out.shape[-2:])
-            return _sspec_numpy(arr, prewhite, window, window_frac, db)
+            return _sspec_numpy(arr, prewhite, window, window_frac, db,
+                                lens, crop_rows)
         return obs.fence(_sspec_jax()(dyn, prewhite, window, window_frac,
-                                      db))
+                                      db, lens, crop_rows))
 
 
 def _postdark(nrfft: int, ncfft: int, xp=np):
@@ -107,12 +166,13 @@ def _postdark(nrfft: int, ncfft: int, xp=np):
     return pd
 
 
-def _sspec_numpy(dyn, prewhite, window, window_frac, db):
+def _sspec_numpy(dyn, prewhite, window, window_frac, db, lens="pow2",
+                 crop_rows=None):
     nf, nt = dyn.shape[-2], dyn.shape[-1]
     dyn = dyn - np.mean(dyn)
     if window is not None:
         dyn = apply_2d_window(dyn, window, window_frac, backend="numpy")
-    nrfft, ncfft = next_pow2_fft_lens(nf, nt)
+    nrfft, ncfft = fft_lens(nf, nt, lens)
     dyn = dyn - np.mean(dyn)
     if prewhite:
         simpw = convolve2d([[1, -1], [-1, 1]], dyn, mode="valid")
@@ -122,8 +182,11 @@ def _sspec_numpy(dyn, prewhite, window, window_frac, db):
     sec = np.real(simf * np.conj(simf))
     sec = np.fft.fftshift(sec)
     sec = sec[nrfft // 2:, :]
+    if crop_rows is not None:
+        sec = sec[:crop_rows, :]
     if prewhite:
-        sec = sec / _postdark(nrfft, ncfft)
+        pd = _postdark(nrfft, ncfft)
+        sec = sec / (pd if crop_rows is None else pd[:crop_rows])
     if db:
         # zero-power pad bins legitimately map to -inf dB (the reference
         # produces the same values, warning unsuppressed); downstream
@@ -138,13 +201,13 @@ def _sspec_jax():
     import jax
     import jax.numpy as jnp
 
-    @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
-    def impl(dyn, prewhite, window, window_frac, db):
+    @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
+    def impl(dyn, prewhite, window, window_frac, db, lens, crop_rows):
         nf, nt = dyn.shape[-2], dyn.shape[-1]
         dyn = dyn - jnp.mean(dyn, axis=(-2, -1), keepdims=True)
         if window is not None:
             dyn = apply_2d_window(dyn, window, window_frac, backend="jax")
-        nrfft, ncfft = next_pow2_fft_lens(nf, nt)
+        nrfft, ncfft = fft_lens(nf, nt, lens)
         dyn = dyn - jnp.mean(dyn, axis=(-2, -1), keepdims=True)
         if prewhite:
             # separable 2nd difference == convolve2d([[1,-1],[-1,1]], 'valid')
@@ -159,10 +222,20 @@ def _sspec_jax():
         # fftshift-then-crop output is u = r (delay axis unshifted), column
         # c is v = c - ncfft/2 (Doppler axis shifted).
         simf = jnp.fft.rfftn(simpw, s=(ncfft, nrfft), axes=(-1, -2))
+        if crop_rows is not None:
+            # static delay-window crop straight off the FFT output: the
+            # |.|^2 / fftshift / postdark / log10 passes below only ever
+            # touch the consumed rows, so the full padded spectrum is
+            # never written back to HBM (the driver computes crop_rows
+            # from the arc fitter's own delmax rule)
+            simf = simf[..., :crop_rows, :]
         sec = jnp.real(simf) ** 2 + jnp.imag(simf) ** 2
         sec = jnp.fft.fftshift(sec, axes=-1)[..., : nrfft // 2, :]
         if prewhite:
-            sec = sec / _postdark(nrfft, ncfft, xp=jnp).astype(sec.dtype)
+            pd = _postdark(nrfft, ncfft, xp=jnp).astype(sec.dtype)
+            if crop_rows is not None:
+                pd = pd[:crop_rows]
+            sec = sec / pd
         if db:
             sec = 10.0 * jnp.log10(sec)
         return sec

@@ -71,13 +71,13 @@ def pad_epoch(d: DynspecData, nchan: int, nsub: int,
     fill='zero' pads with 0 (matches the reference's time-concat gap fill,
     dynspec.py:76-84).
     """
-    dyn = np.asarray(d.dyn, dtype=np.float64)
+    dyn = np.asarray(d.dyn, dtype=np.float64)  # host-f64: host staging (pre-policy)
     nf, nt = dyn.shape
     if nf > nchan or nt > nsub:
         raise ValueError(f"epoch {dyn.shape} larger than pad target "
                          f"({nchan}, {nsub}); crop first")
     value = float(np.mean(dyn)) if fill == "mean" else 0.0
-    out = np.full((nchan, nsub), value, dtype=np.float64)
+    out = np.full((nchan, nsub), value, dtype=np.float64)  # host-f64: host staging (pre-policy)
     out[:nf, :nt] = dyn
     fmask = np.zeros(nchan, dtype=bool)
     fmask[:nf] = True

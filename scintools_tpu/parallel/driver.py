@@ -116,6 +116,31 @@ class PipelineConfig:
     ref_freq: float = 1400.0
     return_acf: bool = False
     return_sspec: bool = False
+    # I/O precision policy: "f32" (default — the staging/transfer dtype
+    # is unchanged, compute as today) or "bf16_io" — the dynspec batch
+    # is CONVERTED to bfloat16 host-side, transferred and held in HBM
+    # at 2 bytes/element (halving bytes_h2d and the step's first-stage
+    # reads), and upcast to float32 at the top of the compiled step so
+    # every FFT/matmul/accumulation still runs in f32.  Parity budget
+    # vs f32 on synthetic epochs is tier-1-tested
+    # (tests/test_precision.py) and documented in docs/performance.md.
+    precision: str = "f32"
+    # Padded FFT lengths for the secondary spectrum: "pow2" (the
+    # reference's next-pow2-doubled rule — the parity path) or "fast"
+    # (smallest even 5-smooth composite >= 2n per axis, never longer
+    # than pow2; changes the spectral sampling, so fdop/tdel grids and
+    # arc fits shift within their errors).  The 2-D ACF path pads
+    # "fast" to composite lengths too, centre-cropped back — those
+    # values are unchanged (ops/acf.py).
+    fft_lens: str = "pow2"
+    # Fuse the arc fitter's delay-window crop into the compiled step:
+    # the secondary spectrum's postdark/dB tail (and the step output)
+    # only materialise the rows the norm_sspec fitter consumes, so the
+    # full padded spectrum never round-trips HBM.  eta is bit-identical
+    # (the profile rows and eta grid are unchanged); etaerr's noise
+    # window shrinks to the cropped grid, so errors differ slightly —
+    # hence opt-in.  Requires fit_arc + norm_sspec and no return_sspec.
+    sspec_crop: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -161,7 +186,7 @@ def lambda_resample_matrix(freqs: np.ndarray) -> tuple[np.ndarray, np.ndarray, f
     from ..ops.scale import natural_cubic_interp_numpy
     from ..data import _C_M_S
 
-    freqs = np.asarray(freqs, dtype=np.float64)
+    freqs = np.asarray(freqs, dtype=np.float64)  # host-f64: host spline precompute
     lam_eq, dlam = lambda_grid(freqs)
     feq = _C_M_S / lam_eq / 1e6
     eye = np.eye(len(freqs))
@@ -227,6 +252,21 @@ def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
             f"PipelineConfig.arc_method: unknown method "
             f"{config.arc_method!r} (expected 'norm_sspec', 'gridmax' or "
             f"'thetatheta')")
+    if config.precision not in ("f32", "bf16_io"):
+        raise ValueError(
+            f"PipelineConfig.precision: unknown policy "
+            f"{config.precision!r} (expected 'f32' or 'bf16_io')")
+    if config.fft_lens not in ("pow2", "fast"):
+        raise ValueError(
+            f"PipelineConfig.fft_lens: unknown mode {config.fft_lens!r} "
+            f"(expected 'pow2' or 'fast')")
+    if config.sspec_crop and (not config.fit_arc or config.return_sspec
+                              or config.arc_method != "norm_sspec"):
+        raise ValueError(
+            "PipelineConfig.sspec_crop fuses the norm_sspec fitter's "
+            "delay-window crop into the step: it requires fit_arc=True "
+            "with arc_method='norm_sspec' and return_sspec=False (a "
+            "returned spectrum must be the full grid)")
     if config.arc_stack and (config.arc_method != "norm_sspec"
                              or not config.fit_arc
                              or config.arc_brackets is not None):
@@ -267,8 +307,8 @@ def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
                 f"arc_method='thetatheta' has no equivalent of "
                 f"{', '.join(ignored)} (norm_sspec/gridmax knobs); leave "
                 "them at their defaults")
-    freqs = np.ascontiguousarray(np.asarray(freqs, dtype=np.float64))
-    times = np.ascontiguousarray(np.asarray(times, dtype=np.float64))
+    freqs = np.ascontiguousarray(np.asarray(freqs, dtype=np.float64))  # host-f64: host axes (cache key)
+    times = np.ascontiguousarray(np.asarray(times, dtype=np.float64))  # host-f64: host axes (cache key)
     return _make_pipeline_cached(
         (freqs.tobytes(), freqs.shape), (times.tobytes(), times.shape),
         config, mesh, _resolve_chan_sharded(mesh, chan_sharded),
@@ -405,8 +445,8 @@ def _bucket_epochs(epochs) -> dict:
 
     buckets: dict[tuple, list[int]] = defaultdict(list)
     for i, d in enumerate(epochs):
-        f = np.asarray(d.freqs, dtype=np.float64)
-        t = np.asarray(d.times, dtype=np.float64)
+        f = np.asarray(d.freqs, dtype=np.float64)  # host-f64: host bucketing key
+        t = np.asarray(d.times, dtype=np.float64)  # host-f64: host bucketing key
         buckets[(f.shape, t.shape, f.tobytes(), t.tobytes())].append(i)
     return buckets
 
@@ -486,9 +526,35 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded,
         W_np, dlam = None, None
         nf_s = nchan
 
-    fdop, tdel, beta = sspec_axes(nf_s, nsub, dt, df, dlam=dlam)
-    fdop = np.asarray(fdop, dtype=np.float64)
-    tdel = np.asarray(tdel, dtype=np.float64)
+    fdop, tdel, beta = sspec_axes(nf_s, nsub, dt, df, dlam=dlam,
+                                  lens=config.fft_lens)
+    fdop = np.asarray(fdop, dtype=np.float64)    # host-f64: grid builder
+    tdel = np.asarray(tdel, dtype=np.float64)    # host-f64: grid builder
+    acf_lens = "fast" if config.fft_lens == "fast" else "exact"
+
+    # Fused arc-window crop (sspec_crop): the norm_sspec fitter consumes
+    # delay rows [0, max(ind, ind_norm)] only, so the sspec op can stop
+    # materialising (and postdark/dB-converting) anything beyond them.
+    # The fitter is rebuilt on the cropped axes with delmax PINNED to
+    # the pre-adjustment value, which the shared row-window rule
+    # guarantees resolves to the same indices — eta is bit-identical,
+    # only the noise-estimate window (rows R/2:) shrinks with the grid.
+    crop_rows = None
+    arc_delmax = config.arc_delmax
+    if config.sspec_crop:
+        from ..fit.arc_fit import norm_sspec_row_window
+
+        ind_c, ind_n, dmax_raw = norm_sspec_row_window(
+            tdel, fc, ref_freq=config.ref_freq, delmax=config.arc_delmax)
+        rows = min(len(tdel), max(ind_c, ind_n) + 1)
+        if rows < len(tdel):
+            crop_rows = rows
+            arc_delmax = dmax_raw
+    yaxis_fit = (beta if config.lamsteps else tdel)
+    tdel_fit = tdel
+    if crop_rows is not None:
+        yaxis_fit = np.asarray(yaxis_fit)[:crop_rows]
+        tdel_fit = tdel_fit[:crop_rows]
 
     def build_arc_fitter(batch_shape=None, itemsize: int = 4):
         # called at TRACE time (inside the first step call), so the
@@ -539,17 +605,24 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded,
         rc = _resolve_arc_scrunch(config, mesh, batch_shape,
                                   itemsize=itemsize)
         return make_arc_fitter(
-            fdop=fdop, yaxis=beta if config.lamsteps else tdel, tdel=tdel,
+            fdop=fdop, yaxis=yaxis_fit, tdel=tdel_fit,
             freq=fc, lamsteps=config.lamsteps, method=config.arc_method,
             numsteps=config.arc_numsteps,
             startbin=config.arc_startbin, cutmid=config.arc_cutmid,
-            nsmooth=config.arc_nsmooth, delmax=config.arc_delmax,
+            nsmooth=config.arc_nsmooth, delmax=arc_delmax,
             constraint=config.arc_constraint, ref_freq=config.ref_freq,
             asymm=config.arc_asymm, constraints=config.arc_brackets,
             scrunch_rows=rc, arc_tail=config.arc_tail)
 
     def step(dyn_batch):
         dyn_batch = jnp.asarray(dyn_batch)
+        if config.precision == "bf16_io":
+            # bf16 is the TRANSFER/RESIDENCY dtype only: upcast at the
+            # step's top so every FFT, matmul and accumulation below
+            # runs in f32 (XLA fuses the convert into the first
+            # consumers — the bf16 batch is read once, at half the
+            # f32 bytes)
+            dyn_batch = dyn_batch.astype(jnp.float32)
         out = {}
         scint = None
         scint2d = tilt = tilterr = None
@@ -566,7 +639,7 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded,
                 dyn_acf = jax.lax.with_sharding_constraint(
                     dyn_batch, NamedSharding(mesh, P(mesh_mod.DATA_AXIS)))
             if config.return_acf or config.fit_scint_2d:
-                acf_b = acf_op(dyn_acf, backend="jax")
+                acf_b = acf_op(dyn_acf, backend="jax", lens=acf_lens)
                 if config.fit_scint:
                     scint = fit_scint_params_batch(
                         acf_b, dt, df, nchan, nsub, alpha=config.alpha,
@@ -590,7 +663,8 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded,
                     steps=config.lm_steps,
                     cuts_method=_resolve_cuts(
                         config.scint_cuts, mesh, dyn_acf.shape,
-                        itemsize=dyn_acf.dtype.itemsize))
+                        itemsize=dyn_acf.dtype.itemsize),
+                    acf_lens=acf_lens)
         arc = None
         arc_stacked = None
         sec_b = None
@@ -601,7 +675,8 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded,
             sec_b = sspec_op(fft_in, prewhite=config.prewhite,
                              window=config.window,
                              window_frac=config.window_frac, db=True,
-                             backend="jax")
+                             backend="jax", lens=config.fft_lens,
+                             crop_rows=crop_rows)
             if config.fit_arc:
                 fitter = build_arc_fitter(tuple(dyn_batch.shape),
                                           dyn_batch.dtype.itemsize)
@@ -632,6 +707,39 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded,
         # non-addressable shards
         kw["out_shardings"] = mesh_mod.replicated(mesh)
     return jax.jit(step, in_shardings=in_shard, **kw)
+
+
+def stage_dtype(precision: str):
+    """The host staging/transfer dtype of a pipeline batch — the single
+    source of truth for run_pipeline's staging conversion and the
+    warmup planner's signature dtypes (compile_cache.plan_steps), which
+    must agree or a warmed artifact misses its key.
+
+    ``"f32"`` keeps the historical staging dtype (float64 host arrays;
+    jax canonicalises to f32 on transfer under the production x64-off
+    runtime — bit-identical to every prior round).  ``"bf16_io"``
+    converts host-side to bfloat16, so the H2D transfer and HBM
+    residency run at 2 bytes/element."""
+    if precision == "bf16_io":
+        import ml_dtypes  # jax's own dtype-extension dependency
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(np.float64)  # host-f64: canonicalised on transfer
+
+
+def transfer_nbytes(arr) -> int:
+    """Bytes this staged batch actually moves H2D: element count times
+    the CANONICALIZED itemsize.  The f32 policy stages float64
+    host-side but jax downcasts it to f32 on transfer under the
+    production x64-off runtime, so counting the staged ``arr.nbytes``
+    would double-report the real traffic — and make bf16_io's
+    documented "half an f32 transfer" read as a 4x counter drop.
+    bfloat16 canonicalises to itself, so the bf16_io count is exact
+    either way."""
+    import jax
+
+    return int(arr.size
+               * np.dtype(jax.dtypes.canonicalize_dtype(arr.dtype)).itemsize)
 
 
 def _resolve_donate(async_exec: bool, chunked: bool, mesh) -> bool:
@@ -788,13 +896,21 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
                         if config.arc_stack:
                             extra = np.full_like(extra, np.nan)
                         dyn = np.concatenate([dyn, extra], axis=0)
+                sdt = stage_dtype(config.precision)
+                if dyn.dtype != sdt:
+                    # precision policy conversion LAST (after every pad
+                    # manipulation, which runs in f64): under bf16_io
+                    # the transfer and HBM residency halve vs f32 — the
+                    # step upcasts to f32 at its top for compute
+                    dyn = dyn.astype(sdt)
                 donate = _resolve_donate(async_exec, c is not None, mesh)
                 step = make_pipeline(freqs_np, times_np, config,
                                      mesh=mesh, chan_sharded=chan_sharded,
                                      donate=donate)
-                stage_sp.set(batch_shape=list(dyn.shape))
+                stage_sp.set(batch_shape=list(dyn.shape),
+                             stage_dtype=str(dyn.dtype))
             obs.inc("epochs_processed", len(idx))
-            obs.inc("bytes_h2d", int(dyn.nbytes))
+            obs.inc("bytes_h2d", transfer_nbytes(dyn))
             # fixed-iteration LM budget actually dispatched for this
             # batch (host-side: trace-time counters inside the jit'd
             # step would undercount cached re-executions)

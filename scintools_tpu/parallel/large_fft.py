@@ -197,7 +197,7 @@ def sspec_host_tiled(dyn, prewhite: bool = True,
     """
     from ..ops.windows import apply_2d_window
 
-    dyn = np.asarray(dyn, dtype=np.float64)
+    dyn = np.asarray(dyn, dtype=np.float64)  # host-f64: host-tiled oracle
     nf, nt = dyn.shape
     nrfft, ncfft = next_pow2_fft_lens(nf, nt)
     d = dyn - dyn.mean()
@@ -210,7 +210,7 @@ def sspec_host_tiled(dyn, prewhite: bool = True,
         pw = d
 
     # FFT along time in row tiles into the single full buffer
-    buf = np.zeros((nrfft, ncfft), np.complex128)
+    buf = np.zeros((nrfft, ncfft), np.complex128)  # host-f64: host-tiled oracle
     for r0 in range(0, pw.shape[0], tile):
         blk = pw[r0:r0 + tile]
         buf[r0:r0 + blk.shape[0]] = np.fft.fft(blk, n=ncfft, axis=1)

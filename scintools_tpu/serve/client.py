@@ -60,12 +60,24 @@ class SurveyClient:
         failed: list[str] = []
         while pending and time.time() < deadline:
             still = []
+            # one queued-directory walk per tick answers "still queued"
+            # for the whole pending set; state_of (whose stamped-name
+            # fallback scans that directory per job) then only runs for
+            # jobs in transit between state dirs
+            queued = self.queue.queued_ids()
             for job_id in pending:
                 if job_id in self.queue.results:
                     done.append(job_id)
-                elif self.queue.state_of(job_id) == FAILED:
+                    continue
+                if job_id in queued:
+                    still.append(job_id)
+                    continue
+                # ONE state lookup per job per poll (state_of walks the
+                # queue directories; calling it twice doubled the cost)
+                state = self.queue.state_of(job_id)
+                if state == FAILED:
                     failed.append(job_id)
-                elif self.queue.state_of(job_id) == DONE:
+                elif state == DONE:
                     done.append(job_id)
                 else:
                     still.append(job_id)

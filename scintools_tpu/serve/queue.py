@@ -12,7 +12,12 @@ Layout (all JSON, one file per job, written tmp+``os.replace`` so a
 crash can never leave a torn record)::
 
     qdir/
-      queued/<job_id>.json    submitted, waiting for a worker
+      queued/<stamp>-<job_id>.json   submitted, waiting for a worker
+                              (<stamp> = 17-digit submit microseconds,
+                              so sorted listdir IS the FIFO claim order
+                              and a poll opens only ~batch_size head
+                              candidates; legacy <job_id>.json names
+                              are still read and drained)
       leased/<job_id>.json    claimed by a worker, lease expiry inside
       done/<job_id>.json      completed (result row in results/)
       failed/<job_id>.json    terminal: retries exhausted (poison input)
@@ -63,20 +68,41 @@ def _submit_stamp() -> float:
     """Strictly-increasing submit timestamps within one process, so
     FIFO claim order equals submit order even when ``time.time()``
     ties across a tight submit loop (claim's tiebreak would otherwise
-    fall back to hash order)."""
+    fall back to hash order).  The 2 µs step keeps the stamps distinct
+    after the queued-FILENAME encoding's microsecond truncation too
+    (float64 rounding at ~1.7e15 µs can eat up to half a microsecond,
+    never a whole one)."""
     global _LAST_STAMP
     t = time.time()
     if t <= _LAST_STAMP:
-        t = _LAST_STAMP + 1e-6
+        t = _LAST_STAMP + 2e-6
     _LAST_STAMP = t
     return t
+
+
+def validate_job_cfg(cfg: dict) -> None:
+    """Reject option dicts the worker would deterministically reject
+    (``make_pipeline`` raises on them), so a misconfigured submit fails
+    at the CLIENT instead of enqueueing a job that burns its whole
+    retry/backoff budget into ``failed/`` poison.  The ONE rule site:
+    ``JobQueue.submit`` calls it for the Python API and the CLI's
+    ``_validate_estimator_flags`` delegates to it for process/warmup/
+    submit (flag spellings map 1:1 onto the dict keys)."""
+    if (cfg.get("sspec_crop")
+            and (cfg.get("no_arc")
+                 or cfg.get("arc_method", "norm_sspec") != "norm_sspec")):
+        raise ValueError(
+            "sspec_crop (--sspec-crop) fuses the norm_sspec fitter's "
+            "delay-window crop into the compiled step: it requires arc "
+            "fitting with arc_method='norm_sspec' (drop no_arc)")
 
 
 def cfg_signature(cfg: dict) -> tuple:
     """Canonical hashable form of a job's processing options: sorted
     (key, value) pairs with lists normalised to tuples AND defaults
     dropped — ``None``, boolean ``False`` (every serve boolean option
-    defaults off) and the default ``arc_method`` — so a sparse dict
+    defaults off) and the string knobs' defaults (``arc_method``,
+    ``precision``, ``fft_lens``) — so a sparse dict
     (``{"lamsteps": True}``) and the CLI's fully-materialised option
     dict hash to the SAME job identity (the idempotent-submit
     contract), regardless of dict ordering or JSON round-trips."""
@@ -85,11 +111,13 @@ def cfg_signature(cfg: dict) -> tuple:
             return tuple(norm(x) for x in v)
         return v
 
+    _string_defaults = {"arc_method": "norm_sspec", "precision": "f32",
+                        "fft_lens": "pow2"}
     out = []
     for k, v in sorted((cfg or {}).items()):
         if v is None or v is False:
             continue
-        if k == "arc_method" and v == "norm_sspec":
+        if _string_defaults.get(k) == v:
             continue
         out.append((str(k), norm(v)))
     return tuple(out)
@@ -147,32 +175,145 @@ class JobQueue:
         self.results = ResultsStore(os.path.join(directory, "results"))
 
     # -- paths / low-level records -----------------------------------------
+    # Queued jobs are named "<17-digit-microsecond-stamp>-<job_id>.json"
+    # so a plain sorted listdir IS the FIFO claim order: claim() no
+    # longer opens every queued record per poll (the PR 3 O(depth)
+    # review finding), only the ~batch_size head candidates.  Leased/
+    # done/failed keep plain "<job_id>.json" names, and every read path
+    # still accepts legacy unstamped queued files (queues written by
+    # earlier versions keep draining).
+    _STAMP_DIGITS = 17  # microseconds since epoch; covers year ~5138
+
+    def _stamp_prefix(self, submitted_at: float) -> str:
+        return f"{int(max(submitted_at, 0.0) * 1e6):0{self._STAMP_DIGITS}d}"
+
+    @classmethod
+    def _split_queued_name(cls, fname: str) -> tuple[float | None, str]:
+        """(submit stamp or None for legacy names, job_id)."""
+        stem = fname[:-5]  # drop ".json"
+        stamp, sep, jid = stem.partition("-")
+        if sep and jid and stamp.isdigit() \
+                and len(stamp) == cls._STAMP_DIGITS:
+            return int(stamp) / 1e6, jid
+        return None, stem
+
     def _path(self, state: str, job_id: str) -> str:
         return os.path.join(self.dir, state, f"{job_id}.json")
 
+    def _queued_path(self, job_id: str, submitted_at: float) -> str:
+        return os.path.join(self.dir, QUEUED,
+                            f"{self._stamp_prefix(submitted_at)}-"
+                            f"{job_id}.json")
+
+    def _find_queued_all(self, job_id: str) -> list[str]:
+        """EVERY queued file for ``job_id`` (stamped and/or legacy) —
+        normally one, but a crash inside ``_write``'s stamped-write →
+        legacy-unlink window (or a duplicate-submit race) can leave
+        more.  Read paths (``_read``/``state_of``) use this scan;
+        removal stays O(1) (``_remove_queued``) because any survivor
+        of a finished job is garbage-collected by ``claim``'s
+        terminal-state guard instead of re-executing.  One
+        directory-name scan, no file opens."""
+        d = os.path.join(self.dir, QUEUED)
+        suffix = f"-{job_id}.json"
+        out = []
+        plain = self._path(QUEUED, job_id)
+        if os.path.exists(plain):
+            out.append(plain)
+        try:
+            with os.scandir(d) as it:
+                for e in it:
+                    if e.name.endswith(suffix) and ".tmp" not in e.name:
+                        out.append(os.path.join(d, e.name))
+        except OSError:
+            pass
+        return out
+
+    def _find_queued(self, job_id: str) -> str | None:
+        """Existing queued file for ``job_id`` (stamped or legacy)."""
+        hits = self._find_queued_all(job_id)
+        return hits[0] if hits else None
+
     def _write(self, state: str, job: Job) -> None:
-        path = self._path(state, job.id)
+        path = (self._queued_path(job.id, job.submitted_at)
+                if state == QUEUED else self._path(state, job.id))
         tmp = f"{path}.tmp{os.getpid()}"
         with open(tmp, "w") as fh:
             json.dump(job.to_record(), fh)
         os.replace(tmp, path)
+        if state == QUEUED:
+            # a legacy unstamped duplicate must not survive a stamped
+            # rewrite (requeue of a legacy job after its claim consumed
+            # the old file is the normal path; this covers direct ones)
+            plain = self._path(QUEUED, job.id)
+            if plain != path and os.path.exists(plain):
+                self._remove_file(plain)
 
-    def _read(self, state: str, job_id: str) -> Job | None:
+    def _read_file(self, path: str) -> Job | None:
         try:
-            with open(self._path(state, job_id)) as fh:
+            with open(path) as fh:
                 return Job.from_record(json.load(fh))
         except (OSError, ValueError, TypeError):
             return None
 
+    def _read(self, state: str, job_id: str) -> Job | None:
+        if state == QUEUED:
+            path = self._find_queued(job_id)
+            return None if path is None else self._read_file(path)
+        return self._read_file(self._path(state, job_id))
+
     def _ids(self, state: str) -> list[str]:
         d = os.path.join(self.dir, state)
-        return sorted(os.path.splitext(f)[0] for f in os.listdir(d)
-                      if f.endswith(".json"))
+        names = [f for f in os.listdir(d)
+                 if f.endswith(".json") and ".tmp" not in f]
+        if state == QUEUED:
+            return sorted(self._split_queued_name(f)[1] for f in names)
+        return sorted(os.path.splitext(f)[0] for f in names)
+
+    def _queued_entries(self) -> list[tuple[float, str, str]]:
+        """Sorted ``(submit stamp, job_id, fname)`` for every queued
+        record — the single queued-dir walk shared by :meth:`claim`
+        (FIFO order) and :meth:`status` (oldest age).  Stamped names
+        sort without being opened; only legacy unstamped records pay a
+        read to learn their submit time."""
+        qdir = os.path.join(self.dir, QUEUED)
+        entries = []
+        for fname in os.listdir(qdir):
+            if not fname.endswith(".json") or ".tmp" in fname:
+                continue
+            stamp, jid = self._split_queued_name(fname)
+            if stamp is None:
+                job = self._read_file(os.path.join(qdir, fname))
+                if job is None:
+                    continue
+                stamp = job.submitted_at
+            entries.append((stamp, jid, fname))
+        entries.sort()
+        return entries
+
+    def queued_ids(self) -> set[str]:
+        """Every queued job id — ONE directory-name walk, no file
+        opens (stamped names carry the id; legacy names ARE the id).
+        The bulk-wait poll's fast path: membership here answers
+        "still queued" for a whole pending set at once, where per-job
+        ``state_of`` would pay its stamped-name fallback scan of this
+        same directory once PER job."""
+        return set(self._ids(QUEUED))
 
     def state_of(self, job_id: str) -> str | None:
-        for state in _STATES:
+        # O(1) probes first: the plain-named states (leased/done/failed
+        # + a legacy-named queued record) are single stat calls; only a
+        # job in none of them pays the queued-directory NAME scan for
+        # its stamped record (no file opens — a fresh bulk submit costs
+        # one listdir walk per submit, which is the cheap half of the
+        # old claim()'s open-every-record cost)
+        if os.path.exists(self._path(QUEUED, job_id)):
+            return QUEUED
+        for state in (LEASED, DONE, FAILED):
             if os.path.exists(self._path(state, job_id)):
                 return state
+        if self._find_queued(job_id) is not None:
+            return QUEUED
         return None
 
     def get(self, job_id: str) -> Job | None:
@@ -197,6 +338,7 @@ class JobQueue:
             raise FileNotFoundError(f"cannot submit {path!r}: no such "
                                     "file")
         cfg = dict(cfg or {})
+        validate_job_cfg(cfg)
         job_id = job_key(path, cfg)
         if job_id in self.results:
             return job_id, DONE
@@ -214,33 +356,49 @@ class JobQueue:
         backoff-eligible only).  The queued->leased ``os.rename`` is
         the race arbiter: a loser's rename raises and it simply moves
         on.  The winner immediately rewrites the leased record with
-        the lease stamp (worker id + expiry)."""
+        the lease stamp (worker id + expiry).
+
+        The submit stamp is encoded in the queued FILENAME, so the
+        sorted listdir itself is FIFO and only the head candidates are
+        opened — ~``n`` file reads per poll plus any skipped
+        (backoff/leased-dup) jobs ahead of them, instead of the whole
+        queue depth.  Legacy unstamped names (queues written before
+        this scheme) are still honoured: only those pay a read to
+        learn their submit time, and they merge into the same FIFO
+        order."""
         now = time.time() if now is None else now
+        qdir = os.path.join(self.dir, QUEUED)
         claimed: list[Job] = []
-        candidates = []
-        for job_id in self._ids(QUEUED):
-            job = self._read(QUEUED, job_id)
-            if job is None or job.not_before > now:
-                continue
-            # a queued duplicate of a still-leased job (crash window of
-            # a requeue) must not double-execute while the lease lives
-            if os.path.exists(self._path(LEASED, job_id)):
-                continue
-            candidates.append(job)
-        candidates.sort(key=lambda j: (j.submitted_at, j.id))
-        for job in candidates:
+        for stamp, jid, fname in self._queued_entries():
             if len(claimed) >= n:
                 break
+            # a queued duplicate of a still-leased job (crash window of
+            # a requeue) must not double-execute while the lease lives
+            if os.path.exists(self._path(LEASED, jid)):
+                continue
+            # a queued survivor of a TERMINAL job is garbage, not work:
+            # two racing submitters can each land a different-stamp
+            # file for one id, and complete()/fail() unlink only the
+            # stamp of the record they finished — the survivor is
+            # collected here (two O(1) stats per head candidate per
+            # poll) instead of re-executing a done or poison job
+            if os.path.exists(self._path(DONE, jid)) \
+                    or os.path.exists(self._path(FAILED, jid)):
+                self._remove_file(os.path.join(qdir, fname))
+                continue
+            job = self._read_file(os.path.join(qdir, fname))
+            if job is None or job.not_before > now:
+                continue
             try:
-                os.rename(self._path(QUEUED, job.id),
-                          self._path(LEASED, job.id))
+                os.rename(os.path.join(qdir, fname),
+                          self._path(LEASED, jid))
             except OSError:
                 continue  # another worker won this one
             # stamp the lease onto the record we actually renamed, not
             # the pre-rename read: another worker may have failed+
             # requeued this job in the read->rename window, and its
             # attempts/backoff must survive the claim
-            fresh = self._read(LEASED, job.id) or job
+            fresh = self._read(LEASED, jid) or job
             leased = dataclasses.replace(fresh, lease_worker=worker,
                                          lease_expires_at=now + lease_s)
             self._write(LEASED, leased)
@@ -300,11 +458,28 @@ class JobQueue:
         return min(self.backoff_s * (2.0 ** max(attempts - 1, 0)),
                    BACKOFF_CAP_S)
 
-    def _remove(self, state: str, job_id: str) -> None:
+    def _remove_file(self, path: str | None) -> None:
+        if path is None:
+            return
         try:
-            os.remove(self._path(state, job_id))
+            os.remove(path)
         except OSError:
             pass
+
+    def _remove(self, state: str, job_id: str) -> None:
+        self._remove_file(self._path(state, job_id))
+
+    def _remove_queued(self, job: Job) -> None:
+        """Drop ``job``'s queued record(s) in O(1): the stamped
+        filename is deterministic from the record (requeues never
+        mutate ``submitted_at``, and JSON round-trips the float
+        exactly), and the only other variant any version ever writes
+        is the legacy plain name — two unlink probes cover the
+        crash window between ``_write``'s stamped write and its
+        legacy unlink, with no directory scan (``complete``/``fail``
+        run this once per job in the worker's hot loop)."""
+        self._remove_file(self._queued_path(job.id, job.submitted_at))
+        self._remove_file(self._path(QUEUED, job.id))
 
     def complete(self, job: Job) -> None:
         """Finalise a job whose result row is stored.  Tolerates the
@@ -313,8 +488,9 @@ class JobQueue:
         (and drop any queued duplicate)."""
         self._write(DONE, dataclasses.replace(
             job, lease_worker=None, lease_expires_at=None, error=None))
-        for state in (LEASED, QUEUED, FAILED):
-            self._remove(state, job.id)
+        self._remove(LEASED, job.id)
+        self._remove_queued(job)
+        self._remove(FAILED, job.id)
 
     def fail(self, job: Job, error: str, retryable: bool = True,
              now: float | None = None) -> str:
@@ -330,8 +506,8 @@ class JobQueue:
         now = time.time() if now is None else now
         if job.id in self.results \
                 or os.path.exists(self._path(DONE, job.id)):
-            for s in (LEASED, QUEUED):
-                self._remove(s, job.id)
+            self._remove(LEASED, job.id)
+            self._remove_queued(job)
             return DONE
         attempts = job.attempts + 1
         rec = dataclasses.replace(job, attempts=attempts, error=error,
@@ -343,8 +519,9 @@ class JobQueue:
             self._write(QUEUED, dataclasses.replace(
                 rec, not_before=now + self._backoff(attempts)))
             state = QUEUED
-        for s in (LEASED,) + ((QUEUED,) if state == FAILED else ()):
-            self._remove(s, job.id)
+        self._remove(LEASED, job.id)
+        if state == FAILED:
+            self._remove_queued(job)
         return state
 
     # -- introspection / control -------------------------------------------
@@ -357,12 +534,10 @@ class JobQueue:
         st["results"] = len(self.results.keys())
         st["depth"] = st[QUEUED] + st[LEASED]
         st["drain_requested"] = self.drain_requested()
-        oldest = None
-        for job_id in self._ids(QUEUED):
-            job = self._read(QUEUED, job_id)
-            if job is not None:
-                age = now - job.submitted_at
-                oldest = age if oldest is None else max(oldest, age)
+        entries = self._queued_entries()
+        # submit ages straight from the filename stamps (shared walk
+        # with claim; only legacy records were opened)
+        oldest = (now - entries[0][0]) if entries else None
         st["oldest_queued_s"] = round(oldest, 3) if oldest is not None \
             else None
         return st

@@ -50,6 +50,16 @@ def config_from_opts(opts: dict):
     bracket = opts.get("arc_bracket")
     if bracket is not None:
         pkw["arc_constraint"] = (float(bracket[0]), float(bracket[1]))
+    # performance-policy knobs: absent keys keep the PipelineConfig
+    # defaults, so legacy job dicts build the identical config (and the
+    # identical job identity — cfg_signature drops nothing here because
+    # _estimator_opts only materialises non-default values)
+    if opts.get("precision") is not None:
+        pkw["precision"] = str(opts["precision"])
+    if opts.get("fft_lens") is not None:
+        pkw["fft_lens"] = str(opts["fft_lens"])
+    if opts.get("sspec_crop"):
+        pkw["sspec_crop"] = True
     # sizing knobs (client API; the CLI keeps the survey defaults)
     for k in ("arc_numsteps", "lm_steps"):
         if opts.get(k) is not None:

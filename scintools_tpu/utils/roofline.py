@@ -16,6 +16,14 @@ deliberately conservative:
   stage's compute dtype (f32 / complex64) — a LOWER bound on traffic
   (XLA fusion can only reduce, never increase, the modelled passes).
 
+When the caller can supply XLA's own per-execution cost analysis for
+the exact compiled step (``roofline_record(measured=...)`` — wired
+through ``obs.instrument_jit`` and bench.py), the MEASURED counts are
+preferred for every achieved/MFU/roofline figure and the analytic model
+becomes the sanity column (``measured_vs_model`` ratios): perf claims
+are then grounded in the program XLA actually built, not in a hand
+model of it.
+
 MFU here = achieved model-flops/s divided by the chip's published peak
 (bf16 systolic peak by default — the GENEROUS denominator, so the quoted
 MFU is conservative).  Peaks are resolved from ``jax.devices()[0]
@@ -51,12 +59,14 @@ def pipeline_epoch_model(nf: int, nt: int, *, lamsteps: bool = True,
                          numsteps: int = 2000, lm_steps: int = 20,
                          scint_cuts: str = "matmul",
                          fit_arc: bool = True,
-                         fit_scint: bool = True) -> dict:
+                         fit_scint: bool = True,
+                         fft_lens: str = "pow2") -> dict:
     """Per-epoch flop/byte counts for the bench pipeline configuration.
 
     Returns ``{stage: {"flops": F, "bytes": B}, ..., "total": {...}}``.
-    Stage models (one nf x nt epoch; padded FFT lengths nrfft/ncfft are
-    next-pow2*2 as in ops/sspec.py):
+    Stage models (one nf x nt epoch; padded FFT lengths nrfft/ncfft
+    follow ``fft_lens`` — "pow2" is ops/sspec.py's next-pow2*2 default,
+    "fast" the 5-smooth composite knob):
 
     lam    freq->lambda resample as the batched pipeline executes it
            (parallel.driver.lambda_resample_matrix): the natural-spline
@@ -75,7 +85,12 @@ def pipeline_epoch_model(nf: int, nt: int, *, lamsteps: bool = True,
            delay scrunch over R = nrfft/2 rows x numsteps bins (~8
            flops/sample); traffic dominated by the [R, numsteps] gather.
     """
-    nrfft, ncfft = _next_pow2_2x(nf), _next_pow2_2x(nt)
+    if fft_lens == "pow2":
+        nrfft, ncfft = _next_pow2_2x(nf), _next_pow2_2x(nt)
+    else:
+        from ..ops.sspec import fft_lens as _lens
+
+        nrfft, ncfft = _lens(nf, nt, fft_lens)
     out: dict[str, dict[str, float]] = {}
 
     if lamsteps:
@@ -201,10 +216,22 @@ def measure_host_peaks(matmul_n: int = 1024, copy_mb: int = 256,
 
 
 def roofline_record(rate_epochs_per_s: float, nf: int, nt: int,
-                    peaks: dict | None = None, **model_kw) -> dict:
+                    peaks: dict | None = None,
+                    measured: dict | None = None, **model_kw) -> dict:
     """Achieved GFLOP/s, GB/s, arithmetic intensity and %-of-peak for a
     measured pipeline rate.  ``peaks=None`` resolves the attached device;
     pass ``peaks={}`` to skip peak lookup (model-only record).
+
+    ``measured`` takes XLA's own per-EPOCH cost-analysis counts
+    (``{"flops": F, "bytes_accessed": B}`` — see
+    ``obs.xla_cost_analysis`` / bench.py) and, when given, is PREFERRED
+    over the analytic model for every achieved/MFU/roofline figure: the
+    model's byte count is a deliberate lower bound, so a model-based
+    roofline_pct overstates nothing but also cannot see padding or
+    fusion reality.  The record then carries both (``measured_*``
+    fields + ``measured_vs_model`` ratios) and names its source in
+    ``roofline_source``, so every future perf claim states what it was
+    computed from.
 
     With both peaks known the record also carries ``roofline_pct``: the
     achieved flop rate as a percentage of the roofline-implied ceiling
@@ -225,18 +252,40 @@ def roofline_record(rate_epochs_per_s: float, nf: int, nt: int,
         "per_stage_gflop": {k: round(v["flops"] / 1e9, 3)
                             for k, v in model.items() if k != "total"},
     }
+    # measured (cost_analysis) counts trump the model when available
+    f_eff, b_eff, source = f, b, "analytic model (lower-bound bytes)"
+    if measured:
+        mf = measured.get("flops")
+        mb = measured.get("bytes_accessed")
+        if mf and mb:
+            f_eff, b_eff = float(mf), float(mb)
+            source = "measured (XLA cost_analysis)"
+            rec["measured_gflop_per_epoch"] = round(f_eff / 1e9, 3)
+            rec["measured_gbytes_per_epoch"] = round(b_eff / 1e9, 3)
+            rec["achieved_gflops"] = round(
+                rate_epochs_per_s * f_eff / 1e9, 3)
+            rec["achieved_gbytes_s"] = round(
+                rate_epochs_per_s * b_eff / 1e9, 3)
+            rec["arithmetic_intensity_flop_per_byte"] = round(
+                f_eff / b_eff, 1)
+            rec["measured_vs_model"] = {"flops": round(f_eff / f, 2),
+                                        "bytes": round(b_eff / b, 2)}
+    rec["roofline_source"] = source
     peak_tf = peaks.get("peak_tflops")
     peak_gb = peaks.get("peak_gbs")
     if peak_tf:
-        rec["mfu_pct"] = round(100.0 * rate_epochs_per_s * f / (peak_tf * 1e12), 4)
+        rec["mfu_pct"] = round(
+            100.0 * rate_epochs_per_s * f_eff / (peak_tf * 1e12), 4)
     if peak_gb:
-        rec["hbm_pct"] = round(100.0 * rate_epochs_per_s * b / (peak_gb * 1e9), 4)
+        rec["hbm_pct"] = round(
+            100.0 * rate_epochs_per_s * b_eff / (peak_gb * 1e9), 4)
     if peak_tf and peak_gb:
-        ai = f / b
+        ai = f_eff / b_eff
         ceiling = min(peak_tf * 1e12, ai * peak_gb * 1e9)
         rec["roofline_pct"] = round(
-            100.0 * rate_epochs_per_s * f / ceiling, 2)
-        rec["roofline_bound"] = ("compute" if peak_tf * 1e12 <= ai * peak_gb * 1e9
+            100.0 * rate_epochs_per_s * f_eff / ceiling, 2)
+        rec["roofline_bound"] = ("compute"
+                                 if peak_tf * 1e12 <= ai * peak_gb * 1e9
                                  else "bandwidth")
     if peaks:
         rec["peaks"] = {k: peaks.get(k) for k in

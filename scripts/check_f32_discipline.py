@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Repo lint: no ``float64`` / ``complex128`` literals in the jax hot
+paths (``scintools_tpu/ops/`` + ``scintools_tpu/parallel/``) without an
+explicit ``# host-f64`` annotation.
+
+The compiled pipeline is an f32 machine: under the production x64-off
+runtime a stray ``astype(np.float64)`` on a traced array either
+silently truncates to f32 behind a UserWarning (the MULTICHIP_r05
+incident in ops/nudft.py) or — with x64 enabled — doubles every byte of
+a bandwidth-bound step.  Host-side numpy code legitimately runs in f64
+(the reference parity paths, grid precomputation, oracle baselines):
+those sites carry a ``# host-f64`` marker on the same line, which both
+allowlists them here and documents WHY the wide dtype is correct.
+
+Token-based, not regex: docstrings and comments that merely mention the
+dtypes don't count; only a real NAME token does.  Enforced in tier-1
+via tests/test_f32_discipline.py.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import sys
+import tokenize
+
+WIDE = {"float64", "complex128"}
+MARKER = "host-f64"
+SUBTREES = ("ops", "parallel")
+
+
+def find_wide_literals(path: str) -> list:
+    """(line, text) of every unannotated wide-dtype NAME token."""
+    with open(path, "rb") as fh:
+        src = fh.read()
+    hits = []
+    try:
+        for tok in tokenize.tokenize(io.BytesIO(src).readline):
+            if (tok.type == tokenize.NAME and tok.string in WIDE
+                    and MARKER not in tok.line):
+                hits.append((tok.start[0], tok.line.strip()))
+    except tokenize.TokenError:  # pragma: no cover - unparseable file
+        hits.append((0, "TokenError: could not tokenize"))
+    return hits
+
+
+def check_tree(pkg_dir: str) -> list:
+    """All offending (path, line, text) under the jax-path subtrees."""
+    offenders = []
+    for sub in SUBTREES:
+        root_dir = os.path.join(pkg_dir, sub)
+        for root, _dirs, files in os.walk(root_dir):
+            for name in sorted(files):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(root, name)
+                for line, text in find_wide_literals(path):
+                    offenders.append((os.path.relpath(path, pkg_dir),
+                                      line, text))
+    return offenders
+
+
+def main() -> int:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = os.path.join(here, "scintools_tpu")
+    offenders = check_tree(pkg)
+    for path, line, text in offenders:
+        sys.stderr.write(f"{path}:{line}: wide dtype in a jax-path "
+                         f"module (annotate host-side parity code with "
+                         f"'# {MARKER}: <why>'): {text}\n")
+    if offenders:
+        sys.stderr.write(f"{len(offenders)} unannotated float64/"
+                         f"complex128 literal(s) in ops/ + parallel/\n")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,26 @@ import jax  # noqa: E402
 
 jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compilation cache for the test process itself
+# (repo-local, gitignored).  The tier-1 suite's wall time is dominated
+# by re-compiling the same few hundred jit programs every run on this
+# 1-core host; with the cache wired, a repeat run serves them from
+# disk.  min_compile_time drops to 0 so the suite's many sub-second
+# CPU compiles persist too (the library default of 1 s targets chip
+# compiles).  Numerics are unaffected — the cache returns the
+# identical executable — and tests that wire their own cache dir
+# (test_compile_cache's tmp dirs) still override it per-test.
+_cache_dir = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    ".jax_cache", "tests")
+try:  # the cache is an optimisation: never fail the suite over it
+    os.makedirs(_cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+except Exception:
+    pass
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -127,13 +127,15 @@ def test_lmfit_shim_bound_transforms_roundtrip():
     assert 0.0 <= res2.params["slope"].value < 1e-6
 
 
-def test_device_throughput_runs_on_cpu_tiny():
+def test_device_throughput_runs_on_cpu_tiny(monkeypatch):
     """The batched device path itself (used both for the chip run and
     the wedged-tunnel cpu-fallback subprocess) executes on the forced-
     CPU test backend and returns a positive rate plus the compile vs
     measure wall-time split."""
     from bench import device_throughput, make_epochs
 
+    # tiny CPU passes don't need the production minimum-wall window
+    monkeypatch.setenv("SCINT_BENCH_MIN_MEASURE_S", "0")
     dyn, freqs, times = make_epochs(32, 32, n_base=1, B=4, seed=3)
     res = device_throughput(dyn, freqs, times, chunk=4)
     assert res["rate"] > 0
@@ -165,6 +167,8 @@ def test_bench_emits_json_line_with_fallback(tmp_path):
     # test's own 900s subprocess budget even if the fallback fires
     env.update(SCINT_BENCH_B="4", SCINT_BENCH_NF="32",
                SCINT_BENCH_NT="32", SCINT_BENCH_CPU_EPOCHS="1",
+               # keep the fixed-wall measurement window OFF in tiny CI
+               SCINT_BENCH_MIN_MEASURE_S="0",
                SCINT_BENCH_CHUNK="4", SCINT_BENCH_DEVICE_TIMEOUT="300",
                SCINT_BENCH_FALLBACK_B="4",
                SCINT_BENCH_FALLBACK_TIMEOUT="300",
@@ -209,6 +213,8 @@ def test_bench_wedged_probe_takes_fallback_path(tmp_path):
     env = dict(os.environ)
     env.update(SCINT_BENCH_B="4", SCINT_BENCH_NF="32",
                SCINT_BENCH_NT="32", SCINT_BENCH_CPU_EPOCHS="1",
+               # keep the fixed-wall measurement window OFF in tiny CI
+               SCINT_BENCH_MIN_MEASURE_S="0",
                SCINT_BENCH_CHUNK="4",
                # timeout <= 0 short-circuits the probe to a failure
                # without launching anything: the DETERMINISTIC wedge
@@ -244,10 +250,15 @@ def test_bench_wedged_probe_takes_fallback_path(tmp_path):
     assert roof["peaks"]["source"].startswith("measured on this host"), roof
     assert roof["roofline_bound"] in ("compute", "bandwidth")
     assert 0 < roof["roofline_pct"] <= 120  # sane fraction of ceiling
-    # round-5 stabilisation: the fallback rate is the MEDIAN of 3 timed
-    # passes and the record carries a host fingerprint, so cross-round
-    # disagreements are diagnosable from the records alone
-    assert len(last["repeat_rates"]) == 3, last.get("repeat_rates")
+    # round-6 stabilisation: the fallback rate is the median of a
+    # FIXED-WALL measurement window (>= 3 passes AND >= the minimum
+    # measured seconds) reported as median + IQR, replacing the old
+    # spike-prone 3-sample list; the record still carries the host
+    # fingerprint so cross-round disagreements stay diagnosable
+    stats = last["rate_stats"]
+    assert stats["n"] >= 3 and stats["median"] > 0, stats
+    assert stats["q25"] <= stats["median"] <= stats["q75"], stats
+    assert stats["measure_wall_s"] > 0, stats
     assert last["host"]["nproc"] == os.cpu_count()
     assert last["host"]["fallback_B"] == 4
     assert last["host"]["cpu_threads_pinned"] >= 1
@@ -350,6 +361,8 @@ def test_bench_respects_device_lock(tmp_path):
         # cross-salvage each other's logs
         env.update(SCINT_BENCH_B="4", SCINT_BENCH_NF="48",
                    SCINT_BENCH_NT="32", SCINT_BENCH_CPU_EPOCHS="1",
+               # keep the fixed-wall measurement window OFF in tiny CI
+               SCINT_BENCH_MIN_MEASURE_S="0",
                    SCINT_BENCH_CHUNK="4", SCINT_BENCH_LOCK_WAIT="1",
                    SCINT_BENCH_LOCK_FILE=lock_file,
                    SCINT_BENCH_FALLBACK_B="4",
@@ -410,6 +423,8 @@ def test_bench_lock_busy_salvages_flight_record(tmp_path):
         env = dict(os.environ)
         env.update(SCINT_BENCH_B="4", SCINT_BENCH_NF="32",
                    SCINT_BENCH_NT="32", SCINT_BENCH_CPU_EPOCHS="1",
+               # keep the fixed-wall measurement window OFF in tiny CI
+               SCINT_BENCH_MIN_MEASURE_S="0",
                    SCINT_BENCH_CHUNK="4", SCINT_BENCH_LOCK_WAIT="1",
                    SCINT_BENCH_LOCK_FILE=lock_file,
                    SCINT_BENCH_FLIGHTS_DIR=str(flights),
@@ -469,6 +484,8 @@ def test_bench_wedged_probe_salvages_same_round_flight(tmp_path):
         env = dict(os.environ)
         env.update(SCINT_BENCH_B="4", SCINT_BENCH_NF="40",
                    SCINT_BENCH_NT="32", SCINT_BENCH_CPU_EPOCHS="1",
+               # keep the fixed-wall measurement window OFF in tiny CI
+               SCINT_BENCH_MIN_MEASURE_S="0",
                    SCINT_BENCH_CHUNK="4",
                    # timeout <= 0: deterministic wedge simulation
                    SCINT_BENCH_PROBE_TIMEOUT="0",

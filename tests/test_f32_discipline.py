@@ -1,0 +1,43 @@
+"""Tier-1 enforcement of scripts/check_f32_discipline.py: the jax hot
+paths (ops/ + parallel/) carry no unannotated float64/complex128
+literals — wide dtypes there are either a silent-truncation bug under
+the production x64-off runtime (the MULTICHIP_r05 nudft incident) or a
+2x tax on a bandwidth-bound step.  Host-side parity/numpy code opts
+out explicitly with a ``# host-f64: <why>`` marker."""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import check_f32_discipline  # noqa: E402
+
+
+def test_no_unannotated_wide_dtypes_in_jax_paths():
+    offenders = check_f32_discipline.check_tree(
+        os.path.join(REPO, "scintools_tpu"))
+    assert offenders == [], (
+        "float64/complex128 literal(s) in scintools_tpu/ops/ or "
+        "parallel/ without a '# host-f64:' annotation:\n"
+        + "\n".join(f"{p}:{ln}: {txt}" for p, ln, txt in offenders))
+
+
+def test_lint_detects_wide_literal(tmp_path):
+    pkg = tmp_path / "scintools_tpu"
+    (pkg / "ops").mkdir(parents=True)
+    (pkg / "parallel").mkdir()
+    bad = pkg / "ops" / "bad.py"
+    bad.write_text(
+        "import numpy as np\n"
+        "a = np.zeros(3, dtype=np.float64)\n"              # flagged
+        "b = np.zeros(3, dtype=np.complex128)  # host-f64: oracle\n"
+        '"""a docstring mentioning float64 is fine"""\n')
+    offenders = check_f32_discipline.check_tree(str(pkg))
+    assert len(offenders) == 1
+    path, line, text = offenders[0]
+    assert line == 2 and "float64" in text
+
+
+def test_lint_cli_exit_code():
+    assert check_f32_discipline.main() == 0

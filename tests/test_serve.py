@@ -144,6 +144,83 @@ def test_claim_lease_expiry_requeue_backoff_and_poison(tmp_path):
         assert job.attempts == 3 and "lease expired" in job.error
 
 
+def test_claim_opens_only_head_candidates(tmp_path, monkeypatch):
+    """The submit stamp lives in the queued FILENAME (PR 3's deferred
+    O(depth) finding): a poll's claim sorts the listdir — FIFO for free
+    — and opens only the candidates it actually leases, not the whole
+    queue."""
+    files = _write_epochs(tmp_path, GOOD_SEEDS)
+    q = JobQueue(str(tmp_path / "q"))
+    ids = [q.submit(f, dict(OPTS, tag=i))[0]
+           for i, f in enumerate(files)]
+    reads = []
+    real = JobQueue._read_file
+
+    def counting_read(self, path):
+        reads.append(path)
+        return real(self, path)
+
+    monkeypatch.setattr(JobQueue, "_read_file", counting_read)
+    claimed = q.claim("w", n=2, lease_s=5.0)
+    # FIFO: the two EARLIEST submissions win, purely from name order
+    assert [j.id for j in claimed] == ids[:2]
+    # 2 candidate reads + 2 post-rename re-reads; never the whole depth
+    queued_reads = [p for p in reads if os.sep + "queued" + os.sep in p]
+    assert len(queued_reads) == 2, queued_reads
+    # stamped names: sorted listdir is submit order
+    names = sorted(os.listdir(os.path.join(q.dir, "queued")))
+    stamps = [n.split("-")[0] for n in names]
+    assert all(s.isdigit() and len(s) == 17 for s in stamps)
+
+
+def test_claim_drains_legacy_unstamped_jobs_fifo(tmp_path):
+    """Queues written before the stamped-name scheme keep draining: a
+    plain <job_id>.json record is read for its submit time and merges
+    into the same FIFO order."""
+    files = _write_epochs(tmp_path, GOOD_SEEDS[:3])
+    q = JobQueue(str(tmp_path / "q"))
+    jid_new, _ = q.submit(files[0], OPTS)
+    # hand-plant a LEGACY-named job that was submitted EARLIER
+    legacy = Job(id="legacyjob01", file=files[1], cfg=dict(OPTS),
+                 submitted_at=1.0)
+    with open(os.path.join(q.dir, "queued", "legacyjob01.json"),
+              "w") as fh:
+        json.dump(legacy.to_record(), fh)
+    assert q.state_of("legacyjob01") == "queued"
+    assert q.get("legacyjob01").file == files[1]
+    claimed = q.claim("w", n=2, lease_s=5.0)
+    assert [j.id for j in claimed] == ["legacyjob01", jid_new]
+    # a requeue of the legacy job comes back STAMPED, original order kept
+    q.fail(claimed[0], "transient")
+    (fname,) = [n for n in os.listdir(os.path.join(q.dir, "queued"))
+                if "legacyjob01" in n]
+    assert fname.endswith("-legacyjob01.json")
+
+
+def test_claim_collects_terminal_duplicate_submit_survivor(tmp_path):
+    """Two racing submitters can land DIFFERENT-stamp queued files for
+    one job id (both passed the dedup check before either write).
+    complete() unlinks only the stamp of the record it finished — the
+    survivor must be garbage-collected by claim's terminal-state
+    guard, never re-executed."""
+    files = _write_epochs(tmp_path, GOOD_SEEDS[:1])
+    q = JobQueue(str(tmp_path / "q"))
+    jid, _ = q.submit(files[0], OPTS)
+    # the racing submitter's copy: same id, a different submit stamp
+    dup = Job(id=jid, file=files[0], cfg=dict(OPTS), submitted_at=2.0)
+    with open(q._queued_path(jid, 2.0), "w") as fh:
+        json.dump(dup.to_record(), fh)
+    assert len(q._find_queued_all(jid)) == 2
+    (job,) = q.claim("w", n=1, lease_s=5.0)
+    q.results.put(job.id, {"name": "x", "tau": 1.0})
+    q.complete(job)
+    # the survivor is still on disk, but the next poll collects it
+    # instead of leasing it
+    assert q.claim("w", n=4, lease_s=5.0) == []
+    assert q.counts() == {"queued": 0, "leased": 0, "done": 1,
+                          "failed": 0}
+
+
 def test_fail_and_complete_tolerate_requeued_copies(tmp_path):
     files = _write_epochs(tmp_path, GOOD_SEEDS[:1])
     q = JobQueue(str(tmp_path / "q"), max_retries=1, backoff_s=0.0)
@@ -191,7 +268,9 @@ def test_claim_preserves_concurrent_requeue_attempts(tmp_path,
     def racy_rename(src, dst):
         # worker B's fail()->requeue slips in between A's candidate
         # read and A's rename: the queued record now carries attempts=2
-        if os.path.basename(src) == f"{jid}.json" and "queued" in src:
+        # (queued names carry the submit-stamp prefix, hence endswith)
+        if os.path.basename(src).endswith(f"-{jid}.json") \
+                and "queued" in src:
             with open(src) as fh:
                 rec = json.load(fh)
             rec.update(attempts=2, error="B failed it twice")
@@ -577,6 +656,26 @@ def test_cli_submit_status_drain_roundtrip(tmp_path, capsys):
     assert cli_main(["submit", qdir, "--lamsteps", *files]) == 0
     rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rec["submitted"] == 0 and rec["deduped"] == 2
+
+    # a config the pipeline would reject fails fast at submit instead
+    # of enqueueing a deterministically-poisoned job
+    before = JobQueue(qdir).queued_ids()
+    with pytest.raises(SystemExit, match="sspec-crop"):
+        cli_main(["submit", qdir, "--sspec-crop", "--no-arc", *files])
+    with pytest.raises(SystemExit, match="sspec-crop"):
+        cli_main(["submit", qdir, "--sspec-crop",
+                  "--arc-method", "gridmax", *files])
+    assert JobQueue(qdir).queued_ids() == before
+    # ... and the Python-API path (SurveyClient/JobQueue.submit, which
+    # never passes through argparse) enforces the same rule
+    with pytest.raises(ValueError, match="sspec_crop"):
+        JobQueue(qdir).submit(files[0], {"sspec_crop": True,
+                                         "no_arc": True})
+    with pytest.raises(ValueError, match="sspec_crop"):
+        JobQueue(qdir).submit(files[0], {"sspec_crop": True,
+                                         "arc_method": "gridmax"})
+    assert JobQueue(qdir).queued_ids() == before
+    capsys.readouterr()
 
     # an unmatched glob / typo'd path is reported missing with rc 1,
     # never enqueued as its literal spelling
