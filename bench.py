@@ -656,6 +656,17 @@ def device_throughput(dyn, freqs, times, chunk: int,
 
 def main():
     _maybe_enable_trace()
+    if not os.environ.get("SCINT_BENCH_TRACE"):
+        # sink-less in-process registry: counters still accumulate so
+        # the flight record's resilience totals (oom_backoff /
+        # epochs_quarantined) are real even without a trace file — a
+        # backoff that degraded the measured chunk size must never
+        # record as a clean zero.  main() only (bench runs in its own
+        # process); library/test imports of bench helpers never flip
+        # the global obs state.
+        from scintools_tpu import obs as _obs
+
+        _obs.enable()
     B = _env_int("SCINT_BENCH_B", DEFAULT_SHAPE[0])
     nf = _env_int("SCINT_BENCH_NF", DEFAULT_SHAPE[1])
     nt = _env_int("SCINT_BENCH_NT", DEFAULT_SHAPE[2])
@@ -692,6 +703,24 @@ def main():
                 rec[k] = res[k]
         if res.get("rate_stats"):
             rec["rate_stats"] = res["rate_stats"]
+        # resilience totals (ISSUE 5): the self-healing events this
+        # run's own pipeline work triggered.  A healthy flight records
+        # zeros; a round that suddenly shows oom_backoff > 0 degraded
+        # its chunk size to finish (throughput comparisons must know),
+        # and epochs_quarantined > 0 means inputs were rejected by
+        # preflight — resilience regressions show in the perf
+        # trajectory alongside the rates.
+        try:
+            from scintools_tpu import obs as _obs
+
+            _c = _obs.counters()
+            rec["resilience"] = {
+                "oom_backoff": int(_c.get("oom_backoff", 0)),
+                "epochs_quarantined": int(
+                    _c.get("epochs_quarantined", 0)),
+            }
+        except Exception as e:  # accounting must never sink the record
+            rec["resilience"] = {"error": f"{type(e).__name__}: {e}"}
         # MFU/roofline accounting against the probed chip's published
         # peaks (device kind comes from the probe subprocess, so a wedged
         # main-process backend is never touched here)

@@ -275,17 +275,22 @@ def cmd_process(args) -> int:
 def _load_clean_epochs(args, files, log, timers=None):
     """Shared load+clean stage of the batched engine and ``warmup``:
     trim/refill (plus the --clean chain) host-side, quarantining
-    unreadable/degenerate files.  Returns (epochs, names, failed).
+    unreadable/degenerate files.  Returns (epochs, names, failed,
+    quarantined) — ``quarantined`` counts the preflight rejections
+    (scintools_tpu.health: structurally-bad epochs routed out with
+    machine-readable reason codes before they can NaN-poison a batch
+    lane; a subset of ``failed``, surfaced in the `done` summary).
 
     The single-epoch chain itself is ``serve.load_epoch`` — ONE
     implementation, so a served epoch enters the pipeline bit-identical
     to a direct run (the byte-equality contract of docs/serving.md)."""
     import contextlib
 
+    from .health import PreflightError
     from .serve import load_epoch
     from .utils import log_event
 
-    epochs, names, failed = [], [], 0
+    epochs, names, failed, quarantined = [], [], 0, 0
     stage = (timers.stage("load+clean") if timers is not None
              else contextlib.nullcontext())
     with stage:
@@ -294,11 +299,18 @@ def _load_clean_epochs(args, files, log, timers=None):
                 epochs.append(load_epoch(
                     fn, clean=getattr(args, "clean", False)))
                 names.append(fn)
+            except PreflightError as e:
+                # counters + epoch_quarantined event already emitted at
+                # the raise site (health.quarantine_check)
+                failed += 1
+                quarantined += 1
+                obs.inc("epochs_failed")
+                log_event(log, "epoch_failed", file=fn, error=repr(e))
             except Exception as e:
                 failed += 1
                 obs.inc("epochs_failed")
                 log_event(log, "epoch_failed", file=fn, error=repr(e))
-    return epochs, names, failed
+    return epochs, names, failed, quarantined
 
 
 def _estimator_opts(args) -> dict:
@@ -356,8 +368,8 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
     from .parallel import make_mesh, run_pipeline, survey_routes
     from .utils import content_key, log_event
 
-    epochs, names, failed = _load_clean_epochs(args, files, log,
-                                               timers=timers)
+    epochs, names, failed, quarantined = _load_clean_epochs(
+        args, files, log, timers=timers)
     processed = 0
     if epochs:
         pcfg = _pipeline_config_from_args(args)
@@ -510,7 +522,8 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
         store.export_csv(args.results,
                          full=getattr(args, "full_csv", False))
     print(timers.report(), file=sys.stderr)
-    log_event(log, "done", processed=processed, failed=failed)
+    log_event(log, "done", processed=processed, failed=failed,
+              quarantined=quarantined)
     return 0 if failed == 0 else 1
 
 
@@ -552,7 +565,7 @@ def cmd_warmup(args) -> int:
         print(json.dumps({"error": "compile cache disabled "
                           "(SCINT_COMPILE_CACHE=off); nothing to warm"}))
         return 1
-    epochs, _names, failed = _load_clean_epochs(args, files, log)
+    epochs, _names, failed, _quar = _load_clean_epochs(args, files, log)
     if not epochs:
         print(json.dumps({"error": "no usable template epochs",
                           "failed": failed}))
@@ -1433,9 +1446,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv: list[str] | None = None) -> int:
+    from . import faults
     from .backend import honor_platform_env
 
     honor_platform_env()
+    # arm any SCINT_FAULTS-requested chaos faults (no-op when unset):
+    # subprocess chaos drives inject through the environment
+    faults.install_env()
     args = build_parser().parse_args(argv)
     if args.trace:
         try:

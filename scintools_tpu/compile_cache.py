@@ -37,7 +37,7 @@ import os
 
 import numpy as np
 
-from . import obs
+from . import faults, obs
 
 ENV_VAR = "SCINT_COMPILE_CACHE"
 DEFAULT_DIR = "~/.cache/scintools_tpu/xla"
@@ -301,6 +301,9 @@ def load_step(key: str, count: bool = True):
             obs.inc("compile_cache_hit")
         return cached
     try:
+        # chaos site: a corrupt/unreadable artifact must degrade to the
+        # jit path (counted as a miss), never fail the survey
+        faults.check("compile_cache.load")
         import jax
         from jax import export
 

@@ -1,0 +1,339 @@
+"""Deterministic fault injection + the infra-error taxonomy (ISSUE 5).
+
+The north star is a resident service on shared TPU pods, where the
+dominant failures are *infrastructure* faults — an XLA
+``RESOURCE_EXHAUSTED`` on an oversized chunk, a preempted device, a
+prefetch thread dying mid-survey — not per-job data pathologies.  Those
+faults are recoverable by construction (production JAX stacks treat
+preemption as a scheduling event, not an error), but a recovery path
+that has never executed is a recovery path that does not work.  This
+module makes every such path *provable*: named injection sites threaded
+through the driver, scheduler, compile cache and serve loop raise
+deterministic faults on the exact call you ask for, so a tier-1 chaos
+test can demand "OOM on chunk 3" and assert the self-healing behaviour
+(driver backoff, serve requeue-without-budget-burn) byte-for-byte.
+
+Design constraints:
+
+1. **Zero overhead when empty.**  Every site calls :func:`check`, whose
+   disarmed cost is ONE dict lookup (``_SPECS.get(site)`` on an empty
+   dict); no env read, no lock, no allocation.  Tier-1 asserts the
+   default path is bit-identical with sites threaded.
+2. **Deterministic.**  A :class:`FaultSpec` fires on exactly the
+   ``at_call``-th invocation of its site (per-process counters), for
+   exactly ``times`` consecutive calls, then disarms.  No randomness —
+   chaos tests replay identically.
+3. **Config/env-driven.**  ``SCINT_FAULTS="driver.chunk_execute:oom@3"``
+   arms sites in a subprocess (comma-separated
+   ``site:kind[@at_call[xtimes]]`` specs, parsed once by
+   :func:`install_env`); tests arm them directly with :func:`inject` /
+   the :func:`injected` context manager.
+
+The module also owns the error TAXONOMY the serve loop classifies by:
+
+* :class:`TransientError` — infrastructure: succeeds on retry/another
+  worker (OOM, lease races, preemption, injected infra faults).  The
+  queue requeues these WITHOUT burning the bounded retry budget
+  (``JobQueue.fail(transient=True)``).
+* :class:`PoisonError` — deterministic: same input, same failure, every
+  time (bad config, corrupt file).  Goes straight to the existing
+  bounded-retry -> ``failed/`` poison path.
+
+:func:`classify_error` maps arbitrary exceptions onto the taxonomy
+(``"transient" | "poison" | "unknown"``); unknown keeps today's
+solo-retry semantics so classification can only *improve* behaviour.
+
+See docs/reliability.md for the fault model and the site catalog.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import threading
+
+from . import obs
+
+ENV_VAR = "SCINT_FAULTS"
+
+# ---------------------------------------------------------------------------
+# taxonomy
+# ---------------------------------------------------------------------------
+
+
+class TransientError(Exception):
+    """An infrastructure failure expected to succeed on retry (OOM,
+    preemption, lease race, injected infra fault).  The serve queue
+    requeues these without decrementing the bounded retry budget."""
+
+
+class PoisonError(Exception):
+    """A deterministic failure: the same input fails the same way every
+    time (bad config, corrupt epoch).  Takes the bounded-retry ->
+    ``failed/`` poison path."""
+
+
+class InjectedFault(TransientError):
+    """An armed :class:`FaultSpec` firing (transient by default: the
+    registry simulates infrastructure faults)."""
+
+
+class InjectedPoison(PoisonError):
+    """An armed ``kind="poison"`` fault firing (for proving the poison
+    path stays bounded under classification)."""
+
+
+# substrings of XLA runtime OOM surfaces (jaxlib raises XlaRuntimeError
+# with the gRPC status name embedded; allocator failures say
+# "out of memory").  Deliberately NO bare "OOM" token: a filename like
+# ZOOM_55.dynspec inside a FileNotFoundError must not read as device
+# memory exhaustion.
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    """Whether ``exc`` is a device memory exhaustion — an
+    ``XlaRuntimeError``/``RESOURCE_EXHAUSTED`` (or an injected
+    ``kind="oom"`` fault, which carries the same marker)."""
+    msg = str(exc)
+    return any(m in msg for m in _OOM_MARKERS)
+
+
+# substrings marking lease-race / preemption surfaces as transient
+_TRANSIENT_MARKERS = ("lease expired", "preempt", "UNAVAILABLE",
+                      "DEADLINE_EXCEEDED")
+
+
+def classify_error(exc: BaseException) -> str:
+    """``"transient"`` / ``"poison"`` / ``"unknown"``.
+
+    Transient: the taxonomy classes above, device OOM (the driver's
+    chunk backoff may still fail at the floor chunk — another worker
+    or a quieter pod succeeds), and lease-race/preemption markers.
+    Poison: :class:`PoisonError` and the constructor-validation errors
+    a deterministic bad config raises (``ValueError``/``TypeError``
+    from ``make_pipeline``/``config_from_opts``).  Everything else is
+    unknown and keeps the existing bounded solo-retry semantics.
+
+    Precedence: explicit taxonomy types first, then the deterministic
+    TYPES (``ValueError``/``TypeError``) — a validation error whose
+    message happens to contain an infra marker (a path, a quoted
+    config value) must still poison — and the message-substring infra
+    markers only for everything else."""
+    if isinstance(exc, TransientError):
+        return "transient"
+    if isinstance(exc, PoisonError):
+        return "poison"
+    if isinstance(exc, (ValueError, TypeError)):
+        return "poison"
+    if is_oom_error(exc):
+        return "transient"
+    if any(m in str(exc) for m in _TRANSIENT_MARKERS):
+        return "transient"
+    return "unknown"
+
+
+# ---------------------------------------------------------------------------
+# the registry
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One armed fault.
+
+    ``kind`` selects the raised exception: ``"oom"`` (an
+    OOM-marker-carrying :class:`InjectedFault`, so ``is_oom_error``
+    and the driver's backoff treat it exactly like a real XLA
+    RESOURCE_EXHAUSTED), ``"transient"`` (:class:`InjectedFault`),
+    ``"poison"`` (:class:`InjectedPoison`), ``"oserror"`` (an
+    :class:`OSError`, for rename/IO race sites whose handlers catch
+    exactly that), or ``"error"`` (a plain :class:`RuntimeError` —
+    lands in the *unknown* classification bucket).
+
+    ``at_call`` is 1-based: the fault fires on that invocation of its
+    site and for ``times`` consecutive calls after it, then disarms.
+
+    Unknown kinds are rejected at construction — a typo'd
+    ``SCINT_FAULTS`` spec (``oomx2``, ``posion``) must fail loudly,
+    never silently inject a differently-classified exception that
+    exercises the wrong recovery path.
+    """
+
+    KINDS = ("oom", "transient", "poison", "oserror", "error")
+
+    kind: str = "transient"
+    at_call: int = 1
+    times: int = 1
+    message: str = ""
+    calls: int = 0  # mutated by check(); per-process
+
+    def __post_init__(self):
+        if self.kind not in self.KINDS:
+            raise ValueError(
+                f"FaultSpec: unknown kind {self.kind!r} (expected one "
+                f"of {'/'.join(self.KINDS)})")
+        if self.at_call < 1 or self.times < 1:
+            raise ValueError(
+                f"FaultSpec: at_call/times must be >= 1, got "
+                f"{self.at_call}/{self.times}")
+
+    def build_exc(self, site: str) -> BaseException:
+        detail = self.message or f"injected {self.kind} at {site} " \
+                                 f"(call {self.calls})"
+        if self.kind == "oom":
+            return InjectedFault(f"RESOURCE_EXHAUSTED: {detail}")
+        if self.kind == "poison":
+            return InjectedPoison(detail)
+        if self.kind == "oserror":
+            return OSError(detail)
+        if self.kind == "error":
+            return RuntimeError(detail)
+        return InjectedFault(detail)
+
+
+# The closed catalog of injection sites threaded through the code
+# (docs/reliability.md has the table).  parse_env validates against it
+# so a typo'd SCINT_FAULTS site fails LOUDLY instead of arming a site
+# no code ever checks (the chaos drive would pass vacuously — clean
+# vs faulted identical because nothing fired).  The programmatic
+# inject() API stays unvalidated on purpose: tests exercise the
+# registry with synthetic sites.  Extend this tuple when threading a
+# new faults.check() site.
+KNOWN_SITES = ("driver.chunk_execute", "schedule.prefetch",
+               "compile_cache.load", "queue.claim_rename",
+               "worker.load", "worker.batch_execute")
+
+# site -> FaultSpec.  EMPTY in production: check()'s disarmed cost is
+# the one dict lookup the acceptance criteria demand.  Armed only by
+# inject()/install_env(); mutations guarded by _ARM_LOCK (check()'s
+# counter increment rides the GIL — chaos tests are single-arming).
+_SPECS: dict[str, FaultSpec] = {}
+_ARM_LOCK = threading.Lock()
+_ENV_INSTALLED = False
+
+
+def check(site: str) -> None:
+    """The injection hook.  Disarmed: one dict lookup.  Armed for
+    ``site``: counts the call and raises the spec's exception when the
+    window [at_call, at_call+times) is hit (``faults_injected``
+    counter, so a trace proves the fault actually fired).  A spec past
+    its window is REMOVED — the site truly disarms (``active()`` stops
+    reporting it, the dict-miss fast path is restored for a
+    long-running worker)."""
+    spec = _SPECS.get(site)
+    if spec is None:
+        return
+    spec.calls += 1
+    if spec.at_call <= spec.calls:
+        if spec.calls >= spec.at_call + spec.times:
+            clear(site)
+            return
+        if spec.calls == spec.at_call + spec.times - 1:
+            clear(site)  # last call of the window: fire AND disarm
+        obs.inc("faults_injected")
+        obs.inc(f"faults_injected[{site}]")
+        raise spec.build_exc(site)
+
+
+def inject(site: str, spec: FaultSpec) -> None:
+    """Arm ``spec`` at ``site`` (replacing any previous spec there)."""
+    with _ARM_LOCK:
+        _SPECS[site] = spec
+
+
+def clear(site: str | None = None) -> None:
+    """Disarm one site, or every site (``site=None``)."""
+    with _ARM_LOCK:
+        if site is None:
+            _SPECS.clear()
+        else:
+            _SPECS.pop(site, None)
+
+
+def active() -> dict[str, FaultSpec]:
+    """The currently-armed sites (a copy; for test introspection)."""
+    with _ARM_LOCK:
+        return dict(_SPECS)
+
+
+@contextlib.contextmanager
+def injected(site: str, spec: FaultSpec):
+    """Scoped arming for tests::
+
+        with faults.injected("driver.chunk_execute",
+                             faults.FaultSpec(kind="oom", at_call=3)):
+            run_pipeline(...)
+    """
+    inject(site, spec)
+    try:
+        yield spec
+    finally:
+        clear(site)
+
+
+def parse_env(value: str) -> dict[str, FaultSpec]:
+    """Parse ``SCINT_FAULTS``: comma-separated
+    ``site:kind[@at_call[xtimes]]`` specs, e.g.
+    ``driver.chunk_execute:oom@3`` or
+    ``worker.batch_execute:transient@1x2``.  Unparseable entries —
+    including unknown kinds (``oomx2``, a typo) — raise: a chaos
+    harness must fail loudly, not silently inject the wrong fault."""
+    out: dict[str, FaultSpec] = {}
+    for entry in value.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        site, sep, rest = entry.partition(":")
+        if not sep or not site:
+            raise ValueError(f"{ENV_VAR}: bad entry {entry!r} "
+                             "(want site:kind[@at_call[xtimes]])")
+        if site not in KNOWN_SITES:
+            raise ValueError(
+                f"{ENV_VAR}: unknown site {site!r} (known sites: "
+                f"{', '.join(KNOWN_SITES)}) — a typo'd site would arm "
+                "nothing and the chaos run would pass vacuously")
+        kind, at_call, times = rest, 1, 1
+        if "@" in rest:
+            kind, _, tail = rest.partition("@")
+            try:
+                if "x" in tail:
+                    a, _, t = tail.partition("x")
+                    at_call, times = int(a), int(t)
+                else:
+                    at_call = int(tail)
+            except ValueError:
+                raise ValueError(
+                    f"{ENV_VAR}: bad entry {entry!r} (non-integer "
+                    "at_call/times; want site:kind[@at_call[xtimes]])")
+        if not kind:
+            raise ValueError(f"{ENV_VAR}: bad entry {entry!r} "
+                             "(empty kind)")
+        try:
+            out[site] = FaultSpec(kind=kind, at_call=at_call,
+                                  times=times)
+        except ValueError as e:
+            raise ValueError(f"{ENV_VAR}: bad entry {entry!r}: {e}")
+    return out
+
+
+def install_env(force: bool = False) -> int:
+    """Arm the faults named by ``SCINT_FAULTS`` (idempotent per process
+    unless ``force``).  Returns the number of armed sites.  Called from
+    the CLI entrypoint so subprocess chaos drives work; a library user
+    embedding the driver calls it explicitly (or uses inject())."""
+    global _ENV_INSTALLED
+    if _ENV_INSTALLED and not force:
+        return len(_SPECS)
+    value = os.environ.get(ENV_VAR, "")
+    if not value.strip():
+        _ENV_INSTALLED = True
+        return 0
+    specs = parse_env(value)  # raises before the latch: a caller that
+    # catches, fixes os.environ, and retries must not find env arming
+    # permanently disabled by the failed first attempt
+    _ENV_INSTALLED = True
+    with _ARM_LOCK:
+        _SPECS.update(specs)
+    return len(specs)

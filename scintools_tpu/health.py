@@ -1,0 +1,119 @@
+"""Epoch preflight: cheap host-side health checks before batching.
+
+A batched survey step is an SPMD program: one pathological epoch does
+not fail alone, it NaN-poisons its lane mid-fit and burns a device
+step (and, under serve, a whole batch's retry round) discovering what
+a microsecond-scale host check could have said up front.  This module
+is that check — run inside the shared load chain (``serve.load_epoch``,
+which the batched CLI engine and the serve worker both use) on the RAW
+post-trim epoch, *before* ``refill`` can repair-by-interpolation what
+should be rejected and before the epoch enters a batch — routing bad
+epochs to a quarantine list with machine-readable reason codes instead
+of letting them fit.
+
+Reason codes (stable strings — they land in serve ``job.error`` fields
+and quarantine logs, so downstream tooling can bucket them):
+
+* ``nonfinite``        — more than ``max_nonfinite_frac`` of the dynspec
+                         is NaN/inf: ``refill`` would fabricate the
+                         majority of the epoch by interpolation.
+* ``all_zero``         — the dynspec is identically zero (dead receiver/
+                         zero-filled file): every downstream normalise
+                         divides by zero.
+* ``zero_band``        — more than ``max_zero_band_frac`` of frequency
+                         channels are entirely zero (dropped subband):
+                         legal per-channel, but this much dead band
+                         biases the whole-epoch fits.
+* ``axis_nonmonotonic``— freqs/times are not strictly monotonic (the
+                         resample/FFT grids assume ordered axes).
+* ``axis_shape``       — axis lengths disagree with the dynspec shape,
+                         or fewer than 2 channels/subints survive.
+
+The thresholds are deliberately loose: preflight exists to catch
+*structurally* bad epochs deterministically, not to second-guess RFI
+excision (``--clean`` owns that).  Counters: ``epochs_quarantined``
+plus per-reason ``epochs_quarantined[<reason>]`` (rendered by ``trace
+report``; docs/reliability.md documents the fault model).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import obs
+
+# quarantine when more than this fraction of samples is NaN/inf
+DEFAULT_MAX_NONFINITE_FRAC = 0.5
+# quarantine when more than this fraction of channels is entirely zero
+DEFAULT_MAX_ZERO_BAND_FRAC = 0.5
+
+
+def preflight_epoch(epoch, max_nonfinite_frac: float =
+                    DEFAULT_MAX_NONFINITE_FRAC,
+                    max_zero_band_frac: float =
+                    DEFAULT_MAX_ZERO_BAND_FRAC) -> list[str]:
+    """Reason codes for one epoch ([] = healthy).  Host-side numpy
+    only — never touches the device, costs microseconds per epoch."""
+    reasons: list[str] = []
+    dyn = np.asarray(epoch.dyn)
+    freqs = np.asarray(epoch.freqs)
+    times = np.asarray(epoch.times)
+    if (dyn.ndim != 2 or freqs.ndim != 1 or times.ndim != 1
+            or dyn.shape != (len(freqs), len(times))
+            or len(freqs) < 2 or len(times) < 2):
+        # shape pathologies make the remaining checks meaningless
+        return ["axis_shape"]
+    for ax in (freqs, times):
+        d = np.diff(ax)
+        if not (np.all(d > 0) or np.all(d < 0)):
+            reasons.append("axis_nonmonotonic")
+            break
+    finite = np.isfinite(dyn)
+    nonfinite_frac = 1.0 - finite.mean()
+    if nonfinite_frac > max_nonfinite_frac:
+        reasons.append("nonfinite")
+    vals = np.where(finite, dyn, 0.0)
+    if not np.any(vals):
+        reasons.append("all_zero")
+    else:
+        zero_band_frac = float(np.mean(~np.any(vals != 0.0, axis=1)))
+        if zero_band_frac > max_zero_band_frac:
+            reasons.append("zero_band")
+    return reasons
+
+
+class PreflightError(ValueError):
+    """An epoch rejected by preflight.  ``reasons`` carries the
+    machine-readable codes; ``str()`` is ``"preflight: a,b"`` — the
+    exact string serve writes into ``job.error`` fields, so queue
+    tooling can bucket quarantines without parsing prose.  A
+    ``ValueError``: deterministic for a given input, so
+    ``faults.classify_error`` routes it down the poison path, never
+    the budget-preserving transient one."""
+
+    def __init__(self, reasons):
+        self.reasons = list(reasons)
+        super().__init__("preflight: " + ",".join(self.reasons))
+
+
+def quarantine_check(epoch, name=None, log=None) -> None:
+    """Raise :class:`PreflightError` when ``epoch`` fails preflight —
+    the single gate ``serve.load_epoch`` runs on the RAW (post-trim,
+    pre-refill) epoch, where dead bands and NaN gaps are still visible
+    (``refill`` repairs them by interpolation, which is exactly the
+    silent fabrication preflight exists to refuse at scale).  Emits an
+    ``epoch_quarantined`` log event and the ``epochs_quarantined`` /
+    ``epochs_quarantined[<reason>]`` counters at the raise site, so
+    every caller of the shared load chain is counted once."""
+    from .utils.log import get_logger, log_event
+
+    reasons = preflight_epoch(epoch)
+    if not reasons:
+        return
+    obs.inc("epochs_quarantined")
+    for r in reasons:
+        obs.inc(f"epochs_quarantined[{r}]")
+    log_event(log or get_logger(), "epoch_quarantined",
+              file=name if name is not None else "?",
+              reasons=",".join(reasons))
+    raise PreflightError(reasons)

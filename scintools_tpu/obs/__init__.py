@@ -27,5 +27,6 @@ from .core import (Registry, counters, disable, enable,  # noqa: F401
 from .jax_helpers import (bytes_of, fence,  # noqa: F401
                           instrument_jit, xla_cost_analysis)
 from .report import (aggregate, compile_split, load_events,  # noqa: F401
-                     measured_roofline, render, report, serve_section)
+                     measured_roofline, reliability_section, render,
+                     report, serve_section)
 from .sinks import JsonlSink, LogSink  # noqa: F401

@@ -159,10 +159,41 @@ def serve_section(counters: dict | None,
     return out
 
 
+def reliability_section(counters: dict | None,
+                        gauges: dict | None = None) -> dict | None:
+    """Self-healing readout (docs/reliability.md): OOM chunk backoffs
+    (+ the surviving ``effective_chunk``), preflight quarantines broken
+    out by reason code, budget-preserving transient requeues, corrupt
+    store rows, and fired chaos injections.  None when the trace shows
+    no degradation at all — a healthy run's report stays unchanged."""
+    counters = counters or {}
+    gauges = gauges or {}
+    quarantined = {
+        name[len("epochs_quarantined["):-1]: int(v)
+        for name, v in counters.items()
+        if name.startswith("epochs_quarantined[") and name.endswith("]")}
+    out = {
+        "oom_backoff": int(counters.get("oom_backoff", 0)),
+        "epochs_quarantined": int(counters.get("epochs_quarantined", 0)),
+        "job_transient_retries": int(
+            counters.get("job_transient_retries", 0)),
+        "store_corrupt_rows": int(counters.get("store_corrupt_rows", 0)),
+        "faults_injected": int(counters.get("faults_injected", 0)),
+    }
+    if not any(out.values()):
+        return None
+    if quarantined:
+        out["quarantine_reasons"] = quarantined
+    if "effective_chunk" in gauges:
+        out["effective_chunk"] = gauges["effective_chunk"]
+    return out
+
+
 def render(spans: dict, counters: dict | None = None,
            gauges: dict | None = None) -> str:
     """Fixed-width per-stage table, longest-total first, then the
-    cold/warm compile split, then the serve section, then counters."""
+    cold/warm compile split, then the serve and reliability sections,
+    then counters."""
     lines = []
     if spans:
         w = max(len("stage"), max(len(n) for n in spans))
@@ -228,6 +259,23 @@ def render(spans: dict, counters: dict | None = None,
         if "queue_depth_last" in serve:
             lines.append(f"  queue_depth (last) = "
                          f"{serve['queue_depth_last']}")
+    rel = reliability_section(counters, gauges)
+    if rel:
+        lines.append("")
+        lines.append("reliability (self-healing events):")
+        lines.append(f"  oom_backoff = {rel['oom_backoff']}"
+                     + (f" (effective_chunk = {rel['effective_chunk']})"
+                        if "effective_chunk" in rel else ""))
+        quar = f"  epochs_quarantined = {rel['epochs_quarantined']}"
+        if rel.get("quarantine_reasons"):
+            quar += " (" + ", ".join(
+                f"{k}={v}" for k, v in
+                sorted(rel["quarantine_reasons"].items())) + ")"
+        lines.append(quar)
+        lines.append(f"  job_transient_retries = "
+                     f"{rel['job_transient_retries']}, "
+                     f"store_corrupt_rows = {rel['store_corrupt_rows']}, "
+                     f"faults_injected = {rel['faults_injected']}")
     if counters:
         lines.append("")
         lines.append("counters:")

@@ -49,6 +49,26 @@ def initialize_multihost(coordinator_address: str | None = None,
     if coordinator_address is None and num_processes in (None, 1):
         return False  # single host
     try:
+        # CPU multi-process computations need a cross-process
+        # collectives backend: on jaxlib 0.4.37 the default is 'none',
+        # so any computation over a cross-process global array fails
+        # with "Multiprocess computations aren't implemented on the
+        # CPU backend" — the root cause of the test_multihost failures
+        # the PR 3 port-retry deflake misattributed.  Wire gloo (the
+        # only built-in) unless the user already chose one; the option
+        # only affects the CPU client, so TPU pods are untouched.
+        # Must run before initialize() creates the backend.  The value
+        # is NOT exposed as a jax.config attribute on 0.4.37 —
+        # config._read is the only readout (verified: attribute access
+        # raises even after a successful update), hence the private
+        # call; a user-set 'mpi' survives untouched.
+        try:
+            cur = jax.config._read("jax_cpu_collectives_implementation")
+            if cur in (None, "", "none"):
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+        except Exception:  # fault-ok: option absent on this jax version
+            pass
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)

@@ -34,7 +34,7 @@ from typing import Any
 
 import numpy as np
 
-from .. import obs
+from .. import faults, obs
 from ..fit.arc_fit import make_arc_fitter
 from ..fit.scint_fit import fit_scint_params_batch
 from ..ops.acf import acf as acf_op
@@ -345,7 +345,7 @@ def _target_is_tpu(mesh) -> bool:
         d = devs[0]
         kind = str(getattr(d, "device_kind", "")).lower()
         return "tpu" in kind or d.platform in ("tpu", "axon")
-    except Exception:
+    except Exception:  # fault-ok: capability probe (no backend => not TPU)
         return False
 
 
@@ -934,6 +934,9 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
                                                     aot=True)
 
             def dispatch(x, _aot=aot, _step=step):
+                # chaos site: a deterministic RESOURCE_EXHAUSTED here
+                # drives the chunked path's OOM-adaptive backoff
+                faults.check("driver.chunk_execute")
                 fn = _aot.get(int(x.shape[0]))
                 if fn is None:
                     return _step(x)
@@ -947,23 +950,84 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
             if c is None:
                 res = dispatch(_as_global_batch(dyn, mesh, chan_sharded))
             else:
-                starts = list(range(0, B, c))
-
-                def stage_chunk(k, _dyn=dyn, _starts=starts, _c=c):
-                    i = _starts[k]
-                    # commit on the async path: H2D runs on the
-                    # prefetch thread, overlapped with device compute
-                    return _as_global_batch(_dyn[i:i + _c], mesh,
-                                            chan_sharded,
-                                            commit=async_exec)
-
-                parts = execute_chunks(dispatch, len(starts), stage_chunk,
-                                       async_exec=async_exec)
+                parts = _run_chunked_adaptive(
+                    dispatch, dyn, B, c, multiple, mesh, chan_sharded,
+                    async_exec, execute_chunks)
                 res = _concat_results(parts)
             with obs.span("pipeline.gather", epochs=len(idx)):
                 results.append((np.asarray(idx),
                                 _take_lanes(res, len(idx), B)))
     return results
+
+
+def _run_chunked_adaptive(dispatch, dyn, B: int, chunk: int,
+                          multiple: int, mesh, chan_sharded: bool,
+                          async_exec: bool, execute_chunks) -> list:
+    """The chunk loop with OOM-adaptive backoff: execute ``dyn`` in
+    ``chunk``-sized steps; on a device RESOURCE_EXHAUSTED (a real
+    ``XlaRuntimeError`` or an injected ``driver.chunk_execute`` fault)
+    HALVE the chunk size — floored at the mesh data-axis multiple —
+    and replay only the epochs whose chunks had not completed.  The
+    async prefetcher is already drained/joined by ``execute_chunks``
+    before the exception propagates, so each retry round starts a
+    fresh producer against the new chunk signature.
+
+    Self-healing is observable: each backoff increments ``oom_backoff``
+    and re-points the ``effective_chunk`` gauge at the surviving size,
+    so ``trace report`` shows the degradation (docs/reliability.md).
+    A survey that completes after backoff returns results identical to
+    the un-faulted run — chunk decomposition only partitions the batch
+    axis, and every per-epoch measurement is lane-independent (asserted
+    by tests/test_faults.py byte-for-byte on the exported CSV).
+
+    Errors surfacing at dispatch/compile time are caught per chunk (the
+    dominant oversized-chunk failure: XLA allocates device memory when
+    the executable loads); an OOM first surfacing at gather time aborts
+    the bucket as before — un-fenced async dispatch cannot attribute it
+    to a chunk.
+
+    Returns the per-chunk PipelineResult list in epoch order.
+    """
+    from ..utils.log import get_logger, log_event
+
+    parts: list = []
+    pos, c = 0, chunk
+    while pos < B:
+        starts = list(range(pos, B, c))
+
+        def stage_chunk(k, _dyn=dyn, _starts=starts, _c=c):
+            i = _starts[k]
+            # commit on the async path: H2D runs on the
+            # prefetch thread, overlapped with device compute
+            return _as_global_batch(_dyn[i:i + _c], mesh,
+                                    chan_sharded,
+                                    commit=async_exec)
+
+        done: list = []
+        try:
+            execute_chunks(dispatch, len(starts), stage_chunk,
+                           async_exec=async_exec, out=done)
+            parts.extend(done)
+            return parts
+        except Exception as e:
+            if not faults.is_oom_error(e):
+                raise
+            new_c = _adjust_chunk(multiple, max(c // 2, 1))
+            if new_c >= c:
+                # already at the mesh-multiple floor: genuinely too
+                # big for this device — nothing left to adapt
+                raise
+            # keep the completed prefix; replay from the first chunk
+            # that did not finish, at the halved size
+            parts.extend(done)
+            pos = starts[len(done)]
+            obs.inc("oom_backoff")
+            obs.gauge("effective_chunk", new_c)
+            log_event(get_logger(), "oom_backoff", chunk=c,
+                      new_chunk=new_c, resume_epoch=pos,
+                      remaining=B - pos, error=repr(e))
+            c = new_c
+    return parts
 
 
 def _take_lanes(res: PipelineResult, n: int, B: int) -> PipelineResult:

@@ -38,7 +38,7 @@ import queue
 import threading
 import time
 
-from .. import obs
+from .. import faults, obs
 
 # one staged chunk in the queue + one being staged by the producer
 DEFAULT_DEPTH = 2
@@ -54,7 +54,8 @@ class _StageError:
 
 
 def execute_chunks(step, n_chunks: int, stage, *, async_exec: bool = True,
-                   depth: int = DEFAULT_DEPTH) -> list:
+                   depth: int = DEFAULT_DEPTH, out: list | None = None
+                   ) -> list:
     """Run ``[step(stage(k)) for k in range(n_chunks)]`` with chunk
     staging overlapped against device execution.
 
@@ -63,9 +64,20 @@ def execute_chunks(step, n_chunks: int, stage, *, async_exec: bool = True,
     program (asynchronously — results are futures).  Results come back
     in chunk order.  ``async_exec=False`` (or a single chunk) runs the
     exact serial loop.
+
+    ``out`` (optional) receives each chunk's result AS IT COMPLETES, in
+    chunk order — on an exception the caller reads the completed prefix
+    there, which is how the driver's OOM-adaptive backoff
+    (driver._run_chunked_adaptive) replays only the unfinished chunks
+    at a smaller size instead of the whole bucket.  The producer thread
+    is always stopped and joined before the exception propagates, so a
+    retry starts against a fresh prefetcher.
     """
+    results = out if out is not None else []
     if not async_exec or n_chunks <= 1:
-        return [step(stage(k)) for k in range(n_chunks)]
+        for k in range(n_chunks):
+            results.append(step(stage(k)))
+        return results
 
     q: queue.Queue = queue.Queue(maxsize=max(int(depth) - 1, 1))
     stop = threading.Event()
@@ -76,8 +88,13 @@ def execute_chunks(step, n_chunks: int, stage, *, async_exec: bool = True,
                 return
             try:
                 with obs.span("pipeline.prefetch", chunk=k):
+                    # chaos site: a prefetch-thread death mid-survey
+                    # must surface in the caller (docs/reliability.md)
+                    faults.check("schedule.prefetch")
                     item = stage(k)
-            except BaseException as e:  # re-raised by the consumer
+            except BaseException as e:  # fault-ok: carried to the
+                #                         consumer as _StageError and
+                #                         re-raised there
                 item = _StageError(e)
             while not stop.is_set():
                 try:
@@ -91,7 +108,6 @@ def execute_chunks(step, n_chunks: int, stage, *, async_exec: bool = True,
     producer = threading.Thread(target=produce, name="scint-prefetch",
                                 daemon=True)
     producer.start()
-    results = []
     stall_s = 0.0
     try:
         for n in range(n_chunks):

@@ -51,6 +51,7 @@ import os
 import time
 from typing import Sequence
 
+from .. import faults
 from ..utils.store import ResultsStore, content_key
 
 # job states = subdirectories
@@ -60,6 +61,13 @@ _STATES = (QUEUED, LEASED, DONE, FAILED)
 DEFAULT_MAX_RETRIES = 3
 DEFAULT_BACKOFF_S = 1.0
 BACKOFF_CAP_S = 300.0
+# transient (budget-preserving) requeues per job before further
+# transient failures ESCALATE to the bounded attempts path, as a
+# multiple of max_retries: a misclassified deterministic error (or a
+# pool where the fault is effectively permanent) must eventually reach
+# failed/ instead of livelocking the queue — generous, because real
+# infra faults clear in one or two placements
+TRANSIENT_ESCALATION_FACTOR = 10
 
 _LAST_STAMP = 0.0
 
@@ -147,6 +155,11 @@ class Job:
     # members cannot re-coalesce into the same failing batch and burn
     # every healthy member's retry budget alongside the poison one
     solo: bool = False
+    # count of TRANSIENT requeues (infra faults: OOM, lease races,
+    # injected chaos — faults.classify_error): observability only, it
+    # never gates the bounded ``attempts`` poison budget, but it does
+    # drive the transient path's own exponential backoff
+    transients: int = 0
 
     def to_record(self) -> dict:
         return {k: v for k, v in dataclasses.asdict(self).items()
@@ -166,10 +179,15 @@ class JobQueue:
 
     def __init__(self, directory: str,
                  max_retries: int = DEFAULT_MAX_RETRIES,
-                 backoff_s: float = DEFAULT_BACKOFF_S):
+                 backoff_s: float = DEFAULT_BACKOFF_S,
+                 max_transients: int | None = None):
         self.dir = directory
         self.max_retries = int(max_retries)
         self.backoff_s = float(backoff_s)
+        self.max_transients = (int(max_transients)
+                               if max_transients is not None
+                               else TRANSIENT_ESCALATION_FACTOR
+                               * max(self.max_retries, 1))
         for sub in _STATES + ("control",):
             os.makedirs(os.path.join(directory, sub), exist_ok=True)
         self.results = ResultsStore(os.path.join(directory, "results"))
@@ -390,6 +408,9 @@ class JobQueue:
             if job is None or job.not_before > now:
                 continue
             try:
+                # chaos site (kind="oserror"): a lost claim race — the
+                # winner-take-one rename semantics must skip, not fail
+                faults.check("queue.claim_rename")
                 os.rename(os.path.join(qdir, fname),
                           self._path(LEASED, jid))
             except OSError:
@@ -493,10 +514,23 @@ class JobQueue:
         self._remove(FAILED, job.id)
 
     def fail(self, job: Job, error: str, retryable: bool = True,
-             now: float | None = None) -> str:
+             transient: bool = False, now: float | None = None) -> str:
         """Record a job failure: requeue with exponential backoff while
         retries remain (and the failure is retryable), else move to the
         terminal ``failed/`` state.  Returns the resulting state.
+
+        ``transient=True`` marks an INFRASTRUCTURE failure (device OOM,
+        lease race, preemption — faults.classify_error): the job
+        requeues with ``attempts`` UNCHANGED, so an unlucky placement
+        can never burn the bounded retry budget into ``failed/`` poison
+        for an error that succeeds on the next worker.  Transient
+        requeues count (and exponentially back off) through the
+        separate ``transients`` field.  They are bounded too — once a
+        job has taken ``max_transients`` budget-free requeues
+        (default 10x ``max_retries``), further transient failures
+        ESCALATE to the normal attempts-burning path, so a
+        misclassified deterministic error still terminates in
+        ``failed/`` instead of livelocking drain/wait forever.
 
         A job another worker already COMPLETED (the at-least-once race:
         this worker's lease expired mid-batch, the job was requeued and
@@ -509,6 +543,15 @@ class JobQueue:
             self._remove(LEASED, job.id)
             self._remove_queued(job)
             return DONE
+        if transient and retryable \
+                and job.transients < self.max_transients:
+            transients = job.transients + 1
+            self._write(QUEUED, dataclasses.replace(
+                job, transients=transients, error=error,
+                lease_worker=None, lease_expires_at=None,
+                not_before=now + self._backoff(transients)))
+            self._remove(LEASED, job.id)
+            return QUEUED
         attempts = job.attempts + 1
         rec = dataclasses.replace(job, attempts=attempts, error=error,
                                   lease_worker=None, lease_expires_at=None)

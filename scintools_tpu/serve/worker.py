@@ -26,7 +26,8 @@ import dataclasses
 import os
 import time
 
-from .. import obs
+from .. import faults, obs
+from ..health import PreflightError
 from ..utils.log import get_logger, log_event
 from .batcher import Batch, DynamicBatcher
 from .queue import JobQueue
@@ -67,14 +68,25 @@ def config_from_opts(opts: dict):
     return PipelineConfig(**pkw)
 
 
-def load_epoch(path: str, clean: bool = False):
+def load_epoch(path: str, clean: bool = False, preflight: bool = True):
     """Host-side load+clean of one epoch — the same chain as the
     batched CLI engine (trim/refill, plus the --clean triage), so a
-    served epoch enters the pipeline bit-identical to a direct run."""
+    served epoch enters the pipeline bit-identical to a direct run.
+
+    ``preflight`` (default on) runs the health checks on the RAW
+    post-trim epoch — before ``refill`` repairs dead bands / NaN gaps
+    by interpolation — raising :class:`~scintools_tpu.health.
+    PreflightError` with machine-readable reason codes; callers route
+    it to their quarantine path (deterministic, so it never burns the
+    serve retry budget)."""
+    from ..health import quarantine_check
     from ..io.psrflux import read_psrflux
     from ..ops.clean import correct_band, refill, trim_edges, zap
 
-    d = refill(trim_edges(read_psrflux(path)))
+    d = trim_edges(read_psrflux(path))
+    if preflight:
+        quarantine_check(d, name=os.path.basename(path))
+    d = refill(d)
     if clean:
         d = correct_band(refill(zap(
             zap(d, method="channels", sigma=5),
@@ -143,8 +155,8 @@ class ServeWorker:
                                       max_wait_s=self.max_wait_s)
         self.log = get_logger()
         self.stats = {"batches": 0, "jobs_done": 0, "jobs_failed": 0,
-                      "job_retries": 0, "lanes_filled": 0,
-                      "lanes_total": 0}
+                      "job_retries": 0, "job_transient_retries": 0,
+                      "lanes_filled": 0, "lanes_total": 0}
 
     # -- one scheduling round ----------------------------------------------
     def poll_once(self, now: float | None = None,
@@ -173,10 +185,29 @@ class ServeWorker:
                     round(max(now - job.submitted_at, 0.0), 6))
             try:
                 with obs.span("serve.load", file=job.file):
+                    # chaos site: the injected fault classifies
+                    # transient (real load errors — FileNotFoundError,
+                    # parse failures — stay deterministic/unknown and
+                    # keep the bounded-retry path)
+                    faults.check("worker.load")
                     epoch = load_epoch(job.file,
                                        clean=bool(job.cfg.get("clean")))
+            except PreflightError as e:
+                # preflight quarantine: a structurally-bad epoch is
+                # routed out with machine-readable reason codes BEFORE
+                # it can NaN-poison a batch lane — deterministic, so
+                # straight to failed/ with no retry budget burned
+                # discovering it (counters emitted at the raise site)
+                state = self.queue.fail(job, str(e), retryable=False)
+                if state == "failed":
+                    self.stats["jobs_failed"] += 1
+                    obs.inc("jobs_failed")
+                log_event(self.log, "job_quarantined", job=job.id,
+                          file=os.path.basename(job.file),
+                          reasons=",".join(e.reasons), state=state)
+                continue
             except Exception as e:
-                self._job_failed(job, f"load failed: {e!r}")
+                self._job_failed(job, f"load failed: {e!r}", exc=e)
                 continue
             self.batcher.add(job, epoch, now)
         drain = self.queue.drain_requested()
@@ -202,8 +233,21 @@ class ServeWorker:
             log_event(self.log, "job_poisoned", job=job.id,
                       attempts=job.attempts, error=job.error)
 
-    def _job_failed(self, job, error: str) -> None:
-        state = self.queue.fail(job, error)
+    def _job_failed(self, job, error: str, exc=None) -> None:
+        """Route a job failure through the error taxonomy
+        (faults.classify_error): transient infra faults requeue WITHOUT
+        burning the bounded retry budget; poison/unknown keep the
+        existing bounded-retry -> ``failed/`` path."""
+        transient = (exc is not None
+                     and faults.classify_error(exc) == "transient")
+        # mirror of queue.fail's budget-free condition: once a job has
+        # exhausted max_transients, a transient-classified failure
+        # ESCALATES to the attempts-burning path and must be counted/
+        # logged as such — an operator watching job_transient_retries
+        # vs job_retries has to see the escalation happen
+        budget_free = (transient
+                       and job.transients < self.queue.max_transients)
+        state = self.queue.fail(job, error, transient=transient)
         if state == "done":
             # completed by another worker under the at-least-once race;
             # the stale local failure is dropped, nothing to count
@@ -212,6 +256,11 @@ class ServeWorker:
             self.stats["jobs_failed"] += 1
             obs.inc("jobs_failed")
             log_event(self.log, "job_poisoned", job=job.id, error=error)
+        elif budget_free:
+            self.stats["job_transient_retries"] += 1
+            obs.inc("job_transient_retries")
+            log_event(self.log, "job_requeued_transient", job=job.id,
+                      error=error)
         else:
             self.stats["job_retries"] += 1
             obs.inc("job_retries")
@@ -235,9 +284,30 @@ class ServeWorker:
         try:
             with obs.span("serve.batch", jobs=n,
                           fill=round(batch.fill_ratio, 4)):
+                # chaos site: an infra fault mid-batch (device
+                # preemption, OOM past the driver's backoff floor)
+                faults.check("worker.batch_execute")
                 rows = self.runner(batch, self.batch_size, self.mesh,
                                    self.async_exec)
         except Exception as e:
+            if faults.classify_error(e) == "transient":
+                # infrastructure fault: EVERY member requeues without
+                # burning its bounded retry budget, un-marked (the same
+                # batch composition is expected to succeed on the next
+                # attempt/worker — no reason to shatter it solo).  A
+                # member already past max_transients ESCALATES to the
+                # attempts-burning path (misclassified deterministic
+                # error), so it goes solo like the non-transient branch
+                # — otherwise the batch re-coalesces each round and
+                # burns one attempt per member until ALL poison together
+                for job in batch.jobs:
+                    if job.transients >= self.queue.max_transients:
+                        job = dataclasses.replace(job, solo=True)
+                    self._job_failed(job, f"batch transient: {e!r}",
+                                     exc=e)
+                log_event(self.log, "batch_transient", jobs=n,
+                          error=repr(e))
+                return
             # whole-batch failure (pipeline error): requeue every member
             # marked SOLO, so retries run as singleton batches — the
             # poison member exhausts its own budget alone and healthy

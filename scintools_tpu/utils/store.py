@@ -90,12 +90,34 @@ class ResultsStore:
         """A missing OR unreadable/corrupt row degrades to None (as
         ``get_meta`` does): the store is a multi-writer surface under
         the serve protocol, and one bad row must not make
-        ``records()``/``export_csv`` raise away every healthy row."""
+        ``records()``/``export_csv`` raise away every healthy row.
+
+        Degradation is OBSERVABLE, not silent: a corrupt row bumps the
+        ``store_corrupt_rows`` counter, logs a ``store_corrupt_row``
+        event with the path, and is quarantined aside under a
+        ``.corrupt`` suffix — so ``__contains__``/``keys()`` stop
+        seeing it (the row re-executes instead of re-parsing the same
+        torn bytes on every scan) and the bytes survive for forensics."""
+        path = self._path(key)
         try:
-            with open(self._path(key)) as fh:
+            with open(path) as fh:
                 return json.load(fh)
-        except (OSError, ValueError):
+        except OSError:
             return None
+        except ValueError:
+            self._quarantine_corrupt(path)
+            return None
+
+    def _quarantine_corrupt(self, path: str) -> None:
+        from .. import obs
+        from .log import get_logger, log_event
+
+        obs.inc("store_corrupt_rows")
+        log_event(get_logger(), "store_corrupt_row", path=path)
+        try:
+            os.replace(path, path + ".corrupt")
+        except OSError:  # fault-ok: already quarantined by a racer
+            pass
 
     def keys(self) -> list[str]:
         return sorted(os.path.splitext(f)[0] for f in os.listdir(self.dir)

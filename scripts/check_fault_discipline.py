@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Repo lint: no silent ``except Exception`` swallows in the fault-
+critical subtrees (``scintools_tpu/parallel/``, ``scintools_tpu/
+serve/``, ``scintools_tpu/ops/``).
+
+The reliability layer (ISSUE 5) turns infrastructure failures into
+*classified, observable, recoverable* events — a broad handler that
+catches ``Exception``/``BaseException`` (or everything, bare) and then
+neither re-raises nor reports is the one pattern that defeats it: the
+fault vanishes, no counter moves, no trace event lands, and the
+self-healing paths (OOM backoff, transient requeue, quarantine) never
+see it.  This lint rejects exactly that pattern.
+
+A broad handler passes when its body (recursively) contains any of:
+
+* a ``raise`` statement (re-raise or translate);
+* a call to the observability surface — ``log_event``, ``obs.inc`` /
+  ``obs.gauge``, ``warnings.warn``, logger methods (`` .warning`` /
+  ``.error`` / ``.exception`` / ``.log``), or ``faults.check``;
+* a ``# fault-ok: <why>`` annotation on the ``except`` line — the
+  triaged allowlist for handlers whose swallowing is the contract
+  (e.g. best-effort capability probes), documenting WHY in place.
+
+Narrow handlers (``except OSError``, ``except ValueError``, ...) are
+out of scope: catching a *specific* exception is a statement about the
+expected failure; catching everything is only safe when the handler
+reports.  AST-based, so strings/comments mentioning ``except`` don't
+count.  Enforced in tier-1 via tests/test_fault_discipline.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+MARKER = "fault-ok"
+SUBTREES = ("parallel", "serve", "ops")
+# exception names whose handlers are in scope (everything-catchers)
+BROAD = {"Exception", "BaseException"}
+# call names (attribute tails) that count as reporting the failure
+_REPORT_CALLS = {"log_event", "inc", "gauge", "warn", "warning", "error",
+                 "exception", "log", "check", "fail", "_job_failed"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:  # bare except:
+        return True
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in BROAD:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in BROAD:
+            return True
+    return False
+
+
+def _reports(handler: ast.ExceptHandler) -> bool:
+    """Whether the handler body re-raises or reports (see module doc)."""
+    for node in ast.walk(ast.Module(body=handler.body,
+                                    type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = (fn.id if isinstance(fn, ast.Name)
+                    else fn.attr if isinstance(fn, ast.Attribute)
+                    else None)
+            if name in _REPORT_CALLS:
+                return True
+    return False
+
+
+def find_silent_handlers(path: str) -> list:
+    """(line, text) of every unannotated silent broad handler."""
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    lines = src.splitlines()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:  # pragma: no cover - unparseable file
+        return [(0, "SyntaxError: could not parse")]
+    hits = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad(node) or _reports(node):
+            continue
+        text = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if MARKER in text:
+            continue
+        hits.append((node.lineno, text.strip()))
+    return sorted(hits)
+
+
+def check_tree(pkg_dir: str) -> list:
+    """All offending (path, line, text) under the fault-critical
+    subtrees."""
+    offenders = []
+    for sub in SUBTREES:
+        root_dir = os.path.join(pkg_dir, sub)
+        for root, _dirs, files in os.walk(root_dir):
+            for name in sorted(files):
+                if not name.endswith(".py"):
+                    continue
+                path = os.path.join(root, name)
+                for line, text in find_silent_handlers(path):
+                    offenders.append((os.path.relpath(path, pkg_dir),
+                                      line, text))
+    return offenders
+
+
+def main() -> int:
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg = os.path.join(here, "scintools_tpu")
+    offenders = check_tree(pkg)
+    for path, line, text in offenders:
+        sys.stderr.write(
+            f"{path}:{line}: broad except swallows silently — re-raise, "
+            f"report via obs/log_event, or annotate '# {MARKER}: <why>': "
+            f"{text}\n")
+    if offenders:
+        sys.stderr.write(f"{len(offenders)} silent broad handler(s) in "
+                         f"{'/'.join(SUBTREES)}\n")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,22 +20,29 @@ _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        "multihost_worker.py")
 
 
-def _free_port() -> int:
-    """Bind-then-release: the kernel hands out a currently-free
-    ephemeral port.  Another process may still grab it between release
-    and the coordinator's bind — the launcher below retries once on
-    that exact failure instead of flaking."""
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+def _reserve_port() -> tuple[socket.socket, int]:
+    """Bind port 0 with SO_REUSEPORT and HOLD the socket: the kernel
+    assigns the port atomically, and keeping the (non-listening)
+    reservation open while the workers run means no other process can
+    bind it in the meantime — the old probe-then-release scheme left a
+    window where anything on the host could steal the port before the
+    coordinator's bind (the CI flake the retry-once deflake only
+    papered over).  jax's gRPC coordinator binds with SO_REUSEPORT
+    itself (gRPC's Linux default, verified against this jaxlib), so
+    the held reservation and the coordinator coexist; connections only
+    ever reach the one LISTENING socket (the coordinator's)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+    s.bind(("127.0.0.1", 0))
+    return s, s.getsockname()[1]
 
 
 def _launch_workers(env) -> tuple[list, list]:
-    """Run the two-process mesh on a freshly-probed free port,
-    retrying ONCE with a new port if the coordinator lost the
-    bind race ('Address already in use')."""
-    for attempt in (0, 1):
-        port = _free_port()
+    """Run the two-process mesh on a port reserved (and held) by this
+    process for the run's whole duration — collision-free by
+    construction, no retry loop needed."""
+    reservation, port = _reserve_port()
+    try:
         procs = [subprocess.Popen(
             [sys.executable, _WORKER, str(i), str(port)],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
@@ -50,13 +57,9 @@ def _launch_workers(env) -> tuple[list, list]:
                 p.kill()
             pytest.fail("multihost workers timed out:\n"
                         + "\n".join(o or "" for o in outs))
-        bind_lost = any(p.returncode != 0
-                        and "Address already in use" in (o or "")
-                        for p, o in zip(procs, outs))
-        if bind_lost and attempt == 0:
-            continue
         return procs, outs
-    return procs, outs  # pragma: no cover (loop always returns)
+    finally:
+        reservation.close()
 
 
 def test_two_process_mesh_psum_survey_stats():

@@ -286,8 +286,11 @@ def test_claim_preserves_concurrent_requeue_attempts(tmp_path,
 
 def test_results_store_put_new_atomicity_and_corrupt_row(tmp_path):
     """put_new never rewrites an existing row; a torn/corrupt row
-    degrades to None and cannot break records()/export_csv for the
-    healthy rows (the store is multi-writer under serve)."""
+    degrades to None — OBSERVABLY: ``store_corrupt_rows`` counter, a
+    ``store_corrupt_row`` log event, and the bad file quarantined
+    aside under ``.corrupt`` so scans stop re-parsing it — and cannot
+    break records()/export_csv for the healthy rows (the store is
+    multi-writer under serve)."""
     from scintools_tpu.utils.store import ResultsStore
 
     st = ResultsStore(str(tmp_path / "r"))
@@ -296,10 +299,130 @@ def test_results_store_put_new_atomicity_and_corrupt_row(tmp_path):
     assert st.get("k1")["tau"] == 1.0
     with open(os.path.join(st.dir, "torn.json"), "w") as fh:
         fh.write('{"name": "b", "tau":')   # crash mid-write elsewhere
-    assert st.get("torn") is None
+    obs.disable(flush=False)
+    obs.reset()
+    with obs.tracing():
+        assert st.get("torn") is None
+        c = obs.counters()
+    assert c.get("store_corrupt_rows") == 1, c
+    # quarantined aside: the torn bytes survive for forensics, but the
+    # key is no longer in the store (the row can re-execute) and a
+    # rescan does NOT re-parse (counter stays put)
+    assert os.path.exists(os.path.join(st.dir, "torn.json.corrupt"))
+    assert not os.path.exists(os.path.join(st.dir, "torn.json"))
+    assert "torn" not in st
+    with obs.tracing():
+        assert st.get("torn") is None          # now simply missing
+        assert obs.counters().get("store_corrupt_rows", 0) == 0
     assert [r["name"] for r in st.records()] == ["a"]
     out = str(tmp_path / "o.csv")
     assert st.export_csv(out, full=True) == 1
+    obs.reset()
+
+
+def test_reap_tolerates_clock_skew_and_claim_time_expiry(tmp_path):
+    """Lease-recovery edge cases: (a) a reaper whose clock runs BEHIND
+    the claimer's never reaps a live lease (negative apparent age);
+    (b) a lease already expired at claim time (lease_s=0 — the
+    clock-skew extreme where the claimer's stamp is in the reaper's
+    past) reaps immediately, requeues with attempts+1 and honours
+    backoff before the next claim."""
+    files = _write_epochs(tmp_path, GOOD_SEEDS[:1])
+    q = JobQueue(str(tmp_path / "q"), max_retries=3, backoff_s=10.0)
+    q.submit(files[0], OPTS)
+    (j,) = q.claim("w1", n=1, lease_s=5.0, now=1000.0)
+    # (a) reaper clock behind the claim stamp: expiry 1005 is in this
+    # reaper's future — nothing to reap, the lease survives
+    assert q.reap_expired(now=900.0) == ([], [])
+    assert q.counts()["leased"] == 1
+    # (b) expiry exactly at "now" counts as expired (<=, not <)
+    requeued, poisoned = q.reap_expired(now=1005.0)
+    assert [r.id for r in requeued] == [j.id] and not poisoned
+    assert q.get(j.id).attempts == 1
+    # backoff gates the reclaim: not claimable until not_before passes
+    assert q.claim("w2", n=1, lease_s=5.0, now=1006.0) == []
+    (j2,) = q.claim("w2", n=1, lease_s=0.0, now=1015.1)
+    # lease_s=0: expired the moment it was claimed — the next reap
+    # sweeps it straight back out
+    requeued, _ = q.reap_expired(now=1015.1)
+    assert [r.id for r in requeued] == [j2.id]
+    assert q.get(j2.id).attempts == 2
+
+
+def test_double_reap_is_idempotent(tmp_path):
+    """A second reap pass (two monitors racing, or one re-run) finds
+    nothing: attempts are burned once per expiry, not once per
+    reaper."""
+    files = _write_epochs(tmp_path, GOOD_SEEDS[:2])
+    q = JobQueue(str(tmp_path / "q"), max_retries=3, backoff_s=100.0)
+    for f in files:
+        q.submit(f, OPTS)
+    q.claim("w1", n=2, lease_s=5.0, now=1000.0)
+    requeued, _ = q.reap_expired(now=2000.0)
+    assert len(requeued) == 2
+    assert q.reap_expired(now=2000.0) == ([], [])
+    assert q.reap_expired(now=2000.1) == ([], [])
+    assert all(j.attempts == 1 for j in q.jobs("queued"))
+    assert q.counts() == {"queued": 2, "leased": 0, "done": 0,
+                          "failed": 0}
+
+
+def test_complete_after_reap_never_uncompletes_or_duplicates(tmp_path):
+    """A worker finishing a job whose lease was ALREADY reaped (the
+    at-least-once window): complete() wins, the requeued copy is
+    consumed, the result row is written exactly once, and neither a
+    later reap nor a later claim can resurrect or duplicate it."""
+    files = _write_epochs(tmp_path, GOOD_SEEDS[:1])
+    q = JobQueue(str(tmp_path / "q"), max_retries=3, backoff_s=0.0)
+    jid, _ = q.submit(files[0], OPTS)
+    (j,) = q.claim("w1", n=1, lease_s=5.0, now=1000.0)
+    # the lease expires and the job is requeued while w1 still runs
+    q.reap_expired(now=2000.0)
+    assert q.state_of(jid) == "queued"
+    # w1 finishes anyway: row stored once, job completed from wherever
+    assert q.results.put_new(jid, {"name": "x", "tau": 1.0}) is True
+    q.complete(j)
+    assert q.state_of(jid) == "done" and q.counts()["queued"] == 0
+    # a second (requeued-copy) execution cannot duplicate the row
+    assert q.results.put_new(jid, {"name": "x", "tau": 9.9}) is False
+    assert q.results.get(jid)["tau"] == 1.0
+    # nothing left to reap or claim; fail() of the stale copy is a
+    # no-op that reports done
+    assert q.reap_expired(now=9e9) == ([], [])
+    assert q.claim("w2", n=4, lease_s=5.0, now=9e9) == []
+    assert q.fail(j, "stale") == "done"
+    assert q.counts() == {"queued": 0, "leased": 0, "done": 1,
+                          "failed": 0}
+    assert len(q.results.keys()) == 1
+
+
+def test_transient_fail_preserves_retry_budget(tmp_path):
+    """queue.fail(transient=True): the job requeues with ``attempts``
+    UNCHANGED (the bounded poison budget is untouched) while the
+    ``transients`` field counts and exponentially backs off the
+    infra-fault retries; a later DETERMINISTIC failure still poisons
+    after exactly the same bounded attempts as before."""
+    files = _write_epochs(tmp_path, GOOD_SEEDS[:1])
+    q = JobQueue(str(tmp_path / "q"), max_retries=1, backoff_s=10.0)
+    jid, _ = q.submit(files[0], OPTS)
+    now = 1000.0
+    for k in range(1, 4):   # three transient strikes, no budget burned
+        (j,) = q.claim("w", n=1, lease_s=5.0, now=now)
+        assert q.fail(j, f"infra {k}", transient=True, now=now) \
+            == "queued"
+        j = q.get(jid)
+        assert j.attempts == 0 and j.transients == k
+        # exponential transient backoff: 10, 20, 40 ...
+        assert j.not_before == now + 10.0 * (2.0 ** (k - 1))
+        now = j.not_before + 0.1
+    # deterministic failures from here: the bounded budget is intact,
+    # so the poison path takes max_retries+1 attempts exactly as today
+    (j,) = q.claim("w", n=1, lease_s=5.0, now=now)
+    assert q.fail(j, "bad epoch", now=now) == "queued"
+    assert q.get(jid).attempts == 1
+    (j,) = q.claim("w", n=1, lease_s=5.0, now=now + 20.0)
+    assert q.fail(j, "bad epoch", now=now + 20.0) == "failed"
+    assert q.get(jid).attempts == 2 and q.state_of(jid) == "failed"
 
 
 # ---------------------------------------------------------------------------
