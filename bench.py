@@ -41,6 +41,8 @@ SCINT_BENCH_MIN_MEASURE_S (minimum total measured wall, default 2 s —
 passes repeat until both are met, capped at SCINT_BENCH_MAX_REPEATS,
 default 32; the record reports median + IQR as ``rate_stats``),
 SCINT_BENCH_CPU_THREADS (BLAS pin in the fallback subprocess),
+SCINT_BENCH_TTFR (0 disables the cold-process time_to_first_result_s
+probe) / SCINT_BENCH_TTFR_TIMEOUT (its child cap, default 900 s),
 SCINT_BENCH_FLIGHTS_DIR (flight-log dir for record salvage, default
 benchmarks/flights/ — test fixtures point it at tmp dirs),
 SCINT_BENCH_TRACE (path: enable scintools_tpu.obs tracing and append
@@ -654,6 +656,99 @@ def device_throughput(dyn, freqs, times, chunk: int,
     return rec
 
 
+def time_to_first_result(nf: int, nt: int, timeout_s: int | None = None,
+                         arc_numsteps: int = 2000, lm_steps: int = 20,
+                         force_cpu: bool = False) -> dict:
+    """Cold-process submit -> first CSV row, measured end to end in a
+    FRESH subprocess: interpreter + jax import, psrflux epoch load,
+    pipeline build, compile (or persistent-cache/warm-artifact
+    deserialize), execution, and the CSV row write.  This is the
+    latency a fresh pod's first request actually pays — the number the
+    shape-bucket catalog + warm-cache artifact work (ISSUE 7) exists to
+    crush — so the flight record carries it as a first-class metric
+    (``time_to_first_result_s``) and the BENCH trajectory guards it.
+
+    The child runs ONE epoch (B=1 canonicalises onto the catalog's
+    smallest rung via ``run_pipeline(bucket=True)``) against the same
+    persistent cache env as the bench (`.jax_cache`): an empty cache
+    measures the true cold start, a populated/unpacked one the warm
+    start — the returned ``jit_cache_miss`` / ``compile_cache_hit``
+    counters say which one was measured.  ``SCINT_BENCH_TTFR=0``
+    disables; ``SCINT_BENCH_TTFR_TIMEOUT`` caps the child (default
+    900 s — a cold CPU compile at the full bench shape is minutes)."""
+    if os.environ.get("SCINT_BENCH_TTFR", "1").strip().lower() \
+            in ("0", "off", "false", ""):
+        return {"skipped": True}
+    timeout_s = timeout_s if timeout_s is not None \
+        else _env_int("SCINT_BENCH_TTFR_TIMEOUT", 900)
+    import shutil
+    import tempfile
+
+    tmpdir = tempfile.mkdtemp(prefix="scint_ttfr_")
+    epoch_path = os.path.join(tmpdir, "ttfr_epoch.dynspec")
+    csv_path = os.path.join(tmpdir, "ttfr.csv")
+    try:
+        from scintools_tpu.data import DynspecData
+        from scintools_tpu.io.psrflux import write_psrflux
+
+        dyn1, freqs, times = make_epochs(nf, nt, n_base=1, B=1)
+        write_psrflux(DynspecData(dyn=dyn1[0], freqs=freqs, times=times),
+                      epoch_path)
+        backend_pre = (
+            "from scintools_tpu.backend import force_host_cpu_devices\n"
+            "force_host_cpu_devices(1)\n" if force_cpu else
+            "from scintools_tpu.backend import honor_platform_env\n"
+            "honor_platform_env()\n")
+        code = (
+            "import time\n"
+            "t0 = time.time()\n"          # BEFORE any heavy import
+            + backend_pre +
+            "import json\n"
+            "from scintools_tpu import obs\n"
+            "from scintools_tpu.io.results import (batch_lane_row,\n"
+            "                                      results_row,\n"
+            "                                      write_results)\n"
+            "from scintools_tpu.parallel import (PipelineConfig,\n"
+            "                                    run_pipeline)\n"
+            "from scintools_tpu.serve.worker import load_epoch\n"
+            f"ep = load_epoch({epoch_path!r})\n"
+            f"cfg = PipelineConfig(arc_numsteps={int(arc_numsteps)},\n"
+            f"                     lm_steps={int(lm_steps)})\n"
+            "with obs.tracing():\n"
+            "    [(idx, res)] = run_pipeline([ep], cfg, bucket=True)\n"
+            "    c = obs.counters()\n"
+            "row = results_row(ep)\n"
+            "row.update(batch_lane_row(res, 0, cfg.lamsteps))\n"
+            f"write_results({csv_path!r}, row)\n"
+            "out = {'s': round(time.time() - t0, 3)}\n"
+            "for k in ('jit_cache_miss', 'compile_cache_hit',\n"
+            "          'compile_cache_miss'):\n"
+            "    out[k] = int(c.get(k, 0))\n"
+            "print(json.dumps(out))\n")
+        env = _cache_env()
+        env["PYTHONPATH"] = _HERE + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run([sys.executable, "-c", code], text=True,
+                              capture_output=True, timeout=timeout_s,
+                              env=env, cwd=_HERE)
+        rec = _last_json_line(proc.stdout)
+        if not rec or rec.get("s") is None:
+            return {"error": f"ttfr child rc={proc.returncode}: "
+                             f"{proc.stderr.strip()[-300:]}"}
+        if not os.path.exists(csv_path):
+            return {"error": "ttfr child reported success but wrote no "
+                             "CSV row"}
+        rec["shape"] = [1, int(nf), int(nt)]
+        rec["backend"] = "cpu-forced" if force_cpu else "ambient"
+        return rec
+    except subprocess.TimeoutExpired:
+        return {"error": f"ttfr child exceeded {timeout_s}s (cold "
+                         "compile budget; SCINT_BENCH_TTFR_TIMEOUT)"}
+    except Exception as e:  # metric capture must never sink the bench
+        return {"error": f"ttfr {type(e).__name__}: {e}"}
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def main():
     _maybe_enable_trace()
     if not os.environ.get("SCINT_BENCH_TRACE"):
@@ -680,6 +775,11 @@ def main():
 
     metric = (f"batched sspec+arc-fit+scint-fit throughput "
               f"({B} dynspecs {nf}x{nt})")
+
+    # cold-process submit -> first CSV row (filled in right before the
+    # matching measurement phase; device_record stamps it into every
+    # flight record so the BENCH trajectory guards first-result latency)
+    ttfr_holder: dict = {}
 
     def device_record(res: dict, probe: dict, is_fallback: bool = False,
                       batch_chunk: int | None = None, **extra) -> dict:
@@ -779,6 +879,13 @@ def main():
                 scint_cuts=cuts, numsteps=2000, lm_steps=20)
         except Exception as e:  # accounting must never sink the record
             rec["roofline"] = {"error": f"{type(e).__name__}: {e}"}
+        t = ttfr_holder.get("rec")
+        if t:
+            rec["time_to_first_result"] = t
+            if t.get("s") is not None:
+                # first-class trajectory metric (ISSUE 7): regressions
+                # in fresh-pod first-result latency show beside rates
+                rec["time_to_first_result_s"] = t["s"]
         rec.update(extra)
         return rec
 
@@ -827,6 +934,11 @@ def main():
 
     result: dict = {}
     if probe_ok:
+        # cold-process -> first-CSV-row latency, measured BEFORE this
+        # process claims the device (the child probes/claims and exits,
+        # exactly like device_preprobe; two concurrent claims would
+        # wedge the tunnel)
+        ttfr_holder["rec"] = time_to_first_result(nf, nt)
         # --- stage 2: full device run under the watchdog -----------------
         # (the tunnel can still die mid-run; the watchdog bounds that)
         timeout_s = _env_int("SCINT_BENCH_DEVICE_TIMEOUT", 1200)
@@ -950,6 +1062,11 @@ def main():
     fb_err = None
     try:
         fb_b = _env_int("SCINT_BENCH_FALLBACK_B", 64)
+        if "rec" not in ttfr_holder:
+            # fallback flight: measure first-result latency on the same
+            # silicon the fallback rate is measured on (cpu-forced)
+            ttfr_holder["rec"] = time_to_first_result(nf, nt,
+                                                      force_cpu=True)
         code = (
             "import json, os\n"
             "from scintools_tpu.backend import force_host_cpu_devices\n"

@@ -1,0 +1,227 @@
+"""Closed shape-bucket catalog: canonicalise arbitrary survey shapes
+onto a small fixed set of compiled step signatures.
+
+BENCH_r05 measured ``compile_s: 324.68`` against ``measure_s: 0.54`` —
+the compiled step is ~600x faster to run than to build, so every NEW
+input signature a survey presents costs minutes of XLA work before the
+first result.  The fix GPU real-time pipelines use (arXiv:1804.05335:
+one resident FDAS transform fed canonicalised inputs; arXiv:2606.01547
+documents recompilation as the dominant practical cost of JAX ports) is
+a CLOSED set of compiled signatures: arbitrary inputs are padded into
+the nearest member and the padding masked out of the results.
+
+What is (and is not) bucketed
+-----------------------------
+Only the BATCH axis is padded.  The per-epoch axes ``(nf, nt)`` — and
+the frequency/time *values* behind them — are baked into the compiled
+program as host-side constants (df/fc/lambda grids, FFT lengths, eta
+grids), so padding them would change every epoch's science.  The batch
+axis, by contrast, is provably lane-independent: the driver's
+``pad_to`` / ``pad_chunks`` machinery already pads it with mask-invalid
+lanes that are sliced off at gather, byte-identical for real lanes
+(tested since PR 2/3).  The catalog is therefore a geometric ladder of
+batch sizes per (axes identity, config, staging dtype) — a survey of
+ANY epoch count executes one of ``len(ladder)`` programs per observing
+setup instead of one per distinct count.
+
+The ladder
+----------
+``batch_ladder(multiple, top)`` = ``multiple * 2^k`` for every rung
+below ``top``, plus ``top`` itself (so a production serve batch size
+that is not a power of two is still a catalog member).  ``top``
+defaults to ``SCINT_BUCKET_TOP`` (env, default 64); surveys larger than
+``top`` chunk at the top rung with uniform-chunk padding — still
+exactly ONE compiled program.  Every rung is a multiple of the mesh's
+data-axis size, as divisibility requires.
+
+Precision/config awareness: a :class:`BucketSignature` carries the
+staging dtype (``driver.stage_dtype`` of the config's precision policy)
+and a config digest, so ``bf16_io`` and ``f32`` jobs land in separate
+catalog entries — they ARE different compiled programs.
+
+Consumers: ``parallel.run_pipeline(bucket=True)`` (pads each shape
+bucket onto the ladder), ``compile_cache.plan_steps(catalog=True)`` /
+``scintools-tpu warmup --catalog`` (pre-compiles the whole ladder so a
+warm worker serves any shape with ``jit_cache_miss == 0``), the serve
+batcher (partial flushes pad to the nearest rung instead of the full
+batch size), and ``scripts/build_warm_cache.py`` (ships the compiled
+catalog as a relocatable artifact keyed on :func:`catalog_digest`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+
+import numpy as np
+
+# largest pad-to rung (and the chunk size above it); env-overridable so
+# a serve fleet with bigger warm batches widens its catalog
+TOP_ENV = "SCINT_BUCKET_TOP"
+DEFAULT_TOP = 64
+
+
+def default_top() -> int:
+    """The ladder's top rung from the environment (``SCINT_BUCKET_TOP``,
+    default 64)."""
+    try:
+        top = int(os.environ.get(TOP_ENV, DEFAULT_TOP))
+    except ValueError:
+        raise ValueError(f"{TOP_ENV} must be an integer, got "
+                         f"{os.environ.get(TOP_ENV)!r}")
+    if top < 1:
+        raise ValueError(f"{TOP_ENV} must be >= 1, got {top}")
+    return top
+
+
+def batch_ladder(multiple: int = 1, top: int | None = None) -> tuple:
+    """The closed set of padded batch sizes: ``multiple * 2^k`` for
+    every value below ``top``, plus ``top`` itself (adjusted up to a
+    multiple of ``multiple``).  Always non-empty and sorted."""
+    multiple = max(int(multiple), 1)
+    top = default_top() if top is None else int(top)
+    # top must itself be a legal batch (mesh divisibility)
+    top = -(-max(top, 1) // multiple) * multiple
+    rungs = []
+    r = multiple
+    while r < top:
+        rungs.append(r)
+        r *= 2
+    rungs.append(top)
+    return tuple(rungs)
+
+
+def rung_for(n: int, multiple: int = 1, top: int | None = None) -> int:
+    """Smallest ladder rung >= ``n`` — the padded batch size ``n``
+    epochs canonicalise onto.  Counts above the top rung return the top
+    rung (the caller chunks at it; see :func:`bucket_plan`)."""
+    if n < 1:
+        raise ValueError(f"rung_for: need n >= 1, got {n}")
+    for r in batch_ladder(multiple, top):
+        if r >= n:
+            return r
+    return batch_ladder(multiple, top)[-1]
+
+
+def bucket_plan(n: int, multiple: int = 1, top: int | None = None) -> dict:
+    """How ``run_pipeline`` executes ``n`` epochs on the catalog:
+    ``{"pad_to": rung}`` when one padded step covers them, or
+    ``{"chunk": top, "pad_chunks": True}`` when the survey is larger
+    than the top rung (uniform chunks of the top rung — still ONE
+    compiled program).  Both reuse the driver's existing mask-invalid
+    lane machinery, so real-lane results are byte-identical to the
+    unbucketed run."""
+    r = rung_for(n, multiple, top)
+    if n <= r:
+        return {"pad_to": r}
+    return {"chunk": r, "pad_chunks": True}
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketSignature:
+    """One catalog member: the padded step signature a canonicalised
+    batch executes (batch rung x exact epoch axes x staging dtype x
+    config digest)."""
+
+    batch: int
+    nf: int
+    nt: int
+    dtype: str
+    axes_digest: str = ""
+    cfg_digest: str = ""
+    chunked: bool = False
+
+    @property
+    def label(self) -> str:
+        """Compact per-signature key, matching the obs gauge/counter
+        label convention (``BxNFxNT:dtype``)."""
+        return f"{self.batch}x{self.nf}x{self.nt}:{self.dtype}"
+
+
+def _cfg_digest(config) -> str:
+    return hashlib.sha256(repr(config).encode()).hexdigest()[:12]
+
+
+def _axes_digest(freqs, times) -> str:
+    f = np.ascontiguousarray(np.asarray(freqs, dtype=np.float64))  # host-f64: catalog key
+    t = np.ascontiguousarray(np.asarray(times, dtype=np.float64))  # host-f64: catalog key
+    return hashlib.sha256(f.tobytes() + t.tobytes()).hexdigest()[:12]
+
+
+def canonicalize(epoch_shape, config, multiple: int = 1,
+                 top: int | None = None, freqs=None,
+                 times=None) -> BucketSignature:
+    """Map an arbitrary ``(B, nf, nt)`` survey shape onto its catalog
+    member: the batch axis rounds UP to the nearest ladder rung (or the
+    top rung, chunk-covered, for bigger surveys); ``(nf, nt)`` pass
+    through untouched (axes identity is sacrosanct — see the module
+    docstring).  ``config`` decides the staging dtype (precision policy)
+    and the config digest, so ``bf16_io`` and ``f32`` surveys land in
+    DIFFERENT catalog entries."""
+    from .parallel.driver import stage_dtype
+
+    b, nf, nt = (int(s) for s in epoch_shape)
+    r = rung_for(b, multiple, top)
+    return BucketSignature(
+        batch=r, nf=nf, nt=nt,
+        dtype=str(np.dtype(stage_dtype(config.precision))),
+        axes_digest=(_axes_digest(freqs, times)
+                     if freqs is not None and times is not None else ""),
+        cfg_digest=_cfg_digest(config),
+        chunked=b > r)
+
+
+def catalog(epochs, config, mesh=None, top: int | None = None) -> list:
+    """The FULL closed signature set for these observing setups: one
+    :class:`BucketSignature` per (axes bucket, ladder rung), top rung
+    additionally marked ``chunked`` (the chunk loop donates its input
+    on TPU, which is part of the compile-cache key).  This is what
+    ``warmup --catalog`` compiles and :func:`catalog_digest` keys the
+    warm-cache artifact on."""
+    from .parallel import mesh as mesh_mod
+    from .parallel.driver import _bucket_epochs, stage_dtype
+
+    multiple = 1
+    if mesh is not None:
+        multiple = mesh.shape[mesh_mod.DATA_AXIS]
+    dtype = str(np.dtype(stage_dtype(config.precision)))
+    cfgd = _cfg_digest(config)
+    out = []
+    for key in _bucket_epochs(epochs):
+        (nf,), (nt,) = key[0], key[1]
+        axes = hashlib.sha256(key[2] + key[3]).hexdigest()[:12]
+        ladder = batch_ladder(multiple, top)
+        for r in ladder:
+            out.append(BucketSignature(batch=r, nf=nf, nt=nt, dtype=dtype,
+                                       axes_digest=axes, cfg_digest=cfgd,
+                                       chunked=False))
+        # the top rung also runs through the chunk loop for
+        # bigger-than-top surveys; donation differs there (TPU), so it
+        # is its own compiled signature
+        out.append(BucketSignature(batch=ladder[-1], nf=nf, nt=nt,
+                                   dtype=dtype, axes_digest=axes,
+                                   cfg_digest=cfgd, chunked=True))
+    return out
+
+
+def catalog_digest(keys) -> str:
+    """Stable digest of a compiled catalog — the warm-cache artifact's
+    identity.  ``keys`` are the compile-cache step keys (which already
+    fold in axes, config, mesh, dtype, donation and the jax/jaxlib/
+    backend versions), so two catalogs digest equal iff they compile
+    the exact same program set."""
+    h = hashlib.sha256()
+    for k in sorted(str(k) for k in keys):
+        h.update(k.encode())
+        h.update(b"\n")
+    return h.hexdigest()[:16]
+
+
+def pad_waste(real_lanes: int, issued_lanes: int) -> float:
+    """Padded-elements / real-elements ratio of one bucket execution —
+    the over-padding visibility metric ``trace report`` surfaces per
+    catalog entry (0.0 = perfect fill)."""
+    if real_lanes <= 0:
+        return 0.0
+    return round(max(issued_lanes - real_lanes, 0) / real_lanes, 4)

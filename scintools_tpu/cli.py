@@ -139,6 +139,8 @@ def cmd_process(args) -> int:
                             "--pad-chunks"),
                            (getattr(args, "no_async", False),
                             "--no-async"),
+                           (getattr(args, "bucket", False),
+                            "--bucket"),
                            (getattr(args, "precision", "f32") != "f32",
                             "--precision"),
                            (getattr(args, "fft_lens", "pow2") != "pow2",
@@ -397,7 +399,8 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
                     epochs, pcfg, mesh=mesh,
                     chunk=getattr(args, "chunk_epochs", None),
                     async_exec=not getattr(args, "no_async", False),
-                    pad_chunks=getattr(args, "pad_chunks", False))
+                    pad_chunks=getattr(args, "pad_chunks", False),
+                    bucket=getattr(args, "bucket", False))
         except Exception as e:
             log_event(log, "pipeline_failed", error=repr(e),
                       epochs=len(epochs))
@@ -549,6 +552,7 @@ def cmd_warmup(args) -> int:
 
     Prints one JSON line: cache dir + per-signature status/compile time.
     """
+    import os
     import time
 
     from . import compile_cache
@@ -572,67 +576,107 @@ def cmd_warmup(args) -> int:
         return 1
     pcfg = _pipeline_config_from_args(args)
     mesh_shape = getattr(args, "mesh", None)
-    mesh = (make_mesh(tuple(int(x) for x in mesh_shape)) if mesh_shape
-            else make_mesh())
+    # the compiled signature INCLUDES the mesh: --no-mesh warms the
+    # MESHLESS signatures a default `serve` worker executes (serve
+    # without --mesh runs run_pipeline(mesh=None)), while the default
+    # here mirrors `process --batched` (full local mesh)
+    if getattr(args, "no_mesh", False):
+        if mesh_shape:
+            raise SystemExit("--no-mesh and --mesh are mutually "
+                             "exclusive")
+        mesh = None
+    else:
+        mesh = (make_mesh(tuple(int(x) for x in mesh_shape))
+                if mesh_shape else make_mesh())
     chan = _resolve_chan_sharded(mesh, None)
     chunk = getattr(args, "chunk_epochs", None)
     pad_chunks = getattr(args, "pad_chunks", False)
+    catalog = getattr(args, "catalog", False)
     plans = compile_cache.plan_steps(epochs, pcfg, mesh=mesh, chunk=chunk,
                                     pad_chunks=pad_chunks,
-                                    batch=args.batch)
+                                    batch=args.batch, catalog=catalog)
     import jax
 
     sigs = []
+    keys = []
     for freqs, times, bshape, dtype, chunked in plans:
         donate = _resolve_donate(not getattr(args, "no_async", False),
                                  chunked, mesh)
         key = compile_cache.step_key(freqs, times, pcfg, mesh, chan,
                                      bshape, dtype, donate=donate)
+        keys.append(key)
         sig = {"shape": list(bshape), "key": key}
         t0 = time.perf_counter()
         spec_sharding = (mesh_mod.data_sharding(mesh, chan)
                          if mesh is not None else None)
+        spec = jax.ShapeDtypeStruct(
+            tuple(bshape), jax.dtypes.canonicalize_dtype(dtype),
+            sharding=spec_sharding)
         # --force first: a load under --force would memoize the stale
         # artifact and defeat the re-export
         fn = None if args.force else compile_cache.load_step(key,
                                                             count=False)
+        if fn is not None and hasattr(fn, "lower"):
+            # StableHLO-only cache (written before the serialized-
+            # executable layer existed): treat as uncached so the
+            # .jaxexec fast path gets BACKFILLED — otherwise a re-warm
+            # of an old cache ships an artifact whose "warm" pods still
+            # pay the full XLA compile
+            fn = None
         if fn is not None:
             sig["status"] = "cached"
-            # the AOT artifact has no eviction but the XLA persistent
-            # cache does: recompile the deserialized module anyway —
-            # near-free on a warm cache, and it REPAIRS an evicted
-            # entry instead of letting the survey pay the full compile
-            fn.lower(jax.ShapeDtypeStruct(
-                tuple(bshape), jax.dtypes.canonicalize_dtype(dtype),
-                sharding=spec_sharding)).compile()
+            # the AOT artifacts have no eviction pressure from XLA, but
+            # the persistent XLA cache does: recompile the LIVE step —
+            # its fingerprint is cross-process stable, so this repairs
+            # an evicted entry for consumers that fall back to the jit
+            # path; near-free (retrace + disk hit) on a warm cache
+            step = make_pipeline(freqs, times, pcfg, mesh=mesh,
+                                 chan_sharded=chan, donate=donate)
+            step.lower(spec).compile()
         else:
             step = make_pipeline(freqs, times, pcfg, mesh=mesh,
                                  chan_sharded=chan, donate=donate)
+            # preferred artifact: the COMPILED executable (zero retrace
+            # AND zero compile on load — the fresh-pod fast path; its
+            # lower().compile() also lands the live step's XLA entry in
+            # the persistent cache), plus the StableHLO export as the
+            # portable fallback layer
+            exec_path = compile_cache.export_executable(
+                step, bshape, dtype, key, sharding=spec_sharding)
             path = compile_cache.export_step(step, bshape, dtype, key)
-            if path is None:
-                # export unsupported for this step/sharding: still warm
-                # the persistent XLA cache through the plain jit path
+            if exec_path is None and path is None:
+                # serialization unsupported for this step/sharding:
+                # still warm the persistent XLA cache via the jit path
                 sig["status"] = "xla-cache-only"
-                spec = jax.ShapeDtypeStruct(
-                    tuple(bshape), jax.dtypes.canonicalize_dtype(dtype))
                 step.lower(spec).compile()
             else:
                 sig["status"] = "exported"
-                # compile the DESERIALIZED module (not the live step):
-                # that is the exact program the survey process will ask
-                # XLA for, so the persistent-cache fingerprints match
-                fn = compile_cache.load_step(key, count=False)
-                fn.lower(jax.ShapeDtypeStruct(
-                    tuple(bshape), jax.dtypes.canonicalize_dtype(dtype),
-                    sharding=spec_sharding)).compile()
+                sig["artifacts"] = ([os.path.basename(p)
+                                     for p in (exec_path, path)
+                                     if p is not None])
+                if exec_path is None:
+                    # executable layer unavailable: at least leave the
+                    # live step's XLA entry behind for the jit fallback
+                    step.lower(spec).compile()
         sig["compile_s"] = round(time.perf_counter() - t0, 3)
         sigs.append(sig)
         log_event(log, "warmup_signature", **{k: v for k, v in sig.items()
                                               if k != "shape"},
                   shape="x".join(str(s) for s in bshape))
-    print(json.dumps({"cache_dir": cache, "jax": jax.__version__,
-                      "backend": jax.default_backend(),
-                      "signatures": sigs, "failed_templates": failed}))
+    out = {"cache_dir": cache, "jax": jax.__version__,
+           "backend": jax.default_backend(),
+           "signatures": sigs, "failed_templates": failed}
+    if catalog:
+        # the catalog's identity, from the step keys themselves (axes +
+        # config + versions all folded in): this is what the warm-cache
+        # artifact (scripts/build_warm_cache.py) is keyed on
+        from .buckets import catalog_digest
+
+        out["catalog_digest"] = catalog_digest(keys)
+    # hygiene: a warmup is the natural growth event — evict LRU entries
+    # beyond the size cap (SCINT_COMPILE_CACHE_MAX_MB) right after it
+    out["evictions"] = compile_cache.enforce_cache_cap()
+    print(json.dumps(out))
     return 0
 
 
@@ -656,7 +700,8 @@ def cmd_serve(args) -> int:
         worker = ServeWorker(queue, batch_size=args.batch,
                              max_wait_s=args.max_wait, lease_s=args.lease,
                              poll_s=args.poll, mesh=mesh,
-                             async_exec=not args.no_async)
+                             async_exec=not args.no_async,
+                             bucket=getattr(args, "bucket", False))
     except ValueError as e:
         # e.g. batch/mesh divisibility — a usage error, not a traceback
         raise SystemExit(str(e))
@@ -1208,6 +1253,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="batched mode: mesh shape (data x chan "
                         "parallelism; CHAN>1 shards the sspec FFT's "
                         "channel axis)")
+    q.add_argument("--bucket", action="store_true",
+                   help="batched mode: canonicalise each shape bucket "
+                        "onto the closed batch-ladder catalog "
+                        "(pad to the nearest rung / chunk at the top "
+                        "rung — only `warmup --catalog` signatures "
+                        "execute; real-lane results byte-identical)")
     _add_perf_policy_flags(q)
     q.set_defaults(fn=cmd_process)
 
@@ -1248,8 +1299,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "differs, which is part of the cache key)")
     q.add_argument("--mesh", type=int, nargs=2, default=None,
                    metavar=("DATA", "CHAN"))
+    q.add_argument("--no-mesh", action="store_true", dest="no_mesh",
+                   help="warm the MESHLESS step signatures (what a "
+                        "`serve` worker without --mesh executes); the "
+                        "default mirrors `process --batched` (full "
+                        "local mesh)")
     q.add_argument("--force", action="store_true",
                    help="re-export even when an artifact already exists")
+    q.add_argument("--catalog", action="store_true",
+                   help="pre-compile the CLOSED shape-bucket catalog "
+                        "(every batch-ladder rung per template setup, "
+                        "scintools_tpu.buckets) instead of this "
+                        "survey's raw sizes — a worker warmed this way "
+                        "serves ANY epoch count with jit_cache_miss=0 "
+                        "when callers canonicalise (--bucket / serve); "
+                        "--batch overrides the ladder top "
+                        "(SCINT_BUCKET_TOP, default 64)")
     _add_perf_policy_flags(q)
     q.set_defaults(fn=cmd_warmup)
 
@@ -1289,6 +1354,12 @@ def build_parser() -> argparse.ArgumentParser:
                    metavar=("DATA", "CHAN"),
                    help="device mesh (as process --batched); --batch "
                         "must divide by DATA")
+    q.add_argument("--bucket", action="store_true",
+                   help="pad partial flushes to the nearest batch-"
+                        "ladder rung (warmup --catalog signatures) "
+                        "instead of the full --batch: less pad waste, "
+                        "same byte-identical results, still zero "
+                        "tracing on a warmed worker")
     q.set_defaults(fn=cmd_serve)
 
     q = sub.add_parser(

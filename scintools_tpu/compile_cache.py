@@ -1,7 +1,7 @@
 """Persistent fixed-cost amortization: XLA compile cache + AOT step export.
 
 BENCH_r05 measured 324.7 s of XLA compilation against 0.54 s of useful
-device time — a fresh process is >99.8 % fixed cost.  Two mechanisms,
+device time — a fresh process is >99.8 % fixed cost.  Three mechanisms,
 layered (the compile-cache discipline GPU pulsar pipelines use to hide
 host costs behind the FFT engine — arXiv:1711.10855, arXiv:1804.05335):
 
@@ -10,15 +10,28 @@ host costs behind the FFT engine — arXiv:1711.10855, arXiv:1804.05335):
    deserialized from disk instead of recompiled.  Directory from
    ``SCINT_COMPILE_CACHE`` (default ``~/.cache/scintools_tpu/xla``;
    ``0``/``off`` disables).  Min-compile-time gating keeps trivial
-   programs from spamming the disk.
+   programs from spamming the disk; an LRU size cap
+   (:func:`enforce_cache_cap`, ``SCINT_COMPILE_CACHE_MAX_MB``) bounds
+   growth.  Serves the LIVE jit path: a warmed-then-restarted process
+   pays retrace but not compile (the live step's cache fingerprint is
+   cross-process stable).
 2. **AOT export of the jit'd pipeline step** (:func:`export_step` /
    :func:`load_step`) — ``jax.export`` StableHLO artifacts keyed on
    (freqs/times digest, PipelineConfig, mesh shape, batch shape, dtype,
-   jax/backend version, x64 flag), so a fresh process *deserializes* the
-   step instead of re-tracing it.  Layer 2 removes the trace+lower cost;
-   layer 1 removes the XLA compile cost of the deserialized module
-   (warmup compiles exactly the program the loading process will ask
-   for, so the persistent-cache fingerprints match).
+   jax/backend version, x64 flag), so a fresh process *deserializes*
+   the step instead of re-tracing it.  Removes the trace+lower cost
+   only: re-lowering a DESERIALIZED module embeds process-history-
+   dependent bytes, so its XLA fingerprint is not reliably served by
+   layer 1 across processes (measured: ~40 s residual compile at the
+   256x256 survey signature).
+3. **Serialized executables** (:func:`export_executable`) — the
+   COMPILED step itself (``jax.experimental.serialize_executable``),
+   keyed identically.  :func:`load_step` prefers this layer: a fresh
+   pod deserializes and RUNS — no retrace, no compile (measured
+   ~0.3 s at the same signature).  Together with the warm-cache
+   artifact (:func:`pack_warm_cache` / :func:`unpack_warm_cache`,
+   ``scripts/build_warm_cache.py``) this is the cold-start kill:
+   cold pod -> first result in seconds.
 
 Artifacts are written by ``scintools-tpu warmup`` (cli.py) and loaded
 opportunistically by :func:`scintools_tpu.parallel.run_pipeline`; a
@@ -44,6 +57,17 @@ DEFAULT_DIR = "~/.cache/scintools_tpu/xla"
 _DISABLED_VALUES = ("", "0", "off", "none", "disabled", "false")
 # artifact format version: bump to invalidate every existing artifact
 _FORMAT = 1
+
+# cache hygiene: total on-disk size cap with LRU (mtime) eviction —
+# the persistent cache grows one file per compiled signature forever
+# otherwise.  Env knob in MB; 0/off disables the cap.
+CAP_ENV = "SCINT_COMPILE_CACHE_MAX_MB"
+DEFAULT_CAP_MB = 4096
+
+# warm-cache artifact manifest, written by pack_warm_cache into the
+# cache dir (and into the tarball) so a fresh pod can verify the
+# artifact matches its runtime before trusting it
+MANIFEST_NAME = "MANIFEST.json"
 
 
 def cache_dir() -> str | None:
@@ -88,10 +112,29 @@ def enable_persistent_cache(path: str | None = None) -> str | None:
         if "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES" not in os.environ:
             jax.config.update(
                 "jax_persistent_cache_min_entry_size_bytes", 0)
-        return d
     except Exception:
         # the cache is an optimisation: never fail the pipeline over it
         return None
+    # hygiene: bound the cache's disk footprint once per process (LRU
+    # eviction; outside the try so a typo'd SCINT_COMPILE_CACHE_MAX_MB
+    # fails loudly instead of silently disabling the cache).  Cap ONLY
+    # the directory this package OWNS — the explicit ``path`` or the
+    # SCINT_COMPILE_CACHE resolution — never an ambient
+    # JAX_COMPILATION_CACHE_DIR override, which may be a machine-wide
+    # cache shared with other jax projects whose files we must not
+    # delete.
+    global _CAP_ENFORCED
+    if not _CAP_ENFORCED:
+        # latch only AFTER a successful pass: a caller that catches the
+        # ValueError from a typo'd cap, fixes os.environ and retries
+        # must not find enforcement permanently disarmed (the same
+        # latch-before-success class faults.install_env fixed)
+        enforce_cache_cap(path if path is not None else cache_dir())
+        _CAP_ENFORCED = True
+    return d
+
+
+_CAP_ENFORCED = False
 
 
 _SERIALIZATION_DONE = False
@@ -195,6 +238,67 @@ def artifact_path(key: str) -> str | None:
     return None if d is None else os.path.join(d, key + ".jaxexport")
 
 
+def artifact_exec_path(key: str) -> str | None:
+    d = aot_dir()
+    return None if d is None else os.path.join(d, key + ".jaxexec")
+
+
+def export_executable(step, batch_shape, dtype, key: str,
+                      sharding=None) -> str | None:
+    """Compile ``step`` for one input signature and persist the
+    COMPILED executable (``jax.experimental.serialize_executable``:
+    pickled payload + in/out trees) under ``key`` — the artifact
+    :func:`load_step` prefers.
+
+    Why a third layer: a ``jax.export`` StableHLO artifact still pays
+    XLA compilation on load, and that compile's persistent-cache
+    fingerprint is NOT cross-process stable for a deserialized module
+    (re-lowering it embeds process-history-dependent bytes — measured:
+    a warmed 256x256 step still cost ~40 s on a fresh pod).  The
+    serialized EXECUTABLE skips retrace AND compile entirely: a fresh
+    pod deserializes and runs (measured ~0.3 s).  The ``step.lower().
+    compile()`` here also lands in the persistent XLA cache under the
+    LIVE step's fingerprint, which IS cross-process stable — the
+    fallback layer when the executable artifact is absent or
+    unreadable.
+
+    The payload is pickle: artifacts are operator-produced trusted
+    inputs (the warm-cache manifest verifies provenance/version skew,
+    not malice) — never load one from an untrusted source.  Returns
+    the artifact path, or None when the cache is disabled or
+    serialization is unsupported for this step/backend."""
+    path = artifact_exec_path(key)
+    if path is None:
+        return None
+    try:
+        import pickle
+
+        import jax
+        from jax.experimental import serialize_executable as se
+
+        _register_serialization()
+        spec = jax.ShapeDtypeStruct(
+            tuple(int(s) for s in batch_shape),
+            jax.dtypes.canonicalize_dtype(dtype), sharding=sharding)
+        compiled = step.lower(spec).compile()
+        data = pickle.dumps(se.serialize(compiled))
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, path)
+        # memoize the live Compiled under the artifact path: it IS what
+        # the file deserializes to, and same-process deserialization of
+        # a just-serialized CPU executable can hit an XLA "Symbols not
+        # found" collision with the process's own compiled symbols
+        # (cross-process loads — the artifact's whole point — are
+        # unaffected; verified both directions)
+        _LOADED[path] = compiled
+        return path
+    except Exception:
+        return None
+
+
 def export_step(step, batch_shape, dtype, key: str) -> str | None:
     """AOT-lower ``step`` for one input signature and persist the
     serialized jax.export artifact under ``key``.  Returns the artifact
@@ -278,31 +382,64 @@ _LOADED: dict = {}
 
 
 def load_step(key: str, count: bool = True):
-    """Deserialize the AOT artifact for ``key`` into a jit'd callable,
-    or None when absent/unreadable.  Increments ``compile_cache_hit`` /
+    """Materialise the warm artifact for ``key``, or None when
+    absent/unreadable.  Increments ``compile_cache_hit`` /
     ``compile_cache_miss`` (obs counters, no-ops when tracing is off)
     unless ``count=False``.
 
-    The returned callable is ``jax.jit`` of the deserialized module's
-    call: its first invocation pays XLA compile of the StableHLO, which
-    the persistent compilation cache serves from disk when ``warmup``
-    populated it (warmup compiles via this same loader, so the
-    fingerprints match)."""
+    Two layers, preferred in order:
+
+    1. **Serialized executable** (``<key>.jaxexec``,
+       :func:`export_executable`) — deserialize_and_load returns a
+       ready ``Compiled``: no retrace, no XLA compile (the true warm
+       start; measured ~0.3 s at the 256x256 survey signature).
+    2. **jax.export StableHLO** (``<key>.jaxexport``,
+       :func:`export_step`) — ``jax.jit`` of the deserialized module's
+       call: skips retrace, but its first invocation pays XLA compile
+       (the persistent cache only sometimes serves it: re-lowering a
+       deserialized module embeds process-history-dependent bytes, so
+       the fingerprint is not reliably cross-process stable)."""
+    epath = artifact_exec_path(key)
     path = artifact_path(key)
-    if path is None:
+    if epath is None and path is None:
         return None
-    if not os.path.exists(path):
+    for p in (epath, path):
+        cached = _LOADED.get(p)
+        if cached is not None:
+            # refresh LRU recency on MEMO hits too: a resident worker
+            # serves from _LOADED for days, and its hottest artifact
+            # must not age into another process's eviction pass
+            _touch(p)
+            if count:
+                obs.inc("compile_cache_hit")
+            return cached
+    if epath is not None and os.path.exists(epath):
+        _touch(epath)  # LRU recency: a served artifact stays young
+        try:
+            # chaos site: a corrupt/unreadable artifact must degrade to
+            # the jit path (counted as a miss), never fail the survey
+            faults.check("compile_cache.load")
+            import pickle
+
+            from jax.experimental import serialize_executable as se
+
+            _register_serialization()
+            _prime_ffi_registrations()
+            with open(epath, "rb") as fh:
+                payload, in_tree, out_tree = pickle.load(fh)
+            fn = se.deserialize_and_load(payload, in_tree, out_tree)
+            _LOADED[epath] = fn
+            if count:
+                obs.inc("compile_cache_hit")
+            return fn
+        except Exception:
+            pass  # degrade to the StableHLO layer below
+    if path is None or not os.path.exists(path):
         if count:
             obs.inc("compile_cache_miss")
         return None
-    cached = _LOADED.get(path)
-    if cached is not None:
-        if count:
-            obs.inc("compile_cache_hit")
-        return cached
+    _touch(path)
     try:
-        # chaos site: a corrupt/unreadable artifact must degrade to the
-        # jit path (counted as a miss), never fail the survey
         faults.check("compile_cache.load")
         import jax
         from jax import export
@@ -325,7 +462,8 @@ def load_step(key: str, count: bool = True):
 
 
 def plan_steps(epochs, config, mesh=None, chunk: int | None = None,
-               pad_chunks: bool = False, batch: int | None = None) -> list:
+               pad_chunks: bool = False, batch: int | None = None,
+               catalog: bool = False) -> list:
     """The exact step signatures a ``run_pipeline(epochs, config, mesh,
     chunk=..., pad_chunks=...)`` call will execute, as
     ``[(freqs, times, (b, nf, nt), dtype, chunked), ...]`` — shares the
@@ -335,7 +473,15 @@ def plan_steps(epochs, config, mesh=None, chunk: int | None = None,
     (which decides input donation — part of the cache key).
 
     ``batch`` overrides each bucket's epoch count (warm up for the
-    production survey size from a few template files)."""
+    production survey size from a few template files).
+
+    ``catalog=True`` plans the CLOSED bucket catalog instead of this
+    survey's raw sizes: every ladder rung per axes bucket
+    (scintools_tpu.buckets — ``batch`` overrides the ladder top), plus
+    the top rung's chunk-loop variant (donation differs there on TPU).
+    A worker warmed this way serves ANY epoch count of these observing
+    setups with ``jit_cache_miss == 0`` when the caller canonicalises
+    (``run_pipeline(bucket=True)`` / the serve batcher)."""
     from .parallel import driver as drv
     from .parallel import mesh as mesh_mod
 
@@ -343,6 +489,29 @@ def plan_steps(epochs, config, mesh=None, chunk: int | None = None,
     if mesh is not None:
         multiple = mesh.shape[mesh_mod.DATA_AXIS]
     plans = []
+    if catalog:
+        from . import buckets as buckets_mod
+
+        sdt = drv.stage_dtype(config.precision)
+        # ladder top: an explicit batch wins; else an explicit chunk
+        # caps it exactly as run_pipeline(bucket=True, chunk=...) does
+        # (adjusted DOWN to a mesh multiple — a warmup must compile the
+        # precise signatures a chunk-capped bucketed survey executes)
+        top = batch
+        if top is None and chunk is not None:
+            top = drv._adjust_chunk(multiple, chunk)
+        for key in drv._bucket_epochs(epochs):
+            (nf,), (nt,) = key[0], key[1]
+            freqs = np.frombuffer(key[2]).reshape(key[0])
+            times = np.frombuffer(key[3]).reshape(key[1])
+            ladder = buckets_mod.batch_ladder(multiple, top=top)
+            for b in ladder:
+                plans.append((freqs, times, (b, nf, nt), sdt, False))
+            # the top rung also runs through the chunk loop (surveys
+            # larger than the catalog top), where input donation
+            # differs on TPU — its own cache key, warmed explicitly
+            plans.append((freqs, times, (ladder[-1], nf, nt), sdt, True))
+        return plans
     for key, idx in drv._bucket_epochs(epochs).items():
         (nf,), (nt,) = key[0], key[1]
         n = batch if batch is not None else len(idx)
@@ -359,3 +528,255 @@ def plan_steps(epochs, config, mesh=None, chunk: int | None = None,
             plans.append((freqs, times, (b, nf, nt),
                           drv.stage_dtype(config.precision), chunked))
     return plans
+
+
+# ---------------------------------------------------------------------------
+# cache hygiene: size cap + LRU eviction
+# ---------------------------------------------------------------------------
+
+
+def _touch(path: str) -> None:
+    """Refresh a cache file's mtime (LRU recency for the cap walk)."""
+    try:
+        os.utime(path, None)
+    except OSError:
+        pass
+
+
+def cache_cap_bytes() -> int | None:
+    """The configured cache size cap in bytes, or None when disabled
+    (``SCINT_COMPILE_CACHE_MAX_MB=0``/``off``)."""
+    val = os.environ.get(CAP_ENV)
+    if val is not None and val.strip().lower() in _DISABLED_VALUES:
+        return None
+    try:
+        mb = int(val) if val is not None else DEFAULT_CAP_MB
+    except ValueError:
+        raise ValueError(f"{CAP_ENV} must be an integer MB count, "
+                         f"got {val!r}")
+    return None if mb <= 0 else mb * (1 << 20)
+
+
+def enforce_cache_cap(path: str | None = None,
+                      cap_bytes: int | None = None) -> int:
+    """Evict least-recently-used cache files until the directory's
+    total size fits the cap (``SCINT_COMPILE_CACHE_MAX_MB``, default
+    4096 MB; 0/off disables).  Returns the number of files evicted and
+    increments the ``compile_cache_evictions`` counter.
+
+    LRU order is file mtime.  The ``.jaxexec``/``.jaxexport`` artifacts
+    — the layer warm consumers actually serve from — are touched by
+    ``load_step`` on every hit, so hot signatures stay young; XLA's own
+    persistent-cache entries are only written on a compile MISS (jax
+    does not touch them on a hit), so for those the order degrades to
+    FIFO — an unpacked warm-cache artifact starts young
+    (``unpack_warm_cache`` refreshes mtimes) but a long-lived pod that
+    churns many one-off programs can age the catalog's XLA entries out,
+    which costs the JIT-FALLBACK path a recompile (the executable
+    artifacts still serve).  The artifact manifest (MANIFEST.json) is
+    exempt — provenance must outlive eviction."""
+    d = path if path is not None else cache_dir()
+    cap = cap_bytes if cap_bytes is not None else cache_cap_bytes()
+    if d is None or cap is None or not os.path.isdir(d):
+        return 0
+    entries = []
+    total = 0
+    for root, _dirs, files in os.walk(d):
+        for name in files:
+            if name == MANIFEST_NAME:
+                continue
+            p = os.path.join(root, name)
+            try:
+                st = os.stat(p)
+            except OSError:
+                continue
+            entries.append((st.st_mtime, st.st_size, p))
+            total += st.st_size
+    if total <= cap:
+        return 0
+    evicted = 0
+    for _mtime, size, p in sorted(entries):
+        if total <= cap:
+            break
+        try:
+            os.remove(p)
+        except OSError:
+            continue
+        _LOADED.pop(p, None)   # a memoized step must not outlive its file
+        total -= size
+        evicted += 1
+    if evicted:
+        obs.inc("compile_cache_evictions", evicted)
+    return evicted
+
+
+# ---------------------------------------------------------------------------
+# warm-cache artifact: pack / verify / unpack
+# ---------------------------------------------------------------------------
+
+
+def _env_fingerprint() -> dict:
+    """The runtime identity a warm cache is only valid for: XLA's
+    serialized executables are keyed (by jax itself and by our step
+    keys) on the jax/jaxlib versions and backend platform, and the AOT
+    artifacts additionally on this package's source tree."""
+    import jax
+    import jaxlib
+
+    return {"jax": jax.__version__, "jaxlib": jaxlib.__version__,
+            "backend": jax.default_backend(),
+            "source_fp": _source_fingerprint()}
+
+
+def manifest_path(cache: str | None = None) -> str | None:
+    d = cache if cache is not None else cache_dir()
+    return None if d is None else os.path.join(d, MANIFEST_NAME)
+
+
+def artifact_manifest(cache: str | None = None) -> dict | None:
+    """The manifest of the warm-cache artifact this cache dir was
+    packed from / unpacked to, or None when the cache was never
+    associated with one."""
+    p = manifest_path(cache)
+    if p is None or not os.path.exists(p):
+        return None
+    try:
+        with open(p) as fh:
+            man = json.load(fh)
+        return man if isinstance(man, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def pack_warm_cache(out_path: str, cache: str | None = None,
+                    catalog_digest: str | None = None,
+                    extra: dict | None = None) -> dict:
+    """Pack the (already warmed) persistent cache into a relocatable
+    gzip tarball — the BUILD-ARTIFACT half of the cold-start fix: CI
+    runs ``warmup --catalog`` once, publishes this file, and every
+    fresh pod starts warm by unpacking it (seconds) instead of
+    compiling the catalog (minutes).
+
+    Writes ``MANIFEST.json`` (format, jax/jaxlib/backend versions,
+    package source fingerprint, catalog digest, file count/bytes) into
+    the cache dir first, so the tarball is self-describing and
+    :func:`unpack_warm_cache` can refuse a version-skewed artifact.
+    Returns the manifest."""
+    import tarfile
+    import time as _time
+
+    d = cache if cache is not None else cache_dir()
+    if d is None or not os.path.isdir(d):
+        raise ValueError("pack_warm_cache: no cache directory to pack "
+                         f"({ENV_VAR} disabled or {d!r} missing); run "
+                         "`scintools-tpu warmup --catalog` first")
+    files = []
+    total = 0
+    for root, _dirs, names in os.walk(d):
+        for name in names:
+            if name == MANIFEST_NAME or ".tmp" in name:
+                continue
+            p = os.path.join(root, name)
+            try:
+                total += os.path.getsize(p)
+            except OSError:
+                continue
+            files.append(os.path.relpath(p, d))
+    if not files:
+        raise ValueError(f"pack_warm_cache: {d} holds no cache entries; "
+                         "run `scintools-tpu warmup --catalog` first")
+    man = dict(_env_fingerprint(), format=_FORMAT,
+               files=len(files), bytes=total,
+               created_at=round(_time.time(), 1))
+    if catalog_digest:
+        man["digest"] = str(catalog_digest)
+    if extra:
+        man.update(extra)
+    mp = manifest_path(d)
+    tmp = f"{mp}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(man, fh, indent=1)
+    os.replace(tmp, mp)
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    out_tmp = f"{out_path}.tmp.{os.getpid()}"
+    with tarfile.open(out_tmp, "w:gz") as tar:
+        tar.add(mp, arcname=MANIFEST_NAME)
+        for rel in sorted(files):
+            tar.add(os.path.join(d, rel), arcname=rel)
+    os.replace(out_tmp, out_path)
+    obs.inc("cache_artifact_packed")
+    return man
+
+
+def verify_artifact(manifest: dict) -> list:
+    """Mismatches between an artifact manifest and THIS runtime — empty
+    when the artifact is directly usable.  Each entry is a human-
+    readable ``"field: artifact=X runtime=Y"`` string."""
+    fp = _env_fingerprint()
+    out = []
+    if manifest.get("format") != _FORMAT:
+        out.append(f"format: artifact={manifest.get('format')} "
+                   f"runtime={_FORMAT}")
+    for k, v in fp.items():
+        have = manifest.get(k)
+        if have != v:
+            out.append(f"{k}: artifact={have} runtime={v}")
+    return out
+
+
+def unpack_warm_cache(tar_path: str, cache: str | None = None,
+                      force: bool = False) -> dict:
+    """Unpack a warm-cache artifact into the persistent cache dir (the
+    fresh-pod cold-start path: unpack, then serve/process — the first
+    step deserializes instead of compiling).
+
+    The embedded manifest is verified against THIS runtime's
+    jax/jaxlib/backend versions and package source fingerprint before
+    any file is extracted; a mismatch raises ``ValueError`` (counted as
+    ``cache_artifact_rejected``) unless ``force=True`` — a skewed cache
+    is not dangerous (keys miss and the program recompiles) but it is a
+    silent return to minutes-long cold starts, which must be loud.
+    Member paths are validated (no absolute paths, no ``..``).
+    Returns the manifest."""
+    import tarfile
+
+    d = cache if cache is not None else cache_dir()
+    if d is None:
+        raise ValueError(f"unpack_warm_cache: {ENV_VAR} is disabled; "
+                         "nowhere to unpack")
+    with tarfile.open(tar_path, "r:gz") as tar:
+        try:
+            fh = tar.extractfile(MANIFEST_NAME)
+            if fh is None:  # a non-file member under the manifest name
+                raise ValueError("manifest member is not a file")
+            man = json.load(fh)
+        except (KeyError, ValueError, TypeError):
+            raise ValueError(f"{tar_path}: not a warm-cache artifact "
+                             f"(no readable {MANIFEST_NAME})")
+        mismatches = verify_artifact(man)
+        if mismatches and not force:
+            obs.inc("cache_artifact_rejected")
+            raise ValueError(
+                f"{tar_path}: artifact does not match this runtime "
+                f"({'; '.join(mismatches)}); rebuild it with "
+                "scripts/build_warm_cache.py, or pass force=True to "
+                "unpack anyway (stale keys simply miss and recompile)")
+        os.makedirs(d, exist_ok=True)
+        for member in tar.getmembers():
+            name = member.name
+            if (name != os.path.normpath(name) or name.startswith(("/", ".."))
+                    or os.path.isabs(name)
+                    or not (member.isfile() or member.isdir())):
+                raise ValueError(f"{tar_path}: unsafe member {name!r}")
+        tar.extractall(d)
+        members = [m.name for m in tar.getmembers() if m.isfile()]
+    obs.inc("cache_artifact_unpacked")
+    # the unpacked entries carry the pack-time mtimes; refresh ONLY the
+    # extracted members so a capped cache does not immediately evict
+    # the artifact it was just seeded with — pre-existing entries keep
+    # their true (older) recency, or the next eviction pass would pick
+    # victims in arbitrary order and could delete the fresh seed
+    for name in members:
+        _touch(os.path.join(d, name))
+    enforce_cache_cap(d)
+    return man

@@ -26,7 +26,8 @@ from .core import (Registry, counters, disable, enable,  # noqa: F401
                    render_summary, reset, span, summary, traced, tracing)
 from .jax_helpers import (bytes_of, fence,  # noqa: F401
                           instrument_jit, xla_cost_analysis)
-from .report import (aggregate, compile_split, load_events,  # noqa: F401
+from .report import (aggregate, catalog_section,  # noqa: F401
+                     compile_profile, compile_split, load_events,
                      measured_roofline, reliability_section, render,
                      report, serve_section)
 from .sinks import JsonlSink, LogSink  # noqa: F401

@@ -163,6 +163,18 @@ def instrument_jit(fn, name: str, aot: bool = False):
     compiled_cache: dict = {}
     cost_memo: dict = {}
     compile_span = name + (".compile.warm" if aot else ".compile")
+    compile_mode = "warm" if aot else "cold"
+
+    def _profile_compile(key, sp, mode: str | None = None) -> None:
+        # per-stage compile attribution: which jit'd stage/signature
+        # the remaining compile wall time belongs to, as an
+        # accumulating counter `compile_ms[<stage>:<sig>:<cold|warm>]`
+        # (trace report's compile-profile section; span tables only
+        # aggregate by name, which loses the signature)
+        ms = getattr(sp, "dur_ms", None)
+        if ms is not None:
+            core.inc(f"compile_ms[{name}:{_sig_label(key)}"
+                     f":{mode or compile_mode}]", round(ms, 3))
 
     def traced_call(*args, **kwargs):
         import jax
@@ -198,13 +210,17 @@ def instrument_jit(fn, name: str, aot: bool = False):
             # jit; fall back rather than fail the pipeline, and remember
             # the fallback so later calls do not re-pay the failed
             # dispatch.  The failed .execute span records with an error
-            # attr; the fallback runs under a .compile span (it pays
-            # jit's trace+compile) so execute rows stay uncontaminated.
+            # attr; the fallback pays jit's FULL trace+compile, so it
+            # records under the COLD span/profile even on an aot
+            # wrapper — a fleet whose artifacts fail to load must show
+            # up as cold-compile regression, not as "warm" time.
             compiled_cache[key] = fn
-            with core.span(compile_span, signature=str(key)[:200],
-                           includes_first_execute=True):
+            with core.span(name + ".compile", signature=str(key)[:200],
+                           includes_first_execute=True,
+                           aot_fallback=aot) as sp:
                 out = fn(*args, **kwargs)
                 jax.block_until_ready(out)
+            _profile_compile(key, sp, mode="cold")
             return out
 
     def _compile(key, *args, **kwargs):
@@ -214,8 +230,9 @@ def instrument_jit(fn, name: str, aot: bool = False):
         if lower is not None:
             try:
                 with core.span(compile_span,
-                               signature=str(key)[:200]):
+                               signature=str(key)[:200]) as sp:
                     executable = lower(*args, **kwargs).compile()
+                _profile_compile(key, sp)
                 compiled_cache[key] = executable
                 # measured roofline source: XLA's own per-execution
                 # flop/byte counts for this exact signature
@@ -227,9 +244,10 @@ def instrument_jit(fn, name: str, aot: bool = False):
         # call IS trace+compile+execute; record it as compile so the
         # steady-state .execute rows stay uncontaminated
         with core.span(compile_span, signature=str(key)[:200],
-                       includes_first_execute=True):
+                       includes_first_execute=True) as sp:
             out = fn(*args, **kwargs)
             jax.block_until_ready(out)
+        _profile_compile(key, sp)
         return (None, out)
 
     def wrapper(*args, **kwargs):

@@ -82,6 +82,82 @@ def compile_split(spans: dict, counters: dict | None = None) -> dict | None:
     }
 
 
+def compile_profile(counters: dict | None,
+                    gauges: dict | None = None) -> dict | None:
+    """Per-stage compile attribution from the ``compile_ms[<stage>:
+    <sig>:<cold|warm>]`` counters (recorded by ``obs.instrument_jit``
+    per compiled signature): which jit'd stage/signature dominates the
+    remaining compile wall time, split cold (full XLA) vs warm
+    (persistent-cache / AOT-deserialized), plus warm-cache-artifact
+    provenance (the ``compile_cache_artifact`` gauge set by
+    ``run_pipeline`` when the cache dir carries a MANIFEST, and the
+    ``cache_artifact_unpacked`` / ``cache_artifact_rejected``
+    counters).  None when the trace carries no compile attribution."""
+    counters = counters or {}
+    gauges = gauges or {}
+    stages: dict[str, dict] = {}
+    for name, value in counters.items():
+        if not (name.startswith("compile_ms[") and name.endswith("]")):
+            continue
+        label = name[len("compile_ms["):-1]
+        rest, _, mode = label.rpartition(":")
+        stage, _, sig = rest.partition(":")
+        if mode not in ("cold", "warm") or not stage:
+            continue
+        row = stages.setdefault(stage, {"cold_ms": 0.0, "warm_ms": 0.0,
+                                        "signatures": {}})
+        row[f"{mode}_ms"] = round(row[f"{mode}_ms"] + float(value), 3)
+        srow = row["signatures"].setdefault(
+            sig or "scalar", {"cold_ms": 0.0, "warm_ms": 0.0})
+        srow[f"{mode}_ms"] = round(srow[f"{mode}_ms"] + float(value), 3)
+    artifact = {
+        "digest": gauges.get("compile_cache_artifact"),
+        "unpacked": int(counters.get("cache_artifact_unpacked", 0)),
+        "rejected": int(counters.get("cache_artifact_rejected", 0)),
+        "evictions": int(counters.get("compile_cache_evictions", 0)),
+    }
+    if not stages and artifact["digest"] is None \
+            and not (artifact["unpacked"] or artifact["rejected"]
+                     or artifact["evictions"]):
+        return None
+    return {"stages": stages, "artifact": artifact}
+
+
+def catalog_section(counters: dict | None,
+                    gauges: dict | None = None) -> dict | None:
+    """Shape-bucket catalog fill (scintools_tpu.buckets): per compiled
+    signature, how many batches hit it this run, the real vs padded
+    lane split and the pad-waste ratio (padded / real elements), plus
+    catalog entries that exist but were never hit — so over-padding and
+    dead rungs are visible rather than silent.  None when the run
+    never bucketed."""
+    counters = counters or {}
+    gauges = gauges or {}
+
+    def _bracketed(src, prefix):
+        return {name[len(prefix):-1]: v for name, v in src.items()
+                if name.startswith(prefix) and name.endswith("]")}
+
+    hits = _bracketed(counters, "bucket_hits[")
+    real = _bracketed(counters, "bucket_lanes_real[")
+    pad = _bracketed(counters, "bucket_lanes_pad[")
+    exist = _bracketed(gauges, "bucket_catalog[")
+    if not hits and not exist:
+        return None
+    from ..buckets import pad_waste
+
+    rows = {}
+    for label in sorted(set(hits) | set(exist)):
+        r, p = int(real.get(label, 0)), int(pad.get(label, 0))
+        rows[label] = {
+            "hits": int(hits.get(label, 0)),
+            "lanes_real": r,
+            "lanes_pad": p,
+            "pad_waste": pad_waste(r, r + p),
+        }
+    return rows
+
+
 def measured_roofline(gauges: dict | None) -> dict | None:
     """Per-signature MEASURED step costs from the ``step_flops[...]`` /
     ``step_bytes[...]`` gauges (XLA cost analysis, recorded by
@@ -226,6 +302,55 @@ def render(spans: dict, counters: dict | None = None,
         lines.append(f"  compile_cache_hit = {split['compile_cache_hit']}, "
                      f"compile_cache_miss = {split['compile_cache_miss']}, "
                      f"jit_cache_miss = {split['jit_cache_miss']}")
+    prof = compile_profile(counters, gauges)
+    if prof:
+        lines.append("")
+        lines.append("compile profile (per jit'd stage/signature, "
+                     "cold = full XLA, warm = cache-served):")
+        order = sorted(prof["stages"],
+                       key=lambda s: (prof["stages"][s]["cold_ms"]
+                                      + prof["stages"][s]["warm_ms"]),
+                       reverse=True)
+        for stage in order:
+            row = prof["stages"][stage]
+            lines.append(f"  {stage}: cold_ms = {row['cold_ms']:.3f}, "
+                         f"warm_ms = {row['warm_ms']:.3f}")
+            for sig in sorted(row["signatures"],
+                              key=lambda s: (row["signatures"][s]["cold_ms"]
+                                             + row["signatures"][s]["warm_ms"]),
+                              reverse=True):
+                srow = row["signatures"][sig]
+                lines.append(f"    {sig}: cold_ms = "
+                             f"{srow['cold_ms']:.3f}, warm_ms = "
+                             f"{srow['warm_ms']:.3f}")
+        art = prof["artifact"]
+        if art["digest"] is not None:
+            lines.append(f"  warm-cache artifact: digest = "
+                         f"{art['digest']} (cache seeded from a packed "
+                         "artifact)")
+        elif art["unpacked"] or art["rejected"]:
+            lines.append(f"  warm-cache artifact: unpacked = "
+                         f"{art['unpacked']}, rejected = "
+                         f"{art['rejected']}")
+        else:
+            lines.append("  warm-cache artifact: none "
+                         "(scripts/build_warm_cache.py ships one)")
+        if art["evictions"]:
+            lines.append(f"  compile_cache_evictions = "
+                         f"{art['evictions']}")
+    cat = catalog_section(counters, gauges)
+    if cat:
+        lines.append("")
+        lines.append("shape-bucket catalog (hits / real vs padded lanes "
+                     "/ pad-waste):")
+        for label, row in cat.items():
+            if row["hits"]:
+                lines.append(
+                    f"  {label}: hits = {row['hits']}, lanes = "
+                    f"{row['lanes_real']} real + {row['lanes_pad']} pad, "
+                    f"pad_waste = {row['pad_waste']}")
+            else:
+                lines.append(f"  {label}: in catalog, not hit this run")
     meas = measured_roofline(gauges)
     if meas:
         lines.append("")

@@ -779,7 +779,7 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
                  mesh=None, chunk: int | None = None,
                  chan_sharded: bool | None = None,
                  async_exec: bool = True, pad_chunks: bool = False,
-                 pad_to: int | None = None):
+                 pad_to: int | None = None, bucket: bool = False):
     """Host-side convenience driver: bucket heterogeneous epochs by shape,
     pad each bucket to the mesh's data-axis multiple, run the jit'd step
     per bucket (optionally in memory-bounded chunks), and gather results
@@ -803,6 +803,20 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
     targets instead of tracing a fresh program per fill level.  Must be
     a multiple of the mesh's data-axis size; buckets already at or over
     ``pad_to`` are left alone (the chunk machinery governs them).
+
+    ``bucket`` canonicalises every shape bucket onto the CLOSED batch
+    ladder (scintools_tpu.buckets): the batch pads up to the nearest
+    catalog rung (``pad_to`` semantics), or — above the top rung —
+    chunks at the top rung with uniform-chunk padding, so a survey of
+    ANY epoch count executes only catalog signatures (the ones
+    ``warmup --catalog`` pre-compiled).  Real-lane results stay
+    byte-identical to the unbucketed run (the same mask-invalid-lane
+    machinery).  An explicit ``chunk`` bounds the ladder's top rung
+    (device-memory cap); mutually exclusive with ``pad_to``.  Per-
+    catalog-entry fill is observable: ``bucket_hits[...]`` /
+    ``bucket_lanes_real[...]`` / ``bucket_lanes_pad[...]`` counters and
+    ``bucket_catalog[...]`` gauges feed ``trace report``'s
+    shape-bucket catalog section (pad-waste per bucket).
 
     When the persistent compile cache is enabled (``SCINT_COMPILE_CACHE``,
     on by default — scintools_tpu.compile_cache) each step signature is
@@ -840,19 +854,50 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
             f"pad_to={pad_to} must be a positive multiple of the mesh's "
             f"data-axis size ({multiple}) — the padded batch is the "
             "compiled signature")
+    if bucket and pad_to is not None:
+        raise ValueError(
+            "bucket=True canonicalises each shape bucket's batch onto "
+            "the catalog ladder itself; it is mutually exclusive with "
+            "an explicit pad_to")
+    if bucket:
+        from .. import buckets as buckets_mod
     chan_sharded = _resolve_chan_sharded(mesh, chan_sharded)
     use_cache = compile_cache.cache_dir() is not None
     if use_cache:
         compile_cache.enable_persistent_cache()
+        if obs.enabled():
+            man = compile_cache.artifact_manifest()
+            if man is not None:
+                # provenance: this run's persistent cache came from an
+                # unpacked warm-cache artifact (trace report shows it)
+                obs.gauge("compile_cache_artifact",
+                          str(man.get("digest", "?")))
     results = []
     with obs.span("pipeline.run", epochs=len(epochs)):
         for idx in _bucket_epochs(epochs).values():
+            eff_pad_to, eff_chunk, eff_pad_chunks = pad_to, chunk, pad_chunks
             with obs.span("pipeline.stage", epochs=len(idx)) as stage_sp:
                 group = [epochs[i] for i in idx]
                 batch, _mask = pad_batch(group, batch_multiple=multiple)
                 freqs_np = np.asarray(group[0].freqs)
                 times_np = np.asarray(group[0].times)
                 dyn = np.asarray(batch.dyn)
+                if bucket:
+                    # catalog canonicalisation: pad the (divisibility-
+                    # padded) batch up to the nearest ladder rung, or
+                    # chunk at the top rung with uniform-chunk padding
+                    # — either way only CLOSED-catalog signatures
+                    # execute.  An explicit ``chunk`` caps the ladder
+                    # top: adjusted DOWN to a mesh multiple
+                    # (_adjust_chunk), like the non-bucket path — a
+                    # device-memory bound must never round up
+                    top = (None if chunk is None
+                           else _adjust_chunk(multiple, chunk))
+                    plan = buckets_mod.bucket_plan(dyn.shape[0], multiple,
+                                                   top=top)
+                    eff_pad_to = plan.get("pad_to")
+                    eff_chunk = plan.get("chunk")
+                    eff_pad_chunks = plan.get("pad_chunks", False)
                 if config.arc_stack and not np.all(_mask.epoch):
                     # divisibility pad-lanes are COPIES of the last epoch
                     # (pad_batch) — fine for per-epoch results (sliced off
@@ -860,30 +905,30 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
                     # NaN-fill them so the stacked nanmean drops them
                     dyn = dyn.copy()
                     dyn[~_mask.epoch] = np.nan
-                if pad_to is not None and dyn.shape[0] < pad_to:
+                if eff_pad_to is not None and dyn.shape[0] < eff_pad_to:
                     # fixed-signature padding: extend to exactly pad_to
                     # with mask-invalid lanes (copies of the last epoch;
                     # NaN under arc_stack so the campaign nanmean drops
                     # them), sliced off at gather like divisibility pads
-                    extra = np.repeat(dyn[-1:], pad_to - dyn.shape[0],
+                    extra = np.repeat(dyn[-1:], eff_pad_to - dyn.shape[0],
                                       axis=0)
                     if config.arc_stack:
                         extra = np.full_like(extra, np.nan)
                     dyn = np.concatenate([dyn, extra], axis=0)
                 c = None
-                if chunk is not None and chunk < dyn.shape[0]:
+                if eff_chunk is not None and eff_chunk < dyn.shape[0]:
                     # memory-bounded chunking; chunk must respect mesh
                     # divisibility
-                    c = _adjust_chunk(multiple, chunk)
-                    if c != chunk:
+                    c = _adjust_chunk(multiple, eff_chunk)
+                    if c != eff_chunk:
                         import warnings
 
                         warnings.warn(
-                            f"run_pipeline: chunk={chunk} adjusted to {c} "
-                            f"(the mesh's data axis needs multiples of "
+                            f"run_pipeline: chunk={eff_chunk} adjusted to "
+                            f"{c} (the mesh's data axis needs multiples of "
                             f"{multiple}); size chunk accordingly when "
                             "bounding device memory", stacklevel=2)
-                    if pad_chunks and dyn.shape[0] % c:
+                    if eff_pad_chunks and dyn.shape[0] % c:
                         # uniform-chunk padding: extend the final chunk to
                         # the full chunk size with mask-invalid lanes —
                         # the same pad-lane machinery as divisibility
@@ -909,6 +954,23 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
                                      donate=donate)
                 stage_sp.set(batch_shape=list(dyn.shape),
                              stage_dtype=str(dyn.dtype))
+            if bucket and obs.enabled():
+                # catalog-fill accounting: the executed signature's hit
+                # count and real-vs-padded lanes (pad-waste), plus one
+                # existence gauge per ladder rung so `trace report` can
+                # show unused catalog entries alongside the hit ones
+                sig_b = c if c is not None else dyn.shape[0]
+                label = (f"{sig_b}x{dyn.shape[1]}x{dyn.shape[2]}"
+                         f":{dyn.dtype}")
+                obs.inc(f"bucket_hits[{label}]")
+                obs.inc(f"bucket_lanes_real[{label}]", len(idx))
+                obs.inc(f"bucket_lanes_pad[{label}]",
+                        dyn.shape[0] - len(idx))
+                for r in buckets_mod.batch_ladder(
+                        multiple, top=None if chunk is None
+                        else _adjust_chunk(multiple, chunk)):
+                    obs.gauge(f"bucket_catalog[{r}x{dyn.shape[1]}"
+                              f"x{dyn.shape[2]}:{dyn.dtype}]", 1)
             obs.inc("epochs_processed", len(idx))
             obs.inc("bytes_h2d", transfer_nbytes(dyn))
             # fixed-iteration LM budget actually dispatched for this
@@ -925,7 +987,7 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
             aot = {}
             if use_cache:
                 for b in sorted(_step_batch_sizes(B, multiple, c,
-                                                  pad_chunks=pad_chunks)):
+                                                  pad_chunks=eff_pad_chunks)):
                     fn = compile_cache.load_step(compile_cache.step_key(
                         freqs_np, times_np, config, mesh, chan_sharded,
                         (b,) + dyn.shape[1:], dyn.dtype, donate=donate))

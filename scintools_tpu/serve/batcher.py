@@ -31,7 +31,13 @@ from .queue import Job, cfg_signature
 
 @dataclasses.dataclass(frozen=True)
 class Batch:
-    """One flushable unit: jobs + their loaded epochs, single bucket."""
+    """One flushable unit: jobs + their loaded epochs, single bucket.
+
+    ``pad_to`` is the padded COMPILED signature this flush executes
+    (the worker passes it to ``run_pipeline(pad_to=...)``): the full
+    ``batch_size`` normally, or — under catalog bucketing — the nearest
+    batch-ladder rung, so a 3-job flush pads to 4 lanes instead of 8
+    and still hits a ``warmup --catalog`` signature."""
 
     jobs: tuple
     epochs: tuple
@@ -39,6 +45,7 @@ class Batch:
     key: tuple
     fill_ratio: float
     waited_s: float
+    pad_to: int = 0
 
 
 def bucket_key(cfg: dict, epoch) -> tuple:
@@ -54,15 +61,33 @@ class DynamicBatcher:
     """Accumulates (job, epoch) pairs into shape/config buckets and
     yields :class:`Batch` flushes on max-batch or max-wait."""
 
-    def __init__(self, batch_size: int = 8, max_wait_s: float = 2.0):
+    def __init__(self, batch_size: int = 8, max_wait_s: float = 2.0,
+                 bucket: bool = False, multiple: int = 1):
         if batch_size < 1:
             raise ValueError(f"batch_size must be >= 1, got {batch_size}")
         self.batch_size = int(batch_size)
         self.max_wait_s = float(max_wait_s)
+        # catalog bucketing (scintools_tpu.buckets): partial flushes
+        # pad to the nearest batch-ladder rung <= batch_size instead of
+        # the full batch_size — less pad waste per flush, and every
+        # rung is a `warmup --catalog` signature so the worker still
+        # never traces.  ``multiple`` is the mesh data-axis size the
+        # rungs must divide by.
+        self.bucket = bool(bucket)
+        self.multiple = max(int(multiple), 1)
         # key -> [(added_at, job, epoch), ...] — PER-ITEM stamps, so a
         # tail left over after a full-slice flush waits its own
         # max_wait rather than inheriting the flushed head's deadline
         self._buckets: dict[tuple, list] = {}
+
+    def _pad_to(self, n: int) -> int:
+        """The padded compiled signature an ``n``-job flush executes."""
+        if not self.bucket:
+            return self.batch_size
+        from .. import buckets as buckets_mod
+
+        return buckets_mod.rung_for(n, self.multiple,
+                                    top=self.batch_size)
 
     def add(self, job: Job, epoch: Any, now: float | None = None) -> None:
         now = time.time() if now is None else now
@@ -103,11 +128,13 @@ class DynamicBatcher:
                                items[self.batch_size:])
                 jobs = tuple(j for _, j, _ in take)
                 epochs = tuple(e for _, _, e in take)
+                pad = self._pad_to(len(take))
                 out.append(Batch(
                     jobs=jobs, epochs=epochs, cfg=dict(jobs[0].cfg),
                     key=key,
-                    fill_ratio=len(take) / float(self.batch_size),
-                    waited_s=max(now - take[0][0], 0.0)))
+                    fill_ratio=len(take) / float(pad),
+                    waited_s=max(now - take[0][0], 0.0),
+                    pad_to=pad))
             if items:
                 self._buckets[key] = items
             else:

@@ -121,9 +121,17 @@ def cfg_signature(cfg: dict) -> tuple:
 
     _string_defaults = {"arc_method": "norm_sspec", "precision": "f32",
                         "fft_lens": "pow2"}
+    # execution-placement knobs that change NO result byte: catalog
+    # bucketing pads with mask-invalid lanes the driver slices off
+    # (byte-identical real lanes, tested), so a job submitted by a
+    # bucket-aware client must dedup/batch with the identical job from
+    # a legacy client — strip it from the identity entirely
+    _placement_keys = ("bucket",)
     out = []
     for k, v in sorted((cfg or {}).items()):
         if v is None or v is False:
+            continue
+        if k in _placement_keys:
             continue
         if _string_defaults.get(k) == v:
             continue

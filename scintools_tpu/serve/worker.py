@@ -129,9 +129,11 @@ class ServeWorker:
     def __init__(self, queue: JobQueue, batch_size: int = 8,
                  max_wait_s: float = 2.0, lease_s: float = 60.0,
                  poll_s: float = 0.2, mesh=None, runner=None,
-                 async_exec: bool = True, worker_id: str | None = None):
+                 async_exec: bool = True, worker_id: str | None = None,
+                 bucket: bool = False):
         self.queue = queue
         self.batch_size = int(batch_size)
+        mult = 1
         if mesh is not None:
             from ..parallel import mesh as mesh_mod
 
@@ -149,10 +151,19 @@ class ServeWorker:
         self.poll_s = float(poll_s)
         self.mesh = mesh
         self.async_exec = bool(async_exec)
+        # catalog bucketing: partial flushes pad to the nearest
+        # batch-ladder rung (a `warmup --catalog` signature) instead of
+        # the full batch_size — same results (mask-invalid pad lanes),
+        # less pad waste, still zero tracing on a warmed worker.
+        # Results are byte-identical either way, so the flag is a
+        # WORKER knob, never part of job identity (queue.cfg_signature
+        # strips it defensively).
+        self.bucket = bool(bucket)
         self.runner = runner if runner is not None else pipeline_runner
         self.worker_id = worker_id or f"{os.uname().nodename}:{os.getpid()}"
         self.batcher = DynamicBatcher(batch_size=self.batch_size,
-                                      max_wait_s=self.max_wait_s)
+                                      max_wait_s=self.max_wait_s,
+                                      bucket=self.bucket, multiple=mult)
         self.log = get_logger()
         self.stats = {"batches": 0, "jobs_done": 0, "jobs_failed": 0,
                       "job_retries": 0, "job_transient_retries": 0,
@@ -272,22 +283,26 @@ class ServeWorker:
         import numpy as np
 
         n = len(batch.jobs)
+        # the padded compiled signature this flush executes: the full
+        # batch_size, or — under catalog bucketing — the batcher's
+        # chosen ladder rung (batcher.Batch.pad_to)
+        pad = batch.pad_to or self.batch_size
         # long compiles must not outlive the claim lease mid-execution
         self.queue.renew(batch.jobs, self._claim_lease_s())
         obs.gauge("batch_fill_ratio", round(batch.fill_ratio, 4))
         obs.inc("serve_batches")
         obs.inc("serve_lanes_filled", n)
-        obs.inc("serve_lanes_total", self.batch_size)
+        obs.inc("serve_lanes_total", pad)
         self.stats["batches"] += 1
         self.stats["lanes_filled"] += n
-        self.stats["lanes_total"] += self.batch_size
+        self.stats["lanes_total"] += pad
         try:
             with obs.span("serve.batch", jobs=n,
                           fill=round(batch.fill_ratio, 4)):
                 # chaos site: an infra fault mid-batch (device
                 # preemption, OOM past the driver's backoff floor)
                 faults.check("worker.batch_execute")
-                rows = self.runner(batch, self.batch_size, self.mesh,
+                rows = self.runner(batch, pad, self.mesh,
                                    self.async_exec)
         except Exception as e:
             if faults.classify_error(e) == "transient":

@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""Build / unpack / verify the relocatable warm compile-cache artifact.
+
+The cold-start fix's CI half (ROADMAP item 2): one machine runs
+``scintools-tpu warmup --catalog`` over the closed shape-bucket ladder
+(scintools_tpu.buckets) and packs the resulting ``SCINT_COMPILE_CACHE``
+into a tarball keyed on (jax/jaxlib/backend versions, package source
+fingerprint, catalog digest); every FRESH pod then unpacks it and
+serves its first result in seconds instead of paying minutes of XLA
+compilation (BENCH_r05: compile_s 324.68 vs measure_s 0.54).
+
+Usage::
+
+    # build: warm the catalog for these template epochs, then pack
+    python scripts/build_warm_cache.py build --out warm_cache.tgz \
+        templates/*.dynspec -- --lamsteps --batch 64
+
+    # fresh pod: verify + unpack into SCINT_COMPILE_CACHE, then serve
+    python scripts/build_warm_cache.py unpack warm_cache.tgz
+    python scripts/build_warm_cache.py verify warm_cache.tgz
+
+``build`` runs the warmup in a SUBPROCESS (a genuinely cold process, so
+the packed cache contains everything a fresh consumer needs — including
+entries this process would have satisfied from its in-memory jit
+cache); everything after ``--`` is passed through to ``scintools-tpu
+warmup`` verbatim (estimator flags, --batch, --mesh, ...).  The
+``--catalog`` flag is added automatically.
+
+Exit codes: 0 on success; 1 on a failed warmup, a version-skewed
+artifact (unpack without --force), or a verify mismatch.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _env(cache: str | None) -> dict:
+    env = dict(os.environ)
+    if cache:
+        env["SCINT_COMPILE_CACHE"] = cache
+    # the warmup child wires jax's cache dir itself, but an ambient
+    # JAX_COMPILATION_CACHE_DIR would win over it (compile_cache's
+    # ambient-wins rule) and the XLA entries would land OUTSIDE the
+    # dir we pack — drop it so the child fills exactly the packed dir
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _last_json_line(text: str) -> dict:
+    """Last parseable JSON object line of a child's stdout (scanning
+    backwards past any trailing log/truncated noise — the same
+    tolerance bench.py's record parsing uses)."""
+    for ln in reversed(text.splitlines()):
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            rec = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict):
+            return rec
+    return {}
+
+
+def cmd_build(args) -> int:
+    from scintools_tpu import compile_cache
+
+    # the warmup child runs with cwd=REPO: template paths given
+    # relative to the OPERATOR's cwd must survive the hop
+    templates = [os.path.abspath(t) for t in args.templates]
+    warmup_args = ["warmup", "--catalog"] + templates + args.warmup_args
+    code = ("import sys\n"
+            "from scintools_tpu.cli import main\n"
+            "sys.exit(main(%r))\n" % (warmup_args,))
+    try:
+        proc = subprocess.run([sys.executable, "-c", code], text=True,
+                              capture_output=True, env=_env(args.cache),
+                              cwd=REPO, timeout=args.timeout)
+    except subprocess.TimeoutExpired:
+        # keep the JSON-line/rc-1 contract: CI parses stdout
+        print(json.dumps({"error": f"warmup --catalog exceeded "
+                          f"{args.timeout}s (--timeout); a chip-scale "
+                          "catalog can take minutes per signature"}))
+        return 1
+    rec = _last_json_line(proc.stdout)
+    if proc.returncode != 0 or not rec.get("signatures"):
+        print(json.dumps({"error": "warmup --catalog failed",
+                          "rc": proc.returncode,
+                          "stderr": proc.stderr.strip()[-500:],
+                          "warmup": rec}))
+        return 1
+    if args.cache:
+        os.environ["SCINT_COMPILE_CACHE"] = args.cache
+    man = compile_cache.pack_warm_cache(
+        args.out, cache=args.cache,
+        catalog_digest=rec.get("catalog_digest"))
+    print(json.dumps({"out": os.path.abspath(args.out),
+                      "manifest": man, "warmup": {
+                          "signatures": len(rec["signatures"]),
+                          "cache_dir": rec.get("cache_dir"),
+                          "evictions": rec.get("evictions", 0)}}))
+    return 0
+
+
+def cmd_unpack(args) -> int:
+    from scintools_tpu import compile_cache
+
+    if args.cache:
+        os.environ["SCINT_COMPILE_CACHE"] = args.cache
+    try:
+        man = compile_cache.unpack_warm_cache(args.artifact,
+                                              cache=args.cache,
+                                              force=args.force)
+    except ValueError as e:
+        print(json.dumps({"error": str(e)}))
+        return 1
+    print(json.dumps({"cache_dir": compile_cache.cache_dir(),
+                      "manifest": man}))
+    return 0
+
+
+def cmd_verify(args) -> int:
+    import tarfile
+
+    from scintools_tpu import compile_cache
+
+    try:
+        with tarfile.open(args.artifact, "r:gz") as tar:
+            fh = tar.extractfile(compile_cache.MANIFEST_NAME)
+            if fh is None:
+                raise ValueError("manifest member is not a file")
+            man = json.load(fh)
+    except (OSError, KeyError, ValueError, TypeError) as e:
+        print(json.dumps({"error": f"{args.artifact}: not a warm-cache "
+                          f"artifact ({e})"}))
+        return 1
+    mismatches = compile_cache.verify_artifact(man)
+    print(json.dumps({"manifest": man, "mismatches": mismatches,
+                      "usable": not mismatches}))
+    return 0 if not mismatches else 1
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    sub = p.add_subparsers(dest="command", required=True)
+
+    q = sub.add_parser("build", help="warm the catalog (subprocess) "
+                                     "and pack the cache")
+    q.add_argument("templates", nargs="+",
+                   help="template psrflux file(s), one per observing "
+                        "setup; flags after `--` pass through to "
+                        "`scintools-tpu warmup`")
+    q.add_argument("--out", default="warm_cache.tgz",
+                   help="output tarball path")
+    q.add_argument("--cache", default=None,
+                   help="cache dir to warm+pack (default: the ambient "
+                        "SCINT_COMPILE_CACHE resolution)")
+    q.add_argument("--timeout", type=int, default=7200,
+                   help="warmup subprocess timeout (seconds)")
+    q.set_defaults(fn=cmd_build)
+
+    q = sub.add_parser("unpack", help="verify + unpack an artifact "
+                                      "into SCINT_COMPILE_CACHE")
+    q.add_argument("artifact")
+    q.add_argument("--cache", default=None,
+                   help="destination cache dir (default: ambient "
+                        "SCINT_COMPILE_CACHE resolution)")
+    q.add_argument("--force", action="store_true",
+                   help="unpack even on a version mismatch (stale keys "
+                        "miss and recompile — slow, never wrong)")
+    q.set_defaults(fn=cmd_unpack)
+
+    q = sub.add_parser("verify", help="print an artifact's manifest "
+                                      "and runtime-compatibility")
+    q.add_argument("artifact")
+    q.set_defaults(fn=cmd_verify)
+    return p
+
+
+def main(argv: list | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # argparse swallows flags after the template list; split at `--`
+    # ourselves so warmup flags pass through verbatim
+    passthrough: list = []
+    if "--" in argv:
+        i = argv.index("--")
+        argv, passthrough = argv[:i], argv[i + 1:]
+    args = build_parser().parse_args(argv)
+    args.warmup_args = passthrough
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

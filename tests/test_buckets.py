@@ -1,0 +1,356 @@
+"""Closed shape-bucket catalog (scintools_tpu.buckets): ladder and
+canonicalisation edges, the driver's bucket=True path (catalog-only
+signatures, byte-identical real lanes, pad-waste accounting), the serve
+batcher's rung-padded flushes, and the trace-report catalog /
+compile-profile sections."""
+
+import os
+
+import numpy as np
+import pytest
+
+from synth import synth_arc_epoch
+
+from scintools_tpu import buckets, obs
+from scintools_tpu.parallel import PipelineConfig, run_pipeline
+
+CFG = PipelineConfig(arc_numsteps=96, lm_steps=3)
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.disable(flush=False)
+    obs.reset()
+    yield
+    obs.disable(flush=False)
+    obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# ladder / canonicalisation edges
+# ---------------------------------------------------------------------------
+
+
+def test_batch_ladder_shapes():
+    assert buckets.batch_ladder(1, 64) == (1, 2, 4, 8, 16, 32, 64)
+    # a non-power-of-two top (a production serve batch) is itself a rung
+    assert buckets.batch_ladder(1, 48) == (1, 2, 4, 8, 16, 32, 48)
+    # every rung divides by the mesh's data axis; top adjusts up
+    assert buckets.batch_ladder(4, 48) == (4, 8, 16, 32, 48)
+    assert buckets.batch_ladder(4, 2) == (4,)
+    assert buckets.batch_ladder(8, 30) == (8, 16, 32)
+
+
+def test_rung_for_edges():
+    # prime-sized batches round up to the next rung
+    assert buckets.rung_for(7, top=64) == 8
+    assert buckets.rung_for(13, top=64) == 16
+    # below the smallest bucket: the mesh multiple IS the floor
+    assert buckets.rung_for(1, multiple=4, top=64) == 4
+    assert buckets.rung_for(3, multiple=4, top=64) == 4
+    # exact-boundary shapes stay put (no spurious padding)
+    assert buckets.rung_for(8, top=64) == 8
+    assert buckets.rung_for(64, top=64) == 64
+    # above the top rung: the top rung (the caller chunks at it)
+    assert buckets.rung_for(65, top=64) == 64
+    with pytest.raises(ValueError):
+        buckets.rung_for(0)
+
+
+def test_default_top_env(monkeypatch):
+    assert buckets.default_top() == buckets.DEFAULT_TOP
+    monkeypatch.setenv(buckets.TOP_ENV, "16")
+    assert buckets.default_top() == 16
+    assert buckets.batch_ladder() == (1, 2, 4, 8, 16)
+    monkeypatch.setenv(buckets.TOP_ENV, "not-a-number")
+    with pytest.raises(ValueError):
+        buckets.default_top()
+    monkeypatch.setenv(buckets.TOP_ENV, "0")
+    with pytest.raises(ValueError):
+        buckets.default_top()
+
+
+def test_bucket_plan_pad_vs_chunk():
+    assert buckets.bucket_plan(5, top=64) == {"pad_to": 8}
+    assert buckets.bucket_plan(64, top=64) == {"pad_to": 64}
+    assert buckets.bucket_plan(200, top=64) == {"chunk": 64,
+                                                "pad_chunks": True}
+    assert buckets.bucket_plan(3, multiple=4, top=64) == {"pad_to": 4}
+
+
+def test_canonicalize_precision_and_config_split():
+    """bf16_io and f32 surveys land in SEPARATE catalog entries (they
+    are different compiled programs), mirroring the serve-signature
+    separation contract of tests/test_precision.py."""
+    cfg_f32 = CFG
+    cfg_bf16 = PipelineConfig(arc_numsteps=96, lm_steps=3,
+                              precision="bf16_io")
+    a = buckets.canonicalize((5, 64, 64), cfg_f32)
+    b = buckets.canonicalize((5, 64, 64), cfg_bf16)
+    assert a.batch == b.batch == 8          # prime-ish count, same rung
+    assert a.dtype == "float64" and b.dtype == "bfloat16"
+    assert a.cfg_digest != b.cfg_digest
+    assert a.label == "8x64x64:float64"
+    assert b.label == "8x64x64:bfloat16"
+    # exact boundary: no padding, chunked=False
+    c = buckets.canonicalize((8, 64, 64), cfg_f32)
+    assert c.batch == 8 and not c.chunked
+    # above the top: top rung, chunk-covered
+    d = buckets.canonicalize((200, 64, 64), cfg_f32, top=64)
+    assert d.batch == 64 and d.chunked
+
+
+def test_catalog_and_plan_steps_enumerate_ladder():
+    from scintools_tpu import compile_cache
+
+    eps = [synth_arc_epoch(seed=s) for s in range(3)]
+    cat = buckets.catalog(eps, CFG, top=8)
+    # one axes bucket x rungs (1,2,4,8) + the chunked top variant
+    assert [s.batch for s in cat] == [1, 2, 4, 8, 8]
+    assert [s.chunked for s in cat] == [False] * 4 + [True]
+    assert len({s.axes_digest for s in cat}) == 1
+    plans = compile_cache.plan_steps(eps, CFG, batch=8, catalog=True)
+    assert [p[2] for p in plans] == [(1, 64, 64), (2, 64, 64),
+                                     (4, 64, 64), (8, 64, 64),
+                                     (8, 64, 64)]
+    assert [p[4] for p in plans] == [False] * 4 + [True]
+    # precision-aware: bf16_io catalogs plan the bf16 staging dtype
+    bf = compile_cache.plan_steps(
+        eps, PipelineConfig(arc_numsteps=96, lm_steps=3,
+                            precision="bf16_io"),
+        batch=2, catalog=True)
+    assert all(str(np.dtype(p[3])) == "bfloat16" for p in bf)
+
+
+def test_catalog_digest_stable_and_sensitive():
+    d1 = buckets.catalog_digest(["k1", "k2", "k3"])
+    assert d1 == buckets.catalog_digest(["k3", "k1", "k2"])  # order-free
+    assert d1 != buckets.catalog_digest(["k1", "k2"])
+    assert d1 != buckets.catalog_digest(["k1", "k2", "k4"])
+
+
+def test_pad_waste():
+    assert buckets.pad_waste(5, 8) == 0.6
+    assert buckets.pad_waste(8, 8) == 0.0
+    assert buckets.pad_waste(0, 8) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# driver: bucket=True
+# ---------------------------------------------------------------------------
+
+
+def test_run_pipeline_bucket_rejects_explicit_pad_to():
+    eps = [synth_arc_epoch(seed=1)]
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        run_pipeline(eps, CFG, bucket=True, pad_to=4)
+
+
+def test_bucketed_survey_csv_byte_identical(tmp_path):
+    """Acceptance: an arbitrary-shape survey canonicalised onto the
+    closed catalog exports a CSV byte-identical to the unbucketed run
+    (the pad_to machinery's mask-invalid lanes are sliced off at
+    gather; same comparison discipline as the serve byte-equality and
+    OOM-backoff tests).  3 epochs canonicalise onto the 4-rung."""
+    from scintools_tpu.io.results import (batch_lane_row, results_row,
+                                          write_results)
+
+    eps = [synth_arc_epoch(seed=s) for s in range(3)]
+
+    def csv_of(name, **kw):
+        out = str(tmp_path / name)
+        [(idx, res)] = run_pipeline(eps, CFG, **kw)
+        for lane, i in enumerate(idx):
+            row = results_row(eps[i])
+            row.update(batch_lane_row(res, lane, CFG.lamsteps))
+            write_results(out, row)
+        with open(out) as fh:
+            return fh.read()
+
+    plain = csv_of("plain.csv")
+    bucketed = csv_of("bucketed.csv", bucket=True)
+    assert bucketed == plain
+    assert "," in plain and len(plain.splitlines()) == 4  # header + 3
+
+
+def test_bucketed_survey_counters_and_close_values():
+    """A 5-epoch survey canonicalises onto the 8-rung: the catalog
+    counters record 5 real + 3 padded lanes (pad-waste 0.6) and the
+    results match the unbucketed run to float64-tight tolerance.
+    (At the 8-lane signature XLA's CPU codegen vectorises the arc-fit
+    reductions differently than at 5, so this composition is the
+    documented ~1e-14 case rather than the byte-identical one — the
+    same caveat as test_compile_cache's uneven-final-chunk lane.)"""
+    eps = [synth_arc_epoch(seed=s) for s in range(5)]
+    [(_, ref)] = run_pipeline(eps, CFG)
+    with obs.tracing() as reg:
+        [(idx, res)] = run_pipeline(eps, CFG, bucket=True)
+        c = obs.counters()
+        g = reg.gauges()
+    assert list(idx) == list(range(5))
+    assert np.asarray(res.scint.tau).shape == (5,)
+    np.testing.assert_allclose(np.asarray(res.scint.tau),
+                               np.asarray(ref.scint.tau), rtol=1e-10)
+    np.testing.assert_allclose(np.asarray(res.arc.eta),
+                               np.asarray(ref.arc.eta), rtol=1e-10)
+    label = "8x64x64:float64"
+    assert c.get(f"bucket_hits[{label}]") == 1
+    assert c.get(f"bucket_lanes_real[{label}]") == 5
+    assert c.get(f"bucket_lanes_pad[{label}]") == 3
+    # the whole ladder exists as catalog gauges (unused rungs visible)
+    assert g.get("bucket_catalog[1x64x64:float64]") == 1
+    assert g.get(f"bucket_catalog[{label}]") == 1
+
+
+def test_bucketed_large_survey_chunks_at_top_rung(monkeypatch):
+    """Above the top rung the survey runs uniform chunks OF the top
+    rung — still exactly one compiled signature (the catalog's)."""
+    from scintools_tpu.parallel.driver import _step_batch_sizes
+
+    monkeypatch.setenv(buckets.TOP_ENV, "2")
+    eps = [synth_arc_epoch(seed=s) for s in range(5)]
+    with obs.tracing():
+        [(idx, res)] = run_pipeline(eps, CFG, bucket=True,
+                                    async_exec=False)
+        c = obs.counters()
+    assert np.asarray(res.scint.tau).shape == (5,)
+    assert np.all(np.isfinite(np.asarray(res.scint.tau)))
+    label = "2x64x64:float64"
+    assert c.get(f"bucket_hits[{label}]") == 1
+    assert c.get(f"bucket_lanes_real[{label}]") == 5
+    assert c.get(f"bucket_lanes_pad[{label}]") == 1    # 5 -> 3 chunks of 2
+    # sanity: the plan really collapses to one step size
+    assert _step_batch_sizes(6, 1, 2, pad_chunks=True) == {2}
+
+
+def test_trace_report_catalog_and_compile_profile(tmp_path, capsys):
+    """`trace report` on a bucketed traced run shows the shape-bucket
+    catalog section (hits + pad-waste + unused rungs) and the
+    compile-profile section (per-stage/signature cold/warm split +
+    artifact provenance line)."""
+    from scintools_tpu.cli import main as cli_main
+
+    eps = [synth_arc_epoch(seed=s) for s in range(5)]
+    path = str(tmp_path / "trace.jsonl")
+    # test-unique config: the compile must happen INSIDE the trace
+    # window (the shared CFG's step is memoised by earlier tests)
+    cfg = PipelineConfig(fit_arc=False, lm_steps=4)
+    with obs.tracing(jsonl=path):
+        run_pipeline(eps, cfg, bucket=True)
+    rc = cli_main(["trace", "report", path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "shape-bucket catalog" in out
+    assert "8x64x64:float64: hits = 1, lanes = 5 real + 3 pad, " \
+           "pad_waste = 0.6" in out
+    assert "in catalog, not hit this run" in out      # unused rungs
+    assert "compile profile" in out
+    assert "pipeline.step: cold_ms =" in out
+    assert "warm-cache artifact" in out
+
+
+# ---------------------------------------------------------------------------
+# serve: rung-padded flushes + job identity
+# ---------------------------------------------------------------------------
+
+
+def _mk_jobs_epochs(tmp_path, n):
+    from scintools_tpu.io.psrflux import write_psrflux
+    from scintools_tpu.serve.queue import Job
+    from scintools_tpu.serve.worker import load_epoch
+
+    jobs, eps = [], []
+    for s in range(n):
+        fn = str(tmp_path / f"ep_{s}.dynspec")
+        write_psrflux(synth_arc_epoch(nf=32, nt=32, seed=s + 1), fn)
+        jobs.append(Job(id=f"j{s}", file=fn,
+                        cfg={"lamsteps": True, "arc_numsteps": 96,
+                             "lm_steps": 3}, submitted_at=0.0))
+        eps.append(load_epoch(fn))
+    return jobs, eps
+
+
+def test_batcher_bucket_flushes_pad_to_rung(tmp_path):
+    from scintools_tpu.serve import DynamicBatcher
+
+    jobs, eps = _mk_jobs_epochs(tmp_path, 3)
+    b = DynamicBatcher(batch_size=8, max_wait_s=0.0, bucket=True)
+    for j, e in zip(jobs, eps):
+        b.add(j, e, now=100.0)
+    (batch,) = b.pop_ready(now=101.0)
+    assert batch.pad_to == 4                    # 3 jobs -> 4-rung
+    assert batch.fill_ratio == 3 / 4            # vs the rung, not 8
+    # a single job pads to the smallest rung: zero waste
+    b.add(jobs[0], eps[0], now=200.0)
+    (one,) = b.pop_ready(now=201.0)
+    assert one.pad_to == 1 and one.fill_ratio == 1.0
+    # without bucketing the padded signature stays the full batch_size
+    legacy = DynamicBatcher(batch_size=8, max_wait_s=0.0)
+    legacy.add(jobs[0], eps[0], now=300.0)
+    (lb,) = legacy.pop_ready(now=301.0)
+    assert lb.pad_to == 8 and lb.fill_ratio == 1 / 8
+
+
+def test_worker_bucket_passes_rung_to_runner(tmp_path):
+    from scintools_tpu.serve import JobQueue, ServeWorker, SurveyClient
+
+    files = []
+    from scintools_tpu.io.psrflux import write_psrflux
+
+    for s in (1, 2, 4):
+        fn = str(tmp_path / f"w_{s}.dynspec")
+        write_psrflux(synth_arc_epoch(nf=32, nt=32, seed=s), fn)
+        files.append(fn)
+    qdir = str(tmp_path / "q")
+    client = SurveyClient(qdir)
+    client.submit(files, {"lamsteps": True, "arc_numsteps": 96,
+                          "lm_steps": 3})
+    client.drain()
+    seen = []
+
+    def runner(batch, batch_size, mesh, async_exec):
+        seen.append(batch_size)
+        return [{"name": os.path.basename(j.file), "mjd": 0, "freq": 0,
+                 "bw": 0, "tobs": 0, "dt": 0, "df": 0, "tau": 1.0}
+                for j in batch.jobs]
+
+    worker = ServeWorker(JobQueue(qdir), batch_size=8, max_wait_s=0.0,
+                         lease_s=30.0, poll_s=0.01, runner=runner,
+                         bucket=True)
+    stats = worker.run()
+    assert stats["jobs_done"] == 3 and stats["jobs_failed"] == 0
+    assert seen == [4]                         # 3 jobs -> 4-rung, not 8
+    assert stats["lanes_total"] == 4 and stats["lanes_filled"] == 3
+
+
+def test_cfg_signature_strips_bucket_placement_knob():
+    """Bucketing changes no result byte, so it must not split job
+    identities: a bucket-aware client's submit dedups/batches with a
+    legacy client's identical job."""
+    from scintools_tpu.serve.queue import cfg_signature
+
+    assert cfg_signature({"lamsteps": True, "bucket": True}) \
+        == cfg_signature({"lamsteps": True})
+    assert cfg_signature({"bucket": True}) == cfg_signature({})
+
+
+def test_bucket_chunk_cap_never_rounds_up():
+    """An explicit ``chunk`` is a device-memory BOUND: the bucket
+    ladder's top adjusts DOWN to a mesh multiple (like the non-bucket
+    path's _adjust_chunk), never up — and the warmup planner's catalog
+    mirrors the same cap so a chunk-capped bucketed survey executes
+    only warmed signatures."""
+    from scintools_tpu import compile_cache
+    from scintools_tpu.parallel.driver import _adjust_chunk
+
+    # multiple=4, chunk=6: the bound resolves to 4-lane chunks, not 8
+    assert _adjust_chunk(4, 6) == 4
+    assert buckets.batch_ladder(4, _adjust_chunk(4, 6)) == (4,)
+    eps = [synth_arc_epoch(seed=s) for s in range(2)]
+    plans = compile_cache.plan_steps(eps, CFG, chunk=2, catalog=True)
+    assert [p[2] for p in plans] == [(1, 64, 64), (2, 64, 64),
+                                     (2, 64, 64)]
+    # an explicit batch still wins over chunk as the ladder top
+    plans = compile_cache.plan_steps(eps, CFG, chunk=2, batch=4,
+                                     catalog=True)
+    assert max(p[2][0] for p in plans) == 4

@@ -1,0 +1,378 @@
+"""Shippable warm-cache artifact (compile_cache pack/unpack/verify +
+scripts/build_warm_cache.py) and compile-cache hygiene (size cap + LRU
+eviction).  The two-process smoke is the fresh-pod acceptance: a pod
+given ONLY the packed artifact serves a catalog-shaped survey with
+``jit_cache_miss == 0`` and no compile span over 1 s."""
+
+import json
+import os
+import subprocess
+import sys
+import tarfile
+import time
+
+import pytest
+
+from synth import synth_arc_epoch
+
+from scintools_tpu import compile_cache, obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "build_warm_cache.py")
+
+
+@pytest.fixture()
+def cache_dir(tmp_path, monkeypatch):
+    d = str(tmp_path / "scc")
+    monkeypatch.setenv("SCINT_COMPILE_CACHE", d)
+    obs.disable(flush=False)
+    obs.reset()
+    yield d
+    obs.disable(flush=False)
+    obs.reset()
+
+
+def _seed_cache(d, names=("aa", "bb"), size=1024, age_step=10.0):
+    """Plant fake cache entries with strictly increasing mtimes."""
+    os.makedirs(d, exist_ok=True)
+    t0 = time.time() - 1000.0
+    for i, name in enumerate(names):
+        p = os.path.join(d, name + ".bin")
+        with open(p, "wb") as fh:
+            fh.write(b"x" * size)
+        os.utime(p, (t0 + i * age_step, t0 + i * age_step))
+    return [os.path.join(d, n + ".bin") for n in names]
+
+
+# ---------------------------------------------------------------------------
+# hygiene: size cap + LRU eviction
+# ---------------------------------------------------------------------------
+
+
+def test_cache_cap_env_parsing(monkeypatch):
+    assert compile_cache.cache_cap_bytes() \
+        == compile_cache.DEFAULT_CAP_MB << 20
+    monkeypatch.setenv(compile_cache.CAP_ENV, "7")
+    assert compile_cache.cache_cap_bytes() == 7 << 20
+    for off in ("0", "off", "none", ""):
+        monkeypatch.setenv(compile_cache.CAP_ENV, off)
+        assert compile_cache.cache_cap_bytes() is None
+    monkeypatch.setenv(compile_cache.CAP_ENV, "lots")
+    with pytest.raises(ValueError):
+        compile_cache.cache_cap_bytes()
+
+
+def test_enforce_cache_cap_evicts_lru(cache_dir):
+    paths = _seed_cache(cache_dir, names=("old", "mid", "new"),
+                        size=1000)
+    # manifest is provenance, never eviction bait
+    with open(os.path.join(cache_dir, compile_cache.MANIFEST_NAME),
+              "w") as fh:
+        json.dump({"digest": "d"}, fh)
+    with obs.tracing():
+        n = compile_cache.enforce_cache_cap(cache_dir, cap_bytes=2000)
+        c = obs.counters()
+    assert n == 1
+    assert not os.path.exists(paths[0])          # oldest evicted
+    assert os.path.exists(paths[1]) and os.path.exists(paths[2])
+    assert os.path.exists(os.path.join(cache_dir,
+                                       compile_cache.MANIFEST_NAME))
+    assert c.get("compile_cache_evictions") == 1
+    # under the cap: no-op
+    assert compile_cache.enforce_cache_cap(cache_dir,
+                                           cap_bytes=10000) == 0
+
+
+def test_enforce_cache_cap_disabled_and_missing(cache_dir):
+    _seed_cache(cache_dir, size=1000)
+    assert compile_cache.enforce_cache_cap(cache_dir,
+                                           cap_bytes=None) == 0
+    assert compile_cache.enforce_cache_cap("/nonexistent/nowhere",
+                                           cap_bytes=1) == 0
+
+
+# ---------------------------------------------------------------------------
+# artifact pack / verify / unpack
+# ---------------------------------------------------------------------------
+
+
+def test_pack_verify_unpack_roundtrip(cache_dir, tmp_path):
+    _seed_cache(cache_dir, names=("entry1", "entry2"))
+    os.makedirs(os.path.join(cache_dir, "aot"), exist_ok=True)
+    with open(os.path.join(cache_dir, "aot", "k.jaxexport"), "wb") as fh:
+        fh.write(b"stablehlo-bytes")
+    art = str(tmp_path / "warm.tgz")
+    with obs.tracing():
+        man = compile_cache.pack_warm_cache(art, cache=cache_dir,
+                                            catalog_digest="cat123")
+        c = obs.counters()
+    assert os.path.exists(art)
+    assert man["digest"] == "cat123" and man["files"] == 3
+    assert compile_cache.verify_artifact(man) == []
+    assert c.get("cache_artifact_packed") == 1
+    # the manifest landed in the cache dir too
+    assert compile_cache.artifact_manifest(cache_dir)["digest"] == "cat123"
+    # fresh destination: verify + extract + manifest present
+    dest = str(tmp_path / "fresh")
+    with obs.tracing():
+        man2 = compile_cache.unpack_warm_cache(art, cache=dest)
+        c = obs.counters()
+    assert man2["digest"] == "cat123"
+    assert os.path.exists(os.path.join(dest, "entry1.bin"))
+    assert os.path.exists(os.path.join(dest, "aot", "k.jaxexport"))
+    assert compile_cache.artifact_manifest(dest)["digest"] == "cat123"
+    assert c.get("cache_artifact_unpacked") == 1
+
+
+def test_unpack_rejects_version_skew(cache_dir, tmp_path, monkeypatch):
+    import jax
+
+    _seed_cache(cache_dir, names=("entry",))
+    art = str(tmp_path / "warm.tgz")
+    compile_cache.pack_warm_cache(art, cache=cache_dir)
+    monkeypatch.setattr(jax, "__version__", "999.0.0")
+    dest = str(tmp_path / "fresh")
+    with obs.tracing():
+        with pytest.raises(ValueError, match="does not match this "
+                                             "runtime"):
+            compile_cache.unpack_warm_cache(art, cache=dest)
+        c = obs.counters()
+    assert c.get("cache_artifact_rejected") == 1
+    assert not os.path.exists(os.path.join(dest, "entry.bin"))
+    # force: stale keys miss and recompile — slow, never wrong
+    man = compile_cache.unpack_warm_cache(art, cache=dest, force=True)
+    assert os.path.exists(os.path.join(dest, "entry.bin"))
+    assert compile_cache.verify_artifact(man) != []
+
+
+def test_unpack_rejects_non_artifact_and_unsafe_members(cache_dir,
+                                                        tmp_path):
+    # a tarball without a manifest is not a warm-cache artifact
+    bogus = str(tmp_path / "bogus.tgz")
+    plain = str(tmp_path / "plain.txt")
+    with open(plain, "w") as fh:
+        fh.write("hi")
+    with tarfile.open(bogus, "w:gz") as tar:
+        tar.add(plain, arcname="plain.txt")
+    with pytest.raises(ValueError, match="not a warm-cache artifact"):
+        compile_cache.unpack_warm_cache(bogus, cache=str(tmp_path / "d"))
+    # a manifest-bearing tarball with a traversal member is rejected
+    evil = str(tmp_path / "evil.tgz")
+    manp = str(tmp_path / compile_cache.MANIFEST_NAME)
+    with open(manp, "w") as fh:
+        json.dump(compile_cache._env_fingerprint()
+                  | {"format": compile_cache._FORMAT}, fh)
+    with tarfile.open(evil, "w:gz") as tar:
+        tar.add(manp, arcname=compile_cache.MANIFEST_NAME)
+        tar.add(plain, arcname="../escape.txt")
+    with pytest.raises(ValueError, match="unsafe member"):
+        compile_cache.unpack_warm_cache(evil, cache=str(tmp_path / "d"))
+
+
+def test_build_script_verify_subcommand(cache_dir, tmp_path):
+    _seed_cache(cache_dir, names=("entry",))
+    art = str(tmp_path / "warm.tgz")
+    compile_cache.pack_warm_cache(art, cache=cache_dir,
+                                  catalog_digest="cat9")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run([sys.executable, SCRIPT, "verify", art],
+                         text=True, capture_output=True, timeout=300,
+                         env=env, cwd=REPO)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    rec = json.loads(out.stdout.splitlines()[-1])
+    assert rec["usable"] is True
+    assert rec["manifest"]["digest"] == "cat9"
+    assert rec["mismatches"] == []
+
+
+def test_warm_cache_artifact_two_process(tmp_path):
+    """THE fresh-pod acceptance (tier-1-safe, CPU): process A builds a
+    tiny warm-cache artifact over the closed catalog
+    (scripts/build_warm_cache.py build -> warmup --catalog subprocess
+    -> pack); process B gets ONLY the artifact, unpacks it into a
+    brand-new SCINT_COMPILE_CACHE via the script, and a third cold
+    process serves a catalog-shaped survey with jit_cache_miss == 0,
+    compile_cache_hit >= 1, every compile span under 1 s, and the
+    artifact digest visible in its trace gauges."""
+    from scintools_tpu.io.psrflux import write_psrflux
+
+    files = []
+    for s in range(2):
+        fn = str(tmp_path / f"tmpl_{s}.dynspec")
+        write_psrflux(synth_arc_epoch(seed=s), fn)
+        files.append(fn)
+    cache_a = str(tmp_path / "cacheA")
+    art = str(tmp_path / "warm_cache.tgz")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               SCINT_COMPILE_CACHE=cache_a, SCINT_BUCKET_TOP="2")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "build", "--out", art] + files
+        + ["--", "--no-arc", "--batch", "2"],
+        text=True, capture_output=True, timeout=600, env=env, cwd=REPO)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    rec = json.loads([ln for ln in out.stdout.splitlines()
+                      if ln.startswith("{")][-1])
+    assert rec["warmup"]["signatures"] >= 2, rec
+    assert rec["manifest"].get("digest"), rec
+
+    # process B: a FRESH pod — empty cache dir, only the artifact
+    cache_b = str(tmp_path / "cacheB")
+    env_b = dict(env, SCINT_COMPILE_CACHE=cache_b)
+    out = subprocess.run(
+        [sys.executable, SCRIPT, "unpack", art],
+        text=True, capture_output=True, timeout=300, env=env_b, cwd=REPO)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    rec = json.loads(out.stdout.splitlines()[-1])
+    assert rec["manifest"]["files"] >= 1
+
+    # cold consumer: catalog-shaped survey (1 epoch -> rung 1) must
+    # pay ZERO trace/compile — counter- AND span-asserted
+    consumer = (
+        "from scintools_tpu.backend import force_host_cpu_devices\n"
+        "force_host_cpu_devices(1)\n"
+        "import json\n"
+        "import numpy as np\n"
+        "from scintools_tpu import obs\n"
+        "from scintools_tpu.io.psrflux import read_psrflux\n"
+        "from scintools_tpu.ops.clean import refill, trim_edges\n"
+        "from scintools_tpu.parallel import (PipelineConfig, make_mesh,\n"
+        "                                    run_pipeline)\n"
+        "epochs = [refill(trim_edges(read_psrflux(%r)))]\n"
+        "cfg = PipelineConfig(lamsteps=False, fit_arc=False)\n"
+        "mesh = make_mesh()\n"
+        "with obs.tracing() as reg:\n"
+        "    buckets = run_pipeline(epochs, cfg, mesh=mesh,\n"
+        "                           bucket=True)\n"
+        "    c = obs.counters()\n"
+        "    g = reg.gauges()\n"
+        "    spans = [(e['name'], e['dur_ms']) for e in reg.events()\n"
+        "             if e.get('kind') == 'span'\n"
+        "             and '.compile' in e['name']]\n"
+        "(_i, res), = buckets\n"
+        "from scintools_tpu import buckets as bmod\n"
+        "from scintools_tpu import compile_cache\n"
+        "from scintools_tpu.parallel.driver import (_resolve_chan_sharded,\n"
+        "                                           stage_dtype)\n"
+        "f, t = np.asarray(epochs[0].freqs), np.asarray(epochs[0].times)\n"
+        "rung = bmod.rung_for(1, mesh.shape['data'])\n"
+        "key = compile_cache.step_key(\n"
+        "    f, t, cfg, mesh, _resolve_chan_sharded(mesh, None),\n"
+        "    (rung, len(f), len(t)), stage_dtype(cfg.precision))\n"
+        "fn = compile_cache.load_step(key, count=False)\n"
+        "print(json.dumps({'counters': c,\n"
+        "                  'artifact': g.get('compile_cache_artifact'),\n"
+        "                  'compile_spans': spans,\n"
+        "                  'exec_layer': bool(fn is not None\n"
+        "                                     and not hasattr(fn,\n"
+        "                                                     'lower')),\n"
+        "                  'tau_finite': bool(np.all(np.isfinite(\n"
+        "                      np.asarray(res.scint.tau))))}))\n"
+        % files[0])
+    out = subprocess.run([sys.executable, "-c", consumer], text=True,
+                         capture_output=True, timeout=600, env=env_b,
+                         cwd=REPO)
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    rec = json.loads([ln for ln in out.stdout.splitlines()
+                      if ln.startswith("{")][-1])
+    assert rec["counters"].get("jit_cache_miss", 0) == 0, rec
+    assert rec["counters"].get("compile_cache_hit", 0) >= 1, rec
+    assert rec["tau_finite"], rec
+    # the fast layer really served: a ready Compiled (no .lower), not
+    # the StableHLO-jit fallback that would pay XLA compile
+    assert rec["exec_layer"], rec
+    # artifact provenance is visible to trace report
+    assert rec["artifact"], rec
+    # no compile span over 1 s: the whole remaining "compile" is
+    # deserialization served by the unpacked persistent cache
+    assert rec["compile_spans"], rec
+    worst = max(d for _n, d in rec["compile_spans"])
+    assert worst < 1000.0, rec["compile_spans"]
+
+
+# ---------------------------------------------------------------------------
+# bench: time_to_first_result probe
+# ---------------------------------------------------------------------------
+
+
+def _load_bench():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_time_to_first_result_probe(monkeypatch):
+    """The cold-process submit->first-CSV-row probe returns a real
+    latency plus the counters that say whether it measured a cold or a
+    warm start (the flight-record trajectory metric of ISSUE 7)."""
+    bench = _load_bench()
+    monkeypatch.setenv("SCINT_BENCH_TTFR", "0")
+    assert bench.time_to_first_result(64, 64) == {"skipped": True}
+    monkeypatch.setenv("SCINT_BENCH_TTFR", "1")
+    rec = bench.time_to_first_result(64, 64, timeout_s=540,
+                                     arc_numsteps=96, lm_steps=3,
+                                     force_cpu=True)
+    assert "error" not in rec, rec
+    assert rec["s"] > 0
+    assert rec["shape"] == [1, 64, 64]
+    assert rec["backend"] == "cpu-forced"
+    for k in ("jit_cache_miss", "compile_cache_hit",
+              "compile_cache_miss"):
+        assert k in rec
+
+
+# ---------------------------------------------------------------------------
+# serialized-executable layer (the load_step fast path)
+# ---------------------------------------------------------------------------
+
+
+def test_export_executable_roundtrip_preferred(cache_dir):
+    """export_executable persists the COMPILED step; load_step prefers
+    it over the StableHLO export (no retrace, no compile) and the
+    result is bit-identical to the live step's."""
+    import numpy as np
+
+    from scintools_tpu.parallel import PipelineConfig
+    from scintools_tpu.parallel.driver import make_pipeline
+
+    cfg = PipelineConfig(fit_arc=False, lm_steps=3)
+    eps = [synth_arc_epoch(seed=s) for s in range(2)]
+    f, t = np.asarray(eps[0].freqs), np.asarray(eps[0].times)
+    dyn = np.stack([np.asarray(e.dyn, dtype=np.float64) for e in eps])
+    step = make_pipeline(f, t, cfg)
+    key = compile_cache.step_key(f, t, cfg, None, False, dyn.shape,
+                                 dyn.dtype)
+    epath = compile_cache.export_executable(step, dyn.shape, dyn.dtype,
+                                            key)
+    assert epath is not None and epath.endswith(".jaxexec")
+    assert os.path.exists(epath)
+    # also write the StableHLO layer; the exec layer must still win
+    assert compile_cache.export_step(step, dyn.shape, dyn.dtype,
+                                     key) is not None
+    with obs.tracing():
+        fn = compile_cache.load_step(key)
+        c = obs.counters()
+    assert fn is not None and c.get("compile_cache_hit") == 1
+    # a ready Compiled: no .lower, directly callable
+    assert not hasattr(fn, "lower")
+    import jax
+
+    live = step(dyn)
+    out = fn(jax.device_put(dyn))
+    for a, b in zip(jax.tree_util.tree_leaves(live),
+                    jax.tree_util.tree_leaves(out)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # corrupt executable artifact degrades to the StableHLO layer
+    compile_cache._LOADED.clear()
+    with open(epath, "wb") as fh:
+        fh.write(b"not-a-pickle")
+    with obs.tracing():
+        fn2 = compile_cache.load_step(key)
+        c = obs.counters()
+    assert fn2 is not None and c.get("compile_cache_hit") == 1
+    assert hasattr(fn2, "lower")          # the jit'd deserialized module
