@@ -50,7 +50,13 @@ span/counter events in the --trace JSONL format, so the headline
 decomposes with `scintools-tpu trace report` — the bench emits
 bench.baseline_epoch / bench.step.* spans and run_pipeline's own
 pipeline.* spans ride along; the env var propagates into the probe and
-fallback subprocesses, which append to the same file).
+fallback subprocesses, which append to the same file),
+SCINT_BENCH_FUSED ("0" default = chain sspec lane, "1" = the fused
+Pallas/XLA sspec lane as the headline, "both" = chain headline PLUS a
+fused pass in the same weather window — the record then carries a
+``fused_vs_chain`` ratio of measured rate and cost-analysis bytes, so
+trajectory moves are attributed to the kernels; every record carries
+``fused: bool``).
 """
 
 import json
@@ -525,8 +531,32 @@ def device_preprobe(timeout_s: int) -> dict:
         return {"ok": False, "error": f"probe {type(e).__name__}: {e}"}
 
 
+def fused_vs_chain_ratio(chain_res: dict, fused_res: dict) -> dict | None:
+    """Attribution record for a both-lanes flight (``SCINT_BENCH_FUSED=
+    both``): the fused/chain ratios of measured rate AND of XLA
+    cost-analysis bytes per epoch, so a BENCH_r0N trajectory move is
+    attributed to the kernels (bytes dropped, rate moved together)
+    rather than to tunnel-weather noise (rate moved, bytes identical).
+    None when either lane is missing its rate."""
+    if not (chain_res.get("rate") and fused_res.get("rate")):
+        return None
+    out = {"rate": round(fused_res["rate"] / chain_res["rate"], 3),
+           "chain_rate": round(chain_res["rate"], 3),
+           "fused_rate": round(fused_res["rate"], 3)}
+    cb = chain_res.get("cost_analysis") or {}
+    fb = fused_res.get("cost_analysis") or {}
+    if cb.get("bytes_accessed") and fb.get("bytes_accessed") \
+            and cb.get("batch") and fb.get("batch"):
+        per_c = cb["bytes_accessed"] / cb["batch"]
+        per_f = fb["bytes_accessed"] / fb["batch"]
+        out["bytes"] = round(per_f / per_c, 3)
+        out["chain_bytes_per_epoch"] = round(per_c, 1)
+        out["fused_bytes_per_epoch"] = round(per_f, 1)
+    return out
+
+
 def device_throughput(dyn, freqs, times, chunk: int,
-                      repeats: int = 1) -> dict:
+                      repeats: int = 1, fused: bool = False) -> dict:
     """Batched jit pipeline on the attached accelerator (one chip here;
     the same step shards over a mesh unchanged).  Returns a dict with
     dynspec/s plus compile and measure wall time, separately.
@@ -551,8 +581,10 @@ def device_throughput(dyn, freqs, times, chunk: int,
 
     # lm_steps rides the shipped default (20 — measured convergence,
     # fit/scint_fit.py) so the bench always measures the framework as
-    # configured out of the box; only the BASELINE-pinned numsteps stays
-    cfg = PipelineConfig(arc_numsteps=2000)
+    # configured out of the box; only the BASELINE-pinned numsteps stays.
+    # ``fused`` flips the sspec stage onto the fused Pallas/XLA kernels
+    # (ops/sspec_pallas) — the SCINT_BENCH_FUSED lane selector.
+    cfg = PipelineConfig(arc_numsteps=2000, fused_sspec=bool(fused))
     step = make_pipeline(freqs, times, cfg)
     B = dyn.shape[0]
     chunk = min(chunk, B)
@@ -652,6 +684,7 @@ def device_throughput(dyn, freqs, times, chunk: int,
         # per-STEP counts at this chunk size; consumers divide by the
         # batch to get per-epoch numbers
         rec["cost_analysis"] = dict(cost, batch=int(chunk))
+    rec["fused"] = bool(fused)
     _trace_flush()   # counters, for the fallback-subprocess caller
     return rec
 
@@ -803,6 +836,22 @@ def main():
                 rec[k] = res[k]
         if res.get("rate_stats"):
             rec["rate_stats"] = res["rate_stats"]
+        # which sspec lane this headline measured (SCINT_BENCH_FUSED);
+        # a both-lanes flight also attributes fused-vs-chain (bytes +
+        # rate) so BENCH trajectories credit the kernels, not noise
+        rec["fused"] = bool(res.get("fused", False))
+        fl = res.get("fused_lane")
+        if fl:
+            ratio = fused_vs_chain_ratio(res, fl)
+            if ratio:
+                rec["fused_vs_chain"] = ratio
+            else:
+                # the lane ran but produced no comparable rate (it
+                # raised, or died before cost analysis): say so in the
+                # record instead of silently reading as "not requested"
+                rec["fused_vs_chain"] = {
+                    "error": fl.get("error", "fused lane incomplete "
+                                    "(no rate measured)")}
         # resilience totals (ISSUE 5): the self-healing events this
         # run's own pipeline work triggered.  A healthy flight records
         # zeros; a round that suddenly shows oom_backoff > 0 degraded
@@ -942,14 +991,40 @@ def main():
         # --- stage 2: full device run under the watchdog -----------------
         # (the tunnel can still die mid-run; the watchdog bounds that)
         timeout_s = _env_int("SCINT_BENCH_DEVICE_TIMEOUT", 1200)
+        if os.environ.get("SCINT_BENCH_FUSED",
+                          "0").strip().lower() == "both":
+            # two full lanes (two compiles + two measure windows) under
+            # one watchdog: double the budget, or a healthy both-lanes
+            # flight reads as a blown watchdog at the fused compile
+            timeout_s *= 2
 
         def _run():
             try:
                 # median-of-3 on chip too: passes are sub-second there,
-                # and tunnel weather makes single-shot rates spiky
+                # and tunnel weather makes single-shot rates spiky.
+                # SCINT_BENCH_FUSED: "1" measures the fused-sspec lane
+                # as the headline, "both" ALSO runs the fused lane
+                # after the chain one (same process, same weather
+                # window) for the fused_vs_chain attribution record
+                fused_mode = os.environ.get("SCINT_BENCH_FUSED",
+                                            "0").strip().lower()
                 result.update(device_throughput(
                     dyn, freqs, times, chunk,
-                    repeats=_env_int("SCINT_BENCH_REPEATS", 3)))
+                    repeats=_env_int("SCINT_BENCH_REPEATS", 3),
+                    fused=fused_mode == "1"))
+                if fused_mode == "both":
+                    # the fused lane's failure must never mask the
+                    # completed chain headline NOR vanish from the
+                    # record: it lands as fused_lane={"error": ...}
+                    # which device_record surfaces in fused_vs_chain
+                    try:
+                        result["fused_lane"] = device_throughput(
+                            dyn, freqs, times, chunk,
+                            repeats=_env_int("SCINT_BENCH_REPEATS", 3),
+                            fused=True)
+                    except Exception as e:
+                        result["fused_lane"] = {
+                            "error": f"{type(e).__name__}: {e}"}
             except Exception as e:  # pragma: no cover - surfaced in JSON
                 result["error"] = f"{type(e).__name__}: {e}"
 

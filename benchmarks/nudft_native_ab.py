@@ -20,8 +20,15 @@ than a claim:
 
 Skips gracefully (explicit JSON) when the reference tree or gcc is
 unavailable.  CPU-only: no jax import, safe under a wedged tunnel.
+
+``--pallas`` additionally A/Bs the rotation-recurrence Pallas NUDFT
+tile (ops/nudft.py ``route="pallas"``, interpret mode on CPU) against
+the same f64 oracle — OPT-IN because it imports jax, which voids this
+harness's wedged-tunnel safety guarantee; only pass it on a host whose
+accelerator state you do not care about.
 """
 
+import argparse
 import ctypes
 import json
 import os
@@ -67,6 +74,40 @@ def time_best(fn, repeats=5):
         fn()
         best = min(best, time.perf_counter() - t0)
     return best
+
+
+def ab_pallas(sizes=(128, 256, 512)):
+    """Opt-in jax lane: the Pallas NUDFT tile (interpret mode on CPU)
+    vs the f64 numpy oracle, one JSON line per size.  Numerics only off
+    TPU — interpret timings are emulation, so none are printed."""
+    import jax
+
+    from scintools_tpu.ops.nudft import _nudft_numpy, _nudft_pallas_reim
+    from scintools_tpu.ops.pallas_common import pallas_interpret_default
+
+    interpret = pallas_interpret_default()
+    rng = np.random.default_rng(0)
+    ok = True
+    for n in sizes:
+        ntime = nfreq = nr = n
+        power = rng.standard_normal((ntime, nfreq)).astype(np.float32)
+        fscale = 1.0 + 0.05 * np.arange(nfreq) / nfreq
+        tsrc = np.arange(ntime, dtype=np.float64)
+        r0, dr = -0.5, 1.0 / ntime
+        want = _nudft_numpy(power.astype(np.float64), fscale, tsrc,
+                            r0, dr, nr)
+        fn = jax.jit(lambda p: _nudft_pallas_reim(
+            p, fscale, tsrc, r0, dr, nr, interpret=interpret))
+        re, im = fn(power)
+        got = np.asarray(re) + 1j * np.asarray(im)
+        err = float(np.max(np.abs(got - want)) / np.max(np.abs(want)))
+        rec = {"kernel": "nudft_pallas", "n": n, "rel_err": err,
+               "interpret": bool(interpret)}
+        if err > 2e-4:   # the einsum route's own on-chip oracle budget
+            rec["error"] = "numerics mismatch"
+            ok = False
+        print(json.dumps(rec), flush=True)
+    return ok
 
 
 def main(sizes=(128, 256, 512)):
@@ -126,5 +167,14 @@ def main(sizes=(128, 256, 512)):
 
 
 if __name__ == "__main__":
-    main(tuple(int(s) for s in sys.argv[1].split(","))
-         if len(sys.argv) > 1 else (128, 256, 512))
+    ap = argparse.ArgumentParser()
+    ap.add_argument("sizes", nargs="?", default="128,256,512",
+                    help="comma-separated square problem sizes")
+    ap.add_argument("--pallas", action="store_true",
+                    help="ALSO A/B the Pallas NUDFT tile (imports jax: "
+                         "voids the wedged-tunnel safety guarantee)")
+    args = ap.parse_args()
+    sizes = tuple(int(s) for s in args.sizes.split(","))
+    main(sizes)
+    if args.pallas and not ab_pallas(sizes):
+        sys.exit(3)

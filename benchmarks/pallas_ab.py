@@ -1,9 +1,20 @@
-"""On-chip A/B for the Pallas row-scrunch kernel — the prove-or-remove
-measurement (docs/roadmap.md): the kernel is timed against the scan path
-it replaced, on the shapes the pipeline actually runs, and a JSON
-verdict line is printed.  Round-4 verdict: "wire", 3.5x — the kernel is
-now the arc fitter's on-chip auto route (arc_scrunch_rows=-1), and this
-A/B is the regression guard that the route stays justified.
+"""On-chip A/B for this package's Pallas kernels — the prove-or-remove
+measurement (docs/roadmap.md): each kernel is timed against the
+production path it would replace, on the shapes the pipeline actually
+runs, and a JSON verdict line is printed per kernel.
+
+* ``row_scrunch`` — round-4 verdict "wire" (3.5x): the arc fitter's
+  on-chip auto route; keep-off here is a REGRESSION (exit 3).
+* ``sspec_fused`` — the fused secondary-spectrum route
+  (``PipelineConfig.fused_sspec``, ops/sspec_pallas): opt-in, so only
+  a numerics mismatch fails the gate; the timing verdict decides
+  whether the knob graduates to an auto default.
+* ``nudft_pallas`` — the rotation-recurrence NUDFT tile (ops/nudft
+  ``route="pallas"``): opt-in, same rule.  (Its VMEM-phase-slab
+  predecessor measured 0.439x in round 4 and was deleted.)
+
+Off-TPU (CPU CI) every kernel runs in interpret mode automatically:
+numerics-only verdicts, timings are emulation.
 
     python benchmarks/pallas_ab.py
 
@@ -107,18 +118,123 @@ def ab_row_scrunch(iters: int, B: int = 64, R: int = 250, C: int = 512,
     return True if interpret else ok
 
 
-# ab_nudft lived here through round 4: the Pallas VMEM-phase NUDFT
-# measured 0.439x the production chunked einsum on-chip (23.6 ms vs
-# 10.4 ms at B=8, 512x256) with matching numerics (both 2.7e-5 scaled
-# vs the f64 oracle after _nudft_jax_reim gained Precision.HIGHEST), so
-# kernel and A/B were deleted per the prove-or-remove policy.
+def ab_sspec_fused(iters: int, B: int = 64, nf: int = 256, nt: int = 512,
+                   crop: int = 64, interpret: bool = False):
+    """Fused secondary-spectrum route (ops/sspec_pallas — prologue +
+    crop-split DFT + tiled epilogue) vs the production XLA op chain at
+    the bench epoch shape, with the arc-window delay crop both lanes
+    share.  The fused route is OPT-IN (`PipelineConfig.fused_sspec`):
+    this A/B is its wire/revert gate per ROADMAP item 4 — a fused
+    kernel that does not beat the chain gets reverted.
+
+    Numerics gate BEFORE any timing verdict: both lanes against the
+    f64 numpy oracle in linear power — the fused lane must not be
+    worse than 2x the chain's own f32 error (measured: the DFT split
+    is typically MORE accurate, its phases are f64-precomputed).
+    Interpret mode (CPU CI) exercises numerics only."""
+    import jax
+
+    from scintools_tpu.ops.sspec import _sspec_numpy, sspec
+    from scintools_tpu.ops.sspec_pallas import sspec_fused
+
+    rng = np.random.default_rng(0)
+    dyn = rng.standard_normal((B, nf, nt)).astype(np.float32)
+    dyn_d = jax.device_put(dyn)
+
+    chain = jax.jit(lambda d: sspec(d, db=False, backend="jax",
+                                    crop_rows=crop))
+    route = "pallas"
+    fused = jax.jit(lambda d: sspec_fused(d, db=False, crop_rows=crop,
+                                          route=route,
+                                          interpret=interpret))
+    # numerics first: one epoch vs the f64 oracle, linear power
+    oracle = _sspec_numpy(dyn[0].astype(np.float64), True, "blackman",
+                          0.1, False, "pow2", crop)
+    sc = np.max(np.abs(oracle))
+    err_c = float(np.max(np.abs(np.asarray(chain(dyn_d[:1]))[0]
+                                - oracle)) / sc)
+    err_f = float(np.max(np.abs(np.asarray(fused(dyn_d[:1]))[0]
+                                - oracle)) / sc)
+    if err_f > max(2.0 * err_c, 1e-4):
+        print(json.dumps({"kernel": "sspec_fused",
+                          "verdict": "numerics-mismatch",
+                          "chain_err": err_c, "fused_err": err_f}),
+              flush=True)
+        return False
+    base_ms = _time(chain, (dyn_d,), iters)
+    fused_ms = _time(fused, (dyn_d,), iters)
+    _emit("sspec_fused", fused_ms, base_ms, "xla op chain")
+    # opt-in kernel: a keep-off verdict keeps the knob off but is not a
+    # CI failure — the hard gate is numerics (above); the wire decision
+    # reads this JSON from the flight log
+    return True
+
+
+def ab_nudft(iters: int, nt: int = 512, nf: int = 256,
+             interpret: bool = False):
+    """Rotation-recurrence Pallas NUDFT tile (ops/nudft route="pallas")
+    vs the production chunked-einsum lowering.  OPT-IN kernel: its
+    predecessor (VMEM cos/sin phase slabs) measured 0.439x the einsum
+    in round 4 and was deleted; this design replaces per-sample
+    transcendentals with one complex multiply (the native kernels'
+    trick), so the verdict may differ — wire only on >= 1.15x with
+    matching numerics, per the same prove-or-remove policy."""
+    import jax
+
+    from scintools_tpu.ops.nudft import (_nudft_jax_reim,
+                                         _nudft_numpy,
+                                         _nudft_pallas_reim, _r_grid)
+
+    rng = np.random.default_rng(1)
+    power = rng.standard_normal((nt, nf)).astype(np.float32)
+    freqs = np.linspace(1300.0, 1500.0, nf)
+    fscale = freqs / freqs[nf // 2]
+    tsrc = np.arange(nt, dtype=np.float64)
+    r0, dr, nr = _r_grid(nt)
+
+    def pw(re, im):
+        return re * re + im * im
+
+    einsum = jax.jit(lambda p: pw(*_nudft_jax_reim(p, fscale, tsrc,
+                                                   r0, dr, nr)))
+    pallas = jax.jit(lambda p: pw(*_nudft_pallas_reim(
+        p, fscale, tsrc, r0, dr, nr, interpret=interpret)))
+    p_d = jax.device_put(power)
+    want = np.abs(_nudft_numpy(power.astype(np.float64), fscale, tsrc,
+                               r0, dr, nr)) ** 2
+    sc = want.max()
+    err_e = float(np.max(np.abs(np.asarray(einsum(p_d)) - want)) / sc)
+    err_p = float(np.max(np.abs(np.asarray(pallas(p_d)) - want)) / sc)
+    # the einsum's own on-chip budget is 2e-4 (tpu_recheck's bf16
+    # guard); hold the tile to the same oracle budget
+    if err_p > 2e-4:
+        print(json.dumps({"kernel": "nudft_pallas",
+                          "verdict": "numerics-mismatch",
+                          "einsum_err": err_e, "pallas_err": err_p}),
+              flush=True)
+        return False
+    base_ms = _time(einsum, (p_d,), iters)
+    pallas_ms = _time(pallas, (p_d,), iters)
+    _emit("nudft_pallas", pallas_ms, base_ms, "chunked einsum")
+    # opt-in kernel (route="pallas"): keep-off keeps it opt-in, the
+    # gate result is the numerics check above
+    return True
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--interpret", action="store_true",
+                    help="force interpret-mode kernels (numerics-only "
+                         "verdicts; auto-forced off-TPU)")
     args = ap.parse_args()
-    if not ab_row_scrunch(args.iters):
+    from scintools_tpu.ops.pallas_common import pallas_interpret_default
+
+    interpret = args.interpret or pallas_interpret_default()
+    ok = ab_row_scrunch(args.iters, interpret=interpret)
+    ok = ab_sspec_fused(args.iters, interpret=interpret) and ok
+    ok = ab_nudft(args.iters, interpret=interpret) and ok
+    if not ok:
         sys.exit(3)
 
 

@@ -192,7 +192,8 @@ def measured_roofline(gauges: dict | None) -> dict | None:
                 from ..utils.roofline import pipeline_epoch_model
 
                 b, nf, nt = (int(d) for d in dims)
-                m = pipeline_epoch_model(nf, nt)["total"]
+                model = pipeline_epoch_model(nf, nt)
+                m = model["total"]
                 row["model_flops"] = b * m["flops"]
                 row["model_bytes"] = b * m["bytes"]
                 if row.get("flops"):
@@ -201,6 +202,17 @@ def measured_roofline(gauges: dict | None) -> dict | None:
                 if row.get("bytes"):
                     row["bytes_vs_model"] = round(
                         row["bytes"] / row["model_bytes"], 2)
+                # per-stage BYTES split beside the flop split (one
+                # batch's worth, model-attributed): on a bandwidth-
+                # bound step the byte attribution is what makes a
+                # fused-vs-chain HBM-traffic claim readable from the
+                # trace rather than only from bench JSON
+                row["model_stage_gflop"] = {
+                    k: round(b * v["flops"] / 1e9, 3)
+                    for k, v in model.items() if k != "total"}
+                row["model_stage_gbytes"] = {
+                    k: round(b * v["bytes"] / 1e9, 3)
+                    for k, v in model.items() if k != "total"}
             except Exception:  # model must never sink the report
                 pass
     return rows
@@ -367,6 +379,14 @@ def render(spans: dict, counters: dict | None = None,
                          f"{row.get('flops_vs_model', '?')}, bytes x"
                          f"{row.get('bytes_vs_model', '?')}]")
             lines.append(part)
+            # per-stage split, flops AND bytes: the bytes column is the
+            # one a bandwidth-bound step's fusion work answers to
+            stages = row.get("model_stage_gbytes")
+            if stages:
+                gf = row.get("model_stage_gflop", {})
+                lines.append("    stage split (model): " + ", ".join(
+                    f"{k} {gf.get(k, 0.0):.3f} GFLOP / {v:.3f} GB"
+                    for k, v in stages.items()))
     serve = serve_section(counters, gauges)
     if serve:
         lines.append("")

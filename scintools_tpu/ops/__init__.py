@@ -5,5 +5,6 @@ from .nudft import (nudft, slow_ft, slow_ft_power,  # noqa: F401
                     slow_ft_power_sharded)
 from .scale import scale_lambda, scale_trapezoid  # noqa: F401
 from .sspec import next_pow2_fft_lens, sspec, sspec_axes  # noqa: F401
+from .sspec_pallas import sspec_fused  # noqa: F401
 from .svd import svd_model  # noqa: F401
 from .windows import apply_2d_window, split_window  # noqa: F401

@@ -24,11 +24,18 @@ Execution paths (all agree to float64 tolerances; see tests/test_nudft.py):
   frequency the phase matrix is a dense [nr, nt] complex operator, so the
   contraction is MXU-shaped and XLA pipelines chunk-by-chunk without ever
   materialising the full [nr, nt, nf] phase tensor.  (A Pallas VMEM-phase
-  kernel was A/B'd on-chip in round 4 and deleted: 0.44x the einsum —
-  see the note at the end of this file.)
+  kernel was A/B'd on-chip in round 4 and deleted: 0.44x the einsum.)
+* ``jax`` + ``route="pallas"`` — OPT-IN rotation-recurrence Pallas tile
+  (:func:`_nudft_pallas_reim`, end of this file): blocked on-chip
+  accumulation with one complex multiply per sample instead of cos+sin,
+  the native kernels' trick brought on-chip.  Gated by the
+  prove-or-remove A/B (benchmarks/pallas_ab.py) before it can become a
+  default.
 """
 
 from __future__ import annotations
+
+import functools
 
 import numpy as np
 
@@ -108,7 +115,8 @@ def _nudft_jax_reim(power, fscale, tsrc, r0, dr, nr, chunk_f: int = 16):
 
 
 def nudft(power, fscale, tsrc=None, r0=None, dr=None, nr=None,
-          backend: str = "numpy", use_native: bool | None = None):
+          backend: str = "numpy", use_native: bool | None = None,
+          route: str = "einsum", interpret=False):
     """NUDFT core: ``out[r, f] = sum_t cis(2*pi*(r0+r*dr)*tsrc[t]*fscale[f])
     * power[t, f]``.
 
@@ -116,7 +124,22 @@ def nudft(power, fscale, tsrc=None, r0=None, dr=None, nr=None,
     Doppler bins = fftfreq(ntime) sorted ascending — scint_utils.py:360-366).
     ``use_native=None`` tries the C++ library on the numpy backend and falls
     back silently.
+
+    ``route`` selects the jax lowering: ``"einsum"`` (the production
+    chunked-matvec path) or ``"pallas"`` (the rotation-recurrence tile,
+    :func:`_nudft_pallas_reim` — OPT-IN until its on-chip A/B returns a
+    "wire" verdict; requires a uniform host ``tsrc`` grid).
     """
+    if route not in ("einsum", "pallas"):
+        raise ValueError(f"nudft route must be 'einsum' or 'pallas', "
+                         f"got {route!r}")
+    if route == "pallas" and resolve(backend) != "jax":
+        # same contract as sspec(fused=True, backend="numpy"): silently
+        # running the numpy/native path would let an A/B believe it
+        # exercised the tile
+        raise ValueError("nudft(route='pallas') is a jax-path knob; "
+                         "the numpy/native backends have no Pallas "
+                         "lowering")
     ntime = power.shape[0]
     if tsrc is None:
         tsrc = np.arange(ntime, dtype=np.float64)  # host-f64: host grid precompute
@@ -128,7 +151,11 @@ def nudft(power, fscale, tsrc=None, r0=None, dr=None, nr=None,
     if resolve(backend) == "jax":
         from jax import lax
 
-        re, im = _nudft_jax_reim(power, fscale, tsrc, r0, dr, nr)
+        if route == "pallas":
+            re, im = _nudft_pallas_reim(power, fscale, tsrc, r0, dr, nr,
+                                        interpret=interpret)
+        else:
+            re, im = _nudft_jax_reim(power, fscale, tsrc, r0, dr, nr)
         # complex assembled ON DEVICE (supported on TPU); callers on real
         # TPU must not transfer it directly — use slow_ft_power, or
         # jnp.real/jnp.imag before the transfer (tpu-complex-unsupported).
@@ -254,11 +281,128 @@ def slow_ft_power_sharded(dyn, freqs, mesh, axis: str = "data",
 
 
 # ---------------------------------------------------------------------------
-# A Pallas NUDFT kernel (VMEM-generated phase slabs) lived here through
-# round 4.  It lowered and ran correctly on real Mosaic (rel err 2.7e-5
-# vs the f64 oracle at 512x256) but measured 0.44x the production
-# chunked-einsum path above (benchmarks history: pallas_ab.py round-4
-# verdict "keep-off" — the MXU contraction beats VPU cos/sin slabs for
-# this op), so it was deleted per the prove-or-remove policy
-# (docs/roadmap.md).  The fused row-scrunch kernel in resample_pallas.py
-# is the one that won its A/B and got wired.
+# Pallas NUDFT tile: rotation-recurrence blocked accumulation
+# ---------------------------------------------------------------------------
+#
+# History: a first Pallas NUDFT kernel (VMEM-generated cos/sin phase
+# slabs feeding the MXU) lived here through round 4; it lowered and ran
+# correctly on real Mosaic but measured 0.44x the production chunked
+# einsum above (pallas_ab.py round-4 verdict "keep-off") and was
+# deleted per the prove-or-remove policy.  The tile below is a
+# DIFFERENT design — the rotation-recurrence trick of the reference's
+# own native kernel (fit_1d-response.c) and ours (native/nudft.cc): on
+# a uniform time grid the per-sample phase STEP is constant per (r, f),
+# so the inner loop is one complex multiply-accumulate instead of
+# cos+sin per element; the transcendentals run only at block init and
+# at a periodic resync that bounds f32 drift.  It stays OPT-IN
+# (``route="pallas"``) until the on-chip A/B (benchmarks/pallas_ab.py,
+# driver scripts/tpu_recheck.sh) returns a "wire" verdict — the same
+# gate that killed its predecessor.
+
+
+def _nudft_pallas_kernel(power_ref, fs_ref, re_ref, im_ref, *,
+                         block_r: int, ntime: int, r0: float, dr: float,
+                         t0: float, dt: float, resync: int):
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    dtype = re_ref.dtype
+    fs = fs_ref[0:1, :]                                  # [1, Fb]
+    r_idx = (i * block_r
+             + lax.broadcasted_iota(jnp.int32, (block_r, 1), 0))
+    rv = r0 + dr * r_idx.astype(dtype)                   # [Rb, 1]
+    w = (2.0 * np.pi) * rv * fs                          # [Rb, Fb]
+    # e^{+i w dt}: the constant per-(r,f) rotation of one time step
+    step_re = jnp.cos(w * dt)
+    step_im = jnp.sin(w * dt)
+    zeros = jnp.zeros(w.shape, dtype)
+    nchunks = -(-ntime // resync)
+
+    def chunk(c, acc):
+        acc_re, acc_im = acc
+        t_base = c * resync
+        # exact phasor at the chunk head: cos/sin once per resync
+        # window, bounding the recurrence's f32 drift to ~resync*eps
+        ph0 = w * (t0 + t_base.astype(dtype) * dt)
+        state = (acc_re, acc_im, jnp.cos(ph0), jnp.sin(ph0))
+
+        def t_body(k, st):
+            a_re, a_im, p_re, p_im = st
+            p = power_ref[pl.ds(t_base + k, 1), :]       # [1, Fb]
+            a_re = a_re + p * p_re
+            a_im = a_im + p * p_im
+            # rotate: phasor *= e^{i w dt}
+            n_re = p_re * step_re - p_im * step_im
+            n_im = p_re * step_im + p_im * step_re
+            return (a_re, a_im, n_re, n_im)
+
+        n_in = jnp.minimum(resync, ntime - t_base)
+        acc_re, acc_im, _, _ = lax.fori_loop(0, n_in, t_body, state)
+        return (acc_re, acc_im)
+
+    acc_re, acc_im = lax.fori_loop(0, nchunks, chunk, (zeros, zeros))
+    re_ref[...] = acc_re
+    im_ref[...] = acc_im
+
+
+def _nudft_pallas_reim(power, fscale, tsrc, r0, dr, nr,
+                       block_r: int = 64, block_f: int = 128,
+                       resync: int = 64, interpret=False):
+    """Pallas NUDFT tile returning ``(re, im)`` — blocked on-chip
+    accumulation replacing the dense-matmul lowering: output tiles
+    [block_r, block_f] accumulate over time IN VMEM (the [nr, nt, nf]
+    phase tensor never exists anywhere), with the rotation recurrence
+    replacing per-sample cos/sin.
+
+    Requires a UNIFORM host-side ``tsrc`` (the driver's grid is
+    ``arange``): the recurrence needs a constant time step.  Real
+    dtypes only at every boundary, like :func:`_nudft_jax_reim`."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    from .pallas_common import resolve_interpret, round_up
+
+    tsrc = np.asarray(tsrc, dtype=np.float64)  # host-f64: uniform-grid check
+    if tsrc.ndim != 1 or tsrc.size < 2:
+        raise ValueError(f"pallas NUDFT needs a 1-D host tsrc grid of "
+                         f">= 2 samples, got shape {tsrc.shape}")
+    steps = np.diff(tsrc)
+    dt_t = float(steps[0])
+    if not np.allclose(steps, dt_t, rtol=1e-12, atol=0.0):
+        raise ValueError("pallas NUDFT requires a uniform tsrc grid "
+                         "(the rotation recurrence needs a constant "
+                         "time step); use the einsum route")
+    power = jnp.asarray(power)
+    if not jnp.issubdtype(power.dtype, jnp.floating):
+        power = power.astype(jnp.float32)
+    ntime, nfreq = power.shape
+    fscale = jnp.asarray(fscale, dtype=power.dtype)
+    nf_pad = round_up(nfreq, block_f)
+    nr_pad = round_up(nr, block_r)
+    pw = jnp.pad(power, ((0, 0), (0, nf_pad - nfreq)))
+    fs = jnp.pad(fscale, (0, nf_pad - nfreq))[None, :]   # [1, nf_pad]
+    grid = (nr_pad // block_r, nf_pad // block_f)
+    re, im = pl.pallas_call(
+        functools.partial(
+            _nudft_pallas_kernel, block_r=block_r, ntime=int(ntime),
+            r0=float(r0), dr=float(dr), t0=float(tsrc[0]), dt=dt_t,
+            resync=int(resync)),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ntime, block_f), lambda i, j: (0, j)),
+            pl.BlockSpec((1, block_f), lambda i, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_r, block_f), lambda i, j: (i, j)),
+            pl.BlockSpec((block_r, block_f), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nr_pad, nf_pad), power.dtype),
+            jax.ShapeDtypeStruct((nr_pad, nf_pad), power.dtype),
+        ],
+        interpret=resolve_interpret(interpret),
+    )(pw, fs)
+    return re[:nr, :nfreq], im[:nr, :nfreq]

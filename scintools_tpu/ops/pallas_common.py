@@ -1,0 +1,105 @@
+"""Shared grid/block-spec helpers for this package's Pallas TPU kernels.
+
+Factored out of the round-4 idiom template (``ops/resample_pallas.py``)
+so the fused secondary-spectrum kernels (``ops/sspec_pallas.py``) and
+the NUDFT tile (``ops/nudft.py``) state their Mosaic constraints once:
+
+* **Tiling** — the last two dims of every block must be multiples of
+  the (8, 128) f32 register tile or the full array dims (probed on the
+  axon TPU; violating the sublane rule dies in the backend, not in
+  tracing).  :func:`round_up` / :func:`pick_row_block` size row grids
+  accordingly.
+* **Residency** — a small operand revisited by every grid step uses a
+  constant-index BlockSpec (:func:`resident_spec`): Pallas keeps the
+  block in VMEM across steps instead of re-fetching per step.
+* **Interpret-mode routing** — :func:`pallas_interpret_default` is THE
+  trace-time "am I on a real TPU" probe every kernel's ``interpret=
+  "auto"`` resolves through (moved here from resample_pallas; the
+  f64-oracle re-trace contract is documented on the function).
+
+Everything here is host-side shape math plus spec construction — no
+device work, importable without jax installed until a spec is built.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "LANE",
+    "SUBLANE",
+    "round_up",
+    "pick_row_block",
+    "resident_spec",
+    "row_tile_spec",
+    "pallas_interpret_default",
+    "resolve_interpret",
+]
+
+# f32 register tile: (sublane, lane).  bf16 doubles the sublane minimum,
+# but every kernel in this package computes in f32 (the bf16_io policy
+# upcasts at the step top — scripts/check_f32_discipline.py guards it).
+LANE = 128
+SUBLANE = 8
+
+
+def round_up(n: int, multiple: int) -> int:
+    """Smallest multiple of ``multiple`` >= ``n`` (>= 1)."""
+    n = max(int(n), 1)
+    multiple = max(int(multiple), 1)
+    return -(-n // multiple) * multiple
+
+
+def pick_row_block(rows: int, candidates: tuple = (64, 32, 16, 8)) -> int:
+    """Largest candidate row-block size that divides ``rows`` (which the
+    caller has already rounded up to a SUBLANE multiple).  Falls back to
+    SUBLANE — every SUBLANE-multiple is divisible by it."""
+    rows = int(rows)
+    for c in candidates:
+        if rows % int(c) == 0 and rows >= int(c):
+            return int(c)
+    return SUBLANE
+
+
+def resident_spec(shape: tuple):
+    """BlockSpec pinning the FULL array as one block with a constant
+    index map (the variadic lambda fits any grid rank): the operand
+    stays VMEM-resident across every grid step (the revisit idiom —
+    small inputs read by all blocks)."""
+    from jax.experimental import pallas as pl
+
+    zeros = (0,) * len(shape)
+    return pl.BlockSpec(tuple(int(s) for s in shape),
+                        lambda *_i: zeros)
+
+
+def row_tile_spec(block_rows: int, ncols: int):
+    """BlockSpec tiling a [rows, ncols] array over a 1-D row grid:
+    block ``i`` covers rows ``[i*block_rows, (i+1)*block_rows)`` and the
+    full lane axis (full-dim lanes satisfy Mosaic for any ncols)."""
+    from jax.experimental import pallas as pl
+
+    return pl.BlockSpec((int(block_rows), int(ncols)), lambda i: (i, 0))
+
+
+def pallas_interpret_default() -> bool:
+    """True when Pallas must run in interpret mode: the execution target
+    is not a real TPU.  Reads ``jax.default_device`` overrides first —
+    ``jax.default_backend()`` still reports "tpu" inside a
+    ``with jax.default_device(cpu)`` block, which is exactly how the f64
+    oracle re-traces a TPU-built pipeline on host."""
+    import jax
+
+    dev = getattr(jax.config, "jax_default_device", None)
+    # jax.default_device accepts a Device object OR a platform string
+    platform = (dev if isinstance(dev, str)
+                else getattr(dev, "platform", None)) or jax.default_backend()
+    return platform != "tpu"
+
+
+def resolve_interpret(interpret) -> bool:
+    """Resolve a kernel's ``interpret`` argument: ``"auto"`` probes the
+    execution target at TRACE time (so a TPU-built pipeline re-traced
+    under ``jax.default_device(cpu)`` flips to interpret mode instead of
+    failing to lower); booleans pass through."""
+    if interpret == "auto":
+        return pallas_interpret_default()
+    return bool(interpret)

@@ -25,7 +25,14 @@ import functools
 
 import numpy as np
 
-__all__ = ["row_scrunch_pallas", "row_scrunch_scan"]
+# the "am I on a real TPU" trace-time probe moved to the shared helper
+# layer (ops/pallas_common) when the fused sspec kernels joined; the
+# re-export keeps the historical import site working (the arc fitter
+# and tests import it from here)
+from .pallas_common import pallas_interpret_default  # noqa: F401
+
+__all__ = ["row_scrunch_pallas", "row_scrunch_scan",
+           "pallas_interpret_default"]
 
 
 def row_scrunch_scan(rows, i0, w, block_r: int = 64):
@@ -200,21 +207,6 @@ def _build(R: int, C: int, n: int, block_r: int, interpret: bool):
                          jnp.nan)
 
     return jax.jit(run)
-
-
-def pallas_interpret_default() -> bool:
-    """True when Pallas must run in interpret mode: the execution target
-    is not a real TPU.  Reads ``jax.default_device`` overrides first —
-    ``jax.default_backend()`` still reports "tpu" inside a
-    ``with jax.default_device(cpu)`` block, which is exactly how the f64
-    oracle re-traces a TPU-built pipeline on host."""
-    import jax
-
-    dev = getattr(jax.config, "jax_default_device", None)
-    # jax.default_device accepts a Device object OR a platform string
-    platform = (dev if isinstance(dev, str)
-                else getattr(dev, "platform", None)) or jax.default_backend()
-    return platform != "tpu"
 
 
 def row_scrunch_pallas(rows, i0, w, block_r: int = 64,

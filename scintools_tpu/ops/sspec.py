@@ -3,7 +3,7 @@
 Reference: ``Dynspec.calc_sspec`` (dynspec.py:1228-1335).  Pipeline:
 
     mean-subtract -> split edge window -> mean-subtract again ->
-    prewhiten (first difference both axes) -> fft2 padded to next-pow2*2 ->
+    prewhiten (2x2 second difference) -> fft2 padded to next-pow2*2 ->
     |.|^2 -> fftshift -> keep positive delays -> postdarken (divide by the
     sin^2 response of the prewhitening filter) -> 10*log10
 
@@ -105,7 +105,8 @@ def sspec_axes(nf: int, nt: int, dt, df, dlam=None, lens: str = "pow2"):
 
 def sspec(dyn, prewhite: bool = True, window: str | None = "blackman",
           window_frac: float = 0.1, db: bool = True, backend: str = "numpy",
-          lens: str = "pow2", crop_rows: int | None = None):
+          lens: str = "pow2", crop_rows: int | None = None,
+          fused: bool = False):
     """Secondary spectrum of ``dyn`` [..., nf, nt].
 
     Returns sec [..., nrfft/2, ncfft] in dB (positive delays only).
@@ -118,8 +119,19 @@ def sspec(dyn, prewhite: bool = True, window: str | None = "blackman",
     touches ONLY the consumed sub-region, so a consumer that reads a
     delay window (the arc fitter's delmax crop) never round-trips the
     full padded spectrum through HBM.
+
+    ``fused=True`` (jax backend only — ``PipelineConfig.fused_sspec``)
+    dispatches to the fused prologue/epilogue kernels of
+    :mod:`scintools_tpu.ops.sspec_pallas` (Pallas on a real TPU, an
+    equivalently-restructured XLA lowering elsewhere).  Opt-in and NOT
+    bit-identical to this chain — fits agree within the documented 2 %
+    budget; the default path below is byte-for-byte unchanged.
     """
     backend = resolve(backend)
+    if fused and backend != "jax":
+        raise ValueError("sspec(fused=True) is a jax-path knob (the "
+                         "Pallas/XLA fused kernels); the numpy parity "
+                         "path stays unfused by contract")
     shape = np.shape(dyn)  # works for lists and device arrays alike
     if len(shape) < 2 or shape[-2] < 2 or shape[-1] < 2:
         raise ValueError(f"secondary spectrum needs at least a 2x2 "
@@ -141,6 +153,10 @@ def sspec(dyn, prewhite: bool = True, window: str | None = "blackman",
                 return out.reshape(lead + out.shape[-2:])
             return _sspec_numpy(arr, prewhite, window, window_frac, db,
                                 lens, crop_rows)
+        if fused:
+            return obs.fence(_sspec_fused_jit()(dyn, prewhite, window,
+                                                window_frac, db, lens,
+                                                crop_rows))
         return obs.fence(_sspec_jax()(dyn, prewhite, window, window_frac,
                                       db, lens, crop_rows))
 
@@ -194,6 +210,25 @@ def _sspec_numpy(dyn, prewhite, window, window_frac, db, lens="pow2",
         with np.errstate(divide="ignore"):
             sec = 10 * np.log10(sec)
     return sec
+
+
+@functools.lru_cache(maxsize=1)
+def _sspec_fused_jit():
+    """jit wrapper of the fused route (ops/sspec_pallas.sspec_fused)
+    mirroring :func:`_sspec_jax`'s static-argument layout, so eager
+    callers get one compiled program per option set and traced callers
+    (the batched step) inline it."""
+    import jax
+
+    from .sspec_pallas import sspec_fused
+
+    @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5, 6))
+    def impl(dyn, prewhite, window, window_frac, db, lens, crop_rows):
+        return sspec_fused(dyn, prewhite=prewhite, window=window,
+                           window_frac=window_frac, db=db, lens=lens,
+                           crop_rows=crop_rows, route="auto",
+                           interpret="auto")
+    return impl
 
 
 @functools.lru_cache(maxsize=1)

@@ -1,0 +1,498 @@
+"""Fused on-chip secondary-spectrum kernels (Pallas) + the fused route.
+
+BENCH_r05 measured the compiled step bandwidth-bound at 5.98 % of the
+TPU v5 lite roofline (AI ~ 6 flop/byte): ``ops/sspec.py``'s jax path is
+a chain of discrete XLA ops (mean-sub -> window -> prewhiten diff ->
+rfftn -> |.|^2 -> fftshift -> postdark -> log10) each round-tripping the
+padded grid through HBM.  The GPU FDAS literature (arXiv:1711.10855,
+arXiv:1804.05335) got its wins by fusing FFT-domain prologues/epilogues
+instead of running op chains — this module is that shape for the
+secondary spectrum:
+
+* :func:`sspec_prologue_pallas` — mean-subtract + split-edge window +
+  2x2 prewhiten second-difference + zero-pad in ONE pass, writing
+  directly into the FFT-input buffer (one HBM write instead of four
+  intermediate round-trips).
+* :func:`sspec_epilogue_pallas` — |.|^2 + Doppler fftshift + postdark
+  divide + 10*log10 + delay-row crop off the FFT output tile-by-tile.
+  The fftshift costs ZERO extra traffic: with column tiles of half the
+  Doppler axis, the shift is pure block-index remapping (out tile j
+  reads in tile 1-j), and the postdark response is generated from iota
+  on-core instead of read from a precomputed HBM array.
+* :func:`sspec_fused` — the routed fused op ``PipelineConfig.
+  fused_sspec`` dispatches to: the Pallas kernels on a real TPU, an
+  equivalently-restructured pure-XLA lowering elsewhere (interpret-mode
+  Pallas emulation inflates the very HBM traffic being engineered —
+  measured: a 4-step grid costs grid x full-buffer dynamic-update-slice
+  passes on the CPU backend).
+
+The structural win both lowerings share — the crop-fused FFT split: when
+the arc fitter's delay window keeps R <= nrfft/4 rows, the delay-axis
+transform runs as an exact R-row DFT MATMUL over only the nf-1 nonzero
+input rows (zero padding contributes nothing to the sum), the Doppler
+FFT then transforms ONLY those R rows, and the full padded spectrum is
+never materialised.  Measured XLA ``cost_analysis()`` bytes-accessed at
+the 256x512 pow2 signature (CPU backend, tier-1-asserted in
+tests/test_sspec_pallas.py): crop=64 11.30 MB -> 7.21 MB (-36 %),
+crop=45 -44 %; the matmul is MXU-shaped on TPU (``Precision.HIGHEST``
+pinned — the same bf16-lowering guard as ops/nudft.py's einsum).
+
+Parity contract: the fused route is opt-in and NOT bit-identical to the
+chain (fp association differs through the split transform); tau/dnu/eta
+agree within the documented 2 % fit budget (tier-1-tested) and the
+unfused/numpy paths are untouched.  The prove-or-remove A/B lives in
+``benchmarks/pallas_ab.py`` (driver: scripts/tpu_recheck.sh) — a fused
+kernel that does not move measured ``step_bytes``/``roofline_pct`` gets
+reverted per ROADMAP.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .pallas_common import (SUBLANE, pick_row_block, resident_spec,
+                            resolve_interpret, round_up, row_tile_spec)
+from .windows import split_window
+
+__all__ = [
+    "sspec_fused",
+    "sspec_prologue_pallas",
+    "sspec_epilogue_pallas",
+    "fused_route_default",
+    "use_dft_pass1",
+]
+
+
+# ---------------------------------------------------------------------------
+# routing rules
+# ---------------------------------------------------------------------------
+
+
+def use_dft_pass1(crop_rows: int | None, nrfft: int) -> bool:
+    """Whether the crop-fused FFT split pays: the R-row DFT matmul +
+    R-row Doppler FFT beats the full-grid rfftn only while the kept
+    delay window is small — measured break-even on the CPU cost model
+    at R ~ nrfft/4 (R = nrfft/8 -> -36 % bytes, R = nrfft/4 -> ~-12 %,
+    above that the R x ncfft complex pad round-trip wins back).  One
+    rule site shared by both lowerings and the byte-drop test."""
+    return crop_rows is not None and int(crop_rows) <= int(nrfft) // 4
+
+
+def _pallas_conforming(nrfft: int, ncfft: int) -> bool:
+    """Shapes the real-Mosaic kernels tile: the epilogue's fftshift
+    block remap needs half the Doppler axis to be a 128-lane multiple
+    (pow2 grids >= 256 always conform; 5-smooth "fast" grids like 600
+    do not and take the XLA lowering instead — same demotion style as
+    resample_pallas's 128-lane gather gate)."""
+    return (ncfft % 256 == 0 and nrfft % SUBLANE == 0
+            and nrfft >= 2 * SUBLANE)
+
+
+def fused_route_default(nrfft: int, ncfft: int) -> str:
+    """Trace-time route resolution for ``sspec_fused(route="auto")``:
+    Pallas kernels on a real TPU with conforming grids, the
+    restructured XLA lowering everywhere else (CPU CI, the f64-oracle
+    re-trace, non-conforming fast-composite grids)."""
+    from .pallas_common import pallas_interpret_default
+
+    if pallas_interpret_default():
+        return "xla"
+    return "pallas" if _pallas_conforming(nrfft, ncfft) else "xla"
+
+
+@functools.lru_cache(maxsize=32)
+def _window_vectors(nf: int, nt: int, window: str | None,
+                    window_frac: float) -> tuple:
+    """Host-side split-window row/column tapers (ones when windowing is
+    off) plus their product sum — static per template, folded into the
+    trace as constants exactly like the chain's apply_2d_window."""
+    if window is None:
+        fw = np.ones(nf)
+        tw = np.ones(nt)
+    else:
+        fw = split_window(nf, window, window_frac)
+        tw = split_window(nt, window, window_frac)
+    return fw, tw, float(fw.sum() * tw.sum())
+
+
+@functools.lru_cache(maxsize=32)
+def _dft_mats(R: int, rows: int, nrfft: int) -> tuple:
+    """cos/sin DFT matrices [R, rows] of the delay-axis transform
+    (``X[r] = sum_k pw[k] * e^{-2pi i r k / nrfft}``), built host-side
+    in f64 and cast to f32 constants (phase accuracy must not depend on
+    f32 evaluation of large 2*pi*r*k products)."""
+    ph = (2.0 * np.pi / nrfft) * np.outer(np.arange(R, dtype=np.float64),  # host-f64: DFT phase precompute
+                                          np.arange(rows, dtype=np.float64))  # host-f64: DFT phase precompute
+    return (np.cos(ph).astype(np.float32),
+            np.sin(ph).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# prologue kernel: mean-sub + window + prewhiten + zero-pad, one pass
+# ---------------------------------------------------------------------------
+
+
+def _prologue_kernel(dp_ref, fw_ref, tw_ref, m2_ref, out_ref, *,
+                     rb: int, nf: int, nt: int, prewhite: bool,
+                     out_cols: int):
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    r0 = i * rb
+    valid_rows = nf - 1 if prewhite else nf
+    dtype = out_ref.dtype
+
+    @pl.when(r0 < valid_rows)
+    def _compute():
+        # rb+1 rows cover the second-difference stencil; the input is
+        # padded past nf so the slice never clamps (clamping would
+        # misalign the straddle block's valid rows)
+        a = dp_ref[pl.ds(r0, rb + 1), :]              # [rb+1, nt]
+        wv = fw_ref[pl.ds(r0, rb + 1), :]             # [rb+1, 1]
+        dw = a * wv * tw_ref[0:1, :] - m2_ref[0:1, 0:1]
+        if prewhite:
+            # separable 2nd difference == convolve2d([[1,-1],[-1,1]])
+            blk = (dw[1:, 1:] - dw[1:, :-1]
+                   - dw[:-1, 1:] + dw[:-1, :-1])      # [rb, nt-1]
+            ncols_v = nt - 1
+        else:
+            blk = dw[:rb, :]
+            ncols_v = nt
+        rows = r0 + lax.broadcasted_iota(jnp.int32, (rb, 1), 0)
+        blk = jnp.where(rows < valid_rows, blk, jnp.zeros((), dtype))
+        if out_cols > ncols_v:
+            blk = jnp.pad(blk, ((0, 0), (0, out_cols - ncols_v)))
+        out_ref[...] = blk
+
+    @pl.when(r0 >= valid_rows)
+    def _zero_pad():
+        out_ref[...] = jnp.zeros((rb, out_cols), dtype)
+
+
+def sspec_prologue_pallas(dyn, m1, m2, window: str | None = "blackman",
+                          window_frac: float = 0.1, *, out_rows: int,
+                          out_cols: int, prewhite: bool = True,
+                          block_rows: int | None = None,
+                          interpret=False):
+    """Fused FFT prologue: ``(dyn - m1) * W - m2``, prewhitened
+    (2x2 second difference) and zero-padded to ``[out_rows, out_cols]``
+    — the delay-axis FFT's input buffer — in ONE kernel pass.
+
+    ``dyn`` [nf, nt] f32; ``m1``/``m2`` the chain's two mean
+    subtractions (traced scalars — the caller computes them as fused
+    reductions, see :func:`sspec_fused`); the window tapers are static
+    host-side constants.  The chain's four elementwise intermediates
+    (mean-sub, windowed, re-centred, prewhitened) never touch HBM.
+    vmap over a batch axis works (pallas batching rule).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    dyn = jnp.asarray(dyn)
+    nf, nt = dyn.shape
+    out_rows = round_up(out_rows, SUBLANE)
+    rb = (pick_row_block(out_rows) if block_rows is None
+          else int(block_rows))
+    if out_rows % rb:
+        raise ValueError(f"block_rows={rb} must divide out_rows="
+                         f"{out_rows}")
+    fw, tw, _sw = _window_vectors(nf, nt, window, float(window_frac))
+    # input padded past the last stencil read so dynamic slices never
+    # clamp (pad rows are masked out of the output anyway)
+    nf_pad = round_up(nf + rb + 1, SUBLANE)
+    dp = jnp.pad(dyn - m1, ((0, nf_pad - nf), (0, 0)))
+    fwp = jnp.zeros((nf_pad, 1), dyn.dtype).at[:nf, 0].set(
+        jnp.asarray(fw, dyn.dtype))
+    twp = jnp.asarray(tw, dyn.dtype)[None, :]
+    m2a = jnp.full((1, 1), 1.0, dyn.dtype) * m2
+    grid = (out_rows // rb,)
+    return pl.pallas_call(
+        functools.partial(_prologue_kernel, rb=rb, nf=nf, nt=nt,
+                          prewhite=bool(prewhite), out_cols=int(out_cols)),
+        grid=grid,
+        in_specs=[
+            resident_spec((nf_pad, nt)),
+            resident_spec((nf_pad, 1)),
+            resident_spec((1, nt)),
+            resident_spec((1, 1)),
+        ],
+        out_specs=row_tile_spec(rb, int(out_cols)),
+        out_shape=jax.ShapeDtypeStruct((out_rows, int(out_cols)),
+                                       dyn.dtype),
+        interpret=resolve_interpret(interpret),
+    )(dp, fwp, twp, m2a)
+
+
+# ---------------------------------------------------------------------------
+# epilogue kernel: |.|^2 + fftshift + postdark + log10 + crop, tiled
+# ---------------------------------------------------------------------------
+
+
+def _epilogue_kernel(re_ref, im_ref, out_ref, *, rb: int, H: int,
+                     nrfft: int, ncfft: int, prewhite: bool, db: bool):
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    dtype = out_ref.dtype
+    re = re_ref[...]
+    im = im_ref[...]
+    sec = re * re + im * im
+    if prewhite:
+        # postdark generated on-core from iota: the sin^2 response of
+        # the 2x2 prewhitening filter, singular fdop=0 column / tdel=0
+        # row forced to 1 (dynspec.py:1308-1309).  Output col c of
+        # block j is c = j*H + l -> fd = c - H = (j-1)*H + l, already
+        # the well-conditioned +-H/2-centred argument (evaluating
+        # sin(pi*c/ncfft) near pi instead loses the small postdark
+        # values to cancellation — measured 1e-4-scale spectrum errors)
+        row = (i * rb
+               + lax.broadcasted_iota(jnp.int32, (rb, H), 0))
+        fd = ((j - 1) * H
+              + lax.broadcasted_iota(jnp.int32, (rb, H), 1))
+        v2 = jnp.sin((np.pi / nrfft) * row.astype(dtype)) ** 2
+        v1 = jnp.sin((np.pi / ncfft) * fd.astype(dtype)) ** 2
+        pd = jnp.where((row == 0) | (fd == 0), jnp.ones((), dtype),
+                       v2 * v1)
+        sec = sec / pd
+    if db:
+        sec = 10.0 * jnp.log10(sec)
+    out_ref[...] = sec
+
+
+def sspec_epilogue_pallas(re, im, *, nrfft: int, ncfft: int,
+                          prewhite: bool = True, db: bool = True,
+                          block_rows: int | None = None,
+                          interpret=False):
+    """Fused FFT epilogue over the (already delay-cropped) Doppler-axis
+    FFT output: power, Doppler fftshift, postdark divide and dB — all
+    tile-by-tile, never materialising intermediate spectra.
+
+    ``re``/``im`` [R, ncfft] f32 (real/imaginary planes — Mosaic has no
+    complex dtype, and the axon TPU backend implements no complex ops;
+    see ops/nudft.py's re/im convention).  Rows are the kept delay
+    window (crop already applied by the caller's row slice — this
+    kernel only ever touches consumed rows).  The Doppler fftshift is
+    block-index remapping: output column tile ``j`` (of 2 half-axis
+    tiles) reads input tile ``1-j`` — zero extra HBM traffic.  Returns
+    [R, ncfft].  vmap over a batch axis works.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    re = jnp.asarray(re)
+    im = jnp.asarray(im)
+    if re.shape != im.shape:
+        raise ValueError(f"re/im shape mismatch: {re.shape} vs {im.shape}")
+    R, nc = re.shape
+    if nc != ncfft:
+        raise ValueError(f"expected {ncfft} Doppler columns, got {nc}")
+    if ncfft % 2:
+        raise ValueError(f"ncfft must be even (fftshift halves), got "
+                         f"{ncfft}")
+    H = ncfft // 2
+    R_pad = round_up(R, SUBLANE)
+    rb = (pick_row_block(R_pad) if block_rows is None else int(block_rows))
+    if R_pad % rb:
+        raise ValueError(f"block_rows={rb} must divide padded R={R_pad}")
+    if R_pad != R:
+        # pad value 1.0 keeps the padded rows' log10 finite (they are
+        # sliced off below; -inf there would only trip jax_debug_nans
+        # during exactly the hardware A/B this kernel exists for)
+        re = jnp.pad(re, ((0, R_pad - R), (0, 0)), constant_values=1.0)
+        im = jnp.pad(im, ((0, R_pad - R), (0, 0)), constant_values=0.0)
+    shift_spec = pl.BlockSpec((rb, H), lambda i, j: (i, 1 - j))
+    out = pl.pallas_call(
+        functools.partial(_epilogue_kernel, rb=rb, H=H, nrfft=int(nrfft),
+                          ncfft=int(ncfft), prewhite=bool(prewhite),
+                          db=bool(db)),
+        grid=(R_pad // rb, 2),
+        in_specs=[shift_spec, shift_spec],
+        out_specs=pl.BlockSpec((rb, H), lambda i, j: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((R_pad, ncfft), re.dtype),
+        interpret=resolve_interpret(interpret),
+    )(re, im)
+    return out[:R]
+
+
+# ---------------------------------------------------------------------------
+# the fused op
+# ---------------------------------------------------------------------------
+
+
+def _prewhiten2x2(dw):
+    """Separable 2x2 second difference == convolve2d([[1,-1],[-1,1]],
+    'valid') — ONE definition for both XLA branches, so a numerics fix
+    can never split the two fused forms silently."""
+    return dw[1:, 1:] - dw[1:, :-1] - dw[:-1, 1:] + dw[:-1, :-1]
+
+
+def _means(d, fw, tw, sw: float, window, chain_exact: bool):
+    """The chain's two mean subtractions: ``(m1, m2, dwc)`` where
+    ``dwc`` is the fully re-centred windowed array (or None).
+
+    ``chain_exact`` (the XLA lowering) materialises the windowed array
+    for m2 exactly like the chain — XLA fuses it away, and the parity
+    vs the chain tightens ~4x at small postdark-amplified crops.  The
+    Pallas lowering computes m2 as one weighted reduction instead
+    (``(sum(d*W) - m1*sum(W)) / N``), preserving the prologue's
+    one-write contract; the difference is fp-rounding-level and inside
+    the fused route's documented fit budget."""
+    import jax.numpy as jnp
+
+    nf, nt = d.shape[-2], d.shape[-1]
+    m1 = jnp.mean(d)
+    if chain_exact:
+        dw = d - m1
+        if window is not None:
+            dw = dw * tw[None, :] * fw[:, None]
+        m2 = jnp.mean(dw)
+        # the second subtraction is analytically a no-op under the
+        # prewhitening difference, but its fp residue is postdark-
+        # amplified at low delays — keep it, exactly like the chain
+        return m1, m2, dw - m2
+    m2 = (jnp.sum(d * fw[:, None] * tw[None, :])
+          - m1 * jnp.asarray(sw, d.dtype)) / (nf * nt)
+    return m1, m2, None
+
+
+def _pass2_and_epilogue(X, R: int, nrfft: int, ncfft: int, prewhite: bool,
+                        db: bool, route: str, interpret) -> "object":
+    """Doppler-axis FFT epilogue shared by both pass-1 forms: ``X``
+    [R, ncfft] complex -> shifted/postdarkened/dB [R, ncfft] real."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if route == "pallas":
+        return sspec_epilogue_pallas(jnp.real(X), jnp.imag(X),
+                                     nrfft=nrfft, ncfft=ncfft,
+                                     prewhite=prewhite, db=db,
+                                     interpret=interpret)
+    sec = jnp.real(X) ** 2 + jnp.imag(X) ** 2
+    if prewhite:
+        td = lax.iota(sec.dtype, R)[:, None]
+        cc = lax.iota(jnp.int32, ncfft)[None, :]
+        # well-conditioned postdark argument: evaluate sin at the
+        # +-H-centred Doppler index, not near pi (see epilogue kernel)
+        fd = (cc - jnp.where(cc >= ncfft // 2, ncfft, 0)).astype(sec.dtype)
+        pd = jnp.where((td == 0) | (cc == 0), jnp.ones((), sec.dtype),
+                       jnp.sin((np.pi / nrfft) * td) ** 2
+                       * jnp.sin((np.pi / ncfft) * fd) ** 2)
+        sec = sec / pd
+    if db:
+        sec = 10.0 * jnp.log10(sec)
+    # ONE roll moves the unshifted-order result into the chain's
+    # fftshifted layout (the pallas epilogue does this as block-index
+    # remapping instead)
+    return jnp.roll(sec, ncfft // 2, axis=-1)
+
+
+def _sspec_fused_2d(d, prewhite: bool, window, window_frac: float,
+                    db: bool, lens: str, crop_rows, route: str,
+                    interpret):
+    """One-epoch fused secondary spectrum (see :func:`sspec_fused`)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    from .sspec import fft_lens
+
+    nf, nt = d.shape
+    nrfft, ncfft = fft_lens(nf, nt, lens)
+    R = nrfft // 2 if crop_rows is None else int(crop_rows)
+    if route == "auto":
+        route = fused_route_default(nrfft, ncfft)
+    fw_np, tw_np, sw = _window_vectors(nf, nt, window, float(window_frac))
+    fw = jnp.asarray(fw_np, d.dtype)
+    tw = jnp.asarray(tw_np, d.dtype)
+    m1, m2, dw = _means(d, fw, tw, sw, window,
+                        chain_exact=(route != "pallas"))
+    ntw = nt - 1 if prewhite else nt
+
+    if use_dft_pass1(crop_rows, nrfft):
+        # crop-fused FFT split: the delay transform evaluates ONLY the
+        # R kept rows, as an exact DFT matmul over the nf-1 nonzero
+        # input rows (zero padding contributes nothing to the sum) —
+        # MXU-shaped on TPU, and the full [nrfft/2, ncfft] spectrum is
+        # never materialised
+        if route == "pallas":
+            rows = round_up(nf - 1 if prewhite else nf, SUBLANE)
+            pw = sspec_prologue_pallas(
+                d, m1, m2, window, window_frac, out_rows=rows,
+                out_cols=ntw, prewhite=prewhite, interpret=interpret)
+        else:
+            pw = _prewhiten2x2(dw) if prewhite else dw
+            rows = pw.shape[0]
+        C, S = _dft_mats(R, int(rows), nrfft)
+        hi = lax.Precision.HIGHEST
+        # HIGHEST precision: the MXU's default bf16 passes cost ~100x
+        # accuracy on exactly this contraction class (the ops/nudft.py
+        # on-chip finding; scripts/tpu_recheck.sh guards it there)
+        re1 = jnp.matmul(jnp.asarray(C, d.dtype), pw, precision=hi)
+        im1 = -jnp.matmul(jnp.asarray(S, d.dtype), pw, precision=hi)
+        X = jnp.fft.fft(lax.complex(re1, im1), n=ncfft, axis=-1)
+        return _pass2_and_epilogue(X, R, nrfft, ncfft, prewhite, db,
+                                   route, interpret)
+
+    # wide-window form: same padded-grid rfftn as the chain (the real
+    # delay axis is already Hermitian-halved there; a transform split
+    # would only add a complex-pad round-trip), with the prologue fused
+    # into one padded write and the epilogue restructured/tiled
+    if route == "pallas":
+        P = sspec_prologue_pallas(
+            d, m1, m2, window, window_frac, out_rows=nrfft,
+            out_cols=ncfft, prewhite=prewhite, interpret=interpret)
+        X = jnp.fft.rfftn(P, axes=(-1, -2))[:R, :]
+    else:
+        pw = _prewhiten2x2(dw) if prewhite else dw
+        X = jnp.fft.rfftn(pw, s=(ncfft, nrfft), axes=(-1, -2))[:R, :]
+    return _pass2_and_epilogue(X, R, nrfft, ncfft, prewhite, db,
+                               route, interpret)
+
+
+def sspec_fused(dyn, prewhite: bool = True, window: str | None = "blackman",
+                window_frac: float = 0.1, db: bool = True,
+                lens: str = "pow2", crop_rows: int | None = None,
+                route: str = "auto", interpret=False):
+    """Fused secondary spectrum of ``dyn`` [..., nf, nt] — the
+    ``PipelineConfig.fused_sspec`` jax-path implementation.
+
+    Same contract as :func:`scintools_tpu.ops.sspec.sspec` (jax
+    backend): returns [..., R, ncfft] in dB, positive delays only,
+    ``crop_rows`` keeping the first R rows.  NOT bit-identical to the
+    chain (fp association differs through the fused/split transform);
+    fit-level parity is the documented 2 % budget.
+
+    ``route``: ``"pallas"`` (real-Mosaic kernels; ``interpret=True``
+    for CPU parity tests), ``"xla"`` (the restructured pure-XLA
+    lowering), or ``"auto"`` (trace-time: pallas on a real TPU with
+    conforming grids, xla elsewhere).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    dyn = jnp.asarray(dyn)
+    if dyn.ndim < 2 or dyn.shape[-2] < 2 or dyn.shape[-1] < 2:
+        raise ValueError(f"secondary spectrum needs at least a 2x2 "
+                         f"dynspec, got {dyn.shape} (prewhitening "
+                         f"differences both axes)")
+    if route not in ("auto", "pallas", "xla"):
+        raise ValueError(f"sspec_fused route must be 'auto', 'pallas' "
+                         f"or 'xla', got {route!r}")
+    core = functools.partial(_sspec_fused_2d, prewhite=bool(prewhite),
+                             window=window, window_frac=float(window_frac),
+                             db=bool(db), lens=lens, crop_rows=crop_rows,
+                             route=route, interpret=interpret)
+    if dyn.ndim == 2:
+        return core(dyn)
+    lead = dyn.shape[:-2]
+    flat = dyn.reshape((-1,) + dyn.shape[-2:])
+    out = jax.vmap(core)(flat)
+    return out.reshape(lead + out.shape[-2:])

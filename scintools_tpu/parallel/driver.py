@@ -141,6 +141,18 @@ class PipelineConfig:
     # window shrinks to the cropped grid, so errors differ slightly —
     # hence opt-in.  Requires fit_arc + norm_sspec and no return_sspec.
     sspec_crop: bool = False
+    # Fused secondary-spectrum kernels (ops/sspec_pallas): prologue
+    # (mean-sub + window + prewhiten + pad in ONE FFT-input write) and
+    # epilogue (|.|^2 + fftshift + postdark + dB + delay crop,
+    # tile-by-tile) as Pallas kernels on a real TPU, an equivalently-
+    # restructured XLA lowering elsewhere; with sspec_crop the delay
+    # transform shrinks to an R-row DFT matmul and the full padded
+    # spectrum is never materialised (measured cost_analysis() bytes
+    # -36 % at the 256x512 crop signature — docs/performance.md "Fused
+    # kernels").  Opt-in: NOT bit-identical to the chain (fits agree
+    # within the 2 % budget); default off keeps every existing output
+    # byte-identical.  CLI: --fused-sspec.
+    fused_sspec: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -267,6 +279,11 @@ def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
             "delay-window crop into the step: it requires fit_arc=True "
             "with arc_method='norm_sspec' and return_sspec=False (a "
             "returned spectrum must be the full grid)")
+    if config.fused_sspec and _resolve_chan_sharded(mesh, chan_sharded):
+        raise ValueError(
+            "PipelineConfig.fused_sspec does not support a chan-sharded "
+            "mesh yet: the fused kernels tile a single device's spectrum "
+            "(the channel-sharded FFT path keeps the unfused chain)")
     if config.arc_stack and (config.arc_method != "norm_sspec"
                              or not config.fit_arc
                              or config.arc_brackets is not None):
@@ -676,7 +693,8 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded,
                              window=config.window,
                              window_frac=config.window_frac, db=True,
                              backend="jax", lens=config.fft_lens,
-                             crop_rows=crop_rows)
+                             crop_rows=crop_rows,
+                             fused=config.fused_sspec)
             if config.fit_arc:
                 fitter = build_arc_fitter(tuple(dyn_batch.shape),
                                           dyn_batch.dtype.itemsize)

@@ -61,6 +61,8 @@ def config_from_opts(opts: dict):
         pkw["fft_lens"] = str(opts["fft_lens"])
     if opts.get("sspec_crop"):
         pkw["sspec_crop"] = True
+    if opts.get("fused_sspec"):
+        pkw["fused_sspec"] = True
     # sizing knobs (client API; the CLI keeps the survey defaults)
     for k in ("arc_numsteps", "lm_steps"):
         if opts.get(k) is not None:

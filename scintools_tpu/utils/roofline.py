@@ -251,6 +251,12 @@ def roofline_record(rate_epochs_per_s: float, nf: int, nt: int,
         "arithmetic_intensity_flop_per_byte": round(f / b, 1),
         "per_stage_gflop": {k: round(v["flops"] / 1e9, 3)
                             for k, v in model.items() if k != "total"},
+        # per-stage BYTES split beside the flop split: on a bandwidth-
+        # bound step (BENCH_r05: 6 % of roofline, AI ~ 6) the traffic
+        # attribution is the one that names the next fusion target —
+        # the fused-vs-chain sspec claim reads from this column
+        "per_stage_gbytes": {k: round(v["bytes"] / 1e9, 3)
+                             for k, v in model.items() if k != "total"},
     }
     # measured (cost_analysis) counts trump the model when available
     f_eff, b_eff, source = f, b, "analytic model (lower-bound bytes)"

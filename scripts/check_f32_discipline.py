@@ -15,6 +15,15 @@ allowlists them here and documents WHY the wide dtype is correct.
 Token-based, not regex: docstrings and comments that merely mention the
 dtypes don't count; only a real NAME token does.  Enforced in tier-1
 via tests/test_f32_discipline.py.
+
+Coverage is the full ``ops/`` + ``parallel/`` walk — which includes
+the Pallas kernel modules (``ops/pallas_common.py``,
+``ops/sspec_pallas.py``, ``ops/resample_pallas.py``, the kernels in
+``ops/nudft.py``): kernels are the EASIEST place to silently
+reintroduce f64 temps (a host-precomputed phase matrix or window taper
+flowing into VMEM doubles the very bytes the kernel exists to save),
+so tests/test_f32_discipline.py pins those files as present in the
+walk.
 """
 
 from __future__ import annotations

@@ -101,6 +101,28 @@ print('row-scrunch pallas on-chip rel err:', err2)
 assert err2 < 5e-3, err2
 "
 
+FUSED_CODE="
+import numpy as np, jax
+from scintools_tpu.ops.sspec import _sspec_numpy, sspec
+from scintools_tpu.ops.sspec_pallas import sspec_fused
+rng = np.random.default_rng(0)
+nf, nt, crop = 256, 512, 64
+dyn = rng.standard_normal((nf, nt)).astype(np.float32)
+oracle = _sspec_numpy(dyn.astype(np.float64), True, 'blackman', 0.1,
+                      False, 'pow2', crop)
+sc = np.max(np.abs(oracle))
+chain = np.asarray(jax.jit(lambda d: sspec(
+    d, db=False, backend='jax', crop_rows=crop))(dyn))
+# route='pallas' explicitly: the real-Mosaic prologue + tiled epilogue
+# must lower and agree on chip, not only in CPU interpret mode
+fusedp = np.asarray(jax.jit(lambda d: sspec_fused(
+    d, db=False, crop_rows=crop, route='pallas'))(dyn))
+err_c = float(np.max(np.abs(chain - oracle)) / sc)
+err_f = float(np.max(np.abs(fusedp - oracle)) / sc)
+print('fused sspec on-chip vs f64 oracle:', err_f, '(chain:', err_c, ')')
+assert err_f < max(2 * err_c, 1e-4), (err_f, err_c)
+"
+
 NUDFT_CODE="
 import numpy as np, jax, jax.numpy as jnp
 from scintools_tpu.ops.nudft import _nudft_numpy, _r_grid, nudft
@@ -129,8 +151,9 @@ probe || { echo "tunnel unreachable; aborting"; exit 1; }
 # long enough for the bench before wedging at the next stage), so:
 #   1. headline bench         (round's #1 deliverable; landed 2026-08-02,
 #                              a repeat in a healthier window raises it)
-#   2-3. pallas gate + nudft bf16 guard (sub-minute CORRECTNESS verdicts
-#        that validate every capture below; CPU CI cannot see either)
+#   2-3. pallas gates (row-scrunch + fused sspec) + nudft bf16 guard
+#        (sub-minute CORRECTNESS verdicts that validate every capture
+#        below; CPU CI cannot see any of them)
 #   4. f32 on-chip budget     (published figures' only missing capture)
 #   5. all five configs       (configs 1-3 have no on-chip record)
 #   6. B=256 stage profile    (repeat-healthy-flight evidence)
@@ -177,6 +200,13 @@ echo "== pallas row-scrunch lowers on chip =="
 # production einsum — benchmarks/pallas_ab.py.)
 gated "pallas lowering check" 600 2 python -u -c "$PALLAS_CODE"
 
+echo "== fused sspec kernels lower on chip =="
+# the --fused-sspec route (ops/sspec_pallas: prologue + crop-split DFT
+# + tiled epilogue) is opt-in until its A/B wires it; this sub-minute
+# gate proves the real-Mosaic lowering AND its oracle numerics before
+# the hour-scale stages spend the window (CPU CI sees interpret only)
+gated "fused sspec lowering check" 600 2 python -u -c "$FUSED_CODE"
+
 echo "== nudft einsum on-chip accuracy (bf16-lowering guard) =="
 # the round-4 A/B caught the vmapped einsum NUDFT silently lowering to
 # bf16 MXU passes (2e-3 scaled error); _nudft_jax_reim now pins
@@ -217,5 +247,8 @@ gated "arc tail A/B" 1800 2 python benchmarks/arc_tail_ab.py --b 256 --iters 5
 echo "== pallas prove-or-remove A/B =="
 # regression guard for the wired row-scrunch route (docs/roadmap.md:
 # wire a kernel only if it beats the production path by >= 1.15x with
-# matching numerics; otherwise it gets deleted)
-gated "pallas A/B" 1800 4 python benchmarks/pallas_ab.py --iters 10
+# matching numerics; otherwise it gets deleted) — now three verdicts:
+# row_scrunch (wired; keep-off = exit 3), sspec_fused and nudft_pallas
+# (opt-in; their wire/keep-off lines decide whether the knobs graduate
+# to auto defaults next round)
+gated "pallas A/B" 1800 8 python benchmarks/pallas_ab.py --iters 10

@@ -23,6 +23,23 @@ def test_no_unannotated_wide_dtypes_in_jax_paths():
         + "\n".join(f"{p}:{ln}: {txt}" for p, ln, txt in offenders))
 
 
+def test_pallas_kernel_modules_are_covered():
+    """The walk must include every Pallas kernel module — kernels are
+    the easiest place to silently reintroduce f64 temps, and a rename
+    that moved them out of ops/ would silently drop them from the
+    lint.  find_wide_literals must also tokenize each one cleanly."""
+    pkg = os.path.join(REPO, "scintools_tpu")
+    kernel_files = [os.path.join(pkg, "ops", name) for name in
+                    ("pallas_common.py", "sspec_pallas.py",
+                     "resample_pallas.py", "nudft.py")]
+    for path in kernel_files:
+        assert os.path.exists(path), path
+        hits = check_f32_discipline.find_wide_literals(path)
+        assert not any(txt.startswith("TokenError") for _ln, txt in hits)
+        # every wide token in a kernel module must carry the marker
+        assert hits == [], (path, hits)
+
+
 def test_lint_detects_wide_literal(tmp_path):
     pkg = tmp_path / "scintools_tpu"
     (pkg / "ops").mkdir(parents=True)

@@ -278,3 +278,87 @@ def test_calc_sspec_slowft_tone_concentrates(rng):
     prof = np.nanmean(10 ** (sec.sspec / 10), axis=0)
     peak = prof.max()
     assert peak > 5 * np.median(prof)
+
+
+# ---------------------------------------------------------------------------
+# Pallas rotation-recurrence tile (route="pallas", interpret on CPU)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("nt,nf,nr", [(64, 48, 64), (33, 17, 29),
+                                      (128, 100, 128)])
+def test_nudft_pallas_tile_matches_oracle(rng, nt, nf, nr):
+    """Interpret-mode parity of the blocked rotation-recurrence tile
+    against the f64 numpy oracle, across non-tile-multiple shapes (the
+    lane/row padding paths).  Budget 2e-4 scaled — the einsum route's
+    own on-chip oracle budget (tpu_recheck's bf16 guard)."""
+    from scintools_tpu.ops.nudft import _nudft_pallas_reim, _r_grid
+
+    power = rng.standard_normal((nt, nf)).astype(np.float32)
+    fscale = 1.0 + 0.05 * np.arange(nf) / nf
+    tsrc = np.arange(nt, dtype=np.float64)
+    r0, dr, _ = _r_grid(nt)
+    want = _nudft_numpy(power.astype(np.float64), fscale, tsrc, r0, dr,
+                        nr)
+    re, im = _nudft_pallas_reim(power, fscale, tsrc, r0, dr, nr,
+                                interpret=True)
+    got = np.asarray(re) + 1j * np.asarray(im)
+    assert got.shape == (nr, nf)
+    err = np.max(np.abs(got - want)) / np.max(np.abs(want))
+    assert err < 2e-4, err
+
+
+def test_nudft_pallas_resync_bounds_drift(rng):
+    """The periodic phasor resync is what bounds the f32 recurrence
+    drift: a long series with a tiny resync window must agree at least
+    as well as a huge one (pure recurrence)."""
+    from scintools_tpu.ops.nudft import _nudft_pallas_reim, _r_grid
+
+    nt, nf, nr = 512, 32, 64
+    power = rng.standard_normal((nt, nf)).astype(np.float32)
+    fscale = 1.0 + 0.05 * np.arange(nf) / nf
+    tsrc = np.arange(nt, dtype=np.float64)
+    r0, dr, _ = _r_grid(nt)
+    want = _nudft_numpy(power.astype(np.float64), fscale, tsrc, r0, dr,
+                        nr)
+    sc = np.max(np.abs(want))
+
+    def err(resync):
+        re, im = _nudft_pallas_reim(power, fscale, tsrc, r0, dr, nr,
+                                    resync=resync, interpret=True)
+        got = np.asarray(re) + 1j * np.asarray(im)
+        return np.max(np.abs(got - want)) / sc
+
+    e_sync = err(16)
+    e_raw = err(4096)   # > nt: one chunk, recurrence never resyncs
+    assert e_sync < 2e-4
+    assert e_sync <= e_raw * 1.5 + 1e-6
+
+
+def test_nudft_pallas_requires_uniform_tsrc(rng):
+    from scintools_tpu.ops.nudft import _nudft_pallas_reim, _r_grid
+
+    nt, nf = 32, 16
+    power = rng.standard_normal((nt, nf)).astype(np.float32)
+    fscale = np.ones(nf)
+    r0, dr, nr = _r_grid(nt)
+    with pytest.raises(ValueError, match="uniform"):
+        _nudft_pallas_reim(power, fscale, np.cumsum(rng.random(nt)),
+                           r0, dr, nr, interpret=True)
+
+
+def test_nudft_route_param(rng):
+    """nudft(route=...) validates and the pallas route agrees with the
+    production einsum lowering."""
+    nt, nf = 48, 32
+    power = rng.standard_normal((nt, nf)).astype(np.float32)
+    fscale = 1.0 + 0.05 * np.arange(nf) / nf
+    with pytest.raises(ValueError, match="route"):
+        nudft(power, fscale, backend="jax", route="nope")
+    with pytest.raises(ValueError, match="jax-path"):
+        nudft(power, fscale, backend="numpy", route="pallas")
+    a = np.asarray(nudft(power, fscale, backend="jax"))
+    b = np.asarray(nudft(power, fscale, backend="jax", route="pallas",
+                         interpret=True))
+    sc = np.max(np.abs(a))
+    assert np.max(np.abs(a - b)) / sc < 2e-4
