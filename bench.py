@@ -56,7 +56,11 @@ Pallas/XLA sspec lane as the headline, "both" = chain headline PLUS a
 fused pass in the same weather window — the record then carries a
 ``fused_vs_chain`` ratio of measured rate and cost-analysis bytes, so
 trajectory moves are attributed to the kernels; every record carries
-``fused: bool``).
+``fused: bool``), SCINT_BENCH_SYNTH ("1" = ALSO run the zero-H2D
+synthetic lane — ``run_pipeline(synthetic=...)`` generate→analyse at
+the bench shape — recording generated+analysed epochs/s and the
+key-only ``bytes_h2d`` beside the file-fed headline; every record
+carries ``synthetic: bool`` saying which feed the headline measured).
 """
 
 import json
@@ -555,6 +559,78 @@ def fused_vs_chain_ratio(chain_res: dict, fused_res: dict) -> dict | None:
     return out
 
 
+def synthetic_throughput(nf: int, nt: int, B: int, chunk: int,
+                         repeats: int = 1) -> dict:
+    """The zero-H2D synthetic lane (``SCINT_BENCH_SYNTH=1``): rate of
+    epochs GENERATED AND ANALYSED per second through the fused
+    on-device generate→analyse step (``run_pipeline(synthetic=...)``,
+    screen kind at the bench shape), plus its key-only ``bytes_h2d``.
+    The flight record carries it beside the file-fed headline so the
+    trajectory can compare "feed the step from host" against "let the
+    step feed itself" — the whole point of ROADMAP item 5's traffic
+    generator.  Measurement mirrors device_throughput's fixed-wall
+    window (median + IQR over repeated passes)."""
+    _enable_compile_cache()
+    _maybe_enable_trace()
+    from scintools_tpu import obs
+    from scintools_tpu.parallel import PipelineConfig, run_pipeline
+    from scintools_tpu.sim import SimParams
+    from scintools_tpu.sim.campaign import SynthSpec
+
+    # the screen's scan axis is the time axis: nx=nt time samples of
+    # nf channels, matching the file lane's epoch shape
+    spec = SynthSpec(kind="screen", n_epochs=B,
+                     params=SimParams(nx=nt, ny=nt, nf=nf, dlam=0.25))
+    cfg = PipelineConfig(arc_numsteps=2000)
+
+    def one_pass():
+        buckets = run_pipeline(config=cfg, synthetic=spec,
+                               chunk=min(chunk, B))
+        # run_pipeline gathers host-side: results are already real
+        (_idx, res), = buckets
+        return float(np.asarray(res.arc.eta).sum()
+                     + np.asarray(res.scint.tau).sum())
+
+    h2d0 = int(obs.counters().get("bytes_h2d", 0)) if obs.enabled() else 0
+    t0 = time.perf_counter()
+    one_pass()
+    compile_s = time.perf_counter() - t0
+    h2d = (int(obs.counters().get("bytes_h2d", 0)) - h2d0
+           if obs.enabled() else None)
+
+    min_wall = float(os.environ.get("SCINT_BENCH_MIN_MEASURE_S", "2.0"))
+    max_passes = _env_int("SCINT_BENCH_MAX_REPEATS", 32)
+    rates = []
+    spent = 0.0
+    while True:
+        t0 = time.perf_counter()
+        one_pass()
+        dt_pass = time.perf_counter() - t0
+        rates.append(B / dt_pass)
+        spent += dt_pass
+        if len(rates) >= max_passes:
+            break
+        if len(rates) >= max(int(repeats), 1) and spent >= min_wall:
+            break
+    rate = float(np.median(rates))
+    q25, q75 = (float(np.percentile(rates, 25)),
+                float(np.percentile(rates, 75)))
+    rec = {"rate": rate, "compile_s": round(compile_s, 2),
+           "measure_s": round(B / rate, 3), "synthetic": True,
+           "shape": [int(B), int(nf), int(nt)],
+           "rate_stats": {"n": len(rates), "median": round(rate, 2),
+                          "q25": round(q25, 2), "q75": round(q75, 2),
+                          "iqr_pct": (round(100.0 * (q75 - q25) / rate,
+                                            1) if rate else 0.0),
+                          "measure_wall_s": round(spent, 3)}}
+    if h2d is not None:
+        # the zero-H2D claim, measured: keys only, independent of
+        # (nf, nt) — the file lane moves B*nf*nt*4 bytes per pass
+        rec["bytes_h2d_first_pass"] = int(h2d)
+    _trace_flush()
+    return rec
+
+
 def device_throughput(dyn, freqs, times, chunk: int,
                       repeats: int = 1, fused: bool = False) -> dict:
     """Batched jit pipeline on the attached accelerator (one chip here;
@@ -839,6 +915,13 @@ def main():
         # which sspec lane this headline measured (SCINT_BENCH_FUSED);
         # a both-lanes flight also attributes fused-vs-chain (bytes +
         # rate) so BENCH trajectories credit the kernels, not noise
+        # which feed this headline measured: file-fed (False) vs the
+        # zero-H2D synthetic route; SCINT_BENCH_SYNTH=1 also attaches
+        # the synthetic lane's own generated+analysed epochs/s record
+        rec["synthetic"] = bool(res.get("synthetic", False))
+        sl = res.get("synthetic_lane")
+        if sl:
+            rec["synthetic_lane"] = sl
         rec["fused"] = bool(res.get("fused", False))
         fl = res.get("fused_lane")
         if fl:
@@ -997,6 +1080,10 @@ def main():
             # one watchdog: double the budget, or a healthy both-lanes
             # flight reads as a blown watchdog at the fused compile
             timeout_s *= 2
+        if os.environ.get("SCINT_BENCH_SYNTH",
+                          "0").strip().lower() == "1":
+            # the synthetic lane is a second compile + measure window
+            timeout_s *= 2
 
         def _run():
             try:
@@ -1024,6 +1111,18 @@ def main():
                             fused=True)
                     except Exception as e:
                         result["fused_lane"] = {
+                            "error": f"{type(e).__name__}: {e}"}
+                if os.environ.get("SCINT_BENCH_SYNTH",
+                                  "0").strip().lower() == "1":
+                    # zero-H2D synthetic lane, same weather window; a
+                    # failure lands in the record instead of silently
+                    # reading as "not requested"
+                    try:
+                        result["synthetic_lane"] = synthetic_throughput(
+                            nf, nt, B, chunk,
+                            repeats=_env_int("SCINT_BENCH_REPEATS", 3))
+                    except Exception as e:
+                        result["synthetic_lane"] = {
                             "error": f"{type(e).__name__}: {e}"}
             except Exception as e:  # pragma: no cover - surfaced in JSON
                 result["error"] = f"{type(e).__name__}: {e}"

@@ -45,6 +45,74 @@ def cmd_info(args) -> int:
     return rc
 
 
+def _synth_spec_dict_from_args(args) -> dict | None:
+    """The --synthetic flag set as a canonical sparse SynthSpec dict
+    (sim.campaign.spec_to_dict form) — the serve job payload, the
+    resume-key ingredient and the run_pipeline(synthetic=) input, built
+    ONCE so process/warmup/submit agree on the campaign identity.
+    Returns None when --synthetic was not given."""
+    n = getattr(args, "synthetic", None)
+    if n is None:
+        return None
+    from .sim import campaign
+
+    kind = getattr(args, "synth_kind", "screen")
+    d: dict = {"kind": kind, "n_epochs": int(n)}
+    if getattr(args, "synth_seed", 0):
+        d["seed"] = int(args.synth_seed)
+    for flag, field in (("synth_dt", "dt"), ("synth_freq", "freq")):
+        val = getattr(args, flag, None)
+        if val is not None:
+            d[field] = float(val)
+    if kind == "screen":
+        params = {}
+        if getattr(args, "synth_nf", None) is not None:
+            params["nf"] = int(args.synth_nf)
+        if getattr(args, "synth_nt", None) is not None:
+            # the screen's scan axis IS the time axis (nx samples)
+            params["nx"] = int(args.synth_nt)
+            params["ny"] = int(args.synth_nt)
+        if getattr(args, "synth_mb2", None) is not None:
+            params["mb2"] = float(args.synth_mb2)
+        if getattr(args, "synth_dlam", None) is not None:
+            params["dlam"] = float(args.synth_dlam)
+        if getattr(args, "synth_pac", False):
+            params["pac"] = True
+        if params:
+            d["params"] = params
+        if getattr(args, "synth_df", None) is not None:
+            raise SystemExit("--synth-df applies to the arc/acf grid "
+                             "kinds; the screen kind derives its "
+                             "frequency axis from --synth-dlam")
+    else:
+        for flag, field in (("synth_nf", "nf"), ("synth_nt", "nt")):
+            val = getattr(args, flag, None)
+            if val is not None:
+                d[field] = int(val)
+        if getattr(args, "synth_df", None) is not None:
+            d["df"] = float(args.synth_df)
+        if kind == "acf":
+            if getattr(args, "synth_tau", None) is not None:
+                d["tau_s"] = float(args.synth_tau)
+            if getattr(args, "synth_dnu", None) is not None:
+                d["dnu_mhz"] = float(args.synth_dnu)
+        if getattr(args, "synth_pac", False) \
+                or getattr(args, "synth_mb2", None) is not None \
+                or getattr(args, "synth_dlam", None) is not None:
+            raise SystemExit("--synth-mb2/--synth-dlam/--synth-pac "
+                             "apply to the screen kind only")
+    if kind != "acf" and (getattr(args, "synth_tau", None) is not None
+                          or getattr(args, "synth_dnu", None) is not None):
+        raise SystemExit("--synth-tau/--synth-dnu inject the acf "
+                         "kind's ground truth; use --synth-kind acf")
+    try:
+        # canonicalise through the spec class: validation + the sparse
+        # form sparse/materialised submitters share
+        return campaign.spec_to_dict(campaign.spec_from_dict(d))
+    except (TypeError, ValueError) as e:
+        raise SystemExit(str(e))
+
+
 def _validate_estimator_flags(args) -> None:
     """Shared --arc-bracket/--arc-method/--pad-chunks fail-fast for
     process, warmup and submit: a warmup or submit must reject exactly
@@ -62,12 +130,21 @@ def _validate_estimator_flags(args) -> None:
             and getattr(args, "chunk_epochs", None) is None):
         raise SystemExit("--pad-chunks pads the final chunk up to "
                          "--chunk-epochs; set --chunk-epochs")
+    synth = _synth_spec_dict_from_args(args)
+    if synth is not None and getattr(args, "clean", False):
+        raise SystemExit("--clean repairs loaded epochs; a synthetic "
+                         "campaign has nothing to clean (and the knob "
+                         "would fork the job identity for nothing)")
     from .serve.queue import validate_job_cfg
     try:
-        validate_job_cfg(
-            {"sspec_crop": getattr(args, "sspec_crop", False),
-             "no_arc": getattr(args, "no_arc", False),
-             "arc_method": getattr(args, "arc_method", "norm_sspec")})
+        cfg = {"sspec_crop": getattr(args, "sspec_crop", False),
+               "no_arc": getattr(args, "no_arc", False),
+               "arc_method": getattr(args, "arc_method", "norm_sspec")}
+        if synth is not None:
+            # full option dict: the synthetic route's config exclusions
+            # (bf16_io, arc_stack) are validated from one rule site
+            cfg = dict(_estimator_opts(args), **cfg, synthetic=synth)
+        validate_job_cfg(cfg)
     except ValueError as e:
         raise SystemExit(str(e))
 
@@ -173,6 +250,24 @@ def cmd_process(args) -> int:
                                                  and args.results):
         raise SystemExit("--full-csv exports the store's columns: it "
                          "needs both --store and --results")
+    synth_d = _synth_spec_dict_from_args(args)
+    if synth_d is not None:
+        if not args.batched:
+            raise SystemExit("--synthetic generates and analyses "
+                             "on-device through the batched engine; "
+                             "add --batched")
+        if files:
+            raise SystemExit("--synthetic campaigns take no input "
+                             "files (the campaign generates its own "
+                             "epochs on-device)")
+        if args.plots:
+            raise SystemExit("--batched does not render per-epoch "
+                             "plots; drop --plots")
+        return _process_synthetic(args, synth_d, cfg, store, log,
+                                  timers)
+    if not files:
+        raise SystemExit("no input files (pass psrflux files, or "
+                         "--synthetic N for an on-device campaign)")
     if args.plots:
         import os
 
@@ -538,6 +633,87 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
     return 0 if failed == 0 else 1
 
 
+def _process_synthetic(args, synth_d: dict, cfg, store, log,
+                       timers) -> int:
+    """Zero-H2D synthetic engine for cmd_process: the campaign's keys
+    go to the device, the dynspec batch is generated IN the compiled
+    analysis step (``run_pipeline(synthetic=...)``), and one result row
+    per epoch lands in the CSV/store — same row builders and NaN-lane
+    quarantine as the file-backed batched engine.
+
+    Resumable: each epoch's store key hashes (campaign identity, epoch
+    index, estimator cfg).  A fully-done campaign is skipped outright;
+    a partial one re-runs the (cheap to regenerate) campaign and
+    re-writes idempotent rows."""
+    from .io.results import write_results
+    from .parallel import make_mesh
+    from .sim import campaign
+    from .utils import content_key, log_event
+
+    spec = campaign.spec_from_dict(synth_d)
+    n = spec.n_epochs
+    # per-epoch resume keys: one campaign digest + the epoch index,
+    # in the serve route's <base>.<index> shape — store keys (and
+    # therefore CSV export order) sort in epoch order, so a direct
+    # campaign's CSV is byte-identical to a served `simulate` job's
+    base = content_key(("synthetic", repr(synth_d)), cfg)
+
+    def keyfn(i: int) -> str:
+        return campaign.synth_row_key(base, i)
+
+    if store is not None:
+        todo = [i for i in range(n) if keyfn(i) not in store]
+        log_event(log, "resume", total=n, todo=len(todo),
+                  done=n - len(todo))
+        if not todo:
+            if args.results:
+                store.export_csv(args.results,
+                                 full=getattr(args, "full_csv", False))
+            print(timers.report(), file=sys.stderr)
+            log_event(log, "done", processed=0, failed=0, quarantined=0)
+            return 0
+    rows, failed = [], 0
+    mesh_shape = getattr(args, "mesh", None)
+    try:
+        mesh = (make_mesh(tuple(int(x) for x in mesh_shape))
+                if mesh_shape else make_mesh())
+        with timers.stage("synthetic_pipeline"):
+            rows = campaign.synthetic_rows(
+                spec, _estimator_opts(args), mesh=mesh,
+                chunk=getattr(args, "chunk_epochs", None),
+                async_exec=not getattr(args, "no_async", False),
+                pad_chunks=getattr(args, "pad_chunks", False),
+                bucket=getattr(args, "bucket", False))
+    except Exception as e:
+        log_event(log, "pipeline_failed", error=repr(e), epochs=n)
+        failed = n
+    processed = 0
+    for i, row in enumerate(rows):
+        if row is None:
+            # NaN lane: quarantined (no CSV row, no store entry ->
+            # retried on resume), as the batched engine does
+            failed += 1
+            obs.inc("epochs_failed")
+            log_event(log, "epoch_failed",
+                      file=campaign.epoch_name(spec, i),
+                      error="non-finite fit (NaN lane)")
+            continue
+        if args.results:
+            write_results(args.results, row)
+        if store is not None:
+            store.put(keyfn(i), row)
+        processed += 1
+        log_event(log, "epoch", file=row["name"], tau=row.get("tau"),
+                  eta=row.get("betaeta", row.get("eta")))
+    if store is not None and args.results:
+        store.export_csv(args.results,
+                         full=getattr(args, "full_csv", False))
+    print(timers.report(), file=sys.stderr)
+    log_event(log, "done", processed=processed, failed=failed,
+              quarantined=0)
+    return 0 if failed == 0 else 1
+
+
 def cmd_warmup(args) -> int:
     """Pre-compile the batched pipeline's step set for a template +
     config, so a later ``process --batched`` run pays ZERO trace/compile
@@ -577,11 +753,27 @@ def cmd_warmup(args) -> int:
         print(json.dumps({"error": "compile cache disabled "
                           "(SCINT_COMPILE_CACHE=off); nothing to warm"}))
         return 1
-    epochs, _names, failed, _quar = _load_clean_epochs(args, files, log)
-    if not epochs:
-        print(json.dumps({"error": "no usable template epochs",
-                          "failed": failed}))
-        return 1
+    synth_d = _synth_spec_dict_from_args(args)
+    synth_spec = genid = None
+    epochs, failed = [], 0
+    if synth_d is not None:
+        # synthetic campaigns need no template files: the spec IS the
+        # observing setup (axes + generator), and every planned step
+        # signature is a uint32 key batch
+        if files:
+            raise SystemExit("--synthetic warmups take no template "
+                             "files (the spec defines the setup)")
+        from .sim import campaign
+
+        synth_spec = campaign.spec_from_dict(synth_d)
+        genid = campaign.generator_id(synth_spec)
+    else:
+        epochs, _names, failed, _quar = _load_clean_epochs(args, files,
+                                                           log)
+        if not epochs:
+            print(json.dumps({"error": "no usable template epochs",
+                              "failed": failed}))
+            return 1
     pcfg = _pipeline_config_from_args(args)
     mesh_shape = getattr(args, "mesh", None)
     # the compiled signature INCLUDES the mesh: --no-mesh warms the
@@ -602,7 +794,8 @@ def cmd_warmup(args) -> int:
     catalog = getattr(args, "catalog", False)
     plans = compile_cache.plan_steps(epochs, pcfg, mesh=mesh, chunk=chunk,
                                     pad_chunks=pad_chunks,
-                                    batch=args.batch, catalog=catalog)
+                                    batch=args.batch, catalog=catalog,
+                                    synthetic=synth_spec)
     import jax
 
     sigs = []
@@ -611,7 +804,8 @@ def cmd_warmup(args) -> int:
         donate = _resolve_donate(not getattr(args, "no_async", False),
                                  chunked, mesh)
         key = compile_cache.step_key(freqs, times, pcfg, mesh, chan,
-                                     bshape, dtype, donate=donate)
+                                     bshape, dtype, donate=donate,
+                                     synth=genid)
         keys.append(key)
         sig = {"shape": list(bshape), "key": key}
         t0 = time.perf_counter()
@@ -639,11 +833,13 @@ def cmd_warmup(args) -> int:
             # an evicted entry for consumers that fall back to the jit
             # path; near-free (retrace + disk hit) on a warm cache
             step = make_pipeline(freqs, times, pcfg, mesh=mesh,
-                                 chan_sharded=chan, donate=donate)
+                                 chan_sharded=chan, donate=donate,
+                                 synth=synth_spec)
             step.lower(spec).compile()
         else:
             step = make_pipeline(freqs, times, pcfg, mesh=mesh,
-                                 chan_sharded=chan, donate=donate)
+                                 chan_sharded=chan, donate=donate,
+                                 synth=synth_spec)
             # preferred artifact: the COMPILED executable (zero retrace
             # AND zero compile on load — the fresh-pod fast path; its
             # lower().compile() also lands the live step's XLA entry in
@@ -738,7 +934,20 @@ def cmd_submit(args) -> int:
     _validate_estimator_flags(args)
     files = _expand(args.files)
     client = SurveyClient(args.queue)
-    recs = client.submit(files, _estimator_opts(args))
+    synth_d = _synth_spec_dict_from_args(args)
+    if synth_d is not None:
+        # `simulate` job kind: one job = one on-device campaign (no
+        # input files; keys + params ARE the job payload)
+        if files:
+            raise SystemExit("--synthetic submits take no input files")
+        rec = client.submit_synthetic(synth_d, _estimator_opts(args))
+        recs = [{"file": f"synthetic:{synth_d.get('kind', 'screen')}",
+                 "job": rec["job"], "status": rec["status"]}]
+    else:
+        if not files:
+            raise SystemExit("no input files (pass psrflux files, or "
+                             "--synthetic N for a simulate job)")
+        recs = client.submit(files, _estimator_opts(args))
     fresh = sum(1 for r in recs if r["status"] == "submitted")
     missing = sum(1 for r in recs if r["status"] == "missing")
     base = {"queue": args.queue, "submitted": fresh,
@@ -1185,6 +1394,50 @@ def _add_perf_policy_flags(q) -> None:
                         "not bit-identical")
 
 
+def _add_synth_flags(q) -> None:
+    """The zero-H2D synthetic-campaign flags — one definition shared by
+    process/warmup/submit, so the campaign identity (resume key,
+    compile-cache key, serve job identity) is built from the same spec
+    everywhere (`_synth_spec_dict_from_args`)."""
+    q.add_argument("--synthetic", type=int, default=None, metavar="N",
+                   help="run an N-epoch on-device synthetic campaign "
+                        "instead of loading files: the compiled step's "
+                        "input is the PRNG key batch and the dynspec "
+                        "batch is generated in device memory (zero H2D "
+                        "traffic in the hot loop; batched engine only)")
+    q.add_argument("--synth-kind", default="screen",
+                   choices=["screen", "arc", "acf"],
+                   help="generator: Kolmogorov phase screens "
+                        "(physics), thin-arc images (closed-form "
+                        "injected curvature), or exact-model-ACF "
+                        "fields (injected tau/dnu)")
+    q.add_argument("--synth-seed", type=int, default=0,
+                   help="campaign base seed (epoch i's key is "
+                        "[seed, i])")
+    q.add_argument("--synth-nf", type=int, default=None,
+                   help="channels (screen: SimParams.nf; arc/acf: nf)")
+    q.add_argument("--synth-nt", type=int, default=None,
+                   help="time samples (screen: SimParams.nx=ny; "
+                        "arc/acf: nt)")
+    q.add_argument("--synth-dt", type=float, default=None,
+                   help="time step in seconds (default 8)")
+    q.add_argument("--synth-df", type=float, default=None,
+                   help="arc/acf channel width in MHz (default 0.5)")
+    q.add_argument("--synth-freq", type=float, default=None,
+                   help="observing frequency in MHz (default 1400)")
+    q.add_argument("--synth-mb2", type=float, default=None,
+                   help="screen kind: scattering strength (Born mb2)")
+    q.add_argument("--synth-dlam", type=float, default=None,
+                   help="screen kind: fractional bandwidth")
+    q.add_argument("--synth-pac", action="store_true",
+                   help="screen kind: Gaussian phase-autocovariance "
+                        "low-frequency compensation (SimParams.pac)")
+    q.add_argument("--synth-tau", type=float, default=None,
+                   help="acf kind: injected 1/e timescale (s)")
+    q.add_argument("--synth-dnu", type=float, default=None,
+                   help="acf kind: injected half-power bandwidth (MHz)")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="scintools-tpu",
@@ -1202,7 +1455,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     q = sub.add_parser("process",
                        help="process epochs: clean -> acf/sspec -> fits")
-    q.add_argument("files", nargs="+")
+    q.add_argument("files", nargs="*",
+                   help="psrflux epoch files (omit with --synthetic)")
     q.add_argument("--lamsteps", action="store_true")
     q.add_argument("--backend", default="numpy",
                    choices=["numpy", "jax"])
@@ -1277,6 +1531,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "rung — only `warmup --catalog` signatures "
                         "execute; real-lane results byte-identical)")
     _add_perf_policy_flags(q)
+    _add_synth_flags(q)
     q.set_defaults(fn=cmd_process)
 
     q = sub.add_parser(
@@ -1284,9 +1539,10 @@ def build_parser() -> argparse.ArgumentParser:
         help="pre-compile the batched step set for a template + config "
              "(persistent compile cache + AOT export), so a later "
              "`process --batched` run re-traces nothing")
-    q.add_argument("files", nargs="+",
+    q.add_argument("files", nargs="*",
                    help="template psrflux file(s): the survey's inputs "
-                        "or one representative epoch per observing setup")
+                        "or one representative epoch per observing "
+                        "setup (omit with --synthetic)")
     q.add_argument("--batch", type=int, default=None,
                    help="planned survey batch size per shape bucket "
                         "(default: the number of template files in the "
@@ -1333,6 +1589,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "--batch overrides the ladder top "
                         "(SCINT_BUCKET_TOP, default 64)")
     _add_perf_policy_flags(q)
+    _add_synth_flags(q)
     q.set_defaults(fn=cmd_warmup)
 
     q = sub.add_parser(
@@ -1384,7 +1641,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="submit epoch files to a serve queue (idempotent per file "
              "content + estimator options)")
     q.add_argument("queue", help="queue directory (created if absent)")
-    q.add_argument("files", nargs="+")
+    q.add_argument("files", nargs="*",
+                   help="epoch files (omit with --synthetic)")
     q.add_argument("--lamsteps", action="store_true")
     q.add_argument("--no-arc", action="store_true")
     q.add_argument("--no-scint", action="store_true")
@@ -1408,6 +1666,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="block until the submitted jobs are terminal "
                         "(or this many seconds pass)")
     _add_perf_policy_flags(q)
+    _add_synth_flags(q)
     q.set_defaults(fn=cmd_submit)
 
     q = sub.add_parser("status",
@@ -1541,7 +1800,20 @@ def main(argv: list[str] | None = None) -> int:
     # arm any SCINT_FAULTS-requested chaos faults (no-op when unset):
     # subprocess chaos drives inject through the environment
     faults.install_env()
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args, extra = parser.parse_known_args(argv)
+    if extra:
+        files = getattr(args, "files", None)
+        if files is not None and all(not t.startswith("-")
+                                     for t in extra):
+            # argparse's zero-width nargs="*" match consumes the files
+            # slot before interspersed flags (`submit q --lamsteps f1
+            # f2` left f1/f2 "unrecognized" once --synthetic made files
+            # optional): fold trailing non-flag tokens back into it —
+            # exactly the nargs="+" interleaving that always worked
+            args.files = list(files) + extra
+        else:
+            parser.error("unrecognized arguments: " + " ".join(extra))
     if args.trace:
         try:
             obs.enable(jsonl=args.trace)

@@ -199,7 +199,8 @@ def _source_fingerprint() -> str:
 
 
 def step_key(freqs, times, config, mesh, chan_sharded: bool,
-             batch_shape, dtype, donate: bool = False) -> str:
+             batch_shape, dtype, donate: bool = False,
+             synth=None) -> str:
     """Content-hash key of one compiled step signature.
 
     Anything that changes the traced program (or the validity of its
@@ -208,7 +209,11 @@ def step_key(freqs, times, config, mesh, chan_sharded: bool,
     batch shape, the canonical input dtype, input donation, the x64
     flag, the jax / jaxlib / backend-platform versions, and a digest of
     this package's own source tree (any scintools_tpu code change can
-    change the traced program, so it must invalidate every artifact)."""
+    change the traced program, so it must invalidate every artifact).
+    ``synth`` is the synthetic route's generator identity
+    (``sim.campaign.generator_id`` — a canonicalised SynthSpec with a
+    stable repr): a key-fed generate→analyse program is a different
+    executable from the file-fed analyser over the same axes."""
     import jax
     import jaxlib
 
@@ -225,6 +230,7 @@ def step_key(freqs, times, config, mesh, chan_sharded: bool,
         bool(jax.config.jax_enable_x64),
         jax.__version__, jaxlib.__version__, jax.default_backend(),
         _source_fingerprint(),
+        repr(synth),
     ))
     h = hashlib.sha256()
     h.update(f.tobytes())
@@ -463,7 +469,7 @@ def load_step(key: str, count: bool = True):
 
 def plan_steps(epochs, config, mesh=None, chunk: int | None = None,
                pad_chunks: bool = False, batch: int | None = None,
-               catalog: bool = False) -> list:
+               catalog: bool = False, synthetic=None) -> list:
     """The exact step signatures a ``run_pipeline(epochs, config, mesh,
     chunk=..., pad_chunks=...)`` call will execute, as
     ``[(freqs, times, (b, nf, nt), dtype, chunked), ...]`` — shares the
@@ -481,7 +487,14 @@ def plan_steps(epochs, config, mesh=None, chunk: int | None = None,
     the top rung's chunk-loop variant (donation differs there on TPU).
     A worker warmed this way serves ANY epoch count of these observing
     setups with ``jit_cache_miss == 0`` when the caller canonicalises
-    (``run_pipeline(bucket=True)`` / the serve batcher)."""
+    (``run_pipeline(bucket=True)`` / the serve batcher).
+
+    ``synthetic`` (a SynthSpec) plans the zero-H2D campaign route
+    instead of file buckets: one axes bucket from the spec, uint32 key
+    signatures ``(b, 2+F)``, the same ladder/chunk math — so ``warmup
+    --synthetic`` pre-compiles exactly what a served ``simulate`` job
+    or ``run_pipeline(synthetic=...)`` will execute (the caller also
+    passes the spec's generator identity into :func:`step_key`)."""
     from .parallel import driver as drv
     from .parallel import mesh as mesh_mod
 
@@ -489,6 +502,31 @@ def plan_steps(epochs, config, mesh=None, chunk: int | None = None,
     if mesh is not None:
         multiple = mesh.shape[mesh_mod.DATA_AXIS]
     plans = []
+    if synthetic is not None:
+        from .sim import campaign
+
+        campaign.validate_spec(synthetic)
+        freqs, times = campaign.synth_axes(synthetic)
+        sdt = np.dtype(np.uint32)
+        width = campaign.stage_width(synthetic)
+        n = batch if batch is not None else synthetic.n_epochs
+        B = -(-n // multiple) * multiple
+        if catalog:
+            from . import buckets as buckets_mod
+
+            top = batch
+            if top is None and chunk is not None:
+                top = drv._adjust_chunk(multiple, chunk)
+            ladder = buckets_mod.batch_ladder(multiple, top=top)
+            for b in ladder:
+                plans.append((freqs, times, (b, width), sdt, False))
+            plans.append((freqs, times, (ladder[-1], width), sdt, True))
+            return plans
+        chunked = chunk is not None and chunk < B
+        for b in sorted(drv._step_batch_sizes(B, multiple, chunk,
+                                              pad_chunks=pad_chunks)):
+            plans.append((freqs, times, (b, width), sdt, chunked))
+        return plans
     if catalog:
         from . import buckets as buckets_mod
 

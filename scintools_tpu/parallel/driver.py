@@ -209,6 +209,30 @@ def lambda_resample_matrix(freqs: np.ndarray) -> tuple[np.ndarray, np.ndarray, f
     return W[::-1].copy(), lam_eq[::-1].copy(), float(dlam)
 
 
+def _validate_synth_config(config: "PipelineConfig", mesh,
+                           chan_sharded: bool | None) -> None:
+    """Config combinations the synthetic route rejects — ONE rule site
+    shared by make_pipeline and run_pipeline (and, through the serve
+    submit validation, the job queue), so a bad campaign fails at the
+    caller with the same message everywhere."""
+    if config.precision != "f32":
+        raise ValueError(
+            "the synthetic route generates the dynspec batch on-device:"
+            " precision='bf16_io' has no host transfer to halve (and "
+            "would fork the step identity for nothing); use the "
+            "default 'f32'")
+    if config.arc_stack:
+        raise ValueError(
+            "arc_stack is not supported on the synthetic route: its "
+            "pad lanes are real re-simulations (keys cannot be "
+            "NaN-filled), which would bias the campaign stack")
+    if _resolve_chan_sharded(mesh, chan_sharded):
+        raise ValueError(
+            "the synthetic route does not support a chan-sharded mesh "
+            "yet: the generator materialises each epoch on one device "
+            "(shard the batch axis over `data`)")
+
+
 def _resolve_chan_sharded(mesh, chan_sharded: bool | None) -> bool:
     """chan_sharded=None derivation rule — the single source of truth
     for make_pipeline's in_shardings and run_pipeline's host-side
@@ -223,8 +247,17 @@ def _resolve_chan_sharded(mesh, chan_sharded: bool | None) -> bool:
 
 def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
                   mesh=None, chan_sharded: bool | None = None,
-                  donate: bool = False):
+                  donate: bool = False, synth=None):
     """Build the jit'd batched step for a fixed (freqs, times) template.
+
+    ``synth`` (a :class:`scintools_tpu.sim.campaign.SynthSpec`) fuses
+    the on-device generator into the step: the compiled program's input
+    becomes the campaign's uint32 key batch ``[B, 2+F]`` and the
+    dynspec batch is generated in HBM at the top of the SAME program —
+    the zero-H2D synthetic route.  The spec is canonicalised to its
+    program identity (``campaign.generator_id``) before it enters the
+    jit memo, so campaigns differing only in epoch count / seed / sweep
+    values share one compiled step.
 
     ``chan_sharded=None`` (default) derives channel sharding from the
     mesh itself: any mesh with a >1 ``chan`` axis shards the
@@ -324,12 +357,18 @@ def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
                 f"arc_method='thetatheta' has no equivalent of "
                 f"{', '.join(ignored)} (norm_sspec/gridmax knobs); leave "
                 "them at their defaults")
+    if synth is not None:
+        from ..sim import campaign
+
+        campaign.validate_spec(synth)
+        _validate_synth_config(config, mesh, chan_sharded)
+        synth = campaign.generator_id(synth)
     freqs = np.ascontiguousarray(np.asarray(freqs, dtype=np.float64))  # host-f64: host axes (cache key)
     times = np.ascontiguousarray(np.asarray(times, dtype=np.float64))  # host-f64: host axes (cache key)
     return _make_pipeline_cached(
         (freqs.tobytes(), freqs.shape), (times.tobytes(), times.shape),
         config, mesh, _resolve_chan_sharded(mesh, chan_sharded),
-        bool(donate))
+        bool(donate), synth)
 
 
 # "auto" falls back to the FFT route above this many bytes of Gram-matrix
@@ -521,13 +560,22 @@ def survey_routes(epochs, config: "PipelineConfig", mesh=None,
 
 @functools.lru_cache(maxsize=None)
 def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded,
-                          donate=False):
+                          donate=False, synth=None):
     import jax
     import jax.numpy as jnp
 
     freqs = np.frombuffer(freqs_key[0]).reshape(freqs_key[1])
     times = np.frombuffer(times_key[0]).reshape(times_key[1])
     nchan, nsub = len(freqs), len(times)
+    gen_fn = None
+    if synth is not None:
+        from ..sim.campaign import synth_generator, synth_shape
+
+        if synth_shape(synth) != (nchan, nsub):
+            raise ValueError(
+                f"synthetic generator grid {synth_shape(synth)} does "
+                f"not match the template axes ({nchan}, {nsub})")
+        gen_fn = synth_generator(synth)
     df = float(freqs[1] - freqs[0])
     dt = float(times[1] - times[0])
     fc = float(np.mean(freqs))
@@ -633,7 +681,12 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded,
 
     def step(dyn_batch):
         dyn_batch = jnp.asarray(dyn_batch)
-        if config.precision == "bf16_io":
+        if gen_fn is not None:
+            # zero-H2D synthetic route: the staged input is the uint32
+            # key batch [B, 2+F]; the dynspec batch is generated HERE,
+            # inside the same compiled program, and never leaves HBM
+            dyn_batch = gen_fn(dyn_batch)
+        elif config.precision == "bf16_io":
             # bf16 is the TRANSFER/RESIDENCY dtype only: upcast at the
             # step's top so every FFT, matmul and accumulation below
             # runs in f32 (XLA fuses the convert into the first
@@ -793,11 +846,12 @@ def _as_global_batch(dyn, mesh, chan_sharded: bool, commit: bool = False):
     return jax.device_put(dyn)
 
 
-def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
+def run_pipeline(epochs=None, config: PipelineConfig = PipelineConfig(),
                  mesh=None, chunk: int | None = None,
                  chan_sharded: bool | None = None,
                  async_exec: bool = True, pad_chunks: bool = False,
-                 pad_to: int | None = None, bucket: bool = False):
+                 pad_to: int | None = None, bucket: bool = False,
+                 synthetic=None):
     """Host-side convenience driver: bucket heterogeneous epochs by shape,
     pad each bucket to the mesh's data-axis multiple, run the jit'd step
     per bucket (optionally in memory-bounded chunks), and gather results
@@ -848,6 +902,21 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
     every [B]-leading result leaf is epoch ``indices[k]`` (divisibility
     pad-lanes are sliced off before returning).
 
+    ``synthetic`` (a :class:`scintools_tpu.sim.campaign.SynthSpec`, in
+    place of ``epochs``) runs the ZERO-H2D on-device campaign route:
+    the staged input is the campaign's uint32 key batch ``[B, 2+F]``
+    (``campaign.stage_batch``) and the compiled step generates the
+    dynspec batch in HBM before the analysis stages — ``bytes_h2d`` is
+    O(keys), independent of (nf, nt), counter-asserted in tier-1.  The
+    key batch rides the SAME machinery as a staged dynspec batch: mesh
+    data-axis sharding, divisibility/rung padding (pad lanes repeat the
+    last key row — a re-simulation, sliced off at gather), chunking,
+    bucket-catalog canonicalisation, and compile-cache/AOT artifacts
+    (the spec's generator identity is part of the step key).  Not
+    supported with ``bf16_io`` precision (nothing to transfer),
+    ``arc_stack`` (pad lanes cannot be NaN-filled) or a chan-sharded
+    mesh.  ``epochs_synthesized`` counts the generated epochs.
+
     When :mod:`scintools_tpu.obs` tracing is enabled, each bucket batch
     records the stage spans ``pipeline.stage`` (host staging: bucketing,
     padding, step build), ``pipeline.step.compile`` /
@@ -863,6 +932,21 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
     from .. import compile_cache
     from .batch import pad_batch
     from .schedule import execute_chunks
+
+    if synthetic is None and epochs is None:
+        raise TypeError("run_pipeline needs epochs (file route) or "
+                        "synthetic= (on-device campaign route)")
+    genid = campaign = None
+    if synthetic is not None:
+        if epochs:
+            raise ValueError("pass epochs OR synthetic=, not both (a "
+                             "campaign generates its own epochs "
+                             "on-device)")
+        from ..sim import campaign
+
+        campaign.validate_spec(synthetic)
+        _validate_synth_config(config, mesh, chan_sharded)
+        genid = campaign.generator_id(synthetic)
 
     multiple = 1
     if mesh is not None:
@@ -890,16 +974,34 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
                 # unpacked warm-cache artifact (trace report shows it)
                 obs.gauge("compile_cache_artifact",
                           str(man.get("digest", "?")))
+    n_total = (synthetic.n_epochs if synthetic is not None
+               else len(epochs))
+    buckets_iter = ([list(range(synthetic.n_epochs))]
+                    if synthetic is not None
+                    else _bucket_epochs(epochs).values())
     results = []
-    with obs.span("pipeline.run", epochs=len(epochs)):
-        for idx in _bucket_epochs(epochs).values():
+    with obs.span("pipeline.run", epochs=n_total):
+        for idx in buckets_iter:
             eff_pad_to, eff_chunk, eff_pad_chunks = pad_to, chunk, pad_chunks
             with obs.span("pipeline.stage", epochs=len(idx)) as stage_sp:
-                group = [epochs[i] for i in idx]
-                batch, _mask = pad_batch(group, batch_multiple=multiple)
-                freqs_np = np.asarray(group[0].freqs)
-                times_np = np.asarray(group[0].times)
-                dyn = np.asarray(batch.dyn)
+                if synthetic is not None:
+                    # the staged batch is the key array: pad it to the
+                    # mesh multiple by repeating the last row (a
+                    # re-simulated lane, sliced off at gather exactly
+                    # like a divisibility pad-lane of a file batch)
+                    freqs_np, times_np = campaign.synth_axes(synthetic)
+                    dyn = campaign.stage_batch(synthetic)
+                    short = (-len(idx)) % multiple
+                    if short:
+                        dyn = np.concatenate(
+                            [dyn, np.repeat(dyn[-1:], short, axis=0)],
+                            axis=0)
+                else:
+                    group = [epochs[i] for i in idx]
+                    batch, _mask = pad_batch(group, batch_multiple=multiple)
+                    freqs_np = np.asarray(group[0].freqs)
+                    times_np = np.asarray(group[0].times)
+                    dyn = np.asarray(batch.dyn)
                 if bucket:
                     # catalog canonicalisation: pad the (divisibility-
                     # padded) batch up to the nearest ladder rung, or
@@ -959,27 +1061,36 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
                         if config.arc_stack:
                             extra = np.full_like(extra, np.nan)
                         dyn = np.concatenate([dyn, extra], axis=0)
-                sdt = stage_dtype(config.precision)
-                if dyn.dtype != sdt:
-                    # precision policy conversion LAST (after every pad
-                    # manipulation, which runs in f64): under bf16_io
-                    # the transfer and HBM residency halve vs f32 — the
-                    # step upcasts to f32 at its top for compute
-                    dyn = dyn.astype(sdt)
+                if synthetic is None:
+                    sdt = stage_dtype(config.precision)
+                    if dyn.dtype != sdt:
+                        # precision policy conversion LAST (after every
+                        # pad manipulation, which runs in f64): under
+                        # bf16_io the transfer and HBM residency halve
+                        # vs f32 — the step upcasts to f32 at its top
+                        # for compute.  (The synthetic route's staged
+                        # batch is the uint32 key array: no conversion.)
+                        dyn = dyn.astype(sdt)
                 donate = _resolve_donate(async_exec, c is not None, mesh)
                 step = make_pipeline(freqs_np, times_np, config,
                                      mesh=mesh, chan_sharded=chan_sharded,
-                                     donate=donate)
+                                     donate=donate, synth=synthetic)
                 stage_sp.set(batch_shape=list(dyn.shape),
                              stage_dtype=str(dyn.dtype))
             if bucket and obs.enabled():
                 # catalog-fill accounting: the executed signature's hit
                 # count and real-vs-padded lanes (pad-waste), plus one
                 # existence gauge per ladder rung so `trace report` can
-                # show unused catalog entries alongside the hit ones
+                # show unused catalog entries alongside the hit ones.
+                # Synthetic buckets label with the ANALYSIS grid (the
+                # staged key batch is [B, 2+F]) plus a :synth marker —
+                # key-fed and file-fed signatures are different
+                # programs and must not share a catalog row.
                 sig_b = c if c is not None else dyn.shape[0]
-                label = (f"{sig_b}x{dyn.shape[1]}x{dyn.shape[2]}"
-                         f":{dyn.dtype}")
+                grid = (f"{dyn.shape[1]}x{dyn.shape[2]}"
+                        if synthetic is None
+                        else f"{len(freqs_np)}x{len(times_np)}:synth")
+                label = f"{sig_b}x{grid}:{dyn.dtype}"
                 obs.inc(f"bucket_hits[{label}]")
                 obs.inc(f"bucket_lanes_real[{label}]", len(idx))
                 obs.inc(f"bucket_lanes_pad[{label}]",
@@ -987,9 +1098,11 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
                 for r in buckets_mod.batch_ladder(
                         multiple, top=None if chunk is None
                         else _adjust_chunk(multiple, chunk)):
-                    obs.gauge(f"bucket_catalog[{r}x{dyn.shape[1]}"
-                              f"x{dyn.shape[2]}:{dyn.dtype}]", 1)
+                    obs.gauge(f"bucket_catalog[{r}x{grid}:{dyn.dtype}]",
+                              1)
             obs.inc("epochs_processed", len(idx))
+            if synthetic is not None:
+                obs.inc("epochs_synthesized", len(idx))
             obs.inc("bytes_h2d", transfer_nbytes(dyn))
             # fixed-iteration LM budget actually dispatched for this
             # batch (host-side: trace-time counters inside the jit'd
@@ -1008,7 +1121,8 @@ def run_pipeline(epochs, config: PipelineConfig = PipelineConfig(),
                                                   pad_chunks=eff_pad_chunks)):
                     fn = compile_cache.load_step(compile_cache.step_key(
                         freqs_np, times_np, config, mesh, chan_sharded,
-                        (b,) + dyn.shape[1:], dyn.dtype, donate=donate))
+                        (b,) + dyn.shape[1:], dyn.dtype, donate=donate,
+                        synth=genid))
                     if fn is not None:
                         aot[b] = obs.instrument_jit(fn, "pipeline.step",
                                                     aot=True)

@@ -16,4 +16,4 @@ from .client import SurveyClient  # noqa: F401
 from .queue import (DEFAULT_MAX_RETRIES, Job, JobQueue,  # noqa: F401
                     cfg_signature, job_key)
 from .worker import (ServeWorker, config_from_opts,  # noqa: F401
-                     load_epoch, pipeline_runner)
+                     load_epoch, pipeline_runner, synthetic_runner)

@@ -42,6 +42,17 @@ class SurveyClient:
             out.append({"file": p, "job": job_id, "status": status})
         return out
 
+    def submit_synthetic(self, spec: dict,
+                         opts: dict | None = None) -> dict:
+        """Submit one on-device synthetic campaign (`simulate` job
+        kind): ``spec`` is a sparse ``sim.campaign.spec_to_dict``
+        payload (e.g. ``{"kind": "screen", "n_epochs": 1024}``),
+        ``opts`` the estimator options.  Idempotent per (canonical
+        spec, opts).  Returns ``{spec, job, status}``."""
+        job_id, status = self.queue.submit_synthetic(spec,
+                                                     dict(opts or {}))
+        return {"spec": dict(spec), "job": job_id, "status": status}
+
     # -- inspection --------------------------------------------------------
     def status(self) -> dict:
         return self.queue.status()

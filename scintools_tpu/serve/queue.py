@@ -103,6 +103,18 @@ def validate_job_cfg(cfg: dict) -> None:
             "sspec_crop (--sspec-crop) fuses the norm_sspec fitter's "
             "delay-window crop into the compiled step: it requires arc "
             "fitting with arc_method='norm_sspec' (drop no_arc)")
+    if cfg.get("synthetic") is not None:
+        # simulate-job payload: fail the bad campaign at submit, with
+        # the driver's own one-rule-site messages (spec validity +
+        # the synthetic route's config exclusions)
+        from ..parallel.driver import _validate_synth_config
+        from ..sim import campaign
+
+        campaign.spec_from_dict(cfg["synthetic"])
+        from .worker import config_from_opts
+
+        _validate_synth_config(config_from_opts(cfg), mesh=None,
+                               chan_sharded=None)
 
 
 def cfg_signature(cfg: dict) -> tuple:
@@ -117,6 +129,11 @@ def cfg_signature(cfg: dict) -> tuple:
     def norm(v):
         if isinstance(v, (list, tuple)):
             return tuple(norm(x) for x in v)
+        if isinstance(v, dict):
+            # nested payloads (the simulate-job SynthSpec dict) must
+            # hash order-independently and survive JSON round-trips
+            return tuple((str(k), norm(val))
+                         for k, val in sorted(v.items()))
         return v
 
     _string_defaults = {"arc_method": "norm_sspec", "precision": "f32",
@@ -372,6 +389,39 @@ class JobQueue:
         if existing is not None:
             return job_id, existing
         self._write(QUEUED, Job(id=job_id, file=os.path.abspath(path),
+                                cfg=cfg, submitted_at=_submit_stamp()))
+        return job_id, "submitted"
+
+    def submit_synthetic(self, spec: dict,
+                         cfg: dict | None = None) -> tuple[str, str]:
+        """Enqueue one on-device synthetic campaign (`simulate` job
+        kind): ``spec`` is a sparse :func:`scintools_tpu.sim.campaign.
+        spec_to_dict` payload, ``cfg`` the estimator options a normal
+        job would carry.  The job has no input file — its identity is
+        the content hash of (canonical spec, canonical options), and
+        its result is ``spec["n_epochs"]`` idempotent rows keyed
+        ``<job_id>.<epoch_index>`` in the results store.  Never batched
+        with file-backed jobs: the spec rides inside the option dict,
+        so ``cfg_signature`` separates the identities by construction
+        (and the worker routes simulate jobs around the batcher
+        entirely).  Idempotent like :meth:`submit`: a campaign whose
+        epoch-0 row already exists reports ``done``."""
+        from ..sim import campaign
+
+        cfg = dict(cfg or {})
+        # canonicalise through the spec class: sparse and materialised
+        # payloads of the same campaign must share one job identity
+        cfg["synthetic"] = campaign.spec_to_dict(
+            campaign.spec_from_dict(spec))
+        validate_job_cfg(cfg)
+        job_id = content_key("synthetic", ("serve",) + cfg_signature(cfg))
+        if campaign.synth_row_key(job_id, 0) in self.results:
+            return job_id, DONE
+        existing = self.state_of(job_id)
+        if existing is not None:
+            return job_id, existing
+        kind = cfg["synthetic"].get("kind", "screen")
+        self._write(QUEUED, Job(id=job_id, file=f"synthetic:{kind}",
                                 cfg=cfg, submitted_at=_submit_stamp()))
         return job_id, "submitted"
 

@@ -98,6 +98,27 @@ def load_epoch(path: str, clean: bool = False, preflight: bool = True):
     return d
 
 
+def synthetic_runner(spec_dict: dict, opts: dict, mesh=None,
+                     async_exec: bool = True,
+                     bucket: bool = False) -> list:
+    """Default `simulate`-job executor: the whole campaign as ONE
+    zero-H2D on-device generate→analyse run (``run_pipeline(
+    synthetic=...)``), rows built by the same helper as the CLI's
+    synthetic engine (``campaign.synthetic_rows``) — served CSV rows
+    are byte-identical to a direct run of the same keys/params.
+    ``bucket`` mirrors the worker's --bucket knob: the campaign's
+    batch canonicalises onto the catalog ladder, so a `warmup
+    --synthetic --catalog`-warmed worker keeps jit_cache_miss = 0 for
+    ANY epoch count (results byte-identical either way — a placement
+    knob, never job identity).  Returns one row dict (or None for a
+    quarantined NaN lane) per epoch, in epoch order."""
+    from ..sim import campaign
+
+    spec = campaign.spec_from_dict(spec_dict)
+    return campaign.synthetic_rows(spec, opts, mesh=mesh,
+                                   async_exec=async_exec, bucket=bucket)
+
+
 def pipeline_runner(batch: Batch, batch_size: int, mesh=None,
                     async_exec: bool = True) -> list:
     """Default batch executor: ONE padded compiled step over the
@@ -132,7 +153,7 @@ class ServeWorker:
                  max_wait_s: float = 2.0, lease_s: float = 60.0,
                  poll_s: float = 0.2, mesh=None, runner=None,
                  async_exec: bool = True, worker_id: str | None = None,
-                 bucket: bool = False):
+                 bucket: bool = False, synth_runner=None):
         self.queue = queue
         self.batch_size = int(batch_size)
         mult = 1
@@ -162,6 +183,9 @@ class ServeWorker:
         # strips it defensively).
         self.bucket = bool(bucket)
         self.runner = runner if runner is not None else pipeline_runner
+        # `simulate`-job executor (injectable for tests, like runner)
+        self.synth_runner = (synth_runner if synth_runner is not None
+                             else synthetic_runner)
         self.worker_id = worker_id or f"{os.uname().nodename}:{os.getpid()}"
         self.batcher = DynamicBatcher(batch_size=self.batch_size,
                                       max_wait_s=self.max_wait_s,
@@ -192,10 +216,18 @@ class ServeWorker:
             # oldest-age readout
             counts = self.queue.counts()
             obs.gauge("queue_depth", counts["queued"] + counts["leased"])
+        ran_synth = 0
         for job in jobs:
             obs.inc("serve_jobs_claimed")
             obs.inc("queue_wait_s",
                     round(max(now - job.submitted_at, 0.0), 6))
+            if job.cfg.get("synthetic") is not None:
+                # `simulate` job kind: a campaign IS its own batch (the
+                # compiled step's input is the key array) — never
+                # coalesced with file-backed epochs, executed directly
+                self._execute_synthetic(job)
+                ran_synth += 1
+                continue
             try:
                 with obs.span("serve.load", file=job.file):
                     # chaos site: the injected fault classifies
@@ -228,7 +260,7 @@ class ServeWorker:
                                          force=force_flush or drain)
         for batch in batches:
             self._execute(batch)
-        return len(batches)
+        return len(batches) + ran_synth
 
     def _claim_lease_s(self) -> float:
         # the lease must cover the batcher's wait AND one execution
@@ -349,6 +381,61 @@ class ServeWorker:
                       file=os.path.basename(job.file),
                       tau=row.get("tau"),
                       eta=row.get("betaeta", row.get("eta")))
+
+    def _execute_synthetic(self, job) -> None:
+        """Run one `simulate` job: the campaign executes as ONE
+        zero-H2D generate→analyse step batch and lands
+        ``n_epochs`` idempotent rows keyed ``<job_id>.<index>``.
+        Failures route through the same taxonomy as batch failures
+        (transient infra faults requeue budget-free)."""
+        from ..sim.campaign import spec_from_dict, synth_row_key
+
+        spec_dict = job.cfg["synthetic"]
+        try:
+            n_epochs = int(spec_from_dict(spec_dict).n_epochs)
+        except Exception as e:
+            # a torn/invalid payload is deterministic poison
+            state = self.queue.fail(job, f"bad synthetic spec: {e!r}",
+                                    retryable=False)
+            if state == "failed":
+                self.stats["jobs_failed"] += 1
+                obs.inc("jobs_failed")
+            log_event(self.log, "job_poisoned", job=job.id,
+                      error=f"bad synthetic spec: {e!r}")
+            return
+        obs.inc("serve_synth_jobs")
+        # a campaign compiles+runs like a batch: keep the lease ahead
+        self.queue.renew([job], self._claim_lease_s())
+        self.stats["batches"] += 1
+        try:
+            with obs.span("serve.batch", jobs=1, synthetic=True,
+                          epochs=n_epochs):
+                # chaos site shared with file batches: an infra fault
+                # mid-campaign classifies transient
+                faults.check("worker.batch_execute")
+                rows = self.synth_runner(spec_dict, job.cfg, self.mesh,
+                                         self.async_exec, self.bucket)
+        except Exception as e:
+            # _job_failed classifies: transient infra faults requeue
+            # budget-free, deterministic errors burn the bounded budget
+            self._job_failed(job, f"synthetic campaign failed: {e!r}",
+                             exc=e)
+            log_event(self.log, "synth_job_failed", job=job.id,
+                      error=repr(e))
+            return
+        stored = 0
+        for i, row in enumerate(rows):
+            if row is None:   # NaN lane: quarantined by the row builder
+                continue
+            self.queue.results.put_new(synth_row_key(job.id, i), row)
+            stored += 1
+        obs.inc("serve_synth_rows", stored)
+        self.queue.complete(job)
+        self.stats["jobs_done"] += 1
+        obs.inc("jobs_done")
+        log_event(self.log, "synth_job_done", job=job.id,
+                  epochs=n_epochs, rows=stored,
+                  quarantined=n_epochs - stored)
 
     # -- the resident loop -------------------------------------------------
     def run(self, max_batches: int | None = None,

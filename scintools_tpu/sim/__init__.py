@@ -1,6 +1,8 @@
+from .campaign import SynthSpec  # noqa: F401
 from .simulation import (SimParams, Simulation, derived_constants,  # noqa: F401
-                         fresnel_filter, frequency_scales, screen_weights,
-                         screen_weights_reference, simulate,
-                         simulate_ensemble, simulate_intensity,
+                         fresnel_filter, frequency_scales, pac_fit,
+                         pac_modes, phase_structure_function,
+                         screen_weights, screen_weights_reference,
+                         simulate, simulate_ensemble, simulate_intensity,
                          simulate_sweep)
 from .synth import thin_arc_epoch  # noqa: F401

@@ -1,0 +1,478 @@
+"""On-device synthetic campaigns: the phase-screen simulator as a
+first-class production workload (ROADMAP item 5).
+
+BENCH_r05 pinned the pipeline bandwidth-bound, and for synthetic
+campaigns a large share of those bytes are pure waste: ``sim/``
+generates dynspecs on host and the driver re-stages them over PCIe,
+even though both ends are jit'd JAX.  This module closes the loop the
+way the GPU real-time search literature keeps its transform pipeline
+resident (arXiv:1804.05335): the compiled analysis step's INPUT becomes
+a batch of PRNG keys (+ optional bitcast sweep values) and the dynspec
+batch is *born in HBM* inside the same jit'd program — generate →
+sspec/ACF → fit with zero H2D traffic in the hot loop
+(``bytes_h2d`` drops from ``O(B · nf · nt · 4 B)`` to ``O(B keys)``,
+counter-asserted in tier-1).
+
+:class:`SynthSpec` describes a campaign; ``parallel.run_pipeline(
+synthetic=spec)`` runs it through the SAME driver machinery as
+file-backed epochs (mesh data-axis sharding, chunking, bucket-catalog
+canonicalisation, compile-cache/AOT artifacts, obs counters).  Three
+generator kinds:
+
+* ``"screen"`` — Kolmogorov phase screens via the jit'd simulator
+  (:func:`~scintools_tpu.sim.simulation.simulate_intensity`), the
+  physics-grade production load generator; supports ``SimParams``
+  float-field sweeps (one compiled program covers a physics grid, the
+  values ride as bitcast traced inputs) and the low-k compensation
+  knobs (``subharmonics`` / ``pac``).
+* ``"arc"`` — the thin-arc scattered-image construction
+  (sim/synth.py) with a CLOSED-FORM injected curvature: robustly
+  arc-fittable at small sizes, the eta half of the closed-loop
+  validation gate.
+* ``"acf"`` — a circular-Gaussian field whose intensity ACF is EXACTLY
+  the scint fitter's model (``exp(-(dt/tau)^alpha)`` in time,
+  half-power ``dnu`` in frequency): ``tau_s`` / ``dnu_mhz`` are the
+  injected ground truth in the fitter's own parameterisation — the
+  tau/dnu half of the closed-loop gate.
+
+Epoch identity is ``(seed, index)``: epoch ``i`` of a campaign stages
+the raw threefry key ``[seed, i]`` (uint32), so resume, chunking,
+padding and serve-side idempotency all address epochs stably without
+any device work at staging time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .simulation import SimParams, _SWEEPABLE
+
+_KINDS = ("screen", "arc", "acf")
+
+# epoch mjd base for synthetic rows (sim/synth.py convention)
+_MJD0 = 53000.0
+
+
+@dataclasses.dataclass(frozen=True)
+class SynthSpec:
+    """One synthetic campaign: generator kind + physics + epoch count.
+
+    Hashable/frozen: a canonicalised spec (:func:`generator_id`) is part
+    of the compiled step's jit and compile-cache identity.  Fields that
+    do not apply to ``kind`` are ignored (and canonicalised away from
+    the program identity)."""
+
+    kind: str = "screen"
+    n_epochs: int = 1
+    seed: int = 0
+    # observing-axis mapping (all kinds): for "screen" the frequency
+    # axis comes from (freq, params.nf, params.dlam) exactly as
+    # io.from_simulation builds it; for "arc"/"acf" freq is the BASE
+    # frequency of an nf-channel axis with df spacing
+    freq: float = 1400.0
+    dt: float = 8.0
+    # --- kind="screen" -----------------------------------------------------
+    params: SimParams = SimParams()
+    freq_chunk: int = 0    # bound the per-epoch [chunk, nx, ny] FFT space
+    screen_chunk: int = 0  # lax.map chunk over epochs INSIDE the step
+    #                        (0 = vmap the whole per-step batch; the
+    #                        driver's `chunk` already bounds that batch)
+    sweep: tuple = ()      # ((field, (v0, ... v_{n_epochs-1})), ...):
+    #                        per-epoch physics values, traced (bitcast
+    #                        into the staged key rows) so one compiled
+    #                        program covers the whole grid
+    # --- kind="arc"/"acf" --------------------------------------------------
+    nf: int = 64
+    nt: int = 64
+    df: float = 0.5        # MHz channel width
+    # thin-arc knobs (sim/synth.thin_arc_epoch)
+    arc_frac: float = 0.5
+    nimg: int = 32
+    core: float = 8.0
+    noise: float = 0.005
+    env: float = 0.3
+    # acf-kind injected ground truth (the fitter's parameterisation)
+    tau_s: float = 200.0
+    dnu_mhz: float = 2.0
+    acf_alpha: float = 5 / 3
+
+
+def validate_spec(spec: SynthSpec) -> None:
+    """Reject specs the generator would deterministically reject —
+    shared by ``run_pipeline(synthetic=...)``, the serve ``simulate``
+    submit path and the CLI, so a bad campaign fails at the caller."""
+    if not isinstance(spec, SynthSpec):
+        raise TypeError(f"expected SynthSpec, got {type(spec).__name__}")
+    if spec.kind not in _KINDS:
+        raise ValueError(f"SynthSpec.kind: unknown generator "
+                         f"{spec.kind!r} (expected one of {_KINDS})")
+    if spec.n_epochs < 1:
+        raise ValueError(f"SynthSpec.n_epochs must be >= 1, got "
+                         f"{spec.n_epochs}")
+    if not 0 <= spec.seed < 2 ** 32:
+        # the staged key word is uint32: a silently-truncated larger
+        # seed would reproduce another campaign's data under a
+        # different identity (resume key / job id / row names)
+        raise ValueError(f"SynthSpec.seed must be in [0, 2^32), got "
+                         f"{spec.seed} (it is staged as one uint32 "
+                         "key word)")
+    if not isinstance(spec.params, SimParams):
+        raise TypeError("SynthSpec.params must be a SimParams")
+    if spec.kind == "screen":
+        if spec.screen_chunk < 0 or spec.freq_chunk < 0:
+            raise ValueError("screen_chunk/freq_chunk must be >= 0")
+        for name, vals in spec.sweep:
+            if name not in _SWEEPABLE:
+                raise ValueError(
+                    f"cannot sweep {name!r}; sweepable float fields "
+                    f"are {_SWEEPABLE}")
+            if len(vals) != spec.n_epochs:
+                raise ValueError(
+                    f"sweep {name!r} carries {len(vals)} values for "
+                    f"{spec.n_epochs} epochs (one value per epoch)")
+        if spec.sweep and (spec.params.subharmonics or spec.params.pac):
+            raise ValueError(
+                "swept campaigns do not support subharmonics/pac "
+                "(host-side mode tables); sweep the plain FFT screens")
+    else:
+        if spec.sweep:
+            raise ValueError("sweep applies to kind='screen' only")
+        if spec.nf < 2 or spec.nt < 2:
+            raise ValueError(f"nf/nt must be >= 2, got "
+                             f"{spec.nf}x{spec.nt}")
+        if spec.kind == "arc" and spec.nimg < 1:
+            raise ValueError("arc kind needs nimg >= 1")
+        if spec.kind == "acf" and (spec.tau_s <= 0 or spec.dnu_mhz <= 0):
+            raise ValueError("acf kind needs tau_s > 0 and dnu_mhz > 0")
+
+
+def synth_shape(spec: SynthSpec) -> tuple[int, int]:
+    """The (nf, nt) grid the generator produces — the analysis step's
+    per-epoch shape."""
+    if spec.kind == "screen":
+        return (spec.params.nf, spec.params.nx)
+    return (spec.nf, spec.nt)
+
+
+def synth_axes(spec: SynthSpec) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side (freqs, times) template axes of the campaign's epochs
+    — what the analysis pipeline's host-side grid builders consume, in
+    place of a loaded epoch's axes."""
+    nf, nt = synth_shape(spec)
+    if spec.kind == "screen":
+        from ..io.adapters import _freqs_from_dlam
+
+        freqs = _freqs_from_dlam(spec.freq, nf, spec.params.dlam)
+    else:
+        freqs = spec.freq + np.arange(nf, dtype=np.float64) * spec.df  # host-f64: host axes
+    times = float(spec.dt) * np.arange(nt, dtype=np.float64)  # host-f64: host axes
+    return np.ascontiguousarray(np.asarray(freqs, dtype=np.float64)), times  # host-f64: host axes
+
+
+def stage_width(spec: SynthSpec) -> int:
+    """Columns of the staged key batch: 2 key words + one bitcast
+    float32 per swept field."""
+    return 2 + (len(spec.sweep) if spec.kind == "screen" else 0)
+
+
+def stage_batch(spec: SynthSpec) -> np.ndarray:
+    """The campaign's staged input: uint32 ``[n_epochs, 2 + F]`` rows of
+    ``[seed, epoch_index, bitcast sweep values...]``.  This — not the
+    dynspec batch — is all that ever crosses PCIe on the synthetic
+    route; everything downstream (mesh sharding, divisibility/rung
+    padding by repeating the last row, chunk slicing) operates on the
+    leading axis exactly as it does for a staged dynspec batch."""
+    rows = np.zeros((spec.n_epochs, stage_width(spec)), dtype=np.uint32)
+    rows[:, 0] = np.uint32(spec.seed)   # validate_spec pins [0, 2^32)
+    rows[:, 1] = np.arange(spec.n_epochs, dtype=np.uint32)
+    if spec.kind == "screen":
+        for j, (_name, vals) in enumerate(spec.sweep):
+            rows[:, 2 + j] = np.asarray(vals,
+                                        dtype=np.float32).view(np.uint32)
+    return rows
+
+
+def generator_id(spec: SynthSpec) -> SynthSpec:
+    """The PROGRAM identity of a spec: everything that shapes the traced
+    generator, with run-only fields (n_epochs, seed, and the sweep
+    VALUES — a traced input) and the other kinds' knobs canonicalised
+    to defaults, so campaigns over the same generator share one
+    compiled step, one compile-cache artifact and one warm signature."""
+    kw = {"kind": spec.kind, "dt": float(spec.dt),
+          "freq": float(spec.freq)}
+    if spec.kind == "screen":
+        kw.update(params=spec.params, freq_chunk=int(spec.freq_chunk),
+                  screen_chunk=int(spec.screen_chunk),
+                  sweep=tuple((name, ()) for name, _vals in spec.sweep))
+    else:
+        kw.update(nf=int(spec.nf), nt=int(spec.nt), df=float(spec.df))
+        if spec.kind == "arc":
+            kw.update(arc_frac=float(spec.arc_frac), nimg=int(spec.nimg),
+                      core=float(spec.core), noise=float(spec.noise),
+                      env=float(spec.env))
+        else:
+            kw.update(tau_s=float(spec.tau_s),
+                      dnu_mhz=float(spec.dnu_mhz),
+                      acf_alpha=float(spec.acf_alpha))
+    return SynthSpec(**kw)
+
+
+# ---------------------------------------------------------------------------
+# traced generators
+# ---------------------------------------------------------------------------
+
+
+def _thin_arc_intensity(key, g: SynthSpec):
+    """jax port of sim/synth.thin_arc_epoch (same construction, jax
+    RNG): ``[nf, nt]`` intensity whose secondary spectrum carries an
+    arc at the closed-form curvature ``synth.thin_arc_eta(g.arc_frac,
+    g.df, g.dt)`` — the injected truth :func:`injected_truth` reports.
+    The per-image factors are separable, so the field is one einsum
+    over host-constant mode tables."""
+    import jax
+    import jax.numpy as jnp
+
+    from .synth import thin_arc_eta
+
+    fd_max = 1e3 / (2 * g.dt)
+    eta = thin_arc_eta(arc_frac=g.arc_frac, df=g.df, dt=g.dt)
+    th = np.linspace(-0.4 * fd_max, 0.4 * fd_max, g.nimg)
+    env = np.exp(-0.5 * (th / (g.env * fd_max)) ** 2)
+    # E = sum_j mu_j u_j(f) v_j(t): host-constant complex mode tables
+    u = np.exp(2j * np.pi * eta * th[:, None] ** 2
+               * (np.arange(g.nf) * g.df)[None, :])          # [nimg, nf]
+    v = np.exp(2j * np.pi * 1e-3 * th[:, None]
+               * (np.arange(g.nt) * g.dt)[None, :])          # [nimg, nt]
+    k1, k2, k3 = jax.random.split(key, 3)
+    mu = (jax.random.normal(k1, (g.nimg,))
+          + 1j * jax.random.normal(k2, (g.nimg,))) * env
+    mu = mu.at[g.nimg // 2].add(g.core)
+    E = jnp.einsum("j,jf,jt->ft", mu, jnp.asarray(u), jnp.asarray(v))
+    dyn = jnp.real(E) ** 2 + jnp.imag(E) ** 2
+    return dyn * (1 + g.noise * jax.random.normal(k3, (g.nf, g.nt)))
+
+
+def _acf_model_intensity(key, g: SynthSpec):
+    """``[nf, nt]`` intensity of a circular-Gaussian field whose
+    ensemble intensity ACF is EXACTLY the scint fitter's model:
+    ``exp(-(dt/tau)^alpha)`` on the time cut and half-power bandwidth
+    ``dnu`` on the frequency cut (models/acf_models.py conventions) —
+    so ``g.tau_s`` and ``g.dnu_mhz`` are injected ground truth in the
+    fitter's own parameterisation.
+
+    Construction: the target FIELD covariance is the square root of the
+    intensity ACF (|C_E|^2 = ACF_I for circular-Gaussian E); its FFT
+    gives exact per-mode variances on the periodic grid, and
+    ``E = fft2(w z)`` realises them."""
+    import jax
+    import jax.numpy as jnp
+
+    lt = np.minimum(np.arange(g.nt), g.nt - np.arange(g.nt)) * g.dt
+    lf = np.minimum(np.arange(g.nf), g.nf - np.arange(g.nf)) * g.df
+    a_t = np.exp(-0.5 * (lt / g.tau_s) ** g.acf_alpha)
+    a_f = np.exp(-0.5 * lf / (g.dnu_mhz / np.log(2)))
+    cov = a_f[:, None] * a_t[None, :]                        # [nf, nt]
+    s = np.clip(np.real(np.fft.fft2(cov)), 0.0, None)
+    w = np.sqrt(s / (2.0 * g.nf * g.nt))
+    k1, k2 = jax.random.split(key)
+    z = (jax.random.normal(k1, (g.nf, g.nt))
+         + 1j * jax.random.normal(k2, (g.nf, g.nt)))
+    E = jnp.fft.fft2(jnp.asarray(w) * z)
+    return jnp.real(E) ** 2 + jnp.imag(E) ** 2
+
+
+def injected_truth(spec: SynthSpec, lamsteps: bool = True) -> dict:
+    """The closed-form ground truth a closed-loop gate checks fits
+    against: ``{"betaeta"| "eta": ...}`` for the arc kind (via
+    sim/synth's unit conversions), ``{"tau": ..., "dnu": ...}`` for the
+    acf kind.  The screen kind has no closed-form single-epoch truth
+    (its validation is statistical — see the pac slope test)."""
+    if spec.kind == "arc":
+        from .synth import thin_arc_betaeta, thin_arc_eta
+
+        freqs, _times = synth_axes(spec)
+        if lamsteps:
+            return {"betaeta": thin_arc_betaeta(
+                freqs, arc_frac=spec.arc_frac, df=spec.df, dt=spec.dt)}
+        return {"eta": thin_arc_eta(arc_frac=spec.arc_frac, df=spec.df,
+                                    dt=spec.dt)}
+    if spec.kind == "acf":
+        return {"tau": float(spec.tau_s), "dnu": float(spec.dnu_mhz)}
+    return {}
+
+
+def synth_generator(gen: SynthSpec):
+    """Build the traced generator of a generator_id-canonical spec:
+    ``raw uint32 [B, 2+F] -> dyn [B, nf, nt]``, composed into the
+    analysis step by ``parallel.driver._make_pipeline_cached`` so the
+    dynspec batch never exists host-side."""
+    import jax
+    import jax.numpy as jnp
+
+    nf, nt = synth_shape(gen)
+    width = stage_width(gen)
+
+    if gen.kind == "screen":
+        p = gen.params
+        fields = tuple(name for name, _vals in gen.sweep)
+        if fields:
+            from .simulation import _sweep_screen_intensity
+
+            swept_one = _sweep_screen_intensity(p, fields)
+
+            def one(row):
+                vals = jax.lax.bitcast_convert_type(row[2:], jnp.float32)
+                return swept_one(row[:2], vals).T
+        else:
+            from .simulation import simulate_intensity
+
+            def one(row):
+                return simulate_intensity(
+                    row[:2], p, freq_chunk=gen.freq_chunk or None).T
+    elif gen.kind == "arc":
+        def one(row):
+            return _thin_arc_intensity(row[:2], gen)
+    else:
+        def one(row):
+            return _acf_model_intensity(row[:2], gen)
+
+    chunk = gen.screen_chunk if gen.kind == "screen" else 0
+
+    def generate(raw):
+        raw = jnp.asarray(raw)
+        if raw.ndim != 2 or raw.shape[1] != width:
+            raise ValueError(
+                f"synthetic step input must be [B, {width}] uint32 key "
+                f"rows, got {raw.shape}")
+        B = raw.shape[0]
+        if not chunk or chunk >= B:
+            return jax.vmap(one)(raw)
+        # lax.map over screen_chunk-sized slabs bounds the generator's
+        # [chunk, nx, ny] FFT workspace; pad rows are re-simulations of
+        # cycled keys, sliced off before the analysis stages
+        from .simulation import _pad_cycle
+
+        rows = _pad_cycle(raw, chunk)
+        kc = rows.reshape(-1, chunk, rows.shape[1])
+        out = jax.lax.map(lambda r: jax.vmap(one)(r), kc)
+        return out.reshape(-1, nf, nt)[:B]
+
+    return generate
+
+
+# ---------------------------------------------------------------------------
+# spec <-> dict (serve job payload / CLI), rows, identity keys
+# ---------------------------------------------------------------------------
+
+
+def spec_to_dict(spec: SynthSpec) -> dict:
+    """Canonical sparse JSON-able form of a spec — the serve job
+    payload and the CLI's resume-key ingredient.  Only non-default
+    fields are kept (so sparse client dicts and fully-materialised CLI
+    dicts share one job identity), with SimParams nested sparsely under
+    ``"params"`` and sweeps as ``[[field, [values...]], ...]``."""
+    out: dict = {}
+    d0 = SynthSpec()
+    p0 = SimParams()
+    for f in dataclasses.fields(SynthSpec):
+        v = getattr(spec, f.name)
+        if f.name == "params":
+            pd = {pf.name: getattr(v, pf.name)
+                  for pf in dataclasses.fields(SimParams)
+                  if getattr(v, pf.name) != getattr(p0, pf.name)}
+            if pd:
+                out["params"] = pd
+        elif f.name == "sweep":
+            if v:
+                out["sweep"] = [[name, [float(x) for x in vals]]
+                                for name, vals in v]
+        elif v != getattr(d0, f.name):
+            out[f.name] = v
+    return out
+
+
+def spec_from_dict(d: dict) -> SynthSpec:
+    """Inverse of :func:`spec_to_dict`, validating loudly: unknown keys
+    raise (a typo'd job payload must fail at submit, not burn a serve
+    retry budget discovering it)."""
+    d = dict(d or {})
+    names = {f.name for f in dataclasses.fields(SynthSpec)}
+    pnames = {f.name for f in dataclasses.fields(SimParams)}
+    params = d.pop("params", None)
+    sweep = d.pop("sweep", None)
+    unknown = set(d) - names
+    if unknown:
+        raise ValueError(f"unknown SynthSpec field(s): {sorted(unknown)}")
+    kw = dict(d)
+    if params is not None:
+        bad = set(params) - pnames
+        if bad:
+            raise ValueError(f"unknown SimParams field(s): {sorted(bad)}")
+        kw["params"] = SimParams(**params)
+    if sweep is not None:
+        kw["sweep"] = tuple((str(name), tuple(float(x) for x in vals))
+                            for name, vals in sweep)
+    spec = SynthSpec(**kw)
+    validate_spec(spec)
+    return spec
+
+
+def epoch_name(spec: SynthSpec, i: int) -> str:
+    """Deterministic per-epoch row name (the CSV ``name`` column)."""
+    return f"synth-{spec.kind}-s{spec.seed}-{int(i):05d}"
+
+
+def synth_meta(spec: SynthSpec) -> dict:
+    """The name-less metadata columns every epoch of this campaign
+    shares (results_row's derivations, computed from the synthetic axes
+    the way DynspecData derives them from loaded axes)."""
+    freqs, times = synth_axes(spec)
+    df = float(freqs[1] - freqs[0])
+    dt = float(times[1] - times[0])
+    return dict(freq=float(np.mean(freqs)),
+                bw=float(abs(freqs[-1] - freqs[0])) + abs(df),
+                tobs=float(times[-1] - times[0]) + abs(dt),
+                dt=dt, df=df)
+
+
+def synth_row_key(base: str, i: int) -> str:
+    """Results-store key of epoch ``i`` under campaign identity
+    ``base`` — shared by the serve ``simulate`` job runner and its
+    dedup probe, and shaped so a campaign's rows sort in epoch order
+    (CSV export order is key order)."""
+    return f"{base}.{int(i):05d}"
+
+
+def synthetic_rows(spec: SynthSpec, opts: dict, mesh=None,
+                   async_exec: bool = True, chunk: int | None = None,
+                   pad_chunks: bool = False,
+                   bucket: bool = False) -> list:
+    """Generate + analyse the campaign on-device and build one result
+    row per epoch (``None`` for lanes whose fits came back non-finite —
+    the quarantine rule the batched CLI engine applies).  The ONE row
+    builder shared by the CLI synthetic engine and the serve
+    ``simulate`` job runner, so served CSV rows are byte-identical to a
+    direct run's."""
+    from ..io.results import batch_lane_row, row_fit_values
+    from ..parallel import run_pipeline
+    from ..serve.worker import config_from_opts
+
+    cfg = config_from_opts(opts)
+    buckets = run_pipeline(config=cfg, mesh=mesh, chunk=chunk,
+                           async_exec=async_exec, pad_chunks=pad_chunks,
+                           bucket=bucket, synthetic=spec)
+    meta = synth_meta(spec)
+    rows: list = [None] * spec.n_epochs
+    for idx, res in buckets:
+        for lane, i in enumerate(idx):
+            row = dict(meta)
+            row["name"] = epoch_name(spec, i)
+            row["mjd"] = _MJD0 + int(i)
+            row.update(batch_lane_row(res, lane, cfg.lamsteps))
+            fitvals = row_fit_values(row)
+            if fitvals and not np.all(np.isfinite(fitvals)):
+                continue   # NaN lane: quarantined (rows[i] stays None)
+            rows[int(i)] = row
+    return rows

@@ -69,6 +69,20 @@ class SimParams:
     #                        |p|,|q|<=1, with spectrum-consistent weights.
     #                        jax screen path only; the numpy path stays
     #                        reference-exact and ignores this field.
+    pac: bool = False      # Gaussian phase-autocovariance compensated
+    #                        weights (arXiv:2208.06060): instead of
+    #                        sampling the power-law spectrum on the FFT
+    #                        grid (which drops ALL power below the grid
+    #                        fundamental), build the per-mode variances
+    #                        from the FFT of the closed-form Kolmogorov
+    #                        phase covariance evaluated on the periodic
+    #                        grid — the screen is then an exact Gaussian
+    #                        process whose structure function follows
+    #                        (r/s0)^alpha out to the wrap scale (the
+    #                        measurable low-frequency accuracy fix; see
+    #                        screen_weights_pac and the slope acceptance
+    #                        test).  Opt-in, jax screen path only,
+    #                        mutually exclusive with ``subharmonics``.
 
 
 def derived_constants(p: SimParams) -> dict:
@@ -94,17 +108,27 @@ def derived_constants(p: SimParams) -> dict:
     )
 
 
-def _swdsp(p: SimParams, consp: float, kx, ky, xp=np):
-    """Anisotropic power-law spectral amplitude with inner-scale cutoff
-    (swdsp, scint_sim.py:229-245)."""
+def _aniso_coeffs(p: SimParams, xp=np):
+    """The det-1 anisotropy quadratic form's (a, b, c): ``q2 = a kx^2 +
+    b ky^2 + c kx ky`` in k-space (swdsp, scint_sim.py:235-241) — ONE
+    derivation shared by the spectral amplitude, the lag-space inverse
+    form and the pac compensator's mode spectrum, so an anisotropy-
+    convention fix can never diverge them."""
     cs = xp.cos(p.psi * xp.pi / 180)
     sn = xp.sin(p.psi * xp.pi / 180)
     r = p.ar
-    con = xp.sqrt(consp)
-    alf = -(p.alpha + 2) / 4
     a = cs ** 2 / r + r * sn ** 2
     b = r * cs ** 2 + sn ** 2 / r
     c = 2 * cs * sn * (1 / r - r)
+    return a, b, c
+
+
+def _swdsp(p: SimParams, consp: float, kx, ky, xp=np):
+    """Anisotropic power-law spectral amplitude with inner-scale cutoff
+    (swdsp, scint_sim.py:229-245)."""
+    con = xp.sqrt(consp)
+    alf = -(p.alpha + 2) / 4
+    a, b, c = _aniso_coeffs(p, xp=xp)
     q2 = a * kx ** 2 + b * ky ** 2 + c * kx * ky
     # q2=0 at DC -> inf weight; callers zero the DC bin explicitly (the
     # screen has no mean-phase term).  np.errstate only affects numpy
@@ -172,6 +196,113 @@ def screen_weights_reference(p: SimParams) -> np.ndarray:
     return w
 
 
+def _aniso_lag(p: SimParams, x, y, xp=np):
+    """Effective separation ``r'`` under the INVERSE of `_swdsp`'s
+    spectral quadratic form (the det-1 anisotropy matrix: ``q2 = a kx^2
+    + b ky^2 + c kx ky`` in k-space maps to ``r'^2 = b x^2 + a y^2 -
+    c x y`` in lag space), so ``D(x, y) = (r'/s0)^alpha``."""
+    a, b, cc = _aniso_coeffs(p, xp=xp)
+    # positive definite (det 1); clamp float ripple at near-zero lags
+    return xp.sqrt(xp.maximum(b * x ** 2 + a * y ** 2 - cc * x * y, 0.0))
+
+
+def phase_structure_function(p: SimParams, x, y, xp=np):
+    """Closed-form theoretical phase structure function ``D(x, y) =
+    (r'/s0)^alpha`` of the anisotropic Kolmogorov spectrum `_swdsp`
+    samples, with (x, y) in the same physical units as ``s0``
+    (Fresnel-scale units times ``rf``)."""
+    c = derived_constants(p)
+    return (_aniso_lag(p, x, y, xp=xp) / c["s0"]) ** p.alpha
+
+
+@functools.lru_cache(maxsize=None)
+def pac_fit(p: SimParams) -> tuple[float, float]:
+    """Fit the Gaussian phase-autocovariance compensator
+    (arXiv:2208.06060): the ``(s2, w)`` of ``B_g(r) = s2 exp(-(r/w)^2)``
+    whose structure-function contribution ``2 s2 (1 - exp(-(r/w)^2))``
+    best repairs the FFT screen's low-frequency deficit.
+
+    The deficit is computed EXACTLY, not modelled: the synthesis
+    ``Re fft2(w z)`` realises covariance ``C(r) = sum_k w_k^2
+    cos(2 pi k r / N) = N ifft2(w^2)``, so one FFT of the sampled
+    weights gives the grid's actual ``D_fft = 2 (C(0) - C(r))``, and
+    the residual against the closed-form Kolmogorov ``(r'/s0)^alpha``
+    is what the Gaussian is least-squares fitted to (closed-form
+    amplitude per candidate width, 1-D width search).  A Gaussian is
+    the right shape because the missing sub-fundamental band
+    contributes quadratically at small ``r`` — exactly a Gaussian
+    covariance's small-lag behaviour."""
+    wf2 = screen_weights(p) ** 2
+    cov = np.real(np.fft.ifft2(wf2)) * (p.nx * p.ny)
+    d_fft = 2.0 * (cov[0, 0] - cov)
+    # wrap-periodic anisotropic lag grid, in the synthesis's own grid
+    # units (x = i dx — the same units dq and the mode phases use, and
+    # the units ``s0`` is normalised to through consp's rf^-alpha)
+    lx = np.asarray(_abs_freq_index(p.nx)) * float(p.dx)
+    ly = np.asarray(_abs_freq_index(p.ny)) * float(p.dy)
+    r = _aniso_lag(p, lx[:, None], ly[None, :], xp=np)
+    d_th = (r / derived_constants(p)["s0"]) ** p.alpha
+    resid = np.maximum(d_th - d_fft, 0.0)
+    extent = float(max(lx.max(), ly.max()))
+    best = None
+    for w in np.geomspace(extent / 16.0, 8.0 * extent, 49):
+        m = 1.0 - np.exp(-((r / w) ** 2))
+        mm = float(np.sum(m * m))
+        if mm <= 0:
+            continue
+        s2 = max(float(np.sum(m * resid)) / (2.0 * mm), 0.0)
+        err = float(np.sum((2.0 * s2 * m - resid) ** 2))
+        if best is None or err < best[0]:
+            best = (err, s2, w)
+    return float(best[1]), float(best[2])
+
+
+# sampling resolution of the compensator's sub-fundamental mode grid:
+# (2*_PAC_M + 1)^2 - 1 explicit modes cover the Gaussian spectrum's
+# support (or the sub-fundamental square, whichever is smaller)
+_PAC_M = 8
+
+
+@functools.lru_cache(maxsize=None)
+def pac_modes(p: SimParams) -> tuple[np.ndarray, np.ndarray]:
+    """Explicit low-k mode table realising the fitted Gaussian
+    compensator (:func:`pac_fit`): wavenumbers [M, 2] and amplitude
+    weights [M], consumed by the same separable-outer-product synthesis
+    as :func:`subharmonic_modes`.
+
+    The fitted compensator typically lives almost entirely BELOW the
+    grid fundamental (that is the deficit being repaired), so it cannot
+    ride the periodic FFT grid at all — like the subharmonic scheme, it
+    must be added as explicit non-periodic modes.  The mode grid spans
+    ``|k| <= min(dq, ~6 sigma_k)`` per axis (beyond ~6/w the Gaussian
+    spectrum is dead; beyond dq the FFT grid already carries the power
+    law), sampled at ``(2M+1)^2 - 1`` points with per-mode amplitude
+    ``sqrt(S_g(k) dkx dky) / (2 pi)`` where ``S_g(k) = s2 pi w^2
+    exp(-q2(k) w^2 / 4)`` is the (anisotropic) Gaussian's spectrum —
+    the continuous-transform pair of ``B_g``."""
+    s2, w = pac_fit(p)
+    c = derived_constants(p)
+    if s2 <= 0.0:
+        return np.zeros((0, 2)), np.zeros((0,))
+    # the aniso form q2 = a kx^2 + ... has eigenvalues in [1/ar, ar]:
+    # the spectrum is dead beyond q2 w^2/4 ~ 9, i.e. |k| ~ 6 sqrt(ar)/w
+    kdead = 6.0 * np.sqrt(max(p.ar, 1.0 / p.ar)) / w
+    kx_max = min(c["dqx"], kdead)
+    ky_max = min(c["dqy"], kdead)
+    m = _PAC_M
+    dkx, dky = kx_max / m, ky_max / m
+    a, b, cc = _aniso_coeffs(p)
+    ii = np.arange(-m, m + 1)
+    kx = (ii * dkx)[:, None] + np.zeros((1, 2 * m + 1))
+    ky = (ii * dky)[None, :] + np.zeros((2 * m + 1, 1))
+    q2 = a * kx ** 2 + b * ky ** 2 + cc * kx * ky
+    sg = s2 * np.pi * w ** 2 * np.exp(-q2 * w ** 2 / 4.0)
+    amp = np.sqrt(sg * dkx * dky) / (2.0 * np.pi)
+    keep = ~((kx == 0.0) & (ky == 0.0))   # no mean-phase mode
+    ks = np.stack([kx[keep], ky[keep]], axis=-1)
+    return ks, amp[keep]
+
+
 def fresnel_filter(p: SimParams, scale, xp=np):
     """exp(-i q^2(scale)) on the full FFT grid (frfilt3 closed form)."""
     c = derived_constants(p)
@@ -208,18 +339,19 @@ class Simulation:
                  inner=0.001, ns=256, nf=256, dlam=0.25, lamsteps=False,
                  seed=None, nx=None, ny=None, dx=None, dy=None,
                  verbose=False, backend: str = "numpy",
-                 subharmonics: int = 0):
-        if subharmonics and backend != "jax":
+                 subharmonics: int = 0, pac: bool = False):
+        if (subharmonics or pac) and backend != "jax":
             raise ValueError(
-                "subharmonic low-k compensation is implemented on the jax "
-                "screen path only (the numpy path stays reference-exact); "
-                "pass backend='jax'")
+                "low-k compensation (subharmonics / pac) is implemented on "
+                "the jax screen path only (the numpy path stays "
+                "reference-exact); pass backend='jax'")
         self.params = SimParams(
             mb2=mb2, rf=rf, dx=dx if dx is not None else ds,
             dy=dy if dy is not None else ds, alpha=alpha, ar=ar, psi=psi,
             inner=inner, nx=nx if nx is not None else ns,
             ny=ny if ny is not None else ns, nf=nf, dlam=dlam,
-            lamsteps=lamsteps, subharmonics=int(subharmonics))
+            lamsteps=lamsteps, subharmonics=int(subharmonics),
+            pac=bool(pac))
         # reference-compatible attribute aliases
         p = self.params
         self.mb2, self.rf, self.alpha, self.ar, self.psi = \
@@ -313,8 +445,8 @@ def subharmonic_modes(p: SimParams) -> tuple[np.ndarray, np.ndarray]:
                 kx, ky = pp * c["dqx"] * f, qq * c["dqy"] * f
                 ks.append((kx, ky))
                 ws.append(float(_swdsp(p, c["consp"], kx, ky, xp=np)) * f)
-    return (np.asarray(ks, dtype=np.float64),
-            np.asarray(ws, dtype=np.float64))
+    return (np.asarray(ks, dtype=np.float64),  # host-f64: host mode table
+            np.asarray(ws, dtype=np.float64))  # host-f64: host mode table
 
 
 @functools.lru_cache(maxsize=None)
@@ -322,6 +454,10 @@ def _simulate_jax(p: SimParams, return_screen: bool, freq_chunk: int | None):
     import jax
     import jax.numpy as jnp
 
+    if p.pac and p.subharmonics:
+        raise ValueError(
+            "SimParams.pac and SimParams.subharmonics are two low-k "
+            "compensation schemes for the same deficit; enable one")
     # Closure constants stay numpy: jnp constants created here would be tied
     # to whatever trace first builds this (cached) closure and leak.
     w = screen_weights(p, xp=np)
@@ -329,11 +465,20 @@ def _simulate_jax(p: SimParams, return_screen: bool, freq_chunk: int | None):
     filt_consts = derived_constants(p)
     qx2 = np.asarray(_abs_freq_index(p.nx)) ** 2 * filt_consts["ffconx"]
     qy2 = np.asarray(_abs_freq_index(p.ny)) ** 2 * filt_consts["ffcony"]
+    # low-k compensation: both schemes yield an explicit mode table
+    # consumed by the same separable-outer-product synthesis below
+    modes = None
     if p.subharmonics:
-        sub_k, sub_w = subharmonic_modes(p)
+        modes = subharmonic_modes(p)
+    elif p.pac:
+        modes = pac_modes(p)
+    if modes is not None and modes[1].size:
+        sub_k, sub_w = modes
         # mode phase on the spatial grid (x = i*dx): [M, nx], [M, ny]
         sub_px = sub_k[:, 0:1] * (np.arange(p.nx) * p.dx)[None, :]
         sub_py = sub_k[:, 1:2] * (np.arange(p.ny) * p.dy)[None, :]
+    else:
+        modes = None
 
     def one_freq(xyp, scale):
         q2 = (qx2[:, None] + qy2[None, :]) * scale
@@ -347,7 +492,7 @@ def _simulate_jax(p: SimParams, return_screen: bool, freq_chunk: int | None):
         z = (jax.random.normal(kr, (p.nx, p.ny))
              + 1j * jax.random.normal(ki, (p.nx, p.ny)))
         xyp = jnp.real(jnp.fft.fft2(w * z))
-        if p.subharmonics:
+        if modes is not None:
             ks1, ks2 = jax.random.split(jax.random.fold_in(key, 7))
             M = sub_w.shape[0]
             gr = jax.random.normal(ks1, (M,))
@@ -437,8 +582,12 @@ def _pad_cycle(arr, multiple: int):
     return jnp.concatenate([arr, filler], axis=0)
 
 
-@functools.lru_cache(maxsize=None)
-def _simulate_sweep_jax(p: SimParams, fields: tuple, point_chunk: int):
+def _sweep_screen_intensity(p: SimParams, fields: tuple):
+    """Single-screen intensity with the named float fields TRACED:
+    ``one(key, vals[F]) -> spi [nx, nf]``.  The building block shared by
+    :func:`simulate_sweep` and the on-device synthetic route's swept
+    generator (sim/campaign.py) — one compiled program covers a whole
+    physics grid."""
     import dataclasses as _dc
 
     import jax
@@ -466,6 +615,15 @@ def _simulate_sweep_jax(p: SimParams, fields: tuple, point_chunk: int):
 
         spe = jax.vmap(one_freq, out_axes=1)(scales)
         return jnp.real(spe) ** 2 + jnp.imag(spe) ** 2
+
+    return one
+
+
+@functools.lru_cache(maxsize=None)
+def _simulate_sweep_jax(p: SimParams, fields: tuple, point_chunk: int):
+    import jax
+
+    one = _sweep_screen_intensity(p, fields)
 
     @jax.jit
     def impl(keys, vals):
@@ -495,10 +653,11 @@ def simulate_sweep(keys, params: SimParams, sweep: dict,
     """
     import jax.numpy as jnp
 
-    if params.subharmonics:
-        raise ValueError("simulate_sweep does not support subharmonics "
-                         "(host-side mode table); use simulate_ensemble "
-                         "per parameter point instead")
+    if params.subharmonics or params.pac:
+        raise ValueError("simulate_sweep does not support subharmonics/"
+                         "pac (host-side mode table / covariance FFT); "
+                         "use simulate_ensemble per parameter point "
+                         "instead")
     fields = tuple(sorted(sweep))
     if not fields:
         raise ValueError("sweep must name at least one field")
@@ -508,8 +667,8 @@ def simulate_sweep(keys, params: SimParams, sweep: dict,
                              f"fields are {_SWEEPABLE}")
     n = keys.shape[0]
     vals = np.stack([np.broadcast_to(
-        np.asarray(sweep[f], dtype=np.float64), (n,)) for f in fields],
-        axis=-1)
+        np.asarray(sweep[f], dtype=np.float64), (n,))  # host-f64: host staging (canonicalised on transfer)
+        for f in fields], axis=-1)
     keys = _pad_cycle(keys, point_chunk)
     vals = _pad_cycle(jnp.asarray(vals), point_chunk)
     # canonicalise the cached trace key: the swept fields' base values

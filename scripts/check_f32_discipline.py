@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Repo lint: no ``float64`` / ``complex128`` literals in the jax hot
-paths (``scintools_tpu/ops/`` + ``scintools_tpu/parallel/``) without an
-explicit ``# host-f64`` annotation.
+paths (``scintools_tpu/ops/`` + ``scintools_tpu/parallel/`` +
+``scintools_tpu/sim/``) without an explicit ``# host-f64`` annotation.
 
 The compiled pipeline is an f32 machine: under the production x64-off
 runtime a stray ``astype(np.float64)`` on a traced array either
@@ -16,14 +16,18 @@ Token-based, not regex: docstrings and comments that merely mention the
 dtypes don't count; only a real NAME token does.  Enforced in tier-1
 via tests/test_f32_discipline.py.
 
-Coverage is the full ``ops/`` + ``parallel/`` walk — which includes
-the Pallas kernel modules (``ops/pallas_common.py``,
+Coverage is the full ``ops/`` + ``parallel/`` + ``sim/`` walk — which
+includes the Pallas kernel modules (``ops/pallas_common.py``,
 ``ops/sspec_pallas.py``, ``ops/resample_pallas.py``, the kernels in
 ``ops/nudft.py``): kernels are the EASIEST place to silently
 reintroduce f64 temps (a host-precomputed phase matrix or window taper
 flowing into VMEM doubles the very bytes the kernel exists to save),
 so tests/test_f32_discipline.py pins those files as present in the
-walk.
+walk.  ``sim/`` joined the walk when the synthetic route fused the
+simulator INTO the compiled analysis step (sim/campaign.py): its
+generators now trace straight into the device program, so a stray wide
+dtype there is the same silent-truncation / 2x-bytes hazard as one in
+ops/ (host-side mode tables and axis builders carry the annotation).
 """
 
 from __future__ import annotations
@@ -35,7 +39,7 @@ import tokenize
 
 WIDE = {"float64", "complex128"}
 MARKER = "host-f64"
-SUBTREES = ("ops", "parallel")
+SUBTREES = ("ops", "parallel", "sim")
 
 
 def find_wide_literals(path: str) -> list:
@@ -79,7 +83,8 @@ def main() -> int:
                          f"'# {MARKER}: <why>'): {text}\n")
     if offenders:
         sys.stderr.write(f"{len(offenders)} unannotated float64/"
-                         f"complex128 literal(s) in ops/ + parallel/\n")
+                         f"complex128 literal(s) in "
+                         f"{' + '.join(s + '/' for s in SUBTREES)}\n")
         return 1
     return 0
 

@@ -123,6 +123,25 @@ print('fused sspec on-chip vs f64 oracle:', err_f, '(chain:', err_c, ')')
 assert err_f < max(2 * err_c, 1e-4), (err_f, err_c)
 "
 
+SYNTH_CODE="
+import numpy as np
+from scintools_tpu import obs
+from scintools_tpu.parallel import PipelineConfig, run_pipeline
+from scintools_tpu.sim import SynthSpec
+obs.enable()
+spec = SynthSpec(kind='arc', n_epochs=4, nf=64, nt=64, dt=10.0)
+buckets = run_pipeline(config=PipelineConfig(lamsteps=True),
+                       synthetic=spec)
+(_, res), = buckets
+eta = np.asarray(res.arc.eta)
+assert eta.shape == (4,) and np.isfinite(eta).all(), eta
+h2d = int(obs.counters()['bytes_h2d'])
+# zero-H2D contract: the staged input is 4 epochs x 2 uint32 key words
+# (8 bytes/epoch) — independent of the (nf, nt) grid
+assert h2d == 4 * 2 * 4, ('bytes_h2d is not keys-only', h2d)
+print('synthetic generate->analyse on chip ok; bytes_h2d =', h2d)
+"
+
 NUDFT_CODE="
 import numpy as np, jax, jax.numpy as jnp
 from scintools_tpu.ops.nudft import _nudft_numpy, _r_grid, nudft
@@ -151,9 +170,11 @@ probe || { echo "tunnel unreachable; aborting"; exit 1; }
 # long enough for the bench before wedging at the next stage), so:
 #   1. headline bench         (round's #1 deliverable; landed 2026-08-02,
 #                              a repeat in a healthier window raises it)
-#   2-3. pallas gates (row-scrunch + fused sspec) + nudft bf16 guard
-#        (sub-minute CORRECTNESS verdicts that validate every capture
-#        below; CPU CI cannot see any of them)
+#   2-3. pallas gates (row-scrunch + fused sspec) + synthetic-lane
+#        zero-H2D smoke + nudft bf16 guard (sub-minute CORRECTNESS
+#        verdicts that validate every capture below; CPU CI cannot
+#        see the Mosaic lowerings, and the on-chip bytes_h2d assert
+#        proves the key-fed program stages no dynspec bytes)
 #   4. f32 on-chip budget     (published figures' only missing capture)
 #   5. all five configs       (configs 1-3 have no on-chip record)
 #   6. B=256 stage profile    (repeat-healthy-flight evidence)
@@ -206,6 +227,13 @@ echo "== fused sspec kernels lower on chip =="
 # gate proves the real-Mosaic lowering AND its oracle numerics before
 # the hour-scale stages spend the window (CPU CI sees interpret only)
 gated "fused sspec lowering check" 600 2 python -u -c "$FUSED_CODE"
+
+echo "== synthetic lane: on-device generate->analyse + zero-H2D =="
+# the zero-H2D campaign route (run_pipeline(synthetic=...)): one
+# sub-minute smoke proves the fused generate->analyse program lowers
+# and runs on real silicon AND that the staged traffic is keys-only
+# (the bytes_h2d counter asserts O(keys), independent of nf x nt)
+gated "synthetic lane check" 600 2 python -u -c "$SYNTH_CODE"
 
 echo "== nudft einsum on-chip accuracy (bf16-lowering guard) =="
 # the round-4 A/B caught the vmapped einsum NUDFT silently lowering to

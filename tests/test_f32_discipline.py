@@ -1,9 +1,12 @@
 """Tier-1 enforcement of scripts/check_f32_discipline.py: the jax hot
-paths (ops/ + parallel/) carry no unannotated float64/complex128
+paths (ops/ + parallel/ + sim/) carry no unannotated float64/complex128
 literals — wide dtypes there are either a silent-truncation bug under
 the production x64-off runtime (the MULTICHIP_r05 nudft incident) or a
 2x tax on a bandwidth-bound step.  Host-side parity/numpy code opts
-out explicitly with a ``# host-f64: <why>`` marker."""
+out explicitly with a ``# host-f64: <why>`` marker.  sim/ joined the
+walk when the synthetic route fused the simulator into the compiled
+analysis step (its generators trace straight into the device
+program)."""
 
 import os
 import sys
@@ -18,9 +21,24 @@ def test_no_unannotated_wide_dtypes_in_jax_paths():
     offenders = check_f32_discipline.check_tree(
         os.path.join(REPO, "scintools_tpu"))
     assert offenders == [], (
-        "float64/complex128 literal(s) in scintools_tpu/ops/ or "
-        "parallel/ without a '# host-f64:' annotation:\n"
+        "float64/complex128 literal(s) in scintools_tpu/ops/, "
+        "parallel/ or sim/ without a '# host-f64:' annotation:\n"
         + "\n".join(f"{p}:{ln}: {txt}" for p, ln, txt in offenders))
+
+
+def test_sim_subtree_is_covered():
+    """The synthetic route traces sim/ generators straight into the
+    compiled step: the lint walk must include the simulator modules
+    (a rename out of sim/ would silently drop them)."""
+    assert "sim" in check_f32_discipline.SUBTREES
+    pkg = os.path.join(REPO, "scintools_tpu")
+    for name in ("simulation.py", "campaign.py", "synth.py"):
+        path = os.path.join(pkg, "sim", name)
+        assert os.path.exists(path), path
+        hits = check_f32_discipline.find_wide_literals(path)
+        assert not any(txt.startswith("TokenError")
+                       for _ln, txt in hits)
+        assert hits == [], (path, hits)
 
 
 def test_pallas_kernel_modules_are_covered():

@@ -1,0 +1,616 @@
+"""Zero-H2D synthetic campaigns (ISSUE 9 tentpole): the on-device
+generate→analyse route — ``run_pipeline(synthetic=SynthSpec)`` — and
+its identity threading (compile-cache step keys, bucket catalog, serve
+`simulate` job kind, CLI resume keys).
+
+The headline contracts, counter-asserted rather than hypothesised:
+
+* ``bytes_h2d`` on the synthetic route is O(keys) — 8 bytes/epoch —
+  INDEPENDENT of the (nf, nt) grid (the file route moves the whole
+  dynspec batch);
+* the closed-loop gate: campaigns with closed-form injected truth
+  (arc kind: curvature; acf kind: tau/dnu in the fitter's own
+  parameterisation) recover the injected values within the documented
+  budgets (eta 2%; tau 10% / dnu 15% on the batch mean — the same
+  budgets the batched-vs-reference parity tests use);
+* a served `simulate` job's CSV rows are byte-identical to a direct
+  ``run_pipeline(synthetic=...)`` run of the same keys/params.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from scintools_tpu import obs
+from scintools_tpu.parallel import PipelineConfig, run_pipeline
+from scintools_tpu.sim import SimParams, SynthSpec
+from scintools_tpu.sim import campaign
+
+# documented closed-loop budgets (docs/performance.md "On-device
+# synthetic campaigns"): eta per-epoch, tau/dnu on the batch mean
+ETA_BUDGET = 0.02
+TAU_BUDGET = 0.10
+DNU_BUDGET = 0.15
+
+# cheap analysis config for the plumbing tests (no arc fitter: the
+# eta sweep dominates compile time at these tiny shapes)
+SCINT_ONLY = PipelineConfig(lamsteps=False, fit_arc=False)
+
+TINY = SynthSpec(kind="screen", n_epochs=5, seed=3,
+                 params=SimParams(nx=64, ny=64, nf=32))
+
+
+def _one(buckets):
+    [(idx, res)] = buckets
+    return idx, res
+
+
+# ---------------------------------------------------------------------------
+# the zero-H2D contract
+# ---------------------------------------------------------------------------
+
+
+def test_bytes_h2d_is_keys_only_and_grid_independent():
+    """The acceptance criterion: staged bytes = B x 8 (two uint32 key
+    words per epoch), identical across (nf, nt) grids — and orders of
+    magnitude below what the file route would stage for the same
+    survey."""
+    specs = [SynthSpec(kind="screen", n_epochs=4,
+                       params=SimParams(nx=32, ny=32, nf=8)),
+             SynthSpec(kind="screen", n_epochs=4,
+                       params=SimParams(nx=64, ny=64, nf=16))]
+    staged = []
+    for spec in specs:
+        with obs.tracing() as reg:
+            run_pipeline(config=SCINT_ONLY, synthetic=spec)
+            c = reg.counters()
+            staged.append(c["bytes_h2d"])
+            assert c["epochs_synthesized"] == 4
+            assert c["epochs_processed"] == 4
+    assert staged[0] == staged[1] == 4 * 2 * 4
+    # the file route for the larger grid would stage B*nf*nt*4 bytes
+    # minimum: the synthetic route is >500x below it even at 64x16
+    assert staged[1] * 500 <= 4 * 16 * 64 * 4
+
+
+def test_sweep_values_ride_the_key_rows():
+    """Swept campaigns stage one extra bitcast float32 word per field —
+    still O(keys), still grid-independent."""
+    spec = SynthSpec(kind="screen", n_epochs=4,
+                     params=SimParams(nx=32, ny=32, nf=8),
+                     sweep=(("mb2", (0.5, 1.0, 2.0, 4.0)),))
+    rows = campaign.stage_batch(spec)
+    assert rows.shape == (4, 3) and rows.dtype == np.uint32
+    np.testing.assert_array_equal(
+        rows[:, 2].view(np.float32), np.float32([0.5, 1.0, 2.0, 4.0]))
+    with obs.tracing() as reg:
+        run_pipeline(config=SCINT_ONLY, synthetic=spec)
+        assert reg.counters()["bytes_h2d"] == 4 * 3 * 4
+
+
+# ---------------------------------------------------------------------------
+# route parity: the generated-on-device campaign equals the host route
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_results():
+    return _one(run_pipeline(config=SCINT_ONLY, synthetic=TINY))
+
+
+def test_synthetic_route_matches_host_staged_route(tiny_results):
+    """Generating inside the step must not change the science: the
+    same keys staged through the classic host route (simulate, wrap as
+    DynspecData, run_pipeline(epochs)) yield the same fits."""
+    from scintools_tpu.data import DynspecData
+    from scintools_tpu.sim import simulate_intensity
+
+    freqs, times = campaign.synth_axes(TINY)
+    rows = campaign.stage_batch(TINY)
+    epochs = []
+    for i in range(TINY.n_epochs):
+        spi = np.asarray(simulate_intensity(rows[i, :2], TINY.params))
+        epochs.append(DynspecData(dyn=spi.T, freqs=freqs, times=times,
+                                  name=f"host{i}"))
+    _, want = _one(run_pipeline(epochs, SCINT_ONLY))
+    _, got = tiny_results
+    for field in ("tau", "dnu"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(got.scint, field)),
+            np.asarray(getattr(want.scint, field)),
+            rtol=1e-3, atol=1e-6)
+
+
+def test_chunk_pad_bucket_and_screen_chunk_consistency(tiny_results):
+    """Every batch-decomposition knob (driver chunking with uniform
+    pads, catalog bucketing, in-step screen chunking) reproduces the
+    plain route's fits: pad lanes are re-simulations that never leak
+    into real lanes."""
+    _, base = tiny_results
+    variants = [
+        dict(chunk=2, pad_chunks=True),
+        dict(bucket=True),
+    ]
+    for kw in variants:
+        idx, res = _one(run_pipeline(config=SCINT_ONLY, synthetic=TINY,
+                                     **kw))
+        assert list(idx) == list(range(5))
+        np.testing.assert_allclose(np.asarray(res.scint.tau),
+                                   np.asarray(base.scint.tau),
+                                   rtol=1e-4, atol=1e-7)
+    chunked = dataclasses.replace(TINY, screen_chunk=2)
+    _, res = _one(run_pipeline(config=SCINT_ONLY, synthetic=chunked))
+    np.testing.assert_allclose(np.asarray(res.scint.tau),
+                               np.asarray(base.scint.tau),
+                               rtol=1e-4, atol=1e-7)
+
+
+def test_synthetic_route_on_mesh(tiny_results):
+    """The key batch shards over the mesh data axis like a dynspec
+    batch: 5 epochs pad to the 8-device multiple with repeated key
+    rows, sliced off at gather — same fits as the meshless run."""
+    from scintools_tpu.parallel import make_mesh
+
+    mesh = make_mesh()
+    idx, res = _one(run_pipeline(config=SCINT_ONLY, synthetic=TINY,
+                                 mesh=mesh))
+    assert list(idx) == list(range(5))
+    _, base = tiny_results
+    np.testing.assert_allclose(np.asarray(res.scint.tau),
+                               np.asarray(base.scint.tau),
+                               rtol=1e-4, atol=1e-7)
+
+
+def test_swept_generator_matches_simulate_sweep():
+    """The in-step swept generator (bitcast traced values) reproduces
+    sim.simulate_sweep for the same keys/values."""
+    from scintools_tpu.sim import simulate_sweep
+
+    p = SimParams(nx=32, ny=32, nf=8)
+    # exactly float32-representable values: the in-step route stages
+    # them as bitcast f32 words, simulate_sweep as host f64 — the
+    # physics must see identical numbers on both paths
+    vals = (0.25, 0.5, 2.0, 16.0)
+    spec = SynthSpec(kind="screen", n_epochs=4, seed=1, params=p,
+                     sweep=(("mb2", vals),))
+    gen = campaign.synth_generator(campaign.generator_id(spec))
+    dyn = np.asarray(gen(campaign.stage_batch(spec)))
+    keys = campaign.stage_batch(spec)[:, :2]
+    want = np.asarray(simulate_sweep(keys, p, {"mb2": np.array(vals)}))
+    np.testing.assert_allclose(dyn, np.transpose(want, (0, 2, 1)),
+                               rtol=5e-3, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# closed-loop validation gate (the continuous chaos-style check)
+# ---------------------------------------------------------------------------
+
+
+def test_closed_loop_arc_recovery():
+    """Simulate epochs with a CLOSED-FORM injected curvature on the
+    zero-H2D route and recover it through the full sspec → norm_sspec
+    arc fit within the 2% arc budget, per epoch."""
+    spec = SynthSpec(kind="arc", n_epochs=4, nf=128, nt=128, dt=10.0,
+                     nimg=128, env=0.5, arc_frac=0.8, noise=0.002)
+    cfg = PipelineConfig(lamsteps=True)
+    idx, res = _one(run_pipeline(config=cfg, synthetic=spec))
+    truth = campaign.injected_truth(spec)["betaeta"]
+    fits = np.asarray(res.arc.eta)
+    rel = np.abs(fits - truth) / truth
+    assert np.all(np.isfinite(fits))
+    assert np.all(rel < ETA_BUDGET), (fits, truth, rel)
+
+
+def test_closed_loop_scint_recovery():
+    """acf-kind campaigns inject tau/dnu in the fitter's OWN
+    parameterisation (1/e timescale, half-power bandwidth: the field
+    ACF is the square root of the fitter's intensity-ACF model), so the
+    batch-mean fit must recover them within the scint-fit budgets."""
+    spec = SynthSpec(kind="acf", n_epochs=8, nf=128, nt=128, dt=8.0,
+                     df=0.5, tau_s=48.0, dnu_mhz=2.0)
+    idx, res = _one(run_pipeline(config=SCINT_ONLY, synthetic=spec))
+    tau = np.asarray(res.scint.tau)
+    dnu = np.asarray(res.scint.dnu)
+    assert np.all(np.isfinite(tau)) and np.all(np.isfinite(dnu))
+    assert abs(float(np.mean(tau)) / spec.tau_s - 1) < TAU_BUDGET
+    assert abs(float(np.mean(dnu)) / spec.dnu_mhz - 1) < DNU_BUDGET
+
+
+# ---------------------------------------------------------------------------
+# spec identity / validation
+# ---------------------------------------------------------------------------
+
+
+def test_generator_id_canonicalises_run_only_fields():
+    a = SynthSpec(kind="screen", n_epochs=100, seed=7,
+                  params=SimParams(nx=32, ny=32, nf=8))
+    b = SynthSpec(kind="screen", n_epochs=3, seed=9,
+                  params=SimParams(nx=32, ny=32, nf=8))
+    assert campaign.generator_id(a) == campaign.generator_id(b)
+    # sweep VALUES are traced input, FIELD NAMES are program identity
+    c = dataclasses.replace(a, sweep=(("mb2", tuple([1.0] * 100)),))
+    d = dataclasses.replace(b, sweep=(("mb2", tuple([2.0] * 3)),))
+    assert campaign.generator_id(c) == campaign.generator_id(d)
+    assert campaign.generator_id(c) != campaign.generator_id(a)
+    # other kinds' knobs are canonicalised away
+    e = SynthSpec(kind="arc", n_epochs=4, tau_s=99.0)
+    f = SynthSpec(kind="arc", n_epochs=9, dnu_mhz=7.0,
+                  params=SimParams(nx=16, ny=16))
+    assert campaign.generator_id(e) == campaign.generator_id(f)
+
+
+def test_make_pipeline_memoises_across_campaigns():
+    """Two campaigns over one generator share ONE jit'd step (no
+    per-seed retrace) — the warm-worker contract."""
+    from scintools_tpu.parallel import make_pipeline
+
+    freqs, times = campaign.synth_axes(TINY)
+    a = make_pipeline(freqs, times, SCINT_ONLY, synth=TINY)
+    b = make_pipeline(freqs, times, SCINT_ONLY,
+                      synth=dataclasses.replace(TINY, n_epochs=7,
+                                                seed=99))
+    assert a is b
+
+
+def test_step_key_folds_generator_identity():
+    from scintools_tpu import compile_cache
+
+    freqs, times = campaign.synth_axes(TINY)
+    base = dict(config=SCINT_ONLY, mesh=None, chan_sharded=False,
+                batch_shape=(4, 2), dtype=np.uint32)
+
+    def key(**kw):
+        kw = dict(base, **kw)
+        return compile_cache.step_key(freqs, times, kw["config"],
+                                      kw["mesh"], kw["chan_sharded"],
+                                      kw["batch_shape"], kw["dtype"],
+                                      synth=kw.get("synth"))
+
+    k_file = key()
+    k_synth = key(synth=campaign.generator_id(TINY))
+    k_other = key(synth=campaign.generator_id(
+        dataclasses.replace(TINY, params=SimParams(nx=64, ny=64,
+                                                   nf=32, mb2=8.0))))
+    assert len({k_file, k_synth, k_other}) == 3
+    # seed / epoch count do NOT fork the artifact
+    assert key(synth=campaign.generator_id(
+        dataclasses.replace(TINY, seed=42, n_epochs=100))) == k_synth
+
+
+def test_plan_steps_synthetic_catalog():
+    """warmup --synthetic plans uint32 key signatures over the ladder
+    (catalog) or the survey's own chunk math."""
+    from scintools_tpu import compile_cache
+
+    spec = SynthSpec(kind="arc", n_epochs=5, nf=32, nt=32)
+    plans = compile_cache.plan_steps([], SCINT_ONLY, batch=4,
+                                     catalog=True, synthetic=spec)
+    shapes = [(tuple(b), bool(ch)) for _f, _t, b, dt, ch in plans]
+    assert shapes == [((1, 2), False), ((2, 2), False),
+                      ((4, 2), False), ((4, 2), True)]
+    assert all(np.dtype(dt) == np.uint32 for _f, _t, _b, dt, _c in plans)
+    plans2 = compile_cache.plan_steps([], SCINT_ONLY, synthetic=spec,
+                                      chunk=2, pad_chunks=True)
+    assert [tuple(b) for _f, _t, b, _d, _c in plans2] == [(2, 2)]
+
+
+def test_validation_rejects_bad_specs_and_configs():
+    with pytest.raises(ValueError, match="kind"):
+        campaign.validate_spec(SynthSpec(kind="nope"))
+    with pytest.raises(ValueError, match="n_epochs"):
+        campaign.validate_spec(SynthSpec(n_epochs=0))
+    # the staged key word is uint32: an out-of-range seed would
+    # silently reproduce another campaign's data under a new identity
+    with pytest.raises(ValueError, match="uint32"):
+        campaign.validate_spec(SynthSpec(seed=2 ** 32))
+    with pytest.raises(ValueError, match="uint32"):
+        campaign.validate_spec(SynthSpec(seed=-1))
+    with pytest.raises(ValueError, match="one value per epoch"):
+        campaign.validate_spec(SynthSpec(
+            kind="screen", n_epochs=3, sweep=(("mb2", (1.0,)),)))
+    with pytest.raises(ValueError, match="sweepable"):
+        campaign.validate_spec(SynthSpec(
+            kind="screen", n_epochs=1, sweep=(("alpha", (1.0,)),)))
+    with pytest.raises(ValueError, match="screen"):
+        campaign.validate_spec(SynthSpec(
+            kind="acf", n_epochs=1, sweep=(("mb2", (1.0,)),)))
+    with pytest.raises(ValueError, match="subharmonics/pac"):
+        campaign.validate_spec(SynthSpec(
+            kind="screen", n_epochs=1,
+            params=SimParams(pac=True), sweep=(("mb2", (1.0,)),)))
+    # config exclusions, one rule site (driver._validate_synth_config)
+    with pytest.raises(ValueError, match="bf16_io"):
+        run_pipeline(config=PipelineConfig(precision="bf16_io"),
+                     synthetic=TINY)
+    with pytest.raises(ValueError, match="arc_stack"):
+        run_pipeline(config=PipelineConfig(arc_stack=True),
+                     synthetic=TINY)
+    with pytest.raises(ValueError, match="epochs OR synthetic"):
+        run_pipeline([object()], synthetic=TINY)
+    with pytest.raises(TypeError, match="epochs .*synthetic"):
+        run_pipeline()
+
+
+def test_spec_dict_round_trip_and_unknown_keys():
+    spec = SynthSpec(kind="acf", n_epochs=6, seed=2, tau_s=30.0)
+    d = campaign.spec_to_dict(spec)
+    assert d == {"kind": "acf", "n_epochs": 6, "seed": 2, "tau_s": 30.0}
+    assert campaign.spec_from_dict(json.loads(json.dumps(d))) == spec
+    with pytest.raises(ValueError, match="unknown SynthSpec"):
+        campaign.spec_from_dict({"kind": "acf", "n_epoch": 3})
+    with pytest.raises(ValueError, match="unknown SimParams"):
+        campaign.spec_from_dict({"params": {"bm2": 2.0}})
+    # sparse and materialised-default dicts share one spec
+    assert campaign.spec_from_dict(
+        {"kind": "acf", "n_epochs": 6, "seed": 2, "tau_s": 30.0,
+         "dt": 8.0, "freq": 1400.0}) == spec
+
+
+# ---------------------------------------------------------------------------
+# serve: the `simulate` job kind
+# ---------------------------------------------------------------------------
+
+SERVE_SPEC = {"kind": "acf", "n_epochs": 3, "nf": 32, "nt": 32,
+              "tau_s": 48.0, "dnu_mhz": 2.0}
+SERVE_OPTS = {"no_arc": True}
+
+
+def test_simulate_job_never_shares_identity_with_file_jobs():
+    from scintools_tpu.serve import cfg_signature
+
+    sig_file = cfg_signature(dict(SERVE_OPTS))
+    sig_synth = cfg_signature(dict(SERVE_OPTS, synthetic=SERVE_SPEC))
+    assert sig_file != sig_synth
+    # dict ordering / JSON round-trips must not fork the identity
+    reordered = json.loads(json.dumps(
+        {"synthetic": dict(reversed(list(SERVE_SPEC.items()))),
+         "no_arc": True}))
+    assert cfg_signature(reordered) == sig_synth
+
+
+def test_submit_synthetic_validates_and_dedups(tmp_path):
+    from scintools_tpu.serve import JobQueue
+
+    q = JobQueue(str(tmp_path / "q"))
+    jid, status = q.submit_synthetic(SERVE_SPEC, SERVE_OPTS)
+    assert status == "submitted"
+    # idempotent: same campaign (sparse vs canonicalised) dedups
+    jid2, status2 = q.submit_synthetic(
+        campaign.spec_to_dict(campaign.spec_from_dict(SERVE_SPEC)),
+        SERVE_OPTS)
+    assert (jid2, status2) == (jid, "queued")
+    with pytest.raises(ValueError, match="unknown SynthSpec"):
+        q.submit_synthetic({"kind": "acf", "bogus": 1}, SERVE_OPTS)
+    with pytest.raises(ValueError, match="arc_stack"):
+        q.submit_synthetic(SERVE_SPEC,
+                           dict(SERVE_OPTS, arc_stack=True))
+    with pytest.raises(ValueError, match="bf16_io"):
+        q.submit_synthetic(SERVE_SPEC,
+                           dict(SERVE_OPTS, precision="bf16_io"))
+
+
+def test_served_simulate_job_rows_byte_identical_to_direct(tmp_path):
+    """The acceptance criterion: a served campaign's exported CSV is
+    byte-identical to a direct run_pipeline(synthetic=...) export of
+    the same keys/params — same row builder, same epoch-ordered store
+    keys, same deterministic compiled program."""
+    from scintools_tpu.serve import JobQueue, ServeWorker
+    from scintools_tpu.utils.store import ResultsStore
+
+    q = JobQueue(str(tmp_path / "q"))
+    jid, _ = q.submit_synthetic(SERVE_SPEC, SERVE_OPTS)
+    worker = ServeWorker(q, batch_size=4, max_wait_s=0.01)
+    stats = worker.run(max_batches=1)
+    assert stats["jobs_done"] == 1 and stats["jobs_failed"] == 0
+    assert sorted(q.results.keys()) == [
+        campaign.synth_row_key(jid, i) for i in range(3)]
+    served_csv = str(tmp_path / "served.csv")
+    assert q.results.export_csv(served_csv) == 3
+
+    rows = campaign.synthetic_rows(
+        campaign.spec_from_dict(SERVE_SPEC), SERVE_OPTS)
+    store = ResultsStore(str(tmp_path / "direct"))
+    for i, row in enumerate(rows):
+        assert row is not None
+        store.put(campaign.synth_row_key("direct", i), row)
+    direct_csv = str(tmp_path / "direct.csv")
+    store.export_csv(direct_csv)
+    with open(served_csv, "rb") as a, open(direct_csv, "rb") as b:
+        assert a.read() == b.read()
+    # resubmit after completion reports done without re-queueing
+    jid3, status3 = q.submit_synthetic(SERVE_SPEC, SERVE_OPTS)
+    assert (jid3, status3) == (jid, "done")
+
+
+def test_simulate_job_failure_routes_through_taxonomy(tmp_path):
+    """A transient infra fault mid-campaign requeues budget-free; a
+    deterministic generator error burns the bounded budget (same
+    taxonomy as file batches)."""
+    from scintools_tpu.serve import JobQueue, ServeWorker
+
+    q = JobQueue(str(tmp_path / "q"))
+    jid, _ = q.submit_synthetic(SERVE_SPEC, SERVE_OPTS)
+
+    calls = {"n": 0}
+
+    def flaky_runner(spec_dict, opts, mesh, async_exec, bucket):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+        raise ValueError("deterministic generator bug")
+
+    worker = ServeWorker(q, batch_size=4, max_wait_s=0.01,
+                         synth_runner=flaky_runner)
+    worker.poll_once(force_flush=True)
+    assert worker.stats["job_transient_retries"] == 1
+    job = q.get(jid)
+    assert job.transients == 1 and job.attempts == 0
+    # drain the backoff then let the deterministic error poison it
+    for _ in range(10):
+        jobs = q.claim("w2", n=1, lease_s=5.0,
+                       now=__import__("time").time() + 1e6)
+        if jobs:
+            worker2 = ServeWorker(q, batch_size=4,
+                                  synth_runner=flaky_runner)
+            worker2._execute_synthetic(jobs[0])
+    assert q.get(jid).attempts > 0
+
+
+def test_worker_passes_bucket_to_synth_runner(tmp_path):
+    """A --bucket worker must canonicalise simulate-job campaigns onto
+    the catalog ladder too (the warmed-worker jit_cache_miss=0
+    contract), so the worker's knob reaches the runner."""
+    from scintools_tpu.serve import JobQueue, ServeWorker
+
+    q = JobQueue(str(tmp_path / "q"))
+    q.submit_synthetic(SERVE_SPEC, SERVE_OPTS)
+    seen = {}
+
+    def spy_runner(spec_dict, opts, mesh, async_exec, bucket):
+        seen["bucket"] = bucket
+        return [None] * spec_dict["n_epochs"]
+
+    worker = ServeWorker(q, batch_size=4, bucket=True,
+                         synth_runner=spy_runner)
+    worker.poll_once(force_flush=True)
+    assert seen["bucket"] is True
+
+
+def test_worker_rejects_torn_synthetic_payload(tmp_path):
+    """A corrupted job record (spec no longer parseable) is
+    deterministic poison: straight to failed/, no retry burn."""
+    from scintools_tpu.serve import JobQueue, ServeWorker
+    from scintools_tpu.serve.queue import Job
+
+    q = JobQueue(str(tmp_path / "q"))
+    job = Job(id="torn", file="synthetic:acf",
+              cfg={"synthetic": {"kind": "acf", "n_epochs": "NaN?"}},
+              submitted_at=0.0)
+    q._write("leased", job)
+    worker = ServeWorker(q, batch_size=4)
+    worker._execute_synthetic(job)
+    assert q.state_of("torn") == "failed"
+
+
+# ---------------------------------------------------------------------------
+# CLI: process --synthetic (resume keys) / submit --synthetic
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(argv):
+    from scintools_tpu.cli import main
+
+    return main(argv)
+
+
+def test_cli_process_synthetic_and_resume(tmp_path, capsys):
+    csv = str(tmp_path / "out.csv")
+    store = str(tmp_path / "runs")
+    argv = ["process", "--synthetic", "3", "--synth-kind", "acf",
+            "--synth-nf", "32", "--synth-nt", "32", "--no-arc",
+            "--batched", "--results", csv, "--store", store]
+    assert _run_cli(argv) == 0
+    with open(csv) as fh:
+        lines = fh.read().splitlines()
+    assert len(lines) == 4  # header + 3 epochs, epoch-ordered
+    assert lines[1].startswith("synth-acf-s0-00000,")
+    assert lines[3].startswith("synth-acf-s0-00002,")
+    # resume: every epoch done -> the pipeline is skipped outright
+    # (store rows untouched), and the CSV re-exports identically
+    import scintools_tpu.sim.campaign as camp
+
+    ran = {"n": 0}
+    orig = camp.synthetic_rows
+
+    def counting(*a, **kw):
+        ran["n"] += 1
+        return orig(*a, **kw)
+
+    camp.synthetic_rows = counting
+    try:
+        assert _run_cli(argv) == 0
+    finally:
+        camp.synthetic_rows = orig
+    assert ran["n"] == 0
+    capsys.readouterr()
+
+
+def test_cli_synthetic_flag_validation(tmp_path):
+    with pytest.raises(SystemExit, match="--batched"):
+        _run_cli(["process", "--synthetic", "2", "--results",
+                  str(tmp_path / "x.csv")])
+    with pytest.raises(SystemExit, match="no input files"):
+        _run_cli(["process", "--batched", "--results",
+                  str(tmp_path / "x.csv")])
+    with pytest.raises(SystemExit, match="take no input files"):
+        _run_cli(["process", "--synthetic", "2", "--batched",
+                  "/nonexistent.dynspec"])
+    with pytest.raises(SystemExit, match="screen kind only"):
+        _run_cli(["process", "--synthetic", "2", "--synth-kind", "acf",
+                  "--synth-mb2", "4", "--batched"])
+    with pytest.raises(SystemExit, match="acf"):
+        _run_cli(["process", "--synthetic", "2", "--synth-tau", "10",
+                  "--batched"])
+    with pytest.raises(SystemExit, match="nothing to clean"):
+        _run_cli(["process", "--synthetic", "2", "--clean",
+                  "--batched"])
+    with pytest.raises(SystemExit, match="arc_stack|arc-stack"):
+        _run_cli(["process", "--synthetic", "2", "--arc-stack",
+                  "--batched"])
+
+
+def test_cli_submit_synthetic(tmp_path, capsys):
+    qdir = str(tmp_path / "q")
+    rc = _run_cli(["submit", qdir, "--synthetic", "2", "--synth-kind",
+                   "acf", "--synth-nf", "32", "--synth-nt", "32",
+                   "--no-arc"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["submitted"] == 1
+    assert out["jobs"][0]["file"] == "synthetic:acf"
+    # dedup on resubmit
+    rc = _run_cli(["submit", qdir, "--synthetic", "2", "--synth-kind",
+                   "acf", "--synth-nf", "32", "--synth-nt", "32",
+                   "--no-arc"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["deduped"] == 1 and out["submitted"] == 0
+
+
+def test_cli_warmup_synthetic_plans_key_signatures(tmp_path, capsys,
+                                                  monkeypatch):
+    monkeypatch.setenv("SCINT_COMPILE_CACHE", str(tmp_path / "cache"))
+    rc = _run_cli(["warmup", "--synthetic", "3", "--synth-kind", "acf",
+                   "--synth-nf", "16", "--synth-nt", "16", "--no-arc",
+                   "--no-scint", "--no-mesh"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    assert [s["shape"] for s in out["signatures"]] == [[3, 2]]
+    assert all(s["status"] in ("exported", "cached", "xla-cache-only")
+               for s in out["signatures"])
+
+
+# ---------------------------------------------------------------------------
+# bench: the synthetic lane
+# ---------------------------------------------------------------------------
+
+
+def test_bench_synthetic_lane_record(monkeypatch, tmp_path):
+    import importlib.util
+
+    monkeypatch.setenv("SCINT_BENCH_MIN_MEASURE_S", "0")
+    monkeypatch.setenv("SCINT_BENCH_MAX_REPEATS", "1")
+    monkeypatch.setenv("SCINT_COMPILE_CACHE", "off")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_synth_test", os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    with obs.tracing():
+        rec = bench.synthetic_throughput(8, 32, 3, 4, repeats=1)
+    assert rec["synthetic"] is True
+    assert rec["rate"] > 0
+    assert rec["shape"] == [3, 8, 32]
+    # the zero-H2D claim in the record: keys only (3 epochs x 8 bytes)
+    assert rec["bytes_h2d_first_pass"] == 3 * 2 * 4
