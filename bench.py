@@ -871,6 +871,15 @@ def main():
         from scintools_tpu import obs as _obs
 
         _obs.enable()
+    # fleet-trace correlation (ISSUE 10): one trace_id per bench run,
+    # embedded in every flight record AND emitted as the run's root
+    # event — a BENCH_*.json headline and its SCINT_BENCH_TRACE jsonl
+    # (or a fleet rollup over a shared trace dir) join on this id
+    from scintools_tpu import obs as _obs_mod
+    from scintools_tpu.obs.fleet import new_trace_id
+
+    run_trace_id = new_trace_id()
+    _obs_mod.event("bench.run", trace_id=run_trace_id)
     B = _env_int("SCINT_BENCH_B", DEFAULT_SHAPE[0])
     nf = _env_int("SCINT_BENCH_NF", DEFAULT_SHAPE[1])
     nt = _env_int("SCINT_BENCH_NT", DEFAULT_SHAPE[2])
@@ -953,6 +962,21 @@ def main():
             }
         except Exception as e:  # accounting must never sink the record
             rec["resilience"] = {"error": f"{type(e).__name__}: {e}"}
+        # trace correlation + the mergeable fixed-bucket latency
+        # histograms (ISSUE 10): the record carries the same summaries
+        # a fleet heartbeat would ship, so BENCH_* trajectories and
+        # fleet rollups read one schema (queue_wait only appears when
+        # this process actually served a queue)
+        rec["trace_id"] = run_trace_id
+        try:
+            hs = _obs.hist_summaries()
+            qw = hs.pop("queue_wait_s", None)
+            if qw:
+                rec["queue_wait_hist"] = qw
+            rec["stage_latency_hists"] = hs
+        except Exception as e:
+            rec["stage_latency_hists"] = {
+                "error": f"{type(e).__name__}: {e}"}
         # MFU/roofline accounting against the probed chip's published
         # peaks (device kind comes from the probe subprocess, so a wedged
         # main-process backend is never touched here)

@@ -905,7 +905,8 @@ def cmd_serve(args) -> int:
                              max_wait_s=args.max_wait, lease_s=args.lease,
                              poll_s=args.poll, mesh=mesh,
                              async_exec=not args.no_async,
-                             bucket=getattr(args, "bucket", False))
+                             bucket=getattr(args, "bucket", False),
+                             heartbeat_s=getattr(args, "heartbeat", 10.0))
     except ValueError as e:
         # e.g. batch/mesh divisibility — a usage error, not a traceback
         raise SystemExit(str(e))
@@ -1311,15 +1312,65 @@ def cmd_wavefield(args) -> int:
 
 
 def cmd_trace_report(args) -> int:
-    """Aggregate a JSONL trace (written by ``--trace``) into the
-    per-stage count/total/p50/p95 table plus counters."""
+    """Aggregate JSONL trace(s) — literal paths and/or globs — into
+    the merged per-stage count/total/p50/p95 table plus counters.
+    Torn/truncated lines and an unreadable file among several degrade
+    to a stderr warning, never a mid-report traceback.  ``--fleet``
+    treats each argument as a fleet directory (heartbeats + traces +
+    crash flights) and appends the merged rollup + backpressure."""
+    import os
+
+    if getattr(args, "fleet", False):
+        rc = 0
+        for d in args.tracefile:
+            if not os.path.isdir(d):
+                print(f"{d}: no such fleet directory", file=sys.stderr)
+                rc = 1
+                continue
+            text, warnings = obs.fleet_report(d)
+            for w in warnings:
+                print(f"warning: {w}", file=sys.stderr)
+            print(text)
+        return rc
     try:
-        print(obs.report(args.tracefile))
+        text, warnings = obs.report_many(list(args.tracefile))
     except (OSError, UnicodeDecodeError) as e:
-        # UnicodeDecodeError: a binary file (e.g. a .dynspec passed by
-        # mistake) must fail with a one-line error, not a traceback
-        print(f"{args.tracefile}: unreadable ({e})", file=sys.stderr)
+        # a binary file (e.g. a .dynspec passed by mistake) or nothing
+        # readable at all fails with a one-line error, not a traceback
+        print(f"{', '.join(args.tracefile)}: unreadable ({e})",
+              file=sys.stderr)
         return 1
+    for w in warnings:
+        print(f"warning: {w}", file=sys.stderr)
+    print(text)
+    return 0
+
+
+def cmd_fleet_status(args) -> int:
+    """Fleet rollup over a serve queue directory: per-worker heartbeat
+    rows, merged histograms, reassembled traces, and the backpressure
+    scalar (docs/observability.md).  ``--json`` prints the machine
+    form (the admission-control input)."""
+    import os
+
+    from .obs import fleet as fleet_mod
+    from .serve import JobQueue
+
+    qdir = _existing_queue_dir(args.queue)
+    # a live depth beats the heartbeat-reported one when the dir IS a
+    # queue (fleet dirs of bare heartbeats have no queued/ subdir)
+    depth = None
+    if os.path.isdir(os.path.join(qdir, "queued")):
+        c = JobQueue(qdir).counts()
+        depth = c["queued"] + c["leased"]
+    heartbeats, events, warnings = fleet_mod.collect_fleet(qdir)
+    for w in warnings:
+        print(f"warning: {w}", file=sys.stderr)
+    rollup = fleet_mod.fleet_rollup(heartbeats, events, depth=depth)
+    if args.json:
+        print(json.dumps({"queue": args.queue, **rollup}, default=str))
+    else:
+        print(fleet_mod.render_fleet(rollup))
     return 0
 
 
@@ -1634,6 +1685,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "instead of the full --batch: less pad waste, "
                         "same byte-identical results, still zero "
                         "tracing on a warmed worker")
+    q.add_argument("--heartbeat", type=float, default=10.0,
+                   metavar="SECONDS",
+                   help="liveness/telemetry snapshot interval: the "
+                        "worker atomically rewrites heartbeat/"
+                        "<worker>.json in the queue dir every N "
+                        "seconds (`fleet status` merges them; 0 "
+                        "disables)")
     q.set_defaults(fn=cmd_serve)
 
     q = sub.add_parser(
@@ -1786,9 +1844,33 @@ def build_parser() -> argparse.ArgumentParser:
     tsub = q.add_subparsers(dest="trace_command", required=True)
     r = tsub.add_parser("report",
                         help="per-stage span table (count/total/p50/p95) "
-                             "+ counters from a trace file")
-    r.add_argument("tracefile", help="JSONL trace written by --trace")
+                             "+ counters, merged over trace file(s)")
+    r.add_argument("tracefile", nargs="+",
+                   help="JSONL trace(s) written by --trace; literal "
+                        "paths or glob patterns, merged into one "
+                        "report (torn lines warn + skip)")
+    r.add_argument("--fleet", action="store_true",
+                   help="treat each argument as a fleet directory "
+                        "(worker heartbeats + traces + crash flights) "
+                        "and print the merged per-worker rollup with "
+                        "the backpressure scalar")
     r.set_defaults(fn=cmd_trace_report)
+
+    q = sub.add_parser(
+        "fleet",
+        help="fleet-level telemetry over a serve queue directory")
+    fsub = q.add_subparsers(dest="fleet_command", required=True)
+    r = fsub.add_parser(
+        "status",
+        help="merge worker heartbeats + traces into per-worker and "
+             "aggregate tables with the backpressure scalar "
+             "(docs/observability.md, fleet section)")
+    r.add_argument("queue", help="serve queue dir (or any dir holding "
+                                 "heartbeat/*.json)")
+    r.add_argument("--json", action="store_true",
+                   help="machine-readable rollup (the admission-"
+                        "control input) instead of the table")
+    r.set_defaults(fn=cmd_fleet_status)
     return p
 
 

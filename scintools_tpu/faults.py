@@ -203,7 +203,7 @@ class FaultSpec:
 # new faults.check() site.
 KNOWN_SITES = ("driver.chunk_execute", "schedule.prefetch",
                "compile_cache.load", "queue.claim_rename",
-               "worker.load", "worker.batch_execute")
+               "worker.load", "worker.batch_execute", "worker.poll")
 
 # site -> FaultSpec.  EMPTY in production: check()'s disarmed cost is
 # the one dict lookup the acceptance criteria demand.  Armed only by

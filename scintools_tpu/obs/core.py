@@ -27,9 +27,13 @@ say nothing about device time.
 from __future__ import annotations
 
 import contextlib
+import itertools
+import os
 import threading
 import time
 from collections import deque
+
+from .hist import Hist
 
 # Single source of the enabled flag.  Read via enabled()/the fast-path
 # checks below; written only by enable()/disable() under _LOCK.
@@ -37,9 +41,21 @@ _ENABLED = False
 _LOCK = threading.RLock()
 _TLS = threading.local()
 
-# bounded in-process event history (tests / summary drill-down); the
+# bounded in-process event history: the crash flight recorder's ring
+# buffer (dump_flight writes its tail) AND the tests' drill-down; the
 # per-name duration lists in the registry are what summary() reads
 _EVENT_HISTORY = 65536
+
+# span/event ids: process-unique, cheap, and globally unique enough for
+# fleet trace reassembly once prefixed with the pid (two workers on one
+# host cannot collide; two hosts sharing a queue dir are distinguished
+# by the hostname in attrs/worker ids, and id collisions across hosts
+# would need equal pid AND equal counter — accepted for a trace tool)
+_ID_COUNTER = itertools.count(1)
+
+
+def _new_id() -> str:
+    return f"{os.getpid():x}-{next(_ID_COUNTER):x}"
 
 
 def _span_stack() -> list:
@@ -76,13 +92,20 @@ class Span:
     reached through different parents still lands in one table row).
     """
 
-    __slots__ = ("name", "attrs", "path", "dur_ms", "_t0")
+    __slots__ = ("name", "attrs", "path", "dur_ms", "span_id",
+                 "parent_id", "_t0")
 
     def __init__(self, name: str, attrs: dict):
         self.name = name
         self.attrs = attrs
         self.path = name
         self.dur_ms = None
+        # causal identity (ISSUE 10 fleet tracing): every recorded span
+        # carries its own id and its in-process parent's, so a merged
+        # multi-process trace reassembles the hierarchy even where the
+        # '/'-joined path is ambiguous (same stage reached twice)
+        self.span_id = _new_id()
+        self.parent_id = None
         self._t0 = 0.0
 
     def set(self, **attrs) -> "Span":
@@ -95,6 +118,7 @@ class Span:
         stack = _span_stack()
         if stack:
             self.path = stack[-1].path + "/" + self.name
+            self.parent_id = stack[-1].span_id
         stack.append(self)
         self._t0 = time.perf_counter()
         return self
@@ -120,6 +144,7 @@ class Registry:
         self._counters: dict[str, float] = {}
         self._flushed: dict[str, float] = {}
         self._gauges: dict[str, float] = {}
+        self._hists: dict[str, Hist] = {}
         self._events = deque(maxlen=_EVENT_HISTORY)
         self._sinks: list = []
 
@@ -127,21 +152,69 @@ class Registry:
     def record_span(self, span: Span) -> None:
         event = {"ts": time.time(), "kind": "span", "name": span.name,
                  "path": span.path, "dur_ms": round(span.dur_ms, 6),
+                 "span": span.span_id, "pid": os.getpid(),
                  "attrs": span.attrs}
+        if span.parent_id is not None:
+            event["parent"] = span.parent_id
         with _LOCK:
             self._durs.setdefault(span.name, []).append(span.dur_ms)
+            # mergeable twin of the duration list: the fixed-bucket
+            # histogram heartbeats ship (per-stage latency buckets)
+            h = self._hists.get(span.name)
+            if h is None:
+                h = self._hists[span.name] = Hist()
+            h.observe(span.dur_ms)
             self._events.append(event)
             sinks = list(self._sinks)
         for s in sinks:
             s.emit(event)
 
+    def record_event(self, name: str, parent: str | None = None,
+                     attrs: dict | None = None) -> str:
+        """A zero-duration lifecycle record (job submit/claim/requeue/
+        complete hops): like a span it carries its own id + optional
+        parent link and streams to sinks immediately, but it has no
+        duration and never enters the span tables.  Returns the new
+        id so callers can persist it as the NEXT hop's parent (the
+        cross-process link a job record carries between workers)."""
+        event = {"ts": time.time(), "kind": "event", "name": name,
+                 "span": _new_id(), "pid": os.getpid(),
+                 "attrs": dict(attrs or {})}
+        if parent is not None:
+            event["parent"] = parent
+        with _LOCK:
+            self._events.append(event)
+            sinks = list(self._sinks)
+        for s in sinks:
+            s.emit(event)
+        return event["span"]
+
     def inc(self, name: str, value=1) -> None:
         with _LOCK:
             self._counters[name] = self._counters.get(name, 0) + value
 
-    def gauge(self, name: str, value) -> None:
+    def gauge(self, name: str, value, stream: bool = False) -> None:
         with _LOCK:
             self._gauges[name] = value
+            sinks = list(self._sinks) if stream else ()
+        if stream:
+            # timeline gauges (queue_depth at submit/complete/fail
+            # transitions): the registry's latest-value cell aliases a
+            # sawtooth at low flush rates, so transition points stream
+            # one timestamped gauge event per change to the sinks
+            event = {"ts": time.time(), "kind": "gauge", "name": name,
+                     "value": value, "pid": os.getpid()}
+            for s in sinks:
+                s.emit(event)
+
+    def observe(self, name: str, value: float) -> None:
+        """Feed one value into the named fixed-bucket histogram (the
+        mergeable fleet form; e.g. per-job queue wait in seconds)."""
+        with _LOCK:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = Hist()
+            h.observe(value)
 
     def add_sink(self, sink) -> None:
         with _LOCK:
@@ -164,6 +237,20 @@ class Registry:
     def gauges(self) -> dict:
         with _LOCK:
             return dict(self._gauges)
+
+    def hists(self) -> dict:
+        """{name: sparse hist dict} — the heartbeat wire form (see
+        obs/hist.py); merge across processes with merge_hist_dicts."""
+        with _LOCK:
+            return {name: h.to_dict() for name, h in self._hists.items()}
+
+    def hist_summaries(self) -> dict:
+        """{name: {count, total, mean, p50, p95, p99, min, max}} from
+        the fixed-bucket histograms (bench flight records embed these;
+        quantiles are bucket-edge estimates, unlike summary()'s exact
+        per-process p50/p95)."""
+        with _LOCK:
+            return {name: h.summary() for name, h in self._hists.items()}
 
     def span_names(self) -> list:
         with _LOCK:
@@ -206,7 +293,42 @@ class Registry:
             self._counters.clear()
             self._flushed.clear()
             self._gauges.clear()
+            self._hists.clear()
             self._events.clear()
+
+    def dump_flight(self, directory: str, error: str | None = None,
+                    classification: str | None = None,
+                    limit: int = 4096, extra: dict | None = None) -> str:
+        """Crash flight recorder: write the event ring buffer's tail
+        (newest ``limit`` records) plus a header snapshot (pid, error +
+        faults.classify_error verdict, counters, gauges) to
+        ``<directory>/flight_<pid>.jsonl``.  Called on unhandled worker
+        failure (serve/worker.py) so the last moments of a dead process
+        survive for the fleet rollup; the JSONL lines are the normal
+        trace format, readable by ``trace report``."""
+        import json
+
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, f"flight_{os.getpid()}.jsonl")
+        with _LOCK:
+            tail = list(self._events)[-max(int(limit), 0):]
+            header = {"ts": time.time(), "kind": "flight",
+                      "pid": os.getpid(), "events": len(tail),
+                      "counters": dict(self._counters),
+                      "gauges": dict(self._gauges)}
+        if error is not None:
+            header["error"] = error
+        if classification is not None:
+            header["classification"] = classification
+        if extra:
+            header.update(extra)
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(json.dumps(header, default=str) + "\n")
+            for ev in tail:
+                fh.write(json.dumps(ev, default=str) + "\n")
+        os.replace(tmp, path)
+        return path
 
 
 def _quantile(sorted_durs: list, q: float) -> float:
@@ -251,10 +373,46 @@ def inc(name: str, value=1) -> None:
         _REGISTRY.inc(name, value)
 
 
-def gauge(name: str, value) -> None:
-    """Set a named gauge to its latest value (no-op when disabled)."""
+def gauge(name: str, value, stream: bool = False) -> None:
+    """Set a named gauge to its latest value (no-op when disabled).
+    ``stream=True`` additionally emits one timestamped gauge event to
+    the sinks NOW — for timeline gauges (queue_depth transitions) whose
+    latest-value cell would alias between flushes."""
     if _ENABLED:
-        _REGISTRY.gauge(name, value)
+        _REGISTRY.gauge(name, value, stream=stream)
+
+
+def observe(name: str, value: float) -> None:
+    """Feed one value into the named fixed-bucket histogram (no-op when
+    disabled) — the mergeable fleet form of a latency sample."""
+    if _ENABLED:
+        _REGISTRY.observe(name, value)
+
+
+def event(name: str, parent: str | None = None, **attrs) -> str | None:
+    """Record a zero-duration lifecycle event with its own id and an
+    optional cross-process parent link; returns the new id (None when
+    disabled — callers persist it as the next hop's parent only when a
+    trace is actually being taken)."""
+    if not _ENABLED:
+        return None
+    return _REGISTRY.record_event(name, parent=parent, attrs=attrs)
+
+
+def hist_summaries() -> dict:
+    return _REGISTRY.hist_summaries()
+
+
+def dump_flight(directory: str, error: str | None = None,
+                classification: str | None = None,
+                limit: int = 4096, extra: dict | None = None) -> str:
+    """Dump the in-process event ring buffer (see
+    Registry.dump_flight); works even when tracing is disabled — the
+    header snapshot (pid/error/classification) still lands, the event
+    tail is simply whatever the ring holds."""
+    return _REGISTRY.dump_flight(directory, error=error,
+                                 classification=classification,
+                                 limit=limit, extra=extra)
 
 
 def get_registry() -> Registry:

@@ -1,0 +1,529 @@
+"""Fleet telemetry: distributed job traces, worker heartbeat snapshots,
+and the merged operator rollup with a backpressure signal (ISSUE 10 —
+the telemetry prerequisite of ROADMAP item 1's multi-worker serve pool).
+
+Three layers, all filesystem-protocol like the serve queue itself:
+
+1. **Distributed traces.**  ``JobQueue.submit`` mints a ``trace_id``
+   and persists it in the job record; every lifecycle hop (claim, load,
+   preflight, batch, row put, complete/fail/requeue — including
+   lease-reap hops taken by a *different* process than the one that
+   died) records an ``obs.event`` carrying ``trace_id`` plus a parent
+   link to the previous hop's event id, which rides the job record
+   between processes.  In-process spans (``serve.batch`` →
+   ``pipeline.*`` → ``*.step.compile/execute``) chain through the span
+   ``span``/``parent`` ids recorded by obs.core.  Merging every
+   process's JSONL sink and calling :func:`assemble_traces` reassembles
+   one causal trace per job — SIGKILL, reap, and requeue hops included.
+
+2. **Heartbeats.**  Each worker atomically overwrites ONE file,
+   ``heartbeat/<worker>.json`` (bounded write amplification: a fleet of
+   N workers writes N small files per interval, never an append log):
+   pid, counters (totals AND deltas since the previous beat), gauges,
+   the mergeable fixed-bucket histograms (obs/hist.py) for queue wait
+   and per-stage latency, last-claim age, and warm-affinity digests
+   (warm-cache artifact / batch ladder).  :func:`merge_heartbeats` is
+   associative and commutative — fold any subset in any order.
+
+3. **Rollup + backpressure.**  ``trace report --fleet DIR`` /
+   ``scintools-tpu fleet status DIR`` merge N heartbeats + any trace
+   (or crash-flight) JSONL files into per-worker and aggregate tables,
+   and compute the scalar :func:`backpressure` ∈ [0, 1] documented
+   below — the admission-control input the serve-fleet item consumes.
+"""
+
+from __future__ import annotations
+
+import glob as glob_mod
+import json
+import os
+import time
+import uuid
+
+from . import core
+from .hist import Hist, merge_hist_dicts
+
+HEARTBEAT_DIRNAME = "heartbeat"
+FLIGHT_DIRNAME = "flight"
+HEARTBEAT_VERSION = 1
+
+# ---------------------------------------------------------------------------
+# trace ids + reassembly
+# ---------------------------------------------------------------------------
+
+
+def new_trace_id() -> str:
+    """A fresh distributed-trace id (uuid4 hex): minted once per job at
+    submit time and carried by every hop that touches the job."""
+    return uuid.uuid4().hex
+
+
+def assemble_traces(events) -> dict:
+    """Reassemble per-job causal traces from a MERGED event stream
+    (any number of processes' JSONL sinks, any order).
+
+    Membership: an event/span belongs to a trace when its attrs carry
+    ``trace_id``, or transitively when its parent id belongs to one —
+    the top-level ``parent`` field (the in-process span chain), with
+    an attrs-level ``parent`` as the CROSS-PROCESS fallback edge (a
+    top-level span like ``serve.load`` links to the job's previous
+    lifecycle hop, recorded by another process, through its attrs).
+    A span touching several jobs (one serve.batch over N jobs)
+    belongs to all of their traces.
+
+    Returns ``{trace_id: {"events": [records sorted by ts], "pids":
+    sorted pid list, "names": [event/span names in ts order],
+    "orphans": [records whose parent id is missing from the merged
+    stream]}}`` — ``orphans`` empty means the causal chain is complete
+    (the cross-process reassembly acceptance)."""
+    recs = [ev for ev in events
+            if ev.get("kind") in ("span", "event") and ev.get("span")]
+    by_id = {ev["span"]: ev for ev in recs}
+    # seed: explicit trace_id attrs
+    traces: dict[str, set] = {}
+    membership: dict[str, set] = {}   # record id -> trace ids
+    for ev in recs:
+        attrs = ev.get("attrs") or {}
+        tid = attrs.get("trace_id")
+        if tid:
+            membership.setdefault(ev["span"], set()).add(tid)
+        # a batch span touches N jobs at once (serve.batch carries
+        # every member's trace id); it and its nested pipeline spans
+        # belong to all of them
+        tids = attrs.get("trace_ids")
+        if isinstance(tids, (list, tuple)):
+            for t in tids:
+                if t:
+                    membership.setdefault(ev["span"], set()).add(t)
+    # propagate down parent chains until fixpoint (children inherit
+    # every trace their parent belongs to); the in-process span-stack
+    # parent is the primary edge, the attrs-level parent (set by
+    # workers on top-level spans to chain them to the job's previous
+    # cross-process hop) the fallback
+    children: dict[str, list] = {}
+    for ev in recs:
+        parent = ev.get("parent") or (ev.get("attrs") or {}).get("parent")
+        if parent:
+            children.setdefault(parent, []).append(ev["span"])
+    frontier = list(membership)
+    while frontier:
+        nxt = []
+        for rid in frontier:
+            tids = membership.get(rid, ())
+            for child in children.get(rid, ()):
+                have = membership.setdefault(child, set())
+                new = set(tids) - have
+                if new:
+                    have |= new
+                    nxt.append(child)
+        frontier = nxt
+    for rid, tids in membership.items():
+        for tid in tids:
+            traces.setdefault(tid, set()).add(rid)
+    out = {}
+    for tid, rids in traces.items():
+        evs = sorted((by_id[r] for r in rids),
+                     key=lambda e: (e.get("ts", 0.0), e["span"]))
+        orphans = [e for e in evs
+                   if e.get("parent") and e["parent"] not in by_id]
+        out[tid] = {"events": evs,
+                    "pids": sorted({e.get("pid") for e in evs
+                                    if e.get("pid") is not None}),
+                    "names": [e.get("name") for e in evs],
+                    "orphans": orphans}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# heartbeats
+# ---------------------------------------------------------------------------
+
+
+def _safe_name(worker_id: str) -> str:
+    return "".join(c if (c.isalnum() or c in "._-") else "_"
+                   for c in worker_id) or "worker"
+
+
+class HeartbeatWriter:
+    """Periodic atomic snapshot of one worker's telemetry into
+    ``<directory>/<worker>.json`` (tmp + ``os.replace`` — a reader can
+    never see a torn heartbeat; each beat OVERWRITES the last, so the
+    on-disk footprint is one bounded file per worker).
+
+    ``beat()`` is cheap when the interval has not elapsed (one clock
+    compare); the snapshot itself reads the obs registry (counters,
+    gauges, hists — empty dicts when tracing is disabled: liveness
+    still works untraced) plus whatever the worker passes in."""
+
+    def __init__(self, directory: str, worker_id: str,
+                 interval_s: float = 10.0):
+        self.dir = directory
+        self.worker_id = worker_id
+        self.interval_s = float(interval_s)
+        self.path = os.path.join(directory,
+                                 f"{_safe_name(worker_id)}.json")
+        self._last_beat = None
+        self._last_counters: dict = {}
+        self._seq = 0
+        self._digests = None
+
+    def _warm_digests(self) -> dict:
+        """Warm-affinity signals, computed once: the warm-cache
+        artifact digest this process's persistent cache was unpacked
+        from (compile_cache MANIFEST — also the catalog digest when
+        packed by ``warmup --catalog``)."""
+        if self._digests is None:
+            digests = {}
+            try:
+                from .. import compile_cache
+
+                man = compile_cache.artifact_manifest()
+                if man is not None:
+                    digests["warm_cache"] = str(man.get("digest", "?"))
+            except Exception:
+                pass
+            self._digests = digests
+        return self._digests
+
+    def due(self, now: float | None = None) -> bool:
+        now = time.time() if now is None else now
+        return (self._last_beat is None
+                or now - self._last_beat >= self.interval_s)
+
+    def beat(self, now: float | None = None, force: bool = False,
+             last_claim_at: float | None = None,
+             stats: dict | None = None,
+             extra: dict | None = None) -> str | None:
+        """Write one heartbeat if due (or ``force``).  Returns the path
+        written, else None."""
+        now = time.time() if now is None else now
+        if not force and not self.due(now):
+            return None
+        reg = core.get_registry()
+        counters = reg.counters()
+        # an UNTRACED worker (the default: obs.inc is a no-op) still
+        # counts outcomes in its own stats dict — map them onto the
+        # canonical counter names so the fleet rollup's jobs_done /
+        # drain-rate / backpressure math works without --trace; a
+        # traced worker's registry counters carry identical values
+        # and win
+        for stat_key, counter in (("jobs_done", "jobs_done"),
+                                  ("jobs_failed", "jobs_failed"),
+                                  ("job_retries", "job_retries"),
+                                  ("job_transient_retries",
+                                   "job_transient_retries"),
+                                  ("batches", "serve_batches"),
+                                  ("lanes_filled", "serve_lanes_filled"),
+                                  ("lanes_total", "serve_lanes_total")):
+            v = (stats or {}).get(stat_key)
+            if counter not in counters and isinstance(v, (int, float)):
+                counters[counter] = v
+        deltas = {k: v - self._last_counters.get(k, 0)
+                  for k, v in counters.items()
+                  if v != self._last_counters.get(k, 0)}
+        elapsed = (None if self._last_beat is None
+                   else round(now - self._last_beat, 6))
+        self._seq += 1
+        hb = {
+            "kind": "heartbeat", "v": HEARTBEAT_VERSION,
+            "worker": self.worker_id, "pid": os.getpid(),
+            "ts": round(now, 6), "seq": self._seq,
+            "interval_s": self.interval_s, "elapsed_s": elapsed,
+            "counters": counters, "deltas": deltas,
+            "gauges": reg.gauges(), "hists": reg.hists(),
+            "last_claim_age_s": (round(now - last_claim_at, 6)
+                                 if last_claim_at is not None else None),
+            "digests": self._warm_digests(),
+        }
+        if stats:
+            hb["stats"] = dict(stats)
+        if extra:
+            hb.update(extra)
+        os.makedirs(self.dir, exist_ok=True)
+        tmp = f"{self.path}.tmp{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(hb, fh, default=str)
+        os.replace(tmp, self.path)
+        self._last_beat = now
+        self._last_counters = counters
+        return self.path
+
+
+def read_heartbeats(directory: str) -> list[dict]:
+    """Every readable heartbeat under ``directory`` (non-recursive);
+    torn/foreign JSON files are skipped — a fleet readout must degrade,
+    never raise, while workers are writing concurrently."""
+    out = []
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return out
+    for name in names:
+        if not name.endswith(".json") or ".tmp" in name:
+            continue
+        try:
+            with open(os.path.join(directory, name),
+                      encoding="utf-8") as fh:
+                hb = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if isinstance(hb, dict) and hb.get("kind") == "heartbeat":
+            out.append(hb)
+    return out
+
+
+def merge_heartbeats(heartbeats) -> dict:
+    """Fold N worker heartbeats into one fleet aggregate — associative
+    and commutative (counter sums, histogram bucket adds, last-writer
+    gauges by timestamp), asserted by tests/test_fleet.py.
+
+    Returns ``{workers, counters, hists (merged summaries), gauges,
+    drain_rate_per_s, depth}``: ``drain_rate_per_s`` sums each
+    worker's ``jobs_done`` delta over its beat interval (a worker's
+    FIRST beat has no interval and contributes 0 — rate needs two
+    observations); ``depth`` is the freshest ``queue_depth`` gauge."""
+    hbs = sorted((hb for hb in heartbeats),
+                 key=lambda hb: (hb.get("ts", 0.0),
+                                 str(hb.get("worker"))))
+    counters: dict[str, float] = {}
+    hists: dict[str, Hist] = {}
+    gauges: dict = {}
+    gauge_ts: dict = {}
+    drain = 0.0
+    for hb in hbs:
+        for k, v in (hb.get("counters") or {}).items():
+            if isinstance(v, (int, float)):
+                counters[k] = counters.get(k, 0) + v
+        for name, d in (hb.get("hists") or {}).items():
+            try:
+                h = Hist.from_dict(d)
+            except (ValueError, TypeError, KeyError):
+                continue
+            hists[name] = h if name not in hists else hists[name].merge(h)
+        ts = hb.get("ts", 0.0)
+        for k, v in (hb.get("gauges") or {}).items():
+            if ts >= gauge_ts.get(k, -1.0):
+                gauges[k], gauge_ts[k] = v, ts
+        elapsed = hb.get("elapsed_s")
+        done = (hb.get("deltas") or {}).get("jobs_done", 0)
+        if elapsed and elapsed > 0 and isinstance(done, (int, float)):
+            drain += max(float(done), 0.0) / float(elapsed)
+    depth = gauges.get("queue_depth")
+    return {"workers": len(hbs),
+            "counters": counters,
+            "hists": {n: h.summary() for n, h in sorted(hists.items())},
+            "gauges": gauges,
+            "drain_rate_per_s": round(drain, 6),
+            "depth": depth}
+
+
+# ---------------------------------------------------------------------------
+# backpressure
+# ---------------------------------------------------------------------------
+
+BACKPRESSURE_HORIZON_S = 60.0
+
+
+def backpressure(depth, drain_rate_per_s,
+                 horizon_s: float = BACKPRESSURE_HORIZON_S) -> float:
+    """The fleet's admission-control scalar in [0, 1]:
+
+        backpressure = depth / (depth + drain_rate_per_s * horizon_s)
+
+    i.e. the fraction of the next ``horizon_s`` seconds the CURRENT
+    backlog would consume at the CURRENT fleet drain rate.  Properties
+    (pinned by tests/test_fleet.py):
+
+    * 0.0 when the queue is empty (any drain rate);
+    * monotonically increasing in ``depth`` at fixed drain;
+    * monotonically decreasing in ``drain_rate_per_s`` at fixed depth;
+    * 1.0 when depth > 0 and nothing is draining (stalled fleet);
+    * 0.5 exactly when the backlog equals one horizon of drain —
+      the natural "scale up" threshold.
+    """
+    d = max(float(depth or 0), 0.0)
+    if d <= 0.0:
+        return 0.0
+    r = max(float(drain_rate_per_s or 0.0), 0.0)
+    return round(d / (d + r * float(horizon_s)), 6)
+
+
+# ---------------------------------------------------------------------------
+# collection + rollup
+# ---------------------------------------------------------------------------
+
+
+def collect_fleet(directory: str) -> tuple[list, list, list]:
+    """Gather a fleet directory's telemetry: ``(heartbeats, events,
+    warnings)``.
+
+    ``directory`` is a serve queue dir (heartbeats under
+    ``heartbeat/``, crash flights under ``flight/``) or a bare
+    heartbeat dir; trace JSONL files directly inside it are merged
+    too.  Unreadable/torn inputs are skipped with a warning string —
+    the rollup never dies on a file a live worker is mid-writing."""
+    from .report import load_trace_files
+
+    heartbeats = read_heartbeats(directory)
+    hb_sub = os.path.join(directory, HEARTBEAT_DIRNAME)
+    if os.path.isdir(hb_sub):
+        heartbeats += read_heartbeats(hb_sub)
+    patterns = [os.path.join(directory, "*.jsonl"),
+                os.path.join(directory, FLIGHT_DIRNAME, "*.jsonl")]
+    paths = sorted(p for pat in patterns for p in glob_mod.glob(pat))
+    events, warnings = load_trace_files(paths)
+    return heartbeats, events, warnings
+
+
+def depth_timeline(events, limit: int = 12) -> list:
+    """(ts, depth) points from streamed ``queue_depth`` gauge events —
+    the transition-stamped timeline (ISSUE 10 satellite: submit/
+    complete/fail stamp depth, so low poll rates don't alias it).
+    Down-sampled evenly to ``limit`` points for rendering."""
+    pts = [(ev.get("ts", 0.0), ev.get("value"))
+           for ev in events
+           if ev.get("kind") == "gauge"
+           and ev.get("name") == "queue_depth"
+           and isinstance(ev.get("value"), (int, float))]
+    pts.sort(key=lambda p: p[0])
+    if len(pts) <= limit:
+        return pts
+    step = (len(pts) - 1) / (limit - 1)
+    return [pts[round(i * step)] for i in range(limit)]
+
+
+def _worker_row(hb: dict, now: float) -> dict:
+    c = hb.get("counters") or {}
+    hists = hb.get("hists") or {}
+    qw = None
+    if "queue_wait_s" in hists:
+        try:
+            qw = Hist.from_dict(hists["queue_wait_s"]).summary()
+        except (ValueError, TypeError, KeyError):
+            qw = None
+    cold = sum(v for k, v in c.items()
+               if k.startswith("compile_ms[") and k.endswith(":cold]"))
+    warm = sum(v for k, v in c.items()
+               if k.startswith("compile_ms[") and k.endswith(":warm]"))
+    lanes_total = c.get("serve_lanes_total", 0)
+    return {
+        "worker": hb.get("worker"), "pid": hb.get("pid"),
+        "age_s": round(max(now - hb.get("ts", now), 0.0), 3),
+        "last_claim_age_s": hb.get("last_claim_age_s"),
+        "jobs_done": int(c.get("jobs_done", 0)),
+        "jobs_failed": int(c.get("jobs_failed", 0)),
+        "job_retries": int(c.get("job_retries", 0)),
+        "job_transient_retries": int(c.get("job_transient_retries", 0)),
+        "epochs_quarantined": int(c.get("epochs_quarantined", 0)),
+        "fill_ratio": (round(c.get("serve_lanes_filled", 0)
+                             / lanes_total, 4) if lanes_total else None),
+        "queue_wait": qw,
+        "compile_cold_ms": round(cold, 3),
+        "compile_warm_ms": round(warm, 3),
+        "warm_cache": (hb.get("digests") or {}).get("warm_cache"),
+    }
+
+
+def fleet_rollup(heartbeats, events=(), depth=None,
+                 now: float | None = None) -> dict:
+    """The machine-readable fleet readout: per-worker rows, the merged
+    aggregate, trace reassembly stats, the depth timeline, and the
+    backpressure scalar.  ``depth`` overrides the heartbeat-reported
+    queue depth with a live measurement when the caller has one (the
+    ``fleet status`` CLI reads the queue dir directly)."""
+    now = time.time() if now is None else now
+    merged = merge_heartbeats(heartbeats)
+    eff_depth = depth if depth is not None else merged["depth"]
+    traces = assemble_traces(events) if events else {}
+    rollup = {
+        "workers": [_worker_row(hb, now) for hb in
+                    sorted(heartbeats,
+                           key=lambda h: str(h.get("worker")))],
+        "merged": merged,
+        "depth": eff_depth,
+        "drain_rate_per_s": merged["drain_rate_per_s"],
+        "backpressure": backpressure(eff_depth,
+                                     merged["drain_rate_per_s"]),
+        "depth_timeline": depth_timeline(events),
+        "traces": {
+            "count": len(traces),
+            "orphan_events": sum(len(t["orphans"])
+                                 for t in traces.values()),
+            "multi_process": sum(1 for t in traces.values()
+                                 if len(t["pids"]) > 1),
+        },
+    }
+    return rollup
+
+
+def _fmt_hist(s: dict | None) -> str:
+    if not s or not s.get("count"):
+        return "-"
+    return (f"n={s['count']} p50={s['p50']:.4g} p95={s['p95']:.4g} "
+            f"p99={s['p99']:.4g}")
+
+
+def render_fleet(rollup: dict) -> str:
+    """Human rendering of :func:`fleet_rollup` (the ``trace report
+    --fleet`` / ``fleet status`` payload)."""
+    lines = ["fleet (merged heartbeats + traces):"]
+    workers = rollup["workers"]
+    if workers:
+        for w in workers:
+            qw = _fmt_hist(w["queue_wait"])
+            claim = (f"{w['last_claim_age_s']:.1f}s"
+                     if w["last_claim_age_s"] is not None else "-")
+            fill = (f"{w['fill_ratio']}" if w["fill_ratio"] is not None
+                    else "-")
+            lines.append(
+                f"  worker {w['worker']} (pid {w['pid']}): beat "
+                f"{w['age_s']:.1f}s ago, last claim {claim}, done = "
+                f"{w['jobs_done']}, failed = {w['jobs_failed']}, "
+                f"retries = {w['job_retries']}"
+                f"+{w['job_transient_retries']}t, fill = {fill}")
+            lines.append(
+                f"    queue_wait_s: {qw}; compile cold/warm ms = "
+                f"{w['compile_cold_ms']:.1f}/{w['compile_warm_ms']:.1f}"
+                + (f"; warm_cache = {w['warm_cache']}"
+                   if w["warm_cache"] else ""))
+    else:
+        lines.append("  (no heartbeats)")
+    merged = rollup["merged"]
+    if merged["hists"]:
+        lines.append("  merged latency histograms:")
+        for name, s in merged["hists"].items():
+            lines.append(f"    {name}: {_fmt_hist(s)}")
+    c = merged["counters"]
+    if c:
+        lines.append(
+            "  totals: jobs_done = %d, jobs_failed = %d, job_retries "
+            "= %d, transient = %d, quarantined = %d" % (
+                c.get("jobs_done", 0), c.get("jobs_failed", 0),
+                c.get("job_retries", 0),
+                c.get("job_transient_retries", 0),
+                c.get("epochs_quarantined", 0)))
+    tl = rollup["depth_timeline"]
+    if tl:
+        lines.append("  queue_depth timeline: "
+                     + " ".join(f"{int(v)}" for _, v in tl))
+    tr = rollup["traces"]
+    if tr["count"]:
+        lines.append(
+            f"  traces: {tr['count']} reassembled, "
+            f"{tr['multi_process']} spanning >1 process, "
+            f"{tr['orphan_events']} orphan event(s)")
+    lines.append(
+        f"  depth = {rollup['depth'] if rollup['depth'] is not None else '-'}, "
+        f"drain = {rollup['drain_rate_per_s']}/s, "
+        f"backpressure = {rollup['backpressure']} "
+        f"(depth / (depth + drain*{BACKPRESSURE_HORIZON_S:.0f}s))")
+    return "\n".join(lines)
+
+
+def fleet_report(directory: str, depth=None) -> tuple[str, list]:
+    """(rendered rollup, warnings) for one fleet directory — the CLI
+    entrypoint shared by ``trace report --fleet`` and ``fleet
+    status``."""
+    heartbeats, events, warnings = collect_fleet(directory)
+    return render_fleet(fleet_rollup(heartbeats, events,
+                                     depth=depth)), warnings

@@ -1,0 +1,158 @@
+"""Fixed-bucket histograms that merge associatively (ISSUE 10).
+
+The per-process registry keeps exact duration lists (``summary()``'s
+p50/p95 stay exact), but a FLEET cannot merge quantiles — p95(A) and
+p95(B) say nothing about p95(A ∪ B).  Bucket counts do merge: with one
+CLOSED bucket ladder shared by every process, ``merge(A, B)`` is an
+elementwise add, associative and commutative by construction, so N
+workers' heartbeat snapshots fold into one fleet histogram in any
+order (tested: tests/test_fleet.py::test_heartbeat_merge_associative).
+
+The ladder is geometric at half-octave (√2) steps — quantiles read
+from bucket edges carry at most ~41 % relative error, uniform across
+the range (µs-scale span latencies to hour-scale queue waits), and the
+exact ``count``/``total``/``min``/``max`` ride alongside so means stay
+exact.  Values are unit-agnostic (spans feed milliseconds, queue waits
+feed seconds); the metric NAME carries the unit, per the obs naming
+convention (``*_ms`` / ``*_s``).
+"""
+
+from __future__ import annotations
+
+# Closed bucket ladder: 2^(k/2) for k in [-28, 34] — 6.1e-5 .. 1.3e5,
+# 63 edges -> 64 buckets (the last is the overflow bucket).  Part of
+# the heartbeat wire format: changing it breaks cross-version merges,
+# so heartbeats stamp BOUNDS_VERSION and merge() refuses a mismatch.
+BOUNDS = tuple(2.0 ** (k / 2.0) for k in range(-28, 35))
+BOUNDS_VERSION = 1
+
+
+def _bucket_index(value: float) -> int:
+    """Index of the first bucket whose upper edge >= value (bisect on
+    the closed ladder; values above every edge land in overflow)."""
+    lo, hi = 0, len(BOUNDS)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if BOUNDS[mid] < value:
+            lo = mid + 1
+        else:
+            hi = mid
+    return lo
+
+
+class Hist:
+    """One fixed-bucket histogram: counts per ladder bucket plus exact
+    count/total/min/max."""
+
+    __slots__ = ("counts", "n", "total", "vmin", "vmax")
+
+    def __init__(self):
+        self.counts = [0] * (len(BOUNDS) + 1)
+        self.n = 0
+        self.total = 0.0
+        self.vmin = None
+        self.vmax = None
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.counts[_bucket_index(value)] += 1
+        self.n += 1
+        self.total += value
+        self.vmin = value if self.vmin is None else min(self.vmin, value)
+        self.vmax = value if self.vmax is None else max(self.vmax, value)
+
+    def quantile(self, q: float) -> float | None:
+        """Upper edge of the bucket holding the q-quantile observation
+        (exact min/max for the extremes; None when empty)."""
+        if not self.n:
+            return None
+        if q <= 0.0:
+            return self.vmin
+        if q >= 1.0:
+            return self.vmax
+        rank = round(q * (self.n - 1))   # nearest-rank, like summary()
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen > rank:
+                if i >= len(BOUNDS):   # overflow bucket: only max known
+                    return self.vmax
+                return min(BOUNDS[i], self.vmax)
+        return self.vmax
+
+    def summary(self) -> dict:
+        """{count, total, mean, p50, p95, p99, min, max} — the rollup
+        row shape shared by heartbeats, fleet tables and bench flight
+        records."""
+        if not self.n:
+            return {"count": 0}
+        return {"count": self.n,
+                "total": round(self.total, 6),
+                "mean": round(self.total / self.n, 6),
+                "p50": round(self.quantile(0.50), 6),
+                "p95": round(self.quantile(0.95), 6),
+                "p99": round(self.quantile(0.99), 6),
+                "min": round(self.vmin, 6),
+                "max": round(self.vmax, 6)}
+
+    # -- wire format (heartbeats) ------------------------------------------
+    def to_dict(self) -> dict:
+        """Sparse JSON form: only occupied buckets travel (bounded
+        write amplification — a worker's heartbeat carries dozens of
+        ints, not 64 zeros per metric)."""
+        return {"v": BOUNDS_VERSION,
+                "buckets": {str(i): c for i, c in enumerate(self.counts)
+                            if c},
+                "n": self.n, "total": round(self.total, 9),
+                "min": self.vmin, "max": self.vmax}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Hist":
+        """Raises ValueError on ANY malformed payload (wrong bounds
+        version, out-of-range bucket index, n > 0 without min/max) —
+        one exception type, so fleet readers can catch-and-warn
+        instead of dying mid-rollup on a corrupt heartbeat."""
+        if int(d.get("v", 0)) != BOUNDS_VERSION:
+            raise ValueError(
+                f"histogram bounds version {d.get('v')!r} != "
+                f"{BOUNDS_VERSION} (cross-version heartbeats do not "
+                "merge; upgrade the older worker)")
+        h = cls()
+        for i, c in (d.get("buckets") or {}).items():
+            idx = int(i)
+            if not 0 <= idx < len(h.counts):
+                raise ValueError(f"histogram bucket index {idx} out of "
+                                 f"range [0, {len(h.counts)})")
+            h.counts[idx] = int(c)
+        h.n = int(d.get("n", 0))
+        h.total = float(d.get("total", 0.0))
+        h.vmin = d.get("min")
+        h.vmax = d.get("max")
+        if h.n > 0 and (h.vmin is None or h.vmax is None):
+            raise ValueError("histogram with n > 0 but no min/max")
+        return h
+
+    def merge(self, other: "Hist") -> "Hist":
+        """Elementwise-add merge (associative + commutative); returns a
+        NEW Hist, operands untouched."""
+        out = Hist()
+        out.counts = [a + b for a, b in zip(self.counts, other.counts)]
+        out.n = self.n + other.n
+        out.total = self.total + other.total
+        mins = [v for v in (self.vmin, other.vmin) if v is not None]
+        maxs = [v for v in (self.vmax, other.vmax) if v is not None]
+        out.vmin = min(mins) if mins else None
+        out.vmax = max(maxs) if maxs else None
+        return out
+
+
+def merge_hist_dicts(dicts) -> dict | None:
+    """Fold sparse heartbeat histogram payloads into one summary dict
+    (the fleet rollup's per-metric row); None when nothing merged."""
+    acc = None
+    for d in dicts:
+        if not d:
+            continue
+        h = Hist.from_dict(d)
+        acc = h if acc is None else acc.merge(h)
+    return None if acc is None else acc.summary()

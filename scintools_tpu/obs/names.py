@@ -1,0 +1,129 @@
+"""The CLOSED catalog of observability names (ISSUE 10 satellite).
+
+Every counter/gauge/span/event/histogram name a ``scintools_tpu``
+module emits must be registered here: a typo'd metric name silently
+creates a brand-new series — it vanishes from `trace report`'s curated
+sections, from the fleet rollup, and from every tier-1 counter
+assertion, and nothing ever fails.  The AST lint
+(``scripts/check_obs_names.py``, enforced by
+``tests/test_obs_names.py``) walks the package for literal first
+arguments to ``obs.inc`` / ``obs.gauge`` / ``obs.span`` /
+``obs.observe`` / ``obs.event`` / ``obs.traced`` (and the
+``core.``-spelled equivalents inside ``obs/``) and fails on any name
+missing from this catalog.
+
+Conventions: units ride in the name (``*_s`` seconds, ``*_ms``
+milliseconds, ``bytes_*``); per-key series use a bracketed FAMILY —
+``family[<key>]`` — registered once in :data:`FAMILIES`; dynamic span
+prefixes (``stage.<name>``) register in :data:`SPAN_PREFIXES`.
+
+Documented in docs/observability.md; extend the relevant set in the
+same change that adds the emitting call site.
+"""
+
+from __future__ import annotations
+
+# -- counters (obs.inc) -----------------------------------------------------
+COUNTERS = frozenset({
+    # pipeline / driver
+    "epochs_processed", "epochs_failed", "epochs_synthesized",
+    "bytes_h2d", "jit_cache_miss", "prefetch_stall_s", "oom_backoff",
+    "lm_steps", "lsq_nfev", "lsq_fits",
+    # ops / cleaning / sim
+    "refill_calls", "refill_pixels", "zap_calls", "zap_pixels",
+    "screens_simulated",
+    # compile cache / warm artifacts
+    "compile_cache_hit", "compile_cache_miss",
+    "compile_cache_evictions", "cache_artifact_packed",
+    "cache_artifact_unpacked", "cache_artifact_rejected",
+    # serve
+    "queue_wait_s", "serve_jobs_claimed", "serve_batches",
+    "serve_lanes_filled", "serve_lanes_total", "jobs_done",
+    "jobs_failed", "job_retries", "job_transient_retries",
+    "serve_synth_jobs", "serve_synth_rows",
+    # reliability
+    "epochs_quarantined", "store_corrupt_rows", "faults_injected",
+})
+
+# -- gauges (obs.gauge) -----------------------------------------------------
+GAUGES = frozenset({
+    "queue_depth", "batch_fill_ratio", "effective_chunk",
+    "compile_cache_artifact",
+})
+
+# -- spans (obs.span / obs.traced) ------------------------------------------
+SPANS = frozenset({
+    "pipeline.run", "pipeline.stage", "pipeline.prefetch",
+    "pipeline.gather",
+    "ops.sspec", "ops.acf",
+    "fit.arc", "fit.scint", "fit.lsq_numpy",
+    "sim.simulation",
+    "serve.poll", "serve.load", "serve.batch",
+})
+
+# dynamic span-name prefixes: obs.span(f"<prefix><runtime part>") — the
+# runtime part is caller-chosen (CLI StageTimers regions; instrument_jit
+# derives "<step name>.compile/.execute" from its name argument)
+SPAN_PREFIXES = ("stage.",)
+
+# -- lifecycle events (obs.event) -------------------------------------------
+EVENTS = frozenset({
+    # distributed job trace hops (obs/fleet.py contract)
+    "job.submit", "job.claim", "job.preflight", "job.batch", "job.row",
+    "job.complete", "job.fail", "job.requeue", "job.poison",
+    # bench run correlation root (bench flight records embed the id)
+    "bench.run",
+})
+
+# -- histograms (obs.observe) -----------------------------------------------
+HISTS = frozenset({
+    "queue_wait_s",
+})
+
+# -- bracketed families: "<family>[<key>]" ----------------------------------
+FAMILIES = frozenset({
+    "compile_ms",                                   # counter
+    "faults_injected", "epochs_quarantined",        # counters
+    "bucket_hits", "bucket_lanes_real", "bucket_lanes_pad",  # counters
+    "bucket_catalog", "step_flops", "step_bytes",   # gauges
+})
+
+_SETS = {"inc": COUNTERS, "gauge": GAUGES, "span": SPANS,
+         "traced": SPANS, "observe": HISTS, "event": EVENTS}
+
+
+def is_registered(func: str, literal: str, prefix_only: bool = False) -> bool:
+    """Whether a literal (or literal PREFIX of an f-string, when
+    ``prefix_only``) first argument to ``obs.<func>(...)`` names a
+    registered series.
+
+    Bracketed families: any name containing ``[`` is checked as its
+    family (the part before the bracket).  F-string prefixes: a prefix
+    ending at ``[`` must be a family; otherwise it must extend a
+    registered span prefix or be extensible to a registered exact name
+    (conservative — the lint's job is catching typos in the common
+    literal case, not proving dynamic names)."""
+    names = _SETS.get(func)
+    if names is None:
+        return True
+    if "[" in literal:
+        return literal.split("[", 1)[0] in FAMILIES
+    if not prefix_only:
+        return (literal in names
+                or (func in ("span", "traced")
+                    and literal.startswith(SPAN_PREFIXES)))
+    # f-string with a constant prefix and no bracket yet: accept a
+    # registered dynamic span prefix, a family the bracket of which
+    # starts in the dynamic part (rare; spelled "family[" above), or a
+    # prefix of some registered exact name
+    if func in ("span", "traced") and literal.startswith(SPAN_PREFIXES):
+        return True
+    return any(n.startswith(literal) for n in names | FAMILIES)
+
+
+def all_names() -> dict:
+    """The whole catalog, keyed by kind (docs/introspection)."""
+    return {"counters": sorted(COUNTERS), "gauges": sorted(GAUGES),
+            "spans": sorted(SPANS), "span_prefixes": list(SPAN_PREFIXES),
+            "events": sorted(EVENTS), "hists": sorted(HISTS),
+            "families": sorted(FAMILIES)}

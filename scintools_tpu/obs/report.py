@@ -12,22 +12,59 @@ import json
 from .core import summarize_durations
 
 
-def load_events(path: str) -> list:
+def load_events(path: str, skipped: list | None = None) -> list:
     """Parse a JSONL trace, skipping non-JSON noise lines (a trace file
-    may interleave with logger output when both target one stream)."""
+    may interleave with logger output when both target one stream, and
+    a SIGKILLed writer leaves a torn final line).  ``skipped``, when
+    given, collects one ``(line_number, snippet)`` per dropped line so
+    callers can warn instead of silently under-reporting."""
     events = []
-    with open(path, encoding="utf-8") as fh:
-        for line in fh:
+    with open(path, encoding="utf-8", errors="replace") as fh:
+        for lineno, line in enumerate(fh, 1):
             line = line.strip()
             if not line:
                 continue
             try:
                 ev = json.loads(line)
             except json.JSONDecodeError:
+                if skipped is not None:
+                    skipped.append((lineno, line[:60]))
                 continue
             if isinstance(ev, dict):
                 events.append(ev)
+            elif skipped is not None:
+                skipped.append((lineno, line[:60]))
     return events
+
+
+def load_trace_files(paths) -> tuple[list, list]:
+    """Merge events from MANY trace files — each entry may be a literal
+    path or a glob pattern (``trace report`` accepts both; the fleet
+    rollup feeds a directory's worth).  Degrades gracefully: an
+    unreadable file or a torn/truncated line becomes a warning string,
+    never an exception mid-report.  Returns ``(events, warnings)``."""
+    import glob as glob_mod
+
+    expanded: list[str] = []
+    warnings: list[str] = []
+    for p in paths:
+        hits = sorted(glob_mod.glob(p)) if glob_mod.has_magic(p) else [p]
+        if not hits:
+            warnings.append(f"{p}: no files match")
+        expanded.extend(hits)
+    events: list = []
+    for path in expanded:
+        skipped: list = []
+        try:
+            events.extend(load_events(path, skipped=skipped))
+        except OSError as e:
+            warnings.append(f"{path}: unreadable ({e}); skipped")
+            continue
+        if skipped:
+            warnings.append(
+                f"{path}: skipped {len(skipped)} torn/non-JSON line(s) "
+                f"(first at line {skipped[0][0]}: {skipped[0][1]!r})")
+    return events, warnings
 
 
 def aggregate(events: list) -> tuple:
@@ -440,3 +477,15 @@ def report(path: str) -> str:
     """The ``trace report`` payload for one JSONL trace file."""
     spans, counters, gauges = aggregate(load_events(path))
     return render(spans, counters, gauges)
+
+
+def report_many(paths) -> tuple[str, list]:
+    """The multi-file/glob ``trace report`` payload: one merged table
+    over every matched trace, plus the degradation warnings.  Raises
+    OSError only when NOTHING was readable (one bad path among many
+    degrades to a warning)."""
+    events, warnings = load_trace_files(paths)
+    if not events and warnings:
+        raise OSError("; ".join(warnings))
+    spans, counters, gauges = aggregate(events)
+    return render(spans, counters, gauges), warnings

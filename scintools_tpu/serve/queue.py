@@ -51,7 +51,8 @@ import os
 import time
 from typing import Sequence
 
-from .. import faults
+from .. import faults, obs
+from ..obs.fleet import new_trace_id
 from ..utils.store import ResultsStore, content_key
 
 # job states = subdirectories
@@ -185,6 +186,13 @@ class Job:
     # never gates the bounded ``attempts`` poison budget, but it does
     # drive the transient path's own exponential backoff
     transients: int = 0
+    # distributed-trace identity (ISSUE 10): ``trace_id`` is minted
+    # ONCE at submit and never changes; ``span`` is the obs event id of
+    # the job's LATEST lifecycle hop — each new hop records an event
+    # with parent=span and persists its own id here, so the causal
+    # chain survives crossing worker processes (SIGKILL, reap, requeue)
+    trace_id: str | None = None
+    span: str | None = None
 
     def to_record(self) -> dict:
         return {k: v for k, v in dataclasses.asdict(self).items()
@@ -366,6 +374,35 @@ class JobQueue:
                 return job
         return None
 
+    # -- fleet telemetry hooks (ISSUE 10) ----------------------------------
+    def _depth_gauge(self) -> None:
+        """Stamp ``queue_depth`` at a state TRANSITION (submit/
+        complete/fail): a timeline sampled only inside ``serve.poll``
+        aliases at low poll rates — the transition points are where
+        depth actually changes (test-pinned).  Streamed, so each stamp
+        is a timestamped gauge event in the trace, not just the
+        registry's latest-value cell.  Disabled tracing: one flag
+        check, no listdir.  Enabled: TWO listdirs (queued/ + leased/
+        only — depth never reads the unbounded done/ and failed/
+        directories, which grow with survey length)."""
+        if not obs.enabled():
+            return
+        depth = len(self._ids(QUEUED)) + len(self._ids(LEASED))
+        obs.gauge("queue_depth", depth, stream=True)
+
+    def _hop(self, job: Job, name: str, **attrs) -> Job:
+        """Record one lifecycle hop of ``job``'s distributed trace (an
+        obs event carrying ``trace_id`` + a parent link to the previous
+        hop) and return the job with ``span`` advanced to the new
+        event id — the link the NEXT hop (possibly in another process)
+        chains from.  No-op passthrough when tracing is disabled or
+        the job predates trace minting (legacy queue records)."""
+        if job.trace_id is None:
+            return job
+        sid = obs.event(name, parent=job.span, trace_id=job.trace_id,
+                        job=job.id, **attrs)
+        return job if sid is None else dataclasses.replace(job, span=sid)
+
     # -- client side -------------------------------------------------------
     def submit(self, path: str, cfg: dict | None = None) -> tuple[str, str]:
         """Enqueue one epoch file.  Returns ``(job_id, status)``:
@@ -388,8 +425,13 @@ class JobQueue:
         existing = self.state_of(job_id)
         if existing is not None:
             return job_id, existing
+        trace = new_trace_id()
+        root = obs.event("job.submit", trace_id=trace, job=job_id,
+                         file=os.path.basename(path))
         self._write(QUEUED, Job(id=job_id, file=os.path.abspath(path),
-                                cfg=cfg, submitted_at=_submit_stamp()))
+                                cfg=cfg, submitted_at=_submit_stamp(),
+                                trace_id=trace, span=root))
+        self._depth_gauge()
         return job_id, "submitted"
 
     def submit_synthetic(self, spec: dict,
@@ -421,8 +463,13 @@ class JobQueue:
         if existing is not None:
             return job_id, existing
         kind = cfg["synthetic"].get("kind", "screen")
+        trace = new_trace_id()
+        root = obs.event("job.submit", trace_id=trace, job=job_id,
+                         file=f"synthetic:{kind}")
         self._write(QUEUED, Job(id=job_id, file=f"synthetic:{kind}",
-                                cfg=cfg, submitted_at=_submit_stamp()))
+                                cfg=cfg, submitted_at=_submit_stamp(),
+                                trace_id=trace, span=root))
+        self._depth_gauge()
         return job_id, "submitted"
 
     # -- worker side -------------------------------------------------------
@@ -478,6 +525,8 @@ class JobQueue:
             # requeued this job in the read->rename window, and its
             # attempts/backoff must survive the claim
             fresh = self._read(LEASED, jid) or job
+            fresh = self._hop(fresh, "job.claim", worker=worker,
+                              attempt=fresh.attempts)
             leased = dataclasses.replace(fresh, lease_worker=worker,
                                          lease_expires_at=now + lease_s)
             self._write(LEASED, leased)
@@ -523,9 +572,18 @@ class JobQueue:
                 lease_expires_at=None,
                 error=f"lease expired (attempt {attempts})")
             if attempts > self.max_retries:
+                back = self._hop(back, "job.poison",
+                                 reason="lease_expired",
+                                 attempt=attempts)
                 self._write(FAILED, back)
                 poisoned.append(back)
             else:
+                # the reap hop is taken by whichever process noticed
+                # the expiry — its event links to the DEAD worker's
+                # claim hop, stitching the trace across the SIGKILL
+                back = self._hop(back, "job.requeue",
+                                 reason="lease_expired",
+                                 attempt=attempts)
                 back = dataclasses.replace(
                     back, not_before=now + self._backoff(attempts))
                 self._write(QUEUED, back)
@@ -565,11 +623,13 @@ class JobQueue:
         at-least-once window: the job may have been requeued from under
         an expired lease, so finalise from whichever state dir holds it
         (and drop any queued duplicate)."""
+        job = self._hop(job, "job.complete")
         self._write(DONE, dataclasses.replace(
             job, lease_worker=None, lease_expires_at=None, error=None))
         self._remove(LEASED, job.id)
         self._remove_queued(job)
         self._remove(FAILED, job.id)
+        self._depth_gauge()
 
     def fail(self, job: Job, error: str, retryable: bool = True,
              transient: bool = False, now: float | None = None) -> str:
@@ -600,29 +660,38 @@ class JobQueue:
                 or os.path.exists(self._path(DONE, job.id)):
             self._remove(LEASED, job.id)
             self._remove_queued(job)
+            self._depth_gauge()
             return DONE
         if transient and retryable \
                 and job.transients < self.max_transients:
             transients = job.transients + 1
+            job = self._hop(job, "job.requeue", reason="transient",
+                            transients=transients, error=error[:200])
             self._write(QUEUED, dataclasses.replace(
                 job, transients=transients, error=error,
                 lease_worker=None, lease_expires_at=None,
                 not_before=now + self._backoff(transients)))
             self._remove(LEASED, job.id)
+            self._depth_gauge()
             return QUEUED
         attempts = job.attempts + 1
         rec = dataclasses.replace(job, attempts=attempts, error=error,
                                   lease_worker=None, lease_expires_at=None)
         if not retryable or attempts > self.max_retries:
+            rec = self._hop(rec, "job.fail", attempt=attempts,
+                            error=error[:200])
             self._write(FAILED, rec)
             state = FAILED
         else:
+            rec = self._hop(rec, "job.requeue", reason="attempt",
+                            attempt=attempts, error=error[:200])
             self._write(QUEUED, dataclasses.replace(
                 rec, not_before=now + self._backoff(attempts)))
             state = QUEUED
         self._remove(LEASED, job.id)
         if state == FAILED:
             self._remove_queued(job)
+        self._depth_gauge()
         return state
 
     # -- introspection / control -------------------------------------------

@@ -28,6 +28,7 @@ import time
 
 from .. import faults, obs
 from ..health import PreflightError
+from ..obs.fleet import FLIGHT_DIRNAME, HEARTBEAT_DIRNAME, HeartbeatWriter
 from ..utils.log import get_logger, log_event
 from .batcher import Batch, DynamicBatcher
 from .queue import JobQueue
@@ -153,7 +154,8 @@ class ServeWorker:
                  max_wait_s: float = 2.0, lease_s: float = 60.0,
                  poll_s: float = 0.2, mesh=None, runner=None,
                  async_exec: bool = True, worker_id: str | None = None,
-                 bucket: bool = False, synth_runner=None):
+                 bucket: bool = False, synth_runner=None,
+                 heartbeat_s: float = 10.0):
         self.queue = queue
         self.batch_size = int(batch_size)
         mult = 1
@@ -194,6 +196,16 @@ class ServeWorker:
         self.stats = {"batches": 0, "jobs_done": 0, "jobs_failed": 0,
                       "job_retries": 0, "job_transient_retries": 0,
                       "lanes_filled": 0, "lanes_total": 0}
+        # fleet liveness: one atomically-overwritten snapshot file per
+        # worker under <queue>/heartbeat/ (obs/fleet.py; heartbeat_s=0
+        # disables).  Written by run()'s loop — counters/hists inside
+        # are whatever the obs registry holds (empty when untraced;
+        # pid/last-claim liveness works regardless).
+        self._last_claim_at: float | None = None
+        self.heartbeat = (HeartbeatWriter(
+            os.path.join(queue.dir, HEARTBEAT_DIRNAME), self.worker_id,
+            interval_s=heartbeat_s) if heartbeat_s and heartbeat_s > 0
+            else None)
 
     # -- one scheduling round ----------------------------------------------
     def poll_once(self, now: float | None = None,
@@ -216,11 +228,16 @@ class ServeWorker:
             # oldest-age readout
             counts = self.queue.counts()
             obs.gauge("queue_depth", counts["queued"] + counts["leased"])
+        if jobs:
+            self._last_claim_at = now
         ran_synth = 0
         for job in jobs:
             obs.inc("serve_jobs_claimed")
-            obs.inc("queue_wait_s",
-                    round(max(now - job.submitted_at, 0.0), 6))
+            wait = round(max(now - job.submitted_at, 0.0), 6)
+            obs.inc("queue_wait_s", wait)
+            # the mergeable fleet form of the same quantity: heartbeat
+            # snapshots ship this histogram, the rollup merges it
+            obs.observe("queue_wait_s", wait)
             if job.cfg.get("synthetic") is not None:
                 # `simulate` job kind: a campaign IS its own batch (the
                 # compiled step's input is the key array) — never
@@ -229,7 +246,10 @@ class ServeWorker:
                 ran_synth += 1
                 continue
             try:
-                with obs.span("serve.load", file=job.file):
+                # trace_id attr makes the load span (and anything
+                # nested under it) part of the job's distributed trace
+                with obs.span("serve.load", file=job.file,
+                              trace_id=job.trace_id, parent=job.span):
                     # chaos site: the injected fault classifies
                     # transient (real load errors — FileNotFoundError,
                     # parse failures — stay deterministic/unknown and
@@ -243,6 +263,8 @@ class ServeWorker:
                 # it can NaN-poison a batch lane — deterministic, so
                 # straight to failed/ with no retry budget burned
                 # discovering it (counters emitted at the raise site)
+                job = self.queue._hop(job, "job.preflight",
+                                      reasons=",".join(e.reasons))
                 state = self.queue.fail(job, str(e), retryable=False)
                 if state == "failed":
                     self.stats["jobs_failed"] += 1
@@ -330,9 +352,22 @@ class ServeWorker:
         self.stats["batches"] += 1
         self.stats["lanes_filled"] += n
         self.stats["lanes_total"] += pad
+        jobs = batch.jobs
         try:
+            # the batch span carries EVERY member's trace id (one span,
+            # N jobs), so the pipeline.* / *.step.compile/execute spans
+            # nested under it reassemble into each member's trace; each
+            # job also records a "job.batch" hop chaining its claim hop
+            # to this execution
+            tids = [j.trace_id for j in jobs if j.trace_id]
             with obs.span("serve.batch", jobs=n,
-                          fill=round(batch.fill_ratio, 4)):
+                          fill=round(batch.fill_ratio, 4),
+                          trace_ids=tids) as bsp:
+                if obs.enabled():
+                    jobs = tuple(self.queue._hop(
+                        j, "job.batch", lanes=n, pad=pad,
+                        batch_span=getattr(bsp, "span_id", None))
+                        for j in jobs)
                 # chaos site: an infra fault mid-batch (device
                 # preemption, OOM past the driver's backoff floor)
                 faults.check("worker.batch_execute")
@@ -349,7 +384,7 @@ class ServeWorker:
                 # error), so it goes solo like the non-transient branch
                 # — otherwise the batch re-coalesces each round and
                 # burns one attempt per member until ALL poison together
-                for job in batch.jobs:
+                for job in jobs:
                     if job.transients >= self.queue.max_transients:
                         job = dataclasses.replace(job, solo=True)
                     self._job_failed(job, f"batch transient: {e!r}",
@@ -362,18 +397,19 @@ class ServeWorker:
             # poison member exhausts its own budget alone and healthy
             # members complete alone instead of re-coalescing into the
             # same failing batch until all are poisoned together
-            for job in batch.jobs:
+            for job in jobs:
                 self._job_failed(dataclasses.replace(job, solo=True),
                                  f"batch failed: {e!r}")
             log_event(self.log, "batch_failed", jobs=n, error=repr(e))
             return
-        for job, row in zip(batch.jobs, rows):
+        for job, row in zip(jobs, rows):
             fitvals = row_fit_values(row) if row is not None else []
             if row is None or (fitvals
                                and not np.all(np.isfinite(fitvals))):
                 self._job_failed(job, "non-finite fit (NaN lane)")
                 continue
             self.queue.results.put_new(job.id, row)
+            job = self.queue._hop(job, "job.row")
             self.queue.complete(job)
             self.stats["jobs_done"] += 1
             obs.inc("jobs_done")
@@ -409,7 +445,13 @@ class ServeWorker:
         self.stats["batches"] += 1
         try:
             with obs.span("serve.batch", jobs=1, synthetic=True,
-                          epochs=n_epochs):
+                          epochs=n_epochs,
+                          trace_ids=[t for t in (job.trace_id,) if t]
+                          ) as bsp:
+                if obs.enabled():
+                    job = self.queue._hop(
+                        job, "job.batch", synthetic=True,
+                        batch_span=getattr(bsp, "span_id", None))
                 # chaos site shared with file batches: an infra fault
                 # mid-campaign classifies transient
                 faults.check("worker.batch_execute")
@@ -430,6 +472,7 @@ class ServeWorker:
             self.queue.results.put_new(synth_row_key(job.id, i), row)
             stored += 1
         obs.inc("serve_synth_rows", stored)
+        job = self.queue._hop(job, "job.row", rows=stored)
         self.queue.complete(job)
         self.stats["jobs_done"] += 1
         obs.inc("jobs_done")
@@ -449,33 +492,78 @@ class ServeWorker:
                   batch=self.batch_size, max_wait_s=self.max_wait_s,
                   lease_s=self.lease_s, queue=self.queue.dir)
         idle_since = None
-        while True:
-            ran = self.poll_once()
-            if ran:
-                idle_since = None
-                if max_batches is not None and \
-                        self.stats["batches"] >= max_batches:
+        try:
+            while True:
+                self._beat()
+                # chaos site (kind="error"): an unhandled crash of the
+                # resident loop itself — proves the flight-recorder
+                # dump below actually fires (docs/reliability.md)
+                faults.check("worker.poll")
+                ran = self.poll_once()
+                if ran:
+                    idle_since = None
+                    if max_batches is not None and \
+                            self.stats["batches"] >= max_batches:
+                        break
+                    continue
+                if self.batcher.pending:
+                    # partial bucket waiting on its deadline: short sleep
+                    time.sleep(min(self.poll_s, self.max_wait_s / 4 or
+                                   self.poll_s))
+                    continue
+                if exit_on_drain and self.queue.drain_requested() \
+                        and self.queue.empty():
+                    # CONSUME the drain request: a drain-then-start flow
+                    # ("finish this queue and exit") must work, so the
+                    # marker is honoured whenever present and cleared by
+                    # the worker that completes it — the next serving
+                    # session starts resident again
+                    self.queue.clear_drain()
                     break
-                continue
-            if self.batcher.pending:
-                # partial bucket waiting on its deadline: short sleep
-                time.sleep(min(self.poll_s, self.max_wait_s / 4 or
-                               self.poll_s))
-                continue
-            if exit_on_drain and self.queue.drain_requested() \
-                    and self.queue.empty():
-                # CONSUME the drain request: a drain-then-start flow
-                # ("finish this queue and exit") must work, so the
-                # marker is honoured whenever present and cleared by
-                # the worker that completes it — the next serving
-                # session starts resident again
-                self.queue.clear_drain()
-                break
-            now = time.time()
-            idle_since = now if idle_since is None else idle_since
-            if idle_exit_s is not None and now - idle_since >= idle_exit_s:
-                break
-            time.sleep(self.poll_s)
+                now = time.time()
+                idle_since = now if idle_since is None else idle_since
+                if idle_exit_s is not None \
+                        and now - idle_since >= idle_exit_s:
+                    break
+                time.sleep(self.poll_s)
+        except Exception as e:
+            # crash flight recorder: an UNHANDLED failure of the
+            # resident loop (per-job failures never reach here) dumps
+            # the obs event ring buffer + a classified header next to
+            # the queue, so the fleet rollup can read the dead
+            # worker's last moments; the error still propagates.  The
+            # dump itself is guarded — crashes correlate with exactly
+            # the IO failures (deleted queue dir, full disk) that
+            # would make the dump raise, and the recorder must never
+            # REPLACE the exception it exists to explain.
+            try:
+                path = obs.dump_flight(
+                    os.path.join(self.queue.dir, FLIGHT_DIRNAME),
+                    error=repr(e),
+                    classification=faults.classify_error(e),
+                    extra={"worker": self.worker_id,
+                           "stats": dict(self.stats)})
+            except Exception as dump_err:  # fault-ok: recorder only
+                path = f"flight dump failed: {dump_err!r}"
+            log_event(self.log, "worker_crash", worker=self.worker_id,
+                      error=repr(e), flight=path)
+            raise
+        finally:
+            self._beat(force=True)
         log_event(self.log, "serve_exit", worker=self.worker_id,
                   **self.stats)
         return dict(self.stats)
+
+    def _beat(self, force: bool = False) -> None:
+        """Write a heartbeat snapshot if due (obs/fleet.py); heartbeat
+        IO must never take the worker down — a full disk degrades to a
+        log line, not a crash that poisons the queue's liveness."""
+        if self.heartbeat is None:
+            return
+        try:
+            self.heartbeat.beat(force=force,
+                                last_claim_at=self._last_claim_at,
+                                stats=self.stats)
+        except OSError as e:  # fault-ok: liveness reporting only
+            log_event(self.log, "heartbeat_failed", worker=self.worker_id,
+                      error=repr(e))

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Repo lint: every literal counter/gauge/span/event/histogram name in
+``scintools_tpu/`` must be registered in the closed catalog
+(``scintools_tpu/obs/names.py``).
+
+Why: a typo'd metric name — ``obs.inc("job_retires")`` — silently
+creates a new series.  Nothing raises; the real counter stays zero,
+`trace report`'s curated sections and the fleet rollup never see the
+typo'd one, and every tier-1 assertion against the intended name reads
+a stale 0.  The catalog turns that silence into a lint failure.
+
+Mechanics (AST, not regex): walk every ``.py`` under the package for
+``Call`` nodes whose func is ``obs.inc`` / ``obs.gauge`` / ``obs.span``
+/ ``obs.observe`` / ``obs.event`` / ``obs.traced`` — or the
+``core.``-spelled equivalents the obs package uses internally — and
+check the FIRST argument:
+
+* a string literal: exact membership (bracketed ``family[...]`` names
+  check their family);
+* an f-string: its leading constant prefix must extend a registered
+  family, span prefix, or name (conservative prefix check);
+* anything fully dynamic (a Name, a BinOp): skipped — the lint exists
+  for the literal 95 %, and dynamic names are built from registered
+  prefixes at their call sites.
+
+Enforced in tier-1 via tests/test_obs_names.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "scintools_tpu")
+
+# the obs API surface whose first argument is a series name, and the
+# module aliases it is reached through in this codebase
+FUNCS = ("inc", "gauge", "span", "observe", "event", "traced")
+OWNERS = ("obs", "core")
+
+
+def _is_registered(func: str, literal: str, prefix_only: bool) -> bool:
+    sys.path.insert(0, REPO)
+    try:
+        from scintools_tpu.obs import names
+    finally:
+        sys.path.pop(0)
+    return names.is_registered(func, literal, prefix_only=prefix_only)
+
+
+def _name_arg(call: ast.Call):
+    """(literal, prefix_only) for the call's first arg, or None when
+    the name is fully dynamic."""
+    if not call.args:
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value, False
+    if isinstance(arg, ast.JoinedStr) and arg.values:
+        head = arg.values[0]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            return head.value, True
+    return None
+
+
+def find_unregistered(path: str) -> list:
+    """(line, func, name) for every unregistered literal obs name."""
+    with open(path, encoding="utf-8") as fh:
+        try:
+            tree = ast.parse(fh.read(), filename=path)
+        except SyntaxError as e:  # pragma: no cover - unparseable file
+            return [(e.lineno or 0, "parse", str(e))]
+    hits = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in FUNCS
+                and isinstance(func.value, ast.Name)
+                and func.value.id in OWNERS):
+            continue
+        got = _name_arg(node)
+        if got is None:
+            continue
+        literal, prefix_only = got
+        if not _is_registered(func.attr, literal, prefix_only):
+            hits.append((node.lineno, func.attr, literal))
+    return hits
+
+
+def check_tree(pkg_dir: str = PKG) -> list:
+    """All offending (relpath, line, func, name) under ``pkg_dir``."""
+    offenders = []
+    for root, _dirs, files in os.walk(pkg_dir):
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(root, name)
+            for line, func, literal in find_unregistered(path):
+                offenders.append((os.path.relpath(path, pkg_dir), line,
+                                  func, literal))
+    return offenders
+
+
+def main() -> int:
+    offenders = check_tree()
+    if offenders:
+        print("unregistered observability names (add to "
+              "scintools_tpu/obs/names.py or fix the typo):")
+        for rel, line, func, literal in offenders:
+            print(f"  {rel}:{line}: obs.{func}({literal!r})")
+        return 1
+    print("obs name catalog: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,609 @@
+"""Fleet telemetry (ISSUE 10): mergeable fixed-bucket histograms,
+span/event causal ids, distributed job traces that reassemble across a
+subprocess SIGKILL + lease-reap + requeue hop, worker heartbeat
+snapshots with associative merge, the backpressure scalar, the
+queue_depth transition stamps, the crash flight recorder, and the
+multi-file / ``--fleet`` trace report CLI."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from synth import synth_arc_epoch
+
+from scintools_tpu import faults, obs
+from scintools_tpu.io.psrflux import write_psrflux
+from scintools_tpu.obs import fleet
+from scintools_tpu.obs.hist import BOUNDS, Hist
+from scintools_tpu.serve import JobQueue, ServeWorker, SurveyClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+OPTS = {"lamsteps": True}
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """obs and faults are process-global; every test starts/ends clean."""
+    obs.disable(flush=False)
+    obs.reset()
+    faults.clear()
+    yield
+    obs.disable(flush=False)
+    obs.reset()
+    faults.clear()
+
+
+def _write_epochs(tmp_path, seeds):
+    files = []
+    for s in seeds:
+        fn = str(tmp_path / f"epoch_{s:02d}.dynspec")
+        write_psrflux(synth_arc_epoch(nf=32, nt=32, seed=s), fn)
+        files.append(fn)
+    return files
+
+
+def _stub_runner():
+    def run(batch, batch_size, mesh, async_exec):
+        return [{"name": os.path.basename(j.file), "mjd": e.mjd,
+                 "freq": e.freq, "bw": e.bw, "tobs": e.tobs, "dt": e.dt,
+                 "df": e.df, "tau": 1.5, "tauerr": 0.1}
+                for j, e in zip(batch.jobs, batch.epochs)]
+    return run
+
+
+# ---------------------------------------------------------------------------
+# histograms
+# ---------------------------------------------------------------------------
+
+
+def test_hist_observe_quantiles_and_roundtrip():
+    h = Hist()
+    for v in (0.001, 0.01, 0.5, 1.0, 2.0, 100.0):
+        h.observe(v)
+    s = h.summary()
+    assert s["count"] == 6
+    assert s["min"] == 0.001 and s["max"] == 100.0
+    assert abs(s["mean"] - (103.511 / 6)) < 1e-6          # exact mean
+    # bucket-edge quantiles: within one half-octave (sqrt 2) of exact
+    assert 0.5 <= s["p50"] <= 2.0 * 2 ** 0.5
+    assert s["p95"] >= 100.0 / 2 ** 0.5
+    # values past the top edge land in overflow; max stays exact
+    h.observe(10.0 * BOUNDS[-1])
+    assert h.summary()["max"] == 10.0 * BOUNDS[-1]
+    assert h.quantile(1.0) == 10.0 * BOUNDS[-1]
+    # sparse wire form round-trips bit-exactly through JSON
+    rt = Hist.from_dict(json.loads(json.dumps(h.to_dict())))
+    assert rt.summary() == h.summary()
+    assert rt.counts == h.counts
+    # cross-version heartbeats refuse to merge silently wrong
+    with pytest.raises(ValueError):
+        Hist.from_dict(dict(h.to_dict(), v=999))
+    # malformed payloads normalise to ValueError (the one type fleet
+    # readers catch-and-warn on): out-of-range bucket index, and a
+    # nonzero count without min/max (summary would TypeError later)
+    with pytest.raises(ValueError):
+        Hist.from_dict({"v": 1, "buckets": {"200": 5}, "n": 5,
+                        "total": 1.0, "min": 0.1, "max": 1.0})
+    with pytest.raises(ValueError):
+        Hist.from_dict({"v": 1, "buckets": {"3": 5}, "n": 5,
+                        "total": 1.0, "min": None, "max": None})
+    # ...and a heartbeat carrying one degrades to a skip, not a crash
+    from scintools_tpu.obs import fleet as fleet_mod
+
+    bad = {"kind": "heartbeat", "v": 1, "worker": "w", "pid": 1,
+           "ts": 1.0, "counters": {"jobs_done": 2}, "deltas": {},
+           "gauges": {}, "hists": {"x": {"v": 1,
+                                         "buckets": {"200": 5},
+                                         "n": 5, "min": None,
+                                         "max": None}}}
+    merged = fleet_mod.merge_heartbeats([bad])
+    assert merged["counters"]["jobs_done"] == 2
+    assert merged["hists"] == {}
+
+
+def test_hist_merge_associative_and_commutative():
+    def mk(values):
+        h = Hist()
+        for v in values:
+            h.observe(v)
+        return h
+
+    a, b, c = mk([0.1, 5.0]), mk([2.0]), mk([0.01, 300.0, 1.0])
+
+    def eq(x, y):
+        return (x.counts == y.counts and x.n == y.n
+                and abs(x.total - y.total) < 1e-12
+                and x.vmin == y.vmin and x.vmax == y.vmax)
+
+    assert eq(a.merge(b), b.merge(a))                       # commutes
+    assert eq(a.merge(b).merge(c), a.merge(b.merge(c)))     # associates
+    # and operands are untouched
+    assert a.n == 2 and b.n == 1 and c.n == 3
+
+
+# ---------------------------------------------------------------------------
+# span/event causal ids + disabled-mode contract
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_event_observe_and_stream_gauge_are_noops():
+    assert not obs.enabled()
+    assert obs.event("job.submit", trace_id="t") is None
+    obs.observe("queue_wait_s", 1.0)
+    obs.gauge("queue_depth", 3, stream=True)
+    assert obs.counters() == {}
+    assert obs.hist_summaries() == {}
+    assert obs.get_registry().events() == []
+
+
+def test_span_and_event_records_carry_ids_pid_and_parents():
+    with obs.tracing() as reg:
+        with obs.span("pipeline.run"):
+            with obs.span("pipeline.stage"):
+                pass
+        root = obs.event("job.submit", trace_id="t1")
+        child = obs.event("job.claim", parent=root, trace_id="t1")
+    evs = {(e["kind"], e["name"]): e for e in reg.events()}
+    run = evs[("span", "pipeline.run")]
+    stage = evs[("span", "pipeline.stage")]
+    assert run["pid"] == os.getpid()
+    assert run["span"] and "parent" not in run
+    assert stage["parent"] == run["span"]
+    sub = evs[("event", "job.submit")]
+    claim = evs[("event", "job.claim")]
+    assert sub["span"] == root and claim["parent"] == root
+    assert claim["span"] == child != root
+    # span-duration histograms accumulate alongside the exact lists
+    hs = obs.get_registry().hist_summaries()
+    assert hs["pipeline.run"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# single-process job trace lifecycle + depth transition stamps
+# ---------------------------------------------------------------------------
+
+
+def test_job_trace_lifecycle_and_depth_transitions(tmp_path):
+    """One served job leaves the full causal hop chain under ONE
+    trace_id, and queue_depth is stamped at the submit/complete/fail
+    transition points (not only inside serve.poll)."""
+    files = _write_epochs(tmp_path, (1, 2))
+    qdir = str(tmp_path / "q")
+    trace = str(tmp_path / "t.jsonl")
+    with obs.tracing(jsonl=trace):
+        client = SurveyClient(qdir)
+        recs = client.submit(files, OPTS)
+        assert [r["status"] for r in recs] == ["submitted"] * 2
+        client.drain()
+        worker = ServeWorker(JobQueue(qdir), batch_size=2,
+                             max_wait_s=0.0, poll_s=0.01,
+                             runner=_stub_runner(), heartbeat_s=0)
+        stats = worker.run()
+    assert stats["jobs_done"] == 2
+    events = obs.load_events(trace)
+    traces = fleet.assemble_traces(events)
+    assert len(traces) == 2
+    for t in traces.values():
+        assert t["orphans"] == []
+        names = t["names"]
+        for hop in ("job.submit", "job.claim", "serve.load", "job.batch",
+                    "serve.batch", "job.row", "job.complete"):
+            assert hop in names, (hop, names)
+        assert names[0] == "job.submit"
+    # depth stamps: two submits (1, 2), then two completes (1, 0) —
+    # poll-time samples may interleave but the TRANSITION values exist
+    # in order as streamed gauge events
+    depth = [e["value"] for e in events
+             if e.get("kind") == "gauge" and e["name"] == "queue_depth"]
+    assert depth[:2] == [1, 2]
+    assert depth[-1] == 0 and 1 in depth[2:]
+
+
+def test_depth_stamped_on_fail_transition(tmp_path):
+    (f,) = _write_epochs(tmp_path, (1,))
+    q = JobQueue(str(tmp_path / "q"), max_retries=0)
+    trace = str(tmp_path / "t.jsonl")
+    with obs.tracing(jsonl=trace):
+        q.submit(f, OPTS)
+        (job,) = q.claim("w", n=1, lease_s=30.0)
+        assert q.fail(job, "boom", retryable=False) == "failed"
+    # streamed transition stamps carry the writer pid; the flush-time
+    # latest-value gauge does not — only the former are the timeline
+    depth = [e["value"] for e in obs.load_events(trace)
+             if e.get("kind") == "gauge" and e["name"] == "queue_depth"
+             and "pid" in e]
+    assert depth == [1, 0]       # submit -> 1, terminal fail -> 0
+    # and the trace carries the poison hop chain
+    traces = fleet.assemble_traces(obs.load_events(trace))
+    (t,) = traces.values()
+    assert t["names"] == ["job.submit", "job.claim", "job.fail"]
+    assert t["orphans"] == []
+
+
+# ---------------------------------------------------------------------------
+# heartbeats
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_write_interval_and_schema(tmp_path):
+    hb_dir = str(tmp_path / "hb")
+    with obs.tracing():
+        obs.inc("jobs_done", 3)
+        obs.observe("queue_wait_s", 0.5)
+        obs.gauge("queue_depth", 7)
+        w = fleet.HeartbeatWriter(hb_dir, "host:1234", interval_s=10.0)
+        assert w.beat(now=1000.0, last_claim_at=999.0,
+                      stats={"batches": 1}) is not None
+        assert w.beat(now=1001.0) is None          # not due
+        obs.inc("jobs_done", 2)
+        assert w.beat(now=1011.0) is not None      # due: 11 s later
+    hbs = fleet.read_heartbeats(hb_dir)
+    assert len(hbs) == 1                           # ONE file, overwritten
+    (hb,) = hbs
+    assert hb["kind"] == "heartbeat" and hb["worker"] == "host:1234"
+    assert hb["pid"] == os.getpid() and hb["seq"] == 2
+    assert hb["counters"]["jobs_done"] == 5
+    assert hb["deltas"]["jobs_done"] == 2          # since previous beat
+    assert hb["elapsed_s"] == 11.0
+    assert hb["gauges"]["queue_depth"] == 7
+    assert "queue_wait_s" in hb["hists"]
+    # untraced liveness still works: empty telemetry, real pid/ts —
+    # and the worker's OWN stats map onto the canonical counter names
+    # (jobs_done etc.), so an untraced fleet still has a drain rate
+    # and a truthful backpressure instead of reading as stalled
+    obs.disable(flush=False)
+    obs.reset()
+    w2 = fleet.HeartbeatWriter(hb_dir, "host:9", interval_s=0.0)
+    w2.beat(now=2000.0, stats={"jobs_done": 3, "batches": 1,
+                               "lanes_filled": 3, "lanes_total": 4})
+    w2.beat(now=2010.0, force=True,
+            stats={"jobs_done": 7, "batches": 2, "lanes_filled": 7,
+                   "lanes_total": 8})
+    hbs = fleet.read_heartbeats(hb_dir)
+    assert {h["worker"] for h in hbs} == {"host:1234", "host:9"}
+    quiet = next(h for h in hbs if h["worker"] == "host:9")
+    assert quiet["hists"] == {}
+    assert quiet["counters"]["jobs_done"] == 7
+    assert quiet["deltas"]["jobs_done"] == 4
+    merged = fleet.merge_heartbeats([quiet])
+    assert merged["drain_rate_per_s"] == pytest.approx(0.4)
+
+
+def test_heartbeat_merge_associative(tmp_path):
+    """merge(A, B) == merge(B, A) and merge over any grouping — the
+    fleet rollup's correctness requirement for concurrently-written
+    heartbeats."""
+    def hb(worker, ts, done, waits, elapsed=10.0, delta=None):
+        h = Hist()
+        for v in waits:
+            h.observe(v)
+        return {"kind": "heartbeat", "v": 1, "worker": worker,
+                "pid": 1, "ts": ts, "seq": 1, "interval_s": 10.0,
+                "elapsed_s": elapsed,
+                "counters": {"jobs_done": done},
+                "deltas": {"jobs_done": delta if delta is not None
+                           else done},
+                "gauges": {"queue_depth": done},
+                "hists": {"queue_wait_s": h.to_dict()},
+                "last_claim_age_s": 1.0, "digests": {}}
+
+    a = hb("a", 100.0, 4, [0.1, 0.2])
+    b = hb("b", 200.0, 6, [1.0])
+    c = hb("c", 150.0, 2, [5.0, 0.01], elapsed=None, delta=2)
+    m1 = fleet.merge_heartbeats([a, b, c])
+    m2 = fleet.merge_heartbeats([c, a, b])
+    m3 = fleet.merge_heartbeats([b, c, a])
+    assert m1 == m2 == m3
+    assert m1["counters"]["jobs_done"] == 12
+    assert m1["hists"]["queue_wait_s"]["count"] == 5
+    # gauges resolve by freshest timestamp regardless of order
+    assert m1["gauges"]["queue_depth"] == 6 and m1["depth"] == 6
+    # drain rate: only beats with an elapsed interval contribute
+    assert m1["drain_rate_per_s"] == round(4 / 10.0 + 6 / 10.0, 6)
+
+
+# ---------------------------------------------------------------------------
+# backpressure
+# ---------------------------------------------------------------------------
+
+
+def test_backpressure_bounds_and_monotonicity():
+    bp = fleet.backpressure
+    assert bp(0, 0.0) == 0.0 and bp(0, 100.0) == 0.0   # empty queue
+    assert bp(1, 0.0) == 1.0 and bp(10**6, 0.0) == 1.0  # stalled fleet
+    # documented midpoint: backlog == one horizon of drain
+    assert bp(60, 1.0, horizon_s=60.0) == 0.5
+    # monotone increasing in depth at fixed drain
+    vals = [bp(d, 2.0) for d in (0, 1, 5, 50, 500, 5000)]
+    assert vals == sorted(vals) and len(set(vals)) == len(vals)
+    # monotone decreasing in drain rate at fixed depth
+    vals = [bp(100, r) for r in (0.0, 0.1, 1.0, 10.0, 100.0)]
+    assert vals == sorted(vals, reverse=True)
+    assert len(set(vals)) == len(vals)
+    # always in [0, 1]
+    for d in (0, 3, 1000):
+        for r in (0.0, 0.5, 50.0):
+            assert 0.0 <= bp(d, r) <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# crash flight recorder
+# ---------------------------------------------------------------------------
+
+
+def test_flight_ring_is_bounded_and_dump_truncates(tmp_path):
+    from scintools_tpu.obs.core import _EVENT_HISTORY
+
+    reg = obs.get_registry()
+    assert reg._events.maxlen == _EVENT_HISTORY    # bounded by design
+    with obs.tracing():
+        for i in range(50):
+            obs.event("job.submit", trace_id=f"t{i}")
+        path = obs.dump_flight(str(tmp_path / "fl"), error="boom",
+                               classification="unknown", limit=10)
+    lines = [json.loads(x) for x in open(path) if x.strip()]
+    assert len(lines) == 11                        # header + 10 newest
+    head = lines[0]
+    assert head["kind"] == "flight" and head["pid"] == os.getpid()
+    assert head["error"] == "boom"
+    assert head["classification"] == "unknown"
+    assert [e["attrs"]["trace_id"] for e in lines[1:]] == \
+        [f"t{i}" for i in range(40, 50)]
+
+
+def test_worker_crash_dumps_flight_via_env_faults(tmp_path, monkeypatch):
+    """SCINT_FAULTS="worker.poll:error" crashes the resident loop; the
+    worker dumps flight_<pid>.jsonl (classified via PR 5's taxonomy)
+    and re-raises — and the flight joins the fleet rollup."""
+    files = _write_epochs(tmp_path, (1,))
+    qdir = str(tmp_path / "q")
+    client = SurveyClient(qdir)
+    client.submit(files, OPTS)
+    monkeypatch.setenv("SCINT_FAULTS", "worker.poll:error@2")
+    assert faults.install_env(force=True) == 1
+    with obs.tracing(jsonl=str(tmp_path / "t.jsonl")):
+        worker = ServeWorker(JobQueue(qdir), batch_size=1,
+                             max_wait_s=0.0, poll_s=0.01,
+                             runner=_stub_runner(), heartbeat_s=0)
+        with pytest.raises(RuntimeError, match="injected error"):
+            worker.run()
+    flight = os.path.join(qdir, "flight", f"flight_{os.getpid()}.jsonl")
+    assert os.path.exists(flight)
+    lines = [json.loads(x) for x in open(flight) if x.strip()]
+    head = lines[0]
+    assert head["kind"] == "flight"
+    assert head["classification"] == "unknown"     # RuntimeError bucket
+    assert "injected error" in head["error"]
+    assert head["worker"] == worker.worker_id
+    assert head["counters"].get("faults_injected") == 1
+    # the ring captured the pre-crash poll round (claim hop included)
+    names = {e.get("name") for e in lines[1:]}
+    assert "job.claim" in names
+    # the crash flight is part of the fleet collection
+    heartbeats, events, _ = fleet.collect_fleet(qdir)
+    assert any(e.get("kind") == "flight" for e in events) or \
+        any(e.get("name") == "job.claim" for e in events)
+
+
+# ---------------------------------------------------------------------------
+# trace report CLI: globs, torn lines, --fleet
+# ---------------------------------------------------------------------------
+
+
+def test_trace_report_multi_file_glob_and_torn_lines(tmp_path, capsys):
+    from scintools_tpu.cli import main as cli_main
+
+    a = str(tmp_path / "a.jsonl")
+    b = str(tmp_path / "b.jsonl")
+    with obs.tracing(jsonl=a):
+        with obs.span("ops.sspec"):
+            pass
+        obs.inc("epochs_processed", 2)
+    obs.reset()
+    with obs.tracing(jsonl=b):
+        with obs.span("ops.sspec"):
+            pass
+        obs.inc("epochs_processed", 3)
+    with open(b, "a") as fh:                 # torn tail (SIGKILL shape)
+        fh.write('{"ts": 1, "kind": "span", "na')
+    # glob + literal path, merged into ONE report, torn line warns
+    rc = cli_main(["trace", "report", str(tmp_path / "*.jsonl")])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "epochs_processed = 5" in out.out
+    assert "torn/non-JSON" in out.err
+    # one unreadable path among several degrades to a warning
+    rc = cli_main(["trace", "report", a, str(tmp_path / "nope.jsonl")])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "epochs_processed = 2" in out.out
+    assert "skipped" in out.err
+    # nothing readable at all still fails cleanly (rc 1, no traceback)
+    rc = cli_main(["trace", "report", str(tmp_path / "nope.jsonl")])
+    assert rc == 1
+    assert "unreadable" in capsys.readouterr().err
+
+
+def test_fleet_status_two_workers_and_backpressure_formula(tmp_path,
+                                                           capsys):
+    """Acceptance: `fleet status` over two concurrently-written worker
+    heartbeats reports per-worker AND merged histograms plus a
+    backpressure scalar matching the documented formula."""
+    from scintools_tpu.cli import main as cli_main
+
+    qdir = tmp_path / "q"
+    hb_dir = str(qdir / "heartbeat")
+    for sub in ("queued", "leased", "done", "failed"):
+        (qdir / sub).mkdir(parents=True)
+    # two workers, interleaved beats (concurrent writers)
+    with obs.tracing():
+        obs.inc("jobs_done", 8)
+        obs.observe("queue_wait_s", 0.25)
+        obs.gauge("queue_depth", 4)
+        w1 = fleet.HeartbeatWriter(hb_dir, "host:1", interval_s=5.0)
+        w1.beat(now=1000.0, last_claim_at=999.5)
+        w2 = fleet.HeartbeatWriter(hb_dir, "host:2", interval_s=5.0)
+        w2.beat(now=1001.0, last_claim_at=1000.5)
+        obs.inc("jobs_done", 4)
+        obs.observe("queue_wait_s", 1.5)
+        w1.beat(now=1010.0, force=True)      # delta 4 over 10 s
+        w2.beat(now=1011.0, force=True)      # delta 4 over 10 s
+    # plant queue depth: 3 queued records (fake files are fine — the
+    # CLI only counts names)
+    for i in range(3):
+        (qdir / "queued" / f"{'0' * 17}-j{i}.json").write_text("{}")
+    rc = cli_main(["fleet", "status", str(qdir), "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    rollup = json.loads(out)
+    assert len(rollup["workers"]) == 2
+    assert {w["worker"] for w in rollup["workers"]} == \
+        {"host:1", "host:2"}
+    # per-worker histograms present...
+    assert all(w["queue_wait"]["count"] >= 1 for w in rollup["workers"])
+    # ...and the merged one sums them
+    merged = rollup["merged"]["hists"]["queue_wait_s"]
+    assert merged["count"] == sum(w["queue_wait"]["count"]
+                                  for w in rollup["workers"])
+    # live depth from the queue dir wins; drain = sum of per-beat rates
+    assert rollup["depth"] == 3
+    drain = rollup["drain_rate_per_s"]
+    assert drain == pytest.approx(0.8)       # 4/10 + 4/10
+    assert rollup["backpressure"] == pytest.approx(
+        3 / (3 + drain * fleet.BACKPRESSURE_HORIZON_S), abs=1e-6)
+    # the human table renders the same sections
+    rc = cli_main(["fleet", "status", str(qdir)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "worker host:1" in out and "worker host:2" in out
+    assert "merged latency histograms" in out
+    assert "backpressure =" in out
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance: cross-process SIGKILL -> reap -> requeue, one trace
+# ---------------------------------------------------------------------------
+
+_WORKER_SRC = """
+import os, sys, time
+from scintools_tpu import obs
+from scintools_tpu.serve import JobQueue, ServeWorker
+
+qdir, trace, mode = sys.argv[1], sys.argv[2], sys.argv[3]
+obs.enable(jsonl=trace)
+
+def stub(batch, batch_size, mesh, async_exec):
+    if mode == "hang":
+        open(os.path.join(qdir, "IN_BATCH"), "w").write(str(os.getpid()))
+        time.sleep(120.0)
+    return [{"name": os.path.basename(j.file), "mjd": e.mjd,
+             "freq": e.freq, "bw": e.bw, "tobs": e.tobs, "dt": e.dt,
+             "df": e.df, "tau": 1.5, "tauerr": 0.1}
+            for j, e in zip(batch.jobs, batch.epochs)]
+
+worker = ServeWorker(JobQueue(qdir, backoff_s=0.05), batch_size=1,
+                     max_wait_s=0.0, lease_s=1.0, poll_s=0.05,
+                     runner=stub, heartbeat_s=0.2,
+                     worker_id="%s:" + str(os.getpid()))
+worker.run(idle_exit_s=None if mode == "hang" else None)
+obs.disable()
+"""
+
+
+def _spawn_worker(qdir, trace, mode, tag):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, "-c", _WORKER_SRC % tag, qdir, trace, mode],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+
+
+def test_sigkill_reap_requeue_reassembles_one_trace(tmp_path, capsys):
+    """Acceptance: a job submitted by THIS process, killed mid-batch in
+    subprocess worker A, lease-reaped and completed by subprocess
+    worker B, yields ONE reassembled trace — single trace_id, causally
+    linked hops from all three pids, no orphans — in `trace report
+    --fleet`."""
+    from scintools_tpu.cli import main as cli_main
+
+    (f,) = _write_epochs(tmp_path, (1,))
+    qdir = str(tmp_path / "q")
+    submit_trace = os.path.join(qdir, "submit.jsonl")
+    os.makedirs(qdir, exist_ok=True)
+    with obs.tracing(jsonl=submit_trace):
+        client = SurveyClient(qdir)
+        (rec,) = client.submit([f], OPTS)
+        assert rec["status"] == "submitted"
+    job_id = rec["job"]
+
+    # worker A: claims, enters the batch, hangs -> SIGKILL mid-batch
+    a = _spawn_worker(qdir, os.path.join(qdir, "worker_a.jsonl"),
+                      "hang", "A")
+    marker = os.path.join(qdir, "IN_BATCH")
+    try:
+        deadline = time.time() + 60.0
+        while time.time() < deadline and not os.path.exists(marker):
+            assert a.poll() is None, ("worker A exited early:\n"
+                                      + (a.stdout.read() or ""))
+            time.sleep(0.02)
+        assert os.path.exists(marker), "worker A never entered a batch"
+        os.kill(a.pid, signal.SIGKILL)
+        a.wait(timeout=30)
+    finally:
+        if a.poll() is None:
+            a.kill()
+    queue = JobQueue(qdir)
+    assert queue.counts()["leased"] == 1        # orphaned lease
+
+    # worker B: reaps the expired lease (requeue hop), completes
+    SurveyClient(qdir).drain()
+    b = _spawn_worker(qdir, os.path.join(qdir, "worker_b.jsonl"),
+                      "ok", "B")
+    try:
+        out_b, _ = b.communicate(timeout=90)
+    except subprocess.TimeoutExpired:
+        b.kill()
+        pytest.fail("worker B never drained:\n" + (b.stdout.read() or ""))
+    assert b.returncode == 0, out_b
+    assert queue.counts()["done"] == 1
+    assert len(queue.results.keys()) == 1       # no duplicate rows
+
+    # merge the three processes' sinks and reassemble
+    events, warnings = obs.load_trace_files(
+        [os.path.join(qdir, "*.jsonl")])
+    traces = fleet.assemble_traces(events)
+    assert len(traces) == 1
+    ((tid, t),) = traces.items()
+    names = t["names"]
+    # the full causal chain, in order: submit -> A's claim/batch ->
+    # the reap's requeue hop -> B's claim -> B's batch -> row ->
+    # complete; and NO hop is orphaned (every parent id resolved
+    # across the merged sinks)
+    assert t["orphans"] == []
+    assert names[0] == "job.submit"
+    assert names.count("job.claim") == 2
+    assert "job.requeue" in names and "job.batch" in names
+    assert "job.complete" in names
+    assert names.index("job.requeue") > names.index("job.claim")
+    # three distinct processes touched the one trace
+    assert len(t["pids"]) == 3
+    assert os.getpid() in t["pids"]
+    # every hop carries the job's id
+    claim_evs = [e for e in t["events"] if e["name"] == "job.claim"]
+    assert all(e["attrs"]["job"] == job_id for e in claim_evs)
+    assert claim_evs[0]["pid"] != claim_evs[1]["pid"]
+
+    # and the operator view agrees: trace report --fleet over the
+    # queue dir (traces + heartbeats) shows one multi-process trace
+    rc = cli_main(["trace", "report", "--fleet", qdir])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "1 reassembled, 1 spanning >1 process, 0 orphan" in out
+    assert "worker A:" in out and "worker B:" in out   # heartbeats

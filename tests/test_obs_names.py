@@ -1,0 +1,72 @@
+"""Tier-1 lint: every literal counter/gauge/span/event/histogram name
+in ``scintools_tpu/`` is registered in the closed catalog
+(``scintools_tpu/obs/names.py``) — a typo'd metric name silently
+creates a new series and vanishes from ``trace report``
+(scripts/check_obs_names.py; ISSUE 10 satellite)."""
+
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(os.path.dirname(_HERE), "scripts"))
+
+import check_obs_names  # noqa: E402
+
+from scintools_tpu.obs import names  # noqa: E402
+
+
+def test_every_obs_name_in_package_is_registered():
+    offenders = check_obs_names.check_tree()
+    assert offenders == [], (
+        "unregistered observability names — add to "
+        "scintools_tpu/obs/names.py or fix the typo:\n"
+        + "\n".join(f"  {p}:{ln}: obs.{fn}({lit!r})"
+                    for p, ln, fn, lit in offenders))
+
+
+def test_lint_catches_typos_families_and_fstrings(tmp_path):
+    """The AST walk flags a typo'd literal, a typo'd bracket family and
+    an unregistered event, while registered names, families, and
+    dynamic span prefixes pass."""
+    bad = tmp_path / "mod.py"
+    bad.write_text(
+        "from scintools_tpu import obs\n"
+        "def f(x):\n"
+        "    obs.inc('job_retires')\n"                 # typo
+        "    obs.span('serve.poll')\n"                 # registered
+        "    obs.gauge(f'bucket_catalog[{x}]', 1)\n"   # family ok
+        "    obs.inc(f'compile_sm[{x}:cold]')\n"       # typo'd family
+        "    obs.span(f'stage.{x}')\n"                 # prefix ok
+        "    obs.event('job.teleport')\n"              # unregistered
+        "    obs.observe('queue_wait_s', 1.0)\n"       # registered
+        "    obs.span(name_built_elsewhere)\n")        # dynamic: skip
+    hits = check_obs_names.find_unregistered(str(bad))
+    assert [(ln, fn, lit) for ln, fn, lit in hits] == [
+        (3, "inc", "job_retires"),
+        (6, "inc", "compile_sm["),
+        (8, "event", "job.teleport")]
+
+
+def test_catalog_is_consistent_and_covers_the_known_floor():
+    """Spot-pin load-bearing names (the ones tier-1 counter assertions
+    and the fleet rollup read) so a catalog refactor cannot silently
+    drop them, and check kinds do not collide with families."""
+    cat = names.all_names()
+    for c in ("epochs_processed", "bytes_h2d", "jit_cache_miss",
+              "jobs_done", "queue_wait_s", "oom_backoff"):
+        assert c in cat["counters"], c
+    for g in ("queue_depth", "batch_fill_ratio"):
+        assert g in cat["gauges"], g
+    for s in ("pipeline.run", "serve.batch"):
+        assert s in cat["spans"], s
+    for e in ("job.submit", "job.claim", "job.requeue", "job.complete"):
+        assert e in cat["events"], e
+    assert "queue_wait_s" in cat["hists"]
+    for fam in ("compile_ms", "step_flops", "bucket_hits"):
+        assert fam in cat["families"], fam
+    # families are name PREFIXES of bracketed series; they must not
+    # also be plain counter/gauge names except the documented
+    # total+breakdown pairs (faults_injected, epochs_quarantined)
+    overlap = (set(cat["families"])
+               & (set(cat["counters"]) | set(cat["gauges"])))
+    assert overlap == {"faults_injected", "epochs_quarantined"}, overlap
