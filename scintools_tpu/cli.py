@@ -619,11 +619,20 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
                 if args.results:
                     write_results(args.results, row)
                 if store is not None:
-                    store.put(content_key(names[idx], cfg), row)
+                    # buffered columnar plane: one sealed segment per
+                    # bucket flush below, instead of one JSON file per
+                    # row (utils/segments — ISSUE 11)
+                    store.put_new_buffered(content_key(names[idx], cfg),
+                                           row)
                 processed += 1
                 log_event(log, "epoch", file=names[idx],
                           tau=row.get("tau"),
                           eta=row.get("betaeta", row.get("eta")))
+            if store is not None:
+                # flush per bucket: rows are durable (and visible to a
+                # concurrent reader) as each bucket completes, not at
+                # campaign end
+                store.flush()
     if store is not None and args.results:
         store.export_csv(args.results,
                          full=getattr(args, "full_csv", False))
@@ -701,10 +710,15 @@ def _process_synthetic(args, synth_d: dict, cfg, store, log,
         if args.results:
             write_results(args.results, row)
         if store is not None:
-            store.put(keyfn(i), row)
+            # buffered columnar plane (auto-flushes every flush_rows,
+            # so a 10^6-epoch campaign holds bounded memory and writes
+            # O(flushes) segment files, not O(B) row files)
+            store.put_new_buffered(keyfn(i), row)
         processed += 1
         log_event(log, "epoch", file=row["name"], tau=row.get("tau"),
                   eta=row.get("betaeta", row.get("eta")))
+    if store is not None:
+        store.flush()
     if store is not None and args.results:
         store.export_csv(args.results,
                          full=getattr(args, "full_csv", False))
@@ -896,7 +910,8 @@ def cmd_serve(args) -> int:
     from .utils import get_logger, log_event
 
     log = get_logger()
-    queue = JobQueue(args.queue, max_retries=args.max_retries)
+    queue = JobQueue(args.queue, max_retries=args.max_retries,
+                     shards=getattr(args, "shards", None))
     compile_cache.enable_persistent_cache()
     mesh = (make_mesh(tuple(int(x) for x in args.mesh)) if args.mesh
             else None)
@@ -936,6 +951,18 @@ def cmd_submit(args) -> int:
     files = _expand(args.files)
     client = SurveyClient(args.queue)
     synth_d = _synth_spec_dict_from_args(args)
+    if getattr(args, "compact", False):
+        # `compact` job kind: results-plane maintenance, no epochs
+        if files or synth_d is not None:
+            raise SystemExit("--compact submits take no input files "
+                             "or --synthetic campaign")
+        rec = client.compact()
+        print(json.dumps({"queue": args.queue, "submitted": 1,
+                          "deduped": 0, "missing": 0,
+                          "jobs": [{"file": "compact:",
+                                    "job": rec["job"],
+                                    "status": rec["status"]}]}))
+        return 0
     if synth_d is not None:
         # `simulate` job kind: one job = one on-device campaign (no
         # input files; keys + params ARE the job payload)
@@ -1360,13 +1387,20 @@ def cmd_fleet_status(args) -> int:
     # a live depth beats the heartbeat-reported one when the dir IS a
     # queue (fleet dirs of bare heartbeats have no queued/ subdir)
     depth = None
+    shard_depths = None
     if os.path.isdir(os.path.join(qdir, "queued")):
-        c = JobQueue(qdir).counts()
+        q = JobQueue(qdir)
+        c = q.counts()
         depth = c["queued"] + c["leased"]
+        # where the backlog actually sits (ISSUE 11): depth piling
+        # into one shard is invisible in the scalar
+        shard_depths = q.shard_depths()
     heartbeats, events, warnings = fleet_mod.collect_fleet(qdir)
     for w in warnings:
         print(f"warning: {w}", file=sys.stderr)
     rollup = fleet_mod.fleet_rollup(heartbeats, events, depth=depth)
+    if shard_depths is not None:
+        rollup["shard_depths"] = shard_depths
     if args.json:
         print(json.dumps({"queue": args.queue, **rollup}, default=str))
     else:
@@ -1692,6 +1726,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "<worker>.json in the queue dir every N "
                         "seconds (`fleet status` merges them; 0 "
                         "disables)")
+    q.add_argument("--shards", type=int, default=None,
+                   help="queued-namespace shard count for a FRESH "
+                        "queue dir (default 8, or SCINT_QUEUE_SHARDS); "
+                        "an existing queue's persisted control/shards "
+                        "value always wins")
     q.set_defaults(fn=cmd_serve)
 
     q = sub.add_parser(
@@ -1723,6 +1762,10 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--wait", type=float, default=None,
                    help="block until the submitted jobs are terminal "
                         "(or this many seconds pass)")
+    q.add_argument("--compact", action="store_true",
+                   help="submit a results-plane compaction job instead "
+                        "of epochs: the worker merges small segment "
+                        "files into one (docs/performance.md)")
     _add_perf_policy_flags(q)
     _add_synth_flags(q)
     q.set_defaults(fn=cmd_submit)

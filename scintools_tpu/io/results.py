@@ -20,15 +20,23 @@ _OPTIONAL = (("tau", "tauerr"), ("dnu", "dnuerr"),
              ("eta", "etaerr"), ("betaeta", "betaetaerr"))
 
 
-def write_results(filename: str, meta: dict) -> None:
-    """Append one row.  ``meta`` must carry name/mjd/freq/bw/tobs/dt/df and
-    may carry any of the optional measurement pairs."""
+def results_line(meta: dict) -> tuple[str, str]:
+    """(header, row) strings for one reference-schema CSV row — the ONE
+    formatter shared by the per-row :func:`write_results` appender and
+    the store's streaming ``export_csv``, so both emit identical bytes."""
     header = "name,mjd,freq,bw,tobs,dt,df"
     row = "{name},{mjd},{freq},{bw},{tobs},{dt},{df}".format(**meta)
     for a, b in _OPTIONAL:
         if a in meta and meta[a] is not None:
             header += f",{a},{b}"
             row += f",{meta[a]},{meta.get(b)}"
+    return header, row
+
+
+def write_results(filename: str, meta: dict) -> None:
+    """Append one row.  ``meta`` must carry name/mjd/freq/bw/tobs/dt/df and
+    may carry any of the optional measurement pairs."""
+    header, row = results_line(meta)
     with open(filename, "a") as fh:
         if not os.path.exists(filename) or os.stat(filename).st_size == 0:
             fh.write(header + "\n")

@@ -214,7 +214,9 @@ class HeartbeatWriter:
                                    "job_transient_retries"),
                                   ("batches", "serve_batches"),
                                   ("lanes_filled", "serve_lanes_filled"),
-                                  ("lanes_total", "serve_lanes_total")):
+                                  ("lanes_total", "serve_lanes_total"),
+                                  ("segment_flushes", "segment_flushes"),
+                                  ("rows_flushed", "segment_rows")):
             v = (stats or {}).get(stat_key)
             if counter not in counters and isinstance(v, (int, float)):
                 counters[counter] = v
@@ -506,6 +508,11 @@ def render_fleet(rollup: dict) -> str:
     if tl:
         lines.append("  queue_depth timeline: "
                      + " ".join(f"{int(v)}" for _, v in tl))
+    sd = rollup.get("shard_depths")
+    if sd and any(sd.values()):
+        lines.append("  queued depth by shard: "
+                     + " ".join(f"{k}={v}"
+                                for k, v in sorted(sd.items()) if v))
     tr = rollup["traces"]
     if tr["count"]:
         lines.append(

@@ -41,6 +41,10 @@ COUNTERS = frozenset({
     "serve_lanes_filled", "serve_lanes_total", "jobs_done",
     "jobs_failed", "job_retries", "job_transient_retries",
     "serve_synth_jobs", "serve_synth_rows",
+    # results plane (columnar segments — utils/segments.py)
+    "segment_flushes", "segment_rows", "segment_bytes",
+    "compactions", "segments_compacted",
+    "segments_quarantined", "segment_salvaged_rows",
     # reliability
     "epochs_quarantined", "store_corrupt_rows", "faults_injected",
 })
@@ -58,7 +62,7 @@ SPANS = frozenset({
     "ops.sspec", "ops.acf",
     "fit.arc", "fit.scint", "fit.lsq_numpy",
     "sim.simulation",
-    "serve.poll", "serve.load", "serve.batch",
+    "serve.poll", "serve.load", "serve.batch", "serve.compact",
 })
 
 # dynamic span-name prefixes: obs.span(f"<prefix><runtime part>") — the
@@ -78,6 +82,9 @@ EVENTS = frozenset({
 # -- histograms (obs.observe) -----------------------------------------------
 HISTS = frozenset({
     "queue_wait_s",
+    # put -> durable/visible latency of buffered result rows (the
+    # segment plane's replacement for the end-of-campaign gather cliff)
+    "row_visibility_s",
 })
 
 # -- bracketed families: "<family>[<key>]" ----------------------------------
@@ -85,7 +92,11 @@ FAMILIES = frozenset({
     "compile_ms",                                   # counter
     "faults_injected", "epochs_quarantined",        # counters
     "bucket_hits", "bucket_lanes_real", "bucket_lanes_pad",  # counters
+    "queue_shard_claims",                           # counter (per shard)
     "bucket_catalog", "step_flops", "step_bytes",   # gauges
+    # per-shard queued depth beside the total queue_depth gauge (the
+    # documented total+breakdown pair pattern)
+    "queue_depth",                                  # gauge (per shard)
 })
 
 _SETS = {"inc": COUNTERS, "gauge": GAUGES, "span": SPANS,

@@ -53,6 +53,14 @@ class SurveyClient:
                                                      dict(opts or {}))
         return {"spec": dict(spec), "job": job_id, "status": status}
 
+    def compact(self) -> dict:
+        """Submit one results-plane compaction (`compact` job kind):
+        the worker merges small segment files into one so long
+        campaigns keep bounded per-lookup segment counts.  Returns
+        ``{job, status}``."""
+        job_id, status = self.queue.submit_compact()
+        return {"job": job_id, "status": status}
+
     # -- inspection --------------------------------------------------------
     def status(self) -> dict:
         return self.queue.status()

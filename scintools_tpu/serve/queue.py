@@ -12,17 +12,32 @@ Layout (all JSON, one file per job, written tmp+``os.replace`` so a
 crash can never leave a torn record)::
 
     qdir/
-      queued/<stamp>-<job_id>.json   submitted, waiting for a worker
-                              (<stamp> = 17-digit submit microseconds,
-                              so sorted listdir IS the FIFO claim order
-                              and a poll opens only ~batch_size head
-                              candidates; legacy <job_id>.json names
-                              are still read and drained)
+      queued/<ss>/<stamp>-<job_id>.json
+                              submitted, waiting for a worker.  <ss> =
+                              the job's SHARD, crc32(job_id) mod N —
+                              the flat queued/ dir was the listdir/
+                              rename contention point at production
+                              depth (ROADMAP item 1), so the namespace
+                              is hashed over N subdirectories; N is
+                              persisted in control/shards at queue
+                              creation so every process agrees.
+                              <stamp> = 17-digit submit microseconds,
+                              so each shard's sorted listdir IS its
+                              FIFO order; claim merges the shard heads
+                              by stamp, preserving global submit order
+                              while every directory op (submit, the
+                              claim rename, the O(1) unlink probes)
+                              lands in a dir of depth/N entries.
+                              Legacy flat queued/<stamp>-<id>.json and
+                              unstamped queued/<id>.json records are
+                              still read and drained.
       leased/<job_id>.json    claimed by a worker, lease expiry inside
       done/<job_id>.json      completed (result row in results/)
       failed/<job_id>.json    terminal: retries exhausted (poison input)
-      results/                utils.store.ResultsStore (idempotent rows)
+      results/                utils.store.ResultsStore (idempotent rows;
+                              segment plane under results/segments/)
       control/drain           drain marker (serve exits when empty)
+      control/shards          persisted queued-shard count
 
 Semantics:
 
@@ -49,6 +64,7 @@ import dataclasses
 import json
 import os
 import time
+import zlib
 from typing import Sequence
 
 from .. import faults, obs
@@ -69,6 +85,13 @@ BACKOFF_CAP_S = 300.0
 # failed/ instead of livelocking the queue — generous, because real
 # infra faults clear in one or two placements
 TRANSIENT_ESCALATION_FACTOR = 10
+
+# queued-namespace shard fan-out for a FRESH queue dir (override with
+# JobQueue(shards=...) or SCINT_QUEUE_SHARDS); an existing queue's
+# persisted control/shards value always wins, so every client/worker
+# process probes the same shard paths
+DEFAULT_QUEUE_SHARDS = 8
+MAX_QUEUE_SHARDS = 256
 
 _LAST_STAMP = 0.0
 
@@ -213,7 +236,8 @@ class JobQueue:
     def __init__(self, directory: str,
                  max_retries: int = DEFAULT_MAX_RETRIES,
                  backoff_s: float = DEFAULT_BACKOFF_S,
-                 max_transients: int | None = None):
+                 max_transients: int | None = None,
+                 shards: int | None = None):
         self.dir = directory
         self.max_retries = int(max_retries)
         self.backoff_s = float(backoff_s)
@@ -223,7 +247,72 @@ class JobQueue:
                                * max(self.max_retries, 1))
         for sub in _STATES + ("control",):
             os.makedirs(os.path.join(directory, sub), exist_ok=True)
+        self.nshards = self._init_shards(shards)
+        self._shard_width = max(2, len(str(self.nshards - 1)))
+        for i in range(self.nshards):
+            os.makedirs(self._shard_dir(i), exist_ok=True)
         self.results = ResultsStore(os.path.join(directory, "results"))
+
+    # -- queued-namespace sharding -----------------------------------------
+    def _shards_path(self) -> str:
+        return os.path.join(self.dir, "control", "shards")
+
+    def _init_shards(self, shards: int | None) -> int:
+        """The queue's shard count: the value persisted at creation
+        wins (every process must probe the same shard paths — a
+        mismatched count would make `_remove_queued`'s O(1) probes
+        miss); a fresh dir persists the constructor/env/default value
+        atomically, first creator wins under a race."""
+        path = self._shards_path()
+        try:
+            with open(path) as fh:
+                return self._valid_shards(fh.read().strip())
+        except (OSError, ValueError):
+            pass
+        n = self._valid_shards(
+            shards if shards is not None
+            else os.environ.get("SCINT_QUEUE_SHARDS",
+                                DEFAULT_QUEUE_SHARDS))
+        tmp = f"{path}.tmp{os.getpid()}"
+        try:
+            with open(tmp, "x") as fh:
+                fh.write(str(n))
+            if not os.path.exists(path):
+                os.replace(tmp, path)
+            else:
+                os.remove(tmp)
+        except OSError:  # fault-ok: a racing creator persisted first
+            pass
+        try:
+            with open(path) as fh:
+                return self._valid_shards(fh.read().strip())
+        except (OSError, ValueError):
+            return n
+
+    @staticmethod
+    def _valid_shards(value) -> int:
+        n = int(value)
+        if not 1 <= n <= MAX_QUEUE_SHARDS:
+            raise ValueError(f"queue shards={n}: expected "
+                             f"1..{MAX_QUEUE_SHARDS}")
+        return n
+
+    def _shard_of(self, job_id: str) -> int:
+        return zlib.crc32(job_id.encode("utf-8")) % self.nshards
+
+    def _shard_name(self, shard: int) -> str:
+        return f"{shard:0{self._shard_width}d}"
+
+    def _shard_dir(self, shard: int) -> str:
+        return os.path.join(self.dir, QUEUED, self._shard_name(shard))
+
+    def _queued_dirs(self) -> list[str]:
+        """Every directory queued records can live in: the N shard
+        dirs plus the flat ``queued/`` root (legacy pre-shard queues
+        keep draining — shard subdir names never end in ``.json`` so
+        the flat walks skip them for free)."""
+        return ([self._shard_dir(i) for i in range(self.nshards)]
+                + [os.path.join(self.dir, QUEUED)])
 
     # -- paths / low-level records -----------------------------------------
     # Queued jobs are named "<17-digit-microsecond-stamp>-<job_id>.json"
@@ -252,7 +341,7 @@ class JobQueue:
         return os.path.join(self.dir, state, f"{job_id}.json")
 
     def _queued_path(self, job_id: str, submitted_at: float) -> str:
-        return os.path.join(self.dir, QUEUED,
+        return os.path.join(self._shard_dir(self._shard_of(job_id)),
                             f"{self._stamp_prefix(submitted_at)}-"
                             f"{job_id}.json")
 
@@ -263,21 +352,24 @@ class JobQueue:
         more.  Read paths (``_read``/``state_of``) use this scan;
         removal stays O(1) (``_remove_queued``) because any survivor
         of a finished job is garbage-collected by ``claim``'s
-        terminal-state guard instead of re-executing.  One
-        directory-name scan, no file opens."""
-        d = os.path.join(self.dir, QUEUED)
+        terminal-state guard instead of re-executing.  Two bounded
+        directory-name scans (the id's OWN shard + the flat legacy
+        root), no file opens."""
         suffix = f"-{job_id}.json"
         out = []
         plain = self._path(QUEUED, job_id)
         if os.path.exists(plain):
             out.append(plain)
-        try:
-            with os.scandir(d) as it:
-                for e in it:
-                    if e.name.endswith(suffix) and ".tmp" not in e.name:
-                        out.append(os.path.join(d, e.name))
-        except OSError:
-            pass
+        for d in (self._shard_dir(self._shard_of(job_id)),
+                  os.path.join(self.dir, QUEUED)):
+            try:
+                with os.scandir(d) as it:
+                    for e in it:
+                        if e.name.endswith(suffix) \
+                                and ".tmp" not in e.name:
+                            out.append(os.path.join(d, e.name))
+            except OSError:
+                pass
         return out
 
     def _find_queued(self, job_id: str) -> str | None:
@@ -293,12 +385,18 @@ class JobQueue:
             json.dump(job.to_record(), fh)
         os.replace(tmp, path)
         if state == QUEUED:
-            # a legacy unstamped duplicate must not survive a stamped
-            # rewrite (requeue of a legacy job after its claim consumed
-            # the old file is the normal path; this covers direct ones)
-            plain = self._path(QUEUED, job.id)
-            if plain != path and os.path.exists(plain):
-                self._remove_file(plain)
+            # legacy duplicates must not survive a sharded rewrite: the
+            # flat unstamped name (pre-stamp queues) and the flat
+            # STAMPED name (pre-shard queues) — two O(1) probes
+            # (requeue of a legacy job after its claim consumed the old
+            # file is the normal path; this covers direct ones)
+            for stale in (self._path(QUEUED, job.id),
+                          os.path.join(
+                              self.dir, QUEUED,
+                              f"{self._stamp_prefix(job.submitted_at)}-"
+                              f"{job.id}.json")):
+                if stale != path and os.path.exists(stale):
+                    self._remove_file(stale)
 
     def _read_file(self, path: str) -> Job | None:
         try:
@@ -314,33 +412,72 @@ class JobQueue:
         return self._read_file(self._path(state, job_id))
 
     def _ids(self, state: str) -> list[str]:
+        if state == QUEUED:
+            out = []
+            for d in self._queued_dirs():
+                try:
+                    names = os.listdir(d)
+                except OSError:
+                    continue
+                out.extend(self._split_queued_name(f)[1] for f in names
+                           if f.endswith(".json") and ".tmp" not in f)
+            return sorted(out)
         d = os.path.join(self.dir, state)
         names = [f for f in os.listdir(d)
                  if f.endswith(".json") and ".tmp" not in f]
-        if state == QUEUED:
-            return sorted(self._split_queued_name(f)[1] for f in names)
         return sorted(os.path.splitext(f)[0] for f in names)
 
     def _queued_entries(self) -> list[tuple[float, str, str]]:
-        """Sorted ``(submit stamp, job_id, fname)`` for every queued
-        record — the single queued-dir walk shared by :meth:`claim`
-        (FIFO order) and :meth:`status` (oldest age).  Stamped names
-        sort without being opened; only legacy unstamped records pay a
-        read to learn their submit time."""
-        qdir = os.path.join(self.dir, QUEUED)
+        """Sorted ``(submit stamp, job_id, path)`` for every queued
+        record — the queued-namespace walk shared by :meth:`claim`
+        (FIFO order) and :meth:`status` (oldest age).  Each shard's
+        stamped names sort without being opened and the per-shard FIFO
+        lists merge by stamp, so global order equals submit order;
+        only legacy unstamped records pay a read to learn their
+        submit time."""
         entries = []
-        for fname in os.listdir(qdir):
-            if not fname.endswith(".json") or ".tmp" in fname:
+        for d in self._queued_dirs():
+            try:
+                names = os.listdir(d)
+            except OSError:
                 continue
-            stamp, jid = self._split_queued_name(fname)
-            if stamp is None:
-                job = self._read_file(os.path.join(qdir, fname))
-                if job is None:
+            for fname in names:
+                if not fname.endswith(".json") or ".tmp" in fname:
                     continue
-                stamp = job.submitted_at
-            entries.append((stamp, jid, fname))
+                stamp, jid = self._split_queued_name(fname)
+                path = os.path.join(d, fname)
+                if stamp is None:
+                    job = self._read_file(path)
+                    if job is None:
+                        continue
+                    stamp = job.submitted_at
+                entries.append((stamp, jid, path))
         entries.sort()
         return entries
+
+    def shard_depths(self) -> dict[str, int]:
+        """Per-shard queued depth (one listdir per shard; the flat
+        legacy root reports under ``"flat"`` only when non-empty) —
+        the ``fleet status`` readout for depth concentrating in one
+        shard."""
+        out: dict[str, int] = {}
+        for i in range(self.nshards):
+            try:
+                names = os.listdir(self._shard_dir(i))
+            except OSError:
+                names = []
+            out[self._shard_name(i)] = sum(
+                1 for f in names
+                if f.endswith(".json") and ".tmp" not in f)
+        try:
+            flat = sum(1 for f in os.listdir(os.path.join(self.dir,
+                                                          QUEUED))
+                       if f.endswith(".json") and ".tmp" not in f)
+        except OSError:
+            flat = 0
+        if flat:
+            out["flat"] = flat
+        return out
 
     def queued_ids(self) -> set[str]:
         """Every queued job id — ONE directory-name walk, no file
@@ -374,21 +511,37 @@ class JobQueue:
                 return job
         return None
 
-    # -- fleet telemetry hooks (ISSUE 10) ----------------------------------
-    def _depth_gauge(self) -> None:
+    # -- fleet telemetry hooks (ISSUE 10/11) -------------------------------
+    def _depth_gauge(self, job_id: str | None = None) -> None:
         """Stamp ``queue_depth`` at a state TRANSITION (submit/
         complete/fail): a timeline sampled only inside ``serve.poll``
         aliases at low poll rates — the transition points are where
         depth actually changes (test-pinned).  Streamed, so each stamp
         is a timestamped gauge event in the trace, not just the
-        registry's latest-value cell.  Disabled tracing: one flag
-        check, no listdir.  Enabled: TWO listdirs (queued/ + leased/
-        only — depth never reads the unbounded done/ and failed/
-        directories, which grow with survey length)."""
+        registry's latest-value cell.  With ``job_id``, the
+        transitioning job's SHARD depth is stamped too as the
+        ``queue_depth[<shard>]`` family — only that shard's count
+        changed, so stamping just it keeps the per-shard timelines
+        complete without N events per transition (ISSUE 11: `fleet
+        status` backpressure must stay truthful when depth concentrates
+        in one shard).  Disabled tracing: one flag check, no listdir.
+        Enabled: bounded listdirs (queued shards + leased/ only — depth
+        never reads the unbounded done/ and failed/ directories, which
+        grow with survey length)."""
         if not obs.enabled():
             return
         depth = len(self._ids(QUEUED)) + len(self._ids(LEASED))
         obs.gauge("queue_depth", depth, stream=True)
+        if job_id is not None:
+            shard = self._shard_of(job_id)
+            try:
+                names = os.listdir(self._shard_dir(shard))
+            except OSError:
+                names = []
+            n = sum(1 for f in names
+                    if f.endswith(".json") and ".tmp" not in f)
+            obs.gauge(f"queue_depth[{self._shard_name(shard)}]", n,
+                      stream=True)
 
     def _hop(self, job: Job, name: str, **attrs) -> Job:
         """Record one lifecycle hop of ``job``'s distributed trace (an
@@ -431,7 +584,7 @@ class JobQueue:
         self._write(QUEUED, Job(id=job_id, file=os.path.abspath(path),
                                 cfg=cfg, submitted_at=_submit_stamp(),
                                 trace_id=trace, span=root))
-        self._depth_gauge()
+        self._depth_gauge(job_id)
         return job_id, "submitted"
 
     def submit_synthetic(self, spec: dict,
@@ -469,7 +622,29 @@ class JobQueue:
         self._write(QUEUED, Job(id=job_id, file=f"synthetic:{kind}",
                                 cfg=cfg, submitted_at=_submit_stamp(),
                                 trace_id=trace, span=root))
-        self._depth_gauge()
+        self._depth_gauge(job_id)
+        return job_id, "submitted"
+
+    def submit_compact(self) -> tuple[str, str]:
+        """Enqueue one results-plane compaction (`compact` job kind):
+        the worker merges the store's small segment files into one
+        (utils/segments.SegmentStore.compact) — the background
+        maintenance pass that keeps per-lookup segment counts bounded
+        over a long campaign.  Not content-addressed: every submit is
+        a fresh job (compaction is idempotent and cheap when there is
+        nothing to merge), identified by its submit stamp.  Routed
+        around the batcher like `simulate` jobs; writes no result
+        rows."""
+        stamp = _submit_stamp()
+        cfg = {"compact": True}
+        job_id = content_key(("compact", stamp), cfg_signature(cfg))
+        trace = new_trace_id()
+        root = obs.event("job.submit", trace_id=trace, job=job_id,
+                         file="compact:")
+        self._write(QUEUED, Job(id=job_id, file="compact:", cfg=cfg,
+                                submitted_at=stamp,
+                                trace_id=trace, span=root))
+        self._depth_gauge(job_id)
         return job_id, "submitted"
 
     # -- worker side -------------------------------------------------------
@@ -490,9 +665,8 @@ class JobQueue:
         learn their submit time, and they merge into the same FIFO
         order."""
         now = time.time() if now is None else now
-        qdir = os.path.join(self.dir, QUEUED)
         claimed: list[Job] = []
-        for stamp, jid, fname in self._queued_entries():
+        for stamp, jid, path in self._queued_entries():
             if len(claimed) >= n:
                 break
             # a queued duplicate of a still-leased job (crash window of
@@ -507,19 +681,20 @@ class JobQueue:
             # poll) instead of re-executing a done or poison job
             if os.path.exists(self._path(DONE, jid)) \
                     or os.path.exists(self._path(FAILED, jid)):
-                self._remove_file(os.path.join(qdir, fname))
+                self._remove_file(path)
                 continue
-            job = self._read_file(os.path.join(qdir, fname))
+            job = self._read_file(path)
             if job is None or job.not_before > now:
                 continue
             try:
                 # chaos site (kind="oserror"): a lost claim race — the
                 # winner-take-one rename semantics must skip, not fail
                 faults.check("queue.claim_rename")
-                os.rename(os.path.join(qdir, fname),
-                          self._path(LEASED, jid))
+                os.rename(path, self._path(LEASED, jid))
             except OSError:
                 continue  # another worker won this one
+            obs.inc("queue_shard_claims"
+                    f"[{self._shard_name(self._shard_of(jid))}]")
             # stamp the lease onto the record we actually renamed, not
             # the pre-rename read: another worker may have failed+
             # requeued this job in the read->rename window, and its
@@ -607,15 +782,21 @@ class JobQueue:
         self._remove_file(self._path(state, job_id))
 
     def _remove_queued(self, job: Job) -> None:
-        """Drop ``job``'s queued record(s) in O(1): the stamped
-        filename is deterministic from the record (requeues never
-        mutate ``submitted_at``, and JSON round-trips the float
-        exactly), and the only other variant any version ever writes
-        is the legacy plain name — two unlink probes cover the
-        crash window between ``_write``'s stamped write and its
-        legacy unlink, with no directory scan (``complete``/``fail``
-        run this once per job in the worker's hot loop)."""
+        """Drop ``job``'s queued record(s) in O(1): the sharded
+        stamped filename is deterministic from the record (requeues
+        never mutate ``submitted_at``, JSON round-trips the float
+        exactly, and the shard is a pure hash of the id against the
+        persisted shard count), and the only other variants any
+        version ever wrote are the flat stamped name (pre-shard) and
+        the flat plain name (pre-stamp) — three unlink probes cover
+        every layout plus the crash window between ``_write``'s
+        sharded write and its legacy unlinks, with no directory scan
+        (``complete``/``fail`` run this once per job in the worker's
+        hot loop)."""
         self._remove_file(self._queued_path(job.id, job.submitted_at))
+        self._remove_file(os.path.join(
+            self.dir, QUEUED,
+            f"{self._stamp_prefix(job.submitted_at)}-{job.id}.json"))
         self._remove_file(self._path(QUEUED, job.id))
 
     def complete(self, job: Job) -> None:
@@ -629,7 +810,7 @@ class JobQueue:
         self._remove(LEASED, job.id)
         self._remove_queued(job)
         self._remove(FAILED, job.id)
-        self._depth_gauge()
+        self._depth_gauge(job.id)
 
     def fail(self, job: Job, error: str, retryable: bool = True,
              transient: bool = False, now: float | None = None) -> str:
@@ -660,7 +841,7 @@ class JobQueue:
                 or os.path.exists(self._path(DONE, job.id)):
             self._remove(LEASED, job.id)
             self._remove_queued(job)
-            self._depth_gauge()
+            self._depth_gauge(job.id)
             return DONE
         if transient and retryable \
                 and job.transients < self.max_transients:
@@ -672,7 +853,7 @@ class JobQueue:
                 lease_worker=None, lease_expires_at=None,
                 not_before=now + self._backoff(transients)))
             self._remove(LEASED, job.id)
-            self._depth_gauge()
+            self._depth_gauge(job.id)
             return QUEUED
         attempts = job.attempts + 1
         rec = dataclasses.replace(job, attempts=attempts, error=error,
@@ -691,7 +872,7 @@ class JobQueue:
         self._remove(LEASED, job.id)
         if state == FAILED:
             self._remove_queued(job)
-        self._depth_gauge()
+        self._depth_gauge(job.id)
         return state
 
     # -- introspection / control -------------------------------------------
@@ -704,6 +885,7 @@ class JobQueue:
         st["results"] = len(self.results.keys())
         st["depth"] = st[QUEUED] + st[LEASED]
         st["drain_requested"] = self.drain_requested()
+        st["shards"] = self.nshards
         entries = self._queued_entries()
         # submit ages straight from the filename stamps (shared walk
         # with claim; only legacy records were opened)

@@ -195,7 +195,8 @@ class ServeWorker:
         self.log = get_logger()
         self.stats = {"batches": 0, "jobs_done": 0, "jobs_failed": 0,
                       "job_retries": 0, "job_transient_retries": 0,
-                      "lanes_filled": 0, "lanes_total": 0}
+                      "lanes_filled": 0, "lanes_total": 0,
+                      "segment_flushes": 0, "rows_flushed": 0}
         # fleet liveness: one atomically-overwritten snapshot file per
         # worker under <queue>/heartbeat/ (obs/fleet.py; heartbeat_s=0
         # disables).  Written by run()'s loop — counters/hists inside
@@ -238,6 +239,12 @@ class ServeWorker:
             # the mergeable fleet form of the same quantity: heartbeat
             # snapshots ship this histogram, the rollup merges it
             obs.observe("queue_wait_s", wait)
+            if job.cfg.get("compact"):
+                # `compact` job kind: results-plane maintenance —
+                # merges small segment files; no epochs, no batcher
+                self._execute_compact(job)
+                ran_synth += 1
+                continue
             if job.cfg.get("synthetic") is not None:
                 # `simulate` job kind: a campaign IS its own batch (the
                 # compiled step's input is the key array) — never
@@ -402,13 +409,23 @@ class ServeWorker:
                                  f"batch failed: {e!r}")
             log_event(self.log, "batch_failed", jobs=n, error=repr(e))
             return
+        finished = []
         for job, row in zip(jobs, rows):
             fitvals = row_fit_values(row) if row is not None else []
             if row is None or (fitvals
                                and not np.all(np.isfinite(fitvals))):
                 self._job_failed(job, "non-finite fit (NaN lane)")
                 continue
-            self.queue.results.put_new(job.id, row)
+            # buffered write-once row: the whole batch lands as ONE
+            # segment at the flush below (O(flushes) files, not O(B))
+            self.queue.results.put_new_buffered(job.id, row)
+            finished.append((job, row))
+        # rows must be DURABLE before their jobs complete: a crash
+        # between complete() and a later flush would finalise jobs
+        # whose rows never hit disk (the row would silently re-execute
+        # under the done/ terminal-state guard — i.e. never)
+        self._flush_rows()
+        for job, row in finished:
             job = self.queue._hop(job, "job.row")
             self.queue.complete(job)
             self.stats["jobs_done"] += 1
@@ -417,6 +434,18 @@ class ServeWorker:
                       file=os.path.basename(job.file),
                       tau=row.get("tau"),
                       eta=row.get("betaeta", row.get("eta")))
+
+    def _flush_rows(self) -> int:
+        """Flush the store's buffered rows as one sealed segment and
+        keep the worker's own stats in step (the heartbeat payload for
+        UNTRACED workers; the obs ``segment_flushes``/``segment_rows``
+        counters are the traced source of truth and also count any
+        size-triggered auto-flush inside a huge campaign)."""
+        flushed = self.queue.results.flush()
+        if flushed:
+            self.stats["segment_flushes"] += 1
+            self.stats["rows_flushed"] += flushed
+        return flushed
 
     def _execute_synthetic(self, job) -> None:
         """Run one `simulate` job: the campaign executes as ONE
@@ -469,8 +498,13 @@ class ServeWorker:
         for i, row in enumerate(rows):
             if row is None:   # NaN lane: quarantined by the row builder
                 continue
-            self.queue.results.put_new(synth_row_key(job.id, i), row)
+            # buffered: the campaign streams out in flush_rows-sized
+            # segments (auto-flush bounds memory at 10^6 epochs), the
+            # tail sealed below BEFORE the job completes
+            self.queue.results.put_new_buffered(synth_row_key(job.id, i),
+                                                row)
             stored += 1
+        self._flush_rows()
         obs.inc("serve_synth_rows", stored)
         job = self.queue._hop(job, "job.row", rows=stored)
         self.queue.complete(job)
@@ -479,6 +513,31 @@ class ServeWorker:
         log_event(self.log, "synth_job_done", job=job.id,
                   epochs=n_epochs, rows=stored,
                   quarantined=n_epochs - stored)
+
+    def _execute_compact(self, job) -> None:
+        """Run one `compact` job: merge the results store's small
+        segment files into one (utils/segments).  Idempotent and
+        row-less — a compaction finding nothing to merge completes
+        with ``compacted=0``.  Failures route through the same
+        taxonomy as batch failures."""
+        self.queue.renew([job], self._claim_lease_s())
+        self.stats["batches"] += 1
+        try:
+            with obs.span("serve.compact",
+                          trace_ids=[t for t in (job.trace_id,) if t]
+                          ) as bsp:
+                if obs.enabled():
+                    job = self.queue._hop(
+                        job, "job.batch", compact=True,
+                        batch_span=getattr(bsp, "span_id", None))
+                stats = self.queue.results.compact()
+        except Exception as e:
+            self._job_failed(job, f"compact failed: {e!r}", exc=e)
+            return
+        self.queue.complete(job)
+        self.stats["jobs_done"] += 1
+        obs.inc("jobs_done")
+        log_event(self.log, "compact_done", job=job.id, **stats)
 
     # -- the resident loop -------------------------------------------------
     def run(self, max_batches: int | None = None,

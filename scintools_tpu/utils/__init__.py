@@ -3,5 +3,6 @@
 from .log import get_logger, log_event  # noqa: F401
 from .misc import (is_valid, load_pickle, remove_duplicates,  # noqa: F401
                    save_pickle)
+from .segments import SegmentStore  # noqa: F401
 from .store import ResultsStore, content_key, seed_range_pending  # noqa: F401
 from .timing import StageTimers, profile_trace, trace_annotation  # noqa: F401

@@ -1,0 +1,719 @@
+"""Append-only columnar segment files: the million-epoch results plane.
+
+The one-JSON-file-per-row :class:`~scintools_tpu.utils.store.
+ResultsStore` is the scale ceiling for synthetic campaigns and the
+serve fleet (ROADMAP item 5): a 10^6-epoch run means 10^6 tiny files
+and an O(N) listdir-heavy gather at campaign end.  This module is the
+replacement sink — results stream out *while* the campaign runs, the
+way real-time Fourier-domain search pipelines emit candidates against
+a live stream (arXiv:1804.05335) — with the axis/metadata discipline
+kept first-class through the refactor (FFTArray, arXiv:2508.03697):
+every write-once idempotency key survives as segment metadata, and the
+footer carries the column union so schema travels with the bytes.
+
+Wire format of one segment (everything little-endian)::
+
+    "SCSEG01\\n"                              8-byte file magic
+    [ <len:u32> <crc32:u32> <payload> ]*      record blocks, payload =
+                                              JSON [key, record] utf-8
+    <footer payload>                          JSON, columnar index:
+                                              {v, rows, keys[], offsets[],
+                                               lengths[], columns[],
+                                               bloom{m,k,bits}}
+    <flen:u32> <fcrc32:u32> "SCSEGFTR"        16-byte trailer
+
+A segment is written to ``<name>.open`` (blocks first, footer last)
+and atomically renamed to ``<name>.seg`` at seal time, so readers only
+ever index sealed files.  A writer SIGKILLed between block append and
+footer flush leaves a ``.open`` file behind: :meth:`SegmentStore.
+refresh` detects the dead writer (pid embedded in the name), salvages
+the checksum-valid block prefix into a fresh sealed segment, and
+quarantines the original aside as ``.corrupt`` — exactly the row
+store's torn-row contract, so the keys lost in the torn tail simply
+re-execute and nothing is ever duplicated (reads dedup by key,
+newest segment first).
+
+Lookup is newest-segment-first: each footer ships a small bloom filter
+over its keys, so a ``has``/``get`` probe touches only segments that
+plausibly hold the key (and the footer's exact key index settles it
+without reading any block).  A background ``compact`` merges small
+segments into one (`serve`'s ``compact`` job kind), keeping the
+per-lookup segment count bounded over a long campaign.
+
+Observability (names registered in obs/names.py): counters
+``segment_flushes`` / ``segment_rows`` / ``segment_bytes`` /
+``compactions`` / ``segments_compacted`` / ``segments_quarantined`` /
+``segment_salvaged_rows``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import struct
+import time
+import zlib
+from typing import Iterable, Sequence
+
+MAGIC = b"SCSEG01\n"
+FOOTER_MAGIC = b"SCSEGFTR"
+SEGMENT_VERSION = 1
+_HDR = struct.Struct("<II")          # (payload length, crc32)
+_TRAILER = struct.Struct("<II")      # (footer length, footer crc32)
+_TRAILER_LEN = _TRAILER.size + len(FOOTER_MAGIC)
+# sanity bound on one block: a results row is ~1 KB of JSON; anything
+# claiming >64 MB is a torn/foreign length field, not a record
+MAX_BLOCK_BYTES = 1 << 26
+
+# bloom sizing: ~10 bits/key, 4 probes ≈ 1-2 % false-positive rate —
+# a false positive costs one dict miss, never a wrong answer
+_BLOOM_BITS_PER_KEY = 10
+_BLOOM_K = 4
+
+OPEN_EXT = ".open"
+SEG_EXT = ".seg"
+CORRUPT_EXT = ".corrupt"
+# a live writer's .open file is left alone; one whose pid is dead (or
+# unparseable) is salvaged once it is older than the MIN age below,
+# and a live-pid file older than the larger grace is treated as
+# abandoned (pid reuse after a reboot)
+OPEN_GRACE_S = 300.0
+# pid liveness is HOST-LOCAL: on a shared filesystem another host's
+# in-flight writer looks "dead" to os.kill here.  A flush seals in
+# well under a second, so a dead-looking .open younger than this is
+# plausibly a remote writer mid-append — leave it for the next pass
+OPEN_SALVAGE_MIN_AGE_S = 5.0
+
+
+class SegmentError(ValueError):
+    """A segment's bytes fail structural validation (bad magic, torn
+    block, checksum mismatch, unreadable footer)."""
+
+
+def _obs():
+    from .. import obs
+
+    return obs
+
+
+# ---------------------------------------------------------------------------
+# block + bloom primitives
+# ---------------------------------------------------------------------------
+
+
+def encode_block(key: str, record: dict) -> bytes:
+    """One length-prefixed, checksummed record block."""
+    payload = json.dumps([key, record]).encode("utf-8")
+    return _HDR.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def _bloom_indices(key: str, m: int, k: int):
+    """Double-hashing bloom probe positions for ``key`` (blake2b split
+    into two 64-bit halves — stable across processes and versions)."""
+    h = hashlib.blake2b(key.encode("utf-8"), digest_size=16).digest()
+    a = int.from_bytes(h[:8], "little")
+    b = int.from_bytes(h[8:], "little") or 1
+    for i in range(k):
+        yield (a + i * b) % m
+
+
+def _bloom_build(keys: Sequence[str]) -> dict:
+    m = max(64, _BLOOM_BITS_PER_KEY * len(keys))
+    m += (-m) % 8                      # whole bytes
+    bits = bytearray(m // 8)
+    for key in keys:
+        for idx in _bloom_indices(key, m, _BLOOM_K):
+            bits[idx >> 3] |= 1 << (idx & 7)
+    return {"m": m, "k": _BLOOM_K, "bits": bytes(bits).hex()}
+
+
+def _bloom_maybe(bloom: dict | None, key: str) -> bool:
+    if not bloom:
+        return True                    # no filter -> cannot rule out
+    try:
+        m, k = int(bloom["m"]), int(bloom["k"])
+        bits = bytes.fromhex(bloom["bits"])
+    except (KeyError, TypeError, ValueError):
+        return True
+    if m <= 0 or k <= 0 or len(bits) * 8 < m:
+        return True
+    return all(bits[i >> 3] & (1 << (i & 7))
+               for i in _bloom_indices(key, m, k))
+
+
+# ---------------------------------------------------------------------------
+# writer
+# ---------------------------------------------------------------------------
+
+_SEQ = 0
+
+
+def _segment_basename() -> str:
+    """Fresh segment stem: ``seg-<17-digit µs stamp>-<pid>-<seq>`` —
+    name-sortable by creation time (the newest-first read order), pid
+    for dead-writer detection, per-process seq for same-µs distinctness."""
+    global _SEQ
+    _SEQ += 1
+    stamp = time.time_ns() // 1000
+    return f"seg-{stamp:017d}-{os.getpid()}-{_SEQ:04d}"
+
+
+def segment_pid(fname: str) -> int | None:
+    """The writer pid embedded in a segment filename, or None."""
+    parts = os.path.basename(fname).split(".")[0].split("-")
+    if len(parts) == 4 and parts[0] == "seg" and parts[2].isdigit():
+        return int(parts[2])
+    return None
+
+
+class SegmentAppender:
+    """Low-level writer of ONE segment: blocks appended in call order
+    to the ``.open`` file, footer + atomic rename at :meth:`seal`.
+    :meth:`SegmentStore.append` drives it; tests drive it directly to
+    stage crash states (a SIGKILL between :meth:`add` and :meth:`seal`
+    is exactly the torn-tail shape ``refresh`` must salvage)."""
+
+    def __init__(self, directory: str):
+        os.makedirs(directory, exist_ok=True)
+        base = _segment_basename()
+        self.path_open = os.path.join(directory, base + OPEN_EXT)
+        self.path_seal = os.path.join(directory, base + SEG_EXT)
+        self._fh = open(self.path_open, "wb")
+        self._fh.write(MAGIC)
+        self._keys: list[str] = []
+        self._offsets: list[int] = []
+        self._lengths: list[int] = []
+        self._columns: set[str] = set()
+
+    def add(self, key: str, record: dict) -> None:
+        block = encode_block(key, record)
+        self._offsets.append(self._fh.tell())
+        self._lengths.append(len(block))
+        self._keys.append(str(key))
+        self._columns.update(str(c) for c in record)
+        self._fh.write(block)
+
+    def seal(self) -> tuple[str, int]:
+        """Write the columnar footer, fsync-free atomic rename to
+        ``.seg``.  Returns ``(sealed path, total bytes)``."""
+        footer = json.dumps({
+            "v": SEGMENT_VERSION, "rows": len(self._keys),
+            "keys": self._keys, "offsets": self._offsets,
+            "lengths": self._lengths,
+            "columns": sorted(self._columns),
+            "bloom": _bloom_build(self._keys),
+            "created_at": round(time.time(), 6),
+        }).encode("utf-8")
+        self._fh.write(footer)
+        self._fh.write(_TRAILER.pack(len(footer), zlib.crc32(footer)))
+        self._fh.write(FOOTER_MAGIC)
+        self._fh.close()
+        size = os.path.getsize(self.path_open)
+        os.rename(self.path_open, self.path_seal)
+        return self.path_seal, size
+
+    def abort(self) -> None:
+        try:
+            self._fh.close()
+        finally:
+            try:
+                os.remove(self.path_open)
+            except OSError:  # fault-ok: best-effort cleanup of our tmp
+                pass
+
+
+# ---------------------------------------------------------------------------
+# reader
+# ---------------------------------------------------------------------------
+
+
+def read_footer(path: str) -> dict:
+    """Parse + checksum-verify a sealed segment's footer; raises
+    :class:`SegmentError` on any structural problem."""
+    try:
+        with open(path, "rb") as fh:
+            head = fh.read(len(MAGIC))
+            if head != MAGIC:
+                raise SegmentError(f"{path}: bad segment magic")
+            fh.seek(-_TRAILER_LEN, os.SEEK_END)
+            tail = fh.read(_TRAILER_LEN)
+            if len(tail) != _TRAILER_LEN \
+                    or tail[_TRAILER.size:] != FOOTER_MAGIC:
+                raise SegmentError(f"{path}: missing footer trailer")
+            flen, fcrc = _TRAILER.unpack(tail[:_TRAILER.size])
+            fh.seek(-(_TRAILER_LEN + flen), os.SEEK_END)
+            footer_bytes = fh.read(flen)
+    except OSError as e:
+        raise SegmentError(f"{path}: unreadable ({e})") from e
+    if len(footer_bytes) != flen or zlib.crc32(footer_bytes) != fcrc:
+        raise SegmentError(f"{path}: footer checksum mismatch")
+    try:
+        footer = json.loads(footer_bytes)
+    except ValueError as e:
+        raise SegmentError(f"{path}: footer not JSON") from e
+    if not isinstance(footer, dict) \
+            or footer.get("v") != SEGMENT_VERSION:
+        raise SegmentError(f"{path}: unsupported footer version")
+    return footer
+
+
+def scan_blocks(path: str) -> tuple[list[tuple[str, dict]], bool]:
+    """Sequentially decode record blocks from the start of ``path``
+    (header magic first), verifying each checksum; stops at the first
+    torn/invalid block OR at a valid footer trailer.  Returns
+    ``(valid rows in append order, clean)`` where ``clean`` is True
+    only when the file ends in a checksum-valid footer — the salvage
+    scanner for ``.open`` leftovers and corrupt sealed files."""
+    rows: list[tuple[str, dict]] = []
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as fh:
+            if fh.read(len(MAGIC)) != MAGIC:
+                return rows, False
+            while True:
+                pos = fh.tell()
+                hdr = fh.read(_HDR.size)
+                if len(hdr) < _HDR.size:
+                    return rows, False          # torn header / EOF
+                length, crc = _HDR.unpack(hdr)
+                if length > MAX_BLOCK_BYTES:
+                    # garbage length field — OR the start of the
+                    # footer, whose JSON head reads as a huge u32;
+                    # "clean" iff the trailer at EOF checks out
+                    return rows, _footer_at(fh, pos, size)
+                payload = fh.read(length)
+                if len(payload) < length \
+                        or zlib.crc32(payload) != crc:
+                    return rows, _footer_at(fh, pos, size)
+                try:
+                    key, rec = json.loads(payload)
+                except (ValueError, TypeError):
+                    return rows, _footer_at(fh, pos, size)
+                if not isinstance(rec, dict):
+                    return rows, _footer_at(fh, pos, size)
+                rows.append((str(key), rec))
+    except OSError:
+        return rows, False
+
+
+def _footer_at(fh, pos: int, size: int) -> bool:
+    """Whether the bytes from ``pos`` to EOF are a checksum-valid
+    footer (payload + trailer)."""
+    if size - pos < _TRAILER_LEN:
+        return False
+    fh.seek(size - _TRAILER_LEN)
+    tail = fh.read(_TRAILER_LEN)
+    if tail[_TRAILER.size:] != FOOTER_MAGIC:
+        return False
+    flen, fcrc = _TRAILER.unpack(tail[:_TRAILER.size])
+    if pos + flen + _TRAILER_LEN != size:
+        return False
+    fh.seek(pos)
+    return zlib.crc32(fh.read(flen)) == fcrc
+
+
+class Segment:
+    """One sealed segment's in-memory index: key -> (offset, length)
+    from the columnar footer, plus the bloom filter for cheap negative
+    probes.  Blocks are only read on :meth:`get`."""
+
+    __slots__ = ("path", "rows", "columns", "_index", "_bloom")
+
+    def __init__(self, path: str, footer: dict):
+        self.path = path
+        keys = footer.get("keys") or []
+        offsets = footer.get("offsets") or []
+        lengths = footer.get("lengths") or []
+        if not (len(keys) == len(offsets) == len(lengths)):
+            raise SegmentError(f"{path}: ragged footer index")
+        self.rows = len(keys)
+        self.columns = tuple(footer.get("columns") or ())
+        self._index = {str(k): (int(o), int(n))
+                       for k, o, n in zip(keys, offsets, lengths)}
+        self._bloom = footer.get("bloom")
+
+    @classmethod
+    def load(cls, path: str) -> "Segment":
+        return cls(path, read_footer(path))
+
+    def keys(self):
+        return self._index.keys()
+
+    def maybe_contains(self, key: str) -> bool:
+        return _bloom_maybe(self._bloom, key)
+
+    def has(self, key: str) -> bool:
+        return self.maybe_contains(key) and key in self._index
+
+    def get(self, key: str, fh=None) -> dict | None:
+        loc = self._index.get(key)
+        if loc is None:
+            return None
+        offset, length = loc
+        own = fh is None
+        if own:
+            fh = open(self.path, "rb")
+        try:
+            fh.seek(offset)
+            block = fh.read(length)
+        finally:
+            if own:
+                fh.close()
+        if len(block) != length:
+            raise SegmentError(f"{self.path}: short block for {key}")
+        plen, crc = _HDR.unpack(block[:_HDR.size])
+        payload = block[_HDR.size:]
+        if plen != len(payload) or zlib.crc32(payload) != crc:
+            raise SegmentError(
+                f"{self.path}: block checksum mismatch for {key}")
+        k, rec = json.loads(payload)
+        return rec
+
+
+# ---------------------------------------------------------------------------
+# the directory of segments
+# ---------------------------------------------------------------------------
+
+
+class SegmentStore:
+    """All sealed segments under one directory, indexed newest-first.
+
+    * ``append(rows)`` seals ONE new segment per call — the flush unit,
+      so a campaign of B epochs lands O(flushes) files, not O(B);
+    * ``has``/``get`` probe newest segment first (bloom, then the exact
+      footer index), so a freshly-flushed row is visible immediately;
+    * ``refresh`` is mtime-gated (one ``stat`` on the no-change fast
+      path — the poll-loop cost of ``SurveyClient.wait``) and salvages
+      dead writers' ``.open`` leftovers on the way;
+    * ``compact`` merges every sealed segment into one, newest row
+      winning per key (rows for one key are deterministic duplicates
+      under the at-least-once serve contract, so either choice is
+      byte-identical).
+    """
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        self._segments: list[Segment] = []   # newest first
+        self._names: set[str] = set()
+        self._mtime: int | None = None
+        self._handles: dict[str, object] = {}
+        # union of every indexed segment's keys: the O(1) membership
+        # probe under the write path's per-row dedup check (a bloom
+        # scan over N segments per put grows linearly with campaign
+        # progress — the very shape this plane retires).  None = stale,
+        # rebuilt lazily; segment ADDS update it incrementally
+        self._keys: set[str] | None = None
+
+    # -- index maintenance -------------------------------------------------
+    def refresh(self, force: bool = False) -> None:
+        """Re-sync the in-memory index with the directory iff its mtime
+        moved (or ``force``): load new sealed segments, drop vanished
+        ones, and salvage any ``.open`` file whose writer is dead."""
+        try:
+            mtime = os.stat(self.dir).st_mtime_ns
+        except OSError:
+            self._segments, self._names = [], set()
+            self._mtime = None
+            self._close_handles()
+            return
+        if not force and mtime == self._mtime:
+            return
+        self._salvage_dead_open()
+        try:
+            names = {n for n in os.listdir(self.dir)
+                     if n.endswith(SEG_EXT)}
+        except OSError:
+            names = set()
+        if names != self._names:
+            removed = False
+            for seg in list(self._segments):
+                if os.path.basename(seg.path) not in names:
+                    self._segments.remove(seg)
+                    self._drop_handle(seg.path)
+                    removed = True
+            have = {os.path.basename(s.path) for s in self._segments}
+            for name in names - have:
+                path = os.path.join(self.dir, name)
+                try:
+                    seg = Segment.load(path)
+                except SegmentError:
+                    self._quarantine(path)
+                    continue
+                self._segments.append(seg)
+                if self._keys is not None:
+                    self._keys.update(seg.keys())
+            if removed:
+                self._keys = None      # rebuild lazily (rare path)
+            self._segments.sort(key=lambda s: os.path.basename(s.path),
+                                reverse=True)
+            self._names = {os.path.basename(s.path)
+                           for s in self._segments}
+        try:
+            self._mtime = os.stat(self.dir).st_mtime_ns
+        except OSError:
+            self._mtime = None
+
+    def _salvage_dead_open(self) -> None:
+        try:
+            opens = [n for n in os.listdir(self.dir)
+                     if n.endswith(OPEN_EXT)]
+        except OSError:
+            return
+        now = time.time()
+        for name in opens:
+            path = os.path.join(self.dir, name)
+            pid = segment_pid(name)
+            if pid == os.getpid():
+                continue                    # our own in-flight append
+            # live local pid: mid-append unless far past any flush
+            # (pid reuse).  Dead/foreign pid: still wait a short MIN
+            # age — liveness probes don't cross hosts, and a remote
+            # writer's flush seals in well under the threshold
+            grace = (OPEN_GRACE_S
+                     if pid is not None and _pid_alive(pid)
+                     else OPEN_SALVAGE_MIN_AGE_S)
+            try:
+                if now - os.path.getmtime(path) < grace:
+                    continue
+            except OSError:
+                continue
+            self._salvage(path)
+
+    def _salvage(self, path: str) -> None:
+        """Recover the checksum-valid block prefix of a torn segment
+        into a fresh sealed one, then quarantine the original aside as
+        ``.corrupt`` (same contract as the row store's torn rows: the
+        bytes survive for forensics, the lost-tail keys re-execute,
+        and scans stop re-parsing the same torn file)."""
+        rows, clean = scan_blocks(path)
+        obs = _obs()
+        if rows:
+            app = SegmentAppender(self.dir)
+            try:
+                for key, rec in rows:
+                    app.add(key, rec)
+                app.seal()
+            except Exception:
+                app.abort()
+                raise
+            obs.inc("segment_salvaged_rows", len(rows))
+        self._quarantine(path)
+        from .log import get_logger, log_event
+
+        log_event(get_logger(), "segment_quarantined", path=path,
+                  salvaged_rows=len(rows), clean_footer=clean)
+
+    def _quarantine(self, path: str) -> None:
+        _obs().inc("segments_quarantined")
+        self._drop_handle(path)
+        try:
+            os.replace(path, path + CORRUPT_EXT)
+        except OSError:  # fault-ok: already quarantined by a racer
+            pass
+
+    # -- handles (reused across export's many random reads) ---------------
+    # bounded LRU: a long un-compacted survey can hold thousands of
+    # sealed segments — caching one fd per segment touched would walk
+    # straight into ulimit -n.  dict preserves insertion order; a hit
+    # re-inserts, so the first entry is always the least recent.
+    MAX_HANDLES = 64
+
+    def _handle(self, seg: Segment):
+        fh = self._handles.pop(seg.path, None)
+        if fh is None:
+            while len(self._handles) >= self.MAX_HANDLES:
+                self._drop_handle(next(iter(self._handles)))
+            fh = open(seg.path, "rb")
+        self._handles[seg.path] = fh
+        return fh
+
+    def _drop_handle(self, path: str) -> None:
+        fh = self._handles.pop(path, None)
+        if fh is not None:
+            try:
+                fh.close()
+            except OSError:  # fault-ok: close of a vanished file
+                pass
+
+    def _close_handles(self) -> None:
+        for path in list(self._handles):
+            self._drop_handle(path)
+
+    # -- writes ------------------------------------------------------------
+    def append(self, rows: Iterable[tuple[str, dict]]) -> str | None:
+        """Seal ONE new segment holding ``rows`` (the flush unit).
+        Returns the sealed path, or None for an empty flush."""
+        rows = list(rows)
+        if not rows:
+            return None
+        app = SegmentAppender(self.dir)
+        try:
+            for key, rec in rows:
+                app.add(key, rec)
+            path, size = app.seal()
+        except Exception:
+            app.abort()
+            raise
+        obs = _obs()
+        obs.inc("segment_flushes")
+        obs.inc("segment_rows", len(rows))
+        obs.inc("segment_bytes", size)
+        # index our own segment in place so the rows are queryable
+        # immediately, but DON'T trust the post-seal mtime as "synced":
+        # a concurrent writer may have sealed in the same window, and
+        # stamping the newer mtime here would mask its segment from
+        # every read until some later write — leave _mtime unset so
+        # the next read re-lists once
+        try:
+            self._segments.insert(0, Segment.load(path))
+            self._names.add(os.path.basename(path))
+            if self._keys is not None:
+                self._keys.update(k for k, _ in rows)
+        except (SegmentError, OSError):
+            pass
+        self._mtime = None
+        return path
+
+    def compact(self, min_segments: int = 2) -> dict:
+        """Merge every sealed segment into one (rows sorted by key,
+        newest wins per duplicate), then unlink the inputs.  A crash
+        between seal and unlink leaves deterministic duplicates that
+        reads dedup; a concurrent ``append`` lands a segment outside
+        the input set and survives untouched."""
+        self.refresh(force=True)
+        inputs = list(self._segments)
+        if len(inputs) < max(int(min_segments), 2):
+            return {"compacted": 0, "rows": 0,
+                    "segments": len(inputs)}
+        winners: dict[str, Segment] = {}
+        for seg in reversed(inputs):        # oldest -> newest wins
+            for key in seg.keys():
+                winners[key] = seg
+        app = SegmentAppender(self.dir)
+        try:
+            for key in sorted(winners):
+                seg = winners[key]
+                app.add(key, seg.get(key, fh=self._handle(seg)))
+            path, size = app.seal()
+        except Exception:
+            app.abort()
+            raise
+        for seg in inputs:
+            self._drop_handle(seg.path)
+            try:
+                os.remove(seg.path)
+            except OSError:  # fault-ok: a racing compactor got there
+                pass
+        obs = _obs()
+        obs.inc("compactions")
+        obs.inc("segments_compacted", len(inputs))
+        self.refresh(force=True)
+        return {"compacted": len(inputs), "rows": len(winners),
+                "segments": len(self._segments),
+                "bytes": size, "path": os.path.basename(path)}
+
+    # -- reads -------------------------------------------------------------
+    def _key_set(self) -> set[str]:
+        if self._keys is None:
+            ks: set[str] = set()
+            for seg in self._segments:
+                ks.update(seg.keys())
+            self._keys = ks
+        return self._keys
+
+    def has(self, key: str) -> bool:
+        self.refresh()
+        return key in self._key_set()
+
+    def get(self, key: str) -> dict | None:
+        self.refresh()
+        for seg in self._segments:
+            if not seg.has(key):
+                continue
+            try:
+                return seg.get(key, fh=self._handle(seg))
+            except (SegmentError, ValueError):
+                # STRUCTURAL corruption evidence (checksum/format):
+                # fails loudly ONCE — quarantine + salvage of the
+                # valid rest — then the lost keys re-execute like
+                # torn rows
+                self._drop_handle(seg.path)
+                self._salvage(seg.path)
+                self.refresh(force=True)
+                return self.get(key)
+            except OSError:
+                # NEVER corruption evidence: transient IO (EMFILE,
+                # NFS hiccup, EIO) must not destroy durable rows.  A
+                # VANISHED file is the benign compaction race — the
+                # key lives in the merged segment; re-sync and retry.
+                # Anything else propagates to the caller.
+                self._drop_handle(seg.path)
+                if os.path.exists(seg.path):
+                    raise
+                self.refresh(force=True)
+                return self.get(key)
+        return None
+
+    def keys(self) -> set[str]:
+        self.refresh()
+        return set(self._key_set())    # copy: callers mutate freely
+
+    def iter_sorted_items(self):
+        """(key, record) in sorted key order, one entry per key
+        (newest segment wins) — the streaming gather under
+        ``export_csv``.  O(total keys) pointers in memory, never the
+        records themselves; block reads go through per-segment cached
+        handles so the gather is one sequential-ish sweep."""
+        self.refresh()
+        winners: dict[str, Segment] = {}
+        for seg in reversed(self._segments):     # oldest -> newest wins
+            for key in seg.keys():
+                winners[key] = seg
+        for key in sorted(winners):
+            seg = winners[key]
+            try:
+                yield key, seg.get(key, fh=self._handle(seg))
+            except (SegmentError, ValueError):
+                # structural corruption only — see get()'s contract
+                self._drop_handle(seg.path)
+                self._salvage(seg.path)
+                self.refresh(force=True)
+                rec = self.get(key)
+                if rec is not None:
+                    yield key, rec
+            except OSError:
+                self._drop_handle(seg.path)
+                if os.path.exists(seg.path):
+                    raise
+                self.refresh(force=True)
+                rec = self.get(key)
+                if rec is not None:
+                    yield key, rec
+
+    def segment_files(self) -> list[str]:
+        self.refresh()
+        return sorted(os.path.basename(s.path) for s in self._segments)
+
+    def stats(self) -> dict:
+        self.refresh()
+        return {"segments": len(self._segments),
+                "rows": sum(s.rows for s in self._segments),
+                "bytes": sum(_size(s.path) for s in self._segments)}
+
+
+def _size(path: str) -> int:
+    try:
+        return os.path.getsize(path)
+    except OSError:
+        return 0
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True                     # EPERM etc: someone owns it
+    return True

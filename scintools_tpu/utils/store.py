@@ -15,6 +15,25 @@ crash-safe:
 
 Simulation ensembles are resumable by PRNG-seed range the same way: key
 on the seed + SimParams.
+
+Two write planes (ISSUE 11 — the million-epoch results plane):
+
+* **row files** (``put``/``put_new``): the legacy one-JSON-per-row
+  plane — still fully supported, still read by every query;
+* **columnar segments** (``put_new_buffered`` + ``flush``; utils/
+  segments.py): buffered rows land as ONE append-only checksummed
+  segment file per flush, so a campaign of B epochs writes
+  O(workers x flushes) files instead of O(B), results are readable the
+  moment their flush seals (``row_visibility_s`` histogram measures
+  put->visible latency), and the end-of-campaign gather streams the
+  segment indexes instead of listdir-ing a million row files.
+
+Every read (``__contains__``/``get``/``keys``/``records``/
+``export_csv``/``pending``) merges BOTH planes, so legacy row-file
+stores keep draining unchanged and the export bytes are identical
+whichever plane wrote the rows.  ``SCINT_RESULTS_PLANE=rows`` forces
+the buffered API back onto row files (the A/B baseline the bench lane
+and the byte-identity tests compare against).
 """
 
 from __future__ import annotations
@@ -22,9 +41,15 @@ from __future__ import annotations
 import hashlib
 import json
 import os
+import time
 from typing import Callable, Iterable, Sequence
 
 import numpy as np
+
+from .segments import SegmentStore
+
+SEGMENT_DIRNAME = "segments"
+DEFAULT_FLUSH_ROWS = 4096
 
 
 def content_key(source, config=None) -> str:
@@ -49,15 +74,36 @@ def content_key(source, config=None) -> str:
 
 
 class ResultsStore:
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, plane: str | None = None,
+                 flush_rows: int | None = None):
         self.dir = directory
         os.makedirs(directory, exist_ok=True)
+        plane = plane or os.environ.get("SCINT_RESULTS_PLANE", "segment")
+        if plane not in ("segment", "rows"):
+            raise ValueError(f"plane={plane!r}: expected 'segment' or "
+                             "'rows' (SCINT_RESULTS_PLANE)")
+        self.plane = plane
+        self.flush_rows = int(flush_rows
+                              if flush_rows is not None
+                              else os.environ.get(
+                                  "SCINT_RESULTS_FLUSH_ROWS",
+                                  DEFAULT_FLUSH_ROWS))
+        # the segment plane is always READ (legacy stores simply have
+        # no segments dir -> one failed stat); plane only selects where
+        # the buffered writes go
+        self.segments = SegmentStore(os.path.join(directory,
+                                                  SEGMENT_DIRNAME))
+        # pending buffered rows: key -> (record, buffered_at) — flushed
+        # as ONE segment by flush(); insertion order preserved
+        self._buf: dict[str, tuple[dict, float]] = {}
 
     def _path(self, key: str) -> str:
         return os.path.join(self.dir, f"{key}.json")
 
     def __contains__(self, key: str) -> bool:
-        return os.path.exists(self._path(key))
+        return (os.path.exists(self._path(key))
+                or key in self._buf
+                or self.segments.has(key))
 
     def put(self, key: str, record: dict) -> None:
         """Atomic write: crash mid-write leaves no half-record behind.
@@ -103,10 +149,14 @@ class ResultsStore:
             with open(path) as fh:
                 return json.load(fh)
         except OSError:
-            return None
+            pass
         except ValueError:
             self._quarantine_corrupt(path)
             return None
+        buffered = self._buf.get(key)
+        if buffered is not None:
+            return buffered[0]
+        return self.segments.get(key)
 
     def _quarantine_corrupt(self, path: str) -> None:
         from .. import obs
@@ -119,12 +169,105 @@ class ResultsStore:
         except OSError:  # fault-ok: already quarantined by a racer
             pass
 
-    def keys(self) -> list[str]:
-        return sorted(os.path.splitext(f)[0] for f in os.listdir(self.dir)
-                      if f.endswith(".json"))
+    # -- the columnar segment plane (ISSUE 11) -----------------------------
+    def put_new_buffered(self, key: str, record: dict) -> bool:
+        """Write-once buffered put: the row becomes durable (and
+        visible to other processes) at the next :meth:`flush`, which
+        seals ONE segment file for the whole buffer — the O(flushes)
+        replacement for per-row ``put_new`` files.  Dedup semantics
+        match ``put_new``: an existing durable OR buffered row returns
+        False untouched.  The buffer auto-flushes at ``flush_rows`` so
+        a million-epoch campaign holds bounded memory.  Under
+        ``plane='rows'`` (SCINT_RESULTS_PLANE=rows — the A/B baseline)
+        this degrades to an immediate per-row ``put_new``."""
+        if self.plane == "rows":
+            return self.put_new(key, record)
+        if key in self:
+            return False
+        self._buf[key] = (record, time.time())
+        if len(self._buf) >= self.flush_rows:
+            self.flush()
+        return True
 
-    def records(self) -> list[dict]:
-        return [r for k in self.keys() if (r := self.get(k)) is not None]
+    def flush(self) -> int:
+        """Seal the buffered rows as one segment (no-op when empty).
+        Returns the number of rows made durable.  Also feeds the
+        ``row_visibility_s`` histogram: the put->visible latency of
+        each flushed row, the metric that replaces the old plane's
+        O(N) end-of-campaign cliff."""
+        if not self._buf:
+            return 0
+        buf, self._buf = self._buf, {}
+        try:
+            self.segments.append((k, rec)
+                                 for k, (rec, _t) in buf.items())
+        except BaseException:
+            # rows must NEVER vanish after put_new_buffered accepted
+            # them: a failed seal (ENOSPC, transient IO) restores the
+            # buffer so a caller that survives the exception retries
+            # the flush instead of silently losing the batch
+            buf.update(self._buf)
+            self._buf = buf
+            raise
+        from .. import obs
+
+        if obs.enabled():
+            now = time.time()
+            for _rec, t in buf.values():
+                obs.observe("row_visibility_s", max(now - t, 0.0))
+        return len(buf)
+
+    def compact(self, min_segments: int = 2) -> dict:
+        """Merge small segments into one (the serve ``compact`` job
+        kind's implementation); flushes pending rows first."""
+        self.flush()
+        return self.segments.compact(min_segments=min_segments)
+
+    def _row_file_keys(self) -> set[str]:
+        try:
+            return {os.path.splitext(f)[0]
+                    for f in os.listdir(self.dir) if f.endswith(".json")}
+        except OSError:
+            return set()
+
+    def keys(self) -> list[str]:
+        """Every DURABLE key, both planes merged, sorted (buffered
+        rows appear after their flush)."""
+        return sorted(self._row_file_keys() | self.segments.keys())
+
+    def iter_items(self):
+        """Streaming ``(key, record)`` in sorted key order — one
+        directory walk + the segment footers, never the whole store in
+        memory (the O(N)-memory ``records()`` list was the scale bug
+        at exactly the campaign sizes the segment plane targets).
+        Row files win over segments for a duplicated key (both are
+        deterministic duplicates under the at-least-once contract)."""
+        row_keys = self._row_file_keys()
+        if not self.segments.keys():
+            for k in sorted(row_keys):
+                rec = self.get(k)
+                if rec is not None:
+                    yield k, rec
+            return
+        seg_items = self.segments.iter_sorted_items()
+        seg_next = next(seg_items, None)
+        for k in sorted(row_keys):
+            while seg_next is not None and seg_next[0] < k:
+                yield seg_next
+                seg_next = next(seg_items, None)
+            if seg_next is not None and seg_next[0] == k:
+                seg_next = next(seg_items, None)   # row file wins
+            rec = self.get(k)
+            if rec is not None:
+                yield k, rec
+        while seg_next is not None:
+            yield seg_next
+            seg_next = next(seg_items, None)
+
+    def records(self):
+        """Streaming generator over all records in key order (was a
+        fully-materialised list — O(N) memory per call)."""
+        return (rec for _k, rec in self.iter_items())
 
     def put_meta(self, name: str, record: dict) -> None:
         """Run metadata (e.g. the resolved auto cuts/scrunch routes),
@@ -163,37 +306,64 @@ class ResultsStore:
 
     def export_csv(self, filename: str, full: bool = False) -> int:
         """Write all records to CSV.  Default: the reference-compatible
-        schema (io/results.write_results — extra columns like tilt or
+        schema (io/results.results_line — extra columns like tilt or
         per-arm curvatures are dropped, as the reference's readers
         expect).  ``full=True`` instead writes EVERY column the records
         carry (union of keys, blank where absent) for downstream tools
         that want the beyond-reference measurements.  Returns the row
-        count."""
+        count.
+
+        STREAMS both planes (rows are read once for the reference
+        schema, twice for ``full`` — fieldname-union pass then the
+        write pass) with one open output handle, instead of the old
+        materialise-everything-then-reopen-per-row gather whose cost
+        was O(N) memory plus O(N) file opens at campaign end.  Output
+        bytes are identical to the old path (same key order, same
+        formatter) whichever plane wrote the rows."""
         import csv
 
-        from ..io.results import write_results
+        from ..io.results import results_line
 
         if os.path.exists(filename):
             os.remove(filename)
-        rows = [{k: v for k, v in rec.items() if not k.startswith("_")}
-                for rec in self.records()]
         if not full:
             # the reference schema REQUIRES name/mjd/... columns; rows
             # without them (e.g. seed-keyed simulation records) cannot
-            # be expressed in it and are skipped
-            rows = [r for r in rows if "name" in r]
-            for row in rows:
-                write_results(filename, row)
-            return len(rows)
+            # be expressed in it and are skipped.  File created lazily
+            # on the first row, matching the appender's behaviour
+            # (zero rows -> no file).
+            n = 0
+            out = None
+            try:
+                for rec in self.records():
+                    row = {k: v for k, v in rec.items()
+                           if not k.startswith("_")}
+                    if "name" not in row:
+                        continue
+                    header, line = results_line(row)
+                    if out is None:
+                        out = open(filename, "w")
+                        out.write(header + "\n")
+                    out.write(line + "\n")
+                    n += 1
+            finally:
+                if out is not None:
+                    out.close()
+            return n
         lead = ["name", "mjd", "freq", "bw", "tobs", "dt", "df"]
-        present = {k for r in rows for k in r}
+        present = {k for rec in self.records()
+                   for k in rec if not k.startswith("_")}
         fields = ([k for k in lead if k in present]
                   + sorted(present - set(lead)))
+        n = 0
         with open(filename, "w", newline="") as fh:
             w = csv.DictWriter(fh, fieldnames=fields, restval="")
             w.writeheader()
-            w.writerows(rows)
-        return len(rows)
+            for rec in self.records():
+                w.writerow({k: v for k, v in rec.items()
+                            if not k.startswith("_")})
+                n += 1
+        return n
 
 
 def seed_range_pending(store: ResultsStore, seeds: Iterable[int],

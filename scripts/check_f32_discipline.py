@@ -40,6 +40,13 @@ import tokenize
 WIDE = {"float64", "complex128"}
 MARKER = "host-f64"
 SUBTREES = ("ops", "parallel", "sim")
+# single modules outside the subtree walk that still sit on hot paths
+# (the ISSUE 11 results plane streams every campaign row — a wide
+# dtype sneaking into its encode/decode would double the bytes of the
+# very plane built to cut them); extend alongside any new storage
+# module, pinned by tests/test_f32_discipline.py::*_is_covered
+EXTRA_FILES = (os.path.join("utils", "segments.py"),
+               os.path.join("utils", "store.py"))
 
 
 def find_wide_literals(path: str) -> list:
@@ -58,7 +65,8 @@ def find_wide_literals(path: str) -> list:
 
 
 def check_tree(pkg_dir: str) -> list:
-    """All offending (path, line, text) under the jax-path subtrees."""
+    """All offending (path, line, text) under the jax-path subtrees
+    plus the pinned EXTRA_FILES."""
     offenders = []
     for sub in SUBTREES:
         root_dir = os.path.join(pkg_dir, sub)
@@ -70,6 +78,12 @@ def check_tree(pkg_dir: str) -> list:
                 for line, text in find_wide_literals(path):
                     offenders.append((os.path.relpath(path, pkg_dir),
                                       line, text))
+    for rel in EXTRA_FILES:
+        path = os.path.join(pkg_dir, rel)
+        if not os.path.exists(path):
+            continue
+        for line, text in find_wide_literals(path):
+            offenders.append((rel, line, text))
     return offenders
 
 

@@ -36,6 +36,13 @@ import sys
 
 MARKER = "fault-ok"
 SUBTREES = ("parallel", "serve", "ops")
+# single modules outside the subtree walk that are fault-critical too:
+# the ISSUE 11 results plane (utils/segments.py + utils/store.py) is
+# the durability layer under the serve queue — a silent swallow there
+# can lose rows without a counter moving; extend alongside any new
+# storage module, pinned by tests/test_fault_discipline.py::*_is_covered
+EXTRA_FILES = (os.path.join("utils", "segments.py"),
+               os.path.join("utils", "store.py"))
 # exception names whose handlers are in scope (everything-catchers)
 BROAD = {"Exception", "BaseException"}
 # call names (attribute tails) that count as reporting the failure
@@ -96,7 +103,7 @@ def find_silent_handlers(path: str) -> list:
 
 def check_tree(pkg_dir: str) -> list:
     """All offending (path, line, text) under the fault-critical
-    subtrees."""
+    subtrees plus the pinned EXTRA_FILES."""
     offenders = []
     for sub in SUBTREES:
         root_dir = os.path.join(pkg_dir, sub)
@@ -108,6 +115,12 @@ def check_tree(pkg_dir: str) -> list:
                 for line, text in find_silent_handlers(path):
                     offenders.append((os.path.relpath(path, pkg_dir),
                                       line, text))
+    for rel in EXTRA_FILES:
+        path = os.path.join(pkg_dir, rel)
+        if not os.path.exists(path):
+            continue
+        for line, text in find_silent_handlers(path):
+            offenders.append((rel, line, text))
     return offenders
 
 

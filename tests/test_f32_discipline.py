@@ -41,6 +41,24 @@ def test_sim_subtree_is_covered():
         assert hits == [], (path, hits)
 
 
+def test_results_plane_modules_are_covered():
+    """The ISSUE 11 storage modules stream every campaign row — a wide
+    dtype sneaking into the encode/decode path would double the bytes
+    of the very plane built to cut them; EXTRA_FILES pins them into
+    the walk so future storage modules can't dodge the lint."""
+    extra = set(check_f32_discipline.EXTRA_FILES)
+    pkg = os.path.join(REPO, "scintools_tpu")
+    for rel in (os.path.join("utils", "segments.py"),
+                os.path.join("utils", "store.py")):
+        assert rel in extra, rel
+        path = os.path.join(pkg, rel)
+        assert os.path.exists(path), path
+        hits = check_f32_discipline.find_wide_literals(path)
+        assert not any(txt.startswith("TokenError")
+                       for _ln, txt in hits)
+        assert hits == [], (path, hits)
+
+
 def test_pallas_kernel_modules_are_covered():
     """The walk must include every Pallas kernel module — kernels are
     the easiest place to silently reintroduce f64 temps, and a rename

@@ -23,6 +23,19 @@ def test_no_silent_broad_handlers_in_fault_critical_subtrees():
         + "\n".join(f"  {p}:{ln}: {txt}" for p, ln, txt in offenders))
 
 
+def test_results_plane_modules_are_covered():
+    """The ISSUE 11 storage modules (the durability layer under the
+    serve queue) are pinned into the lint's walk: a future storage
+    module must join EXTRA_FILES (or a linted subtree) rather than
+    silently dodging the discipline."""
+    pkg = os.path.join(os.path.dirname(_HERE), "scintools_tpu")
+    extra = set(check_fault_discipline.EXTRA_FILES)
+    for rel in (os.path.join("utils", "segments.py"),
+                os.path.join("utils", "store.py")):
+        assert rel in extra, rel
+        assert os.path.exists(os.path.join(pkg, rel)), rel
+
+
 def _hits(tmp_path, src):
     mod = tmp_path / "mod.py"
     mod.write_text(textwrap.dedent(src))

@@ -64,9 +64,20 @@ def test_catalog_is_consistent_and_covers_the_known_floor():
     assert "queue_wait_s" in cat["hists"]
     for fam in ("compile_ms", "step_flops", "bucket_hits"):
         assert fam in cat["families"], fam
+    # the results-plane + sharded-queue names (ISSUE 11): tier-1
+    # counter assertions and the fleet rollup read these
+    for c in ("segment_flushes", "segment_rows", "segment_bytes",
+              "compactions", "segments_quarantined"):
+        assert c in cat["counters"], c
+    assert "row_visibility_s" in cat["hists"]
+    for fam in ("queue_shard_claims", "queue_depth"):
+        assert fam in cat["families"], fam
+    assert "serve.compact" in cat["spans"]
     # families are name PREFIXES of bracketed series; they must not
     # also be plain counter/gauge names except the documented
-    # total+breakdown pairs (faults_injected, epochs_quarantined)
+    # total+breakdown pairs (faults_injected, epochs_quarantined, and
+    # queue_depth whose total gauge rides beside the per-shard family)
     overlap = (set(cat["families"])
                & (set(cat["counters"]) | set(cat["gauges"])))
-    assert overlap == {"faults_injected", "epochs_quarantined"}, overlap
+    assert overlap == {"faults_injected", "epochs_quarantined",
+                       "queue_depth"}, overlap

@@ -1,0 +1,571 @@
+"""Million-epoch results plane (ISSUE 11): the columnar segment
+format's round-trip + bloom index, torn-tail salvage after a SIGKILLed
+writer (checksum-detected, quarantined, keys re-execute with no
+duplicate CSV rows), the store's streaming iterators, segment-vs-row
+export byte-identity, compaction (store-level and the serve `compact`
+job kind), the sharded queue namespace's placement/telemetry, the
+worker's O(flushes) segment accounting, and the results bench lane."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from synth import synth_arc_epoch
+
+from scintools_tpu import obs
+from scintools_tpu.io.psrflux import write_psrflux
+from scintools_tpu.serve import JobQueue, ServeWorker, SurveyClient
+from scintools_tpu.utils.segments import (SegmentAppender, SegmentStore,
+                                          encode_block, read_footer,
+                                          scan_blocks)
+from scintools_tpu.utils.store import ResultsStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+OPTS = {"lamsteps": True}
+GOOD_SEEDS = (1, 2, 4, 5, 7, 8)
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.disable(flush=False)
+    obs.reset()
+    yield
+    obs.disable(flush=False)
+    obs.reset()
+
+
+def _row(i: int, **extra) -> dict:
+    r = {"name": f"epoch{i:05d}", "mjd": 60000 + i, "freq": 1400.0,
+         "bw": 16.0, "tobs": 1024.0, "dt": 8.0, "df": 0.5,
+         "tau": 1.0 + i, "tauerr": 0.1}
+    r.update(extra)
+    return r
+
+
+def _write_epochs(tmp_path, seeds):
+    files = []
+    for s in seeds:
+        fn = str(tmp_path / f"epoch_{s:02d}.dynspec")
+        write_psrflux(synth_arc_epoch(nf=32, nt=32, seed=s), fn)
+        files.append(fn)
+    return files
+
+
+def _stub_runner():
+    def run(batch, batch_size, mesh, async_exec):
+        return [{"name": os.path.basename(j.file), "mjd": e.mjd,
+                 "freq": e.freq, "bw": e.bw, "tobs": e.tobs,
+                 "dt": e.dt, "df": e.df, "tau": 1.5, "tauerr": 0.1}
+                for j, e in zip(batch.jobs, batch.epochs)]
+    return run
+
+
+# ---------------------------------------------------------------------------
+# segment format
+# ---------------------------------------------------------------------------
+
+
+def test_segment_roundtrip_footer_and_bloom(tmp_path):
+    d = str(tmp_path / "segs")
+    ss = SegmentStore(d)
+    rows = [(f"key{i:04d}", _row(i)) for i in range(64)]
+    path = ss.append(rows)
+    assert path.endswith(".seg") and os.path.exists(path)
+    # a FRESH store (another process) indexes the sealed file
+    ss2 = SegmentStore(d)
+    assert ss2.keys() == {k for k, _ in rows}
+    assert ss2.get("key0003")["tau"] == 4.0
+    assert ss2.get("missing") is None
+    # columnar footer: keys/offsets/lengths aligned + the column union
+    footer = read_footer(path)
+    assert footer["rows"] == 64
+    assert len(footer["keys"]) == len(footer["offsets"]) \
+        == len(footer["lengths"]) == 64
+    assert "tau" in footer["columns"] and "name" in footer["columns"]
+    # the bloom index rules out most absent keys without touching the
+    # exact index (deterministic hashing: measure the fp fraction)
+    (seg,) = ss2._segments
+    absent = [f"absent{i:05d}" for i in range(300)]
+    fp = sum(1 for k in absent if seg.maybe_contains(k))
+    assert fp < 60, f"bloom false-positive fraction too high: {fp}/300"
+    assert all(seg.maybe_contains(k) for k, _ in rows)   # no false neg
+    # blocks themselves are checksummed length-prefixed JSON
+    recs, clean = scan_blocks(path)
+    assert clean and [k for k, _ in recs] == [k for k, _ in rows]
+
+
+def test_store_streaming_generators_and_plane_merge(tmp_path):
+    st = ResultsStore(str(tmp_path / "r"))
+    # buffered write-once: dedup against buffer AND durable planes
+    assert st.put_new_buffered("b1", _row(1)) is True
+    assert st.put_new_buffered("b1", _row(99)) is False
+    assert "b1" in st and st.get("b1")["tau"] == 2.0
+    assert st.flush() == 1 and st.flush() == 0
+    # legacy row files merge into the same read surface
+    st.put("a0", _row(0))
+    st.put_new("c2", _row(2))
+    assert st.keys() == ["a0", "b1", "c2"]
+    # records() streams (generator, not a materialised list) in key
+    # order across both planes
+    gen = st.records()
+    assert not isinstance(gen, list)
+    assert [r["name"] for r in gen] == ["epoch00000", "epoch00001",
+                                        "epoch00002"]
+    # a key in BOTH planes yields once
+    st.put("b1", _row(1))
+    assert [k for k, _ in st.iter_items()] == ["a0", "b1", "c2"]
+    # put_new against a segment-plane row is still write-once
+    assert st.put_new("b1", _row(5)) is False
+
+
+def test_export_csv_byte_identical_across_planes(tmp_path):
+    rows = {f"k{i:03d}": _row(i) for i in range(37)}
+    rows["nameless"] = {"seed": 7, "tau": 1.0}     # ref schema skips it
+    seg = ResultsStore(str(tmp_path / "seg"), plane="segment",
+                       flush_rows=10)              # multiple segments
+    raw = ResultsStore(str(tmp_path / "rows"), plane="rows")
+    for k, r in rows.items():
+        seg.put_new_buffered(k, r)
+        raw.put_new_buffered(k, r)
+    seg.flush()
+    a, b = str(tmp_path / "a.csv"), str(tmp_path / "b.csv")
+    assert seg.export_csv(a) == raw.export_csv(b) == 37
+    assert open(a, "rb").read() == open(b, "rb").read()
+    assert seg.export_csv(a, full=True) \
+        == raw.export_csv(b, full=True) == 38
+    assert open(a, "rb").read() == open(b, "rb").read()
+    # the segment store really is O(flushes) files, the row store O(N)
+    assert len(seg.segments.segment_files()) == 4
+    assert len([f for f in os.listdir(seg.dir)
+                if f.endswith(".json")]) == 0
+    assert len([f for f in os.listdir(raw.dir)
+                if f.endswith(".json")]) == 38
+
+
+def test_compaction_merges_segments_newest_wins(tmp_path):
+    st = ResultsStore(str(tmp_path / "r"))
+    for burst in range(3):
+        for i in range(5):
+            st.put_new_buffered(f"k{burst}{i}", _row(10 * burst + i))
+        st.flush()
+    # a deterministic duplicate in a NEWER segment (at-least-once
+    # worker race): compaction keeps the newest copy
+    st.segments.append([("k00", _row(0, marker="newest"))])
+    assert len(st.segments.segment_files()) == 4
+    obs.disable(flush=False)
+    obs.reset()
+    with obs.tracing():
+        out = st.compact()
+        c = obs.counters()
+    assert out["compacted"] == 4 and out["rows"] == 15
+    assert c.get("compactions") == 1
+    assert c.get("segments_compacted") == 4
+    assert len(st.segments.segment_files()) == 1
+    st2 = ResultsStore(st.dir)
+    assert len(st2.keys()) == 15
+    assert st2.get("k00")["marker"] == "newest"
+    # nothing to merge -> no-op
+    assert st.compact()["compacted"] == 0
+
+
+# ---------------------------------------------------------------------------
+# crash mid-segment: SIGKILL between block append and footer flush
+# ---------------------------------------------------------------------------
+
+_CRASH_CHILD = """\
+import sys, time
+from scintools_tpu.utils.segments import SegmentAppender, encode_block
+
+app = SegmentAppender(sys.argv[1])
+# one complete checksummed block ...
+app.add("goodkey0001", {"name": "good", "mjd": 60000, "freq": 1400.0,
+                        "bw": 16.0, "tobs": 1024.0, "dt": 8.0,
+                        "df": 0.5, "tau": 1.0, "tauerr": 0.1})
+# ... then a TORN tail: the block write is cut mid-payload, exactly
+# what a crash inside the kernel write path leaves behind
+app._fh.write(encode_block("tornkey0002", {"name": "torn"})[:13])
+app._fh.flush()
+print("READY", flush=True)
+time.sleep(120)   # hold the .open file un-sealed until the SIGKILL
+"""
+
+
+def test_sigkill_between_block_append_and_footer_flush(tmp_path):
+    """THE torn-segment acceptance: a subprocess writer SIGKILLed
+    between block append and footer flush leaves a footerless .open
+    file; the next store reader detects the torn tail via checksum,
+    salvages the valid prefix, quarantines the bytes as .corrupt
+    (like torn rows), and the lost keys re-execute with no duplicate
+    rows in the exported CSV."""
+    store_dir = str(tmp_path / "r")
+    seg_dir = os.path.join(store_dir, "segments")
+    os.makedirs(seg_dir, exist_ok=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.Popen([sys.executable, "-c", _CRASH_CHILD,
+                             seg_dir], env=env, cwd=REPO,
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        assert proc.stdout.readline().strip() == "READY"
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    (leftover,) = os.listdir(seg_dir)
+    assert leftover.endswith(".open")
+
+    # a FRESH dead-pid .open is left alone: pid liveness is host-local,
+    # so a too-eager salvage would destroy a remote writer's in-flight
+    # append on a shared filesystem (OPEN_SALVAGE_MIN_AGE_S gate)
+    early = ResultsStore(store_dir)
+    assert "goodkey0001" not in early
+    assert not any(f.endswith(".corrupt") for f in os.listdir(seg_dir))
+    # age the leftover past the gate: now it is a crash, not a writer
+    past = time.time() - 60.0
+    os.utime(os.path.join(seg_dir, leftover), (past, past))
+
+    obs.disable(flush=False)
+    obs.reset()
+    with obs.tracing():
+        store = ResultsStore(store_dir)
+        # valid prefix salvaged: the good key is readable
+        assert "goodkey0001" in store
+        assert store.get("goodkey0001")["name"] == "good"
+        # torn tail detected via checksum: the key is NOT in the store
+        assert "tornkey0002" not in store
+        c = obs.counters()
+    assert c.get("segments_quarantined") == 1, c
+    assert c.get("segment_salvaged_rows") == 1, c
+    # the torn bytes survive for forensics, quarantined aside
+    assert any(f.endswith(".corrupt") for f in os.listdir(seg_dir))
+    assert not any(f.endswith(".open") for f in os.listdir(seg_dir))
+    # the affected key simply re-executes (the resume filter offers it)
+    todo = store.pending(["goodkey0001", "tornkey0002"], lambda k: k)
+    assert todo == ["tornkey0002"]
+    store.put_new_buffered("tornkey0002",
+                           _row(2, name="torn"))
+    store.flush()
+    # no duplicate rows in the export; byte-identical to a clean
+    # rows-plane store holding the same two rows
+    out = str(tmp_path / "served.csv")
+    assert store.export_csv(out, full=True) == 2
+    oracle = ResultsStore(str(tmp_path / "oracle"), plane="rows")
+    oracle.put("goodkey0001", store.get("goodkey0001"))
+    oracle.put("tornkey0002", store.get("tornkey0002"))
+    ref = str(tmp_path / "oracle.csv")
+    oracle.export_csv(ref, full=True)
+    assert open(out, "rb").read() == open(ref, "rb").read()
+
+
+def test_live_writer_open_file_is_left_alone(tmp_path):
+    """A .open file belonging to a LIVE pid (this process) is an
+    in-flight append, not a crash: refresh must not salvage it."""
+    d = str(tmp_path / "segs")
+    app = SegmentAppender(d)
+    app.add("inflight", _row(1))
+    ss = SegmentStore(d)
+    ss.refresh(force=True)
+    assert not any(f.endswith(".corrupt") for f in os.listdir(d))
+    app.seal()
+    assert ss.has("inflight")
+
+
+# ---------------------------------------------------------------------------
+# serve integration: O(workers x flushes) files, byte-identical CSV
+# ---------------------------------------------------------------------------
+
+
+def _serve_once(tmp_path, qname, files):
+    qdir = str(tmp_path / qname)
+    client = SurveyClient(qdir)
+    recs = client.submit(files, OPTS)
+    assert all(r["status"] == "submitted" for r in recs)
+    client.drain()
+    worker = ServeWorker(JobQueue(qdir), batch_size=3, max_wait_s=0.0,
+                         lease_s=30.0, poll_s=0.01,
+                         runner=_stub_runner())
+    stats = worker.run()
+    csv = str(tmp_path / f"{qname}.csv")
+    client.export_csv(csv)
+    return qdir, stats, csv
+
+
+def test_batched_campaign_o_flushes_segments_and_identical_csv(
+        tmp_path, monkeypatch):
+    """The tier-1 acceptance counter-assert: B epochs through the
+    worker produce O(workers x flushes) segment files — not O(B) row
+    files — with export_csv byte-identical to the legacy row-store
+    plane on the same run, and the flush counters visible in obs and
+    in the worker's heartbeat stats."""
+    files = _write_epochs(tmp_path, GOOD_SEEDS)     # B = 6, batch 3
+    obs.disable(flush=False)
+    obs.reset()
+    with obs.tracing():
+        qdir, stats, seg_csv = _serve_once(tmp_path, "q_seg", files)
+        c = obs.counters()
+    assert stats["jobs_done"] == 6 and stats["batches"] == 2
+    # one sealed segment per batch flush; ZERO per-row JSON files
+    results_dir = os.path.join(qdir, "results")
+    segs = os.listdir(os.path.join(results_dir, "segments"))
+    assert len([f for f in segs if f.endswith(".seg")]) == 2
+    assert [f for f in os.listdir(results_dir)
+            if f.endswith(".json")] == []
+    assert c.get("segment_flushes") == 2, c
+    assert c.get("segment_rows") == 6, c
+    assert c.get("segment_bytes", 0) > 0, c
+    assert stats["segment_flushes"] == 2
+    assert stats["rows_flushed"] == 6
+    # the same survey through the legacy rows plane: O(B) files and a
+    # byte-identical export
+    monkeypatch.setenv("SCINT_RESULTS_PLANE", "rows")
+    qdir2, stats2, row_csv = _serve_once(tmp_path, "q_rows", files)
+    monkeypatch.delenv("SCINT_RESULTS_PLANE")
+    assert stats2["jobs_done"] == 6
+    results2 = os.path.join(qdir2, "results")
+    assert len([f for f in os.listdir(results2)
+                if f.endswith(".json")]) == 6
+    assert open(seg_csv, "rb").read() == open(row_csv, "rb").read()
+    # untraced heartbeats map the worker's own flush stats onto the
+    # canonical counter names for the fleet rollup
+    from scintools_tpu.obs import fleet
+
+    obs.disable(flush=False)
+    obs.reset()
+    w = fleet.HeartbeatWriter(str(tmp_path / "hb"), "w1", interval_s=0.0)
+    w.beat(now=1000.0, stats=stats)
+    (hb,) = fleet.read_heartbeats(str(tmp_path / "hb"))
+    assert hb["counters"]["segment_flushes"] == 2
+    assert hb["counters"]["segment_rows"] == 6
+
+
+def test_compact_job_kind_through_worker(tmp_path):
+    """`compact` rides the queue like `simulate`: submitted by the
+    client, routed around the batcher, merges the store's segments,
+    completes with no result rows."""
+    files = _write_epochs(tmp_path, GOOD_SEEDS[:4])
+    qdir = str(tmp_path / "q")
+    client = SurveyClient(qdir)
+    client.submit(files, OPTS)
+    client.drain()
+    worker = ServeWorker(JobQueue(qdir), batch_size=2, max_wait_s=0.0,
+                         lease_s=30.0, poll_s=0.01,
+                         runner=_stub_runner())
+    stats = worker.run()
+    assert stats["jobs_done"] == 4 and stats["segment_flushes"] == 2
+    q = JobQueue(qdir)
+    assert len(q.results.segments.segment_files()) == 2
+    rec = client.compact()
+    assert rec["status"] == "submitted"
+    client.drain()
+    obs.disable(flush=False)
+    obs.reset()
+    with obs.tracing():
+        worker2 = ServeWorker(JobQueue(qdir), batch_size=2,
+                              max_wait_s=0.0, lease_s=30.0, poll_s=0.01,
+                              runner=_stub_runner())
+        stats2 = worker2.run()
+        c = obs.counters()
+    assert stats2["jobs_done"] == 1 and stats2["jobs_failed"] == 0
+    assert c.get("compactions") == 1, c
+    assert len(q.results.segments.segment_files()) == 1
+    # rows intact after the merge, export unchanged
+    assert len(q.results.keys()) == 4
+    out = str(tmp_path / "after.csv")
+    assert q.results.export_csv(out) == 4
+
+
+# ---------------------------------------------------------------------------
+# sharded queue namespace
+# ---------------------------------------------------------------------------
+
+
+def test_queue_shard_layout_persistence_and_placement(tmp_path):
+    files = _write_epochs(tmp_path, GOOD_SEEDS[:4])
+    qdir = str(tmp_path / "q")
+    q = JobQueue(qdir, shards=4)
+    assert q.nshards == 4
+    assert sorted(os.listdir(os.path.join(qdir, "queued"))) == [
+        "00", "01", "02", "03"]
+    with open(os.path.join(qdir, "control", "shards")) as fh:
+        assert fh.read().strip() == "4"
+    # a different constructor value CANNOT diverge an existing queue
+    q2 = JobQueue(qdir, shards=16)
+    assert q2.nshards == 4
+    with pytest.raises(ValueError, match="shards"):
+        JobQueue(str(tmp_path / "q_bad"), shards=0)
+    # every queued record lands in its id's shard
+    ids = [q.submit(f, dict(OPTS, tag=i))[0]
+           for i, f in enumerate(files)]
+    for jid in ids:
+        shard = q._shard_name(q._shard_of(jid))
+        names = os.listdir(os.path.join(qdir, "queued", shard))
+        assert any(n.endswith(f"-{jid}.json") for n in names), jid
+    # depth/status aggregate across shards; per-shard readout works
+    st = q.status()
+    assert st["queued"] == 4 and st["shards"] == 4
+    assert sum(q.shard_depths().values()) == 4
+    # claim merges the per-shard FIFO heads by stamp: global submit
+    # order, and the per-shard claim counters tick
+    obs.disable(flush=False)
+    obs.reset()
+    with obs.tracing():
+        claimed = q.claim("w", n=4, lease_s=30.0)
+        c = obs.counters()
+    assert [j.id for j in claimed] == ids
+    shard_claims = {k: v for k, v in c.items()
+                    if k.startswith("queue_shard_claims[")}
+    assert sum(shard_claims.values()) == 4, c
+
+
+def test_queue_depth_stamped_per_shard(tmp_path):
+    (f,) = _write_epochs(tmp_path, (1,))
+    qdir = str(tmp_path / "q")
+    trace = str(tmp_path / "t.jsonl")
+    obs.disable(flush=False)
+    obs.reset()
+    with obs.tracing(jsonl=trace):
+        q = JobQueue(qdir, max_retries=0)
+        jid, _ = q.submit(f, OPTS)
+        (job,) = q.claim("w", n=1, lease_s=30.0)
+        q.fail(job, "boom", retryable=False)
+    shard = q._shard_name(q._shard_of(jid))
+    events = obs.load_events(trace)
+    # the total timeline is unchanged (ISSUE 10 contract) ...
+    total = [e["value"] for e in events
+             if e.get("kind") == "gauge" and e["name"] == "queue_depth"
+             and "pid" in e]
+    assert total == [1, 0]
+    # ... and the transitioning job's SHARD depth is stamped beside it
+    per_shard = [e["value"] for e in events
+                 if e.get("kind") == "gauge"
+                 and e["name"] == f"queue_depth[{shard}]"
+                 and "pid" in e]      # streamed stamps, not the
+    #                                   flush-time registry dump
+    assert per_shard == [1, 0]
+
+
+def test_legacy_flat_stamped_queue_drains_into_shards(tmp_path):
+    """A queue written by the PRE-SHARD layout (stamped files directly
+    under queued/) keeps draining: reads merge the flat root, claims
+    honour its stamps, and a requeue migrates the record into its
+    shard."""
+    from scintools_tpu.serve.queue import Job
+
+    files = _write_epochs(tmp_path, GOOD_SEEDS[:2])
+    qdir = str(tmp_path / "q")
+    q = JobQueue(qdir)
+    legacy = Job(id="legacyflat01", file=files[0], cfg=dict(OPTS),
+                 submitted_at=1.0)
+    flat = os.path.join(qdir, "queued",
+                        f"{q._stamp_prefix(1.0)}-legacyflat01.json")
+    with open(flat, "w") as fh:
+        json.dump(legacy.to_record(), fh)
+    jid_new, _ = q.submit(files[1], OPTS)
+    assert q.state_of("legacyflat01") == "queued"
+    assert q.counts()["queued"] == 2
+    claimed = q.claim("w", n=2, lease_s=30.0)
+    assert [j.id for j in claimed] == ["legacyflat01", jid_new]  # FIFO
+    # requeue lands SHARDED; the flat stamped file is collected by the
+    # deterministic unlink probes, not a scan
+    q.fail(claimed[0], "transient")
+    assert not os.path.exists(flat)
+    shard = q._shard_name(q._shard_of("legacyflat01"))
+    assert any(n.endswith("-legacyflat01.json")
+               for n in os.listdir(os.path.join(qdir, "queued", shard)))
+    # complete() of the sharded record leaves nothing queued anywhere
+    (j,) = q.claim("w", n=1, lease_s=30.0, now=time.time() + 60.0)
+    q.results.put(j.id, {"name": "x", "tau": 1.0})
+    q.complete(j)
+    q.complete(claimed[1])
+    assert q.counts()["queued"] == 0
+
+
+def test_cli_synthetic_campaign_writes_segments_not_row_files(
+        tmp_path, monkeypatch, capsys):
+    """The real batched engine end to end: a `process --batched
+    --synthetic` campaign lands its store rows as sealed segments
+    (zero per-row JSON files), resumes off the segment index, and
+    exports a CSV byte-identical to the same campaign through the
+    legacy rows plane."""
+    from scintools_tpu.cli import main as cli_main
+
+    def run(store_dir, csv):
+        rc = cli_main(["process", "--batched", "--synthetic", "3",
+                       "--synth-kind", "acf", "--synth-nf", "32",
+                       "--synth-nt", "32", "--no-arc",
+                       "--store", store_dir, "--results", csv])
+        capsys.readouterr()
+        return rc
+
+    seg_store = str(tmp_path / "seg_store")
+    seg_csv = str(tmp_path / "seg.csv")
+    assert run(seg_store, seg_csv) == 0
+    segs = os.listdir(os.path.join(seg_store, "segments"))
+    assert len([f for f in segs if f.endswith(".seg")]) == 1
+    assert [f for f in os.listdir(seg_store)
+            if f.endswith(".json")] == []
+    # resume: everything already done, nothing re-runs, export intact
+    assert run(seg_store, seg_csv) == 0
+    assert len([f for f in os.listdir(os.path.join(
+        seg_store, "segments")) if f.endswith(".seg")]) == 1
+    # the same campaign through the legacy plane: O(B) row files and a
+    # byte-identical CSV
+    monkeypatch.setenv("SCINT_RESULTS_PLANE", "rows")
+    row_store = str(tmp_path / "row_store")
+    row_csv = str(tmp_path / "rows.csv")
+    assert run(row_store, row_csv) == 0
+    monkeypatch.delenv("SCINT_RESULTS_PLANE")
+    assert len([f for f in os.listdir(row_store)
+                if f.endswith(".json")]) == 3
+    assert open(seg_csv, "rb").read() == open(row_csv, "rb").read()
+
+
+def test_cli_submit_compact_flag(tmp_path, capsys):
+    from scintools_tpu.cli import main as cli_main
+
+    qdir = str(tmp_path / "q")
+    assert cli_main(["submit", qdir, "--compact"]) == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["submitted"] == 1
+    assert rec["jobs"][0]["file"] == "compact:"
+    assert JobQueue(qdir).counts()["queued"] == 1
+    # --compact is a maintenance verb: mixing it with inputs is a
+    # usage error, not a half-submitted state
+    (f,) = _write_epochs(tmp_path, (1,))
+    with pytest.raises(SystemExit, match="compact"):
+        cli_main(["submit", qdir, "--compact", f])
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# bench lane
+# ---------------------------------------------------------------------------
+
+
+def test_results_bench_lane_smoke(monkeypatch):
+    """Tiny CPU-sized smoke of the SCINT_BENCH_RESULTS lane: both
+    planes measured, visibility bounded by the flush cadence, the
+    gather ratio present (the 10^5-row acceptance numbers come from a
+    real bench flight; this pins the record schema + the machinery)."""
+    monkeypatch.setenv("SCINT_BENCH_MIN_MEASURE_S", "0")
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    rec = bench.results_plane_throughput(n_rows=240, flush_rows=64)
+    assert rec["rows"] == 240 and rec["csv_rows"] == 240
+    assert rec["rows_per_s_sustained"] > 0
+    assert rec["segment_files"] == 4             # ceil(240/64)
+    vis = rec["row_visibility_s"]
+    assert vis["flushes"] == 4 and vis["max"] is not None
+    base = rec["baseline_rows_plane"]
+    assert base["csv_rows"] == 240 and base["files"] == 240
+    assert rec["gather_speedup_vs_rows"] > 0

@@ -45,6 +45,25 @@ def _write_epochs(tmp_path, seeds):
     return files
 
 
+def _queued_shard_files(q):
+    """(shard name, fname) for every queued record across the shard
+    namespace (flat legacy root included under shard name '')."""
+    out = []
+    qdir = os.path.join(q.dir, "queued")
+    for entry in sorted(os.listdir(qdir)):
+        path = os.path.join(qdir, entry)
+        if os.path.isdir(path):
+            out.extend((entry, f) for f in sorted(os.listdir(path))
+                       if f.endswith(".json"))
+        elif entry.endswith(".json"):
+            out.append(("", entry))
+    return out
+
+
+def _queued_files(q):
+    return [f for _s, f in _queued_shard_files(q)]
+
+
 def _stub_runner(rows_by_name=None, fail_names=()):
     """A sub-millisecond runner for queue/batcher-semantics tests: real
     epochs, no jax."""
@@ -163,14 +182,20 @@ def test_claim_opens_only_head_candidates(tmp_path, monkeypatch):
     monkeypatch.setattr(JobQueue, "_read_file", counting_read)
     claimed = q.claim("w", n=2, lease_s=5.0)
     # FIFO: the two EARLIEST submissions win, purely from name order
+    # (per-shard stamped FIFO lists merged by stamp = global order)
     assert [j.id for j in claimed] == ids[:2]
     # 2 candidate reads + 2 post-rename re-reads; never the whole depth
     queued_reads = [p for p in reads if os.sep + "queued" + os.sep in p]
     assert len(queued_reads) == 2, queued_reads
-    # stamped names: sorted listdir is submit order
-    names = sorted(os.listdir(os.path.join(q.dir, "queued")))
+    # stamped names inside the SHARD dirs: each shard's sorted listdir
+    # is its submit order, and every record lives in its id's shard
+    names = _queued_files(q)
+    assert names, names
     stamps = [n.split("-")[0] for n in names]
     assert all(s.isdigit() and len(s) == 17 for s in stamps)
+    for shard_name, fname in _queued_shard_files(q):
+        jid = fname[:-5].split("-", 1)[1]
+        assert shard_name == q._shard_name(q._shard_of(jid))
 
 
 def test_claim_drains_legacy_unstamped_jobs_fifo(tmp_path):
@@ -190,10 +215,10 @@ def test_claim_drains_legacy_unstamped_jobs_fifo(tmp_path):
     assert q.get("legacyjob01").file == files[1]
     claimed = q.claim("w", n=2, lease_s=5.0)
     assert [j.id for j in claimed] == ["legacyjob01", jid_new]
-    # a requeue of the legacy job comes back STAMPED, original order kept
+    # a requeue of the legacy job comes back STAMPED in its shard dir,
+    # original order kept
     q.fail(claimed[0], "transient")
-    (fname,) = [n for n in os.listdir(os.path.join(q.dir, "queued"))
-                if "legacyjob01" in n]
+    (fname,) = [n for n in _queued_files(q) if "legacyjob01" in n]
     assert fname.endswith("-legacyjob01.json")
 
 
