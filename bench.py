@@ -241,6 +241,17 @@ def _trace_flush():
         obs.flush()
 
 
+def _xprof_window():
+    """jax.profiler.trace bracket for the measure window when
+    SCINT_BENCH_XPROF names a directory (set by `scintools-tpu bench
+    --xprof DIR`): the headline passes land in a TensorBoard/XProf-
+    loadable device timeline, with the pipeline's TraceAnnotation
+    regions naming what ran.  nullcontext when unset."""
+    from scintools_tpu.utils.timing import xprof_bracket
+
+    return xprof_bracket(os.environ.get("SCINT_BENCH_XPROF"))
+
+
 def _cache_env(env=None):
     """Env dict with the persistent XLA compilation cache enabled.
 
@@ -838,23 +849,24 @@ def device_throughput(dyn, freqs, times, chunk: int,
     max_passes = _env_int("SCINT_BENCH_MAX_REPEATS", 32)
     rates = []
     spent = 0.0
-    while True:
-        t0 = time.perf_counter()
-        with obs.span("bench.step.execute", B=B, chunk=chunk):
-            outs = []
-            for i in range(0, B, chunk):
-                part = dyn_d[i:i + chunk]
-                if part.shape[0] != chunk:  # keep one compiled shape
-                    part = dyn_d[B - chunk:B]
-                outs.append(step(part))  # async dispatch; fits on device
-            sync(outs)
-        dt_pass = time.perf_counter() - t0
-        rates.append(B / dt_pass)
-        spent += dt_pass
-        if len(rates) >= max_passes:
-            break
-        if len(rates) >= max(int(repeats), 1) and spent >= min_wall:
-            break
+    with _xprof_window():
+        while True:
+            t0 = time.perf_counter()
+            with obs.span("bench.step.execute", B=B, chunk=chunk):
+                outs = []
+                for i in range(0, B, chunk):
+                    part = dyn_d[i:i + chunk]
+                    if part.shape[0] != chunk:  # keep one compiled shape
+                        part = dyn_d[B - chunk:B]
+                    outs.append(step(part))  # async; fits on device
+                sync(outs)
+            dt_pass = time.perf_counter() - t0
+            rates.append(B / dt_pass)
+            spent += dt_pass
+            if len(rates) >= max_passes:
+                break
+            if len(rates) >= max(int(repeats), 1) and spent >= min_wall:
+                break
     rate = float(np.median(rates))
     q25, q75 = (float(np.percentile(rates, 25)),
                 float(np.percentile(rates, 75)))

@@ -22,6 +22,18 @@ import sys
 from . import obs
 
 
+def _xprof_ctx(dirpath: str | None):
+    """``--xprof DIR`` bracket (ISSUE 12): wrap the run's measure
+    window in ``jax.profiler.trace`` so a TensorBoard/XProf-loadable
+    device timeline lands in DIR — with the pipeline's
+    ``TraceAnnotation`` regions (pipeline.stage/step/gather,
+    serve.batch) naming what the device was doing.  nullcontext when
+    the flag is unset."""
+    from .utils.timing import xprof_bracket
+
+    return xprof_bracket(dirpath)
+
+
 def _expand(patterns: list[str]) -> list[str]:
     from .utils import remove_duplicates
 
@@ -212,7 +224,8 @@ def cmd_process(args) -> int:
     if not args.batched:
         for flag, name in ((getattr(args, "mesh", None), "--mesh"),
                            (getattr(args, "chunk_epochs", None),
-                            "--chunk-epochs")):
+                            "--chunk-epochs"),
+                           (getattr(args, "xprof", None), "--xprof")):
             if flag is not None:
                 raise SystemExit(f"{name} only applies to the batched "
                                  "engine; add --batched")
@@ -497,7 +510,8 @@ def _process_batched(args, files, cfg, store, log, timers) -> int:
             # identifiers — pass as one JSON field, never ** unpacking
             # (non-identifier ** keys are implementation-defined)
             log_event(log, "routes", routes=json.dumps(routes))
-            with timers.stage("batched_pipeline"):
+            with timers.stage("batched_pipeline"), \
+                    _xprof_ctx(getattr(args, "xprof", None)):
                 buckets = run_pipeline(
                     epochs, pcfg, mesh=mesh,
                     chunk=getattr(args, "chunk_epochs", None),
@@ -686,7 +700,8 @@ def _process_synthetic(args, synth_d: dict, cfg, store, log,
     try:
         mesh = (make_mesh(tuple(int(x) for x in mesh_shape))
                 if mesh_shape else make_mesh())
-        with timers.stage("synthetic_pipeline"):
+        with timers.stage("synthetic_pipeline"), \
+                _xprof_ctx(getattr(args, "xprof", None)):
             rows = campaign.synthetic_rows(
                 spec, _estimator_opts(args), mesh=mesh,
                 chunk=getattr(args, "chunk_epochs", None),
@@ -926,9 +941,10 @@ def cmd_serve(args) -> int:
         # e.g. batch/mesh divisibility — a usage error, not a traceback
         raise SystemExit(str(e))
     try:
-        stats = worker.run(max_batches=args.max_batches,
-                           exit_on_drain=not args.ignore_drain,
-                           idle_exit_s=args.idle_exit)
+        with _xprof_ctx(getattr(args, "xprof", None)):
+            stats = worker.run(max_batches=args.max_batches,
+                               exit_on_drain=not args.ignore_drain,
+                               idle_exit_s=args.idle_exit)
     except KeyboardInterrupt:
         # leased jobs are reclaimed by lease expiry; report honestly
         stats = dict(worker.stats)
@@ -1347,7 +1363,18 @@ def cmd_trace_report(args) -> int:
     crash flights) and appends the merged rollup + backpressure."""
     import os
 
+    try:
+        since = (obs.parse_when(args.since)
+                 if getattr(args, "since", None) else None)
+        last = (obs.parse_duration(args.last)
+                if getattr(args, "last", None) else None)
+    except ValueError as e:
+        raise SystemExit(str(e))
     if getattr(args, "fleet", False):
+        if since is not None or last is not None:
+            raise SystemExit("--since/--last filter trace records; the "
+                             "--fleet rollup reads live heartbeats "
+                             "(stale workers are flagged instead)")
         rc = 0
         for d in args.tracefile:
             if not os.path.isdir(d):
@@ -1360,7 +1387,8 @@ def cmd_trace_report(args) -> int:
             print(text)
         return rc
     try:
-        text, warnings = obs.report_many(list(args.tracefile))
+        text, warnings = obs.report_many(list(args.tracefile),
+                                         since=since, last=last)
     except (OSError, UnicodeDecodeError) as e:
         # a binary file (e.g. a .dynspec passed by mistake) or nothing
         # readable at all fails with a one-line error, not a traceback
@@ -1425,6 +1453,10 @@ def cmd_bench(args) -> int:
         # abspath: the fallback subprocess runs with cwd=repo-root, so a
         # relative path would silently split the trace across two files.
         os.environ["SCINT_BENCH_TRACE"] = os.path.abspath(args.trace)
+    if getattr(args, "xprof", None):
+        # bench reads the env (its measure window lives in bench.py's
+        # device_throughput); abspath for the same cwd reason as above
+        os.environ["SCINT_BENCH_XPROF"] = os.path.abspath(args.xprof)
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     path = os.path.join(root, "bench.py")
     if os.path.exists(path):
@@ -1615,6 +1647,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "(pad to the nearest rung / chunk at the top "
                         "rung — only `warmup --catalog` signatures "
                         "execute; real-lane results byte-identical)")
+    q.add_argument("--xprof", default=None, metavar="DIR",
+                   help="batched mode: bracket the pipeline's measure "
+                        "window in jax.profiler.trace — a TensorBoard/"
+                        "XProf-loadable device timeline lands in DIR, "
+                        "with pipeline.stage/step/gather annotations "
+                        "naming the regions")
     _add_perf_policy_flags(q)
     _add_synth_flags(q)
     q.set_defaults(fn=cmd_process)
@@ -1731,6 +1769,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "queue dir (default 8, or SCINT_QUEUE_SHARDS); "
                         "an existing queue's persisted control/shards "
                         "value always wins")
+    q.add_argument("--xprof", default=None, metavar="DIR",
+                   help="bracket the whole serving session in "
+                        "jax.profiler.trace (device timeline to DIR; "
+                        "serve.batch annotations name each executed "
+                        "batch) — for profiling a worker under load")
     q.set_defaults(fn=cmd_serve)
 
     q = sub.add_parser(
@@ -1880,6 +1923,10 @@ def build_parser() -> argparse.ArgumentParser:
     q.set_defaults(fn=cmd_wavefield)
 
     q = sub.add_parser("bench", help="run the headline benchmark")
+    q.add_argument("--xprof", default=None, metavar="DIR",
+                   help="bracket the bench measure window in "
+                        "jax.profiler.trace (sets SCINT_BENCH_XPROF; "
+                        "the device timeline lands in DIR)")
     q.set_defaults(fn=cmd_bench)
 
     q = sub.add_parser("trace",
@@ -1897,6 +1944,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "(worker heartbeats + traces + crash flights) "
                         "and print the merged per-worker rollup with "
                         "the backpressure scalar")
+    r.add_argument("--since", default=None, metavar="TS",
+                   help="event-time filter: keep only records stamped "
+                        "at/after TS (unix seconds, or an ISO date/"
+                        "datetime like 2026-08-04T12:00) — multi-day "
+                        "merged JSONL reports one window at a time")
+    r.add_argument("--last", default=None, metavar="DUR",
+                   help="event-time filter: keep only records within "
+                        "the trailing DUR (N[s|m|h|d], e.g. 2h) of "
+                        "the NEWEST stamped record — event time, not "
+                        "wall clock, so old traces still filter "
+                        "meaningfully")
     r.set_defaults(fn=cmd_trace_report)
 
     q = sub.add_parser(

@@ -201,7 +201,8 @@ class FaultSpec:
 # inject() API stays unvalidated on purpose: tests exercise the
 # registry with synthetic sites.  Extend this tuple when threading a
 # new faults.check() site.
-KNOWN_SITES = ("driver.chunk_execute", "schedule.prefetch",
+KNOWN_SITES = ("driver.chunk_execute", "driver.admit_chunk",
+               "schedule.prefetch",
                "compile_cache.load", "queue.claim_rename",
                "worker.load", "worker.batch_execute", "worker.poll")
 

@@ -21,20 +21,22 @@ Disabled (the default), every hook is a single flag check — see
 docs/observability.md for the span taxonomy and the trace CLI.
 """
 
+from . import devmem  # noqa: F401  (the device-memory plane)
 from .core import (Registry, counters, disable,  # noqa: F401
                    dump_flight, enable, enabled, event, flush, gauge,
                    get_registry, hist_summaries, inc, observe,
                    render_summary, reset, span, summary, traced, tracing)
 from .fleet import (HeartbeatWriter, assemble_traces,  # noqa: F401
                     backpressure, fleet_report, fleet_rollup,
-                    merge_heartbeats, new_trace_id, read_heartbeats,
-                    render_fleet)
+                    heartbeat_stale, merge_heartbeats, new_trace_id,
+                    read_heartbeats, render_fleet)
 from .hist import Hist, merge_hist_dicts  # noqa: F401
 from .jax_helpers import (bytes_of, fence,  # noqa: F401
                           instrument_jit, xla_cost_analysis)
 from .report import (aggregate, catalog_section,  # noqa: F401
-                     compile_profile, compile_split, load_events,
-                     load_trace_files, measured_roofline,
+                     compile_profile, compile_split, devmem_section,
+                     filter_events, load_events, load_trace_files,
+                     measured_roofline, parse_duration, parse_when,
                      reliability_section, render, report, report_many,
                      serve_section)
 from .sinks import JsonlSink, LogSink  # noqa: F401

@@ -194,18 +194,24 @@ class Registry:
             self._counters[name] = self._counters.get(name, 0) + value
 
     def gauge(self, name: str, value, stream: bool = False) -> None:
+        if not stream:
+            with _LOCK:
+                self._gauges[name] = value
+            return
+        # timeline gauges (queue_depth at submit/complete/fail
+        # transitions, hbm_bytes_in_use per execute window): the
+        # registry's latest-value cell aliases a sawtooth at low flush
+        # rates, so transition points stream one timestamped gauge
+        # event per change to the sinks AND into the event ring — the
+        # in-process timeline render_summary()/dump_flight read
+        event = {"ts": time.time(), "kind": "gauge", "name": name,
+                 "value": value, "pid": os.getpid()}
         with _LOCK:
             self._gauges[name] = value
-            sinks = list(self._sinks) if stream else ()
-        if stream:
-            # timeline gauges (queue_depth at submit/complete/fail
-            # transitions): the registry's latest-value cell aliases a
-            # sawtooth at low flush rates, so transition points stream
-            # one timestamped gauge event per change to the sinks
-            event = {"ts": time.time(), "kind": "gauge", "name": name,
-                     "value": value, "pid": os.getpid()}
-            for s in sinks:
-                s.emit(event)
+            self._events.append(event)
+            sinks = list(self._sinks)
+        for s in sinks:
+            s.emit(event)
 
     def observe(self, name: str, value: float) -> None:
         """Feed one value into the named fixed-bucket histogram (the
@@ -521,4 +527,5 @@ def render_summary() -> str:
     (same renderer as ``trace report``)."""
     from .report import render
 
-    return render(summary(), counters(), gauges=_REGISTRY.gauges())
+    return render(summary(), counters(), gauges=_REGISTRY.gauges(),
+                  events=_REGISTRY.events())

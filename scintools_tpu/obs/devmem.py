@@ -1,0 +1,393 @@
+"""Device-memory observability plane (ISSUE 12 tentpole): HBM gauges,
+per-signature peak attribution, and the predicted-footprint source the
+driver's predictive chunk admission consults.
+
+The r05 flight proved the chip path fast (405.9x reference) but blind
+to the resource that bounds it: HBM.  The reactive OOM backoff (PR 5)
+throws away a chunk's work AFTER ``RESOURCE_EXHAUSTED``; the roofline
+gauges (PR 4) carry XLA's byte *model*, never measured residency.
+This module closes both gaps from one sampling surface:
+
+* **Gauges** — :func:`sample` reads ``device.memory_stats()`` over the
+  local devices and publishes ``hbm_bytes_in_use`` /
+  ``hbm_bytes_limit`` (summed across devices; a mesh-sharded step's
+  residency is divided over them, so totals compare against totals).
+  ``stream=True`` additionally stamps a timestamped gauge event — the
+  headroom timeline ``trace report``'s memory section renders.
+* **Per-signature peaks** — ``obs.instrument_jit`` opens a
+  :func:`begin_window` / :func:`end_window` pair around every fenced
+  ``.execute`` region, attributing the window's peak HBM to the
+  compiled signature as a ``step_hbm_peak[<stage>:<sig>]`` gauge —
+  a MEASURED footprint next to the modeled ``step_bytes[...]``.
+
+  **Fencing caveat** (documented in docs/observability.md): PJRT
+  exposes no peak-counter reset on current jax, so a window is only
+  exactly attributable when it RAISES the process high-water mark
+  (then the new peak is the window's own — the execute region is
+  fenced, so no other dispatch overlaps it).  A window that stays
+  under an earlier signature's peak records the fenced in-use bytes
+  as a LOWER-BOUND estimate instead (never overwriting an exact
+  record).  Where a backend does expose a reset hook, every window
+  is exact.
+* **Prediction** — :func:`predicted_peak` answers "what will this
+  signature cost?" for the driver's admission check: a recorded peak
+  for the exact signature, a recorded peak at another batch size
+  scaled linearly in the batch, the ``step_bytes[...]`` cost-analysis
+  model, in that order of trust.
+
+**Degradation contract**: a backend whose ``memory_stats()`` returns
+None (CPU) disables the whole plane — the first probe memoises the
+negative, every later call is one flag check, and pipeline output is
+bit-identical with the plane on or off (tests/test_devmem.py).  All
+hooks are additionally gated on ``obs.enabled()``: untraced runs never
+pay a stats read per step.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import core
+
+# availability memo: None = unprobed, False = backend reports no
+# memory stats (CPU) — the permanent no-op fast path, True = live.
+# reset() clears it (tests swap the provider mid-process).
+_AVAILABLE: bool | None = None
+# whether the backend exposes a peak-counter reset (probed once);
+# current jax/PJRT does not — the estimate path below is the norm
+_RESET_SUPPORTED: bool | None = None
+# test seam: a callable returning True after resetting every device's
+# peak counter (real backends lack one; fakes install it here)
+_RESET_HOOK = None
+
+_LOCK = threading.Lock()
+# label -> best known window peak (bytes); labels in _ESTIMATED carry
+# the lower-bound caveat (no reset + window under the high-water mark)
+_PEAKS: dict[str, float] = {}
+_ESTIMATED: set[str] = set()
+# label -> the window's INCREMENTAL cost (peak minus the pre-window
+# in-use bytes) for EXACT windows only — the quantity that scales
+# linearly in the batch.  Scaling the absolute peak would multiply
+# the ambient residency along with it and over-predict.
+_DELTAS: dict[str, float] = {}
+
+
+def _device_stats() -> list[dict] | None:
+    """Raw per-device ``memory_stats()`` dicts, or None when the
+    backend does not report them (CPU returns None; a backend without
+    jax at all degrades the same way).  The test seam: fakes
+    monkeypatch this function."""
+    try:
+        import jax
+
+        devs = jax.local_devices()
+    except Exception:  # fault-ok: capability probe (no backend => no plane)
+        return None
+    out = []
+    for d in devs:
+        try:
+            st = d.memory_stats()
+        except Exception:  # fault-ok: capability probe per device
+            st = None
+        if not isinstance(st, dict) or "bytes_in_use" not in st:
+            return None
+        out.append(st)
+    return out or None
+
+
+def snapshot() -> dict | None:
+    """One aggregated reading over the local devices:
+    ``{bytes_in_use, peak_bytes_in_use, bytes_limit, n_devices}``
+    (sums — a sharded step divides its residency over the devices), or
+    None when the backend reports nothing.  Updates the availability
+    memo either way."""
+    global _AVAILABLE
+    if _AVAILABLE is False:
+        return None
+    stats = _device_stats()
+    if stats is None:
+        _AVAILABLE = False
+        return None
+    _AVAILABLE = True
+    agg = {"bytes_in_use": 0, "peak_bytes_in_use": 0, "bytes_limit": 0,
+           "n_devices": len(stats)}
+    for st in stats:
+        in_use = int(st.get("bytes_in_use", 0))
+        agg["bytes_in_use"] += in_use
+        agg["peak_bytes_in_use"] += int(st.get("peak_bytes_in_use",
+                                               in_use))
+        agg["bytes_limit"] += int(st.get("bytes_limit", 0))
+    return agg
+
+
+def available() -> bool:
+    """Whether the backend exposes memory stats (memoised probe)."""
+    global _AVAILABLE
+    if _AVAILABLE is None:
+        snapshot()
+    return bool(_AVAILABLE)
+
+
+def headroom() -> float | None:
+    """``bytes_limit - bytes_in_use`` summed over local devices — the
+    admission signal — or None when the plane is degraded (CPU)."""
+    snap = snapshot()
+    if snap is None or not snap["bytes_limit"]:
+        return None
+    return float(snap["bytes_limit"] - snap["bytes_in_use"])
+
+
+def sample(stream: bool = False) -> dict | None:
+    """Publish the HBM gauges from one snapshot (no-op when the plane
+    or tracing is off).  ``stream=True`` stamps ``hbm_bytes_in_use``
+    as a timestamped gauge event too — the headroom-timeline points
+    ``trace report``'s memory section renders."""
+    if not core.enabled():
+        return None
+    snap = snapshot()
+    if snap is None:
+        return None
+    core.gauge("hbm_bytes_in_use", snap["bytes_in_use"], stream=stream)
+    core.gauge("hbm_bytes_limit", snap["bytes_limit"])
+    return snap
+
+
+def _reset_peak() -> bool:
+    """Best-effort per-device peak-counter reset; returns whether one
+    happened.  Current jax/PJRT devices expose none (the probe
+    memoises the negative), but the seam keeps the EXACT attribution
+    path testable and ready for runtimes that grow one."""
+    global _RESET_SUPPORTED
+    if _RESET_SUPPORTED is False:
+        return False
+    if _RESET_HOOK is not None:
+        try:
+            ok = bool(_RESET_HOOK())
+        except Exception:  # fault-ok: capability probe
+            ok = False
+        _RESET_SUPPORTED = ok
+        return ok
+    try:
+        import jax
+
+        ok = False
+        for d in jax.local_devices():
+            for attr in ("reset_peak_bytes_in_use", "reset_memory_stats"):
+                fn = getattr(d, attr, None)
+                if fn is not None:
+                    fn()
+                    ok = True
+                    break
+    except Exception:  # fault-ok: capability probe
+        ok = False
+    _RESET_SUPPORTED = ok
+    return ok
+
+
+def begin_window():
+    """Open a peak-attribution window around a fenced execute region
+    (called by ``obs.instrument_jit``).  Returns opaque state for
+    :func:`end_window`, or None when the plane is inactive (degraded
+    backend, or tracing disabled) — the no-op fast path is one flag
+    compare plus one ``core.enabled()`` check."""
+    if _AVAILABLE is False or not core.enabled():
+        return None
+    pre = snapshot()
+    if pre is None:
+        return None
+    return (pre, _reset_peak())
+
+
+def end_window(win, label: str) -> float | None:
+    """Close a window and attribute its peak HBM to ``label``
+    (``<stage>:<B>x<nf>x<nt>:<dtype>`` — the instrument_jit signature
+    label).  Publishes the signature's best-known peak as the
+    ``step_hbm_peak[<label>]`` gauge and streams one HBM gauge sample
+    (a headroom-timeline point per step).  Returns the window's peak
+    bytes, or None when inactive."""
+    if win is None:
+        return None
+    pre, did_reset = win
+    post = snapshot()
+    if post is None:
+        return None
+    if did_reset:
+        peak, estimated = post["peak_bytes_in_use"], False
+    elif post["peak_bytes_in_use"] > pre["peak_bytes_in_use"]:
+        # the fenced window raised the process high-water mark, so the
+        # new peak is the window's own measurement
+        peak, estimated = post["peak_bytes_in_use"], False
+    else:
+        # fencing caveat: no reset and the window stayed under an older
+        # peak — the true window peak is unknowable, record the fenced
+        # residency as a lower bound
+        peak = max(post["bytes_in_use"], pre["bytes_in_use"])
+        estimated = True
+    with _LOCK:
+        prev = _PEAKS.get(label)
+        prev_est = label in _ESTIMATED
+        if (prev is None or (prev_est and not estimated)
+                or (estimated == prev_est and peak > prev)):
+            _PEAKS[label] = float(peak)
+            if estimated:
+                _ESTIMATED.add(label)
+            else:
+                _ESTIMATED.discard(label)
+        if not estimated:
+            delta = max(float(peak) - float(pre["bytes_in_use"]), 0.0)
+            _DELTAS[label] = max(_DELTAS.get(label, 0.0), delta)
+        best = _PEAKS[label]
+    core.gauge(f"step_hbm_peak[{label}]", best)
+    # publish the HBM gauges from the post reading already in hand (a
+    # third memory_stats sweep per step would be pure overhead); the
+    # streamed in-use stamp is the headroom-timeline point
+    if core.enabled():
+        core.gauge("hbm_bytes_in_use", post["bytes_in_use"],
+                   stream=True)
+        core.gauge("hbm_bytes_limit", post["bytes_limit"])
+    return float(peak)
+
+
+def recorded_peaks() -> dict:
+    """``{label: {"bytes": peak, "estimated": bool}}`` — the
+    per-signature measured footprints (heartbeats ship this; the
+    admission check and ``trace report`` read the gauges)."""
+    with _LOCK:
+        return {label: {"bytes": v, "estimated": label in _ESTIMATED}
+                for label, v in _PEAKS.items()}
+
+
+def _parse_label(label: str):
+    """``(stage, batch, grid)`` from ``<stage>:<B>x<dims...>:<dtype>``
+    or None for labels that do not follow the signature form."""
+    parts = label.split(":")
+    if len(parts) < 2:
+        return None
+    dims = parts[1].split("x")
+    if not dims or not all(d.isdigit() for d in dims):
+        return None
+    return parts[0], int(dims[0]), tuple(int(d) for d in dims[1:])
+
+
+# sources whose values are ABSOLUTE residency totals (they were read
+# as summed bytes_in_use, ambient allocations included) — the
+# admission check compares these against bytes_limit; every other
+# source is INCREMENTAL (bytes the chunk itself adds) and compares
+# against headroom.  Mixing the units double-counts what is already
+# resident and forces spurious step-downs.  "measured-scaled" is
+# INCREMENTAL by construction: it scales the recorded window DELTA
+# (peak minus pre-window in-use), never the absolute peak — scaling
+# an absolute total would multiply the ambient residency with it.
+ABSOLUTE_PEAK_SOURCES = frozenset({"measured", "estimated-floor"})
+
+
+def predicted_peak(stage: str, batch: int, grid,
+                   gauges: dict | None = None):
+    """Predicted peak HBM bytes for the signature
+    ``<stage>:<batch>x<grid...>:*`` and the source of the prediction,
+    or None when nothing is known.  Trust order:
+
+    1. ``("measured", ...)`` — an EXACT recorded window peak for the
+       stage/batch/grid (any dtype; an ABSOLUTE residency total);
+    2. ``("measured-scaled", ...)`` — the exact window's INCREMENTAL
+       delta (peak − pre-window in-use) for the same stage+grid at
+       another batch size, scaled linearly in the batch (the batch
+       axis is the only one that varies on the ladder; the ambient
+       residency must NOT scale with it);
+    3. ``("model", ...)`` / ``("model-scaled", ...)`` — the
+       ``step_bytes[...]`` XLA cost-analysis gauge, same two ways
+       (bytes *accessed*, an upper-ish proxy for residency);
+    4. ``("estimated-floor", ...)`` — a LOWER-BOUND window estimate
+       (the fencing caveat).  Last on purpose: an under-estimate
+       admitted as "measured" would shadow a possibly-accurate model
+       and launch a chunk straight into the reactive OOM path.
+
+    Sources in :data:`ABSOLUTE_PEAK_SOURCES` are residency totals
+    (compare vs ``bytes_limit``); the rest are incremental (compare vs
+    headroom).  ``gauges`` defaults to the live registry's (the driver
+    passes nothing); injectable for tests and offline analysis."""
+    grid = tuple(int(g) for g in grid)
+
+    def match(records):
+        exact, scaled = None, None
+        for label, value in records:
+            parsed = _parse_label(label)
+            if parsed is None:
+                continue
+            lstage, lbatch, lgrid = parsed
+            if lstage != stage or lgrid != grid:
+                continue
+            if lbatch == batch:
+                exact = max(exact or 0.0, float(value))
+            elif lbatch > 0:
+                est = float(value) * batch / lbatch
+                scaled = max(scaled or 0.0, est)
+        return exact, scaled
+
+    with _LOCK:
+        exact_recs = [(la, v) for la, v in _PEAKS.items()
+                      if la not in _ESTIMATED]
+        delta_recs = list(_DELTAS.items())
+        floor_recs = [(la, v) for la, v in _PEAKS.items()
+                      if la in _ESTIMATED]
+    exact, _ = match(exact_recs)
+    if exact is not None:
+        return exact, "measured"
+    _, scaled = match(delta_recs)
+    if scaled is not None:
+        return scaled, "measured-scaled"
+    from .report import bracketed_values
+
+    if gauges is None:
+        gauges = core.get_registry().gauges()
+    exact, scaled = match(bracketed_values(gauges,
+                                           "step_bytes[").items())
+    if exact is not None:
+        return exact, "model"
+    if scaled is not None:
+        return scaled, "model-scaled"
+    exact, scaled = match(floor_recs)
+    if exact is not None:
+        return exact, "estimated-floor"
+    if scaled is not None:
+        return scaled, "estimated-floor"
+    return None
+
+
+def memory_profile_dump(directory: str, tag: str = "") -> str | None:
+    """Write ``jax.profiler.device_memory_profile()`` (a gzipped pprof
+    protobuf of live device buffers) to
+    ``<directory>/memprof_<pid>[_<tag>].pb`` — the on-OOM snapshot the
+    crash flight recorder attaches (docs/observability.md).  Returns
+    the path, or None when the profiler (or jax) is unavailable; never
+    raises — a diagnostics dump must not replace the error it
+    explains."""
+    import os
+
+    try:
+        import jax
+
+        with core.span("devmem.memory_profile"):
+            blob = jax.profiler.device_memory_profile()
+        os.makedirs(directory, exist_ok=True)
+        name = f"memprof_{os.getpid()}{('_' + tag) if tag else ''}.pb"
+        path = os.path.join(directory, name)
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            fh.write(blob)
+        os.replace(tmp, path)
+        return path
+    except Exception:  # fault-ok: diagnostics only, caller logs None
+        return None
+
+
+def reset() -> None:
+    """Clear every memo and record (tests swap providers between
+    cases; a long-lived process never needs this)."""
+    global _AVAILABLE, _RESET_SUPPORTED
+    with _LOCK:
+        _PEAKS.clear()
+        _ESTIMATED.clear()
+        _DELTAS.clear()
+    _AVAILABLE = None
+    _RESET_SUPPORTED = None

@@ -40,7 +40,7 @@ import os
 import time
 import uuid
 
-from . import core
+from . import core, devmem
 from .hist import Hist, merge_hist_dicts
 
 HEARTBEAT_DIRNAME = "heartbeat"
@@ -237,6 +237,21 @@ class HeartbeatWriter:
                                  if last_claim_at is not None else None),
             "digests": self._warm_digests(),
         }
+        # device-memory plane (ISSUE 12): a DIRECT sample into the
+        # heartbeat body, so an untraced worker (obs.gauge is a no-op
+        # without --trace) still publishes its headroom — the
+        # admission signal the pool controller routes on.  One
+        # memory_stats read per beat; a backend without stats (CPU)
+        # memoises the negative and this is one flag check.
+        snap = devmem.snapshot()
+        if snap is not None:
+            mem = dict(snap)
+            if mem.get("bytes_limit"):
+                mem["headroom"] = mem["bytes_limit"] - mem["bytes_in_use"]
+            peaks = devmem.recorded_peaks()
+            if peaks:
+                mem["step_peaks"] = peaks
+            hb["devmem"] = mem
         if stats:
             hb["stats"] = dict(stats)
         if extra:
@@ -274,7 +289,20 @@ def read_heartbeats(directory: str) -> list[dict]:
     return out
 
 
-def merge_heartbeats(heartbeats) -> dict:
+def heartbeat_stale(hb: dict, now: float) -> bool:
+    """Whether a heartbeat is STALE: beat age over 3x the worker's own
+    ``interval_s`` (ISSUE 12 satellite).  A dead worker's last
+    snapshot keeps its frozen ``deltas`` forever; folding them into
+    the drain rate dilutes the fleet estimate with a rate the worker
+    is no longer producing.  Heartbeats without an interval (foreign
+    payloads) never read as stale."""
+    iv = hb.get("interval_s")
+    if not isinstance(iv, (int, float)) or iv <= 0:
+        return False
+    return (now - hb.get("ts", now)) > 3.0 * iv
+
+
+def merge_heartbeats(heartbeats, now: float | None = None) -> dict:
     """Fold N worker heartbeats into one fleet aggregate — associative
     and commutative (counter sums, histogram bucket adds, last-writer
     gauges by timestamp), asserted by tests/test_fleet.py.
@@ -283,7 +311,12 @@ def merge_heartbeats(heartbeats) -> dict:
     drain_rate_per_s, depth}``: ``drain_rate_per_s`` sums each
     worker's ``jobs_done`` delta over its beat interval (a worker's
     FIRST beat has no interval and contributes 0 — rate needs two
-    observations); ``depth`` is the freshest ``queue_depth`` gauge."""
+    observations); ``depth`` is the freshest ``queue_depth`` gauge.
+    ``now`` (when given) excludes STALE workers — beat age > 3x their
+    own interval, :func:`heartbeat_stale` — from the drain rate (and
+    therefore from backpressure): a dead worker's frozen deltas must
+    not read as live throughput.  Their counters still merge (totals
+    stay truthful) and ``stale_workers`` counts them."""
     hbs = sorted((hb for hb in heartbeats),
                  key=lambda hb: (hb.get("ts", 0.0),
                                  str(hb.get("worker"))))
@@ -292,6 +325,7 @@ def merge_heartbeats(heartbeats) -> dict:
     gauges: dict = {}
     gauge_ts: dict = {}
     drain = 0.0
+    stale = 0
     for hb in hbs:
         for k, v in (hb.get("counters") or {}).items():
             if isinstance(v, (int, float)):
@@ -306,12 +340,16 @@ def merge_heartbeats(heartbeats) -> dict:
         for k, v in (hb.get("gauges") or {}).items():
             if ts >= gauge_ts.get(k, -1.0):
                 gauges[k], gauge_ts[k] = v, ts
+        if now is not None and heartbeat_stale(hb, now):
+            stale += 1
+            continue     # frozen deltas: no drain contribution
         elapsed = hb.get("elapsed_s")
         done = (hb.get("deltas") or {}).get("jobs_done", 0)
         if elapsed and elapsed > 0 and isinstance(done, (int, float)):
             drain += max(float(done), 0.0) / float(elapsed)
     depth = gauges.get("queue_depth")
     return {"workers": len(hbs),
+            "stale_workers": stale,
             "counters": counters,
             "hists": {n: h.summary() for n, h in sorted(hists.items())},
             "gauges": gauges,
@@ -381,17 +419,42 @@ def depth_timeline(events, limit: int = 12) -> list:
     """(ts, depth) points from streamed ``queue_depth`` gauge events —
     the transition-stamped timeline (ISSUE 10 satellite: submit/
     complete/fail stamp depth, so low poll rates don't alias it).
-    Down-sampled evenly to ``limit`` points for rendering."""
-    pts = [(ev.get("ts", 0.0), ev.get("value"))
-           for ev in events
-           if ev.get("kind") == "gauge"
-           and ev.get("name") == "queue_depth"
-           and isinstance(ev.get("value"), (int, float))]
-    pts.sort(key=lambda p: p[0])
-    if len(pts) <= limit:
-        return pts
-    step = (len(pts) - 1) / (limit - 1)
-    return [pts[round(i * step)] for i in range(limit)]
+    Down-sampled evenly to ``limit`` points for rendering (the shared
+    :func:`obs.report.gauge_timeline` resampler)."""
+    from .report import gauge_timeline
+
+    return gauge_timeline(events, "queue_depth", limit=limit)
+
+
+def _worker_memory(hb: dict) -> dict | None:
+    """The worker's memory column: the heartbeat's direct ``devmem``
+    sample (works untraced), falling back to the traced registry's
+    ``hbm_*`` gauges.  None when the worker's backend has no plane."""
+    mem = hb.get("devmem")
+    if isinstance(mem, dict) and "bytes_in_use" in mem:
+        out = {"bytes_in_use": mem.get("bytes_in_use"),
+               "peak_bytes_in_use": mem.get("peak_bytes_in_use"),
+               "bytes_limit": mem.get("bytes_limit"),
+               "headroom": mem.get("headroom")}
+        if mem.get("step_peaks"):
+            out["step_peaks"] = mem["step_peaks"]
+        return out
+    from .report import bracketed_values
+
+    g = hb.get("gauges") or {}
+    in_use, limit = g.get("hbm_bytes_in_use"), g.get("hbm_bytes_limit")
+    if not isinstance(in_use, (int, float)):
+        return None
+    out = {"bytes_in_use": in_use, "peak_bytes_in_use": None,
+           "bytes_limit": limit,
+           "headroom": (limit - in_use
+                        if isinstance(limit, (int, float)) and limit
+                        else None)}
+    peaks = bracketed_values(g, "step_hbm_peak[")
+    if peaks:
+        out["step_peaks"] = {label: {"bytes": v}
+                             for label, v in peaks.items()}
+    return out
 
 
 def _worker_row(hb: dict, now: float) -> dict:
@@ -411,6 +474,8 @@ def _worker_row(hb: dict, now: float) -> dict:
     return {
         "worker": hb.get("worker"), "pid": hb.get("pid"),
         "age_s": round(max(now - hb.get("ts", now), 0.0), 3),
+        "stale": heartbeat_stale(hb, now),
+        "memory": _worker_memory(hb),
         "last_claim_age_s": hb.get("last_claim_age_s"),
         "jobs_done": int(c.get("jobs_done", 0)),
         "jobs_failed": int(c.get("jobs_failed", 0)),
@@ -434,7 +499,10 @@ def fleet_rollup(heartbeats, events=(), depth=None,
     queue depth with a live measurement when the caller has one (the
     ``fleet status`` CLI reads the queue dir directly)."""
     now = time.time() if now is None else now
-    merged = merge_heartbeats(heartbeats)
+    # stale workers (beat age > 3x their own interval) are excluded
+    # from the drain rate — and therefore from backpressure — so a
+    # dead worker's frozen deltas cannot dilute the fleet estimate
+    merged = merge_heartbeats(heartbeats, now=now)
     eff_depth = depth if depth is not None else merged["depth"]
     traces = assemble_traces(events) if events else {}
     rollup = {
@@ -477,8 +545,9 @@ def render_fleet(rollup: dict) -> str:
                      if w["last_claim_age_s"] is not None else "-")
             fill = (f"{w['fill_ratio']}" if w["fill_ratio"] is not None
                     else "-")
+            stale = " STALE" if w.get("stale") else ""
             lines.append(
-                f"  worker {w['worker']} (pid {w['pid']}): beat "
+                f"  worker {w['worker']} (pid {w['pid']}){stale}: beat "
                 f"{w['age_s']:.1f}s ago, last claim {claim}, done = "
                 f"{w['jobs_done']}, failed = {w['jobs_failed']}, "
                 f"retries = {w['job_retries']}"
@@ -488,6 +557,20 @@ def render_fleet(rollup: dict) -> str:
                 f"{w['compile_cold_ms']:.1f}/{w['compile_warm_ms']:.1f}"
                 + (f"; warm_cache = {w['warm_cache']}"
                    if w["warm_cache"] else ""))
+            mem = w.get("memory")
+            if mem:
+                def _gib(v):
+                    return (f"{v / 2**30:.2f}"
+                            if isinstance(v, (int, float)) else "-")
+
+                peak = mem.get("peak_bytes_in_use")
+                lines.append(
+                    f"    hbm GiB: in_use = {_gib(mem['bytes_in_use'])}"
+                    f", peak = {_gib(peak)}, limit = "
+                    f"{_gib(mem['bytes_limit'])}, headroom = "
+                    f"{_gib(mem.get('headroom'))}"
+                    + (f" ({len(mem['step_peaks'])} signature peak(s))"
+                       if mem.get("step_peaks") else ""))
     else:
         lines.append("  (no heartbeats)")
     merged = rollup["merged"]
@@ -519,6 +602,10 @@ def render_fleet(rollup: dict) -> str:
             f"  traces: {tr['count']} reassembled, "
             f"{tr['multi_process']} spanning >1 process, "
             f"{tr['orphan_events']} orphan event(s)")
+    stale_n = rollup["merged"].get("stale_workers", 0)
+    if stale_n:
+        lines.append(f"  {stale_n} STALE worker(s) excluded from the "
+                     "drain rate (beat age > 3x their interval)")
     lines.append(
         f"  depth = {rollup['depth'] if rollup['depth'] is not None else '-'}, "
         f"drain = {rollup['drain_rate_per_s']}/s, "

@@ -23,7 +23,7 @@ first call bundles trace+compile with execution.  Two helpers fix both:
 
 from __future__ import annotations
 
-from . import core
+from . import core, devmem
 
 # wrapper memo keyed by id(fn); the wrapper closes over fn (strong ref),
 # so the id cannot be recycled while the entry lives.  Steps from
@@ -188,9 +188,11 @@ def instrument_jit(fn, name: str, aot: bool = False):
         if compiled is fn:
             # no AOT path: the first (compiling) call was already timed
             # and executed inside _compile; later calls land here
+            win = devmem.begin_window()
             with core.span(name + ".execute"):
                 out = fn(*args, **kwargs)
                 jax.block_until_ready(out)
+            devmem.end_window(win, f"{name}:{_sig_label(key)}")
             return out
         if isinstance(compiled, tuple):  # first call's output rides along
             compiled_cache[key] = compiled[0] if compiled[0] is not None \
@@ -201,9 +203,15 @@ def instrument_jit(fn, name: str, aot: bool = False):
             # call: tracing may have been enabled AFTER the warm step
             # compiled (memoised steps outlive any one trace window)
             _record_cost_analysis(name, key, compiled, cost_memo)
+            # device-memory window (obs/devmem): the execute region is
+            # fenced, so the window's peak HBM attributes to exactly
+            # this signature — the measured footprint beside the
+            # step_bytes model (no-op on backends without memory_stats)
+            win = devmem.begin_window()
             with core.span(name + ".execute"):
                 out = compiled(*args, **kwargs)
                 jax.block_until_ready(out)
+            devmem.end_window(win, f"{name}:{_sig_label(key)}")
             return out
         except Exception:
             # AOT executables can be stricter about input placement than

@@ -28,6 +28,11 @@ COUNTERS = frozenset({
     # pipeline / driver
     "epochs_processed", "epochs_failed", "epochs_synthesized",
     "bytes_h2d", "jit_cache_miss", "prefetch_stall_s", "oom_backoff",
+    # predictive OOM avoidance (obs/devmem + driver admission): chunk
+    # rung step-downs taken BEFORE launching a chunk whose predicted
+    # peak exceeds measured headroom (the reactive oom_backoff stays
+    # the fallback)
+    "oom_predicted_avoided",
     "lm_steps", "lsq_nfev", "lsq_fits",
     # ops / cleaning / sim
     "refill_calls", "refill_pixels", "zap_calls", "zap_pixels",
@@ -53,6 +58,10 @@ COUNTERS = frozenset({
 GAUGES = frozenset({
     "queue_depth", "batch_fill_ratio", "effective_chunk",
     "compile_cache_artifact",
+    # device-memory plane (obs/devmem): summed over local devices;
+    # hbm_bytes_in_use additionally streams timestamped events per
+    # execute window (the headroom timeline)
+    "hbm_bytes_in_use", "hbm_bytes_limit",
 })
 
 # -- spans (obs.span / obs.traced) ------------------------------------------
@@ -63,6 +72,10 @@ SPANS = frozenset({
     "fit.arc", "fit.scint", "fit.lsq_numpy",
     "sim.simulation",
     "serve.poll", "serve.load", "serve.batch", "serve.compact",
+    # device-memory & profiler plane (obs/devmem, utils/timing):
+    # the --xprof jax.profiler.trace bracket and the on-OOM
+    # device_memory_profile snapshot dump
+    "devmem.xprof", "devmem.memory_profile",
 })
 
 # dynamic span-name prefixes: obs.span(f"<prefix><runtime part>") — the
@@ -94,6 +107,9 @@ FAMILIES = frozenset({
     "bucket_hits", "bucket_lanes_real", "bucket_lanes_pad",  # counters
     "queue_shard_claims",                           # counter (per shard)
     "bucket_catalog", "step_flops", "step_bytes",   # gauges
+    # measured per-signature peak HBM beside the step_bytes model
+    # (obs/devmem window attribution; key = <stage>:<B>x<grid>:<dtype>)
+    "step_hbm_peak",                                # gauge
     # per-shard queued depth beside the total queue_depth gauge (the
     # documented total+breakdown pair pattern)
     "queue_depth",                                  # gauge (per shard)

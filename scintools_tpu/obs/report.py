@@ -67,6 +67,79 @@ def load_trace_files(paths) -> tuple[list, list]:
     return events, warnings
 
 
+def parse_when(value) -> float:
+    """``--since`` argument: unix seconds, or an ISO date/datetime
+    (``2026-08-04`` / ``2026-08-04T12:30:00``), as a unix timestamp."""
+    try:
+        return float(value)
+    except (TypeError, ValueError):
+        pass
+    import datetime as dt
+
+    try:
+        return dt.datetime.fromisoformat(str(value)).timestamp()
+    except ValueError:
+        raise ValueError(f"--since: {value!r} is neither a unix "
+                         "timestamp nor an ISO date/datetime")
+
+
+def parse_duration(value) -> float:
+    """``--last`` argument: seconds, with an optional ``s``/``m``/
+    ``h``/``d`` suffix (``90``, ``15m``, ``2h``, ``1d``)."""
+    units = {"s": 1.0, "m": 60.0, "h": 3600.0, "d": 86400.0}
+    v = str(value).strip()
+    mult = 1.0
+    if v and v[-1].lower() in units:
+        mult = units[v[-1].lower()]
+        v = v[:-1]
+    try:
+        out = float(v) * mult
+    except ValueError:
+        raise ValueError(f"--last: {value!r} is not a duration "
+                         "(N[s|m|h|d])")
+    if out <= 0:
+        raise ValueError(f"--last: {value!r} must be positive")
+    return out
+
+
+def filter_events(events, since: float | None = None,
+                  last: float | None = None) -> list:
+    """Event-time filter for multi-day merged JSONL (ISSUE 12
+    satellite — every record already rides a ``ts`` stamp): keep
+    records stamped at/after ``since`` (unix seconds) and/or within
+    the trailing ``last`` seconds of the NEWEST stamped record (event
+    time, not wall clock — a report over yesterday's trace still has
+    a meaningful ``--last 1h``).  Records without a stamp are dropped
+    while a filter is active: they are unplaceable in time."""
+    if since is None and last is None:
+        return events
+    stamped = [ev for ev in events
+               if isinstance(ev.get("ts"), (int, float))]
+    cut = float(since) if since is not None else float("-inf")
+    if last is not None and stamped:
+        newest = max(ev["ts"] for ev in stamped)
+        cut = max(cut, newest - float(last))
+    return [ev for ev in stamped if ev["ts"] >= cut]
+
+
+def gauge_timeline(events, name: str, limit: int = 12,
+                   streamed_only: bool = False) -> list:
+    """(ts, value) points of a gauge's timestamped events, evenly
+    down-sampled to ``limit`` points for rendering — the ONE resampler
+    behind the queue-depth and HBM-in-use timelines.
+    ``streamed_only`` keeps only transition-stamped events (they carry
+    the writer ``pid``; flush-time latest-value gauges do not)."""
+    pts = [(ev.get("ts", 0.0), ev.get("value")) for ev in events
+           if ev.get("kind") == "gauge" and ev.get("name") == name
+           and (not streamed_only or "pid" in ev)
+           and isinstance(ev.get("value"), (int, float))]
+    pts.sort(key=lambda p: p[0])
+    if len(pts) <= limit:
+        return pts
+    step = (len(pts) - 1) / (limit - 1)
+    return [pts[round(i * step)] for i in range(limit)]
+
+
 def aggregate(events: list) -> tuple:
     """(spans, counters, gauges): spans is {name: {count, total_ms,
     mean_ms, p50_ms, p95_ms}} keyed in first-appearance order; counters
@@ -133,10 +206,8 @@ def compile_profile(counters: dict | None,
     counters = counters or {}
     gauges = gauges or {}
     stages: dict[str, dict] = {}
-    for name, value in counters.items():
-        if not (name.startswith("compile_ms[") and name.endswith("]")):
-            continue
-        label = name[len("compile_ms["):-1]
+    for label, value in bracketed_values(counters,
+                                         "compile_ms[").items():
         rest, _, mode = label.rpartition(":")
         stage, _, sig = rest.partition(":")
         if mode not in ("cold", "warm") or not stage:
@@ -170,15 +241,10 @@ def catalog_section(counters: dict | None,
     never bucketed."""
     counters = counters or {}
     gauges = gauges or {}
-
-    def _bracketed(src, prefix):
-        return {name[len(prefix):-1]: v for name, v in src.items()
-                if name.startswith(prefix) and name.endswith("]")}
-
-    hits = _bracketed(counters, "bucket_hits[")
-    real = _bracketed(counters, "bucket_lanes_real[")
-    pad = _bracketed(counters, "bucket_lanes_pad[")
-    exist = _bracketed(gauges, "bucket_catalog[")
+    hits = bracketed_values(counters, "bucket_hits[")
+    real = bracketed_values(counters, "bucket_lanes_real[")
+    pad = bracketed_values(counters, "bucket_lanes_pad[")
+    exist = bracketed_values(gauges, "bucket_catalog[")
     if not hits and not exist:
         return None
     from ..buckets import pad_waste
@@ -210,12 +276,10 @@ def measured_roofline(gauges: dict | None) -> dict | None:
     """
     gauges = gauges or {}
     rows: dict[str, dict] = {}
-    for name, value in gauges.items():
-        for prefix, field in (("step_flops[", "flops"),
-                              ("step_bytes[", "bytes")):
-            if name.startswith(prefix) and name.endswith("]"):
-                label = name[len(prefix):-1]
-                rows.setdefault(label, {})[field] = float(value)
+    for prefix, field in (("step_flops[", "flops"),
+                          ("step_bytes[", "bytes")):
+        for label, value in bracketed_values(gauges, prefix).items():
+            rows.setdefault(label, {})[field] = value
     if not rows:
         return None
     for label, row in rows.items():
@@ -253,6 +317,60 @@ def measured_roofline(gauges: dict | None) -> dict | None:
             except Exception:  # model must never sink the report
                 pass
     return rows
+
+
+def bracketed_values(src: dict, prefix: str) -> dict:
+    """``{key: float(value)}`` for every ``<family>[<key>]`` entry of a
+    counter/gauge dict — the ONE parser of the bracketed-family naming
+    convention (obs/names.py FAMILIES), shared by the report sections,
+    the fleet rollup and devmem's prediction lookup."""
+    return {name[len(prefix):-1]: float(v) for name, v in src.items()
+            if name.startswith(prefix) and name.endswith("]")
+            and isinstance(v, (int, float))}
+
+
+def devmem_section(counters: dict | None, gauges: dict | None = None,
+                   events=None) -> dict | None:
+    """Device-memory readout (obs/devmem): the HBM gauges, every
+    signature's MEASURED peak residency beside its modeled
+    ``step_bytes`` (cost-analysis) bytes, the predicted-avoided vs
+    suffered OOM counts, and — when the event stream is available —
+    the in-use/headroom timeline from the streamed ``hbm_bytes_in_use``
+    gauge stamps.  None when the plane never sampled (CPU backends:
+    ``memory_stats()`` is None and no gauge ever lands)."""
+    counters = counters or {}
+    gauges = gauges or {}
+    peaks = bracketed_values(gauges, "step_hbm_peak[")
+    in_use = gauges.get("hbm_bytes_in_use")
+    limit = gauges.get("hbm_bytes_limit")
+    avoided = int(counters.get("oom_predicted_avoided", 0))
+    if in_use is None and not peaks and not avoided:
+        return None
+    numeric = all(isinstance(v, (int, float)) for v in (in_use, limit))
+    out = {
+        "bytes_in_use": in_use, "bytes_limit": limit,
+        "headroom": (limit - in_use if numeric and limit else None),
+        "oom_predicted_avoided": avoided,
+        "oom_backoff": int(counters.get("oom_backoff", 0)),
+    }
+    model = bracketed_values(gauges, "step_bytes[")
+    sigs = {}
+    for label in sorted(peaks):
+        row = {"peak_bytes": peaks[label]}
+        if label in model:
+            row["model_bytes"] = model[label]
+            if model[label]:
+                row["peak_vs_model"] = round(peaks[label]
+                                             / model[label], 2)
+        sigs[label] = row
+    if sigs:
+        out["signatures"] = sigs
+    if events:
+        pts = gauge_timeline(events, "hbm_bytes_in_use",
+                             streamed_only=True)
+        if pts:
+            out["in_use_timeline"] = pts
+    return out
 
 
 def serve_section(counters: dict | None,
@@ -293,10 +411,8 @@ def reliability_section(counters: dict | None,
     no degradation at all — a healthy run's report stays unchanged."""
     counters = counters or {}
     gauges = gauges or {}
-    quarantined = {
-        name[len("epochs_quarantined["):-1]: int(v)
-        for name, v in counters.items()
-        if name.startswith("epochs_quarantined[") and name.endswith("]")}
+    quarantined = {k: int(v) for k, v in bracketed_values(
+        counters, "epochs_quarantined[").items()}
     out = {
         "oom_backoff": int(counters.get("oom_backoff", 0)),
         "epochs_quarantined": int(counters.get("epochs_quarantined", 0)),
@@ -315,10 +431,11 @@ def reliability_section(counters: dict | None,
 
 
 def render(spans: dict, counters: dict | None = None,
-           gauges: dict | None = None) -> str:
+           gauges: dict | None = None, events=None) -> str:
     """Fixed-width per-stage table, longest-total first, then the
-    cold/warm compile split, then the serve and reliability sections,
-    then counters."""
+    cold/warm compile split, then the serve, device-memory and
+    reliability sections, then counters.  ``events`` (optional — the
+    raw record stream) feeds the memory section's headroom timeline."""
     lines = []
     if spans:
         w = max(len("stage"), max(len(n) for n in spans))
@@ -424,6 +541,33 @@ def render(spans: dict, counters: dict | None = None,
                 lines.append("    stage split (model): " + ", ".join(
                     f"{k} {gf.get(k, 0.0):.3f} GFLOP / {v:.3f} GB"
                     for k, v in stages.items()))
+    mem = devmem_section(counters, gauges, events)
+    if mem:
+        def _gib(v):
+            return (f"{v / 2**30:.3f} GiB"
+                    if isinstance(v, (int, float)) else "-")
+
+        lines.append("")
+        lines.append("device memory (measured HBM, obs/devmem):")
+        if mem["bytes_in_use"] is not None:
+            lines.append(
+                f"  in_use = {_gib(mem['bytes_in_use'])}, limit = "
+                f"{_gib(mem['bytes_limit'])}, headroom = "
+                f"{_gib(mem['headroom'])}")
+        for label, row in mem.get("signatures", {}).items():
+            part = f"  {label}: peak = {_gib(row['peak_bytes'])}"
+            if "model_bytes" in row:
+                part += f", model = {_gib(row['model_bytes'])}"
+                if "peak_vs_model" in row:
+                    part += f" [peak/model x{row['peak_vs_model']}]"
+            lines.append(part)
+        lines.append(
+            f"  oom_predicted_avoided = {mem['oom_predicted_avoided']}"
+            f", oom_backoff (reactive) = {mem['oom_backoff']}")
+        tl = mem.get("in_use_timeline")
+        if tl:
+            lines.append("  hbm_bytes_in_use timeline: "
+                         + " ".join(f"{int(v)}" for _, v in tl))
     serve = serve_section(counters, gauges)
     if serve:
         lines.append("")
@@ -475,17 +619,26 @@ def render(spans: dict, counters: dict | None = None,
 
 def report(path: str) -> str:
     """The ``trace report`` payload for one JSONL trace file."""
-    spans, counters, gauges = aggregate(load_events(path))
-    return render(spans, counters, gauges)
+    events = load_events(path)
+    spans, counters, gauges = aggregate(events)
+    return render(spans, counters, gauges, events)
 
 
-def report_many(paths) -> tuple[str, list]:
+def report_many(paths, since: float | None = None,
+                last: float | None = None) -> tuple[str, list]:
     """The multi-file/glob ``trace report`` payload: one merged table
     over every matched trace, plus the degradation warnings.  Raises
     OSError only when NOTHING was readable (one bad path among many
-    degrades to a warning)."""
+    degrades to a warning).  ``since``/``last`` apply the event-time
+    filters (:func:`filter_events`) before aggregation, so a multi-day
+    merged file reports only the asked-for window."""
     events, warnings = load_trace_files(paths)
     if not events and warnings:
         raise OSError("; ".join(warnings))
+    total = len(events)
+    events = filter_events(events, since=since, last=last)
+    if total and not events:
+        warnings.append(f"time filter dropped all {total} record(s) "
+                        "(nothing stamped inside the window)")
     spans, counters, gauges = aggregate(events)
-    return render(spans, counters, gauges), warnings
+    return render(spans, counters, gauges, events), warnings

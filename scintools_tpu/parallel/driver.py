@@ -35,6 +35,7 @@ from typing import Any
 import numpy as np
 
 from .. import faults, obs
+from ..utils.timing import trace_annotation
 from ..fit.arc_fit import make_arc_fitter
 from ..fit.scint_fit import fit_scint_params_batch
 from ..ops.acf import acf as acf_op
@@ -981,9 +982,16 @@ def run_pipeline(epochs=None, config: PipelineConfig = PipelineConfig(),
                     else _bucket_epochs(epochs).values())
     results = []
     with obs.span("pipeline.run", epochs=n_total):
+        # survey-start memory sample (obs/devmem): the HBM gauges and
+        # one streamed timeline stamp exist even before the first
+        # instrumented execute window — a trace of a run that OOMs in
+        # staging still shows where memory stood.  No-op when
+        # untraced or on backends without memory_stats().
+        obs.devmem.sample(stream=True)
         for idx in buckets_iter:
             eff_pad_to, eff_chunk, eff_pad_chunks = pad_to, chunk, pad_chunks
-            with obs.span("pipeline.stage", epochs=len(idx)) as stage_sp:
+            with obs.span("pipeline.stage", epochs=len(idx)) as stage_sp, \
+                    trace_annotation("pipeline.stage"):
                 if synthetic is not None:
                     # the staged batch is the key array: pad it to the
                     # mesh multiple by repeating the last row (a
@@ -1131,15 +1139,18 @@ def run_pipeline(epochs=None, config: PipelineConfig = PipelineConfig(),
                 # chaos site: a deterministic RESOURCE_EXHAUSTED here
                 # drives the chunked path's OOM-adaptive backoff
                 faults.check("driver.chunk_execute")
-                fn = _aot.get(int(x.shape[0]))
-                if fn is None:
-                    return _step(x)
-                if isinstance(x, np.ndarray):
-                    # a deserialized export needs correctly-placed
-                    # inputs (it has no in_shardings to do it)
-                    x = _as_global_batch(x, mesh, chan_sharded,
-                                         commit=True)
-                return fn(x)
+                # labeled device timeline: the xprof trace (--xprof)
+                # shows each step dispatch as a named region
+                with trace_annotation("pipeline.step"):
+                    fn = _aot.get(int(x.shape[0]))
+                    if fn is None:
+                        return _step(x)
+                    if isinstance(x, np.ndarray):
+                        # a deserialized export needs correctly-placed
+                        # inputs (it has no in_shardings to do it)
+                        x = _as_global_batch(x, mesh, chan_sharded,
+                                             commit=True)
+                    return fn(x)
 
             if c is None:
                 res = dispatch(_as_global_batch(dyn, mesh, chan_sharded))
@@ -1148,10 +1159,90 @@ def run_pipeline(epochs=None, config: PipelineConfig = PipelineConfig(),
                     dispatch, dyn, B, c, multiple, mesh, chan_sharded,
                     async_exec, execute_chunks)
                 res = _concat_results(parts)
-            with obs.span("pipeline.gather", epochs=len(idx)):
+            with obs.span("pipeline.gather", epochs=len(idx)), \
+                    trace_annotation("pipeline.gather"):
                 results.append((np.asarray(idx),
                                 _take_lanes(res, len(idx), B)))
     return results
+
+
+def _admit_chunk(dyn, c: int, multiple: int) -> int:
+    """Predictive OOM avoidance (ISSUE 12): before launching a chunk
+    round, compare the signature's predicted peak HBM against its
+    measured budget and step the chunk DOWN — halved, mesh-floored,
+    the same rule as the reactive backoff, so bucket-ladder rungs step
+    onto rungs — until the prediction fits.  Prediction trust order
+    (:func:`obs.devmem.predicted_peak`): an exact recorded
+    execute-window peak, a batch-scaled one, the ``step_bytes``
+    cost-analysis model, a lower-bound window estimate; a
+    never-profiled signature falls back to the chunk's own staged
+    input bytes (a chunk cannot run without its input resident).
+    Absolute sources (recorded peaks) compare against ``bytes_limit``;
+    incremental ones (model, input bytes) against live headroom.  Each
+    step-down counts ``oom_predicted_avoided`` and re-points
+    ``effective_chunk``; the reactive ``RESOURCE_EXHAUSTED`` halving
+    stays the fallback for a prediction that was wrong.
+
+    The ``driver.admit_chunk`` chaos site (kind="oom") forces a
+    marginal-headroom reading — each fire takes exactly ONE predictive
+    step-down — so tier-1 proves the path without a real OOM, with
+    results byte-identical (chunking only partitions the batch axis).
+    On backends without ``memory_stats()`` (CPU) headroom is None and
+    admission is a no-op."""
+    from ..obs import devmem
+    from ..utils.log import get_logger, log_event
+
+    def step_down(cur: int, pred, budget) -> int:
+        new_c = _adjust_chunk(multiple, max(cur // 2, 1))
+        if new_c >= cur:
+            return cur       # at the floor: launch and let the
+        #                      reactive backoff be the judge
+        obs.inc("oom_predicted_avoided")
+        obs.gauge("effective_chunk", new_c)
+        log_event(get_logger(), "oom_predicted_avoided", chunk=cur,
+                  new_chunk=new_c, predicted_bytes=round(pred[0]),
+                  predicted_source=pred[1], budget_bytes=round(budget))
+        return new_c
+
+    def predict(cur: int):
+        pred = devmem.predicted_peak("pipeline.step", cur,
+                                     tuple(int(s)
+                                           for s in dyn.shape[1:]))
+        if pred is None:
+            # residency lower bound: the chunk's own staged input
+            pred = (float(transfer_nbytes(dyn[:cur])), "input-bytes")
+        return pred
+
+    try:
+        faults.check("driver.admit_chunk")
+    except Exception as e:
+        if not faults.is_oom_error(e):
+            raise
+        # injected marginal-headroom reading: one step-down per fire
+        return step_down(c, predict(c), 0.0)
+    snap = devmem.snapshot()
+    if snap is None or not snap["bytes_limit"]:
+        return c
+    limit = float(snap["bytes_limit"])
+    headroom = limit - float(snap["bytes_in_use"])
+    while c > multiple:
+        pred = predict(c)
+        # unit discipline: measured window peaks are ABSOLUTE residency
+        # totals (ambient allocations included when they were read) —
+        # compare those against the limit; the model / input-bytes
+        # sources count only what the chunk ADDS — compare against
+        # headroom.  Mixing them double-counts the already-resident
+        # bytes and would spuriously shrink any pipeline whose working
+        # set passes half of HBM.
+        budget = (limit if pred[1] in devmem.ABSOLUTE_PEAK_SOURCES
+                  else headroom)
+        if pred[0] <= budget:
+            break
+        new_c = step_down(c, pred, budget)
+        if new_c >= c:
+            break
+        c = new_c
+    return c
 
 
 def _run_chunked_adaptive(dispatch, dyn, B: int, chunk: int,
@@ -1187,6 +1278,10 @@ def _run_chunked_adaptive(dispatch, dyn, B: int, chunk: int,
     parts: list = []
     pos, c = 0, chunk
     while pos < B:
+        # predictive admission (ISSUE 12): step the chunk rung down
+        # BEFORE launching anything the measured headroom says would
+        # OOM; the reactive halving below stays the fallback
+        c = _admit_chunk(dyn, c, multiple)
         starts = list(range(pos, B, c))
 
         def stage_chunk(k, _dyn=dyn, _starts=starts, _c=c):

@@ -28,8 +28,10 @@ import time
 
 from .. import faults, obs
 from ..health import PreflightError
+from ..obs import devmem
 from ..obs.fleet import FLIGHT_DIRNAME, HEARTBEAT_DIRNAME, HeartbeatWriter
 from ..utils.log import get_logger, log_event
+from ..utils.timing import trace_annotation
 from .batcher import Batch, DynamicBatcher
 from .queue import JobQueue
 
@@ -203,6 +205,9 @@ class ServeWorker:
         # are whatever the obs registry holds (empty when untraced;
         # pid/last-claim liveness works regardless).
         self._last_claim_at: float | None = None
+        # single-shot flight-dump latch: a SIGTERM handler that dumps
+        # and then raises must not dump AGAIN from the crash handler
+        self._flight_dumped = False
         self.heartbeat = (HeartbeatWriter(
             os.path.join(queue.dir, HEARTBEAT_DIRNAME), self.worker_id,
             interval_s=heartbeat_s) if heartbeat_s and heartbeat_s > 0
@@ -378,8 +383,11 @@ class ServeWorker:
                 # chaos site: an infra fault mid-batch (device
                 # preemption, OOM past the driver's backoff floor)
                 faults.check("worker.batch_execute")
-                rows = self.runner(batch, pad, self.mesh,
-                                   self.async_exec)
+                # labeled device timeline: an --xprof capture shows
+                # each served batch as a named region
+                with trace_annotation("serve.batch"):
+                    rows = self.runner(batch, pad, self.mesh,
+                                       self.async_exec)
         except Exception as e:
             if faults.classify_error(e) == "transient":
                 # infrastructure fault: EVERY member requeues without
@@ -539,6 +547,71 @@ class ServeWorker:
         obs.inc("jobs_done")
         log_event(self.log, "compact_done", job=job.id, **stats)
 
+    # -- flight recorder + signal diagnostics ------------------------------
+    def _dump_flight(self, error: str, classification: str | None = None,
+                     extra: dict | None = None) -> str | None:
+        """Single-shot guarded flight dump: the obs event ring + a
+        classified header land beside the queue exactly ONCE per
+        worker life (a SIGTERM handler that dumps and then raises must
+        not dump again from the crash handler).  The dump itself is
+        guarded — crashes correlate with exactly the IO failures
+        (deleted queue dir, full disk) that would make the dump raise,
+        and the recorder must never REPLACE the error it explains."""
+        if self._flight_dumped:
+            return None
+        self._flight_dumped = True
+        try:
+            return obs.dump_flight(
+                os.path.join(self.queue.dir, FLIGHT_DIRNAME),
+                error=error, classification=classification,
+                extra={"worker": self.worker_id,
+                       "stats": dict(self.stats), **(extra or {})})
+        except Exception as dump_err:  # fault-ok: recorder only
+            return f"flight dump failed: {dump_err!r}"
+
+    def _install_signal_dump(self):
+        """Dump a flight record on SIGTERM/SIGINT too (ISSUE 12
+        satellite): a politely stopped worker must leave the same
+        diagnostics as a crashed one — graceful drains are where
+        operators look FIRST when a fleet misbehaves.  The handler
+        dumps once (the latch guards signal-then-raise double dumps)
+        and then takes the polite exit: KeyboardInterrupt for SIGINT
+        (the CLI's existing graceful path), SystemExit(128+sig) for
+        SIGTERM, so ``finally`` blocks (final heartbeat) still run.
+        Returns a restore callable; degrades to a no-op off the main
+        thread, where ``signal.signal`` is unavailable."""
+        import signal as signal_mod
+
+        previous: dict = {}
+
+        def handler(signum, frame):
+            name = signal_mod.Signals(signum).name
+            path = self._dump_flight(f"signal: {name}",
+                                     classification="signal")
+            if path is not None:
+                log_event(self.log, "worker_signal",
+                          worker=self.worker_id, signal=name,
+                          flight=path)
+            if signum == signal_mod.SIGINT:
+                raise KeyboardInterrupt
+            raise SystemExit(128 + signum)
+
+        try:
+            for sig in (signal_mod.SIGTERM, signal_mod.SIGINT):
+                previous[sig] = signal_mod.signal(sig, handler)
+        except ValueError:  # fault-ok: not the main thread
+            for sig, prev in previous.items():
+                signal_mod.signal(sig, prev)
+            return lambda: None
+
+        def restore():
+            for sig, prev in previous.items():
+                try:
+                    signal_mod.signal(sig, prev)
+                except ValueError:  # fault-ok: thread moved under us
+                    pass
+        return restore
+
     # -- the resident loop -------------------------------------------------
     def run(self, max_batches: int | None = None,
             exit_on_drain: bool = True,
@@ -551,6 +624,7 @@ class ServeWorker:
                   batch=self.batch_size, max_wait_s=self.max_wait_s,
                   lease_s=self.lease_s, queue=self.queue.dir)
         idle_since = None
+        restore_signals = self._install_signal_dump()
         try:
             while True:
                 self._beat()
@@ -590,24 +664,26 @@ class ServeWorker:
             # resident loop (per-job failures never reach here) dumps
             # the obs event ring buffer + a classified header next to
             # the queue, so the fleet rollup can read the dead
-            # worker's last moments; the error still propagates.  The
-            # dump itself is guarded — crashes correlate with exactly
-            # the IO failures (deleted queue dir, full disk) that
-            # would make the dump raise, and the recorder must never
-            # REPLACE the exception it exists to explain.
-            try:
-                path = obs.dump_flight(
+            # worker's last moments; the error still propagates.  An
+            # OOM crash additionally attaches a device-memory profile
+            # snapshot (obs/devmem.memory_profile_dump — the live
+            # HBM buffers at death, pprof-loadable), the answer to
+            # "what was resident when it died".
+            extra = {}
+            if faults.is_oom_error(e):
+                mp = devmem.memory_profile_dump(
                     os.path.join(self.queue.dir, FLIGHT_DIRNAME),
-                    error=repr(e),
-                    classification=faults.classify_error(e),
-                    extra={"worker": self.worker_id,
-                           "stats": dict(self.stats)})
-            except Exception as dump_err:  # fault-ok: recorder only
-                path = f"flight dump failed: {dump_err!r}"
+                    tag="oom")
+                if mp is not None:
+                    extra["memory_profile"] = mp
+            path = self._dump_flight(
+                repr(e), classification=faults.classify_error(e),
+                extra=extra)
             log_event(self.log, "worker_crash", worker=self.worker_id,
                       error=repr(e), flight=path)
             raise
         finally:
+            restore_signals()
             self._beat(force=True)
         log_event(self.log, "serve_exit", worker=self.worker_id,
                   **self.stats)

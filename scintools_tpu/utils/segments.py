@@ -84,6 +84,12 @@ OPEN_GRACE_S = 300.0
 # well under a second, so a dead-looking .open younger than this is
 # plausibly a remote writer mid-append — leave it for the next pass
 OPEN_SALVAGE_MIN_AGE_S = 5.0
+# how old a memoised directory mtime must be before an equal re-stat
+# is trusted as "nothing changed" (the racy-stat guard in
+# SegmentStore.refresh): one second covers every filesystem timestamp
+# granularity in practice (the same bound git's racily-clean index
+# rule assumes)
+_MTIME_SETTLE_NS = 1_000_000_000
 
 
 class SegmentError(ValueError):
@@ -397,6 +403,11 @@ class SegmentStore:
         self._segments: list[Segment] = []   # newest first
         self._names: set[str] = set()
         self._mtime: int | None = None
+        # when the memoised scan was TAKEN (wall clock): the mtime
+        # gate below only trusts an equal re-stat when the scan
+        # postdates the mtime tick by more than the settle window —
+        # a scan racing the tick could have missed a same-tick seal
+        self._scan_ns: int | None = None
         self._handles: dict[str, object] = {}
         # union of every indexed segment's keys: the O(1) membership
         # probe under the write path's per-row dedup check (a bloom
@@ -415,9 +426,21 @@ class SegmentStore:
         except OSError:
             self._segments, self._names = [], set()
             self._mtime = None
+            self._scan_ns = None
             self._close_handles()
             return
-        if not force and mtime == self._mtime:
+        # racy-stat guard (git's racily-clean-index rule): an unchanged
+        # mtime only proves nothing changed when the memoised SCAN was
+        # taken more than one timestamp-granularity window AFTER the
+        # mtime tick — a scan racing the tick could have missed a
+        # same-tick seal(), which would otherwise stay invisible to
+        # every gated read until some later write moved the directory
+        # clock (observed as a tier-1 flake on coarse-mtime runtimes).
+        # A racy scan rescans; the rescan re-stamps _scan_ns, so the
+        # gate re-closes one settled read later.
+        if (not force and mtime == self._mtime
+                and self._scan_ns is not None
+                and self._scan_ns - mtime > _MTIME_SETTLE_NS):
             return
         self._salvage_dead_open()
         try:
@@ -451,8 +474,10 @@ class SegmentStore:
                            for s in self._segments}
         try:
             self._mtime = os.stat(self.dir).st_mtime_ns
+            self._scan_ns = time.time_ns()
         except OSError:
             self._mtime = None
+            self._scan_ns = None
 
     def _salvage_dead_open(self) -> None:
         try:

@@ -104,3 +104,20 @@ def profile_trace(logdir: str):
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+def xprof_bracket(logdir: str | None):
+    """The shared ``--xprof DIR`` implementation (CLI process/serve and
+    bench): :func:`profile_trace` around the measure window, recorded
+    as a ``devmem.xprof`` obs span so the capture window itself shows
+    in ``trace report``.  nullcontext when ``logdir`` is falsy."""
+    if not logdir:
+        return contextlib.nullcontext()
+    from .. import obs
+
+    @contextlib.contextmanager
+    def bracket():
+        with obs.span("devmem.xprof", dir=logdir):
+            with profile_trace(logdir):
+                yield
+    return bracket()

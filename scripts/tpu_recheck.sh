@@ -142,6 +142,28 @@ assert h2d == 4 * 2 * 4, ('bytes_h2d is not keys-only', h2d)
 print('synthetic generate->analyse on chip ok; bytes_h2d =', h2d)
 "
 
+DEVMEM_CODE="
+import numpy as np
+from scintools_tpu import obs
+from scintools_tpu.obs import devmem
+from scintools_tpu.parallel import PipelineConfig, run_pipeline
+from scintools_tpu.sim import SynthSpec
+obs.enable()
+spec = SynthSpec(kind='arc', n_epochs=4, nf=64, nt=64, dt=10.0)
+run_pipeline(config=PipelineConfig(lamsteps=True), synthetic=spec)
+g = obs.get_registry().gauges()
+assert g.get('hbm_bytes_in_use', 0) > 0, ('hbm gauges missing', g)
+assert g.get('hbm_bytes_limit', 0) > 0, ('hbm limit missing', g)
+peaks = {k: v for k, v in g.items() if k.startswith('step_hbm_peak[')}
+assert peaks, ('no step_hbm_peak recorded', sorted(g))
+# the fenced step cannot run below its own residency: the measured
+# peak must cover at least the generated dynspec batch (4x64x64 f32)
+model_floor = 4 * 64 * 64 * 4
+assert max(peaks.values()) >= model_floor, (peaks, model_floor)
+print('devmem plane on chip ok:', {k: int(v) for k, v in peaks.items()},
+      'in_use =', int(g['hbm_bytes_in_use']))
+"
+
 NUDFT_CODE="
 import numpy as np, jax, jax.numpy as jnp
 from scintools_tpu.ops.nudft import _nudft_numpy, _r_grid, nudft
@@ -234,6 +256,14 @@ echo "== synthetic lane: on-device generate->analyse + zero-H2D =="
 # and runs on real silicon AND that the staged traffic is keys-only
 # (the bytes_h2d counter asserts O(keys), independent of nf x nt)
 gated "synthetic lane check" 600 2 python -u -c "$SYNTH_CODE"
+
+echo "== devmem plane: HBM gauges + per-signature peak on chip =="
+# the device-memory plane (obs/devmem, ISSUE 12): CPU CI only proves
+# the degraded no-op path (memory_stats() is None there), so this
+# sub-minute gate is where the live plane is validated — gauges
+# nonzero and the measured per-signature peak at least the staged
+# batch's model bytes
+gated "devmem plane check" 600 2 python -u -c "$DEVMEM_CODE"
 
 echo "== nudft einsum on-chip accuracy (bf16-lowering guard) =="
 # the round-4 A/B caught the vmapped einsum NUDFT silently lowering to

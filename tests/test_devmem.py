@@ -1,0 +1,534 @@
+"""Device memory & profiler plane (ISSUE 12): HBM gauges +
+per-signature peak attribution (obs/devmem), predictive OOM avoidance
+in the chunked driver, the on-OOM memory-profile snapshot, the
+SIGTERM/SIGINT flight dump, the ``trace report`` memory section and
+``--since``/``--last`` event-time filters, and the CPU degradation
+contract (``memory_stats() is None`` => bit-identical no-op)."""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from synth import synth_arc_epoch
+
+from scintools_tpu import faults, obs
+from scintools_tpu.faults import FaultSpec
+from scintools_tpu.io.psrflux import write_psrflux
+from scintools_tpu.obs import devmem
+from scintools_tpu.parallel import PipelineConfig, run_pipeline
+from scintools_tpu.serve import JobQueue, ServeWorker, SurveyClient
+from scintools_tpu.serve.worker import load_epoch
+from scintools_tpu.sim import SynthSpec
+
+OPTS = {"lamsteps": True, "arc_numsteps": 96, "lm_steps": 3}
+PCFG = PipelineConfig(arc_numsteps=96, lm_steps=3)
+SPEC = SynthSpec(kind="arc", n_epochs=2, nf=32, nt=32, dt=10.0)
+SCFG = PipelineConfig(lamsteps=True, arc_numsteps=96, lm_steps=3)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """obs, faults and devmem are process-global; start/end clean."""
+    obs.disable(flush=False)
+    obs.reset()
+    devmem.reset()
+    faults.clear()
+    yield
+    obs.disable(flush=False)
+    obs.reset()
+    devmem.reset()
+    faults.clear()
+
+
+def _fake_devmem(monkeypatch, in_use=100, peak=100, limit=1000):
+    """Install a fake per-device memory_stats provider; returns the
+    mutable state dict so tests drive the readings."""
+    state = {"in_use": in_use, "peak": peak, "limit": limit}
+    devmem.reset()
+    monkeypatch.setattr(
+        devmem, "_device_stats",
+        lambda: [{"bytes_in_use": state["in_use"],
+                  "peak_bytes_in_use": state["peak"],
+                  "bytes_limit": state["limit"]}])
+    return state
+
+
+def _write_epochs(tmp_path, seeds):
+    files = []
+    for s in seeds:
+        fn = str(tmp_path / f"epoch_{s:02d}.dynspec")
+        write_psrflux(synth_arc_epoch(nf=32, nt=32, seed=s), fn)
+        files.append(fn)
+    return files
+
+
+def _stub_runner():
+    def run(batch, batch_size, mesh, async_exec):
+        return [{"name": os.path.basename(j.file), "mjd": e.mjd,
+                 "freq": e.freq, "bw": e.bw, "tobs": e.tobs, "dt": e.dt,
+                 "df": e.df, "tau": 1.5, "tauerr": 0.1}
+                for j, e in zip(batch.jobs, batch.epochs)]
+    return run
+
+
+# ---------------------------------------------------------------------------
+# degradation: CPU backend (memory_stats() is None) is a bit-identical no-op
+# ---------------------------------------------------------------------------
+
+
+def test_cpu_backend_degrades_to_noop_bit_identical():
+    """The acceptance's degradation half: on a backend whose
+    memory_stats() is None, the plane probes once, memoises the
+    negative, publishes NOTHING, and pipeline output is bit-identical
+    with the plane's hooks live (traced) vs entirely off."""
+    (_, r_off), = run_pipeline(config=SCFG, synthetic=SPEC)
+    with obs.tracing() as reg:
+        (_, r_on), = run_pipeline(config=SCFG, synthetic=SPEC)
+        g = reg.gauges()
+    assert devmem.available() is False          # probed and memoised
+    assert devmem.snapshot() is None
+    assert devmem.headroom() is None
+    assert devmem.begin_window() is None
+    assert not any(k.startswith(("hbm_", "step_hbm_peak[")) for k in g), g
+    for a, b in ((r_off.arc.eta, r_on.arc.eta),
+                 (r_off.scint.tau, r_on.scint.tau)):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes()
+
+
+# ---------------------------------------------------------------------------
+# window attribution: exact (reset / high-water) vs lower-bound estimate
+# ---------------------------------------------------------------------------
+
+
+def test_window_exact_when_high_water_mark_rises(monkeypatch):
+    state = _fake_devmem(monkeypatch)
+    with obs.tracing() as reg:
+        win = devmem.begin_window()
+        state["in_use"], state["peak"] = 300, 700   # window raised it
+        peak = devmem.end_window(win, "pipeline.step:8x64x64:float32")
+        g = reg.gauges()
+    assert peak == 700
+    rec = devmem.recorded_peaks()["pipeline.step:8x64x64:float32"]
+    assert rec == {"bytes": 700.0, "estimated": False}
+    assert g["step_hbm_peak[pipeline.step:8x64x64:float32]"] == 700.0
+    assert g["hbm_bytes_in_use"] == 300 and g["hbm_bytes_limit"] == 1000
+
+
+def test_window_estimate_under_old_peak_and_measured_wins(monkeypatch):
+    """No reset + window under the process high-water mark => the
+    fenced residency lands as a LOWER-BOUND estimate (the documented
+    fencing caveat); a later EXACT measurement replaces it even when
+    numerically smaller."""
+    state = _fake_devmem(monkeypatch, in_use=100, peak=1000)
+    label = "pipeline.step:4x32x32:float32"
+    with obs.tracing():
+        win = devmem.begin_window()
+        state["in_use"] = 700                       # peak stays 1000
+        assert devmem.end_window(win, label) == 700
+        assert devmem.recorded_peaks()[label] == {"bytes": 700.0,
+                                                  "estimated": True}
+        # a bigger estimate updates an estimate
+        win = devmem.begin_window()
+        state["in_use"] = 800
+        devmem.end_window(win, label)
+        assert devmem.recorded_peaks()[label]["bytes"] == 800.0
+        # a floor estimate predicts LAST (after the model) and as an
+        # absolute source — never disguised as "measured"
+        assert devmem.predicted_peak("pipeline.step", 4, (32, 32),
+                                     gauges={}) \
+            == (800.0, "estimated-floor")
+        assert devmem.predicted_peak(
+            "pipeline.step", 4, (32, 32),
+            gauges={"step_bytes[pipeline.step:4x32x32:f32]": 123.0}) \
+            == (123.0, "model")
+        assert "estimated-floor" in devmem.ABSOLUTE_PEAK_SOURCES
+        # exact measurement via a reset hook replaces the estimate,
+        # even though it is SMALLER (an estimate is only a floor)
+        monkeypatch.setattr(devmem, "_RESET_HOOK",
+                            lambda: state.update(peak=state["in_use"])
+                            or True)
+        devmem._RESET_SUPPORTED = None              # re-probe the hook
+        win = devmem.begin_window()
+        state["in_use"], state["peak"] = 200, 500
+        devmem.end_window(win, label)
+        assert devmem.recorded_peaks()[label] == {"bytes": 500.0,
+                                                  "estimated": False}
+        # ...and an estimate can never overwrite an exact record
+        monkeypatch.setattr(devmem, "_RESET_HOOK", lambda: False)
+        devmem._RESET_SUPPORTED = None
+        state["peak"] = 2000                        # high-water from. . .
+        win = devmem.begin_window()                 # . . .someone else
+        state["in_use"] = 1900
+        devmem.end_window(win, label)
+        assert devmem.recorded_peaks()[label] == {"bytes": 500.0,
+                                                  "estimated": False}
+
+
+def test_pipeline_records_step_peak_with_fake_provider(monkeypatch):
+    """The instrument_jit integration: a traced pipeline on a
+    stats-reporting backend lands a step_hbm_peak[...] gauge for the
+    executed signature plus the HBM gauges."""
+    _fake_devmem(monkeypatch, in_use=777, peak=777, limit=10 ** 9)
+    with obs.tracing() as reg:
+        run_pipeline(config=SCFG, synthetic=SPEC)
+        g = reg.gauges()
+    peaks = {k: v for k, v in g.items()
+             if k.startswith("step_hbm_peak[")}
+    assert peaks, sorted(g)
+    assert any(k.startswith("step_hbm_peak[pipeline.step:")
+               for k in peaks)
+    assert all(v == 777.0 for v in peaks.values())
+    assert g["hbm_bytes_in_use"] == 777
+
+
+# ---------------------------------------------------------------------------
+# prediction + admission
+# ---------------------------------------------------------------------------
+
+
+def test_predicted_peak_precedence_and_scaling(monkeypatch):
+    state = _fake_devmem(monkeypatch)         # pre-window in_use = 100
+    label = "pipeline.step:8x64x64:float32"
+    with obs.tracing():
+        win = devmem.begin_window()
+        state["in_use"], state["peak"] = 300, 700
+        devmem.end_window(win, label)
+    # measured beats everything (absolute total); the batch-scaled
+    # tier scales the window DELTA (700 - 100 = 600), not the
+    # absolute peak — ambient residency must not multiply with the
+    # batch — and reads as an incremental source
+    assert devmem.predicted_peak("pipeline.step", 8, (64, 64)) \
+        == (700.0, "measured")
+    assert devmem.predicted_peak("pipeline.step", 4, (64, 64)) \
+        == (300.0, "measured-scaled")
+    assert "measured-scaled" not in devmem.ABSOLUTE_PEAK_SOURCES
+    # model fallback for a never-run grid (gauges injectable)
+    gauges = {"step_bytes[pipeline.step:8x128x128:float32]": 4000.0}
+    assert devmem.predicted_peak("pipeline.step", 8, (128, 128),
+                                 gauges=gauges) == (4000.0, "model")
+    assert devmem.predicted_peak("pipeline.step", 2, (128, 128),
+                                 gauges=gauges) == (1000.0,
+                                                    "model-scaled")
+    assert devmem.predicted_peak("pipeline.step", 8, (32, 32),
+                                 gauges={}) is None
+
+
+def test_admit_chunk_steps_down_until_prediction_fits(monkeypatch):
+    """The predictive admission rule in isolation: a recorded peak
+    over its budget steps the chunk down (halved, floored) until the
+    batch-scaled prediction fits, counting each step — with the unit
+    discipline: ABSOLUTE sources (recorded peaks) compare against the
+    limit, INCREMENTAL ones (model/input bytes) against headroom."""
+    from scintools_tpu.parallel.driver import _admit_chunk
+
+    _fake_devmem(monkeypatch, in_use=0, peak=0, limit=1000)
+    devmem._PEAKS["pipeline.step:4x32x32:float64"] = 1600.0
+    devmem._DELTAS["pipeline.step:4x32x32:float64"] = 1600.0
+    dyn = np.zeros((8, 32, 32))
+    with obs.tracing() as reg:
+        c = _admit_chunk(dyn, 4, 1)
+        counters = obs.counters()
+        g = reg.gauges()
+    assert c == 2             # 1600 > limit 1000; delta-scaled 800 fits
+    assert counters["oom_predicted_avoided"] == 1
+    assert g["effective_chunk"] == 2
+    # plenty of headroom: admitted unchanged, nothing counted
+    obs.reset()
+    _fake_devmem(monkeypatch, in_use=0, peak=0, limit=10 ** 9)
+    devmem._PEAKS["pipeline.step:4x32x32:float64"] = 1600.0
+    with obs.tracing():
+        assert _admit_chunk(dyn, 4, 1) == 4
+        assert "oom_predicted_avoided" not in obs.counters()
+    # ABSOLUTE measured peak compares against the LIMIT, not headroom:
+    # a steady-state pipeline holding 600 of 1000 bytes whose recorded
+    # peak is 800 must NOT step down (800 <= limit 1000, even though
+    # headroom is only 400 — the peak already includes resident bytes)
+    obs.reset()
+    devmem.reset()
+    _fake_devmem(monkeypatch, in_use=600, peak=600, limit=1000)
+    devmem._PEAKS["pipeline.step:4x32x32:float64"] = 800.0
+    with obs.tracing():
+        assert _admit_chunk(dyn, 4, 1) == 4
+        assert "oom_predicted_avoided" not in obs.counters()
+    # ...while the INCREMENTAL model source compares against headroom:
+    # model 800 > headroom 400 -> step down; scaled 400 fits
+    obs.reset()
+    devmem.reset()
+    _fake_devmem(monkeypatch, in_use=600, peak=600, limit=1000)
+    with obs.tracing():
+        obs.gauge("step_bytes[pipeline.step:4x32x32:float64]", 800.0)
+        assert _admit_chunk(dyn, 4, 1) == 2
+        assert obs.counters()["oom_predicted_avoided"] == 1
+
+
+def _survey_csv(files, tmp_path, tag, chunk=4):
+    """run_pipeline -> content-keyed store -> CSV (the serve/CLI row
+    path in miniature), chunked — mirrors tests/test_faults.py."""
+    from scintools_tpu.io.results import (batch_lane_row, results_row,
+                                          row_fit_values)
+    from scintools_tpu.serve import job_key
+    from scintools_tpu.utils.store import ResultsStore
+
+    epochs = [load_epoch(f) for f in files]
+    store = ResultsStore(str(tmp_path / f"store_{tag}"))
+    buckets = run_pipeline(epochs, PCFG, chunk=chunk)
+    for idx, res in buckets:
+        for lane, i in enumerate(idx):
+            row = results_row(epochs[i])
+            row.update(batch_lane_row(res, lane, PCFG.lamsteps))
+            fitvals = row_fit_values(row)
+            if fitvals and not np.all(np.isfinite(fitvals)):
+                continue
+            row["name"] = os.path.basename(files[i])
+            store.put(job_key(files[i], OPTS), row)
+    out = str(tmp_path / f"{tag}.csv")
+    store.export_csv(out)
+    with open(out) as fh:
+        return fh.read()
+
+
+@pytest.mark.chaos
+def test_forced_low_headroom_avoids_oom_csv_identical(tmp_path):
+    """THE acceptance: a chaos-forced marginal-headroom reading
+    (driver.admit_chunk, no real OOM) steps the chunk rung down BEFORE
+    launch, increments oom_predicted_avoided, and the survey CSV is
+    byte-identical to the unconstrained run."""
+    files = _write_epochs(tmp_path, (1, 2, 4, 5, 7, 8))
+    clean = _survey_csv(files, tmp_path, "clean")
+    obs.disable(flush=False)
+    obs.reset()
+    trace = str(tmp_path / "chaos.jsonl")
+    with obs.tracing(jsonl=trace):
+        with faults.injected("driver.admit_chunk",
+                             FaultSpec(kind="oom")):
+            forced = _survey_csv(files, tmp_path, "forced")
+        c = obs.counters()
+        g = obs.get_registry().gauges()
+    assert forced == clean
+    assert forced.count("\n") == len(files) + 1
+    # one fire = one predictive step-down: 4 -> 2, nothing ever threw
+    assert c.get("oom_predicted_avoided") == 1, c
+    assert c.get("faults_injected[driver.admit_chunk]") == 1
+    assert c.get("oom_backoff") is None
+    assert g.get("effective_chunk") == 2
+    # and the memory section reports the avoidance
+    text = obs.report(trace)
+    assert "device memory (measured HBM" in text
+    assert "oom_predicted_avoided = 1" in text
+
+
+def test_env_chaos_site_parses():
+    """driver.admit_chunk is a KNOWN site: the env grammar arms it."""
+    specs = faults.parse_env("driver.admit_chunk:oom@1")
+    assert set(specs) == {"driver.admit_chunk"}
+
+
+# ---------------------------------------------------------------------------
+# trace report: memory section + event-time filters
+# ---------------------------------------------------------------------------
+
+
+def test_trace_report_memory_section(tmp_path, capsys):
+    from scintools_tpu.cli import main as cli_main
+
+    trace = str(tmp_path / "t.jsonl")
+    with obs.tracing(jsonl=trace):
+        obs.gauge("hbm_bytes_in_use", 2 << 30, stream=True)
+        obs.gauge("hbm_bytes_in_use", 3 << 30, stream=True)
+        obs.gauge("hbm_bytes_limit", 8 << 30)
+        obs.gauge("step_hbm_peak[pipeline.step:4x32x32:float32]",
+                  1 << 30)
+        obs.gauge("step_bytes[pipeline.step:4x32x32:float32]", 1 << 29)
+        obs.inc("oom_predicted_avoided", 1)
+        # the IN-PROCESS renderer sees the same timeline: streamed
+        # gauge stamps enter the event ring, not only the JSONL sink
+        assert "hbm_bytes_in_use timeline:" in obs.render_summary()
+    rc = cli_main(["trace", "report", trace])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "device memory (measured HBM, obs/devmem):" in out
+    assert "in_use = 3.000 GiB, limit = 8.000 GiB, " \
+           "headroom = 5.000 GiB" in out
+    assert "peak = 1.000 GiB, model = 0.500 GiB [peak/model x2.0]" in out
+    assert "oom_predicted_avoided = 1, oom_backoff (reactive) = 0" in out
+    assert "hbm_bytes_in_use timeline:" in out
+
+
+def test_trace_report_since_last_filters(tmp_path, capsys):
+    from scintools_tpu.cli import main as cli_main
+    from scintools_tpu.obs.report import (filter_events, parse_duration,
+                                          parse_when)
+
+    assert parse_duration("90") == 90.0
+    assert parse_duration("15m") == 900.0
+    assert parse_duration("2h") == 7200.0
+    assert parse_duration("1d") == 86400.0
+    with pytest.raises(ValueError):
+        parse_duration("soon")
+    assert parse_when("1700000000.5") == 1700000000.5
+    import datetime as dt
+
+    assert parse_when("2026-08-04") == dt.datetime(2026, 8,
+                                                   4).timestamp()
+    with pytest.raises(ValueError):
+        parse_when("not-a-date")
+
+    path = str(tmp_path / "t.jsonl")
+    with open(path, "w") as fh:
+        for ts, n in ((100.0, 2), (200.0, 3)):
+            fh.write(json.dumps({"ts": ts, "kind": "span",
+                                 "name": "ops.sspec", "dur_ms": 1.0,
+                                 "span": f"s{ts}",
+                                 "pid": 1, "attrs": {}}) + "\n")
+            fh.write(json.dumps({"ts": ts, "kind": "counter",
+                                 "name": "epochs_processed",
+                                 "value": n}) + "\n")
+    # unfiltered: both windows sum
+    rc = cli_main(["trace", "report", path])
+    assert rc == 0
+    assert "epochs_processed = 5" in capsys.readouterr().out
+    # --since keeps only the second window
+    rc = cli_main(["trace", "report", path, "--since", "150"])
+    assert rc == 0
+    assert "epochs_processed = 3" in capsys.readouterr().out
+    # --last is EVENT time (newest stamp = 200), not wall clock
+    rc = cli_main(["trace", "report", path, "--last", "10s"])
+    assert rc == 0
+    assert "epochs_processed = 3" in capsys.readouterr().out
+    # unstamped records drop while filtering
+    evs = [{"kind": "counter", "name": "x", "value": 1},
+           {"ts": 50.0, "kind": "counter", "name": "x", "value": 1}]
+    assert filter_events(evs, since=10.0) == [evs[1]]
+    assert filter_events(evs) == evs
+    # bad values are usage errors, not tracebacks
+    with pytest.raises(SystemExit):
+        cli_main(["trace", "report", path, "--since", "whenever"])
+    with pytest.raises(SystemExit):
+        cli_main(["trace", "report", "--fleet", str(tmp_path),
+                  "--last", "1h"])
+    # a window containing nothing degrades to a warning, not rc 1
+    rc = cli_main(["trace", "report", path, "--since", "9999"])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "time filter dropped all" in out.err
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: signals + on-OOM memory profile
+# ---------------------------------------------------------------------------
+
+
+def test_memory_profile_dump_writes_pprof(tmp_path):
+    path = devmem.memory_profile_dump(str(tmp_path / "mp"), tag="t")
+    assert path is not None and os.path.exists(path)
+    with open(path, "rb") as fh:
+        assert fh.read(2) == b"\x1f\x8b"       # gzipped pprof proto
+
+
+@pytest.mark.chaos
+def test_worker_oom_crash_attaches_memory_profile(tmp_path):
+    files = _write_epochs(tmp_path, (1,))
+    qdir = str(tmp_path / "q")
+    SurveyClient(qdir).submit(files, OPTS)
+    worker = ServeWorker(JobQueue(qdir), batch_size=1, max_wait_s=0.0,
+                         poll_s=0.01, runner=_stub_runner(),
+                         heartbeat_s=0)
+    with faults.injected("worker.poll", FaultSpec(kind="oom")):
+        with pytest.raises(Exception) as ei:
+            worker.run()
+    assert faults.is_oom_error(ei.value)
+    flight = os.path.join(qdir, "flight",
+                          f"flight_{os.getpid()}.jsonl")
+    assert os.path.exists(flight)
+    with open(flight) as fh:
+        head = json.loads(fh.readline())
+    assert head["classification"] == "transient"
+    mp = head.get("memory_profile")
+    assert mp and os.path.exists(mp)
+    with open(mp, "rb") as fh:
+        assert fh.read(2) == b"\x1f\x8b"
+
+
+def test_sigterm_dumps_flight_then_exits_gracefully(tmp_path):
+    """ISSUE 12 satellite: a politely stopped worker leaves a flight
+    record too — and the signal-then-raise path cannot double-dump."""
+    files = _write_epochs(tmp_path, (1,))
+    qdir = str(tmp_path / "q")
+    SurveyClient(qdir).submit(files, OPTS)
+    prev = signal.getsignal(signal.SIGTERM)
+
+    def runner(batch, batch_size, mesh, async_exec):
+        signal.raise_signal(signal.SIGTERM)
+
+    worker = ServeWorker(JobQueue(qdir), batch_size=1, max_wait_s=0.0,
+                         poll_s=0.01, runner=runner, heartbeat_s=0)
+    with pytest.raises(SystemExit) as ei:
+        worker.run()
+    assert ei.value.code == 128 + signal.SIGTERM
+    flight = os.path.join(qdir, "flight",
+                          f"flight_{os.getpid()}.jsonl")
+    assert os.path.exists(flight)
+    with open(flight) as fh:
+        head = json.loads(fh.readline())
+    assert head["error"] == "signal: SIGTERM"
+    assert head["classification"] == "signal"
+    assert head["worker"] == worker.worker_id
+    # the latch guards any later dump attempt (signal-then-raise)
+    assert worker._dump_flight("again") is None
+    # and the previous handler is restored
+    assert signal.getsignal(signal.SIGTERM) == prev
+
+
+def test_sigint_dumps_flight_and_keyboardinterrupts(tmp_path):
+    files = _write_epochs(tmp_path, (1,))
+    qdir = str(tmp_path / "q2")
+    SurveyClient(qdir).submit(files, OPTS)
+    prev = signal.getsignal(signal.SIGINT)
+
+    def runner(batch, batch_size, mesh, async_exec):
+        signal.raise_signal(signal.SIGINT)
+
+    worker = ServeWorker(JobQueue(qdir), batch_size=1, max_wait_s=0.0,
+                         poll_s=0.01, runner=runner, heartbeat_s=0)
+    with pytest.raises(KeyboardInterrupt):
+        worker.run()
+    flight = os.path.join(qdir, "flight",
+                          f"flight_{os.getpid()}.jsonl")
+    with open(flight) as fh:
+        head = json.loads(fh.readline())
+    assert head["error"] == "signal: SIGINT"
+    assert signal.getsignal(signal.SIGINT) == prev
+
+
+# ---------------------------------------------------------------------------
+# --xprof: labeled device timelines
+# ---------------------------------------------------------------------------
+
+
+def test_xprof_writes_device_timeline(tmp_path, capsys):
+    from scintools_tpu.cli import main as cli_main
+
+    files = _write_epochs(tmp_path, (1, 2))
+    xdir = str(tmp_path / "xprof")
+    out = str(tmp_path / "res.csv")
+    rc = cli_main(["process", "--batched", "--lamsteps",
+                   "--results", out, "--xprof", xdir, *files])
+    capsys.readouterr()
+    assert rc == 0
+    artifacts = [f for _, _, fs in os.walk(xdir) for f in fs]
+    assert artifacts, "no profiler artifacts written under --xprof DIR"
+    # the CSV still lands — profiling must not perturb the survey
+    with open(out) as fh:
+        assert fh.read().count("\n") == 3
+
+
+def test_xprof_is_batched_only(tmp_path):
+    from scintools_tpu.cli import main as cli_main
+
+    files = _write_epochs(tmp_path, (1,))
+    with pytest.raises(SystemExit, match="--xprof"):
+        cli_main(["process", "--lamsteps", "--xprof",
+                  str(tmp_path / "x"), *files])

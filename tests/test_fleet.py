@@ -29,13 +29,16 @@ OPTS = {"lamsteps": True}
 
 @pytest.fixture(autouse=True)
 def _clean_state():
-    """obs and faults are process-global; every test starts/ends clean."""
+    """obs, faults and devmem are process-global; every test starts/ends
+    clean."""
     obs.disable(flush=False)
     obs.reset()
+    obs.devmem.reset()
     faults.clear()
     yield
     obs.disable(flush=False)
     obs.reset()
+    obs.devmem.reset()
     faults.clear()
 
 
@@ -231,8 +234,23 @@ def test_depth_stamped_on_fail_transition(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def test_heartbeat_write_interval_and_schema(tmp_path):
+def _fake_devmem(monkeypatch, in_use=3 << 30, peak=5 << 30,
+                 limit=16 << 30):
+    """Install a fake memory_stats provider (CPU backends report
+    None); returns the mutable state dict."""
+    state = {"in_use": in_use, "peak": peak, "limit": limit}
+    obs.devmem.reset()
+    monkeypatch.setattr(
+        obs.devmem, "_device_stats",
+        lambda: [{"bytes_in_use": state["in_use"],
+                  "peak_bytes_in_use": state["peak"],
+                  "bytes_limit": state["limit"]}])
+    return state
+
+
+def test_heartbeat_write_interval_and_schema(tmp_path, monkeypatch):
     hb_dir = str(tmp_path / "hb")
+    _fake_devmem(monkeypatch)
     with obs.tracing():
         obs.inc("jobs_done", 3)
         obs.observe("queue_wait_s", 0.5)
@@ -253,6 +271,13 @@ def test_heartbeat_write_interval_and_schema(tmp_path):
     assert hb["elapsed_s"] == 11.0
     assert hb["gauges"]["queue_depth"] == 7
     assert "queue_wait_s" in hb["hists"]
+    # ISSUE 12: the memory plane rides the heartbeat as a DIRECT
+    # sample (JSON round-trip through the file included)
+    assert hb["devmem"]["bytes_in_use"] == 3 << 30
+    assert hb["devmem"]["bytes_limit"] == 16 << 30
+    assert hb["devmem"]["headroom"] == 13 << 30
+    mem = fleet._worker_memory(hb)
+    assert mem["headroom"] == 13 << 30
     # untraced liveness still works: empty telemetry, real pid/ts —
     # and the worker's OWN stats map onto the canonical counter names
     # (jobs_done etc.), so an untraced fleet still has a drain rate
@@ -275,27 +300,40 @@ def test_heartbeat_write_interval_and_schema(tmp_path):
     assert merged["drain_rate_per_s"] == pytest.approx(0.4)
 
 
+def _mk_hb(worker, ts, done, waits, elapsed=10.0, delta=None,
+           interval_s=10.0, in_use=None):
+    h = Hist()
+    for v in waits:
+        h.observe(v)
+    hb = {"kind": "heartbeat", "v": 1, "worker": worker,
+          "pid": 1, "ts": ts, "seq": 1, "interval_s": interval_s,
+          "elapsed_s": elapsed,
+          "counters": {"jobs_done": done},
+          "deltas": {"jobs_done": delta if delta is not None
+                     else done},
+          "gauges": {"queue_depth": done},
+          "hists": {"queue_wait_s": h.to_dict()},
+          "last_claim_age_s": 1.0, "digests": {}}
+    if in_use is not None:
+        # the ISSUE 12 memory payload, as HeartbeatWriter writes it
+        hb["devmem"] = {"bytes_in_use": in_use,
+                        "peak_bytes_in_use": in_use * 2,
+                        "bytes_limit": 16 << 30,
+                        "headroom": (16 << 30) - in_use,
+                        "n_devices": 1,
+                        "step_peaks": {"pipeline.step:8x64x64:float32":
+                                       {"bytes": in_use,
+                                        "estimated": False}}}
+    return hb
+
+
 def test_heartbeat_merge_associative(tmp_path):
     """merge(A, B) == merge(B, A) and merge over any grouping — the
     fleet rollup's correctness requirement for concurrently-written
-    heartbeats."""
-    def hb(worker, ts, done, waits, elapsed=10.0, delta=None):
-        h = Hist()
-        for v in waits:
-            h.observe(v)
-        return {"kind": "heartbeat", "v": 1, "worker": worker,
-                "pid": 1, "ts": ts, "seq": 1, "interval_s": 10.0,
-                "elapsed_s": elapsed,
-                "counters": {"jobs_done": done},
-                "deltas": {"jobs_done": delta if delta is not None
-                           else done},
-                "gauges": {"queue_depth": done},
-                "hists": {"queue_wait_s": h.to_dict()},
-                "last_claim_age_s": 1.0, "digests": {}}
-
-    a = hb("a", 100.0, 4, [0.1, 0.2])
-    b = hb("b", 200.0, 6, [1.0])
-    c = hb("c", 150.0, 2, [5.0, 0.01], elapsed=None, delta=2)
+    heartbeats, with the ISSUE 12 memory fields riding along."""
+    a = _mk_hb("a", 100.0, 4, [0.1, 0.2], in_use=1 << 30)
+    b = _mk_hb("b", 200.0, 6, [1.0], in_use=3 << 30)
+    c = _mk_hb("c", 150.0, 2, [5.0, 0.01], elapsed=None, delta=2)
     m1 = fleet.merge_heartbeats([a, b, c])
     m2 = fleet.merge_heartbeats([c, a, b])
     m3 = fleet.merge_heartbeats([b, c, a])
@@ -306,6 +344,44 @@ def test_heartbeat_merge_associative(tmp_path):
     assert m1["gauges"]["queue_depth"] == 6 and m1["depth"] == 6
     # drain rate: only beats with an elapsed interval contribute
     assert m1["drain_rate_per_s"] == round(4 / 10.0 + 6 / 10.0, 6)
+    # the per-worker memory column reads the heartbeat payload
+    rows = {w["worker"]: w
+            for w in (fleet._worker_row(h, 210.0) for h in (a, b, c))}
+    assert rows["a"]["memory"]["bytes_in_use"] == 1 << 30
+    assert rows["a"]["memory"]["headroom"] == 15 << 30
+    assert "pipeline.step:8x64x64:float32" in \
+        rows["b"]["memory"]["step_peaks"]
+    assert rows["c"]["memory"] is None
+
+
+def test_stale_workers_flagged_and_excluded_from_drain():
+    """ISSUE 12 satellite: a worker whose beat age exceeds 3x its own
+    interval renders STALE and its frozen deltas drop out of the
+    drain-rate/backpressure aggregation — a dead worker must not read
+    as live throughput."""
+    now = 1000.0
+    fresh = _mk_hb("fresh", now - 12.0, 4, [0.1])       # age 12 < 30
+    dead = _mk_hb("dead", now - 100.0, 6, [0.2])        # age 100 > 30
+    assert not fleet.heartbeat_stale(fresh, now)
+    assert fleet.heartbeat_stale(dead, now)
+    # without `now` (legacy callers) nothing is excluded
+    m = fleet.merge_heartbeats([fresh, dead])
+    assert m["drain_rate_per_s"] == pytest.approx(1.0)
+    m = fleet.merge_heartbeats([fresh, dead], now=now)
+    assert m["drain_rate_per_s"] == pytest.approx(0.4)  # fresh only
+    assert m["stale_workers"] == 1
+    # counters still merge: totals stay truthful
+    assert m["counters"]["jobs_done"] == 10
+    # the rollup flags the row and backpressure uses the excluded rate
+    rollup = fleet.fleet_rollup([fresh, dead], depth=24, now=now)
+    rows = {w["worker"]: w for w in rollup["workers"]}
+    assert rows["dead"]["stale"] and not rows["fresh"]["stale"]
+    assert rollup["drain_rate_per_s"] == pytest.approx(0.4)
+    assert rollup["backpressure"] == pytest.approx(
+        24 / (24 + 0.4 * fleet.BACKPRESSURE_HORIZON_S))
+    text = fleet.render_fleet(rollup)
+    assert "STALE" in text
+    assert "excluded from the drain rate" in text
 
 
 # ---------------------------------------------------------------------------
@@ -441,19 +517,22 @@ def test_fleet_status_two_workers_and_backpressure_formula(tmp_path,
     hb_dir = str(qdir / "heartbeat")
     for sub in ("queued", "leased", "done", "failed"):
         (qdir / sub).mkdir(parents=True)
-    # two workers, interleaved beats (concurrent writers)
+    # two workers, interleaved beats (concurrent writers).  Timestamps
+    # near NOW: the stale rule (age > 3x interval) would otherwise
+    # exclude ancient fixture beats from the drain rate by design
+    base = time.time() - 11.0
     with obs.tracing():
         obs.inc("jobs_done", 8)
         obs.observe("queue_wait_s", 0.25)
         obs.gauge("queue_depth", 4)
         w1 = fleet.HeartbeatWriter(hb_dir, "host:1", interval_s=5.0)
-        w1.beat(now=1000.0, last_claim_at=999.5)
+        w1.beat(now=base, last_claim_at=base - 0.5)
         w2 = fleet.HeartbeatWriter(hb_dir, "host:2", interval_s=5.0)
-        w2.beat(now=1001.0, last_claim_at=1000.5)
+        w2.beat(now=base + 1.0, last_claim_at=base + 0.5)
         obs.inc("jobs_done", 4)
         obs.observe("queue_wait_s", 1.5)
-        w1.beat(now=1010.0, force=True)      # delta 4 over 10 s
-        w2.beat(now=1011.0, force=True)      # delta 4 over 10 s
+        w1.beat(now=base + 10.0, force=True)     # delta 4 over 10 s
+        w2.beat(now=base + 11.0, force=True)     # delta 4 over 10 s
     # plant queue depth: 3 queued records (fake files are fine — the
     # CLI only counts names)
     for i in range(3):

@@ -277,6 +277,46 @@ def test_live_writer_open_file_is_left_alone(tmp_path):
     assert ss.has("inflight")
 
 
+def test_refresh_mtime_gate_distrusts_racy_scan(tmp_path):
+    """The racily-clean guard (git's index rule): a memoised scan
+    taken within one timestamp-granularity window of the directory
+    mtime tick must NOT be trusted on an equal re-stat — a seal()
+    renamed in that same tick would otherwise stay invisible to every
+    gated read until some unrelated write moved the clock (the
+    coarse-mtime tier-1 flake this fixes)."""
+    d = str(tmp_path / "segs")
+    app = SegmentAppender(d)
+    app.add("k1", _row(1))
+    app.seal()
+    ss = SegmentStore(d)
+    ss.refresh(force=True)
+    assert ss.has("k1")
+    # a second seal hidden in the same mtime tick as the memoised scan
+    app2 = SegmentAppender(d)
+    app2.add("k2", _row(2))
+    app2.seal()
+    m = os.stat(d).st_mtime_ns
+    ss._mtime = m
+    ss._scan_ns = m + 1            # scan raced the tick: must rescan
+    assert ss.has("k2")
+    # a SETTLED scan is trusted: the gated early-out never re-lists
+    ss.refresh(force=True)
+    ss._scan_ns = ss._mtime + 10 ** 10
+    real_listdir = os.listdir
+    calls = []
+
+    def spy(path):
+        calls.append(path)
+        return real_listdir(path)
+
+    try:
+        os.listdir = spy
+        ss.refresh()
+    finally:
+        os.listdir = real_listdir
+    assert calls == []             # early-out took the gate
+
+
 # ---------------------------------------------------------------------------
 # serve integration: O(workers x flushes) files, byte-identical CSV
 # ---------------------------------------------------------------------------
