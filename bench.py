@@ -65,7 +65,16 @@ whichever headline record goes out), SCINT_BENCH_SYNTH ("1" = ALSO run the zero-
 synthetic lane — ``run_pipeline(synthetic=...)`` generate→analyse at
 the bench shape — recording generated+analysed epochs/s and the
 key-only ``bytes_h2d`` beside the file-fed headline; every record
-carries ``synthetic: bool`` saying which feed the headline measured).
+carries ``synthetic: bool`` saying which feed the headline measured),
+SCINT_BENCH_FLEET ("1" = ALSO run the pool-controller capacity lane —
+a real `scintools-tpu pool` control loop over CPU-pinned serve worker
+subprocesses draining SCINT_BENCH_FLEET_JOBS bulk `simulate` jobs
+(PR 9's zero-data load generator) plus one mid-backlog interactive
+probe — recording jobs/s, the scale-up/down decisions taken, the
+interactive queue-wait, and affinity/lane claim counters; attached as
+``fleet_lane``.  CPU-pinned on purpose: it measures the CONTROL
+PLANE's capacity — claim fairness, elasticity, hint routing — without
+contending for the device tunnel).
 """
 
 import json
@@ -647,6 +656,124 @@ def synthetic_throughput(nf: int, nt: int, B: int, chunk: int,
     return rec
 
 
+_FLEET_WORKER_SRC = """
+import os, sys, time
+from scintools_tpu.serve import JobQueue, ServeWorker
+
+qdir, wid = sys.argv[1], sys.argv[2]
+worker = ServeWorker(JobQueue(qdir, backoff_s=0.05), batch_size=1,
+                     max_wait_s=0.0, lease_s=30.0, poll_s=0.05,
+                     heartbeat_s=0.5, worker_id=wid)
+worker.run(exit_on_drain=False)
+"""
+
+
+def fleet_capacity(n_jobs: int | None = None,
+                   max_workers: int | None = None) -> dict:
+    """The fleet pool-controller capacity lane (``SCINT_BENCH_FLEET=1``):
+    a REAL control loop (serve/pool.PoolController) over CPU-pinned
+    worker subprocesses running the REAL `simulate` pipeline on tiny
+    acf-kind campaigns — PR 9's zero-data load generator — plus one
+    interactive `simulate` probe submitted mid-backlog.
+
+    Record fields: ``jobs`` / ``workers_max`` / ``scale_up`` /
+    ``scale_down`` (the elasticity the backlog actually triggered),
+    ``jobs_per_s`` (end-to-end drain rate through the pool),
+    ``interactive_wait_s`` (submit -> row visible for the probe while
+    bulk work was pending — the QoS figure), and ``wall_s``.
+
+    CPU-pinned (workers run under JAX_PLATFORMS=cpu) so the lane can
+    run before any tunnel work and never double-claims the device:
+    it measures the CONTROL plane, not chip throughput."""
+    _maybe_enable_trace()
+    import shutil
+    import tempfile
+
+    from scintools_tpu.serve import SurveyClient
+    from scintools_tpu.serve.pool import PoolConfig, PoolController
+
+    n = int(n_jobs if n_jobs is not None
+            else _env_int("SCINT_BENCH_FLEET_JOBS", 6))
+    wmax = int(max_workers if max_workers is not None
+               else _env_int("SCINT_BENCH_FLEET_WORKERS", 2))
+    timeout_s = _env_int("SCINT_BENCH_FLEET_TIMEOUT", 600)
+    qdir = tempfile.mkdtemp(prefix="scint_bench_fleet_")
+    rec: dict = {"jobs": n, "max_workers": wmax}
+    try:
+        client = SurveyClient(qdir)
+        opts = {"no_arc": True}
+        spec = {"kind": "acf", "n_epochs": 2, "nf": 32, "nt": 32}
+        for i in range(n):
+            client.submit_synthetic(dict(spec, seed=1 + i), opts)
+
+        def spawn(wid):
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            # the child inherits the fd; close the parent's copy
+            with open(os.path.join(qdir, f"{wid}.log"), "w") as log:
+                return subprocess.Popen(
+                    [sys.executable, "-c", _FLEET_WORKER_SRC, qdir,
+                     wid],
+                    env=env, stdout=log, stderr=subprocess.STDOUT)
+
+        ctl = PoolController(
+            qdir, PoolConfig(min_workers=1, max_workers=wmax,
+                             high_water=0.3, low_water=0.1,
+                             cooldown_s=1.0, poll_s=0.2), spawn=spawn)
+        q = ctl.queue
+        t0 = time.perf_counter()
+        probe_id = None
+        t_probe = wait_probe = None
+        workers_max = 0
+        deadline = time.time() + timeout_s
+        try:
+            while time.time() < deadline:
+                ctl.poll_once()
+                workers_max = max(workers_max, len(ctl.workers))
+                done = q.counts()["done"]
+                if probe_id is None and done >= 1:
+                    probe_id = client.submit_synthetic(
+                        dict(spec, seed=10001), opts,
+                        lane="interactive")["job"]
+                    t_probe = time.perf_counter()
+                if probe_id is not None and wait_probe is None \
+                        and q.state_of(probe_id) == "done":
+                    # (`simulate` rows are keyed <job>.<epoch>, so the
+                    # job's terminal state — not a bare row-key probe —
+                    # is the completion signal)
+                    wait_probe = time.perf_counter() - t_probe
+                # the probe is NOT a bulk completion: n bulk jobs must
+                # drain on their own account
+                if done - int(wait_probe is not None) >= n \
+                        and wait_probe is not None and q.empty():
+                    break
+                time.sleep(0.2)
+        finally:
+            ctl.shutdown(timeout_s=30.0)
+        wall = time.perf_counter() - t0
+        done = q.counts()["done"]
+        bulk_done = done - int(wait_probe is not None)
+        rec.update({
+            "wall_s": round(wall, 3),
+            "jobs_done": bulk_done,
+            "jobs_per_s": (round(done / wall, 3) if wall
+                           else None),   # all completions, probe incl.
+            "workers_max": workers_max,
+            "scale_up": ctl.stats["scale_up"],
+            "scale_down": ctl.stats["scale_down"],
+            "interactive_wait_s": (round(wait_probe, 3)
+                                   if wait_probe is not None else None),
+            "rows": len(q.results.keys()),
+        })
+        if bulk_done < n or wait_probe is None:
+            rec["error"] = (f"fleet lane incomplete: {bulk_done}/{n} "
+                            f"bulk jobs, probe "
+                            f"{'done' if wait_probe else 'pending'}")
+    finally:
+        shutil.rmtree(qdir, ignore_errors=True)
+    _trace_flush()
+    return rec
+
+
 def results_plane_throughput(n_rows: int | None = None,
                              flush_rows: int | None = None,
                              baseline: bool = True) -> dict:
@@ -1044,6 +1171,17 @@ def main():
         except Exception as e:
             results_holder["rec"] = {"error": f"{type(e).__name__}: {e}"}
 
+    # pool-controller capacity lane (SCINT_BENCH_FLEET=1): CPU-pinned
+    # worker subprocesses, so it too runs before any tunnel work and a
+    # wedged chip can never mask it; failures land as {"error": ...}
+    fleet_holder: dict = {}
+    if os.environ.get("SCINT_BENCH_FLEET",
+                      "0").strip().lower() == "1":
+        try:
+            fleet_holder["rec"] = fleet_capacity()
+        except Exception as e:
+            fleet_holder["rec"] = {"error": f"{type(e).__name__}: {e}"}
+
     def device_record(res: dict, probe: dict, is_fallback: bool = False,
                       batch_chunk: int | None = None, **extra) -> dict:
         rate = res["rate"]
@@ -1079,6 +1217,9 @@ def main():
         rl = results_holder.get("rec")
         if rl:
             rec["results_lane"] = rl
+        fl_lane = fleet_holder.get("rec")
+        if fl_lane:
+            rec["fleet_lane"] = fl_lane
         rec["fused"] = bool(res.get("fused", False))
         fl = res.get("fused_lane")
         if fl:
@@ -1343,6 +1484,9 @@ def main():
     if results_holder.get("rec"):
         # the host-only results-plane lane survives a dead tunnel
         zero_rec["results_lane"] = results_holder["rec"]
+    if fleet_holder.get("rec"):
+        # the CPU-pinned fleet capacity lane survives one too
+        zero_rec["fleet_lane"] = fleet_holder["rec"]
     _trace_flush()
     print(json.dumps(zero_rec), flush=True)
     if device_lock is None:

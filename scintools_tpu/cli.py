@@ -921,7 +921,7 @@ def cmd_serve(args) -> int:
     (scintools_tpu.serve; docs/serving.md)."""
     from . import compile_cache
     from .parallel import make_mesh
-    from .serve import JobQueue, ServeWorker
+    from .serve import JobQueue, ServeWorker, parse_lane_budgets
     from .utils import get_logger, log_event
 
     log = get_logger()
@@ -931,12 +931,16 @@ def cmd_serve(args) -> int:
     mesh = (make_mesh(tuple(int(x) for x in args.mesh)) if args.mesh
             else None)
     try:
+        budgets = (parse_lane_budgets(args.lane_budgets)
+                   if getattr(args, "lane_budgets", None) else None)
         worker = ServeWorker(queue, batch_size=args.batch,
                              max_wait_s=args.max_wait, lease_s=args.lease,
                              poll_s=args.poll, mesh=mesh,
                              async_exec=not args.no_async,
                              bucket=getattr(args, "bucket", False),
-                             heartbeat_s=getattr(args, "heartbeat", 10.0))
+                             heartbeat_s=getattr(args, "heartbeat", 10.0),
+                             worker_id=getattr(args, "worker_id", None),
+                             lane_budgets=budgets)
     except ValueError as e:
         # e.g. batch/mesh divisibility — a usage error, not a traceback
         raise SystemExit(str(e))
@@ -979,19 +983,22 @@ def cmd_submit(args) -> int:
                                     "job": rec["job"],
                                     "status": rec["status"]}]}))
         return 0
+    lane = getattr(args, "lane", None)
     if synth_d is not None:
         # `simulate` job kind: one job = one on-device campaign (no
-        # input files; keys + params ARE the job payload)
+        # input files; keys + params ARE the job payload).  Defaults
+        # onto the BULK lane unless --lane says otherwise.
         if files:
             raise SystemExit("--synthetic submits take no input files")
-        rec = client.submit_synthetic(synth_d, _estimator_opts(args))
+        rec = client.submit_synthetic(synth_d, _estimator_opts(args),
+                                      lane=lane)
         recs = [{"file": f"synthetic:{synth_d.get('kind', 'screen')}",
                  "job": rec["job"], "status": rec["status"]}]
     else:
         if not files:
             raise SystemExit("no input files (pass psrflux files, or "
                              "--synthetic N for a simulate job)")
-        recs = client.submit(files, _estimator_opts(args))
+        recs = client.submit(files, _estimator_opts(args), lane=lane)
     fresh = sum(1 for r in recs if r["status"] == "submitted")
     missing = sum(1 for r in recs if r["status"] == "missing")
     base = {"queue": args.queue, "submitted": fresh,
@@ -1008,6 +1015,56 @@ def cmd_submit(args) -> int:
                          or missing) else 1
     print(json.dumps(base))
     return 0 if missing == 0 else 1
+
+
+def cmd_pool(args) -> int:
+    """Run the fleet pool controller over a serve queue directory:
+    spawn `serve` worker subprocesses when the merged-heartbeat
+    backpressure crosses the high-water threshold, drain one (the
+    per-worker drain marker) below the low-water one, replace
+    STALE-heartbeat workers, and publish warm/memory-affinity claim
+    hints the workers honour at claim time (scintools_tpu.serve.pool;
+    docs/fleet.md)."""
+    from .serve import parse_lane_budgets
+    from .serve.pool import PoolConfig, PoolController
+    from .utils import get_logger, log_event
+
+    log = get_logger()
+    try:
+        cfg = PoolConfig(min_workers=args.min, max_workers=args.max,
+                         high_water=args.high, low_water=args.low,
+                         cooldown_s=args.cooldown, poll_s=args.poll)
+    except ValueError as e:
+        raise SystemExit(str(e))
+    if args.heartbeat <= 0:
+        # the controller's WHOLE signal set (drain rate, warm sigs,
+        # headroom, staleness) rides the heartbeats; a heartbeat-less
+        # pool would read every worker as dead forever
+        raise SystemExit("pool workers must heartbeat: --heartbeat "
+                         "must be > 0")
+    # curated pass-through: the worker knobs a pool operator tunes
+    worker_args: list[str] = ["--batch", str(args.batch),
+                              "--max-wait", str(args.max_wait),
+                              "--lease", str(args.lease),
+                              "--heartbeat", str(args.heartbeat)]
+    if args.bucket:
+        worker_args.append("--bucket")
+    if args.lane_budgets:
+        try:
+            parse_lane_budgets(args.lane_budgets)   # fail fast HERE
+        except ValueError as e:
+            raise SystemExit(str(e))
+        worker_args += ["--lane-budgets", args.lane_budgets]
+    ctl = PoolController(args.queue, cfg, worker_args=worker_args)
+    try:
+        stats = ctl.run(max_rounds=args.rounds)
+    except KeyboardInterrupt:
+        stats = dict(ctl.stats)
+        log_event(log, "pool_interrupted", **stats)
+    finally:
+        ctl.shutdown()
+    print(json.dumps({"queue": args.queue, **stats}))
+    return 0
 
 
 def _existing_queue_dir(qdir: str) -> str:
@@ -1406,29 +1463,19 @@ def cmd_fleet_status(args) -> int:
     rows, merged histograms, reassembled traces, and the backpressure
     scalar (docs/observability.md).  ``--json`` prints the machine
     form (the admission-control input)."""
-    import os
-
     from .obs import fleet as fleet_mod
-    from .serve import JobQueue
 
     qdir = _existing_queue_dir(args.queue)
-    # a live depth beats the heartbeat-reported one when the dir IS a
-    # queue (fleet dirs of bare heartbeats have no queued/ subdir)
-    depth = None
-    shard_depths = None
-    if os.path.isdir(os.path.join(qdir, "queued")):
-        q = JobQueue(qdir)
-        c = q.counts()
-        depth = c["queued"] + c["leased"]
-        # where the backlog actually sits (ISSUE 11): depth piling
-        # into one shard is invisible in the scalar
-        shard_depths = q.shard_depths()
+    # live queue-side readouts (depth, per-shard/per-lane backlogs,
+    # pool-controller snapshot) beat the heartbeat-reported ones when
+    # the dir IS a queue (bare heartbeat dirs have no queued/ subdir)
+    extras = fleet_mod.queue_extras(qdir)
     heartbeats, events, warnings = fleet_mod.collect_fleet(qdir)
     for w in warnings:
         print(f"warning: {w}", file=sys.stderr)
-    rollup = fleet_mod.fleet_rollup(heartbeats, events, depth=depth)
-    if shard_depths is not None:
-        rollup["shard_depths"] = shard_depths
+    rollup = fleet_mod.fleet_rollup(heartbeats, events,
+                                    depth=extras.get("depth"))
+    rollup.update(extras)
     if args.json:
         print(json.dumps({"queue": args.queue, **rollup}, default=str))
     else:
@@ -1769,6 +1816,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "queue dir (default 8, or SCINT_QUEUE_SHARDS); "
                         "an existing queue's persisted control/shards "
                         "value always wins")
+    q.add_argument("--worker-id", default=None, dest="worker_id",
+                   help="explicit worker id (default <host>:<pid>) — "
+                        "the pool controller names its workers so "
+                        "heartbeats, hints and per-worker drain "
+                        "markers line up")
+    q.add_argument("--lane-budgets", default=None, dest="lane_budgets",
+                   metavar="LANE=N,...",
+                   help="weighted-fair claim budgets per QoS lane, "
+                        "e.g. interactive=3,bulk=1 (the default): per "
+                        "claim cycle up to N candidates are taken "
+                        "from each lane, so bulk campaigns cannot "
+                        "starve interactive submits")
     q.add_argument("--xprof", default=None, metavar="DIR",
                    help="bracket the whole serving session in "
                         "jax.profiler.trace (device timeline to DIR; "
@@ -1809,9 +1868,59 @@ def build_parser() -> argparse.ArgumentParser:
                    help="submit a results-plane compaction job instead "
                         "of epochs: the worker merges small segment "
                         "files into one (docs/performance.md)")
+    q.add_argument("--lane", default=None,
+                   choices=["interactive", "bulk"],
+                   help="QoS lane (scheduling priority, never job "
+                        "identity): file submits default to "
+                        "interactive, --synthetic campaigns to bulk; "
+                        "claim order is weighted-fair over the lanes "
+                        "(serve --lane-budgets)")
     _add_perf_policy_flags(q)
     _add_synth_flags(q)
     q.set_defaults(fn=cmd_submit)
+
+    q = sub.add_parser(
+        "pool",
+        help="run the fleet pool controller: autoscale serve workers "
+             "around the heartbeat backpressure signal, replace stale "
+             "ones, and publish warm/memory-affinity claim hints")
+    q.add_argument("queue", help="queue directory (created if absent)")
+    q.add_argument("--min", type=int, default=1,
+                   help="minimum resident workers (refilled "
+                        "immediately, no cooldown)")
+    q.add_argument("--max", type=int, default=4,
+                   help="maximum workers")
+    q.add_argument("--high", type=float, default=0.5,
+                   help="scale-up backpressure threshold (0.5 = the "
+                        "backlog equals one 60 s horizon of drain)")
+    q.add_argument("--low", type=float, default=0.1,
+                   help="scale-down backpressure threshold")
+    q.add_argument("--cooldown", type=float, default=15.0,
+                   help="seconds between scale decisions")
+    q.add_argument("--poll", type=float, default=1.0,
+                   help="control-round interval (seconds)")
+    q.add_argument("--rounds", type=int, default=None,
+                   help="exit after this many control rounds "
+                        "(default: run until drained/interrupted)")
+    # worker pass-through knobs (each spawned `serve` subprocess)
+    q.add_argument("--batch", type=int, default=8,
+                   help="worker dynamic batch size (serve --batch)")
+    q.add_argument("--max-wait", type=float, default=2.0,
+                   help="worker partial-batch wait (serve --max-wait)")
+    q.add_argument("--lease", type=float, default=60.0,
+                   help="worker job lease seconds (serve --lease)")
+    q.add_argument("--heartbeat", type=float, default=2.0,
+                   help="worker heartbeat interval — the controller's "
+                        "whole signal set rides on it, so pool "
+                        "workers default to 2 s (serve --heartbeat)")
+    q.add_argument("--bucket", action="store_true",
+                   help="workers pad partial flushes to batch-ladder "
+                        "rungs (serve --bucket)")
+    q.add_argument("--lane-budgets", default=None, dest="lane_budgets",
+                   metavar="LANE=N,...",
+                   help="workers' weighted-fair claim budgets "
+                        "(serve --lane-budgets)")
+    q.set_defaults(fn=cmd_pool)
 
     q = sub.add_parser("status",
                        help="print a serve queue's state as one JSON "

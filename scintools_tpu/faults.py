@@ -204,7 +204,8 @@ class FaultSpec:
 KNOWN_SITES = ("driver.chunk_execute", "driver.admit_chunk",
                "schedule.prefetch",
                "compile_cache.load", "queue.claim_rename",
-               "worker.load", "worker.batch_execute", "worker.poll")
+               "worker.load", "worker.batch_execute", "worker.poll",
+               "pool.spawn", "pool.drain")
 
 # site -> FaultSpec.  EMPTY in production: check()'s disarmed cost is
 # the one dict lookup the acceptance criteria demand.  Armed only by

@@ -393,6 +393,36 @@ def backpressure(depth, drain_rate_per_s,
 # ---------------------------------------------------------------------------
 
 
+def queue_extras(directory: str) -> dict:
+    """Live queue-side readouts for a fleet rollup when ``directory``
+    IS a serve queue dir: depth, per-shard/per-lane queued depths, and
+    the pool controller's last ``control/pool.json`` snapshot (ISSUE
+    13).  Empty for bare heartbeat dirs; every probe degrades rather
+    than raising (the rollup must render mid-churn)."""
+    out: dict = {}
+    if not os.path.isdir(os.path.join(directory, "queued")):
+        return out
+    try:
+        from ..serve.queue import JobQueue
+
+        q = JobQueue(directory)
+        c = q.counts()
+        out["depth"] = c["queued"] + c["leased"]
+        out["shard_depths"] = q.shard_depths()
+        out["lane_depths"] = q.lane_depths()
+    except (OSError, ValueError):  # fault-ok: live probe is optional
+        pass
+    try:
+        from ..serve.pool import read_pool_status
+
+        pool = read_pool_status(directory)
+        if pool is not None:
+            out["pool"] = pool
+    except OSError:  # fault-ok: snapshot is advisory
+        pass
+    return out
+
+
 def collect_fleet(directory: str) -> tuple[list, list, list]:
     """Gather a fleet directory's telemetry: ``(heartbeats, events,
     warnings)``.
@@ -596,6 +626,27 @@ def render_fleet(rollup: dict) -> str:
         lines.append("  queued depth by shard: "
                      + " ".join(f"{k}={v}"
                                 for k, v in sorted(sd.items()) if v))
+    ld = rollup.get("lane_depths")
+    if ld and any(ld.values()):
+        lines.append("  queued depth by lane: "
+                     + " ".join(f"{k}={v}"
+                                for k, v in sorted(ld.items())))
+    pool = rollup.get("pool")
+    if pool:
+        ps = pool.get("stats") or {}
+        nw = len(pool.get("workers") or {})
+        draining = sum(1 for w in (pool.get("workers") or {}).values()
+                       if isinstance(w, dict) and w.get("draining"))
+        lines.append(
+            f"  pool controller (pid {pool.get('pid')}): workers = "
+            f"{nw}" + (f" ({draining} draining)" if draining else "")
+            + f" in [{pool.get('min_workers')}, "
+            f"{pool.get('max_workers')}], scale_up = "
+            f"{ps.get('scale_up', 0)}, scale_down = "
+            f"{ps.get('scale_down', 0)}, stale_replaced = "
+            f"{ps.get('stale_replaced', 0)}"
+            + (f", last = {pool['last_decision']}"
+               if pool.get("last_decision") else ""))
     tr = rollup["traces"]
     if tr["count"]:
         lines.append(
@@ -617,7 +668,13 @@ def render_fleet(rollup: dict) -> str:
 def fleet_report(directory: str, depth=None) -> tuple[str, list]:
     """(rendered rollup, warnings) for one fleet directory — the CLI
     entrypoint shared by ``trace report --fleet`` and ``fleet
-    status``."""
+    status``.  When the directory is a live queue dir, the rollup also
+    carries its measured depth, per-shard/per-lane queued depths and
+    the pool controller's decisions (:func:`queue_extras`)."""
     heartbeats, events, warnings = collect_fleet(directory)
-    return render_fleet(fleet_rollup(heartbeats, events,
-                                     depth=depth)), warnings
+    extras = queue_extras(directory)
+    if depth is None:
+        depth = extras.get("depth")
+    rollup = fleet_rollup(heartbeats, events, depth=depth)
+    rollup.update(extras)
+    return render_fleet(rollup), warnings

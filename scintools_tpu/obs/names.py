@@ -52,6 +52,16 @@ COUNTERS = frozenset({
     "segments_quarantined", "segment_salvaged_rows",
     # reliability
     "epochs_quarantined", "store_corrupt_rows", "faults_injected",
+    # fleet pool controller (serve/pool.py — ISSUE 13): backpressure-
+    # driven scale decisions, stale-worker replacement, spawn failures
+    "pool_scale_up", "pool_scale_down", "pool_stale_replaced",
+    "pool_spawn_failed",
+    # claim-time affinity routing (JobQueue.claim under hints): warm-
+    # here claims, warm-elsewhere claims taken after the grace window,
+    # deferrals left for the warm worker, memory-unfit deferrals left
+    # for a roomier worker
+    "affinity_hits", "affinity_misses", "affinity_deferred",
+    "pool_mem_deferred",
 })
 
 # -- gauges (obs.gauge) -----------------------------------------------------
@@ -62,6 +72,8 @@ GAUGES = frozenset({
     # hbm_bytes_in_use additionally streams timestamped events per
     # execute window (the headroom timeline)
     "hbm_bytes_in_use", "hbm_bytes_limit",
+    # pool controller (serve/pool.py): live worker-process count
+    "pool_workers",
 })
 
 # -- spans (obs.span / obs.traced) ------------------------------------------
@@ -110,9 +122,12 @@ FAMILIES = frozenset({
     # measured per-signature peak HBM beside the step_bytes model
     # (obs/devmem window attribution; key = <stage>:<B>x<grid>:<dtype>)
     "step_hbm_peak",                                # gauge
-    # per-shard queued depth beside the total queue_depth gauge (the
-    # documented total+breakdown pair pattern)
+    # per-shard AND per-lane queued depth beside the total queue_depth
+    # gauge (the documented total+breakdown pair pattern; lane keys are
+    # spelled "lane:<lane>" to stay distinct from shard numbers)
     "queue_depth",                                  # gauge (per shard)
+    # per-QoS-lane claim counts (ISSUE 13 weighted-fair claim order)
+    "lane_claims",                                  # counter (per lane)
 })
 
 _SETS = {"inc": COUNTERS, "gauge": GAUGES, "span": SPANS,

@@ -13,7 +13,9 @@ docs/serving.md).
 
 from .batcher import Batch, DynamicBatcher, bucket_key  # noqa: F401
 from .client import SurveyClient  # noqa: F401
-from .queue import (DEFAULT_MAX_RETRIES, Job, JobQueue,  # noqa: F401
-                    cfg_signature, job_key)
+from .pool import PoolConfig, PoolController  # noqa: F401
+from .queue import (DEFAULT_MAX_RETRIES, LANES, ClaimHints,  # noqa: F401
+                    Job, JobQueue, cfg_signature, job_key, job_sig,
+                    parse_lane_budgets)
 from .worker import (ServeWorker, config_from_opts,  # noqa: F401
                      load_epoch, pipeline_runner, synthetic_runner)

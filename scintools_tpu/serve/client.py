@@ -23,34 +23,38 @@ class SurveyClient:
         self.queue = JobQueue(queue_dir)
 
     # -- submission --------------------------------------------------------
-    def submit(self, paths: Sequence[str],
-               opts: dict | None = None) -> list[dict]:
+    def submit(self, paths: Sequence[str], opts: dict | None = None,
+               lane: str | None = None) -> list[dict]:
         """Submit epoch files for processing under ``opts`` (the
         estimator options a ``process --batched`` run would take).
         Idempotent per (file content, opts): re-submitting reports the
         existing state instead of duplicating.  A nonexistent path
         (typo, unexpanded glob) reports ``status="missing"`` with
-        ``job=None`` instead of poisoning the queue.  Returns one
-        record per path: ``{file, job, status}``."""
+        ``job=None`` instead of poisoning the queue.  ``lane`` picks
+        the QoS lane (default interactive; scheduling only — never job
+        identity).  Returns one record per path: ``{file, job,
+        status}``."""
         opts = dict(opts or {})
         out = []
         for p in paths:
             if not os.path.exists(p):
                 out.append({"file": p, "job": None, "status": "missing"})
                 continue
-            job_id, status = self.queue.submit(p, opts)
+            job_id, status = self.queue.submit(p, opts, lane=lane)
             out.append({"file": p, "job": job_id, "status": status})
         return out
 
-    def submit_synthetic(self, spec: dict,
-                         opts: dict | None = None) -> dict:
+    def submit_synthetic(self, spec: dict, opts: dict | None = None,
+                         lane: str | None = None) -> dict:
         """Submit one on-device synthetic campaign (`simulate` job
         kind): ``spec`` is a sparse ``sim.campaign.spec_to_dict``
         payload (e.g. ``{"kind": "screen", "n_epochs": 1024}``),
         ``opts`` the estimator options.  Idempotent per (canonical
-        spec, opts).  Returns ``{spec, job, status}``."""
-        job_id, status = self.queue.submit_synthetic(spec,
-                                                     dict(opts or {}))
+        spec, opts).  ``lane`` defaults to bulk — campaigns are the
+        traffic the QoS lanes keep from starving live submits.
+        Returns ``{spec, job, status}``."""
+        job_id, status = self.queue.submit_synthetic(
+            spec, dict(opts or {}), lane=lane)
         return {"spec": dict(spec), "job": job_id, "status": status}
 
     def compact(self) -> dict:
@@ -69,14 +73,25 @@ class SurveyClient:
         return self.queue.results.get(job_id)
 
     def wait(self, job_ids: Sequence[str], timeout: float = 60.0,
-             poll_s: float = 0.2) -> dict:
+             poll_s: float = 0.2, poll_cap_s: float = 5.0) -> dict:
         """Block until every job is terminal (done or failed) or the
         timeout lapses.  Returns ``{done: [...], failed: [...],
-        pending: [...]}``."""
+        pending: [...]}``.
+
+        Poll cadence backs off EXPONENTIALLY while nothing changes
+        (x1.6 per idle tick, capped at ``poll_cap_s``, with ±25 %
+        jitter so a fleet of waiting clients decorrelates instead of
+        hammering the queue directory in lockstep) and snaps back to
+        ``poll_s`` the moment any job goes terminal — a long idle
+        campaign costs one directory walk per cap interval, while an
+        actively-draining one is tracked at full resolution."""
+        import random
+
         deadline = time.time() + timeout
         pending = list(job_ids)
         done: list[str] = []
         failed: list[str] = []
+        delay = float(poll_s)
         while pending and time.time() < deadline:
             still = []
             # one queued-directory walk per tick answers "still queued"
@@ -100,9 +115,15 @@ class SurveyClient:
                     done.append(job_id)
                 else:
                     still.append(job_id)
+            progressed = len(still) < len(pending)
             pending = still
             if pending:
-                time.sleep(poll_s)
+                # the cap never undercuts an explicitly slower poll_s
+                cap = max(float(poll_cap_s), float(poll_s))
+                delay = (float(poll_s) if progressed
+                         else min(delay * 1.6, cap))
+                time.sleep(min(delay * (0.75 + 0.5 * random.random()),
+                               max(deadline - time.time(), 0.0)))
         return {"done": done, "failed": failed, "pending": pending}
 
     # -- results -----------------------------------------------------------

@@ -1,0 +1,502 @@
+"""Fleet pool controller: elastic autoscaling, QoS-aware drain, and
+warm/memory-affinity claim hints for the serve queue (ISSUE 13 — the
+"millions of users" control plane of ROADMAP item 1).
+
+The controller is a PURE CONSUMER of telemetry the system already
+emits: merged worker heartbeats (PR 10 — per-beat ``jobs_done`` deltas
+-> drain rate; warm signatures; PR 12 device-memory headroom) plus the
+live queue depth, folded into the documented ``backpressure =
+depth / (depth + drain * 60 s)`` scalar.  Three responsibilities:
+
+1. **Elasticity.**  Spawn worker subprocesses (``scintools-tpu serve
+   ... --worker-id pool-<pid>-<n> --ignore-drain``) when backpressure
+   crosses the high-water threshold; drain one (the per-worker drain
+   marker — the worker stops claiming, finishes the batches it holds,
+   consumes the marker, exits) below the low-water one.  Min/max
+   bounds, a scale cooldown, and STALE-worker replacement (a live
+   process whose heartbeat froze is killed and respawned — the
+   GPU real-time search stacks' "keep the resident pipeline fed or
+   replace it" discipline, arXiv 1804.05335).
+
+2. **Claim hints.**  Each round the controller folds every fresh
+   heartbeat's ``warm_sigs`` (the bucket/config signatures that worker
+   has already executed — the warm-affinity signal) and ``devmem``
+   headroom into ONE atomically-rewritten ``control/hints.json``;
+   workers read it (mtime-gated) and honour it inside
+   ``JobQueue.claim``: claim warm-here jobs eagerly
+   (``affinity_hits``), briefly defer jobs warm elsewhere
+   (``affinity_deferred`` -> the warm worker lands them instead of
+   recompiling), and leave jobs bigger than the published headroom for
+   a roomier worker (``pool_mem_deferred``) — time-bounded, so hints
+   delay placement but never starve a job.
+
+3. **Operator surface.**  Every round lands an atomic
+   ``control/pool.json`` snapshot (decisions, worker table, lane
+   depths, backpressure) that ``fleet status`` / ``trace report
+   --fleet`` render, plus obs counters ``pool_scale_up/down`` /
+   ``pool_stale_replaced`` and the ``pool_workers`` gauge.
+
+Failure model: chaos sites ``pool.spawn`` and ``pool.drain`` (PR 5
+registry) prove a failed spawn/drain degrades to a logged, counted
+skip — and scale-down can never lose a job, because the drain marker
+only ever asks a worker to STOP CLAIMING; anything already leased is
+finished by that worker or lease-reaped by the survivors.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+from .. import faults, obs
+from ..obs import fleet
+from ..utils.log import get_logger, log_event
+from .queue import (DEFAULT_AFFINITY_DEFER_S, DEFAULT_MEM_DEFER_S,
+                    ClaimHints, JobQueue)
+
+HINTS_BASENAME = "hints.json"
+POOL_STATUS_BASENAME = "pool.json"
+HINTS_VERSION = 1
+# cap the per-worker preferred-signature list a hints file carries (a
+# long-lived worker accumulates warm signatures without bound; the
+# newest are the ones still resident)
+MAX_PREFER_SIGS = 64
+
+
+def hints_path(queue_dir: str) -> str:
+    """Path of the claim-hints file under a queue dir."""
+    return os.path.join(queue_dir, "control", HINTS_BASENAME)
+
+
+def pool_status_path(queue_dir: str) -> str:
+    """Path of the controller status snapshot under a queue dir."""
+    return os.path.join(queue_dir, "control", POOL_STATUS_BASENAME)
+
+
+def _write_json(path: str, payload: dict) -> str:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, default=str)
+    os.replace(tmp, path)
+    return path
+
+
+def _read_json(path: str) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return data if isinstance(data, dict) else None
+
+
+def write_hints(queue_dir: str, workers: dict,
+                defer_s: float = DEFAULT_AFFINITY_DEFER_S,
+                mem_defer_s: float = DEFAULT_MEM_DEFER_S) -> str:
+    """Atomically rewrite the claim-hints file: ``workers`` maps
+    worker id -> ``{"prefer": [sig, ...], "max_bytes": int | None}``."""
+    return _write_json(hints_path(queue_dir), {
+        "kind": "pool_hints", "v": HINTS_VERSION,
+        "ts": round(time.time(), 6), "pid": os.getpid(),
+        "defer_s": float(defer_s), "mem_defer_s": float(mem_defer_s),
+        "workers": workers})
+
+
+def read_hints(queue_dir: str) -> dict | None:
+    """The current hints payload; torn/missing/foreign degrades to
+    None (hints are advisory — a reader must never fail on them)."""
+    data = _read_json(hints_path(queue_dir))
+    if data is None or data.get("kind") != "pool_hints":
+        return None
+    return data
+
+
+def claim_hints_for(data: dict | None,
+                    worker_id: str) -> ClaimHints | None:
+    """This worker's :class:`~.queue.ClaimHints` view of a hints
+    payload: its own preferred signatures + headroom bound, and the
+    union of every OTHER worker's preferences (the defer set).  None
+    when the payload carries no workers (claim runs unhinted)."""
+    workers = (data or {}).get("workers") or {}
+    if not isinstance(workers, dict) or not workers:
+        return None
+    mine = workers.get(worker_id) or {}
+    prefer = frozenset(str(s) for s in (mine.get("prefer") or ()))
+    elsewhere = frozenset(
+        str(s) for wid, ent in workers.items()
+        if wid != worker_id and isinstance(ent, dict)
+        for s in (ent.get("prefer") or ())) - prefer
+    max_bytes = mine.get("max_bytes")
+    if not isinstance(max_bytes, (int, float)):
+        max_bytes = None
+    return ClaimHints(
+        prefer=prefer, elsewhere=elsewhere,
+        max_bytes=int(max_bytes) if max_bytes is not None else None,
+        defer_s=float(data.get("defer_s", DEFAULT_AFFINITY_DEFER_S)),
+        mem_defer_s=float(data.get("mem_defer_s",
+                                   DEFAULT_MEM_DEFER_S)))
+
+
+def read_pool_status(queue_dir: str) -> dict | None:
+    """The controller's last ``control/pool.json`` snapshot (None when
+    no controller has run here / the file is torn)."""
+    data = _read_json(pool_status_path(queue_dir))
+    if data is None or data.get("kind") != "pool":
+        return None
+    return data
+
+
+def hints_from_heartbeats(heartbeats, now: float) -> dict:
+    """Per-worker hint entries from FRESH heartbeats: ``warm_sigs``
+    (published by the worker, newest-capped) -> ``prefer``; the devmem
+    headroom (PR 12 — in-use vs limit, the same figure the predictive
+    OOM admission trusts) -> ``max_bytes``.  Stale workers publish no
+    hints: a frozen heartbeat's warmth/headroom describes a process
+    that may be gone."""
+    out: dict[str, dict] = {}
+    for hb in heartbeats:
+        wid = hb.get("worker")
+        if not wid or fleet.heartbeat_stale(hb, now):
+            continue
+        ent: dict = {}
+        sigs = hb.get("warm_sigs")
+        if isinstance(sigs, (list, tuple)) and sigs:
+            ent["prefer"] = [str(s) for s in sigs][-MAX_PREFER_SIGS:]
+        mem = hb.get("devmem")
+        if isinstance(mem, dict):
+            head = mem.get("headroom")
+            if isinstance(head, (int, float)) and head > 0:
+                ent["max_bytes"] = int(head)
+        if ent:
+            out[str(wid)] = ent
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class PoolConfig:
+    """Controller thresholds.  ``high_water``/``low_water`` are
+    backpressure bounds (0.5 = backlog equals one 60 s horizon of
+    drain — the documented natural scale-up point); ``cooldown_s``
+    spaces scale DECISIONS so one burst cannot slam the pool between
+    bounds; ``stale_grace_s`` is how long a fresh spawn may run before
+    a stale/absent heartbeat makes it replaceable."""
+
+    min_workers: int = 1
+    max_workers: int = 4
+    high_water: float = 0.5
+    low_water: float = 0.1
+    cooldown_s: float = 15.0
+    poll_s: float = 1.0
+    stale_grace_s: float = 60.0
+    drain_grace_s: float = 60.0
+    # replacement threshold for a FROZEN heartbeat: a worker blocked in
+    # one long execute/compile (on-chip cold compiles have measured
+    # minutes) writes no beats while it works, so the kill rule must be
+    # far more conservative than the 3x-interval STALE *rendering* —
+    # beat age must exceed max(3x interval, stale_kill_s)
+    stale_kill_s: float = 300.0
+
+    def __post_init__(self):
+        if self.min_workers < 0:
+            raise ValueError(f"min_workers={self.min_workers}: "
+                             "must be >= 0")
+        if self.max_workers < max(self.min_workers, 1):
+            raise ValueError(
+                f"max_workers={self.max_workers}: must be >= "
+                f"max(min_workers, 1) = {max(self.min_workers, 1)}")
+        if not 0.0 <= self.low_water < self.high_water <= 1.0:
+            raise ValueError(
+                f"watermarks must satisfy 0 <= low < high <= 1, got "
+                f"low={self.low_water} high={self.high_water}")
+
+
+class PoolController:
+    """One control process per queue directory (``scintools-tpu pool
+    QDIR``).  ``spawn`` is injectable for tests: ``spawn(worker_id) ->
+    Popen-like`` (``poll``/``terminate``/``kill``/``pid``); the
+    default launches ``scintools-tpu serve`` subprocesses with
+    ``worker_args`` appended (stdout/stderr to
+    ``control/worker-logs/<id>.log``)."""
+
+    def __init__(self, queue_dir: str, config: PoolConfig | None = None,
+                 spawn=None, worker_args=()):
+        self.queue = JobQueue(queue_dir)
+        self.cfg = config or PoolConfig()
+        self.worker_args = list(worker_args)
+        self.spawn = spawn if spawn is not None else self._default_spawn
+        # worker_id -> {"proc", "spawned_at", "draining", "drained_at"}
+        self.workers: dict[str, dict] = {}
+        self._n = 0
+        self._last_scale = float("-inf")
+        self.stats = {"rounds": 0, "scale_up": 0, "scale_down": 0,
+                      "stale_replaced": 0, "spawn_failed": 0,
+                      "drain_failed": 0, "worker_exits": 0}
+        self._last_hint_entries: dict | None = None
+        self._last_decision: str | None = None
+        self.log = get_logger()
+
+    # -- spawning ----------------------------------------------------------
+    def _next_worker_id(self) -> str:
+        self._n += 1
+        return f"pool-{os.getpid()}-{self._n}"
+
+    def _default_spawn(self, worker_id: str):
+        logdir = os.path.join(self.queue.dir, "control", "worker-logs")
+        os.makedirs(logdir, exist_ok=True)
+        cmd = [sys.executable, "-m", "scintools_tpu", "serve",
+               self.queue.dir, "--worker-id", worker_id,
+               "--ignore-drain"] + self.worker_args
+        # --ignore-drain: pool workers' lifecycle belongs to the
+        # CONTROLLER (per-worker markers + shutdown); racing N workers
+        # at the one global marker would stop an arbitrary subset
+        with open(os.path.join(logdir, f"{worker_id}.log"),
+                  "a") as logfh:
+            return subprocess.Popen(cmd, stdout=logfh,
+                                    stderr=subprocess.STDOUT)
+
+    def _spawn_one(self, reason: str,
+                   now: float | None = None) -> str | None:
+        wid = self._next_worker_id()
+        try:
+            # chaos site (kind="error"): a spawn failure (exec error,
+            # fork limit) must degrade to a counted, logged skip the
+            # next round retries — never crash the control loop
+            faults.check("pool.spawn")
+            proc = self.spawn(wid)
+        except Exception as e:
+            self.stats["spawn_failed"] += 1
+            obs.inc("pool_spawn_failed")
+            log_event(self.log, "pool_spawn_failed", worker=wid,
+                      reason=reason, error=repr(e))
+            return None
+        self.workers[wid] = {"proc": proc,
+                             "spawned_at": (time.time() if now is None
+                                            else now),
+                             "draining": False, "drained_at": None}
+        log_event(self.log, "pool_spawn", worker=wid, reason=reason,
+                  pid=getattr(proc, "pid", None))
+        return wid
+
+    # -- lifecycle bookkeeping ---------------------------------------------
+    def _reap_children(self, now: float | None = None) -> None:
+        now = time.time() if now is None else now
+        for wid, w in list(self.workers.items()):
+            rc = w["proc"].poll()
+            if rc is None:
+                if w["draining"] and w["drained_at"] is not None and \
+                        now - w["drained_at"] > self.cfg.drain_grace_s:
+                    # polite drain ignored (wedged worker): terminate,
+                    # then ESCALATE to kill on the next expiry — a
+                    # worker stuck in uninterruptible IO must not stay
+                    # a zombie in the pool forever; its leased jobs
+                    # are reclaimed by lease expiry either way
+                    if w.get("term_sent"):
+                        w["proc"].kill()
+                    else:
+                        w["proc"].terminate()
+                        w["term_sent"] = True
+                    w["drained_at"] = now          # re-arm the grace
+                continue
+            del self.workers[wid]
+            self.queue.clear_worker_drain(wid)
+            self.stats["worker_exits"] += 1
+            log_event(self.log, "pool_worker_exit", worker=wid, rc=rc,
+                      draining=bool(w["draining"]))
+
+    def _replace_stale(self, heartbeats: dict, now: float) -> None:
+        for wid, w in list(self.workers.items()):
+            if w["draining"]:
+                continue
+            if now - w["spawned_at"] < self.cfg.stale_grace_s:
+                continue   # still starting up (compiles, imports)
+            hb = heartbeats.get(wid)
+            if hb is not None:
+                iv = hb.get("interval_s")
+                iv = float(iv) if isinstance(iv, (int, float)) else 0.0
+                age = now - hb.get("ts", now)
+                # the STALE rendering threshold (3x interval) excludes
+                # a worker from the drain rate; KILLING it demands a
+                # frozen beat far beyond any legitimate blocking window
+                # (the worker beats only between poll rounds, so one
+                # long execute/compile — minutes on a cold chip —
+                # freezes the file while the worker is hard at work)
+                if age <= max(3.0 * iv, self.cfg.stale_kill_s):
+                    continue
+            # a live process whose heartbeat froze (or never appeared)
+            # past every legitimate window is not serving: kill it —
+            # its leases reap — and respawn
+            try:
+                w["proc"].kill()
+            except Exception as e:  # fault-ok: already-dead race
+                log_event(self.log, "pool_kill_failed", worker=wid,
+                          error=repr(e))
+            del self.workers[wid]
+            self.stats["stale_replaced"] += 1
+            obs.inc("pool_stale_replaced")
+            log_event(self.log, "pool_stale_replaced", worker=wid)
+            self._spawn_one("stale_replacement", now)
+
+    def _alive(self) -> list[str]:
+        return [wid for wid, w in self.workers.items()
+                if not w["draining"]]
+
+    def _pick_drain(self, alive, heartbeats: dict) -> str:
+        """The scale-down victim: the idlest worker (largest last-claim
+        age from its heartbeat), tiebroken toward the youngest spawn —
+        drain the one doing the least, keep the warmed-up veterans."""
+        def idle_key(wid):
+            hb = heartbeats.get(wid) or {}
+            age = hb.get("last_claim_age_s")
+            idle = age if isinstance(age, (int, float)) else -1.0
+            return (idle, self.workers[wid]["spawned_at"])
+
+        return max(alive, key=idle_key)
+
+    # -- one control round -------------------------------------------------
+    def poll_once(self, now: float | None = None) -> dict:
+        """Reap -> replace-stale -> scale -> publish hints + status.
+        Returns the status snapshot written to ``control/pool.json``."""
+        now = time.time() if now is None else now
+        self.stats["rounds"] += 1
+        self._reap_children(now)
+        hb_dir = os.path.join(self.queue.dir, fleet.HEARTBEAT_DIRNAME)
+        heartbeats = {hb.get("worker"): hb
+                      for hb in fleet.read_heartbeats(hb_dir)}
+        self._replace_stale(heartbeats, now)
+        counts = self.queue.counts()
+        depth = counts["queued"] + counts["leased"]
+        merged = fleet.merge_heartbeats(heartbeats.values(), now=now)
+        bp = fleet.backpressure(depth, merged["drain_rate_per_s"])
+        alive = self._alive()
+        decision = None
+        cooled = now - self._last_scale >= self.cfg.cooldown_s
+        if len(alive) < self.cfg.min_workers:
+            # the floor is unconditional: a pool below min is not a
+            # scaling judgment, it is a hole (first round, crashed
+            # worker) — refill immediately, no cooldown, no counter
+            if self._spawn_one("min_floor", now) is not None:
+                decision = "spawn_to_min"
+        elif (bp >= self.cfg.high_water
+              and len(alive) < self.cfg.max_workers and cooled):
+            if self._spawn_one("backpressure", now) is not None:
+                self.stats["scale_up"] += 1
+                obs.inc("pool_scale_up")
+                self._last_scale = now
+                decision = "scale_up"
+        elif (bp <= self.cfg.low_water
+              and len(alive) > self.cfg.min_workers and cooled):
+            wid = self._pick_drain(alive, heartbeats)
+            try:
+                # chaos site (kind="error"): a failed drain request
+                # must leave the worker serving and the queue intact —
+                # scale-down is advisory, jobs are never at risk
+                faults.check("pool.drain")
+                self.queue.request_worker_drain(wid)
+            except Exception as e:
+                self.stats["drain_failed"] += 1
+                log_event(self.log, "pool_drain_failed", worker=wid,
+                          error=repr(e))
+            else:
+                self.workers[wid]["draining"] = True
+                self.workers[wid]["drained_at"] = now
+                self.stats["scale_down"] += 1
+                obs.inc("pool_scale_down")
+                self._last_scale = now
+                decision = "scale_down"
+                log_event(self.log, "pool_drain", worker=wid)
+        if decision:
+            self._last_decision = decision
+        entries = hints_from_heartbeats(heartbeats.values(), now)
+        # rewrite only on CHANGE (or a vanished file): every worker
+        # stat-gates its reparse on (mtime, size) — an every-round
+        # rewrite with a fresh ts would defeat that fast path
+        if entries != self._last_hint_entries \
+                or not os.path.exists(hints_path(self.queue.dir)):
+            write_hints(self.queue.dir, entries)
+            self._last_hint_entries = entries
+        obs.gauge("pool_workers", len(self.workers))
+        status = {
+            "kind": "pool", "v": 1, "ts": round(now, 6),
+            "pid": os.getpid(),
+            "backpressure": bp, "depth": depth,
+            "drain_rate_per_s": merged["drain_rate_per_s"],
+            "min_workers": self.cfg.min_workers,
+            "max_workers": self.cfg.max_workers,
+            "high_water": self.cfg.high_water,
+            "low_water": self.cfg.low_water,
+            "workers": {wid: {"pid": getattr(w["proc"], "pid", None),
+                              "draining": bool(w["draining"])}
+                        for wid, w in self.workers.items()},
+            "lane_depths": self.queue.lane_depths(),
+            "decision": decision,
+            "last_decision": self._last_decision,
+            "stats": dict(self.stats),
+        }
+        try:
+            _write_json(pool_status_path(self.queue.dir), status)
+        except OSError as e:  # fault-ok: status snapshot only
+            log_event(self.log, "pool_status_write_failed",
+                      error=repr(e))
+        return status
+
+    # -- the resident control loop -----------------------------------------
+    def run(self, max_rounds: int | None = None,
+            exit_on_drain: bool = True) -> dict:
+        """Control until told to stop: ``max_rounds`` rounds executed
+        (tests/smokes), or — with ``exit_on_drain`` — a GLOBAL drain
+        request with the queue empty (the controller then drains its
+        workers, consumes the marker and exits, mirroring the single-
+        worker drain contract)."""
+        log_event(self.log, "pool_start", queue=self.queue.dir,
+                  min=self.cfg.min_workers, max=self.cfg.max_workers,
+                  high=self.cfg.high_water, low=self.cfg.low_water)
+        try:
+            while True:
+                self.poll_once()
+                if max_rounds is not None \
+                        and self.stats["rounds"] >= max_rounds:
+                    break
+                if exit_on_drain and self.queue.drain_requested() \
+                        and self.queue.empty():
+                    self.shutdown()
+                    self.queue.clear_drain()
+                    break
+                time.sleep(self.cfg.poll_s)
+        finally:
+            log_event(self.log, "pool_exit", **self.stats)
+        return dict(self.stats)
+
+    def shutdown(self, timeout_s: float = 30.0) -> None:
+        """Drain every worker politely, then terminate stragglers.
+        Leased jobs are never lost: a drained worker finishes what it
+        holds before exiting, and a terminated one's leases are
+        reclaimed by ``reap_expired`` wherever the queue next runs."""
+        for wid in list(self.workers):
+            try:
+                self.queue.request_worker_drain(wid)
+            except OSError as e:  # fault-ok: terminate path below
+                log_event(self.log, "pool_drain_failed", worker=wid,
+                          error=repr(e))
+        deadline = time.time() + timeout_s
+        while self.workers and time.time() < deadline:
+            self._reap_children()
+            if self.workers:
+                time.sleep(0.1)
+        for wid, w in list(self.workers.items()):
+            try:
+                w["proc"].terminate()
+                w["proc"].wait(timeout=5.0)
+            except Exception as e:  # fault-ok: best-effort teardown
+                log_event(self.log, "pool_terminate_failed",
+                          worker=wid, error=repr(e))
+                try:
+                    w["proc"].kill()
+                except Exception:  # fault-ok: already dead
+                    pass
+            self.queue.clear_worker_drain(wid)
+            del self.workers[wid]

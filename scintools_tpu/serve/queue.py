@@ -12,8 +12,13 @@ Layout (all JSON, one file per job, written tmp+``os.replace`` so a
 crash can never leave a torn record)::
 
     qdir/
-      queued/<ss>/<stamp>-<job_id>.json
-                              submitted, waiting for a worker.  <ss> =
+      queued/<lane>/<ss>/<stamp>-<job_id>.json
+                              submitted, waiting for a worker.
+                              <lane> = the job's QoS lane (ISSUE 13):
+                              ``interactive`` or ``bulk`` — claim order
+                              is weighted-fair over the lanes, so a
+                              million-epoch bulk campaign can never
+                              starve a live observer's job.  <ss> =
                               the job's SHARD, crc32(job_id) mod N —
                               the flat queued/ dir was the listdir/
                               rename contention point at production
@@ -24,20 +29,33 @@ crash can never leave a torn record)::
                               <stamp> = 17-digit submit microseconds,
                               so each shard's sorted listdir IS its
                               FIFO order; claim merges the shard heads
-                              by stamp, preserving global submit order
-                              while every directory op (submit, the
-                              claim rename, the O(1) unlink probes)
-                              lands in a dir of depth/N entries.
-                              Legacy flat queued/<stamp>-<id>.json and
-                              unstamped queued/<id>.json records are
-                              still read and drained.
+                              by stamp, preserving per-lane submit
+                              order while every directory op (submit,
+                              the claim rename, the O(1) unlink
+                              probes) lands in a dir of depth/N
+                              entries.  Legacy pre-lane
+                              queued/<ss>/..., flat
+                              queued/<stamp>-<id>.json and unstamped
+                              queued/<id>.json records are still read
+                              and drained — as the BULK lane.
       leased/<job_id>.json    claimed by a worker, lease expiry inside
       done/<job_id>.json      completed (result row in results/)
       failed/<job_id>.json    terminal: retries exhausted (poison input)
       results/                utils.store.ResultsStore (idempotent rows;
                               segment plane under results/segments/)
       control/drain           drain marker (serve exits when empty)
+      control/drain.<worker>  per-worker drain marker (ISSUE 13): the
+                              pool controller's scale-down handle — the
+                              named worker stops claiming, finishes the
+                              batches it holds, consumes the marker and
+                              exits; every other worker ignores it
       control/shards          persisted queued-shard count
+      control/hints.json      pool-controller claim hints (serve/pool):
+                              per-worker preferred warm signatures +
+                              max admissible batch bytes, honoured by
+                              :meth:`JobQueue.claim`
+      control/pool.json       pool-controller status snapshot (rendered
+                              by ``fleet status``)
 
 Semantics:
 
@@ -92,6 +110,30 @@ TRANSIENT_ESCALATION_FACTOR = 10
 # process probes the same shard paths
 DEFAULT_QUEUE_SHARDS = 8
 MAX_QUEUE_SHARDS = 256
+
+# QoS lanes (ISSUE 13).  The lane is a SCHEDULING attribute, never part
+# of the job identity (the same epoch+options submitted on either lane
+# dedups to one job): "interactive" for live observers' submits,
+# "bulk" for campaign traffic (`simulate` jobs default here).  Legacy
+# laneless queued records drain as bulk.
+LANE_INTERACTIVE, LANE_BULK = "interactive", "bulk"
+LANES = (LANE_INTERACTIVE, LANE_BULK)
+# weighted-fair claim budgets: per claim cycle, up to budget[lane]
+# candidates are taken from each lane in LANES order before the cycle
+# repeats — so an interactive head job is claimed after at most
+# budget[bulk] bulk jobs (the pinned starvation bound), while bulk
+# still progresses whenever interactive work is thinner than its
+# budget (unused slots fall through within the same cycle)
+DEFAULT_LANE_BUDGETS = {LANE_INTERACTIVE: 3, LANE_BULK: 1}
+
+# affinity-hint deferral (serve/pool claim hints): a job whose warm
+# signature is preferred by ANOTHER worker is left on the queue for
+# this grace window so the warm worker can claim it first; memory-unfit
+# jobs (est_bytes over the worker's hinted headroom) wait the longer
+# window below before any worker takes them anyway (a hint must delay
+# placement, never starve a job no worker advertises room for)
+DEFAULT_AFFINITY_DEFER_S = 2.0
+DEFAULT_MEM_DEFER_S = 30.0
 
 _LAST_STAMP = 0.0
 
@@ -187,6 +229,73 @@ def job_key(path: str, cfg: dict) -> str:
     return content_key(path, ("serve",) + cfg_signature(cfg))
 
 
+def job_sig(cfg: dict) -> str:
+    """The job's WARM-AFFINITY signature: a short digest of the
+    canonical option dict (which, for `simulate` jobs, embeds the
+    whole campaign spec).  Jobs sharing it run the same pipeline
+    config — the dominant recompile driver across a mixed queue — so a
+    worker that has executed one is warm for the rest.  Coarser than
+    the compiled step signature on purpose: the axes identity needs
+    the epoch LOADED, and the hint must be computable from the job
+    record alone at claim time."""
+    return content_key(("sig",) + cfg_signature(cfg))[:12]
+
+
+def validate_lane(lane: str | None, default: str) -> str:
+    """Normalise/validate a submit-time lane choice."""
+    if lane is None:
+        return default
+    if lane not in LANES:
+        raise ValueError(f"lane={lane!r}: expected one of "
+                         f"{'/'.join(LANES)}")
+    return lane
+
+
+def parse_lane_budgets(text: str) -> dict:
+    """``"interactive=3,bulk=1"`` -> budgets dict (the serve
+    ``--lane-budgets`` flag).  A zero budget starves that lane only
+    while other lanes have work (claim falls back when every budgeted
+    lane is empty)."""
+    out: dict[str, int] = {}
+    for part in str(text).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        lane, sep, val = part.partition("=")
+        lane = lane.strip()
+        if not sep or lane not in LANES:
+            raise ValueError(f"--lane-budgets entry {part!r}: expected "
+                             f"LANE=N with LANE in {'/'.join(LANES)}")
+        try:
+            n = int(val)
+        except ValueError:
+            raise ValueError(f"--lane-budgets {lane}: {val!r} is not "
+                             "an integer")
+        if n < 0:
+            raise ValueError(f"--lane-budgets {lane}: budget must be "
+                             ">= 0")
+        out[lane] = n
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class ClaimHints:
+    """Pool-controller claim hints for ONE worker (serve/pool.py builds
+    these from ``control/hints.json``): ``prefer`` = warm signatures
+    this worker should claim eagerly; ``elsewhere`` = signatures some
+    OTHER worker is warm for (deferred for ``defer_s`` so the warm
+    worker lands them instead of this one recompiling); ``max_bytes`` =
+    the admissible staged/generated batch size from this worker's
+    published HBM headroom (bigger jobs wait ``mem_defer_s`` for a
+    roomier worker, then run anyway under the driver's OOM backoff)."""
+
+    prefer: frozenset = frozenset()
+    elsewhere: frozenset = frozenset()
+    max_bytes: int | None = None
+    defer_s: float = DEFAULT_AFFINITY_DEFER_S
+    mem_defer_s: float = DEFAULT_MEM_DEFER_S
+
+
 @dataclasses.dataclass(frozen=True)
 class Job:
     """One queued unit of work (an observing epoch + its options)."""
@@ -216,6 +325,15 @@ class Job:
     # chain survives crossing worker processes (SIGKILL, reap, requeue)
     trace_id: str | None = None
     span: str | None = None
+    # QoS lane (ISSUE 13): scheduling only, never job identity.  None =
+    # legacy record, drained as bulk.
+    lane: str | None = None
+    # warm-affinity signature (job_sig) + a rough staged/generated-batch
+    # byte estimate: the claim-time routing inputs the pool controller's
+    # hints compare against (both optional — legacy records route
+    # normally)
+    sig: str | None = None
+    est_bytes: int | None = None
 
     def to_record(self) -> dict:
         return {k: v for k, v in dataclasses.asdict(self).items()
@@ -249,8 +367,9 @@ class JobQueue:
             os.makedirs(os.path.join(directory, sub), exist_ok=True)
         self.nshards = self._init_shards(shards)
         self._shard_width = max(2, len(str(self.nshards - 1)))
-        for i in range(self.nshards):
-            os.makedirs(self._shard_dir(i), exist_ok=True)
+        for lane in LANES:
+            for i in range(self.nshards):
+                os.makedirs(self._lane_shard_dir(lane, i), exist_ok=True)
         self.results = ResultsStore(os.path.join(directory, "results"))
 
     # -- queued-namespace sharding -----------------------------------------
@@ -304,15 +423,34 @@ class JobQueue:
         return f"{shard:0{self._shard_width}d}"
 
     def _shard_dir(self, shard: int) -> str:
+        """The LEGACY (pre-lane) shard dir — still read/drained."""
         return os.path.join(self.dir, QUEUED, self._shard_name(shard))
 
-    def _queued_dirs(self) -> list[str]:
-        """Every directory queued records can live in: the N shard
-        dirs plus the flat ``queued/`` root (legacy pre-shard queues
-        keep draining — shard subdir names never end in ``.json`` so
-        the flat walks skip them for free)."""
-        return ([self._shard_dir(i) for i in range(self.nshards)]
-                + [os.path.join(self.dir, QUEUED)])
+    def _lane_shard_dir(self, lane: str, shard: int) -> str:
+        return os.path.join(self.dir, QUEUED, lane,
+                            self._shard_name(shard))
+
+    @staticmethod
+    def _lane_of(job: "Job") -> str:
+        """The lane a record WRITES into (legacy/None -> bulk — the
+        documented drain lane for laneless records, and deterministic
+        so ``_remove_queued``'s probes stay O(1))."""
+        return job.lane if job.lane in LANES else LANE_BULK
+
+    def _queued_dirs(self) -> list[tuple[str | None, str]]:
+        """Every ``(lane, directory)`` queued records can live in: the
+        lane x shard dirs, the legacy pre-lane shard dirs and the flat
+        ``queued/`` root (both legacy layouts keep draining, as the
+        bulk lane: ``lane=None`` here).  Subdir names never end in
+        ``.json`` so the flat walks skip them for free."""
+        out: list[tuple[str | None, str]] = []
+        for lane in LANES:
+            out.extend((lane, self._lane_shard_dir(lane, i))
+                       for i in range(self.nshards))
+        out.extend((None, self._shard_dir(i))
+                   for i in range(self.nshards))
+        out.append((None, os.path.join(self.dir, QUEUED)))
+        return out
 
     # -- paths / low-level records -----------------------------------------
     # Queued jobs are named "<17-digit-microsecond-stamp>-<job_id>.json"
@@ -340,10 +478,11 @@ class JobQueue:
     def _path(self, state: str, job_id: str) -> str:
         return os.path.join(self.dir, state, f"{job_id}.json")
 
-    def _queued_path(self, job_id: str, submitted_at: float) -> str:
-        return os.path.join(self._shard_dir(self._shard_of(job_id)),
-                            f"{self._stamp_prefix(submitted_at)}-"
-                            f"{job_id}.json")
+    def _queued_path(self, job_id: str, submitted_at: float,
+                     lane: str = LANE_BULK) -> str:
+        return os.path.join(
+            self._lane_shard_dir(lane, self._shard_of(job_id)),
+            f"{self._stamp_prefix(submitted_at)}-{job_id}.json")
 
     def _find_queued_all(self, job_id: str) -> list[str]:
         """EVERY queued file for ``job_id`` (stamped and/or legacy) —
@@ -352,16 +491,18 @@ class JobQueue:
         more.  Read paths (``_read``/``state_of``) use this scan;
         removal stays O(1) (``_remove_queued``) because any survivor
         of a finished job is garbage-collected by ``claim``'s
-        terminal-state guard instead of re-executing.  Two bounded
-        directory-name scans (the id's OWN shard + the flat legacy
-        root), no file opens."""
+        terminal-state guard instead of re-executing.  Bounded
+        directory-name scans (the id's OWN shard per lane + the legacy
+        shard + the flat root), no file opens."""
         suffix = f"-{job_id}.json"
         out = []
         plain = self._path(QUEUED, job_id)
         if os.path.exists(plain):
             out.append(plain)
-        for d in (self._shard_dir(self._shard_of(job_id)),
-                  os.path.join(self.dir, QUEUED)):
+        shard = self._shard_of(job_id)
+        for d in ([self._lane_shard_dir(lane, shard) for lane in LANES]
+                  + [self._shard_dir(shard),
+                     os.path.join(self.dir, QUEUED)]):
             try:
                 with os.scandir(d) as it:
                     for e in it:
@@ -378,23 +519,27 @@ class JobQueue:
         return hits[0] if hits else None
 
     def _write(self, state: str, job: Job) -> None:
-        path = (self._queued_path(job.id, job.submitted_at)
+        path = (self._queued_path(job.id, job.submitted_at,
+                                  self._lane_of(job))
                 if state == QUEUED else self._path(state, job.id))
         tmp = f"{path}.tmp{os.getpid()}"
         with open(tmp, "w") as fh:
             json.dump(job.to_record(), fh)
         os.replace(tmp, path)
         if state == QUEUED:
-            # legacy duplicates must not survive a sharded rewrite: the
-            # flat unstamped name (pre-stamp queues) and the flat
-            # STAMPED name (pre-shard queues) — two O(1) probes
+            # legacy duplicates must not survive a lane-sharded
+            # rewrite: the flat unstamped name (pre-stamp queues), the
+            # flat STAMPED name (pre-shard queues) and the laneless
+            # sharded name (pre-lane queues) — three O(1) probes
             # (requeue of a legacy job after its claim consumed the old
             # file is the normal path; this covers direct ones)
+            stamped = f"{self._stamp_prefix(job.submitted_at)}-" \
+                      f"{job.id}.json"
             for stale in (self._path(QUEUED, job.id),
+                          os.path.join(self.dir, QUEUED, stamped),
                           os.path.join(
-                              self.dir, QUEUED,
-                              f"{self._stamp_prefix(job.submitted_at)}-"
-                              f"{job.id}.json")):
+                              self._shard_dir(self._shard_of(job.id)),
+                              stamped)):
                 if stale != path and os.path.exists(stale):
                     self._remove_file(stale)
 
@@ -414,7 +559,7 @@ class JobQueue:
     def _ids(self, state: str) -> list[str]:
         if state == QUEUED:
             out = []
-            for d in self._queued_dirs():
+            for _lane, d in self._queued_dirs():
                 try:
                     names = os.listdir(d)
                 except OSError:
@@ -427,16 +572,17 @@ class JobQueue:
                  if f.endswith(".json") and ".tmp" not in f]
         return sorted(os.path.splitext(f)[0] for f in names)
 
-    def _queued_entries(self) -> list[tuple[float, str, str]]:
-        """Sorted ``(submit stamp, job_id, path)`` for every queued
-        record — the queued-namespace walk shared by :meth:`claim`
-        (FIFO order) and :meth:`status` (oldest age).  Each shard's
-        stamped names sort without being opened and the per-shard FIFO
-        lists merge by stamp, so global order equals submit order;
-        only legacy unstamped records pay a read to learn their
-        submit time."""
+    def _queued_entries(self) -> list[tuple[float, str, str, str]]:
+        """Sorted ``(submit stamp, job_id, path, lane)`` for every
+        queued record — the queued-namespace walk shared by
+        :meth:`claim` (FIFO order) and :meth:`status` (oldest age).
+        Each shard's stamped names sort without being opened and the
+        per-shard FIFO lists merge by stamp, so order-within-a-lane
+        equals submit order; only legacy unstamped records pay a read
+        to learn their submit time.  Lane comes from the DIRECTORY (no
+        file open); both legacy layouts report as the bulk lane."""
         entries = []
-        for d in self._queued_dirs():
+        for lane, d in self._queued_dirs():
             try:
                 names = os.listdir(d)
             except OSError:
@@ -451,33 +597,99 @@ class JobQueue:
                     if job is None:
                         continue
                     stamp = job.submitted_at
-                entries.append((stamp, jid, path))
+                entries.append((stamp, jid, path, lane or LANE_BULK))
         entries.sort()
         return entries
 
+    def _claim_order(self, lane_budgets: dict | None
+                     ) -> list[tuple[float, str, str, str]]:
+        """Queued entries in WEIGHTED-FAIR claim order: repeat cycles
+        that take up to ``budgets[lane]`` FIFO candidates from each
+        lane in :data:`LANES` order.  The starvation bound this pins:
+        a lane's head candidate appears after at most
+        ``sum(other lanes' budgets)`` foreign candidates, however deep
+        the other lanes' backlogs run.  A zero budget parks a lane
+        while any budgeted lane still has entries (and drains it
+        otherwise — budgets shape priority, they never deadlock the
+        queue)."""
+        entries = self._queued_entries()
+        by_lane: dict[str, list] = {}
+        for e in entries:
+            by_lane.setdefault(e[3], []).append(e)
+        if len(by_lane) <= 1:
+            return entries
+        budgets = dict(DEFAULT_LANE_BUDGETS)
+        budgets.update(lane_budgets or {})
+        order: list = []
+        cursors = {lane: 0 for lane in by_lane}
+
+        def _remaining(lane):
+            return len(by_lane[lane]) - cursors[lane]
+
+        while any(_remaining(lane) for lane in by_lane):
+            took = 0
+            for lane in LANES:
+                if lane not in by_lane:
+                    continue
+                take = min(max(int(budgets.get(lane, 1)), 0),
+                           _remaining(lane))
+                if take:
+                    i = cursors[lane]
+                    order.extend(by_lane[lane][i:i + take])
+                    cursors[lane] = i + take
+                    took += take
+            if not took:
+                # every lane with work has budget 0: drain FIFO-by-
+                # stamp anyway rather than deadlocking the claim
+                tail = []
+                for lane in by_lane:
+                    tail.extend(by_lane[lane][cursors[lane]:])
+                    cursors[lane] = len(by_lane[lane])
+                order.extend(sorted(tail))
+                break
+        return order
+
+    @staticmethod
+    def _count_json(d: str) -> int:
+        try:
+            names = os.listdir(d)
+        except OSError:
+            return 0
+        return sum(1 for f in names
+                   if f.endswith(".json") and ".tmp" not in f)
+
     def shard_depths(self) -> dict[str, int]:
-        """Per-shard queued depth (one listdir per shard; the flat
-        legacy root reports under ``"flat"`` only when non-empty) —
-        the ``fleet status`` readout for depth concentrating in one
-        shard."""
+        """Per-shard queued depth summed over the lanes + the legacy
+        laneless shard dir (one listdir each; the flat legacy root
+        reports under ``"flat"`` only when non-empty) — the ``fleet
+        status`` readout for depth concentrating in one shard."""
         out: dict[str, int] = {}
         for i in range(self.nshards):
-            try:
-                names = os.listdir(self._shard_dir(i))
-            except OSError:
-                names = []
-            out[self._shard_name(i)] = sum(
-                1 for f in names
-                if f.endswith(".json") and ".tmp" not in f)
-        try:
-            flat = sum(1 for f in os.listdir(os.path.join(self.dir,
-                                                          QUEUED))
-                       if f.endswith(".json") and ".tmp" not in f)
-        except OSError:
-            flat = 0
+            n = self._count_json(self._shard_dir(i))
+            for lane in LANES:
+                n += self._count_json(self._lane_shard_dir(lane, i))
+            out[self._shard_name(i)] = n
+        flat = self._count_json(os.path.join(self.dir, QUEUED))
         if flat:
             out["flat"] = flat
         return out
+
+    def _lane_depth(self, lane: str) -> int:
+        """One lane's queued depth; bulk folds in the legacy laneless
+        layouts (pre-lane shard dirs + the flat root)."""
+        n = sum(self._count_json(self._lane_shard_dir(lane, i))
+                for i in range(self.nshards))
+        if lane == LANE_BULK:
+            n += sum(self._count_json(self._shard_dir(i))
+                     for i in range(self.nshards))
+            n += self._count_json(os.path.join(self.dir, QUEUED))
+        return n
+
+    def lane_depths(self) -> dict[str, int]:
+        """Per-lane queued depth (legacy laneless records count as
+        bulk) — the ``fleet status`` / pool-controller readout for a
+        bulk backlog building behind the interactive lane."""
+        return {lane: self._lane_depth(lane) for lane in LANES}
 
     def queued_ids(self) -> set[str]:
         """Every queued job id — ONE directory-name walk, no file
@@ -512,7 +724,8 @@ class JobQueue:
         return None
 
     # -- fleet telemetry hooks (ISSUE 10/11) -------------------------------
-    def _depth_gauge(self, job_id: str | None = None) -> None:
+    def _depth_gauge(self, job_id: str | None = None,
+                     lane: str | None = None) -> None:
         """Stamp ``queue_depth`` at a state TRANSITION (submit/
         complete/fail): a timeline sampled only inside ``serve.poll``
         aliases at low poll rates — the transition points are where
@@ -534,14 +747,25 @@ class JobQueue:
         obs.gauge("queue_depth", depth, stream=True)
         if job_id is not None:
             shard = self._shard_of(job_id)
-            try:
-                names = os.listdir(self._shard_dir(shard))
-            except OSError:
-                names = []
-            n = sum(1 for f in names
-                    if f.endswith(".json") and ".tmp" not in f)
+            n = self._count_json(self._shard_dir(shard))
+            for ln in LANES:
+                n += self._count_json(self._lane_shard_dir(ln, shard))
             obs.gauge(f"queue_depth[{self._shard_name(shard)}]", n,
                       stream=True)
+        if lane is not None:
+            self._lane_gauge(lane)
+
+    def _lane_gauge(self, lane: str) -> None:
+        """Stamp the transitioning job's LANE depth as a streamed
+        ``queue_depth[lane:<lane>]`` gauge event (same family as the
+        per-shard stamps; only the lane whose count changed is
+        stamped).  Bulk folds the legacy laneless layouts in
+        (``_lane_depth``) — the timeline and the ``lane_depths``
+        status readout must agree on a mid-migration queue."""
+        if not obs.enabled():
+            return
+        obs.gauge(f"queue_depth[lane:{lane}]", self._lane_depth(lane),
+                  stream=True)
 
     def _hop(self, job: Job, name: str, **attrs) -> Job:
         """Record one lifecycle hop of ``job``'s distributed trace (an
@@ -557,19 +781,23 @@ class JobQueue:
         return job if sid is None else dataclasses.replace(job, span=sid)
 
     # -- client side -------------------------------------------------------
-    def submit(self, path: str, cfg: dict | None = None) -> tuple[str, str]:
+    def submit(self, path: str, cfg: dict | None = None,
+               lane: str | None = None) -> tuple[str, str]:
         """Enqueue one epoch file.  Returns ``(job_id, status)``:
         ``"submitted"`` for a fresh submission, or — for an idempotent
         dedup hit — the job's existing state (``queued/leased/done/
         failed``); a result row already in the store reports ``"done"``
         without touching the queue at all (the dedup-against-the-store
-        contract)."""
+        contract).  ``lane`` (default interactive for file submits)
+        picks the QoS lane — scheduling only, never job identity, so a
+        re-submit on the other lane dedups instead of forking."""
         if not os.path.exists(path):
             # fail fast: content_key would silently hash the path
             # SPELLING (an unmatched glob pattern, a typo) and the
             # worker would burn its whole retry budget discovering it
             raise FileNotFoundError(f"cannot submit {path!r}: no such "
                                     "file")
+        lane = validate_lane(lane, LANE_INTERACTIVE)
         cfg = dict(cfg or {})
         validate_job_cfg(cfg)
         job_id = job_key(path, cfg)
@@ -578,17 +806,38 @@ class JobQueue:
         existing = self.state_of(job_id)
         if existing is not None:
             return job_id, existing
+        try:
+            est = int(os.path.getsize(path))
+        except OSError:  # fault-ok: best-effort routing hint only
+            est = None
         trace = new_trace_id()
         root = obs.event("job.submit", trace_id=trace, job=job_id,
-                         file=os.path.basename(path))
+                         file=os.path.basename(path), lane=lane)
         self._write(QUEUED, Job(id=job_id, file=os.path.abspath(path),
                                 cfg=cfg, submitted_at=_submit_stamp(),
-                                trace_id=trace, span=root))
-        self._depth_gauge(job_id)
+                                trace_id=trace, span=root, lane=lane,
+                                sig=job_sig(cfg), est_bytes=est))
+        self._depth_gauge(job_id, lane=lane)
         return job_id, "submitted"
 
-    def submit_synthetic(self, spec: dict,
-                         cfg: dict | None = None) -> tuple[str, str]:
+    @staticmethod
+    def _synth_est_bytes(spec) -> int | None:
+        """Rough generated-batch footprint of a `simulate` job (the
+        dynspec batch materialises in HBM even though the staged input
+        is keys-only) — the memory-fit routing hint.  The grid comes
+        from the campaign's own shape rule (one source per kind).
+        Best-effort: None when the grid is not derivable."""
+        from ..sim.campaign import synth_shape
+
+        try:
+            nf, nt = synth_shape(spec)
+            return int(spec.n_epochs) * int(nf) * int(nt) * 4
+        except (AttributeError, TypeError,
+                ValueError):  # fault-ok: routing hint only
+            return None
+
+    def submit_synthetic(self, spec: dict, cfg: dict | None = None,
+                         lane: str | None = None) -> tuple[str, str]:
         """Enqueue one on-device synthetic campaign (`simulate` job
         kind): ``spec`` is a sparse :func:`scintools_tpu.sim.campaign.
         spec_to_dict` payload, ``cfg`` the estimator options a normal
@@ -600,14 +849,17 @@ class JobQueue:
         so ``cfg_signature`` separates the identities by construction
         (and the worker routes simulate jobs around the batcher
         entirely).  Idempotent like :meth:`submit`: a campaign whose
-        epoch-0 row already exists reports ``done``."""
+        epoch-0 row already exists reports ``done``.  ``lane`` defaults
+        to BULK — campaigns are the traffic class the QoS lanes exist
+        to keep from starving live submits."""
         from ..sim import campaign
 
+        lane = validate_lane(lane, LANE_BULK)
         cfg = dict(cfg or {})
         # canonicalise through the spec class: sparse and materialised
         # payloads of the same campaign must share one job identity
-        cfg["synthetic"] = campaign.spec_to_dict(
-            campaign.spec_from_dict(spec))
+        spec_obj = campaign.spec_from_dict(spec)
+        cfg["synthetic"] = campaign.spec_to_dict(spec_obj)
         validate_job_cfg(cfg)
         job_id = content_key("synthetic", ("serve",) + cfg_signature(cfg))
         if campaign.synth_row_key(job_id, 0) in self.results:
@@ -618,11 +870,14 @@ class JobQueue:
         kind = cfg["synthetic"].get("kind", "screen")
         trace = new_trace_id()
         root = obs.event("job.submit", trace_id=trace, job=job_id,
-                         file=f"synthetic:{kind}")
+                         file=f"synthetic:{kind}", lane=lane)
         self._write(QUEUED, Job(id=job_id, file=f"synthetic:{kind}",
                                 cfg=cfg, submitted_at=_submit_stamp(),
-                                trace_id=trace, span=root))
-        self._depth_gauge(job_id)
+                                trace_id=trace, span=root, lane=lane,
+                                sig=job_sig(cfg),
+                                est_bytes=self._synth_est_bytes(
+                                    spec_obj)))
+        self._depth_gauge(job_id, lane=lane)
         return job_id, "submitted"
 
     def submit_compact(self) -> tuple[str, str]:
@@ -640,33 +895,62 @@ class JobQueue:
         job_id = content_key(("compact", stamp), cfg_signature(cfg))
         trace = new_trace_id()
         root = obs.event("job.submit", trace_id=trace, job=job_id,
-                         file="compact:")
+                         file="compact:", lane=LANE_BULK)
         self._write(QUEUED, Job(id=job_id, file="compact:", cfg=cfg,
-                                submitted_at=stamp,
+                                submitted_at=stamp, lane=LANE_BULK,
                                 trace_id=trace, span=root))
-        self._depth_gauge(job_id)
+        self._depth_gauge(job_id, lane=LANE_BULK)
         return job_id, "submitted"
 
     # -- worker side -------------------------------------------------------
+    def _hint_defer(self, job: Job, hints: ClaimHints,
+                    now: float) -> bool:
+        """Whether claim hints say to LEAVE this candidate for another
+        worker this poll.  Both deferrals are time-bounded by the
+        job's queue age, so a hint can delay placement but never
+        starve a job nothing else will take."""
+        age = now - job.submitted_at
+        if (hints.max_bytes is not None and job.est_bytes
+                and job.est_bytes > hints.max_bytes
+                and age < hints.mem_defer_s):
+            obs.inc("pool_mem_deferred")
+            return True
+        if (job.sig and job.sig in hints.elsewhere
+                and job.sig not in hints.prefer
+                and age < hints.defer_s):
+            obs.inc("affinity_deferred")
+            return True
+        return False
+
     def claim(self, worker: str, n: int, lease_s: float,
-              now: float | None = None) -> list[Job]:
-        """Lease up to ``n`` runnable queued jobs (FIFO by submit time,
-        backoff-eligible only).  The queued->leased ``os.rename`` is
-        the race arbiter: a loser's rename raises and it simply moves
-        on.  The winner immediately rewrites the leased record with
-        the lease stamp (worker id + expiry).
+              now: float | None = None,
+              lane_budgets: dict | None = None,
+              hints: ClaimHints | None = None) -> list[Job]:
+        """Lease up to ``n`` runnable queued jobs (weighted-fair over
+        the QoS lanes via :meth:`_claim_order`, FIFO by submit time
+        within a lane, backoff-eligible only).  The queued->leased
+        ``os.rename`` is the race arbiter: a loser's rename raises and
+        it simply moves on.  The winner immediately rewrites the
+        leased record with the lease stamp (worker id + expiry).
 
         The submit stamp is encoded in the queued FILENAME, so the
         sorted listdir itself is FIFO and only the head candidates are
         opened — ~``n`` file reads per poll plus any skipped
-        (backoff/leased-dup) jobs ahead of them, instead of the whole
-        queue depth.  Legacy unstamped names (queues written before
-        this scheme) are still honoured: only those pay a read to
-        learn their submit time, and they merge into the same FIFO
-        order."""
+        (backoff/leased-dup/hint-deferred) jobs ahead of them, instead
+        of the whole queue depth.  Legacy unstamped names (queues
+        written before this scheme) are still honoured: only those pay
+        a read to learn their submit time, and they merge into the
+        bulk lane's FIFO order.
+
+        ``hints`` (pool-controller affinity/memory routing) defer
+        candidates that are warm elsewhere or too big for this
+        worker's headroom — counted as ``affinity_deferred`` /
+        ``pool_mem_deferred``; a claimed candidate counts
+        ``affinity_hits`` (warm here) or ``affinity_misses`` (was warm
+        elsewhere, taken after its grace window anyway)."""
         now = time.time() if now is None else now
         claimed: list[Job] = []
-        for stamp, jid, path in self._queued_entries():
+        for stamp, jid, path, lane in self._claim_order(lane_budgets):
             if len(claimed) >= n:
                 break
             # a queued duplicate of a still-leased job (crash window of
@@ -686,6 +970,8 @@ class JobQueue:
             job = self._read_file(path)
             if job is None or job.not_before > now:
                 continue
+            if hints is not None and self._hint_defer(job, hints, now):
+                continue
             try:
                 # chaos site (kind="oserror"): a lost claim race — the
                 # winner-take-one rename semantics must skip, not fail
@@ -695,6 +981,12 @@ class JobQueue:
                 continue  # another worker won this one
             obs.inc("queue_shard_claims"
                     f"[{self._shard_name(self._shard_of(jid))}]")
+            obs.inc(f"lane_claims[{lane}]")
+            if hints is not None and job.sig:
+                if job.sig in hints.prefer:
+                    obs.inc("affinity_hits")
+                elif job.sig in hints.elsewhere:
+                    obs.inc("affinity_misses")
             # stamp the lease onto the record we actually renamed, not
             # the pre-rename read: another worker may have failed+
             # requeued this job in the read->rename window, and its
@@ -782,21 +1074,24 @@ class JobQueue:
         self._remove_file(self._path(state, job_id))
 
     def _remove_queued(self, job: Job) -> None:
-        """Drop ``job``'s queued record(s) in O(1): the sharded
+        """Drop ``job``'s queued record(s) in O(1): the lane-sharded
         stamped filename is deterministic from the record (requeues
-        never mutate ``submitted_at``, JSON round-trips the float
-        exactly, and the shard is a pure hash of the id against the
-        persisted shard count), and the only other variants any
-        version ever wrote are the flat stamped name (pre-shard) and
-        the flat plain name (pre-stamp) — three unlink probes cover
-        every layout plus the crash window between ``_write``'s
-        sharded write and its legacy unlinks, with no directory scan
+        never mutate ``submitted_at`` or ``lane``, JSON round-trips
+        the float exactly, and the shard is a pure hash of the id
+        against the persisted shard count), and the only other
+        variants any version ever wrote are the laneless sharded name
+        (pre-lane), the flat stamped name (pre-shard) and the flat
+        plain name (pre-stamp) — four unlink probes cover every layout
+        plus the crash window between ``_write``'s lane-sharded write
+        and its legacy unlinks, with no directory scan
         (``complete``/``fail`` run this once per job in the worker's
         hot loop)."""
-        self._remove_file(self._queued_path(job.id, job.submitted_at))
+        stamped = f"{self._stamp_prefix(job.submitted_at)}-{job.id}.json"
+        self._remove_file(self._queued_path(job.id, job.submitted_at,
+                                            self._lane_of(job)))
         self._remove_file(os.path.join(
-            self.dir, QUEUED,
-            f"{self._stamp_prefix(job.submitted_at)}-{job.id}.json"))
+            self._shard_dir(self._shard_of(job.id)), stamped))
+        self._remove_file(os.path.join(self.dir, QUEUED, stamped))
         self._remove_file(self._path(QUEUED, job.id))
 
     def complete(self, job: Job) -> None:
@@ -810,7 +1105,7 @@ class JobQueue:
         self._remove(LEASED, job.id)
         self._remove_queued(job)
         self._remove(FAILED, job.id)
-        self._depth_gauge(job.id)
+        self._depth_gauge(job.id, lane=self._lane_of(job))
 
     def fail(self, job: Job, error: str, retryable: bool = True,
              transient: bool = False, now: float | None = None) -> str:
@@ -841,7 +1136,7 @@ class JobQueue:
                 or os.path.exists(self._path(DONE, job.id)):
             self._remove(LEASED, job.id)
             self._remove_queued(job)
-            self._depth_gauge(job.id)
+            self._depth_gauge(job.id, lane=self._lane_of(job))
             return DONE
         if transient and retryable \
                 and job.transients < self.max_transients:
@@ -853,7 +1148,7 @@ class JobQueue:
                 lease_worker=None, lease_expires_at=None,
                 not_before=now + self._backoff(transients)))
             self._remove(LEASED, job.id)
-            self._depth_gauge(job.id)
+            self._depth_gauge(job.id, lane=self._lane_of(job))
             return QUEUED
         attempts = job.attempts + 1
         rec = dataclasses.replace(job, attempts=attempts, error=error,
@@ -872,7 +1167,7 @@ class JobQueue:
         self._remove(LEASED, job.id)
         if state == FAILED:
             self._remove_queued(job)
-        self._depth_gauge(job.id)
+        self._depth_gauge(job.id, lane=self._lane_of(job))
         return state
 
     # -- introspection / control -------------------------------------------
@@ -887,6 +1182,12 @@ class JobQueue:
         st["drain_requested"] = self.drain_requested()
         st["shards"] = self.nshards
         entries = self._queued_entries()
+        # per-lane depths fall out of the same walk for free (legacy
+        # layouts already report as bulk) — no second listdir pass
+        lanes = {lane: 0 for lane in LANES}
+        for e in entries:
+            lanes[e[3]] = lanes.get(e[3], 0) + 1
+        st["lanes"] = lanes
         # submit ages straight from the filename stamps (shared walk
         # with claim; only legacy records were opened)
         oldest = (now - entries[0][0]) if entries else None
@@ -921,3 +1222,34 @@ class JobQueue:
 
     def drain_requested(self) -> bool:
         return os.path.exists(self._drain_path())
+
+    # per-worker drain (ISSUE 13): the pool controller's scale-down
+    # handle — same tmp+replace marker protocol as the global drain,
+    # but only the NAMED worker honours it (stop claiming, finish the
+    # batches it holds, consume the marker, exit); the queue keeps
+    # draining through every other worker, so scale-down can never
+    # lose or strand a job
+    @staticmethod
+    def _safe_worker(worker_id: str) -> str:
+        return "".join(c if (c.isalnum() or c in "._-") else "_"
+                       for c in worker_id) or "worker"
+
+    def _worker_drain_path(self, worker_id: str) -> str:
+        return os.path.join(self.dir, "control",
+                            f"drain.{self._safe_worker(worker_id)}")
+
+    def request_worker_drain(self, worker_id: str) -> None:
+        path = self._worker_drain_path(worker_id)
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "w") as fh:
+            fh.write(str(time.time()))
+        os.replace(tmp, path)
+
+    def worker_drain_requested(self, worker_id: str) -> bool:
+        return os.path.exists(self._worker_drain_path(worker_id))
+
+    def clear_worker_drain(self, worker_id: str) -> None:
+        try:
+            os.remove(self._worker_drain_path(worker_id))
+        except OSError:
+            pass

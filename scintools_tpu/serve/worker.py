@@ -157,7 +157,8 @@ class ServeWorker:
                  poll_s: float = 0.2, mesh=None, runner=None,
                  async_exec: bool = True, worker_id: str | None = None,
                  bucket: bool = False, synth_runner=None,
-                 heartbeat_s: float = 10.0):
+                 heartbeat_s: float = 10.0,
+                 lane_budgets: dict | None = None):
         self.queue = queue
         self.batch_size = int(batch_size)
         mult = 1
@@ -199,6 +200,17 @@ class ServeWorker:
                       "job_retries": 0, "job_transient_retries": 0,
                       "lanes_filled": 0, "lanes_total": 0,
                       "segment_flushes": 0, "rows_flushed": 0}
+        # QoS claim weighting (ISSUE 13): per-cycle lane budgets passed
+        # to JobQueue.claim (None = the queue's documented defaults)
+        self.lane_budgets = dict(lane_budgets) if lane_budgets else None
+        # warm-affinity signal: the job signatures this worker has
+        # EXECUTED (published in each heartbeat as `warm_sigs`; the
+        # pool controller folds them into claim hints); insertion-
+        # ordered so the hints cap keeps the newest
+        self._warm_sigs: dict[str, None] = {}
+        # pool-controller claim hints (control/hints.json), mtime-gated
+        self._hints = None
+        self._hints_stamp = None
         # fleet liveness: one atomically-overwritten snapshot file per
         # worker under <queue>/heartbeat/ (obs/fleet.py; heartbeat_s=0
         # disables).  Written by run()'s loop — counters/hists inside
@@ -214,21 +226,49 @@ class ServeWorker:
             else None)
 
     # -- one scheduling round ----------------------------------------------
+    def _load_hints(self):
+        """The pool controller's claim hints for THIS worker, re-parsed
+        only when ``control/hints.json`` changes (one stat per poll;
+        absent file = unhinted claim, zero further cost)."""
+        from . import pool
+
+        path = pool.hints_path(self.queue.dir)
+        try:
+            st = os.stat(path)
+        except OSError:
+            self._hints = None
+            self._hints_stamp = None
+            return None
+        stamp = (st.st_mtime_ns, st.st_size)
+        if stamp != self._hints_stamp:
+            self._hints_stamp = stamp
+            self._hints = pool.claim_hints_for(pool.read_hints(
+                self.queue.dir), self.worker_id)
+        return self._hints
+
     def poll_once(self, now: float | None = None,
-                  force_flush: bool = False) -> int:
+                  force_flush: bool = False, claim: bool = True) -> int:
         """Reap -> claim -> load -> batch -> execute.  Returns the
         number of batches executed this round.  An injected ``now``
         (tests/replay) drives EVERY clock read in the round, flush
         deadlines included; live runs re-read the wall clock at flush
-        so epoch-load time counts toward a partial bucket's wait."""
+        so epoch-load time counts toward a partial bucket's wait.
+        ``claim=False`` (the per-worker drain path) skips reap+claim
+        and only flushes/executes what the batcher already holds."""
         injected = now is not None
         now = time.time() if now is None else now
+        jobs = []
         with obs.span("serve.poll"):
-            requeued, poisoned = self.queue.reap_expired(now)
-            self._count_retries(requeued, poisoned, reason="lease_expired")
-            jobs = self.queue.claim(self.worker_id, n=self.batch_size,
-                                    lease_s=self._claim_lease_s(),
-                                    now=now)
+            if claim:
+                requeued, poisoned = self.queue.reap_expired(now)
+                self._count_retries(requeued, poisoned,
+                                    reason="lease_expired")
+                jobs = self.queue.claim(self.worker_id,
+                                        n=self.batch_size,
+                                        lease_s=self._claim_lease_s(),
+                                        now=now,
+                                        lane_budgets=self.lane_budgets,
+                                        hints=self._load_hints())
             # counts() is listdir-only; status() would open and parse
             # every queued job file per poll just to discard its
             # oldest-age readout
@@ -436,12 +476,28 @@ class ServeWorker:
         for job, row in finished:
             job = self.queue._hop(job, "job.row")
             self.queue.complete(job)
+            self._mark_warm(job)
             self.stats["jobs_done"] += 1
             obs.inc("jobs_done")
             log_event(self.log, "job_done", job=job.id,
                       file=os.path.basename(job.file),
                       tau=row.get("tau"),
                       eta=row.get("betaeta", row.get("eta")))
+
+    def _mark_warm(self, job) -> None:
+        """Record an executed job's affinity signature — the
+        `warm_sigs` heartbeat payload the pool controller folds into
+        claim hints (insertion-ordered; re-execution refreshes a sig's
+        recency).  Bounded to the controller's own newest-N cap: a
+        long-lived worker on a heterogeneous queue must not grow its
+        heartbeat (and every reader's parse) without bound."""
+        from .pool import MAX_PREFER_SIGS
+
+        if getattr(job, "sig", None):
+            self._warm_sigs.pop(job.sig, None)
+            self._warm_sigs[job.sig] = None
+            while len(self._warm_sigs) > MAX_PREFER_SIGS:
+                del self._warm_sigs[next(iter(self._warm_sigs))]
 
     def _flush_rows(self) -> int:
         """Flush the store's buffered rows as one sealed segment and
@@ -516,6 +572,7 @@ class ServeWorker:
         obs.inc("serve_synth_rows", stored)
         job = self.queue._hop(job, "job.row", rows=stored)
         self.queue.complete(job)
+        self._mark_warm(job)
         self.stats["jobs_done"] += 1
         obs.inc("jobs_done")
         log_event(self.log, "synth_job_done", job=job.id,
@@ -632,6 +689,19 @@ class ServeWorker:
                 # resident loop itself — proves the flight-recorder
                 # dump below actually fires (docs/reliability.md)
                 faults.check("worker.poll")
+                if self.queue.worker_drain_requested(self.worker_id):
+                    # pool scale-down (ISSUE 13): stop CLAIMING, flush
+                    # and execute every batch we already hold (each
+                    # claimed job completes or routes through the
+                    # normal failure taxonomy — nothing is stranded),
+                    # consume OUR marker, exit.  Other workers keep
+                    # serving; the global drain marker is untouched.
+                    while self.batcher.pending:
+                        self.poll_once(force_flush=True, claim=False)
+                    self.queue.clear_worker_drain(self.worker_id)
+                    log_event(self.log, "worker_drained",
+                              worker=self.worker_id)
+                    break
                 ran = self.poll_once()
                 if ran:
                     idle_since = None
@@ -696,9 +766,13 @@ class ServeWorker:
         if self.heartbeat is None:
             return
         try:
+            # warm_sigs = the affinity signal the pool controller
+            # routes on (empty until something has executed)
+            extra = ({"warm_sigs": list(self._warm_sigs)}
+                     if self._warm_sigs else None)
             self.heartbeat.beat(force=force,
                                 last_claim_at=self._last_claim_at,
-                                stats=self.stats)
+                                stats=self.stats, extra=extra)
         except OSError as e:  # fault-ok: liveness reporting only
             log_event(self.log, "heartbeat_failed", worker=self.worker_id,
                       error=repr(e))

@@ -189,6 +189,35 @@ class ResultsStore:
             self.flush()
         return True
 
+    def put_versioned(self, key: str, record: dict) -> bool:
+        """VERSIONED buffered put (ROADMAP item 5 open tail, for item
+        2's streaming rows): the newest write under ``key`` WINS at
+        read time — ``put_new``'s write-once dedup is deliberately
+        bypassed, so a streaming producer can advance a key's value
+        tick by tick (live curvature/timescale tracking re-issues the
+        same window key per update).
+
+        No format change: the segment plane already reads newest-
+        segment-first and dedups by key (``SegmentStore.get`` /
+        ``iter_sorted_items`` / ``compact`` all resolve duplicates
+        newest-wins), so versioning is purely this write-policy
+        change.  A not-yet-flushed buffered version supersedes both
+        earlier buffered ones (the buffer is keyed) and every sealed
+        one (``get`` consults the buffer before the segments).  Under
+        ``plane='rows'`` this degrades to an overwriting :meth:`put`.
+
+        Caveat: versioned keys must be written ONLY through this
+        method — a legacy row FILE under the same key would win every
+        read (``get`` probes row files first, the cross-plane merge
+        rule for the write-once planes)."""
+        if self.plane == "rows":
+            self.put(key, record)
+            return True
+        self._buf[key] = (record, time.time())
+        if len(self._buf) >= self.flush_rows:
+            self.flush()
+        return True
+
     def flush(self) -> int:
         """Seal the buffered rows as one segment (no-op when empty).
         Returns the number of rows made durable.  Also feeds the

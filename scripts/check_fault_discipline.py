@@ -42,7 +42,13 @@ SUBTREES = ("parallel", "serve", "ops")
 # can lose rows without a counter moving; extend alongside any new
 # storage module, pinned by tests/test_fault_discipline.py::*_is_covered
 EXTRA_FILES = (os.path.join("utils", "segments.py"),
-               os.path.join("utils", "store.py"))
+               os.path.join("utils", "store.py"),
+               # the ISSUE 13 pool controller spawns/kills worker
+               # processes — a silent swallow there can strand a fleet
+               # with no counter moving (serve/ is already walked;
+               # pinned here so a future move out of serve/ cannot
+               # silently drop it from the discipline)
+               os.path.join("serve", "pool.py"))
 # exception names whose handlers are in scope (everything-catchers)
 BROAD = {"Exception", "BaseException"}
 # call names (attribute tails) that count as reporting the failure

@@ -164,6 +164,63 @@ print('devmem plane on chip ok:', {k: int(v) for k, v in peaks.items()},
       'in_use =', int(g['hbm_bytes_in_use']))
 "
 
+POOL_CODE="
+import os, tempfile, time
+from scintools_tpu import obs
+from scintools_tpu.serve import ClaimHints, JobQueue, PoolConfig, \
+    PoolController
+from scintools_tpu.serve import pool as pool_mod
+obs.enable()
+qdir = tempfile.mkdtemp(prefix='scint_pool_gate_')
+q = JobQueue(qdir)
+tmp = tempfile.mkdtemp(prefix='scint_pool_gate_files_')
+files = []
+for i in range(8):
+    fn = os.path.join(tmp, 'e%02d.bin' % i)
+    open(fn, 'wb').write(bytes([i]) * 64)
+    files.append(fn)
+for f in files[:6]:
+    q.submit(f, {'lamsteps': True}, lane='bulk')
+for f in files[6:]:
+    q.submit(f, {'lamsteps': True}, lane='interactive')
+order = [e[3] for e in q._claim_order({'interactive': 2, 'bulk': 1})]
+assert order[:3] == ['interactive', 'interactive', 'bulk'], order
+jobs = q.claim('w', n=8, lease_s=30.0)
+assert [j.lane for j in jobs[:2]] == ['interactive', 'interactive']
+c = obs.counters()
+assert c['lane_claims[interactive]'] == 2, c
+assert c['lane_claims[bulk]'] == 6, c
+sig = jobs[0].sig
+for j in jobs:
+    q.fail(j, 'gate requeue', transient=True, now=time.time() - 10)
+hinted = q.claim('cold', n=8, lease_s=30.0,
+                 hints=ClaimHints(elsewhere=frozenset([sig]),
+                                  defer_s=3600.0))
+assert hinted == [], hinted
+assert obs.counters()['affinity_deferred'] >= 1
+warm = q.claim('warm', n=8, lease_s=30.0,
+               hints=ClaimHints(prefer=frozenset([sig])))
+assert len(warm) == 8 and obs.counters()['affinity_hits'] == 8
+
+class P:
+    pid = 1
+    def poll(self): return None
+    def kill(self): pass
+    def terminate(self): pass
+ctl = PoolController(qdir, PoolConfig(min_workers=1, max_workers=2,
+                                      cooldown_s=0.0),
+                     spawn=lambda wid: P())
+st = ctl.poll_once()
+assert st['decision'] == 'spawn_to_min', st
+st = ctl.poll_once()   # leased depth 8, no drain -> bp 1 -> scale up
+assert st['decision'] == 'scale_up', st
+assert pool_mod.read_pool_status(qdir)['stats']['scale_up'] == 1
+assert os.path.exists(pool_mod.hints_path(qdir))
+print('pool gate ok: fair-claim + hints + scale decisions',
+      {k: int(v) for k, v in obs.counters().items()
+       if 'lane' in k or 'affinity' in k or k.startswith('pool_')})
+"
+
 NUDFT_CODE="
 import numpy as np, jax, jax.numpy as jnp
 from scintools_tpu.ops.nudft import _nudft_numpy, _r_grid, nudft
@@ -264,6 +321,14 @@ echo "== devmem plane: HBM gauges + per-signature peak on chip =="
 # nonzero and the measured per-signature peak at least the staged
 # batch's model bytes
 gated "devmem plane check" 600 2 python -u -c "$DEVMEM_CODE"
+
+echo "== pool controller: QoS lanes + affinity hints + scale math =="
+# the fleet pool controller (ISSUE 13): weighted-fair lane claim
+# order, hint-driven affinity deferral/hits, and the backpressure
+# scale-up decision, exercised against the real queue dir on this
+# host — sub-minute, no worker subprocesses spawned (a fake Popen
+# stands in; the capacity lane SCINT_BENCH_FLEET=1 runs real ones)
+gated "pool controller check" 600 2 python -u -c "$POOL_CODE"
 
 echo "== nudft einsum on-chip accuracy (bf16-lowering guard) =="
 # the round-4 A/B caught the vmapped einsum NUDFT silently lowering to

@@ -49,7 +49,8 @@ def test_results_plane_modules_are_covered():
     extra = set(check_f32_discipline.EXTRA_FILES)
     pkg = os.path.join(REPO, "scintools_tpu")
     for rel in (os.path.join("utils", "segments.py"),
-                os.path.join("utils", "store.py")):
+                os.path.join("utils", "store.py"),
+                os.path.join("serve", "pool.py")):
         assert rel in extra, rel
         path = os.path.join(pkg, rel)
         assert os.path.exists(path), path

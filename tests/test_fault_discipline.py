@@ -31,7 +31,8 @@ def test_results_plane_modules_are_covered():
     pkg = os.path.join(os.path.dirname(_HERE), "scintools_tpu")
     extra = set(check_fault_discipline.EXTRA_FILES)
     for rel in (os.path.join("utils", "segments.py"),
-                os.path.join("utils", "store.py")):
+                os.path.join("utils", "store.py"),
+                os.path.join("serve", "pool.py")):
         assert rel in extra, rel
         assert os.path.exists(os.path.join(pkg, rel)), rel
 

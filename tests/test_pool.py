@@ -1,0 +1,897 @@
+"""Fleet pool controller (ISSUE 13): QoS lanes with weighted-fair
+claim order and a pinned starvation bound, legacy laneless drain,
+per-worker drain markers, warm/memory-affinity claim hints, the
+autoscaler's scale-up/scale-down/stale-replacement decisions, the
+pool.spawn / pool.drain chaos sites, client wait backoff, and the
+multi-subprocess acceptance run (scale 1->N under a bulk `simulate`
+backlog with a bounded interactive queue-wait and a byte-identical
+CSV after drain-to-min)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from synth import synth_arc_epoch
+
+from scintools_tpu import faults, obs
+from scintools_tpu.io.psrflux import write_psrflux
+from scintools_tpu.obs import fleet
+from scintools_tpu.serve import (ClaimHints, Job, JobQueue, PoolConfig,
+                                 PoolController, ServeWorker,
+                                 SurveyClient, job_sig,
+                                 parse_lane_budgets)
+from scintools_tpu.serve import pool as pool_mod
+from scintools_tpu.serve.queue import (LANE_BULK, LANE_INTERACTIVE,
+                                       validate_lane)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+OPTS = {"lamsteps": True}
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    obs.disable(flush=False)
+    obs.reset()
+    faults.clear()
+    yield
+    obs.disable(flush=False)
+    obs.reset()
+    faults.clear()
+
+
+def _write_epochs(tmp_path, seeds, nf=32, nt=32):
+    files = []
+    for s in seeds:
+        fn = str(tmp_path / f"epoch_{s:02d}.dynspec")
+        write_psrflux(synth_arc_epoch(nf=nf, nt=nt, seed=s), fn)
+        files.append(fn)
+    return files
+
+
+def _write_blobs(tmp_path, n, size=64):
+    """Cheap distinct submit payloads for queue-semantics tests (the
+    queue hashes bytes; no epoch parsing happens until claim+load)."""
+    files = []
+    for i in range(n):
+        fn = str(tmp_path / f"blob_{i:03d}.bin")
+        with open(fn, "wb") as fh:
+            fh.write(bytes([i % 256]) * size)
+        files.append(fn)
+    return files
+
+
+def _stub_runner():
+    def run(batch, batch_size, mesh, async_exec):
+        return [{"name": os.path.basename(j.file), "mjd": e.mjd,
+                 "freq": e.freq, "bw": e.bw, "tobs": e.tobs, "dt": e.dt,
+                 "df": e.df, "tau": 1.5, "tauerr": 0.1}
+                for j, e in zip(batch.jobs, batch.epochs)]
+    return run
+
+
+# ---------------------------------------------------------------------------
+# QoS lanes: weighted-fair claim order + starvation bound
+# ---------------------------------------------------------------------------
+
+
+def test_lane_fair_claim_order_and_starvation_bound(tmp_path):
+    """10 bulk jobs submitted BEFORE 3 interactive ones: the claim
+    order interleaves by lane budgets, the interactive head is claimed
+    first, and no interactive candidate waits behind more than
+    budget[bulk] bulk claims — the pinned starvation bound.  Claims
+    tick ``lane_claims[<lane>]``."""
+    files = _write_blobs(tmp_path, 13)
+    q = JobQueue(str(tmp_path / "q"))
+    for f in files[:10]:
+        q.submit(f, OPTS, lane="bulk")
+    for f in files[10:]:
+        q.submit(f, OPTS, lane="interactive")
+    order = [e[3] for e in q._claim_order({"interactive": 2, "bulk": 1})]
+    assert order == (["interactive"] * 2 + ["bulk"]
+                     + ["interactive"] + ["bulk"] * 9)
+    # starvation bound: any window before an interactive candidate
+    # holds at most budget[bulk] bulk entries
+    first_i = order.index("interactive")
+    assert first_i == 0
+    # and bulk still progresses: its head is claimed within one cycle
+    assert order.index("bulk") <= 2
+    with obs.tracing():
+        jobs = q.claim("w", n=13, lease_s=30.0)
+        c = obs.counters()
+    # default budgets (3/1): three interactive first, then bulk fills
+    assert [j.lane for j in jobs[:4]] == ["interactive"] * 3 + ["bulk"]
+    assert c["lane_claims[interactive]"] == 3
+    assert c["lane_claims[bulk]"] == 10
+    assert len(jobs) == 13
+
+
+def test_lane_zero_budget_parks_but_never_deadlocks(tmp_path):
+    files = _write_blobs(tmp_path, 4)
+    q = JobQueue(str(tmp_path / "q"))
+    q.submit(files[0], OPTS, lane="bulk")
+    q.submit(files[1], OPTS, lane="bulk")
+    q.submit(files[2], OPTS, lane="interactive")
+    q.submit(files[3], OPTS, lane="interactive")
+    # bulk budget 0: parked behind interactive...
+    order = [e[3] for e in q._claim_order({"interactive": 1, "bulk": 0})]
+    assert order == ["interactive", "interactive", "bulk", "bulk"]
+    # ...but an all-zero budget map still drains (FIFO by stamp)
+    order = [e[3] for e in q._claim_order({"interactive": 0, "bulk": 0})]
+    assert sorted(order) == ["bulk", "bulk", "interactive",
+                             "interactive"]
+    # parse/validate surfaces
+    assert parse_lane_budgets("interactive=3,bulk=1") == {
+        "interactive": 3, "bulk": 1}
+    with pytest.raises(ValueError, match="LANE=N"):
+        parse_lane_budgets("fastlane=2")
+    with pytest.raises(ValueError, match="not an integer"):
+        parse_lane_budgets("bulk=two")
+    with pytest.raises(ValueError, match=">= 0"):
+        parse_lane_budgets("bulk=-1")
+    with pytest.raises(ValueError, match="lane="):
+        validate_lane("premium", LANE_BULK)
+    assert validate_lane(None, LANE_BULK) == LANE_BULK
+
+
+def test_legacy_laneless_records_drain_as_bulk(tmp_path):
+    """A laneless record planted in the flat legacy root reads, counts
+    and claims as BULK — and a requeue migrates it into the bulk
+    lane's shard."""
+    files = _write_blobs(tmp_path, 2)
+    q = JobQueue(str(tmp_path / "q"))
+    legacy = Job(id="legacylane01", file=files[0], cfg=dict(OPTS),
+                 submitted_at=1.0)
+    with open(os.path.join(q.dir, "queued", "legacylane01.json"),
+              "w") as fh:
+        json.dump(legacy.to_record(), fh)
+    q.submit(files[1], OPTS, lane="interactive")
+    assert q.lane_depths() == {"interactive": 1, "bulk": 1}
+    assert q.status()["lanes"] == {"interactive": 1, "bulk": 1}
+    order = [(e[3], e[1]) for e in q._claim_order(None)]
+    assert ("bulk", "legacylane01") in order
+    # the streamed lane gauge agrees with lane_depths mid-migration:
+    # the laneless record folds into the bulk count
+    with obs.tracing():
+        q._lane_gauge("bulk")
+        assert obs.get_registry().gauges()[
+            "queue_depth[lane:bulk]"] == 1
+    obs.disable(flush=False)
+    obs.reset()
+    jobs = q.claim("w", n=2, lease_s=30.0)
+    legacy_claimed = next(j for j in jobs if j.id == "legacylane01")
+    q.fail(legacy_claimed, "transient")
+    shard = q._shard_name(q._shard_of("legacylane01"))
+    assert any(n.endswith("-legacylane01.json")
+               for n in os.listdir(os.path.join(
+                   q.dir, "queued", "bulk", shard)))
+
+
+def test_lane_persisted_and_depth_gauges(tmp_path):
+    """Submit lanes persist on the job record (simulate jobs default
+    bulk, files interactive), and transitions stamp the streamed
+    ``queue_depth[lane:<lane>]`` gauge family."""
+    (f,) = _write_blobs(tmp_path, 1)
+    trace = str(tmp_path / "t.jsonl")
+    with obs.tracing(jsonl=trace):
+        q = JobQueue(str(tmp_path / "q"))
+        jid, _ = q.submit(f, OPTS)
+        sid, _ = q.submit_synthetic(
+            {"kind": "acf", "n_epochs": 2, "nf": 32, "nt": 32}, OPTS)
+        assert q.get(jid).lane == "interactive"
+        syn = q.get(sid)
+        assert syn.lane == "bulk"
+        # routing inputs persisted: affinity signature + byte estimate
+        assert q.get(jid).sig == job_sig(dict(OPTS))
+        assert q.get(jid).est_bytes == os.path.getsize(f)
+        assert syn.est_bytes == 2 * 32 * 32 * 4
+    events = obs.load_events(trace)
+    lanes = [(e["name"], e["value"]) for e in events
+             if e.get("kind") == "gauge"
+             and e["name"].startswith("queue_depth[lane:")]
+    assert ("queue_depth[lane:interactive]", 1) in lanes
+    assert ("queue_depth[lane:bulk]", 1) in lanes
+
+
+def test_cli_submit_lane_flag(tmp_path, capsys):
+    from scintools_tpu.cli import main as cli_main
+
+    files = _write_epochs(tmp_path, (1,))
+    qdir = str(tmp_path / "q")
+    assert cli_main(["submit", qdir, "--lamsteps", "--lane", "bulk",
+                     files[0]]) == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    q = JobQueue(qdir)
+    assert q.get(rec["jobs"][0]["job"]).lane == "bulk"
+    assert q.lane_depths()["bulk"] == 1
+
+
+# ---------------------------------------------------------------------------
+# claim hints: warm affinity + memory fit
+# ---------------------------------------------------------------------------
+
+
+def test_hints_roundtrip_and_per_worker_view(tmp_path):
+    qdir = str(tmp_path / "q")
+    JobQueue(qdir)
+    pool_mod.write_hints(qdir, {
+        "wA": {"prefer": ["sig1"], "max_bytes": 1000},
+        "wB": {"prefer": ["sig2", "sig3"]}})
+    data = pool_mod.read_hints(qdir)
+    a = pool_mod.claim_hints_for(data, "wA")
+    assert a.prefer == frozenset({"sig1"})
+    assert a.elsewhere == frozenset({"sig2", "sig3"})
+    assert a.max_bytes == 1000
+    b = pool_mod.claim_hints_for(data, "wB")
+    assert b.prefer == frozenset({"sig2", "sig3"})
+    assert b.elsewhere == frozenset({"sig1"})
+    assert b.max_bytes is None
+    # an unknown worker defers to every advertised signature
+    c = pool_mod.claim_hints_for(data, "wC")
+    assert c.prefer == frozenset()
+    assert c.elsewhere == frozenset({"sig1", "sig2", "sig3"})
+    # empty/torn payloads degrade to None (unhinted claim)
+    assert pool_mod.claim_hints_for({"workers": {}}, "wA") is None
+    with open(pool_mod.hints_path(qdir), "w") as fh:
+        fh.write('{"kind": "pool_hints", "wor')
+    assert pool_mod.read_hints(qdir) is None
+    assert pool_mod.read_pool_status(qdir) is None
+
+
+def test_claim_hints_defer_grace_and_counters(tmp_path):
+    """A job warm ELSEWHERE is deferred for the grace window (the warm
+    worker claims it first) and counted; past the window this worker
+    takes it anyway as an affinity miss.  A memory-unfit job defers on
+    its own (longer) window.  Warm-here claims count hits."""
+    files = _write_blobs(tmp_path, 3, size=64)
+    big = str(tmp_path / "big.bin")
+    with open(big, "wb") as fh:
+        fh.write(b"x" * 4096)
+    q = JobQueue(str(tmp_path / "q"))
+    jid_cold, _ = q.submit(files[0], OPTS)
+    sig = q.get(jid_cold).sig
+    jid_big, _ = q.submit(big, OPTS)
+    hints_cold = ClaimHints(elsewhere=frozenset({sig}), defer_s=5.0)
+    hints_warm = ClaimHints(prefer=frozenset({sig}))
+    hints_small = ClaimHints(max_bytes=1024, mem_defer_s=60.0)
+    t0 = time.time()
+    with obs.tracing():
+        # within the grace window the cold worker leaves both the
+        # warm-elsewhere job and the too-big job on the queue
+        assert q.claim("cold", n=2, lease_s=30.0, now=t0,
+                       hints=ClaimHints(elsewhere=frozenset({sig}),
+                                        max_bytes=1024,
+                                        defer_s=5.0,
+                                        mem_defer_s=60.0)) == []
+        # the warm worker claims its preferred job: a hit
+        (j,) = q.claim("warm", n=1, lease_s=30.0, now=t0,
+                       hints=hints_warm)
+        assert j.id == jid_cold
+        # past the grace window the cold worker takes a warm-elsewhere
+        # job anyway: a miss, not starvation
+        q.fail(j, "transient", transient=True, now=t0)
+        (j2,) = q.claim("cold", n=1, lease_s=30.0, now=t0 + 30.0,
+                        hints=hints_cold)
+        assert j2.id == jid_cold
+        # memory fit: the small worker defers the big job inside its
+        # window, then takes it once the window lapses
+        assert q.claim("small", n=1, lease_s=30.0, now=t0,
+                       hints=hints_small) == []
+        (j3,) = q.claim("small", n=1, lease_s=30.0, now=t0 + 120.0,
+                        hints=hints_small)
+        assert j3.id == jid_big
+        c = obs.counters()
+    assert c["affinity_hits"] == 1
+    assert c["affinity_misses"] == 1
+    assert c["affinity_deferred"] == 1
+    assert c["pool_mem_deferred"] == 2
+
+
+def test_worker_loads_hints_mtime_gated_and_marks_warm(tmp_path):
+    """The worker re-parses control/hints.json only when it changes,
+    exposes its own ClaimHints view, and publishes executed job
+    signatures as the heartbeat `warm_sigs` payload."""
+    files = _write_epochs(tmp_path, (1,))
+    qdir = str(tmp_path / "q")
+    client = SurveyClient(qdir)
+    (rec,) = client.submit(files, OPTS)
+    q = JobQueue(qdir)
+    w = ServeWorker(q, batch_size=1, max_wait_s=0.0, poll_s=0.01,
+                    runner=_stub_runner(), heartbeat_s=0.0,
+                    worker_id="wA")
+    assert w._load_hints() is None
+    pool_mod.write_hints(qdir, {"wA": {"prefer": ["sigX"]},
+                                "wB": {"prefer": ["sigY"]}})
+    h = w._load_hints()
+    assert h.prefer == frozenset({"sigX"})
+    assert h.elsewhere == frozenset({"sigY"})
+    assert w._load_hints() is h          # same stamp: no re-parse
+    client.drain()
+    w.run()
+    assert list(w._warm_sigs) == [job_sig(dict(OPTS))]
+    hb = fleet.HeartbeatWriter(str(tmp_path / "hb"), "wA",
+                               interval_s=0.0)
+    hb.beat(force=True, stats=w.stats,
+            extra={"warm_sigs": list(w._warm_sigs)})
+    (read,) = fleet.read_heartbeats(str(tmp_path / "hb"))
+    assert read["warm_sigs"] == [job_sig(dict(OPTS))]
+    # the controller folds that heartbeat into hint entries
+    read["devmem"] = {"bytes_in_use": 1, "bytes_limit": 10,
+                      "headroom": 9}
+    ents = pool_mod.hints_from_heartbeats([read], now=read["ts"])
+    assert ents["wA"]["prefer"] == [job_sig(dict(OPTS))]
+    assert ents["wA"]["max_bytes"] == 9
+
+
+def test_affinity_routing_reduces_cache_misses_two_workers(tmp_path):
+    """Two-worker warm/cold acceptance: worker A warm on cfg1, worker
+    B warm on cfg2.  With affinity hints each claims its warm
+    signature (`affinity_hits` ticks, zero new compiles); unhinted
+    round-robin splits both signatures across both workers and pays
+    compiles on both (`jit_cache_miss` strictly higher)."""
+    files = _write_epochs(tmp_path, range(1, 9))
+    cfg1 = {"lamsteps": True}
+    cfg2 = {"no_arc": True}
+    sig1, sig2 = job_sig(dict(cfg1)), job_sig(dict(cfg2))
+
+    def tracking_runner(executed_sigs):
+        def run(batch, batch_size, mesh, async_exec):
+            sig = job_sig(dict(batch.cfg))
+            if sig not in executed_sigs:
+                # a signature this worker has never executed means a
+                # fresh trace+compile in the real pipeline
+                obs.inc("jit_cache_miss")
+                executed_sigs.add(sig)
+            return _stub_runner()(batch, batch_size, mesh, async_exec)
+        return run
+
+    def drive(qdir, hinted):
+        client = SurveyClient(qdir)
+        q = JobQueue(qdir)
+        warm = {"wA": {sig1}, "wB": {sig2}}
+        workers = {wid: ServeWorker(
+            q, batch_size=4, max_wait_s=0.0, poll_s=0.01,
+            runner=tracking_runner(warm[wid]), heartbeat_s=0.0,
+            worker_id=wid) for wid in ("wA", "wB")}
+        if hinted:
+            pool_mod.write_hints(qdir, {
+                wid: {"prefer": sorted(warm[wid])} for wid in workers})
+        # interleave the two signatures across the submit order
+        for i, f in enumerate(files):
+            client.submit([f], cfg1 if i % 2 == 0 else cfg2)
+        with obs.tracing():
+            # alternate single polls: round-robin arrival at the queue
+            for _ in range(12):
+                now = time.time()
+                workers["wA"].poll_once(now=now, force_flush=True)
+                workers["wB"].poll_once(now=now, force_flush=True)
+                if q.empty():
+                    break
+            c = dict(obs.counters())
+        assert q.counts()["done"] == 8
+        return c
+
+    hinted = drive(str(tmp_path / "q_hints"), hinted=True)
+    cold = drive(str(tmp_path / "q_rr"), hinted=False)
+    # affinity routing: every claim lands on its warm worker
+    assert hinted.get("jit_cache_miss", 0) == 0
+    assert hinted["affinity_hits"] == 8
+    # round-robin control: both workers pay at least one fresh compile
+    assert cold.get("jit_cache_miss", 0) >= 2
+    assert cold.get("affinity_hits", 0) == 0
+    assert hinted.get("jit_cache_miss", 0) < cold["jit_cache_miss"]
+
+
+# ---------------------------------------------------------------------------
+# per-worker drain
+# ---------------------------------------------------------------------------
+
+
+def test_worker_drain_marker_stops_one_worker_without_losing_jobs(
+        tmp_path):
+    """Scale-down safety: worker A holds CLAIMED jobs in its batcher
+    when its drain marker lands — it executes them, consumes the
+    marker and exits with the queue still full; worker B finishes the
+    backlog.  Zero lost, zero duplicated rows."""
+    files = _write_epochs(tmp_path, range(1, 7))
+    qdir = str(tmp_path / "q")
+    client = SurveyClient(qdir)
+    recs = client.submit(files[:2], OPTS)
+    q = JobQueue(qdir)
+    a = ServeWorker(q, batch_size=4, max_wait_s=60.0, poll_s=0.01,
+                    runner=_stub_runner(), heartbeat_s=0.0,
+                    worker_id="wA")
+    # A claims 2 jobs into a PARTIAL bucket (max_wait far away, fill
+    # 2/4: unflushed — exactly the held-work state a scale-down hits)
+    a.poll_once(now=time.time())
+    assert a.batcher.pending == 2
+    recs += client.submit(files[2:], OPTS)
+    q.request_worker_drain("wA")
+    stats_a = a.run()
+    # A finished exactly what it held, consumed ITS marker, left the
+    # global drain untouched and the rest of the queue intact
+    assert stats_a["jobs_done"] == 2
+    assert not q.worker_drain_requested("wA")
+    assert not q.drain_requested()
+    assert q.counts()["queued"] == 4
+    client.drain()
+    b = ServeWorker(q, batch_size=2, max_wait_s=0.0, poll_s=0.01,
+                    runner=_stub_runner(), heartbeat_s=0.0,
+                    worker_id="wB")
+    stats_b = b.run()
+    assert stats_b["jobs_done"] == 4
+    assert q.counts()["done"] == 6
+    assert sorted(q.results.keys()) == sorted(r["job"] for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# the controller: scale decisions on synthetic telemetry
+# ---------------------------------------------------------------------------
+
+
+class FakeProc:
+    _pid = 90000
+
+    def __init__(self):
+        FakeProc._pid += 1
+        self.pid = FakeProc._pid
+        self.rc = None
+        self.killed = False
+        self.terminated = False
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        self.terminated = True
+        self.rc = -15
+
+    def kill(self):
+        self.killed = True
+        self.rc = -9
+
+    def wait(self, timeout=None):
+        return self.rc
+
+
+def _beat(qdir, wid, now, done=0, delta=0, elapsed=10.0,
+          interval_s=10.0, warm_sigs=None, headroom=None):
+    """Plant one worker heartbeat file (the controller's only input)."""
+    hb = {"kind": "heartbeat", "v": 1, "worker": wid, "pid": 1,
+          "ts": now, "seq": 1, "interval_s": interval_s,
+          "elapsed_s": elapsed, "counters": {"jobs_done": done},
+          "deltas": {"jobs_done": delta}, "gauges": {}, "hists": {},
+          "last_claim_age_s": 0.5, "digests": {}}
+    if warm_sigs:
+        hb["warm_sigs"] = list(warm_sigs)
+    if headroom is not None:
+        hb["devmem"] = {"bytes_in_use": 1, "bytes_limit": headroom + 1,
+                        "headroom": headroom}
+    d = os.path.join(qdir, fleet.HEARTBEAT_DIRNAME)
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, f"{wid.replace(':', '_')}.json"),
+              "w") as fh:
+        json.dump(hb, fh)
+
+
+def test_controller_scales_up_down_replaces_stale_and_publishes(
+        tmp_path):
+    """The control loop against planted telemetry: min-floor spawn,
+    backpressure scale-up gated by the cooldown, scale-down via the
+    per-worker drain marker, stale-heartbeat replacement, and the
+    hints + pool.json publications every round."""
+    files = _write_blobs(tmp_path, 6)
+    qdir = str(tmp_path / "q")
+    spawned = {}
+
+    def spawn(wid):
+        spawned[wid] = FakeProc()
+        return spawned[wid]
+
+    cfg = PoolConfig(min_workers=1, max_workers=3, high_water=0.5,
+                     low_water=0.1, cooldown_s=5.0, stale_grace_s=20.0,
+                     stale_kill_s=60.0)
+    with obs.tracing():
+        ctl = PoolController(qdir, cfg, spawn=spawn)
+        t0 = 1000.0
+        # round 1: empty pool -> min floor (no cooldown, no counter)
+        st = ctl.poll_once(now=t0)
+        assert st["decision"] == "spawn_to_min"
+        assert len(ctl.workers) == 1 and ctl.stats["scale_up"] == 0
+        (w1,) = list(ctl.workers)
+        # backlog + a fresh heartbeat with zero drain -> bp = 1.0
+        for f in files:
+            ctl.queue.submit(f, OPTS, lane="bulk")
+        _beat(qdir, w1, t0 + 1.0, warm_sigs=["sigA"], headroom=512)
+        st = ctl.poll_once(now=t0 + 1.0)
+        assert st["backpressure"] == 1.0
+        assert st["decision"] == "scale_up"
+        assert len(ctl.workers) == 2 and ctl.stats["scale_up"] == 1
+        # cooldown: an immediate next round does NOT spawn
+        st = ctl.poll_once(now=t0 + 2.0)
+        assert st["decision"] is None and len(ctl.workers) == 2
+        # cooldown elapsed, still backed up -> third worker (the max)
+        st = ctl.poll_once(now=t0 + 7.0)
+        assert st["decision"] == "scale_up" and len(ctl.workers) == 3
+        # at max: no further spawn even at bp = 1
+        st = ctl.poll_once(now=t0 + 13.0)
+        assert st["decision"] is None and len(ctl.workers) == 3
+        # hints were published from the heartbeat (warm sigs + headroom)
+        hints = pool_mod.read_hints(qdir)
+        assert hints["workers"][w1]["prefer"] == ["sigA"]
+        assert hints["workers"][w1]["max_bytes"] == 512
+        # unchanged telemetry -> the hints file is NOT rewritten, so
+        # the workers' (mtime, size) reparse gate stays warm
+        stamp = os.stat(pool_mod.hints_path(qdir)).st_mtime_ns
+        ctl.poll_once(now=t0 + 14.0)
+        assert os.stat(pool_mod.hints_path(qdir)).st_mtime_ns == stamp
+        # drain the backlog: claims complete, fresh beats show low bp
+        for j in ctl.queue.claim("w", n=6, lease_s=30.0):
+            ctl.queue.results.put(j.id, {"name": "x", "tau": 1.0})
+            ctl.queue.complete(j)
+        t1 = t0 + 20.0
+        for wid in ctl.workers:
+            _beat(qdir, wid, t1, done=2, delta=2, elapsed=2.0)
+        st = ctl.poll_once(now=t1)
+        assert st["backpressure"] == 0.0
+        assert st["decision"] == "scale_down"
+        assert ctl.stats["scale_down"] == 1
+        draining = [wid for wid, w in ctl.workers.items()
+                    if w["draining"]]
+        assert len(draining) == 1
+        assert ctl.queue.worker_drain_requested(draining[0])
+        # the drained worker exits -> reaped, marker cleared; further
+        # rounds shed workers down to min (fresh beats each round so
+        # the stale rule stays out of the way)
+        first_drained = draining[0]
+        for _ in range(8):
+            for wid, w in list(ctl.workers.items()):
+                if w["draining"]:
+                    spawned[wid].rc = 0
+            t1 += 6.0
+            for wid, w in ctl.workers.items():
+                if not w["draining"]:
+                    _beat(qdir, wid, t1, done=2, delta=2, elapsed=2.0)
+            st = ctl.poll_once(now=t1)
+            if len(ctl.workers) == 1 and not \
+                    ctl.workers[next(iter(ctl.workers))]["draining"]:
+                break
+        assert not ctl.queue.worker_drain_requested(first_drained)
+        assert first_drained not in ctl.workers
+        assert len(ctl.workers) == cfg.min_workers == 1
+        # stale replacement: the survivor's heartbeat freezes while
+        # its process stays alive.  The kill threshold is the
+        # CONSERVATIVE max(3x interval, stale_kill_s) — a beat age
+        # inside it (a long compile) is left alone...
+        (w_last,) = list(ctl.workers)
+        st = ctl.poll_once(now=t1 + 45.0)      # age 45 < kill 60
+        assert ctl.stats["stale_replaced"] == 0
+        assert w_last in ctl.workers
+        # ...past it the worker is killed and respawned
+        t2 = t1 + 100.0
+        st = ctl.poll_once(now=t2)
+        assert ctl.stats["stale_replaced"] == 1
+        assert spawned[w_last].killed
+        assert w_last not in ctl.workers and len(ctl.workers) == 1
+        c = dict(obs.counters())
+    assert c["pool_scale_up"] == 2
+    assert c["pool_scale_down"] >= 1
+    assert c["pool_stale_replaced"] == 1
+    # the status snapshot is the fleet-status payload
+    status = pool_mod.read_pool_status(qdir)
+    assert status["min_workers"] == 1 and status["max_workers"] == 3
+    assert status["stats"]["scale_up"] == 2
+    assert "lane_depths" in status
+    text, _w = fleet.fleet_report(qdir)
+    assert "pool controller" in text
+    assert "scale_up = 2" in text
+
+
+def test_pool_spawn_chaos_degrades_and_retries(tmp_path):
+    """pool.spawn chaos: a failed spawn is counted + logged and the
+    NEXT round succeeds — the control loop never dies on it."""
+    qdir = str(tmp_path / "q")
+    procs = []
+
+    def spawn(wid):
+        procs.append(FakeProc())
+        return procs[-1]
+
+    with obs.tracing():
+        ctl = PoolController(qdir, PoolConfig(min_workers=1,
+                                              max_workers=2),
+                             spawn=spawn)
+        with faults.injected("pool.spawn",
+                             faults.FaultSpec(kind="error")):
+            st = ctl.poll_once(now=1000.0)
+        assert st["decision"] is None
+        assert ctl.stats["spawn_failed"] == 1 and not ctl.workers
+        st = ctl.poll_once(now=1001.0)
+        assert st["decision"] == "spawn_to_min"
+        assert len(ctl.workers) == 1
+        c = dict(obs.counters())
+    assert c["pool_spawn_failed"] == 1
+    assert c["faults_injected[pool.spawn]"] == 1
+
+
+def test_pool_drain_chaos_leaves_worker_serving(tmp_path):
+    """pool.drain chaos: a failed drain request leaves the victim
+    serving (no marker, not marked draining) and the decision is
+    retried on a later round — scale-down is advisory, never
+    job-destructive."""
+    files = _write_blobs(tmp_path, 2)
+    qdir = str(tmp_path / "q")
+
+    def spawn(wid):
+        return FakeProc()
+
+    cfg = PoolConfig(min_workers=1, max_workers=3, cooldown_s=0.0)
+    ctl = PoolController(qdir, cfg, spawn=spawn)
+    t0 = 1000.0
+    ctl.poll_once(now=t0)
+    # force a second worker via backlog...
+    for f in files:
+        ctl.queue.submit(f, OPTS)
+    for wid in list(ctl.workers):
+        _beat(qdir, wid, t0 + 1.0)
+    ctl.poll_once(now=t0 + 1.0)
+    assert len(ctl.workers) == 2
+    # ...then empty the queue so bp drops to 0
+    for j in ctl.queue.claim("w", n=2, lease_s=30.0):
+        ctl.queue.results.put(j.id, {"name": "x", "tau": 1.0})
+        ctl.queue.complete(j)
+    for wid in list(ctl.workers):
+        _beat(qdir, wid, t0 + 2.0, done=1, delta=1, elapsed=1.0)
+    with faults.injected("pool.drain", faults.FaultSpec(kind="error")):
+        st = ctl.poll_once(now=t0 + 2.0)
+    assert st["decision"] is None
+    assert ctl.stats["drain_failed"] == 1
+    assert all(not w["draining"] for w in ctl.workers.values())
+    assert not any(ctl.queue.worker_drain_requested(wid)
+                   for wid in ctl.workers)
+    # next round (fault exhausted): the drain goes through
+    for wid in list(ctl.workers):
+        _beat(qdir, wid, t0 + 3.0, done=1, delta=1, elapsed=1.0)
+    st = ctl.poll_once(now=t0 + 3.0)
+    assert st["decision"] == "scale_down"
+
+
+# ---------------------------------------------------------------------------
+# client wait backoff
+# ---------------------------------------------------------------------------
+
+
+def test_wait_poll_backoff_grows_caps_and_resets(tmp_path,
+                                                 monkeypatch):
+    """Idle waits back off exponentially with jitter up to the cap;
+    progress (a job going terminal) snaps the delay back to poll_s."""
+    files = _write_blobs(tmp_path, 2)
+    client = SurveyClient(str(tmp_path / "q"))
+    recs = client.submit(files, OPTS)
+    ids = [r["job"] for r in recs]
+    sleeps = []
+    clock = {"t": 1000.0}
+    monkeypatch.setattr(time, "time", lambda: clock["t"])
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        clock["t"] += max(s, 1e-3)
+        if len(sleeps) == 8:
+            # progress mid-wait: one job completes
+            client.queue.results.put(ids[0], {"name": "x", "tau": 1.0})
+
+    monkeypatch.setattr(time, "sleep", fake_sleep)
+    out = client.wait(ids, timeout=300.0, poll_s=0.2, poll_cap_s=2.0)
+    assert out["done"] == [ids[0]] and out["pending"] == [ids[1]]
+    assert len(sleeps) >= 10
+    # jitter bounds: every sleep within ±25% of [poll_s, cap] — except
+    # the FINAL one, which wait() deliberately clamps to the remaining
+    # deadline (it may land below the jitter floor)
+    assert all(0.2 * 0.75 - 1e-9 <= s <= 2.0 * 1.25 + 1e-9
+               for s in sleeps[:-1])
+    assert sleeps[-1] <= 2.0 * 1.25 + 1e-9
+    # growth while idle: strictly increasing until the cap window
+    idle = sleeps[:8]
+    assert idle[3] > idle[0]
+    assert max(idle) > 1.0                       # reached cap region
+    # reset on progress: the post-progress sleep drops back near poll_s
+    assert sleeps[8] <= 0.2 * 1.25 + 1e-9
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance: subprocess pool scales 1->N under a bulk backlog
+# ---------------------------------------------------------------------------
+
+_POOL_WORKER_SRC = """
+import os, sys, time
+from scintools_tpu.serve import JobQueue, ServeWorker
+
+qdir, wid, sleep_s = sys.argv[1], sys.argv[2], float(sys.argv[3])
+
+
+def stub(batch, batch_size, mesh, async_exec):
+    return [{"name": os.path.basename(j.file), "mjd": e.mjd,
+             "freq": e.freq, "bw": e.bw, "tobs": e.tobs, "dt": e.dt,
+             "df": e.df, "tau": 1.5, "tauerr": 0.1}
+            for j, e in zip(batch.jobs, batch.epochs)]
+
+
+def synth_stub(spec_dict, opts, mesh, async_exec, bucket):
+    time.sleep(sleep_s)
+    n = int(spec_dict.get("n_epochs", 1))
+    seed = int(spec_dict.get("seed", 0))
+    return [{"name": "synth_%05d_%04d" % (seed, i), "mjd": 60000 + i,
+             "freq": 1400.0, "bw": 16.0, "tobs": 512.0, "dt": 8.0,
+             "df": 0.5, "tau": float(seed), "tauerr": 0.1}
+            for i in range(n)]
+
+
+worker = ServeWorker(JobQueue(qdir, backoff_s=0.05), batch_size=1,
+                     max_wait_s=0.0, lease_s=15.0, poll_s=0.05,
+                     runner=stub, synth_runner=synth_stub,
+                     heartbeat_s=0.2, worker_id=wid)
+worker.run(exit_on_drain=False)
+"""
+
+
+def _inproc_synth_stub(spec_dict, opts, mesh, async_exec, bucket):
+    """The subprocess stub's row builder, verbatim (minus the sleep):
+    the byte-identity baseline must produce identical rows."""
+    n = int(spec_dict.get("n_epochs", 1))
+    seed = int(spec_dict.get("seed", 0))
+    return [{"name": "synth_%05d_%04d" % (seed, i), "mjd": 60000 + i,
+             "freq": 1400.0, "bw": 16.0, "tobs": 512.0, "dt": 8.0,
+             "df": 0.5, "tau": float(seed), "tauerr": 0.1}
+            for i in range(n)]
+
+
+def _bulk_specs(n):
+    return [{"kind": "acf", "n_epochs": 2, "nf": 32, "nt": 32,
+             "seed": 1 + i} for i in range(n)]
+
+
+def test_pool_acceptance_scales_under_bulk_backlog(tmp_path):
+    """ISSUE 13 acceptance: the controller scales 1->N subprocess
+    workers under a bulk `simulate` backlog, an interactive job
+    submitted mid-backlog completes with bounded queue-wait while bulk
+    work is still pending, the pool drains back to min with zero
+    lost/duplicated rows, and the exported CSV is byte-identical to a
+    single-worker run of the same jobs."""
+    qdir = str(tmp_path / "q")
+    (epoch_file,) = _write_epochs(tmp_path, (7,))
+    client = SurveyClient(qdir)
+    n_bulk, sleep_s = 10, 0.6
+    bulk_ids = [client.submit_synthetic(s, OPTS)["job"]
+                for s in _bulk_specs(n_bulk)]
+    assert JobQueue(qdir).lane_depths()["bulk"] == n_bulk
+
+    def spawn(wid):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        log = open(os.path.join(qdir, f"{wid}.log"), "w")
+        return subprocess.Popen(
+            [sys.executable, "-c", _POOL_WORKER_SRC, qdir, wid,
+             str(sleep_s)], env=env, cwd=REPO, stdout=log,
+            stderr=subprocess.STDOUT)
+
+    cfg = PoolConfig(min_workers=1, max_workers=3, high_water=0.5,
+                     low_water=0.1, cooldown_s=0.4, poll_s=0.1,
+                     stale_grace_s=60.0)
+    ctl = PoolController(qdir, cfg, spawn=spawn)
+    q = ctl.queue
+    interactive_id = None
+    t_submit = t_done = None
+    bulk_left_at_done = None
+    max_workers_seen = 0
+    deadline = time.time() + 150.0
+    try:
+        while time.time() < deadline:
+            ctl.poll_once()
+            max_workers_seen = max(max_workers_seen, len(ctl.workers))
+            done = q.counts()["done"]
+            if interactive_id is None and done >= 1:
+                (rec,) = client.submit([epoch_file], OPTS)  # interactive
+                assert rec["status"] == "submitted"
+                interactive_id = rec["job"]
+                t_submit = time.time()
+            if interactive_id is not None and t_done is None \
+                    and interactive_id in q.results:
+                t_done = time.time()
+                bulk_left_at_done = (q.lane_depths()["bulk"]
+                                     + q.counts()["leased"])
+            if t_done is not None and q.empty() \
+                    and done >= n_bulk:
+                break
+            time.sleep(0.1)
+        assert interactive_id is not None, "no bulk job ever completed"
+        assert t_done is not None, "interactive job never completed"
+        # behaviour 1 — elasticity: the backlog forced a scale-up
+        assert max_workers_seen >= 2
+        assert ctl.stats["scale_up"] >= 1
+        # behaviour 2 — QoS: the interactive job's wait stayed bounded
+        # while the bulk backlog was still draining.  Bound: the lane
+        # budgets guarantee it goes out within ~one bulk job per free
+        # worker; 6x one bulk service time is generous slack for CI
+        assert t_done - t_submit < 6 * sleep_s, (t_done - t_submit)
+        assert bulk_left_at_done >= 1, \
+            "bulk backlog drained before the interactive job finished"
+        # drain-to-min: with the queue empty, backpressure is 0 and the
+        # controller sheds workers down to min via per-worker markers
+        deadline2 = time.time() + 60.0
+        while time.time() < deadline2:
+            ctl.poll_once()
+            if len(ctl.workers) <= cfg.min_workers \
+                    and ctl.stats["scale_down"] >= 1:
+                break
+            time.sleep(0.1)
+        assert ctl.stats["scale_down"] >= 1
+        assert len(ctl.workers) <= 2    # draining stragglers at most
+    finally:
+        ctl.shutdown(timeout_s=20.0)
+    assert not ctl.workers
+    # behaviour 3 — zero lost/duplicated rows: every bulk epoch + the
+    # interactive row, exactly once
+    store = JobQueue(qdir).results
+    assert len(store.keys()) == n_bulk * 2 + 1
+    pool_csv = str(tmp_path / "pool.csv")
+    store.export_csv(pool_csv)
+    # byte-identity baseline: the SAME jobs through one in-process
+    # worker with the same stub row builders
+    qdir2 = str(tmp_path / "q2")
+    client2 = SurveyClient(qdir2)
+    for s in _bulk_specs(n_bulk):
+        client2.submit_synthetic(s, OPTS)
+    client2.submit([epoch_file], OPTS)
+    client2.drain()
+    w = ServeWorker(JobQueue(qdir2), batch_size=1, max_wait_s=0.0,
+                    poll_s=0.01, runner=_stub_runner(),
+                    synth_runner=_inproc_synth_stub, heartbeat_s=0.0)
+    w.run()
+    single_csv = str(tmp_path / "single.csv")
+    JobQueue(qdir2).results.export_csv(single_csv)
+    assert open(pool_csv, "rb").read() == open(single_csv, "rb").read()
+
+
+# ---------------------------------------------------------------------------
+# CLI: pool verb smoke + fleet rendering
+# ---------------------------------------------------------------------------
+
+
+def test_cli_pool_rounds_smoke_and_fleet_render(tmp_path, capsys,
+                                                monkeypatch):
+    """`scintools-tpu pool QDIR --rounds N` runs N control rounds with
+    the real spawner path stubbed out (chaos-armed so no subprocess is
+    actually launched) and `fleet status` renders the controller
+    section + lane depths."""
+    from scintools_tpu.cli import main as cli_main
+
+    files = _write_blobs(tmp_path, 2)
+    qdir = str(tmp_path / "q")
+    q = JobQueue(qdir)
+    q.submit(files[0], OPTS, lane="interactive")
+    q.submit(files[1], OPTS, lane="bulk")
+    # arm pool.spawn for every round: the CLI smoke proves the loop +
+    # status plumbing without launching real serve subprocesses
+    monkeypatch.setenv("SCINT_FAULTS", "pool.spawn:error@1x3")
+    assert faults.install_env(force=True) == 1
+    assert cli_main(["pool", qdir, "--rounds", "3", "--min", "1",
+                     "--max", "2", "--poll", "0.01"]) == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["rounds"] == 3
+    assert rec["spawn_failed"] == 3
+    status = pool_mod.read_pool_status(qdir)
+    assert status is not None and status["stats"]["rounds"] == 3
+    assert cli_main(["fleet", "status", qdir]) == 0
+    out = capsys.readouterr().out
+    assert "pool controller" in out
+    assert "queued depth by lane" in out
+    assert "interactive=1" in out and "bulk=1" in out
+    # and the JSON form carries the machine payloads
+    assert cli_main(["fleet", "status", qdir, "--json"]) == 0
+    rollup = json.loads(capsys.readouterr().out)
+    assert rollup["lane_depths"] == {"interactive": 1, "bulk": 1}
+    assert rollup["pool"]["stats"]["rounds"] == 3

@@ -431,8 +431,13 @@ def test_queue_shard_layout_persistence_and_placement(tmp_path):
     qdir = str(tmp_path / "q")
     q = JobQueue(qdir, shards=4)
     assert q.nshards == 4
+    # ISSUE 13: the queued namespace is lane x shard
     assert sorted(os.listdir(os.path.join(qdir, "queued"))) == [
-        "00", "01", "02", "03"]
+        "bulk", "interactive"]
+    for lane in ("bulk", "interactive"):
+        assert sorted(os.listdir(os.path.join(qdir, "queued",
+                                              lane))) == [
+            "00", "01", "02", "03"]
     with open(os.path.join(qdir, "control", "shards")) as fh:
         assert fh.read().strip() == "4"
     # a different constructor value CANNOT diverge an existing queue
@@ -445,7 +450,8 @@ def test_queue_shard_layout_persistence_and_placement(tmp_path):
            for i, f in enumerate(files)]
     for jid in ids:
         shard = q._shard_name(q._shard_of(jid))
-        names = os.listdir(os.path.join(qdir, "queued", shard))
+        names = os.listdir(os.path.join(qdir, "queued",
+                                        "interactive", shard))
         assert any(n.endswith(f"-{jid}.json") for n in names), jid
     # depth/status aggregate across shards; per-shard readout works
     st = q.status()
@@ -507,18 +513,21 @@ def test_legacy_flat_stamped_queue_drains_into_shards(tmp_path):
                         f"{q._stamp_prefix(1.0)}-legacyflat01.json")
     with open(flat, "w") as fh:
         json.dump(legacy.to_record(), fh)
-    jid_new, _ = q.submit(files[1], OPTS)
+    # bulk lane: laneless legacy records drain as bulk (ISSUE 13), so
+    # the FIFO merge is pinned within one lane
+    jid_new, _ = q.submit(files[1], OPTS, lane="bulk")
     assert q.state_of("legacyflat01") == "queued"
     assert q.counts()["queued"] == 2
     claimed = q.claim("w", n=2, lease_s=30.0)
     assert [j.id for j in claimed] == ["legacyflat01", jid_new]  # FIFO
-    # requeue lands SHARDED; the flat stamped file is collected by the
-    # deterministic unlink probes, not a scan
+    # requeue lands LANE-SHARDED (laneless -> bulk); the flat stamped
+    # file is collected by the deterministic unlink probes, not a scan
     q.fail(claimed[0], "transient")
     assert not os.path.exists(flat)
     shard = q._shard_name(q._shard_of("legacyflat01"))
     assert any(n.endswith("-legacyflat01.json")
-               for n in os.listdir(os.path.join(qdir, "queued", shard)))
+               for n in os.listdir(os.path.join(qdir, "queued", "bulk",
+                                                shard)))
     # complete() of the sharded record leaves nothing queued anywhere
     (j,) = q.claim("w", n=1, lease_s=30.0, now=time.time() + 60.0)
     q.results.put(j.id, {"name": "x", "tau": 1.0})
@@ -609,3 +618,45 @@ def test_results_bench_lane_smoke(monkeypatch):
     base = rec["baseline_rows_plane"]
     assert base["csv_rows"] == 240 and base["files"] == 240
     assert rec["gather_speedup_vs_rows"] > 0
+
+
+def test_put_versioned_rows_newest_wins(tmp_path):
+    """ISSUE 13 satellite (ROADMAP item 5 open tail): `put_versioned`
+    advances a key's value tick by tick — newest wins through the
+    buffer, across sealed segments, after compaction, and in the CSV
+    export — with NO segment-format change (the plane's newest-first
+    dedup is the whole mechanism)."""
+    store = ResultsStore(str(tmp_path / "s"))
+    key = "streamkey00000001"
+    store.put_versioned(key, {"name": "w", "tau": 1.0, "tick": 0})
+    # buffered version wins immediately (pre-flush)
+    assert store.get(key)["tick"] == 0
+    # a newer buffered version supersedes the older BUFFERED one: the
+    # flush seals ONE record for the key, not two
+    store.put_versioned(key, {"name": "w", "tau": 1.5, "tick": 1})
+    assert store.flush() == 1
+    assert store.get(key)["tick"] == 1
+    # a later version in a NEWER segment shadows the sealed one
+    store.put_versioned(key, {"name": "w", "tau": 2.0, "tick": 2})
+    store.flush()
+    assert store.get(key)["tick"] == 2
+    assert len(store.segments.segment_files()) == 2
+    # streaming reads and the exporter agree (exactly one row)
+    assert [r["tick"] for _k, r in store.iter_items()] == [2]
+    csv = str(tmp_path / "out.csv")
+    assert store.export_csv(csv, full=True) == 1
+    assert "2.0" in open(csv).read()
+    # write-once semantics are untouched: put_new_buffered still
+    # refuses to advance an existing key
+    assert store.put_new_buffered(key, {"name": "w", "tick": 9}) \
+        is False
+    # compaction keeps the newest version and drops the shadowed one
+    stats = store.compact()
+    assert stats["compacted"] == 2
+    assert store.get(key)["tick"] == 2
+    assert [r["tick"] for _k, r in store.iter_items()] == [2]
+    # rows-plane degrade: plain overwrite, same newest-wins read
+    rows = ResultsStore(str(tmp_path / "rows"), plane="rows")
+    rows.put_versioned(key, {"name": "w", "tick": 0})
+    rows.put_versioned(key, {"name": "w", "tick": 1})
+    assert rows.get(key)["tick"] == 1

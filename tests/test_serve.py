@@ -46,17 +46,17 @@ def _write_epochs(tmp_path, seeds):
 
 
 def _queued_shard_files(q):
-    """(shard name, fname) for every queued record across the shard
-    namespace (flat legacy root included under shard name '')."""
+    """(shard name, fname) for every queued record across the
+    lane x shard namespace (ISSUE 13 added the lane level; legacy
+    laneless shard dirs and the flat root — shard name '' — are still
+    walked)."""
     out = []
     qdir = os.path.join(q.dir, "queued")
-    for entry in sorted(os.listdir(qdir)):
-        path = os.path.join(qdir, entry)
-        if os.path.isdir(path):
-            out.extend((entry, f) for f in sorted(os.listdir(path))
-                       if f.endswith(".json"))
-        elif entry.endswith(".json"):
-            out.append(("", entry))
+    for root, dirs, files in os.walk(qdir):
+        dirs.sort()
+        shard = "" if root == qdir else os.path.basename(root)
+        out.extend((shard, f) for f in sorted(files)
+                   if f.endswith(".json"))
     return out
 
 
@@ -201,10 +201,12 @@ def test_claim_opens_only_head_candidates(tmp_path, monkeypatch):
 def test_claim_drains_legacy_unstamped_jobs_fifo(tmp_path):
     """Queues written before the stamped-name scheme keep draining: a
     plain <job_id>.json record is read for its submit time and merges
-    into the same FIFO order."""
+    into the same FIFO order.  Laneless legacy records drain as the
+    BULK lane (ISSUE 13), so the FIFO merge is pinned against a bulk
+    submit — cross-lane order is weighted-fair, not global FIFO."""
     files = _write_epochs(tmp_path, GOOD_SEEDS[:3])
     q = JobQueue(str(tmp_path / "q"))
-    jid_new, _ = q.submit(files[0], OPTS)
+    jid_new, _ = q.submit(files[0], OPTS, lane="bulk")
     # hand-plant a LEGACY-named job that was submitted EARLIER
     legacy = Job(id="legacyjob01", file=files[1], cfg=dict(OPTS),
                  submitted_at=1.0)
