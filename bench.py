@@ -1043,7 +1043,11 @@ def time_to_first_result(nf: int, nt: int, timeout_s: int | None = None,
     start — the returned ``jit_cache_miss`` / ``compile_cache_hit``
     counters say which one was measured.  ``SCINT_BENCH_TTFR=0``
     disables; ``SCINT_BENCH_TTFR_TIMEOUT`` caps the child (default
-    900 s — a cold CPU compile at the full bench shape is minutes)."""
+    900 s — a cold CPU compile at the full bench shape is minutes).
+    ``SCINT_BENCH_SPLIT=1`` makes the child run
+    ``PipelineConfig(split_programs=True)``, so the TTFR pair (this
+    catalog-shape probe + the novel-shape probe below) measures the
+    split pipeline's cold path."""
     if os.environ.get("SCINT_BENCH_TTFR", "1").strip().lower() \
             in ("0", "off", "false", ""):
         return {"skipped": True}
@@ -1067,6 +1071,8 @@ def time_to_first_result(nf: int, nt: int, timeout_s: int | None = None,
             "force_host_cpu_devices(1)\n" if force_cpu else
             "from scintools_tpu.backend import honor_platform_env\n"
             "honor_platform_env()\n")
+        split = os.environ.get("SCINT_BENCH_SPLIT",
+                               "0").strip().lower() in ("1", "on", "true")
         code = (
             "import time\n"
             "t0 = time.time()\n"          # BEFORE any heavy import
@@ -1081,7 +1087,8 @@ def time_to_first_result(nf: int, nt: int, timeout_s: int | None = None,
             "from scintools_tpu.serve.worker import load_epoch\n"
             f"ep = load_epoch({epoch_path!r})\n"
             f"cfg = PipelineConfig(arc_numsteps={int(arc_numsteps)},\n"
-            f"                     lm_steps={int(lm_steps)})\n"
+            f"                     lm_steps={int(lm_steps)},\n"
+            f"                     split_programs={split})\n"
             "with obs.tracing():\n"
             "    [(idx, res)] = run_pipeline([ep], cfg, bucket=True)\n"
             "    c = obs.counters()\n"
@@ -1107,6 +1114,7 @@ def time_to_first_result(nf: int, nt: int, timeout_s: int | None = None,
                              "CSV row"}
         rec["shape"] = [1, int(nf), int(nt)]
         rec["backend"] = "cpu-forced" if force_cpu else "ambient"
+        rec["split_programs"] = split
         return rec
     except subprocess.TimeoutExpired:
         return {"error": f"ttfr child exceeded {timeout_s}s (cold "
@@ -1115,6 +1123,33 @@ def time_to_first_result(nf: int, nt: int, timeout_s: int | None = None,
         return {"error": f"ttfr {type(e).__name__}: {e}"}
     finally:
         shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def novel_ttfr_shape(nf: int, nt: int) -> tuple:
+    """A deterministic (nf, nt) perturbation GUARANTEED absent from a
+    warm artifact built for the bench shape: the catalog keys on the
+    exact axes, so any different grid is a cache-cold front-end.  Kept
+    within ~10 % of the bench shape so the two TTFR numbers are
+    comparable work."""
+    return max(32, nf - max(8, nf // 16)), nt + max(8, nt // 16)
+
+
+def time_to_first_result_novel(nf: int, nt: int, **kw) -> dict:
+    """``time_to_first_result`` re-run against a shape ABSENT from the
+    warm artifact (ISSUE 14 satellite): the existing TTFR metric only
+    measures catalog shapes, so it cannot see what program splitting
+    buys — a warmed pod hitting a NOVEL (nf, nt) recompiles the whole
+    monolithic step, but only the front-end slice under
+    ``SCINT_BENCH_SPLIT=1``.  ``SCINT_BENCH_TTFR_NOVEL=0`` skips the
+    probe (it costs a second cold child)."""
+    if os.environ.get("SCINT_BENCH_TTFR_NOVEL",
+                      "1").strip().lower() in ("0", "off", "false", ""):
+        return {"skipped": True}
+    nf2, nt2 = novel_ttfr_shape(nf, nt)
+    rec = time_to_first_result(nf2, nt2, **kw)
+    if rec.get("s") is not None:
+        rec["novel_of"] = [int(nf), int(nt)]
+    return rec
 
 
 def main():
@@ -1331,6 +1366,15 @@ def main():
                 # first-class trajectory metric (ISSUE 7): regressions
                 # in fresh-pod first-result latency show beside rates
                 rec["time_to_first_result_s"] = t["s"]
+        tn = ttfr_holder.get("novel")
+        if tn:
+            # novel-shape TTFR (ISSUE 14): what a warmed pod pays for a
+            # shape ABSENT from the warm artifact — the number program
+            # splitting exists to crush (SCINT_BENCH_SPLIT=1 runs the
+            # pair through the split pipeline)
+            rec["time_to_first_result_novel"] = tn
+            if tn.get("s") is not None:
+                rec["time_to_first_result_novel_s"] = tn["s"]
         rec.update(extra)
         return rec
 
@@ -1384,6 +1428,9 @@ def main():
         # exactly like device_preprobe; two concurrent claims would
         # wedge the tunnel)
         ttfr_holder["rec"] = time_to_first_result(nf, nt)
+        # novel-shape probe AGAINST THE SAME WARM CACHE: the catalog
+        # covers (nf, nt), so this child's front-end is cache-cold
+        ttfr_holder["novel"] = time_to_first_result_novel(nf, nt)
         # --- stage 2: full device run under the watchdog -----------------
         # (the tunnel can still die mid-run; the watchdog bounds that)
         timeout_s = _env_int("SCINT_BENCH_DEVICE_TIMEOUT", 1200)
@@ -1560,6 +1607,9 @@ def main():
             # silicon the fallback rate is measured on (cpu-forced)
             ttfr_holder["rec"] = time_to_first_result(nf, nt,
                                                       force_cpu=True)
+        if "novel" not in ttfr_holder:
+            ttfr_holder["novel"] = time_to_first_result_novel(
+                nf, nt, force_cpu=True)
         code = (
             "import json, os\n"
             "from scintools_tpu.backend import force_host_cpu_devices\n"

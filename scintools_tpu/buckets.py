@@ -118,6 +118,46 @@ def bucket_plan(n: int, multiple: int = 1, top: int | None = None) -> dict:
     return {"chunk": r, "pad_chunks": True}
 
 
+# ---------------------------------------------------------------------------
+# mini vector ladder (ISSUE 14): closed rung lengths for the split
+# pipeline's canonicalised fitter inputs.  The split back-end consumes
+# tail-padded cut vectors whose REAL length is (nf + nt)-derived and
+# therefore shape-volatile; padding onto this small geometric ladder
+# makes the padded length — and with it the fitter program — a member
+# of a closed set, so virtually every survey shape maps onto an
+# already-compiled fitter.  Mirrors the batch ladder above: pow2 rungs
+# from a floor, unbounded top (a cut vector is O(nf+nt) floats — the
+# pad waste is bytes, not a device-memory hazard like batch lanes).
+# ---------------------------------------------------------------------------
+
+# smallest rung: below this every observing grid shares one program
+VECTOR_RUNG_MIN = 256
+
+
+def vector_rung(n: int, minimum: int = VECTOR_RUNG_MIN) -> int:
+    """Smallest pow2-ladder rung >= ``n``: the padded length a
+    ``n``-element fitter input canonicalises onto."""
+    if n < 1:
+        raise ValueError(f"vector_rung: need n >= 1, got {n}")
+    r = max(int(minimum), 1)
+    while r < n:
+        r *= 2
+    return r
+
+
+def vector_ladder(n_max: int, minimum: int = VECTOR_RUNG_MIN) -> tuple:
+    """Every vector rung up to (and including) the one covering
+    ``n_max`` — the closed fitter-input length set a warmup should
+    pre-compile."""
+    out = []
+    r = max(int(minimum), 1)
+    top = vector_rung(n_max, minimum)
+    while r <= top:
+        out.append(r)
+        r *= 2
+    return tuple(out)
+
+
 @dataclasses.dataclass(frozen=True)
 class BucketSignature:
     """One catalog member: the padded step signature a canonicalised

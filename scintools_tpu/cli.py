@@ -149,13 +149,14 @@ def _validate_estimator_flags(args) -> None:
                          "would fork the job identity for nothing)")
     from .serve.queue import validate_job_cfg
     try:
-        cfg = {"sspec_crop": getattr(args, "sspec_crop", False),
-               "no_arc": getattr(args, "no_arc", False),
-               "arc_method": getattr(args, "arc_method", "norm_sspec")}
+        # the FULL option dict (not a subset): validate_job_cfg builds
+        # the worker's own PipelineConfig from it and runs the one rule
+        # site (PipelineConfig.validate), so flags that interact —
+        # --arc-bracket satisfying thetatheta's finite-window rule,
+        # --split-programs vs --arc-method — are judged together
+        cfg = _estimator_opts(args)
         if synth is not None:
-            # full option dict: the synthetic route's config exclusions
-            # (bf16_io, arc_stack) are validated from one rule site
-            cfg = dict(_estimator_opts(args), **cfg, synthetic=synth)
+            cfg = dict(cfg, synthetic=synth)
         validate_job_cfg(cfg)
     except ValueError as e:
         raise SystemExit(str(e))
@@ -242,7 +243,9 @@ def cmd_process(args) -> int:
                            (getattr(args, "sspec_crop", False),
                             "--sspec-crop"),
                            (getattr(args, "fused_sspec", False),
-                            "--fused-sspec")):
+                            "--fused-sspec"),
+                           (getattr(args, "split_programs", False),
+                            "--split-programs")):
             if flag:
                 raise SystemExit(f"{name} only applies to the batched "
                                  "engine; add --batched")
@@ -458,6 +461,12 @@ def _estimator_opts(args) -> dict:
         opts["sspec_crop"] = True
     if getattr(args, "fused_sspec", False):
         opts["fused_sspec"] = True
+    if getattr(args, "split_programs", False):
+        # placement knob: rides in the option dict so warmup/serve
+        # build the same PipelineConfig, but cfg_signature strips it
+        # from the job identity and cmd_process keeps it out of the
+        # resume key (results are bit-identical either way)
+        opts["split_programs"] = True
     for k in ("arc_numsteps", "lm_steps"):
         if getattr(args, k, None) is not None:
             opts[k] = int(getattr(args, k))
@@ -827,74 +836,102 @@ def cmd_warmup(args) -> int:
                                     synthetic=synth_spec)
     import jax
 
+    from .parallel.driver import _SplitStep
+
     sigs = []
     keys = []
     for freqs, times, bshape, dtype, chunked in plans:
         donate = _resolve_donate(not getattr(args, "no_async", False),
                                  chunked, mesh)
-        key = compile_cache.step_key(freqs, times, pcfg, mesh, chan,
-                                     bshape, dtype, donate=donate,
-                                     synth=genid)
-        keys.append(key)
-        sig = {"shape": list(bshape), "key": key}
-        t0 = time.perf_counter()
         spec_sharding = (mesh_mod.data_sharding(mesh, chan)
                          if mesh is not None else None)
         spec = jax.ShapeDtypeStruct(
             tuple(bshape), jax.dtypes.canonicalize_dtype(dtype),
             sharding=spec_sharding)
-        # --force first: a load under --force would memoize the stale
-        # artifact and defeat the re-export
-        fn = None if args.force else compile_cache.load_step(key,
-                                                            count=False)
-        if fn is not None and hasattr(fn, "lower"):
-            # StableHLO-only cache (written before the serialized-
-            # executable layer existed): treat as uncached so the
-            # .jaxexec fast path gets BACKFILLED — otherwise a re-warm
-            # of an old cache ships an artifact whose "warm" pods still
-            # pay the full XLA compile
-            fn = None
-        if fn is not None:
-            sig["status"] = "cached"
-            # the AOT artifacts have no eviction pressure from XLA, but
-            # the persistent XLA cache does: recompile the LIVE step —
-            # its fingerprint is cross-process stable, so this repairs
-            # an evicted entry for consumers that fall back to the jit
-            # path; near-free (retrace + disk hit) on a warm cache
-            step = make_pipeline(freqs, times, pcfg, mesh=mesh,
-                                 chan_sharded=chan, donate=donate,
-                                 synth=synth_spec)
-            step.lower(spec).compile()
+        step = make_pipeline(freqs, times, pcfg, mesh=mesh,
+                             chan_sharded=chan, donate=donate,
+                             synth=synth_spec)
+        if isinstance(step, _SplitStep):
+            # split pipeline (ISSUE 14): each unit is its own artifact.
+            # The back (fitter) unit's key is axes-free, so the SECOND
+            # template/rung that maps onto it reports `cached` — one
+            # warmed fitter set covers every survey shape.  Under a
+            # >1-device mesh the back unit stays jit-served (its
+            # artifact spec cannot describe the sharded handoff —
+            # _SplitStep.back_aot_eligible), so only the front exports.
+            units = [("front", step.front_key(tuple(bshape), dtype),
+                      step.front, spec)]
+            if step.back_aot_eligible():
+                units.append(("back", step.back_key(int(bshape[0])),
+                              step.back,
+                              step.back_spec(int(bshape[0]))))
         else:
-            step = make_pipeline(freqs, times, pcfg, mesh=mesh,
-                                 chan_sharded=chan, donate=donate,
-                                 synth=synth_spec)
-            # preferred artifact: the COMPILED executable (zero retrace
-            # AND zero compile on load — the fresh-pod fast path; its
-            # lower().compile() also lands the live step's XLA entry in
-            # the persistent cache), plus the StableHLO export as the
-            # portable fallback layer
-            exec_path = compile_cache.export_executable(
-                step, bshape, dtype, key, sharding=spec_sharding)
-            path = compile_cache.export_step(step, bshape, dtype, key)
-            if exec_path is None and path is None:
-                # serialization unsupported for this step/sharding:
-                # still warm the persistent XLA cache via the jit path
-                sig["status"] = "xla-cache-only"
-                step.lower(spec).compile()
+            units = [(None, compile_cache.step_key(
+                freqs, times, pcfg, mesh, chan, bshape, dtype,
+                donate=donate, synth=genid), step, spec)]
+        sig = {"shape": list(bshape), "key": units[0][1]}
+        if units[0][0] is not None:
+            sig["units"] = {}
+        t0 = time.perf_counter()
+        for uname, ukey, ufn, uspec in units:
+            keys.append(ukey)
+            # --force first: a load under --force would memoize the
+            # stale artifact and defeat the re-export
+            fn = None if args.force else compile_cache.load_step(
+                ukey, count=False)
+            if fn is not None and hasattr(fn, "lower"):
+                # StableHLO-only cache (written before the serialized-
+                # executable layer existed): treat as uncached so the
+                # .jaxexec fast path gets BACKFILLED — otherwise a
+                # re-warm of an old cache ships an artifact whose
+                # "warm" pods still pay the full XLA compile
+                fn = None
+            if fn is not None:
+                status = "cached"
+                # the AOT artifacts have no eviction pressure from XLA,
+                # but the persistent XLA cache does: recompile the LIVE
+                # step — its fingerprint is cross-process stable, so
+                # this repairs an evicted entry for consumers that fall
+                # back to the jit path; near-free on a warm cache
+                ufn.lower(uspec).compile()
             else:
-                sig["status"] = "exported"
-                sig["artifacts"] = ([os.path.basename(p)
-                                     for p in (exec_path, path)
-                                     if p is not None])
-                if exec_path is None:
-                    # executable layer unavailable: at least leave the
-                    # live step's XLA entry behind for the jit fallback
-                    step.lower(spec).compile()
+                # preferred artifact: the COMPILED executable (zero
+                # retrace AND zero compile on load — the fresh-pod fast
+                # path; its lower().compile() also lands the live
+                # step's XLA entry in the persistent cache), plus the
+                # StableHLO export as the portable fallback layer
+                exec_path = compile_cache.export_executable(
+                    ufn, bshape, dtype, ukey, sharding=spec_sharding,
+                    spec=uspec)
+                path = compile_cache.export_step(ufn, bshape, dtype,
+                                                 ukey, spec=uspec)
+                if exec_path is None and path is None:
+                    # serialization unsupported for this step/sharding:
+                    # still warm the persistent XLA cache via jit
+                    status = "xla-cache-only"
+                    ufn.lower(uspec).compile()
+                else:
+                    status = "exported"
+                    arts = [os.path.basename(p)
+                            for p in (exec_path, path) if p is not None]
+                    if uname is None:
+                        sig["artifacts"] = arts
+                    if exec_path is None:
+                        # executable layer unavailable: at least leave
+                        # the live step's XLA entry for the jit fallback
+                        ufn.lower(uspec).compile()
+            if uname is None:
+                sig["status"] = status
+            else:
+                sig["units"][uname] = {"key": ukey, "status": status}
+        if "status" not in sig:
+            sig["status"] = "/".join(u["status"]
+                                     for u in sig["units"].values())
         sig["compile_s"] = round(time.perf_counter() - t0, 3)
         sigs.append(sig)
-        log_event(log, "warmup_signature", **{k: v for k, v in sig.items()
-                                              if k != "shape"},
+        log_event(log, "warmup_signature",
+                  **{k: v for k, v in sig.items()
+                     if k not in ("shape", "units")},
                   shape="x".join(str(s) for s in bshape))
     out = {"cache_dir": cache, "jax": jax.__version__,
            "backend": jax.default_backend(),
@@ -1556,6 +1593,18 @@ def _add_perf_policy_flags(q) -> None:
                         "-36%% sspec-stage HBM bytes at 256x512); "
                         "opt-in — fits agree within the 2%% budget, "
                         "not bit-identical")
+    q.add_argument("--split-programs", action="store_true",
+                   dest="split_programs",
+                   help="compile the batched step as TWO separately "
+                        "cached program units: a shape-volatile "
+                        "front-end (transforms) and a shape-stable "
+                        "fitter back-end keyed on canonicalised "
+                        "intermediate lengths — a novel (nf, nt) "
+                        "recompiles only the front slice (back-end "
+                        "jit_cache_miss stays 0).  Results are "
+                        "bit-identical to the fused step; a placement "
+                        "knob, never part of the job identity or "
+                        "resume key")
 
 
 def _add_synth_flags(q) -> None:
@@ -1747,6 +1796,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "`serve` worker without --mesh executes); the "
                         "default mirrors `process --batched` (full "
                         "local mesh)")
+    q.add_argument("--arc-numsteps", type=int, default=None,
+                   help="mirror a consumer's eta-grid size override "
+                        "(enters the compiled signature, so the warmed "
+                        "programs must match)")
+    q.add_argument("--lm-steps", type=int, default=None,
+                   help="mirror a consumer's LM iteration budget "
+                        "(enters the compiled signature)")
     q.add_argument("--force", action="store_true",
                    help="re-export even when an artifact already exists")
     q.add_argument("--catalog", action="store_true",

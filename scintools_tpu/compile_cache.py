@@ -200,7 +200,7 @@ def _source_fingerprint() -> str:
 
 def step_key(freqs, times, config, mesh, chan_sharded: bool,
              batch_shape, dtype, donate: bool = False,
-             synth=None) -> str:
+             synth=None, unit: str | None = None) -> str:
     """Content-hash key of one compiled step signature.
 
     Anything that changes the traced program (or the validity of its
@@ -213,7 +213,14 @@ def step_key(freqs, times, config, mesh, chan_sharded: bool,
     ``synth`` is the synthetic route's generator identity
     (``sim.campaign.generator_id`` — a canonicalised SynthSpec with a
     stable repr): a key-fed generate→analyse program is a different
-    executable from the file-fed analyser over the same axes."""
+    executable from the file-fed analyser over the same axes.
+
+    ``unit`` names a split-program unit (ISSUE 14): the split
+    front-end keys here as ``unit="front"`` with the fitter-only knobs
+    already pinned (``driver._front_config``), so changing a fitter
+    knob never invalidates the transform artifacts; the shape-stable
+    back-end keys through :func:`split_backend_key` instead — its
+    identity holds NO axes at all."""
     import jax
     import jaxlib
 
@@ -231,12 +238,36 @@ def step_key(freqs, times, config, mesh, chan_sharded: bool,
         jax.__version__, jaxlib.__version__, jax.default_backend(),
         _source_fingerprint(),
         repr(synth),
+        unit,
     ))
     h = hashlib.sha256()
     h.update(f.tobytes())
     h.update(t.tobytes())
     h.update(desc.encode())
     return h.hexdigest()[:32]
+
+
+def split_backend_key(back_desc, back_sig) -> str:
+    """Content-hash key of the split pipeline's shape-stable BACK-END
+    unit (ISSUE 14).  Deliberately axes-free: the identity is the
+    fitter-program description (``driver.split_backend_desc`` — alpha,
+    lm_steps, profile length, tail knobs ...) plus the canonicalised
+    intermediate signature (``_SplitStep.back_sig`` — rung/profile
+    lengths and batch size), the x64 flag, the jax/jaxlib/backend
+    versions and the package source digest.  Every (nf, nt) whose
+    intermediates pad onto the same rungs shares ONE artifact — the
+    warmed fitter set covers novel survey shapes."""
+    import jax
+    import jaxlib
+
+    desc = repr((
+        _FORMAT, "split-back",
+        tuple(back_desc), tuple(back_sig),
+        bool(jax.config.jax_enable_x64),
+        jax.__version__, jaxlib.__version__, jax.default_backend(),
+        _source_fingerprint(),
+    ))
+    return hashlib.sha256(desc.encode()).hexdigest()[:32]
 
 
 def artifact_path(key: str) -> str | None:
@@ -250,7 +281,7 @@ def artifact_exec_path(key: str) -> str | None:
 
 
 def export_executable(step, batch_shape, dtype, key: str,
-                      sharding=None) -> str | None:
+                      sharding=None, spec=None) -> str | None:
     """Compile ``step`` for one input signature and persist the
     COMPILED executable (``jax.experimental.serialize_executable``:
     pickled payload + in/out trees) under ``key`` — the artifact
@@ -283,9 +314,12 @@ def export_executable(step, batch_shape, dtype, key: str,
         from jax.experimental import serialize_executable as se
 
         _register_serialization()
-        spec = jax.ShapeDtypeStruct(
-            tuple(int(s) for s in batch_shape),
-            jax.dtypes.canonicalize_dtype(dtype), sharding=sharding)
+        if spec is None:
+            # single dyn-batch input signature; split units pass their
+            # own ShapeDtypeStruct pytree via ``spec=`` instead
+            spec = jax.ShapeDtypeStruct(
+                tuple(int(s) for s in batch_shape),
+                jax.dtypes.canonicalize_dtype(dtype), sharding=sharding)
         compiled = step.lower(spec).compile()
         data = pickle.dumps(se.serialize(compiled))
         os.makedirs(os.path.dirname(path), exist_ok=True)
@@ -305,7 +339,8 @@ def export_executable(step, batch_shape, dtype, key: str,
         return None
 
 
-def export_step(step, batch_shape, dtype, key: str) -> str | None:
+def export_step(step, batch_shape, dtype, key: str,
+                spec=None) -> str | None:
     """AOT-lower ``step`` for one input signature and persist the
     serialized jax.export artifact under ``key``.  Returns the artifact
     path, or None when the cache is disabled or export is unsupported
@@ -319,9 +354,10 @@ def export_step(step, batch_shape, dtype, key: str) -> str | None:
         from jax import export
 
         _register_serialization()
-        spec = jax.ShapeDtypeStruct(
-            tuple(int(s) for s in batch_shape),
-            jax.dtypes.canonicalize_dtype(dtype))
+        if spec is None:
+            spec = jax.ShapeDtypeStruct(
+                tuple(int(s) for s in batch_shape),
+                jax.dtypes.canonicalize_dtype(dtype))
         data = export.export(step)(spec).serialize()
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + f".tmp.{os.getpid()}"
@@ -494,7 +530,14 @@ def plan_steps(epochs, config, mesh=None, chunk: int | None = None,
     signatures ``(b, 2+F)``, the same ladder/chunk math — so ``warmup
     --synthetic`` pre-compiles exactly what a served ``simulate`` job
     or ``run_pipeline(synthetic=...)`` will execute (the caller also
-    passes the spec's generator identity into :func:`step_key`)."""
+    passes the spec's generator identity into :func:`step_key`).
+
+    Under ``config.split_programs`` each planned signature expands to
+    TWO artifacts at export time (cmd_warmup): the shape-keyed
+    front-end (``step_key(..., unit="front")``) and the axes-free
+    fitter back-end (:func:`split_backend_key`) — the plan tuples
+    themselves are unchanged, since both units derive from one
+    ``make_pipeline`` signature."""
     from .parallel import driver as drv
     from .parallel import mesh as mesh_mod
 

@@ -479,240 +479,24 @@ def fit_arc(sec: SecSpec, freq: float, method: str = "norm_sspec",
 
 
 @functools.lru_cache(maxsize=None)
-def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
-                            method, delmax, numsteps, startbin, cutmid,
-                            etamax, etamin, low_power_diff, high_power_diff,
-                            ref_freq, constraint, nsmooth, noise_error,
-                            asymm=False, constraints=None,
-                            scrunch_rows=0, arc_tail="exact"):
-    if asymm and constraints is not None:
-        raise ValueError("asymm=True and multi-arc constraints are "
-                         "mutually exclusive on the batched fitter")
+def _profile_tail(nsmooth, low_power_diff, high_power_diff, noise_error,
+                  arc_tail="exact"):
+    """Measurement-tail factory: masked peak search + power-drop walks +
+    (log-)parabola fit over a power-vs-eta profile, with everything
+    grid-shaped (the eta array, validity window, constraint mask)
+    entering PER CALL -- axes-free, so the per-axes fitter
+    (:func:`_make_arc_fitter_cached`, which bakes its static grids into
+    the caller's trace) and the split pipeline's shape-stable back-end
+    unit (:func:`make_profile_measurer`) share ONE implementation.
+
+    Returns ``measure_profile(avg, valid, noise, ea, cmask, use_log)``
+    -- the ``arc_tail="exact"`` compacted-array tail or the
+    ``"fast"`` masked-reduction tail.
+    """
     import jax
     import jax.numpy as jnp
 
     from ..models.parabola import fit_parabola as _fitpar
-
-    fdop = np.frombuffer(fdop_key[0]).reshape(fdop_key[1])
-    yaxis = np.frombuffer(yaxis_key[0]).reshape(yaxis_key[1])
-    tdel_axis = np.frombuffer(tdel_key[0]).reshape(tdel_key[1])
-
-    # ---- host-side static precomputation -------------------------------
-    # One frequency adjustment for the fit-level delay cut (dynspec.py:428-
-    # 429); norm_sspec then re-applies it internally (dynspec.py:796-797) —
-    # the reference's double-adjustment quirk, reproduced for parity.
-    # The row indices come from the shared rule so the driver's fused
-    # sspec crop (norm_sspec_row_window) resolves identically.
-    ind, ind_norm, dmax_raw = norm_sspec_row_window(
-        tdel_axis, freq, ref_freq=ref_freq, delmax=delmax)
-    dmax = dmax_raw * (ref_freq / freq) ** 2
-    ymax = yaxis[ind] if lamsteps else dmax
-    yc = yaxis[:ind]
-    emax = etamax if etamax is not None else \
-        ymax / ((fdop[1] - fdop[0]) * cutmid) ** 2
-    emin = etamin if etamin is not None else \
-        (yc[1] - yc[0]) * startbin / np.max(fdop) ** 2
-    cons = np.asarray(constraint, dtype=np.float64)
-    emin_norm = emin
-    if not lamsteps:
-        b2e = _beta_to_eta_factor(freq, ref_freq)
-        emax = emax / (freq / ref_freq) ** 2 * b2e
-        emin = emin / (freq / ref_freq) ** 2 * b2e
-        cons = cons / (freq / ref_freq) ** 2 * b2e
-        # norm_sspec converts the (already converted) eta again
-        # (dynspec.py:820-825) — second half of the same quirk
-        emin_norm = emin / (freq / ref_freq) ** 2 * b2e
-    else:
-        emin_norm = emin
-
-    n = int(numsteps)
-    # constraint sanity: the masks are host-side static, so an impossible
-    # window fails at build time like the numpy path does at fit time
-    # (otherwise the traced argmax would degenerate silently to index 0)
-    def _check_constraint(grid_mask, grid, window=None):
-        if not grid_mask.any():
-            w = tuple(cons) if window is None else tuple(window)
-            raise ValueError(
-                f"no eta grid points inside constraint {w} "
-                f"(grid spans {grid.min():.4g}..{grid.max():.4g})")
-
-    # norm_sspec internals (maxnormfac=1): rows startbin..ind_norm-1
-    tdel_rows = yaxis[startbin:ind_norm]
-    scales = np.sqrt(tdel_rows / emin_norm)         # [R] per-row fdop scale
-    fdopnew = np.linspace(-1.0, 1.0, n)
-    # fold indices (static): positive/negative arms of fdopnew
-    etafrac = np.linspace(-1.0, 1.0, n)
-    ipos = np.where(etafrac > 1 / (2 * n))[0]
-    ineg = np.where(etafrac < -1 / (2 * n))[0]
-    etafrac_avg = 1.0 / etafrac[ipos]               # descending eta
-    eta_array = emin * etafrac_avg[::-1] ** 2       # ascending in eta
-    keep_static = eta_array < emax                  # static part of validity
-    # multi-arc mode: one shared profile measured under K constraint
-    # windows (constraints=...); single-arc mode uses the one constraint.
-    # Windows get the same unit conversion the single constraint received
-    # above (lamsteps=False fits run in converted beta-eta units)
-    def _conv_window(c):
-        c = np.asarray(c, dtype=np.float64)
-        if not lamsteps:
-            c = c / (freq / ref_freq) ** 2 * _beta_to_eta_factor(freq,
-                                                                ref_freq)
-        return c
-
-    cons_windows = ([cons] if constraints is None
-                    else [_conv_window(c) for c in constraints])
-    cons_masks = [(eta_array > c[0]) & (eta_array < c[1])
-                  for c in cons_windows]
-    cons_mask = cons_masks[0]
-    if method == "norm_sspec":
-        # the searchable region is the constraint INTERSECTED with the
-        # static validity window (eta < emax): a constraint lying wholly
-        # past emax would degenerate silently at fit time otherwise
-        for cm, w in zip(cons_masks, cons_windows):
-            _check_constraint(cm & keep_static, eta_array[keep_static],
-                              window=w)
-    # cutmid NaN columns of the row-normalised spectrum (norm_sspec flavour:
-    # floor on both sides, dynspec.py:838-839)
-    ncol = len(fdop)
-    if scrunch_rows == "pallas" and ncol >= 128 and ncol % 128:
-        # Mosaic's gather decomposition works in 128-lane segments
-        # (ops/resample_pallas.py); non-conforming Doppler widths (only
-        # reachable via hand-cropped spectra passed straight to this
-        # fitter — the pipeline's FFT-padded grids are always pow2, so
-        # resolve_routes' recorded "pallas" stays truthful there)
-        # demote to the scan route rather than erroring, and say so
-        from ..utils.log import get_logger, log_event
-
-        log_event(get_logger(), "arc_scrunch_demoted", route="scan",
-                  block=64, ncol=ncol,
-                  reason="ncol not tileable by 128-lane segments")
-        scrunch_rows = 64
-    cut_lo = int(ncol / 2 - np.floor(cutmid / 2))
-    cut_hi = int(ncol / 2 + np.floor(cutmid / 2))
-    col_nan = np.zeros(ncol, dtype=bool)
-    col_nan[cut_lo:cut_hi] = True
-    # fdop is a uniform grid (sspec_axes), so row interpolation reduces to
-    # direct index arithmetic — no searchsorted (log-n gather chains) in
-    # the hot vmapped row loop.  The grid MUST be uniform for this; fail
-    # loudly for exotic callers.
-    f0 = float(fdop[0])
-    dfd = float(fdop[1] - fdop[0])
-    if not np.allclose(np.diff(fdop), dfd, rtol=1e-9, atol=0.0):
-        raise ValueError("jax arc fitter requires a uniform fdop grid "
-                         "(sspec_axes produces one); use backend='numpy' "
-                         "for non-uniform axes")
-    # half-ulp slack so ceil/floor match searchsorted when a query lands
-    # exactly on a grid value (linspace grids differ in the last ulp)
-    _EDGE_EPS = 1e-12
-
-    def _stack_windows(measure_fn, masks, noise):
-        """Measure one shared profile under K constraint windows and
-        stack the per-window (eta, etaerr, etaerr2); profile/filter come
-        from the first window (identical across windows)."""
-        per = [measure_fn(cmask=cm) for cm in masks]
-        return (jnp.stack([q[0] for q in per]),
-                jnp.stack([q[1] for q in per]),
-                jnp.stack([q[2] for q in per]),
-                per[0][3], per[0][4], noise)
-
-    # ---- static row-interp pattern ------------------------------------
-    # The interpolation positions depend only on the (fdop, scales) grids,
-    # never on the data: precompute the [R, n] gather indices and weights
-    # host-side once, so the device step is one take_along_axis + fused
-    # multiply-adds instead of per-row index arithmetic.
-    def _row_interp_pattern():
-        s = scales[:, None]                                  # [R, 1]
-        blo = (-s - f0) / dfd
-        bhi = (s - f0) / dfd
-        lo = np.clip(np.ceil(blo - _EDGE_EPS * np.abs(blo)).astype(np.int64),
-                     0, ncol - 1)
-        hi = np.clip(np.floor(bhi + _EDGE_EPS * np.abs(bhi)).astype(np.int64),
-                     0, ncol - 1)
-        q = np.clip(fdopnew[None, :] * s, f0 + lo * dfd, f0 + hi * dfd)
-        pos = np.clip((q - f0) / dfd, 0.0, ncol - 1.0)
-        i0 = np.clip(np.floor(pos).astype(np.int64), 0, ncol - 2)
-        w = pos - i0
-        return i0.astype(np.int32), w
-
-    _i0_static, _w_static = _row_interp_pattern()            # [R, n]
-
-    def profile_of(sspec):
-        """Per-epoch half: noise estimate + normalised delay-scrunched
-        profile [n].  Split from the measurement tail so the stacked
-        mode can nanmean profiles across epochs (a batch-axis reduction
-        — psum under a data-sharded mesh) before ONE measurement."""
-        # ---- noise estimate (dynspec.py:446-451,463) -------------------
-        noise = _noise_estimate(sspec, cutmid, xp=jnp)
-        noise = noise / (ind - startbin)
-
-        # ---- normalised, delay-scrunched profile -----------------------
-        rows = sspec[startbin:ind_norm, :]
-        rows = jnp.where(col_nan[None, :], jnp.nan, rows)
-
-        if scrunch_rows == "pallas":
-            # Fused Pallas kernel: gather + lerp + NaN-masked accumulate
-            # entirely in VMEM — measured 3.5x the scan path on-chip at
-            # the bench shape (benchmarks/pallas_ab.py, round-4 verdict
-            # "wire").  Off-TPU executions (CPU-fallback bench, forced
-            # route in CI) run the same kernel in interpret mode.
-            from ..ops.resample_pallas import row_scrunch_pallas
-
-            prof = row_scrunch_pallas(rows, _i0_static, _w_static,
-                                      interpret="auto")
-        elif scrunch_rows:
-            # lax.scan over row blocks: the full-gather path materialises
-            # [R, n] (x3 under a B-epoch vmap: [B, R, n] v0/v1/norm in
-            # HBM); accumulating the delay-scrunch nansum/count per block
-            # caps the working set at [B, scrunch_rows, n] regardless of
-            # the delay cut.  Shared with the Pallas A/B baseline
-            # (ops.resample_pallas.row_scrunch_scan), so the
-            # prove-or-remove measurement always races the kernel
-            # against exactly this production path.
-            from ..ops.resample_pallas import row_scrunch_scan
-
-            prof = row_scrunch_scan(rows, _i0_static, _w_static,
-                                    block_r=scrunch_rows)
-        else:
-            i0 = jnp.asarray(_i0_static)
-            w = jnp.asarray(_w_static, dtype=rows.dtype)
-            v0 = jnp.take_along_axis(rows, i0, axis=1)
-            v1 = jnp.take_along_axis(rows, i0 + 1, axis=1)
-            norm = v0 * (1.0 - w) + v1 * w                   # [R, n]
-            prof = jnp.nanmean(norm, axis=0)                 # [n]
-        return prof, noise
-
-    def measure_from_prof(prof, noise):
-        """Measurement tail on a (possibly epoch-stacked) profile."""
-        # +2 dB quirk (dynspec.py:864-866)
-        i_at_1 = int(np.argmin(np.abs(fdopnew - 1) - 2))
-        prof = jnp.where(prof[i_at_1] < 0, prof + 2.0, prof)
-
-        # ---- fold arms onto the eta grid -------------------------------
-        def measure_arm(arm, cmask=None):
-            # arm indexed like ipos (descending eta); flip to ascending
-            avg = arm[::-1]
-            valid = jnp.isfinite(avg) & jnp.asarray(keep_static)
-            return measure_profile(avg, valid, noise,
-                                   jnp.asarray(eta_array),
-                                   cons_mask if cmask is None else cmask,
-                                   use_log=False)
-
-        right = prof[ipos]
-        left = prof[ineg][::-1]
-        combined = (right + left) / 2
-        if constraints is not None:
-            return _stack_windows(
-                functools.partial(measure_arm, combined), cons_masks,
-                noise)
-        out = measure_arm(combined) + (noise,)
-        if asymm:
-            el, eel = measure_arm(left)[:2]
-            er, eer = measure_arm(right)[:2]
-            out = out + (el, eel, er, eer)
-        return out
-
-    def one_epoch(sspec):
-        prof, noise = profile_of(sspec)
-        return measure_from_prof(prof, noise)
 
     def measure_profile_fast(avg, valid, noise, ea, cmask, use_log):
         """Masked-reduction measurement tail (``arc_tail="fast"``).
@@ -977,11 +761,320 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
         filt_full = jnp.where(valid, filt_c[inv], jnp.nan)
         return eta, etaerr, etaerr_fit, avg_f, filt_full
 
-    if arc_tail == "fast":
-        # late-binding closure: measure_arm / measure_pow read this name
-        # at trace time, so rebinding routes BOTH methods (and the
-        # stacked mode) through the masked-reduction tail
-        measure_profile = measure_profile_fast  # noqa: F811
+    return measure_profile_fast if arc_tail == "fast" else measure_profile
+
+
+@functools.lru_cache(maxsize=None)
+def make_profile_measurer(numsteps, nsmooth=5, low_power_diff=-3.0,
+                          high_power_diff=-1.5, noise_error=True,
+                          asymm=False, n_windows=None, arc_tail="exact"):
+    """Axes-free norm_sspec measurement unit: fold the normalised
+    delay-scrunched profile's arms onto the eta grid and run the
+    measurement tail — with every grid-shaped static (the ascending eta
+    array, the ``eta < emax`` validity window, the K constraint masks)
+    entering PER CALL instead of being baked in at build time.
+
+    This is the shape-stable back-end of the split pipeline
+    (``PipelineConfig.split_programs``): the profile length is
+    ``numsteps`` (config, not observing grid), so ONE compiled program
+    serves every (nf, nt) — a novel dynspec shape recompiles only the
+    shape-volatile front-end.  Called with numpy inputs (as the
+    per-axes fitter does) the values bake into the caller's trace
+    exactly as before; called with runtime arrays the program is
+    grid-independent.
+
+    Returns ``measure(prof [n], noise, eta_array [m], keep [m],
+    cmasks [K, m]) -> measurement tuple`` (the contract
+    :func:`pack_measurement` / the fitter-internal ``_pack`` consume).
+    """
+    import jax.numpy as jnp
+
+    n = int(numsteps)
+    measure_profile = _profile_tail(int(nsmooth), float(low_power_diff),
+                                    float(high_power_diff),
+                                    bool(noise_error), str(arc_tail))
+    fdopnew = np.linspace(-1.0, 1.0, n)
+    etafrac = np.linspace(-1.0, 1.0, n)
+    ipos = np.where(etafrac > 1 / (2 * n))[0]
+    ineg = np.where(etafrac < -1 / (2 * n))[0]
+    # +2 dB quirk index (dynspec.py:864-866): static on the fdopnew grid
+    i_at_1 = int(np.argmin(np.abs(fdopnew - 1) - 2))
+
+    def measure(prof, noise, eta_array, keep, cmasks):
+        prof = jnp.where(prof[i_at_1] < 0, prof + 2.0, prof)
+
+        # ---- fold arms onto the eta grid -------------------------------
+        def measure_arm(arm, cmask):
+            # arm indexed like ipos (descending eta); flip to ascending
+            avg = arm[::-1]
+            valid = jnp.isfinite(avg) & jnp.asarray(keep)
+            return measure_profile(avg, valid, noise,
+                                   jnp.asarray(eta_array),
+                                   jnp.asarray(cmask), use_log=False)
+
+        right = prof[ipos]
+        left = prof[ineg][::-1]
+        combined = (right + left) / 2
+        if n_windows is not None:
+            per = [measure_arm(combined, cmasks[k])
+                   for k in range(int(n_windows))]
+            return (jnp.stack([q[0] for q in per]),
+                    jnp.stack([q[1] for q in per]),
+                    jnp.stack([q[2] for q in per]),
+                    per[0][3], per[0][4], noise)
+        out = measure_arm(combined, cmasks[0]) + (noise,)
+        if asymm:
+            el, eel = measure_arm(left, cmasks[0])[:2]
+            er, eer = measure_arm(right, cmasks[0])[:2]
+            out = out + (el, eel, er, eer)
+        return out
+
+    return measure
+
+
+def pack_measurement(res, lamsteps, profile_eta, asymm=False):
+    """Measurement tuple -> :class:`ArcFit` — the split back-end's
+    counterpart of the fitter-internal ``_pack`` (same field contract,
+    ``profile_eta`` supplied at runtime instead of baked)."""
+    import jax.numpy as jnp
+
+    eta, etaerr, etaerr2, avg, filt, noise = res[:6]
+    arms = {}
+    if asymm:
+        arms = dict(zip(("eta_left", "etaerr_left", "eta_right",
+                         "etaerr_right"), res[6:10]))
+    return ArcFit(eta=eta, etaerr=etaerr, etaerr2=etaerr2,
+                  lamsteps=lamsteps, profile_eta=jnp.asarray(profile_eta),
+                  profile_power=avg, profile_power_filt=filt,
+                  noise=noise, **arms)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
+                            method, delmax, numsteps, startbin, cutmid,
+                            etamax, etamin, low_power_diff, high_power_diff,
+                            ref_freq, constraint, nsmooth, noise_error,
+                            asymm=False, constraints=None,
+                            scrunch_rows=0, arc_tail="exact"):
+    if asymm and constraints is not None:
+        raise ValueError("asymm=True and multi-arc constraints are "
+                         "mutually exclusive on the batched fitter")
+    import jax
+    import jax.numpy as jnp
+
+    fdop = np.frombuffer(fdop_key[0]).reshape(fdop_key[1])
+    yaxis = np.frombuffer(yaxis_key[0]).reshape(yaxis_key[1])
+    tdel_axis = np.frombuffer(tdel_key[0]).reshape(tdel_key[1])
+
+    # ---- host-side static precomputation -------------------------------
+    # One frequency adjustment for the fit-level delay cut (dynspec.py:428-
+    # 429); norm_sspec then re-applies it internally (dynspec.py:796-797) —
+    # the reference's double-adjustment quirk, reproduced for parity.
+    # The row indices come from the shared rule so the driver's fused
+    # sspec crop (norm_sspec_row_window) resolves identically.
+    ind, ind_norm, dmax_raw = norm_sspec_row_window(
+        tdel_axis, freq, ref_freq=ref_freq, delmax=delmax)
+    dmax = dmax_raw * (ref_freq / freq) ** 2
+    ymax = yaxis[ind] if lamsteps else dmax
+    yc = yaxis[:ind]
+    emax = etamax if etamax is not None else \
+        ymax / ((fdop[1] - fdop[0]) * cutmid) ** 2
+    emin = etamin if etamin is not None else \
+        (yc[1] - yc[0]) * startbin / np.max(fdop) ** 2
+    cons = np.asarray(constraint, dtype=np.float64)
+    emin_norm = emin
+    if not lamsteps:
+        b2e = _beta_to_eta_factor(freq, ref_freq)
+        emax = emax / (freq / ref_freq) ** 2 * b2e
+        emin = emin / (freq / ref_freq) ** 2 * b2e
+        cons = cons / (freq / ref_freq) ** 2 * b2e
+        # norm_sspec converts the (already converted) eta again
+        # (dynspec.py:820-825) — second half of the same quirk
+        emin_norm = emin / (freq / ref_freq) ** 2 * b2e
+    else:
+        emin_norm = emin
+
+    n = int(numsteps)
+    # constraint sanity: the masks are host-side static, so an impossible
+    # window fails at build time like the numpy path does at fit time
+    # (otherwise the traced argmax would degenerate silently to index 0)
+    def _check_constraint(grid_mask, grid, window=None):
+        if not grid_mask.any():
+            w = tuple(cons) if window is None else tuple(window)
+            raise ValueError(
+                f"no eta grid points inside constraint {w} "
+                f"(grid spans {grid.min():.4g}..{grid.max():.4g})")
+
+    # norm_sspec internals (maxnormfac=1): rows startbin..ind_norm-1
+    tdel_rows = yaxis[startbin:ind_norm]
+    scales = np.sqrt(tdel_rows / emin_norm)         # [R] per-row fdop scale
+    fdopnew = np.linspace(-1.0, 1.0, n)
+    # fold indices (static): positive/negative arms of fdopnew
+    etafrac = np.linspace(-1.0, 1.0, n)
+    ipos = np.where(etafrac > 1 / (2 * n))[0]
+    ineg = np.where(etafrac < -1 / (2 * n))[0]
+    etafrac_avg = 1.0 / etafrac[ipos]               # descending eta
+    eta_array = emin * etafrac_avg[::-1] ** 2       # ascending in eta
+    keep_static = eta_array < emax                  # static part of validity
+    # multi-arc mode: one shared profile measured under K constraint
+    # windows (constraints=...); single-arc mode uses the one constraint.
+    # Windows get the same unit conversion the single constraint received
+    # above (lamsteps=False fits run in converted beta-eta units)
+    def _conv_window(c):
+        c = np.asarray(c, dtype=np.float64)
+        if not lamsteps:
+            c = c / (freq / ref_freq) ** 2 * _beta_to_eta_factor(freq,
+                                                                ref_freq)
+        return c
+
+    cons_windows = ([cons] if constraints is None
+                    else [_conv_window(c) for c in constraints])
+    cons_masks = [(eta_array > c[0]) & (eta_array < c[1])
+                  for c in cons_windows]
+    cons_mask = cons_masks[0]
+    if method == "norm_sspec":
+        # the searchable region is the constraint INTERSECTED with the
+        # static validity window (eta < emax): a constraint lying wholly
+        # past emax would degenerate silently at fit time otherwise
+        for cm, w in zip(cons_masks, cons_windows):
+            _check_constraint(cm & keep_static, eta_array[keep_static],
+                              window=w)
+    # cutmid NaN columns of the row-normalised spectrum (norm_sspec flavour:
+    # floor on both sides, dynspec.py:838-839)
+    ncol = len(fdop)
+    if scrunch_rows == "pallas" and ncol >= 128 and ncol % 128:
+        # Mosaic's gather decomposition works in 128-lane segments
+        # (ops/resample_pallas.py); non-conforming Doppler widths (only
+        # reachable via hand-cropped spectra passed straight to this
+        # fitter — the pipeline's FFT-padded grids are always pow2, so
+        # resolve_routes' recorded "pallas" stays truthful there)
+        # demote to the scan route rather than erroring, and say so
+        from ..utils.log import get_logger, log_event
+
+        log_event(get_logger(), "arc_scrunch_demoted", route="scan",
+                  block=64, ncol=ncol,
+                  reason="ncol not tileable by 128-lane segments")
+        scrunch_rows = 64
+    cut_lo = int(ncol / 2 - np.floor(cutmid / 2))
+    cut_hi = int(ncol / 2 + np.floor(cutmid / 2))
+    col_nan = np.zeros(ncol, dtype=bool)
+    col_nan[cut_lo:cut_hi] = True
+    # fdop is a uniform grid (sspec_axes), so row interpolation reduces to
+    # direct index arithmetic — no searchsorted (log-n gather chains) in
+    # the hot vmapped row loop.  The grid MUST be uniform for this; fail
+    # loudly for exotic callers.
+    f0 = float(fdop[0])
+    dfd = float(fdop[1] - fdop[0])
+    if not np.allclose(np.diff(fdop), dfd, rtol=1e-9, atol=0.0):
+        raise ValueError("jax arc fitter requires a uniform fdop grid "
+                         "(sspec_axes produces one); use backend='numpy' "
+                         "for non-uniform axes")
+    # half-ulp slack so ceil/floor match searchsorted when a query lands
+    # exactly on a grid value (linspace grids differ in the last ulp)
+    _EDGE_EPS = 1e-12
+
+    def _stack_windows(measure_fn, masks, noise):
+        """Measure one shared profile under K constraint windows and
+        stack the per-window (eta, etaerr, etaerr2); profile/filter come
+        from the first window (identical across windows)."""
+        per = [measure_fn(cmask=cm) for cm in masks]
+        return (jnp.stack([q[0] for q in per]),
+                jnp.stack([q[1] for q in per]),
+                jnp.stack([q[2] for q in per]),
+                per[0][3], per[0][4], noise)
+
+    # ---- static row-interp pattern ------------------------------------
+    # The interpolation positions depend only on the (fdop, scales) grids,
+    # never on the data: precompute the [R, n] gather indices and weights
+    # host-side once, so the device step is one take_along_axis + fused
+    # multiply-adds instead of per-row index arithmetic.
+    def _row_interp_pattern():
+        s = scales[:, None]                                  # [R, 1]
+        blo = (-s - f0) / dfd
+        bhi = (s - f0) / dfd
+        lo = np.clip(np.ceil(blo - _EDGE_EPS * np.abs(blo)).astype(np.int64),
+                     0, ncol - 1)
+        hi = np.clip(np.floor(bhi + _EDGE_EPS * np.abs(bhi)).astype(np.int64),
+                     0, ncol - 1)
+        q = np.clip(fdopnew[None, :] * s, f0 + lo * dfd, f0 + hi * dfd)
+        pos = np.clip((q - f0) / dfd, 0.0, ncol - 1.0)
+        i0 = np.clip(np.floor(pos).astype(np.int64), 0, ncol - 2)
+        w = pos - i0
+        return i0.astype(np.int32), w
+
+    _i0_static, _w_static = _row_interp_pattern()            # [R, n]
+
+    def profile_of(sspec):
+        """Per-epoch half: noise estimate + normalised delay-scrunched
+        profile [n].  Split from the measurement tail so the stacked
+        mode can nanmean profiles across epochs (a batch-axis reduction
+        — psum under a data-sharded mesh) before ONE measurement."""
+        # ---- noise estimate (dynspec.py:446-451,463) -------------------
+        noise = _noise_estimate(sspec, cutmid, xp=jnp)
+        noise = noise / (ind - startbin)
+
+        # ---- normalised, delay-scrunched profile -----------------------
+        rows = sspec[startbin:ind_norm, :]
+        rows = jnp.where(col_nan[None, :], jnp.nan, rows)
+
+        if scrunch_rows == "pallas":
+            # Fused Pallas kernel: gather + lerp + NaN-masked accumulate
+            # entirely in VMEM — measured 3.5x the scan path on-chip at
+            # the bench shape (benchmarks/pallas_ab.py, round-4 verdict
+            # "wire").  Off-TPU executions (CPU-fallback bench, forced
+            # route in CI) run the same kernel in interpret mode.
+            from ..ops.resample_pallas import row_scrunch_pallas
+
+            prof = row_scrunch_pallas(rows, _i0_static, _w_static,
+                                      interpret="auto")
+        elif scrunch_rows:
+            # lax.scan over row blocks: the full-gather path materialises
+            # [R, n] (x3 under a B-epoch vmap: [B, R, n] v0/v1/norm in
+            # HBM); accumulating the delay-scrunch nansum/count per block
+            # caps the working set at [B, scrunch_rows, n] regardless of
+            # the delay cut.  Shared with the Pallas A/B baseline
+            # (ops.resample_pallas.row_scrunch_scan), so the
+            # prove-or-remove measurement always races the kernel
+            # against exactly this production path.
+            from ..ops.resample_pallas import row_scrunch_scan
+
+            prof = row_scrunch_scan(rows, _i0_static, _w_static,
+                                    block_r=scrunch_rows)
+        else:
+            i0 = jnp.asarray(_i0_static)
+            w = jnp.asarray(_w_static, dtype=rows.dtype)
+            # mode="clip": i0 is host-clamped to [0, ncol-2]; the
+            # default fill mode's bounds masks cost seconds of XLA
+            # constant folding over the [R, n] index constants
+            v0 = jnp.take_along_axis(rows, i0, axis=1, mode="clip")
+            v1 = jnp.take_along_axis(rows, i0 + 1, axis=1, mode="clip")
+            norm = v0 * (1.0 - w) + v1 * w                   # [R, n]
+            prof = jnp.nanmean(norm, axis=0)                 # [n]
+        return prof, noise
+
+    # measurement tail + arm fold: ONE axes-free implementation
+    # (module-level factories, shared with the split pipeline's
+    # shape-stable back-end unit).  Called here with the baked numpy
+    # statics, the values embed into the trace exactly as the old
+    # closures did (bit-identical programs).
+    measure_profile = _profile_tail(nsmooth, low_power_diff,
+                                    high_power_diff, noise_error,
+                                    arc_tail)
+    _measurer = make_profile_measurer(
+        n, nsmooth=nsmooth, low_power_diff=low_power_diff,
+        high_power_diff=high_power_diff, noise_error=noise_error,
+        asymm=asymm,
+        n_windows=None if constraints is None else len(cons_masks),
+        arc_tail=arc_tail)
+
+    def measure_from_prof(prof, noise):
+        """Measurement tail on a (possibly epoch-stacked) profile."""
+        return _measurer(prof, noise, eta_array, keep_static,
+                         tuple(cons_masks))
+
+    def one_epoch(sspec):
+        prof, noise = profile_of(sspec)
+        return measure_from_prof(prof, noise)
 
     # ---- gridmax statics (dynspec.py:516-659) --------------------------
     if method == "gridmax":
@@ -1121,6 +1214,20 @@ def _make_arc_fitter_cached(fdop_key, yaxis_key, tdel_key, freq, lamsteps,
             return _pack(measure_from_prof(prof, noise))
 
         impl.stacked = impl_stacked
+        # split-pipeline export (PipelineConfig.split_programs): the
+        # shape-volatile per-epoch half (profile extraction) and the
+        # grid inputs the axes-free measurer unit consumes at runtime
+        # (its program identity is driver.split_backend_desc).  The
+        # unsplit path above bakes exactly these values into its
+        # trace, so split/unsplit fits are bit-identical (tier-1
+        # asserts the CSV bytes).
+        impl.profile_of = profile_of
+        impl.measure_inputs = {
+            "arc_eta": np.asarray(eta_array, dtype=np.float64),
+            "arc_keep": np.asarray(keep_static, dtype=bool),
+            "arc_cmasks": np.stack([np.asarray(m, dtype=bool)
+                                    for m in cons_masks]),
+        }
 
     return impl
 

@@ -48,12 +48,55 @@ def _register():
 _register()
 
 
-def _covariance(xp, J, r, n_par):
-    """lmfit-style scaled covariance: inv(J^T J) * redchi."""
-    n = r.shape[0]
+def _jtj(xp, J):
+    """``J^T J`` with a tail-padding-STABLE reduction order: one GEMM
+    per fixed 128-row block, then an across-block sum.  An all-zero
+    padded tail contributes exact ``+0`` blocks, so the split
+    pipeline's canonicalised (rung-padded, ``buckets.vector_rung``)
+    residual vectors yield bit-identical normal equations to the
+    unpadded fit.  XLA's single fused GEMM does NOT have this property
+    — measured on CPU, ``J.T @ J`` over ``[N+pad, P]`` drifts by ulps
+    when the padded length changes, which through 20 LM iterations
+    breaks the split path's CSV byte-equality contract.  (The 1-D dots
+    ``r @ r`` / ``J.T @ r`` reduce per-output sequentially and ARE
+    tail-padding-exact; only the GEMM needs this.)"""
+    if xp is np:
+        return J.T @ J
+    import jax.numpy as jnp
+
+    n, p = J.shape
+    pad = (-int(n)) % 128
+    Jb = jnp.pad(J, ((0, pad), (0, 0))).reshape(-1, 128, p)
+    return jnp.sum(jnp.einsum("bip,biq->bpq", Jb, Jb), axis=0)
+
+
+def _covariance(xp, J, r, n_par, nobs=None):
+    """lmfit-style scaled covariance: inv(J^T J) * redchi.
+
+    ``nobs`` overrides the observation count when the residual vector
+    is TAIL-PADDED with exact zeros (the split pipeline's canonicalised
+    fitter unit): padded entries contribute nothing to ``r @ r`` or
+    ``J^T J``, but ``r.shape[0]`` would inflate the dof and shrink
+    redchi/stderr.  Pass the real count (a traced scalar is fine)."""
+    n = r.shape[0] if nobs is None else nobs
     dof = max(n - n_par, 1) if isinstance(n, int) else n - n_par
-    redchi = (r @ r) / dof
-    JTJ = J.T @ J
+    if nobs is not None and not isinstance(n, int):
+        dof = xp.maximum(n - n_par, 1)
+    if xp is np:
+        redchi = (r @ r) / dof
+    else:
+        # divide via an EXPLICIT same-dtype reciprocal-multiply: when
+        # dof is a compile-time constant (the fused single-program
+        # pipeline) XLA's algebraic simplifier rewrites x / const into
+        # x * (1/const), while a runtime dof (the split back-end unit,
+        # where nobs is an input) keeps the true division — measured as
+        # a 1-ulp redchi/stderr fork between the two programs.  Writing
+        # the reciprocal-multiply ourselves puts both on the identical
+        # rounding path (the folded 1/const equals the runtime f32
+        # divide).
+        dof = xp.asarray(dof, dtype=r.dtype)
+        redchi = (r @ r) * (xp.asarray(1.0, dtype=r.dtype) / dof)
+    JTJ = _jtj(xp, J)
     cov = xp.linalg.inv(JTJ + 1e-300 * xp.eye(n_par)) * redchi
     return cov, redchi
 
@@ -91,14 +134,47 @@ def least_squares_numpy(residual_fn: Callable, p0, bounds=None,
                      cov=cov, redchi=redchi, cost=cost)
 
 
+def outlined_call(fn: Callable, *args):
+    """Run ``fn(*args)`` as its OWN XLA computation inside the caller's
+    jit — a conditional branch of a 2-trip ``lax.scan``.
+
+    Why: XLA compiles the MAIN program graph with whole-module fusion
+    (FMA grouping, reassociation) that depends on everything around a
+    subgraph, so the same ``fn`` traced into two different programs can
+    produce results differing by 1 ulp.  Branch (and loop-body)
+    computations compile per-computation, giving ``fn`` the identical
+    instruction stream in every enclosing program.  The split pipeline
+    leans on this: its shape-stable fitter unit and the fused
+    single-program step both route the scint LM fit through ONE
+    outlined computation, which is what makes their CSV outputs
+    byte-identical (a plain inline trace measurably drifts).  A 1-trip
+    loop would be inlined by XLA's while-loop simplifier; the second
+    trip runs the identity branch, so ``fn`` still executes once."""
+    import jax
+    import jax.numpy as jnp
+
+    shapes = jax.eval_shape(fn, *args)
+    zeros = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+
+    def body(carry, i):
+        out = jax.lax.cond(i == 0, lambda: fn(*args), lambda: carry)
+        return out, None
+
+    out, _ = jax.lax.scan(body, zeros, jnp.arange(2))
+    return out
+
+
 def lm_fit_jax(residual_fn: Callable, p0, bounds=None, args: Sequence = (),
                steps: int = 30, lam0: float = 1e-3, lam_up: float = 10.0,
-               lam_down: float = 0.3):
+               lam_down: float = 0.3, nobs=None):
     """Fixed-iteration damped LM with box projection; fully jittable and
     vmappable (no data-dependent control flow; rejected steps raise the
     damping instead of re-solving).
 
     residual_fn(p, *args) -> [N]; p0 [P].  Returns LsqResult of jax arrays.
+    ``nobs`` is the REAL observation count when the residual vector is
+    tail-padded with exact zeros (see :func:`_covariance`).
     """
     import jax
     import jax.numpy as jnp
@@ -119,7 +195,7 @@ def lm_fit_jax(residual_fn: Callable, p0, bounds=None, args: Sequence = (),
         p, r, c, lam = state
         J = jax.jacfwd(residual_fn)(p, *args)
         g = J.T @ r
-        JTJ = J.T @ J
+        JTJ = _jtj(jnp, J)
         damp = lam * jnp.diag(jnp.diag(JTJ)) + 1e-12 * jnp.eye(n_par)
         dp = jnp.linalg.solve(JTJ + damp, -g)
         p_try = project(p + dp)
@@ -139,7 +215,7 @@ def lm_fit_jax(residual_fn: Callable, p0, bounds=None, args: Sequence = (),
         step, (p_init, r0, c0, jnp.asarray(lam0, dtype=p0.dtype)),
         length=steps)
     J = jax.jacfwd(residual_fn)(p_fin, *args)
-    cov, redchi = _covariance(jnp, J, r, n_par)
+    cov, redchi = _covariance(jnp, J, r, n_par, nobs=nobs)
     return LsqResult(params=p_fin, stderr=jnp.sqrt(jnp.abs(jnp.diag(cov))),
                      cov=cov, redchi=redchi, cost=c_fin)
 

@@ -196,20 +196,34 @@ def _fit_scint_from_dyn_jax(alpha, steps, cuts_method="fft",
     computed with padded 1-D FFT reductions (ops.acf.acf_cuts_direct),
     never materialising the [B, 2nf, 2nt] 2-D ACF — the fast path of the
     batched pipeline.  ``cuts_method="matmul"`` uses the MXU Gram-matrix
-    route for the cuts instead of 1-D FFTs."""
+    route for the cuts instead of 1-D FFTs.
+
+    The LM runs over the CANONICALISED rung-padded concatenated cuts
+    (``scint_cat_front`` + ``fit_scint_params_cat``) — the exact
+    machinery the split pipeline's two-program route uses, so the fused
+    and split paths trace structurally identical fitter bodies and
+    their fits are bit-identical (padding with exact zeros + the
+    padding-stable ``_jtj`` reduction make the rung length
+    numerically inert)."""
     import jax
     import jax.numpy as jnp
 
+    from .. import buckets
     from ..ops.acf import acf_cuts_direct
 
     @jax.jit
     def impl(dyn_batch, dt, df):
         cut_t, cut_f = acf_cuts_direct(dyn_batch, backend="jax",
                                        method=cuts_method, lens=acf_lens)
-        res = jax.vmap(
-            lambda yt, yf, a, b: _fit_scint_single_from_cuts(
-                yt, yf, a, b, alpha, steps))(cut_t, cut_f, dt, df)
-        return _to_scint_params(res, alpha, jnp)
+        nt_, nf_ = cut_t.shape[-1], cut_f.shape[-1]
+        rung = buckets.vector_rung(nt_ + nf_)
+        parts = scint_cat_front(cut_t, cut_f, dt, df, rung)
+        aux = scint_cat_statics(nt_, nf_, rung)
+        return fit_scint_params_cat(
+            parts["scint_y"], parts["scint_p0"], aux["scint_nobs"],
+            parts["scint_x"], aux["scint_is_t"], aux["scint_spike"],
+            parts["scint_xmax"], aux["scint_valid"],
+            alpha=alpha, steps=steps)
 
     return impl
 
@@ -255,6 +269,162 @@ def _fit_scint_jax(alpha, steps, batched):
         return _to_scint_params(fn(acf2d, dt, df, nchan, nsub), alpha, jnp)
 
     return impl
+
+
+# ---------------------------------------------------------------------------
+# split-pipeline fitter unit (PipelineConfig.split_programs): the LM fit
+# over CANONICALISED concatenated cut vectors.  The front-end (shape-
+# keyed) computes the cuts, lag axes and initial guesses exactly as the
+# fused path does, concatenates them in the fused path's order and
+# TAIL-pads to a closed rung length (buckets.vector_rung) — so the real
+# elements sit at identical positions, every reduction in the LM fit
+# accumulates the same values in the same order plus exact trailing
+# zeros, and the fit is bit-identical to the fused path while ONE
+# compiled fitter program serves every (nf, nt).
+# ---------------------------------------------------------------------------
+
+
+def scint_cat_statics(nt_: int, nf_: int, pad_to: int) -> dict:
+    """Host-side constants of the canonicalised cut layout: the
+    time-part selector, white-noise-spike positions (each part's
+    zero-lag sample) and validity mask over the padded axis, plus the
+    real observation count (the LM dof — padded entries must not
+    inflate it).  Keys match the split driver's back-end input dict."""
+    L, nt_, nf_ = int(pad_to), int(nt_), int(nf_)
+    if nt_ + nf_ > L:
+        raise ValueError(f"scint_cat_statics: cuts {nt_}+{nf_} exceed "
+                         f"rung {L}")
+    is_t = np.zeros(L, dtype=bool)
+    is_t[:nt_] = True
+    spike = np.zeros(L, dtype=np.float32)
+    spike[0] = 1.0
+    spike[nt_] = 1.0
+    valid = np.zeros(L, dtype=bool)
+    valid[:nt_ + nf_] = True
+    return {"scint_is_t": is_t, "scint_spike": spike,
+            "scint_valid": valid,
+            "scint_nobs": np.float32(nt_ + nf_)}
+
+
+def scint_cat_front(cut_t, cut_f, dt, df, pad_to: int) -> dict:
+    """TRACED front half of the split scint fit: the per-epoch
+    concatenated, tail-padded cut vector [B, pad_to], the matching
+    per-lane padded lag axis / per-part taper scales, and the
+    initial-guess vector [B, 4] — everything whose computation needs
+    the static (nt, nf).  Guesses and axes are computed with the exact
+    expressions of :func:`_fit_scint_single_from_cuts`, and the fused
+    fast path (:func:`_fit_scint_from_dyn_jax`) routes through THIS
+    same packer, so split and fused programs trace structurally
+    identical LM bodies — the basis of the bit-identity contract."""
+    import jax
+    import jax.numpy as jnp
+
+    nt_, nf_ = cut_t.shape[-1], cut_f.shape[-1]
+    L = int(pad_to)
+    pad = L - (nt_ + nf_)
+    B = cut_t.shape[0]
+    dt_b = jnp.broadcast_to(jnp.asarray(dt, dtype=jnp.result_type(float)),
+                            (B,))
+    df_b = jnp.broadcast_to(jnp.asarray(df, dtype=jnp.result_type(float)),
+                            (B,))
+
+    def one(y_t, y_f, dt_, df_):
+        x_t = dt_ * jnp.linspace(0, nt_, nt_)
+        x_f = df_ * jnp.linspace(0, nf_, nf_)
+        tau0, dnu0, amp0, wn0 = initial_guesses(x_t, y_t, x_f, y_f,
+                                                xp=jnp)
+        y = jnp.concatenate([y_t, y_f])
+        x = jnp.concatenate([x_t, x_f])
+        # each part's own lag maximum = the triangle-taper scale (a
+        # per-part reduction the shape-stable unit cannot recover from
+        # the concat)
+        xmax = jnp.concatenate([jnp.broadcast_to(jnp.max(x_t), (nt_,)),
+                                jnp.broadcast_to(jnp.max(x_f), (nf_,))])
+        # tail-pad: zeros for the data (exact-zero residuals), last
+        # value for the axis/taper vectors (finite model values under
+        # the mask)
+        return (jnp.pad(y, (0, pad)), jnp.pad(x, (0, pad), mode="edge"),
+                jnp.pad(xmax, (0, pad), mode="edge"),
+                jnp.stack([tau0, dnu0, amp0, wn0]))
+
+    y, x, xmax, g = jax.vmap(one)(cut_t, cut_f, dt_b, df_b)
+    return {"scint_y": y, "scint_x": x, "scint_xmax": xmax,
+            "scint_p0": g}
+
+
+def _residual_cat_fixed(p, x, is_t, spike, xmax, valid, y, alpha):
+    import jax.numpy as jnp
+
+    from ..models.acf_models import scint_acf_model_cat
+
+    model = scint_acf_model_cat(x, is_t, spike, xmax, p[0], p[1], p[2],
+                                p[3], alpha, xp=jnp)
+    return jnp.where(valid, y - model, 0.0)
+
+
+def _residual_cat_free(p, x, is_t, spike, xmax, valid, y):
+    import jax.numpy as jnp
+
+    from ..models.acf_models import scint_acf_model_cat
+
+    model = scint_acf_model_cat(x, is_t, spike, xmax, p[0], p[1], p[2],
+                                p[3], p[4], xp=jnp)
+    return jnp.where(valid, y - model, 0.0)
+
+
+@functools.lru_cache(maxsize=None)
+def _fit_scint_cat_jax(alpha, steps):
+    import jax
+    import jax.numpy as jnp
+
+    free = alpha is None
+
+    def single(y, g, nobs, x, is_t, spike, xmax, valid):
+        if free:
+            p0 = jnp.concatenate(
+                [g, jnp.asarray([_ALPHA_KOLMOGOROV], dtype=g.dtype)])
+            lo = jnp.array([1e-10, 1e-10, 0.0, 0.0, 0.0])
+            hi = jnp.array([jnp.inf, jnp.inf, jnp.inf, jnp.inf, 8.0])
+            return lm_fit_jax(_residual_cat_free, p0, bounds=(lo, hi),
+                              args=(x, is_t, spike, xmax, valid, y),
+                              steps=steps, nobs=nobs)
+        lo = jnp.array([1e-10, 1e-10, 0.0, 0.0])
+        hi = jnp.full(4, jnp.inf)
+        return lm_fit_jax(_residual_cat_fixed, g, bounds=(lo, hi),
+                          args=(x, is_t, spike, xmax, valid, y, alpha),
+                          steps=steps, nobs=nobs)
+
+    def impl(y, g, nobs, x, is_t, spike, xmax, valid):
+        # the WHOLE vmapped fit runs as one outlined computation
+        # (lm.outlined_call): identical instruction stream whether this
+        # traces into the fused single-program step or the split
+        # pipeline's back-end unit — the other half (beside rung
+        # padding) of the split path's bit-identity contract
+        from .lm import outlined_call
+
+        res = outlined_call(
+            lambda: jax.vmap(
+                single,
+                in_axes=(0, 0, None, 0, None, None, 0, None))(
+                y, g, nobs, x, is_t, spike, xmax, valid))
+        return _to_scint_params(res, alpha, jnp)
+
+    return impl
+
+
+def fit_scint_params_cat(y, p0, nobs, x, is_t, spike, xmax, valid,
+                         alpha: float | None = _ALPHA_KOLMOGOROV,
+                         steps: int = 20) -> ScintParams:
+    """Batched tau/dnu fit over canonicalised concatenated ACF cuts —
+    the shape-stable back-end unit of the split pipeline.  All grid-
+    derived vectors arrive as runtime inputs, so the traced program
+    depends only on (rung length, alpha, steps): every (nf, nt) whose
+    cuts pad onto the same rung reuses one compiled program.  Results
+    on the real elements are bit-identical to
+    :func:`fit_scint_params_from_dyn` (tier-1-asserted via the CSV
+    byte-equality gate in tests/test_split_programs.py)."""
+    return _fit_scint_cat_jax(alpha, int(steps))(
+        y, p0, nobs, x, is_t, spike, xmax, valid)
 
 
 # ---------------------------------------------------------------------------

@@ -43,6 +43,28 @@ def scint_acf_model(x_t, x_f, tau, dnu, amp, wn, alpha=5 / 3, xp=np):
     return xp.concatenate([mt, mf])
 
 
+def scint_acf_model_cat(x, is_t, spike, xmax, tau, dnu, amp, wn,
+                        alpha=5 / 3, xp=np):
+    """:func:`scint_acf_model` on ONE pre-concatenated lag axis — the
+    shape-stable form the split pipeline's fitter unit compiles once
+    for every observing grid.
+
+    ``x`` is the concatenated (time-cut, frequency-cut) lag vector
+    (tail-padded to a closed rung length by the front-end), ``is_t``
+    selects the time part, ``spike`` is 1.0 at each part's zero-lag
+    sample (the white-noise spike positions), and ``xmax`` carries each
+    part's own lag maximum (the triangle-taper scale — a per-part
+    reduction the concatenated form cannot recompute).  Element-for-
+    element identical to the concat of :func:`tau_acf_model` /
+    :func:`dnu_acf_model` (same operation order per element, so the
+    split fit is bit-identical — tested in tests/test_split_programs).
+    """
+    mt = amp * xp.exp(-(x / tau) ** alpha)
+    mf = amp * xp.exp(-x / (dnu / np.log(2)))
+    model = xp.where(is_t, mt, mf) + wn * spike
+    return model * (1 - x / xmax)
+
+
 def mirror_spectrum(y, xp=np):
     """Mirror a positive-lag function to a symmetric one and return the
     real FFT's positive half — the ACF->power-spectrum transform used by

@@ -184,6 +184,11 @@ def instrument_jit(fn, name: str, aot: bool = False):
         if compiled is None:
             if not aot:
                 core.inc("jit_cache_miss")
+                # per-unit attribution (ISSUE 14): the split pipeline's
+                # acceptance contract is jit_cache_miss[pipeline.back]
+                # == 0 on a warmed process hitting a novel shape — the
+                # aggregate counter cannot say WHICH unit missed
+                core.inc(f"jit_cache_miss[{name}]")
             compiled = _compile(key, *args, **kwargs)
         if compiled is fn:
             # no AOT path: the first (compiling) call was already timed

@@ -115,6 +115,12 @@ HISTS = frozenset({
 # -- bracketed families: "<family>[<key>]" ----------------------------------
 FAMILIES = frozenset({
     "compile_ms",                                   # counter
+    # per-unit jit-cache misses beside the aggregate jit_cache_miss
+    # counter (ISSUE 14 split pipeline: key = pipeline.front /
+    # pipeline.back / pipeline.step — the split acceptance gate asserts
+    # jit_cache_miss[pipeline.back] == 0 on a warmed process hitting a
+    # novel shape)
+    "jit_cache_miss",                               # counter
     "faults_injected", "epochs_quarantined",        # counters
     "bucket_hits", "bucket_lanes_real", "bucket_lanes_pad",  # counters
     "queue_shard_claims",                           # counter (per shard)

@@ -228,7 +228,23 @@ def compile_profile(counters: dict | None,
             and not (artifact["unpacked"] or artifact["rejected"]
                      or artifact["evictions"]):
         return None
-    return {"stages": stages, "artifact": artifact}
+    out = {"stages": stages, "artifact": artifact}
+    # program-splitting rollup (ISSUE 14): when the run executed split
+    # units, quantify the RECOMPILED slice (shape-volatile front-end)
+    # against the REUSED one (shape-stable fitter back-end) — the
+    # number the split exists to improve.  Per-unit jit_cache_miss
+    # comes from the bracketed family obs.instrument_jit records.
+    misses = bracketed_values(counters, "jit_cache_miss[")
+    if "pipeline.front" in stages or "pipeline.back" in stages:
+        front = stages.get("pipeline.front", {"cold_ms": 0.0})
+        back = stages.get("pipeline.back", {"cold_ms": 0.0})
+        out["split"] = {
+            "front_cold_ms": front.get("cold_ms", 0.0),
+            "front_misses": int(misses.get("pipeline.front", 0)),
+            "back_cold_ms": back.get("cold_ms", 0.0),
+            "back_misses": int(misses.get("pipeline.back", 0)),
+        }
+    return out
 
 
 def catalog_section(counters: dict | None,
@@ -489,6 +505,16 @@ def render(spans: dict, counters: dict | None = None,
                 lines.append(f"    {sig}: cold_ms = "
                              f"{srow['cold_ms']:.3f}, warm_ms = "
                              f"{srow['warm_ms']:.3f}")
+        sp = prof.get("split")
+        if sp:
+            lines.append(
+                f"  program split: recompiled slice (front) = "
+                f"{sp['front_cold_ms']:.3f} ms over "
+                f"{sp['front_misses']} signature(s); reused fitter "
+                f"(back) = {sp['back_cold_ms']:.3f} ms over "
+                f"{sp['back_misses']} signature(s)"
+                + (" — every novel shape served by warm fitters"
+                   if sp["back_misses"] == 0 else ""))
         art = prof["artifact"]
         if art["digest"] is not None:
             lines.append(f"  warm-cache artifact: digest = "

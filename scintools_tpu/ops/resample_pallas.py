@@ -94,8 +94,14 @@ def row_scrunch_scan(rows, i0, w, block_r: int = 64):
 
     def body(acc, xs):
         rc, ic, wc = xs
-        v0 = jnp.take_along_axis(rc, ic, axis=1)
-        v1 = jnp.take_along_axis(rc, ic + 1, axis=1)
+        # mode="clip": the indices are host-clamped to [0, ncol-2]
+        # (arc_fit._row_interp_pattern), so the default fill mode's
+        # out-of-bounds masks are dead weight — and XLA constant-folds
+        # those [R, n] masks at COMPILE time, which measured ~8 s of
+        # the step's cold compile at a 2000-point eta grid (values are
+        # identical either way; tier-1 pins the profile bytes)
+        v0 = jnp.take_along_axis(rc, ic, axis=1, mode="clip")
+        v1 = jnp.take_along_axis(rc, ic + 1, axis=1, mode="clip")
         nrm = v0 * (1.0 - wc) + v1 * wc
         keep = ~jnp.isnan(nrm)
         fin = jnp.isfinite(nrm)
@@ -137,11 +143,11 @@ def _kernel(rows_ref, i0_ref, w_ref, sum_ref, cnt_ref, *, L):
             seg = rows[:, s * L:(s + 1) * L]   # [rb, L] register-width
             loc0 = i0 - s * L
             g0 = jnp.take_along_axis(seg, jnp.clip(loc0, 0, L - 1),
-                                     axis=1)
+                                     axis=1, mode="clip")
             v0 = jnp.where((loc0 >= 0) & (loc0 < L), g0, v0)
             loc1 = loc0 + 1
             g1 = jnp.take_along_axis(seg, jnp.clip(loc1, 0, L - 1),
-                                     axis=1)
+                                     axis=1, mode="clip")
             v1 = jnp.where((loc1 >= 0) & (loc1 < L), g1, v1)
         nrm = v0 * (1.0 - w) + v1 * w
         keep = ~jnp.isnan(nrm)

@@ -154,6 +154,142 @@ class PipelineConfig:
     # within the 2 % budget); default off keeps every existing output
     # byte-identical.  CLI: --fused-sspec.
     fused_sspec: bool = False
+    # Compile-unit splitting (ISSUE 14): run the step as TWO separately
+    # jit-compiled, separately cached program units — a shape-volatile
+    # FRONT-END (generate/upcast → lambda resample → sspec transform →
+    # ACF cuts + normalised arc profile) keyed on the full (axes, nf,
+    # nt, B, dtype) signature, and a shape-stable BACK-END (the scint
+    # LM fit over canonicalised tail-padded cut vectors, the arc
+    # measurement tail over fixed-length norm_sspec profiles) keyed
+    # only on its canonicalised intermediate signature (a closed mini
+    # ladder of padded lengths — buckets.vector_rung).  A novel
+    # (nf, nt) then recompiles only the front-end slice; the fitter
+    # programs serve warm (back-end jit_cache_miss == 0,
+    # tier-1-asserted).  Device-resident handoff (no host round-trip);
+    # results are BIT-identical to the fused single program (the CSV
+    # byte-equality gate), so this is a placement knob: serve job
+    # identity strips it like `bucket`.  Default off — the fused
+    # single program stays the bit-matching default.  CLI:
+    # --split-programs.  Requires the standard survey config (norm_
+    # sspec arc fitting, no return_acf/return_sspec/fit_scint_2d/
+    # arc_stack, no chan-sharded mesh — PipelineConfig.validate).
+    split_programs: bool = False
+
+    def validate(self, mesh=None, chan_sharded: bool | None = None) -> None:
+        """Reject config combinations the traced step cannot honour —
+        the ONE rule site (ISSUE 14 satellite) shared by
+        :func:`make_pipeline` (driver/CLI) and
+        ``serve.queue.validate_job_cfg`` (client submit), so
+        split/crop/arc rules cannot drift between CLI, driver and
+        serve.  ``mesh``/``chan_sharded`` gate the sharding-dependent
+        rules; a meshless validation (the serve client's) simply skips
+        them."""
+        if self.scint_cuts not in ("auto", "fft", "matmul"):
+            raise ValueError(
+                f"PipelineConfig.scint_cuts: unknown method "
+                f"{self.scint_cuts!r} (expected 'auto', 'fft' or "
+                f"'matmul')")
+        if (self.arc_scrunch_rows != "pallas"
+                and (isinstance(self.arc_scrunch_rows, str)
+                     or self.arc_scrunch_rows < -1)):
+            raise ValueError(
+                f"PipelineConfig.arc_scrunch_rows must be -1 (auto), 0 "
+                f"(full gather), a positive block size or 'pallas', got "
+                f"{self.arc_scrunch_rows}")
+        if self.arc_tail not in ("exact", "fast"):
+            raise ValueError(
+                f"PipelineConfig.arc_tail must be 'exact' or 'fast', "
+                f"got {self.arc_tail!r}")
+        if self.arc_method not in ("norm_sspec", "gridmax", "thetatheta"):
+            raise ValueError(
+                f"PipelineConfig.arc_method: unknown method "
+                f"{self.arc_method!r} (expected 'norm_sspec', 'gridmax' "
+                f"or 'thetatheta')")
+        if self.precision not in ("f32", "bf16_io"):
+            raise ValueError(
+                f"PipelineConfig.precision: unknown policy "
+                f"{self.precision!r} (expected 'f32' or 'bf16_io')")
+        if self.fft_lens not in ("pow2", "fast"):
+            raise ValueError(
+                f"PipelineConfig.fft_lens: unknown mode "
+                f"{self.fft_lens!r} (expected 'pow2' or 'fast')")
+        if self.sspec_crop and (not self.fit_arc or self.return_sspec
+                                or self.arc_method != "norm_sspec"):
+            raise ValueError(
+                "PipelineConfig.sspec_crop fuses the norm_sspec fitter's "
+                "delay-window crop into the step: it requires "
+                "fit_arc=True with arc_method='norm_sspec' and "
+                "return_sspec=False (a returned spectrum must be the "
+                "full grid)")
+        if self.fused_sspec and _resolve_chan_sharded(mesh, chan_sharded):
+            raise ValueError(
+                "PipelineConfig.fused_sspec does not support a "
+                "chan-sharded mesh yet: the fused kernels tile a single "
+                "device's spectrum (the channel-sharded FFT path keeps "
+                "the unfused chain)")
+        if self.arc_stack and (self.arc_method != "norm_sspec"
+                               or not self.fit_arc
+                               or self.arc_brackets is not None):
+            raise ValueError(
+                "PipelineConfig.arc_stack requires fit_arc=True with "
+                "arc_method='norm_sspec' and no arc_brackets (the "
+                "campaign stack averages ONE normalised profile per "
+                "epoch)")
+        if self.split_programs:
+            if self.fit_arc and self.arc_method != "norm_sspec":
+                raise ValueError(
+                    "PipelineConfig.split_programs splits the step at "
+                    "the norm_sspec profile boundary: arc_method="
+                    f"{self.arc_method!r} has no shape-stable fitter "
+                    "unit yet (use 'norm_sspec', or drop the split)")
+            if (self.return_acf or self.return_sspec
+                    or self.fit_scint_2d or self.arc_stack):
+                raise ValueError(
+                    "PipelineConfig.split_programs supports the "
+                    "standard survey step only: return_acf/return_sspec"
+                    "/fit_scint_2d/arc_stack keep shape-volatile grids "
+                    "past the split boundary (drop them, or drop the "
+                    "split)")
+            if _resolve_chan_sharded(mesh, chan_sharded):
+                raise ValueError(
+                    "PipelineConfig.split_programs does not support a "
+                    "chan-sharded mesh: the handoff intermediates are "
+                    "batch-sharded only")
+        if self.arc_method == "thetatheta" and self.fit_arc:
+            windows = (self.arc_brackets
+                       if self.arc_brackets is not None
+                       else (self.arc_constraint,))
+            if len(windows) == 0:
+                raise ValueError("arc_brackets must contain at least "
+                                 "one (lo, hi) window")
+            for lo, hi in windows:
+                if not (np.isfinite(lo) and np.isfinite(hi)
+                        and 0 < lo < hi):
+                    raise ValueError(
+                        "arc_method='thetatheta' sweeps its curvature "
+                        f"bracket(s), which must be finite and "
+                        f"positive, got {tuple(windows)} (units follow "
+                        "the spectrum: beta-eta for lamsteps, us/mHz^2 "
+                        "otherwise, as fit_arc_thetatheta)")
+            if self.arc_asymm:
+                raise ValueError(
+                    "arc_method='thetatheta' does not support arc_asymm "
+                    "(the concentration sweep has no per-arm split)")
+            # knobs of the power-profile fitters that the concentration
+            # sweep has no analogue for: reject loudly, never ignore
+            _def = PipelineConfig()
+            ignored = [name for name, val, dflt in (
+                ("arc_delmax", self.arc_delmax, _def.arc_delmax),
+                ("arc_nsmooth", self.arc_nsmooth, _def.arc_nsmooth),
+                ("arc_scrunch_rows", self.arc_scrunch_rows,
+                 _def.arc_scrunch_rows),
+                ("arc_tail", self.arc_tail, _def.arc_tail),
+            ) if val != dflt]
+            if ignored:
+                raise ValueError(
+                    f"arc_method='thetatheta' has no equivalent of "
+                    f"{', '.join(ignored)} (norm_sspec/gridmax knobs); "
+                    "leave them at their defaults")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -278,86 +414,10 @@ def make_pipeline(freqs, times, config: PipelineConfig = PipelineConfig(),
     template return the same compiled step (no retrace/recompile per
     survey batch).
     """
-    if config.scint_cuts not in ("auto", "fft", "matmul"):
-        raise ValueError(
-            f"PipelineConfig.scint_cuts: unknown method "
-            f"{config.scint_cuts!r} (expected 'auto', 'fft' or 'matmul')")
-    if (config.arc_scrunch_rows != "pallas"
-            and (isinstance(config.arc_scrunch_rows, str)
-                 or config.arc_scrunch_rows < -1)):
-        raise ValueError(
-            f"PipelineConfig.arc_scrunch_rows must be -1 (auto), 0 (full "
-            f"gather), a positive block size or 'pallas', got "
-            f"{config.arc_scrunch_rows}")
-    if config.arc_tail not in ("exact", "fast"):
-        raise ValueError(
-            f"PipelineConfig.arc_tail must be 'exact' or 'fast', got "
-            f"{config.arc_tail!r}")
-    if config.arc_method not in ("norm_sspec", "gridmax", "thetatheta"):
-        raise ValueError(
-            f"PipelineConfig.arc_method: unknown method "
-            f"{config.arc_method!r} (expected 'norm_sspec', 'gridmax' or "
-            f"'thetatheta')")
-    if config.precision not in ("f32", "bf16_io"):
-        raise ValueError(
-            f"PipelineConfig.precision: unknown policy "
-            f"{config.precision!r} (expected 'f32' or 'bf16_io')")
-    if config.fft_lens not in ("pow2", "fast"):
-        raise ValueError(
-            f"PipelineConfig.fft_lens: unknown mode {config.fft_lens!r} "
-            f"(expected 'pow2' or 'fast')")
-    if config.sspec_crop and (not config.fit_arc or config.return_sspec
-                              or config.arc_method != "norm_sspec"):
-        raise ValueError(
-            "PipelineConfig.sspec_crop fuses the norm_sspec fitter's "
-            "delay-window crop into the step: it requires fit_arc=True "
-            "with arc_method='norm_sspec' and return_sspec=False (a "
-            "returned spectrum must be the full grid)")
-    if config.fused_sspec and _resolve_chan_sharded(mesh, chan_sharded):
-        raise ValueError(
-            "PipelineConfig.fused_sspec does not support a chan-sharded "
-            "mesh yet: the fused kernels tile a single device's spectrum "
-            "(the channel-sharded FFT path keeps the unfused chain)")
-    if config.arc_stack and (config.arc_method != "norm_sspec"
-                             or not config.fit_arc
-                             or config.arc_brackets is not None):
-        raise ValueError(
-            "PipelineConfig.arc_stack requires fit_arc=True with "
-            "arc_method='norm_sspec' and no arc_brackets (the campaign "
-            "stack averages ONE normalised profile per epoch)")
-    if config.arc_method == "thetatheta" and config.fit_arc:
-        windows = (config.arc_brackets if config.arc_brackets is not None
-                   else (config.arc_constraint,))
-        if len(windows) == 0:
-            raise ValueError("arc_brackets must contain at least one "
-                             "(lo, hi) window")
-        for lo, hi in windows:
-            if not (np.isfinite(lo) and np.isfinite(hi) and 0 < lo < hi):
-                raise ValueError(
-                    "arc_method='thetatheta' sweeps its curvature "
-                    f"bracket(s), which must be finite and positive, got "
-                    f"{tuple(windows)} (units follow the spectrum: "
-                    "beta-eta for lamsteps, us/mHz^2 otherwise, as "
-                    "fit_arc_thetatheta)")
-        if config.arc_asymm:
-            raise ValueError(
-                "arc_method='thetatheta' does not support arc_asymm "
-                "(the concentration sweep has no per-arm split)")
-        # knobs of the power-profile fitters that the concentration sweep
-        # has no analogue for: reject loudly rather than silently ignore
-        _def = PipelineConfig()
-        ignored = [name for name, val, dflt in (
-            ("arc_delmax", config.arc_delmax, _def.arc_delmax),
-            ("arc_nsmooth", config.arc_nsmooth, _def.arc_nsmooth),
-            ("arc_scrunch_rows", config.arc_scrunch_rows,
-             _def.arc_scrunch_rows),
-            ("arc_tail", config.arc_tail, _def.arc_tail),
-        ) if val != dflt]
-        if ignored:
-            raise ValueError(
-                f"arc_method='thetatheta' has no equivalent of "
-                f"{', '.join(ignored)} (norm_sspec/gridmax knobs); leave "
-                "them at their defaults")
+    # ONE rule site (PipelineConfig.validate — shared with the serve
+    # client's submit validation), so a bad config fails identically
+    # from the CLI, the driver and a queued job
+    config.validate(mesh=mesh, chan_sharded=chan_sharded)
     if synth is not None:
         from ..sim import campaign
 
@@ -559,6 +619,231 @@ def survey_routes(epochs, config: "PipelineConfig", mesh=None,
     return out
 
 
+# ---------------------------------------------------------------------------
+# compile-unit splitting (ISSUE 14): shape-volatile front-end vs
+# shape-stable fitter back-end as separately compiled, separately
+# cached program units.
+# ---------------------------------------------------------------------------
+
+# config fields ONLY the back-end (fitter) unit reads: they are pinned
+# to defaults in the front-end's cache key (changing a fitter knob must
+# not invalidate the transform programs) and enter the back-end's key
+# via split_backend_desc.  arc_delmax/arc_startbin/arc_cutmid/
+# arc_constraint/arc_brackets shape the profile EXTRACTION or arrive as
+# runtime grid inputs, so they are deliberately NOT here (brackets'
+# window COUNT is back-program structure and rides in the desc below).
+_SPLIT_BACK_ONLY_FIELDS = ("alpha", "lm_steps", "arc_nsmooth", "arc_tail",
+                           "arc_asymm")
+
+
+def _front_config(config: "PipelineConfig") -> "PipelineConfig":
+    """The split front-end unit's program identity: the full config
+    with the fitter-only knobs pinned to defaults (the front-end trace
+    never reads them, so two configs differing only there must share
+    one front artifact)."""
+    d = PipelineConfig()
+    return dataclasses.replace(
+        config, **{f: getattr(d, f) for f in _SPLIT_BACK_ONLY_FIELDS})
+
+
+def split_backend_desc(config: "PipelineConfig") -> tuple:
+    """The split back-end unit's program identity: everything that
+    changes its traced program and NOTHING axes-derived (the point of
+    the split).  Doubles as the `_make_split_backend` cache key and a
+    component of ``compile_cache.split_backend_key``.  Grid-derived
+    values (eta array, validity window, constraint masks, lag axes)
+    arrive as runtime inputs and are absent by design."""
+    return (bool(config.fit_scint), config.alpha, int(config.lm_steps),
+            bool(config.fit_arc), int(config.arc_numsteps),
+            int(config.arc_nsmooth), bool(config.arc_asymm),
+            None if config.arc_brackets is None
+            else len(config.arc_brackets),
+            str(config.arc_tail), bool(config.lamsteps))
+
+
+@functools.lru_cache(maxsize=None)
+def _make_split_backend(back_desc: tuple):
+    """ONE jit'd fitter program per back identity, shared across every
+    axes/(nf, nt) signature — the module-level cache (and, under
+    obs.instrument_jit, the shared wrapper whose compiled-signature
+    memo) is what makes a novel dynspec shape hit
+    ``jit_cache_miss[pipeline.back] == 0``."""
+    import jax
+
+    (fit_scint, alpha, lm_steps, fit_arc, numsteps, nsmooth, asymm,
+     n_windows, arc_tail, lamsteps) = back_desc
+
+    measure = None
+    if fit_arc:
+        from ..fit.arc_fit import make_profile_measurer
+
+        measure = make_profile_measurer(
+            numsteps, nsmooth=nsmooth, noise_error=True, asymm=asymm,
+            n_windows=n_windows, arc_tail=arc_tail)
+
+    def back(parts):
+        scint = arc = None
+        if fit_scint:
+            from ..fit.scint_fit import fit_scint_params_cat
+
+            scint = fit_scint_params_cat(
+                parts["scint_y"], parts["scint_p0"],
+                parts["scint_nobs"], parts["scint_x"],
+                parts["scint_is_t"], parts["scint_spike"],
+                parts["scint_xmax"], parts["scint_valid"],
+                alpha=alpha, steps=lm_steps)
+        if fit_arc:
+            from ..fit.arc_fit import pack_measurement
+
+            res = jax.vmap(measure, in_axes=(0, 0, None, None, None))(
+                parts["prof"], parts["noise"], parts["arc_eta"],
+                parts["arc_keep"], parts["arc_cmasks"])
+            arc = pack_measurement(res, lamsteps, parts["arc_eta"],
+                                   asymm=asymm)
+        return {"scint": scint, "arc": arc}
+
+    return jax.jit(back)
+
+
+def _split_profile_len(numsteps: int) -> int:
+    """Length of the folded eta-grid arm (= the eta array / masks the
+    back unit consumes) — the same ``ipos`` rule the fitter uses."""
+    n = int(numsteps)
+    etafrac = np.linspace(-1.0, 1.0, n)
+    return int(np.sum(etafrac > 1 / (2 * n)))
+
+
+class _SplitStep:
+    """The split pipeline step: front jit + (shared) back jit with a
+    device-resident handoff, behind the same ``step(dyn) ->
+    PipelineResult`` contract as the fused program.  Carries the
+    per-unit cache keys so run_pipeline/warmup can load/export each
+    unit's ``.jaxexec`` artifact independently."""
+
+    unit_front = "pipeline.front"
+    unit_back = "pipeline.back"
+
+    def __init__(self, front, back, aux: dict, result_fn, back_desc,
+                 key_parts, dims: dict):
+        self.front = front            # jit'd, per-axes
+        self.back = back              # jit'd, SHARED per back_desc
+        self._aux_np = aux            # canonical-dtype numpy grid inputs
+        self._aux_dev = None          # lazy device residency (one H2D)
+        self._result = result_fn
+        self.back_desc = back_desc
+        self._key_parts = key_parts   # (freqs, times, config, mesh,
+        #                                chan_sharded, donate, genid)
+        self.dims = dims              # rung/profile lengths for specs
+
+    # -- cache keys / AOT specs -----------------------------------------
+    def front_key(self, batch_shape, dtype) -> str:
+        from .. import compile_cache
+
+        f, t, cfg, mesh, chan, donate, genid = self._key_parts
+        return compile_cache.step_key(
+            f, t, _front_config(cfg), mesh, chan, batch_shape, dtype,
+            donate=donate, synth=genid, unit="front")
+
+    def back_sig(self, b: int) -> tuple:
+        """Canonical (name, shape, dtype) tuple of the back unit's
+        input pytree at batch size ``b`` — the shape component of its
+        artifact key."""
+        spec = self.back_spec(b)
+        return tuple(sorted((k, tuple(int(s) for s in v.shape),
+                             str(v.dtype)) for k, v in spec.items()))
+
+    def back_key(self, b: int) -> str:
+        from .. import compile_cache
+
+        return compile_cache.split_backend_key(self.back_desc,
+                                               self.back_sig(b))
+
+    def back_aot_eligible(self) -> bool:
+        """Whether the back unit may be served from / exported as a
+        serialized executable.  Under a >1-device mesh the front jit's
+        outputs are batch-SHARDED arrays, but ``back_spec`` describes
+        plain single-device inputs (the axes-free key deliberately
+        carries no mesh): a deserialized executable would reject the
+        sharded handoff and instrument_jit's fallback would silently
+        re-pay the cold compile while still counting warm.  The live
+        back jit handles sharded inputs fine, so meshed runs simply
+        skip the artifact layer for this unit."""
+        mesh = self._key_parts[3]
+        return mesh is None or int(np.prod(list(
+            dict(mesh.shape).values()))) == 1
+
+    def back_spec(self, b: int) -> dict:
+        """ShapeDtypeStruct pytree of the back unit's input dict."""
+        import jax
+
+        # the canonical float dtype this runtime computes in (f32 under
+        # the production x64-off runtime, f64 under x64 test configs)
+        fdt = jax.dtypes.canonicalize_dtype(np.float64)  # host-f64: canonicalisation probe
+        S = jax.ShapeDtypeStruct
+        spec = {}
+        d = self.dims
+        if "scint_rung" in d:
+            L = d["scint_rung"]
+            spec.update(scint_y=S((b, L), fdt), scint_x=S((b, L), fdt),
+                        scint_xmax=S((b, L), fdt), scint_p0=S((b, 4), fdt),
+                        scint_is_t=S((L,), np.dtype(bool)),
+                        scint_spike=S((L,), np.dtype(np.float32)),
+                        scint_valid=S((L,), np.dtype(bool)),
+                        scint_nobs=S((), np.dtype(np.float32)))
+        if "arc_profile" in d:
+            n, m, k = d["arc_profile"], d["arc_grid"], d["arc_windows"]
+            spec.update(prof=S((b, n), fdt), noise=S((b,), fdt),
+                        arc_eta=S((m,), fdt),
+                        arc_keep=S((m,), np.dtype(bool)),
+                        arc_cmasks=S((k, m), np.dtype(bool)))
+        return spec
+
+    # -- execution -------------------------------------------------------
+    def _aux_device(self) -> dict:
+        if self._aux_dev is None:
+            import jax
+
+            self._aux_dev = {k: jax.device_put(v)
+                             for k, v in self._aux_np.items()}
+        return self._aux_dev
+
+    def bind(self, front_fn=None, back_fn=None):
+        """A plain ``step(dyn)`` callable over the given unit
+        implementations (live jit by default; AOT-loaded executables
+        from run_pipeline's per-unit artifact lookup)."""
+        ffn = self.front if front_fn is None else front_fn
+        bfn = self.back if back_fn is None else back_fn
+
+        def call(dyn_batch):
+            parts = ffn(dyn_batch)
+            full = dict(parts)
+            full.update(self._aux_device())
+            return self._result(bfn(full))
+
+        return call
+
+    def instrumented(self, front_aot=None, back_aot=None):
+        """The composed step with per-unit obs accounting: compile/
+        execute spans and ``compile_ms``/``jit_cache_miss`` series land
+        under ``pipeline.front`` / ``pipeline.back`` instead of one
+        opaque ``pipeline.step`` — `trace report`'s compile profile
+        then shows exactly which slice a novel shape recompiled.
+        ``front_aot``/``back_aot``: artifact-loaded unit executables
+        (instrumented as warm)."""
+        f = (obs.instrument_jit(self.front, self.unit_front)
+             if front_aot is None
+             else obs.instrument_jit(front_aot, self.unit_front,
+                                     aot=True))
+        b = (obs.instrument_jit(self.back, self.unit_back)
+             if back_aot is None
+             else obs.instrument_jit(back_aot, self.unit_back,
+                                     aot=True))
+        return self.bind(front_fn=f, back_fn=b)
+
+    def __call__(self, dyn_batch):
+        return self.bind()(dyn_batch)
+
+
 @functools.lru_cache(maxsize=None)
 def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded,
                           donate=False, synth=None):
@@ -679,6 +964,114 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded,
             constraint=config.arc_constraint, ref_freq=config.ref_freq,
             asymm=config.arc_asymm, constraints=config.arc_brackets,
             scrunch_rows=rc, arc_tail=config.arc_tail)
+
+    if config.split_programs:
+        if jax.process_count() > 1:
+            raise ValueError(
+                "PipelineConfig.split_programs is not supported under a "
+                "multi-process runtime yet (the handoff intermediates "
+                "are process-local); drop the split on multihost pods")
+        from .. import buckets as buckets_mod
+        from ..fit.scint_fit import scint_cat_front, scint_cat_statics
+
+        # canonicalised intermediate dims (the back unit's signature)
+        dims: dict = {}
+        aux: dict = {}
+        if config.fit_scint:
+            # acf_cuts_direct returns (cut_t [..., nt], cut_f [..., nf])
+            rung = buckets_mod.vector_rung(nsub + nchan)
+            dims["scint_rung"] = rung
+            aux.update(scint_cat_statics(nsub, nchan, rung))
+        if config.fit_arc:
+            # grid inputs of the axes-free measurer: built by the SAME
+            # fitter closure the fused path bakes them from (scrunch
+            # route pinned to 0 here — the statics do not depend on it
+            # and resolving the real route probes the device, which a
+            # pipeline BUILD must never do)
+            stat_fitter = make_arc_fitter(
+                fdop=fdop, yaxis=yaxis_fit, tdel=tdel_fit, freq=fc,
+                lamsteps=config.lamsteps, method=config.arc_method,
+                numsteps=config.arc_numsteps,
+                startbin=config.arc_startbin, cutmid=config.arc_cutmid,
+                nsmooth=config.arc_nsmooth, delmax=arc_delmax,
+                constraint=config.arc_constraint,
+                ref_freq=config.ref_freq, asymm=config.arc_asymm,
+                constraints=config.arc_brackets, scrunch_rows=0,
+                arc_tail=config.arc_tail)
+            mi = stat_fitter.measure_inputs
+            # canonical runtime dtypes (what jit would canonicalise the
+            # f64 statics to anyway — f32 under the production x64-off
+            # runtime, f64 under x64 — and what a deserialized unit
+            # executable demands exactly; the fused path bakes the SAME
+            # canonicalised values as trace constants)
+            grid_dt = jax.dtypes.canonicalize_dtype(np.float64)  # host-f64: canonicalisation probe
+            aux["arc_eta"] = np.asarray(mi["arc_eta"], dtype=grid_dt)
+            aux["arc_keep"] = np.asarray(mi["arc_keep"], dtype=bool)
+            aux["arc_cmasks"] = np.asarray(mi["arc_cmasks"], dtype=bool)
+            dims["arc_profile"] = int(config.arc_numsteps)
+            dims["arc_grid"] = int(aux["arc_eta"].shape[0])
+            dims["arc_windows"] = int(aux["arc_cmasks"].shape[0])
+            assert dims["arc_grid"] == _split_profile_len(
+                config.arc_numsteps)
+
+        def front(dyn_batch):
+            dyn_batch = jnp.asarray(dyn_batch)
+            if gen_fn is not None:
+                dyn_batch = gen_fn(dyn_batch)
+            elif config.precision == "bf16_io":
+                dyn_batch = dyn_batch.astype(jnp.float32)
+            parts = {}
+            if config.fit_scint:
+                from ..ops.acf import acf_cuts_direct
+
+                cut_t, cut_f = acf_cuts_direct(
+                    dyn_batch, backend="jax",
+                    method=_resolve_cuts(
+                        config.scint_cuts, mesh, dyn_batch.shape,
+                        itemsize=dyn_batch.dtype.itemsize),
+                    lens=acf_lens)
+                parts.update(scint_cat_front(cut_t, cut_f, dt, df,
+                                             dims["scint_rung"]))
+            if config.fit_arc:
+                fft_in = (jnp.einsum("lf,bft->blt", jnp.asarray(W_np),
+                                     dyn_batch)
+                          if config.lamsteps else dyn_batch)
+                sec_b = sspec_op(fft_in, prewhite=config.prewhite,
+                                 window=config.window,
+                                 window_frac=config.window_frac,
+                                 db=True, backend="jax",
+                                 lens=config.fft_lens,
+                                 crop_rows=crop_rows,
+                                 fused=config.fused_sspec)
+                fitter = build_arc_fitter(tuple(dyn_batch.shape),
+                                          dyn_batch.dtype.itemsize)
+                prof, noise = jax.vmap(fitter.profile_of)(sec_b)
+                parts["prof"] = prof
+                parts["noise"] = noise
+            return parts
+
+        fkw = {}
+        if donate:
+            fkw["donate_argnums"] = 0
+        if mesh is not None:
+            fkw["in_shardings"] = mesh_mod.data_sharding(
+                mesh, chan_sharded=chan_sharded)
+        front_jit = jax.jit(front, **fkw)
+        back_desc = split_backend_desc(config)
+        back_jit = _make_split_backend(back_desc)
+        fdop_np = np.asarray(fdop, dtype=np.float32)
+        tdel_np = np.asarray(tdel, dtype=np.float32)
+        beta_np = None if beta is None else np.asarray(beta,
+                                                       dtype=np.float32)
+
+        def result_fn(out):
+            return PipelineResult(scint=out["scint"], arc=out["arc"],
+                                  fdop=fdop_np, tdel=tdel_np,
+                                  beta=beta_np)
+
+        return _SplitStep(front_jit, back_jit, aux, result_fn, back_desc,
+                          (freqs, times, config, mesh, chan_sharded,
+                           bool(donate), synth), dims)
 
     def step(dyn_batch):
         dyn_batch = jnp.asarray(dyn_batch)
@@ -1119,14 +1512,36 @@ def run_pipeline(epochs=None, config: PipelineConfig = PipelineConfig(),
             if n_lm_fits:
                 obs.inc("lm_steps",
                         config.lm_steps * n_lm_fits * dyn.shape[0])
-            step = obs.instrument_jit(step, "pipeline.step")
+            split_step = step if isinstance(step, _SplitStep) else None
+            if split_step is not None:
+                # per-unit accounting: compile/execute spans and
+                # compile_ms / jit_cache_miss series land under
+                # pipeline.front / pipeline.back (the back wrapper is
+                # SHARED across axes, so a novel shape whose
+                # intermediates hit warm rungs counts zero back misses)
+                step = split_step.instrumented()
+            else:
+                step = obs.instrument_jit(step, "pipeline.step")
             B = dyn.shape[0]
             # AOT lookup: one artifact per step batch size this bucket
-            # will issue (warmup wrote them keyed identically)
+            # will issue (warmup wrote them keyed identically); split
+            # steps look up each UNIT's artifact and compose, falling
+            # back unit-wise to the live jit
             aot = {}
             if use_cache:
                 for b in sorted(_step_batch_sizes(B, multiple, c,
                                                   pad_chunks=eff_pad_chunks)):
+                    if split_step is not None:
+                        ffn = compile_cache.load_step(
+                            split_step.front_key((b,) + dyn.shape[1:],
+                                                 dyn.dtype))
+                        bfn = (compile_cache.load_step(
+                            split_step.back_key(b))
+                            if split_step.back_aot_eligible() else None)
+                        if ffn is not None or bfn is not None:
+                            aot[b] = split_step.instrumented(
+                                front_aot=ffn, back_aot=bfn)
+                        continue
                     fn = compile_cache.load_step(compile_cache.step_key(
                         freqs_np, times_np, config, mesh, chan_sharded,
                         (b,) + dyn.shape[1:], dyn.dtype, donate=donate,

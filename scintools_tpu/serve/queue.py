@@ -158,17 +158,20 @@ def validate_job_cfg(cfg: dict) -> None:
     """Reject option dicts the worker would deterministically reject
     (``make_pipeline`` raises on them), so a misconfigured submit fails
     at the CLIENT instead of enqueueing a job that burns its whole
-    retry/backoff budget into ``failed/`` poison.  The ONE rule site:
-    ``JobQueue.submit`` calls it for the Python API and the CLI's
-    ``_validate_estimator_flags`` delegates to it for process/warmup/
+    retry/backoff budget into ``failed/`` poison.
+
+    ONE rule site (ISSUE 14 satellite): the option dict is built into
+    the worker's own :class:`~scintools_tpu.parallel.PipelineConfig`
+    (``serve.worker.config_from_opts`` — the identical builder the
+    worker runs) and validated by ``PipelineConfig.validate`` — the
+    method ``make_pipeline`` itself calls — so split/crop/arc rules
+    can NEVER drift between CLI, driver and serve.  ``JobQueue.submit``
+    calls this for the Python API and the CLI's
+    ``_validate_estimator_flags`` delegates here for process/warmup/
     submit (flag spellings map 1:1 onto the dict keys)."""
-    if (cfg.get("sspec_crop")
-            and (cfg.get("no_arc")
-                 or cfg.get("arc_method", "norm_sspec") != "norm_sspec")):
-        raise ValueError(
-            "sspec_crop (--sspec-crop) fuses the norm_sspec fitter's "
-            "delay-window crop into the compiled step: it requires arc "
-            "fitting with arc_method='norm_sspec' (drop no_arc)")
+    from .worker import config_from_opts
+
+    config_from_opts(cfg).validate()
     if cfg.get("synthetic") is not None:
         # simulate-job payload: fail the bad campaign at submit, with
         # the driver's own one-rule-site messages (spec validity +
@@ -177,8 +180,6 @@ def validate_job_cfg(cfg: dict) -> None:
         from ..sim import campaign
 
         campaign.spec_from_dict(cfg["synthetic"])
-        from .worker import config_from_opts
-
         _validate_synth_config(config_from_opts(cfg), mesh=None,
                                chan_sharded=None)
 
@@ -205,11 +206,13 @@ def cfg_signature(cfg: dict) -> tuple:
     _string_defaults = {"arc_method": "norm_sspec", "precision": "f32",
                         "fft_lens": "pow2"}
     # execution-placement knobs that change NO result byte: catalog
-    # bucketing pads with mask-invalid lanes the driver slices off
-    # (byte-identical real lanes, tested), so a job submitted by a
-    # bucket-aware client must dedup/batch with the identical job from
-    # a legacy client — strip it from the identity entirely
-    _placement_keys = ("bucket",)
+    # bucketing pads with mask-invalid lanes the driver slices off,
+    # and program splitting (ISSUE 14) runs the same math as two
+    # compiled units with a bit-identical CSV (both tested) — so a job
+    # submitted by a knob-aware client must dedup/batch with the
+    # identical job from a legacy client: strip them from the identity
+    # entirely
+    _placement_keys = ("bucket", "split_programs")
     out = []
     for k, v in sorted((cfg or {}).items()):
         if v is None or v is False:

@@ -66,6 +66,11 @@ def config_from_opts(opts: dict):
         pkw["sspec_crop"] = True
     if opts.get("fused_sspec"):
         pkw["fused_sspec"] = True
+    if opts.get("split_programs"):
+        # placement knob (cfg_signature strips it from the job
+        # identity, like `bucket`): results are bit-identical, only
+        # the compile-unit granularity changes
+        pkw["split_programs"] = True
     # sizing knobs (client API; the CLI keeps the survey defaults)
     for k in ("arc_numsteps", "lm_steps"):
         if opts.get(k) is not None:

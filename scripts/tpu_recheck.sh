@@ -221,6 +221,36 @@ print('pool gate ok: fair-claim + hints + scale decisions',
        if 'lane' in k or 'affinity' in k or k.startswith('pool_')})
 "
 
+SPLIT_CODE="
+import numpy as np
+from scintools_tpu import obs
+from scintools_tpu.parallel import PipelineConfig, run_pipeline
+from scintools_tpu.data import DynspecData
+obs.enable()
+rng = np.random.default_rng(0)
+def mk(nf, nt, b):
+    freqs = np.linspace(1300.0, 1300.0 + 0.5 * nf, nf)
+    times = np.arange(nt) * 10.0
+    return [DynspecData(dyn=rng.standard_normal((nf, nt)) + 5.0,
+                        freqs=freqs, times=times, mjd=58000.0 + i,
+                        df=0.5, dt=10.0, bw=0.5 * nf,
+                        freq=float(freqs.mean()), tobs=10.0 * nt,
+                        name='e%d' % i) for i in range(b)]
+cfg = PipelineConfig(lamsteps=True, split_programs=True)
+run_pipeline(mk(64, 64, 2), cfg)     # warm the fitter (back) programs
+c0 = dict(obs.counters())
+run_pipeline(mk(96, 48, 2), cfg)     # never-seen (nf, nt)
+c1 = dict(obs.counters())
+bm = (c1.get('jit_cache_miss[pipeline.back]', 0)
+      - c0.get('jit_cache_miss[pipeline.back]', 0))
+fm = (c1.get('jit_cache_miss[pipeline.front]', 0)
+      - c0.get('jit_cache_miss[pipeline.front]', 0))
+assert bm == 0, ('novel shape recompiled the fitter back-end', bm)
+assert fm >= 1, ('front-end should have (cheaply) recompiled', fm)
+print('split gate ok on chip: novel shape back_miss=0, front_miss=',
+      fm)
+"
+
 NUDFT_CODE="
 import numpy as np, jax, jax.numpy as jnp
 from scintools_tpu.ops.nudft import _nudft_numpy, _r_grid, nudft
@@ -329,6 +359,14 @@ echo "== pool controller: QoS lanes + affinity hints + scale math =="
 # host — sub-minute, no worker subprocesses spawned (a fake Popen
 # stands in; the capacity lane SCINT_BENCH_FLEET=1 runs real ones)
 gated "pool controller check" 600 2 python -u -c "$POOL_CODE"
+
+echo "== program splitting: novel shape reuses warm fitter programs =="
+# compile-unit splitting (ISSUE 14): warm one shape's fitter (back)
+# programs, then hit a never-seen (nf, nt) — the shape-stable back-end
+# must serve warm (jit_cache_miss[pipeline.back] == 0) while only the
+# shape-volatile front-end recompiles.  CPU tier-1 proves the same
+# contract; this proves it against the real TPU compiler/cache.
+gated "split programs check" 600 2 python -u -c "$SPLIT_CODE"
 
 echo "== nudft einsum on-chip accuracy (bf16-lowering guard) =="
 # the round-4 A/B caught the vmapped einsum NUDFT silently lowering to

@@ -75,9 +75,11 @@ def test_catalog_is_consistent_and_covers_the_known_floor():
     assert "serve.compact" in cat["spans"]
     # families are name PREFIXES of bracketed series; they must not
     # also be plain counter/gauge names except the documented
-    # total+breakdown pairs (faults_injected, epochs_quarantined, and
-    # queue_depth whose total gauge rides beside the per-shard family)
+    # total+breakdown pairs (faults_injected, epochs_quarantined,
+    # queue_depth whose total gauge rides beside the per-shard family,
+    # and jit_cache_miss whose total rides beside the per-unit family
+    # the split pipeline's acceptance gate reads — ISSUE 14)
     overlap = (set(cat["families"])
                & (set(cat["counters"]) | set(cat["gauges"])))
     assert overlap == {"faults_injected", "epochs_quarantined",
-                       "queue_depth"}, overlap
+                       "queue_depth", "jit_cache_miss"}, overlap

@@ -810,9 +810,9 @@ def test_cli_submit_status_drain_roundtrip(tmp_path, capsys):
     # a config the pipeline would reject fails fast at submit instead
     # of enqueueing a deterministically-poisoned job
     before = JobQueue(qdir).queued_ids()
-    with pytest.raises(SystemExit, match="sspec-crop"):
+    with pytest.raises(SystemExit, match="sspec.crop"):
         cli_main(["submit", qdir, "--sspec-crop", "--no-arc", *files])
-    with pytest.raises(SystemExit, match="sspec-crop"):
+    with pytest.raises(SystemExit, match="sspec.crop"):
         cli_main(["submit", qdir, "--sspec-crop",
                   "--arc-method", "gridmax", *files])
     assert JobQueue(qdir).queued_ids() == before
