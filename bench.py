@@ -74,7 +74,14 @@ probe — recording jobs/s, the scale-up/down decisions taken, the
 interactive queue-wait, and affinity/lane claim counters; attached as
 ``fleet_lane``.  CPU-pinned on purpose: it measures the CONTROL
 PLANE's capacity — claim fairness, elasticity, hint routing — without
-contending for the device tunnel).
+contending for the device tunnel), SCINT_BENCH_STREAM ("1" = ALSO run
+the streaming-ingest lane — a simulated observation fed chunk-by-chunk
+through a live feed + StreamSession (ISSUE 15), recording per-tick
+``tick_latency_s`` p50/p95, the final ``stream_lag_s``, tick counts
+and the warm-tick ``jit_cache_miss`` delta (contract: 0) at
+SCINT_BENCH_STREAM_TICKS ticks (default 24) over a
+SCINT_BENCH_STREAM_WINDOW x SCINT_BENCH_STREAM_NF window; attached as
+``stream_lane``).
 """
 
 import json
@@ -774,6 +781,92 @@ def fleet_capacity(n_jobs: int | None = None,
     return rec
 
 
+def stream_throughput(n_ticks: int | None = None,
+                      window: int | None = None,
+                      nf: int | None = None) -> dict:
+    """The streaming-ingest lane (``SCINT_BENCH_STREAM=1``): a
+    simulated observation fed chunk-by-chunk through a live feed +
+    :class:`scintools_tpu.stream.StreamSession` — the latency a live
+    observatory monitor would see per sliding-window recompute tick.
+
+    Record fields: ``tick_latency_s`` p50/p95 over ``n_ticks`` warm
+    ticks (the first, compiling tick is reported separately as
+    ``first_tick_s``), the final ``stream_lag_s`` (append -> consumed
+    wall lag), and ``warm_jit_cache_miss`` — the jit-cache-miss delta
+    across the warm ticks, whose contract (the fixed window signature)
+    is 0."""
+    _maybe_enable_trace()
+    import shutil
+    import tempfile
+
+    from scintools_tpu import obs
+    from scintools_tpu.sim import thin_arc_epoch
+    from scintools_tpu.stream import FeedWriter, StreamSession
+
+    ticks = int(n_ticks if n_ticks is not None
+                else _env_int("SCINT_BENCH_STREAM_TICKS", 24))
+    W = int(window if window is not None
+            else _env_int("SCINT_BENCH_STREAM_WINDOW", 128))
+    NF = int(nf if nf is not None
+             else _env_int("SCINT_BENCH_STREAM_NF", 64))
+    hop = max(W // 8, 1)
+    total = W + ticks * hop
+    epoch = thin_arc_epoch(nf=NF, nt=total, seed=1)
+    dyn = np.asarray(epoch.dyn)
+    feed_dir = tempfile.mkdtemp(prefix="scint_bench_feed_")
+    rec: dict = {"window": W, "nf": NF, "hop": hop, "ticks_target": ticks}
+    try:
+        writer = FeedWriter(feed_dir, freqs=epoch.freqs, dt=epoch.dt,
+                            mjd=epoch.mjd, name="bench-stream")
+        sess = StreamSession(
+            feed_dir, {"lamsteps": True, "arc_numsteps": 200,
+                       "lm_steps": 6}, window=W, hop=hop)
+        lat: list[float] = []
+        first_tick_s = None
+        i = 0
+        miss_at_warm = None
+        while i < total:
+            writer.append(dyn[:, i:i + hop])
+            i += hop
+            t0 = time.perf_counter()
+            rows = sess.poll()
+            wall = time.perf_counter() - t0
+            if not rows:
+                continue
+            if first_tick_s is None:
+                # the compiling tick: report it, then snapshot the
+                # miss counter the warm contract is asserted against
+                first_tick_s = wall
+                miss_at_warm = obs.counters().get("jit_cache_miss", 0)
+            else:
+                lat.append(wall)
+        writer.finalize()
+        t0 = time.perf_counter()
+        if sess.poll():
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        rec.update({
+            "ticks": int(sess.tick_seq),
+            "first_tick_s": (round(first_tick_s, 4)
+                             if first_tick_s is not None else None),
+            "tick_latency_s": ({
+                "p50": round(lat[len(lat) // 2], 6),
+                "p95": round(lat[min(len(lat) - 1,
+                                     int(len(lat) * 0.95))], 6),
+                "n": len(lat)} if lat else None),
+            "stream_lag_s": (round(sess.lag_s(), 6)
+                             if sess.lag_s() is not None else None),
+            "warm_jit_cache_miss": (
+                int(obs.counters().get("jit_cache_miss", 0)
+                    - miss_at_warm)
+                if miss_at_warm is not None else None),
+            "quarantined_chunks": int(sum(sess.quarantined.values())),
+        })
+    finally:
+        shutil.rmtree(feed_dir, ignore_errors=True)
+    return rec
+
+
 def results_plane_throughput(n_rows: int | None = None,
                              flush_rows: int | None = None,
                              baseline: bool = True) -> dict:
@@ -1217,6 +1310,19 @@ def main():
         except Exception as e:
             fleet_holder["rec"] = {"error": f"{type(e).__name__}: {e}"}
 
+    # streaming-ingest lane (SCINT_BENCH_STREAM=1): tick latency of a
+    # live feed's sliding-window recompute (ISSUE 15).  Runs on THIS
+    # process's backend (the warm-signature contract is the point), so
+    # it sits with the other pre-headline lanes; failures land as
+    # {"error": ...} instead of reading as "not requested"
+    stream_holder: dict = {}
+    if os.environ.get("SCINT_BENCH_STREAM",
+                      "0").strip().lower() == "1":
+        try:
+            stream_holder["rec"] = stream_throughput()
+        except Exception as e:
+            stream_holder["rec"] = {"error": f"{type(e).__name__}: {e}"}
+
     def device_record(res: dict, probe: dict, is_fallback: bool = False,
                       batch_chunk: int | None = None, **extra) -> dict:
         rate = res["rate"]
@@ -1255,6 +1361,9 @@ def main():
         fl_lane = fleet_holder.get("rec")
         if fl_lane:
             rec["fleet_lane"] = fl_lane
+        st_lane = stream_holder.get("rec")
+        if st_lane:
+            rec["stream_lane"] = st_lane
         rec["fused"] = bool(res.get("fused", False))
         fl = res.get("fused_lane")
         if fl:
@@ -1534,6 +1643,10 @@ def main():
     if fleet_holder.get("rec"):
         # the CPU-pinned fleet capacity lane survives one too
         zero_rec["fleet_lane"] = fleet_holder["rec"]
+    if stream_holder.get("rec"):
+        # the streaming-ingest lane's ticks already ran on whatever
+        # backend this process got: keep them with the failure record
+        zero_rec["stream_lane"] = stream_holder["rec"]
     _trace_flush()
     print(json.dumps(zero_rec), flush=True)
     if device_lock is None:

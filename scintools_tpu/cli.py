@@ -1010,9 +1010,10 @@ def cmd_submit(args) -> int:
     synth_d = _synth_spec_dict_from_args(args)
     if getattr(args, "compact", False):
         # `compact` job kind: results-plane maintenance, no epochs
-        if files or synth_d is not None:
-            raise SystemExit("--compact submits take no input files "
-                             "or --synthetic campaign")
+        if files or synth_d is not None \
+                or getattr(args, "stream", None):
+            raise SystemExit("--compact submits take no input files, "
+                             "--synthetic campaign or --stream feed")
         rec = client.compact()
         print(json.dumps({"queue": args.queue, "submitted": 1,
                           "deduped": 0, "missing": 0,
@@ -1021,6 +1022,28 @@ def cmd_submit(args) -> int:
                                     "status": rec["status"]}]}))
         return 0
     lane = getattr(args, "lane", None)
+    if getattr(args, "stream", None):
+        # `stream` job kind: register a live append-mode feed — the
+        # worker polls it between batch claims, publishing versioned
+        # rows per sliding-window tick (docs/streaming.md)
+        if files or synth_d is not None:
+            raise SystemExit("--stream registrations take no input "
+                             "files or --synthetic campaign")
+        try:
+            rec = client.submit_stream(
+                args.stream, _estimator_opts(args),
+                window=getattr(args, "stream_window", None),
+                hop=getattr(args, "stream_hop", None), lane=lane)
+        except ValueError as e:
+            raise SystemExit(str(e))
+        print(json.dumps({
+            "queue": args.queue,
+            "submitted": 1 if rec["status"] == "submitted" else 0,
+            "deduped": 0 if rec["status"] == "submitted" else 1,
+            "missing": 0,
+            "jobs": [{"file": f"stream:{rec['feed']}",
+                      "job": rec["job"], "status": rec["status"]}]}))
+        return 0
     if synth_d is not None:
         # `simulate` job kind: one job = one on-device campaign (no
         # input files; keys + params ARE the job payload).  Defaults
@@ -1137,7 +1160,8 @@ def cmd_drain(args) -> int:
     st = client.drain(timeout=args.timeout)
     if args.results:
         st["csv_rows"] = client.export_csv(
-            args.results, full=getattr(args, "full_csv", False))
+            args.results, full=getattr(args, "full_csv", False),
+            latest_only=getattr(args, "latest_only", False))
     print(json.dumps({"queue": args.queue, **st}))
     return 0 if st["drained"] or args.timeout is None else 1
 
@@ -1924,6 +1948,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="submit a results-plane compaction job instead "
                         "of epochs: the worker merges small segment "
                         "files into one (docs/performance.md)")
+    q.add_argument("--stream", default=None, metavar="FEED",
+                   help="register a live append-mode feed directory "
+                        "(stream job kind): the worker re-fits the "
+                        "last --stream-window samples every "
+                        "--stream-hop new ones and publishes "
+                        "versioned rows per tick (docs/streaming.md)")
+    q.add_argument("--stream-window", type=int, default=None,
+                   dest="stream_window", metavar="W",
+                   help="sliding-window length in time samples "
+                        "(default 256; enters the job identity — the "
+                        "ONE compiled signature every tick executes)")
+    q.add_argument("--stream-hop", type=int, default=None,
+                   dest="stream_hop", metavar="H",
+                   help="minimum new samples between ticks (default "
+                        "window/4; enters the job identity)")
     q.add_argument("--lane", default=None,
                    choices=["interactive", "bulk"],
                    help="QoS lane (scheduling priority, never job "
@@ -1995,6 +2034,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="export the results store to this CSV")
     q.add_argument("--full-csv", action="store_true",
                    help="with --results: export EVERY store column")
+    q.add_argument("--latest-only", action="store_true",
+                   dest="latest_only",
+                   help="with --results: collapse each versioned "
+                        "stream series to its newest row (final "
+                        "values per live feed, not the whole tracked "
+                        "time series)")
     q.set_defaults(fn=cmd_drain)
 
     q = sub.add_parser("sort", help="triage files into good/bad lists")

@@ -216,7 +216,8 @@ class HeartbeatWriter:
                                   ("lanes_filled", "serve_lanes_filled"),
                                   ("lanes_total", "serve_lanes_total"),
                                   ("segment_flushes", "segment_flushes"),
-                                  ("rows_flushed", "segment_rows")):
+                                  ("rows_flushed", "segment_rows"),
+                                  ("stream_ticks", "stream_ticks")):
             v = (stats or {}).get(stat_key)
             if counter not in counters and isinstance(v, (int, float)):
                 counters[counter] = v
@@ -518,6 +519,9 @@ def _worker_row(hb: dict, now: float) -> dict:
         "compile_cold_ms": round(cold, 3),
         "compile_warm_ms": round(warm, 3),
         "warm_cache": (hb.get("digests") or {}).get("warm_cache"),
+        # registered live feeds (ISSUE 15): the worker's per-feed
+        # stream payload (tick count, lag, quarantines) when any
+        "streams": hb.get("streams") or None,
     }
 
 
@@ -601,6 +605,20 @@ def render_fleet(rollup: dict) -> str:
                     f"{_gib(mem.get('headroom'))}"
                     + (f" ({len(mem['step_peaks'])} signature peak(s))"
                        if mem.get("step_peaks") else ""))
+            streams = w.get("streams")
+            if streams:
+                for s in streams.values():
+                    if not isinstance(s, dict):
+                        continue
+                    lag = s.get("lag_s")
+                    fin = " finalized" if s.get("finalized") else ""
+                    lines.append(
+                        f"    stream {s.get('feed', '?')}: ticks = "
+                        f"{s.get('ticks', 0)}, consumed = "
+                        f"{s.get('consumed', 0)}/"
+                        f"{s.get('committed', 0)}{fin}, lag = "
+                        f"{lag if lag is not None else '-'} s, "
+                        f"quarantined = {s.get('quarantined', 0)}")
     else:
         lines.append("  (no heartbeats)")
     merged = rollup["merged"]
@@ -617,6 +635,11 @@ def render_fleet(rollup: dict) -> str:
                 c.get("job_retries", 0),
                 c.get("job_transient_retries", 0),
                 c.get("epochs_quarantined", 0)))
+        if c.get("stream_ticks"):
+            lines.append(
+                "  streams: ticks = %d, chunks quarantined = %d" % (
+                    c.get("stream_ticks", 0),
+                    c.get("chunks_quarantined", 0)))
     tl = rollup["depth_timeline"]
     if tl:
         lines.append("  queue_depth timeline: "

@@ -62,6 +62,11 @@ COUNTERS = frozenset({
     # for a roomier worker
     "affinity_hits", "affinity_misses", "affinity_deferred",
     "pool_mem_deferred",
+    # streaming ingest plane (scintools_tpu.stream — ISSUE 15):
+    # sliding-window recompute ticks over live feeds, stream-job
+    # registrations, and per-chunk data-quality quarantines (masked,
+    # never fatal — reasons in the bracketed family)
+    "stream_ticks", "serve_stream_jobs", "chunks_quarantined",
 })
 
 # -- gauges (obs.gauge) -----------------------------------------------------
@@ -74,6 +79,10 @@ GAUGES = frozenset({
     "hbm_bytes_in_use", "hbm_bytes_limit",
     # pool controller (serve/pool.py): live worker-process count
     "pool_workers",
+    # streaming ingest plane (stream/window.py): wall seconds the
+    # consumer runs behind the feed head (streamed timeline; the
+    # per-feed breakdown rides the bracketed family)
+    "stream_lag_s",
 })
 
 # -- spans (obs.span / obs.traced) ------------------------------------------
@@ -84,6 +93,8 @@ SPANS = frozenset({
     "fit.arc", "fit.scint", "fit.lsq_numpy",
     "sim.simulation",
     "serve.poll", "serve.load", "serve.batch", "serve.compact",
+    # streaming ingest plane: one sliding-window recompute tick
+    "stream.tick",
     # device-memory & profiler plane (obs/devmem, utils/timing):
     # the --xprof jax.profiler.trace bracket and the on-OOM
     # device_memory_profile snapshot dump
@@ -97,9 +108,10 @@ SPAN_PREFIXES = ("stage.",)
 
 # -- lifecycle events (obs.event) -------------------------------------------
 EVENTS = frozenset({
-    # distributed job trace hops (obs/fleet.py contract)
+    # distributed job trace hops (obs/fleet.py contract); job.tick =
+    # one stream registration's tick batch (ISSUE 15)
     "job.submit", "job.claim", "job.preflight", "job.batch", "job.row",
-    "job.complete", "job.fail", "job.requeue", "job.poison",
+    "job.complete", "job.fail", "job.requeue", "job.poison", "job.tick",
     # bench run correlation root (bench flight records embed the id)
     "bench.run",
 })
@@ -110,6 +122,9 @@ HISTS = frozenset({
     # put -> durable/visible latency of buffered result rows (the
     # segment plane's replacement for the end-of-campaign gather cliff)
     "row_visibility_s",
+    # wall seconds of one sliding-window stream tick (consume ->
+    # published row), the SCINT_BENCH_STREAM lane's p50/p95 source
+    "tick_latency_s",
 })
 
 # -- bracketed families: "<family>[<key>]" ----------------------------------
@@ -134,6 +149,10 @@ FAMILIES = frozenset({
     "queue_depth",                                  # gauge (per shard)
     # per-QoS-lane claim counts (ISSUE 13 weighted-fair claim order)
     "lane_claims",                                  # counter (per lane)
+    # streaming ingest plane (ISSUE 15): quarantine reasons and the
+    # per-feed lag breakdown beside the totals above
+    "chunks_quarantined",                           # counter (per reason)
+    "stream_lag_s",                                 # gauge (per feed)
 })
 
 _SETS = {"inc": COUNTERS, "gauge": GAUGES, "span": SPANS,

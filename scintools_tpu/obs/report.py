@@ -418,6 +418,34 @@ def serve_section(counters: dict | None,
     return out
 
 
+def stream_section(counters: dict | None,
+                   gauges: dict | None = None) -> dict | None:
+    """Streaming-ingest readout (scintools_tpu.stream — ISSUE 15):
+    sliding-window recompute ticks, per-feed processing lag, and
+    per-chunk quarantine reasons.  None when the trace carries no
+    streaming activity."""
+    counters = counters or {}
+    gauges = gauges or {}
+    ticks = int(counters.get("stream_ticks", 0))
+    jobs = int(counters.get("serve_stream_jobs", 0))
+    quarantined = int(counters.get("chunks_quarantined", 0))
+    if not (ticks or jobs or quarantined):
+        return None
+    out = {"stream_jobs": jobs, "stream_ticks": ticks,
+           "chunks_quarantined": quarantined}
+    reasons = {k: int(v) for k, v in bracketed_values(
+        counters, "chunks_quarantined[").items()}
+    if reasons:
+        out["quarantine_reasons"] = reasons
+    if "stream_lag_s" in gauges:
+        out["stream_lag_s_last"] = gauges["stream_lag_s"]
+    feeds = bracketed_values(gauges, "stream_lag_s[")
+    if feeds:
+        out["feed_lag_s"] = {k: round(float(v), 3)
+                             for k, v in feeds.items()}
+    return out
+
+
 def reliability_section(counters: dict | None,
                         gauges: dict | None = None) -> dict | None:
     """Self-healing readout (docs/reliability.md): OOM chunk backoffs
@@ -611,6 +639,25 @@ def render(spans: dict, counters: dict | None = None,
         if "queue_depth_last" in serve:
             lines.append(f"  queue_depth (last) = "
                          f"{serve['queue_depth_last']}")
+    streams = stream_section(counters, gauges)
+    if streams:
+        lines.append("")
+        lines.append("streams (live feeds, sliding-window recompute):")
+        lines.append(f"  stream_jobs = {streams['stream_jobs']}, "
+                     f"stream_ticks = {streams['stream_ticks']}")
+        quar = (f"  chunks_quarantined = "
+                f"{streams['chunks_quarantined']}")
+        if streams.get("quarantine_reasons"):
+            quar += " (" + ", ".join(
+                f"{k}={v}" for k, v in
+                sorted(streams["quarantine_reasons"].items())) + ")"
+        lines.append(quar)
+        if "stream_lag_s_last" in streams:
+            lines.append(f"  stream_lag_s (last) = "
+                         f"{streams['stream_lag_s_last']}")
+        for feed, lag in sorted(streams.get("feed_lag_s",
+                                            {}).items()):
+            lines.append(f"    {feed}: lag = {lag} s")
     rel = reliability_section(counters, gauges)
     if rel:
         lines.append("")

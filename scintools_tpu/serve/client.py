@@ -65,6 +65,23 @@ class SurveyClient:
         job_id, status = self.queue.submit_compact()
         return {"job": job_id, "status": status}
 
+    def submit_stream(self, feed_dir: str, opts: dict | None = None,
+                      window: int | None = None, hop: int | None = None,
+                      lane: str | None = None) -> dict:
+        """Register one live feed (`stream` job kind — ISSUE 15): the
+        worker follows the append-mode feed directory between batch
+        claims, re-fitting the last ``window`` time samples every
+        ``hop`` new ones and publishing eta/tau/dnu per tick as
+        VERSIONED rows — poll ``result(f"{job}.live")`` for the
+        current values, or export the whole tracked series.  The job
+        completes when the producer finalizes the feed.  Idempotent
+        per (feed path, opts, window/hop).  Returns ``{feed, job,
+        status}``."""
+        job_id, status = self.queue.submit_stream(
+            feed_dir, dict(opts or {}), window=window, hop=hop,
+            lane=lane)
+        return {"feed": feed_dir, "job": job_id, "status": status}
+
     # -- inspection --------------------------------------------------------
     def status(self) -> dict:
         return self.queue.status()
@@ -127,12 +144,16 @@ class SurveyClient:
         return {"done": done, "failed": failed, "pending": pending}
 
     # -- results -----------------------------------------------------------
-    def export_csv(self, filename: str, full: bool = False) -> int:
+    def export_csv(self, filename: str, full: bool = False,
+                   latest_only: bool = False) -> int:
         """Write every stored result row to CSV (reference schema by
         default; ``full=True`` adds the beyond-reference columns) —
         the same exporter as ``process --store``, so a served survey's
-        CSV is directly comparable to a direct run's."""
-        return self.queue.results.export_csv(filename, full=full)
+        CSV is directly comparable to a direct run's.
+        ``latest_only=True`` collapses each versioned stream series to
+        its newest row (the final values per live feed)."""
+        return self.queue.results.export_csv(filename, full=full,
+                                             latest_only=latest_only)
 
     # -- drain -------------------------------------------------------------
     def drain(self, timeout: float | None = None,

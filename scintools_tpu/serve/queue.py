@@ -905,6 +905,65 @@ class JobQueue:
         self._depth_gauge(job_id, lane=LANE_BULK)
         return job_id, "submitted"
 
+    def submit_stream(self, feed_dir: str, cfg: dict | None = None,
+                      window: int | None = None, hop: int | None = None,
+                      lane: str | None = None) -> tuple[str, str]:
+        """Register one live feed (`stream` job kind — ISSUE 15):
+        ``feed_dir`` is an append-mode feed directory
+        (scintools_tpu.stream.ingest) a producer grows chunk-by-chunk;
+        the claiming worker keeps the job REGISTERED, polling the feed
+        between batch claims and publishing one VERSIONED result row
+        per sliding-window tick (``window`` samples, re-fit every
+        ``hop`` new ones) until the feed finalizes — live curvature/
+        timescale tracking across the observation.
+
+        The job's identity is (feed path, estimator options, window/
+        hop): re-submitting the same registration dedups; the same
+        feed under different options or window geometry is a different
+        stream (different results).  The feed must already exist with
+        a readable manifest — a typo'd path fails HERE, not after
+        burning the retry budget.  ``lane`` defaults to interactive
+        (a live observer's feed is exactly what the QoS lanes protect
+        from bulk backlogs)."""
+        from ..stream.window import validate_stream_spec
+
+        lane = validate_lane(lane, LANE_INTERACTIVE)
+        cfg = dict(cfg or {})
+        if cfg.get("synthetic") is not None or cfg.get("compact"):
+            raise ValueError("a stream job carries only estimator "
+                             "options (no synthetic/compact payload)")
+        if cfg.get("arc_stack"):
+            raise ValueError("arc_stack is a campaign knob; a stream "
+                             "tick fits one window")
+        spec = validate_stream_spec({"feed": feed_dir,
+                                     **({"window": window}
+                                        if window is not None else {}),
+                                     **({"hop": hop}
+                                        if hop is not None else {})})
+        # fail fast on a non-feed: FeedReader raises FeedError
+        # (ValueError) on a missing/torn manifest
+        from ..stream.ingest import FeedReader
+
+        reader = FeedReader(spec["feed"])
+        cfg["stream"] = spec
+        validate_job_cfg(cfg)
+        job_id = content_key(("stream", spec["feed"]),
+                             ("serve",) + cfg_signature(cfg))
+        existing = self.state_of(job_id)
+        if existing is not None:
+            return job_id, existing
+        est = reader.nf * spec["window"] * 4   # the resident window
+        trace = new_trace_id()
+        fname = f"stream:{os.path.basename(spec['feed'])}"
+        root = obs.event("job.submit", trace_id=trace, job=job_id,
+                         file=fname, lane=lane)
+        self._write(QUEUED, Job(id=job_id, file=fname, cfg=cfg,
+                                submitted_at=_submit_stamp(),
+                                trace_id=trace, span=root, lane=lane,
+                                sig=job_sig(cfg), est_bytes=est))
+        self._depth_gauge(job_id, lane=lane)
+        return job_id, "submitted"
+
     # -- worker side -------------------------------------------------------
     def _hint_defer(self, job: Job, hints: ClaimHints,
                     now: float) -> bool:
@@ -1014,6 +1073,34 @@ class JobQueue:
             if held is not None and held.lease_worker == job.lease_worker:
                 self._write(LEASED, dataclasses.replace(
                     held, lease_expires_at=now + lease_s))
+
+    def release(self, job: Job) -> None:
+        """Voluntarily hand a LEASED job back to the queue with its
+        whole retry budget untouched (``attempts`` AND ``transients``
+        unchanged, no backoff) — the stream worker's drain/idle
+        handback: a long-lived `stream` registration is not a failure
+        when its worker is asked to scale down, and must be claimable
+        by the next worker immediately.  A job another worker already
+        holds (our lease expired and was re-claimed) is left alone —
+        and a job that reached a TERMINAL state under the
+        at-least-once race (our lease expired, the reap requeued it,
+        another worker finished it) is never resurrected: done/failed
+        win, exactly as :meth:`fail` tolerates the same race."""
+        if os.path.exists(self._path(DONE, job.id)) \
+                or os.path.exists(self._path(FAILED, job.id)):
+            self._remove(LEASED, job.id)
+            return
+        held = self._read(LEASED, job.id)
+        if held is not None and held.lease_worker is not None \
+                and held.lease_worker != job.lease_worker:
+            return
+        rec = held if held is not None else job
+        rec = self._hop(rec, "job.requeue", reason="released")
+        self._write(QUEUED, dataclasses.replace(
+            rec, lease_worker=None, lease_expires_at=None,
+            not_before=0.0))
+        self._remove(LEASED, job.id)
+        self._depth_gauge(job.id, lane=self._lane_of(rec))
 
     def reap_expired(self, now: float | None = None
                      ) -> tuple[list[Job], list[Job]]:

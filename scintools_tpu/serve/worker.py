@@ -149,6 +149,17 @@ def pipeline_runner(batch: Batch, batch_size: int, mesh=None,
     return rows
 
 
+@dataclasses.dataclass
+class _StreamState:
+    """One registered live-feed session (`stream` job kind): the
+    leased job record (its ``span`` advances per tick hop) + the
+    resident :class:`~scintools_tpu.stream.StreamSession`."""
+
+    job: object
+    session: object
+    last_renew: float
+
+
 class ServeWorker:
     """One resident worker process bound to a queue directory.
 
@@ -204,7 +215,12 @@ class ServeWorker:
         self.stats = {"batches": 0, "jobs_done": 0, "jobs_failed": 0,
                       "job_retries": 0, "job_transient_retries": 0,
                       "lanes_filled": 0, "lanes_total": 0,
-                      "segment_flushes": 0, "rows_flushed": 0}
+                      "segment_flushes": 0, "rows_flushed": 0,
+                      "stream_ticks": 0}
+        # registered live-feed sessions (`stream` job kind — ISSUE 15):
+        # job_id -> _StreamState; polled between batch claims, released
+        # back to the queue on drain/idle exit
+        self._streams: dict[str, "_StreamState"] = {}
         # QoS claim weighting (ISSUE 13): per-cycle lane budgets passed
         # to JobQueue.claim (None = the queue's documented defaults)
         self.lane_budgets = dict(lane_budgets) if lane_budgets else None
@@ -289,6 +305,12 @@ class ServeWorker:
             # the mergeable fleet form of the same quantity: heartbeat
             # snapshots ship this histogram, the rollup merges it
             obs.observe("queue_wait_s", wait)
+            if job.cfg.get("stream") is not None:
+                # `stream` job kind (ISSUE 15): a live feed is not a
+                # unit of work but a REGISTRATION — the session stays
+                # resident and is polled between batch claims below
+                self._register_stream(job)
+                continue
             if job.cfg.get("compact"):
                 # `compact` job kind: results-plane maintenance —
                 # merges small segment files; no epochs, no batcher
@@ -339,7 +361,9 @@ class ServeWorker:
                                          force=force_flush or drain)
         for batch in batches:
             self._execute(batch)
-        return len(batches) + ran_synth
+        # registered live feeds tick between batch claims (ISSUE 15)
+        ran_stream = self._poll_streams(now if injected else None)
+        return len(batches) + ran_synth + ran_stream
 
     def _claim_lease_s(self) -> float:
         # the lease must cover the batcher's wait AND one execution
@@ -584,6 +608,134 @@ class ServeWorker:
                   epochs=n_epochs, rows=stored,
                   quarantined=n_epochs - stored)
 
+    # -- the `stream` job kind (ISSUE 15) ----------------------------------
+    def _stream_meta(self, job_id: str) -> str:
+        return f"stream.{job_id}"
+
+    def _register_stream(self, job) -> None:
+        """Claiming a `stream` job REGISTERS its feed: the session
+        stays resident (polled by :meth:`_poll_streams` between batch
+        claims) until the feed finalizes and the job completes.  A
+        durable cursor (``meta.stream.<job>`` in the results store,
+        written only after each tick batch's flush) resumes a crashed
+        or re-claimed registration from the feed manifest with no
+        duplicate and no lost versioned rows."""
+        if job.id in self._streams:
+            # duplicate claim of an already-registered feed (the
+            # at-least-once lease window): one session is enough
+            self.queue.renew([job], self._claim_lease_s())
+            return
+        from ..stream import StreamSession
+
+        obs.inc("serve_stream_jobs")
+        spec = job.cfg["stream"]
+        try:
+            session = StreamSession(spec["feed"], job.cfg,
+                                    window=spec["window"],
+                                    hop=spec["hop"])
+        except Exception as e:
+            # a vanished feed / torn manifest classifies through the
+            # taxonomy (FeedError = ValueError = poison; transient IO
+            # keeps its budget-free path)
+            self._job_failed(job, f"stream register failed: {e!r}",
+                             exc=e)
+            return
+        meta = self.queue.results.get_meta(self._stream_meta(job.id))
+        if meta:
+            try:
+                session.restore(meta)
+            except Exception as e:  # fault-ok: a corrupt cursor only
+                # costs a from-scratch replay, never the stream
+                log_event(self.log, "stream_restore_failed",
+                          job=job.id, error=repr(e))
+        self._streams[job.id] = _StreamState(job=job, session=session,
+                                             last_renew=time.time())
+        log_event(self.log, "stream_registered", job=job.id,
+                  feed=session.name, window=session.window,
+                  hop=session.hop, resumed=bool(meta))
+
+    def _poll_streams(self, now: float | None = None) -> int:
+        """Advance every registered feed: consume newly committed
+        chunks, run due ticks, publish each tick's eta/tau/dnu as
+        VERSIONED rows (history key per window end + a `.live` key the
+        monitoring consumer polls), flush, THEN persist the resume
+        cursor — the durability order that makes crash replay
+        idempotent.  Returns the tick count (the worker's idle logic
+        treats ticks as work)."""
+        if not self._streams:
+            return 0
+        wall = time.time() if now is None else now
+        ran = 0
+        for jid, st in list(self._streams.items()):
+            job = st.job
+            if wall - st.last_renew > self.lease_s / 2.0:
+                # the registration outlives any one poll: keep the
+                # lease ahead so a live stream is never reaped from
+                # under its own worker
+                self.queue.renew([job], self._claim_lease_s())
+                st.last_renew = wall
+            try:
+                rows = st.session.poll()
+            except Exception as e:
+                self._streams.pop(jid, None)
+                self._job_failed(job, f"stream poll failed: {e!r}",
+                                 exc=e)
+                log_event(self.log, "stream_poll_failed", job=jid,
+                          error=repr(e))
+                continue
+            if rows:
+                # a tick batch may have included the first (compiling)
+                # tick: re-arm the lease right after the long work, so
+                # the next reap pass finds it fresh (the lease, like
+                # the batch contract, must be sized to cover one tick)
+                self.queue.renew([job], self._claim_lease_s())
+                st.last_renew = time.time() if now is None else now
+                for row in rows:
+                    key = f"{jid}.w{int(row['window_end']):09d}"
+                    self.queue.results.put_versioned(key, row,
+                                                     series=jid)
+                    self.queue.results.put_versioned(f"{jid}.live",
+                                                     row, series=jid)
+                self._flush_rows()
+                self.queue.results.put_meta(self._stream_meta(jid),
+                                            st.session.state())
+                st.job = job = self.queue._hop(
+                    job, "job.tick", ticks=len(rows),
+                    window_end=int(rows[-1]["window_end"]))
+                ran += len(rows)
+                self.stats["stream_ticks"] += len(rows)
+            if st.session.complete:
+                job = self.queue._hop(job, "job.row",
+                                      rows=st.session.tick_seq)
+                self.queue.complete(job)
+                self._mark_warm(job)
+                self._streams.pop(jid, None)
+                self.stats["jobs_done"] += 1
+                obs.inc("jobs_done")
+                log_event(self.log, "stream_job_done", job=jid,
+                          feed=st.session.name,
+                          ticks=st.session.tick_seq,
+                          quarantined=sum(
+                              st.session.quarantined.values()))
+        return ran
+
+    def _release_streams(self, reason: str = "exit") -> None:
+        """Hand every registered (unfinished) stream back to the queue
+        with its budget untouched (``JobQueue.release``) so the next
+        worker resumes it from the durable cursor — the scale-down/
+        idle-exit path.  A crash skips this; lease expiry + the cursor
+        cover that case identically."""
+        for jid, st in list(self._streams.items()):
+            try:
+                self.queue.results.put_meta(self._stream_meta(jid),
+                                            st.session.state())
+            except OSError:  # fault-ok: replay covers a lost cursor
+                pass
+            self.queue.release(st.job)
+            log_event(self.log, "stream_released", job=jid,
+                      reason=reason)
+        self._streams.clear()
+
     def _execute_compact(self, job) -> None:
         """Run one `compact` job: merge the results store's small
         segment files into one (utils/segments).  Idempotent and
@@ -703,6 +855,9 @@ class ServeWorker:
                     # serving; the global drain marker is untouched.
                     while self.batcher.pending:
                         self.poll_once(force_flush=True, claim=False)
+                    # live feeds hand back to the queue (budget
+                    # untouched) so a surviving worker resumes them
+                    self._release_streams(reason="worker_drain")
                     self.queue.clear_worker_drain(self.worker_id)
                     log_event(self.log, "worker_drained",
                               worker=self.worker_id)
@@ -734,6 +889,10 @@ class ServeWorker:
                         and now - idle_since >= idle_exit_s:
                     break
                 time.sleep(self.poll_s)
+            # any exit path that falls out of the loop releases the
+            # registered (unfinished) streams: nothing stays leased
+            # behind a politely-stopped worker
+            self._release_streams()
         except Exception as e:
             # crash flight recorder: an UNHANDLED failure of the
             # resident loop (per-job failures never reach here) dumps
@@ -772,12 +931,20 @@ class ServeWorker:
             return
         try:
             # warm_sigs = the affinity signal the pool controller
-            # routes on (empty until something has executed)
-            extra = ({"warm_sigs": list(self._warm_sigs)}
-                     if self._warm_sigs else None)
+            # routes on (empty until something has executed);
+            # streams = the per-feed liveness payload the fleet
+            # rollup's streams section renders
+            extra = {}
+            if self._warm_sigs:
+                extra["warm_sigs"] = list(self._warm_sigs)
+            if self._streams:
+                extra["streams"] = {jid: st.session.stats()
+                                    for jid, st in
+                                    self._streams.items()}
             self.heartbeat.beat(force=force,
                                 last_claim_at=self._last_claim_at,
-                                stats=self.stats, extra=extra)
+                                stats=self.stats,
+                                extra=extra or None)
         except OSError as e:  # fault-ok: liveness reporting only
             log_event(self.log, "heartbeat_failed", worker=self.worker_id,
                       error=repr(e))

@@ -1,0 +1,36 @@
+"""Streaming ingest plane (ISSUE 15): live dynspec feeds.
+
+Everything else in the pipeline is batch — a dynamic spectrum must be
+complete on disk before ``submit``.  Real observatories emit the time
+axis incrementally; this package is the append-mode counterpart:
+
+* :mod:`~scintools_tpu.stream.ingest` — a durable chunk-append log
+  (atomic chunk files + a manifest, torn-tail tolerant like the
+  results plane's segments) grown column-by-column along the time
+  axis, plus a device-resident :class:`~scintools_tpu.stream.ingest.
+  Ring` over the last W samples and an incrementally-maintained
+  time-lag ACF cut over that ring;
+* :mod:`~scintools_tpu.stream.window` — sliding-window recompute
+  ticks whose ``(1, nf, W)`` window shape is ONE fixed bucket-catalog
+  signature, so a warmed session never recompiles per tick.
+
+The serve layer registers feeds as a ``stream`` job kind
+(``JobQueue.submit_stream`` / ``scintools-tpu submit QDIR --stream
+FEED``): the worker polls registered feeds between batch claims and
+publishes eta/tau/dnu per tick as VERSIONED result rows
+(``ResultsStore.put_versioned``) — live curvature/timescale tracking
+across an observation.  docs/streaming.md documents the log format,
+the window/tick semantics and the versioned-row contract.
+"""
+
+from .ingest import (FeedError, FeedReader, FeedWriter, IncrementalACF,
+                     Ring, chunk_rung, preflight_chunk)
+from .window import (DEFAULT_HOP, DEFAULT_WINDOW, StreamSession,
+                     validate_stream_spec)
+
+__all__ = [
+    "FeedError", "FeedReader", "FeedWriter", "IncrementalACF", "Ring",
+    "chunk_rung", "preflight_chunk",
+    "DEFAULT_HOP", "DEFAULT_WINDOW", "StreamSession",
+    "validate_stream_spec",
+]

@@ -1,0 +1,601 @@
+"""Append-mode dynspec feed: a durable chunk log + device ring buffer.
+
+A FEED is a directory an observatory-side producer grows chunk-by-chunk
+along the time axis while consumers (the streaming serve worker, a
+notebook session) follow it live:
+
+    feed_dir/
+      MANIFEST.json          the feed identity (freqs/dt/mjd/name) and
+                             the COMMITTED chunk list — one record per
+                             chunk {seq, file, nt, crc, t}; rewritten
+                             atomically (tmp + os.replace) per append,
+                             so it is always a whole, valid JSON
+      chunk_<seq>.npy        one appended [nf, nt_chunk] float32 block,
+                             written tmp + os.replace — a chunk file is
+                             whole or absent, never torn
+      chunk_*.corrupt        quarantined bytes of an unparseable
+                             orphan (forensics, like the results
+                             plane's torn-tail salvage)
+
+Durability contract (same shape as utils/segments' torn-tail rule): the
+MANIFEST is the source of truth — a chunk is part of the feed the
+moment its manifest record lands.  A producer crash between the chunk
+rename and the manifest rewrite leaves a whole-but-uncommitted ORPHAN
+chunk file; :class:`FeedWriter` reopen adopts it (it re-verifies the
+bytes and commits the record) so no appended data is lost, and
+quarantines unparseable orphans aside as ``.corrupt``.  Readers only
+ever see committed chunks, and verify each chunk's CRC32 on read.
+
+The consumer side keeps the live window DEVICE-RESIDENT:
+:class:`Ring` holds the last W time samples in HBM and updates it with
+one fixed-signature jitted roll per push (the incoming chunk is padded
+onto a closed pow2 width ladder, so the whole observation executes a
+handful of tiny programs) — per-tick H2D traffic is O(nf x chunk)
+instead of O(nf x W).  :class:`IncrementalACF` maintains the zero
+frequency-lag time-ACF cut over the same ring by adding the new
+columns' pair terms and subtracting the evicted ones — O(chunk x lags
+x nf) per push instead of a from-scratch O(W x lags x nf) — with a
+periodic exact resync bounding float drift (the same discipline as the
+NUDFT tile's phasor resync).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import time
+import zlib
+
+import numpy as np
+
+from .. import obs
+from ..health import (DEFAULT_MAX_NONFINITE_FRAC,
+                      DEFAULT_MAX_ZERO_BAND_FRAC)
+from ..utils.log import get_logger, log_event
+
+MANIFEST = "MANIFEST.json"
+FEED_VERSION = 1
+_CHUNK_RE = re.compile(r"^chunk_(\d{8})\.npy$")
+
+# smallest padded chunk width: below this every push shares one ring
+# program (mirror of buckets.VECTOR_RUNG_MIN's role for fitter inputs)
+CHUNK_RUNG_MIN = 8
+
+# incremental-ACF exact recompute cadence: bounds float drift from the
+# add/subtract updates (parity vs from-scratch is test-pinned)
+ACF_RESYNC_EVERY = 64
+
+
+class FeedError(ValueError):
+    """A structurally-invalid feed: missing/torn manifest, shape
+    mismatch, or a committed chunk whose bytes fail their CRC.  A
+    ``ValueError``, so the serve taxonomy classifies it poison
+    (deterministic for the bytes on disk), never transient."""
+
+
+def chunk_rung(n: int, minimum: int = CHUNK_RUNG_MIN) -> int:
+    """Smallest pow2-ladder width >= ``n``: the padded chunk width a
+    ring push canonicalises onto, so arbitrary producer chunk sizes
+    execute a CLOSED set of ring-update programs."""
+    if n < 1:
+        raise ValueError(f"chunk_rung: need n >= 1, got {n}")
+    r = max(int(minimum), 1)
+    while r < n:
+        r *= 2
+    return r
+
+
+def preflight_chunk(chunk,
+                    max_nonfinite_frac: float = DEFAULT_MAX_NONFINITE_FRAC,
+                    max_zero_band_frac: float = DEFAULT_MAX_ZERO_BAND_FRAC
+                    ) -> list[str]:
+    """Per-chunk data-quality reason codes ([] = healthy) — the
+    streaming counterpart of :func:`scintools_tpu.health.
+    preflight_epoch`, with the same thresholds and code spellings for
+    the checks that make sense on a [nf, c] block (axes belong to the
+    feed manifest, not the chunk).  Host numpy, microseconds per
+    chunk."""
+    dyn = np.asarray(chunk)
+    if dyn.ndim != 2 or dyn.shape[0] < 2 or dyn.shape[1] < 1:
+        return ["axis_shape"]
+    reasons: list[str] = []
+    finite = np.isfinite(dyn)
+    if 1.0 - finite.mean() > max_nonfinite_frac:
+        reasons.append("nonfinite")
+    vals = np.where(finite, dyn, 0.0)
+    if not np.any(vals):
+        reasons.append("all_zero")
+    elif float(np.mean(~np.any(vals != 0.0, axis=1))) \
+            > max_zero_band_frac:
+        reasons.append("zero_band")
+    return reasons
+
+
+def mask_chunk(chunk: np.ndarray) -> np.ndarray:
+    """Deterministic repair of a quarantined chunk so the window stays
+    continuous (bad chunks are MASKED, not fatal): non-finite samples
+    become the chunk's own per-channel finite mean (refill's
+    interpolation idea, chunk-local), and a chunk with nothing usable
+    becomes zeros.  CHUNK-LOCAL on purpose: crash recovery rebuilds the
+    ring by replaying the log, and a mask that depended on window
+    history would not replay to the same bytes."""
+    dyn = np.asarray(chunk, dtype=np.float32)
+    finite = np.isfinite(dyn)
+    if not finite.any():
+        return np.zeros_like(dyn)
+    if finite.all():
+        return dyn
+    counts = finite.sum(axis=1, keepdims=True)
+    sums = np.where(finite, dyn, 0.0).sum(axis=1, keepdims=True)
+    fill = np.divide(sums, counts, out=np.zeros_like(sums),
+                     where=counts > 0)
+    return np.where(finite, dyn, fill).astype(np.float32)
+
+
+def _chunk_name(seq: int) -> str:
+    return f"chunk_{seq:08d}.npy"
+
+
+def _encode_chunk(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(arr, dtype=np.float32))
+    return buf.getvalue()
+
+
+def _decode_chunk(data: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+class FeedWriter:
+    """Producer handle: append [nf, c] chunks, finalize when the
+    observation ends.  Reopening an existing feed resumes it (adopting
+    any whole-but-uncommitted orphan chunk a crash left behind)."""
+
+    def __init__(self, directory: str, freqs=None, dt: float | None = None,
+                 mjd: float = 50000.0, name: str | None = None):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        man = _read_manifest(directory, missing_ok=True)
+        if man is None:
+            if freqs is None or dt is None:
+                raise ValueError("a fresh feed needs freqs= and dt= "
+                                 "(the axes identity every window "
+                                 "shares)")
+            freqs = [float(f) for f in np.asarray(freqs).ravel()]
+            if len(freqs) < 2:
+                raise ValueError(f"feed needs >= 2 channels, got "
+                                 f"{len(freqs)}")
+            man = {"version": FEED_VERSION, "kind": "scintools-tpu-feed",
+                   "name": name or os.path.basename(
+                       os.path.abspath(directory)),
+                   "mjd": float(mjd), "dt": float(dt), "freqs": freqs,
+                   "chunks": [], "finalized": False}
+            _write_manifest(directory, man)
+        else:
+            if freqs is not None and len(np.asarray(freqs).ravel()) \
+                    != len(man["freqs"]):
+                raise FeedError(
+                    f"feed {directory}: reopen with {len(freqs)} "
+                    f"channels, manifest has {len(man['freqs'])}")
+            self._recover(man)
+        self.manifest = man
+
+    @property
+    def nf(self) -> int:
+        return len(self.manifest["freqs"])
+
+    @property
+    def total_samples(self) -> int:
+        return sum(int(c["nt"]) for c in self.manifest["chunks"])
+
+    def _recover(self, man: dict) -> None:
+        """Adopt whole-but-uncommitted orphan chunks (producer crashed
+        between the chunk rename and the manifest rewrite) in seq
+        order; quarantine unparseable ones aside as ``.corrupt``.
+        Renames are atomic, so an orphan is never torn — but its bytes
+        are still re-verified before commit."""
+        committed = {int(c["seq"]) for c in man["chunks"]}
+        names = []
+        try:
+            names = sorted(os.listdir(self.dir))
+        except OSError:
+            return
+        changed = False
+        for fname in names:
+            m = _CHUNK_RE.match(fname)
+            if m is None or int(m.group(1)) in committed:
+                continue
+            path = os.path.join(self.dir, fname)
+            try:
+                with open(path, "rb") as fh:
+                    data = fh.read()
+                arr = _decode_chunk(data)
+                if arr.ndim != 2 or arr.shape[0] != len(man["freqs"]):
+                    raise ValueError(f"orphan shape {arr.shape}")
+            except (OSError, ValueError):
+                try:
+                    os.replace(path, path + ".corrupt")
+                except OSError:  # fault-ok: quarantined by a racer
+                    pass
+                log_event(get_logger(), "feed_chunk_quarantined",
+                          feed=self.dir, chunk=fname)
+                continue
+            man["chunks"].append({"seq": int(m.group(1)), "file": fname,
+                                  "nt": int(arr.shape[1]),
+                                  "crc": zlib.crc32(data),
+                                  "t": round(os.path.getmtime(path), 6)})
+            changed = True
+            log_event(get_logger(), "feed_chunk_adopted", feed=self.dir,
+                      chunk=fname)
+        if changed:
+            man["chunks"].sort(key=lambda c: int(c["seq"]))
+            _write_manifest(self.dir, man)
+
+    def append(self, chunk) -> int:
+        """Commit one [nf, c] chunk (stored float32 — the staging
+        dtype).  Returns the chunk's sequence number.  Chunk bytes
+        land atomically BEFORE the manifest record commits them, so a
+        crash anywhere leaves either a committed chunk or a
+        recoverable orphan — never a torn feed."""
+        if self.manifest["finalized"]:
+            raise FeedError(f"feed {self.dir} is finalized")
+        arr = np.asarray(chunk)
+        if arr.ndim != 2 or arr.shape[0] != self.nf or arr.shape[1] < 1:
+            raise ValueError(
+                f"append: expected [nf={self.nf}, c>=1], got "
+                f"{arr.shape}")
+        seq = (int(self.manifest["chunks"][-1]["seq"]) + 1
+               if self.manifest["chunks"] else 0)
+        fname = _chunk_name(seq)
+        data = _encode_chunk(arr)
+        path = os.path.join(self.dir, fname)
+        tmp = f"{path}.tmp{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, path)
+        self.manifest["chunks"].append(
+            {"seq": seq, "file": fname, "nt": int(arr.shape[1]),
+             "crc": zlib.crc32(data), "t": round(time.time(), 6)})
+        _write_manifest(self.dir, self.manifest)
+        return seq
+
+    def finalize(self) -> None:
+        """Mark the observation complete: consumers run their final
+        window tick and streaming jobs COMPLETE (until then they stay
+        registered, polling for more chunks)."""
+        if not self.manifest["finalized"]:
+            self.manifest["finalized"] = True
+            _write_manifest(self.dir, self.manifest)
+
+
+def _write_manifest(directory: str, man: dict) -> None:
+    path = os.path.join(directory, MANIFEST)
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(man, fh)
+    os.replace(tmp, path)
+
+
+def _read_manifest(directory: str, missing_ok: bool = False):
+    path = os.path.join(directory, MANIFEST)
+    try:
+        with open(path) as fh:
+            man = json.load(fh)
+    except FileNotFoundError:
+        if missing_ok:
+            return None
+        raise FeedError(f"{directory}: not a feed (no {MANIFEST})")
+    # other OSErrors (transient IO on a shared feed dir) propagate:
+    # they are retry evidence, not "this is not a feed"
+    except ValueError as e:
+        # the manifest is written atomically, so torn JSON here is
+        # real corruption, not a mid-write race
+        raise FeedError(f"{directory}/{MANIFEST}: invalid JSON ({e})")
+    if not isinstance(man, dict) or man.get("kind") != \
+            "scintools-tpu-feed" or "chunks" not in man:
+        raise FeedError(f"{directory}/{MANIFEST}: not a scintools-tpu "
+                        "feed manifest")
+    return man
+
+
+class FeedReader:
+    """Consumer handle over a feed directory: committed chunks only,
+    CRC-verified on read."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        self.manifest = _read_manifest(directory)
+
+    def refresh(self) -> None:
+        self.manifest = _read_manifest(self.dir)
+
+    @property
+    def nf(self) -> int:
+        return len(self.manifest["freqs"])
+
+    @property
+    def dt(self) -> float:
+        return float(self.manifest["dt"])
+
+    @property
+    def name(self) -> str:
+        return str(self.manifest.get("name", "feed"))
+
+    @property
+    def finalized(self) -> bool:
+        return bool(self.manifest.get("finalized", False))
+
+    @property
+    def total_samples(self) -> int:
+        return sum(int(c["nt"]) for c in self.manifest["chunks"])
+
+    def freqs(self) -> np.ndarray:
+        return np.asarray(self.manifest["freqs"], dtype=np.float64)  # host-f64: axes identity
+
+    def read_chunk(self, rec: dict) -> np.ndarray:
+        path = os.path.join(self.dir, rec["file"])
+        try:
+            with open(path, "rb") as fh:
+                data = fh.read()
+        except FileNotFoundError as e:
+            # a COMMITTED chunk's file vanished: deterministic for the
+            # directory on disk (someone deleted feed data)
+            raise FeedError(f"feed chunk {rec['file']}: missing ({e})")
+        # any other OSError (ESTALE/EIO/NFS blip on a shared feed dir)
+        # is transient evidence, NOT corruption: it propagates as-is so
+        # the serve taxonomy keeps its bounded-retry path instead of
+        # poisoning a healthy live observation
+        if zlib.crc32(data) != int(rec["crc"]):
+            raise FeedError(f"feed chunk {rec['file']}: CRC mismatch "
+                            "(corrupt bytes)")
+        arr = _decode_chunk(data)
+        if arr.ndim != 2 or arr.shape[0] != self.nf:
+            raise FeedError(f"feed chunk {rec['file']}: shape "
+                            f"{arr.shape} != [nf={self.nf}, *]")
+        return arr
+
+    def chunks_since(self, sample: int):
+        """Yield ``(start_sample, record)`` for every committed chunk
+        whose samples begin at or after ``sample`` (the session's
+        consume cursor — always chunk-aligned, so no partial
+        overlaps).  Call :meth:`refresh` first to see new commits."""
+        start = 0
+        for rec in self.manifest["chunks"]:
+            if start >= sample:
+                yield start, rec
+            start += int(rec["nt"])
+
+    def times(self, n: int, start: int = 0) -> np.ndarray:
+        """The feed's RELATIVE time axis for ``n`` samples: 0-based
+        ``arange(n) * dt``.  Every full window shares this axis
+        regardless of where it sits in the observation (the fits
+        depend on the dt spacing, not the absolute offset) — which is
+        exactly what keeps the window step ONE compiled signature."""
+        del start  # relative by design; kept for call-site clarity
+        return np.arange(n, dtype=np.float64) * self.dt  # host-f64: axes
+
+    def epoch(self, last: int | None = None):
+        """The committed feed (or its last ``last`` samples) as a
+        :class:`~scintools_tpu.data.DynspecData` — the one-shot batch
+        view of the same data a streaming session windows over, used
+        by the byte-identity acceptance gate and the partial-window
+        final fit."""
+        from ..data import DynspecData
+
+        parts = [self.read_chunk(rec) for rec in
+                 self.manifest["chunks"]]
+        if not parts:
+            raise FeedError(f"feed {self.dir}: no committed chunks")
+        dyn = np.concatenate(parts, axis=1)
+        if last is not None:
+            dyn = dyn[:, -int(last):]
+        return DynspecData(dyn=dyn.astype(np.float64),  # host-f64: staging parity with pad_batch
+                           freqs=self.freqs(),
+                           times=self.times(dyn.shape[1]),
+                           mjd=float(self.manifest.get("mjd", 50000.0)),
+                           name=self.name)
+
+
+class Ring:
+    """Device-resident ring over the last W time samples.
+
+    ``push`` transfers only the (rung-padded) incoming chunk and
+    updates the HBM window with one fixed-signature jitted
+    concat+dynamic-slice — no per-push recompiles beyond the closed
+    pow2 chunk-width ladder, no O(W) re-staging per tick.  A host
+    mirror is kept in step (pure data movement on both sides, so they
+    are bit-identical) for the incremental ACF, chunk preflight and
+    crash-recovery replay."""
+
+    def __init__(self, nf: int, window: int, dtype="float32"):
+        if window < 2:
+            raise ValueError(f"ring window must be >= 2, got {window}")
+        self.nf = int(nf)
+        self.window = int(window)
+        self.dtype = np.dtype(dtype)
+        self._host = np.zeros((self.nf, self.window), dtype=self.dtype)
+        self._dev = None
+        self.count = 0            # total samples ever pushed
+
+    @property
+    def full(self) -> bool:
+        return self.count >= self.window
+
+    def _updater(self):
+        import jax
+
+        nf, W = self.nf, self.window
+
+        def upd(win, chunk, n):
+            cat = jax.numpy.concatenate([win, chunk], axis=1)
+            return jax.lax.dynamic_slice(
+                cat, (jax.numpy.int32(0), n), (nf, W))
+
+        # ONE jit'd callable: jax's own cache retraces per padded
+        # chunk shape, and the rung ladder keeps that set closed
+        return jax.jit(upd)
+
+    def push(self, chunk: np.ndarray) -> None:
+        """Append ``c`` new samples (oldest ``c`` fall out once full).
+        Chunks wider than the window keep only their last W columns."""
+        arr = np.asarray(chunk, dtype=self.dtype)
+        if arr.ndim != 2 or arr.shape[0] != self.nf:
+            raise ValueError(f"push: expected [nf={self.nf}, c], got "
+                             f"{arr.shape}")
+        pushed = arr.shape[1]
+        c = pushed
+        if c >= self.window:
+            arr = arr[:, -self.window:]
+            c = self.window
+        self._host = np.concatenate([self._host[:, c:], arr], axis=1)
+        self.count += pushed
+        self._push_device(arr, c)
+
+    def _push_device(self, arr: np.ndarray, c: int) -> None:
+        try:
+            import jax
+        except ImportError:  # pragma: no cover - jax is a core dep
+            return
+        if self._dev is None:
+            self._dev = jax.numpy.asarray(
+                np.zeros((self.nf, self.window), dtype=self.dtype))
+        cpad = chunk_rung(c)
+        padded = np.zeros((self.nf, cpad), dtype=self.dtype)
+        padded[:, :c] = arr
+        # the ONLY per-push H2D traffic: the rung-padded chunk (the
+        # window itself stays HBM-resident across ticks)
+        obs.inc("bytes_h2d", padded.nbytes)
+        fn = getattr(self, "_update_fn", None)
+        if fn is None:
+            fn = self._update_fn = self._updater()
+        self._dev = fn(self._dev, padded, np.int32(c))
+
+    def window_device(self):
+        """The HBM-resident [nf, W] window (host mirror if nothing was
+        ever pushed through the device path)."""
+        return self._dev if self._dev is not None else self._host
+
+    def window_host(self) -> np.ndarray:
+        return self._host
+
+    def reset(self, host: np.ndarray, count: int) -> None:
+        """Crash-recovery replay handoff: install a rebuilt host
+        window (and re-stage it once — the one full-window transfer a
+        resume pays)."""
+        host = np.asarray(host, dtype=self.dtype)
+        if host.shape != (self.nf, self.window):
+            raise ValueError(f"reset: expected {(self.nf, self.window)}"
+                             f", got {host.shape}")
+        self._host = host.copy()
+        self.count = int(count)
+        self._dev = None
+        try:
+            import jax
+
+            obs.inc("bytes_h2d", self._host.nbytes)
+            self._dev = jax.numpy.asarray(self._host)
+        except ImportError:  # pragma: no cover
+            pass
+
+
+class IncrementalACF:
+    """Zero frequency-lag time-ACF cut over a sliding window, updated
+    INCREMENTALLY: ``A[lag] = sum_{f,t} w[f,t] * w[f,t+lag]``.  A push
+    of ``c`` columns subtracts the evicted columns' pair terms (from
+    the pre-push window) and adds the new columns' (from the post-push
+    window) — O(c x lags x nf) instead of the from-scratch
+    O(W x lags x nf) — and every :data:`ACF_RESYNC_EVERY` pushes an
+    exact recompute re-anchors the accumulator so float drift stays
+    bounded (parity with from-scratch is test-pinned).
+
+    This is the stream plane's cheap between-fits surface: the
+    normalised cut's half-power lag (:meth:`halfwidth_s`) is the live
+    timescale proxy each tick row carries beside the canonical warm
+    compiled tau/dnu fit (which is never derived from this
+    accumulator)."""
+
+    def __init__(self, window: int, nlags: int | None = None,
+                 resync_every: int = ACF_RESYNC_EVERY):
+        self.window = int(window)
+        self.nlags = int(nlags if nlags is not None
+                         else max(2, min(self.window // 2, 64)))
+        if not 1 <= self.nlags <= self.window:
+            raise ValueError(f"nlags={self.nlags} must be within the "
+                             f"window ({self.window})")
+        self.resync_every = int(resync_every)
+        self.acf = np.zeros(self.nlags, dtype=np.float64)  # host-f64: accumulator precision
+        self._pushes = 0
+
+    @staticmethod
+    def _pairs(win: np.ndarray, lags: int, cols) -> np.ndarray:
+        """Pair-term sums ``sum_f win[:, j-lag] * win[:, j]`` for
+        ``j`` in ``cols``, per lag (terms with j-lag < 0 drop)."""
+        out = np.zeros(lags, dtype=np.float64)  # host-f64: accumulator precision
+        cols = np.asarray(cols)
+        for lag in range(lags):
+            js = cols[cols >= lag]
+            if js.size:
+                out[lag] = float(np.einsum("ij,ij->", win[:, js - lag],
+                                           win[:, js]))
+        return out
+
+    def compute(self, win: np.ndarray) -> np.ndarray:
+        """From-scratch cut over ``win`` — the resync anchor and the
+        parity oracle."""
+        w = np.asarray(win, dtype=np.float64)  # host-f64: accumulator precision
+        out = np.zeros(self.nlags, dtype=np.float64)  # host-f64: accumulator precision
+        for lag in range(self.nlags):
+            out[lag] = float(np.einsum(
+                "ij,ij->", w[:, :w.shape[1] - lag] if lag else w,
+                w[:, lag:] if lag else w))
+        return out
+
+    def push(self, before: np.ndarray, after: np.ndarray,
+             c: int) -> None:
+        """Advance over one ring push: ``before``/``after`` are the
+        host windows around it, ``c`` the slide width."""
+        c = min(int(c), self.window)
+        self._pushes += 1
+        if self._pushes % self.resync_every == 0 or c >= self.window:
+            self.acf = self.compute(after)
+            return
+        W = self.window
+        bf = np.asarray(before, dtype=np.float64)  # host-f64: accumulator precision
+        af = np.asarray(after, dtype=np.float64)  # host-f64: accumulator precision
+        # pairs lost with the evicted leading c columns of `before`:
+        # every pair whose EARLIER member sits at i < c, i.e. whose
+        # later member j = i + lag lands in [0, c + lag)
+        lost = np.zeros(self.nlags, dtype=np.float64)  # host-f64: accumulator precision
+        for lag in range(self.nlags):
+            hi = min(c + lag, W)
+            js = np.arange(lag, hi)
+            if js.size:
+                lost[lag] = float(np.einsum(
+                    "ij,ij->", bf[:, js - lag], bf[:, js]))
+        # pairs gained with the new trailing c columns of `after`
+        gained = self._pairs(af, self.nlags, np.arange(W - c, W))
+        self.acf = self.acf - lost + gained
+
+    def cut(self) -> np.ndarray:
+        """The current (unnormalised) time-lag cut."""
+        return self.acf.copy()
+
+    def halfwidth_s(self, dt: float) -> float | None:
+        """First lag (seconds, linear-interpolated) where the
+        normalised cut falls below 1/2 — the live timescale proxy.
+        None while the cut is degenerate (empty/flat window)."""
+        a0 = self.acf[0]
+        if not np.isfinite(a0) or a0 <= 0:
+            return None
+        norm = self.acf / a0
+        below = np.nonzero(norm < 0.5)[0]
+        if below.size == 0:
+            return None
+        k = int(below[0])
+        if k == 0:
+            return 0.0
+        y0, y1 = norm[k - 1], norm[k]
+        frac = (y0 - 0.5) / (y0 - y1) if y0 != y1 else 0.0
+        return float((k - 1 + frac) * dt)

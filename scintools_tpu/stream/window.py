@@ -1,0 +1,381 @@
+"""Sliding-window recompute: fixed-signature ticks over a live feed.
+
+A :class:`StreamSession` follows one feed and re-issues the survey fit
+over the last W time samples each time ``hop`` new samples have
+arrived ("a tick").  The whole design exists to make a tick CHEAP on a
+warm process:
+
+* the window shape is pinned to ``(1, nf, W)`` with a 0-based relative
+  time axis, so every tick of the whole observation executes ONE
+  compiled signature — a member of the PR 6 bucket catalog (batch rung
+  1), servable from a warm-cache artifact, with ``jit_cache_miss == 0``
+  across ticks once warmed (tier-1 counter-asserted);
+* the window lives in HBM (:class:`~scintools_tpu.stream.ingest.Ring`)
+  — per-tick H2D is the rung-padded chunk, not the window;
+* the time-lag ACF cut is maintained incrementally over the ring
+  (:class:`~scintools_tpu.stream.ingest.IncrementalACF`) rather than
+  from scratch — the live timescale proxy between fits;
+* each appended chunk passes the preflight data-quality gate
+  (:func:`~scintools_tpu.stream.ingest.preflight_chunk`): a bad chunk
+  is MASKED (chunk-local deterministic repair) and counted
+  (``chunks_quarantined[<reason>]``), never fatal to the stream.
+
+The canonical eta/tau/dnu per tick come from the SAME pipeline the
+batch path runs (the identical memoised ``make_pipeline`` step), so a
+final full window's fit row is byte-identical to a one-shot batch run
+over the same data — the acceptance contract.
+
+Crash recovery: a session serialises to a tiny cursor dict
+(:meth:`StreamSession.state`); :meth:`restore` replays the last W
+samples from the feed log (masking is chunk-local, hence
+deterministic) and resumes ticking where the dead session stopped —
+no duplicate and no lost versioned rows, because tick rows are keyed
+by window-end sample.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .. import obs
+from ..utils.log import get_logger, log_event
+from .ingest import (FeedError, FeedReader, IncrementalACF, Ring,
+                     mask_chunk, preflight_chunk)
+
+DEFAULT_WINDOW = 256
+DEFAULT_HOP = 64
+MIN_WINDOW = 8
+
+
+def validate_stream_spec(spec: dict) -> dict:
+    """Normalise/validate a ``stream`` job payload ``{feed, window,
+    hop}`` — ONE rule site shared by ``JobQueue.submit_stream`` (the
+    client-side fail-fast) and the worker's registration path."""
+    import os
+
+    spec = dict(spec or {})
+    feed = spec.get("feed")
+    if not feed:
+        raise ValueError("stream spec needs feed=<feed directory>")
+    w = int(spec.get("window", DEFAULT_WINDOW))
+    h = int(spec.get("hop", max(w // 4, 1)))
+    if w < MIN_WINDOW:
+        raise ValueError(f"stream window={w}: need >= {MIN_WINDOW} "
+                         "time samples for the fits")
+    if not 1 <= h <= w:
+        raise ValueError(f"stream hop={h}: need 1 <= hop <= window "
+                         f"({w})")
+    return {"feed": os.path.abspath(str(feed)), "window": w, "hop": h}
+
+
+class StreamSession:
+    """Incremental-recompute consumer of one feed.
+
+    ``opts`` is the serve option dict (the same estimator flags a
+    batch job carries); a mesh is not supported — a streaming window
+    is a single-device residency by design (shard across FEEDS, not
+    within one window)."""
+
+    def __init__(self, feed_dir: str, opts: dict | None = None,
+                 window: int = DEFAULT_WINDOW, hop: int = DEFAULT_HOP,
+                 nlags: int | None = None):
+        from ..parallel.driver import stage_dtype
+        from ..serve.worker import config_from_opts
+
+        spec = validate_stream_spec({"feed": feed_dir, "window": window,
+                                     "hop": hop})
+        self.window = spec["window"]
+        self.hop = spec["hop"]
+        self.opts = dict(opts or {})
+        self.opts.pop("stream", None)   # the payload is not an estimator knob
+        self.cfg = config_from_opts(self.opts)
+        if self.cfg.arc_stack:
+            raise ValueError("arc_stack is a campaign knob; a stream "
+                             "tick fits one window")
+        self.cfg.validate()
+        self.reader = FeedReader(spec["feed"])
+        self.freqs = self.reader.freqs()
+        self.nf = len(self.freqs)
+        self.dt = self.reader.dt
+        self.win_times = self.reader.times(self.window)
+        self._stage_dtype = np.dtype(stage_dtype(self.cfg.precision))
+        self.ring = Ring(self.nf, self.window, dtype=self._stage_dtype)
+        self.acf = IncrementalACF(self.window, nlags=nlags)
+        self.consumed = 0           # committed samples consumed
+        self.tick_seq = 0
+        self.last_tick_at = None    # consumed-sample count of last tick
+        self.quarantined: dict[str, int] = {}
+        self.final_done = False
+        self.tick_latencies: list[float] = []   # bounded (newest 256)
+        self._last_chunk_t = None   # producer wall stamp of newest
+        self._stepfn = None         # consumed chunk (lag readout)
+        self.log = get_logger()
+
+    # -- identity / durability ---------------------------------------------
+    @property
+    def name(self) -> str:
+        return self.reader.name
+
+    def signature(self) -> str:
+        """The one compiled window signature every tick executes
+        (obs-label spelling, like the bucket catalog's)."""
+        return f"1x{self.nf}x{self.window}:{self._stage_dtype}"
+
+    def state(self) -> dict:
+        """The durable resume cursor (persist AFTER the tick rows'
+        flush, so a crash between them replays — versioned rows make
+        the replayed publishes idempotent)."""
+        return {"consumed": int(self.consumed),
+                "tick_seq": int(self.tick_seq),
+                "last_tick_at": self.last_tick_at,
+                "quarantined": dict(self.quarantined),
+                "final_done": bool(self.final_done)}
+
+    def restore(self, state: dict) -> None:
+        """Resume from a :meth:`state` cursor: replay the last W
+        consumed samples out of the feed log (chunk-local masking
+        replays to the same bytes) to rebuild the ring + ACF, then
+        continue from the cursor."""
+        consumed = int(state.get("consumed", 0))
+        if consumed <= 0:
+            return
+        total = self.reader.total_samples
+        if consumed > total:
+            # a cursor AHEAD of the committed log (manifest rolled
+            # back?) cannot replay; re-consume from scratch
+            log_event(self.log, "stream_cursor_ahead", feed=self.name,
+                      cursor=consumed, committed=total)
+            return
+        window = np.zeros((self.nf, self.window),
+                          dtype=self._stage_dtype)
+        filled = 0
+        start_want = consumed - self.window
+        for start, rec in self.reader.chunks_since(0):
+            end = start + int(rec["nt"])
+            if end <= max(start_want, 0) or start >= consumed:
+                continue
+            arr = np.asarray(self.reader.read_chunk(rec))
+            if preflight_chunk(arr):
+                arr = mask_chunk(arr)
+            arr = arr.astype(self._stage_dtype)
+            lo = max(start_want - start, 0)
+            hi = int(rec["nt"]) - max(end - consumed, 0)
+            piece = arr[:, lo:hi]
+            pos = self.window - (consumed - (start + lo))
+            window[:, pos:pos + piece.shape[1]] = piece
+            filled += piece.shape[1]
+        self.ring.reset(window, consumed)
+        self.acf.acf = self.acf.compute(window)
+        self.consumed = consumed
+        self.tick_seq = int(state.get("tick_seq", 0))
+        self.last_tick_at = state.get("last_tick_at")
+        self.quarantined = dict(state.get("quarantined") or {})
+        self.final_done = bool(state.get("final_done", False))
+        log_event(self.log, "stream_resumed", feed=self.name,
+                  consumed=consumed, replayed=filled,
+                  ticks=self.tick_seq)
+
+    # -- consuming the feed -------------------------------------------------
+    def _consume(self, rec: dict) -> None:
+        arr = np.asarray(self.reader.read_chunk(rec))
+        reasons = preflight_chunk(arr)
+        if reasons:
+            for r in reasons:
+                self.quarantined[r] = self.quarantined.get(r, 0) + 1
+                obs.inc(f"chunks_quarantined[{r}]")
+            obs.inc("chunks_quarantined")
+            log_event(self.log, "stream_chunk_quarantined",
+                      feed=self.name, seq=rec.get("seq"),
+                      reasons=",".join(reasons))
+            arr = mask_chunk(arr)
+        chunk = arr.astype(self._stage_dtype)
+        before = self.ring.window_host()
+        self.ring.push(chunk)
+        self.acf.push(before, self.ring.window_host(), chunk.shape[1])
+        self.consumed += int(rec["nt"])
+        self._last_chunk_t = rec.get("t")
+
+    def _tick_due(self) -> bool:
+        if not self.ring.full:
+            return False
+        if self.last_tick_at is None:
+            return True
+        return self.consumed - self.last_tick_at >= self.hop
+
+    def poll(self, now: float | None = None) -> list[dict]:
+        """Consume newly committed chunks and run every due tick.
+        Returns the tick rows (possibly empty).  A corrupt committed
+        chunk raises :class:`FeedError` (deterministic poison — the
+        serve worker routes it to ``failed/``)."""
+        self.reader.refresh()
+        rows: list[dict] = []
+        for _start, rec in self.reader.chunks_since(self.consumed):
+            self._consume(rec)
+            if self._tick_due():
+                rows.append(self._tick(now=now))
+        if self.reader.finalized and not self.final_done \
+                and self.consumed >= self.reader.total_samples:
+            final = self._final_tick(now=now)
+            if final is not None:
+                rows.append(final)
+            self.final_done = True
+        return rows
+
+    @property
+    def complete(self) -> bool:
+        """All committed samples consumed, feed finalized, final
+        window published — the stream job's completion condition."""
+        return (self.final_done and self.reader.finalized
+                and self.consumed >= self.reader.total_samples)
+
+    # -- the warm fixed-signature fit ---------------------------------------
+    def _ensure_step(self):
+        """Build (once) the window step: the SAME memoised
+        ``make_pipeline`` program the batch driver runs for a
+        ``[1, nf, W]`` epoch — preferring a warm-cache AOT artifact
+        exactly as ``run_pipeline`` would, so a warmed pod's first
+        tick re-traces nothing."""
+        if self._stepfn is not None:
+            return self._stepfn
+        from .. import compile_cache
+        from ..parallel.driver import _SplitStep, make_pipeline
+
+        step = make_pipeline(self.freqs, self.win_times, self.cfg,
+                             mesh=None, donate=False)
+        if isinstance(step, _SplitStep):
+            self._stepfn = step.instrumented()
+            return self._stepfn
+        aot = None
+        if compile_cache.cache_dir() is not None:
+            compile_cache.enable_persistent_cache()
+            aot = compile_cache.load_step(compile_cache.step_key(
+                self.freqs, self.win_times, self.cfg, None, False,
+                (1, self.nf, self.window), self._stage_dtype,
+                donate=False))
+        self._stepfn = (obs.instrument_jit(aot, "pipeline.step",
+                                           aot=True)
+                        if aot is not None
+                        else obs.instrument_jit(step, "pipeline.step"))
+        return self._stepfn
+
+    def _row_base(self, window_end: int, final: bool) -> dict:
+        man = self.reader.manifest
+        freqs = self.freqs
+        df = float(freqs[1] - freqs[0]) if len(freqs) > 1 else 1.0
+        return {
+            "name": f"{self.name}@{'final' if final else 'w%d' % window_end}",
+            "mjd": float(man.get("mjd", 50000.0)),
+            "freq": round(float(np.mean(freqs)), 2),
+            "bw": float(abs(freqs[-1] - freqs[0])) + abs(df),
+            "tobs": self.window * self.dt, "dt": self.dt, "df": df,
+            "window_end": int(window_end), "tick": int(self.tick_seq),
+            "window": int(self.window), "final": bool(final),
+        }
+
+    def _publish_metrics(self, latency: float, now: float) -> None:
+        obs.inc("stream_ticks")
+        obs.observe("tick_latency_s", latency)
+        self.tick_latencies.append(latency)
+        del self.tick_latencies[:-256]
+        lag = self.lag_s(now)
+        if lag is not None:
+            obs.gauge("stream_lag_s", round(lag, 6), stream=True)
+            obs.gauge(f"stream_lag_s[{self.name}]", round(lag, 6))
+
+    def lag_s(self, now: float | None = None) -> float | None:
+        """Processing lag behind the feed head: wall seconds since the
+        newest CONSUMED chunk was appended by the producer (the
+        ``stream_lag_s`` gauge; None before any consumption)."""
+        if self._last_chunk_t is None:
+            return None
+        now = time.time() if now is None else now
+        return max(now - float(self._last_chunk_t), 0.0)
+
+    def _measure_row(self, res, lane: int = 0) -> dict:
+        from ..io.results import batch_lane_row
+
+        return batch_lane_row(res, lane, self.cfg.lamsteps)
+
+    def _tick(self, now: float | None = None) -> dict:
+        """One sliding-window recompute over the HBM-resident ring:
+        the fixed-signature compiled fit + the incremental-ACF
+        timescale proxy, emitted as one result row."""
+        t0 = time.perf_counter()
+        step = self._ensure_step()
+        with obs.span("stream.tick", feed=self.name,
+                      window_end=self.consumed):
+            res = step(self.ring.window_device()[None])
+        self.tick_seq += 1
+        self.last_tick_at = self.consumed
+        row = self._row_base(self.consumed, final=False)
+        row.update(self._measure_row(res))
+        hw = self.acf.halfwidth_s(self.dt)
+        if hw is not None:
+            row["acf_halfwidth_s"] = round(hw, 6)
+        row["quarantined_chunks"] = int(sum(self.quarantined.values()))
+        latency = time.perf_counter() - t0
+        row["tick_latency_s"] = round(latency, 6)
+        self._publish_metrics(latency,
+                              time.time() if now is None else now)
+        return row
+
+    def _final_tick(self, now: float | None = None) -> dict | None:
+        """The finalized feed's last word: one more full-window tick
+        over the completed tail, marked ``final`` — the row the
+        byte-identity acceptance gate compares against a one-shot
+        batch run of the same data (re-running the warm signature is
+        cheaper than special-casing "the last hop already ticked
+        here").  A feed SHORTER than the window cannot execute the
+        fixed signature: it runs the one-shot batch path over the
+        actual samples instead (its own — warmable — signature),
+        marked ``partial_window``."""
+        if self.ring.full:
+            row = self._tick(now=now)
+            row["final"] = True
+            row["name"] = f"{self.name}@final"
+            return row
+        # short feed: one-shot batch fit over what arrived
+        from ..parallel import run_pipeline
+
+        t0 = time.perf_counter()
+        try:
+            epoch = self.reader.epoch()
+        except FeedError:
+            return None
+        ((idx, res),) = run_pipeline([epoch], self.cfg,
+                                     async_exec=False)
+        del idx
+        self.tick_seq += 1
+        self.last_tick_at = self.consumed
+        row = self._row_base(self.consumed, final=True)
+        row["tobs"] = epoch.nsub * self.dt
+        row["partial_window"] = True
+        row.update(self._measure_row(res))
+        row["quarantined_chunks"] = int(sum(self.quarantined.values()))
+        latency = time.perf_counter() - t0
+        row["tick_latency_s"] = round(latency, 6)
+        self._publish_metrics(latency,
+                              time.time() if now is None else now)
+        return row
+
+    # -- telemetry ----------------------------------------------------------
+    def stats(self) -> dict:
+        """The per-feed heartbeat/fleet payload."""
+        lat = sorted(self.tick_latencies)
+        return {
+            "feed": self.name, "window": self.window, "hop": self.hop,
+            "ticks": int(self.tick_seq),
+            "consumed": int(self.consumed),
+            "committed": int(self.reader.total_samples),
+            "finalized": self.reader.finalized,
+            "quarantined": int(sum(self.quarantined.values())),
+            "lag_s": (round(self.lag_s(), 3)
+                      if self._last_chunk_t is not None else None),
+            "tick_latency_s": ({
+                "p50": round(lat[len(lat) // 2], 6),
+                "p95": round(lat[min(len(lat) - 1,
+                                     int(len(lat) * 0.95))], 6)}
+                if lat else None),
+        }

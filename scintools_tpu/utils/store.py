@@ -51,6 +51,42 @@ from .segments import SegmentStore
 SEGMENT_DIRNAME = "segments"
 DEFAULT_FLUSH_ROWS = 4096
 
+_LAST_VERSION_STAMP = 0.0
+
+
+def _version_stamp() -> float:
+    """Strictly-increasing version stamps within one process (the
+    ``_v`` field ``put_versioned`` rides): two versions of a key
+    written inside one clock tick must still resolve newest-wins
+    deterministically across the planes."""
+    global _LAST_VERSION_STAMP
+    t = time.time()
+    if t <= _LAST_VERSION_STAMP:
+        t = _LAST_VERSION_STAMP + 1e-6
+    _LAST_VERSION_STAMP = t
+    return round(t, 6)
+
+
+def _newest_version(*candidates):
+    """Cross-plane newest-wins resolution for a duplicated key
+    (ROADMAP item 5's open read-policy tail): candidates are
+    ``(record | None)`` in DESCENDING legacy priority (row file,
+    buffer, segment).  The record with the largest ``_v`` stamp wins;
+    records without a stamp (the write-once planes — deterministic
+    duplicates by contract) rank below any stamped version, and a tie
+    keeps the legacy priority order."""
+    best = None
+    best_rank = None
+    for prio, rec in enumerate(candidates):
+        if rec is None:
+            continue
+        v = rec.get("_v") if isinstance(rec, dict) else None
+        rank = (v if isinstance(v, (int, float)) else float("-inf"),
+                -prio)
+        if best is None or rank > best_rank:
+            best, best_rank = rec, rank
+    return best
+
 
 def content_key(source, config=None) -> str:
     """Stable hash of an input + config.
@@ -132,6 +168,20 @@ class ResultsStore:
         self.put(key, record)
         return True
 
+    def _row_file_get(self, key: str) -> dict | None:
+        """The row-file plane's record for ``key``: missing degrades
+        to None, corrupt bytes quarantine aside (observable — see
+        :meth:`get`)."""
+        path = self._path(key)
+        try:
+            with open(path) as fh:
+                return json.load(fh)
+        except OSError:
+            return None
+        except ValueError:
+            self._quarantine_corrupt(path)
+            return None
+
     def get(self, key: str) -> dict | None:
         """A missing OR unreadable/corrupt row degrades to None (as
         ``get_meta`` does): the store is a multi-writer surface under
@@ -143,20 +193,24 @@ class ResultsStore:
         event with the path, and is quarantined aside under a
         ``.corrupt`` suffix — so ``__contains__``/``keys()`` stop
         seeing it (the row re-executes instead of re-parsing the same
-        torn bytes on every scan) and the bytes survive for forensics."""
-        path = self._path(key)
-        try:
-            with open(path) as fh:
-                return json.load(fh)
-        except OSError:
-            pass
-        except ValueError:
-            self._quarantine_corrupt(path)
-            return None
+        torn bytes on every scan) and the bytes survive for forensics.
+
+        VERSIONED keys (written by :meth:`put_versioned`, which stamps
+        ``_v``) resolve newest-wins ACROSS the planes: a versioned row
+        file (a ``plane='rows'`` producer) and versioned segment rows
+        compare by stamp instead of the write-once planes' row-file-
+        wins rule — so live streaming rows read correctly whichever
+        plane the producer ran on.  Unstamped rows keep the legacy
+        fast path: a row file satisfies the read without touching the
+        segment index."""
+        row = self._row_file_get(key)
+        if row is not None and "_v" not in row:
+            return row            # write-once fast path (legacy rule)
         buffered = self._buf.get(key)
-        if buffered is not None:
-            return buffered[0]
-        return self.segments.get(key)
+        buf = buffered[0] if buffered is not None else None
+        if row is None and buf is not None and "_v" not in buf:
+            return buf
+        return _newest_version(row, buf, self.segments.get(key))
 
     def _quarantine_corrupt(self, path: str) -> None:
         from .. import obs
@@ -189,7 +243,8 @@ class ResultsStore:
             self.flush()
         return True
 
-    def put_versioned(self, key: str, record: dict) -> bool:
+    def put_versioned(self, key: str, record: dict,
+                      series: str | None = None) -> bool:
         """VERSIONED buffered put (ROADMAP item 5 open tail, for item
         2's streaming rows): the newest write under ``key`` WINS at
         read time — ``put_new``'s write-once dedup is deliberately
@@ -200,16 +255,28 @@ class ResultsStore:
         No format change: the segment plane already reads newest-
         segment-first and dedups by key (``SegmentStore.get`` /
         ``iter_sorted_items`` / ``compact`` all resolve duplicates
-        newest-wins), so versioning is purely this write-policy
-        change.  A not-yet-flushed buffered version supersedes both
-        earlier buffered ones (the buffer is keyed) and every sealed
-        one (``get`` consults the buffer before the segments).  Under
-        ``plane='rows'`` this degrades to an overwriting :meth:`put`.
+        newest-wins), so versioning is a write-policy + read-policy
+        change.  Every versioned record is stamped with a strictly-
+        increasing ``_v`` (underscore-prefixed: CSV exports never show
+        it), which is what lets ``get``/``iter_items`` resolve a
+        duplicated key newest-wins even ACROSS planes — a
+        ``plane='rows'`` producer's row file and older sealed segment
+        versions compare by stamp instead of the write-once planes'
+        row-file-wins rule.  A not-yet-flushed buffered version
+        supersedes both earlier buffered ones (the buffer is keyed)
+        and every sealed one.  Under ``plane='rows'`` this degrades to
+        an overwriting (stamped) :meth:`put`.
 
-        Caveat: versioned keys must be written ONLY through this
-        method — a legacy row FILE under the same key would win every
-        read (``get`` probes row files first, the cross-plane merge
-        rule for the write-once planes)."""
+        ``series`` (optional) tags the record's version GROUP
+        (``_series``): the streaming plane stamps each feed's tick
+        rows with the stream job id, and ``export_csv(latest_only=
+        True)`` keeps only the newest row per series — the final
+        values per live feed, instead of the whole tracked time
+        series."""
+        record = dict(record)
+        record["_v"] = _version_stamp()
+        if series is not None:
+            record["_series"] = str(series)
         if self.plane == "rows":
             self.put(key, record)
             return True
@@ -269,12 +336,15 @@ class ResultsStore:
         directory walk + the segment footers, never the whole store in
         memory (the O(N)-memory ``records()`` list was the scale bug
         at exactly the campaign sizes the segment plane targets).
-        Row files win over segments for a duplicated key (both are
-        deterministic duplicates under the at-least-once contract)."""
+        Row files win over segments for a duplicated key of the
+        write-once planes (both are deterministic duplicates under the
+        at-least-once contract); VERSIONED duplicates (``_v``-stamped
+        — put_versioned) resolve newest-wins across the planes, same
+        rule as :meth:`get`."""
         row_keys = self._row_file_keys()
         if not self.segments.keys():
             for k in sorted(row_keys):
-                rec = self.get(k)
+                rec = self._row_file_get(k)
                 if rec is not None:
                     yield k, rec
             return
@@ -284,11 +354,18 @@ class ResultsStore:
             while seg_next is not None and seg_next[0] < k:
                 yield seg_next
                 seg_next = next(seg_items, None)
+            seg_rec = None
             if seg_next is not None and seg_next[0] == k:
-                seg_next = next(seg_items, None)   # row file wins
-            rec = self.get(k)
+                seg_rec = seg_next[1]
+                seg_next = next(seg_items, None)
+            rec = self._row_file_get(k)
+            if rec is not None and seg_rec is not None \
+                    and ("_v" in rec or "_v" in seg_rec):
+                rec = _newest_version(rec, None, seg_rec)
             if rec is not None:
                 yield k, rec
+            elif seg_rec is not None:
+                yield k, seg_rec
         while seg_next is not None:
             yield seg_next
             seg_next = next(seg_items, None)
@@ -333,7 +410,37 @@ class ResultsStore:
         """Items whose key is not yet in the store (the resume filter)."""
         return [it for it in items if keyfn(it) not in self]
 
-    def export_csv(self, filename: str, full: bool = False) -> int:
+    def _latest_only_keys(self) -> set[str]:
+        """One key per version SERIES (``_series``-tagged records —
+        put_versioned): the newest-``_v`` row of each series, ties
+        broken by key order.  The ``--latest-only`` export filter's
+        keep-set; untagged records are never filtered."""
+        best: dict[str, tuple] = {}
+        for k, rec in self.iter_items():
+            s = rec.get("_series")
+            if s is None:
+                continue
+            v = rec.get("_v")
+            rank = (v if isinstance(v, (int, float)) else float("-inf"),
+                    k)
+            if s not in best or rank > best[s][0]:
+                best[s] = (rank, k)
+        return {k for _rank, k in best.values()}
+
+    def _export_items(self, latest_only: bool):
+        """The export row stream: all durable records, optionally with
+        each version series collapsed to its newest row."""
+        if not latest_only:
+            yield from self.iter_items()
+            return
+        keep = self._latest_only_keys()
+        for k, rec in self.iter_items():
+            if rec.get("_series") is not None and k not in keep:
+                continue
+            yield k, rec
+
+    def export_csv(self, filename: str, full: bool = False,
+                   latest_only: bool = False) -> int:
         """Write all records to CSV.  Default: the reference-compatible
         schema (io/results.results_line — extra columns like tilt or
         per-arm curvatures are dropped, as the reference's readers
@@ -341,6 +448,13 @@ class ResultsStore:
         carry (union of keys, blank where absent) for downstream tools
         that want the beyond-reference measurements.  Returns the row
         count.
+
+        ``latest_only=True`` collapses each VERSION SERIES (records a
+        streaming producer tagged via ``put_versioned(series=...)``)
+        to its newest row — the final value per live feed instead of
+        the whole tracked time series; untagged records always export.
+        Internal underscore columns (``_v``/``_series``) never appear
+        in either schema.
 
         STREAMS both planes (rows are read once for the reference
         schema, twice for ``full`` — fieldname-union pass then the
@@ -364,7 +478,7 @@ class ResultsStore:
             n = 0
             out = None
             try:
-                for rec in self.records():
+                for _key, rec in self._export_items(latest_only):
                     row = {k: v for k, v in rec.items()
                            if not k.startswith("_")}
                     if "name" not in row:
@@ -380,7 +494,7 @@ class ResultsStore:
                     out.close()
             return n
         lead = ["name", "mjd", "freq", "bw", "tobs", "dt", "df"]
-        present = {k for rec in self.records()
+        present = {k for _key, rec in self._export_items(latest_only)
                    for k in rec if not k.startswith("_")}
         fields = ([k for k in lead if k in present]
                   + sorted(present - set(lead)))
@@ -388,7 +502,7 @@ class ResultsStore:
         with open(filename, "w", newline="") as fh:
             w = csv.DictWriter(fh, fieldnames=fields, restval="")
             w.writeheader()
-            for rec in self.records():
+            for _key, rec in self._export_items(latest_only):
                 w.writerow({k: v for k, v in rec.items()
                             if not k.startswith("_")})
                 n += 1

@@ -32,6 +32,7 @@ PAGES = [
     ("docs/api.md", "api", "API reference"),
     ("docs/performance.md", "performance", "Performance & roofline"),
     ("docs/serving.md", "serving", "Resident survey service"),
+    ("docs/streaming.md", "streaming", "Streaming ingest (live feeds)"),
     ("docs/fleet.md", "fleet", "Fleet pool controller"),
     ("docs/reliability.md", "reliability", "Reliability & fault injection"),
     ("docs/observability.md", "observability", "Tracing & metrics"),

@@ -39,7 +39,11 @@ import tokenize
 
 WIDE = {"float64", "complex128"}
 MARKER = "host-f64"
-SUBTREES = ("ops", "parallel", "sim")
+# stream/ joined the walk with the ISSUE 15 streaming ingest plane:
+# the ring updater traces into the device program and the feed log
+# stores the staged dtype — a stray wide dtype there doubles the very
+# per-tick bytes the device-resident window exists to avoid
+SUBTREES = ("ops", "parallel", "sim", "stream")
 # single modules outside the subtree walk that still sit on hot paths
 # (the ISSUE 11 results plane streams every campaign row — a wide
 # dtype sneaking into its encode/decode would double the bytes of the

@@ -35,7 +35,11 @@ import os
 import sys
 
 MARKER = "fault-ok"
-SUBTREES = ("parallel", "serve", "ops")
+# stream/ joined the walk with the ISSUE 15 streaming ingest plane:
+# its feed log + resume cursor are the durability layer under live
+# monitoring — a silent swallow there can lose appended samples or a
+# tick with no counter moving
+SUBTREES = ("parallel", "serve", "ops", "stream")
 # single modules outside the subtree walk that are fault-critical too:
 # the ISSUE 11 results plane (utils/segments.py + utils/store.py) is
 # the durability layer under the serve queue — a silent swallow there

@@ -41,6 +41,22 @@ def test_sim_subtree_is_covered():
         assert hits == [], (path, hits)
 
 
+def test_stream_subtree_is_covered():
+    """The ISSUE 15 streaming ingest plane traces its ring updater
+    into the device program and stores the staged dtype in the feed
+    log: the lint walk must include stream/ (a rename out of it would
+    silently drop the discipline)."""
+    assert "stream" in check_f32_discipline.SUBTREES
+    pkg = os.path.join(REPO, "scintools_tpu")
+    for name in ("ingest.py", "window.py"):
+        path = os.path.join(pkg, "stream", name)
+        assert os.path.exists(path), path
+        hits = check_f32_discipline.find_wide_literals(path)
+        assert not any(txt.startswith("TokenError")
+                       for _ln, txt in hits)
+        assert hits == [], (path, hits)
+
+
 def test_results_plane_modules_are_covered():
     """The ISSUE 11 storage modules stream every campaign row — a wide
     dtype sneaking into the encode/decode path would double the bytes

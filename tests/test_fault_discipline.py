@@ -37,6 +37,17 @@ def test_results_plane_modules_are_covered():
         assert os.path.exists(os.path.join(pkg, rel)), rel
 
 
+def test_stream_subtree_is_covered():
+    """The ISSUE 15 streaming ingest plane (feed log + resume cursor
+    = the durability layer under live monitoring) is pinned into the
+    lint's walk: a rename out of stream/ must not silently drop the
+    discipline."""
+    assert "stream" in check_fault_discipline.SUBTREES
+    pkg = os.path.join(os.path.dirname(_HERE), "scintools_tpu")
+    for name in ("ingest.py", "window.py"):
+        assert os.path.exists(os.path.join(pkg, "stream", name)), name
+
+
 def _hits(tmp_path, src):
     mod = tmp_path / "mod.py"
     mod.write_text(textwrap.dedent(src))

@@ -77,9 +77,12 @@ def test_catalog_is_consistent_and_covers_the_known_floor():
     # also be plain counter/gauge names except the documented
     # total+breakdown pairs (faults_injected, epochs_quarantined,
     # queue_depth whose total gauge rides beside the per-shard family,
-    # and jit_cache_miss whose total rides beside the per-unit family
-    # the split pipeline's acceptance gate reads — ISSUE 14)
+    # jit_cache_miss whose total rides beside the per-unit family the
+    # split pipeline's acceptance gate reads — ISSUE 14 — and the
+    # streaming plane's chunks_quarantined / stream_lag_s totals
+    # beside their per-reason / per-feed families — ISSUE 15)
     overlap = (set(cat["families"])
                & (set(cat["counters"]) | set(cat["gauges"])))
     assert overlap == {"faults_injected", "epochs_quarantined",
-                       "queue_depth", "jit_cache_miss"}, overlap
+                       "queue_depth", "jit_cache_miss",
+                       "chunks_quarantined", "stream_lag_s"}, overlap

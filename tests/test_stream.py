@@ -1,0 +1,751 @@
+"""Streaming ingest plane (scintools_tpu.stream — ISSUE 15): feed-log
+durability, the device-resident ring + incremental ACF, sliding-window
+recompute sessions (warm fixed-signature ticks, byte-identical final
+window), the serve `stream` job kind, versioned-row read policy, and
+SIGKILL crash recovery of a streaming worker.
+
+All pipeline-executing tests share ONE tiny (1, 32, 32) window
+signature (OPTS/W below) so the in-process jit trace is paid once
+across the module."""
+
+import json
+import math
+import os
+import signal
+import subprocess
+import sys
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from synth import synth_arc_epoch
+
+from scintools_tpu import obs
+from scintools_tpu.io.results import batch_lane_row
+from scintools_tpu.obs import fleet
+from scintools_tpu.serve import JobQueue, ServeWorker, SurveyClient
+from scintools_tpu.serve.worker import config_from_opts
+from scintools_tpu.stream import (FeedError, FeedReader, FeedWriter,
+                                  IncrementalACF, Ring, StreamSession,
+                                  chunk_rung, preflight_chunk)
+from scintools_tpu.stream.ingest import mask_chunk
+from scintools_tpu.utils.store import ResultsStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# one shared tiny-but-real window signature for every fitting test
+OPTS = {"lamsteps": True, "arc_numsteps": 96, "lm_steps": 3}
+NF, W, HOP = 32, 32, 4
+
+
+def _feed_from_epoch(tmp_path, epoch, name="feed", subdir="feed"):
+    d = str(tmp_path / subdir)
+    return d, FeedWriter(d, freqs=epoch.freqs, dt=epoch.dt,
+                         mjd=epoch.mjd, name=name)
+
+
+def _rows_same(a: dict, b: dict, keys) -> bool:
+    return all((a[k] == b[k]) or (isinstance(a[k], float)
+                                  and math.isnan(a[k])
+                                  and math.isnan(b[k]))
+               for k in keys)
+
+
+# ---------------------------------------------------------------------------
+# feed log durability
+# ---------------------------------------------------------------------------
+
+
+def test_feed_append_manifest_and_reader_roundtrip(tmp_path):
+    ep = synth_arc_epoch(nf=NF, nt=24, seed=1)
+    d, w = _feed_from_epoch(tmp_path, ep, name="obs1")
+    dyn = np.asarray(ep.dyn, dtype=np.float32)
+    assert w.append(dyn[:, :10]) == 0
+    assert w.append(dyn[:, 10:24]) == 1
+    r = FeedReader(d)
+    assert r.total_samples == 24 and not r.finalized
+    assert r.name == "obs1" and r.nf == NF and r.dt == ep.dt
+    # chunks_since honours the cursor; chunk bytes round-trip exactly
+    recs = list(r.chunks_since(0))
+    assert [s for s, _ in recs] == [0, 10]
+    np.testing.assert_array_equal(r.read_chunk(recs[1][1]),
+                                  dyn[:, 10:24])
+    assert list(r.chunks_since(10)) == [recs[1]]
+    # the one-shot batch view concatenates the committed log
+    epoch = r.epoch()
+    np.testing.assert_array_equal(np.asarray(epoch.dyn,
+                                             dtype=np.float32), dyn)
+    np.testing.assert_allclose(epoch.times, np.arange(24) * ep.dt)
+    w.finalize()
+    r.refresh()
+    assert r.finalized
+    with pytest.raises(FeedError):
+        w.append(dyn[:, :2])       # finalized feeds are closed
+    # shape validation
+    w2 = FeedWriter(str(tmp_path / "f2"), freqs=ep.freqs, dt=ep.dt)
+    with pytest.raises(ValueError):
+        w2.append(dyn[: NF - 1, :4])
+
+
+def test_feed_orphan_adoption_and_corrupt_quarantine(tmp_path):
+    """Producer crash between the chunk rename and the manifest
+    rewrite: a whole orphan chunk is ADOPTED at reopen (no appended
+    data lost); an unparseable orphan quarantines aside."""
+    ep = synth_arc_epoch(nf=NF, nt=16, seed=1)
+    d, w = _feed_from_epoch(tmp_path, ep)
+    dyn = np.asarray(ep.dyn, dtype=np.float32)
+    w.append(dyn[:, :8])
+    # simulate the crash window: chunk_00000001 lands, manifest not
+    # rewritten (write the file exactly as append would)
+    import io as io_mod
+    buf = io_mod.BytesIO()
+    np.save(buf, dyn[:, 8:12])
+    orphan = os.path.join(d, "chunk_00000001.npy")
+    with open(orphan, "wb") as fh:
+        fh.write(buf.getvalue())
+    garbage = os.path.join(d, "chunk_00000002.npy")
+    with open(garbage, "wb") as fh:
+        fh.write(b"not an npy")
+    w2 = FeedWriter(d)     # reopen recovers
+    assert w2.total_samples == 12
+    assert os.path.exists(garbage + ".corrupt")
+    assert not os.path.exists(garbage)
+    r = FeedReader(d)
+    np.testing.assert_array_equal(
+        np.asarray(r.epoch().dyn, dtype=np.float32), dyn[:, :12])
+    # the adopted chunk's CRC was computed from the real bytes
+    rec = r.manifest["chunks"][1]
+    with open(orphan, "rb") as fh:
+        assert zlib.crc32(fh.read()) == rec["crc"]
+
+
+def test_feed_corrupt_committed_chunk_raises(tmp_path):
+    ep = synth_arc_epoch(nf=NF, nt=8, seed=1)
+    d, w = _feed_from_epoch(tmp_path, ep)
+    w.append(np.asarray(ep.dyn)[:, :8])
+    path = os.path.join(d, "chunk_00000000.npy")
+    with open(path, "r+b") as fh:
+        fh.seek(120)
+        fh.write(b"\xff\xff\xff\xff")
+    r = FeedReader(d)
+    with pytest.raises(FeedError):
+        r.read_chunk(r.manifest["chunks"][0])
+    # a non-feed dir fails fast
+    with pytest.raises(FeedError):
+        FeedReader(str(tmp_path / "nope"))
+
+
+def test_chunk_rung_ladder():
+    assert chunk_rung(1) == 8 and chunk_rung(8) == 8
+    assert chunk_rung(9) == 16 and chunk_rung(100) == 128
+    with pytest.raises(ValueError):
+        chunk_rung(0)
+
+
+# ---------------------------------------------------------------------------
+# ring + incremental ACF
+# ---------------------------------------------------------------------------
+
+
+def test_ring_device_matches_host_and_counts_chunk_h2d():
+    rng = np.random.default_rng(0)
+    ring = Ring(6, 12)
+    with obs.tracing() as reg:
+        for c in (3, 1, 12, 5, 7, 30):
+            ring.push(rng.standard_normal((6, c)).astype(np.float32))
+            np.testing.assert_array_equal(
+                np.asarray(ring.window_device()), ring.window_host())
+        h2d = reg.counters()["bytes_h2d"]
+    # every push transferred its rung-padded chunk only (a >window
+    # chunk clips to the window before padding)
+    expect = sum(6 * chunk_rung(min(c, 12)) * 4
+                 for c in (3, 1, 12, 5, 7, 30))
+    assert h2d == expect
+    assert ring.count == 3 + 1 + 12 + 5 + 7 + 30 and ring.full
+
+
+def test_incremental_acf_matches_from_scratch():
+    rng = np.random.default_rng(1)
+    ring = Ring(8, 24)
+    acf = IncrementalACF(24, nlags=10, resync_every=10 ** 9)  # no resync
+    for _ in range(40):
+        c = int(rng.integers(1, 7))
+        chunk = rng.standard_normal((8, c)).astype(np.float32)
+        before = ring.window_host()
+        ring.push(chunk)
+        acf.push(before, ring.window_host(), c)
+    oracle = acf.compute(ring.window_host())
+    drift = np.max(np.abs(acf.cut() - oracle)) / abs(oracle[0])
+    assert drift < 1e-10, drift
+    # halfwidth of white noise decays immediately
+    hw = acf.halfwidth_s(2.0)
+    assert hw is not None and 0.0 <= hw < 4.0
+
+
+def test_preflight_chunk_and_deterministic_mask():
+    good = np.ones((4, 6), dtype=np.float32)
+    assert preflight_chunk(good) == []
+    bad = good.copy()
+    bad[:, :4] = np.nan
+    assert preflight_chunk(bad) == ["nonfinite"]
+    assert preflight_chunk(np.zeros((4, 6))) == ["all_zero"]
+    zb = good.copy()
+    zb[:3] = 0.0
+    assert preflight_chunk(zb) == ["zero_band"]
+    assert preflight_chunk(np.ones((1, 6))) == ["axis_shape"]
+    # masking is chunk-local and deterministic (the crash-replay rule)
+    m1, m2 = mask_chunk(bad), mask_chunk(bad)
+    np.testing.assert_array_equal(m1, m2)
+    assert np.isfinite(m1).all()
+    # non-finite samples took the chunk's own per-channel finite mean
+    np.testing.assert_allclose(m1[:, 0], 1.0)
+    np.testing.assert_array_equal(mask_chunk(np.full((4, 6), np.nan)),
+                                  np.zeros((4, 6), dtype=np.float32))
+
+
+# ---------------------------------------------------------------------------
+# the acceptance gate: warm zero-miss ticks + byte-identical final window
+# ---------------------------------------------------------------------------
+
+
+def test_warm_session_zero_miss_ticks_and_final_window_byte_identity(
+        tmp_path):
+    """ISSUE 15 acceptance: a warmed streaming session shows
+    ``jit_cache_miss == 0`` across >= 10 consecutive ticks, and the
+    final-window fit row is byte-identical to a one-shot batch
+    ``run_pipeline`` over the same completed data."""
+    from scintools_tpu.parallel import run_pipeline
+
+    total = W + 12 * HOP
+    ep = synth_arc_epoch(nf=NF, nt=total, seed=1)
+    d, writer = _feed_from_epoch(tmp_path, ep)
+    dyn = np.asarray(ep.dyn)
+    with obs.tracing() as reg:
+        sess = StreamSession(d, OPTS, window=W, hop=HOP)
+        rows = []
+        i = 0
+        warm_miss_base = None
+        while i < total:
+            writer.append(dyn[:, i:i + HOP])
+            i += HOP
+            rows += sess.poll()
+            if rows and warm_miss_base is None:
+                # first (compiling) tick done: everything after must
+                # execute the one warm window signature
+                warm_miss_base = reg.counters().get("jit_cache_miss", 0)
+        writer.finalize()
+        rows += sess.poll()
+        warm_miss = (reg.counters().get("jit_cache_miss", 0)
+                     - warm_miss_base)
+        warm_ticks = len(rows) - 1
+        assert warm_ticks >= 10, warm_ticks
+        assert warm_miss == 0, (
+            f"{warm_miss} recompiles across {warm_ticks} warm ticks")
+        assert reg.counters()["stream_ticks"] == len(rows)
+        # the final window vs the one-shot batch path over the SAME
+        # completed data (the feed's own batch view)
+        epoch = FeedReader(d).epoch(last=W)
+        cfg = config_from_opts(OPTS)
+        ((_idx, res),) = run_pipeline([epoch], cfg, async_exec=False)
+    want = batch_lane_row(res, 0, cfg.lamsteps)
+    final = [r for r in rows if r.get("final")][-1]
+    assert _rows_same(want, final, want.keys()), (want, final)
+    # tick rows carry the live ACF proxy + window bookkeeping
+    assert final["window_end"] == total and final["window"] == W
+    assert "acf_halfwidth_s" in final
+    assert final["tick_latency_s"] > 0
+
+
+def test_session_masks_bad_chunks_and_counts_quarantine(tmp_path):
+    ep = synth_arc_epoch(nf=NF, nt=W + 2 * HOP, seed=2)
+    d, writer = _feed_from_epoch(tmp_path, ep)
+    dyn = np.asarray(ep.dyn)
+    with obs.tracing() as reg:
+        sess = StreamSession(d, OPTS, window=W, hop=HOP)
+        i = 0
+        while i < dyn.shape[1]:
+            c = dyn[:, i:i + HOP].copy()
+            if i == HOP:
+                c[:] = np.nan          # a dead chunk mid-stream
+            writer.append(c)
+            i += HOP
+            sess.poll()
+        writer.finalize()
+        rows = sess.poll()
+        counters = reg.counters()
+    assert sess.quarantined.get("nonfinite") == 1
+    assert counters["chunks_quarantined"] >= 1
+    assert counters["chunks_quarantined[nonfinite]"] == 1
+    assert sess.complete
+    # the stream survived: the final row exists and is finite-keyed
+    assert rows and rows[-1]["quarantined_chunks"] >= 1
+
+
+def test_short_finalized_feed_runs_partial_window_fit(tmp_path):
+    nt = 20     # shorter than the window: fixed signature impossible
+    ep = synth_arc_epoch(nf=NF, nt=nt, seed=1)
+    d, writer = _feed_from_epoch(tmp_path, ep)
+    sess = StreamSession(d, OPTS, window=W, hop=HOP)
+    writer.append(np.asarray(ep.dyn))
+    writer.finalize()
+    rows = sess.poll()
+    assert sess.complete
+    (row,) = rows
+    assert row["final"] and row.get("partial_window")
+    assert row["window_end"] == nt
+    assert any(k in row for k in ("betaeta", "eta"))
+
+
+def test_session_restore_replays_ring_and_continues(tmp_path):
+    """Crash-recovery replay: a new session restored from the durable
+    cursor rebuilds the identical ring (chunk-local masking included)
+    and continues ticking exactly where the dead one stopped."""
+    ep = synth_arc_epoch(nf=NF, nt=W + 4 * HOP, seed=2)
+    d, writer = _feed_from_epoch(tmp_path, ep)
+    dyn = np.asarray(ep.dyn)
+    s1 = StreamSession(d, OPTS, window=W, hop=HOP)
+    i = 0
+    while i < dyn.shape[1]:
+        c = dyn[:, i:i + HOP].copy()
+        if i == 2 * HOP:
+            c[:] = np.nan        # masked chunk must replay identically
+        writer.append(c)
+        i += HOP
+        s1.poll()
+    state = s1.state()
+    s2 = StreamSession(d, OPTS, window=W, hop=HOP)
+    s2.restore(state)
+    np.testing.assert_array_equal(s2.ring.window_host(),
+                                  s1.ring.window_host())
+    assert (s2.consumed, s2.tick_seq) == (s1.consumed, s1.tick_seq)
+    assert s2.quarantined == s1.quarantined
+    writer.finalize()
+    (r1,) = s1.poll()
+    (r2,) = s2.poll()
+    assert _rows_same(r1, r2, [k for k in ("tau", "dnu", "betaeta")
+                               if k in r1])
+
+
+def test_session_rejects_bad_geometry_and_mesh_knobs(tmp_path):
+    ep = synth_arc_epoch(nf=NF, nt=16, seed=1)
+    d, _w = _feed_from_epoch(tmp_path, ep)
+    with pytest.raises(ValueError):
+        StreamSession(d, OPTS, window=4, hop=1)       # window too small
+    with pytest.raises(ValueError):
+        StreamSession(d, OPTS, window=W, hop=0)
+    with pytest.raises(ValueError):
+        StreamSession(d, OPTS, window=W, hop=W + 1)
+    with pytest.raises(ValueError):
+        StreamSession(d, dict(OPTS, arc_stack=True), window=W, hop=HOP)
+
+
+# ---------------------------------------------------------------------------
+# versioned-row READ policy (ROADMAP item 5 open tail)
+# ---------------------------------------------------------------------------
+
+
+def test_versioned_rows_resolve_newest_wins_across_planes(tmp_path):
+    """put_versioned keys resolve newest-wins even when versions span
+    the segment plane AND the row-file plane (a plane='rows' producer
+    run), while unstamped write-once rows keep the legacy
+    row-file-wins rule."""
+    d = str(tmp_path / "store")
+    seg = ResultsStore(d, plane="segment", flush_rows=4)
+    seg.put_versioned("k", {"name": "v1", "tau": 1.0})
+    seg.flush()
+    # a later run on the ROWS plane advances the same key
+    rows = ResultsStore(d, plane="rows")
+    rows.put_versioned("k", {"name": "v2", "tau": 2.0})
+    assert ResultsStore(d).get("k")["name"] == "v2"
+    # ...and a newer segment version beats the stale row file
+    seg2 = ResultsStore(d, plane="segment", flush_rows=4)
+    seg2.put_versioned("k", {"name": "v3", "tau": 3.0})
+    seg2.flush()
+    merged = ResultsStore(d)
+    assert merged.get("k")["name"] == "v3"
+    items = dict(merged.iter_items())
+    assert items["k"]["name"] == "v3"
+    # unstamped duplicate: row file wins as before
+    seg3 = ResultsStore(d, plane="segment", flush_rows=4)
+    seg3.put_new_buffered("w", {"name": "seg-w"})
+    seg3.flush()
+    rows.put("w", {"name": "row-w"})
+    fresh = ResultsStore(d)
+    assert fresh.get("w")["name"] == "row-w"
+    assert dict(fresh.iter_items())["w"]["name"] == "row-w"
+    # a buffered (unflushed) version supersedes everything sealed
+    seg4 = ResultsStore(d, plane="segment", flush_rows=100)
+    seg4.put_versioned("k", {"name": "v4"})
+    assert seg4.get("k")["name"] == "v4"
+
+
+def test_export_latest_only_collapses_version_series(tmp_path):
+    d = str(tmp_path / "store")
+    st = ResultsStore(d, plane="segment", flush_rows=100)
+    base = dict(mjd=60000, freq=1400.0, bw=16.0, tobs=320.0, dt=10.0,
+                df=0.5, tau=1.0, tauerr=0.1)
+    for i, end in enumerate((32, 36, 40)):
+        st.put_versioned(f"job.w{end:09d}",
+                         dict(base, name=f"f@w{end}", tau=1.0 + i),
+                         series="job")
+    st.put_versioned("job.live", dict(base, name="f@live", tau=3.0),
+                     series="job")
+    st.put_new_buffered("other", dict(base, name="batch-row"))
+    st.flush()
+    out_all = str(tmp_path / "all.csv")
+    out_latest = str(tmp_path / "latest.csv")
+    assert st.export_csv(out_all) == 5
+    assert st.export_csv(out_latest, latest_only=True) == 2
+    text = open(out_latest).read()
+    assert "batch-row" in text and "f@live" in text
+    assert "f@w32" not in text
+    # internal version columns never leak into either schema
+    assert "_v" not in open(out_all).read()
+    n_full = st.export_csv(str(tmp_path / "full.csv"), full=True,
+                           latest_only=True)
+    assert n_full == 2
+    header = open(str(tmp_path / "full.csv")).readline()
+    assert "_series" not in header and "_v" not in header
+
+
+# ---------------------------------------------------------------------------
+# the serve `stream` job kind
+# ---------------------------------------------------------------------------
+
+
+def test_submit_stream_validation_and_identity(tmp_path):
+    ep = synth_arc_epoch(nf=NF, nt=16, seed=1)
+    d, _w = _feed_from_epoch(tmp_path, ep)
+    q = JobQueue(str(tmp_path / "q"))
+    with pytest.raises(FeedError):
+        q.submit_stream(str(tmp_path / "missing"), OPTS)
+    with pytest.raises(ValueError):
+        q.submit_stream(d, OPTS, window=4)
+    with pytest.raises(ValueError):
+        q.submit_stream(d, OPTS, window=W, hop=0)
+    with pytest.raises(ValueError):
+        q.submit_stream(d, dict(OPTS, arc_stack=True), window=W)
+    with pytest.raises(ValueError):
+        q.submit_stream(d, dict(OPTS, synthetic={"kind": "acf"}),
+                        window=W)
+    jid, st = q.submit_stream(d, OPTS, window=W, hop=HOP)
+    assert st == "submitted"
+    assert q.submit_stream(d, OPTS, window=W, hop=HOP) == (jid, "queued")
+    # window geometry IS identity (different window = different results)
+    jid2, st2 = q.submit_stream(d, OPTS, window=W, hop=HOP * 2)
+    assert st2 == "submitted" and jid2 != jid
+    (job,) = [j for j in q.jobs("queued") if j.id == jid]
+    assert job.lane == "interactive"
+    assert job.cfg["stream"]["window"] == W
+    assert job.est_bytes == NF * W * 4
+    assert job.file.startswith("stream:")
+
+
+def test_release_never_resurrects_a_terminal_job(tmp_path):
+    """At-least-once race: a stalled worker's registration is reaped,
+    re-claimed and COMPLETED elsewhere; the stalled worker's late
+    release must not resurrect the done job back into queued/ (the
+    same done-wins rule fail() applies)."""
+    ep = synth_arc_epoch(nf=NF, nt=16, seed=1)
+    d, _w = _feed_from_epoch(tmp_path, ep)
+    q = JobQueue(str(tmp_path / "q"))
+    jid, _ = q.submit_stream(d, OPTS, window=W, hop=HOP)
+    (stale,) = q.claim("A", n=1, lease_s=0.1, now=1000.0)
+    # the lease expires, the reap requeues, B claims and completes
+    q.reap_expired(now=2000.0)
+    (held,) = q.claim("B", n=1, lease_s=30.0, now=2010.0)  # past backoff
+    q.complete(held)
+    assert q.state_of(jid) == "done"
+    q.release(stale)                       # A's late handback
+    assert q.state_of(jid) == "done"
+    assert q.counts()["queued"] == 0
+    # failed wins the same way
+    jid2, _ = q.submit_stream(d, OPTS, window=W, hop=HOP * 2)
+    (s2,) = q.claim("A", n=1, lease_s=0.1, now=3000.0)
+    q.reap_expired(now=4000.0)
+    (h2,) = q.claim("B", n=1, lease_s=30.0, now=4010.0)
+    q.fail(h2, "boom", retryable=False)
+    q.release(s2)
+    assert q.state_of(jid2) == "failed"
+    assert q.counts()["queued"] == 0
+
+
+def test_worker_serves_stream_job_end_to_end(tmp_path):
+    """Claim -> register -> tick between polls -> versioned rows
+    (history + live) -> complete on finalize; exports collapse with
+    --latest-only."""
+    total = W + 3 * HOP
+    ep = synth_arc_epoch(nf=NF, nt=total, seed=1)
+    d, writer = _feed_from_epoch(tmp_path, ep)
+    dyn = np.asarray(ep.dyn)
+    qdir = str(tmp_path / "q")
+    with obs.tracing() as reg:
+        client = SurveyClient(qdir)
+        rec = client.submit_stream(d, OPTS, window=W, hop=HOP)
+        assert rec["status"] == "submitted"
+        jid = rec["job"]
+        worker = ServeWorker(client.queue, batch_size=4,
+                             max_wait_s=0.0, poll_s=0.01,
+                             heartbeat_s=0)
+        i = 0
+        while i < total:
+            writer.append(dyn[:, i:i + HOP])
+            i += HOP
+            worker.poll_once()
+        writer.finalize()
+        worker.poll_once()
+        counters = reg.counters()
+    q = client.queue
+    assert q.state_of(jid) == "done"
+    assert worker.stats["jobs_done"] == 1
+    assert worker.stats["stream_ticks"] >= 2
+    assert counters["serve_stream_jobs"] == 1
+    assert counters["stream_ticks"] == worker.stats["stream_ticks"]
+    live = q.results.get(f"{jid}.live")
+    assert live and live["final"] and live["window_end"] == total
+    hist = sorted(k for k in q.results.keys()
+                  if k.startswith(f"{jid}.w"))
+    assert len(hist) >= 2
+    # history keys encode the window end; each resolves to its row
+    for k in hist:
+        assert q.results.get(k)["window_end"] == int(k.split(".w")[-1])
+    n_latest = client.export_csv(str(tmp_path / "latest.csv"),
+                                 latest_only=True)
+    assert n_latest == 1
+
+
+def test_worker_releases_stream_on_idle_exit(tmp_path):
+    """An idle-exiting worker hands its unfinished registration back
+    (attempts untouched, claimable immediately) with the cursor
+    persisted — the scale-down path."""
+    ep = synth_arc_epoch(nf=NF, nt=W + HOP, seed=1)
+    d, writer = _feed_from_epoch(tmp_path, ep)
+    writer.append(np.asarray(ep.dyn))   # feed stalls after this
+    q = JobQueue(str(tmp_path / "q"))
+    jid, _ = q.submit_stream(d, OPTS, window=W, hop=HOP)
+    worker = ServeWorker(q, batch_size=4, max_wait_s=0.0, poll_s=0.01,
+                         heartbeat_s=0)
+    worker.run(idle_exit_s=0.05, exit_on_drain=False)
+    assert worker.stats["stream_ticks"] >= 1      # it did tick first
+    assert q.state_of(jid) == "queued"            # released, not failed
+    job = q.get(jid)
+    assert job.attempts == 0 and job.transients == 0
+    meta = q.results.get_meta(f"stream.{jid}")
+    assert meta and meta["consumed"] == W + HOP
+    # a second worker resumes from the cursor and completes
+    writer.finalize()
+    w2 = ServeWorker(q, batch_size=4, max_wait_s=0.0, poll_s=0.01,
+                     heartbeat_s=0)
+    w2.run(idle_exit_s=1.0, exit_on_drain=False)
+    assert q.state_of(jid) == "done"
+
+
+def test_stream_heartbeat_and_fleet_render(tmp_path):
+    # untraced-worker path: the registry must be empty so the beat's
+    # stats->counter mapping (not a stale traced value) is what lands
+    obs.get_registry().reset()
+    ep = synth_arc_epoch(nf=NF, nt=W, seed=1)
+    d, writer = _feed_from_epoch(tmp_path, ep)
+    writer.append(np.asarray(ep.dyn))
+    q = JobQueue(str(tmp_path / "q"))
+    q.submit_stream(d, OPTS, window=W, hop=HOP)
+    worker = ServeWorker(q, batch_size=4, max_wait_s=0.0, poll_s=0.01,
+                         heartbeat_s=0.001)
+    worker.poll_once()
+    worker._beat(force=True)
+    (hb,) = fleet.read_heartbeats(os.path.join(q.dir, "heartbeat"))
+    assert hb["streams"]
+    (srec,) = hb["streams"].values()
+    assert srec["ticks"] >= 1 and srec["window"] == W
+    # untraced workers still publish tick totals via the stats mapping
+    assert hb["counters"]["stream_ticks"] == srec["ticks"]
+    rollup = fleet.fleet_rollup([hb])
+    text = fleet.render_fleet(rollup)
+    assert "stream " in text and "ticks =" in text
+    worker._release_streams()
+
+
+def test_trace_report_streams_section(tmp_path):
+    from scintools_tpu.obs.report import render, stream_section
+
+    counters = {"stream_ticks": 7, "serve_stream_jobs": 1,
+                "chunks_quarantined": 2,
+                "chunks_quarantined[nonfinite]": 2}
+    gauges = {"stream_lag_s": 0.5, "stream_lag_s[obs1]": 0.5}
+    sec = stream_section(counters, gauges)
+    assert sec["stream_ticks"] == 7
+    assert sec["quarantine_reasons"] == {"nonfinite": 2}
+    assert sec["feed_lag_s"] == {"obs1": 0.5}
+    text = render({}, counters, gauges)
+    assert "streams (live feeds" in text
+    assert "stream_ticks = 7" in text
+    assert "chunks_quarantined = 2 (nonfinite=2)" in text
+    assert stream_section({}, {}) is None
+
+
+def test_submit_stream_cli(tmp_path, capsys):
+    from scintools_tpu.cli import main as cli_main
+
+    ep = synth_arc_epoch(nf=NF, nt=16, seed=1)
+    d, _w = _feed_from_epoch(tmp_path, ep)
+    qdir = str(tmp_path / "q")
+    rc = cli_main(["submit", qdir, "--stream", d, "--stream-window",
+                   str(W), "--stream-hop", str(HOP), "--lamsteps"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["submitted"] == 1
+    (rec,) = out["jobs"]
+    assert rec["status"] == "submitted"
+    # dedup on resubmit
+    rc = cli_main(["submit", qdir, "--stream", d, "--stream-window",
+                   str(W), "--stream-hop", str(HOP), "--lamsteps"])
+    assert rc == 0
+    out2 = json.loads(capsys.readouterr().out.strip())
+    assert out2["deduped"] == 1 and out2["jobs"][0]["job"] == rec["job"]
+    # a bad geometry fails fast with a usage error, not a traceback
+    with pytest.raises(SystemExit):
+        cli_main(["submit", qdir, "--stream", d, "--stream-window", "2"])
+    # streams take no files
+    with pytest.raises(SystemExit):
+        cli_main(["submit", qdir, "--stream", d, "somefile"])
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL crash recovery (satellite): resume from the manifest with no
+# duplicate/lost versioned rows and a causally-linked trace
+# ---------------------------------------------------------------------------
+
+
+_STREAM_WORKER_SRC = """
+import os, sys
+from scintools_tpu import obs
+from scintools_tpu.serve import JobQueue, ServeWorker
+
+qdir, trace, mode = sys.argv[1], sys.argv[2], sys.argv[3]
+obs.enable(jsonl=trace)
+worker = ServeWorker(JobQueue(qdir, backoff_s=0.05), batch_size=1,
+                     max_wait_s=0.0, lease_s=1.0, poll_s=0.05,
+                     heartbeat_s=0,
+                     worker_id="%s:" + str(os.getpid()))
+worker.run(idle_exit_s=None if mode == "hang" else 30.0,
+           exit_on_drain=(mode != "hang"))
+obs.disable()
+"""
+
+
+def _spawn_stream_worker(qdir, trace, mode, tag):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, "-c", _STREAM_WORKER_SRC % tag, qdir, trace,
+         mode],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+
+
+def test_sigkill_streaming_worker_resumes_from_manifest(tmp_path):
+    """SIGKILL the streaming worker mid-observation; a second worker
+    reaps the lease, restores the session from the durable cursor +
+    feed manifest, finishes the observation — no duplicate or lost
+    versioned rows, and the trace chain stays causally linked across
+    the three pids (PR 10 contract)."""
+    total = W + 4 * HOP
+    ep = synth_arc_epoch(nf=NF, nt=total, seed=1)
+    d, writer = _feed_from_epoch(tmp_path, ep, subdir="feed")
+    dyn = np.asarray(ep.dyn)
+    qdir = str(tmp_path / "q")
+    os.makedirs(qdir, exist_ok=True)
+    submit_trace = os.path.join(qdir, "submit.jsonl")
+    with obs.tracing(jsonl=submit_trace):
+        client = SurveyClient(qdir)
+        rec = client.submit_stream(d, OPTS, window=W, hop=HOP)
+        assert rec["status"] == "submitted"
+    jid = rec["job"]
+    # first half of the observation arrives
+    i = 0
+    while i < W + HOP:
+        writer.append(dyn[:, i:i + HOP])
+        i += HOP
+    q = JobQueue(qdir)
+    a = _spawn_stream_worker(qdir, os.path.join(qdir, "wa.jsonl"),
+                             "hang", "A")
+    try:
+        # wait until at least one tick row is DURABLE, then kill mid-
+        # stream (between a flushed tick and the next chunk)
+        deadline = time.time() + 120.0
+        while time.time() < deadline \
+                and q.results.get(f"{jid}.live") is None:
+            assert a.poll() is None, ("worker A exited early:\n"
+                                      + (a.stdout.read() or ""))
+            time.sleep(0.05)
+        assert q.results.get(f"{jid}.live") is not None, \
+            "worker A never published a tick"
+        os.kill(a.pid, signal.SIGKILL)
+        a.wait(timeout=30)
+    finally:
+        if a.poll() is None:
+            a.kill()
+    # the orphaned registration is leased (or mid-requeue if A's first
+    # compiling tick outlived the deliberately tiny test lease) —
+    # never terminal
+    assert q.state_of(jid) in ("leased", "queued")
+    ticks_before = q.results.get_meta(f"stream.{jid}")["tick_seq"]
+    assert ticks_before >= 1
+    # the rest of the observation lands while no worker is alive
+    while i < total:
+        writer.append(dyn[:, i:i + HOP])
+        i += HOP
+    writer.finalize()
+    b = _spawn_stream_worker(qdir, os.path.join(qdir, "wb.jsonl"),
+                             "ok", "B")
+    try:
+        out_b, _ = b.communicate(timeout=180)
+    except subprocess.TimeoutExpired:
+        b.kill()
+        pytest.fail("worker B never finished the stream")
+    assert b.returncode == 0, out_b
+    assert q.state_of(jid) == "done"
+    # versioned rows: one per expected window end, none lost, the
+    # duplicate republish of A's last tick resolved newest-wins
+    hist = sorted(k for k in q.results.keys()
+                  if k.startswith(f"{jid}.w"))
+    ends = {int(k.split(".w")[-1]) for k in hist}
+    assert ends == set(range(W, total + 1, HOP)), ends
+    for k in hist:
+        assert q.results.get(k)["window_end"] == int(k.split(".w")[-1])
+    assert q.results.get(f"{jid}.live")["window_end"] == total
+    # the trace chain: one trace id, >= 3 pids (submitter, A, B), the
+    # requeue hop stitched across the SIGKILL, no orphan hops
+    events, _warnings = obs.load_trace_files(
+        [os.path.join(qdir, "*.jsonl")])
+    traces = fleet.assemble_traces(events)
+    assert len(traces) == 1
+    ((_tid, t),) = traces.items()
+    names = t["names"]
+    for hop_name in ("job.submit", "job.claim", "job.tick",
+                     "job.requeue", "job.row", "job.complete"):
+        assert hop_name in names, (hop_name, names)
+    assert len(t["pids"]) >= 3
+    assert t["orphans"] == []
+
+
+# ---------------------------------------------------------------------------
+# bench lane smoke
+# ---------------------------------------------------------------------------
+
+
+def test_bench_stream_lane_smoke(monkeypatch):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_stream_smoke", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    rec = bench.stream_throughput(n_ticks=3, window=W, nf=NF)
+    assert rec["ticks"] >= 3
+    assert rec["tick_latency_s"]["p50"] > 0
+    assert rec["warm_jit_cache_miss"] == 0
+    assert rec["stream_lag_s"] is not None
+    assert rec["quarantined_chunks"] == 0
