@@ -81,7 +81,11 @@ through a live feed + StreamSession (ISSUE 15), recording per-tick
 and the warm-tick ``jit_cache_miss`` delta (contract: 0) at
 SCINT_BENCH_STREAM_TICKS ticks (default 24) over a
 SCINT_BENCH_STREAM_WINDOW x SCINT_BENCH_STREAM_NF window; attached as
-``stream_lane``).
+``stream_lane``), SCINT_BENCH_SLO ("1" = ALSO run the SLO-plane
+overhead lane (ISSUE 16) — asserting the tracing-disabled observe hot
+path stays one-flag-check-grade, and recording the armed judgment
+cycle's p50/max wall plus the fleet fold cost per merged snapshot over
+SCINT_BENCH_SLO_CYCLES cycles, default 50; attached as ``slo_lane``).
 """
 
 import json
@@ -867,6 +871,97 @@ def stream_throughput(n_ticks: int | None = None,
     return rec
 
 
+def slo_overhead(cycles: int | None = None) -> dict:
+    """The SLO-plane overhead lane (``SCINT_BENCH_SLO=1``): the cost
+    of judging (ISSUE 16) must be invisible next to the cost of
+    measuring.  Record fields:
+
+    * ``disarmed_ns_per_call`` — the hot-path cost of the worker's new
+      per-job/per-lane ``obs.observe`` stamps with tracing DISABLED,
+      beside ``flag_check_ns_per_call`` (a bare ``obs.enabled()``
+      call, the one-flag-check reference).  The lane ASSERTS the
+      disarmed ratio stays one-flag-check-grade — an SLO plane that
+      taxes un-traced workers is a regression, not a feature;
+    * ``eval_cycle_ms`` — one full armed judgment cycle (registry
+      histogram snapshot -> burn-rate windows -> alert state machine
+      persist) at heartbeat cadence, p50/max over
+      ``SCINT_BENCH_SLO_CYCLES`` cycles (default 50);
+    * ``fold_us_per_snapshot`` — the fleet-scope associative fold
+      (``merge_slo_snapshots``) per merged worker snapshot.
+    """
+    _maybe_enable_trace()
+    import shutil
+    import tempfile
+
+    from scintools_tpu import obs
+    from scintools_tpu.obs import slo
+    from scintools_tpu.utils.store import ResultsStore
+
+    n_cycles = int(cycles if cycles is not None
+                   else _env_int("SCINT_BENCH_SLO_CYCLES", 50))
+    rec: dict = {"cycles": n_cycles}
+
+    # disarmed hot path: tracing off, every observe is one flag check
+    obs.disable()
+    calls = 200_000
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        obs.enabled()
+    flag_ns = (time.perf_counter() - t0) / calls * 1e9
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        obs.observe("queue_wait_s[bulk]", 0.001)
+    disarmed_ns = (time.perf_counter() - t0) / calls * 1e9
+    rec["flag_check_ns_per_call"] = round(flag_ns, 1)
+    rec["disarmed_ns_per_call"] = round(disarmed_ns, 1)
+    ratio = disarmed_ns / flag_ns if flag_ns else None
+    rec["disarmed_vs_flag_check"] = (round(ratio, 1)
+                                     if ratio is not None else None)
+    # generous noise margin; a dict lookup or lock sneaking into the
+    # disarmed path shows up as 100x+, not 25x
+    assert ratio is None or ratio < 25, (
+        f"disarmed SLO observe is {ratio:.0f}x a flag check — "
+        "the un-traced hot path grew real work")
+
+    specs = [slo.validate_slo_spec(s) for s in (
+        {"name": "feed-fresh", "kind": "stream_lag_s", "key": "feed0",
+         "threshold_s": 2.0},
+        {"name": "bulk-wait", "kind": "queue_wait_s", "key": "bulk",
+         "threshold_s": 8.0},
+    )]
+    qdir = tempfile.mkdtemp(prefix="scint_bench_slo_")
+    try:
+        obs.enable()
+        for i in range(4096):
+            obs.observe("stream_lag_s[feed0]", 0.01 * (i % 7 + 1))
+            obs.observe("queue_wait_s[bulk]", 0.02 * (i % 5 + 1))
+        ev = slo.SloEvaluator(specs)
+        engine = slo.AlertEngine(
+            ResultsStore(os.path.join(qdir, "results")))
+        walls = []
+        now = time.time()
+        for c in range(n_cycles):
+            t0 = time.perf_counter()
+            ev.observe(obs.get_registry().hists(), now=now + c)
+            engine.step(ev.statuses(now=now + c), now=now + c)
+            walls.append(time.perf_counter() - t0)
+        walls.sort()
+        rec["eval_cycle_ms"] = {
+            "p50": round(walls[len(walls) // 2] * 1e3, 3),
+            "max": round(walls[-1] * 1e3, 3)}
+        wire = ev.wire(now=now + n_cycles)
+        t0 = time.perf_counter()
+        folds = 512
+        slo.merge_slo_snapshots([wire] * folds)
+        rec["fold_us_per_snapshot"] = round(
+            (time.perf_counter() - t0) / folds * 1e6, 2)
+    finally:
+        obs.disable()
+        obs.reset()
+        shutil.rmtree(qdir, ignore_errors=True)
+    return rec
+
+
 def results_plane_throughput(n_rows: int | None = None,
                              flush_rows: int | None = None,
                              baseline: bool = True) -> dict:
@@ -1323,6 +1418,17 @@ def main():
         except Exception as e:
             stream_holder["rec"] = {"error": f"{type(e).__name__}: {e}"}
 
+    # SLO-plane overhead lane (SCINT_BENCH_SLO=1): host-only judgment
+    # cost (ISSUE 16) — runs with the other pre-headline lanes so a
+    # wedged chip can never mask it; failures land as {"error": ...}
+    slo_holder: dict = {}
+    if os.environ.get("SCINT_BENCH_SLO",
+                      "0").strip().lower() == "1":
+        try:
+            slo_holder["rec"] = slo_overhead()
+        except Exception as e:
+            slo_holder["rec"] = {"error": f"{type(e).__name__}: {e}"}
+
     def device_record(res: dict, probe: dict, is_fallback: bool = False,
                       batch_chunk: int | None = None, **extra) -> dict:
         rate = res["rate"]
@@ -1364,6 +1470,9 @@ def main():
         st_lane = stream_holder.get("rec")
         if st_lane:
             rec["stream_lane"] = st_lane
+        sl_lane = slo_holder.get("rec")
+        if sl_lane:
+            rec["slo_lane"] = sl_lane
         rec["fused"] = bool(res.get("fused", False))
         fl = res.get("fused_lane")
         if fl:

@@ -1537,10 +1537,69 @@ def cmd_fleet_status(args) -> int:
     rollup = fleet_mod.fleet_rollup(heartbeats, events,
                                     depth=extras.get("depth"))
     rollup.update(extras)
+    fleet_mod.attach_slo_status(rollup, heartbeats)
     if args.json:
         print(json.dumps({"queue": args.queue, **rollup}, default=str))
     else:
         print(fleet_mod.render_fleet(rollup))
+    return 0
+
+
+def cmd_alerts(args) -> int:
+    """Durable SLO alert rows of a serve queue directory (obs/slo,
+    docs/slo.md): list them firing-first, ``--ack`` one (a versioned
+    newest-wins write that survives worker crashes), or print a row's
+    retained ``--history`` of state transitions."""
+    import os
+
+    from .obs import slo as slo_mod
+    from .utils.store import ResultsStore
+
+    qdir = _existing_queue_dir(args.queue)
+    if args.ack:
+        store = ResultsStore(os.path.join(qdir, "results"))
+        engine = slo_mod.AlertEngine(store)
+        row = engine.ack(args.ack)
+        if row is None:
+            print(f"{args.ack}: no such alert row", file=sys.stderr)
+            return 1
+        print(f"{args.ack}: acked (state = {row['state']})")
+        return 0
+    rows = slo_mod.read_alerts(qdir)
+    if args.history:
+        match = [r for r in rows if r.get("slo") == args.history]
+        if not match:
+            print(f"{args.history}: no such alert row", file=sys.stderr)
+            return 1
+        row = match[0]
+        if args.json:
+            print(json.dumps(row, default=str))
+            return 0
+        print(f"{row['slo']}: state = {row['state']}"
+              + (" (acked)" if row.get("ack") else ""))
+        for ts, state in row.get("history", ()):
+            print(f"  {float(ts):.3f}  {state}")
+        return 0
+    if args.json:
+        print(json.dumps({"queue": args.queue, "alerts": rows},
+                         default=str))
+        return 0
+    if not rows:
+        print("(no alert rows — no slo.json declared, or the plane "
+              "has not evaluated yet)")
+        return 0
+    for row in rows:
+        line = f"{row['slo']}: {row['state']}"
+        if row.get("metric"):
+            line += f"  [{row['metric']}]"
+        bf, bs = row.get("burn_fast"), row.get("burn_slow")
+        if isinstance(bf, (int, float)) and isinstance(bs, (int, float)):
+            line += f"  burn fast/slow = {bf:g}/{bs:g}"
+        if row.get("ack"):
+            line += "  (acked)"
+        if row.get("trace_id"):
+            line += f"  trace={row['trace_id']}"
+        print(line)
     return 0
 
 
@@ -2182,6 +2241,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="machine-readable rollup (the admission-"
                         "control input) instead of the table")
     r.set_defaults(fn=cmd_fleet_status)
+
+    q = sub.add_parser(
+        "alerts",
+        help="durable SLO alerts of a serve queue directory: list the "
+             "newest-wins rows, acknowledge one, or show a row's "
+             "transition history (docs/slo.md)")
+    q.add_argument("queue", help="serve queue dir holding results/ "
+                                 "alert rows and slo.json")
+    q.add_argument("--ack", default=None, metavar="SLO",
+                   help="acknowledge the named SLO's alert (a durable "
+                        "newest-wins write; the row keeps firing but "
+                        "is marked acked in every readout)")
+    q.add_argument("--history", default=None, metavar="SLO",
+                   help="print the named alert's retained transition "
+                        "history ([ts, state] pairs, newest last)")
+    q.add_argument("--json", action="store_true",
+                   help="machine-readable rows instead of the table")
+    q.set_defaults(fn=cmd_alerts)
     return p
 
 

@@ -205,7 +205,11 @@ KNOWN_SITES = ("driver.chunk_execute", "driver.admit_chunk",
                "schedule.prefetch",
                "compile_cache.load", "queue.claim_rename",
                "worker.load", "worker.batch_execute", "worker.poll",
-               "pool.spawn", "pool.drain")
+               "pool.spawn", "pool.drain",
+               # blocks StreamSession.poll's consumption loop (lag
+               # still sampled): the injected freshness breach the
+               # SLO smoke gate drives (ISSUE 16)
+               "stream.poll")
 
 # site -> FaultSpec.  EMPTY in production: check()'s disarmed cost is
 # the one dict lookup the acceptance criteria demand.  Armed only by

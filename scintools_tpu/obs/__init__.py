@@ -27,9 +27,9 @@ from .core import (Registry, counters, disable,  # noqa: F401
                    get_registry, hist_summaries, inc, observe,
                    render_summary, reset, span, summary, traced, tracing)
 from .fleet import (HeartbeatWriter, assemble_traces,  # noqa: F401
-                    backpressure, fleet_report, fleet_rollup,
-                    heartbeat_stale, merge_heartbeats, new_trace_id,
-                    read_heartbeats, render_fleet)
+                    attach_slo_status, backpressure, fleet_report,
+                    fleet_rollup, heartbeat_stale, merge_heartbeats,
+                    new_trace_id, read_heartbeats, render_fleet)
 from .hist import Hist, merge_hist_dicts  # noqa: F401
 from .jax_helpers import (bytes_of, fence,  # noqa: F401
                           instrument_jit, xla_cost_analysis)
@@ -38,5 +38,9 @@ from .report import (aggregate, catalog_section,  # noqa: F401
                      filter_events, load_events, load_trace_files,
                      measured_roofline, parse_duration, parse_when,
                      reliability_section, render, report, report_many,
-                     serve_section)
+                     serve_section, slo_section)
+from .slo import (AlertEngine, SloEvaluator, alert_key,  # noqa: F401
+                  fleet_statuses, linear_trend, load_slos,
+                  merge_slo_snapshots, metric_name, predict_value,
+                  read_alerts, slo_path, validate_slo_spec)
 from .sinks import JsonlSink, LogSink  # noqa: F401

@@ -349,11 +349,16 @@ def merge_heartbeats(heartbeats, now: float | None = None) -> dict:
         if elapsed and elapsed > 0 and isinstance(done, (int, float)):
             drain += max(float(done), 0.0) / float(elapsed)
     depth = gauges.get("queue_depth")
+    # per-worker SLO window deltas (obs/slo.py — ISSUE 16) fold by
+    # elementwise addition, like the histograms they were cut from
+    from .slo import merge_slo_snapshots
+
     return {"workers": len(hbs),
             "stale_workers": stale,
             "counters": counters,
             "hists": {n: h.summary() for n, h in sorted(hists.items())},
             "gauges": gauges,
+            "slo": merge_slo_snapshots(hb.get("slo") for hb in hbs),
             "drain_rate_per_s": round(drain, 6),
             "depth": depth}
 
@@ -420,6 +425,22 @@ def queue_extras(directory: str) -> dict:
         if pool is not None:
             out["pool"] = pool
     except OSError:  # fault-ok: snapshot is advisory
+        pass
+    # declared SLO registry + durable alert rows (obs/slo.py — ISSUE
+    # 16): present only when the queue declares objectives
+    try:
+        from .slo import load_slos, read_alerts
+
+        alerts = read_alerts(directory)
+        if alerts:
+            out["alerts"] = alerts
+        try:
+            specs = load_slos(directory)
+        except ValueError:  # malformed registry: rollup still renders
+            specs = []
+        if specs:
+            out["slos"] = specs
+    except OSError:  # fault-ok: judgment plane is optional
         pass
     return out
 
@@ -560,6 +581,20 @@ def fleet_rollup(heartbeats, events=(), depth=None,
     return rollup
 
 
+def attach_slo_status(rollup: dict, heartbeats) -> None:
+    """Attach fleet-scope SLO statuses to a rollup that carries a
+    declared registry (``queue_extras``): histogram kinds evaluate the
+    merged heartbeat window deltas — exactly the single-process burn
+    math on the summed counts — and liveness kinds read beat ages."""
+    specs = rollup.get("slos")
+    if not specs:
+        return
+    from .slo import fleet_statuses
+
+    rollup["slo_status"] = fleet_statuses(
+        specs, (rollup.get("merged") or {}).get("slo"), heartbeats)
+
+
 def _fmt_hist(s: dict | None) -> str:
     if not s or not s.get("count"):
         return "-"
@@ -571,6 +606,17 @@ def render_fleet(rollup: dict) -> str:
     """Human rendering of :func:`fleet_rollup` (the ``trace report
     --fleet`` / ``fleet status`` payload)."""
     lines = ["fleet (merged heartbeats + traces):"]
+    alerts = rollup.get("alerts") or []
+    firing = [a for a in alerts if a.get("state") == "firing"]
+    if firing:
+        # the banner an operator must not scroll past: every alert
+        # currently in the firing state, burn context inline
+        lines.append(
+            "  *** ALERTS FIRING: " + ", ".join(
+                f"{a.get('slo')} (burn fast/slow = "
+                f"{a.get('burn_fast')}/{a.get('burn_slow')}"
+                + (", acked" if a.get("ack") else "") + ")"
+                for a in firing) + " ***")
     workers = rollup["workers"]
     if workers:
         for w in workers:
@@ -670,6 +716,30 @@ def render_fleet(rollup: dict) -> str:
             f"{ps.get('stale_replaced', 0)}"
             + (f", last = {pool['last_decision']}"
                if pool.get("last_decision") else ""))
+    slo_rows = rollup.get("slo_status")
+    if slo_rows:
+        lines.append("  slo (error budgets over merged heartbeats):")
+        for st in slo_rows:
+            w = st["windows"]
+            lines.append(
+                f"    {st['slo']} [{st['metric']} <= "
+                f"{st['threshold_s']:g}s @ {st['objective']:g}]: "
+                f"burn fast = {w['fast']['burn']:g} "
+                f"(n={w['fast']['n']}), slow = {w['slow']['burn']:g} "
+                f"(n={w['slow']['n']}), budget remaining = "
+                f"{st['budget_remaining']:g}"
+                + (" BREACH" if st.get("breach") else ""))
+    if alerts:
+        lines.append("  alerts (durable newest-wins rows):")
+        for a in alerts:
+            lines.append(
+                f"    {a.get('slo')}: {a.get('state')}"
+                + (f" since {a.get('since_ts')}"
+                   if a.get("state") in ("pending", "firing")
+                   and a.get("since_ts") else "")
+                + (" acked" if a.get("ack") else "")
+                + (f" trace {a.get('trace_id')}"
+                   if a.get("trace_id") else ""))
     tr = rollup["traces"]
     if tr["count"]:
         lines.append(
@@ -700,4 +770,5 @@ def fleet_report(directory: str, depth=None) -> tuple[str, list]:
         depth = extras.get("depth")
     rollup = fleet_rollup(heartbeats, events, depth=depth)
     rollup.update(extras)
+    attach_slo_status(rollup, heartbeats)
     return render_fleet(rollup), warnings

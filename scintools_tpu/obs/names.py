@@ -67,6 +67,10 @@ COUNTERS = frozenset({
     # registrations, and per-chunk data-quality quarantines (masked,
     # never fatal — reasons in the bracketed family)
     "stream_ticks", "serve_stream_jobs", "chunks_quarantined",
+    # SLO & alerting plane (obs/slo.py — ISSUE 16): scale-ups taken on
+    # the PREDICTED-breach signal (the trend-leading branch, beside the
+    # reactive pool_scale_up backpressure one)
+    "pool_predicted_breach",
 })
 
 # -- gauges (obs.gauge) -----------------------------------------------------
@@ -83,6 +87,9 @@ GAUGES = frozenset({
     # consumer runs behind the feed head (streamed timeline; the
     # per-feed breakdown rides the bracketed family)
     "stream_lag_s",
+    # SLO & alerting plane (obs/slo.py): count of alerts currently in
+    # the firing state (per-SLO burn/budget ride bracketed families)
+    "alerts_firing",
 })
 
 # -- spans (obs.span / obs.traced) ------------------------------------------
@@ -99,6 +106,10 @@ SPANS = frozenset({
     # the --xprof jax.profiler.trace bracket and the on-OOM
     # device_memory_profile snapshot dump
     "devmem.xprof", "devmem.memory_profile",
+    # repo-root bench.py (walked by the lint since ISSUE 16): the
+    # headline measurement's own decomposition spans
+    "bench.baseline_epoch", "bench.h2d", "bench.step.compile",
+    "bench.step.compile.warm", "bench.step.execute",
 })
 
 # dynamic span-name prefixes: obs.span(f"<prefix><runtime part>") — the
@@ -114,6 +125,9 @@ EVENTS = frozenset({
     "job.complete", "job.fail", "job.requeue", "job.poison", "job.tick",
     # bench run correlation root (bench flight records embed the id)
     "bench.run",
+    # alert lifecycle (obs/slo.py AlertEngine — ISSUE 16): one event
+    # per durable state-machine transition, plus operator acks
+    "alert.pending", "alert.firing", "alert.resolved", "alert.ack",
 })
 
 # -- histograms (obs.observe) -----------------------------------------------
@@ -125,6 +139,9 @@ HISTS = frozenset({
     # wall seconds of one sliding-window stream tick (consume ->
     # published row), the SCINT_BENCH_STREAM lane's p50/p95 source
     "tick_latency_s",
+    # submit -> complete wall seconds of one serve job (the end-to-end
+    # latency SLO source; per-lane breakdown rides the family)
+    "job_latency_s",
 })
 
 # -- bracketed families: "<family>[<key>]" ----------------------------------
@@ -150,9 +167,18 @@ FAMILIES = frozenset({
     # per-QoS-lane claim counts (ISSUE 13 weighted-fair claim order)
     "lane_claims",                                  # counter (per lane)
     # streaming ingest plane (ISSUE 15): quarantine reasons and the
-    # per-feed lag breakdown beside the totals above
+    # per-feed lag breakdown beside the totals above — since ISSUE 16
+    # the per-feed lag ALSO feeds a bucket-ladder histogram of the
+    # same family (freshness SLO source, merged via heartbeats)
     "chunks_quarantined",                           # counter (per reason)
-    "stream_lag_s",                                 # gauge (per feed)
+    "stream_lag_s",                                 # gauge+hist (per feed)
+    # SLO & alerting plane (ISSUE 16): per-lane queue-wait and
+    # end-to-end job-latency histograms beside their totals, and the
+    # per-SLO burn/budget gauges the trace-report SLO section reads
+    "queue_wait_s",                                 # hist (per lane)
+    "job_latency_s",                                # hist (per lane)
+    "slo_burn_fast", "slo_burn_slow",               # gauges (per SLO)
+    "slo_budget_remaining",                         # gauge (per SLO)
 })
 
 _SETS = {"inc": COUNTERS, "gauge": GAUGES, "span": SPANS,

@@ -474,6 +474,43 @@ def reliability_section(counters: dict | None,
     return out
 
 
+def slo_section(counters: dict | None, gauges: dict | None = None,
+                events=None) -> dict | None:
+    """SLO readout (obs/slo — ISSUE 16): per-objective fast/slow
+    burn rates and remaining error budget from the AlertEngine's
+    flush-time gauges, the firing-alert count, and — when the raw
+    event stream is available — the alert lifecycle timeline
+    (``alert.pending``/``alert.firing``/``alert.resolved``/
+    ``alert.ack`` events in time order).  None when the trace carries
+    no SLO activity at all — an un-SLO'd run's report is unchanged."""
+    counters = counters or {}
+    gauges = gauges or {}
+    fast = bracketed_values(gauges, "slo_burn_fast[")
+    slow = bracketed_values(gauges, "slo_burn_slow[")
+    budget = bracketed_values(gauges, "slo_budget_remaining[")
+    firing = gauges.get("alerts_firing")
+    transitions = []
+    for ev in events or ():
+        name = ev.get("name", "")
+        if ev.get("kind") == "event" and name.startswith("alert."):
+            transitions.append((ev.get("ts", 0.0), name,
+                                (ev.get("attrs") or {}).get("slo")))
+    if not (fast or slow or budget or transitions or firing):
+        return None
+    slos = {}
+    for name in sorted(set(fast) | set(slow) | set(budget)):
+        slos[name] = {"burn_fast": fast.get(name),
+                      "burn_slow": slow.get(name),
+                      "budget_remaining": budget.get(name)}
+    out: dict = {"slos": slos}
+    if firing is not None:
+        out["alerts_firing"] = int(firing)
+    if transitions:
+        transitions.sort(key=lambda t: t[0])
+        out["alert_timeline"] = transitions
+    return out
+
+
 def render(spans: dict, counters: dict | None = None,
            gauges: dict | None = None, events=None) -> str:
     """Fixed-width per-stage table, longest-total first, then the
@@ -675,6 +712,21 @@ def render(spans: dict, counters: dict | None = None,
                      f"{rel['job_transient_retries']}, "
                      f"store_corrupt_rows = {rel['store_corrupt_rows']}, "
                      f"faults_injected = {rel['faults_injected']}")
+    slo = slo_section(counters, gauges, events)
+    if slo:
+        lines.append("")
+        lines.append("slo (error-budget burn, obs/slo):")
+        if "alerts_firing" in slo:
+            lines.append(f"  alerts_firing = {slo['alerts_firing']}")
+        for name, row in slo["slos"].items():
+            def _b(v):
+                return f"{v:g}" if isinstance(v, (int, float)) else "-"
+            lines.append(f"  {name}: burn fast = {_b(row['burn_fast'])}, "
+                         f"slow = {_b(row['burn_slow'])}, budget "
+                         f"remaining = {_b(row['budget_remaining'])}")
+        for ts, name, slo_name in slo.get("alert_timeline", ()):
+            who = f" ({slo_name})" if slo_name else ""
+            lines.append(f"    {ts:.3f}  {name}{who}")
     if counters:
         lines.append("")
         lines.append("counters:")

@@ -1,0 +1,577 @@
+"""Declared SLOs, error-budget burn rates, and durable alerts
+(ISSUE 16 — the judgment layer over the PR 10/12/15 telemetry stack).
+
+The telemetry planes *measure* (per-feed ``stream_lag_s`` freshness,
+per-lane queue waits, tick latencies — all through the closed
+bucket-ladder histograms of :mod:`~scintools_tpu.obs.hist`); this
+module *judges*: a declarative SLO registry, a multi-window
+error-budget burn-rate evaluator, and durable alert state machines.
+
+Three design rules keep the plane cheap and fleet-exact:
+
+1. **No new sample transport.**  Every latency/freshness SLO evaluates
+   over the histograms the workers already stamp into heartbeats.  The
+   bad/good split at a threshold is PER-BUCKET (a bucket is "bad" when
+   its lower ladder edge is >= the threshold), so classification
+   commutes with :meth:`~scintools_tpu.obs.hist.Hist.merge` — the
+   fleet-scope burn rate is an associative fold of per-worker (bad, n)
+   deltas, equal to the single-process value on the same samples
+   (tier-1 gated, tests/test_slo.py).  The effective threshold rounds
+   UP to the next ladder edge (at most ~41 % — half an octave); pick
+   thresholds on edges (powers of √2) for exactness.
+
+2. **Multi-window burn rates.**  ``burn = (bad/n) / (1 - objective)``:
+   1.0 means the error budget burns exactly at the rate that exhausts
+   it over the window; an alert trips when the FAST window burns at
+   >= ``fast_burn`` (page-grade: minutes to exhaustion) OR the SLOW
+   window at >= ``slow_burn`` (ticket-grade: hours).  Budget remaining
+   is read off the slow window.
+
+3. **Durable alerts.**  One versioned newest-wins row per SLO
+   (``alert.<name>``, the dedup key) in the PR 15 results store:
+   pending → firing → resolved with ``min_hold_s`` hysteresis in BOTH
+   directions, a bounded transition history, and trace-linked context
+   (the ``trace_id`` of the breaching feed/lane job where one exists).
+   Rows survive worker SIGKILL; any process (worker, pool controller,
+   ``scintools-tpu alerts``) reads the same state.
+
+Specs load from ``<queue dir>/slo.json`` (a list of spec dicts, or
+``{"slos": [...]}``) with ``SCINT_SLOS`` env JSON overriding by name —
+validated by :func:`validate_slo_spec` exactly like
+``validate_stream_spec`` gates stream payloads.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from . import core
+from .hist import BOUNDS, Hist, _bucket_index
+
+SLO_FILENAME = "slo.json"
+SLO_VERSION = 1
+
+# freshness/latency kinds evaluate over the bucket-ladder histogram of
+# the same name (per-key series via the bracketed family); "heartbeat"
+# is the liveness kind, evaluated fleet-scope from beat ages instead
+SLO_KINDS = ("stream_lag_s", "queue_wait_s", "job_latency_s",
+             "heartbeat")
+
+DEFAULT_OBJECTIVE = 0.99
+DEFAULT_FAST_WINDOW_S = 300.0
+DEFAULT_SLOW_WINDOW_S = 3600.0
+# Google-style multiwindow multipliers: 14.4x on the fast window pages
+# (budget gone in ~2 % of the slow window), 6x on the slow one tickets
+DEFAULT_FAST_BURN = 14.4
+DEFAULT_SLOW_BURN = 6.0
+DEFAULT_MIN_HOLD_S = 15.0
+
+ALERT_STATES = ("ok", "pending", "firing", "resolved")
+ALERT_HISTORY_LIMIT = 32
+
+
+# ---------------------------------------------------------------------------
+# spec validation + loading
+# ---------------------------------------------------------------------------
+
+def validate_slo_spec(spec: dict) -> dict:
+    """Normalise/validate ONE SLO spec dict — the single rule site
+    shared by ``load_slos`` (file/env), the CLI override path, and the
+    evaluator constructor (mirrors ``validate_stream_spec``).
+
+    Canonical fields: ``name`` (dedup slug), ``kind`` (one of
+    :data:`SLO_KINDS`), ``key`` (feed for ``stream_lag_s``, lane for
+    the queue/job kinds; None = the total series), ``threshold_s``,
+    ``objective``, ``fast_window_s``/``slow_window_s``,
+    ``fast_burn``/``slow_burn``, ``min_hold_s``."""
+    spec = dict(spec or {})
+    name = str(spec.get("name") or "").strip()
+    if not name or any(c.isspace() for c in name):
+        raise ValueError("slo spec needs name=<unique slug, no "
+                         "whitespace> (the alert dedup key)")
+    kind = spec.get("kind")
+    if kind not in SLO_KINDS:
+        raise ValueError(f"slo {name}: kind={kind!r} not in "
+                         f"{SLO_KINDS}")
+    key = spec.get("key")
+    key = None if key in (None, "") else str(key)
+    if key is not None and ("[" in key or "]" in key):
+        raise ValueError(f"slo {name}: key={key!r} may not contain "
+                         "brackets (it becomes family[key])")
+    try:
+        threshold = float(spec.get("threshold_s"))
+    except (TypeError, ValueError):
+        raise ValueError(f"slo {name}: threshold_s="
+                         f"{spec.get('threshold_s')!r} is not a number")
+    if not threshold > 0.0:
+        raise ValueError(f"slo {name}: threshold_s={threshold} must "
+                         "be > 0")
+    objective = float(spec.get("objective", DEFAULT_OBJECTIVE))
+    if not 0.0 < objective < 1.0:
+        raise ValueError(f"slo {name}: objective={objective} must be "
+                         "in (0, 1) — it is the good-event fraction")
+    fast = float(spec.get("fast_window_s", DEFAULT_FAST_WINDOW_S))
+    slow = float(spec.get("slow_window_s", DEFAULT_SLOW_WINDOW_S))
+    if not 0.0 < fast <= slow:
+        raise ValueError(f"slo {name}: need 0 < fast_window_s "
+                         f"({fast}) <= slow_window_s ({slow})")
+    fast_burn = float(spec.get("fast_burn", DEFAULT_FAST_BURN))
+    slow_burn = float(spec.get("slow_burn", DEFAULT_SLOW_BURN))
+    if fast_burn <= 0.0 or slow_burn <= 0.0:
+        raise ValueError(f"slo {name}: burn multipliers must be > 0")
+    min_hold = float(spec.get("min_hold_s", DEFAULT_MIN_HOLD_S))
+    if min_hold < 0.0:
+        raise ValueError(f"slo {name}: min_hold_s={min_hold} must be "
+                         ">= 0")
+    return {"name": name, "kind": kind, "key": key,
+            "threshold_s": threshold, "objective": objective,
+            "fast_window_s": fast, "slow_window_s": slow,
+            "fast_burn": fast_burn, "slow_burn": slow_burn,
+            "min_hold_s": min_hold}
+
+
+def metric_name(spec: dict) -> str:
+    """The histogram series an SLO evaluates: the kind itself for the
+    total series, ``kind[key]`` for a per-feed/per-lane one."""
+    if spec.get("key"):
+        return f"{spec['kind']}[{spec['key']}]"
+    return spec["kind"]
+
+
+def slo_path(directory: str) -> str:
+    """``<queue dir>/slo.json`` — beside ``queued/`` and
+    ``heartbeat/``, so every plane (worker, pool, CLI) reads one
+    source of truth."""
+    return os.path.join(directory, SLO_FILENAME)
+
+
+def load_slos(directory: str | None, env: dict | None = None) -> list:
+    """Load + validate the SLO registry for a queue dir: ``slo.json``
+    first, then ``SCINT_SLOS`` (env JSON, same shape) overriding or
+    extending BY NAME.  Returns canonical spec dicts (possibly empty);
+    raises ValueError on a malformed file — a typo'd objective should
+    fail loud, not silently disarm the plane."""
+    specs: dict[str, dict] = {}
+    if directory:
+        path = slo_path(directory)
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as fh:
+                try:
+                    payload = json.load(fh)
+                except json.JSONDecodeError as e:
+                    raise ValueError(f"{path}: invalid JSON ({e})")
+            if isinstance(payload, dict):
+                payload = payload.get("slos", [])
+            if not isinstance(payload, list):
+                raise ValueError(f"{path}: expected a list of SLO "
+                                 "specs or {'slos': [...]}")
+            for raw in payload:
+                s = validate_slo_spec(raw)
+                specs[s["name"]] = s
+    env = os.environ if env is None else env
+    raw_env = env.get("SCINT_SLOS")
+    if raw_env:
+        try:
+            payload = json.loads(raw_env)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"SCINT_SLOS: invalid JSON ({e})")
+        if isinstance(payload, dict):
+            payload = payload.get("slos", [])
+        for raw in payload:
+            s = validate_slo_spec(raw)
+            specs[s["name"]] = s
+    return [specs[n] for n in sorted(specs)]
+
+
+# ---------------------------------------------------------------------------
+# burn-rate math over the closed bucket ladder
+# ---------------------------------------------------------------------------
+
+def bad_edge_index(threshold_s: float) -> int:
+    """First ladder-bucket index whose LOWER edge is >= the threshold:
+    every bucket at or above it holds only values > threshold.  The
+    bucket containing the threshold counts as GOOD (the effective
+    threshold rounds up to its upper edge) — a fixed per-bucket split,
+    so bad counts add under histogram merge."""
+    return _bucket_index(threshold_s) + 1
+
+
+def hist_bad_good(hist_dict: dict | None,
+                  threshold_s: float) -> tuple[int, int]:
+    """(bad, n) of one sparse heartbeat-wire histogram payload at a
+    threshold (``(0, 0)`` for an empty/missing payload)."""
+    if not hist_dict:
+        return (0, 0)
+    h = Hist.from_dict(hist_dict)
+    j = bad_edge_index(threshold_s)
+    return (sum(h.counts[j:]), h.n)
+
+
+def burn_rate(bad: int, n: int, objective: float) -> float:
+    """``(bad/n) / (1 - objective)`` — 1.0 burns the whole error
+    budget over the window; 0.0 when the window holds no events (no
+    evidence is not a breach)."""
+    if n <= 0:
+        return 0.0
+    return (bad / n) / max(1.0 - objective, 1e-12)
+
+
+class SloEvaluator:
+    """Per-process multi-window evaluator.
+
+    Feed it the obs registry's cumulative histogram payloads
+    (``obs.hists()``) at each heartbeat; it keeps a bounded timeline of
+    cumulative (bad, n) per SLO and differences over the fast/slow
+    windows.  :meth:`wire` returns the per-worker heartbeat snapshot —
+    window DELTAS, which fold by addition across the fleet
+    (:func:`merge_slo_snapshots`)."""
+
+    def __init__(self, specs, now: float | None = None):
+        self.specs = [validate_slo_spec(s) for s in specs]
+        # name -> list of (ts, bad_cum, n_cum), oldest first, trimmed
+        # to the slow window (+1 baseline entry past its left edge)
+        self._timeline: dict[str, list] = {s["name"]: []
+                                           for s in self.specs}
+
+    def observe(self, hists: dict, now: float) -> None:
+        """Record one cumulative sample point per SLO from the live
+        histogram registry payloads (``{series: hist_dict}``)."""
+        for spec in self.specs:
+            if spec["kind"] == "heartbeat":
+                continue
+            bad, n = hist_bad_good(hists.get(metric_name(spec)),
+                                   spec["threshold_s"])
+            tl = self._timeline[spec["name"]]
+            tl.append((float(now), bad, n))
+            # trim: keep exactly one point at/left of the slow edge
+            edge = float(now) - spec["slow_window_s"]
+            while len(tl) >= 2 and tl[1][0] <= edge:
+                tl.pop(0)
+
+    def _window(self, spec: dict, window_s: float,
+                now: float) -> tuple[int, int]:
+        """(bad, n) DELTA over the trailing window: newest cumulative
+        minus the newest point at/left of the window edge (zero
+        baseline when the whole timeline is inside the window)."""
+        tl = self._timeline[spec["name"]]
+        if not tl:
+            return (0, 0)
+        edge = float(now) - window_s
+        base_bad = base_n = 0
+        for ts, bad, n in tl:
+            if ts <= edge:
+                base_bad, base_n = bad, n
+            else:
+                break
+        _, bad, n = tl[-1]
+        return (max(bad - base_bad, 0), max(n - base_n, 0))
+
+    def statuses(self, now: float) -> list:
+        """One status dict per histogram-kind SLO: burn per window,
+        budget remaining, and the breach verdict (fast-burn OR
+        slow-burn rule)."""
+        out = []
+        for spec in self.specs:
+            if spec["kind"] == "heartbeat":
+                continue
+            out.append(status_from_counts(
+                spec,
+                self._window(spec, spec["fast_window_s"], now),
+                self._window(spec, spec["slow_window_s"], now)))
+        return out
+
+    def wire(self, now: float) -> dict:
+        """The heartbeat snapshot: per-SLO per-window (bad, n) deltas
+        — pure counts, so the fleet fold is elementwise addition."""
+        slos = {}
+        for spec in self.specs:
+            if spec["kind"] == "heartbeat":
+                continue
+            fb, fn = self._window(spec, spec["fast_window_s"], now)
+            sb, sn = self._window(spec, spec["slow_window_s"], now)
+            slos[spec["name"]] = {"fast": [fb, fn], "slow": [sb, sn]}
+        return {"v": SLO_VERSION, "ts": float(now), "slos": slos}
+
+
+def status_from_counts(spec: dict, fast: tuple, slow: tuple) -> dict:
+    """Assemble one SLO status row from (bad, n) window counts — the
+    shared shape of per-worker and fleet-folded evaluation."""
+    fb, fn = fast
+    sb, sn = slow
+    burn_fast = burn_rate(fb, fn, spec["objective"])
+    burn_slow = burn_rate(sb, sn, spec["objective"])
+    breach = (burn_fast >= spec["fast_burn"]
+              or burn_slow >= spec["slow_burn"])
+    return {"slo": spec["name"], "kind": spec["kind"],
+            "key": spec["key"], "metric": metric_name(spec),
+            "threshold_s": spec["threshold_s"],
+            "objective": spec["objective"],
+            "windows": {
+                "fast": {"window_s": spec["fast_window_s"],
+                         "bad": fb, "n": fn,
+                         "burn": round(burn_fast, 6),
+                         "max_burn": spec["fast_burn"]},
+                "slow": {"window_s": spec["slow_window_s"],
+                         "bad": sb, "n": sn,
+                         "burn": round(burn_slow, 6),
+                         "max_burn": spec["slow_burn"]}},
+            "min_hold_s": spec["min_hold_s"],
+            "budget_remaining": round(
+                max(1.0 - burn_slow, 0.0), 6),
+            "breach": breach}
+
+
+def merge_slo_snapshots(snapshots) -> dict | None:
+    """Fold per-worker heartbeat SLO snapshots: elementwise-add the
+    (bad, n) window deltas per SLO name — associative and commutative
+    like the histogram merge they were cut from.  None when nothing
+    carried a snapshot."""
+    acc: dict[str, dict] = {}
+    ts = None
+    seen = False
+    for snap in snapshots:
+        if not snap or not isinstance(snap, dict):
+            continue
+        seen = True
+        ts = max(ts or 0.0, float(snap.get("ts") or 0.0))
+        for name, wins in (snap.get("slos") or {}).items():
+            slot = acc.setdefault(name, {"fast": [0, 0],
+                                         "slow": [0, 0]})
+            for w in ("fast", "slow"):
+                pair = wins.get(w) or [0, 0]
+                slot[w][0] += int(pair[0])
+                slot[w][1] += int(pair[1])
+    if not seen:
+        return None
+    return {"v": SLO_VERSION, "ts": ts, "slos": acc}
+
+
+def fleet_statuses(specs, merged_snapshot: dict | None,
+                   heartbeats=(), now: float | None = None) -> list:
+    """Fleet-scope SLO statuses: histogram kinds from the folded
+    snapshot counts (exactly the single-process math on the summed
+    windows), heartbeat-liveness kinds from beat ages — a worker is
+    "bad" when its last beat is older than the SLO threshold."""
+    import time as _time
+
+    now = _time.time() if now is None else float(now)
+    merged = (merged_snapshot or {}).get("slos") or {}
+    out = []
+    for raw in specs:
+        spec = validate_slo_spec(raw)
+        if spec["kind"] == "heartbeat":
+            ages = [now - float(hb.get("ts", now))
+                    for hb in heartbeats if isinstance(hb, dict)]
+            bad = sum(1 for a in ages
+                      if a > spec["threshold_s"])
+            pair = (bad, len(ages))
+            out.append(status_from_counts(spec, pair, pair))
+            continue
+        wins = merged.get(spec["name"]) or {}
+        out.append(status_from_counts(
+            spec,
+            tuple(wins.get("fast") or (0, 0)),
+            tuple(wins.get("slow") or (0, 0))))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# durable alert state machines
+# ---------------------------------------------------------------------------
+
+def alert_key(name: str) -> str:
+    """The versioned-row dedup key of one SLO's alert: all processes
+    write ``alert.<slo name>`` and newest-wins resolves the race."""
+    return f"alert.{name}"
+
+
+ALERTS_INDEX_META = "alerts"
+
+
+class AlertEngine:
+    """Durable pending → firing → resolved state machines over a
+    results store (one versioned newest-wins row per SLO).
+
+    Hysteresis is symmetric: a breach must HOLD ``min_hold_s`` before
+    pending escalates to firing, and the all-clear must hold
+    ``min_hold_s`` before firing resolves — flapping burn rates sit in
+    pending/firing instead of paging on every poll.  Each transition
+    appends to the row's bounded history (the ``alerts history`` CLI
+    verb) and emits an ``alert.<state>`` obs event."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def _row(self, name: str) -> dict:
+        row = self.store.get(alert_key(name))
+        if row and row.get("kind") == "alert":
+            return dict(row)
+        return {"kind": "alert", "v": 1, "slo": name,
+                "state": "ok", "since_ts": None, "fired_ts": None,
+                "resolved_ts": None, "clear_since_ts": None,
+                "ack": False, "history": []}
+
+    def step(self, statuses, now: float,
+             trace_ids: dict | None = None) -> list:
+        """Advance every SLO's machine one tick from its status row;
+        persist rows whose state changed (newest-wins, flushed — the
+        row survives SIGKILL the moment step returns).  Returns the
+        current rows."""
+        rows = []
+        dirty = False
+        firing = 0
+        for st in statuses:
+            name = st["slo"]
+            row = self._row(name)
+            prev = row["state"]
+            hold = float(st.get("min_hold_s", DEFAULT_MIN_HOLD_S))
+            if st["breach"]:
+                row["clear_since_ts"] = None
+                if row["state"] in ("ok", "resolved"):
+                    row["state"] = "pending"
+                    row["since_ts"] = float(now)
+                elif (row["state"] == "pending"
+                        and float(now) - float(
+                            now if row["since_ts"] is None
+                            else row["since_ts"]) >= hold):
+                    row["state"] = "firing"
+                    row["fired_ts"] = float(now)
+            else:
+                if row["state"] == "pending":
+                    # a breach that never held min_hold_s clears
+                    # straight back to ok — it never paged
+                    row["state"] = "ok"
+                    row["since_ts"] = None
+                elif row["state"] == "firing":
+                    if row.get("clear_since_ts") is None:
+                        row["clear_since_ts"] = float(now)
+                    elif (float(now) - float(row["clear_since_ts"])
+                            >= hold):
+                        row["state"] = "resolved"
+                        row["resolved_ts"] = float(now)
+            # live context rides every persisted row
+            row["burn_fast"] = st["windows"]["fast"]["burn"]
+            row["burn_slow"] = st["windows"]["slow"]["burn"]
+            row["budget_remaining"] = st["budget_remaining"]
+            row["threshold_s"] = st["threshold_s"]
+            row["metric"] = st["metric"]
+            row["ts"] = float(now)
+            tid = (trace_ids or {}).get(st["metric"])
+            if tid:
+                row["trace_id"] = tid
+            if row["state"] != prev:
+                row["history"] = (list(row.get("history") or [])
+                                  + [[float(now), row["state"]]])
+                del row["history"][:-ALERT_HISTORY_LIMIT]
+                if row["state"] == "pending":
+                    row["ack"] = False
+                core.event(f"alert.{row['state']}", slo=name,
+                           metric=st["metric"],
+                           burn_fast=row["burn_fast"],
+                           burn_slow=row["burn_slow"],
+                           trace_id=row.get("trace_id"))
+                self._persist(row)
+                dirty = True
+            elif row["state"] != "ok":
+                # refresh live burn context on active alerts
+                self._persist(row)
+                dirty = True
+            if row["state"] == "firing":
+                firing += 1
+            core.gauge(f"slo_burn_fast[{name}]", row["burn_fast"])
+            core.gauge(f"slo_burn_slow[{name}]", row["burn_slow"])
+            core.gauge(f"slo_budget_remaining[{name}]",
+                       row["budget_remaining"])
+            rows.append(row)
+        core.gauge("alerts_firing", firing)
+        if dirty:
+            self.store.flush()
+        return rows
+
+    def _persist(self, row: dict) -> None:
+        name = row["slo"]
+        self.store.put_versioned(alert_key(name), row, series="alerts")
+        idx = self.store.get_meta(ALERTS_INDEX_META) or {}
+        keys = set(idx.get("slos") or [])
+        if name not in keys:
+            keys.add(name)
+            self.store.put_meta(ALERTS_INDEX_META,
+                                {"slos": sorted(keys)})
+
+    def ack(self, name: str, now: float | None = None) -> dict | None:
+        """Mark one alert acknowledged (newest-wins row write; a later
+        pending transition clears it).  None when no such alert."""
+        row = self.store.get(alert_key(name))
+        if not row or row.get("kind") != "alert":
+            return None
+        row = dict(row)
+        row["ack"] = True
+        row["ack_ts"] = float(time.time() if now is None else now)
+        core.event("alert.ack", slo=name)
+        self._persist(row)
+        self.store.flush()
+        return row
+
+
+def read_alerts(directory: str) -> list:
+    """Newest-wins alert rows of a queue dir (worker-written index +
+    the declared registry's names), sorted firing-first — the
+    ``scintools-tpu alerts`` / ``fleet status`` read path.  Empty list
+    when the plane never armed."""
+    from ..utils.store import ResultsStore
+
+    results_dir = os.path.join(directory, "results")
+    if not os.path.isdir(results_dir):
+        return []
+    store = ResultsStore(results_dir)
+    names = set()
+    idx = store.get_meta(ALERTS_INDEX_META) or {}
+    names.update(idx.get("slos") or [])
+    try:
+        names.update(s["name"] for s in load_slos(directory))
+    except ValueError:
+        pass
+    rows = []
+    for name in sorted(names):
+        row = store.get(alert_key(name))
+        if row and row.get("kind") == "alert":
+            rows.append(row)
+    order = {"firing": 0, "pending": 1, "resolved": 2, "ok": 3}
+    rows.sort(key=lambda r: (order.get(r.get("state"), 9),
+                             r.get("slo") or ""))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# predicted breach (the autoscaler's leading signal)
+# ---------------------------------------------------------------------------
+
+def linear_trend(points) -> tuple[float, float] | None:
+    """Least-squares (value_now, slope_per_s) over ``(ts, value)``
+    points — the PoolController's breach predictor input.  None with
+    fewer than two distinct timestamps."""
+    pts = [(float(t), float(v)) for t, v in points
+           if v is not None]
+    if len(pts) < 2:
+        return None
+    n = len(pts)
+    mt = sum(t for t, _ in pts) / n
+    mv = sum(v for _, v in pts) / n
+    den = sum((t - mt) ** 2 for t, _ in pts)
+    if den <= 0.0:
+        return None
+    slope = sum((t - mt) * (v - mv) for t, v in pts) / den
+    return (pts[-1][1], slope)
+
+
+def predict_value(points, horizon_s: float) -> float | None:
+    """The trend's value ``horizon_s`` from the newest point (never
+    below the newest observation when the trend still rises — the
+    predictor leads, it does not discount a live breach)."""
+    got = linear_trend(points)
+    if got is None:
+        return None
+    value, slope = got
+    return value + max(slope, 0.0) * float(horizon_s)

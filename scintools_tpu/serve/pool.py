@@ -200,6 +200,15 @@ class PoolConfig:
     # far more conservative than the 3x-interval STALE *rendering* —
     # beat age must exceed max(3x interval, stale_kill_s)
     stale_kill_s: float = 300.0
+    # SLO breach prediction (obs/slo.py — ISSUE 16): each round the
+    # controller fits a linear trend over the declared SLO metrics'
+    # recent timeline (per-feed stream lag from heartbeats, estimated
+    # queue wait = depth/drain) and scales UP when the trend crosses a
+    # declared threshold within predict_horizon_s — before the error
+    # budget burns, alongside (ahead of) raw backpressure
+    predict_horizon_s: float = 60.0
+    predict_window_s: float = 300.0
+    predict_min_points: int = 3
 
     def __post_init__(self):
         if self.min_workers < 0:
@@ -213,6 +222,15 @@ class PoolConfig:
             raise ValueError(
                 f"watermarks must satisfy 0 <= low < high <= 1, got "
                 f"low={self.low_water} high={self.high_water}")
+        if self.predict_horizon_s < 0.0 or self.predict_window_s <= 0.0:
+            raise ValueError(
+                "predict_horizon_s must be >= 0 and predict_window_s "
+                f"> 0, got horizon={self.predict_horizon_s} "
+                f"window={self.predict_window_s}")
+        if self.predict_min_points < 2:
+            raise ValueError(
+                f"predict_min_points={self.predict_min_points}: a "
+                "trend needs >= 2 points")
 
 
 class PoolController:
@@ -235,9 +253,16 @@ class PoolController:
         self._last_scale = float("-inf")
         self.stats = {"rounds": 0, "scale_up": 0, "scale_down": 0,
                       "stale_replaced": 0, "spawn_failed": 0,
-                      "drain_failed": 0, "worker_exits": 0}
+                      "drain_failed": 0, "worker_exits": 0,
+                      "predicted_breach": 0}
         self._last_hint_entries: dict | None = None
         self._last_decision: str | None = None
+        # SLO registry (slo.json, mtime-gated) + per-SLO metric
+        # timelines for the breach predictor (ISSUE 16)
+        self._slo_specs: list = []
+        self._slo_stamp = None
+        self._trends: dict[str, list] = {}
+        self._last_predict: dict | None = None
         self.log = get_logger()
 
     # -- spawning ----------------------------------------------------------
@@ -345,6 +370,94 @@ class PoolController:
         return [wid for wid, w in self.workers.items()
                 if not w["draining"]]
 
+    # -- SLO breach prediction (ISSUE 16) ----------------------------------
+    def _reload_slos(self) -> None:
+        """Mtime-gated reload of the declared SLO registry (the same
+        ``slo.json`` the workers evaluate; one stat per round).  A
+        malformed file logs and disarms the predictor — the reactive
+        backpressure branch still protects the pool."""
+        from ..obs import slo as slo_mod
+
+        try:
+            st = os.stat(slo_mod.slo_path(self.queue.dir))
+            stamp = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            stamp = ()
+        if stamp == self._slo_stamp:
+            return
+        self._slo_stamp = stamp
+        try:
+            self._slo_specs = slo_mod.load_slos(self.queue.dir)
+        except ValueError as e:
+            log_event(self.log, "pool_slo_load_failed", error=repr(e))
+            self._slo_specs = []
+        self._trends = {s["name"]: [] for s in self._slo_specs}
+
+    def _metric_value(self, spec: dict, heartbeats: dict,
+                      depth: int, drain) -> float | None:
+        """One current observation of an SLO's metric, from telemetry
+        the controller already holds: per-feed lag from the heartbeat
+        ``streams`` payloads (worst across workers), and estimated
+        wait ``depth / drain`` for the queue-side kinds."""
+        kind, key = spec["kind"], spec["key"]
+        if kind == "stream_lag_s":
+            lags = []
+            for hb in heartbeats.values():
+                for st in (hb.get("streams") or {}).values():
+                    if key is not None and st.get("feed") != key:
+                        continue
+                    v = st.get("lag_s")
+                    if isinstance(v, (int, float)):
+                        lags.append(float(v))
+            return max(lags) if lags else None
+        if kind in ("queue_wait_s", "job_latency_s"):
+            if isinstance(drain, (int, float)) and drain > 0:
+                return depth / float(drain)
+            return None
+        return None
+
+    def _predict_breaches(self, heartbeats: dict, depth: int,
+                          drain, now: float) -> list:
+        """Advance every SLO's metric timeline and return the names
+        whose linear trend crosses the declared threshold within
+        ``predict_horizon_s`` — the scale-up signal that leads the
+        error budget instead of chasing it."""
+        if not self._slo_specs:
+            self._last_predict = None
+            return []
+        from ..obs import slo as slo_mod
+
+        breaches = []
+        predict = {}
+        for spec in self._slo_specs:
+            if spec["kind"] == "heartbeat":
+                continue
+            value = self._metric_value(spec, heartbeats, depth, drain)
+            tl = self._trends.setdefault(spec["name"], [])
+            if value is not None:
+                tl.append((now, float(value)))
+            edge = now - self.cfg.predict_window_s
+            while tl and tl[0][0] < edge:
+                tl.pop(0)
+            pred = None
+            if len(tl) >= self.cfg.predict_min_points:
+                pred = slo_mod.predict_value(
+                    tl, self.cfg.predict_horizon_s)
+            breach = (pred is not None
+                      and pred >= spec["threshold_s"])
+            predict[spec["name"]] = {
+                "metric": slo_mod.metric_name(spec),
+                "value": tl[-1][1] if tl else None,
+                "predicted": (round(pred, 6) if pred is not None
+                              else None),
+                "threshold_s": spec["threshold_s"],
+                "horizon_s": self.cfg.predict_horizon_s,
+                "breach": breach}
+            if breach:
+                breaches.append(spec["name"])
+        self._last_predict = predict
+        return breaches
+
     def _pick_drain(self, alive, heartbeats: dict) -> str:
         """The scale-down victim: the idlest worker (largest last-claim
         age from its heartbeat), tiebroken toward the youngest spawn —
@@ -372,6 +485,9 @@ class PoolController:
         depth = counts["queued"] + counts["leased"]
         merged = fleet.merge_heartbeats(heartbeats.values(), now=now)
         bp = fleet.backpressure(depth, merged["drain_rate_per_s"])
+        self._reload_slos()
+        predicted = self._predict_breaches(
+            heartbeats, depth, merged["drain_rate_per_s"], now)
         alive = self._alive()
         decision = None
         cooled = now - self._last_scale >= self.cfg.cooldown_s
@@ -381,6 +497,20 @@ class PoolController:
             # worker) — refill immediately, no cooldown, no counter
             if self._spawn_one("min_floor", now) is not None:
                 decision = "spawn_to_min"
+        elif (predicted and len(alive) < self.cfg.max_workers
+              and cooled):
+            # predicted SLO breach (ISSUE 16): a declared metric's
+            # trend crosses its threshold within the horizon — spawn
+            # BEFORE the budget burns, even while raw backpressure
+            # still sits below high_water
+            if self._spawn_one("predicted_breach", now) is not None:
+                self.stats["predicted_breach"] += 1
+                obs.inc("pool_predicted_breach")
+                self._last_scale = now
+                decision = "scale_up_predicted"
+                log_event(self.log, "pool_predicted_breach",
+                          slos=",".join(predicted),
+                          backpressure=round(bp, 4))
         elif (bp >= self.cfg.high_water
               and len(alive) < self.cfg.max_workers and cooled):
             if self._spawn_one("backpressure", now) is not None:
@@ -388,8 +518,11 @@ class PoolController:
                 obs.inc("pool_scale_up")
                 self._last_scale = now
                 decision = "scale_up"
-        elif (bp <= self.cfg.low_water
+        elif (bp <= self.cfg.low_water and not predicted
               and len(alive) > self.cfg.min_workers and cooled):
+            # `not predicted`: a live predicted breach vetoes the
+            # drain — low raw backpressure is exactly what the leading
+            # signal is warning will not last
             wid = self._pick_drain(alive, heartbeats)
             try:
                 # chaos site (kind="error"): a failed drain request
@@ -435,6 +568,7 @@ class PoolController:
             "lane_depths": self.queue.lane_depths(),
             "decision": decision,
             "last_decision": self._last_decision,
+            "slo_predict": self._last_predict,
             "stats": dict(self.stats),
         }
         try:

@@ -232,6 +232,15 @@ class ServeWorker:
         # pool-controller claim hints (control/hints.json), mtime-gated
         self._hints = None
         self._hints_stamp = None
+        # SLO & alerting plane (obs/slo.py — ISSUE 16): armed only when
+        # the queue dir declares objectives (slo.json / SCINT_SLOS);
+        # every hot-path hook below is behind one `is not None` check,
+        # so an undeclared queue pays a single flag test
+        self._slo = None
+        self._slo_engine = None
+        self._slo_stamp = None
+        self._slo_traces: dict[str, str] = {}
+        self._reload_slos()
         # fleet liveness: one atomically-overwritten snapshot file per
         # worker under <queue>/heartbeat/ (obs/fleet.py; heartbeat_s=0
         # disables).  Written by run()'s loop — counters/hists inside
@@ -266,6 +275,51 @@ class ServeWorker:
             self._hints = pool.claim_hints_for(pool.read_hints(
                 self.queue.dir), self.worker_id)
         return self._hints
+
+    def _reload_slos(self) -> None:
+        """Arm/refresh the SLO plane when ``<queue>/slo.json`` changes
+        (one stat per heartbeat, the ``_load_hints`` stamp pattern;
+        ``SCINT_SLOS`` alone can arm it at startup).  A malformed
+        registry logs and disarms — judgment is optional, serving is
+        not."""
+        from ..obs import slo as slo_mod
+
+        try:
+            st = os.stat(slo_mod.slo_path(self.queue.dir))
+            stamp = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            stamp = ()
+        if stamp == self._slo_stamp:
+            return
+        self._slo_stamp = stamp
+        try:
+            specs = slo_mod.load_slos(self.queue.dir)
+        except ValueError as e:
+            log_event(self.log, "slo_load_failed", error=repr(e))
+            specs = []
+        if specs:
+            self._slo = slo_mod.SloEvaluator(specs)
+            self._slo_engine = slo_mod.AlertEngine(self.queue.results)
+        else:
+            self._slo = None
+            self._slo_engine = None
+
+    def _slo_tick(self, now: float | None = None) -> dict | None:
+        """One evaluator step: sample the live histogram registry,
+        advance the durable alert machines, and return the heartbeat
+        snapshot (window deltas — the fleet's associative fold input).
+        None when the plane is disarmed."""
+        if self._slo is None:
+            return None
+        now = time.time() if now is None else now
+        self._slo.observe(obs.get_registry().hists(), now)
+        statuses = self._slo.statuses(now)
+        try:
+            self._slo_engine.step(statuses, now,
+                                  trace_ids=self._slo_traces)
+        except OSError as e:  # fault-ok: judgment must not kill serving
+            log_event(self.log, "slo_step_failed", error=repr(e))
+        return self._slo.wire(now)
 
     def poll_once(self, now: float | None = None,
                   force_flush: bool = False, claim: bool = True) -> int:
@@ -303,8 +357,14 @@ class ServeWorker:
             wait = round(max(now - job.submitted_at, 0.0), 6)
             obs.inc("queue_wait_s", wait)
             # the mergeable fleet form of the same quantity: heartbeat
-            # snapshots ship this histogram, the rollup merges it
+            # snapshots ship this histogram, the rollup merges it —
+            # the per-lane breakdown is the queue-wait SLO's series
             obs.observe("queue_wait_s", wait)
+            obs.observe(f"queue_wait_s[{job.lane}]", wait)
+            if self._slo is not None and job.trace_id:
+                self._slo_traces["queue_wait_s"] = job.trace_id
+                self._slo_traces[f"queue_wait_s[{job.lane}]"] = \
+                    job.trace_id
             if job.cfg.get("stream") is not None:
                 # `stream` job kind (ISSUE 15): a live feed is not a
                 # unit of work but a REGISTRATION — the session stays
@@ -506,12 +566,26 @@ class ServeWorker:
             job = self.queue._hop(job, "job.row")
             self.queue.complete(job)
             self._mark_warm(job)
+            self._job_latency(job)
             self.stats["jobs_done"] += 1
             obs.inc("jobs_done")
             log_event(self.log, "job_done", job=job.id,
                       file=os.path.basename(job.file),
                       tau=row.get("tau"),
                       eta=row.get("betaeta", row.get("eta")))
+
+    def _job_latency(self, job, now: float | None = None) -> None:
+        """Submit -> complete end-to-end wall seconds (total + the
+        per-lane breakdown): the ``job_latency_s`` SLO's bucket-ladder
+        series, observed once per completed job of any kind."""
+        wall = time.time() if now is None else now
+        lat = round(max(wall - job.submitted_at, 0.0), 6)
+        obs.observe("job_latency_s", lat)
+        obs.observe(f"job_latency_s[{job.lane}]", lat)
+        if self._slo is not None and job.trace_id:
+            self._slo_traces["job_latency_s"] = job.trace_id
+            self._slo_traces[f"job_latency_s[{job.lane}]"] = \
+                job.trace_id
 
     def _mark_warm(self, job) -> None:
         """Record an executed job's affinity signature — the
@@ -602,6 +676,7 @@ class ServeWorker:
         job = self.queue._hop(job, "job.row", rows=stored)
         self.queue.complete(job)
         self._mark_warm(job)
+        self._job_latency(job)
         self.stats["jobs_done"] += 1
         obs.inc("jobs_done")
         log_event(self.log, "synth_job_done", job=job.id,
@@ -650,6 +725,11 @@ class ServeWorker:
                           job=job.id, error=repr(e))
         self._streams[job.id] = _StreamState(job=job, session=session,
                                              last_renew=time.time())
+        if self._slo is not None and job.trace_id:
+            # freshness alerts on this feed link back to the stream
+            # job's distributed trace
+            self._slo_traces[f"stream_lag_s[{session.name}]"] = \
+                job.trace_id
         log_event(self.log, "stream_registered", job=job.id,
                   feed=session.name, window=session.window,
                   hop=session.hop, resumed=bool(meta))
@@ -709,6 +789,7 @@ class ServeWorker:
                                       rows=st.session.tick_seq)
                 self.queue.complete(job)
                 self._mark_warm(job)
+                self._job_latency(job, now=wall)
                 self._streams.pop(jid, None)
                 self.stats["jobs_done"] += 1
                 obs.inc("jobs_done")
@@ -757,6 +838,7 @@ class ServeWorker:
             self._job_failed(job, f"compact failed: {e!r}", exc=e)
             return
         self.queue.complete(job)
+        self._job_latency(job)
         self.stats["jobs_done"] += 1
         obs.inc("jobs_done")
         log_event(self.log, "compact_done", job=job.id, **stats)
@@ -927,6 +1009,11 @@ class ServeWorker:
         """Write a heartbeat snapshot if due (obs/fleet.py); heartbeat
         IO must never take the worker down — a full disk degrades to a
         log line, not a crash that poisons the queue's liveness."""
+        # SLO evaluation rides the heartbeat cadence: reload-check the
+        # registry (one stat), advance the alert machines, and stamp
+        # the window-delta snapshot into this beat's extra payload
+        self._reload_slos()
+        slo_snapshot = self._slo_tick()
         if self.heartbeat is None:
             return
         try:
@@ -941,6 +1028,8 @@ class ServeWorker:
                 extra["streams"] = {jid: st.session.stats()
                                     for jid, st in
                                     self._streams.items()}
+            if slo_snapshot is not None:
+                extra["slo"] = slo_snapshot
             self.heartbeat.beat(force=force,
                                 last_claim_at=self._last_claim_at,
                                 stats=self.stats,

@@ -39,7 +39,8 @@ import time
 
 import numpy as np
 
-from .. import obs
+from .. import faults, obs
+from ..obs.hist import Hist
 from ..utils.log import get_logger, log_event
 from .ingest import (FeedError, FeedReader, IncrementalACF, Ring,
                      mask_chunk, preflight_chunk)
@@ -108,7 +109,12 @@ class StreamSession:
         self.last_tick_at = None    # consumed-sample count of last tick
         self.quarantined: dict[str, int] = {}
         self.final_done = False
-        self.tick_latencies: list[float] = []   # bounded (newest 256)
+        # per-session tick-latency histogram on the CLOSED bucket
+        # ladder (ISSUE 16): the same counts obs.observe feeds the
+        # heartbeat registry, so stats() quantiles and fleet-merged
+        # quantiles read one representation — no truncated
+        # process-local sample list to diverge from
+        self.tick_hist = Hist()
         self._last_chunk_t = None   # producer wall stamp of newest
         self._stepfn = None         # consumed chunk (lag readout)
         self.log = get_logger()
@@ -211,16 +217,24 @@ class StreamSession:
         serve worker routes it to ``failed/``)."""
         self.reader.refresh()
         rows: list[dict] = []
-        for _start, rec in self.reader.chunks_since(self.consumed):
-            self._consume(rec)
-            if self._tick_due():
-                rows.append(self._tick(now=now))
-        if self.reader.finalized and not self.final_done \
-                and self.consumed >= self.reader.total_samples:
-            final = self._final_tick(now=now)
-            if final is not None:
-                rows.append(final)
-            self.final_done = True
+        try:
+            # fault site: an armed fault blocks consumption (the
+            # consumer genuinely falls behind the feed head), while
+            # the finally still samples the growing lag — the SLO
+            # smoke gate's injected freshness breach
+            faults.check("stream.poll")
+            for _start, rec in self.reader.chunks_since(self.consumed):
+                self._consume(rec)
+                if self._tick_due():
+                    rows.append(self._tick(now=now))
+            if self.reader.finalized and not self.final_done \
+                    and self.consumed >= self.reader.total_samples:
+                final = self._final_tick(now=now)
+                if final is not None:
+                    rows.append(final)
+                self.final_done = True
+        finally:
+            self._publish_lag(time.time() if now is None else now)
         return rows
 
     @property
@@ -277,12 +291,18 @@ class StreamSession:
     def _publish_metrics(self, latency: float, now: float) -> None:
         obs.inc("stream_ticks")
         obs.observe("tick_latency_s", latency)
-        self.tick_latencies.append(latency)
-        del self.tick_latencies[:-256]
+        self.tick_hist.observe(latency)
+
+    def _publish_lag(self, now: float) -> None:
+        """Per-POLL lag sample: gauges for the operator timeline plus
+        a per-feed bucket-ladder histogram observation (the freshness
+        SLO source — sampled even when a stalled feed yields no tick,
+        so a breach keeps producing evidence; ISSUE 16)."""
         lag = self.lag_s(now)
         if lag is not None:
             obs.gauge("stream_lag_s", round(lag, 6), stream=True)
             obs.gauge(f"stream_lag_s[{self.name}]", round(lag, 6))
+            obs.observe(f"stream_lag_s[{self.name}]", lag)
 
     def lag_s(self, now: float | None = None) -> float | None:
         """Processing lag behind the feed head: wall seconds since the
@@ -363,7 +383,6 @@ class StreamSession:
     # -- telemetry ----------------------------------------------------------
     def stats(self) -> dict:
         """The per-feed heartbeat/fleet payload."""
-        lat = sorted(self.tick_latencies)
         return {
             "feed": self.name, "window": self.window, "hop": self.hop,
             "ticks": int(self.tick_seq),
@@ -374,8 +393,7 @@ class StreamSession:
             "lag_s": (round(self.lag_s(), 3)
                       if self._last_chunk_t is not None else None),
             "tick_latency_s": ({
-                "p50": round(lat[len(lat) // 2], 6),
-                "p95": round(lat[min(len(lat) - 1,
-                                     int(len(lat) * 0.95))], 6)}
-                if lat else None),
+                "p50": round(self.tick_hist.quantile(0.50), 6),
+                "p95": round(self.tick_hist.quantile(0.95), 6)}
+                if self.tick_hist.n else None),
         }

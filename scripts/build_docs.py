@@ -36,6 +36,7 @@ PAGES = [
     ("docs/fleet.md", "fleet", "Fleet pool controller"),
     ("docs/reliability.md", "reliability", "Reliability & fault injection"),
     ("docs/observability.md", "observability", "Tracing & metrics"),
+    ("docs/slo.md", "slo", "SLOs, error budgets & alerting"),
     ("docs/migrating.md", "migrating", "Migrating from scintools"),
     ("docs/wavefield.md", "wavefield", "Wavefield holography"),
     ("docs/roadmap.md", "roadmap", "Roadmap / build log"),

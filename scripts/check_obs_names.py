@@ -35,6 +35,11 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "scintools_tpu")
 
+# out-of-package emitters: repo-root bench.py stamps obs names from its
+# env-gated lanes (SCINT_BENCH_SLO's disarmed-path probe among them) —
+# a typo there silently benchmarks a nonexistent series
+EXTRA_FILES = (os.path.join(REPO, "bench.py"),)
+
 # the obs API surface whose first argument is a series name, and the
 # module aliases it is reached through in this codebase
 FUNCS = ("inc", "gauge", "span", "observe", "event", "traced")
@@ -91,17 +96,22 @@ def find_unregistered(path: str) -> list:
     return hits
 
 
-def check_tree(pkg_dir: str = PKG) -> list:
-    """All offending (relpath, line, func, name) under ``pkg_dir``."""
+def check_tree(pkg_dir: str = PKG, extra_files=EXTRA_FILES) -> list:
+    """All offending (relpath, line, func, name) under ``pkg_dir``
+    plus the registered out-of-package emitters (``extra_files``)."""
     offenders = []
+    paths = []
     for root, _dirs, files in os.walk(pkg_dir):
         for name in sorted(files):
-            if not name.endswith(".py"):
-                continue
-            path = os.path.join(root, name)
-            for line, func, literal in find_unregistered(path):
-                offenders.append((os.path.relpath(path, pkg_dir), line,
-                                  func, literal))
+            if name.endswith(".py"):
+                paths.append(os.path.join(root, name))
+    paths.extend(p for p in (extra_files or ()) if os.path.isfile(p))
+    for path in paths:
+        for line, func, literal in find_unregistered(path):
+            rel = (os.path.relpath(path, pkg_dir)
+                   if path.startswith(pkg_dir + os.sep)
+                   else os.path.basename(path))
+            offenders.append((rel, line, func, literal))
     return offenders
 
 

@@ -251,6 +251,71 @@ print('stream gate ok on chip: ticks=', ticks, 'warm_miss=0',
       'tick_p50_s=', round(lat, 4), 'lag_s=', round(sess.lag_s(), 4))
 "
 
+SLO_CODE="
+import json, os, tempfile, time
+from scintools_tpu import faults, obs
+from scintools_tpu.obs import slo
+from scintools_tpu.sim import thin_arc_epoch
+from scintools_tpu.stream import FeedWriter, StreamSession
+from scintools_tpu.utils.store import ResultsStore
+obs.enable()
+qdir = tempfile.mkdtemp(prefix='scint_slo_gate_')
+json.dump([{'name': 'gate-fresh', 'kind': 'stream_lag_s',
+            'key': 'gate', 'threshold_s': 0.25, 'fast_window_s': 1.5,
+            'slow_window_s': 3.0, 'min_hold_s': 0.3}],
+          open(slo.slo_path(qdir), 'w'))
+specs = slo.load_slos(qdir)
+ev = slo.SloEvaluator(specs)
+engine = slo.AlertEngine(ResultsStore(os.path.join(qdir, 'results')))
+# window >> appended samples: the gate never ticks (no device work) —
+# it exercises the JUDGMENT plane, not the recompute plane
+ep = thin_arc_epoch(nf=8, nt=64, seed=0)
+import numpy as np
+dyn = np.asarray(ep.dyn)
+feed = tempfile.mkdtemp(prefix='scint_slo_feed_')
+fw = FeedWriter(feed, freqs=ep.freqs, dt=ep.dt, name='gate')
+sess = StreamSession(feed, {'lamsteps': True}, window=4096, hop=4096)
+fw.append(dyn[:, :4]); sess.poll()          # consume: lag ~ 0
+def judge():
+    now = time.time()
+    ev.observe(obs.get_registry().hists(), now=now)
+    return {r['slo']: r for r in engine.step(ev.statuses(now=now),
+                                             now=now)}
+# inject the freshness breach: stream.poll faults block consumption
+# while the per-poll lag sample keeps accumulating breach evidence
+faults.inject('stream.poll', faults.FaultSpec(kind='transient',
+                                              times=4))
+fw.append(dyn[:, 4:8])
+states = []
+for _ in range(4):
+    time.sleep(0.45)
+    try:
+        sess.poll()
+    except faults.TransientError:
+        pass
+    states.append(judge()['gate-fresh']['state'])
+assert 'pending' in states and states[-1] == 'firing', states
+# durability: a FRESH store (new process's view of the same dir)
+# reads the firing row back — the newest-wins contract the SIGKILL
+# tier-1 test (tests/test_slo.py) proves across a real kill
+rows = slo.read_alerts(qdir)
+assert rows and rows[0]['state'] == 'firing', rows
+# fault window exhausted -> consumption resumes on fresh appends ->
+# lag collapses, the breach window ages out, the alert resolves
+deadline = time.time() + 20.0
+state = 'firing'
+while state != 'resolved' and time.time() < deadline:
+    fw.append(dyn[:, :2])
+    sess.poll()
+    time.sleep(0.3)
+    state = judge()['gate-fresh']['state']
+assert state == 'resolved', state
+hist = [s for _, s in slo.read_alerts(qdir)[0]['history']]
+assert hist[-3:] == ['pending', 'firing', 'resolved'], hist
+print('slo gate ok: breach -> pending -> firing -> resolved,',
+      'durable rows readable across stores')
+"
+
 SPLIT_CODE="
 import numpy as np
 from scintools_tpu import obs
@@ -406,6 +471,15 @@ echo "== streaming ingest: warm fixed-signature ticks on chip =="
 # TPU compiler, and prints the on-chip per-tick latency the live
 # monitoring scenario actually gets
 gated "streaming smoke check" 600 2 python -u -c "$STREAM_CODE"
+
+echo "== slo plane: injected lag breach fires + resolves durably =="
+# the ISSUE 16 judgment plane, end to end in under a minute: a
+# stream.poll chaos fault (faults.py) stalls consumption, the per-poll
+# lag samples burn the freshness budget, the durable alert walks
+# pending -> firing (min-hold hysteresis) and back to resolved once
+# the fault window exhausts — with the rows readable through a fresh
+# store, the crash-survival contract tier-1 proves across a SIGKILL
+gated "slo smoke check" 600 2 python -u -c "$SLO_CODE"
 
 echo "== nudft einsum on-chip accuracy (bf16-lowering guard) =="
 # the round-4 A/B caught the vmapped einsum NUDFT silently lowering to

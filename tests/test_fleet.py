@@ -334,12 +334,33 @@ def test_heartbeat_merge_associative(tmp_path):
     a = _mk_hb("a", 100.0, 4, [0.1, 0.2], in_use=1 << 30)
     b = _mk_hb("b", 200.0, 6, [1.0], in_use=3 << 30)
     c = _mk_hb("c", 150.0, 2, [5.0, 0.01], elapsed=None, delta=2)
+    # the ISSUE 16 keys ride the same fold: per-feed lag + tick-latency
+    # bucket ladders in hists, and the per-worker SLO window snapshot
+    # ((bad, n) deltas) as a top-level payload
+    for hb, lags, ticks, bn in ((a, [0.5], [0.01], [1, 3]),
+                                (b, [2.0, 8.0], [], [2, 2]),
+                                (c, [0.1], [0.02, 0.04], [0, 4])):
+        lh, th = Hist(), Hist()
+        for v in lags:
+            lh.observe(v)
+        for v in ticks:
+            th.observe(v)
+        hb["hists"]["stream_lag_s[feedA]"] = lh.to_dict()
+        if ticks:
+            hb["hists"]["tick_latency_s"] = th.to_dict()
+        hb["slo"] = {"v": 1, "ts": hb["ts"],
+                     "slos": {"lag": {"fast": bn, "slow": bn}}}
     m1 = fleet.merge_heartbeats([a, b, c])
     m2 = fleet.merge_heartbeats([c, a, b])
     m3 = fleet.merge_heartbeats([b, c, a])
     assert m1 == m2 == m3
     assert m1["counters"]["jobs_done"] == 12
     assert m1["hists"]["queue_wait_s"]["count"] == 5
+    assert m1["hists"]["stream_lag_s[feedA]"]["count"] == 4
+    assert m1["hists"]["tick_latency_s"]["count"] == 3
+    # slo snapshots fold elementwise; ts resolves to the freshest beat
+    assert m1["slo"]["slos"]["lag"]["fast"] == [3, 9]
+    assert m1["slo"]["ts"] == 200.0
     # gauges resolve by freshest timestamp regardless of order
     assert m1["gauges"]["queue_depth"] == 6 and m1["depth"] == 6
     # drain rate: only beats with an elapsed interval contribute

@@ -114,6 +114,23 @@ def test_summary_percentiles():
     assert s["p95_ms"] == 100.0
 
 
+def test_hist_quantile_clamps_to_observed_max():
+    """Regression (ISSUE 16 satellite): a quantile landing in the last
+    populated bucket must report at most the observed max, not the
+    bucket's upper ladder edge — a single 1.0 s sample sits in the
+    (~0.71, 1.0] bucket and an unclamped p99 would read the edge of a
+    LATER interpolation point, overshooting the true extreme."""
+    from scintools_tpu.obs.hist import Hist
+    h = Hist()
+    for v in (0.2, 0.3, 1.0):
+        h.observe(v)
+    for q in (0.5, 0.9, 0.99, 1.0):
+        assert h.quantile(q) <= 1.0, (q, h.quantile(q))
+    assert h.quantile(1.0) == 1.0
+    # and the low side symmetrically never reads below the observed min
+    assert h.quantile(0.0) >= h.vmin
+
+
 # ---------------------------------------------------------------------------
 # JSONL sink -> trace report round trip
 # ---------------------------------------------------------------------------

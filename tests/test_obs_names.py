@@ -73,16 +73,60 @@ def test_catalog_is_consistent_and_covers_the_known_floor():
     for fam in ("queue_shard_claims", "queue_depth"):
         assert fam in cat["families"], fam
     assert "serve.compact" in cat["spans"]
+    # the SLO & alerting plane (ISSUE 16): the lifecycle events, the
+    # per-lane latency hists, and the per-SLO burn/budget families the
+    # trace-report slo section and the fleet rollup read
+    for e in ("alert.pending", "alert.firing", "alert.resolved",
+              "alert.ack"):
+        assert e in cat["events"], e
+    assert "job_latency_s" in cat["hists"]
+    assert "pool_predicted_breach" in cat["counters"]
+    assert "alerts_firing" in cat["gauges"]
+    for fam in ("queue_wait_s", "job_latency_s", "stream_lag_s",
+                "slo_burn_fast", "slo_burn_slow",
+                "slo_budget_remaining"):
+        assert fam in cat["families"], fam
     # families are name PREFIXES of bracketed series; they must not
     # also be plain counter/gauge names except the documented
     # total+breakdown pairs (faults_injected, epochs_quarantined,
     # queue_depth whose total gauge rides beside the per-shard family,
     # jit_cache_miss whose total rides beside the per-unit family the
-    # split pipeline's acceptance gate reads — ISSUE 14 — and the
+    # split pipeline's acceptance gate reads — ISSUE 14 — the
     # streaming plane's chunks_quarantined / stream_lag_s totals
-    # beside their per-reason / per-feed families — ISSUE 15)
+    # beside their per-reason / per-feed families — ISSUE 15 — and
+    # queue_wait_s, whose total counter/hist ride beside the per-lane
+    # SLO family — ISSUE 16)
     overlap = (set(cat["families"])
                & (set(cat["counters"]) | set(cat["gauges"])))
     assert overlap == {"faults_injected", "epochs_quarantined",
                        "queue_depth", "jit_cache_miss",
-                       "chunks_quarantined", "stream_lag_s"}, overlap
+                       "chunks_quarantined", "stream_lag_s",
+                       "queue_wait_s"}, overlap
+
+
+def test_lint_covers_alert_lifecycle_and_slo_families(tmp_path):
+    """Alert-lifecycle emission idioms pass the lint (literal events,
+    f-string burn-gauge families, the dynamic ``alert.{state}``
+    transition event) while a typo'd lifecycle event or burn family
+    still fails — and the walk now covers repo-root bench.py."""
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "from scintools_tpu import obs\n"
+        "def f(name, state):\n"
+        "    obs.event('alert.pending', slo=name)\n"        # registered
+        "    obs.event(f'alert.{state}', slo=name)\n"       # prefix ok
+        "    obs.gauge(f'slo_burn_fast[{name}]', 1.0)\n"    # family ok
+        "    obs.gauge('alerts_firing', 0)\n"               # registered
+        "    obs.observe(f'job_latency_s[{name}]', 0.1)\n"  # family ok
+        "    obs.event('alert.snoozed')\n"                  # typo
+        "    obs.gauge(f'slo_burn_fst[{name}]', 1.0)\n")    # typo'd fam
+    hits = check_obs_names.find_unregistered(str(mod))
+    assert [(ln, fn, lit) for ln, fn, lit in hits] == [
+        (8, "event", "alert.snoozed"),
+        (9, "gauge", "slo_burn_fst[")]
+    # the out-of-package emitter list includes bench.py, and an empty
+    # extras tuple restores the package-only walk
+    assert any(p.endswith("bench.py")
+               for p in check_obs_names.EXTRA_FILES)
+    pkg_only = check_obs_names.check_tree(extra_files=())
+    assert pkg_only == []
