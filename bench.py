@@ -80,8 +80,13 @@ through a live feed + StreamSession (ISSUE 15), recording per-tick
 ``tick_latency_s`` p50/p95, the final ``stream_lag_s``, tick counts
 and the warm-tick ``jit_cache_miss`` delta (contract: 0) at
 SCINT_BENCH_STREAM_TICKS ticks (default 24) over a
-SCINT_BENCH_STREAM_WINDOW x SCINT_BENCH_STREAM_NF window; attached as
-``stream_lane``), SCINT_BENCH_SLO ("1" = ALSO run the SLO-plane
+SCINT_BENCH_STREAM_WINDOW x SCINT_BENCH_STREAM_NF window — run as an
+incremental-vs-full A/B (ISSUE 17): the same feed ticks once through
+the full-recompute path (the top-level fields) and once through the
+O(hop) incremental path (the ``incremental`` sub-record), with the
+warm-p50/p95 ratios attached as ``speedup_p50``/``speedup_p95`` so
+the flight log proves the win (or flags a regression) per backend;
+attached as ``stream_lane``), SCINT_BENCH_SLO ("1" = ALSO run the SLO-plane
 overhead lane (ISSUE 16) — asserting the tracing-disabled observe hot
 path stays one-flag-check-grade, and recording the armed judgment
 cycle's p50/max wall plus the fleet fold cost per merged snapshot over
@@ -794,18 +799,16 @@ def stream_throughput(n_ticks: int | None = None,
     observatory monitor would see per sliding-window recompute tick.
 
     Record fields: ``tick_latency_s`` p50/p95 over ``n_ticks`` warm
-    ticks (the first, compiling tick is reported separately as
+    ticks (compiling ticks are reported separately as
     ``first_tick_s``), the final ``stream_lag_s`` (append -> consumed
     wall lag), and ``warm_jit_cache_miss`` — the jit-cache-miss delta
     across the warm ticks, whose contract (the fixed window signature)
-    is 0."""
+    is 0.  The lane is an incremental-vs-full A/B (ISSUE 17): the
+    top-level fields are the full-recompute run, ``incremental``
+    carries the same fields for the O(hop) sliding-update run, and
+    ``speedup_p50``/``speedup_p95`` are the full/incremental warm
+    latency ratios (>1 = the incremental path wins)."""
     _maybe_enable_trace()
-    import shutil
-    import tempfile
-
-    from scintools_tpu import obs
-    from scintools_tpu.sim import thin_arc_epoch
-    from scintools_tpu.stream import FeedWriter, StreamSession
 
     ticks = int(n_ticks if n_ticks is not None
                 else _env_int("SCINT_BENCH_STREAM_TICKS", 24))
@@ -814,19 +817,52 @@ def stream_throughput(n_ticks: int | None = None,
     NF = int(nf if nf is not None
              else _env_int("SCINT_BENCH_STREAM_NF", 64))
     hop = max(W // 8, 1)
+    rec: dict = {"window": W, "nf": NF, "hop": hop,
+                 "ticks_target": ticks}
+    rec.update(_stream_mode_run(ticks, W, NF, hop, incremental=False))
+    try:
+        rec["incremental"] = _stream_mode_run(ticks, W, NF, hop,
+                                              incremental=True)
+    except Exception as e:  # the A/B must not kill the whole lane
+        rec["incremental"] = {"error": f"{type(e).__name__}: {e}"}
+    full_lat = rec.get("tick_latency_s") or {}
+    inc_lat = rec["incremental"].get("tick_latency_s") or {}
+    for q in ("p50", "p95"):
+        if full_lat.get(q) and inc_lat.get(q):
+            rec[f"speedup_{q}"] = round(full_lat[q] / inc_lat[q], 3)
+    return rec
+
+
+def _stream_mode_run(ticks: int, W: int, NF: int, hop: int,
+                     incremental: bool) -> dict:
+    """One mode of the stream A/B: feed a simulated observation
+    chunk-by-chunk through a live session and time every tick.  Warm
+    latencies start after the compiling prefix — one tick for the full
+    path, two for the incremental one (the first sliding tick traces
+    the advance + dynamic fitter programs)."""
+    import shutil
+    import tempfile
+
+    from scintools_tpu import obs
+    from scintools_tpu.sim import thin_arc_epoch
+    from scintools_tpu.stream import FeedWriter, StreamSession
+
     total = W + ticks * hop
     epoch = thin_arc_epoch(nf=NF, nt=total, seed=1)
     dyn = np.asarray(epoch.dyn)
     feed_dir = tempfile.mkdtemp(prefix="scint_bench_feed_")
-    rec: dict = {"window": W, "nf": NF, "hop": hop, "ticks_target": ticks}
+    warmup = 2 if incremental else 1
+    rec: dict = {}
     try:
         writer = FeedWriter(feed_dir, freqs=epoch.freqs, dt=epoch.dt,
                             mjd=epoch.mjd, name="bench-stream")
         sess = StreamSession(
             feed_dir, {"lamsteps": True, "arc_numsteps": 200,
-                       "lm_steps": 6}, window=W, hop=hop)
+                       "lm_steps": 6}, window=W, hop=hop,
+            incremental=incremental)
         lat: list[float] = []
         first_tick_s = None
+        warm_seen = 0
         i = 0
         miss_at_warm = None
         while i < total:
@@ -837,11 +873,16 @@ def stream_throughput(n_ticks: int | None = None,
             wall = time.perf_counter() - t0
             if not rows:
                 continue
-            if first_tick_s is None:
-                # the compiling tick: report it, then snapshot the
-                # miss counter the warm contract is asserted against
-                first_tick_s = wall
-                miss_at_warm = obs.counters().get("jit_cache_miss", 0)
+            warm_seen += 1
+            if warm_seen <= warmup:
+                # a compiling tick: report the first, then snapshot
+                # the miss counter the warm contract is asserted
+                # against once the compiling prefix is done
+                if first_tick_s is None:
+                    first_tick_s = wall
+                if warm_seen == warmup:
+                    miss_at_warm = obs.counters().get(
+                        "jit_cache_miss", 0)
             else:
                 lat.append(wall)
         writer.finalize()
@@ -866,6 +907,9 @@ def stream_throughput(n_ticks: int | None = None,
                 if miss_at_warm is not None else None),
             "quarantined_chunks": int(sum(sess.quarantined.values())),
         })
+        if incremental:
+            rec["inc_ticks"] = int(sess.inc_ticks)
+            rec["resyncs"] = int(sess.resyncs)
     finally:
         shutil.rmtree(feed_dir, ignore_errors=True)
     return rec

@@ -1033,7 +1033,10 @@ def cmd_submit(args) -> int:
             rec = client.submit_stream(
                 args.stream, _estimator_opts(args),
                 window=getattr(args, "stream_window", None),
-                hop=getattr(args, "stream_hop", None), lane=lane)
+                hop=getattr(args, "stream_hop", None), lane=lane,
+                incremental=(True if getattr(args, "stream_incremental",
+                                             False) else None),
+                resync_every=getattr(args, "stream_resync", None))
         except ValueError as e:
             raise SystemExit(str(e))
         print(json.dumps({
@@ -2022,6 +2025,17 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="stream_hop", metavar="H",
                    help="minimum new samples between ticks (default "
                         "window/4; enters the job identity)")
+    q.add_argument("--stream-incremental", action="store_true",
+                   dest="stream_incremental",
+                   help="O(hop) incremental ticks: sliding-window "
+                        "sspec/ACF updates + a warm-started fitter, "
+                        "with periodic exact resync to the full path "
+                        "(docs/streaming.md; enters the job identity)")
+    q.add_argument("--stream-resync", type=int, default=None,
+                   dest="stream_resync", metavar="N",
+                   help="full-recompute resync cadence for "
+                        "--stream-incremental ticks (default 16; "
+                        "bounds sliding-update float drift)")
     q.add_argument("--lane", default=None,
                    choices=["interactive", "bulk"],
                    help="QoS lane (scheduling priority, never job "

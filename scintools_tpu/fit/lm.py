@@ -167,7 +167,7 @@ def outlined_call(fn: Callable, *args):
 
 def lm_fit_jax(residual_fn: Callable, p0, bounds=None, args: Sequence = (),
                steps: int = 30, lam0: float = 1e-3, lam_up: float = 10.0,
-               lam_down: float = 0.3, nobs=None):
+               lam_down: float = 0.3, nobs=None, steps_rt=None):
     """Fixed-iteration damped LM with box projection; fully jittable and
     vmappable (no data-dependent control flow; rejected steps raise the
     damping instead of re-solving).
@@ -175,6 +175,15 @@ def lm_fit_jax(residual_fn: Callable, p0, bounds=None, args: Sequence = (),
     residual_fn(p, *args) -> [N]; p0 [P].  Returns LsqResult of jax arrays.
     ``nobs`` is the REAL observation count when the residual vector is
     tail-padded with exact zeros (see :func:`_covariance`).
+
+    ``steps_rt`` (optional TRACED scalar) bounds the iteration count at
+    RUNTIME: the loop becomes a ``lax.while_loop`` running
+    ``min(steps_rt, steps)`` trips, so a warm-started caller (the
+    streaming plane seeding from the previous tick's converged
+    parameters) genuinely skips device iterations without changing the
+    program's input signature — ``steps`` stays the static trip ceiling
+    and the compile cache key.  ``None`` keeps the historical
+    ``lax.scan`` path byte-for-byte.
     """
     import jax
     import jax.numpy as jnp
@@ -211,9 +220,24 @@ def lm_fit_jax(residual_fn: Callable, p0, bounds=None, args: Sequence = (),
     p_init = project(p0)
     r0 = residual_fn(p_init, *args)
     c0 = 0.5 * (r0 @ r0)
-    (p_fin, r, c_fin, _), _ = jax.lax.scan(
-        step, (p_init, r0, c0, jnp.asarray(lam0, dtype=p0.dtype)),
-        length=steps)
+    init = (p_init, r0, c0, jnp.asarray(lam0, dtype=p0.dtype))
+    if steps_rt is None:
+        (p_fin, r, c_fin, _), _ = jax.lax.scan(step, init, length=steps)
+    else:
+        limit = jnp.minimum(jnp.asarray(steps_rt, dtype=jnp.int32),
+                            jnp.int32(steps))
+
+        def cond(carry):
+            i, _ = carry
+            return i < limit
+
+        def body(carry):
+            i, state = carry
+            state, _ = step(state, None)
+            return i + 1, state
+
+        _, (p_fin, r, c_fin, _) = jax.lax.while_loop(
+            cond, body, (jnp.int32(0), init))
     J = jax.jacfwd(residual_fn)(p_fin, *args)
     cov, redchi = _covariance(jnp, J, r, n_par, nobs=nobs)
     return LsqResult(params=p_fin, stderr=jnp.sqrt(jnp.abs(jnp.diag(cov))),

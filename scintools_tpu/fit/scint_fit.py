@@ -373,13 +373,13 @@ def _residual_cat_free(p, x, is_t, spike, xmax, valid, y):
 
 
 @functools.lru_cache(maxsize=None)
-def _fit_scint_cat_jax(alpha, steps):
+def _fit_scint_cat_jax(alpha, steps, dynamic=False):
     import jax
     import jax.numpy as jnp
 
     free = alpha is None
 
-    def single(y, g, nobs, x, is_t, spike, xmax, valid):
+    def single(y, g, nobs, x, is_t, spike, xmax, valid, steps_rt=None):
         if free:
             p0 = jnp.concatenate(
                 [g, jnp.asarray([_ALPHA_KOLMOGOROV], dtype=g.dtype)])
@@ -387,14 +387,14 @@ def _fit_scint_cat_jax(alpha, steps):
             hi = jnp.array([jnp.inf, jnp.inf, jnp.inf, jnp.inf, 8.0])
             return lm_fit_jax(_residual_cat_free, p0, bounds=(lo, hi),
                               args=(x, is_t, spike, xmax, valid, y),
-                              steps=steps, nobs=nobs)
+                              steps=steps, nobs=nobs, steps_rt=steps_rt)
         lo = jnp.array([1e-10, 1e-10, 0.0, 0.0])
         hi = jnp.full(4, jnp.inf)
         return lm_fit_jax(_residual_cat_fixed, g, bounds=(lo, hi),
                           args=(x, is_t, spike, xmax, valid, y, alpha),
-                          steps=steps, nobs=nobs)
+                          steps=steps, nobs=nobs, steps_rt=steps_rt)
 
-    def impl(y, g, nobs, x, is_t, spike, xmax, valid):
+    def impl(y, g, nobs, x, is_t, spike, xmax, valid, steps_rt=None):
         # the WHOLE vmapped fit runs as one outlined computation
         # (lm.outlined_call): identical instruction stream whether this
         # traces into the fused single-program step or the split
@@ -402,11 +402,22 @@ def _fit_scint_cat_jax(alpha, steps):
         # padding) of the split path's bit-identity contract
         from .lm import outlined_call
 
-        res = outlined_call(
-            lambda: jax.vmap(
-                single,
-                in_axes=(0, 0, None, 0, None, None, 0, None))(
-                y, g, nobs, x, is_t, spike, xmax, valid))
+        if dynamic:
+            # runtime iteration bound shared across the batch (the
+            # streaming warm-start): one extra SCALAR input, vmapped
+            # with in_axes=None so the while-loop trip count stays
+            # batch-uniform
+            res = outlined_call(
+                lambda: jax.vmap(
+                    single,
+                    in_axes=(0, 0, None, 0, None, None, 0, None, None))(
+                    y, g, nobs, x, is_t, spike, xmax, valid, steps_rt))
+        else:
+            res = outlined_call(
+                lambda: jax.vmap(
+                    single,
+                    in_axes=(0, 0, None, 0, None, None, 0, None))(
+                    y, g, nobs, x, is_t, spike, xmax, valid))
         return _to_scint_params(res, alpha, jnp)
 
     return impl
@@ -414,7 +425,7 @@ def _fit_scint_cat_jax(alpha, steps):
 
 def fit_scint_params_cat(y, p0, nobs, x, is_t, spike, xmax, valid,
                          alpha: float | None = _ALPHA_KOLMOGOROV,
-                         steps: int = 20) -> ScintParams:
+                         steps: int = 20, steps_rt=None) -> ScintParams:
     """Batched tau/dnu fit over canonicalised concatenated ACF cuts —
     the shape-stable back-end unit of the split pipeline.  All grid-
     derived vectors arrive as runtime inputs, so the traced program
@@ -422,9 +433,17 @@ def fit_scint_params_cat(y, p0, nobs, x, is_t, spike, xmax, valid,
     cuts pad onto the same rung reuses one compiled program.  Results
     on the real elements are bit-identical to
     :func:`fit_scint_params_from_dyn` (tier-1-asserted via the CSV
-    byte-equality gate in tests/test_split_programs.py)."""
-    return _fit_scint_cat_jax(alpha, int(steps))(
-        y, p0, nobs, x, is_t, spike, xmax, valid)
+    byte-equality gate in tests/test_split_programs.py).
+
+    ``steps_rt`` (optional traced scalar) runtime-bounds the LM trip
+    count below the static ``steps`` ceiling — the streaming plane's
+    warm-started ticks pass the previous tick's convergence budget here
+    while the program cache key (rung, alpha, steps) stays unchanged."""
+    if steps_rt is None:
+        return _fit_scint_cat_jax(alpha, int(steps))(
+            y, p0, nobs, x, is_t, spike, xmax, valid)
+    return _fit_scint_cat_jax(alpha, int(steps), dynamic=True)(
+        y, p0, nobs, x, is_t, spike, xmax, valid, steps_rt)
 
 
 # ---------------------------------------------------------------------------

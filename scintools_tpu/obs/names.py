@@ -71,6 +71,19 @@ COUNTERS = frozenset({
     # the PREDICTED-breach signal (the trend-leading branch, beside the
     # reactive pool_scale_up backpressure one)
     "pool_predicted_breach",
+    # incremental streaming hot path (ISSUE 17, stream/incremental.py +
+    # stream/window.py): O(hop) sliding-window ticks vs full-path
+    # resyncs, and the warm-started fitter's seed/fallback split —
+    # the drift-bounding discipline made countable
+    "incremental_ticks", "tick_resyncs",
+    "warm_start_seeded", "warm_start_fallbacks",
+    # feed->worker pinning + backfill lane (serve/queue.py +
+    # serve/worker.py): pinned claims honoured, claims deferred for a
+    # live pinned owner, and bulk-lane catch-up jobs for late feeds
+    # backfill_jobs = catch-up jobs SUBMITTED at registration;
+    # serve_backfill_jobs = backfill executions a worker ran
+    "feed_pins", "feed_pin_deferred", "backfill_jobs",
+    "serve_backfill_jobs",
 })
 
 # -- gauges (obs.gauge) -----------------------------------------------------
@@ -100,6 +113,8 @@ SPANS = frozenset({
     "fit.arc", "fit.scint", "fit.lsq_numpy",
     "sim.simulation",
     "serve.poll", "serve.load", "serve.batch", "serve.compact",
+    # backfill lane: one bulk catch-up pass over a deep feed backlog
+    "serve.backfill",
     # streaming ingest plane: one sliding-window recompute tick
     "stream.tick",
     # device-memory & profiler plane (obs/devmem, utils/timing):

@@ -686,12 +686,18 @@ def _make_split_backend(back_desc: tuple):
         if fit_scint:
             from ..fit.scint_fit import fit_scint_params_cat
 
+            # optional RUNTIME iteration bound (streaming warm-started
+            # ticks): presence of the key selects the dynamic trace of
+            # this same shared jit — the compile cache key (back_desc)
+            # is untouched, and steady-state streaming always passes
+            # the key, so the warm signature is stable
             scint = fit_scint_params_cat(
                 parts["scint_y"], parts["scint_p0"],
                 parts["scint_nobs"], parts["scint_x"],
                 parts["scint_is_t"], parts["scint_spike"],
                 parts["scint_xmax"], parts["scint_valid"],
-                alpha=alpha, steps=lm_steps)
+                alpha=alpha, steps=lm_steps,
+                steps_rt=parts.get("lm_steps_rt"))
         if fit_arc:
             from ..fit.arc_fit import pack_measurement
 
@@ -821,6 +827,24 @@ class _SplitStep:
             return self._result(bfn(full))
 
         return call
+
+    def bind_parts(self, parts, back_fn=None):
+        """Run ONLY the back unit + result packing over an externally
+        computed ``parts`` dict (the streaming plane's incremental
+        front hands its sliding-window update results straight to the
+        shared fitter program, bypassing ``self.front``)."""
+        bfn = self.back if back_fn is None else back_fn
+        full = dict(parts)
+        full.update(self._aux_device())
+        return self._result(bfn(full))
+
+    def instrumented_back(self):
+        """The shared back jit under the SAME obs wrapper
+        ``instrumented()`` uses (instrument_jit memoises on the
+        function object): per-signature ``jit_cache_miss`` accounting
+        is common, so an incremental tick's back call is warm iff the
+        full path already compiled that signature."""
+        return obs.instrument_jit(self.back, self.unit_back)
 
     def instrumented(self, front_aot=None, back_aot=None):
         """The composed step with per-unit obs accounting: compile/
@@ -1069,9 +1093,20 @@ def _make_pipeline_cached(freqs_key, times_key, config, mesh, chan_sharded,
                                   fdop=fdop_np, tdel=tdel_np,
                                   beta=beta_np)
 
-        return _SplitStep(front_jit, back_jit, aux, result_fn, back_desc,
-                          (freqs, times, config, mesh, chan_sharded,
-                           bool(donate), synth), dims)
+        split_step = _SplitStep(front_jit, back_jit, aux, result_fn,
+                                back_desc,
+                                (freqs, times, config, mesh, chan_sharded,
+                                 bool(donate), synth), dims)
+        # geometry the streaming plane's incremental front
+        # (stream/incremental.SlidingSspec) needs to rebuild this exact
+        # front's transform chain as a sliding-window update — inert
+        # for every other consumer
+        split_step.inc_geom = {
+            "config": config, "nf": nchan, "nf_s": nf_s, "W_np": W_np,
+            "dt": dt, "df": df, "crop_rows": crop_rows, "dims": dims,
+            "build_arc_fitter": build_arc_fitter,
+        }
+        return split_step
 
     def step(dyn_batch):
         dyn_batch = jnp.asarray(dyn_batch)

@@ -67,7 +67,9 @@ class SurveyClient:
 
     def submit_stream(self, feed_dir: str, opts: dict | None = None,
                       window: int | None = None, hop: int | None = None,
-                      lane: str | None = None) -> dict:
+                      lane: str | None = None,
+                      incremental: bool | None = None,
+                      resync_every: int | None = None) -> dict:
         """Register one live feed (`stream` job kind — ISSUE 15): the
         worker follows the append-mode feed directory between batch
         claims, re-fitting the last ``window`` time samples every
@@ -75,11 +77,15 @@ class SurveyClient:
         VERSIONED rows — poll ``result(f"{job}.live")`` for the
         current values, or export the whole tracked series.  The job
         completes when the producer finalizes the feed.  Idempotent
-        per (feed path, opts, window/hop).  Returns ``{feed, job,
-        status}``."""
+        per (feed path, opts, window/hop and the incremental knobs
+        when set).  ``incremental=True`` asks the worker for O(hop)
+        sliding-update ticks with periodic exact resync every
+        ``resync_every`` ticks (docs/streaming.md).  Returns ``{feed,
+        job, status}``."""
         job_id, status = self.queue.submit_stream(
             feed_dir, dict(opts or {}), window=window, hop=hop,
-            lane=lane)
+            lane=lane, incremental=incremental,
+            resync_every=resync_every)
         return {"feed": feed_dir, "job": job_id, "status": status}
 
     # -- inspection --------------------------------------------------------

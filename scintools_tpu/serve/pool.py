@@ -56,7 +56,7 @@ from .. import faults, obs
 from ..obs import fleet
 from ..utils.log import get_logger, log_event
 from .queue import (DEFAULT_AFFINITY_DEFER_S, DEFAULT_MEM_DEFER_S,
-                    ClaimHints, JobQueue)
+                    DEFAULT_PIN_DEFER_S, ClaimHints, JobQueue)
 
 HINTS_BASENAME = "hints.json"
 POOL_STATUS_BASENAME = "pool.json"
@@ -97,13 +97,16 @@ def _read_json(path: str) -> dict | None:
 
 def write_hints(queue_dir: str, workers: dict,
                 defer_s: float = DEFAULT_AFFINITY_DEFER_S,
-                mem_defer_s: float = DEFAULT_MEM_DEFER_S) -> str:
+                mem_defer_s: float = DEFAULT_MEM_DEFER_S,
+                pin_defer_s: float = DEFAULT_PIN_DEFER_S) -> str:
     """Atomically rewrite the claim-hints file: ``workers`` maps
-    worker id -> ``{"prefer": [sig, ...], "max_bytes": int | None}``."""
+    worker id -> ``{"prefer": [sig, ...], "max_bytes": int | None,
+    "pins": [feed path, ...]}`` (every entry key optional)."""
     return _write_json(hints_path(queue_dir), {
         "kind": "pool_hints", "v": HINTS_VERSION,
         "ts": round(time.time(), 6), "pid": os.getpid(),
         "defer_s": float(defer_s), "mem_defer_s": float(mem_defer_s),
+        "pin_defer_s": float(pin_defer_s),
         "workers": workers})
 
 
@@ -119,9 +122,10 @@ def read_hints(queue_dir: str) -> dict | None:
 def claim_hints_for(data: dict | None,
                     worker_id: str) -> ClaimHints | None:
     """This worker's :class:`~.queue.ClaimHints` view of a hints
-    payload: its own preferred signatures + headroom bound, and the
-    union of every OTHER worker's preferences (the defer set).  None
-    when the payload carries no workers (claim runs unhinted)."""
+    payload: its own preferred signatures + headroom bound + pinned
+    feeds, and the union of every OTHER worker's preferences/pins (the
+    defer sets).  None when the payload carries no workers (claim runs
+    unhinted)."""
     workers = (data or {}).get("workers") or {}
     if not isinstance(workers, dict) or not workers:
         return None
@@ -131,6 +135,11 @@ def claim_hints_for(data: dict | None,
         str(s) for wid, ent in workers.items()
         if wid != worker_id and isinstance(ent, dict)
         for s in (ent.get("prefer") or ())) - prefer
+    pinned = frozenset(str(p) for p in (mine.get("pins") or ()))
+    pinned_elsewhere = frozenset(
+        str(p) for wid, ent in workers.items()
+        if wid != worker_id and isinstance(ent, dict)
+        for p in (ent.get("pins") or ())) - pinned
     max_bytes = mine.get("max_bytes")
     if not isinstance(max_bytes, (int, float)):
         max_bytes = None
@@ -139,7 +148,14 @@ def claim_hints_for(data: dict | None,
         max_bytes=int(max_bytes) if max_bytes is not None else None,
         defer_s=float(data.get("defer_s", DEFAULT_AFFINITY_DEFER_S)),
         mem_defer_s=float(data.get("mem_defer_s",
-                                   DEFAULT_MEM_DEFER_S)))
+                                   DEFAULT_MEM_DEFER_S)),
+        pinned=pinned, pinned_elsewhere=pinned_elsewhere,
+        # the pin deferral window runs from the hints file's OWN write
+        # stamp (a stream job's queue age is useless for grace — see
+        # queue.DEFAULT_PIN_DEFER_S)
+        pin_ts=float(data.get("ts", 0.0) or 0.0),
+        pin_defer_s=float(data.get("pin_defer_s",
+                                   DEFAULT_PIN_DEFER_S)))
 
 
 def read_pool_status(queue_dir: str) -> dict | None:
@@ -155,9 +171,14 @@ def hints_from_heartbeats(heartbeats, now: float) -> dict:
     """Per-worker hint entries from FRESH heartbeats: ``warm_sigs``
     (published by the worker, newest-capped) -> ``prefer``; the devmem
     headroom (PR 12 — in-use vs limit, the same figure the predictive
-    OOM admission trusts) -> ``max_bytes``.  Stale workers publish no
-    hints: a frozen heartbeat's warmth/headroom describes a process
-    that may be gone."""
+    OOM admission trusts) -> ``max_bytes``; registered live-feed dirs
+    (the ``streams`` payload's per-session ``dir``) -> ``pins``, the
+    feed->worker affinity ``JobQueue.claim`` honours ahead of warm
+    sigs (ISSUE 17).  Stale workers publish no hints (a frozen
+    heartbeat describes a process that may be gone), and a DRAINING
+    worker's feeds are deliberately unpinned — its final beat
+    advertises the hand-back so the survivors re-pin instead of
+    deferring to a worker that is exiting."""
     out: dict[str, dict] = {}
     for hb in heartbeats:
         wid = hb.get("worker")
@@ -172,6 +193,12 @@ def hints_from_heartbeats(heartbeats, now: float) -> dict:
             head = mem.get("headroom")
             if isinstance(head, (int, float)) and head > 0:
                 ent["max_bytes"] = int(head)
+        streams = hb.get("streams")
+        if isinstance(streams, dict) and not hb.get("draining"):
+            pins = sorted({str(s["dir"]) for s in streams.values()
+                           if isinstance(s, dict) and s.get("dir")})
+            if pins:
+                ent["pins"] = pins
         if ent:
             out[str(wid)] = ent
     return out

@@ -134,6 +134,14 @@ DEFAULT_LANE_BUDGETS = {LANE_INTERACTIVE: 3, LANE_BULK: 1}
 # placement, never starve a job no worker advertises room for)
 DEFAULT_AFFINITY_DEFER_S = 2.0
 DEFAULT_MEM_DEFER_S = 30.0
+# feed-pin deferral (ISSUE 17): a stream job pinned to ANOTHER live
+# worker is left alone for this window measured from the PIN's OWN
+# timestamp (hints-file mtime), not the job's queue age — a long-lived
+# stream registration is hours old by the time a drain releases it, so
+# an age-bounded grace would be a no-op.  After the window the feed is
+# claimable by anyone (a pin must route placement, never strand a feed
+# whose pinned worker is gone but not yet reaped).
+DEFAULT_PIN_DEFER_S = 15.0
 
 _LAST_STAMP = 0.0
 
@@ -297,6 +305,29 @@ class ClaimHints:
     max_bytes: int | None = None
     defer_s: float = DEFAULT_AFFINITY_DEFER_S
     mem_defer_s: float = DEFAULT_MEM_DEFER_S
+    # feed->worker pinning (ISSUE 17): feed paths whose ring +
+    # incremental transform state is resident on THIS worker
+    # (``pinned`` — claim eagerly, ahead of every warm-sig hint) or on
+    # some other live worker (``pinned_elsewhere`` — defer for
+    # ``pin_defer_s`` measured from ``pin_ts``, the hints file's own
+    # write stamp)
+    pinned: frozenset = frozenset()
+    pinned_elsewhere: frozenset = frozenset()
+    pin_ts: float = 0.0
+    pin_defer_s: float = DEFAULT_PIN_DEFER_S
+
+
+def stream_feed_of(job: "Job") -> str | None:
+    """The feed path a LIVE `stream` job is bound to — the pinning
+    key; None for every other job kind.  Backfill jobs deliberately
+    don't count: they run the stateless batch path and should land on
+    whatever bulk capacity is free, NOT compete with the pinned
+    worker's live ticks."""
+    spec = job.cfg.get("stream")
+    if isinstance(spec, dict) and not job.cfg.get("backfill"):
+        feed = spec.get("feed")
+        return str(feed) if feed else None
+    return None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -907,7 +938,10 @@ class JobQueue:
 
     def submit_stream(self, feed_dir: str, cfg: dict | None = None,
                       window: int | None = None, hop: int | None = None,
-                      lane: str | None = None) -> tuple[str, str]:
+                      lane: str | None = None,
+                      incremental: bool | None = None,
+                      resync_every: int | None = None
+                      ) -> tuple[str, str]:
         """Register one live feed (`stream` job kind — ISSUE 15):
         ``feed_dir`` is an append-mode feed directory
         (scintools_tpu.stream.ingest) a producer grows chunk-by-chunk;
@@ -918,9 +952,10 @@ class JobQueue:
         timescale tracking across the observation.
 
         The job's identity is (feed path, estimator options, window/
-        hop): re-submitting the same registration dedups; the same
-        feed under different options or window geometry is a different
-        stream (different results).  The feed must already exist with
+        hop, plus the incremental-tick knobs when set): re-submitting
+        the same registration dedups; the same feed under different
+        options or window geometry is a different stream (different
+        results).  The feed must already exist with
         a readable manifest — a typo'd path fails HERE, not after
         burning the retry budget.  ``lane`` defaults to interactive
         (a live observer's feed is exactly what the QoS lanes protect
@@ -939,7 +974,13 @@ class JobQueue:
                                      **({"window": window}
                                         if window is not None else {}),
                                      **({"hop": hop}
-                                        if hop is not None else {})})
+                                        if hop is not None else {}),
+                                     **({"incremental": incremental}
+                                        if incremental is not None
+                                        else {}),
+                                     **({"resync_every": resync_every}
+                                        if resync_every is not None
+                                        else {})})
         # fail fast on a non-feed: FeedReader raises FeedError
         # (ValueError) on a missing/torn manifest
         from ..stream.ingest import FeedReader
@@ -964,13 +1005,73 @@ class JobQueue:
         self._depth_gauge(job_id, lane=lane)
         return job_id, "submitted"
 
+    def submit_backfill(self, feed_dir: str, cfg: dict | None = None,
+                        window: int | None = None,
+                        hop: int | None = None, upto: int = 0,
+                        parent: str | None = None) -> tuple[str, str]:
+        """Enqueue the catch-up lane for a LATE-registered feed
+        (ISSUE 17): one bulk-lane job that replays the already-
+        committed backlog through the chunked batch path — every
+        window whose end sample is ``<= upto`` — publishing the same
+        versioned tick rows the live session would have, while the
+        live registration fast-forwards its cursor past ``upto`` and
+        keeps its tick-latency budget.  Identity is (feed, options,
+        geometry, upto): re-registering the same late feed dedups; a
+        later registration with a bigger backlog is a NEW backfill
+        covering the longer prefix (rows are versioned by window-end
+        key, so overlapping publishes merge instead of duplicating)."""
+        cfg = dict(cfg or {})
+        if cfg.get("synthetic") is not None or cfg.get("compact"):
+            raise ValueError("a backfill job carries only estimator "
+                             "options (no synthetic/compact payload)")
+        from ..stream.window import validate_stream_spec
+
+        spec = validate_stream_spec({"feed": feed_dir,
+                                     **({"window": window}
+                                        if window is not None else {}),
+                                     **({"hop": hop}
+                                        if hop is not None else {})})
+        cfg.pop("stream", None)   # stateless batch replay, not a feed
+        cfg["backfill"] = {**spec, "upto": int(upto),
+                           **({"parent": str(parent)} if parent else {})}
+        validate_job_cfg(cfg)
+        job_id = content_key(("backfill", spec["feed"]),
+                             ("serve",) + cfg_signature(cfg))
+        existing = self.state_of(job_id)
+        if existing is not None:
+            return job_id, existing
+        trace = new_trace_id()
+        fname = f"backfill:{os.path.basename(spec['feed'])}"
+        root = obs.event("job.submit", trace_id=trace, job=job_id,
+                         file=fname, lane=LANE_BULK)
+        est = spec["window"] * 4 * 8   # a few windows staged per chunk
+        self._write(QUEUED, Job(id=job_id, file=fname, cfg=cfg,
+                                submitted_at=_submit_stamp(),
+                                trace_id=trace, span=root,
+                                lane=LANE_BULK, sig=job_sig(cfg),
+                                est_bytes=est))
+        self._depth_gauge(job_id, lane=LANE_BULK)
+        return job_id, "submitted"
+
     # -- worker side -------------------------------------------------------
     def _hint_defer(self, job: Job, hints: ClaimHints,
                     now: float) -> bool:
         """Whether claim hints say to LEAVE this candidate for another
-        worker this poll.  Both deferrals are time-bounded by the
-        job's queue age, so a hint can delay placement but never
-        starve a job nothing else will take."""
+        worker this poll.  Feed pins outrank every other hint: a feed
+        pinned HERE is never deferred (its state lives on this
+        worker), a feed pinned to another LIVE worker is left for it
+        within the pin's own freshness window.  The sig/memory
+        deferrals stay time-bounded by the job's queue age, so a hint
+        can delay placement but never starve a job nothing else will
+        take."""
+        feed = stream_feed_of(job)
+        if feed is not None:
+            if feed in hints.pinned:
+                return False
+            if (feed in hints.pinned_elsewhere
+                    and now - hints.pin_ts < hints.pin_defer_s):
+                obs.inc("feed_pin_deferred")
+                return True
         age = now - job.submitted_at
         if (hints.max_bytes is not None and job.est_bytes
                 and job.est_bytes > hints.max_bytes
@@ -1012,13 +1113,16 @@ class JobQueue:
         elsewhere, taken after its grace window anyway)."""
         now = time.time() if now is None else now
         claimed: list[Job] = []
-        for stamp, jid, path, lane in self._claim_order(lane_budgets):
-            if len(claimed) >= n:
-                break
+        taken: set[str] = set()
+
+        def runnable(jid, path):
+            """The shared claim-candidate gate: duplicate-lease,
+            terminal-survivor and backoff checks; the job record or
+            None."""
             # a queued duplicate of a still-leased job (crash window of
             # a requeue) must not double-execute while the lease lives
             if os.path.exists(self._path(LEASED, jid)):
-                continue
+                return None
             # a queued survivor of a TERMINAL job is garbage, not work:
             # two racing submitters can each land a different-stamp
             # file for one id, and complete()/fail() unlink only the
@@ -1028,19 +1132,22 @@ class JobQueue:
             if os.path.exists(self._path(DONE, jid)) \
                     or os.path.exists(self._path(FAILED, jid)):
                 self._remove_file(path)
-                continue
+                return None
             job = self._read_file(path)
             if job is None or job.not_before > now:
-                continue
-            if hints is not None and self._hint_defer(job, hints, now):
-                continue
+                return None
+            return job
+
+        def attempt(jid, path, lane, job):
+            """Rename-race for one candidate; the leased record or
+            None on a lost race."""
             try:
                 # chaos site (kind="oserror"): a lost claim race — the
                 # winner-take-one rename semantics must skip, not fail
                 faults.check("queue.claim_rename")
                 os.rename(path, self._path(LEASED, jid))
             except OSError:
-                continue  # another worker won this one
+                return None  # another worker won this one
             obs.inc("queue_shard_claims"
                     f"[{self._shard_name(self._shard_of(jid))}]")
             obs.inc(f"lane_claims[{lane}]")
@@ -1059,7 +1166,43 @@ class JobQueue:
             leased = dataclasses.replace(fresh, lease_worker=worker,
                                          lease_expires_at=now + lease_s)
             self._write(LEASED, leased)
-            claimed.append(leased)
+            return leased
+
+        order = list(self._claim_order(lane_budgets))
+        if hints is not None and hints.pinned:
+            # pinned pre-pass: a feed whose device state lives HERE is
+            # claimed ahead of lane budgets and warm-sig hints — its
+            # tick latency is the whole point of the pin.  This pass
+            # reads candidate records beyond the usual head window,
+            # but only while pins exist (a reap/re-registration
+            # transient, not steady state).
+            for stamp, jid, path, lane in order:
+                if len(claimed) >= n:
+                    break
+                job = runnable(jid, path)
+                if job is None:
+                    continue
+                feed = stream_feed_of(job)
+                if feed is None or feed not in hints.pinned:
+                    continue
+                leased = attempt(jid, path, lane, job)
+                if leased is not None:
+                    obs.inc("feed_pins")
+                    claimed.append(leased)
+                    taken.add(jid)
+        for stamp, jid, path, lane in order:
+            if len(claimed) >= n:
+                break
+            if jid in taken:
+                continue
+            job = runnable(jid, path)
+            if job is None:
+                continue
+            if hints is not None and self._hint_defer(job, hints, now):
+                continue
+            leased = attempt(jid, path, lane, job)
+            if leased is not None:
+                claimed.append(leased)
         return claimed
 
     def renew(self, jobs: Sequence[Job], lease_s: float,

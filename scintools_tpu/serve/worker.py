@@ -33,7 +33,18 @@ from ..obs.fleet import FLIGHT_DIRNAME, HEARTBEAT_DIRNAME, HeartbeatWriter
 from ..utils.log import get_logger, log_event
 from ..utils.timing import trace_annotation
 from .batcher import Batch, DynamicBatcher
-from .queue import JobQueue
+from .queue import JobQueue, stream_feed_of
+
+# late-joining feed threshold (ISSUE 17): a registration whose backlog
+# holds at least this many live-cadence ticks catches up through the
+# bulk backfill lane instead of replaying the history tick-by-tick on
+# the live (latency-budgeted) path
+BACKFILL_MIN_TICKS = 8
+# how long a reaper keeps a dead worker's feed pinned to ITSELF after
+# requeueing its expired stream lease: long enough to win the next few
+# claim rounds, short enough that an unclaimed feed (this worker died
+# too / is saturated) falls back to the open pool
+REAPED_PIN_TTL_S = 60.0
 
 
 def config_from_opts(opts: dict):
@@ -232,6 +243,15 @@ class ServeWorker:
         # pool-controller claim hints (control/hints.json), mtime-gated
         self._hints = None
         self._hints_stamp = None
+        # reaper re-pin (ISSUE 17): feed path -> reap stamp for stream
+        # jobs THIS worker requeued off an expired lease — folded into
+        # the claim hints as self-pins so the dead worker's feeds land
+        # here (the reaper already proved it is alive and polling)
+        self._reaped_pins: dict[str, float] = {}
+        # set once the worker has handed its registered feeds back
+        # (drain/exit): the next forced heartbeat advertises it, so
+        # the pool controller drops this worker's pins immediately
+        self._draining = False
         # SLO & alerting plane (obs/slo.py — ISSUE 16): armed only when
         # the queue dir declares objectives (slo.json / SCINT_SLOS);
         # every hot-path hook below is behind one `is not None` check,
@@ -259,8 +279,11 @@ class ServeWorker:
     def _load_hints(self):
         """The pool controller's claim hints for THIS worker, re-parsed
         only when ``control/hints.json`` changes (one stat per poll;
-        absent file = unhinted claim, zero further cost)."""
+        absent file = unhinted claim, zero further cost), plus this
+        worker's own REAPED-feed pins merged in (reaper re-pin works
+        with or without a controller writing hints)."""
         from . import pool
+        from .queue import ClaimHints
 
         path = pool.hints_path(self.queue.dir)
         try:
@@ -268,13 +291,22 @@ class ServeWorker:
         except OSError:
             self._hints = None
             self._hints_stamp = None
-            return None
-        stamp = (st.st_mtime_ns, st.st_size)
-        if stamp != self._hints_stamp:
-            self._hints_stamp = stamp
-            self._hints = pool.claim_hints_for(pool.read_hints(
-                self.queue.dir), self.worker_id)
-        return self._hints
+        else:
+            stamp = (st.st_mtime_ns, st.st_size)
+            if stamp != self._hints_stamp:
+                self._hints_stamp = stamp
+                self._hints = pool.claim_hints_for(pool.read_hints(
+                    self.queue.dir), self.worker_id)
+        hints = self._hints
+        if self._reaped_pins:
+            mine = frozenset(self._reaped_pins)
+            base = hints if hints is not None else ClaimHints()
+            # a reaped feed is pinned HERE even if a stale hints file
+            # still lists the dead worker: the reap is newer evidence
+            hints = dataclasses.replace(
+                base, pinned=base.pinned | mine,
+                pinned_elsewhere=base.pinned_elsewhere - mine)
+        return hints
 
     def _reload_slos(self) -> None:
         """Arm/refresh the SLO plane when ``<queue>/slo.json`` changes
@@ -338,6 +370,10 @@ class ServeWorker:
                 requeued, poisoned = self.queue.reap_expired(now)
                 self._count_retries(requeued, poisoned,
                                     reason="lease_expired")
+                # a dead pinned worker's feeds re-pin to their REAPER:
+                # the pins land in this round's _load_hints, so the
+                # claim below takes the orphaned streams first
+                self._repin_reaped(requeued, now)
                 jobs = self.queue.claim(self.worker_id,
                                         n=self.batch_size,
                                         lease_s=self._claim_lease_s(),
@@ -370,6 +406,14 @@ class ServeWorker:
                 # unit of work but a REGISTRATION — the session stays
                 # resident and is polled between batch claims below
                 self._register_stream(job)
+                continue
+            if job.cfg.get("backfill") is not None:
+                # `backfill` job kind (ISSUE 17): a late-joined feed's
+                # committed backlog, replayed through the chunked
+                # batch path on the bulk lane — live streams keep
+                # ticking between its chunks
+                self._execute_backfill(job)
+                ran_synth += 1
                 continue
             if job.cfg.get("compact"):
                 # `compact` job kind: results-plane maintenance —
@@ -440,6 +484,28 @@ class ServeWorker:
             obs.inc("jobs_failed")
             log_event(self.log, "job_poisoned", job=job.id,
                       attempts=job.attempts, error=job.error)
+
+    def _repin_reaped(self, requeued, now: float) -> None:
+        """Pin every stream job THIS worker just requeued off an
+        expired lease to itself (ISSUE 17): a dead pinned worker's
+        feed state is gone, the replay has to land SOMEWHERE alive,
+        and the reaper is — by construction — alive and polling.  The
+        self-pin is short-lived (:data:`REAPED_PIN_TTL_S`): once
+        claimed it turns into a real registration (the heartbeat's
+        ``streams`` payload re-pins it through the controller), and an
+        unclaimed one falls back to the open pool."""
+        changed = False
+        for job in requeued:
+            feed = stream_feed_of(job)
+            if feed is not None:
+                self._reaped_pins[feed] = now
+                changed = True
+                log_event(self.log, "stream_repinned", job=job.id,
+                          feed=feed, worker=self.worker_id)
+        if changed or self._reaped_pins:
+            for feed, ts in list(self._reaped_pins.items()):
+                if now - ts > REAPED_PIN_TTL_S:
+                    del self._reaped_pins[feed]
 
     def _job_failed(self, job, error: str, exc=None) -> None:
         """Route a job failure through the error taxonomy
@@ -705,9 +771,11 @@ class ServeWorker:
         obs.inc("serve_stream_jobs")
         spec = job.cfg["stream"]
         try:
-            session = StreamSession(spec["feed"], job.cfg,
-                                    window=spec["window"],
-                                    hop=spec["hop"])
+            session = StreamSession(
+                spec["feed"], job.cfg, window=spec["window"],
+                hop=spec["hop"],
+                incremental=bool(spec.get("incremental", False)),
+                resync_every=spec.get("resync_every"))
         except Exception as e:
             # a vanished feed / torn manifest classifies through the
             # taxonomy (FeedError = ValueError = poison; transient IO
@@ -723,6 +791,10 @@ class ServeWorker:
                 # costs a from-scratch replay, never the stream
                 log_event(self.log, "stream_restore_failed",
                           job=job.id, error=repr(e))
+        else:
+            # fresh registration: a deep backlog catches up through
+            # the bulk backfill lane instead of replaying live
+            self._maybe_backfill(job, session, spec)
         self._streams[job.id] = _StreamState(job=job, session=session,
                                              last_renew=time.time())
         if self._slo is not None and job.trace_id:
@@ -815,7 +887,140 @@ class ServeWorker:
             self.queue.release(st.job)
             log_event(self.log, "stream_released", job=jid,
                       reason=reason)
+        if self._streams:
+            # advertise the hand-back: the next (forced) heartbeat
+            # carries draining=true + an empty streams payload, so the
+            # pool controller drops this worker's pins and a survivor
+            # re-pins the feeds instead of deferring to a ghost
+            self._draining = True
         self._streams.clear()
+
+    def _maybe_backfill(self, job, session, spec: dict) -> None:
+        """Late-joining feed (ISSUE 17): when the already-committed
+        backlog holds at least :data:`BACKFILL_MIN_TICKS` live-cadence
+        ticks, enqueue ONE bulk backfill job for the history (chunked
+        batch replay, versioned rows on the live job's keys) and
+        fast-forward the live session past it — registration-to-first-
+        live-row latency stays O(window), not O(backlog).  The newest
+        hop stays live so the feed publishes immediately.  Submission
+        failure degrades to the old behaviour (live replay)."""
+        from ..stream.window import backfill_tick_ends
+
+        upto = session.reader.total_samples - session.hop
+        if upto < session.window:
+            return
+        ends = [e for e in backfill_tick_ends(
+            session.reader, session.window, session.hop, upto)
+            if e[0] > session.consumed]
+        if len(ends) < BACKFILL_MIN_TICKS:
+            return
+        try:
+            bf_id, state = self.queue.submit_backfill(
+                spec["feed"], cfg=dict(job.cfg),
+                window=session.window, hop=session.hop, upto=upto,
+                parent=job.id)
+        except Exception as e:  # fault-ok: the live path replays
+            log_event(self.log, "backfill_submit_failed", job=job.id,
+                      error=repr(e))
+            return
+        session.skip_ticks_until(upto)
+        obs.inc("backfill_jobs")
+        log_event(self.log, "backfill_submitted", job=bf_id,
+                  parent=job.id, feed=session.name, ticks=len(ends),
+                  upto=upto, state=state)
+
+    def _execute_backfill(self, job) -> None:
+        """Run one `backfill` job: replay every live-cadence window of
+        the feed's committed prefix (``<= upto``) through the CHUNKED
+        batch path, publishing the same versioned row per window-end
+        key the live session would have (``parent`` = the live stream
+        job whose row keys/series this catch-up fills in).  Registered
+        live streams tick BETWEEN chunks, so catch-up throughput never
+        buys head-of-line live latency."""
+        import numpy as np
+
+        from ..data import DynspecData
+        from ..io.results import batch_lane_row
+        from ..parallel import run_pipeline
+        from ..parallel.driver import stage_dtype
+        from ..stream.ingest import FeedReader
+        from ..stream.window import (backfill_tick_ends,
+                                     read_feed_window, stream_row_base)
+
+        spec = job.cfg["backfill"]
+        self.queue.renew([job], self._claim_lease_s())
+        try:
+            reader = FeedReader(spec["feed"])
+            window, hop = int(spec["window"]), int(spec["hop"])
+            upto = int(spec.get("upto", 0))
+            parent = spec.get("parent")
+            opts = {k: v for k, v in job.cfg.items()
+                    if k != "backfill"}
+            cfg = config_from_opts(opts)
+            cfg.validate()
+            ends = backfill_tick_ends(reader, window, hop, upto)
+        except Exception as e:
+            self._job_failed(job, f"backfill setup failed: {e!r}",
+                             exc=e)
+            return
+        obs.inc("serve_backfill_jobs")
+        dtype = np.dtype(stage_dtype(cfg.precision))
+        series = str(parent) if parent else job.id
+        group_n = max(int(self.batch_size), 1)
+        done = 0
+        self.stats["batches"] += 1
+        try:
+            with obs.span("serve.backfill", feed=reader.name,
+                          ticks=len(ends),
+                          trace_ids=[t for t in (job.trace_id,) if t]
+                          ) as bsp:
+                if obs.enabled():
+                    job = self.queue._hop(
+                        job, "job.batch", backfill=True,
+                        ticks=len(ends),
+                        batch_span=getattr(bsp, "span_id", None))
+                for i in range(0, len(ends), group_n):
+                    group = ends[i:i + group_n]
+                    epochs = [DynspecData(
+                        dyn=read_feed_window(reader, end, window,
+                                             dtype).astype(np.float64),
+                        freqs=reader.freqs(),
+                        times=reader.times(window),
+                        mjd=float(reader.manifest.get("mjd", 50000.0)),
+                        name=f"{reader.name}@w{end}")
+                        for end, _tick in group]
+                    for idx, res in run_pipeline(epochs, cfg,
+                                                 async_exec=False):
+                        for lane, ei in enumerate(np.asarray(idx)):
+                            end, tick = group[int(ei)]
+                            row = stream_row_base(reader, window,
+                                                  reader.dt, end,
+                                                  tick, final=False)
+                            row["backfill"] = True
+                            row.update(batch_lane_row(res, lane,
+                                                      cfg.lamsteps))
+                            self.queue.results.put_versioned(
+                                f"{series}.w{end:09d}", row,
+                                series=series)
+                            done += 1
+                    self._flush_rows()
+                    self.queue.renew([job], self._claim_lease_s())
+                    # live feeds keep their latency budget: one stream
+                    # poll between backlog chunks
+                    self._poll_streams()
+        except Exception as e:
+            self._job_failed(job, f"backfill failed: {e!r}", exc=e)
+            log_event(self.log, "backfill_failed", job=job.id,
+                      error=repr(e))
+            return
+        job = self.queue._hop(job, "job.row", rows=done)
+        self.queue.complete(job)
+        self._mark_warm(job)
+        self._job_latency(job)
+        self.stats["jobs_done"] += 1
+        obs.inc("jobs_done")
+        log_event(self.log, "backfill_done", job=job.id,
+                  feed=reader.name, rows=done, upto=upto)
 
     def _execute_compact(self, job) -> None:
         """Run one `compact` job: merge the results store's small
@@ -941,6 +1146,12 @@ class ServeWorker:
                     # untouched) so a surviving worker resumes them
                     self._release_streams(reason="worker_drain")
                     self.queue.clear_worker_drain(self.worker_id)
+                    # the hand-back beat (draining=true, no streams):
+                    # without it the released feeds stay pinned to
+                    # this exiting worker until its heartbeat goes
+                    # stale — exactly the stranding window the
+                    # re-pin protocol exists to close
+                    self._beat(force=True)
                     log_event(self.log, "worker_drained",
                               worker=self.worker_id)
                     break
@@ -1028,6 +1239,10 @@ class ServeWorker:
                 extra["streams"] = {jid: st.session.stats()
                                     for jid, st in
                                     self._streams.items()}
+            if self._draining:
+                # released our feeds: the controller must drop this
+                # worker's pins NOW, not at heartbeat staleness
+                extra["draining"] = True
             if slo_snapshot is not None:
                 extra["slo"] = slo_snapshot
             self.heartbeat.beat(force=force,

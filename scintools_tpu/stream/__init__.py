@@ -12,7 +12,13 @@ axis incrementally; this package is the append-mode counterpart:
   time-lag ACF cut over that ring;
 * :mod:`~scintools_tpu.stream.window` — sliding-window recompute
   ticks whose ``(1, nf, W)`` window shape is ONE fixed bucket-catalog
-  signature, so a warmed session never recompiles per tick.
+  signature, so a warmed session never recompiles per tick;
+* :mod:`~scintools_tpu.stream.incremental` — the ISSUE 17 O(hop)
+  hot path: :class:`~scintools_tpu.stream.incremental.SlidingSspec`
+  (rank-``hop`` sliding update of the time-axis DFT front) and
+  :class:`~scintools_tpu.stream.incremental.IncrementalCuts`
+  (host-f64 pair-sum fitter cuts), re-anchored by periodic exact
+  resync (``resync_every``) — docs/streaming.md "Incremental ticks".
 
 The serve layer registers feeds as a ``stream`` job kind
 (``JobQueue.submit_stream`` / ``scintools-tpu submit QDIR --stream
@@ -23,6 +29,8 @@ across an observation.  docs/streaming.md documents the log format,
 the window/tick semantics and the versioned-row contract.
 """
 
+from .incremental import (DEFAULT_RESYNC_EVERY, IncrementalCuts,
+                          SlidingSspec)
 from .ingest import (FeedError, FeedReader, FeedWriter, IncrementalACF,
                      Ring, chunk_rung, preflight_chunk)
 from .window import (DEFAULT_HOP, DEFAULT_WINDOW, StreamSession,
@@ -33,4 +41,5 @@ __all__ = [
     "chunk_rung", "preflight_chunk",
     "DEFAULT_HOP", "DEFAULT_WINDOW", "StreamSession",
     "validate_stream_spec",
+    "DEFAULT_RESYNC_EVERY", "IncrementalCuts", "SlidingSspec",
 ]

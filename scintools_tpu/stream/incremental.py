@@ -1,0 +1,438 @@
+"""Incremental streaming hot path (ISSUE 17): O(hop) tick updates.
+
+A stream tick re-fits the last ``W`` samples every ``hop`` new ones.
+PR 15 made the tick a single warm compiled signature, but the program
+still recomputes the whole ``[nf, W]`` window from scratch — the
+secondary-spectrum FFT pair, the ACF cuts, a cold-started LM fit.
+This module turns the between-resync ticks into O(hop) work:
+
+* :class:`SlidingSspec` keeps the time-axis DFT of the sspec stage's
+  PREWHITENED window as device-resident state ``S [Rs, ncfft]`` and
+  advances it per hop with a rank-``hop`` update (two small GEMMs for
+  the departing/arriving columns) instead of re-running the 2-D FFT.
+  The decomposition that makes this exact: with the split edge taper
+  ``tw``/``fw`` (flat ones in the interior) and the 2x2 second
+  difference ``p`` of the windowed, mean-subtracted input,
+
+      p = p_flat + p_edge,   p_flat[f, j] = vx[f, j+1] - vx[f, j],
+
+  where ``vx`` is the frequency difference of the ROW-tapered raw
+  window.  ``p_flat`` is column-local and shift-invariant (both global
+  mean subtractions cancel in it), so its transform obeys a sliding
+  DFT recurrence; ``p_edge`` (the taper slopes plus the mean term
+  ``-m * dfw x dtw``) lives on the ~``window_frac*W`` edge columns and
+  is recomputed fresh each tick from those columns only.
+
+* :class:`IncrementalCuts` extends the :class:`~scintools_tpu.stream.
+  ingest.IncrementalACF` machinery from the single zero-freq time-lag
+  cut to the FULL cut pair the scint fitter consumes: raw pair sums
+  over time lags 0..W-1 and freq lags 0..nf-1 slide in O(hop * W * nf)
+  per push; the exact mean-centering (what ``acf_cuts_direct`` bakes
+  into its transform) is applied at read time from the window's
+  column/row sums, so the accumulator itself never needs re-centering.
+
+Both carry the same drift-bounding discipline as ``IncrementalACF``:
+float error from the add/subtract updates accumulates, so every
+``resync_every`` ticks the session runs the FULL path (which is
+byte-identical to the batch pipeline by the PR 14/15 contracts) and
+rebuilds the incremental state from scratch — resync rows are exact,
+between-resync rows sit within a test-pinned drift budget.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+DEFAULT_RESYNC_EVERY = 16
+
+
+class IncrementalCuts:
+    """Both fitter ACF cuts over a sliding window, updated incrementally.
+
+    Maintains the RAW pair sums (no mean subtraction)
+
+        Rt[lag] = sum_{f, j} x[f, j] * x[f, j + lag]     lag = 0..W-1
+        Rf[lag] = sum_{f, t} x[f, t] * x[f + lag, t]     lag = 0..nf-1
+
+    over the ring's host mirror.  A push of ``c`` columns subtracts the
+    evicted columns' pair terms and adds the new ones — one
+    ``[c, nf] x [nf, W]`` GEMM per axis instead of the from-scratch
+    ``[W, nf] x [nf, W]`` — with the same periodic exact resync as
+    :class:`~scintools_tpu.stream.ingest.IncrementalACF`.  The
+    mean-centred cuts the fitter consumes are derived at read time
+    (:meth:`cuts`): with ``m`` the window mean and ``s``/``r`` the
+    column/row sums,
+
+        cut_t[lag] = Rt[lag] - m*(prefix_s[lag] + suffix_s[lag])
+                     + nf*(W-lag)*m^2
+
+    (and symmetrically for ``cut_f``) — an exact expansion of the
+    mean-subtracted correlation, so the accumulator never has to be
+    re-centred when the mean drifts.  Host float64 throughout: this is
+    host bookkeeping, and the wide accumulator is what keeps the
+    between-resync drift at FFT-rounding scale.
+    """
+
+    def __init__(self, window: int, nf: int,
+                 resync_every: int = DEFAULT_RESYNC_EVERY * 4):
+        self.window = int(window)
+        self.nf = int(nf)
+        if self.window < 2 or self.nf < 2:
+            raise ValueError(f"IncrementalCuts: need window >= 2 and "
+                             f"nf >= 2, got ({window}, {nf})")
+        self.resync_every = int(resync_every)
+        self.rt = np.zeros(self.window, dtype=np.float64)  # host-f64: accumulator precision
+        self.rf = np.zeros(self.nf, dtype=np.float64)  # host-f64: accumulator precision
+        self._pushes = 0
+
+    # -- exact anchors ------------------------------------------------------
+    @staticmethod
+    def _diag_sums(M: np.ndarray, n: int) -> np.ndarray:
+        """out[lag] = sum_i M[i, i + lag] for lag = 0..n-1."""
+        out = np.empty(n, dtype=np.float64)  # host-f64: accumulator precision
+        for lag in range(n):
+            out[lag] = M.trace(offset=lag)
+        return out
+
+    def compute(self, win: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """From-scratch raw pair sums — the resync anchor and the
+        parity oracle."""
+        w = np.asarray(win, dtype=np.float64)  # host-f64: accumulator precision
+        rt = self._diag_sums(w.T @ w, self.window)
+        rf = self._diag_sums(w @ w.T, self.nf)
+        return rt, rf
+
+    def resync(self, win: np.ndarray) -> None:
+        self.rt, self.rf = self.compute(win)
+
+    # -- the incremental update ---------------------------------------------
+    def push(self, before: np.ndarray, after: np.ndarray,
+             c: int) -> None:
+        """Advance over one ring push: ``before``/``after`` are the
+        host windows around it, ``c`` the slide width (mirror of
+        ``IncrementalACF.push``)."""
+        c = min(int(c), self.window)
+        self._pushes += 1
+        if self._pushes % self.resync_every == 0 or c >= self.window:
+            self.resync(after)
+            return
+        W = self.window
+        bf = np.asarray(before, dtype=np.float64)  # host-f64: accumulator precision
+        af = np.asarray(after, dtype=np.float64)  # host-f64: accumulator precision
+        # time lags: a pair (i, i+lag) is lost iff its EARLIER member
+        # sits in the evicted leading c columns (the later member then
+        # cannot be older), gained iff its LATER member sits in the new
+        # trailing c columns
+        G = bf[:, :c].T @ bf                      # [c, W]
+        lost = np.zeros(W, dtype=np.float64)  # host-f64: accumulator precision
+        for i in range(c):
+            lost[:W - i] += G[i, i:]
+        H = af.T @ af[:, W - c:]                  # [W, c]
+        gained = np.zeros(W, dtype=np.float64)  # host-f64: accumulator precision
+        for q in range(c):
+            j = W - c + q
+            gained[:j + 1] += H[j::-1, q]
+        self.rt = self.rt - lost + gained
+        # freq lags are column-separable: each column contributes its
+        # own nf x nf Gram diagonals, so evicted/added columns
+        # subtract/add independently
+        E = bf[:, :c]
+        N = af[:, W - c:]
+        self.rf = (self.rf - self._diag_sums(E @ E.T, self.nf)
+                   + self._diag_sums(N @ N.T, self.nf))
+
+    # -- the fitter-facing read ---------------------------------------------
+    def cuts(self, win: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """The mean-subtracted (cut_t [W], cut_f [nf]) pair, exactly as
+        ``acf_cuts_direct`` defines them, from the raw accumulators
+        plus the window's column/row sums (O(nf * W))."""
+        w = np.asarray(win, dtype=np.float64)  # host-f64: accumulator precision
+        W, nf = self.window, self.nf
+        s = w.sum(axis=0)                        # [W] column sums
+        r = w.sum(axis=1)                        # [nf] row sums
+        tot = float(s.sum())
+        m = tot / (nf * W)
+        cs = np.concatenate([[0.0], np.cumsum(s)])
+        lag_t = np.arange(W)
+        # earlier members: cols 0..W-1-lag; later members: cols lag..W-1
+        cut_t = (self.rt - m * (cs[W - lag_t] + (tot - cs[lag_t]))
+                 + nf * (W - lag_t) * m * m)
+        cr = np.concatenate([[0.0], np.cumsum(r)])
+        rtot = float(r.sum())
+        lag_f = np.arange(nf)
+        cut_f = (self.rf - m * (cr[nf - lag_f] + (rtot - cr[lag_f]))
+                 + (nf - lag_f) * W * m * m)
+        return cut_t, cut_f
+
+
+def sliding_unsupported(config) -> str | None:
+    """Why ``config`` cannot run the incremental sspec update (None if
+    it can).  The decomposition needs the default prewhitened chain:
+    the 2x2 second difference is what makes the interior columns
+    shift-invariant, and the fused-kernel route is a different (not
+    byte-matching) epilogue."""
+    if not config.prewhite:
+        return ("incremental ticks need prewhite=True (the second "
+                "difference is what cancels the window means in the "
+                "sliding state)")
+    if config.fused_sspec:
+        return ("incremental ticks update the default sspec chain; "
+                "disable fused_sspec for streaming sessions")
+    if not config.split_programs:
+        return "incremental ticks ride the split-programs back-end"
+    return None
+
+
+class SlidingSspec:
+    """Device-resident sliding-window secondary-spectrum front.
+
+    Built from a split pipeline step's geometry
+    (``_SplitStep.inc_geom``) for one ``(window, hop)``; holds the
+    transform state ``S [Rs, ncfft]`` (the freq-rfft x time-fft of the
+    shift-invariant prewhitened interior, delay rows cropped to the
+    consumed ``Rs``) plus the resampled lead-column buffer the next
+    departure update needs.  Two jitted programs:
+
+    * ``rebuild(win)``: from-scratch state (first tick / resync).
+    * ``advance(S, lead, win, cut_t, cut_f)``: one hop-slide — the
+      sliding-DFT recurrence ``S' = twiddle * (S - D) + A`` with
+      departing/arriving contributions as ``[Rs, nf_s-1] x
+      [nf_s-1, hop] x [hop, ncfft]`` GEMMs, plus the fresh edge-taper
+      correction, then the exact epilogue of ``ops.sspec._sspec_jax``
+      (|.|^2, Doppler fftshift, positive-delay crop, postdark, dB) and
+      the same parts dict the split front hands the fitter back-end.
+
+    The bases (cropped rfft matrix ``Fr``, the column DFT bases, the
+    taper slopes) are host-precomputed in f64 and embedded as
+    canonical-dtype trace constants.
+    """
+
+    def __init__(self, step, window: int, hop: int):
+        geom = getattr(step, "inc_geom", None)
+        if geom is None:
+            raise ValueError("SlidingSspec needs a split pipeline step "
+                             "(PipelineConfig.split_programs)")
+        cfg = geom["config"]
+        reason = sliding_unsupported(cfg)
+        if reason:
+            raise ValueError(reason)
+        W = int(window)
+        hop = int(hop)
+        if not 1 <= hop < W:
+            raise ValueError(f"SlidingSspec: need 1 <= hop < window, "
+                             f"got hop={hop}, window={W}")
+        self.window, self.hop = W, hop
+        self.cfg = cfg
+        self.nf = int(geom["nf"])
+        self.nf_s = int(geom["nf_s"])
+        self._W_np = geom["W_np"]          # [nf_s, nf] or None
+        self.dt, self.df = geom["dt"], geom["df"]
+        self.dims = dict(geom["dims"])
+        self._build_arc_fitter = geom["build_arc_fitter"]
+        from ..ops.sspec import fft_lens
+        from ..ops.windows import split_window
+
+        nrfft, ncfft = fft_lens(self.nf_s, W, cfg.fft_lens)
+        self.nrfft, self.ncfft = nrfft, ncfft
+        crop = geom["crop_rows"]
+        self.Rs = int(crop) if crop is not None else nrfft // 2 + 1
+        self.final_rows = min(self.Rs, nrfft // 2)
+        # split edge tapers on the RESAMPLED grid (the order the front
+        # applies them: resample first, window inside sspec)
+        if cfg.window is not None:
+            tw = split_window(W, cfg.window, cfg.window_frac)
+            fw = split_window(self.nf_s, cfg.window, cfg.window_frac)
+            m = int(np.floor(cfg.window_frac * W))
+            cut = int(np.ceil(m / 2))
+        else:
+            tw, fw = np.ones(W), np.ones(self.nf_s)
+            m = cut = 0
+        self._tw, self._fw = tw, fw
+        # edge-column support of p_edge + the mean term: head columns
+        # j in [0, cut), tail columns j in [W-(m-cut)-1, W-1)
+        self._head = cut
+        self._jt0 = W - (m - cut) - 1 if m > cut else None
+        self._bases = self._build_bases()
+        self._advance_fn = None
+        self._rebuild_fn = None
+        # live state (device arrays once built)
+        self.S = None
+        self.lead = None
+
+    # -- host-precomputed constants -----------------------------------------
+    def _build_bases(self) -> dict:
+        W, hop, ncfft, nrfft = (self.window, self.hop, self.ncfft,
+                                self.nrfft)
+        k = np.arange(ncfft)
+        tau = -2j * np.pi / ncfft
+        b: dict = {}
+        # cropped freq-axis rfft as a direct GEMM (Rs rows only — the
+        # whole point of sspec_crop is that nothing past them is read)
+        r = np.arange(self.Rs)
+        f = np.arange(self.nf_s - 1)
+        b["Fr"] = np.exp(-2j * np.pi * np.outer(r, f) / nrfft)
+        b["twid"] = np.exp(-tau * hop * k)          # shift phase w^-hop*k
+        b["Bd"] = np.exp(tau * np.outer(np.arange(hop), k))
+        b["Ba"] = np.exp(tau * np.outer(W - 1 - hop + np.arange(hop), k))
+        tw, fw = self._tw, self._fw
+        b["dfw"] = fw[1:] - fw[:-1]                  # [nf_s - 1]
+        if self._head:
+            h = self._head
+            b["Beh"] = np.exp(tau * np.outer(np.arange(h), k))
+            b["eh0"], b["eh1"] = tw[:h] - 1.0, tw[1:h + 1] - 1.0
+            b["dtw_h"] = tw[1:h + 1] - tw[:h]
+        if self._jt0 is not None:
+            j0 = self._jt0
+            n_t = (W - 1) - j0
+            b["Bet"] = np.exp(tau * np.outer(j0 + np.arange(n_t), k))
+            b["et0"] = tw[j0:W - 1] - 1.0
+            b["et1"] = tw[j0 + 1:W] - 1.0
+            b["dtw_t"] = tw[j0 + 1:W] - tw[j0:W - 1]
+        return b
+
+    # -- traced helpers ------------------------------------------------------
+    def _programs(self):
+        """Build (once) the jitted rebuild/advance programs."""
+        if self._advance_fn is not None:
+            return self._rebuild_fn, self._advance_fn
+        import jax
+        import jax.numpy as jnp
+
+        from ..fit.scint_fit import scint_cat_front
+        from ..ops.sspec import _postdark
+
+        cfg = self.cfg
+        W, hop, nf_s = self.window, self.hop, self.nf_s
+        nrfft, ncfft = self.nrfft, self.ncfft
+        Rs, final_rows = self.Rs, self.final_rows
+        fdt = jnp.result_type(float)
+        cdt = jnp.result_type(1j * np.float32(1))
+        bases = {k: jnp.asarray(v, dtype=cdt if np.iscomplexobj(v)
+                                else fdt)
+                 for k, v in self._bases.items()}
+        fw = jnp.asarray(self._fw, dtype=fdt)
+        Wm = (None if self._W_np is None
+              else jnp.asarray(self._W_np, dtype=fdt))
+        svec = (self._W_np.sum(axis=0) if self._W_np is not None
+                else np.ones(self.nf))
+        svec = jnp.asarray(svec, dtype=fdt)
+        head, jt0 = self._head, self._jt0
+        rung = self.dims.get("scint_rung")
+        dt, df = self.dt, self.df
+        fit_scint, fit_arc = cfg.fit_scint, cfg.fit_arc
+        build_fitter = self._build_arc_fitter
+        nf_raw = self.nf
+        pd = _postdark(nrfft, ncfft, xp=np)[:final_rows]  # host-f64: grid constant, cast below
+
+        def resample(cols):
+            if Wm is None:
+                return cols
+            return jnp.einsum("lf,fk->lk", Wm, cols)
+
+        def vx_of(cols):
+            """Frequency difference of the row-tapered columns."""
+            ub = cols * fw[:, None]
+            return ub[1:] - ub[:-1]
+
+        def pflat_of(cols):
+            v = vx_of(cols)
+            return v[:, 1:] - v[:, :-1]
+
+        def edge_correction(win_f, m_g):
+            """(Fr @ p_edge) @ Be over the taper-support columns; the
+            mean term -m*dfw x dtw shares the support and folds in."""
+            F = jnp.zeros((Rs, ncfft), dtype=cdt)
+            if head:
+                xh = resample(win_f[:, :head + 1])
+                vh = vx_of(xh)                       # [nf_s-1, head+1]
+                pe = (bases["eh1"][None, :] * vh[:, 1:]
+                      - bases["eh0"][None, :] * vh[:, :-1]
+                      - m_g * (bases["dfw"][:, None]
+                               * bases["dtw_h"][None, :]))
+                F = F + (bases["Fr"] @ pe.astype(cdt)) @ bases["Beh"]
+            if jt0 is not None:
+                xt = resample(win_f[:, jt0:])
+                vt = vx_of(xt)
+                pe = (bases["et1"][None, :] * vt[:, 1:]
+                      - bases["et0"][None, :] * vt[:, :-1]
+                      - m_g * (bases["dfw"][:, None]
+                               * bases["dtw_t"][None, :]))
+                F = F + (bases["Fr"] @ pe.astype(cdt)) @ bases["Bet"]
+            return F
+
+        def epilogue(T):
+            """Mirror of ops.sspec._sspec_jax past the FFT: |.|^2,
+            Doppler fftshift, positive-delay rows, postdark, dB."""
+            sec = jnp.real(T) ** 2 + jnp.imag(T) ** 2
+            sec = jnp.fft.fftshift(sec, axes=-1)[:final_rows]
+            sec = sec / jnp.asarray(pd, dtype=sec.dtype)
+            return 10.0 * jnp.log10(sec)
+
+        def rebuild(win):
+            win_f = win.astype(fdt)
+            x = resample(win_f)
+            P = jnp.fft.fft(pflat_of(x).astype(cdt), n=ncfft, axis=-1)
+            S = bases["Fr"] @ P
+            return S, x[:, :hop + 1]
+
+        def advance(S, lead, win, cut_t, cut_f):
+            win_f = win.astype(fdt)
+            # departing columns (old window's lead buffer) and arriving
+            # columns (new window's tail) as rank-hop GEMM updates
+            D = (bases["Fr"] @ pflat_of(lead).astype(cdt)) @ bases["Bd"]
+            tail = resample(win_f[:, W - hop - 1:])
+            A = (bases["Fr"] @ pflat_of(tail).astype(cdt)) @ bases["Ba"]
+            S2 = bases["twid"][None, :] * (S - D) + A
+            m_g = jnp.einsum("f,ft->", svec, win_f) / (nf_s * W)
+            T = S2 + edge_correction(win_f, m_g)
+            parts = {}
+            if fit_scint:
+                parts.update(scint_cat_front(
+                    cut_t.astype(fdt)[None], cut_f.astype(fdt)[None],
+                    dt, df, rung))
+            if fit_arc:
+                sec_b = epilogue(T)[None]
+                fitter = build_fitter((1, nf_raw, W),
+                                      win.dtype.itemsize)
+                prof, noise = jax.vmap(fitter.profile_of)(sec_b)
+                parts["prof"] = prof
+                parts["noise"] = noise
+            lead2 = resample(win_f[:, :hop + 1])
+            return S2, lead2, parts
+
+        self._rebuild_fn = jax.jit(rebuild)
+        self._advance_fn = jax.jit(advance)
+        return self._rebuild_fn, self._advance_fn
+
+    # -- session-facing API --------------------------------------------------
+    @property
+    def ready(self) -> bool:
+        return self.S is not None
+
+    def reset(self) -> None:
+        """Drop the device state (restore / divergence): the next tick
+        must be a full-path rebuild."""
+        self.S = None
+        self.lead = None
+
+    def rebuild(self, win_dev) -> None:
+        """From-scratch state over the current device window (runs at
+        every full-path/resync tick — the drift re-anchor)."""
+        rebuild_fn, _ = self._programs()
+        self.S, self.lead = rebuild_fn(win_dev)
+
+    def advance(self, win_dev, cut_t=None, cut_f=None) -> dict:
+        """One hop-slide: update the state and return the split
+        back-end ``parts`` dict for the new window."""
+        if self.S is None:
+            raise RuntimeError("SlidingSspec.advance before rebuild")
+        _, advance_fn = self._programs()
+        if cut_t is None:
+            cut_t = np.zeros(self.window, dtype=np.float32)
+        if cut_f is None:
+            cut_f = np.zeros(self.nf, dtype=np.float32)
+        self.S, self.lead, parts = advance_fn(
+            self.S, self.lead, win_dev,
+            np.asarray(cut_t, dtype=np.float32),
+            np.asarray(cut_f, dtype=np.float32))
+        return parts

@@ -514,7 +514,11 @@ class IncrementalACF:
     normalised cut's half-power lag (:meth:`halfwidth_s`) is the live
     timescale proxy each tick row carries beside the canonical warm
     compiled tau/dnu fit (which is never derived from this
-    accumulator)."""
+    accumulator).  The ISSUE 17 incremental tick path generalises
+    this push discipline to the fitter's own inputs —
+    :class:`~scintools_tpu.stream.incremental.IncrementalCuts`
+    maintains BOTH fit cuts with the same evict/add pair-sum update
+    and resync cadence."""
 
     def __init__(self, window: int, nlags: int | None = None,
                  resync_every: int = ACF_RESYNC_EVERY):

@@ -35,6 +35,7 @@ by window-end sample.
 
 from __future__ import annotations
 
+import os
 import time
 
 import numpy as np
@@ -42,6 +43,8 @@ import numpy as np
 from .. import faults, obs
 from ..obs.hist import Hist
 from ..utils.log import get_logger, log_event
+from .incremental import (DEFAULT_RESYNC_EVERY, IncrementalCuts,
+                          SlidingSspec, sliding_unsupported)
 from .ingest import (FeedError, FeedReader, IncrementalACF, Ring,
                      mask_chunk, preflight_chunk)
 
@@ -52,10 +55,12 @@ MIN_WINDOW = 8
 
 def validate_stream_spec(spec: dict) -> dict:
     """Normalise/validate a ``stream`` job payload ``{feed, window,
-    hop}`` — ONE rule site shared by ``JobQueue.submit_stream`` (the
-    client-side fail-fast) and the worker's registration path."""
-    import os
-
+    hop}`` plus the optional incremental-tick knobs — ONE rule site
+    shared by ``JobQueue.submit_stream`` (the client-side fail-fast)
+    and the worker's registration path.  ``incremental`` /
+    ``resync_every`` are included in the normalised payload ONLY when
+    explicitly set, so pre-existing submitted jobs keep their content
+    identity."""
     spec = dict(spec or {})
     feed = spec.get("feed")
     if not feed:
@@ -68,7 +73,89 @@ def validate_stream_spec(spec: dict) -> dict:
     if not 1 <= h <= w:
         raise ValueError(f"stream hop={h}: need 1 <= hop <= window "
                          f"({w})")
-    return {"feed": os.path.abspath(str(feed)), "window": w, "hop": h}
+    out = {"feed": os.path.abspath(str(feed)), "window": w, "hop": h}
+    if "incremental" in spec:
+        inc = bool(spec["incremental"])
+        if inc and h >= w:
+            raise ValueError(f"stream incremental=True needs hop < "
+                             f"window, got hop={h}, window={w}")
+        out["incremental"] = inc
+    if "resync_every" in spec:
+        r = int(spec["resync_every"])
+        if r < 1:
+            raise ValueError(f"stream resync_every={r}: need >= 1")
+        out["resync_every"] = r
+    return out
+
+
+def read_feed_window(reader: FeedReader, end: int, window: int,
+                     dtype) -> np.ndarray:
+    """The masked ``[nf, window]`` block ending at committed sample
+    ``end``, replayed from the feed log.  Masking is chunk-local
+    (:func:`~.ingest.mask_chunk`), so this replays to the SAME bytes
+    the live ring held over that span — the property both crash
+    recovery (:meth:`StreamSession.restore`) and the backfill lane's
+    catch-up windows rely on."""
+    out = np.zeros((reader.nf, window), dtype=dtype)
+    start_want = end - window
+    for start, rec in reader.chunks_since(0):
+        cend = start + int(rec["nt"])
+        if cend <= max(start_want, 0) or start >= end:
+            continue
+        arr = np.asarray(reader.read_chunk(rec))
+        if preflight_chunk(arr):
+            arr = mask_chunk(arr)
+        arr = arr.astype(dtype)
+        lo = max(start_want - start, 0)
+        hi = int(rec["nt"]) - max(cend - end, 0)
+        piece = arr[:, lo:hi]
+        pos = window - (end - (start + lo))
+        out[:, pos:pos + piece.shape[1]] = piece
+    return out
+
+
+def backfill_tick_ends(reader: FeedReader, window: int, hop: int,
+                       upto: int) -> list[tuple[int, int]]:
+    """``(window_end, tick_index)`` for every live-cadence tick whose
+    end sample is ``<= upto`` — the manifest replayed under the SAME
+    cadence rule the live session uses (full ring, then every chunk
+    boundary >= hop samples since the last tick), so the backfill
+    lane's rows land on exactly the window-end keys AND the 1-based
+    tick numbers the skipped live ticks would have published."""
+    ends: list[tuple[int, int]] = []
+    consumed = 0
+    last = None
+    tick = 1
+    for rec in reader.manifest["chunks"]:
+        consumed += int(rec["nt"])
+        if consumed < window:
+            continue
+        if last is None or consumed - last >= hop:
+            if consumed > upto:
+                break
+            ends.append((consumed, tick))
+            last = consumed
+            tick += 1
+    return ends
+
+
+def stream_row_base(reader: FeedReader, window: int, dt: float,
+                    window_end: int, tick: int, final: bool) -> dict:
+    """The identity/axis columns of one stream result row — shared by
+    the live session's ticks and the backfill lane's catch-up rows so
+    both publish the same schema for the same window-end key."""
+    man = reader.manifest
+    freqs = reader.freqs()
+    df = float(freqs[1] - freqs[0]) if len(freqs) > 1 else 1.0
+    return {
+        "name": f"{reader.name}@{'final' if final else 'w%d' % window_end}",
+        "mjd": float(man.get("mjd", 50000.0)),
+        "freq": round(float(np.mean(freqs)), 2),
+        "bw": float(abs(freqs[-1] - freqs[0])) + abs(df),
+        "tobs": window * dt, "dt": dt, "df": df,
+        "window_end": int(window_end), "tick": int(tick),
+        "window": int(window), "final": bool(final),
+    }
 
 
 class StreamSession:
@@ -81,12 +168,16 @@ class StreamSession:
 
     def __init__(self, feed_dir: str, opts: dict | None = None,
                  window: int = DEFAULT_WINDOW, hop: int = DEFAULT_HOP,
-                 nlags: int | None = None):
+                 nlags: int | None = None, incremental: bool = False,
+                 resync_every: int | None = None):
+        import dataclasses
+
         from ..parallel.driver import stage_dtype
         from ..serve.worker import config_from_opts
 
         spec = validate_stream_spec({"feed": feed_dir, "window": window,
-                                     "hop": hop})
+                                     "hop": hop,
+                                     "incremental": incremental})
         self.window = spec["window"]
         self.hop = spec["hop"]
         self.opts = dict(opts or {})
@@ -95,6 +186,19 @@ class StreamSession:
         if self.cfg.arc_stack:
             raise ValueError("arc_stack is a campaign knob; a stream "
                              "tick fits one window")
+        self.incremental = bool(incremental)
+        self.resync_every = int(resync_every or DEFAULT_RESYNC_EVERY)
+        if self.incremental:
+            # incremental ticks ride the split-programs back-end (the
+            # warm-start seed is a runtime input of its shared fitter
+            # unit); forcing the split here is shape-safe — streaming
+            # is single-device, fixed-signature by construction
+            if not self.cfg.split_programs:
+                self.cfg = dataclasses.replace(self.cfg,
+                                               split_programs=True)
+            reason = sliding_unsupported(self.cfg)
+            if reason:
+                raise ValueError(f"stream incremental=True: {reason}")
         self.cfg.validate()
         self.reader = FeedReader(spec["feed"])
         self.freqs = self.reader.freqs()
@@ -117,6 +221,23 @@ class StreamSession:
         self.tick_hist = Hist()
         self._last_chunk_t = None   # producer wall stamp of newest
         self._stepfn = None         # consumed chunk (lag readout)
+        # incremental-tick state (inert unless self.incremental)
+        self._step_obj = None       # the _SplitStep behind _stepfn
+        self._sliding = None        # SlidingSspec device state
+        self.cuts = (IncrementalCuts(self.window, self.nf)
+                     if self.incremental and self.cfg.fit_scint
+                     else None)
+        self._ticks_since_resync = 0
+        self._prev_params = None    # last tick's [tau, dnu, amp, wn]
+        self._quar_since_tick = False
+        self.inc_ticks = 0
+        self.resyncs = 0
+        # backfill fast-forward (ISSUE 17): live-cadence ticks whose
+        # window end is <= this cursor are SKIPPED (bookkeeping only,
+        # no device work) — a submitted backfill job publishes their
+        # rows through the batch path instead
+        self._skip_upto = 0
+        self.skipped_ticks = 0
         self.log = get_logger()
 
     # -- identity / durability ---------------------------------------------
@@ -137,7 +258,8 @@ class StreamSession:
                 "tick_seq": int(self.tick_seq),
                 "last_tick_at": self.last_tick_at,
                 "quarantined": dict(self.quarantined),
-                "final_done": bool(self.final_done)}
+                "final_done": bool(self.final_done),
+                "skip_upto": int(self._skip_upto)}
 
     def restore(self, state: dict) -> None:
         """Resume from a :meth:`state` cursor: replay the last W
@@ -154,31 +276,25 @@ class StreamSession:
             log_event(self.log, "stream_cursor_ahead", feed=self.name,
                       cursor=consumed, committed=total)
             return
-        window = np.zeros((self.nf, self.window),
-                          dtype=self._stage_dtype)
-        filled = 0
-        start_want = consumed - self.window
-        for start, rec in self.reader.chunks_since(0):
-            end = start + int(rec["nt"])
-            if end <= max(start_want, 0) or start >= consumed:
-                continue
-            arr = np.asarray(self.reader.read_chunk(rec))
-            if preflight_chunk(arr):
-                arr = mask_chunk(arr)
-            arr = arr.astype(self._stage_dtype)
-            lo = max(start_want - start, 0)
-            hi = int(rec["nt"]) - max(end - consumed, 0)
-            piece = arr[:, lo:hi]
-            pos = self.window - (consumed - (start + lo))
-            window[:, pos:pos + piece.shape[1]] = piece
-            filled += piece.shape[1]
+        window = read_feed_window(self.reader, consumed, self.window,
+                                  self._stage_dtype)
+        filled = min(consumed, self.window)
         self.ring.reset(window, consumed)
         self.acf.acf = self.acf.compute(window)
+        if self.cuts is not None:
+            self.cuts.resync(window)
+        if self._sliding is not None:
+            # device transform state cannot be trusted across a resume
+            # boundary: the next tick runs the full path and rebuilds
+            self._sliding.reset()
+        self._prev_params = None
+        self._quar_since_tick = False
         self.consumed = consumed
         self.tick_seq = int(state.get("tick_seq", 0))
         self.last_tick_at = state.get("last_tick_at")
         self.quarantined = dict(state.get("quarantined") or {})
         self.final_done = bool(state.get("final_done", False))
+        self._skip_upto = int(state.get("skip_upto", 0))
         log_event(self.log, "stream_resumed", feed=self.name,
                   consumed=consumed, replayed=filled,
                   ticks=self.tick_seq)
@@ -196,10 +312,17 @@ class StreamSession:
                       feed=self.name, seq=rec.get("seq"),
                       reasons=",".join(reasons))
             arr = mask_chunk(arr)
+            self._quar_since_tick = True
         chunk = arr.astype(self._stage_dtype)
         before = self.ring.window_host()
         self.ring.push(chunk)
         self.acf.push(before, self.ring.window_host(), chunk.shape[1])
+        if self.cuts is not None:
+            # masked chunks flow through here like any other bytes:
+            # the incremental cut state tracks the ring's host mirror,
+            # which already holds the masked window
+            self.cuts.push(before, self.ring.window_host(),
+                           chunk.shape[1])
         self.consumed += int(rec["nt"])
         self._last_chunk_t = rec.get("t")
 
@@ -226,7 +349,10 @@ class StreamSession:
             for _start, rec in self.reader.chunks_since(self.consumed):
                 self._consume(rec)
                 if self._tick_due():
-                    rows.append(self._tick(now=now))
+                    if self.consumed <= self._skip_upto:
+                        self._skip_tick()
+                    else:
+                        rows.append(self._tick(now=now))
             if self.reader.finalized and not self.final_done \
                     and self.consumed >= self.reader.total_samples:
                 final = self._final_tick(now=now)
@@ -236,6 +362,27 @@ class StreamSession:
         finally:
             self._publish_lag(time.time() if now is None else now)
         return rows
+
+    def skip_ticks_until(self, window_end: int) -> None:
+        """Fast-forward the live cadence past a backlog (ISSUE 17):
+        ticks whose window end is ``<= window_end`` advance the tick
+        bookkeeping but run NO device work and publish NO row — the
+        caller has submitted a backfill job that replays those windows
+        through the batch path (same window-end keys, versioned rows).
+        The final tick is never skipped (it runs live regardless, so
+        the byte-identity acceptance gate keeps its anchor).  Durable:
+        the cursor carries the mark, so a crash mid-catch-up resumes
+        skipping instead of replaying the backlog live."""
+        self._skip_upto = max(self._skip_upto, int(window_end))
+
+    def _skip_tick(self) -> None:
+        """Advance past one backfilled tick: same tick_seq/cursor
+        bookkeeping as a real tick (the backfill rows carry the tick
+        indices a live replay would have), zero compute."""
+        self.tick_seq += 1
+        self.last_tick_at = self.consumed
+        self.skipped_ticks += 1
+        self._quar_since_tick = False
 
     @property
     def complete(self) -> bool:
@@ -259,6 +406,10 @@ class StreamSession:
         step = make_pipeline(self.freqs, self.win_times, self.cfg,
                              mesh=None, donate=False)
         if isinstance(step, _SplitStep):
+            self._step_obj = step
+            if self.incremental:
+                self._sliding = SlidingSspec(step, self.window,
+                                             self.hop)
             self._stepfn = step.instrumented()
             return self._stepfn
         aot = None
@@ -275,18 +426,8 @@ class StreamSession:
         return self._stepfn
 
     def _row_base(self, window_end: int, final: bool) -> dict:
-        man = self.reader.manifest
-        freqs = self.freqs
-        df = float(freqs[1] - freqs[0]) if len(freqs) > 1 else 1.0
-        return {
-            "name": f"{self.name}@{'final' if final else 'w%d' % window_end}",
-            "mjd": float(man.get("mjd", 50000.0)),
-            "freq": round(float(np.mean(freqs)), 2),
-            "bw": float(abs(freqs[-1] - freqs[0])) + abs(df),
-            "tobs": self.window * self.dt, "dt": self.dt, "df": df,
-            "window_end": int(window_end), "tick": int(self.tick_seq),
-            "window": int(self.window), "final": bool(final),
-        }
+        return stream_row_base(self.reader, self.window, self.dt,
+                               window_end, self.tick_seq, final)
 
     def _publish_metrics(self, latency: float, now: float) -> None:
         obs.inc("stream_ticks")
@@ -318,18 +459,114 @@ class StreamSession:
 
         return batch_lane_row(res, lane, self.cfg.lamsteps)
 
+    # -- incremental ticks ---------------------------------------------------
+    def _incremental_due(self) -> bool:
+        """Whether THIS tick may take the O(hop) path: device state
+        anchored, the slide since the last tick exactly ``hop`` (an
+        oversize chunk or a catch-up burst changes the shift width the
+        sliding-DFT recurrence was built for), and the resync cadence
+        not yet due."""
+        return (self.incremental
+                and self._sliding is not None
+                and self._sliding.ready
+                and self.last_tick_at is not None
+                and self.consumed - self.last_tick_at == self.hop
+                and self._ticks_since_resync < self.resync_every)
+
+    def _warm_steps(self) -> int:
+        return max(1, int(self.cfg.lm_steps) // 2)
+
+    def _after_full_tick(self) -> None:
+        """Re-anchor the incremental state after a full-path tick: the
+        full program just produced the exact row, so rebuilding from
+        the same device window makes resync ticks byte-identical to
+        the batch path BY CONSTRUCTION (same program, same bytes)."""
+        self._sliding.rebuild(self.ring.window_device())
+        if self.cuts is not None:
+            self.cuts.resync(self.ring.window_host())
+        self._ticks_since_resync = 0
+        self.resyncs += 1
+        obs.inc("tick_resyncs")
+        obs.inc("lm_steps", int(self.cfg.lm_steps))
+
+    def _incremental_tick(self):
+        """The O(hop) tick: slide the sspec transform state, read the
+        incremental cuts, seed the fitter from the previous tick, and
+        run ONLY the shared back unit."""
+        cut_t = cut_f = None
+        if self.cuts is not None:
+            cut_t, cut_f = self.cuts.cuts(self.ring.window_host())
+        parts = self._sliding.advance(self.ring.window_device(),
+                                      cut_t, cut_f)
+        if self.cfg.fit_scint:
+            # a usable seed is a HEALTHY previous fit: finite, interior
+            # (tau/dnu pinned at the 1e-10 LM lower bound mark a
+            # diverged tick — re-seeding there strands the fit), and
+            # not computed over a window a masked chunk has since
+            # rewritten
+            warm = (self._prev_params is not None
+                    and not self._quar_since_tick
+                    and bool(np.all(np.isfinite(self._prev_params)))
+                    and bool(np.all(self._prev_params[:2] > 1e-9))
+                    and bool(np.all(self._prev_params[2:] >= 0)))
+            if warm:
+                steps_rt = self._warm_steps()
+                p0 = np.asarray(
+                    self._prev_params,
+                    dtype=np.dtype(parts["scint_p0"].dtype))[None]
+                parts["scint_p0"] = p0
+                obs.inc("warm_start_seeded")
+            else:
+                # diverged / quarantine-masked tick: cold default seed
+                # at the full iteration budget — but STILL through the
+                # dynamic trace (a stable warm signature beats saving
+                # the key), so steps_rt carries the full count
+                steps_rt = int(self.cfg.lm_steps)
+                obs.inc("warm_start_fallbacks")
+            parts["lm_steps_rt"] = np.int32(steps_rt)
+            obs.inc("lm_steps", steps_rt)
+        res = self._step_obj.bind_parts(
+            parts, back_fn=self._step_obj.instrumented_back())
+        self._ticks_since_resync += 1
+        self.inc_ticks += 1
+        obs.inc("incremental_ticks")
+        return res
+
+    def _remember_fit(self, res) -> None:
+        if not (self.incremental and self.cfg.fit_scint):
+            return
+        s = res.scint
+        if s is None or s.amp is None or s.wn is None:
+            self._prev_params = None
+            return
+        self._prev_params = np.array(
+            [float(np.asarray(s.tau)[0]), float(np.asarray(s.dnu)[0]),
+             float(np.asarray(s.amp)[0]), float(np.asarray(s.wn)[0])])
+
     def _tick(self, now: float | None = None) -> dict:
         """One sliding-window recompute over the HBM-resident ring:
         the fixed-signature compiled fit + the incremental-ACF
-        timescale proxy, emitted as one result row."""
+        timescale proxy, emitted as one result row.  In incremental
+        mode the between-resync ticks take the O(hop) sliding-update
+        path instead of the full program."""
         t0 = time.perf_counter()
         step = self._ensure_step()
+        inc = self._incremental_due()
         with obs.span("stream.tick", feed=self.name,
                       window_end=self.consumed):
-            res = step(self.ring.window_device()[None])
+            if inc:
+                res = self._incremental_tick()
+            else:
+                res = step(self.ring.window_device()[None])
+                if self.incremental and self._sliding is not None:
+                    self._after_full_tick()
+        self._remember_fit(res)
+        self._quar_since_tick = False
         self.tick_seq += 1
         self.last_tick_at = self.consumed
         row = self._row_base(self.consumed, final=False)
+        if inc:
+            row["incremental"] = True
         row.update(self._measure_row(res))
         hw = self.acf.halfwidth_s(self.dt)
         if hw is not None:
@@ -385,7 +622,15 @@ class StreamSession:
         """The per-feed heartbeat/fleet payload."""
         return {
             "feed": self.name, "window": self.window, "hop": self.hop,
+            # the PINNING key (serve/pool folds this into per-worker
+            # claim hints): the feed's absolute path, matching what
+            # queue.stream_feed_of reads off the job payload
+            "dir": os.path.abspath(self.reader.dir),
             "ticks": int(self.tick_seq),
+            "skipped": int(self.skipped_ticks),
+            "incremental": bool(self.incremental),
+            "inc_ticks": int(self.inc_ticks),
+            "resyncs": int(self.resyncs),
             "consumed": int(self.consumed),
             "committed": int(self.reader.total_samples),
             "finalized": self.reader.finalized,

@@ -48,7 +48,7 @@ def test_stream_subtree_is_covered():
     silently drop the discipline)."""
     assert "stream" in check_f32_discipline.SUBTREES
     pkg = os.path.join(REPO, "scintools_tpu")
-    for name in ("ingest.py", "window.py"):
+    for name in ("ingest.py", "window.py", "incremental.py"):
         path = os.path.join(pkg, "stream", name)
         assert os.path.exists(path), path
         hits = check_f32_discipline.find_wide_literals(path)

@@ -44,7 +44,7 @@ def test_stream_subtree_is_covered():
     discipline."""
     assert "stream" in check_fault_discipline.SUBTREES
     pkg = os.path.join(os.path.dirname(_HERE), "scintools_tpu")
-    for name in ("ingest.py", "window.py"):
+    for name in ("ingest.py", "window.py", "incremental.py"):
         assert os.path.exists(os.path.join(pkg, "stream", name)), name
 
 

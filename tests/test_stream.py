@@ -4,6 +4,12 @@ recompute sessions (warm fixed-signature ticks, byte-identical final
 window), the serve `stream` job kind, versioned-row read policy, and
 SIGKILL crash recovery of a streaming worker.
 
+ISSUE 17 adds the incremental hot path: O(hop) sliding-update ticks
+with periodic exact resync (byte-identical to the full path at resync
+ticks, drift-bounded between them), warm-started fits, feed->worker
+pinning honoured by ``JobQueue.claim``, and the bulk backfill lane for
+late-joining feeds.
+
 All pipeline-executing tests share ONE tiny (1, 32, 32) window
 signature (OPTS/W below) so the in-process jit trace is paid once
 across the module."""
@@ -30,7 +36,10 @@ from scintools_tpu.serve.worker import config_from_opts
 from scintools_tpu.stream import (FeedError, FeedReader, FeedWriter,
                                   IncrementalACF, Ring, StreamSession,
                                   chunk_rung, preflight_chunk)
+from scintools_tpu.stream.incremental import IncrementalCuts
 from scintools_tpu.stream.ingest import mask_chunk
+from scintools_tpu.stream.window import (backfill_tick_ends,
+                                         read_feed_window)
 from scintools_tpu.utils.store import ResultsStore
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -645,12 +654,18 @@ def _spawn_stream_worker(qdir, trace, mode, tag):
         stderr=subprocess.STDOUT, text=True)
 
 
-def test_sigkill_streaming_worker_resumes_from_manifest(tmp_path):
+@pytest.mark.parametrize("incremental", [False, True],
+                         ids=["full", "incremental"])
+def test_sigkill_streaming_worker_resumes_from_manifest(tmp_path,
+                                                        incremental):
     """SIGKILL the streaming worker mid-observation; a second worker
     reaps the lease, restores the session from the durable cursor +
     feed manifest, finishes the observation — no duplicate or lost
     versioned rows, and the trace chain stays causally linked across
-    the three pids (PR 10 contract)."""
+    the three pids (PR 10 contract).  Parametrized over the ISSUE 17
+    incremental path: a restored session re-anchors its device state
+    (the next tick resyncs), so replay stability is the same
+    window-end key set either way."""
     total = W + 4 * HOP
     ep = synth_arc_epoch(nf=NF, nt=total, seed=1)
     d, writer = _feed_from_epoch(tmp_path, ep, subdir="feed")
@@ -660,7 +675,9 @@ def test_sigkill_streaming_worker_resumes_from_manifest(tmp_path):
     submit_trace = os.path.join(qdir, "submit.jsonl")
     with obs.tracing(jsonl=submit_trace):
         client = SurveyClient(qdir)
-        rec = client.submit_stream(d, OPTS, window=W, hop=HOP)
+        rec = client.submit_stream(
+            d, OPTS, window=W, hop=HOP,
+            incremental=True if incremental else None)
         assert rec["status"] == "submitted"
     jid = rec["job"]
     # first half of the observation arrives
@@ -732,6 +749,429 @@ def test_sigkill_streaming_worker_resumes_from_manifest(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# ISSUE 17: incremental ticks — resync identity, drift budget, warm fits
+# ---------------------------------------------------------------------------
+
+# the parity run needs a CONVERGED fitter: at the module's truncated
+# lm_steps=3 both paths are iteration-dominated and tau/dnu reflect
+# the truncation order, not the incremental state (betaeta tracks at
+# ~1e-5 regardless — the sliding sspec state is f32-rounding exact).
+# split_programs pinned on BOTH sessions so resync byte-identity is
+# same-program, same-bytes by construction.
+INC_OPTS = dict(OPTS, lm_steps=20, split_programs=True)
+
+
+def test_incremental_cuts_track_direct_oracle():
+    """IncrementalCuts push-updates vs the from-scratch oracle: the
+    raw pair-sum accumulators AND the mean-centred fitter cuts stay at
+    f64-accumulation scale across many slides with no resync; an
+    oversize slide collapses to an exact resync."""
+    rng = np.random.default_rng(2)
+    Wc, nfc = 24, 8
+    ring = Ring(nfc, Wc)
+    cuts = IncrementalCuts(Wc, nfc, resync_every=10 ** 9)
+    oracle = IncrementalCuts(Wc, nfc)
+    for _ in range(50):
+        c = int(rng.integers(1, 7))
+        chunk = rng.standard_normal((nfc, c)).astype(np.float32)
+        before = ring.window_host()
+        ring.push(chunk)
+        cuts.push(before, ring.window_host(), c)
+    win = ring.window_host()
+    rt, rf = oracle.compute(win)
+    scale = max(abs(rt[0]), 1e-30)
+    assert np.max(np.abs(cuts.rt - rt)) / scale < 1e-10
+    assert np.max(np.abs(cuts.rf - rf)) / scale < 1e-10
+    oracle.resync(win)
+    ct_o, cf_o = oracle.cuts(win)
+    ct, cf = cuts.cuts(win)
+    assert np.max(np.abs(ct - ct_o)) / max(abs(ct_o[0]), 1e-30) < 1e-10
+    assert np.max(np.abs(cf - cf_o)) / max(abs(cf_o[0]), 1e-30) < 1e-10
+    big = rng.standard_normal((nfc, Wc + 3)).astype(np.float32)
+    before = ring.window_host()
+    ring.push(big)
+    cuts.push(before, ring.window_host(), big.shape[1])
+    rt2, rf2 = oracle.compute(ring.window_host())
+    np.testing.assert_allclose(cuts.rt, rt2, rtol=1e-12)
+    np.testing.assert_allclose(cuts.rf, rf2, rtol=1e-12)
+
+
+def test_incremental_session_resync_identity_and_drift_budget(tmp_path):
+    """ISSUE 17 acceptance: over one feed (including a masked chunk),
+    the incremental session's resync/full ticks are byte-identical to
+    a full-recompute session's, the between-resync sliding ticks stay
+    inside the pinned drift budget wherever the full-path fit is
+    itself healthy, the warm-started fitter spends strictly fewer LM
+    iterations, and the warm sliding ticks add no compiles."""
+    total = W + 12 * HOP
+    ep = synth_arc_epoch(nf=NF, nt=total, seed=3)
+    dyn = np.asarray(ep.dyn)
+    d1, w1 = _feed_from_epoch(tmp_path, ep, name="full", subdir="full")
+    d2, w2 = _feed_from_epoch(tmp_path, ep, name="inc", subdir="inc")
+    with obs.tracing() as reg:
+        full = StreamSession(d1, INC_OPTS, window=W, hop=HOP)
+        inc = StreamSession(d2, INC_OPTS, window=W, hop=HOP,
+                            incremental=True, resync_every=4)
+        rows_f, rows_i = [], []
+        miss_warm = None
+        i = 0
+        while i < total:
+            c = dyn[:, i:i + HOP].copy()
+            if i == W + 4 * HOP:
+                c[:] = np.nan       # masked chunk mid-stream
+            w1.append(c)
+            w2.append(c)
+            i += HOP
+            rows_f += full.poll()
+            rows_i += inc.poll()
+            if miss_warm is None and inc.inc_ticks >= 1:
+                # first sliding tick traced the advance + dynamic
+                # fitter programs; everything after must run warm
+                miss_warm = reg.counters().get("jit_cache_miss", 0)
+        w1.finalize()
+        w2.finalize()
+        rows_f += full.poll()
+        rows_i += inc.poll()
+        counters = reg.counters()
+    assert len(rows_f) == len(rows_i)
+    assert inc.inc_ticks >= 8 and inc.resyncs >= 3
+    fit_keys = [k for k in ("tau", "dnu", "tauerr", "dnuerr",
+                            "betaeta", "betaetaerr")
+                if k in rows_f[0]]
+    n_inc = 0
+    for rf, ri in zip(rows_f, rows_i):
+        assert rf["window_end"] == ri["window_end"]
+        if not ri.get("incremental"):
+            # resync / full-path ticks: byte-identical to the full
+            # session (same split program over the same ring bytes)
+            assert _rows_same(rf, ri, fit_keys), (rf, ri)
+            continue
+        n_inc += 1
+        # arc curvature rides the sliding sspec state: tight on every
+        # tick (both-NaN = the window itself is arc-degenerate)
+        bf, bi = rf["betaeta"], ri["betaeta"]
+        if math.isnan(bf):
+            assert math.isnan(bi)
+        else:
+            assert abs(bi - bf) / max(abs(bf), 1e-30) < 1e-3, (rf, ri)
+        # tau/dnu: drift-budgeted wherever the full-path fit is itself
+        # interior (a bound-pinned full fit marks the WINDOW as
+        # degenerate — rel error against ~1e-10 is meaningless)
+        for k in ("tau", "dnu"):
+            if np.isfinite(rf[k]) and rf[k] > 1e-8:
+                assert np.isfinite(ri[k]), (k, rf, ri)
+                assert abs(ri[k] - rf[k]) / rf[k] < 0.15, (k, rf, ri)
+    assert n_inc == inc.inc_ticks and n_inc >= 8
+    assert counters["incremental_ticks"] == inc.inc_ticks
+    assert counters["tick_resyncs"] == inc.resyncs
+    # healthy previous ticks seed warm; the masked window forces at
+    # least one cold fallback — and every sliding tick is one or the
+    # other
+    assert counters["warm_start_seeded"] >= 3
+    assert counters["warm_start_fallbacks"] >= 1
+    assert (counters["warm_start_seeded"]
+            + counters["warm_start_fallbacks"]) == inc.inc_ticks
+    # warm-start acceptance: strictly fewer LM iterations than the
+    # same ticks at the full budget (only the incremental session's
+    # fit path feeds the lm_steps counter here)
+    full_budget = (inc.resyncs + inc.inc_ticks) * INC_OPTS["lm_steps"]
+    assert 0 < counters["lm_steps"] < full_budget
+    # ...and nothing recompiled across the warm sliding ticks
+    assert miss_warm is not None
+    assert counters.get("jit_cache_miss", 0) == miss_warm
+
+
+def test_incremental_session_restore_resyncs_and_continues(tmp_path):
+    """Crash-replay on the incremental path: a session restored from
+    the cursor cannot trust device transform state — its next tick
+    runs the full path (re-anchoring the sliding state), and the row
+    matches a never-crashed incremental session's resync row."""
+    total = W + 6 * HOP
+    ep = synth_arc_epoch(nf=NF, nt=total, seed=4)
+    dyn = np.asarray(ep.dyn)
+    d, writer = _feed_from_epoch(tmp_path, ep)
+    s1 = StreamSession(d, INC_OPTS, window=W, hop=HOP,
+                       incremental=True, resync_every=4)
+    i = 0
+    while i < W + 3 * HOP:
+        writer.append(dyn[:, i:i + HOP])
+        i += HOP
+        s1.poll()
+    assert s1.inc_ticks >= 1
+    state = s1.state()
+    s2 = StreamSession(d, INC_OPTS, window=W, hop=HOP,
+                       incremental=True, resync_every=4)
+    s2.restore(state)
+    np.testing.assert_array_equal(s2.ring.window_host(),
+                                  s1.ring.window_host())
+    assert (s2.consumed, s2.tick_seq) == (s1.consumed, s1.tick_seq)
+    writer.append(dyn[:, i:i + HOP])
+    (r2,) = s2.poll()
+    # the restored session's first tick re-anchored: full path, no
+    # incremental flag, and the device state is rebuilt for the next
+    # sliding tick
+    assert not r2.get("incremental")
+    assert s2.resyncs >= 1
+    writer.append(dyn[:, i + HOP:i + 2 * HOP])
+    (r3,) = s2.poll()
+    assert r3.get("incremental")
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 17: backfill lane — cadence determinism, skip fast-forward
+# ---------------------------------------------------------------------------
+
+
+def test_backfill_tick_ends_match_live_cadence(tmp_path):
+    """The manifest replay hands out exactly the (window_end, tick)
+    pairs a live session publishes over the same chunk boundaries —
+    irregular chunk sizes included — so backfill rows land on the
+    identical versioned keys the skipped live ticks would have."""
+    sizes = [7, 5, 9, 3, 6, 4, 8, 5, 7, 6]
+    total = sum(sizes)
+    ep = synth_arc_epoch(nf=NF, nt=total, seed=5)
+    dyn = np.asarray(ep.dyn)
+    d, writer = _feed_from_epoch(tmp_path, ep)
+    sess = StreamSession(d, OPTS, window=W, hop=HOP)
+    rows = []
+    i = 0
+    for nt in sizes:
+        writer.append(dyn[:, i:i + nt])
+        i += nt
+        rows += sess.poll()
+    live = [(r["window_end"], r["tick"]) for r in rows]
+    reader = FeedReader(d)
+    assert backfill_tick_ends(reader, W, HOP, upto=total) == live
+    # a tighter upto truncates, never shifts
+    upto = live[-2][0]
+    assert backfill_tick_ends(reader, W, HOP, upto=upto) == live[:-1]
+    # and the replayed window bytes equal the live ring's
+    np.testing.assert_array_equal(
+        read_feed_window(reader, sess.consumed, W,
+                         sess.ring.window_host().dtype),
+        sess.ring.window_host())
+
+
+def test_skip_ticks_fastforward_and_cursor_roundtrip(tmp_path):
+    """skip_ticks_until: due ticks at or below the mark advance the
+    tick bookkeeping with NO device work and NO row; the mark rides
+    the durable cursor so a crash mid-catch-up resumes skipping."""
+    total = W + 6 * HOP
+    ep = synth_arc_epoch(nf=NF, nt=total, seed=1)
+    dyn = np.asarray(ep.dyn)
+    d, writer = _feed_from_epoch(tmp_path, ep)
+    sess = StreamSession(d, OPTS, window=W, hop=HOP)
+    upto = W + 3 * HOP
+    sess.skip_ticks_until(upto)
+    i = 0
+    rows = []
+    while i < W + 4 * HOP:
+        writer.append(dyn[:, i:i + HOP])
+        i += HOP
+        rows += sess.poll()
+    # ticks at 32..44 skipped (4 of them), the 48 tick ran live
+    assert sess.skipped_ticks == 4
+    assert [r["window_end"] for r in rows] == [W + 4 * HOP]
+    # tick numbering stayed contiguous across the skip
+    assert rows[0]["tick"] == sess.tick_seq == 5
+    state = sess.state()
+    assert state["skip_upto"] == upto
+    s2 = StreamSession(d, OPTS, window=W, hop=HOP)
+    s2.restore(state)
+    assert s2._skip_upto == upto
+    writer.append(dyn[:, i:i + HOP])
+    writer.finalize()
+    more = s2.poll()
+    # past the mark: live ticks resume (plus the final full window)
+    end = W + 5 * HOP
+    assert [r["window_end"] for r in more] == [end, end]
+    assert more[-1]["final"]
+
+
+def test_worker_backfills_deep_backlog_end_to_end(tmp_path):
+    """A stream registration against a deep committed backlog submits
+    ONE bulk backfill job and fast-forwards the live cadence: the
+    backfill publishes every skipped window through the chunked batch
+    path (same versioned keys, contiguous tick numbers, rows flagged),
+    while the live session serves the head and the final window."""
+    total = W + 12 * HOP
+    ep = synth_arc_epoch(nf=NF, nt=total, seed=1)
+    d, writer = _feed_from_epoch(tmp_path, ep)
+    dyn = np.asarray(ep.dyn)
+    i = 0
+    while i < total:        # the whole backlog lands pre-registration
+        writer.append(dyn[:, i:i + HOP])
+        i += HOP
+    with obs.tracing() as reg:
+        client = SurveyClient(str(tmp_path / "q"))
+        jid = client.submit_stream(d, OPTS, window=W, hop=HOP)["job"]
+        worker = ServeWorker(client.queue, batch_size=4,
+                             max_wait_s=0.0, poll_s=0.01,
+                             heartbeat_s=0)
+        worker.poll_once()      # register -> submit backfill, skip
+        worker.poll_once()      # claim + execute the backfill
+        writer.finalize()
+        worker.poll_once()      # final live window -> complete
+        counters = reg.counters()
+    q = client.queue
+    assert q.state_of(jid) == "done"
+    assert counters["backfill_jobs"] == 1
+    assert counters["serve_backfill_jobs"] == 1
+    hist = sorted(k for k in q.results.keys()
+                  if k.startswith(f"{jid}.w"))
+    ends = [int(k.split(".w")[-1]) for k in hist]
+    assert ends == list(range(W, total + 1, HOP))
+    rows = [q.results.get(k) for k in hist]
+    # everything except the live head is backfill-flagged, and the
+    # tick numbering is contiguous across the skip boundary
+    assert [r["tick"] for r in rows[:-1]] == list(range(1, len(rows)))
+    n_bf = sum(1 for r in rows if r.get("backfill"))
+    assert n_bf == len(rows) - 1
+    # the newest version of the head key is the final full-window
+    # republish — live, never backfilled
+    assert rows[-1]["final"] and not rows[-1].get("backfill")
+    live = q.results.get(f"{jid}.live")
+    assert live and live["final"] and live["window_end"] == total
+
+
+def test_shallow_backlog_replays_live_without_backfill(tmp_path):
+    """Below the backfill threshold the registration replays the
+    backlog through the live path — no bulk job, no skipped ticks."""
+    total = W + 3 * HOP
+    ep = synth_arc_epoch(nf=NF, nt=total, seed=1)
+    d, writer = _feed_from_epoch(tmp_path, ep)
+    writer.append(np.asarray(ep.dyn))
+    writer.finalize()
+    with obs.tracing() as reg:
+        q = JobQueue(str(tmp_path / "q"))
+        jid, _ = q.submit_stream(d, OPTS, window=W, hop=HOP)
+        worker = ServeWorker(q, batch_size=4, max_wait_s=0.0,
+                             poll_s=0.01, heartbeat_s=0)
+        worker.poll_once()
+        worker.poll_once()
+        counters = reg.counters()
+    assert q.state_of(jid) == "done"
+    assert counters.get("backfill_jobs", 0) == 0
+    assert q.counts()["queued"] == 0
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 17: feed->worker pinning — hints, claim pre-pass, reaper re-pin
+# ---------------------------------------------------------------------------
+
+
+def test_stream_pins_fold_from_heartbeats_and_route_claims(tmp_path):
+    """The pinning protocol end to end at the hints layer: a live
+    registration's heartbeat `streams` payload folds into per-worker
+    pins (a DRAINING worker's are dropped), claim_hints_for splits
+    pinned/pinned-elsewhere, and JobQueue.claim honours both — the
+    pinned owner claims its feed ahead of everything, another worker
+    defers inside the pin freshness window and takes it after."""
+    from scintools_tpu.serve import pool as pool_mod
+
+    ep = synth_arc_epoch(nf=NF, nt=W, seed=1)
+    d, writer = _feed_from_epoch(tmp_path, ep)
+    writer.append(np.asarray(ep.dyn))
+    feed = os.path.abspath(d)
+    now = time.time()
+    hbs = [{"worker": "wA", "ts": now, "interval_s": 30.0,
+            "streams": {"j1": {"dir": feed, "ticks": 3}}},
+           {"worker": "wB", "ts": now, "interval_s": 30.0,
+            "draining": True,
+            "streams": {"j2": {"dir": "/feeds/elsewhere"}}}]
+    ents = pool_mod.hints_from_heartbeats(hbs, now=now)
+    assert ents["wA"]["pins"] == [feed]
+    assert "pins" not in ents.get("wB", {})     # draining: unpinned
+    qdir = str(tmp_path / "q")
+    q = JobQueue(qdir)
+    pool_mod.write_hints(qdir, ents, pin_defer_s=15.0)
+    data = pool_mod.read_hints(qdir)
+    mine = pool_mod.claim_hints_for(data, "wA")
+    other = pool_mod.claim_hints_for(data, "wC")
+    assert mine.pinned == frozenset({feed})
+    assert other.pinned_elsewhere == frozenset({feed})
+    assert other.pin_ts == data["ts"]
+    assert other.pin_defer_s == 15.0
+    jid, _ = q.submit_stream(d, OPTS, window=W, hop=HOP)
+    with obs.tracing() as reg:
+        # inside the freshness window the foreign worker leaves the
+        # pinned feed alone...
+        assert q.claim("wC", n=1, lease_s=30.0, now=now + 1.0,
+                       hints=other) == []
+        # ...the owner claims it ahead of everything
+        (job,) = q.claim("wA", n=1, lease_s=30.0, now=now + 1.0,
+                         hints=mine)
+        assert job.id == jid
+        q.release(job)
+        # a stale pin stops deferring once the window lapses
+        (job2,) = q.claim("wC", n=1, lease_s=30.0, now=now + 60.0,
+                          hints=other)
+        assert job2.id == jid
+        counters = reg.counters()
+    assert counters["feed_pins"] == 1
+    assert counters["feed_pin_deferred"] == 1
+
+
+def test_reaped_stream_repins_to_the_reaping_worker(tmp_path):
+    """A dead pinned worker's lease expires; whichever worker reaps
+    the registration pins the feed to ITSELF (controller hints or not)
+    and claims it in the same poll — the replay lands somewhere alive
+    instead of bouncing between foreign deferrals."""
+    ep = synth_arc_epoch(nf=NF, nt=W + HOP, seed=1)
+    d, writer = _feed_from_epoch(tmp_path, ep)
+    writer.append(np.asarray(ep.dyn))
+    q = JobQueue(str(tmp_path / "q"), backoff_s=0.0)
+    jid, _ = q.submit_stream(d, OPTS, window=W, hop=HOP)
+    t0 = time.time()
+    (held,) = q.claim("dead-worker", n=1, lease_s=0.05, now=t0)
+    assert held.id == jid
+    worker = ServeWorker(q, batch_size=4, max_wait_s=0.0, poll_s=0.01,
+                         heartbeat_s=0)
+    with obs.tracing() as reg:
+        worker.poll_once(now=t0 + 60.0)
+        counters = reg.counters()
+    feed = os.path.abspath(d)
+    assert feed in worker._reaped_pins
+    assert jid in worker._streams          # reaped AND re-claimed here
+    assert counters["feed_pins"] == 1
+    # the local pin merges into (absent) controller hints as `pinned`
+    hints = worker._load_hints()
+    assert feed in hints.pinned
+    worker._release_streams()
+
+
+def test_draining_worker_beat_drops_pins(tmp_path):
+    """The scale-down hand-back beat: a worker that released its
+    streams advertises `draining`, so the controller's next hints
+    round unpins its feeds (the satellite fix — survivors re-pin
+    instead of deferring to an exiting worker)."""
+    from scintools_tpu.serve import pool as pool_mod
+
+    obs.get_registry().reset()
+    ep = synth_arc_epoch(nf=NF, nt=W, seed=1)
+    d, writer = _feed_from_epoch(tmp_path, ep)
+    writer.append(np.asarray(ep.dyn))
+    q = JobQueue(str(tmp_path / "q"))
+    q.submit_stream(d, OPTS, window=W, hop=HOP)
+    worker = ServeWorker(q, batch_size=4, max_wait_s=0.0, poll_s=0.01,
+                         heartbeat_s=0.001)
+    worker.poll_once()
+    worker._beat(force=True)
+    hb_dir = os.path.join(q.dir, "heartbeat")
+    (hb,) = fleet.read_heartbeats(hb_dir)
+    ents = pool_mod.hints_from_heartbeats([hb], now=hb["ts"])
+    assert ents[worker.worker_id]["pins"] == [os.path.abspath(d)]
+    # release (scale-down / idle-exit path) -> forced beat advertises
+    # the hand-back -> the same folding drops the pins
+    worker._release_streams()
+    worker._beat(force=True)
+    (hb2,) = fleet.read_heartbeats(hb_dir)
+    assert hb2["draining"] is True
+    ents2 = pool_mod.hints_from_heartbeats([hb2], now=hb2["ts"])
+    assert "pins" not in ents2.get(worker.worker_id, {})
+
+
+# ---------------------------------------------------------------------------
 # bench lane smoke
 # ---------------------------------------------------------------------------
 
@@ -749,3 +1189,12 @@ def test_bench_stream_lane_smoke(monkeypatch):
     assert rec["warm_jit_cache_miss"] == 0
     assert rec["stream_lag_s"] is not None
     assert rec["quarantined_chunks"] == 0
+    # the ISSUE 17 A/B sub-record: the incremental run shares the
+    # record shape, took sliding ticks with at least one resync, and
+    # kept the warm zero-miss contract; the ratio fields landed
+    inc = rec["incremental"]
+    assert "error" not in inc, inc
+    assert inc["ticks"] >= 3
+    assert inc["inc_ticks"] >= 1 and inc["resyncs"] >= 1
+    assert inc["warm_jit_cache_miss"] == 0
+    assert rec["speedup_p50"] > 0 and rec["speedup_p95"] > 0
