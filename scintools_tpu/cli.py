@@ -125,6 +125,42 @@ def _synth_spec_dict_from_args(args) -> dict | None:
         raise SystemExit(str(e))
 
 
+def _infer_spec_dict_from_args(args) -> dict | None:
+    """The --infer flag set as a canonical sparse InferSpec dict
+    (infer.infer_to_dict form) — the serve job payload and the direct
+    engine's resume-key ingredient, built ONCE so process/submit agree
+    on the optimiser identity.  Returns None when --infer was not
+    given; rejects orphan --infer-* knobs (they would silently do
+    nothing)."""
+    flags = (("infer_steps", "opt_steps", int),
+             ("infer_starts", "starts", int),
+             ("infer_lr", "lr", float),
+             ("infer_tol", "tol", float),
+             ("infer_spread", "spread", float),
+             ("infer_seed", "seed", int))
+    if not getattr(args, "infer", False):
+        orphans = [f"--{flag.replace('_', '-')}"
+                   for flag, _f, _c in flags
+                   if getattr(args, flag, None) is not None]
+        if orphans:
+            raise SystemExit(f"{', '.join(orphans)} tune the gradient "
+                             "fit; add --infer")
+        return None
+    from .infer import infer_from_dict, infer_to_dict
+
+    d: dict = {}
+    for flag, field, cast in flags:
+        val = getattr(args, flag, None)
+        if val is not None:
+            d[field] = cast(val)
+    try:
+        # canonicalise through the spec class: validation + the sparse
+        # form sparse/materialised submitters share
+        return infer_to_dict(infer_from_dict(d))
+    except (TypeError, ValueError) as e:
+        raise SystemExit(str(e))
+
+
 def _validate_estimator_flags(args) -> None:
     """Shared --arc-bracket/--arc-method/--pad-chunks fail-fast for
     process, warmup and submit: a warmup or submit must reject exactly
@@ -157,6 +193,17 @@ def _validate_estimator_flags(args) -> None:
         cfg = _estimator_opts(args)
         if synth is not None:
             cfg = dict(cfg, synthetic=synth)
+        infer_d = _infer_spec_dict_from_args(args)
+        if infer_d is not None:
+            if synth is None:
+                raise SystemExit("--infer fits a --synthetic "
+                                 "campaign's physics by gradient "
+                                 "descent; add --synthetic N")
+            # rides beside the campaign payload: validate_job_cfg runs
+            # the one infer rule site (validate_infer_config), so a
+            # process/submit --infer rejects exactly what the worker
+            # would reject
+            cfg = dict(cfg, infer=infer_d)
         validate_job_cfg(cfg)
     except ValueError as e:
         raise SystemExit(str(e))
@@ -279,6 +326,10 @@ def cmd_process(args) -> int:
         if args.plots:
             raise SystemExit("--batched does not render per-epoch "
                              "plots; drop --plots")
+        infer_d = _infer_spec_dict_from_args(args)
+        if infer_d is not None:
+            return _process_infer(args, synth_d, infer_d, cfg, store,
+                                  log, timers)
         return _process_synthetic(args, synth_d, cfg, store, log,
                                   timers)
     if not files:
@@ -752,6 +803,98 @@ def _process_synthetic(args, synth_d: dict, cfg, store, log,
     return 0 if failed == 0 else 1
 
 
+def _process_infer(args, synth_d: dict, infer_d: dict, cfg, store,
+                   log, timers) -> int:
+    """Gradient-inference engine for cmd_process (ISSUE 18): the
+    campaign's keys go to the device and the WHOLE chain — generate ->
+    differentiable loss -> vmapped multi-start Adam -> Fisher errors —
+    runs as ONE compiled step (``infer.infer_rows``).  One result row
+    per epoch lands in the CSV/store through the same row builder and
+    NaN-lane quarantine as the served `infer` job kind, so a direct
+    run's CSV is byte-identical to a served one.
+
+    Resumable like the synthetic engine: per-epoch store keys hash
+    (campaign identity, optimiser identity, epoch index, estimator
+    cfg) in the serve route's ``<base>.<index>`` shape."""
+    from .io.results import write_results
+    from .infer import infer_rows
+    from .parallel import make_mesh
+    from .sim import campaign
+    from .utils import content_key, log_event
+
+    for flag, name in ((getattr(args, "chunk_epochs", None),
+                        "--chunk-epochs"),
+                       (getattr(args, "pad_chunks", False),
+                        "--pad-chunks")):
+        if flag:
+            raise SystemExit(f"{name} chunks the file/simulate "
+                             "engines; the infer step always runs the "
+                             "campaign as one bucketed batch")
+    spec = campaign.spec_from_dict(synth_d)
+    n = spec.n_epochs
+    # per-epoch resume keys: campaign digest + optimiser digest + the
+    # epoch index — a gradient fit is a different result than a
+    # summary fit of the same campaign, so the identities never alias
+    base = content_key(("infer", repr(synth_d), repr(infer_d)), cfg)
+
+    def keyfn(i: int) -> str:
+        return campaign.synth_row_key(base, i)
+
+    if store is not None:
+        todo = [i for i in range(n) if keyfn(i) not in store]
+        log_event(log, "resume", total=n, todo=len(todo),
+                  done=n - len(todo))
+        if not todo:
+            if args.results:
+                store.export_csv(args.results,
+                                 full=getattr(args, "full_csv", False))
+            print(timers.report(), file=sys.stderr)
+            log_event(log, "done", processed=0, failed=0, quarantined=0)
+            return 0
+    obs.inc("infer_jobs")
+    rows, failed = [], 0
+    mesh_shape = getattr(args, "mesh", None)
+    try:
+        mesh = (make_mesh(tuple(int(x) for x in mesh_shape))
+                if mesh_shape else make_mesh())
+        with timers.stage("infer_pipeline"), \
+                _xprof_ctx(getattr(args, "xprof", None)):
+            rows = infer_rows(
+                spec, infer_d, _estimator_opts(args), mesh=mesh,
+                async_exec=not getattr(args, "no_async", False))
+    except Exception as e:
+        log_event(log, "pipeline_failed", error=repr(e), epochs=n)
+        failed = n
+    processed = 0
+    for i, row in enumerate(rows):
+        if row is None:
+            # NaN lane: quarantined (no CSV row, no store entry ->
+            # retried on resume), as the batched engine does
+            failed += 1
+            obs.inc("epochs_failed")
+            log_event(log, "epoch_failed",
+                      file=campaign.epoch_name(spec, i),
+                      error="non-finite fit (NaN lane)")
+            continue
+        if args.results:
+            write_results(args.results, row)
+        if store is not None:
+            store.put_new_buffered(keyfn(i), row)
+        processed += 1
+        log_event(log, "epoch", file=row["name"], tau=row.get("tau"),
+                  eta=row.get("betaeta"),
+                  converged=row.get("infer_converged"))
+    if store is not None:
+        store.flush()
+    if store is not None and args.results:
+        store.export_csv(args.results,
+                         full=getattr(args, "full_csv", False))
+    print(timers.report(), file=sys.stderr)
+    log_event(log, "done", processed=processed, failed=failed,
+              quarantined=0)
+    return 0 if failed == 0 else 1
+
+
 def cmd_warmup(args) -> int:
     """Pre-compile the batched pipeline's step set for a template +
     config, so a later ``process --batched`` run pays ZERO trace/compile
@@ -1050,13 +1193,28 @@ def cmd_submit(args) -> int:
     if synth_d is not None:
         # `simulate` job kind: one job = one on-device campaign (no
         # input files; keys + params ARE the job payload).  Defaults
-        # onto the BULK lane unless --lane says otherwise.
+        # onto the BULK lane unless --lane says otherwise.  With
+        # --infer it becomes the `infer` job kind: the same campaign
+        # payload plus the optimiser knobs, fitted by gradient descent
+        # through the compiled simulator (docs/inference.md).
         if files:
             raise SystemExit("--synthetic submits take no input files")
-        rec = client.submit_synthetic(synth_d, _estimator_opts(args),
-                                      lane=lane)
-        recs = [{"file": f"synthetic:{synth_d.get('kind', 'screen')}",
-                 "job": rec["job"], "status": rec["status"]}]
+        infer_d = _infer_spec_dict_from_args(args)
+        if infer_d is not None:
+            try:
+                rec = client.submit_infer(synth_d, infer_d,
+                                          _estimator_opts(args),
+                                          lane=lane)
+            except ValueError as e:
+                raise SystemExit(str(e))
+            recs = [{"file": f"infer:{synth_d.get('kind', 'screen')}",
+                     "job": rec["job"], "status": rec["status"]}]
+        else:
+            rec = client.submit_synthetic(synth_d, _estimator_opts(args),
+                                          lane=lane)
+            recs = [{"file": f"synthetic:"
+                             f"{synth_d.get('kind', 'screen')}",
+                     "job": rec["job"], "status": rec["status"]}]
     else:
         if not files:
             raise SystemExit("no input files (pass psrflux files, or "
@@ -1737,6 +1895,44 @@ def _add_synth_flags(q) -> None:
                    help="acf kind: injected half-power bandwidth (MHz)")
 
 
+def _add_infer_flags(q) -> None:
+    """The gradient-inference flags (ISSUE 18) — one definition shared
+    by process/submit, so the optimiser identity (resume key, serve
+    job identity) is built from the same spec everywhere
+    (`_infer_spec_dict_from_args`)."""
+    q.add_argument("--infer", action="store_true",
+                   help="fit the --synthetic campaign's physics by "
+                        "gradient descent THROUGH the compiled "
+                        "simulator (vmapped multi-start Adam + Fisher "
+                        "errors, one device program; arc/acf kinds — "
+                        "docs/inference.md)")
+    q.add_argument("--infer-steps", type=int, default=None,
+                   dest="infer_steps", metavar="N",
+                   help="Adam iteration ceiling per epoch (default "
+                        "400; compiled in — the runtime budget "
+                        "tightens it without recompiling)")
+    q.add_argument("--infer-starts", type=int, default=None,
+                   dest="infer_starts", metavar="S",
+                   help="multi-start lanes per epoch (default 8; best "
+                        "finite loss wins)")
+    q.add_argument("--infer-lr", type=float, default=None,
+                   dest="infer_lr", help="Adam learning rate in the "
+                                         "unconstrained parameter "
+                                         "space (default 0.05)")
+    q.add_argument("--infer-tol", type=float, default=None,
+                   dest="infer_tol",
+                   help="per-lane gradient-norm convergence tolerance "
+                        "(default 1e-3)")
+    q.add_argument("--infer-spread", type=float, default=None,
+                   dest="infer_spread",
+                   help="multi-start lattice spread around the "
+                        "data-driven init (default 0.25)")
+    q.add_argument("--infer-seed", type=int, default=None,
+                   dest="infer_seed",
+                   help="start-lattice seed (default 0; deterministic "
+                        "host-side lattice, never runtime RNG)")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="scintools-tpu",
@@ -1837,6 +2033,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "naming the regions")
     _add_perf_policy_flags(q)
     _add_synth_flags(q)
+    _add_infer_flags(q)
     q.set_defaults(fn=cmd_process)
 
     q = sub.add_parser(
@@ -2045,6 +2242,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "(serve --lane-budgets)")
     _add_perf_policy_flags(q)
     _add_synth_flags(q)
+    _add_infer_flags(q)
     q.set_defaults(fn=cmd_submit)
 
     q = sub.add_parser(

@@ -1,0 +1,30 @@
+"""Differentiable inference plane (ISSUE 18).
+
+Gradient-based MAP fits of physical scattering parameters THROUGH the
+compiled forward model: the PR 9 synthetic generators run inside the
+same jit as a differentiable loss (sspec-profile or ACF-cut space) and
+a vmapped multi-start Adam loop, so ``jax.grad`` flows end to end —
+screen params -> dynspec -> data likelihood.  Served as the batched
+``infer`` job kind (``JobQueue.submit_infer`` /
+``scint-tpu submit QDIR --infer``) and runnable directly
+(``scint-tpu process --synthetic N --infer``).
+
+See docs/inference.md for the loss geometry, transform/multi-start
+semantics, and when to prefer the gradient path over the summary fits.
+"""
+
+from .loss import (InferLoss, bounded_log_phys, bounded_log_sigma,
+                   log_phys, log_sigma, make_acf_loss, make_arc_loss)
+from .map_fit import MapFitResult, fisher_sigma_u, map_fit, select_best
+from .runner import (InferSpec, infer_campaign, infer_from_dict,
+                     infer_rows, infer_to_dict, validate_infer,
+                     validate_infer_config)
+
+__all__ = [
+    "InferLoss", "InferSpec", "MapFitResult",
+    "bounded_log_phys", "bounded_log_sigma", "log_phys", "log_sigma",
+    "make_acf_loss", "make_arc_loss",
+    "map_fit", "select_best", "fisher_sigma_u",
+    "infer_campaign", "infer_rows", "infer_to_dict", "infer_from_dict",
+    "validate_infer", "validate_infer_config",
+]

@@ -1,0 +1,251 @@
+"""Differentiable data likelihoods for gradient-based inference.
+
+Two loss geometries, one per closed-form synthetic oracle kind
+(ISSUE 18):
+
+* **acf** — the scint fitter's own least-squares objective, made
+  end-to-end differentiable: the central positive-lag ACF cuts
+  (``ops.acf.acf_cuts_direct``, the batched pipeline's cut route)
+  against ``models.acf_models.scint_acf_model`` on the reference's
+  ``linspace(0, n, n)`` lag axes, normalised per epoch so the loss is
+  scale-free.  Parameters (tau, dnu, amp, wn) ride a log transform —
+  the optimiser is unconstrained, positivity is structural.
+
+* **arc** — the normalised-secondary-spectrum profile geometry of
+  ``fit.arc_fit``: the delay rows come from the SAME
+  ``norm_sspec_row_window`` rule the summary fitter (and the driver's
+  fused sspec crop) resolves, and the loss is the negative of a
+  Gaussian-kernel smooth sample of the FOLDED profile at the arm
+  position ``x(eta) = sqrt(emin / eta)`` — the coordinate at which a
+  parabola of curvature ``eta`` lands on the normalised grid (the
+  fitter's own ``eta_array = emin / etafrac**2`` mapping, inverted).
+  Its gradient therefore climbs toward exactly the profile peak the
+  summary fitter's argmax measures.  eta rides a bounded-log (logit in
+  log space) transform pinned to the searchable window
+  ``[emin, emax] ∩ constraint``, so every optimiser iterate stays on
+  the physically measurable branch.
+
+Both factories return an :class:`InferLoss` bundle consumed by
+``infer.runner``: a traced ``prep`` (per-epoch data extraction), the
+scalar ``loss_fn(u, dat)``, a deterministic multi-start ``init`` (host
+lattice, static seed — no runtime RNG, so reruns are bit-stable), and
+the transform's ``phys`` / ``sigma_phys`` maps (delta method).
+"""
+
+from __future__ import annotations
+
+import typing
+
+import numpy as np
+
+__all__ = ["InferLoss", "log_phys", "log_sigma", "bounded_log_phys",
+           "bounded_log_sigma", "make_acf_loss", "make_arc_loss"]
+
+
+class InferLoss(typing.NamedTuple):
+    """One kind's differentiable-inference bundle."""
+
+    prep: typing.Any        # dyn-derived per-epoch data -> dat pytree
+    loss_fn: typing.Any     # (u [P], dat slice) -> scalar
+    init: typing.Any        # dat -> u0 [B, S, P] multi-start inits
+    phys: typing.Any        # u [..., P] -> physical params [..., P]
+    sigma_phys: typing.Any  # (u, sigma_u) -> physical 1-sigma
+    names: tuple            # physical parameter names, order of P
+    nobs: typing.Any        # residual count for chi2 error scaling
+
+
+# ---------------------------------------------------------------------------
+# parameter transforms (unconstrained u <-> physical)
+# ---------------------------------------------------------------------------
+
+
+def log_phys(u, xp=np):
+    """Log transform: ``phys = exp(u)`` (positivity is structural)."""
+    return xp.exp(u)
+
+
+def log_sigma(u, sigma_u, xp=np):
+    """Delta method through the log transform: ``d phys/d u = phys``."""
+    return xp.exp(u) * sigma_u
+
+
+def bounded_log_phys(u, log_lo: float, log_hi: float, xp=np):
+    """Bounded-log (logit-in-log-space) transform:
+    ``phys = exp(lo + (hi - lo) * sigmoid(u))`` — unconstrained ``u``
+    covers ``(exp(lo), exp(hi))`` exactly, uniformly in log."""
+    s = 1.0 / (1.0 + xp.exp(-u))
+    return xp.exp(log_lo + (log_hi - log_lo) * s)
+
+
+def bounded_log_sigma(u, sigma_u, log_lo: float, log_hi: float, xp=np):
+    """Delta method through :func:`bounded_log_phys`."""
+    s = 1.0 / (1.0 + xp.exp(-u))
+    jac = bounded_log_phys(u, log_lo, log_hi, xp=xp) \
+        * (log_hi - log_lo) * s * (1.0 - s)
+    return xp.abs(jac) * sigma_u
+
+
+def _start_lattice(starts: int, p: int, seed: int) -> np.ndarray:
+    """Deterministic host-side multi-start offsets ``[S, P]``: a fixed
+    standard-normal lattice with row 0 zeroed, so start 0 is always the
+    exact data-driven (or grid-center) initial guess."""
+    lat = np.random.default_rng(int(seed)).standard_normal(
+        (int(starts), int(p))).astype(np.float32)
+    lat[0] = 0.0
+    return lat
+
+
+# ---------------------------------------------------------------------------
+# acf kind: differentiable scint_acf_model least squares on the cuts
+# ---------------------------------------------------------------------------
+
+
+def make_acf_loss(nf: int, nt: int, dt: float, df: float, *,
+                  alpha: float = 5 / 3, lens: str = "exact",
+                  starts: int = 8, spread: float = 0.25,
+                  seed: int = 0) -> InferLoss:
+    """The scint summary fit's residuals as a differentiable loss."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..fit.scint_fit import initial_guesses
+    from ..models.acf_models import scint_acf_model
+    from ..ops.acf import acf_cuts_direct
+
+    # the reference's linspace(0, n, n) lag-axis quirk, kept so the
+    # gradient path optimises the EXACT objective the LM summary fit
+    # solves (scint_fit.acf_cuts / scint_cat_front)
+    x_t = np.asarray(float(dt) * np.linspace(0, int(nt), int(nt)),
+                     dtype=np.float32)
+    x_f = np.asarray(float(df) * np.linspace(0, int(nf), int(nf)),
+                     dtype=np.float32)
+    # the fractional power (x/tau)**alpha has no second derivative at
+    # x = 0 (0**(alpha-2) -> inf under jax.hessian), which would NaN
+    # the Fisher errors; a sub-resolution nudge of the zero-lag time
+    # sample keeps the curvature analytic at negligible model bias
+    # (the zero-lag value is wn-spike dominated anyway)
+    x_t[0] = 1e-3 * float(dt)
+    lat = _start_lattice(starts, 4, seed)
+    nobs = int(nt) + int(nf)
+
+    def prep(dyn_batch):
+        cut_t, cut_f = acf_cuts_direct(dyn_batch, backend="jax",
+                                       method="fft", lens=lens)
+        y = jnp.concatenate([cut_t, cut_f], axis=-1)
+        # per-epoch normalisation: the loss (and its convergence tol)
+        # is scale-free in the dynspec's arbitrary intensity units
+        scale = jnp.maximum(jnp.sum(y * y, axis=-1), 1e-20)
+        return {"y": y, "cut_t": cut_t, "cut_f": cut_f, "scale": scale}
+
+    def loss_fn(u, d):
+        p = jnp.exp(u)
+        model = scint_acf_model(jnp.asarray(x_t), jnp.asarray(x_f),
+                                p[0], p[1], p[2], p[3], alpha, xp=jnp)
+        r = d["y"] - model
+        return 0.5 * jnp.sum(r * r) / d["scale"]
+
+    def init(d):
+        tau0, dnu0, amp0, wn0 = initial_guesses(
+            jnp.asarray(x_t), d["cut_t"], jnp.asarray(x_f), d["cut_f"],
+            xp=jnp)
+        # floors: the argmin-based guesses can land on the zero-lag
+        # sample (tau/dnu = 0) or a negative first-lag drop (wn <= 0) —
+        # both outside the log transform's range
+        y0 = jnp.maximum(d["y"][..., 0], 1e-20)
+        tau0 = jnp.maximum(tau0, float(dt))
+        dnu0 = jnp.maximum(dnu0, float(df))
+        amp0 = jnp.maximum(amp0, 1e-4 * y0)
+        wn0 = jnp.maximum(wn0, 1e-4 * y0)
+        u_c = jnp.log(jnp.stack([tau0, dnu0, amp0, wn0], axis=-1))
+        return u_c[:, None, :] + float(spread) * jnp.asarray(lat)[None]
+
+    return InferLoss(prep=prep, loss_fn=loss_fn, init=init,
+                     phys=lambda u: log_phys(u, xp=jnp),
+                     sigma_phys=lambda u, s: log_sigma(u, s, xp=jnp),
+                     names=("tau", "dnu", "amp", "wn"), nobs=nobs)
+
+
+# ---------------------------------------------------------------------------
+# arc kind: folded norm_sspec profile sampled at x(eta)
+# ---------------------------------------------------------------------------
+
+
+def make_arc_loss(fdop, yaxis, tdel, freq: float, *,
+                  ref_freq: float = 1400.0, delmax=None,
+                  numsteps: int = 1024, startbin: int = 3,
+                  cutmid: int = 3, constraint=(0, np.inf),
+                  starts: int = 8, spread: float = 0.25, seed: int = 0,
+                  kernel_cells: float = 1.5) -> InferLoss:
+    """Arc-curvature loss on the normalised-sspec folded profile.
+
+    The geometry is the arc fitter's own, derived from the SAME shared
+    row rule (``norm_sspec_row_window``) so the loss sees exactly the
+    delay window the summary fitter measures.  lamsteps-only: the
+    fitted curvature is beta-eta, the arc oracle's injected truth.
+    """
+    import jax.numpy as jnp
+
+    from ..fit.arc_fit import norm_sspec_row_window
+
+    fdop = np.asarray(fdop)
+    yaxis = np.asarray(yaxis)
+    tdel = np.asarray(tdel)
+    ind, _ind_norm, _dmax_raw = norm_sspec_row_window(
+        tdel, freq, ref_freq=ref_freq, delmax=delmax)
+    ymax = yaxis[ind]
+    yc = yaxis[:ind]
+    # emin/emax exactly as _make_arc_fitter_cached (lamsteps branch)
+    emax = float(ymax / ((fdop[1] - fdop[0]) * cutmid) ** 2)
+    emin = float((yc[1] - yc[0]) * startbin / np.max(fdop) ** 2)
+    lo = max(emin, float(constraint[0]))
+    hi = min(emax, float(constraint[1]))
+    if not lo < hi:
+        raise ValueError(
+            f"arc infer: empty searchable window [{lo:.4g}, {hi:.4g}] "
+            f"(emin={emin:.4g}, emax={emax:.4g}, "
+            f"constraint={tuple(constraint)})")
+    log_lo, log_hi = float(np.log(lo)), float(np.log(hi))
+
+    # fold geometry: the fitter's static positive/negative arm indices
+    # over the normalised grid etafrac = linspace(-1, 1, numsteps)
+    n = int(numsteps)
+    etafrac = np.linspace(-1.0, 1.0, n)
+    ipos = np.where(etafrac > 1 / (2 * n))[0]
+    ineg = np.where(etafrac < -1 / (2 * n))[0]
+    xgrid = np.asarray(etafrac[ipos], dtype=np.float32)      # [M]
+    h = float(kernel_cells) * 2.0 / (n - 1)
+    # multi-start: a uniform grid over the bounded transform's range
+    # (sigmoid centers at (k+1/2)/S), jittered by the fixed lattice
+    s_c = (np.arange(int(starts)) + 0.5) / int(starts)
+    base = np.log(s_c / (1.0 - s_c)).astype(np.float32)      # [S]
+    lat = _start_lattice(starts, 1, seed)
+    u0_const = (base[:, None]
+                + float(spread) * lat).astype(np.float32)    # [S, 1]
+
+    def prep(prof_batch):
+        folded = 0.5 * (prof_batch[:, ipos]
+                        + prof_batch[:, ineg][:, ::-1])      # [B, M]
+        return {"folded": folded}
+
+    def loss_fn(u, d):
+        eta = bounded_log_phys(u[0], log_lo, log_hi, xp=jnp)
+        x = jnp.sqrt(emin / eta)                  # arm position in (0, 1]
+        w = jnp.exp(-0.5 * ((jnp.asarray(xgrid) - x) / h) ** 2)
+        fin = jnp.isfinite(d["folded"])
+        w = jnp.where(fin, w, 0.0)
+        f = jnp.where(fin, d["folded"], 0.0)
+        # negative smoothed profile power (dB): minimising it climbs
+        # the folded profile toward the fitter's measured peak
+        return -jnp.sum(w * f) / (jnp.sum(w) + 1e-12)
+
+    def init(d):
+        B = d["folded"].shape[0]
+        return jnp.broadcast_to(jnp.asarray(u0_const)[None],
+                                (B,) + u0_const.shape)
+
+    return InferLoss(
+        prep=prep, loss_fn=loss_fn, init=init,
+        phys=lambda u: bounded_log_phys(u, log_lo, log_hi, xp=jnp),
+        sigma_phys=lambda u, s: bounded_log_sigma(u, s, log_lo, log_hi,
+                                                  xp=jnp),
+        names=("betaeta",), nobs=None)

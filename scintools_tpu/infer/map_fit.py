@@ -1,0 +1,161 @@
+"""Batched MAP optimisation by Adam through a differentiable loss.
+
+The engine half of the differentiable inference plane (ISSUE 18): a
+shape-stable, jit-safe gradient-descent loop over a ``[B, S, P]`` state
+— B independent epochs x S multi-start initialisations x P unconstrained
+parameters — against any per-epoch scalar loss ``loss_fn(u, dat)``
+built by :mod:`scintools_tpu.infer.loss`.
+
+Shape discipline follows the split-backend style (PR 14 / fit/lm.py):
+
+* ``steps`` is the STATIC loop ceiling — part of the compiled program's
+  identity (one program per physics grid x optimiser config);
+* ``steps_rt`` is a TRACED runtime input bounding the executed
+  iterations at ``min(steps_rt, steps)`` — warm reruns with a different
+  iteration budget never recompile (mirrors ``lm_fit_jax(steps_rt=)``);
+* every lane carries its own convergence mask: a lane freezes (state
+  stops updating, its step count stops) once its gradient norm drops to
+  ``tol``, while the ``lax.while_loop`` keeps running lanes hot and
+  exits early only when ALL lanes froze.
+
+Uncertainty at the optimum is curvature-based: the Hessian of the loss
+in the unconstrained coordinates, inverted (with a jitter floor) to a
+covariance, scaled to physical units by the caller's transform
+Jacobian (delta method) — see :func:`fisher_sigma_u`.
+"""
+
+from __future__ import annotations
+
+import typing
+
+__all__ = ["MapFitResult", "map_fit", "select_best", "fisher_sigma_u"]
+
+
+class MapFitResult(typing.NamedTuple):
+    """Full multi-start state at loop exit (all arrays lead ``[B, S]``)."""
+
+    u: typing.Any          # [B, S, P] unconstrained params at exit
+    loss: typing.Any       # [B, S] loss at exit
+    grad_norm: typing.Any  # [B, S] gradient norm at exit
+    converged: typing.Any  # [B, S] bool: grad_norm <= tol
+    steps: typing.Any      # [B, S] int32 iterations each lane took
+
+
+def _batched_value_and_grad(loss_fn):
+    import jax
+
+    # per-lane scalar loss -> [B, S] values / [B, S, P] grads; the data
+    # pytree has one leading B axis shared by that epoch's S starts
+    return jax.vmap(jax.vmap(jax.value_and_grad(loss_fn),
+                             in_axes=(0, None)),
+                    in_axes=(0, 0))
+
+
+def map_fit(loss_fn, u0, dat, *, steps: int, steps_rt=None,
+            lr: float = 0.05, tol: float = 1e-3,
+            b1: float = 0.9, b2: float = 0.999,
+            eps: float = 1e-8) -> MapFitResult:
+    """Run masked batched Adam from ``u0 [B, S, P]`` against per-epoch
+    data ``dat`` (a pytree whose leaves lead with the B axis).
+
+    ``loss_fn(u [P], dat_slice) -> scalar`` must be jax-traceable; the
+    whole loop is designed to run INSIDE the caller's jit (the infer
+    program), so nothing here touches the host.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    steps = int(steps)
+    u0 = jnp.asarray(u0)
+    B, S, P = u0.shape
+    vg = _batched_value_and_grad(loss_fn)
+    limit = (jnp.uint32(steps) if steps_rt is None
+             else jnp.minimum(jnp.asarray(steps_rt, dtype=jnp.uint32),
+                              jnp.uint32(steps)))
+    zero = jnp.zeros_like(u0)
+
+    def gnorm(g):
+        return jnp.sqrt(jnp.sum(g * g, axis=-1))
+
+    def cond(state):
+        i, _u, _m, _v, active, _taken = state
+        return jnp.logical_and(i < limit, jnp.any(active))
+
+    def body(state):
+        i, u, m, v, active, taken = state
+        _val, g = vg(u, dat)
+        # NaN gradients (a lane that wandered into a non-finite loss
+        # region) freeze the lane rather than poisoning its state
+        finite = jnp.all(jnp.isfinite(g), axis=-1)
+        live = jnp.logical_and(active, jnp.logical_and(
+            finite, gnorm(g) > tol))
+        g = jnp.where(live[..., None], g, 0.0)
+        m = b1 * m + (1.0 - b1) * g
+        v = b2 * v + (1.0 - b2) * g * g
+        t = (i + 1).astype(u.dtype)
+        mhat = m / (1.0 - b1 ** t)
+        vhat = v / (1.0 - b2 ** t)
+        du = lr * mhat / (jnp.sqrt(vhat) + eps)
+        u = jnp.where(live[..., None], u - du, u)
+        taken = taken + live.astype(taken.dtype)
+        return (i + 1, u, m, v, live, taken)
+
+    state = (jnp.uint32(0), u0, zero, zero,
+             jnp.ones((B, S), dtype=bool),
+             jnp.zeros((B, S), dtype=jnp.int32))
+    _i, u, _m, _v, _active, taken = jax.lax.while_loop(cond, body, state)
+    loss, g = vg(u, dat)
+    gn = gnorm(g)
+    return MapFitResult(u=u, loss=loss, grad_norm=gn,
+                        converged=gn <= tol, steps=taken)
+
+
+def select_best(res: MapFitResult) -> dict:
+    """Pick each epoch's best start: minimum FINITE loss over the S
+    axis (non-finite lanes rank last; an epoch whose every start
+    diverged keeps start 0 and reports its non-finite loss, which the
+    row builder quarantines).  Returns ``[B]``-leading arrays."""
+    import jax.numpy as jnp
+
+    loss = jnp.where(jnp.isfinite(res.loss), res.loss, jnp.inf)
+    best = jnp.argmin(loss, axis=1)                          # [B]
+    take = jnp.take_along_axis
+    pick = best[:, None]
+    return {
+        "u": take(res.u, pick[..., None], axis=1)[:, 0, :],  # [B, P]
+        "loss": take(res.loss, pick, axis=1)[:, 0],
+        "grad_norm": take(res.grad_norm, pick, axis=1)[:, 0],
+        "converged": take(res.converged, pick, axis=1)[:, 0],
+        "steps": take(res.steps, pick, axis=1)[:, 0],
+        "start": best,
+    }
+
+
+def fisher_sigma_u(loss_fn, u_best, dat, nobs: float | None = None,
+                   jitter: float = 1e-6) -> typing.Any:
+    """Curvature (observed-Fisher) 1-sigma in the UNCONSTRAINED
+    coordinates at each epoch's optimum ``u_best [B, P]``.
+
+    ``H = hessian(loss)`` per epoch; ``cov = inv(H + jitter I)``.  When
+    the loss is half the (normalised) residual sum of squares, passing
+    ``nobs`` scales the covariance by the reduced chi-square
+    ``2 L / (nobs - P)`` — the standard least-squares error estimate
+    (the LM fitter's convention).  Negative curvature directions clip
+    to zero variance rather than going imaginary.  The caller maps to
+    physical units via its transform Jacobian (delta method).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    u_best = jnp.asarray(u_best)
+    P = u_best.shape[-1]
+    hess = jax.vmap(jax.hessian(loss_fn), in_axes=(0, 0))
+    H = hess(u_best, dat)                                    # [B, P, P]
+    H = H + jitter * jnp.eye(P, dtype=H.dtype)
+    cov = jnp.linalg.inv(H)
+    var = jnp.clip(jnp.diagonal(cov, axis1=-2, axis2=-1), 0.0, None)
+    if nobs is not None:
+        loss = jax.vmap(loss_fn, in_axes=(0, 0))(u_best, dat)
+        s2 = 2.0 * loss / jnp.maximum(float(nobs) - P, 1.0)
+        var = var * jnp.clip(s2, 0.0, None)[:, None]
+    return jnp.sqrt(var)                                     # [B, P]

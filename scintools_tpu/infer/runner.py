@@ -1,0 +1,333 @@
+"""The infer campaign engine: one compiled MAP program per physics grid.
+
+Ties the plane together (ISSUE 18): a :class:`InferSpec` of optimiser
+knobs rides next to a synthetic campaign spec; the pair (plus the
+analysis config fields the loss geometry consumes) keys ONE memoised
+jit program per (generator identity, grid, optimiser statics, batch
+rung).  The program is the full forward-and-backward chain on device —
+``uint32 key rows -> generator -> (sspec profile | ACF cuts) ->
+multi-start Adam -> Fisher errors`` — wrapped in
+``obs.instrument_jit(step, "infer.step")`` so warm reruns are
+counter-auditable (``jit_cache_miss == 0``).
+
+Identity discipline mirrors the simulate route:
+
+* the batch axis pads to the bucket ladder rung (``buckets.rung_for``)
+  by repeating the last key row — every campaign size within a rung
+  shares one compiled program, pad lanes are sliced off;
+* the iteration budget executes as the TRACED input ``opt_steps_rt``
+  (ceiling = the static ``opt_steps`` program key), so rerunning with a
+  shorter budget never recompiles;
+* :func:`infer_rows` is the ONE row builder shared by the CLI ``--infer``
+  engine and the serve ``infer`` job runner — served CSV bytes are
+  identical to a direct run's by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .. import buckets, obs
+from ..sim import campaign
+from .loss import make_acf_loss, make_arc_loss
+from .map_fit import fisher_sigma_u, map_fit, select_best
+
+__all__ = ["InferSpec", "validate_infer", "infer_to_dict",
+           "infer_from_dict", "validate_infer_config",
+           "infer_campaign", "infer_rows"]
+
+
+@dataclasses.dataclass(frozen=True)
+class InferSpec:
+    """Optimiser knobs of one infer campaign.  All fields are PROGRAM
+    statics except that ``opt_steps`` is only the compiled ceiling —
+    the executed budget is the runtime input (see module docstring)."""
+
+    opt_steps: int = 400   # static Adam iteration ceiling (program key)
+    starts: int = 8        # multi-start inits per epoch
+    lr: float = 0.05       # Adam step size in unconstrained coords
+    tol: float = 1e-3      # per-lane freeze threshold on |grad|
+    spread: float = 0.25   # multi-start lattice scale (u-space)
+    seed: int = 0          # lattice seed (host-side, deterministic)
+
+
+def validate_infer(inf: InferSpec) -> None:
+    """Loud validation at submit/build time (the serve contract: a bad
+    payload must fail before it burns a retry budget)."""
+    if not 1 <= int(inf.opt_steps) <= 100_000:
+        raise ValueError(f"opt_steps must be in [1, 100000], got "
+                         f"{inf.opt_steps}")
+    if not 1 <= int(inf.starts) <= 256:
+        raise ValueError(f"starts must be in [1, 256], got {inf.starts}")
+    if not inf.lr > 0:
+        raise ValueError(f"lr must be > 0, got {inf.lr}")
+    if not inf.tol > 0:
+        raise ValueError(f"tol must be > 0, got {inf.tol}")
+    if inf.spread < 0:
+        raise ValueError(f"spread must be >= 0, got {inf.spread}")
+    if not 0 <= int(inf.seed) < 2 ** 32:
+        raise ValueError(f"seed must be a uint32, got {inf.seed}")
+
+
+def infer_to_dict(inf: InferSpec) -> dict:
+    """Canonical sparse JSON-able form (the serve job payload under
+    ``cfg["infer"]`` and the CLI resume-key ingredient): only
+    non-default fields, so sparse client dicts and materialised CLI
+    dicts share one job identity (the spec_to_dict convention)."""
+    d0 = InferSpec()
+    return {f.name: getattr(inf, f.name)
+            for f in dataclasses.fields(InferSpec)
+            if getattr(inf, f.name) != getattr(d0, f.name)}
+
+
+def infer_from_dict(d: dict | None) -> InferSpec:
+    """Inverse of :func:`infer_to_dict`; unknown keys raise."""
+    d = dict(d or {})
+    names = {f.name for f in dataclasses.fields(InferSpec)}
+    unknown = set(d) - names
+    if unknown:
+        raise ValueError(f"unknown InferSpec field(s): {sorted(unknown)}")
+    inf = InferSpec(**d)
+    validate_infer(inf)
+    return inf
+
+
+def validate_infer_config(spec, inf: InferSpec, config) -> None:
+    """Cross-field validation of (campaign, optimiser, analysis) — the
+    shared gate of the CLI engine and ``JobQueue.submit_infer``."""
+    validate_infer(inf)
+    if spec.kind not in ("arc", "acf"):
+        raise ValueError(
+            f"infer supports the closed-form synthetic kinds 'arc' and "
+            f"'acf' (kind={spec.kind!r}; screen-kind gradient fits are "
+            f"roadmap follow-up work)")
+    if spec.kind == "arc" and not config.lamsteps:
+        raise ValueError(
+            "arc-kind infer requires lamsteps=True: the bounded-log "
+            "curvature transform and the injected truth are both in "
+            "beta-eta units")
+
+
+_PARAM_NAMES = {"arc": ("betaeta",), "acf": ("tau", "dnu", "amp", "wn")}
+
+# program cache: one compiled step per (generator identity, analysis
+# fingerprint, optimiser statics, batch rung) — the infer plane's
+# analogue of the driver's _make_pipeline_cached memo
+_PROGRAMS: dict = {}
+
+
+def _cfg_fingerprint(config, kind: str) -> tuple:
+    """The analysis-config fields the infer program's trace consumes —
+    its share of the program identity (everything else is inert)."""
+    if kind == "acf":
+        return ("acf", config.fft_lens)
+    return ("arc", bool(config.lamsteps), bool(config.prewhite),
+            config.window, float(config.window_frac), config.fft_lens,
+            bool(config.fused_sspec), int(config.arc_numsteps),
+            int(config.arc_startbin), int(config.arc_cutmid),
+            config.arc_delmax,
+            tuple(float(x) for x in config.arc_constraint),
+            float(config.ref_freq), int(config.arc_nsmooth),
+            config.arc_tail)
+
+
+def _build_acf_loss(spec, config, inf: InferSpec):
+    nf, nt = campaign.synth_shape(spec)
+    freqs, times = campaign.synth_axes(spec)
+    acf_lens = "fast" if config.fft_lens == "fast" else "exact"
+    L = make_acf_loss(nf, nt, dt=float(times[1] - times[0]),
+                      df=float(freqs[1] - freqs[0]), lens=acf_lens,
+                      starts=inf.starts, spread=inf.spread,
+                      seed=inf.seed)
+    return L, L.prep
+
+
+def _build_arc_loss(spec, config, inf: InferSpec):
+    import jax
+    import jax.numpy as jnp
+
+    from ..fit.arc_fit import make_arc_fitter
+    from ..ops.sspec import sspec as sspec_op, sspec_axes
+    from ..parallel.driver import lambda_resample_matrix
+
+    freqs, times = campaign.synth_axes(spec)
+    nsub = len(times)
+    df = float(freqs[1] - freqs[0])
+    dt = float(times[1] - times[0])
+    fc = float(np.mean(freqs))
+    W, _lam, dlam = lambda_resample_matrix(freqs)
+    nf_s = W.shape[0]
+    fdop, tdel, beta = sspec_axes(nf_s, nsub, dt, df, dlam=dlam,
+                                  lens=config.fft_lens)
+    # the summary fitter's own per-epoch profile extraction — the loss
+    # optimises over EXACTLY the profile the argmax fitter measures
+    # (norm_sspec method regardless of config.arc_method: only that
+    # flavour exposes profile_of)
+    fitter = make_arc_fitter(
+        fdop=fdop, yaxis=beta, tdel=tdel, freq=fc, lamsteps=True,
+        method="norm_sspec", numsteps=config.arc_numsteps,
+        startbin=config.arc_startbin, cutmid=config.arc_cutmid,
+        nsmooth=config.arc_nsmooth, delmax=config.arc_delmax,
+        constraint=config.arc_constraint, ref_freq=config.ref_freq,
+        arc_tail=config.arc_tail)
+    L = make_arc_loss(fdop, beta, tdel, fc, ref_freq=config.ref_freq,
+                      delmax=config.arc_delmax,
+                      numsteps=config.arc_numsteps,
+                      startbin=config.arc_startbin,
+                      cutmid=config.arc_cutmid,
+                      constraint=config.arc_constraint,
+                      starts=inf.starts, spread=inf.spread,
+                      seed=inf.seed)
+    W_np = np.asarray(W)
+
+    def prep(dyn_batch):
+        fft_in = jnp.einsum("lf,bft->blt", jnp.asarray(W_np), dyn_batch)
+        sec_b = sspec_op(fft_in, prewhite=config.prewhite,
+                         window=config.window,
+                         window_frac=config.window_frac, db=True,
+                         backend="jax", lens=config.fft_lens,
+                         fused=config.fused_sspec)
+        prof, _noise = jax.vmap(fitter.profile_of)(sec_b)
+        return L.prep(prof)
+
+    return L, prep
+
+
+def _infer_program(spec, config, inf: InferSpec, rung: int):
+    """Memoised jit'd step ``(raw uint32 [rung, 2+F], opt_steps_rt) ->
+    dict of [rung]-leading result arrays``."""
+    import jax
+
+    gid = campaign.generator_id(spec)
+    key = (gid, int(rung), _cfg_fingerprint(config, spec.kind),
+           dataclasses.astuple(inf))
+    prog = _PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+
+    import jax.numpy as jnp
+
+    gen = campaign.synth_generator(gid)
+    build = _build_acf_loss if spec.kind == "acf" else _build_arc_loss
+    L, prep = build(spec, config, inf)
+
+    def step(raw, opt_steps_rt):
+        dyn = gen(raw).astype(jnp.float32)
+        dat = prep(dyn)
+        u0 = L.init(dat)
+        res = map_fit(L.loss_fn, u0, dat, steps=inf.opt_steps,
+                      steps_rt=opt_steps_rt, lr=inf.lr, tol=inf.tol)
+        best = select_best(res)
+        sigma_u = fisher_sigma_u(L.loss_fn, best["u"], dat, nobs=L.nobs)
+        return {"params": L.phys(best["u"]),
+                "errs": L.sigma_phys(best["u"], sigma_u),
+                "loss": best["loss"], "grad_norm": best["grad_norm"],
+                "converged": best["converged"], "steps": best["steps"],
+                "start": best["start"]}
+
+    prog = obs.instrument_jit(jax.jit(step), "infer.step")
+    _PROGRAMS[key] = prog
+    return prog
+
+
+def infer_campaign(spec, inf=None, opts=None, *, bucket: bool = True,
+                   opt_steps_rt: int | None = None) -> dict:
+    """Run one gradient-inference campaign on device and return the
+    per-epoch MAP estimates.
+
+    ``spec``/``inf`` accept dataclasses or (sparse) dicts.  ``bucket``
+    pads the epoch axis to the catalog rung (default: the serve/warm
+    contract); ``opt_steps_rt`` caps the executed Adam iterations below
+    the compiled ``inf.opt_steps`` ceiling without recompiling.
+
+    Returns ``{"kind", "params": {name: [B]}, "errs": {name+"err":
+    [B]}, "loss", "grad_norm", "converged", "steps", "start"}``.
+    """
+    from ..serve.worker import config_from_opts
+
+    if not isinstance(spec, campaign.SynthSpec):
+        spec = campaign.spec_from_dict(spec)
+    if not isinstance(inf, InferSpec):
+        inf = infer_from_dict(inf)
+    config = config_from_opts(dict(opts or {}))
+    validate_infer_config(spec, inf, config)
+    B = int(spec.n_epochs)
+    rung = buckets.rung_for(B) if bucket else B
+    raw = campaign.stage_batch(spec)
+    if rung > B:
+        raw = np.concatenate([raw, np.repeat(raw[-1:], rung - B,
+                                             axis=0)], axis=0)
+    steps_rt = inf.opt_steps if opt_steps_rt is None else opt_steps_rt
+    if not 0 < int(steps_rt) <= inf.opt_steps:
+        raise ValueError(f"opt_steps_rt must be in [1, {inf.opt_steps}] "
+                         f"(the compiled ceiling), got {steps_rt}")
+    prog = _infer_program(spec, config, inf, rung)
+    obs.inc("infer_epochs", B)
+    obs.inc("bytes_h2d", raw.nbytes)
+    with obs.span("infer.fit", kind=spec.kind, epochs=B, rung=rung,
+                  starts=inf.starts, opt_steps_rt=int(steps_rt)):
+        out = prog(raw, np.uint32(steps_rt))
+    out = {k: np.asarray(v)[:B] for k, v in out.items()}
+    finite = np.all(np.isfinite(out["params"]), axis=-1) \
+        & np.isfinite(out["loss"])
+    obs.inc("opt_steps", int(out["steps"].sum()))
+    obs.inc("infer_converged", int(np.sum(out["converged"] & finite)))
+    obs.inc("infer_diverged", int(np.sum(~finite)))
+    names = _PARAM_NAMES[spec.kind]
+    return {"kind": spec.kind,
+            "params": {nm: out["params"][:, i]
+                       for i, nm in enumerate(names)},
+            "errs": {nm + "err": out["errs"][:, i]
+                     for i, nm in enumerate(names)},
+            "loss": out["loss"], "grad_norm": out["grad_norm"],
+            "converged": out["converged"], "steps": out["steps"],
+            "start": out["start"]}
+
+
+# CSV columns per kind: the io/results reference schema's fit columns
+# (amp/wn are optimiser nuisance parameters — stored, never exported)
+_ROW_COLS = {"arc": ("betaeta",), "acf": ("tau", "dnu")}
+
+
+def infer_rows(spec, inf=None, opts=None, mesh=None,
+               async_exec: bool = True, bucket: bool = True) -> list:
+    """One result row per epoch (``None`` for quarantined non-finite
+    lanes) — the ONE row builder shared by the CLI ``--infer`` engine
+    and the serve ``infer`` job runner, so served CSV rows are
+    byte-identical to a direct run's (the simulate-route contract).
+
+    ``mesh``/``async_exec`` are accepted for runner-signature symmetry
+    with ``synthetic_rows``; the infer program is single-host today
+    (sharded infer is roadmap follow-up).
+    """
+    from ..io.results import row_fit_values
+
+    del mesh, async_exec
+    if not isinstance(spec, campaign.SynthSpec):
+        spec = campaign.spec_from_dict(spec)
+    if not isinstance(inf, InferSpec):
+        inf = infer_from_dict(inf)
+    res = infer_campaign(spec, inf, opts, bucket=bucket)
+    meta = campaign.synth_meta(spec)
+    names = _PARAM_NAMES[spec.kind]
+    cols = _ROW_COLS[spec.kind]
+    rows: list = [None] * spec.n_epochs
+    for i in range(spec.n_epochs):
+        row = dict(meta)
+        row["name"] = campaign.epoch_name(spec, i)
+        row["mjd"] = campaign._MJD0 + int(i)
+        for nm in names:
+            key = nm if nm in cols else f"infer_{nm}"
+            row[key] = float(res["params"][nm][i])
+            row[key + "err"] = float(res["errs"][nm + "err"][i])
+        row["infer_loss"] = float(res["loss"][i])
+        row["infer_converged"] = int(res["converged"][i])
+        row["infer_steps"] = int(res["steps"][i])
+        row["infer_start"] = int(res["start"][i])
+        fitvals = row_fit_values(row)
+        if fitvals and not np.all(np.isfinite(fitvals)):
+            continue   # NaN lane: quarantined (rows[i] stays None)
+        rows[i] = row
+    return rows

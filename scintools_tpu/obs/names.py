@@ -84,6 +84,14 @@ COUNTERS = frozenset({
     # serve_backfill_jobs = backfill executions a worker ran
     "feed_pins", "feed_pin_deferred", "backfill_jobs",
     "serve_backfill_jobs",
+    # differentiable inference plane (scintools_tpu.infer — ISSUE 18):
+    # infer_jobs = gradient-inference campaigns executed (served or
+    # direct CLI); infer_epochs = epochs entering the MAP fit;
+    # opt_steps = Adam iterations actually taken by the winning starts;
+    # infer_converged/infer_diverged = per-epoch outcome split
+    # (diverged = best lane's loss non-finite -> row quarantined)
+    "infer_jobs", "infer_epochs", "opt_steps",
+    "infer_converged", "infer_diverged",
 })
 
 # -- gauges (obs.gauge) -----------------------------------------------------
@@ -121,6 +129,10 @@ SPANS = frozenset({
     # the --xprof jax.profiler.trace bracket and the on-OOM
     # device_memory_profile snapshot dump
     "devmem.xprof", "devmem.memory_profile",
+    # differentiable inference plane (infer/runner.py — ISSUE 18): one
+    # span per MAP-fit campaign; the compiled step's compile/execute
+    # sub-spans ride instrument_jit's dynamic "infer.step.*" names
+    "infer.fit",
     # repo-root bench.py (walked by the lint since ISSUE 16): the
     # headline measurement's own decomposition spans
     "bench.baseline_epoch", "bench.h2d", "bench.step.compile",

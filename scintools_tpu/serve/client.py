@@ -57,6 +57,22 @@ class SurveyClient:
             spec, dict(opts or {}), lane=lane)
         return {"spec": dict(spec), "job": job_id, "status": status}
 
+    def submit_infer(self, spec: dict, infer: dict | None = None,
+                     opts: dict | None = None,
+                     lane: str | None = None) -> dict:
+        """Submit one gradient-inference campaign (`infer` job kind,
+        ISSUE 18): ``spec`` is the synthetic-campaign forward model,
+        ``infer`` the sparse optimiser knobs
+        (``scintools_tpu.infer.infer_to_dict``), ``opts`` the pipeline
+        options the loss geometry derives from.  Idempotent per
+        (canonical spec, canonical infer, opts) — a distinct identity
+        from a plain simulate of the same campaign.  ``lane`` defaults
+        to bulk.  Returns ``{spec, infer, job, status}``."""
+        job_id, status = self.queue.submit_infer(
+            spec, infer, dict(opts or {}), lane=lane)
+        return {"spec": dict(spec), "infer": dict(infer or {}),
+                "job": job_id, "status": status}
+
     def compact(self) -> dict:
         """Submit one results-plane compaction (`compact` job kind):
         the worker merges small segment files into one so long

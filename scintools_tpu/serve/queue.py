@@ -190,6 +190,20 @@ def validate_job_cfg(cfg: dict) -> None:
         campaign.spec_from_dict(cfg["synthetic"])
         _validate_synth_config(config_from_opts(cfg), mesh=None,
                                chan_sharded=None)
+    if cfg.get("infer") is not None:
+        # infer-job payload (ISSUE 18): the optimiser spec and its
+        # cross-field rules (supported kinds, lamsteps for arc) fail at
+        # submit with the infer plane's own one-rule-site messages
+        from ..infer import infer_from_dict, validate_infer_config
+        from ..sim import campaign
+
+        if cfg.get("synthetic") is None:
+            raise ValueError(
+                "infer jobs ride a synthetic campaign payload: "
+                "cfg['synthetic'] is required beside cfg['infer']")
+        validate_infer_config(campaign.spec_from_dict(cfg["synthetic"]),
+                              infer_from_dict(cfg["infer"]),
+                              config_from_opts(cfg))
 
 
 def cfg_signature(cfg: dict) -> tuple:
@@ -906,6 +920,51 @@ class JobQueue:
         root = obs.event("job.submit", trace_id=trace, job=job_id,
                          file=f"synthetic:{kind}", lane=lane)
         self._write(QUEUED, Job(id=job_id, file=f"synthetic:{kind}",
+                                cfg=cfg, submitted_at=_submit_stamp(),
+                                trace_id=trace, span=root, lane=lane,
+                                sig=job_sig(cfg),
+                                est_bytes=self._synth_est_bytes(
+                                    spec_obj)))
+        self._depth_gauge(job_id, lane=lane)
+        return job_id, "submitted"
+
+    def submit_infer(self, spec: dict, infer: dict | None = None,
+                     cfg: dict | None = None,
+                     lane: str | None = None) -> tuple[str, str]:
+        """Enqueue one gradient-inference campaign (`infer` job kind,
+        ISSUE 18): ``spec`` is the synthetic-campaign payload the
+        forward model runs (the closed-form oracle kinds), ``infer``
+        the sparse :func:`scintools_tpu.infer.infer_to_dict` optimiser
+        knobs.  Both ride inside the option dict (``cfg["synthetic"]``
+        + ``cfg["infer"]``) so ``cfg_signature`` separates infer jobs
+        from plain simulate jobs of the same campaign by construction.
+        Identity, dedup, idempotent rows, est-bytes routing and the
+        BULK lane default all follow the simulate-job contract; rows
+        key ``<job_id>.<epoch_index>`` and the served CSV is
+        byte-identical to a direct ``process --infer`` run (one shared
+        row builder, :func:`scintools_tpu.infer.infer_rows`)."""
+        from ..infer import infer_from_dict, infer_to_dict
+        from ..sim import campaign
+
+        lane = validate_lane(lane, LANE_BULK)
+        cfg = dict(cfg or {})
+        # canonicalise both payloads: sparse and materialised dicts of
+        # the same (campaign, optimiser) must share one job identity
+        spec_obj = campaign.spec_from_dict(spec)
+        cfg["synthetic"] = campaign.spec_to_dict(spec_obj)
+        cfg["infer"] = infer_to_dict(infer_from_dict(infer))
+        validate_job_cfg(cfg)
+        job_id = content_key("infer", ("serve",) + cfg_signature(cfg))
+        if campaign.synth_row_key(job_id, 0) in self.results:
+            return job_id, DONE
+        existing = self.state_of(job_id)
+        if existing is not None:
+            return job_id, existing
+        kind = cfg["synthetic"].get("kind", "screen")
+        trace = new_trace_id()
+        root = obs.event("job.submit", trace_id=trace, job=job_id,
+                         file=f"infer:{kind}", lane=lane)
+        self._write(QUEUED, Job(id=job_id, file=f"infer:{kind}",
                                 cfg=cfg, submitted_at=_submit_stamp(),
                                 trace_id=trace, span=root, lane=lane,
                                 sig=job_sig(cfg),

@@ -138,6 +138,26 @@ def synthetic_runner(spec_dict: dict, opts: dict, mesh=None,
                                    async_exec=async_exec, bucket=bucket)
 
 
+def infer_job_runner(spec_dict: dict, infer_dict: dict, opts: dict,
+                     mesh=None, async_exec: bool = True,
+                     bucket: bool = False) -> list:
+    """Default `infer`-job executor (ISSUE 18): the gradient-inference
+    campaign as ONE on-device forward+backward program, rows built by
+    the same helper as the CLI's ``--infer`` engine
+    (``scintools_tpu.infer.infer_rows``) — served CSV rows are
+    byte-identical to a direct run of the same payloads.  The infer
+    program always canonicalises its batch onto the catalog ladder
+    (results byte-identical at any rung), so the worker's ``bucket``
+    knob is forwarded for signature symmetry only."""
+    from ..infer import infer_from_dict, infer_rows
+    from ..sim import campaign
+
+    del bucket
+    spec = campaign.spec_from_dict(spec_dict)
+    return infer_rows(spec, infer_from_dict(infer_dict), opts,
+                      mesh=mesh, async_exec=async_exec)
+
+
 def pipeline_runner(batch: Batch, batch_size: int, mesh=None,
                     async_exec: bool = True) -> list:
     """Default batch executor: ONE padded compiled step over the
@@ -185,7 +205,7 @@ class ServeWorker:
                  async_exec: bool = True, worker_id: str | None = None,
                  bucket: bool = False, synth_runner=None,
                  heartbeat_s: float = 10.0,
-                 lane_budgets: dict | None = None):
+                 lane_budgets: dict | None = None, infer_runner=None):
         self.queue = queue
         self.batch_size = int(batch_size)
         mult = 1
@@ -218,6 +238,9 @@ class ServeWorker:
         # `simulate`-job executor (injectable for tests, like runner)
         self.synth_runner = (synth_runner if synth_runner is not None
                              else synthetic_runner)
+        # `infer`-job executor (ISSUE 18; injectable like synth_runner)
+        self.infer_runner = (infer_runner if infer_runner is not None
+                             else infer_job_runner)
         self.worker_id = worker_id or f"{os.uname().nodename}:{os.getpid()}"
         self.batcher = DynamicBatcher(batch_size=self.batch_size,
                                       max_wait_s=self.max_wait_s,
@@ -419,6 +442,13 @@ class ServeWorker:
                 # `compact` job kind: results-plane maintenance —
                 # merges small segment files; no epochs, no batcher
                 self._execute_compact(job)
+                ran_synth += 1
+                continue
+            if job.cfg.get("infer") is not None:
+                # `infer` job kind (ISSUE 18): a gradient-inference
+                # campaign — routed BEFORE the simulate check (its cfg
+                # carries both payloads), executed directly like one
+                self._execute_infer(job)
                 ran_synth += 1
                 continue
             if job.cfg.get("synthetic") is not None:
@@ -746,6 +776,76 @@ class ServeWorker:
         self.stats["jobs_done"] += 1
         obs.inc("jobs_done")
         log_event(self.log, "synth_job_done", job=job.id,
+                  epochs=n_epochs, rows=stored,
+                  quarantined=n_epochs - stored)
+
+    def _execute_infer(self, job) -> None:
+        """Run one `infer` job (ISSUE 18): the gradient-inference
+        campaign executes as ONE forward+backward device program and
+        lands ``n_epochs`` idempotent rows keyed ``<job_id>.<index>``
+        (the simulate-job storage contract; failures route through the
+        same taxonomy)."""
+        from ..infer import infer_from_dict
+        from ..sim.campaign import spec_from_dict, synth_row_key
+
+        spec_dict = job.cfg.get("synthetic")
+        infer_dict = job.cfg.get("infer")
+        try:
+            n_epochs = int(spec_from_dict(spec_dict).n_epochs)
+            infer_from_dict(infer_dict)
+        except Exception as e:
+            # a torn/invalid payload is deterministic poison
+            state = self.queue.fail(job, f"bad infer payload: {e!r}",
+                                    retryable=False)
+            if state == "failed":
+                self.stats["jobs_failed"] += 1
+                obs.inc("jobs_failed")
+            log_event(self.log, "job_poisoned", job=job.id,
+                      error=f"bad infer payload: {e!r}")
+            return
+        obs.inc("infer_jobs")
+        # the MAP loop compiles+runs like a batch: keep the lease ahead
+        self.queue.renew([job], self._claim_lease_s())
+        self.stats["batches"] += 1
+        try:
+            with obs.span("serve.batch", jobs=1, infer=True,
+                          epochs=n_epochs,
+                          trace_ids=[t for t in (job.trace_id,) if t]
+                          ) as bsp:
+                if obs.enabled():
+                    job = self.queue._hop(
+                        job, "job.batch", infer=True,
+                        batch_span=getattr(bsp, "span_id", None))
+                # chaos site shared with file batches: an infra fault
+                # mid-campaign classifies transient
+                faults.check("worker.batch_execute")
+                rows = self.infer_runner(spec_dict, infer_dict, job.cfg,
+                                         self.mesh, self.async_exec,
+                                         self.bucket)
+        except Exception as e:
+            # _job_failed classifies: transient infra faults requeue
+            # budget-free, deterministic errors burn the bounded budget
+            self._job_failed(job, f"infer campaign failed: {e!r}",
+                             exc=e)
+            log_event(self.log, "infer_job_failed", job=job.id,
+                      error=repr(e))
+            return
+        stored = 0
+        for i, row in enumerate(rows):
+            if row is None:   # NaN lane: quarantined by the row builder
+                continue
+            self.queue.results.put_new_buffered(synth_row_key(job.id, i),
+                                                row)
+            stored += 1
+        self._flush_rows()
+        obs.inc("serve_synth_rows", stored)
+        job = self.queue._hop(job, "job.row", rows=stored)
+        self.queue.complete(job)
+        self._mark_warm(job)
+        self._job_latency(job)
+        self.stats["jobs_done"] += 1
+        obs.inc("jobs_done")
+        log_event(self.log, "infer_job_done", job=job.id,
                   epochs=n_epochs, rows=stored,
                   quarantined=n_epochs - stored)
 

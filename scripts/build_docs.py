@@ -33,6 +33,7 @@ PAGES = [
     ("docs/performance.md", "performance", "Performance & roofline"),
     ("docs/serving.md", "serving", "Resident survey service"),
     ("docs/streaming.md", "streaming", "Streaming ingest (live feeds)"),
+    ("docs/inference.md", "inference", "Differentiable inference"),
     ("docs/fleet.md", "fleet", "Fleet pool controller"),
     ("docs/reliability.md", "reliability", "Reliability & fault injection"),
     ("docs/observability.md", "observability", "Tracing & metrics"),

@@ -42,8 +42,12 @@ MARKER = "host-f64"
 # stream/ joined the walk with the ISSUE 15 streaming ingest plane:
 # the ring updater traces into the device program and the feed log
 # stores the staged dtype — a stray wide dtype there doubles the very
-# per-tick bytes the device-resident window exists to avoid
-SUBTREES = ("ops", "parallel", "sim", "stream")
+# per-tick bytes the device-resident window exists to avoid.
+# infer/ joined with the ISSUE 18 differentiable inference plane: the
+# loss/optimiser/Fisher chain traces into ONE compiled program whose
+# gradients double every wide dtype's cost twice over (forward AND
+# backward pass)
+SUBTREES = ("infer", "ops", "parallel", "sim", "stream")
 # single modules outside the subtree walk that still sit on hot paths
 # (the ISSUE 11 results plane streams every campaign row — a wide
 # dtype sneaking into its encode/decode would double the bytes of the

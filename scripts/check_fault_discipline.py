@@ -38,8 +38,11 @@ MARKER = "fault-ok"
 # stream/ joined the walk with the ISSUE 15 streaming ingest plane:
 # its feed log + resume cursor are the durability layer under live
 # monitoring — a silent swallow there can lose appended samples or a
-# tick with no counter moving
-SUBTREES = ("parallel", "serve", "ops", "stream")
+# tick with no counter moving.
+# infer/ joined with the ISSUE 18 differentiable inference plane: a
+# swallowed optimiser failure would publish half-fitted physics as if
+# converged — divergence must route to the quarantine/poison taxonomy
+SUBTREES = ("infer", "ops", "parallel", "serve", "stream")
 # single modules outside the subtree walk that are fault-critical too:
 # the ISSUE 11 results plane (utils/segments.py + utils/store.py) is
 # the durability layer under the serve queue — a silent swallow there

@@ -377,6 +377,33 @@ print('slo gate ok: breach -> pending -> firing -> resolved,',
       'durable rows readable across stores')
 "
 
+INFER_CODE="
+import dataclasses
+import numpy as np
+from scintools_tpu import obs
+from scintools_tpu.infer import InferSpec, infer_campaign
+from scintools_tpu.sim import campaign
+obs.enable()
+spec = campaign.SynthSpec(kind='acf', n_epochs=8, nf=128, nt=128,
+                          dt=8.0, df=0.5, tau_s=48.0, dnu_mhz=2.0)
+out = infer_campaign(spec, InferSpec())
+tru = campaign.injected_truth(spec)
+te = float(abs(np.asarray(out['params']['tau']).mean()
+               - np.mean(tru['tau'])) / np.mean(tru['tau']))
+de = float(abs(np.asarray(out['params']['dnu']).mean()
+               - np.mean(tru['dnu'])) / np.mean(tru['dnu']))
+assert te < 0.10, ('tau recovery off on chip', te)
+assert de < 0.15, ('dnu recovery off on chip', de)
+assert int(np.asarray(out['converged']).sum()) == 8, out['converged']
+m0 = obs.counters().get('jit_cache_miss', 0)
+warm = dataclasses.replace(spec, n_epochs=5, seed=7)
+infer_campaign(warm, InferSpec(), opt_steps_rt=200)
+miss = obs.counters().get('jit_cache_miss', 0) - m0
+assert miss == 0, ('warm infer rerun recompiled', miss)
+print('infer gate ok on chip: tau_rel_err=', round(te, 4),
+      'dnu_rel_err=', round(de, 4), 'warm_miss=0')
+"
+
 SPLIT_CODE="
 import numpy as np
 from scintools_tpu import obs
@@ -550,6 +577,17 @@ echo "== slo plane: injected lag breach fires + resolves durably =="
 # the fault window exhausts — with the rows readable through a fresh
 # store, the crash-survival contract tier-1 proves across a SIGKILL
 gated "slo smoke check" 600 2 python -u -c "$SLO_CODE"
+
+echo "== differentiable inference: closed-loop gradient fit on chip =="
+# the ISSUE 18 inference plane, sub-minute: an acf campaign's injected
+# (tau, dnu) truth must be recovered by gradient descent through the
+# compiled simulator within the closed-loop budgets (10%/15% batch
+# mean, every lane converged), and a warm rerun at a different batch
+# size / seed / runtime step budget must serve from the SAME compiled
+# program (jit_cache_miss == 0) — CPU tier-1 pins both contracts
+# (tests/test_infer.py); this proves them against the real TPU
+# compiler and its autodiff lowering
+gated "differentiable inference check" 600 2 python -u -c "$INFER_CODE"
 
 echo "== nudft einsum on-chip accuracy (bf16-lowering guard) =="
 # the round-4 A/B caught the vmapped einsum NUDFT silently lowering to

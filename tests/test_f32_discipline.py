@@ -57,6 +57,22 @@ def test_stream_subtree_is_covered():
         assert hits == [], (path, hits)
 
 
+def test_infer_subtree_is_covered():
+    """The ISSUE 18 differentiable inference plane traces its whole
+    loss/optimiser/Fisher chain into one compiled program — a wide
+    dtype there is paid twice over (forward AND backward pass); the
+    lint walk must include infer/."""
+    assert "infer" in check_f32_discipline.SUBTREES
+    pkg = os.path.join(REPO, "scintools_tpu")
+    for name in ("loss.py", "map_fit.py", "runner.py"):
+        path = os.path.join(pkg, "infer", name)
+        assert os.path.exists(path), path
+        hits = check_f32_discipline.find_wide_literals(path)
+        assert not any(txt.startswith("TokenError")
+                       for _ln, txt in hits)
+        assert hits == [], (path, hits)
+
+
 def test_results_plane_modules_are_covered():
     """The ISSUE 11 storage modules stream every campaign row — a wide
     dtype sneaking into the encode/decode path would double the bytes

@@ -48,6 +48,18 @@ def test_stream_subtree_is_covered():
         assert os.path.exists(os.path.join(pkg, "stream", name)), name
 
 
+def test_infer_subtree_is_covered():
+    """The ISSUE 18 differentiable inference plane is pinned into the
+    lint's walk: a swallowed optimiser failure would publish
+    half-fitted physics as if converged, so divergence must route to
+    the quarantine/poison taxonomy — a rename out of infer/ must not
+    silently drop the discipline."""
+    assert "infer" in check_fault_discipline.SUBTREES
+    pkg = os.path.join(os.path.dirname(_HERE), "scintools_tpu")
+    for name in ("loss.py", "map_fit.py", "runner.py"):
+        assert os.path.exists(os.path.join(pkg, "infer", name)), name
+
+
 def _hits(tmp_path, src):
     mod = tmp_path / "mod.py"
     mod.write_text(textwrap.dedent(src))

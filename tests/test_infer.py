@@ -1,0 +1,473 @@
+"""Differentiable inference plane (ISSUE 18): gradient-based MAP fits
+THROUGH the compiled simulator, served as the batched `infer` job kind.
+
+The headline contracts, counter-asserted rather than hypothesised:
+
+* the closed-loop gate: gradient descent through the forward model
+  recovers the synthetic oracles' injected truth — arc betaeta within
+  2% PER EPOCH, acf tau/dnu within 10%/15% on the batch mean (the
+  simulate-route budgets);
+* warm reruns never recompile: a second campaign with a different
+  epoch count (same rung), seed and runtime iteration budget executes
+  with ``jit_cache_miss == 0``;
+* a served `infer` job's CSV rows are byte-identical to a direct
+  ``process --infer`` run (one shared row builder).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from scintools_tpu import obs
+from scintools_tpu.infer import (InferSpec, bounded_log_phys,
+                                 bounded_log_sigma, fisher_sigma_u,
+                                 infer_campaign, infer_from_dict,
+                                 infer_rows, infer_to_dict, log_phys,
+                                 log_sigma, map_fit, select_best,
+                                 validate_infer_config)
+from scintools_tpu.sim import SynthSpec
+from scintools_tpu.sim import campaign
+
+# documented closed-loop budgets (docs/inference.md): betaeta
+# per-epoch, tau/dnu on the batch mean — the simulate-route budgets
+ETA_BUDGET = 0.02
+TAU_BUDGET = 0.10
+DNU_BUDGET = 0.15
+
+# the tier-1 gate specs: grids where the generators' injected truth is
+# cleanly measurable (the 64x64 defaults scatter too much — same
+# finding as the summary-fit closed-loop gate in test_synth_route)
+ARC_GATE = SynthSpec(kind="arc", n_epochs=4, nf=128, nt=128, dt=10.0,
+                     nimg=128, env=0.5, arc_frac=0.8, noise=0.002)
+ACF_GATE = SynthSpec(kind="acf", n_epochs=8, nf=128, nt=128, dt=8.0,
+                     df=0.5, tau_s=48.0, dnu_mhz=2.0)
+
+# cheap serve/CLI plumbing spec: small grid, short optimiser budget
+SERVE_SPEC = {"kind": "acf", "n_epochs": 3, "nf": 64, "nt": 64,
+              "tau_s": 40.0, "dnu_mhz": 2.0}
+SERVE_INFER = {"opt_steps": 120, "starts": 4}
+
+
+# ---------------------------------------------------------------------------
+# transforms
+# ---------------------------------------------------------------------------
+
+
+def test_log_transform_roundtrip_and_delta_method():
+    u = np.linspace(-2.0, 3.0, 7)
+    np.testing.assert_allclose(np.log(log_phys(u)), u, rtol=1e-12)
+    # delta method: sigma_phys = |d phys / d u| * sigma_u = phys * s
+    np.testing.assert_allclose(log_sigma(u, 0.5), 0.5 * np.exp(u))
+
+
+def test_bounded_log_transform_covers_window():
+    lo, hi = np.log(2.0), np.log(50.0)
+    u = np.linspace(-30.0, 30.0, 101)
+    phys = bounded_log_phys(u, lo, hi)
+    assert np.all(phys >= 2.0 - 1e-9) and np.all(phys <= 50.0 + 1e-9)
+    # u=0 maps to the log-midpoint; extremes saturate at the bounds
+    np.testing.assert_allclose(bounded_log_phys(0.0, lo, hi),
+                               np.sqrt(2.0 * 50.0), rtol=1e-9)
+    # delta method vanishes at the (saturated) bounds, positive inside
+    sig = bounded_log_sigma(u, 1.0, lo, hi)
+    assert sig[50] > 0 and sig[0] < 1e-9 and sig[-1] < 1e-9
+
+
+# ---------------------------------------------------------------------------
+# the optimiser core on an analytic objective
+# ---------------------------------------------------------------------------
+
+
+def _quad_loss(u, d):
+    import jax.numpy as jnp
+
+    return 0.5 * jnp.sum((u - d) ** 2)
+
+
+def test_map_fit_converges_on_quadratic():
+    import jax.numpy as jnp
+
+    targets = jnp.asarray(np.float32([[1.0, -2.0], [0.5, 3.0]]))  # [B,P]
+    u0 = jnp.zeros((2, 3, 2), dtype=jnp.float32)                  # [B,S,P]
+    res = map_fit(_quad_loss, u0, targets, steps=400, lr=0.1,
+                  tol=1e-4)
+    best = select_best(res)
+    np.testing.assert_allclose(np.asarray(best["u"]),
+                               np.asarray(targets), atol=1e-3)
+    assert np.all(np.asarray(best["converged"]))
+    assert np.all(np.asarray(best["steps"]) < 400)
+
+
+def test_map_fit_runtime_step_budget_and_lane_freeze():
+    import jax.numpy as jnp
+
+    targets = jnp.asarray(np.float32([[4.0, 4.0]]))
+    u0 = jnp.zeros((1, 1, 2), dtype=jnp.float32)
+    res = map_fit(_quad_loss, u0, targets, steps=400, steps_rt=5,
+                  lr=0.01, tol=1e-6)
+    # the runtime budget caps execution below the compiled ceiling
+    assert int(np.asarray(res.steps)[0, 0]) == 5
+    assert not bool(np.asarray(res.converged)[0, 0])
+    # a lane that starts converged freezes immediately (taken = 0)
+    res0 = map_fit(_quad_loss, targets[:, None, :], targets, steps=50,
+                   lr=0.1, tol=1e-3)
+    assert int(np.asarray(res0.steps)[0, 0]) == 0
+    assert bool(np.asarray(res0.converged)[0, 0])
+
+
+def test_select_best_skips_non_finite_lanes():
+    import jax.numpy as jnp
+
+    res = map_fit(_quad_loss, jnp.zeros((1, 2, 1), jnp.float32),
+                  jnp.asarray(np.float32([[1.0]])), steps=10, lr=0.1)
+    poisoned = res._replace(
+        loss=jnp.asarray(np.float32([[np.nan, 0.5]])))
+    best = select_best(poisoned)
+    assert int(np.asarray(best["start"])[0]) == 1
+
+
+def test_fisher_sigma_on_quadratic_is_unit():
+    import jax.numpy as jnp
+
+    # hessian of the quadratic is the identity -> sigma_u = 1 exactly
+    u = jnp.asarray(np.float32([[1.0, -2.0]]))
+    sig = fisher_sigma_u(_quad_loss, u, jnp.zeros((1, 2), jnp.float32))
+    np.testing.assert_allclose(np.asarray(sig), 1.0, rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# spec round-trip + validation
+# ---------------------------------------------------------------------------
+
+
+def test_infer_spec_roundtrip_is_sparse():
+    assert infer_to_dict(InferSpec()) == {}
+    d = {"opt_steps": 100, "lr": 0.1}
+    assert infer_to_dict(infer_from_dict(d)) == d
+    with pytest.raises(ValueError, match="unknown InferSpec"):
+        infer_from_dict({"bogus": 1})
+    with pytest.raises(ValueError, match="opt_steps"):
+        infer_from_dict({"opt_steps": 0})
+    with pytest.raises(ValueError, match="starts"):
+        infer_from_dict({"starts": 10000})
+
+
+def test_validate_infer_config_kind_rules():
+    from scintools_tpu.serve.worker import config_from_opts
+
+    inf = InferSpec()
+    with pytest.raises(ValueError, match="roadmap follow-up"):
+        validate_infer_config(SynthSpec(kind="screen"), inf,
+                              config_from_opts({}))
+    with pytest.raises(ValueError, match="lamsteps"):
+        validate_infer_config(SynthSpec(kind="arc"), inf,
+                              config_from_opts({}))
+    validate_infer_config(SynthSpec(kind="arc"), inf,
+                          config_from_opts({"lamsteps": True}))
+    validate_infer_config(SynthSpec(kind="acf"), inf,
+                          config_from_opts({}))
+
+
+# ---------------------------------------------------------------------------
+# the closed-loop acceptance gates (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_closed_loop_acf_gradient_recovery():
+    """The gradient path recovers the acf oracle's injected tau/dnu
+    within the simulate-route budgets, with finite Fisher errors."""
+    truth = campaign.injected_truth(ACF_GATE)
+    with obs.tracing() as reg:
+        out = infer_campaign(ACF_GATE)
+        c = reg.counters()
+    assert c["infer_epochs"] == ACF_GATE.n_epochs
+    assert c["infer_converged"] == ACF_GATE.n_epochs
+    assert c["infer_diverged"] == 0
+    assert c["opt_steps"] > 0
+    tau = np.asarray(out["params"]["tau"])
+    dnu = np.asarray(out["params"]["dnu"])
+    assert abs(tau.mean() - truth["tau"]) / truth["tau"] < TAU_BUDGET
+    assert abs(dnu.mean() - truth["dnu"]) / truth["dnu"] < DNU_BUDGET
+    assert np.all(np.isfinite(np.asarray(out["errs"]["tauerr"])))
+    assert np.all(np.isfinite(np.asarray(out["errs"]["dnuerr"])))
+    assert np.all(np.asarray(out["converged"]))
+
+
+def test_closed_loop_arc_gradient_recovery():
+    """The gradient path recovers the arc oracle's injected betaeta
+    within 2% PER EPOCH (the arc summary-fit budget)."""
+    truth = campaign.injected_truth(ARC_GATE)
+    out = infer_campaign(ARC_GATE, opts={"lamsteps": True})
+    beta = np.asarray(out["params"]["betaeta"])
+    rel = np.abs(beta - truth["betaeta"]) / truth["betaeta"]
+    assert np.all(rel < ETA_BUDGET), rel
+    assert np.all(np.asarray(out["converged"]))
+    assert np.all(np.isfinite(np.asarray(out["errs"]["betaetaerr"])))
+
+
+def test_warm_rerun_never_recompiles():
+    """The shape-stable contract: after a first campaign compiles the
+    program, a rerun with a DIFFERENT epoch count (same bucket rung),
+    different seed and a runtime-input iteration budget executes with
+    zero jit-cache misses."""
+    import dataclasses
+
+    with obs.tracing() as reg:
+        infer_campaign(SERVE_SPEC, SERVE_INFER)
+        base = reg.counters().get("jit_cache_miss", 0)
+        warm = dataclasses.replace(campaign.spec_from_dict(SERVE_SPEC),
+                                   n_epochs=4, seed=7)
+        out = infer_campaign(warm, SERVE_INFER, opt_steps_rt=40)
+        assert reg.counters().get("jit_cache_miss", 0) == base
+    assert len(np.asarray(out["loss"])) == 4
+    # the runtime budget really bound the executed iterations
+    assert np.all(np.asarray(out["steps"]) <= 40)
+
+
+def test_opt_steps_rt_validation():
+    with pytest.raises(ValueError, match="opt_steps_rt"):
+        infer_campaign(SERVE_SPEC, SERVE_INFER,
+                       opt_steps_rt=SERVE_INFER["opt_steps"] + 1)
+
+
+# ---------------------------------------------------------------------------
+# serve: the `infer` job kind
+# ---------------------------------------------------------------------------
+
+
+def test_infer_job_identity_is_distinct_and_canonical():
+    from scintools_tpu.serve import cfg_signature
+
+    sig_synth = cfg_signature({"synthetic": SERVE_SPEC})
+    sig_infer = cfg_signature({"synthetic": SERVE_SPEC, "infer": {}})
+    assert sig_infer != sig_synth
+    # dict ordering / JSON round-trips must not fork the identity
+    reordered = json.loads(json.dumps(
+        {"infer": dict(reversed(list(SERVE_INFER.items()))),
+         "synthetic": dict(reversed(list(SERVE_SPEC.items())))}))
+    assert cfg_signature(reordered) == cfg_signature(
+        {"synthetic": SERVE_SPEC, "infer": SERVE_INFER})
+
+
+def test_submit_infer_validates_and_dedups(tmp_path):
+    from scintools_tpu.serve import JobQueue
+
+    q = JobQueue(str(tmp_path / "q"))
+    jid, status = q.submit_infer(SERVE_SPEC, SERVE_INFER)
+    assert status == "submitted"
+    # idempotent: sparse vs canonicalised payloads dedup
+    jid2, status2 = q.submit_infer(
+        campaign.spec_to_dict(campaign.spec_from_dict(SERVE_SPEC)),
+        infer_to_dict(infer_from_dict(SERVE_INFER)))
+    assert (jid2, status2) == (jid, "queued")
+    # never aliases the plain simulate job of the same campaign
+    sid, _ = q.submit_synthetic(SERVE_SPEC)
+    assert sid != jid
+    with pytest.raises(ValueError, match="unknown InferSpec"):
+        q.submit_infer(SERVE_SPEC, {"bogus": 1})
+    with pytest.raises(ValueError, match="roadmap follow-up"):
+        q.submit_infer({"kind": "screen", "n_epochs": 2}, None)
+    with pytest.raises(ValueError, match="lamsteps"):
+        q.submit_infer({"kind": "arc", "n_epochs": 2}, None)
+
+
+def test_served_infer_rows_byte_identical_to_direct(tmp_path):
+    """The acceptance criterion: a served `infer` job's exported CSV
+    is byte-identical to a direct infer_rows export of the same
+    (campaign, optimiser) — one shared row builder, epoch-ordered
+    store keys, one deterministic compiled program."""
+    from scintools_tpu.serve import JobQueue, ServeWorker
+    from scintools_tpu.utils.store import ResultsStore
+
+    q = JobQueue(str(tmp_path / "q"))
+    jid, _ = q.submit_infer(SERVE_SPEC, SERVE_INFER)
+    worker = ServeWorker(q, batch_size=4, max_wait_s=0.01)
+    stats = worker.run(max_batches=1)
+    assert stats["jobs_done"] == 1 and stats["jobs_failed"] == 0
+    assert sorted(q.results.keys()) == [
+        campaign.synth_row_key(jid, i) for i in range(3)]
+    served_csv = str(tmp_path / "served.csv")
+    assert q.results.export_csv(served_csv) == 3
+
+    rows = infer_rows(SERVE_SPEC, SERVE_INFER)
+    store = ResultsStore(str(tmp_path / "direct"))
+    for i, row in enumerate(rows):
+        assert row is not None
+        store.put(campaign.synth_row_key("direct", i), row)
+    direct_csv = str(tmp_path / "direct.csv")
+    store.export_csv(direct_csv)
+    with open(served_csv, "rb") as a, open(direct_csv, "rb") as b:
+        assert a.read() == b.read()
+    # resubmit after completion reports done without re-queueing
+    jid3, status3 = q.submit_infer(SERVE_SPEC, SERVE_INFER)
+    assert (jid3, status3) == (jid, "done")
+
+
+def test_worker_routes_infer_jobs_with_knobs(tmp_path):
+    """The claim loop routes infer jobs to the injectable runner with
+    the worker's own placement knobs (mesh/async/bucket) — the warmed
+    --bucket worker contract from the simulate route."""
+    from scintools_tpu.serve import JobQueue, ServeWorker
+
+    q = JobQueue(str(tmp_path / "q"))
+    q.submit_infer(SERVE_SPEC, SERVE_INFER)
+    seen = {}
+
+    def spy_runner(spec_dict, infer_dict, opts, mesh, async_exec,
+                   bucket):
+        seen.update(spec=spec_dict, infer=infer_dict, bucket=bucket)
+        return [None] * spec_dict["n_epochs"]
+
+    worker = ServeWorker(q, batch_size=4, bucket=True,
+                         infer_runner=spy_runner)
+    worker.poll_once(force_flush=True)
+    assert seen["bucket"] is True
+    assert seen["spec"]["kind"] == "acf"
+    assert seen["infer"] == SERVE_INFER
+
+
+def test_worker_rejects_torn_infer_payload(tmp_path):
+    """A corrupted job record (either payload unparseable) is
+    deterministic poison: straight to failed/, no retry burn."""
+    from scintools_tpu.serve import JobQueue, ServeWorker
+    from scintools_tpu.serve.queue import Job
+
+    q = JobQueue(str(tmp_path / "q"))
+    job = Job(id="torn", file="infer:acf",
+              cfg={"synthetic": dict(SERVE_SPEC),
+                   "infer": {"opt_steps": "NaN?"}},
+              submitted_at=0.0)
+    q._write("leased", job)
+    worker = ServeWorker(q, batch_size=4)
+    worker._execute_infer(job)
+    assert q.state_of("torn") == "failed"
+
+
+def test_infer_job_failure_routes_through_taxonomy(tmp_path):
+    """A transient infra fault mid-campaign requeues budget-free (same
+    taxonomy as batches and simulate jobs)."""
+    from scintools_tpu.serve import JobQueue, ServeWorker
+
+    q = JobQueue(str(tmp_path / "q"))
+    jid, _ = q.submit_infer(SERVE_SPEC, SERVE_INFER)
+
+    def flaky_runner(spec_dict, infer_dict, opts, mesh, async_exec,
+                     bucket):
+        raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+
+    worker = ServeWorker(q, batch_size=4, max_wait_s=0.01,
+                         infer_runner=flaky_runner)
+    worker.poll_once(force_flush=True)
+    assert worker.stats["job_transient_retries"] == 1
+    job = q.get(jid)
+    assert job.transients == 1 and job.attempts == 0
+
+
+# ---------------------------------------------------------------------------
+# CLI: process --infer (resume keys) / submit --infer
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(argv):
+    from scintools_tpu.cli import main
+
+    return main(argv)
+
+
+_CLI_ARGS = ["--synthetic", "3", "--synth-kind", "acf", "--synth-nf",
+             "64", "--synth-nt", "64", "--synth-tau", "40", "--infer",
+             "--infer-steps", "120", "--infer-starts", "4"]
+
+
+def test_cli_process_infer_and_resume(tmp_path, capsys):
+    csv = str(tmp_path / "out.csv")
+    store = str(tmp_path / "runs")
+    argv = ["process", "--batched"] + _CLI_ARGS + ["--results", csv,
+                                                   "--store", store]
+    assert _run_cli(argv) == 0
+    with open(csv) as fh:
+        lines = fh.read().splitlines()
+    assert len(lines) == 4  # header + 3 epochs, epoch-ordered
+    assert lines[1].startswith("synth-acf-s0-00000,")
+    assert lines[3].startswith("synth-acf-s0-00002,")
+    # resume: every epoch done -> the fit is skipped outright
+    import scintools_tpu.infer as infer_pkg
+
+    ran = {"n": 0}
+    orig = infer_pkg.infer_rows
+
+    def counting(*a, **kw):
+        ran["n"] += 1
+        return orig(*a, **kw)
+
+    infer_pkg.infer_rows = counting
+    try:
+        assert _run_cli(argv) == 0
+    finally:
+        infer_pkg.infer_rows = orig
+    assert ran["n"] == 0
+    capsys.readouterr()
+
+
+def test_cli_infer_flag_validation(tmp_path):
+    with pytest.raises(SystemExit, match="add --infer"):
+        _run_cli(["process", "--batched", "--synthetic", "2",
+                  "--infer-steps", "50"])
+    with pytest.raises(SystemExit, match="--synthetic N"):
+        _run_cli(["process", "--batched", "--infer"])
+    with pytest.raises(SystemExit, match="roadmap follow-up"):
+        _run_cli(["process", "--batched", "--synthetic", "2",
+                  "--synth-kind", "screen", "--infer"])
+    with pytest.raises(SystemExit, match="lamsteps"):
+        _run_cli(["process", "--batched", "--synthetic", "2",
+                  "--synth-kind", "arc", "--infer"])
+    with pytest.raises(SystemExit, match="opt_steps"):
+        _run_cli(["process", "--batched", "--synthetic", "2",
+                  "--synth-kind", "acf", "--infer",
+                  "--infer-steps", "0"])
+    with pytest.raises(SystemExit, match="one bucketed batch"):
+        _run_cli(["process", "--batched", "--synthetic", "2",
+                  "--synth-kind", "acf", "--infer",
+                  "--chunk-epochs", "2"])
+
+
+def test_cli_submit_infer(tmp_path, capsys):
+    qdir = str(tmp_path / "q")
+    argv = ["submit", qdir] + _CLI_ARGS
+    rc = _run_cli(argv)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["submitted"] == 1
+    assert out["jobs"][0]["file"] == "infer:acf"
+    # dedup on resubmit
+    rc = _run_cli(argv)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["deduped"] == 1 and out["submitted"] == 0
+
+
+# ---------------------------------------------------------------------------
+# bench: the infer lane
+# ---------------------------------------------------------------------------
+
+
+def test_bench_infer_lane_record(monkeypatch, tmp_path):
+    import importlib.util
+
+    monkeypatch.setenv("SCINT_BENCH_MIN_MEASURE_S", "0")
+    monkeypatch.setenv("SCINT_BENCH_MAX_REPEATS", "1")
+    monkeypatch.setenv("SCINT_COMPILE_CACHE", "off")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_infer_test", os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    with obs.tracing():
+        rec = bench.infer_throughput(128, 128, 3, opt_steps=60, starts=2)
+    assert rec["infer"] is True
+    assert rec["epochs_per_s"] > 0
+    assert rec["opt_step_latency_s"] > 0
+    assert rec["shape"] == [3, 128, 128]
+    # the closed-loop claim rides the record: batch-mean recovery error
+    assert rec["tau_rel_err"] < TAU_BUDGET
+    assert rec["dnu_rel_err"] < DNU_BUDGET
