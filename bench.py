@@ -96,7 +96,15 @@ SCINT_BENCH_INFER ("1" = ALSO run the differentiable-inference lane
 multi-start MAP optimiser, recording ``epochs_per_s``, the amortised
 ``opt_step_latency_s`` and the batch-mean ``tau_rel_err`` /
 ``dnu_rel_err`` recovery error against the campaign's injected truth;
-attached as ``infer_lane`` to whichever headline record goes out).
+attached as ``infer_lane`` to whichever headline record goes out),
+SCINT_BENCH_SEARCH ("1" = ALSO run the acceleration-search lane
+(ISSUE 19) — the pruned coarse-to-fine matched filter over an arc-kind
+campaign, recording ``templates_epochs_per_s``, the resident
+``bank_bytes``, the closed-loop ``eta_rel_err`` and a naive
+exhaustive A/B as ``pruned_vs_naive`` rate+bytes ratios (error
+sub-record if that lane fails); sized by SCINT_BENCH_SEARCH_EPOCHS /
+_TRIALS / _TOPK / _DECIM; attached as ``search_lane`` to whichever
+headline record goes out).
 """
 
 import json
@@ -752,6 +760,122 @@ def infer_throughput(nf: int, nt: int, B: int, opt_steps: int = 400,
                           "iqr_pct": (round(100.0 * (q75 - q25) / rate,
                                             1) if rate else 0.0),
                           "measure_wall_s": round(spent, 3)}}
+    _trace_flush()
+    return rec
+
+
+def search_throughput(nf: int, nt: int, B: int, trials: int = 1024,
+                      repeats: int = 1) -> dict:
+    """The acceleration-search lane (``SCINT_BENCH_SEARCH=1``): rate
+    of template-epoch correlations per second through the pruned
+    coarse-to-fine program (``search_campaign``, arc kind at the bench
+    shape), the resident-bank footprint, the measured coarse/fine byte
+    split, and — because a fast search that misses the arc is
+    worthless — the batch-mean closed-loop curvature error against the
+    campaign's injected truth.  A naive exhaustive-full-resolution A/B
+    runs in the same weather window and lands as ``pruned_vs_naive``
+    (rate + measured-bytes ratios, the PR 7 ``fused_vs_chain``
+    pattern); if that lane fails, an error sub-record says so instead
+    of silently reading as "not requested"."""
+    _enable_compile_cache()
+    _maybe_enable_trace()
+    from scintools_tpu import obs
+    from scintools_tpu.search import SearchSpec, program_dims, \
+        search_campaign
+    from scintools_tpu.serve.worker import config_from_opts
+    from scintools_tpu.sim import campaign
+
+    spec = campaign.SynthSpec(kind="arc", n_epochs=B, nf=nf, nt=nt,
+                              dt=8.0, df=0.5)
+    # decim=8 keeps the coarse pass's recall solid on arc campaigns
+    # (the recall/cost trade-off in docs/search.md); the perf tier-1
+    # gate pushes decim higher on the acf kind where only the traffic
+    # ratio is asserted
+    srch = SearchSpec(
+        n_trials=int(trials),
+        top_k=_env_int("SCINT_BENCH_SEARCH_TOPK", 16),
+        decim=_env_int("SCINT_BENCH_SEARCH_DECIM", 8))
+    truth = campaign.injected_truth(spec, lamsteps=False)
+    J = int(srch.n_trials)
+    opts = {"lamsteps": False}
+    dims = program_dims(spec, config_from_opts(opts), srch)
+
+    out_holder: dict = {}
+
+    def one_pass(naive: bool = False):
+        out_holder["out"] = out = search_campaign(spec, srch, opts,
+                                                  naive=naive)
+        return float(np.asarray(out["score"]).sum())
+
+    def _measure(naive: bool = False):
+        min_wall = float(os.environ.get("SCINT_BENCH_MIN_MEASURE_S",
+                                        "2.0"))
+        max_passes = _env_int("SCINT_BENCH_MAX_REPEATS", 32)
+        rates = []
+        spent = 0.0
+        while True:
+            t0 = time.perf_counter()
+            one_pass(naive=naive)
+            dt_pass = time.perf_counter() - t0
+            rates.append(B * J / dt_pass)
+            spent += dt_pass
+            if len(rates) >= max_passes:
+                break
+            if len(rates) >= max(int(repeats), 1) and spent >= min_wall:
+                break
+        return rates, spent
+
+    def _step_bytes(gauges: dict, name: str):
+        vals = [v for k, v in gauges.items()
+                if k.startswith(f"step_bytes[{name}")]
+        return float(vals[0]) if vals else None
+
+    with obs.tracing() as reg:
+        t0 = time.perf_counter()
+        one_pass()
+        compile_s = time.perf_counter() - t0
+        gauges = dict(reg.gauges())
+    pruned_bytes = _step_bytes(gauges, "search.step")
+    rates, spent = _measure()
+    rate = float(np.median(rates))
+    q25, q75 = (float(np.percentile(rates, 25)),
+                float(np.percentile(rates, 75)))
+    out = out_holder["out"]
+    eta_fit = np.asarray(out["eta"], dtype=np.float64)  # host-f64: oracle comparison
+    eta_tru = float(truth["eta"])
+    rec = {"search": True, "templates_epochs_per_s": rate,
+           "epochs_per_s": rate / J,
+           "compile_s": round(compile_s, 2),
+           "shape": [int(B), int(nf), int(nt)],
+           "trials": J, "top_k": int(srch.top_k),
+           "decim": int(srch.decim),
+           "eta_rel_err": round(float(
+               abs(eta_fit.mean() - eta_tru) / eta_tru), 4),
+           "bank_bytes": gauges.get("bank_bytes"),
+           "dims": {k: int(dims[k]) for k in ("R", "L", "F", "Fc")},
+           "step_bytes": pruned_bytes,
+           "rate_stats": {"n": len(rates), "median": round(rate, 2),
+                          "q25": round(q25, 2), "q75": round(q75, 2),
+                          "iqr_pct": (round(100.0 * (q75 - q25) / rate,
+                                            1) if rate else 0.0),
+                          "measure_wall_s": round(spent, 3)}}
+    # the A/B lane: the naive exhaustive program in the same weather
+    # window.  Failures land as an error sub-record (the PR 7 pattern)
+    try:
+        with obs.tracing() as reg:
+            one_pass(naive=True)
+            naive_bytes = _step_bytes(dict(reg.gauges()),
+                                      "search.naive")
+        n_rates, _spent = _measure(naive=True)
+        n_rate = float(np.median(n_rates))
+        rec["pruned_vs_naive"] = {
+            "rate": round(rate / n_rate, 2) if n_rate else 0.0,
+            "naive_templates_epochs_per_s": round(n_rate, 2),
+            "bytes": (round(pruned_bytes / naive_bytes, 4)
+                      if pruned_bytes and naive_bytes else None),
+            "naive_step_bytes": naive_bytes}
+    except Exception as e:
+        rec["pruned_vs_naive"] = {"error": f"{type(e).__name__}: {e}"}
     _trace_flush()
     return rec
 
@@ -1574,6 +1698,23 @@ def main():
         except Exception as e:
             infer_holder["rec"] = {"error": f"{type(e).__name__}: {e}"}
 
+    # acceleration-search lane (SCINT_BENCH_SEARCH=1): pruned
+    # matched-filter throughput + closed-loop curvature recovery +
+    # naive A/B (ISSUE 19).  Like the infer lane it runs on THIS
+    # process's backend with the other pre-headline lanes, so it
+    # attaches to the device record AND the fallback record and a
+    # wedged chip can never mask it; failures land as {"error": ...}
+    # instead of reading as "not requested"
+    search_holder: dict = {}
+    if os.environ.get("SCINT_BENCH_SEARCH",
+                      "0").strip().lower() == "1":
+        try:
+            search_holder["rec"] = search_throughput(
+                nf, nt, _env_int("SCINT_BENCH_SEARCH_EPOCHS", 8),
+                trials=_env_int("SCINT_BENCH_SEARCH_TRIALS", 1024))
+        except Exception as e:
+            search_holder["rec"] = {"error": f"{type(e).__name__}: {e}"}
+
     def device_record(res: dict, probe: dict, is_fallback: bool = False,
                       batch_chunk: int | None = None, **extra) -> dict:
         rate = res["rate"]
@@ -1621,6 +1762,9 @@ def main():
         inf_lane = infer_holder.get("rec")
         if inf_lane:
             rec["infer_lane"] = inf_lane
+        srch_lane = search_holder.get("rec")
+        if srch_lane:
+            rec["search_lane"] = srch_lane
         rec["fused"] = bool(res.get("fused", False))
         fl = res.get("fused_lane")
         if fl:
@@ -1907,6 +2051,9 @@ def main():
     if infer_holder.get("rec"):
         # so did the differentiable-inference lane's gradient fits
         zero_rec["infer_lane"] = infer_holder["rec"]
+    if search_holder.get("rec"):
+        # and the acceleration-search lane's correlations
+        zero_rec["search_lane"] = search_holder["rec"]
     _trace_flush()
     print(json.dumps(zero_rec), flush=True)
     if device_lock is None:

@@ -161,6 +161,44 @@ def _infer_spec_dict_from_args(args) -> dict | None:
         raise SystemExit(str(e))
 
 
+def _search_spec_dict_from_args(args) -> dict | None:
+    """The --search flag set as a canonical sparse SearchSpec dict
+    (search.search_to_dict form) — the serve job payload and the
+    direct engine's resume-key ingredient, built ONCE so
+    process/submit/warmup agree on the bank identity.  Returns None
+    when --search was not given; rejects orphan --search-* knobs
+    (they would silently do nothing)."""
+    flags = (("search_trials", "n_trials", int),
+             ("search_eta_min", "eta_min", float),
+             ("search_eta_max", "eta_max", float),
+             ("search_width", "width", float),
+             ("search_rows", "delay_rows", int),
+             ("search_min_row", "min_row", int),
+             ("search_top_k", "top_k", int),
+             ("search_decim", "decim", int))
+    if not getattr(args, "search", False):
+        orphans = [f"--{flag.replace('_', '-')}"
+                   for flag, _f, _c in flags
+                   if getattr(args, flag, None) is not None]
+        if orphans:
+            raise SystemExit(f"{', '.join(orphans)} shape the "
+                             "template bank; add --search")
+        return None
+    from .search import search_from_dict, search_to_dict
+
+    d: dict = {}
+    for flag, field, cast in flags:
+        val = getattr(args, flag, None)
+        if val is not None:
+            d[field] = cast(val)
+    try:
+        # canonicalise through the spec class: validation + the sparse
+        # form sparse/materialised submitters share
+        return search_to_dict(search_from_dict(d))
+    except (TypeError, ValueError) as e:
+        raise SystemExit(str(e))
+
+
 def _validate_estimator_flags(args) -> None:
     """Shared --arc-bracket/--arc-method/--pad-chunks fail-fast for
     process, warmup and submit: a warmup or submit must reject exactly
@@ -204,6 +242,18 @@ def _validate_estimator_flags(args) -> None:
             # process/submit --infer rejects exactly what the worker
             # would reject
             cfg = dict(cfg, infer=infer_d)
+        search_d = _search_spec_dict_from_args(args)
+        if search_d is not None:
+            if synth is None:
+                raise SystemExit("--search scores a --synthetic "
+                                 "campaign's epochs against the "
+                                 "template bank; add --synthetic N")
+            # rides beside the campaign payload: validate_job_cfg runs
+            # the one search rule site (validate_search_config,
+            # including the infer/search mutual exclusion), so a
+            # process/submit --search rejects exactly what the worker
+            # would reject
+            cfg = dict(cfg, search=search_d)
         validate_job_cfg(cfg)
     except ValueError as e:
         raise SystemExit(str(e))
@@ -330,6 +380,10 @@ def cmd_process(args) -> int:
         if infer_d is not None:
             return _process_infer(args, synth_d, infer_d, cfg, store,
                                   log, timers)
+        search_d = _search_spec_dict_from_args(args)
+        if search_d is not None:
+            return _process_search(args, synth_d, search_d, cfg, store,
+                                   log, timers)
         return _process_synthetic(args, synth_d, cfg, store, log,
                                   timers)
     if not files:
@@ -895,6 +949,99 @@ def _process_infer(args, synth_d: dict, infer_d: dict, cfg, store,
     return 0 if failed == 0 else 1
 
 
+def _process_search(args, synth_d: dict, search_d: dict, cfg, store,
+                    log, timers) -> int:
+    """Acceleration-search engine for cmd_process (ISSUE 19): the
+    campaign's keys go to the device and the WHOLE chain — generate ->
+    cropped secondary spectrum -> Fourier-domain correlation against
+    the resident template bank -> coarse-to-fine scores — runs as ONE
+    compiled step (``search.search_rows``).  One candidate row per
+    epoch lands in the CSV/store through the same row builder and
+    NaN-lane quarantine as the served `search` job kind, so a direct
+    run's CSV is byte-identical to a served one.
+
+    Resumable like the synthetic engine: per-epoch store keys hash
+    (campaign identity, bank identity, epoch index, estimator cfg) in
+    the serve route's ``<base>.<index>`` shape."""
+    from .io.results import write_results
+    from .parallel import make_mesh
+    from .search import search_rows
+    from .sim import campaign
+    from .utils import content_key, log_event
+
+    for flag, name in ((getattr(args, "chunk_epochs", None),
+                        "--chunk-epochs"),
+                       (getattr(args, "pad_chunks", False),
+                        "--pad-chunks")):
+        if flag:
+            raise SystemExit(f"{name} chunks the file/simulate "
+                             "engines; the search step always runs "
+                             "the campaign as one bucketed batch")
+    spec = campaign.spec_from_dict(synth_d)
+    n = spec.n_epochs
+    # per-epoch resume keys: campaign digest + bank digest + the epoch
+    # index — a matched-filter search is a different result than a
+    # summary fit OR a gradient fit of the same campaign, so the
+    # identities never alias
+    base = content_key(("search", repr(synth_d), repr(search_d)), cfg)
+
+    def keyfn(i: int) -> str:
+        return campaign.synth_row_key(base, i)
+
+    if store is not None:
+        todo = [i for i in range(n) if keyfn(i) not in store]
+        log_event(log, "resume", total=n, todo=len(todo),
+                  done=n - len(todo))
+        if not todo:
+            if args.results:
+                store.export_csv(args.results,
+                                 full=getattr(args, "full_csv", False))
+            print(timers.report(), file=sys.stderr)
+            log_event(log, "done", processed=0, failed=0, quarantined=0)
+            return 0
+    obs.inc("search_jobs")
+    rows, failed = [], 0
+    mesh_shape = getattr(args, "mesh", None)
+    try:
+        mesh = (make_mesh(tuple(int(x) for x in mesh_shape))
+                if mesh_shape else make_mesh())
+        with timers.stage("search_pipeline"), \
+                _xprof_ctx(getattr(args, "xprof", None)):
+            rows = search_rows(
+                spec, search_d, _estimator_opts(args), mesh=mesh,
+                async_exec=not getattr(args, "no_async", False))
+    except Exception as e:
+        log_event(log, "pipeline_failed", error=repr(e), epochs=n)
+        failed = n
+    processed = 0
+    for i, row in enumerate(rows):
+        if row is None:
+            # NaN lane: quarantined (no CSV row, no store entry ->
+            # retried on resume), as the batched engine does
+            failed += 1
+            obs.inc("epochs_failed")
+            log_event(log, "epoch_failed",
+                      file=campaign.epoch_name(spec, i),
+                      error="non-finite score (NaN lane)")
+            continue
+        if args.results:
+            write_results(args.results, row)
+        if store is not None:
+            store.put_new_buffered(keyfn(i), row)
+        processed += 1
+        log_event(log, "epoch", file=row["name"], eta=row.get("eta"),
+                  snr=row.get("search_snr"))
+    if store is not None:
+        store.flush()
+    if store is not None and args.results:
+        store.export_csv(args.results,
+                         full=getattr(args, "full_csv", False))
+    print(timers.report(), file=sys.stderr)
+    log_event(log, "done", processed=processed, failed=failed,
+              quarantined=0)
+    return 0 if failed == 0 else 1
+
+
 def cmd_warmup(args) -> int:
     """Pre-compile the batched pipeline's step set for a template +
     config, so a later ``process --batched`` run pays ZERO trace/compile
@@ -935,6 +1082,31 @@ def cmd_warmup(args) -> int:
                           "(SCINT_COMPILE_CACHE=off); nothing to warm"}))
         return 1
     synth_d = _synth_spec_dict_from_args(args)
+    search_d = _search_spec_dict_from_args(args)
+    if search_d is not None:
+        # `warmup --search` (ISSUE 19): lower the pruned correlation
+        # program against ShapeDtypeStructs — no bank build, no
+        # campaign run — landing the persistent-cache entries a later
+        # `process --batched --search` or served `search` job hits
+        # warm.  --catalog warms every rung up to the campaign's (the
+        # serve worker's any-epoch-count contract).
+        if files:
+            raise SystemExit("--search warmups take no template files "
+                             "(the campaign + bank specs define the "
+                             "program)")
+        import jax
+
+        from .search import warm_search
+
+        sigs = warm_search(synth_d, search_d, _estimator_opts(args),
+                           batch=args.batch,
+                           catalog=getattr(args, "catalog", False))
+        for sig in sigs:
+            log_event(log, "warmup_signature", **sig)
+        print(json.dumps({"cache_dir": cache, "jax": jax.__version__,
+                          "backend": jax.default_backend(),
+                          "signatures": sigs}))
+        return 0
     synth_spec = genid = None
     epochs, failed = [], 0
     if synth_d is not None:
@@ -1200,6 +1372,7 @@ def cmd_submit(args) -> int:
         if files:
             raise SystemExit("--synthetic submits take no input files")
         infer_d = _infer_spec_dict_from_args(args)
+        search_d = _search_spec_dict_from_args(args)
         if infer_d is not None:
             try:
                 rec = client.submit_infer(synth_d, infer_d,
@@ -1208,6 +1381,19 @@ def cmd_submit(args) -> int:
             except ValueError as e:
                 raise SystemExit(str(e))
             recs = [{"file": f"infer:{synth_d.get('kind', 'screen')}",
+                     "job": rec["job"], "status": rec["status"]}]
+        elif search_d is not None:
+            # `search` job kind (ISSUE 19): the same campaign payload
+            # plus the bank/pruning knobs, scored by Fourier-domain
+            # matched filtering against the resident template bank
+            # (docs/search.md)
+            try:
+                rec = client.submit_search(synth_d, search_d,
+                                           _estimator_opts(args),
+                                           lane=lane)
+            except ValueError as e:
+                raise SystemExit(str(e))
+            recs = [{"file": f"search:{synth_d.get('kind', 'screen')}",
                      "job": rec["job"], "status": rec["status"]}]
         else:
             rec = client.submit_synthetic(synth_d, _estimator_opts(args),
@@ -1933,6 +2119,53 @@ def _add_infer_flags(q) -> None:
                         "host-side lattice, never runtime RNG)")
 
 
+def _add_search_flags(q) -> None:
+    """The acceleration-search flags (ISSUE 19) — one definition
+    shared by process/warmup/submit, so the bank identity (resume key,
+    serve job identity) is built from the same spec everywhere
+    (`_search_spec_dict_from_args`)."""
+    q.add_argument("--search", action="store_true",
+                   help="score the --synthetic campaign's secondary "
+                        "spectra against an HBM-resident bank of "
+                        "curvature-trial templates (Fourier-domain "
+                        "matched filter, coarse-to-fine pruning; "
+                        "docs/search.md)")
+    q.add_argument("--search-trials", type=int, default=None,
+                   dest="search_trials", metavar="J",
+                   help="curvature trials in the bank (default 256, "
+                        "geometric spacing)")
+    q.add_argument("--search-eta-min", type=float, default=None,
+                   dest="search_eta_min", metavar="ETA",
+                   help="lowest trial curvature, us/mHz^2 (default: "
+                        "auto range derived from the grid; set both "
+                        "bounds or neither)")
+    q.add_argument("--search-eta-max", type=float, default=None,
+                   dest="search_eta_max", metavar="ETA",
+                   help="highest trial curvature, us/mHz^2")
+    q.add_argument("--search-width", type=float, default=None,
+                   dest="search_width",
+                   help="template ridge sigma in Doppler pixels "
+                        "(default 1.0)")
+    q.add_argument("--search-rows", type=int, default=None,
+                   dest="search_rows", metavar="R",
+                   help="delay rows scored (default nrfft/4 — the "
+                        "crop-split window)")
+    q.add_argument("--search-min-row", type=int, default=None,
+                   dest="search_min_row", metavar="R0",
+                   help="zero template rows below this delay row "
+                        "(default 1: skip the DC self-power row)")
+    q.add_argument("--search-top-k", type=int, default=None,
+                   dest="search_top_k", metavar="K",
+                   help="fine-pass survivors per epoch (default 16; "
+                        "the compiled ceiling — the runtime budget "
+                        "tightens it without recompiling)")
+    q.add_argument("--search-decim", type=int, default=None,
+                   dest="search_decim", metavar="D",
+                   help="coarse-pass Fourier-bin decimation (default "
+                        "8; the compiled grid — the runtime budget "
+                        "coarsens it without recompiling)")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="scintools-tpu",
@@ -2034,6 +2267,7 @@ def build_parser() -> argparse.ArgumentParser:
     _add_perf_policy_flags(q)
     _add_synth_flags(q)
     _add_infer_flags(q)
+    _add_search_flags(q)
     q.set_defaults(fn=cmd_process)
 
     q = sub.add_parser(
@@ -2099,6 +2333,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "(SCINT_BUCKET_TOP, default 64)")
     _add_perf_policy_flags(q)
     _add_synth_flags(q)
+    _add_search_flags(q)
     q.set_defaults(fn=cmd_warmup)
 
     q = sub.add_parser(
@@ -2243,6 +2478,7 @@ def build_parser() -> argparse.ArgumentParser:
     _add_perf_policy_flags(q)
     _add_synth_flags(q)
     _add_infer_flags(q)
+    _add_search_flags(q)
     q.set_defaults(fn=cmd_submit)
 
     q = sub.add_parser(

@@ -270,6 +270,33 @@ def split_backend_key(back_desc, back_sig) -> str:
     return hashlib.sha256(desc.encode()).hexdigest()[:32]
 
 
+def search_key(spec, config, srch, rung: int) -> str:
+    """Content-hash key of one compiled acceleration-search signature
+    (ISSUE 19).  The bank DIMENSIONS are program statics, so they key
+    here alongside the generator identity, the analysis config, the
+    batch rung and the environment: a different trial count, delay
+    window or pruning envelope is a different executable even over
+    identical axes (the ``warmup --search`` label + cache identity)."""
+    import dataclasses as _dc
+
+    import jax
+    import jaxlib
+
+    from .sim import campaign as _campaign
+
+    desc = repr((
+        _FORMAT, "search",
+        _campaign.generator_id(spec),
+        repr(config),
+        _dc.astuple(srch),
+        int(rung),
+        bool(jax.config.jax_enable_x64),
+        jax.__version__, jaxlib.__version__, jax.default_backend(),
+        _source_fingerprint(),
+    ))
+    return hashlib.sha256(desc.encode()).hexdigest()[:32]
+
+
 def artifact_path(key: str) -> str | None:
     d = aot_dir()
     return None if d is None else os.path.join(d, key + ".jaxexport")

@@ -92,6 +92,16 @@ COUNTERS = frozenset({
     # (diverged = best lane's loss non-finite -> row quarantined)
     "infer_jobs", "infer_epochs", "opt_steps",
     "infer_converged", "infer_diverged",
+    # acceleration-search plane (scintools_tpu.search — ISSUE 19):
+    # search_jobs = search campaigns executed (served or direct CLI);
+    # search_epochs = epochs scored; templates_scored = (epoch,
+    # template) correlations issued (coarse full bank + fine
+    # survivors, or the full bank once on the naive reference);
+    # prune_survivors = fine-lane trials that survived the coarse
+    # pass; candidates_emitted = per-epoch candidate rows that
+    # cleared the non-finite quarantine
+    "search_jobs", "search_epochs", "templates_scored",
+    "prune_survivors", "candidates_emitted",
 })
 
 # -- gauges (obs.gauge) -----------------------------------------------------
@@ -111,6 +121,9 @@ GAUGES = frozenset({
     # SLO & alerting plane (obs/slo.py): count of alerts currently in
     # the firing state (per-SLO burn/budget ride bracketed families)
     "alerts_firing",
+    # acceleration-search plane (search/bank.py): resident template
+    # bank footprint (the conjugated rFFT buffer held in HBM)
+    "bank_bytes",
 })
 
 # -- spans (obs.span / obs.traced) ------------------------------------------
@@ -133,6 +146,11 @@ SPANS = frozenset({
     # span per MAP-fit campaign; the compiled step's compile/execute
     # sub-spans ride instrument_jit's dynamic "infer.step.*" names
     "infer.fit",
+    # acceleration-search plane (search/runner.py — ISSUE 19): one
+    # span per scored campaign; the compiled programs' compile/execute
+    # sub-spans ride instrument_jit's dynamic "search.step.*" /
+    # "search.naive.*" names
+    "search.score",
     # repo-root bench.py (walked by the lint since ISSUE 16): the
     # headline measurement's own decomposition spans
     "bench.baseline_epoch", "bench.h2d", "bench.step.compile",

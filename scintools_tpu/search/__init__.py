@@ -1,0 +1,34 @@
+"""Fourier-domain acceleration-search plane (ISSUE 19).
+
+Batched matched-filter scoring of synthetic campaigns against an
+HBM-resident bank of curvature-trial templates: the GPU FDAS
+correlation shape (arXiv:1804.05335 / arXiv:1711.10855 — resident
+bank + frequency-domain multiply-accumulate) ported onto the bucket
+ladder, the PR 7 crop-split row DFT and the serve identity stack.
+Coarse-to-fine pruning (decimated full-bank pass, top-K re-scored at
+full resolution) keeps the scored traffic a small fraction of the
+exhaustive reference; K and the decimation are runtime inputs, so
+re-budgeting recall/cost never recompiles.  Served as the ``search``
+job kind (``JobQueue.submit_search`` / ``scint-tpu submit QDIR
+--search``) and runnable directly (``scint-tpu process --synthetic N
+--search``).
+
+See docs/search.md for bank geometry, the recall/cost trade-off and
+measured throughput.
+"""
+
+from .bank import (SearchSpec, bank_delay_rows, bank_resident,
+                   build_bank, trial_etas, validate_search)
+from .engine import program_dims, search_grid, search_program, \
+    search_step_fn
+from .runner import (search_campaign, search_from_dict, search_rows,
+                     search_to_dict, validate_search_config,
+                     warm_search)
+
+__all__ = [
+    "SearchSpec", "validate_search", "bank_delay_rows", "trial_etas",
+    "build_bank", "bank_resident",
+    "search_grid", "program_dims", "search_step_fn", "search_program",
+    "search_campaign", "search_rows", "search_to_dict",
+    "search_from_dict", "validate_search_config", "warm_search",
+]

@@ -1,0 +1,262 @@
+"""The search campaign engine: batched matched-filter scoring of a
+synthetic campaign against a resident curvature-trial bank.
+
+Ties the plane together (ISSUE 19): a :class:`SearchSpec` of bank
+geometry + pruning knobs rides next to a synthetic campaign spec; the
+pair (plus the analysis-config fields the spectrum consumes) keys ONE
+memoised jit program per (generator identity, grid, bank statics,
+batch rung) — :mod:`scintools_tpu.search.engine`.  Identity discipline
+mirrors the simulate/infer routes:
+
+* the batch axis pads to the bucket ladder rung (``buckets.rung_for``)
+  by repeating the last key row — every campaign size within a rung
+  shares one compiled program, pad lanes are sliced off;
+* the executed fine-lane count and coarse decimation ride as TRACED
+  runtime inputs (``top_k_rt``/``decim_rt``) within the compiled
+  ``top_k``/``decim`` envelope, so re-budgeting recall/cost never
+  recompiles;
+* :func:`search_rows` is the ONE row builder shared by the CLI
+  ``--search`` engine and the serve ``search`` job runner — served CSV
+  bytes are identical to a direct run's by construction.  The winning
+  trial's curvature exports through the standard ``eta``/``etaerr``
+  columns (``etaerr`` = the trial grid's half-step quantisation);
+  SNR, scores and pruning diagnostics ride as store-only
+  ``search_*`` columns.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .. import buckets, obs
+from ..sim import campaign
+from .bank import SearchSpec, bank_resident, validate_search
+from .engine import program_dims, search_grid, search_program
+
+__all__ = ["search_to_dict", "search_from_dict",
+           "validate_search_config", "search_campaign", "search_rows",
+           "warm_search"]
+
+
+def search_to_dict(srch: SearchSpec) -> dict:
+    """Canonical sparse JSON-able form (the serve job payload under
+    ``cfg["search"]`` and the CLI resume-key ingredient): only
+    non-default fields, so sparse client dicts and materialised CLI
+    dicts share one job identity (the spec_to_dict convention)."""
+    d0 = SearchSpec()
+    return {f.name: getattr(srch, f.name)
+            for f in dataclasses.fields(SearchSpec)
+            if getattr(srch, f.name) != getattr(d0, f.name)}
+
+
+def search_from_dict(d: dict | None) -> SearchSpec:
+    """Inverse of :func:`search_to_dict`; unknown keys raise."""
+    d = dict(d or {})
+    names = {f.name for f in dataclasses.fields(SearchSpec)}
+    unknown = set(d) - names
+    if unknown:
+        raise ValueError(f"unknown SearchSpec field(s): "
+                         f"{sorted(unknown)}")
+    srch = SearchSpec(**d)
+    validate_search(srch)
+    return srch
+
+
+def validate_search_config(spec, srch: SearchSpec, config) -> None:
+    """Cross-field validation of (campaign, bank, analysis) — the
+    shared gate of the CLI engine and ``JobQueue.submit_search``."""
+    validate_search(srch)
+    if config.lamsteps:
+        raise ValueError(
+            "search scores the frequency-grid secondary spectrum "
+            "(trial curvature eta in us/mHz^2); lambda-resampled "
+            "(beta-eta) banks are roadmap follow-up work")
+    # grid cross-checks (delay window, coarse-bin floor, auto range)
+    # raise here — at submit — with the bank plane's own messages
+    program_dims(spec, config, srch)
+    nf, nt, dt, df = search_grid(spec)
+    from .bank import trial_etas
+
+    trial_etas(nf, nt, dt, df, config.fft_lens, srch)
+
+
+def search_campaign(spec, srch=None, opts=None, *, bucket: bool = True,
+                    top_k_rt: int | None = None,
+                    decim_rt: int | None = None,
+                    naive: bool = False) -> dict:
+    """Run one acceleration-search campaign on device and return the
+    per-epoch best-trial candidates.
+
+    ``spec``/``srch`` accept dataclasses or (sparse) dicts.  ``bucket``
+    pads the epoch axis to the catalog rung (default: the serve/warm
+    contract); ``top_k_rt``/``decim_rt`` re-budget the pruning within
+    the compiled envelope without recompiling; ``naive=True`` runs the
+    exhaustive full-resolution reference program instead (the A/B
+    lane — identical output contract, no pruning knobs).
+
+    Returns ``{"kind", "eta": [B], "etaerr": [B], "snr": [B],
+    "score": [B], "coarse": [B], "trial": [B], "shift": [B],
+    "trials": J, "survivors": K_rt}`` with ``shift`` the signed
+    Doppler-lag bin of the correlation peak.
+    """
+    from .. import compile_cache
+    from ..serve.worker import config_from_opts
+
+    if not isinstance(spec, campaign.SynthSpec):
+        spec = campaign.spec_from_dict(spec)
+    if not isinstance(srch, SearchSpec):
+        srch = search_from_dict(srch)
+    config = config_from_opts(dict(opts or {}))
+    validate_search_config(spec, srch, config)
+    # the direct `process --batched --search` path reaches the compile
+    # below without the driver/worker entrypoints that wire the
+    # persistent XLA cache — wire it here (idempotent) so a
+    # `warmup --search` entry is actually hit
+    compile_cache.enable_persistent_cache()
+    dims = program_dims(spec, config, srch)
+    k_rt = srch.top_k if top_k_rt is None else int(top_k_rt)
+    if not 0 < k_rt <= srch.top_k:
+        raise ValueError(f"top_k_rt must be in [1, {srch.top_k}] (the "
+                         f"compiled ceiling), got {k_rt}")
+    d_rt = srch.decim if decim_rt is None else int(decim_rt)
+    if d_rt < srch.decim:
+        raise ValueError(f"decim_rt must be >= {srch.decim} (the "
+                         f"compiled coarse grid), got {d_rt}")
+    if dims["F"] // d_rt < 2:
+        raise ValueError(f"decim_rt={d_rt} leaves fewer than 2 coarse "
+                         f"Fourier bins (F={dims['F']})")
+    B = int(spec.n_epochs)
+    rung = buckets.rung_for(B) if bucket else B
+    raw = campaign.stage_batch(spec)
+    if rung > B:
+        raw = np.concatenate([raw, np.repeat(raw[-1:], rung - B,
+                                             axis=0)], axis=0)
+    nf, nt, dt, df = (dims["nf"], dims["nt"], dims["dt"], dims["df"])
+    etas, bank_hat, _L = bank_resident(nf, nt, dt, df, config.fft_lens,
+                                       srch)
+    prog = search_program(spec, config, srch, rung, naive=naive)
+    J = int(srch.n_trials)
+    obs.inc("search_epochs", B)
+    obs.inc("bytes_h2d", raw.nbytes)
+    # every epoch scores the full bank coarsely plus K_rt survivors
+    # finely; the naive reference scores the bank once, exhaustively
+    obs.inc("templates_scored", B * J if naive else B * (J + k_rt))
+    if not naive:
+        obs.inc("prune_survivors", B * k_rt)
+    with obs.span("search.score", kind=spec.kind, epochs=B, rung=rung,
+                  trials=J, top_k=k_rt, decim=d_rt, naive=bool(naive)):
+        if naive:
+            out = prog(raw, bank_hat)
+        else:
+            out = prog(raw, bank_hat, np.uint32(k_rt), np.uint32(d_rt))
+    out = {k: np.asarray(v)[:B] for k, v in out.items()}
+    trial = out["trial"].astype(int)
+    eta = np.asarray(etas)[trial]
+    # trial-grid quantisation as the reported uncertainty: half a
+    # geometric step on either side of the winning trial
+    g = float(etas[1] / etas[0]) if len(etas) > 1 else 1.0
+    etaerr = eta * (g - 1.0) / 2.0
+    shift = out["shift"].astype(int)
+    L = dims["L"]
+    shift = np.where(shift > L // 2, shift - L, shift)
+    return {"kind": spec.kind, "eta": eta, "etaerr": etaerr,
+            "snr": out["snr"], "score": out["score"],
+            "coarse": out["coarse"], "trial": trial, "shift": shift,
+            "trials": J, "survivors": int(k_rt)}
+
+
+def search_rows(spec, srch=None, opts=None, mesh=None,
+                async_exec: bool = True, bucket: bool = True) -> list:
+    """One candidate row per epoch (``None`` for quarantined non-finite
+    lanes) — the ONE row builder shared by the CLI ``--search`` engine
+    and the serve ``search`` job runner, so served CSV rows are
+    byte-identical to a direct run's (the simulate-route contract).
+
+    ``mesh``/``async_exec`` are accepted for runner-signature symmetry
+    with ``synthetic_rows``; the search program is single-host today
+    (sharded search is roadmap follow-up).
+    """
+    from ..io.results import row_fit_values
+
+    del mesh, async_exec
+    if not isinstance(spec, campaign.SynthSpec):
+        spec = campaign.spec_from_dict(spec)
+    if not isinstance(srch, SearchSpec):
+        srch = search_from_dict(srch)
+    res = search_campaign(spec, srch, opts, bucket=bucket)
+    meta = campaign.synth_meta(spec)
+    rows: list = [None] * spec.n_epochs
+    emitted = 0
+    for i in range(spec.n_epochs):
+        row = dict(meta)
+        row["name"] = campaign.epoch_name(spec, i)
+        row["mjd"] = campaign._MJD0 + int(i)
+        row["eta"] = float(res["eta"][i])
+        row["etaerr"] = float(res["etaerr"][i])
+        row["search_snr"] = float(res["snr"][i])
+        row["search_score"] = float(res["score"][i])
+        row["search_coarse"] = float(res["coarse"][i])
+        row["search_trial"] = int(res["trial"][i])
+        row["search_shift"] = int(res["shift"][i])
+        row["search_survivors"] = int(res["survivors"])
+        fitvals = row_fit_values(row)
+        if (fitvals and not np.all(np.isfinite(fitvals))) \
+                or not np.isfinite(res["score"][i]):
+            continue   # NaN lane: quarantined (rows[i] stays None)
+        rows[i] = row
+        emitted += 1
+    obs.inc("candidates_emitted", emitted)
+    return rows
+
+
+def warm_search(spec, srch=None, opts=None, *, batch: int | None = None,
+                catalog: bool = False) -> list:
+    """Pre-compile the search program set for a campaign + bank spec
+    (the ``warmup --search`` engine): lowers the PRUNED step against
+    ShapeDtypeStructs — no bank build, no campaign execution — and
+    compiles it with whatever persistent XLA cache the caller enabled,
+    so a later ``process --batched --search`` or served `search` job
+    pays zero compile.  ``catalog`` warms every bucket rung up to the
+    campaign's (the serve worker's any-epoch-count contract);
+    ``batch`` overrides the planned epoch count.
+
+    Returns one ``{"rung", "key", "status", "compile_s"}`` record per
+    signature (``key`` = the bank-dimension compile-cache key,
+    :func:`scintools_tpu.compile_cache.search_key`)."""
+    import time
+
+    import jax
+
+    from .. import compile_cache
+    from ..serve.worker import config_from_opts
+    from .engine import search_step_fn
+
+    if not isinstance(spec, campaign.SynthSpec):
+        spec = campaign.spec_from_dict(spec)
+    if not isinstance(srch, SearchSpec):
+        srch = search_from_dict(srch)
+    config = config_from_opts(dict(opts or {}))
+    validate_search_config(spec, srch, config)
+    dims = program_dims(spec, config, srch)
+    B = int(batch or spec.n_epochs)
+    top = buckets.rung_for(B)
+    rungs = ([r for r in buckets.batch_ladder() if r <= top] or [top]) \
+        if catalog else [top]
+    width = campaign.stage_width(spec)
+    J = int(srch.n_trials)
+    sigs = []
+    for rung in rungs:
+        step = search_step_fn(spec, config, srch)
+        raw_s = jax.ShapeDtypeStruct((int(rung), width), np.uint32)
+        bank_s = jax.ShapeDtypeStruct((J, dims["R"], dims["F"]),
+                                      np.complex64)
+        scalar = jax.ShapeDtypeStruct((), np.uint32)
+        key = compile_cache.search_key(spec, config, srch, int(rung))
+        t0 = time.perf_counter()
+        jax.jit(step).lower(raw_s, bank_s, scalar, scalar).compile()
+        sigs.append({"rung": int(rung), "key": key,
+                     "status": "compiled",
+                     "compile_s": round(time.perf_counter() - t0, 3)})
+    return sigs

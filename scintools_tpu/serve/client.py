@@ -73,6 +73,23 @@ class SurveyClient:
         return {"spec": dict(spec), "infer": dict(infer or {}),
                 "job": job_id, "status": status}
 
+    def submit_search(self, spec: dict, search: dict | None = None,
+                      opts: dict | None = None,
+                      lane: str | None = None) -> dict:
+        """Submit one acceleration-search campaign (`search` job kind,
+        ISSUE 19): ``spec`` is the synthetic campaign whose epochs are
+        scored, ``search`` the sparse bank/pruning knobs
+        (``scintools_tpu.search.search_to_dict``), ``opts`` the
+        pipeline options the spectrum derives from.  Idempotent per
+        (canonical spec, canonical search, opts) — a distinct identity
+        from the simulate and infer jobs of the same campaign.
+        ``lane`` defaults to bulk.  Returns
+        ``{spec, search, job, status}``."""
+        job_id, status = self.queue.submit_search(
+            spec, search, dict(opts or {}), lane=lane)
+        return {"spec": dict(spec), "search": dict(search or {}),
+                "job": job_id, "status": status}
+
     def compact(self) -> dict:
         """Submit one results-plane compaction (`compact` job kind):
         the worker merges small segment files into one so long

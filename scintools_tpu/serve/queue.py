@@ -190,6 +190,12 @@ def validate_job_cfg(cfg: dict) -> None:
         campaign.spec_from_dict(cfg["synthetic"])
         _validate_synth_config(config_from_opts(cfg), mesh=None,
                                chan_sharded=None)
+    if cfg.get("infer") is not None and cfg.get("search") is not None:
+        # the cross-engine rule outranks either engine's own checks: a
+        # two-engine cfg is malformed whatever each payload says
+        raise ValueError(
+            "a job is one engine: cfg['search'] and cfg['infer'] "
+            "are mutually exclusive (submit two jobs)")
     if cfg.get("infer") is not None:
         # infer-job payload (ISSUE 18): the optimiser spec and its
         # cross-field rules (supported kinds, lamsteps for arc) fail at
@@ -204,6 +210,21 @@ def validate_job_cfg(cfg: dict) -> None:
         validate_infer_config(campaign.spec_from_dict(cfg["synthetic"]),
                               infer_from_dict(cfg["infer"]),
                               config_from_opts(cfg))
+    if cfg.get("search") is not None:
+        # search-job payload (ISSUE 19): the bank spec and its grid
+        # cross-field rules (delay window, coarse-bin floor, auto trial
+        # range, lamsteps exclusion) fail at submit with the search
+        # plane's own one-rule-site messages
+        from ..search import search_from_dict, validate_search_config
+        from ..sim import campaign
+
+        if cfg.get("synthetic") is None:
+            raise ValueError(
+                "search jobs ride a synthetic campaign payload: "
+                "cfg['synthetic'] is required beside cfg['search']")
+        validate_search_config(
+            campaign.spec_from_dict(cfg["synthetic"]),
+            search_from_dict(cfg["search"]), config_from_opts(cfg))
 
 
 def cfg_signature(cfg: dict) -> tuple:
@@ -965,6 +986,52 @@ class JobQueue:
         root = obs.event("job.submit", trace_id=trace, job=job_id,
                          file=f"infer:{kind}", lane=lane)
         self._write(QUEUED, Job(id=job_id, file=f"infer:{kind}",
+                                cfg=cfg, submitted_at=_submit_stamp(),
+                                trace_id=trace, span=root, lane=lane,
+                                sig=job_sig(cfg),
+                                est_bytes=self._synth_est_bytes(
+                                    spec_obj)))
+        self._depth_gauge(job_id, lane=lane)
+        return job_id, "submitted"
+
+    def submit_search(self, spec: dict, search: dict | None = None,
+                      cfg: dict | None = None,
+                      lane: str | None = None) -> tuple[str, str]:
+        """Enqueue one acceleration-search campaign (`search` job kind,
+        ISSUE 19): ``spec`` is the synthetic-campaign payload whose
+        epochs are scored, ``search`` the sparse
+        :func:`scintools_tpu.search.search_to_dict` bank/pruning knobs.
+        Both ride inside the option dict (``cfg["synthetic"]`` +
+        ``cfg["search"]``) so ``cfg_signature`` separates search jobs
+        from the simulate AND infer jobs of the same campaign by
+        construction.  Identity, dedup, idempotent rows, est-bytes
+        routing and the BULK lane default all follow the simulate-job
+        contract; rows key ``<job_id>.<epoch_index>`` and the served
+        CSV is byte-identical to a direct ``process --search`` run
+        (one shared row builder,
+        :func:`scintools_tpu.search.search_rows`)."""
+        from ..search import search_from_dict, search_to_dict
+        from ..sim import campaign
+
+        lane = validate_lane(lane, LANE_BULK)
+        cfg = dict(cfg or {})
+        # canonicalise both payloads: sparse and materialised dicts of
+        # the same (campaign, bank) must share one job identity
+        spec_obj = campaign.spec_from_dict(spec)
+        cfg["synthetic"] = campaign.spec_to_dict(spec_obj)
+        cfg["search"] = search_to_dict(search_from_dict(search))
+        validate_job_cfg(cfg)
+        job_id = content_key("search", ("serve",) + cfg_signature(cfg))
+        if campaign.synth_row_key(job_id, 0) in self.results:
+            return job_id, DONE
+        existing = self.state_of(job_id)
+        if existing is not None:
+            return job_id, existing
+        kind = cfg["synthetic"].get("kind", "screen")
+        trace = new_trace_id()
+        root = obs.event("job.submit", trace_id=trace, job=job_id,
+                         file=f"search:{kind}", lane=lane)
+        self._write(QUEUED, Job(id=job_id, file=f"search:{kind}",
                                 cfg=cfg, submitted_at=_submit_stamp(),
                                 trace_id=trace, span=root, lane=lane,
                                 sig=job_sig(cfg),

@@ -158,6 +158,27 @@ def infer_job_runner(spec_dict: dict, infer_dict: dict, opts: dict,
                       mesh=mesh, async_exec=async_exec)
 
 
+def search_job_runner(spec_dict: dict, search_dict: dict, opts: dict,
+                      mesh=None, async_exec: bool = True,
+                      bucket: bool = False) -> list:
+    """Default `search`-job executor (ISSUE 19): the acceleration
+    search as ONE fused correlation program against the resident
+    template bank, rows built by the same helper as the CLI's
+    ``--search`` engine (``scintools_tpu.search.search_rows``) —
+    served CSV rows are byte-identical to a direct run of the same
+    payloads.  The search program always canonicalises its batch onto
+    the catalog ladder (results byte-identical at any rung), so the
+    worker's ``bucket`` knob is forwarded for signature symmetry
+    only."""
+    from ..search import search_from_dict, search_rows
+    from ..sim import campaign
+
+    del bucket
+    spec = campaign.spec_from_dict(spec_dict)
+    return search_rows(spec, search_from_dict(search_dict), opts,
+                       mesh=mesh, async_exec=async_exec)
+
+
 def pipeline_runner(batch: Batch, batch_size: int, mesh=None,
                     async_exec: bool = True) -> list:
     """Default batch executor: ONE padded compiled step over the
@@ -205,7 +226,8 @@ class ServeWorker:
                  async_exec: bool = True, worker_id: str | None = None,
                  bucket: bool = False, synth_runner=None,
                  heartbeat_s: float = 10.0,
-                 lane_budgets: dict | None = None, infer_runner=None):
+                 lane_budgets: dict | None = None, infer_runner=None,
+                 search_runner=None):
         self.queue = queue
         self.batch_size = int(batch_size)
         mult = 1
@@ -241,6 +263,9 @@ class ServeWorker:
         # `infer`-job executor (ISSUE 18; injectable like synth_runner)
         self.infer_runner = (infer_runner if infer_runner is not None
                              else infer_job_runner)
+        # `search`-job executor (ISSUE 19; injectable like the others)
+        self.search_runner = (search_runner if search_runner is not None
+                              else search_job_runner)
         self.worker_id = worker_id or f"{os.uname().nodename}:{os.getpid()}"
         self.batcher = DynamicBatcher(batch_size=self.batch_size,
                                       max_wait_s=self.max_wait_s,
@@ -449,6 +474,14 @@ class ServeWorker:
                 # campaign — routed BEFORE the simulate check (its cfg
                 # carries both payloads), executed directly like one
                 self._execute_infer(job)
+                ran_synth += 1
+                continue
+            if job.cfg.get("search") is not None:
+                # `search` job kind (ISSUE 19): a matched-filter
+                # acceleration search — routed BEFORE the simulate
+                # check (its cfg carries both payloads), executed
+                # directly like one
+                self._execute_search(job)
                 ran_synth += 1
                 continue
             if job.cfg.get("synthetic") is not None:
@@ -846,6 +879,77 @@ class ServeWorker:
         self.stats["jobs_done"] += 1
         obs.inc("jobs_done")
         log_event(self.log, "infer_job_done", job=job.id,
+                  epochs=n_epochs, rows=stored,
+                  quarantined=n_epochs - stored)
+
+    def _execute_search(self, job) -> None:
+        """Run one `search` job (ISSUE 19): the acceleration search
+        executes as ONE fused correlation program against the resident
+        template bank and lands ``n_epochs`` idempotent candidate rows
+        keyed ``<job_id>.<index>`` (the simulate-job storage contract;
+        failures route through the same taxonomy)."""
+        from ..search import search_from_dict
+        from ..sim.campaign import spec_from_dict, synth_row_key
+
+        spec_dict = job.cfg.get("synthetic")
+        search_dict = job.cfg.get("search")
+        try:
+            n_epochs = int(spec_from_dict(spec_dict).n_epochs)
+            search_from_dict(search_dict)
+        except Exception as e:
+            # a torn/invalid payload is deterministic poison
+            state = self.queue.fail(job, f"bad search payload: {e!r}",
+                                    retryable=False)
+            if state == "failed":
+                self.stats["jobs_failed"] += 1
+                obs.inc("jobs_failed")
+            log_event(self.log, "job_poisoned", job=job.id,
+                      error=f"bad search payload: {e!r}")
+            return
+        obs.inc("search_jobs")
+        # bank build + correlation compile+run like a batch: keep the
+        # lease ahead
+        self.queue.renew([job], self._claim_lease_s())
+        self.stats["batches"] += 1
+        try:
+            with obs.span("serve.batch", jobs=1, search=True,
+                          epochs=n_epochs,
+                          trace_ids=[t for t in (job.trace_id,) if t]
+                          ) as bsp:
+                if obs.enabled():
+                    job = self.queue._hop(
+                        job, "job.batch", search=True,
+                        batch_span=getattr(bsp, "span_id", None))
+                # chaos site shared with file batches: an infra fault
+                # mid-campaign classifies transient
+                faults.check("worker.batch_execute")
+                rows = self.search_runner(spec_dict, search_dict,
+                                          job.cfg, self.mesh,
+                                          self.async_exec, self.bucket)
+        except Exception as e:
+            # _job_failed classifies: transient infra faults requeue
+            # budget-free, deterministic errors burn the bounded budget
+            self._job_failed(job, f"search campaign failed: {e!r}",
+                             exc=e)
+            log_event(self.log, "search_job_failed", job=job.id,
+                      error=repr(e))
+            return
+        stored = 0
+        for i, row in enumerate(rows):
+            if row is None:   # NaN lane: quarantined by the row builder
+                continue
+            self.queue.results.put_new_buffered(synth_row_key(job.id, i),
+                                                row)
+            stored += 1
+        self._flush_rows()
+        obs.inc("serve_synth_rows", stored)
+        job = self.queue._hop(job, "job.row", rows=stored)
+        self.queue.complete(job)
+        self._mark_warm(job)
+        self._job_latency(job)
+        self.stats["jobs_done"] += 1
+        obs.inc("jobs_done")
+        log_event(self.log, "search_job_done", job=job.id,
                   epochs=n_epochs, rows=stored,
                   quarantined=n_epochs - stored)
 

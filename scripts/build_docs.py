@@ -34,6 +34,7 @@ PAGES = [
     ("docs/serving.md", "serving", "Resident survey service"),
     ("docs/streaming.md", "streaming", "Streaming ingest (live feeds)"),
     ("docs/inference.md", "inference", "Differentiable inference"),
+    ("docs/search.md", "search", "Acceleration search"),
     ("docs/fleet.md", "fleet", "Fleet pool controller"),
     ("docs/reliability.md", "reliability", "Reliability & fault injection"),
     ("docs/observability.md", "observability", "Tracing & metrics"),

@@ -47,7 +47,11 @@ MARKER = "host-f64"
 # loss/optimiser/Fisher chain traces into ONE compiled program whose
 # gradients double every wide dtype's cost twice over (forward AND
 # backward pass)
-SUBTREES = ("infer", "ops", "parallel", "sim", "stream")
+#
+# search/ joined with the ISSUE 19 acceleration-search plane: the
+# correlation scores J templates x B epochs in one program — a wide
+# dtype in the bank or the MAC multiplies the dominant traffic term
+SUBTREES = ("infer", "ops", "parallel", "search", "sim", "stream")
 # single modules outside the subtree walk that still sit on hot paths
 # (the ISSUE 11 results plane streams every campaign row — a wide
 # dtype sneaking into its encode/decode would double the bytes of the

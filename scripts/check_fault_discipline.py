@@ -42,7 +42,12 @@ MARKER = "fault-ok"
 # infer/ joined with the ISSUE 18 differentiable inference plane: a
 # swallowed optimiser failure would publish half-fitted physics as if
 # converged — divergence must route to the quarantine/poison taxonomy
-SUBTREES = ("infer", "ops", "parallel", "serve", "stream")
+#
+# search/ joined with the ISSUE 19 acceleration-search plane: a
+# swallowed bank-build or scoring failure would publish empty or
+# half-scored candidate rows as if searched — failures must route to
+# the quarantine/poison taxonomy
+SUBTREES = ("infer", "ops", "parallel", "search", "serve", "stream")
 # single modules outside the subtree walk that are fault-critical too:
 # the ISSUE 11 results plane (utils/segments.py + utils/store.py) is
 # the durability layer under the serve queue — a silent swallow there

@@ -73,6 +73,22 @@ def test_infer_subtree_is_covered():
         assert hits == [], (path, hits)
 
 
+def test_search_subtree_is_covered():
+    """The ISSUE 19 acceleration-search plane correlates J templates x
+    B epochs in one compiled program — a wide dtype in the bank or the
+    multiply-accumulate multiplies the dominant traffic term; the lint
+    walk must include search/."""
+    assert "search" in check_f32_discipline.SUBTREES
+    pkg = os.path.join(REPO, "scintools_tpu")
+    for name in ("bank.py", "engine.py", "runner.py"):
+        path = os.path.join(pkg, "search", name)
+        assert os.path.exists(path), path
+        hits = check_f32_discipline.find_wide_literals(path)
+        assert not any(txt.startswith("TokenError")
+                       for _ln, txt in hits)
+        assert hits == [], (path, hits)
+
+
 def test_results_plane_modules_are_covered():
     """The ISSUE 11 storage modules stream every campaign row — a wide
     dtype sneaking into the encode/decode path would double the bytes

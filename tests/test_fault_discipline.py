@@ -60,6 +60,17 @@ def test_infer_subtree_is_covered():
         assert os.path.exists(os.path.join(pkg, "infer", name)), name
 
 
+def test_search_subtree_is_covered():
+    """The ISSUE 19 acceleration-search plane is pinned into the
+    lint's walk: a swallowed bank-build or scoring failure would
+    publish empty or half-scored candidate rows as if searched — a
+    rename out of search/ must not silently drop the discipline."""
+    assert "search" in check_fault_discipline.SUBTREES
+    pkg = os.path.join(os.path.dirname(_HERE), "scintools_tpu")
+    for name in ("bank.py", "engine.py", "runner.py"):
+        assert os.path.exists(os.path.join(pkg, "search", name)), name
+
+
 def _hits(tmp_path, src):
     mod = tmp_path / "mod.py"
     mod.write_text(textwrap.dedent(src))

@@ -1,0 +1,511 @@
+"""Fourier-domain acceleration-search engine (ISSUE 19): HBM-resident
+template banks, batched coarse-to-fine matched filtering, served as the
+`search` job kind.
+
+The headline contracts, counter-asserted rather than hypothesised:
+
+* the closed-loop gate: the pruned coarse-to-fine program recovers a
+  seeded arc campaign's injected curvature within 10% PER EPOCH and
+  picks the SAME winning trial as the exhaustive reference at the gate
+  (grid, bank);
+* the perf gate, MEASURED on this backend: the pruned program's XLA
+  cost analysis moves <= 40% of the naive program's bytes, its warm
+  wall-clock rate is >= 5x naive, and a runtime (K, decim) re-budget
+  executes with ``jit_cache_miss == 0``;
+* a served `search` job's CSV rows are byte-identical to a direct
+  ``process --search`` run (one shared row builder).
+"""
+
+import dataclasses
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from scintools_tpu import obs
+from scintools_tpu.search import (SearchSpec, bank_delay_rows,
+                                  bank_resident, build_bank,
+                                  search_campaign, search_from_dict,
+                                  search_grid, search_rows,
+                                  search_to_dict, trial_etas,
+                                  validate_search,
+                                  validate_search_config)
+from scintools_tpu.sim import SynthSpec
+from scintools_tpu.sim import campaign
+
+# documented closed-loop budget (docs/search.md): the trial grid is
+# geometric, so recovery precision is quantisation-limited — 10% per
+# epoch at the gate bank's J=128 spacing (measured margin ~3x)
+ETA_BUDGET = 0.10
+
+# the tier-1 closed-loop gate: a grid where the arc oracle's injected
+# curvature is cleanly measurable (same finding as the summary-fit and
+# infer gates: the 64x64 default scatters too much), with decim=8 —
+# the recall-solid coarse budget (docs/search.md)
+ARC_GATE = SynthSpec(kind="arc", n_epochs=6, nf=128, nt=128, dt=10.0,
+                     df=0.5, seed=11, arc_frac=0.8)
+ARC_SEARCH = SearchSpec(n_trials=128, top_k=16, decim=8)
+
+# the perf gate: a big bank on the acf kind (only traffic/rate ratios
+# are asserted, so the coarse budget can be pushed hard — decim=32
+# keeps 2 coarse bins of 33 at this grid)
+PERF_SPEC = SynthSpec(kind="acf", n_epochs=4, nf=64, nt=64, dt=10.0,
+                      df=0.5, seed=3)
+PERF_SEARCH = SearchSpec(n_trials=2048, top_k=16, decim=32)
+
+# cheap serve/CLI plumbing payloads: small grid, small bank
+SERVE_SPEC = {"kind": "arc", "nf": 64, "nt": 64, "n_epochs": 3,
+              "seed": 5, "arc_frac": 0.8}
+SERVE_SEARCH = {"n_trials": 64, "top_k": 4, "decim": 4}
+
+
+# ---------------------------------------------------------------------------
+# the bank: determinism, dtype discipline, residency
+# ---------------------------------------------------------------------------
+
+
+def test_bank_build_is_deterministic_f32_and_normalised():
+    srch = SearchSpec(n_trials=32)
+    e1, b1 = build_bank(64, 64, 10.0, 0.5, "pow2", srch)
+    e2, b2 = build_bank(64, 64, 10.0, 0.5, "pow2", srch)
+    # no RNG anywhere: bit-identical across builds, so bank identity
+    # can ride content keys and compile-cache keys
+    assert np.array_equal(e1, e2) and np.array_equal(b1, b2)
+    assert b1.dtype == np.float32
+    assert b1.shape[:2] == (32, bank_delay_rows(64, 64, "pow2", srch))
+    # matched-filter normalisation: zero mean, unit L2; the zeroed DC
+    # row carries no ridge structure (flat after the mean shift)
+    assert np.allclose(b1.mean(axis=(1, 2)), 0.0, atol=1e-6)
+    assert np.allclose(np.sqrt((b1 ** 2).sum(axis=(1, 2))), 1.0,
+                       atol=1e-4)
+    assert np.all(b1[:, 0, :] == b1[:, 0, :1])
+
+
+def test_bank_residency_shares_buffer_across_pruning_knobs():
+    # a geometry not used elsewhere in this file -> fresh build here
+    srch = SearchSpec(n_trials=24, top_k=8)
+    with obs.tracing() as reg:
+        etas, hat, L = bank_resident(64, 64, 10.0, 0.5, "pow2", srch)
+        g = dict(reg.gauges())
+    assert str(hat.dtype) == "complex64"
+    assert g.get("bank_bytes") == hat.nbytes
+    # re-budgeting top_k/decim must NOT fork the resident HBM buffer
+    rebud = dataclasses.replace(srch, top_k=4, decim=16)
+    etas2, hat2, L2 = bank_resident(64, 64, 10.0, 0.5, "pow2", rebud)
+    assert hat2 is hat and L2 == L and etas2 is etas
+
+
+def test_auto_trial_range_brackets_injected_truth():
+    nf, nt, dt, df = search_grid(ARC_GATE)
+    etas = trial_etas(nf, nt, dt, df, "pow2", ARC_SEARCH)
+    tru = campaign.injected_truth(ARC_GATE, lamsteps=False)["eta"]
+    # the 0/0 AUTO range derived from the grid must bracket the arc
+    # the grid's own oracle injects, with geometric spacing
+    assert etas[0] < tru < etas[-1]
+    ratios = etas[1:] / etas[:-1]
+    np.testing.assert_allclose(ratios, ratios[0], rtol=1e-9)
+
+
+def test_validate_search_rejects_bad_geometry():
+    with pytest.raises(ValueError, match="n_trials"):
+        validate_search(SearchSpec(n_trials=1))
+    with pytest.raises(ValueError, match="set both"):
+        validate_search(SearchSpec(eta_min=1.0))
+    with pytest.raises(ValueError, match="eta_max must exceed"):
+        validate_search(SearchSpec(eta_min=2.0, eta_max=1.0))
+    with pytest.raises(ValueError, match="width"):
+        validate_search(SearchSpec(width=0.0))
+    with pytest.raises(ValueError, match="top_k"):
+        validate_search(SearchSpec(n_trials=8, top_k=9))
+    with pytest.raises(ValueError, match="exceeds the spectrum"):
+        bank_delay_rows(64, 64, "pow2", SearchSpec(delay_rows=1000))
+    with pytest.raises(ValueError, match="min_row"):
+        bank_delay_rows(64, 64, "pow2",
+                        SearchSpec(delay_rows=4, min_row=4))
+
+
+# ---------------------------------------------------------------------------
+# spec round-trip + validation
+# ---------------------------------------------------------------------------
+
+
+def test_search_spec_roundtrip_is_sparse():
+    assert search_to_dict(SearchSpec()) == {}
+    d = {"n_trials": 512, "decim": 16}
+    assert search_to_dict(search_from_dict(d)) == d
+    with pytest.raises(ValueError, match="unknown SearchSpec"):
+        search_from_dict({"bogus": 1})
+    with pytest.raises(ValueError, match="n_trials"):
+        search_from_dict({"n_trials": 1})
+
+
+def test_validate_search_config_rules():
+    from scintools_tpu.serve.worker import config_from_opts
+
+    spec = campaign.spec_from_dict(SERVE_SPEC)
+    srch = search_from_dict(SERVE_SEARCH)
+    # frequency-grid only: lambda-resampled banks are roadmap work
+    with pytest.raises(ValueError, match="lambda-resampled"):
+        validate_search_config(spec, srch,
+                               config_from_opts({"lamsteps": True}))
+    # the coarse-bin floor raises at submit, not inside the trace
+    with pytest.raises(ValueError, match="coarse Fourier bins"):
+        validate_search_config(spec, SearchSpec(decim=4096),
+                               config_from_opts({}))
+    validate_search_config(spec, srch, config_from_opts({}))
+
+
+# ---------------------------------------------------------------------------
+# the closed-loop acceptance gate (tier-1)
+# ---------------------------------------------------------------------------
+
+
+def test_closed_loop_arc_curvature_recovery():
+    """The pruned coarse-to-fine program recovers the arc oracle's
+    injected curvature within the quantisation budget PER EPOCH, and
+    at the gate (grid, bank) picks the SAME winning trial as the
+    exhaustive full-resolution reference — pruning loses nothing."""
+    tru = campaign.injected_truth(ARC_GATE, lamsteps=False)["eta"]
+    with obs.tracing() as reg:
+        out = search_campaign(ARC_GATE, ARC_SEARCH)
+        c = reg.counters()
+    B, J, K = ARC_GATE.n_epochs, ARC_SEARCH.n_trials, ARC_SEARCH.top_k
+    assert c["search_epochs"] == B
+    assert c["templates_scored"] == B * (J + K)
+    assert c["prune_survivors"] == B * K
+    rel = np.abs(out["eta"] - tru) / tru
+    assert np.all(rel < ETA_BUDGET), (out["eta"], tru)
+    assert np.all(out["etaerr"] > 0)
+    assert np.all(np.isfinite(out["snr"]))
+    naive = search_campaign(ARC_GATE, ARC_SEARCH, naive=True)
+    assert np.array_equal(out["trial"], naive["trial"])
+    nrel = np.abs(naive["eta"] - tru) / tru
+    assert np.all(nrel < ETA_BUDGET), (naive["eta"], tru)
+
+
+def test_runtime_rebudget_never_recompiles():
+    """The envelope contract: after a first campaign compiles the
+    program, a rerun with a DIFFERENT epoch count (same bucket rung),
+    different seed and runtime (top_k_rt, decim_rt) knobs executes
+    with zero jit-cache misses."""
+    with obs.tracing() as reg:
+        search_campaign(ARC_GATE, ARC_SEARCH)
+        base = reg.counters().get("jit_cache_miss", 0)
+        warm = dataclasses.replace(ARC_GATE, n_epochs=5, seed=7)
+        out = search_campaign(warm, ARC_SEARCH, top_k_rt=4,
+                              decim_rt=16)
+        assert reg.counters().get("jit_cache_miss", 0) == base
+    assert len(out["eta"]) == 5
+    assert out["survivors"] == 4
+
+
+def test_runtime_knob_validation():
+    with pytest.raises(ValueError, match="top_k_rt"):
+        search_campaign(ARC_GATE, ARC_SEARCH,
+                        top_k_rt=ARC_SEARCH.top_k + 1)
+    with pytest.raises(ValueError, match="decim_rt"):
+        search_campaign(ARC_GATE, ARC_SEARCH,
+                        decim_rt=ARC_SEARCH.decim - 1)
+    with pytest.raises(ValueError, match="coarse Fourier bins"):
+        search_campaign(ARC_GATE, ARC_SEARCH, decim_rt=4096)
+
+
+# ---------------------------------------------------------------------------
+# the perf gate (tier-1, measured on this backend)
+# ---------------------------------------------------------------------------
+
+
+def test_pruned_vs_naive_measured_bytes_and_rate():
+    """The optimisation claim, measured rather than hypothesised: at a
+    big bank the pruned program's cost analysis moves <= 40% of the
+    exhaustive program's bytes, and its warm wall-clock rate is >= 5x
+    (measured margins ~29% and ~18x on CPU CI)."""
+    with obs.tracing() as reg:
+        search_campaign(PERF_SPEC, PERF_SEARCH)
+        search_campaign(PERF_SPEC, PERF_SEARCH, naive=True)
+        g = dict(reg.gauges())
+    pb = [v for k, v in g.items()
+          if k.startswith("step_bytes[search.step")]
+    nb = [v for k, v in g.items()
+          if k.startswith("step_bytes[search.naive")]
+    assert pb and nb, sorted(g)
+    assert pb[0] <= 0.40 * nb[0], (pb[0], nb[0])
+
+    def median_wall(naive):
+        walls = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            search_campaign(PERF_SPEC, PERF_SEARCH, naive=naive)
+            walls.append(time.perf_counter() - t0)
+        return float(np.median(walls))
+
+    pruned_s, naive_s = median_wall(False), median_wall(True)
+    assert naive_s >= 5.0 * pruned_s, (pruned_s, naive_s)
+
+
+# ---------------------------------------------------------------------------
+# serve: the `search` job kind
+# ---------------------------------------------------------------------------
+
+
+def test_search_job_identity_is_distinct_and_canonical():
+    from scintools_tpu.serve import cfg_signature
+
+    sig_synth = cfg_signature({"synthetic": SERVE_SPEC})
+    sig_infer = cfg_signature({"synthetic": SERVE_SPEC, "infer": {}})
+    sig_search = cfg_signature({"synthetic": SERVE_SPEC, "search": {}})
+    assert len({sig_synth, sig_infer, sig_search}) == 3
+    # dict ordering / JSON round-trips must not fork the identity
+    reordered = json.loads(json.dumps(
+        {"search": dict(reversed(list(SERVE_SEARCH.items()))),
+         "synthetic": dict(reversed(list(SERVE_SPEC.items())))}))
+    assert cfg_signature(reordered) == cfg_signature(
+        {"synthetic": SERVE_SPEC, "search": SERVE_SEARCH})
+
+
+def test_submit_search_validates_and_dedups(tmp_path):
+    from scintools_tpu.serve import JobQueue
+    from scintools_tpu.serve.queue import validate_job_cfg
+
+    q = JobQueue(str(tmp_path / "q"))
+    jid, status = q.submit_search(SERVE_SPEC, SERVE_SEARCH)
+    assert status == "submitted"
+    # idempotent: sparse vs canonicalised payloads dedup
+    jid2, status2 = q.submit_search(
+        campaign.spec_to_dict(campaign.spec_from_dict(SERVE_SPEC)),
+        search_to_dict(search_from_dict(SERVE_SEARCH)))
+    assert (jid2, status2) == (jid, "queued")
+    # never aliases the simulate or infer jobs of the same campaign
+    sid, _ = q.submit_synthetic(SERVE_SPEC)
+    iid, _ = q.submit_infer(SERVE_SPEC, None,
+                            cfg={"lamsteps": True})
+    assert len({jid, sid, iid}) == 3
+    with pytest.raises(ValueError, match="unknown SearchSpec"):
+        q.submit_search(SERVE_SPEC, {"bogus": 1})
+    with pytest.raises(ValueError, match="lambda-resampled"):
+        q.submit_search(SERVE_SPEC, SERVE_SEARCH,
+                        cfg={"lamsteps": True})
+    # a job is one engine; search rides a synthetic campaign payload
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        validate_job_cfg({"synthetic": SERVE_SPEC,
+                          "search": SERVE_SEARCH, "infer": {}})
+    with pytest.raises(ValueError, match="required beside"):
+        validate_job_cfg({"search": SERVE_SEARCH})
+
+
+def test_served_search_rows_byte_identical_to_direct(tmp_path):
+    """The acceptance criterion: a served `search` job's exported CSV
+    is byte-identical to a direct search_rows export of the same
+    (campaign, bank) — one shared row builder, epoch-ordered store
+    keys, one deterministic compiled program + deterministic bank."""
+    from scintools_tpu.serve import JobQueue, ServeWorker
+    from scintools_tpu.utils.store import ResultsStore
+
+    q = JobQueue(str(tmp_path / "q"))
+    jid, _ = q.submit_search(SERVE_SPEC, SERVE_SEARCH)
+    worker = ServeWorker(q, batch_size=4, max_wait_s=0.01)
+    stats = worker.run(max_batches=1)
+    assert stats["jobs_done"] == 1 and stats["jobs_failed"] == 0
+    assert sorted(q.results.keys()) == [
+        campaign.synth_row_key(jid, i) for i in range(3)]
+    served_csv = str(tmp_path / "served.csv")
+    assert q.results.export_csv(served_csv) == 3
+
+    rows = search_rows(SERVE_SPEC, SERVE_SEARCH)
+    store = ResultsStore(str(tmp_path / "direct"))
+    for i, row in enumerate(rows):
+        assert row is not None
+        assert row["search_survivors"] == SERVE_SEARCH["top_k"]
+        store.put(campaign.synth_row_key("direct", i), row)
+    direct_csv = str(tmp_path / "direct.csv")
+    store.export_csv(direct_csv)
+    with open(served_csv, "rb") as a, open(direct_csv, "rb") as b:
+        assert a.read() == b.read()
+    # resubmit after completion reports done without re-queueing
+    jid3, status3 = q.submit_search(SERVE_SPEC, SERVE_SEARCH)
+    assert (jid3, status3) == (jid, "done")
+
+
+def test_worker_routes_search_jobs_with_knobs(tmp_path):
+    """The claim loop routes search jobs to the injectable runner with
+    the worker's own placement knobs — the warmed --bucket worker
+    contract from the simulate/infer routes."""
+    from scintools_tpu.serve import JobQueue, ServeWorker
+
+    q = JobQueue(str(tmp_path / "q"))
+    q.submit_search(SERVE_SPEC, SERVE_SEARCH)
+    seen = {}
+
+    def spy_runner(spec_dict, search_dict, opts, mesh, async_exec,
+                   bucket):
+        seen.update(spec=spec_dict, search=search_dict, bucket=bucket)
+        return [None] * spec_dict["n_epochs"]
+
+    worker = ServeWorker(q, batch_size=4, bucket=True,
+                         search_runner=spy_runner)
+    worker.poll_once(force_flush=True)
+    assert seen["bucket"] is True
+    assert seen["spec"]["kind"] == "arc"
+    assert seen["search"] == SERVE_SEARCH
+
+
+def test_worker_rejects_torn_search_payload(tmp_path):
+    """A corrupted job record (either payload unparseable) is
+    deterministic poison: straight to failed/, no retry burn."""
+    from scintools_tpu.serve import JobQueue, ServeWorker
+    from scintools_tpu.serve.queue import Job
+
+    q = JobQueue(str(tmp_path / "q"))
+    job = Job(id="torn", file="search:arc",
+              cfg={"synthetic": dict(SERVE_SPEC),
+                   "search": {"n_trials": "NaN?"}},
+              submitted_at=0.0)
+    q._write("leased", job)
+    worker = ServeWorker(q, batch_size=4)
+    worker._execute_search(job)
+    assert q.state_of("torn") == "failed"
+
+
+def test_search_job_failure_routes_through_taxonomy(tmp_path):
+    """A transient infra fault mid-campaign requeues budget-free (same
+    taxonomy as batches and simulate/infer jobs)."""
+    from scintools_tpu.serve import JobQueue, ServeWorker
+
+    q = JobQueue(str(tmp_path / "q"))
+    jid, _ = q.submit_search(SERVE_SPEC, SERVE_SEARCH)
+
+    def flaky_runner(spec_dict, search_dict, opts, mesh, async_exec,
+                     bucket):
+        raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+
+    worker = ServeWorker(q, batch_size=4, max_wait_s=0.01,
+                         search_runner=flaky_runner)
+    worker.poll_once(force_flush=True)
+    assert worker.stats["job_transient_retries"] == 1
+    job = q.get(jid)
+    assert job.transients == 1 and job.attempts == 0
+
+
+# ---------------------------------------------------------------------------
+# CLI: process --search (resume keys) / submit --search / warmup
+# ---------------------------------------------------------------------------
+
+
+def _run_cli(argv):
+    from scintools_tpu.cli import main
+
+    return main(argv)
+
+
+_CLI_ARGS = ["--synthetic", "3", "--synth-kind", "acf", "--synth-nf",
+             "64", "--synth-nt", "64", "--search", "--search-trials",
+             "64", "--search-top-k", "4", "--search-decim", "4"]
+
+
+def test_cli_process_search_and_resume(tmp_path, capsys):
+    csv = str(tmp_path / "out.csv")
+    store = str(tmp_path / "runs")
+    argv = ["process", "--batched"] + _CLI_ARGS + ["--results", csv,
+                                                   "--store", store]
+    assert _run_cli(argv) == 0
+    with open(csv) as fh:
+        lines = fh.read().splitlines()
+    assert len(lines) == 4  # header + 3 epochs, epoch-ordered
+    # eta/etaerr ride the standard CSV columns (search_* diagnostics
+    # are store-only)
+    assert lines[0].endswith("eta,etaerr")
+    assert lines[1].startswith("synth-acf-s0-00000,")
+    assert lines[3].startswith("synth-acf-s0-00002,")
+    # resume: every epoch done -> the correlation is skipped outright
+    import scintools_tpu.search as search_pkg
+
+    ran = {"n": 0}
+    orig = search_pkg.search_rows
+
+    def counting(*a, **kw):
+        ran["n"] += 1
+        return orig(*a, **kw)
+
+    search_pkg.search_rows = counting
+    try:
+        assert _run_cli(argv) == 0
+    finally:
+        search_pkg.search_rows = orig
+    assert ran["n"] == 0
+    capsys.readouterr()
+
+
+def test_cli_search_flag_validation(tmp_path):
+    with pytest.raises(SystemExit, match="add --search"):
+        _run_cli(["process", "--batched", "--synthetic", "2",
+                  "--search-trials", "64"])
+    with pytest.raises(SystemExit, match="--synthetic N"):
+        _run_cli(["process", "--batched", "--search"])
+    with pytest.raises(SystemExit, match="lambda-resampled"):
+        _run_cli(["process", "--batched", "--synthetic", "2",
+                  "--synth-kind", "acf", "--lamsteps", "--search"])
+    with pytest.raises(SystemExit, match="mutually exclusive"):
+        _run_cli(["process", "--batched", "--synthetic", "2",
+                  "--synth-kind", "acf", "--infer", "--search"])
+    with pytest.raises(SystemExit, match="n_trials"):
+        _run_cli(["process", "--batched", "--synthetic", "2",
+                  "--synth-kind", "acf", "--search",
+                  "--search-trials", "1"])
+    with pytest.raises(SystemExit, match="one bucketed batch"):
+        _run_cli(["process", "--batched"] + _CLI_ARGS +
+                 ["--chunk-epochs", "2"])
+
+
+def test_cli_submit_search(tmp_path, capsys):
+    qdir = str(tmp_path / "q")
+    argv = ["submit", qdir] + _CLI_ARGS
+    rc = _run_cli(argv)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and out["submitted"] == 1
+    assert out["jobs"][0]["file"] == "search:acf"
+    # dedup on resubmit
+    rc = _run_cli(argv)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["deduped"] == 1 and out["submitted"] == 0
+
+
+def test_cli_warmup_search(tmp_path, capsys, monkeypatch):
+    monkeypatch.setenv("SCINT_COMPILE_CACHE", str(tmp_path / "cache"))
+    rc = _run_cli(["warmup"] + _CLI_ARGS)
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0
+    sigs = out["signatures"]
+    assert [s["rung"] for s in sigs] == [4]  # rung_for(3) on the ladder
+    assert all(s["status"] == "compiled" and s["key"] for s in sigs)
+    with pytest.raises(SystemExit, match="no template files"):
+        _run_cli(["warmup", "some.dynspec"] + _CLI_ARGS)
+
+
+# ---------------------------------------------------------------------------
+# bench: the search lane
+# ---------------------------------------------------------------------------
+
+
+def test_bench_search_lane_record(monkeypatch, tmp_path):
+    import importlib.util
+
+    monkeypatch.setenv("SCINT_BENCH_MIN_MEASURE_S", "0")
+    monkeypatch.setenv("SCINT_BENCH_MAX_REPEATS", "1")
+    monkeypatch.setenv("SCINT_COMPILE_CACHE", "off")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_search_test", os.path.join(repo, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    with obs.tracing():
+        rec = bench.search_throughput(64, 64, 2, trials=64, repeats=1)
+    assert rec["search"] is True
+    assert rec["templates_epochs_per_s"] > 0
+    assert rec["shape"] == [2, 64, 64] and rec["trials"] == 64
+    assert rec["bank_bytes"] and rec["step_bytes"]
+    # the A/B sub-record landed as ratios, not as an error
+    ab = rec["pruned_vs_naive"]
+    assert "error" not in ab, ab
+    assert ab["rate"] > 0 and 0 < ab["bytes"] < 1

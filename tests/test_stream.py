@@ -708,8 +708,14 @@ def test_sigkill_streaming_worker_resumes_from_manifest(tmp_path,
     # compiling tick outlived the deliberately tiny test lease) —
     # never terminal
     assert q.state_of(jid) in ("leased", "queued")
-    ticks_before = q.results.get_meta(f"stream.{jid}")["tick_seq"]
-    assert ticks_before >= 1
+    # the durable cursor trails the row publish by design (rows first,
+    # then meta — "replay covers a lost cursor"), so a SIGKILL landing
+    # in that gap leaves `.live` durable with no cursor yet; worker B
+    # then replays the feed from scratch, which the row assertions
+    # below verify either way
+    cursor = q.results.get_meta(f"stream.{jid}")
+    if cursor is not None:
+        assert cursor["tick_seq"] >= 1
     # the rest of the observation lands while no worker is alive
     while i < total:
         writer.append(dyn[:, i:i + HOP])
