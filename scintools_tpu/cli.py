@@ -1950,6 +1950,23 @@ def cmd_alerts(args) -> int:
     return 0
 
 
+def cmd_fsck(args) -> int:
+    """Crash-consistency audit of a serve queue directory
+    (serve/fsck.py, invariant catalog in docs/reliability.md):
+    dry-run by default, ``--repair`` applies only the planes' own
+    proven recovery actions.  Exit 0 when clean (every invariant
+    holds, or every finding repaired), 1 otherwise."""
+    from .serve import fsck as fsck_mod
+
+    qdir = _existing_queue_dir(args.queue)
+    report = fsck_mod.run_fsck(qdir, repair=args.repair)
+    if args.json:
+        print(json.dumps(report, default=str))
+    else:
+        print(fsck_mod.render_report(report))
+    return 0 if report["clean"] else 1
+
+
 def cmd_bench(args) -> int:
     # bench.py lives at the repo root (the driver contract), not in the
     # installed package: load it by path relative to this package, falling
@@ -2689,6 +2706,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="machine-readable rollup (the admission-"
                         "control input) instead of the table")
     r.set_defaults(fn=cmd_fleet_status)
+
+    q = sub.add_parser(
+        "fsck",
+        help="audit a serve queue directory's on-disk invariants "
+             "(orphaned tmp/open files, torn segments, misplaced or "
+             "terminal-twin queued records, expired leases, stale "
+             "drain markers, stream cursor skew — docs/reliability.md)")
+    q.add_argument("queue", help="serve queue dir to audit")
+    q.add_argument("--repair", action="store_true",
+                   help="apply the proven recovery actions (the same "
+                        "code paths crash recovery runs); default is "
+                        "a report-only dry run")
+    q.add_argument("--json", action="store_true",
+                   help="machine-readable report instead of the table")
+    q.set_defaults(fn=cmd_fsck)
 
     q = sub.add_parser(
         "alerts",

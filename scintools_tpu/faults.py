@@ -50,6 +50,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import errno
 import os
 import threading
 
@@ -82,6 +83,26 @@ class InjectedFault(TransientError):
 class InjectedPoison(PoisonError):
     """An armed ``kind="poison"`` fault firing (for proving the poison
     path stays bounded under classification)."""
+
+
+class InjectedCrash(BaseException):
+    """An armed storage-crash kind firing (``torn_write`` /
+    ``crash_before_rename`` / ``crash_after_rename``).  Deliberately a
+    ``BaseException``: this is not an error to classify but a crash
+    directive — the fsio driver verbs catch it at the injection site,
+    perform the spec'd partial work, and hard-exit (``os._exit``), so
+    the process dies exactly as a SIGKILL at that boundary would.  An
+    escaped one (armed at a non-fsio site) kills the process, which is
+    the honest semantics for a crash kind."""
+
+    #: faults kind -> the driver's crash choreography
+    CRASH = {"torn_write": "torn", "crash_before_rename": "before",
+             "crash_after_rename": "after"}
+
+    def __init__(self, kind: str, detail: str):
+        super().__init__(detail)
+        self.kind = kind
+        self.crash = self.CRASH[kind]
 
 
 # substrings of XLA runtime OOM surfaces (jaxlib raises XlaRuntimeError
@@ -127,6 +148,12 @@ def classify_error(exc: BaseException) -> str:
         return "poison"
     if isinstance(exc, (ValueError, TypeError)):
         return "poison"
+    if isinstance(exc, OSError) and exc.errno in (errno.ENOSPC,
+                                                  errno.EDQUOT):
+        # a full disk/quota recovers after compaction or space
+        # recovery — burning the bounded retry budget would poison a
+        # perfectly good job for an infrastructure condition
+        return "transient"
     if is_oom_error(exc):
         return "transient"
     if any(m in str(exc) for m in _TRANSIENT_MARKERS):
@@ -149,8 +176,15 @@ class FaultSpec:
     RESOURCE_EXHAUSTED), ``"transient"`` (:class:`InjectedFault`),
     ``"poison"`` (:class:`InjectedPoison`), ``"oserror"`` (an
     :class:`OSError`, for rename/IO race sites whose handlers catch
-    exactly that), or ``"error"`` (a plain :class:`RuntimeError` —
-    lands in the *unknown* classification bucket).
+    exactly that), ``"error"`` (a plain :class:`RuntimeError` —
+    lands in the *unknown* classification bucket), the errno storage
+    kinds ``"enospc"``/``"eio"`` (an :class:`OSError` carrying that
+    errno, so the caller's existing narrow handlers AND
+    :func:`classify_error` see the real thing), or the storage CRASH
+    kinds ``"torn_write"``/``"crash_before_rename"``/
+    ``"crash_after_rename"`` (an :class:`InjectedCrash` directive the
+    fsio driver verbs translate into partial work + ``os._exit`` —
+    arm these only at ``fsio.*`` sites).
 
     ``at_call`` is 1-based: the fault fires on that invocation of its
     site and for ``times`` consecutive calls after it, then disarms.
@@ -161,7 +195,9 @@ class FaultSpec:
     exercises the wrong recovery path.
     """
 
-    KINDS = ("oom", "transient", "poison", "oserror", "error")
+    KINDS = ("oom", "transient", "poison", "oserror", "error",
+             "torn_write", "crash_before_rename", "crash_after_rename",
+             "enospc", "eio")
 
     kind: str = "transient"
     at_call: int = 1
@@ -188,6 +224,12 @@ class FaultSpec:
             return InjectedPoison(detail)
         if self.kind == "oserror":
             return OSError(detail)
+        if self.kind == "enospc":
+            return OSError(errno.ENOSPC, detail)
+        if self.kind == "eio":
+            return OSError(errno.EIO, detail)
+        if self.kind in InjectedCrash.CRASH:
+            return InjectedCrash(self.kind, detail)
         if self.kind == "error":
             return RuntimeError(detail)
         return InjectedFault(detail)
@@ -209,7 +251,14 @@ KNOWN_SITES = ("driver.chunk_execute", "driver.admit_chunk",
                # blocks StreamSession.poll's consumption loop (lag
                # still sampled): the injected freshness breach the
                # SLO smoke gate drives (ISSUE 16)
-               "stream.poll")
+               "stream.poll",
+               # the storage driver verbs (ISSUE 20): one site per
+               # verb, armed with the errno kinds (enospc/eio) or the
+               # crash kinds (torn_write/crash_before_rename/
+               # crash_after_rename) to enumerate recovery from every
+               # durable-plane mutation boundary
+               "fsio.put", "fsio.append", "fsio.read", "fsio.list",
+               "fsio.delete", "fsio.rename")
 
 # site -> FaultSpec.  EMPTY in production: check()'s disarmed cost is
 # the one dict lookup the acceptance criteria demand.  Armed only by

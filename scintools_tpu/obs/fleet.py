@@ -41,6 +41,7 @@ import time
 import uuid
 
 from . import core, devmem
+from ..utils import fsio
 from .hist import Hist, merge_hist_dicts
 
 HEARTBEAT_DIRNAME = "heartbeat"
@@ -258,10 +259,7 @@ class HeartbeatWriter:
         if extra:
             hb.update(extra)
         os.makedirs(self.dir, exist_ok=True)
-        tmp = f"{self.path}.tmp{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as fh:
-            json.dump(hb, fh, default=str)
-        os.replace(tmp, self.path)
+        fsio.put_atomic(self.path, json.dumps(hb, default=str))
         self._last_beat = now
         self._last_counters = counters
         return self.path
@@ -273,16 +271,14 @@ def read_heartbeats(directory: str) -> list[dict]:
     never raise, while workers are writing concurrently."""
     out = []
     try:
-        names = sorted(os.listdir(directory))
+        names = sorted(fsio.list(directory))
     except OSError:
         return out
     for name in names:
         if not name.endswith(".json") or ".tmp" in name:
             continue
         try:
-            with open(os.path.join(directory, name),
-                      encoding="utf-8") as fh:
-                hb = json.load(fh)
+            hb = json.loads(fsio.read(os.path.join(directory, name)))
         except (OSError, ValueError):
             continue
         if isinstance(hb, dict) and hb.get("kind") == "heartbeat":
@@ -424,6 +420,15 @@ def queue_extras(directory: str) -> dict:
         pool = read_pool_status(directory)
         if pool is not None:
             out["pool"] = pool
+    except OSError:  # fault-ok: snapshot is advisory
+        pass
+    # last crash-consistency audit snapshot (serve/fsck — ISSUE 20)
+    try:
+        from ..serve.fsck import read_fsck_status
+
+        fsck = read_fsck_status(directory)
+        if fsck is not None:
+            out["fsck"] = fsck
     except OSError:  # fault-ok: snapshot is advisory
         pass
     # declared SLO registry + durable alert rows (obs/slo.py — ISSUE
@@ -716,6 +721,18 @@ def render_fleet(rollup: dict) -> str:
             f"{ps.get('stale_replaced', 0)}"
             + (f", last = {pool['last_decision']}"
                if pool.get("last_decision") else ""))
+    fsck = rollup.get("fsck")
+    if fsck:
+        cls = fsck.get("classes") or {}
+        detail = (" [" + " ".join(f"{k}={v}"
+                                  for k, v in sorted(cls.items()))
+                  + "]" if cls else "")
+        lines.append(
+            f"  fsck (last audit, "
+            f"{'repair' if fsck.get('repair') else 'dry-run'}): "
+            + ("clean" if fsck.get("clean") else "NOT CLEAN")
+            + f", {fsck.get('findings', 0)} finding(s)"
+            + f", {fsck.get('repaired', 0)} repaired" + detail)
     slo_rows = rollup.get("slo_status")
     if slo_rows:
         lines.append("  slo (error budgets over merged heartbeats):")

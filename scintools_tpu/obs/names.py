@@ -102,6 +102,13 @@ COUNTERS = frozenset({
     # cleared the non-finite quarantine
     "search_jobs", "search_epochs", "templates_scored",
     "prune_survivors", "candidates_emitted",
+    # crash-consistency plane (utils/fsio.py + serve/fsck.py — ISSUE
+    # 20): fsio_write_errors = degraded best-effort plane writes
+    # (heartbeat/hints/pool status) that used to be log-line-only;
+    # fsck_runs/findings/repairs = audit executions, invariant
+    # violations found, repairs applied (per-class breakdown rides
+    # the bracketed families)
+    "fsio_write_errors", "fsck_runs", "fsck_findings", "fsck_repairs",
 })
 
 # -- gauges (obs.gauge) -----------------------------------------------------
@@ -224,6 +231,11 @@ FAMILIES = frozenset({
     "job_latency_s",                                # hist (per lane)
     "slo_burn_fast", "slo_burn_slow",               # gauges (per SLO)
     "slo_budget_remaining",                         # gauge (per SLO)
+    # crash-consistency plane (ISSUE 20): which best-effort plane's
+    # write degraded (heartbeat/hints/pool), and the per-invariant-
+    # class finding/repair breakdown beside the fsck totals
+    "fsio_write_errors",                            # counter (per plane)
+    "fsck_findings", "fsck_repairs",                # counters (per class)
 })
 
 _SETS = {"inc": COUNTERS, "gauge": GAUGES, "span": SPANS,

@@ -1,0 +1,575 @@
+"""Crash-consistency audit + repair of a serve queue directory.
+
+``scintools-tpu fsck QDIR`` walks every durable plane a queue
+directory holds — the queued/leased/done/failed job records, the
+results row + segment planes, the control markers, worker heartbeats,
+and the feeds of live `stream` registrations — and checks the
+invariant catalog below (normative prose in docs/reliability.md).
+Dry-run by default: findings are REPORTED, nothing is touched.
+``--repair`` applies only recovery actions the planes already ship
+(the same code paths crash recovery runs), so a repair can never
+invent state the system would not have reconverged to on its own;
+a second dry-run after ``--repair`` reports clean.
+
+Invariant catalog (class -> violated invariant -> repair):
+
+``orphan_tmp``
+    A ``*.tmp<pid>`` atomic-write staging file whose writer pid is
+    dead: ``fsio.put_atomic`` crashed between tmp write and rename.
+    The target path never saw a torn byte — the tmp is garbage.
+    Repair: delete.
+``orphan_open``
+    An ``*.open`` segment whose writer is gone (dead pid past the
+    salvage age, or live pid past the flush grace): a SIGKILL between
+    block appends and ``seal``.  Repair: the store's own salvage —
+    recover the checksum-valid block prefix into a fresh sealed
+    segment, quarantine the original as ``.corrupt``.
+``torn_segment``
+    A sealed ``*.seg`` whose footer fails :func:`segments.read_footer`
+    (truncated tail, checksum mismatch).  Repair: same salvage path.
+``corrupt_record``
+    An unparseable job-state JSON record.  Records are written
+    atomically, so this is real corruption, not a mid-write race.
+    Repair: quarantine aside as ``.corrupt`` (the row store's rule).
+``queued_terminal_twin``
+    A queued record for a job already in ``done/``/``failed/`` (the
+    racing-submitter crash window).  Repair: remove — ``claim``'s own
+    terminal-survivor GC, run eagerly.
+``queued_misplaced``
+    A queued record at a path the O(1) removal probes can never hit:
+    wrong shard dir for its id, a filename stamp disagreeing with the
+    record's ``submitted_at``, or a filename id disagreeing with the
+    record.  (Legacy flat/laneless layouts are VALID — still drained —
+    not findings.)  Repair: rewrite at the canonical lane-sharded path
+    (``JobQueue._write``) and remove the misplaced file.
+``expired_lease``
+    A leased record whose lease has run out (SIGKILLed worker).
+    Repair: ``JobQueue.reap_expired`` — requeue with attempts+1 and
+    backoff, or poison once retries are exhausted.
+``stale_drain``
+    A per-worker ``control/drain.<worker>`` marker for a worker with
+    no live heartbeat, older than the consume grace: the pool asked a
+    worker to scale down and the worker died first.  Repair:
+    ``JobQueue.clear_worker_drain``.
+``stream_cursor_ahead``
+    A live stream registration's durable cursor claims more consumed
+    samples than the feed manifest has committed (manifest rolled
+    back).  Repair: reset the cursor to the empty state — exactly the
+    from-scratch replay ``window.restore`` falls back to when it
+    meets this cursor (versioned rows make the replay idempotent).
+``feed_orphan_chunk``
+    A live stream job's feed holds chunk files the manifest never
+    committed (producer crashed between chunk rename and manifest
+    rewrite).  Repair: reopen the feed writer — ``FeedWriter._recover``
+    adopts whole orphans in seq order and quarantines torn ones.
+``versioned_series_gap``
+    ADVISORY (never blocks a clean report, no repair): a live stream's
+    window-end row series has holes relative to its own hop spacing.
+    The versioned replay heals gaps when the stream re-runs; fsck only
+    surfaces them.
+
+Every run writes a trimmed snapshot to ``control/fsck.json``
+(rendered by ``fleet status``) and counts ``fsck_runs`` /
+``fsck_findings[<class>]`` / ``fsck_repairs[<class>]``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+
+from .. import obs
+from ..utils import fsio
+from ..utils.log import get_logger, log_event
+from ..utils.segments import (OPEN_EXT, OPEN_GRACE_S,
+                              OPEN_SALVAGE_MIN_AGE_S, SEG_EXT,
+                              SegmentError, _pid_alive, read_footer,
+                              segment_pid)
+from .queue import DONE, FAILED, LEASED, QUEUED, Job, JobQueue
+
+FSCK_BASENAME = "fsck.json"
+
+# a dead-pid *.tmp younger than this may belong to a REMOTE writer
+# (pid liveness doesn't cross hosts) whose rename lands momentarily —
+# same reasoning as the segment plane's OPEN_SALVAGE_MIN_AGE_S
+TMP_GRACE_S = 5.0
+# a drain marker younger than this may target a worker that simply
+# hasn't beaten yet (scale-down races its own heartbeat)
+STALE_DRAIN_GRACE_S = 60.0
+
+_TMP_RE = re.compile(r"\.tmp(\d+)$")
+_CLS_ORDER = ("orphan_tmp", "orphan_open", "torn_segment",
+              "corrupt_record", "queued_terminal_twin",
+              "queued_misplaced", "expired_lease", "stale_drain",
+              "stream_cursor_ahead", "feed_orphan_chunk")
+
+
+def _snapshot_path(qdir: str) -> str:
+    return os.path.join(qdir, "control", FSCK_BASENAME)
+
+
+def read_fsck_status(qdir: str) -> dict | None:
+    """The last audit's ``control/fsck.json`` snapshot (the ``fleet
+    status`` readout), or None."""
+    try:
+        snap = json.loads(fsio.read(_snapshot_path(qdir)))
+    except (OSError, ValueError):  # fault-ok: advisory snapshot
+        return None
+    return snap if isinstance(snap, dict) \
+        and snap.get("kind") == "fsck" else None
+
+
+class _Audit:
+    """One fsck pass over ``qdir`` (:func:`run_fsck` drives it)."""
+
+    def __init__(self, qdir: str, repair: bool, now: float):
+        self.qdir = qdir
+        self.repair = repair
+        self.now = now
+        self.q = JobQueue(qdir)
+        self.log = get_logger()
+        self.findings: list[dict] = []
+        self.advisories: list[dict] = []
+
+    def _find(self, cls: str, path: str, detail: str,
+              action: str) -> dict:
+        f = {"cls": cls, "path": path, "detail": detail,
+             "action": action, "repaired": False}
+        self.findings.append(f)
+        return f
+
+    def _repair_failed(self, f: dict, exc: BaseException) -> None:
+        f["detail"] += f" (repair failed: {exc!r})"
+        log_event(self.log, "fsck_repair_failed", cls=f["cls"],
+                  path=f["path"], error=repr(exc))
+
+    # -- orphaned atomic-write staging files -------------------------------
+    def check_orphan_tmp(self) -> None:
+        for dirpath, _dirnames, filenames in os.walk(self.qdir):
+            for fname in sorted(filenames):
+                m = _TMP_RE.search(fname)
+                if m is None:
+                    continue
+                pid = int(m.group(1))
+                if pid == os.getpid() or _pid_alive(pid):
+                    continue        # a live writer mid-replace
+                path = os.path.join(dirpath, fname)
+                try:
+                    age = self.now - os.path.getmtime(path)
+                except OSError:  # fault-ok: renamed away = completed
+                    continue
+                if age < TMP_GRACE_S:
+                    continue        # possibly a remote writer's
+                f = self._find(
+                    "orphan_tmp", path,
+                    f"dead writer pid {pid}, age {age:.1f}s",
+                    "delete (target path never saw a torn byte)")
+                if self.repair:
+                    try:
+                        fsio.delete(path)
+                        f["repaired"] = True
+                    except OSError as e:
+                        self._repair_failed(f, e)
+
+    # -- segment plane ------------------------------------------------------
+    def check_segments(self) -> None:
+        store = self.q.results.segments
+        try:
+            names = sorted(fsio.list(store.dir))
+        except OSError:  # fault-ok: no segment plane written yet
+            return
+        for name in names:
+            path = os.path.join(store.dir, name)
+            if name.endswith(OPEN_EXT):
+                pid = segment_pid(name)
+                if pid == os.getpid():
+                    continue
+                grace = (OPEN_GRACE_S
+                         if pid is not None and _pid_alive(pid)
+                         else OPEN_SALVAGE_MIN_AGE_S)
+                try:
+                    age = self.now - os.path.getmtime(path)
+                except OSError:  # fault-ok: sealed/salvaged mid-scan
+                    continue
+                if age < grace:
+                    continue
+                f = self._find(
+                    "orphan_open", path,
+                    f"writer pid {pid} gone, age {age:.1f}s",
+                    "salvage valid block prefix, quarantine original")
+            elif name.endswith(SEG_EXT):
+                try:
+                    read_footer(path)
+                    continue
+                except SegmentError as e:
+                    f = self._find(
+                        "torn_segment", path, str(e),
+                        "salvage valid block prefix, quarantine "
+                        "original")
+            else:
+                continue
+            if self.repair:
+                try:
+                    store._salvage(path)
+                    f["repaired"] = True
+                except (OSError, SegmentError, ValueError) as e:
+                    self._repair_failed(f, e)
+
+    # -- job-state records --------------------------------------------------
+    def _corrupt_record(self, path: str, exc: Exception) -> None:
+        f = self._find("corrupt_record", path, repr(exc),
+                       "quarantine aside as .corrupt")
+        if self.repair:
+            try:
+                fsio.rename_if_absent(path, path + ".corrupt")
+                f["repaired"] = True
+            except OSError as e:
+                self._repair_failed(f, e)
+
+    def check_queued(self) -> None:
+        q = self.q
+        for lane, d in q._queued_dirs():
+            try:
+                names = sorted(fsio.list(d))
+            except OSError:  # fault-ok: dir vanished mid-scan
+                continue
+            for fname in names:
+                if not fname.endswith(".json") or ".tmp" in fname:
+                    continue
+                path = os.path.join(d, fname)
+                stamp, jid = q._split_queued_name(fname)
+                if os.path.exists(q._path(DONE, jid)) \
+                        or os.path.exists(q._path(FAILED, jid)):
+                    f = self._find(
+                        "queued_terminal_twin", path,
+                        f"job {jid} is terminal",
+                        "remove (claim's terminal-survivor GC)")
+                    if self.repair:
+                        q._remove_file(path)
+                        f["repaired"] = True
+                    continue
+                try:
+                    raw = fsio.read(path)
+                except OSError:  # fault-ok: claimed/removed mid-scan
+                    continue
+                try:
+                    job = Job.from_record(json.loads(raw))
+                except (ValueError, TypeError) as e:
+                    self._corrupt_record(path, e)
+                    continue
+                self._check_queued_placement(lane, d, path, fname,
+                                             stamp, jid, job)
+
+    def _check_queued_placement(self, lane, d, path, fname, stamp,
+                                jid, job) -> None:
+        """Flag a queued record the O(1) removal probes
+        (``_remove_queued``) and the bounded id scans
+        (``_find_queued_all``) can never hit; legacy flat/laneless
+        names stay valid."""
+        q = self.q
+        expected = q._queued_path(job.id, job.submitted_at,
+                                  q._lane_of(job))
+        if jid != job.id:
+            why = f"filename id {jid} != record id {job.id}"
+        elif lane is not None:
+            if os.path.abspath(path) == os.path.abspath(expected):
+                return
+            why = "lane/shard/stamp disagree with the record"
+        elif stamp is not None and fname.split("-", 1)[0] \
+                != q._stamp_prefix(job.submitted_at):
+            why = "filename stamp disagrees with submitted_at"
+        elif os.path.basename(d).isdigit() \
+                and int(os.path.basename(d)) != q._shard_of(jid):
+            why = "wrong legacy shard dir for this id"
+        else:
+            return
+        f = self._find(
+            "queued_misplaced", path, f"{why}; canonical {expected}",
+            "rewrite at canonical path, remove misplaced record")
+        if self.repair:
+            try:
+                q._write(QUEUED, job)
+                if os.path.abspath(path) != os.path.abspath(expected):
+                    q._remove_file(path)
+                f["repaired"] = True
+            except OSError as e:
+                self._repair_failed(f, e)
+
+    def check_state_records(self) -> None:
+        for state in (LEASED, DONE, FAILED):
+            d = os.path.join(self.qdir, state)
+            try:
+                names = sorted(fsio.list(d))
+            except OSError:  # fault-ok: rollup must survive churn
+                continue
+            for fname in names:
+                if not fname.endswith(".json") or ".tmp" in fname:
+                    continue
+                path = os.path.join(d, fname)
+                try:
+                    raw = fsio.read(path)
+                except OSError:  # fault-ok: finalised mid-scan
+                    continue
+                try:
+                    Job.from_record(json.loads(raw))
+                except (ValueError, TypeError) as e:
+                    self._corrupt_record(path, e)
+
+    def check_leases(self) -> None:
+        q = self.q
+        expired = []
+        for jid in q._ids(LEASED):
+            job = q._read(LEASED, jid)
+            if job is None:
+                continue
+            exp = job.lease_expires_at
+            if exp is None:
+                # mid-claim record (rename done, lease stamp pending):
+                # same mtime grace the reap itself applies
+                try:
+                    exp = os.path.getmtime(q._path(LEASED, jid)) + 30.0
+                except OSError:  # fault-ok: finalised mid-scan
+                    continue
+            if exp > self.now:
+                continue
+            expired.append(self._find(
+                "expired_lease", q._path(LEASED, jid),
+                f"worker {job.lease_worker}, expired "
+                f"{self.now - exp:.1f}s ago",
+                "reap_expired: requeue with backoff, or poison once "
+                "retries exhaust"))
+        if expired and self.repair:
+            try:
+                q.reap_expired(self.now)
+                for f in expired:
+                    f["repaired"] = True
+            except OSError as e:
+                for f in expired:
+                    self._repair_failed(f, e)
+
+    # -- control markers ----------------------------------------------------
+    def _live_workers(self) -> set:
+        """Sanitised names of workers with a heartbeat whose pid still
+        runs (fleet's heartbeat plane under ``qdir/heartbeat/``)."""
+        from ..obs.fleet import HEARTBEAT_DIRNAME, read_heartbeats
+
+        out = set()
+        for hb in read_heartbeats(
+                os.path.join(self.qdir, HEARTBEAT_DIRNAME)):
+            pid = hb.get("pid")
+            if isinstance(pid, int) and not _pid_alive(pid):
+                continue
+            out.add(self.q._safe_worker(str(hb.get("worker"))))
+        return out
+
+    def check_drain_markers(self) -> None:
+        cdir = os.path.join(self.qdir, "control")
+        try:
+            names = sorted(fsio.list(cdir))
+        except OSError:  # fault-ok: no control plane yet
+            return
+        live = None
+        for fname in names:
+            if not fname.startswith("drain.") or ".tmp" in fname:
+                continue
+            wname = fname[len("drain."):]
+            path = os.path.join(cdir, fname)
+            try:
+                age = self.now - os.path.getmtime(path)
+            except OSError:  # fault-ok: consumed mid-scan
+                continue
+            if age < STALE_DRAIN_GRACE_S:
+                continue
+            if live is None:
+                live = self._live_workers()
+            if wname in live:
+                continue
+            f = self._find(
+                "stale_drain", path,
+                f"worker {wname} has no live heartbeat, marker age "
+                f"{age:.1f}s", "clear_worker_drain")
+            if self.repair:
+                self.q.clear_worker_drain(wname)
+                f["repaired"] = True
+
+    # -- streaming plane ----------------------------------------------------
+    def _stream_jobs(self) -> list:
+        jobs = []
+        for state in (QUEUED, LEASED):
+            for jid in self.q._ids(state):
+                job = self.q._read(state, jid)
+                if job is not None \
+                        and isinstance(job.cfg.get("stream"), dict) \
+                        and not job.cfg.get("backfill"):
+                    jobs.append(job)
+        return jobs
+
+    def check_streams(self) -> None:
+        from ..stream.ingest import _CHUNK_RE, _read_manifest
+
+        stream_jobs = self._stream_jobs()
+        for job in stream_jobs:
+            feed = str(job.cfg["stream"].get("feed"))
+            try:
+                man = _read_manifest(feed, missing_ok=True)
+            except (OSError, ValueError):  # fault-ok: a broken feed
+                # poisons at register with the stream plane's own
+                # message — not a queue-dir invariant
+                continue
+            if man is None:
+                continue
+            total = sum(int(c.get("nt", 0)) for c in man["chunks"])
+            meta = self.q.results.get_meta(f"stream.{job.id}")
+            consumed = (int(meta.get("consumed", 0))
+                        if isinstance(meta, dict) else 0)
+            if consumed > total:
+                f = self._find(
+                    "stream_cursor_ahead",
+                    os.path.join(self.q.results.dir,
+                                 f"meta.stream.{job.id}"),
+                    f"cursor {consumed} > committed {total} "
+                    f"(feed {feed})",
+                    "reset cursor to empty state (restore's own "
+                    "from-scratch replay; versioned rows dedup)")
+                if self.repair:
+                    try:
+                        self.q.results.put_meta(f"stream.{job.id}", {})
+                        f["repaired"] = True
+                    except OSError as e:
+                        self._repair_failed(f, e)
+            committed = {int(c["seq"]) for c in man["chunks"]}
+            try:
+                names = sorted(fsio.list(feed))
+            except OSError:  # fault-ok: feed vanished; register path
+                continue     # reports it with its own taxonomy
+            orphans = [n for n in names
+                       if (m := _CHUNK_RE.match(n)) is not None
+                       and int(m.group(1)) not in committed]
+            if orphans:
+                f = self._find(
+                    "feed_orphan_chunk", feed,
+                    f"{len(orphans)} uncommitted chunk(s): "
+                    + " ".join(orphans[:4])
+                    + ("..." if len(orphans) > 4 else ""),
+                    "reopen feed: _recover adopts whole orphans, "
+                    "quarantines torn ones")
+                if self.repair:
+                    try:
+                        from ..stream.ingest import FeedWriter
+
+                        FeedWriter(feed)
+                        f["repaired"] = True
+                    except (OSError, ValueError) as e:
+                        self._repair_failed(f, e)
+        self._check_series_gaps(stream_jobs)
+
+    def _check_series_gaps(self, stream_jobs) -> None:
+        """ADVISORY: holes in a live stream's window-end row series
+        relative to its own smallest hop.  The versioned replay heals
+        gaps when the stream re-runs; no repair action exists, so
+        gaps never block a clean report."""
+        if not stream_jobs:
+            return
+        keys = self.q.results.keys()
+        for job in stream_jobs:
+            pref = f"{job.id}.w"
+            ends = sorted(int(k[len(pref):]) for k in keys
+                          if k.startswith(pref)
+                          and k[len(pref):].isdigit())
+            if len(ends) < 3:
+                continue
+            diffs = [b - a for a, b in zip(ends, ends[1:])]
+            hop = min(diffs)
+            missing = sum(d // hop - 1 for d in diffs
+                          if hop > 0 and d % hop == 0 and d > hop)
+            if missing:
+                self.advisories.append({
+                    "cls": "versioned_series_gap", "path": pref + "*",
+                    "detail": f"{missing} missing window end(s) at "
+                              f"hop {hop} over {len(ends)} rows"})
+
+    # -- drive --------------------------------------------------------------
+    def run(self) -> dict:
+        self.check_orphan_tmp()
+        self.check_segments()
+        self.check_queued()
+        self.check_state_records()
+        self.check_leases()
+        self.check_drain_markers()
+        self.check_streams()
+        classes: dict[str, int] = {}
+        repaired = 0
+        for f in self.findings:
+            classes[f["cls"]] = classes.get(f["cls"], 0) + 1
+            repaired += bool(f["repaired"])
+        order = {c: i for i, c in enumerate(_CLS_ORDER)}
+        self.findings.sort(
+            key=lambda f: (order.get(f["cls"], len(order)), f["path"]))
+        return {
+            "kind": "fsck", "v": 1, "qdir": self.qdir,
+            "ts": round(self.now, 3), "repair": self.repair,
+            "findings": self.findings, "advisories": self.advisories,
+            "classes": classes, "repaired": repaired,
+            "clean": all(f["repaired"] for f in self.findings),
+        }
+
+
+def run_fsck(qdir: str, repair: bool = False,
+             now: float | None = None) -> dict:
+    """Audit ``qdir``'s on-disk invariants (dry-run) or audit+repair.
+
+    Returns the report dict (module docstring catalog); ``clean`` is
+    True when no finding remains unrepaired.  Always writes the
+    trimmed ``control/fsck.json`` snapshot ``fleet status`` renders,
+    and counts ``fsck_runs``/``fsck_findings``/``fsck_repairs``."""
+    now = time.time() if now is None else now
+    audit = _Audit(qdir, repair=bool(repair), now=now)
+    report = audit.run()
+    obs.inc("fsck_runs")
+    for f in report["findings"]:
+        obs.inc("fsck_findings")
+        obs.inc(f"fsck_findings[{f['cls']}]")
+        if f["repaired"]:
+            obs.inc("fsck_repairs")
+            obs.inc(f"fsck_repairs[{f['cls']}]")
+    log_event(audit.log, "fsck_done", qdir=qdir, repair=bool(repair),
+              findings=len(report["findings"]),
+              repaired=report["repaired"], clean=report["clean"])
+    snap = {k: report[k] for k in ("kind", "v", "ts", "repair",
+                                   "classes", "repaired", "clean")}
+    snap["findings"] = len(report["findings"])
+    snap["advisories"] = len(report["advisories"])
+    try:
+        os.makedirs(os.path.join(qdir, "control"), exist_ok=True)
+        fsio.put_atomic(_snapshot_path(qdir), json.dumps(snap))
+    except OSError as e:  # fault-ok: the snapshot is advisory; the
+        # report (and exit code) already carry the audit
+        log_event(audit.log, "fsck_snapshot_failed", error=repr(e))
+    return report
+
+
+def render_report(report: dict) -> str:
+    """Human rendering of a :func:`run_fsck` report (the CLI's
+    non-``--json`` output)."""
+    mode = "repair" if report["repair"] else "dry-run"
+    lines = [f"fsck {report['qdir']} ({mode}):"]
+    if not report["findings"] and not report["advisories"]:
+        lines.append("  clean: every invariant holds")
+        return "\n".join(lines)
+    for f in report["findings"]:
+        state = ("repaired" if f["repaired"]
+                 else "would repair" if not report["repair"]
+                 else "UNREPAIRED")
+        lines.append(f"  {f['cls']}: {f['path']}")
+        lines.append(f"    {f['detail']}")
+        lines.append(f"    {state}: {f['action']}")
+    for a in report["advisories"]:
+        lines.append(f"  advisory {a['cls']}: {a['path']}")
+        lines.append(f"    {a['detail']}")
+    n = len(report["findings"])
+    lines.append(f"  {n} finding(s), {report['repaired']} repaired, "
+                 f"{len(report['advisories'])} advisory; "
+                 + ("clean" if report["clean"] else "NOT clean"))
+    return "\n".join(lines)

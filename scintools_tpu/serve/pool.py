@@ -54,6 +54,7 @@ import time
 
 from .. import faults, obs
 from ..obs import fleet
+from ..utils import fsio
 from ..utils.log import get_logger, log_event
 from .queue import (DEFAULT_AFFINITY_DEFER_S, DEFAULT_MEM_DEFER_S,
                     DEFAULT_PIN_DEFER_S, ClaimHints, JobQueue)
@@ -79,17 +80,13 @@ def pool_status_path(queue_dir: str) -> str:
 
 def _write_json(path: str, payload: dict) -> str:
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    tmp = f"{path}.tmp{os.getpid()}"
-    with open(tmp, "w", encoding="utf-8") as fh:
-        json.dump(payload, fh, default=str)
-    os.replace(tmp, path)
+    fsio.put_atomic(path, json.dumps(payload, default=str))
     return path
 
 
 def _read_json(path: str) -> dict | None:
     try:
-        with open(path, encoding="utf-8") as fh:
-            data = json.load(fh)
+        data = json.loads(fsio.read(path))
     except (OSError, ValueError):
         return None
     return data if isinstance(data, dict) else None
@@ -577,8 +574,17 @@ class PoolController:
         # rewrite with a fresh ts would defeat that fast path
         if entries != self._last_hint_entries \
                 or not os.path.exists(hints_path(self.queue.dir)):
-            write_hints(self.queue.dir, entries)
-            self._last_hint_entries = entries
+            try:
+                write_hints(self.queue.dir, entries)
+                self._last_hint_entries = entries
+            except OSError as e:  # fault-ok: hints are advisory
+                # visible fleet-wide, not just in this log: a
+                # controller that silently stops steering claims
+                # shows up as fsio_write_errors[hints]
+                obs.inc("fsio_write_errors")
+                obs.inc("fsio_write_errors[hints]")
+                log_event(self.log, "hints_write_failed",
+                          error=repr(e))
         obs.gauge("pool_workers", len(self.workers))
         status = {
             "kind": "pool", "v": 1, "ts": round(now, 6),
@@ -601,6 +607,8 @@ class PoolController:
         try:
             _write_json(pool_status_path(self.queue.dir), status)
         except OSError as e:  # fault-ok: status snapshot only
+            obs.inc("fsio_write_errors")
+            obs.inc("fsio_write_errors[pool]")
             log_event(self.log, "pool_status_write_failed",
                       error=repr(e))
         return status

@@ -87,6 +87,7 @@ from typing import Sequence
 
 from .. import faults, obs
 from ..obs.fleet import new_trace_id
+from ..utils import fsio
 from ..utils.store import ResultsStore, content_key
 
 # job states = subdirectories
@@ -461,14 +462,9 @@ class JobQueue:
             shards if shards is not None
             else os.environ.get("SCINT_QUEUE_SHARDS",
                                 DEFAULT_QUEUE_SHARDS))
-        tmp = f"{path}.tmp{os.getpid()}"
         try:
-            with open(tmp, "x") as fh:
-                fh.write(str(n))
             if not os.path.exists(path):
-                os.replace(tmp, path)
-            else:
-                os.remove(tmp)
+                fsio.put_atomic(path, str(n))
         except OSError:  # fault-ok: a racing creator persisted first
             pass
         try:
@@ -591,10 +587,7 @@ class JobQueue:
         path = (self._queued_path(job.id, job.submitted_at,
                                   self._lane_of(job))
                 if state == QUEUED else self._path(state, job.id))
-        tmp = f"{path}.tmp{os.getpid()}"
-        with open(tmp, "w") as fh:
-            json.dump(job.to_record(), fh)
-        os.replace(tmp, path)
+        fsio.put_atomic(path, json.dumps(job.to_record()))
         if state == QUEUED:
             # legacy duplicates must not survive a lane-sharded
             # rewrite: the flat unstamped name (pre-stamp queues), the
@@ -614,8 +607,7 @@ class JobQueue:
 
     def _read_file(self, path: str) -> Job | None:
         try:
-            with open(path) as fh:
-                return Job.from_record(json.load(fh))
+            return Job.from_record(json.loads(fsio.read(path)))
         except (OSError, ValueError, TypeError):
             return None
 
@@ -630,14 +622,14 @@ class JobQueue:
             out = []
             for _lane, d in self._queued_dirs():
                 try:
-                    names = os.listdir(d)
+                    names = fsio.list(d)
                 except OSError:
                     continue
                 out.extend(self._split_queued_name(f)[1] for f in names
                            if f.endswith(".json") and ".tmp" not in f)
             return sorted(out)
         d = os.path.join(self.dir, state)
-        names = [f for f in os.listdir(d)
+        names = [f for f in fsio.list(d)
                  if f.endswith(".json") and ".tmp" not in f]
         return sorted(os.path.splitext(f)[0] for f in names)
 
@@ -653,7 +645,10 @@ class JobQueue:
         entries = []
         for lane, d in self._queued_dirs():
             try:
-                names = os.listdir(d)
+                # a lane/shard dir can vanish mid-scan (compaction /
+                # fsck / tooling race): re-sync by skipping, never
+                # classify as corruption — the next poll resees it
+                names = fsio.list(d)
             except OSError:
                 continue
             for fname in names:
@@ -1271,7 +1266,7 @@ class JobQueue:
                 # chaos site (kind="oserror"): a lost claim race — the
                 # winner-take-one rename semantics must skip, not fail
                 faults.check("queue.claim_rename")
-                os.rename(path, self._path(LEASED, jid))
+                fsio.rename_if_absent(path, self._path(LEASED, jid))
             except OSError:
                 return None  # another worker won this one
             obs.inc("queue_shard_claims"
@@ -1425,7 +1420,7 @@ class JobQueue:
         if path is None:
             return
         try:
-            os.remove(path)
+            fsio.delete(path)
         except OSError:
             pass
 
@@ -1567,15 +1562,11 @@ class JobQueue:
         return os.path.join(self.dir, "control", "drain")
 
     def request_drain(self) -> None:
-        path = self._drain_path()
-        tmp = f"{path}.tmp{os.getpid()}"
-        with open(tmp, "w") as fh:
-            fh.write(str(time.time()))
-        os.replace(tmp, path)
+        fsio.put_atomic(self._drain_path(), str(time.time()))
 
     def clear_drain(self) -> None:
         try:
-            os.remove(self._drain_path())
+            fsio.delete(self._drain_path())
         except OSError:
             pass
 
@@ -1598,17 +1589,14 @@ class JobQueue:
                             f"drain.{self._safe_worker(worker_id)}")
 
     def request_worker_drain(self, worker_id: str) -> None:
-        path = self._worker_drain_path(worker_id)
-        tmp = f"{path}.tmp{os.getpid()}"
-        with open(tmp, "w") as fh:
-            fh.write(str(time.time()))
-        os.replace(tmp, path)
+        fsio.put_atomic(self._worker_drain_path(worker_id),
+                        str(time.time()))
 
     def worker_drain_requested(self, worker_id: str) -> bool:
         return os.path.exists(self._worker_drain_path(worker_id))
 
     def clear_worker_drain(self, worker_id: str) -> None:
         try:
-            os.remove(self._worker_drain_path(worker_id))
+            fsio.delete(self._worker_drain_path(worker_id))
         except OSError:
             pass

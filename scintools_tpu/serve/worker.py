@@ -1454,5 +1454,11 @@ class ServeWorker:
                                 stats=self.stats,
                                 extra=extra or None)
         except OSError as e:  # fault-ok: liveness reporting only
+            # counted, not just logged: a worker whose heartbeats are
+            # silently failing (full disk, dead NFS) must surface in
+            # `fleet status` as fsio_write_errors[heartbeat], not only
+            # in its own local log
+            obs.inc("fsio_write_errors")
+            obs.inc("fsio_write_errors[heartbeat]")
             log_event(self.log, "heartbeat_failed", worker=self.worker_id,
                       error=repr(e))

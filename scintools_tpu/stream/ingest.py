@@ -51,6 +51,7 @@ import zlib
 import numpy as np
 
 from .. import obs
+from ..utils import fsio
 from ..health import (DEFAULT_MAX_NONFINITE_FRAC,
                       DEFAULT_MAX_ZERO_BAND_FRAC)
 from ..utils.log import get_logger, log_event
@@ -199,7 +200,7 @@ class FeedWriter:
         committed = {int(c["seq"]) for c in man["chunks"]}
         names = []
         try:
-            names = sorted(os.listdir(self.dir))
+            names = sorted(fsio.list(self.dir))
         except OSError:
             return
         changed = False
@@ -209,14 +210,13 @@ class FeedWriter:
                 continue
             path = os.path.join(self.dir, fname)
             try:
-                with open(path, "rb") as fh:
-                    data = fh.read()
+                data = fsio.read(path)
                 arr = _decode_chunk(data)
                 if arr.ndim != 2 or arr.shape[0] != len(man["freqs"]):
                     raise ValueError(f"orphan shape {arr.shape}")
             except (OSError, ValueError):
                 try:
-                    os.replace(path, path + ".corrupt")
+                    fsio.rename_if_absent(path, path + ".corrupt")
                 except OSError:  # fault-ok: quarantined by a racer
                     pass
                 log_event(get_logger(), "feed_chunk_quarantined",
@@ -251,10 +251,7 @@ class FeedWriter:
         fname = _chunk_name(seq)
         data = _encode_chunk(arr)
         path = os.path.join(self.dir, fname)
-        tmp = f"{path}.tmp{os.getpid()}"
-        with open(tmp, "wb") as fh:
-            fh.write(data)
-        os.replace(tmp, path)
+        fsio.put_atomic(path, data)
         self.manifest["chunks"].append(
             {"seq": seq, "file": fname, "nt": int(arr.shape[1]),
              "crc": zlib.crc32(data), "t": round(time.time(), 6)})
@@ -271,18 +268,14 @@ class FeedWriter:
 
 
 def _write_manifest(directory: str, man: dict) -> None:
-    path = os.path.join(directory, MANIFEST)
-    tmp = f"{path}.tmp{os.getpid()}"
-    with open(tmp, "w") as fh:
-        json.dump(man, fh)
-    os.replace(tmp, path)
+    fsio.put_atomic(os.path.join(directory, MANIFEST),
+                    json.dumps(man))
 
 
 def _read_manifest(directory: str, missing_ok: bool = False):
     path = os.path.join(directory, MANIFEST)
     try:
-        with open(path) as fh:
-            man = json.load(fh)
+        man = json.loads(fsio.read(path))
     except FileNotFoundError:
         if missing_ok:
             return None
@@ -337,8 +330,7 @@ class FeedReader:
     def read_chunk(self, rec: dict) -> np.ndarray:
         path = os.path.join(self.dir, rec["file"])
         try:
-            with open(path, "rb") as fh:
-                data = fh.read()
+            data = fsio.read(path)
         except FileNotFoundError as e:
             # a COMMITTED chunk's file vanished: deterministic for the
             # directory on disk (someone deleted feed data)

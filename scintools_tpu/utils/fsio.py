@@ -1,0 +1,295 @@
+"""The storage driver seam under every durable plane (ISSUE 20).
+
+Seven on-disk planes (lane x shard queue records + leases, segment
+results, stream feed chunks + manifests, resume cursors, heartbeats,
+controller hints/pool status, drain markers) each grew the same
+hand-rolled durability idiom: write ``<path>.tmp<pid>`` then
+``os.replace``, arbitrate ownership with ``os.rename``, salvage torn
+tails on read.  This module names that idiom as a SMALL verb set —
+
+* :func:`put_atomic`   — whole-file publish (tmp + optional fsync +
+  atomic replace); readers never observe a torn file
+* :func:`append`       — ordered bytes through a held handle (the
+  segment appender's block log; torn tails are the READER's contract)
+* :func:`read`         — whole-file fetch (``FileNotFoundError`` and
+  other ``OSError`` propagate unchanged: callers already classify)
+* :func:`list`         — directory listing
+* :func:`delete`       — idempotent-at-the-caller unlink
+* :func:`rename_if_absent` — ownership arbitration: exactly one of N
+  racing callers wins, the losers see the source vanish (``OSError``).
+  The local driver is plain ``os.rename`` — POSIX rename overwrites,
+  so *callers keep their own existence probe* where dst collisions are
+  possible (the queue's claim path renames to a per-job leased name,
+  where the source-vanish race IS the arbitration).  An object-store
+  driver maps this verb to a conditional PUT (if-none-match) — the
+  ROADMAP item 3 port — which is why the verb carries the stricter
+  name.
+
+— and routes every plane through it, byte-identical formats, so ONE
+driver underlies the whole fleet directory.  ``DRIVER`` is the
+module-level default (:class:`LocalDriver`); a future object-store
+backend replaces it wholesale and must satisfy the invariant catalog
+in docs/reliability.md.
+
+Crash-point enumeration
+-----------------------
+
+Every *mutating* verb call (put/append/delete/rename) is one crash
+point.  Two instrumentation modes, both OFF by default (the disarmed
+cost is one dict lookup via :func:`faults.check` plus one module-
+global ``is None`` test — no syscalls, no env reads):
+
+1. **Per-site fault specs** — the ``fsio.*`` sites accept the storage
+   fault kinds (``torn_write`` / ``crash_before_rename`` /
+   ``crash_after_rename`` / ``enospc`` / ``eio``) via
+   ``faults.inject`` or ``SCINT_FAULTS``.  The errno kinds raise an
+   ``OSError`` the caller's existing handlers classify; the crash
+   kinds perform the spec'd partial work then hard-exit
+   (``os._exit``) — indistinguishable from SIGKILL at that boundary.
+2. **The global sweep** (the chaos harness) — env-driven so a
+   subprocess stub can walk a full lifecycle:
+
+   * ``SCINT_FSIO_COUNT_FILE=<path>``: count mutating verb calls and
+     write the total to ``<path>`` at interpreter exit (the clean run
+     learns K = the number of crash points to sweep).
+   * ``SCINT_FSIO_CRASH_POINT=<k>`` + ``SCINT_FSIO_CRASH_KIND=torn|
+     before|after``: hard-kill the process AT the k-th (1-based)
+     mutating verb call — ``torn`` leaves partial bytes, ``before``
+     completes none of the op, ``after`` completes all of it.
+     Sweeping ``torn`` and ``after`` at every k covers every crash
+     boundary, because all durable mutations route through here.
+
+:func:`crash_points` exposes the running count for in-process
+harnesses.  See docs/reliability.md for the invariant catalog each
+crash point must recover into.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .. import faults
+
+ENV_COUNT_FILE = "SCINT_FSIO_COUNT_FILE"
+ENV_CRASH_POINT = "SCINT_FSIO_CRASH_POINT"
+ENV_CRASH_KIND = "SCINT_FSIO_CRASH_KIND"
+ENV_FSYNC = "SCINT_FSIO_FSYNC"
+
+# exit code of an injected hard kill (distinct from real SIGKILL's
+# -9 so the harness can assert the crash actually came from the
+# enumerated point, not an unrelated abort)
+CRASH_EXIT_CODE = 86
+
+_CRASHES = ("torn", "before", "after")
+
+
+class LocalDriver:
+    """The default backend: local POSIX filesystem, the exact syscall
+    sequences the planes used before the seam (no extra syscalls; the
+    acceptance criterion).  ``fsync`` is opt-in per call or globally
+    via ``SCINT_FSIO_FSYNC=1`` — the planes' recovery paths are
+    rename-ordering-based, not fsync-based, and the disarmed hot path
+    must not grow a syscall."""
+
+    def __init__(self):
+        self.fsync_default = bool(os.environ.get(ENV_FSYNC, ""))
+
+    # -- verbs --------------------------------------------------------------
+    def put_atomic(self, path: str, data: bytes, *,
+                   fsync: bool = False, crash: str | None = None) -> None:
+        tmp = f"{path}.tmp{os.getpid()}"
+        if crash == "torn":
+            with open(tmp, "wb") as fh:
+                fh.write(data[: len(data) // 2])
+            hard_exit()
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+            if fsync or self.fsync_default:
+                fh.flush()
+                os.fsync(fh.fileno())
+        if crash == "before":
+            hard_exit()
+        os.replace(tmp, path)
+        if crash == "after":
+            hard_exit()
+
+    def append(self, fh, data: bytes, *, crash: str | None = None) -> None:
+        if crash == "torn":
+            fh.write(data[: len(data) // 2])
+            fh.flush()
+            hard_exit()
+        if crash == "before":
+            hard_exit()
+        fh.write(data)
+        if crash == "after":
+            fh.flush()
+            hard_exit()
+
+    def read(self, path: str) -> bytes:
+        with open(path, "rb") as fh:
+            return fh.read()
+
+    def list(self, path: str) -> list[str]:
+        return os.listdir(path)
+
+    def delete(self, path: str, *, crash: str | None = None) -> None:
+        if crash in ("torn", "before"):
+            hard_exit()
+        if crash == "after":
+            # "after" = killed the instant the syscall returns control,
+            # whatever its outcome — an ENOENT probe is still a crash
+            # boundary (callers probe several candidate paths)
+            try:
+                os.remove(path)
+            finally:
+                hard_exit()
+        os.remove(path)
+
+    def rename_if_absent(self, src: str, dst: str, *,
+                         crash: str | None = None) -> None:
+        if crash in ("torn", "before"):
+            hard_exit()
+        if crash == "after":
+            try:
+                os.rename(src, dst)
+            finally:
+                hard_exit()
+        os.rename(src, dst)
+
+
+DRIVER = LocalDriver()
+
+
+# ---------------------------------------------------------------------------
+# crash-point instrumentation
+# ---------------------------------------------------------------------------
+
+def hard_exit() -> None:
+    """Die NOW: no atexit, no buffered flushes, no finally blocks —
+    the same boundary a SIGKILL leaves."""
+    os._exit(CRASH_EXIT_CODE)
+
+
+class _Sweep:
+    """The env-driven global crash-point mode (see module doc)."""
+
+    def __init__(self, count_file: str | None, crash_point: int,
+                 crash_kind: str):
+        if crash_kind not in _CRASHES:
+            raise ValueError(
+                f"{ENV_CRASH_KIND}: unknown kind {crash_kind!r} "
+                f"(expected one of {'/'.join(_CRASHES)})")
+        self.count_file = count_file
+        self.crash_point = crash_point
+        self.crash_kind = crash_kind
+        self.mutations = 0
+        if count_file:
+            import atexit
+            atexit.register(self._write_count)
+
+    def _write_count(self) -> None:
+        try:
+            with open(self.count_file, "w") as fh:
+                fh.write(str(self.mutations))
+        except OSError:  # fault-ok: harness bookkeeping only
+            pass
+
+    def step(self) -> str | None:
+        self.mutations += 1
+        if self.crash_point and self.mutations == self.crash_point:
+            return self.crash_kind
+        return None
+
+
+def _sweep_from_env():
+    count_file = os.environ.get(ENV_COUNT_FILE) or None
+    point = int(os.environ.get(ENV_CRASH_POINT, "0") or "0")
+    if count_file is None and point <= 0:
+        return None
+    return _Sweep(count_file, point,
+                  os.environ.get(ENV_CRASH_KIND) or "torn")
+
+
+_SWEEP = _sweep_from_env()
+
+
+def arm(crash_point: int = 0, crash_kind: str = "torn",
+        count_file: str | None = None) -> None:
+    """(Re)arm the sweep in-process — the fork-based chaos harness arms
+    each forked child without env vars or a reimport.  With no args,
+    disarms."""
+    global _SWEEP
+    _SWEEP = (_Sweep(count_file, crash_point, crash_kind)
+              if (count_file or crash_point > 0) else None)
+
+
+def crash_points() -> int:
+    """Mutating verb calls so far in this process (0 when the sweep
+    instrumentation is off — counting costs nothing disarmed)."""
+    return _SWEEP.mutations if _SWEEP is not None else 0
+
+
+def _gate(verb: str, mutating: bool = True) -> str | None:
+    """The per-verb instrumentation gate.  Disarmed: one dict lookup
+    (``faults.check``) + one ``is None`` test.  Returns a crash
+    directive (``torn``/``before``/``after``) for the verb to
+    choreograph, or ``None``; errno fault kinds raise here."""
+    try:
+        faults.check(f"fsio.{verb}")
+    except faults.InjectedCrash as e:
+        return e.crash
+    if _SWEEP is not None and mutating:
+        return _SWEEP.step()
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the plane-facing API (module functions so every backend shares the
+# fault/crash seam; swap backends by assigning ``fsio.DRIVER``)
+# ---------------------------------------------------------------------------
+
+def put_atomic(path: str, data: bytes | str, *,
+               fsync: bool = False) -> None:
+    """Atomically publish ``data`` as ``path`` (tmp + replace; fsync
+    opt-in).  ``str`` data is UTF-8 encoded.  Readers never observe a
+    torn file; a crash leaves either the old content or the new, plus
+    at most one orphaned ``.tmp`` (the fsck catalog's O1)."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    DRIVER.put_atomic(path, data, fsync=fsync, crash=_gate("put"))
+
+
+def append(fh, data: bytes) -> None:
+    """Ordered bytes through a held handle (the segment block log).
+    Torn tails are the reader's contract (``scan_blocks`` salvage)."""
+    DRIVER.append(fh, data, crash=_gate("append"))
+
+
+def read(path: str) -> bytes:
+    """Whole-file fetch.  ``FileNotFoundError``/``OSError`` propagate
+    unchanged — callers already classify (vanished = re-sync, torn =
+    quarantine)."""
+    _gate("read", mutating=False)
+    return DRIVER.read(path)
+
+
+def list(path: str) -> list[str]:  # noqa: A001 - the verb set's name
+    """Directory listing.  ``OSError`` propagates — a vanished
+    directory is a re-sync signal, never corruption."""
+    _gate("list", mutating=False)
+    return DRIVER.list(path)
+
+
+def delete(path: str) -> None:
+    """Unlink.  ``OSError`` propagates; callers treat a vanished path
+    as already-deleted (idempotent at the call site)."""
+    DRIVER.delete(path, crash=_gate("delete"))
+
+
+def rename_if_absent(src: str, dst: str) -> None:
+    """Ownership arbitration: move ``src`` to ``dst``; of N racing
+    callers exactly one wins and the rest see the source vanish
+    (``OSError``).  Local driver: plain ``os.rename`` (see module doc
+    for the conditional-PUT mapping and the caller-side existence
+    probe contract)."""
+    DRIVER.rename_if_absent(src, dst, crash=_gate("rename"))

@@ -56,6 +56,8 @@ import time
 import zlib
 from typing import Iterable, Sequence
 
+from . import fsio
+
 MAGIC = b"SCSEG01\n"
 FOOTER_MAGIC = b"SCSEGFTR"
 SEGMENT_VERSION = 1
@@ -180,13 +182,13 @@ class SegmentAppender:
     stage crash states (a SIGKILL between :meth:`add` and :meth:`seal`
     is exactly the torn-tail shape ``refresh`` must salvage)."""
 
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, base: str | None = None):
         os.makedirs(directory, exist_ok=True)
-        base = _segment_basename()
+        base = base or _segment_basename()
         self.path_open = os.path.join(directory, base + OPEN_EXT)
         self.path_seal = os.path.join(directory, base + SEG_EXT)
         self._fh = open(self.path_open, "wb")
-        self._fh.write(MAGIC)
+        fsio.append(self._fh, MAGIC)
         self._keys: list[str] = []
         self._offsets: list[int] = []
         self._lengths: list[int] = []
@@ -198,7 +200,7 @@ class SegmentAppender:
         self._lengths.append(len(block))
         self._keys.append(str(key))
         self._columns.update(str(c) for c in record)
-        self._fh.write(block)
+        fsio.append(self._fh, block)
 
     def seal(self) -> tuple[str, int]:
         """Write the columnar footer, fsync-free atomic rename to
@@ -211,12 +213,12 @@ class SegmentAppender:
             "bloom": _bloom_build(self._keys),
             "created_at": round(time.time(), 6),
         }).encode("utf-8")
-        self._fh.write(footer)
-        self._fh.write(_TRAILER.pack(len(footer), zlib.crc32(footer)))
-        self._fh.write(FOOTER_MAGIC)
+        fsio.append(self._fh, footer
+                    + _TRAILER.pack(len(footer), zlib.crc32(footer))
+                    + FOOTER_MAGIC)
         self._fh.close()
         size = os.path.getsize(self.path_open)
-        os.rename(self.path_open, self.path_seal)
+        fsio.rename_if_absent(self.path_open, self.path_seal)
         return self.path_seal, size
 
     def abort(self) -> None:
@@ -224,7 +226,7 @@ class SegmentAppender:
             self._fh.close()
         finally:
             try:
-                os.remove(self.path_open)
+                fsio.delete(self.path_open)
             except OSError:  # fault-ok: best-effort cleanup of our tmp
                 pass
 
@@ -444,7 +446,7 @@ class SegmentStore:
             return
         self._salvage_dead_open()
         try:
-            names = {n for n in os.listdir(self.dir)
+            names = {n for n in fsio.list(self.dir)
                      if n.endswith(SEG_EXT)}
         except OSError:
             names = set()
@@ -481,7 +483,7 @@ class SegmentStore:
 
     def _salvage_dead_open(self) -> None:
         try:
-            opens = [n for n in os.listdir(self.dir)
+            opens = [n for n in fsio.list(self.dir)
                      if n.endswith(OPEN_EXT)]
         except OSError:
             return
@@ -507,14 +509,23 @@ class SegmentStore:
 
     def _salvage(self, path: str) -> None:
         """Recover the checksum-valid block prefix of a torn segment
-        into a fresh sealed one, then quarantine the original aside as
-        ``.corrupt`` (same contract as the row store's torn rows: the
-        bytes survive for forensics, the lost-tail keys re-execute,
-        and scans stop re-parsing the same torn file)."""
+        into a sealed one AT THE ORIGINAL NAME POSITION (stem + ``s``
+        sorts immediately after the torn original), then quarantine
+        the original aside as ``.corrupt`` (same contract as the row
+        store's torn rows: the bytes survive for forensics, the
+        lost-tail keys re-execute, and scans stop re-parsing the same
+        torn file).  Sealing under a FRESH stamp here would resurrect
+        stale values — newest-wins scans resolve duplicate keys by
+        name order, and a salvage that runs after the key was
+        re-written (a crashed writer's re-driven successor, then a
+        late fsck) must not advance the old rows past the new.  Seal
+        first, quarantine second: a crash mid-salvage leaves the torn
+        original for the next pass, never a row loss."""
         rows, clean = scan_blocks(path)
         obs = _obs()
         if rows:
-            app = SegmentAppender(self.dir)
+            base = os.path.basename(path).rsplit(".", 1)[0] + "s"
+            app = SegmentAppender(self.dir, base=base)
             try:
                 for key, rec in rows:
                     app.add(key, rec)
@@ -533,7 +544,7 @@ class SegmentStore:
         _obs().inc("segments_quarantined")
         self._drop_handle(path)
         try:
-            os.replace(path, path + CORRUPT_EXT)
+            fsio.rename_if_absent(path, path + CORRUPT_EXT)
         except OSError:  # fault-ok: already quarantined by a racer
             pass
 
@@ -627,7 +638,7 @@ class SegmentStore:
         for seg in inputs:
             self._drop_handle(seg.path)
             try:
-                os.remove(seg.path)
+                fsio.delete(seg.path)
             except OSError:  # fault-ok: a racing compactor got there
                 pass
         obs = _obs()

@@ -46,6 +46,7 @@ from typing import Callable, Iterable, Sequence
 
 import numpy as np
 
+from . import fsio
 from .segments import SegmentStore
 
 SEGMENT_DIRNAME = "segments"
@@ -148,10 +149,7 @@ class ResultsStore:
         duplicate executions — can never interleave bytes through one
         shared tmp file; each write is whole, and the last rename
         wins."""
-        tmp = self._path(key) + f".tmp{os.getpid()}"
-        with open(tmp, "w") as fh:
-            json.dump(record, fh)
-        os.replace(tmp, self._path(key))
+        fsio.put_atomic(self._path(key), json.dumps(record))
 
     def put_new(self, key: str, record: dict) -> bool:
         """Write-once variant of :meth:`put` for at-least-once
@@ -174,8 +172,7 @@ class ResultsStore:
         :meth:`get`)."""
         path = self._path(key)
         try:
-            with open(path) as fh:
-                return json.load(fh)
+            return json.loads(fsio.read(path))
         except OSError:
             return None
         except ValueError:
@@ -219,7 +216,7 @@ class ResultsStore:
         obs.inc("store_corrupt_rows")
         log_event(get_logger(), "store_corrupt_row", path=path)
         try:
-            os.replace(path, path + ".corrupt")
+            fsio.rename_if_absent(path, path + ".corrupt")
         except OSError:  # fault-ok: already quarantined by a racer
             pass
 
@@ -322,7 +319,7 @@ class ResultsStore:
     def _row_file_keys(self) -> set[str]:
         try:
             return {os.path.splitext(f)[0]
-                    for f in os.listdir(self.dir) if f.endswith(".json")}
+                    for f in fsio.list(self.dir) if f.endswith(".json")}
         except OSError:
             return set()
 
@@ -381,11 +378,8 @@ class ResultsStore:
         (no ``.json``), so ``keys()``/``records()``/CSV export never see
         them.  Atomic like ``put``; the tmp name is per-process so two
         CLI runs sharing a store cannot interleave half-writes."""
-        path = os.path.join(self.dir, f"meta.{name}")
-        tmp = f"{path}.tmp{os.getpid()}"
-        with open(tmp, "w") as fh:
-            json.dump(record, fh)
-        os.replace(tmp, path)
+        fsio.put_atomic(os.path.join(self.dir, f"meta.{name}"),
+                        json.dumps(record))
 
     def meta_names(self, prefix: str = "") -> list[str]:
         """Names of stored metadata records (optionally filtered by
@@ -393,7 +387,7 @@ class ResultsStore:
         (e.g. ``arc_stack.<digest>``: one atomic file per campaign, so
         concurrent runs can never lose each other's records the way a
         read-modify-append of one shared list would)."""
-        return sorted(f[len("meta."):] for f in os.listdir(self.dir)
+        return sorted(f[len("meta."):] for f in fsio.list(self.dir)
                       if f.startswith("meta." + prefix)
                       and ".tmp" not in f)
 
@@ -401,8 +395,8 @@ class ResultsStore:
         """Metadata is diagnostic: a missing OR unreadable/corrupt file
         degrades to None rather than failing the run that asked."""
         try:
-            with open(os.path.join(self.dir, f"meta.{name}")) as fh:
-                return json.load(fh)
+            return json.loads(fsio.read(
+                os.path.join(self.dir, f"meta.{name}")))
         except (OSError, ValueError):
             return None
 

@@ -63,7 +63,13 @@ EXTRA_FILES = (os.path.join("utils", "segments.py"),
                # lint's subtree walk): its hint math feeds claim-time
                # routing on byte counts — a wide dtype there is the
                # same silent 2x the storage modules guard against
-               os.path.join("serve", "pool.py"))
+               os.path.join("serve", "pool.py"),
+               # the ISSUE 20 storage-driver seam sits under every
+               # durable byte the planes write, and the fsck auditor
+               # re-reads every plane it wrote (serve/ is outside this
+               # lint's subtree walk)
+               os.path.join("utils", "fsio.py"),
+               os.path.join("serve", "fsck.py"))
 
 
 def find_wide_literals(path: str) -> list:

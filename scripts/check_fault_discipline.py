@@ -60,7 +60,16 @@ EXTRA_FILES = (os.path.join("utils", "segments.py"),
                # with no counter moving (serve/ is already walked;
                # pinned here so a future move out of serve/ cannot
                # silently drop it from the discipline)
-               os.path.join("serve", "pool.py"))
+               os.path.join("serve", "pool.py"),
+               # the ISSUE 20 storage-driver seam: every durable write
+               # in the system funnels through it, so a swallowed
+               # OSError here loses state across ALL planes at once
+               os.path.join("utils", "fsio.py"),
+               # ...and the auditor that repairs what crashes leave
+               # behind — a swallowed repair failure would report
+               # "clean" over a still-broken dir (serve/ is walked;
+               # pinned like pool.py against a future move)
+               os.path.join("serve", "fsck.py"))
 # exception names whose handlers are in scope (everything-catchers)
 BROAD = {"Exception", "BaseException"}
 # call names (attribute tails) that count as reporting the failure

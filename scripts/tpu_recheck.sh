@@ -377,6 +377,40 @@ print('slo gate ok: breach -> pending -> firing -> resolved,',
       'durable rows readable across stores')
 "
 
+FSCK_CODE="
+import os, tempfile, time
+from scintools_tpu.serve import fsck
+from scintools_tpu.serve.queue import JobQueue
+qdir = tempfile.mkdtemp(prefix='scint_fsck_gate_')
+q = JobQueue(qdir, max_retries=5, backoff_s=0.0)
+# seed three catalog classes: dead-pid atomic-write litter, a torn
+# segment tail, and an expired lease
+ep = os.path.join(qdir, 'gate.dat')
+open(ep, 'w').write('gate\n' * 4)
+q.submit(ep, {}, lane='bulk')
+assert q.claim('w1', 1, lease_s=0.5)
+q.results.put_new_buffered('rowk', {'x': 1.0})
+q.results.flush()
+segdir = q.results.segments.dir
+seg = [n for n in os.listdir(segdir) if n.endswith('.seg')][0]
+litter = os.path.join(qdir, 'control', 'hints.json.tmp999999')
+open(litter, 'w').write('{half')
+os.utime(litter, (time.time() - 600,) * 2)
+with open(os.path.join(segdir, seg), 'r+b') as fh:
+    fh.truncate(os.path.getsize(os.path.join(segdir, seg)) - 12)
+future = time.time() + 3600.0
+dry = fsck.run_fsck(qdir, now=future)
+want = {'orphan_tmp', 'torn_segment', 'expired_lease'}
+assert set(dry['classes']) == want, dry['classes']
+rep = fsck.run_fsck(qdir, repair=True, now=future)
+assert rep['clean'], rep['findings']
+again = fsck.run_fsck(qdir, now=future)
+assert again['clean'] and not again['findings'], again['findings']
+assert fsck.read_fsck_status(qdir)['clean']
+print('fsck gate ok: seeded', sorted(want), 'detected, repaired,',
+      'second audit clean')
+"
+
 INFER_CODE="
 import dataclasses
 import numpy as np
@@ -613,6 +647,15 @@ echo "== slo plane: injected lag breach fires + resolves durably =="
 # the fault window exhausts — with the rows readable through a fresh
 # store, the crash-survival contract tier-1 proves across a SIGKILL
 gated "slo smoke check" 600 2 python -u -c "$SLO_CODE"
+
+echo "== fsck: seeded corruption detected, repaired, converged =="
+# the ISSUE 20 auditor, end to end in seconds: a queue dir seeded
+# with dead-pid tmp litter, a torn segment tail and an expired lease
+# is flagged on a dry run, healed by --repair, and a second dry run
+# reports clean — the crash-point sweep itself is tier-1
+# (tests/test_crashpoints.py); this proves the repair loop converges
+# in the flight's environment too
+gated "fsck repair convergence check" 300 2 python -u -c "$FSCK_CODE"
 
 echo "== differentiable inference: closed-loop gradient fit on chip =="
 # the ISSUE 18 inference plane, sub-minute: an acf campaign's injected

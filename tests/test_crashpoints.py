@@ -1,0 +1,317 @@
+"""Crash-point enumeration sweep (ISSUE 20 tentpole).
+
+Every durable mutation routes through the ``utils/fsio`` verb seam,
+so the set of crash boundaries IS the sequence of mutating verb calls
+a lifecycle makes.  A subprocess stub runner (no jax — the pipeline is
+stubbed out) drives the serve lifecycle (submit -> claim -> batch ->
+flush -> complete) and the streaming lifecycle (feed append/finalize
+-> tick rows -> durable cursor -> resume):
+
+1. a COUNT run (``SCINT_FSIO_COUNT_FILE``) learns K, the number of
+   crash points, and an untouched ORACLE run exports the expected CSV;
+2. a single DRIVER subprocess then ``os.fork``s one child per (k,
+   kind) for EVERY k in 1..K and both covering crash shapes (``torn``
+   = partial bytes then die, ``after`` = op completes then die — see
+   fsio's module doc for why these two cover every boundary): the
+   child arms the sweep via :func:`fsio.arm`, is hard-killed at point
+   k (asserted via the distinct exit code), and a second disarmed fork
+   RE-DRIVES the same dir with the identical idempotent lifecycle —
+   fork instead of spawn so the interpreter+import cost is paid ONCE,
+   keeping the full sweep sub-minute on one core;
+3. after recovery: ``fsck --repair`` converges (a second dry-run
+   audit reports clean), the queue holds no lost/duplicated work, the
+   stream cursor never leads the committed feed, and the exported CSV
+   is byte-identical to the oracle's.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from scintools_tpu.serve import fsck
+from scintools_tpu.utils import fsio
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the stub runner: drives one lifecycle against a queue dir (crashing
+# wherever the fsio sweep says, re-driving idempotently when disarmed)
+# and, in ``sweep`` mode, forks the whole (k, kind) grid in-process.
+RUNNER = r'''
+import os, sys, time
+
+from scintools_tpu.serve import queue as queue_mod
+from scintools_tpu.serve.queue import JobQueue
+from scintools_tpu.utils import fsio
+from scintools_tpu.utils.store import ResultsStore
+
+# stub: cfg validation builds the jax pipeline config; irrelevant here
+queue_mod.validate_job_cfg = lambda cfg: None
+
+
+def run_serve(qdir):
+    os.makedirs(os.path.join(qdir, "in"), exist_ok=True)
+    files = []
+    for i in range(2):
+        p = os.path.join(qdir, "in", f"epoch{i}.dat")
+        if not os.path.exists(p):
+            with open(p, "w") as fh:
+                fh.write(f"epoch-{i}\n" * 4)   # deterministic job ids
+        files.append(p)
+    q = JobQueue(qdir, max_retries=99, backoff_s=0.0)
+    for i, p in enumerate(files):
+        q.submit(p, {"i": i}, lane="bulk")
+    for _round in range(50):
+        # logical clock: far ahead of real time so a crashed run's
+        # stamps are stale, and ADVANCING per round so any lease the
+        # crashed run wrote (with its own skewed clock) expires
+        now = time.time() + 3600.0 * (1 + _round)
+        q.reap_expired(now)
+        jobs = q.claim("stub", 4, lease_s=1.0, now=now)
+        if not jobs:
+            if not q._ids("queued") and not q._ids("leased"):
+                break
+            continue
+        for job in jobs:
+            if job.id not in q.results:
+                q.results.put_new_buffered(job.id, {
+                    "src": os.path.basename(job.file),
+                    "value": float(job.cfg["i"]) * 0.5})
+        q.results.flush()
+        for job in jobs:
+            q.complete(job)
+    assert len(q._ids("done")) == 2, q.counts()
+    assert not q._ids("queued") and not q._ids("leased"), q.counts()
+
+
+def run_stream(qdir):
+    import numpy as np
+
+    from scintools_tpu.stream.ingest import FeedWriter, _read_manifest
+
+    JobQueue(qdir)                      # the audited queue dir exists
+    feed = os.path.join(qdir, "feed")
+    results = ResultsStore(os.path.join(qdir, "results"))
+    NF, NT, NCHUNK = 4, 2, 3
+    writer = FeedWriter(feed, freqs=[1e3 + i for i in range(NF)],
+                        dt=1.0)         # reopen recovers orphan chunks
+    have = {int(c["seq"]) for c in writer.manifest["chunks"]}
+    for seq in range(NCHUNK):
+        if seq in have:
+            continue
+        chunk = (np.arange(NF * NT, dtype="float32")
+                 .reshape(NF, NT) + seq)
+        writer.append(chunk)
+    writer.finalize()
+    jid = "stubstream01"
+    meta = results.get_meta(f"stream.{jid}") or {}
+    consumed, tick = int(meta.get("consumed", 0)), \
+        int(meta.get("tick_seq", 0))
+    for end in (4, 6):                  # window=4 hop=2 over 6 samples
+        if end <= consumed:
+            continue
+        row = {"feed": "feed", "window_end": end, "eta": end * 0.25}
+        results.put_versioned(f"{jid}.w{end:09d}", row, series=jid)
+        results.put_versioned(f"{jid}.live", row, series=jid)
+        results.flush()                 # rows durable BEFORE cursor
+        tick += 1
+        results.put_meta(f"stream.{jid}",
+                         {"consumed": end, "tick_seq": tick})
+        consumed = end
+    man = _read_manifest(feed)
+    assert man["finalized"]
+    assert sum(int(c["nt"]) for c in man["chunks"]) == NCHUNK * NT
+    cur = results.get_meta(f"stream.{jid}")
+    assert cur and int(cur["consumed"]) == NCHUNK * NT, cur
+
+
+def _fork_lifecycle(run, qdir, k=0, kind="torn"):
+    """Run one lifecycle in a forked child (armed iff k > 0) and
+    return its exit status — fork shares the already-imported
+    interpreter, so each crash point costs milliseconds, not a
+    fresh python startup."""
+    sys.stdout.flush()
+    sys.stderr.flush()
+    pid = os.fork()
+    if pid == 0:
+        fsio.arm(k, kind)               # arm(0) disarms (the re-drive)
+        try:
+            run(qdir)
+        except BaseException:
+            import traceback
+            traceback.print_exc()
+            os._exit(1)
+        os._exit(0)
+    return os.waitstatus_to_exitcode(os.waitpid(pid, 0)[1])
+
+
+def sweep(scenario, base, k_total):
+    run = {"serve": run_serve, "stream": run_stream}[scenario]
+    for k in range(1, k_total + 1):
+        for kind in ("torn", "after"):
+            qdir = os.path.join(base, f"{kind}-{k:03d}")
+            rc = _fork_lifecycle(run, qdir, k, kind)
+            if rc != fsio.CRASH_EXIT_CODE:
+                print(f"FAIL k={k} {kind}: expected the injected "
+                      f"hard kill, got rc={rc}", flush=True)
+                sys.exit(3)
+            rc = _fork_lifecycle(run, qdir)
+            if rc != 0:
+                print(f"FAIL k={k} {kind}: re-drive failed rc={rc}",
+                      flush=True)
+                sys.exit(4)
+
+
+cmd = sys.argv[1]
+if cmd == "sweep":
+    sweep(sys.argv[2], sys.argv[3], int(sys.argv[4]))
+else:
+    {"serve": run_serve, "stream": run_stream}[cmd](sys.argv[2])
+'''
+
+
+@pytest.fixture(scope="module")
+def runner_path(tmp_path_factory):
+    p = tmp_path_factory.mktemp("crashpoints") / "runner.py"
+    p.write_text(RUNNER)
+    return str(p)
+
+
+def _env(**extra) -> dict:
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("SCINT_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra)
+    return env
+
+
+def _run(runner, *args, **envkw):
+    return subprocess.run(
+        [sys.executable, runner, *args], env=_env(**envkw),
+        capture_output=True, text=True, timeout=300)
+
+
+def _export(qdir: str, out: str) -> bytes:
+    from scintools_tpu.utils.store import ResultsStore
+
+    ResultsStore(os.path.join(qdir, "results")).export_csv(
+        out, full=True)
+    with open(out, "rb") as fh:
+        return fh.read()
+
+
+def _age_crash_litter(qdir: str) -> None:
+    """Backdate the crashed run's ``.tmp``/``.open`` litter past the
+    fsck/salvage remote-writer grace windows, so the audit must both
+    FLAG and REPAIR it (fresh litter is deliberately left alone)."""
+    for dirpath, _dirs, files in os.walk(qdir):
+        for f in files:
+            if ".tmp" in f or f.endswith(".open"):
+                p = os.path.join(dirpath, f)
+                old = time.time() - 600.0
+                try:
+                    os.utime(p, (old, old))
+                except OSError:
+                    pass
+
+
+def _learn_k_and_oracle(runner, scenario, tmp_path):
+    """K (the crash-point count) from a counted clean run + the oracle
+    CSV bytes from its own results."""
+    qdir = str(tmp_path / f"oracle-{scenario}")
+    count_file = str(tmp_path / f"count-{scenario}")
+    r = _run(runner, scenario, qdir, SCINT_FSIO_COUNT_FILE=count_file)
+    assert r.returncode == 0, r.stderr
+    with open(count_file) as fh:
+        k_total = int(fh.read())
+    assert k_total > 10, f"{scenario}: suspiciously few crash points"
+    oracle = _export(qdir, str(tmp_path / f"oracle-{scenario}.csv"))
+    assert oracle, "oracle CSV is empty"
+    return k_total, oracle
+
+
+def _audit_one(scenario, qdir, k, kind, oracle):
+    """Post-recovery invariants for one swept crash point: fsck
+    --repair converges and the recovered CSV matches the oracle."""
+    _age_crash_litter(qdir)
+    rep = fsck.run_fsck(qdir, repair=True)
+    assert rep["clean"], (k, kind, rep["findings"])
+    rep2 = fsck.run_fsck(qdir)
+    assert rep2["clean"] and not rep2["findings"], (
+        f"{scenario} k={k} {kind}: repair did not converge: "
+        f"{rep2['findings']}")
+    csv = _export(qdir, os.path.join(qdir, "out.csv"))
+    assert csv == oracle, (
+        f"{scenario} k={k} {kind}: recovered CSV diverged from the "
+        f"clean run's")
+
+
+def _sweep(runner, scenario, tmp_path, extra_check=None):
+    k_total, oracle = _learn_k_and_oracle(runner, scenario, tmp_path)
+    base = str(tmp_path / f"sweep-{scenario}")
+    r = _run(runner, "sweep", scenario, base, str(k_total))
+    assert r.returncode == 0, (
+        f"{scenario} sweep driver failed\n{r.stdout}\n{r.stderr}")
+    for k in range(1, k_total + 1):
+        for kind in ("torn", "after"):
+            qdir = os.path.join(base, f"{kind}-{k:03d}")
+            _audit_one(scenario, qdir, k, kind, oracle)
+            if extra_check is not None:
+                extra_check(qdir, k, kind)
+    return k_total
+
+
+def test_serve_lifecycle_survives_every_crash_point(runner_path,
+                                                    tmp_path):
+    """Hard-killing submit->claim->flush->complete at EVERY mutating
+    fsio call (both covering shapes) recovers to the oracle CSV with
+    no lost or duplicated jobs, and fsck --repair converges."""
+    def check(qdir, k, kind):
+        from scintools_tpu.serve.queue import JobQueue
+
+        q = JobQueue(qdir)
+        c = q.counts()
+        assert c["done"] == 2 and c["queued"] == 0 \
+            and c["leased"] == 0 and c["failed"] == 0, (k, kind, c)
+
+    k_total = _sweep(runner_path, "serve", tmp_path, check)
+    assert k_total >= 20   # the lifecycle really spans the planes
+
+
+def test_stream_lifecycle_survives_every_crash_point(runner_path,
+                                                     tmp_path):
+    """Hard-killing feed append/finalize -> tick rows -> cursor at
+    EVERY mutating fsio call recovers to the oracle CSV with the
+    cursor never leading the committed feed."""
+    def check(qdir, k, kind):
+        from scintools_tpu.stream.ingest import _read_manifest
+        from scintools_tpu.utils.store import ResultsStore
+
+        man = _read_manifest(os.path.join(qdir, "feed"))
+        total = sum(int(c["nt"]) for c in man["chunks"])
+        assert man["finalized"] and total == 6, (k, kind, man)
+        cur = ResultsStore(os.path.join(qdir, "results")).get_meta(
+            "stream.stubstream01")
+        assert cur and int(cur["consumed"]) <= total, (k, kind, cur)
+        assert int(cur["consumed"]) == total, (k, kind, cur)
+
+    _sweep(runner_path, "stream", tmp_path, check)
+
+
+def test_crash_sweep_runner_is_deterministic(runner_path, tmp_path):
+    """Two counted clean runs agree on K — the sweep's guarantee that
+    crash point k in a killed run is the same boundary the count run
+    enumerated."""
+    ks = []
+    for tag in ("a", "b"):
+        count = str(tmp_path / f"count-{tag}")
+        r = _run(runner_path, "serve", str(tmp_path / f"det-{tag}"),
+                 SCINT_FSIO_COUNT_FILE=count)
+        assert r.returncode == 0, r.stderr
+        with open(count) as fh:
+            ks.append(int(fh.read()))
+    assert ks[0] == ks[1], ks

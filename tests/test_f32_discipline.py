@@ -98,7 +98,9 @@ def test_results_plane_modules_are_covered():
     pkg = os.path.join(REPO, "scintools_tpu")
     for rel in (os.path.join("utils", "segments.py"),
                 os.path.join("utils", "store.py"),
-                os.path.join("serve", "pool.py")):
+                os.path.join("serve", "pool.py"),
+                os.path.join("utils", "fsio.py"),
+                os.path.join("serve", "fsck.py")):
         assert rel in extra, rel
         path = os.path.join(pkg, rel)
         assert os.path.exists(path), path

@@ -32,7 +32,9 @@ def test_results_plane_modules_are_covered():
     extra = set(check_fault_discipline.EXTRA_FILES)
     for rel in (os.path.join("utils", "segments.py"),
                 os.path.join("utils", "store.py"),
-                os.path.join("serve", "pool.py")):
+                os.path.join("serve", "pool.py"),
+                os.path.join("utils", "fsio.py"),
+                os.path.join("serve", "fsck.py")):
         assert rel in extra, rel
         assert os.path.exists(os.path.join(pkg, rel)), rel
 

@@ -629,3 +629,117 @@ def test_env_driven_chaos_through_cli_serve(tmp_path, capsys,
     assert cli_main(["status", qdir]) == 0
     st = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert st["done"] == 2 and st["depth"] == 0
+
+
+# ---------------------------------------------------------------------------
+# the storage driver seam (utils/fsio -- ISSUE 20 satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_enospc_and_edquot_classify_transient():
+    """A full disk / blown quota recovers after compaction or space
+    recovery -- it must requeue on the budget-free transient path, not
+    burn the bounded retry budget and poison a good job."""
+    import errno
+
+    assert classify_error(OSError(errno.ENOSPC, "disk full")) \
+        == "transient"
+    assert classify_error(OSError(errno.EDQUOT, "quota")) == "transient"
+    # an unrelated errno keeps the unknown bucket's bounded retries
+    assert classify_error(OSError(errno.EPERM, "denied")) == "unknown"
+
+
+def test_fsio_errno_fault_kinds_reach_callers(tmp_path):
+    """The enospc/eio kinds armed at an fsio verb surface as the real
+    OSError the caller's narrow handlers and classify_error see."""
+    import errno
+
+    from scintools_tpu.utils import fsio
+
+    p = str(tmp_path / "f.json")
+    with faults.injected("fsio.put", FaultSpec(kind="enospc")):
+        with pytest.raises(OSError) as ei:
+            fsio.put_atomic(p, b"{}")
+    assert ei.value.errno == errno.ENOSPC
+    assert classify_error(ei.value) == "transient"
+    assert not os.path.exists(p)    # fired before any byte landed
+    fsio.put_atomic(p, b"{}")       # disarmed: the verb works again
+    with faults.injected("fsio.read", FaultSpec(kind="eio")):
+        with pytest.raises(OSError) as ei:
+            fsio.read(p)
+    assert ei.value.errno == errno.EIO
+    assert fsio.read(p) == b"{}"
+
+
+def test_fsio_crash_kinds_carry_driver_choreography():
+    """The crash kinds raise the InjectedCrash directive whose .crash
+    names the driver's partial-work shape (the fsio verbs translate it
+    into bytes + os._exit -- proven end-to-end by the subprocess sweep
+    in test_crashpoints.py; here: the registry->directive mapping)."""
+    for kind, crash in (("torn_write", "torn"),
+                        ("crash_before_rename", "before"),
+                        ("crash_after_rename", "after")):
+        with faults.injected("fsio.delete", FaultSpec(kind=kind)):
+            with pytest.raises(faults.InjectedCrash) as ei:
+                faults.check("fsio.delete")
+        assert ei.value.crash == crash
+
+
+def test_fsio_disarmed_overhead_is_one_gate():
+    """The production fsio gate: sweep off, registry empty -- 100k
+    gate passes cost what 100k dict lookups cost, and no counter or
+    crash-point state is touched."""
+    from scintools_tpu.utils import fsio
+
+    assert fsio._SWEEP is None          # env instrumentation off
+    assert fsio.crash_points() == 0
+    assert faults.active() == {}
+    with obs.tracing():
+        t0 = time.perf_counter()
+        for _ in range(100_000):
+            fsio._gate("put")
+        dt = time.perf_counter() - t0
+        assert obs.counters() == {}
+    assert dt < 1.0, f"disarmed fsio gate too slow: {dt:.3f}s / 100k"
+    obs.reset()
+
+
+def test_heartbeat_write_failure_counts_fsio_write_errors(tmp_path):
+    """Satellite: a worker whose heartbeat put fails (full disk, dead
+    NFS) degrades to fsio_write_errors[heartbeat] + a log line -- the
+    worker must never crash over liveness reporting."""
+    q = JobQueue(str(tmp_path / "q"))
+    worker = ServeWorker(q, runner=_stub_runner())
+    with obs.tracing():
+        with faults.injected("fsio.put", FaultSpec(kind="enospc")):
+            worker._beat(force=True)
+        c = obs.counters()
+    assert c.get("fsio_write_errors") == 1
+    assert c.get("fsio_write_errors[heartbeat]") == 1
+    obs.reset()
+
+
+def test_claim_survives_vanished_queue_dirs(tmp_path):
+    """Satellite: a vanished lane/shard dir (concurrent GC, a remote
+    backend re-sync) or a listing error mid-claim degrades to an empty
+    claim, never an exception -- and the next claim heals."""
+    qdir = str(tmp_path / "q")
+    q = JobQueue(qdir)
+    src = str(tmp_path / "v.dat")
+    with open(src, "w") as fh:
+        fh.write("epoch\n" * 4)
+    q.submit(src, {"lamsteps": True}, lane="bulk")
+    import shutil
+
+    shutil.rmtree(os.path.join(qdir, "queued"))
+    assert q.claim("w", 4, lease_s=5.0) == []
+    q = JobQueue(qdir)                  # re-init recreates the layout
+    src2 = str(tmp_path / "v2.dat")
+    with open(src2, "w") as fh:
+        fh.write("epoch2\n" * 4)
+    jid2, _ = q.submit(src2, {"lamsteps": True}, lane="bulk")
+    with faults.injected("fsio.list",
+                         FaultSpec(kind="oserror", times=99)):
+        assert q.claim("w", 4, lease_s=5.0) == []
+    jobs = q.claim("w", 4, lease_s=5.0)
+    assert [j.id for j in jobs] == [jid2]

@@ -1,0 +1,277 @@
+"""`scintools-tpu fsck`: every invariant class in the catalog is
+detected, `--repair` converges (a second dry-run reports clean), and
+the snapshot feeds `fleet status` (ISSUE 20 tentpole)."""
+
+import json
+import os
+import shutil
+import time
+
+import pytest
+
+from scintools_tpu import cli, faults, obs
+from scintools_tpu.serve import fsck
+from scintools_tpu.serve.queue import DONE, QUEUED, Job, JobQueue
+from scintools_tpu.utils.segments import SegmentAppender
+
+DEAD_PID = 999999
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    obs.disable(flush=False)
+    obs.reset()
+    faults.clear()
+    yield
+    obs.disable(flush=False)
+    obs.reset()
+    faults.clear()
+
+
+def _backdate(path: str, by_s: float = 600.0) -> None:
+    old = time.time() - by_s
+    os.utime(path, (old, old))
+
+
+def _epoch(tmp_path, name: str) -> str:
+    p = str(tmp_path / name)
+    with open(p, "w") as fh:
+        fh.write(f"{name}\n" * 4)
+    return p
+
+
+def _seed_orphan_tmp(qdir: str) -> str:
+    path = os.path.join(qdir, "control", f"hints.json.tmp{DEAD_PID}")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        fh.write("{half-written")
+    _backdate(path)
+    return path
+
+
+def _seed_every_class(tmp_path, qdir: str):
+    """One queue dir violating EVERY catalog class at once (plus the
+    series-gap advisory)."""
+    q = JobQueue(qdir, max_retries=5, backoff_s=0.0)
+    t0 = time.time()
+
+    # expired_lease: claim then let the lease run out (audited at a
+    # `now` far past expiry)
+    q.submit(_epoch(tmp_path, "lease.dat"), {}, lane="bulk")
+    assert q.claim("w1", 1, lease_s=0.5, now=t0)
+
+    # queued_terminal_twin: a done record appears while the queued
+    # record survives (racing-submitter crash window)
+    jid2, _ = q.submit(_epoch(tmp_path, "twin.dat"), {}, lane="bulk")
+    q._write(DONE, q._read(QUEUED, jid2))
+
+    # queued_misplaced: a valid record moved into the WRONG lane dir
+    # (the O(1) removal probes can never hit it there)
+    jid3, _ = q.submit(_epoch(tmp_path, "misplaced.dat"), {},
+                       lane="bulk")
+    job3 = q._read(QUEUED, jid3)
+    canonical = q._queued_path(jid3, job3.submitted_at, "bulk")
+    wrong = canonical.replace(f"{os.sep}bulk{os.sep}",
+                              f"{os.sep}interactive{os.sep}")
+    assert wrong != canonical
+    os.makedirs(os.path.dirname(wrong), exist_ok=True)
+    os.rename(canonical, wrong)
+
+    # corrupt_record: unparseable terminal-state JSON
+    corrupt = os.path.join(qdir, "done", "0badc0ffee.json")
+    with open(corrupt, "w") as fh:
+        fh.write("{not json")
+
+    # orphan_tmp: dead-pid atomic-write staging litter
+    _seed_orphan_tmp(qdir)
+
+    segdir = q.results.segments.dir
+
+    # stale_drain: marker for a worker with no heartbeat...
+    q.request_worker_drain("ghost")
+    _backdate(q._worker_drain_path("ghost"), 120.0)
+    # ...while a drained worker with a LIVE heartbeat is NOT flagged
+    q.request_worker_drain("alive")
+    _backdate(q._worker_drain_path("alive"), 120.0)
+    hbd = os.path.join(qdir, "heartbeat")
+    os.makedirs(hbd, exist_ok=True)
+    with open(os.path.join(hbd, "alive.json"), "w") as fh:
+        json.dump({"kind": "heartbeat", "worker": "alive",
+                   "pid": os.getpid(), "ts": time.time()}, fh)
+
+    # torn_segment: seal a sacrificial row into its own segment NOW,
+    # torn at the very end (nothing may refresh the store after the
+    # tear — a refresh would quarantine it via the store's own
+    # recovery) so the later versioned rows live in a separate one
+    q.results.put_new_buffered("tornrow", {"x": 1.0})
+    q.results.flush()
+    torn = os.path.join(segdir, sorted(
+        n for n in os.listdir(segdir) if n.endswith(".seg"))[0])
+
+    # a live stream registration over a real feed
+    from scintools_tpu.stream.ingest import FeedWriter
+
+    feed = str(tmp_path / "feed")
+    writer = FeedWriter(feed, freqs=[1e3, 2e3], dt=1.0)
+    import numpy as np
+
+    for seq in range(2):
+        writer.append(np.ones((2, 2), dtype="float32") * seq)
+    jid = "streamfsck01"
+    q._write(QUEUED, Job(id=jid, file="stream:feed",
+                         cfg={"stream": {"feed": feed}},
+                         submitted_at=time.time()))
+    # stream_cursor_ahead: durable cursor claims more than committed
+    q.results.put_meta(f"stream.{jid}", {"consumed": 99})
+    # feed_orphan_chunk: a whole chunk the manifest never committed
+    shutil.copy(os.path.join(feed, "chunk_00000000.npy"),
+                os.path.join(feed, "chunk_00000005.npy"))
+    # versioned_series_gap (advisory): window ends 2,4,8 at hop 2
+    for end in (2, 4, 8):
+        q.results.put_versioned(f"{jid}.w{end:09d}",
+                                {"window_end": end}, series=jid)
+    q.results.flush()
+
+    # orphan_open + the tear go in LAST: any store write after them
+    # would refresh the segment index, whose own recovery would
+    # salvage/quarantine the seeds before fsck ever sees them
+    app = SegmentAppender(segdir)
+    app.add("orphanrow", {"v": 1.0})
+    app._fh.close()
+    orphan_open = os.path.join(
+        segdir, f"seg-00000000000000001-{DEAD_PID}-0001.open")
+    os.rename(app.path_open, orphan_open)
+    _backdate(orphan_open)
+    with open(torn, "r+b") as fh:
+        fh.truncate(os.path.getsize(torn) - 12)
+    return q, t0
+
+
+ALL_CLASSES = {"orphan_tmp", "orphan_open", "torn_segment",
+               "corrupt_record", "queued_terminal_twin",
+               "queued_misplaced", "expired_lease", "stale_drain",
+               "stream_cursor_ahead", "feed_orphan_chunk"}
+
+
+def test_fsck_detects_every_class_and_repair_converges(tmp_path):
+    qdir = str(tmp_path / "q")
+    _seed_every_class(tmp_path, qdir)
+    future = time.time() + 3600.0
+
+    dry = fsck.run_fsck(qdir, now=future)
+    assert set(dry["classes"]) == ALL_CLASSES, dry["classes"]
+    assert not dry["clean"] and dry["repaired"] == 0
+    assert [a["cls"] for a in dry["advisories"]] \
+        == ["versioned_series_gap"]
+    # dry-run never repairs: findings are ordered by catalog class
+    order = [f["cls"] for f in dry["findings"]]
+    assert order == sorted(order, key=fsck._CLS_ORDER.index)
+
+    rep = fsck.run_fsck(qdir, repair=True, now=future)
+    assert rep["clean"], rep["findings"]
+    assert all(f["repaired"] for f in rep["findings"])
+
+    again = fsck.run_fsck(qdir, now=future)
+    assert again["clean"] and not again["findings"], again["findings"]
+    # the advisory survives (no repair action exists; the replay heals
+    # it) and still does not block a clean report
+    assert [a["cls"] for a in again["advisories"]] \
+        == ["versioned_series_gap"]
+
+    # repairs really converged into the planes' own shapes
+    q = JobQueue(qdir)
+    assert q._ids("leased") == []            # reaped back to queued
+    man = json.loads(open(os.path.join(
+        str(tmp_path / "feed"), "MANIFEST.json")).read())
+    assert {int(c["seq"]) for c in man["chunks"]} == {0, 1, 5}
+    meta = q.results.get_meta("stream.streamfsck01") or {}
+    assert int(meta.get("consumed", 0)) == 0
+
+
+def test_torn_segment_salvage_preserves_scan_position(tmp_path):
+    """The salvaged segment seals at the original's name position
+    (stem + ``s``) — a late salvage must not resurrect stale rows past
+    newer writes in the newest-first name order."""
+    qdir = str(tmp_path / "q")
+    q = JobQueue(qdir)
+    q.results.put_new_buffered("rowk", {"x": 1.0})
+    q.results.flush()
+    segdir = q.results.segments.dir
+    seg = [n for n in os.listdir(segdir) if n.endswith(".seg")][0]
+    path = os.path.join(segdir, seg)
+    with open(path, "r+b") as fh:
+        fh.truncate(os.path.getsize(path) - 12)
+
+    rep = fsck.run_fsck(qdir, repair=True)
+    assert [f["cls"] for f in rep["findings"]] == ["torn_segment"]
+    assert rep["clean"]
+    names = set(os.listdir(segdir))
+    assert seg + ".corrupt" in names
+    assert seg[: -len(".seg")] + "s.seg" in names
+    assert fsck.run_fsck(qdir)["clean"]
+
+
+def test_fresh_litter_is_left_alone(tmp_path):
+    """A dead-pid ``.tmp`` younger than the remote-writer grace is NOT
+    flagged (pid liveness doesn't cross hosts) — and an empty queue
+    dir is clean."""
+    qdir = str(tmp_path / "q")
+    JobQueue(qdir)
+    assert fsck.run_fsck(qdir)["clean"]
+    path = _seed_orphan_tmp(qdir)
+    os.utime(path)                          # fresh again
+    rep = fsck.run_fsck(qdir)
+    assert rep["clean"] and not rep["findings"]
+
+
+def test_fsck_cli_exit_codes_snapshot_and_fleet_render(tmp_path):
+    qdir = str(tmp_path / "q")
+    JobQueue(qdir)
+    _seed_orphan_tmp(qdir)
+
+    assert cli.main(["fsck", qdir]) == 1     # findings -> exit 1
+    snap = fsck.read_fsck_status(qdir)
+    assert snap["findings"] == 1 and not snap["clean"]
+    assert snap["classes"] == {"orphan_tmp": 1}
+
+    assert cli.main(["fsck", qdir, "--repair", "--json"]) == 0
+    snap = fsck.read_fsck_status(qdir)
+    assert snap["clean"] and snap["repaired"] == 1
+
+    # the snapshot rides the fleet rollup into `fleet status`
+    from scintools_tpu.obs.fleet import (fleet_rollup, queue_extras,
+                                         render_fleet)
+
+    extras = queue_extras(qdir)
+    assert extras["fsck"]["clean"]
+    rollup = fleet_rollup([])
+    rollup.update(extras)
+    text = render_fleet(rollup)
+    assert "fsck (last audit, repair): clean" in text
+
+    assert cli.main(["fsck", qdir]) == 0     # converged
+
+
+def test_fsck_counters_and_report_shape(tmp_path, capsys):
+    qdir = str(tmp_path / "q")
+    JobQueue(qdir)
+    _seed_orphan_tmp(qdir)
+    obs.enable()
+    rep = fsck.run_fsck(qdir, repair=True)
+    c = obs.counters()
+    assert c.get("fsck_runs") == 1
+    assert c.get("fsck_findings") == 1
+    assert c.get("fsck_findings[orphan_tmp]") == 1
+    assert c.get("fsck_repairs[orphan_tmp]") == 1
+
+    for key in ("kind", "v", "qdir", "ts", "repair", "findings",
+                "advisories", "classes", "repaired", "clean"):
+        assert key in rep, key
+    f = rep["findings"][0]
+    assert set(f) == {"cls", "path", "detail", "action", "repaired"}
+    text = fsck.render_report(rep)
+    assert "orphan_tmp" in text and "repaired" in text
+
+    assert cli.main(["fsck", qdir, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["kind"] == "fsck" and out["clean"]

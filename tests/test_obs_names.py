@@ -95,13 +95,16 @@ def test_catalog_is_consistent_and_covers_the_known_floor():
     # streaming plane's chunks_quarantined / stream_lag_s totals
     # beside their per-reason / per-feed families — ISSUE 15 — and
     # queue_wait_s, whose total counter/hist ride beside the per-lane
-    # SLO family — ISSUE 16)
+    # SLO family — ISSUE 16 — and the crash-consistency plane's
+    # fsio_write_errors / fsck_findings / fsck_repairs totals beside
+    # their per-plane / per-invariant-class families — ISSUE 20)
     overlap = (set(cat["families"])
                & (set(cat["counters"]) | set(cat["gauges"])))
     assert overlap == {"faults_injected", "epochs_quarantined",
                        "queue_depth", "jit_cache_miss",
                        "chunks_quarantined", "stream_lag_s",
-                       "queue_wait_s"}, overlap
+                       "queue_wait_s", "fsio_write_errors",
+                       "fsck_findings", "fsck_repairs"}, overlap
 
 
 def test_lint_covers_alert_lifecycle_and_slo_families(tmp_path):

@@ -732,6 +732,12 @@ def test_worker_sigkill_mid_batch_resumes_bit_identical(tmp_path):
     assert stats["jobs_done"] == 6 and stats["jobs_failed"] == 0
     assert stats["job_retries"] >= 4   # the reclaimed leases
     assert queue.empty() and queue.counts()["done"] == 6
+    # and the recovered directory passes a dry-run crash-consistency
+    # audit: the SIGKILL left nothing fsck would need to repair
+    from scintools_tpu.serve.fsck import run_fsck
+
+    report = run_fsck(qdir)
+    assert report["clean"], report["findings"]
     # exactly one result row per epoch: idempotent content keys
     assert len(queue.results.keys()) == 6
 

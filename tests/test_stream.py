@@ -752,6 +752,12 @@ def test_sigkill_streaming_worker_resumes_from_manifest(tmp_path,
         assert hop_name in names, (hop_name, names)
     assert len(t["pids"]) >= 3
     assert t["orphans"] == []
+    # and the recovered directory passes a dry-run crash-consistency
+    # audit: the SIGKILL left nothing fsck would need to repair
+    from scintools_tpu.serve.fsck import run_fsck
+
+    report = run_fsck(qdir)
+    assert report["clean"], report["findings"]
 
 
 # ---------------------------------------------------------------------------
